@@ -1,0 +1,105 @@
+"""paddle_tpu: a TPU-native deep-learning framework with PaddlePaddle's capabilities.
+
+Built directly on XLA via JAX (jit/pjit/shard_map) with Pallas kernels for the
+fused-op hot list. The public namespace mirrors `paddle.*` (reference:
+python/paddle/__init__.py) so reference users can switch with an import rename.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+import warnings as _warnings
+
+# int64 requests truncate to int32 with x64 disabled (the right tradeoff on
+# TPU); the behavior is intended, silence the per-call warning.
+_warnings.filterwarnings(
+    "ignore", message="Explicitly requested dtype.*is not available")
+
+# Core tensor + autograd.
+from .tensor import Tensor, Parameter, to_tensor, is_tensor  # noqa: F401
+from .framework.dtype import (  # noqa: F401
+    bfloat16, bool_, complex64, complex128, float16, float32, float64,
+    get_default_dtype, int8, int16, int32, int64, set_default_dtype, uint8,
+)
+from .framework.random import seed, get_rng_state, set_rng_state  # noqa: F401
+from .framework.flags import set_flags, get_flags  # noqa: F401
+from .autograd import no_grad, enable_grad, grad, is_grad_enabled, set_grad_enabled  # noqa: F401
+
+# Ops: importing attaches Tensor methods and fills the functional namespace.
+from . import ops as _ops_pkg  # noqa: F401
+from .ops.creation import (  # noqa: F401
+    arange, assign, cast, clone, complex, diag, diag_embed, diagflat, empty,
+    empty_like, eye, full, full_like, linspace, logspace, meshgrid, numel, ones,
+    ones_like, polar, rank, shape, tril, tril_indices, triu, triu_indices, zeros,
+    zeros_like,
+)
+from .ops.math import (  # noqa: F401
+    abs, acos, acosh, add, add_, add_n, addmm, amax, amin, angle, asin, asinh,
+    atan, atan2, atanh, ceil, ceil_, clip, clip_, conj, copysign, cos, cosh,
+    cummax, cummin, cumprod, cumsum, deg2rad, diff, digamma, divide, divide_,
+    erf, erfinv, exp, exp_, expm1, floor, floor_, floor_divide, floor_mod, fmax,
+    fmin, frac, gcd, heaviside, hypot, i0, i0e, i1, i1e, imag, increment, inner,
+    isfinite, isinf, isnan, kron, lcm, ldexp, lgamma, log, log1p, log2, log10,
+    logaddexp, logcumsumexp, logit, logsumexp, max, maximum, min, minimum, mod,
+    multiply, multiply_, multiply_no_nan, nan_to_num, neg, nextafter, outer, pow,
+    prod, rad2deg, real, reciprocal, reciprocal_, remainder, remainder_, round,
+    round_, rsqrt, rsqrt_, scale, scale_, sigmoid, sign, signbit, sin, sinh, sqrt,
+    sqrt_, square, stanh, subtract, subtract_, sum, tan, tanh, tanh_, trapezoid,
+    trunc,
+)
+from .ops.linalg import (  # noqa: F401
+    bincount, bmm, cholesky, cholesky_solve, corrcoef, cov, cross, det, dist,
+    dot, eig, eigh, eigvals, eigvalsh, einsum, histogram, histogramdd,
+    householder_product, inverse, lstsq, lu, matmul, matrix_power, matrix_rank,
+    mm, multi_dot, mv, norm, pinv, qr, slogdet, solve, svd, svdvals,
+    triangular_solve,
+)
+from .ops.logic import (  # noqa: F401
+    all, allclose, any, bitwise_and, bitwise_left_shift, bitwise_not, bitwise_or,
+    bitwise_right_shift, bitwise_xor, equal, equal_all, greater_equal,
+    greater_than, is_complex, is_empty, is_floating_point, is_integer, isclose,
+    less_equal, less_than, logical_and, logical_not, logical_or, logical_xor,
+    not_equal,
+)
+from .ops.manipulation import (  # noqa: F401
+    as_complex, as_real, atleast_1d, atleast_2d, atleast_3d, broadcast_shape,
+    broadcast_tensors, broadcast_to, chunk, concat, crop, dstack, expand,
+    expand_as, flatten, flip, gather, gather_nd, hstack, index_add, index_add_,
+    index_put, index_put_, index_sample, index_select, masked_fill,
+    masked_fill_, masked_scatter, masked_select, matrix_transpose, moveaxis,
+    put_along_axis, repeat_interleave, reshape, reshape_, roll, rot90, scatter,
+    scatter_, scatter_nd, scatter_nd_add, slice, split, squeeze, squeeze_,
+    stack, strided_slice, t, take, take_along_axis, tensordot, tile, transpose,
+    unbind, unique, unique_consecutive, unsqueeze, unsqueeze_, unstack, view,
+    view_as, vstack,
+)
+from .ops.search import (  # noqa: F401
+    argmax, argmin, argsort, bucketize, count_nonzero, index_fill, index_fill_,
+    kthvalue, mode, nonzero, searchsorted, sort, topk, where, where_,
+)
+from .ops.stat import (  # noqa: F401
+    mean, median, nanmean, nanmedian, nanquantile, nansum, quantile, std, var,
+)
+from .ops.random_ops import (  # noqa: F401
+    bernoulli, bernoulli_, binomial, multinomial, normal, poisson, rand,
+    rand_like, randint, randint_like, randn, randn_like, randperm,
+    standard_normal, uniform, uniform_,
+)
+
+from . import autograd  # noqa: F401
+from . import framework  # noqa: F401
+from . import linalg  # noqa: F401
+
+# Subsystem namespaces (populated incrementally; mirror paddle.* submodules).
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import io  # noqa: F401
+from . import amp  # noqa: F401
+from . import jit  # noqa: F401
+from . import distributed  # noqa: F401
+from . import vision  # noqa: F401
+from . import device  # noqa: F401
+from .framework.io import save, load  # noqa: F401
+from .nn.layer.layers import disable_static, enable_static, in_dynamic_mode  # noqa: F401
+
+DataParallel = distributed.DataParallel

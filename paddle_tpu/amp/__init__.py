@@ -1,0 +1,192 @@
+"""Automatic mixed precision.
+
+Reference parity: python/paddle/amp/ (auto_cast.py, GradScaler grad_scaler.py:657,
+amp_lists.py) + the C++ autocast interception (paddle/fluid/eager/amp_auto_cast.h).
+TPU-native: the natural compute dtype is bfloat16 — no loss scaling is required
+for bf16 (GradScaler becomes a transparent pass-through, same as the reference's
+bf16 path); autocast intercepts at op dispatch, casting matmul/conv inputs.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax.numpy as jnp
+
+from ..framework.dtype import convert_dtype
+from ..tensor import Tensor
+
+# Ops cast to low precision under autocast (parity: amp_lists white list).
+WHITE_LIST = {"matmul", "linear", "conv1d", "conv2d", "conv3d", "bmm", "mm",
+              "mv", "einsum", "flash_attention", "sdpa", "addmm"}
+# Ops forced to fp32 (parity: black list).
+BLACK_LIST = {"exp", "log", "log2", "log10", "mean", "sum", "softmax",
+              "log_softmax", "cross_entropy", "layer_norm", "batch_norm",
+              "group_norm", "instance_norm", "rms_norm", "norm", "cumsum",
+              "logsumexp", "erfinv", "pow"}
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = jnp.bfloat16
+        self.level = "O1"
+        self.custom_white = set()
+        self.custom_black = set()
+
+
+amp_state = _AmpState()
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    """Parity: paddle.amp.auto_cast."""
+    prev = (amp_state.enabled, amp_state.dtype, amp_state.level,
+            amp_state.custom_white, amp_state.custom_black)
+    amp_state.enabled = enable
+    amp_state.dtype = convert_dtype(dtype)
+    amp_state.level = level
+    amp_state.custom_white = set(custom_white_list or ())
+    amp_state.custom_black = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        (amp_state.enabled, amp_state.dtype, amp_state.level,
+         amp_state.custom_white, amp_state.custom_black) = prev
+
+
+amp_guard = auto_cast
+
+
+def _maybe_cast(op_name, arrays):
+    """Called from ops.dispatch when amp is enabled."""
+    if not amp_state.enabled:
+        return arrays
+    white = (WHITE_LIST | amp_state.custom_white) - amp_state.custom_black
+    low = amp_state.dtype
+    if op_name in white:
+        return tuple(a.astype(low) if hasattr(a, "dtype")
+                     and a.dtype == jnp.float32 else a for a in arrays)
+    if amp_state.level == "O2" and op_name not in (
+            BLACK_LIST | amp_state.custom_black):
+        return tuple(a.astype(low) if hasattr(a, "dtype")
+                     and a.dtype == jnp.float32 else a for a in arrays)
+    if op_name in (BLACK_LIST | amp_state.custom_black):
+        return tuple(a.astype(jnp.float32) if hasattr(a, "dtype")
+                     and a.dtype == low else a for a in arrays)
+    return arrays
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """Parity: paddle.amp.decorate. For O2, casts model params to low precision."""
+    single_model = not isinstance(models, (list, tuple))
+    model_list = [models] if single_model else list(models)
+    if level == "O2":
+        for m in model_list:
+            m.to(dtype=dtype)
+    if optimizers is None:
+        return models if single_model else model_list
+    return (models if single_model else model_list), optimizers
+
+
+class GradScaler:
+    """Parity: paddle.amp.GradScaler (grad_scaler.py:657).
+
+    With bf16 (TPU default) scaling is unnecessary: enable=False behavior.
+    The fp16 path implements dynamic loss scaling for parity.
+    """
+
+    def __init__(self, enable=True, init_loss_scaling=65536.0,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=2000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+        self._unscaled_opts = set()  # ids of optimizers already unscaled this step
+
+    def scale(self, loss):
+        if not self._enable:
+            return loss
+        return loss * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        if id(optimizer) in self._unscaled_opts:
+            return  # parity: avoid double-unscale in the clip-then-step pattern
+        self._unscaled_opts.add(id(optimizer))
+        inv = 1.0 / self._scale
+        found = False
+        for p in optimizer._parameter_list or []:
+            if p.grad is not None:
+                g = p.grad._data * inv
+                if not bool(jnp.isfinite(g).all()):
+                    found = True
+                p.grad = Tensor(g)
+        self._found_inf = found
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self._unscaled_opts.discard(id(optimizer))
+        self.update()
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+
+    def update(self):
+        if not self._dynamic:
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_init_loss_scaling(self):
+        return self._scale
+
+    def state_dict(self):
+        return {"scale": self._scale, "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, state):
+        self._scale = state["scale"]
+        self._good_steps = state["good_steps"]
+        self._bad_steps = state["bad_steps"]
+
+
+def is_float16_supported(device=None):
+    return True
+
+
+def is_bfloat16_supported(device=None):
+    return True

@@ -1,0 +1,100 @@
+"""Autograd package.
+
+Reference parity: paddle.autograd (PyLayer python/paddle/autograd/py_layer.py:282,
+paddle.grad, no_grad). The engine itself lives in tape.py/backward.py.
+"""
+from __future__ import annotations
+
+from .tape import Node, no_grad, enable_grad, is_grad_enabled, set_grad_enabled
+from .backward import grad, run_backward
+
+__all__ = ["no_grad", "enable_grad", "is_grad_enabled", "set_grad_enabled",
+           "grad", "backward", "PyLayer", "PyLayerContext"]
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """Parity: paddle.autograd.backward."""
+    if not isinstance(tensors, (list, tuple)):
+        tensors = [tensors]
+    run_backward(list(tensors), grad_tensors, retain_graph=retain_graph)
+
+
+class PyLayerContext:
+    """Parity: paddle.autograd.PyLayerContext."""
+
+    def __init__(self):
+        self._saved = ()
+        self.needs_input_grad = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    def saved_tensor(self):
+        return self._saved
+
+
+class PyLayer:
+    """User-defined differentiable op (parity: paddle.autograd.PyLayer).
+
+    Subclass with @staticmethod forward(ctx, *args, **kwargs) and
+    backward(ctx, *output_grads) returning one grad per *Tensor* input of forward
+    (None allowed for non-differentiable inputs).
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from ..tensor import Tensor
+        import jax.numpy as jnp
+
+        ctx = PyLayerContext()
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        ctx.needs_input_grad = tuple(not t.stop_gradient for t in tensor_inputs)
+        need_grad = is_grad_enabled() and any(ctx.needs_input_grad)
+
+        with no_grad():
+            outputs = cls.forward(ctx, *args, **kwargs)
+
+        single = not isinstance(outputs, (tuple, list))
+        out_list = [outputs] if single else list(outputs)
+        out_tensors = [o for o in out_list if isinstance(o, Tensor)]
+        if not need_grad:
+            return outputs
+
+        diff_inputs = [t for t in tensor_inputs if not t.stop_gradient]
+        diff_pos = [i for i, t in enumerate(tensor_inputs) if not t.stop_gradient]
+        out_specs = [(tuple(o.shape), o.dtype) for o in out_tensors]
+
+        def vjp_fn(cts):
+            if len(out_tensors) == 1:
+                cts = (cts,)
+            grads = cls.backward(ctx, *[Tensor(c) for c in cts])
+            if not isinstance(grads, (tuple, list)):
+                grads = (grads,)
+            if len(grads) != len(tensor_inputs):
+                raise RuntimeError(
+                    f"{cls.__name__}.backward returned {len(grads)} grads for "
+                    f"{len(tensor_inputs)} tensor inputs")
+            out = []
+            for i in diff_pos:
+                g = grads[i]
+                out.append(None if g is None else
+                           (g._data if isinstance(g, Tensor) else jnp.asarray(g)))
+            return tuple(out)
+
+        node = Node(cls.__name__, vjp_fn, diff_inputs, out_specs)
+        k = 0
+        for o in out_list:
+            if isinstance(o, Tensor):
+                o._node = node
+                o._out_index = k
+                o.stop_gradient = False
+                k += 1
+        return outputs

@@ -1,0 +1,247 @@
+"""Backward engine: topological sweep over the vjp tape.
+
+Reference parity: egr::RunBackward (paddle/fluid/eager/backward.cc:106 — in-degree
+map + ready-queue execution) and paddle.grad (backward.cc:484). TPU-native design:
+nodes hold jax.vjp closures; executing one is a cached-XLA call chain, no kernel
+dispatch machinery needed.
+"""
+from __future__ import annotations
+
+import weakref
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .tape import Node
+
+# id(tensor) -> [hook, ...]; applied to the gradient when it is materialized.
+# Keyed by id (Tensor.__eq__ is elementwise, so Tensors can't be dict keys);
+# a weakref.finalize per tensor clears the slot when the tensor dies.
+_tensor_hooks: dict = {}
+
+
+class RemovableHandle:
+    def __init__(self, store, key, hook):
+        self._store, self._key, self._hook = store, key, hook
+
+    def remove(self):
+        hooks = self._store.get(self._key)
+        if hooks and self._hook in hooks:
+            hooks.remove(self._hook)
+
+
+def register_tensor_hook(tensor, hook):
+    tid = id(tensor)
+    if tid not in _tensor_hooks:
+        _tensor_hooks[tid] = []
+        weakref.finalize(tensor, _tensor_hooks.pop, tid, None)
+    hooks = _tensor_hooks[tid]
+    hooks.append(hook)
+    node = tensor._node
+    if node is not None:
+        # Intermediate tensor: remember it on its producing node so the sweep can
+        # apply hooks to the cotangent flowing through this output slot.
+        if node.post_hooks is None:
+            node.post_hooks = [None] * node.n_out
+        node.post_hooks[tensor._out_index] = weakref.ref(tensor)
+    return RemovableHandle(_tensor_hooks, tid, hook)
+
+
+def _zero_ct(spec):
+    shape, dtype = spec
+    if jnp.issubdtype(dtype, jnp.floating) or jnp.issubdtype(dtype, jnp.complexfloating):
+        return jnp.zeros(shape, dtype)
+    return np.zeros(shape, jax.dtypes.float0)
+
+
+def _apply_hooks(tensor, grad_arr):
+    from ..tensor import Tensor
+    hooks = _tensor_hooks.get(id(tensor))
+    if not hooks:
+        return grad_arr
+    for hook in hooks:
+        out = hook(Tensor(grad_arr))
+        if out is not None:
+            grad_arr = out._data if isinstance(out, Tensor) else jnp.asarray(out)
+    return grad_arr
+
+
+def _topo_order(seed_nodes) -> List[Node]:
+    """Post-order DFS (iterative) producing forward-topological node order."""
+    order, state = [], {}
+    for root in seed_nodes:
+        if id(root) in state:
+            continue
+        stack = [(root, False)]
+        while stack:
+            node, processed = stack.pop()
+            if processed:
+                order.append(node)
+                state[id(node)] = 2
+                continue
+            if state.get(id(node)):
+                continue
+            state[id(node)] = 1
+            stack.append((node, True))
+            for inp in node.inputs:
+                n = inp._node
+                if n is not None and not state.get(id(n)):
+                    stack.append((n, False))
+    return order
+
+
+def _accumulate(slot_map, node, idx, ct):
+    slots = slot_map[id(node)]
+    slots[idx] = ct if slots[idx] is None else slots[idx] + ct
+
+
+def run_backward(tensors, grad_tensors=None, retain_graph: bool = False,
+                 inputs=None, accumulate_into_leaf: bool = True
+                 ) -> Optional[List]:
+    """Run reverse-mode sweep.
+
+    If `inputs` is None: accumulate into .grad of every reachable leaf
+    (Tensor.backward semantics). Else: return grads for exactly `inputs`
+    (paddle.grad semantics), without touching .grad.
+    """
+    from ..tensor import Tensor
+
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+
+    # Seed cotangents.
+    slot_map: Dict[int, List] = {}
+    leaf_grads: Dict[int, jax.Array] = {}  # id(tensor) -> grad array
+    wanted: Optional[Dict[int, Tuple[int, Tensor]]] = None
+    if inputs is not None:
+        wanted = {id(t): (i, t) for i, t in enumerate(inputs)}
+
+    seed_nodes = []
+    for t, g in zip(tensors, grad_tensors):
+        if g is None:
+            # parity: the reference seeds all-ones for ANY shape
+            # (paddle/fluid/eager/backward.cc — FillConstant 1.0 seed grads)
+            g_arr = jnp.ones_like(t._data)
+        else:
+            g_arr = g._data if isinstance(g, Tensor) else jnp.asarray(g)
+        node = t._node
+        if node is None:
+            if not t.stop_gradient:
+                prev = leaf_grads.get(id(t))
+                leaf_grads[id(t)] = g_arr if prev is None else prev + g_arr
+            continue
+        if id(node) not in slot_map:
+            slot_map[id(node)] = [None] * node.n_out
+            seed_nodes.append(node)
+        _accumulate(slot_map, node, t._out_index, g_arr)
+
+    order = _topo_order(seed_nodes)
+
+    # Keep strong refs to leaf tensors we touch (for .grad write-back).
+    leaves: Dict[int, Tensor] = {}
+    for t in tensors:
+        if t._node is None:
+            leaves[id(t)] = t
+
+    # Grads requested for non-leaf inputs are read off their producing node's
+    # output slot right before that node executes (slots are freed afterwards).
+    hooked_tids: set = set()
+    wanted_by_slot: Dict[Tuple[int, int], int] = {}
+    if wanted is not None:
+        for tid, (_pos, t) in wanted.items():
+            if t._node is not None:
+                wanted_by_slot[(id(t._node), t._out_index)] = tid
+
+    # Reverse sweep.
+    for node in reversed(order):
+        slots = slot_map.get(id(node))
+        if slots is None:
+            continue
+        cts = tuple(s if s is not None else _zero_ct(spec)
+                    for s, spec in zip(slots, node.out_specs))
+        # Tensor-level hooks on this node's outputs.
+        if node.post_hooks:
+            new_cts = []
+            for i, c in enumerate(cts):
+                ref = node.post_hooks[i] if i < len(node.post_hooks) else None
+                t = ref() if ref is not None else None
+                new_cts.append(_apply_hooks(t, c) if t is not None else c)
+            cts = tuple(new_cts)
+        if wanted_by_slot:
+            for i in range(node.n_out):
+                tid = wanted_by_slot.get((id(node), i))
+                if tid is not None and slots[i] is not None:
+                    prev = leaf_grads.get(tid)
+                    leaf_grads[tid] = cts[i] if prev is None else prev
+                    hooked_tids.add(tid)  # hooks already applied via post_hooks
+        in_cts = node.vjp_fn(cts if node.n_out > 1 else cts[0])
+        if not isinstance(in_cts, tuple):
+            in_cts = (in_cts,)
+        for inp, ct in zip(node.inputs, in_cts):
+            if ct is None or (hasattr(ct, "dtype") and ct.dtype == jax.dtypes.float0):
+                continue
+            child = inp._node
+            if child is not None:
+                if id(child) not in slot_map:
+                    slot_map[id(child)] = [None] * child.n_out
+                _accumulate(slot_map, child, inp._out_index, ct)
+            elif not inp.stop_gradient:
+                prev = leaf_grads.get(id(inp))
+                leaf_grads[id(inp)] = ct if prev is None else prev + ct
+                leaves[id(inp)] = inp
+        if not retain_graph:
+            slot_map.pop(id(node), None)
+
+    # Write back / collect.
+    if wanted is not None:
+        result: List[Optional[Tensor]] = [None] * len(inputs)
+        for tid, (pos, t) in wanted.items():
+            g = leaf_grads.get(tid)
+            if g is not None:
+                if tid not in hooked_tids:
+                    g = _apply_hooks(t, g)
+                result[pos] = Tensor(g)
+        return result
+
+    for tid, t in leaves.items():
+        g = leaf_grads.get(tid)
+        if g is None:
+            continue
+        g = _apply_hooks(t, g)
+        if accumulate_into_leaf and t.grad is not None:
+            t.grad = Tensor(t.grad._data + g)
+        else:
+            t.grad = Tensor(g)
+    return None
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph: bool = False, only_inputs: bool = True,
+         allow_unused: bool = False, no_grad_vars=None):
+    """Parity: paddle.grad (python/paddle/base/dygraph/base.py)."""
+    from ..tensor import Tensor
+    del only_inputs, no_grad_vars
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True (double backward) is not supported yet; "
+            "use jax-level jax.grad composition via paddle_tpu.jit for higher-order.")
+    single = isinstance(inputs, Tensor)
+    if single:
+        inputs = [inputs]
+    if isinstance(outputs, Tensor):
+        outputs = [outputs]
+    res = run_backward(list(outputs), grad_outputs,
+                       retain_graph=bool(retain_graph), inputs=list(inputs))
+    if not allow_unused:
+        for r, i in zip(res, inputs):
+            if r is None:
+                raise RuntimeError(
+                    "One of the differentiated Tensors appears unused in the graph; "
+                    "pass allow_unused=True to return None for it.")
+    return res[0] if single else res

@@ -1,0 +1,83 @@
+"""Eager autograd tape.
+
+Reference parity: the eager autograd engine (paddle/fluid/eager/ — GradNodeBase
+grad_node_info.h:197, RunBackward backward.cc:106). TPU-native design: instead of
+per-op hand-written grad nodes, each dispatched op records the `jax.vjp` closure of
+its forward; backward is a topological sweep calling those closures. Residuals live
+on-device inside the vjp closures and are freed when the graph is released.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, List, Optional, Sequence
+
+
+class _GradState(threading.local):
+    def __init__(self):
+        self.enabled = True
+
+
+_grad_state = _GradState()
+
+
+def is_grad_enabled() -> bool:
+    return _grad_state.enabled
+
+
+def set_grad_enabled(mode: bool):
+    _grad_state.enabled = bool(mode)
+
+
+class no_grad:
+    """Context manager / decorator disabling tape recording (parity: paddle.no_grad)."""
+
+    def __enter__(self):
+        self._prev = _grad_state.enabled
+        _grad_state.enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _grad_state.enabled = self._prev
+        return False
+
+    def __call__(self, fn):
+        def wrapper(*args, **kwargs):
+            with no_grad():
+                return fn(*args, **kwargs)
+
+        wrapper.__name__ = getattr(fn, "__name__", "wrapped")
+        return wrapper
+
+
+class enable_grad:
+    def __enter__(self):
+        self._prev = _grad_state.enabled
+        _grad_state.enabled = True
+        return self
+
+    def __exit__(self, *exc):
+        _grad_state.enabled = self._prev
+        return False
+
+
+class Node:
+    """One recorded op application (parity: GradNodeBase).
+
+    vjp_fn: callable mapping a tuple of output cotangents -> tuple of input
+        cotangents, one per entry of `inputs` (the differentiable tensor inputs).
+    inputs: the differentiable input Tensors, in vjp order.
+    out_specs: (shape, dtype) per forward output, for building zero cotangents.
+    """
+
+    __slots__ = ("name", "vjp_fn", "inputs", "out_specs", "n_out", "post_hooks")
+
+    def __init__(self, name: str, vjp_fn, inputs: Sequence[Any], out_specs: List):
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.inputs = list(inputs)
+        self.out_specs = out_specs
+        self.n_out = len(out_specs)
+        self.post_hooks = None
+
+    def __repr__(self):
+        return f"<Node {self.name} n_in={len(self.inputs)} n_out={self.n_out}>"

@@ -1,0 +1,131 @@
+"""Device management.
+
+Reference parity: python/paddle/device/ (set_device, get_device, cuda submodule).
+TPU-native: one logical device namespace over jax.devices(); "gpu" APIs report
+absent (no GPU in the loop), "tpu"/"xpu"-style custom device is the native path.
+"""
+from __future__ import annotations
+
+import jax
+
+
+def _devices():
+    return jax.devices()
+
+
+def get_device() -> str:
+    d = _devices()[0]
+    return f"{d.platform}:{d.id}"
+
+
+def set_device(device: str):
+    return get_device()
+
+
+def get_all_custom_device_type():
+    return ["tpu"] if _devices()[0].platform == "tpu" else []
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_custom_device(device_type: str) -> bool:
+    return device_type in ("tpu",)
+
+
+def is_compiled_with_cinn() -> bool:
+    return True  # XLA is the compiler
+
+
+def device_count() -> int:
+    return len(_devices())
+
+
+class cuda:
+    @staticmethod
+    def device_count():
+        return 0
+
+    @staticmethod
+    def is_available():
+        return False
+
+    @staticmethod
+    def synchronize(device=None):
+        pass
+
+    @staticmethod
+    def empty_cache():
+        pass
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        return 0
+
+    @staticmethod
+    def memory_allocated(device=None):
+        return 0
+
+
+def synchronize(device=None):
+    """Block until all queued device work completes."""
+    for d in _devices():
+        try:
+            d.synchronize_all_activity()
+        except AttributeError:
+            pass
+
+
+class Stream:
+    """XLA manages streams internally; kept for API parity."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize()
+
+    def wait_stream(self, stream):
+        pass
+
+    def record_event(self, event=None):
+        return event or Event()
+
+    def wait_event(self, event):
+        pass
+
+
+class Event:
+    def __init__(self, enable_timing=False, blocking=False, interprocess=False):
+        pass
+
+    def record(self, stream=None):
+        pass
+
+    def query(self):
+        return True
+
+    def synchronize(self):
+        synchronize()
+
+
+def current_stream(device=None):
+    return Stream(device)
+
+
+def set_stream(stream):
+    return stream
+
+
+def stream_guard(stream):
+    import contextlib
+    return contextlib.nullcontext()

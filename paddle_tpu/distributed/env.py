@@ -1,0 +1,89 @@
+"""Distributed environment bootstrap.
+
+Reference parity: paddle.distributed.init_parallel_env (parallel.py:978) and
+ParallelEnv. TPU-native: jax is single-controller-per-host; `rank` maps to the
+process (host) index and `world_size` to process count for multi-host pods.
+Rendezvous uses jax.distributed.initialize (its own TCP store), mirroring the
+reference's MASTER_ADDR/PORT + TCPStore flow (parallel.py:1111-1148).
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+_initialized = [False]
+
+
+def init_parallel_env():
+    if _initialized[0]:
+        return ParallelEnv()
+    coord = os.environ.get("MASTER_ADDR")
+    port = os.environ.get("MASTER_PORT")
+    n_proc = os.environ.get("PADDLE_TRAINERS_NUM") or os.environ.get(
+        "WORLD_SIZE")
+    pid = os.environ.get("PADDLE_TRAINER_ID") or os.environ.get("RANK")
+    if coord and port and n_proc and int(n_proc) > 1:
+        jax.distributed.initialize(
+            coordinator_address=f"{coord}:{port}",
+            num_processes=int(n_proc),
+            process_id=int(pid or 0))
+    _initialized[0] = True
+    return ParallelEnv()
+
+
+def is_initialized() -> bool:
+    return _initialized[0]
+
+
+def get_rank(group=None) -> int:
+    if group is not None:
+        return group.get_group_rank(jax.process_index())
+    return jax.process_index()
+
+
+def get_world_size(group=None) -> int:
+    if group is not None:
+        return group.nranks
+    return jax.process_count()
+
+
+def parallel_device_count() -> int:
+    return jax.local_device_count()
+
+
+class ParallelEnv:
+    """Parity: paddle.distributed.ParallelEnv."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def local_rank(self):
+        return 0
+
+    @property
+    def nranks(self):
+        return get_world_size()
+
+    @property
+    def dev_id(self):
+        return 0
+
+    @property
+    def device_type(self):
+        return jax.devices()[0].platform
+
+    @property
+    def current_endpoint(self):
+        eps = self.trainer_endpoints
+        return eps[self.rank] if self.rank < len(eps) else ""
+
+    @property
+    def trainer_endpoints(self):
+        return os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")

@@ -1,0 +1,48 @@
+"""fleet: hybrid-parallel facade.
+
+Reference parity: python/paddle/distributed/fleet/ (fleet.py:151 init /
+distributed_model / distributed_optimizer; topology.py:189
+HybridCommunicateGroup). TPU-native: the 5-D hybrid topology (dp/pp/mp/sep/
+sharding) becomes a named jax Mesh; "communication groups" are mesh axes.
+"""
+from __future__ import annotations
+
+from .base import (  # noqa: F401
+    DistributedStrategy, Fleet, HybridCommunicateGroup, fleet_instance,
+)
+from . import meta_parallel  # noqa: F401
+from .utils import recompute  # noqa: F401
+
+_fleet = fleet_instance
+
+
+def init(role_maker=None, is_collective=False, strategy=None, log_level="INFO"):
+    return _fleet.init(role_maker, is_collective, strategy, log_level)
+
+
+def distributed_model(model):
+    return _fleet.distributed_model(model)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    return _fleet.distributed_optimizer(optimizer, strategy)
+
+
+def get_hybrid_communicate_group():
+    return _fleet.get_hybrid_communicate_group()
+
+
+def worker_index():
+    return _fleet.worker_index()
+
+
+def worker_num():
+    return _fleet.worker_num()
+
+
+def is_first_worker():
+    return _fleet.worker_index() == 0
+
+
+def barrier_worker():
+    pass

@@ -1,0 +1,272 @@
+"""Fleet core: strategy, topology, facade.
+
+Reference parity: DistributedStrategy (fleet/base/distributed_strategy.py:284,
+proto distributed_strategy.proto:365), HybridCommunicateGroup
+(fleet/base/topology.py:189 — axis order pp->mp->sep->sharding->dp at :298),
+Fleet (fleet/fleet.py:151). TPU-native: the topology materializes one jax Mesh
+whose axis order mirrors the reference's group-creation order so collectives on
+inner axes (mp) land on the fastest ICI rings.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax
+
+from ..group import Group, new_group
+from ..mesh import ProcessMesh, set_mesh
+
+
+class DistributedStrategy:
+    def __init__(self):
+        self.hybrid_configs = {
+            "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+            "sep_degree": 1, "sharding_degree": 1,
+        }
+        self.amp = False
+        self.amp_configs = {}
+        self.recompute = False
+        self.recompute_configs = {}
+        self.sharding = False
+        self.sharding_configs = {}
+        self.pipeline = False
+        self.pipeline_configs = {"accumulate_steps": 1, "micro_batch_size": 1}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1}
+        self.find_unused_parameters = False
+        self.tensor_parallel_configs = {}
+        self.fuse_all_reduce_ops = True
+        self.without_graph_optimization = False
+
+    def __setattr__(self, k, v):
+        if k == "hybrid_configs" and hasattr(self, "hybrid_configs"):
+            merged = dict(self.__dict__["hybrid_configs"])
+            merged.update(v)
+            self.__dict__[k] = merged
+        else:
+            self.__dict__[k] = v
+
+
+class CommunicateTopology:
+    """Parity: fleet/base/topology.py CommunicateTopology."""
+
+    def __init__(self, hybrid_group_names, dims):
+        self._names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self._world = np.arange(int(np.prod(dims))).reshape(dims)
+
+    def get_hybrid_group_names(self):
+        return self._names
+
+    def get_dim(self, name):
+        return self._dims[self._names.index(name)]
+
+    def world_size(self):
+        return int(self._world.size)
+
+    def get_rank(self, **kwargs):
+        coord = tuple(kwargs[n] for n in self._names)
+        return int(self._world[coord])
+
+    def get_coord(self, rank):
+        pos = np.argwhere(self._world == rank)[0]
+        return dict(zip(self._names, pos.tolist()))
+
+    def get_axis_list(self, axis_name, index):
+        axis = self._names.index(axis_name)
+        sl = [slice(None)] * len(self._dims)
+        sl[axis] = index
+        return sorted(self._world[tuple(sl)].reshape(-1).tolist())
+
+    def get_comm_list(self, axis_name):
+        """All groups along `axis_name`: list of rank lists."""
+        axis = self._names.index(axis_name)
+        moved = np.moveaxis(self._world, axis, -1)
+        return moved.reshape(-1, self._dims[axis]).tolist()
+
+
+class HybridCommunicateGroup:
+    """Parity: topology.py:189. Axis order pp->mp->sep->sharding->dp (:298)."""
+
+    AXIS_ORDER = ["pp", "mp", "sep", "sharding", "dp"]
+
+    def __init__(self, strategy: Optional[DistributedStrategy] = None,
+                 topology=None):
+        cfg = (strategy.hybrid_configs if strategy else
+               {"dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+                "sep_degree": 1, "sharding_degree": 1})
+        self._dp_degree = cfg.get("dp_degree", 1)
+        self._mp_degree = cfg.get("mp_degree", 1)
+        self._pp_degree = cfg.get("pp_degree", 1)
+        self._sep_degree = cfg.get("sep_degree", 1)
+        self._sharding_degree = cfg.get("sharding_degree", 1)
+        dims = [self._pp_degree, self._mp_degree, self._sep_degree,
+                self._sharding_degree, self._dp_degree]
+        self._topo = CommunicateTopology(self.AXIS_ORDER, dims)
+        self.nranks = self._topo.world_size()
+        self.global_rank = 0  # single-controller; per-device ranks are virtual
+
+        # One mesh for the whole topology; axes named after hybrid dims.
+        # (jax mesh axis order: outermost..innermost = dp, pp, sep, sharding, mp
+        #  so mp lands on adjacent devices / fastest ICI.)
+        mesh_dims = {"dp": self._dp_degree, "pp": self._pp_degree,
+                     "sep": self._sep_degree, "sharding": self._sharding_degree,
+                     "mp": self._mp_degree}
+        names = [n for n, d in mesh_dims.items()]
+        shape = [mesh_dims[n] for n in names]
+        if int(np.prod(shape)) <= jax.device_count():
+            self.mesh = ProcessMesh(shape=shape, dim_names=names,
+                                    process_ids=list(range(int(np.prod(shape)))))
+            set_mesh(self.mesh)
+        else:
+            self.mesh = None  # topology larger than local devices (multi-host)
+
+        self._dp_group = new_group(list(range(self._dp_degree)), axis_name="dp")
+        self._mp_group = new_group(list(range(self._mp_degree)), axis_name="mp")
+        self._pp_group = new_group(list(range(self._pp_degree)), axis_name="pp")
+        self._sep_group = new_group(list(range(self._sep_degree)),
+                                    axis_name="sep")
+        self._sharding_group = new_group(list(range(self._sharding_degree)),
+                                         axis_name="sharding")
+
+    # topology info -----------------------------------------------------------
+    def get_parallel_mode(self):
+        if self._pp_degree > 1:
+            return "pipeline"
+        if self._mp_degree > 1 or self._sep_degree > 1:
+            return "model" if self._mp_degree > 1 else "segment"
+        if self._sharding_degree > 1:
+            return "sharding"
+        return "data"
+
+    def topology(self):
+        return self._topo
+
+    # degrees / ranks ---------------------------------------------------------
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_sep_parallel_world_size(self):
+        return self._sep_degree
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_stage_id(self):
+        return 0
+
+    def get_sep_parallel_rank(self):
+        return 0
+
+    def get_sharding_parallel_rank(self):
+        return 0
+
+    # groups ------------------------------------------------------------------
+    def get_data_parallel_group(self) -> Group:
+        return self._dp_group
+
+    def get_model_parallel_group(self) -> Group:
+        return self._mp_group
+
+    def get_pipe_parallel_group(self) -> Group:
+        return self._pp_group
+
+    def get_sep_parallel_group(self) -> Group:
+        return self._sep_group
+
+    def get_sharding_parallel_group(self) -> Group:
+        return self._sharding_group
+
+    def get_check_parallel_group(self, sharding=False) -> Group:
+        return self._mp_group
+
+    def get_data_parallel_group_src_rank(self):
+        return 0
+
+    def get_model_parallel_group_src_rank(self):
+        return 0
+
+    def get_rank_from_stage(self, stage_id, **kwargs):
+        return stage_id
+
+    # pp helpers --------------------------------------------------------------
+    @property
+    def is_first_stage(self):
+        return self.get_stage_id() == 0
+
+    @property
+    def is_last_stage(self):
+        return self.get_stage_id() == self._pp_degree - 1
+
+    def get_p2p_groups(self):
+        return None
+
+
+class Fleet:
+    """Parity: fleet/fleet.py:151."""
+
+    def __init__(self):
+        self._is_initialized = False
+        self._strategy: Optional[DistributedStrategy] = None
+        self._hcg: Optional[HybridCommunicateGroup] = None
+
+    def init(self, role_maker=None, is_collective=False, strategy=None,
+             log_level="INFO"):
+        from ..env import init_parallel_env
+        init_parallel_env()
+        self._strategy = strategy or DistributedStrategy()
+        self._hcg = HybridCommunicateGroup(self._strategy)
+        self._is_initialized = True
+        return self
+
+    @property
+    def is_initialized(self):
+        return self._is_initialized
+
+    def get_hybrid_communicate_group(self) -> HybridCommunicateGroup:
+        if self._hcg is None:
+            self._hcg = HybridCommunicateGroup(self._strategy)
+        return self._hcg
+
+    def worker_index(self):
+        return jax.process_index()
+
+    def worker_num(self):
+        return jax.process_count()
+
+    def distributed_model(self, model):
+        """Parity: fleet/model.py:33 — wrap by parallel mode."""
+        hcg = self.get_hybrid_communicate_group()
+        mode = hcg.get_parallel_mode()
+        from .meta_parallel import (PipelineParallel, ShardingParallel,
+                                    TensorParallel)
+        from ..parallel import DataParallel
+        if mode == "pipeline":
+            return PipelineParallel(model, hcg, self._strategy)
+        if mode == "model":
+            return TensorParallel(model, hcg, self._strategy)
+        if mode == "sharding":
+            return ShardingParallel(model, hcg, self._strategy)
+        return DataParallel(model)
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        from .meta_parallel import HybridParallelOptimizer
+        hcg = self.get_hybrid_communicate_group()
+        return HybridParallelOptimizer(optimizer, hcg,
+                                       strategy or self._strategy)
+
+
+fleet_instance = Fleet()

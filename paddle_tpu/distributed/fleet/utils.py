@@ -1,0 +1,86 @@
+"""fleet.utils: recompute (activation checkpointing) and helpers.
+
+Reference parity: fleet/recompute/recompute.py:128 (RecomputeFunction with RNG
+state preservation) and recompute_sequential :630. TPU-native: jax.checkpoint
+(rematerialization) IS activation checkpointing, applied at trace time inside
+compiled programs; the eager path preserves RNG state and replays forward under
+grad, matching the reference semantics.
+"""
+from __future__ import annotations
+
+import jax
+
+from ...autograd import PyLayer
+from ...autograd.tape import no_grad
+from ...framework.random import get_rng_state, set_rng_state
+from ...tensor import Tensor
+
+
+class _RecomputeFunction(PyLayer):
+    @staticmethod
+    def forward(ctx, run_function, preserve_rng_state, *args):
+        ctx.run_function = run_function
+        ctx.preserve_rng = preserve_rng_state
+        if preserve_rng_state:
+            ctx.rng_state = get_rng_state()
+        ctx.inputs = args
+        with no_grad():
+            outputs = run_function(*args)
+        return outputs
+
+    @staticmethod
+    def backward(ctx, *grads):
+        from ...autograd.backward import run_backward
+        if ctx.preserve_rng:
+            saved = get_rng_state()
+            set_rng_state(ctx.rng_state)
+        detached = [a.detach() if isinstance(a, Tensor) else a
+                    for a in ctx.inputs]
+        for d, orig in zip(detached, ctx.inputs):
+            if isinstance(orig, Tensor):
+                d.stop_gradient = orig.stop_gradient
+        outputs = ctx.run_function(*detached)
+        if ctx.preserve_rng:
+            set_rng_state(saved)
+        if isinstance(outputs, Tensor):
+            outputs = [outputs]
+            grads = [grads[0]]
+        out_list = [o for o in outputs if isinstance(o, Tensor)]
+        # Full backward: parameters used inside the block accumulate into their
+        # .grad directly (parity: the reference replays forward and calls the
+        # normal engine); input grads are read off the detached leaves.
+        run_backward(out_list, list(grads))
+        result = []
+        for orig, d in zip(ctx.inputs, detached):
+            if isinstance(orig, Tensor):
+                result.append(d.grad if not orig.stop_gradient else None)
+        return tuple(result) if len(result) != 1 else result[0]
+
+
+def recompute(function, *args, **kwargs):
+    """Parity: paddle.distributed.fleet.utils.recompute."""
+    preserve = kwargs.pop("preserve_rng_state", True)
+    use_reentrant = kwargs.pop("use_reentrant", True)
+    del use_reentrant
+    if kwargs:
+        def wrapped(*a):
+            return function(*a, **kwargs)
+        return _RecomputeFunction.apply(wrapped, preserve, *args)
+    return _RecomputeFunction.apply(function, preserve, *args)
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """Parity: recompute_sequential (:630) — chunked recompute over Sequential."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    layers = list(functions)
+    chunk = max(len(layers) // segments, 1)
+    out = args[0] if len(args) == 1 else args
+    for i in range(0, len(layers), chunk):
+        seg = layers[i:i + chunk]
+
+        def run_seg(x, seg=seg):
+            for l in seg:
+                x = l(x)
+            return x
+        out = recompute(run_seg, out, **kwargs)
+    return out

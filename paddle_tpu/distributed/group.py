@@ -1,0 +1,63 @@
+"""Process groups over mesh axes.
+
+Reference parity: Group (communication/group.py:29) / new_group (collective.py:195).
+TPU-native: a Group names a set of ranks AND (optionally) a mesh axis; collectives
+called under a shard_map trace use the axis name, so the "group" is resolved by
+the compiler, not a communicator object (SURVEY §2.4 TPU-note).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+_group_map = {}
+_next_gid = [0]
+
+
+class Group:
+    def __init__(self, rank_in_group: int, gid: int, ranks: List[int],
+                 axis_name: Optional[str] = None):
+        self.rank = rank_in_group
+        self.id = gid
+        self.ranks = list(ranks)
+        self.nranks = len(ranks)
+        self.axis_name = axis_name  # mesh axis this group maps to (if any)
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    @property
+    def process_group(self):
+        return self
+
+    def get_group_rank(self, rank: int) -> int:
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    def is_member(self) -> bool:
+        from .env import get_rank
+        return get_rank() in self.ranks or self.nranks == 0
+
+    def __repr__(self):
+        return f"Group(id={self.id}, ranks={self.ranks}, axis={self.axis_name})"
+
+
+def new_group(ranks=None, backend=None, timeout=None, axis_name=None) -> Group:
+    from .env import get_rank, get_world_size
+    if ranks is None:
+        ranks = list(range(get_world_size()))
+    gid = _next_gid[0]
+    _next_gid[0] += 1
+    g = Group(ranks.index(get_rank()) if get_rank() in ranks else -1,
+              gid, ranks, axis_name=axis_name)
+    _group_map[gid] = g
+    return g
+
+
+def get_group(gid: int = 0) -> Optional[Group]:
+    if gid not in _group_map and gid == 0:
+        return new_group()
+    return _group_map.get(gid)
+
+
+def is_available() -> bool:
+    return True

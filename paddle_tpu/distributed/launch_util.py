@@ -1,0 +1,36 @@
+"""Process spawn utility.
+
+Reference parity: paddle.distributed.spawn (python/paddle/distributed/spawn.py).
+On TPU the normal deployment is one process per host (jax SPMD), so spawn runs
+the target once per requested proc in subprocesses with PADDLE_* env set —
+used by tests that exercise the multi-host bootstrap path on CPU.
+"""
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+
+
+def _worker(fn, rank, nprocs, env, args):
+    os.environ.update(env)
+    os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
+    fn(*args)
+
+
+def spawn(func, args=(), nprocs=1, join=True, daemon=False, **options):
+    ctx = mp.get_context("spawn")
+    procs = []
+    env = {k: v for k, v in os.environ.items() if k.startswith("PADDLE_")}
+    for rank in range(nprocs):
+        p = ctx.Process(target=_worker, args=(func, rank, nprocs, env, args),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+    if join:
+        for p in procs:
+            p.join()
+        for p in procs:
+            if p.exitcode != 0:
+                raise RuntimeError(f"spawned process failed: {p.exitcode}")
+    return procs

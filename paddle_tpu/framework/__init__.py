@@ -1,0 +1,5 @@
+"""Framework internals: dtypes, flags, RNG, io (io imported lazily to avoid
+the tensor<->framework import cycle)."""
+from . import dtype, flags, random  # noqa: F401
+from .dtype import get_default_dtype, set_default_dtype  # noqa: F401
+from .random import seed  # noqa: F401

@@ -1,0 +1,76 @@
+"""Dtype registry.
+
+Reference parity: paddle exposes dtype objects (paddle.float32, ...) used across
+the tensor API (python/paddle/framework/dtype.py in the reference). Here dtypes
+are numpy/jax dtypes directly so they interoperate with jnp without conversion.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtype objects (jnp dtypes are numpy dtype-likes).
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+uint8 = jnp.uint8
+uint16 = jnp.uint16
+uint32 = jnp.uint32
+uint64 = jnp.uint64
+bool_ = jnp.bool_
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_STR_TO_DTYPE = {
+    "float16": float16, "fp16": float16, "half": float16,
+    "bfloat16": bfloat16, "bf16": bfloat16,
+    "float32": float32, "fp32": float32, "float": float32,
+    "float64": float64, "fp64": float64, "double": float64,
+    "int8": int8, "int16": int16, "int32": int32, "int64": int64,
+    "uint8": uint8, "uint16": uint16, "uint32": uint32, "uint64": uint64,
+    "bool": bool_,
+    "complex64": complex64, "complex128": complex128,
+}
+
+_default_dtype = [np.dtype("float32")]
+
+
+def convert_dtype(dtype):
+    """Normalize a dtype spec (str | np.dtype | jnp dtype | None) to np.dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        key = dtype.lower()
+        if key not in _STR_TO_DTYPE:
+            raise ValueError(f"Unknown dtype string: {dtype!r}")
+        return np.dtype(_STR_TO_DTYPE[key])
+    return np.dtype(dtype)
+
+
+def set_default_dtype(dtype):
+    d = convert_dtype(dtype)
+    if d not in (np.dtype("float16"), np.dtype("bfloat16"), np.dtype("float32"),
+                 np.dtype("float64")):
+        raise TypeError(f"default dtype must be floating, got {d}")
+    _default_dtype[0] = d
+
+
+def get_default_dtype():
+    return _default_dtype[0]
+
+
+def is_floating_dtype(dtype) -> bool:
+    return jnp.issubdtype(np.dtype(dtype), jnp.floating)
+
+
+def is_integer_dtype(dtype) -> bool:
+    return jnp.issubdtype(np.dtype(dtype), jnp.integer)
+
+
+def is_complex_dtype(dtype) -> bool:
+    return jnp.issubdtype(np.dtype(dtype), jnp.complexfloating)

@@ -1,0 +1,56 @@
+"""Flag registry.
+
+Reference parity: paddle's gflags-style registry (paddle/common/flags.h,
+flags_native.cc) exposed via paddle.set_flags/get_flags. Flags may be overridden
+with FLAGS_<name> environment variables at import time.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+_FLAGS: Dict[str, Any] = {}
+
+
+def define_flag(name: str, default, help_str: str = ""):
+    env = os.environ.get("FLAGS_" + name)
+    value = default
+    if env is not None:
+        if isinstance(default, bool):
+            value = env.lower() in ("1", "true", "yes", "on")
+        elif isinstance(default, int):
+            value = int(env)
+        elif isinstance(default, float):
+            value = float(env)
+        else:
+            value = env
+    _FLAGS[name] = value
+    return value
+
+
+def set_flags(flags: Dict[str, Any]):
+    for k, v in flags.items():
+        key = k[6:] if k.startswith("FLAGS_") else k
+        if key not in _FLAGS:
+            raise KeyError(f"Unknown flag: {k}")
+        _FLAGS[key] = v
+
+
+def get_flags(flags):
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for k in flags:
+        key = k[6:] if k.startswith("FLAGS_") else k
+        out[k] = _FLAGS[key]
+    return out
+
+
+def flag(name: str):
+    return _FLAGS[name]
+
+
+# Core flags (parity with the reference's most commonly used debug flags).
+define_flag("check_nan_inf", False, "Check outputs of every op for NaN/Inf.")
+define_flag("check_nan_inf_level", 0, "0: error on nan/inf; >0: warn only.")
+define_flag("eager_op_log", False, "Log every dispatched eager op.")

@@ -1,0 +1,54 @@
+"""paddle.save / paddle.load.
+
+Reference parity: python/paddle/framework/io.py — pickled nested state dicts.
+Tensors are stored as numpy arrays (host); loaded back as device tensors.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..tensor import Tensor, to_tensor
+
+
+def _to_storable(obj):
+    if isinstance(obj, Tensor):
+        return {"__tensor__": True, "data": np.asarray(obj._data),
+                "stop_gradient": obj.stop_gradient, "name": obj.name}
+    if isinstance(obj, dict):
+        return {k: _to_storable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_to_storable(v) for v in obj)
+    return obj
+
+
+def _from_storable(obj, return_numpy=False):
+    if isinstance(obj, dict):
+        if obj.get("__tensor__"):
+            if return_numpy:
+                return obj["data"]
+            t = to_tensor(obj["data"])
+            t.stop_gradient = obj.get("stop_gradient", True)
+            t.name = obj.get("name")
+            return t
+        return {k: _from_storable(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_from_storable(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_storable(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _from_storable(obj, return_numpy=return_numpy)

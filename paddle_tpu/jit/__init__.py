@@ -1,0 +1,209 @@
+"""jit: trace-and-compile execution.
+
+Reference parity: python/paddle/jit/ — to_static (api.py:197) with its two
+engines (AST dy2static, SOT bytecode capture). TPU-native design: neither engine
+is needed — eager ops are jnp calls, so running the same Python forward under
+jax tracing *is* the graph capture. to_static wraps a Layer/function into one
+jitted XLA program: parameters/buffers become inputs, buffers are threaded out
+functionally (BatchNorm running stats stay correct), randomness comes from a
+per-call key input, and the whole compiled program is recorded as a single node
+on the eager autograd tape (so loss.backward() still works and the backward is
+also one compiled program).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd.tape import no_grad
+from ..framework.random import key_context, next_key
+from ..nn.layer.layers import Layer
+from ..ops.dispatch import dispatch
+from ..tensor import Tensor
+
+
+def _flatten_tensors(obj, out_list):
+    """Collect Tensors from nested structures; return a spec for rebuilding."""
+    if isinstance(obj, Tensor):
+        out_list.append(obj)
+        return ("t", len(out_list) - 1)
+    if isinstance(obj, (list, tuple)):
+        specs = [_flatten_tensors(o, out_list) for o in obj]
+        return ("seq", type(obj).__name__, specs)
+    if isinstance(obj, dict):
+        keys = list(obj.keys())
+        specs = [_flatten_tensors(obj[k], out_list) for k in keys]
+        return ("dict", keys, specs)
+    return ("const", obj)
+
+
+def _rebuild(spec, tensors):
+    kind = spec[0]
+    if kind == "t":
+        return tensors[spec[1]]
+    if kind == "seq":
+        seq = [_rebuild(s, tensors) for s in spec[2]]
+        return tuple(seq) if spec[1] == "tuple" else seq
+    if kind == "dict":
+        return {k: _rebuild(s, tensors) for k, s in zip(spec[1], spec[2])}
+    return spec[1]
+
+
+class StaticFunction:
+    """A compiled callable over a Layer's forward (or a plain function)."""
+
+    def __init__(self, function: Callable, layer: Optional[Layer] = None,
+                 input_spec=None, build_strategy=None, backend=None,
+                 full_graph: bool = True):
+        self._function = function
+        self._layer = layer
+        self._input_spec = input_spec
+        self._out_spec = None
+        self._jitted = None
+        self._param_names: List[str] = []
+        self._buffer_names: List[str] = []
+        self.__name__ = getattr(function, "__name__", "static_fn")
+
+    @property
+    def dygraph_function(self):
+        return self._function
+
+    def _build(self):
+        layer = self._layer
+        if layer is not None:
+            self._param_names = [n for n, _ in layer.named_parameters()]
+            self._buffer_names = [n for n, _ in layer.named_buffers()]
+
+        def pure(state_arrays: Dict[str, Any], key, in_arrays: Tuple,
+                 in_spec, static_kwargs: Dict):
+            in_tensors = [Tensor(a) for a in in_arrays]
+            args = _rebuild(in_spec, in_tensors)
+            with key_context(key):
+                if layer is not None:
+                    with layer.swap_state(state_arrays):
+                        with no_grad():
+                            out = self._function(*args, **static_kwargs)
+                        new_buffers = [
+                            dict(layer.named_buffers())[n]._data
+                            for n in self._buffer_names]
+                else:
+                    with no_grad():
+                        out = self._function(*args, **static_kwargs)
+                    new_buffers = []
+            out_tensors: List[Tensor] = []
+            out_spec = _flatten_tensors(out, out_tensors)
+            return tuple(t._data for t in out_tensors), tuple(new_buffers), out_spec
+
+        # jit with out_spec returned via host callback-free trick: out_spec is
+        # python metadata — capture it on first trace through a mutable cell.
+        spec_cell = {}
+
+        @functools.partial(jax.jit, static_argnums=(3,))
+        def jitted(state_arrays, key, in_arrays, static_key):
+            static_kwargs, in_spec = self._static_tbl[static_key]
+            outs, new_bufs, out_spec = pure(state_arrays, key, in_arrays,
+                                            in_spec, static_kwargs)
+            spec_cell[static_key] = out_spec
+            return outs, new_bufs
+
+        self._static_tbl: Dict = {}
+        self._jitted = jitted
+        self._spec_cell = spec_cell
+
+    def __call__(self, *args, **kwargs):
+        if self._jitted is None:
+            self._build()
+        layer = self._layer
+        in_tensors: List[Tensor] = []
+        in_spec = _flatten_tensors(list(args), in_tensors)
+        static_key = (repr(sorted(kwargs.items())), repr(in_spec))
+        self._static_tbl[static_key] = (kwargs, in_spec)
+
+        state_tensors: List[Tensor] = []
+        names: List[str] = []
+        if layer is not None:
+            state = layer.named_state()
+            for n in self._param_names + self._buffer_names:
+                names.append(n)
+                state_tensors.append(state[n])
+
+        key = next_key()
+        all_inputs = state_tensors + in_tensors
+        n_state = len(state_tensors)
+        n_buf = len(self._buffer_names)
+
+        def fwd(*arrays):
+            state_arrays = dict(zip(names, arrays[:n_state]))
+            outs, new_bufs = self._jitted(state_arrays, key,
+                                          tuple(arrays[n_state:]), static_key)
+            return tuple(outs) + tuple(new_bufs)
+
+        result = dispatch("to_static", fwd, *all_inputs)
+        if not isinstance(result, tuple):
+            result = (result,)
+        out_spec = self._spec_cell[static_key]
+        n_out = len(result) - n_buf
+        # write back updated buffers
+        if layer is not None and n_buf:
+            buffers = dict(layer.named_buffers())
+            for i, n in enumerate(self._buffer_names):
+                buffers[n]._data = result[n_out + i]._data
+        out = _rebuild(out_spec, list(result[:n_out]))
+        return out
+
+    # parity helpers
+    def concrete_program(self):
+        raise NotImplementedError("PIR program export: use jit.save")
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, full_graph=True, **kwargs):
+    """Parity: paddle.jit.to_static (python/paddle/jit/api.py:197)."""
+    def decorate(obj):
+        if isinstance(obj, Layer):
+            static = StaticFunction(obj.forward, layer=obj,
+                                    input_spec=input_spec)
+            obj.forward = static
+            return obj
+        return StaticFunction(obj, layer=None, input_spec=input_spec)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn
+
+
+class ignore_module:
+    def __init__(self, modules):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+def enable_to_static(flag: bool):
+    pass
+
+
+def save(layer, path, input_spec=None, **config):
+    """Parity: paddle.jit.save — serialize weights + (future) StableHLO export."""
+    from ..framework.io import save as fsave
+    if isinstance(layer, Layer):
+        fsave(layer.state_dict(), path + ".pdparams")
+    else:
+        raise TypeError("jit.save expects a Layer")
+
+
+def load(path, **config):
+    from ..framework.io import load as fload
+    return fload(path + ".pdparams")

@@ -1,0 +1,7 @@
+"""Pallas TPU kernels — the fused-op hot list.
+
+Reference parity: paddle/phi/kernels/fusion/gpu/ (fused_rope, fused
+bias+dropout+residual+layernorm, flash attention, fused MoE dispatch). Here each
+is a Pallas kernel (MXU/VMEM-aware) with an XLA reference fallback; kernels are
+validated against the pure-jnp oracle in tests.
+"""

@@ -1,0 +1,43 @@
+"""Flash attention (Pallas TPU kernel + availability gate).
+
+Reference parity: paddle/phi/kernels/gpu/flash_attn_kernel.cu:673 (FA2 via
+dynload). TPU-native: online-softmax tiled kernel in Pallas (implemented in
+flash_pallas.py); this module is the dispatch gate. Falls back to the XLA
+reference path (nn/functional/attention.py) when shapes/platform don't fit.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.lru_cache(maxsize=1)
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def is_available(q) -> bool:
+    """Pallas kernel requires TPU + seq/head-dim tiling-friendly shapes."""
+    if not _on_tpu():
+        return False
+    if q.ndim != 4:
+        return False
+    _, seq, _, head_dim = q.shape
+    return seq % 128 == 0 and head_dim in (64, 128, 256) and \
+        q.dtype in (jnp.float32, jnp.bfloat16)
+
+
+def flash_attention_bshd(q, k, v, causal: bool = False, scale=None):
+    """[batch, seq, heads, dim] layout wrapper around the Pallas kernel."""
+    from .flash_pallas import flash_attention as fa_bhsd
+    # kernel uses [batch, heads, seq, dim]
+    qh = jnp.swapaxes(q, 1, 2)
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+    out = fa_bhsd(qh, kh, vh, causal=causal, scale=scale)
+    return jnp.swapaxes(out, 1, 2)

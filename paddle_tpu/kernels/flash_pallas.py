@@ -1,0 +1,146 @@
+"""Pallas TPU flash-attention kernel (online softmax, tiled over KV).
+
+Reference parity: the capability of paddle's FA2 integration
+(paddle/phi/kernels/gpu/flash_attn_kernel.cu:673). Design: 3-D sequential grid
+(batch*heads, q_blocks, kv_blocks) with running (m, l, acc) carried in VMEM
+scratch across the innermost kv dimension — the standard TPU online-softmax
+pattern; MXU does the two matmuls per tile in fp32 accumulation.
+
+Backward currently recomputes via the XLA reference path (fused bwd kernel is a
+planned optimization); forward is the inference/serving hot path.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+NEG_INF = -1e30
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch, acc_scratch, *,
+               scale, causal, block_q, block_k, nk):
+    ik = pl.program_id(2)
+    iq = pl.program_id(1)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scratch[:] = jnp.full_like(m_scratch, NEG_INF)
+        l_scratch[:] = jnp.zeros_like(l_scratch)
+        acc_scratch[:] = jnp.zeros_like(acc_scratch)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)            # [Bq, d]
+        k = k_ref[0].astype(jnp.float32)            # [Bk, d]
+        v = v_ref[0].astype(jnp.float32)            # [Bk, d]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = iq * block_q + jax.lax.broadcasted_iota(jnp.int32,
+                                                            (block_q, block_k), 0)
+            k_pos = ik * block_k + jax.lax.broadcasted_iota(jnp.int32,
+                                                            (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_prev = m_scratch[:]                        # [Bq, 1]
+        l_prev = l_scratch[:]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                       # [Bq, Bk]
+        alpha = jnp.exp(m_prev - m_new)              # [Bq, 1]
+        l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        acc_scratch[:] = acc_scratch[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        m_scratch[:] = m_new
+        l_scratch[:] = l_new
+
+    if causal:
+        # Skip fully-masked tiles (kv block entirely after the q block).
+        @pl.when(ik * block_k <= iq * block_q + (block_q - 1))
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        l = l_scratch[:]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scratch[:] / l_safe).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal, scale, block_q, block_k):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bq = min(block_q, sq)
+    bk = min(block_k, sk)
+    nq = sq // bq
+    nk = sk // bk
+    bh = b * h
+    q_r = q.reshape(bh, sq, d)
+    k_r = k.reshape(bh, sk, d)
+    v_r = v.reshape(bh, sk, d)
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+
+    kernel = functools.partial(_fa_kernel, scale=s, causal=causal, block_q=bq,
+                               block_k=bk, nk=nk)
+    out = pl.pallas_call(
+        kernel,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda ibh, iq, ik: (ibh, iq, 0)),
+            pl.BlockSpec((1, bk, d), lambda ibh, iq, ik: (ibh, ik, 0)),
+            pl.BlockSpec((1, bk, d), lambda ibh, iq, ik: (ibh, ik, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda ibh, iq, ik: (ibh, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+    )(q_r, k_r, v_r)
+    return out.reshape(b, h, sq, d)
+
+
+def _reference_bhsd(q, k, v, causal, scale):
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    scores = jnp.einsum("bhsd,bhtd->bhst", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * s
+    if causal:
+        sq, sk = scores.shape[-2], scores.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), jnp.bool_), k=sk - sq)
+        scores = jnp.where(mask, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhst,bhtd->bhsd", probs, v.astype(jnp.float32)) \
+        .astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal=False, scale=None,
+                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+    """q,k,v: [batch, heads, seq, head_dim]."""
+    return _flash_forward(q, k, v, causal, scale, block_q, block_k)
+
+
+def _fa_fwd(q, k, v, causal, scale, block_q, block_k):
+    out = _flash_forward(q, k, v, causal, scale, block_q, block_k)
+    return out, (q, k, v)
+
+
+def _fa_bwd(causal, scale, block_q, block_k, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda a, b, c: _reference_bhsd(a, b, c, causal, scale),
+                     q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fa_fwd, _fa_bwd)

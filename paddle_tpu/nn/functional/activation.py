@@ -1,0 +1,201 @@
+"""Activation functionals.
+
+Reference parity: python/paddle/nn/functional/activation.py.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.dispatch import dispatch, ensure_tensor
+
+
+def relu(x, name=None):
+    return dispatch("relu", jax.nn.relu, ensure_tensor(x))
+
+
+def relu_(x, name=None):
+    return x._assign_from(relu(x))
+
+
+def relu6(x, name=None):
+    return dispatch("relu6", jax.nn.relu6, ensure_tensor(x))
+
+
+def gelu(x, approximate=False, name=None):
+    return dispatch("gelu", lambda a: jax.nn.gelu(a, approximate=approximate),
+                    ensure_tensor(x))
+
+
+def sigmoid(x, name=None):
+    return dispatch("sigmoid", jax.nn.sigmoid, ensure_tensor(x))
+
+
+def silu(x, name=None):
+    return dispatch("silu", jax.nn.silu, ensure_tensor(x))
+
+
+swish = silu
+
+
+def tanh(x, name=None):
+    return dispatch("tanh", jnp.tanh, ensure_tensor(x))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return dispatch("leaky_relu", lambda a: jax.nn.leaky_relu(a, negative_slope),
+                    ensure_tensor(x))
+
+
+def elu(x, alpha=1.0, name=None):
+    return dispatch("elu", lambda a: jax.nn.elu(a, alpha), ensure_tensor(x))
+
+
+def celu(x, alpha=1.0, name=None):
+    return dispatch("celu", lambda a: jax.nn.celu(a, alpha), ensure_tensor(x))
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return dispatch("selu",
+                    lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)),
+                    ensure_tensor(x))
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def fwd(a, w):
+        if w.size == 1:
+            return jnp.where(a > 0, a, w.reshape(()) * a)
+        ch_axis = 1 if data_format.startswith("NC") else a.ndim - 1
+        shape = [1] * a.ndim
+        shape[ch_axis] = -1
+        return jnp.where(a > 0, a, w.reshape(shape) * a)
+    return dispatch("prelu", fwd, ensure_tensor(x), ensure_tensor(weight))
+
+
+def rrelu(x, lower=0.125, upper=0.3333333333333333, training=False, name=None):
+    from ...framework.random import next_key
+    xt = ensure_tensor(x)
+    if training:
+        key = next_key()
+
+        def fwd(a):
+            slope = jax.random.uniform(key, a.shape, a.dtype, lower, upper)
+            return jnp.where(a >= 0, a, slope * a)
+        return dispatch("rrelu", fwd, xt)
+    mid = (lower + upper) / 2.0
+    return dispatch("rrelu", lambda a: jnp.where(a >= 0, a, mid * a), xt)
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return dispatch("hardshrink",
+                    lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0).astype(a.dtype),
+                    ensure_tensor(x))
+
+
+def softshrink(x, threshold=0.5, name=None):
+    def fwd(a):
+        return jnp.where(a > threshold, a - threshold,
+                         jnp.where(a < -threshold, a + threshold,
+                                   jnp.zeros_like(a)))
+    return dispatch("softshrink", fwd, ensure_tensor(x))
+
+
+def tanhshrink(x, name=None):
+    return dispatch("tanhshrink", lambda a: a - jnp.tanh(a), ensure_tensor(x))
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return dispatch("hardtanh", lambda a: jnp.clip(a, min, max), ensure_tensor(x))
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return dispatch("hardsigmoid",
+                    lambda a: jnp.clip(a * slope + offset, 0.0, 1.0),
+                    ensure_tensor(x))
+
+
+def hardswish(x, name=None):
+    return dispatch("hardswish",
+                    lambda a: a * jnp.clip(a + 3.0, 0.0, 6.0) / 6.0,
+                    ensure_tensor(x))
+
+
+def mish(x, name=None):
+    return dispatch("mish", lambda a: a * jnp.tanh(jax.nn.softplus(a)),
+                    ensure_tensor(x))
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    def fwd(a):
+        scaled = beta * a
+        return jnp.where(scaled > threshold, a, jax.nn.softplus(scaled) / beta)
+    return dispatch("softplus", fwd, ensure_tensor(x))
+
+
+def softsign(x, name=None):
+    return dispatch("softsign", jax.nn.soft_sign, ensure_tensor(x))
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return dispatch("thresholded_relu",
+                    lambda a: jnp.where(a > threshold, a, value).astype(a.dtype),
+                    ensure_tensor(x))
+
+
+def log_sigmoid(x, name=None):
+    return dispatch("log_sigmoid", jax.nn.log_sigmoid, ensure_tensor(x))
+
+
+def maxout(x, groups, axis=1, name=None):
+    def fwd(a):
+        ax = axis % a.ndim
+        ch = a.shape[ax]
+        new_shape = a.shape[:ax] + (ch // groups, groups) + a.shape[ax + 1:]
+        return jnp.max(a.reshape(new_shape), axis=ax + 1)
+    return dispatch("maxout", fwd, ensure_tensor(x))
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    from ...framework.dtype import convert_dtype
+    d = convert_dtype(dtype)
+
+    def fwd(a):
+        if d is not None:
+            a = a.astype(d)
+        return jax.nn.softmax(a, axis=int(axis))
+    return dispatch("softmax", fwd, ensure_tensor(x))
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    return x._assign_from(softmax(x, axis, dtype))
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    from ...framework.dtype import convert_dtype
+    d = convert_dtype(dtype)
+
+    def fwd(a):
+        if d is not None:
+            a = a.astype(d)
+        return jax.nn.log_softmax(a, axis=int(axis))
+    return dispatch("log_softmax", fwd, ensure_tensor(x))
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...framework.random import next_key
+    key = next_key()
+
+    def fwd(a):
+        g = jax.random.gumbel(key, a.shape, a.dtype)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            onehot = jnp.zeros_like(y)
+            onehot = jnp.put_along_axis(onehot, idx, 1.0, axis=axis, inplace=False)
+            y = onehot + y - jax.lax.stop_gradient(y)
+        return y
+    return dispatch("gumbel_softmax", fwd, ensure_tensor(x))
+
+
+def glu(x, axis=-1, name=None):
+    return dispatch("glu", lambda a: jax.nn.glu(a, axis=axis), ensure_tensor(x))

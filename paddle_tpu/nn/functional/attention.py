@@ -1,0 +1,112 @@
+"""Attention functionals.
+
+Reference parity: python/paddle/nn/functional/flash_attention.py (flash_attention
+:358, scaled_dot_product_attention, flashmask_attention :1299). TPU-native: the
+fused path is a Pallas flash-attention kernel (paddle_tpu/kernels/flash_attention.py);
+the reference XLA path below is the fallback and the numerics oracle.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.dispatch import dispatch, ensure_tensor
+from ...tensor import Tensor
+
+
+def _sdpa_reference(q, k, v, mask=None, dropout_p=0.0, causal=False, scale=None):
+    """q,k,v: [batch, seq, heads, dim] (reference layout). Returns same layout."""
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    # scores: [b, h, sq, sk]
+    scores = jnp.einsum("bshd,bthd->bhst", qf, kf) * s
+    if causal:
+        sq, sk = scores.shape[-2], scores.shape[-1]
+        causal_mask = jnp.tril(jnp.ones((sq, sk), jnp.bool_), k=sk - sq)
+        scores = jnp.where(causal_mask, scores, -1e30)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            scores = jnp.where(mask, scores, -1e30)
+        else:
+            scores = scores + mask.astype(jnp.float32)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhst,bthd->bshd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False, training=True,
+                                 name=None):
+    """Parity: paddle.nn.functional.scaled_dot_product_attention.
+
+    Layout [batch, seq, num_heads, head_dim]. Uses the Pallas flash kernel on TPU
+    for the mask-free case, XLA reference path otherwise.
+    """
+    qt, kt, vt = ensure_tensor(query), ensure_tensor(key), ensure_tensor(value)
+    use_flash = attn_mask is None and dropout_p == 0.0
+    if use_flash:
+        from ...kernels import flash_attention as fa
+        if fa.is_available(qt._data):
+            return dispatch(
+                "flash_attention",
+                lambda q, k, v: fa.flash_attention_bshd(q, k, v, causal=is_causal),
+                qt, kt, vt)
+    if attn_mask is not None:
+        mt = ensure_tensor(attn_mask)
+        return dispatch(
+            "sdpa",
+            lambda q, k, v, m: _sdpa_reference(q, k, v, mask=m, causal=is_causal),
+            qt, kt, vt, mt)
+    return dispatch(
+        "sdpa", lambda q, k, v: _sdpa_reference(q, k, v, causal=is_causal),
+        qt, kt, vt)
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None, rng_name="",
+                    training=True, name=None):
+    """Parity: paddle.nn.functional.flash_attention.flash_attention (:358)."""
+    out = scaled_dot_product_attention(query, key, value, dropout_p=dropout,
+                                       is_causal=causal, training=training)
+    return out, None
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale, dropout=0.0,
+                        causal=False, return_softmax=False,
+                        fixed_seed_offset=None, rng_name="", training=True,
+                        name=None):
+    """Varlen flash attention: q/k/v are [total_tokens, heads, dim] packed.
+
+    Implemented as a segment-masked SDPA (segment ids derived from cu_seqlens).
+    """
+    qt, kt, vt = ensure_tensor(query), ensure_tensor(key), ensure_tensor(value)
+    cq = ensure_tensor(cu_seqlens_q)
+    ck = ensure_tensor(cu_seqlens_k)
+
+    def fwd(q, k, v, cu_q, cu_k):
+        total_q = q.shape[0]
+        total_k = k.shape[0]
+        seg_q = jnp.searchsorted(cu_q, jnp.arange(total_q), side="right")
+        seg_k = jnp.searchsorted(cu_k, jnp.arange(total_k), side="right")
+        mask = seg_q[:, None] == seg_k[None, :]
+        scores = jnp.einsum("shd,thd->hst", q.astype(jnp.float32),
+                            k.astype(jnp.float32)) * scale
+        if causal:
+            pos_q = jnp.arange(total_q) - jnp.take(cu_q, seg_q - 1)
+            pos_k = jnp.arange(total_k) - jnp.take(cu_k, seg_k - 1)
+            mask = mask & (pos_q[:, None] >= pos_k[None, :])
+        scores = jnp.where(mask[None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("hst,thd->shd", probs, v.astype(jnp.float32))
+        return out.astype(q.dtype)
+    return dispatch("flash_attn_unpadded", fwd, qt, kt, vt, cq, ck), None
+
+
+def sdp_kernel(*args, **kwargs):  # config context no-op (XLA chooses)
+    import contextlib
+    return contextlib.nullcontext()

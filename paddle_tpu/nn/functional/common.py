@@ -1,0 +1,309 @@
+"""Common functionals: linear, embedding, dropout, padding, interpolate, one_hot.
+
+Reference parity: python/paddle/nn/functional/common.py + input.py + extension.py.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.random import next_key
+from ...ops.dispatch import dispatch, ensure_tensor
+from ...tensor import Tensor
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b with W of shape [in, out] (reference layout)."""
+    if bias is not None:
+        return dispatch("linear", lambda a, w, b: jnp.matmul(a, w) + b,
+                        ensure_tensor(x), ensure_tensor(weight), ensure_tensor(bias))
+    return dispatch("linear", jnp.matmul, ensure_tensor(x), ensure_tensor(weight))
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    def fwd(ids, w):
+        out = jnp.take(w, ids, axis=0)
+        if padding_idx is not None:
+            mask = (ids == padding_idx)[..., None]
+            out = jnp.where(mask, jnp.zeros_like(out), out)
+        return out
+    return dispatch("embedding", fwd, ensure_tensor(x), ensure_tensor(weight))
+
+
+def one_hot(x, num_classes, name=None):
+    return dispatch("one_hot",
+                    lambda a: jax.nn.one_hot(a, int(num_classes), dtype=jnp.float32),
+                    ensure_tensor(x))
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    xt = ensure_tensor(x)
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return dispatch("dropout", lambda a: a * (1.0 - p), xt)
+        return xt
+    if p == 1.0:
+        return dispatch("dropout", lambda a: jnp.zeros_like(a), xt)
+    key = next_key()
+
+    def fwd(a):
+        shape = list(a.shape)
+        if axis is not None:
+            axes = [axis] if isinstance(axis, int) else list(axis)
+            shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), jnp.zeros_like(a)).astype(a.dtype)
+        return jnp.where(keep, a, jnp.zeros_like(a)).astype(a.dtype)
+    return dispatch("dropout", fwd, xt)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    xt = ensure_tensor(x)
+    if not training or p == 0.0:
+        return xt
+    key = next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def fwd(a):
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        q = 1.0 - p
+        a_coef = (q + alpha_p ** 2 * q * p) ** -0.5
+        b_coef = -a_coef * alpha_p * p
+        return (a_coef * jnp.where(keep, a, alpha_p) + b_coef).astype(a.dtype)
+    return dispatch("alpha_dropout", fwd, xt)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format=None, pad_from_left_axis=True,
+        name=None):
+    xt = ensure_tensor(x)
+    nd = xt._data.ndim
+    if isinstance(pad, Tensor):
+        pad = pad.tolist()
+    pad = [int(p) for p in pad]
+
+    if len(pad) == 2 * nd:
+        # full-rank paddle format: [a0_lo, a0_hi, a1_lo, a1_hi, ...]
+        widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # NCHW-style: pad applies to trailing spatial dims, reversed pairs
+        n_spatial = len(pad) // 2
+        widths = [(0, 0)] * nd
+        if data_format and data_format.endswith("C"):  # NHWC/NDHWC/NLC
+            spatial_axes = list(range(1, 1 + n_spatial))
+        else:
+            spatial_axes = list(range(nd - n_spatial, nd))
+        for i, ax in enumerate(reversed(spatial_axes)):
+            widths[ax] = (pad[2 * i], pad[2 * i + 1])
+
+    jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge",
+             "circular": "wrap"}[mode]
+
+    def fwd(a):
+        if jmode == "constant":
+            return jnp.pad(a, widths, mode="constant", constant_values=value)
+        return jnp.pad(a, widths, mode=jmode)
+    return dispatch("pad", fwd, xt)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0, data_format=data_format)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    xt = ensure_tensor(x)
+    a_shape = tuple(xt._data.shape)
+    channel_last = data_format.endswith("C")
+    nd = len(a_shape) - 2
+    spatial = a_shape[1:-1] if channel_last else a_shape[2:]
+    if size is not None:
+        if isinstance(size, Tensor):
+            size = size.tolist()
+        out_spatial = tuple(int(s.item()) if isinstance(s, Tensor) else int(s)
+                            for s in (size if isinstance(size, (list, tuple)) else [size]))
+    else:
+        if isinstance(scale_factor, (int, float)):
+            scale_factor = [scale_factor] * nd
+        out_spatial = tuple(int(s * f) for s, f in zip(spatial, scale_factor))
+
+    method = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+              "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode.lower()]
+
+    def fwd(a):
+        if channel_last:
+            out_shape = (a.shape[0],) + out_spatial + (a.shape[-1],)
+            scale_axes = list(range(1, 1 + nd))
+        else:
+            out_shape = a.shape[:2] + out_spatial
+            scale_axes = list(range(2, 2 + nd))
+        if method == "nearest":
+            # exact paddle/nearest semantics: floor(i * in/out)
+            idx = []
+            for ax, o in zip(scale_axes, out_spatial):
+                ratio = a.shape[ax] / o
+                idx.append(jnp.floor(jnp.arange(o) * ratio).astype(jnp.int32))
+            out = a
+            for ax, ind in zip(scale_axes, idx):
+                out = jnp.take(out, ind, axis=ax)
+            return out
+        if align_corners:
+            # build index grid with align_corners scaling, gather via map_coordinates
+            coords = []
+            for ax, o in zip(scale_axes, out_spatial):
+                i = a.shape[ax]
+                if o == 1:
+                    c = jnp.zeros((1,), jnp.float32)
+                else:
+                    c = jnp.arange(o, dtype=jnp.float32) * (i - 1) / (o - 1)
+                coords.append(c)
+            out = a.astype(jnp.float32)
+            for k, (ax, c) in enumerate(zip(scale_axes, coords)):
+                lo = jnp.floor(c).astype(jnp.int32)
+                hi = jnp.minimum(lo + 1, a.shape[ax] - 1)
+                w = (c - lo).astype(out.dtype)
+                shape = [1] * out.ndim
+                shape[ax] = -1
+                w = w.reshape(shape)
+                out = (jnp.take(out, lo, axis=ax) * (1 - w)
+                       + jnp.take(out, hi, axis=ax) * w)
+            return out.astype(a.dtype)
+        return jax.image.resize(a, out_shape, method=method).astype(a.dtype)
+    return dispatch("interpolate", fwd, xt)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+             align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    def tolist(v, n=2):
+        return [v] * n if isinstance(v, int) else list(v)
+    k = tolist(kernel_sizes)
+    s = tolist(strides)
+    p = tolist(paddings) if not isinstance(paddings, int) else [paddings] * 2
+    d = tolist(dilations)
+
+    def fwd(a):
+        n, c, h, w = a.shape
+        a_p = jnp.pad(a, ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])))
+        out_h = (h + 2 * p[0] - d[0] * (k[0] - 1) - 1) // s[0] + 1
+        out_w = (w + 2 * p[1] - d[1] * (k[1] - 1) - 1) // s[1] + 1
+        cols = []
+        for i in range(k[0]):
+            for j in range(k[1]):
+                patch = a_p[:, :, i * d[0]: i * d[0] + out_h * s[0]: s[0],
+                            j * d[1]: j * d[1] + out_w * s[1]: s[1]]
+                cols.append(patch)
+        stacked = jnp.stack(cols, axis=2)  # [N, C, k*k, out_h, out_w]
+        return stacked.reshape(n, c * k[0] * k[1], out_h * out_w)
+    return dispatch("unfold", fwd, ensure_tensor(x))
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    def tolist(v):
+        return [v, v] if isinstance(v, int) else list(v)
+    out_size = tolist(output_sizes)
+    k = tolist(kernel_sizes)
+    s = tolist(strides)
+    p = tolist(paddings)
+    d = tolist(dilations)
+
+    def fwd(a):
+        n, ckk, L = a.shape
+        c = ckk // (k[0] * k[1])
+        out_h = (out_size[0] + 2 * p[0] - d[0] * (k[0] - 1) - 1) // s[0] + 1
+        out_w = (out_size[1] + 2 * p[1] - d[1] * (k[1] - 1) - 1) // s[1] + 1
+        a_r = a.reshape(n, c, k[0], k[1], out_h, out_w)
+        res = jnp.zeros((n, c, out_size[0] + 2 * p[0], out_size[1] + 2 * p[1]),
+                        a.dtype)
+        for i in range(k[0]):
+            for j in range(k[1]):
+                res = res.at[:, :, i * d[0]: i * d[0] + out_h * s[0]: s[0],
+                             j * d[1]: j * d[1] + out_w * s[1]: s[1]].add(
+                    a_r[:, :, i, j])
+        return res[:, :, p[0]: p[0] + out_size[0], p[1]: p[1] + out_size[1]]
+    return dispatch("fold", fwd, ensure_tensor(x))
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def fwd(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        nb = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return dot / jnp.maximum(na * nb, eps)
+    return dispatch("cosine_similarity", fwd, ensure_tensor(x1), ensure_tensor(x2))
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = int(upscale_factor)
+
+    def fwd(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c // (r * r), r, r, h, w)
+            a = jnp.transpose(a, (0, 1, 4, 2, 5, 3))
+            return a.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h, w, r, r, c // (r * r))
+        a = jnp.transpose(a, (0, 1, 3, 2, 4, 5))
+        return a.reshape(n, h * r, w * r, c // (r * r))
+    return dispatch("pixel_shuffle", fwd, ensure_tensor(x))
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = int(downscale_factor)
+
+    def fwd(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c, h // r, r, w // r, r)
+            a = jnp.transpose(a, (0, 1, 3, 5, 2, 4))
+            return a.reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h // r, r, w // r, r, c)
+        a = jnp.transpose(a, (0, 1, 3, 2, 4, 5))
+        return a.reshape(n, h // r, w // r, c * r * r)
+    return dispatch("pixel_unshuffle", fwd, ensure_tensor(x))
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    g = int(groups)
+
+    def fwd(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, g, c // g, h, w)
+            a = jnp.swapaxes(a, 1, 2)
+            return a.reshape(n, c, h, w)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h, w, g, c // g)
+        a = jnp.swapaxes(a, 3, 4)
+        return a.reshape(n, h, w, c)
+    return dispatch("channel_shuffle", fwd, ensure_tensor(x))
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def fwd(l):
+        k = l.shape[-1]
+        if prior_dist is not None:
+            pd = prior_dist._data if isinstance(prior_dist, Tensor) else prior_dist
+            return (1 - epsilon) * l + epsilon * pd
+        return (1 - epsilon) * l + epsilon / k
+    return dispatch("label_smooth", fwd, ensure_tensor(label))

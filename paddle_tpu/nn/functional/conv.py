@@ -1,0 +1,170 @@
+"""Convolution functionals.
+
+Reference parity: python/paddle/nn/functional/conv.py (conv2d etc. → phi conv
+kernels/cuDNN). TPU-native: jax.lax.conv_general_dilated — XLA lowers it onto the
+MXU directly; no cuDNN-style algo search needed (XLA autotunes layouts).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ...ops.dispatch import dispatch, ensure_tensor
+
+
+def _norm_tuple(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(int(x) for x in v)
+
+
+def _norm_padding(padding, n):
+    """Returns (lax_padding, explicit) where lax_padding is str or list of pairs."""
+    if isinstance(padding, str):
+        return padding.upper(), None
+    if isinstance(padding, int):
+        return [(padding, padding)] * n, None
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding], None
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)], None
+    # paddle also allows [[0,0],[0,0],[h_lo,h_hi],[w_lo,w_hi]]
+    pairs = [tuple(p) for p in padding if not isinstance(p, int)]
+    if len(pairs) == n + 2:
+        pairs = pairs[2:]
+    return [tuple(int(v) for v in p) for p in pairs], None
+
+
+def _conv_nd(name, x, weight, bias, stride, padding, dilation, groups,
+             data_format, nd):
+    strides = _norm_tuple(stride, nd)
+    dil = _norm_tuple(dilation, nd)
+    pad_spec, _ = _norm_padding(padding, nd)
+    channel_last = data_format.endswith("C")
+    spatial = "DHW"[-nd:] if nd > 1 else "W"
+    if channel_last:
+        dn_in = "N" + spatial + "C"
+    else:
+        dn_in = "NC" + spatial
+    dn = lax.conv_dimension_numbers((1,) * (nd + 2), (1,) * (nd + 2),
+                                    (dn_in, "OI" + spatial, dn_in))
+
+    def fwd(*args):
+        a, w = args[0], args[1]
+        out = lax.conv_general_dilated(
+            a, w, window_strides=strides, padding=pad_spec,
+            rhs_dilation=dil, dimension_numbers=dn, feature_group_count=groups,
+            preferred_element_type=a.dtype if a.dtype != jnp.bfloat16 else jnp.float32)
+        out = out.astype(a.dtype)
+        if len(args) == 3:
+            b = args[2]
+            shape = [1] * out.ndim
+            shape[-1 if channel_last else 1] = -1
+            out = out + b.reshape(shape)
+        return out
+
+    tensors = [ensure_tensor(x), ensure_tensor(weight)]
+    if bias is not None:
+        tensors.append(ensure_tensor(bias))
+    return dispatch(name, fwd, *tensors)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    fmt = "NCW" if data_format in ("NCL", "NCW") else "NWC"
+    return _conv_nd("conv1d", x, weight, bias, stride, padding, dilation, groups,
+                    fmt, 1)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv_nd("conv2d", x, weight, bias, stride, padding, dilation, groups,
+                    data_format, 2)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv_nd("conv3d", x, weight, bias, stride, padding, dilation, groups,
+                    data_format, 3)
+
+
+def _conv_transpose_nd(name, x, weight, bias, stride, padding, output_padding,
+                       dilation, groups, data_format, nd, output_size=None):
+    strides = _norm_tuple(stride, nd)
+    dil = _norm_tuple(dilation, nd)
+    out_pad = _norm_tuple(output_padding, nd)
+    channel_last = data_format.endswith("C")
+    spatial = "DHW"[-nd:] if nd > 1 else "W"
+    dn_in = ("N" + spatial + "C") if channel_last else ("NC" + spatial)
+    # weight layout parity with reference: [in, out/groups, *k]
+    dn = lax.conv_dimension_numbers((1,) * (nd + 2), (1,) * (nd + 2),
+                                    (dn_in, "IO" + spatial, dn_in))
+    if isinstance(padding, str):
+        pad_mode = padding.upper()
+        pad_pairs = None
+    else:
+        pad_pairs, _ = _norm_padding(padding, nd)
+        pad_mode = None
+
+    def fwd(*args):
+        a, w = args[0], args[1]
+        k = [d * (s - 1) + 1 for d, s in
+             zip(dil, w.shape[2:] if not channel_last else w.shape[2:])]
+        if pad_mode == "SAME":
+            pads = "SAME"
+        elif pad_mode == "VALID":
+            pads = [(kk - 1, kk - 1 + op) for kk, op in zip(k, out_pad)]
+        else:
+            pads = [(kk - 1 - lo, kk - 1 - hi + op)
+                    for kk, (lo, hi), op in zip(k, pad_pairs, out_pad)]
+        if groups > 1:
+            # split along input-channel axis of both activations and weight
+            ch_axis = -1 if channel_last else 1
+            a_parts = jnp.split(a, groups, axis=ch_axis)
+            w_parts = jnp.split(w, groups, axis=0)
+            outs = [lax.conv_general_dilated(
+                ap, wp, window_strides=(1,) * nd, padding=pads,
+                lhs_dilation=strides, rhs_dilation=dil, dimension_numbers=dn)
+                for ap, wp in zip(a_parts, w_parts)]
+            out = jnp.concatenate(outs, axis=ch_axis)
+        else:
+            out = lax.conv_general_dilated(
+                a, w, window_strides=(1,) * nd, padding=pads,
+                lhs_dilation=strides, rhs_dilation=dil, dimension_numbers=dn)
+        out = out.astype(a.dtype)
+        if len(args) == 3:
+            b = args[2]
+            shape = [1] * out.ndim
+            shape[-1 if channel_last else 1] = -1
+            out = out + b.reshape(shape)
+        return out
+
+    tensors = [ensure_tensor(x), ensure_tensor(weight)]
+    if bias is not None:
+        tensors.append(ensure_tensor(bias))
+    return dispatch(name, fwd, *tensors)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCL",
+                     name=None):
+    fmt = "NCW" if data_format in ("NCL", "NCW") else "NWC"
+    return _conv_transpose_nd("conv1d_transpose", x, weight, bias, stride, padding,
+                              output_padding, dilation, groups, fmt, 1, output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCHW",
+                     name=None):
+    return _conv_transpose_nd("conv2d_transpose", x, weight, bias, stride, padding,
+                              output_padding, dilation, groups, data_format, 2,
+                              output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCDHW",
+                     name=None):
+    return _conv_transpose_nd("conv3d_transpose", x, weight, bias, stride, padding,
+                              output_padding, dilation, groups, data_format, 3,
+                              output_size)

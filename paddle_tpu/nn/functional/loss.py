@@ -1,0 +1,281 @@
+"""Loss functionals.
+
+Reference parity: python/paddle/nn/functional/loss.py.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.dispatch import dispatch, ensure_tensor
+from ...tensor import Tensor
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0,
+                  name=None):
+    it, lt = ensure_tensor(input), ensure_tensor(label)
+    has_w = weight is not None
+
+    def fwd(*args):
+        logits, lab = args[0], args[1]
+        w = args[2] if has_w else None
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
+        else:
+            logp = jnp.log(jnp.maximum(logits.astype(jnp.float32), 1e-30))
+        n_classes = logits.shape[axis]
+        if soft_label or (lab.dtype.kind == "f" and lab.ndim == logits.ndim):
+            soft = lab.astype(jnp.float32)
+            if label_smoothing > 0:
+                soft = soft * (1 - label_smoothing) + label_smoothing / n_classes
+            loss = -jnp.sum(soft * logp, axis=axis)
+            if has_w:
+                wmax = jnp.sum(soft * w.reshape((1,) * (logp.ndim - 1) + (-1,)),
+                               axis=axis)
+                loss = loss * wmax
+            return _reduce(loss, reduction)
+        lab_i = lab.astype(jnp.int32)
+        if lab_i.ndim == logits.ndim:
+            lab_i = jnp.squeeze(lab_i, axis=axis)
+        valid = lab_i != ignore_index
+        safe_lab = jnp.where(valid, lab_i, 0)
+        if label_smoothing > 0:
+            onehot = jax.nn.one_hot(safe_lab, n_classes, axis=axis)
+            soft = onehot * (1 - label_smoothing) + label_smoothing / n_classes
+            loss = -jnp.sum(soft * logp, axis=axis)
+        else:
+            loss = -jnp.take_along_axis(
+                logp, jnp.expand_dims(safe_lab, axis), axis=axis).squeeze(axis)
+        loss = jnp.where(valid, loss, 0.0)
+        if has_w:
+            wsel = jnp.take(w.astype(jnp.float32), safe_lab)
+            wsel = jnp.where(valid, wsel, 0.0)
+            loss = loss * wsel
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(wsel), 1e-12)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(
+                jnp.sum(valid.astype(jnp.float32)), 1.0)
+        return _reduce(loss, reduction)
+
+    tensors = [it, lt]
+    if has_w:
+        tensors.append(ensure_tensor(weight))
+    return dispatch("cross_entropy", fwd, *tensors)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    loss = unsqueeze_last(loss, axis)
+    if return_softmax:
+        from .activation import softmax as softmax_fn
+        return loss, softmax_fn(logits, axis=axis)
+    return loss
+
+
+def unsqueeze_last(t, axis):
+    from ...ops.manipulation import unsqueeze
+    return unsqueeze(t, axis if axis != -1 else -1)
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    it, lt = ensure_tensor(input), ensure_tensor(label)
+    has_w = weight is not None
+
+    def fwd(*args):
+        logp, lab = args[0].astype(jnp.float32), args[1].astype(jnp.int32)
+        valid = lab != ignore_index
+        safe = jnp.where(valid, lab, 0)
+        loss = -jnp.take_along_axis(logp, jnp.expand_dims(safe, 1), axis=1) \
+            .squeeze(1)
+        wsel = jnp.ones_like(loss)
+        if has_w:
+            wsel = jnp.take(args[2].astype(jnp.float32), safe)
+        wsel = jnp.where(valid, wsel, 0.0)
+        loss = loss * wsel
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(wsel), 1e-12)
+        return _reduce(loss, reduction)
+
+    tensors = [it, lt]
+    if has_w:
+        tensors.append(ensure_tensor(weight))
+    return dispatch("nll_loss", fwd, *tensors)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return dispatch("mse_loss",
+                    lambda a, b: _reduce((a - b) ** 2, reduction),
+                    ensure_tensor(input), ensure_tensor(label))
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return dispatch("l1_loss",
+                    lambda a, b: _reduce(jnp.abs(a - b), reduction),
+                    ensure_tensor(input), ensure_tensor(label))
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def fwd(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        # paddle uses delta-scaled variant: 0.5*d^2/delta for d<delta
+        return _reduce(loss, reduction)
+    return dispatch("smooth_l1_loss", fwd, ensure_tensor(input),
+                    ensure_tensor(label))
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean", name=None):
+    def fwd(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d <= delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+        return _reduce(loss, reduction)
+    return dispatch("huber_loss", fwd, ensure_tensor(input), ensure_tensor(label))
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    has_w = weight is not None
+
+    def fwd(*args):
+        p, y = args[0].astype(jnp.float32), args[1].astype(jnp.float32)
+        p = jnp.clip(p, 1e-12, 1.0 - 1e-12)
+        loss = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        if has_w:
+            loss = loss * args[2].astype(jnp.float32)
+        return _reduce(loss, reduction)
+    tensors = [ensure_tensor(input), ensure_tensor(label)]
+    if has_w:
+        tensors.append(ensure_tensor(weight))
+    return dispatch("binary_cross_entropy", fwd, *tensors)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    has_w = weight is not None
+    has_pw = pos_weight is not None
+
+    def fwd(*args):
+        z, y = args[0].astype(jnp.float32), args[1].astype(jnp.float32)
+        i = 2
+        # stable: max(z,0) - z*y + log(1+exp(-|z|))
+        base = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        if has_pw:
+            pw = args[i + int(has_w)].astype(jnp.float32) if has_w else \
+                args[i].astype(jnp.float32)
+            logsig = jax.nn.log_sigmoid(z)
+            log1msig = jax.nn.log_sigmoid(-z)
+            base = -(pw * y * logsig + (1 - y) * log1msig)
+        if has_w:
+            base = base * args[2].astype(jnp.float32)
+        return _reduce(base, reduction)
+    tensors = [ensure_tensor(logit), ensure_tensor(label)]
+    if has_w:
+        tensors.append(ensure_tensor(weight))
+    if has_pw:
+        tensors.append(ensure_tensor(pos_weight))
+    return dispatch("bce_with_logits", fwd, *tensors)
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def fwd(a, b):
+        a = a.astype(jnp.float32)
+        b = b.astype(jnp.float32)
+        if log_target:
+            loss = jnp.exp(b) * (b - a)
+        else:
+            loss = jnp.where(b > 0, b * (jnp.log(jnp.maximum(b, 1e-30)) - a), 0.0)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / a.shape[0]
+        return _reduce(loss, reduction)
+    return dispatch("kl_div", fwd, ensure_tensor(input), ensure_tensor(label))
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    def fwd(a, b, y):
+        loss = jnp.maximum(0.0, -y * (a - b) + margin)
+        return _reduce(loss, reduction)
+    return dispatch("margin_ranking_loss", fwd, ensure_tensor(input),
+                    ensure_tensor(other), ensure_tensor(label))
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean",
+                          name=None):
+    def fwd(a, b, y):
+        cos = (jnp.sum(a * b, axis=-1)
+               / jnp.maximum(jnp.linalg.norm(a, axis=-1)
+                             * jnp.linalg.norm(b, axis=-1), 1e-12))
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+    return dispatch("cosine_embedding_loss", fwd, ensure_tensor(input1),
+                    ensure_tensor(input2), ensure_tensor(label))
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-06, swap=False, reduction="mean", name=None):
+    def fwd(a, pos, neg):
+        dp = jnp.linalg.norm(a - pos + epsilon, ord=p, axis=-1)
+        dn = jnp.linalg.norm(a - neg + epsilon, ord=p, axis=-1)
+        if swap:
+            dsn = jnp.linalg.norm(pos - neg + epsilon, ord=p, axis=-1)
+            dn = jnp.minimum(dn, dsn)
+        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+    return dispatch("triplet_margin_loss", fwd, ensure_tensor(input),
+                    ensure_tensor(positive), ensure_tensor(negative))
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    def fwd(a, y):
+        loss = jnp.where(y == 1, a, jnp.maximum(0.0, margin - a))
+        return _reduce(loss, reduction)
+    return dispatch("hinge_embedding_loss", fwd, ensure_tensor(input),
+                    ensure_tensor(label))
+
+
+def square_error_cost(input, label):
+    return dispatch("square_error_cost", lambda a, b: (a - b) ** 2,
+                    ensure_tensor(input), ensure_tensor(label))
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    def fwd(p, y):
+        return -y * jnp.log(p + epsilon) - (1 - y) * jnp.log(1 - p + epsilon)
+    return dispatch("log_loss", fwd, ensure_tensor(input), ensure_tensor(label))
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    has_n = normalizer is not None
+
+    def fwd(*args):
+        z, y = args[0].astype(jnp.float32), args[1].astype(jnp.float32)
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * ((1 - p_t) ** gamma) * ce
+        if has_n:
+            loss = loss / args[2].astype(jnp.float32)
+        return _reduce(loss, reduction)
+    tensors = [ensure_tensor(logit), ensure_tensor(label)]
+    if has_n:
+        tensors.append(ensure_tensor(normalizer))
+    return dispatch("sigmoid_focal_loss", fwd, *tensors)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    raise NotImplementedError("ctc_loss: planned (lax.scan DP implementation)")

@@ -1,0 +1,225 @@
+"""Normalization functionals.
+
+Reference parity: python/paddle/nn/functional/norm.py (+ fused
+rms_norm/layer_norm in incubate). These are the HBM-bandwidth-bound ops XLA fuses
+into single kernels on TPU; a Pallas fused path is used for the hot RMSNorm case
+(kernels/rmsnorm.py) when available.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...ops.dispatch import dispatch, ensure_tensor
+from ...tensor import Tensor
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def fwd(a):
+        if p == 2:
+            n = jnp.sqrt(jnp.sum(a * a, axis=axis, keepdims=True))
+        else:
+            n = jnp.sum(jnp.abs(a) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return a / jnp.maximum(n, epsilon)
+    return dispatch("normalize", fwd, ensure_tensor(x))
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
+               name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    n_axes = len(tuple(normalized_shape))
+
+    def fwd(*args):
+        a = args[0]
+        axes = tuple(range(a.ndim - n_axes, a.ndim))
+        mean = jnp.mean(a.astype(jnp.float32), axis=axes, keepdims=True)
+        var = jnp.var(a.astype(jnp.float32), axis=axes, keepdims=True)
+        out = (a.astype(jnp.float32) - mean) / jnp.sqrt(var + epsilon)
+        i = 1
+        if weight is not None:
+            out = out * args[i].astype(jnp.float32)
+            i += 1
+        if bias is not None:
+            out = out + args[i].astype(jnp.float32)
+        return out.astype(a.dtype)
+
+    tensors = [ensure_tensor(x)]
+    if weight is not None:
+        tensors.append(ensure_tensor(weight))
+    if bias is not None:
+        tensors.append(ensure_tensor(bias))
+    return dispatch("layer_norm", fwd, *tensors)
+
+
+def rms_norm(x, weight=None, bias=None, epsilon=1e-6, begin_norm_axis=-1,
+             name=None):
+    """Parity: paddle.incubate.nn.functional.fused_rms_norm."""
+    def fwd(*args):
+        a = args[0]
+        ax = begin_norm_axis if begin_norm_axis >= 0 else a.ndim + begin_norm_axis
+        axes = tuple(range(ax, a.ndim))
+        a32 = a.astype(jnp.float32)
+        ms = jnp.mean(a32 * a32, axis=axes, keepdims=True)
+        out = a32 * (1.0 / jnp.sqrt(ms + epsilon))
+        i = 1
+        if weight is not None:
+            out = out * args[i].astype(jnp.float32)
+            i += 1
+        if bias is not None:
+            out = out + args[i].astype(jnp.float32)
+        return out.astype(a.dtype)
+
+    tensors = [ensure_tensor(x)]
+    if weight is not None:
+        tensors.append(ensure_tensor(weight))
+    if bias is not None:
+        tensors.append(ensure_tensor(bias))
+    return dispatch("rms_norm", fwd, *tensors)
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-05, data_format="NCHW",
+               use_global_stats=None, name=None):
+    xt = ensure_tensor(x)
+    ch_axis = xt._data.ndim - 1 if data_format.endswith("C") and \
+        data_format != "NCHW" else 1
+    if xt._data.ndim == 2:
+        ch_axis = 1
+    reduce_axes = tuple(i for i in range(xt._data.ndim) if i != ch_axis)
+    use_batch_stats = training and not use_global_stats
+
+    rm = ensure_tensor(running_mean)
+    rv = ensure_tensor(running_var)
+
+    if use_batch_stats:
+        # update running stats eagerly (buffers mutate in place, parity with ref)
+        a32 = xt._data.astype(jnp.float32)
+        batch_mean = jnp.mean(a32, axis=reduce_axes)
+        batch_var = jnp.var(a32, axis=reduce_axes)
+        rm._data = (momentum * rm._data + (1 - momentum) * batch_mean).astype(
+            rm._data.dtype)
+        rv._data = (momentum * rv._data + (1 - momentum) * batch_var).astype(
+            rv._data.dtype)
+
+        def fwd(*args):
+            a = args[0]
+            a32_ = a.astype(jnp.float32)
+            m = jnp.mean(a32_, axis=reduce_axes, keepdims=True)
+            v = jnp.var(a32_, axis=reduce_axes, keepdims=True)
+            out = (a32_ - m) / jnp.sqrt(v + epsilon)
+            i = 1
+            shape = [1] * a.ndim
+            shape[ch_axis] = -1
+            if weight is not None:
+                out = out * args[i].astype(jnp.float32).reshape(shape)
+                i += 1
+            if bias is not None:
+                out = out + args[i].astype(jnp.float32).reshape(shape)
+            return out.astype(a.dtype)
+        tensors = [xt]
+    else:
+        def fwd(*args):
+            a, m, v = args[0], args[1], args[2]
+            shape = [1] * a.ndim
+            shape[ch_axis] = -1
+            out = ((a.astype(jnp.float32) - m.astype(jnp.float32).reshape(shape))
+                   / jnp.sqrt(v.astype(jnp.float32).reshape(shape) + epsilon))
+            i = 3
+            if weight is not None:
+                out = out * args[i].astype(jnp.float32).reshape(shape)
+                i += 1
+            if bias is not None:
+                out = out + args[i].astype(jnp.float32).reshape(shape)
+            return out.astype(a.dtype)
+        tensors = [xt, rm, rv]
+
+    if weight is not None:
+        tensors.append(ensure_tensor(weight))
+    if bias is not None:
+        tensors.append(ensure_tensor(bias))
+    return dispatch("batch_norm", fwd, *tensors)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-05,
+                  data_format="NCHW", name=None):
+    def fwd(*args):
+        a = args[0]
+        axes = tuple(range(2, a.ndim))
+        a32 = a.astype(jnp.float32)
+        m = jnp.mean(a32, axis=axes, keepdims=True)
+        v = jnp.var(a32, axis=axes, keepdims=True)
+        out = (a32 - m) / jnp.sqrt(v + eps)
+        shape = [1] * a.ndim
+        shape[1] = -1
+        i = 1
+        if weight is not None:
+            out = out * args[i].astype(jnp.float32).reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + args[i].astype(jnp.float32).reshape(shape)
+        return out.astype(a.dtype)
+
+    tensors = [ensure_tensor(x)]
+    if weight is not None:
+        tensors.append(ensure_tensor(weight))
+    if bias is not None:
+        tensors.append(ensure_tensor(bias))
+    return dispatch("instance_norm", fwd, *tensors)
+
+
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    g = int(num_groups)
+    channel_last = data_format.endswith("C") and data_format != "NCHW"
+
+    def fwd(*args):
+        a = args[0]
+        if channel_last:
+            a_m = jnp.moveaxis(a, -1, 1)
+        else:
+            a_m = a
+        n, c = a_m.shape[0], a_m.shape[1]
+        rest = a_m.shape[2:]
+        r = a_m.reshape(n, g, c // g, *rest).astype(jnp.float32)
+        axes = tuple(range(2, r.ndim))
+        m = jnp.mean(r, axis=axes, keepdims=True)
+        v = jnp.var(r, axis=axes, keepdims=True)
+        out = ((r - m) / jnp.sqrt(v + epsilon)).reshape(a_m.shape)
+        shape = [1] * a_m.ndim
+        shape[1] = -1
+        i = 1
+        if weight is not None:
+            out = out * args[i].astype(jnp.float32).reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + args[i].astype(jnp.float32).reshape(shape)
+        out = out.astype(a.dtype)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    tensors = [ensure_tensor(x)]
+    if weight is not None:
+        tensors.append(ensure_tensor(weight))
+    if bias is not None:
+        tensors.append(ensure_tensor(bias))
+    return dispatch("group_norm", fwd, *tensors)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    def fwd(a):
+        sq = a.astype(jnp.float32) ** 2
+        ch_axis = 1
+        c = a.shape[ch_axis]
+        half = size // 2
+        pad_width = [(0, 0)] * a.ndim
+        pad_width[ch_axis] = (half, size - half - 1)
+        padded = jnp.pad(sq, pad_width)
+        acc = jnp.zeros_like(sq)
+        for i in range(size):
+            acc = acc + jnp.take(padded, jnp.arange(i, i + c), axis=ch_axis)
+        div = (k + alpha * acc) ** beta
+        return (a.astype(jnp.float32) / div).astype(a.dtype)
+    return dispatch("local_response_norm", fwd, ensure_tensor(x))

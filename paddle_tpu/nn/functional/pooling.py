@@ -1,0 +1,258 @@
+"""Pooling functionals.
+
+Reference parity: python/paddle/nn/functional/pooling.py. TPU-native:
+lax.reduce_window (XLA pools natively; no pooling kernels to write).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from ...ops.dispatch import dispatch, ensure_tensor
+
+
+def _norm(v, n):
+    return (v,) * n if isinstance(v, int) else tuple(int(x) for x in v)
+
+
+def _pads(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    pairs = [tuple(p) for p in padding]
+    if len(pairs) == n + 2:
+        pairs = pairs[2:]
+    return pairs
+
+
+def _pool(name, x, ksize, stride, padding, nd, reducer, init, channel_last,
+          ceil_mode=False, exclusive=True, count_include_pad=False):
+    k = _norm(ksize, nd)
+    s = _norm(stride if stride is not None else ksize, nd)
+    p = _pads(padding, nd)
+
+    def fwd(a):
+        if channel_last:
+            window = (1,) + k + (1,)
+            strides = (1,) + s + (1,)
+            spatial_axes = list(range(1, 1 + nd))
+        else:
+            window = (1, 1) + k
+            strides = (1, 1) + s
+            spatial_axes = list(range(2, 2 + nd))
+        if isinstance(p, str):
+            pads = p
+        else:
+            full = [(0, 0)] * a.ndim
+            for ax, pr in zip(spatial_axes, p):
+                extra = 0
+                if ceil_mode:
+                    size = a.shape[ax] + pr[0] + pr[1]
+                    kk, ss = window[ax], strides[ax]
+                    rem = (size - kk) % ss
+                    if rem != 0:
+                        extra = ss - rem
+                full[ax] = (pr[0], pr[1] + extra)
+            pads = full
+        if name.startswith("max"):
+            neg = (jnp.finfo(a.dtype).min if a.dtype.kind == "f"
+                   else jnp.iinfo(a.dtype).min)
+            return lax.reduce_window(a, neg, lax.max, window, strides, pads)
+        # avg pool
+        ones = jnp.ones_like(a)
+        summed = lax.reduce_window(a, 0.0 if a.dtype.kind == "f" else 0,
+                                   lax.add, window, strides, pads)
+        if exclusive and not count_include_pad:
+            counts = lax.reduce_window(ones, 0.0, lax.add, window, strides, pads)
+            return (summed / counts).astype(a.dtype)
+        return (summed / float(np.prod(k))).astype(a.dtype)
+    return dispatch(name, fwd, ensure_tensor(x))
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    out = _pool("max_pool1d", x, kernel_size, stride, padding, 1, lax.max, None,
+                data_format.endswith("C") and data_format != "NCL",
+                ceil_mode=ceil_mode)
+    if return_mask:
+        return out, _pool_mask(x, out, kernel_size, stride, padding, 1)
+    return out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    out = _pool("max_pool2d", x, kernel_size, stride, padding, 2, lax.max, None,
+                data_format == "NHWC", ceil_mode=ceil_mode)
+    if return_mask:
+        return out, _pool_mask(x, out, kernel_size, stride, padding, 2)
+    return out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    out = _pool("max_pool3d", x, kernel_size, stride, padding, 3, lax.max, None,
+                data_format == "NDHWC", ceil_mode=ceil_mode)
+    if return_mask:
+        return out, _pool_mask(x, out, kernel_size, stride, padding, 3)
+    return out
+
+
+def _pool_mask(x, out, kernel_size, stride, padding, nd):
+    """Indices of max elements (flat per spatial plane), computed via unfold-argmax."""
+    xt = ensure_tensor(x)
+    k = _norm(kernel_size, nd)
+    s = _norm(stride if stride is not None else kernel_size, nd)
+    p = _pads(padding, nd)
+
+    def fwd(a):
+        # build windows by gather; nd<=3 small loops are fine (traced once)
+        if nd != 2:
+            raise NotImplementedError("return_mask only for 2d pooling")
+        n, c, h, w = a.shape
+        (ph, _), (pw, _) = p if not isinstance(p, str) else ((0, 0), (0, 0))
+        neg = jnp.finfo(a.dtype).min
+        a_p = jnp.pad(a, ((0, 0), (0, 0), (ph, ph), (pw, pw)),
+                      constant_values=neg)
+        out_h = (h + 2 * ph - k[0]) // s[0] + 1
+        out_w = (w + 2 * pw - k[1]) // s[1] + 1
+        patches, indices = [], []
+        for i in range(k[0]):
+            for j in range(k[1]):
+                patch = a_p[:, :, i: i + out_h * s[0]: s[0],
+                            j: j + out_w * s[1]: s[1]]
+                patches.append(patch)
+                row = jnp.arange(out_h) * s[0] + i - ph
+                col = jnp.arange(out_w) * s[1] + j - pw
+                flat = row[:, None] * w + col[None, :]
+                indices.append(jnp.broadcast_to(flat, (n, c, out_h, out_w)))
+        stacked = jnp.stack(patches, axis=-1)
+        idx_stacked = jnp.stack(indices, axis=-1)
+        which = jnp.argmax(stacked, axis=-1)
+        return jnp.take_along_axis(idx_stacked, which[..., None],
+                                   axis=-1)[..., 0].astype(jnp.int32)
+    return dispatch("max_pool_mask", fwd, xt)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool("avg_pool1d", x, kernel_size, stride, padding, 1, lax.add, 0.0,
+                 False, ceil_mode=ceil_mode, exclusive=exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    if divisor_override is not None:
+        k = _norm(kernel_size, 2)
+        out = _pool("avg_pool2d", x, kernel_size, stride, padding, 2, lax.add, 0.0,
+                    data_format == "NHWC", ceil_mode=ceil_mode, exclusive=False,
+                    count_include_pad=True)
+        scale = float(np.prod(k)) / float(divisor_override)
+        from ...ops.math import scale as scale_op
+        return scale_op(out, scale)
+    return _pool("avg_pool2d", x, kernel_size, stride, padding, 2, lax.add, 0.0,
+                 data_format == "NHWC", ceil_mode=ceil_mode, exclusive=exclusive)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool("avg_pool3d", x, kernel_size, stride, padding, 3, lax.add, 0.0,
+                 data_format == "NDHWC", ceil_mode=ceil_mode, exclusive=exclusive)
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0, ceil_mode=False,
+              data_format="NCL", name=None):
+    from ...ops import math as M
+    p = float(norm_type)
+    xt = ensure_tensor(x)
+    powered = dispatch("lp_pow", lambda a: jnp.abs(a) ** p, xt)
+    pooled = _pool("avg_pool1d", powered, kernel_size, stride, padding, 1,
+                   lax.add, 0.0, False, ceil_mode=ceil_mode, exclusive=False,
+                   count_include_pad=True)
+    k = _norm(kernel_size, 1)
+    return dispatch("lp_root", lambda a: (a * float(np.prod(k))) ** (1.0 / p),
+                    pooled)
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0, ceil_mode=False,
+              data_format="NCHW", name=None):
+    p = float(norm_type)
+    xt = ensure_tensor(x)
+    powered = dispatch("lp_pow", lambda a: jnp.abs(a) ** p, xt)
+    pooled = _pool("avg_pool2d", powered, kernel_size, stride, padding, 2,
+                   lax.add, 0.0, data_format == "NHWC", ceil_mode=ceil_mode,
+                   exclusive=False, count_include_pad=True)
+    k = _norm(kernel_size, 2)
+    return dispatch("lp_root", lambda a: (a * float(np.prod(k))) ** (1.0 / p),
+                    pooled)
+
+
+def _adaptive_bounds(in_size, out_size):
+    starts = (np.arange(out_size) * in_size) // out_size
+    ends = -(-((np.arange(out_size) + 1) * in_size) // out_size)
+    return starts, ends
+
+
+def _adaptive_pool(name, x, output_size, nd, is_max, channel_last=False,
+                   return_mask=False):
+    o = _norm(output_size, nd)
+
+    def fwd(a):
+        spatial_axes = (list(range(1, 1 + nd)) if channel_last
+                        else list(range(2, 2 + nd)))
+        out = a
+        for ax, osz in zip(spatial_axes, o):
+            if osz is None:
+                continue
+            in_sz = out.shape[ax]
+            if in_sz % osz == 0:
+                # uniform windows: reshape-reduce (fast path)
+                kk = in_sz // osz
+                new_shape = out.shape[:ax] + (osz, kk) + out.shape[ax + 1:]
+                r = out.reshape(new_shape)
+                out = (jnp.max(r, axis=ax + 1) if is_max
+                       else jnp.mean(r, axis=ax + 1))
+            else:
+                starts, ends = _adaptive_bounds(in_sz, osz)
+                slices = []
+                for st, en in zip(starts, ends):
+                    seg = jnp.take(out, jnp.arange(st, en), axis=ax)
+                    slices.append(jnp.max(seg, axis=ax, keepdims=True) if is_max
+                                  else jnp.mean(seg, axis=ax, keepdims=True))
+                out = jnp.concatenate(slices, axis=ax)
+        return out.astype(a.dtype)
+    return dispatch(name, fwd, ensure_tensor(x))
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool("adaptive_avg_pool1d", x, output_size, 1, False)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool("adaptive_avg_pool2d", x, output_size, 2, False,
+                          data_format == "NHWC")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool("adaptive_avg_pool3d", x, output_size, 3, False,
+                          data_format == "NDHWC")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool("adaptive_max_pool1d", x, output_size, 1, True)
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool("adaptive_max_pool2d", x, output_size, 2, True)
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool("adaptive_max_pool3d", x, output_size, 3, True)

@@ -1,0 +1,2 @@
+"""Containers live in layers.py (Sequential, LayerList, LayerDict, ParameterList)."""
+from .layers import LayerDict, LayerList, ParameterList, Sequential  # noqa: F401

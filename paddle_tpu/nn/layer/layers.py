@@ -1,0 +1,500 @@
+"""Layer: the module base class.
+
+Reference parity: python/paddle/nn/layer/layers.py:353 (class Layer; __call__
+at :1521) — sublayer/parameter auto-registration via __setattr__, state_dict with
+structured names, train/eval modes, forward hooks, apply/to. TPU-native addition:
+`named_state()` + `swap_state()` used by jit.to_static to run the same eager
+forward code as a pure function of (params, buffers) under jax tracing.
+"""
+from __future__ import annotations
+
+import contextlib
+from collections import OrderedDict
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from ...framework.dtype import convert_dtype, get_default_dtype
+from ...tensor import Parameter, Tensor
+
+_dygraph_mode = [True]
+
+
+def in_dynamic_mode():
+    return _dygraph_mode[0]
+
+
+def enable_static():
+    _dygraph_mode[0] = False
+
+
+def disable_static():
+    _dygraph_mode[0] = True
+
+
+class HookRemoveHelper:
+    def __init__(self, hooks, idx):
+        self._hooks, self._idx = hooks, idx
+
+    def remove(self):
+        self._hooks.pop(self._idx, None)
+
+
+class Layer:
+    def __init__(self, name_scope: Optional[str] = None, dtype=None):
+        # Use object.__setattr__ because our __setattr__ inspects these dicts.
+        object.__setattr__(self, "_parameters", OrderedDict())
+        object.__setattr__(self, "_sub_layers", OrderedDict())
+        object.__setattr__(self, "_buffers", OrderedDict())
+        object.__setattr__(self, "_non_persistable_buffer_names", set())
+        self.training = True
+        self._dtype = convert_dtype(dtype) or get_default_dtype()
+        self._name_scope = name_scope or type(self).__name__.lower()
+        self._forward_pre_hooks = OrderedDict()
+        self._forward_post_hooks = OrderedDict()
+        self._hook_id = 0
+        self._casted_by_pure_fp16 = False
+
+    # -- registration ---------------------------------------------------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call Layer.__init__ before assigning parameters")
+            for store in (layers, buffers):
+                if store is not None:
+                    store.pop(name, None)
+            params[name] = value
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call Layer.__init__ before assigning sublayers")
+            for store in (params, buffers):
+                if store is not None:
+                    store.pop(name, None)
+            layers[name] = value
+        else:
+            if params is not None and name in params:
+                if value is None:
+                    params[name] = None
+                    return
+                params.pop(name)
+            if layers is not None and name in layers:
+                layers.pop(name)
+            if buffers is not None and name in buffers:
+                if value is None or isinstance(value, Tensor):
+                    buffers[name] = value
+                    return
+                buffers.pop(name)
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for store_name in ("_parameters", "_sub_layers", "_buffers"):
+            store = self.__dict__.get(store_name)
+            if store is not None and name in store:
+                return store[name]
+        raise AttributeError(f"'{type(self).__name__}' object has no attribute '{name}'")
+
+    def __delattr__(self, name):
+        for store_name in ("_parameters", "_sub_layers", "_buffers"):
+            store = self.__dict__.get(store_name)
+            if store is not None and name in store:
+                del store[name]
+                return
+        object.__delattr__(self, name)
+
+    def __dir__(self):
+        base = list(super().__dir__())
+        return base + list(self._parameters) + list(self._sub_layers) + list(self._buffers)
+
+    def add_sublayer(self, name: str, sublayer: "Layer") -> "Layer":
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    def add_parameter(self, name: str, parameter: Optional[Parameter]):
+        self._parameters[str(name)] = parameter
+        return parameter
+
+    def register_buffer(self, name: str, tensor: Optional[Tensor],
+                        persistable: bool = True):
+        self._buffers[str(name)] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(str(name))
+        elif name in self._non_persistable_buffer_names:
+            self._non_persistable_buffer_names.remove(str(name))
+
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias: bool = False,
+                         default_initializer=None) -> Parameter:
+        from ..initializer import Constant, XavierUniform, _resolve_attr
+        dtype = convert_dtype(dtype) or self._dtype
+        init, learning_rate, name = _resolve_attr(attr, default_initializer,
+                                                  is_bias=is_bias)
+        if init is None:
+            init = Constant(0.0) if is_bias else XavierUniform()
+        data = init(tuple(int(s) for s in shape), dtype)
+        p = Parameter(data, name=name)
+        p.optimize_attr["learning_rate"] = learning_rate
+        return p
+
+    def create_tensor(self, name=None, dtype=None, persistable=False):
+        import jax.numpy as jnp
+        t = Tensor(jnp.zeros((), convert_dtype(dtype) or self._dtype), name=name)
+        t.persistable = persistable
+        return t
+
+    # -- traversal ------------------------------------------------------------
+    def named_sublayers(self, prefix: str = "", include_self: bool = False,
+                        layers_set=None) -> Iterator[Tuple[str, "Layer"]]:
+        if layers_set is None:
+            layers_set = set()
+        if id(self) in layers_set:
+            return
+        layers_set.add(id(self))
+        if include_self:
+            yield prefix, self
+        for name, layer in self._sub_layers.items():
+            if layer is None:
+                continue
+            p = prefix + ("." if prefix else "") + name
+            yield from layer.named_sublayers(prefix=p, include_self=True,
+                                             layers_set=layers_set)
+
+    def sublayers(self, include_self: bool = False) -> List["Layer"]:
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def children(self) -> Iterator["Layer"]:
+        for _, l in self.named_children():
+            yield l
+
+    def named_children(self) -> Iterator[Tuple[str, "Layer"]]:
+        for name, l in self._sub_layers.items():
+            if l is not None:
+                yield name, l
+
+    def named_parameters(self, prefix: str = "", include_sublayers: bool = True
+                         ) -> Iterator[Tuple[str, Parameter]]:
+        seen = set()
+        for layer_name, layer in self.named_sublayers(prefix=prefix,
+                                                      include_self=True):
+            for name, p in layer._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                yield layer_name + ("." if layer_name else "") + name, p
+            if not include_sublayers:
+                break
+
+    def parameters(self, include_sublayers: bool = True) -> List[Parameter]:
+        return [p for _, p in self.named_parameters(
+            include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix: str = "", include_sublayers: bool = True
+                      ) -> Iterator[Tuple[str, Tensor]]:
+        seen = set()
+        for layer_name, layer in self.named_sublayers(prefix=prefix,
+                                                      include_self=True):
+            for name, b in layer._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                yield layer_name + ("." if layer_name else "") + name, b
+            if not include_sublayers:
+                break
+
+    def buffers(self, include_sublayers: bool = True) -> List[Tensor]:
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    # -- state dict -----------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers: bool = True,
+                   structured_name_prefix: str = "", use_hook: bool = True
+                   ) -> Dict[str, Tensor]:
+        out = destination if destination is not None else OrderedDict()
+        for name, p in self.named_parameters(prefix=structured_name_prefix):
+            out[name] = p
+        for layer_name, layer in self.named_sublayers(
+                prefix=structured_name_prefix, include_self=True):
+            for name, b in layer._buffers.items():
+                if b is None or name in layer._non_persistable_buffer_names:
+                    continue
+                out[layer_name + ("." if layer_name else "") + name] = b
+        return out
+
+    def set_state_dict(self, state_dict, use_structured_name: bool = True):
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for name, target in own.items():
+            if name in state_dict:
+                value = state_dict[name]
+                arr = value._data if isinstance(value, Tensor) else np.asarray(value)
+                target.set_value(arr)
+            else:
+                missing.append(name)
+        for name in state_dict:
+            if name not in own:
+                unexpected.append(name)
+        return missing, unexpected
+
+    set_dict = set_state_dict
+    load_dict = set_state_dict
+
+    # -- modes ----------------------------------------------------------------
+    def train(self):
+        self.training = True
+        for l in self.sublayers():
+            l.training = True
+        return self
+
+    def eval(self):
+        self.training = False
+        for l in self.sublayers():
+            l.training = False
+        return self
+
+    def apply(self, fn: Callable[["Layer"], None]) -> "Layer":
+        for l in self.children():
+            l.apply(fn)
+        fn(self)
+        return self
+
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            import jax.numpy as jnp
+            d = convert_dtype(dtype)
+            for p in self.parameters():
+                p._data = p._data.astype(d)
+            for b in self.buffers():
+                # issubdtype, not dtype.kind: bfloat16's numpy kind is 'V'
+                if jnp.issubdtype(b._data.dtype, jnp.floating):
+                    b._data = b._data.astype(d)
+            for _, l in self.named_sublayers(include_self=True):
+                l._dtype = d
+        return self
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    def float(self):
+        return self.to(dtype="float32")
+
+    def half(self):
+        return self.to(dtype="float16")
+
+    def bfloat16(self):
+        return self.to(dtype="bfloat16")
+
+    # -- hooks ----------------------------------------------------------------
+    def register_forward_pre_hook(self, hook):
+        self._hook_id += 1
+        self._forward_pre_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_pre_hooks, self._hook_id)
+
+    def register_forward_post_hook(self, hook):
+        self._hook_id += 1
+        self._forward_post_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_post_hooks, self._hook_id)
+
+    # -- call -----------------------------------------------------------------
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in list(self._forward_pre_hooks.values()):
+            out = hook(self, inputs)
+            if out is not None:
+                inputs = out if isinstance(out, tuple) else (out,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in list(self._forward_post_hooks.values()):
+            out = hook(self, inputs, outputs)
+            if out is not None:
+                outputs = out
+        return outputs
+
+    # -- functional-state bridge (TPU-native; used by jit.to_static) ----------
+    def named_state(self) -> Dict[str, Tensor]:
+        """All parameters + buffers, by structured name."""
+        out = OrderedDict()
+        for name, p in self.named_parameters():
+            out[name] = p
+        for name, b in self.named_buffers():
+            out[name] = b
+        return out
+
+    @contextlib.contextmanager
+    def swap_state(self, arrays: Dict[str, object]):
+        """Temporarily rebind named state storages to `arrays` (jax tracers ok)."""
+        state = self.named_state()
+        saved = {}
+        try:
+            for name, arr in arrays.items():
+                t = state[name]
+                saved[name] = t._data
+                t._data = arr
+            yield
+        finally:
+            for name, old in saved.items():
+                state[name]._data = old
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, layer in self._sub_layers.items():
+            rep = repr(layer).split("\n")
+            rep = [rep[0]] + ["  " + r for r in rep[1:]]
+            lines.append(f"({name}): " + "\n".join(rep))
+        main = type(self).__name__ + "(" + extra
+        if lines:
+            main += "\n  " + "\n  ".join(lines) + "\n"
+        return main + ")"
+
+    def extra_repr(self) -> str:
+        return ""
+
+
+class Sequential(Layer):
+    """Parity: paddle.nn.Sequential."""
+
+    def __init__(self, *layers):
+        super().__init__()
+        if len(layers) == 1 and isinstance(layers[0], (list, tuple)) and \
+                len(layers[0]) and isinstance(layers[0][0], (list, tuple)):
+            for name, layer in layers[0]:
+                self.add_sublayer(name, layer)
+        else:
+            for i, layer in enumerate(layers):
+                if isinstance(layer, tuple):
+                    self.add_sublayer(layer[0], layer[1])
+                else:
+                    self.add_sublayer(str(i), layer)
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return Sequential(*list(self._sub_layers.values())[idx])
+        keys = list(self._sub_layers)
+        return self._sub_layers[keys[idx]]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers.values())
+
+    def forward(self, x):
+        for layer in self._sub_layers.values():
+            x = layer(x)
+        return x
+
+
+class LayerList(Layer):
+    """Parity: paddle.nn.LayerList."""
+
+    def __init__(self, sublayers=None):
+        super().__init__()
+        if sublayers is not None:
+            for i, l in enumerate(sublayers):
+                self.add_sublayer(str(i), l)
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return LayerList(list(self._sub_layers.values())[idx])
+        n = len(self._sub_layers)
+        if idx < 0:
+            idx += n
+        return self._sub_layers[str(idx)]
+
+    def __setitem__(self, idx, layer):
+        self._sub_layers[str(idx)] = layer
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers.values())
+
+    def append(self, layer):
+        self.add_sublayer(str(len(self._sub_layers)), layer)
+        return self
+
+    def insert(self, index, layer):
+        layers = list(self._sub_layers.values())
+        layers.insert(index, layer)
+        self._sub_layers.clear()
+        for i, l in enumerate(layers):
+            self._sub_layers[str(i)] = l
+
+    def extend(self, layers):
+        for l in layers:
+            self.append(l)
+        return self
+
+
+class LayerDict(Layer):
+    """Parity: paddle.nn.LayerDict."""
+
+    def __init__(self, sublayers=None):
+        super().__init__()
+        if sublayers is not None:
+            self.update(sublayers)
+
+    def __getitem__(self, key):
+        return self._sub_layers[key]
+
+    def __setitem__(self, key, layer):
+        self.add_sublayer(key, layer)
+
+    def __delitem__(self, key):
+        del self._sub_layers[key]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers)
+
+    def __contains__(self, key):
+        return key in self._sub_layers
+
+    def clear(self):
+        self._sub_layers.clear()
+
+    def pop(self, key):
+        layer = self._sub_layers[key]
+        del self._sub_layers[key]
+        return layer
+
+    def keys(self):
+        return self._sub_layers.keys()
+
+    def items(self):
+        return self._sub_layers.items()
+
+    def values(self):
+        return self._sub_layers.values()
+
+    def update(self, sublayers):
+        items = sublayers.items() if isinstance(sublayers, dict) else sublayers
+        for key, layer in items:
+            self.add_sublayer(key, layer)
+
+
+class ParameterList(Layer):
+    """Parity: paddle.nn.ParameterList."""
+
+    def __init__(self, parameters=None):
+        super().__init__()
+        if parameters is not None:
+            for i, p in enumerate(parameters):
+                self.add_parameter(str(i), p)
+
+    def __getitem__(self, idx):
+        return self._parameters[str(idx)]
+
+    def __len__(self):
+        return len(self._parameters)
+
+    def __iter__(self):
+        return iter(self._parameters.values())
+
+    def append(self, parameter):
+        self.add_parameter(str(len(self._parameters)), parameter)
+        return self

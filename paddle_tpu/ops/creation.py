@@ -1,0 +1,223 @@
+"""Tensor creation ops.
+
+Reference parity: python/paddle/tensor/creation.py.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.dtype import convert_dtype, get_default_dtype
+from ..tensor import Tensor, to_tensor
+from .dispatch import dispatch, ensure_tensor, register_op
+
+
+def _dt(dtype, default_float=True):
+    d = convert_dtype(dtype)
+    if d is None:
+        return get_default_dtype() if default_float else None
+    return d
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.tolist())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s._data) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    d = convert_dtype(dtype)
+    if d is None:
+        if isinstance(fill_value, bool):
+            d = np.dtype("bool")
+        elif isinstance(fill_value, int):
+            d = get_default_dtype()  # paddle.full defaults to float
+        else:
+            d = get_default_dtype()
+    return Tensor(jnp.full(_shape(shape), fill_value, d))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return dispatch("zeros_like", lambda a: jnp.zeros_like(a, dtype=convert_dtype(dtype)),
+                    ensure_tensor(x))
+
+
+def ones_like(x, dtype=None, name=None):
+    return dispatch("ones_like", lambda a: jnp.ones_like(a, dtype=convert_dtype(dtype)),
+                    ensure_tensor(x))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return dispatch("full_like",
+                    lambda a: jnp.full_like(a, fill_value, dtype=convert_dtype(dtype)),
+                    ensure_tensor(x))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def val(v):
+        return v.item() if isinstance(v, Tensor) else v
+    start, end, step = val(start), val(end), val(step)
+    if end is None:
+        start, end = 0, start
+    d = convert_dtype(dtype)
+    if d is None:
+        if all(isinstance(v, (int, np.integer)) for v in (start, end, step)):
+            d = np.dtype("int64")
+        else:
+            d = get_default_dtype()
+    return Tensor(jnp.arange(start, end, step, dtype=d))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    def val(v):
+        return v.item() if isinstance(v, Tensor) else v
+    return Tensor(jnp.linspace(val(start), val(stop), int(val(num)),
+                               dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    def val(v):
+        return v.item() if isinstance(v, Tensor) else v
+    return Tensor(jnp.logspace(val(start), val(stop), int(val(num)), base=val(base),
+                               dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(int(num_rows),
+                          int(num_columns) if num_columns is not None else None,
+                          dtype=_dt(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    def fwd(a):
+        if a.ndim == 1 and padding_value != 0:
+            n = a.shape[0] + abs(offset)
+            base = jnp.full((n, n), padding_value, a.dtype)
+            return base + jnp.diag(a, k=offset) - jnp.diag(
+                jnp.full((a.shape[0],), padding_value, a.dtype), k=offset)
+        return jnp.diag(a, k=offset)
+    return dispatch("diag", fwd, ensure_tensor(x))
+
+
+def diagflat(x, offset=0, name=None):
+    return dispatch("diagflat", lambda a: jnp.diagflat(a, k=offset), ensure_tensor(x))
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    def fwd(a):
+        iota = jnp.arange(a.shape[-1])
+        r = iota + max(-offset, 0)
+        c = iota + max(offset, 0)
+        n = a.shape[-1] + abs(offset)
+        full = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+        full = full.at[..., r, c].set(a)
+        # Move the two new axes to dim1/dim2.
+        nd = full.ndim
+        d1, d2 = dim1 % nd, dim2 % nd
+        rest = [i for i in range(nd - 2)]
+        order = []
+        for i in range(nd):
+            if i == d1:
+                order.append(nd - 2)
+            elif i == d2:
+                order.append(nd - 1)
+            else:
+                order.append(rest.pop(0))
+        return jnp.transpose(full, order)
+    return dispatch("diag_embed", fwd, ensure_tensor(input))
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    tensors = [ensure_tensor(a) for a in args]
+    return dispatch("meshgrid", lambda *arrays: tuple(jnp.meshgrid(*arrays, indexing="ij")),
+                    *tensors)
+
+
+def tril(x, diagonal=0, name=None):
+    return dispatch("tril", lambda a: jnp.tril(a, k=diagonal), ensure_tensor(x))
+
+
+def triu(x, diagonal=0, name=None):
+    return dispatch("triu", lambda a: jnp.triu(a, k=diagonal), ensure_tensor(x))
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.stack([jnp.asarray(r), jnp.asarray(c)]).astype(convert_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    r, c = np.triu_indices(row, offset, col if col is not None else row)
+    return Tensor(jnp.stack([jnp.asarray(r), jnp.asarray(c)]).astype(convert_dtype(dtype)))
+
+
+def assign(x, output=None):
+    src = ensure_tensor(x) if not isinstance(x, (list, tuple, np.ndarray, float, int)) \
+        else to_tensor(x)
+    out = dispatch("assign", lambda a: a + 0, src)
+    if output is not None:
+        output._assign_from(out)
+        return output
+    return out
+
+
+def clone(x, name=None):
+    return dispatch("clone", lambda a: a + 0, ensure_tensor(x))
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(int(np.prod(x.shape)) if x.shape else 1, jnp.int64))
+
+
+def rank(x):
+    return Tensor(jnp.asarray(ensure_tensor(x).ndim, jnp.int32))
+
+
+def shape(x):
+    return Tensor(jnp.asarray(ensure_tensor(x)._data.shape, jnp.int32))
+
+
+def complex(real, imag, name=None):
+    return dispatch("complex", lax_complex, ensure_tensor(real), ensure_tensor(imag))
+
+
+def lax_complex(r, i):
+    return r + 1j * i
+
+
+def polar(abs, angle, name=None):
+    return dispatch("polar", lambda r, t: r * jnp.exp(1j * t),
+                    ensure_tensor(abs), ensure_tensor(angle))
+
+
+def cast(x, dtype):
+    d = convert_dtype(dtype)
+    return dispatch("cast", lambda a: a.astype(d), ensure_tensor(x))
+
+
+for _n in ("zeros_like", "ones_like", "full_like", "cast", "clone", "tril", "triu",
+           "diag", "diagflat", "diag_embed", "numel", "rank"):
+    register_op(_n, globals()[_n])
+register_op("assign", assign, method=False)

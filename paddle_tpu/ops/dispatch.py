@@ -1,0 +1,153 @@
+"""Op dispatch: eager forward + vjp tape recording.
+
+Reference parity: the generated `*_ad_func` forward path (paddle/fluid/eager/
+auto_code_generator/generator/eager_gen.py:367) + phi kernel dispatch
+(paddle/phi/api/lib/kernel_dispatch.h:216). TPU-native design: the "kernel" is a
+jnp/lax/pallas callable executed by XLA; autograd capture is jax.vjp over exactly
+the differentiable tensor inputs. Under jax tracing (jit/pjit/shard_map) the same
+code path simply stages into the surrounding computation — this is what lets
+`jit.to_static` trace eager model code into one compiled program.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd.tape import Node, is_grad_enabled
+from ..framework import flags
+from ..tensor import Tensor, _OPS
+
+_diff_dtype_cache = {}
+
+
+def _is_diff_dtype(dtype) -> bool:
+    """True for float/complex dtypes incl. bfloat16 (numpy kind 'V')."""
+    r = _diff_dtype_cache.get(dtype)
+    if r is None:
+        r = bool(jnp.issubdtype(dtype, jnp.inexact))
+        _diff_dtype_cache[dtype] = r
+    return r
+
+_amp = None  # lazily bound paddle_tpu.amp module (avoids import cycle)
+
+
+def _amp_cast(name, arrays):
+    global _amp
+    if _amp is None:
+        from .. import amp as _amp_mod
+        _amp = _amp_mod
+    if not _amp.amp_state.enabled:
+        return arrays
+    return _amp._maybe_cast(name, arrays)
+
+
+def _is_diff(t: Tensor) -> bool:
+    return (not t.stop_gradient) and _is_diff_dtype(t._data.dtype)
+
+
+def _wrap_outputs(out, node, stop_gradient):
+    if isinstance(out, (tuple, list)):
+        tensors = []
+        for i, a in enumerate(out):
+            t = Tensor(a, stop_gradient=stop_gradient)
+            if node is not None:
+                t._node = node
+                t._out_index = i
+            tensors.append(t)
+        return tuple(tensors)
+    t = Tensor(out, stop_gradient=stop_gradient)
+    if node is not None:
+        t._node = node
+    return t
+
+
+def _check_numerics(name, out):
+    arrays = out if isinstance(out, (tuple, list)) else (out,)
+    for a in arrays:
+        if hasattr(a, "dtype") and a.dtype.kind == "f":
+            if not bool(jnp.isfinite(a).all()):
+                msg = f"NaN/Inf detected in output of op '{name}'"
+                if flags.flag("check_nan_inf_level") > 0:
+                    print("WARNING:", msg)
+                else:
+                    raise FloatingPointError(msg)
+
+
+def dispatch(name: str, fwd, *tensor_inputs: Tensor):
+    """Run `fwd` over the arrays of `tensor_inputs`, recording a vjp node if needed.
+
+    `fwd` takes jax arrays positionally (statics closed over) and returns one
+    array or a tuple of arrays.
+    """
+    arrays = _amp_cast(name, tuple(t._data for t in tensor_inputs))
+    record = is_grad_enabled() and any(_is_diff(t) for t in tensor_inputs)
+
+    if not record:
+        out = fwd(*arrays)
+        if flags.flag("check_nan_inf"):
+            _check_numerics(name, out)
+        return _wrap_outputs(out, None, stop_gradient=True)
+
+    diff_idx = [i for i, t in enumerate(tensor_inputs) if _is_diff(t)]
+    if len(diff_idx) == len(tensor_inputs):
+        out, vjp_fn = jax.vjp(fwd, *arrays)
+        node_inputs: Sequence[Tensor] = tensor_inputs
+    else:
+        const = list(arrays)
+
+        def partial_fwd(*diff_arrays):
+            full = list(const)
+            for i, a in zip(diff_idx, diff_arrays):
+                full[i] = a
+            return fwd(*full)
+
+        out, vjp_fn = jax.vjp(partial_fwd, *(arrays[i] for i in diff_idx))
+        node_inputs = [tensor_inputs[i] for i in diff_idx]
+
+    if flags.flag("check_nan_inf"):
+        _check_numerics(name, out)
+
+    if isinstance(out, (tuple, list)):
+        specs = [(tuple(a.shape), a.dtype) for a in out]
+    else:
+        specs = [(tuple(out.shape), out.dtype)]
+    node = Node(name, vjp_fn, node_inputs, specs)
+    return _wrap_outputs(out, node, stop_gradient=False)
+
+
+def ensure_tensor(x, dtype=None) -> Tensor:
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(jnp.asarray(x, dtype=dtype))
+
+
+_METHODS = {}
+
+
+def register_op(name: str, fn, method: bool = True, method_name: str = None):
+    """Register `fn` in the global op table (drives Tensor dunders + methods)."""
+    _OPS[name] = fn
+    if method:
+        _METHODS[method_name or name] = fn
+    return fn
+
+
+def attach_methods():
+    """Attach registered ops as Tensor methods (parity: monkey-patched Tensor API)."""
+    skip = {"shape", "dtype", "ndim", "size", "place", "grad", "name",
+            "stop_gradient", "T", "mT"}
+    for name, fn in _METHODS.items():
+        if name in skip or hasattr(Tensor, name):
+            continue
+        setattr(Tensor, name, fn)
+
+
+def make_inplace(fn, name=None):
+    """Build an in-place variant `x.op_()` rebinding x's storage + tape link."""
+    def inplace(x, *args, **kwargs):
+        out = fn(x, *args, **kwargs)
+        return x._assign_from(out)
+    inplace.__name__ = name or (getattr(fn, "__name__", "op") + "_")
+    return inplace

@@ -1,0 +1,300 @@
+"""Linear algebra ops.
+
+Reference parity: python/paddle/tensor/linalg.py (matmul at linalg.py:220) and
+paddle.linalg.* . TPU-native: matmul & friends lower straight to XLA dot_general
+(MXU); decompositions use jax.numpy.linalg / lax.linalg (QR/SVD/Cholesky run via
+XLA's native TPU implementations).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor import Tensor
+from .dispatch import dispatch, ensure_tensor, register_op
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def fwd(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+    return dispatch("matmul", fwd, ensure_tensor(x), ensure_tensor(y))
+
+
+def mm(input, mat2, name=None):
+    return matmul(input, mat2)
+
+
+def bmm(x, y, name=None):
+    return dispatch("bmm", jnp.matmul, ensure_tensor(x), ensure_tensor(y))
+
+
+def mv(x, vec, name=None):
+    return dispatch("mv", jnp.matmul, ensure_tensor(x), ensure_tensor(vec))
+
+
+def dot(x, y, name=None):
+    def fwd(a, b):
+        return jnp.sum(a * b, axis=-1)
+    return dispatch("dot", fwd, ensure_tensor(x), ensure_tensor(y))
+
+
+def cross(x, y, axis=9, name=None):
+    def fwd(a, b):
+        ax = axis
+        if ax == 9:  # paddle default: first axis with dim 3
+            ax = next(i for i, d in enumerate(a.shape) if d == 3)
+        return jnp.cross(a, b, axis=ax)
+    return dispatch("cross", fwd, ensure_tensor(x), ensure_tensor(y))
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    def fwd(a):
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        if p is None:
+            ord_ = None if ax is None or isinstance(ax, int) else "fro"
+            if ax is None:
+                return jnp.linalg.norm(a.reshape(-1), ord=2, keepdims=False)
+            return jnp.linalg.norm(a, ord=ord_, axis=ax, keepdims=keepdim)
+        if p in ("fro", "nuc"):
+            return jnp.linalg.norm(a, ord=p, axis=ax, keepdims=keepdim)
+        if ax is None:
+            a = a.reshape(-1)
+            ax = 0
+        if p == np.inf or p == float("inf"):
+            return jnp.max(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p == -np.inf or p == float("-inf"):
+            return jnp.min(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=ax, keepdims=keepdim)
+        return jnp.sum(jnp.abs(a) ** p, axis=ax, keepdims=keepdim) ** (1.0 / p)
+    return dispatch("norm", fwd, ensure_tensor(x))
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    return norm(x, p=p, axis=axis, keepdim=keepdim)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    return dispatch("matrix_norm",
+                    lambda a: jnp.linalg.norm(a, ord=p, axis=tuple(axis),
+                                              keepdims=keepdim),
+                    ensure_tensor(x))
+
+
+def dist(x, y, p=2.0, name=None):
+    def fwd(a, b):
+        d = (a - b).reshape(-1)
+        if p == 0:
+            return jnp.sum((d != 0).astype(a.dtype))
+        if p == float("inf"):
+            return jnp.max(jnp.abs(d))
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(d))
+        return jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
+    return dispatch("dist", fwd, ensure_tensor(x), ensure_tensor(y))
+
+
+def cholesky(x, upper=False, name=None):
+    def fwd(a):
+        l = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(l, -1, -2).conj() if upper else l
+    return dispatch("cholesky", fwd, ensure_tensor(x))
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def fwd(b, l):
+        return jax.scipy.linalg.cho_solve((l, not upper), b)
+    return dispatch("cholesky_solve", fwd, ensure_tensor(x), ensure_tensor(y))
+
+
+def qr(x, mode="reduced", name=None):
+    out = dispatch("qr", lambda a: tuple(jnp.linalg.qr(a, mode=mode)),
+                   ensure_tensor(x)) if mode != "r" else None
+    if mode == "r":
+        return dispatch("qr", lambda a: jnp.linalg.qr(a, mode="r"), ensure_tensor(x))
+    return out
+
+
+def svd(x, full_matrices=False, name=None):
+    return dispatch("svd",
+                    lambda a: tuple(jnp.linalg.svd(a, full_matrices=full_matrices)),
+                    ensure_tensor(x))
+
+
+def svdvals(x, name=None):
+    return dispatch("svdvals", lambda a: jnp.linalg.svd(a, compute_uv=False),
+                    ensure_tensor(x))
+
+
+def eig(x, name=None):
+    xt = ensure_tensor(x)
+    # TPU/XLA nonsymmetric eig runs on host (same as reference's CPU-only eig kernel).
+    w, v = np.linalg.eig(np.asarray(xt._data))
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def eigvals(x, name=None):
+    xt = ensure_tensor(x)
+    return Tensor(jnp.asarray(np.linalg.eigvals(np.asarray(xt._data))))
+
+
+def eigh(x, UPLO="L", name=None):
+    return dispatch("eigh", lambda a: tuple(jnp.linalg.eigh(a, UPLO=UPLO)),
+                    ensure_tensor(x))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return dispatch("eigvalsh", lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO),
+                    ensure_tensor(x))
+
+
+def inv(x, name=None):
+    return dispatch("inv", jnp.linalg.inv, ensure_tensor(x))
+
+
+inverse = inv
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return dispatch("pinv",
+                    lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian),
+                    ensure_tensor(x))
+
+
+def det(x, name=None):
+    return dispatch("det", jnp.linalg.det, ensure_tensor(x))
+
+
+def slogdet(x, name=None):
+    def fwd(a):
+        sign, logdet = jnp.linalg.slogdet(a)
+        return jnp.stack([sign, logdet])
+    return dispatch("slogdet", fwd, ensure_tensor(x))
+
+
+def solve(x, y, name=None):
+    def fwd(a, b):
+        if b.ndim == a.ndim - 1:
+            return jnp.linalg.solve(a, b[..., None])[..., 0]
+        return jnp.linalg.solve(a, b)
+    return dispatch("solve", fwd, ensure_tensor(x), ensure_tensor(y))
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    def fwd(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+    return dispatch("triangular_solve", fwd, ensure_tensor(x), ensure_tensor(y))
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    xt, yt = ensure_tensor(x), ensure_tensor(y)
+    sol, res, rank_, sv = jnp.linalg.lstsq(xt._data, yt._data, rcond=rcond)
+    return (Tensor(sol), Tensor(res), Tensor(jnp.asarray(rank_)), Tensor(sv))
+
+
+def matrix_power(x, n, name=None):
+    return dispatch("matrix_power", lambda a: jnp.linalg.matrix_power(a, int(n)),
+                    ensure_tensor(x))
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    xt = ensure_tensor(x)
+    return Tensor(jnp.linalg.matrix_rank(xt._data, rtol=tol))
+
+
+def multi_dot(x, name=None):
+    tensors = [ensure_tensor(t) for t in x]
+    return dispatch("multi_dot", lambda *arrays: jnp.linalg.multi_dot(arrays),
+                    *tensors)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    def fwd(a):
+        return jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0)
+    return dispatch("cov", fwd, ensure_tensor(x))
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return dispatch("corrcoef", lambda a: jnp.corrcoef(a, rowvar=rowvar),
+                    ensure_tensor(x))
+
+
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False, name=None):
+    xt = ensure_tensor(input)
+    a = np.asarray(xt._data)
+    lo, hi = (min, max) if (min != 0 or max != 0) else (a.min(), a.max())
+    w = np.asarray(weight._data) if isinstance(weight, Tensor) else weight
+    hist, _ = np.histogram(a, bins=int(bins), range=(float(lo), float(hi)),
+                           weights=w, density=density)
+    return Tensor(jnp.asarray(hist if density or w is not None else
+                              hist.astype(np.int64)))
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
+    xt = ensure_tensor(x)
+    w = np.asarray(weights._data) if isinstance(weights, Tensor) else weights
+    hist, edges = np.histogramdd(np.asarray(xt._data), bins=bins, range=ranges,
+                                 density=density, weights=w)
+    return Tensor(jnp.asarray(hist)), [Tensor(jnp.asarray(e)) for e in edges]
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    xt = ensure_tensor(x)
+    a = np.asarray(xt._data)
+    w = np.asarray(weights._data) if isinstance(weights, Tensor) else weights
+    return Tensor(jnp.asarray(np.bincount(a, weights=w, minlength=int(minlength))))
+
+
+def einsum(equation, *operands):
+    if len(operands) == 1 and isinstance(operands[0], (list, tuple)):
+        operands = tuple(operands[0])
+    tensors = [ensure_tensor(t) for t in operands]
+    return dispatch("einsum", lambda *arrays: jnp.einsum(equation, *arrays),
+                    *tensors)
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    xt = ensure_tensor(x)
+    lu_arr, piv = jax.scipy.linalg.lu_factor(xt._data)
+    info = Tensor(jnp.zeros(xt._data.shape[:-2], jnp.int32))
+    if get_infos:
+        return Tensor(lu_arr), Tensor(piv.astype(jnp.int32) + 1), info
+    return Tensor(lu_arr), Tensor(piv.astype(jnp.int32) + 1)
+
+
+def cond(x, p=None, name=None):
+    def fwd(a):
+        return jnp.linalg.cond(a, p=p)
+    return dispatch("cond", fwd, ensure_tensor(x))
+
+
+def householder_product(x, tau, name=None):
+    def fwd(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        q = jnp.eye(m, dtype=a.dtype)
+        q = jnp.broadcast_to(q, a.shape[:-2] + (m, m)).copy() if a.ndim > 2 else q
+        for i in range(t.shape[-1]):
+            v = jnp.where(jnp.arange(m) < i, 0.0, a[..., :, i])
+            v = v.at[..., i].set(1.0)
+            ti = t[..., i]
+            outer = v[..., :, None] * v[..., None, :]
+            q = q - ti[..., None, None] * (q @ outer)
+        return q[..., :, :n]
+    return dispatch("householder_product", fwd, ensure_tensor(x), ensure_tensor(tau))
+
+
+for _n in ("matmul", "mm", "bmm", "mv", "dot", "cross", "norm", "dist",
+           "cholesky", "cholesky_solve", "qr", "svd", "eig", "eigvals", "eigh",
+           "eigvalsh", "inv", "inverse", "pinv", "det", "slogdet", "solve",
+           "triangular_solve", "lstsq", "matrix_power", "matrix_rank",
+           "multi_dot", "cov", "corrcoef", "histogram", "bincount"):
+    register_op(_n, globals()[_n])
+register_op("einsum", einsum, method=False)

@@ -1,0 +1,99 @@
+"""Comparison / logical / bitwise ops.
+
+Reference parity: python/paddle/tensor/logic.py.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from .dispatch import dispatch, ensure_tensor, register_op
+
+
+def _cmp_factory(name, jfn):
+    def op(x, y, name=None):
+        xt, yt = isinstance(x, Tensor), isinstance(y, Tensor)
+        if xt and yt:
+            return dispatch(op.__name__, jfn, x, y)
+        if xt:
+            return dispatch(op.__name__, lambda a: jfn(a, y), x)
+        return dispatch(op.__name__, lambda b: jfn(x, b), ensure_tensor(y))
+    op.__name__ = name
+    return op
+
+
+_BINOPS = {
+    "equal": jnp.equal, "not_equal": jnp.not_equal,
+    "less_than": jnp.less, "less_equal": jnp.less_equal,
+    "greater_than": jnp.greater, "greater_equal": jnp.greater_equal,
+    "logical_and": jnp.logical_and, "logical_or": jnp.logical_or,
+    "logical_xor": jnp.logical_xor,
+    "bitwise_and": jnp.bitwise_and, "bitwise_or": jnp.bitwise_or,
+    "bitwise_xor": jnp.bitwise_xor,
+    "bitwise_left_shift": jnp.left_shift, "bitwise_right_shift": jnp.right_shift,
+}
+
+_g = globals()
+for _name, _fn in _BINOPS.items():
+    _g[_name] = register_op(_name, _cmp_factory(_name, _fn))
+
+
+def logical_not(x, name=None):
+    return dispatch("logical_not", jnp.logical_not, ensure_tensor(x))
+
+
+def bitwise_not(x, name=None):
+    return dispatch("bitwise_not", jnp.bitwise_not, ensure_tensor(x))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return dispatch("isclose",
+                    lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol,
+                                             equal_nan=equal_nan),
+                    ensure_tensor(x), ensure_tensor(y))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return dispatch("allclose",
+                    lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol,
+                                              equal_nan=equal_nan),
+                    ensure_tensor(x), ensure_tensor(y))
+
+
+def equal_all(x, y, name=None):
+    return dispatch("equal_all", lambda a, b: jnp.array_equal(a, b),
+                    ensure_tensor(x), ensure_tensor(y))
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(ensure_tensor(x)._data.size == 0))
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return dispatch("any", lambda a: jnp.any(a, axis=ax, keepdims=keepdim),
+                    ensure_tensor(x))
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return dispatch("all", lambda a: jnp.all(a, axis=ax, keepdims=keepdim),
+                    ensure_tensor(x))
+
+
+def is_complex(x):
+    return ensure_tensor(x)._data.dtype.kind == "c"
+
+
+def is_floating_point(x):
+    return ensure_tensor(x)._data.dtype.kind == "f"
+
+
+def is_integer(x):
+    return ensure_tensor(x)._data.dtype.kind in "iu"
+
+
+for _n in ("logical_not", "bitwise_not", "isclose", "allclose", "equal_all",
+           "is_empty", "any", "all", "is_complex", "is_floating_point",
+           "is_integer"):
+    register_op(_n, _g[_n])

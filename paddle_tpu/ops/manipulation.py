@@ -1,0 +1,615 @@
+"""Shape/layout manipulation ops + indexing.
+
+Reference parity: python/paddle/tensor/manipulation.py and the getitem/setitem
+paths (paddle/fluid/pybind/eager_method.cc, slice/set_value kernels). TPU-native:
+everything is functional; `setitem` lowers to `x.at[idx].set(v)` and in-place
+Python semantics are recovered by rebinding the Tensor's storage + tape link.
+"""
+from __future__ import annotations
+
+import builtins
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.dtype import convert_dtype
+from ..tensor import Tensor
+from .dispatch import dispatch, ensure_tensor, register_op, make_inplace
+
+
+def _axes(axis):
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _static_shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(v) for v in shape.tolist())
+    return tuple(int(v._data) if isinstance(v, Tensor) else int(v) for v in shape)
+
+
+def reshape(x, shape, name=None):
+    s = _static_shape(shape)
+    return dispatch("reshape", lambda a: jnp.reshape(a, s), ensure_tensor(x))
+
+
+def reshape_(x, shape, name=None):
+    return x._assign_from(reshape(x, shape))
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def fwd(a):
+        nd = a.ndim
+        s0 = start_axis % nd if nd else 0
+        s1 = stop_axis % nd if nd else 0
+        new_shape = a.shape[:s0] + (-1,) + a.shape[s1 + 1:]
+        return jnp.reshape(a, new_shape)
+    return dispatch("flatten", fwd, ensure_tensor(x))
+
+
+flatten_ = make_inplace(flatten, "flatten_")
+
+
+def squeeze(x, axis=None, name=None):
+    def fwd(a):
+        if axis is None:
+            return jnp.squeeze(a)
+        ax = _axes(axis)
+        if isinstance(ax, int):
+            ax = (ax,)
+        ax = tuple(a_ % a.ndim for a_ in ax if a.shape[a_ % a.ndim] == 1)
+        return jnp.squeeze(a, axis=ax) if ax else a
+    return dispatch("squeeze", fwd, ensure_tensor(x))
+
+
+squeeze_ = make_inplace(squeeze, "squeeze_")
+
+
+def unsqueeze(x, axis, name=None):
+    ax = _axes(axis)
+    return dispatch("unsqueeze", lambda a: jnp.expand_dims(a, ax), ensure_tensor(x))
+
+
+unsqueeze_ = make_inplace(unsqueeze, "unsqueeze_")
+
+
+def transpose(x, perm, name=None):
+    p = _axes(perm)
+    return dispatch("transpose", lambda a: jnp.transpose(a, p), ensure_tensor(x))
+
+
+def t(x, name=None):
+    def fwd(a):
+        if a.ndim < 2:
+            return a
+        if a.ndim == 2:
+            return a.T
+        raise ValueError("paddle.t only supports tensors with ndim <= 2; "
+                         "use transpose for higher-rank")
+    return dispatch("t", fwd, ensure_tensor(x))
+
+
+def matrix_transpose(x, name=None):
+    return dispatch("matrix_transpose", lambda a: jnp.swapaxes(a, -1, -2),
+                    ensure_tensor(x))
+
+
+def moveaxis(x, source, destination, name=None):
+    return dispatch("moveaxis", lambda a: jnp.moveaxis(a, source, destination),
+                    ensure_tensor(x))
+
+
+def roll(x, shifts, axis=None, name=None):
+    return dispatch("roll", lambda a: jnp.roll(a, shifts, axis=axis), ensure_tensor(x))
+
+
+def flip(x, axis, name=None):
+    ax = _axes(axis)
+    return dispatch("flip", lambda a: jnp.flip(a, axis=ax), ensure_tensor(x))
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return dispatch("rot90", lambda a: jnp.rot90(a, k=k, axes=tuple(axes)),
+                    ensure_tensor(x))
+
+
+def concat(x, axis=0, name=None):
+    tensors = [ensure_tensor(t) for t in x]
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    return dispatch("concat", lambda *arrays: jnp.concatenate(arrays, axis=ax),
+                    *tensors)
+
+
+def stack(x, axis=0, name=None):
+    tensors = [ensure_tensor(t) for t in x]
+    return dispatch("stack", lambda *arrays: jnp.stack(arrays, axis=int(axis)),
+                    *tensors)
+
+
+def hstack(x, name=None):
+    tensors = [ensure_tensor(t) for t in x]
+    return dispatch("hstack", lambda *arrays: jnp.hstack(arrays), *tensors)
+
+
+def vstack(x, name=None):
+    tensors = [ensure_tensor(t) for t in x]
+    return dispatch("vstack", lambda *arrays: jnp.vstack(arrays), *tensors)
+
+
+def dstack(x, name=None):
+    tensors = [ensure_tensor(t) for t in x]
+    return dispatch("dstack", lambda *arrays: jnp.dstack(arrays), *tensors)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    xt = ensure_tensor(x)
+    dim = xt._data.shape[ax]
+    if isinstance(num_or_sections, int):
+        if dim % num_or_sections != 0:
+            raise ValueError(
+                f"split: dimension {ax} (size {dim}) is not divisible by "
+                f"num_or_sections={num_or_sections}; pass an explicit "
+                "sections list for uneven splits")
+        sections = [dim // num_or_sections] * num_or_sections
+    else:
+        sections = [int(s.item()) if isinstance(s, Tensor) else int(s)
+                    for s in num_or_sections]
+        n_unknown = builtins.sum(1 for s in sections if s < 0)
+        if n_unknown:
+            known = builtins.sum(s for s in sections if s >= 0)
+            sections = [s if s >= 0 else dim - known for s in sections]
+    bounds = np.cumsum(sections)[:-1].tolist()
+
+    def fwd(a):
+        return tuple(jnp.split(a, bounds, axis=ax))
+    out = dispatch("split", fwd, xt)
+    return list(out)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(input, axis=0, name=None):
+    xt = ensure_tensor(input)
+    n = xt._data.shape[int(axis)]
+
+    def fwd(a):
+        return tuple(jnp.squeeze(s, axis=int(axis))
+                     for s in jnp.split(a, n, axis=int(axis)))
+    return list(dispatch("unbind", fwd, xt))
+
+
+def unstack(x, axis=0, num=None, name=None):
+    return unbind(x, axis)
+
+
+def tile(x, repeat_times, name=None):
+    reps = _static_shape(repeat_times)
+    return dispatch("tile", lambda a: jnp.tile(a, reps), ensure_tensor(x))
+
+
+def expand(x, shape, name=None):
+    s = _static_shape(shape)
+
+    def fwd(a):
+        target = list(s)
+        # paddle allows -1 to keep original dim
+        off = len(target) - a.ndim
+        for i in range(len(target)):
+            if target[i] == -1:
+                target[i] = a.shape[i - off]
+        return jnp.broadcast_to(a, tuple(target))
+    return dispatch("expand", fwd, ensure_tensor(x))
+
+
+def expand_as(x, y, name=None):
+    target = tuple(ensure_tensor(y)._data.shape)
+    return dispatch("expand_as", lambda a: jnp.broadcast_to(a, target),
+                    ensure_tensor(x))
+
+
+def broadcast_to(x, shape, name=None):
+    s = _static_shape(shape)
+    return dispatch("broadcast_to", lambda a: jnp.broadcast_to(a, s), ensure_tensor(x))
+
+
+def broadcast_tensors(input, name=None):
+    tensors = [ensure_tensor(t) for t in input]
+    return list(dispatch("broadcast_tensors",
+                         lambda *arrays: tuple(jnp.broadcast_arrays(*arrays)),
+                         *tensors))
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def gather(x, index, axis=0, name=None):
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    idx = ensure_tensor(index)
+    return dispatch("gather", lambda a, i: jnp.take(a, i.reshape(-1), axis=ax),
+                    ensure_tensor(x), idx)
+
+
+def gather_nd(x, index, name=None):
+    def fwd(a, idx):
+        return a[tuple(jnp.moveaxis(idx, -1, 0))]
+    return dispatch("gather_nd", fwd, ensure_tensor(x), ensure_tensor(index))
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def fwd(a, i, u):
+        i = i.reshape(-1)
+        if overwrite:
+            return a.at[i].set(u)
+        z = a.at[i].set(jnp.zeros_like(u))
+        return z.at[i].add(u)
+    return dispatch("scatter", fwd, ensure_tensor(x), ensure_tensor(index),
+                    ensure_tensor(updates))
+
+
+scatter_ = make_inplace(scatter, "scatter_")
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def fwd(a, i, u):
+        return a.at[tuple(jnp.moveaxis(i, -1, 0))].add(u)
+    return dispatch("scatter_nd_add", fwd, ensure_tensor(x), ensure_tensor(index),
+                    ensure_tensor(updates))
+
+
+def scatter_nd(index, updates, shape, name=None):
+    s = _static_shape(shape)
+
+    def fwd(i, u):
+        return jnp.zeros(s, u.dtype).at[tuple(jnp.moveaxis(i, -1, 0))].add(u)
+    return dispatch("scatter_nd", fwd, ensure_tensor(index), ensure_tensor(updates))
+
+
+def slice(input, axes, starts, ends):
+    axes = [int(a) for a in axes]
+    starts = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in starts]
+    ends = [int(e.item()) if isinstance(e, Tensor) else int(e) for e in ends]
+
+    def fwd(a):
+        idx = [builtins.slice(None)] * a.ndim
+        for ax, st, en in zip(axes, starts, ends):
+            idx[ax] = builtins.slice(st, en)
+        return a[tuple(idx)]
+    return dispatch("slice", fwd, ensure_tensor(input))
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    def fwd(a):
+        idx = [builtins.slice(None)] * a.ndim
+        for ax, st, en, sd in zip(axes, starts, ends, strides):
+            idx[int(ax)] = builtins.slice(int(st), int(en), int(sd))
+        return a[tuple(idx)]
+    return dispatch("strided_slice", fwd, ensure_tensor(x))
+
+
+def index_select(x, index, axis=0, name=None):
+    return dispatch("index_select",
+                    lambda a, i: jnp.take(a, i.reshape(-1), axis=int(axis)),
+                    ensure_tensor(x), ensure_tensor(index))
+
+
+def index_sample(x, index):
+    def fwd(a, i):
+        rows = jnp.arange(a.shape[0])[:, None]
+        return a[rows, i]
+    return dispatch("index_sample", fwd, ensure_tensor(x), ensure_tensor(index))
+
+
+def index_add(x, index, axis, value, name=None):
+    def fwd(a, i, v):
+        moved = jnp.moveaxis(a, int(axis), 0)
+        out = moved.at[i.reshape(-1)].add(jnp.moveaxis(v, int(axis), 0))
+        return jnp.moveaxis(out, 0, int(axis))
+    return dispatch("index_add", fwd, ensure_tensor(x), ensure_tensor(index),
+                    ensure_tensor(value))
+
+
+index_add_ = make_inplace(index_add, "index_add_")
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    idx_tensors = [ensure_tensor(i) for i in indices]
+    n_idx = len(idx_tensors)
+
+    def fwd(a, v, *idx):
+        if accumulate:
+            return a.at[tuple(idx)].add(v)
+        return a.at[tuple(idx)].set(v)
+    del n_idx
+    return dispatch("index_put", fwd, ensure_tensor(x), ensure_tensor(value),
+                    *idx_tensors)
+
+
+index_put_ = make_inplace(index_put, "index_put_")
+
+
+def masked_select(x, mask, name=None):
+    xt, mt = ensure_tensor(x), ensure_tensor(mask)
+    # Data-dependent shape: must materialize (same as reference's masked_select).
+    a = np.asarray(xt._data)
+    m = np.asarray(mt._data)
+    m_b = np.broadcast_to(m, a.shape)
+    if not xt.stop_gradient:
+        flat_idx = np.nonzero(m_b.reshape(-1))[0]
+        return dispatch("masked_select",
+                        lambda arr: jnp.take(arr.reshape(-1), jnp.asarray(flat_idx)),
+                        xt)
+    return Tensor(jnp.asarray(a[m_b]))
+
+
+def masked_fill(x, mask, value, name=None):
+    v = value._data if isinstance(value, Tensor) else value
+    if isinstance(value, Tensor):
+        return dispatch("masked_fill",
+                        lambda a, m, val: jnp.where(m, val.astype(a.dtype), a),
+                        ensure_tensor(x), ensure_tensor(mask), value)
+    return dispatch("masked_fill", lambda a, m: jnp.where(m, jnp.asarray(v, a.dtype), a),
+                    ensure_tensor(x), ensure_tensor(mask))
+
+
+masked_fill_ = make_inplace(masked_fill, "masked_fill_")
+
+
+def masked_scatter(x, mask, value, name=None):
+    xt, mt, vt = ensure_tensor(x), ensure_tensor(mask), ensure_tensor(value)
+    m = np.asarray(mt._data)
+    m_b = np.broadcast_to(m, tuple(xt._data.shape))
+    flat_idx = np.nonzero(m_b.reshape(-1))[0]
+
+    def fwd(a, v):
+        flat = a.reshape(-1)
+        out = flat.at[jnp.asarray(flat_idx)].set(v.reshape(-1)[:len(flat_idx)])
+        return out.reshape(a.shape)
+    return dispatch("masked_scatter", fwd, xt, vt)
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    return dispatch("take_along_axis",
+                    lambda a, i: jnp.take_along_axis(a, i, axis=int(axis)),
+                    ensure_tensor(arr), ensure_tensor(indices))
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", include_self=True,
+                   broadcast=True, name=None):
+    def fwd(a, i, v):
+        v = jnp.broadcast_to(v, i.shape).astype(a.dtype)
+        if reduce == "assign":
+            return jnp.put_along_axis(a, i, v, axis=int(axis), inplace=False)
+        dims = [jnp.broadcast_to(
+            jnp.arange(i.shape[d]).reshape([-1 if k == d else 1 for k in range(i.ndim)]),
+            i.shape) for d in range(i.ndim)]
+        dims[int(axis) % a.ndim] = i
+        idx = tuple(dims)
+        if reduce in ("add", "sum"):
+            return a.at[idx].add(v)
+        if reduce in ("mul", "multiply"):
+            return a.at[idx].multiply(v)
+        if reduce == "amax":
+            return a.at[idx].max(v)
+        if reduce == "amin":
+            return a.at[idx].min(v)
+        raise ValueError(f"unknown reduce {reduce}")
+    return dispatch("put_along_axis", fwd, ensure_tensor(arr), ensure_tensor(indices),
+                    ensure_tensor(values))
+
+
+put_along_axis_ = make_inplace(put_along_axis, "put_along_axis_")
+
+
+def take(x, index, mode="raise", name=None):
+    def fwd(a, i):
+        flat = a.reshape(-1)
+        n = flat.shape[0]
+        if mode == "wrap":
+            i = jnp.mod(i, n)
+        elif mode == "clip":
+            i = jnp.clip(i, 0, n - 1)
+        else:
+            i = jnp.where(i < 0, i + n, i)
+        return jnp.take(flat, i)
+    return dispatch("take", fwd, ensure_tensor(x), ensure_tensor(index))
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        reps = np.asarray(repeats._data)
+        total = int(reps.sum())
+
+        def fwd(a, r):
+            return jnp.repeat(a, r, axis=axis if axis is None else int(axis),
+                              total_repeat_length=total)
+        return dispatch("repeat_interleave", fwd, ensure_tensor(x), repeats)
+    return dispatch("repeat_interleave",
+                    lambda a: jnp.repeat(a, int(repeats),
+                                         axis=axis if axis is None else int(axis)),
+                    ensure_tensor(x))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    xt = ensure_tensor(x)
+    a = np.asarray(xt._data)
+    res = np.unique(a, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    idt = convert_dtype(dtype)
+    if not (return_index or return_inverse or return_counts):
+        return Tensor(jnp.asarray(res))
+    out = [Tensor(jnp.asarray(res[0]))]
+    for extra in res[1:]:
+        out.append(Tensor(jnp.asarray(extra.astype(idt))))
+    return tuple(out)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    xt = ensure_tensor(x)
+    a = np.asarray(xt._data)
+    if axis is None:
+        a = a.reshape(-1)
+        ax = 0
+    else:
+        ax = int(axis)
+    take_idx = [0]
+    sl = np.moveaxis(a, ax, 0)
+    for i in range(1, sl.shape[0]):
+        if not np.array_equal(sl[i], sl[i - 1]):
+            take_idx.append(i)
+    uniq = np.take(a, take_idx, axis=ax)
+    outs = [Tensor(jnp.asarray(uniq))]
+    if return_inverse:
+        inv = np.zeros(sl.shape[0], dtype=np.int64)
+        j = -1
+        for i in range(sl.shape[0]):
+            if i in set(take_idx):
+                j += 1
+            inv[i] = j
+        outs.append(Tensor(jnp.asarray(inv)))
+    if return_counts:
+        bounds = take_idx + [sl.shape[0]]
+        counts = np.diff(bounds)
+        outs.append(Tensor(jnp.asarray(counts.astype(np.int64))))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def as_real(x, name=None):
+    def fwd(a):
+        return jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1)
+    return dispatch("as_real", fwd, ensure_tensor(x))
+
+
+def as_complex(x, name=None):
+    return dispatch("as_complex", lambda a: a[..., 0] + 1j * a[..., 1],
+                    ensure_tensor(x))
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    d = convert_dtype(shape_or_dtype)
+    return dispatch("view", lambda a: a.view(d), ensure_tensor(x))
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [dispatch("atleast_1d", jnp.atleast_1d, ensure_tensor(t)) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [dispatch("atleast_2d", jnp.atleast_2d, ensure_tensor(t)) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [dispatch("atleast_3d", jnp.atleast_3d, ensure_tensor(t)) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def tensordot(x, y, axes=2, name=None):
+    ax = axes
+    if isinstance(ax, Tensor):
+        ax = ax.tolist()
+    if isinstance(ax, (list, tuple)):
+        ax = tuple(tuple(int(v) for v in (a.tolist() if isinstance(a, Tensor) else a))
+                   if isinstance(a, (list, tuple, Tensor)) else int(a) for a in ax)
+    return dispatch("tensordot", lambda a, b: jnp.tensordot(a, b, axes=ax),
+                    ensure_tensor(x), ensure_tensor(y))
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    s = _static_shape(shape)
+    offs = [0] * len(s) if offsets is None else [
+        int(o.item()) if isinstance(o, Tensor) else int(o) for o in offsets]
+
+    def fwd(a):
+        idx = tuple(builtins.slice(o, o + (dim if dim != -1 else a.shape[i] - o))
+                    for i, (o, dim) in enumerate(zip(offs, s)))
+        return a[idx]
+    return dispatch("crop", fwd, ensure_tensor(x))
+
+
+def fill_(x, value):
+    xt = ensure_tensor(x)
+    xt._data = jnp.full_like(xt._data, value)
+    return xt
+
+
+def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
+    xt = ensure_tensor(x)
+    n = builtins.min(xt._data.shape[-2], xt._data.shape[-1])
+    i = jnp.arange(n - builtins.max(offset, 0) - builtins.max(-offset, 0))
+    xt._data = xt._data.at[..., i + builtins.max(-offset, 0),
+                           i + builtins.max(offset, 0)].set(value)
+    return xt
+
+
+# ---- indexing ---------------------------------------------------------------
+
+def _convert_index(idx):
+    """Convert Tensors inside an index expression to raw arrays."""
+    if isinstance(idx, Tensor):
+        if idx._data.dtype == jnp.bool_:
+            return np.asarray(idx._data)  # boolean mask -> host (dynamic shape)
+        return idx._data
+    if isinstance(idx, builtins.slice):
+        def v(s):
+            return int(s.item()) if isinstance(s, Tensor) else s
+        return builtins.slice(v(idx.start), v(idx.stop), v(idx.step))
+    if isinstance(idx, (list, np.ndarray)):
+        return np.asarray(idx)
+    if isinstance(idx, tuple):
+        return tuple(_convert_index(i) for i in idx)
+    return idx
+
+
+def getitem(x, idx):
+    converted = _convert_index(idx)
+    return dispatch("getitem", lambda a: a[converted], ensure_tensor(x))
+
+
+def setitem(x, idx, value):
+    converted = _convert_index(idx)
+    if isinstance(value, Tensor):
+        out = dispatch("setitem",
+                       lambda a, v: a.at[converted].set(v.astype(a.dtype)),
+                       x, value)
+    else:
+        val = np.asarray(value)
+        out = dispatch("setitem",
+                       lambda a: a.at[converted].set(jnp.asarray(val, a.dtype)),
+                       x)
+    return x._assign_from(out)
+
+
+for _n in ("reshape", "reshape_", "flatten", "flatten_", "squeeze", "squeeze_",
+           "unsqueeze", "unsqueeze_", "transpose", "t", "matrix_transpose",
+           "moveaxis", "roll", "flip", "rot90", "split", "chunk", "unbind",
+           "unstack", "tile", "expand", "expand_as", "broadcast_to", "gather",
+           "gather_nd", "scatter", "scatter_", "scatter_nd_add", "index_select",
+           "index_sample", "index_add", "index_add_", "index_put", "index_put_",
+           "masked_select", "masked_fill", "masked_fill_", "masked_scatter",
+           "take_along_axis", "put_along_axis", "put_along_axis_", "take",
+           "repeat_interleave", "unique", "unique_consecutive", "as_real",
+           "as_complex", "view", "view_as", "tensordot", "fill_",
+           "fill_diagonal_"):
+    register_op(_n, globals()[_n])
+register_op("getitem", getitem, method=False)
+register_op("setitem", setitem, method=False)
+register_op("concat", concat, method=False)
+register_op("stack", stack, method=False)
+register_op("slice", slice, method=False)
+register_op("strided_slice", strided_slice, method=False)

@@ -1,0 +1,344 @@
+"""Elementwise & reduction math ops.
+
+Reference parity: python/paddle/tensor/math.py (routing to _C_ops) and the
+corresponding phi kernels (paddle/phi/kernels/{cpu,gpu}/*_kernel.*). TPU-native:
+each op is a jnp/lax lambda dispatched through ops.dispatch (XLA fuses chains of
+these into single kernels; no hand-written elementwise CUDA needed).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..tensor import Tensor
+from .dispatch import dispatch, ensure_tensor, register_op, make_inplace
+
+
+def _unary_factory(name, jfn):
+    def op(x, name=None):
+        # `name` is a user label only (parity kwarg); never the dispatch key —
+        # AMP lists and NaN diagnostics key on the canonical op name.
+        return dispatch(op.__name__, jfn, ensure_tensor(x))
+    op.__name__ = name
+    return op
+
+
+def _binary_factory(name, jfn):
+    def op(x, y, name=None):
+        xt, yt = isinstance(x, Tensor), isinstance(y, Tensor)
+        if xt and yt:
+            return dispatch(op.__name__, jfn, x, y)
+        if xt:  # keep python scalars weakly-typed for jnp promotion parity
+            return dispatch(op.__name__, lambda a: jfn(a, y), x)
+        if yt:
+            return dispatch(op.__name__, lambda b: jfn(x, b), y)
+        return dispatch(op.__name__, jfn, ensure_tensor(x), ensure_tensor(y))
+    op.__name__ = name
+    return op
+
+
+_UNARY = {
+    "exp": jnp.exp, "expm1": jnp.expm1,
+    "log": jnp.log, "log2": jnp.log2, "log10": jnp.log10, "log1p": jnp.log1p,
+    "sqrt": jnp.sqrt, "rsqrt": lax.rsqrt, "square": jnp.square,
+    "abs": jnp.abs, "sign": jnp.sign, "neg": jnp.negative,
+    "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
+    "asin": jnp.arcsin, "acos": jnp.arccos, "atan": jnp.arctan,
+    "sinh": jnp.sinh, "cosh": jnp.cosh, "tanh": jnp.tanh,
+    "asinh": jnp.arcsinh, "acosh": jnp.arccosh, "atanh": jnp.arctanh,
+    "ceil": jnp.ceil, "floor": jnp.floor, "trunc": jnp.trunc,
+    "reciprocal": jnp.reciprocal,
+    "sigmoid": jax.nn.sigmoid,
+    "erf": jax.scipy.special.erf, "erfinv": jax.scipy.special.erfinv,
+    "lgamma": jax.scipy.special.gammaln, "digamma": jax.scipy.special.digamma,
+    "i0": lambda a: jax.scipy.special.i0(a), "i0e": lambda a: jax.scipy.special.i0e(a),
+    "i1": lambda a: jax.scipy.special.i1(a), "i1e": lambda a: jax.scipy.special.i1e(a),
+    "isnan": jnp.isnan, "isinf": jnp.isinf, "isfinite": jnp.isfinite,
+    "frac": lambda a: a - jnp.trunc(a),
+    "deg2rad": jnp.deg2rad, "rad2deg": jnp.rad2deg,
+    "angle": jnp.angle, "conj": jnp.conj, "real": jnp.real, "imag": jnp.imag,
+    "signbit": jnp.signbit,
+    "logit": jax.scipy.special.logit,
+    "exponential": jnp.exp,  # alias safety
+}
+
+_BINARY = {
+    "add": jnp.add, "subtract": jnp.subtract, "multiply": jnp.multiply,
+    "divide": jnp.divide, "floor_divide": jnp.floor_divide,
+    "remainder": jnp.remainder, "mod": jnp.remainder, "floor_mod": jnp.remainder,
+    "pow": jnp.power,
+    "maximum": jnp.maximum, "minimum": jnp.minimum,
+    "fmax": jnp.fmax, "fmin": jnp.fmin,
+    "atan2": jnp.arctan2, "hypot": jnp.hypot,
+    "heaviside": jnp.heaviside,
+    "logaddexp": jnp.logaddexp,
+    "gcd": jnp.gcd, "lcm": jnp.lcm,
+    "ldexp": jnp.ldexp,
+    "copysign": jnp.copysign,
+    "nextafter": jnp.nextafter,
+    "rsub": lambda a, b: jnp.subtract(b, a),
+    "rdiv": lambda a, b: jnp.divide(b, a),
+    "rpow": lambda a, b: jnp.power(b, a),
+    "inner": jnp.inner, "outer": jnp.outer, "kron": jnp.kron,
+}
+
+_g = globals()
+for _name, _fn in _UNARY.items():
+    _g[_name] = register_op(_name, _unary_factory(_name, _fn))
+for _name, _fn in _BINARY.items():
+    _g[_name] = register_op(_name, _binary_factory(_name, _fn),
+                            method=_name not in ("rsub", "rdiv", "rpow"))
+
+tanh_ = register_op("tanh_", make_inplace(_g["tanh"]))
+sqrt_ = register_op("sqrt_", make_inplace(_g["sqrt"]))
+rsqrt_ = register_op("rsqrt_", make_inplace(_g["rsqrt"]))
+exp_ = register_op("exp_", make_inplace(_g["exp"]))
+reciprocal_ = register_op("reciprocal_", make_inplace(_g["reciprocal"]))
+ceil_ = register_op("ceil_", make_inplace(_g["ceil"]))
+floor_ = register_op("floor_", make_inplace(_g["floor"]))
+add_ = register_op("add_", make_inplace(_g["add"]))
+subtract_ = register_op("subtract_", make_inplace(_g["subtract"]))
+multiply_ = register_op("multiply_", make_inplace(_g["multiply"]))
+divide_ = register_op("divide_", make_inplace(_g["divide"]))
+remainder_ = register_op("remainder_", make_inplace(_g["remainder"]))
+
+
+def round(x, decimals=0, name=None):
+    return dispatch("round", lambda a: jnp.round(a, decimals), ensure_tensor(x))
+
+
+register_op("round", round)
+round_ = register_op("round_", make_inplace(round))
+
+
+def clip(x, min=None, max=None, name=None):
+    lo = min._data if isinstance(min, Tensor) else min
+    hi = max._data if isinstance(max, Tensor) else max
+    return dispatch("clip", lambda a: jnp.clip(a, lo, hi), ensure_tensor(x))
+
+
+register_op("clip", clip)
+clip_ = register_op("clip_", make_inplace(clip))
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s = scale._data if isinstance(scale, Tensor) else scale
+
+    def fwd(a):
+        out = a * s + bias if bias_after_scale else (a + bias) * s
+        return out.astype(a.dtype)
+    out = dispatch("scale", fwd, ensure_tensor(x))
+    if act is not None:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+register_op("scale", scale)
+scale_ = register_op("scale_", make_inplace(scale))
+
+
+def increment(x, value=1.0, name=None):
+    out = dispatch("increment", lambda a: a + jnp.asarray(value, a.dtype),
+                   ensure_tensor(x))
+    return x._assign_from(out)
+
+
+register_op("increment", increment)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return dispatch("stanh", lambda a: scale_b * jnp.tanh(scale_a * a),
+                    ensure_tensor(x))
+
+
+register_op("stanh", stanh)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return dispatch("nan_to_num",
+                    lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf),
+                    ensure_tensor(x))
+
+
+register_op("nan_to_num", nan_to_num)
+
+
+def multiply_no_nan(x, y, name=None):
+    def fwd(a, b):
+        return jnp.where(b == 0, jnp.zeros_like(a), a * b)
+    return dispatch("multiply_no_nan", fwd, ensure_tensor(x), ensure_tensor(y))
+
+
+# ---- reductions -------------------------------------------------------------
+
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    from ..framework.dtype import convert_dtype
+    dt = convert_dtype(dtype)
+    ax = _norm_axis(axis)
+
+    def fwd(a):
+        if dt is None and a.dtype.kind == "b":
+            return jnp.sum(a, axis=ax, keepdims=keepdim, dtype=jnp.int64)
+        return jnp.sum(a, axis=ax, keepdims=keepdim, dtype=dt)
+    return dispatch("sum", fwd, ensure_tensor(x))
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    from ..framework.dtype import convert_dtype
+    dt = convert_dtype(dtype)
+    ax = _norm_axis(axis)
+    return dispatch("prod", lambda a: jnp.prod(a, axis=ax, keepdims=keepdim, dtype=dt),
+                    ensure_tensor(x))
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return dispatch("max", lambda a: jnp.max(a, axis=ax, keepdims=keepdim),
+                    ensure_tensor(x))
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return dispatch("min", lambda a: jnp.min(a, axis=ax, keepdims=keepdim),
+                    ensure_tensor(x))
+
+
+def amax(x, axis=None, keepdim=False, name=None):
+    return max(x, axis, keepdim)
+
+
+def amin(x, axis=None, keepdim=False, name=None):
+    return min(x, axis, keepdim)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return dispatch("logsumexp",
+                    lambda a: jax.scipy.special.logsumexp(a, axis=ax, keepdims=keepdim),
+                    ensure_tensor(x))
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    from ..framework.dtype import convert_dtype
+    dt = convert_dtype(dtype)
+
+    def fwd(a):
+        if axis is None:
+            return jnp.cumsum(a.reshape(-1), dtype=dt)
+        return jnp.cumsum(a, axis=int(axis), dtype=dt)
+    return dispatch("cumsum", fwd, ensure_tensor(x))
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    from ..framework.dtype import convert_dtype
+    dt = convert_dtype(dtype)
+
+    def fwd(a):
+        if dim is None:
+            return jnp.cumprod(a.reshape(-1), dtype=dt)
+        return jnp.cumprod(a, axis=int(dim), dtype=dt)
+    return dispatch("cumprod", fwd, ensure_tensor(x))
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    ax = -1 if axis is None else int(axis)
+    xt = ensure_tensor(x)
+    a = xt._data.reshape(-1) if axis is None else xt._data
+    values = dispatch("cummax", lambda v: lax.cummax(v, axis=ax),
+                      Tensor(a) if axis is None else xt)
+    # Running argmax: positions where value equals the running max, cummax of iota.
+    iota = jnp.arange(a.shape[ax]).reshape([-1 if i == (ax % a.ndim) else 1
+                                            for i in range(a.ndim)])
+    iota = jnp.broadcast_to(iota, a.shape)
+    indices = lax.cummax(jnp.where(a == values._data, iota, -1), axis=ax)
+    from ..framework.dtype import convert_dtype
+    return values, Tensor(indices.astype(convert_dtype(dtype)))
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    ax = -1 if axis is None else int(axis)
+    xt = ensure_tensor(x)
+    a = xt._data.reshape(-1) if axis is None else xt._data
+    values = dispatch("cummin", lambda v: lax.cummin(v, axis=ax),
+                      Tensor(a) if axis is None else xt)
+    iota = jnp.arange(a.shape[ax]).reshape([-1 if i == (ax % a.ndim) else 1
+                                            for i in range(a.ndim)])
+    iota = jnp.broadcast_to(iota, a.shape)
+    indices = lax.cummax(jnp.where(a == values._data, iota, -1), axis=ax)
+    from ..framework.dtype import convert_dtype
+    return values, Tensor(indices.astype(convert_dtype(dtype)))
+
+
+def logcumsumexp(x, axis=None, name=None):
+    def fwd(a):
+        if axis is None:
+            a = a.reshape(-1)
+            ax = 0
+        else:
+            ax = int(axis)
+        return lax.cumlogsumexp(a, axis=ax)
+    return dispatch("logcumsumexp", fwd, ensure_tensor(x))
+
+
+def add_n(inputs, name=None):
+    if isinstance(inputs, Tensor):
+        return inputs
+    tensors = [ensure_tensor(t) for t in inputs]
+
+    def fwd(*arrays):
+        out = arrays[0]
+        for a in arrays[1:]:
+            out = out + a
+        return out
+    return dispatch("add_n", fwd, *tensors)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return dispatch("addmm", lambda i, a, b: beta * i + alpha * (a @ b),
+                    ensure_tensor(input), ensure_tensor(x), ensure_tensor(y))
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    tensors = [ensure_tensor(x)]
+    has_pre = prepend is not None
+    has_app = append is not None
+    if has_pre:
+        tensors.append(ensure_tensor(prepend))
+    if has_app:
+        tensors.append(ensure_tensor(append))
+
+    def fwd(*arrays):
+        a = arrays[0]
+        pre = arrays[1] if has_pre else None
+        app = arrays[1 + int(has_pre)] if has_app else None
+        return jnp.diff(a, n=n, axis=axis, prepend=pre, append=app)
+    return dispatch("diff", fwd, *tensors)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    yt = ensure_tensor(y)
+    if x is not None:
+        return dispatch("trapezoid",
+                        lambda a, b: jax.scipy.integrate.trapezoid(a, x=b, axis=axis),
+                        yt, ensure_tensor(x))
+    d = 1.0 if dx is None else dx
+    return dispatch("trapezoid",
+                    lambda a: jax.scipy.integrate.trapezoid(a, dx=d, axis=axis), yt)
+
+
+for _n in ("sum", "prod", "max", "min", "amax", "amin", "logsumexp", "cumsum",
+           "cumprod", "cummax", "cummin", "logcumsumexp", "add_n", "addmm",
+           "diff", "trapezoid", "multiply_no_nan"):
+    register_op(_n, _g[_n])

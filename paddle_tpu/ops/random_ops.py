@@ -1,0 +1,159 @@
+"""Random sampling ops (eager: consume the global generator).
+
+Reference parity: python/paddle/tensor/random.py. TPU-native: stateless JAX PRNG;
+the global generator (framework/random.py) hands each eager call a fresh key so
+results are reproducible under paddle_tpu.seed().
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.dtype import convert_dtype, get_default_dtype
+from ..framework.random import next_key
+from ..tensor import Tensor
+from .dispatch import dispatch, ensure_tensor, register_op
+
+
+def _dt(dtype):
+    d = convert_dtype(dtype)
+    return get_default_dtype() if d is None else d
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(v) for v in shape.tolist())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(v._data) if isinstance(v, Tensor) else int(v) for v in shape)
+
+
+def rand(shape, dtype=None, name=None):
+    return Tensor(jax.random.uniform(next_key(), _shape(shape), _dt(dtype)))
+
+
+def randn(shape, dtype=None, name=None):
+    return Tensor(jax.random.normal(next_key(), _shape(shape), _dt(dtype)))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._data if isinstance(mean, Tensor) else mean
+        s = std._data if isinstance(std, Tensor) else std
+        out_shape = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        return Tensor(jax.random.normal(next_key(), out_shape,
+                                        get_default_dtype()) * s + m)
+    sh = _shape(shape) if shape is not None else ()
+    return Tensor(jax.random.normal(next_key(), sh, get_default_dtype()) * std + mean)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.PRNGKey(seed) if seed else next_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), _dt(dtype),
+                                     minval=float(min), maxval=float(max)))
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(next_key(), _shape(shape), int(low), int(high),
+                                     convert_dtype(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    xt = ensure_tensor(x)
+    if high is None:
+        low, high = 0, low
+    d = convert_dtype(dtype) or xt._data.dtype
+    return Tensor(jax.random.randint(next_key(), tuple(xt._data.shape),
+                                     int(low), int(high), d))
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(next_key(), int(n))
+                  .astype(convert_dtype(dtype)))
+
+
+def bernoulli(x, p=None, name=None):
+    xt = ensure_tensor(x)
+    probs = xt._data if p is None else p
+    return Tensor(jax.random.bernoulli(next_key(), probs,
+                                       tuple(xt._data.shape)).astype(xt._data.dtype))
+
+
+def bernoulli_(x, p=0.5, name=None):
+    xt = ensure_tensor(x)
+    xt._data = jax.random.bernoulli(next_key(), p, tuple(xt._data.shape)) \
+        .astype(xt._data.dtype)
+    return xt
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    xt = ensure_tensor(x)
+    a = xt._data
+    logits = jnp.log(jnp.maximum(a, 1e-30))
+    if replacement:
+        out = jax.random.categorical(next_key(), logits, axis=-1,
+                                     shape=(num_samples,) + tuple(a.shape[:-1]))
+        if a.ndim == 2:
+            out = jnp.moveaxis(out, 0, 1)
+        return Tensor(out.astype(jnp.int64))
+    # Without replacement: Gumbel top-k trick.
+    g = jax.random.gumbel(next_key(), tuple(a.shape))
+    from jax import lax
+    _, idx = lax.top_k(logits + g, num_samples)
+    return Tensor(idx.astype(jnp.int64))
+
+
+def poisson(x, name=None):
+    xt = ensure_tensor(x)
+    return Tensor(jax.random.poisson(next_key(), xt._data).astype(xt._data.dtype))
+
+
+def binomial(count, prob, name=None):
+    ct, pt = ensure_tensor(count), ensure_tensor(prob)
+    return Tensor(jax.random.binomial(next_key(), ct._data, pt._data)
+                  .astype(jnp.int64))
+
+
+def exponential_(x, lam=1.0, name=None):
+    xt = ensure_tensor(x)
+    u = jax.random.uniform(next_key(), tuple(xt._data.shape), xt._data.dtype)
+    xt._data = -jnp.log(1.0 - u) / lam
+    return xt
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    xt = ensure_tensor(x)
+    key = jax.random.PRNGKey(seed) if seed else next_key()
+    xt._data = jax.random.uniform(key, tuple(xt._data.shape), xt._data.dtype,
+                                  minval=float(min), maxval=float(max))
+    return xt
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    xt = ensure_tensor(x)
+    xt._data = (jax.random.normal(next_key(), tuple(xt._data.shape), xt._data.dtype)
+                * std + mean)
+    return xt
+
+
+def rand_like(x, dtype=None, name=None):
+    xt = ensure_tensor(x)
+    d = convert_dtype(dtype) or xt._data.dtype
+    return Tensor(jax.random.uniform(next_key(), tuple(xt._data.shape), d))
+
+
+def randn_like(x, dtype=None, name=None):
+    xt = ensure_tensor(x)
+    d = convert_dtype(dtype) or xt._data.dtype
+    return Tensor(jax.random.normal(next_key(), tuple(xt._data.shape), d))
+
+
+for _n in ("bernoulli_", "exponential_", "uniform_", "normal_", "multinomial"):
+    register_op(_n, globals()[_n])

@@ -1,0 +1,186 @@
+"""Search / sort / selection ops.
+
+Reference parity: python/paddle/tensor/search.py.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.dtype import convert_dtype
+from ..tensor import Tensor
+from .dispatch import dispatch, ensure_tensor, register_op
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    d = convert_dtype(dtype)
+
+    def fwd(a):
+        out = jnp.argmax(a, axis=None if axis is None else int(axis),
+                         keepdims=keepdim)
+        return out.astype(d)
+    return dispatch("argmax", fwd, ensure_tensor(x))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    d = convert_dtype(dtype)
+
+    def fwd(a):
+        out = jnp.argmin(a, axis=None if axis is None else int(axis),
+                         keepdims=keepdim)
+        return out.astype(d)
+    return dispatch("argmin", fwd, ensure_tensor(x))
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    def fwd(a):
+        idx = jnp.argsort(a, axis=int(axis), stable=True,
+                          descending=descending)
+        return idx.astype(jnp.int64)
+    return dispatch("argsort", fwd, ensure_tensor(x))
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    def fwd(a):
+        out = jnp.sort(a, axis=int(axis), stable=True, descending=descending)
+        return out
+    return dispatch("sort", fwd, ensure_tensor(x))
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    kk = int(k.item()) if isinstance(k, Tensor) else int(k)
+
+    def fwd(a):
+        ax = a.ndim - 1 if axis is None else int(axis) % a.ndim
+        moved = jnp.moveaxis(a, ax, -1)
+        if largest:
+            vals, idx = _topk_lax(moved, kk)
+        else:
+            vals, idx = _topk_lax(-moved, kk)
+            vals = -vals
+        return (jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx, -1, ax).astype(jnp.int64))
+    return dispatch("topk", fwd, ensure_tensor(x))
+
+
+def _topk_lax(a, k):
+    from jax import lax
+    return lax.top_k(a, k)
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    ct = ensure_tensor(condition)
+    xt_is = isinstance(x, Tensor)
+    yt_is = isinstance(y, Tensor)
+    if xt_is and yt_is:
+        return dispatch("where", lambda c, a, b: jnp.where(c, a, b), ct, x, y)
+    if xt_is:
+        return dispatch("where", lambda c, a: jnp.where(c, a, y), ct, x)
+    if yt_is:
+        return dispatch("where", lambda c, b: jnp.where(c, x, b), ct, y)
+    return dispatch("where", lambda c: jnp.where(c, x, y), ct)
+
+
+def where_(condition, x=None, y=None, name=None):
+    out = where(condition, x, y)
+    return x._assign_from(out)
+
+
+def nonzero(x, as_tuple=False):
+    a = np.asarray(ensure_tensor(x)._data)
+    nz = np.nonzero(a)  # data-dependent shape -> host (parity: reference syncs too)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i.astype(np.int64))) for i in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1).astype(np.int64)))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    def fwd(s, v):
+        out = jnp.searchsorted(s, v, side="right" if right else "left")
+        return out.astype(jnp.int32 if out_int32 else jnp.int64)
+    if ensure_tensor(sorted_sequence)._data.ndim > 1:
+        def fwd_batched(s, v):
+            import jax
+            f = lambda ss, vv: jnp.searchsorted(ss, vv,
+                                                side="right" if right else "left")
+            for _ in range(s.ndim - 1):
+                f = jax.vmap(f)
+            out = f(s, v)
+            return out.astype(jnp.int32 if out_int32 else jnp.int64)
+        return dispatch("searchsorted", fwd_batched, ensure_tensor(sorted_sequence),
+                        ensure_tensor(values))
+    return dispatch("searchsorted", fwd, ensure_tensor(sorted_sequence),
+                    ensure_tensor(values))
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def kthvalue(x, k, axis=None, keepdim=False, name=None):
+    kk = int(k)
+
+    def fwd(a):
+        ax = a.ndim - 1 if axis is None else int(axis) % a.ndim
+        srt = jnp.sort(a, axis=ax)
+        idx = jnp.argsort(a, axis=ax, stable=True)
+        vals = jnp.take(srt, kk - 1, axis=ax)
+        inds = jnp.take(idx, kk - 1, axis=ax).astype(jnp.int64)
+        if keepdim:
+            vals = jnp.expand_dims(vals, ax)
+            inds = jnp.expand_dims(inds, ax)
+        return vals, inds
+    return dispatch("kthvalue", fwd, ensure_tensor(x))
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    xt = ensure_tensor(x)
+    a = np.asarray(xt._data)
+    ax = int(axis) % a.ndim
+    moved = np.moveaxis(a, ax, -1)
+    flat = moved.reshape(-1, moved.shape[-1])
+    vals = np.empty(flat.shape[0], a.dtype)
+    idxs = np.empty(flat.shape[0], np.int64)
+    for i, row in enumerate(flat):
+        uniq, counts = np.unique(row, return_counts=True)
+        # On ties pick the largest value (last max count in ascending unique order).
+        best = uniq[len(counts) - 1 - np.argmax(counts[::-1])]
+        vals[i] = best
+        idxs[i] = np.where(row == best)[0][-1]
+    out_shape = moved.shape[:-1]
+    v = vals.reshape(out_shape)
+    ii = idxs.reshape(out_shape)
+    if keepdim:
+        v = np.expand_dims(v, ax)
+        ii = np.expand_dims(ii, ax)
+    return Tensor(jnp.asarray(v)), Tensor(jnp.asarray(ii))
+
+
+def index_fill(x, index, axis, value, name=None):
+    def fwd(a, i):
+        moved = jnp.moveaxis(a, int(axis), 0)
+        v = value._data if isinstance(value, Tensor) else value
+        out = moved.at[i.reshape(-1)].set(jnp.asarray(v, a.dtype))
+        return jnp.moveaxis(out, 0, int(axis))
+    return dispatch("index_fill", fwd, ensure_tensor(x), ensure_tensor(index))
+
+
+def index_fill_(x, index, axis, value, name=None):
+    return x._assign_from(index_fill(x, index, axis, value))
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return dispatch("count_nonzero",
+                    lambda a: jnp.count_nonzero(a, axis=ax, keepdims=keepdim)
+                    .astype(jnp.int64),
+                    ensure_tensor(x))
+
+
+import jax  # noqa: E402  (used by searchsorted vmap path)
+
+for _n in ("argmax", "argmin", "argsort", "sort", "topk", "where", "where_",
+           "nonzero", "searchsorted", "bucketize", "kthvalue", "mode",
+           "index_fill", "index_fill_", "count_nonzero"):
+    register_op(_n, globals()[_n])

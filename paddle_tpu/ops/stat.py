@@ -1,0 +1,94 @@
+"""Statistics ops.
+
+Reference parity: python/paddle/tensor/stat.py.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .dispatch import dispatch, ensure_tensor, register_op
+
+
+def _ax(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return dispatch("mean", lambda a: jnp.mean(a, axis=_ax(axis), keepdims=keepdim),
+                    ensure_tensor(x))
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return dispatch("std",
+                    lambda a: jnp.std(a, axis=_ax(axis), ddof=1 if unbiased else 0,
+                                      keepdims=keepdim),
+                    ensure_tensor(x))
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return dispatch("var",
+                    lambda a: jnp.var(a, axis=_ax(axis), ddof=1 if unbiased else 0,
+                                      keepdims=keepdim),
+                    ensure_tensor(x))
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    def fwd(a):
+        if mode == "min":
+            # paddle mode='min' returns lower of the two middles
+            ax = _ax(axis)
+            if ax is None:
+                flat = jnp.sort(a.reshape(-1))
+                return flat[(flat.shape[0] - 1) // 2]
+            srt = jnp.sort(a, axis=ax)
+            n = srt.shape[ax]
+            return jnp.take(srt, (n - 1) // 2, axis=ax)
+        return jnp.median(a, axis=_ax(axis), keepdims=keepdim)
+    return dispatch("median", fwd, ensure_tensor(x))
+
+
+def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
+    return dispatch("nanmedian",
+                    lambda a: jnp.nanmedian(a, axis=_ax(axis), keepdims=keepdim),
+                    ensure_tensor(x))
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return dispatch("nanmean",
+                    lambda a: jnp.nanmean(a, axis=_ax(axis), keepdims=keepdim),
+                    ensure_tensor(x))
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    from ..framework.dtype import convert_dtype
+    return dispatch("nansum",
+                    lambda a: jnp.nansum(a, axis=_ax(axis), keepdims=keepdim,
+                                         dtype=convert_dtype(dtype)),
+                    ensure_tensor(x))
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    qv = q.tolist() if hasattr(q, "tolist") else q
+
+    def fwd(a):
+        return jnp.quantile(a, jnp.asarray(qv), axis=_ax(axis), keepdims=keepdim,
+                            method=interpolation)
+    return dispatch("quantile", fwd, ensure_tensor(x))
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    qv = q.tolist() if hasattr(q, "tolist") else q
+
+    def fwd(a):
+        return jnp.nanquantile(a, jnp.asarray(qv), axis=_ax(axis), keepdims=keepdim,
+                               method=interpolation)
+    return dispatch("nanquantile", fwd, ensure_tensor(x))
+
+
+for _n in ("mean", "std", "var", "median", "nanmedian", "nanmean", "nansum",
+           "quantile", "nanquantile"):
+    register_op(_n, globals()[_n])

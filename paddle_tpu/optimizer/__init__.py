@@ -1,0 +1,463 @@
+"""Optimizers.
+
+Reference parity: python/paddle/optimizer/ (Optimizer base optimizer.py; fused
+adamw path adamw.py:528). TPU-native: each optimizer's update rule is a pure
+jitted function applied per-parameter (XLA caches one executable per shape); the
+same rules are reused by the functional training-step path (jit/train loops) so
+eager and compiled training share numerics.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from . import lr as lr_mod
+from .lr import LRScheduler
+
+__all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adagrad",
+           "Adadelta", "Adamax", "RMSProp", "Lamb", "lr"]
+
+lr = lr_mod
+
+
+# ---- grad clipping (parity: python/paddle/nn/clip.py) ------------------------
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def __call__(self, params_grads):
+        return [(p, Tensor(jnp.clip(g._data, self.min, self.max)))
+                for p, g in params_grads]
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            n = jnp.sqrt(jnp.sum(g._data.astype(jnp.float32) ** 2))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(n, 1e-12), 1.0)
+            out.append((p, Tensor((g._data * scale).astype(g._data.dtype))))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        sq = [jnp.sum(g._data.astype(jnp.float32) ** 2) for p, g in params_grads
+              if getattr(p, "need_clip", True)]
+        if not sq:
+            return params_grads
+        global_norm = jnp.sqrt(sum(sq))
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        return [(p, Tensor((g._data * scale).astype(g._data.dtype))
+                 if getattr(p, "need_clip", True) else g)
+                for p, g in params_grads]
+
+
+# ---- base --------------------------------------------------------------------
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        self._lr = learning_rate
+        self._parameter_list = list(parameters) if parameters is not None else None
+        self._param_groups = None
+        if self._parameter_list and isinstance(self._parameter_list[0], dict):
+            self._param_groups = self._parameter_list
+            flat = []
+            for g in self._param_groups:
+                flat.extend(g["params"])
+            self._parameter_list = flat
+        self._weight_decay = weight_decay
+        self._grad_clip = grad_clip
+        self._name = name
+        # per-parameter state: dict id(param) -> dict of jnp arrays
+        self._accumulators: Dict[int, Dict[str, jnp.ndarray]] = {}
+        self._global_step = 0
+
+    # lr ----------------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._lr, LRScheduler):
+            return float(self._lr())
+        return float(self._lr)
+
+    def set_lr(self, value):
+        self._lr = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._lr = scheduler
+
+    @property
+    def _learning_rate(self):
+        return self._lr
+
+    # state -------------------------------------------------------------------
+    def state_dict(self) -> Dict:
+        state = {"global_step": self._global_step, "accumulators": {}}
+        for i, p in enumerate(self._parameter_list or []):
+            acc = self._accumulators.get(id(p))
+            if acc is not None:
+                key = p.name or f"param_{i}"
+                state["accumulators"][key] = {k: Tensor(v) for k, v in acc.items()}
+        if isinstance(self._lr, LRScheduler):
+            state["LR_Scheduler"] = self._lr.state_dict()
+        return state
+
+    def set_state_dict(self, state):
+        self._global_step = state.get("global_step", 0)
+        accs = state.get("accumulators", {})
+        for i, p in enumerate(self._parameter_list or []):
+            key = p.name or f"param_{i}"
+            if key in accs:
+                self._accumulators[id(p)] = {
+                    k: (v._data if isinstance(v, Tensor) else jnp.asarray(v))
+                    for k, v in accs[key].items()}
+        if "LR_Scheduler" in state and isinstance(self._lr, LRScheduler):
+            self._lr.set_state_dict(state["LR_Scheduler"])
+
+    # helpers -----------------------------------------------------------------
+    def _wd_coeff(self, param) -> float:
+        wd = self._weight_decay
+        if wd is None:
+            return 0.0
+        if isinstance(wd, (int, float)):
+            return 0.0 if getattr(param, "regularizer", None) is False else float(wd)
+        return float(wd)
+
+    def _collect_params_grads(self):
+        pgs = []
+        for p in self._parameter_list or []:
+            if p.grad is not None and not p.stop_gradient:
+                pgs.append((p, p.grad))
+        if self._grad_clip is not None:
+            pgs = self._grad_clip(pgs)
+        return pgs
+
+    def clear_grad(self, set_to_zero: bool = False):
+        for p in self._parameter_list or []:
+            p.clear_gradient(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    # main API ----------------------------------------------------------------
+    @jax.named_scope("optimizer_step")
+    def step(self):
+        self._global_step += 1
+        pgs = self._collect_params_grads()
+        for p, g in pgs:
+            acc = self._accumulators.get(id(p))
+            if acc is None:
+                acc = self._init_state(p)
+                acc["_step"] = 0
+                self._accumulators[id(p)] = acc
+            # per-parameter step (bias correction must reflect how many updates
+            # THIS param has seen — parity with the reference's beta1_pow/
+            # beta2_pow accumulators, not the optimizer-global counter)
+            step = int(acc.get("_step", 0)) + 1
+            lr_val = self.get_lr() * p.optimize_attr.get("learning_rate", 1.0) \
+                if hasattr(p, "optimize_attr") else self.get_lr()
+            state = {k: v for k, v in acc.items() if k != "_step"}
+            new_param, acc_new = self._update(
+                p._data, g._data.astype(p._data.dtype), state, lr_val,
+                self._wd_coeff(p), step)
+            p._data = new_param
+            acc_new["_step"] = step
+            self._accumulators[id(p)] = acc_new
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    # to implement ------------------------------------------------------------
+    def _init_state(self, param) -> Dict[str, jnp.ndarray]:
+        return {}
+
+    def _update(self, param, grad, state, lr_val, wd, step):
+        raise NotImplementedError
+
+
+# ---- concrete optimizers -----------------------------------------------------
+
+@jax.jit
+def _sgd_update(p, g, lr_val, wd):
+    g = g + wd * p
+    return (p - lr_val * g).astype(p.dtype)
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+
+    def _update(self, param, grad, state, lr_val, wd, step):
+        return _sgd_update(param, grad, jnp.float32(lr_val), jnp.float32(wd)), state
+
+
+@jax.jit
+def _momentum_update(p, g, vel, lr_val, mu, wd, use_nesterov):
+    g = g + wd * p
+    v_new = mu * vel + g
+    update = jnp.where(use_nesterov, g + mu * v_new, v_new)
+    return (p - lr_val * update).astype(p.dtype), v_new
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _init_state(self, param):
+        return {"velocity": jnp.zeros_like(param._data)}
+
+    def _update(self, param, grad, state, lr_val, wd, step):
+        new_p, v = _momentum_update(param, grad, state["velocity"],
+                                    jnp.float32(lr_val),
+                                    jnp.float32(self._momentum),
+                                    jnp.float32(wd), self._use_nesterov)
+        return new_p, {"velocity": v}
+
+
+@jax.jit
+def _adam_update(p, g, m, v, lr_val, beta1, beta2, eps, step, wd, decoupled):
+    gf = g.astype(jnp.float32)
+    pf = p.astype(jnp.float32)
+    gf = jnp.where(decoupled, gf, gf + wd * pf)
+    m_new = beta1 * m + (1 - beta1) * gf
+    v_new = beta2 * v + (1 - beta2) * gf * gf
+    mhat = m_new / (1 - beta1 ** step)
+    vhat = v_new / (1 - beta2 ** step)
+    upd = mhat / (jnp.sqrt(vhat) + eps)
+    pf = jnp.where(decoupled, pf * (1 - lr_val * wd), pf)
+    return (pf - lr_val * upd).astype(p.dtype), m_new, v_new
+
+
+class Adam(Optimizer):
+    _decoupled_wd = False
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _init_state(self, param):
+        return {"moment1": jnp.zeros(param._data.shape, jnp.float32),
+                "moment2": jnp.zeros(param._data.shape, jnp.float32)}
+
+    def _update(self, param, grad, state, lr_val, wd, step):
+        new_p, m, v = _adam_update(param, grad, state["moment1"],
+                                   state["moment2"], jnp.float32(lr_val),
+                                   jnp.float32(self._beta1),
+                                   jnp.float32(self._beta2),
+                                   jnp.float32(self._epsilon),
+                                   jnp.float32(step), jnp.float32(wd),
+                                   self._decoupled_wd)
+        return new_p, {"moment1": m, "moment2": v}
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (parity: paddle.optimizer.AdamW, adamw.py:528)."""
+    _decoupled_wd = True
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None, **kw):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, name=name)
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _wd_coeff(self, param):
+        if self._apply_decay_param_fun is not None and \
+                not self._apply_decay_param_fun(param.name or ""):
+            return 0.0
+        return super()._wd_coeff(param)
+
+
+@jax.jit
+def _adagrad_update(p, g, mom, lr_val, eps, wd):
+    g = g.astype(jnp.float32) + wd * p.astype(jnp.float32)
+    mom_new = mom + g * g
+    return (p.astype(jnp.float32)
+            - lr_val * g / (jnp.sqrt(mom_new) + eps)).astype(p.dtype), mom_new
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-06, parameters=None,
+                 weight_decay=None, grad_clip=None,
+                 initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon = epsilon
+        self._init_val = initial_accumulator_value
+
+    def _init_state(self, param):
+        return {"moment": jnp.full(param._data.shape, self._init_val,
+                                   jnp.float32)}
+
+    def _update(self, param, grad, state, lr_val, wd, step):
+        new_p, mom = _adagrad_update(param, grad, state["moment"],
+                                     jnp.float32(lr_val),
+                                     jnp.float32(self._epsilon),
+                                     jnp.float32(wd))
+        return new_p, {"moment": mom}
+
+
+@jax.jit
+def _adadelta_update(p, g, avg_sq, avg_upd, rho, eps, lr_val, wd):
+    g = g.astype(jnp.float32) + wd * p.astype(jnp.float32)
+    avg_sq_new = rho * avg_sq + (1 - rho) * g * g
+    upd = jnp.sqrt(avg_upd + eps) / jnp.sqrt(avg_sq_new + eps) * g
+    avg_upd_new = rho * avg_upd + (1 - rho) * upd * upd
+    return (p.astype(jnp.float32) - lr_val * upd).astype(p.dtype), \
+        avg_sq_new, avg_upd_new
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-06, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def _init_state(self, param):
+        z = jnp.zeros(param._data.shape, jnp.float32)
+        return {"avg_squared_grad": z, "avg_squared_update": z}
+
+    def _update(self, param, grad, state, lr_val, wd, step):
+        new_p, sq, up = _adadelta_update(param, grad,
+                                         state["avg_squared_grad"],
+                                         state["avg_squared_update"],
+                                         jnp.float32(self._rho),
+                                         jnp.float32(self._epsilon),
+                                         jnp.float32(lr_val), jnp.float32(wd))
+        return new_p, {"avg_squared_grad": sq, "avg_squared_update": up}
+
+
+@jax.jit
+def _adamax_update(p, g, m, inf_norm, lr_val, beta1, beta2, eps, step, wd):
+    g = g.astype(jnp.float32) + wd * p.astype(jnp.float32)
+    m_new = beta1 * m + (1 - beta1) * g
+    inf_new = jnp.maximum(beta2 * inf_norm, jnp.abs(g))
+    upd = m_new / (1 - beta1 ** step) / (inf_new + eps)
+    return (p.astype(jnp.float32) - lr_val * upd).astype(p.dtype), m_new, inf_new
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _init_state(self, param):
+        z = jnp.zeros(param._data.shape, jnp.float32)
+        return {"moment": z, "inf_norm": z}
+
+    def _update(self, param, grad, state, lr_val, wd, step):
+        new_p, m, inf = _adamax_update(param, grad, state["moment"],
+                                       state["inf_norm"], jnp.float32(lr_val),
+                                       jnp.float32(self._beta1),
+                                       jnp.float32(self._beta2),
+                                       jnp.float32(self._epsilon),
+                                       jnp.float32(step), jnp.float32(wd))
+        return new_p, {"moment": m, "inf_norm": inf}
+
+
+@jax.jit
+def _rmsprop_update(p, g, mean_sq, mean_g, mom, lr_val, rho, eps, momentum,
+                    centered, wd):
+    g = g.astype(jnp.float32) + wd * p.astype(jnp.float32)
+    mean_sq_new = rho * mean_sq + (1 - rho) * g * g
+    mean_g_new = jnp.where(centered, rho * mean_g + (1 - rho) * g, mean_g)
+    denom = mean_sq_new - jnp.where(centered, mean_g_new * mean_g_new, 0.0)
+    mom_new = momentum * mom + lr_val * g / jnp.sqrt(denom + eps)
+    return (p.astype(jnp.float32) - mom_new).astype(p.dtype), \
+        mean_sq_new, mean_g_new, mom_new
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-06, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _init_state(self, param):
+        z = jnp.zeros(param._data.shape, jnp.float32)
+        return {"mean_square": z, "mean_grad": z, "momentum": z}
+
+    def _update(self, param, grad, state, lr_val, wd, step):
+        new_p, ms, mg, mom = _rmsprop_update(
+            param, grad, state["mean_square"], state["mean_grad"],
+            state["momentum"], jnp.float32(lr_val), jnp.float32(self._rho),
+            jnp.float32(self._epsilon), jnp.float32(self._momentum),
+            self._centered, jnp.float32(wd))
+        return new_p, {"mean_square": ms, "mean_grad": mg, "momentum": mom}
+
+
+@jax.jit
+def _lamb_update(p, g, m, v, lr_val, beta1, beta2, eps, step, wd):
+    gf = g.astype(jnp.float32)
+    pf = p.astype(jnp.float32)
+    m_new = beta1 * m + (1 - beta1) * gf
+    v_new = beta2 * v + (1 - beta2) * gf * gf
+    mhat = m_new / (1 - beta1 ** step)
+    vhat = v_new / (1 - beta2 ** step)
+    r = mhat / (jnp.sqrt(vhat) + eps) + wd * pf
+    w_norm = jnp.linalg.norm(pf)
+    r_norm = jnp.linalg.norm(r)
+    ratio = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+    return (pf - lr_val * ratio * r).astype(p.dtype), m_new, v_new
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-06, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, lamb_weight_decay,
+                         grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _init_state(self, param):
+        z = jnp.zeros(param._data.shape, jnp.float32)
+        return {"moment1": z, "moment2": z}
+
+    def _wd_coeff(self, param):
+        if self._exclude_fn is not None and self._exclude_fn(param):
+            return 0.0
+        return super()._wd_coeff(param)
+
+    def _update(self, param, grad, state, lr_val, wd, step):
+        new_p, m, v = _lamb_update(param, grad, state["moment1"],
+                                   state["moment2"], jnp.float32(lr_val),
+                                   jnp.float32(self._beta1),
+                                   jnp.float32(self._beta2),
+                                   jnp.float32(self._epsilon),
+                                   jnp.float32(step), jnp.float32(wd))
+        return new_p, {"moment1": m, "moment2": v}

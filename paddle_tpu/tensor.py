@@ -1,0 +1,353 @@
+"""The eager Tensor.
+
+Reference parity: paddle::Tensor + AutogradMeta (paddle/phi/api/include/tensor.h,
+paddle/fluid/eager/autograd_meta.h:61) and the Python-visible Tensor behavior
+(python/paddle/base/dygraph/tensor_patch_methods.py). TPU-native design: the
+storage is a jax.Array (device-resident, XLA-managed); autograd metadata is a
+(node, out_index) link into the vjp tape (autograd/tape.py). Every op both exists
+as a free function (paddle_tpu.add) and as a method (Tensor.add) — methods are
+registered by the ops package at import time.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .autograd import tape
+from .framework import dtype as dtype_mod
+
+# Populated by paddle_tpu.ops at import time: name -> callable. Tensor dunders and
+# methods route through this table so ops and methods stay one implementation.
+_OPS = {}
+
+
+def _op(name):
+    return _OPS[name]
+
+
+class Tensor:
+    __slots__ = ("_data", "stop_gradient", "grad", "_node", "_out_index", "name",
+                 "persistable", "__weakref__")
+
+    def __init__(self, data, stop_gradient: bool = True, name: Optional[str] = None):
+        if isinstance(data, Tensor):
+            data = data._data
+        if not isinstance(data, jax.Array):
+            data = jnp.asarray(data)
+        self._data = data
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self._node = None
+        self._out_index = 0
+        self.name = name
+        self.persistable = False
+
+    # -- metadata -------------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    def dim(self):
+        return self._data.ndim
+
+    @property
+    def size(self):
+        return int(self._data.size)
+
+    def numel(self):
+        return int(self._data.size)
+
+    @property
+    def place(self):
+        devs = self._data.devices()
+        return next(iter(devs)) if devs else None
+
+    @property
+    def is_leaf(self):
+        return self._node is None
+
+    # -- conversion -----------------------------------------------------------
+    def numpy(self):
+        return np.asarray(self._data)
+
+    def item(self, *args):
+        if args:
+            return self.numpy().item(*args)
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def __float__(self):
+        return float(self.item())
+
+    def __int__(self):
+        return int(self.item())
+
+    def __bool__(self):
+        return bool(self.item())
+
+    def __index__(self):
+        return int(self.item())
+
+    def __len__(self):
+        if self._data.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __hash__(self):
+        return id(self)
+
+    # -- autograd -------------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph: bool = False):
+        from .autograd.backward import run_backward
+        run_backward(self, grad_tensor, retain_graph=retain_graph)
+
+    def detach(self) -> "Tensor":
+        return Tensor(self._data, stop_gradient=True, name=self.name)
+
+    def detach_(self) -> "Tensor":
+        self._node = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self) -> "Tensor":
+        return _op("clone")(self)
+
+    def clear_grad(self):
+        self.grad = None
+
+    def clear_gradient(self, set_to_zero: bool = False):
+        if set_to_zero and self.grad is not None:
+            self.grad = Tensor(jnp.zeros_like(self._data))
+        else:
+            self.grad = None
+
+    def zero_(self):
+        self._data = jnp.zeros_like(self._data)
+        return self
+
+    def _assign_from(self, other: "Tensor"):
+        """Rebind storage + tape link in place (supports in-place-style APIs).
+
+        If `self` is an input of the node that produced `other` (x.op_(...)
+        pattern), the node must keep referring to self's OLD tape position —
+        otherwise the rebound tensor becomes its own ancestor and gradients
+        silently vanish. Replace such inputs with an alias snapshot.
+        """
+        node = other._node
+        if node is not None:
+            for i, inp in enumerate(node.inputs):
+                if inp is self:
+                    if self._node is None and not self.stop_gradient:
+                        # parity: paddle forbids recorded in-place ops on leaf
+                        # tensors that require grad (grads would be lost).
+                        raise RuntimeError(
+                            "a leaf Tensor that requires grad is being used in "
+                            "an in-place operation; detach() it first or wrap "
+                            "in no_grad()")
+                    alias = Tensor.__new__(Tensor)
+                    alias._data = self._data
+                    alias.stop_gradient = self.stop_gradient
+                    alias.grad = None
+                    alias._node = self._node
+                    alias._out_index = self._out_index
+                    alias.name = self.name
+                    alias.persistable = False
+                    node.inputs[i] = alias
+        self._data = other._data
+        self._node = other._node
+        self._out_index = other._out_index
+        if not other.stop_gradient:
+            self.stop_gradient = False
+        return self
+
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            value = value._data
+        self._data = jnp.asarray(value, dtype=self._data.dtype).reshape(self._data.shape)
+        return self
+
+    def copy_(self, other):
+        return self.set_value(other)
+
+    def register_hook(self, hook):
+        """Grad hook on this tensor's gradient (parity: Tensor.register_hook)."""
+        from .autograd.backward import register_tensor_hook
+        return register_tensor_hook(self, hook)
+
+    # -- repr -----------------------------------------------------------------
+    def __repr__(self):
+        grad_txt = f", stop_gradient={self.stop_gradient}"
+        return (f"Tensor(shape={self.shape}, dtype={self._data.dtype.name}"
+                f"{grad_txt},\n       {np.asarray(self._data)!r})")
+
+    # -- device no-ops (single logical XLA device space) ----------------------
+    def cpu(self):
+        return self
+
+    def cuda(self, *a, **k):
+        return self
+
+    def to(self, *args, **kwargs):
+        dtype = kwargs.get("dtype")
+        for a in args:
+            if isinstance(a, (str, np.dtype)) and str(a) in (
+                    "float16", "bfloat16", "float32", "float64", "int32", "int64"):
+                dtype = a
+        if dtype is not None:
+            return self.astype(dtype)
+        return self
+
+    def contiguous(self):
+        return self
+
+    def pin_memory(self):
+        return self
+
+    def astype(self, dtype) -> "Tensor":
+        return _op("cast")(self, dtype)
+
+    def cast(self, dtype) -> "Tensor":
+        return _op("cast")(self, dtype)
+
+    # -- arithmetic dunders ---------------------------------------------------
+    def __add__(self, other):
+        return _op("add")(self, other)
+
+    def __radd__(self, other):
+        return _op("add")(self, other)
+
+    def __sub__(self, other):
+        return _op("subtract")(self, other)
+
+    def __rsub__(self, other):
+        return _op("rsub")(self, other)
+
+    def __mul__(self, other):
+        return _op("multiply")(self, other)
+
+    def __rmul__(self, other):
+        return _op("multiply")(self, other)
+
+    def __truediv__(self, other):
+        return _op("divide")(self, other)
+
+    def __rtruediv__(self, other):
+        return _op("rdiv")(self, other)
+
+    def __floordiv__(self, other):
+        return _op("floor_divide")(self, other)
+
+    def __mod__(self, other):
+        return _op("remainder")(self, other)
+
+    def __pow__(self, other):
+        return _op("pow")(self, other)
+
+    def __rpow__(self, other):
+        return _op("rpow")(self, other)
+
+    def __neg__(self):
+        return _op("neg")(self)
+
+    def __abs__(self):
+        return _op("abs")(self)
+
+    def __matmul__(self, other):
+        return _op("matmul")(self, other)
+
+    def __invert__(self):
+        return _op("logical_not")(self)
+
+    # comparisons
+    def __eq__(self, other):
+        return _op("equal")(self, other)
+
+    def __ne__(self, other):
+        return _op("not_equal")(self, other)
+
+    def __lt__(self, other):
+        return _op("less_than")(self, other)
+
+    def __le__(self, other):
+        return _op("less_equal")(self, other)
+
+    def __gt__(self, other):
+        return _op("greater_than")(self, other)
+
+    def __ge__(self, other):
+        return _op("greater_equal")(self, other)
+
+    # indexing
+    def __getitem__(self, idx):
+        return _op("getitem")(self, idx)
+
+    def __setitem__(self, idx, value):
+        return _op("setitem")(self, idx, value)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    @property
+    def T(self):
+        return _op("t")(self)
+
+    @property
+    def mT(self):
+        return _op("matrix_transpose")(self)
+
+
+class Parameter(Tensor):
+    """Trainable tensor (parity: paddle.base.framework.EagerParamBase)."""
+
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip")
+
+    def __init__(self, data, trainable: bool = True, name: Optional[str] = None):
+        super().__init__(data, stop_gradient=not trainable, name=name)
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.need_clip = True
+        self.persistable = True
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> Tensor:
+    """Parity: paddle.to_tensor (python/paddle/tensor/creation.py)."""
+    del place
+    if isinstance(data, Tensor):
+        out = data.astype(dtype) if dtype is not None else Tensor(data._data)
+        out.stop_gradient = stop_gradient
+        return out
+    np_dtype = dtype_mod.convert_dtype(dtype)
+    if np_dtype is None and not isinstance(data, (jax.Array, np.ndarray)):
+        probe = np.asarray(data)
+        if probe.dtype == np.float64:
+            np_dtype = dtype_mod.get_default_dtype()
+        elif probe.dtype == np.int64:
+            np_dtype = np.dtype("int64")
+    arr = jnp.asarray(data, dtype=np_dtype)
+    return Tensor(arr, stop_gradient=stop_gradient)
+
+
+def is_tensor(x) -> bool:
+    return isinstance(x, Tensor)

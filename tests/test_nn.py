@@ -1,0 +1,287 @@
+"""nn layers + functional vs oracle."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def _t(a, sg=True):
+    return paddle.to_tensor(np.asarray(a), stop_gradient=sg)
+
+
+class TestLayerBase:
+    def test_registration_and_traversal(self):
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = nn.Linear(4, 8)
+                self.fc2 = nn.Linear(8, 2)
+
+            def forward(self, x):
+                return self.fc2(F.relu(self.fc1(x)))
+
+        net = Net()
+        names = [n for n, _ in net.named_parameters()]
+        assert set(names) == {"fc1.weight", "fc1.bias", "fc2.weight",
+                              "fc2.bias"}
+        assert len(net.parameters()) == 4
+        assert len(net.sublayers()) == 2
+        out = net(_t(np.random.randn(3, 4).astype(np.float32)))
+        assert out.shape == [3, 2]
+
+    def test_state_dict_roundtrip(self):
+        net = nn.Linear(3, 5)
+        sd = net.state_dict()
+        assert set(sd) == {"weight", "bias"}
+        net2 = nn.Linear(3, 5)
+        net2.set_state_dict(sd)
+        np.testing.assert_allclose(net2.weight.numpy(), net.weight.numpy())
+
+    def test_train_eval_mode(self):
+        net = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+        net.eval()
+        assert not net[1].training
+        net.train()
+        assert net[1].training
+
+    def test_apply_and_to_dtype(self):
+        net = nn.Linear(2, 2)
+        net.to(dtype="float16")
+        assert net.weight.dtype == np.dtype("float16")
+
+    def test_forward_hooks(self):
+        net = nn.Linear(2, 2)
+        calls = []
+        net.register_forward_post_hook(lambda l, i, o: calls.append(1))
+        net(_t(np.ones((1, 2), np.float32)))
+        assert calls == [1]
+
+    def test_containers(self):
+        seq = nn.Sequential(nn.Linear(2, 3), nn.Linear(3, 4))
+        assert len(seq) == 2
+        ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+        ll.append(nn.Linear(2, 2))
+        assert len(ll) == 4
+        assert len(ll.parameters()) == 8
+
+
+class TestFunctional:
+    def test_linear_oracle(self):
+        x = np.random.randn(4, 3).astype(np.float32)
+        w = np.random.randn(3, 5).astype(np.float32)
+        b = np.random.randn(5).astype(np.float32)
+        got = F.linear(_t(x), _t(w), _t(b)).numpy()
+        np.testing.assert_allclose(got, x @ w + b, rtol=1e-5)
+
+    def test_activations_oracle(self):
+        x = np.random.randn(3, 4).astype(np.float32)
+        np.testing.assert_allclose(F.relu(_t(x)).numpy(), np.maximum(x, 0))
+        np.testing.assert_allclose(F.sigmoid(_t(x)).numpy(),
+                                   1 / (1 + np.exp(-x)), rtol=1e-5)
+        sm = F.softmax(_t(x), axis=-1).numpy()
+        e = np.exp(x - x.max(-1, keepdims=True))
+        np.testing.assert_allclose(sm, e / e.sum(-1, keepdims=True), rtol=1e-5)
+        np.testing.assert_allclose(F.leaky_relu(_t(x), 0.1).numpy(),
+                                   np.where(x > 0, x, 0.1 * x), rtol=1e-6)
+
+    def test_conv2d_oracle(self):
+        """conv2d vs scipy-style direct computation."""
+        x = np.random.randn(2, 3, 8, 8).astype(np.float32)
+        w = np.random.randn(4, 3, 3, 3).astype(np.float32)
+        got = F.conv2d(_t(x), _t(w), padding=1).numpy()
+        assert got.shape == (2, 4, 8, 8)
+        # oracle: explicit loop conv at one output position
+        xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+        want_00 = (xp[0, :, 0:3, 0:3] * w[1]).sum()
+        np.testing.assert_allclose(got[0, 1, 0, 0], want_00, rtol=1e-4)
+
+    def test_conv2d_stride_groups(self):
+        x = np.random.randn(1, 4, 8, 8).astype(np.float32)
+        w = np.random.randn(8, 2, 3, 3).astype(np.float32)
+        got = F.conv2d(_t(x), _t(w), stride=2, padding=1, groups=2)
+        assert got.shape == [1, 8, 4, 4]
+
+    def test_conv_transpose(self):
+        x = np.random.randn(1, 3, 4, 4).astype(np.float32)
+        w = np.random.randn(3, 5, 2, 2).astype(np.float32)  # [in, out, k, k]
+        got = F.conv2d_transpose(_t(x), _t(w), stride=2)
+        assert got.shape == [1, 5, 8, 8]
+
+    def test_pooling(self):
+        x = np.random.randn(1, 2, 4, 4).astype(np.float32)
+        mp = F.max_pool2d(_t(x), 2, 2).numpy()
+        want = x.reshape(1, 2, 2, 2, 2, 2).max((3, 5))
+        np.testing.assert_allclose(mp, want)
+        ap = F.avg_pool2d(_t(x), 2, 2).numpy()
+        np.testing.assert_allclose(ap, x.reshape(1, 2, 2, 2, 2, 2).mean((3, 5)),
+                                   rtol=1e-6)
+        aap = F.adaptive_avg_pool2d(_t(x), 1).numpy()
+        np.testing.assert_allclose(aap[..., 0, 0], x.mean((2, 3)), rtol=1e-6)
+
+    def test_layer_norm_oracle(self):
+        x = np.random.randn(2, 3, 8).astype(np.float32)
+        w = np.random.rand(8).astype(np.float32)
+        b = np.random.rand(8).astype(np.float32)
+        got = F.layer_norm(_t(x), 8, _t(w), _t(b)).numpy()
+        mu = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        want = (x - mu) / np.sqrt(var + 1e-5) * w + b
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_rms_norm_oracle(self):
+        x = np.random.randn(2, 8).astype(np.float32)
+        w = np.random.rand(8).astype(np.float32)
+        got = F.rms_norm(_t(x), _t(w), epsilon=1e-6).numpy()
+        want = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6) * w
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_batch_norm_train_updates_stats(self):
+        bn = nn.BatchNorm2D(3)
+        x = _t(np.random.randn(4, 3, 5, 5).astype(np.float32) + 2.0)
+        bn.train()
+        out = bn(x)
+        assert out.shape == [4, 3, 5, 5]
+        assert abs(float(bn._mean.numpy().mean())) > 0.01  # stats moved
+        bn.eval()
+        out_eval = bn(x)
+        assert out_eval.shape == [4, 3, 5, 5]
+
+    def test_dropout_train_eval(self):
+        x = _t(np.ones((100, 100), np.float32))
+        out = F.dropout(x, 0.5, training=True)
+        frac_zero = float((out.numpy() == 0).mean())
+        assert 0.3 < frac_zero < 0.7
+        np.testing.assert_allclose(F.dropout(x, 0.5, training=False).numpy(),
+                                   x.numpy())
+
+    def test_embedding(self):
+        w = np.random.randn(10, 4).astype(np.float32)
+        ids = np.asarray([[1, 2], [3, 4]])
+        got = F.embedding(_t(ids), _t(w)).numpy()
+        np.testing.assert_allclose(got, w[ids])
+
+    def test_pad_interpolate(self):
+        x = np.random.randn(1, 2, 4, 4).astype(np.float32)
+        p = F.pad(_t(x), [1, 1, 2, 2]).numpy()
+        assert p.shape == (1, 2, 8, 6)
+        up = F.interpolate(_t(x), scale_factor=2, mode="nearest").numpy()
+        assert up.shape == (1, 2, 8, 8)
+        np.testing.assert_allclose(up[..., ::2, ::2], x)
+        bi = F.interpolate(_t(x), size=[8, 8], mode="bilinear").numpy()
+        assert bi.shape == (1, 2, 8, 8)
+
+
+class TestLosses:
+    def test_cross_entropy_oracle(self):
+        logits = np.random.randn(4, 5).astype(np.float32)
+        labels = np.asarray([0, 2, 4, 1])
+        got = F.cross_entropy(_t(logits), _t(labels)).numpy()
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        want = -np.log(p[np.arange(4), labels]).mean()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_cross_entropy_ignore_index(self):
+        logits = np.random.randn(4, 5).astype(np.float32)
+        labels = np.asarray([0, -100, 4, -100])
+        got = F.cross_entropy(_t(logits), _t(labels),
+                              ignore_index=-100).numpy()
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        want = -np.log(p[[0, 2], [0, 4]]).mean()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_cross_entropy_soft_label(self):
+        logits = np.random.randn(3, 4).astype(np.float32)
+        soft = np.random.rand(3, 4).astype(np.float32)
+        soft /= soft.sum(-1, keepdims=True)
+        got = F.cross_entropy(_t(logits), _t(soft), soft_label=True).numpy()
+        logp = logits - logits.max(-1, keepdims=True)
+        logp = logp - np.log(np.exp(logp).sum(-1, keepdims=True))
+        want = (-(soft * logp).sum(-1)).mean()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_mse_l1(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        b = np.random.randn(3, 4).astype(np.float32)
+        np.testing.assert_allclose(F.mse_loss(_t(a), _t(b)).numpy(),
+                                   ((a - b) ** 2).mean(), rtol=1e-5)
+        np.testing.assert_allclose(F.l1_loss(_t(a), _t(b)).numpy(),
+                                   np.abs(a - b).mean(), rtol=1e-5)
+
+    def test_bce_with_logits(self):
+        z = np.random.randn(6).astype(np.float32)
+        y = (np.random.rand(6) > 0.5).astype(np.float32)
+        got = F.binary_cross_entropy_with_logits(_t(z), _t(y)).numpy()
+        p = 1 / (1 + np.exp(-z))
+        want = -(y * np.log(p) + (1 - y) * np.log(1 - p)).mean()
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    def test_kl_div(self):
+        a = np.log(np.random.rand(4, 3).astype(np.float32) + 0.1)
+        b = np.random.rand(4, 3).astype(np.float32)
+        b /= b.sum(-1, keepdims=True)
+        got = F.kl_div(_t(a), _t(b), reduction="sum").numpy()
+        want = (b * (np.log(b) - a)).sum()
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    def test_loss_layers(self):
+        logits = _t(np.random.randn(4, 5).astype(np.float32))
+        labels = _t(np.asarray([0, 1, 2, 3]))
+        loss = nn.CrossEntropyLoss()(logits, labels)
+        assert loss.shape == []
+
+
+class TestAttention:
+    def test_sdpa_oracle(self):
+        b, s, h, d = 2, 8, 2, 4
+        q = np.random.randn(b, s, h, d).astype(np.float32)
+        k = np.random.randn(b, s, h, d).astype(np.float32)
+        v = np.random.randn(b, s, h, d).astype(np.float32)
+        got = F.scaled_dot_product_attention(_t(q), _t(k), _t(v)).numpy()
+        # oracle
+        qh = q.transpose(0, 2, 1, 3)
+        kh = k.transpose(0, 2, 1, 3)
+        vh = v.transpose(0, 2, 1, 3)
+        scores = qh @ kh.transpose(0, 1, 3, 2) / np.sqrt(d)
+        e = np.exp(scores - scores.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        want = (p @ vh).transpose(0, 2, 1, 3)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_sdpa_causal(self):
+        b, s, h, d = 1, 4, 1, 4
+        q = np.random.randn(b, s, h, d).astype(np.float32)
+        k = np.random.randn(b, s, h, d).astype(np.float32)
+        v = np.random.randn(b, s, h, d).astype(np.float32)
+        out = F.scaled_dot_product_attention(_t(q), _t(k), _t(v),
+                                             is_causal=True).numpy()
+        # first position attends only to itself
+        np.testing.assert_allclose(out[0, 0, 0], v[0, 0, 0], rtol=1e-5)
+
+    def test_sdpa_grad_flows(self):
+        q = _t(np.random.randn(1, 4, 2, 4).astype(np.float32), sg=False)
+        k = _t(np.random.randn(1, 4, 2, 4).astype(np.float32), sg=False)
+        v = _t(np.random.randn(1, 4, 2, 4).astype(np.float32), sg=False)
+        F.scaled_dot_product_attention(q, k, v).sum().backward()
+        assert q.grad is not None and k.grad is not None and v.grad is not None
+
+
+class TestGradThroughLayers:
+    def test_linear_grad(self):
+        net = nn.Linear(3, 2)
+        x = _t(np.random.randn(4, 3).astype(np.float32))
+        loss = net(x).sum()
+        loss.backward()
+        assert net.weight.grad is not None
+        np.testing.assert_allclose(net.bias.grad.numpy(), [4, 4], rtol=1e-5)
+
+    def test_conv_bn_grad(self):
+        net = nn.Sequential(nn.Conv2D(1, 2, 3, padding=1), nn.BatchNorm2D(2),
+                            nn.ReLU())
+        x = _t(np.random.randn(2, 1, 4, 4).astype(np.float32))
+        net(x).sum().backward()
+        for p in net.parameters():
+            assert p.grad is not None, p.name

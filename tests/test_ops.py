@@ -1,0 +1,308 @@
+"""Op coverage vs NumPy oracle (reference test strategy: OpTest, SURVEY §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _t(a, sg=True):
+    return paddle.to_tensor(np.asarray(a), stop_gradient=sg)
+
+
+class TestMathOps:
+    def test_unary_oracle(self):
+        x = np.random.rand(3, 4).astype(np.float32) + 0.5
+        cases = {
+            "exp": np.exp, "log": np.log, "sqrt": np.sqrt,
+            "abs": np.abs, "sin": np.sin, "cos": np.cos, "tanh": np.tanh,
+            "floor": np.floor, "ceil": np.ceil, "square": np.square,
+            "sign": np.sign, "log1p": np.log1p, "expm1": np.expm1,
+        }
+        for name, np_fn in cases.items():
+            got = getattr(paddle, name)(_t(x)).numpy()
+            np.testing.assert_allclose(got, np_fn(x), rtol=1e-5, atol=1e-6,
+                                       err_msg=name)
+
+    def test_binary_oracle(self):
+        a = np.random.rand(3, 4).astype(np.float32) + 0.5
+        b = np.random.rand(3, 4).astype(np.float32) + 0.5
+        cases = {
+            "add": np.add, "subtract": np.subtract, "multiply": np.multiply,
+            "divide": np.divide, "maximum": np.maximum, "minimum": np.minimum,
+            "pow": np.power, "atan2": np.arctan2,
+        }
+        for name, np_fn in cases.items():
+            got = getattr(paddle, name)(_t(a), _t(b)).numpy()
+            np.testing.assert_allclose(got, np_fn(a, b), rtol=1e-5,
+                                       err_msg=name)
+
+    def test_reductions(self):
+        x = np.random.rand(3, 4, 5).astype(np.float32)
+        np.testing.assert_allclose(paddle.sum(_t(x)).numpy(), x.sum(),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(paddle.sum(_t(x), axis=1).numpy(),
+                                   x.sum(1), rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.mean(_t(x), axis=[0, 2], keepdim=True).numpy(),
+            x.mean((0, 2), keepdims=True), rtol=1e-5)
+        np.testing.assert_allclose(paddle.max(_t(x), axis=2).numpy(), x.max(2))
+        np.testing.assert_allclose(paddle.prod(_t(x), axis=0).numpy(),
+                                   x.prod(0), rtol=1e-4)
+        np.testing.assert_allclose(paddle.std(_t(x)).numpy(), x.std(ddof=1),
+                                   rtol=1e-4)
+        np.testing.assert_allclose(paddle.logsumexp(_t(x), axis=1).numpy(),
+                                   np.log(np.exp(x).sum(1)), rtol=1e-5)
+
+    def test_cumsum_cumprod(self):
+        x = np.random.rand(3, 4).astype(np.float32)
+        np.testing.assert_allclose(paddle.cumsum(_t(x), axis=1).numpy(),
+                                   np.cumsum(x, 1), rtol=1e-5)
+        np.testing.assert_allclose(paddle.cumprod(_t(x), dim=0).numpy(),
+                                   np.cumprod(x, 0), rtol=1e-5)
+
+    def test_cummax(self):
+        x = np.asarray([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]], np.float32)
+        v, i = paddle.cummax(_t(x), axis=1)
+        np.testing.assert_allclose(v.numpy(), np.maximum.accumulate(x, 1))
+        np.testing.assert_array_equal(i.numpy(), [[0, 0, 0], [0, 1, 1]])
+
+    def test_clip_scale(self):
+        x = np.asarray([-2.0, 0.5, 3.0], np.float32)
+        np.testing.assert_allclose(paddle.clip(_t(x), -1, 1).numpy(),
+                                   np.clip(x, -1, 1))
+        np.testing.assert_allclose(paddle.scale(_t(x), 2.0, 1.0).numpy(),
+                                   x * 2 + 1)
+
+    def test_add_n(self):
+        xs = [np.random.rand(2, 2).astype(np.float32) for _ in range(3)]
+        got = paddle.add_n([_t(x) for x in xs]).numpy()
+        np.testing.assert_allclose(got, sum(xs), rtol=1e-6)
+
+
+class TestManipulation:
+    def test_reshape_transpose(self):
+        x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        assert paddle.reshape(_t(x), [6, 4]).shape == [6, 4]
+        assert paddle.transpose(_t(x), [2, 0, 1]).shape == [4, 2, 3]
+        assert paddle.flatten(_t(x), 1).shape == [2, 12]
+        assert paddle.squeeze(_t(x[None]), axis=0).shape == [2, 3, 4]
+        assert paddle.unsqueeze(_t(x), [0, 2]).shape == [1, 2, 1, 3, 4]
+
+    def test_concat_split_stack(self):
+        a = np.ones((2, 3), np.float32)
+        b = np.zeros((2, 3), np.float32)
+        c = paddle.concat([_t(a), _t(b)], axis=0)
+        assert c.shape == [4, 3]
+        s = paddle.split(c, 2, axis=0)
+        assert len(s) == 2 and s[0].shape == [2, 3]
+        st = paddle.stack([_t(a), _t(b)], axis=1)
+        assert st.shape == [2, 2, 3]
+        parts = paddle.split(_t(np.arange(10, dtype=np.float32)), [3, 7])
+        assert parts[0].shape == [3] and parts[1].shape == [7]
+        parts = paddle.split(_t(np.arange(10, dtype=np.float32)), [3, -1])
+        assert parts[1].shape == [7]
+
+    def test_gather_scatter(self):
+        x = np.arange(12, dtype=np.float32).reshape(4, 3)
+        idx = np.asarray([0, 2])
+        np.testing.assert_allclose(paddle.gather(_t(x), _t(idx)).numpy(),
+                                   x[idx])
+        upd = np.full((2, 3), 9.0, np.float32)
+        got = paddle.scatter(_t(x), _t(idx), _t(upd)).numpy()
+        want = x.copy()
+        want[idx] = 9.0
+        np.testing.assert_allclose(got, want)
+
+    def test_gather_nd(self):
+        x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        idx = np.asarray([[0, 1], [1, 2]])
+        np.testing.assert_allclose(paddle.gather_nd(_t(x), _t(idx)).numpy(),
+                                   x[[0, 1], [1, 2]])
+
+    def test_tile_expand(self):
+        x = np.asarray([[1.0, 2.0]], np.float32)
+        assert paddle.tile(_t(x), [2, 3]).shape == [2, 6]
+        assert paddle.expand(_t(x), [4, 2]).shape == [4, 2]
+        assert paddle.broadcast_to(_t(x), [3, 2]).shape == [3, 2]
+
+    def test_masked_ops(self):
+        x = np.asarray([1.0, -2.0, 3.0], np.float32)
+        mask = x > 0
+        np.testing.assert_allclose(
+            paddle.masked_select(_t(x), _t(mask)).numpy(), [1, 3])
+        np.testing.assert_allclose(
+            paddle.masked_fill(_t(x), _t(mask), 0.0).numpy(), [0, -2, 0])
+
+    def test_take_along_put_along(self):
+        x = np.random.rand(3, 4).astype(np.float32)
+        idx = np.argsort(x, axis=1)
+        np.testing.assert_allclose(
+            paddle.take_along_axis(_t(x), _t(idx), 1).numpy(),
+            np.take_along_axis(x, idx, 1))
+
+    def test_unique(self):
+        x = np.asarray([3, 1, 2, 1, 3], np.int64)
+        u = paddle.unique(_t(x))
+        np.testing.assert_array_equal(u.numpy(), [1, 2, 3])
+        u, inv, counts = paddle.unique(_t(x), return_inverse=True,
+                                       return_counts=True)
+        np.testing.assert_array_equal(counts.numpy(), [2, 1, 2])
+
+    def test_flip_roll(self):
+        x = np.arange(6, dtype=np.float32).reshape(2, 3)
+        np.testing.assert_allclose(paddle.flip(_t(x), axis=1).numpy(),
+                                   x[:, ::-1])
+        np.testing.assert_allclose(paddle.roll(_t(x), 1, axis=1).numpy(),
+                                   np.roll(x, 1, 1))
+
+
+class TestLinalg:
+    def test_matmul_variants(self):
+        a = np.random.rand(2, 3, 4).astype(np.float32)
+        b = np.random.rand(2, 4, 5).astype(np.float32)
+        np.testing.assert_allclose(paddle.matmul(_t(a), _t(b)).numpy(),
+                                   a @ b, rtol=1e-5)
+        np.testing.assert_allclose(paddle.bmm(_t(a), _t(b)).numpy(), a @ b,
+                                   rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.matmul(_t(a), _t(b.transpose(0, 2, 1)),
+                          transpose_y=True).numpy(),
+            a @ b, rtol=1e-5)
+
+    def test_norm(self):
+        x = np.random.rand(3, 4).astype(np.float32)
+        np.testing.assert_allclose(paddle.norm(_t(x)).numpy(),
+                                   np.linalg.norm(x), rtol=1e-5)
+        np.testing.assert_allclose(paddle.norm(_t(x), p=1, axis=1).numpy(),
+                                   np.abs(x).sum(1), rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.norm(_t(x), p=np.inf, axis=0).numpy(),
+            np.abs(x).max(0), rtol=1e-5)
+
+    def test_decompositions(self):
+        a = np.random.rand(4, 4).astype(np.float32)
+        spd = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+        l = paddle.cholesky(_t(spd)).numpy()
+        np.testing.assert_allclose(l @ l.T, spd, rtol=1e-4, atol=1e-4)
+        q, r = paddle.qr(_t(a))
+        np.testing.assert_allclose(q.numpy() @ r.numpy(), a, rtol=1e-4,
+                                   atol=1e-4)
+        u, s, vt = paddle.svd(_t(a))
+        np.testing.assert_allclose(
+            (u.numpy() * s.numpy()) @ vt.numpy(), a, rtol=1e-3, atol=1e-4)
+        np.testing.assert_allclose(paddle.det(_t(spd)).numpy(),
+                                   np.linalg.det(spd), rtol=1e-3)
+        inv = paddle.inverse(_t(spd)).numpy()
+        np.testing.assert_allclose(inv @ spd, np.eye(4), rtol=1e-3, atol=1e-3)
+
+    def test_solve(self):
+        a = np.random.rand(3, 3).astype(np.float32) + 3 * np.eye(3,
+                                                                 dtype=np.float32)
+        b = np.random.rand(3, 2).astype(np.float32)
+        x = paddle.solve(_t(a), _t(b)).numpy()
+        np.testing.assert_allclose(a @ x, b, rtol=1e-3, atol=1e-4)
+
+    def test_einsum(self):
+        a = np.random.rand(2, 3).astype(np.float32)
+        b = np.random.rand(3, 4).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.einsum("ij,jk->ik", _t(a), _t(b)).numpy(), a @ b,
+            rtol=1e-5)
+
+
+class TestSearchSort:
+    def test_argmax_sort_topk(self):
+        x = np.asarray([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]], np.float32)
+        np.testing.assert_array_equal(paddle.argmax(_t(x), axis=1).numpy(),
+                                      [0, 1])
+        np.testing.assert_allclose(paddle.sort(_t(x), axis=1).numpy(),
+                                   np.sort(x, 1))
+        np.testing.assert_array_equal(paddle.argsort(_t(x), axis=1).numpy(),
+                                      np.argsort(x, 1))
+        v, i = paddle.topk(_t(x), 2, axis=1)
+        np.testing.assert_allclose(v.numpy(), [[3, 2], [5, 4]])
+
+    def test_where_nonzero(self):
+        x = np.asarray([1.0, -1.0, 2.0], np.float32)
+        np.testing.assert_allclose(
+            paddle.where(_t(x > 0), _t(x), _t(-x)).numpy(), np.abs(x))
+        nz = paddle.nonzero(_t(x > 0))
+        np.testing.assert_array_equal(nz.numpy(), [[0], [2]])
+
+    def test_searchsorted(self):
+        s = np.asarray([1.0, 3.0, 5.0, 7.0], np.float32)
+        v = np.asarray([2.0, 6.0], np.float32)
+        np.testing.assert_array_equal(
+            paddle.searchsorted(_t(s), _t(v)).numpy(),
+            np.searchsorted(s, v))
+
+    def test_kthvalue_median(self):
+        x = np.asarray([[3.0, 1.0, 2.0]], np.float32)
+        v, i = paddle.kthvalue(_t(x), 2, axis=1)
+        assert v.numpy()[0] == 2.0
+        np.testing.assert_allclose(paddle.median(_t(x), axis=1).numpy(), [2.0])
+
+
+class TestLogic:
+    def test_logical_bitwise(self):
+        a = np.asarray([True, False, True])
+        b = np.asarray([True, True, False])
+        np.testing.assert_array_equal(
+            paddle.logical_and(_t(a), _t(b)).numpy(), a & b)
+        np.testing.assert_array_equal(paddle.logical_not(_t(a)).numpy(), ~a)
+        x = np.asarray([1, 2, 3], np.int32)
+        np.testing.assert_array_equal(
+            paddle.bitwise_and(_t(x), _t(x)).numpy(), x)
+
+    def test_allclose_isclose(self):
+        a = np.asarray([1.0, 2.0], np.float32)
+        assert bool(paddle.allclose(_t(a), _t(a + 1e-9)).numpy())
+        assert not bool(paddle.allclose(_t(a), _t(a + 1.0)).numpy())
+        assert bool(paddle.equal_all(_t(a), _t(a)).numpy())
+
+    def test_any_all(self):
+        x = np.asarray([[True, False], [True, True]])
+        np.testing.assert_array_equal(paddle.any(_t(x), axis=1).numpy(),
+                                      [True, True])
+        np.testing.assert_array_equal(paddle.all(_t(x), axis=1).numpy(),
+                                      [False, True])
+
+
+class TestRandom:
+    def test_shapes_and_ranges(self):
+        r = paddle.rand([3, 4])
+        assert r.shape == [3, 4]
+        assert (r.numpy() >= 0).all() and (r.numpy() < 1).all()
+        n = paddle.randn([100])
+        assert abs(float(n.mean())) < 0.5
+        ri = paddle.randint(0, 10, [50])
+        assert (ri.numpy() >= 0).all() and (ri.numpy() < 10).all()
+        perm = paddle.randperm(10)
+        np.testing.assert_array_equal(np.sort(perm.numpy()), np.arange(10))
+
+    def test_seed_reproducible(self):
+        paddle.seed(7)
+        a = paddle.rand([4]).numpy()
+        paddle.seed(7)
+        b = paddle.rand([4]).numpy()
+        np.testing.assert_array_equal(a, b)
+
+    def test_grad_flows_through_ops(self):
+        """Spot-check grads of assorted ops vs jax.grad oracle."""
+        import jax
+        import jax.numpy as jnp
+        x_np = np.random.rand(3, 4).astype(np.float32) + 0.1
+
+        import paddle_tpu.nn.functional as F
+        x = _t(x_np, sg=False)
+        y = paddle.sum(F.softmax(paddle.log(x), axis=1)
+                       * paddle.sigmoid(x))
+        y.backward()
+        ours = x.grad.numpy()
+
+        def f(a):
+            return jnp.sum(jax.nn.softmax(jnp.log(a), axis=1)
+                           * jax.nn.sigmoid(a))
+        want = jax.grad(f)(jnp.asarray(x_np))
+        np.testing.assert_allclose(ours, np.asarray(want), rtol=1e-4,
+                                   atol=1e-5)

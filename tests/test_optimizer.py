@@ -1,0 +1,134 @@
+"""Optimizers: update-rule oracles + convergence + schedulers."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import optimizer as opt
+
+
+def _quad_problem():
+    """Minimize ||Wx - y||^2 for fixed x, y."""
+    w = paddle.Parameter(np.full((2, 2), 0.5, np.float32))
+    x = paddle.to_tensor(np.asarray([[1.0, 0.5], [0.3, 2.0]], np.float32))
+    y = paddle.to_tensor(np.asarray([[1.0, 2.0], [3.0, 4.0]], np.float32))
+
+    def loss_fn():
+        return ((paddle.matmul(w, x) - y) ** 2).sum()
+    return w, loss_fn
+
+
+@pytest.mark.parametrize("cls,kwargs", [
+    (opt.SGD, dict(learning_rate=0.05)),
+    (opt.Momentum, dict(learning_rate=0.02, momentum=0.9)),
+    (opt.Adam, dict(learning_rate=0.1)),
+    (opt.AdamW, dict(learning_rate=0.1, weight_decay=0.0)),
+    (opt.RMSProp, dict(learning_rate=0.02)),
+    (opt.Adagrad, dict(learning_rate=0.3)),
+    (opt.Adamax, dict(learning_rate=0.2)),
+    (opt.Adadelta, dict(learning_rate=50.0)),
+    (opt.Lamb, dict(learning_rate=0.06, lamb_weight_decay=0.0)),
+])
+def test_optimizer_converges(cls, kwargs):
+    w, loss_fn = _quad_problem()
+    o = cls(parameters=[w], **kwargs)
+    first = float(loss_fn().numpy())
+    for _ in range(60):
+        loss = loss_fn()
+        loss.backward()
+        o.step()
+        o.clear_grad()
+    assert float(loss_fn().numpy()) < first * 0.1, cls.__name__
+
+
+def test_sgd_exact_update():
+    w = paddle.Parameter(np.asarray([1.0, 2.0], np.float32))
+    o = opt.SGD(learning_rate=0.1, parameters=[w])
+    (w * paddle.to_tensor([2.0, 4.0])).sum().backward()
+    o.step()
+    np.testing.assert_allclose(w.numpy(), [1.0 - 0.2, 2.0 - 0.4], rtol=1e-6)
+
+
+def test_adam_exact_first_step():
+    w = paddle.Parameter(np.asarray([1.0], np.float32))
+    o = opt.Adam(learning_rate=0.1, parameters=[w])
+    (w * 3.0).sum().backward()
+    o.step()
+    # first adam step moves by ~lr regardless of grad scale
+    np.testing.assert_allclose(w.numpy(), [1.0 - 0.1], rtol=1e-4)
+
+
+def test_adamw_decoupled_decay():
+    w = paddle.Parameter(np.asarray([1.0], np.float32))
+    o = opt.AdamW(learning_rate=0.1, parameters=[w], weight_decay=0.5)
+    (w * 0.0).sum().backward()
+    o.step()
+    # zero grad -> only decay: w *= (1 - lr*wd)
+    np.testing.assert_allclose(w.numpy(), [1.0 * (1 - 0.05)], rtol=1e-5)
+
+
+def test_grad_clip_global_norm():
+    w1 = paddle.Parameter(np.asarray([3.0], np.float32))
+    w2 = paddle.Parameter(np.asarray([4.0], np.float32))
+    clip = opt.ClipGradByGlobalNorm(1.0)
+    o = opt.SGD(learning_rate=1.0, parameters=[w1, w2], grad_clip=clip)
+    (w1 * 3.0 + w2 * 4.0).sum().backward()  # grads 3, 4 -> norm 5
+    o.step()
+    np.testing.assert_allclose(w1.numpy(), [3.0 - 3.0 / 5], rtol=1e-5)
+    np.testing.assert_allclose(w2.numpy(), [4.0 - 4.0 / 5], rtol=1e-5)
+
+
+def test_optimizer_state_dict_roundtrip():
+    w, loss_fn = _quad_problem()
+    o = opt.Adam(learning_rate=0.1, parameters=[w])
+    loss_fn().backward()
+    o.step()
+    sd = o.state_dict()
+    w2, _ = _quad_problem()
+    o2 = opt.Adam(learning_rate=0.1, parameters=[w2])
+    o2.set_state_dict(sd)
+    assert o2._global_step == 1
+    acc = o2._accumulators[id(w2)]
+    np.testing.assert_allclose(np.asarray(acc["moment1"]),
+                               np.asarray(o._accumulators[id(w)]["moment1"]))
+
+
+class TestLRSchedulers:
+    def test_step_decay(self):
+        s = opt.lr.StepDecay(learning_rate=1.0, step_size=2, gamma=0.5)
+        lrs = []
+        for _ in range(5):
+            lrs.append(s())
+            s.step()
+        np.testing.assert_allclose(lrs, [1.0, 1.0, 0.5, 0.5, 0.25])
+
+    def test_cosine(self):
+        s = opt.lr.CosineAnnealingDecay(learning_rate=1.0, T_max=10)
+        assert abs(s() - 1.0) < 1e-6
+        for _ in range(10):
+            s.step()
+        assert s() < 1e-6
+
+    def test_linear_warmup(self):
+        s = opt.lr.LinearWarmup(learning_rate=1.0, warmup_steps=4,
+                                start_lr=0.0, end_lr=1.0)
+        vals = []
+        for _ in range(6):
+            vals.append(s())
+            s.step()
+        np.testing.assert_allclose(vals[:4], [0.0, 0.25, 0.5, 0.75])
+        assert vals[4] == 1.0
+
+    def test_scheduler_drives_optimizer(self):
+        sched = opt.lr.StepDecay(learning_rate=0.1, step_size=1, gamma=0.1)
+        w = paddle.Parameter(np.asarray([1.0], np.float32))
+        o = opt.SGD(learning_rate=sched, parameters=[w])
+        assert o.get_lr() == 0.1
+        sched.step()
+        assert abs(o.get_lr() - 0.01) < 1e-9
+
+    def test_reduce_on_plateau(self):
+        s = opt.lr.ReduceOnPlateau(learning_rate=1.0, patience=1, factor=0.1)
+        for loss in [1.0, 1.0, 1.0, 1.0]:
+            s.step(loss)
+        assert s() < 1.0

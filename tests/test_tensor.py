@@ -1,0 +1,122 @@
+"""Tensor basics: creation, metadata, conversion, dunders, indexing."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_basic():
+    t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert t.shape == [2, 2]
+    assert t.dtype == paddle.float32
+    np.testing.assert_allclose(t.numpy(), [[1, 2], [3, 4]])
+
+
+def test_to_tensor_dtype_inference():
+    assert paddle.to_tensor([1, 2]).dtype == np.dtype("int64") or \
+        paddle.to_tensor([1, 2]).dtype == np.dtype("int32")
+    assert paddle.to_tensor(1.5).dtype == paddle.float32
+    assert paddle.to_tensor([True]).dtype == np.dtype("bool")
+
+
+def test_creation_ops():
+    assert paddle.zeros([2, 3]).shape == [2, 3]
+    assert paddle.ones([4]).numpy().sum() == 4
+    np.testing.assert_allclose(paddle.full([2], 7.0).numpy(), [7, 7])
+    np.testing.assert_array_equal(paddle.arange(5).numpy(), np.arange(5))
+    np.testing.assert_allclose(paddle.eye(3).numpy(), np.eye(3))
+    np.testing.assert_allclose(
+        paddle.linspace(0, 1, 5).numpy(), np.linspace(0, 1, 5), rtol=1e-6)
+
+
+def test_arithmetic_dunders():
+    a = paddle.to_tensor([1.0, 2.0, 3.0])
+    b = paddle.to_tensor([4.0, 5.0, 6.0])
+    np.testing.assert_allclose((a + b).numpy(), [5, 7, 9])
+    np.testing.assert_allclose((a - b).numpy(), [-3, -3, -3])
+    np.testing.assert_allclose((a * b).numpy(), [4, 10, 18])
+    np.testing.assert_allclose((b / a).numpy(), [4, 2.5, 2], rtol=1e-6)
+    np.testing.assert_allclose((a ** 2).numpy(), [1, 4, 9])
+    np.testing.assert_allclose((-a).numpy(), [-1, -2, -3])
+    np.testing.assert_allclose((2.0 + a).numpy(), [3, 4, 5])
+    np.testing.assert_allclose((2.0 - a).numpy(), [1, 0, -1])
+    np.testing.assert_allclose((6.0 / a).numpy(), [6, 3, 2], rtol=1e-6)
+
+
+def test_comparison_dunders():
+    a = paddle.to_tensor([1.0, 2.0, 3.0])
+    np.testing.assert_array_equal((a > 2).numpy(), [False, False, True])
+    np.testing.assert_array_equal((a == 2).numpy(), [False, True, False])
+    np.testing.assert_array_equal((a <= 2).numpy(), [True, True, False])
+
+
+def test_matmul_dunder():
+    a = paddle.to_tensor(np.ones((2, 3), np.float32))
+    b = paddle.to_tensor(np.ones((3, 4), np.float32))
+    assert (a @ b).shape == [2, 4]
+
+
+def test_item_and_scalar_conversion():
+    t = paddle.to_tensor(3.5)
+    assert t.item() == 3.5
+    assert float(t) == 3.5
+    assert int(paddle.to_tensor(7)) == 7
+    assert bool(paddle.to_tensor(True))
+
+
+def test_astype_cast():
+    t = paddle.to_tensor([1.5, 2.5])
+    assert t.astype("int32").dtype == np.dtype("int32")
+    assert t.astype(paddle.float16).dtype == np.dtype("float16")
+    assert paddle.cast(t, "int64").dtype in (np.dtype("int64"),
+                                             np.dtype("int32"))
+
+
+def test_getitem_setitem():
+    t = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+    np.testing.assert_allclose(t[1].numpy(), [4, 5, 6, 7])
+    np.testing.assert_allclose(t[:, 1].numpy(), [1, 5, 9])
+    np.testing.assert_allclose(t[1:3, 2:].numpy(), [[6, 7], [10, 11]])
+    t[0] = 0.0
+    np.testing.assert_allclose(t[0].numpy(), [0, 0, 0, 0])
+    t[1, 1] = 99.0
+    assert t.numpy()[1, 1] == 99.0
+
+
+def test_bool_mask_getitem():
+    t = paddle.to_tensor([1.0, 2.0, 3.0, 4.0])
+    mask = t > 2
+    np.testing.assert_allclose(t[mask].numpy(), [3, 4])
+
+
+def test_tensor_methods_attached():
+    t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert t.sum().item() == 10.0
+    assert t.mean().item() == 2.5
+    assert t.reshape([4]).shape == [4]
+    assert t.transpose([1, 0]).shape == [2, 2]
+    assert t.max().item() == 4.0
+    np.testing.assert_allclose(t.T.numpy(), t.numpy().T)
+
+
+def test_inplace_ops():
+    t = paddle.to_tensor([1.0, -2.0, 3.0])
+    t.clip_(min=0.0)
+    np.testing.assert_allclose(t.numpy(), [1, 0, 3])
+    t.scale_(2.0)
+    np.testing.assert_allclose(t.numpy(), [2, 0, 6])
+
+
+def test_detach_and_clone():
+    t = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    d = t.detach()
+    assert d.stop_gradient
+    c = t.clone()
+    assert not c.stop_gradient
+    np.testing.assert_allclose(c.numpy(), t.numpy())
+
+
+def test_parameter():
+    p = paddle.Parameter(np.ones((2, 2), np.float32))
+    assert not p.stop_gradient
+    assert p.trainable
