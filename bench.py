@@ -1,0 +1,100 @@
+"""Benchmark: Llama causal-LM training throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+value = tokens/sec/chip for a compiled fwd+bwd+AdamW step (bf16 params,
+fp32 moments — the mixed-precision recipe of the reference's AMP O2 path).
+vs_baseline = MFU / 0.50 (fraction of the north-star 50% MFU target from
+BASELINE.md; the reference publishes no in-tree numbers to compare against).
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def peak_flops_per_chip(device) -> float:
+    kind = getattr(device, "device_kind", "").lower()
+    table = {
+        "v5 lite": 197e12, "v5e": 197e12, "v5litepod": 197e12,
+        "v5p": 459e12, "v5": 459e12,
+        "v4": 275e12,
+        "v6": 918e12, "trillium": 918e12,
+        "cpu": 1e12,  # nominal, debug only
+    }
+    for k, v in table.items():
+        if k in kind:
+            return v
+    return 197e12
+
+
+def main():
+    debug = "--debug" in sys.argv
+    if debug:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.parallel import SpmdTrainer
+
+    paddle.seed(0)
+    dev = jax.devices()[0]
+    if debug:
+        cfg = LlamaConfig.tiny(vocab_size=256, hidden_size=64, layers=2,
+                               heads=4, kv_heads=2, seq=128)
+        batch, seq, steps, warmup = 2, 128, 4, 1
+    else:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
+                          intermediate_size=2816, num_hidden_layers=16,
+                          num_attention_heads=16, num_key_value_heads=16,
+                          max_position_embeddings=2048)
+        batch, seq, steps, warmup = 8, 2048, 10, 2
+
+    model = LlamaForCausalLM(cfg)
+    model.bfloat16()  # bf16 params, fp32 optimizer moments (AMP O2 recipe)
+    optimizer = opt.AdamW(learning_rate=1e-4, parameters=model.parameters())
+
+    def loss_fn(m, input_ids, labels):
+        return m.compute_loss(m(input_ids), labels)
+
+    trainer = SpmdTrainer(model, optimizer, loss_fn, mesh=None,
+                          remat_layers=None)
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(rng.integers(0, cfg.vocab_size,
+                                        (batch, seq)).astype(np.int32))
+    for _ in range(warmup):
+        trainer.train_step(ids, ids)
+    trainer.block()
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = trainer.train_step(ids, ids)
+    trainer.block()
+    dt = time.perf_counter() - t0
+
+    tokens = batch * seq * steps
+    tps = tokens / dt
+    flops_tok = model.flops_per_token(seq)
+    mfu = tps * flops_tok / peak_flops_per_chip(dev)
+    result = {
+        "metric": "llama_train_tokens_per_sec_per_chip",
+        "value": round(tps, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.50, 4),
+        "extra": {
+            "mfu": round(mfu, 4),
+            "loss": round(float(loss.numpy()), 4),
+            "params": model.num_params(),
+            "batch": batch, "seq": seq,
+            "device": getattr(dev, "device_kind", str(dev)),
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

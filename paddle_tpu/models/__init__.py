@@ -1,0 +1,3 @@
+"""Flagship model families (parity targets from BASELINE.json configs)."""
+from . import llama  # noqa: F401
+from .llama import LlamaConfig, LlamaForCausalLM, LlamaModel  # noqa: F401

@@ -1,0 +1,236 @@
+"""Llama-2 model family (flagship; BASELINE.json config #2).
+
+Reference parity: the PaddleNLP llama modeling stack the reference's fleet
+hybrid-parallel trains (fused rope / rms_norm / flash attention kernels named
+in phi/kernels/fusion/gpu). TPU-native: built from fleet TP layers whose
+parameters carry mp-axis sharding annotations; under the SPMD trainer, GSPMD
+partitions attention/MLP the Megatron way (column→row) with collectives on ICI.
+Flash attention lowers to the Pallas kernel on TPU.
+
+Weight layout matches paddle Linear ([in, out]) so checkpoints map over.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..distributed.fleet.meta_parallel import (ColumnParallelLinear,
+                                               RowParallelLinear,
+                                               VocabParallelEmbedding)
+from ..nn import functional as F
+from ..ops.dispatch import dispatch, ensure_tensor
+from ..tensor import Tensor
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: Optional[int] = None  # GQA; None = MHA
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    use_flash_attention: bool = True
+    dtype: str = "float32"
+
+    @staticmethod
+    def llama2_7b():
+        return LlamaConfig()
+
+    @staticmethod
+    def llama2_13b():
+        return LlamaConfig(hidden_size=5120, intermediate_size=13824,
+                           num_hidden_layers=40, num_attention_heads=40)
+
+    @staticmethod
+    def tiny(vocab_size=256, hidden_size=64, layers=2, heads=4, kv_heads=2,
+             seq=128):
+        return LlamaConfig(vocab_size=vocab_size, hidden_size=hidden_size,
+                           intermediate_size=hidden_size * 2,
+                           num_hidden_layers=layers, num_attention_heads=heads,
+                           num_key_value_heads=kv_heads,
+                           max_position_embeddings=seq)
+
+
+def build_rope_cache(seq_len: int, head_dim: int, theta: float = 10000.0,
+                     dtype=jnp.float32):
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32)
+                                / head_dim))
+    t = jnp.arange(seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)  # [seq, hd/2]
+    return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
+
+
+def apply_rope(q, k, cos, sin):
+    """Rotate pairs (parity: fused_rope_kernel.cu:27 FusedRopeKernel semantics,
+    NeoX/llama style half-rotation). q,k: [b, s, h, d]."""
+    def rotate(x):
+        x1 = x[..., 0::2]
+        x2 = x[..., 1::2]
+        c = cos[None, :, None, :]
+        s = sin[None, :, None, :]
+        ro1 = x1 * c - x2 * s
+        ro2 = x2 * c + x1 * s
+        out = jnp.stack([ro1, ro2], axis=-1)
+        return out.reshape(x.shape)
+    return rotate(q), rotate(k)
+
+
+def fused_rope(query, key, cos, sin):
+    """Tensor-level rope (recorded as one tape op)."""
+    cos_a = cos._data if isinstance(cos, Tensor) else cos
+    sin_a = sin._data if isinstance(sin, Tensor) else sin
+    return dispatch("fused_rope",
+                    lambda q, k: apply_rope(q, k, cos_a, sin_a),
+                    ensure_tensor(query), ensure_tensor(key))
+
+
+class LlamaAttention(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.hidden_size = config.hidden_size
+        self.num_heads = config.num_attention_heads
+        self.num_kv_heads = config.num_key_value_heads or self.num_heads
+        self.head_dim = self.hidden_size // self.num_heads
+        self.q_proj = ColumnParallelLinear(self.hidden_size,
+                                           self.num_heads * self.head_dim,
+                                           has_bias=False)
+        self.k_proj = ColumnParallelLinear(self.hidden_size,
+                                           self.num_kv_heads * self.head_dim,
+                                           has_bias=False)
+        self.v_proj = ColumnParallelLinear(self.hidden_size,
+                                           self.num_kv_heads * self.head_dim,
+                                           has_bias=False)
+        self.o_proj = RowParallelLinear(self.num_heads * self.head_dim,
+                                        self.hidden_size, has_bias=False)
+
+    def forward(self, hidden_states, rope_cache, attention_mask=None):
+        b, s, _ = hidden_states.shape
+        q = self.q_proj(hidden_states).reshape([b, s, self.num_heads,
+                                                self.head_dim])
+        k = self.k_proj(hidden_states).reshape([b, s, self.num_kv_heads,
+                                                self.head_dim])
+        v = self.v_proj(hidden_states).reshape([b, s, self.num_kv_heads,
+                                                self.head_dim])
+        cos, sin = rope_cache
+        q, k = fused_rope(q, k, cos, sin)
+        if self.num_kv_heads != self.num_heads:
+            rep = self.num_heads // self.num_kv_heads
+            from ..ops.manipulation import repeat_interleave
+            k = repeat_interleave(k, rep, axis=2)
+            v = repeat_interleave(v, rep, axis=2)
+        out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        out = out.reshape([b, s, self.num_heads * self.head_dim])
+        return self.o_proj(out)
+
+
+class LlamaMLP(nn.Layer):
+    """SwiGLU (parity: fused_bias_act / swiglu in the reference kernel list)."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.gate_proj = ColumnParallelLinear(config.hidden_size,
+                                              config.intermediate_size,
+                                              has_bias=False)
+        self.up_proj = ColumnParallelLinear(config.hidden_size,
+                                            config.intermediate_size,
+                                            has_bias=False)
+        self.down_proj = RowParallelLinear(config.intermediate_size,
+                                           config.hidden_size, has_bias=False)
+
+    def forward(self, x):
+        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+
+
+class LlamaDecoderLayer(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.self_attn = LlamaAttention(config)
+        self.mlp = LlamaMLP(config)
+        self.input_layernorm = nn.RMSNorm(config.hidden_size,
+                                          epsilon=config.rms_norm_eps)
+        self.post_attention_layernorm = nn.RMSNorm(config.hidden_size,
+                                                   epsilon=config.rms_norm_eps)
+
+    def forward(self, hidden_states, rope_cache, attention_mask=None):
+        residual = hidden_states
+        h = self.input_layernorm(hidden_states)
+        h = self.self_attn(h, rope_cache, attention_mask)
+        h = residual + h
+        residual = h
+        h2 = self.post_attention_layernorm(h)
+        h2 = self.mlp(h2)
+        return residual + h2
+
+
+class LlamaModel(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = VocabParallelEmbedding(config.vocab_size,
+                                                   config.hidden_size)
+        self.layers = nn.LayerList(
+            [LlamaDecoderLayer(config) for _ in range(config.num_hidden_layers)])
+        self.norm = nn.RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+        head_dim = config.hidden_size // config.num_attention_heads
+        cos, sin = build_rope_cache(config.max_position_embeddings, head_dim,
+                                    config.rope_theta)
+        self.register_buffer("rope_cos", Tensor(cos), persistable=False)
+        self.register_buffer("rope_sin", Tensor(sin), persistable=False)
+
+    def forward(self, input_ids, attention_mask=None):
+        h = self.embed_tokens(input_ids)
+        s = input_ids.shape[1]
+        cos = Tensor(self.rope_cos._data[:s])
+        sin = Tensor(self.rope_sin._data[:s])
+        for layer in self.layers:
+            h = layer(h, (cos, sin), attention_mask)
+        return self.norm(h)
+
+
+class LlamaForCausalLM(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.model = LlamaModel(config)
+        if config.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = ColumnParallelLinear(config.hidden_size,
+                                                config.vocab_size,
+                                                has_bias=False)
+
+    def forward(self, input_ids, attention_mask=None):
+        h = self.model(input_ids, attention_mask)
+        if self.lm_head is None:
+            from ..ops.linalg import matmul
+            return matmul(h, self.model.embed_tokens.weight, transpose_y=True)
+        return self.lm_head(h)
+
+    def compute_loss(self, logits, labels):
+        """Shifted next-token cross entropy."""
+        from ..ops.manipulation import reshape
+        b, s, v = logits.shape
+        shift_logits = logits[:, :-1, :]
+        shift_labels = labels[:, 1:]
+        return F.cross_entropy(reshape(shift_logits, [b * (s - 1), v]),
+                               reshape(shift_labels, [b * (s - 1)]))
+
+    def num_params(self):
+        return sum(p.numel() for p in self.parameters())
+
+    def flops_per_token(self, seq_len: int) -> float:
+        """Approximate training FLOPs/token (6N + attention term)."""
+        n = self.num_params()
+        c = self.config
+        attn = (12 * c.num_hidden_layers * c.hidden_size * seq_len) / 2
+        return 6.0 * n + 6.0 * attn

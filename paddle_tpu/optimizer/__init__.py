@@ -23,6 +23,12 @@ __all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adagrad",
 lr = lr_mod
 
 
+
+def _f32(v):
+    """Scalar to f32 array; works for python numbers AND jax tracers
+    (jnp.float32(tracer) would force concretization)."""
+    return jnp.asarray(v, jnp.float32)
+
 # ---- grad clipping (parity: python/paddle/nn/clip.py) ------------------------
 
 class ClipGradBase:
@@ -208,7 +214,7 @@ class SGD(Optimizer):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
 
     def _update(self, param, grad, state, lr_val, wd, step):
-        return _sgd_update(param, grad, jnp.float32(lr_val), jnp.float32(wd)), state
+        return _sgd_update(param, grad, _f32(lr_val), _f32(wd)), state
 
 
 @jax.jit
@@ -232,9 +238,9 @@ class Momentum(Optimizer):
 
     def _update(self, param, grad, state, lr_val, wd, step):
         new_p, v = _momentum_update(param, grad, state["velocity"],
-                                    jnp.float32(lr_val),
-                                    jnp.float32(self._momentum),
-                                    jnp.float32(wd), self._use_nesterov)
+                                    _f32(lr_val),
+                                    _f32(self._momentum),
+                                    _f32(wd), self._use_nesterov)
         return new_p, {"velocity": v}
 
 
@@ -270,11 +276,11 @@ class Adam(Optimizer):
 
     def _update(self, param, grad, state, lr_val, wd, step):
         new_p, m, v = _adam_update(param, grad, state["moment1"],
-                                   state["moment2"], jnp.float32(lr_val),
-                                   jnp.float32(self._beta1),
-                                   jnp.float32(self._beta2),
-                                   jnp.float32(self._epsilon),
-                                   jnp.float32(step), jnp.float32(wd),
+                                   state["moment2"], _f32(lr_val),
+                                   _f32(self._beta1),
+                                   _f32(self._beta2),
+                                   _f32(self._epsilon),
+                                   _f32(step), _f32(wd),
                                    self._decoupled_wd)
         return new_p, {"moment1": m, "moment2": v}
 
@@ -320,9 +326,9 @@ class Adagrad(Optimizer):
 
     def _update(self, param, grad, state, lr_val, wd, step):
         new_p, mom = _adagrad_update(param, grad, state["moment"],
-                                     jnp.float32(lr_val),
-                                     jnp.float32(self._epsilon),
-                                     jnp.float32(wd))
+                                     _f32(lr_val),
+                                     _f32(self._epsilon),
+                                     _f32(wd))
         return new_p, {"moment": mom}
 
 
@@ -351,9 +357,9 @@ class Adadelta(Optimizer):
         new_p, sq, up = _adadelta_update(param, grad,
                                          state["avg_squared_grad"],
                                          state["avg_squared_update"],
-                                         jnp.float32(self._rho),
-                                         jnp.float32(self._epsilon),
-                                         jnp.float32(lr_val), jnp.float32(wd))
+                                         _f32(self._rho),
+                                         _f32(self._epsilon),
+                                         _f32(lr_val), _f32(wd))
         return new_p, {"avg_squared_grad": sq, "avg_squared_update": up}
 
 
@@ -379,11 +385,11 @@ class Adamax(Optimizer):
 
     def _update(self, param, grad, state, lr_val, wd, step):
         new_p, m, inf = _adamax_update(param, grad, state["moment"],
-                                       state["inf_norm"], jnp.float32(lr_val),
-                                       jnp.float32(self._beta1),
-                                       jnp.float32(self._beta2),
-                                       jnp.float32(self._epsilon),
-                                       jnp.float32(step), jnp.float32(wd))
+                                       state["inf_norm"], _f32(lr_val),
+                                       _f32(self._beta1),
+                                       _f32(self._beta2),
+                                       _f32(self._epsilon),
+                                       _f32(step), _f32(wd))
         return new_p, {"moment": m, "inf_norm": inf}
 
 
@@ -414,9 +420,9 @@ class RMSProp(Optimizer):
     def _update(self, param, grad, state, lr_val, wd, step):
         new_p, ms, mg, mom = _rmsprop_update(
             param, grad, state["mean_square"], state["mean_grad"],
-            state["momentum"], jnp.float32(lr_val), jnp.float32(self._rho),
-            jnp.float32(self._epsilon), jnp.float32(self._momentum),
-            self._centered, jnp.float32(wd))
+            state["momentum"], _f32(lr_val), _f32(self._rho),
+            _f32(self._epsilon), _f32(self._momentum),
+            self._centered, _f32(wd))
         return new_p, {"mean_square": ms, "mean_grad": mg, "momentum": mom}
 
 
@@ -455,9 +461,9 @@ class Lamb(Optimizer):
 
     def _update(self, param, grad, state, lr_val, wd, step):
         new_p, m, v = _lamb_update(param, grad, state["moment1"],
-                                   state["moment2"], jnp.float32(lr_val),
-                                   jnp.float32(self._beta1),
-                                   jnp.float32(self._beta2),
-                                   jnp.float32(self._epsilon),
-                                   jnp.float32(step), jnp.float32(wd))
+                                   state["moment2"], _f32(lr_val),
+                                   _f32(self._beta1),
+                                   _f32(self._beta2),
+                                   _f32(self._epsilon),
+                                   _f32(step), _f32(wd))
         return new_p, {"moment1": m, "moment2": v}

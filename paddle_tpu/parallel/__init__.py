@@ -1,0 +1,8 @@
+"""paddle_tpu.parallel — compiled SPMD training over a device mesh.
+
+This is the TPU-native replacement for the reference's whole static-graph
+distributed stack (auto_parallel Engine/Completer/Partitioner/Resharder +
+PirInterpreter + CommContext, SURVEY §3.5): one jitted training step over a
+jax Mesh, with GSPMD doing sharding propagation and collective insertion.
+"""
+from .trainer import SpmdTrainer, make_hybrid_mesh  # noqa: F401

@@ -1,0 +1,274 @@
+"""SpmdTrainer: the compiled hybrid-parallel training step.
+
+Reference parity: fleet's hybrid training step (§3.3 of SURVEY — 1F1B loop,
+TP allreduces, sharded optimizer, global-norm clip across groups) and the
+auto-parallel static pipeline (Engine._prepare_program → Completer →
+Partitioner → Resharder, engine.py:1001). TPU-native design: the eager model
+code is traced ONCE into a single XLA program per step;
+
+  * TP: parameters carry mp-axis annotations (fleet TP layers) → GSPMD
+    partitions matmuls Megatron-style and inserts all-reduce/all-gather on ICI.
+  * DP + ZeRO: batch is sharded over (dp, sharding); optimizer state is
+    sharded over the sharding axis (ZeRO-1); gradient psum is inserted by the
+    compiler (global-view semantics).
+  * Remat: decoder blocks wrapped in jax.checkpoint (reference's recompute
+    pass, auto_parallel_recompute.py).
+  * The optimizer update reuses the SAME `_update` rules as the eager
+    optimizers, so eager and compiled training share numerics exactly.
+
+Buffers must be step-invariant (transformers: rope caches). BatchNorm-style
+mutable buffers require the jit.to_static path instead.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..autograd.tape import no_grad
+from ..framework.random import key_context, next_key
+from ..optimizer import (ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue,
+                         Optimizer)
+from ..tensor import Tensor
+from ..distributed.mesh import ProcessMesh
+from ..distributed.fleet.meta_parallel import get_param_annotation
+
+
+def make_hybrid_mesh(dp: int = 1, mp: int = 1, pp: int = 1, sharding: int = 1,
+                     sep: int = 1) -> ProcessMesh:
+    """Build the fleet-style hybrid mesh over local devices.
+
+    Axis order (outer→inner): dp, pp, sep, sharding, mp — mp innermost so TP
+    collectives ride adjacent-device ICI links (reference topology.py:298
+    creates groups in pp->mp->sep->sharding->dp order for the same reason).
+    """
+    shape = [dp, pp, sep, sharding, mp]
+    names = ["dp", "pp", "sep", "sharding", "mp"]
+    n = int(np.prod(shape))
+    return ProcessMesh(shape=shape, dim_names=names,
+                       process_ids=list(range(n)))
+
+
+def _clip_grads_functional(grad_clip, params: Dict, grads: Dict) -> Dict:
+    """Functional grad clipping (parity: HybridParallelClipGrad :112 — the
+    cross-group norm allreduces are emitted by GSPMD automatically)."""
+    if grad_clip is None:
+        return grads
+    if isinstance(grad_clip, ClipGradByValue):
+        return {k: jnp.clip(g, grad_clip.min, grad_clip.max)
+                for k, g in grads.items()}
+    if isinstance(grad_clip, ClipGradByNorm):
+        out = {}
+        for k, g in grads.items():
+            n = jnp.sqrt(jnp.sum(g.astype(jnp.float32) ** 2))
+            scale = jnp.minimum(grad_clip.clip_norm / jnp.maximum(n, 1e-12),
+                                1.0)
+            out[k] = (g * scale).astype(g.dtype)
+        return out
+    if isinstance(grad_clip, ClipGradByGlobalNorm):
+        total = sum(jnp.sum(g.astype(jnp.float32) ** 2)
+                    for g in grads.values())
+        gnorm = jnp.sqrt(total)
+        scale = grad_clip.clip_norm / jnp.maximum(gnorm, grad_clip.clip_norm)
+        return {k: (g * scale).astype(g.dtype) for k, g in grads.items()}
+    raise TypeError(f"unsupported grad clip {type(grad_clip)}")
+
+
+def _wrap_remat(layer):
+    """Wrap a Layer's forward in jax.checkpoint (activation recompute)."""
+    orig = layer.forward
+    if getattr(layer, "_remat_wrapped", False):
+        return
+
+    def remat_forward(h, *args, **kwargs):
+        def pure(h_arr):
+            return orig(Tensor(h_arr), *args, **kwargs)._data
+        return Tensor(jax.checkpoint(pure)(h._data if isinstance(h, Tensor)
+                                           else h))
+    layer.forward = remat_forward
+    layer._remat_wrapped = True
+
+
+class SpmdTrainer:
+    """Compiled training step over a hybrid mesh.
+
+    loss_fn(model, *batch_tensors) -> scalar loss Tensor.
+    """
+
+    def __init__(self, model, optimizer: Optimizer, loss_fn: Callable,
+                 mesh: Optional[ProcessMesh] = None, remat_layers=None,
+                 donate: bool = True, batch_axes=("dp", "sharding"),
+                 seq_axis: Optional[str] = None):
+        self.model = model
+        self.opt = optimizer
+        self.loss_fn = loss_fn
+        self.mesh = mesh
+        self.batch_axes = tuple(a for a in batch_axes
+                                if mesh is not None and a in mesh.dim_names
+                                and mesh.get_dim_size(a) > 1) or None
+        self.seq_axis = seq_axis
+        self.donate = donate
+        if remat_layers:
+            for l in remat_layers:
+                _wrap_remat(l)
+
+        self._params: Dict[str, Tensor] = dict(model.named_parameters())
+        self._param_list: List[str] = list(self._params)
+        self._buffers = {n: b._data for n, b in model.named_buffers()}
+        self._jax_mesh = mesh.to_jax() if mesh is not None else None
+        self._step_fn = None
+        self._opt_state: Optional[Dict] = None
+        self._step_count = 0
+        self._last_loss = None
+
+    # -- shardings ------------------------------------------------------------
+    def _param_spec(self, name: str, p: Tensor) -> PartitionSpec:
+        if self.mesh is None:
+            return PartitionSpec()
+        entries = [None] * p._data.ndim
+        ann = get_param_annotation(p)
+        if ann is not None:
+            axis_name, dim = ann
+            if axis_name in self.mesh.dim_names and \
+                    self.mesh.get_dim_size(axis_name) > 1 and \
+                    p._data.shape[dim] % self.mesh.get_dim_size(axis_name) == 0:
+                entries[dim] = axis_name
+        return PartitionSpec(*entries)
+
+    def _state_spec(self, pspec: PartitionSpec, shape) -> PartitionSpec:
+        """ZeRO-1: additionally shard optimizer state over the sharding axis."""
+        if self.mesh is None or "sharding" not in self.mesh.dim_names:
+            return pspec
+        deg = self.mesh.get_dim_size("sharding")
+        if deg <= 1 or not shape:
+            return pspec
+        entries = list(pspec) + [None] * (len(shape) - len(list(pspec)))
+        if entries[0] is None and shape[0] % deg == 0:
+            entries[0] = "sharding"
+        return PartitionSpec(*entries)
+
+    def _sharding(self, spec: PartitionSpec):
+        return NamedSharding(self._jax_mesh, spec) if self._jax_mesh else None
+
+    def _batch_spec(self, arr) -> PartitionSpec:
+        entries = [None] * arr.ndim
+        if self.batch_axes:
+            entries[0] = self.batch_axes if len(self.batch_axes) > 1 \
+                else self.batch_axes[0]
+        if self.seq_axis is not None and arr.ndim > 1 and self.mesh and \
+                self.seq_axis in self.mesh.dim_names:
+            entries[1] = self.seq_axis
+        return PartitionSpec(*entries)
+
+    # -- state ----------------------------------------------------------------
+    def _init_opt_state(self):
+        state = {}
+        for name in self._param_list:
+            p = self._params[name]
+            s = self.opt._init_state(p)
+            if self._jax_mesh is not None:
+                pspec = self._param_spec(name, p)
+                s = {k: jax.device_put(
+                        v, self._sharding(self._state_spec(pspec, v.shape)))
+                     for k, v in s.items()}
+            state[name] = s
+        return state
+
+    def _place_params(self):
+        """Apply mp/dp shardings to the live model parameters."""
+        if self._jax_mesh is None:
+            return
+        for name in self._param_list:
+            p = self._params[name]
+            p._data = jax.device_put(
+                p._data, self._sharding(self._param_spec(name, p)))
+
+    # -- compiled step --------------------------------------------------------
+    def _build(self, batch_arrays):
+        model = self.model
+        opt = self.opt
+        loss_fn = self.loss_fn
+        names = self._param_list
+        buffers = self._buffers
+        wd = {n: opt._wd_coeff(self._params[n]) for n in names}
+        lr_mult = {n: self._params[n].optimize_attr.get("learning_rate", 1.0)
+                   for n in names}
+
+        def step_fn(params, opt_state, lr, step_i, key, *batch):
+            def pure_loss(params_):
+                tensors = [Tensor(a) for a in batch]
+                state = dict(params_)
+                state.update(buffers)
+                with model.swap_state(state), key_context(key), no_grad():
+                    loss_t = loss_fn(model, *tensors)
+                return loss_t._data.astype(jnp.float32)
+
+            loss, grads = jax.value_and_grad(pure_loss)(params)
+            grads = _clip_grads_functional(opt._grad_clip, params, grads)
+            new_params, new_state = {}, {}
+            for n in names:
+                p = params[n]
+                g = grads[n].astype(p.dtype)
+                np_, ns_ = opt._update(p, g, opt_state[n], lr * lr_mult[n],
+                                       wd[n], step_i)
+                new_params[n] = np_
+                new_state[n] = ns_
+            return loss, new_params, new_state
+
+        jit_kwargs = {}
+        if self._jax_mesh is not None:
+            param_sh = {n: self._sharding(self._param_spec(n, self._params[n]))
+                        for n in names}
+            state_sh = {}
+            for n in names:
+                pspec = self._param_spec(n, self._params[n])
+                state_sh[n] = {
+                    k: self._sharding(self._state_spec(pspec, np.shape(v)))
+                    for k, v in self._opt_state[n].items()}
+            batch_sh = tuple(self._sharding(self._batch_spec(a))
+                             for a in batch_arrays)
+            rep = self._sharding(PartitionSpec())
+            jit_kwargs["in_shardings"] = (param_sh, state_sh, rep, rep, rep,
+                                          *batch_sh)
+            jit_kwargs["out_shardings"] = (rep, param_sh, state_sh)
+        if self.donate:
+            jit_kwargs["donate_argnums"] = (0, 1)
+        return jax.jit(step_fn, **jit_kwargs)
+
+    def train_step(self, *batch) -> Tensor:
+        """One compiled fwd+bwd+update step. batch: Tensors or arrays."""
+        batch_arrays = tuple(b._data if isinstance(b, Tensor) else jnp.asarray(b)
+                             for b in batch)
+        if self._opt_state is None:
+            self._place_params()
+            self._opt_state = self._init_opt_state()
+        if self._step_fn is None:
+            self._step_fn = self._build(batch_arrays)
+        self._step_count += 1
+        params = {n: self._params[n]._data for n in self._param_list}
+        lr = jnp.float32(self.opt.get_lr())
+        loss, new_params, new_state = self._step_fn(
+            params, self._opt_state, lr, jnp.float32(self._step_count),
+            next_key(), *batch_arrays)
+        for n in self._param_list:
+            self._params[n]._data = new_params[n]
+        self._opt_state = new_state
+        self.opt._global_step = self._step_count
+        self._last_loss = loss
+        return Tensor(loss)
+
+    def block(self):
+        if self._last_loss is not None:
+            jax.block_until_ready(self._last_loss)
+
+    # checkpoint bridge: expose optimizer state in the eager optimizer format
+    def sync_optimizer_state(self):
+        for n in self._param_list:
+            p = self._params[n]
+            st = dict(self._opt_state[n])
+            st["_step"] = self._step_count
+            self.opt._accumulators[id(p)] = st
