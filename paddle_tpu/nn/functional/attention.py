@@ -49,6 +49,22 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     qt, kt, vt = ensure_tensor(query), ensure_tensor(key), ensure_tensor(value)
     use_flash = attn_mask is None and dropout_p == 0.0
     if use_flash:
+        # Context parallelism: sequence sharded over the sep axis -> ring
+        # attention (explicit KV rotation over ICI) instead of letting GSPMD
+        # all-gather K/V.
+        from ...parallel import context as pctx
+        seq_ax = pctx.sequence_axis()
+        if seq_ax is not None:
+            from ...parallel.ring_attention import ring_attention
+            mesh = pctx.current_mesh()
+            baxes = pctx.batch_axes()
+            return dispatch(
+                "ring_attention",
+                lambda q, k, v: ring_attention(q, k, v, mesh, seq_ax,
+                                               batch_axes=baxes,
+                                               causal=is_causal),
+                qt, kt, vt)
+    if use_flash:
         from ...kernels import flash_attention as fa
         if fa.is_available(qt._data):
             return dispatch(

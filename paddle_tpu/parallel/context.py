@@ -1,0 +1,51 @@
+"""Active parallel context (mesh + axis roles) for ops that need shard_map.
+
+Most parallelism here is GSPMD (sharding annotations on a global-view trace).
+Ring attention is the exception: its communication schedule (KV rotation via
+ppermute) must be explicit, so attention ops consult this context to know the
+mesh and which axis shards the sequence.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional, Sequence
+
+
+class _Ctx(threading.local):
+    def __init__(self):
+        self.mesh = None            # ProcessMesh
+        self.batch_axes: Optional[Sequence[str]] = None
+        self.seq_axis: Optional[str] = None
+
+
+_ctx = _Ctx()
+
+
+class parallel_context:
+    def __init__(self, mesh, batch_axes=None, seq_axis=None):
+        self.new = (mesh, batch_axes, seq_axis)
+
+    def __enter__(self):
+        self.old = (_ctx.mesh, _ctx.batch_axes, _ctx.seq_axis)
+        _ctx.mesh, _ctx.batch_axes, _ctx.seq_axis = self.new
+        return self
+
+    def __exit__(self, *exc):
+        _ctx.mesh, _ctx.batch_axes, _ctx.seq_axis = self.old
+        return False
+
+
+def set_parallel_context(mesh, batch_axes=None, seq_axis=None):
+    _ctx.mesh, _ctx.batch_axes, _ctx.seq_axis = mesh, batch_axes, seq_axis
+
+
+def current_mesh():
+    return _ctx.mesh
+
+
+def sequence_axis() -> Optional[str]:
+    return _ctx.seq_axis
+
+
+def batch_axes():
+    return _ctx.batch_axes

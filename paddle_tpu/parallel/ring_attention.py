@@ -1,0 +1,85 @@
+"""Ring attention: context parallelism for long sequences.
+
+Reference parity: the capability the reference covers with SEP + Megatron-SP +
+FlashAttention (SURVEY §2.3 notes no ring attention in the snapshot — this
+deliberately exceeds it, per §5 "long-context" guidance). TPU-native design:
+sequence is sharded over the `sep` mesh axis; each device holds a Q chunk and
+rotates K/V chunks around the ICI ring with lax.ppermute, accumulating online
+softmax (flash-attention statistics) per hop. Communication overlaps compute
+hop-by-hop; jax.grad differentiates through the scan+ppermute, giving the
+reverse ring schedule for the backward automatically.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec
+
+NEG_INF = -1e30
+
+
+def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
+                          scale: Optional[float]):
+    """Per-device body (inside shard_map). q,k,v: [b, s_loc, h, d] local chunks.
+
+    Online-softmax accumulation over P hops; K/V rotate by +1 each hop.
+    """
+    p = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, s_loc, h, d = q.shape
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+
+    qf = q.astype(jnp.float32)
+    q_pos = my * s_loc + jnp.arange(s_loc)  # global positions of local queries
+
+    def hop(carry, i):
+        k_cur, v_cur, m, l, acc = carry
+        src = (my - i) % p  # which global chunk k_cur/v_cur hold this hop
+        scores = jnp.einsum("bshd,bthd->bhst", qf, k_cur.astype(jnp.float32)) * s
+        if causal:
+            k_pos = src * s_loc + jnp.arange(s_loc)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            scores = jnp.where(mask[None, None], scores, NEG_INF)
+        m_cur = jnp.max(scores, axis=-1, keepdims=True)      # [b,h,sq,1]
+        m_new = jnp.maximum(m, m_cur)
+        pexp = jnp.exp(scores - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(pexp, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jnp.einsum(
+            "bhst,bthd->bhsd", pexp, v_cur.astype(jnp.float32))
+        perm = [(j, (j + 1) % p) for j in range(p)]
+        k_next = lax.ppermute(k_cur, axis_name, perm)
+        v_next = lax.ppermute(v_cur, axis_name, perm)
+        return (k_next, v_next, m_new, l_new, acc_new), None
+
+    m0 = jnp.full((b, h, s_loc, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, s_loc, 1), jnp.float32)
+    acc0 = jnp.zeros((b, h, s_loc, d), jnp.float32)
+    (_, _, _, l_f, acc_f), _ = lax.scan(hop, (k, v, m0, l0, acc0),
+                                        jnp.arange(p))
+    l_safe = jnp.where(l_f == 0.0, 1.0, l_f)
+    out = (acc_f / l_safe).astype(q.dtype)                   # [b,h,s,d]
+    return jnp.transpose(out, (0, 2, 1, 3))                  # [b,s,h,d]
+
+
+def ring_attention(q, k, v, mesh, seq_axis: str, batch_axes=None,
+                   causal: bool = True, scale: Optional[float] = None):
+    """Global-view entry: q,k,v [b, s, h, d] (s sharded over seq_axis).
+
+    Wraps the local body in shard_map over the full mesh so it can be called
+    inside a jitted (GSPMD) program.
+    """
+    jax_mesh = mesh.to_jax() if hasattr(mesh, "to_jax") else mesh
+    batch_entry = None
+    if batch_axes:
+        batch_entry = tuple(batch_axes) if len(batch_axes) > 1 else batch_axes[0]
+    spec = PartitionSpec(batch_entry, seq_axis, None, None)
+    fn = functools.partial(_ring_attention_local, axis_name=seq_axis,
+                           causal=causal, scale=scale)
+    return jax.shard_map(fn, mesh=jax_mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)

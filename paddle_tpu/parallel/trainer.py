@@ -198,12 +198,18 @@ class SpmdTrainer:
         lr_mult = {n: self._params[n].optimize_attr.get("learning_rate", 1.0)
                    for n in names}
 
+        from . import context as pctx
+        mesh = self.mesh
+        batch_axes = self.batch_axes
+        seq_axis = self.seq_axis
+
         def step_fn(params, opt_state, lr, step_i, key, *batch):
             def pure_loss(params_):
                 tensors = [Tensor(a) for a in batch]
                 state = dict(params_)
                 state.update(buffers)
-                with model.swap_state(state), key_context(key), no_grad():
+                with model.swap_state(state), key_context(key), no_grad(), \
+                        pctx.parallel_context(mesh, batch_axes, seq_axis):
                     loss_t = loss_fn(model, *tensors)
                 return loss_t._data.astype(jnp.float32)
 
