@@ -11,6 +11,7 @@ Weight layout matches paddle Linear ([in, out]) so checkpoints map over.
 """
 from __future__ import annotations
 
+import contextlib
 import math
 from dataclasses import dataclass
 from typing import Optional
@@ -192,8 +193,19 @@ class LlamaModel(nn.Layer):
         s = input_ids.shape[1]
         cos = Tensor(self.rope_cos._data[:s])
         sin = Tensor(self.rope_sin._data[:s])
-        for layer in self.layers:
-            h = layer(h, (cos, sin), attention_mask)
+        run_blocks = getattr(self, "_pp_run_blocks", None)
+        if run_blocks is not None:
+            if attention_mask is not None:
+                raise NotImplementedError(
+                    "attention_mask is not threaded through the pipelined "
+                    "block region yet (causal masking only); pad with "
+                    "ignore_index labels instead")
+            # pipeline-parallel trace: the trainer replaces the block loop
+            # with the compiled circular-pipeline region
+            h = Tensor(run_blocks(h._data, cos._data, sin._data))
+        else:
+            for layer in self.layers:
+                h = layer(h, (cos, sin), attention_mask)
         return self.norm(h)
 
 
@@ -224,6 +236,25 @@ class LlamaForCausalLM(nn.Layer):
         shift_labels = labels[:, 1:]
         return F.cross_entropy(reshape(shift_logits, [b * (s - 1), v]),
                                reshape(shift_labels, [b * (s - 1)]))
+
+    # -- pipeline protocol (parallel.pipeline.PipelinedTrainer) ---------------
+    def pp_block_layers(self):
+        return list(self.model.layers)
+
+    @staticmethod
+    def pp_block_call(layer, h, cos, sin):
+        return layer(h, (cos, sin))
+
+    @contextlib.contextmanager
+    def pp_install(self, run_blocks):
+        """Route this model's block loop through `run_blocks(h, *consts)` for
+        the duration of a pipeline-parallel trace; forward() is otherwise
+        unchanged, so any user loss_fn(model, *batch) works pipelined."""
+        self.model._pp_run_blocks = run_blocks
+        try:
+            yield
+        finally:
+            self.model._pp_run_blocks = None
 
     def num_params(self):
         return sum(p.numel() for p in self.parameters())

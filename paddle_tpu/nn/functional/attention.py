@@ -64,7 +64,6 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                                batch_axes=baxes,
                                                causal=is_causal),
                 qt, kt, vt)
-    if use_flash:
         from ...kernels import flash_attention as fa
         if fa.is_available(qt._data):
             return dispatch(

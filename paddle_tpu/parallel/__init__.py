@@ -6,3 +6,5 @@ PirInterpreter + CommContext, SURVEY §3.5): one jitted training step over a
 jax Mesh, with GSPMD doing sharding propagation and collective insertion.
 """
 from .trainer import SpmdTrainer, make_hybrid_mesh  # noqa: F401
+from .pipeline import PipelinedTrainer, pipeline_blocks  # noqa: F401
+from .ring_attention import ring_attention  # noqa: F401

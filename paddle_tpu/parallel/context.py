@@ -35,6 +35,11 @@ class parallel_context:
         return False
 
 
+def rotate_perm(p: int):
+    """Ring topology: stage/chunk j hands off to j+1 (mod p) over ICI."""
+    return [(j, (j + 1) % p) for j in range(p)]
+
+
 def set_parallel_context(mesh, batch_axes=None, seq_axis=None):
     _ctx.mesh, _ctx.batch_axes, _ctx.seq_axis = mesh, batch_axes, seq_axis
 

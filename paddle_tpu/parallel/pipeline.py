@@ -1,0 +1,335 @@
+"""Pipeline parallelism over the `pp` mesh axis (TPU-native circular pipeline).
+
+Reference parity: fleet's PipelineParallel schedules — 1F1B
+(`meta_parallel/pipeline_parallel.py:684 forward_backward_pipeline`),
+layer segmentation (`parallel_layers/pp_layers.py:258 PipelineLayer`,
+`SegmentLayers :93`) and the p2p activation exchange
+(`pp_utils/p2p_communication.py:651 P2pHelper`).
+
+TPU-native design (NOT a translation of the NCCL p2p machinery):
+
+* Decoder blocks are *stacked* along a leading layer axis and sharded over
+  the `pp` mesh axis, so each pipeline stage physically owns L/P layers.
+* The schedule is a circular pipeline inside a partial-manual
+  ``jax.shard_map`` — manual over `pp` only; dp/mp/sharding stay in GSPMD
+  auto mode, so Megatron-TP collectives inside a block are still inserted
+  by the compiler. Activations rotate stage→stage+1 around the ICI ring
+  with ``lax.ppermute`` — the reference's batched isend/irecv becomes one
+  ppermute per tick.
+* The backward pass is ``jax.grad`` through the scan: ppermute transposes
+  to the reverse ring, yielding the reverse pipeline schedule
+  automatically. Per-tick ``jax.checkpoint`` bounds activation memory to
+  stage-boundary activations (the 1F1B memory property) instead of full
+  per-layer residuals.
+* Microbatching (the reference's `accumulate_steps`) is the `n_micro` axis
+  of the pipeline loop; there are no Python-level micro-steps — the whole
+  schedule is ONE compiled XLA program.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec
+
+from ..autograd.tape import no_grad
+from ..framework.random import key_context
+from ..tensor import Tensor
+from ..distributed.fleet.meta_parallel import get_param_annotation
+from .context import rotate_perm
+from .trainer import SpmdTrainer
+
+
+def pipeline_blocks(h0, consts, stacked_leaves, *, block_apply_flat,
+                    axis_name: str, n_micro: int, remat: bool = True):
+    """Per-device circular-pipeline body (call inside shard_map).
+
+    h0: [n_micro, mb, ...] microbatched stage-0 activations (replicated over
+    `pp`); consts: tuple of per-call constants (e.g. rope caches) shared by
+    every block; stacked_leaves: list of [L_local, ...] parameter arrays for
+    the L/P blocks this stage owns. block_apply_flat(leaves_slice, h, *consts)
+    applies ONE block. Returns [n_micro, mb, ...] outputs of the last stage
+    (broadcast to all pp ranks).
+    """
+    p = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+
+    def apply_stage(x):
+        def body(h, leaf_slices):
+            return block_apply_flat(leaf_slices, h, *consts), None
+        y, _ = lax.scan(body, x, stacked_leaves)
+        return y
+
+    if remat:
+        apply_stage = jax.checkpoint(apply_stage)
+
+    ticks = n_micro + p - 1
+    out0 = jnp.zeros_like(h0)
+    x0 = jnp.zeros_like(h0[0])
+
+    def compute(t, x, out):
+        t_in = jnp.clip(t, 0, n_micro - 1)
+        fresh = lax.dynamic_index_in_dim(h0, t_in, 0, keepdims=False)
+        x_in = jnp.where(rank == 0, fresh, x)
+        y = apply_stage(x_in)
+        t_out = jnp.clip(t - (p - 1), 0, n_micro - 1)
+        valid = (rank == p - 1) & (t >= p - 1)
+        cur = lax.dynamic_index_in_dim(out, t_out, 0, keepdims=False)
+        out = lax.dynamic_update_index_in_dim(
+            out, jnp.where(valid, y, cur), t_out, 0)
+        return y, out
+
+    def tick(carry, t):
+        x, out = carry
+        y, out = compute(t, x, out)
+        x_next = lax.ppermute(y, axis_name, rotate_perm(p))
+        return (x_next, out), None
+
+    # final tick peeled: its rotated activation would be discarded
+    (x_l, out), _ = lax.scan(tick, (x0, out0), jnp.arange(ticks - 1))
+    _, out = compute(ticks - 1, x_l, out)
+    # Only the last stage holds real outputs; broadcast around the ring so the
+    # (replicated-over-pp) head/loss epilogue sees them everywhere.
+    return lax.psum(jnp.where(rank == p - 1, out, jnp.zeros_like(out)),
+                    axis_name)
+
+
+class PipelinedTrainer(SpmdTrainer):
+    """SpmdTrainer with the decoder blocks run as a circular pp pipeline.
+
+    The model must implement the pipeline protocol:
+      * ``pp_block_layers() -> List[Layer]`` — the homogeneous blocks;
+      * ``pp_install(run_blocks)`` — contextmanager that reroutes the model's
+        block loop through ``run_blocks(h_arr, *const_arrays)``, so the
+        user's ``loss_fn(model, *batch)`` runs unchanged on the pipelined
+        trace;
+      * ``pp_block_call(layer, h, *consts) -> Tensor`` (static) — applies one
+        block layer to a hidden-state Tensor.
+
+    Parity: `fleet.meta_parallel.PipelineLayer` segmentation + `train_batch`
+    (pipeline_parallel.py:940) fused into one compiled step.
+    """
+
+    STACK_PREFIX = "pp_stacked."
+
+    def __init__(self, model, optimizer, loss_fn, mesh=None,
+                 n_micro: int = 1, remat: bool = True, **kw):
+        blocks: List = model.pp_block_layers()
+        self._blocks = blocks
+        self._template = blocks[0]
+        self.n_micro = n_micro
+        self._pp_remat = remat
+        super().__init__(model, optimizer, loss_fn, mesh=mesh,
+                         remat_layers=None, **kw)
+        self.pp_degree = (mesh.get_dim_size("pp")
+                          if mesh is not None and "pp" in mesh.dim_names else 1)
+        if len(blocks) % max(self.pp_degree, 1) != 0:
+            raise ValueError(
+                f"{len(blocks)} blocks not divisible by pp={self.pp_degree}")
+
+        # Identify block params inside the model's flat namespace.
+        block_param_ids = set()
+        for b in blocks:
+            for _, bp in b.named_parameters():
+                block_param_ids.add(id(bp))
+        self._nonblock_names = [n for n in self._param_list
+                                if id(self._params[n]) not in block_param_ids]
+
+        # Local (per-block) param names from the template, and per-layer
+        # Tensors in block order for stacking / unstacking.
+        self._local_names = [n for n, _ in self._template.named_parameters()]
+        self._per_layer: Dict[str, List[Tensor]] = {
+            ln: [] for ln in self._local_names}
+        for b in blocks:
+            bp = dict(b.named_parameters())
+            for ln in self._local_names:
+                self._per_layer[ln].append(bp[ln])
+
+        # Stack block params: [L, ...] Tensors owned by the trainer. Weight
+        # decay / lr-multiplier policy must be uniform across the layers of a
+        # stack (it is applied to the whole [L, ...] array at once).
+        stacked: Dict[str, Tensor] = {}
+        self._stack_ann: Dict[str, Optional[tuple]] = {}
+        self._stack_wd: Dict[str, float] = {}
+        self._stack_lr_mult: Dict[str, float] = {}
+        tmpl_params = dict(self._template.named_parameters())
+        from ..tensor import Parameter
+        for ln in self._local_names:
+            per_layer = self._per_layer[ln]
+            sname = self.STACK_PREFIX + ln
+            wds = {optimizer._wd_coeff(t) for t in per_layer}
+            lrs = {(getattr(t, "optimize_attr", None) or {})
+                   .get("learning_rate", 1.0) for t in per_layer}
+            if len(wds) > 1 or len(lrs) > 1:
+                raise ValueError(
+                    f"block param '{ln}' has non-uniform weight-decay/lr "
+                    f"policy across layers (wd={wds}, lr_mult={lrs}); "
+                    "pipeline stacking requires uniform per-layer policy")
+            self._stack_wd[sname] = wds.pop()
+            self._stack_lr_mult[sname] = lrs.pop()
+            st = Parameter(jnp.stack([t._data for t in per_layer]))
+            tmpl = tmpl_params[ln]
+            st.name = tmpl.name
+            st.trainable = getattr(tmpl, "trainable", True)
+            st.regularizer = getattr(tmpl, "regularizer", None)
+            st.need_clip = getattr(tmpl, "need_clip", True)
+            st.optimize_attr = dict(getattr(tmpl, "optimize_attr", None) or
+                                    {"learning_rate": 1.0})
+            stacked[sname] = st
+            self._stack_ann[sname] = get_param_annotation(tmpl)
+
+        self._params = {n: self._params[n] for n in self._nonblock_names}
+        self._params.update(stacked)
+        self._param_list = list(self._params)
+        self._stacked_names = list(stacked)
+
+    # -- per-param optimizer policy -------------------------------------------
+    def _wd(self, name: str) -> float:
+        if name.startswith(self.STACK_PREFIX):
+            return self._stack_wd[name]
+        return super()._wd(name)
+
+    def _lr_mult(self, name: str) -> float:
+        if name.startswith(self.STACK_PREFIX):
+            return self._stack_lr_mult[name]
+        return super()._lr_mult(name)
+
+    # -- shardings ------------------------------------------------------------
+    def _param_spec(self, name: str, p: Tensor) -> PartitionSpec:
+        if not name.startswith(self.STACK_PREFIX):
+            return super()._param_spec(name, p)
+        if self.mesh is None:
+            return PartitionSpec()
+        entries = [None] * p._data.ndim
+        if "pp" in self.mesh.dim_names and self.pp_degree > 1:
+            entries[0] = "pp"
+        ann = self._stack_ann.get(name)
+        if ann is not None:
+            axis_name, dim = ann
+            if axis_name in self.mesh.dim_names and \
+                    self.mesh.get_dim_size(axis_name) > 1 and \
+                    p._data.shape[dim + 1] % self.mesh.get_dim_size(axis_name) == 0:
+                entries[dim + 1] = axis_name
+        return PartitionSpec(*entries)
+
+    def _state_spec(self, pspec: PartitionSpec, shape):
+        # Stacked params already shard dim0 over pp; ZeRO state sharding over
+        # the `sharding` axis applies to dim1 when free and divisible.
+        entries = list(pspec) + [None] * (len(shape) - len(list(pspec)))
+        if self.mesh is None or "sharding" not in self.mesh.dim_names:
+            return PartitionSpec(*entries)
+        deg = self.mesh.get_dim_size("sharding")
+        if deg <= 1 or not shape:
+            return PartitionSpec(*entries)
+        if entries and entries[0] == "pp":
+            if len(entries) > 1 and entries[1] is None and shape[1] % deg == 0:
+                entries[1] = "sharding"
+            return PartitionSpec(*entries)
+        return super()._state_spec(pspec, shape)
+
+    # -- traced loss with the pipelined block region --------------------------
+    def _pure_loss(self, params_, batch_arrays, key):
+        from . import context as pctx
+        model = self.model
+        template = self._template
+        local_names = self._local_names
+        n_micro = self.n_micro
+        remat = self._pp_remat
+        pp = self.pp_degree
+        mesh = self.mesh
+
+        def block_apply_flat(leaf_slices, h, *consts):
+            state = dict(zip(local_names, leaf_slices))
+            with template.swap_state(state), no_grad():
+                out = type(model).pp_block_call(
+                    template, Tensor(h), *[Tensor(c) for c in consts])
+            return out._data
+
+        stacked_leaves = [params_[self.STACK_PREFIX + ln]
+                          for ln in local_names]
+
+        def run_blocks(h_arr, *const_arrays):
+            b = h_arr.shape[0]
+            if pp <= 1:
+                def body(h, leaf_slices):
+                    return block_apply_flat(leaf_slices, h,
+                                            *const_arrays), None
+                f = lambda x: lax.scan(body, x, stacked_leaves)[0]
+                return jax.checkpoint(f)(h_arr) if remat else f(h_arr)
+            nm = n_micro
+            assert b % nm == 0, f"batch {b} not divisible by n_micro {nm}"
+            h0 = h_arr.reshape((nm, b // nm) + h_arr.shape[1:])
+            body = functools.partial(
+                pipeline_blocks, block_apply_flat=block_apply_flat,
+                axis_name="pp", n_micro=nm, remat=remat)
+            n_stacked = len(stacked_leaves)
+
+            def local_fn(h0_, consts_, *leaves):
+                return body(h0_, tuple(consts_), list(leaves))
+
+            leaf_specs = tuple(
+                PartitionSpec(*( ["pp"] + [None] * (l.ndim - 1)))
+                for l in stacked_leaves)
+            const_specs = tuple(PartitionSpec() for _ in const_arrays)
+            out = jax.shard_map(
+                local_fn,
+                mesh=self._jax_mesh,
+                in_specs=(PartitionSpec(), const_specs) + leaf_specs,
+                out_specs=PartitionSpec(),
+                axis_names={"pp"},
+                check_vma=False,
+            )(h0, tuple(const_arrays), *stacked_leaves)
+            return out.reshape((b,) + h_arr.shape[1:])
+
+        # Swap only the non-block state; blocks run through the template.
+        state = {n: params_[n] for n in self._nonblock_names}
+        state.update(self._buffers)
+        tensors = [Tensor(a) for a in batch_arrays]
+        with model.swap_state(state), key_context(key), no_grad(), \
+                pctx.parallel_context(mesh, self.batch_axes, self.seq_axis), \
+                model.pp_install(run_blocks):
+            loss_t = self.loss_fn(model, *tensors)
+        return loss_t._data.astype(jnp.float32)
+
+    # -- checkpoint bridge ----------------------------------------------------
+    def sync_model(self):
+        """Write stacked block params back into the per-layer model tensors
+        (so model.state_dict() reflects training; reference analog: the PP
+        layers always own their slice — here the trainer owns the stack)."""
+        for ln in self._local_names:
+            st = self._params[self.STACK_PREFIX + ln]._data
+            for i, t in enumerate(self._per_layer[ln]):
+                t._data = st[i]
+
+    def load_from_model(self):
+        """Re-stack block params from the model (after set_state_dict).
+
+        NOTE: discards the compiled step and the trainer-held optimizer
+        moments (a fresh start from the loaded weights). To checkpoint and
+        resume *with* moments, use sync_optimizer_state()/opt.state_dict()
+        before saving and a fresh trainer after loading.
+        """
+        for ln in self._local_names:
+            arrs = [t._data for t in self._per_layer[ln]]
+            self._params[self.STACK_PREFIX + ln]._data = jnp.stack(arrs)
+        self._opt_state = None
+        self._step_fn = None
+
+    def sync_optimizer_state(self):
+        """Expose optimizer state in the eager optimizer's per-param format:
+        stacked [L, ...] moments are unstacked onto the per-layer Parameters
+        so opt.state_dict() round-trips (keys follow the model params)."""
+        for n in self._param_list:
+            st = dict(self._opt_state[n])
+            st["_step"] = self._step_count
+            if not n.startswith(self.STACK_PREFIX):
+                self.opt._accumulators[id(self._params[n])] = st
+                continue
+            ln = n[len(self.STACK_PREFIX):]
+            for i, t in enumerate(self._per_layer[ln]):
+                per = {k: (v if k == "_step" else v[i])
+                       for k, v in st.items()}
+                self.opt._accumulators[id(t)] = per

@@ -20,6 +20,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec
 
+from .context import rotate_perm
+
 NEG_INF = -1e30
 
 
@@ -27,7 +29,8 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
                           scale: Optional[float]):
     """Per-device body (inside shard_map). q,k,v: [b, s_loc, h, d] local chunks.
 
-    Online-softmax accumulation over P hops; K/V rotate by +1 each hop.
+    Online-softmax accumulation over P hops; K/V rotate by +1 each hop (the
+    final hop is peeled so no wasted rotation trails the loop).
     """
     p = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
@@ -37,8 +40,7 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
     qf = q.astype(jnp.float32)
     q_pos = my * s_loc + jnp.arange(s_loc)  # global positions of local queries
 
-    def hop(carry, i):
-        k_cur, v_cur, m, l, acc = carry
+    def accumulate(i, k_cur, v_cur, m, l, acc):
         src = (my - i) % p  # which global chunk k_cur/v_cur hold this hop
         scores = jnp.einsum("bshd,bthd->bhst", qf, k_cur.astype(jnp.float32)) * s
         if causal:
@@ -52,16 +54,21 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
         l_new = alpha * l + jnp.sum(pexp, axis=-1, keepdims=True)
         acc_new = acc * alpha + jnp.einsum(
             "bhst,bthd->bhsd", pexp, v_cur.astype(jnp.float32))
-        perm = [(j, (j + 1) % p) for j in range(p)]
-        k_next = lax.ppermute(k_cur, axis_name, perm)
-        v_next = lax.ppermute(v_cur, axis_name, perm)
-        return (k_next, v_next, m_new, l_new, acc_new), None
+        return m_new, l_new, acc_new
+
+    def hop(carry, i):
+        k_cur, v_cur, m, l, acc = carry
+        m, l, acc = accumulate(i, k_cur, v_cur, m, l, acc)
+        k_next = lax.ppermute(k_cur, axis_name, rotate_perm(p))
+        v_next = lax.ppermute(v_cur, axis_name, rotate_perm(p))
+        return (k_next, v_next, m, l, acc), None
 
     m0 = jnp.full((b, h, s_loc, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, h, s_loc, 1), jnp.float32)
     acc0 = jnp.zeros((b, h, s_loc, d), jnp.float32)
-    (_, _, _, l_f, acc_f), _ = lax.scan(hop, (k, v, m0, l0, acc0),
-                                        jnp.arange(p))
+    (k_l, v_l, m_f, l_f, acc_f), _ = lax.scan(
+        hop, (k, v, m0, l0, acc0), jnp.arange(p - 1))
+    _, l_f, acc_f = accumulate(p - 1, k_l, v_l, m_f, l_f, acc_f)
     l_safe = jnp.where(l_f == 0.0, 1.0, l_f)
     out = (acc_f / l_safe).astype(q.dtype)                   # [b,h,s,d]
     return jnp.transpose(out, (0, 2, 1, 3))                  # [b,s,h,d]
@@ -78,7 +85,14 @@ def ring_attention(q, k, v, mesh, seq_axis: str, batch_axes=None,
     batch_entry = None
     if batch_axes:
         batch_entry = tuple(batch_axes) if len(batch_axes) > 1 else batch_axes[0]
-    spec = PartitionSpec(batch_entry, seq_axis, None, None)
+    # Keep Megatron-TP inside attention: heads stay sharded over mp (the
+    # ColumnParallelLinear annotations put them there) when divisible.
+    heads_entry = None
+    if "mp" in jax_mesh.axis_names:
+        mp_size = jax_mesh.shape["mp"]
+        if mp_size > 1 and q.shape[2] % mp_size == 0:
+            heads_entry = "mp"
+    spec = PartitionSpec(batch_entry, seq_axis, heads_entry, None)
     fn = functools.partial(_ring_attention_local, axis_name=seq_axis,
                            causal=causal, scale=scale)
     return jax.shard_map(fn, mesh=jax_mesh, in_specs=(spec, spec, spec),

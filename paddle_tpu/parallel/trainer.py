@@ -110,6 +110,13 @@ class SpmdTrainer:
         self.batch_axes = tuple(a for a in batch_axes
                                 if mesh is not None and a in mesh.dim_names
                                 and mesh.get_dim_size(a) > 1) or None
+        if seq_axis is not None and (mesh is None or
+                                     seq_axis not in mesh.dim_names):
+            raise ValueError(
+                f"seq_axis={seq_axis!r} requires a mesh with that axis "
+                f"(mesh={'None' if mesh is None else mesh.dim_names})")
+        if seq_axis is not None and mesh.get_dim_size(seq_axis) <= 1:
+            seq_axis = None  # degenerate context parallelism = serial
         self.seq_axis = seq_axis
         self.donate = donate
         if remat_layers:
@@ -188,30 +195,36 @@ class SpmdTrainer:
                 p._data, self._sharding(self._param_spec(name, p)))
 
     # -- compiled step --------------------------------------------------------
-    def _build(self, batch_arrays):
-        model = self.model
-        opt = self.opt
-        loss_fn = self.loss_fn
-        names = self._param_list
-        buffers = self._buffers
-        wd = {n: opt._wd_coeff(self._params[n]) for n in names}
-        lr_mult = {n: self._params[n].optimize_attr.get("learning_rate", 1.0)
-                   for n in names}
-
+    def _pure_loss(self, params_, batch_arrays, key):
+        """Traceable loss of the full model state dict; subclasses override
+        (the pipelined trainer swaps in the stage-stacked block params)."""
         from . import context as pctx
-        mesh = self.mesh
-        batch_axes = self.batch_axes
-        seq_axis = self.seq_axis
+        tensors = [Tensor(a) for a in batch_arrays]
+        state = dict(params_)
+        state.update(self._buffers)
+        with self.model.swap_state(state), key_context(key), no_grad(), \
+                pctx.parallel_context(self.mesh, self.batch_axes,
+                                      self.seq_axis):
+            loss_t = self.loss_fn(self.model, *tensors)
+        return loss_t._data.astype(jnp.float32)
+
+    def _lr_mult(self, name: str) -> float:
+        p = self._params[name]
+        attr = getattr(p, "optimize_attr", None) or {}
+        return attr.get("learning_rate", 1.0)
+
+    def _wd(self, name: str) -> float:
+        return self.opt._wd_coeff(self._params[name])
+
+    def _build(self, batch_arrays):
+        opt = self.opt
+        names = self._param_list
+        wd = {n: self._wd(n) for n in names}
+        lr_mult = {n: self._lr_mult(n) for n in names}
 
         def step_fn(params, opt_state, lr, step_i, key, *batch):
             def pure_loss(params_):
-                tensors = [Tensor(a) for a in batch]
-                state = dict(params_)
-                state.update(buffers)
-                with model.swap_state(state), key_context(key), no_grad(), \
-                        pctx.parallel_context(mesh, batch_axes, seq_axis):
-                    loss_t = loss_fn(model, *tensors)
-                return loss_t._data.astype(jnp.float32)
+                return self._pure_loss(params_, batch, key)
 
             loss, grads = jax.value_and_grad(pure_loss)(params)
             grads = _clip_grads_functional(opt._grad_clip, params, grads)
