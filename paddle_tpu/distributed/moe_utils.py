@@ -1,0 +1,54 @@
+"""global_scatter / global_gather (parity: python/paddle/distributed/utils/
+moe_utils.py:20; kernels phi/kernels/gpu/global_{scatter,gather}_kernel.cu).
+
+In the reference these are NCCL all-to-all-v ops moving expert-bound token
+rows between ranks: the send buffer is grouped by destination expert
+(assign_pos order) and the receive buffer is grouped by (source rank, local
+expert). TPU-native, the MoELayer dispatch einsum + ep-axis sharding
+constraint compiles to the same exchange as HLO all-to-all, so the
+cross-rank movement lives in the compiled program, not in these functions.
+
+Here they implement the single-worker (global-view) case, where send order
+equals receive order; the multi-worker regrouping has no host-side
+equivalent in the single-controller model and raises, directing users to
+MoELayer.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ops.dispatch import dispatch, ensure_tensor
+
+
+def _check_single_worker(group, lc, gc, name):
+    if group is not None and getattr(group, "nranks", 1) > 1:
+        raise NotImplementedError(
+            f"{name} with a multi-rank group has no eager equivalent in the "
+            "single-controller SPMD model; use MoELayer, whose dispatch "
+            "compiles to all-to-all over the ep mesh axis")
+    if int(lc.sum()) != int(gc.sum()):
+        raise ValueError(
+            f"{name}: local_count sum ({int(lc.sum())}) != global_count sum "
+            f"({int(gc.sum())})")
+
+
+def global_scatter(x, local_count, global_count, group=None,
+                   use_calc_stream=True):
+    """Move rows grouped by destination expert (sizes `local_count`) into
+    receive order (sizes `global_count`). Single-worker: the identity
+    permutation."""
+    lc = ensure_tensor(local_count)
+    gc = ensure_tensor(global_count)
+    _check_single_worker(group, lc._data, gc._data, "global_scatter")
+    return dispatch("global_scatter", lambda a, l, g: a + 0, ensure_tensor(x),
+                    lc, gc)
+
+
+def global_gather(x, local_count, global_count, group=None,
+                  use_calc_stream=True):
+    """Inverse of global_scatter."""
+    lc = ensure_tensor(local_count)
+    gc = ensure_tensor(global_count)
+    _check_single_worker(group, lc._data, gc._data, "global_gather")
+    return dispatch("global_gather", lambda a, l, g: a + 0, ensure_tensor(x),
+                    lc, gc)

@@ -1,0 +1,2 @@
+"""paddle_tpu.incubate — experimental APIs (parity: python/paddle/incubate/)."""
+from . import distributed, nn  # noqa: F401

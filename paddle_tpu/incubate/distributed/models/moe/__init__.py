@@ -1,0 +1,3 @@
+"""Expert-parallel MoE (parity: python/paddle/incubate/distributed/models/moe/)."""
+from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate  # noqa: F401
+from .moe_layer import MoELayer, top_k_gating  # noqa: F401

@@ -1,0 +1,95 @@
+"""MoE gates.
+
+Reference parity: python/paddle/incubate/distributed/models/moe/gate/
+{base_gate,naive_gate,gshard_gate,switch_gate}.py. Gates score tokens with a
+linear router; the MoELayer turns the scores into capacity-bounded
+combine/dispatch arrays (GShard Alg. 1). The gate stashes its load-balance
+auxiliary loss on `self.loss` exactly like the reference (`get_loss`).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from paddle_tpu.nn.initializer import XavierUniform
+from paddle_tpu.nn.layer.layers import Layer
+from paddle_tpu.nn import functional as F
+
+
+class BaseGate(Layer):
+    """Linear router over experts.
+
+    top_k choices per token; `capacity_factor(train)` bounds tokens/expert
+    (None = unbounded, no token dropping); `second_policy` in
+    {"all", "random"} — "random" is GShard's stochastic 2nd-expert routing.
+    """
+
+    top_k: int = 2
+    second_policy: str = "all"
+    use_aux_loss: bool = True  # load-balance loss added to the objective
+
+    def __init__(self, d_model: int, num_expert: int, world_size: int = 1,
+                 top_k: int = 2, gate_bias: bool = True):
+        super().__init__()
+        self.d_model = d_model
+        self.num_expert = num_expert
+        self.world_size = world_size
+        self.tot_expert = num_expert * world_size
+        self.top_k = top_k
+        self.weight = self.create_parameter(
+            [d_model, self.tot_expert], default_initializer=XavierUniform())
+        self.bias = self.create_parameter([self.tot_expert], is_bias=True) \
+            if gate_bias else None
+        self.loss = None
+
+    def capacity_factor(self, training: bool) -> Optional[float]:
+        return None
+
+    def forward(self, x):
+        """x: [tokens, d_model] -> logits [tokens, tot_expert]."""
+        return F.linear(x, self.weight, self.bias)
+
+    def set_loss(self, loss):
+        self.loss = loss
+
+    def get_loss(self, clear: bool = True):
+        loss = self.loss
+        if clear:
+            self.loss = None
+        return loss
+
+
+class NaiveGate(BaseGate):
+    """Parity: gate/naive_gate.py — plain top-k routing, no capacity limit,
+    no auxiliary loss."""
+
+    use_aux_loss = False
+
+
+class GShardGate(BaseGate):
+    """Parity: gate/gshard_gate.py — top-2, capacity-bounded, random second
+    expert, load-balance aux loss e * sum(me * ce)."""
+
+    def __init__(self, d_model, num_expert, world_size=1, top_k=2,
+                 capacity=(1.2, 2.4), random_routing=True,
+                 group=None, gate_bias=True):
+        super().__init__(d_model, num_expert, world_size, top_k,
+                         gate_bias=gate_bias)
+        self.capacity = tuple(capacity)
+        self.second_policy = "random" if random_routing else "all"
+
+    def capacity_factor(self, training: bool) -> Optional[float]:
+        return self.capacity[0] if training else self.capacity[1]
+
+
+class SwitchGate(BaseGate):
+    """Parity: gate/switch_gate.py — top-1 (Switch Transformer) with
+    capacity bound and the same load-balance loss."""
+
+    def __init__(self, d_model, num_expert, world_size=1, top_k=1,
+                 capacity=(1.2, 2.4), group=None, gate_bias=True):
+        super().__init__(d_model, num_expert, world_size, top_k=1,
+                         gate_bias=gate_bias)
+        self.capacity = tuple(capacity)
+
+    def capacity_factor(self, training: bool) -> Optional[float]:
+        return self.capacity[0] if training else self.capacity[1]

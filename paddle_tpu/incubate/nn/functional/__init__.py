@@ -1,0 +1,2 @@
+"""Fused incubate functionals (parity: python/paddle/incubate/nn/functional/)."""
+from .fused_moe import fused_moe  # noqa: F401

@@ -1,0 +1,58 @@
+"""fused_moe functional (parity: python/paddle/incubate/nn/functional/fused_moe.py).
+
+One-call MoE FFN over stacked expert weights. On TPU the "fusion" is the
+XLA program itself: routing + dispatch einsum + batched expert matmuls +
+combine einsum compile into a single fused region (all-to-all over the ep
+mesh axis when sharded), so no custom fused CUDA kernel is needed.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from paddle_tpu.incubate.distributed.models.moe.moe_layer import (
+    moe_expert_ffn, top_k_gating)
+from paddle_tpu.ops.dispatch import dispatch, ensure_tensor
+
+
+def fused_moe(x, gate_weight, ffn1_weight, ffn2_weight, ffn1_bias=None,
+              ffn2_bias=None, moe_topk: int = 2, capacity=None,
+              capacity_factor: float = 1.25, norm_topk_prob: bool = True,
+              ep_axis: str = "ep"):
+    """x [..., d]; gate_weight [d, e]; ffn1_weight [e, d, h]; ffn2_weight
+    [e, h, d]. Returns same shape as x.
+
+    capacity defaults to ceil(capacity_factor * tokens * moe_topk / e) so the
+    [tokens, e, capacity] routing arrays stay linear in tokens; pass
+    capacity=tokens explicitly for no-drop routing.
+    """
+    xt = ensure_tensor(x)
+    d = xt.shape[-1]
+    tokens = int(xt.numel()) // d
+    e = gate_weight.shape[-1]
+    if capacity is not None:
+        cap = int(capacity)
+    else:
+        cap = max(4, int(math.ceil(capacity_factor * tokens * moe_topk / e)))
+    args = [xt, ensure_tensor(gate_weight), ensure_tensor(ffn1_weight),
+            ensure_tensor(ffn2_weight)]
+    has_b1 = ffn1_bias is not None
+    has_b2 = ffn2_bias is not None
+    if has_b1:
+        args.append(ensure_tensor(ffn1_bias))
+    if has_b2:
+        args.append(ensure_tensor(ffn2_bias))
+
+    def fwd(x_arr, gw, w1, w2, *biases):
+        bi = list(biases)
+        b1 = bi.pop(0) if has_b1 else None
+        b2 = bi.pop(0) if has_b2 else None
+        x2 = x_arr.reshape(-1, d)
+        logits = x2.astype(jnp.float32) @ gw.astype(jnp.float32)
+        combine, disp, _ = top_k_gating(logits, moe_topk, cap,
+                                        normalize=norm_topk_prob)
+        y2 = moe_expert_ffn(x2, combine, disp, w1, b1, w2, b2,
+                            ep_axis=ep_axis)
+        return y2.reshape(x_arr.shape)
+    return dispatch("fused_moe", fwd, *args)

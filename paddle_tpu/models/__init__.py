@@ -1,3 +1,4 @@
 """Flagship model families (parity targets from BASELINE.json configs)."""
-from . import llama  # noqa: F401
+from . import gpt, llama  # noqa: F401
+from .gpt import GPTConfig, GPTForCausalLM, GPTModel  # noqa: F401
 from .llama import LlamaConfig, LlamaForCausalLM, LlamaModel  # noqa: F401

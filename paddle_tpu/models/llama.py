@@ -260,8 +260,12 @@ class LlamaForCausalLM(nn.Layer):
         return sum(p.numel() for p in self.parameters())
 
     def flops_per_token(self, seq_len: int) -> float:
-        """Approximate training FLOPs/token (6N + attention term)."""
-        n = self.num_params()
+        """Approximate training FLOPs/token (6N + attention term).
+
+        Attention matmuls (QK^T, AV): 4*s*h per layer forward, x3 for
+        fwd+bwd, halved by causal masking -> 6*L*h*s per token.
+        """
         c = self.config
-        attn = (12 * c.num_hidden_layers * c.hidden_size * seq_len) / 2
-        return 6.0 * n + 6.0 * attn
+        n = self.num_params()
+        attn = 6.0 * c.num_hidden_layers * c.hidden_size * seq_len
+        return 6.0 * n + attn

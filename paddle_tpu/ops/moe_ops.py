@@ -1,0 +1,114 @@
+"""MoE auxiliary ops.
+
+Reference parity: the expert-parallel helper kernels under phi/kernels —
+`number_count` (gpu/number_count_kernel.cu), `assign_pos`
+(gpu/assign_pos_kernel.cu), `limit_by_capacity`
+(gpu/limit_by_capacity_kernel.cu), `prune_gate_by_capacity`
+(gpu/prune_gate_by_capacity_kernel.cu), `random_routing`
+(gpu/random_routing_kernel.cu) — used by
+python/paddle/incubate/distributed/models/moe/moe_layer.py.
+
+TPU-native: all are small integer-housekeeping ops; they lower to XLA
+scatter/sort/cumsum HLOs (no custom kernels needed — the hot path is the
+dispatch einsum + all-to-all in MoELayer, not these).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .dispatch import dispatch, ensure_tensor
+
+
+def number_count(numbers, upper_range):
+    """Count occurrences of each value in [0, upper_range).
+
+    numbers: int Tensor of expert indices (any shape). Returns int32 Tensor
+    [upper_range] (int64 is unavailable without x64 mode). Out-of-range
+    values (e.g. -1 pruned tokens) are ignored.
+    """
+    e = int(upper_range)
+
+    def fwd(a):
+        a = a.reshape(-1)
+        valid = (a >= 0) & (a < e)
+        idx = jnp.where(valid, a, 0)
+        return jnp.zeros((e,), jnp.int32).at[idx].add(
+            valid.astype(jnp.int32))
+    return dispatch("number_count", fwd, ensure_tensor(numbers))
+
+
+def assign_pos(x, cum_count=None):
+    """Token order grouped by expert: output[j] = index of the token that is
+    j-th in expert-major order (stable within an expert). Pruned tokens
+    (index < 0) sort to the tail, after every expert's block.
+
+    Matches the reference semantics (assign_pos_kernel: scatter token ids into
+    per-expert slots given cumulative counts); here a stable argsort.
+    """
+    def fwd(a):
+        a = a.reshape(-1)
+        big = jnp.iinfo(a.dtype).max
+        keyed = jnp.where(a < 0, big, a)
+        return jnp.argsort(keyed, stable=True).astype(jnp.int32)
+    return dispatch("assign_pos", fwd, ensure_tensor(x))
+
+
+def limit_by_capacity(expert_count, capacity, n_worker):
+    """Clip per-(expert, worker) counts so each expert's global total does not
+    exceed `capacity`, allocating capacity to workers in rank order.
+
+    expert_count: int Tensor [n_expert * n_worker] (expert-major).
+    capacity: int Tensor [n_expert]. Returns the clipped counts, same shape.
+    """
+    w = int(n_worker)
+
+    def fwd(ec, cap):
+        ec2 = ec.reshape(-1, w)
+        prefix = jnp.cumsum(ec2, axis=1) - ec2
+        allowed = jnp.clip(cap[:, None] - prefix, 0, None)
+        return jnp.minimum(ec2, allowed).reshape(-1).astype(ec.dtype)
+    return dispatch("limit_by_capacity", fwd, ensure_tensor(expert_count),
+                    ensure_tensor(capacity))
+
+
+def prune_gate_by_capacity(gate_idx, expert_count, n_expert=None,
+                           n_worker=None):
+    """Set gate indices of tokens that overflow their expert's (already
+    limited) count to -1; earlier tokens have priority (stable order).
+
+    gate_idx: int Tensor [tokens]; expert_count: int Tensor [n_expert] or
+    [n_expert * n_worker] (summed over workers).
+    """
+    e = int(n_expert) if n_expert is not None else None
+
+    def fwd(gi, ec):
+        ne = e if e is not None else ec.reshape(-1).shape[0]
+        if ec.ndim > 1 or (n_worker and int(n_worker) > 1):
+            ec = ec.reshape(ne, -1).sum(axis=1)
+        gi_flat = gi.reshape(-1)
+        valid = (gi_flat >= 0) & (gi_flat < ne)
+        oh = jax.nn.one_hot(jnp.where(valid, gi_flat, 0), ne,
+                            dtype=jnp.int32) * valid[:, None].astype(jnp.int32)
+        rank = jnp.cumsum(oh, axis=0) - oh
+        my_rank = (rank * oh).sum(-1)
+        keep = valid & (my_rank < ec[jnp.where(valid, gi_flat, 0)])
+        return jnp.where(keep, gi_flat, -1).reshape(gi.shape)
+    return dispatch("prune_gate_by_capacity", fwd, ensure_tensor(gate_idx),
+                    ensure_tensor(expert_count))
+
+
+def random_routing(topk_idx, topk_value, prob):
+    """GShard second-expert random routing: keep the 2nd choice only when
+    prob < 2 * its gate value, else route to -1 (dropped).
+
+    topk_idx/topk_value: [tokens, k>=2]; prob: [tokens] uniform samples.
+    """
+    def fwd(idx, val, p):
+        if idx.shape[-1] < 2:
+            return idx
+        keep = p < 2.0 * val[:, 1]
+        second = jnp.where(keep, idx[:, 1], -1)
+        return idx.at[:, 1].set(second)
+    return dispatch("random_routing", fwd, ensure_tensor(topk_idx),
+                    ensure_tensor(topk_value), ensure_tensor(prob))

@@ -54,3 +54,35 @@ def sequence_axis() -> Optional[str]:
 
 def batch_axes():
     return _ctx.batch_axes
+
+
+def sharding_constraint(arr, *entries):
+    """Annotate `arr` with a PartitionSpec over the ambient mesh (no-op when no
+    mesh is active or every named axis is degenerate).
+
+    Entries are mesh-axis names (or None). This is how explicit-layout ops
+    (MoE all-to-all dispatch, sequence resharding) tell GSPMD where the data
+    must live — the compiler then materialises the movement as all-to-all /
+    collective-permute on ICI (reference's global_scatter/global_gather NCCL
+    ops, phi/kernels/gpu/global_scatter_kernel.cu, become these HLOs).
+    """
+    mesh = _ctx.mesh
+    if mesh is None:
+        return arr
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+    names = set(mesh.dim_names)
+    norm = []
+    for e in entries:
+        if e is None:
+            norm.append(None)
+        elif isinstance(e, (tuple, list)):
+            keep = [a for a in e if a in names and mesh.get_dim_size(a) > 1]
+            norm.append(tuple(keep) if keep else None)
+        else:
+            norm.append(e if e in names and mesh.get_dim_size(e) > 1 else None)
+    if all(e is None for e in norm):
+        return arr
+    norm = norm[:arr.ndim] + [None] * (arr.ndim - len(norm))
+    return jax.lax.with_sharding_constraint(
+        arr, NamedSharding(mesh.to_jax(), PartitionSpec(*norm)))

@@ -39,15 +39,16 @@ from ..distributed.fleet.meta_parallel import get_param_annotation
 
 
 def make_hybrid_mesh(dp: int = 1, mp: int = 1, pp: int = 1, sharding: int = 1,
-                     sep: int = 1) -> ProcessMesh:
+                     sep: int = 1, ep: int = 1) -> ProcessMesh:
     """Build the fleet-style hybrid mesh over local devices.
 
-    Axis order (outer→inner): dp, pp, sep, sharding, mp — mp innermost so TP
-    collectives ride adjacent-device ICI links (reference topology.py:298
+    Axis order (outer→inner): dp, pp, sep, sharding, ep, mp — mp innermost so
+    TP collectives ride adjacent-device ICI links (reference topology.py:298
     creates groups in pp->mp->sep->sharding->dp order for the same reason).
+    ep shards MoE expert banks (all-to-all dispatch stays within-replica).
     """
-    shape = [dp, pp, sep, sharding, mp]
-    names = ["dp", "pp", "sep", "sharding", "mp"]
+    shape = [dp, pp, sep, sharding, ep, mp]
+    names = ["dp", "pp", "sep", "sharding", "ep", "mp"]
     n = int(np.prod(shape))
     return ProcessMesh(shape=shape, dim_names=names,
                        process_ids=list(range(n)))
