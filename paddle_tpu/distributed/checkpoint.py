@@ -2,11 +2,21 @@
 
 Reference parity: dist.save_state_dict/load_state_dict
 (python/paddle/distributed/checkpoint/save_state_dict.py:135,
-load_state_dict.py:526) with Metadata (checkpoint/metadata.py:20-44). TPU-native
-v1: each host writes its addressable shards + a metadata JSON; load reads
-metadata, reassembles global arrays, and re-applies the target sharding (XLA
-handles placement) — cross-config resharding falls out of `shard_tensor` on the
-new mesh. Async save via a background thread (orbax-style).
+load_state_dict.py:526) with Metadata (checkpoint/metadata.py:20-44 —
+state_dict_metadata + storage_metadata + flat_mapping).
+
+TPU-native design: every rank writes only its *addressable shards* — one
+.npy file per shard chunk, tagged with its global offsets in the metadata —
+no gather, no redundant bytes (replicated shards are written once, by
+replica 0). Load computes, for each target shard under the NEW sharding/
+mesh, the set of overlapping saved chunks, memory-maps just those files
+(npy mmap => only the overlapping byte ranges are actually paged in),
+assembles the shard buffer on its device, and builds the global array with
+jax.make_array_from_single_device_arrays — the reference's overlap/reshard
+algorithm with XLA arrays instead of p2p sends. Works for any mesh/sharding
+change between save and load; incomplete coverage is a hard error, not a
+silent zero-fill. async_save snapshots device->host synchronously, then
+writes in a background thread.
 """
 from __future__ import annotations
 
@@ -14,105 +24,285 @@ import json
 import os
 import pickle
 import threading
-from typing import Dict, Optional
+import time
+from typing import Dict, List, Optional, Tuple
 
-import numpy as np
 import jax
+import jax.numpy as jnp
+import ml_dtypes
+import numpy as np
 
 from ..tensor import Tensor
 
 _META_NAME = "metadata.json"
+_FORMAT_VERSION = 2
 _async_lock = threading.Lock()
 
 
-def _flatten(state_dict, prefix=""):
+def _flatten(state_dict, prefix="", parents=None):
+    """Flat {path: leaf}; `parents` (if a dict is passed) additionally maps
+    path -> (container, leaf_key) so leaves whose keys contain '.'/'/' can
+    be written back without re-parsing the path."""
     out = {}
     for k, v in state_dict.items():
-        key = f"{prefix}.{k}" if prefix else str(k)
+        key = f"{prefix}/{k}" if prefix else str(k)
         if isinstance(v, dict):
-            out.update(_flatten(v, key))
+            out.update(_flatten(v, key, parents))
         else:
             out[key] = v
+            if parents is not None:
+                parents[key] = (state_dict, k)
     return out
 
 
-def _unflatten(flat: Dict):
-    root: Dict = {}
-    for k, v in flat.items():
-        parts = k.split(".")
-        cur = root
-        for p in parts[:-1]:
-            cur = cur.setdefault(p, {})
-        cur[parts[-1]] = v
-    return root
+def _is_array(v) -> bool:
+    return isinstance(v, (Tensor, jax.Array, np.ndarray))
+
+
+def _as_jax(v):
+    return v._data if isinstance(v, Tensor) else jnp.asarray(v)
+
+
+def _shard_chunks(arr: jax.Array) -> List[Tuple[List[List[int]], np.ndarray]]:
+    """[(offsets [[start, stop] per dim], host chunk)] for shards this
+    process must persist (replica 0 only, so replicated values are written
+    exactly once across the fleet)."""
+    chunks = []
+    shards = getattr(arr, "addressable_shards", None)
+    if not shards:
+        return [([[0, s] for s in arr.shape], np.asarray(arr))]
+    for sh in shards:
+        if sh.replica_id != 0:
+            continue
+        offs = []
+        for dim, sl in enumerate(sh.index):
+            start = 0 if sl.start is None else int(sl.start)
+            stop = arr.shape[dim] if sl.stop is None else int(sl.stop)
+            offs.append([start, stop])
+        chunks.append((offs, np.asarray(sh.data)))
+    return chunks
 
 
 def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
-                    async_save=False):
+                    async_save=False, unique_id: Optional[int] = None,
+                    barrier_timeout: float = 300.0):
+    """Write this process's shards of `state_dict` (nested dicts of
+    Tensor/array/python leaves) under `path` (or `path/<unique_id>`).
+    Returns the writer thread when async_save, else None."""
+    if unique_id is not None:
+        path = os.path.join(path, str(unique_id))
     os.makedirs(path, exist_ok=True)
     flat = _flatten(state_dict)
     rank = jax.process_index()
+    nprocs = jax.process_count()
+    rank_dir = f"rank_{rank}"
+    os.makedirs(os.path.join(path, rank_dir), exist_ok=True)
+
+    # snapshot device->host NOW so the caller may keep training (async)
+    meta_state: Dict[str, Dict] = {}
+    npy_payload: List[Tuple[str, np.ndarray]] = []
+    py_payload: Dict[str, object] = {}
+    storage: Dict[str, List[Dict]] = {}
+    counter = 0
+    for key, v in flat.items():
+        if _is_array(v):
+            arr = _as_jax(v)
+            meta_state[key] = {"shape": [int(s) for s in arr.shape],
+                               "dtype": str(arr.dtype)}
+            entries = []
+            for offs, chunk in _shard_chunks(arr):
+                fname = f"{rank_dir}/c{counter}.npy"
+                counter += 1
+                npy_payload.append((fname, chunk))
+                entries.append({"file": fname, "offsets": offs,
+                                "cdtype": str(chunk.dtype)})
+            storage[key] = entries
+        else:
+            meta_state[key] = {"py": True}
+            py_payload[key] = v
+            storage[key] = [{"file": f"{rank_dir}/py.pkl", "chunk": key,
+                             "offsets": None}]
 
     def _do_save():
-        meta = {"state": {}, "storage": {}}
-        shard_file = os.path.join(path, f"shard_{rank}.pkl")
-        payload = {}
-        for key, t in flat.items():
-            if isinstance(t, Tensor):
-                arr = np.asarray(t._data)
-                meta["state"][key] = {"shape": list(arr.shape),
-                                      "dtype": str(arr.dtype)}
-                meta["storage"][key] = f"shard_{rank}.pkl"
-                payload[key] = arr
-            else:
-                meta["state"][key] = {"py": True}
-                meta["storage"][key] = f"shard_{rank}.pkl"
-                payload[key] = t
-        with open(shard_file, "wb") as f:
-            pickle.dump(payload, f, protocol=4)
-        if rank == coordinator_rank:
-            with open(os.path.join(path, _META_NAME), "w") as f:
-                json.dump(meta, f)
+        with _async_lock:
+            for fname, chunk in npy_payload:
+                np.save(os.path.join(path, fname), chunk,
+                        allow_pickle=False)
+            if py_payload:
+                with open(os.path.join(path, rank_dir, "py.pkl"), "wb") as f:
+                    pickle.dump(py_payload, f, protocol=4)
+            with open(os.path.join(path, f"meta_{rank}.json"), "w") as f:
+                json.dump({"state": meta_state, "storage": storage}, f)
+            if rank == coordinator_rank:
+                # wait for every live rank's metadata (poor-man's barrier;
+                # multi-host file systems are shared for checkpoints)
+                expect = [os.path.join(path, f"meta_{r}.json")
+                          for r in range(nprocs)]
+                deadline = time.time() + barrier_timeout
+                while not all(os.path.exists(p) for p in expect):
+                    if time.time() > deadline:
+                        missing = [p for p in expect
+                                   if not os.path.exists(p)]
+                        raise TimeoutError(
+                            f"save_state_dict: rank metadata missing after "
+                            f"{barrier_timeout}s: {missing}")
+                    time.sleep(0.05)
+                # drop stale files from an earlier save with a larger world
+                for fn in os.listdir(path):
+                    if fn.startswith("meta_") and fn.endswith(".json"):
+                        r = int(fn[5:-5])
+                        if r >= nprocs:
+                            os.remove(os.path.join(path, fn))
+                merged_state, merged_storage = {}, {}
+                for p in expect:
+                    with open(p) as f:
+                        m = json.load(f)
+                    merged_state.update(m["state"])
+                    for k, entries in m["storage"].items():
+                        merged_storage.setdefault(k, []).extend(entries)
+                with open(os.path.join(path, _META_NAME), "w") as f:
+                    json.dump({"format": _FORMAT_VERSION,
+                               "world_size": nprocs,
+                               "state": merged_state,
+                               "storage": merged_storage}, f)
 
     if async_save:
-        t = threading.Thread(target=lambda: (_async_lock.acquire(),
-                                             _do_save(), _async_lock.release()))
-        t.daemon = True
+        t = threading.Thread(target=_do_save, daemon=True)
         t.start()
         return t
     _do_save()
+    return None
+
+
+def _resolve_dtype(name: str):
+    try:
+        return np.dtype(name)
+    except TypeError:
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _overlap(t_offs, c_offs):
+    """Intersection of two [start, stop] boxes; None if empty."""
+    sl_t, sl_c = [], []
+    for (ts, te), (cs, ce) in zip(t_offs, c_offs):
+        s, e = max(ts, cs), min(te, ce)
+        if s >= e:
+            return None
+        sl_t.append(slice(s - ts, e - ts))
+        sl_c.append(slice(s - cs, e - cs))
+    return tuple(sl_t), tuple(sl_c)
+
+
+class _ChunkReader:
+    """mmap-backed chunk access: only overlapping slices are paged in; the
+    pickled python-leaf files (small) are cached whole."""
+
+    def __init__(self, path):
+        self.path = path
+        self._pkl_cache: Dict[str, Dict] = {}
+
+    def array(self, fname, cdtype=None) -> np.ndarray:
+        arr = np.load(os.path.join(self.path, fname), mmap_mode="r",
+                      allow_pickle=False)
+        if arr.dtype.kind == "V" and cdtype:
+            # ml_dtypes (bfloat16, float8_*) round-trip npy as raw bytes;
+            # reinterpret with the dtype recorded at save time
+            arr = np.asarray(arr).view(_resolve_dtype(cdtype))
+        return arr
+
+    def py(self, fname, key):
+        if fname not in self._pkl_cache:
+            with open(os.path.join(self.path, fname), "rb") as f:
+                self._pkl_cache[fname] = pickle.load(f)
+        return self._pkl_cache[fname][key]
+
+
+def _assemble(key, offsets_box, entries, reader, dtype):
+    """Fill the [start,stop]-box buffer from every overlapping saved chunk;
+    raise if any element of the box is not covered by some chunk."""
+    shape = tuple(e - s for s, e in offsets_box)
+    buf = np.zeros(shape, dtype=dtype)
+    covered = np.zeros(shape, dtype=bool) if shape else np.zeros((), bool)
+    for ent in entries:
+        ov = _overlap(offsets_box, ent["offsets"])
+        if ov is None:
+            continue
+        sl_t, sl_c = ov
+        buf[sl_t] = reader.array(ent["file"], ent.get("cdtype"))[sl_c]
+        covered[sl_t] = True
+    if not covered.all():
+        raise ValueError(
+            f"checkpoint is missing data for '{key}' region {offsets_box}: "
+            f"{int((~covered).sum())} of {covered.size} elements uncovered "
+            "(incomplete or corrupted save)")
+    return buf
 
 
 def load_state_dict(state_dict, path, process_group=None,
-                    coordinator_rank=0, offload=False):
-    """Loads into the provided (possibly differently-sharded) state_dict."""
+                    coordinator_rank=0, offload=False,
+                    unique_id: Optional[int] = None):
+    """Load into the provided (possibly differently-sharded) state_dict.
+
+    Each target Tensor keeps its current sharding; its per-device shards are
+    assembled from whatever saved chunks overlap them (reshard-on-load)."""
+    if unique_id is not None:
+        path = os.path.join(path, str(unique_id))
     with open(os.path.join(path, _META_NAME)) as f:
         meta = json.load(f)
-    cache: Dict[str, Dict] = {}
-    flat_target = _flatten(state_dict)
+    fmt = meta.get("format")
+    if fmt != _FORMAT_VERSION:
+        raise ValueError(
+            f"checkpoint format {fmt!r} unsupported (expected "
+            f"{_FORMAT_VERSION}); re-save with this version")
+    reader = _ChunkReader(path)
+    parents = {}
+    flat_target = _flatten(state_dict, parents=parents)
     for key, target in flat_target.items():
         if key not in meta["storage"]:
             continue
-        fname = meta["storage"][key]
-        if fname not in cache:
-            with open(os.path.join(path, fname), "rb") as f:
-                cache[fname] = pickle.load(f)
-        value = cache[fname][key]
-        if isinstance(target, Tensor):
-            sharding = getattr(target._data, "sharding", None)
-            arr = jax.numpy.asarray(value, dtype=target._data.dtype)
-            if sharding is not None:
-                # reshard-on-load: place global values under the target sharding
-                arr = jax.device_put(arr, sharding)
-            target._data = arr.reshape(target._data.shape)
+        entries = meta["storage"][key]
+        info = meta["state"][key]
+        if info.get("py"):
+            container, leaf = parents[key]
+            container[leaf] = reader.py(entries[0]["file"],
+                                        entries[0]["chunk"])
+            continue
+        saved_shape = tuple(info["shape"])
+        if not _is_array(target):
+            # saved an array, target holds a plain python slot: materialize
+            # the full array and write it back
+            box = [[0, s] for s in saved_shape]
+            container, leaf = parents[key]
+            container[leaf] = _assemble(key, box, entries, reader,
+                                        np.dtype(info["dtype"]))
+            continue
+        tgt_arr = _as_jax(target)
+        dtype = tgt_arr.dtype  # numpy dtype (ml_dtypes covers bfloat16)
+        if tuple(tgt_arr.shape) != saved_shape:
+            raise ValueError(
+                f"{key}: saved shape {saved_shape} != target shape "
+                f"{tuple(tgt_arr.shape)} (reshard-on-load changes layout, "
+                "not logical shape)")
+        sharding = getattr(tgt_arr, "sharding", None)
+        shards = getattr(tgt_arr, "addressable_shards", None)
+        if sharding is None or not shards or \
+                isinstance(sharding, jax.sharding.SingleDeviceSharding):
+            box = [[0, s] for s in saved_shape]
+            new_arr = jnp.asarray(_assemble(key, box, entries, reader, dtype))
         else:
-            # plain python leaf: write back into the nested dict
-            parts = key.split(".")
-            cur = state_dict
-            for p in parts[:-1]:
-                cur = cur[p]
-            cur[parts[-1]] = value
-
-
-def get_checkpoint_files(path):
-    return [f for f in os.listdir(path) if f.startswith("shard_")]
+            per_device = []
+            for sh in shards:
+                offs = []
+                for dim, sl in enumerate(sh.index):
+                    start = 0 if sl.start is None else int(sl.start)
+                    stop = saved_shape[dim] if sl.stop is None else int(sl.stop)
+                    offs.append([start, stop])
+                buf = _assemble(key, offs, entries, reader, dtype)
+                per_device.append(jax.device_put(buf, sh.device))
+            new_arr = jax.make_array_from_single_device_arrays(
+                saved_shape, sharding, per_device)
+        if isinstance(target, Tensor):
+            target._data = new_arr
+        else:
+            container, leaf = parents[key]
+            container[leaf] = new_arr
