@@ -1,0 +1,104 @@
+"""Native runtime build + ctypes bindings.
+
+The C++ sources live in paddle_tpu/csrc/ (store.cpp: rendezvous TCPStore;
+shm_ring.cpp: shared-memory batch ring for the DataLoader). They are built
+on first use with g++ into this directory and loaded via ctypes (pybind11 is
+not available in this environment; the C ABI keeps the boundary trivial).
+
+`load()` returns the ctypes CDLL or None when no toolchain is available —
+callers fall back to pure-Python implementations.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_CSRC = os.path.join(os.path.dirname(_HERE), "csrc")
+_LIB = os.path.join(_HERE, "libpaddle_tpu_native.so")
+_SOURCES = ["store.cpp", "shm_ring.cpp"]
+
+_lock = threading.RLock()  # load() calls build() while holding it
+_lib = None
+_tried = False
+
+
+def _needs_build() -> bool:
+    if not os.path.exists(_LIB):
+        return True
+    lib_mtime = os.path.getmtime(_LIB)
+    return any(os.path.getmtime(os.path.join(_CSRC, s)) > lib_mtime
+               for s in _SOURCES)
+
+
+def build(verbose: bool = False) -> str:
+    """Compile the native library (idempotent; rebuilds when sources change)."""
+    with _lock:
+        if _needs_build():
+            srcs = [os.path.join(_CSRC, s) for s in _SOURCES]
+            cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-shared", "-pthread",
+                   *srcs, "-lrt", "-o", _LIB + ".tmp"]
+            if verbose:
+                print("building native runtime:", " ".join(cmd))
+            subprocess.run(cmd, check=True, capture_output=not verbose)
+            os.replace(_LIB + ".tmp", _LIB)  # atomic vs concurrent importers
+    return _LIB
+
+
+def load():
+    """CDLL with typed signatures, or None if the toolchain is missing."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        try:
+            build()
+        except (subprocess.CalledProcessError, FileNotFoundError, OSError):
+            return None
+        lib = ctypes.CDLL(_LIB)
+        c = ctypes
+        u8p = c.POINTER(c.c_uint8)
+
+        lib.pt_store_server_start.restype = c.c_void_p
+        lib.pt_store_server_start.argtypes = [c.c_int]
+        lib.pt_store_server_port.restype = c.c_int
+        lib.pt_store_server_port.argtypes = [c.c_void_p]
+        lib.pt_store_server_stop.argtypes = [c.c_void_p]
+        lib.pt_store_connect.restype = c.c_void_p
+        lib.pt_store_connect.argtypes = [c.c_char_p, c.c_int, c.c_int]
+        lib.pt_store_set.restype = c.c_int
+        lib.pt_store_set.argtypes = [c.c_void_p, c.c_char_p, u8p, c.c_uint64]
+        lib.pt_store_get.restype = c.c_int64
+        lib.pt_store_get.argtypes = [c.c_void_p, c.c_char_p, c.c_int64,
+                                     c.POINTER(u8p)]
+        lib.pt_store_add.restype = c.c_int64
+        lib.pt_store_add.argtypes = [c.c_void_p, c.c_char_p, c.c_int64]
+        lib.pt_store_del.restype = c.c_int
+        lib.pt_store_del.argtypes = [c.c_void_p, c.c_char_p]
+        lib.pt_store_check.restype = c.c_int
+        lib.pt_store_check.argtypes = [c.c_void_p, c.c_char_p]
+        lib.pt_store_disconnect.argtypes = [c.c_void_p]
+        lib.pt_store_free.argtypes = [u8p]
+
+        lib.pt_ring_create.restype = c.c_void_p
+        lib.pt_ring_create.argtypes = [c.c_char_p, c.c_uint64]
+        lib.pt_ring_open.restype = c.c_void_p
+        lib.pt_ring_open.argtypes = [c.c_char_p]
+        lib.pt_ring_push.restype = c.c_int
+        lib.pt_ring_push.argtypes = [c.c_void_p, u8p, c.c_uint64, c.c_int64]
+        lib.pt_ring_pop.restype = c.c_int64
+        lib.pt_ring_pop.argtypes = [c.c_void_p, c.POINTER(u8p), c.c_int64]
+        lib.pt_ring_close_write.argtypes = [c.c_void_p]
+        lib.pt_ring_destroy.argtypes = [c.c_void_p]
+        lib.pt_ring_free.argtypes = [u8p]
+        _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return load() is not None

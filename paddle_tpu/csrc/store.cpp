@@ -1,0 +1,309 @@
+// TCP key-value store for distributed bootstrap/rendezvous.
+//
+// Reference parity: paddle::distributed::TCPStore
+// (paddle/phi/core/distributed/store/tcp_store.h:121, socket impl
+// store/socket.cpp). The reference uses it to exchange NCCL unique ids and
+// run barriers; here it bootstraps multi-host meshes, coordinates
+// checkpoints and elastic membership. Collectives themselves are XLA HLOs —
+// this store is control-plane only, so a simple thread-per-connection
+// blocking server is the right complexity.
+//
+// Protocol (client -> server), little-endian:
+//   [u8 op][u32 klen][key bytes][u64 vlen][value bytes]
+//   op: 0=SET 1=GET(blocking, vlen=8: timeout_ms i64) 2=ADD(vlen=8: i64
+//       delta) 3=DEL 4=CHECK
+// Reply: SET/DEL -> [u8 ok]
+//        GET    -> [i64 len][bytes] (len=-1 on timeout)
+//        ADD    -> [i64 new_value]
+//        CHECK  -> [u8 exists]
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct KvState {
+  std::mutex mu;
+  std::condition_variable cv;
+  std::map<std::string, std::vector<uint8_t>> data;
+};
+
+struct Server {
+  int listen_fd = -1;
+  int port = 0;
+  KvState kv;
+  std::vector<std::thread> workers;
+  std::thread acceptor;
+  bool stopping = false;
+};
+
+bool read_full(int fd, void* buf, size_t n) {
+  auto* p = static_cast<uint8_t*>(buf);
+  size_t got = 0;
+  while (got < n) {
+    ssize_t r = ::read(fd, p + got, n - got);
+    if (r <= 0) return false;
+    got += static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  auto* p = static_cast<const uint8_t*>(buf);
+  size_t sent = 0;
+  while (sent < n) {
+    ssize_t r = ::write(fd, p + sent, n - sent);
+    if (r <= 0) return false;
+    sent += static_cast<size_t>(r);
+  }
+  return true;
+}
+
+void serve_conn(Server* s, int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  for (;;) {
+    uint8_t op;
+    uint32_t klen;
+    uint64_t vlen;
+    if (!read_full(fd, &op, 1) || !read_full(fd, &klen, 4)) break;
+    std::string key(klen, '\0');
+    if (klen && !read_full(fd, key.data(), klen)) break;
+    if (!read_full(fd, &vlen, 8)) break;
+    std::vector<uint8_t> val(vlen);
+    if (vlen && !read_full(fd, val.data(), vlen)) break;
+
+    if (op == 0) {  // SET
+      {
+        std::lock_guard<std::mutex> lk(s->kv.mu);
+        s->kv.data[key] = std::move(val);
+      }
+      s->kv.cv.notify_all();
+      uint8_t ok = 1;
+      if (!write_full(fd, &ok, 1)) break;
+    } else if (op == 1) {  // blocking GET with timeout
+      int64_t timeout_ms;
+      std::memcpy(&timeout_ms, val.data(), 8);
+      std::unique_lock<std::mutex> lk(s->kv.mu);
+      bool found = s->kv.cv.wait_for(
+          lk, std::chrono::milliseconds(timeout_ms),
+          [&] { return s->stopping || s->kv.data.count(key) > 0; });
+      if (found && !s->stopping) {
+        const auto& v = s->kv.data[key];
+        int64_t len = static_cast<int64_t>(v.size());
+        std::vector<uint8_t> out(v);  // copy under lock
+        lk.unlock();
+        if (!write_full(fd, &len, 8)) break;
+        if (len && !write_full(fd, out.data(), out.size())) break;
+      } else {
+        lk.unlock();
+        int64_t len = -1;
+        if (!write_full(fd, &len, 8)) break;
+      }
+    } else if (op == 2) {  // ADD (returns new value)
+      int64_t delta;
+      std::memcpy(&delta, val.data(), 8);
+      int64_t cur = 0;
+      {
+        std::lock_guard<std::mutex> lk(s->kv.mu);
+        auto it = s->kv.data.find(key);
+        if (it != s->kv.data.end() && it->second.size() == 8)
+          std::memcpy(&cur, it->second.data(), 8);
+        cur += delta;
+        std::vector<uint8_t> nv(8);
+        std::memcpy(nv.data(), &cur, 8);
+        s->kv.data[key] = std::move(nv);
+      }
+      s->kv.cv.notify_all();
+      if (!write_full(fd, &cur, 8)) break;
+    } else if (op == 3) {  // DEL
+      {
+        std::lock_guard<std::mutex> lk(s->kv.mu);
+        s->kv.data.erase(key);
+      }
+      uint8_t ok = 1;
+      if (!write_full(fd, &ok, 1)) break;
+    } else if (op == 4) {  // CHECK
+      uint8_t exists;
+      {
+        std::lock_guard<std::mutex> lk(s->kv.mu);
+        exists = s->kv.data.count(key) ? 1 : 0;
+      }
+      if (!write_full(fd, &exists, 1)) break;
+    } else {
+      break;
+    }
+  }
+  ::close(fd);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns server handle, or null on failure. port==0 picks a free port
+// (readable via pt_store_server_port).
+void* pt_store_server_start(int port) {
+  auto* s = new Server();
+  s->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (s->listen_fd < 0) {
+    delete s;
+    return nullptr;
+  }
+  int one = 1;
+  setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (bind(s->listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) <
+          0 ||
+      listen(s->listen_fd, 128) < 0) {
+    ::close(s->listen_fd);
+    delete s;
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(s->listen_fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  s->port = ntohs(addr.sin_port);
+  s->acceptor = std::thread([s] {
+    for (;;) {
+      int fd = ::accept(s->listen_fd, nullptr, nullptr);
+      if (fd < 0) break;  // listen_fd closed -> shutdown
+      s->workers.emplace_back([s, fd] { serve_conn(s, fd); });
+    }
+  });
+  return s;
+}
+
+int pt_store_server_port(void* h) { return static_cast<Server*>(h)->port; }
+
+void pt_store_server_stop(void* h) {
+  auto* s = static_cast<Server*>(h);
+  {
+    std::lock_guard<std::mutex> lk(s->kv.mu);
+    s->stopping = true;
+  }
+  s->kv.cv.notify_all();
+  ::shutdown(s->listen_fd, SHUT_RDWR);
+  ::close(s->listen_fd);
+  if (s->acceptor.joinable()) s->acceptor.join();
+  for (auto& t : s->workers)
+    if (t.joinable()) t.join();
+  delete s;
+}
+
+// ---- client ----------------------------------------------------------------
+
+struct Client {
+  int fd = -1;
+  std::mutex mu;  // one request in flight per client
+};
+
+void* pt_store_connect(const char* host, int port, int timeout_ms) {
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  for (;;) {
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return nullptr;
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+      ::close(fd);
+      return nullptr;
+    }
+    if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      auto* c = new Client();
+      c->fd = fd;
+      return c;
+    }
+    ::close(fd);
+    if (std::chrono::steady_clock::now() > deadline) return nullptr;
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  }
+}
+
+static bool send_req(Client* c, uint8_t op, const char* key, const void* val,
+                     uint64_t vlen) {
+  uint32_t klen = static_cast<uint32_t>(std::strlen(key));
+  return write_full(c->fd, &op, 1) && write_full(c->fd, &klen, 4) &&
+         write_full(c->fd, key, klen) && write_full(c->fd, &vlen, 8) &&
+         (vlen == 0 || write_full(c->fd, val, vlen));
+}
+
+int pt_store_set(void* h, const char* key, const uint8_t* val, uint64_t len) {
+  auto* c = static_cast<Client*>(h);
+  std::lock_guard<std::mutex> lk(c->mu);
+  if (!send_req(c, 0, key, val, len)) return -1;
+  uint8_t ok;
+  return read_full(c->fd, &ok, 1) && ok == 1 ? 0 : -1;
+}
+
+// Blocking get; returns malloc'd buffer via *out (caller frees with
+// pt_store_free). Returns length, or -1 on timeout/error.
+int64_t pt_store_get(void* h, const char* key, int64_t timeout_ms,
+                     uint8_t** out) {
+  auto* c = static_cast<Client*>(h);
+  std::lock_guard<std::mutex> lk(c->mu);
+  if (!send_req(c, 1, key, &timeout_ms, 8)) return -1;
+  int64_t len;
+  if (!read_full(c->fd, &len, 8)) return -1;
+  if (len < 0) return -1;
+  *out = static_cast<uint8_t*>(std::malloc(len ? len : 1));
+  if (len && !read_full(c->fd, *out, static_cast<size_t>(len))) {
+    std::free(*out);
+    return -1;
+  }
+  return len;
+}
+
+int64_t pt_store_add(void* h, const char* key, int64_t delta) {
+  auto* c = static_cast<Client*>(h);
+  std::lock_guard<std::mutex> lk(c->mu);
+  if (!send_req(c, 2, key, &delta, 8)) return INT64_MIN;
+  int64_t v;
+  if (!read_full(c->fd, &v, 8)) return INT64_MIN;
+  return v;
+}
+
+int pt_store_del(void* h, const char* key) {
+  auto* c = static_cast<Client*>(h);
+  std::lock_guard<std::mutex> lk(c->mu);
+  if (!send_req(c, 3, key, nullptr, 0)) return -1;
+  uint8_t ok;
+  return read_full(c->fd, &ok, 1) && ok == 1 ? 0 : -1;
+}
+
+int pt_store_check(void* h, const char* key) {
+  auto* c = static_cast<Client*>(h);
+  std::lock_guard<std::mutex> lk(c->mu);
+  if (!send_req(c, 4, key, nullptr, 0)) return -1;
+  uint8_t exists;
+  if (!read_full(c->fd, &exists, 1)) return -1;
+  return exists;
+}
+
+void pt_store_disconnect(void* h) {
+  auto* c = static_cast<Client*>(h);
+  ::close(c->fd);
+  delete c;
+}
+
+void pt_store_free(uint8_t* buf) { std::free(buf); }
+
+}  // extern "C"

@@ -53,15 +53,11 @@ def shard_tensor(data, mesh: Optional[ProcessMesh] = None, placements=None,
 
 
 def setattr_dist(t: Tensor, attr: DistAttr):
-    # Tensor uses __slots__; dist attrs live in a side table keyed by id.
-    _dist_table[id(t)] = attr
-
-
-_dist_table = {}
+    t._dist_attr = attr
 
 
 def get_dist_attr(t: Tensor) -> Optional[DistAttr]:
-    return _dist_table.get(id(t))
+    return getattr(t, "_dist_attr", None)
 
 
 def reshard(x: Tensor, mesh: ProcessMesh, placements) -> Tensor:

@@ -97,6 +97,13 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
     nprocs = jax.process_count()
     rank_dir = f"rank_{rank}"
     os.makedirs(os.path.join(path, rank_dir), exist_ok=True)
+    # every rank removes ITS stale metadata first so the coordinator's wait
+    # below can only be satisfied by this save's files. NOTE: concurrent
+    # saves into the same directory must use distinct unique_id (each save
+    # generation gets its own subdirectory), as in the reference.
+    stale = os.path.join(path, f"meta_{rank}.json")
+    if os.path.exists(stale):
+        os.remove(stale)
 
     # snapshot device->host NOW so the caller may keep training (async)
     meta_state: Dict[str, Dict] = {}
@@ -195,19 +202,25 @@ def _overlap(t_offs, c_offs):
 
 class _ChunkReader:
     """mmap-backed chunk access: only overlapping slices are paged in; the
-    pickled python-leaf files (small) are cached whole."""
+    pickled python-leaf files (small) are cached whole. Memmap handles are
+    cached so repeated overlaps with the same chunk reuse one mapping."""
 
     def __init__(self, path):
         self.path = path
         self._pkl_cache: Dict[str, Dict] = {}
+        self._mmap_cache: Dict[str, np.ndarray] = {}
 
     def array(self, fname, cdtype=None) -> np.ndarray:
-        arr = np.load(os.path.join(self.path, fname), mmap_mode="r",
-                      allow_pickle=False)
-        if arr.dtype.kind == "V" and cdtype:
-            # ml_dtypes (bfloat16, float8_*) round-trip npy as raw bytes;
-            # reinterpret with the dtype recorded at save time
-            arr = np.asarray(arr).view(_resolve_dtype(cdtype))
+        arr = self._mmap_cache.get(fname)
+        if arr is None:
+            arr = np.load(os.path.join(self.path, fname), mmap_mode="r",
+                          allow_pickle=False)
+            if arr.dtype.kind == "V" and cdtype:
+                # ml_dtypes (bfloat16, float8_*) round-trip npy as raw
+                # bytes; reinterpret the memmap in place (a full-array view
+                # keeps it lazy — only sliced ranges are paged in)
+                arr = arr.view(_resolve_dtype(cdtype))
+            self._mmap_cache[fname] = arr
         return arr
 
     def py(self, fname, key):
@@ -274,7 +287,7 @@ def load_state_dict(state_dict, path, process_group=None,
             box = [[0, s] for s in saved_shape]
             container, leaf = parents[key]
             container[leaf] = _assemble(key, box, entries, reader,
-                                        np.dtype(info["dtype"]))
+                                        _resolve_dtype(info["dtype"]))
             continue
         tgt_arr = _as_jax(target)
         dtype = tgt_arr.dtype  # numpy dtype (ml_dtypes covers bfloat16)

@@ -21,19 +21,15 @@ from ...nn.layer.layers import Layer
 from ...tensor import Tensor
 from ..sharding_types import Replicate, Shard
 
-_TP_ANNOTATION = "_tp_placement"  # attr name on parameters: ("mp", dim) or None
-
-
 def annotate_param(param, axis_name: str, dim: Optional[int]):
-    """Record the mesh-axis sharding of a parameter (read by jit/pjit runner).
-    Tensor has __slots__, so annotations live in the dist side-table."""
-    from ..api import _dist_table
-    _dist_table[id(param)] = (axis_name, dim)
+    """Record the mesh-axis sharding of a parameter (read by jit/pjit
+    runner). Stored on the tensor itself (id-keyed side tables go stale when
+    ids are recycled after GC)."""
+    param._dist_attr = (axis_name, dim)
 
 
 def get_param_annotation(param):
-    from ..api import _dist_table
-    v = _dist_table.get(id(param))
+    v = getattr(param, "_dist_attr", None)
     return v if isinstance(v, tuple) else None
 
 

@@ -1,0 +1,185 @@
+"""TCPStore: KV store + barrier for multi-host bootstrap.
+
+Reference parity: paddle::distributed::TCPStore
+(paddle/phi/core/distributed/store/tcp_store.h:121; Python surface
+paddle.distributed's create_or_get_global_tcp_store, parallel.py:1134).
+Backed by the C++ server/client in paddle_tpu/csrc/store.cpp (ctypes); a
+pure-Python fallback covers toolchain-less environments.
+
+On TPU this is control-plane only: collectives are XLA HLOs over ICI/DCN;
+the store bootstraps meshes, coordinates checkpoints and elastic membership
+(SURVEY §2.4 "keep a small host-side process group for bootstrap").
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+import time
+from typing import List, Optional
+
+from .. import _native
+
+
+class TCPStore:
+    """KV store. The master rank hosts the server in-process; every rank
+    (master included) connects a client to it."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 is_master: bool = False, world_size: int = 1,
+                 timeout: float = 900.0):
+        self.host = host
+        self.world_size = world_size
+        self.timeout = timeout
+        self._lib = _native.load()
+        self._server = None
+        self._client = None
+        self._py = None
+        if self._lib is None:
+            self._py = _PyStore(host, port, is_master, timeout)
+            self.port = self._py.port
+            return
+        if is_master:
+            self._server = self._lib.pt_store_server_start(port)
+            if not self._server:
+                raise RuntimeError(f"TCPStore: cannot bind port {port}")
+            port = self._lib.pt_store_server_port(self._server)
+        self.port = port
+        self._client = self._lib.pt_store_connect(
+            host.encode(), port, int(timeout * 1000))
+        if not self._client:
+            raise RuntimeError(f"TCPStore: cannot connect {host}:{port}")
+
+    # -- KV -------------------------------------------------------------------
+    def set(self, key: str, value) -> None:
+        data = value.encode() if isinstance(value, str) else bytes(value)
+        if self._py:
+            return self._py.set(key, data)
+        buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data) if data \
+            else None
+        rc = self._lib.pt_store_set(self._client, key.encode(), buf,
+                                    len(data))
+        if rc != 0:
+            raise RuntimeError(f"TCPStore.set({key}) failed")
+
+    def get(self, key: str, timeout: Optional[float] = None) -> bytes:
+        """Blocks until the key exists (up to timeout)."""
+        t = self.timeout if timeout is None else timeout
+        if self._py:
+            return self._py.get(key, t)
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        n = self._lib.pt_store_get(self._client, key.encode(),
+                                   int(t * 1000), ctypes.byref(out))
+        if n < 0:
+            raise TimeoutError(f"TCPStore.get({key}) timed out after {t}s")
+        data = ctypes.string_at(out, n) if n else b""
+        if n:
+            self._lib.pt_store_free(out)
+        return data
+
+    def add(self, key: str, amount: int = 1) -> int:
+        if self._py:
+            return self._py.add(key, amount)
+        v = self._lib.pt_store_add(self._client, key.encode(), amount)
+        if v == -(2 ** 63):
+            raise RuntimeError(f"TCPStore.add({key}) failed")
+        return int(v)
+
+    def delete_key(self, key: str) -> None:
+        if self._py:
+            return self._py.delete_key(key)
+        self._lib.pt_store_del(self._client, key.encode())
+
+    def check(self, keys: List[str]) -> bool:
+        if self._py:
+            return self._py.check(keys)
+        return all(self._lib.pt_store_check(self._client, k.encode()) == 1
+                   for k in keys)
+
+    def wait(self, keys: List[str], timeout: Optional[float] = None) -> None:
+        for k in keys:
+            self.get(k, timeout)
+
+    # -- barrier --------------------------------------------------------------
+    def barrier(self, prefix: str = "default",
+                timeout: Optional[float] = None) -> None:
+        """All `world_size` ranks must call with the same prefix."""
+        t = self.timeout if timeout is None else timeout
+        arrived = self.add(f"__barrier/{prefix}/count", 1)
+        if arrived == self.world_size:
+            self.set(f"__barrier/{prefix}/go", b"1")
+        self.get(f"__barrier/{prefix}/go", t)
+
+    def stop(self):
+        if self._py:
+            self._py.stop()
+        elif self._lib is not None:
+            if self._client:
+                self._lib.pt_store_disconnect(self._client)
+                self._client = None
+            if self._server:
+                self._lib.pt_store_server_stop(self._server)
+                self._server = None
+
+    def __del__(self):  # best effort
+        try:
+            self.stop()
+        except Exception:
+            pass
+
+
+class _PyStore:
+    """In-process fallback (single-host only) used when g++ is unavailable."""
+
+    def __init__(self, host, port, is_master, timeout):
+        self._data = {}
+        self._cv = threading.Condition()
+        self.port = port or 0
+
+    def set(self, key, data):
+        with self._cv:
+            self._data[key] = data
+            self._cv.notify_all()
+
+    def get(self, key, timeout):
+        with self._cv:
+            ok = self._cv.wait_for(lambda: key in self._data, timeout)
+            if not ok:
+                raise TimeoutError(f"get({key}) timed out")
+            return self._data[key]
+
+    def add(self, key, amount):
+        with self._cv:
+            cur = int.from_bytes(self._data.get(key, b"\0" * 8), "little",
+                                 signed=True) + amount
+            self._data[key] = cur.to_bytes(8, "little", signed=True)
+            self._cv.notify_all()
+            return cur
+
+    def delete_key(self, key):
+        with self._cv:
+            self._data.pop(key, None)
+
+    def check(self, keys):
+        with self._cv:
+            return all(k in self._data for k in keys)
+
+    def stop(self):
+        pass
+
+
+_global_store: List[Optional[TCPStore]] = [None]
+
+
+def create_or_get_global_tcp_store() -> TCPStore:
+    """Parity: core.create_or_get_global_tcp_store (parallel.py:1134)."""
+    if _global_store[0] is None:
+        master = os.environ.get("MASTER_ADDR", "127.0.0.1")
+        port = int(os.environ.get("MASTER_PORT", "0") or 0)
+        rank = int(os.environ.get("PADDLE_TRAINER_ID",
+                                  os.environ.get("RANK", "0")) or 0)
+        world = int(os.environ.get("PADDLE_TRAINERS_NUM",
+                                   os.environ.get("WORLD_SIZE", "1")) or 1)
+        _global_store[0] = TCPStore(master, port, is_master=(rank == 0),
+                                    world_size=world)
+    return _global_store[0]

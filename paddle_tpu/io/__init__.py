@@ -4,9 +4,15 @@ Reference parity: python/paddle/io/ (DataLoader io/reader.py:262, Dataset,
 BatchSampler; multiprocess iter io/dataloader/dataloader_iter.py:368). TPU-native
 note: the loader yields host numpy batches; device transfer happens on first op
 (jnp.asarray), and the training loop overlaps host loading with device compute
-thanks to XLA async dispatch. Multiprocess workers use a thread-based prefetcher
-(processes add little on TPU hosts where decode is rarely the bottleneck; a
-C++/shared-memory path is a planned optimization).
+thanks to XLA async dispatch.
+
+num_workers>0 with use_shared_memory (default) forks worker processes that
+fetch samples and push them through the native shared-memory ring
+(paddle_tpu/csrc/shm_ring.cpp) — the reference's shared-memory child-process
+transport (fluid/imperative/data_loader.cc) without a pipe syscall per batch.
+Workers must not touch jax (they only run dataset[i]); collation happens in
+the trainer process. Falls back to a thread prefetcher when the native
+runtime is unavailable.
 """
 from __future__ import annotations
 
@@ -279,6 +285,10 @@ class DataLoader:
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
+        self.use_shared_memory = use_shared_memory
+        self.worker_init_fn = worker_init_fn
+        self.timeout = timeout
+        self._shm_capacity = 8 << 20  # per-worker ring bytes
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -314,7 +324,15 @@ class DataLoader:
         if self.num_workers == 0:
             yield from self._iter_batches()
             return
-        # threaded prefetch pipeline
+        if (self.use_shared_memory and not self._iterable_mode
+                and self.batch_sampler is not None):
+            from . import shm_queue
+            if shm_queue.available():
+                yield from self._iter_multiprocess()
+                return
+        yield from self._iter_threaded()
+
+    def _iter_threaded(self):
         q: "queue.Queue" = queue.Queue(maxsize=self.num_workers
                                        * self.prefetch_factor)
         sentinel = object()
@@ -335,6 +353,96 @@ class DataLoader:
             yield item
         t.join()
 
+    def _iter_multiprocess(self):
+        """Fork workers; each fetches its round-robin share of batches and
+        pushes raw sample lists through a shared-memory ring; the parent
+        collates (workers never touch jax, keeping fork safe)."""
+        import os
+        from .shm_queue import ShmQueue
+
+        global _mp_seq
+        _mp_seq += 1
+        nw = self.num_workers
+        batches = list(self.batch_sampler)
+        tag = f"/ptdl_{os.getpid()}_{_mp_seq}"
+        queues = [ShmQueue(f"{tag}_{w}", capacity=self._shm_capacity)
+                  for w in range(nw)]
+        pids = []
+        import warnings
+        for w in range(nw):
+            with warnings.catch_warnings():
+                # workers run pure python/numpy (no jax), so the
+                # fork-in-multithreaded-process caveat does not apply
+                warnings.simplefilter("ignore", DeprecationWarning)
+                warnings.simplefilter("ignore", RuntimeWarning)
+                pid = os.fork()
+            if pid == 0:  # worker: plain python + numpy only
+                code = 0
+                try:
+                    qc = ShmQueue(f"{tag}_{w}", create=False)
+                    _worker_info.id = w
+                    _worker_info.num_workers = nw
+                    _worker_info.dataset = self.dataset
+                    if self.worker_init_fn is not None:
+                        self.worker_init_fn(w)
+                    for bi in range(w, len(batches), nw):
+                        samples = [self.dataset[i] for i in batches[bi]]
+                        qc.put(samples, timeout=self.timeout or 600.0)
+                    qc.close_write()
+                except BaseException as e:  # propagate to trainer
+                    try:
+                        qc.put({"__worker_error__": repr(e)})
+                        qc.close_write()
+                    except Exception:
+                        code = 1
+                finally:
+                    os._exit(code)
+            pids.append(pid)
+        try:
+            for bi in range(len(batches)):
+                w = bi % nw
+                item = queues[w].get(timeout=self.timeout or 600.0)
+                if item is None:
+                    raise RuntimeError(
+                        f"DataLoader worker {w} exited after delivering only "
+                        f"part of its batches (expected batch {bi})")
+                if isinstance(item, dict) and "__worker_error__" in item:
+                    raise RuntimeError(
+                        f"DataLoader worker {w} failed: "
+                        f"{item['__worker_error__']}")
+                yield self.collate_fn(item)
+        finally:
+            for q in queues:
+                q.close_write()
+            fail = None
+            for w, pid in enumerate(pids):
+                try:
+                    _, status = os.waitpid(pid, 0)
+                    if status != 0:
+                        fail = (w, status)
+                except ChildProcessError:
+                    pass
+            for q in queues:
+                q.destroy()
+            if fail is not None:
+                raise RuntimeError(
+                    f"DataLoader worker {fail[0]} exited with status "
+                    f"{fail[1]}")
+
+
+_mp_seq = 0
+
+
+class _WorkerInfo:
+    id: Optional[int] = None
+    num_workers: int = 0
+    dataset = None
+
+
+_worker_info = _WorkerInfo()
+
 
 def get_worker_info():
-    return None
+    """Parity: paddle.io.get_worker_info — None in the trainer process,
+    (id, num_workers, dataset) inside a loader worker."""
+    return _worker_info if _worker_info.id is not None else None

@@ -1,0 +1,74 @@
+"""Shared-memory batch queue over the native ring buffer.
+
+Reference parity: the reference DataLoader's shared-memory tensor transport
+between worker processes and the trainer (io/dataloader/dataloader_iter.py:368
+_DataLoaderIterMultiProcess; fluid/imperative/data_loader.cc). Here the C++
+ring (paddle_tpu/csrc/shm_ring.cpp) carries pickled sample batches: workers
+push without the GIL or a pipe syscall per message; the trainer pops.
+"""
+from __future__ import annotations
+
+import ctypes
+import pickle
+from typing import Any, Optional
+
+from .. import _native
+
+
+def available() -> bool:
+    return _native.available()
+
+
+class ShmQueue:
+    """Multi-producer/consumer byte-message queue in POSIX shared memory.
+
+    Create in the parent BEFORE forking workers; children attach with
+    ShmQueue(name, create=False).
+    """
+
+    def __init__(self, name: str, capacity: int = 64 << 20,
+                 create: bool = True):
+        self._lib = _native.load()
+        if self._lib is None:
+            raise RuntimeError("native runtime unavailable (no g++?)")
+        self.name = name
+        if create:
+            self._h = self._lib.pt_ring_create(name.encode(), capacity)
+        else:
+            self._h = self._lib.pt_ring_open(name.encode())
+        if not self._h:
+            raise RuntimeError(f"ShmQueue: cannot map segment {name!r}")
+
+    def put(self, obj: Any, timeout: float = 300.0) -> None:
+        data = pickle.dumps(obj, protocol=4)
+        buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+        rc = self._lib.pt_ring_push(self._h, buf, len(data),
+                                    int(timeout * 1000))
+        if rc == -2:
+            raise BrokenPipeError("queue closed")
+        if rc == -3:
+            raise ValueError(
+                f"message of {len(data)} bytes exceeds ring capacity")
+        if rc != 0:
+            raise TimeoutError("ShmQueue.put timed out")
+
+    def get(self, timeout: float = 300.0) -> Optional[Any]:
+        """Returns the next object, or None when closed and drained."""
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        n = self._lib.pt_ring_pop(self._h, ctypes.byref(out),
+                                  int(timeout * 1000))
+        if n == -2:
+            return None
+        if n < 0:
+            raise TimeoutError("ShmQueue.get timed out")
+        data = ctypes.string_at(out, n)
+        self._lib.pt_ring_free(out)
+        return pickle.loads(data)
+
+    def close_write(self) -> None:
+        self._lib.pt_ring_close_write(self._h)
+
+    def destroy(self) -> None:
+        if self._h:
+            self._lib.pt_ring_destroy(self._h)
+            self._h = None

@@ -30,7 +30,7 @@ def _op(name):
 
 class Tensor:
     __slots__ = ("_data", "stop_gradient", "grad", "_node", "_out_index", "name",
-                 "persistable", "__weakref__")
+                 "persistable", "_dist_attr", "__weakref__")
 
     def __init__(self, data, stop_gradient: bool = True, name: Optional[str] = None):
         if isinstance(data, Tensor):

@@ -1,0 +1,198 @@
+"""Native runtime tests: C++ TCPStore, shared-memory ring, multiprocess
+DataLoader (reference pattern: store tests + dataloader multiprocess tests)."""
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from paddle_tpu import _native
+
+pytestmark = pytest.mark.skipif(not _native.available(),
+                                reason="native toolchain unavailable")
+
+
+class TestTCPStore:
+    def test_kv_roundtrip(self):
+        from paddle_tpu.distributed.store import TCPStore
+        s = TCPStore(is_master=True, world_size=1)
+        try:
+            s.set("alpha", b"value-1")
+            assert s.get("alpha") == b"value-1"
+            s.set("alpha", "value-2")  # str accepted
+            assert s.get("alpha") == b"value-2"
+            assert s.check(["alpha"]) and not s.check(["beta"])
+            s.delete_key("alpha")
+            assert not s.check(["alpha"])
+        finally:
+            s.stop()
+
+    def test_add_and_timeout(self):
+        from paddle_tpu.distributed.store import TCPStore
+        s = TCPStore(is_master=True, world_size=1)
+        try:
+            assert s.add("ctr", 3) == 3
+            assert s.add("ctr", -1) == 2
+            with pytest.raises(TimeoutError):
+                s.get("never", timeout=0.2)
+        finally:
+            s.stop()
+
+    def test_multi_client_barrier(self):
+        from paddle_tpu.distributed.store import TCPStore
+        master = TCPStore(is_master=True, world_size=3)
+        errs = []
+
+        def rank(i):
+            try:
+                c = TCPStore(port=master.port, world_size=3)
+                c.barrier("b1", timeout=20)
+                c.stop()
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        threads = [threading.Thread(target=rank, args=(i,)) for i in range(2)]
+        for t in threads:
+            t.start()
+        master.barrier("b1", timeout=20)
+        for t in threads:
+            t.join(timeout=20)
+        master.stop()
+        assert not errs
+
+    def test_blocking_get_cross_thread(self):
+        from paddle_tpu.distributed.store import TCPStore
+        s = TCPStore(is_master=True, world_size=1)
+        try:
+            result = {}
+
+            def waiter():
+                result["v"] = s.get("late-key", timeout=10)
+
+            t = threading.Thread(target=waiter)
+            t.start()
+            import time
+            time.sleep(0.2)
+            c = TCPStore(port=s.port, world_size=1)
+            c.set("late-key", b"arrived")
+            t.join(timeout=10)
+            c.stop()
+            assert result["v"] == b"arrived"
+        finally:
+            s.stop()
+
+
+class TestShmRing:
+    def test_inprocess_fifo(self):
+        from paddle_tpu.io.shm_queue import ShmQueue
+        q = ShmQueue(f"/pt_t_{os.getpid()}_a", capacity=1 << 20)
+        try:
+            for i in range(50):
+                q.put((i, np.arange(64) + i))
+            for i in range(50):
+                j, arr = q.get(timeout=5)
+                assert j == i and arr[0] == i
+        finally:
+            q.destroy()
+
+    def test_wraparound_many_messages(self):
+        from paddle_tpu.io.shm_queue import ShmQueue
+        # ring much smaller than total bytes -> exercises wrap + blocking
+        q = ShmQueue(f"/pt_t_{os.getpid()}_b", capacity=64 << 10)
+        got = []
+
+        def consumer():
+            while True:
+                item = q.get(timeout=20)
+                if item is None:
+                    return
+                got.append(item[0])
+                assert item[1].sum() == item[0] * 1000
+
+        t = threading.Thread(target=consumer)
+        t.start()
+        for i in range(300):
+            q.put((i, np.full(1000, float(i))))
+        q.close_write()
+        t.join(timeout=30)
+        q.destroy()
+        assert got == list(range(300))
+
+    def test_oversize_message_rejected(self):
+        from paddle_tpu.io.shm_queue import ShmQueue
+        q = ShmQueue(f"/pt_t_{os.getpid()}_c", capacity=4096)
+        try:
+            with pytest.raises(ValueError):
+                q.put(np.zeros(10000))
+        finally:
+            q.destroy()
+
+    def test_cross_process(self):
+        from paddle_tpu.io.shm_queue import ShmQueue
+        name = f"/pt_t_{os.getpid()}_d"
+        q = ShmQueue(name, capacity=1 << 20)
+        pid = os.fork()
+        if pid == 0:
+            try:
+                qc = ShmQueue(name, create=False)
+                for i in range(100):
+                    qc.put(np.full(256, i))
+                qc.close_write()
+            finally:
+                os._exit(0)
+        for i in range(100):
+            arr = q.get(timeout=30)
+            assert arr[0] == i
+        os.waitpid(pid, 0)
+        q.destroy()
+
+
+class TestMultiprocessDataLoader:
+    def test_matches_serial(self):
+        import paddle_tpu
+        from paddle_tpu.io import DataLoader, Dataset
+
+        class Squares(Dataset):
+            def __len__(self):
+                return 37
+
+            def __getitem__(self, i):
+                return np.asarray([i, i * i], dtype=np.float32)
+
+        serial = [np.asarray(b._data) for b in
+                  DataLoader(Squares(), batch_size=5, num_workers=0)]
+        parallel = [np.asarray(b._data) for b in
+                    DataLoader(Squares(), batch_size=5, num_workers=3)]
+        assert len(serial) == len(parallel) == 8
+        for a, b in zip(serial, parallel):
+            np.testing.assert_array_equal(a, b)
+
+    def test_worker_error_propagates(self):
+        from paddle_tpu.io import DataLoader, Dataset
+
+        class Bad(Dataset):
+            def __len__(self):
+                return 10
+
+            def __getitem__(self, i):
+                if i == 7:
+                    raise RuntimeError("boom at 7")
+                return np.zeros(2, np.float32)
+
+        with pytest.raises(RuntimeError, match="worker"):
+            list(DataLoader(Bad(), batch_size=2, num_workers=2))
+
+    def test_two_epochs_reuse(self):
+        from paddle_tpu.io import DataLoader, Dataset
+
+        class Rng(Dataset):
+            def __len__(self):
+                return 12
+
+            def __getitem__(self, i):
+                return np.asarray([i], np.float32)
+
+        dl = DataLoader(Rng(), batch_size=4, num_workers=2)
+        e1 = [float(b._data[0, 0]) for b in dl]
+        e2 = [float(b._data[0, 0]) for b in dl]
+        assert e1 == e2 == [0.0, 4.0, 8.0]
