@@ -38,12 +38,13 @@ def build(verbose: bool = False) -> str:
     with _lock:
         if _needs_build():
             srcs = [os.path.join(_CSRC, s) for s in _SOURCES]
+            tmp = f"{_LIB}.{os.getpid()}.tmp"  # pid-unique: parallel ranks
             cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-shared", "-pthread",
-                   *srcs, "-lrt", "-o", _LIB + ".tmp"]
+                   *srcs, "-lrt", "-o", tmp]
             if verbose:
                 print("building native runtime:", " ".join(cmd))
             subprocess.run(cmd, check=True, capture_output=not verbose)
-            os.replace(_LIB + ".tmp", _LIB)  # atomic vs concurrent importers
+            os.replace(tmp, _LIB)  # atomic vs concurrent importers
     return _LIB
 
 

@@ -45,6 +45,8 @@ struct Server {
   int port = 0;
   KvState kv;
   std::vector<std::thread> workers;
+  std::vector<int> client_fds;
+  std::mutex conn_mu;
   std::thread acceptor;
   bool stopping = false;
 };
@@ -182,6 +184,8 @@ void* pt_store_server_start(int port) {
     for (;;) {
       int fd = ::accept(s->listen_fd, nullptr, nullptr);
       if (fd < 0) break;  // listen_fd closed -> shutdown
+      std::lock_guard<std::mutex> lk(s->conn_mu);
+      s->client_fds.push_back(fd);
       s->workers.emplace_back([s, fd] { serve_conn(s, fd); });
     }
   });
@@ -200,6 +204,11 @@ void pt_store_server_stop(void* h) {
   ::shutdown(s->listen_fd, SHUT_RDWR);
   ::close(s->listen_fd);
   if (s->acceptor.joinable()) s->acceptor.join();
+  {
+    // unblock serve_conn threads stuck in read() on live connections
+    std::lock_guard<std::mutex> lk(s->conn_mu);
+    for (int fd : s->client_fds) ::shutdown(fd, SHUT_RDWR);
+  }
   for (auto& t : s->workers)
     if (t.joinable()) t.join();
   delete s;

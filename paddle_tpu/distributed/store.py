@@ -31,6 +31,7 @@ class TCPStore:
         self.host = host
         self.world_size = world_size
         self.timeout = timeout
+        self._barrier_rounds = {}
         self._lib = _native.load()
         self._server = None
         self._client = None
@@ -73,8 +74,7 @@ class TCPStore:
         if n < 0:
             raise TimeoutError(f"TCPStore.get({key}) timed out after {t}s")
         data = ctypes.string_at(out, n) if n else b""
-        if n:
-            self._lib.pt_store_free(out)
+        self._lib.pt_store_free(out)  # buffer is malloc'd even when n == 0
         return data
 
     def add(self, key: str, amount: int = 1) -> int:
@@ -103,12 +103,16 @@ class TCPStore:
     # -- barrier --------------------------------------------------------------
     def barrier(self, prefix: str = "default",
                 timeout: Optional[float] = None) -> None:
-        """All `world_size` ranks must call with the same prefix."""
+        """All `world_size` ranks must call with the same prefix, the same
+        number of times (each call is its own rendezvous round)."""
         t = self.timeout if timeout is None else timeout
-        arrived = self.add(f"__barrier/{prefix}/count", 1)
+        rnd = self._barrier_rounds.get(prefix, 0)
+        self._barrier_rounds[prefix] = rnd + 1
+        key = f"__barrier/{prefix}/{rnd}"
+        arrived = self.add(f"{key}/count", 1)
         if arrived == self.world_size:
-            self.set(f"__barrier/{prefix}/go", b"1")
-        self.get(f"__barrier/{prefix}/go", t)
+            self.set(f"{key}/go", b"1")
+        self.get(f"{key}/go", t)
 
     def stop(self):
         if self._py:

@@ -389,6 +389,8 @@ class DataLoader:
                         samples = [self.dataset[i] for i in batches[bi]]
                         qc.put(samples, timeout=self.timeout or 600.0)
                     qc.close_write()
+                except BrokenPipeError:
+                    pass  # parent closed the ring (early break): clean exit
                 except BaseException as e:  # propagate to trainer
                     try:
                         qc.put({"__worker_error__": repr(e)})
@@ -398,6 +400,7 @@ class DataLoader:
                 finally:
                     os._exit(code)
             pids.append(pid)
+        completed = False
         try:
             for bi in range(len(batches)):
                 w = bi % nw
@@ -411,7 +414,10 @@ class DataLoader:
                         f"DataLoader worker {w} failed: "
                         f"{item['__worker_error__']}")
                 yield self.collate_fn(item)
+            completed = True
         finally:
+            import sys
+            in_flight = sys.exc_info()[0] is not None or not completed
             for q in queues:
                 q.close_write()
             fail = None
@@ -424,7 +430,9 @@ class DataLoader:
                     pass
             for q in queues:
                 q.destroy()
-            if fail is not None:
+            # don't mask the real exception (worker error / timeout) with a
+            # secondary status complaint
+            if fail is not None and not in_flight:
                 raise RuntimeError(
                     f"DataLoader worker {fail[0]} exited with status "
                     f"{fail[1]}")

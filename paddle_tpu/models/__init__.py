@@ -1,4 +1,9 @@
 """Flagship model families (parity targets from BASELINE.json configs)."""
-from . import gpt, llama  # noqa: F401
+from . import ernie, gpt, llama, unet  # noqa: F401
+from .ernie import (  # noqa: F401
+    ErnieConfig, ErnieForPretraining, ErnieForSequenceClassification,
+    ErnieModel,
+)
 from .gpt import GPTConfig, GPTForCausalLM, GPTModel  # noqa: F401
 from .llama import LlamaConfig, LlamaForCausalLM, LlamaModel  # noqa: F401
+from .unet import UNet2DConditionModel, UNetConfig  # noqa: F401

@@ -1,0 +1,200 @@
+"""ERNIE/BERT-style encoder family (BASELINE.json config #3).
+
+Reference parity: the ERNIE pretraining stack the reference's fleet API
+trains (PaddleNLP ernie modeling on top of fleet TP/DP; masked-LM +
+next-sentence objectives). TPU-native: encoder blocks built from the fleet
+TP layers (mp-axis annotations -> Megatron partitioning under the SPMD
+trainer); the pretraining entrypoint `ernie_pretrain_step` composes with
+fleet.distributed_model / SpmdTrainer.
+
+Post-LN transformer encoder (BERT/ERNIE-base layout): token + position +
+segment embeddings -> N blocks (MHA -> Add&LN -> FFN -> Add&LN) -> MLM head
+(tied to embeddings) + NSP head over the pooled [CLS].
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..distributed.fleet.meta_parallel import (ColumnParallelLinear,
+                                               RowParallelLinear,
+                                               VocabParallelEmbedding)
+from ..nn import functional as F
+from ..tensor import Tensor
+
+
+@dataclass
+class ErnieConfig:
+    vocab_size: int = 18000
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 4
+    layer_norm_eps: float = 1e-12
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+
+    @staticmethod
+    def ernie_base():
+        return ErnieConfig()
+
+    @staticmethod
+    def tiny(vocab_size=128, hidden_size=64, layers=2, heads=4, seq=32):
+        return ErnieConfig(vocab_size=vocab_size, hidden_size=hidden_size,
+                           num_hidden_layers=layers,
+                           num_attention_heads=heads,
+                           intermediate_size=hidden_size * 2,
+                           max_position_embeddings=seq,
+                           hidden_dropout_prob=0.0,
+                           attention_probs_dropout_prob=0.0)
+
+
+class ErnieEmbeddings(nn.Layer):
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        self.word_embeddings = VocabParallelEmbedding(config.vocab_size,
+                                                      config.hidden_size)
+        self.position_embeddings = nn.Embedding(
+            config.max_position_embeddings, config.hidden_size)
+        self.token_type_embeddings = nn.Embedding(config.type_vocab_size,
+                                                  config.hidden_size)
+        self.layer_norm = nn.LayerNorm(config.hidden_size,
+                                       epsilon=config.layer_norm_eps)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None):
+        s = input_ids.shape[1]
+        pos = Tensor(jnp.arange(s, dtype=jnp.int32))
+        emb = self.word_embeddings(input_ids) + self.position_embeddings(pos)
+        if token_type_ids is not None:
+            emb = emb + self.token_type_embeddings(token_type_ids)
+        return self.dropout(self.layer_norm(emb))
+
+
+class ErnieSelfAttention(nn.Layer):
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        h = config.hidden_size
+        self.num_heads = config.num_attention_heads
+        self.head_dim = h // self.num_heads
+        self.qkv = ColumnParallelLinear(h, 3 * h, has_bias=True)
+        self.out = RowParallelLinear(h, h, has_bias=True)
+        self.dropout_p = config.attention_probs_dropout_prob
+
+    def forward(self, x, attention_mask=None):
+        b, s, h = x.shape
+        qkv = self.qkv(x).reshape([b, s, 3, self.num_heads, self.head_dim])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attention_mask,
+            dropout_p=self.dropout_p if self.training else 0.0,
+            is_causal=False)
+        return self.out(out.reshape([b, s, h]))
+
+
+class ErnieBlock(nn.Layer):
+    """Post-LN encoder block (BERT layout)."""
+
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        self.attention = ErnieSelfAttention(config)
+        self.attn_norm = nn.LayerNorm(config.hidden_size,
+                                      epsilon=config.layer_norm_eps)
+        self.ffn_in = ColumnParallelLinear(config.hidden_size,
+                                           config.intermediate_size,
+                                           has_bias=True)
+        self.ffn_out = RowParallelLinear(config.intermediate_size,
+                                         config.hidden_size, has_bias=True)
+        self.ffn_norm = nn.LayerNorm(config.hidden_size,
+                                     epsilon=config.layer_norm_eps)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+
+    def forward(self, x, attention_mask=None):
+        x = self.attn_norm(x + self.dropout(self.attention(x,
+                                                           attention_mask)))
+        ff = self.ffn_out(F.gelu(self.ffn_in(x)))
+        return self.ffn_norm(x + self.dropout(ff))
+
+
+class ErnieModel(nn.Layer):
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = ErnieEmbeddings(config)
+        self.encoder = nn.LayerList([ErnieBlock(config)
+                                     for _ in range(config.num_hidden_layers)])
+        self.pooler = nn.Linear(config.hidden_size, config.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        h = self.embeddings(input_ids, token_type_ids)
+        for block in self.encoder:
+            h = block(h, attention_mask)
+        pooled = F.tanh(self.pooler(h[:, 0]))
+        return h, pooled
+
+
+class ErnieForPretraining(nn.Layer):
+    """MLM (tied decoder) + NSP heads; `compute_loss` mirrors the reference
+    pretraining criterion (masked positions use ignore_index=-100)."""
+
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        self.config = config
+        self.ernie = ErnieModel(config)
+        self.mlm_transform = nn.Linear(config.hidden_size, config.hidden_size)
+        self.mlm_norm = nn.LayerNorm(config.hidden_size,
+                                     epsilon=config.layer_norm_eps)
+        self.nsp_head = nn.Linear(config.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        h, pooled = self.ernie(input_ids, token_type_ids, attention_mask)
+        h = self.mlm_norm(F.gelu(self.mlm_transform(h)))
+        from ..ops.linalg import matmul
+        mlm_logits = matmul(h, self.ernie.embeddings.word_embeddings.weight,
+                            transpose_y=True)
+        nsp_logits = self.nsp_head(pooled)
+        return mlm_logits, nsp_logits
+
+    def compute_loss(self, mlm_logits, nsp_logits, mlm_labels,
+                     nsp_labels=None):
+        from ..ops.manipulation import reshape
+        b, s, v = mlm_logits.shape
+        loss = F.cross_entropy(reshape(mlm_logits, [b * s, v]),
+                               reshape(mlm_labels, [b * s]),
+                               ignore_index=-100)
+        if nsp_labels is not None:
+            loss = loss + F.cross_entropy(nsp_logits, nsp_labels)
+        return loss
+
+    def num_params(self):
+        return sum(p.numel() for p in self.parameters())
+
+
+class ErnieForSequenceClassification(nn.Layer):
+    def __init__(self, config: ErnieConfig, num_classes: int = 2,
+                 dropout: Optional[float] = None):
+        super().__init__()
+        self.ernie = ErnieModel(config)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob
+                                  if dropout is None else dropout)
+        self.classifier = nn.Linear(config.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        _, pooled = self.ernie(input_ids, token_type_ids, attention_mask)
+        return self.classifier(self.dropout(pooled))
+
+
+def ernie_pretrain_step(model, batch):
+    """Loss for one pretraining batch
+    {input_ids, token_type_ids, mlm_labels, nsp_labels}; usable as the
+    SpmdTrainer loss_fn via
+    `lambda m, *arrays: ernie_pretrain_step(m, dict(zip(keys, arrays)))`."""
+    mlm_logits, nsp_logits = model(batch["input_ids"],
+                                   batch.get("token_type_ids"))
+    return model.compute_loss(mlm_logits, nsp_logits, batch["mlm_labels"],
+                              batch.get("nsp_labels"))

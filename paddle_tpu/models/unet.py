@@ -1,0 +1,303 @@
+"""Stable-Diffusion-style conditional UNet (BASELINE.json config #5).
+
+Reference parity: the ppdiffusers UNet2DConditionModel the reference
+ecosystem trains/serves (conv + cross-attention blocks; the fused attention
+and group-norm kernels in phi/kernels/fusion are its hot ops). TPU-native:
+plain XLA convs + the framework's flash-attention path; GroupNorm/SiLU fuse
+into the surrounding convs under XLA.
+
+Structure (diffusers UNet2DConditionModel layout): conv_in -> down blocks
+(ResNet blocks + optional spatial transformer with self+cross attention,
+then stride-2 downsample) -> mid (res, attn, res) -> up blocks with skip
+concats and nearest-neighbour upsample -> GroupNorm/SiLU/conv_out. Timestep
+conditioning via sinusoidal embedding + 2-layer MLP added in every ResNet
+block; text conditioning via cross-attention over encoder_hidden_states.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import functional as F
+from ..ops.dispatch import dispatch, ensure_tensor
+from ..ops.manipulation import concat
+from ..tensor import Tensor
+
+
+@dataclass
+class UNetConfig:
+    in_channels: int = 4
+    out_channels: int = 4
+    block_out_channels: Tuple[int, ...] = (320, 640, 1280, 1280)
+    layers_per_block: int = 2
+    cross_attention_dim: int = 768
+    attention_head_dim: int = 8
+    norm_num_groups: int = 32
+    # levels with a spatial transformer (SD: all but the last down level)
+    attn_levels: Optional[Tuple[int, ...]] = None
+
+    @staticmethod
+    def sd15():
+        return UNetConfig()
+
+    @staticmethod
+    def tiny(ch=(32, 64), cross=32, groups=8):
+        return UNetConfig(in_channels=4, out_channels=4,
+                          block_out_channels=tuple(ch), layers_per_block=1,
+                          cross_attention_dim=cross, attention_head_dim=4,
+                          norm_num_groups=groups)
+
+    def attn_at(self, level: int) -> bool:
+        if self.attn_levels is not None:
+            return level in self.attn_levels
+        return level < len(self.block_out_channels) - 1
+
+
+def timestep_embedding(t, dim: int, max_period: float = 10000.0):
+    """Sinusoidal embedding [B] -> [B, dim] (diffusers get_timestep_embedding
+    semantics)."""
+    def fwd(ts):
+        ts = ts.reshape(-1).astype(jnp.float32)
+        half = dim // 2
+        freqs = jnp.exp(-math.log(max_period)
+                        * jnp.arange(half, dtype=jnp.float32) / half)
+        args = ts[:, None] * freqs[None, :]
+        emb = jnp.concatenate([jnp.sin(args), jnp.cos(args)], axis=-1)
+        if dim % 2:
+            emb = jnp.pad(emb, [(0, 0), (0, 1)])
+        return emb
+    return dispatch("timestep_embedding", fwd, ensure_tensor(t))
+
+
+class TimestepEmbedding(nn.Layer):
+    def __init__(self, in_dim, time_embed_dim):
+        super().__init__()
+        self.linear_1 = nn.Linear(in_dim, time_embed_dim)
+        self.linear_2 = nn.Linear(time_embed_dim, time_embed_dim)
+
+    def forward(self, emb):
+        return self.linear_2(F.silu(self.linear_1(emb)))
+
+
+class ResnetBlock2D(nn.Layer):
+    def __init__(self, in_ch, out_ch, temb_ch, groups):
+        super().__init__()
+        self.norm1 = nn.GroupNorm(min(groups, in_ch), in_ch)
+        self.conv1 = nn.Conv2D(in_ch, out_ch, 3, padding=1)
+        self.time_emb_proj = nn.Linear(temb_ch, out_ch)
+        self.norm2 = nn.GroupNorm(min(groups, out_ch), out_ch)
+        self.conv2 = nn.Conv2D(out_ch, out_ch, 3, padding=1)
+        self.conv_shortcut = nn.Conv2D(in_ch, out_ch, 1) \
+            if in_ch != out_ch else None
+
+    def forward(self, x, temb):
+        h = self.conv1(F.silu(self.norm1(x)))
+        t = self.time_emb_proj(F.silu(temb))
+        h = h + t.reshape([t.shape[0], t.shape[1], 1, 1])
+        h = self.conv2(F.silu(self.norm2(h)))
+        skip = x if self.conv_shortcut is None else self.conv_shortcut(x)
+        return skip + h
+
+
+class CrossAttention(nn.Layer):
+    def __init__(self, query_dim, context_dim, heads, head_dim):
+        super().__init__()
+        inner = heads * head_dim
+        self.heads = heads
+        self.head_dim = head_dim
+        self.to_q = nn.Linear(query_dim, inner, bias_attr=False)
+        self.to_k = nn.Linear(context_dim, inner, bias_attr=False)
+        self.to_v = nn.Linear(context_dim, inner, bias_attr=False)
+        self.to_out = nn.Linear(inner, query_dim)
+
+    def forward(self, x, context=None):
+        context = x if context is None else context
+        b, s, _ = x.shape
+        sk = context.shape[1]
+        q = self.to_q(x).reshape([b, s, self.heads, self.head_dim])
+        k = self.to_k(context).reshape([b, sk, self.heads, self.head_dim])
+        v = self.to_v(context).reshape([b, sk, self.heads, self.head_dim])
+        out = F.scaled_dot_product_attention(q, k, v, is_causal=False)
+        return self.to_out(out.reshape([b, s, self.heads * self.head_dim]))
+
+
+class TransformerBlock(nn.Layer):
+    """Self-attn -> cross-attn -> FF (diffusers BasicTransformerBlock)."""
+
+    def __init__(self, dim, context_dim, heads, head_dim):
+        super().__init__()
+        self.norm1 = nn.LayerNorm(dim)
+        self.attn1 = CrossAttention(dim, dim, heads, head_dim)
+        self.norm2 = nn.LayerNorm(dim)
+        self.attn2 = CrossAttention(dim, context_dim, heads, head_dim)
+        self.norm3 = nn.LayerNorm(dim)
+        self.ff_in = nn.Linear(dim, 4 * dim)
+        self.ff_out = nn.Linear(4 * dim, dim)
+
+    def forward(self, x, context):
+        x = x + self.attn1(self.norm1(x))
+        x = x + self.attn2(self.norm2(x), context)
+        return x + self.ff_out(F.gelu(self.ff_in(self.norm3(x))))
+
+
+class SpatialTransformer(nn.Layer):
+    """GroupNorm -> 1x1 in -> transformer over HW tokens -> 1x1 out + skip."""
+
+    def __init__(self, channels, context_dim, heads, groups):
+        super().__init__()
+        head_dim = max(channels // heads, 1)
+        self.norm = nn.GroupNorm(min(groups, channels), channels)
+        self.proj_in = nn.Conv2D(channels, channels, 1)
+        self.transformer = TransformerBlock(channels, context_dim, heads,
+                                            head_dim)
+        self.proj_out = nn.Conv2D(channels, channels, 1)
+
+    def forward(self, x, context):
+        b, c, h, w = x.shape
+        res = x
+        x = self.proj_in(self.norm(x))
+        x = x.reshape([b, c, h * w]).transpose([0, 2, 1])
+        x = self.transformer(x, context)
+        x = x.transpose([0, 2, 1]).reshape([b, c, h, w])
+        return res + self.proj_out(x)
+
+
+class Downsample(nn.Layer):
+    def __init__(self, ch):
+        super().__init__()
+        self.conv = nn.Conv2D(ch, ch, 3, stride=2, padding=1)
+
+    def forward(self, x):
+        return self.conv(x)
+
+
+class Upsample(nn.Layer):
+    def __init__(self, ch):
+        super().__init__()
+        self.conv = nn.Conv2D(ch, ch, 3, padding=1)
+
+    def forward(self, x):
+        x = F.interpolate(x, scale_factor=2, mode="nearest")
+        return self.conv(x)
+
+
+class UNet2DConditionModel(nn.Layer):
+    def __init__(self, config: UNetConfig = None, **kwargs):
+        super().__init__()
+        config = config or UNetConfig(**kwargs)
+        self.config = config
+        chs = config.block_out_channels
+        groups = config.norm_num_groups
+        temb_ch = chs[0] * 4
+        self.conv_in = nn.Conv2D(config.in_channels, chs[0], 3, padding=1)
+        self.time_embedding = TimestepEmbedding(chs[0], temb_ch)
+
+        # down
+        self.down_resnets = nn.LayerList()
+        self.down_attns = nn.LayerList()
+        self.downsamplers = nn.LayerList()
+        self._down_plan: List[Tuple[int, bool]] = []
+        ch = chs[0]
+        for level, out_ch in enumerate(chs):
+            for _ in range(config.layers_per_block):
+                self.down_resnets.append(
+                    ResnetBlock2D(ch, out_ch, temb_ch, groups))
+                use_attn = config.attn_at(level)
+                self.down_attns.append(
+                    SpatialTransformer(out_ch, config.cross_attention_dim,
+                                       config.attention_head_dim, groups)
+                    if use_attn else nn.Identity())
+                self._down_plan.append((out_ch, use_attn))
+                ch = out_ch
+            if level < len(chs) - 1:
+                self.downsamplers.append(Downsample(ch))
+
+        # mid
+        self.mid_res1 = ResnetBlock2D(ch, ch, temb_ch, groups)
+        self.mid_attn = SpatialTransformer(ch, config.cross_attention_dim,
+                                           config.attention_head_dim, groups)
+        self.mid_res2 = ResnetBlock2D(ch, ch, temb_ch, groups)
+
+        # up (mirror of down, consuming skip connections)
+        self.up_resnets = nn.LayerList()
+        self.up_attns = nn.LayerList()
+        self.upsamplers = nn.LayerList()
+        self._up_plan: List[bool] = []
+        skip_chs = [chs[0]]
+        for level, out_c in enumerate(chs):
+            skip_chs.extend([out_c] * config.layers_per_block)
+            if level < len(chs) - 1:
+                skip_chs.append(out_c)  # downsample output
+        for level in reversed(range(len(chs))):
+            out_ch = chs[level]
+            for _ in range(config.layers_per_block + 1):
+                skip = skip_chs.pop()
+                self.up_resnets.append(
+                    ResnetBlock2D(ch + skip, out_ch, temb_ch, groups))
+                use_attn = config.attn_at(level)
+                self.up_attns.append(
+                    SpatialTransformer(out_ch, config.cross_attention_dim,
+                                       config.attention_head_dim, groups)
+                    if use_attn else nn.Identity())
+                self._up_plan.append(use_attn)
+                ch = out_ch
+                if not skip_chs:
+                    break
+            if level > 0:
+                self.upsamplers.append(Upsample(ch))
+
+        self.conv_norm_out = nn.GroupNorm(min(groups, ch), ch)
+        self.conv_out = nn.Conv2D(ch, config.out_channels, 3, padding=1)
+
+    def forward(self, sample, timestep, encoder_hidden_states):
+        """sample [B, C, H, W]; timestep [B] (or scalar); context [B, L, D].
+        Returns the predicted noise, same shape as sample."""
+        cfg = self.config
+        temb = self.time_embedding(
+            timestep_embedding(timestep, cfg.block_out_channels[0]))
+
+        h = self.conv_in(sample)
+        skips = [h]
+        di = 0
+        ds = 0
+        for level in range(len(cfg.block_out_channels)):
+            for _ in range(cfg.layers_per_block):
+                h = self.down_resnets[di](h, temb)
+                attn = self.down_attns[di]
+                if not isinstance(attn, nn.Identity):
+                    h = attn(h, encoder_hidden_states)
+                skips.append(h)
+                di += 1
+            if level < len(cfg.block_out_channels) - 1:
+                h = self.downsamplers[ds](h)
+                skips.append(h)
+                ds += 1
+
+        h = self.mid_res1(h, temb)
+        h = self.mid_attn(h, encoder_hidden_states)
+        h = self.mid_res2(h, temb)
+
+        ui = 0
+        us = 0
+        for level in reversed(range(len(cfg.block_out_channels))):
+            for _ in range(cfg.layers_per_block + 1):
+                if not skips:
+                    break
+                h = concat([h, skips.pop()], axis=1)
+                h = self.up_resnets[ui](h, temb)
+                attn = self.up_attns[ui]
+                if not isinstance(attn, nn.Identity):
+                    h = attn(h, encoder_hidden_states)
+                ui += 1
+            if level > 0:
+                h = self.upsamplers[us](h)
+                us += 1
+
+        return self.conv_out(F.silu(self.conv_norm_out(h)))
+
+    def num_params(self):
+        return sum(p.numel() for p in self.parameters())

@@ -62,7 +62,9 @@ def _pool(name, x, ksize, stride, padding, nd, reducer, init, channel_last,
                 full[ax] = (pr[0], pr[1] + extra)
             pads = full
         if name.startswith("max"):
-            neg = (jnp.finfo(a.dtype).min if a.dtype.kind == "f"
+            # -inf (not finfo.min) so jax recognizes the max monoid and the
+            # reduce_window has a reverse-mode autodiff rule
+            neg = (-jnp.inf if a.dtype.kind == "f"
                    else jnp.iinfo(a.dtype).min)
             return lax.reduce_window(a, neg, lax.max, window, strides, pads)
         # avg pool

@@ -1,0 +1,166 @@
+"""MobileNetV3 (parity: python/paddle/vision/models/mobilenetv3.py —
+small/large variants with squeeze-excitation and hardswish)."""
+from __future__ import annotations
+
+from ... import nn
+from ...nn import functional as F
+
+__all__ = ["MobileNetV3Small", "MobileNetV3Large", "mobilenet_v3_small",
+           "mobilenet_v3_large"]
+
+
+def _make_divisible(v, divisor=8):
+    new_v = max(divisor, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class SqueezeExcitation(nn.Layer):
+    def __init__(self, input_channels, squeeze_channels):
+        super().__init__()
+        self.avgpool = nn.AdaptiveAvgPool2D(1)
+        self.fc1 = nn.Conv2D(input_channels, squeeze_channels, 1)
+        self.fc2 = nn.Conv2D(squeeze_channels, input_channels, 1)
+
+    def forward(self, x):
+        s = self.avgpool(x)
+        s = F.relu(self.fc1(s))
+        s = F.hardsigmoid(self.fc2(s), slope=0.2, offset=0.5)
+        return x * s
+
+
+class ConvNormActivation(nn.Sequential):
+    def __init__(self, in_ch, out_ch, kernel=3, stride=1, groups=1,
+                 activation="hardswish"):
+        padding = (kernel - 1) // 2
+        layers = [
+            nn.Conv2D(in_ch, out_ch, kernel, stride=stride, padding=padding,
+                      groups=groups, bias_attr=False),
+            nn.BatchNorm2D(out_ch),
+        ]
+        if activation == "relu":
+            layers.append(nn.ReLU())
+        elif activation == "hardswish":
+            layers.append(nn.Hardswish())
+        super().__init__(*layers)
+
+
+class InvertedResidual(nn.Layer):
+    def __init__(self, in_ch, exp_ch, out_ch, kernel, stride, use_se,
+                 activation):
+        super().__init__()
+        self.use_res = stride == 1 and in_ch == out_ch
+        layers = []
+        if exp_ch != in_ch:
+            layers.append(ConvNormActivation(in_ch, exp_ch, kernel=1,
+                                             activation=activation))
+        layers.append(ConvNormActivation(exp_ch, exp_ch, kernel=kernel,
+                                         stride=stride, groups=exp_ch,
+                                         activation=activation))
+        if use_se:
+            layers.append(SqueezeExcitation(exp_ch,
+                                            _make_divisible(exp_ch // 4)))
+        layers.append(ConvNormActivation(exp_ch, out_ch, kernel=1,
+                                         activation=None))
+        self.block = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+# (kernel, exp, out, use_se, activation, stride)
+_LARGE = [
+    (3, 16, 16, False, "relu", 1),
+    (3, 64, 24, False, "relu", 2),
+    (3, 72, 24, False, "relu", 1),
+    (5, 72, 40, True, "relu", 2),
+    (5, 120, 40, True, "relu", 1),
+    (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hardswish", 2),
+    (3, 200, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1),
+    (3, 480, 112, True, "hardswish", 1),
+    (3, 672, 112, True, "hardswish", 1),
+    (5, 672, 160, True, "hardswish", 2),
+    (5, 960, 160, True, "hardswish", 1),
+    (5, 960, 160, True, "hardswish", 1),
+]
+_SMALL = [
+    (3, 16, 16, True, "relu", 2),
+    (3, 72, 24, False, "relu", 2),
+    (3, 88, 24, False, "relu", 1),
+    (5, 96, 40, True, "hardswish", 2),
+    (5, 240, 40, True, "hardswish", 1),
+    (5, 240, 40, True, "hardswish", 1),
+    (5, 120, 48, True, "hardswish", 1),
+    (5, 144, 48, True, "hardswish", 1),
+    (5, 288, 96, True, "hardswish", 2),
+    (5, 576, 96, True, "hardswish", 1),
+    (5, 576, 96, True, "hardswish", 1),
+]
+
+
+class MobileNetV3(nn.Layer):
+    def __init__(self, config, last_channel, scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        in_ch = _make_divisible(16 * scale)
+        layers = [ConvNormActivation(3, in_ch, kernel=3, stride=2,
+                                     activation="hardswish")]
+        for k, exp, out, se, act, s in config:
+            exp_ch = _make_divisible(exp * scale)
+            out_ch = _make_divisible(out * scale)
+            layers.append(InvertedResidual(in_ch, exp_ch, out_ch, k, s, se,
+                                           act))
+            in_ch = out_ch
+        last_conv = _make_divisible(6 * in_ch)
+        layers.append(ConvNormActivation(in_ch, last_conv, kernel=1,
+                                         activation="hardswish"))
+        self.features = nn.Sequential(*layers)
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(last_conv, last_channel),
+                nn.Hardswish(),
+                nn.Dropout(0.2),
+                nn.Linear(last_channel, num_classes),
+            )
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+            x = self.classifier(x)
+        return x
+
+
+class MobileNetV3Small(MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_SMALL, _make_divisible(1024 * scale), scale,
+                         num_classes, with_pool)
+
+
+class MobileNetV3Large(MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_LARGE, _make_divisible(1280 * scale), scale,
+                         num_classes, with_pool)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights are not bundled")
+    return MobileNetV3Small(scale=scale, **kwargs)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights are not bundled")
+    return MobileNetV3Large(scale=scale, **kwargs)

@@ -1,0 +1,78 @@
+"""SqueezeNet (parity: python/paddle/vision/models/squeezenet.py —
+fire modules, 1.0/1.1 variants)."""
+from __future__ import annotations
+
+from ... import nn
+from ...ops.manipulation import concat
+
+__all__ = ["SqueezeNet", "squeezenet1_0", "squeezenet1_1"]
+
+
+class MakeFire(nn.Layer):
+    def __init__(self, in_ch, squeeze_ch, expand1x1_ch, expand3x3_ch):
+        super().__init__()
+        self._conv = nn.Conv2D(in_ch, squeeze_ch, 1)
+        self._conv_path1 = nn.Conv2D(squeeze_ch, expand1x1_ch, 1)
+        self._conv_path2 = nn.Conv2D(squeeze_ch, expand3x3_ch, 3, padding=1)
+        self._relu = nn.ReLU()
+
+    def forward(self, x):
+        x = self._relu(self._conv(x))
+        p1 = self._relu(self._conv_path1(x))
+        p2 = self._relu(self._conv_path2(x))
+        return concat([p1, p2], axis=1)
+
+
+class SqueezeNet(nn.Layer):
+    """Input [N, 3, 224, 224]. version in {'1.0', '1.1'}."""
+
+    def __init__(self, version: str = "1.0", num_classes: int = 1000,
+                 with_pool: bool = True):
+        super().__init__()
+        self.version = str(version)
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if self.version == "1.0":
+            self._conv = nn.Conv2D(3, 96, 7, stride=2)
+            fires = [(96, 16, 64, 64), (128, 16, 64, 64), (128, 32, 128, 128),
+                     (256, 32, 128, 128), (256, 48, 192, 192),
+                     (384, 48, 192, 192), (384, 64, 256, 256),
+                     (512, 64, 256, 256)]
+            self.pool_after = {0, 3}  # maxpool after these fire indices' input
+        elif self.version == "1.1":
+            self._conv = nn.Conv2D(3, 64, 3, stride=2, padding=1)
+            fires = [(64, 16, 64, 64), (128, 16, 64, 64), (128, 32, 128, 128),
+                     (256, 32, 128, 128), (256, 48, 192, 192),
+                     (384, 48, 192, 192), (384, 64, 256, 256),
+                     (512, 64, 256, 256)]
+            self.pool_after = {1, 3}
+        else:
+            raise ValueError(f"unsupported version {version!r}")
+        self._relu = nn.ReLU()
+        self._pool = nn.MaxPool2D(3, 2)
+        self.fires = nn.LayerList([MakeFire(*f) for f in fires])
+        self._drop = nn.Dropout(0.5)
+        self._conv_last = nn.Conv2D(512, num_classes, 1)
+        self._avg_pool = nn.AdaptiveAvgPool2D(1)
+
+    def forward(self, x):
+        x = self._pool(self._relu(self._conv(x)))
+        for i, fire in enumerate(self.fires):
+            x = fire(x)
+            if i in self.pool_after:
+                x = self._pool(x)
+        x = self._relu(self._conv_last(self._drop(x)))
+        x = self._avg_pool(x)
+        return x.flatten(1)
+
+
+def squeezenet1_0(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights are not bundled")
+    return SqueezeNet(version="1.0", **kwargs)
+
+
+def squeezenet1_1(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights are not bundled")
+    return SqueezeNet(version="1.1", **kwargs)
