@@ -99,6 +99,9 @@ from . import jit  # noqa: F401
 from . import distributed  # noqa: F401
 from . import vision  # noqa: F401
 from . import device  # noqa: F401
+from . import metric  # noqa: F401
+from . import hapi  # noqa: F401
+from .hapi import Model, summary  # noqa: F401
 from .framework.io import save, load  # noqa: F401
 from .nn.layer.layers import disable_static, enable_static, in_dynamic_mode  # noqa: F401
 

@@ -22,6 +22,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <chrono>
 #include <condition_variable>
 #include <cstdint>
@@ -147,6 +148,13 @@ void serve_conn(Server* s, int fd) {
     } else {
       break;
     }
+  }
+  {
+    // prune before close so server_stop never shutdown()s a recycled fd
+    std::lock_guard<std::mutex> lk(s->conn_mu);
+    s->client_fds.erase(
+        std::remove(s->client_fds.begin(), s->client_fds.end(), fd),
+        s->client_fds.end());
   }
   ::close(fd);
 }

@@ -1,0 +1,12 @@
+"""High-level API (parity: python/paddle/hapi/)."""
+from . import callbacks  # noqa: F401
+from .callbacks import (  # noqa: F401
+    Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger,
+    VisualDL,
+)
+from .model import Model  # noqa: F401
+
+
+def summary(net, input_size=None, dtypes=None):
+    """Parity: paddle.summary."""
+    return Model(net).summary(input_size, dtypes)

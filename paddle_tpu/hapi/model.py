@@ -1,0 +1,262 @@
+"""Keras-like Model API.
+
+Reference parity: paddle.Model (python/paddle/hapi/model.py:1472; fit :2200,
+DynamicGraphAdapter :1196). TPU-native: one adapter — eager model code, with
+`prepare(jit=True)` routing train/eval batches through `jit.to_static` so
+the whole step compiles to a single XLA program (the reference's
+static-graph adapter, done the trace-and-compile way).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .. import optimizer as opt_mod
+from ..io import DataLoader, Dataset
+from ..metric import Metric
+from ..tensor import Tensor, to_tensor
+from .callbacks import Callback, CallbackList, ModelCheckpoint, ProgBarLogger
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _tensorize(batch):
+    if isinstance(batch, (list, tuple)):
+        return [b if isinstance(b, Tensor) else to_tensor(b) for b in batch]
+    return [batch if isinstance(batch, Tensor) else to_tensor(batch)]
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self._jit = False
+        self._compiled_step = None
+        self._save_dir = None
+        self.stop_training = False
+
+    # -- setup ---------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None, jit: bool = False):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = _to_list(metrics)
+        for m in self._metrics:
+            if not isinstance(m, Metric):
+                raise TypeError(f"metric {m!r} is not a paddle.metric.Metric")
+        if jit and not self._jit:
+            # compile the network forward into one XLA program; backward
+            # flows through the compiled node's vjp (trace-and-compile
+            # analog of the reference's StaticGraphAdapter)
+            from ..jit import to_static
+            to_static(self.network)
+        self._jit = self._jit or jit
+
+    # -- single-batch ops ----------------------------------------------------
+    def _forward_loss(self, inputs, labels):
+        outputs = self.network(*inputs)
+        outs = _to_list(outputs)
+        loss = self._loss(*outs, *labels)
+        return loss, outputs
+
+    def train_batch(self, inputs, labels=None, update=True,
+                    loss_scale=1.0):
+        self.network.train()
+        inputs = _tensorize(inputs)
+        labels = _tensorize(labels) if labels is not None else []
+        loss, outputs = self._forward_loss(inputs, labels)
+        (loss * loss_scale if loss_scale != 1.0 else loss).backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = self._update_metrics(outputs, labels)
+        return [float(np.asarray(loss._data))], metrics
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        from ..autograd import no_grad
+        inputs = _tensorize(inputs)
+        labels = _tensorize(labels) if labels is not None else []
+        with no_grad():
+            loss, outputs = self._forward_loss(inputs, labels)
+        metrics = self._update_metrics(outputs, labels)
+        return [float(np.asarray(loss._data))], metrics
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        from ..autograd import no_grad
+        with no_grad():
+            out = self.network(*_tensorize(inputs))
+        return [np.asarray(o._data) for o in _to_list(out)]
+
+    def _update_metrics(self, outputs, labels):
+        res = []
+        outs = _to_list(outputs)
+        for m in self._metrics:
+            vals = m.compute(outs[0], *labels) if labels else outs[0]
+            if not isinstance(vals, tuple):
+                vals = (vals,)
+            m.update(*vals)
+            res.append(m.accumulate())
+        return res
+
+    # -- loops ---------------------------------------------------------------
+    def _loader(self, data, batch_size, shuffle, num_workers,
+                drop_last=False):
+        if data is None or isinstance(data, DataLoader):
+            return data
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          num_workers=num_workers, drop_last=drop_last)
+
+    def _metric_logs(self, loss, prefix=""):
+        logs = {prefix + "loss": loss[0]}
+        for m in self._metrics:
+            names = m.name() if isinstance(m.name(), list) else [m.name()]
+            accs = m.accumulate()
+            accs = accs if isinstance(accs, list) else [accs]
+            for n, a in zip(names, accs):
+                logs[prefix + n] = a
+        return logs
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        assert self._optimizer is not None and self._loss is not None, \
+            "call prepare(optimizer, loss) first"
+        self._save_dir = save_dir
+        loader = self._loader(train_data, batch_size, shuffle, num_workers,
+                              drop_last=drop_last)
+        eval_loader = self._loader(eval_data, batch_size, False, num_workers)
+
+        cbs = CallbackList([ProgBarLogger(log_freq, verbose=verbose)]
+                           + _to_list(callbacks))
+        if save_dir:
+            cbs.append(ModelCheckpoint(save_freq, save_dir))
+        cbs.set_model(self)
+        cbs.set_params({"epochs": epochs, "verbose": verbose,
+                        "metrics": ["loss"] + [m.name()
+                                               for m in self._metrics]})
+        cbs.on_train_begin()
+        steps_done = 0
+        for epoch in range(epochs):
+            for m in self._metrics:
+                m.reset()
+            cbs.on_epoch_begin(epoch)
+            logs = {}
+            pending_update = False
+            for step, batch in enumerate(loader):
+                cbs.on_train_batch_begin(step)
+                inputs, labels = self._split_batch(batch)
+                update = (step + 1) % accumulate_grad_batches == 0
+                loss, _ = self.train_batch(
+                    inputs, labels, update=update,
+                    loss_scale=1.0 / accumulate_grad_batches)
+                pending_update = not update
+                logs = self._metric_logs(loss)
+                cbs.on_train_batch_end(step, logs)
+                steps_done += 1
+                if num_iters is not None and steps_done >= num_iters:
+                    break
+            if pending_update:  # flush a partial accumulation window
+                self._optimizer.step()
+                self._optimizer.clear_grad()
+            cbs.on_epoch_end(epoch, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                self._run_eval(eval_loader, cbs)
+            if self.stop_training or any(
+                    getattr(cb, "stop_training", False)
+                    for cb in cbs.callbacks):
+                break
+            if num_iters is not None and steps_done >= num_iters:
+                break
+        cbs.on_train_end()
+
+    def _run_eval(self, loader, cbs):
+        for m in self._metrics:
+            m.reset()
+        cbs.on_eval_begin()
+        logs = {}
+        for step, batch in enumerate(loader):
+            cbs.on_eval_batch_begin(step)
+            inputs, labels = self._split_batch(batch)
+            loss, _ = self.eval_batch(inputs, labels)
+            logs = self._metric_logs(loss, prefix="eval_")
+            cbs.on_eval_batch_end(step, logs)
+        cbs.on_eval_end(logs)
+        return logs
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None):
+        loader = self._loader(eval_data, batch_size, False, num_workers)
+        cbs = CallbackList([ProgBarLogger(log_freq, verbose=verbose)]
+                           + _to_list(callbacks))
+        cbs.set_model(self)
+        cbs.set_params({"verbose": verbose})
+        return self._run_eval(loader, cbs)
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        loader = self._loader(test_data, batch_size, False, num_workers)
+        outputs = []
+        for batch in loader:
+            # datasets yielding (inputs..., label) keep working: the trailing
+            # element is dropped, matching fit/evaluate's split
+            inputs, _ = self._split_batch(batch)
+            outputs.append(self.predict_batch(inputs))
+        if stack_outputs and outputs:
+            n_out = len(outputs[0])
+            return [np.concatenate([o[i] for o in outputs])
+                    for i in range(n_out)]
+        return outputs
+
+    def _split_batch(self, batch, has_labels=True):
+        if isinstance(batch, (list, tuple)) and len(batch) >= 2 and has_labels:
+            return _to_list(batch[:-1]), _to_list(batch[-1])
+        return _to_list(batch), []
+
+    # -- persistence / info ---------------------------------------------------
+    def save(self, path, training=True):
+        from ..framework.io import save as fsave
+        fsave(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None and \
+                hasattr(self._optimizer, "state_dict"):
+            fsave(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        import os
+        from ..framework.io import load as fload
+        self.network.set_state_dict(fload(path + ".pdparams"))
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(opt_path) and \
+                hasattr(self._optimizer, "set_state_dict"):
+            self._optimizer.set_state_dict(fload(opt_path))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        n_params = sum(int(p.numel()) for p in self.network.parameters())
+        trainable = sum(int(p.numel()) for p in self.network.parameters()
+                        if not p.stop_gradient)
+        lines = [f"{type(self.network).__name__}: {n_params:,} params "
+                 f"({trainable:,} trainable)"]
+        for name, layer in self.network.named_sublayers():
+            own = sum(int(p.numel())
+                      for p in layer._parameters.values()) if hasattr(
+                layer, "_parameters") else 0
+            if own:
+                lines.append(f"  {name} ({type(layer).__name__}): {own:,}")
+        text = "\n".join(lines)
+        print(text)
+        return {"total_params": n_params, "trainable_params": trainable}

@@ -119,7 +119,8 @@ class StaticFunction:
         layer = self._layer
         in_tensors: List[Tensor] = []
         in_spec = _flatten_tensors(list(args), in_tensors)
-        static_key = (repr(sorted(kwargs.items())), repr(in_spec))
+        mode = layer.training if layer is not None else None
+        static_key = (repr(sorted(kwargs.items())), repr(in_spec), mode)
         self._static_tbl[static_key] = (kwargs, in_spec)
 
         state_tensors: List[Tensor] = []
@@ -139,7 +140,9 @@ class StaticFunction:
             state_arrays = dict(zip(names, arrays[:n_state]))
             outs, new_bufs = self._jitted(state_arrays, key,
                                           tuple(arrays[n_state:]), static_key)
-            return tuple(outs) + tuple(new_bufs)
+            combined = tuple(outs) + tuple(new_bufs)
+            # a 1-tuple would break the tape's vjp pytree contract
+            return combined if len(combined) != 1 else combined[0]
 
         result = dispatch("to_static", fwd, *all_inputs)
         if not isinstance(result, tuple):

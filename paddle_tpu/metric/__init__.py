@@ -1,0 +1,157 @@
+"""Metrics (parity: python/paddle/metric/metrics.py — Metric base with
+update/accumulate/reset/name, Accuracy, Precision, Recall, Auc)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..tensor import Tensor
+
+
+def _np(x):
+    if isinstance(x, Tensor):
+        return np.asarray(x._data)
+    return np.asarray(x)
+
+
+class Metric:
+    def __init__(self, name=None):
+        self._name = name or self.__class__.__name__.lower()
+
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def name(self):
+        return self._name
+
+    def compute(self, pred, label, *args):
+        """Optional preprocessing hook run on batch outputs before update."""
+        return pred, label
+
+
+class Accuracy(Metric):
+    """Top-k accuracy. update() takes correctness per sample (from
+    compute()), mirroring the reference two-stage protocol."""
+
+    def __init__(self, topk=(1,), name=None):
+        self.topk = (topk,) if isinstance(topk, int) else tuple(topk)
+        super().__init__(name or "acc")
+        self.reset()
+
+    def reset(self):
+        self.total = [0.0] * len(self.topk)
+        self.count = [0] * len(self.topk)
+
+    def compute(self, pred, label, *args):
+        """[n, classes] logits + [n] (or one-hot) labels -> [n, maxk]
+        correctness indicators."""
+        pred = _np(pred)
+        label = _np(label)
+        if label.ndim == pred.ndim and label.shape[-1] > 1:
+            label = label.argmax(-1)  # one-hot -> index
+        label = label.reshape(-1)
+        maxk = max(self.topk)
+        order = np.argsort(-pred, axis=-1)[..., :maxk]
+        return (order == label[:, None]).astype(np.float32)
+
+    def update(self, correct, *args):
+        correct = _np(correct)
+        n = correct.shape[0]
+        for i, k in enumerate(self.topk):
+            self.total[i] += float(correct[:, :k].max(axis=1).sum()) \
+                if correct.ndim > 1 else float(correct.sum())
+            self.count[i] += n
+        res = [t / max(c, 1) for t, c in zip(self.total, self.count)]
+        return res[0] if len(res) == 1 else res
+
+    def accumulate(self):
+        res = [t / max(c, 1) for t, c in zip(self.total, self.count)]
+        return res[0] if len(res) == 1 else res
+
+    def name(self):
+        if len(self.topk) == 1:
+            return self._name
+        return [f"{self._name}_top{k}" for k in self.topk]
+
+
+class Precision(Metric):
+    """Binary precision; pred is P(y=1) (threshold 0.5)."""
+
+    def __init__(self, name=None):
+        super().__init__(name or "precision")
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        p = (_np(preds).reshape(-1) > 0.5).astype(np.int64)
+        t = _np(labels).reshape(-1).astype(np.int64)
+        self.tp += int(((p == 1) & (t == 1)).sum())
+        self.fp += int(((p == 1) & (t == 0)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fp
+        return self.tp / denom if denom else 0.0
+
+
+class Recall(Metric):
+    """Binary recall; pred is P(y=1) (threshold 0.5)."""
+
+    def __init__(self, name=None):
+        super().__init__(name or "recall")
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        p = (_np(preds).reshape(-1) > 0.5).astype(np.int64)
+        t = _np(labels).reshape(-1).astype(np.int64)
+        self.tp += int(((p == 1) & (t == 1)).sum())
+        self.fn += int(((p == 0) & (t == 1)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fn
+        return self.tp / denom if denom else 0.0
+
+
+class Auc(Metric):
+    """ROC AUC via thresholded confusion histogram (reference algorithm)."""
+
+    def __init__(self, curve="ROC", num_thresholds=4095, name=None):
+        super().__init__(name or "auc")
+        self.num_thresholds = num_thresholds
+        self.reset()
+
+    def reset(self):
+        self._stat_pos = np.zeros(self.num_thresholds + 1)
+        self._stat_neg = np.zeros(self.num_thresholds + 1)
+
+    def update(self, preds, labels):
+        preds = _np(preds)
+        if preds.ndim == 2:
+            preds = preds[:, -1]  # P(y=1)
+        preds = preds.reshape(-1)
+        labels = _np(labels).reshape(-1)
+        idx = np.clip((preds * self.num_thresholds).astype(np.int64), 0,
+                      self.num_thresholds)
+        np.add.at(self._stat_pos, idx[labels == 1], 1)
+        np.add.at(self._stat_neg, idx[labels == 0], 1)
+
+    def accumulate(self):
+        tot_pos = tot_neg = auc = 0.0
+        for i in range(self.num_thresholds, -1, -1):
+            new_pos = tot_pos + self._stat_pos[i]
+            new_neg = tot_neg + self._stat_neg[i]
+            auc += (new_pos + tot_pos) * self._stat_neg[i] / 2.0
+            tot_pos, tot_neg = new_pos, new_neg
+        denom = tot_pos * tot_neg
+        return float(auc / denom) if denom else 0.0

@@ -200,7 +200,6 @@ class UNet2DConditionModel(nn.Layer):
         self.down_resnets = nn.LayerList()
         self.down_attns = nn.LayerList()
         self.downsamplers = nn.LayerList()
-        self._down_plan: List[Tuple[int, bool]] = []
         ch = chs[0]
         for level, out_ch in enumerate(chs):
             for _ in range(config.layers_per_block):
@@ -211,7 +210,6 @@ class UNet2DConditionModel(nn.Layer):
                     SpatialTransformer(out_ch, config.cross_attention_dim,
                                        config.attention_head_dim, groups)
                     if use_attn else nn.Identity())
-                self._down_plan.append((out_ch, use_attn))
                 ch = out_ch
             if level < len(chs) - 1:
                 self.downsamplers.append(Downsample(ch))
@@ -226,7 +224,6 @@ class UNet2DConditionModel(nn.Layer):
         self.up_resnets = nn.LayerList()
         self.up_attns = nn.LayerList()
         self.upsamplers = nn.LayerList()
-        self._up_plan: List[bool] = []
         skip_chs = [chs[0]]
         for level, out_c in enumerate(chs):
             skip_chs.extend([out_c] * config.layers_per_block)
@@ -243,7 +240,6 @@ class UNet2DConditionModel(nn.Layer):
                     SpatialTransformer(out_ch, config.cross_attention_dim,
                                        config.attention_head_dim, groups)
                     if use_attn else nn.Identity())
-                self._up_plan.append(use_attn)
                 ch = out_ch
                 if not skip_chs:
                     break

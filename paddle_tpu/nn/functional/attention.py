@@ -12,11 +12,13 @@ import math
 import jax
 import jax.numpy as jnp
 
+from ...framework.random import next_key
 from ...ops.dispatch import dispatch, ensure_tensor
 from ...tensor import Tensor
 
 
-def _sdpa_reference(q, k, v, mask=None, dropout_p=0.0, causal=False, scale=None):
+def _sdpa_reference(q, k, v, mask=None, dropout_p=0.0, causal=False,
+                    scale=None, key=None):
     """q,k,v: [batch, seq, heads, dim] (reference layout). Returns same layout."""
     d = q.shape[-1]
     s = scale if scale is not None else 1.0 / math.sqrt(d)
@@ -34,6 +36,9 @@ def _sdpa_reference(q, k, v, mask=None, dropout_p=0.0, causal=False, scale=None)
         else:
             scores = scores + mask.astype(jnp.float32)
     probs = jax.nn.softmax(scores, axis=-1)
+    if dropout_p > 0.0 and key is not None:
+        keep = jax.random.bernoulli(key, 1.0 - dropout_p, probs.shape)
+        probs = probs * keep / (1.0 - dropout_p)
     out = jnp.einsum("bhst,bthd->bshd", probs, v.astype(jnp.float32))
     return out.astype(q.dtype)
 
@@ -70,14 +75,19 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
                 "flash_attention",
                 lambda q, k, v: fa.flash_attention_bshd(q, k, v, causal=is_causal),
                 qt, kt, vt)
+    p_drop = float(dropout_p) if training else 0.0
+    key = next_key() if p_drop > 0.0 else None
     if attn_mask is not None:
         mt = ensure_tensor(attn_mask)
         return dispatch(
             "sdpa",
-            lambda q, k, v, m: _sdpa_reference(q, k, v, mask=m, causal=is_causal),
+            lambda q, k, v, m: _sdpa_reference(q, k, v, mask=m,
+                                               causal=is_causal,
+                                               dropout_p=p_drop, key=key),
             qt, kt, vt, mt)
     return dispatch(
-        "sdpa", lambda q, k, v: _sdpa_reference(q, k, v, causal=is_causal),
+        "sdpa", lambda q, k, v: _sdpa_reference(q, k, v, causal=is_causal,
+                                                dropout_p=p_drop, key=key),
         qt, kt, vt)
 
 

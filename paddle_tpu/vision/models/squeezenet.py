@@ -38,7 +38,7 @@ class SqueezeNet(nn.Layer):
                      (256, 32, 128, 128), (256, 48, 192, 192),
                      (384, 48, 192, 192), (384, 64, 256, 256),
                      (512, 64, 256, 256)]
-            self.pool_after = {0, 3}  # maxpool after these fire indices' input
+            self.pool_after = {2, 6}  # maxpool after 3rd and 7th fire
         elif self.version == "1.1":
             self._conv = nn.Conv2D(3, 64, 3, stride=2, padding=1)
             fires = [(64, 16, 64, 64), (128, 16, 64, 64), (128, 32, 128, 128),

@@ -1,0 +1,145 @@
+"""High-level API tests: Model.fit/evaluate/predict, metrics, callbacks
+(reference pattern: test/legacy_test/test_model.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.hapi import EarlyStopping
+from paddle_tpu.io import TensorDataset
+from paddle_tpu.metric import Accuracy, Auc, Precision, Recall
+
+
+def _cls_dataset(n=96, din=8, classes=3, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((n, din)).astype(np.float32)
+    y = (X @ rng.standard_normal((din, classes)).astype(np.float32)) \
+        .argmax(-1).astype(np.int64)
+    return TensorDataset([paddle.to_tensor(X), paddle.to_tensor(y)])
+
+
+def _mlp(din=8, classes=3):
+    return nn.Sequential(nn.Linear(din, 32), nn.ReLU(),
+                         nn.Linear(32, classes))
+
+
+class TestMetrics:
+    def test_accuracy_topk(self):
+        m = Accuracy(topk=(1, 2))
+        pred = np.asarray([[0.1, 0.9, 0.0], [0.8, 0.1, 0.1]])
+        label = np.asarray([1, 2])  # first correct, second in no top-2? no:
+        # sample 2 top-2 = {0, 1}, label 2 -> wrong for both k
+        correct = m.compute(pred, label)
+        m.update(correct)
+        acc1, acc2 = m.accumulate()
+        assert acc1 == 0.5 and acc2 == 0.5
+
+    def test_precision_recall(self):
+        p, r = Precision(), Recall()
+        preds = np.asarray([0.9, 0.8, 0.2, 0.7])
+        labels = np.asarray([1, 0, 1, 1])
+        p.update(preds, labels)
+        r.update(preds, labels)
+        assert abs(p.accumulate() - 2 / 3) < 1e-6  # tp=2 fp=1
+        assert abs(r.accumulate() - 2 / 3) < 1e-6  # tp=2 fn=1
+
+    def test_auc_perfect_and_random(self):
+        a = Auc()
+        a.update(np.asarray([0.9, 0.8, 0.1, 0.2]), np.asarray([1, 1, 0, 0]))
+        assert a.accumulate() == 1.0
+        a.reset()
+        a.update(np.asarray([0.5, 0.5, 0.5, 0.5]), np.asarray([1, 0, 1, 0]))
+        assert abs(a.accumulate() - 0.5) < 1e-6
+
+
+class TestModel:
+    def test_fit_evaluate_predict(self):
+        ds = _cls_dataset()
+        net = _mlp()
+        model = paddle.Model(net)
+        model.prepare(
+            paddle.optimizer.Adam(learning_rate=0.01,
+                                  parameters=net.parameters()),
+            nn.CrossEntropyLoss(), Accuracy())
+        model.fit(ds, ds, batch_size=16, epochs=3, verbose=0)
+        logs = model.evaluate(ds, batch_size=32, verbose=0)
+        assert logs["eval_acc"] > 0.75
+        preds = model.predict(ds, batch_size=32, stack_outputs=True)
+        assert preds[0].shape == (96, 3)
+
+    def test_save_load_roundtrip(self, tmp_path):
+        ds = _cls_dataset(seed=1)
+        net = _mlp()
+        model = paddle.Model(net)
+        model.prepare(
+            paddle.optimizer.Adam(learning_rate=0.01,
+                                  parameters=net.parameters()),
+            nn.CrossEntropyLoss(), Accuracy())
+        model.fit(ds, batch_size=16, epochs=2, verbose=0)
+        ref = model.evaluate(ds, verbose=0)["eval_acc"]
+        model.save(str(tmp_path / "ck"))
+        assert os.path.exists(tmp_path / "ck.pdparams")
+        assert os.path.exists(tmp_path / "ck.pdopt")
+
+        net2 = _mlp()
+        m2 = paddle.Model(net2)
+        m2.prepare(paddle.optimizer.Adam(learning_rate=0.01,
+                                         parameters=net2.parameters()),
+                   nn.CrossEntropyLoss(), Accuracy())
+        m2.load(str(tmp_path / "ck"))
+        assert abs(m2.evaluate(ds, verbose=0)["eval_acc"] - ref) < 1e-6
+
+    def test_jit_mode_trains(self):
+        ds = _cls_dataset(seed=2)
+        net = _mlp()
+        model = paddle.Model(net)
+        model.prepare(
+            paddle.optimizer.Adam(learning_rate=0.01,
+                                  parameters=net.parameters()),
+            nn.CrossEntropyLoss(), Accuracy(), jit=True)
+        model.fit(ds, batch_size=32, epochs=3, verbose=0)
+        assert model.evaluate(ds, verbose=0)["eval_acc"] > 0.7
+
+    def test_early_stopping(self):
+        ds = _cls_dataset(seed=3)
+        net = _mlp()
+        model = paddle.Model(net)
+        model.prepare(
+            paddle.optimizer.Adam(learning_rate=0.0,  # no progress
+                                  parameters=net.parameters()),
+            nn.CrossEntropyLoss(), Accuracy())
+        es = EarlyStopping(monitor="eval_acc", patience=1,
+                           save_best_model=False, verbose=0)
+        model.fit(ds, ds, batch_size=32, epochs=10, verbose=0, callbacks=[es])
+        assert es.stop_training  # halted long before 10 epochs
+
+    def test_callbacks_fire(self):
+        from paddle_tpu.hapi import Callback
+
+        class Counter(Callback):
+            def __init__(self):
+                super().__init__()
+                self.epochs = 0
+                self.batches = 0
+
+            def on_epoch_end(self, epoch, logs=None):
+                self.epochs += 1
+
+            def on_train_batch_end(self, step, logs=None):
+                self.batches += 1
+
+        ds = _cls_dataset()
+        net = _mlp()
+        model = paddle.Model(net)
+        model.prepare(paddle.optimizer.SGD(learning_rate=0.01,
+                                           parameters=net.parameters()),
+                      nn.CrossEntropyLoss())
+        c = Counter()
+        model.fit(ds, batch_size=16, epochs=2, verbose=0, callbacks=[c])
+        assert c.epochs == 2 and c.batches == 12
+
+    def test_summary(self):
+        info = paddle.summary(_mlp())
+        assert info["total_params"] == 8 * 32 + 32 + 32 * 3 + 3
