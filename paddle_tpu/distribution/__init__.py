@@ -1,0 +1,474 @@
+"""Probability distributions.
+
+Reference parity: python/paddle/distribution/ (Distribution base with
+sample/rsample/log_prob/entropy/kl_divergence, Normal, Uniform, Bernoulli,
+Categorical, Beta, Gamma, Dirichlet, Exponential, Laplace, LogNormal,
+Multinomial, kl_divergence registry). TPU-native: sampling draws from the
+framework PRNG (framework.random.next_key), so compiled programs get their
+randomness from the per-step key like every other random op.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, Tuple, Type
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.random import next_key
+from ..ops.dispatch import ensure_tensor
+from ..tensor import Tensor
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _shape(sample_shape, batch_shape):
+    return tuple(int(s) for s in sample_shape) + tuple(batch_shape)
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        import jax
+        return Tensor(jax.lax.stop_gradient(self.rsample(shape)._data))
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return Tensor(jnp.exp(self.log_prob(value)._data))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc).astype(jnp.float32)
+        self.scale = _arr(scale).astype(jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(self.scale ** 2, self.batch_shape))
+
+    def rsample(self, shape=()):
+        shp = _shape(shape, self.batch_shape)
+        eps = jax.random.normal(next_key(), shp)
+        return Tensor(self.loc + self.scale * eps)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        var = self.scale ** 2
+        return Tensor(-((v - self.loc) ** 2) / (2 * var)
+                      - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        e = 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale)
+        return Tensor(jnp.broadcast_to(e, self.batch_shape))
+
+    def cdf(self, value):
+        v = _arr(value)
+        return Tensor(0.5 * (1 + jax.scipy.special.erf(
+            (v - self.loc) / (self.scale * math.sqrt(2)))))
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _arr(loc).astype(jnp.float32)
+        self.scale = _arr(scale).astype(jnp.float32)
+        self._base = Normal(loc, scale)
+        super().__init__(self._base.batch_shape)
+
+    @property
+    def mean(self):
+        return Tensor(jnp.exp(self.loc + self.scale ** 2 / 2))
+
+    @property
+    def variance(self):
+        s2 = self.scale ** 2
+        return Tensor((jnp.exp(s2) - 1) * jnp.exp(2 * self.loc + s2))
+
+    def rsample(self, shape=()):
+        return Tensor(jnp.exp(self._base.rsample(shape)._data))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return Tensor(self._base.log_prob(jnp.log(v))._data - jnp.log(v))
+
+    def entropy(self):
+        return Tensor(self._base.entropy()._data + self.loc)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _arr(low).astype(jnp.float32)
+        self.high = _arr(high).astype(jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.low.shape,
+                                              self.high.shape))
+
+    @property
+    def mean(self):
+        return Tensor((self.low + self.high) / 2)
+
+    @property
+    def variance(self):
+        return Tensor((self.high - self.low) ** 2 / 12)
+
+    def rsample(self, shape=()):
+        shp = _shape(shape, self.batch_shape)
+        u = jax.random.uniform(next_key(), shp)
+        return Tensor(self.low + (self.high - self.low) * u)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        inside = (v >= self.low) & (v < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return Tensor(jnp.where(inside, lp, -jnp.inf))
+
+    def entropy(self):
+        return Tensor(jnp.broadcast_to(jnp.log(self.high - self.low),
+                                       self.batch_shape))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs=None, logits=None, name=None):
+        if (probs is None) == (logits is None):
+            raise ValueError("pass exactly one of probs/logits")
+        if probs is not None:
+            self.probs = _arr(probs).astype(jnp.float32)
+            self.logits = jnp.log(self.probs) - jnp.log1p(-self.probs)
+        else:
+            self.logits = _arr(logits).astype(jnp.float32)
+            self.probs = jax.nn.sigmoid(self.logits)
+        super().__init__(self.probs.shape)
+
+    @property
+    def mean(self):
+        return Tensor(self.probs)
+
+    @property
+    def variance(self):
+        return Tensor(self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        shp = _shape(shape, self.batch_shape)
+        return Tensor(jax.random.bernoulli(next_key(), self.probs, shp)
+                      .astype(jnp.float32))
+
+    def rsample(self, shape=()):
+        raise NotImplementedError("Bernoulli has no reparameterized sample")
+
+    def log_prob(self, value):
+        v = _arr(value).astype(jnp.float32)
+        return Tensor(v * jnp.log(self.probs)
+                      + (1 - v) * jnp.log1p(-self.probs))
+
+    def entropy(self):
+        p = self.probs
+        return Tensor(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is not None and probs is None:
+            # reference Categorical(logits) treats input as unnormalized
+            # NON-log scores when positive; follow jax convention: logits
+            self.logits = _arr(logits).astype(jnp.float32)
+        elif probs is not None:
+            self.probs_in = _arr(probs).astype(jnp.float32)
+            self.logits = jnp.log(self.probs_in
+                                  / self.probs_in.sum(-1, keepdims=True))
+        else:
+            raise ValueError("pass logits or probs")
+        self._log_norm = jax.nn.log_softmax(self.logits, axis=-1)
+        super().__init__(self.logits.shape[:-1])
+
+    @property
+    def probs(self):
+        return Tensor(jnp.exp(self._log_norm))
+
+    def sample(self, shape=()):
+        shp = _shape(shape, self.batch_shape)
+        return Tensor(jax.random.categorical(next_key(), self.logits,
+                                             shape=shp))
+
+    def log_prob(self, value):
+        v = _arr(value).astype(jnp.int32)
+        return Tensor(jnp.take_along_axis(self._log_norm, v[..., None],
+                                          axis=-1)[..., 0])
+
+    def entropy(self):
+        p = jnp.exp(self._log_norm)
+        return Tensor(-(p * self._log_norm).sum(-1))
+
+
+class Exponential(Distribution):
+    def __init__(self, rate):
+        self.rate = _arr(rate).astype(jnp.float32)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return Tensor(1.0 / self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(1.0 / self.rate ** 2)
+
+    def rsample(self, shape=()):
+        shp = _shape(shape, self.batch_shape)
+        u = jax.random.uniform(next_key(), shp, minval=1e-7, maxval=1.0)
+        return Tensor(-jnp.log(u) / self.rate)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return Tensor(jnp.log(self.rate) - self.rate * v)
+
+    def entropy(self):
+        return Tensor(1.0 - jnp.log(self.rate)
+                      + jnp.zeros(self.batch_shape))
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _arr(loc).astype(jnp.float32)
+        self.scale = _arr(scale).astype(jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(2 * self.scale ** 2,
+                                       self.batch_shape))
+
+    def rsample(self, shape=()):
+        shp = _shape(shape, self.batch_shape)
+        u = jax.random.uniform(next_key(), shp, minval=-0.5 + 1e-7,
+                               maxval=0.5)
+        return Tensor(self.loc - self.scale * jnp.sign(u)
+                      * jnp.log1p(-2 * jnp.abs(u)))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return Tensor(-jnp.abs(v - self.loc) / self.scale
+                      - jnp.log(2 * self.scale))
+
+    def entropy(self):
+        return Tensor(jnp.broadcast_to(1 + jnp.log(2 * self.scale),
+                                       self.batch_shape))
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate):
+        self.concentration = _arr(concentration).astype(jnp.float32)
+        self.rate = _arr(rate).astype(jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.concentration.shape,
+                                              self.rate.shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.concentration / self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(self.concentration / self.rate ** 2)
+
+    def rsample(self, shape=()):
+        shp = _shape(shape, self.batch_shape)
+        g = jax.random.gamma(next_key(), jnp.broadcast_to(
+            self.concentration, shp))
+        return Tensor(g / self.rate)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        a, b = self.concentration, self.rate
+        return Tensor(a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v
+                      - jax.scipy.special.gammaln(a))
+
+    def entropy(self):
+        a, b = self.concentration, self.rate
+        return Tensor(a - jnp.log(b) + jax.scipy.special.gammaln(a)
+                      + (1 - a) * jax.scipy.special.digamma(a))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta):
+        self.alpha = _arr(alpha).astype(jnp.float32)
+        self.beta = _arr(beta).astype(jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape,
+                                              self.beta.shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.alpha / (self.alpha + self.beta))
+
+    @property
+    def variance(self):
+        s = self.alpha + self.beta
+        return Tensor(self.alpha * self.beta / (s ** 2 * (s + 1)))
+
+    def rsample(self, shape=()):
+        shp = _shape(shape, self.batch_shape)
+        ga = jax.random.gamma(next_key(), jnp.broadcast_to(self.alpha, shp))
+        gb = jax.random.gamma(next_key(), jnp.broadcast_to(self.beta, shp))
+        return Tensor(ga / (ga + gb))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        a, b = self.alpha, self.beta
+        lbeta = (jax.scipy.special.gammaln(a) + jax.scipy.special.gammaln(b)
+                 - jax.scipy.special.gammaln(a + b))
+        return Tensor((a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v) - lbeta)
+
+    def entropy(self):
+        a, b = self.alpha, self.beta
+        lbeta = (jax.scipy.special.gammaln(a) + jax.scipy.special.gammaln(b)
+                 - jax.scipy.special.gammaln(a + b))
+        dg = jax.scipy.special.digamma
+        return Tensor(lbeta - (a - 1) * dg(a) - (b - 1) * dg(b)
+                      + (a + b - 2) * dg(a + b))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration):
+        self.concentration = _arr(concentration).astype(jnp.float32)
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    @property
+    def mean(self):
+        return Tensor(self.concentration
+                      / self.concentration.sum(-1, keepdims=True))
+
+    def rsample(self, shape=()):
+        shp = _shape(shape, self.concentration.shape)
+        g = jax.random.gamma(next_key(),
+                             jnp.broadcast_to(self.concentration, shp))
+        return Tensor(g / g.sum(-1, keepdims=True))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        a = self.concentration
+        lnorm = (jax.scipy.special.gammaln(a).sum(-1)
+                 - jax.scipy.special.gammaln(a.sum(-1)))
+        return Tensor(((a - 1) * jnp.log(v)).sum(-1) - lnorm)
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs):
+        self.total_count = int(total_count)
+        p = _arr(probs).astype(jnp.float32)
+        self.probs = p / p.sum(-1, keepdims=True)
+        super().__init__(self.probs.shape[:-1], self.probs.shape[-1:])
+
+    @property
+    def mean(self):
+        return Tensor(self.total_count * self.probs)
+
+    @property
+    def variance(self):
+        return Tensor(self.total_count * self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        shp = _shape(shape, self.batch_shape)
+        logits = jnp.log(self.probs)
+        draws = jax.random.categorical(
+            next_key(), logits, shape=(self.total_count,) + shp)
+        k = self.probs.shape[-1]
+        counts = jax.nn.one_hot(draws, k).sum(0)
+        return Tensor(counts)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        gammaln = jax.scipy.special.gammaln
+        return Tensor(gammaln(jnp.asarray(self.total_count + 1.0))
+                      - gammaln(v + 1).sum(-1)
+                      + (v * jnp.log(self.probs)).sum(-1))
+
+
+# ---- KL divergence registry --------------------------------------------------
+
+_KL_TABLE: Dict[Tuple[Type, Type], callable] = {}
+
+
+def register_kl(p_cls, q_cls):
+    def deco(fn):
+        _KL_TABLE[(p_cls, q_cls)] = fn
+        return fn
+    return deco
+
+
+def kl_divergence(p: Distribution, q: Distribution) -> Tensor:
+    fn = _KL_TABLE.get((type(p), type(q)))
+    if fn is None:
+        raise NotImplementedError(
+            f"kl_divergence({type(p).__name__}, {type(q).__name__})")
+    return fn(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    return Tensor(jnp.log((q.high - q.low) / (p.high - p.low)))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_cat(p, q):
+    pp = jnp.exp(p._log_norm)
+    return Tensor((pp * (p._log_norm - q._log_norm)).sum(-1))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bern(p, q):
+    a, b = p.probs, q.probs
+    return Tensor(a * (jnp.log(a) - jnp.log(b))
+                  + (1 - a) * (jnp.log1p(-a) - jnp.log1p(-b)))
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exp(p, q):
+    r = q.rate / p.rate
+    return Tensor(jnp.log(p.rate) - jnp.log(q.rate) + r - 1.0)

@@ -75,12 +75,38 @@ def _check_numerics(name, out):
                     raise FloatingPointError(msg)
 
 
+_prof = None  # lazily bound paddle_tpu.profiler (host tracer)
+
+
+def _prof_span(name):
+    """Open a RecordEvent for this op when the profiler is recording
+    (parity: the 'Dygraph Record Event' slot in eager_gen.py:372)."""
+    global _prof
+    if _prof is None:
+        from .. import profiler as _prof_mod
+        _prof = _prof_mod
+    if not _prof._tracer.enabled:
+        return None
+    ev = _prof.RecordEvent(name, _prof.TracerEventType.Operator)
+    ev.begin()
+    return ev
+
+
 def dispatch(name: str, fwd, *tensor_inputs: Tensor):
     """Run `fwd` over the arrays of `tensor_inputs`, recording a vjp node if needed.
 
     `fwd` takes jax arrays positionally (statics closed over) and returns one
     array or a tuple of arrays.
     """
+    span = _prof_span(name)
+    try:
+        return _dispatch_inner(name, fwd, tensor_inputs)
+    finally:
+        if span is not None:
+            span.end()
+
+
+def _dispatch_inner(name: str, fwd, tensor_inputs):
     arrays = _amp_cast(name, tuple(t._data for t in tensor_inputs))
     record = is_grad_enabled() and any(_is_diff(t) for t in tensor_inputs)
 

@@ -1,0 +1,277 @@
+"""Profiler.
+
+Reference parity: python/paddle/profiler/ (Profiler profiler.py:358 with
+states CLOSED/READY/RECORD/RECORD_AND_RETURN, ProfilerTarget, RecordEvent
+utils.py:47, make_scheduler, chrome-trace export, summary tables) wrapping
+the C++ host tracer + CUPTI (fluid/platform/profiler/).
+
+TPU-native: host-side annotations are recorded in-process (RecordEvent
+spans; the framework emits one per dispatched op when profiling is on), and
+device-side tracing delegates to jax.profiler (XLA's TPU trace), the
+platform's CUPTI equivalent. Chrome-trace JSON export merges host spans;
+device traces land in the jax.profiler log dir for TensorBoard.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from enum import Enum
+from typing import Callable, List, Optional
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM_DEVICE = 3
+    TPU = 4
+
+
+class TracerEventType(Enum):
+    Operator = 0
+    Dataloader = 1
+    ProfileStep = 2
+    Forward = 3
+    Backward = 4
+    Optimization = 5
+    Communication = 6
+    PythonOp = 7
+    UserDefined = 8
+
+
+class _HostTracer(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.events: List[dict] = []
+
+
+_tracer = _HostTracer()
+
+
+def _now_us() -> float:
+    return time.perf_counter_ns() / 1000.0
+
+
+class RecordEvent:
+    """Context manager / start-end span (parity: profiler/utils.py:47)."""
+
+    def __init__(self, name: str,
+                 event_type: TracerEventType = TracerEventType.UserDefined):
+        self.name = name
+        self.event_type = event_type
+        self._begin = None
+
+    def begin(self):
+        self._begin = _now_us()
+
+    def end(self):
+        if self._begin is None or not _tracer.enabled:
+            self._begin = None
+            return
+        _tracer.events.append({
+            "name": self.name, "cat": self.event_type.name, "ph": "X",
+            "ts": self._begin, "dur": _now_us() - self._begin,
+            "pid": os.getpid(), "tid": threading.get_ident() % 100000,
+        })
+        self._begin = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
+                   skip_first: int = 0) -> Callable[[int], ProfilerState]:
+    """State machine over step numbers (parity: profiler.make_scheduler)."""
+    period = closed + ready + record
+
+    def scheduler(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= repeat * period:
+            return ProfilerState.CLOSED
+        pos = s % period
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+    return scheduler
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
+    """on_trace_ready callback writing chrome://tracing JSON."""
+    def handler(prof: "Profiler"):
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or f"host_{os.getpid()}"
+        path = os.path.join(dir_name, f"{name}_{prof._export_seq}.json")
+        prof._export_seq += 1
+        with open(path, "w") as f:
+            json.dump({"traceEvents": prof._events}, f)
+        prof.last_export_path = path
+    return handler
+
+
+class Profiler:
+    """Parity: paddle.profiler.Profiler (profiler.py:358).
+
+    with Profiler(targets=[...], scheduler=(2, 5)) as p:
+        for batch: train(); p.step()
+    """
+
+    def __init__(self, *, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only: bool = False, record_shapes: bool = False,
+                 profile_memory: bool = False, with_flops: bool = False):
+        self.targets = list(targets or [ProfilerTarget.CPU])
+        if scheduler is None:
+            self._scheduler = lambda step: ProfilerState.RECORD
+        elif isinstance(scheduler, tuple):
+            start, end = scheduler
+            self._scheduler = make_scheduler(closed=max(start, 0), ready=0,
+                                             record=end - start, repeat=1)
+        else:
+            self._scheduler = scheduler
+        self.on_trace_ready = on_trace_ready
+        self.timer_only = timer_only
+        self._step = 0
+        self._state = ProfilerState.CLOSED
+        self._events: List[dict] = []
+        self._export_seq = 0
+        self.last_export_path = None
+        self._step_times: List[float] = []
+        self._last_step_ts = None
+        self._jax_trace_dir = None
+
+    # -- lifecycle ------------------------------------------------------------
+    def start(self):
+        self._state = self._scheduler(self._step)
+        self._apply_state()
+
+    def stop(self):
+        if self._state in (ProfilerState.RECORD,
+                           ProfilerState.RECORD_AND_RETURN):
+            self._collect()
+            self._finish_record()
+        self._state = ProfilerState.CLOSED
+        _tracer.enabled = False
+
+    def step(self, num_samples: Optional[int] = None):
+        now = _now_us()
+        if self._last_step_ts is not None:
+            self._step_times.append((now - self._last_step_ts) / 1000.0)
+        self._last_step_ts = now
+        prev = self._state
+        if prev in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
+            self._collect()
+        self._step += 1
+        self._state = self._scheduler(self._step)
+        if prev == ProfilerState.RECORD_AND_RETURN or (
+                prev == ProfilerState.RECORD
+                and self._state not in (ProfilerState.RECORD,
+                                        ProfilerState.RECORD_AND_RETURN)):
+            self._finish_record()
+        self._apply_state()
+
+    def _apply_state(self):
+        recording = self._state in (ProfilerState.RECORD,
+                                    ProfilerState.RECORD_AND_RETURN)
+        if recording and not _tracer.enabled:
+            _tracer.enabled = True
+            _tracer.events = []
+            if not self.timer_only and (
+                    ProfilerTarget.TPU in self.targets
+                    or ProfilerTarget.GPU in self.targets):
+                self._start_device_trace()
+        elif not recording and _tracer.enabled:
+            _tracer.enabled = False
+
+    def _start_device_trace(self):
+        if self._jax_trace_dir is not None:
+            return
+        import tempfile
+
+        import jax
+        self._jax_trace_dir = tempfile.mkdtemp(prefix="paddle_tpu_trace_")
+        try:
+            jax.profiler.start_trace(self._jax_trace_dir)
+        except Exception:
+            self._jax_trace_dir = None
+
+    def _collect(self):
+        self._events.extend(_tracer.events)
+        _tracer.events = []
+
+    def _finish_record(self):
+        if self._jax_trace_dir is not None:
+            import jax
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._jax_trace_dir = None
+        if self.on_trace_ready is not None:
+            self.on_trace_ready(self)
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- results --------------------------------------------------------------
+    def export(self, path: str, format: str = "json"):
+        with open(path, "w") as f:
+            json.dump({"traceEvents": self._events}, f)
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        by_name = {}
+        for e in self._events:
+            d = by_name.setdefault(e["name"], {"calls": 0, "total_us": 0.0})
+            d["calls"] += 1
+            d["total_us"] += e["dur"]
+        rows = sorted(by_name.items(), key=lambda kv: -kv[1]["total_us"])
+        lines = [f"{'name':<40} {'calls':>8} {'total(ms)':>12} {'avg(us)':>10}"]
+        for name, d in rows[:50]:
+            lines.append(f"{name:<40} {d['calls']:>8} "
+                         f"{d['total_us'] / 1000.0:>12.3f} "
+                         f"{d['total_us'] / max(d['calls'], 1):>10.1f}")
+        text = "\n".join(lines)
+        print(text)
+        return by_name
+
+    def step_info(self, unit=None) -> str:
+        if not self._step_times:
+            return "no steps recorded"
+        import numpy as np
+        arr = np.asarray(self._step_times)
+        return (f"steps: {len(arr)}, avg: {arr.mean():.3f} ms, "
+                f"p50: {np.percentile(arr, 50):.3f} ms, "
+                f"p99: {np.percentile(arr, 99):.3f} ms")
+
+
+def host_tracing_enabled() -> bool:
+    return _tracer.enabled
+
+
+def load_profiler_result(path: str):
+    with open(path) as f:
+        return json.load(f)

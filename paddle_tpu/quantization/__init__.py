@@ -1,0 +1,229 @@
+"""Quantization: QAT fake-quant + PTQ observers.
+
+Reference parity: python/paddle/quantization/ (QuantConfig config.py, QAT
+qat.py, PTQ ptq.py, observers under observer/, fake-quanter
+quanters/abs_max.py) — observer-collect-then-convert PTQ and
+straight-through-estimator QAT.
+
+TPU-native: fake-quant is a pure function (round/clip with an STE custom
+vjp) that XLA fuses into the surrounding matmul; int8 storage is simulated
+(JAX TPU matmuls run bf16/int8 via native dot types when converted).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Type
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn.layer.layers import Layer
+from ..ops.dispatch import dispatch, ensure_tensor
+from ..tensor import Tensor
+
+
+@jax.custom_vjp
+def _fake_quant(x, scale, qmin, qmax):
+    q = jnp.clip(jnp.round(x / scale), qmin, qmax)
+    return q * scale
+
+
+def _fq_fwd(x, scale, qmin, qmax):
+    return _fake_quant(x, scale, qmin, qmax), (x, scale, qmin, qmax)
+
+
+def _fq_bwd(res, g):
+    x, scale, qmin, qmax = res
+    # straight-through estimator: pass grads inside the clip range
+    inside = (x / scale >= qmin) & (x / scale <= qmax)
+    return (g * inside.astype(g.dtype), None, None, None)
+
+
+_fake_quant.defvjp(_fq_fwd, _fq_bwd)
+
+
+def quantize(x, scale, zero_point=0, bit_length: int = 8):
+    """Affine per-tensor quantize to int: round(x/scale) + zp."""
+    qmax = 2 ** (bit_length - 1) - 1
+    xt = ensure_tensor(x)
+    st = ensure_tensor(scale)
+    return dispatch(
+        "quantize",
+        lambda a, s: jnp.clip(jnp.round(a / s) + zero_point, -qmax - 1,
+                              qmax).astype(jnp.int8),
+        xt, st)
+
+
+def dequantize(x, scale, zero_point=0):
+    xt = ensure_tensor(x)
+    st = ensure_tensor(scale)
+    return dispatch(
+        "dequantize",
+        lambda a, s: (a.astype(jnp.float32) - zero_point) * s, xt, st)
+
+
+# ---- observers ---------------------------------------------------------------
+
+class BaseObserver:
+    def __init__(self, quant_bits: int = 8):
+        self.quant_bits = quant_bits
+        self._scale = None
+
+    def observe(self, x: Tensor):
+        raise NotImplementedError
+
+    def scale(self) -> float:
+        qmax = 2 ** (self.quant_bits - 1) - 1
+        return (self._scale or 1e-8) / qmax
+
+    def cal_thresholds(self):
+        return self._scale
+
+
+class AbsmaxObserver(BaseObserver):
+    """Running max of |x| (parity: observer/abs_max.py)."""
+
+    def observe(self, x: Tensor):
+        m = float(jnp.abs(x._data).max())
+        self._scale = m if self._scale is None else max(self._scale, m)
+
+
+class EMAObserver(BaseObserver):
+    """Exponential moving average of |x| max (MovingAverageAbsmax)."""
+
+    def __init__(self, quant_bits: int = 8, moving_rate: float = 0.9):
+        super().__init__(quant_bits)
+        self.moving_rate = moving_rate
+
+    def observe(self, x: Tensor):
+        m = float(jnp.abs(x._data).max())
+        self._scale = m if self._scale is None else (
+            self.moving_rate * self._scale + (1 - self.moving_rate) * m)
+
+
+# ---- fake-quant layers -------------------------------------------------------
+
+class FakeQuanterWithAbsMax(Layer):
+    """Activation/weight fake-quant with live absmax scale (QAT)."""
+
+    def __init__(self, quant_bits: int = 8, moving_rate: float = 0.9):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self.qmax = 2 ** (quant_bits - 1) - 1
+        self.moving_rate = moving_rate
+        self.register_buffer("_ema_scale", Tensor(jnp.asarray(0.0)),
+                             persistable=True)
+
+    def forward(self, x):
+        xt = ensure_tensor(x)
+        qmax = float(self.qmax)
+        rate = self.moving_rate
+        training = self.training
+        ema = self._ema_scale._data
+
+        def fwd(a):
+            absmax = jnp.abs(a).max()
+            s = jnp.where(ema > 0,
+                          rate * ema + (1 - rate) * absmax,
+                          absmax) if training else jnp.maximum(ema, 1e-8)
+            scale = jnp.maximum(s, 1e-8) / qmax
+            return _fake_quant(a, scale, -qmax - 1, qmax), s
+        out, new_scale = dispatch("fake_quant_absmax", fwd, xt)
+        if training:
+            self._ema_scale._data = jax.lax.stop_gradient(new_scale._data)
+        return out
+
+
+class QuantedLinear(Layer):
+    """Linear with weight+activation fake-quant (parity:
+    nn/quant/qat/linear.py QuantedLinear)."""
+
+    def __init__(self, linear: nn.Linear, q_config=None):
+        super().__init__()
+        self.weight = linear.weight
+        self.bias = linear.bias
+        bits = getattr(q_config, "quant_bits", 8) if q_config else 8
+        self.weight_quanter = FakeQuanterWithAbsMax(bits)
+        self.activation_quanter = FakeQuanterWithAbsMax(bits)
+
+    def forward(self, x):
+        from ..nn import functional as F
+        xq = self.activation_quanter(x)
+        wq = self.weight_quanter(self.weight)
+        return F.linear(xq, wq, self.bias)
+
+
+class QuantedConv2D(Layer):
+    def __init__(self, conv, q_config=None):
+        super().__init__()
+        self._conv = conv
+        bits = getattr(q_config, "quant_bits", 8) if q_config else 8
+        self.weight_quanter = FakeQuanterWithAbsMax(bits)
+        self.activation_quanter = FakeQuanterWithAbsMax(bits)
+
+    def forward(self, x):
+        xq = self.activation_quanter(x)
+        w_orig = self._conv.weight
+        wq = self.weight_quanter(w_orig)
+        self._conv.weight = wq
+        try:
+            return self._conv(xq)
+        finally:
+            self._conv.weight = w_orig
+
+
+class QuantConfig:
+    """Parity: quantization/config.py — maps layer types to quanters."""
+
+    def __init__(self, activation=None, weight=None, quant_bits: int = 8):
+        self.activation = activation
+        self.weight = weight
+        self.quant_bits = quant_bits
+        self._type_map: Dict[Type, Type] = {nn.Linear: QuantedLinear,
+                                            nn.Conv2D: QuantedConv2D}
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        pass  # per-type quanter selection: absmax only in this version
+
+
+def _replace_layers(model: Layer, type_map, q_config):
+    for name, child in list(model._sub_layers.items()):
+        repl = type_map.get(type(child))
+        if repl is not None:
+            model._sub_layers[name] = repl(child, q_config)
+        else:
+            _replace_layers(child, type_map, q_config)
+    return model
+
+
+class QAT:
+    """Quantization-aware training driver (parity: quantization/qat.py)."""
+
+    def __init__(self, q_config: Optional[QuantConfig] = None):
+        self.q_config = q_config or QuantConfig()
+
+    def quantize(self, model: Layer, inplace: bool = True) -> Layer:
+        return _replace_layers(model, self.q_config._type_map, self.q_config)
+
+    def convert(self, model: Layer, inplace: bool = True) -> Layer:
+        """Freeze: quanters switch to eval scales."""
+        model.eval()
+        return model
+
+
+class PTQ:
+    """Post-training quantization: observe activations, then freeze scales
+    (parity: quantization/ptq.py)."""
+
+    def __init__(self, q_config: Optional[QuantConfig] = None):
+        self.q_config = q_config or QuantConfig()
+        self._observers: List = []
+
+    def quantize(self, model: Layer, inplace: bool = True) -> Layer:
+        q = _replace_layers(model, self.q_config._type_map, self.q_config)
+        q.train()  # quanters keep observing during calibration runs
+        return q
+
+    def convert(self, model: Layer, inplace: bool = True) -> Layer:
+        model.eval()
+        return model

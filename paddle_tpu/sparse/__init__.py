@@ -1,0 +1,245 @@
+"""Sparse tensors and ops.
+
+Reference parity: python/paddle/sparse/ (sparse_coo_tensor,
+sparse_csr_tensor, to_dense/to_sparse_coo/to_sparse_csr, unary ops, add,
+matmul, masked_matmul; C++ SparseCooTensor/SparseCsrTensor in
+phi/core/sparse_*_tensor.h, kernels phi/kernels/sparse/).
+
+TPU-native: XLA has no sparse storage, so sparse tensors are coordinate
+lists (indices + values as dense arrays) and the ops lower to
+gather/scatter/segment-sum HLOs — the standard JAX sparse recipe (a BCOO
+analog). Values stay differentiable; structure (indices) is static data.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.dispatch import dispatch, ensure_tensor
+from ..tensor import Tensor
+
+
+class SparseCooTensor:
+    """COO: indices [ndim, nnz] (int), values [nnz, ...dense_dims]."""
+
+    def __init__(self, indices, values, shape, coalesced: bool = False):
+        self.indices = ensure_tensor(indices)
+        self.values = ensure_tensor(values)
+        self._shape = [int(s) for s in shape]
+        self._coalesced = coalesced
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def nnz(self) -> int:
+        return int(self.indices._data.shape[1])
+
+    @property
+    def stop_gradient(self):
+        return self.values.stop_gradient
+
+    @stop_gradient.setter
+    def stop_gradient(self, v):
+        self.values.stop_gradient = v
+
+    def to_dense(self) -> Tensor:
+        shape = tuple(self._shape)
+        nd = self.indices._data.shape[0]
+
+        def fwd(idx, vals):
+            dense = jnp.zeros(shape[:nd] + vals.shape[1:], vals.dtype)
+            return dense.at[tuple(idx)].add(vals)
+        return dispatch("sparse_to_dense", fwd, self.indices, self.values)
+
+    def coalesce(self) -> "SparseCooTensor":
+        """Merge duplicate coordinates (sum values)."""
+        idx = self.indices._data
+        vals = self.values._data
+        nd = idx.shape[0]
+        flat = jnp.ravel_multi_index(tuple(idx), tuple(self._shape[:nd]),
+                                     mode="clip")
+        uniq, pos = jnp.unique(flat, return_inverse=True)
+        merged = jnp.zeros((uniq.shape[0],) + vals.shape[1:], vals.dtype) \
+            .at[pos].add(vals)
+        new_idx = jnp.stack(jnp.unravel_index(uniq, tuple(self._shape[:nd])))
+        return SparseCooTensor(Tensor(new_idx), Tensor(merged), self._shape,
+                               coalesced=True)
+
+    def transpose(self, perm) -> "SparseCooTensor":
+        idx = self.indices._data[jnp.asarray(perm)]
+        shape = [self._shape[p] for p in perm]
+        return SparseCooTensor(Tensor(idx), self.values, shape)
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self._shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+
+class SparseCsrTensor:
+    """CSR (2-D): crows [rows+1], cols [nnz], values [nnz]."""
+
+    def __init__(self, crows, cols, values, shape):
+        self.crows = ensure_tensor(crows)
+        self.cols = ensure_tensor(cols)
+        self.values = ensure_tensor(values)
+        self._shape = [int(s) for s in shape]
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def nnz(self) -> int:
+        return int(self.cols._data.shape[0])
+
+    def _row_indices(self):
+        crows = self.crows._data
+        counts = crows[1:] - crows[:-1]
+        return jnp.repeat(jnp.arange(self._shape[0]), counts,
+                          total_repeat_length=self.nnz())
+
+    def to_dense(self) -> Tensor:
+        rows = self._row_indices()
+        shape = tuple(self._shape)
+
+        def fwd(cols, vals):
+            dense = jnp.zeros(shape, vals.dtype)
+            return dense.at[rows, cols].add(vals)
+        return dispatch("csr_to_dense", fwd, self.cols, self.values)
+
+    def to_sparse_coo(self, sparse_dim: int = 2) -> SparseCooTensor:
+        rows = self._row_indices()
+        idx = jnp.stack([rows, self.cols._data])
+        return SparseCooTensor(Tensor(idx), self.values, self._shape)
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self._shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True) -> SparseCooTensor:
+    it = ensure_tensor(indices)
+    vt = ensure_tensor(values, dtype=dtype)
+    if shape is None:
+        maxes = jnp.max(it._data, axis=1) + 1
+        shape = [int(m) for m in maxes] + list(vt._data.shape[1:])
+    t = SparseCooTensor(it, vt, shape)
+    t.stop_gradient = stop_gradient
+    return t
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True) -> SparseCsrTensor:
+    t = SparseCsrTensor(ensure_tensor(crows), ensure_tensor(cols),
+                        ensure_tensor(values, dtype=dtype), shape)
+    t.values.stop_gradient = stop_gradient
+    return t
+
+
+def to_sparse_coo(dense: Tensor, sparse_dim: Optional[int] = None):
+    """Dense -> COO over the leading `sparse_dim` dims (default: all)."""
+    dt = ensure_tensor(dense)
+    arr = dt._data
+    nd = sparse_dim or arr.ndim
+    lead = arr.reshape(arr.shape[:nd] + (-1,))
+    mask = jnp.any(lead != 0, axis=-1)
+    idx = jnp.stack(jnp.nonzero(mask))
+    vals = arr[tuple(idx)]
+    return SparseCooTensor(Tensor(idx), Tensor(vals), list(arr.shape))
+
+
+def to_sparse_csr(dense: Tensor) -> SparseCsrTensor:
+    arr = ensure_tensor(dense)._data
+    assert arr.ndim == 2, "CSR is 2-D"
+    rows, cols = jnp.nonzero(arr != 0)
+    vals = arr[rows, cols]
+    crows = jnp.zeros(arr.shape[0] + 1, jnp.int32).at[rows + 1].add(1)
+    crows = jnp.cumsum(crows)
+    return SparseCsrTensor(Tensor(crows), Tensor(cols), Tensor(vals),
+                           list(arr.shape))
+
+
+def _unary(name, jnp_fn):
+    """Zero-preserving unary op applied to values only (reference
+    phi/kernels/sparse/unary_kernel pattern)."""
+    def op(x):
+        if isinstance(x, SparseCooTensor):
+            out = dispatch(f"sparse_{name}", jnp_fn, x.values)
+            return SparseCooTensor(x.indices, out, x.shape)
+        if isinstance(x, SparseCsrTensor):
+            out = dispatch(f"sparse_{name}", jnp_fn, x.values)
+            return SparseCsrTensor(x.crows, x.cols, out, x.shape)
+        raise TypeError(f"sparse.{name} expects a sparse tensor")
+    op.__name__ = name
+    return op
+
+
+relu = _unary("relu", jax.nn.relu)
+abs = _unary("abs", jnp.abs)
+sin = _unary("sin", jnp.sin)
+tanh = _unary("tanh", jnp.tanh)
+sqrt = _unary("sqrt", jnp.sqrt)
+neg = _unary("neg", jnp.negative)
+expm1 = _unary("expm1", jnp.expm1)
+log1p = _unary("log1p", jnp.log1p)
+pow = _unary("square", jnp.square)  # noqa: A001 - parity name
+
+
+def add(x, y):
+    """sparse+sparse (same shape) -> sparse; sparse+dense -> dense."""
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        idx = jnp.concatenate([x.indices._data, y.indices._data], axis=1)
+        from ..ops.manipulation import concat
+        vals = concat([x.values, y.values], axis=0)
+        return SparseCooTensor(Tensor(idx), vals, x.shape).coalesce()
+    if isinstance(x, SparseCooTensor):
+        return x.to_dense() + ensure_tensor(y)
+    raise TypeError("sparse.add expects sparse x")
+
+
+def matmul(x, y) -> Tensor:
+    """sparse [m, k] @ dense [k, n] -> dense [m, n] via gather +
+    segment-sum (XLA's sparse-matmul recipe)."""
+    if isinstance(x, SparseCsrTensor):
+        x = x.to_sparse_coo()
+    if not isinstance(x, SparseCooTensor):
+        raise TypeError("sparse.matmul expects sparse x")
+    yt = ensure_tensor(y)
+    m = x.shape[0]
+    rows = x.indices._data[0]
+    cols = x.indices._data[1]
+
+    def fwd(vals, dense):
+        gathered = vals[:, None] * dense[cols]           # [nnz, n]
+        return jax.ops.segment_sum(gathered, rows, num_segments=m)
+    return dispatch("sparse_matmul", fwd, x.values, yt)
+
+
+def masked_matmul(x: Tensor, y: Tensor, mask) -> SparseCooTensor:
+    """dense @ dense evaluated only at `mask`'s coordinates (SDDMM)."""
+    if not isinstance(mask, (SparseCooTensor, SparseCsrTensor)):
+        raise TypeError("mask must be sparse")
+    coo = mask.to_sparse_coo() if isinstance(mask, SparseCsrTensor) else mask
+    xt, yt = ensure_tensor(x), ensure_tensor(y)
+    rows = coo.indices._data[0]
+    cols = coo.indices._data[1]
+
+    def fwd(a, b):
+        return (a[rows] * b[:, cols].T).sum(-1)
+    vals = dispatch("masked_matmul", fwd, xt, yt)
+    return SparseCooTensor(coo.indices, vals, coo.shape)
+
+
+def is_same_shape(x, y) -> bool:
+    return list(x.shape) == list(y.shape)
