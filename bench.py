@@ -30,12 +30,43 @@ def peak_flops_per_chip(device) -> float:
     return 197e12
 
 
+def _emit_error(msg: str) -> None:
+    print(json.dumps({
+        "metric": "llama_train_tokens_per_sec_per_chip",
+        "value": 0.0,
+        "unit": "tokens/s",
+        "vs_baseline": 0.0,
+        "extra": {"error": msg[-2000:]},
+    }))
+
+
 def main():
     debug = "--debug" in sys.argv
-    if debug:
-        import jax
-        jax.config.update("jax_platforms", "cpu")
+    # Watchdog: a hung backend init (or compile) must surface as a JSON error
+    # line, never an indefinite hang (round-1 failure mode). A thread (not
+    # SIGALRM) because a deadlock inside a native call never returns to the
+    # interpreter, so a Python signal handler would never run.
+    import os
+    import threading
+
+    deadline = {"t": time.monotonic() + 600, "what": "backend init"}
+
+    def _watchdog():
+        while True:
+            time.sleep(5)
+            if time.monotonic() > deadline["t"]:
+                _emit_error(f"bench watchdog expired during {deadline['what']}")
+                sys.stdout.flush()
+                os._exit(1)
+
+    threading.Thread(target=_watchdog, daemon=True).start()
     import jax
+    # Force the platform via the config API: the axon TPU plugin ignores the
+    # JAX_PLATFORMS env var, so this is the only reliable switch.
+    jax.config.update("jax_platforms", "cpu" if debug else "tpu")
+    jax.devices()
+    deadline["t"] = time.monotonic() + 2400
+    deadline["what"] = "compile/measurement"
     import paddle_tpu as paddle
     from paddle_tpu import optimizer as opt
     from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
@@ -93,8 +124,14 @@ def main():
             "device": getattr(dev, "device_kind", str(dev)),
         },
     }
+    deadline["t"] = float("inf")
     print(json.dumps(result))
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except BaseException as e:  # noqa: BLE001 - any failure must yield JSON
+        import traceback
+        _emit_error(f"{type(e).__name__}: {e}\n{traceback.format_exc()}")
+        sys.exit(1)

@@ -14,19 +14,29 @@ import jax
 
 
 class _GeneratorState(threading.local):
+    # `key` is created lazily on first use: materializing a PRNGKey initializes
+    # the JAX backend, which must never happen at `import paddle_tpu` time
+    # (the axon TPU plugin ignores JAX_PLATFORMS, so import-time init would pin
+    # the platform before the caller can choose CPU/TPU).
     def __init__(self):
         self.seed_value = 0
-        self.key = jax.random.PRNGKey(0)
+        self.key = None
         self.counter = 0
 
 
 _state = _GeneratorState()
 
 
+def _base_key():
+    if _state.key is None:
+        _state.key = jax.random.PRNGKey(_state.seed_value)
+    return _state.key
+
+
 def seed(value: int):
-    """Seed the global generator (parity: paddle.seed)."""
+    """Seed the global generator (parity: paddle.seed). Lazy: no backend init."""
     _state.seed_value = int(value)
-    _state.key = jax.random.PRNGKey(int(value))
+    _state.key = None
     _state.counter = 0
     return _state
 
@@ -77,7 +87,7 @@ def next_key():
         ctx.counter += 1
         return jax.random.fold_in(ctx.base_key, ctx.counter)
     _state.counter += 1
-    return jax.random.fold_in(_state.key, _state.counter)
+    return jax.random.fold_in(_base_key(), _state.counter)
 
 
 def split_key(n: int):
