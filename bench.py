@@ -61,10 +61,16 @@ def main():
 
     threading.Thread(target=_watchdog, daemon=True).start()
     import jax
-    # Force the platform via the config API: the axon TPU plugin ignores the
-    # JAX_PLATFORMS env var, so this is the only reliable switch.
-    jax.config.update("jax_platforms", "cpu" if debug else "tpu")
+    # Debug: force CPU via the config API (the axon TPU plugin ignores the
+    # JAX_PLATFORMS env var). Non-debug: leave the default platform order —
+    # the TPU plugin may register under a name other than "tpu" (e.g. the
+    # axon tunnel), so forcing "tpu" can fail even when a chip is present.
+    if debug:
+        jax.config.update("jax_platforms", "cpu")
     jax.devices()
+    if not debug and jax.devices()[0].platform == "cpu":
+        raise RuntimeError("no accelerator available (default backend is cpu); "
+                           "use --debug for a CPU smoke run")
     deadline["t"] = time.monotonic() + 2400
     deadline["what"] = "compile/measurement"
     import paddle_tpu as paddle
@@ -104,7 +110,9 @@ def main():
     t0 = time.perf_counter()
     for _ in range(steps):
         loss = trainer.train_step(ids, ids)
-    trainer.block()
+    # Host fetch of the final loss = true barrier on the whole step chain
+    # (block_until_ready is unreliable through the remote-tunnel backend).
+    final_loss = float(loss.numpy())
     dt = time.perf_counter() - t0
 
     tokens = batch * seq * steps
@@ -118,7 +126,7 @@ def main():
         "vs_baseline": round(mfu / 0.50, 4),
         "extra": {
             "mfu": round(mfu, 4),
-            "loss": round(float(loss.numpy()), 4),
+            "loss": round(final_loss, 4),
             "params": model.num_params(),
             "batch": batch, "seq": seq,
             "device": getattr(dev, "device_kind", str(dev)),

@@ -1,13 +1,25 @@
-"""Pallas TPU flash-attention kernel (online softmax, tiled over KV).
+"""Pallas TPU flash-attention kernels (forward + backward).
 
 Reference parity: the capability of paddle's FA2 integration
-(paddle/phi/kernels/gpu/flash_attn_kernel.cu:673). Design: 3-D sequential grid
-(batch*heads, q_blocks, kv_blocks) with running (m, l, acc) carried in VMEM
-scratch across the innermost kv dimension — the standard TPU online-softmax
-pattern; MXU does the two matmuls per tile in fp32 accumulation.
+(paddle/phi/kernels/gpu/flash_attn_kernel.cu:673 forward,
+phi/kernels/gpu/flash_attn_grad_kernel.cu:673 backward). Design:
 
-Backward currently recomputes via the XLA reference path (fused bwd kernel is a
-planned optimization); forward is the inference/serving hot path.
+  forward: 3-D sequential grid (batch*heads, q_blocks, kv_blocks) with running
+  (m, l, acc) carried in VMEM scratch across the innermost kv dimension — the
+  standard TPU online-softmax pattern. Also emits the logsumexp per row so the
+  backward can recompute probabilities tile-by-tile without rematerializing
+  the full [s, s] score matrix.
+
+  backward: two kernels (the FA2 split). dq: grid (bh, q_blocks, kv_blocks),
+  accumulating dq tiles in VMEM while sweeping kv. dk/dv: grid
+  (bh, kv_blocks, q_blocks), accumulating dk/dv tiles while sweeping q. Each
+  tile recomputes p = exp(s - lse) from q/k and the saved lse (no softmax
+  storage), and uses delta = rowsum(dO * O) for the softmax jacobian.
+
+MXU notes: all dots keep the input dtype (bf16 stays bf16) and accumulate in
+fp32 via preferred_element_type — casting inputs to fp32 first would run the
+MXU at a fraction of its bf16 rate. Probabilities are cast back to the value
+dtype before the p@v / p^T@dO dots for the same reason.
 """
 from __future__ import annotations
 
@@ -22,10 +34,33 @@ from jax.experimental.pallas import tpu as pltpu
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 NEG_INF = -1e30
+# Row statistics (lse, delta) are stored broadcast over a trailing lane dim:
+# Pallas TPU requires the last two block dims to be (8, 128)-divisible or
+# equal to the array dims, so a [rows] vector can't use a (1, block) spec.
+# A trailing dim of 8 satisfies "equal to the array dim" while costing 16x
+# less HBM than the 128-lane layout used by jax's reference flash kernel.
+LANES = 8
+
+_INTERPRET = False  # tests flip this to run the kernels off-TPU
 
 
-def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch, acc_scratch, *,
-               scale, causal, block_q, block_k, nk):
+def _dot(a, b, dims):
+    return jax.lax.dot_general(a, b, (dims, ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
+def _causal_mask(iq, ik, block_q, block_k):
+    q_pos = iq * block_q + jax.lax.broadcasted_iota(jnp.int32,
+                                                    (block_q, block_k), 0)
+    k_pos = ik * block_k + jax.lax.broadcasted_iota(jnp.int32,
+                                                    (block_q, block_k), 1)
+    return q_pos >= k_pos
+
+
+# -- forward ------------------------------------------------------------------
+
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scratch, l_scratch,
+               acc_scratch, *, scale, causal, block_q, block_k, nk):
     ik = pl.program_id(2)
     iq = pl.program_id(1)
 
@@ -36,26 +71,21 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch, acc_scratch, *,
         acc_scratch[:] = jnp.zeros_like(acc_scratch)
 
     def _compute():
-        q = q_ref[0].astype(jnp.float32)            # [Bq, d]
-        k = k_ref[0].astype(jnp.float32)            # [Bk, d]
-        v = v_ref[0].astype(jnp.float32)            # [Bk, d]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
+        q = q_ref[0]                                 # [Bq, d] (input dtype)
+        k = k_ref[0]                                 # [Bk, d]
+        v = v_ref[0]                                 # [Bk, d]
+        s = _dot(q, k, (((1,), (1,)))) * scale       # [Bq, Bk] fp32
         if causal:
-            q_pos = iq * block_q + jax.lax.broadcasted_iota(jnp.int32,
-                                                            (block_q, block_k), 0)
-            k_pos = ik * block_k + jax.lax.broadcasted_iota(jnp.int32,
-                                                            (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            s = jnp.where(_causal_mask(iq, ik, block_q, block_k), s, NEG_INF)
         m_prev = m_scratch[:]                        # [Bq, 1]
         l_prev = l_scratch[:]
         m_cur = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new)                       # [Bq, Bk]
+        p = jnp.exp(s - m_new)                       # [Bq, Bk] fp32
         alpha = jnp.exp(m_prev - m_new)              # [Bq, 1]
         l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
-        acc_scratch[:] = acc_scratch[:] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        acc_scratch[:] = acc_scratch[:] * alpha + _dot(
+            p.astype(v.dtype), v, ((1,), (0,)))
         m_scratch[:] = m_new
         l_scratch[:] = l_new
 
@@ -72,6 +102,15 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch, acc_scratch, *,
         l = l_scratch[:]
         l_safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_scratch[:] / l_safe).astype(o_ref.dtype)
+        lse = jnp.where(l == 0.0, NEG_INF, m_scratch[:] + jnp.log(l_safe))
+        lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
+
+
+def _check_divisible(sq, sk, bq, bk):
+    if sq % bq or sk % bk:
+        raise ValueError(
+            f"flash_attention requires seq lengths divisible by the block "
+            f"sizes (q {sq}%{bq}, kv {sk}%{bk}); pad or use the XLA path")
 
 
 def _flash_forward(q, k, v, causal, scale, block_q, block_k):
@@ -79,6 +118,7 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k):
     sk = k.shape[2]
     bq = min(block_q, sq)
     bk = min(block_k, sk)
+    _check_divisible(sq, sk, bq, bk)
     nq = sq // bq
     nk = sk // bk
     bh = b * h
@@ -89,7 +129,7 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k):
 
     kernel = functools.partial(_fa_kernel, scale=s, causal=causal, block_q=bq,
                                block_k=bk, nk=nk)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(bh, nq, nk),
         in_specs=[
@@ -97,8 +137,14 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k):
             pl.BlockSpec((1, bk, d), lambda ibh, iq, ik: (ibh, ik, 0)),
             pl.BlockSpec((1, bk, d), lambda ibh, iq, ik: (ibh, ik, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda ibh, iq, ik: (ibh, iq, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda ibh, iq, ik: (ibh, iq, 0)),
+            pl.BlockSpec((1, bq, LANES), lambda ibh, iq, ik: (ibh, iq, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq, LANES), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((bq, 1), jnp.float32),
             pltpu.VMEM((bq, 1), jnp.float32),
@@ -106,9 +152,157 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k):
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_INTERPRET,
     )(q_r, k_r, v_r)
-    return out.reshape(b, h, sq, d)
+    return out.reshape(b, h, sq, d), lse
 
+
+# -- backward -----------------------------------------------------------------
+
+def _fa_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
+                  acc_scratch, *, scale, causal, block_q, block_k, nk):
+    ik = pl.program_id(2)
+    iq = pl.program_id(1)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_scratch[:] = jnp.zeros_like(acc_scratch)
+
+    def _compute():
+        q = q_ref[0]                                    # [Bq, d]
+        k = k_ref[0]                                    # [Bk, d]
+        v = v_ref[0]                                    # [Bk, d]
+        g = g_ref[0]                                    # [Bq, d]
+        lse = lse_ref[0][:, :1]                         # [Bq, 1] fp32
+        delta = delta_ref[0][:, :1]                     # [Bq, 1] fp32
+        s = _dot(q, k, ((1,), (1,))) * scale            # [Bq, Bk] fp32
+        if causal:
+            s = jnp.where(_causal_mask(iq, ik, block_q, block_k), s, NEG_INF)
+        p = jnp.exp(s - lse)                            # [Bq, Bk] fp32
+        dp = _dot(g, v, ((1,), (1,)))                   # [Bq, Bk] fp32
+        ds = p * (dp - delta) * scale
+        acc_scratch[:] += _dot(ds.astype(k.dtype), k, ((1,), (0,)))
+
+    if causal:
+        @pl.when(ik * block_k <= iq * block_q + (block_q - 1))
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        dq_ref[0] = acc_scratch[:].astype(dq_ref.dtype)
+
+
+def _fa_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dk_ref,
+                   dv_ref, dk_scratch, dv_scratch, *, scale, causal, block_q,
+                   block_k, nq):
+    iq = pl.program_id(2)
+    ik = pl.program_id(1)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_scratch[:] = jnp.zeros_like(dk_scratch)
+        dv_scratch[:] = jnp.zeros_like(dv_scratch)
+
+    def _compute():
+        # Same orientation as the dq kernel ([Bq, Bk] tiles); dk/dv contract
+        # over the q dim (dim 0) instead, so no in-kernel transposes.
+        q = q_ref[0]                                    # [Bq, d]
+        k = k_ref[0]                                    # [Bk, d]
+        v = v_ref[0]                                    # [Bk, d]
+        g = g_ref[0]                                    # [Bq, d]
+        lse = lse_ref[0][:, :1]                         # [Bq, 1] fp32
+        delta = delta_ref[0][:, :1]                     # [Bq, 1] fp32
+        s = _dot(q, k, ((1,), (1,))) * scale            # [Bq, Bk] fp32
+        if causal:
+            s = jnp.where(_causal_mask(iq, ik, block_q, block_k), s, NEG_INF)
+        p = jnp.exp(s - lse)                            # [Bq, Bk] fp32
+        dv_scratch[:] += _dot(p.astype(g.dtype), g, ((0,), (0,)))
+        dp = _dot(g, v, ((1,), (1,)))                   # [Bq, Bk] fp32
+        ds = p * (dp - delta) * scale
+        dk_scratch[:] += _dot(ds.astype(q.dtype), q, ((0,), (0,)))
+
+    if causal:
+        # Skip q blocks entirely before this kv block.
+        @pl.when(iq * block_q + (block_q - 1) >= ik * block_k)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(iq == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_scratch[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scratch[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bq = min(block_q, sq)
+    bk = min(block_k, sk)
+    _check_divisible(sq, sk, bq, bk)
+    nq = sq // bq
+    nk = sk // bk
+    bh = b * h
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+
+    q_r = q.reshape(bh, sq, d)
+    k_r = k.reshape(bh, sk, d)
+    v_r = v.reshape(bh, sk, d)
+    g_r = g.reshape(bh, sq, d)
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1).reshape(bh, sq)
+    delta = jnp.broadcast_to(delta[:, :, None], (bh, sq, LANES))
+
+    q_spec = pl.BlockSpec((1, bq, d), lambda ibh, i, j: (ibh, i, 0))
+    row_spec = pl.BlockSpec((1, bq, LANES), lambda ibh, i, j: (ibh, i, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_fa_dq_kernel, scale=s, causal=causal, block_q=bq,
+                          block_k=bk, nk=nk),
+        grid=(bh, nq, nk),
+        in_specs=[
+            q_spec,
+            pl.BlockSpec((1, bk, d), lambda ibh, iq, ik: (ibh, ik, 0)),
+            pl.BlockSpec((1, bk, d), lambda ibh, iq, ik: (ibh, ik, 0)),
+            q_spec, row_spec, row_spec,
+        ],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_INTERPRET,
+    )(q_r, k_r, v_r, g_r, lse, delta)
+
+    kv_spec = pl.BlockSpec((1, bk, d), lambda ibh, ik, iq: (ibh, ik, 0))
+    q_spec2 = pl.BlockSpec((1, bq, d), lambda ibh, ik, iq: (ibh, iq, 0))
+    row_spec2 = pl.BlockSpec((1, bq, LANES), lambda ibh, ik, iq: (ibh, iq, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_fa_dkv_kernel, scale=s, causal=causal, block_q=bq,
+                          block_k=bk, nq=nq),
+        grid=(bh, nk, nq),
+        in_specs=[q_spec2, kv_spec, kv_spec, q_spec2, row_spec2, row_spec2],
+        out_specs=[kv_spec, kv_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_INTERPRET,
+    )(q_r, k_r, v_r, g_r, lse, delta)
+
+    return (dq.reshape(b, h, sq, d), dk.reshape(b, h, sk, d),
+            dv.reshape(b, h, sk, d))
+
+
+# -- public op ----------------------------------------------------------------
 
 def _reference_bhsd(q, k, v, causal, scale):
     d = q.shape[-1]
@@ -128,19 +322,19 @@ def _reference_bhsd(q, k, v, causal, scale):
 def flash_attention(q, k, v, causal=False, scale=None,
                     block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
     """q,k,v: [batch, heads, seq, head_dim]."""
-    return _flash_forward(q, k, v, causal, scale, block_q, block_k)
+    out, _ = _flash_forward(q, k, v, causal, scale, block_q, block_k)
+    return out
 
 
 def _fa_fwd(q, k, v, causal, scale, block_q, block_k):
-    out = _flash_forward(q, k, v, causal, scale, block_q, block_k)
-    return out, (q, k, v)
+    out, lse = _flash_forward(q, k, v, causal, scale, block_q, block_k)
+    return out, (q, k, v, out, lse)
 
 
 def _fa_bwd(causal, scale, block_q, block_k, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda a, b, c: _reference_bhsd(a, b, c, causal, scale),
-                     q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    return _flash_backward(q, k, v, out, lse, g, causal, scale, block_q,
+                           block_k)
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)

@@ -241,6 +241,35 @@ class LlamaForCausalLM(nn.Layer):
     def pp_block_layers(self):
         return list(self.model.layers)
 
+    # 1F1B protocol: embed/tail halves so the loss runs inside the pipeline
+    # region (parity: PipelineLayer's SharedLayerDesc head placement,
+    # parallel_layers/pp_layers.py:77).
+    def pp_embed(self, input_ids):
+        h = self.model.embed_tokens(input_ids)
+        s = input_ids.shape[1]
+        cos = self.model.rope_cos._data[:s]
+        sin = self.model.rope_sin._data[:s]
+        return h, (cos, sin)
+
+    def pp_tail(self, h, labels):
+        h = self.model.norm(h)
+        if self.lm_head is None:
+            from ..ops.linalg import matmul
+            logits = matmul(h, self.model.embed_tokens.weight,
+                            transpose_y=True)
+        else:
+            logits = self.lm_head(h)
+        return self.compute_loss(logits, labels)
+
+    def pp_embed_param_names(self):
+        return ["model.embed_tokens.weight"]
+
+    def pp_tail_param_names(self):
+        names = ["model.norm.weight"]
+        names.append("model.embed_tokens.weight" if self.lm_head is None
+                     else "lm_head.weight")
+        return names
+
     @staticmethod
     def pp_block_call(layer, h, cos, sin):
         return layer(h, (cos, sin))

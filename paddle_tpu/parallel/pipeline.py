@@ -97,6 +97,131 @@ def pipeline_blocks(h0, consts, stacked_leaves, *, block_apply_flat,
                     axis_name)
 
 
+def pipeline_1f1b(h0, labels, consts, stacked_leaves, tail_leaves, *,
+                  block_apply_flat, tail_apply_flat, axis_name: str,
+                  n_micro: int, remat: bool = True):
+    """Per-device 1F1B schedule (call inside shard_map; manual over `pp`).
+
+    Parity: fleet's 1F1B `forward_backward_pipeline`
+    (meta_parallel/pipeline_parallel.py:684). Unlike the circular schedule
+    (whose backward is jax.grad of the forward loop, so every microbatch's
+    stage input stays live across the whole forward phase), this is a manual
+    lockstep loop in which each tick runs ONE forward micro-step and ONE
+    backward micro-step per device; gradients are produced directly by the
+    region. The activation stash is a ring buffer of 2p-1 slots — the 1F1B
+    bounded-memory property (<= O(p) in-flight microbatches instead of
+    O(n_micro)).
+
+    The loss epilogue (`tail_apply_flat`: final norm + head + loss) runs
+    inside the region on the last stage, immediately after each microbatch's
+    forward — that is what lets its backward start p-1 ticks later instead of
+    after all forwards.
+
+    h0: [m, mb, ...] stage-0 activations; labels: [m, ...] per-microbatch;
+    stacked_leaves: [L_local, ...] block params of this stage; tail_leaves:
+    replicated tail params. Returns (mean_loss, d_h0, blk_grads, tail_grads);
+    blk_grads are per-device (sharded over pp), the rest are psum'd so every
+    rank holds identical replicated values.
+    """
+    p = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+    m = n_micro
+    S = 2 * p - 1                      # stash slots: max in-flight microbatches
+    T = m + 2 * (p - 1)                # lockstep ticks
+
+    def block_step(h, leaf_slices):
+        return block_apply_flat(leaf_slices, h, *consts), None
+
+    def stage_fn(x, leaves):
+        step = jax.checkpoint(block_step) if remat else block_step
+        y, _ = lax.scan(step, x, leaves)
+        return y
+
+    def tail_fn(y, tleaves, label):
+        return tail_apply_flat(list(tleaves), y, label)
+
+    zeros_like_tree = lambda tr: jax.tree.map(jnp.zeros_like, tr)
+    x0 = jnp.zeros_like(h0[0])
+    carry0 = (
+        x0,                                        # x_recv
+        x0,                                        # dy_recv
+        jnp.zeros((S,) + h0.shape[1:], h0.dtype),  # stash
+        jnp.float32(0.0),                          # loss accumulator
+        zeros_like_tree(list(stacked_leaves)),     # block grads
+        zeros_like_tree(list(tail_leaves)),        # tail grads
+        jnp.zeros_like(h0),                        # d_h0 accumulator
+    )
+
+    def tick(carry, t):
+        x_recv, dy_recv, stash, loss_acc, blk_g, tail_g, dh0_acc = carry
+
+        # ---- forward micro-step -------------------------------------------
+        f = t - rank
+        fwd_valid = (f >= 0) & (f < m)
+        f_idx = jnp.clip(f, 0, m - 1)
+        fresh = lax.dynamic_index_in_dim(h0, f_idx, 0, keepdims=False)
+        x_in = jnp.where(rank == 0, fresh, x_recv)
+        y = stage_fn(x_in, list(stacked_leaves))
+        slot_f = jnp.mod(f_idx, S)
+        old = lax.dynamic_index_in_dim(stash, slot_f, 0, keepdims=False)
+        stash = lax.dynamic_update_index_in_dim(
+            stash, jnp.where(fwd_valid, x_in, old), slot_f, 0)
+
+        # last stage: loss + dL/dy for this microbatch, right after forward.
+        # lax.cond (not a where-mask) so the vocab-size tail matmul + vjp run
+        # only on the last pp rank; tail_fn holds no pp collectives, and any
+        # GSPMD (mp) collectives inside agree across the cond because all
+        # devices of one pp rank take the same branch.
+        lab = lax.dynamic_index_in_dim(labels, f_idx, 0, keepdims=False)
+
+        def tail_branch(y_, tleaves):
+            loss_f, tl_vjp = jax.vjp(lambda yy, tl: tail_fn(yy, tl, lab),
+                                     y_, tleaves)
+            dh, dtail = tl_vjp(jnp.float32(1.0 / m))
+            return loss_f, dh, dtail
+
+        def tail_skip(y_, tleaves):
+            return (jnp.float32(0.0), jnp.zeros_like(y_),
+                    tuple(jnp.zeros_like(t) for t in tleaves))
+
+        loss_f, dh_f, dtail_f = lax.cond(
+            fwd_valid & (rank == p - 1), tail_branch, tail_skip,
+            y, tuple(tail_leaves))
+        loss_acc = loss_acc + loss_f / m
+        tail_g = [tg + dt for tg, dt in zip(tail_g, dtail_f)]
+
+        # ---- backward micro-step ------------------------------------------
+        b = t - (2 * (p - 1) - rank)
+        bwd_valid = (b >= 0) & (b < m)
+        b_idx = jnp.clip(b, 0, m - 1)
+        x_b = lax.dynamic_index_in_dim(stash, jnp.mod(b_idx, S), 0,
+                                       keepdims=False)
+        # On the last stage the bwd microbatch IS this tick's fwd microbatch
+        # (b == f), so its dL/dy was just computed above.
+        dy_in = jnp.where(rank == p - 1, dh_f.astype(x0.dtype), dy_recv)
+        _, st_vjp = jax.vjp(stage_fn, x_b, list(stacked_leaves))
+        dx_b, dleaves_b = st_vjp(dy_in)
+        blk_g = [bg + jnp.where(bwd_valid, dl, jnp.zeros_like(dl))
+                 for bg, dl in zip(blk_g, dleaves_b)]
+        cur = lax.dynamic_index_in_dim(dh0_acc, b_idx, 0, keepdims=False)
+        dh0_acc = lax.dynamic_update_index_in_dim(
+            dh0_acc, jnp.where(bwd_valid & (rank == 0), dx_b, cur), b_idx, 0)
+
+        # ---- ring exchanges (activations fwd, grads reverse) --------------
+        x_next = lax.ppermute(y, axis_name, rotate_perm(p))
+        dy_next = lax.ppermute(dx_b, axis_name,
+                               [(j, (j - 1) % p) for j in range(p)])
+        return (x_next, dy_next, stash, loss_acc, blk_g, tail_g, dh0_acc), None
+
+    (x_l, dy_l, stash, loss_acc, blk_g, tail_g, dh0_acc), _ = lax.scan(
+        tick, carry0, jnp.arange(T))
+
+    loss = lax.psum(loss_acc, axis_name)
+    d_h0 = lax.psum(dh0_acc, axis_name)
+    tail_g = [lax.psum(g, axis_name) for g in tail_g]
+    return loss, d_h0, blk_g, tail_g
+
+
 class PipelinedTrainer(SpmdTrainer):
     """SpmdTrainer with the decoder blocks run as a circular pp pipeline.
 
@@ -115,13 +240,21 @@ class PipelinedTrainer(SpmdTrainer):
 
     STACK_PREFIX = "pp_stacked."
 
+    SCHEDULES = ("circular", "1f1b", "vpp")
+
     def __init__(self, model, optimizer, loss_fn, mesh=None,
-                 n_micro: int = 1, remat: bool = True, **kw):
+                 n_micro: int = 1, remat: bool = True,
+                 schedule: str = "circular", vpp_chunks: int = 2, **kw):
+        if schedule not in self.SCHEDULES:
+            raise ValueError(f"schedule must be one of {self.SCHEDULES}, "
+                             f"got {schedule!r}")
         blocks: List = model.pp_block_layers()
         self._blocks = blocks
         self._template = blocks[0]
         self.n_micro = n_micro
         self._pp_remat = remat
+        self.schedule = schedule
+        self.vpp_chunks = vpp_chunks if schedule == "vpp" else 1
         super().__init__(model, optimizer, loss_fn, mesh=mesh,
                          remat_layers=None, **kw)
         self.pp_degree = (mesh.get_dim_size("pp")
@@ -129,6 +262,21 @@ class PipelinedTrainer(SpmdTrainer):
         if len(blocks) % max(self.pp_degree, 1) != 0:
             raise ValueError(
                 f"{len(blocks)} blocks not divisible by pp={self.pp_degree}")
+        if schedule == "vpp":
+            v, p = self.vpp_chunks, max(self.pp_degree, 1)
+            if len(blocks) % (v * p) != 0:
+                raise ValueError(
+                    f"{len(blocks)} blocks not divisible by "
+                    f"vpp_chunks*pp={v}*{p}")
+            self._vpp_reorder()
+        if schedule == "1f1b":
+            for meth in ("pp_embed", "pp_tail", "pp_embed_param_names",
+                         "pp_tail_param_names"):
+                if not hasattr(model, meth):
+                    raise TypeError(
+                        f"schedule='1f1b' runs the loss inside the pipeline "
+                        f"region; the model must implement {meth}() "
+                        "(see LlamaForCausalLM)")
 
         # Identify block params inside the model's flat namespace.
         block_param_ids = set()
@@ -186,6 +334,30 @@ class PipelinedTrainer(SpmdTrainer):
         self._param_list = list(self._params)
         self._stacked_names = list(stacked)
 
+    def _vpp_reorder(self):
+        """Interleaved-VPP layer PLACEMENT (parity: PipelineParallelWithInterleave,
+        pipeline_parallel.py:1308): device r owns chunks {r, r+p, ..., r+(v-1)p}
+        of L/(v*p) consecutive layers each, instead of one contiguous span.
+        The stack is reordered so the contiguous pp-shard of dim0 lands each
+        device exactly its interleaved chunks; the ring then runs v phases.
+
+        NOTE: this reproduces VPP's placement and checkpoint layout, NOT its
+        bubble reduction — the v sequential ring phases have the same bubble
+        fraction as the circular schedule (each phase pays p-1 fill ticks).
+        See PIPELINE_SCHEDULES.md for why, and for what true cross-phase
+        overlap would require in a lockstep-compiled SPMD program.
+        """
+        v, p = self.vpp_chunks, max(self.pp_degree, 1)
+        L = len(self._blocks)
+        lc = L // (v * p)
+        order = []
+        for r in range(p):
+            for j in range(v):
+                c = j * p + r
+                order.extend(range(c * lc, (c + 1) * lc))
+        self._vpp_order = order
+        self._blocks[:] = [self._blocks[i] for i in order]
+
     # -- per-param optimizer policy -------------------------------------------
     def _wd(self, name: str) -> float:
         if name.startswith(self.STACK_PREFIX):
@@ -230,6 +402,106 @@ class PipelinedTrainer(SpmdTrainer):
             return PartitionSpec(*entries)
         return super()._state_spec(pspec, shape)
 
+    # -- 1F1B: manual schedule, grads produced by the region -------------------
+    def _build(self, batch_arrays):
+        if self.schedule != "1f1b":
+            return super()._build(batch_arrays)
+        if self._jax_mesh is None or "pp" not in self.mesh.dim_names:
+            raise ValueError("schedule='1f1b' requires a mesh with a 'pp' axis")
+        return self._jit_step(self._make_1f1b_step(), batch_arrays)
+
+    def _make_1f1b_step(self):
+        model = self.model
+        template = self._template
+        local_names = self._local_names
+        nm = self.n_micro
+        embed_names = list(model.pp_embed_param_names())
+        tail_names = list(model.pp_tail_param_names())
+        known = set(embed_names) | set(tail_names)
+        leftovers = [n for n in self._nonblock_names if n not in known]
+        if leftovers:
+            raise ValueError(
+                f"1f1b: non-block params {leftovers} are neither embed nor "
+                "tail params; extend pp_embed_param_names/pp_tail_param_names")
+        buffers = self._buffers
+
+        def block_apply_flat(leaf_slices, h, *consts):
+            state = dict(zip(local_names, leaf_slices))
+            with template.swap_state(state), no_grad():
+                out = type(model).pp_block_call(
+                    template, Tensor(h), *[Tensor(c) for c in consts])
+            return out._data
+
+        def tail_apply_flat(tail_leaves, y, label):
+            state = dict(zip(tail_names, tail_leaves))
+            state.update(buffers)
+            with model.swap_state(state), no_grad():
+                loss = model.pp_tail(Tensor(y), Tensor(label))
+            return loss._data.astype(jnp.float32)
+
+        region = functools.partial(
+            pipeline_1f1b, block_apply_flat=block_apply_flat,
+            tail_apply_flat=tail_apply_flat, axis_name="pp", n_micro=nm,
+            remat=self._pp_remat)
+        P0 = PartitionSpec()
+
+        def step_fn(params, opt_state, lr, step_i, key, *batch):
+            with key_context(key):
+                return run_step(params, opt_state, lr, step_i, *batch)
+
+        def run_step(params, opt_state, lr, step_i, *batch):
+            ids, labels = batch  # causal-LM batch: (input_ids, labels)
+            bsz = ids.shape[0]
+            if bsz % nm != 0:
+                raise ValueError(f"batch {bsz} not divisible by n_micro {nm}")
+            mb = bsz // nm
+
+            def embed_fn(embed_params):
+                state = dict(embed_params)
+                state.update(buffers)
+                with model.swap_state(state), no_grad():
+                    h, consts = model.pp_embed(Tensor(ids))
+                return h._data, tuple(
+                    c._data if isinstance(c, Tensor) else jnp.asarray(c)
+                    for c in consts)
+
+            h0_flat, emb_vjp, consts = jax.vjp(
+                embed_fn, {n: params[n] for n in embed_names}, has_aux=True)
+            h0 = h0_flat.reshape((nm, mb) + h0_flat.shape[1:])
+            labels_m = labels.reshape((nm, mb) + labels.shape[1:])
+            stacked = tuple(params[self.STACK_PREFIX + ln]
+                            for ln in local_names)
+            tail_list = tuple(params[n] for n in tail_names)
+
+            leaf_specs = tuple(
+                PartitionSpec(*(["pp"] + [None] * (l.ndim - 1)))
+                for l in stacked)
+            loss, d_h0, blk_g, tail_g = jax.shard_map(
+                lambda h0_, lab_, consts_, st_, tl_: region(
+                    h0_, lab_, tuple(consts_), list(st_), list(tl_)),
+                mesh=self._jax_mesh,
+                in_specs=(P0, P0, tuple(P0 for _ in consts), leaf_specs,
+                          tuple(P0 for _ in tail_list)),
+                out_specs=(P0, P0, list(leaf_specs),
+                           [P0 for _ in tail_list]),
+                axis_names={"pp"},
+                check_vma=False,
+            )(h0, labels_m, consts, stacked, tail_list)
+
+            emb_g = emb_vjp(d_h0.reshape(h0_flat.shape))[0]
+            grads = {}
+            for ln, g in zip(local_names, blk_g):
+                grads[self.STACK_PREFIX + ln] = g
+            for n, g in zip(tail_names, tail_g):
+                grads[n] = grads[n] + g if n in grads else g
+            for n, g in emb_g.items():
+                grads[n] = grads[n] + g if n in grads else g
+            new_params, new_state = self._apply_update(params, grads,
+                                                       opt_state, lr, step_i)
+            return loss, new_params, new_state
+
+        return step_fn
+
     # -- traced loss with the pipelined block region --------------------------
     def _pure_loss(self, params_, batch_arrays, key):
         from . import context as pctx
@@ -266,9 +538,19 @@ class PipelinedTrainer(SpmdTrainer):
                 pipeline_blocks, block_apply_flat=block_apply_flat,
                 axis_name="pp", n_micro=nm, remat=remat)
             n_stacked = len(stacked_leaves)
+            v = self.vpp_chunks
 
             def local_fn(h0_, consts_, *leaves):
-                return body(h0_, tuple(consts_), list(leaves))
+                if v <= 1:
+                    return body(h0_, tuple(consts_), list(leaves))
+                # interleaved VPP: v ring phases, phase j applying this
+                # device's j-th chunk (virtual stage j*p + rank)
+                lc = leaves[0].shape[0] // v
+                h = h0_
+                for j in range(v):
+                    h = body(h, tuple(consts_),
+                             [l[j * lc:(j + 1) * lc] for l in leaves])
+                return h
 
             leaf_specs = tuple(
                 PartitionSpec(*( ["pp"] + [None] * (l.ndim - 1)))

@@ -217,28 +217,34 @@ class SpmdTrainer:
     def _wd(self, name: str) -> float:
         return self.opt._wd_coeff(self._params[name])
 
-    def _build(self, batch_arrays):
+    def _apply_update(self, params, grads, opt_state, lr, step_i):
+        """Shared step epilogue: grad clip + per-param optimizer update."""
         opt = self.opt
-        names = self._param_list
-        wd = {n: self._wd(n) for n in names}
-        lr_mult = {n: self._lr_mult(n) for n in names}
+        grads = _clip_grads_functional(opt._grad_clip, params, grads)
+        new_params, new_state = {}, {}
+        for n in self._param_list:
+            p = params[n]
+            g = grads[n].astype(p.dtype)
+            np_, ns_ = opt._update(p, g, opt_state[n],
+                                   lr * self._lr_mult(n), self._wd(n), step_i)
+            new_params[n] = np_
+            new_state[n] = ns_
+        return new_params, new_state
 
+    def _build(self, batch_arrays):
         def step_fn(params, opt_state, lr, step_i, key, *batch):
             def pure_loss(params_):
                 return self._pure_loss(params_, batch, key)
 
             loss, grads = jax.value_and_grad(pure_loss)(params)
-            grads = _clip_grads_functional(opt._grad_clip, params, grads)
-            new_params, new_state = {}, {}
-            for n in names:
-                p = params[n]
-                g = grads[n].astype(p.dtype)
-                np_, ns_ = opt._update(p, g, opt_state[n], lr * lr_mult[n],
-                                       wd[n], step_i)
-                new_params[n] = np_
-                new_state[n] = ns_
+            new_params, new_state = self._apply_update(params, grads,
+                                                       opt_state, lr, step_i)
             return loss, new_params, new_state
 
+        return self._jit_step(step_fn, batch_arrays)
+
+    def _jit_step(self, step_fn, batch_arrays):
+        names = self._param_list
         jit_kwargs = {}
         if self._jax_mesh is not None:
             param_sh = {n: self._sharding(self._param_spec(n, self._params[n]))
@@ -282,8 +288,16 @@ class SpmdTrainer:
         return Tensor(loss)
 
     def block(self):
+        """Barrier on all dispatched steps.
+
+        Fetches the last loss to host rather than block_until_ready: under a
+        remote-tunnel backend (axon) block_until_ready has been observed to
+        return before the dispatched chain actually finishes, while a host
+        fetch is a true sync point. The loss depends on the whole param
+        chain, so one scalar fetch drains every outstanding step.
+        """
         if self._last_loss is not None:
-            jax.block_until_ready(self._last_loss)
+            np.asarray(self._last_loss)
 
     # checkpoint bridge: expose optimizer state in the eager optimizer format
     def sync_optimizer_state(self):
