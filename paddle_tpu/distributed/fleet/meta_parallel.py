@@ -113,6 +113,103 @@ class ParallelCrossEntropy(Layer):
                                ignore_index=self.ignore_index)
 
 
+# ---- Megatron-SP: sequence parallelism inside the TP group -------------------
+# Reference parity: fleet/utils/sequence_parallel_utils.py — Scatter/Gather/
+# AllGather/ReduceScatter PyLayers (:85-146) and the sequence-parallel Linear
+# variants ColumnSequenceParallelLinear (:429) / RowSequenceParallelLinear
+# (:564). TPU-native: the activation layout BETWEEN TP blocks is declared with
+# sharding constraints (seq dim sharded over mp); GSPMD then lowers the
+# reference's explicit collectives itself — the RowParallel psum becomes a
+# reduce-scatter and the ColumnParallel input gather becomes an all-gather,
+# exactly the Megatron-SP comm pattern, scheduled by the compiler.
+
+def _seq_parallel_constraint(x: Tensor, name: str) -> Tensor:
+    """Constrain [batch, seq, ...] activations to seq-sharded over mp (keeps
+    the ambient batch sharding). No-op without a mesh / with mp degree 1."""
+    from ...ops.dispatch import dispatch, ensure_tensor
+    from ...parallel import context as pctx
+    mesh = pctx.current_mesh()
+    if mesh is None or "mp" not in mesh.dim_names or \
+            mesh.get_dim_size("mp") <= 1:
+        return ensure_tensor(x)
+    baxes = pctx.batch_axes()
+    entry0 = tuple(baxes) if baxes else None
+    # compose with context parallelism: the seq dim may already be sharded
+    # over the sep axis (ring attention); SP subdivides it further over mp
+    seqax = pctx.sequence_axis()
+    entry1 = (seqax, "mp") if seqax else "mp"
+    return dispatch(name,
+                    lambda a: pctx.sharding_constraint(a, entry0, entry1),
+                    ensure_tensor(x))
+
+
+def scatter(x):
+    """Parity: sequence_parallel_utils.ScatterOp — full-seq -> seq-sharded
+    (lowers to a local slice / reshard under GSPMD)."""
+    return _seq_parallel_constraint(x, "sp_scatter")
+
+
+def all_gather_sp(x):
+    """Parity: sequence_parallel_utils.AllGatherOp — seq-sharded -> full seq."""
+    from ...ops.dispatch import dispatch, ensure_tensor
+    from ...parallel import context as pctx
+    mesh = pctx.current_mesh()
+    if mesh is None:
+        return ensure_tensor(x)
+    baxes = pctx.batch_axes()
+    entry0 = tuple(baxes) if baxes else None
+    seqax = pctx.sequence_axis()
+    return dispatch("sp_gather",
+                    lambda a: pctx.sharding_constraint(a, entry0, seqax),
+                    ensure_tensor(x))
+
+
+class GatherOp:
+    apply = staticmethod(all_gather_sp)
+
+
+class ScatterOp:
+    apply = staticmethod(scatter)
+
+
+def mark_as_sequence_parallel_parameter(param):
+    """Parity: sequence_parallel_utils.mark_as_sequence_parallel_parameter.
+    Under GSPMD the norm-weight grads are psum'd by the compiler; the mark is
+    kept as metadata for checkpoint tools."""
+    param.sequence_parallel = True
+
+
+def is_sequence_parallel_parameter(param):
+    return getattr(param, "sequence_parallel", False)
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1,
+                                               fuse_allreduce=False):
+    """Parity: sequence_parallel_utils.py:192. A no-op by design: the SP
+    parameter grad allreduce the reference installs as a backward hook is
+    emitted by GSPMD from the sharding specs (grads of replicated params used
+    by sharded activations are partial -> psum)."""
+    return model
+
+
+class ColumnSequenceParallelLinear(ColumnParallelLinear):
+    """Parity: sequence_parallel_utils.py:429. Input arrives seq-sharded;
+    the constraint makes GSPMD all-gather it for the out-sharded matmul."""
+
+    def forward(self, x):
+        x = _seq_parallel_constraint(x, "sp_column_in")
+        return F.linear(x, self.weight, self.bias)
+
+
+class RowSequenceParallelLinear(RowParallelLinear):
+    """Parity: sequence_parallel_utils.py:564. Output is declared seq-sharded,
+    so the partial-sum over mp lowers to reduce-scatter instead of all-reduce."""
+
+    def forward(self, x):
+        y = F.linear(x, self.weight, self.bias)
+        return _seq_parallel_constraint(y, "sp_row_out")
+
+
 # ---- model wrappers ----------------------------------------------------------
 
 class MetaParallelBase(Layer):

@@ -49,18 +49,21 @@ def _dot(a, b, dims):
                                preferred_element_type=jnp.float32)
 
 
-def _causal_mask(iq, ik, block_q, block_k):
+def _causal_mask(iq, ik, block_q, block_k, offset):
+    """Bottom-right-aligned causal mask (query i attends keys <= i + sk - sq),
+    matching the XLA reference paths and the kv-cache decode convention;
+    offset = sk - sq (0 for self-attention)."""
     q_pos = iq * block_q + jax.lax.broadcasted_iota(jnp.int32,
                                                     (block_q, block_k), 0)
     k_pos = ik * block_k + jax.lax.broadcasted_iota(jnp.int32,
                                                     (block_q, block_k), 1)
-    return q_pos >= k_pos
+    return q_pos + offset >= k_pos
 
 
 # -- forward ------------------------------------------------------------------
 
 def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scratch, l_scratch,
-               acc_scratch, *, scale, causal, block_q, block_k, nk):
+               acc_scratch, *, scale, causal, block_q, block_k, nk, offset):
     ik = pl.program_id(2)
     iq = pl.program_id(1)
 
@@ -76,7 +79,8 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scratch, l_scratch,
         v = v_ref[0]                                 # [Bk, d]
         s = _dot(q, k, (((1,), (1,)))) * scale       # [Bq, Bk] fp32
         if causal:
-            s = jnp.where(_causal_mask(iq, ik, block_q, block_k), s, NEG_INF)
+            s = jnp.where(_causal_mask(iq, ik, block_q, block_k, offset), s,
+                          NEG_INF)
         m_prev = m_scratch[:]                        # [Bq, 1]
         l_prev = l_scratch[:]
         m_cur = jnp.max(s, axis=1, keepdims=True)
@@ -91,7 +95,7 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scratch, l_scratch,
 
     if causal:
         # Skip fully-masked tiles (kv block entirely after the q block).
-        @pl.when(ik * block_k <= iq * block_q + (block_q - 1))
+        @pl.when(ik * block_k <= iq * block_q + (block_q - 1) + offset)
         def _():
             _compute()
     else:
@@ -128,7 +132,7 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k):
     s = scale if scale is not None else 1.0 / math.sqrt(d)
 
     kernel = functools.partial(_fa_kernel, scale=s, causal=causal, block_q=bq,
-                               block_k=bk, nk=nk)
+                               block_k=bk, nk=nk, offset=sk - sq)
     out, lse = pl.pallas_call(
         kernel,
         grid=(bh, nq, nk),
@@ -160,7 +164,7 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k):
 # -- backward -----------------------------------------------------------------
 
 def _fa_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
-                  acc_scratch, *, scale, causal, block_q, block_k, nk):
+                  acc_scratch, *, scale, causal, block_q, block_k, nk, offset):
     ik = pl.program_id(2)
     iq = pl.program_id(1)
 
@@ -177,14 +181,15 @@ def _fa_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
         delta = delta_ref[0][:, :1]                     # [Bq, 1] fp32
         s = _dot(q, k, ((1,), (1,))) * scale            # [Bq, Bk] fp32
         if causal:
-            s = jnp.where(_causal_mask(iq, ik, block_q, block_k), s, NEG_INF)
+            s = jnp.where(_causal_mask(iq, ik, block_q, block_k, offset), s,
+                          NEG_INF)
         p = jnp.exp(s - lse)                            # [Bq, Bk] fp32
         dp = _dot(g, v, ((1,), (1,)))                   # [Bq, Bk] fp32
         ds = p * (dp - delta) * scale
         acc_scratch[:] += _dot(ds.astype(k.dtype), k, ((1,), (0,)))
 
     if causal:
-        @pl.when(ik * block_k <= iq * block_q + (block_q - 1))
+        @pl.when(ik * block_k <= iq * block_q + (block_q - 1) + offset)
         def _():
             _compute()
     else:
@@ -197,7 +202,7 @@ def _fa_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
 
 def _fa_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dk_ref,
                    dv_ref, dk_scratch, dv_scratch, *, scale, causal, block_q,
-                   block_k, nq):
+                   block_k, nq, offset):
     iq = pl.program_id(2)
     ik = pl.program_id(1)
 
@@ -217,7 +222,8 @@ def _fa_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dk_ref,
         delta = delta_ref[0][:, :1]                     # [Bq, 1] fp32
         s = _dot(q, k, ((1,), (1,))) * scale            # [Bq, Bk] fp32
         if causal:
-            s = jnp.where(_causal_mask(iq, ik, block_q, block_k), s, NEG_INF)
+            s = jnp.where(_causal_mask(iq, ik, block_q, block_k, offset), s,
+                          NEG_INF)
         p = jnp.exp(s - lse)                            # [Bq, Bk] fp32
         dv_scratch[:] += _dot(p.astype(g.dtype), g, ((0,), (0,)))
         dp = _dot(g, v, ((1,), (1,)))                   # [Bq, Bk] fp32
@@ -226,7 +232,7 @@ def _fa_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dk_ref,
 
     if causal:
         # Skip q blocks entirely before this kv block.
-        @pl.when(iq * block_q + (block_q - 1) >= ik * block_k)
+        @pl.when(iq * block_q + (block_q - 1) + offset >= ik * block_k)
         def _():
             _compute()
     else:
@@ -262,7 +268,7 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k):
 
     dq = pl.pallas_call(
         functools.partial(_fa_dq_kernel, scale=s, causal=causal, block_q=bq,
-                          block_k=bk, nk=nk),
+                          block_k=bk, nk=nk, offset=sk - sq),
         grid=(bh, nq, nk),
         in_specs=[
             q_spec,
@@ -283,7 +289,7 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k):
     row_spec2 = pl.BlockSpec((1, bq, LANES), lambda ibh, ik, iq: (ibh, iq, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_fa_dkv_kernel, scale=s, causal=causal, block_q=bq,
-                          block_k=bk, nq=nq),
+                          block_k=bk, nq=nq, offset=sk - sq),
         grid=(bh, nk, nq),
         in_specs=[q_spec2, kv_spec, kv_spec, q_spec2, row_spec2, row_spec2],
         out_specs=[kv_spec, kv_spec],

@@ -20,11 +20,23 @@ import jax.numpy as jnp
 
 from .. import nn
 from ..distributed.fleet.meta_parallel import (ColumnParallelLinear,
+                                               ColumnSequenceParallelLinear,
                                                RowParallelLinear,
-                                               VocabParallelEmbedding)
+                                               RowSequenceParallelLinear,
+                                               VocabParallelEmbedding,
+                                               scatter as sp_scatter)
 from ..nn import functional as F
 from ..ops.dispatch import dispatch, ensure_tensor
 from ..tensor import Tensor
+
+
+def _tp_linears(config):
+    """Column/Row TP layer classes; the SP variants keep activations
+    seq-sharded over mp between blocks (Megatron-SP,
+    fleet/utils/sequence_parallel_utils.py:429,:564)."""
+    if getattr(config, "sequence_parallel", False):
+        return ColumnSequenceParallelLinear, RowSequenceParallelLinear
+    return ColumnParallelLinear, RowParallelLinear
 
 
 @dataclass
@@ -40,6 +52,7 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     tie_word_embeddings: bool = False
     use_flash_attention: bool = True
+    sequence_parallel: bool = False  # Megatron-SP inside the TP group
     dtype: str = "float32"
 
     @staticmethod
@@ -102,17 +115,15 @@ class LlamaAttention(nn.Layer):
         self.num_heads = config.num_attention_heads
         self.num_kv_heads = config.num_key_value_heads or self.num_heads
         self.head_dim = self.hidden_size // self.num_heads
-        self.q_proj = ColumnParallelLinear(self.hidden_size,
-                                           self.num_heads * self.head_dim,
-                                           has_bias=False)
-        self.k_proj = ColumnParallelLinear(self.hidden_size,
-                                           self.num_kv_heads * self.head_dim,
-                                           has_bias=False)
-        self.v_proj = ColumnParallelLinear(self.hidden_size,
-                                           self.num_kv_heads * self.head_dim,
-                                           has_bias=False)
-        self.o_proj = RowParallelLinear(self.num_heads * self.head_dim,
-                                        self.hidden_size, has_bias=False)
+        Col, Row = _tp_linears(config)
+        self.q_proj = Col(self.hidden_size, self.num_heads * self.head_dim,
+                          has_bias=False)
+        self.k_proj = Col(self.hidden_size, self.num_kv_heads * self.head_dim,
+                          has_bias=False)
+        self.v_proj = Col(self.hidden_size, self.num_kv_heads * self.head_dim,
+                          has_bias=False)
+        self.o_proj = Row(self.num_heads * self.head_dim, self.hidden_size,
+                          has_bias=False)
 
     def forward(self, hidden_states, rope_cache, attention_mask=None):
         b, s, _ = hidden_states.shape
@@ -139,14 +150,13 @@ class LlamaMLP(nn.Layer):
 
     def __init__(self, config: LlamaConfig):
         super().__init__()
-        self.gate_proj = ColumnParallelLinear(config.hidden_size,
-                                              config.intermediate_size,
-                                              has_bias=False)
-        self.up_proj = ColumnParallelLinear(config.hidden_size,
-                                            config.intermediate_size,
-                                            has_bias=False)
-        self.down_proj = RowParallelLinear(config.intermediate_size,
-                                           config.hidden_size, has_bias=False)
+        Col, Row = _tp_linears(config)
+        self.gate_proj = Col(config.hidden_size, config.intermediate_size,
+                             has_bias=False)
+        self.up_proj = Col(config.hidden_size, config.intermediate_size,
+                           has_bias=False)
+        self.down_proj = Row(config.intermediate_size, config.hidden_size,
+                             has_bias=False)
 
     def forward(self, x):
         return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
@@ -190,6 +200,10 @@ class LlamaModel(nn.Layer):
 
     def forward(self, input_ids, attention_mask=None):
         h = self.embed_tokens(input_ids)
+        if self.config.sequence_parallel:
+            # Megatron-SP: activations between blocks live seq-sharded over mp
+            # (reference: split_inputs_sequence_dim + ScatterOp after embed)
+            h = sp_scatter(h)
         s = input_ids.shape[1]
         cos = Tensor(self.rope_cos._data[:s])
         sin = Tensor(self.rope_sin._data[:s])
@@ -217,9 +231,9 @@ class LlamaForCausalLM(nn.Layer):
         if config.tie_word_embeddings:
             self.lm_head = None
         else:
-            self.lm_head = ColumnParallelLinear(config.hidden_size,
-                                                config.vocab_size,
-                                                has_bias=False)
+            Col, _ = _tp_linears(config)
+            self.lm_head = Col(config.hidden_size, config.vocab_size,
+                               has_bias=False)
 
     def forward(self, input_ids, attention_mask=None):
         h = self.model(input_ids, attention_mask)

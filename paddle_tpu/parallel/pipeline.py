@@ -385,6 +385,9 @@ class PipelinedTrainer(SpmdTrainer):
                     self.mesh.get_dim_size(axis_name) > 1 and \
                     p._data.shape[dim + 1] % self.mesh.get_dim_size(axis_name) == 0:
                 entries[dim + 1] = axis_name
+        if self.zero_stage >= 3:
+            entries = self._zero_entries(entries, p._data.shape,
+                                         f"stacked param {name}")
         return PartitionSpec(*entries)
 
     def _state_spec(self, pspec: PartitionSpec, shape):

@@ -69,3 +69,25 @@ def test_multi_block_kv_accumulation():
     ref = fp._reference_bhsd(q, k, v, False, None)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5,
                                rtol=2e-5)
+
+
+def test_causal_kv_longer_than_q():
+    """Bottom-right-aligned causal mask (kv-cache decode): query i attends
+    keys up to i + (sk - sq), matching the XLA reference convention."""
+    q, k, v = _rand_qkv(1, 2, 128, 64, jnp.float32, kv_s=384)
+    out = fp.flash_attention(q, k, v, True, None, 128, 128)
+    ref = fp._reference_bhsd(q, k, v, True, None)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5,
+                               rtol=2e-5)
+
+    def f_kernel(q, k, v):
+        return jnp.sum(fp.flash_attention(q, k, v, True, None, 128, 128) ** 2)
+
+    def f_ref(q, k, v):
+        return jnp.sum(fp._reference_bhsd(q, k, v, True, None) ** 2)
+
+    gk = jax.grad(f_kernel, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gk, gr, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4,
+                                   rtol=2e-4, err_msg=f"d{name}")

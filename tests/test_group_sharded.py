@@ -1,0 +1,109 @@
+"""ZeRO stage 1/2/3 (GroupSharded): numerics == serial AND per-device bytes
+actually shrink.
+
+Mirrors the reference's dygraph_group_sharded_stage{2,3}.py strategy (SURVEY
+§4): parallel loss vs single-process loss, on the virtual 8-device CPU mesh.
+"""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import optimizer as opt
+from paddle_tpu.distributed import group_sharded_parallel
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.parallel import SpmdTrainer, make_hybrid_mesh
+
+
+def _make(seed=9):
+    paddle.seed(seed)
+    cfg = LlamaConfig.tiny(vocab_size=64, hidden_size=32, layers=2, heads=4,
+                           kv_heads=4, seq=16)
+    cfg.use_flash_attention = False
+    model = LlamaForCausalLM(cfg)
+    optimizer = opt.AdamW(learning_rate=1e-2, parameters=model.parameters())
+    return cfg, model, optimizer
+
+
+def _train(trainer, cfg, steps=2):
+    rng = np.random.default_rng(4)
+    losses = []
+    for _ in range(steps):
+        ids = paddle.to_tensor(
+            rng.integers(0, cfg.vocab_size, (8, 16)).astype(np.int32))
+        losses.append(float(trainer.train_step(ids, ids).numpy()))
+    return losses
+
+
+def _local_elems(arr):
+    return int(np.prod(arr.addressable_shards[0].data.shape))
+
+
+@pytest.fixture(scope="module")
+def serial_ref():
+    cfg, model, optim = _make()
+    return _train(SpmdTrainer(model, optim, _loss, mesh=None), cfg)
+
+
+def _loss(m, x, y):
+    return m.compute_loss(m(x), y)
+
+
+@pytest.mark.parametrize("stage", [1, 2, 3])
+def test_zero_stage_matches_serial(stage, serial_ref):
+    cfg, model, optim = _make()
+    mesh = make_hybrid_mesh(sharding=4)
+    tr = SpmdTrainer(model, optim, _loss, mesh=mesh, zero_stage=stage)
+    got = _train(tr, cfg)
+    np.testing.assert_allclose(got, serial_ref, rtol=3e-4, atol=3e-5)
+
+
+def test_zero3_param_and_state_bytes_shrink():
+    cfg, model, optim = _make()
+    mesh = make_hybrid_mesh(sharding=4)
+    tr = SpmdTrainer(model, optim, _loss, mesh=mesh, zero_stage=3)
+    _train(tr, cfg, steps=1)
+    name = "model.layers.0.mlp.gate_proj.weight"
+    p = tr._params[name]._data
+    assert _local_elems(p) * 4 == p.size, (
+        f"stage-3 param not sharded 4-ways: local {_local_elems(p)} of {p.size}")
+    m1 = tr._opt_state[name]["moment1"]
+    assert _local_elems(m1) * 4 == m1.size
+
+
+def test_zero1_state_sharded_params_replicated():
+    cfg, model, optim = _make()
+    mesh = make_hybrid_mesh(sharding=4)
+    tr = SpmdTrainer(model, optim, _loss, mesh=mesh, zero_stage=1)
+    _train(tr, cfg, steps=1)
+    name = "model.layers.0.mlp.gate_proj.weight"
+    p = tr._params[name]._data
+    assert _local_elems(p) == p.size, "stage-1 params must stay replicated"
+    m1 = tr._opt_state[name]["moment1"]
+    assert _local_elems(m1) * 4 == m1.size, "stage-1 moments must be sharded"
+
+
+def test_zero_nondivisible_warns():
+    cfg, model, optim = _make()
+    mesh = make_hybrid_mesh(sharding=4)
+    tr = SpmdTrainer(model, optim, _loss, mesh=mesh, zero_stage=3)
+    # hidden 32, vocab 64, seq 16 all divide by 4; fabricate a bad shape
+    class FakeP:
+        pass
+    entries = [None]
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        tr._zero_entries(entries, (7,), "param test")
+    assert any("stays replicated" in str(x.message) for x in w)
+
+
+def test_group_sharded_parallel_api():
+    cfg, model, optim = _make()
+    model2, optim2, scaler = group_sharded_parallel(model, optim, "p_g_os")
+    assert scaler is None
+    mesh = make_hybrid_mesh(sharding=4)
+    tr = SpmdTrainer(model2, optim2, _loss, mesh=mesh)  # picks up the tag
+    assert tr.zero_stage == 3
+    with pytest.raises(ValueError):
+        group_sharded_parallel(model, optim, "bogus")
