@@ -1,0 +1,183 @@
+"""Host-side (CPU, cross-process) collectives over the TCPStore.
+
+Reference parity: the gloo ProcessGroup role — eager collectives that work
+across OS processes without the accelerator (process_group_gloo.cc; python
+surface collective_*_api tests). TPU-native split: the *performance* path is
+compiler-emitted XLA collectives inside compiled programs (communication.py
+traced branch); this module is the *control plane* — correct, store-routed
+collectives for bootstrap, checkpoint coordination, metrics, and tests.
+
+Implementation: rendezvous through the C++ TCPStore (csrc/store.cpp). Each
+collective round uses a fresh key namespace (per-op sequence counter, kept in
+lockstep because every rank executes the same collective sequence); payloads
+are numpy arrays serialized with np.save (dtype/shape self-describing). The
+last rank to finish a round deletes its keys.
+"""
+from __future__ import annotations
+
+import io
+import os
+import pickle
+from typing import List, Optional
+
+import numpy as np
+
+from .store import TCPStore, create_or_get_global_tcp_store
+
+
+def _dump(arr: np.ndarray) -> bytes:
+    buf = io.BytesIO()
+    np.save(buf, arr, allow_pickle=False)
+    return buf.getvalue()
+
+
+def _load(data: bytes) -> np.ndarray:
+    return np.load(io.BytesIO(data), allow_pickle=False)
+
+
+class HostCollectives:
+    """Store-routed collectives among `world` processes (global ranks)."""
+
+    def __init__(self, store: TCPStore, rank: int, world: int,
+                 prefix: str = "hc"):
+        self.store = store
+        self.rank = rank
+        self.world = world
+        self.prefix = prefix
+        self._seq: dict = {}
+        self._p2p_seq: dict = {}
+
+    def _key(self, op: str) -> str:
+        n = self._seq.get(op, 0)
+        self._seq[op] = n + 1
+        return f"__hc/{self.prefix}/{op}/{n}"
+
+    def _finish(self, key: str, keys: List[str]) -> None:
+        if self.store.add(f"{key}/done", 1) == self.world:
+            for k in keys + [f"{key}/done"]:
+                self.store.delete_key(k)
+
+    # -- core rounds ----------------------------------------------------------
+    def all_gather_bytes(self, data: bytes, op: str = "ag") -> List[bytes]:
+        key = self._key(op)
+        mine = f"{key}/{self.rank}"
+        self.store.set(mine, data)
+        out = [self.store.get(f"{key}/{i}") for i in range(self.world)]
+        self._finish(key, [f"{key}/{i}" for i in range(self.world)])
+        return out
+
+    def broadcast_bytes(self, data: Optional[bytes], src: int,
+                        op: str = "bc") -> bytes:
+        key = self._key(op)
+        if self.rank == src:
+            self.store.set(f"{key}/v", data or b"")
+        out = self.store.get(f"{key}/v")
+        self._finish(key, [f"{key}/v"])
+        return out
+
+    # -- array collectives ----------------------------------------------------
+    def all_gather(self, arr: np.ndarray) -> List[np.ndarray]:
+        return [_load(b) for b in self.all_gather_bytes(_dump(arr))]
+
+    def all_reduce(self, arr: np.ndarray, op: str = "sum") -> np.ndarray:
+        parts = self.all_gather(arr)
+        stack = np.stack(parts)
+        if op == "sum":
+            return stack.sum(0).astype(arr.dtype)
+        if op == "max":
+            return stack.max(0)
+        if op == "min":
+            return stack.min(0)
+        if op == "prod":
+            return np.prod(stack, axis=0).astype(arr.dtype)
+        if op == "avg":
+            return (stack.sum(0) / self.world).astype(arr.dtype)
+        raise ValueError(f"unknown reduce op {op}")
+
+    def broadcast(self, arr: np.ndarray, src: int) -> np.ndarray:
+        data = _dump(arr) if self.rank == src else None
+        return _load(self.broadcast_bytes(data, src))
+
+    def reduce_scatter(self, arr: np.ndarray, op: str = "sum") -> np.ndarray:
+        full = self.all_reduce(arr, op)
+        chunk = full.shape[0] // self.world
+        return full[self.rank * chunk:(self.rank + 1) * chunk]
+
+    def all_to_all(self, parts: List[np.ndarray]) -> List[np.ndarray]:
+        key = self._key("a2a")
+        keys = []
+        for dst, p in enumerate(parts):
+            k = f"{key}/{self.rank}->{dst}"
+            self.store.set(k, _dump(p))
+            keys.append(k)
+        out = [_load(self.store.get(f"{key}/{src}->{self.rank}"))
+               for src in range(self.world)]
+        self._finish(key, [f"{key}/{s}->{d}" for s in range(self.world)
+                           for d in range(self.world)])
+        return out
+
+    def scatter(self, parts: Optional[List[np.ndarray]],
+                src: int) -> np.ndarray:
+        """src writes one key per destination (world x chunk traffic, not the
+        world^2 a broadcast-of-the-stack would cost)."""
+        key = self._key("sc")
+        if self.rank == src:
+            for dst, p in enumerate(parts):
+                self.store.set(f"{key}/{dst}", _dump(p))
+        out = _load(self.store.get(f"{key}/{self.rank}"))
+        self._finish(key, [f"{key}/{i}" for i in range(self.world)])
+        return out
+
+    # -- p2p ------------------------------------------------------------------
+    def send(self, arr: np.ndarray, dst: int) -> None:
+        pair = (self.rank, dst)
+        n = self._p2p_seq.get(pair, 0)
+        self._p2p_seq[pair] = n + 1
+        self.store.set(f"__hc/{self.prefix}/p2p/{self.rank}->{dst}/{n}",
+                       _dump(arr))
+
+    def recv(self, src: int) -> np.ndarray:
+        pair = (src, self.rank)
+        n = self._p2p_seq.get(pair, 0)
+        self._p2p_seq[pair] = n + 1
+        k = f"__hc/{self.prefix}/p2p/{src}->{self.rank}/{n}"
+        out = _load(self.store.get(k))
+        self.store.delete_key(k)
+        return out
+
+    # -- objects --------------------------------------------------------------
+    def all_gather_object(self, obj) -> List:
+        return [pickle.loads(b)
+                for b in self.all_gather_bytes(pickle.dumps(obj), op="ago")]
+
+    def broadcast_object(self, obj, src: int):
+        data = pickle.dumps(obj) if self.rank == src else None
+        return pickle.loads(self.broadcast_bytes(data, src, op="bco"))
+
+    def barrier(self) -> None:
+        self.store.barrier(prefix=f"hc/{self.prefix}")
+
+
+_host_cc: List[Optional[HostCollectives]] = [None]
+
+
+def world_info():
+    """(rank, world) from the launcher env (reference PADDLE_* / torchrun-style
+    RANK/WORLD_SIZE), without requiring jax.distributed to be initialized."""
+    rank = int(os.environ.get("PADDLE_TRAINER_ID",
+                              os.environ.get("RANK", "0")) or 0)
+    world = int(os.environ.get("PADDLE_TRAINERS_NUM",
+                               os.environ.get("WORLD_SIZE", "1")) or 1)
+    return rank, world
+
+
+def get_host_collectives() -> Optional[HostCollectives]:
+    """The process-wide HostCollectives over the global TCPStore, or None in
+    single-process mode."""
+    if _host_cc[0] is None:
+        rank, world = world_info()
+        if world <= 1:
+            return None
+        _host_cc[0] = HostCollectives(create_or_get_global_tcp_store(),
+                                     rank, world)
+    return _host_cc[0]

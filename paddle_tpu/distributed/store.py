@@ -179,7 +179,12 @@ def create_or_get_global_tcp_store() -> TCPStore:
     """Parity: core.create_or_get_global_tcp_store (parallel.py:1134)."""
     if _global_store[0] is None:
         master = os.environ.get("MASTER_ADDR", "127.0.0.1")
-        port = int(os.environ.get("MASTER_PORT", "0") or 0)
+        # Dedicated store port: MASTER_PORT itself belongs to the
+        # jax.distributed coordinator (env.py init_parallel_env) — binding
+        # both on one port would crash rank 0. PADDLE_STORE_PORT overrides.
+        sp = os.environ.get("PADDLE_STORE_PORT")
+        mp = int(os.environ.get("MASTER_PORT", "0") or 0)
+        port = int(sp) if sp else (mp + 1 if mp else 0)
         rank = int(os.environ.get("PADDLE_TRAINER_ID",
                                   os.environ.get("RANK", "0")) or 0)
         world = int(os.environ.get("PADDLE_TRAINERS_NUM",

@@ -21,17 +21,22 @@ def _on_tpu() -> bool:
         return False
 
 
-def is_available(q, k=None) -> bool:
+def is_available(q, k=None, causal=False) -> bool:
     """Pallas kernel requires TPU + seq/head-dim tiling-friendly shapes for
-    BOTH q and k/v (a non-divisible kv length would silently truncate)."""
+    BOTH q and k/v (a non-divisible kv length would silently truncate), and
+    q_len <= kv_len for causal (bottom-right alignment leaves leading rows
+    keyless otherwise — the XLA fallback defines that case)."""
     if not _on_tpu():
         return False
     if q.ndim != 4:
         return False
     _, seq, _, head_dim = q.shape
-    if k is not None and (k.ndim != 4 or k.shape[1] % 128 != 0 or
-                          k.shape[3] != head_dim or k.dtype != q.dtype):
-        return False
+    if k is not None:
+        if k.ndim != 4 or k.shape[1] % 128 != 0 or \
+                k.shape[3] != head_dim or k.dtype != q.dtype:
+            return False
+        if causal and seq > k.shape[1]:
+            return False
     return seq % 128 == 0 and head_dim in (64, 128, 256) and \
         q.dtype in (jnp.float32, jnp.bfloat16)
 

@@ -110,11 +110,17 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scratch, l_scratch,
         lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
 
 
-def _check_divisible(sq, sk, bq, bk):
+def _check_divisible(sq, sk, bq, bk, causal=False):
     if sq % bq or sk % bk:
         raise ValueError(
             f"flash_attention requires seq lengths divisible by the block "
             f"sizes (q {sq}%{bq}, kv {sk}%{bk}); pad or use the XLA path")
+    if causal and sq > sk:
+        # bottom-right alignment: rows i < sq-sk can attend NO keys; their
+        # softmax is undefined (would silently emit uniform attention)
+        raise ValueError(
+            f"causal flash_attention requires q_len <= kv_len "
+            f"(got {sq} > {sk}): leading rows would have empty masks")
 
 
 def _flash_forward(q, k, v, causal, scale, block_q, block_k):
@@ -122,7 +128,7 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k):
     sk = k.shape[2]
     bq = min(block_q, sq)
     bk = min(block_k, sk)
-    _check_divisible(sq, sk, bq, bk)
+    _check_divisible(sq, sk, bq, bk, causal)
     nq = sq // bq
     nk = sk // bk
     bh = b * h
@@ -249,7 +255,7 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k):
     sk = k.shape[2]
     bq = min(block_q, sq)
     bk = min(block_k, sk)
-    _check_divisible(sq, sk, bq, bk)
+    _check_divisible(sq, sk, bq, bk, causal)
     nq = sq // bq
     nk = sk // bk
     bh = b * h

@@ -70,7 +70,7 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                                causal=is_causal),
                 qt, kt, vt)
         from ...kernels import flash_attention as fa
-        if fa.is_available(qt._data, kt._data):
+        if fa.is_available(qt._data, kt._data, causal=is_causal):
             return dispatch(
                 "flash_attention",
                 lambda q, k, v: fa.flash_attention_bshd(q, k, v, causal=is_causal),

@@ -1,0 +1,90 @@
+"""Worker program for multi-process host-collective tests (run by
+test_host_collectives.py with PADDLE_TRAINER_ID/PADDLE_TRAINERS_NUM env) —
+the reference's collective_*_api.py pattern: each rank computes, asserts
+against the local numpy reduction."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+
+
+def main():
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    world = int(os.environ["PADDLE_TRAINERS_NUM"])
+
+    # all_reduce SUM
+    t = paddle.to_tensor(np.full((2, 3), float(rank + 1), np.float32))
+    dist.all_reduce(t)
+    expect = sum(range(1, world + 1))
+    np.testing.assert_allclose(t.numpy(), np.full((2, 3), expect, np.float32))
+
+    # all_reduce PROD (the round-1 psum(log) bug would break negatives)
+    t = paddle.to_tensor(np.array([-2.0, 3.0], np.float32) * (rank + 1))
+    dist.all_reduce(t, op=dist.ReduceOp.PROD)
+    base = np.array([-2.0, 3.0], np.float32)
+    expect = np.prod(np.stack([base * (i + 1) for i in range(world)]), axis=0)
+    np.testing.assert_allclose(t.numpy(), expect, rtol=1e-6)
+
+    # all_gather
+    out = []
+    dist.all_gather(out, paddle.to_tensor(np.array([rank], np.int32)))
+    got = np.concatenate([o.numpy() for o in out])
+    np.testing.assert_array_equal(got, np.arange(world, dtype=np.int32))
+
+    # broadcast from rank 1
+    t = paddle.to_tensor(np.array([rank * 10.0], np.float32))
+    dist.broadcast(t, src=1)
+    np.testing.assert_allclose(t.numpy(), [10.0])
+
+    # send/recv ring
+    nxt, prv = (rank + 1) % world, (rank - 1) % world
+    dist.send(paddle.to_tensor(np.array([rank], np.int32)), dst=nxt)
+    r = paddle.to_tensor(np.array([-1], np.int32))
+    dist.recv(r, src=prv)
+    np.testing.assert_array_equal(r.numpy(), [prv])
+
+    # all_to_all
+    outs = []
+    ins = [paddle.to_tensor(np.array([rank * 100 + d], np.int32))
+           for d in range(world)]
+    dist.all_to_all(outs, ins)
+    got = np.concatenate([o.numpy() for o in outs])
+    np.testing.assert_array_equal(
+        got, np.array([s * 100 + rank for s in range(world)], np.int32))
+
+    # scatter from rank 0 (per-destination store keys)
+    t = paddle.to_tensor(np.zeros(2, np.float32))
+    parts = [paddle.to_tensor(np.full(2, float(d), np.float32))
+             for d in range(world)] if rank == 0 else None
+    dist.scatter(t, parts, src=0)
+    np.testing.assert_allclose(t.numpy(), np.full(2, float(rank), np.float32))
+
+    # object + barrier
+    objs = []
+    dist.all_gather_object(objs, {"rank": rank})
+    assert [o["rank"] for o in objs] == list(range(world))
+    dist.barrier()
+
+    # subgroup must fail loudly, not silently no-op
+    g = dist.new_group(ranks=[0])
+    try:
+        dist.all_reduce(paddle.to_tensor(np.ones(1, np.float32)), group=g)
+    except NotImplementedError:
+        pass
+    else:
+        raise AssertionError("subgroup eager collective silently passed")
+
+    print(f"rank {rank} OK")
+
+
+if __name__ == "__main__":
+    main()

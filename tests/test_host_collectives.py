@@ -1,0 +1,69 @@
+"""Multi-process eager collectives over the TCPStore (reference strategy:
+TestDistBase spawning trainer subprocesses, SURVEY §4)."""
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_host_collectives_three_ranks():
+    world = 3
+    port = _free_port()
+    worker = os.path.join(os.path.dirname(__file__), "collective_worker.py")
+    procs = []
+    for rank in range(world):
+        env = dict(os.environ)
+        env.update({
+            "MASTER_ADDR": "127.0.0.1",
+            "MASTER_PORT": str(port),
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(world),
+            "JAX_PLATFORMS": "cpu",
+        })
+        env.pop("XLA_FLAGS", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, worker], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    fails = []
+    for rank, p in enumerate(procs):
+        out, _ = p.communicate(timeout=180)
+        if p.returncode != 0:
+            fails.append(f"rank {rank} rc={p.returncode}:\n"
+                         + out.decode()[-2000:])
+    assert not fails, "\n".join(fails)
+
+
+def test_traced_prod_allreduce():
+    """PROD inside a compiled program (mesh axis): psum(log) would be wrong
+    for negative values — must be prod of all_gather."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec
+
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.tensor import Tensor
+
+    devs = np.array(jax.devices("cpu")[:4])
+    mesh = Mesh(devs, ("x",))
+    g = dist.new_group(axis_name="x")
+
+    def body(x):
+        t = Tensor(x[0])
+        dist.all_reduce(t, op=dist.ReduceOp.PROD, group=g)
+        return t._data[None]
+
+    x = jnp.asarray(np.array([[-2.0], [3.0], [-4.0], [5.0]], np.float32))
+    out = jax.shard_map(body, mesh=mesh, in_specs=PartitionSpec("x"),
+                        out_specs=PartitionSpec("x"))(x)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.full((4, 1), 120.0, np.float32))

@@ -1,0 +1,64 @@
+"""Launcher CLI: env wiring + restart-on-failure (reference: launch/main.py:23,
+controllers/collective.py:267 watcher; elastic restart semantics)."""
+import os
+import subprocess
+import sys
+import tempfile
+
+WORKER = """
+import os, sys
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+world = int(os.environ["PADDLE_TRAINERS_NUM"])
+gen = int(os.environ["PADDLE_RESTART_GENERATION"])
+assert os.environ["MASTER_ADDR"] == "127.0.0.1"
+assert world == 2
+marker_dir = sys.argv[1]
+open(os.path.join(marker_dir, f"rank{rank}.gen{gen}"), "w").close()
+# rank 1 dies in generation 0; everyone succeeds in generation 1
+if rank == 1 and gen == 0:
+    sys.exit(7)
+"""
+
+
+def test_launch_restarts_failed_generation():
+    with tempfile.TemporaryDirectory() as td:
+        script = os.path.join(td, "train.py")
+        with open(script, "w") as f:
+            f.write(WORKER)
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nnodes", "2", "--max_restarts", "2", script, td],
+            capture_output=True, timeout=120,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        err = r.stderr.decode()
+        assert r.returncode == 0, err
+        assert "restarting generation 1" in err
+        # both generations ran: gen0 rank0+1, gen1 rank0+1
+        for gen in (0, 1):
+            for rank in (0, 1):
+                assert os.path.exists(
+                    os.path.join(td, f"rank{rank}.gen{gen}")), (gen, rank, err)
+
+
+def test_launch_gives_up_after_max_restarts():
+    with tempfile.TemporaryDirectory() as td:
+        script = os.path.join(td, "train.py")
+        with open(script, "w") as f:
+            f.write("import sys; sys.exit(3)\n")
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nnodes", "1", "--max_restarts", "1", script],
+            capture_output=True, timeout=120,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        assert r.returncode == 1
+        assert "max_restarts=1 exhausted" in r.stderr.decode()
+
+
+def test_launch_rejects_ps_mode():
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--run_mode", "ps", "x.py"],
+        capture_output=True, timeout=60,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert r.returncode != 0
+    assert "NotImplementedError" in r.stderr.decode()
