@@ -100,6 +100,7 @@ from . import distributed  # noqa: F401
 from . import vision  # noqa: F401
 from . import device  # noqa: F401
 from . import metric  # noqa: F401
+from . import inference  # noqa: F401
 from . import hapi  # noqa: F401
 from .hapi import Model, summary  # noqa: F401
 from .framework.io import save, load  # noqa: F401

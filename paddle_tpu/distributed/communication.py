@@ -231,20 +231,22 @@ def scatter_object_list(out_object_list, in_object_list, src=0, group=None):
 
 
 def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
-    if gather_list is not None:
-        ax = _axis(group)
-        if ax is not None and _is_traced(tensor._data):
+    ax = _axis(group)
+    if ax is not None and _is_traced(tensor._data):
+        if gather_list is not None:
             g = lax.all_gather(tensor._data, ax)
             for i in range(g.shape[0]):
                 gather_list.append(Tensor(g[i]))
-            return _Task()
-        hc = _host(group, tensor._data)
-        if hc is not None:
-            parts = hc.all_gather(_np(tensor))
-            if hc.rank == dst:
-                gather_list.extend(Tensor(jnp.asarray(a)) for a in parts)
-        else:
-            gather_list.append(Tensor(tensor._data))
+        return _Task()
+    # every rank must join the round (a None gather_list on non-dst ranks is
+    # the standard calling convention) or the collective sequence desyncs
+    hc = _host(group, tensor._data)
+    if hc is not None:
+        parts = hc.all_gather(_np(tensor))
+        if hc.rank == dst and gather_list is not None:
+            gather_list.extend(Tensor(jnp.asarray(a)) for a in parts)
+    elif gather_list is not None:
+        gather_list.append(Tensor(tensor._data))
     return _Task()
 
 
@@ -291,6 +293,20 @@ class P2POp:
 def batch_isend_irecv(p2p_op_list: List[P2POp]):
     """Parity: communication/batch_isend_irecv.py. Traced path: each matched
     send/recv pair lowers to one lax.ppermute over the group axis."""
+    first = p2p_op_list[0] if p2p_op_list else None
+    if first is not None and not _is_traced(first.tensor._data):
+        hc = _host(first.group, first.tensor._data)
+        if hc is not None:
+            # real cross-process p2p: each op stands alone (a rank may post
+            # only sends or only recvs)
+            for op in p2p_op_list:
+                if op.op in (isend, send):
+                    hc.send(np.asarray(op.tensor._data), op.peer)
+                else:
+                    op.tensor._data = jnp.asarray(hc.recv(op.peer))
+            return [_Task() for _ in p2p_op_list]
+    # traced: matched send/recv pairs lower to one ppermute over the axis;
+    # single-process eager: identity pairing
     sends = [p for p in p2p_op_list if p.op in (isend, send)]
     recvs = [p for p in p2p_op_list if p.op in (irecv, recv)]
     for s, r in zip(sends, recvs):
@@ -300,12 +316,7 @@ def batch_isend_irecv(p2p_op_list: List[P2POp]):
             perm = [(i, (i + 1) % n) for i in range(n)]
             r.tensor._data = lax.ppermute(s.tensor._data, ax, perm)
         else:
-            hc = _host(s.group, s.tensor._data)
-            if hc is not None:
-                hc.send(np.asarray(s.tensor._data), s.peer)
-                r.tensor._data = jnp.asarray(hc.recv(r.peer))
-            else:
-                r.tensor._data = s.tensor._data
+            r.tensor._data = s.tensor._data
     return [_Task() for _ in p2p_op_list]
 
 

@@ -99,6 +99,10 @@ class HostCollectives:
         return _load(self.broadcast_bytes(data, src))
 
     def reduce_scatter(self, arr: np.ndarray, op: str = "sum") -> np.ndarray:
+        if arr.shape[0] % self.world != 0:
+            raise ValueError(
+                f"reduce_scatter: leading dim {arr.shape[0]} not divisible "
+                f"by world size {self.world}")
         full = self.all_reduce(arr, op)
         chunk = full.shape[0] // self.world
         return full[self.rank * chunk:(self.rank + 1) * chunk]

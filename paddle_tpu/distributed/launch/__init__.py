@@ -80,6 +80,10 @@ class Controller:
         self.world = args.world_size or self.nranks_local
         master = args.master or f"127.0.0.1:{_free_port()}"
         self.master_addr, self.master_port = master.rsplit(":", 1)
+        # separate, verified-free port for the TCPStore (MASTER_PORT belongs
+        # to the jax.distributed coordinator; the +1 default could collide
+        # with an unrelated service or the rank-0 endpoint)
+        self.store_port = _free_port()
         self.procs: List[subprocess.Popen] = []
         self._logs: List = []
         self.generation = 0
@@ -92,6 +96,7 @@ class Controller:
         env.update({
             "MASTER_ADDR": self.master_addr,
             "MASTER_PORT": str(self.master_port),
+            "PADDLE_STORE_PORT": str(self.store_port),
             "PADDLE_TRAINER_ID": str(rank),
             "PADDLE_TRAINERS_NUM": str(self.world),
             "PADDLE_TRAINER_ENDPOINTS": endpoints,

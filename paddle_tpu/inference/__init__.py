@@ -1,0 +1,134 @@
+"""paddle_tpu.inference — serving predictor over AOT-exported artifacts.
+
+Reference parity: paddle.inference (AnalysisConfig + AnalysisPredictor,
+paddle/fluid/inference/api/analysis_predictor.cc:1574 Run, :2177
+OptimizeInferenceProgram). TPU-native: the offline optimization pipeline
+(IR passes, TRT subgraphs) is replaced by ahead-of-time XLA compilation —
+the artifact produced by `paddle_tpu.jit.save` is a serialized StableHLO
+module with the weights alongside; `create_predictor` deserializes it and
+runs it through the XLA runtime. Zero-copy handles mirror the reference's
+copy_from_cpu/copy_to_cpu tensor API.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class Config:
+    """Parity: paddle.inference.Config (AnalysisConfig). Graph-optimization
+    knobs are accepted for API compatibility; XLA owns those decisions."""
+
+    def __init__(self, model_path: Optional[str] = None,
+                 params_path: Optional[str] = None):
+        if model_path and model_path.endswith(".pdmodel"):
+            model_path = model_path[:-len(".pdmodel")]
+        self.model_path = model_path
+        self.params_path = params_path
+        self._ir_optim = True
+        self._memory_optim = True
+
+    def set_model(self, model_path, params_path=None):
+        self.__init__(model_path, params_path)
+
+    def model_dir(self):
+        return self.model_path
+
+    # accepted no-ops (XLA decides): keep the reference surface working
+    def switch_ir_optim(self, flag=True):
+        self._ir_optim = flag
+
+    def enable_memory_optim(self, flag=True):
+        self._memory_optim = flag
+
+    def disable_glog_info(self):
+        pass
+
+    def enable_use_gpu(self, *a, **k):
+        pass  # device choice is jax platform selection
+
+    def disable_gpu(self):
+        pass
+
+    def enable_xpu(self, *a, **k):
+        pass
+
+    def set_cpu_math_library_num_threads(self, n):
+        pass
+
+
+class _Handle:
+    """Parity: the predictor's input/output tensor handle
+    (copy_from_cpu/copy_to_cpu)."""
+
+    def __init__(self):
+        self._array = None
+
+    def copy_from_cpu(self, arr: np.ndarray):
+        self._array = jnp.asarray(arr)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        return np.asarray(self._array)
+
+    def reshape(self, shape):
+        if self._array is not None:
+            self._array = self._array.reshape(shape)
+
+    @property
+    def shape(self):
+        return None if self._array is None else list(self._array.shape)
+
+
+class Predictor:
+    """Parity: paddle.inference.Predictor (AnalysisPredictor::Run :1574)."""
+
+    def __init__(self, config: Config):
+        from ..jit import load
+        if not config.model_path:
+            raise ValueError("Config needs a model path (jit.save artifact)")
+        self._layer = load(config.model_path)
+        self._inputs: Dict[str, _Handle] = {
+            n: _Handle() for n in self._layer.input_names()}
+        self._output_arrays: List = []
+
+    def get_input_names(self) -> List[str]:
+        return list(self._inputs)
+
+    def get_input_handle(self, name: str) -> _Handle:
+        return self._inputs[name]
+
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        """Either positional `inputs` (returns outputs directly, the modern
+        predictor.run(list) form) or via handles (copy_from_cpu then run())."""
+        if inputs is not None:
+            for h, a in zip(self._inputs.values(), inputs):
+                h.copy_from_cpu(np.asarray(a))
+        args = [h._array for h in self._inputs.values()]
+        if any(a is None for a in args):
+            missing = [n for n, h in self._inputs.items() if h._array is None]
+            raise ValueError(f"inputs not set: {missing}")
+        out = self._layer.forward(*args)
+        if not isinstance(out, (list, tuple)):
+            out = [out]
+        self._output_arrays = [o._data for o in out]
+        return [np.asarray(a) for a in self._output_arrays]
+
+    def get_output_names(self) -> List[str]:
+        return [f"output_{i}" for i in range(len(self._output_arrays))]
+
+    def get_output_handle(self, name: str) -> _Handle:
+        i = int(name.rsplit("_", 1)[1])
+        h = _Handle()
+        h._array = self._output_arrays[i]
+        return h
+
+
+def create_predictor(config: Config) -> Predictor:
+    """Parity: paddle.inference.create_predictor (CreatePaddlePredictor,
+    analysis_predictor.cc:2236)."""
+    return Predictor(config)
+
+
+__all__ = ["Config", "Predictor", "create_predictor"]

@@ -198,15 +198,179 @@ def enable_to_static(flag: bool):
     pass
 
 
+class InputSpec:
+    """Parity: paddle.static.InputSpec. None/-1 dims become symbolic (the
+    exported artifact accepts any size there, e.g. dynamic batch)."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = list(shape)
+        self.dtype = dtype
+        self.name = name
+
+    @staticmethod
+    def from_tensor(t, name=None):
+        return InputSpec(list(t.shape), str(t._data.dtype), name)
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, " \
+               f"name={self.name})"
+
+
 def save(layer, path, input_spec=None, **config):
-    """Parity: paddle.jit.save — serialize weights + (future) StableHLO export."""
+    """Parity: paddle.jit.save / the inference-export path
+    (AnalysisPredictor's offline artifact, analysis_predictor.cc:1574
+    capability). TPU-native artifact = serialized StableHLO of the traced
+    forward (jax.export, multi-platform cpu+tpu) + weights + meta:
+
+      path.pdmodel   — jax.export serialization (StableHLO + calling conv)
+      path.pdiparams — state dict (framework.io format)
+      path.meta.json — input specs, parameter order, output tree spec
+
+    input_spec: list of InputSpec (None/-1 dims symbolic) or example Tensors.
+    """
+    import json
+
+    from jax import export as jexport
+
     from ..framework.io import save as fsave
-    if isinstance(layer, Layer):
-        fsave(layer.state_dict(), path + ".pdparams")
-    else:
+    if not isinstance(layer, Layer):
         raise TypeError("jit.save expects a Layer")
+    if input_spec is None:
+        raise ValueError("jit.save requires input_spec (InputSpec list or "
+                         "example Tensors) to trace the export")
+    specs = [s if isinstance(s, InputSpec) else InputSpec.from_tensor(s)
+             for s in input_spec]
+
+    state = layer.named_state()
+    names = list(state)
+    was_training = layer.training
+    layer.eval()
+
+    def pure(state_arrays, *in_arrays):
+        st = dict(zip(names, state_arrays))
+        with layer.swap_state(st), no_grad():
+            out = self_fn(*[Tensor(a) for a in in_arrays])
+        outs: List[Tensor] = []
+        spec = _flatten_tensors(out, outs)
+        pure._out_spec = spec
+        return tuple(t._data for t in outs)
+
+    self_fn = layer.forward
+    if isinstance(self_fn, StaticFunction):  # to_static-wrapped layer
+        self_fn = self_fn.dygraph_function  # already bound
+
+    # symbolic dims: None/-1 get a positional symbol; a STRING dim (e.g.
+    # "batch") names a shared symbol, letting several inputs declare the
+    # same dynamic size (required when the model combines them)
+    sym_cache: Dict[str, Any] = {}
+
+    def avals():
+        out = []
+        for i, s in enumerate(specs):
+            dims = []
+            for j, d in enumerate(s.shape):
+                if d is None or d == -1 or isinstance(d, str):
+                    nm = d if isinstance(d, str) else f"d{i}_{j}"
+                    if nm not in sym_cache:
+                        sym_cache[nm] = jexport.symbolic_shape(nm)[0]
+                    dims.append(sym_cache[nm])
+                else:
+                    dims.append(d)
+            out.append(jax.ShapeDtypeStruct(tuple(dims), jnp.dtype(s.dtype)))
+        return out
+
+    state_avals = [jax.ShapeDtypeStruct(state[n]._data.shape,
+                                        state[n]._data.dtype) for n in names]
+    try:
+        try:
+            platforms = config.get("platforms", ("cpu", "tpu"))
+            exp = jexport.export(jax.jit(pure), platforms=platforms)(
+                state_avals, *avals())
+        except Exception as e:
+            # some ops lower per-platform (e.g. Pallas kernels): retry
+            # native-only — but say so; a silently narrower artifact fails
+            # far from its cause at serving time
+            import warnings
+            warnings.warn(
+                f"jit.save: multi-platform export for {platforms} failed "
+                f"({type(e).__name__}: {e}); falling back to the current "
+                "platform only", stacklevel=2)
+            exp = jexport.export(jax.jit(pure))(state_avals, *avals())
+    finally:
+        if was_training:
+            layer.train()
+
+    with open(path + ".pdmodel", "wb") as f:
+        f.write(exp.serialize())
+    fsave({n: t for n, t in state.items()}, path + ".pdiparams")
+    meta = {
+        "param_names": names,
+        "inputs": [{"shape": s.shape, "dtype": s.dtype, "name": s.name or
+                    f"input_{i}"} for i, s in enumerate(specs)],
+        "out_spec": pure._out_spec,
+    }
+    with open(path + ".meta.json", "w") as f:
+        json.dump(meta, f)
+
+
+class TranslatedLayer(Layer):
+    """Parity: paddle.jit.TranslatedLayer — a loaded inference artifact.
+    Holds the deserialized StableHLO executable + weights; forward() runs it.
+    """
+
+    def __init__(self, exported, state_arrays, param_names, out_spec, meta):
+        super().__init__()
+        self._exported = exported
+        self._state_arrays = state_arrays
+        self._param_names = param_names
+        self._out_spec = out_spec
+        self._meta = meta
+
+    def forward(self, *inputs):
+        arrays = [i._data if isinstance(i, Tensor) else jnp.asarray(i)
+                  for i in inputs]
+        outs = self._exported.call(
+            [self._state_arrays[n] for n in self._param_names], *arrays)
+        return _rebuild(self._out_spec,
+                        [Tensor(o) for o in outs])
+
+    def state_dict(self, *a, **k):
+        return {n: Tensor(v) for n, v in self._state_arrays.items()}
+
+    def input_names(self):
+        return [i["name"] for i in self._meta["inputs"]]
+
+    def input_specs(self):
+        return self._meta["inputs"]
+
+
+def _json_to_spec(obj):
+    """meta.json round-trips the out_spec tree (lists for tuples)."""
+    if isinstance(obj, list):
+        if obj and obj[0] == "t":
+            return ("t", obj[1])
+        if obj and obj[0] == "seq":
+            return ("seq", obj[1], [_json_to_spec(o) for o in obj[2]])
+        if obj and obj[0] == "dict":
+            return ("dict", obj[1], [_json_to_spec(o) for o in obj[2]])
+        if obj and obj[0] == "const":
+            return ("const", obj[1])
+    return obj
 
 
 def load(path, **config):
+    """Parity: paddle.jit.load — returns a TranslatedLayer."""
+    import json
+
+    from jax import export as jexport
+
     from ..framework.io import load as fload
-    return fload(path + ".pdparams")
+    with open(path + ".pdmodel", "rb") as f:
+        exported = jexport.deserialize(f.read())
+    with open(path + ".meta.json") as f:
+        meta = json.load(f)
+    raw = fload(path + ".pdiparams")
+    state_arrays = {n: (v._data if isinstance(v, Tensor) else jnp.asarray(v))
+                    for n, v in raw.items()}
+    return TranslatedLayer(exported, state_arrays, meta["param_names"],
+                           _json_to_spec(meta["out_spec"]), meta)
