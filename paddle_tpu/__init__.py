@@ -80,6 +80,13 @@ from .ops.search import (  # noqa: F401
 from .ops.stat import (  # noqa: F401
     mean, median, nanmean, nanmedian, nanquantile, nansum, quantile, std, var,
 )
+from .ops.special import (  # noqa: F401
+    as_strided, clip_by_norm, copysign, diagonal, fill_diagonal_,
+    fill_diagonal_tensor, frexp, gammainc, gammaincc, gammaln, gather_tree,
+    l1_norm, ldexp, lerp, multiplex, polygamma, reduce_as, renorm, reverse,
+    sequence_mask, shard_index, squared_l2_norm, swiglu, top_p_sampling,
+    trace, vander, view,
+)
 from .ops.random_ops import (  # noqa: F401
     bernoulli, bernoulli_, binomial, multinomial, normal, poisson, rand,
     rand_like, randint, randint_like, randn, randn_like, randperm,
@@ -101,6 +108,8 @@ from . import vision  # noqa: F401
 from . import device  # noqa: F401
 from . import metric  # noqa: F401
 from . import inference  # noqa: F401
+from . import fft  # noqa: F401
+from . import signal  # noqa: F401
 from . import hapi  # noqa: F401
 from .hapi import Model, summary  # noqa: F401
 from .framework.io import save, load  # noqa: F401

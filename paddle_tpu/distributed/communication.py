@@ -298,11 +298,14 @@ def batch_isend_irecv(p2p_op_list: List[P2POp]):
         hc = _host(first.group, first.tensor._data)
         if hc is not None:
             # real cross-process p2p: each op stands alone (a rank may post
-            # only sends or only recvs)
+            # only sends or only recvs). All sends fire first — store.set is
+            # non-blocking while recv blocks, so list order must not matter
+            # (ranks may legally post their recvs before their sends).
             for op in p2p_op_list:
                 if op.op in (isend, send):
                     hc.send(np.asarray(op.tensor._data), op.peer)
-                else:
+            for op in p2p_op_list:
+                if op.op in (irecv, recv):
                     op.tensor._data = jnp.asarray(hc.recv(op.peer))
             return [_Task() for _ in p2p_op_list]
     # traced: matched send/recv pairs lower to one ppermute over the axis;

@@ -80,10 +80,12 @@ class Controller:
         self.world = args.world_size or self.nranks_local
         master = args.master or f"127.0.0.1:{_free_port()}"
         self.master_addr, self.master_port = master.rsplit(":", 1)
-        # separate, verified-free port for the TCPStore (MASTER_PORT belongs
-        # to the jax.distributed coordinator; the +1 default could collide
-        # with an unrelated service or the rank-0 endpoint)
-        self.store_port = _free_port()
+        # Store port must be the SAME on every machine of the job. With an
+        # explicit --master (multi-machine) derive it deterministically
+        # (master_port+1, store.py's default); single-machine default-master
+        # launches can instead grab a verified-free local port.
+        self.store_port = (int(self.master_port) + 1) if args.master \
+            else _free_port()
         self.procs: List[subprocess.Popen] = []
         self._logs: List = []
         self.generation = 0

@@ -1,0 +1,136 @@
+"""paddle_tpu.fft — discrete Fourier transforms.
+
+Reference parity: python/paddle/fft.py (fft/ifft/rfft/..., backed by the
+fft_c2c/fft_c2r/fft_r2c kernels, paddle/phi/ops/yaml/ops.yaml). TPU-native:
+lowers to XLA's FFT HLO via jnp.fft, recorded on the autograd tape through
+the dispatch layer (FFT is linear, so the vjp is jax's).
+
+Norm conventions match numpy/paddle: "backward" (default), "ortho",
+"forward".
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .ops.dispatch import dispatch, ensure_tensor
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+    "fft2", "ifft2", "rfft2", "irfft2", "hfft2", "ihfft2",
+    "fftn", "ifftn", "rfftn", "irfftn", "hfftn", "ihfftn",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+
+def _wrap1(name, jfn, x, n, axis, norm):
+    xt = ensure_tensor(x)
+    return dispatch(name, lambda a: jfn(a, n=n, axis=axis, norm=norm), xt)
+
+
+def _wrapn(name, jfn, x, s, axes, norm):
+    xt = ensure_tensor(x)
+    return dispatch(name, lambda a: jfn(a, s=s, axes=axes, norm=norm), xt)
+
+
+def fft(x, n=None, axis=-1, norm="backward", name=None):
+    return _wrap1("fft", jnp.fft.fft, x, n, axis, norm)
+
+
+def ifft(x, n=None, axis=-1, norm="backward", name=None):
+    return _wrap1("ifft", jnp.fft.ifft, x, n, axis, norm)
+
+
+def rfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _wrap1("rfft", jnp.fft.rfft, x, n, axis, norm)
+
+
+def irfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _wrap1("irfft", jnp.fft.irfft, x, n, axis, norm)
+
+
+def hfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _wrap1("hfft", jnp.fft.hfft, x, n, axis, norm)
+
+
+def ihfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _wrap1("ihfft", jnp.fft.ihfft, x, n, axis, norm)
+
+
+def fft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _wrapn("fft2", jnp.fft.fft2, x, s, axes, norm)
+
+
+def ifft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _wrapn("ifft2", jnp.fft.ifft2, x, s, axes, norm)
+
+
+def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _wrapn("rfft2", jnp.fft.rfft2, x, s, axes, norm)
+
+
+def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _wrapn("irfft2", jnp.fft.irfft2, x, s, axes, norm)
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    xt = ensure_tensor(x)
+    return dispatch(
+        "hfft2",
+        lambda a: jnp.fft.hfft(jnp.fft.ifft(a, axis=axes[0], norm=norm),
+                               n=None if s is None else s[-1], axis=axes[1],
+                               norm=norm), xt)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    xt = ensure_tensor(x)
+    return dispatch(
+        "ihfft2",
+        lambda a: jnp.fft.ihfft(jnp.fft.fft(a, axis=axes[0], norm=norm),
+                                n=None if s is None else s[-1], axis=axes[1],
+                                norm=norm), xt)
+
+
+def fftn(x, s=None, axes=None, norm="backward", name=None):
+    return _wrapn("fftn", jnp.fft.fftn, x, s, axes, norm)
+
+
+def ifftn(x, s=None, axes=None, norm="backward", name=None):
+    return _wrapn("ifftn", jnp.fft.ifftn, x, s, axes, norm)
+
+
+def rfftn(x, s=None, axes=None, norm="backward", name=None):
+    return _wrapn("rfftn", jnp.fft.rfftn, x, s, axes, norm)
+
+
+def irfftn(x, s=None, axes=None, norm="backward", name=None):
+    return _wrapn("irfftn", jnp.fft.irfftn, x, s, axes, norm)
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    raise NotImplementedError("hfftn: use hfft/hfft2 (rare in practice)")
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    raise NotImplementedError("ihfftn: use ihfft/ihfft2 (rare in practice)")
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    from .tensor import Tensor
+    out = jnp.fft.fftfreq(n, d)
+    return Tensor(out.astype(dtype) if dtype else out)
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    from .tensor import Tensor
+    out = jnp.fft.rfftfreq(n, d)
+    return Tensor(out.astype(dtype) if dtype else out)
+
+
+def fftshift(x, axes=None, name=None):
+    return dispatch("fftshift", lambda a: jnp.fft.fftshift(a, axes=axes),
+                    ensure_tensor(x))
+
+
+def ifftshift(x, axes=None, name=None):
+    return dispatch("ifftshift", lambda a: jnp.fft.ifftshift(a, axes=axes),
+                    ensure_tensor(x))

@@ -1,2 +1,5 @@
 """paddle_tpu.incubate — experimental APIs (parity: python/paddle/incubate/)."""
 from . import distributed, nn  # noqa: F401
+from .segment_ops import (  # noqa: F401
+    segment_max, segment_mean, segment_min, segment_sum, send_u_recv,
+)

@@ -1,0 +1,80 @@
+"""Segment reductions (parity: paddle.incubate.segment_sum/mean/max/min;
+kernels segment_pool in ops.yaml, also the paddle.geometric send_u_recv
+family). TPU-native: jax.ops.segment_* — one fused scatter-reduce on the
+VPU, sorted-segment fast path available to XLA."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.dispatch import dispatch, ensure_tensor
+
+
+def _seg(name, jfn, data, segment_ids):
+    dt, st = ensure_tensor(data), ensure_tensor(segment_ids)
+    import numpy as np
+    num = int(np.asarray(st._data).max()) + 1 if st._data.size else 0
+
+    def fwd(d, s):
+        return jfn(d, s.astype(jnp.int32), num_segments=num)
+
+    return dispatch(name, fwd, dt, st)
+
+
+def segment_sum(data, segment_ids, name=None):
+    return _seg("segment_sum", jax.ops.segment_sum, data, segment_ids)
+
+
+def segment_mean(data, segment_ids, name=None):
+    dt, st = ensure_tensor(data), ensure_tensor(segment_ids)
+    import numpy as np
+    num = int(np.asarray(st._data).max()) + 1 if st._data.size else 0
+
+    def fwd(d, s):
+        s32 = s.astype(jnp.int32)
+        tot = jax.ops.segment_sum(d, s32, num_segments=num)
+        cnt = jax.ops.segment_sum(jnp.ones_like(s32, d.dtype), s32,
+                                  num_segments=num)
+        shape = (num,) + (1,) * (d.ndim - 1)
+        return tot / jnp.maximum(cnt.reshape(shape), 1)
+
+    return dispatch("segment_mean", fwd, dt, st)
+
+
+def segment_max(data, segment_ids, name=None):
+    return _seg("segment_max", jax.ops.segment_max, data, segment_ids)
+
+
+def segment_min(data, segment_ids, name=None):
+    return _seg("segment_min", jax.ops.segment_min, data, segment_ids)
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Parity: paddle.geometric.send_u_recv — gather rows at src_index,
+    scatter-reduce them at dst_index."""
+    xt = ensure_tensor(x)
+    st, dt_ = ensure_tensor(src_index), ensure_tensor(dst_index)
+    import numpy as np
+    num = out_size or (int(np.asarray(dt_._data).max()) + 1
+                       if dt_._data.size else 0)
+    fns = {"sum": jax.ops.segment_sum, "max": jax.ops.segment_max,
+           "min": jax.ops.segment_min}
+
+    def fwd(a, si, di):
+        msg = a[si.astype(jnp.int32)]
+        if reduce_op == "mean":
+            tot = jax.ops.segment_sum(msg, di.astype(jnp.int32),
+                                      num_segments=num)
+            cnt = jax.ops.segment_sum(jnp.ones(di.shape[0], a.dtype),
+                                      di.astype(jnp.int32),
+                                      num_segments=num)
+            return tot / jnp.maximum(cnt.reshape((num,) + (1,) *
+                                                 (a.ndim - 1)), 1)
+        return fns[reduce_op](msg, di.astype(jnp.int32), num_segments=num)
+
+    return dispatch("send_u_recv", fwd, xt, st, dt_)
+
+
+__all__ = ["segment_sum", "segment_mean", "segment_max", "segment_min",
+           "send_u_recv"]

@@ -103,6 +103,10 @@ class Predictor:
         """Either positional `inputs` (returns outputs directly, the modern
         predictor.run(list) form) or via handles (copy_from_cpu then run())."""
         if inputs is not None:
+            if len(inputs) != len(self._inputs):
+                raise ValueError(
+                    f"predictor expects {len(self._inputs)} inputs "
+                    f"({list(self._inputs)}), got {len(inputs)}")
             for h, a in zip(self._inputs.values(), inputs):
                 h.copy_from_cpu(np.asarray(a))
         args = [h._array for h in self._inputs.values()]

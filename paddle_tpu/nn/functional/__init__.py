@@ -9,6 +9,9 @@ from .attention import (  # noqa: F401
     flash_attention, flash_attn_unpadded, scaled_dot_product_attention,
     sdp_kernel,
 )
+from .vision import (  # noqa: F401
+    affine_grid, grid_sample, temporal_shift,
+)
 from .common import (  # noqa: F401
     alpha_dropout, channel_shuffle, cosine_similarity, dropout, dropout2d,
     dropout3d, embedding, fold, interpolate, label_smooth, linear, one_hot, pad,

@@ -1,0 +1,109 @@
+"""Spatial-transformer functionals: affine_grid, grid_sample, temporal_shift.
+
+Reference parity: paddle.nn.functional.{affine_grid, grid_sample,
+temporal_shift} (ops.yaml affine_grid/grid_sample/temporal_shift). All are
+gather + elementwise, fused by XLA.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...ops.dispatch import dispatch, ensure_tensor
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """theta: [N, 2, 3]; out_shape: [N, C, H, W] -> grid [N, H, W, 2]."""
+    tt = ensure_tensor(theta)
+    n, _, h, w = [int(s) for s in out_shape]
+
+    def base(size):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, size)
+        step = 2.0 / size
+        return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, size)
+
+    def fwd(th):
+        xs = base(w)
+        ys = base(h)
+        gx, gy = jnp.meshgrid(xs, ys)                  # [H, W]
+        ones = jnp.ones_like(gx)
+        coords = jnp.stack([gx, gy, ones], -1)         # [H, W, 3]
+        return jnp.einsum("hwk,njk->nhwj", coords, th)
+
+    return dispatch("affine_grid", fwd, tt)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """x: [N, C, H, W]; grid: [N, Hg, Wg, 2] (x, y) in [-1, 1]."""
+    xt, gt = ensure_tensor(x), ensure_tensor(grid)
+
+    def unnorm(c, size):
+        if align_corners:
+            return (c + 1.0) * (size - 1) / 2.0
+        return ((c + 1.0) * size - 1.0) / 2.0
+
+    def fwd(img, g):
+        n, c, h, w = img.shape
+        gx = unnorm(g[..., 0], w)                       # [N, Hg, Wg]
+        gy = unnorm(g[..., 1], h)
+        if padding_mode == "border":
+            gx = jnp.clip(gx, 0, w - 1)
+            gy = jnp.clip(gy, 0, h - 1)
+        elif padding_mode == "reflection":
+            span_x = (w - 1) if align_corners else w
+            span_y = (h - 1) if align_corners else h
+            gx = jnp.abs(jnp.mod(gx + span_x * 2, span_x * 2) - span_x) \
+                if span_x > 0 else gx
+            gy = jnp.abs(jnp.mod(gy + span_y * 2, span_y * 2) - span_y) \
+                if span_y > 0 else gy
+            gx = jnp.clip(gx, 0, w - 1)
+            gy = jnp.clip(gy, 0, h - 1)
+
+        def sample(ix, iy):
+            ixc = jnp.clip(ix, 0, w - 1).astype(int)
+            iyc = jnp.clip(iy, 0, h - 1).astype(int)
+            batch = jnp.arange(n)[:, None, None]
+            vals = img[batch, :, iyc, ixc]              # [N, Hg, Wg, C]
+            if padding_mode == "zeros":
+                ok = (ix >= 0) & (ix <= w - 1) & (iy >= 0) & (iy <= h - 1)
+                vals = vals * ok[..., None]
+            return vals
+
+        if mode == "nearest":
+            out = sample(jnp.round(gx), jnp.round(gy))
+        else:
+            x0 = jnp.floor(gx)
+            y0 = jnp.floor(gy)
+            wx = gx - x0
+            wy = gy - y0
+            out = (sample(x0, y0) * ((1 - wx) * (1 - wy))[..., None] +
+                   sample(x0 + 1, y0) * (wx * (1 - wy))[..., None] +
+                   sample(x0, y0 + 1) * ((1 - wx) * wy)[..., None] +
+                   sample(x0 + 1, y0 + 1) * (wx * wy)[..., None])
+        return jnp.moveaxis(out, -1, 1)                 # [N, C, Hg, Wg]
+
+    return dispatch("grid_sample", fwd, xt, gt)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    """Parity: paddle.nn.functional.temporal_shift (TSM)."""
+    xt = ensure_tensor(x)
+
+    def fwd(a):
+        v = a if data_format == "NCHW" else jnp.moveaxis(a, -1, 1)
+        nt, c, h, w = v.shape
+        n = nt // seg_num
+        v = v.reshape(n, seg_num, c, h, w)
+        fold = int(c * shift_ratio)
+        left = jnp.concatenate(
+            [v[:, 1:, :fold], jnp.zeros_like(v[:, :1, :fold])], 1)
+        right = jnp.concatenate(
+            [jnp.zeros_like(v[:, :1, fold:2 * fold]),
+             v[:, :-1, fold:2 * fold]], 1)
+        mid = v[:, :, 2 * fold:]
+        out = jnp.concatenate([left, right, mid], 2).reshape(nt, c, h, w)
+        return out if data_format == "NCHW" else jnp.moveaxis(out, 1, -1)
+
+    return dispatch("temporal_shift", fwd, xt)

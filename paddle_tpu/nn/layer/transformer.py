@@ -1,0 +1,299 @@
+"""Transformer layer classes.
+
+Reference parity: python/paddle/nn/layer/transformer.py (MultiHeadAttention,
+TransformerEncoderLayer/Encoder, TransformerDecoderLayer/Decoder,
+Transformer). TPU-native: attention routes through
+F.scaled_dot_product_attention, which lowers to the Pallas flash kernel on
+TPU for the mask-free causal/full cases and to the fused XLA softmax path
+otherwise; projections are plain MXU matmuls that GSPMD can shard when the
+layers are built inside a parallel context.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ...ops.dispatch import ensure_tensor
+from ...tensor import Tensor
+from .. import functional as F
+from .common import Dropout, Linear
+from .layers import Layer, LayerList
+from .norm import LayerNorm
+
+
+def _convert_attn_mask(mask):
+    """Paddle convention: bool mask True=keep; float mask added to scores."""
+    if mask is None:
+        return None
+    return ensure_tensor(mask)
+
+
+class MultiHeadAttention(Layer):
+    """Parity: paddle.nn.MultiHeadAttention (nn/layer/transformer.py).
+
+    Layout [batch, seq, embed_dim]; separate q/k/v/out projections named like
+    the reference (q_proj/k_proj/v_proj/out_proj) for state-dict porting.
+    """
+
+    class Cache:
+        def __init__(self, k, v):
+            self.k = k
+            self.v = v
+
+    class StaticCache:
+        def __init__(self, k, v):
+            self.k = k
+            self.v = v
+
+    def __init__(self, embed_dim, num_heads, dropout=0.0, kdim=None,
+                 vdim=None, need_weights=False, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__()
+        if embed_dim % num_heads != 0:
+            raise ValueError(f"embed_dim {embed_dim} not divisible by "
+                             f"num_heads {num_heads}")
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.dropout = dropout
+        self.need_weights = need_weights
+        self.kdim = kdim or embed_dim
+        self.vdim = vdim or embed_dim
+        self.q_proj = Linear(embed_dim, embed_dim, weight_attr=weight_attr,
+                             bias_attr=bias_attr)
+        self.k_proj = Linear(self.kdim, embed_dim, weight_attr=weight_attr,
+                             bias_attr=bias_attr)
+        self.v_proj = Linear(self.vdim, embed_dim, weight_attr=weight_attr,
+                             bias_attr=bias_attr)
+        self.out_proj = Linear(embed_dim, embed_dim, weight_attr=weight_attr,
+                               bias_attr=bias_attr)
+
+    def gen_cache(self, key, value=None, type=None):
+        if type is MultiHeadAttention.StaticCache:
+            k, v = self._kv(key, value if value is not None else key)
+            return MultiHeadAttention.StaticCache(k, v)
+        b = key.shape[0]
+        shape = (b, 0, self.num_heads, self.head_dim)
+        z = Tensor(jnp.zeros(shape, jnp.float32))
+        return MultiHeadAttention.Cache(z, z)
+
+    def _split_heads(self, t):
+        b, s, _ = t.shape
+        return t.reshape([b, s, self.num_heads, self.head_dim])
+
+    def _kv(self, key, value):
+        k = self._split_heads(self.k_proj(key))
+        v = self._split_heads(self.v_proj(value))
+        return k, v
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                cache=None):
+        query = ensure_tensor(query)
+        key = query if key is None else ensure_tensor(key)
+        value = key if value is None else ensure_tensor(value)
+
+        q = self._split_heads(self.q_proj(query))
+        if isinstance(cache, MultiHeadAttention.StaticCache):
+            k, v = cache.k, cache.v
+        else:
+            k, v = self._kv(key, value)
+        new_cache = None
+        if isinstance(cache, MultiHeadAttention.Cache):
+            k = Tensor(jnp.concatenate([cache.k._data, k._data], axis=1))
+            v = Tensor(jnp.concatenate([cache.v._data, v._data], axis=1))
+            new_cache = MultiHeadAttention.Cache(k, v)
+
+        mask = _convert_attn_mask(attn_mask)
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=mask, dropout_p=self.dropout,
+            is_causal=False, training=self.training)
+        b, s = out.shape[0], out.shape[1]
+        out = self.out_proj(out.reshape([b, s, self.embed_dim]))
+        if cache is not None:
+            return out, new_cache
+        return out
+
+
+class TransformerEncoderLayer(Layer):
+    """Parity: paddle.nn.TransformerEncoderLayer."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout=0.1,
+                 activation="relu", attn_dropout=None, act_dropout=None,
+                 normalize_before=False, weight_attr=None, bias_attr=None,
+                 layer_norm_eps=1e-5, name=None):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.self_attn = MultiHeadAttention(
+            d_model, nhead,
+            dropout=attn_dropout if attn_dropout is not None else dropout,
+            weight_attr=weight_attr, bias_attr=bias_attr)
+        self.linear1 = Linear(d_model, dim_feedforward,
+                              weight_attr=weight_attr, bias_attr=bias_attr)
+        self.linear2 = Linear(dim_feedforward, d_model,
+                              weight_attr=weight_attr, bias_attr=bias_attr)
+        self.norm1 = LayerNorm(d_model, epsilon=layer_norm_eps)
+        self.norm2 = LayerNorm(d_model, epsilon=layer_norm_eps)
+        self.dropout1 = Dropout(dropout)
+        self.dropout2 = Dropout(dropout)
+        self.dropout_act = Dropout(
+            act_dropout if act_dropout is not None else dropout)
+        self.activation = getattr(F, activation)
+
+    def forward(self, src, src_mask=None, cache=None):
+        residual = src
+        x = self.norm1(src) if self.normalize_before else src
+        if cache is None:
+            x = self.self_attn(x, attn_mask=src_mask)
+        else:
+            x, cache = self.self_attn(x, attn_mask=src_mask, cache=cache)
+        x = residual + self.dropout1(x)
+        if not self.normalize_before:
+            x = self.norm1(x)
+        residual = x
+        y = self.norm2(x) if self.normalize_before else x
+        y = self.linear2(self.dropout_act(self.activation(self.linear1(y))))
+        y = residual + self.dropout2(y)
+        if not self.normalize_before:
+            y = self.norm2(y)
+        return y if cache is None else (y, cache)
+
+
+class TransformerEncoder(Layer):
+    """Parity: paddle.nn.TransformerEncoder."""
+
+    def __init__(self, encoder_layer, num_layers, norm=None):
+        super().__init__()
+        import copy
+        self.layers = LayerList(
+            [encoder_layer] +
+            [copy.deepcopy(encoder_layer) for _ in range(num_layers - 1)])
+        self.num_layers = num_layers
+        self.norm = norm
+
+    def forward(self, src, src_mask=None):
+        out = src
+        for layer in self.layers:
+            out = layer(out, src_mask=src_mask)
+        if self.norm is not None:
+            out = self.norm(out)
+        return out
+
+
+class TransformerDecoderLayer(Layer):
+    """Parity: paddle.nn.TransformerDecoderLayer (self + cross attention)."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout=0.1,
+                 activation="relu", attn_dropout=None, act_dropout=None,
+                 normalize_before=False, weight_attr=None, bias_attr=None,
+                 layer_norm_eps=1e-5, name=None):
+        super().__init__()
+        self.normalize_before = normalize_before
+        ad = attn_dropout if attn_dropout is not None else dropout
+        self.self_attn = MultiHeadAttention(d_model, nhead, dropout=ad,
+                                            weight_attr=weight_attr,
+                                            bias_attr=bias_attr)
+        self.cross_attn = MultiHeadAttention(d_model, nhead, dropout=ad,
+                                             weight_attr=weight_attr,
+                                             bias_attr=bias_attr)
+        self.linear1 = Linear(d_model, dim_feedforward,
+                              weight_attr=weight_attr, bias_attr=bias_attr)
+        self.linear2 = Linear(dim_feedforward, d_model,
+                              weight_attr=weight_attr, bias_attr=bias_attr)
+        self.norm1 = LayerNorm(d_model, epsilon=layer_norm_eps)
+        self.norm2 = LayerNorm(d_model, epsilon=layer_norm_eps)
+        self.norm3 = LayerNorm(d_model, epsilon=layer_norm_eps)
+        self.dropout1 = Dropout(dropout)
+        self.dropout2 = Dropout(dropout)
+        self.dropout3 = Dropout(dropout)
+        self.dropout_act = Dropout(
+            act_dropout if act_dropout is not None else dropout)
+        self.activation = getattr(F, activation)
+
+    def forward(self, tgt, memory, tgt_mask=None, memory_mask=None,
+                cache=None):
+        residual = tgt
+        x = self.norm1(tgt) if self.normalize_before else tgt
+        x = self.self_attn(x, attn_mask=tgt_mask)
+        x = residual + self.dropout1(x)
+        if not self.normalize_before:
+            x = self.norm1(x)
+        residual = x
+        y = self.norm2(x) if self.normalize_before else x
+        y = self.cross_attn(y, memory, memory, attn_mask=memory_mask)
+        y = residual + self.dropout2(y)
+        if not self.normalize_before:
+            y = self.norm2(y)
+        residual = y
+        z = self.norm3(y) if self.normalize_before else y
+        z = self.linear2(self.dropout_act(self.activation(self.linear1(z))))
+        z = residual + self.dropout3(z)
+        if not self.normalize_before:
+            z = self.norm3(z)
+        return z
+
+
+class TransformerDecoder(Layer):
+    """Parity: paddle.nn.TransformerDecoder."""
+
+    def __init__(self, decoder_layer, num_layers, norm=None):
+        super().__init__()
+        import copy
+        self.layers = LayerList(
+            [decoder_layer] +
+            [copy.deepcopy(decoder_layer) for _ in range(num_layers - 1)])
+        self.num_layers = num_layers
+        self.norm = norm
+
+    def forward(self, tgt, memory, tgt_mask=None, memory_mask=None):
+        out = tgt
+        for layer in self.layers:
+            out = layer(out, memory, tgt_mask=tgt_mask,
+                        memory_mask=memory_mask)
+        if self.norm is not None:
+            out = self.norm(out)
+        return out
+
+
+class Transformer(Layer):
+    """Parity: paddle.nn.Transformer (full encoder-decoder)."""
+
+    def __init__(self, d_model=512, nhead=8, num_encoder_layers=6,
+                 num_decoder_layers=6, dim_feedforward=2048, dropout=0.1,
+                 activation="relu", attn_dropout=None, act_dropout=None,
+                 normalize_before=False, weight_attr=None, bias_attr=None,
+                 custom_encoder=None, custom_decoder=None, name=None):
+        super().__init__()
+        self.d_model = d_model
+        self.nhead = nhead
+        if custom_encoder is not None:
+            self.encoder = custom_encoder
+        else:
+            enc_layer = TransformerEncoderLayer(
+                d_model, nhead, dim_feedforward, dropout, activation,
+                attn_dropout, act_dropout, normalize_before, weight_attr,
+                bias_attr)
+            self.encoder = TransformerEncoder(
+                enc_layer, num_encoder_layers,
+                LayerNorm(d_model) if normalize_before else None)
+        if custom_decoder is not None:
+            self.decoder = custom_decoder
+        else:
+            dec_layer = TransformerDecoderLayer(
+                d_model, nhead, dim_feedforward, dropout, activation,
+                attn_dropout, act_dropout, normalize_before, weight_attr,
+                bias_attr)
+            self.decoder = TransformerDecoder(
+                dec_layer, num_decoder_layers,
+                LayerNorm(d_model) if normalize_before else None)
+
+    def forward(self, src, tgt, src_mask=None, tgt_mask=None,
+                memory_mask=None):
+        memory = self.encoder(src, src_mask=src_mask)
+        return self.decoder(tgt, memory, tgt_mask=tgt_mask,
+                            memory_mask=memory_mask)
+
+    def generate_square_subsequent_mask(self, length):
+        """Float mask: 0 on/below diagonal, -inf above (paddle semantics)."""
+        m = jnp.triu(jnp.full((length, length), -1e9, jnp.float32), k=1)
+        return Tensor(m)

@@ -4,7 +4,7 @@ Reference parity: python/paddle/tensor/* + phi kernels. Importing this package
 populates the op table that drives both the functional API (paddle_tpu.add) and
 Tensor methods/dunders.
 """
-from . import creation, logic, linalg, manipulation, math, random_ops, search, stat  # noqa: F401
+from . import creation, logic, linalg, manipulation, math, random_ops, search, special, stat  # noqa: F401
 from .dispatch import attach_methods, dispatch, ensure_tensor, register_op  # noqa: F401
 
 attach_methods()

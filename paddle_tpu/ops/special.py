@@ -1,0 +1,321 @@
+"""Special functions and tail math ops.
+
+Reference parity: assorted ops from paddle/phi/ops/yaml/ops.yaml that round
+out the tensor API (lerp, trace, diagonal, renorm, multiplex, polygamma,
+gammaln, gammainc/gammaincc, sequence_mask, shard_index, fill_diagonal,
+clip_by_norm, squared_l2_norm, swiglu, top_p_sampling, ...). All lower to
+jnp/lax/jax.scipy and are recorded on the tape via dispatch (NumPy-oracle
+tests in tests/test_special_ops.py).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..tensor import Tensor
+from .dispatch import dispatch, ensure_tensor
+
+
+def lerp(x, y, weight, name=None):
+    """Parity: paddle.lerp — x + weight * (y - x)."""
+    xt, yt = ensure_tensor(x), ensure_tensor(y)
+    if isinstance(weight, (int, float)):
+        return dispatch("lerp", lambda a, b: a + weight * (b - a), xt, yt)
+    wt = ensure_tensor(weight)
+    return dispatch("lerp", lambda a, b, w: a + w * (b - a), xt, yt, wt)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    """Parity: paddle.trace."""
+    return dispatch(
+        "trace",
+        lambda a: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2),
+        ensure_tensor(x))
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    """Parity: paddle.diagonal."""
+    return dispatch(
+        "diagonal",
+        lambda a: jnp.diagonal(a, offset=offset, axis1=axis1, axis2=axis2),
+        ensure_tensor(x))
+
+
+def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
+    """Parity: paddle.Tensor.fill_diagonal_ (2-D)."""
+    xt = ensure_tensor(x)
+
+    def fwd(a):
+        n = min(a.shape[0], a.shape[1])
+        i = jnp.arange(n - max(offset, 0))
+        rows = i + max(-offset, 0)
+        cols = i + max(offset, 0)
+        return a.at[rows, cols].set(value)
+
+    out = dispatch("fill_diagonal", fwd, xt)
+    xt._data = out._data
+    return xt
+
+
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1, name=None):
+    """Parity: paddle.fill_diagonal_tensor — write `y` along the diagonal."""
+    xt, yt = ensure_tensor(x), ensure_tensor(y)
+
+    def fwd(a, v):
+        n = min(a.shape[dim1], a.shape[dim2])
+        m = n - abs(offset)
+        i = jnp.arange(m)
+        rows = i + max(-offset, 0)
+        cols = i + max(offset, 0)
+        a2 = jnp.moveaxis(a, (dim1, dim2), (0, 1))
+        a2 = a2.at[rows, cols].set(jnp.moveaxis(v, -1, 0) if v.ndim > 1 else v)
+        return jnp.moveaxis(a2, (0, 1), (dim1, dim2))
+
+    return dispatch("fill_diagonal_tensor", fwd, xt, yt)
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Parity: paddle.renorm — clamp the p-norm of every slice along axis."""
+    xt = ensure_tensor(x)
+
+    def fwd(a):
+        moved = jnp.moveaxis(a, axis, 0)
+        flat = moved.reshape(moved.shape[0], -1)
+        norms = jnp.sum(jnp.abs(flat) ** p, axis=1) ** (1.0 / p)
+        scale = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        out = flat * scale[:, None]
+        return jnp.moveaxis(out.reshape(moved.shape), 0, axis)
+
+    return dispatch("renorm", fwd, xt)
+
+
+def multiplex(inputs, index, name=None):
+    """Parity: paddle.multiplex — row i of the output comes from
+    inputs[index[i]] row i."""
+    ts = [ensure_tensor(t) for t in inputs]
+    it = ensure_tensor(index)
+
+    def fwd(idx, *arrs):
+        stack = jnp.stack(arrs)                      # [k, batch, ...]
+        rows = jnp.arange(stack.shape[1])
+        return stack[idx.reshape(-1).astype(jnp.int32), rows]
+
+    return dispatch("multiplex", lambda idx, *arrs: fwd(idx, *arrs), it, *ts)
+
+
+def polygamma(x, n, name=None):
+    """Parity: paddle.polygamma."""
+    from jax.scipy.special import polygamma as jpoly
+    return dispatch("polygamma", lambda a: jpoly(n, a), ensure_tensor(x))
+
+
+def gammaln(x, name=None):
+    from jax.scipy.special import gammaln as jg
+    return dispatch("gammaln", jg, ensure_tensor(x))
+
+
+def gammainc(x, y, name=None):
+    """Parity: paddle.gammainc — regularized lower incomplete gamma P(x, y)."""
+    from jax.scipy.special import gammainc as jg
+    return dispatch("gammainc", jg, ensure_tensor(x), ensure_tensor(y))
+
+
+def gammaincc(x, y, name=None):
+    """Parity: paddle.gammaincc — regularized upper incomplete gamma Q(x, y)."""
+    from jax.scipy.special import gammaincc as jg
+    return dispatch("gammaincc", jg, ensure_tensor(x), ensure_tensor(y))
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """Parity: paddle.nn.functional.sequence_mask (ops.yaml sequence_mask)."""
+    xt = ensure_tensor(x)
+    from ..framework.dtype import convert_dtype
+    d = convert_dtype(dtype)
+
+    def fwd(lens):
+        m = maxlen if maxlen is not None else int(lens.max())
+        return (jnp.arange(m)[None, :] <
+                lens.reshape(-1, 1)).reshape(lens.shape + (m,)).astype(d)
+
+    return dispatch("sequence_mask", fwd, xt)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1,
+                name=None):
+    """Parity: paddle.shard_index — recode ids into a shard-local range."""
+    it = ensure_tensor(input)
+    size = index_num // nshards
+
+    def fwd(ids):
+        shard = ids // size
+        local = ids % size
+        return jnp.where(shard == shard_id, local, ignore_value)
+
+    return dispatch("shard_index", fwd, it)
+
+
+def reverse(x, axis, name=None):
+    """Parity: paddle.reverse (alias of flip)."""
+    ax = axis if isinstance(axis, (list, tuple)) else [axis]
+    return dispatch("reverse", lambda a: jnp.flip(a, ax), ensure_tensor(x))
+
+
+def squared_l2_norm(x, name=None):
+    return dispatch("squared_l2_norm",
+                    lambda a: jnp.sum(a.astype(jnp.float32) ** 2)
+                    .astype(a.dtype), ensure_tensor(x))
+
+
+def l1_norm(x, name=None):
+    return dispatch("l1_norm", lambda a: jnp.sum(jnp.abs(a)),
+                    ensure_tensor(x))
+
+
+def clip_by_norm(x, max_norm, name=None):
+    """Parity: paddle.nn.clip.clip_by_norm."""
+    def fwd(a):
+        n = jnp.sqrt(jnp.sum(a.astype(jnp.float32) ** 2))
+        scale = jnp.where(n > max_norm, max_norm / n, 1.0)
+        return (a * scale).astype(a.dtype)
+
+    return dispatch("clip_by_norm", fwd, ensure_tensor(x))
+
+
+def swiglu(x, y=None, name=None):
+    """Parity: paddle.incubate.nn.functional.swiglu — silu(x) * y (y defaults
+    to the second half of x's last dim)."""
+    xt = ensure_tensor(x)
+    if y is None:
+        def fwd(a):
+            a1, a2 = jnp.split(a, 2, axis=-1)
+            return a1 * (1.0 / (1.0 + jnp.exp(-a1.astype(jnp.float32))))\
+                .astype(a.dtype) * a2
+        return dispatch("swiglu", fwd, xt)
+    yt = ensure_tensor(y)
+    return dispatch(
+        "swiglu",
+        lambda a, b: (a * (1.0 / (1.0 + jnp.exp(-a.astype(jnp.float32))))
+                      .astype(a.dtype)) * b, xt, yt)
+
+
+def top_p_sampling(x, ps, threshold=None, seed=None, name=None):
+    """Parity: paddle.tensor.top_p_sampling — nucleus sampling over the last
+    dim. Returns (sampled values, sampled ids)."""
+    from ..framework.random import next_key
+    xt, pt = ensure_tensor(x), ensure_tensor(ps)
+    key = next_key() if seed is None else jax.random.PRNGKey(seed)
+
+    def fwd(logits, p):
+        sort_idx = jnp.argsort(-logits, axis=-1)
+        sorted_logits = jnp.take_along_axis(logits, sort_idx, axis=-1)
+        probs = jax.nn.softmax(sorted_logits.astype(jnp.float32), axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep = cum - probs < p.reshape(-1, 1)      # keep until mass >= p
+        masked = jnp.where(keep, sorted_logits, -jnp.inf)
+        choice = jax.random.categorical(key, masked.astype(jnp.float32),
+                                        axis=-1)
+        ids = jnp.take_along_axis(sort_idx, choice[..., None], axis=-1)
+        vals = jnp.take_along_axis(logits, ids, axis=-1)
+        return vals, ids.astype(jnp.int64)
+
+    return dispatch("top_p_sampling", fwd, xt, pt)
+
+
+def reduce_as(x, target, name=None):
+    """Parity: paddle.reduce_as — sum-reduce x to target's shape."""
+    xt, tt = ensure_tensor(x), ensure_tensor(target)
+
+    def fwd(a, t):
+        extra = a.ndim - t.ndim
+        if extra:
+            a = jnp.sum(a, axis=tuple(range(extra)))
+        axes = tuple(i for i in range(a.ndim)
+                     if t.shape[i] == 1 and a.shape[i] != 1)
+        return jnp.sum(a, axis=axes, keepdims=True) if axes else a
+
+    return dispatch("reduce_as", fwd, xt, tt)
+
+
+def gather_tree(ids, parents, name=None):
+    """Parity: paddle.nn.functional.gather_tree — beam-search backtrace.
+    ids/parents: [max_time, batch, beam]."""
+    it, pt = ensure_tensor(ids), ensure_tensor(parents)
+
+    def fwd(idv, par):
+        T = idv.shape[0]
+        beams = jnp.arange(idv.shape[2])
+
+        def step(carry, t):
+            parent = carry                       # [batch, beam]
+            tok = jnp.take_along_axis(idv[t], parent, axis=1)
+            nxt = jnp.take_along_axis(par[t], parent, axis=1)
+            return nxt, tok
+
+        init = jnp.broadcast_to(beams[None, :], idv.shape[1:])
+        _, toks = lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+        return jnp.flip(toks, 0)
+
+    return dispatch("gather_tree", fwd, it, pt)
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    """Parity: paddle.as_strided (view op). XLA has no aliasing views; this
+    materializes the strided gather, which is what the compiler would do."""
+    xt = ensure_tensor(x)
+
+    def fwd(a):
+        flat = a.reshape(-1)
+        idx = jnp.asarray(offset)
+        grids = jnp.meshgrid(*[jnp.arange(s) for s in shape], indexing="ij")
+        for g, st in zip(grids, stride):
+            idx = idx + g * st
+        return flat[idx.reshape(-1)].reshape(shape)
+
+    return dispatch("as_strided", fwd, xt)
+
+
+def view(x, shape_or_dtype, name=None):
+    """Parity: paddle.view — reinterpret shape or dtype (copy-free in the
+    reference; a cheap reshape/bitcast here)."""
+    xt = ensure_tensor(x)
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return dispatch("view_shape",
+                        lambda a: a.reshape(shape_or_dtype), xt)
+    from ..framework.dtype import convert_dtype
+    d = convert_dtype(shape_or_dtype)
+    return dispatch("view_dtype", lambda a: lax.bitcast_convert_type(a, d),
+                    xt)
+
+
+def copysign(x, y, name=None):
+    return dispatch("copysign", jnp.copysign, ensure_tensor(x),
+                    ensure_tensor(y))
+
+
+def ldexp(x, y, name=None):
+    return dispatch("ldexp", lambda a, b: a * (2.0 ** b.astype(jnp.float32)),
+                    ensure_tensor(x), ensure_tensor(y))
+
+
+def frexp(x, name=None):
+    xt = ensure_tensor(x)
+
+    def fwd(a):
+        m, e = jnp.frexp(a)
+        return m, e.astype(jnp.int32)
+
+    return dispatch("frexp", fwd, xt)
+
+
+def vander(x, n=None, increasing=False, name=None):
+    return dispatch(
+        "vander",
+        lambda a: jnp.vander(a, N=n, increasing=increasing),
+        ensure_tensor(x))
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """Already in nn.functional? kept here as the op-level alias."""
+    from ..nn.functional import unfold as f_unfold
+    return f_unfold(x, kernel_sizes, strides, paddings, dilations)

@@ -1,0 +1,140 @@
+"""paddle_tpu.signal — frame/overlap_add/stft/istft.
+
+Reference parity: python/paddle/signal.py (stft :269, istft, frame,
+overlap_add — kernels frame/overlap_add/fft in ops.yaml). TPU-native:
+framing is a gather-free strided reshape-and-slice (XLA fuses it); FFT is
+the XLA FFT HLO via paddle_tpu.fft.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .ops.dispatch import dispatch, ensure_tensor
+from .tensor import Tensor
+
+
+def frame(x, frame_length: int, hop_length: int, axis: int = -1, name=None):
+    """Slice overlapping frames of size frame_length every hop_length.
+    Output appends a [frame_length, num_frames] (axis=-1) or
+    [num_frames, frame_length] (axis=0) pair of dims like the reference."""
+    xt = ensure_tensor(x)
+
+    def fwd(a):
+        ax = axis if axis >= 0 else a.ndim + axis
+        n = a.shape[ax]
+        if frame_length > n:
+            raise ValueError(f"frame_length {frame_length} > signal {n}")
+        num = 1 + (n - frame_length) // hop_length
+        starts = jnp.arange(num) * hop_length
+        idx = starts[:, None] + jnp.arange(frame_length)[None, :]
+        frames = jnp.take(a, idx, axis=ax)  # [..., num, frame_length, ...]
+        if ax == a.ndim - 1:
+            # reference layout for axis=-1: [..., frame_length, num_frames]
+            return jnp.swapaxes(frames, -1, -2)
+        return frames  # axis=0: [num_frames, frame_length, ...]
+
+    return dispatch("frame", fwd, xt)
+
+
+def overlap_add(x, hop_length: int, axis: int = -1, name=None):
+    """Inverse of frame: sum overlapping frames.
+    x: [..., frame_length, num_frames] (axis=-1) or
+       [num_frames, frame_length, ...] (axis=0)."""
+    xt = ensure_tensor(x)
+
+    def fwd(a):
+        if axis in (-1, a.ndim - 1):
+            fl, num = a.shape[-2], a.shape[-1]
+            frames = jnp.swapaxes(a, -1, -2)      # [..., num, fl]
+        else:
+            num, fl = a.shape[0], a.shape[1]
+            frames = jnp.moveaxis(a, (0, 1), (a.ndim - 2, a.ndim - 1))
+        out_len = (num - 1) * hop_length + fl
+        idx = (jnp.arange(num) * hop_length)[:, None] + \
+            jnp.arange(fl)[None, :]               # [num, fl]
+        out = jnp.zeros(frames.shape[:-2] + (out_len,), a.dtype)
+        out = out.at[..., idx].add(frames)
+        if axis in (-1, a.ndim - 1):
+            return out
+        return jnp.moveaxis(out, -1, 0)
+
+    return dispatch("overlap_add", fwd, xt)
+
+
+def stft(x, n_fft: int, hop_length: Optional[int] = None,
+         win_length: Optional[int] = None, window=None, center: bool = True,
+         pad_mode: str = "reflect", normalized: bool = False,
+         onesided: bool = True, name=None):
+    """Parity: paddle.signal.stft (signal.py:269). x: [batch, signal] or
+    [signal]. Returns complex [batch, n_fft//2+1 or n_fft, num_frames]."""
+    xt = ensure_tensor(x)
+    hop = hop_length or n_fft // 4
+    wl = win_length or n_fft
+    win = ensure_tensor(window)._data if window is not None else \
+        jnp.ones(wl, jnp.float32)
+    if wl < n_fft:  # center-pad window to n_fft
+        pad = (n_fft - wl) // 2
+        win = jnp.pad(win, (pad, n_fft - wl - pad))
+
+    def fwd(a, w):
+        sig = a[None] if a.ndim == 1 else a
+        if center:
+            sig = jnp.pad(sig, [(0, 0), (n_fft // 2, n_fft // 2)],
+                          mode=pad_mode)
+        n = sig.shape[-1]
+        num = 1 + (n - n_fft) // hop
+        idx = (jnp.arange(num) * hop)[:, None] + jnp.arange(n_fft)[None, :]
+        frames = sig[:, idx] * w                   # [b, num, n_fft]
+        spec = jnp.fft.rfft(frames, axis=-1) if onesided \
+            else jnp.fft.fft(frames, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        out = jnp.swapaxes(spec, -1, -2)           # [b, freq, num]
+        return out[0] if a.ndim == 1 else out
+
+    return dispatch("stft", fwd, xt, Tensor(win))
+
+
+def istft(x, n_fft: int, hop_length: Optional[int] = None,
+          win_length: Optional[int] = None, window=None, center: bool = True,
+          normalized: bool = False, onesided: bool = True, length=None,
+          return_complex: bool = False, name=None):
+    """Parity: paddle.signal.istft — overlap-add inverse with window-square
+    normalization."""
+    xt = ensure_tensor(x)
+    hop = hop_length or n_fft // 4
+    wl = win_length or n_fft
+    win = ensure_tensor(window)._data if window is not None else \
+        jnp.ones(wl, jnp.float32)
+    if wl < n_fft:
+        pad = (n_fft - wl) // 2
+        win = jnp.pad(win, (pad, n_fft - wl - pad))
+
+    def fwd(a, w):
+        spec = a[None] if a.ndim == 2 else a       # [b, freq, num]
+        spec = jnp.swapaxes(spec, -1, -2)          # [b, num, freq]
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        frames = jnp.fft.irfft(spec, n=n_fft, axis=-1) if onesided \
+            else jnp.fft.ifft(spec, axis=-1).real
+        frames = frames * w
+        num = frames.shape[-2]
+        out_len = (num - 1) * hop + n_fft
+        idx = (jnp.arange(num) * hop)[:, None] + jnp.arange(n_fft)[None, :]
+        out = jnp.zeros(frames.shape[:-2] + (out_len,), frames.dtype)
+        out = out.at[..., idx].add(frames)
+        wsq = jnp.zeros(out_len, frames.dtype).at[idx.reshape(-1)].add(
+            jnp.tile(w * w, num))
+        out = out / jnp.maximum(wsq, 1e-11)
+        if center:
+            out = out[..., n_fft // 2:out_len - n_fft // 2]
+        if length is not None:
+            out = out[..., :length]
+        return out[0] if a.ndim == 2 else out
+
+    return dispatch("istft", fwd, xt, Tensor(win))
+
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]

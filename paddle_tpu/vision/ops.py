@@ -1,0 +1,261 @@
+"""paddle_tpu.vision.ops — detection ops.
+
+Reference parity: python/paddle/vision/ops.py (nms, roi_align, box_coder,
+yolo_box, ...; kernels in ops.yaml). TPU-native notes: NMS's data-dependent
+loop becomes a fixed-trip lax.scan over score-sorted boxes (compile-friendly,
+O(n^2) mask math on the VPU instead of a serial CPU loop); roi_align is a
+gather + bilinear interpolation that XLA fuses.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.dispatch import dispatch, ensure_tensor
+from ..tensor import Tensor
+
+
+def _iou_matrix(boxes):
+    x1, y1, x2, y2 = [boxes[:, i] for i in range(4)]
+    area = jnp.maximum(x2 - x1, 0) * jnp.maximum(y2 - y1, 0)
+    ix1 = jnp.maximum(x1[:, None], x1[None, :])
+    iy1 = jnp.maximum(y1[:, None], y1[None, :])
+    ix2 = jnp.minimum(x2[:, None], x2[None, :])
+    iy2 = jnp.minimum(y2[:, None], y2[None, :])
+    inter = jnp.maximum(ix2 - ix1, 0) * jnp.maximum(iy2 - iy1, 0)
+    union = area[:, None] + area[None, :] - inter
+    return inter / jnp.maximum(union, 1e-10)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None, name=None):
+    """Parity: paddle.vision.ops.nms. Returns kept indices (score order).
+
+    Greedy NMS as a lax.scan over boxes sorted by score: box i is kept iff no
+    higher-scored KEPT box overlaps it above the threshold.
+    """
+    bt = ensure_tensor(boxes)
+    st = ensure_tensor(scores) if scores is not None else None
+    ct = ensure_tensor(category_idxs) if category_idxs is not None else None
+
+    def fwd(b, *rest):
+        n = b.shape[0]
+        s = rest[0] if st is not None else jnp.arange(n, 0, -1, jnp.float32)
+        order = jnp.argsort(-s)
+        bs = b[order]
+        iou = _iou_matrix(bs)
+        if ct is not None:
+            cat = rest[-1][order]
+            iou = jnp.where(cat[:, None] == cat[None, :], iou, 0.0)
+
+        def step(keep, i):
+            # suppressed if any earlier kept box overlaps > threshold
+            over = (iou[i] > iou_threshold) & keep & \
+                (jnp.arange(n) < i)
+            ki = ~jnp.any(over)
+            return keep.at[i].set(ki), ki
+
+        keep0 = jnp.zeros(n, bool)
+        keep, _ = lax.scan(step, keep0, jnp.arange(n))
+        kept_sorted = order[jnp.nonzero(keep, size=n, fill_value=-1)[0]]
+        valid = jnp.sum(keep)
+        return kept_sorted, valid
+
+    args = [bt] + ([st] if st is not None else []) + \
+        ([ct] if ct is not None else [])
+    kept, valid = dispatch("nms", fwd, *args)
+    import numpy as np
+    k = int(np.asarray(valid._data))
+    out = kept._data[:k]
+    if top_k is not None:
+        out = out[:top_k]
+    return Tensor(out)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, axis=0,
+              name=None):
+    """Parity: paddle.vision.ops.box_coder (encode/decode center-size)."""
+    pb = ensure_tensor(prior_box)
+    tv = ensure_tensor(target_box)
+    var = ensure_tensor(prior_box_var) if prior_box_var is not None and \
+        not isinstance(prior_box_var, (list, tuple)) else None
+    var_list = prior_box_var if isinstance(prior_box_var, (list, tuple)) \
+        else None
+    norm = 0.0 if box_normalized else 1.0
+
+    def fwd(p, t, *v):
+        pw = p[:, 2] - p[:, 0] + norm
+        ph = p[:, 3] - p[:, 1] + norm
+        pcx = p[:, 0] + 0.5 * pw
+        pcy = p[:, 1] + 0.5 * ph
+        pvar = v[0] if v else (jnp.asarray(var_list, t.dtype)[None]
+                               if var_list else jnp.ones((1, 4), t.dtype))
+        if code_type == "encode_center_size":
+            tw = t[:, 2] - t[:, 0] + norm
+            th = t[:, 3] - t[:, 1] + norm
+            tcx = t[:, 0] + 0.5 * tw
+            tcy = t[:, 1] + 0.5 * th
+            out = jnp.stack([
+                (tcx[:, None] - pcx[None, :]) / pw[None, :],
+                (tcy[:, None] - pcy[None, :]) / ph[None, :],
+                jnp.log(tw[:, None] / pw[None, :]),
+                jnp.log(th[:, None] / ph[None, :]),
+            ], axis=-1)
+            return out / pvar.reshape(1, -1, 4)
+        # decode: t [N, M, 4] or [N, 4] deltas against priors
+        d = t if t.ndim == 3 else t[:, None, :]
+        d = d * pvar.reshape(1, -1, 4) if pvar.shape[0] != 1 or v else \
+            d * pvar.reshape(1, 1, 4)
+        if axis == 0:
+            cw, ch, cx, cy = pw[None, :], ph[None, :], pcx[None, :], \
+                pcy[None, :]
+        else:
+            cw, ch, cx, cy = pw[:, None], ph[:, None], pcx[:, None], \
+                pcy[:, None]
+        ocx = d[..., 0] * cw + cx
+        ocy = d[..., 1] * ch + cy
+        ow = jnp.exp(d[..., 2]) * cw
+        oh = jnp.exp(d[..., 3]) * ch
+        out = jnp.stack([ocx - 0.5 * ow, ocy - 0.5 * oh,
+                         ocx + 0.5 * ow - norm, ocy + 0.5 * oh - norm],
+                        axis=-1)
+        return out if t.ndim == 3 else out[:, 0]
+
+    args = [pb, tv] + ([var] if var is not None else [])
+    return dispatch("box_coder", fwd, *args)
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """Parity: paddle.vision.ops.roi_align. x: [N, C, H, W]; boxes [R, 4]
+    (x1, y1, x2, y2); boxes_num: rois per image."""
+    xt, bt, nt = ensure_tensor(x), ensure_tensor(boxes), \
+        ensure_tensor(boxes_num)
+    oh, ow = (output_size if isinstance(output_size, (list, tuple))
+              else (output_size, output_size))
+
+    def fwd(feat, rois, rois_num):
+        n, c, h, w = feat.shape
+        r = rois.shape[0]
+        # image index per roi from boxes_num
+        img_idx = jnp.repeat(jnp.arange(rois_num.shape[0]), rois_num,
+                             total_repeat_length=r)
+        off = 0.5 if aligned else 0.0
+        x1 = rois[:, 0] * spatial_scale - off
+        y1 = rois[:, 1] * spatial_scale - off
+        x2 = rois[:, 2] * spatial_scale - off
+        y2 = rois[:, 3] * spatial_scale - off
+        rw = jnp.maximum(x2 - x1, 1e-5 if aligned else 1.0)
+        rh = jnp.maximum(y2 - y1, 1e-5 if aligned else 1.0)
+        sr = sampling_ratio if sampling_ratio > 0 else 2
+        # sample grid: [R, oh*sr, ow*sr]
+        gy = (jnp.arange(oh * sr) + 0.5) / (oh * sr)
+        gx = (jnp.arange(ow * sr) + 0.5) / (ow * sr)
+        ys = y1[:, None] + gy[None, :] * rh[:, None]      # [R, oh*sr]
+        xs = x1[:, None] + gx[None, :] * rw[:, None]      # [R, ow*sr]
+
+        def bilinear(img, yy, xx):
+            y0 = jnp.clip(jnp.floor(yy), 0, h - 1)
+            x0 = jnp.clip(jnp.floor(xx), 0, w - 1)
+            y1_ = jnp.clip(y0 + 1, 0, h - 1)
+            x1_ = jnp.clip(x0 + 1, 0, w - 1)
+            wy = jnp.clip(yy - y0, 0, 1)
+            wx = jnp.clip(xx - x0, 0, 1)
+            y0i, y1i = y0.astype(int), y1_.astype(int)
+            x0i, x1i = x0.astype(int), x1_.astype(int)
+            v00 = img[:, y0i[:, None], x0i[None, :]]
+            v01 = img[:, y0i[:, None], x1i[None, :]]
+            v10 = img[:, y1i[:, None], x0i[None, :]]
+            v11 = img[:, y1i[:, None], x1i[None, :]]
+            return (v00 * (1 - wy[:, None]) * (1 - wx[None, :]) +
+                    v01 * (1 - wy[:, None]) * wx[None, :] +
+                    v10 * wy[:, None] * (1 - wx[None, :]) +
+                    v11 * wy[:, None] * wx[None, :])
+
+        def per_roi(i):
+            img = feat[img_idx[i]]
+            vals = bilinear(img, ys[i], xs[i])            # [C, oh*sr, ow*sr]
+            vals = vals.reshape(c, oh, sr, ow, sr)
+            return vals.mean((2, 4))
+
+        import jax
+        return jax.vmap(per_roi)(jnp.arange(r))
+
+    return dispatch("roi_align", fwd, xt, bt, nt)
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
+             clip_bbox=True, scale_x_y=1.0, iou_aware=False,
+             iou_aware_factor=0.5, name=None):
+    """Parity: paddle.vision.ops.yolo_box — decode YOLO head output to boxes
+    and scores. x: [N, C, H, W] with C = len(anchors)/2 * (5 + class_num)."""
+    xt, it = ensure_tensor(x), ensure_tensor(img_size)
+    na = len(anchors) // 2
+    anc = jnp.asarray(anchors, jnp.float32).reshape(na, 2)
+
+    def fwd(p, imgs):
+        n, c, h, w = p.shape
+        p = p.reshape(n, na, 5 + class_num, h, w)
+        gx = jnp.arange(w, dtype=jnp.float32)
+        gy = jnp.arange(h, dtype=jnp.float32)
+        sig = lambda v: 1.0 / (1.0 + jnp.exp(-v))
+        bx = (gx[None, None, None, :] +
+              sig(p[:, :, 0]) * scale_x_y - 0.5 * (scale_x_y - 1.0)) / w
+        by = (gy[None, None, :, None] +
+              sig(p[:, :, 1]) * scale_x_y - 0.5 * (scale_x_y - 1.0)) / h
+        in_w = w * downsample_ratio
+        in_h = h * downsample_ratio
+        bw = jnp.exp(p[:, :, 2]) * anc[None, :, 0, None, None] / in_w
+        bh = jnp.exp(p[:, :, 3]) * anc[None, :, 1, None, None] / in_h
+        conf = sig(p[:, :, 4])
+        cls = sig(p[:, :, 5:])
+        score = conf[:, :, None] * cls
+        keep = conf > conf_thresh
+        imw = imgs[:, 1].astype(jnp.float32)[:, None, None, None]
+        imh = imgs[:, 0].astype(jnp.float32)[:, None, None, None]
+        x1 = (bx - bw / 2) * imw
+        y1 = (by - bh / 2) * imh
+        x2 = (bx + bw / 2) * imw
+        y2 = (by + bh / 2) * imh
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, imw - 1)
+            y1 = jnp.clip(y1, 0, imh - 1)
+            x2 = jnp.clip(x2, 0, imw - 1)
+            y2 = jnp.clip(y2, 0, imh - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], -1).reshape(n, -1, 4)
+        keep_f = keep.reshape(n, -1, 1).astype(boxes.dtype)
+        scores = (score * keep[:, :, None]).transpose(0, 1, 3, 4, 2) \
+            .reshape(n, -1, class_num)
+        return boxes * keep_f, scores
+
+    return dispatch("yolo_box", fwd, xt, it)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    """Parity: paddle.vision.ops.distribute_fpn_proposals — assign rois to
+    FPN levels by scale."""
+    rt = ensure_tensor(fpn_rois)
+    import numpy as np
+    rois = np.asarray(rt._data)
+    off = 1.0 if pixel_offset else 0.0
+    ws = rois[:, 2] - rois[:, 0] + off
+    hs = rois[:, 3] - rois[:, 1] + off
+    scale = np.sqrt(np.maximum(ws * hs, 1e-12))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(int)
+    outs, idxs = [], []
+    for l in range(min_level, max_level + 1):
+        sel = np.nonzero(lvl == l)[0]
+        outs.append(Tensor(jnp.asarray(rois[sel])))
+        idxs.append(sel)
+    restore = np.argsort(np.concatenate(idxs)) if idxs else np.empty(0, int)
+    nums = [Tensor(jnp.asarray(np.array([len(i)], np.int32)))
+            for i in idxs] if rois_num is not None else None
+    return outs, Tensor(jnp.asarray(restore.astype(np.int32))[:, None], ), nums
+
+
+__all__ = ["nms", "box_coder", "roi_align", "yolo_box",
+           "distribute_fpn_proposals"]

@@ -1,0 +1,267 @@
+"""NumPy/SciPy/torch-oracle tests for the breadth batch: special math ops,
+fft, signal, vision ops, segment ops, grid_sample (reference OpTest style)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+rng = np.random.default_rng(0)
+
+
+# -- special math --------------------------------------------------------------
+
+def test_lerp():
+    x = rng.standard_normal((3, 4)).astype(np.float32)
+    y = rng.standard_normal((3, 4)).astype(np.float32)
+    np.testing.assert_allclose(paddle.lerp(_t(x), _t(y), 0.3).numpy(),
+                               x + 0.3 * (y - x), rtol=1e-6)
+
+
+def test_trace_diagonal():
+    x = rng.standard_normal((4, 5)).astype(np.float32)
+    np.testing.assert_allclose(paddle.trace(_t(x)).numpy(), np.trace(x),
+                               rtol=1e-6)
+    np.testing.assert_allclose(paddle.diagonal(_t(x), offset=1).numpy(),
+                               np.diagonal(x, offset=1))
+
+
+def test_fill_diagonal():
+    x = np.zeros((4, 4), np.float32)
+    t = _t(x.copy())
+    paddle.fill_diagonal_(t, 7.0)
+    np.testing.assert_allclose(t.numpy(), np.diag([7.0] * 4))
+    y = rng.standard_normal(3).astype(np.float32)
+    out = paddle.fill_diagonal_tensor(_t(np.zeros((3, 3), np.float32)), _t(y))
+    np.testing.assert_allclose(np.diagonal(out.numpy()), y)
+
+
+def test_renorm():
+    x = rng.standard_normal((3, 8)).astype(np.float32) * 5
+    out = paddle.renorm(_t(x), p=2.0, axis=0, max_norm=1.0).numpy()
+    norms = np.linalg.norm(out.reshape(3, -1), axis=1)
+    assert (norms <= 1.0 + 1e-4).all()
+
+
+def test_multiplex():
+    a = rng.standard_normal((4, 3)).astype(np.float32)
+    b = rng.standard_normal((4, 3)).astype(np.float32)
+    idx = np.array([0, 1, 1, 0], np.int32)
+    out = paddle.multiplex([_t(a), _t(b)], _t(idx)).numpy()
+    expect = np.where(idx[:, None] == 0, a, b)
+    np.testing.assert_allclose(out, expect)
+
+
+def test_gamma_family():
+    from scipy import special as sp
+    x = np.abs(rng.standard_normal(6)).astype(np.float32) + 0.5
+    y = np.abs(rng.standard_normal(6)).astype(np.float32) + 0.5
+    np.testing.assert_allclose(paddle.gammaln(_t(x)).numpy(), sp.gammaln(x),
+                               rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(paddle.gammainc(_t(x), _t(y)).numpy(),
+                               sp.gammainc(x, y), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(paddle.gammaincc(_t(x), _t(y)).numpy(),
+                               sp.gammaincc(x, y), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(paddle.polygamma(_t(x), 1).numpy(),
+                               sp.polygamma(1, x), rtol=1e-4)
+
+
+def test_sequence_mask_and_shard_index():
+    lens = np.array([1, 3, 2], np.int64)
+    out = paddle.sequence_mask(_t(lens), maxlen=4, dtype="int32").numpy()
+    expect = (np.arange(4)[None] < lens[:, None]).astype(np.int32)
+    np.testing.assert_array_equal(out, expect)
+    ids = np.array([0, 5, 9, 14], np.int64)
+    out = paddle.shard_index(_t(ids), index_num=16, nshards=2,
+                             shard_id=1).numpy()
+    np.testing.assert_array_equal(out, [-1, -1, 1, 6])
+
+
+def test_norm_helpers():
+    x = rng.standard_normal((3, 4)).astype(np.float32)
+    np.testing.assert_allclose(paddle.squared_l2_norm(_t(x)).numpy(),
+                               (x ** 2).sum(), rtol=1e-5)
+    np.testing.assert_allclose(paddle.l1_norm(_t(x)).numpy(),
+                               np.abs(x).sum(), rtol=1e-5)
+    big = x * 100
+    out = paddle.clip_by_norm(_t(big), 1.0).numpy()
+    np.testing.assert_allclose(np.linalg.norm(out), 1.0, rtol=1e-4)
+
+
+def test_swiglu():
+    x = rng.standard_normal((2, 8)).astype(np.float32)
+    a, b = x[:, :4], x[:, 4:]
+    expect = a / (1 + np.exp(-a)) * b
+    np.testing.assert_allclose(paddle.swiglu(_t(x)).numpy(), expect,
+                               rtol=1e-5)
+    np.testing.assert_allclose(paddle.swiglu(_t(a), _t(b)).numpy(), expect,
+                               rtol=1e-5)
+
+
+def test_top_p_sampling():
+    paddle.seed(0)
+    logits = np.log(np.array([[0.01, 0.04, 0.05, 0.9]], np.float32))
+    vals, ids = paddle.top_p_sampling(_t(logits), _t(np.array([0.5],
+                                                             np.float32)))
+    assert int(ids.numpy()[0, 0]) == 3  # only the 0.9 token survives p=0.5
+
+
+def test_reduce_as_and_reverse():
+    x = rng.standard_normal((2, 3, 4)).astype(np.float32)
+    tgt = np.zeros((3, 1), np.float32)
+    out = paddle.reduce_as(_t(x), _t(tgt)).numpy()
+    np.testing.assert_allclose(out, x.sum(0).sum(-1, keepdims=True),
+                               rtol=1e-5)
+    np.testing.assert_allclose(paddle.reverse(_t(x), axis=1).numpy(),
+                               x[:, ::-1])
+
+
+def test_as_strided_view_copysign():
+    x = np.arange(12, dtype=np.float32)
+    out = paddle.as_strided(_t(x), [3, 2], [4, 1]).numpy()
+    np.testing.assert_allclose(out, np.lib.stride_tricks.as_strided(
+        x, (3, 2), (16, 4)))
+    v = paddle.view(_t(x), [3, 4]).numpy()
+    assert v.shape == (3, 4)
+    a = np.array([1.0, -2.0], np.float32)
+    b = np.array([-1.0, 3.0], np.float32)
+    np.testing.assert_allclose(paddle.copysign(_t(a), _t(b)).numpy(),
+                               np.copysign(a, b))
+
+
+def test_gather_tree():
+    ids = np.array([[[2, 2]], [[3, 4]], [[5, 6]]], np.int64)  # [T=3,B=1,K=2]
+    parents = np.array([[[0, 0]], [[1, 0]], [[1, 0]]], np.int64)
+    out = paddle.gather_tree(_t(ids), _t(parents)).numpy()
+    # beam 0 backtrace: t2 parent 1 -> t1 id 4 (parent 0) -> t0 id 2
+    np.testing.assert_array_equal(out[:, 0, 0], [2, 4, 5])
+
+
+# -- fft / signal --------------------------------------------------------------
+
+def test_fft_roundtrip():
+    x = rng.standard_normal(16).astype(np.float32)
+    X = paddle.fft.fft(_t(x))
+    back = paddle.fft.ifft(X).numpy()
+    np.testing.assert_allclose(back.real, x, atol=1e-5)
+    np.testing.assert_allclose(paddle.fft.rfft(_t(x)).numpy(),
+                               np.fft.rfft(x), atol=1e-4)
+    m = rng.standard_normal((4, 6)).astype(np.float32)
+    np.testing.assert_allclose(paddle.fft.fft2(_t(m)).numpy(),
+                               np.fft.fft2(m), atol=1e-4)
+    np.testing.assert_allclose(paddle.fft.irfftn(paddle.fft.rfftn(_t(m)),
+                                                 s=m.shape).numpy(),
+                               m, atol=1e-5)
+
+
+def test_fft_shift_freq():
+    np.testing.assert_allclose(paddle.fft.fftfreq(8, 0.5).numpy(),
+                               np.fft.fftfreq(8, 0.5))
+    x = np.arange(8.0)
+    np.testing.assert_allclose(paddle.fft.fftshift(_t(x)).numpy(),
+                               np.fft.fftshift(x))
+
+
+def test_stft_istft_roundtrip():
+    sig = rng.standard_normal(512).astype(np.float32)
+    win = np.hanning(128).astype(np.float32)
+    spec = paddle.signal.stft(_t(sig), n_fft=128, hop_length=32,
+                              window=_t(win))
+    assert spec.shape[0] == 65
+    back = paddle.signal.istft(spec, n_fft=128, hop_length=32, window=_t(win),
+                               length=512).numpy()
+    np.testing.assert_allclose(back, sig, atol=1e-4)
+
+
+def test_frame_overlap_add():
+    x = np.arange(10, dtype=np.float32)
+    f = paddle.signal.frame(_t(x), frame_length=4, hop_length=2)
+    assert tuple(f.shape) == (4, 4)
+    np.testing.assert_allclose(f.numpy()[:, 0], [0, 1, 2, 3])
+    # overlap_add of disjoint hop == reconstruction
+    f2 = paddle.signal.frame(_t(x[:8]), frame_length=4, hop_length=4)
+    back = paddle.signal.overlap_add(f2, hop_length=4).numpy()
+    np.testing.assert_allclose(back, x[:8])
+
+
+# -- vision ops ----------------------------------------------------------------
+
+def test_nms():
+    from paddle_tpu.vision.ops import nms
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30]],
+                     np.float32)
+    scores = np.array([0.9, 0.8, 0.7], np.float32)
+    keep = nms(_t(boxes), 0.5, _t(scores)).numpy()
+    np.testing.assert_array_equal(np.sort(keep), [0, 2])
+
+
+def test_box_coder_roundtrip():
+    from paddle_tpu.vision.ops import box_coder
+    priors = np.array([[0, 0, 10, 10], [5, 5, 20, 20]], np.float32)
+    targets = np.array([[1, 1, 9, 9], [6, 6, 18, 18]], np.float32)
+    enc = box_coder(_t(priors), [1.0, 1.0, 1.0, 1.0], _t(targets),
+                    code_type="encode_center_size").numpy()
+    dec = box_coder(_t(priors), [1.0, 1.0, 1.0, 1.0],
+                    _t(enc), code_type="decode_center_size", axis=0).numpy()
+    np.testing.assert_allclose(dec[0, 0], targets[0], atol=1e-4)
+    np.testing.assert_allclose(dec[1, 1], targets[1], atol=1e-4)
+
+
+def test_roi_align_constant_map():
+    from paddle_tpu.vision.ops import roi_align
+    x = np.full((1, 2, 8, 8), 3.0, np.float32)
+    rois = np.array([[0, 0, 4, 4]], np.float32)
+    out = roi_align(_t(x), _t(rois), _t(np.array([1], np.int32)),
+                    output_size=2)
+    assert tuple(out.shape) == (1, 2, 2, 2)
+    np.testing.assert_allclose(out.numpy(), 3.0, rtol=1e-5)
+
+
+def test_grid_sample_identity():
+    import paddle_tpu.nn.functional as F
+    x = rng.standard_normal((1, 1, 4, 4)).astype(np.float32)
+    theta = np.array([[[1, 0, 0], [0, 1, 0]]], np.float32)
+    grid = F.affine_grid(_t(theta), [1, 1, 4, 4], align_corners=True)
+    out = F.grid_sample(_t(x), grid, align_corners=True).numpy()
+    np.testing.assert_allclose(out, x, atol=1e-5)
+
+
+def test_temporal_shift_shapes():
+    import paddle_tpu.nn.functional as F
+    x = rng.standard_normal((4, 8, 2, 2)).astype(np.float32)
+    out = F.temporal_shift(_t(x), seg_num=2, shift_ratio=0.25)
+    assert tuple(out.shape) == (4, 8, 2, 2)
+    # last chunk of channels is unshifted
+    np.testing.assert_allclose(out.numpy()[:, 4:], x[:, 4:])
+
+
+# -- segment ops ---------------------------------------------------------------
+
+def test_segment_ops():
+    import paddle_tpu.incubate as inc
+    data = np.array([[1., 2.], [3., 4.], [5., 6.]], np.float32)
+    seg = np.array([0, 0, 1], np.int32)
+    np.testing.assert_allclose(inc.segment_sum(_t(data), _t(seg)).numpy(),
+                               [[4., 6.], [5., 6.]])
+    np.testing.assert_allclose(inc.segment_mean(_t(data), _t(seg)).numpy(),
+                               [[2., 3.], [5., 6.]])
+    np.testing.assert_allclose(inc.segment_max(_t(data), _t(seg)).numpy(),
+                               [[3., 4.], [5., 6.]])
+    np.testing.assert_allclose(inc.segment_min(_t(data), _t(seg)).numpy(),
+                               [[1., 2.], [5., 6.]])
+
+
+def test_send_u_recv():
+    import paddle_tpu.incubate as inc
+    x = np.array([[1.0], [2.0], [4.0]], np.float32)
+    src = np.array([0, 1, 2], np.int64)
+    dst = np.array([1, 1, 0], np.int64)
+    out = inc.send_u_recv(_t(x), _t(src), _t(dst), reduce_op="sum").numpy()
+    np.testing.assert_allclose(out, [[4.0], [3.0]])  # out rows = max(dst)+1
+    out3 = inc.send_u_recv(_t(x), _t(src), _t(dst), reduce_op="sum",
+                           out_size=3).numpy()
+    np.testing.assert_allclose(out3, [[4.0], [3.0], [0.0]])
