@@ -15,7 +15,10 @@ import time
 import numpy as np
 
 
-def peak_flops_per_chip(device) -> float:
+def peak_flops_per_chip(device):
+    """(bf16 peak FLOP/s, assumed?) — assumed=True means the device kind was
+    not recognized and MFU is computed against a guessed peak (flagged in the
+    output instead of silently inflating/deflating MFU)."""
     kind = getattr(device, "device_kind", "").lower()
     table = {
         "v5 lite": 197e12, "v5e": 197e12, "v5litepod": 197e12,
@@ -26,8 +29,8 @@ def peak_flops_per_chip(device) -> float:
     }
     for k, v in table.items():
         if k in kind:
-            return v
-    return 197e12
+            return v, False
+    return 197e12, True
 
 
 def _emit_error(msg: str) -> None:
@@ -78,62 +81,104 @@ def main():
     from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
     from paddle_tpu.parallel import SpmdTrainer
 
-    paddle.seed(0)
     dev = jax.devices()[0]
+
+    def cfg_1b():
+        # TinyLlama-1.1B-class: the VERDICT's "credible >=1B bf16" bar
+        return LlamaConfig(vocab_size=32000, hidden_size=2048,
+                           intermediate_size=5632, num_hidden_layers=22,
+                           num_attention_heads=16, num_key_value_heads=16,
+                           max_position_embeddings=2048)
+
+    def cfg_small():
+        return LlamaConfig(vocab_size=32000, hidden_size=1024,
+                           intermediate_size=2816, num_hidden_layers=16,
+                           num_attention_heads=16, num_key_value_heads=16,
+                           max_position_embeddings=2048)
+
     if debug:
-        cfg = LlamaConfig.tiny(vocab_size=256, hidden_size=64, layers=2,
-                               heads=4, kv_heads=2, seq=128)
-        batch, seq, steps, warmup = 2, 128, 4, 1
+        attempts = [("tiny", LlamaConfig.tiny(vocab_size=256, hidden_size=64,
+                                              layers=2, heads=4, kv_heads=2,
+                                              seq=128), 2, 128, 4, 1, False)]
     else:
-        cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
-                          intermediate_size=2816, num_hidden_layers=16,
-                          num_attention_heads=16, num_key_value_heads=16,
-                          max_position_embeddings=2048)
-        batch, seq, steps, warmup = 8, 2048, 10, 2
+        # (tag, cfg, batch, seq, steps, warmup, remat) — fall back on OOM so
+        # the driver always gets a real number from one chip
+        attempts = [
+            ("llama-1.1b-b8", cfg_1b(), 8, 2048, 10, 2, True),
+            ("llama-1.1b-b4", cfg_1b(), 4, 2048, 10, 2, True),
+            ("llama-1.1b-b2", cfg_1b(), 2, 2048, 10, 2, True),
+            ("llama-0.27b-b8", cfg_small(), 8, 2048, 10, 2, False),
+        ]
 
-    model = LlamaForCausalLM(cfg)
-    model.bfloat16()  # bf16 params, fp32 optimizer moments (AMP O2 recipe)
-    optimizer = opt.AdamW(learning_rate=1e-4, parameters=model.parameters())
+    last_err = None
+    for tag, cfg, batch, seq, steps, warmup, remat in attempts:
+        try:
+            deadline["t"] = time.monotonic() + 1500
+            deadline["what"] = f"compile/measure {tag}"
+            paddle.seed(0)
+            model = LlamaForCausalLM(cfg)
+            model.bfloat16()  # bf16 params, fp32 moments (AMP O2 recipe)
+            optimizer = opt.AdamW(learning_rate=1e-4,
+                                  parameters=model.parameters())
 
-    def loss_fn(m, input_ids, labels):
-        return m.compute_loss(m(input_ids), labels)
+            def loss_fn(m, input_ids, labels):
+                return m.compute_loss(m(input_ids), labels)
 
-    trainer = SpmdTrainer(model, optimizer, loss_fn, mesh=None,
-                          remat_layers=None)
-    rng = np.random.default_rng(0)
-    ids = paddle.to_tensor(rng.integers(0, cfg.vocab_size,
-                                        (batch, seq)).astype(np.int32))
-    for _ in range(warmup):
-        trainer.train_step(ids, ids)
-    trainer.block()
+            trainer = SpmdTrainer(
+                model, optimizer, loss_fn, mesh=None,
+                remat_layers=list(model.model.layers) if remat else None)
+            rng = np.random.default_rng(0)
+            ids = paddle.to_tensor(rng.integers(
+                0, cfg.vocab_size, (batch, seq)).astype(np.int32))
+            for _ in range(warmup):
+                trainer.train_step(ids, ids)
+            trainer.block()
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = trainer.train_step(ids, ids)
-    # Host fetch of the final loss = true barrier on the whole step chain
-    # (block_until_ready is unreliable through the remote-tunnel backend).
-    final_loss = float(loss.numpy())
-    dt = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                loss = trainer.train_step(ids, ids)
+            # Host fetch of the final loss + one param element = true barrier
+            # on the whole step chain incl. the last optimizer update
+            # (block_until_ready is unreliable through the tunnel backend).
+            final_loss = float(loss.numpy())
+            trainer.block()
+            dt = time.perf_counter() - t0
+        except Exception as e:  # noqa: BLE001 - OOM/compile fail -> fallback
+            last_err = f"{tag}: {type(e).__name__}: {e}"
+            sys.stderr.write(f"bench attempt failed, falling back — "
+                             f"{last_err[:500]}\n")
+            # release this attempt's device buffers before the next one, or
+            # the fallback configs inherit the OOM
+            import gc
+            model = optimizer = trainer = ids = loss = None  # noqa: F841
+            gc.collect()
+            continue
 
-    tokens = batch * seq * steps
-    tps = tokens / dt
-    flops_tok = model.flops_per_token(seq)
-    mfu = tps * flops_tok / peak_flops_per_chip(dev)
-    result = {
-        "metric": "llama_train_tokens_per_sec_per_chip",
-        "value": round(tps, 1),
-        "unit": "tokens/s",
-        "vs_baseline": round(mfu / 0.50, 4),
-        "extra": {
-            "mfu": round(mfu, 4),
-            "loss": round(final_loss, 4),
-            "params": model.num_params(),
-            "batch": batch, "seq": seq,
-            "device": getattr(dev, "device_kind", str(dev)),
-        },
-    }
-    deadline["t"] = float("inf")
-    print(json.dumps(result))
+        tokens = batch * seq * steps
+        tps = tokens / dt
+        flops_tok = model.flops_per_token(seq)
+        peak, peak_assumed = peak_flops_per_chip(dev)
+        mfu = tps * flops_tok / peak
+        result = {
+            "metric": "llama_train_tokens_per_sec_per_chip",
+            "value": round(tps, 1),
+            "unit": "tokens/s",
+            "vs_baseline": round(mfu / 0.50, 4),
+            "extra": {
+                "mfu": round(mfu, 4),
+                "loss": round(final_loss, 4),
+                "params": model.num_params(),
+                "config": tag,
+                "batch": batch, "seq": seq,
+                "device": getattr(dev, "device_kind", str(dev)),
+                "peak_flops_assumed": peak_assumed,
+            },
+        }
+        deadline["t"] = float("inf")
+        print(json.dumps(result))
+        return
+    _emit_error(f"all bench configs failed; last: {last_err}")
+    sys.exit(1)
 
 
 if __name__ == "__main__":

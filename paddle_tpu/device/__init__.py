@@ -67,13 +67,23 @@ class cuda:
     def empty_cache():
         pass
 
+    # The reference exposes memory stats under device.cuda.*; route to the
+    # accelerator actually present so reference code keeps working.
     @staticmethod
     def max_memory_allocated(device=None):
-        return 0
+        return max_memory_allocated(device)
 
     @staticmethod
     def memory_allocated(device=None):
-        return 0
+        return memory_allocated(device)
+
+    @staticmethod
+    def memory_reserved(device=None):
+        return memory_reserved(device)
+
+    @staticmethod
+    def max_memory_reserved(device=None):
+        return max_memory_reserved(device)
 
 
 def synchronize(device=None):
@@ -83,6 +93,54 @@ def synchronize(device=None):
             d.synchronize_all_activity()
         except AttributeError:
             pass
+
+
+# -- memory stats (reference phi/core/memory/stats.h; python
+#    paddle.device.cuda.{memory_allocated,max_memory_allocated,...}) ----------
+# TPU-native: XLA owns allocation; PJRT exposes per-device counters via
+# Device.memory_stats() (bytes_in_use, peak_bytes_in_use, bytes_limit, ...).
+
+def _mem_stats(device=None) -> dict:
+    idx = 0
+    if isinstance(device, int):
+        idx = device
+    elif isinstance(device, str) and ":" in device:
+        idx = int(device.rsplit(":", 1)[1])
+    d = _devices()[idx]
+    try:
+        return d.memory_stats() or {}
+    except Exception:
+        return {}
+
+
+def memory_allocated(device=None) -> int:
+    """Bytes currently allocated on the device (stats.h STAT_GetCurrentValue
+    analog)."""
+    return int(_mem_stats(device).get("bytes_in_use", 0))
+
+
+def max_memory_allocated(device=None) -> int:
+    """Peak allocated bytes (stats.h STAT_GetPeakValue analog)."""
+    s = _mem_stats(device)
+    return int(s.get("peak_bytes_in_use", s.get("bytes_in_use", 0)))
+
+
+def memory_reserved(device=None) -> int:
+    """Bytes reserved by the allocator pool (bytes_limit under XLA's
+    preallocated BFC arena; falls back to in-use)."""
+    s = _mem_stats(device)
+    return int(s.get("bytes_reserved", s.get("bytes_in_use", 0)))
+
+
+def max_memory_reserved(device=None) -> int:
+    s = _mem_stats(device)
+    return int(s.get("peak_bytes_reserved",
+                     s.get("peak_bytes_in_use", s.get("bytes_in_use", 0))))
+
+
+def memory_stats(device=None) -> dict:
+    """Full PJRT allocator counter dict (device-kind dependent keys)."""
+    return _mem_stats(device)
 
 
 class Stream:

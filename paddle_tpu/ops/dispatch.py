@@ -63,16 +63,33 @@ def _wrap_outputs(out, node, stop_gradient):
     return t
 
 
+def _nan_report(name, bad):
+    """Host-side sink for traced NaN checks (jax.debug.callback target)."""
+    if bad:
+        msg = f"NaN/Inf detected in output of op '{name}'"
+        if flags.flag("check_nan_inf_level") > 0:
+            print("WARNING:", msg)
+        else:
+            # raising inside the callback aborts the program like the
+            # reference's FLAGS_check_nan_inf enforce does
+            raise FloatingPointError(msg)
+
+
 def _check_numerics(name, out):
+    """NaN/Inf output checking (reference FLAGS_check_nan_inf,
+    check_numerics_utils.h) — works BOTH eagerly and inside a jit trace.
+
+    Traced path: a jax.debug.callback carries the any-nonfinite bit to the
+    host, so the compiled trainer step (SpmdTrainer) gets numerics checking
+    too; eager path raises synchronously."""
     arrays = out if isinstance(out, (tuple, list)) else (out,)
     for a in arrays:
         if hasattr(a, "dtype") and a.dtype.kind == "f":
-            if not bool(jnp.isfinite(a).all()):
-                msg = f"NaN/Inf detected in output of op '{name}'"
-                if flags.flag("check_nan_inf_level") > 0:
-                    print("WARNING:", msg)
-                else:
-                    raise FloatingPointError(msg)
+            bad = ~jnp.isfinite(a).all()
+            if isinstance(bad, jax.core.Tracer):
+                jax.debug.callback(_nan_report, name, bad)
+            elif bool(bad):
+                _nan_report(name, True)
 
 
 _prof = None  # lazily bound paddle_tpu.profiler (host tracer)
@@ -107,6 +124,11 @@ def dispatch(name: str, fwd, *tensor_inputs: Tensor):
 
 
 def _dispatch_inner(name: str, fwd, tensor_inputs):
+    # static-graph build: any symbolic input defers the op into the Program
+    # graph (shape/dtype via eval_shape) instead of executing it
+    if any(isinstance(t._data, jax.ShapeDtypeStruct) for t in tensor_inputs):
+        from ..static import record_static_op
+        return record_static_op(name, fwd, tensor_inputs)
     arrays = _amp_cast(name, tuple(t._data for t in tensor_inputs))
     record = is_grad_enabled() and any(_is_diff(t) for t in tensor_inputs)
 

@@ -162,6 +162,19 @@ class Optimizer:
     clear_gradients = clear_grad
 
     # main API ----------------------------------------------------------------
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        """Parity: Optimizer.minimize. Dygraph: backward+step+clear. Static:
+        records the optimize directive on the main Program — Executor.run
+        then derives grads with jax.value_and_grad and applies _update."""
+        from ..static import Variable, default_main_program
+        if isinstance(loss, Variable):
+            default_main_program()._optimize = (self, loss, parameters)
+            return None, []
+        loss.backward()
+        self.step()
+        return None, []
+
     @jax.named_scope("optimizer_step")
     def step(self):
         self._global_step += 1
@@ -185,12 +198,6 @@ class Optimizer:
             p._data = new_param
             acc_new["_step"] = step
             self._accumulators[id(p)] = acc_new
-
-    def minimize(self, loss, startup_program=None, parameters=None,
-                 no_grad_set=None):
-        loss.backward()
-        self.step()
-        return None, None
 
     # to implement ------------------------------------------------------------
     def _init_state(self, param) -> Dict[str, jnp.ndarray]:

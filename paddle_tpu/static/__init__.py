@@ -1,0 +1,329 @@
+"""paddle_tpu.static — static-graph user API.
+
+Reference parity: python/paddle/static/ (Program, data, Executor.run
+base/executor.py:1237, append_backward/minimize) over the legacy framework
+(ProgramDesc + PirInterpreter, SURVEY layer 12). TPU-native design: there is
+no hand-written interpreter — `paddle.static.data` creates SYMBOLIC
+variables (jax avals), every op that touches one records a deferred node
+through the same dispatch chokepoint the eager API uses (shape/dtype
+inference via jax.eval_shape = InferMeta), and `Executor.run` replays the
+recorded graph as ONE jitted XLA program keyed on feed shapes. Parameters
+are captured by reference, so `minimize` lowers to jax.value_and_grad over
+the replayed loss plus the eager optimizers' own `_update` rules — static
+and dynamic training share numerics exactly.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn.layer.layers import disable_static, enable_static, in_dynamic_mode
+from ..tensor import Tensor
+from ..jit import InputSpec  # noqa: F401  (paddle.static.InputSpec parity)
+
+
+class _StaticNode:
+    """One recorded op: replayable fwd + input refs (Variables or concrete
+    Tensors captured by reference, e.g. Parameters)."""
+
+    __slots__ = ("name", "fwd", "inputs", "n_out")
+
+    def __init__(self, name, fwd, inputs, n_out):
+        self.name = name
+        self.fwd = fwd
+        self.inputs = inputs
+        self.n_out = n_out
+
+
+class Variable(Tensor):
+    """Symbolic tensor: `_data` is a jax.ShapeDtypeStruct."""
+
+    __slots__ = ("_static_node", "_static_idx", "_feed_name")
+
+    def __init__(self, aval, name=None, node=None, idx=0, feed_name=None):
+        # bypass Tensor.__init__'s jnp.asarray (avals aren't arrays)
+        self._data = aval
+        self.stop_gradient = True
+        self.grad = None
+        self._node = None
+        self._out_index = 0
+        self.name = name
+        self.persistable = False
+        self._static_node = node
+        self._static_idx = idx
+        self._feed_name = feed_name
+
+    def numpy(self):
+        raise RuntimeError(
+            "static Variable has no value at graph-build time; run it "
+            "through Executor.run(feed=..., fetch_list=[...])")
+
+
+class Program:
+    """Parity: paddle.static.Program. Records optimize directives; the op
+    graph itself lives on the Variables (node links)."""
+
+    def __init__(self):
+        self._optimize = None          # (optimizer, loss_var, params)
+        self.random_seed = None
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        import copy
+        p = Program()
+        p._optimize = None if for_test else self._optimize
+        return p
+
+
+_main_program = [Program()]
+_startup_program = [Program()]
+
+
+def default_main_program() -> Program:
+    return _main_program[0]
+
+
+def default_startup_program() -> Program:
+    return _startup_program[0]
+
+
+class program_guard:
+    """Parity: paddle.static.program_guard."""
+
+    def __init__(self, main_program, startup_program=None):
+        self.main = main_program
+        self.startup = startup_program
+
+    def __enter__(self):
+        self._saved = (_main_program[0], _startup_program[0])
+        _main_program[0] = self.main
+        if self.startup is not None:
+            _startup_program[0] = self.startup
+        return self
+
+    def __exit__(self, *exc):
+        _main_program[0], _startup_program[0] = self._saved
+        return False
+
+
+def data(name: str, shape, dtype="float32", lod_level=0) -> Variable:
+    """Parity: paddle.static.data — a feed placeholder. None/-1 dims default
+    to 1 at compile time unless the feed provides the real size (the program
+    re-jits per feed shape)."""
+    from ..framework.dtype import convert_dtype
+    dims = tuple(1 if (d is None or d == -1) else int(d) for d in shape)
+    aval = jax.ShapeDtypeStruct(dims, convert_dtype(dtype))
+    return Variable(aval, name=name, feed_name=name)
+
+
+def record_static_op(name, fwd, tensor_inputs):
+    """Called by ops.dispatch when any input is symbolic: shape/dtype
+    inference via eval_shape (the InferMeta role), node recording."""
+    avals = tuple(
+        t._data if isinstance(t._data, jax.ShapeDtypeStruct)
+        else jax.ShapeDtypeStruct(t._data.shape, t._data.dtype)
+        for t in tensor_inputs)
+    out = jax.eval_shape(fwd, *avals)
+    node = _StaticNode(name, fwd, list(tensor_inputs),
+                       len(out) if isinstance(out, (tuple, list)) else 1)
+    if isinstance(out, (tuple, list)):
+        return tuple(Variable(a, node=node, idx=i)
+                     for i, a in enumerate(out))
+    return Variable(out, node=node)
+
+
+class Executor:
+    """Parity: paddle.static.Executor (base/executor.py:1237). `place` is
+    accepted and ignored — jax owns placement."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._jit_cache: Dict = {}
+
+    def run(self, program: Optional[Program] = None, feed: Optional[dict] = None,
+            fetch_list: Optional[Sequence] = None, return_numpy: bool = True):
+        program = program or default_main_program()
+        feed = feed or {}
+        fetch_list = list(fetch_list or [])
+        if not fetch_list and program._optimize is None:
+            return []  # startup program: params are already initialized
+
+        # collect graph inputs: feed placeholders + referenced parameters
+        opt_spec = program._optimize
+        params: List[Tensor] = []
+        seen: set = set()
+        roots = list(fetch_list) + ([opt_spec[1]] if opt_spec else [])
+        feed_vars: Dict[str, Variable] = {}
+
+        def visit(var):
+            node = getattr(var, "_static_node", None)
+            if getattr(var, "_feed_name", None):
+                feed_vars[var._feed_name] = var
+            if node is None or id(node) in seen:
+                return
+            seen.add(id(node))
+            for t in node.inputs:
+                if isinstance(t, Variable):
+                    visit(t)
+                elif not t.stop_gradient:
+                    if id(t) not in {id(p) for p in params}:
+                        params.append(t)
+
+        for r in roots:
+            if isinstance(r, Variable):
+                visit(r)
+        missing = [n for n in feed_vars if n not in feed]
+        if missing:
+            raise ValueError(f"feed is missing inputs: {missing}")
+
+        feed_names = sorted(feed_vars)
+        feed_arrays = [jnp.asarray(feed[n]) for n in feed_names]
+        # optimizer restriction from minimize(parameters=...)
+        if opt_spec is not None and opt_spec[2]:
+            allowed = {id(p) for p in opt_spec[2]}
+            params = [p for p in params if id(p) in allowed]
+        cache_key = (id(program), tuple(id(r) for r in roots),
+                     tuple((n, a.shape, str(a.dtype))
+                           for n, a in zip(feed_names, feed_arrays)))
+
+        def replay(param_arrays, *feeds):
+            env: Dict[int, object] = {}
+            pmap = {id(p): a for p, a in zip(params, param_arrays)}
+            fmap = dict(zip(feed_names, feeds))
+
+            def ev(t):
+                if isinstance(t, Variable):
+                    if t._feed_name is not None:
+                        return fmap[t._feed_name]
+                    node = t._static_node
+                    if node is None:
+                        raise ValueError(
+                            f"Variable {t.name!r} has no producer and no "
+                            "feed name")
+                    if id(node) not in env:
+                        env[id(node)] = node.fwd(*[ev(i)
+                                                   for i in node.inputs])
+                    out = env[id(node)]
+                    return out[t._static_idx] if node.n_out > 1 else out
+                return pmap.get(id(t), t._data)
+
+            return [ev(v) if isinstance(v, Variable) else jnp.asarray(v)
+                    for v in roots]
+
+        if opt_spec is None:
+            fn = self._jit_cache.get(cache_key)
+            if fn is None:
+                fn = self._jit_cache[cache_key] = jax.jit(replay)
+            outs = fn([p._data for p in params], *feed_arrays)
+        else:
+            optimizer, loss_var, _ = opt_spec
+            li = len(fetch_list)  # loss is the extra root
+            # current optimizer state, threaded THROUGH the jit (a closure
+            # would freeze the initial moments into the compiled program)
+            states = []
+            for p in params:
+                st = optimizer._accumulators.get(id(p))
+                if st is None:
+                    st = optimizer._init_state(p)
+                states.append({k: v for k, v in st.items() if k != "_step"})
+
+            def train_step(param_arrays, state_list, lr, step_i, *feeds):
+                def loss_of(pa):
+                    return replay(pa, *feeds)[li].astype(jnp.float32)
+
+                loss, grads = jax.value_and_grad(loss_of)(param_arrays)
+                # grad clipping must match the dygraph step exactly
+                from ..parallel.trainer import _clip_grads_functional
+                gdict = _clip_grads_functional(
+                    optimizer._grad_clip,
+                    {i: a for i, a in enumerate(param_arrays)},
+                    {i: g for i, g in enumerate(grads)})
+                grads = [gdict[i] for i in range(len(grads))]
+                new_params = []
+                new_states = []
+                for p, a, g, st in zip(params, param_arrays, grads,
+                                       state_list):
+                    np_, ns_ = optimizer._update(
+                        a, g.astype(a.dtype), st, lr,
+                        optimizer._wd_coeff(p), step_i)
+                    new_params.append(np_)
+                    new_states.append(ns_)
+                outs = replay(param_arrays, *feeds)[:li]
+                return loss, outs, new_params, new_states
+
+            fn = self._jit_cache.get(cache_key)
+            if fn is None:
+                fn = self._jit_cache[cache_key] = jax.jit(train_step)
+            optimizer._global_step += 1
+            loss, outs, new_params, new_states = fn(
+                [p._data for p in params], states,
+                jnp.float32(optimizer.get_lr()),
+                jnp.float32(optimizer._global_step), *feed_arrays)
+            for p, a, ns in zip(params, new_params, new_states):
+                p._data = a
+                ns = dict(ns)
+                ns["_step"] = optimizer._global_step
+                optimizer._accumulators[id(p)] = ns
+            outs = list(outs)  # exactly the user's fetch_list entries
+
+        if return_numpy:
+            return [np.asarray(o) for o in outs]
+        return [Tensor(o) for o in outs]
+
+    def close(self):
+        pass
+
+
+def append_backward(loss, parameter_list=None):
+    """Parity: paddle.static.append_backward — here gradients are derived at
+    run time by jax.value_and_grad; this records nothing but validates."""
+    if not isinstance(loss, Variable):
+        raise TypeError("append_backward expects a static Variable loss")
+    return []
+
+
+class CompiledProgram:
+    """Parity shim: paddle.static.CompiledProgram — programs are always
+    compiled (XLA)."""
+
+    def __init__(self, program, build_strategy=None):
+        self.program = program
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
+                         program=None):
+    raise NotImplementedError(
+        "static save_inference_model: export the dygraph layer with "
+        "paddle_tpu.jit.save (StableHLO artifact) instead")
+
+
+def load_inference_model(path_prefix, executor):
+    raise NotImplementedError(
+        "static load_inference_model: use paddle_tpu.jit.load / "
+        "paddle_tpu.inference.create_predictor")
+
+
+def gradients(targets, inputs, target_gradients=None):
+    raise NotImplementedError(
+        "static.gradients: wrap the computation in a function and use "
+        "paddle_tpu.autograd.grad (functional AD)")
+
+
+def name_scope(prefix):
+    import contextlib
+    return contextlib.nullcontext()
+
+
+__all__ = [
+    "Program", "program_guard", "default_main_program",
+    "default_startup_program", "data", "Executor", "append_backward",
+    "CompiledProgram", "InputSpec", "enable_static", "disable_static",
+    "in_dynamic_mode", "name_scope", "save_inference_model",
+    "load_inference_model", "gradients",
+]

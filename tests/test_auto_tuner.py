@@ -1,0 +1,75 @@
+"""Auto-tuner: candidate pruning + measured trials on the 8-device CPU mesh
+(reference: distributed/auto_tuner/tuner.py:21)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import optimizer as opt
+from paddle_tpu.distributed.auto_tuner import AutoTuner, TuneSpec
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.parallel import SpmdTrainer, make_hybrid_mesh
+
+
+def _spec(**kw):
+    d = dict(n_devices=8, num_layers=4, num_heads=4, hidden_size=32,
+             intermediate_size=64, vocab_size=64, global_batch=8, seq_len=16)
+    d.update(kw)
+    return TuneSpec(**d)
+
+
+def test_candidates_respect_constraints():
+    tuner = AutoTuner(_spec())
+    cands = tuner.search_space()
+    assert cands, "search space empty"
+    for c in cands:
+        assert c.dp * c.mp * c.pp * c.sharding == 8
+        assert 4 % c.mp == 0 and 4 % c.pp == 0
+        assert 8 % (c.dp * c.sharding) == 0
+
+
+def test_prunes_indivisible_heads():
+    cands = AutoTuner(_spec(num_heads=3)).search_space()
+    assert all(c.mp == 1 for c in cands)
+
+
+def test_memory_bound_prunes_pure_dp():
+    # 7B-class params cannot fit replicated on a 16GB chip: dp=8 must be
+    # pruned while sharded configs survive
+    spec = _spec(hidden_size=4096, intermediate_size=11008, num_layers=32,
+                 num_heads=32, vocab_size=32000, global_batch=64,
+                 seq_len=2048)
+    cands = AutoTuner(spec).search_space()
+    assert cands
+    assert all(c.mp * c.pp * c.sharding > 1 for c in cands)
+
+
+def test_measured_trials_pick_runnable_config():
+    spec = _spec()
+    tuner = AutoTuner(spec)
+    rng = np.random.default_rng(0)
+    ids_np = rng.integers(0, 64, (8, 16)).astype(np.int32)
+
+    def trial(cfg_dict):
+        paddle.seed(1)
+        cfg = LlamaConfig.tiny(vocab_size=64, hidden_size=32, layers=4,
+                               heads=4, kv_heads=4, seq=16)
+        cfg.use_flash_attention = False
+        model = LlamaForCausalLM(cfg)
+        o = opt.SGD(learning_rate=0.01, parameters=model.parameters())
+        mesh = make_hybrid_mesh(dp=cfg_dict["dp"], mp=cfg_dict["mp"],
+                                sharding=cfg_dict["sharding"])
+        if cfg_dict["pp"] > 1:
+            raise RuntimeError("trial skips pp for speed")
+        tr = SpmdTrainer(model, o,
+                         lambda m, x, y: m.compute_loss(m(x), y), mesh=mesh)
+        ids = paddle.to_tensor(ids_np)
+        import time
+        tr.train_step(ids, ids)
+        tr.block()
+        t0 = time.perf_counter()
+        tr.train_step(ids, ids)
+        tr.block()
+        return ids_np.size / (time.perf_counter() - t0)
+
+    best = tuner.tune(trial_fn=trial, max_trials=3)
+    assert best.throughput is not None and best.throughput > 0
+    assert best.dp * best.mp * best.pp * best.sharding == 8

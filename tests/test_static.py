@@ -1,0 +1,115 @@
+"""paddle.static analog: symbolic Program build + Executor.run (reference:
+python/paddle/static, base/executor.py:1237). Static and dygraph must share
+numerics exactly (same op implementations, same optimizer rules)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import optimizer as opt
+from paddle_tpu import static
+
+
+def _mlp(seed=3):
+    paddle.seed(seed)
+    return nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+
+
+def test_static_forward_matches_eager():
+    m = _mlp()
+    x_np = np.random.default_rng(0).standard_normal((5, 8)).astype(np.float32)
+    eager_out = m(paddle.to_tensor(x_np)).numpy()
+
+    paddle.enable_static()
+    try:
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [None, 8], "float32")
+            y = m(x)
+        exe = static.Executor()
+        exe.run(static.default_startup_program())
+        out, = exe.run(prog, feed={"x": x_np}, fetch_list=[y])
+    finally:
+        paddle.disable_static()
+    np.testing.assert_allclose(out, eager_out, rtol=1e-5, atol=1e-6)
+
+
+def test_static_feed_shape_rejit():
+    m = _mlp()
+    paddle.enable_static()
+    try:
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [None, 8], "float32")
+            y = m(x)
+        exe = static.Executor()
+        for b in (2, 7):
+            x_np = np.random.default_rng(b).standard_normal(
+                (b, 8)).astype(np.float32)
+            out, = exe.run(prog, feed={"x": x_np}, fetch_list=[y])
+            assert out.shape == (b, 4)
+            np.testing.assert_allclose(
+                out, m(paddle.to_tensor(x_np)).numpy(), rtol=1e-5, atol=1e-6)
+    finally:
+        paddle.disable_static()
+
+
+def test_static_training_matches_eager():
+    """3 SGD steps in static mode == 3 eager steps, same init."""
+    rng = np.random.default_rng(1)
+    x_np = rng.standard_normal((16, 8)).astype(np.float32)
+    y_np = rng.integers(0, 4, 16).astype(np.int64)
+
+    # eager reference
+    m1 = _mlp(seed=9)
+    o1 = opt.SGD(learning_rate=0.1, parameters=m1.parameters())
+    eager_losses = []
+    for _ in range(3):
+        loss = nn.CrossEntropyLoss()(m1(paddle.to_tensor(x_np)),
+                                     paddle.to_tensor(y_np))
+        eager_losses.append(float(loss.numpy()))
+        loss.backward()
+        o1.step()
+        o1.clear_grad()
+
+    # static
+    m2 = _mlp(seed=9)
+    o2 = opt.SGD(learning_rate=0.1, parameters=m2.parameters())
+    paddle.enable_static()
+    try:
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [16, 8], "float32")
+            yl = static.data("y", [16], "int64")
+            loss = nn.CrossEntropyLoss()(m2(x), yl)
+            o2.minimize(loss)
+        exe = static.Executor()
+        exe.run(static.default_startup_program())
+        static_losses = []
+        for _ in range(3):
+            lv, = exe.run(prog, feed={"x": x_np, "y": y_np},
+                          fetch_list=[loss])
+            static_losses.append(float(lv))
+    finally:
+        paddle.disable_static()
+    np.testing.assert_allclose(static_losses, eager_losses, rtol=2e-5,
+                               atol=2e-6)
+
+
+def test_static_variable_guards():
+    paddle.enable_static()
+    try:
+        x = static.data("x", [2, 3], "float32")
+        try:
+            x.numpy()
+            raise AssertionError("expected RuntimeError")
+        except RuntimeError:
+            pass
+        exe = static.Executor()
+        y = paddle.exp(x)
+        try:
+            exe.run(static.Program(), feed={}, fetch_list=[y])
+            raise AssertionError("expected missing-feed error")
+        except ValueError as e:
+            assert "missing" in str(e)
+    finally:
+        paddle.disable_static()
