@@ -260,6 +260,7 @@ class PipelineParallel(MetaParallelBase):
         self.accumulate_steps = cfg.get("accumulate_steps", 1)
         self.micro_batch_size = cfg.get("micro_batch_size", 1)
         self._pp_trainer = None
+        self._pp_key = None
 
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
         """One pipeline step over `accumulate_steps` microbatches.
@@ -276,15 +277,25 @@ class PipelineParallel(MetaParallelBase):
         # uses the eager accumulation fallback (scaler semantics preserved).
         if scaler is None and hasattr(self._layers, "pp_block_layers") and \
                 hasattr(self._layers, "pp_install"):
-            if self._pp_trainer is None:
-                from ...parallel import PipelinedTrainer
-                from ...distributed import get_mesh
-                inner = getattr(optimizer, "_inner_opt", optimizer)
+            from ...parallel import PipelinedTrainer
+            from ...distributed import get_mesh
+            inner = getattr(optimizer, "_inner_opt", optimizer)
+            mesh = get_mesh()
+            key = (id(inner), id(mesh), max(self.accumulate_steps, 1))
+            if self._pp_trainer is None or self._pp_key != key:
+                # rebuild on optimizer/mesh/accumulation change — a cached
+                # trainer would silently keep stale settings
                 self._pp_trainer = PipelinedTrainer(
                     self._layers, inner,
                     lambda m, x, y: m.compute_loss(m(x), y),
-                    mesh=get_mesh(), n_micro=max(self.accumulate_steps, 1))
+                    mesh=mesh, n_micro=max(self.accumulate_steps, 1))
+                self._pp_key = key
             loss = self._pp_trainer.train_step(inputs, labels)
+            # keep the wrapped model/optimizer externally consistent: the
+            # trainer owns stacked copies of the block params and its own
+            # moments; state_dict()/paddle.save must see trained values
+            self._pp_trainer.sync_model()
+            self._pp_trainer.sync_optimizer_state()
             if lr_scheduler is not None:
                 lr_scheduler.step()
             return loss

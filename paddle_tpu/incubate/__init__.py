@@ -3,3 +3,4 @@ from . import distributed, nn  # noqa: F401
 from .segment_ops import (  # noqa: F401
     segment_max, segment_mean, segment_min, segment_sum, send_u_recv,
 )
+from . import asp  # noqa: F401

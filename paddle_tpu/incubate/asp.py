@@ -1,0 +1,85 @@
+"""ASP — automatic structured (n:m) sparsity.
+
+Reference parity: python/paddle/incubate/asp/ (prune_model, decorate,
+calculate_density; 2:4 masks for sparse-tensor-core GEMMs). TPU-native note:
+the MXU has no 2:4 sparse mode, so the masks' value here is model-size/
+regularization parity and checkpoint compatibility — masks are applied as
+elementwise multiplies that XLA fuses into the surrounding matmuls.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn.layer.layers import Layer
+from ..tensor import Tensor
+
+_masks: Dict[int, jnp.ndarray] = {}
+_excluded: List[str] = []
+
+
+def calculate_density(x) -> float:
+    """Parity: paddle.incubate.asp.calculate_density."""
+    arr = np.asarray(x._data if isinstance(x, Tensor) else x)
+    return float((arr != 0).sum() / arr.size)
+
+
+def _nm_mask(w: np.ndarray, n: int, m: int) -> np.ndarray:
+    """Keep the n largest-|w| entries of every group of m along dim 0
+    (the reduction dim of a [in, out] Linear weight — reference mask_1d)."""
+    rows, cols = w.shape
+    if rows % m:
+        return np.ones_like(w, dtype=bool)
+    g = np.abs(w).reshape(rows // m, m, cols)
+    order = np.argsort(-g, axis=1)
+    mask = np.zeros_like(g, dtype=bool)
+    np.put_along_axis(mask, order[:, :n], True, axis=1)
+    return mask.reshape(rows, cols)
+
+
+def set_excluded_layers(param_names: List[str], main_program=None):
+    _excluded.extend(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    _excluded.clear()
+
+
+def prune_model(model: Layer, n: int = 2, m: int = 4, mask_algo: str = "mask_1d",
+                with_mask: bool = True) -> Dict[str, float]:
+    """Apply n:m masks to every 2-D Linear-style weight. Returns per-param
+    density after pruning (reference returns the mask dict; density is the
+    useful diagnostic)."""
+    out = {}
+    for name, p in model.named_parameters():
+        if p._data.ndim != 2 or any(name.startswith(e) or name == e
+                                    for e in _excluded):
+            continue
+        w = np.asarray(p._data)
+        mask = _nm_mask(w, n, m)
+        p._data = jnp.asarray(w * mask)
+        if with_mask:
+            _masks[id(p)] = jnp.asarray(mask, p._data.dtype)
+        out[name] = calculate_density(p)
+    return out
+
+
+def decorate(optimizer):
+    """Parity: asp.decorate — re-applies masks after every optimizer step so
+    pruned weights stay zero through training."""
+    orig_step = optimizer.step
+
+    def step():
+        orig_step()
+        for p in optimizer._parameter_list:
+            mk = _masks.get(id(p))
+            if mk is not None:
+                p._data = p._data * mk
+    optimizer.step = step
+    return optimizer
+
+
+__all__ = ["calculate_density", "prune_model", "decorate",
+           "set_excluded_layers", "reset_excluded_layers"]

@@ -1,1 +1,2 @@
 from . import models  # noqa: F401
+from .host_embedding import HostEmbedding  # noqa: F401

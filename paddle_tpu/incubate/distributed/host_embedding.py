@@ -1,0 +1,96 @@
+"""HostEmbedding: larger-than-HBM embedding with row-sparse host updates.
+
+Reference parity: the sparse-table core of the parameter server
+(fluid/distributed/ps/table/ memory_sparse_table; python
+paddle.static.nn.sparse_embedding) — see distributed/DESIGN_PS.md for the
+scope decision. The table lives in host RAM (numpy); each step gathers only
+the touched rows to the device, and the backward applies a row-sparse
+update on the host (SGD or Adagrad), so HBM cost is O(batch-unique-ids),
+not O(vocab).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...nn.layer.layers import Layer
+from ...ops.dispatch import dispatch
+from ...tensor import Tensor
+
+
+class HostEmbedding(Layer):
+    """Embedding whose weight never leaves the host in full.
+
+    forward(ids) gathers rows; apply_sparse_grad() (called by the layer's
+    backward hook) scatters the row gradients back with a built-in sparse
+    optimizer — the PS "push" without a server.
+    """
+
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 optimizer: str = "sgd", learning_rate: float = 0.01,
+                 initializer_range: float = 0.02, seed: int = 0, name=None):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        rng = np.random.default_rng(seed)
+        self.table = rng.normal(
+            0.0, initializer_range,
+            (num_embeddings, embedding_dim)).astype(np.float32)
+        if optimizer not in ("sgd", "adagrad"):
+            raise ValueError("optimizer must be sgd or adagrad")
+        self.optimizer = optimizer
+        self.learning_rate = learning_rate
+        self._g2 = np.zeros(num_embeddings, np.float32) \
+            if optimizer == "adagrad" else None
+
+    def forward(self, ids):
+        ids_t = ids if isinstance(ids, Tensor) else Tensor(ids)
+        ids_np = np.asarray(ids_t._data).astype(np.int64)
+        flat, inverse = np.unique(ids_np.reshape(-1), return_inverse=True)
+        # only the touched rows travel host -> HBM; differentiable so the
+        # tape produces d_rows for the sparse push
+        rows = Tensor(jnp.asarray(self.table[flat]), stop_gradient=False)
+        inv = jnp.asarray(inverse.astype(np.int32))
+        layer = self
+
+        def fwd(rows_arr):
+            return rows_arr[inv].reshape(ids_np.shape + (layer.embedding_dim,))
+
+        out = dispatch("host_embedding_gather", fwd, rows)
+        node = out._node
+        if node is not None:
+            # row-sparse "push": route the row cotangents into the host-side
+            # sparse update as they are computed (PS push without a server)
+            orig_vjp = node.vjp_fn
+
+            def vjp_and_push(g):
+                (d_rows,) = orig_vjp(g)
+                layer.apply_sparse_grad(flat, np.asarray(d_rows))
+                return (d_rows,)
+
+            node.vjp_fn = vjp_and_push
+        return out
+
+    def apply_sparse_grad(self, row_ids: np.ndarray, row_grads: np.ndarray):
+        """Update only the touched rows (PS sparse-table push semantics)."""
+        if self.optimizer == "sgd":
+            self.table[row_ids] -= self.learning_rate * row_grads
+            return
+        g2 = (row_grads ** 2).mean(axis=1)
+        self._g2[row_ids] += g2
+        scale = self.learning_rate / np.sqrt(self._g2[row_ids] + 1e-10)
+        self.table[row_ids] -= scale[:, None] * row_grads
+
+    def lookup(self, ids: np.ndarray) -> np.ndarray:
+        return self.table[np.asarray(ids).astype(np.int64)]
+
+    def state_dict(self, *a, **k):
+        return {"table": Tensor(jnp.asarray(self.table))}
+
+    def set_state_dict(self, sd, *a, **k):
+        self.table = np.asarray(sd["table"]._data
+                                if isinstance(sd["table"], Tensor)
+                                else sd["table"]).copy()
+
+
+__all__ = ["HostEmbedding"]

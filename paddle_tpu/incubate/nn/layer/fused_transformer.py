@@ -1,0 +1,175 @@
+"""Fused transformer layers.
+
+Reference parity: python/paddle/incubate/nn/layer/fused_transformer.py
+(FusedMultiHeadAttention, FusedFeedForward, FusedTransformerEncoderLayer —
+backed by fused_attention/fused_feedforward CUDA kernels,
+phi/kernels/fusion/gpu/fused_attention_kernel.cu). TPU-native: "fused" means
+ONE traced region whose attention core is the Pallas flash kernel and whose
+norm/bias/residual/dropout chain XLA fuses — the packed-QKV single matmul is
+kept because it is the part XLA cannot re-associate by itself.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from ....nn import functional as F
+from ....nn.initializer import Constant, XavierNormal
+from ....nn.layer.layers import Layer
+from ....tensor import Tensor
+
+
+class FusedMultiHeadAttention(Layer):
+    """Parity: incubate.nn.FusedMultiHeadAttention — pre/post-LN + packed QKV
+    projection + attention + out projection + residual, one traced region."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False, qkv_weight_attr=None,
+                 qkv_bias_attr=None, linear_weight_attr=None,
+                 linear_bias_attr=None, pre_ln_scale_attr=None,
+                 pre_ln_bias_attr=None, ln_scale_attr=None, ln_bias_attr=None,
+                 epsilon=1e-5, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        if embed_dim % num_heads:
+            raise ValueError(
+                f"embed_dim ({embed_dim}) must be divisible by num_heads "
+                f"({num_heads})")
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
+        self._epsilon = epsilon
+        # packed [3, heads, head_dim, embed] like the reference kernel layout
+        self.qkv_weight = self.create_parameter(
+            [3, num_heads, self.head_dim, embed_dim], attr=qkv_weight_attr,
+            default_initializer=XavierNormal())
+        self.qkv_bias = self.create_parameter(
+            [3, num_heads, self.head_dim], attr=qkv_bias_attr, is_bias=True)
+        self.linear_weight = self.create_parameter(
+            [embed_dim, embed_dim], attr=linear_weight_attr,
+            default_initializer=XavierNormal())
+        self.linear_bias = self.create_parameter(
+            [embed_dim], attr=linear_bias_attr, is_bias=True)
+        self.pre_ln_scale = self.create_parameter(
+            [embed_dim], attr=pre_ln_scale_attr,
+            default_initializer=Constant(1.0))
+        self.pre_ln_bias = self.create_parameter(
+            [embed_dim], attr=pre_ln_bias_attr, is_bias=True)
+        self.ln_scale = self.create_parameter(
+            [embed_dim], attr=ln_scale_attr,
+            default_initializer=Constant(1.0))
+        self.ln_bias = self.create_parameter(
+            [embed_dim], attr=ln_bias_attr, is_bias=True)
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                cache=None):
+        x = query
+        residual = x
+        if self.normalize_before:
+            x = F.layer_norm(x, [self.embed_dim], self.pre_ln_scale,
+                             self.pre_ln_bias, self._epsilon)
+        b, s, _ = x.shape
+        from ....ops.manipulation import reshape
+        from ....ops.linalg import matmul
+        w = reshape(self.qkv_weight, [3 * self.embed_dim, self.embed_dim])
+        qkv = matmul(x, w, transpose_y=True) + \
+            reshape(self.qkv_bias, [3 * self.embed_dim])
+        qkv = reshape(qkv, [b, s, 3, self.num_heads, self.head_dim])
+        q = qkv[:, :, 0]
+        k = qkv[:, :, 1]
+        v = qkv[:, :, 2]
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, dropout_p=self.attn_dropout_rate,
+            is_causal=False, training=self.training)
+        out = reshape(out, [b, s, self.embed_dim])
+        out = matmul(out, self.linear_weight) + self.linear_bias
+        out = F.dropout(out, self.dropout_rate, training=self.training)
+        out = residual + out
+        if not self.normalize_before:
+            out = F.layer_norm(out, [self.embed_dim], self.ln_scale,
+                               self.ln_bias, self._epsilon)
+        return out
+
+
+class FusedFeedForward(Layer):
+    """Parity: incubate.nn.FusedFeedForward (fused_feedforward_kernel.cu)."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None, ln2_bias_attr=None,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.d_model = d_model
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.act_dropout_rate = act_dropout_rate if act_dropout_rate is not \
+            None else dropout_rate
+        self.activation = activation
+        self._epsilon = epsilon
+        self.linear1_weight = self.create_parameter(
+            [d_model, dim_feedforward], attr=linear1_weight_attr,
+            default_initializer=XavierNormal())
+        self.linear1_bias = self.create_parameter(
+            [dim_feedforward], attr=linear1_bias_attr, is_bias=True)
+        self.linear2_weight = self.create_parameter(
+            [dim_feedforward, d_model], attr=linear2_weight_attr,
+            default_initializer=XavierNormal())
+        self.linear2_bias = self.create_parameter(
+            [d_model], attr=linear2_bias_attr, is_bias=True)
+        self.ln1_scale = self.create_parameter(
+            [d_model], attr=ln1_scale_attr, default_initializer=Constant(1.0))
+        self.ln1_bias = self.create_parameter([d_model], attr=ln1_bias_attr,
+                                              is_bias=True)
+        self.ln2_scale = self.create_parameter(
+            [d_model], attr=ln2_scale_attr, default_initializer=Constant(1.0))
+        self.ln2_bias = self.create_parameter([d_model], attr=ln2_bias_attr,
+                                              is_bias=True)
+
+    def forward(self, src, cache=None):
+        residual = src
+        x = src
+        if self.normalize_before:
+            x = F.layer_norm(x, [self.d_model], self.ln1_scale, self.ln1_bias,
+                             self._epsilon)
+        x = F.linear(x, self.linear1_weight, self.linear1_bias)
+        x = getattr(F, self.activation)(x)
+        x = F.dropout(x, self.act_dropout_rate, training=self.training)
+        x = F.linear(x, self.linear2_weight, self.linear2_bias)
+        x = F.dropout(x, self.dropout_rate, training=self.training)
+        x = residual + x
+        if not self.normalize_before:
+            x = F.layer_norm(x, [self.d_model], self.ln2_scale, self.ln2_bias,
+                             self._epsilon)
+        return x
+
+
+class FusedTransformerEncoderLayer(Layer):
+    """Parity: incubate.nn.FusedTransformerEncoderLayer."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False,
+                 weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead,
+            dropout_rate=dropout_rate,
+            attn_dropout_rate=attn_dropout_rate if attn_dropout_rate is not
+            None else dropout_rate,
+            normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation,
+            act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        out = self.fused_attn(src, attn_mask=src_mask)
+        return self.ffn(out)

@@ -84,7 +84,9 @@ def _check_numerics(name, out):
     too; eager path raises synchronously."""
     arrays = out if isinstance(out, (tuple, list)) else (out,)
     for a in arrays:
-        if hasattr(a, "dtype") and a.dtype.kind == "f":
+        # issubdtype, not dtype.kind: bfloat16's numpy kind is 'V', and bf16
+        # is exactly the dtype the AMP-O2/bench path trains in
+        if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating):
             bad = ~jnp.isfinite(a).all()
             if isinstance(bad, jax.core.Tracer):
                 jax.debug.callback(_nan_report, name, bad)

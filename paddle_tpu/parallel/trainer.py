@@ -262,12 +262,21 @@ class SpmdTrainer:
         """Shared step epilogue: grad clip + per-param optimizer update."""
         opt = self.opt
         grads = _clip_grads_functional(opt._grad_clip, params, grads)
+        # ASP: n:m sparsity masks survive compiled updates too (the eager
+        # path reapplies them in the decorated step(); see incubate/asp.py)
+        import sys
+        asp = sys.modules.get("paddle_tpu.incubate.asp")
+        asp_masks = asp._masks if asp is not None and asp._masks else None
         new_params, new_state = {}, {}
         for n in self._param_list:
             p = params[n]
             g = grads[n].astype(p.dtype)
             np_, ns_ = opt._update(p, g, opt_state[n],
                                    lr * self._lr_mult(n), self._wd(n), step_i)
+            if asp_masks is not None:
+                mk = asp_masks.get(id(self._params[n]))
+                if mk is not None:
+                    np_ = np_ * mk.astype(np_.dtype)
             new_params[n] = np_
             new_state[n] = ns_
         return new_params, new_state

@@ -184,10 +184,15 @@ class Executor:
 
         feed_names = sorted(feed_vars)
         feed_arrays = [jnp.asarray(feed[n]) for n in feed_names]
-        # optimizer restriction from minimize(parameters=...)
-        if opt_spec is not None and opt_spec[2]:
-            allowed = {id(p) for p in opt_spec[2]}
-            params = [p for p in params if id(p) in allowed]
+        # optimizer restriction: minimize(parameters=...) or the optimizer's
+        # own parameter list (frozen-backbone training must not update
+        # reachable-but-unlisted tensors; parity with eager step())
+        if opt_spec is not None:
+            restrict = opt_spec[2] or getattr(opt_spec[0], "_parameter_list",
+                                              None)
+            if restrict:
+                allowed = {id(p) for p in restrict}
+                params = [p for p in params if id(p) in allowed]
         cache_key = (id(program), tuple(id(r) for r in roots),
                      tuple((n, a.shape, str(a.dtype))
                            for n, a in zip(feed_names, feed_arrays)))
@@ -249,8 +254,10 @@ class Executor:
                 new_states = []
                 for p, a, g, st in zip(params, param_arrays, grads,
                                        state_list):
+                    mult = (getattr(p, "optimize_attr", None) or
+                            {}).get("learning_rate", 1.0)
                     np_, ns_ = optimizer._update(
-                        a, g.astype(a.dtype), st, lr,
+                        a, g.astype(a.dtype), st, lr * mult,
                         optimizer._wd_coeff(p), step_i)
                     new_params.append(np_)
                     new_states.append(ns_)

@@ -1,0 +1,59 @@
+"""HostEmbedding: larger-than-HBM sparse table (PS sparse-table analog,
+see distributed/DESIGN_PS.md)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.incubate.distributed import HostEmbedding
+
+
+def test_gather_matches_table():
+    emb = HostEmbedding(100, 8, seed=1)
+    ids = np.array([[3, 7], [7, 99]], np.int64)
+    out = emb(paddle.to_tensor(ids)).numpy()
+    np.testing.assert_allclose(out, emb.lookup(ids), rtol=1e-6)
+
+
+def test_sparse_update_touches_only_used_rows():
+    emb = HostEmbedding(50, 4, optimizer="sgd", learning_rate=0.5, seed=2)
+    before = emb.table.copy()
+    ids = np.array([[1, 2, 2]], np.int64)
+    out = emb(paddle.to_tensor(ids))
+    out.sum().backward()
+    used = [1, 2]
+    untouched = [i for i in range(50) if i not in used]
+    np.testing.assert_array_equal(emb.table[untouched], before[untouched])
+    assert (emb.table[used] != before[used]).any()
+    # duplicate id 2 accumulates both occurrences' grads (sum of ones = 2)
+    np.testing.assert_allclose(before[2] - emb.table[2], 0.5 * 2.0,
+                               rtol=1e-6)
+    np.testing.assert_allclose(before[1] - emb.table[1], 0.5 * 1.0,
+                               rtol=1e-6)
+
+
+def test_trains_with_downstream_layers():
+    paddle.seed(4)
+    emb = HostEmbedding(30, 8, optimizer="adagrad", learning_rate=0.1, seed=3)
+    head = nn.Linear(8, 2)
+    from paddle_tpu import optimizer as opt
+    o = opt.SGD(learning_rate=0.1, parameters=head.parameters())
+    ids = paddle.to_tensor(np.random.default_rng(0)
+                           .integers(0, 30, (8,)).astype(np.int64))
+    y = paddle.to_tensor(np.random.default_rng(1).integers(0, 2, 8))
+    losses = []
+    for _ in range(10):
+        logits = head(emb(ids))
+        loss = nn.CrossEntropyLoss()(logits, y)
+        losses.append(float(loss.numpy()))
+        loss.backward()
+        o.step()
+        o.clear_grad()
+    assert losses[-1] < losses[0] * 0.8
+
+
+def test_state_dict_roundtrip():
+    emb = HostEmbedding(10, 4, seed=5)
+    sd = emb.state_dict()
+    emb2 = HostEmbedding(10, 4, seed=6)
+    emb2.set_state_dict(sd)
+    np.testing.assert_array_equal(emb.table, emb2.table)

@@ -1,0 +1,161 @@
+"""Watchdog (hang + heartbeat), ASP 2:4 sparsity, fused transformer layers."""
+import io
+import time
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import optimizer as opt
+
+
+def test_step_watchdog_fires_and_ticks():
+    from paddle_tpu.distributed.watchdog import StepWatchdog
+    fired = []
+    wd = StepWatchdog(timeout=0.3, on_hang=lambda: fired.append(1),
+                      poll_interval=0.05).start()
+    try:
+        for _ in range(5):  # active ticking: no fire
+            wd.tick()
+            time.sleep(0.1)
+        assert not fired
+        time.sleep(0.8)  # silence: must fire
+        assert fired
+    finally:
+        wd.stop()
+
+
+def test_step_watchdog_wraps_trainer():
+    from paddle_tpu.distributed.watchdog import StepWatchdog
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.parallel import SpmdTrainer
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(vocab_size=32, hidden_size=16, layers=1, heads=2,
+                           kv_heads=2, seq=8)
+    cfg.use_flash_attention = False
+    m = LlamaForCausalLM(cfg)
+    o = opt.SGD(learning_rate=0.1, parameters=m.parameters())
+    tr = SpmdTrainer(m, o, lambda mm, x, y: mm.compute_loss(mm(x), y),
+                     mesh=None)
+    wd = StepWatchdog(timeout=60.0)
+    wd.wrap(tr)
+    try:
+        ids = paddle.to_tensor(np.zeros((2, 8), np.int32))
+        before = wd._last
+        tr.train_step(ids, ids)
+        assert wd._last >= before
+        assert wd.fired == 0
+    finally:
+        wd.stop()
+
+
+def test_heartbeat_detects_dead_peer():
+    from paddle_tpu.distributed.store import TCPStore
+    from paddle_tpu.distributed.watchdog import Heartbeat
+    master = TCPStore("127.0.0.1", 0, is_master=True, world_size=2)
+    hb0 = Heartbeat(master, rank=0, world=2, interval=0.1)
+    hb1 = Heartbeat(master, rank=1, world=2, interval=0.1)
+    hb0.start()
+    hb1.start()
+    try:
+        time.sleep(0.3)
+        assert hb0.dead_peers() == []
+        hb1.stop()
+        time.sleep(0.6)
+        assert hb0.dead_peers(stale_after=0.4) == [1]
+    finally:
+        hb0.stop()
+        master.stop()
+
+
+def test_asp_prune_and_decorate():
+    import paddle_tpu.incubate.asp as asp
+    paddle.seed(1)
+    m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    dens = asp.prune_model(m, n=2, m=4)
+    assert dens, "no params pruned"
+    for name, d in dens.items():
+        assert abs(d - 0.5) < 1e-6, (name, d)
+    # per group of 4 along dim0: exactly 2 nonzero
+    w = np.asarray(m[0].weight.numpy())
+    groups = (w != 0).reshape(w.shape[0] // 4, 4, w.shape[1]).sum(1)
+    assert (groups == 2).all()
+
+    o = asp.decorate(opt.SGD(learning_rate=0.1, parameters=m.parameters()))
+    x = paddle.to_tensor(np.random.default_rng(0)
+                         .standard_normal((4, 8)).astype(np.float32))
+    y = paddle.to_tensor(np.random.default_rng(1).integers(0, 4, 4))
+    loss = nn.CrossEntropyLoss()(m(x), y)
+    loss.backward()
+    o.step()
+    w2 = np.asarray(m[0].weight.numpy())
+    assert ((w == 0) >= (w2 != 0)).all() or ((w2 != 0) <= (w != 0)).all()
+    np.testing.assert_array_equal(w2 != 0, w != 0)  # mask preserved
+    assert abs(asp.calculate_density(m[0].weight) - 0.5) < 1e-6
+
+
+def test_asp_masks_survive_compiled_trainer():
+    """Masks must hold through SpmdTrainer's compiled functional updates,
+    not only the eager decorated step()."""
+    import paddle_tpu.incubate.asp as asp
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.parallel import SpmdTrainer
+    paddle.seed(3)
+    cfg = LlamaConfig.tiny(vocab_size=32, hidden_size=16, layers=1, heads=2,
+                           kv_heads=2, seq=8)
+    cfg.use_flash_attention = False
+    m = LlamaForCausalLM(cfg)
+    asp.prune_model(m, n=2, m=4)
+    w_name = "model.layers.0.mlp.gate_proj.weight"
+    w0 = np.asarray(
+        dict(m.named_parameters())[w_name].numpy()).copy()  # pre-train snap
+    zero_pattern = w0 == 0
+    o = opt.SGD(learning_rate=0.1, parameters=m.parameters())
+    tr = SpmdTrainer(m, o, lambda mm, x, y: mm.compute_loss(mm(x), y),
+                     mesh=None)
+    ids = paddle.to_tensor(np.random.default_rng(4)
+                           .integers(0, 32, (2, 8)).astype(np.int32))
+    tr.train_step(ids, ids)
+    tr.block()
+    w1 = np.asarray(tr._params[w_name]._data)
+    assert (w1[zero_pattern] == 0).all(), "pruned weights drifted nonzero"
+    assert (w1[~zero_pattern] != w0[~zero_pattern]).any()
+    asp._masks.clear()
+
+
+def test_nan_check_covers_bfloat16():
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        bad = paddle.to_tensor(np.array([1.0], np.float32)).astype("bfloat16")
+        try:
+            (bad / paddle.to_tensor(np.array([0.0], np.float32))
+             .astype("bfloat16"))
+            raise AssertionError("bf16 inf escaped the check")
+        except FloatingPointError:
+            pass
+    finally:
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_fused_transformer_layers():
+    from paddle_tpu.incubate.nn import (FusedFeedForward,
+                                        FusedMultiHeadAttention,
+                                        FusedTransformerEncoderLayer)
+    paddle.seed(2)
+    x = paddle.to_tensor(np.random.default_rng(2)
+                         .standard_normal((2, 6, 16)).astype(np.float32))
+    mha = FusedMultiHeadAttention(16, 4, dropout_rate=0.0,
+                                  attn_dropout_rate=0.0)
+    out = mha(x)
+    assert tuple(out.shape) == (2, 6, 16)
+    out.sum().backward()
+    assert mha.qkv_weight.grad is not None
+
+    ffn = FusedFeedForward(16, 32, dropout_rate=0.0)
+    y = ffn(x)
+    assert tuple(y.shape) == (2, 6, 16)
+
+    enc = FusedTransformerEncoderLayer(16, 4, 32, dropout_rate=0.0)
+    z = enc(x)
+    assert tuple(z.shape) == (2, 6, 16)
+    z.sum().backward()

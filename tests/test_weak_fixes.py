@@ -89,6 +89,14 @@ def test_fleet_pipeline_routes_to_compiled():
         dist.set_mesh(None)
     np.testing.assert_allclose(got, ref, rtol=3e-4, atol=3e-5)
     assert pp._pp_trainer is not None  # compiled pipeline actually used
+    # trained block weights must be visible through the model (sync_model)
+    w_serial = np.asarray(
+        dict(m1.named_parameters())
+        ["model.layers.0.self_attn.q_proj.weight"].numpy())
+    w_pp = np.asarray(
+        dict(m2.named_parameters())
+        ["model.layers.0.self_attn.q_proj.weight"].numpy())
+    np.testing.assert_allclose(w_pp, w_serial, rtol=3e-4, atol=3e-5)
 
 
 def test_fleet_pipeline_fallback_loss_type():
