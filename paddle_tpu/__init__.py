@@ -111,6 +111,7 @@ from . import inference  # noqa: F401
 from . import fft  # noqa: F401
 from . import signal  # noqa: F401
 from . import static  # noqa: F401
+from . import utils  # noqa: F401
 from . import hapi  # noqa: F401
 from .hapi import Model, summary  # noqa: F401
 from .framework.io import save, load  # noqa: F401

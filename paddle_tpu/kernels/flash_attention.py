@@ -41,12 +41,46 @@ def is_available(q, k=None, causal=False) -> bool:
         q.dtype in (jnp.float32, jnp.bfloat16)
 
 
-def flash_attention_bshd(q, k, v, causal: bool = False, scale=None):
-    """[batch, seq, heads, dim] layout wrapper around the Pallas kernel."""
+def _tune_signature(q_bshd, k_bshd, causal):
+    b, sq, h, d = q_bshd.shape
+    return ((b, h, sq, d), k_bshd.shape[1], str(q_bshd.dtype), causal)
+
+
+def tune_blocks(q_bshd, k_bshd, v_bshd, causal: bool = False, scale=None):
+    """Autotune (block_q, block_k) for these CONCRETE [b,s,h,d] inputs and
+    cache the winner (kernels/autotune.py). Call sites inside a trace must
+    instead use cached_blocks(); dispatch layers call this before tracing
+    (nn/functional/attention.py) so training picks up tuned blocks."""
+    from . import autotune
+    sq, sk, d = q_bshd.shape[1], k_bshd.shape[1], q_bshd.shape[3]
+    sig = _tune_signature(q_bshd, k_bshd, causal)
+    return autotune.pick(
+        "flash_fwd", sig, autotune.flash_block_candidates(sq, sk, d),
+        lambda c: flash_attention_bshd(q_bshd, k_bshd, v_bshd, causal=causal,
+                                       scale=scale, block_q=c[0],
+                                       block_k=c[1]))
+
+
+def cached_blocks(q_bshd, k_bshd, causal: bool):
+    from . import autotune
+    from .flash_pallas import DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q
+    hit = autotune.cached("flash_fwd", _tune_signature(q_bshd, k_bshd,
+                                                       causal))
+    return hit if hit is not None else (DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K)
+
+
+def flash_attention_bshd(q, k, v, causal: bool = False, scale=None,
+                         block_q=None, block_k=None):
+    """[batch, seq, heads, dim] layout wrapper around the Pallas kernel.
+    Block sizes default to the autotune cache entry for this signature
+    (tuned via tune_blocks(); 128x128 otherwise)."""
     from .flash_pallas import flash_attention as fa_bhsd
+    if block_q is None or block_k is None:
+        block_q, block_k = cached_blocks(q, k, causal)
     # kernel uses [batch, heads, seq, dim]
     qh = jnp.swapaxes(q, 1, 2)
     kh = jnp.swapaxes(k, 1, 2)
     vh = jnp.swapaxes(v, 1, 2)
-    out = fa_bhsd(qh, kh, vh, causal=causal, scale=scale)
+    out = fa_bhsd(qh, kh, vh, causal=causal, scale=scale, block_q=block_q,
+                  block_k=block_k)
     return jnp.swapaxes(out, 1, 2)

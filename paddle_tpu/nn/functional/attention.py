@@ -71,6 +71,13 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
                 qt, kt, vt)
         from ...kernels import flash_attention as fa
         if fa.is_available(qt._data, kt._data, causal=is_causal):
+            from ...framework import flags as _flags
+            if _flags.flag("use_autotune") and \
+                    not isinstance(qt._data, jax.core.Tracer):
+                # tune HERE, on concrete arrays, before dispatch's vjp
+                # tracing makes everything a Tracer
+                fa.tune_blocks(qt._data, kt._data, vt._data,
+                               causal=is_causal)
             return dispatch(
                 "flash_attention",
                 lambda q, k, v: fa.flash_attention_bshd(q, k, v, causal=is_causal),

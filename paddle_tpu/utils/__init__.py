@@ -1,0 +1,10 @@
+"""paddle_tpu.utils — parity with paddle.utils."""
+from . import cpp_extension  # noqa: F401
+
+
+def try_import(name):
+    import importlib
+    try:
+        return importlib.import_module(name)
+    except ImportError:
+        return None

@@ -1,0 +1,172 @@
+"""Custom C++ op extension.
+
+Reference parity: python/paddle/utils/cpp_extension/ + the custom-operator
+registry (fluid/framework/custom_operator.cc, paddle/extension.h) — user C++
+compiled at runtime and registered as a framework op.
+
+TPU-native split of the capability:
+
+* DEVICE custom kernels are written in Pallas (see kernels/flash_pallas.py)
+  and registered as ordinary ops through ops.dispatch — Python is the
+  authoring language for TPU kernels, so no C++ toolchain is involved.
+* HOST custom ops (pre/post-processing, tokenization, lookup logic) are the
+  real C++ story here: `load()` g++-compiles the sources to a shared
+  library, and `CppExtension.op()` wraps an exported C function as a
+  framework op that works BOTH eagerly and inside jit (via
+  jax.pure_callback), with an optional C backward function for autograd.
+
+C ABI for wrapped ops (one contiguous float32 array in/out):
+
+    extern "C" void my_op(const float* x, float* y, int64_t n);
+    extern "C" void my_op_grad(const float* x, const float* gy, float* gx,
+                               int64_t n);   // optional
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_lock = threading.Lock()
+_BUILD_ROOT = os.path.join(os.path.expanduser("~"), ".cache",
+                           "paddle_tpu_extensions")
+
+
+def _build(name: str, sources: Sequence[str], extra_cflags: Sequence[str],
+           build_directory: Optional[str], verbose: bool) -> str:
+    out_dir = build_directory or os.path.join(_BUILD_ROOT, name)
+    os.makedirs(out_dir, exist_ok=True)
+    # flags participate in the artifact name: changed cflags must not reuse
+    # a stale .so whose mtime beats the sources
+    import hashlib
+    tag = hashlib.sha1(" ".join(extra_cflags).encode()).hexdigest()[:8]
+    lib = os.path.join(out_dir, f"lib{name}.{tag}.so")
+    srcs = [os.path.abspath(s) for s in sources]
+    if os.path.exists(lib) and all(
+            os.path.getmtime(lib) >= os.path.getmtime(s) for s in srcs):
+        return lib
+    tmp = f"{lib}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-shared",
+           *extra_cflags, *srcs, "-o", tmp]
+    if verbose:
+        print("cpp_extension:", " ".join(cmd))
+    try:
+        subprocess.run(cmd, check=True, capture_output=not verbose)
+    except subprocess.CalledProcessError as e:
+        raise RuntimeError(
+            f"cpp_extension build failed: {' '.join(cmd)}\n"
+            f"{(e.stderr or b'').decode()[-2000:]}") from e
+    os.replace(tmp, lib)
+    return lib
+
+
+class CppExtension:
+    """A loaded custom-op library."""
+
+    def __init__(self, name: str, lib_path: str):
+        self.name = name
+        self.lib_path = lib_path
+        self.lib = ctypes.CDLL(lib_path)
+
+    def raw(self, fn_name: str):
+        """The raw ctypes symbol (any signature; caller sets argtypes)."""
+        return getattr(self.lib, fn_name)
+
+    def op(self, fn_name: str, grad_fn_name: Optional[str] = None):
+        """Wrap `void f(const float*, float*, int64_t)` as a framework op.
+
+        Returns a callable Tensor -> Tensor usable eagerly and under jit;
+        with grad_fn_name (`void g(const float* x, const float* gy,
+        float* gx, int64_t n)`) the op is differentiable on the tape and
+        under jax.grad.
+        """
+        from ..ops.dispatch import dispatch, ensure_tensor
+
+        cfn = getattr(self.lib, fn_name)
+        cfn.argtypes = [ctypes.POINTER(ctypes.c_float),
+                        ctypes.POINTER(ctypes.c_float), ctypes.c_int64]
+        cgrad = None
+        if grad_fn_name:
+            cgrad = getattr(self.lib, grad_fn_name)
+            cgrad.argtypes = [ctypes.POINTER(ctypes.c_float),
+                              ctypes.POINTER(ctypes.c_float),
+                              ctypes.POINTER(ctypes.c_float), ctypes.c_int64]
+
+        def host_fwd(x: np.ndarray) -> np.ndarray:
+            x = np.ascontiguousarray(x, np.float32)
+            y = np.empty_like(x)
+            cfn(x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                y.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), x.size)
+            return y
+
+        def host_bwd(x: np.ndarray, gy: np.ndarray) -> np.ndarray:
+            x = np.ascontiguousarray(x, np.float32)
+            gy = np.ascontiguousarray(gy, np.float32)
+            gx = np.empty_like(x)
+            cgrad(x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                  gy.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                  gx.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), x.size)
+            return gx
+
+        @jax.custom_vjp
+        def jfn(x):
+            return jax.pure_callback(
+                host_fwd, jax.ShapeDtypeStruct(x.shape, jnp.float32), x,
+                vmap_method="sequential")
+
+        def jfn_fwd(x):
+            return jfn(x), x
+
+        def jfn_bwd(x, g):
+            if cgrad is None:
+                raise NotImplementedError(
+                    f"custom op {fn_name} has no grad function; pass "
+                    "grad_fn_name to CppExtension.op")
+            gx = jax.pure_callback(
+                host_bwd, jax.ShapeDtypeStruct(x.shape, jnp.float32), x, g,
+                vmap_method="sequential")
+            return (gx,)
+
+        jfn.defvjp(jfn_fwd, jfn_bwd)
+
+        def op_call(x):
+            xt = ensure_tensor(x)
+            return dispatch(f"custom.{self.name}.{fn_name}", jfn, xt)
+
+        op_call.__name__ = fn_name
+        return op_call
+
+
+def load(name: str, sources: Sequence[str],
+         extra_cflags: Sequence[str] = (), extra_cuda_cflags=None,
+         build_directory: Optional[str] = None,
+         verbose: bool = False) -> CppExtension:
+    """Parity: paddle.utils.cpp_extension.load (JIT-compile and load)."""
+    with _lock:
+        lib = _build(name, sources, list(extra_cflags or ()),
+                     build_directory, verbose)
+    return CppExtension(name, lib)
+
+
+def CUDAExtension(*a, **k):
+    raise NotImplementedError(
+        "CUDAExtension: device custom kernels on TPU are written in Pallas "
+        "(python), not CUDA — see kernels/flash_pallas.py for the pattern")
+
+
+class BuildExtension:
+    """setuptools hook parity shim (reference cpp_extension.BuildExtension);
+    runtime `load()` is the supported path here."""
+
+    @staticmethod
+    def with_options(**kw):
+        return BuildExtension
+
+
+__all__ = ["load", "CppExtension", "CUDAExtension", "BuildExtension"]

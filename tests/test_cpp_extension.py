@@ -1,0 +1,78 @@
+"""Custom C++ host op: compile, run eagerly, under jit, and through autograd
+(reference: paddle.utils.cpp_extension + custom_operator.cc capability)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+SRC = r"""
+#include <cstdint>
+extern "C" void cube(const float* x, float* y, int64_t n) {
+    for (int64_t i = 0; i < n; ++i) y[i] = x[i] * x[i] * x[i];
+}
+extern "C" void cube_grad(const float* x, const float* gy, float* gx,
+                          int64_t n) {
+    for (int64_t i = 0; i < n; ++i) gx[i] = 3.0f * x[i] * x[i] * gy[i];
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def ext(tmp_path_factory):
+    import shutil
+    if shutil.which("g++") is None:
+        pytest.skip("g++ not available")
+    from paddle_tpu.utils import cpp_extension
+    d = tmp_path_factory.mktemp("ext")
+    src = d / "cube.cc"
+    src.write_text(SRC)
+    return cpp_extension.load("cube_ops", [str(src)],
+                              build_directory=str(d / "build"))
+
+
+def test_eager_forward_and_grad(ext):
+    cube = ext.op("cube", grad_fn_name="cube_grad")
+    x = paddle.to_tensor(np.array([1.0, 2.0, -3.0], np.float32))
+    x.stop_gradient = False
+    y = cube(x)
+    np.testing.assert_allclose(y.numpy(), [1.0, 8.0, -27.0], rtol=1e-6)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [3.0, 12.0, 27.0], rtol=1e-6)
+
+
+def test_inside_jit(ext):
+    import jax
+    import jax.numpy as jnp
+    cube = ext.op("cube", grad_fn_name="cube_grad")
+
+    def f(arr):
+        from paddle_tpu.tensor import Tensor
+        return cube(Tensor(arr))._data.sum()
+
+    x = jnp.asarray(np.array([2.0, 3.0], np.float32))
+    v = jax.jit(f)(x)
+    np.testing.assert_allclose(float(v), 35.0, rtol=1e-6)
+    g = jax.grad(lambda a: jax.jit(f)(a))(x)
+    np.testing.assert_allclose(np.asarray(g), [12.0, 27.0], rtol=1e-6)
+
+
+def test_missing_grad_raises(ext):
+    import jax
+    relu_no_grad = ext.op("cube")  # no grad fn
+    x = paddle.to_tensor(np.array([1.0], np.float32))
+    x.stop_gradient = False
+    y = relu_no_grad(x)
+    with pytest.raises(Exception):
+        y.sum().backward()
+
+
+def test_raw_symbol_access(ext):
+    import ctypes
+    fn = ext.raw("cube")
+    fn.argtypes = [ctypes.POINTER(ctypes.c_float),
+                   ctypes.POINTER(ctypes.c_float), ctypes.c_int64]
+    x = np.array([4.0], np.float32)
+    y = np.empty_like(x)
+    fn(x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+       y.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), 1)
+    assert y[0] == 64.0
