@@ -112,6 +112,9 @@ from . import fft  # noqa: F401
 from . import signal  # noqa: F401
 from . import static  # noqa: F401
 from . import utils  # noqa: F401
+from . import onnx  # noqa: F401
+from . import quantization  # noqa: F401
+from . import kernels  # noqa: F401  (registers kernel flags, e.g. autotune)
 from . import hapi  # noqa: F401
 from .hapi import Model, summary  # noqa: F401
 from .framework.io import save, load  # noqa: F401

@@ -11,6 +11,7 @@ from .base import (  # noqa: F401
     DistributedStrategy, Fleet, HybridCommunicateGroup, fleet_instance,
 )
 from . import meta_parallel  # noqa: F401
+from . import elastic  # noqa: F401
 from .utils import recompute  # noqa: F401
 
 _fleet = fleet_instance

@@ -1,0 +1,83 @@
+"""Elastic training facade.
+
+Reference parity: fleet/elastic/manager.py:125 (ElasticManager — etcd
+leases/watches for node membership, scale-in/out decisions, restart hooks)
+and launch --elastic_level. TPU-native shape: membership signals ride the
+TCPStore heartbeat (distributed/watchdog.Heartbeat) instead of etcd, and
+the restart POLICY lives in the launcher (distributed/launch restarts the
+whole generation, the collective-controller behavior). This manager is the
+in-process view: register, watch peers, decide NEED_RESTART/SCALE events,
+and expose them to training loops or the launcher.
+"""
+from __future__ import annotations
+
+import time
+from enum import Enum
+from typing import List, Optional
+
+
+class ElasticStatus(Enum):
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    """Minimal elastic membership manager over the TCPStore heartbeat."""
+
+    def __init__(self, store=None, rank: Optional[int] = None,
+                 world: Optional[int] = None, interval: float = 5.0,
+                 stale_after: Optional[float] = None):
+        from ..host_collectives import world_info
+        from ..store import create_or_get_global_tcp_store
+        from ..watchdog import Heartbeat
+        r, w = world_info()
+        self.rank = rank if rank is not None else r
+        self.world = world if world is not None else w
+        self.enabled = self.world > 1
+        self.stale_after = stale_after
+        self._hb = None
+        if self.enabled:
+            self._hb = Heartbeat(store or create_or_get_global_tcp_store(),
+                                 self.rank, self.world, interval=interval)
+            self._hb.start()
+
+    def pre_hook(self):
+        if self._hb is not None:
+            self._hb.beat()
+
+    def dead_members(self) -> List[int]:
+        if self._hb is None:
+            return []
+        return self._hb.dead_peers(stale_after=self.stale_after)
+
+    def health_check(self) -> ElasticStatus:
+        """HOLD while peers are healthy; RESTART when membership broke
+        (reference: manager watch loop -> restart decision)."""
+        if not self.enabled:
+            return ElasticStatus.HOLD
+        return ElasticStatus.RESTART if self.dead_members() \
+            else ElasticStatus.HOLD
+
+    def exit(self, completed: bool = True) -> ElasticStatus:
+        if self._hb is not None:
+            self._hb.stop()
+        return ElasticStatus.COMPLETED if completed else ElasticStatus.ERROR
+
+    def wait(self, timeout: float = 60.0) -> bool:
+        """Block until every peer has heartbeat at least once (job-start
+        barrier); True when all present."""
+        if self._hb is None:
+            return True
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if all(self._hb.last_seen(r) is not None
+                   for r in range(self.world)):
+                return True
+            time.sleep(0.2)
+        return False
+
+
+__all__ = ["ElasticManager", "ElasticStatus"]

@@ -5,3 +5,5 @@ bias+dropout+residual+layernorm, flash attention, fused MoE dispatch). Here each
 is a Pallas kernel (MXU/VMEM-aware) with an XLA reference fallback; kernels are
 validated against the pure-jnp oracle in tests.
 """
+
+from . import autotune  # noqa: F401  (defines FLAGS_use_autotune)

@@ -98,7 +98,9 @@ def pick(kernel: str, signature: Sequence, candidates: Sequence[tuple],
     if best is None:
         best = tuple(candidates[0])
     _cache[key] = best
-    disk[dkey] = list(best)
+    # merge-on-write: concurrent ranks sharing the cache file must not drop
+    # each other's winners (os.replace only prevents torn files)
+    disk = {**_load_disk(), dkey: list(best)}
     _store_disk(disk)
     return best
 

@@ -75,9 +75,12 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
             if _flags.flag("use_autotune") and \
                     not isinstance(qt._data, jax.core.Tracer):
                 # tune HERE, on concrete arrays, before dispatch's vjp
-                # tracing makes everything a Tracer
-                fa.tune_blocks(qt._data, kt._data, vt._data,
-                               causal=is_causal)
+                # tracing makes everything a Tracer — and on the POST-AMP
+                # dtype, which is what the kernel will actually execute
+                from ...ops.dispatch import _amp_cast
+                tq, tk, tv = _amp_cast(
+                    "flash_attention", (qt._data, kt._data, vt._data))
+                fa.tune_blocks(tq, tk, tv, causal=is_causal)
             return dispatch(
                 "flash_attention",
                 lambda q, k, v: fa.flash_attention_bshd(q, k, v, causal=is_causal),

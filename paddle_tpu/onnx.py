@@ -1,0 +1,33 @@
+"""paddle_tpu.onnx — model export facade.
+
+Reference parity: python/paddle/onnx/export.py (paddle.onnx.export, backed
+by the external paddle2onnx converter). TPU-native: the deployable artifact
+of this stack is the AOT StableHLO bundle produced by paddle_tpu.jit.save —
+portable across cpu/tpu XLA runtimes, which is the role ONNX plays for the
+reference's CPU/GPU serving. `export` therefore emits that artifact; a
+literal .onnx protobuf is NOT produced (no converter dependency exists in
+this environment), and callers asking for one get a loud error rather than
+a mislabeled file.
+"""
+from __future__ import annotations
+
+
+def export(layer, path: str, input_spec=None, opset_version=None,
+           export_format: str = "stablehlo", **configs):
+    """Export `layer` for serving. export_format='stablehlo' (default)
+    writes the jit.save artifact (path.pdmodel/.pdiparams/.meta.json) and
+    returns the path prefix. export_format='onnx' raises: see module doc."""
+    if export_format == "onnx":
+        raise NotImplementedError(
+            "ONNX protobuf export requires the external paddle2onnx "
+            "converter; this TPU-native stack's portable serving artifact "
+            "is the StableHLO bundle (export_format='stablehlo', loadable "
+            "with paddle_tpu.jit.load / paddle_tpu.inference)")
+    from . import jit
+    if path.endswith(".onnx"):
+        path = path[:-5]
+    jit.save(layer, path, input_spec=input_spec)
+    return path
+
+
+__all__ = ["export"]

@@ -196,6 +196,49 @@ def _replace_layers(model: Layer, type_map, q_config):
     return model
 
 
+class Int8Linear(Layer):
+    """Converted (frozen) weight-int8 linear: int8 storage + fp scale; the
+    dequantize folds into the matmul under XLA (weight-only-int8 inference,
+    reference capability: weight_quantize/weight_only_linear ops)."""
+
+    def __init__(self, weight_int8, scale, bias, act_scale=None):
+        super().__init__()
+        self.register_buffer("weight_int8", Tensor(weight_int8),
+                             persistable=True)
+        self.register_buffer("weight_scale", Tensor(scale), persistable=True)
+        self.bias = bias
+        self.act_scale = act_scale
+
+    def forward(self, x):
+        from ..nn import functional as F
+        w8 = self.weight_int8
+        sc = self.weight_scale._data
+
+        def deq(w):
+            return w.astype(jnp.float32) * sc
+
+        w = dispatch("weight_dequantize", deq, w8)
+        return F.linear(ensure_tensor(x), w, self.bias)
+
+
+def _freeze_quanted(model: Layer) -> Layer:
+    """Replace QuantedLinear children with Int8Linear (real int8 weights)."""
+    for name, child in list(model._sub_layers.items()):
+        if isinstance(child, QuantedLinear):
+            qmax = float(child.weight_quanter.qmax)
+            w = child.weight._data
+            absmax = jnp.maximum(jnp.abs(w).max(), 1e-8)
+            scale = absmax / qmax
+            w8 = jnp.clip(jnp.round(w / scale), -qmax - 1,
+                          qmax).astype(jnp.int8)
+            act_s = child.activation_quanter._ema_scale._data
+            model._sub_layers[name] = Int8Linear(w8, scale, child.bias,
+                                                 act_scale=act_s)
+        else:
+            _freeze_quanted(child)
+    return model
+
+
 class QAT:
     """Quantization-aware training driver (parity: quantization/qat.py)."""
 
@@ -206,9 +249,10 @@ class QAT:
         return _replace_layers(model, self.q_config._type_map, self.q_config)
 
     def convert(self, model: Layer, inplace: bool = True) -> Layer:
-        """Freeze: quanters switch to eval scales."""
+        """Freeze into a deployable int8-weight model (Linear layers become
+        Int8Linear; convs keep frozen fake-quant scales)."""
         model.eval()
-        return model
+        return _freeze_quanted(model)
 
 
 class PTQ:
@@ -226,4 +270,4 @@ class PTQ:
 
     def convert(self, model: Layer, inplace: bool = True) -> Layer:
         model.eval()
-        return model
+        return _freeze_quanted(model)

@@ -1,0 +1,57 @@
+"""onnx export facade + elastic manager."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def test_onnx_export_writes_stablehlo_bundle(tmp_path):
+    paddle.seed(1)
+    m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    from paddle_tpu.jit import InputSpec
+    p = paddle.onnx.export(m, str(tmp_path / "m.onnx"),
+                           input_spec=[InputSpec([None, 4], "float32")])
+    loaded = paddle.jit.load(p)
+    x = paddle.to_tensor(np.random.default_rng(0)
+                         .standard_normal((3, 4)).astype(np.float32))
+    np.testing.assert_allclose(loaded(x).numpy(), m(x).numpy(), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_onnx_protobuf_requested_raises(tmp_path):
+    m = nn.Linear(2, 2)
+    with pytest.raises(NotImplementedError):
+        paddle.onnx.export(m, str(tmp_path / "m"), export_format="onnx")
+
+
+def test_elastic_manager_detects_dead_member():
+    import time
+
+    from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
+                                                      ElasticStatus)
+    from paddle_tpu.distributed.store import TCPStore
+    store = TCPStore("127.0.0.1", 0, is_master=True, world_size=2)
+    m0 = ElasticManager(store=store, rank=0, world=2, interval=0.1,
+                        stale_after=0.4)
+    m1 = ElasticManager(store=store, rank=1, world=2, interval=0.1,
+                        stale_after=0.4)
+    try:
+        assert m0.wait(timeout=5)
+        assert m0.health_check() is ElasticStatus.HOLD
+        m1.exit()
+        time.sleep(0.6)
+        assert m0.health_check() is ElasticStatus.RESTART
+        assert m0.dead_members() == [1]
+    finally:
+        m0.exit()
+        store.stop()
+
+
+def test_elastic_single_process_disabled():
+    from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
+                                                      ElasticStatus)
+    m = ElasticManager(world=1, rank=0)
+    assert not m.enabled
+    assert m.health_check() is ElasticStatus.HOLD
+    assert m.wait()
