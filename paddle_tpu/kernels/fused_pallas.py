@@ -1,0 +1,149 @@
+"""Pallas TPU kernels: fused rope and fused RMSNorm(+residual).
+
+Reference parity: phi/kernels/fusion/gpu/fused_rope_kernel.cu:27
+(FusedRopeKernel) and fused_layernorm_kernel.cu / fused_rms_norm — the
+memory-bound fusion list SURVEY §7 step 7 names. XLA already fuses these
+elementwise chains into neighbors well; the Pallas versions exist to pin
+the layout (single HBM pass, fp32 accumulation in VMEM) where profiles show
+XLA splitting the chain. They are OFF by default — FLAGS_use_pallas_fused
+routes the model-level fused_rope / rms_norm through them on TPU; the jnp
+implementations remain the numerics oracle and the fallback.
+
+Both kernels are forward-custom only (backward = jax AD of the jnp oracle
+via custom_vjp's recompute): these ops are cheap relative to attention, so
+the win is the forward HBM pass, not a bespoke backward.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..framework import flags
+
+flags.define_flag("use_pallas_fused", False,
+                  "Route fused_rope/rms_norm through the Pallas kernels on "
+                  "TPU (default: XLA-fused jnp).")
+
+_INTERPRET = False  # tests flip
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def enabled() -> bool:
+    return flags.flag("use_pallas_fused") and (_on_tpu() or _INTERPRET)
+
+
+# -- fused rope ---------------------------------------------------------------
+# q,k: [b, s, h, d]; cos/sin: [s, d/2]. Interleaved-pair rotation (llama).
+
+def _rope_kernel(q_ref, k_ref, cos_ref, sin_ref, oq_ref, ok_ref):
+    c = cos_ref[0]                                  # [Bs, d/2] fp32
+    s = sin_ref[0]
+    for src, dst in ((q_ref, oq_ref), (k_ref, ok_ref)):
+        x = src[0].astype(jnp.float32)              # [Bs, h, d]
+        x1 = x[:, :, 0::2]
+        x2 = x[:, :, 1::2]
+        ro1 = x1 * c[:, None, :] - x2 * s[:, None, :]
+        ro2 = x2 * c[:, None, :] + x1 * s[:, None, :]
+        out = jnp.stack([ro1, ro2], axis=-1).reshape(x.shape)
+        dst[0] = out.astype(dst.dtype)
+
+
+def fused_rope_pallas(q, k, cos, sin, block_s: int = 256):
+    """One HBM pass over q and k (parity: fused_rope_kernel.cu:27)."""
+    b, s, h, d = q.shape
+    bs = min(block_s, s)
+    if s % bs:
+        bs = s
+    ns = s // bs
+    cos2 = cos.astype(jnp.float32)
+    sin2 = sin.astype(jnp.float32)
+    kern = functools.partial(_rope_kernel)
+    oq, ok = pl.pallas_call(
+        kern,
+        grid=(b, ns),
+        in_specs=[
+            pl.BlockSpec((1, bs, h, d), lambda ib, i: (ib, i, 0, 0)),
+            pl.BlockSpec((1, bs, k.shape[2], d), lambda ib, i: (ib, i, 0, 0)),
+            pl.BlockSpec((1, bs, d // 2), lambda ib, i: (0, i, 0)),
+            pl.BlockSpec((1, bs, d // 2), lambda ib, i: (0, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bs, h, d), lambda ib, i: (ib, i, 0, 0)),
+            pl.BlockSpec((1, bs, k.shape[2], d), lambda ib, i: (ib, i, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+        ],
+        interpret=_INTERPRET,
+    )(q, k, cos2[None], sin2[None])
+    return oq, ok
+
+
+# -- fused RMSNorm(+residual) -------------------------------------------------
+
+def _rmsnorm_kernel(x_ref, w_ref, o_ref, *, eps, has_residual, r_ref=None):
+    x = x_ref[0].astype(jnp.float32)                # [Br, hidden]
+    if has_residual:
+        x = x + r_ref[0].astype(jnp.float32)
+    ms = jnp.mean(x * x, axis=-1, keepdims=True)
+    y = x * jax.lax.rsqrt(ms + eps) * w_ref[...].astype(jnp.float32)
+    o_ref[0] = y.astype(o_ref.dtype)
+
+
+def _rmsnorm_res_kernel(x_ref, r_ref, w_ref, o_ref, *, eps):
+    _rmsnorm_kernel(x_ref, w_ref, o_ref, eps=eps, has_residual=True,
+                    r_ref=r_ref)
+
+
+def fused_rms_norm_pallas(x, weight, eps: float = 1e-6, residual=None,
+                          block_rows: int = 512):
+    """RMSNorm (optionally fused with a residual add) in one HBM pass
+    (parity: fused_layernorm_kernel.cu / fused_rms_norm capability)."""
+    orig_shape = x.shape
+    hidden = orig_shape[-1]
+    rows = 1
+    for dd in orig_shape[:-1]:
+        rows *= dd
+    xr = x.reshape(rows, hidden)
+    br = min(block_rows, rows)
+    if rows % br:
+        br = rows
+    nr = rows // br
+    if residual is not None:
+        rr = residual.reshape(rows, hidden)
+        out = pl.pallas_call(
+            functools.partial(_rmsnorm_res_kernel, eps=eps),
+            grid=(nr,),
+            in_specs=[
+                pl.BlockSpec((1, br, hidden), lambda i: (0, i, 0)),
+                pl.BlockSpec((1, br, hidden), lambda i: (0, i, 0)),
+                pl.BlockSpec((hidden,), lambda i: (0,)),
+            ],
+            out_specs=pl.BlockSpec((1, br, hidden), lambda i: (0, i, 0)),
+            out_shape=jax.ShapeDtypeStruct((1, rows, hidden), x.dtype),
+            interpret=_INTERPRET,
+        )(xr[None], rr[None], weight)
+    else:
+        out = pl.pallas_call(
+            functools.partial(_rmsnorm_kernel, eps=eps, has_residual=False),
+            grid=(nr,),
+            in_specs=[
+                pl.BlockSpec((1, br, hidden), lambda i: (0, i, 0)),
+                pl.BlockSpec((hidden,), lambda i: (0,)),
+            ],
+            out_specs=pl.BlockSpec((1, br, hidden), lambda i: (0, i, 0)),
+            out_shape=jax.ShapeDtypeStruct((1, rows, hidden), x.dtype),
+            interpret=_INTERPRET,
+        )(xr[None], weight)
+    return out.reshape(orig_shape)

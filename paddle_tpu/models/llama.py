@@ -16,6 +16,7 @@ import math
 from dataclasses import dataclass
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 
 from .. import nn
@@ -94,16 +95,34 @@ def apply_rope(q, k, cos, sin):
         ro1 = x1 * c - x2 * s
         ro2 = x2 * c + x1 * s
         out = jnp.stack([ro1, ro2], axis=-1)
-        return out.reshape(x.shape)
+        # keep the input dtype: an fp32 rope cache must not silently promote
+        # bf16 activations (and the Pallas path preserves dtype)
+        return out.reshape(x.shape).astype(x.dtype)
     return rotate(q), rotate(k)
 
 
 def fused_rope(query, key, cos, sin):
-    """Tensor-level rope (recorded as one tape op)."""
+    """Tensor-level rope (recorded as one tape op). With
+    FLAGS_use_pallas_fused on TPU, the forward runs the single-HBM-pass
+    Pallas kernel (fused_rope_kernel.cu:27 analog); backward is AD of the
+    jnp oracle either way."""
     cos_a = cos._data if isinstance(cos, Tensor) else cos
     sin_a = sin._data if isinstance(sin, Tensor) else sin
-    return dispatch("fused_rope",
-                    lambda q, k: apply_rope(q, k, cos_a, sin_a),
+
+    def fwd(q, k):
+        from ..kernels import fused_pallas as fp
+        if fp.enabled():
+            # forward via the Pallas kernel, backward via the jnp oracle's
+            # vjp (rope is linear in q/k, so the cotangent rule is exact)
+            prim = lambda qq, kk: fp.fused_rope_pallas(qq, kk, cos_a, sin_a)
+            oracle = lambda qq, kk: apply_rope(qq, kk, cos_a, sin_a)
+            f = jax.custom_vjp(prim)
+            f.defvjp(lambda qq, kk: (prim(qq, kk), (qq, kk)),
+                     lambda res, g: jax.vjp(oracle, *res)[1](g))
+            return f(q, k)
+        return apply_rope(q, k, cos_a, sin_a)
+
+    return dispatch("fused_rope", fwd,
                     ensure_tensor(query), ensure_tensor(key))
 
 

@@ -7,6 +7,7 @@ into single kernels on TPU; a Pallas fused path is used for the hot RMSNorm case
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from ...ops.dispatch import dispatch, ensure_tensor
@@ -53,8 +54,10 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
 
 def rms_norm(x, weight=None, bias=None, epsilon=1e-6, begin_norm_axis=-1,
              name=None):
-    """Parity: paddle.incubate.nn.functional.fused_rms_norm."""
-    def fwd(*args):
+    """Parity: paddle.incubate.nn.functional.fused_rms_norm. With
+    FLAGS_use_pallas_fused on TPU (last-axis norm, weight-only), the forward
+    runs the one-pass Pallas kernel; backward is AD of the oracle."""
+    def _oracle(*args):
         a = args[0]
         ax = begin_norm_axis if begin_norm_axis >= 0 else a.ndim + begin_norm_axis
         axes = tuple(range(ax, a.ndim))
@@ -68,6 +71,18 @@ def rms_norm(x, weight=None, bias=None, epsilon=1e-6, begin_norm_axis=-1,
         if bias is not None:
             out = out + args[i].astype(jnp.float32)
         return out.astype(a.dtype)
+
+    def fwd(*args):
+        from ...kernels import fused_pallas as fp
+        last_axis = begin_norm_axis in (-1, args[0].ndim - 1)
+        if fp.enabled() and last_axis and weight is not None and bias is None:
+            prim = lambda a, w: fp.fused_rms_norm_pallas(a, w, eps=epsilon)
+            f = jax.custom_vjp(prim)
+            f.defvjp(lambda a, w: (prim(a, w), (a, w)),
+                     lambda res, g: jax.vjp(
+                         lambda a_, w_: _oracle(a_, w_), *res)[1](g))
+            return f(args[0], args[1])
+        return _oracle(*args)
 
     tensors = [ensure_tensor(x)]
     if weight is not None:

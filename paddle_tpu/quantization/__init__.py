@@ -64,6 +64,69 @@ def dequantize(x, scale, zero_point=0):
 
 # ---- observers ---------------------------------------------------------------
 
+def fake_quantize_abs_max(x, bit_length: int = 8):
+    """Functional op parity: ops.yaml fake_quantize_abs_max. Returns
+    (quantized-dequantized x, scale)."""
+    xt = ensure_tensor(x)
+    qmax = 2 ** (bit_length - 1) - 1
+
+    def fwd(a):
+        s = jnp.maximum(jnp.abs(a).max(), 1e-8) / qmax
+        return _fake_quant(a, s, -qmax - 1, qmax), s
+
+    return dispatch("fake_quantize_abs_max", fwd, xt)
+
+
+def fake_quantize_dequantize_abs_max(x, bit_length: int = 8):
+    out, _ = fake_quantize_abs_max(x, bit_length)
+    return out
+
+
+def fake_channel_wise_quantize_abs_max(x, bit_length: int = 8,
+                                       quant_axis: int = 0):
+    """Per-channel absmax fake quant (ops.yaml fake_channel_wise_*)."""
+    xt = ensure_tensor(x)
+    qmax = 2 ** (bit_length - 1) - 1
+
+    def fwd(a):
+        axes = tuple(i for i in range(a.ndim) if i != quant_axis)
+        s = jnp.maximum(jnp.abs(a).max(axis=axes, keepdims=True),
+                        1e-8) / qmax
+        return _fake_quant(a, s, -qmax - 1, qmax), s.reshape(-1)
+
+    return dispatch("fake_channel_wise_quantize_abs_max", fwd, xt)
+
+
+def weight_quantize(w, algo: str = "weight_only_int8"):
+    """Parity: ops.yaml weight_quantize — returns (int8 weight, scale)."""
+    if algo != "weight_only_int8":
+        raise NotImplementedError(
+            f"weight_quantize algo={algo!r}: only weight_only_int8 is "
+            "implemented (int4 packing is not)")
+    arr = ensure_tensor(w)._data
+    qmax = 127.0
+    scale = jnp.maximum(jnp.abs(arr).max(axis=0), 1e-8) / qmax
+    q = jnp.clip(jnp.round(arr / scale), -128, 127).astype(jnp.int8)
+    return Tensor(q), Tensor(scale)
+
+
+def weight_dequantize(w_int8, scale):
+    """Parity: ops.yaml weight_dequantize."""
+    q = ensure_tensor(w_int8)
+    s = ensure_tensor(scale)
+    return dispatch("weight_dequantize",
+                    lambda a, b: a.astype(jnp.float32) * b, q, s)
+
+
+def weight_only_linear(x, weight_int8, bias=None, weight_scale=None,
+                       weight_dtype="int8"):
+    """Parity: ops.yaml weight_only_linear / llm_int8_linear capability —
+    dequant folds into the matmul under XLA."""
+    from ..nn import functional as F
+    w = weight_dequantize(weight_int8, weight_scale)
+    return F.linear(ensure_tensor(x), w, bias)
+
+
 class BaseObserver:
     def __init__(self, quant_bits: int = 8):
         self.quant_bits = quant_bits
@@ -210,25 +273,34 @@ class Int8Linear(Layer):
         self.act_scale = act_scale
 
     def forward(self, x):
-        from ..nn import functional as F
-        w8 = self.weight_int8
-        sc = self.weight_scale._data
-
-        def deq(w):
-            return w.astype(jnp.float32) * sc
-
-        w = dispatch("weight_dequantize", deq, w8)
-        return F.linear(ensure_tensor(x), w, self.bias)
+        xt = ensure_tensor(x)
+        act_s = self.act_scale
+        if act_s is not None and float(act_s) > 0:
+            # keep the QAT activation quantization in the converted model
+            # (training/serving parity: the eval fake-quant model is what
+            # was validated)
+            qmax = 127.0
+            scale = jnp.maximum(jnp.asarray(act_s), 1e-8) / qmax
+            xt = dispatch("fake_quant_act",
+                          lambda a: _fake_quant(a, scale, -128.0, qmax), xt)
+        return weight_only_linear(xt, self.weight_int8, self.bias,
+                                  self.weight_scale)
 
 
 def _freeze_quanted(model: Layer) -> Layer:
     """Replace QuantedLinear children with Int8Linear (real int8 weights)."""
     for name, child in list(model._sub_layers.items()):
         if isinstance(child, QuantedLinear):
+            if child.weight_quanter.quant_bits != 8:
+                continue  # int8 storage only; other widths stay fake-quant
             qmax = float(child.weight_quanter.qmax)
             w = child.weight._data
-            absmax = jnp.maximum(jnp.abs(w).max(), 1e-8)
-            scale = absmax / qmax
+            # use the TRAINED quanter scale (EMA) when present — recomputing
+            # from raw absmax would diverge from the validated eval model
+            ema = child.weight_quanter._ema_scale._data
+            absmax = jnp.where(ema > 0, ema,
+                               jnp.maximum(jnp.abs(w).max(), 1e-8))
+            scale = jnp.maximum(absmax, 1e-8) / qmax
             w8 = jnp.clip(jnp.round(w / scale), -qmax - 1,
                           qmax).astype(jnp.int8)
             act_s = child.activation_quanter._ema_scale._data

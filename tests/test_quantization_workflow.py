@@ -60,6 +60,26 @@ def test_ptq_calibrate_then_convert():
     assert np.mean(np.abs(out - ref)) < 0.1 * (np.abs(ref).mean() + 1)
 
 
+def test_quant_functional_ops():
+    from paddle_tpu.quantization import (fake_channel_wise_quantize_abs_max,
+                                         fake_quantize_abs_max,
+                                         weight_dequantize,
+                                         weight_only_linear, weight_quantize)
+    rng = np.random.default_rng(0)
+    w = paddle.to_tensor(rng.standard_normal((8, 4)).astype(np.float32))
+    x = paddle.to_tensor(rng.standard_normal((2, 8)).astype(np.float32))
+    q, s = weight_quantize(w)
+    assert str(q.dtype) == "int8"
+    deq = weight_dequantize(q, s)
+    assert np.abs(deq.numpy() - w.numpy()).max() < 0.05
+    out = weight_only_linear(x, q, weight_scale=s)
+    np.testing.assert_allclose(out.numpy(), x.numpy() @ w.numpy(), atol=0.2)
+    fq, scale = fake_quantize_abs_max(x)
+    assert float(scale.numpy()) > 0
+    _, ch_scales = fake_channel_wise_quantize_abs_max(w, quant_axis=0)
+    assert tuple(ch_scales.shape) == (8,)
+
+
 def test_int8_state_dict_roundtrip(tmp_path):
     m = _model(seed=3)
     qat = QAT()
