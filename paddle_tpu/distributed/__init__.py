@@ -41,3 +41,4 @@ from . import sharding  # noqa: F401
 from .sharding import (  # noqa: F401
     group_sharded_parallel, save_group_sharded_model,
 )
+from .engine import Engine, to_static  # noqa: F401

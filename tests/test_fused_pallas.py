@@ -48,8 +48,35 @@ def test_rmsnorm_residual_fusion():
     np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5)
 
 
+def test_flag_routes_fused_rope_with_grads():
+    """The llama fused_rope flag path (custom_vjp: Pallas fwd, oracle bwd)."""
+    from paddle_tpu.models.llama import fused_rope
+    rng = np.random.default_rng(5)
+    q_np = rng.standard_normal((2, 8, 4, 16)).astype(np.float32)
+    k_np = rng.standard_normal((2, 8, 2, 16)).astype(np.float32)
+    cos, sin = build_rope_cache(8, 16)
+
+    def run():
+        q = paddle.to_tensor(q_np)
+        k = paddle.to_tensor(k_np)
+        q.stop_gradient = False
+        k.stop_gradient = False
+        oq, ok = fused_rope(q, k, cos, sin)
+        (oq.sum() + (ok * 2.0).sum()).backward()
+        return oq.numpy(), ok.numpy(), q.grad.numpy(), k.grad.numpy()
+
+    base = run()
+    paddle.set_flags({"FLAGS_use_pallas_fused": True})
+    try:
+        fused = run()
+    finally:
+        paddle.set_flags({"FLAGS_use_pallas_fused": False})
+    for a, b in zip(base, fused):
+        np.testing.assert_allclose(a, b, atol=1e-5)
+
+
 def test_flag_routes_model_ops_and_grads_match():
-    """With the flag on (interpret), model-level rms_norm/fused_rope values
+    """With the flag on (interpret), model-level rms_norm values
     AND grads match the flag-off path."""
     import paddle_tpu.nn.functional as F
     rng = np.random.default_rng(3)

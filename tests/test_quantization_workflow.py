@@ -93,3 +93,4 @@ def test_int8_state_dict_roundtrip(tmp_path):
     paddle.save(conv.state_dict(), path)
     sd = paddle.load(path)
     assert any("weight_int8" in k for k in sd)
+    assert any("act_scale" in k for k in sd)  # QAT act scale must persist
