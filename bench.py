@@ -43,8 +43,86 @@ def _emit_error(msg: str) -> None:
     }))
 
 
+# attempt order, largest first; _attempt_table() must define exactly these
+ATTEMPT_ORDER = ("llama-1.1b-b8", "llama-1.1b-b4", "llama-1.1b-b2",
+                 "llama-0.27b-b8", "llama-0.27b-b8-remat")
+
+
+def _attempt_table():
+    from paddle_tpu.models.llama import LlamaConfig
+
+    def cfg_1b():
+        # TinyLlama-1.1B-class: the VERDICT's "credible >=1B bf16" bar
+        return LlamaConfig(vocab_size=32000, hidden_size=2048,
+                           intermediate_size=5632, num_hidden_layers=22,
+                           num_attention_heads=16, num_key_value_heads=16,
+                           max_position_embeddings=2048)
+
+    def cfg_small():
+        return LlamaConfig(vocab_size=32000, hidden_size=1024,
+                           intermediate_size=2816, num_hidden_layers=16,
+                           num_attention_heads=16, num_key_value_heads=16,
+                           max_position_embeddings=2048)
+
+    # tag -> (cfg, batch, seq, steps, warmup, remat)
+    table = {
+        "llama-1.1b-b8": (cfg_1b(), 8, 2048, 10, 2, True),
+        "llama-1.1b-b4": (cfg_1b(), 4, 2048, 10, 2, True),
+        "llama-1.1b-b2": (cfg_1b(), 2, 2048, 10, 2, True),
+        "llama-0.27b-b8": (cfg_small(), 8, 2048, 10, 2, False),
+        "llama-0.27b-b8-remat": (cfg_small(), 8, 2048, 10, 2, True),
+    }
+    assert set(table) == set(ATTEMPT_ORDER)
+    return table
+
+
+def _run_parent():
+    """Try each config in a FRESH subprocess: an OOM'd attempt leaves device
+    buffers whose release through the tunnel backend is unreliable, so
+    in-process fallback inherits the exhaustion (observed round 2)."""
+    import os
+    import subprocess
+    last_err = None
+    for tag in ATTEMPT_ORDER:
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--attempt", tag],
+                capture_output=True, text=True, timeout=2700)
+        except subprocess.TimeoutExpired:
+            last_err = f"{tag}: timeout"
+            sys.stderr.write(f"bench attempt timed out — {tag}\n")
+            continue
+        line = None
+        for ln in (proc.stdout or "").splitlines():
+            if ln.startswith("{"):
+                line = ln
+        if line is not None:
+            try:
+                res = json.loads(line)
+            except json.JSONDecodeError:
+                res = None
+            if res and res.get("value", 0) > 0:
+                print(line)
+                return
+            if res:
+                last_err = f"{tag}: {res.get('extra', {}).get('error', '?')}"
+        else:
+            last_err = (f"{tag}: rc={proc.returncode} "
+                        f"{(proc.stderr or '')[-400:]}")
+        sys.stderr.write(f"bench attempt failed, falling back — "
+                         f"{str(last_err)[:500]}\n")
+    _emit_error(f"all bench configs failed; last: {last_err}")
+    sys.exit(1)
+
+
 def main():
     debug = "--debug" in sys.argv
+    attempt_tag = None
+    if "--attempt" in sys.argv:
+        attempt_tag = sys.argv[sys.argv.index("--attempt") + 1]
+    if not debug and attempt_tag is None:
+        _run_parent()
+        return
     # Watchdog: a hung backend init (or compile) must surface as a JSON error
     # line, never an indefinite hang (round-1 failure mode). A thread (not
     # SIGALRM) because a deadlock inside a native call never returns to the
@@ -83,32 +161,13 @@ def main():
 
     dev = jax.devices()[0]
 
-    def cfg_1b():
-        # TinyLlama-1.1B-class: the VERDICT's "credible >=1B bf16" bar
-        return LlamaConfig(vocab_size=32000, hidden_size=2048,
-                           intermediate_size=5632, num_hidden_layers=22,
-                           num_attention_heads=16, num_key_value_heads=16,
-                           max_position_embeddings=2048)
-
-    def cfg_small():
-        return LlamaConfig(vocab_size=32000, hidden_size=1024,
-                           intermediate_size=2816, num_hidden_layers=16,
-                           num_attention_heads=16, num_key_value_heads=16,
-                           max_position_embeddings=2048)
-
     if debug:
         attempts = [("tiny", LlamaConfig.tiny(vocab_size=256, hidden_size=64,
                                               layers=2, heads=4, kv_heads=2,
                                               seq=128), 2, 128, 4, 1, False)]
     else:
-        # (tag, cfg, batch, seq, steps, warmup, remat) — fall back on OOM so
-        # the driver always gets a real number from one chip
-        attempts = [
-            ("llama-1.1b-b8", cfg_1b(), 8, 2048, 10, 2, True),
-            ("llama-1.1b-b4", cfg_1b(), 4, 2048, 10, 2, True),
-            ("llama-1.1b-b2", cfg_1b(), 2, 2048, 10, 2, True),
-            ("llama-0.27b-b8", cfg_small(), 8, 2048, 10, 2, False),
-        ]
+        table = _attempt_table()
+        attempts = [(attempt_tag, *table[attempt_tag])]
 
     last_err = None
     for tag, cfg, batch, seq, steps, warmup, remat in attempts:

@@ -107,6 +107,7 @@ from . import distributed  # noqa: F401
 from . import vision  # noqa: F401
 from . import device  # noqa: F401
 from . import metric  # noqa: F401
+from . import text  # noqa: F401
 from . import inference  # noqa: F401
 from . import fft  # noqa: F401
 from . import signal  # noqa: F401

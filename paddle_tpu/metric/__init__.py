@@ -155,3 +155,23 @@ class Auc(Metric):
             tot_pos, tot_neg = new_pos, new_neg
         denom = tot_pos * tot_neg
         return float(auc / denom) if denom else 0.0
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """Top-k accuracy op (parity: paddle.metric.accuracy / phi accuracy
+    kernel). input: [N, C] scores; label: [N] or [N, 1] int. Returns a []
+    float32 tensor."""
+    import jax
+    import jax.numpy as jnp
+    from ..ops.dispatch import dispatch, ensure_tensor
+
+    it, lt = ensure_tensor(input), ensure_tensor(label)
+
+    def fwd(x, y):
+        kk = min(int(k), x.shape[-1])
+        _, topk_idx = jax.lax.top_k(x, kk)
+        y = y.reshape(-1, 1).astype(topk_idx.dtype)
+        hit = jnp.any(topk_idx == y, axis=-1)
+        return jnp.mean(hit.astype(jnp.float32))
+
+    return dispatch("accuracy", fwd, it, lt)

@@ -21,6 +21,7 @@ from .layer.conv import (  # noqa: F401
 )
 from .layer.loss import (  # noqa: F401
     BCELoss, BCEWithLogitsLoss, CosineEmbeddingLoss, CrossEntropyLoss,
+    CTCLoss, HSigmoidLoss, RNNTLoss,
     HingeEmbeddingLoss, HuberLoss, KLDivLoss, L1Loss, MSELoss,
     MarginRankingLoss, NLLLoss, SmoothL1Loss, TripletMarginLoss,
 )
@@ -32,7 +33,8 @@ from .layer.norm import (  # noqa: F401
 from .layer.pooling import (  # noqa: F401
     AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D, AdaptiveMaxPool1D,
     AdaptiveMaxPool2D, AdaptiveMaxPool3D, AvgPool1D, AvgPool2D, AvgPool3D,
-    MaxPool1D, MaxPool2D, MaxPool3D,
+    FractionalMaxPool2D, FractionalMaxPool3D, MaxPool1D, MaxPool2D, MaxPool3D,
+    MaxUnPool1D, MaxUnPool2D, MaxUnPool3D,
 )
 from .layer.rnn import (  # noqa: F401
     BiRNN, GRU, GRUCell, LSTM, LSTMCell, RNN, SimpleRNN, SimpleRNNCell,

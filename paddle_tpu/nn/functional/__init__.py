@@ -23,6 +23,7 @@ from .conv import (  # noqa: F401
 from .loss import (  # noqa: F401
     binary_cross_entropy, binary_cross_entropy_with_logits,
     cosine_embedding_loss, cross_entropy, ctc_loss, hinge_embedding_loss,
+    hsigmoid_loss, margin_cross_entropy, rnnt_loss,
     huber_loss, kl_div, l1_loss, log_loss, margin_ranking_loss, mse_loss,
     nll_loss, sigmoid_focal_loss, smooth_l1_loss, softmax_with_cross_entropy,
     square_error_cost, triplet_margin_loss,
@@ -34,6 +35,7 @@ from .norm import (  # noqa: F401
 from .pooling import (  # noqa: F401
     adaptive_avg_pool1d, adaptive_avg_pool2d, adaptive_avg_pool3d,
     adaptive_max_pool1d, adaptive_max_pool2d, adaptive_max_pool3d, avg_pool1d,
-    avg_pool2d, avg_pool3d, lp_pool1d, lp_pool2d, max_pool1d, max_pool2d,
-    max_pool3d,
+    avg_pool2d, avg_pool3d, fractional_max_pool2d, fractional_max_pool3d,
+    lp_pool1d, lp_pool2d, max_pool1d, max_pool2d, max_pool3d, max_unpool1d,
+    max_unpool2d, max_unpool3d,
 )

@@ -276,6 +276,232 @@ def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
     return dispatch("sigmoid_focal_loss", fwd, *tensors)
 
 
+_NEG = -1e30
+
+
 def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
-             reduction="mean", norm_by_times=False):
-    raise NotImplementedError("ctc_loss: planned (lax.scan DP implementation)")
+             reduction="mean", norm_by_times=False, name=None):
+    """CTC loss (parity: paddle.nn.functional.ctc_loss / warpctc kernel,
+    phi/kernels/impl/warpctc_kernel_impl.h). TPU-native: the alpha-recursion
+    in the log semiring as one lax.scan over time — no warpctc library.
+
+    log_probs: [T, B, C] (paddle's warpctc layout) — raw logits are accepted
+    and log-softmax-normalized, matching the reference kernel.
+    labels: [B, L] int padded; input_lengths/label_lengths: [B].
+    """
+    lp, lab = ensure_tensor(log_probs), ensure_tensor(labels)
+    ilen, llen = ensure_tensor(input_lengths), ensure_tensor(label_lengths)
+
+    def fwd(lp_a, lab_a, ilen_a, llen_a):
+        lp_a = jax.nn.log_softmax(lp_a.astype(jnp.float32), axis=-1)
+        T, B, C = lp_a.shape
+        L = lab_a.shape[1]
+        S = 2 * L + 1
+        lab_a = lab_a.astype(jnp.int32)
+        # extended label sequence: blank, l1, blank, l2, ..., blank
+        ext = jnp.full((B, S), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(lab_a)
+        # allowed skip (s-2 -> s): only onto odd s with ext[s] != ext[s-2]
+        s_idx = jnp.arange(S)
+        skip_ok = (s_idx[None, :] % 2 == 1) & (s_idx[None, :] >= 2) & \
+            (ext != jnp.roll(ext, 2, axis=1))
+        alpha0 = jnp.full((B, S), _NEG, jnp.float32)
+        alpha0 = alpha0.at[:, 0].set(lp_a[0, :, blank])
+        if L > 0:  # all-blank batches (L == 0) have only the blank path
+            first_lab = jnp.take_along_axis(lp_a[0], ext[:, 1:2],
+                                            axis=1)[:, 0]
+            alpha0 = alpha0.at[:, 1].set(
+                jnp.where(llen_a > 0, first_lab, _NEG))
+
+        # per-sample final time index; a_last frozen inside the scan carry
+        # (no [T, B, S] alpha history materialized)
+        t_last = jnp.clip(ilen_a.astype(jnp.int32) - 1, 0, T - 1)
+
+        def step(carry, inp):
+            alpha, a_last, t = carry
+            lp_t = inp
+            stay = alpha
+            # [:, :S] keeps the shifted rows at width S even when S < 2
+            # (empty-label batches)
+            prev1 = jnp.concatenate(
+                [jnp.full((B, 1), _NEG), alpha[:, :-1]], axis=1)[:, :S]
+            prev2 = jnp.concatenate(
+                [jnp.full((B, 2), _NEG), alpha[:, :-2]], axis=1)[:, :S]
+            prev2 = jnp.where(skip_ok, prev2, _NEG)
+            merged = jnp.logaddexp(jnp.logaddexp(stay, prev1), prev2)
+            emit = jnp.take_along_axis(lp_t, ext, axis=1)
+            alpha = merged + emit
+            a_last = jnp.where((t == t_last)[:, None], alpha, a_last)
+            return (alpha, a_last, t + 1), None
+
+        (_, a_last, _), _ = jax.lax.scan(
+            step, (alpha0, alpha0, jnp.int32(1)), lp_a[1:])      # [B, S]
+        sl = 2 * llen_a.astype(jnp.int32)
+        end1 = jnp.take_along_axis(a_last, sl[:, None], axis=1)[:, 0]
+        end2 = jnp.take_along_axis(
+            a_last, jnp.clip(sl - 1, 0, S - 1)[:, None], axis=1)[:, 0]
+        end2 = jnp.where(llen_a > 0, end2, _NEG)
+        nll = -jnp.logaddexp(end1, end2)
+        if norm_by_times:
+            nll = nll / jnp.maximum(ilen_a.astype(jnp.float32), 1.0)
+        if reduction == "mean":
+            # reference 'mean' = mean(loss / label_lengths)
+            # (python/paddle/nn/functional/loss.py ctc_loss)
+            nll = nll / jnp.maximum(llen_a.astype(jnp.float32), 1.0)
+        return _reduce(nll, reduction)
+
+    return dispatch("ctc_loss", fwd, lp, lab, ilen, llen)
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """RNN-T transducer loss (parity: paddle.nn.functional.rnnt_loss backed by
+    warprnnt). Log-semiring alpha recursion: a lax.scan over time whose body
+    resolves the within-frame emission chain with a nested scan over U.
+
+    input: [B, T, U+1, V] joint-network logits (log-softmaxed internally);
+    label: [B, U] int. fastemit_lambda only rescales emission *gradients* in
+    the reference's warprnnt backend (the reported loss value is the plain
+    negative log-likelihood), so the loss value here matches the reference
+    for all lambda; that gradient rescaling itself is not applied.
+    """
+    it, lt = ensure_tensor(input), ensure_tensor(label)
+    ilen, llen = ensure_tensor(input_lengths), ensure_tensor(label_lengths)
+
+    def fwd(x, lab_a, ilen_a, llen_a):
+        x = jax.nn.log_softmax(x.astype(jnp.float32), axis=-1)
+        B, T, U1, V = x.shape
+        U = U1 - 1
+        lab_a = lab_a.astype(jnp.int32)
+        blank_lp = x[..., blank]                       # [B, T, U+1]
+        emit_lp = jnp.take_along_axis(
+            x[:, :, :U, :], lab_a[:, None, :, None], axis=3)[..., 0]  # [B,T,U]
+        u_ok = jnp.arange(U)[None, :] < llen_a[:, None]               # [B, U]
+
+        def emit_chain(base, emit_t):
+            """Resolve u-chain within a frame: out[u] = logaddexp(base[u],
+            out[u-1] + emit[u-1]), emissions masked beyond label_lengths."""
+            em = jnp.where(u_ok, emit_t, _NEG)
+
+            def ustep(carry, xs):
+                a_u, e_u = xs
+                new = jnp.logaddexp(a_u, carry + e_u)
+                return new, new
+
+            _, rest = jax.lax.scan(ustep, base[:, 0],
+                                   (base[:, 1:].T, em.T))
+            return jnp.concatenate([base[:, :1], rest.T], axis=1)
+
+        alpha0 = jnp.full((B, U1), _NEG, jnp.float32)
+        alpha0 = alpha0.at[:, 0].set(0.0)
+        alpha = emit_chain(alpha0, emit_lp[:, 0, :])
+        t_last = jnp.clip(ilen_a.astype(jnp.int32) - 1, 0, T - 1)
+
+        def time_step(carry, inp):
+            alpha, a_last, t = carry
+            blank_t, emit_t = inp                      # [B, U+1], [B, U]
+            out = emit_chain(alpha + blank_t, emit_t)
+            a_last = jnp.where((t == t_last)[:, None], out, a_last)
+            return (out, a_last, t + 1), None
+
+        (_, a_last, _), _ = jax.lax.scan(
+            time_step, (alpha, alpha, jnp.int32(1)),
+            (jnp.moveaxis(blank_lp[:, :-1, :], 1, 0),
+             jnp.moveaxis(emit_lp[:, 1:, :], 1, 0)))  # a_last: [B, U+1]
+        ul = llen_a.astype(jnp.int32)
+        a_end = jnp.take_along_axis(a_last, ul[:, None], axis=1)[:, 0]
+        blank_last_t = blank_lp[jnp.arange(B), t_last]  # [B, U+1]
+        final_blank = jnp.take_along_axis(blank_last_t, ul[:, None],
+                                          axis=1)[:, 0]
+        nll = -(a_end + final_blank)
+        return _reduce(nll, reduction)
+
+    return dispatch("rnnt_loss", fwd, it, lt, ilen, llen)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
+                         scale=64.0, group=None, return_softmax=False,
+                         reduction="mean"):
+    """ArcFace-family combined-margin softmax loss (parity:
+    paddle.nn.functional.margin_cross_entropy; kernel
+    phi/kernels/gpu/margin_cross_entropy_kernel.cu). `logits` are cosine
+    similarities of normalized features/weights. Model-parallel class
+    sharding (the reference's group path) is subsumed by GSPMD when called
+    inside a compiled trainer with vocab-sharded logits."""
+    lt, yt = ensure_tensor(logits), ensure_tensor(label)
+
+    def fwd(cos_t, y):
+        cos_t = cos_t.astype(jnp.float32)
+        n, c = cos_t.shape
+        y = y.reshape(-1).astype(jnp.int32)
+        onehot = jax.nn.one_hot(y, c, dtype=jnp.bool_)
+        # clip strictly inside (-1, 1): arccos' gradient is infinite at the
+        # boundary and a cos of exactly 1 (feature aligned with its class
+        # center) would propagate NaN into every parameter
+        lim = 1.0 - 1e-6
+        target_cos = jnp.clip(jnp.take_along_axis(cos_t, y[:, None], axis=1),
+                              -lim, lim)
+        theta = jnp.arccos(target_cos)
+        m_cos = jnp.cos(margin1 * theta + margin2) - margin3
+        adjusted = jnp.where(onehot, m_cos, cos_t) * scale
+        logp = jax.nn.log_softmax(adjusted, axis=-1)
+        loss = -jnp.take_along_axis(logp, y[:, None], axis=1)
+        loss = _reduce(loss, reduction)
+        if return_softmax:
+            return loss, jnp.exp(logp)
+        return loss
+
+    out = dispatch("margin_cross_entropy", fwd, lt, yt)
+    return out
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False, name=None):
+    """Hierarchical sigmoid loss (parity: paddle.nn.functional.hsigmoid_loss;
+    default-tree bit codes per phi/kernels/funcs/matrix_bit_code.h SimpleCode:
+    c = label + num_classes, index(d) = (c >> (d+1)) - 1, bit(d) = (c >> d) & 1,
+    path length = floor(log2(c))).
+    """
+    xt, yt = ensure_tensor(input), ensure_tensor(label)
+    wt = ensure_tensor(weight)
+    args = [xt, yt, wt]
+    has_bias = bias is not None
+    if has_bias:
+        args.append(ensure_tensor(bias))
+    custom = path_table is not None and path_code is not None
+    if custom:
+        args.append(ensure_tensor(path_table))
+        args.append(ensure_tensor(path_code))
+    import math as _math
+    max_len = (int(path_table.shape[1]) if custom
+               else _math.floor(_math.log2(max(num_classes * 2 - 1, 2))))
+
+    def fwd(x, y, w, *rest):
+        rest = list(rest)
+        b = rest.pop(0) if has_bias else None
+        x = x.astype(jnp.float32)
+        y = y.reshape(-1).astype(jnp.int32)
+        if custom:
+            table = rest[0].astype(jnp.int32)          # [N, L]
+            code = rest[1].astype(jnp.int32)           # [N, L]
+            valid = table >= 0
+            idx = jnp.clip(table, 0, w.shape[0] - 1)
+            bits = code.astype(jnp.float32)
+        else:
+            c = y + num_classes
+            d = jnp.arange(max_len)
+            # bit d is on the path iff the node above it exists: (c>>(d+1)) >= 1
+            valid = (c[:, None] >> (d[None, :] + 1)) >= 1
+            idx = jnp.clip((c[:, None] >> (d[None, :] + 1)) - 1,
+                           0, w.shape[0] - 1)
+            bits = ((c[:, None] >> d[None, :]) & 1).astype(jnp.float32)
+        wg = w.astype(jnp.float32)[idx]                # [N, L, D]
+        pre = jnp.einsum("nd,nld->nl", x, wg)
+        if b is not None:
+            pre = pre + b.astype(jnp.float32).reshape(-1)[idx]
+        # sigmoid cross entropy with the path bit as the binary label
+        per_node = jax.nn.softplus(pre) - bits * pre
+        loss = jnp.sum(jnp.where(valid, per_node, 0.0), axis=1, keepdims=True)
+        return loss.astype(x.dtype)
+
+    return dispatch("hsigmoid_loss", fwd, *args)

@@ -80,66 +80,252 @@ def _pool(name, x, ksize, stride, padding, nd, reducer, init, channel_last,
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCL", name=None):
+    channel_last = data_format == "NLC"
     out = _pool("max_pool1d", x, kernel_size, stride, padding, 1, lax.max, None,
-                data_format.endswith("C") and data_format != "NCL",
-                ceil_mode=ceil_mode)
+                channel_last, ceil_mode=ceil_mode)
     if return_mask:
-        return out, _pool_mask(x, out, kernel_size, stride, padding, 1)
+        return out, _pool_mask(x, out, kernel_size, stride, padding, 1,
+                               ceil_mode, channel_last)
     return out
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCHW", name=None):
+    channel_last = data_format == "NHWC"
     out = _pool("max_pool2d", x, kernel_size, stride, padding, 2, lax.max, None,
-                data_format == "NHWC", ceil_mode=ceil_mode)
+                channel_last, ceil_mode=ceil_mode)
     if return_mask:
-        return out, _pool_mask(x, out, kernel_size, stride, padding, 2)
+        return out, _pool_mask(x, out, kernel_size, stride, padding, 2,
+                               ceil_mode, channel_last)
     return out
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
+    channel_last = data_format == "NDHWC"
     out = _pool("max_pool3d", x, kernel_size, stride, padding, 3, lax.max, None,
-                data_format == "NDHWC", ceil_mode=ceil_mode)
+                channel_last, ceil_mode=ceil_mode)
     if return_mask:
-        return out, _pool_mask(x, out, kernel_size, stride, padding, 3)
+        return out, _pool_mask(x, out, kernel_size, stride, padding, 3,
+                               ceil_mode, channel_last)
     return out
 
 
-def _pool_mask(x, out, kernel_size, stride, padding, nd):
-    """Indices of max elements (flat per spatial plane), computed via unfold-argmax."""
+def _pool_mask(x, out, kernel_size, stride, padding, nd, ceil_mode=False,
+               channel_last=False):
+    """Indices of max elements (flat over the spatial plane, row-major),
+    computed via unfold-argmax. Supports nd in {1, 2, 3} (parity:
+    max_pool2d_with_index / max_pool3d_with_index kernels). Padding/ceil_mode
+    handling mirrors _pool so the mask shape always matches the output."""
+    import itertools
+
     xt = ensure_tensor(x)
     k = _norm(kernel_size, nd)
     s = _norm(stride if stride is not None else kernel_size, nd)
     p = _pads(padding, nd)
 
     def fwd(a):
-        # build windows by gather; nd<=3 small loops are fine (traced once)
-        if nd != 2:
-            raise NotImplementedError("return_mask only for 2d pooling")
-        n, c, h, w = a.shape
-        (ph, _), (pw, _) = p if not isinstance(p, str) else ((0, 0), (0, 0))
+        if channel_last:
+            a = jnp.moveaxis(a, -1, 1)
+        n, c = a.shape[:2]
+        spatial = a.shape[2:]
+        pad = ([list(pr) for pr in p] if not isinstance(p, str)
+               else [[0, 0]] * nd)
+        if ceil_mode:
+            for d in range(nd):
+                size = spatial[d] + pad[d][0] + pad[d][1]
+                rem = (size - k[d]) % s[d]
+                if rem != 0:
+                    pad[d][1] += s[d] - rem
         neg = jnp.finfo(a.dtype).min
-        a_p = jnp.pad(a, ((0, 0), (0, 0), (ph, ph), (pw, pw)),
+        a_p = jnp.pad(a, [(0, 0), (0, 0)] + [(pl, pr) for pl, pr in pad],
                       constant_values=neg)
-        out_h = (h + 2 * ph - k[0]) // s[0] + 1
-        out_w = (w + 2 * pw - k[1]) // s[1] + 1
+        out_sz = [(spatial[d] + pad[d][0] + pad[d][1] - k[d]) // s[d] + 1
+                  for d in range(nd)]
+        # row-major strides of the UNPADDED spatial plane
+        plane_strides = [1] * nd
+        for d in range(nd - 2, -1, -1):
+            plane_strides[d] = plane_strides[d + 1] * spatial[d + 1]
         patches, indices = [], []
-        for i in range(k[0]):
-            for j in range(k[1]):
-                patch = a_p[:, :, i: i + out_h * s[0]: s[0],
-                            j: j + out_w * s[1]: s[1]]
-                patches.append(patch)
-                row = jnp.arange(out_h) * s[0] + i - ph
-                col = jnp.arange(out_w) * s[1] + j - pw
-                flat = row[:, None] * w + col[None, :]
-                indices.append(jnp.broadcast_to(flat, (n, c, out_h, out_w)))
+        for offs in itertools.product(*[range(kk) for kk in k]):
+            sl = [slice(None), slice(None)]
+            flat = 0
+            for d, o in enumerate(offs):
+                sl.append(slice(o, o + out_sz[d] * s[d], s[d]))
+                coord = jnp.arange(out_sz[d]) * s[d] + o - pad[d][0]
+                shape = [1] * nd
+                shape[d] = out_sz[d]
+                flat = flat + coord.reshape(shape) * plane_strides[d]
+            patches.append(a_p[tuple(sl)])
+            indices.append(jnp.broadcast_to(flat, (n, c) + tuple(out_sz)))
         stacked = jnp.stack(patches, axis=-1)
         idx_stacked = jnp.stack(indices, axis=-1)
         which = jnp.argmax(stacked, axis=-1)
-        return jnp.take_along_axis(idx_stacked, which[..., None],
+        mask = jnp.take_along_axis(idx_stacked, which[..., None],
                                    axis=-1)[..., 0].astype(jnp.int32)
+        if channel_last:
+            mask = jnp.moveaxis(mask, 1, -1)
+        return mask
     return dispatch("max_pool_mask", fwd, xt)
+
+
+def _max_unpool(name, x, indices, kernel_size, stride, padding, nd,
+                output_size):
+    """Scatter pooled values back to the positions recorded in `indices`
+    (parity: paddle.nn.functional.max_unpool{1,2,3}d / unpool kernels)."""
+    k = _norm(kernel_size, nd)
+    s = _norm(stride if stride is not None else kernel_size, nd)
+    p = _norm(padding, nd)
+    xt, it = ensure_tensor(x), ensure_tensor(indices)
+    in_spatial = tuple(int(d) for d in xt.shape[2:])
+    if output_size is None:
+        out_spatial = tuple((in_spatial[d] - 1) * s[d] - 2 * p[d] + k[d]
+                            for d in range(nd))
+    else:
+        out_spatial = tuple(int(v) for v in tuple(output_size)[-nd:])
+
+    def fwd(a, idx):
+        n, c = a.shape[:2]
+        numel = 1
+        for d in out_spatial:
+            numel *= d
+        flat_vals = a.reshape(n, c, -1)
+        flat_idx = idx.reshape(n, c, -1).astype(jnp.int32)
+        bi = jnp.arange(n)[:, None, None]
+        ci = jnp.arange(c)[None, :, None]
+        out = jnp.zeros((n, c, numel), a.dtype)
+        out = out.at[bi, ci, flat_idx].set(flat_vals)
+        return out.reshape((n, c) + out_spatial)
+    return dispatch(name, fwd, xt, it)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    return _max_unpool("max_unpool1d", x, indices, kernel_size, stride,
+                       padding, 1, output_size)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _max_unpool("max_unpool2d", x, indices, kernel_size, stride,
+                       padding, 2, output_size)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _max_unpool("max_unpool3d", x, indices, kernel_size, stride,
+                       padding, 3, output_size)
+
+
+def _fractional_max_pool(name, x, output_size, kernel_size, random_u,
+                         return_mask, nd):
+    """Fractional max pooling (Graham 2014). Parity:
+    phi/kernels/funcs/pooling.h FractionalRationalU/StartIndex/EndIndex."""
+    o = _norm(output_size, nd)
+    ks = _norm(kernel_size, nd) if kernel_size is not None else (0,) * nd
+    if random_u is None:
+        from ...framework.random import next_key
+        import jax
+        u0 = float(jax.random.uniform(next_key(), ()))
+    else:
+        u0 = float(random_u)
+        if not 0 < u0 < 1:
+            raise ValueError(f"random_u must be in (0, 1), got {u0}")
+    xt = ensure_tensor(x)
+    spatial = tuple(int(d) for d in xt.shape[2:])
+
+    # per-dim static window bounds (host math; mirrors pooling.cc:1896-1930:
+    # alpha = (input - pool) / (output - (pool>0)), start/end clamped to the
+    # input)
+    starts, ends = [], []
+    for d in range(nd):
+        inp, out, pool = spatial[d], o[d], ks[d]
+        alpha = (inp - pool) / (out - (1 if pool > 0 else 0))
+        if pool > 0:
+            u = u0
+        else:
+            base = inp // out
+            u_max1 = (base + 2) / alpha - 1
+            u_max2 = (inp + 1 - base) / alpha - (out - 1)
+            u = u0 * min(u_max1, u_max2)
+        st = [int((i + u) * alpha) - int(u * alpha) for i in range(out)]
+        if pool > 0:
+            en = [s_ + pool for s_ in st]
+        else:
+            en = [int((i + 1 + u) * alpha) - int(u * alpha) for i in range(out)]
+        st = [max(s_, 0) for s_ in st]
+        en = [min(e, inp) for e in en]
+        starts.append(st)
+        ends.append(en)
+
+    kmax = [max(e - s_ for s_, e in zip(starts[d], ends[d]))
+            for d in range(nd)]
+    plane_strides = [1] * nd
+    for d in range(nd - 2, -1, -1):
+        plane_strides[d] = plane_strides[d + 1] * spatial[d + 1]
+
+    def fwd(a):
+        n, c = a.shape[:2]
+        neg = jnp.finfo(a.dtype).min
+        # gather-unfold: patches[..., out_d, k_d, ...] with invalid slots = -inf
+        pat = a
+        coords = []
+        for d in range(nd):
+            st = jnp.asarray(starts[d])                       # [out]
+            kk = jnp.arange(kmax[d])                          # [kmax]
+            idx = st[:, None] + kk[None, :]                   # [out, kmax]
+            valid = idx < jnp.asarray(ends[d])[:, None]
+            idx = jnp.clip(idx, 0, spatial[d] - 1)
+            ax = 2 + d * 2  # each processed dim expands into (out, k)
+            pat = jnp.take(pat, idx.reshape(-1), axis=ax)
+            new_shape = pat.shape[:ax] + (len(starts[d]), kmax[d]) + \
+                pat.shape[ax + 1:]
+            pat = pat.reshape(new_shape)
+            vshape = [1] * pat.ndim
+            vshape[ax], vshape[ax + 1] = valid.shape
+            pat = jnp.where(valid.reshape(vshape), pat, neg)
+            coords.append(idx)
+        # move all k axes last, flatten
+        perm = ([0, 1] + [2 + 2 * d for d in range(nd)]
+                + [3 + 2 * d for d in range(nd)])
+        pat = pat.transpose(perm)
+        out_sz = tuple(len(starts[d]) for d in range(nd))
+        pat = pat.reshape((n, c) + out_sz + (-1,))
+        result = jnp.max(pat, axis=-1)
+        if not return_mask:
+            return result
+        which = jnp.argmax(pat, axis=-1)
+        # decompose flat k index -> per-dim k, map to plane index
+        flat = jnp.zeros(which.shape, jnp.int32)
+        rem = which
+        for d in range(nd - 1, -1, -1):
+            kd = rem % kmax[d]
+            rem = rem // kmax[d]
+            # coords[d]: [out_d, kmax_d] input coordinate
+            coord_d = jnp.take(coords[d].astype(jnp.int32).reshape(-1),
+                               (jnp.arange(out_sz[d]).reshape(
+                                   [1, 1] + [out_sz[i] if i == d else 1
+                                             for i in range(nd)])
+                                * kmax[d] + kd))
+            flat = flat + coord_d * plane_strides[d]
+        return result, flat
+
+    if return_mask:
+        out, mask = dispatch(name, fwd, xt)
+        return out, mask
+    return dispatch(name, fwd, xt)
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    return _fractional_max_pool("fractional_max_pool2d", x, output_size,
+                                kernel_size, random_u, return_mask, 2)
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    return _fractional_max_pool("fractional_max_pool3d", x, output_size,
+                                kernel_size, random_u, return_mask, 3)
 
 
 def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,

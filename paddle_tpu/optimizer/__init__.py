@@ -474,3 +474,171 @@ class Lamb(Optimizer):
                                    _f32(self._epsilon),
                                    _f32(step), _f32(wd))
         return new_p, {"moment1": m, "moment2": v}
+
+
+@jax.jit
+def _nadam_update(p, g, m, v, mu_prod, lr_val, beta1, beta2, eps, psi, step,
+                  wd):
+    """Parity: phi/kernels/impl/nadam_kernel_impl.h (momentum_decay_pow is
+    0.96**step, recomputed from the integer step instead of carried)."""
+    gf = g.astype(jnp.float32) + wd * p.astype(jnp.float32)
+    md_pow = 0.96 ** step
+    beta2_pow = beta2 ** step
+    mu_t = beta1 * (1.0 - 0.5 * md_pow ** psi)
+    mu_t1 = beta1 * (1.0 - 0.5 * md_pow ** psi * 0.96 ** psi)
+    mu_prod_new = mu_prod * mu_t
+    mu_prod_t1 = mu_prod_new * mu_t1
+    m_new = beta1 * m + (1 - beta1) * gf
+    v_new = beta2 * v + (1 - beta2) * gf * gf
+    m_hat = mu_t1 * m_new / (1 - mu_prod_t1) + \
+        (1 - mu_t) * gf / (1 - mu_prod_new)
+    v_hat = v_new / (1 - beta2_pow)
+    new_p = (p.astype(jnp.float32)
+             - lr_val * m_hat / (jnp.sqrt(v_hat) + eps)).astype(p.dtype)
+    return new_p, m_new, v_new, mu_prod_new
+
+
+class NAdam(Optimizer):
+    """Parity: paddle.optimizer.NAdam (python/paddle/optimizer/nadam.py)."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, momentum_decay=0.004, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._momentum_decay = momentum_decay
+
+    def _init_state(self, param):
+        z = jnp.zeros(param._data.shape, jnp.float32)
+        return {"moment1": z, "moment2": z,
+                "mu_product": jnp.ones((), jnp.float32)}
+
+    def _update(self, param, grad, state, lr_val, wd, step):
+        new_p, m, v, mu = _nadam_update(
+            param, grad, state["moment1"], state["moment2"],
+            state["mu_product"], _f32(lr_val), _f32(self._beta1),
+            _f32(self._beta2), _f32(self._epsilon),
+            _f32(self._momentum_decay), _f32(step), _f32(wd))
+        return new_p, {"moment1": m, "moment2": v, "mu_product": mu}
+
+
+@jax.jit
+def _radam_update(p, g, m, v, lr_val, beta1, beta2, eps, step, wd):
+    """Parity: phi/kernels/impl/radam_kernel_impl.h. rho_t is recomputed from
+    the step count (the closed form of the kernel's carried recurrence)."""
+    gf = g.astype(jnp.float32) + wd * p.astype(jnp.float32)
+    beta1_pow = beta1 ** step
+    beta2_pow = beta2 ** step
+    rho_inf = 2.0 / (1.0 - beta2) - 1.0
+    rho_t = rho_inf - 2.0 * step * beta2_pow / (1.0 - beta2_pow)
+    m_new = beta1 * m + (1 - beta1) * gf
+    v_new = beta2 * v + (1 - beta2) * gf * gf
+    m_hat = m_new / (1 - beta1_pow)
+    l_t = jnp.sqrt(1.0 - beta2_pow) / (jnp.sqrt(v_new) + eps)
+    r_t = jnp.sqrt(((rho_t - 4.0) * (rho_t - 2.0) * rho_inf)
+                   / ((rho_inf - 4.0) * (rho_inf - 2.0)
+                      * jnp.maximum(rho_t, 4.5)))
+    upd = jnp.where(rho_t > 5.0, m_hat * r_t * l_t, m_hat)
+    return (p.astype(jnp.float32) - lr_val * upd).astype(p.dtype), m_new, v_new
+
+
+class RAdam(Optimizer):
+    """Parity: paddle.optimizer.RAdam (python/paddle/optimizer/radam.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _init_state(self, param):
+        z = jnp.zeros(param._data.shape, jnp.float32)
+        return {"moment1": z, "moment2": z}
+
+    def _update(self, param, grad, state, lr_val, wd, step):
+        new_p, m, v = _radam_update(param, grad, state["moment1"],
+                                    state["moment2"], _f32(lr_val),
+                                    _f32(self._beta1), _f32(self._beta2),
+                                    _f32(self._epsilon), _f32(step), _f32(wd))
+        return new_p, {"moment1": m, "moment2": v}
+
+
+@jax.jit
+def _rprop_update(p, g, prev, lrs, lr_min, lr_max, eta_neg, eta_pos):
+    """Parity: phi/kernels/cpu/rprop_kernel.cc RpropKernelCPUImpl."""
+    gf = g.astype(jnp.float32)
+    prod = gf * prev
+    eta = jnp.where(prod > 0, eta_pos, jnp.where(prod < 0, eta_neg, 1.0))
+    gf = jnp.where(prod < 0, 0.0, gf)
+    lrs_new = jnp.clip(lrs * eta, lr_min, lr_max)
+    new_p = (p.astype(jnp.float32) - jnp.sign(gf) * lrs_new).astype(p.dtype)
+    return new_p, gf, lrs_new
+
+
+class Rprop(Optimizer):
+    """Parity: paddle.optimizer.Rprop (python/paddle/optimizer/rprop.py);
+    per-element sign-based step sizes, full-batch training only."""
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50.0),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._lr_min, self._lr_max = map(float, learning_rate_range)
+        self._eta_neg, self._eta_pos = map(float, etas)
+
+    def _init_state(self, param):
+        return {"prev": jnp.zeros(param._data.shape, jnp.float32),
+                "learning_rates": jnp.full(param._data.shape,
+                                           float(self.get_lr()), jnp.float32)}
+
+    def _update(self, param, grad, state, lr_val, wd, step):
+        new_p, prev, lrs = _rprop_update(
+            param, grad, state["prev"], state["learning_rates"],
+            _f32(self._lr_min), _f32(self._lr_max), _f32(self._eta_neg),
+            _f32(self._eta_pos))
+        return new_p, {"prev": prev, "learning_rates": lrs}
+
+
+@jax.jit
+def _asgd_update(p, g, d, ys, idx, n_eff, lr_val, wd):
+    """Parity: phi/kernels/cpu/asgd_kernel.cc — d tracks the sum of the last
+    `n` grads via a rotating history buffer ys."""
+    gf = g.astype(jnp.float32) + wd * p.astype(jnp.float32)
+    y_old = ys[idx]
+    d_new = d - y_old + gf
+    ys_new = ys.at[idx].set(gf)
+    new_p = (p.astype(jnp.float32) - (lr_val / n_eff) * d_new).astype(p.dtype)
+    return new_p, d_new, ys_new
+
+
+class ASGD(Optimizer):
+    """Parity: paddle.optimizer.ASGD (python/paddle/optimizer/asgd.py) —
+    averaged SGD over a sliding window of the last `batch_num` gradients."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        if not batch_num or batch_num <= 0:
+            raise ValueError("batch_num should be greater than 0")
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._n = int(batch_num)
+
+    def _init_state(self, param):
+        return {"d": jnp.zeros(param._data.shape, jnp.float32),
+                "ys": jnp.zeros((self._n,) + tuple(param._data.shape),
+                                jnp.float32)}
+
+    def _update(self, param, grad, state, lr_val, wd, step):
+        idx = (int(step) - 1) % self._n
+        n_eff = min(int(step), self._n)
+        new_p, d, ys = _asgd_update(param, grad, state["d"], state["ys"],
+                                    idx, _f32(n_eff), _f32(lr_val), _f32(wd))
+        return new_p, {"d": d, "ys": ys}
+
+
+from .lbfgs import LBFGS  # noqa: E402  (import kept at the bottom so the
+# closure-based LBFGS sits with the other exports without a cycle)
+
+__all__ += ["NAdam", "RAdam", "Rprop", "ASGD", "LBFGS"]

@@ -1,0 +1,94 @@
+"""paddle.text namespace: Viterbi decoding for CRF-style taggers.
+
+Reference parity: python/paddle/text/viterbi_decode.py (op) +
+phi/kernels/cpu/viterbi_decode_kernel.cc (semantics: transitions row N-1 is
+the start tag's outgoing transitions, row N-2 the stop tag's; both applied
+only when include_bos_eos_tag=True).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.dispatch import dispatch, ensure_tensor
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """Max-score tag path per sequence.
+
+    potentials: [B, T, N] unary emission scores; transition_params: [N, N];
+    lengths: [B] int. Returns (scores [B], paths [B, T] int64 — entries past
+    a sequence's length are 0).
+    """
+    pt = ensure_tensor(potentials)
+    tt = ensure_tensor(transition_params)
+    lt = ensure_tensor(lengths)
+
+    def fwd(pot, trans, lens):
+        pot = pot.astype(jnp.float32)
+        trans = trans.astype(jnp.float32)
+        B, T, N = pot.shape
+        lens = lens.astype(jnp.int32)
+        alpha = pot[:, 0, :]
+        if include_bos_eos_tag:
+            alpha = alpha + trans[N - 1][None, :]
+            alpha = alpha + jnp.where((lens == 1)[:, None],
+                                      trans[N - 2][None, :], 0.0)
+
+        def step(carry, inp):
+            alpha, t = carry
+            logit_t = inp
+            # alpha_trn[b, i, j] = alpha[b, i] + trans[i, j]
+            trn = alpha[:, :, None] + trans[None, :, :]
+            hist = jnp.argmax(trn, axis=1)              # [B, N]
+            amax = jnp.max(trn, axis=1)
+            nxt = amax + logit_t
+            if include_bos_eos_tag:
+                nxt = nxt + jnp.where((t == lens - 1)[:, None],
+                                      trans[N - 2][None, :], 0.0)
+            live = (t < lens)[:, None]
+            alpha = jnp.where(live, nxt, alpha)
+            return (alpha, t + 1), hist
+
+        (alpha, _), historys = jax.lax.scan(
+            step, (alpha, jnp.int32(1)), jnp.moveaxis(pot[:, 1:, :], 1, 0))
+        scores = jnp.max(alpha, axis=-1)
+        last_tag = jnp.argmax(alpha, axis=-1).astype(jnp.int32)   # [B]
+
+        # backtrack: walk historys from the end; positions past len-1 keep
+        # propagating last_tag (their history rows were never applied)
+        def back(tag, inp):
+            hist, t = inp
+            prev = jnp.take_along_axis(hist, tag[:, None], axis=1)[:, 0]
+            tag_new = jnp.where(t < lens - 1, prev, tag)
+            return tag_new.astype(jnp.int32), tag_new.astype(jnp.int32)
+
+        ts = jnp.arange(T - 2, -1, -1, dtype=jnp.int32)
+        _, rev_tags = jax.lax.scan(back, last_tag,
+                                   (historys[::-1], ts))
+        # paths[t] for t in 0..T-2 from rev_tags reversed; path[len-1]=last_tag
+        path_head = rev_tags[::-1]                     # [T-1, B]
+        full = jnp.concatenate([path_head,
+                                jnp.zeros((1, B), jnp.int32)], axis=0)
+        t_grid = jnp.arange(T)[:, None]
+        full = jnp.where(t_grid == (lens - 1)[None, :], last_tag[None, :],
+                         full)
+        full = jnp.where(t_grid < lens[None, :], full, 0)
+        return scores, jnp.moveaxis(full, 0, 1).astype(jnp.int64)
+
+    return dispatch("viterbi_decode", fwd, pt, tt, lt)
+
+
+class ViterbiDecoder:
+    """Parity: paddle.text.ViterbiDecoder."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

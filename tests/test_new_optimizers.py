@@ -1,0 +1,240 @@
+"""NAdam / RAdam / Rprop / ASGD / LBFGS vs independent oracles.
+
+Oracle style per SURVEY §4: NumPy transcriptions of the reference kernel math
+(phi/kernels/impl/{nadam,radam}_kernel_impl.h, cpu/{asgd,rprop}_kernel.cc),
+plus torch cross-checks where torch's algorithm is identical (Rprop, LBFGS).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import optimizer as opt
+
+
+def _run_steps(optimizer, p, grads):
+    """Drive optimizer with a fixed grad sequence; returns param history."""
+    hist = []
+    for g in grads:
+        p.grad = paddle.to_tensor(g)
+        optimizer.step()
+        optimizer.clear_grad()
+        hist.append(np.asarray(p.numpy(), np.float64))
+    return hist
+
+
+def _make_param(x0):
+    p = paddle.to_tensor(x0.copy())
+    p.stop_gradient = False
+    return p
+
+
+RNG = np.random.default_rng(7)
+X0 = RNG.normal(size=(3, 4)).astype(np.float32)
+GRADS = [RNG.normal(size=(3, 4)).astype(np.float32) for _ in range(6)]
+
+
+def test_nadam_matches_kernel_math():
+    beta1, beta2, eps, psi, lr = 0.9, 0.999, 1e-8, 0.004, 0.01
+    p = _make_param(X0)
+    o = opt.NAdam(learning_rate=lr, beta1=beta1, beta2=beta2, epsilon=eps,
+                  momentum_decay=psi, parameters=[p])
+    hist = _run_steps(o, p, GRADS)
+
+    # oracle: nadam_kernel_impl.h
+    x = X0.astype(np.float64)
+    m = np.zeros_like(x)
+    v = np.zeros_like(x)
+    mu_prod = 1.0
+    for t, g in enumerate(GRADS, start=1):
+        g = g.astype(np.float64)
+        md_pow = 0.96 ** t
+        mu_t = beta1 * (1 - 0.5 * md_pow ** psi)
+        mu_t1 = beta1 * (1 - 0.5 * md_pow ** psi * 0.96 ** psi)
+        mu_prod = mu_prod * mu_t
+        m = beta1 * m + (1 - beta1) * g
+        v = beta2 * v + (1 - beta2) * g * g
+        m_hat = mu_t1 * m / (1 - mu_prod * mu_t1) + \
+            (1 - mu_t) * g / (1 - mu_prod)
+        v_hat = v / (1 - beta2 ** t)
+        x = x - lr * m_hat / (np.sqrt(v_hat) + eps)
+    np.testing.assert_allclose(hist[-1], x, rtol=2e-5, atol=2e-6)
+
+
+def test_radam_matches_kernel_math():
+    beta1, beta2, eps, lr = 0.9, 0.999, 1e-8, 0.01
+    p = _make_param(X0)
+    o = opt.RAdam(learning_rate=lr, beta1=beta1, beta2=beta2, epsilon=eps,
+                  parameters=[p])
+    hist = _run_steps(o, p, GRADS)
+
+    x = X0.astype(np.float64)
+    m = np.zeros_like(x)
+    v = np.zeros_like(x)
+    rho_inf = 2 / (1 - beta2) - 1
+    for t, g in enumerate(GRADS, start=1):
+        g = g.astype(np.float64)
+        b1p, b2p = beta1 ** t, beta2 ** t
+        rho_t = rho_inf - 2 * t * b2p / (1 - b2p)
+        m = beta1 * m + (1 - beta1) * g
+        v = beta2 * v + (1 - beta2) * g * g
+        m_hat = m / (1 - b1p)
+        if rho_t > 5:
+            l_t = np.sqrt(1 - b2p) / (np.sqrt(v) + eps)
+            r_t = np.sqrt(((rho_t - 4) * (rho_t - 2) * rho_inf)
+                          / ((rho_inf - 4) * (rho_inf - 2) * rho_t))
+            x = x - lr * m_hat * r_t * l_t
+        else:
+            x = x - lr * m_hat
+    np.testing.assert_allclose(hist[-1], x, rtol=2e-5, atol=2e-6)
+
+
+def test_rprop_matches_kernel_math_and_torch():
+    lr = 0.01
+    p = _make_param(X0)
+    o = opt.Rprop(learning_rate=lr, learning_rate_range=(1e-5, 50.0),
+                  etas=(0.5, 1.2), parameters=[p])
+    hist = _run_steps(o, p, GRADS)
+
+    # oracle: rprop_kernel.cc
+    x = X0.astype(np.float64)
+    prev = np.zeros_like(x)
+    lrs = np.full_like(x, lr)
+    for g in GRADS:
+        g = g.astype(np.float64)
+        prod = g * prev
+        eta = np.where(prod > 0, 1.2, np.where(prod < 0, 0.5, 1.0))
+        g = np.where(prod < 0, 0.0, g)
+        lrs = np.clip(lrs * eta, 1e-5, 50.0)
+        x = x - np.sign(g) * lrs
+        prev = g
+    np.testing.assert_allclose(hist[-1], x, rtol=1e-5, atol=1e-6)
+
+    torch = pytest.importorskip("torch")
+    tp = torch.tensor(X0.astype(np.float64), requires_grad=True)
+    to = torch.optim.Rprop([tp], lr=lr, etas=(0.5, 1.2),
+                           step_sizes=(1e-5, 50.0))
+    for g in GRADS:
+        tp.grad = torch.tensor(g.astype(np.float64))
+        to.step()
+    np.testing.assert_allclose(hist[-1], tp.detach().numpy(), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_asgd_matches_kernel_math():
+    lr, n = 0.1, 3
+    p = _make_param(X0)
+    o = opt.ASGD(learning_rate=lr, batch_num=n, parameters=[p])
+    hist = _run_steps(o, p, GRADS)
+
+    # oracle: asgd_kernel.cc + the python wrapper's rotating ys index
+    x = X0.astype(np.float64)
+    d = np.zeros_like(x)
+    ys = np.zeros((n,) + x.shape)
+    for t, g in enumerate(GRADS, start=1):
+        g = g.astype(np.float64)
+        idx = (t - 1) % n
+        d = d - ys[idx] + g
+        ys[idx] = g
+        n_eff = min(t, n)
+        x = x - (lr / n_eff) * d
+    np.testing.assert_allclose(hist[-1], x, rtol=1e-5, atol=1e-6)
+
+
+def test_weight_decay_coupled():
+    # wd adds wd*p to the grad (L2-style, like Adam's coupled path)
+    lr, wd = 0.01, 0.1
+    p = _make_param(X0)
+    o = opt.RAdam(learning_rate=lr, weight_decay=wd, parameters=[p])
+    p2 = _make_param(X0)
+    o2 = opt.RAdam(learning_rate=lr, parameters=[p2])
+    g = GRADS[0]
+    p.grad = paddle.to_tensor(g)
+    o.step()
+    p2.grad = paddle.to_tensor(g + wd * X0)
+    o2.step()
+    np.testing.assert_allclose(p.numpy(), p2.numpy(), rtol=1e-6, atol=1e-7)
+
+
+@pytest.mark.parametrize("line_search", [None, "strong_wolfe"])
+def test_lbfgs_quadratic_converges_like_torch(line_search):
+    """Minimize 0.5 x^T A x - b x; LBFGS should match torch's trajectory."""
+    torch = pytest.importorskip("torch")
+    rng = np.random.default_rng(0)
+    A_half = rng.normal(size=(6, 6))
+    A = (A_half @ A_half.T + 6 * np.eye(6)).astype(np.float32)
+    b = rng.normal(size=(6,)).astype(np.float32)
+    x0 = rng.normal(size=(6,)).astype(np.float32)
+
+    p = _make_param(x0)
+    o = opt.LBFGS(learning_rate=1.0, max_iter=10, history_size=5,
+                  line_search_fn=line_search, parameters=[p])
+
+    At = paddle.to_tensor(A)
+    bt = paddle.to_tensor(b)
+
+    def closure():
+        o.clear_grad()
+        loss = 0.5 * paddle.sum(p * paddle.matmul(At, p)) - paddle.sum(bt * p)
+        loss.backward()
+        return loss
+
+    for _ in range(3):
+        o.step(closure)
+
+    tp = torch.tensor(x0, requires_grad=True)
+    to = torch.optim.LBFGS([tp], lr=1.0, max_iter=10, history_size=5,
+                           line_search_fn=line_search)
+    tA = torch.tensor(A)
+    tb = torch.tensor(b)
+
+    def tclosure():
+        to.zero_grad()
+        loss = 0.5 * tp @ tA @ tp - tb @ tp
+        loss.backward()
+        return loss
+
+    for _ in range(3):
+        to.step(tclosure)
+
+    x_star = np.linalg.solve(A.astype(np.float64), b.astype(np.float64))
+    np.testing.assert_allclose(p.numpy(), x_star, rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(p.numpy(), tp.detach().numpy(), rtol=1e-3,
+                               atol=1e-3)
+
+
+def test_state_dict_roundtrip_nadam():
+    p = _make_param(X0)
+    o = opt.NAdam(learning_rate=0.01, parameters=[p])
+    _run_steps(o, p, GRADS[:3])
+    sd = o.state_dict()
+
+    p2 = _make_param(X0)
+    o2 = opt.NAdam(learning_rate=0.01, parameters=[p2])
+    _run_steps(o2, p2, GRADS[:3])   # same trajectory, then load state anyway
+    o2.set_state_dict(sd)
+    p2._data = p._data
+
+    h1 = _run_steps(o, p, GRADS[3:])
+    h2 = _run_steps(o2, p2, GRADS[3:])
+    np.testing.assert_allclose(h1[-1], h2[-1], rtol=1e-6, atol=1e-7)
+
+
+def test_lbfgs_state_dict_roundtrip():
+    p = _make_param(X0)
+    o = opt.LBFGS(learning_rate=1.0, max_iter=3, history_size=4,
+                  parameters=[p])
+
+    def closure():
+        o.clear_grad()
+        loss = paddle.sum(p * p)
+        loss.backward()
+        return loss
+
+    o.step(closure)
+    sd = o.state_dict()
+    assert "state" in sd and sd["state"]["n_iter"] > 0
+    o2 = opt.LBFGS(learning_rate=1.0, max_iter=3, history_size=4,
+                   parameters=[p])
+    o2.set_state_dict(sd)
+    assert o2.state["n_iter"] == o.state["n_iter"]
+    o2.step(closure)  # continues from restored curvature history

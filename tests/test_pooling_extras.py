@@ -1,0 +1,189 @@
+"""max_pool return_mask (1d/2d/3d), max_unpool, fractional_max_pool vs torch.
+
+Oracle: torch.nn.functional (identical index/unpool semantics; fractional
+pooling is checked against the reference kernel's start/end math instead,
+since torch's random-sample handling differs).
+"""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+RNG = np.random.default_rng(3)
+
+
+@pytest.mark.parametrize("nd,shape,k,s,p", [
+    (1, (2, 3, 16), 2, 2, 0),
+    (2, (2, 3, 8, 8), 2, 2, 0),
+    (2, (2, 3, 9, 9), 3, 2, 1),
+    (3, (2, 2, 6, 6, 6), 2, 2, 0),
+])
+def test_max_pool_return_mask_matches_torch(nd, shape, k, s, p):
+    x = RNG.normal(size=shape).astype(np.float32)
+    f = {1: F.max_pool1d, 2: F.max_pool2d, 3: F.max_pool3d}[nd]
+    out, mask = f(paddle.to_tensor(x), k, s, p, return_mask=True)
+    tf = {1: torch.nn.functional.max_pool1d, 2: torch.nn.functional.max_pool2d,
+          3: torch.nn.functional.max_pool3d}[nd]
+    tout, tidx = tf(torch.tensor(x), k, s, p, return_indices=True)
+    np.testing.assert_allclose(out.numpy(), tout.numpy(), rtol=1e-6)
+    np.testing.assert_array_equal(mask.numpy(), tidx.numpy())
+
+
+@pytest.mark.parametrize("nd", [1, 2, 3])
+def test_max_unpool_roundtrip_matches_torch(nd):
+    shape = {1: (2, 3, 16), 2: (2, 3, 8, 10), 3: (2, 2, 4, 6, 8)}[nd]
+    x = RNG.normal(size=shape).astype(np.float32)
+    k, s = 2, 2
+    f = {1: F.max_pool1d, 2: F.max_pool2d, 3: F.max_pool3d}[nd]
+    unf = {1: F.max_unpool1d, 2: F.max_unpool2d, 3: F.max_unpool3d}[nd]
+    out, mask = f(paddle.to_tensor(x), k, s, return_mask=True)
+    rec = unf(out, mask, k, s)
+
+    tf = {1: torch.nn.functional.max_pool1d, 2: torch.nn.functional.max_pool2d,
+          3: torch.nn.functional.max_pool3d}[nd]
+    tunf = {1: torch.nn.functional.max_unpool1d,
+            2: torch.nn.functional.max_unpool2d,
+            3: torch.nn.functional.max_unpool3d}[nd]
+    tout, tidx = tf(torch.tensor(x), k, s, return_indices=True)
+    trec = tunf(tout, tidx, k, s)
+    np.testing.assert_allclose(rec.numpy(), trec.numpy(), rtol=1e-6)
+
+
+def test_max_unpool2d_output_size():
+    x = RNG.normal(size=(1, 2, 7, 7)).astype(np.float32)
+    out, mask = F.max_pool2d(paddle.to_tensor(x), 2, 2, return_mask=True)
+    rec = F.max_unpool2d(out, mask, 2, 2, output_size=(7, 7))
+    assert tuple(rec.shape) == (1, 2, 7, 7)
+    tout, tidx = torch.nn.functional.max_pool2d(torch.tensor(x), 2, 2,
+                                                return_indices=True)
+    trec = torch.nn.functional.max_unpool2d(tout, tidx, 2, 2,
+                                            output_size=(7, 7))
+    np.testing.assert_allclose(rec.numpy(), trec.numpy(), rtol=1e-6)
+
+
+def _frac_oracle(x, output_size, kernel_size, u0):
+    """NumPy transcription of phi/kernels/funcs/pooling.h fractional helpers."""
+    nd = x.ndim - 2
+    o = (output_size,) * nd if isinstance(output_size, int) else output_size
+    ks = ((kernel_size,) * nd if isinstance(kernel_size, int) else
+          kernel_size) if kernel_size is not None else (0,) * nd
+    spatial = x.shape[2:]
+    windows = []
+    for d in range(nd):
+        inp, out, pool = spatial[d], o[d], ks[d]
+        alpha = (inp - pool) / (out - (1 if pool > 0 else 0))
+        if pool > 0:
+            u = u0
+        else:
+            base = inp // out
+            u_max1 = (base + 2) / alpha - 1
+            u_max2 = (inp + 1 - base) / alpha - (out - 1)
+            u = u0 * min(u_max1, u_max2)
+        st = [int((i + u) * alpha) - int(u * alpha) for i in range(out)]
+        en = ([s_ + pool for s_ in st] if pool > 0 else
+              [int((i + 1 + u) * alpha) - int(u * alpha) for i in range(out)])
+        st = [max(s_, 0) for s_ in st]
+        en = [min(e, inp) for e in en]
+        windows.append(list(zip(st, en)))
+    n, c = x.shape[:2]
+    out_arr = np.zeros((n, c) + tuple(o), x.dtype)
+    import itertools
+    for pos in itertools.product(*[range(oo) for oo in o]):
+        sl = tuple(slice(*windows[d][pos[d]]) for d in range(nd))
+        out_arr[(slice(None), slice(None)) + pos] = \
+            x[(slice(None), slice(None)) + sl].max(
+                axis=tuple(range(2, 2 + nd)))
+    return out_arr
+
+
+@pytest.mark.parametrize("kernel_size", [None, 2])
+def test_fractional_max_pool2d_matches_kernel_math(kernel_size):
+    x = RNG.normal(size=(2, 3, 11, 13)).astype(np.float32)
+    u = 0.37
+    out = F.fractional_max_pool2d(paddle.to_tensor(x), (5, 6),
+                                  kernel_size=kernel_size, random_u=u)
+    ref = _frac_oracle(x, (5, 6), kernel_size, u)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-6)
+
+
+def test_fractional_max_pool3d_with_mask():
+    x = RNG.normal(size=(1, 2, 8, 9, 10)).astype(np.float32)
+    u = 0.61
+    out, mask = F.fractional_max_pool3d(paddle.to_tensor(x), (4, 4, 5),
+                                        random_u=u, return_mask=True)
+    ref = _frac_oracle(x, (4, 4, 5), None, u)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-6)
+    # mask flat indices must address the max values in the input plane
+    n, c = x.shape[:2]
+    flat = x.reshape(n, c, -1)
+    gathered = np.take_along_axis(flat, mask.numpy().reshape(n, c, -1),
+                                  axis=2).reshape(out.shape)
+    np.testing.assert_allclose(gathered, out.numpy(), rtol=1e-6)
+
+
+def test_max_pool_mask_grad_flows():
+    x = paddle.to_tensor(RNG.normal(size=(1, 1, 4, 4)).astype(np.float32))
+    x.stop_gradient = False
+    out, mask = F.max_pool2d(x, 2, 2, return_mask=True)
+    loss = paddle.sum(out)
+    loss.backward()
+    g = x.grad.numpy()
+    assert g.sum() == 4.0  # one 1 per window
+    assert set(np.unique(g)) <= {0.0, 1.0}
+
+
+def test_layers_exist():
+    import paddle_tpu.nn as nn
+    up = nn.MaxUnPool2D(2, 2)
+    fp = nn.FractionalMaxPool2D((3, 3), random_u=0.5)
+    x = paddle.to_tensor(RNG.normal(size=(1, 2, 8, 8)).astype(np.float32))
+    out, mask = F.max_pool2d(x, 2, 2, return_mask=True)
+    assert tuple(up(out, mask).shape) == (1, 2, 8, 8)
+    assert tuple(fp(x).shape) == (1, 2, 3, 3)
+
+
+def test_max_pool2d_ceil_mode_mask_matches_torch():
+    x = RNG.normal(size=(2, 3, 8, 8)).astype(np.float32)
+    out, mask = F.max_pool2d(paddle.to_tensor(x), 3, 2, 0, return_mask=True,
+                             ceil_mode=True)
+    tout, tidx = torch.nn.functional.max_pool2d(
+        torch.tensor(x), 3, 2, 0, ceil_mode=True, return_indices=True)
+    assert tuple(out.shape) == tuple(tout.shape)
+    assert tuple(mask.shape) == tuple(tidx.shape)
+    np.testing.assert_allclose(out.numpy(), tout.numpy(), rtol=1e-6)
+    np.testing.assert_array_equal(mask.numpy(), tidx.numpy())
+
+
+def test_max_pool1d_nlc_return_mask():
+    x = RNG.normal(size=(2, 16, 3)).astype(np.float32)  # [N, L, C]
+    out, mask = F.max_pool1d(paddle.to_tensor(x), 2, 2, data_format="NLC",
+                             return_mask=True)
+    assert tuple(out.shape) == (2, 8, 3)
+    assert tuple(mask.shape) == (2, 8, 3)
+    # indices address positions in the L plane
+    ref_out, ref_mask = F.max_pool1d(
+        paddle.to_tensor(np.moveaxis(x, -1, 1)), 2, 2, return_mask=True)
+    np.testing.assert_allclose(np.moveaxis(out.numpy(), -1, 1),
+                               ref_out.numpy(), rtol=1e-6)
+    np.testing.assert_array_equal(np.moveaxis(mask.numpy(), -1, 1),
+                                  ref_mask.numpy())
+
+
+def test_fractional_max_pool2d_kernel_matches_torch():
+    # with kernel_size, the window layout must match torch's sampler
+    # (last window anchored at input - kernel)
+    x = RNG.normal(size=(2, 3, 11, 13)).astype(np.float32)
+    u = 0.6
+    out, mask = F.fractional_max_pool2d(paddle.to_tensor(x), (4, 5),
+                                        kernel_size=2, random_u=u,
+                                        return_mask=True)
+    samples = torch.full((2, 3, 2), u, dtype=torch.float32)
+    tout, tidx = torch.nn.functional.fractional_max_pool2d(
+        torch.tensor(x), 2, output_size=(4, 5), _random_samples=samples,
+        return_indices=True)
+    np.testing.assert_allclose(out.numpy(), tout.numpy(), rtol=1e-6)
+    np.testing.assert_array_equal(mask.numpy(), tidx.numpy())

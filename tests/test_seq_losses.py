@@ -1,0 +1,279 @@
+"""ctc_loss / rnnt_loss / margin_cross_entropy / hsigmoid_loss /
+viterbi_decode / metric.accuracy vs oracles.
+
+ctc_loss: torch.nn.functional.ctc_loss (identical semantics).
+rnnt_loss + viterbi_decode: NumPy brute-force path enumeration.
+hsigmoid_loss: NumPy transcription of matrix_bit_code.h SimpleCode.
+"""
+import itertools
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+RNG = np.random.default_rng(11)
+
+
+# ---- CTC --------------------------------------------------------------------
+
+@pytest.mark.parametrize("reduction", ["mean", "sum", "none"])
+def test_ctc_loss_matches_torch(reduction):
+    torch = pytest.importorskip("torch")
+    T, B, C, L = 12, 3, 5, 4
+    logits = RNG.normal(size=(T, B, C)).astype(np.float32)
+    labels = RNG.integers(1, C, size=(B, L)).astype(np.int32)
+    ilen = np.array([12, 10, 7], np.int32)
+    llen = np.array([4, 3, 2], np.int32)
+
+    out = F.ctc_loss(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                     paddle.to_tensor(ilen), paddle.to_tensor(llen),
+                     blank=0, reduction=reduction)
+
+    tlp = torch.tensor(logits).log_softmax(-1)
+    tl = torch.nn.functional.ctc_loss(
+        tlp, torch.tensor(labels.astype(np.int64)), torch.tensor(ilen),
+        torch.tensor(llen), blank=0, reduction="none", zero_infinity=False)
+    if reduction == "mean":
+        # paddle mean = mean(loss / label_lengths)
+        expect = (tl.numpy() / llen).mean()
+    elif reduction == "sum":
+        expect = tl.numpy().sum()
+    else:
+        expect = tl.numpy()
+    np.testing.assert_allclose(np.asarray(out.numpy()).reshape(-1),
+                               np.asarray(expect).reshape(-1),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_ctc_loss_grad_flows():
+    T, B, C, L = 6, 2, 4, 2
+    logits = paddle.to_tensor(RNG.normal(size=(T, B, C)).astype(np.float32))
+    logits.stop_gradient = False
+    loss = F.ctc_loss(logits, paddle.to_tensor([[1, 2], [3, 1]]),
+                      paddle.to_tensor([6, 5]), paddle.to_tensor([2, 2]))
+    loss.backward()
+    g = logits.grad.numpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+# ---- RNNT -------------------------------------------------------------------
+
+def _rnnt_brute(x, label, T, U, blank=0):
+    """Sum over all monotone alignments (T-1 blanks interleaved with U emits,
+    ending with a final blank)."""
+    lp = x - np.log(np.exp(x).sum(-1, keepdims=True))
+    # paths: sequences of moves from (0,0) to (T-1,U) then final blank
+    total = -np.inf
+
+    def go(t, u, acc):
+        nonlocal total
+        if t == T - 1 and u == U:
+            total = np.logaddexp(total, acc + lp[t, u, blank])
+            return
+        if t < T - 1:
+            go(t + 1, u, acc + lp[t, u, blank])
+        if u < U:
+            go(t, u + 1, acc + lp[t, u, label[u]])
+    go(0, 0, 0.0)
+    return -total
+
+
+def test_rnnt_loss_matches_brute_force():
+    B, T, U, V = 2, 4, 3, 5
+    x = RNG.normal(size=(B, T, U + 1, V)).astype(np.float32)
+    label = RNG.integers(1, V, size=(B, U)).astype(np.int32)
+    ilen = np.array([4, 3], np.int32)
+    llen = np.array([3, 2], np.int32)
+    out = F.rnnt_loss(paddle.to_tensor(x), paddle.to_tensor(label),
+                      paddle.to_tensor(ilen), paddle.to_tensor(llen),
+                      reduction="none")
+    expect = np.array([
+        _rnnt_brute(x[0].astype(np.float64), label[0], 4, 3),
+        _rnnt_brute(x[1].astype(np.float64), label[1], 3, 2),
+    ])
+    np.testing.assert_allclose(np.asarray(out.numpy()).reshape(-1), expect,
+                               rtol=1e-4, atol=1e-5)
+
+
+# ---- margin cross entropy ---------------------------------------------------
+
+def test_margin_cross_entropy_reduces_to_softmax_ce():
+    # m1=1, m2=0, m3=0 => plain scaled softmax cross entropy
+    n, c = 4, 6
+    feats = RNG.normal(size=(n, c))
+    cos = (feats / np.linalg.norm(feats, axis=1, keepdims=True)).astype(
+        np.float32)
+    y = RNG.integers(0, c, size=(n,)).astype(np.int64)
+    loss = F.margin_cross_entropy(paddle.to_tensor(cos), paddle.to_tensor(y),
+                                  margin1=1.0, margin2=0.0, margin3=0.0,
+                                  scale=10.0, reduction="mean")
+    z = 10.0 * cos
+    logp = z - np.log(np.exp(z).sum(-1, keepdims=True))
+    expect = -logp[np.arange(n), y].mean()
+    np.testing.assert_allclose(float(loss.numpy()), expect, rtol=1e-5)
+
+
+def test_margin_cross_entropy_arcface_margin():
+    n, c = 3, 5
+    feats = RNG.normal(size=(n, c))
+    cos = (feats / np.linalg.norm(feats, axis=1, keepdims=True)).astype(
+        np.float32)
+    y = np.array([0, 2, 4], np.int64)
+    m1, m2, m3, s = 1.0, 0.5, 0.1, 64.0
+    loss, sm = F.margin_cross_entropy(
+        paddle.to_tensor(cos), paddle.to_tensor(y), margin1=m1, margin2=m2,
+        margin3=m3, scale=s, return_softmax=True, reduction="none")
+    z = cos.astype(np.float64).copy()
+    tgt = np.clip(z[np.arange(n), y], -1, 1)
+    z[np.arange(n), y] = np.cos(m1 * np.arccos(tgt) + m2) - m3
+    z *= s
+    logp = z - np.log(np.exp(z).sum(-1, keepdims=True))
+    expect = -logp[np.arange(n), y]
+    np.testing.assert_allclose(np.asarray(loss.numpy()).reshape(-1), expect,
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(sm.numpy()), np.exp(logp),
+                               rtol=1e-4, atol=1e-6)
+
+
+# ---- hsigmoid ---------------------------------------------------------------
+
+def _hsig_oracle(x, y, num_classes, w, b):
+    """matrix_bit_code.h SimpleCode transcription."""
+    n = x.shape[0]
+    out = np.zeros((n, 1))
+    for i in range(n):
+        c = int(y[i]) + num_classes
+        length = int(math.floor(math.log2(c)))
+        s = 0.0
+        for d in range(length):
+            idx = (c >> (d + 1)) - 1
+            bit = (c >> d) & 1
+            pre = x[i] @ w[idx] + (b[idx, 0] if b is not None else 0.0)
+            s += np.log1p(np.exp(pre)) - bit * pre
+        out[i, 0] = s
+    return out
+
+
+def test_hsigmoid_loss_default_tree():
+    n, d, C = 5, 3, 7
+    x = RNG.normal(size=(n, d)).astype(np.float32)
+    y = RNG.integers(0, C, size=(n,)).astype(np.int64)
+    w = RNG.normal(size=(C - 1, d)).astype(np.float32)
+    b = RNG.normal(size=(C - 1, 1)).astype(np.float32)
+    out = F.hsigmoid_loss(paddle.to_tensor(x), paddle.to_tensor(y), C,
+                          paddle.to_tensor(w), paddle.to_tensor(b))
+    expect = _hsig_oracle(x.astype(np.float64), y, C, w.astype(np.float64),
+                          b.astype(np.float64))
+    np.testing.assert_allclose(np.asarray(out.numpy()), expect, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_hsigmoid_layer_trains():
+    import paddle_tpu.nn as nn
+    layer = nn.HSigmoidLoss(4, 6)
+    x = paddle.to_tensor(RNG.normal(size=(3, 4)).astype(np.float32))
+    y = paddle.to_tensor(np.array([0, 3, 5], np.int64))
+    loss = paddle.mean(layer(x, y))
+    loss.backward()
+    assert layer.weight.grad is not None
+    assert np.isfinite(layer.weight.grad.numpy()).all()
+
+
+# ---- viterbi ----------------------------------------------------------------
+
+def _viterbi_brute(pot, trans, length, include_tag):
+    T, N = pot.shape
+    best, best_path = -np.inf, None
+    for path in itertools.product(range(N), repeat=length):
+        s = pot[0, path[0]]
+        if include_tag:
+            s += trans[N - 1, path[0]]
+        for t in range(1, length):
+            s += trans[path[t - 1], path[t]] + pot[t, path[t]]
+        if include_tag:
+            s += trans[N - 2, path[length - 1]]
+        if s > best:
+            best, best_path = s, path
+    return best, list(best_path)
+
+
+@pytest.mark.parametrize("include_tag", [True, False])
+def test_viterbi_decode_matches_brute_force(include_tag):
+    B, T, N = 3, 5, 4
+    pot = RNG.normal(size=(B, T, N)).astype(np.float32)
+    trans = RNG.normal(size=(N, N)).astype(np.float32)
+    lens = np.array([5, 3, 1], np.int64)
+    scores, paths = paddle.text.viterbi_decode(
+        paddle.to_tensor(pot), paddle.to_tensor(trans),
+        paddle.to_tensor(lens), include_bos_eos_tag=include_tag)
+    scores = np.asarray(scores.numpy())
+    paths = np.asarray(paths.numpy())
+    for b in range(B):
+        es, ep = _viterbi_brute(pot[b].astype(np.float64),
+                                trans.astype(np.float64), int(lens[b]),
+                                include_tag)
+        np.testing.assert_allclose(scores[b], es, rtol=1e-5)
+        assert list(paths[b][:int(lens[b])]) == ep
+        assert (paths[b][int(lens[b]):] == 0).all()
+
+
+def test_viterbi_decoder_class():
+    trans = paddle.to_tensor(RNG.normal(size=(3, 3)).astype(np.float32))
+    dec = paddle.text.ViterbiDecoder(trans, include_bos_eos_tag=False)
+    pot = paddle.to_tensor(RNG.normal(size=(2, 4, 3)).astype(np.float32))
+    scores, paths = dec(pot, paddle.to_tensor(np.array([4, 4], np.int64)))
+    assert tuple(paths.shape) == (2, 4)
+
+
+# ---- accuracy ---------------------------------------------------------------
+
+def test_metric_accuracy_topk():
+    x = np.array([[0.1, 0.9, 0.0], [0.8, 0.05, 0.15], [0.2, 0.3, 0.5]],
+                 np.float32)
+    y = np.array([1, 2, 2], np.int64)
+    acc1 = paddle.metric.accuracy(paddle.to_tensor(x), paddle.to_tensor(y),
+                                  k=1)
+    acc2 = paddle.metric.accuracy(paddle.to_tensor(x), paddle.to_tensor(y),
+                                  k=2)
+    np.testing.assert_allclose(float(acc1.numpy()), 2 / 3, rtol=1e-6)
+    np.testing.assert_allclose(float(acc2.numpy()), 1.0, rtol=1e-6)
+
+
+def test_hsigmoid_layer_bias_attr_false():
+    import paddle_tpu.nn as nn
+    layer = nn.HSigmoidLoss(4, 6, bias_attr=False)
+    assert layer.bias is None
+    x = paddle.to_tensor(RNG.normal(size=(2, 4)).astype(np.float32))
+    y = paddle.to_tensor(np.array([1, 5], np.int64))
+    out = layer(x, y)
+    expect = _hsig_oracle(np.asarray(x.numpy(), np.float64), y.numpy(), 6,
+                          np.asarray(layer.weight.numpy(), np.float64), None)
+    np.testing.assert_allclose(np.asarray(out.numpy()), expect, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_margin_cross_entropy_grad_finite_at_cos_one():
+    cos = paddle.to_tensor(np.array([[1.0, 0.2], [0.5, -0.3]], np.float32))
+    cos.stop_gradient = False
+    loss = F.margin_cross_entropy(cos, paddle.to_tensor(
+        np.array([0, 1], np.int64)))
+    loss.backward()
+    assert np.isfinite(cos.grad.numpy()).all()
+
+
+def test_ctc_loss_empty_labels():
+    # all-blank batch: NLL = -sum over valid frames of log p(blank)
+    T, B, C = 4, 2, 5
+    logits = RNG.normal(size=(T, B, C)).astype(np.float32)
+    out = F.ctc_loss(paddle.to_tensor(logits),
+                     paddle.to_tensor(np.zeros((B, 0), np.int32)),
+                     paddle.to_tensor(np.array([4, 3], np.int32)),
+                     paddle.to_tensor(np.array([0, 0], np.int32)),
+                     reduction="none")
+    lp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+    expect = np.array([-lp[:4, 0, 0].sum(), -lp[:3, 1, 0].sum()])
+    np.testing.assert_allclose(np.asarray(out.numpy()).reshape(-1), expect,
+                               rtol=1e-5, atol=1e-5)
