@@ -6,6 +6,7 @@ lax.reduce_window (XLA pools natively; no pooling kernels to write).
 from __future__ import annotations
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -32,6 +33,32 @@ def _pads(padding, n):
     return pairs
 
 
+def _out_size(in_sz, pl, pr, k, s, ceil_mode):
+    """Pooled output length per the paddle/torch (cuDNN) convention: with
+    ceil_mode, a window that would start entirely in the right padding is
+    dropped."""
+    size = in_sz + pl + pr
+    if ceil_mode:
+        out = -(-(size - k) // s) + 1
+        if (out - 1) * s >= in_sz + pl:
+            out -= 1
+    else:
+        out = (size - k) // s + 1
+    return out
+
+
+def _resolve_string_pads(in_sizes, k, s, mode):
+    """Explicit (lo, hi) pads matching XLA's SAME/VALID for reduce_window."""
+    if mode == "VALID":
+        return [(0, 0)] * len(in_sizes)
+    pads = []
+    for in_sz, kk, ss in zip(in_sizes, k, s):
+        out = -(-in_sz // ss)
+        total = max((out - 1) * ss + kk - in_sz, 0)
+        pads.append((total // 2, total - total // 2))
+    return pads
+
+
 def _pool(name, x, ksize, stride, padding, nd, reducer, init, channel_last,
           ceil_mode=False, exclusive=True, count_include_pad=False):
     k = _norm(ksize, nd)
@@ -52,13 +79,11 @@ def _pool(name, x, ksize, stride, padding, nd, reducer, init, channel_last,
         else:
             full = [(0, 0)] * a.ndim
             for ax, pr in zip(spatial_axes, p):
-                extra = 0
-                if ceil_mode:
-                    size = a.shape[ax] + pr[0] + pr[1]
-                    kk, ss = window[ax], strides[ax]
-                    rem = (size - kk) % ss
-                    if rem != 0:
-                        extra = ss - rem
+                kk, ss = window[ax], strides[ax]
+                out_t = _out_size(a.shape[ax], pr[0], pr[1], kk, ss,
+                                  ceil_mode)
+                extra = max(0, (out_t - 1) * ss + kk
+                            - (a.shape[ax] + pr[0] + pr[1]))
                 full[ax] = (pr[0], pr[1] + extra)
             pads = full
         if name.startswith("max"):
@@ -130,18 +155,19 @@ def _pool_mask(x, out, kernel_size, stride, padding, nd, ceil_mode=False,
         n, c = a.shape[:2]
         spatial = a.shape[2:]
         pad = ([list(pr) for pr in p] if not isinstance(p, str)
-               else [[0, 0]] * nd)
-        if ceil_mode:
-            for d in range(nd):
-                size = spatial[d] + pad[d][0] + pad[d][1]
-                rem = (size - k[d]) % s[d]
-                if rem != 0:
-                    pad[d][1] += s[d] - rem
+               else [list(pr) for pr in
+                     _resolve_string_pads(spatial, k, s, p)])
+        out_sz = []
+        for d in range(nd):
+            out_t = _out_size(spatial[d], pad[d][0], pad[d][1], k[d], s[d],
+                              ceil_mode)
+            out_sz.append(out_t)
+            pad[d][1] = max(pad[d][1],
+                            (out_t - 1) * s[d] + k[d]
+                            - spatial[d] - pad[d][0])
         neg = jnp.finfo(a.dtype).min
         a_p = jnp.pad(a, [(0, 0), (0, 0)] + [(pl, pr) for pl, pr in pad],
                       constant_values=neg)
-        out_sz = [(spatial[d] + pad[d][0] + pad[d][1] - k[d]) // s[d] + 1
-                  for d in range(nd)]
         # row-major strides of the UNPADDED spatial plane
         plane_strides = [1] * nd
         for d in range(nd - 2, -1, -1):
@@ -184,11 +210,21 @@ def _max_unpool(name, x, indices, kernel_size, stride, padding, nd,
     else:
         out_spatial = tuple(int(v) for v in tuple(output_size)[-nd:])
 
+    numel = 1
+    for d in out_spatial:
+        numel *= d
+    # eager-mode index validation (parity: the reference unpool kernel raises
+    # on out-of-range indices; inside a trace XLA scatter drops them instead)
+    if not isinstance(it._data, jax.core.Tracer):
+        lo = int(jnp.min(it._data)) if it._data.size else 0
+        hi = int(jnp.max(it._data)) if it._data.size else 0
+        if lo < 0 or hi >= numel:
+            raise ValueError(
+                f"{name}: indices out of range [0, {numel}) "
+                f"(got min={lo}, max={hi}); check output_size/padding")
+
     def fwd(a, idx):
         n, c = a.shape[:2]
-        numel = 1
-        for d in out_spatial:
-            numel *= d
         flat_vals = a.reshape(n, c, -1)
         flat_idx = idx.reshape(n, c, -1).astype(jnp.int32)
         bi = jnp.arange(n)[:, None, None]
@@ -240,6 +276,16 @@ def _fractional_max_pool(name, x, output_size, kernel_size, random_u,
     starts, ends = [], []
     for d in range(nd):
         inp, out, pool = spatial[d], o[d], ks[d]
+        if out < 1 or out > inp:
+            raise ValueError(
+                f"fractional pool output_size[{d}]={out} must be in "
+                f"[1, input={inp}]")
+        if pool > 0 and out == 1:
+            # single window anchored at the end (the sampler's last-window
+            # rule; alpha is undefined for out == 1)
+            starts.append([inp - pool])
+            ends.append([inp])
+            continue
         alpha = (inp - pool) / (out - (1 if pool > 0 else 0))
         if pool > 0:
             u = u0

@@ -222,6 +222,241 @@ def pipeline_1f1b(h0, labels, consts, stacked_leaves, tail_leaves, *,
     return loss, d_h0, blk_g, tail_g
 
 
+def _interleaved_schedule(p: int, v: int, m: int):
+    """Static lockstep schedule for interleaved-VPP 1F1B.
+
+    Parity: PipelineParallelWithInterleave (pipeline_parallel.py:1308) —
+    device r owns virtual stages {j*p + r}; microbatches advance in groups of
+    p through the chunks. Rather than translating Megatron's per-rank
+    send/recv loop, the schedule is *simulated once on the host* (in-order
+    per-device queues, ASAP dispatch, 1-tick ICI transfer latency) and the
+    result is baked into [T, p] int tables the compiled region indexes per
+    tick. Returns dict of numpy arrays; -1 = idle.
+    """
+    import numpy as np_
+    V = v * p
+
+    # unit (i, s) lives on dev(s) = s % p with local chunk j = s // p;
+    # per-device in-order queues follow Megatron's group-of-p traversal
+    fwd_order = {r: [] for r in range(p)}
+    bwd_order = {r: [] for r in range(p)}
+    for r in range(p):
+        for g in range(0, m, p):
+            grp = list(range(g, min(g + p, m)))
+            for j in range(v):
+                for i in grp:
+                    fwd_order[r].append((i, j))
+            for j in reversed(range(v)):
+                for i in grp:
+                    bwd_order[r].append((i, j))
+
+    fwd_done = {}
+    bwd_done = {}
+    fq = [0] * p
+    bq = [0] * p
+    F_mb, F_ch, B_mb, B_ch = [], [], [], []
+    t = 0
+    limit = 4 * (m * v + 2 * p) + 16
+    while (any(bq[r] < len(bwd_order[r]) for r in range(p))) and t < limit:
+        f_row = [(-1, -1)] * p
+        b_row = [(-1, -1)] * p
+        for r in range(p):
+            if fq[r] < len(fwd_order[r]):
+                i, j = fwd_order[r][fq[r]]
+                s = j * p + r
+                if s == 0 or fwd_done.get((i, s - 1), 10 ** 9) + 1 <= t:
+                    f_row[r] = (i, j)
+                    fwd_done[(i, s)] = t
+                    fq[r] += 1
+        for r in range(p):
+            if bq[r] < len(bwd_order[r]):
+                i, j = bwd_order[r][bq[r]]
+                s = j * p + r
+                if s == V - 1:
+                    ok = fwd_done.get((i, s), 10 ** 9) <= t
+                else:
+                    ok = bwd_done.get((i, s + 1), 10 ** 9) + 1 <= t
+                if ok:
+                    b_row[r] = (i, j)
+                    bwd_done[(i, s)] = t
+                    bq[r] += 1
+        F_mb.append([x[0] for x in f_row])
+        F_ch.append([x[1] for x in f_row])
+        B_mb.append([x[0] for x in b_row])
+        B_ch.append([x[1] for x in b_row])
+        t += 1
+    if t >= limit:
+        raise RuntimeError("interleaved schedule did not converge")
+
+    T = t
+    F_mb = np_.asarray(F_mb, np_.int32)
+    F_ch = np_.asarray(F_ch, np_.int32)
+    B_mb = np_.asarray(B_mb, np_.int32)
+    B_ch = np_.asarray(B_ch, np_.int32)
+    # arrival tables: what lands on device r at tick t via each ring
+    RSF_mb = np_.full((T, p), -1, np_.int32)   # fwd ring: store x into
+    RSF_ch = np_.full((T, p), -1, np_.int32)   # in_buf[ch, mb]
+    RSB_mb = np_.full((T, p), -1, np_.int32)   # bwd ring: store dy into
+    RSB_ch = np_.full((T, p), -1, np_.int32)   # dy_buf[ch, mb]
+    for t_ in range(1, T):
+        for r in range(p):
+            src = (r - 1) % p
+            i, j = F_mb[t_ - 1, src], F_ch[t_ - 1, src]
+            if i >= 0:
+                s = int(j) * p + src
+                if s + 1 < V:
+                    RSF_mb[t_, r] = i
+                    RSF_ch[t_, r] = (s + 1) // p
+            srcb = (r + 1) % p
+            ib, jb = B_mb[t_ - 1, srcb], B_ch[t_ - 1, srcb]
+            if ib >= 0:
+                s = int(jb) * p + srcb
+                if s - 1 >= 0:
+                    RSB_mb[t_, r] = ib
+                    RSB_ch[t_, r] = (s - 1) // p
+    return {"T": T, "F_mb": F_mb, "F_ch": F_ch, "B_mb": B_mb, "B_ch": B_ch,
+            "RSF_mb": RSF_mb, "RSF_ch": RSF_ch, "RSB_mb": RSB_mb,
+            "RSB_ch": RSB_ch}
+
+
+def pipeline_interleaved(h0, labels, consts, stacked_leaves, tail_leaves, *,
+                         block_apply_flat, tail_apply_flat, axis_name: str,
+                         n_micro: int, vpp_chunks: int, remat: bool = True):
+    """Per-device interleaved-VPP 1F1B region (call inside shard_map).
+
+    True cross-phase overlap: one fwd micro-step and one bwd micro-step per
+    tick, with the (microbatch, chunk) choice driven by the host-simulated
+    schedule tables (see _interleaved_schedule) — fill/drain cost is the
+    (p-1)/v property of interleaving, not v sequential ring phases.
+
+    Activation stash and ring in/out buffers are indexed [chunk, microbatch]
+    (O(v*m) activations — simpler than Megatron's O(p) rotating stash; a
+    slot-reuse pass can shrink it later without changing the schedule).
+    h0: [m, mb, ...]; labels: [m, ...]; stacked_leaves: [L_local, ...] with
+    L_local = v * lc rows, chunk j = rows [j*lc, (j+1)*lc).
+    Returns (mean_loss, d_h0, blk_grads, tail_grads) like pipeline_1f1b.
+    """
+    p = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+    m, v = n_micro, vpp_chunks
+    sched = _interleaved_schedule(int(p), v, m)
+    T = sched["T"]
+    lc = stacked_leaves[0].shape[0] // v
+
+    def chunk_slices(leaves, j):
+        return [lax.dynamic_slice_in_dim(l, j * lc, lc, axis=0)
+                for l in leaves]
+
+    def stage_fn(x, leaves):
+        def body(h, leaf_slices):
+            return block_apply_flat(leaf_slices, h, *consts), None
+        step = jax.checkpoint(body) if remat else body
+        y, _ = lax.scan(step, x, leaves)
+        return y
+
+    def tail_fn(y, tleaves, label):
+        return tail_apply_flat(list(tleaves), y, label)
+
+    x0 = jnp.zeros_like(h0[0])
+    zeros_like_tree = lambda tr: jax.tree.map(jnp.zeros_like, tr)
+    buf_shape = (v, m) + h0.shape[1:]
+    carry0 = (
+        x0,                                   # x_recv
+        x0,                                   # dy_recv
+        jnp.zeros(buf_shape, h0.dtype),       # in_buf[ch, mb]
+        jnp.zeros(buf_shape, h0.dtype),       # dy_buf[ch, mb]
+        jnp.zeros(buf_shape, h0.dtype),       # stash[ch, mb]
+        jnp.float32(0.0),                     # loss accumulator
+        zeros_like_tree(list(stacked_leaves)),  # block grads
+        zeros_like_tree(list(tail_leaves)),     # tail grads
+        jnp.zeros_like(h0),                   # d_h0 accumulator
+    )
+    V = v * int(p)
+
+    tables = tuple(jnp.asarray(sched[k]) for k in
+                   ("F_mb", "F_ch", "B_mb", "B_ch",
+                    "RSF_mb", "RSF_ch", "RSB_mb", "RSB_ch"))
+
+    def tick(carry, xs):
+        (x_recv, dy_recv, in_buf, dy_buf, stash, loss_acc, blk_g, tail_g,
+         dh0_acc) = carry
+        f_mb, f_ch, b_mb, b_ch, rsf_mb, rsf_ch, rsb_mb, rsb_ch = [
+            row[rank] for row in xs]
+
+        # ---- store ring arrivals -----------------------------------------
+        def store(buf, val, ch, mb, valid):
+            ch_i = jnp.clip(ch, 0, v - 1)
+            mb_i = jnp.clip(mb, 0, m - 1)
+            cur = buf[ch_i, mb_i]
+            return buf.at[ch_i, mb_i].set(jnp.where(valid, val, cur))
+
+        in_buf = store(in_buf, x_recv, rsf_ch, rsf_mb, rsf_mb >= 0)
+        dy_buf = store(dy_buf, dy_recv, rsb_ch, rsb_mb, rsb_mb >= 0)
+
+        # ---- forward micro-step ------------------------------------------
+        fwd_valid = f_mb >= 0
+        fi = jnp.clip(f_mb, 0, m - 1)
+        fj = jnp.clip(f_ch, 0, v - 1)
+        s_virt = fj * p + rank
+        fresh = lax.dynamic_index_in_dim(h0, fi, 0, keepdims=False)
+        from_buf = in_buf[fj, fi]
+        x_in = jnp.where(s_virt == 0, fresh, from_buf)
+        y = stage_fn(x_in, chunk_slices(list(stacked_leaves), fj))
+        stash = store(stash, x_in, fj, fi, fwd_valid)
+
+        # last virtual stage: loss + dL/dy, fed straight into dy_buf
+        lab = lax.dynamic_index_in_dim(labels, fi, 0, keepdims=False)
+
+        def tail_branch(y_, tleaves):
+            loss_f, tl_vjp = jax.vjp(lambda yy, tl: tail_fn(yy, tl, lab),
+                                     y_, tleaves)
+            dh, dtail = tl_vjp(jnp.float32(1.0 / m))
+            return loss_f, dh, dtail
+
+        def tail_skip(y_, tleaves):
+            return (jnp.float32(0.0), jnp.zeros_like(y_),
+                    tuple(jnp.zeros_like(t_) for t_ in tleaves))
+
+        is_last_virt = fwd_valid & (s_virt == V - 1)
+        loss_f, dh_f, dtail_f = lax.cond(
+            is_last_virt, tail_branch, tail_skip, y, tuple(tail_leaves))
+        loss_acc = loss_acc + loss_f / m
+        tail_g = [tg + dt for tg, dt in zip(tail_g, dtail_f)]
+        dy_buf = store(dy_buf, dh_f.astype(h0.dtype), fj, fi, is_last_virt)
+
+        # ---- backward micro-step -----------------------------------------
+        bwd_valid = b_mb >= 0
+        bi = jnp.clip(b_mb, 0, m - 1)
+        bj = jnp.clip(b_ch, 0, v - 1)
+        sb_virt = bj * p + rank
+        x_b = stash[bj, bi]
+        dy_in = dy_buf[bj, bi]
+        _, st_vjp = jax.vjp(
+            lambda xx, lv: stage_fn(xx, chunk_slices(lv, bj)),
+            x_b, list(stacked_leaves))
+        dx_b, dleaves_b = st_vjp(dy_in)
+        blk_g = [bg + jnp.where(bwd_valid, dl, jnp.zeros_like(dl))
+                 for bg, dl in zip(blk_g, dleaves_b)]
+        cur = lax.dynamic_index_in_dim(dh0_acc, bi, 0, keepdims=False)
+        dh0_acc = lax.dynamic_update_index_in_dim(
+            dh0_acc, jnp.where(bwd_valid & (sb_virt == 0), dx_b, cur), bi, 0)
+
+        # ---- ring exchanges ----------------------------------------------
+        x_next = lax.ppermute(y, axis_name, rotate_perm(p))
+        dy_next = lax.ppermute(dx_b, axis_name,
+                               [(jj, (jj - 1) % p) for jj in range(p)])
+        return (x_next, dy_next, in_buf, dy_buf, stash, loss_acc, blk_g,
+                tail_g, dh0_acc), None
+
+    (x_l, dy_l, in_buf, dy_buf, stash, loss_acc, blk_g, tail_g,
+     dh0_acc), _ = lax.scan(tick, carry0, tables)
+
+    loss = lax.psum(loss_acc, axis_name)
+    d_h0 = lax.psum(dh0_acc, axis_name)
+    tail_g = [lax.psum(g, axis_name) for g in tail_g]
+    return loss, d_h0, blk_g, tail_g
+
+
 class PipelinedTrainer(SpmdTrainer):
     """SpmdTrainer with the decoder blocks run as a circular pp pipeline.
 
@@ -240,7 +475,7 @@ class PipelinedTrainer(SpmdTrainer):
 
     STACK_PREFIX = "pp_stacked."
 
-    SCHEDULES = ("circular", "1f1b", "vpp")
+    SCHEDULES = ("circular", "1f1b", "vpp", "interleave")
 
     def __init__(self, model, optimizer, loss_fn, mesh=None,
                  n_micro: int = 1, remat: bool = True,
@@ -254,7 +489,7 @@ class PipelinedTrainer(SpmdTrainer):
         self.n_micro = n_micro
         self._pp_remat = remat
         self.schedule = schedule
-        self.vpp_chunks = vpp_chunks if schedule == "vpp" else 1
+        self.vpp_chunks = vpp_chunks if schedule in ("vpp", "interleave") else 1
         super().__init__(model, optimizer, loss_fn, mesh=mesh,
                          remat_layers=None, **kw)
         self.pp_degree = (mesh.get_dim_size("pp")
@@ -262,21 +497,21 @@ class PipelinedTrainer(SpmdTrainer):
         if len(blocks) % max(self.pp_degree, 1) != 0:
             raise ValueError(
                 f"{len(blocks)} blocks not divisible by pp={self.pp_degree}")
-        if schedule == "vpp":
+        if schedule in ("vpp", "interleave"):
             v, p = self.vpp_chunks, max(self.pp_degree, 1)
             if len(blocks) % (v * p) != 0:
                 raise ValueError(
                     f"{len(blocks)} blocks not divisible by "
                     f"vpp_chunks*pp={v}*{p}")
             self._vpp_reorder()
-        if schedule == "1f1b":
+        if schedule in ("1f1b", "interleave"):
             for meth in ("pp_embed", "pp_tail", "pp_embed_param_names",
                          "pp_tail_param_names"):
                 if not hasattr(model, meth):
                     raise TypeError(
-                        f"schedule='1f1b' runs the loss inside the pipeline "
-                        f"region; the model must implement {meth}() "
-                        "(see LlamaForCausalLM)")
+                        f"schedule={schedule!r} runs the loss inside the "
+                        f"pipeline region; the model must implement "
+                        f"{meth}() (see LlamaForCausalLM)")
 
         # Identify block params inside the model's flat namespace.
         block_param_ids = set()
@@ -405,12 +640,13 @@ class PipelinedTrainer(SpmdTrainer):
             return PartitionSpec(*entries)
         return super()._state_spec(pspec, shape)
 
-    # -- 1F1B: manual schedule, grads produced by the region -------------------
+    # -- 1F1B / interleave: manual schedules, grads produced by the region -----
     def _build(self, batch_arrays):
-        if self.schedule != "1f1b":
+        if self.schedule not in ("1f1b", "interleave"):
             return super()._build(batch_arrays)
         if self._jax_mesh is None or "pp" not in self.mesh.dim_names:
-            raise ValueError("schedule='1f1b' requires a mesh with a 'pp' axis")
+            raise ValueError(
+                f"schedule={self.schedule!r} requires a mesh with a 'pp' axis")
         return self._jit_step(self._make_1f1b_step(), batch_arrays)
 
     def _make_1f1b_step(self):
@@ -442,10 +678,16 @@ class PipelinedTrainer(SpmdTrainer):
                 loss = model.pp_tail(Tensor(y), Tensor(label))
             return loss._data.astype(jnp.float32)
 
-        region = functools.partial(
-            pipeline_1f1b, block_apply_flat=block_apply_flat,
-            tail_apply_flat=tail_apply_flat, axis_name="pp", n_micro=nm,
-            remat=self._pp_remat)
+        if self.schedule == "interleave":
+            region = functools.partial(
+                pipeline_interleaved, block_apply_flat=block_apply_flat,
+                tail_apply_flat=tail_apply_flat, axis_name="pp", n_micro=nm,
+                vpp_chunks=self.vpp_chunks, remat=self._pp_remat)
+        else:
+            region = functools.partial(
+                pipeline_1f1b, block_apply_flat=block_apply_flat,
+                tail_apply_flat=tail_apply_flat, axis_name="pp", n_micro=nm,
+                remat=self._pp_remat)
         P0 = PartitionSpec()
 
         def step_fn(params, opt_state, lr, step_i, key, *batch):

@@ -1,6 +1,7 @@
 """ERNIE + Stable-Diffusion UNet family tests (BASELINE configs #3/#5)."""
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu import optimizer as opt
@@ -81,12 +82,12 @@ class TestUNet:
         from paddle_tpu.models.unet import UNet2DConditionModel, UNetConfig
         rng = np.random.default_rng(0)
         u = UNet2DConditionModel(UNetConfig.tiny())
-        x = Tensor(jnp.asarray(rng.standard_normal((2, 4, 16, 16)),
+        x = Tensor(jnp.asarray(rng.standard_normal((1, 4, 16, 16)),
                                jnp.float32))
-        t = Tensor(jnp.asarray([3, 7], jnp.int32))
-        ctx = Tensor(jnp.asarray(rng.standard_normal((2, 8, 32)), jnp.float32))
+        t = Tensor(jnp.asarray([3], jnp.int32))
+        ctx = Tensor(jnp.asarray(rng.standard_normal((1, 8, 32)), jnp.float32))
         out = u(x, t, ctx)
-        assert list(out.shape) == [2, 4, 16, 16]
+        assert list(out.shape) == [1, 4, 16, 16]
         (out * out).mean().backward()
         missing = [n for n, p in u.named_parameters().items()
                    if p.grad is None] if isinstance(
@@ -95,6 +96,7 @@ class TestUNet:
             if p.grad is None]
         assert not missing, f"params without grad: {missing[:5]}"
 
+    @pytest.mark.slow
     def test_denoising_step_loss_decreases(self):
         from paddle_tpu.models.unet import UNet2DConditionModel, UNetConfig
         rng = np.random.default_rng(3)

@@ -187,3 +187,55 @@ def test_fractional_max_pool2d_kernel_matches_torch():
         return_indices=True)
     np.testing.assert_allclose(out.numpy(), tout.numpy(), rtol=1e-6)
     np.testing.assert_array_equal(mask.numpy(), tidx.numpy())
+
+
+def test_max_pool2d_ceil_mode_with_padding_matches_torch():
+    # the cuDNN rule: windows starting entirely in the right padding are
+    # dropped (out 3x3 here, not 4x4)
+    x = RNG.normal(size=(1, 1, 5, 5)).astype(np.float32)
+    out, mask = F.max_pool2d(paddle.to_tensor(x), 2, 2, 1, return_mask=True,
+                             ceil_mode=True)
+    tout, tidx = torch.nn.functional.max_pool2d(
+        torch.tensor(x), 2, 2, 1, ceil_mode=True, return_indices=True)
+    assert tuple(out.shape) == tuple(tout.shape)
+    np.testing.assert_allclose(out.numpy(), tout.numpy(), rtol=1e-6)
+    np.testing.assert_array_equal(mask.numpy(), tidx.numpy())
+
+
+def test_max_pool2d_same_padding_mask_shape():
+    x = RNG.normal(size=(1, 2, 5, 5)).astype(np.float32)
+    out, mask = F.max_pool2d(paddle.to_tensor(x), 3, 1, "SAME",
+                             return_mask=True)
+    assert tuple(out.shape) == (1, 2, 5, 5)
+    assert tuple(mask.shape) == (1, 2, 5, 5)
+    # indices address the max cells of the unpadded plane
+    flat = x.reshape(1, 2, -1)
+    gathered = np.take_along_axis(flat, mask.numpy().reshape(1, 2, -1),
+                                  axis=2).reshape(out.shape)
+    np.testing.assert_allclose(gathered, out.numpy(), rtol=1e-6)
+
+
+def test_fractional_max_pool2d_output_size_one():
+    x = RNG.normal(size=(1, 1, 8, 8)).astype(np.float32)
+    out = F.fractional_max_pool2d(paddle.to_tensor(x), (1, 4), kernel_size=2,
+                                  random_u=0.4)
+    assert tuple(out.shape) == (1, 1, 1, 4)
+    # the single row-window is anchored at the end: rows 6..8
+    sub = x[:, :, 6:8, :]
+    tout = torch.nn.functional.fractional_max_pool2d(
+        torch.tensor(x), 2, output_size=(1, 4),
+        _random_samples=torch.full((1, 1, 2), 0.4))
+    np.testing.assert_allclose(out.numpy(), tout.numpy(), rtol=1e-6)
+
+
+def test_fractional_max_pool2d_bad_output_size_raises():
+    x = paddle.to_tensor(RNG.normal(size=(1, 1, 4, 4)).astype(np.float32))
+    with pytest.raises(ValueError, match="output_size"):
+        F.fractional_max_pool2d(x, (5, 2), random_u=0.3)
+
+
+def test_max_unpool2d_out_of_range_indices_raise():
+    vals = paddle.to_tensor(RNG.normal(size=(1, 1, 2, 2)).astype(np.float32))
+    bad = paddle.to_tensor(np.array([[[[0, 1], [2, 99]]]], np.int32))
+    with pytest.raises(ValueError, match="out of range"):
+        F.max_unpool2d(vals, bad, 2, 2)  # output plane is 4x4 = 16

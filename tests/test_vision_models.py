@@ -17,12 +17,12 @@ def _x(n=1, c=3, hw=64):
 
 
 @pytest.mark.parametrize("builder,kwargs,hw", [
-    (M.mobilenet_v1, {"scale": 0.25}, 64),
-    (M.mobilenet_v2, {"scale": 0.25}, 64),
-    (M.mobilenet_v3_small, {"scale": 0.5}, 64),
-    (M.shufflenet_v2_x0_25, {}, 64),
+    (M.mobilenet_v1, {"scale": 0.25}, 32),
+    (M.mobilenet_v2, {"scale": 0.25}, 32),
+    (M.mobilenet_v3_small, {"scale": 0.5}, 32),
+    (M.shufflenet_v2_x0_25, {}, 32),
     (M.squeezenet1_1, {}, 64),
-    (M.densenet121, {}, 64),
+    pytest.param(M.densenet121, {}, 32, marks=pytest.mark.slow),
 ])
 def test_small_backbones_forward(builder, kwargs, hw):
     model = builder(num_classes=7, **kwargs)
@@ -49,6 +49,7 @@ def test_lenet_trains():
     assert float(loss.item()) < first
 
 
+@pytest.mark.slow
 def test_mobilenet_v3_backward():
     model = M.mobilenet_v3_small(scale=0.35, num_classes=4)
     out = model(_x(hw=32))
@@ -57,17 +58,23 @@ def test_mobilenet_v3_backward():
     assert len(grads) > 20  # SE convs, depthwise, classifier all reached
 
 
-def test_vgg_and_alexnet_224():
-    for model in (M.vgg11(num_classes=5), M.alexnet(num_classes=5)):
-        model.eval()
-        assert list(model(_x(hw=224)).shape) == [1, 5]
+def test_vgg_and_alexnet():
+    # vgg's AdaptiveAvgPool2D((7,7)) makes it input-size-agnostic, so 112px
+    # covers it cheaply; alexnet's classifier is fixed 256*6*6 (parity with
+    # the reference), so it must see 224px
+    vgg = M.vgg11(num_classes=5)
+    vgg.eval()
+    assert list(vgg(_x(hw=112)).shape) == [1, 5]
+    anet = M.alexnet(num_classes=5)
+    anet.eval()
+    assert list(anet(_x(hw=224)).shape) == [1, 5]
 
 
 def test_googlenet_aux_heads():
     g = M.googlenet(num_classes=6)
     g.train()
-    out, aux1, aux2 = g(_x(hw=224))
+    out, aux1, aux2 = g(_x(hw=96))
     assert list(out.shape) == [1, 6]
     assert list(aux1.shape) == [1, 6] and list(aux2.shape) == [1, 6]
     g.eval()
-    assert list(g(_x(hw=224)).shape) == [1, 6]
+    assert list(g(_x(hw=96)).shape) == [1, 6]
