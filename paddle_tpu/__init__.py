@@ -108,6 +108,7 @@ from . import vision  # noqa: F401
 from . import device  # noqa: F401
 from . import metric  # noqa: F401
 from . import text  # noqa: F401
+from . import geometric  # noqa: F401
 from . import inference  # noqa: F401
 from . import fft  # noqa: F401
 from . import signal  # noqa: F401

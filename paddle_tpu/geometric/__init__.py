@@ -1,0 +1,244 @@
+"""paddle.geometric — graph learning ops.
+
+Reference parity: python/paddle/geometric/ (message_passing/send_recv.py
+send_u_recv :55 / send_ue_recv :210 / send_uv :413; sampling/neighbors.py
+sample_neighbors :30; reindex.py reindex_graph :34; plus the segment ops).
+TPU-native: message passing is gather + scatter-reduce (`.at[].add/max/min`),
+which XLA lowers to fused scatters; sampling/reindexing are host-side eager
+ops (data-dependent shapes), matching the reference's CPU kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.dispatch import dispatch, ensure_tensor
+from ..tensor import Tensor
+from ..incubate.segment_ops import (  # noqa: F401
+    segment_max, segment_mean, segment_min, segment_sum,
+)
+
+__all__ = ["send_u_recv", "send_ue_recv", "send_uv", "sample_neighbors",
+           "reindex_graph", "segment_sum", "segment_mean", "segment_max",
+           "segment_min", "weighted_sample_neighbors"]
+
+
+def _host_rng():
+    """Host-side numpy RNG seeded from the framework RNG stream, so
+    paddle.seed() makes graph sampling reproducible (parity: the reference
+    samplers draw from the global generator)."""
+    import numpy as np
+
+    from ..framework.random import next_key
+    seed = int(jax.random.randint(next_key(), (), 0, 2 ** 31 - 1))
+    return np.random.default_rng(seed)
+
+
+def _resolve_out_size(out_size, dst_arr):
+    if out_size is None:
+        return None
+    if isinstance(out_size, Tensor):
+        out_size = int(out_size.numpy())
+    out_size = int(out_size)
+    return out_size if out_size > 0 else None
+
+
+def _scatter_reduce(msgs, dst, n_out, reduce_op, dtype):
+    shape = (n_out,) + msgs.shape[1:]
+    if reduce_op == "sum" or reduce_op == "mean":
+        out = jnp.zeros(shape, jnp.float32).at[dst].add(
+            msgs.astype(jnp.float32))
+        if reduce_op == "mean":
+            cnt = jnp.zeros((n_out,), jnp.float32).at[dst].add(1.0)
+            out = out / jnp.maximum(cnt, 1.0).reshape(
+                (n_out,) + (1,) * (msgs.ndim - 1))
+        return out.astype(dtype)
+    if reduce_op == "max":
+        init = jnp.finfo(jnp.float32).min
+    elif reduce_op == "min":
+        init = jnp.finfo(jnp.float32).max
+    else:
+        raise ValueError(f"unknown reduce_op {reduce_op!r}")
+    out = jnp.full(shape, init, jnp.float32)
+    out = (out.at[dst].max(msgs.astype(jnp.float32)) if reduce_op == "max"
+           else out.at[dst].min(msgs.astype(jnp.float32)))
+    # untouched rows are 0 (reference fills missing destinations with 0)
+    touched = jnp.zeros((n_out,), jnp.bool_).at[dst].set(True)
+    out = jnp.where(touched.reshape((n_out,) + (1,) * (msgs.ndim - 1)),
+                    out, 0.0)
+    return out.astype(dtype)
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather x[src] then scatter-reduce at dst (send_recv.py:55)."""
+    xt = ensure_tensor(x)
+    st, dt = ensure_tensor(src_index), ensure_tensor(dst_index)
+    n_out = _resolve_out_size(out_size, dt) or int(xt.shape[0])
+
+    def fwd(xa, src, dst):
+        msgs = xa[src.astype(jnp.int32)]
+        return _scatter_reduce(msgs, dst.astype(jnp.int32), n_out, reduce_op,
+                               xa.dtype)
+
+    return dispatch("send_u_recv", fwd, xt, st, dt)
+
+
+def _message(msg_op, xe, y):
+    y = y.astype(jnp.float32)
+    xe = xe.astype(jnp.float32)
+    while y.ndim < xe.ndim:
+        y = y[..., None]
+    if msg_op == "add":
+        return xe + y
+    if msg_op == "sub":
+        return xe - y
+    if msg_op == "mul":
+        return xe * y
+    if msg_op == "div":
+        return xe / y
+    raise ValueError(f"unknown message_op {msg_op!r}")
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Gather x[src], combine with the per-edge feature y, scatter-reduce at
+    dst (send_recv.py:210)."""
+    xt, yt = ensure_tensor(x), ensure_tensor(y)
+    st, dt = ensure_tensor(src_index), ensure_tensor(dst_index)
+    n_out = _resolve_out_size(out_size, dt) or int(xt.shape[0])
+
+    def fwd(xa, ya, src, dst):
+        msgs = _message(message_op, xa[src.astype(jnp.int32)], ya)
+        return _scatter_reduce(msgs, dst.astype(jnp.int32), n_out, reduce_op,
+                               xa.dtype)
+
+    return dispatch("send_ue_recv", fwd, xt, yt, st, dt)
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Edge features from source/destination node features
+    (send_recv.py:413): out[e] = x[src[e]] (op) y[dst[e]]."""
+    xt, yt = ensure_tensor(x), ensure_tensor(y)
+    st, dt = ensure_tensor(src_index), ensure_tensor(dst_index)
+
+    def fwd(xa, ya, src, dst):
+        xe = xa[src.astype(jnp.int32)].astype(jnp.float32)
+        ye = ya[dst.astype(jnp.int32)].astype(jnp.float32)
+        if message_op == "add":
+            out = xe + ye
+        elif message_op == "sub":
+            out = xe - ye
+        elif message_op == "mul":
+            out = xe * ye
+        elif message_op == "div":
+            out = xe / ye
+        else:
+            raise ValueError(f"unknown message_op {message_op!r}")
+        return out.astype(xa.dtype)
+
+    return dispatch("send_uv", fwd, xt, yt, st, dt)
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    """Uniform neighbor sampling over a CSC graph (neighbors.py:30).
+
+    Host-side eager op (data-dependent output size, like the reference CPU
+    kernel). Returns (out_neighbors, out_count[, out_eids])."""
+    import numpy as np
+
+    rows = np.asarray(ensure_tensor(row).numpy()).reshape(-1)
+    cptr = np.asarray(ensure_tensor(colptr).numpy()).reshape(-1)
+    nodes = np.asarray(ensure_tensor(input_nodes).numpy()).reshape(-1)
+    eid_arr = (np.asarray(ensure_tensor(eids).numpy()).reshape(-1)
+               if eids is not None else None)
+    rng = _host_rng()
+    out_n, out_c, out_e = [], [], []
+    for v in nodes:
+        beg, end = int(cptr[v]), int(cptr[v + 1])
+        neigh = rows[beg:end]
+        if sample_size < 0 or end - beg <= sample_size:
+            pick = np.arange(end - beg)
+        else:
+            pick = rng.choice(end - beg, size=sample_size, replace=False)
+        out_n.append(neigh[pick])
+        out_c.append(len(pick))
+        if eid_arr is not None:
+            out_e.append(eid_arr[beg:end][pick])
+    neighbors = Tensor(jnp.asarray(np.concatenate(out_n)
+                                   if out_n else np.zeros(0, rows.dtype)))
+    counts = Tensor(jnp.asarray(np.asarray(out_c, np.int32)))
+    if return_eids:
+        if eid_arr is None:
+            raise ValueError("return_eids=True requires eids")
+        return neighbors, counts, Tensor(jnp.asarray(np.concatenate(out_e)))
+    return neighbors, counts
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    """Weighted neighbor sampling (weighted_sample_neighbors op): neighbors
+    drawn without replacement with probability proportional to edge weight."""
+    import numpy as np
+
+    rows = np.asarray(ensure_tensor(row).numpy()).reshape(-1)
+    cptr = np.asarray(ensure_tensor(colptr).numpy()).reshape(-1)
+    wts = np.asarray(ensure_tensor(edge_weight).numpy()).reshape(-1)
+    nodes = np.asarray(ensure_tensor(input_nodes).numpy()).reshape(-1)
+    eid_arr = (np.asarray(ensure_tensor(eids).numpy()).reshape(-1)
+               if eids is not None else None)
+    rng = _host_rng()
+    out_n, out_c, out_e = [], [], []
+    for v in nodes:
+        beg, end = int(cptr[v]), int(cptr[v + 1])
+        neigh = rows[beg:end]
+        w = wts[beg:end].astype(np.float64)
+        if sample_size < 0 or end - beg <= sample_size:
+            pick = np.arange(end - beg)
+        else:
+            pr = w / w.sum()
+            pick = rng.choice(end - beg, size=sample_size, replace=False,
+                              p=pr)
+        out_n.append(neigh[pick])
+        out_c.append(len(pick))
+        if eid_arr is not None:
+            out_e.append(eid_arr[beg:end][pick])
+    neighbors = Tensor(jnp.asarray(np.concatenate(out_n)
+                                   if out_n else np.zeros(0, rows.dtype)))
+    counts = Tensor(jnp.asarray(np.asarray(out_c, np.int32)))
+    if return_eids:
+        if eid_arr is None:
+            raise ValueError("return_eids=True requires eids")
+        return neighbors, counts, Tensor(jnp.asarray(np.concatenate(out_e)))
+    return neighbors, counts
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Reindex sampled subgraph node ids from 0 (reindex.py:34). Returns
+    (reindex_src, reindex_dst, out_nodes)."""
+    import numpy as np
+
+    xs = np.asarray(ensure_tensor(x).numpy()).reshape(-1)
+    nb = np.asarray(ensure_tensor(neighbors).numpy()).reshape(-1)
+    ct = np.asarray(ensure_tensor(count).numpy()).reshape(-1)
+    mapping = {}
+    out_nodes = []
+    for v in xs:
+        if int(v) not in mapping:
+            mapping[int(v)] = len(out_nodes)
+            out_nodes.append(int(v))
+    src = np.empty(len(nb), np.int64)
+    for i, v in enumerate(nb):
+        vi = int(v)
+        if vi not in mapping:
+            mapping[vi] = len(out_nodes)
+            out_nodes.append(vi)
+        src[i] = mapping[vi]
+    dst = np.repeat(np.arange(len(xs)), ct)
+    dtype = nb.dtype
+    return (Tensor(jnp.asarray(src.astype(dtype))),
+            Tensor(jnp.asarray(dst.astype(dtype))),
+            Tensor(jnp.asarray(np.asarray(out_nodes, dtype))))

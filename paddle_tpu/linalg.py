@@ -1,7 +1,7 @@
 """paddle_tpu.linalg — parity with paddle.linalg namespace."""
 from .ops.linalg import (  # noqa: F401
     cholesky, cholesky_solve, cond, corrcoef, cov, det, eig, eigh,
-    eigvals, eigvalsh, householder_product, inv, lstsq, lu, matmul,
+    eigvals, eigvalsh, householder_product, inv, lstsq, lu, lu_unpack, matmul,
     matrix_power, matrix_rank, multi_dot, norm, pinv, qr, slogdet, solve, svd,
     svdvals, triangular_solve,
 )

@@ -44,3 +44,5 @@ from .layer.transformer import (  # noqa: F401
     TransformerDecoderLayer, TransformerEncoder, TransformerEncoderLayer,
 )
 from .initializer import ParamAttr  # noqa: F401
+
+from . import utils  # noqa: F401

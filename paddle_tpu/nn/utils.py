@@ -1,0 +1,141 @@
+"""paddle.nn.utils — weight/spectral norm reparameterizations + param vectors.
+
+Reference parity: python/paddle/nn/utils/{weight_norm_hook.py,
+spectral_norm_hook.py, transform_parameters.py}. Implemented as
+forward-pre hooks that recompute the wrapped parameter from its
+reparameterized storage before every forward.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..tensor import Parameter, Tensor
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
+           "parameters_to_vector", "vector_to_parameters"]
+
+
+def _norm_except_dim(w, dim):
+    axes = tuple(i for i in range(w.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(w.astype(jnp.float32) ** 2, axis=axes,
+                            keepdims=True))
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """w = g * v / ||v|| (parity: weight_norm_hook.py). Adds `name`_g and
+    `name`_v parameters; recomputes `name` on every forward."""
+    w = getattr(layer, name)
+    if dim is None:
+        dim = -1  # norm over the whole tensor
+    v = Parameter(w._data)
+    if dim == -1:
+        g0 = jnp.sqrt(jnp.sum(w._data.astype(jnp.float32) ** 2))
+        g = Parameter(g0.reshape((1,) * w._data.ndim))
+    else:
+        g = Parameter(_norm_except_dim(w._data, dim))
+    setattr(layer, name + "_v", v)
+    setattr(layer, name + "_g", g)
+    if name in layer._parameters:
+        del layer._parameters[name]
+
+    def compute():
+        vv = v._data.astype(jnp.float32)
+        nn_ = (jnp.sqrt(jnp.sum(vv ** 2)) if dim == -1
+               else _norm_except_dim(v._data, dim))
+        return (g._data.astype(jnp.float32) * vv / jnp.maximum(nn_, 1e-12)) \
+            .astype(v._data.dtype)
+
+    def hook(lyr, inputs):
+        object.__setattr__(lyr, name, Tensor(compute()))
+        return None
+
+    handle = layer.register_forward_pre_hook(hook)
+    layer._weight_norm_handles = getattr(layer, "_weight_norm_handles", {})
+    layer._weight_norm_handles[name] = (handle, v, g)
+    hook(layer, ())
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    handles = getattr(layer, "_weight_norm_handles", {})
+    if name not in handles:
+        raise ValueError(f"no weight_norm on parameter {name!r}")
+    handle, v, g = handles.pop(name)
+    handle.remove()
+    w = getattr(layer, name)
+    data = w._data if isinstance(w, Tensor) else w
+    delattr(layer, name + "_v")
+    delattr(layer, name + "_g")
+    setattr(layer, name, Parameter(data))
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """w = w / sigma_max(w) via power iteration (parity:
+    spectral_norm_hook.py). u/v vectors persist as non-trainable buffers."""
+    import jax
+
+    from ..framework.random import next_key
+
+    w = getattr(layer, name)
+    if dim is None:
+        from .layer.common import Linear
+        dim = 1 if isinstance(layer, Linear) else 0
+    wd = w._data
+    orig = Parameter(wd)
+    setattr(layer, name + "_orig", orig)
+    if name in layer._parameters:
+        del layer._parameters[name]
+    mat0 = jnp.moveaxis(wd, dim, 0).reshape(wd.shape[dim], -1)
+    h, w_ = mat0.shape
+    k1, k2 = jax.random.split(next_key())
+    state = {
+        "u": jax.random.normal(k1, (h,), jnp.float32),
+        "v": jax.random.normal(k2, (w_,), jnp.float32),
+    }
+    state["u"] = state["u"] / jnp.maximum(jnp.linalg.norm(state["u"]), eps)
+    state["v"] = state["v"] / jnp.maximum(jnp.linalg.norm(state["v"]), eps)
+
+    def compute():
+        mat = jnp.moveaxis(orig._data, dim, 0).reshape(
+            orig._data.shape[dim], -1).astype(jnp.float32)
+        u, v = state["u"], state["v"]
+        for _ in range(n_power_iterations):
+            v = mat.T @ u
+            v = v / jnp.maximum(jnp.linalg.norm(v), eps)
+            u = mat @ v
+            u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+        import jax as _jax
+        if not isinstance(mat, _jax.core.Tracer):
+            state["u"], state["v"] = u, v
+        sigma = u @ mat @ v
+        return (orig._data.astype(jnp.float32) / jnp.maximum(sigma, eps)) \
+            .astype(orig._data.dtype)
+
+    def hook(lyr, inputs):
+        object.__setattr__(lyr, name, Tensor(compute()))
+        return None
+
+    layer.register_forward_pre_hook(hook)
+    hook(layer, ())
+    return layer
+
+
+def parameters_to_vector(parameters, name=None):
+    """Flatten a parameter list into one 1-D tensor (transform_parameters.py)."""
+    arrs = [jnp.ravel(p._data) for p in parameters]
+    return Tensor(jnp.concatenate(arrs))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    """Write a flat vector back into the parameter list."""
+    data = vec._data if isinstance(vec, Tensor) else jnp.asarray(vec)
+    offset = 0
+    for p in parameters:
+        n = 1
+        for d in p._data.shape:
+            n *= int(d)
+        p._data = data[offset:offset + n].reshape(p._data.shape) \
+            .astype(p._data.dtype)
+        offset += n

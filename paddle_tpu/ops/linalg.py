@@ -205,9 +205,33 @@ def matrix_power(x, n, name=None):
                     ensure_tensor(x))
 
 
-def matrix_rank(x, tol=None, hermitian=False, name=None):
+def matrix_rank(x, tol=None, hermitian=False, atol=None, rtol=None,
+                name=None):
+    """Parity: paddle.linalg.matrix_rank incl. the atol/rtol variant
+    (matrix_rank_atol_rtol op): rank = #(sigma > max(atol, rtol*sigma_max));
+    legacy `tol` is an absolute threshold."""
     xt = ensure_tensor(x)
-    return Tensor(jnp.linalg.matrix_rank(xt._data, rtol=tol))
+
+    def fwd(a):
+        af = a.astype(jnp.float32)
+        if hermitian:
+            s_ = jnp.abs(jnp.linalg.eigvalsh(af))
+        else:
+            s_ = jnp.linalg.svd(af, compute_uv=False)
+        smax = jnp.max(s_, axis=-1, keepdims=True)
+        if tol is not None:
+            thresh = jnp.asarray(tol, jnp.float32)
+        elif atol is not None or rtol is not None:
+            a_ = jnp.asarray(0.0 if atol is None else atol, jnp.float32)
+            r_ = jnp.asarray(0.0 if rtol is None else rtol, jnp.float32)
+            thresh = jnp.maximum(a_, r_ * smax[..., 0])
+        else:
+            eps = jnp.finfo(jnp.float32).eps
+            thresh = smax[..., 0] * max(a.shape[-2], a.shape[-1]) * eps
+        return jnp.sum(s_ > jnp.asarray(thresh)[..., None],
+                       axis=-1).astype(jnp.int32)
+
+    return dispatch("matrix_rank", fwd, xt)
 
 
 def multi_dot(x, name=None):
@@ -298,3 +322,51 @@ for _n in ("matmul", "mm", "bmm", "mv", "dot", "cross", "norm", "dist",
            "multi_dot", "cov", "corrcoef", "histogram", "bincount"):
     register_op(_n, globals()[_n])
 register_op("einsum", einsum, method=False)
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Unpack LU factorization (parity: paddle.linalg.lu_unpack /
+    phi/kernels/impl/lu_unpack_kernel_impl.h): returns (P, L, U) from the
+    packed LU matrix and 1-based pivot vector of paddle.linalg.lu. Outputs
+    gated off by unpack_ludata/unpack_pivots are returned as None (the
+    reference leaves them unallocated)."""
+    xt, yt = ensure_tensor(x), ensure_tensor(y)
+
+    def fwd_lu(a):
+        m, n = a.shape[-2], a.shape[-1]
+        k = min(m, n)
+        eye_l = jnp.eye(m, k, dtype=a.dtype)
+        tril = jnp.tril(a[..., :, :k], k=-1) + eye_l
+        triu = jnp.triu(a[..., :k, :])
+        return tril, triu
+
+    def fwd_p(a, piv):
+        m = a.shape[-2]
+        # pivots -> permutation: apply row swaps i <-> piv[i]-1 in order
+        def swaps(perm, pv):
+            def body(i, pm):
+                j = pv[i] - 1
+                pi = pm[i]
+                pm = pm.at[i].set(pm[j])
+                return pm.at[j].set(pi)
+            return jax.lax.fori_loop(0, pv.shape[0], body, perm)
+
+        if piv.ndim == 1:
+            perm = swaps(jnp.arange(m), piv)
+            return jnp.eye(m, dtype=a.dtype)[perm].T
+        flat_piv = piv.reshape((-1, piv.shape[-1]))
+        flat_perm = jax.vmap(swaps)(
+            jnp.broadcast_to(jnp.arange(m), (flat_piv.shape[0], m)),
+            flat_piv)
+        p = jnp.swapaxes(jnp.eye(m, dtype=a.dtype)[flat_perm], -1, -2)
+        return p.reshape(piv.shape[:-1] + (m, m))
+
+    l_ = u = p = None
+    if unpack_ludata:
+        l_, u = dispatch("lu_unpack", fwd_lu, xt)
+    if unpack_pivots:
+        p = dispatch("lu_unpack_pivot", fwd_p, xt, yt)
+    return p, l_, u
+
+
+register_op("lu_unpack", lu_unpack)

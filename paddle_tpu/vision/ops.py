@@ -259,3 +259,368 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
 
 __all__ = ["nms", "box_coder", "roi_align", "yolo_box",
            "distribute_fpn_proposals"]
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """SSD prior boxes (parity: phi/kernels/cpu/prior_box_kernel.cc; aspect
+    ratio expansion per prior_box_kernel.h:38 ExpandAspectRatios). The box
+    layout depends only on static shapes, so it is generated host-side."""
+    import numpy as np
+
+    it, im = ensure_tensor(input), ensure_tensor(image)
+    if not isinstance(min_sizes, (list, tuple)):
+        min_sizes = [min_sizes]
+    max_sizes = ([] if max_sizes is None else
+                 (list(max_sizes) if isinstance(max_sizes, (list, tuple))
+                  else [max_sizes]))
+    if not isinstance(aspect_ratios, (list, tuple)):
+        aspect_ratios = [aspect_ratios]
+    if not isinstance(steps, (list, tuple)):
+        steps = [steps, steps]
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if all(abs(ar - e) >= 1e-6 for e in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+
+    fh, fw = int(it.shape[2]), int(it.shape[3])
+    ih, iw = int(im.shape[2]), int(im.shape[3])
+    step_w = steps[0] if steps[0] else iw / fw
+    step_h = steps[1] if steps[1] else ih / fh
+
+    boxes = []
+    for h in range(fh):
+        row = []
+        for w in range(fw):
+            cx = (w + offset) * step_w
+            cy = (h + offset) * step_h
+            cell = []
+
+            def emit(bw, bh):
+                cell.append([(cx - bw) / iw, (cy - bh) / ih,
+                             (cx + bw) / iw, (cy + bh) / ih])
+
+            for s, mn in enumerate(min_sizes):
+                if min_max_aspect_ratios_order:
+                    emit(mn / 2.0, mn / 2.0)
+                    if max_sizes:
+                        sz = (mn * max_sizes[s]) ** 0.5 / 2.0
+                        emit(sz, sz)
+                    for ar in ars:
+                        if abs(ar - 1.0) < 1e-6:
+                            continue
+                        emit(mn * ar ** 0.5 / 2.0, mn / ar ** 0.5 / 2.0)
+                else:
+                    for ar in ars:
+                        emit(mn * ar ** 0.5 / 2.0, mn / ar ** 0.5 / 2.0)
+                    if max_sizes:
+                        sz = (mn * max_sizes[s]) ** 0.5 / 2.0
+                        emit(sz, sz)
+            row.append(cell)
+        boxes.append(row)
+    arr = np.asarray(boxes, np.float32)          # [H, W, np, 4]
+    if clip:
+        arr = np.clip(arr, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32), arr.shape).copy()
+    return Tensor(jnp.asarray(arr)), Tensor(jnp.asarray(var))
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
+               keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """Matrix NMS (parity: phi/kernels/cpu/matrix_nms_kernel.cc — decay-based
+    soft suppression). Detection postprocessing runs eagerly on host, like
+    the reference's CPU kernel; bboxes [N, M, 4], scores [N, C, M]."""
+    import numpy as np
+
+    bb = np.asarray(ensure_tensor(bboxes).numpy(), np.float64)
+    sc = np.asarray(ensure_tensor(scores).numpy(), np.float64)
+    n, m, _ = bb.shape
+    c = sc.shape[1]
+
+    def area(b):
+        if b[2] < b[0] or b[3] < b[1]:
+            return 0.0
+        w, h = b[2] - b[0], b[3] - b[1]
+        return w * h if normalized else (w + 1) * (h + 1)
+
+    def iou(b1, b2):
+        if b2[0] > b1[2] or b2[2] < b1[0] or b2[1] > b1[3] or b2[3] < b1[1]:
+            return 0.0
+        norm = 0.0 if normalized else 1.0
+        iw = min(b1[2], b2[2]) - max(b1[0], b2[0]) + norm
+        ih = min(b1[3], b2[3]) - max(b1[1], b2[1]) + norm
+        inter = iw * ih
+        return inter / (area(b1) + area(b2) - inter)
+
+    out_rows, out_index, rois_num = [], [], []
+    for bi in range(n):
+        all_idx, all_scores, all_classes = [], [], []
+        for ci in range(c):
+            if ci == background_label:
+                continue
+            s = sc[bi, ci]
+            perm = [i for i in range(m) if s[i] > score_threshold]
+            perm.sort(key=lambda i: -s[i])
+            if nms_top_k > -1:
+                perm = perm[:nms_top_k]
+            if not perm:
+                continue
+            iou_max = [0.0]
+            ious = {}
+            for i in range(1, len(perm)):
+                mx = 0.0
+                for j in range(i):
+                    v = iou(bb[bi, perm[i]], bb[bi, perm[j]])
+                    ious[(i, j)] = v
+                    mx = max(mx, v)
+                iou_max.append(mx)
+            if s[perm[0]] > post_threshold:
+                all_idx.append(perm[0])
+                all_scores.append(s[perm[0]])
+                all_classes.append(ci)
+            for i in range(1, len(perm)):
+                min_decay = 1.0
+                for j in range(i):
+                    v, mx = ious[(i, j)], iou_max[j]
+                    decay = (np.exp((mx * mx - v * v) * gaussian_sigma)
+                             if use_gaussian else (1.0 - v) / (1.0 - mx))
+                    min_decay = min(min_decay, decay)
+                ds = min_decay * s[perm[i]]
+                if ds <= post_threshold:
+                    continue
+                all_idx.append(perm[i])
+                all_scores.append(ds)
+                all_classes.append(ci)
+        num_det = len(all_idx)
+        if keep_top_k > -1:
+            num_det = min(num_det, keep_top_k)
+        order = sorted(range(len(all_idx)),
+                       key=lambda p: -all_scores[p])[:num_det]
+        for p in order:
+            out_rows.append([float(all_classes[p]), all_scores[p],
+                             *bb[bi, all_idx[p]]])
+            out_index.append(bi * m + all_idx[p])
+        rois_num.append(num_det)
+
+    out = Tensor(jnp.asarray(np.asarray(out_rows, np.float32).reshape(-1, 6)))
+    ret = [out]
+    if return_index:
+        ret.append(Tensor(jnp.asarray(
+            np.asarray(out_index, np.int32).reshape(-1, 1))))
+    if return_rois_num:
+        ret.append(Tensor(jnp.asarray(np.asarray(rois_num, np.int32))))
+    return ret[0] if len(ret) == 1 else tuple(ret)
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable conv v1/v2 (parity: deformable_conv kernels via
+    funcs/deformable_conv_functor.cc — offset channel layout
+    [dg, kh*kw, (h, w)], bilinear sampling with zero outside, optional
+    modulation mask). TPU-native: one gather-based bilinear sample per
+    kernel tap, then a grouped einsum — no im2col buffer."""
+    xt = ensure_tensor(x)
+    ot = ensure_tensor(offset)
+    wt = ensure_tensor(weight)
+    s = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    p = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    d = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+    args = [xt, ot, wt]
+    if mask is not None:
+        args.append(ensure_tensor(mask))
+    if bias is not None:
+        args.append(ensure_tensor(bias))
+    has_mask = mask is not None
+    has_bias = bias is not None
+    dg = deformable_groups
+
+    def fwd(xa, off, w, *rest):
+        rest = list(rest)
+        mk = rest.pop(0) if has_mask else None
+        b = rest.pop(0) if has_bias else None
+        xa32 = xa.astype(jnp.float32)
+        n, cin, hh, ww = xa.shape
+        cout, cin_g, kh, kw = w.shape
+        ho, wo = off.shape[2], off.shape[3]
+        off = off.reshape(n, dg, kh * kw, 2, ho, wo).astype(jnp.float32)
+        if mk is not None:
+            mk = mk.reshape(n, dg, kh * kw, ho, wo).astype(jnp.float32)
+
+        h_base = jnp.arange(ho) * s[0] - p[0]      # [Ho]
+        w_base = jnp.arange(wo) * s[1] - p[1]      # [Wo]
+        cols = []
+        for i in range(kh):
+            for j in range(kw):
+                t = i * kw + j
+                h_im = (h_base[None, None, :, None] + i * d[0]
+                        + off[:, :, t, 0])         # [N, dg, Ho, Wo]
+                w_im = (w_base[None, None, None, :] + j * d[1]
+                        + off[:, :, t, 1])
+                inside = (h_im > -1) & (w_im > -1) & (h_im < hh) & (w_im < ww)
+                h0 = jnp.floor(h_im)
+                w0 = jnp.floor(w_im)
+                lh = h_im - h0
+                lw = w_im - w0
+                xflat = xa32.reshape(n, dg, cin // dg, hh * ww)
+                vals = jnp.zeros((n, dg, cin // dg, ho, wo), jnp.float32)
+                for (dh, dw, wgt) in (
+                        (0, 0, (1 - lh) * (1 - lw)), (0, 1, (1 - lh) * lw),
+                        (1, 0, lh * (1 - lw)), (1, 1, lh * lw)):
+                    hi = h0 + dh
+                    wi = w0 + dw
+                    ok = (hi >= 0) & (hi < hh) & (wi >= 0) & (wi < ww)
+                    hi_i = jnp.clip(hi, 0, hh - 1).astype(jnp.int32)
+                    wi_i = jnp.clip(wi, 0, ww - 1).astype(jnp.int32)
+                    # channels of a deformable group share sample positions
+                    pos = (hi_i * ww + wi_i).reshape(n, dg, 1, ho * wo)
+                    g = jnp.take_along_axis(
+                        xflat, jnp.broadcast_to(
+                            pos, (n, dg, cin // dg, ho * wo)), axis=3)
+                    g = g.reshape(n, dg, cin // dg, ho, wo)
+                    contrib = wgt[:, :, None] * g
+                    vals = vals + jnp.where(ok[:, :, None], contrib, 0.0)
+                vals = jnp.where(inside[:, :, None], vals, 0.0)
+                if mk is not None:
+                    vals = vals * mk[:, :, t][:, :, None]
+                cols.append(vals.reshape(n, cin, ho, wo))
+        # cols: kh*kw tensors [N, Cin, Ho, Wo] -> [N, Cin, kh*kw, Ho, Wo]
+        col = jnp.stack(cols, axis=2)
+        col = col.reshape(n, groups, cin // groups, kh * kw, ho, wo)
+        wg = w.reshape(groups, cout // groups, cin_g, kh * kw) \
+            .astype(jnp.float32)
+        out = jnp.einsum("ngcthw,goct->ngohw", col, wg)
+        out = out.reshape(n, cout, ho, wo)
+        if b is not None:
+            out = out + b.astype(jnp.float32).reshape(1, cout, 1, 1)
+        return out.astype(xa.dtype)
+
+    return dispatch("deform_conv2d", fwd, *args)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """RoI max pooling (parity: phi/kernels/cpu/roi_pool_kernel.cc —
+    rounded box coords, malformed RoIs forced to 1x1, floor/ceil bins)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    xt, bt = ensure_tensor(x), ensure_tensor(boxes)
+    nt = ensure_tensor(boxes_num)
+
+    def fwd(xa, ba, na):
+        xa32 = xa.astype(jnp.float32)
+        n, c, hh, ww = xa.shape
+        nrois = ba.shape[0]
+        batch_id = jnp.searchsorted(jnp.cumsum(na), jnp.arange(nrois),
+                                    side="right")
+        bx = jnp.round(ba.astype(jnp.float32) * spatial_scale).astype(
+            jnp.int32)
+        x1, y1, x2, y2 = bx[:, 0], bx[:, 1], bx[:, 2], bx[:, 3]
+        bh = jnp.maximum(y2 - y1 + 1, 1)
+        bw = jnp.maximum(x2 - x1 + 1, 1)
+        bin_h = bh.astype(jnp.float32) / ph
+        bin_w = bw.astype(jnp.float32) / pw
+        outs = []
+        neg = jnp.finfo(jnp.float32).min
+        # fixed max bin extents keep everything static-shaped: a bin spans at
+        # most ceil(H/ph)+1 rows of the (clipped) box
+        for ih in range(ph):
+            hstart = y1 + jnp.floor(ih * bin_h).astype(jnp.int32)
+            hend = y1 + jnp.ceil((ih + 1) * bin_h).astype(jnp.int32)
+            hstart = jnp.clip(hstart, 0, hh)
+            hend = jnp.clip(hend, 0, hh)
+            for iw_ in range(pw):
+                wstart = x1 + jnp.floor(iw_ * bin_w).astype(jnp.int32)
+                wend = x1 + jnp.ceil((iw_ + 1) * bin_w).astype(jnp.int32)
+                wstart = jnp.clip(wstart, 0, ww)
+                wend = jnp.clip(wend, 0, ww)
+                # mask-based max over the full plane (H, W are small for
+                # detection heads; XLA fuses the reduction)
+                hgrid = jnp.arange(hh)[None, :, None]
+                wgrid = jnp.arange(ww)[None, None, :]
+                sel = ((hgrid >= hstart[:, None, None])
+                       & (hgrid < hend[:, None, None])
+                       & (wgrid >= wstart[:, None, None])
+                       & (wgrid < wend[:, None, None]))  # [R, H, W]
+                feat = xa32[batch_id]                    # [R, C, H, W]
+                masked = jnp.where(sel[:, None, :, :], feat, neg)
+                mx = jnp.max(masked, axis=(2, 3))        # [R, C]
+                empty = ~jnp.any(sel, axis=(1, 2))
+                mx = jnp.where(empty[:, None], 0.0, mx)
+                outs.append(mx)
+        out = jnp.stack(outs, axis=-1).reshape(nrois, c, ph, pw)
+        return out.astype(xa.dtype)
+
+    return dispatch("roi_pool", fwd, xt, bt, nt)
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI average pooling (parity:
+    phi/kernels/cpu/psroi_pool_kernel.cc — each output bin (ph, pw) reads
+    its own channel group c*ph*pw + ih*pw + iw)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    xt, bt = ensure_tensor(x), ensure_tensor(boxes)
+    nt = ensure_tensor(boxes_num)
+
+    def fwd(xa, ba, na):
+        xa32 = xa.astype(jnp.float32)
+        n, cin, hh, ww = xa.shape
+        cout = cin // (ph * pw)
+        nrois = ba.shape[0]
+        batch_id = jnp.searchsorted(jnp.cumsum(na), jnp.arange(nrois),
+                                    side="right")
+        # reference order: round the raw coords FIRST, then scale
+        # (psroi_pool_kernel.cc: roi_start = round(x1) * scale,
+        # roi_end = (round(x2) + 1) * scale)
+        bf = ba.astype(jnp.float32)
+        x1 = jnp.round(bf[:, 0]) * spatial_scale
+        y1 = jnp.round(bf[:, 1]) * spatial_scale
+        x2 = (jnp.round(bf[:, 2]) + 1.0) * spatial_scale
+        y2 = (jnp.round(bf[:, 3]) + 1.0) * spatial_scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        outs = []
+        for ih in range(ph):
+            hstart = jnp.clip(jnp.floor(y1 + ih * bin_h), 0, hh).astype(
+                jnp.int32)
+            hend = jnp.clip(jnp.ceil(y1 + (ih + 1) * bin_h), 0, hh).astype(
+                jnp.int32)
+            for iw_ in range(pw):
+                wstart = jnp.clip(jnp.floor(x1 + iw_ * bin_w), 0, ww).astype(
+                    jnp.int32)
+                wend = jnp.clip(jnp.ceil(x1 + (iw_ + 1) * bin_w), 0,
+                                ww).astype(jnp.int32)
+                hgrid = jnp.arange(hh)[None, :, None]
+                wgrid = jnp.arange(ww)[None, None, :]
+                sel = ((hgrid >= hstart[:, None, None])
+                       & (hgrid < hend[:, None, None])
+                       & (wgrid >= wstart[:, None, None])
+                       & (wgrid < wend[:, None, None]))
+                # channel group for this bin: [cout] channels at offset
+                chan = jnp.arange(cout) * ph * pw + ih * pw + iw_
+                feat = xa32[batch_id][:, chan]          # [R, cout, H, W]
+                ssum = jnp.sum(jnp.where(sel[:, None], feat, 0.0),
+                               axis=(2, 3))
+                cnt = jnp.sum(sel, axis=(1, 2)).astype(jnp.float32)
+                outs.append(jnp.where(cnt[:, None] > 0,
+                                      ssum / jnp.maximum(cnt[:, None], 1.0),
+                                      0.0))
+        out = jnp.stack(outs, axis=-1).reshape(nrois, cout, ph, pw)
+        return out.astype(xa.dtype)
+
+    return dispatch("psroi_pool", fwd, xt, bt, nt)
+
+
+__all__ += ["prior_box", "matrix_nms", "deform_conv2d", "roi_pool",
+            "psroi_pool"]

@@ -1,0 +1,238 @@
+"""prior_box / matrix_nms / deform_conv2d / roi_pool / psroi_pool oracles.
+
+Oracle style per SURVEY §4: independent NumPy transcriptions of the reference
+kernels (prior_box_kernel.cc, matrix_nms_kernel.cc, deformable_conv_functor.cc,
+roi_pool_kernel.cc), scalar loops vs the vectorized jnp implementations.
+"""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as V
+
+RNG = np.random.default_rng(5)
+
+
+# ---- prior_box --------------------------------------------------------------
+
+@pytest.mark.parametrize("mm_order", [False, True])
+def test_prior_box_matches_kernel_math(mm_order):
+    feat = paddle.to_tensor(np.zeros((1, 8, 3, 4), np.float32))
+    img = paddle.to_tensor(np.zeros((1, 3, 9, 12), np.float32))
+    boxes, var = V.prior_box(feat, img, min_sizes=[2.0, 4.0], max_sizes=[5.0, 8.0],
+                             aspect_ratios=[2.0], flip=True, clip=True,
+                             min_max_aspect_ratios_order=mm_order)
+    # expanded ars: [1, 2, 0.5]; priors per cell = 2 min * 3 ar + 2 max = 8
+    assert tuple(boxes.shape) == (3, 4, 8, 4)
+    assert tuple(var.shape) == (3, 4, 8, 4)
+    b = np.asarray(boxes.numpy())
+    assert (b >= 0).all() and (b <= 1).all()
+    # spot-check cell (1, 2): first prior is min_size=2, ar=1
+    step_w, step_h = 12 / 4, 9 / 3
+    cx, cy = (2 + 0.5) * step_w, (1 + 0.5) * step_h
+    exp0 = [(cx - 1) / 12, (cy - 1) / 9, (cx + 1) / 12, (cy + 1) / 9]
+    np.testing.assert_allclose(b[1, 2, 0], exp0, rtol=1e-5)
+    if mm_order:
+        # second prior is the sqrt(min*max) square
+        s = math.sqrt(2.0 * 5.0) / 2
+        exp1 = [(cx - s) / 12, (cy - s) / 9, (cx + s) / 12, (cy + s) / 9]
+        np.testing.assert_allclose(b[1, 2, 1], exp1, rtol=1e-5)
+    v = np.asarray(var.numpy())
+    np.testing.assert_allclose(v[0, 0, 0], [0.1, 0.1, 0.2, 0.2], rtol=1e-6)
+
+
+# ---- matrix_nms -------------------------------------------------------------
+
+def test_matrix_nms_decay_math():
+    # two overlapping boxes + one far box, one class (background=-1 keeps all)
+    bboxes = np.array([[[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]]],
+                      np.float32)
+    scores = np.array([[[0.9, 0.8, 0.7]]], np.float32)
+    out, idx, nums = V.matrix_nms(
+        paddle.to_tensor(bboxes), paddle.to_tensor(scores),
+        score_threshold=0.1, post_threshold=0.0, nms_top_k=-1, keep_top_k=-1,
+        background_label=-1, normalized=False, return_index=True)
+    o = np.asarray(out.numpy())
+    assert o.shape == (3, 6)
+    # decayed score of box 1: (1 - iou01) / (1 - 0) * 0.8
+    inter = (min(10, 11) - max(0, 1) + 1) ** 2
+    a0 = 11 * 11
+    a1 = 11 * 11
+    iou01 = inter / (a0 + a1 - inter)
+    np.testing.assert_allclose(sorted(o[:, 1], reverse=True),
+                               [0.9, max((1 - iou01) * 0.8, 0.7),
+                                min((1 - iou01) * 0.8, 0.7)], rtol=1e-5)
+    assert np.asarray(nums.numpy()).tolist() == [3]
+
+
+def test_matrix_nms_thresholds_and_topk():
+    bboxes = np.array([[[0, 0, 10, 10], [1, 1, 11, 11], [2, 2, 12, 12]]],
+                      np.float32)
+    scores = np.array([[[0.9, 0.8, 0.05]]], np.float32)
+    out, nums = V.matrix_nms(
+        paddle.to_tensor(bboxes), paddle.to_tensor(scores),
+        score_threshold=0.1, post_threshold=0.5, nms_top_k=2, keep_top_k=1,
+        background_label=-1, normalized=True)
+    o = np.asarray(out.numpy())
+    assert o.shape == (1, 6) and abs(o[0, 1] - 0.9) < 1e-6
+    assert np.asarray(nums.numpy()).tolist() == [1]
+
+
+# ---- deform_conv2d ----------------------------------------------------------
+
+def _deform_oracle(x, off, w, b, stride, pad, dil, dg, groups, mask):
+    """Scalar transcription of deformable_conv_functor.cc."""
+    n, cin, hh, ww = x.shape
+    cout, cin_g, kh, kw = w.shape
+    ho = (hh + 2 * pad - (dil * (kh - 1) + 1)) // stride + 1
+    wo = (ww + 2 * pad - (dil * (kw - 1) + 1)) // stride + 1
+    out = np.zeros((n, cout, ho, wo))
+    cpg = cin // groups
+
+    def bilinear(img, h, w_):
+        h0, w0 = int(np.floor(h)), int(np.floor(w_))
+        val = 0.0
+        for dh in (0, 1):
+            for dw in (0, 1):
+                hi, wi = h0 + dh, w0 + dw
+                if 0 <= hi < img.shape[0] and 0 <= wi < img.shape[1]:
+                    wt = ((h - h0) if dh else (1 - (h - h0))) * \
+                         ((w_ - w0) if dw else (1 - (w_ - w0)))
+                    val += wt * img[hi, wi]
+        return val
+
+    for ni in range(n):
+        for oc in range(cout):
+            g = oc // (cout // groups)
+            for oh in range(ho):
+                for ow in range(wo):
+                    acc = 0.0
+                    for ic in range(cin_g):
+                        c_im = g * cpg + ic
+                        gd = c_im // (cin // dg)
+                        for i in range(kh):
+                            for j in range(kw):
+                                t = i * kw + j
+                                oh_off = off[ni, (gd * 2 * kh * kw)
+                                             + 2 * t, oh, ow]
+                                ow_off = off[ni, (gd * 2 * kh * kw)
+                                             + 2 * t + 1, oh, ow]
+                                h_im = oh * stride - pad + i * dil + oh_off
+                                w_im = ow * stride - pad + j * dil + ow_off
+                                v = 0.0
+                                if -1 < h_im < hh and -1 < w_im < ww:
+                                    v = bilinear(x[ni, c_im], h_im, w_im)
+                                if mask is not None:
+                                    v *= mask[ni, gd * kh * kw + t, oh, ow]
+                                acc += v * w[oc, ic, i, j]
+                    out[ni, oc, oh, ow] = acc
+    if b is not None:
+        out += b.reshape(1, -1, 1, 1)
+    return out
+
+
+@pytest.mark.parametrize("dg,groups,with_mask", [(1, 1, False), (2, 1, True),
+                                                 (1, 2, True)])
+def test_deform_conv2d_matches_kernel_math(dg, groups, with_mask):
+    n, cin, hh, ww = 1, 4, 6, 6
+    cout, kh, kw = 4, 3, 3
+    stride, pad, dil = 1, 1, 1
+    x = RNG.normal(size=(n, cin, hh, ww)).astype(np.float32)
+    w = RNG.normal(size=(cout, cin // groups, kh, kw)).astype(np.float32) * 0.2
+    b = RNG.normal(size=(cout,)).astype(np.float32)
+    ho = wo = 6
+    off = (RNG.normal(size=(n, 2 * dg * kh * kw, ho, wo)) * 0.7).astype(
+        np.float32)
+    mask = (RNG.uniform(0.2, 1.0, size=(n, dg * kh * kw, ho, wo)).astype(
+        np.float32) if with_mask else None)
+    out = V.deform_conv2d(
+        paddle.to_tensor(x), paddle.to_tensor(off), paddle.to_tensor(w),
+        bias=paddle.to_tensor(b), stride=stride, padding=pad, dilation=dil,
+        deformable_groups=dg, groups=groups,
+        mask=paddle.to_tensor(mask) if with_mask else None)
+    ref = _deform_oracle(x.astype(np.float64), off.astype(np.float64),
+                         w.astype(np.float64), b.astype(np.float64),
+                         stride, pad, dil, dg, groups,
+                         mask.astype(np.float64) if with_mask else None)
+    np.testing.assert_allclose(np.asarray(out.numpy()), ref, rtol=2e-4,
+                               atol=2e-5)
+
+
+def test_deform_conv2d_zero_offset_equals_conv():
+    import paddle_tpu.nn.functional as F
+    x = RNG.normal(size=(1, 3, 8, 8)).astype(np.float32)
+    w = RNG.normal(size=(5, 3, 3, 3)).astype(np.float32) * 0.3
+    off = np.zeros((1, 18, 8, 8), np.float32)
+    out = V.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                          paddle.to_tensor(w), padding=1)
+    ref = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w), padding=1)
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               np.asarray(ref.numpy()), rtol=2e-4, atol=2e-4)
+
+
+def test_deform_conv2d_grad_flows():
+    x = paddle.to_tensor(RNG.normal(size=(1, 2, 5, 5)).astype(np.float32))
+    off = paddle.to_tensor(
+        (RNG.normal(size=(1, 8, 5, 5)) * 0.5).astype(np.float32))
+    w = paddle.to_tensor(RNG.normal(size=(2, 2, 2, 2)).astype(np.float32))
+    for t in (x, off, w):
+        t.stop_gradient = False
+    # offset spatial dims define the output grid (kernel contract)
+    out = V.deform_conv2d(x, off, w, padding=0, stride=1)
+    paddle.sum(out).backward()
+    assert x.grad is not None and off.grad is not None and w.grad is not None
+    assert np.isfinite(off.grad.numpy()).all()
+
+
+# ---- roi_pool ---------------------------------------------------------------
+
+def _roi_pool_oracle(x, boxes, batch_ids, out_hw, scale):
+    n_rois = boxes.shape[0]
+    c = x.shape[1]
+    ph, pw = out_hw
+    out = np.zeros((n_rois, c, ph, pw))
+    for r in range(n_rois):
+        bx = np.round(boxes[r] * scale).astype(int)
+        x1, y1, x2, y2 = bx
+        bh = max(y2 - y1 + 1, 1)
+        bw = max(x2 - x1 + 1, 1)
+        for ih in range(ph):
+            hs = int(np.floor(ih * bh / ph)) + y1
+            he = int(np.ceil((ih + 1) * bh / ph)) + y1
+            hs, he = max(hs, 0), min(he, x.shape[2])
+            for iw in range(pw):
+                ws = int(np.floor(iw * bw / pw)) + x1
+                we = int(np.ceil((iw + 1) * bw / pw)) + x1
+                ws, we = max(ws, 0), min(we, x.shape[3])
+                if hs >= he or ws >= we:
+                    continue
+                out[r, :, ih, iw] = x[batch_ids[r], :, hs:he, ws:we].max(
+                    axis=(1, 2))
+    return out
+
+
+def test_roi_pool_matches_kernel_math():
+    x = RNG.normal(size=(2, 3, 8, 8)).astype(np.float32)
+    boxes = np.array([[0, 0, 7, 7], [2, 2, 6, 7], [1, 0, 5, 3]], np.float32)
+    nums = np.array([2, 1], np.int32)
+    out = V.roi_pool(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                     paddle.to_tensor(nums), output_size=2, spatial_scale=1.0)
+    ref = _roi_pool_oracle(x.astype(np.float64), boxes, [0, 0, 1], (2, 2), 1.0)
+    np.testing.assert_allclose(np.asarray(out.numpy()), ref, rtol=1e-5)
+
+
+def test_psroi_pool_shapes_and_mean():
+    ph = pw = 2
+    cout = 3
+    x = RNG.normal(size=(1, cout * ph * pw, 6, 6)).astype(np.float32)
+    boxes = np.array([[0, 0, 5, 5]], np.float32)
+    out = V.psroi_pool(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                       paddle.to_tensor(np.array([1], np.int32)),
+                       output_size=2)
+    assert tuple(out.shape) == (1, cout, 2, 2)
+    # bin (0,0) of out channel c averages channel c*4 over rows/cols 0..2
+    exp = x[0, 0, 0:3, 0:3].mean()
+    np.testing.assert_allclose(np.asarray(out.numpy())[0, 0, 0, 0], exp,
+                               rtol=1e-5)

@@ -1,0 +1,116 @@
+"""paddle.geometric message passing / sampling / reindex vs oracles.
+
+send_u_recv/send_ue_recv outputs match the reference docstring examples
+(send_recv.py:55/:210); sampling/reindex checked structurally.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import geometric as G
+
+
+def test_send_u_recv_docstring_example():
+    x = paddle.to_tensor(np.array([[0, 2, 3], [1, 4, 5], [2, 6, 7]],
+                                  np.float32))
+    src = paddle.to_tensor(np.array([0, 1, 2, 0], np.int32))
+    dst = paddle.to_tensor(np.array([1, 2, 1, 0], np.int32))
+    out = G.send_u_recv(x, src, dst, reduce_op="sum")
+    np.testing.assert_allclose(out.numpy(),
+                               [[0, 2, 3], [2, 8, 10], [1, 4, 5]])
+
+
+def test_send_u_recv_reduce_ops():
+    x = paddle.to_tensor(np.array([[1.0], [2.0], [3.0]], np.float32))
+    src = paddle.to_tensor(np.array([0, 1, 2], np.int32))
+    dst = paddle.to_tensor(np.array([0, 0, 2], np.int32))
+    assert G.send_u_recv(x, src, dst, "mean").numpy().tolist() == \
+        [[1.5], [0.0], [3.0]]
+    assert G.send_u_recv(x, src, dst, "max").numpy().tolist() == \
+        [[2.0], [0.0], [3.0]]
+    assert G.send_u_recv(x, src, dst, "min").numpy().tolist() == \
+        [[1.0], [0.0], [3.0]]
+
+
+def test_send_ue_recv_docstring_example():
+    x = paddle.to_tensor(np.array([[0, 2, 3], [1, 4, 5], [2, 6, 7]],
+                                  np.float32))
+    y = paddle.to_tensor(np.array([1, 1, 1, 1], np.float32))
+    src = paddle.to_tensor(np.array([0, 1, 2, 0], np.int32))
+    dst = paddle.to_tensor(np.array([1, 2, 1, 0], np.int32))
+    out = G.send_ue_recv(x, y, src, dst, "add", "sum")
+    np.testing.assert_allclose(out.numpy(),
+                               [[1, 3, 4], [4, 10, 12], [2, 5, 6]])
+
+
+def test_send_uv():
+    x = paddle.to_tensor(np.array([[0, 2, 3], [1, 4, 5], [2, 6, 7]],
+                                  np.float32))
+    y = paddle.to_tensor(np.array([[0, 1, 2], [2, 3, 4], [4, 5, 6]],
+                                  np.float32))
+    src = paddle.to_tensor(np.array([0, 1, 2, 0], np.int32))
+    dst = paddle.to_tensor(np.array([1, 2, 1, 0], np.int32))
+    out = G.send_uv(x, y, src, dst, "add")
+    np.testing.assert_allclose(
+        out.numpy(), [[2, 5, 7], [5, 9, 11], [4, 9, 11], [0, 3, 5]])
+
+
+def test_send_u_recv_grad_flows():
+    x = paddle.to_tensor(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+    x.stop_gradient = False
+    src = paddle.to_tensor(np.array([0, 1, 0], np.int32))
+    dst = paddle.to_tensor(np.array([1, 0, 0], np.int32))
+    out = G.send_u_recv(x, src, dst, "sum")
+    paddle.sum(out).backward()
+    # node 0 is source of 2 edges, node 1 of 1
+    np.testing.assert_allclose(x.grad.numpy(), [[2, 2], [1, 1]])
+
+
+def test_sample_neighbors_and_reindex():
+    # CSC: node 0 has neighbors [1, 2], node 1 -> [2], node 2 -> [0, 1]
+    row = paddle.to_tensor(np.array([1, 2, 2, 0, 1], np.int64))
+    colptr = paddle.to_tensor(np.array([0, 2, 3, 5], np.int64))
+    nodes = paddle.to_tensor(np.array([0, 2], np.int64))
+    neigh, counts = G.sample_neighbors(row, colptr, nodes, sample_size=-1)
+    assert counts.numpy().tolist() == [2, 2]
+    assert neigh.numpy().tolist() == [1, 2, 0, 1]
+
+    src, dst, out_nodes = G.reindex_graph(nodes, neigh, counts)
+    on = out_nodes.numpy().tolist()
+    assert on[:2] == [0, 2]
+    # every edge endpoint resolves through out_nodes to the original ids
+    for s, original in zip(src.numpy().tolist(), [1, 2, 0, 1]):
+        assert on[s] == original
+    assert dst.numpy().tolist() == [0, 0, 1, 1]
+
+    neigh2, counts2 = G.sample_neighbors(row, colptr, nodes, sample_size=1)
+    assert counts2.numpy().tolist() == [1, 1]
+
+
+def test_weighted_sample_neighbors_prefers_heavy_edges():
+    row = paddle.to_tensor(np.array([1, 2], np.int64))
+    colptr = paddle.to_tensor(np.array([0, 2], np.int64))
+    w = paddle.to_tensor(np.array([1000.0, 0.001], np.float32))
+    nodes = paddle.to_tensor(np.array([0], np.int64))
+    hits = 0
+    for _ in range(10):
+        neigh, _ = G.weighted_sample_neighbors(row, colptr, w, nodes,
+                                               sample_size=1)
+        hits += int(neigh.numpy().tolist()[0] == 1)
+    assert hits >= 8  # heavy edge nearly always wins
+
+
+def test_reexported_segment_ops():
+    x = paddle.to_tensor(np.array([[1.0], [2.0], [3.0]], np.float32))
+    ids = paddle.to_tensor(np.array([0, 0, 1], np.int32))
+    np.testing.assert_allclose(G.segment_sum(x, ids).numpy(), [[3.0], [3.0]])
+
+
+def test_sample_neighbors_seeded_reproducible():
+    row = paddle.to_tensor(np.arange(10, dtype=np.int64))
+    colptr = paddle.to_tensor(np.array([0, 10], np.int64))
+    nodes = paddle.to_tensor(np.array([0], np.int64))
+    paddle.seed(123)
+    a, _ = G.sample_neighbors(row, colptr, nodes, sample_size=3)
+    paddle.seed(123)
+    b, _ = G.sample_neighbors(row, colptr, nodes, sample_size=3)
+    assert a.numpy().tolist() == b.numpy().tolist()

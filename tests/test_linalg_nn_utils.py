@@ -1,0 +1,117 @@
+"""lu_unpack / matrix_rank atol-rtol / nn.utils weight+spectral norm."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+RNG = np.random.default_rng(9)
+
+
+def test_lu_unpack_reconstructs():
+    a = RNG.normal(size=(5, 5)).astype(np.float32)
+    lu, piv = paddle.linalg.lu(paddle.to_tensor(a))
+    p, l_, u = paddle.linalg.lu_unpack(lu, piv)
+    rec = np.asarray(p.numpy()) @ np.asarray(l_.numpy()) @ np.asarray(u.numpy())
+    np.testing.assert_allclose(rec, a, rtol=1e-4, atol=1e-5)
+
+
+def test_lu_unpack_rectangular_and_torch():
+    torch = pytest.importorskip("torch")
+    a = RNG.normal(size=(4, 6)).astype(np.float32)
+    lu, piv = paddle.linalg.lu(paddle.to_tensor(a))
+    p, l_, u = paddle.linalg.lu_unpack(lu, piv)
+    tlu, tpiv = torch.linalg.lu_factor(torch.tensor(a.astype(np.float64)))
+    tp, tl, tu = torch.lu_unpack(tlu, tpiv)
+    assert tuple(l_.shape) == tuple(tl.shape)
+    assert tuple(u.shape) == tuple(tu.shape)
+    rec = np.asarray(p.numpy()) @ np.asarray(l_.numpy()) @ np.asarray(u.numpy())
+    np.testing.assert_allclose(rec, a, rtol=1e-4, atol=1e-5)
+
+
+def test_matrix_rank_tol_variants():
+    # rank-2 matrix with a tiny third singular value
+    u_ = np.linalg.qr(RNG.normal(size=(5, 5)))[0]
+    v_ = np.linalg.qr(RNG.normal(size=(5, 5)))[0]
+    s = np.diag([5.0, 2.0, 1e-4, 0.0, 0.0])
+    a = (u_ @ s @ v_).astype(np.float32)
+    t = paddle.to_tensor(a)
+    assert int(paddle.linalg.matrix_rank(t).numpy()) == 3  # default eps tiny
+    assert int(paddle.linalg.matrix_rank(t, tol=1e-2).numpy()) == 2
+    assert int(paddle.linalg.matrix_rank(t, atol=1e-2, rtol=0.0).numpy()) == 2
+    assert int(paddle.linalg.matrix_rank(t, atol=0.0, rtol=0.5).numpy()) == 1
+    sym = (a @ a.T).astype(np.float32)
+    r = paddle.linalg.matrix_rank(paddle.to_tensor(sym), hermitian=True,
+                                  tol=1e-3)
+    assert int(r.numpy()) == 2
+
+
+def test_weight_norm_matches_torch():
+    torch = pytest.importorskip("torch")
+    lin = nn.Linear(4, 3)
+    w0 = np.asarray(lin.weight.numpy())  # [in, out] paddle layout
+    nn.utils.weight_norm(lin, dim=1)
+    x = RNG.normal(size=(2, 4)).astype(np.float32)
+    out = lin(paddle.to_tensor(x))
+    # oracle: w = g * v/||v|| computed per output column (dim=1)
+    g = np.asarray(lin.weight_g.numpy())
+    v = np.asarray(lin.weight_v.numpy())
+    wn = g * v / np.sqrt((v ** 2).sum(axis=0, keepdims=True))
+    ref = x @ wn + np.asarray(lin.bias.numpy())
+    np.testing.assert_allclose(np.asarray(out.numpy()), ref, rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(wn, w0, rtol=1e-5, atol=1e-6)  # init preserves
+
+    nn.utils.remove_weight_norm(lin)
+    out2 = lin(paddle.to_tensor(x))
+    np.testing.assert_allclose(np.asarray(out2.numpy()), ref, rtol=1e-5,
+                               atol=1e-6)
+    assert not hasattr(lin, "weight_v")
+
+
+def test_weight_norm_trains():
+    lin = nn.Linear(4, 2)
+    nn.utils.weight_norm(lin)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=list(lin.parameters()))
+    x = paddle.to_tensor(RNG.normal(size=(8, 4)).astype(np.float32))
+    y = paddle.to_tensor(RNG.normal(size=(8, 2)).astype(np.float32))
+    first = None
+    for _ in range(10):
+        loss = paddle.mean((lin(x) - y) ** 2)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        first = first if first is not None else float(loss.numpy())
+    assert float(loss.numpy()) < first
+    assert lin.weight_g.grad is None  # cleared
+
+
+def test_spectral_norm_unit_sigma():
+    lin = nn.Linear(6, 4)
+    nn.utils.spectral_norm(lin, n_power_iterations=20)
+    x = paddle.to_tensor(np.eye(6, dtype=np.float32))
+    lin(x)  # trigger hook
+    w = np.asarray(lin.weight.numpy())
+    sigma = np.linalg.svd(w, compute_uv=False).max()
+    np.testing.assert_allclose(sigma, 1.0, rtol=1e-3)
+
+
+def test_parameters_vector_roundtrip():
+    lin = nn.Linear(3, 2)
+    params = list(lin.parameters())
+    vec = nn.utils.parameters_to_vector(params)
+    assert tuple(vec.shape) == (3 * 2 + 2,)
+    orig = [np.asarray(p.numpy()).copy() for p in params]
+    nn.utils.vector_to_parameters(vec * 2.0, params)
+    for p, o in zip(params, orig):
+        np.testing.assert_allclose(np.asarray(p.numpy()), o * 2, rtol=1e-6)
+
+
+def test_lu_unpack_flags():
+    a = RNG.normal(size=(4, 4)).astype(np.float32)
+    lu, piv = paddle.linalg.lu(paddle.to_tensor(a))
+    p, l_, u = paddle.linalg.lu_unpack(lu, piv, unpack_ludata=False)
+    assert l_ is None and u is None and p is not None
+    p2, l2, u2 = paddle.linalg.lu_unpack(lu, piv, unpack_pivots=False)
+    assert p2 is None and l2 is not None and u2 is not None
