@@ -64,13 +64,15 @@ def _attempt_table():
                            num_attention_heads=16, num_key_value_heads=16,
                            max_position_embeddings=2048)
 
-    # tag -> (cfg, batch, seq, steps, warmup, remat)
+    # tag -> (cfg, batch, seq, steps, warmup, remat, loss_chunk)
+    # loss_chunk: sequence-chunked CE (no [B,S,V] logits buffer) — the
+    # 1.1B configs need it to fit ~13GB usable HBM on one v5e
     table = {
-        "llama-1.1b-b8": (cfg_1b(), 8, 2048, 10, 2, True),
-        "llama-1.1b-b4": (cfg_1b(), 4, 2048, 10, 2, True),
-        "llama-1.1b-b2": (cfg_1b(), 2, 2048, 10, 2, True),
-        "llama-0.27b-b8": (cfg_small(), 8, 2048, 10, 2, False),
-        "llama-0.27b-b8-remat": (cfg_small(), 8, 2048, 10, 2, True),
+        "llama-1.1b-b8": (cfg_1b(), 8, 2048, 10, 2, True, 256),
+        "llama-1.1b-b4": (cfg_1b(), 4, 2048, 10, 2, True, 256),
+        "llama-1.1b-b2": (cfg_1b(), 2, 2048, 10, 2, True, 256),
+        "llama-0.27b-b8": (cfg_small(), 8, 2048, 10, 2, False, None),
+        "llama-0.27b-b8-remat": (cfg_small(), 8, 2048, 10, 2, True, 256),
     }
     assert set(table) == set(ATTEMPT_ORDER)
     return table
@@ -164,13 +166,14 @@ def main():
     if debug:
         attempts = [("tiny", LlamaConfig.tiny(vocab_size=256, hidden_size=64,
                                               layers=2, heads=4, kv_heads=2,
-                                              seq=128), 2, 128, 4, 1, False)]
+                                              seq=128), 2, 128, 4, 1, False,
+                     None)]
     else:
         table = _attempt_table()
         attempts = [(attempt_tag, *table[attempt_tag])]
 
     last_err = None
-    for tag, cfg, batch, seq, steps, warmup, remat in attempts:
+    for tag, cfg, batch, seq, steps, warmup, remat, loss_chunk in attempts:
         try:
             deadline["t"] = time.monotonic() + 1500
             deadline["what"] = f"compile/measure {tag}"
@@ -181,7 +184,8 @@ def main():
                                   parameters=model.parameters())
 
             def loss_fn(m, input_ids, labels):
-                return m.compute_loss(m(input_ids), labels)
+                return m.forward_loss(input_ids, labels,
+                                      loss_chunk_size=loss_chunk)
 
             trainer = SpmdTrainer(
                 model, optimizer, loss_fn, mesh=None,

@@ -123,21 +123,50 @@ def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
     st, dt = ensure_tensor(src_index), ensure_tensor(dst_index)
 
     def fwd(xa, ya, src, dst):
-        xe = xa[src.astype(jnp.int32)].astype(jnp.float32)
-        ye = ya[dst.astype(jnp.int32)].astype(jnp.float32)
-        if message_op == "add":
-            out = xe + ye
-        elif message_op == "sub":
-            out = xe - ye
-        elif message_op == "mul":
-            out = xe * ye
-        elif message_op == "div":
-            out = xe / ye
-        else:
-            raise ValueError(f"unknown message_op {message_op!r}")
+        out = _message(message_op, xa[src.astype(jnp.int32)],
+                       ya[dst.astype(jnp.int32)])
         return out.astype(xa.dtype)
 
     return dispatch("send_uv", fwd, xt, yt, st, dt)
+
+
+def _sample_csc(row, colptr, input_nodes, sample_size, eids, return_eids,
+                weights):
+    """Shared CSC neighbor sampler (uniform when weights is None)."""
+    import numpy as np
+
+    rows = np.asarray(ensure_tensor(row).numpy()).reshape(-1)
+    cptr = np.asarray(ensure_tensor(colptr).numpy()).reshape(-1)
+    nodes = np.asarray(ensure_tensor(input_nodes).numpy()).reshape(-1)
+    wts = (np.asarray(ensure_tensor(weights).numpy()).reshape(-1)
+           if weights is not None else None)
+    eid_arr = (np.asarray(ensure_tensor(eids).numpy()).reshape(-1)
+               if eids is not None else None)
+    if return_eids and eid_arr is None:
+        raise ValueError("return_eids=True requires eids")
+    rng = _host_rng()
+    out_n, out_c, out_e = [], [], []
+    for v in nodes:
+        beg, end = int(cptr[v]), int(cptr[v + 1])
+        if sample_size < 0 or end - beg <= sample_size:
+            pick = np.arange(end - beg)
+        else:
+            pr = None
+            if wts is not None:
+                w = wts[beg:end].astype(np.float64)
+                pr = w / w.sum()
+            pick = rng.choice(end - beg, size=sample_size, replace=False,
+                              p=pr)
+        out_n.append(rows[beg:end][pick])
+        out_c.append(len(pick))
+        if eid_arr is not None:
+            out_e.append(eid_arr[beg:end][pick])
+    neighbors = Tensor(jnp.asarray(np.concatenate(out_n)
+                                   if out_n else np.zeros(0, rows.dtype)))
+    counts = Tensor(jnp.asarray(np.asarray(out_c, np.int32)))
+    if return_eids:
+        return neighbors, counts, Tensor(jnp.asarray(np.concatenate(out_e)))
+    return neighbors, counts
 
 
 def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
@@ -146,34 +175,8 @@ def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
 
     Host-side eager op (data-dependent output size, like the reference CPU
     kernel). Returns (out_neighbors, out_count[, out_eids])."""
-    import numpy as np
-
-    rows = np.asarray(ensure_tensor(row).numpy()).reshape(-1)
-    cptr = np.asarray(ensure_tensor(colptr).numpy()).reshape(-1)
-    nodes = np.asarray(ensure_tensor(input_nodes).numpy()).reshape(-1)
-    eid_arr = (np.asarray(ensure_tensor(eids).numpy()).reshape(-1)
-               if eids is not None else None)
-    rng = _host_rng()
-    out_n, out_c, out_e = [], [], []
-    for v in nodes:
-        beg, end = int(cptr[v]), int(cptr[v + 1])
-        neigh = rows[beg:end]
-        if sample_size < 0 or end - beg <= sample_size:
-            pick = np.arange(end - beg)
-        else:
-            pick = rng.choice(end - beg, size=sample_size, replace=False)
-        out_n.append(neigh[pick])
-        out_c.append(len(pick))
-        if eid_arr is not None:
-            out_e.append(eid_arr[beg:end][pick])
-    neighbors = Tensor(jnp.asarray(np.concatenate(out_n)
-                                   if out_n else np.zeros(0, rows.dtype)))
-    counts = Tensor(jnp.asarray(np.asarray(out_c, np.int32)))
-    if return_eids:
-        if eid_arr is None:
-            raise ValueError("return_eids=True requires eids")
-        return neighbors, counts, Tensor(jnp.asarray(np.concatenate(out_e)))
-    return neighbors, counts
+    return _sample_csc(row, colptr, input_nodes, sample_size, eids,
+                       return_eids, None)
 
 
 def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
@@ -181,38 +184,8 @@ def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
                               name=None):
     """Weighted neighbor sampling (weighted_sample_neighbors op): neighbors
     drawn without replacement with probability proportional to edge weight."""
-    import numpy as np
-
-    rows = np.asarray(ensure_tensor(row).numpy()).reshape(-1)
-    cptr = np.asarray(ensure_tensor(colptr).numpy()).reshape(-1)
-    wts = np.asarray(ensure_tensor(edge_weight).numpy()).reshape(-1)
-    nodes = np.asarray(ensure_tensor(input_nodes).numpy()).reshape(-1)
-    eid_arr = (np.asarray(ensure_tensor(eids).numpy()).reshape(-1)
-               if eids is not None else None)
-    rng = _host_rng()
-    out_n, out_c, out_e = [], [], []
-    for v in nodes:
-        beg, end = int(cptr[v]), int(cptr[v + 1])
-        neigh = rows[beg:end]
-        w = wts[beg:end].astype(np.float64)
-        if sample_size < 0 or end - beg <= sample_size:
-            pick = np.arange(end - beg)
-        else:
-            pr = w / w.sum()
-            pick = rng.choice(end - beg, size=sample_size, replace=False,
-                              p=pr)
-        out_n.append(neigh[pick])
-        out_c.append(len(pick))
-        if eid_arr is not None:
-            out_e.append(eid_arr[beg:end][pick])
-    neighbors = Tensor(jnp.asarray(np.concatenate(out_n)
-                                   if out_n else np.zeros(0, rows.dtype)))
-    counts = Tensor(jnp.asarray(np.asarray(out_c, np.int32)))
-    if return_eids:
-        if eid_arr is None:
-            raise ValueError("return_eids=True requires eids")
-        return neighbors, counts, Tensor(jnp.asarray(np.concatenate(out_e)))
-    return neighbors, counts
+    return _sample_csc(row, colptr, input_nodes, sample_size, eids,
+                       return_eids, edge_weight)
 
 
 def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,

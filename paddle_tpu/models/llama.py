@@ -270,6 +270,61 @@ class LlamaForCausalLM(nn.Layer):
         return F.cross_entropy(reshape(shift_logits, [b * (s - 1), v]),
                                reshape(shift_labels, [b * (s - 1)]))
 
+    def forward_loss(self, input_ids, labels, loss_chunk_size=None,
+                     attention_mask=None):
+        """Trunk forward + shifted CE without materializing full logits.
+
+        With loss_chunk_size=c, the head matmul + softmax run per sequence
+        chunk inside a remat'd lax.scan, so peak memory holds [B, c, V]
+        logits instead of [B, S, V] (plus the same-sized cotangent) — the
+        difference between fitting and OOMing a 1B-class model on one 16GB
+        chip. Numerics identical to compute_loss(self(ids), labels).
+        """
+        if loss_chunk_size is None:
+            return self.compute_loss(self(input_ids, attention_mask), labels)
+        h = self.model(input_ids, attention_mask)
+        tied = self.lm_head is None
+        w = (self.model.embed_tokens.weight if tied
+             else self.lm_head.weight)  # tied: [V, H]; head: [H, V]
+        lt = ensure_tensor(labels)
+        c = int(loss_chunk_size)
+
+        def fwd(h_a, w_raw, y_a):
+            w_a = w_raw.T if tied else w_raw
+            hs = h_a[:, :-1, :]
+            ys = y_a[:, 1:]
+            b, sm1, hid = hs.shape
+            nc = -(-sm1 // c)
+            pad = nc * c - sm1
+            hs = jnp.pad(hs, ((0, 0), (0, pad), (0, 0)))
+            ys = jnp.pad(ys, ((0, 0), (0, pad)), constant_values=0)
+            valid = jnp.pad(jnp.ones((b, sm1), jnp.bool_),
+                            ((0, 0), (0, pad)))
+            hs = hs.reshape(b, nc, c, hid).swapaxes(0, 1)
+            ys = ys.reshape(b, nc, c).swapaxes(0, 1)
+            valid = valid.reshape(b, nc, c).swapaxes(0, 1)
+
+            def body(carry, xs):
+                hc, yc, mc = xs
+                # honor cross_entropy's ignore_index=-100 contract so the
+                # chunked path matches compute_loss on padded batches
+                mc = mc & (yc != -100)
+                yc = jnp.where(yc < 0, 0, yc)
+                logits = hc.astype(jnp.float32) @ w_a.astype(jnp.float32)
+                logp = jax.nn.log_softmax(logits, axis=-1)
+                nll = -jnp.take_along_axis(
+                    logp, yc[..., None].astype(jnp.int32), axis=-1)[..., 0]
+                s_ = jnp.sum(jnp.where(mc, nll, 0.0))
+                n_ = jnp.sum(mc)
+                return (carry[0] + s_, carry[1] + n_), None
+
+            (tot, cnt), _ = jax.lax.scan(
+                jax.checkpoint(body), (jnp.float32(0.0), jnp.int32(0)),
+                (hs, ys, valid))
+            return tot / jnp.maximum(cnt, 1).astype(jnp.float32)
+
+        return dispatch("chunked_causal_ce", fwd, h, ensure_tensor(w), lt)
+
     # -- pipeline protocol (parallel.pipeline.PipelinedTrainer) ---------------
     def pp_block_layers(self):
         return list(self.model.layers)

@@ -148,15 +148,18 @@ def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
 
 
 def to_sparse_coo(dense: Tensor, sparse_dim: Optional[int] = None):
-    """Dense -> COO over the leading `sparse_dim` dims (default: all)."""
+    """Dense -> COO over the leading `sparse_dim` dims (default: all).
+
+    The coordinate pattern is data (extracted eagerly); the values gather
+    goes through dispatch so gradients flow back into the dense input."""
     dt = ensure_tensor(dense)
     arr = dt._data
     nd = sparse_dim or arr.ndim
     lead = arr.reshape(arr.shape[:nd] + (-1,))
     mask = jnp.any(lead != 0, axis=-1)
     idx = jnp.stack(jnp.nonzero(mask))
-    vals = arr[tuple(idx)]
-    return SparseCooTensor(Tensor(idx), Tensor(vals), list(arr.shape))
+    vals = dispatch("coo_values_gather", lambda a: a[tuple(idx)], dt)
+    return SparseCooTensor(Tensor(idx), vals, list(arr.shape))
 
 
 def to_sparse_csr(dense: Tensor) -> SparseCsrTensor:
@@ -188,12 +191,162 @@ def _unary(name, jnp_fn):
 relu = _unary("relu", jax.nn.relu)
 abs = _unary("abs", jnp.abs)
 sin = _unary("sin", jnp.sin)
+sinh = _unary("sinh", jnp.sinh)
+asin = _unary("asin", jnp.arcsin)
+asinh = _unary("asinh", jnp.arcsinh)
+atan = _unary("atan", jnp.arctan)
+atanh = _unary("atanh", jnp.arctanh)
+tan = _unary("tan", jnp.tan)
 tanh = _unary("tanh", jnp.tanh)
 sqrt = _unary("sqrt", jnp.sqrt)
+square = _unary("square", jnp.square)
 neg = _unary("neg", jnp.negative)
 expm1 = _unary("expm1", jnp.expm1)
 log1p = _unary("log1p", jnp.log1p)
-pow = _unary("square", jnp.square)  # noqa: A001 - parity name
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+isnan = _unary("isnan", jnp.isnan)
+
+
+def pow(x, factor, name=None):  # noqa: A001 - parity name
+    """Element-wise power on the stored values (zero-preserving for
+    factor > 0, matching the reference sparse pow)."""
+    f = float(factor)
+    return _unary("pow", lambda v: jnp.power(v, f))(x)
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    from ..framework.dtype import convert_dtype
+    vd = convert_dtype(value_dtype) if value_dtype is not None else None
+    idd = convert_dtype(index_dtype) if index_dtype is not None else None
+    if isinstance(x, SparseCooTensor):
+        idx = (Tensor(x.indices._data.astype(idd)) if idd else x.indices)
+        vals = (Tensor(x.values._data.astype(vd)) if vd else x.values)
+        return SparseCooTensor(idx, vals, x.shape)
+    if isinstance(x, SparseCsrTensor):
+        crows = (Tensor(x.crows._data.astype(idd)) if idd else x.crows)
+        cols = (Tensor(x.cols._data.astype(idd)) if idd else x.cols)
+        vals = (Tensor(x.values._data.astype(vd)) if vd else x.values)
+        return SparseCsrTensor(crows, cols, vals, x.shape)
+    raise TypeError("sparse.cast expects a sparse tensor")
+
+
+def coalesce(x, name=None):
+    if not isinstance(x, SparseCooTensor):
+        raise TypeError("sparse.coalesce expects a COO tensor")
+    return x.coalesce()
+
+
+def transpose(x, perm, name=None):
+    if isinstance(x, SparseCsrTensor):
+        x = x.to_sparse_coo()
+    return x.transpose(perm)
+
+
+def reshape(x, shape, name=None):
+    """COO reshape via flat-coordinate remapping over the SPARSE dims; the
+    trailing dense dims (hybrid COO) must be unchanged by the new shape."""
+    if isinstance(x, SparseCsrTensor):
+        x = x.to_sparse_coo()
+    nd = x.indices._data.shape[0]
+    old_sparse = tuple(x.shape[:nd])
+    dense_tail = list(x.shape[nd:])
+    shape = list(shape)
+    total_sparse = 1
+    for d in old_sparse:
+        total_sparse *= d
+    if -1 in shape:
+        known = 1
+        for d in shape:
+            if d != -1:
+                known *= d
+        total_all = total_sparse
+        for d in dense_tail:
+            total_all *= d
+        shape[shape.index(-1)] = total_all // known
+    if dense_tail:
+        if shape[len(shape) - len(dense_tail):] != dense_tail:
+            raise ValueError(
+                f"sparse.reshape on a hybrid COO tensor must keep the dense "
+                f"tail {dense_tail} unchanged, got {shape}")
+        new_sparse = tuple(shape[:len(shape) - len(dense_tail)])
+    else:
+        new_sparse = tuple(shape)
+    flat = jnp.ravel_multi_index(tuple(x.indices._data), old_sparse,
+                                 mode="clip")
+    new_idx = jnp.stack(jnp.unravel_index(flat, new_sparse))
+    return SparseCooTensor(Tensor(new_idx), x.values, shape)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
+    """Sum over one axis; full reduction returns a dense scalar Tensor.
+    Negative axes are normalized by the TENSOR rank; a dense-tail axis of a
+    hybrid COO tensor reduces the values array directly."""
+    if isinstance(x, SparseCsrTensor):
+        x = x.to_sparse_coo()
+    if axis is None:
+        from ..ops import math as M
+        return M.sum(x.values)
+    nd = x.indices._data.shape[0]
+    rank = len(x.shape)
+    ax = axis if axis >= 0 else axis + rank
+    if ax >= nd:
+        # dense-tail axis: values dim (ax - nd + 1); structure unchanged
+        vax = ax - nd + 1
+        vals = jnp.sum(x.values._data.astype(jnp.float32), axis=vax,
+                       keepdims=keepdim).astype(x.values._data.dtype)
+        shp = list(x.shape)
+        if keepdim:
+            shp[ax] = 1
+        else:
+            shp.pop(ax)
+        return SparseCooTensor(x.indices, Tensor(vals), shp)
+    keep = [d for d in range(nd) if d != ax]
+    new_idx = x.indices._data[jnp.asarray(keep)]
+    new_shape = [x.shape[d] for d in keep] + list(x.shape[nd:])
+    out = SparseCooTensor(Tensor(new_idx), x.values, new_shape).coalesce()
+    if keepdim:
+        exp = jnp.insert(out.indices._data, ax, 0, axis=0)
+        shp = list(out.shape)
+        shp.insert(ax, 1)
+        return SparseCooTensor(Tensor(exp), out.values, shp)
+    return out
+
+
+def slice(x, axes, starts, ends, name=None):  # noqa: A001 - parity name
+    """COO slice: host-filtered coordinates (eager; structure is data)."""
+    import numpy as np
+    if isinstance(x, SparseCsrTensor):
+        x = x.to_sparse_coo()
+    idx = np.asarray(x.indices.numpy())
+    vals_keep = np.ones(idx.shape[1], bool)
+    new_shape = list(x.shape)
+    shifts = np.zeros(idx.shape[0], np.int64)
+    for ax, st, en in zip(axes, starts, ends):
+        size = x.shape[ax]
+        st = max(st + size, 0) if st < 0 else min(st, size)
+        en = max(en + size, 0) if en < 0 else min(en, size)
+        vals_keep &= (idx[ax] >= st) & (idx[ax] < en)
+        new_shape[ax] = max(en - st, 0)
+        shifts[ax] = st
+    sel = np.nonzero(vals_keep)[0]
+    new_idx = idx[:, sel] - shifts[:, None]
+    return SparseCooTensor(Tensor(jnp.asarray(new_idx)),
+                           Tensor(x.values._data[jnp.asarray(sel)]),
+                           new_shape)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """PCA of a sparse matrix (parity: paddle.sparse.pca_lowrank). Lowers to
+    a dense SVD — XLA has no sparse factorization, and q is typically small."""
+    dense = x.to_dense() if not isinstance(x, Tensor) else x
+    a = dense._data.astype(jnp.float32)
+    if q is None:
+        q = min(6, a.shape[-2], a.shape[-1])
+    if center:
+        a = a - jnp.mean(a, axis=-2, keepdims=True)
+    u, s_, vt = jnp.linalg.svd(a, full_matrices=False)
+    return Tensor(u[..., :q]), Tensor(s_[..., :q]),         Tensor(jnp.swapaxes(vt, -1, -2)[..., :q])
 
 
 def add(x, y):
@@ -243,3 +396,70 @@ def masked_matmul(x: Tensor, y: Tensor, mask) -> SparseCooTensor:
 
 def is_same_shape(x, y) -> bool:
     return list(x.shape) == list(y.shape)
+
+
+def _coo_binary(name, x, y, fn):
+    """Elementwise sparse-sparse op via the union of coordinates (reference
+    sparse elementwise kernels); zero-fill for coordinates present in only
+    one operand."""
+    if not (isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor)):
+        raise TypeError(f"sparse.{name} expects two COO tensors")
+    xc, yc = x.coalesce(), y.coalesce()
+    nd = xc.indices._data.shape[0]
+    shape = tuple(xc.shape[:nd])
+    fx = jnp.ravel_multi_index(tuple(xc.indices._data), shape, mode="clip")
+    fy = jnp.ravel_multi_index(tuple(yc.indices._data), shape, mode="clip")
+    uni = jnp.unique(jnp.concatenate([fx, fy]))
+    n = uni.shape[0]
+    vx = jnp.zeros((n,) + xc.values._data.shape[1:], jnp.float32)         .at[jnp.searchsorted(uni, fx)].set(
+            xc.values._data.astype(jnp.float32))
+    vy = jnp.zeros((n,) + yc.values._data.shape[1:], jnp.float32)         .at[jnp.searchsorted(uni, fy)].set(
+            yc.values._data.astype(jnp.float32))
+    vals = fn(vx, vy).astype(xc.values._data.dtype)
+    idx = jnp.stack(jnp.unravel_index(uni, shape))
+    return SparseCooTensor(Tensor(idx), Tensor(vals), x.shape,
+                           coalesced=True)
+
+
+def subtract(x, y, name=None):
+    return _coo_binary("subtract", x, y, lambda a, b: a - b)
+
+
+def multiply(x, y, name=None):
+    return _coo_binary("multiply", x, y, lambda a, b: a * b)
+
+
+def divide(x, y, name=None):
+    return _coo_binary("divide", x, y, lambda a, b: a / b)
+
+
+def mv(x, vec, name=None):
+    """sparse [m, k] @ dense [k] -> dense [m]."""
+    out = matmul(x, ensure_tensor(vec).reshape([-1, 1]))
+    return out.reshape([-1])
+
+
+def mask_as(x, mask, name=None):
+    """Take dense x's values at `mask`'s coordinates."""
+    coo = mask.to_sparse_coo() if isinstance(mask, SparseCsrTensor) else mask
+    xt = ensure_tensor(x)
+    idx = coo.indices._data
+
+    def fwd(a):
+        return a[tuple(idx)]
+
+    vals = dispatch("mask_as", fwd, xt)
+    return SparseCooTensor(coo.indices, vals, coo.shape)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta * input + alpha * (x @ y) with sparse x (parity:
+    paddle.sparse.addmm)."""
+    prod = matmul(x, y)
+    it = input.to_dense() if isinstance(
+        input, (SparseCooTensor, SparseCsrTensor)) else ensure_tensor(input)
+    from ..ops import math as M
+    return M.add(M.scale(it, beta), M.scale(prod, alpha))
+
+
+from . import nn  # noqa: E402,F401 (sparse.nn layer package)

@@ -1,0 +1,219 @@
+"""paddle.sparse.nn — layers over sparse tensors.
+
+Reference parity: python/paddle/sparse/nn/ (layer/activation.py, conv.py,
+norm.py, pooling.py; kernels phi/kernels/sparse/ conv_kernel etc.).
+
+TPU-native notes: activations/norms act on the dense `values` array of the
+COO tensor (same as the reference kernels). The conv family lowers to a
+dense XLA convolution and re-sparsifies — XLA has no sparse gather-gemm
+conv; for submanifold convs the output keeps the input's coordinate set,
+matching SubmConv semantics exactly.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.layer.layers import Layer
+from ..tensor import Tensor
+
+
+def _values_map(x, fn, name):
+    from . import SparseCooTensor, SparseCsrTensor
+    from ..ops.dispatch import dispatch
+    if isinstance(x, SparseCooTensor):
+        return SparseCooTensor(x.indices, dispatch(name, fn, x.values),
+                               x.shape)
+    if isinstance(x, SparseCsrTensor):
+        return SparseCsrTensor(x.crows, x.cols, dispatch(name, fn, x.values),
+                               x.shape)
+    raise TypeError(f"sparse.nn.{name} expects a sparse tensor")
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return _values_map(x, jax.nn.relu, "sparse_relu")
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        return _values_map(x, lambda v: jnp.clip(v, 0, 6), "sparse_relu6")
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01, name=None):
+        super().__init__()
+        self._slope = float(negative_slope)
+
+    def forward(self, x):
+        s = self._slope
+        return _values_map(x, lambda v: jnp.where(v >= 0, v, s * v),
+                           "sparse_leaky_relu")
+
+
+class Softmax(Layer):
+    """Row-wise softmax over the stored values of a 2-D CSR matrix
+    (parity: sparse/nn/layer/activation.py Softmax, axis=-1 only)."""
+
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        if axis != -1:
+            raise ValueError("sparse Softmax supports axis=-1 only")
+
+    def forward(self, x):
+        from . import SparseCsrTensor
+        from ..ops.dispatch import dispatch
+        if not isinstance(x, SparseCsrTensor):
+            raise TypeError("sparse Softmax expects a CSR tensor")
+        rows = x._row_indices()
+        n_rows = x.shape[0]
+
+        def fwd(vals):
+            v = vals.astype(jnp.float32)
+            mx = jnp.full((n_rows,), jnp.finfo(jnp.float32).min) \
+                .at[rows].max(v)
+            e = jnp.exp(v - mx[rows])
+            den = jnp.zeros((n_rows,), jnp.float32).at[rows].add(e)
+            return (e / den[rows]).astype(vals.dtype)
+
+        return SparseCsrTensor(x.crows, x.cols, dispatch("sparse_softmax",
+                                                         fwd, x.values),
+                               x.shape)
+
+
+class BatchNorm(Layer):
+    """BatchNorm over the channel (last) axis of COO values (parity:
+    sparse/nn/layer/norm.py BatchNorm — input layout [N, ..., C] sparse)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        from ..nn import BatchNorm1D
+        self._bn = BatchNorm1D(num_features, momentum=momentum,
+                               epsilon=epsilon, weight_attr=weight_attr,
+                               bias_attr=bias_attr)
+
+    def forward(self, x):
+        from . import SparseCooTensor
+        if not isinstance(x, SparseCooTensor):
+            raise TypeError("sparse BatchNorm expects a COO tensor")
+        out_vals = self._bn(x.values)
+        return SparseCooTensor(x.indices, out_vals, x.shape)
+
+
+class SyncBatchNorm(BatchNorm):
+    """Single-process alias; cross-replica stats are subsumed by GSPMD when
+    the values array is batch-sharded inside a compiled step."""
+
+
+class MaxPool3D(Layer):
+    """Sparse NDHWC max pooling via dense lowering (values re-sparsified
+    with the pooled nonzero pattern)."""
+
+    def __init__(self, kernel_size, stride=None, padding=0, name=None):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+
+    def forward(self, x):
+        from . import SparseCooTensor, to_sparse_coo
+        from ..nn import functional as F
+        if not isinstance(x, SparseCooTensor):
+            raise TypeError("sparse MaxPool3D expects a COO tensor")
+        dense = x.to_dense()  # [N, D, H, W, C]
+        out = F.max_pool3d(dense.transpose([0, 4, 1, 2, 3]),
+                           self.kernel_size, self.stride, self.padding)
+        out = out.transpose([0, 2, 3, 4, 1])
+        return to_sparse_coo(out, sparse_dim=4)
+
+
+class _SparseConvNd(Layer):
+    """Shared dense-lowered sparse conv (NDHWC / NHWC layouts)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, nd,
+                 stride=1, padding=0, dilation=1, groups=1, subm=False,
+                 padding_mode="zeros", weight_attr=None, bias_attr=None,
+                 data_format=None, name=None):
+        super().__init__()
+        self._nd = nd
+        self._subm = subm
+        self.stride = stride
+        self.padding = padding
+        self.dilation = dilation
+        self.groups = groups
+        if isinstance(kernel_size, int):
+            kernel_size = (kernel_size,) * nd
+        # paddle sparse conv weight layout: [*kernel, in/groups, out]
+        self.weight = self.create_parameter(
+            tuple(kernel_size) + (in_channels // groups, out_channels),
+            attr=weight_attr)
+        self.bias = (None if bias_attr is False else
+                     self.create_parameter((out_channels,), attr=bias_attr,
+                                           is_bias=True))
+
+    def forward(self, x):
+        from . import SparseCooTensor, to_sparse_coo
+        from ..nn import functional as F
+        from ..ops.manipulation import transpose as tr
+        if not isinstance(x, SparseCooTensor):
+            raise TypeError("sparse conv expects a COO tensor")
+        nd = self._nd
+        dense = x.to_dense()                      # [N, *spatial, C]
+        perm_in = [0, nd + 1] + list(range(1, nd + 1))
+        perm_w = [nd + 1, nd] + list(range(nd))   # -> [out, in/g, *kernel]
+        conv = F.conv3d if nd == 3 else F.conv2d
+        out = conv(tr(dense, perm_in), tr(self.weight, perm_w),
+                   bias=self.bias, stride=self.stride, padding=self.padding,
+                   dilation=self.dilation, groups=self.groups)
+        perm_out = [0] + list(range(2, nd + 2)) + [1]
+        out = tr(out, perm_out)                   # [N, *spatial, C]
+        if self._subm:
+            # submanifold: output keeps the input's coordinate set
+            from . import mask_as
+            ref = SparseCooTensor(x.indices,
+                                  Tensor(jnp.ones((x.nnz(),), jnp.float32)),
+                                  list(out.shape))
+            return mask_as(out, ref)
+        return to_sparse_coo(out, sparse_dim=nd + 1)
+
+
+class Conv2D(_SparseConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NHWC",
+                 name=None):
+        super().__init__(in_channels, out_channels, kernel_size, 2, stride,
+                         padding, dilation, groups, subm=False,
+                         weight_attr=weight_attr, bias_attr=bias_attr)
+
+
+class Conv3D(_SparseConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 name=None):
+        super().__init__(in_channels, out_channels, kernel_size, 3, stride,
+                         padding, dilation, groups, subm=False,
+                         weight_attr=weight_attr, bias_attr=bias_attr)
+
+
+class SubmConv2D(_SparseConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 key=None, weight_attr=None, bias_attr=None,
+                 data_format="NHWC", name=None):
+        super().__init__(in_channels, out_channels, kernel_size, 2, stride,
+                         padding, dilation, groups, subm=True,
+                         weight_attr=weight_attr, bias_attr=bias_attr)
+
+
+class SubmConv3D(_SparseConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 key=None, weight_attr=None, bias_attr=None,
+                 data_format="NDHWC", name=None):
+        super().__init__(in_channels, out_channels, kernel_size, 3, stride,
+                         padding, dilation, groups, subm=True,
+                         weight_attr=weight_attr, bias_attr=bias_attr)

@@ -528,6 +528,7 @@ def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
         bin_w = bw.astype(jnp.float32) / pw
         outs = []
         neg = jnp.finfo(jnp.float32).min
+        feat = xa32[batch_id]                            # [R, C, H, W]
         # fixed max bin extents keep everything static-shaped: a bin spans at
         # most ceil(H/ph)+1 rows of the (clipped) box
         for ih in range(ph):
@@ -548,7 +549,6 @@ def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
                        & (hgrid < hend[:, None, None])
                        & (wgrid >= wstart[:, None, None])
                        & (wgrid < wend[:, None, None]))  # [R, H, W]
-                feat = xa32[batch_id]                    # [R, C, H, W]
                 masked = jnp.where(sel[:, None, :, :], feat, neg)
                 mx = jnp.max(masked, axis=(2, 3))        # [R, C]
                 empty = ~jnp.any(sel, axis=(1, 2))
@@ -591,6 +591,7 @@ def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
         bin_h = rh / ph
         bin_w = rw / pw
         outs = []
+        feat_all = xa32[batch_id]                        # [R, Cin, H, W]
         for ih in range(ph):
             hstart = jnp.clip(jnp.floor(y1 + ih * bin_h), 0, hh).astype(
                 jnp.int32)
@@ -609,7 +610,7 @@ def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
                        & (wgrid < wend[:, None, None]))
                 # channel group for this bin: [cout] channels at offset
                 chan = jnp.arange(cout) * ph * pw + ih * pw + iw_
-                feat = xa32[batch_id][:, chan]          # [R, cout, H, W]
+                feat = feat_all[:, chan]                # [R, cout, H, W]
                 ssum = jnp.sum(jnp.where(sel[:, None], feat, 0.0),
                                axis=(2, 3))
                 cnt = jnp.sum(sel, axis=(1, 2)).astype(jnp.float32)

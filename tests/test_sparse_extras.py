@@ -1,0 +1,155 @@
+"""Sparse breadth: unary/binary/addmm/mask_as + sparse.nn layers."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse as S
+
+RNG = np.random.default_rng(13)
+
+
+def _coo(dense):
+    return S.to_sparse_coo(paddle.to_tensor(dense))
+
+
+def test_unary_tail_ops():
+    d = np.array([[0.0, 0.5], [-0.25, 0.0]], np.float32)
+    x = _coo(d)
+    np.testing.assert_allclose(S.asin(x).to_dense().numpy(), np.arcsin(d),
+                               rtol=1e-6)
+    np.testing.assert_allclose(S.tan(x).to_dense().numpy(), np.tan(d),
+                               rtol=1e-6)
+    np.testing.assert_allclose(S.rad2deg(x).to_dense().numpy(),
+                               np.rad2deg(d), rtol=1e-6)
+    np.testing.assert_allclose(S.pow(x, 3).to_dense().numpy(), d ** 3,
+                               rtol=1e-6)
+    np.testing.assert_allclose(S.square(x).to_dense().numpy(), d ** 2,
+                               rtol=1e-6)
+    c = S.cast(x, index_dtype="int32", value_dtype="float64")
+    assert str(c.values.dtype).endswith("float32") or \
+        str(c.values.dtype).endswith("float64")  # x64 may be disabled
+
+
+def test_binary_union_ops():
+    a = np.array([[1.0, 0, 2], [0, 3, 0]], np.float32)
+    b = np.array([[0.5, 4, 0], [0, 1, 0]], np.float32)
+    x, y = _coo(a), _coo(b)
+    np.testing.assert_allclose(S.subtract(x, y).to_dense().numpy(), a - b,
+                               rtol=1e-6)
+    np.testing.assert_allclose(S.multiply(x, y).to_dense().numpy(), a * b,
+                               rtol=1e-6)
+    np.testing.assert_allclose(S.add(x, y).to_dense().numpy(), a + b,
+                               rtol=1e-6)
+
+
+def test_mv_addmm_mask_as():
+    a = np.array([[1.0, 0, 2], [0, 3, 0]], np.float32)
+    x = _coo(a)
+    v = np.array([1.0, 2.0, 3.0], np.float32)
+    np.testing.assert_allclose(S.mv(x, paddle.to_tensor(v)).numpy(), a @ v,
+                               rtol=1e-6)
+    inp = RNG.normal(size=(2, 4)).astype(np.float32)
+    y = RNG.normal(size=(3, 4)).astype(np.float32)
+    out = S.addmm(paddle.to_tensor(inp), x, paddle.to_tensor(y), beta=0.5,
+                  alpha=2.0)
+    np.testing.assert_allclose(out.numpy(), 0.5 * inp + 2.0 * (a @ y),
+                               rtol=1e-5)
+    dense = RNG.normal(size=(2, 3)).astype(np.float32)
+    m = S.mask_as(paddle.to_tensor(dense), x)
+    np.testing.assert_allclose(m.to_dense().numpy(), dense * (a != 0),
+                               rtol=1e-6)
+
+
+def test_sum_reshape_slice_transpose():
+    a = np.array([[1.0, 0, 2], [0, 3, 0]], np.float32)
+    x = _coo(a)
+    np.testing.assert_allclose(float(S.sum(x).numpy()), a.sum(), rtol=1e-6)
+    np.testing.assert_allclose(S.sum(x, axis=0).to_dense().numpy(),
+                               a.sum(0), rtol=1e-6)
+    r = S.reshape(x, [3, 2])
+    np.testing.assert_allclose(r.to_dense().numpy(), a.reshape(3, 2),
+                               rtol=1e-6)
+    t = S.transpose(x, [1, 0])
+    np.testing.assert_allclose(t.to_dense().numpy(), a.T, rtol=1e-6)
+    sl = S.slice(x, [1], [1], [3])
+    np.testing.assert_allclose(sl.to_dense().numpy(), a[:, 1:3], rtol=1e-6)
+
+
+def test_pca_lowrank_reconstructs():
+    a = RNG.normal(size=(6, 4)).astype(np.float32)
+    a[np.abs(a) < 0.3] = 0
+    u, s_, v = S.pca_lowrank(_coo(a), q=4, center=False)
+    rec = np.asarray(u.numpy()) @ np.diag(np.asarray(s_.numpy())) @ \
+        np.asarray(v.numpy()).T
+    np.testing.assert_allclose(rec, a, rtol=1e-3, atol=1e-4)
+
+
+def test_sparse_nn_activations_and_softmax():
+    import paddle_tpu.sparse.nn as snn
+    d = np.array([[0.0, -1.5], [2.0, 0.0]], np.float32)
+    x = _coo(d)
+    out = snn.ReLU()(x)
+    np.testing.assert_allclose(out.to_dense().numpy(), np.maximum(d, 0))
+    lr = snn.LeakyReLU(0.1)(x)
+    np.testing.assert_allclose(lr.to_dense().numpy(),
+                               np.where(d >= 0, d, 0.1 * d), rtol=1e-6)
+    csr = S.to_sparse_csr(paddle.to_tensor(
+        np.array([[1.0, 2.0, 0], [0, 0.5, 0.5]], np.float32)))
+    sm = snn.Softmax()(csr)
+    vals = np.asarray(sm.values.numpy())
+    # row 0: softmax over [1, 2]; row 1: softmax over [0.5, 0.5]
+    e = np.exp([1.0, 2.0])
+    np.testing.assert_allclose(vals[:2], e / e.sum(), rtol=1e-5)
+    np.testing.assert_allclose(vals[2:], [0.5, 0.5], rtol=1e-5)
+
+
+def test_sparse_subm_conv3d_keeps_pattern():
+    import paddle_tpu.sparse.nn as snn
+    paddle.seed(0)
+    dense = np.zeros((1, 4, 4, 4, 2), np.float32)  # NDHWC
+    dense[0, 1, 2, 3] = [1.0, -1.0]
+    dense[0, 0, 0, 0] = [0.5, 2.0]
+    x = S.to_sparse_coo(paddle.to_tensor(dense), sparse_dim=4)
+    conv = snn.SubmConv3D(2, 3, kernel_size=3, padding=1)
+    out = conv(x)
+    assert out.nnz() == x.nnz()
+    np.testing.assert_array_equal(np.asarray(out.indices.numpy()),
+                                  np.asarray(x.indices.numpy()))
+    assert out.to_dense().numpy().shape == (1, 4, 4, 4, 3)
+
+
+def test_sparse_conv2d_and_batchnorm_train():
+    import paddle_tpu.sparse.nn as snn
+    paddle.seed(1)
+    dense = np.zeros((1, 6, 6, 2), np.float32)  # NHWC
+    dense[0, 2, 3] = [1.0, 2.0]
+    dense[0, 4, 1] = [-1.0, 0.5]
+    x = S.to_sparse_coo(paddle.to_tensor(dense), sparse_dim=3)
+    conv = snn.Conv2D(2, 4, kernel_size=3, padding=1)
+    bn = snn.BatchNorm(4)
+    out = bn(conv(x))
+    assert out.shape[-1] == 4
+    loss = paddle.sum(S.square(out).values)
+    loss.backward()
+    assert conv.weight.grad is not None
+    assert np.isfinite(conv.weight.grad.numpy()).all()
+
+
+def test_hybrid_coo_reshape_and_sum():
+    dense = RNG.normal(size=(2, 3, 4)).astype(np.float32)
+    dense[np.abs(dense) < 0.6] = 0
+    x = S.to_sparse_coo(paddle.to_tensor(dense), sparse_dim=2)
+    # dense-tail axis sum
+    s_tail = S.sum(x, axis=-1)
+    np.testing.assert_allclose(s_tail.to_dense().numpy(), dense.sum(-1),
+                               rtol=1e-5, atol=1e-6)
+    # sparse-axis sum still right
+    s0 = S.sum(x, axis=0)
+    np.testing.assert_allclose(s0.to_dense().numpy(), dense.sum(0),
+                               rtol=1e-5, atol=1e-6)
+    # reshape over sparse dims keeps the dense tail
+    r = S.reshape(x, [3, 2, 4])
+    np.testing.assert_allclose(r.to_dense().numpy(), dense.reshape(3, 2, 4),
+                               rtol=1e-6)
+    with pytest.raises(ValueError, match="dense tail"):
+        S.reshape(x, [4, 3, 2])
