@@ -1,0 +1,372 @@
+"""Fused incubate functionals.
+
+Reference parity: python/paddle/incubate/nn/functional/ —
+fused_rotary_position_embedding.py:27, fused_rms_norm.py:59 (+fused_layer_norm
+:44), fused_dropout_add.py:37, fused_matmul_bias.py:31/:95/:136,
+fused_bias_act.py:26, swiglu.py:26, variable_length_memory_efficient_attention.
+
+TPU-native: these lower to jnp expressions XLA fuses into one kernel; the
+fused_rms_norm forward additionally routes through the Pallas kernel when
+FLAGS_use_pallas_fused is on and the norm is over the last axis with no norm
+bias (kernels/fused_pallas.py), mirroring how the reference routes to its
+CUDA fusion kernels.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ....framework.random import next_key
+from ....ops.dispatch import dispatch, ensure_tensor
+from ....tensor import Tensor
+
+__all__ = ["fused_rotary_position_embedding", "fused_layer_norm",
+           "fused_rms_norm", "fused_dropout_add", "fused_matmul_bias",
+           "fused_linear", "fused_linear_activation", "fused_bias_act",
+           "swiglu", "variable_length_memory_efficient_attention"]
+
+
+def _rope_rotate(x, cos, sin, neox):
+    """neox (rotate_half): pair (x1, x2) = split at dim/2; else interleaved
+    (rotate_every_two) — fused_rope_utils.h:191/:306."""
+    if neox:
+        d = x.shape[-1] // 2
+        x1, x2 = x[..., :d], x[..., d:]
+        rot = jnp.concatenate([-x2, x1], axis=-1)
+        return x * cos + rot * sin
+    x1 = x[..., 0::2]
+    x2 = x[..., 1::2]
+    c = cos[..., 0::2]
+    s = sin[..., 0::2]
+    ro1 = x1 * c - x2 * s
+    ro2 = x2 * c + x1 * s
+    return jnp.stack([ro1, ro2], axis=-1).reshape(x.shape)
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None,
+                                    use_neox_rotary_style=True,
+                                    time_major=False,
+                                    rotary_emb_base=10000.0):
+    """Apply RoPE to each of q/k/v that is not None. Layout
+    [batch, seq, heads, head_dim] ([seq, batch, ...] when time_major)."""
+    tensors = [ensure_tensor(t) for t in (q, k, v) if t is not None]
+    present = [t is not None for t in (q, k, v)]
+    seq_axis = 0 if time_major else 1
+    head_dim = int(tensors[0].shape[-1])
+    seq_len = int(tensors[0].shape[seq_axis])
+
+    pid = (ensure_tensor(position_ids)._data.astype(jnp.int32)
+           if position_ids is not None else None)       # [B, S]
+    if sin is None or cos is None:
+        # build a table long enough for every referenced position
+        table_len = seq_len
+        if pid is not None:
+            if isinstance(pid, jax.core.Tracer):
+                raise ValueError(
+                    "fused_rotary_position_embedding inside a trace needs an "
+                    "explicit sin/cos cache when position_ids is used (the "
+                    "required table length is data-dependent)")
+            table_len = max(seq_len, int(jnp.max(pid)) + 1)
+        inv = 1.0 / (rotary_emb_base
+                     ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32)
+                        / head_dim))
+        t = jnp.arange(table_len, dtype=jnp.float32)
+        freqs = jnp.outer(t, inv)                       # [len, hd/2]
+        emb = jnp.repeat(freqs, 2, axis=-1) if not use_neox_rotary_style \
+            else jnp.concatenate([freqs, freqs], axis=-1)
+        cos_a = jnp.cos(emb)
+        sin_a = jnp.sin(emb)
+    else:
+        # cache may be longer than the current input (decode with a
+        # precomputed table): keep its full length
+        cos_a = ensure_tensor(cos)._data.reshape(-1, head_dim)
+        sin_a = ensure_tensor(sin)._data.reshape(-1, head_dim)
+
+    if pid is not None:
+        cos_a = cos_a[pid]                              # [B, S, hd]
+        sin_a = sin_a[pid]
+        exp = (lambda a: a[:, :, None, :]) if not time_major else \
+            (lambda a: jnp.swapaxes(a, 0, 1)[:, :, None, :])
+    else:
+        cos_a = cos_a[:seq_len]
+        sin_a = sin_a[:seq_len]
+        if time_major:
+            exp = lambda a: a[:, None, None, :]
+        else:
+            exp = lambda a: a[None, :, None, :]
+    cos_b = exp(cos_a)
+    sin_b = exp(sin_a)
+
+    def fwd(*arrs):
+        outs = []
+        for a in arrs:
+            c = cos_b.astype(jnp.float32)
+            s = sin_b.astype(jnp.float32)
+            outs.append(_rope_rotate(a.astype(jnp.float32), c, s,
+                                     use_neox_rotary_style).astype(a.dtype))
+        return tuple(outs) if len(outs) > 1 else outs[0]
+
+    out = dispatch("fused_rope", fwd, *tensors)
+    out = list(out) if isinstance(out, (tuple, list)) else [out]
+    results = []
+    for p in present:
+        results.append(out.pop(0) if p else None)
+    return tuple(results)
+
+
+def fused_rms_norm(x, norm_weight, norm_bias, epsilon, begin_norm_axis,
+                   bias=None, residual=None, quant_scale=-1,
+                   quant_round_type=0, quant_max_bound=0, quant_min_bound=0):
+    """out = rms_norm(x + bias + residual) * w (+ b). Returns (out,
+    residual_out) — residual_out is the pre-norm sum (fused_rms_norm.py:59).
+    With FLAGS_use_pallas_fused on TPU, the forward runs the Pallas kernel."""
+    xt = ensure_tensor(x)
+    wt = ensure_tensor(norm_weight)
+    args = [xt, wt]
+    has_nb = norm_bias is not None
+    has_b = bias is not None
+    has_r = residual is not None
+    for t, h in ((norm_bias, has_nb), (bias, has_b), (residual, has_r)):
+        if h:
+            args.append(ensure_tensor(t))
+
+    def fwd(xa, wa, *rest):
+        rest = list(rest)
+        nb = rest.pop(0) if has_nb else None
+        b = rest.pop(0) if has_b else None
+        r = rest.pop(0) if has_r else None
+
+        def oracle(pre_):
+            axes = tuple(range(begin_norm_axis, pre_.ndim))
+            ms = jnp.mean(pre_ * pre_, axis=axes, keepdims=True)
+            o = pre_ * jax.lax.rsqrt(ms + epsilon) * wa.astype(jnp.float32)
+            if nb is not None:
+                o = o + nb.astype(jnp.float32)
+            return o
+
+        pre = xa.astype(jnp.float32)
+        if b is not None:
+            pre = pre + b.astype(jnp.float32)
+        if r is not None:
+            pre = pre + r.astype(jnp.float32)
+        from ....kernels import fused_pallas as fp
+        last_axis_only = begin_norm_axis == xa.ndim - 1
+        if fp.enabled() and last_axis_only and nb is None:
+            # Pallas single-HBM-pass forward; backward is AD of the oracle
+            # (same pattern as models/llama.py fused_rope)
+            prim = lambda p_: fp.fused_rms_norm_pallas(
+                p_.astype(xa.dtype), wa, eps=epsilon).astype(jnp.float32)
+            f = jax.custom_vjp(prim)
+            f.defvjp(lambda p_: (prim(p_), p_),
+                     lambda res, g: jax.vjp(oracle, res)[1](g))
+            out = f(pre)
+        else:
+            out = oracle(pre)
+        return out.astype(xa.dtype), pre.astype(xa.dtype)
+
+    out, residual_out = dispatch("fused_rms_norm", fwd, *args)
+    return out, residual_out
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon, begin_norm_axis,
+                     bias=None, residual=None, quant_scale=-1,
+                     quant_round_type=0, quant_max_bound=0,
+                     quant_min_bound=0):
+    """LayerNorm variant of fused_rms_norm (mean-centered)."""
+    xt = ensure_tensor(x)
+    has_w = norm_weight is not None
+    has_nb = norm_bias is not None
+    has_b = bias is not None
+    has_r = residual is not None
+    args = [xt]
+    for t, h in ((norm_weight, has_w), (norm_bias, has_nb), (bias, has_b),
+                 (residual, has_r)):
+        if h:
+            args.append(ensure_tensor(t))
+
+    def fwd(xa, *rest):
+        rest = list(rest)
+        wa = rest.pop(0) if has_w else None
+        nb = rest.pop(0) if has_nb else None
+        b = rest.pop(0) if has_b else None
+        r = rest.pop(0) if has_r else None
+        pre = xa.astype(jnp.float32)
+        if b is not None:
+            pre = pre + b.astype(jnp.float32)
+        if r is not None:
+            pre = pre + r.astype(jnp.float32)
+        axes = tuple(range(begin_norm_axis, pre.ndim))
+        mu = jnp.mean(pre, axis=axes, keepdims=True)
+        var = jnp.mean((pre - mu) ** 2, axis=axes, keepdims=True)
+        out = (pre - mu) * jax.lax.rsqrt(var + epsilon)
+        if wa is not None:
+            out = out * wa.astype(jnp.float32)
+        if nb is not None:
+            out = out + nb.astype(jnp.float32)
+        return out.astype(xa.dtype), pre.astype(xa.dtype)
+
+    out, residual_out = dispatch("fused_layer_norm", fwd, *args)
+    return out, residual_out
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      name=None):
+    """dropout(x) + y in one pass (fused_dropout_add.py:37)."""
+    xt, yt = ensure_tensor(x), ensure_tensor(y)
+    p = float(p)
+    key = next_key() if (training and p > 0.0) else None
+
+    def fwd(a, b):
+        if not training or p == 0.0:
+            out = a if mode != "downscale_in_infer" or training else a * (1 - p)
+            return (out + b).astype(a.dtype)
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        scaled = jnp.where(keep, a, 0.0)
+        if mode == "upscale_in_train":
+            scaled = scaled / (1.0 - p)
+        return (scaled + b).astype(a.dtype)
+
+    return dispatch("fused_dropout_add", fwd, xt, yt)
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
+                      name=None):
+    """matmul + bias epilogue (fused_matmul_bias.py:31; the reference fuses
+    via cublasLt — XLA fuses the add into the GEMM on TPU)."""
+    xt, yt = ensure_tensor(x), ensure_tensor(y)
+    args = [xt, yt]
+    has_b = bias is not None
+    if has_b:
+        args.append(ensure_tensor(bias))
+
+    def fwd(a, b, *rest):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2)
+        out = a @ b
+        if rest:
+            out = out + rest[0]
+        return out
+
+    return dispatch("fused_matmul_bias", fwd, *args)
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    """fused_matmul_bias.py:95."""
+    return fused_matmul_bias(x, weight, bias, False, transpose_weight)
+
+
+def fused_linear_activation(x, y, bias, trans_x=False, trans_y=False,
+                            activation="gelu"):
+    """GEMM + bias + activation epilogue (fused_matmul_bias.py:136)."""
+    out = fused_matmul_bias(x, y, bias, trans_x, trans_y)
+    act = {"gelu": lambda a: jax.nn.gelu(a, approximate=False),
+           "relu": jax.nn.relu,
+           "none": lambda a: a}[activation]
+    return dispatch("fused_act", act, out)
+
+
+_ACTS = {
+    "gelu": lambda a: jax.nn.gelu(a, approximate=False),
+    "relu": jax.nn.relu,
+    "silu": jax.nn.silu,
+    "swish": jax.nn.silu,
+    "geglu": None,   # gated variants handled below
+    "swiglu": None,
+}
+
+
+def fused_bias_act(x, bias=None, dequant_scales=None, shift=None, smooth=None,
+                   act_method="gelu", compute_dtype="default", quant_scale=-1,
+                   quant_round_type=0, quant_max_bound=0, quant_min_bound=0,
+                   name=None):
+    """act(x + bias), incl. the gated geglu/swiglu forms
+    (fused_bias_act.py:26)."""
+    xt = ensure_tensor(x)
+    args = [xt]
+    has_b = bias is not None
+    if has_b:
+        args.append(ensure_tensor(bias))
+    m = act_method.lower()
+
+    def fwd(a, *rest):
+        z = a.astype(jnp.float32)
+        if rest:
+            z = z + rest[0].astype(jnp.float32)
+        if m in ("geglu", "swiglu"):
+            d = z.shape[-1] // 2
+            gate, val = z[..., :d], z[..., d:]
+            g = (jax.nn.gelu(gate, approximate=False) if m == "geglu"
+                 else jax.nn.silu(gate))
+            return (g * val).astype(a.dtype)
+        return _ACTS[m](z).astype(a.dtype)
+
+    return dispatch("fused_bias_act", fwd, *args)
+
+
+def swiglu(x, y=None, name=None):
+    """silu(x) * y; y=None splits x in half (swiglu.py:26)."""
+    xt = ensure_tensor(x)
+    if y is None:
+        def fwd(a):
+            a1, a2 = jnp.split(a, 2, axis=-1)
+            return jax.nn.silu(a1.astype(jnp.float32)) \
+                * a2.astype(jnp.float32)
+        return dispatch("swiglu", lambda a: fwd(a).astype(a.dtype), xt)
+    yt = ensure_tensor(y)
+    return dispatch(
+        "swiglu",
+        lambda a, b: (jax.nn.silu(a.astype(jnp.float32))
+                      * b.astype(jnp.float32)).astype(a.dtype), xt, yt)
+
+
+def variable_length_memory_efficient_attention(query, key, value, seq_lens,
+                                               kv_seq_lens, mask=None,
+                                               scale=None, causal=False,
+                                               pre_cache_length=0):
+    """Variable-length attention with per-sequence lengths (parity:
+    variable_length_memory_efficient_attention.py; CUTLASS kernel in the
+    reference). Layout [B, num_heads, seq, head_dim]; lengths mask out the
+    padded tails. Lowers to one masked SDPA XLA fuses; flash/ring kernels
+    cover the long-context path elsewhere."""
+    qt, kt, vt = ensure_tensor(query), ensure_tensor(key), ensure_tensor(value)
+    sl, kl = ensure_tensor(seq_lens), ensure_tensor(kv_seq_lens)
+    args = [qt, kt, vt, sl, kl]
+    has_m = mask is not None
+    if has_m:
+        args.append(ensure_tensor(mask))
+
+    def fwd(q, k, v, slen, klen, *rest):
+        b, h, sq, d = q.shape
+        sk = k.shape[2]
+        s = scale if scale is not None else 1.0 / math.sqrt(d)
+        if k.shape[1] != h:  # GQA: repeat kv heads
+            k = jnp.repeat(k, h // k.shape[1], axis=1)
+            v = jnp.repeat(v, h // v.shape[1], axis=1)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                            k.astype(jnp.float32)) * s
+        qpos = jnp.arange(sq)
+        kpos = jnp.arange(sk)
+        valid = (qpos[None, :, None] < slen.reshape(-1, 1, 1)) & \
+            (kpos[None, None, :] < klen.reshape(-1, 1, 1))
+        if causal:
+            # per-sample end alignment: query row i attends keys up to
+            # klen - slen + i (covers decode sq < sk and the pre-cache
+            # prefix, which lives at the front of k)
+            off = (klen.reshape(-1, 1, 1) - slen.reshape(-1, 1, 1))
+            valid = valid & (kpos[None, None, :]
+                             <= qpos[None, :, None] + off)
+        scores = jnp.where(valid[:, None, :, :], scores, -1e30)
+        if rest:
+            scores = scores + rest[0].astype(jnp.float32)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bhqk,bhkd->bhqd", probs, v.astype(jnp.float32))
+        # zero out padded query rows
+        qvalid = qpos[None, None, :, None] < slen.reshape(-1, 1, 1, 1)
+        return jnp.where(qvalid, out, 0.0).astype(q.dtype)
+
+    return dispatch("varlen_mem_efficient_attention", fwd, *args)

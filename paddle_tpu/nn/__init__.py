@@ -11,6 +11,7 @@ from .layer.activation import (  # noqa: F401
     Tanhshrink, ThresholdedReLU,
 )
 from .layer.common import (  # noqa: F401
+    Bilinear,
     AlphaDropout, ChannelShuffle, CosineSimilarity, Dropout, Dropout2D,
     Dropout3D, Embedding, Flatten, Identity, Linear, Pad1D, Pad2D, Pad3D,
     PixelShuffle, PixelUnshuffle, Unflatten, Upsample, UpsamplingBilinear2D,

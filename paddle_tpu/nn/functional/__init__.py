@@ -13,6 +13,7 @@ from .vision import (  # noqa: F401
     affine_grid, grid_sample, temporal_shift,
 )
 from .common import (  # noqa: F401
+    bilinear,
     alpha_dropout, channel_shuffle, cosine_similarity, dropout, dropout2d,
     dropout3d, embedding, fold, interpolate, label_smooth, linear, one_hot, pad,
     pixel_shuffle, pixel_unshuffle, unfold, upsample, zeropad2d,

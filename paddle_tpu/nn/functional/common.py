@@ -307,3 +307,22 @@ def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
             return (1 - epsilon) * l + epsilon * pd
         return (1 - epsilon) * l + epsilon / k
     return dispatch("label_smooth", fwd, ensure_tensor(label))
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    """Bilinear map out[n, o] = x1[n, :] @ W[o] @ x2[n, :] (+ b)
+    (parity: paddle.nn.functional.bilinear / bilinear kernel)."""
+    x1t, x2t, wt = ensure_tensor(x1), ensure_tensor(x2), ensure_tensor(weight)
+    args = [x1t, x2t, wt]
+    has_b = bias is not None
+    if has_b:
+        args.append(ensure_tensor(bias))
+
+    def fwd(a, b, w, *rest):
+        out = jnp.einsum("ni,oij,nj->no", a.astype(jnp.float32),
+                         w.astype(jnp.float32), b.astype(jnp.float32))
+        if rest:
+            out = out + rest[0].astype(jnp.float32)
+        return out.astype(a.dtype)
+
+    return dispatch("bilinear", fwd, *args)

@@ -92,3 +92,53 @@ class ViterbiDecoder:
     def __call__(self, potentials, lengths):
         return viterbi_decode(potentials, self.transitions, lengths,
                               self.include_bos_eos_tag)
+
+
+def crf_decoding(potentials, transition_params, lengths,
+                 include_bos_eos_tag=True, name=None):
+    """Legacy alias of viterbi_decode (parity: crf_decoding op)."""
+    return viterbi_decode(potentials, transition_params, lengths,
+                          include_bos_eos_tag, name)
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None, name=None):
+    """Levenshtein distance between id sequences (parity: edit_distance op).
+    input/label: [B, L] padded int tensors; returns (distance [B, 1],
+    sequence_num [1]). Host-side eager DP (data-dependent trip counts)."""
+    import numpy as np
+
+    from ..ops.dispatch import ensure_tensor
+    import jax.numpy as jnp
+
+    a = np.asarray(ensure_tensor(input).numpy())
+    b = np.asarray(ensure_tensor(label).numpy())
+    il = (np.asarray(ensure_tensor(input_length).numpy()).reshape(-1)
+          if input_length is not None else
+          np.full(a.shape[0], a.shape[1], np.int64))
+    ll = (np.asarray(ensure_tensor(label_length).numpy()).reshape(-1)
+          if label_length is not None else
+          np.full(b.shape[0], b.shape[1], np.int64))
+    ignored = set(ignored_tokens or [])
+    out = np.zeros((a.shape[0], 1), np.float32)
+    for i in range(a.shape[0]):
+        s = [t for t in a[i, :il[i]].tolist() if t not in ignored]
+        t = [u for u in b[i, :ll[i]].tolist() if u not in ignored]
+        m, n = len(s), len(t)
+        dp = np.arange(n + 1, dtype=np.float32)
+        for x in range(1, m + 1):
+            prev = dp.copy()
+            dp[0] = x
+            for y in range(1, n + 1):
+                dp[y] = min(prev[y] + 1, dp[y - 1] + 1,
+                            prev[y - 1] + (s[x - 1] != t[y - 1]))
+        d = dp[n]
+        if normalized:
+            d = d / max(n, 1)
+        out[i, 0] = d
+    from ..tensor import Tensor
+    return (Tensor(jnp.asarray(out)),
+            Tensor(jnp.asarray(np.asarray([a.shape[0]], np.int64))))
+
+
+__all__ += ["crf_decoding", "edit_distance"]

@@ -625,3 +625,71 @@ def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
 
 __all__ += ["prior_box", "matrix_nms", "deform_conv2d", "roi_pool",
             "psroi_pool"]
+
+
+def box_clip(input, im_info, name=None):
+    """Clip boxes to image boundaries (parity: box_clip kernel). input:
+    [N, 4] or [B, N, 4]; im_info: [B, 3] (h, w, scale) — boxes clipped to
+    [0, w/scale - 1] x [0, h/scale - 1]."""
+    it, mt = ensure_tensor(input), ensure_tensor(im_info)
+
+    if len(it.shape) == 2 and int(mt.shape[0]) != 1:
+        raise ValueError(
+            "box_clip with 2-D boxes needs a single-image im_info (there is "
+            "no per-box image mapping); pass boxes as [B, N, 4] for batches")
+
+    def fwd(b, info):
+        h = info[:, 0] / info[:, 2] - 1.0
+        w = info[:, 1] / info[:, 2] - 1.0
+        if b.ndim == 2:
+            h0, w0 = h[0], w[0]
+            return jnp.stack([
+                jnp.clip(b[:, 0], 0, w0), jnp.clip(b[:, 1], 0, h0),
+                jnp.clip(b[:, 2], 0, w0), jnp.clip(b[:, 3], 0, h0)], axis=1)
+        hh = h[:, None]
+        ww = w[:, None]
+        return jnp.stack([
+            jnp.clip(b[..., 0], 0, ww), jnp.clip(b[..., 1], 0, hh),
+            jnp.clip(b[..., 2], 0, ww), jnp.clip(b[..., 3], 0, hh)], axis=-1)
+
+    return dispatch("box_clip", fwd, it, mt)
+
+
+def bipartite_match(dist_matrix, match_type="bipartite", dist_threshold=None,
+                    name=None):
+    """Greedy bipartite matching (parity: bipartite_match kernel): columns
+    are matched to rows in order of decreasing distance; with
+    match_type='per_prediction', unmatched columns are matched to their
+    argmax row when dist >= threshold. Host-side eager (sequential greedy)."""
+    import numpy as np
+
+    d = np.asarray(ensure_tensor(dist_matrix).numpy(), np.float64).copy()
+    rows, cols = d.shape
+    match_idx = np.full(cols, -1, np.int64)
+    match_dist = np.zeros(cols, np.float32)
+    row_used = np.zeros(rows, bool)
+    work = d.copy()
+    while True:
+        r, c = np.unravel_index(np.argmax(work), work.shape)
+        if work[r, c] <= 0:
+            break
+        match_idx[c] = r
+        match_dist[c] = d[r, c]
+        work[r, :] = -1
+        work[:, c] = -1
+        row_used[r] = True
+        if row_used.all():
+            break
+    if match_type == "per_prediction":
+        thr = dist_threshold if dist_threshold is not None else 0.5
+        for c in range(cols):
+            if match_idx[c] == -1:
+                r = int(np.argmax(d[:, c]))
+                if d[r, c] >= thr:
+                    match_idx[c] = r
+                    match_dist[c] = d[r, c]
+    return (Tensor(jnp.asarray(match_idx[None, :])),
+            Tensor(jnp.asarray(match_dist[None, :].astype(np.float32))))
+
+
+__all__ += ["box_clip", "bipartite_match"]

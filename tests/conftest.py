@@ -47,8 +47,10 @@ if not os.environ.get("PADDLE_TPU_NO_XLA_CACHE"):
         try:
             os.kill(pid, 0)
             _live = True
-        except OSError:
+        except ProcessLookupError:
             _dead.append(mp)
+        except PermissionError:
+            _live = True  # alive, owned by another user
     if _dead and not _live:
         shutil.rmtree(_cache_dir, ignore_errors=True)
         os.makedirs(_cache_dir, exist_ok=True)

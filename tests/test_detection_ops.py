@@ -236,3 +236,23 @@ def test_psroi_pool_shapes_and_mean():
     exp = x[0, 0, 0:3, 0:3].mean()
     np.testing.assert_allclose(np.asarray(out.numpy())[0, 0, 0, 0], exp,
                                rtol=1e-5)
+
+
+def test_box_clip():
+    boxes = np.array([[-5.0, 2.0, 30.0, 40.0]], np.float32)
+    im_info = np.array([[20.0, 25.0, 1.0]], np.float32)  # h=20, w=25
+    out = V.box_clip(paddle.to_tensor(boxes), paddle.to_tensor(im_info))
+    np.testing.assert_allclose(out.numpy(), [[0.0, 2.0, 24.0, 19.0]],
+                               rtol=1e-6)
+
+
+def test_bipartite_match():
+    # 2 gt rows x 3 prediction cols
+    dist = np.array([[0.9, 0.2, 0.5], [0.1, 0.8, 0.6]], np.float32)
+    idx, d = V.bipartite_match(paddle.to_tensor(dist))
+    # greedy: col0->row0 (0.9), col1->row1 (0.8); col2 unmatched
+    assert idx.numpy().reshape(-1).tolist() == [0, 1, -1]
+    idx2, d2 = V.bipartite_match(paddle.to_tensor(dist),
+                                 match_type="per_prediction",
+                                 dist_threshold=0.5)
+    assert idx2.numpy().reshape(-1).tolist() == [0, 1, 1]  # col2 -> row1, 0.6

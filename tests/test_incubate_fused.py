@@ -1,0 +1,210 @@
+"""incubate.nn.functional fused ops vs plain compositions / torch."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.incubate.nn.functional as FF
+import paddle_tpu.nn.functional as F
+
+RNG = np.random.default_rng(17)
+
+
+def test_swiglu_both_forms():
+    x = RNG.normal(size=(3, 8)).astype(np.float32)
+    y = RNG.normal(size=(3, 8)).astype(np.float32)
+    out2 = FF.swiglu(paddle.to_tensor(x), paddle.to_tensor(y))
+    sil = x / (1 + np.exp(-x))
+    np.testing.assert_allclose(out2.numpy(), sil * y, rtol=1e-5)
+    out1 = FF.swiglu(paddle.to_tensor(np.concatenate([x, y], -1)))
+    np.testing.assert_allclose(out1.numpy(), sil * y, rtol=1e-5)
+
+
+def test_fused_rms_and_layer_norm_with_residual():
+    x = RNG.normal(size=(2, 5, 8)).astype(np.float32)
+    r = RNG.normal(size=(2, 5, 8)).astype(np.float32)
+    b = RNG.normal(size=(8,)).astype(np.float32)
+    w = RNG.normal(size=(8,)).astype(np.float32) + 1.0
+    out, res = FF.fused_rms_norm(paddle.to_tensor(x), paddle.to_tensor(w),
+                                 None, 1e-6, 2, bias=paddle.to_tensor(b),
+                                 residual=paddle.to_tensor(r))
+    pre = x + b + r
+    ms = (pre ** 2).mean(-1, keepdims=True)
+    expect = pre / np.sqrt(ms + 1e-6) * w
+    np.testing.assert_allclose(out.numpy(), expect, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(res.numpy(), pre, rtol=1e-5)
+
+    nb = RNG.normal(size=(8,)).astype(np.float32)
+    out2, _ = FF.fused_layer_norm(paddle.to_tensor(x), paddle.to_tensor(w),
+                                  paddle.to_tensor(nb), 1e-5, 2)
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    expect2 = (x - mu) / np.sqrt(var + 1e-5) * w + nb
+    np.testing.assert_allclose(out2.numpy(), expect2, rtol=1e-4, atol=1e-5)
+
+
+def test_fused_rope_matches_llama_apply_rope():
+    from paddle_tpu.models.llama import apply_rope, build_rope_cache
+    b, s, h, d = 2, 6, 4, 8
+    q = RNG.normal(size=(b, s, h, d)).astype(np.float32)
+    k = RNG.normal(size=(b, s, h, d)).astype(np.float32)
+    qo, ko, vo = FF.fused_rotary_position_embedding(
+        paddle.to_tensor(q), paddle.to_tensor(k), None,
+        use_neox_rotary_style=False)
+    assert vo is None
+    import jax.numpy as jnp
+    cos, sin = build_rope_cache(s, d)
+    rq, rk = apply_rope(jnp.asarray(q), jnp.asarray(k), cos, sin)
+    np.testing.assert_allclose(np.asarray(qo.numpy()), np.asarray(rq),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(ko.numpy()), np.asarray(rk),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_fused_rope_neox_and_position_ids():
+    b, s, h, d = 1, 4, 2, 6
+    q = RNG.normal(size=(b, s, h, d)).astype(np.float32)
+    pid = np.array([[3, 2, 1, 0]], np.int64)
+    qo, _, _ = FF.fused_rotary_position_embedding(
+        paddle.to_tensor(q), position_ids=paddle.to_tensor(pid),
+        use_neox_rotary_style=True)
+    # row i uses position pid[i]: compare against identity positions reversed
+    q_plain, _, _ = FF.fused_rotary_position_embedding(
+        paddle.to_tensor(q[:, ::-1]), use_neox_rotary_style=True)
+    np.testing.assert_allclose(np.asarray(qo.numpy())[:, ::-1],
+                               np.asarray(q_plain.numpy()), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_fused_dropout_add_modes():
+    x = RNG.normal(size=(64, 64)).astype(np.float32)
+    y = RNG.normal(size=(64, 64)).astype(np.float32)
+    out_eval = FF.fused_dropout_add(paddle.to_tensor(x), paddle.to_tensor(y),
+                                    p=0.3, training=False)
+    np.testing.assert_allclose(out_eval.numpy(), x + y, rtol=1e-6)
+    paddle.seed(7)
+    out_tr = np.asarray(FF.fused_dropout_add(
+        paddle.to_tensor(x), paddle.to_tensor(y), p=0.5).numpy())
+    diff = out_tr - y
+    zero_frac = (np.abs(diff) < 1e-9).mean()
+    assert 0.3 < zero_frac < 0.7  # ~half dropped
+    kept = np.abs(diff) > 1e-9
+    np.testing.assert_allclose(diff[kept], (x * 2.0)[kept], rtol=1e-3,
+                               atol=1e-5)
+
+
+def test_fused_matmul_bias_linear_activation():
+    x = RNG.normal(size=(4, 6)).astype(np.float32)
+    w = RNG.normal(size=(6, 3)).astype(np.float32)
+    b = RNG.normal(size=(3,)).astype(np.float32)
+    out = FF.fused_linear(paddle.to_tensor(x), paddle.to_tensor(w),
+                          paddle.to_tensor(b))
+    np.testing.assert_allclose(out.numpy(), x @ w + b, rtol=1e-5)
+    outt = FF.fused_matmul_bias(paddle.to_tensor(x), paddle.to_tensor(w.T),
+                                paddle.to_tensor(b), transpose_y=True)
+    np.testing.assert_allclose(outt.numpy(), x @ w + b, rtol=1e-5)
+    outa = FF.fused_linear_activation(paddle.to_tensor(x),
+                                      paddle.to_tensor(w),
+                                      paddle.to_tensor(b), activation="relu")
+    np.testing.assert_allclose(outa.numpy(), np.maximum(x @ w + b, 0),
+                               rtol=1e-5)
+
+
+def test_fused_bias_act_gated():
+    x = RNG.normal(size=(3, 10)).astype(np.float32)
+    b = RNG.normal(size=(10,)).astype(np.float32)
+    out = FF.fused_bias_act(paddle.to_tensor(x), paddle.to_tensor(b),
+                            act_method="swiglu")
+    z = x + b
+    gate, val = z[:, :5], z[:, 5:]
+    np.testing.assert_allclose(out.numpy(),
+                               gate / (1 + np.exp(-gate)) * val, rtol=1e-5)
+
+
+def test_varlen_attention_masks_padded_tails():
+    torch = pytest.importorskip("torch")
+    b, h, s, d = 2, 2, 6, 8
+    q = RNG.normal(size=(b, h, s, d)).astype(np.float32)
+    k = RNG.normal(size=(b, h, s, d)).astype(np.float32)
+    v = RNG.normal(size=(b, h, s, d)).astype(np.float32)
+    lens = np.array([6, 3], np.int32)
+    out = FF.variable_length_memory_efficient_attention(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+        paddle.to_tensor(lens), paddle.to_tensor(lens), causal=True)
+    o = np.asarray(out.numpy())
+    # sample 1 rows beyond len 3 are zero
+    assert np.abs(o[1, :, 3:, :]).max() == 0
+    # sample 0 (full length) matches torch causal sdpa
+    ref = torch.nn.functional.scaled_dot_product_attention(
+        torch.tensor(q[0:1]), torch.tensor(k[0:1]), torch.tensor(v[0:1]),
+        is_causal=True)
+    np.testing.assert_allclose(o[0:1], ref.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_fused_rope_long_cache_decode_step():
+    # decode: 1-token query against a 16-position precomputed cache
+    import jax.numpy as jnp
+    d = 8
+    cache_len = 16
+    inv = 1.0 / (10000.0 ** (np.arange(0, d, 2) / d))
+    freqs = np.outer(np.arange(cache_len), inv)
+    emb = np.concatenate([freqs, freqs], -1).astype(np.float32)
+    cos = np.cos(emb).reshape(1, cache_len, 1, d)
+    sin = np.sin(emb).reshape(1, cache_len, 1, d)
+    q = RNG.normal(size=(1, 1, 2, d)).astype(np.float32)
+    qo, _, _ = FF.fused_rotary_position_embedding(
+        paddle.to_tensor(q), sin=paddle.to_tensor(sin),
+        cos=paddle.to_tensor(cos),
+        position_ids=paddle.to_tensor(np.array([[7]], np.int64)))
+    # oracle: rotate_half at position 7
+    c7, s7 = np.cos(emb[7]), np.sin(emb[7])
+    x1, x2 = q[..., :d // 2], q[..., d // 2:]
+    rot = np.concatenate([-x2, x1], -1)
+    np.testing.assert_allclose(np.asarray(qo.numpy()), q * c7 + rot * s7,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_fused_rope_position_ids_beyond_seq_builds_table():
+    q = RNG.normal(size=(1, 2, 1, 8)).astype(np.float32)
+    pid = np.array([[40, 41]], np.int64)  # positions far beyond seq_len=2
+    qo, _, _ = FF.fused_rotary_position_embedding(
+        paddle.to_tensor(q), position_ids=paddle.to_tensor(pid))
+    assert np.isfinite(np.asarray(qo.numpy())).all()
+    # must differ from positions [0, 1]
+    q0, _, _ = FF.fused_rotary_position_embedding(paddle.to_tensor(q))
+    assert np.abs(np.asarray(qo.numpy()) - np.asarray(q0.numpy())).max() > 1e-3
+
+
+def test_varlen_attention_decode_causal_offset():
+    # sq=1 decode against sk=5 keys: the single query must see ALL keys up
+    # to klen, not just key 0
+    b, h, d = 1, 1, 4
+    q = RNG.normal(size=(b, h, 1, d)).astype(np.float32)
+    k = RNG.normal(size=(b, h, 5, d)).astype(np.float32)
+    v = RNG.normal(size=(b, h, 5, d)).astype(np.float32)
+    out = FF.variable_length_memory_efficient_attention(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+        paddle.to_tensor(np.array([1], np.int32)),
+        paddle.to_tensor(np.array([5], np.int32)), causal=True)
+    scores = (q[0, 0, 0] @ k[0, 0].T) / np.sqrt(d)
+    p = np.exp(scores - scores.max())
+    p /= p.sum()
+    np.testing.assert_allclose(np.asarray(out.numpy())[0, 0, 0], p @ v[0, 0],
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_fused_rms_norm_pallas_route_matches_oracle():
+    from paddle_tpu.framework import flags
+    x = RNG.normal(size=(2, 4, 16)).astype(np.float32)
+    w = (RNG.normal(size=(16,)) * 0.1 + 1).astype(np.float32)
+    base, _ = FF.fused_rms_norm(paddle.to_tensor(x), paddle.to_tensor(w),
+                                None, 1e-6, 2)
+    old = flags.flag("use_pallas_fused")
+    try:
+        flags.set_flags({"FLAGS_use_pallas_fused": True})
+        routed, _ = FF.fused_rms_norm(paddle.to_tensor(x),
+                                      paddle.to_tensor(w), None, 1e-6, 2)
+    finally:
+        flags.set_flags({"FLAGS_use_pallas_fused": old})
+    np.testing.assert_allclose(np.asarray(routed.numpy()),
+                               np.asarray(base.numpy()), rtol=1e-5,
+                               atol=1e-6)

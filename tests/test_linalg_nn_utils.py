@@ -115,3 +115,22 @@ def test_lu_unpack_flags():
     assert l_ is None and u is None and p is not None
     p2, l2, u2 = paddle.linalg.lu_unpack(lu, piv, unpack_pivots=False)
     assert p2 is None and l2 is not None and u2 is not None
+
+
+def test_bilinear_layer_and_functional():
+    torch = pytest.importorskip("torch")
+    import paddle_tpu.nn.functional as F
+    x1 = RNG.normal(size=(4, 3)).astype(np.float32)
+    x2 = RNG.normal(size=(4, 5)).astype(np.float32)
+    w = RNG.normal(size=(2, 3, 5)).astype(np.float32)
+    b = RNG.normal(size=(1, 2)).astype(np.float32)
+    out = F.bilinear(paddle.to_tensor(x1), paddle.to_tensor(x2),
+                     paddle.to_tensor(w), paddle.to_tensor(b))
+    ref = torch.nn.functional.bilinear(torch.tensor(x1), torch.tensor(x2),
+                                       torch.tensor(w),
+                                       torch.tensor(b.reshape(2)))
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-4,
+                               atol=1e-5)
+    layer = nn.Bilinear(3, 5, 2)
+    got = layer(paddle.to_tensor(x1), paddle.to_tensor(x2))
+    assert tuple(got.shape) == (4, 2)

@@ -277,3 +277,30 @@ def test_ctc_loss_empty_labels():
     expect = np.array([-lp[:4, 0, 0].sum(), -lp[:3, 1, 0].sum()])
     np.testing.assert_allclose(np.asarray(out.numpy()).reshape(-1), expect,
                                rtol=1e-5, atol=1e-5)
+
+
+def test_edit_distance():
+    a = paddle.to_tensor(np.array([[1, 2, 3, 4], [5, 6, 7, 0]], np.int64))
+    b = paddle.to_tensor(np.array([[1, 3, 3, 0], [5, 6, 7, 8]], np.int64))
+    d, n = paddle.text.edit_distance(
+        a, b, normalized=False,
+        input_length=paddle.to_tensor(np.array([4, 3], np.int64)),
+        label_length=paddle.to_tensor(np.array([3, 4], np.int64)))
+    # "1234" vs "133": sub 2->3, del 4 => 2 ; "567" vs "5678": ins => 1
+    assert np.asarray(d.numpy()).reshape(-1).tolist() == [2.0, 1.0]
+    assert int(n.numpy()[0]) == 2
+    dn, _ = paddle.text.edit_distance(a, b, normalized=True,
+        input_length=paddle.to_tensor(np.array([4, 3], np.int64)),
+        label_length=paddle.to_tensor(np.array([3, 4], np.int64)))
+    np.testing.assert_allclose(np.asarray(dn.numpy()).reshape(-1),
+                               [2 / 3, 1 / 4], rtol=1e-6)
+
+
+def test_crf_decoding_alias():
+    pot = paddle.to_tensor(RNG.normal(size=(1, 3, 4)).astype(np.float32))
+    trans = paddle.to_tensor(RNG.normal(size=(4, 4)).astype(np.float32))
+    lens = paddle.to_tensor(np.array([3], np.int64))
+    s1, p1 = paddle.text.viterbi_decode(pot, trans, lens)
+    s2, p2 = paddle.text.crf_decoding(pot, trans, lens)
+    np.testing.assert_allclose(s1.numpy(), s2.numpy())
+    np.testing.assert_array_equal(p1.numpy(), p2.numpy())
