@@ -109,6 +109,7 @@ from . import device  # noqa: F401
 from . import metric  # noqa: F401
 from . import text  # noqa: F401
 from . import geometric  # noqa: F401
+from . import audio  # noqa: F401
 from . import inference  # noqa: F401
 from . import fft  # noqa: F401
 from . import signal  # noqa: F401

@@ -1,0 +1,16 @@
+"""paddle.audio — audio feature extraction.
+
+Reference parity: python/paddle/audio/ (functional/functional.py:29-306
+hz_to_mel/mel_to_hz/mel_frequencies/fft_frequencies/compute_fbank_matrix/
+power_to_db/create_dct, functional/window.py get_window, features/layers.py
+Spectrogram/MelSpectrogram/LogMelSpectrogram/MFCC). TPU-native: everything
+composes paddle_tpu.signal.stft (XLA FFT HLO) with jnp filterbank matmuls —
+feature extraction runs inside jit with the model when desired.
+"""
+from . import functional  # noqa: F401
+from .features import (  # noqa: F401
+    LogMelSpectrogram, MelSpectrogram, MFCC, Spectrogram,
+)
+
+__all__ = ["functional", "features", "Spectrogram", "MelSpectrogram",
+           "LogMelSpectrogram", "MFCC"]

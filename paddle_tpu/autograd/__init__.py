@@ -98,3 +98,68 @@ class PyLayer:
                 o.stop_gradient = False
                 k += 1
         return outputs
+
+
+def jacobian(ys, xs, batch_axis=None):
+    """Parity: paddle.autograd.jacobian (autograd.py:461) — dense Jacobian of
+    computed tensors w.r.t. tape inputs, materialized via one retained
+    backward pass per output element. Shapes follow the reference:
+    [my, nx] flattened (batch_axis=None) or [B, my, nx] (batch_axis=0).
+    For function-transform Jacobians (and higher order), use
+    paddle.incubate.autograd.Jacobian."""
+    import jax.numpy as jnp
+
+    from .backward import grad as _grad
+    from ..tensor import Tensor
+
+    single_y = isinstance(ys, Tensor)
+    single_x = isinstance(xs, Tensor)
+    ys_l = [ys] if single_y else list(ys)
+    xs_l = [xs] if single_x else list(xs)
+
+    def one_pair(y, x):
+        y_flat = y._data.reshape(-1)
+        m = y_flat.shape[0]
+        rows = []
+        for i in range(m):
+            seed = jnp.zeros_like(y_flat).at[i].set(1.0).reshape(
+                y._data.shape)
+            g = _grad([y], [x], grad_outputs=[Tensor(seed)],
+                      retain_graph=True, allow_unused=True)[0]
+            rows.append(jnp.zeros(x._data.shape, jnp.float32).reshape(-1)
+                        if g is None else
+                        g._data.astype(jnp.float32).reshape(-1))
+        jac = jnp.stack(rows)                      # [my, nx]
+        if batch_axis is None:
+            return Tensor(jac)
+        if batch_axis != 0:
+            raise ValueError("batch_axis must be None or 0")
+        b = y._data.shape[0]
+        my = m // b
+        if x._data.shape[0] != b:
+            raise ValueError(
+                f"batch_axis=0 needs matching leading dims, got ys batch {b} "
+                f"vs xs batch {x._data.shape[0]}")
+        # batched: per-sample block-diagonal slices [B, my, nx_per]
+        jac_b = jac.reshape(b, my, *x._data.shape)
+        per = jac_b.reshape(b, my, b, -1)
+        idx = jnp.arange(b)
+        return Tensor(per[idx, :, idx, :])
+
+    out = [[one_pair(y, x) for x in xs_l] for y in ys_l]
+    if single_y and single_x:
+        return out[0][0]
+    if single_y:
+        return tuple(out[0])
+    if single_x:
+        return tuple(r[0] for r in out)
+    return tuple(tuple(r) for r in out)
+
+
+def hessian(ys, xs, batch_axis=None):
+    """The eager tape cannot replay a second backward (no create_graph);
+    Hessians are provided by the function-transform API."""
+    raise NotImplementedError(
+        "tape-based hessian needs double backward; use "
+        "paddle.incubate.autograd.Hessian(func, xs) (jax.hessian under the "
+        "hood) instead")

@@ -281,14 +281,25 @@ class PipelineParallel(MetaParallelBase):
             from ...distributed import get_mesh
             inner = getattr(optimizer, "_inner_opt", optimizer)
             mesh = get_mesh()
-            key = (id(inner), id(mesh), max(self.accumulate_steps, 1))
+            # schedule selection (parity: the reference picks 1F1B vs
+            # interleave via pp config / virtual stages)
+            cfg = (self._strategy.pipeline_configs
+                   if self._strategy else {}) or {}
+            sched = str(cfg.get("schedule_mode", "circular")).lower()
+            sched = {"f-then-b": "circular", "fthenb": "circular",
+                     "1f1b": "1f1b", "vpp": "vpp",
+                     "interleave": "interleave"}.get(sched, sched)
+            vpp = int(cfg.get("vpp_degree", 2))
+            key = (id(inner), id(mesh), max(self.accumulate_steps, 1),
+                   sched, vpp)
             if self._pp_trainer is None or self._pp_key != key:
                 # rebuild on optimizer/mesh/accumulation change — a cached
                 # trainer would silently keep stale settings
                 self._pp_trainer = PipelinedTrainer(
                     self._layers, inner,
                     lambda m, x, y: m.compute_loss(m(x), y),
-                    mesh=mesh, n_micro=max(self.accumulate_steps, 1))
+                    mesh=mesh, n_micro=max(self.accumulate_steps, 1),
+                    schedule=sched, vpp_chunks=vpp)
                 self._pp_key = key
             loss = self._pp_trainer.train_step(inputs, labels)
             # keep the wrapped model/optimizer externally consistent: the

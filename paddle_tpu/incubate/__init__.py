@@ -4,3 +4,5 @@ from .segment_ops import (  # noqa: F401
     segment_max, segment_mean, segment_min, segment_sum, send_u_recv,
 )
 from . import asp  # noqa: F401
+
+from . import autograd  # noqa: F401

@@ -1,0 +1,116 @@
+"""paddle.incubate.autograd — function-transform AD (vjp/jvp/Jacobian/Hessian).
+
+Reference parity: python/paddle/incubate/autograd/functional.py (vjp :50,
+jvp :109, Jacobian, Hessian). TPU-native: these are direct jax transforms
+over a Tensor<->array bridge — higher-order differentiation (Hessian) comes
+for free from jax, where the eager tape cannot replay.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd.tape import no_grad
+from ..tensor import Tensor
+
+__all__ = ["vjp", "jvp", "Jacobian", "Hessian"]
+
+
+def _wrap(func):
+    """Tensor-function -> array-function (single or sequence inputs)."""
+    def arr_func(*arrays):
+        with no_grad():
+            outs = func(*[Tensor(a) for a in arrays])
+        if isinstance(outs, (tuple, list)):
+            return tuple(o._data for o in outs)
+        return outs._data
+    return arr_func
+
+
+def _unpack(xs):
+    if isinstance(xs, (tuple, list)):
+        return [x._data if isinstance(x, Tensor) else jnp.asarray(x)
+                for x in xs], False
+    return [xs._data if isinstance(xs, Tensor) else jnp.asarray(xs)], True
+
+
+def vjp(func, xs, v=None):
+    """(func(xs), vjp(v)) — functional.py:50."""
+    arrs, single = _unpack(xs)
+    out, pull = jax.vjp(_wrap(func), *arrs)
+    if v is None:
+        if isinstance(out, tuple):
+            raise ValueError("v is required for multi-output func")
+        v_arr = jnp.ones_like(out)
+    else:
+        v_list, _ = _unpack(v)
+        v_arr = tuple(v_list) if isinstance(out, tuple) else v_list[0]
+    grads = pull(v_arr)
+    outs_t = (tuple(Tensor(o) for o in out) if isinstance(out, tuple)
+              else Tensor(out))
+    grads_t = Tensor(grads[0]) if single else tuple(Tensor(g) for g in grads)
+    return outs_t, grads_t
+
+
+def jvp(func, xs, v=None):
+    """(func(xs), jvp(v)) — functional.py:109."""
+    arrs, single = _unpack(xs)
+    if v is None:
+        tangents = [jnp.ones_like(a) for a in arrs]
+    else:
+        tangents, _ = _unpack(v)
+    out, tan = jax.jvp(_wrap(func), tuple(arrs), tuple(tangents))
+    outs_t = (tuple(Tensor(o) for o in out) if isinstance(out, tuple)
+              else Tensor(out))
+    tans_t = (tuple(Tensor(t) for t in tan) if isinstance(tan, tuple)
+              else Tensor(tan))
+    return outs_t, tans_t
+
+
+class Jacobian:
+    """Full Jacobian of func at a single xs tensor (functional Jacobian
+    parity): ys_shape + xs_shape, computed with jax.jacrev."""
+
+    def __init__(self, func, xs, is_batched=False):
+        arrs, single = _unpack(xs)
+        if is_batched:
+            raise NotImplementedError(
+                "batched Jacobian: vmap inside func instead")
+        if not single:
+            raise NotImplementedError(
+                "Jacobian takes one xs tensor; call per input for multiple")
+        self._jac = jax.jacrev(_wrap(func))(arrs[0])
+
+    def __getitem__(self, idx):
+        return Tensor(self._jac)[idx]
+
+    @property
+    def shape(self):
+        return self._jac.shape
+
+    def numpy(self):
+        import numpy as np
+        return np.asarray(self._jac)
+
+
+class Hessian:
+    """Lazy Hessian of a scalar func at xs (jax.hessian under the hood)."""
+
+    def __init__(self, func, xs, is_batched=False):
+        arrs, self._single = _unpack(xs)
+        if is_batched:
+            raise NotImplementedError(
+                "batched Hessian: flatten the batch into func instead")
+        f = _wrap(func)
+        self._hess = jax.hessian(f)(*arrs)
+
+    def __getitem__(self, idx):
+        return Tensor(jnp.asarray(self._hess))[idx]
+
+    @property
+    def shape(self):
+        return jnp.asarray(self._hess).shape
+
+    def numpy(self):
+        import numpy as np
+        return np.asarray(jnp.asarray(self._hess))

@@ -139,10 +139,10 @@ def fused_rms_norm(x, norm_weight, norm_bias, epsilon, begin_norm_axis,
         b = rest.pop(0) if has_b else None
         r = rest.pop(0) if has_r else None
 
-        def oracle(pre_):
+        def oracle(pre_, w_):
             axes = tuple(range(begin_norm_axis, pre_.ndim))
             ms = jnp.mean(pre_ * pre_, axis=axes, keepdims=True)
-            o = pre_ * jax.lax.rsqrt(ms + epsilon) * wa.astype(jnp.float32)
+            o = pre_ * jax.lax.rsqrt(ms + epsilon) * w_.astype(jnp.float32)
             if nb is not None:
                 o = o + nb.astype(jnp.float32)
             return o
@@ -155,16 +155,19 @@ def fused_rms_norm(x, norm_weight, norm_bias, epsilon, begin_norm_axis,
         from ....kernels import fused_pallas as fp
         last_axis_only = begin_norm_axis == xa.ndim - 1
         if fp.enabled() and last_axis_only and nb is None:
-            # Pallas single-HBM-pass forward; backward is AD of the oracle
-            # (same pattern as models/llama.py fused_rope)
-            prim = lambda p_: fp.fused_rms_norm_pallas(
-                p_.astype(xa.dtype), wa, eps=epsilon).astype(jnp.float32)
+            # Pallas single-HBM-pass forward; backward is AD of the oracle.
+            # The weight is an explicit custom_vjp argument (a closed-over
+            # traced value would make it non-differentiable).
+            def prim(p_, w_):
+                return fp.fused_rms_norm_pallas(
+                    p_.astype(xa.dtype), w_, eps=epsilon).astype(jnp.float32)
+
             f = jax.custom_vjp(prim)
-            f.defvjp(lambda p_: (prim(p_), p_),
-                     lambda res, g: jax.vjp(oracle, res)[1](g))
-            out = f(pre)
+            f.defvjp(lambda p_, w_: (prim(p_, w_), (p_, w_)),
+                     lambda res, g: jax.vjp(oracle, *res)[1](g))
+            out = f(pre, wa)
         else:
-            out = oracle(pre)
+            out = oracle(pre, wa)
         return out.astype(xa.dtype), pre.astype(xa.dtype)
 
     out, residual_out = dispatch("fused_rms_norm", fwd, *args)
@@ -223,6 +226,8 @@ def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
         if not training or p == 0.0:
             out = a if mode != "downscale_in_infer" or training else a * (1 - p)
             return (out + b).astype(a.dtype)
+        if p >= 1.0:  # everything dropped (reference: output is y)
+            return b.astype(a.dtype)
         keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
         scaled = jnp.where(keep, a, 0.0)
         if mode == "upscale_in_train":

@@ -639,8 +639,9 @@ def box_clip(input, im_info, name=None):
             "no per-box image mapping); pass boxes as [B, N, 4] for batches")
 
     def fwd(b, info):
-        h = info[:, 0] / info[:, 2] - 1.0
-        w = info[:, 1] / info[:, 2] - 1.0
+        # reference rounds the descaled extents before the -1
+        h = jnp.round(info[:, 0] / info[:, 2]) - 1.0
+        w = jnp.round(info[:, 1] / info[:, 2]) - 1.0
         if b.ndim == 2:
             h0, w0 = h[0], w[0]
             return jnp.stack([

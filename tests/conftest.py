@@ -17,15 +17,18 @@ import jax  # noqa: E402
 # before any backend is initialized.
 jax.config.update("jax_platforms", "cpu")
 
-# Persistent compilation cache: per-test XLA compiles of 8-device hybrid
-# programs dominate suite time (VERDICT r1 weak #5); repeated runs hit disk.
-# A cache poisoned by a killed or concurrent writer ABORTS later runs when a
-# truncated entry is loaded (observed twice in round 2: "Fatal Python error:
-# Aborted" while executing a cached executable). Guard: a .clean stamp is
-# removed while a session is running and re-written on clean exit — if a
-# previous session died mid-write, the stamp is missing and the whole cache
-# is wiped (one slow cold run beats an aborted CI run).
-if not os.environ.get("PADDLE_TPU_NO_XLA_CACHE"):
+# Persistent compilation cache: OPT-IN ONLY (PADDLE_TPU_XLA_CACHE=1).
+# It cuts the suite from ~18 to ~11 min, but in this environment XLA:CPU AOT
+# cache entries are not reliably loadable across processes: runs abort with
+# "Fatal Python error: Aborted" while EXECUTING a cached executable that a
+# previous (green, cleanly-exited) run wrote — cpu_aot_loader logs a
+# compile-vs-host machine-feature mismatch (+prefer-no-gather etc.), i.e.
+# the AOT result was specialized for CPU features the loading process does
+# not report. Observed three times in round 2 at the same test; a cold run
+# is slower but never aborts, so cold is the default. The dead-PID marker
+# guard below additionally wipes leftovers from killed writers when the
+# cache IS enabled.
+if os.environ.get("PADDLE_TPU_XLA_CACHE"):
     import atexit
     import glob
     import shutil

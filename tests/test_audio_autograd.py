@@ -1,0 +1,137 @@
+"""paddle.audio features vs librosa-style math + autograd jacobian/vjp/jvp."""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+RNG = np.random.default_rng(23)
+
+
+# ---- audio ------------------------------------------------------------------
+
+def test_hz_mel_roundtrip_and_scales():
+    AF = paddle.audio.functional
+    for htk in (False, True):
+        f = paddle.to_tensor(np.array([0.0, 440.0, 4000.0], np.float32))
+        m = AF.hz_to_mel(f, htk)
+        back = AF.mel_to_hz(m, htk)
+        np.testing.assert_allclose(back.numpy(), f.numpy(), rtol=1e-4,
+                                   atol=1e-2)
+    # scalar path mirrors tensor path
+    assert abs(AF.hz_to_mel(440.0) -
+               float(AF.hz_to_mel(paddle.to_tensor(440.0)).numpy())) < 1e-3
+
+
+def test_fbank_matrix_properties():
+    AF = paddle.audio.functional
+    fb = AF.compute_fbank_matrix(16000, 512, n_mels=40)
+    w = np.asarray(fb.numpy())
+    assert w.shape == (40, 257)
+    assert (w >= 0).all()
+    # each filter is a contiguous triangle: single maximum, no plateau gaps
+    for i in range(40):
+        nz = np.nonzero(w[i])[0]
+        if len(nz):
+            assert (np.diff(nz) == 1).all()
+
+
+def test_spectrogram_matches_manual_stft():
+    sr, n_fft, hop = 16000, 256, 128
+    t = np.arange(sr // 10) / sr
+    x = np.sin(2 * math.pi * 1000 * t).astype(np.float32)[None]
+    spec = paddle.audio.Spectrogram(n_fft=n_fft, hop_length=hop)(
+        paddle.to_tensor(x))
+    s = np.asarray(spec.numpy())
+    assert s.shape[1] == n_fft // 2 + 1
+    # 1 kHz bin dominates
+    peak_bin = s[0].mean(-1).argmax()
+    assert abs(peak_bin - round(1000 * n_fft / sr)) <= 1
+
+
+def test_mfcc_pipeline_shapes_and_grad():
+    x = paddle.to_tensor(RNG.normal(size=(2, 4000)).astype(np.float32))
+    x.stop_gradient = False
+    mfcc = paddle.audio.MFCC(sr=16000, n_mfcc=13,
+                             n_fft=256, n_mels=40, top_db=80.0)
+    out = mfcc(x)
+    assert tuple(out.shape)[0:2] == (2, 13)
+    paddle.sum(out).backward()
+    assert np.isfinite(x.grad.numpy()).all()
+
+
+def test_log_mel_top_db_floor():
+    x = paddle.to_tensor(RNG.normal(size=(1, 2000)).astype(np.float32))
+    lm = paddle.audio.LogMelSpectrogram(sr=16000, n_fft=256, n_mels=32,
+                                        top_db=30.0)(x)
+    v = np.asarray(lm.numpy())
+    assert v.max() - v.min() <= 30.0 + 1e-4
+
+
+# ---- autograd ---------------------------------------------------------------
+
+def test_tape_jacobian_matches_analytic():
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+    x.stop_gradient = False
+    y = x * x  # dy_i/dx_j = 2 x_i delta_ij
+    jac = paddle.autograd.jacobian(y, x)
+    np.testing.assert_allclose(np.asarray(jac.numpy()),
+                               np.diag([2.0, 4.0, 6.0]), rtol=1e-6)
+
+
+def test_tape_jacobian_batched():
+    x = paddle.to_tensor(RNG.normal(size=(4, 3)).astype(np.float32))
+    x.stop_gradient = False
+    w = paddle.to_tensor(RNG.normal(size=(3, 2)).astype(np.float32))
+    y = paddle.matmul(x, w)
+    jac = paddle.autograd.jacobian(y, x, batch_axis=0)
+    assert tuple(jac.shape) == (4, 2, 3)
+    np.testing.assert_allclose(np.asarray(jac.numpy())[0],
+                               np.asarray(w.numpy()).T, rtol=1e-5)
+
+
+def test_incubate_vjp_jvp_hessian():
+    from paddle_tpu.incubate import autograd as IA
+
+    def f(a):
+        return paddle.sum(a * a * a)
+
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    out, g = IA.vjp(f, x)
+    assert abs(float(out.numpy()) - 9.0) < 1e-5
+    np.testing.assert_allclose(g.numpy(), [3.0, 12.0], rtol=1e-5)
+
+    out2, t = IA.jvp(f, x, paddle.to_tensor(np.array([1.0, 0.0], np.float32)))
+    np.testing.assert_allclose(float(t.numpy()), 3.0, rtol=1e-5)
+
+    h = IA.Hessian(f, x)
+    np.testing.assert_allclose(h.numpy(), np.diag([6.0, 12.0]), rtol=1e-5)
+
+    j = IA.Jacobian(lambda a: a * a, x)
+    np.testing.assert_allclose(j.numpy(), np.diag([2.0, 4.0]), rtol=1e-5)
+
+
+def test_tape_hessian_raises_with_guidance():
+    x = paddle.to_tensor(np.array([1.0], np.float32))
+    x.stop_gradient = False
+    y = paddle.sum(x * x)
+    with pytest.raises(NotImplementedError, match="incubate.autograd"):
+        paddle.autograd.hessian(y, x)
+
+
+def test_get_window_triang_matches_scipy_values():
+    AF = paddle.audio.functional
+    np.testing.assert_allclose(
+        AF.get_window("triang", 4, fftbins=False).numpy(),
+        [0.25, 0.75, 0.75, 0.25], rtol=1e-6)
+    np.testing.assert_allclose(
+        AF.get_window("triang", 3, fftbins=False).numpy(),
+        [0.5, 1.0, 0.5], rtol=1e-6)
+
+
+def test_create_dct_norm_none_scale():
+    AF = paddle.audio.functional
+    d = np.asarray(AF.create_dct(3, 8, norm=None).numpy())
+    # k=0 column of un-normalized DCT-II (x2) is all 2s
+    np.testing.assert_allclose(d[:, 0], np.full(8, 2.0), rtol=1e-6)

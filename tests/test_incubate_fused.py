@@ -208,3 +208,29 @@ def test_fused_rms_norm_pallas_route_matches_oracle():
     np.testing.assert_allclose(np.asarray(routed.numpy()),
                                np.asarray(base.numpy()), rtol=1e-5,
                                atol=1e-6)
+
+
+def test_fused_rms_norm_pallas_route_trains_weight():
+    # flag-gated path must be differentiable w.r.t. the weight
+    from paddle_tpu.framework import flags
+    x = paddle.to_tensor(RNG.normal(size=(2, 3, 16)).astype(np.float32))
+    x.stop_gradient = False
+    w = paddle.to_tensor((RNG.normal(size=(16,)) * 0.1 + 1).astype(
+        np.float32))
+    w.stop_gradient = False
+    old = flags.flag("use_pallas_fused")
+    try:
+        flags.set_flags({"FLAGS_use_pallas_fused": True})
+        out, _ = FF.fused_rms_norm(x, w, None, 1e-6, 2)
+        paddle.sum(out * out).backward()
+    finally:
+        flags.set_flags({"FLAGS_use_pallas_fused": old})
+    assert w.grad is not None and np.isfinite(w.grad.numpy()).all()
+    assert x.grad is not None and np.isfinite(x.grad.numpy()).all()
+
+
+def test_fused_dropout_add_p_one():
+    x = paddle.to_tensor(RNG.normal(size=(4, 4)).astype(np.float32))
+    y = paddle.to_tensor(RNG.normal(size=(4, 4)).astype(np.float32))
+    out = FF.fused_dropout_add(x, y, p=1.0, training=True)
+    np.testing.assert_allclose(out.numpy(), y.numpy(), rtol=1e-6)

@@ -126,3 +126,39 @@ def test_fleet_pipeline_fallback_loss_type():
     loss = pp.train_batch((x, y), o)
     v = float(loss.numpy())
     assert np.isfinite(v)
+
+
+def test_fleet_pipeline_schedule_mode_interleave():
+    """pipeline_configs.schedule_mode routes fleet train_batch to the
+    interleaved-VPP 1F1B trainer."""
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.fleet.meta_parallel import PipelineParallel
+
+    def make():
+        paddle.seed(21)
+        cfg = LlamaConfig.tiny(vocab_size=64, hidden_size=32, layers=4,
+                               heads=4, kv_heads=4, seq=16)
+        cfg.use_flash_attention = False
+        m = LlamaForCausalLM(cfg)
+        return m, opt.AdamW(learning_rate=1e-2, parameters=m.parameters())
+
+    rng = np.random.default_rng(3)
+    ids = paddle.to_tensor(rng.integers(0, 64, (4, 16)).astype(np.int32))
+
+    m1, o1 = make()
+    serial = SpmdTrainer(m1, o1, _loss, mesh=None)
+    ref = float(serial.train_step(ids, ids).numpy())
+
+    m2, o2 = make()
+    dist.set_mesh(make_hybrid_mesh(pp=2))
+
+    class Strat:
+        pipeline_configs = {"accumulate_steps": 2, "micro_batch_size": 2,
+                            "schedule_mode": "interleave", "vpp_degree": 2}
+    try:
+        pp = PipelineParallel(m2, hcg=None, strategy=Strat())
+        got = float(pp.train_batch((ids, ids), o2).numpy())
+        assert pp._pp_trainer.schedule == "interleave"
+    finally:
+        dist.set_mesh(None)
+    np.testing.assert_allclose(got, ref, rtol=3e-4, atol=3e-5)
