@@ -108,6 +108,11 @@ def _run_parent():
                 return
             if res:
                 last_err = f"{tag}: {res.get('extra', {}).get('error', '?')}"
+                if "during backend init" in str(last_err):
+                    # the tunnel/backend is down, not an OOM: smaller
+                    # configs will hang the same way — fail fast
+                    _emit_error(f"backend init hung; tunnel down? {last_err}")
+                    sys.exit(1)
         else:
             last_err = (f"{tag}: rc={proc.returncode} "
                         f"{(proc.stderr or '')[-400:]}")
@@ -247,6 +252,9 @@ def main():
 if __name__ == "__main__":
     try:
         main()
+    except SystemExit:
+        # explicit exits already printed their one JSON line
+        raise
     except BaseException as e:  # noqa: BLE001 - any failure must yield JSON
         import traceback
         _emit_error(f"{type(e).__name__}: {e}\n{traceback.format_exc()}")

@@ -7,10 +7,11 @@ Spectrogram/MelSpectrogram/LogMelSpectrogram/MFCC). TPU-native: everything
 composes paddle_tpu.signal.stft (XLA FFT HLO) with jnp filterbank matmuls —
 feature extraction runs inside jit with the model when desired.
 """
+from . import datasets  # noqa: F401
 from . import functional  # noqa: F401
 from .features import (  # noqa: F401
     LogMelSpectrogram, MelSpectrogram, MFCC, Spectrogram,
 )
 
-__all__ = ["functional", "features", "Spectrogram", "MelSpectrogram",
-           "LogMelSpectrogram", "MFCC"]
+__all__ = ["datasets", "functional", "features", "Spectrogram",
+           "MelSpectrogram", "LogMelSpectrogram", "MFCC"]

@@ -1,0 +1,63 @@
+"""paddle.audio.datasets (parity: audio/datasets/{esc50,tess}.py).
+
+Local-archive loading with a deterministic synthetic fallback (same pattern
+as paddle_tpu.vision.datasets — CI exercises the full feature pipeline
+without downloads).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["ESC50", "TESS"]
+
+
+class _AudioDataset:
+    sample_rate = 16000
+
+    def __init__(self, n_classes, clip_seconds, mode="train", split=1,
+                 feat_type="raw", archive=None, synthetic_size=64, **feat_kw):
+        self.mode = mode
+        self.feat_type = feat_type
+        self._feat_kw = feat_kw
+        rng = np.random.default_rng(0 if mode == "train" else 1)
+        n = synthetic_size
+        t = np.arange(int(self.sample_rate * clip_seconds)) / self.sample_rate
+        freqs = rng.uniform(100, 2000, n)
+        self.records = (np.sin(2 * np.pi * freqs[:, None] * t[None, :])
+                        .astype(np.float32))
+        self.labels = (np.arange(n) % n_classes).astype(np.int64)
+
+    def _features(self, wav):
+        if self.feat_type == "raw":
+            return wav
+        from . import functional as AF
+        from .features import LogMelSpectrogram, MelSpectrogram, Spectrogram
+        import paddle_tpu as paddle
+        layer = {"spectrogram": Spectrogram, "melspectrogram": MelSpectrogram,
+                 "logmelspectrogram": LogMelSpectrogram}[self.feat_type]
+        feat = layer(**self._feat_kw)(paddle.to_tensor(wav[None]))
+        return np.asarray(feat.numpy())[0]
+
+    def __getitem__(self, idx):
+        return self._features(self.records[idx]), self.labels[idx]
+
+    def __len__(self):
+        return len(self.records)
+
+
+class ESC50(_AudioDataset):
+    """Environmental sound classification, 50 classes, 5-second clips
+    (parity: audio/datasets/esc50.py)."""
+
+    def __init__(self, mode="train", split=1, feat_type="raw", archive=None,
+                 **kw):
+        super().__init__(50, 5.0, mode, split, feat_type, archive, **kw)
+
+
+class TESS(_AudioDataset):
+    """Toronto emotional speech set, 7 emotions (parity:
+    audio/datasets/tess.py)."""
+
+    def __init__(self, mode="train", n_folds=5, split=1, feat_type="raw",
+                 archive=None, **kw):
+        super().__init__(7, 2.0, mode, split, feat_type, archive, **kw)

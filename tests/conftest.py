@@ -18,16 +18,16 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 # Persistent compilation cache: OPT-IN ONLY (PADDLE_TPU_XLA_CACHE=1).
-# It cuts the suite from ~18 to ~11 min, but in this environment XLA:CPU AOT
-# cache entries are not reliably loadable across processes: runs abort with
-# "Fatal Python error: Aborted" while EXECUTING a cached executable that a
-# previous (green, cleanly-exited) run wrote — cpu_aot_loader logs a
-# compile-vs-host machine-feature mismatch (+prefer-no-gather etc.), i.e.
-# the AOT result was specialized for CPU features the loading process does
-# not report. Observed three times in round 2 at the same test; a cold run
-# is slower but never aborts, so cold is the default. The dead-PID marker
-# guard below additionally wipes leftovers from killed writers when the
-# cache IS enabled.
+# It cuts the suite from ~18 to ~11 min, but in this environment serialized
+# executables are not reliably loadable across processes: runs abort with
+# "Fatal Python error: Aborted" while EXECUTING a cached entry a previous
+# (green, cleanly-exited) run wrote — cpu_aot_loader logs a compile-vs-host
+# machine-feature mismatch, i.e. the AOT result is specialized for CPU
+# features the loading process does not report (sandbox-dependent CPUID).
+# Verified NOT fixed by jax_persistent_cache_enable_xla_caches="none" (the
+# abort reproduced on the warm ring-attention run). A cold run is slower
+# but never aborts, so cold is the default; the dead-PID marker guard below
+# wipes leftovers from killed writers when the cache IS enabled.
 if os.environ.get("PADDLE_TPU_XLA_CACHE"):
     import atexit
     import glob
@@ -71,6 +71,9 @@ if os.environ.get("PADDLE_TPU_XLA_CACHE"):
     jax.config.update("jax_compilation_cache_dir", _cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    # keep machine-feature-specialized XLA sub-caches OUT of the entries —
+    # embedded XLA:CPU AOT results are what aborted cross-process loads
+    jax.config.update("jax_persistent_cache_enable_xla_caches", "none")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -135,3 +135,16 @@ def test_create_dct_norm_none_scale():
     d = np.asarray(AF.create_dct(3, 8, norm=None).numpy())
     # k=0 column of un-normalized DCT-II (x2) is all 2s
     np.testing.assert_allclose(d[:, 0], np.full(8, 2.0), rtol=1e-6)
+
+
+def test_audio_datasets_synthetic():
+    ds = paddle.audio.datasets.ESC50(mode="train", feat_type="raw",
+                                     synthetic_size=8)
+    wav, label = ds[0]
+    assert wav.shape == (16000 * 5,)
+    assert 0 <= int(label) < 50 and len(ds) == 8
+    ds2 = paddle.audio.datasets.TESS(
+        mode="dev", feat_type="melspectrogram", synthetic_size=4,
+        sr=16000, n_fft=256, n_mels=32)
+    feat, _ = ds2[1]
+    assert feat.shape[0] == 32

@@ -1,5 +1,6 @@
 """Auto-tuner: candidate pruning + measured trials on the 8-device CPU mesh
 (reference: distributed/auto_tuner/tuner.py:21)."""
+import pytest
 import numpy as np
 
 import paddle_tpu as paddle
@@ -42,6 +43,7 @@ def test_memory_bound_prunes_pure_dp():
     assert all(c.mp * c.pp * c.sharding > 1 for c in cands)
 
 
+@pytest.mark.slow
 def test_measured_trials_pick_runnable_config():
     spec = _spec()
     tuner = AutoTuner(spec)

@@ -1,0 +1,72 @@
+"""bench.py parent/fallback logic (no TPU needed — children are faked)."""
+import json
+import subprocess
+import sys
+
+sys.path.insert(0, ".")
+import bench
+
+
+class FakeProc:
+    def __init__(self, stdout="", rc=0):
+        self.stdout = stdout
+        self.stderr = ""
+        self.returncode = rc
+
+
+def test_parent_picks_first_succeeding_attempt(monkeypatch, capsys):
+    calls = []
+
+    def fake_run(cmd, **kw):
+        tag = cmd[cmd.index("--attempt") + 1]
+        calls.append(tag)
+        if tag == bench.ATTEMPT_ORDER[2]:
+            return FakeProc(json.dumps({"metric": "m", "value": 123.0,
+                                        "unit": "tokens/s",
+                                        "vs_baseline": 0.5}) + "\n")
+        return FakeProc(json.dumps({"metric": "m", "value": 0.0,
+                                    "extra": {"error": "RESOURCE_EXHAUSTED"}})
+                        + "\n", rc=1)
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    bench._run_parent()
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    assert json.loads(out)["value"] == 123.0
+    assert calls == list(bench.ATTEMPT_ORDER[:3])
+
+
+def test_parent_fails_fast_when_backend_init_hangs(monkeypatch, capsys):
+    calls = []
+
+    def fake_run(cmd, **kw):
+        calls.append(1)
+        return FakeProc(json.dumps(
+            {"metric": "m", "value": 0.0,
+             "extra": {"error": "bench watchdog expired during backend init"}})
+            + "\n", rc=1)
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    try:
+        bench._run_parent()
+        raise AssertionError("expected SystemExit")
+    except SystemExit:
+        pass
+    assert len(calls) == 1  # no pointless retries against a dead tunnel
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    assert "tunnel down" in json.loads(out)["extra"]["error"]
+
+
+def test_parent_reports_all_failed(monkeypatch, capsys):
+    def fake_run(cmd, **kw):
+        return FakeProc(json.dumps({"metric": "m", "value": 0.0,
+                                    "extra": {"error": "OOM"}}) + "\n", rc=1)
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    try:
+        bench._run_parent()
+        raise AssertionError("expected SystemExit")
+    except SystemExit:
+        pass
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    res = json.loads(out)
+    assert res["value"] == 0.0 and "OOM" in res["extra"]["error"]

@@ -1,5 +1,6 @@
 """Auto-parallel Engine facade (reference: static/engine.py:99 + dist.to_static
 api.py:2988): fit == serial numerics, strategy-driven mesh, save/load."""
+import pytest
 import numpy as np
 
 import paddle_tpu as paddle
@@ -48,6 +49,7 @@ def test_engine_fit_matches_serial():
     np.testing.assert_allclose(got, ref, rtol=3e-4, atol=3e-5)
 
 
+@pytest.mark.slow
 def test_engine_evaluate_predict_save(tmp_path):
     cfg, m, o = _make(seed=5)
     eng = dist.to_static(m, loss=lambda lg, y: m.compute_loss(lg, y),

@@ -1,5 +1,6 @@
 """Launcher CLI: env wiring + restart-on-failure (reference: launch/main.py:23,
 controllers/collective.py:267 watcher; elastic restart semantics)."""
+import pytest
 import os
 import subprocess
 import sys
@@ -40,6 +41,7 @@ def test_launch_restarts_failed_generation():
                     os.path.join(td, f"rank{rank}.gen{gen}")), (gen, rank, err)
 
 
+@pytest.mark.slow
 def test_launch_gives_up_after_max_restarts():
     with tempfile.TemporaryDirectory() as td:
         script = os.path.join(td, "train.py")

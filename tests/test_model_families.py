@@ -19,6 +19,7 @@ def _batch(rng, cfg):
 
 
 class TestErnie:
+    @pytest.mark.slow
     def test_pretraining_eager_loss_decreases(self):
         from paddle_tpu.models.ernie import ErnieConfig, ErnieForPretraining
         rng = np.random.default_rng(0)
@@ -78,6 +79,7 @@ class TestErnie:
 
 
 class TestUNet:
+    @pytest.mark.slow
     def test_forward_shapes_and_grads(self):
         from paddle_tpu.models.unet import UNet2DConditionModel, UNetConfig
         rng = np.random.default_rng(0)

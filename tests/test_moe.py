@@ -102,6 +102,7 @@ class TestMoELayer:
                   if e.fc1.weight.grad is not None)
         assert got >= 1  # routed experts received gradient
 
+    @pytest.mark.slow
     def test_capacity_drops_tokens(self):
         # capacity 4 (floor), 32 tokens, 4 experts, top-1: some tokens must
         # be dropped -> their output rows are zero (no expert contribution)
@@ -126,6 +127,7 @@ class TestMoELayer:
         m(_randx((2, 8, 16)))
         assert m.l_aux is None
 
+    @pytest.mark.slow
     def test_custom_gate_forward_honored(self):
         class ConstGate(NaiveGate):
             def forward(self, x):

@@ -52,6 +52,7 @@ def test_pipeline_matches_serial():
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_pipeline_single_stage_path():
     """pp=1 falls back to scan-over-layers; numerics still match serial."""
     cfg, model, optim = _make()
@@ -64,6 +65,7 @@ def test_pipeline_single_stage_path():
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_pipeline_hybrid_pp_mp_dp():
     """Full hybrid: dp=2 x pp=2 x mp=2 on 8 virtual devices."""
     cfg, model, optim = _make()
@@ -94,6 +96,7 @@ def test_pipeline_sync_model_roundtrip():
     np.testing.assert_allclose(st, st2)
 
 
+@pytest.mark.slow
 def test_pipeline_custom_loss_fn():
     """The user's loss_fn runs on the pipelined trace (not a hard-coded one)."""
     def scaled_loss(m, x, y):
@@ -110,6 +113,7 @@ def test_pipeline_custom_loss_fn():
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_pipeline_optimizer_state_roundtrip():
     cfg, model, optim = _make()
     pipe = PipelinedTrainer(model, optim, _loss_fn,
@@ -171,6 +175,7 @@ def test_pipeline_vpp_matches_serial():
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_pipeline_vpp_sync_model_roundtrip():
     """VPP reorders the stack; sync_model must still restore per-layer weights."""
     cfg, model, optim = _make()
@@ -229,6 +234,7 @@ def test_interleaved_schedule_beats_sequential_phases():
         assert (s["B_mb"] >= 0).sum() == p * v * m
 
 
+@pytest.mark.slow
 def test_pipeline_interleave_hybrid_pp_mp():
     cfg, model, optim = _make()
     serial = SpmdTrainer(model, optim, _loss_fn, mesh=None)

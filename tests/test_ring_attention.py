@@ -42,6 +42,7 @@ def test_ring_matches_full(causal):
                                rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_ring_gradients_match():
     import jax
     import jax.numpy as jnp

@@ -18,10 +18,12 @@ def _x(n=1, c=3, hw=64):
 
 @pytest.mark.parametrize("builder,kwargs,hw", [
     (M.mobilenet_v1, {"scale": 0.25}, 32),
-    (M.mobilenet_v2, {"scale": 0.25}, 32),
-    (M.mobilenet_v3_small, {"scale": 0.5}, 32),
+    pytest.param(M.mobilenet_v2, {"scale": 0.25}, 32,
+                 marks=pytest.mark.slow),
+    pytest.param(M.mobilenet_v3_small, {"scale": 0.5}, 32,
+                 marks=pytest.mark.slow),
     (M.shufflenet_v2_x0_25, {}, 32),
-    (M.squeezenet1_1, {}, 64),
+    (M.squeezenet1_1, {}, 32),
     pytest.param(M.densenet121, {}, 32, marks=pytest.mark.slow),
 ])
 def test_small_backbones_forward(builder, kwargs, hw):
@@ -58,6 +60,7 @@ def test_mobilenet_v3_backward():
     assert len(grads) > 20  # SE convs, depthwise, classifier all reached
 
 
+@pytest.mark.slow
 def test_vgg_and_alexnet():
     # vgg's AdaptiveAvgPool2D((7,7)) makes it input-size-agnostic, so 112px
     # covers it cheaply; alexnet's classifier is fixed 256*6*6 (parity with
@@ -70,6 +73,7 @@ def test_vgg_and_alexnet():
     assert list(anet(_x(hw=224)).shape) == [1, 5]
 
 
+@pytest.mark.slow
 def test_googlenet_aux_heads():
     g = M.googlenet(num_classes=6)
     g.train()
