@@ -194,7 +194,8 @@ def main():
 
             trainer = SpmdTrainer(
                 model, optimizer, loss_fn, mesh=None,
-                remat_layers=list(model.model.layers) if remat else None)
+                remat_layers=list(model.model.layers) if remat else None,
+                remat_policy="dots")
             rng = np.random.default_rng(0)
             ids = paddle.to_tensor(rng.integers(
                 0, cfg.vocab_size, (batch, seq)).astype(np.int32))

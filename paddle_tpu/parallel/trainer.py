@@ -79,17 +79,46 @@ def _clip_grads_functional(grad_clip, params: Dict, grads: Dict) -> Dict:
     raise TypeError(f"unsupported grad clip {type(grad_clip)}")
 
 
-def _wrap_remat(layer):
-    """Wrap a Layer's forward in jax.checkpoint (activation recompute)."""
+REMAT_POLICIES = {
+    # parity target: the reference's recompute strategies (fleet/recompute);
+    # TPU-native knob = WHAT jax.checkpoint saves vs recomputes. "dots" is
+    # the usual MFU sweet spot for transformer blocks: keep the MXU outputs
+    # (matmul activations), recompute the cheap VPU elementwise chains.
+    "full": None,                           # save nothing: max memory saving
+    "dots": "dots_saveable",                # keep matmul results
+    "dots_no_batch": "dots_with_no_batch_dims_saveable",
+    "nothing": "nothing_saveable",
+}
+
+
+def _remat_policy(name):
+    if name is None or name == "full":
+        return None
+    import jax.ad_checkpoint as adc
+    key = REMAT_POLICIES.get(name)
+    if key is None:
+        raise ValueError(f"remat_policy must be one of {list(REMAT_POLICIES)},"
+                         f" got {name!r}")
+    return getattr(adc.checkpoint_policies, key)
+
+
+def _wrap_remat(layer, policy: str = "full"):
+    """Wrap a Layer's forward in jax.checkpoint (activation recompute).
+
+    policy selects what is saved across the backward (REMAT_POLICIES):
+    "full" recomputes everything, "dots" keeps MXU matmul outputs, etc."""
     orig = layer.forward
     if getattr(layer, "_remat_wrapped", False):
         return
+    pol = _remat_policy(policy)
+    ckpt = (jax.checkpoint if pol is None
+            else functools.partial(jax.checkpoint, policy=pol))
 
     def remat_forward(h, *args, **kwargs):
         def pure(h_arr):
             return orig(Tensor(h_arr), *args, **kwargs)._data
-        return Tensor(jax.checkpoint(pure)(h._data if isinstance(h, Tensor)
-                                           else h))
+        return Tensor(ckpt(pure)(h._data if isinstance(h, Tensor)
+                                 else h))
     layer.forward = remat_forward
     layer._remat_wrapped = True
 
@@ -104,7 +133,8 @@ class SpmdTrainer:
                  mesh: Optional[ProcessMesh] = None, remat_layers=None,
                  donate: bool = True, batch_axes=("dp", "sharding"),
                  seq_axis: Optional[str] = None,
-                 zero_stage: Optional[int] = None):
+                 zero_stage: Optional[int] = None,
+                 remat_policy: str = "full"):
         self.model = model
         self.opt = optimizer
         self.loss_fn = loss_fn
@@ -129,7 +159,7 @@ class SpmdTrainer:
         self.donate = donate
         if remat_layers:
             for l in remat_layers:
-                _wrap_remat(l)
+                _wrap_remat(l, remat_policy)
 
         self._params: Dict[str, Tensor] = dict(model.named_parameters())
         self._param_list: List[str] = list(self._params)

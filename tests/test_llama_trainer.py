@@ -154,3 +154,41 @@ def test_gqa_heads():
     assert out.shape == [2, 16, 64]
     _loss_fn(model, ids, ids).backward()
     assert model.model.layers[0].self_attn.k_proj.weight.grad is not None
+
+
+def test_remat_policy_dots_matches_full():
+    """remat_policy='dots' (keep MXU outputs) must not change numerics."""
+    import numpy as np
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu.parallel import SpmdTrainer
+    import paddle_tpu as paddle
+
+    def make():
+        paddle.seed(5)
+        cfg = LlamaConfig.tiny(vocab_size=64, hidden_size=32, layers=2,
+                               heads=4, kv_heads=2, seq=16)
+        m = LlamaForCausalLM(cfg)
+        o = opt.AdamW(learning_rate=1e-3, parameters=m.parameters())
+        return m, o
+
+    def loss_fn(m, i, l):
+        return m.compute_loss(m(i), l)
+
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(rng.integers(0, 64, (2, 16)).astype(np.int32))
+    m1, o1 = make()
+    t1 = SpmdTrainer(m1, o1, loss_fn, mesh=None,
+                     remat_layers=list(m1.model.layers), remat_policy="full")
+    ref = [float(t1.train_step(ids, ids).numpy()) for _ in range(3)]
+    m2, o2 = make()
+    t2 = SpmdTrainer(m2, o2, loss_fn, mesh=None,
+                     remat_layers=list(m2.model.layers), remat_policy="dots")
+    got = [float(t2.train_step(ids, ids).numpy()) for _ in range(3)]
+    np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-6)
+
+    import pytest
+    with pytest.raises(ValueError, match="remat_policy"):
+        m3, o3 = make()
+        SpmdTrainer(m3, o3, loss_fn, mesh=None,
+                    remat_layers=list(m3.model.layers), remat_policy="bogus")
