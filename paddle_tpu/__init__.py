@@ -81,11 +81,11 @@ from .ops.stat import (  # noqa: F401
     mean, median, nanmean, nanmedian, nanquantile, nansum, quantile, std, var,
 )
 from .ops.special import (  # noqa: F401
-    as_strided, clip_by_norm, copysign, diagonal, fill_diagonal_,
+    as_strided, cdist, clip_by_norm, copysign, diagonal, fill_diagonal_,
     fill_diagonal_tensor, frexp, gammainc, gammaincc, gammaln, gather_tree,
-    l1_norm, ldexp, lerp, multiplex, polygamma, reduce_as, renorm, reverse,
-    sequence_mask, shard_index, squared_l2_norm, swiglu, top_p_sampling,
-    trace, vander, view,
+    l1_norm, ldexp, lerp, multigammaln, multiplex, polygamma, reduce_as,
+    renorm, reverse, sequence_mask, sgn, shard_index, slice_scatter,
+    squared_l2_norm, swapaxes, swiglu, top_p_sampling, trace, vander, view,
 )
 from .ops.random_ops import (  # noqa: F401
     bernoulli, bernoulli_, binomial, multinomial, normal, poisson, rand,

@@ -319,3 +319,74 @@ def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
     """Already in nn.functional? kept here as the op-level alias."""
     from ..nn.functional import unfold as f_unfold
     return f_unfold(x, kernel_sizes, strides, paddings, dilations)
+
+
+def sgn(x, name=None):
+    """Parity: paddle.sgn — sign for real, unit phasor for complex."""
+    def fwd(a):
+        if jnp.iscomplexobj(a):
+            mag = jnp.abs(a)
+            return jnp.where(mag == 0, 0.0 + 0.0j, a / mag)
+        return jnp.sign(a)
+    return dispatch("sgn", fwd, ensure_tensor(x))
+
+
+def multigammaln(x, p, name=None):
+    """Parity: paddle.multigammaln — log multivariate gamma."""
+    import math
+
+    def fwd(a):
+        a = a.astype(jnp.float32)
+        out = 0.25 * p * (p - 1) * math.log(math.pi)
+        for j in range(p):
+            out = out + jax.scipy.special.gammaln(a - 0.5 * j)
+        return out
+    return dispatch("multigammaln", fwd, ensure_tensor(x))
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    """Parity: paddle.cdist — pairwise p-norm distance [.., m, n]."""
+    xt, yt = ensure_tensor(x), ensure_tensor(y)
+
+    def fwd(a, b):
+        a = a.astype(jnp.float32)
+        b = b.astype(jnp.float32)
+        diff = a[..., :, None, :] - b[..., None, :, :]
+        if p == 2.0:
+            return jnp.sqrt(jnp.maximum(jnp.sum(diff * diff, -1), 0.0))
+        if p == float("inf"):
+            return jnp.max(jnp.abs(diff), -1)
+        if p == 0:
+            return jnp.sum(diff != 0, -1).astype(jnp.float32)
+        return jnp.sum(jnp.abs(diff) ** p, -1) ** (1.0 / p)
+    return dispatch("cdist", fwd, xt, yt)
+
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    """Parity: paddle.slice_scatter — write `value` into the strided slice."""
+    xt, vt = ensure_tensor(x), ensure_tensor(value)
+
+    def fwd(a, v):
+        idx = [slice(None)] * a.ndim
+        for ax, st, en, sd in zip(axes, starts, ends, strides):
+            idx[ax] = slice(st, en, sd)
+        return a.at[tuple(idx)].set(v)
+    return dispatch("slice_scatter", fwd, xt, vt)
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    """Parity: paddle.swapaxes (alias of transpose on two axes)."""
+    def fwd(a):
+        return jnp.swapaxes(a, axis0, axis1)
+    return dispatch("swapaxes", fwd, ensure_tensor(x))
+
+
+moveaxis_alias = None  # moveaxis already exists in manipulation
+
+
+from .dispatch import register_op as _reg  # noqa: E402
+for _n in ("sgn", "multigammaln", "cdist", "slice_scatter", "swapaxes",
+           "trace", "lerp", "renorm", "vander", "as_strided", "unfold"):
+    _reg(_n, globals()[_n])
+del _reg

@@ -265,3 +265,38 @@ def test_send_u_recv():
     out3 = inc.send_u_recv(_t(x), _t(src), _t(dst), reduce_op="sum",
                            out_size=3).numpy()
     np.testing.assert_allclose(out3, [[4.0], [3.0], [0.0]])
+
+
+def test_sgn_swapaxes_cdist_multigammaln_slice_scatter():
+    torch = pytest.importorskip("torch")
+    import paddle_tpu as paddle
+    x = np.array([-2.0, 0.0, 3.0], np.float32)
+    np.testing.assert_allclose(paddle.sgn(paddle.to_tensor(x)).numpy(),
+                               np.sign(x))
+    a = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    np.testing.assert_allclose(
+        paddle.swapaxes(paddle.to_tensor(a), 0, 2).numpy(),
+        np.swapaxes(a, 0, 2))
+    # method form too
+    assert paddle.to_tensor(a).swapaxes(1, 2).numpy().shape == (2, 4, 3)
+
+    p_, q_ = (np.random.default_rng(0).normal(size=(5, 3)).astype(np.float32),
+              np.random.default_rng(1).normal(size=(4, 3)).astype(np.float32))
+    for pp in (2.0, 1.0, float("inf")):
+        got = paddle.cdist(paddle.to_tensor(p_), paddle.to_tensor(q_), p=pp)
+        ref = torch.cdist(torch.tensor(p_), torch.tensor(q_), p=pp)
+        np.testing.assert_allclose(got.numpy(), ref.numpy(), rtol=1e-4,
+                                   atol=1e-5)
+
+    v = np.array([2.5, 4.0], np.float32)
+    got = paddle.multigammaln(paddle.to_tensor(v), 3)
+    ref = torch.special.multigammaln(torch.tensor(v), 3)
+    np.testing.assert_allclose(got.numpy(), ref.numpy(), rtol=1e-4)
+
+    base = np.zeros((4, 6), np.float32)
+    val = np.ones((4, 2), np.float32)
+    out = paddle.slice_scatter(paddle.to_tensor(base), paddle.to_tensor(val),
+                               axes=[1], starts=[1], ends=[5], strides=[2])
+    expect = base.copy()
+    expect[:, 1:5:2] = val
+    np.testing.assert_allclose(out.numpy(), expect)
