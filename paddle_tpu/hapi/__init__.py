@@ -2,7 +2,7 @@
 from . import callbacks  # noqa: F401
 from .callbacks import (  # noqa: F401
     Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger,
-    VisualDL,
+    ReduceLROnPlateau, VisualDL, WandbCallback,
 )
 from .model import Model  # noqa: F401
 

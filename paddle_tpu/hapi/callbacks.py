@@ -190,3 +190,99 @@ class VisualDL(Callback):  # pragma: no cover - external viz package
 
     def on_train_batch_end(self, step, logs=None):
         pass
+
+
+class ReduceLROnPlateau(Callback):
+    """Parity: hapi ReduceLROnPlateau — shrink the optimizer lr when the
+    monitored metric plateaus (wraps the optimizer's plain-float lr; if a
+    scheduler is installed this callback leaves it alone)."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0):
+        super().__init__()
+        self.monitor = monitor
+        self.factor = float(factor)
+        if self.factor >= 1.0:
+            raise ValueError("factor must be < 1.0")
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = abs(min_delta)
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        if mode == "max" or (mode == "auto" and "acc" in monitor):
+            self._better = lambda cur, best: cur > best + self.min_delta
+            self._best0 = -float("inf")
+        else:
+            self._better = lambda cur, best: cur < best - self.min_delta
+            self._best0 = float("inf")
+        self._best = self._best0
+        self._wait = 0
+        self._cooldown_counter = 0
+
+    def _get_metric(self, logs):
+        v = (logs or {}).get(self.monitor)
+        if isinstance(v, (list, tuple)):
+            v = v[0]
+        return v
+
+    def on_eval_end(self, logs=None):
+        self._check(self._get_metric(logs))
+
+    def on_epoch_end(self, epoch, logs=None):
+        v = self._get_metric(logs)
+        if v is not None:
+            self._check(v)
+
+    def _check(self, current):
+        if current is None:
+            return
+        opt = getattr(self.model, "_optimizer", None)
+        if opt is None:
+            return
+        if self._cooldown_counter > 0:
+            self._cooldown_counter -= 1
+            self._wait = 0
+        if self._better(current, self._best):
+            self._best = current
+            self._wait = 0
+            return
+        self._wait += 1
+        if self._wait >= self.patience:
+            from ..optimizer.lr import LRScheduler
+            if isinstance(opt._learning_rate, LRScheduler):
+                return  # scheduler owns the lr
+            old = float(opt.get_lr())
+            new = max(old * self.factor, self.min_lr)
+            if old - new > 1e-12:
+                opt.set_lr(new)
+                if self.verbose:
+                    print(f"ReduceLROnPlateau: lr {old:.3e} -> {new:.3e}")
+            self._cooldown_counter = self.cooldown
+            self._wait = 0
+
+
+class WandbCallback(Callback):
+    """Parity: hapi WandbCallback — metric logging to Weights & Biases.
+    Requires the external `wandb` package; constructing without it raises
+    (the reference behaves the same way)."""
+
+    def __init__(self, project=None, name=None, dir=None, mode=None, **kw):
+        super().__init__()
+        try:
+            import wandb  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "WandbCallback requires the wandb package (pip install "
+                "wandb); it is not bundled in this environment") from e
+        self._wandb = __import__("wandb")
+        self._run = self._wandb.init(project=project, name=name, dir=dir,
+                                     mode=mode, **kw)
+
+    def on_epoch_end(self, epoch, logs=None):
+        payload = {k: (v[0] if isinstance(v, (list, tuple)) else v)
+                   for k, v in (logs or {}).items()}
+        payload["epoch"] = epoch
+        self._run.log(payload)
+
+    def on_train_end(self, logs=None):
+        self._run.finish()

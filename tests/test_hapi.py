@@ -143,3 +143,56 @@ class TestModel:
     def test_summary(self):
         info = paddle.summary(_mlp())
         assert info["total_params"] == 8 * 32 + 32 + 32 * 3 + 3
+
+
+def test_linear_lr_schedule():
+    from paddle_tpu.optimizer.lr import LinearLR
+    s = LinearLR(learning_rate=0.1, total_steps=4, start_factor=0.5,
+                 end_factor=1.0)
+    vals = [s()]
+    for _ in range(5):
+        s.step()
+        vals.append(s())
+    np.testing.assert_allclose(
+        vals[:5], [0.05, 0.0625, 0.075, 0.0875, 0.1], rtol=1e-6)
+    np.testing.assert_allclose(vals[5], 0.1, rtol=1e-6)  # clamped at end
+
+
+def test_reduce_lr_on_plateau_callback():
+    import paddle_tpu.hapi as hapi
+
+    class FakeOpt:
+        def __init__(self):
+            self._lr = 0.1
+            self._learning_rate = 0.1
+        def get_lr(self):
+            return self._lr
+        def set_lr(self, v):
+            self._lr = v
+            self._learning_rate = v
+
+    class FakeModel:
+        _optimizer = FakeOpt()
+
+    cb = hapi.ReduceLROnPlateau(monitor="loss", factor=0.5, patience=2,
+                                verbose=0)
+    cb.model = FakeModel()
+    cb.on_epoch_end(0, {"loss": 1.0})
+    cb.on_epoch_end(1, {"loss": 1.0})   # wait 1
+    cb.on_epoch_end(2, {"loss": 1.0})   # wait 2 -> reduce
+    assert abs(FakeModel._optimizer.get_lr() - 0.05) < 1e-9
+    cb.on_epoch_end(3, {"loss": 0.5})   # improves -> best resets
+    cb.on_epoch_end(4, {"loss": 0.5})
+    assert abs(FakeModel._optimizer.get_lr() - 0.05) < 1e-9
+
+
+def test_wandb_callback_requires_package():
+    import paddle_tpu.hapi as hapi
+    try:
+        import wandb  # noqa: F401
+        has = True
+    except ImportError:
+        has = False
+    if not has:
+        with pytest.raises(ImportError, match="wandb"):
+            hapi.WandbCallback(project="x")
