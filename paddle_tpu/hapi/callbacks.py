@@ -220,7 +220,12 @@ class ReduceLROnPlateau(Callback):
         self._cooldown_counter = 0
 
     def _get_metric(self, logs):
-        v = (logs or {}).get(self.monitor)
+        logs = logs or {}
+        v = logs.get(self.monitor)
+        if v is None:
+            # eval logs carry an "eval_" prefix (same fallback as
+            # EarlyStopping above)
+            v = logs.get("eval_" + self.monitor)
         if isinstance(v, (list, tuple)):
             v = v[0]
         return v
@@ -240,8 +245,12 @@ class ReduceLROnPlateau(Callback):
         if opt is None:
             return
         if self._cooldown_counter > 0:
+            # in cooldown: consume an epoch, track bests, do NOT count waits
             self._cooldown_counter -= 1
             self._wait = 0
+            if self._better(current, self._best):
+                self._best = current
+            return
         if self._better(current, self._best):
             self._best = current
             self._wait = 0
@@ -269,20 +278,31 @@ class WandbCallback(Callback):
     def __init__(self, project=None, name=None, dir=None, mode=None, **kw):
         super().__init__()
         try:
-            import wandb  # noqa: F401
+            import wandb
         except ImportError as e:
             raise ImportError(
                 "WandbCallback requires the wandb package (pip install "
                 "wandb); it is not bundled in this environment") from e
-        self._wandb = __import__("wandb")
-        self._run = self._wandb.init(project=project, name=name, dir=dir,
-                                     mode=mode, **kw)
+        self._wandb = wandb
+        self._init_kw = dict(project=project, name=name, dir=dir, mode=mode,
+                             **kw)
+        self._run = None
+
+    def on_train_begin(self, logs=None):
+        # start the (network-backed) run lazily per fit(), so construction
+        # is side-effect free and the callback is reusable across fits
+        if self._run is None:
+            self._run = self._wandb.init(**self._init_kw)
 
     def on_epoch_end(self, epoch, logs=None):
+        if self._run is None:
+            return
         payload = {k: (v[0] if isinstance(v, (list, tuple)) else v)
                    for k, v in (logs or {}).items()}
         payload["epoch"] = epoch
         self._run.log(payload)
 
     def on_train_end(self, logs=None):
-        self._run.finish()
+        if self._run is not None:
+            self._run.finish()
+            self._run = None

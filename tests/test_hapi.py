@@ -196,3 +196,52 @@ def test_wandb_callback_requires_package():
     if not has:
         with pytest.raises(ImportError, match="wandb"):
             hapi.WandbCallback(project="x")
+
+
+def test_reduce_lr_cooldown_suppresses_waits():
+    import paddle_tpu.hapi as hapi
+
+    class FakeOpt:
+        _lr = 0.1
+        _learning_rate = 0.1
+        def get_lr(self):
+            return self._lr
+        def set_lr(self, v):
+            self._lr = v
+
+    class FakeModel:
+        _optimizer = FakeOpt()
+
+    cb = hapi.ReduceLROnPlateau(monitor="loss", factor=0.5, patience=1,
+                                cooldown=3, verbose=0)
+    cb.model = FakeModel()
+    cb.on_epoch_end(0, {"loss": 1.0})   # best
+    cb.on_epoch_end(1, {"loss": 1.0})   # wait 1 -> reduce, cooldown starts
+    assert abs(FakeModel._optimizer.get_lr() - 0.05) < 1e-9
+    for e in range(2, 5):               # cooldown epochs: no further cuts
+        cb.on_epoch_end(e, {"loss": 1.0})
+    assert abs(FakeModel._optimizer.get_lr() - 0.05) < 1e-9
+    cb.on_epoch_end(5, {"loss": 1.0})   # first post-cooldown wait -> reduce
+    assert abs(FakeModel._optimizer.get_lr() - 0.025) < 1e-9
+
+
+def test_reduce_lr_monitors_eval_prefix():
+    import paddle_tpu.hapi as hapi
+
+    class FakeOpt:
+        _lr = 0.1
+        _learning_rate = 0.1
+        def get_lr(self):
+            return self._lr
+        def set_lr(self, v):
+            self._lr = v
+
+    class FakeModel:
+        _optimizer = FakeOpt()
+
+    cb = hapi.ReduceLROnPlateau(monitor="loss", factor=0.5, patience=1,
+                                verbose=0)
+    cb.model = FakeModel()
+    cb.on_eval_end({"eval_loss": 1.0})
+    cb.on_eval_end({"eval_loss": 1.0})
+    assert abs(FakeModel._optimizer.get_lr() - 0.05) < 1e-9
