@@ -1,0 +1,42 @@
+"""Layer wrappers over the fused functionals (parity:
+incubate/nn/layer/{fused_linear.py:26, fused_dropout_add.py:26})."""
+from __future__ import annotations
+
+from ....nn.layer.layers import Layer
+
+
+class FusedLinear(Layer):
+    """Linear backed by fused_matmul_bias (one GEMM+bias epilogue)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        self._transpose = transpose_weight
+        shape = ((out_features, in_features) if transpose_weight
+                 else (in_features, out_features))
+        self.weight = self.create_parameter(shape, attr=weight_attr)
+        self.bias = (None if bias_attr is False else
+                     self.create_parameter((out_features,), attr=bias_attr,
+                                           is_bias=True))
+
+    def forward(self, x):
+        from ..functional import fused_linear
+        return fused_linear(x, self.weight, self.bias,
+                            transpose_weight=self._transpose)
+
+
+class FusedDropoutAdd(Layer):
+    """dropout(x) + y in one pass."""
+
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x, y):
+        from ..functional import fused_dropout_add
+        return fused_dropout_add(x, y, p=self.p, training=self.training,
+                                 mode=self.mode)
+
+    def extra_repr(self):
+        return f"p={self.p}, mode={self.mode}"

@@ -234,3 +234,26 @@ def test_fused_dropout_add_p_one():
     y = paddle.to_tensor(RNG.normal(size=(4, 4)).astype(np.float32))
     out = FF.fused_dropout_add(x, y, p=1.0, training=True)
     np.testing.assert_allclose(out.numpy(), y.numpy(), rtol=1e-6)
+
+
+def test_fused_linear_and_dropout_add_layers():
+    import paddle_tpu.incubate.nn as inn
+    lin = inn.FusedLinear(6, 3)
+    x = paddle.to_tensor(RNG.normal(size=(4, 6)).astype(np.float32))
+    out = lin(x)
+    ref = np.asarray(x.numpy()) @ np.asarray(lin.weight.numpy()) + \
+        np.asarray(lin.bias.numpy())
+    np.testing.assert_allclose(np.asarray(out.numpy()), ref, rtol=1e-5,
+                               atol=1e-6)
+    lint = inn.FusedLinear(6, 3, transpose_weight=True)
+    assert tuple(lint(x).shape) == (4, 3)
+
+    da = inn.FusedDropoutAdd(p=0.4)
+    da.eval()
+    y = paddle.to_tensor(RNG.normal(size=(4, 6)).astype(np.float32))
+    np.testing.assert_allclose(np.asarray(da(x, y).numpy()),
+                               np.asarray(x.numpy()) + np.asarray(y.numpy()),
+                               rtol=1e-6)
+    da.train()
+    out_tr = da(x, y)
+    assert out_tr.shape == x.shape
