@@ -694,3 +694,105 @@ def bipartite_match(dist_matrix, match_type="bipartite", dist_threshold=None,
 
 
 __all__ += ["box_clip", "bipartite_match"]
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False, name=None):
+    """RPN proposal generation (parity: vision/ops.py generate_proposals /
+    phi/kernels/cpu/generate_proposals_kernel.cc — decode with exp clip at
+    log(1000/16), clip to image, min-size filter, greedy NMS with the
+    pixel_offset area convention). Host-eager like the reference CPU kernel
+    (data-dependent output sizes).
+
+    scores [N, A, H, W]; bbox_deltas [N, 4A, H, W]; img_size [N, 2] (h, w);
+    anchors [H, W, A, 4]; variances [H, W, A, 4].
+    Returns (rpn_rois [total, 4], rpn_roi_probs [total, 1][, rois_num [N]]).
+    """
+    import math as _math
+
+    import numpy as np
+
+    sc = np.asarray(ensure_tensor(scores).numpy(), np.float64)
+    bd = np.asarray(ensure_tensor(bbox_deltas).numpy(), np.float64)
+    ims = np.asarray(ensure_tensor(img_size).numpy(), np.float64)
+    an = np.asarray(ensure_tensor(anchors).numpy(), np.float64).reshape(-1, 4)
+    va = np.asarray(ensure_tensor(variances).numpy(),
+                    np.float64).reshape(-1, 4)
+    n, a, h, w = sc.shape
+    off = 1.0 if pixel_offset else 0.0
+    clip = _math.log(1000.0 / 16.0)
+    min_size = max(min_size, 1.0)
+
+    def iou(b1, b2):
+        x1 = max(b1[0], b2[0])
+        y1 = max(b1[1], b2[1])
+        x2 = min(b1[2], b2[2])
+        y2 = min(b1[3], b2[3])
+        iw = max(x2 - x1 + off, 0.0)
+        ih = max(y2 - y1 + off, 0.0)
+        inter = iw * ih
+        a1 = (b1[2] - b1[0] + off) * (b1[3] - b1[1] + off)
+        a2 = (b2[2] - b2[0] + off) * (b2[3] - b2[1] + off)
+        return inter / max(a1 + a2 - inter, 1e-10)
+
+    all_rois, all_probs, nums = [], [], []
+    for i in range(n):
+        s_i = sc[i].transpose(1, 2, 0).reshape(-1)            # [HWA]
+        d_i = bd[i].reshape(a, 4, h, w).transpose(2, 3, 0, 1).reshape(-1, 4)
+        order = np.argsort(-s_i, kind="stable")[:pre_nms_top_n]
+        s_i, d_i = s_i[order], d_i[order]
+        an_i, va_i = an[order], va[order]
+
+        aw = an_i[:, 2] - an_i[:, 0] + off
+        ah = an_i[:, 3] - an_i[:, 1] + off
+        acx = an_i[:, 0] + 0.5 * aw
+        acy = an_i[:, 1] + 0.5 * ah
+        cx = va_i[:, 0] * d_i[:, 0] * aw + acx
+        cy = va_i[:, 1] * d_i[:, 1] * ah + acy
+        bw = np.exp(np.minimum(va_i[:, 2] * d_i[:, 2], clip)) * aw
+        bh = np.exp(np.minimum(va_i[:, 3] * d_i[:, 3], clip)) * ah
+        props = np.stack([cx - bw / 2, cy - bh / 2,
+                          cx + bw / 2 - off, cy + bh / 2 - off], axis=1)
+        im_h, im_w = ims[i, 0], ims[i, 1]
+        props[:, 0] = np.clip(props[:, 0], 0, im_w - off)
+        props[:, 2] = np.clip(props[:, 2], 0, im_w - off)
+        props[:, 1] = np.clip(props[:, 1], 0, im_h - off)
+        props[:, 3] = np.clip(props[:, 3], 0, im_h - off)
+
+        ws = props[:, 2] - props[:, 0] + off
+        hs = props[:, 3] - props[:, 1] + off
+        keep = (ws >= min_size) & (hs >= min_size)
+        if pixel_offset:
+            keep &= (props[:, 0] + ws / 2 <= im_w) & \
+                (props[:, 1] + hs / 2 <= im_h)
+        props, s_i = props[keep], s_i[keep]
+
+        picked = []
+        for j in range(len(props)):
+            ok = True
+            for k in picked:
+                if iou(props[j], props[k]) > nms_thresh:
+                    ok = False
+                    break
+            if ok:
+                picked.append(j)
+            if len(picked) >= post_nms_top_n:
+                break
+        all_rois.append(props[picked])
+        all_probs.append(s_i[picked])
+        nums.append(len(picked))
+
+    rois = Tensor(jnp.asarray(np.concatenate(all_rois)
+                              if all_rois else np.zeros((0, 4)),
+                              ).astype(jnp.float32))
+    probs = Tensor(jnp.asarray(
+        np.concatenate(all_probs).reshape(-1, 1)
+        if all_probs else np.zeros((0, 1))).astype(jnp.float32))
+    if return_rois_num:
+        return rois, probs, Tensor(jnp.asarray(np.asarray(nums, np.int32)))
+    return rois, probs
+
+
+__all__ += ["generate_proposals"]

@@ -256,3 +256,58 @@ def test_bipartite_match():
                                  match_type="per_prediction",
                                  dist_threshold=0.5)
     assert idx2.numpy().reshape(-1).tolist() == [0, 1, 1]  # col2 -> row1, 0.6
+
+
+def test_generate_proposals_basic():
+    # one anchor layout where decode/clip/filter/nms are hand-checkable
+    n, a, h, w = 1, 2, 2, 2
+    scores = np.array([[[[0.9, 0.1], [0.2, 0.3]],
+                        [[0.8, 0.05], [0.15, 0.25]]]], np.float32)
+    deltas = np.zeros((n, 4 * a, h, w), np.float32)  # identity decode
+    anchors = np.zeros((h, w, a, 4), np.float32)
+    for yy in range(h):
+        for xx in range(w):
+            for aa in range(a):
+                anchors[yy, xx, aa] = [4 * xx, 4 * yy,
+                                       4 * xx + 6 + aa, 4 * yy + 6 + aa]
+    var = np.ones((h, w, a, 4), np.float32)
+    img = np.array([[16.0, 16.0]], np.float32)
+    rois, probs, nums = V.generate_proposals(
+        paddle.to_tensor(scores), paddle.to_tensor(deltas),
+        paddle.to_tensor(img), paddle.to_tensor(anchors),
+        paddle.to_tensor(var), pre_nms_top_n=8, post_nms_top_n=4,
+        nms_thresh=0.5, min_size=2.0, return_rois_num=True)
+    r = np.asarray(rois.numpy())
+    p = np.asarray(probs.numpy()).reshape(-1)
+    assert nums.numpy().tolist() == [len(r)]
+    # highest score first; probs sorted descending
+    assert (np.diff(p) <= 1e-6).all()
+    assert p[0] == 0.9
+    # boxes clipped inside the 16x16 image
+    assert (r >= 0).all() and (r[:, 2] <= 16).all() and (r[:, 3] <= 16).all()
+    # overlapping same-position anchors suppressed: kept boxes pairwise iou<=0.5
+    def iou(b1, b2):
+        x1, y1 = max(b1[0], b2[0]), max(b1[1], b2[1])
+        x2, y2 = min(b1[2], b2[2]), min(b1[3], b2[3])
+        inter = max(x2 - x1, 0) * max(y2 - y1, 0)
+        a1 = (b1[2] - b1[0]) * (b1[3] - b1[1])
+        a2 = (b2[2] - b2[0]) * (b2[3] - b2[1])
+        return inter / (a1 + a2 - inter)
+    for i in range(len(r)):
+        for j in range(i):
+            assert iou(r[i], r[j]) <= 0.5 + 1e-6
+
+
+def test_generate_proposals_exp_clip_and_min_size():
+    # huge positive delta must be clipped at log(1000/16); tiny boxes filtered
+    scores = np.array([[[[0.9]]]], np.float32)
+    deltas = np.array([[[[0.0]], [[0.0]], [[50.0]], [[50.0]]]], np.float32)
+    anchors = np.array([[[[0, 0, 4, 4]]]], np.float32)
+    var = np.ones((1, 1, 1, 4), np.float32)
+    img = np.array([[100.0, 100.0]], np.float32)
+    rois, probs = V.generate_proposals(
+        paddle.to_tensor(scores), paddle.to_tensor(deltas),
+        paddle.to_tensor(img), paddle.to_tensor(anchors),
+        paddle.to_tensor(var))
+    r = np.asarray(rois.numpy())
+    assert len(r) == 1 and (r[0] == [0, 0, 100, 100]).all()  # clipped to img
