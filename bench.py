@@ -43,8 +43,10 @@ def _emit_error(msg: str) -> None:
     }))
 
 
-# attempt order, largest first; _attempt_table() must define exactly these
-ATTEMPT_ORDER = ("llama-1.1b-b8", "llama-1.1b-b4", "llama-1.1b-b2",
+# Attempt order: proven-fit FIRST (land *a* number), then the bigger configs
+# that produce the better headline. The parent reports the best (highest-MFU)
+# success and lists every attempt in extra.attempts.
+ATTEMPT_ORDER = ("llama-0.5b-b8", "llama-1.1b-b8", "llama-1.1b-b4",
                  "llama-0.27b-b8", "llama-0.27b-b8-remat")
 
 
@@ -58,6 +60,15 @@ def _attempt_table():
                            num_attention_heads=16, num_key_value_heads=16,
                            max_position_embeddings=2048)
 
+    def cfg_half():
+        # ~0.5B guaranteed-fit rung: ~1.0GB bf16 params + ~4.0GB fp32 moments
+        # ≈ 5GB — comfortable headroom under the ~13GB usable HBM measured in
+        # round 2, even with activations (remat + chunked CE keep those small).
+        return LlamaConfig(vocab_size=32000, hidden_size=1536,
+                           intermediate_size=4096, num_hidden_layers=14,
+                           num_attention_heads=16, num_key_value_heads=16,
+                           max_position_embeddings=2048)
+
     def cfg_small():
         return LlamaConfig(vocab_size=32000, hidden_size=1024,
                            intermediate_size=2816, num_hidden_layers=16,
@@ -68,9 +79,9 @@ def _attempt_table():
     # loss_chunk: sequence-chunked CE (no [B,S,V] logits buffer) — the
     # 1.1B configs need it to fit ~13GB usable HBM on one v5e
     table = {
+        "llama-0.5b-b8": (cfg_half(), 8, 2048, 10, 2, True, 256),
         "llama-1.1b-b8": (cfg_1b(), 8, 2048, 10, 2, True, 256),
         "llama-1.1b-b4": (cfg_1b(), 4, 2048, 10, 2, True, 256),
-        "llama-1.1b-b2": (cfg_1b(), 2, 2048, 10, 2, True, 256),
         "llama-0.27b-b8": (cfg_small(), 8, 2048, 10, 2, False, None),
         "llama-0.27b-b8-remat": (cfg_small(), 8, 2048, 10, 2, True, 256),
     }
@@ -78,56 +89,232 @@ def _attempt_table():
     return table
 
 
-def _run_parent():
-    """Try each config in a FRESH subprocess: an OOM'd attempt leaves device
-    buffers whose release through the tunnel backend is unreliable, so
-    in-process fallback inherits the exhaustion (observed round 2)."""
+def _sub(argv, timeout):
+    """Run this file in a fresh subprocess, return (parsed-json-or-None, err)."""
     import os
     import subprocess
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), *argv],
+            capture_output=True, text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return None, "timeout"
+    line = None
+    for ln in (proc.stdout or "").splitlines():
+        if ln.startswith("{"):
+            line = ln
+    if line is None:
+        return None, f"rc={proc.returncode} {(proc.stderr or '')[-400:]}"
+    try:
+        return json.loads(line), None
+    except json.JSONDecodeError:
+        return None, f"bad json: {line[:200]}"
+
+
+def _run_probe():
+    """<60s-after-init probe tier: proves the chip answers and times the
+    kernels that matter before any training config is attempted. Each step is
+    individually guarded so one Mosaic lowering failure doesn't void the rest
+    — surfacing those failures is half the point (the Pallas kernels had
+    never run outside interpret mode before round 3)."""
+    import time as _t
+
+    out = {"ok": False, "steps": {}}
+
+    def step(name, fn):
+        t0 = _t.perf_counter()
+        try:
+            extra = fn() or {}
+            out["steps"][name] = {"ok": True,
+                                  "sec": round(_t.perf_counter() - t0, 4),
+                                  **extra}
+        except Exception as e:  # noqa: BLE001 - report, keep probing
+            out["steps"][name] = {"ok": False,
+                                  "sec": round(_t.perf_counter() - t0, 4),
+                                  "error": f"{type(e).__name__}: {e}"[:500]}
+
+    import jax
+    import jax.numpy as jnp
+
+    t0 = _t.perf_counter()
+    dev = jax.devices()[0]
+    out["init_sec"] = round(_t.perf_counter() - t0, 1)
+    out["platform"] = dev.platform
+    out["device_kind"] = getattr(dev, "device_kind", str(dev))
+    if dev.platform == "cpu":
+        out["error"] = "default backend is cpu (no TPU through tunnel)"
+        return out
+
+    def barrier(x):
+        # host fetch = true barrier (block_until_ready unreliable via tunnel)
+        return float(jnp.sum(x.astype(jnp.float32)))
+
+    def timeit(fn, iters=10):
+        barrier(fn())  # warm (compile) + sync so it can't bleed into the clock
+        t0 = _t.perf_counter()
+        for _ in range(iters):
+            r = fn()
+        barrier(r)
+        return (_t.perf_counter() - t0) / iters
+
+    def mm_probe():
+        x = jnp.ones((256, 256), jnp.bfloat16)
+        barrier(x @ x)
+        n = 4096
+        a = jax.random.normal(jax.random.PRNGKey(0), (n, n)).astype(jnp.bfloat16)
+        f = jax.jit(lambda a: a @ a)
+        dt = timeit(lambda: f(a))
+        peak, assumed = peak_flops_per_chip(dev)
+        tflops = 2 * n ** 3 / dt / 1e12
+        return {"matmul4096_us": round(dt * 1e6, 1),
+                "bf16_tflops": round(tflops, 1),
+                "frac_peak": round(tflops * 1e12 / peak, 3),
+                "peak_assumed": assumed}
+
+    b, h, s, d = 4, 16, 2048, 64
+    key = jax.random.PRNGKey(1)
+    qkv = [jax.random.normal(k, (b, h, s, d)).astype(jnp.bfloat16)
+           for k in jax.random.split(key, 3)]
+    fa_flops = 4 * b * h * s * s * d / 2  # causal ~halves the work
+
+    def flash_fwd_probe():
+        from paddle_tpu.kernels.flash_pallas import flash_attention
+        f = jax.jit(lambda q, k, v: flash_attention(q, k, v, True))
+        dt = timeit(lambda: f(*qkv))
+        return {"us": round(dt * 1e6, 1),
+                "tflops": round(fa_flops / dt / 1e12, 1),
+                "shape": f"b{b}h{h}s{s}d{d}"}
+
+    def flash_bwd_probe():
+        from paddle_tpu.kernels.flash_pallas import flash_attention
+        g = jax.jit(jax.grad(
+            lambda q, k, v: flash_attention(q, k, v, True)
+            .astype(jnp.float32).sum(), argnums=(0, 1, 2)))
+        dt = timeit(lambda: g(*qkv)[0])
+        return {"us": round(dt * 1e6, 1),
+                "tflops": round(2.5 * fa_flops / dt / 1e12, 1)}
+
+    def xla_attn_probe():
+        from paddle_tpu.kernels.flash_pallas import _reference_bhsd
+        f = jax.jit(lambda q, k, v: _reference_bhsd(q, k, v, True, None))
+        dt = timeit(lambda: f(*qkv))
+        g = jax.jit(jax.grad(
+            lambda q, k, v: _reference_bhsd(q, k, v, True, None)
+            .astype(jnp.float32).sum(), argnums=(0, 1, 2)))
+        dtb = timeit(lambda: g(*qkv)[0])
+        return {"fwd_us": round(dt * 1e6, 1), "bwd_us": round(dtb * 1e6, 1)}
+
+    def fused_probe():
+        from paddle_tpu.kernels.fused_pallas import (fused_rms_norm_pallas,
+                                                     fused_rope_pallas)
+        bb, ss, hh, dd = 8, 2048, 16, 128
+        ks = jax.random.split(jax.random.PRNGKey(2), 4)
+        q = jax.random.normal(ks[0], (bb, ss, hh, dd)).astype(jnp.bfloat16)
+        k = jax.random.normal(ks[1], (bb, ss, hh, dd)).astype(jnp.bfloat16)
+        cos = jnp.cos(jnp.arange(ss * dd // 2, dtype=jnp.float32)
+                      .reshape(ss, dd // 2))
+        sin = jnp.sin(jnp.arange(ss * dd // 2, dtype=jnp.float32)
+                      .reshape(ss, dd // 2))
+        fr = jax.jit(lambda q, k: fused_rope_pallas(q, k, cos, sin))
+        dt_rope = timeit(lambda: fr(q, k)[0])
+        x = jax.random.normal(ks[2], (bb, ss, hh * dd)).astype(jnp.bfloat16)
+        w = jnp.ones((hh * dd,), jnp.bfloat16)
+        fn = jax.jit(lambda x: fused_rms_norm_pallas(x, w))
+        dt_rms = timeit(lambda: fn(x))
+        # XLA-fused jnp versions of the same math, for the flag decision
+        def rms_jnp(x):
+            xf = x.astype(jnp.float32)
+            return (xf * jax.lax.rsqrt(jnp.mean(xf * xf, -1, keepdims=True)
+                                       + 1e-6) * w).astype(x.dtype)
+        fx = jax.jit(rms_jnp)
+        dt_rms_xla = timeit(lambda: fx(x))
+        return {"rope_us": round(dt_rope * 1e6, 1),
+                "rms_us": round(dt_rms * 1e6, 1),
+                "rms_xla_us": round(dt_rms_xla * 1e6, 1)}
+
+    def mem_probe():
+        try:
+            stats = dev.memory_stats() or {}
+            return {"bytes_limit": stats.get("bytes_limit"),
+                    "bytes_in_use": stats.get("bytes_in_use")}
+        except Exception:  # noqa: BLE001
+            return {}
+
+    step("matmul", mm_probe)
+    step("flash_fwd", flash_fwd_probe)
+    step("flash_bwd", flash_bwd_probe)
+    step("xla_attn", xla_attn_probe)
+    step("fused", fused_probe)
+    step("mem", mem_probe)
+    out["ok"] = out["steps"].get("matmul", {}).get("ok", False)
+    return out
+
+
+def _run_parent():
+    """Probe first (commit *some* hardware evidence even if training fails),
+    then the attempt ladder, each in a FRESH subprocess: an OOM'd attempt
+    leaves device buffers whose release through the tunnel backend is
+    unreliable, so in-process fallback inherits the exhaustion (round 2)."""
+    import os
+    probe, perr = _sub(["--probe"], timeout=900)
+    probe_extra = probe if probe is not None else {"error": f"probe: {perr}"}
+    try:  # persist probe evidence independently of the training ladder
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "PROBE_LATEST.json"), "w") as f:
+            json.dump(probe_extra, f, indent=1)
+    except OSError:
+        pass
+    if probe is None or not probe.get("ok"):
+        why = (perr or probe_extra.get("error")
+               or probe_extra.get("extra", {}).get("error")  # __main__ handler
+               or str(probe_extra.get("steps", {})
+                      .get("matmul", {}).get("error", "?")))
+        print(json.dumps({
+            "metric": "llama_train_tokens_per_sec_per_chip",
+            "value": 0.0, "unit": "tokens/s", "vs_baseline": 0.0,
+            "extra": {"error": f"probe tier failed: {why}"[:1500],
+                      "probe": probe_extra},
+        }))
+        sys.exit(1)
+
+    results, attempts_log = [], {}
     last_err = None
     for tag in ATTEMPT_ORDER:
-        try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "--attempt", tag],
-                capture_output=True, text=True, timeout=2700)
-        except subprocess.TimeoutExpired:
-            last_err = f"{tag}: timeout"
-            sys.stderr.write(f"bench attempt timed out — {tag}\n")
+        if tag.startswith("llama-0.27b") and results:
+            continue  # fallback rungs only needed when nothing else landed
+        if tag == "llama-1.1b-b4" and "llama-1.1b-b8" in {
+                r.get("extra", {}).get("config") for r in results}:
+            continue  # same model, half batch: can't beat b8's MFU — don't
+            # spend a scarce tunnel-up window on it
+        res, err = _sub(["--attempt", tag], timeout=2700)
+        if res is not None and res.get("value", 0) > 0:
+            results.append(res)
+            attempts_log[tag] = {"tps": res["value"],
+                                 "mfu": res.get("extra", {}).get("mfu")}
             continue
-        line = None
-        for ln in (proc.stdout or "").splitlines():
-            if ln.startswith("{"):
-                line = ln
-        if line is not None:
-            try:
-                res = json.loads(line)
-            except json.JSONDecodeError:
-                res = None
-            if res and res.get("value", 0) > 0:
-                print(line)
-                return
-            if res:
-                last_err = f"{tag}: {res.get('extra', {}).get('error', '?')}"
-                if "during backend init" in str(last_err):
-                    # the tunnel/backend is down, not an OOM: smaller
-                    # configs will hang the same way — fail fast
-                    _emit_error(f"backend init hung; tunnel down? {last_err}")
-                    sys.exit(1)
-        else:
-            last_err = (f"{tag}: rc={proc.returncode} "
-                        f"{(proc.stderr or '')[-400:]}")
+        emsg = err or (res or {}).get("extra", {}).get("error", "?")
+        attempts_log[tag] = {"error": str(emsg)[:300]}
+        last_err = f"{tag}: {emsg}"
+        if "during backend init" in str(emsg):
+            break  # tunnel died mid-ladder; smaller configs hang the same way
         sys.stderr.write(f"bench attempt failed, falling back — "
                          f"{str(last_err)[:500]}\n")
-    _emit_error(f"all bench configs failed; last: {last_err}")
-    sys.exit(1)
+    if not results:
+        _emit_error(f"all bench configs failed; last: {last_err}")
+        sys.exit(1)
+    best = max(results, key=lambda r: r.get("extra", {}).get("mfu", 0))
+    best.setdefault("extra", {})["attempts"] = attempts_log
+    best["extra"]["probe"] = probe_extra
+    print(json.dumps(best))
 
 
 def main():
     debug = "--debug" in sys.argv
+    probe = "--probe" in sys.argv
     attempt_tag = None
     if "--attempt" in sys.argv:
         attempt_tag = sys.argv[sys.argv.index("--attempt") + 1]
-    if not debug and attempt_tag is None:
+    if not debug and not probe and attempt_tag is None:
         _run_parent()
         return
     # Watchdog: a hung backend init (or compile) must surface as a JSON error
@@ -143,11 +330,22 @@ def main():
         while True:
             time.sleep(5)
             if time.monotonic() > deadline["t"]:
-                _emit_error(f"bench watchdog expired during {deadline['what']}")
+                if deadline["what"] == "probe":
+                    print(json.dumps({
+                        "ok": False,
+                        "error": "probe watchdog expired (backend init hung; "
+                                 "tunnel down?)"}))
+                else:
+                    _emit_error(
+                        f"bench watchdog expired during {deadline['what']}")
                 sys.stdout.flush()
                 os._exit(1)
 
     threading.Thread(target=_watchdog, daemon=True).start()
+    if probe:
+        deadline["what"] = "probe"
+        print(json.dumps(_run_probe()))
+        return
     import jax
     # Debug: force CPU via the config API (the axon TPU plugin ignores the
     # JAX_PLATFORMS env var). Non-debug: leave the default platform order —

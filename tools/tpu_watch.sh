@@ -1,0 +1,56 @@
+#!/bin/bash
+# TPU tunnel watcher: probe every 10 min; the moment the chip answers, commit
+# the probe evidence, then run the full bench ladder and commit its result.
+# Run in the background for the whole session (round-3 war objective: land a
+# real hardware number whenever a tunnel-up window appears).
+set -u
+cd "$(dirname "$0")/.."
+ROUND="${1:-r03}"
+LOG=tools/tpu_watch.log
+
+commit_retry() {  # survive index.lock races with the interactive session
+    for i in 1 2 3 4 5; do
+        git add -A "$@" 2>>"$LOG" && git commit -m "TPU watcher: hardware evidence ($ROUND)" -- "$@" >>"$LOG" 2>&1 && return 0
+        sleep 7
+    done
+    return 1
+}
+
+echo "[watch] start $(date -u +%FT%TZ)" >> "$LOG"
+while true; do
+    timeout 700 python bench.py --probe > /tmp/probe_out.json 2>>"$LOG"
+    if python - <<'EOF'
+import json,sys
+try:
+    lines=[l for l in open('/tmp/probe_out.json') if l.startswith('{')]
+    sys.exit(0 if lines and json.loads(lines[-1]).get('ok') else 1)
+except Exception:
+    sys.exit(1)
+EOF
+    then
+        echo "[watch] PROBE OK $(date -u +%FT%TZ)" >> "$LOG"
+        grep '^{' /tmp/probe_out.json | tail -1 > "PROBE_$ROUND.json"
+        cp "PROBE_$ROUND.json" PROBE_LATEST.json
+        commit_retry "PROBE_$ROUND.json" PROBE_LATEST.json
+        echo "[watch] running full bench ladder..." >> "$LOG"
+        timeout 14400 python bench.py > /tmp/bench_out.json 2>>"$LOG"
+        grep '^{' /tmp/bench_out.json | tail -1 > "BENCH_SESSION_$ROUND.json"
+        echo "[watch] bench done $(date -u +%FT%TZ): $(cat BENCH_SESSION_$ROUND.json)" >> "$LOG"
+        commit_retry "BENCH_SESSION_$ROUND.json" "PROBE_$ROUND.json" PROBE_LATEST.json
+        # success with a real number -> stop; else keep watching
+        if BFILE="BENCH_SESSION_$ROUND.json" python - <<'EOF'
+import json,os,sys
+try:
+    sys.exit(0 if json.load(open(os.environ["BFILE"])).get("value",0)>0 else 1)
+except Exception:
+    sys.exit(1)
+EOF
+        then
+            echo "[watch] SUCCESS, exiting" >> "$LOG"
+            exit 0
+        fi
+    else
+        echo "[watch] probe failed $(date -u +%FT%TZ)" >> "$LOG"
+    fi
+    sleep 600
+done
