@@ -285,11 +285,15 @@ class PipelineParallel(MetaParallelBase):
             # interleave via pp config / virtual stages)
             cfg = (self._strategy.pipeline_configs
                    if self._strategy else {}) or {}
-            sched = str(cfg.get("schedule_mode", "circular")).lower()
+            # defaults match the reference: schedule_mode="1F1B", vpp_degree=1
+            # (fleet/base/distributed_strategy.py pipeline_configs)
+            sched = str(cfg.get("schedule_mode", "1f1b")).lower()
             sched = {"f-then-b": "circular", "fthenb": "circular",
                      "1f1b": "1f1b", "vpp": "vpp",
                      "interleave": "interleave"}.get(sched, sched)
-            vpp = int(cfg.get("vpp_degree", 2))
+            vpp = int(cfg.get("vpp_degree", 1))
+            if vpp <= 1 and sched in ("vpp", "interleave"):
+                vpp = 2  # these schedules are meaningless without >1 chunk
             key = (id(inner), id(mesh), max(self.accumulate_steps, 1),
                    sched, vpp)
             if self._pp_trainer is None or self._pp_key != key:

@@ -343,7 +343,14 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
         end2 = jnp.where(llen_a > 0, end2, _NEG)
         nll = -jnp.logaddexp(end1, end2)
         if norm_by_times:
-            nll = nll / jnp.maximum(ilen_a.astype(jnp.float32), 1.0)
+            # The reference warpctc kernel normalizes only the GRADIENT by the
+            # per-sample time-step count; the reported forward loss stays
+            # unscaled (phi/kernels/impl/warpctc_kernel_impl.h). value(x) +
+            # scale*(x - stop_grad(x)) keeps the forward value while scaling
+            # the gradient.
+            inv_t = 1.0 / jnp.maximum(ilen_a.astype(jnp.float32), 1.0)
+            nll = jax.lax.stop_gradient(nll) + inv_t * (
+                nll - jax.lax.stop_gradient(nll))
         if reduction == "mean":
             # reference 'mean' = mean(loss / label_lengths)
             # (python/paddle/nn/functional/loss.py ctc_loss)
@@ -360,10 +367,11 @@ def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
     resolves the within-frame emission chain with a nested scan over U.
 
     input: [B, T, U+1, V] joint-network logits (log-softmaxed internally);
-    label: [B, U] int. fastemit_lambda only rescales emission *gradients* in
-    the reference's warprnnt backend (the reported loss value is the plain
-    negative log-likelihood), so the loss value here matches the reference
-    for all lambda; that gradient rescaling itself is not applied.
+    label: [B, U] int. fastemit_lambda rescales emission *gradients* by
+    (1 + lambda) as in the reference's warprnnt backend — the reported loss
+    value is the plain negative log-likelihood for all lambda; only the
+    backward pass sees the FastEmit scaling (applied to dL/d(log p_emit)
+    before it chains through the log-softmax).
     """
     it, lt = ensure_tensor(input), ensure_tensor(label)
     ilen, llen = ensure_tensor(input_lengths), ensure_tensor(label_lengths)
@@ -376,6 +384,12 @@ def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
         blank_lp = x[..., blank]                       # [B, T, U+1]
         emit_lp = jnp.take_along_axis(
             x[:, :, :U, :], lab_a[:, None, :, None], axis=3)[..., 0]  # [B,T,U]
+        if fastemit_lambda:
+            # FastEmit: emission gradients scaled by (1 + lambda), loss value
+            # unchanged — same-value different-gradient identity as above.
+            lam = float(fastemit_lambda)
+            emit_lp = emit_lp * (1.0 + lam) - \
+                jax.lax.stop_gradient(emit_lp) * lam
         u_ok = jnp.arange(U)[None, :] < llen_a[:, None]               # [B, U]
 
         def emit_chain(base, emit_t):

@@ -1,138 +1,149 @@
 """L-BFGS optimizer (closure-based full-batch quasi-Newton).
 
-Reference parity: paddle.optimizer.LBFGS (python/paddle/optimizer/lbfgs.py —
-itself the torch-style implementation: two-loop recursion over a bounded
-(s, y) history + optional strong-Wolfe cubic line search). Host-side Python
-control flow is the right shape on TPU too: every iteration re-evaluates the
-user closure (which may itself be jitted); the optimizer math is O(history)
-vector ops.
+Reference parity: paddle.optimizer.LBFGS capability (python/paddle/optimizer/
+lbfgs.py — two-loop recursion over a bounded (s, y) history + optional
+strong-Wolfe line search). Host-side Python control flow is the right shape
+on TPU too: every iteration re-evaluates the user closure (which may itself
+be jitted); the optimizer math is O(history) vector ops.
+
+The line search is Nocedal & Wright Algorithms 3.5/3.6 (bracket, then zoom
+with Hermite-cubic candidates), with the safeguards every practical
+implementation needs: bounded extrapolation during bracketing, a
+stay-inside-the-bracket nudge during zoom, and bisection when the cubic has
+no real minimizer. It is organized around a small point record (`_Pt`)
+rather than parallel arrays.
 """
 from __future__ import annotations
+
+import dataclasses
 
 import jax.numpy as jnp
 
 import numpy as np
 
 
-def _cubic_interpolate(x1, f1, g1, x2, f2, g2, bounds=None):
-    """Minimizer of the cubic through (x1,f1,g1),(x2,f2,g2) (torch/paddle
-    _cubic_interpolate)."""
-    if bounds is not None:
-        xmin_bound, xmax_bound = bounds
-    else:
-        xmin_bound, xmax_bound = (x1, x2) if x1 <= x2 else (x2, x1)
-    d1 = g1 + g2 - 3 * (f1 - f2) / (x1 - x2)
-    d2_square = d1 ** 2 - g1 * g2
-    if d2_square >= 0:
-        d2 = d2_square ** 0.5
-        if x1 <= x2:
-            min_pos = x2 - (x2 - x1) * ((g2 + d2 - d1) / (g2 - g1 + 2 * d2))
-        else:
-            min_pos = x1 - (x1 - x2) * ((g1 + d2 - d1) / (g1 - g2 + 2 * d2))
-        return min(max(min_pos, xmin_bound), xmax_bound)
-    return (xmin_bound + xmax_bound) / 2.0
+@dataclasses.dataclass
+class _Pt:
+    """One line-search evaluation: position t along d, value, directional
+    derivative, and the full gradient at that point."""
+    t: float
+    val: float
+    slope: float
+    grad: object = None
+
+
+def _cubic_min(a: _Pt, b: _Pt, lo_bound=None, hi_bound=None) -> float:
+    """Minimizer of the Hermite cubic fitted to two (t, val, slope) records,
+    clamped to [lo_bound, hi_bound] (defaults: the span of a and b). Falls
+    back to the midpoint when the cubic has no real stationary minimum."""
+    if lo_bound is None:
+        lo_bound, hi_bound = sorted((a.t, b.t))
+    theta = a.slope + b.slope - 3 * (a.val - b.val) / (a.t - b.t)
+    disc = theta * theta - a.slope * b.slope
+    if disc < 0:
+        return 0.5 * (lo_bound + hi_bound)
+    gamma = disc ** 0.5
+    # express the root relative to the rightmost point so the formula is
+    # branch-free after ordering
+    lo, hi = (a, b) if a.t <= b.t else (b, a)
+    span = hi.t - lo.t
+    tstar = hi.t - span * (hi.slope + gamma - theta) / (
+        hi.slope - lo.slope + 2 * gamma)
+    return min(max(tstar, lo_bound), hi_bound)
 
 
 def _strong_wolfe(obj_func, x, t, d, f, g, gtd, c1=1e-4, c2=0.9,
                   tolerance_change=1e-9, max_ls=25):
-    """Strong-Wolfe line search (torch/paddle _strong_wolfe). obj_func(x, t, d)
-    -> (f, g) at x + t*d. Returns (f_new, g_new, t, ls_func_evals)."""
-    d_norm = float(jnp.max(jnp.abs(d)))
-    g = jnp.array(g)
-    f_new, g_new = obj_func(x, t, d)
-    ls_func_evals = 1
-    gtd_new = float(jnp.dot(g_new, d))
+    """Strong-Wolfe line search. obj_func(x, t, d) -> (f, g) at x + t*d.
+    Returns (f_new, g_new, t, n_evals).
 
-    t_prev, f_prev, g_prev, gtd_prev = 0.0, f, g, gtd
-    done = False
-    ls_iter = 0
-    bracket = bracket_f = bracket_g = bracket_gtd = None
-    while ls_iter < max_ls:
-        if f_new > (f + c1 * t * gtd) or (ls_iter > 1 and f_new >= f_prev):
-            bracket = [t_prev, t]
-            bracket_f = [f_prev, f_new]
-            bracket_g = [g_prev, jnp.array(g_new)]
-            bracket_gtd = [gtd_prev, gtd_new]
-            break
-        if abs(gtd_new) <= -c2 * gtd:
-            bracket = [t, t]
-            bracket_f = [f_new, f_new]
-            bracket_g = [jnp.array(g_new), jnp.array(g_new)]
-            done = True
-            break
-        if gtd_new >= 0:
-            bracket = [t_prev, t]
-            bracket_f = [f_prev, f_new]
-            bracket_g = [g_prev, jnp.array(g_new)]
-            bracket_gtd = [gtd_prev, gtd_new]
-            break
-        min_step = t + 0.01 * (t - t_prev)
-        max_step = t * 10
-        tmp = t
-        t = _cubic_interpolate(t_prev, f_prev, gtd_prev, t, f_new, gtd_new,
-                               bounds=(min_step, max_step))
-        t_prev, f_prev, g_prev, gtd_prev = tmp, f_new, jnp.array(g_new), gtd_new
-        f_new, g_new = obj_func(x, t, d)
-        ls_func_evals += 1
-        gtd_new = float(jnp.dot(g_new, d))
-        ls_iter += 1
+    Phase 1 walks t forward (bounded cubic extrapolation) until it brackets
+    a Wolfe point or satisfies both conditions outright; phase 2 shrinks the
+    bracket with safeguarded cubic steps. `lo` always holds the best
+    Armijo-satisfying end of the bracket, `hi` the other end.
+    """
+    scale = float(jnp.max(jnp.abs(d)))  # converts |Δt| to a parameter delta
 
-    if ls_iter == max_ls:
-        bracket = [0.0, t]
-        bracket_f = [f, f_new]
-        bracket_g = [g, jnp.array(g_new)]
-        bracket_gtd = [gtd, gtd_new]
+    def probe(step):
+        val, grad = obj_func(x, step, d)
+        return _Pt(step, val, float(jnp.dot(grad, d)), jnp.array(grad))
 
-    # zoom phase
-    insuf_progress = False
-    low_pos, high_pos = (0, 1) if bracket_f[0] <= bracket_f[-1] else (1, 0)
-    while not done and ls_iter < max_ls:
-        if abs(bracket[1] - bracket[0]) * d_norm < tolerance_change:
+    def armijo_ok(p):
+        return p.val <= f + c1 * p.t * gtd
+
+    def curvature_ok(p):
+        return abs(p.slope) <= -c2 * gtd
+
+    origin = _Pt(0.0, f, gtd, jnp.array(g))
+    prev, cur = origin, probe(t)
+    evals = 1
+    lo = hi = None
+    satisfied = False
+
+    # -- phase 1: bracket ----------------------------------------------------
+    rounds = 0
+    while rounds < max_ls:
+        if not armijo_ok(cur) or (rounds > 1 and cur.val >= prev.val):
+            lo, hi = prev, cur          # minimum is between them
             break
-        t = _cubic_interpolate(bracket[0], bracket_f[0], bracket_gtd[0],
-                               bracket[1], bracket_f[1], bracket_gtd[1])
-        eps = 0.1 * (max(bracket) - min(bracket))
-        if min(max(bracket) - t, t - min(bracket)) < eps:
-            if insuf_progress or t >= max(bracket) or t <= min(bracket):
-                if abs(t - max(bracket)) < abs(t - min(bracket)):
-                    t = max(bracket) - eps
-                else:
-                    t = min(bracket) + eps
-                insuf_progress = False
+        if curvature_ok(cur):
+            lo, hi = cur, cur
+            satisfied = True
+            break
+        if cur.slope >= 0:
+            lo, hi = prev, cur          # slope changed sign inside (prev, cur)
+            break
+        # still descending: extrapolate, at least 1% past cur, at most 10x
+        nxt = _cubic_min(prev, cur,
+                         lo_bound=cur.t + 0.01 * (cur.t - prev.t),
+                         hi_bound=cur.t * 10)
+        prev, cur = cur, probe(nxt)
+        evals += 1
+        rounds += 1
+    else:
+        lo, hi = origin, cur            # exhausted: whole walked range
+
+    if lo.val > hi.val:
+        lo, hi = hi, lo
+
+    # -- phase 2: zoom -------------------------------------------------------
+    nudged_last = False
+    while not satisfied and rounds < max_ls:
+        width = abs(hi.t - lo.t)
+        if width * scale < tolerance_change:
+            break
+        cand = _cubic_min(lo, hi)
+        # Keep candidates a safe margin inside the bracket. A candidate within
+        # 10% of either edge is accepted once (progress may be genuine), but a
+        # second consecutive edge-hugger — or one at/outside the bracket — is
+        # pulled to the margin, guaranteeing the interval keeps shrinking.
+        left, right = min(lo.t, hi.t), max(lo.t, hi.t)
+        margin = 0.1 * width
+        if min(right - cand, cand - left) < margin:
+            if nudged_last or cand >= right or cand <= left:
+                cand = (right - margin if abs(cand - right) < abs(cand - left)
+                        else left + margin)
+                nudged_last = False
             else:
-                insuf_progress = True
+                nudged_last = True
         else:
-            insuf_progress = False
+            nudged_last = False
 
-        f_new, g_new = obj_func(x, t, d)
-        ls_func_evals += 1
-        gtd_new = float(jnp.dot(g_new, d))
-        ls_iter += 1
-
-        if f_new > (f + c1 * t * gtd) or f_new >= bracket_f[low_pos]:
-            bracket[high_pos] = t
-            bracket_f[high_pos] = f_new
-            bracket_g[high_pos] = jnp.array(g_new)
-            bracket_gtd[high_pos] = gtd_new
-            low_pos, high_pos = ((0, 1) if bracket_f[0] <= bracket_f[1]
-                                 else (1, 0))
+        p = probe(cand)
+        evals += 1
+        rounds += 1
+        if not armijo_ok(p) or p.val >= lo.val:
+            hi = p                      # too high: shrink toward lo
+            if lo.val > hi.val:
+                lo, hi = hi, lo         # keep lo = lowest value seen
         else:
-            if abs(gtd_new) <= -c2 * gtd:
-                done = True
-            elif gtd_new * (bracket[high_pos] - bracket[low_pos]) >= 0:
-                bracket[high_pos] = bracket[low_pos]
-                bracket_f[high_pos] = bracket_f[low_pos]
-                bracket_g[high_pos] = bracket_g[low_pos]
-                bracket_gtd[high_pos] = bracket_gtd[low_pos]
-            bracket[low_pos] = t
-            bracket_f[low_pos] = f_new
-            bracket_g[low_pos] = jnp.array(g_new)
-            bracket_gtd[low_pos] = gtd_new
+            if curvature_ok(p):
+                satisfied = True
+            elif p.slope * (hi.t - lo.t) >= 0:
+                hi = lo                 # minimum is on lo's other side
+            lo = p
 
-    t = bracket[low_pos]
-    f_new = bracket_f[low_pos]
-    g_new = bracket_g[low_pos]
-    return f_new, g_new, t, ls_func_evals
+    return lo.val, lo.grad, lo.t, evals
 
 
 class LBFGS:

@@ -346,7 +346,8 @@ def pca_lowrank(x, q=None, center=True, niter=2, name=None):
     if center:
         a = a - jnp.mean(a, axis=-2, keepdims=True)
     u, s_, vt = jnp.linalg.svd(a, full_matrices=False)
-    return Tensor(u[..., :q]), Tensor(s_[..., :q]),         Tensor(jnp.swapaxes(vt, -1, -2)[..., :q])
+    return (Tensor(u[..., :q]), Tensor(s_[..., :q]),
+            Tensor(jnp.swapaxes(vt, -1, -2)[..., :q]))
 
 
 def add(x, y):
@@ -411,10 +412,12 @@ def _coo_binary(name, x, y, fn):
     fy = jnp.ravel_multi_index(tuple(yc.indices._data), shape, mode="clip")
     uni = jnp.unique(jnp.concatenate([fx, fy]))
     n = uni.shape[0]
-    vx = jnp.zeros((n,) + xc.values._data.shape[1:], jnp.float32)         .at[jnp.searchsorted(uni, fx)].set(
-            xc.values._data.astype(jnp.float32))
-    vy = jnp.zeros((n,) + yc.values._data.shape[1:], jnp.float32)         .at[jnp.searchsorted(uni, fy)].set(
-            yc.values._data.astype(jnp.float32))
+    vx = (jnp.zeros((n,) + xc.values._data.shape[1:], jnp.float32)
+          .at[jnp.searchsorted(uni, fx)]
+          .set(xc.values._data.astype(jnp.float32)))
+    vy = (jnp.zeros((n,) + yc.values._data.shape[1:], jnp.float32)
+          .at[jnp.searchsorted(uni, fy)]
+          .set(yc.values._data.astype(jnp.float32)))
     vals = fn(vx, vy).astype(xc.values._data.dtype)
     idx = jnp.stack(jnp.unravel_index(uni, shape))
     return SparseCooTensor(Tensor(idx), Tensor(vals), x.shape,

@@ -304,3 +304,49 @@ def test_crf_decoding_alias():
     s2, p2 = paddle.text.crf_decoding(pot, trans, lens)
     np.testing.assert_allclose(s1.numpy(), s2.numpy())
     np.testing.assert_array_equal(p1.numpy(), p2.numpy())
+
+
+def test_ctc_norm_by_times_value_unscaled_grad_scaled():
+    """norm_by_times must leave the forward loss unscaled (warpctc only
+    normalizes gradients by the time-step count)."""
+    T, B, C = 6, 2, 4
+    x = RNG.normal(size=(T, B, C)).astype(np.float32)
+    lab = paddle.to_tensor([[1, 2], [3, 1]])
+    ilen, llen = paddle.to_tensor([6, 4]), paddle.to_tensor([2, 2])
+
+    def run(norm):
+        t = paddle.to_tensor(x)
+        t.stop_gradient = False
+        loss = F.ctc_loss(t, lab, ilen, llen, reduction="none",
+                          norm_by_times=norm)
+        loss.sum().backward()
+        return np.asarray(loss.numpy()).reshape(-1), t.grad.numpy()
+
+    v0, g0 = run(False)
+    v1, g1 = run(True)
+    np.testing.assert_allclose(v0, v1, rtol=1e-6)          # value unchanged
+    # per-sample gradient scaled by 1/input_length
+    np.testing.assert_allclose(g1[:, 0], g0[:, 0] / 6.0, rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(g1[:, 1], g0[:, 1] / 4.0, rtol=1e-5, atol=1e-7)
+
+
+def test_rnnt_fastemit_value_unchanged_grad_scaled():
+    """FastEmit rescales emission gradients by (1+lambda); the loss value is
+    the plain NLL for any lambda."""
+    B, T, U, V = 1, 3, 2, 4
+    x = RNG.normal(size=(B, T, U + 1, V)).astype(np.float32)
+    lab = paddle.to_tensor(RNG.integers(1, V, size=(B, U)).astype(np.int32))
+    ilen, llen = paddle.to_tensor([T]), paddle.to_tensor([U])
+
+    def run(lam):
+        t = paddle.to_tensor(x)
+        t.stop_gradient = False
+        loss = F.rnnt_loss(t, lab, ilen, llen, fastemit_lambda=lam,
+                           reduction="sum")
+        loss.backward()
+        return float(loss.numpy()), t.grad.numpy()
+
+    v0, g0 = run(0.0)
+    v1, g1 = run(0.5)
+    np.testing.assert_allclose(v0, v1, rtol=1e-6)          # value unchanged
+    assert np.abs(g1 - g0).max() > 1e-6                    # gradients differ

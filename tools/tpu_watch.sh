@@ -33,7 +33,7 @@ EOF
         cp "PROBE_$ROUND.json" PROBE_LATEST.json
         commit_retry "PROBE_$ROUND.json" PROBE_LATEST.json
         echo "[watch] running full bench ladder..." >> "$LOG"
-        timeout 14400 python bench.py > /tmp/bench_out.json 2>>"$LOG"
+        timeout 14400 python bench.py --skip-probe > /tmp/bench_out.json 2>>"$LOG"
         grep '^{' /tmp/bench_out.json | tail -1 > "BENCH_SESSION_$ROUND.json"
         echo "[watch] bench done $(date -u +%FT%TZ): $(cat BENCH_SESSION_$ROUND.json)" >> "$LOG"
         commit_retry "BENCH_SESSION_$ROUND.json" "PROBE_$ROUND.json" PROBE_LATEST.json
