@@ -260,17 +260,20 @@ def _run_parent():
     leaves device buffers whose release through the tunnel backend is
     unreliable, so in-process fallback inherits the exhaustion (round 2)."""
     import os
-    here = os.path.dirname(os.path.abspath(__file__))
+    here = os.environ.get("BENCH_ARTIFACT_DIR") or os.path.dirname(
+        os.path.abspath(__file__))
     if "--skip-probe" in sys.argv:
         # caller (e.g. tools/tpu_watch.sh) just proved the chip with its own
-        # probe — don't burn the window on a duplicate init+compile pass
+        # probe — don't burn the window on a duplicate init+compile pass.
+        # A saved record must say ok:true explicitly; anything else (stale
+        # error records are bench-shaped, no "ok" key) fails the gate.
+        perr = None
         try:
             with open(os.path.join(here, "PROBE_LATEST.json")) as f:
                 probe = json.load(f)
         except (OSError, json.JSONDecodeError):
-            probe = {"ok": True, "skipped": True}
+            probe = {"ok": True, "skipped": True}  # no record: trust caller
         probe_extra = probe
-        probe.setdefault("ok", True)
     else:
         probe, perr = _sub(["--probe"], timeout=1800)
         probe_extra = probe if probe is not None else {"error": f"probe: {perr}"}
@@ -311,7 +314,9 @@ def _run_parent():
         attempts_log[tag] = {"error": str(emsg)[:300]}
         last_err = f"{tag}: {emsg}"
         if "during backend init" in str(emsg):
-            break  # tunnel died mid-ladder; smaller configs hang the same way
+            # tunnel died mid-ladder; smaller configs hang the same way
+            last_err = f"backend init hung; tunnel down? {last_err}"
+            break
         sys.stderr.write(f"bench attempt failed, falling back — "
                          f"{str(last_err)[:500]}\n")
     if not results:
@@ -345,7 +350,7 @@ def main():
         while True:
             time.sleep(5)
             if time.monotonic() > deadline["t"]:
-                if deadline["what"] == "probe":
+                if deadline["what"].startswith("probe"):
                     print(json.dumps({
                         "ok": False,
                         "error": "probe watchdog expired (backend init hung; "

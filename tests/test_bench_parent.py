@@ -3,8 +3,12 @@ import json
 import subprocess
 import sys
 
+import pytest
+
 sys.path.insert(0, ".")
 import bench
+
+PROBE_OK = json.dumps({"ok": True, "platform": "tpu", "steps": {}}) + "\n"
 
 
 class FakeProc:
@@ -14,16 +18,25 @@ class FakeProc:
         self.returncode = rc
 
 
-def test_parent_picks_first_succeeding_attempt(monkeypatch, capsys):
+@pytest.fixture(autouse=True)
+def _artifact_dir(tmp_path, monkeypatch):
+    # keep PROBE_LATEST.json out of the repo root during tests
+    monkeypatch.setenv("BENCH_ARTIFACT_DIR", str(tmp_path))
+
+
+def test_parent_picks_best_attempt_and_skips_fallbacks(monkeypatch, capsys):
     calls = []
 
     def fake_run(cmd, **kw):
+        if "--probe" in cmd:
+            return FakeProc(PROBE_OK)
         tag = cmd[cmd.index("--attempt") + 1]
         calls.append(tag)
         if tag == bench.ATTEMPT_ORDER[2]:
-            return FakeProc(json.dumps({"metric": "m", "value": 123.0,
-                                        "unit": "tokens/s",
-                                        "vs_baseline": 0.5}) + "\n")
+            return FakeProc(json.dumps(
+                {"metric": "m", "value": 123.0, "unit": "tokens/s",
+                 "vs_baseline": 0.5,
+                 "extra": {"mfu": 0.25, "config": tag}}) + "\n")
         return FakeProc(json.dumps({"metric": "m", "value": 0.0,
                                     "extra": {"error": "RESOURCE_EXHAUSTED"}})
                         + "\n", rc=1)
@@ -31,42 +44,121 @@ def test_parent_picks_first_succeeding_attempt(monkeypatch, capsys):
     monkeypatch.setattr(subprocess, "run", fake_run)
     bench._run_parent()
     out = capsys.readouterr().out.strip().splitlines()[-1]
-    assert json.loads(out)["value"] == 123.0
+    res = json.loads(out)
+    assert res["value"] == 123.0
+    # ladder ran the non-fallback rungs; 0.27b fallbacks skipped on success
     assert calls == list(bench.ATTEMPT_ORDER[:3])
+    assert res["extra"]["attempts"][bench.ATTEMPT_ORDER[0]]["error"]
 
 
-def test_parent_fails_fast_when_backend_init_hangs(monkeypatch, capsys):
-    calls = []
+def test_parent_prefers_higher_mfu_over_first_success(monkeypatch, capsys):
+    def fake_run(cmd, **kw):
+        if "--probe" in cmd:
+            return FakeProc(PROBE_OK)
+        tag = cmd[cmd.index("--attempt") + 1]
+        mfu = {bench.ATTEMPT_ORDER[0]: 0.3, bench.ATTEMPT_ORDER[1]: 0.4}.get(tag)
+        if mfu is None:
+            return FakeProc(json.dumps({"metric": "m", "value": 0.0,
+                                        "extra": {"error": "OOM"}}) + "\n", 1)
+        return FakeProc(json.dumps(
+            {"metric": "m", "value": 100.0 * mfu, "unit": "tokens/s",
+             "vs_baseline": mfu / 0.5,
+             "extra": {"mfu": mfu, "config": tag}}) + "\n")
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    bench._run_parent()
+    res = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert res["extra"]["config"] == bench.ATTEMPT_ORDER[1]  # best MFU wins
+    # 1.1b-b4 skipped once 1.1b-b8 landed; fallbacks skipped too
+    assert set(res["extra"]["attempts"]) == set(bench.ATTEMPT_ORDER[:2])
+
+
+def test_parent_fails_fast_when_probe_fails(monkeypatch, capsys):
+    attempts = []
 
     def fake_run(cmd, **kw):
-        calls.append(1)
+        if "--probe" in cmd:
+            return FakeProc(json.dumps(
+                {"ok": False, "error": "probe watchdog expired (backend init "
+                                       "hung; tunnel down?)"}) + "\n")
+        attempts.append(1)
+        raise AssertionError("no attempt should run after a failed probe")
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    with pytest.raises(SystemExit):
+        bench._run_parent()
+    assert not attempts
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    assert "probe tier failed" in json.loads(out)["extra"]["error"]
+
+
+def test_parent_stops_ladder_when_backend_init_hangs(monkeypatch, capsys):
+    attempts = []
+
+    def fake_run(cmd, **kw):
+        if "--probe" in cmd:
+            return FakeProc(PROBE_OK)
+        attempts.append(1)
         return FakeProc(json.dumps(
             {"metric": "m", "value": 0.0,
              "extra": {"error": "bench watchdog expired during backend init"}})
             + "\n", rc=1)
 
     monkeypatch.setattr(subprocess, "run", fake_run)
-    try:
+    with pytest.raises(SystemExit):
         bench._run_parent()
-        raise AssertionError("expected SystemExit")
-    except SystemExit:
-        pass
-    assert len(calls) == 1  # no pointless retries against a dead tunnel
+    assert len(attempts) == 1  # no pointless retries against a dead tunnel
     out = capsys.readouterr().out.strip().splitlines()[-1]
     assert "tunnel down" in json.loads(out)["extra"]["error"]
 
 
 def test_parent_reports_all_failed(monkeypatch, capsys):
     def fake_run(cmd, **kw):
+        if "--probe" in cmd:
+            return FakeProc(PROBE_OK)
         return FakeProc(json.dumps({"metric": "m", "value": 0.0,
                                     "extra": {"error": "OOM"}}) + "\n", rc=1)
 
     monkeypatch.setattr(subprocess, "run", fake_run)
-    try:
+    with pytest.raises(SystemExit):
         bench._run_parent()
-        raise AssertionError("expected SystemExit")
-    except SystemExit:
-        pass
     out = capsys.readouterr().out.strip().splitlines()[-1]
     res = json.loads(out)
     assert res["value"] == 0.0 and "OOM" in res["extra"]["error"]
+
+
+def test_parent_skip_probe_uses_saved_probe(monkeypatch, capsys, tmp_path):
+    (tmp_path / "PROBE_LATEST.json").write_text(
+        json.dumps({"ok": True, "platform": "tpu", "device_kind": "v5e"}))
+
+    def fake_run(cmd, **kw):
+        assert "--probe" not in cmd
+        tag = cmd[cmd.index("--attempt") + 1]
+        return FakeProc(json.dumps(
+            {"metric": "m", "value": 50.0, "unit": "tokens/s",
+             "vs_baseline": 0.2, "extra": {"mfu": 0.1, "config": tag}}) + "\n")
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    monkeypatch.setattr(sys, "argv", ["bench.py", "--skip-probe"])
+    bench._run_parent()
+    res = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert res["value"] == 50.0
+    assert res["extra"]["probe"]["device_kind"] == "v5e"
+
+
+def test_parent_skip_probe_rejects_stale_error_record(monkeypatch, capsys,
+                                                      tmp_path):
+    # bench-shaped error records (no "ok" key) must fail the skip-probe gate
+    (tmp_path / "PROBE_LATEST.json").write_text(
+        json.dumps({"metric": "m", "value": 0.0,
+                    "extra": {"error": "RESOURCE_EXHAUSTED"}}))
+
+    def fake_run(cmd, **kw):
+        raise AssertionError("no subprocess should run")
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    monkeypatch.setattr(sys, "argv", ["bench.py", "--skip-probe"])
+    with pytest.raises(SystemExit):
+        bench._run_parent()
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    assert "probe tier failed" in json.loads(out)["extra"]["error"]

@@ -18,7 +18,7 @@ commit_retry() {  # survive index.lock races with the interactive session
 
 echo "[watch] start $(date -u +%FT%TZ)" >> "$LOG"
 while true; do
-    timeout 700 python bench.py --probe > /tmp/probe_out.json 2>>"$LOG"
+    timeout 1800 python bench.py --probe > /tmp/probe_out.json 2>>"$LOG"
     if python - <<'EOF'
 import json,sys
 try:
