@@ -271,6 +271,8 @@ def _run_parent():
         try:
             with open(os.path.join(here, "PROBE_LATEST.json")) as f:
                 probe = json.load(f)
+            if not isinstance(probe, dict):
+                probe = {"ok": False, "error": "saved probe record not a dict"}
         except (OSError, json.JSONDecodeError):
             probe = {"ok": True, "skipped": True}  # no record: trust caller
         probe_extra = probe
