@@ -105,6 +105,7 @@ from . import amp  # noqa: F401
 from . import jit  # noqa: F401
 from . import distributed  # noqa: F401
 from . import vision  # noqa: F401
+from . import distribution  # noqa: F401
 from . import device  # noqa: F401
 from . import metric  # noqa: F401
 from . import text  # noqa: F401

@@ -1,11 +1,16 @@
 """Probability distributions.
 
-Reference parity: python/paddle/distribution/ (Distribution base with
-sample/rsample/log_prob/entropy/kl_divergence, Normal, Uniform, Bernoulli,
-Categorical, Beta, Gamma, Dirichlet, Exponential, Laplace, LogNormal,
-Multinomial, kl_divergence registry). TPU-native: sampling draws from the
-framework PRNG (framework.random.next_key), so compiled programs get their
-randomness from the per-step key like every other random op.
+Reference parity: python/paddle/distribution/ — Distribution/
+ExponentialFamily bases; Normal, LogNormal, Uniform, Bernoulli, Categorical,
+Exponential, Laplace, Gamma, Beta, Dirichlet, Multinomial, Poisson, Binomial,
+Geometric, Gumbel, Cauchy, Chi2, StudentT, ContinuousBernoulli,
+MultivariateNormal, LKJCholesky; Independent + TransformedDistribution and
+the full Transform set (transform.py); kl_divergence registry with
+MRO-aware dispatch and the generic exponential-family Bregman rule.
+TPU-native: sampling draws from the framework PRNG
+(framework.random.next_key), so compiled programs get their randomness from
+the per-step key like every other random op; densities and transforms are
+pure jnp and trace into compiled programs.
 """
 from __future__ import annotations
 
@@ -28,10 +33,91 @@ def _shape(sample_shape, batch_shape):
     return tuple(int(s) for s in sample_shape) + tuple(batch_shape)
 
 
+# differentiable surface: methods/properties routed through ops.dispatch so
+# gradients flow from log_prob/rsample/... back to Tensor-valued parameters
+# (the reference's distributions are built from tracked paddle ops and get
+# this for free; here the tape must be told explicitly)
+_DIFF_METHODS = ("log_prob", "rsample", "cdf", "icdf", "entropy", "pmf")
+_DIFF_PROPS = ("mean", "variance", "stddev")
+
+
+def _ctor_tensors(ctor):
+    args, kwargs = ctor
+    return [a for a in (*args, *kwargs.values())
+            if isinstance(a, Tensor) and not a.stop_gradient
+            and jnp.issubdtype(a._data.dtype, jnp.inexact)]
+
+
+def _rebuild_ctor(ctor, arrays):
+    """Replace each tracked Tensor in the ctor args with the next array."""
+    it = iter(arrays)
+
+    def sub(a):
+        if isinstance(a, Tensor) and not a.stop_gradient \
+                and jnp.issubdtype(a._data.dtype, jnp.inexact):
+            return next(it)
+        return a
+
+    args, kwargs = ctor
+    return tuple(sub(a) for a in args), {k: sub(v) for k, v in
+                                         kwargs.items()}
+
+
+def _diff_route(cls, name, orig, is_prop):
+    def wrapped(self, *args):
+        from ..autograd.tape import is_grad_enabled
+        from ..ops.dispatch import dispatch
+        ctor = getattr(self, "_ctor", None)
+        params = _ctor_tensors(ctor) if ctor is not None else []
+        t_args = [a for a in args if isinstance(a, Tensor)]
+        if not params or not is_grad_enabled():
+            return orig(self, *args) if not is_prop else orig.fget(self)
+
+        def fwd(*arrays):
+            pv = arrays[:len(params)]
+            av = list(arrays[len(params):])
+            na, nk = _rebuild_ctor(ctor, pv)
+            clone = object.__new__(type(self))
+            type(self).__init__(clone, *na, **nk)
+            new_args = [av.pop(0) if isinstance(a, Tensor) else a
+                        for a in args]
+            out = (orig(clone, *new_args) if not is_prop
+                   else orig.fget(clone))
+            return out._data
+
+        return dispatch(f"dist_{cls.__name__}.{name}", fwd, *params, *t_args)
+
+    if is_prop:
+        return property(wrapped)
+    return wrapped
+
+
 class Distribution:
     def __init__(self, batch_shape=(), event_shape=()):
         self._batch_shape = tuple(batch_shape)
         self._event_shape = tuple(event_shape)
+
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        if "__init__" in cls.__dict__:
+            orig_init = cls.__dict__["__init__"]
+
+            def init(self, *a, _orig=orig_init, **k):
+                outermost = not hasattr(self, "_ctor")
+                if outermost:  # nested super().__init__ must not overwrite
+                    self._ctor = (a, k)
+                _orig(self, *a, **k)
+
+            cls.__init__ = init
+        for m in _DIFF_METHODS:
+            if m in cls.__dict__:
+                cls.__dict__[m]._undiff = True  # marker: original math
+                setattr(cls, m, _diff_route(cls, m, cls.__dict__[m], False))
+        for m in _DIFF_PROPS:
+            p = cls.__dict__.get(m)
+            if isinstance(p, property) and not getattr(p.fget, "_routed", 0):
+                p.fget._routed = True
+                setattr(cls, m, _diff_route(cls, m, p, True))
 
     @property
     def batch_shape(self):
@@ -67,6 +153,24 @@ class Distribution:
 
     def kl_divergence(self, other):
         return kl_divergence(self, other)
+
+
+class ExponentialFamily(Distribution):
+    """Base for natural exponential families. Subclasses expose natural
+    parameters + log-normalizer, which powers the generic Bregman-divergence
+    KL (reference: distribution/exponential_family.py — there via autodiff of
+    the log-normalizer, here via jax.grad, the same trick natively)."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0.0
 
 
 class Normal(Distribution):
@@ -163,7 +267,7 @@ class Uniform(Distribution):
                                        self.batch_shape))
 
 
-class Bernoulli(Distribution):
+class Bernoulli(ExponentialFamily):
     def __init__(self, probs=None, logits=None, name=None):
         if (probs is None) == (logits is None):
             raise ValueError("pass exactly one of probs/logits")
@@ -200,6 +304,13 @@ class Bernoulli(Distribution):
         p = self.probs
         return Tensor(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
 
+    @property
+    def _natural_parameters(self):
+        return (self.logits,)
+
+    def _log_normalizer(self, eta):
+        return jax.nn.softplus(eta)
+
 
 class Categorical(Distribution):
     def __init__(self, logits=None, probs=None, name=None):
@@ -235,7 +346,7 @@ class Categorical(Distribution):
         return Tensor(-(p * self._log_norm).sum(-1))
 
 
-class Exponential(Distribution):
+class Exponential(ExponentialFamily):
     def __init__(self, rate):
         self.rate = _arr(rate).astype(jnp.float32)
         super().__init__(self.rate.shape)
@@ -260,6 +371,13 @@ class Exponential(Distribution):
     def entropy(self):
         return Tensor(1.0 - jnp.log(self.rate)
                       + jnp.zeros(self.batch_shape))
+
+    @property
+    def _natural_parameters(self):
+        return (-self.rate,)
+
+    def _log_normalizer(self, eta):
+        return -jnp.log(-eta)
 
 
 class Laplace(Distribution):
@@ -295,7 +413,7 @@ class Laplace(Distribution):
                                        self.batch_shape))
 
 
-class Gamma(Distribution):
+class Gamma(ExponentialFamily):
     def __init__(self, concentration, rate):
         self.concentration = _arr(concentration).astype(jnp.float32)
         self.rate = _arr(rate).astype(jnp.float32)
@@ -327,8 +445,16 @@ class Gamma(Distribution):
         return Tensor(a - jnp.log(b) + jax.scipy.special.gammaln(a)
                       + (1 - a) * jax.scipy.special.digamma(a))
 
+    @property
+    def _natural_parameters(self):
+        return (self.concentration - 1.0, -self.rate)
 
-class Beta(Distribution):
+    def _log_normalizer(self, e1, e2):
+        return (jax.scipy.special.gammaln(e1 + 1.0)
+                - (e1 + 1.0) * jnp.log(-e2))
+
+
+class Beta(ExponentialFamily):
     def __init__(self, alpha, beta):
         self.alpha = _arr(alpha).astype(jnp.float32)
         self.beta = _arr(beta).astype(jnp.float32)
@@ -365,8 +491,16 @@ class Beta(Distribution):
         return Tensor(lbeta - (a - 1) * dg(a) - (b - 1) * dg(b)
                       + (a + b - 2) * dg(a + b))
 
+    @property
+    def _natural_parameters(self):
+        return (self.alpha, self.beta)
 
-class Dirichlet(Distribution):
+    def _log_normalizer(self, a, b):
+        g = jax.scipy.special.gammaln
+        return g(a) + g(b) - g(a + b)
+
+
+class Dirichlet(ExponentialFamily):
     def __init__(self, concentration):
         self.concentration = _arr(concentration).astype(jnp.float32)
         super().__init__(self.concentration.shape[:-1],
@@ -389,6 +523,14 @@ class Dirichlet(Distribution):
         lnorm = (jax.scipy.special.gammaln(a).sum(-1)
                  - jax.scipy.special.gammaln(a.sum(-1)))
         return Tensor(((a - 1) * jnp.log(v)).sum(-1) - lnorm)
+
+    @property
+    def _natural_parameters(self):
+        return (self.concentration,)
+
+    def _log_normalizer(self, a):
+        return (jax.scipy.special.gammaln(a).sum(-1)
+                - jax.scipy.special.gammaln(a.sum(-1)))
 
 
 class Multinomial(Distribution):
@@ -438,6 +580,18 @@ def register_kl(p_cls, q_cls):
 def kl_divergence(p: Distribution, q: Distribution) -> Tensor:
     fn = _KL_TABLE.get((type(p), type(q)))
     if fn is None:
+        # most-specific registered (super(p), super(q)) pair wins, so e.g.
+        # Chi2 vs Chi2 resolves to the Gamma-Gamma rule and EF pairs fall
+        # back to the generic Bregman rule (reference kl.py dispatch)
+        best = None
+        for (pc, qc), cand in _KL_TABLE.items():
+            if isinstance(p, pc) and isinstance(q, qc):
+                rank = (type(p).__mro__.index(pc), type(q).__mro__.index(qc))
+                if best is None or rank < best[0]:
+                    best = (rank, cand)
+        if best is not None:
+            fn = best[1]
+    if fn is None:
         raise NotImplementedError(
             f"kl_divergence({type(p).__name__}, {type(q).__name__})")
     return fn(p, q)
@@ -472,3 +626,171 @@ def _kl_bern(p, q):
 def _kl_exp(p, q):
     r = q.rate / p.rate
     return Tensor(jnp.log(p.rate) - jnp.log(q.rate) + r - 1.0)
+
+
+@register_kl(Beta, Beta)
+def _kl_beta(p, q):
+    dg = jax.scipy.special.digamma
+    gl = jax.scipy.special.gammaln
+
+    def lbeta(a, b):
+        return gl(a) + gl(b) - gl(a + b)
+
+    sp = p.alpha + p.beta
+    return Tensor(lbeta(q.alpha, q.beta) - lbeta(p.alpha, p.beta)
+                  + (p.alpha - q.alpha) * dg(p.alpha)
+                  + (p.beta - q.beta) * dg(p.beta)
+                  + (q.alpha - p.alpha + q.beta - p.beta) * dg(sp))
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma(p, q):
+    dg = jax.scipy.special.digamma
+    gl = jax.scipy.special.gammaln
+    ap, bp, aq, bq = p.concentration, p.rate, q.concentration, q.rate
+    return Tensor((ap - aq) * dg(ap) - gl(ap) + gl(aq)
+                  + aq * (jnp.log(bp) - jnp.log(bq)) + ap * (bq - bp) / bp)
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet(p, q):
+    dg = jax.scipy.special.digamma
+    gl = jax.scipy.special.gammaln
+    a, b = p.concentration, q.concentration
+    sa = a.sum(-1)
+    return Tensor(gl(sa) - gl(b.sum(-1)) - (gl(a) - gl(b)).sum(-1)
+                  + ((a - b) * (dg(a) - dg(sa)[..., None])).sum(-1))
+
+
+@register_kl(LogNormal, LogNormal)
+def _kl_lognormal(p, q):
+    return kl_divergence(p._base, q._base)
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace(p, q):
+    ad = jnp.abs(p.loc - q.loc)
+    return Tensor(jnp.log(q.scale) - jnp.log(p.scale) + ad / q.scale
+                  + p.scale / q.scale * jnp.exp(-ad / p.scale) - 1.0)
+
+
+# ---- round-3 completion: scalar families, transforms, multivariate ----------
+
+from . import transform  # noqa: E402
+from .transform import (AbsTransform, AffineTransform, ChainTransform,  # noqa: E402,F401
+                        ExpTransform, IndependentTransform, PowerTransform,
+                        ReshapeTransform, SigmoidTransform, SoftmaxTransform,
+                        StackTransform, StickBreakingTransform, TanhTransform,
+                        Transform)
+from .families import (Binomial, Cauchy, ContinuousBernoulli, Geometric,  # noqa: E402,F401
+                       Gumbel, Poisson, StudentT)
+from .multivariate import LKJCholesky, MultivariateNormal  # noqa: E402,F401
+from .transformed_distribution import (Independent,  # noqa: E402,F401
+                                       TransformedDistribution)
+
+
+class Chi2(Gamma):
+    """Chi-squared with df degrees of freedom == Gamma(df/2, rate=1/2)."""
+
+    def __init__(self, df):
+        self.df = _arr(df).astype(jnp.float32)
+        super().__init__(self.df / 2.0, jnp.full_like(self.df, 0.5))
+
+
+@register_kl(Poisson, Poisson)
+def _kl_poisson(p, q):
+    return Tensor(p.rate * (jnp.log(p.rate) - jnp.log(q.rate))
+                  - p.rate + q.rate)
+
+
+@register_kl(Geometric, Geometric)
+def _kl_geometric(p, q):
+    return Tensor(jnp.log(p.probs) - jnp.log(q.probs)
+                  + (1.0 / p.probs - 1.0)
+                  * (jnp.log1p(-p.probs) - jnp.log1p(-q.probs)))
+
+
+@register_kl(Binomial, Binomial)
+def _kl_binomial(p, q):
+    import numpy as _np
+    if not bool(_np.all(_np.asarray(p.total_count)
+                        == _np.asarray(q.total_count))):
+        raise NotImplementedError(
+            "kl_divergence(Binomial, Binomial) requires equal total_count")
+    n = p.total_count.astype(jnp.float32)
+    return Tensor(n * (p.probs * (jnp.log(p.probs) - jnp.log(q.probs))
+                       + (1 - p.probs) * (jnp.log1p(-p.probs)
+                                          - jnp.log1p(-q.probs))))
+
+
+@register_kl(Cauchy, Cauchy)
+def _kl_cauchy(p, q):
+    # closed form (Chyzak & Nielsen 2019)
+    return Tensor(jnp.log(((p.scale + q.scale) ** 2
+                           + (p.loc - q.loc) ** 2)
+                          / (4.0 * p.scale * q.scale)))
+
+
+@register_kl(Gumbel, Gumbel)
+def _kl_gumbel(p, q):
+    # E_p[log p - log q] in closed form via E[e^{-tG}] = Gamma(1+t) for
+    # standard Gumbel G: with r = b_p/b_q and m = (mu_p - mu_q)/b_q,
+    # KL = log(b_q/b_p) + euler*(r-1) - 1 + m + e^{-m} Gamma(1+r)
+    from .families import _EULER
+    r = p.scale / q.scale
+    m = (p.loc - q.loc) / q.scale
+    return Tensor(jnp.log(q.scale) - jnp.log(p.scale) + _EULER * (r - 1.0)
+                  - 1.0 + m
+                  + jnp.exp(-m + jax.scipy.special.gammaln(1.0 + r)))
+
+
+@register_kl(ContinuousBernoulli, ContinuousBernoulli)
+def _kl_cb(p, q):
+    m = p.mean._data
+    return Tensor(m * (jnp.log(p.probs) - jnp.log(q.probs))
+                  + (1.0 - m) * (jnp.log1p(-p.probs) - jnp.log1p(-q.probs))
+                  + p._log_norm() - q._log_norm())
+
+
+@register_kl(MultivariateNormal, MultivariateNormal)
+def _kl_mvn(p, q):
+    lp, lq = p._scale_tril, q._scale_tril
+    k = lp.shape[-1]
+    # M = Lq^-1 Lp ; tr(Sq^-1 Sp) = |M|_F^2
+    m = jax.scipy.linalg.solve_triangular(lq, lp, lower=True)
+    tr = jnp.square(m).sum((-2, -1))
+    diff = p.loc - q.loc
+    z = jax.scipy.linalg.solve_triangular(lq, diff[..., None],
+                                          lower=True)[..., 0]
+    maha = jnp.square(z).sum(-1)
+    logdet = (jnp.log(jnp.diagonal(lq, axis1=-2, axis2=-1)).sum(-1)
+              - jnp.log(jnp.diagonal(lp, axis1=-2, axis2=-1)).sum(-1))
+    return Tensor(0.5 * (tr + maha - k) + logdet)
+
+
+@register_kl(ExponentialFamily, ExponentialFamily)
+def _kl_expfamily(p, q):
+    """Generic Bregman-divergence KL between same-family EF distributions
+    (reference exponential_family.py / kl.py _kl_expfamily_expfamily):
+    KL = F(eta_q) - F(eta_p) - <grad F(eta_p), eta_q - eta_p>."""
+    if type(p) is not type(q):
+        raise NotImplementedError(
+            f"generic EF KL needs matching families, got "
+            f"{type(p).__name__} vs {type(q).__name__}")
+    tp = [jnp.asarray(t, jnp.float32) for t in p._natural_parameters]
+    tq = [jnp.asarray(t, jnp.float32) for t in q._natural_parameters]
+
+    def F(params):
+        return p._log_normalizer(*params).sum()
+
+    fp = p._log_normalizer(*tp)
+    fq = q._log_normalizer(*tq)
+    grads = jax.grad(F)(tp)
+    out = fq - fp
+    for g, a, b in zip(grads, tp, tq):
+        term = g * (b - a)
+        # sum event dims of the natural-parameter space back to batch shape
+        while term.ndim > out.ndim:
+            term = term.sum(-1)
+        out = out - term
+    return Tensor(out)

@@ -1,0 +1,375 @@
+"""Scalar distribution families (round-3 completion set).
+
+Reference parity: python/paddle/distribution/{poisson,binomial,geometric,
+gumbel,cauchy,chi2,student_t,continuous_bernoulli}.py. All samplers draw
+from the framework PRNG (framework.random.next_key) like the rest of the
+distribution package, and every density is written directly in jnp so it
+traces into compiled programs.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.random import next_key
+from ..tensor import Tensor
+
+# imported by the package __init__ AFTER these are defined, so the
+# partial-module import is safe
+from . import Distribution, _arr, _shape  # noqa: E402
+
+_EULER = 0.57721566490153286060  # Euler-Mascheroni
+
+
+def _f32(x):
+    return _arr(x).astype(jnp.float32)
+
+
+class Poisson(Distribution):
+    """Poisson(rate): pmf(k) = rate^k e^-rate / k!."""
+
+    def __init__(self, rate):
+        self.rate = _f32(rate)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return Tensor(self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(self.rate)
+
+    def sample(self, shape=()):
+        shp = _shape(shape, self.batch_shape)
+        return Tensor(jax.random.poisson(
+            next_key(), self.rate, shape=shp).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return Tensor(v * jnp.log(self.rate) - self.rate
+                      - jax.scipy.special.gammaln(v + 1))
+
+    def entropy(self):
+        # truncated-support sum: support mass beyond rate + 10*sqrt(rate) + 20
+        # is negligible at fp32 (the reference's Poisson entropy is likewise a
+        # series evaluation). Under jit the rate is traced, so the truncation
+        # can't be sized from it — fall back to a fixed 1024-term window
+        # (accurate for rate up to ~900).
+        try:
+            n = int(jnp.max(self.rate) + 10 * math.sqrt(float(jnp.max(
+                self.rate)) + 1) + 20)
+        except jax.errors.ConcretizationTypeError:
+            n = 1024
+        k = jnp.arange(n + 1, dtype=jnp.float32)
+        shape = (n + 1,) + (1,) * self.rate.ndim
+        kk = k.reshape(shape)
+        lp = (kk * jnp.log(self.rate) - self.rate
+              - jax.scipy.special.gammaln(kk + 1))
+        return Tensor(-(jnp.exp(lp) * lp).sum(0))
+
+
+class Binomial(Distribution):
+    """Binomial(total_count, probs): number of successes in n trials."""
+
+    def __init__(self, total_count, probs):
+        self.total_count = _arr(total_count).astype(jnp.int32)
+        self.probs = _f32(probs)
+        super().__init__(jnp.broadcast_shapes(self.total_count.shape,
+                                              self.probs.shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.total_count * self.probs)
+
+    @property
+    def variance(self):
+        return Tensor(self.total_count * self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        shp = _shape(shape, self.batch_shape)
+        n = jnp.broadcast_to(self.total_count, shp).astype(jnp.float32)
+        draws = jax.random.binomial(next_key(), n,
+                                    jnp.broadcast_to(self.probs, shp))
+        return Tensor(draws.astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        n = self.total_count.astype(jnp.float32)
+        gammaln = jax.scipy.special.gammaln
+        log_comb = gammaln(n + 1) - gammaln(v + 1) - gammaln(n - v + 1)
+        return Tensor(log_comb + v * jnp.log(self.probs)
+                      + (n - v) * jnp.log1p(-self.probs))
+
+    def entropy(self):
+        # exact sum over the (n+1)-point support (fixed window under jit,
+        # where total_count is traced; terms beyond n are masked out below)
+        try:
+            n_max = int(jnp.max(self.total_count))
+        except jax.errors.ConcretizationTypeError:
+            n_max = 1024
+        k = jnp.arange(n_max + 1, dtype=jnp.float32)
+        kk = k.reshape((n_max + 1,) + (1,) * len(self.batch_shape))
+        n = self.total_count.astype(jnp.float32)
+        gammaln = jax.scipy.special.gammaln
+        lp = (gammaln(n + 1) - gammaln(kk + 1) - gammaln(n - kk + 1)
+              + kk * jnp.log(self.probs)
+              + (n - kk) * jnp.log1p(-self.probs))
+        lp = jnp.where(kk <= n, lp, -jnp.inf)
+        p = jnp.exp(lp)
+        return Tensor(-(p * jnp.where(jnp.isfinite(lp), lp, 0.0)).sum(0))
+
+
+class Geometric(Distribution):
+    """Geometric(probs): failures before the first success,
+    pmf(k) = (1-p)^k p, k = 0, 1, 2, ..."""
+
+    def __init__(self, probs):
+        self.probs = _f32(probs)
+        super().__init__(self.probs.shape)
+
+    @property
+    def mean(self):
+        return Tensor(1.0 / self.probs - 1.0)
+
+    @property
+    def variance(self):
+        return Tensor((1.0 - self.probs) / self.probs ** 2)
+
+    @property
+    def stddev(self):
+        return Tensor(jnp.sqrt(self.variance._data))
+
+    def sample(self, shape=()):
+        shp = _shape(shape, self.batch_shape)
+        u = jax.random.uniform(next_key(), shp, minval=jnp.finfo(
+            jnp.float32).tiny, maxval=1.0)
+        return Tensor(jnp.floor(jnp.log(u) / jnp.log1p(-self.probs)))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return Tensor(v * jnp.log1p(-self.probs) + jnp.log(self.probs))
+
+    def pmf(self, k):
+        return Tensor(jnp.exp(self.log_prob(k)._data))
+
+    def entropy(self):
+        p, q = self.probs, 1.0 - self.probs
+        return Tensor(-(q * jnp.log(q) + p * jnp.log(p)) / p)
+
+    def cdf(self, k):
+        v = _arr(k)
+        return Tensor(1.0 - jnp.power(1.0 - self.probs, v + 1.0))
+
+
+class Gumbel(Distribution):
+    """Gumbel(loc, scale) — the max-stable extreme-value family."""
+
+    def __init__(self, loc, scale):
+        self.loc = _f32(loc)
+        self.scale = _f32(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc + _EULER * self.scale,
+                                       self.batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(
+            (math.pi ** 2 / 6.0) * self.scale ** 2, self.batch_shape))
+
+    @property
+    def stddev(self):
+        return Tensor(jnp.sqrt(self.variance._data))
+
+    def rsample(self, shape=()):
+        shp = _shape(shape, self.batch_shape)
+        g = jax.random.gumbel(next_key(), shp)
+        return Tensor(self.loc + self.scale * g)
+
+    def log_prob(self, value):
+        z = (_arr(value) - self.loc) / self.scale
+        return Tensor(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+
+    def entropy(self):
+        return Tensor(jnp.broadcast_to(jnp.log(self.scale) + 1.0 + _EULER,
+                                       self.batch_shape))
+
+    def cdf(self, value):
+        z = (_arr(value) - self.loc) / self.scale
+        return Tensor(jnp.exp(-jnp.exp(-z)))
+
+
+class Cauchy(Distribution):
+    """Cauchy(loc, scale); heavy-tailed, no finite moments."""
+
+    def __init__(self, loc, scale):
+        self.loc = _f32(loc)
+        self.scale = _f32(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        raise ValueError("Cauchy distribution has no mean")
+
+    @property
+    def variance(self):
+        raise ValueError("Cauchy distribution has no variance")
+
+    @property
+    def stddev(self):
+        raise ValueError("Cauchy distribution has no stddev")
+
+    def rsample(self, shape=()):
+        shp = _shape(shape, self.batch_shape)
+        return Tensor(self.loc + self.scale * jax.random.cauchy(next_key(),
+                                                                shp))
+
+    def log_prob(self, value):
+        z = (_arr(value) - self.loc) / self.scale
+        return Tensor(-math.log(math.pi) - jnp.log(self.scale)
+                      - jnp.log1p(z ** 2))
+
+    def entropy(self):
+        return Tensor(jnp.broadcast_to(
+            jnp.log(4 * math.pi * self.scale), self.batch_shape))
+
+    def cdf(self, value):
+        z = (_arr(value) - self.loc) / self.scale
+        return Tensor(jnp.arctan(z) / math.pi + 0.5)
+
+
+class StudentT(Distribution):
+    """StudentT(df, loc, scale)."""
+
+    def __init__(self, df, loc=0.0, scale=1.0):
+        self.df = _f32(df)
+        self.loc = _f32(loc)
+        self.scale = _f32(scale)
+        super().__init__(jnp.broadcast_shapes(
+            self.df.shape, self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(
+            jnp.where(self.df > 1, self.loc, jnp.nan), self.batch_shape))
+
+    @property
+    def variance(self):
+        v = jnp.where(self.df > 2,
+                      self.scale ** 2 * self.df / (self.df - 2), jnp.inf)
+        return Tensor(jnp.broadcast_to(
+            jnp.where(self.df > 1, v, jnp.nan), self.batch_shape))
+
+    def rsample(self, shape=()):
+        shp = _shape(shape, self.batch_shape)
+        t = jax.random.t(next_key(), jnp.broadcast_to(self.df, shp), shp)
+        return Tensor(self.loc + self.scale * t)
+
+    def log_prob(self, value):
+        z = (_arr(value) - self.loc) / self.scale
+        d = self.df
+        gammaln = jax.scipy.special.gammaln
+        return Tensor(gammaln((d + 1) / 2) - gammaln(d / 2)
+                      - 0.5 * jnp.log(d * math.pi) - jnp.log(self.scale)
+                      - (d + 1) / 2 * jnp.log1p(z ** 2 / d))
+
+    def entropy(self):
+        d = self.df
+        dg = jax.scipy.special.digamma
+        gammaln = jax.scipy.special.gammaln
+        ent = ((d + 1) / 2 * (dg((d + 1) / 2) - dg(d / 2))
+               + 0.5 * jnp.log(d) + _lbeta(d / 2, 0.5) + jnp.log(self.scale))
+        del gammaln
+        return Tensor(jnp.broadcast_to(ent, self.batch_shape))
+
+
+def _lbeta(a, b):
+    g = jax.scipy.special.gammaln
+    return g(a) + g(b) - g(a + b)
+
+
+class ContinuousBernoulli(Distribution):
+    """ContinuousBernoulli(probs): exponential-family density on [0, 1] with
+    natural parameter logit(probs); lims guards the removable singularity at
+    probs=0.5 (where the density is Uniform(0,1))."""
+
+    def __init__(self, probs, lims=(0.499, 0.501)):
+        self.probs = _f32(probs)
+        self._lims = lims
+        super().__init__(self.probs.shape)
+
+    def _outside(self):
+        return (self.probs < self._lims[0]) | (self.probs > self._lims[1])
+
+    def _safe_probs(self):
+        # value used on the non-singular branch only
+        return jnp.where(self._outside(), self.probs, 0.3)
+
+    def _log_norm(self):
+        """log C(probs) where C normalizes the density."""
+        lam = self._safe_probs()
+        out = jnp.log(jnp.abs(2.0 * jnp.arctanh(1.0 - 2.0 * lam))
+                      / jnp.abs(1.0 - 2.0 * lam))
+        # Taylor expansion around 0.5: log 2 + 4/3 eps^2 + O(eps^4)
+        eps = self.probs - 0.5
+        taylor = math.log(2.0) + 4.0 / 3.0 * eps ** 2 + 104.0 / 45.0 * eps ** 4
+        return jnp.where(self._outside(), out, taylor)
+
+    @property
+    def mean(self):
+        lam = self._safe_probs()
+        m = lam / (2.0 * lam - 1.0) + 1.0 / (
+            2.0 * jnp.arctanh(1.0 - 2.0 * lam))
+        eps = self.probs - 0.5
+        taylor = 0.5 + eps / 3.0 + 16.0 / 45.0 * eps ** 3
+        return Tensor(jnp.where(self._outside(), m, taylor))
+
+    @property
+    def variance(self):
+        lam = self._safe_probs()
+        v = (1.0 / (2.0 * jnp.arctanh(1.0 - 2.0 * lam)) ** 2
+             - (1.0 - lam) * lam / (1.0 - 2.0 * lam) ** 2)
+        eps = self.probs - 0.5
+        taylor = 1.0 / 12.0 - eps ** 2 / 15.0
+        return Tensor(jnp.where(self._outside(), v, taylor))
+
+    def rsample(self, shape=()):
+        shp = _shape(shape, self.batch_shape)
+        u = jax.random.uniform(next_key(), shp,
+                               minval=jnp.finfo(jnp.float32).tiny, maxval=1.0)
+        lam = self._safe_probs()
+        # inverse CDF for lambda != 0.5
+        x = (jnp.log1p(u * (2.0 * lam - 1.0) / (1.0 - lam))
+             / (jnp.log(lam) - jnp.log1p(-lam)))
+        return Tensor(jnp.where(self._outside(), x, u))
+
+    sample = Distribution.sample
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return Tensor(v * jnp.log(self.probs)
+                      + (1.0 - v) * jnp.log1p(-self.probs)
+                      + self._log_norm())
+
+    def cdf(self, value):
+        v = _arr(value)
+        lam = self._safe_probs()
+        num = (jnp.power(lam, v) * jnp.power(1.0 - lam, 1.0 - v)
+               + lam - 1.0)
+        c = num / (2.0 * lam - 1.0)
+        c = jnp.where(self._outside(), c, v)
+        return Tensor(jnp.clip(c, 0.0, 1.0))
+
+    def entropy(self):
+        # E[-log p(X)] with the analytic mean
+        m = self.mean._data
+        return Tensor(-(m * jnp.log(self.probs)
+                        + (1.0 - m) * jnp.log1p(-self.probs)
+                        + self._log_norm()))

@@ -1,0 +1,481 @@
+"""Random-variable transforms.
+
+Reference parity: python/paddle/distribution/transform.py (Transform base +
+Abs/Affine/Chain/Exp/Independent/Power/Reshape/Sigmoid/Softmax/Stack/
+StickBreaking/Tanh transforms). TPU-native: every transform is a pair of
+jnp-traceable maps plus an analytic log|det J|, so TransformedDistribution
+log_probs stay fully compilable — no autodiff fallback in the hot path.
+"""
+from __future__ import annotations
+
+import enum
+import math
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from . import _arr
+from ..tensor import Tensor
+
+
+class Type(enum.Enum):
+    BIJECTION = "bijection"
+    INJECTION = "injection"
+    SURJECTION = "surjection"
+    OTHER = "other"
+
+    @classmethod
+    def is_injective(cls, t) -> bool:
+        return t in (cls.BIJECTION, cls.INJECTION)
+
+
+class _Domain:
+    """Minimal stand-in for the reference's variable.Variable: just what the
+    Transform machinery needs (event rank + discreteness)."""
+
+    def __init__(self, event_rank: int = 0, is_discrete: bool = False):
+        self.event_rank = event_rank
+        self.is_discrete = is_discrete
+
+
+class Transform:
+    _type = Type.INJECTION
+
+    @property
+    def type(self):
+        return self._type
+
+    def __call__(self, x):
+        from . import Distribution
+        from .transformed_distribution import TransformedDistribution
+        if isinstance(x, Distribution):
+            return TransformedDistribution(x, [self])
+        return self.forward(x)
+
+    # -- public API (wrap/unwrap Tensor) -------------------------------------
+    def forward(self, x):
+        return Tensor(self._forward(_arr(x)))
+
+    def inverse(self, y):
+        inv = self._inverse(_arr(y))
+        if isinstance(inv, tuple):
+            return tuple(Tensor(v) for v in inv)
+        return Tensor(inv)
+
+    def forward_log_det_jacobian(self, x):
+        return Tensor(self._fldj(_arr(x)))
+
+    def inverse_log_det_jacobian(self, y):
+        return Tensor(self._ildj(_arr(y)))
+
+    def forward_shape(self, shape: Sequence[int]):
+        return tuple(self._forward_shape(tuple(shape)))
+
+    def inverse_shape(self, shape: Sequence[int]):
+        return tuple(self._inverse_shape(tuple(shape)))
+
+    @property
+    def _domain(self):
+        return _Domain()
+
+    @property
+    def _codomain(self):
+        return _Domain()
+
+    # -- subclass hooks -------------------------------------------------------
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _fldj(self, x):
+        raise NotImplementedError
+
+    def _ildj(self, y):
+        # default: -fldj at the preimage (valid for injective transforms)
+        return -self._fldj(self._inverse(y))
+
+    def _forward_shape(self, shape):
+        return shape
+
+    def _inverse_shape(self, shape):
+        return shape
+
+
+class AbsTransform(Transform):
+    """y = |x|. Surjective onto [0, inf); inverse returns both preimages
+    (-y, y), each with zero log-det (slope +-1)."""
+    _type = Type.SURJECTION
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return (-y, y)
+
+    def _ildj(self, y):
+        return (jnp.zeros_like(y), jnp.zeros_like(y))
+
+    @property
+    def _codomain(self):
+        return _Domain()
+
+
+class AffineTransform(Transform):
+    """y = loc + scale * x."""
+    _type = Type.BIJECTION
+
+    def __init__(self, loc, scale):
+        self.loc = _arr(loc).astype(jnp.float32)
+        self.scale = _arr(scale).astype(jnp.float32)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _fldj(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+    def _forward_shape(self, shape):
+        return jnp.broadcast_shapes(shape, self.loc.shape, self.scale.shape)
+
+    _inverse_shape = _forward_shape
+
+
+class ChainTransform(Transform):
+    """Composition t_n(...t_1(x)); log-dets accumulate through the chain."""
+
+    def __init__(self, transforms):
+        if not all(isinstance(t, Transform) for t in transforms):
+            raise TypeError("all elements must be Transforms")
+        self.transforms = list(transforms)
+        kinds = {t._type for t in self.transforms}
+        if kinds <= {Type.BIJECTION}:
+            self._type = Type.BIJECTION
+        elif kinds <= {Type.BIJECTION, Type.INJECTION}:
+            self._type = Type.INJECTION
+        else:
+            # any surjective/other member makes the chain non-injective, so
+            # TransformedDistribution.log_prob's guard rejects it cleanly
+            self._type = Type.OTHER
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _fldj(self, x):
+        # terms from transforms of different event ranks are aligned by
+        # summing each one down to the chain's overall event rank
+        chain_rank = max(t._domain.event_rank for t in self.transforms)
+        total = 0.0
+        for t in self.transforms:
+            total = total + _sum_rightmost(
+                t._fldj(x), chain_rank - t._domain.event_rank)
+            x = t._forward(x)
+        return total
+
+    def _forward_shape(self, shape):
+        for t in self.transforms:
+            shape = t._forward_shape(shape)
+        return shape
+
+    def _inverse_shape(self, shape):
+        for t in reversed(self.transforms):
+            shape = t._inverse_shape(shape)
+        return shape
+
+    @property
+    def _domain(self):
+        return self.transforms[0]._domain
+
+    @property
+    def _codomain(self):
+        return self.transforms[-1]._codomain
+
+
+def _sum_rightmost(x, n):
+    return x.sum(axis=tuple(range(x.ndim - n, x.ndim))) if n > 0 else x
+
+
+class ExpTransform(Transform):
+    """y = exp(x)."""
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        return x
+
+
+class IndependentTransform(Transform):
+    """Wraps a base transform, reinterpreting the rightmost
+    reinterpreted_batch_rank batch dims as event dims (log-dets summed)."""
+
+    def __init__(self, base, reinterpreted_batch_rank: int):
+        if reinterpreted_batch_rank <= 0:
+            raise ValueError("reinterpreted_batch_rank must be positive")
+        self.base = base
+        self.reinterpreted_batch_rank = int(reinterpreted_batch_rank)
+        self._type = base._type
+
+    def _forward(self, x):
+        return self.base._forward(x)
+
+    def _inverse(self, y):
+        return self.base._inverse(y)
+
+    def _fldj(self, x):
+        return _sum_rightmost(self.base._fldj(x),
+                              self.reinterpreted_batch_rank)
+
+    def _ildj(self, y):
+        return _sum_rightmost(self.base._ildj(y),
+                              self.reinterpreted_batch_rank)
+
+    def _forward_shape(self, shape):
+        return self.base._forward_shape(shape)
+
+    def _inverse_shape(self, shape):
+        return self.base._inverse_shape(shape)
+
+    @property
+    def _domain(self):
+        return _Domain(self.base._domain.event_rank
+                       + self.reinterpreted_batch_rank,
+                       self.base._domain.is_discrete)
+
+    @property
+    def _codomain(self):
+        return _Domain(self.base._codomain.event_rank
+                       + self.reinterpreted_batch_rank,
+                       self.base._codomain.is_discrete)
+
+
+class PowerTransform(Transform):
+    """y = x ** power (x > 0)."""
+    _type = Type.BIJECTION
+
+    def __init__(self, power):
+        self.power = _arr(power).astype(jnp.float32)
+
+    def _forward(self, x):
+        return jnp.power(x, self.power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def _fldj(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+    def _forward_shape(self, shape):
+        return jnp.broadcast_shapes(shape, self.power.shape)
+
+    _inverse_shape = _forward_shape
+
+
+class ReshapeTransform(Transform):
+    """Reshapes the event part of the tensor from in_event_shape to
+    out_event_shape; volume-preserving (log-det 0)."""
+    _type = Type.BIJECTION
+
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(int(s) for s in in_event_shape)
+        self.out_event_shape = tuple(int(s) for s in out_event_shape)
+        if math.prod(self.in_event_shape) != math.prod(self.out_event_shape):
+            raise ValueError("in_event_shape and out_event_shape must have "
+                             "the same number of elements")
+
+    @property
+    def _domain(self):
+        return _Domain(len(self.in_event_shape))
+
+    @property
+    def _codomain(self):
+        return _Domain(len(self.out_event_shape))
+
+    def _split(self, shape, event):
+        n = len(event)
+        if n and tuple(shape[-n:]) != event:
+            raise ValueError(f"trailing shape {shape} does not match {event}")
+        return shape[:len(shape) - n]
+
+    def _forward(self, x):
+        batch = self._split(x.shape, self.in_event_shape)
+        return x.reshape(batch + self.out_event_shape)
+
+    def _inverse(self, y):
+        batch = self._split(y.shape, self.out_event_shape)
+        return y.reshape(batch + self.in_event_shape)
+
+    def _fldj(self, x):
+        batch = self._split(x.shape, self.in_event_shape)
+        return jnp.zeros(batch, x.dtype)
+
+    def _ildj(self, y):
+        batch = self._split(y.shape, self.out_event_shape)
+        return jnp.zeros(batch, y.dtype)
+
+    def _forward_shape(self, shape):
+        return self._split(shape, self.in_event_shape) + self.out_event_shape
+
+    def _inverse_shape(self, shape):
+        return self._split(shape, self.out_event_shape) + self.in_event_shape
+
+
+class SigmoidTransform(Transform):
+    """y = sigmoid(x)."""
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _fldj(self, x):
+        # log sig(x) + log sig(-x), in the stable softplus form
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+    @property
+    def _codomain(self):
+        return _Domain()
+
+
+class SoftmaxTransform(Transform):
+    """y = softmax(x) over the last dim. Not injective (softmax is shift
+    invariant); inverse maps to the log-probability representative."""
+    _type = Type.OTHER
+
+    @property
+    def _domain(self):
+        return _Domain(1)
+
+    @property
+    def _codomain(self):
+        return _Domain(1)
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, axis=-1)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+
+class StackTransform(Transform):
+    """Applies a list of transforms to the slices of one axis."""
+
+    def __init__(self, transforms, axis: int = 0):
+        if not transforms or not all(
+                isinstance(t, Transform) for t in transforms):
+            raise TypeError("transforms must be a non-empty Transform list")
+        self.transforms = list(transforms)
+        self.axis = int(axis)
+        self._type = (Type.BIJECTION if all(
+            t._type == Type.BIJECTION for t in self.transforms)
+            else Type.OTHER)
+
+    def _map(self, fn_name, x):
+        parts = jnp.split(x, len(self.transforms), axis=self.axis)
+        outs = [getattr(t, fn_name)(jnp.squeeze(p, self.axis))
+                for t, p in zip(self.transforms, parts)]
+        return jnp.stack(outs, axis=self.axis)
+
+    def _forward(self, x):
+        return self._map("_forward", x)
+
+    def _inverse(self, y):
+        return self._map("_inverse", y)
+
+    def _fldj(self, x):
+        return self._map("_fldj", x)
+
+    def _ildj(self, y):
+        return self._map("_ildj", y)
+
+
+class StickBreakingTransform(Transform):
+    """Maps R^K to the (K+1)-simplex by iterated stick breaking."""
+    _type = Type.INJECTION
+
+    @property
+    def _domain(self):
+        return _Domain(1)
+
+    @property
+    def _codomain(self):
+        return _Domain(1)
+
+    def _forward(self, x):
+        k = x.shape[-1]
+        # logistic transform with the simplex-centering offset
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=x.dtype))
+        z = jax.nn.sigmoid(x - offset)
+        # cumulative product of leftover stick lengths
+        lead = jnp.cumprod(1 - z, axis=-1)
+        lead = jnp.concatenate(
+            [jnp.ones(x.shape[:-1] + (1,), x.dtype), lead], axis=-1)
+        probs = jnp.concatenate(
+            [z, jnp.ones(x.shape[:-1] + (1,), x.dtype)], axis=-1)
+        return probs * lead
+
+    def _inverse(self, y):
+        k = y.shape[-1] - 1
+        leftover = 1.0 - jnp.cumsum(y[..., :-1], axis=-1)
+        leftover = jnp.concatenate(
+            [jnp.ones(y.shape[:-1] + (1,), y.dtype), leftover[..., :-1]],
+            axis=-1)
+        z = y[..., :-1] / leftover
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=y.dtype))
+        return jnp.log(z) - jnp.log1p(-z) + offset
+
+    def _fldj(self, x):
+        k = x.shape[-1]
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=x.dtype))
+        xo = x - offset
+        z = jax.nn.sigmoid(xo)
+        leftover = jnp.cumprod(1 - z, axis=-1)
+        leftover = jnp.concatenate(
+            [jnp.ones(x.shape[:-1] + (1,), x.dtype), leftover[..., :-1]],
+            axis=-1)
+        # d y_i / d z_i = leftover_i ; d z_i / d x_i = sig'(x - offset)
+        return jnp.sum(jnp.log(leftover)
+                       - jax.nn.softplus(-xo) - jax.nn.softplus(xo), axis=-1)
+
+    def _forward_shape(self, shape):
+        return shape[:-1] + (shape[-1] + 1,)
+
+    def _inverse_shape(self, shape):
+        return shape[:-1] + (shape[-1] - 1,)
+
+
+class TanhTransform(Transform):
+    """y = tanh(x)."""
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _fldj(self, x):
+        # log(1 - tanh^2 x) = 2 (log 2 - x - softplus(-2x)), the stable form
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+    @property
+    def _codomain(self):
+        return _Domain()

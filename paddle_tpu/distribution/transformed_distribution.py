@@ -1,0 +1,128 @@
+"""Independent and TransformedDistribution.
+
+Reference parity: python/paddle/distribution/independent.py and
+transformed_distribution.py. Both are pure composition — no sampling
+primitives of their own — so they stay fully traceable.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import Distribution, _arr
+from ..tensor import Tensor
+from .transform import ChainTransform, Transform, Type, _sum_rightmost
+
+
+class Independent(Distribution):
+    """Reinterprets the rightmost `reinterpreted_batch_rank` batch dims of a
+    base distribution as event dims: log_prob sums over them."""
+
+    def __init__(self, base, reinterpreted_batch_rank: int):
+        if not isinstance(base, Distribution):
+            raise TypeError("base must be a Distribution")
+        rank = int(reinterpreted_batch_rank)
+        if not 0 < rank <= len(base.batch_shape):
+            raise ValueError(
+                f"reinterpreted_batch_rank must be in (0, "
+                f"{len(base.batch_shape)}], got {reinterpreted_batch_rank}")
+        self.base = base
+        self.reinterpreted_batch_rank = rank
+        cut = len(base.batch_shape) - rank
+        super().__init__(base.batch_shape[:cut],
+                         base.batch_shape[cut:] + tuple(base.event_shape))
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        # Tensor-level sums keep the tape: gradients flow to the base
+        # distribution's parameters
+        lp = self.base.log_prob(value)
+        for _ in range(self.reinterpreted_batch_rank):
+            lp = lp.sum(axis=-1)
+        return lp
+
+    def entropy(self):
+        ent = self.base.entropy()
+        for _ in range(self.reinterpreted_batch_rank):
+            ent = ent.sum(axis=-1)
+        return ent
+
+
+class TransformedDistribution(Distribution):
+    """Distribution of y = T_k(...T_1(x)) for x ~ base: samples map forward,
+    log_prob pulls back through the inverse with the log-det correction
+    (non-injective chains keep sample() but raise on log_prob)."""
+
+    def __init__(self, base, transforms):
+        if not isinstance(base, Distribution):
+            raise TypeError("base must be a Distribution")
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        if not all(isinstance(t, Transform) for t in transforms):
+            raise TypeError("transforms must be Transforms")
+        chain = ChainTransform(list(transforms))
+        base_event_rank = len(base.event_shape)
+        if chain._domain.event_rank > base_event_rank:
+            raise ValueError(
+                f"transform domain event rank {chain._domain.event_rank} "
+                f"exceeds base event rank {base_event_rank}")
+        self.base = base
+        self.chain = chain
+        self.transforms = list(transforms)
+        shape = tuple(base.batch_shape) + tuple(base.event_shape)
+        out = chain.forward_shape(shape)
+        # event rank can only grow through the chain
+        self._event_rank = max(chain._codomain.event_rank, base_event_rank)
+        super().__init__(tuple(out[:len(out) - self._event_rank]),
+                         tuple(out[len(out) - self._event_rank:]))
+
+    def sample(self, shape=()):
+        import jax
+        return Tensor(jax.lax.stop_gradient(self.rsample(shape)._data))
+
+    def rsample(self, shape=()):
+        from ..ops.dispatch import dispatch
+        x = self.base.rsample(shape)  # Tensor: grads flow to base params
+        return dispatch("transformed_rsample", self.chain._forward, x)
+
+    def log_prob(self, value):
+        if not Type.is_injective(self.chain.type):
+            raise TypeError(
+                "log_prob is undefined for non-injective transforms")
+        from ..ops.dispatch import dispatch
+        vt = value if isinstance(value, Tensor) else \
+            Tensor(jnp.asarray(value))
+
+        def pullback(y):
+            """(preimage under the chain, -sum of log-det corrections)."""
+            event_rank = self._event_rank
+            corr = None
+            for t in reversed(self.transforms):
+                x = t._inverse(y)
+                event_rank += t._domain.event_rank - t._codomain.event_rank
+                term = _sum_rightmost(t._fldj(x),
+                                      event_rank - t._domain.event_rank)
+                corr = term if corr is None else corr + term
+                y = x
+            return y, -jnp.asarray(corr)
+
+        x_t, corr_t = dispatch("transformed_pullback", pullback, vt)
+        base_lp = self.base.log_prob(x_t)  # grads: base params AND value
+        final_rank = self._event_rank + sum(
+            t._domain.event_rank - t._codomain.event_rank
+            for t in self.transforms)
+        for _ in range(final_rank - len(self.base.event_shape)):
+            base_lp = base_lp.sum(axis=-1)
+        return base_lp + corr_t
