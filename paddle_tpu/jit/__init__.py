@@ -52,12 +52,57 @@ def _rebuild(spec, tensors):
     return spec[1]
 
 
+_GRAPH_BREAK_ERRORS = None
+
+
+def _graph_break_errors():
+    """Trace-time errors caused by data-dependent Python control flow on
+    tensor VALUES (the reference SOT's graph-break triggers,
+    jit/sot/opcode_translator/executor/opcode_executor.py:353)."""
+    global _GRAPH_BREAK_ERRORS
+    if _GRAPH_BREAK_ERRORS is None:
+        errs = []
+        for n in ("ConcretizationTypeError", "TracerBoolConversionError",
+                  "TracerArrayConversionError",
+                  "TracerIntegerConversionError",
+                  "NonConcreteBooleanIndexError"):
+            e = getattr(jax.errors, n, None)
+            if e is not None:
+                errs.append(e)
+        _GRAPH_BREAK_ERRORS = tuple(errs)
+    return _GRAPH_BREAK_ERRORS
+
+
+def _next_bucket(n: int) -> int:
+    b = 1
+    while b < n:
+        b <<= 1
+    return b
+
+
 class StaticFunction:
-    """A compiled callable over a Layer's forward (or a plain function)."""
+    """A compiled callable over a Layer's forward (or a plain function).
+
+    Robustness beyond plain trace-and-compile (reference SOT capability,
+    minus bytecode rewriting):
+    - graph-break fallback: if tracing hits data-dependent Python control
+      flow on tensor values (``if float(x) > 0``), the call falls back to
+      eager execution with a one-time warning, and that (shape, kwargs)
+      signature keeps using eager so the failing trace isn't re-attempted.
+    - optional shape bucketing (``to_static(..., bucket_batch=True)``): the
+      leading dim of every input is padded to the next power of two and
+      outputs are sliced back, so serving-style dynamic batch sizes reuse a
+      handful of compiled programs instead of one per size (the reference's
+      dynamic-shape/recompilation-storm story, sot/executor_cache.py).
+      CONTRACT: axis 0 of every input and output is the batch, and outputs
+      are per-sample — global reductions would see the zero padding, and
+      batch-coupled buffer updates (BatchNorm running stats) are skipped
+      with a warning when padding occurred.
+    """
 
     def __init__(self, function: Callable, layer: Optional[Layer] = None,
                  input_spec=None, build_strategy=None, backend=None,
-                 full_graph: bool = True):
+                 full_graph: bool = True, bucket_batch: bool = False):
         self._function = function
         self._layer = layer
         self._input_spec = input_spec
@@ -65,6 +110,10 @@ class StaticFunction:
         self._jitted = None
         self._param_names: List[str] = []
         self._buffer_names: List[str] = []
+        self._bucket_batch = bucket_batch
+        self._fallback_keys = set()
+        self._warned_break = False
+        self._trace_count = 0  # diagnostics: number of fresh traces
         self.__name__ = getattr(function, "__name__", "static_fn")
 
     @property
@@ -79,6 +128,7 @@ class StaticFunction:
 
         def pure(state_arrays: Dict[str, Any], key, in_arrays: Tuple,
                  in_spec, static_kwargs: Dict):
+            self._trace_count += 1  # body runs only while tracing
             in_tensors = [Tensor(a) for a in in_arrays]
             args = _rebuild(in_spec, in_tensors)
             with key_context(key):
@@ -113,13 +163,42 @@ class StaticFunction:
         self._jitted = jitted
         self._spec_cell = spec_cell
 
+    def _call_eager(self, args, kwargs):
+        return self._function(*args, **kwargs)
+
+    def _graph_break(self, static_key, err):
+        self._fallback_keys.add(static_key)
+        if not self._warned_break:
+            self._warned_break = True
+            import warnings
+            warnings.warn(
+                f"to_static({self.__name__}): graph break — data-dependent "
+                f"Python control flow on tensor values cannot be traced; "
+                f"falling back to eager for this call signature. "
+                f"({type(err).__name__}: {str(err)[:200]})", stacklevel=3)
+
     def __call__(self, *args, **kwargs):
         if self._jitted is None:
             self._build()
         layer = self._layer
-        in_tensors: List[Tensor] = []
-        in_spec = _flatten_tensors(list(args), in_tensors)
+        raw_args = args
+        raw_tensors: List[Tensor] = []
+        raw_spec = _flatten_tensors(list(args), raw_tensors)
         mode = layer.training if layer is not None else None
+        # fallback decisions are per (kwargs, tree, shapes/dtypes) signature
+        fallback_key = (repr(sorted(kwargs.items())), repr(raw_spec), mode,
+                        tuple((tuple(t._data.shape), str(t._data.dtype))
+                              for t in raw_tensors))
+        if fallback_key in self._fallback_keys:
+            return self._call_eager(raw_args, kwargs)
+        orig_batch = None
+        if self._bucket_batch:
+            args, orig_batch = self._pad_args(raw_spec, raw_tensors)
+        if orig_batch is None or orig_batch[0] == orig_batch[1]:
+            in_tensors, in_spec = raw_tensors, raw_spec
+        else:
+            in_tensors = []
+            in_spec = _flatten_tensors(list(args), in_tensors)
         static_key = (repr(sorted(kwargs.items())), repr(in_spec), mode)
         self._static_tbl[static_key] = (kwargs, in_spec)
 
@@ -144,18 +223,69 @@ class StaticFunction:
             # a 1-tuple would break the tape's vjp pytree contract
             return combined if len(combined) != 1 else combined[0]
 
-        result = dispatch("to_static", fwd, *all_inputs)
+        try:
+            result = dispatch("to_static", fwd, *all_inputs)
+        except _graph_break_errors() as e:
+            self._graph_break(fallback_key, e)
+            return self._call_eager(raw_args, kwargs)
         if not isinstance(result, tuple):
             result = (result,)
         out_spec = self._spec_cell[static_key]
         n_out = len(result) - n_buf
-        # write back updated buffers
+        padded = orig_batch is not None and orig_batch[0] != orig_batch[1]
+        # write back updated buffers — unless the batch was padded, in which
+        # case batch-coupled stats (BatchNorm running mean/var) would have
+        # seen the zero rows: keep the previous buffers and warn once
         if layer is not None and n_buf:
-            buffers = dict(layer.named_buffers())
-            for i, n in enumerate(self._buffer_names):
-                buffers[n]._data = result[n_out + i]._data
+            if padded:
+                if not getattr(self, "_warned_buffers", False):
+                    self._warned_buffers = True
+                    import warnings
+                    warnings.warn(
+                        f"to_static({self.__name__}): bucket_batch padded "
+                        "the batch; buffer updates (e.g. BatchNorm running "
+                        "stats) are skipped for padded calls.", stacklevel=2)
+            else:
+                buffers = dict(layer.named_buffers())
+                for i, n in enumerate(self._buffer_names):
+                    buffers[n]._data = result[n_out + i]._data
         out = _rebuild(out_spec, list(result[:n_out]))
+        if padded:
+            out = self._slice_outputs(out, orig_batch)
         return out
+
+    # -- shape bucketing ------------------------------------------------------
+    def _pad_args(self, spec, tensors):
+        """Pad axis 0 of every input tensor up to the next power of two;
+        returns (new_args, (orig_batch, padded_batch)). Padding goes through
+        the dispatched op so input gradients stay on the tape."""
+        batches = {t._data.shape[0] for t in tensors if t._data.ndim >= 1}
+        if len(batches) != 1:
+            return None, None  # ambiguous batch dim: leave untouched
+        b = batches.pop()
+        pb = _next_bucket(b)
+        if pb == b:
+            return None, (b, b)
+        padded = []
+        for t in tensors:
+            if t._data.ndim >= 1 and t._data.shape[0] == b:
+                width = [(0, pb - b)] + [(0, 0)] * (t._data.ndim - 1)
+                padded.append(dispatch(
+                    "bucket_pad", lambda a, w=tuple(width): jnp.pad(a, w), t))
+            else:
+                padded.append(t)
+        return tuple(_rebuild(spec, padded)), (b, pb)
+
+    def _slice_outputs(self, out, orig_batch):
+        """Slice padded outputs back to the true batch via the dispatched op
+        (keeps the tape edge for backward through bucketed calls)."""
+        b, pb = orig_batch
+        tensors: List[Tensor] = []
+        spec = _flatten_tensors(out, tensors)
+        sliced = [dispatch("bucket_slice", lambda a, n=b: a[:n], t)
+                  if t._data.ndim >= 1 and t._data.shape[0] == pb else t
+                  for t in tensors]
+        return _rebuild(spec, sliced)
 
     # parity helpers
     def concrete_program(self):
@@ -163,15 +293,19 @@ class StaticFunction:
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,
-              backend=None, full_graph=True, **kwargs):
-    """Parity: paddle.jit.to_static (python/paddle/jit/api.py:197)."""
+              backend=None, full_graph=True, bucket_batch=False, **kwargs):
+    """Parity: paddle.jit.to_static (python/paddle/jit/api.py:197).
+    bucket_batch=True additionally pads the batch dim to power-of-two
+    buckets to avoid per-batch-size recompilation (see StaticFunction)."""
     def decorate(obj):
         if isinstance(obj, Layer):
             static = StaticFunction(obj.forward, layer=obj,
-                                    input_spec=input_spec)
+                                    input_spec=input_spec,
+                                    bucket_batch=bucket_batch)
             obj.forward = static
             return obj
-        return StaticFunction(obj, layer=None, input_spec=input_spec)
+        return StaticFunction(obj, layer=None, input_spec=input_spec,
+                              bucket_batch=bucket_batch)
 
     if function is not None:
         return decorate(function)

@@ -1,0 +1,145 @@
+"""jit.to_static robustness: graph-break fallback + shape bucketing.
+
+Reference capability: SOT graph breaks on data-dependent control flow
+(jit/sot/opcode_translator/executor/opcode_executor.py:353) and the
+executor-cache/guard design (sot/executor_cache.py, guard.py). Here: the
+trace-time concretization error triggers a clean per-signature fallback to
+eager, and bucket_batch pads the batch dim to power-of-two buckets so
+dynamic batch sizes reuse compiled programs.
+"""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a, np.float32))
+
+
+def test_graph_break_falls_back_to_eager():
+    @paddle.jit.to_static
+    def f(x):
+        # data-dependent Python control flow: untraceable by design
+        if float(x.sum().numpy()) > 0:
+            return x * 2
+        return x - 1
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        pos = f(_t([1.0, 2.0]))
+        neg = f(_t([-5.0, 1.0]))
+    np.testing.assert_allclose(np.asarray(pos.numpy()), [2.0, 4.0])
+    np.testing.assert_allclose(np.asarray(neg.numpy()), [-6.0, 0.0])
+    assert any("graph break" in str(x.message) for x in w)
+    # one-time warning only
+    with warnings.catch_warnings(record=True) as w2:
+        warnings.simplefilter("always")
+        f(_t([3.0, 3.0]))
+    assert not any("graph break" in str(x.message) for x in w2)
+
+
+def test_graph_break_layer_keeps_autograd():
+    class M(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = nn.Linear(4, 4)
+
+        def forward(self, x):
+            y = self.lin(x)
+            if float(y.sum().numpy()) > 1e9:  # never taken, still breaks
+                return y * 0
+            return y.sum()
+
+    m = M()
+    paddle.jit.to_static(m)
+    x = _t(np.ones((2, 4)))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        loss = m(x)
+        loss.backward()
+    g = m.lin.weight.grad
+    assert g is not None and np.abs(np.asarray(g.numpy())).sum() > 0
+
+
+def test_traceable_function_still_compiles():
+    calls = {"n": 0}
+
+    @paddle.jit.to_static
+    def f(x):
+        calls["n"] += 1  # trace-time only
+        return x * 3 + 1
+
+    sf = f
+    out = f(_t([1.0, 2.0]))
+    np.testing.assert_allclose(np.asarray(out.numpy()), [4.0, 7.0])
+    f(_t([5.0, 6.0]))
+    f(_t([7.0, 8.0]))
+    assert sf._trace_count == 1  # same shape: one trace, cached executions
+    assert not sf._fallback_keys
+
+
+def test_bucket_batch_reuses_compilation():
+    m = nn.Linear(8, 3)
+    static = paddle.jit.StaticFunction(m.forward, layer=m, bucket_batch=True)
+    outs = {}
+    for b in (5, 6, 7, 8):
+        x = np.arange(b * 8, dtype=np.float32).reshape(b, 8) / 10
+        outs[b] = np.asarray(static(_t(x)).numpy())
+        assert outs[b].shape == (b, 3)
+    # all batch sizes bucketed to 8: exactly one trace
+    assert static._trace_count == 1
+    # numerics match the eager layer exactly (padding sliced away)
+    for b in (5, 6, 7, 8):
+        x = np.arange(b * 8, dtype=np.float32).reshape(b, 8) / 10
+        np.testing.assert_allclose(outs[b], np.asarray(m(_t(x)).numpy()),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_bucket_batch_next_bucket_retraces_once():
+    m = nn.Linear(4, 2)
+    static = paddle.jit.StaticFunction(m.forward, layer=m, bucket_batch=True)
+    for b in (2, 6, 9, 12, 16):
+        out = static(_t(np.ones((b, 4), np.float32)))
+        assert np.asarray(out.numpy()).shape == (b, 2)
+    # buckets hit: 2, 8, 16, 16, 16 -> 3 traces
+    assert static._trace_count == 3
+
+
+def test_bucket_batch_keeps_gradients():
+    m = nn.Linear(4, 2)
+    static = paddle.jit.StaticFunction(m.forward, layer=m, bucket_batch=True)
+    x = _t(np.ones((3, 4)))  # pads 3 -> 4
+    x.stop_gradient = False
+    out = static(x)
+    assert np.asarray(out.numpy()).shape == (3, 2)
+    out.sum().backward()
+    g = m.weight.grad
+    assert g is not None and np.abs(np.asarray(g.numpy())).sum() > 0
+    # input grads: padded rows contribute nothing
+    gx = np.asarray(x.grad.numpy())
+    assert gx.shape == (3, 4) and np.abs(gx).sum() > 0
+
+
+def test_bucket_batch_skips_buffer_writeback_when_padded():
+    class M(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.bn = nn.BatchNorm1D(4)
+
+        def forward(self, x):
+            return self.bn(x)
+
+    m = M()
+    static = paddle.jit.StaticFunction(m.forward, layer=m, bucket_batch=True)
+    before = np.asarray(m.bn._mean.numpy()).copy()
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        static(_t(np.random.randn(3, 4)))  # padded: stats must NOT update
+    np.testing.assert_allclose(np.asarray(m.bn._mean.numpy()), before)
+    assert any("buffer updates" in str(x.message) for x in w)
+    static(_t(np.random.randn(4, 4)))  # exact bucket: stats update normally
+    assert np.abs(np.asarray(m.bn._mean.numpy()) - before).sum() > 0
