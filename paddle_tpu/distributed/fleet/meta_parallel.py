@@ -290,7 +290,8 @@ class PipelineParallel(MetaParallelBase):
             sched = str(cfg.get("schedule_mode", "1f1b")).lower()
             sched = {"f-then-b": "circular", "fthenb": "circular",
                      "1f1b": "1f1b", "vpp": "vpp",
-                     "interleave": "interleave"}.get(sched, sched)
+                     "interleave": "interleave", "zb": "zb",
+                     "zbh1": "zb"}.get(sched, sched)
             vpp = int(cfg.get("vpp_degree", 1))
             if vpp <= 1 and sched in ("vpp", "interleave"):
                 vpp = 2  # these schedules are meaningless without >1 chunk

@@ -186,7 +186,8 @@ class StaticFunction:
         raw_spec = _flatten_tensors(list(args), raw_tensors)
         mode = layer.training if layer is not None else None
         # fallback decisions are per (kwargs, tree, shapes/dtypes) signature
-        fallback_key = (repr(sorted(kwargs.items())), repr(raw_spec), mode,
+        kw_repr = repr(sorted(kwargs.items()))
+        fallback_key = (kw_repr, repr(raw_spec), mode,
                         tuple((tuple(t._data.shape), str(t._data.dtype))
                               for t in raw_tensors))
         if fallback_key in self._fallback_keys:
@@ -199,7 +200,7 @@ class StaticFunction:
         else:
             in_tensors = []
             in_spec = _flatten_tensors(list(args), in_tensors)
-        static_key = (repr(sorted(kwargs.items())), repr(in_spec), mode)
+        static_key = (kw_repr, repr(in_spec), mode)
         self._static_tbl[static_key] = (kwargs, in_spec)
 
         state_tensors: List[Tensor] = []

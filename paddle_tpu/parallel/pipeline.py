@@ -222,6 +222,301 @@ def pipeline_1f1b(h0, labels, consts, stacked_leaves, tail_leaves, *,
     return loss, d_h0, blk_g, tail_g
 
 
+def _linear_scan_alloc(intervals):
+    """Register-style slot allocation over [write_t, read_t] lifetimes.
+    intervals: [(write_t, read_t, key)] -> ({key: slot}, n_slots). A slot is
+    busy through read_t inclusive (within a tick, reads can happen after
+    unrelated writes), free again from read_t + 1."""
+    import heapq
+    free_heap, free_now, slot_of, n = [], [], {}, 0
+    for w, rd, key in sorted(intervals):
+        while free_heap and free_heap[0][0] <= w:
+            free_now.append(heapq.heappop(free_heap)[1])
+        if free_now:
+            s = min(free_now)
+            free_now.remove(s)
+        else:
+            s, n = n, n + 1
+        slot_of[key] = s
+        heapq.heappush(free_heap, (rd + 1, s))
+    return slot_of, n
+
+
+def _zb_schedule(p: int, m: int):
+    """ZB-H1 tick tables: 1F1B's F and B(dx) lanes plus a deferred W
+    (weight-gradient) lane (parity: pipeline_zero_bubble.py:62
+    PipelineZeroBubblePipelinePass).
+
+    F on rank r at tick t iff t - r in [0, m); B(dx) at tick t iff
+    t - (2(p-1) - r) in [0, m) — identical timing to pipeline_1f1b, so the
+    inter-stage dependency chain is untouched. W placement is load-aware:
+    walking the ticks in order, a ready W unit is scheduled on rank r only
+    when r's lane count stays strictly below that tick's busiest rank —
+    i.e. W rides for free on ranks the barrier would leave waiting anyway
+    (fill ticks where early ranks only forward, drain ticks where late
+    ranks idle). Deferral is bounded: a unit whose (x, dy) has been parked
+    for 2p ticks is force-scheduled, so the W buffer stays O(p) and the
+    1F1B memory property survives (real ZB-H1 makes the same trade).
+    Whatever W remains after the F/B ticks drains in cheap all-W tail
+    ticks. Returns tables + modeled makespans (work units, F=B=W=1) for
+    both lockstep and async cost models."""
+    import numpy as np_
+    T0 = m + 2 * (p - 1)
+    w_tick = {}
+    ready = {r: [] for r in range(p)}   # FIFO of (unit, b_tick)
+    nxt_b = [0] * p
+    t = 0
+    while any(len(ready[r]) + (m - nxt_b[r]) for r in range(p)) or t < T0:
+        base = [0] * p
+        for r in range(p):
+            if 0 <= t - r < m:
+                base[r] += 1
+            if 0 <= t - (2 * (p - 1) - r) < m:
+                base[r] += 1
+                ready[r].append((nxt_b[r], t))  # (x, dy) exist from this tick
+                nxt_b[r] += 1
+        tick_max = max(base)
+        for r in range(p):
+            if not ready[r]:
+                continue
+            unit, b_t = ready[r][0]
+            free = base[r] + 1 <= tick_max or tick_max == 0
+            overdue = t - b_t >= 2 * p
+            if free or overdue:
+                w_tick[(r, unit)] = t
+                ready[r].pop(0)
+        t += 1
+        if t > 4 * T0 + 4 * m:
+            raise RuntimeError("zb W placement did not converge")
+    T = max([T0] + [tt + 1 for tt in w_tick.values()])
+
+    F_mb = np_.full((T, p), -1, np_.int32)
+    B_mb = np_.full((T, p), -1, np_.int32)
+    W_mb = np_.full((T, p), -1, np_.int32)
+    for r in range(p):
+        for i in range(m):
+            F_mb[i + r, r] = i
+            B_mb[2 * (p - 1) - r + i, r] = i
+            W_mb[w_tick[(r, i)], r] = i
+
+    # W-lane buffers: (x, dy) of unit i live [b_tick, w_tick]
+    W_store_slot = np_.full((T, p), -1, np_.int32)
+    W_read_slot = np_.full((T, p), -1, np_.int32)
+    S_w = 1
+    for r in range(p):
+        iv = [(2 * (p - 1) - r + i, w_tick[(r, i)], i) for i in range(m)]
+        slots, n = _linear_scan_alloc(iv)
+        S_w = max(S_w, n)
+        for i in range(m):
+            W_store_slot[2 * (p - 1) - r + i, r] = slots[i]
+            W_read_slot[w_tick[(r, i)], r] = slots[i]
+
+    # ---- cost models --------------------------------------------------------
+    # (a) lockstep: makespan = sum_t max_r (work at tick t). Extending T with
+    #     new W ticks nets zero, but the load-aware placement above puts W on
+    #     ranks the barrier leaves waiting anyway, which is a genuine win.
+    # (b) async (no per-tick barrier): per-device in-order queues, ops start
+    #     when their dependencies finish. The dx/dw split also wins here:
+    #     B releases the upstream dependency after 1 unit instead of 2.
+    mk_lock_1f1b = 0
+    for t in range(T0):
+        mk_lock_1f1b += max((1 if 0 <= t - r < m else 0)
+                            + (2 if 0 <= t - (2 * (p - 1) - r) < m else 0)
+                            for r in range(p))
+    mk_lock_zb = 0
+    for t in range(T):
+        mk_lock_zb += max((1 if F_mb[t, r] >= 0 else 0)
+                          + (1 if B_mb[t, r] >= 0 else 0)
+                          + (1 if W_mb[t, r] >= 0 else 0) for r in range(p))
+
+    def async_makespan(split_w: bool):
+        # ops: ("F", i, r) deps F(i, r-1); ("B", i, r) deps F(i, r) and
+        # B(i, r+1); ("W", i, r) deps B(i, r). 1F1B folds W into B (cost 2).
+        order = {r: [] for r in range(p)}
+        src_T = T if split_w else T0
+        for t in range(src_T):
+            for r in range(p):
+                if split_w:
+                    if F_mb[t, r] >= 0:
+                        order[r].append(("F", int(F_mb[t, r])))
+                    if B_mb[t, r] >= 0:
+                        order[r].append(("B", int(B_mb[t, r])))
+                    if W_mb[t, r] >= 0:
+                        order[r].append(("W", int(W_mb[t, r])))
+                else:
+                    if 0 <= t - r < m:
+                        order[r].append(("F", t - r))
+                    if 0 <= t - (2 * (p - 1) - r) < m:
+                        order[r].append(("B", t - (2 * (p - 1) - r)))
+        cost = {"F": 1.0, "B": 1.0 if split_w else 2.0, "W": 1.0}
+        done = {}
+        clock = [0.0] * p
+        pending = {r: list(order[r]) for r in range(p)}
+
+        def deps(kind, i, r):
+            if kind == "F":
+                return [("F", i, r - 1)] if r > 0 else []
+            if kind == "B":
+                d = [("F", i, r)]
+                if r < p - 1:
+                    d.append(("B", i, r + 1))
+                return d
+            return [("B", i, r)]
+
+        progressed = True
+        while progressed:
+            progressed = False
+            for r in range(p):
+                while pending[r]:
+                    kind, i = pending[r][0]
+                    dl = deps(kind, i, r)
+                    if any(d not in done for d in dl):
+                        break
+                    start = max([clock[r]] + [done[d] for d in dl])
+                    done[(kind, i, r)] = start + cost[kind]
+                    clock[r] = done[(kind, i, r)]
+                    pending[r].pop(0)
+                    progressed = True
+        assert all(not q for q in pending.values()), "async sim deadlock"
+        return max(done.values())
+
+    return {"T": T, "F_mb": F_mb, "B_mb": B_mb, "W_mb": W_mb,
+            "W_store_slot": W_store_slot, "W_read_slot": W_read_slot,
+            "S_w": S_w,
+            "makespan_lockstep_zb": mk_lock_zb,
+            "makespan_lockstep_1f1b": mk_lock_1f1b,
+            "makespan_async_zb": async_makespan(True),
+            "makespan_async_1f1b": async_makespan(False)}
+
+
+def pipeline_zb(h0, labels, consts, stacked_leaves, tail_leaves, *,
+                block_apply_flat, tail_apply_flat, axis_name: str,
+                n_micro: int, remat: bool = True):
+    """Per-device ZB-H1 region (call inside shard_map; manual over `pp`).
+
+    The backward is split: the B lane computes ONLY dx (what the upstream
+    stage is waiting for); the weight gradient W is deferred to the tick
+    tables of _zb_schedule, filling slack instead of sitting on the fill
+    ticks' critical path. Numerics are identical to pipeline_1f1b — the
+    same per-unit dW is accumulated, one lane later.
+
+    Cost note: with remat enabled the W lane re-runs the stage forward a
+    second time (the B vjp already recomputed it once), trading ~one extra
+    forward per microbatch for the bubble reduction; profitable when the
+    bubble fraction (p-1)/m exceeds the recompute fraction. The modeled
+    makespans in the schedule dict quantify the bubble win.
+    """
+    p = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+    m = n_micro
+    S = 2 * p - 1
+    sched = _zb_schedule(int(p), m)
+
+    def block_step(h, leaf_slices):
+        return block_apply_flat(leaf_slices, h, *consts), None
+
+    def stage_fn(x, leaves):
+        step = jax.checkpoint(block_step) if remat else block_step
+        y, _ = lax.scan(step, x, leaves)
+        return y
+
+    def tail_fn(y, tleaves, label):
+        return tail_apply_flat(list(tleaves), y, label)
+
+    zeros_like_tree = lambda tr: jax.tree.map(jnp.zeros_like, tr)
+    x0 = jnp.zeros_like(h0[0])
+    unit = h0.shape[1:]
+    carry0 = (
+        x0,                                        # x_recv
+        x0,                                        # dy_recv
+        jnp.zeros((S,) + unit, h0.dtype),          # fwd-input stash
+        jnp.zeros((sched["S_w"],) + unit, h0.dtype),   # W lane: x
+        jnp.zeros((sched["S_w"],) + unit, h0.dtype),   # W lane: dy
+        jnp.float32(0.0),                          # loss accumulator
+        zeros_like_tree(list(stacked_leaves)),     # block grads
+        zeros_like_tree(list(tail_leaves)),        # tail grads
+        jnp.zeros_like(h0),                        # d_h0 accumulator
+    )
+    tables = tuple(jnp.asarray(sched[k]) for k in
+                   ("F_mb", "B_mb", "W_mb", "W_store_slot", "W_read_slot"))
+
+    def tick(carry, xs):
+        (x_recv, dy_recv, stash, wx_buf, wdy_buf, loss_acc, blk_g, tail_g,
+         dh0_acc) = carry
+        f_mb, b_mb, w_mb, w_store, w_read = [row[rank] for row in xs]
+
+        # ---- forward micro-step (identical to 1F1B) ----------------------
+        fwd_valid = f_mb >= 0
+        f_idx = jnp.clip(f_mb, 0, m - 1)
+        fresh = lax.dynamic_index_in_dim(h0, f_idx, 0, keepdims=False)
+        x_in = jnp.where(rank == 0, fresh, x_recv)
+        y = stage_fn(x_in, list(stacked_leaves))
+        slot_f = jnp.mod(f_idx, S)
+        old = lax.dynamic_index_in_dim(stash, slot_f, 0, keepdims=False)
+        stash = lax.dynamic_update_index_in_dim(
+            stash, jnp.where(fwd_valid, x_in, old), slot_f, 0)
+
+        lab = lax.dynamic_index_in_dim(labels, f_idx, 0, keepdims=False)
+
+        def tail_branch(y_, tleaves):
+            loss_f, tl_vjp = jax.vjp(lambda yy, tl: tail_fn(yy, tl, lab),
+                                     y_, tleaves)
+            dh, dtail = tl_vjp(jnp.float32(1.0 / m))
+            return loss_f, dh, dtail
+
+        def tail_skip(y_, tleaves):
+            return (jnp.float32(0.0), jnp.zeros_like(y_),
+                    tuple(jnp.zeros_like(t_) for t_ in tleaves))
+
+        loss_f, dh_f, dtail_f = lax.cond(
+            fwd_valid & (rank == p - 1), tail_branch, tail_skip,
+            y, tuple(tail_leaves))
+        loss_acc = loss_acc + loss_f / m
+        tail_g = [tg + dt for tg, dt in zip(tail_g, dtail_f)]
+
+        # ---- B lane: dx ONLY ---------------------------------------------
+        bwd_valid = b_mb >= 0
+        b_idx = jnp.clip(b_mb, 0, m - 1)
+        x_b = lax.dynamic_index_in_dim(stash, jnp.mod(b_idx, S), 0,
+                                       keepdims=False)
+        dy_in = jnp.where(rank == p - 1, dh_f.astype(x0.dtype), dy_recv)
+        _, dx_vjp = jax.vjp(lambda xx: stage_fn(xx, list(stacked_leaves)),
+                            x_b)
+        (dx_b,) = dx_vjp(dy_in)
+        cur = lax.dynamic_index_in_dim(dh0_acc, b_idx, 0, keepdims=False)
+        dh0_acc = lax.dynamic_update_index_in_dim(
+            dh0_acc, jnp.where(bwd_valid & (rank == 0), dx_b, cur), b_idx, 0)
+        # stash (x, dy) for the deferred W lane
+        ws = jnp.clip(w_store, 0, wx_buf.shape[0] - 1)
+        wx_buf = wx_buf.at[ws].set(jnp.where(bwd_valid, x_b, wx_buf[ws]))
+        wdy_buf = wdy_buf.at[ws].set(jnp.where(bwd_valid, dy_in,
+                                               wdy_buf[ws]))
+
+        # ---- W lane: dW for a (possibly earlier) unit --------------------
+        w_valid = w_mb >= 0
+        wr = jnp.clip(w_read, 0, wx_buf.shape[0] - 1)
+        x_w, dy_w = wx_buf[wr], wdy_buf[wr]
+        _, dw_vjp = jax.vjp(lambda lv: stage_fn(x_w, lv),
+                            list(stacked_leaves))
+        (dleaves_w,) = dw_vjp(dy_w)
+        blk_g = [bg + jnp.where(w_valid, dl, jnp.zeros_like(dl))
+                 for bg, dl in zip(blk_g, dleaves_w)]
+
+        x_next = lax.ppermute(y, axis_name, rotate_perm(p))
+        dy_next = lax.ppermute(dx_b, axis_name,
+                               [(j, (j - 1) % p) for j in range(p)])
+        return (x_next, dy_next, stash, wx_buf, wdy_buf, loss_acc, blk_g,
+                tail_g, dh0_acc), None
+
+    (x_l, dy_l, stash, wx_buf, wdy_buf, loss_acc, blk_g, tail_g,
+     dh0_acc), _ = lax.scan(tick, carry0, tables)
+
+    loss = lax.psum(loss_acc, axis_name)
+    d_h0 = lax.psum(dh0_acc, axis_name)
+    tail_g = [lax.psum(g, axis_name) for g in tail_g]
+    return loss, d_h0, blk_g, tail_g
+
+
 def _interleaved_schedule(p: int, v: int, m: int):
     """Static lockstep schedule for interleaved-VPP 1F1B.
 
@@ -314,9 +609,78 @@ def _interleaved_schedule(p: int, v: int, m: int):
                 if s - 1 >= 0:
                     RSB_mb[t_, r] = ib
                     RSB_ch[t_, r] = (s - 1) // p
+    # ---- slot allocation (activation-memory high-water mark) ---------------
+    # The three per-device buffers (stash, fwd-input, dy) used to be indexed
+    # [chunk, microbatch] = O(v*m) slots. Each unit's buffer entry is live
+    # only over a known [write_tick, read_tick] interval of the simulated
+    # schedule, so _linear_scan_alloc shrinks every buffer to its true
+    # high-water mark (Megatron's interleave keeps O(p) activations by
+    # rotating stashes — same property, obtained from the tables instead
+    # of from send/recv order; reference pipeline_parallel.py:1308).
+    alloc = _linear_scan_alloc
+
+    fwd_tick = {}
+    bwd_tick = {}
+    arrF_tick = {}
+    arrB_tick = {}
+    for t_ in range(T):
+        for r in range(p):
+            if F_mb[t_, r] >= 0:
+                fwd_tick[(r, int(F_mb[t_, r]), int(F_ch[t_, r]))] = t_
+            if B_mb[t_, r] >= 0:
+                bwd_tick[(r, int(B_mb[t_, r]), int(B_ch[t_, r]))] = t_
+            if RSF_mb[t_, r] >= 0:
+                arrF_tick[(r, int(RSF_mb[t_, r]), int(RSF_ch[t_, r]))] = t_
+            if RSB_mb[t_, r] >= 0:
+                arrB_tick[(r, int(RSB_mb[t_, r]), int(RSB_ch[t_, r]))] = t_
+
+    F_in_slot = np_.full((T, p), -1, np_.int32)
+    F_stash_slot = np_.full((T, p), -1, np_.int32)
+    F_dy_slot = np_.full((T, p), -1, np_.int32)     # tail writes dL/dy
+    B_stash_slot = np_.full((T, p), -1, np_.int32)
+    B_dy_slot = np_.full((T, p), -1, np_.int32)
+    RSF_slot = np_.full((T, p), -1, np_.int32)
+    RSB_slot = np_.full((T, p), -1, np_.int32)
+    S_in = S_stash = S_dy = 1
+    for r in range(p):
+        stash_iv, in_iv, dy_iv = [], [], []
+        for i in range(m):
+            for j in range(v):
+                s = j * p + r
+                tf, tb = fwd_tick[(r, i, j)], bwd_tick[(r, i, j)]
+                stash_iv.append((tf, tb, (i, j)))
+                if s > 0:
+                    in_iv.append((arrF_tick[(r, i, j)], tf, (i, j)))
+                dy_w = tf if s == V - 1 else arrB_tick[(r, i, j)]
+                dy_iv.append((dy_w, tb, (i, j)))
+        stash_slots, n_st = alloc(stash_iv)
+        in_slots, n_in = alloc(in_iv)
+        dy_slots, n_dy = alloc(dy_iv)
+        S_stash, S_in, S_dy = (max(S_stash, n_st), max(S_in, n_in),
+                               max(S_dy, n_dy))
+        for i in range(m):
+            for j in range(v):
+                s = j * p + r
+                tf, tb = fwd_tick[(r, i, j)], bwd_tick[(r, i, j)]
+                F_stash_slot[tf, r] = stash_slots[(i, j)]
+                B_stash_slot[tb, r] = stash_slots[(i, j)]
+                B_dy_slot[tb, r] = dy_slots[(i, j)]
+                if s > 0:
+                    F_in_slot[tf, r] = in_slots[(i, j)]
+                    RSF_slot[arrF_tick[(r, i, j)], r] = in_slots[(i, j)]
+                if s == V - 1:
+                    F_dy_slot[tf, r] = dy_slots[(i, j)]
+                else:
+                    RSB_slot[arrB_tick[(r, i, j)], r] = dy_slots[(i, j)]
+
     return {"T": T, "F_mb": F_mb, "F_ch": F_ch, "B_mb": B_mb, "B_ch": B_ch,
             "RSF_mb": RSF_mb, "RSF_ch": RSF_ch, "RSB_mb": RSB_mb,
-            "RSB_ch": RSB_ch}
+            "RSB_ch": RSB_ch,
+            "F_in_slot": F_in_slot, "F_stash_slot": F_stash_slot,
+            "F_dy_slot": F_dy_slot, "B_stash_slot": B_stash_slot,
+            "B_dy_slot": B_dy_slot, "RSF_slot": RSF_slot,
+            "RSB_slot": RSB_slot,
+            "S_in": S_in, "S_stash": S_stash, "S_dy": S_dy}
 
 
 def pipeline_interleaved(h0, labels, consts, stacked_leaves, tail_leaves, *,
@@ -329,9 +693,11 @@ def pipeline_interleaved(h0, labels, consts, stacked_leaves, tail_leaves, *,
     schedule tables (see _interleaved_schedule) — fill/drain cost is the
     (p-1)/v property of interleaving, not v sequential ring phases.
 
-    Activation stash and ring in/out buffers are indexed [chunk, microbatch]
-    (O(v*m) activations — simpler than Megatron's O(p) rotating stash; a
-    slot-reuse pass can shrink it later without changing the schedule).
+    Activation stash and ring in/out buffers are slot-indexed: the
+    host-simulated schedule computes each unit's [write, read] lifetime and
+    a linear-scan allocation packs them into the true high-water mark of
+    slots (S_stash/S_in/S_dy), not O(v*m) — the memory property interleaving
+    exists to buy (Megatron's O(p) rotating stash, pipeline_parallel.py:1308).
     h0: [m, mb, ...]; labels: [m, ...]; stacked_leaves: [L_local, ...] with
     L_local = v * lc rows, chunk j = rows [j*lc, (j+1)*lc).
     Returns (mean_loss, d_h0, blk_grads, tail_grads) like pipeline_1f1b.
@@ -359,13 +725,13 @@ def pipeline_interleaved(h0, labels, consts, stacked_leaves, tail_leaves, *,
 
     x0 = jnp.zeros_like(h0[0])
     zeros_like_tree = lambda tr: jax.tree.map(jnp.zeros_like, tr)
-    buf_shape = (v, m) + h0.shape[1:]
+    unit = h0.shape[1:]
     carry0 = (
         x0,                                   # x_recv
         x0,                                   # dy_recv
-        jnp.zeros(buf_shape, h0.dtype),       # in_buf[ch, mb]
-        jnp.zeros(buf_shape, h0.dtype),       # dy_buf[ch, mb]
-        jnp.zeros(buf_shape, h0.dtype),       # stash[ch, mb]
+        jnp.zeros((sched["S_in"],) + unit, h0.dtype),     # in_buf[slot]
+        jnp.zeros((sched["S_dy"],) + unit, h0.dtype),     # dy_buf[slot]
+        jnp.zeros((sched["S_stash"],) + unit, h0.dtype),  # stash[slot]
         jnp.float32(0.0),                     # loss accumulator
         zeros_like_tree(list(stacked_leaves)),  # block grads
         zeros_like_tree(list(tail_leaves)),     # tail grads
@@ -375,23 +741,23 @@ def pipeline_interleaved(h0, labels, consts, stacked_leaves, tail_leaves, *,
 
     tables = tuple(jnp.asarray(sched[k]) for k in
                    ("F_mb", "F_ch", "B_mb", "B_ch",
-                    "RSF_mb", "RSF_ch", "RSB_mb", "RSB_ch"))
+                    "F_in_slot", "F_stash_slot", "F_dy_slot",
+                    "B_stash_slot", "B_dy_slot", "RSF_slot", "RSB_slot"))
 
     def tick(carry, xs):
         (x_recv, dy_recv, in_buf, dy_buf, stash, loss_acc, blk_g, tail_g,
          dh0_acc) = carry
-        f_mb, f_ch, b_mb, b_ch, rsf_mb, rsf_ch, rsb_mb, rsb_ch = [
+        (f_mb, f_ch, b_mb, b_ch, f_in_slot, f_stash_slot, f_dy_slot,
+         b_stash_slot, b_dy_slot, rsf_slot, rsb_slot) = [
             row[rank] for row in xs]
 
         # ---- store ring arrivals -----------------------------------------
-        def store(buf, val, ch, mb, valid):
-            ch_i = jnp.clip(ch, 0, v - 1)
-            mb_i = jnp.clip(mb, 0, m - 1)
-            cur = buf[ch_i, mb_i]
-            return buf.at[ch_i, mb_i].set(jnp.where(valid, val, cur))
+        def store(buf, val, slot, valid):
+            si = jnp.clip(slot, 0, buf.shape[0] - 1)
+            return buf.at[si].set(jnp.where(valid, val, buf[si]))
 
-        in_buf = store(in_buf, x_recv, rsf_ch, rsf_mb, rsf_mb >= 0)
-        dy_buf = store(dy_buf, dy_recv, rsb_ch, rsb_mb, rsb_mb >= 0)
+        in_buf = store(in_buf, x_recv, rsf_slot, rsf_slot >= 0)
+        dy_buf = store(dy_buf, dy_recv, rsb_slot, rsb_slot >= 0)
 
         # ---- forward micro-step ------------------------------------------
         fwd_valid = f_mb >= 0
@@ -399,10 +765,10 @@ def pipeline_interleaved(h0, labels, consts, stacked_leaves, tail_leaves, *,
         fj = jnp.clip(f_ch, 0, v - 1)
         s_virt = fj * p + rank
         fresh = lax.dynamic_index_in_dim(h0, fi, 0, keepdims=False)
-        from_buf = in_buf[fj, fi]
+        from_buf = in_buf[jnp.clip(f_in_slot, 0, in_buf.shape[0] - 1)]
         x_in = jnp.where(s_virt == 0, fresh, from_buf)
         y = stage_fn(x_in, chunk_slices(list(stacked_leaves), fj))
-        stash = store(stash, x_in, fj, fi, fwd_valid)
+        stash = store(stash, x_in, f_stash_slot, fwd_valid)
 
         # last virtual stage: loss + dL/dy, fed straight into dy_buf
         lab = lax.dynamic_index_in_dim(labels, fi, 0, keepdims=False)
@@ -422,15 +788,16 @@ def pipeline_interleaved(h0, labels, consts, stacked_leaves, tail_leaves, *,
             is_last_virt, tail_branch, tail_skip, y, tuple(tail_leaves))
         loss_acc = loss_acc + loss_f / m
         tail_g = [tg + dt for tg, dt in zip(tail_g, dtail_f)]
-        dy_buf = store(dy_buf, dh_f.astype(h0.dtype), fj, fi, is_last_virt)
+        dy_buf = store(dy_buf, dh_f.astype(h0.dtype), f_dy_slot,
+                       is_last_virt)
 
         # ---- backward micro-step -----------------------------------------
         bwd_valid = b_mb >= 0
         bi = jnp.clip(b_mb, 0, m - 1)
         bj = jnp.clip(b_ch, 0, v - 1)
         sb_virt = bj * p + rank
-        x_b = stash[bj, bi]
-        dy_in = dy_buf[bj, bi]
+        x_b = stash[jnp.clip(b_stash_slot, 0, stash.shape[0] - 1)]
+        dy_in = dy_buf[jnp.clip(b_dy_slot, 0, dy_buf.shape[0] - 1)]
         _, st_vjp = jax.vjp(
             lambda xx, lv: stage_fn(xx, chunk_slices(lv, bj)),
             x_b, list(stacked_leaves))
@@ -475,7 +842,7 @@ class PipelinedTrainer(SpmdTrainer):
 
     STACK_PREFIX = "pp_stacked."
 
-    SCHEDULES = ("circular", "1f1b", "vpp", "interleave")
+    SCHEDULES = ("circular", "1f1b", "vpp", "interleave", "zb")
 
     def __init__(self, model, optimizer, loss_fn, mesh=None,
                  n_micro: int = 1, remat: bool = True,
@@ -642,7 +1009,7 @@ class PipelinedTrainer(SpmdTrainer):
 
     # -- 1F1B / interleave: manual schedules, grads produced by the region -----
     def _build(self, batch_arrays):
-        if self.schedule not in ("1f1b", "interleave"):
+        if self.schedule not in ("1f1b", "interleave", "zb"):
             return super()._build(batch_arrays)
         if self._jax_mesh is None or "pp" not in self.mesh.dim_names:
             raise ValueError(
@@ -683,6 +1050,11 @@ class PipelinedTrainer(SpmdTrainer):
                 pipeline_interleaved, block_apply_flat=block_apply_flat,
                 tail_apply_flat=tail_apply_flat, axis_name="pp", n_micro=nm,
                 vpp_chunks=self.vpp_chunks, remat=self._pp_remat)
+        elif self.schedule == "zb":
+            region = functools.partial(
+                pipeline_zb, block_apply_flat=block_apply_flat,
+                tail_apply_flat=tail_apply_flat, axis_name="pp", n_micro=nm,
+                remat=self._pp_remat)
         else:
             region = functools.partial(
                 pipeline_1f1b, block_apply_flat=block_apply_flat,

@@ -246,3 +246,82 @@ def test_pipeline_interleave_hybrid_pp_mp():
                             schedule="interleave", vpp_chunks=2)
     got = _train(pipe, cfg2, steps=2)
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_interleave_slot_reuse_matches_high_water_mark():
+    """Slot-allocated buffers equal the schedule's true max-in-flight unit
+    count (computed independently from the tick tables), and shrink far
+    below the old O(v*m) allocation."""
+    from paddle_tpu.parallel.pipeline import _interleaved_schedule
+    for p, v, m in ((2, 2, 8), (4, 2, 8), (2, 3, 6)):
+        s = _interleaved_schedule(p, v, m)
+        T = s["T"]
+        # independent recomputation: max overlap of [fwd, bwd] lifetimes
+        expect_stash = 0
+        for r in range(p):
+            fwd_t, bwd_t = {}, {}
+            for t in range(T):
+                if s["F_mb"][t, r] >= 0:
+                    fwd_t[(s["F_mb"][t, r], s["F_ch"][t, r])] = t
+                if s["B_mb"][t, r] >= 0:
+                    bwd_t[(s["B_mb"][t, r], s["B_ch"][t, r])] = t
+            live = [sum(1 for k in fwd_t
+                        if fwd_t[k] <= t <= bwd_t[k]) for t in range(T)]
+            expect_stash = max(expect_stash, max(live))
+        assert s["S_stash"] == expect_stash, (p, v, m)
+        assert s["S_stash"] < v * m  # strictly better than the old layout
+        assert s["S_in"] <= s["S_stash"] + 1
+        assert s["S_dy"] <= v * m
+        # every scheduled read/write has a slot assigned
+        for t in range(T):
+            for r in range(p):
+                if s["F_mb"][t, r] >= 0:
+                    assert s["F_stash_slot"][t, r] >= 0
+                    if s["F_ch"][t, r] * p + r > 0:
+                        assert s["F_in_slot"][t, r] >= 0
+                if s["B_mb"][t, r] >= 0:
+                    assert s["B_stash_slot"][t, r] >= 0
+                    assert s["B_dy_slot"][t, r] >= 0
+
+
+def test_pipeline_zb_matches_serial():
+    """ZB-H1: backward split into a dx lane (1F1B timing) and a deferred
+    weight-gradient lane; numerics must match serial training exactly like
+    the other schedules."""
+    cfg, model, optim = _make()
+    serial = SpmdTrainer(model, optim, _loss_fn, mesh=None)
+    ref = _train(serial, cfg)
+
+    cfg2, model2, optim2 = _make()
+    mesh = make_hybrid_mesh(dp=1, pp=2)
+    pipe = PipelinedTrainer(model2, optim2, _loss_fn, mesh=mesh, n_micro=4,
+                            schedule="zb")
+    got = _train(pipe, cfg2)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_zb_schedule_makespans_and_memory_bound():
+    """The dx/dw split always shortens the async critical path (upstream
+    stages get dx one work unit earlier); under the per-tick ppermute
+    barrier the load-aware W placement wins when the fill/drain slack can
+    absorb the W units (m <~ 2p) and never loses; and the staleness bound
+    keeps the deferred (x, dy) buffer O(p), preserving 1F1B's memory
+    property (quantified version of PIPELINE_SCHEDULES.md's analysis)."""
+    from paddle_tpu.parallel.pipeline import _zb_schedule
+    for p, m in ((2, 4), (4, 8), (4, 16), (8, 16), (8, 32)):
+        s = _zb_schedule(p, m)
+        assert s["makespan_async_zb"] < s["makespan_async_1f1b"], (p, m)
+        assert s["makespan_lockstep_zb"] <= s["makespan_lockstep_1f1b"]
+        assert s["S_w"] <= 2 * p + 1, (p, m, s["S_w"])
+    # the regime the slack can absorb: strict lockstep win
+    s = _zb_schedule(8, 16)
+    assert s["makespan_lockstep_zb"] < s["makespan_lockstep_1f1b"]
+    # every unit's W scheduled exactly once per device, at/after its B
+    for p, m in ((4, 8),):
+        s = _zb_schedule(p, m)
+        for r in range(p):
+            w_rows = [t for t in range(s["T"]) if s["W_mb"][t, r] >= 0]
+            assert len(w_rows) == m
+            for t in w_rows:
+                i = s["W_mb"][t, r]
+                assert t >= 2 * (p - 1) - r + i  # not before its B tick
