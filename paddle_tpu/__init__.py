@@ -90,7 +90,7 @@ from .ops.special import (  # noqa: F401
 from .ops.random_ops import (  # noqa: F401
     bernoulli, bernoulli_, binomial, multinomial, normal, poisson, rand,
     rand_like, randint, randint_like, randn, randn_like, randperm,
-    standard_normal, uniform, uniform_,
+    standard_gamma, standard_normal, uniform, uniform_,
 )
 
 from . import autograd  # noqa: F401

@@ -14,9 +14,11 @@ from .vision import (  # noqa: F401
 )
 from .common import (  # noqa: F401
     bilinear,
-    alpha_dropout, channel_shuffle, cosine_similarity, dropout, dropout2d,
+    alpha_dropout, channel_shuffle, class_center_sample, cosine_similarity,
+    dropout, dropout2d,
     dropout3d, embedding, fold, interpolate, label_smooth, linear, one_hot, pad,
-    pixel_shuffle, pixel_unshuffle, unfold, upsample, zeropad2d,
+    pixel_shuffle, pixel_unshuffle, sparse_attention, unfold, upsample,
+    zeropad2d,
 )
 from .conv import (  # noqa: F401
     conv1d, conv1d_transpose, conv2d, conv2d_transpose, conv3d, conv3d_transpose,

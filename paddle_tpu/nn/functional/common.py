@@ -326,3 +326,95 @@ def bilinear(x1, x2, weight, bias=None, name=None):
         return out.astype(a.dtype)
 
     return dispatch("bilinear", fwd, *args)
+
+
+def class_center_sample(label, num_classes, num_samples, group=None,
+                        name=None):
+    """PartialFC class-center sampling (parity:
+    paddle.nn.functional.class_center_sample, nn/functional/common.py:2372 /
+    class_center_sample kernel). Keeps every positive class center, fills up
+    to num_samples with uniformly sampled negatives, returns
+    (remapped_label, sampled_class_index) with the sampled set sorted
+    ascending. If the positives alone exceed num_samples they are all kept
+    (matching the reference's documented behavior)."""
+    from ...framework.random import next_key
+    lt = ensure_tensor(label)
+    lab = lt._data.astype(jnp.int32)
+    pos_mask = jnp.zeros((num_classes,), jnp.bool_).at[lab].set(True)
+    n_pos = int(jnp.sum(pos_mask))
+    n_keep = max(int(num_samples), n_pos)
+    # priority sort: positives first (score -1), negatives by random score
+    score = jnp.where(pos_mask, -1.0,
+                      jax.random.uniform(next_key(), (num_classes,)))
+    sampled = jnp.sort(jnp.argsort(score)[:n_keep])
+    remapped = jnp.searchsorted(sampled, lab).astype(lab.dtype)
+    return (Tensor(remapped.astype(jnp.int64)),
+            Tensor(sampled.astype(jnp.int64)))
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """CSR-masked attention (parity: paddle.nn.functional.sparse_attention /
+    sparse_attention CUDA kernel — nn/functional/sparse_attention.py:22).
+    q/k/v: [B, H, S, D]; offset: [B, H, S+1]; columns: [B, H, nnz]. Each
+    query row i attends only to columns[offset[i]:offset[i+1]].
+
+    TPU-native: instead of the SDD block kernels, scores are computed per
+    stored nonzero (gather q-row and k-column), softmax is a segment
+    reduction over rows, and the output is a segment sum of p * v — O(nnz)
+    work and fully vectorized/jit-able.
+    """
+    qt, kt, vt = ensure_tensor(query), ensure_tensor(key), ensure_tensor(value)
+    ot, ct = ensure_tensor(sparse_csr_offset), ensure_tensor(sparse_csr_columns)
+    args = [qt, kt, vt, ot, ct]
+    if key_padding_mask is not None:
+        args.append(ensure_tensor(key_padding_mask))
+    has_kpm = key_padding_mask is not None
+    if attn_mask is not None:
+        args.append(ensure_tensor(attn_mask))
+    has_am = attn_mask is not None
+
+    def fwd(q, k, v, offset, cols, *rest):
+        b, h, s, d = q.shape
+        nnz = cols.shape[-1]
+        offset = offset.astype(jnp.int32)
+        cols = cols.astype(jnp.int32)
+        # row id of each stored nonzero: r[j] = #{i : offset[i+1] <= j}
+        pos = jnp.arange(nnz)
+
+        def one_head(qh, kh, vh, off, cl, kpm, am):
+            rows = jnp.searchsorted(off[1:], pos, side="right")  # [nnz]
+            rows = jnp.clip(rows, 0, s - 1)
+            scl = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+            scores = (qh[rows].astype(jnp.float32)
+                      * kh[cl].astype(jnp.float32)).sum(-1) * scl
+            if kpm is not None:   # 0 => masked key
+                scores = jnp.where(kpm[cl] == 0, -jnp.inf, scores)
+            if am is not None:    # 0 => masked (i, j) pair
+                scores = jnp.where(am[rows, cl] == 0, -jnp.inf, scores)
+            # entries beyond this head's true nnz (padded) are invalid
+            valid = pos < off[-1]
+            scores = jnp.where(valid, scores, -jnp.inf)
+            rmax = jax.ops.segment_max(scores, rows, num_segments=s)
+            rmax = jnp.where(jnp.isfinite(rmax), rmax, 0.0)
+            p = jnp.where(valid, jnp.exp(scores - rmax[rows]), 0.0)
+            denom = jax.ops.segment_sum(p, rows, num_segments=s)
+            out = jax.ops.segment_sum(p[:, None] * vh[cl].astype(jnp.float32),
+                                      rows, num_segments=s)
+            return out / jnp.maximum(denom, 1e-20)[:, None]
+
+        kpm = rest[0] if has_kpm else None            # [B, S] or None
+        am = rest[has_kpm] if has_am else None        # [S, S] shared or None
+        kpm_b = kpm if kpm is not None else jnp.ones((b, s), jnp.float32)
+        am_b = am if am is not None else jnp.ones((s, s), jnp.float32)
+        inner = jax.vmap(  # over heads; kpm is per-batch, am is global
+            lambda qh, kh, vh, off, cl, m, a: one_head(
+                qh, kh, vh, off, cl,
+                m if has_kpm else None, a if has_am else None),
+            in_axes=(0, 0, 0, 0, 0, None, None))
+        out = jax.vmap(inner, in_axes=(0, 0, 0, 0, 0, 0, None))(
+            q, k, v, offset, cols, kpm_b, am_b)
+        return out.astype(q.dtype)
+
+    return dispatch("sparse_attention", fwd, *args)

@@ -115,6 +115,17 @@ def poisson(x, name=None):
     return Tensor(jax.random.poisson(next_key(), xt._data).astype(xt._data.dtype))
 
 
+def standard_gamma(x, name=None):
+    """Sample Gamma(alpha=x, scale=1) per element (parity: paddle
+    standard_gamma, ops.yaml standard_gamma). Reparameterized: jax's gamma
+    sampler carries implicit gradients d(sample)/d(alpha)."""
+    from .dispatch import dispatch
+    xt = ensure_tensor(x)
+    key = next_key()
+    return dispatch("standard_gamma",
+                    lambda a: jax.random.gamma(key, a).astype(a.dtype), xt)
+
+
 def binomial(count, prob, name=None):
     ct, pt = ensure_tensor(count), ensure_tensor(prob)
     return Tensor(jax.random.binomial(next_key(), ct._data, pt._data)

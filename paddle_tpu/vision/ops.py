@@ -796,3 +796,151 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
 
 
 __all__ += ["generate_proposals"]
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 loss (parity: paddle.vision.ops.yolo_loss / yolo_loss kernel,
+    reference vision/ops.py:69). x: [N, S*(5+cls), H, W] raw head output;
+    gt_box: [N, B, 4] center-format (cx, cy, w, h) normalized to the input
+    image; gt_label: [N, B] int; returns per-sample loss [N].
+
+    Loss = sigmoid-CE on (x, y) + L1 on (w, h), both scaled by
+    (2 - gw*gh); sigmoid-CE objectness (negatives with best-gt IoU >
+    ignore_thresh are ignored); sigmoid-CE classification at positives
+    (optionally label-smoothed). Each gt matches the best-IoU anchor over
+    ALL anchors; it contributes only if that anchor is in anchor_mask.
+    TPU-native: assignment is a vectorized scatter over (N, B) with
+    out-of-bounds drop; no per-box Python loop.
+    """
+    import numpy as np
+
+    xt = ensure_tensor(x)
+    gbt, glt = ensure_tensor(gt_box), ensure_tensor(gt_label)
+    args = [xt, gbt, glt]
+    if gt_score is not None:
+        args.append(ensure_tensor(gt_score))
+    has_score = gt_score is not None
+    anchors_np = np.asarray(anchors, np.float32).reshape(-1, 2)
+    mask_np = np.asarray(anchor_mask, np.int32)
+
+    def fwd(xa, gb, gl, *rest):
+        n, c, h, w = xa.shape
+        s = len(mask_np)
+        assert c == s * (5 + class_num), (c, s, class_num)
+        xa = xa.reshape(n, s, 5 + class_num, h, w).astype(jnp.float32)
+        gb = gb.astype(jnp.float32)
+        gl = gl.astype(jnp.int32)
+        score = (rest[0].astype(jnp.float32) if has_score
+                 else jnp.ones(gb.shape[:2], jnp.float32))
+        in_w = float(w * downsample_ratio)
+        in_h = float(h * downsample_ratio)
+        aw = jnp.asarray(anchors_np[:, 0])            # all anchors, px
+        ah = jnp.asarray(anchors_np[:, 1])
+        m_aw = aw[mask_np]                            # masked anchors [S]
+        m_ah = ah[mask_np]
+
+        tx, ty, tw, th = xa[:, :, 0], xa[:, :, 1], xa[:, :, 2], xa[:, :, 3]
+        tobj = xa[:, :, 4]
+        tcls = xa[:, :, 5:]                           # [N, S, cls, H, W]
+
+        # ---- predicted boxes (normalized) for the ignore mask ------------
+        gx_grid = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+        gy_grid = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+        sig = jax.nn.sigmoid
+        bx = (sig(tx) * scale_x_y - 0.5 * (scale_x_y - 1.0) + gx_grid) / w
+        by = (sig(ty) * scale_x_y - 0.5 * (scale_x_y - 1.0) + gy_grid) / h
+        bw = jnp.exp(tw) * m_aw[None, :, None, None] / in_w
+        bh = jnp.exp(th) * m_ah[None, :, None, None] / in_h
+
+        valid_gt = (gb[..., 2] > 0) & (gb[..., 3] > 0)        # [N, B]
+
+        def box_iou_centered(cx1, cy1, w1, h1, cx2, cy2, w2, h2):
+            l1, r1 = cx1 - w1 / 2, cx1 + w1 / 2
+            t1, b1 = cy1 - h1 / 2, cy1 + h1 / 2
+            l2, r2 = cx2 - w2 / 2, cx2 + w2 / 2
+            t2, b2 = cy2 - h2 / 2, cy2 + h2 / 2
+            iw = jnp.maximum(jnp.minimum(r1, r2) - jnp.maximum(l1, l2), 0)
+            ih = jnp.maximum(jnp.minimum(b1, b2) - jnp.maximum(t1, t2), 0)
+            inter = iw * ih
+            return inter / jnp.maximum(w1 * h1 + w2 * h2 - inter, 1e-10)
+
+        # best IoU of each prediction vs any gt: [N, S, H, W]
+        iou_pg = box_iou_centered(
+            bx[:, None], by[:, None], bw[:, None], bh[:, None],
+            gb[:, :, None, None, None, 0], gb[:, :, None, None, None, 1],
+            gb[:, :, None, None, None, 2], gb[:, :, None, None, None, 3])
+        iou_pg = jnp.where(valid_gt[:, :, None, None, None], iou_pg, 0.0)
+        best_iou = iou_pg.max(axis=1)
+        ignore = best_iou > ignore_thresh                     # [N, S, H, W]
+
+        # ---- gt -> (anchor, cell) assignment -----------------------------
+        gw_px, gh_px = gb[..., 2] * in_w, gb[..., 3] * in_h   # [N, B]
+        # wh-IoU vs every anchor (centered at origin)
+        inter = (jnp.minimum(gw_px[..., None], aw) *
+                 jnp.minimum(gh_px[..., None], ah))
+        iou_a = inter / jnp.maximum(
+            gw_px[..., None] * gh_px[..., None] + aw * ah - inter, 1e-10)
+        best_a = jnp.argmax(iou_a, axis=-1)                   # [N, B]
+        # position of best_a inside anchor_mask, or -1
+        in_mask = jnp.full(iou_a.shape[:2], -1, jnp.int32)
+        for mi, a_idx in enumerate(mask_np):
+            in_mask = jnp.where(best_a == int(a_idx), mi, in_mask)
+        gi = jnp.clip((gb[..., 0] * w).astype(jnp.int32), 0, w - 1)
+        gj = jnp.clip((gb[..., 1] * h).astype(jnp.int32), 0, h - 1)
+        pos = valid_gt & (in_mask >= 0)                       # [N, B]
+        # scatter indices; invalid rows -> out-of-bounds (mode="drop")
+        BIG = s * h * w + 7
+        n_ix = jnp.broadcast_to(jnp.arange(n)[:, None], pos.shape)
+        flat = jnp.where(pos, (in_mask * h + gj) * w + gi, BIG)
+
+        def scat(val, init=0.0):
+            tgt = jnp.full((n, s * h * w), init, jnp.float32)
+            return tgt.at[n_ix, flat].set(val, mode="drop") \
+                .reshape(n, s, h, w)
+
+        tx_t = scat(gb[..., 0] * w - gi)
+        ty_t = scat(gb[..., 1] * h - gj)
+        m_aw_g = m_aw[jnp.clip(in_mask, 0, s - 1)]
+        m_ah_g = m_ah[jnp.clip(in_mask, 0, s - 1)]
+        tw_t = scat(jnp.log(jnp.maximum(gw_px / jnp.maximum(m_aw_g, 1e-6),
+                                        1e-9)))
+        th_t = scat(jnp.log(jnp.maximum(gh_px / jnp.maximum(m_ah_g, 1e-6),
+                                        1e-9)))
+        wt_t = scat(2.0 - gb[..., 2] * gb[..., 3])
+        sc_t = scat(score)
+        pos_t = scat(jnp.ones_like(score))                    # positive mask
+        lbl_t = scat(gl.astype(jnp.float32))                  # class id
+
+        def bce(logit, target):
+            return jnp.maximum(logit, 0) - logit * target + \
+                jnp.log1p(jnp.exp(-jnp.abs(logit)))
+
+        # location losses at positives
+        loss_xy = pos_t * wt_t * sc_t * (bce(tx, tx_t) + bce(ty, ty_t))
+        loss_wh = pos_t * wt_t * sc_t * 0.5 * (jnp.abs(tw - tw_t)
+                                               + jnp.abs(th - th_t))
+        # objectness: positives target their mixup score; negatives target 0
+        # unless ignored
+        obj_pos = pos_t * sc_t * bce(tobj, jnp.ones_like(tobj))
+        obj_neg = (1.0 - pos_t) * jnp.where(ignore, 0.0, 1.0) * \
+            bce(tobj, jnp.zeros_like(tobj))
+        loss_obj = obj_pos + obj_neg
+        # classification at positives
+        smooth_hi = 1.0 - 1.0 / class_num if use_label_smooth else 1.0
+        smooth_lo = 1.0 / class_num if use_label_smooth else 0.0
+        onehot = jax.nn.one_hot(lbl_t.astype(jnp.int32), class_num,
+                                axis=2)                        # [N,S,cls,H,W]
+        cls_t = onehot * smooth_hi + (1 - onehot) * smooth_lo
+        loss_cls = (pos_t[:, :, None] * sc_t[:, :, None]
+                    * bce(tcls, cls_t)).sum(axis=2)
+        total = (loss_xy + loss_wh + loss_obj + loss_cls) \
+            .sum(axis=(1, 2, 3))
+        return total
+
+    import jax
+    return dispatch("yolo_loss", fwd, *args)
+
+
+__all__ += ["yolo_loss"]
