@@ -14,6 +14,7 @@ pure jnp and trace into compiled programs.
 """
 from __future__ import annotations
 
+import inspect
 import math
 from typing import Dict, Tuple, Type
 
@@ -64,14 +65,24 @@ def _rebuild_ctor(ctor, arrays):
 
 
 def _diff_route(cls, name, orig, is_prop):
-    def wrapped(self, *args):
+    fn = orig.fget if is_prop else orig
+    sig = inspect.signature(fn) if not is_prop else None
+
+    def wrapped(self, *args, **kwargs):
         from ..autograd.tape import is_grad_enabled
         from ..ops.dispatch import dispatch
+        if kwargs:
+            # keyword calls (log_prob(value=v), rsample(shape=s)) must reach
+            # the positional-only dispatch path: bind them to the method's
+            # signature so kwarg Tensors are routed like positional ones
+            bound = sig.bind(self, *args, **kwargs)
+            args = bound.args[1:]
+            kwargs = bound.kwargs
         ctor = getattr(self, "_ctor", None)
         params = _ctor_tensors(ctor) if ctor is not None else []
         t_args = [a for a in args if isinstance(a, Tensor)]
         if not params or not is_grad_enabled():
-            return orig(self, *args) if not is_prop else orig.fget(self)
+            return fn(self, *args, **kwargs)
 
         def fwd(*arrays):
             pv = arrays[:len(params)]
@@ -81,8 +92,7 @@ def _diff_route(cls, name, orig, is_prop):
             type(self).__init__(clone, *na, **nk)
             new_args = [av.pop(0) if isinstance(a, Tensor) else a
                         for a in args]
-            out = (orig(clone, *new_args) if not is_prop
-                   else orig.fget(clone))
+            out = fn(clone, *new_args, **kwargs)
             return out._data
 
         return dispatch(f"dist_{cls.__name__}.{name}", fwd, *params, *t_args)

@@ -283,10 +283,8 @@ class StudentT(Distribution):
     def entropy(self):
         d = self.df
         dg = jax.scipy.special.digamma
-        gammaln = jax.scipy.special.gammaln
         ent = ((d + 1) / 2 * (dg((d + 1) / 2) - dg(d / 2))
                + 0.5 * jnp.log(d) + _lbeta(d / 2, 0.5) + jnp.log(self.scale))
-        del gammaln
         return Tensor(jnp.broadcast_to(ent, self.batch_shape))
 
 

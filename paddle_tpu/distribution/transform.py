@@ -150,9 +150,14 @@ class ChainTransform(Transform):
     """Composition t_n(...t_1(x)); log-dets accumulate through the chain."""
 
     def __init__(self, transforms):
+        transforms = list(transforms)
+        if not transforms:
+            raise ValueError(
+                "ChainTransform requires at least one transform; pass the "
+                "base distribution directly instead of an empty chain")
         if not all(isinstance(t, Transform) for t in transforms):
             raise TypeError("all elements must be Transforms")
-        self.transforms = list(transforms)
+        self.transforms = transforms
         kinds = {t._type for t in self.transforms}
         if kinds <= {Type.BIJECTION}:
             self._type = Type.BIJECTION

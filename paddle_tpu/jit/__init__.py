@@ -239,13 +239,11 @@ class StaticFunction:
         # seen the zero rows: keep the previous buffers and warn once
         if layer is not None and n_buf:
             if padded:
-                if not getattr(self, "_warned_buffers", False):
-                    self._warned_buffers = True
-                    import warnings
-                    warnings.warn(
-                        f"to_static({self.__name__}): bucket_batch padded "
-                        "the batch; buffer updates (e.g. BatchNorm running "
-                        "stats) are skipped for padded calls.", stacklevel=2)
+                self._warn_once(
+                    "_warned_buffers",
+                    f"to_static({self.__name__}): bucket_batch padded "
+                    "the batch; buffer updates (e.g. BatchNorm running "
+                    "stats) are skipped for padded calls.")
             else:
                 buffers = dict(layer.named_buffers())
                 for i, n in enumerate(self._buffer_names):
@@ -254,6 +252,12 @@ class StaticFunction:
         if padded:
             out = self._slice_outputs(out, orig_batch)
         return out
+
+    def _warn_once(self, flag, msg):
+        if not getattr(self, flag, False):
+            setattr(self, flag, True)
+            import warnings
+            warnings.warn(msg, stacklevel=3)
 
     # -- shape bucketing ------------------------------------------------------
     def _pad_args(self, spec, tensors):
@@ -267,6 +271,20 @@ class StaticFunction:
         pb = _next_bucket(b)
         if pb == b:
             return None, (b, b)
+        # a tensor whose *trailing* dims also equal b (e.g. a [B, B]
+        # attention mask or length-B per-class vector) is ambiguous: only
+        # axis 0 is padded, which silently corrupts a batch-square input
+        for t in tensors:
+            d = t._data
+            if d.ndim >= 2 and d.shape[0] == b and b in d.shape[1:]:
+                self._warn_once(
+                    "_warned_ambiguous_batch",
+                    f"to_static({self.__name__}): bucket_batch pads only "
+                    f"axis 0, but an input of shape {d.shape} also has a "
+                    f"trailing dim equal to the batch size {b}; if that "
+                    "dim is batch-coupled (e.g. a [B, B] mask) the "
+                    "padded call computes on zero rows.")
+                break
         padded = []
         for t in tensors:
             if t._data.ndim >= 1 and t._data.shape[0] == b:

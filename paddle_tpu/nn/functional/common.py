@@ -336,12 +336,26 @@ def class_center_sample(label, num_classes, num_samples, group=None,
     to num_samples with uniformly sampled negatives, returns
     (remapped_label, sampled_class_index) with the sampled set sorted
     ascending. If the positives alone exceed num_samples they are all kept
-    (matching the reference's documented behavior)."""
+    (matching the reference's documented behavior).
+
+    Eager-only: n_keep depends on the label values, so the result shape is
+    data-dependent and the op cannot trace under jit. The reference's
+    per-group distributed sampling (allreduced positives across the model-
+    parallel group) is not implemented; pass group=None."""
     from ...framework.random import next_key
+    if group is not None:
+        raise NotImplementedError(
+            "class_center_sample(group=...) distributed per-group sampling "
+            "is not implemented; call it per-rank with group=None")
     lt = ensure_tensor(label)
     lab = lt._data.astype(jnp.int32)
     pos_mask = jnp.zeros((num_classes,), jnp.bool_).at[lab].set(True)
-    n_pos = int(jnp.sum(pos_mask))
+    try:
+        n_pos = int(jnp.sum(pos_mask))
+    except jax.errors.ConcretizationTypeError as e:
+        raise NotImplementedError(
+            "class_center_sample is eager-only: the sampled-set size depends "
+            "on the label values, so it cannot run under jit tracing") from e
     n_keep = max(int(num_samples), n_pos)
     # priority sort: positives first (score -1), negatives by random score
     score = jnp.where(pos_mask, -1.0,

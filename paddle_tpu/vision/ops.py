@@ -894,6 +894,16 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
         BIG = s * h * w + 7
         n_ix = jnp.broadcast_to(jnp.arange(n)[:, None], pos.shape)
         flat = jnp.where(pos, (in_mask * h + gj) * w + gi, BIG)
+        # two gt boxes can land on the same (anchor, cell); XLA scatter-set
+        # with duplicate indices picks an arbitrary winner, so drop every gt
+        # shadowed by a later one — the last gt wins, like the reference
+        # kernel's sequential overwrite
+        nb = pos.shape[1]
+        same = (flat[:, :, None] == flat[:, None, :]) & \
+            pos[:, :, None] & pos[:, None, :]
+        later = jnp.triu(jnp.ones((nb, nb), jnp.bool_), k=1)
+        shadowed = jnp.any(same & later[None], axis=2)
+        flat = jnp.where(shadowed, BIG, flat)
 
         def scat(val, init=0.0):
             tgt = jnp.full((n, s * h * w), init, jnp.float32)

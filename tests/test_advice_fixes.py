@@ -1,0 +1,93 @@
+"""Regression tests for the round-3 advisor findings (ADVICE.md r03):
+keyword routing on distribution methods, empty ChainTransform, eager-only
+class_center_sample contract, bucket_batch ambiguous-input warning, and
+deterministic yolo_loss duplicate-cell assignment."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import distribution as D
+
+
+def test_distribution_methods_accept_keywords():
+    n = D.Normal(0.0, 1.0)
+    got = n.log_prob(value=paddle.to_tensor(0.5))
+    want = n.log_prob(paddle.to_tensor(0.5))
+    np.testing.assert_allclose(got.numpy(), want.numpy())
+    s = n.rsample(shape=(3,))
+    assert tuple(s.shape) == (3,)
+    assert float(n.cdf(value=paddle.to_tensor(0.0)).numpy()) == pytest.approx(
+        0.5, abs=1e-6)
+
+
+def test_distribution_keyword_args_reach_the_tape():
+    # the kwarg Tensor must be routed through dispatch so gradients flow
+    loc = paddle.to_tensor(np.float32(0.3))
+    loc.stop_gradient = False
+    v = paddle.to_tensor(np.float32(1.1))
+    v.stop_gradient = False
+    lp = D.Normal(loc, 1.0).log_prob(value=v)
+    lp.backward()
+    # d/dloc log N(v; loc, 1) = (v - loc); d/dv = -(v - loc)
+    np.testing.assert_allclose(loc.grad.numpy(), 0.8, rtol=1e-5)
+    np.testing.assert_allclose(v.grad.numpy(), -0.8, rtol=1e-5)
+
+
+def test_empty_transform_chain_rejected():
+    with pytest.raises(ValueError):
+        D.ChainTransform([])
+    with pytest.raises(ValueError):
+        D.TransformedDistribution(D.Normal(0.0, 1.0), [])
+
+
+def test_class_center_sample_group_not_implemented():
+    lab = paddle.to_tensor(np.array([1, 3, 5], np.int64))
+    with pytest.raises(NotImplementedError):
+        F.class_center_sample(lab, 10, 6, group=object())
+    # group=None path still works
+    remapped, sampled = F.class_center_sample(lab, 10, 6)
+    s = sampled.numpy()
+    assert len(s) == 6 and set([1, 3, 5]) <= set(s.tolist())
+    np.testing.assert_array_equal(s[remapped.numpy()], lab.numpy())
+
+
+def test_bucket_batch_warns_on_batch_square_input():
+    import paddle_tpu.nn as nn
+
+    class M(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, x, m):
+            return (self.fc(x)[:, None, :] * m).sum()
+
+    st = paddle.jit.to_static(M(), bucket_batch=True)
+    x = paddle.to_tensor(np.ones((3, 4), np.float32))
+    m = paddle.to_tensor(np.ones((3, 3, 4), np.float32))  # [B, B, 4]
+    with pytest.warns(UserWarning, match="trailing dim equal to the batch"):
+        st(x, m)
+
+
+def test_yolo_loss_duplicate_cell_later_gt_wins():
+    # two gt boxes with identical geometry (same cell + anchor) but different
+    # classes: the later one must own the cell, so the loss equals the loss
+    # computed with only the later box present
+    rng = np.random.default_rng(0)
+    n, cls, hw = 1, 4, 4
+    anchors = [10, 13, 16, 30, 33, 23]
+    x = rng.standard_normal((n, 3 * (5 + cls), hw, hw)).astype(np.float32)
+    box = np.array([0.5, 0.5, 0.2, 0.3], np.float32)
+    gt_dup = np.stack([box, box])[None]                     # [1, 2, 4]
+    lbl_dup = np.array([[1, 2]], np.int64)                  # earlier=1 later=2
+    gt_single = np.stack([box, np.zeros(4, np.float32)])[None]
+    lbl_single = np.array([[2, 0]], np.int64)               # only class 2
+
+    def loss(gt, lbl):
+        return paddle.vision.ops.yolo_loss(
+            paddle.to_tensor(x), paddle.to_tensor(gt), paddle.to_tensor(lbl),
+            anchors, [0, 1, 2], cls, 0.7, 32).numpy()
+
+    np.testing.assert_allclose(loss(gt_dup, lbl_dup),
+                               loss(gt_single, lbl_single), rtol=1e-5)
