@@ -60,10 +60,47 @@ def _causal_mask(iq, ik, block_q, block_k, offset):
     return q_pos + offset >= k_pos
 
 
+def _flashmask_visible(iq, ik, block_q, block_k, bounds, causal, window):
+    """FlashMask column-wise sparse mask for one [Bq, Bk] tile.
+
+    bounds: [4, Bk] int32 rows = (LTS, LTE, UTS, UTE) for this kv block's
+    columns — the canonical form of the reference's startend_row_indices
+    (python/paddle/nn/functional/flash_attention.py:1299): in the strict
+    lower triangle (i > j) rows LTS[j] <= i < LTE[j] are masked; in the
+    strict upper triangle (i < j) rows UTS[j] <= i < UTE[j] are masked
+    (causal masks the whole upper triangle instead). The O(S) bounds replace
+    the O(S^2) dense mask — this is the point of flashmask. window (wl, wr)
+    additionally restricts query i to keys in [i - wl, i + wr]."""
+    i = iq * block_q + jax.lax.broadcasted_iota(jnp.int32,
+                                                (block_q, block_k), 0)
+    j = ik * block_k + jax.lax.broadcasted_iota(jnp.int32,
+                                                (block_q, block_k), 1)
+    lts, lte = bounds[0][None, :], bounds[1][None, :]
+    masked_low = (i > j) & (i >= lts) & (i < lte)
+    if causal:
+        masked_up = i < j
+    else:
+        uts, ute = bounds[2][None, :], bounds[3][None, :]
+        masked_up = (i < j) & (i >= uts) & (i < ute)
+    masked = masked_low | masked_up
+    if window is not None:
+        wl, wr = window
+        if wl is not None:
+            masked = masked | (i > j + wl)
+        if not causal and wr is not None:
+            masked = masked | (i < j - wr)
+    return ~masked
+
+
 # -- forward ------------------------------------------------------------------
 
-def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scratch, l_scratch,
-               acc_scratch, *, scale, causal, block_q, block_k, nk, offset):
+def _fa_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, block_q, block_k,
+               nk, offset, masked=False, window=None):
+    if masked:
+        bounds_ref, o_ref, lse_ref, m_scratch, l_scratch, acc_scratch = rest
+    else:
+        bounds_ref = None
+        o_ref, lse_ref, m_scratch, l_scratch, acc_scratch = rest
     ik = pl.program_id(2)
     iq = pl.program_id(1)
 
@@ -73,12 +110,14 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scratch, l_scratch,
         l_scratch[:] = jnp.zeros_like(l_scratch)
         acc_scratch[:] = jnp.zeros_like(acc_scratch)
 
-    def _compute():
+    def _compute(vis=None):
         q = q_ref[0]                                 # [Bq, d] (input dtype)
         k = k_ref[0]                                 # [Bk, d]
         v = v_ref[0]                                 # [Bk, d]
         s = _dot(q, k, (((1,), (1,)))) * scale       # [Bq, Bk] fp32
-        if causal:
+        if vis is not None:
+            s = jnp.where(vis, s, NEG_INF)
+        elif causal:
             s = jnp.where(_causal_mask(iq, ik, block_q, block_k, offset), s,
                           NEG_INF)
         m_prev = m_scratch[:]                        # [Bq, 1]
@@ -93,7 +132,17 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scratch, l_scratch,
         m_scratch[:] = m_new
         l_scratch[:] = l_new
 
-    if causal:
+    if masked:
+        # Dynamic block skip — the flashmask win: a tile whose columns mask
+        # out every row (from the O(S) bounds, VPU-only work) never touches
+        # the MXU. Causal full-upper tiles fall out of the same test.
+        vis = _flashmask_visible(iq, ik, block_q, block_k, bounds_ref[0],
+                                 causal, window)
+
+        @pl.when(jnp.any(vis))
+        def _():
+            _compute(vis)
+    elif causal:
         # Skip fully-masked tiles (kv block entirely after the q block).
         @pl.when(ik * block_k <= iq * block_q + (block_q - 1) + offset)
         def _():
@@ -123,7 +172,8 @@ def _check_divisible(sq, sk, bq, bk, causal=False):
             f"(got {sq} > {sk}): leading rows would have empty masks")
 
 
-def _flash_forward(q, k, v, causal, scale, block_q, block_k):
+def _flash_forward(q, k, v, causal, scale, block_q, block_k, bounds=None,
+                   window=None):
     b, h, sq, d = q.shape
     sk = k.shape[2]
     bq = min(block_q, sq)
@@ -136,17 +186,26 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k):
     k_r = k.reshape(bh, sk, d)
     v_r = v.reshape(bh, sk, d)
     s = scale if scale is not None else 1.0 / math.sqrt(d)
+    masked = bounds is not None
+    inputs = [q_r, k_r, v_r]
+    in_specs = [
+        pl.BlockSpec((1, bq, d), lambda ibh, iq, ik: (ibh, iq, 0)),
+        pl.BlockSpec((1, bk, d), lambda ibh, iq, ik: (ibh, ik, 0)),
+        pl.BlockSpec((1, bk, d), lambda ibh, iq, ik: (ibh, ik, 0)),
+    ]
+    if masked:
+        # [b, h, sk, 4] -> [bh, 4, sk] (component-major for the kernel)
+        inputs.append(jnp.swapaxes(bounds.reshape(bh, sk, 4), 1, 2))
+        in_specs.append(
+            pl.BlockSpec((1, 4, bk), lambda ibh, iq, ik: (ibh, 0, ik)))
 
     kernel = functools.partial(_fa_kernel, scale=s, causal=causal, block_q=bq,
-                               block_k=bk, nk=nk, offset=sk - sq)
+                               block_k=bk, nk=nk, offset=sk - sq,
+                               masked=masked, window=window)
     out, lse = pl.pallas_call(
         kernel,
         grid=(bh, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda ibh, iq, ik: (ibh, iq, 0)),
-            pl.BlockSpec((1, bk, d), lambda ibh, iq, ik: (ibh, ik, 0)),
-            pl.BlockSpec((1, bk, d), lambda ibh, iq, ik: (ibh, ik, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda ibh, iq, ik: (ibh, iq, 0)),
             pl.BlockSpec((1, bq, LANES), lambda ibh, iq, ik: (ibh, iq, 0)),
@@ -163,14 +222,20 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_INTERPRET,
-    )(q_r, k_r, v_r)
+    )(*inputs)
     return out.reshape(b, h, sq, d), lse
 
 
 # -- backward -----------------------------------------------------------------
 
-def _fa_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
-                  acc_scratch, *, scale, causal, block_q, block_k, nk, offset):
+def _fa_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, *rest, scale,
+                  causal, block_q, block_k, nk, offset, masked=False,
+                  window=None):
+    if masked:
+        bounds_ref, dq_ref, acc_scratch = rest
+    else:
+        bounds_ref = None
+        dq_ref, acc_scratch = rest
     ik = pl.program_id(2)
     iq = pl.program_id(1)
 
@@ -178,7 +243,7 @@ def _fa_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
     def _init():
         acc_scratch[:] = jnp.zeros_like(acc_scratch)
 
-    def _compute():
+    def _compute(vis=None):
         q = q_ref[0]                                    # [Bq, d]
         k = k_ref[0]                                    # [Bk, d]
         v = v_ref[0]                                    # [Bk, d]
@@ -186,7 +251,9 @@ def _fa_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
         lse = lse_ref[0][:, :1]                         # [Bq, 1] fp32
         delta = delta_ref[0][:, :1]                     # [Bq, 1] fp32
         s = _dot(q, k, ((1,), (1,))) * scale            # [Bq, Bk] fp32
-        if causal:
+        if vis is not None:
+            s = jnp.where(vis, s, NEG_INF)
+        elif causal:
             s = jnp.where(_causal_mask(iq, ik, block_q, block_k, offset), s,
                           NEG_INF)
         p = jnp.exp(s - lse)                            # [Bq, Bk] fp32
@@ -194,7 +261,14 @@ def _fa_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
         ds = p * (dp - delta) * scale
         acc_scratch[:] += _dot(ds.astype(k.dtype), k, ((1,), (0,)))
 
-    if causal:
+    if masked:
+        vis = _flashmask_visible(iq, ik, block_q, block_k, bounds_ref[0],
+                                 causal, window)
+
+        @pl.when(jnp.any(vis))
+        def _():
+            _compute(vis)
+    elif causal:
         @pl.when(ik * block_k <= iq * block_q + (block_q - 1) + offset)
         def _():
             _compute()
@@ -206,9 +280,14 @@ def _fa_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = acc_scratch[:].astype(dq_ref.dtype)
 
 
-def _fa_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dk_ref,
-                   dv_ref, dk_scratch, dv_scratch, *, scale, causal, block_q,
-                   block_k, nq, offset):
+def _fa_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, *rest,
+                   scale, causal, block_q, block_k, nq, offset, masked=False,
+                   window=None):
+    if masked:
+        bounds_ref, dk_ref, dv_ref, dk_scratch, dv_scratch = rest
+    else:
+        bounds_ref = None
+        dk_ref, dv_ref, dk_scratch, dv_scratch = rest
     iq = pl.program_id(2)
     ik = pl.program_id(1)
 
@@ -217,7 +296,7 @@ def _fa_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dk_ref,
         dk_scratch[:] = jnp.zeros_like(dk_scratch)
         dv_scratch[:] = jnp.zeros_like(dv_scratch)
 
-    def _compute():
+    def _compute(vis=None):
         # Same orientation as the dq kernel ([Bq, Bk] tiles); dk/dv contract
         # over the q dim (dim 0) instead, so no in-kernel transposes.
         q = q_ref[0]                                    # [Bq, d]
@@ -227,7 +306,9 @@ def _fa_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dk_ref,
         lse = lse_ref[0][:, :1]                         # [Bq, 1] fp32
         delta = delta_ref[0][:, :1]                     # [Bq, 1] fp32
         s = _dot(q, k, ((1,), (1,))) * scale            # [Bq, Bk] fp32
-        if causal:
+        if vis is not None:
+            s = jnp.where(vis, s, NEG_INF)
+        elif causal:
             s = jnp.where(_causal_mask(iq, ik, block_q, block_k, offset), s,
                           NEG_INF)
         p = jnp.exp(s - lse)                            # [Bq, Bk] fp32
@@ -236,7 +317,14 @@ def _fa_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dk_ref,
         ds = p * (dp - delta) * scale
         dk_scratch[:] += _dot(ds.astype(q.dtype), q, ((0,), (0,)))
 
-    if causal:
+    if masked:
+        vis = _flashmask_visible(iq, ik, block_q, block_k, bounds_ref[0],
+                                 causal, window)
+
+        @pl.when(jnp.any(vis))
+        def _():
+            _compute(vis)
+    elif causal:
         # Skip q blocks entirely before this kv block.
         @pl.when(iq * block_q + (block_q - 1) + offset >= ik * block_k)
         def _():
@@ -250,7 +338,8 @@ def _fa_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dk_ref,
         dv_ref[0] = dv_scratch[:].astype(dv_ref.dtype)
 
 
-def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k):
+def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
+                    bounds=None, window=None):
     b, h, sq, d = q.shape
     sk = k.shape[2]
     bq = min(block_q, sq)
@@ -260,6 +349,7 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k):
     nk = sk // bk
     bh = b * h
     s = scale if scale is not None else 1.0 / math.sqrt(d)
+    masked = bounds is not None
 
     q_r = q.reshape(bh, sq, d)
     k_r = k.reshape(bh, sk, d)
@@ -272,32 +362,47 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k):
     q_spec = pl.BlockSpec((1, bq, d), lambda ibh, i, j: (ibh, i, 0))
     row_spec = pl.BlockSpec((1, bq, LANES), lambda ibh, i, j: (ibh, i, 0))
 
+    dq_inputs = [q_r, k_r, v_r, g_r, lse, delta]
+    dq_in_specs = [
+        q_spec,
+        pl.BlockSpec((1, bk, d), lambda ibh, iq, ik: (ibh, ik, 0)),
+        pl.BlockSpec((1, bk, d), lambda ibh, iq, ik: (ibh, ik, 0)),
+        q_spec, row_spec, row_spec,
+    ]
+    if masked:
+        bounds_r = jnp.swapaxes(bounds.reshape(bh, sk, 4), 1, 2)
+        dq_inputs.append(bounds_r)
+        dq_in_specs.append(
+            pl.BlockSpec((1, 4, bk), lambda ibh, iq, ik: (ibh, 0, ik)))
     dq = pl.pallas_call(
         functools.partial(_fa_dq_kernel, scale=s, causal=causal, block_q=bq,
-                          block_k=bk, nk=nk, offset=sk - sq),
+                          block_k=bk, nk=nk, offset=sk - sq, masked=masked,
+                          window=window),
         grid=(bh, nq, nk),
-        in_specs=[
-            q_spec,
-            pl.BlockSpec((1, bk, d), lambda ibh, iq, ik: (ibh, ik, 0)),
-            pl.BlockSpec((1, bk, d), lambda ibh, iq, ik: (ibh, ik, 0)),
-            q_spec, row_spec, row_spec,
-        ],
+        in_specs=dq_in_specs,
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_INTERPRET,
-    )(q_r, k_r, v_r, g_r, lse, delta)
+    )(*dq_inputs)
 
     kv_spec = pl.BlockSpec((1, bk, d), lambda ibh, ik, iq: (ibh, ik, 0))
     q_spec2 = pl.BlockSpec((1, bq, d), lambda ibh, ik, iq: (ibh, iq, 0))
     row_spec2 = pl.BlockSpec((1, bq, LANES), lambda ibh, ik, iq: (ibh, iq, 0))
+    dkv_inputs = [q_r, k_r, v_r, g_r, lse, delta]
+    dkv_in_specs = [q_spec2, kv_spec, kv_spec, q_spec2, row_spec2, row_spec2]
+    if masked:
+        dkv_inputs.append(bounds_r)
+        dkv_in_specs.append(
+            pl.BlockSpec((1, 4, bk), lambda ibh, ik, iq: (ibh, 0, ik)))
     dk, dv = pl.pallas_call(
         functools.partial(_fa_dkv_kernel, scale=s, causal=causal, block_q=bq,
-                          block_k=bk, nq=nq, offset=sk - sq),
+                          block_k=bk, nq=nq, offset=sk - sq, masked=masked,
+                          window=window),
         grid=(bh, nk, nq),
-        in_specs=[q_spec2, kv_spec, kv_spec, q_spec2, row_spec2, row_spec2],
+        in_specs=dkv_in_specs,
         out_specs=[kv_spec, kv_spec],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
@@ -308,7 +413,7 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_INTERPRET,
-    )(q_r, k_r, v_r, g_r, lse, delta)
+    )(*dkv_inputs)
 
     return (dq.reshape(b, h, sq, d), dk.reshape(b, h, sk, d),
             dv.reshape(b, h, sk, d))
@@ -350,3 +455,36 @@ def _fa_bwd(causal, scale, block_q, block_k, res, g):
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)
+
+
+# -- flashmask ----------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def flashmask_attention(q, k, v, bounds, causal=False, scale=None,
+                        window=None, block_q=DEFAULT_BLOCK_Q,
+                        block_k=DEFAULT_BLOCK_K):
+    """FlashMask attention: q,k,v [batch, heads, seq, head_dim]; bounds
+    [batch, heads, kv_seq, 4] int32 canonical (LTS, LTE, UTS, UTE) column
+    bounds (see _flashmask_visible). The sparse mask costs O(seq) memory and
+    fully-masked tiles skip the MXU — the capability of the reference's
+    flashmask_attention (flash_attention.py:1299) without a dense mask."""
+    out, _ = _flash_forward(q, k, v, causal, scale, block_q, block_k,
+                            bounds=bounds, window=window)
+    return out
+
+
+def _fm_fwd(q, k, v, bounds, causal, scale, window, block_q, block_k):
+    out, lse = _flash_forward(q, k, v, causal, scale, block_q, block_k,
+                              bounds=bounds, window=window)
+    return out, (q, k, v, bounds, out, lse)
+
+
+def _fm_bwd(causal, scale, window, block_q, block_k, res, g):
+    q, k, v, bounds, out, lse = res
+    dq, dk, dv = _flash_backward(q, k, v, out, lse, g, causal, scale,
+                                 block_q, block_k, bounds=bounds,
+                                 window=window)
+    return dq, dk, dv, None
+
+
+flashmask_attention.defvjp(_fm_fwd, _fm_bwd)

@@ -6,8 +6,8 @@ from .activation import (  # noqa: F401
     softshrink, softsign, swish, tanh, tanhshrink, thresholded_relu,
 )
 from .attention import (  # noqa: F401
-    flash_attention, flash_attn_unpadded, scaled_dot_product_attention,
-    sdp_kernel,
+    flash_attention, flash_attn_unpadded, flashmask_attention,
+    scaled_dot_product_attention, sdp_kernel,
 )
 from .vision import (  # noqa: F401
     affine_grid, grid_sample, temporal_shift,

@@ -110,6 +110,177 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
     return out, None
 
 
+def _canonical_startend(se, sq, causal):
+    """Normalize startend_row_indices [B, KH, Sk, C] (C in {1, 2, 4}; see the
+    reference doc at flash_attention.py:1299) to the canonical component
+    stack (LTS, LTE, UTS, UTE) [B, KH, Sk, 4]: strict-lower-triangle rows
+    [LTS, LTE) and strict-upper-triangle rows [UTS, UTE) are masked per key
+    column."""
+    se = se.astype(jnp.int32)
+    c = se.shape[-1]
+    zeros = jnp.zeros_like(se[..., 0])
+    full = jnp.full_like(se[..., 0], sq)
+    if causal:
+        if c == 1:
+            lts, lte, uts, ute = se[..., 0], full, zeros, zeros
+        elif c == 2:
+            lts, lte, uts, ute = se[..., 0], se[..., 1], zeros, zeros
+        else:
+            raise ValueError(
+                f"causal flashmask expects startend_row_indices with last "
+                f"dim 1 or 2, got {c}")
+    else:
+        if c == 2:
+            lts, lte, uts, ute = se[..., 0], full, zeros, se[..., 1]
+        elif c == 4:
+            lts, lte, uts, ute = (se[..., 0], se[..., 1], se[..., 2],
+                                  se[..., 3])
+        else:
+            raise ValueError(
+                f"non-causal flashmask expects startend_row_indices with "
+                f"last dim 2 or 4, got {c}")
+    return jnp.stack([lts, lte, uts, ute], axis=-1)
+
+
+def _flashmask_dense_visible(bounds, sq, sk, causal, window):
+    """Dense [B, H, Sq, Sk] visibility mask from canonical bounds — the jnp
+    oracle / fallback for the Pallas flashmask kernel (same semantics as
+    kernels/flash_pallas._flashmask_visible)."""
+    i = jnp.arange(sq)[:, None]
+    j = jnp.arange(sk)[None, :]
+    lts = bounds[..., None, :, 0]                         # [B, KH, 1, Sk]
+    lte = bounds[..., None, :, 1]
+    masked_low = (i > j) & (i >= lts) & (i < lte)
+    if causal:
+        masked_up = (i < j) & jnp.ones_like(masked_low)
+    else:
+        uts = bounds[..., None, :, 2]
+        ute = bounds[..., None, :, 3]
+        masked_up = (i < j) & (i >= uts) & (i < ute)
+    masked = masked_low | masked_up
+    if window is not None:
+        wl, wr = window
+        if wl is not None:
+            masked = masked | (i > j + wl)
+        if not causal and wr is not None:
+            masked = masked | (i < j - wr)
+    return ~masked
+
+
+def _norm_window(window_size, causal):
+    if window_size is None:
+        return None
+    if isinstance(window_size, int):
+        wl = wr = int(window_size)
+    else:
+        wl, wr = (int(w) if w is not None else None for w in window_size)
+    return (wl, None) if causal else (wl, wr)
+
+
+def flashmask_attention(query, key, value, startend_row_indices=None, *,
+                        dropout=0.0, causal=False, window_size=None,
+                        return_softmax_lse=False, return_seed_offset=False,
+                        fixed_seed_offset=None, rng_name="", training=True,
+                        name=None):
+    """FlashMask sparse-mask attention (parity:
+    paddle.nn.functional.flashmask_attention, flash_attention.py:1299 —
+    arXiv 2410.01359). Layout [batch, seq, num_heads, head_dim]; GQA
+    supported (kv heads broadcast to query heads).
+
+    startend_row_indices [B, KH, Sk, {1, 2, 4}] int32 gives per-key-column
+    masked row bands — O(S) memory instead of an O(S^2) dense mask. On TPU
+    with tiling-friendly shapes this runs the Pallas flashmask kernel
+    (kernels/flash_pallas.flashmask_attention): fully-masked tiles are
+    skipped on-device, so block-sparse masks (causal documents, sequence
+    packing) cost compute proportional to the visible area. Elsewhere (CPU,
+    odd shapes, dropout, return_softmax_lse) it falls back to the dense-mask
+    XLA path with identical numerics."""
+    if return_seed_offset:
+        raise NotImplementedError(
+            "return_seed_offset tracks the reference's CUDA dropout RNG "
+            "state; randomness here comes from the framework PRNG "
+            "(framework.random), which has no seed-offset notion")
+    qt, kt, vt = ensure_tensor(query), ensure_tensor(key), ensure_tensor(value)
+    b, sq, h, d = qt._data.shape
+    sk, kh = kt._data.shape[1], kt._data.shape[2]
+    window = _norm_window(window_size, causal)
+
+    if startend_row_indices is None and window is None:
+        out = scaled_dot_product_attention(qt, kt, vt, dropout_p=dropout,
+                                           is_causal=causal,
+                                           training=training)
+        if return_softmax_lse:
+            raise NotImplementedError(
+                "return_softmax_lse requires startend_row_indices")
+        return out
+
+    if startend_row_indices is not None:
+        se = ensure_tensor(startend_row_indices)._data
+        if se.ndim != 4 or se.shape[2] != sk:
+            raise ValueError(
+                f"startend_row_indices must be [batch, kv_heads, {sk}, C], "
+                f"got {se.shape}")
+        bounds = _canonical_startend(se, sq, causal)       # [B, KH', Sk, 4]
+    else:
+        # window-only: empty bands (nothing extra masked)
+        bounds = jnp.broadcast_to(
+            jnp.array([sq, sq, 0, 0], jnp.int32), (b, 1, sk, 4))
+    # broadcast mask heads to query heads (KH' in {1, kh}; GQA groups share)
+    if bounds.shape[1] == 1:
+        bounds_h = jnp.broadcast_to(bounds, (b, h, sk, 4))
+    elif bounds.shape[1] == kh and kh != h:
+        bounds_h = jnp.repeat(bounds, h // kh, axis=1)
+    elif bounds.shape[1] == h:
+        bounds_h = bounds
+    else:
+        raise ValueError(
+            f"startend_row_indices kv_heads dim {bounds.shape[1]} must be 1, "
+            f"{kh}, or {h}")
+
+    p_drop = float(dropout) if training else 0.0
+    from ...kernels import flash_attention as fa
+    use_pallas = (p_drop == 0.0 and not return_softmax_lse and sq == sk
+                  and fa.is_available(qt._data, kt._data, causal=causal))
+    if use_pallas:
+        from ...kernels import flash_pallas as fp
+
+        def fwd(q, k, v):
+            qh = jnp.swapaxes(q, 1, 2)
+            kh_ = jnp.swapaxes(k, 1, 2)
+            vh = jnp.swapaxes(v, 1, 2)
+            if kh_.shape[1] != h:                          # GQA: expand kv
+                kh_ = jnp.repeat(kh_, h // kh_.shape[1], axis=1)
+                vh = jnp.repeat(vh, h // vh.shape[1], axis=1)
+            out = fp.flashmask_attention(qh, kh_, vh, bounds_h,
+                                         causal=causal, window=window)
+            return jnp.swapaxes(out, 1, 2)
+
+        return dispatch("flashmask_attention", fwd, qt, kt, vt)
+
+    visible = _flashmask_dense_visible(bounds_h, sq, sk, causal, window)
+    key_rng = next_key() if p_drop > 0.0 else None
+
+    def fwd_dense(q, k, v):
+        kr, vr = k, v
+        if kr.shape[2] != h:                               # GQA: expand kv
+            kr = jnp.repeat(kr, h // kr.shape[2], axis=2)
+            vr = jnp.repeat(vr, h // vr.shape[2], axis=2)
+        return _sdpa_reference(q, kr, vr, mask=visible, dropout_p=p_drop,
+                               key=key_rng)
+
+    out = dispatch("flashmask_attention", fwd_dense, qt, kt, vt)
+    if return_softmax_lse:
+        qf = qt._data.astype(jnp.float32)
+        kf = kt._data.astype(jnp.float32)
+        if kf.shape[2] != h:
+            kf = jnp.repeat(kf, h // kf.shape[2], axis=2)
+        scores = jnp.einsum("bshd,bthd->bhst", qf, kf) / math.sqrt(d)
+        scores = jnp.where(visible, scores, -1e30)
+        lse = jax.scipy.special.logsumexp(scores, axis=-1)
+        return out, Tensor(lse)
+    return out
+
+
 def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
                         max_seqlen_q, max_seqlen_k, scale, dropout=0.0,
                         causal=False, return_softmax=False,

@@ -53,7 +53,6 @@ ALIASES = {
     "flash_attn": "F.flash_attention",
     "flash_attn_qkvpacked": "F.flash_attention",
     "flash_attn_varlen_qkvpacked": "F.flash_attn_unpadded",
-    "flashmask_attention": "F.scaled_dot_product_attention",
     "memory_efficient_attention":
         "paddle.incubate.nn.functional.variable_length_memory_efficient_attention",
     # norms / linalg
