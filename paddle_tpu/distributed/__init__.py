@@ -37,6 +37,8 @@ from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
 from .launch_util import spawn  # noqa: F401
 from . import launch  # noqa: F401  (python -m paddle_tpu.distributed.launch)
 from .host_collectives import HostCollectives, get_host_collectives  # noqa: F401
+from . import rpc  # noqa: F401
+from . import ps  # noqa: F401
 from . import sharding  # noqa: F401
 from .sharding import (  # noqa: F401
     group_sharded_parallel, save_group_sharded_model,

@@ -42,10 +42,11 @@ def parse_args(argv: Optional[List[str]] = None):
     p.add_argument("--nnodes", type=int, default=1,
                    help="number of host-controller processes to launch")
     p.add_argument("--nproc_per_node", type=int, default=1,
-                   help="accepted for reference-CLI parity; on TPU each host "
-                        "runs ONE controller process (jax owns all local "
-                        "devices), so this scales ranks only when you know "
-                        "what you are doing")
+                   help="worker processes per node (reference-CLI parity). "
+                        "On a TPU host exactly ONE process owns all local "
+                        "chips, so values > 1 are rejected unless the ranks "
+                        "run on CPU (JAX_PLATFORMS=cpu) — scale TPU jobs "
+                        "with --nnodes / --rank_offset instead")
     p.add_argument("--master", default=None,
                    help="host:port of the rendezvous store "
                         "(default: 127.0.0.1:<free port>)")
@@ -59,8 +60,16 @@ def parse_args(argv: Optional[List[str]] = None):
                         "(reference --elastic_level analog)")
     p.add_argument("--log_dir", default=None, help="per-rank log directory")
     p.add_argument("--run_mode", default="collective",
-                   help="collective (default); ps/rpc are not part of the "
-                        "TPU deployment model and are rejected")
+                   help="collective (default) or ps (spawns --server_num "
+                        "table servers + trainers; ranks see PS_ROLE / "
+                        "PADDLE_MASTER and use distributed.rpc + "
+                        "distributed.ps)")
+    p.add_argument("--server_num", type=int, default=1,
+                   help="ps mode: number of table-server processes "
+                        "(reference --server_num)")
+    p.add_argument("--trainer_num", type=int, default=None,
+                   help="ps mode: trainer processes (default: "
+                        "nproc_per_node)")
     p.add_argument("training_script", help="script (or -m module) to run")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -71,11 +80,25 @@ class Controller:
     restarts the generation on failure (collective.py:267 Watcher analog)."""
 
     def __init__(self, args):
-        if args.run_mode != "collective":
+        if args.run_mode not in ("collective", "ps"):
             raise NotImplementedError(
-                f"run_mode={args.run_mode!r}: only collective launch exists; "
-                "parameter-server deployment is not part of the TPU stack")
+                f"run_mode={args.run_mode!r}: collective and ps exist "
+                "(rpc workers launch as collective ranks + distributed.rpc)")
         self.args = args
+        self.ps_servers = 0
+        if args.run_mode == "ps":
+            trainers = args.trainer_num or args.nproc_per_node
+            self.ps_servers = args.server_num
+            args.nproc_per_node = self.ps_servers + trainers
+        if args.nproc_per_node > 1 and \
+                os.environ.get("JAX_PLATFORMS", "").lower() != "cpu":
+            # one process owns all local TPU chips; several would fight over
+            # the device (the reference's per-GPU model does not transfer)
+            raise SystemExit(
+                f"--nproc_per_node={args.nproc_per_node}: a TPU host runs "
+                "ONE worker process (jax owns every local chip). Scale with "
+                "--nnodes/--rank_offset, or set JAX_PLATFORMS=cpu if these "
+                "ranks are CPU-only (e.g. ps servers/trainers).")
         self.nranks_local = args.nnodes * args.nproc_per_node
         self.world = args.world_size or self.nranks_local
         master = args.master or f"127.0.0.1:{_free_port()}"
@@ -106,6 +129,12 @@ class Controller:
             "RANK": str(rank),
             "WORLD_SIZE": str(self.world),
         })
+        if self.args.run_mode == "ps":
+            env["PS_ROLE"] = "server" if rank < self.ps_servers else "trainer"
+            # rpc hosts its own store on the master port (no jax.distributed
+            # coordinator in a CPU ps job; the global TCPStore, if any, uses
+            # PADDLE_STORE_PORT)
+            env["PADDLE_MASTER"] = f"{self.master_addr}:{self.master_port}"
         return env
 
     def _spawn_rank(self, rank: int) -> subprocess.Popen:

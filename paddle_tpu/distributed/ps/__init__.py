@@ -1,0 +1,310 @@
+"""Parameter server: a runnable table server with async push/pull.
+
+Reference parity (minimal, capability-level): the brpc PS subsystem —
+`fluid/distributed/ps/service/brpc_ps_server.cc:901` (dense/sparse table
+service), `ps/table/memory_sparse_table`, Python `the_one_ps.py`. TPU-native
+scope (see DESIGN_PS.md): dense model state scales via mesh sharding, so the
+PS here serves the one workload that genuinely wants a server — sparse
+tables larger than device+host memory of one worker, trained asynchronously
+— and stays control-plane: it rides the TCPStore RPC fabric
+(distributed/rpc.py), holds numpy tables, and applies row-sparse optimizer
+updates server-side on push.
+
+Consistency: bounded-staleness (SSP). Each trainer advances a clock after
+its step; a pull carrying clock c blocks on the server until
+c - min(all trainer clocks) <= staleness, so a fast trainer can run ahead of
+the slowest by at most `staleness` steps (staleness=None -> fully async).
+
+Roles:
+  server process:  rpc.init_rpc("ps_server", ...); ps.run_server()
+  trainer process: rpc.init_rpc(f"trainer{i}", ...);
+                   c = ps.PSClient(); c.create_table(...); c.pull/push/clock
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from .. import rpc
+
+_SERVER_NAME = "ps_server"
+
+
+class Table:
+    """One server-side table with a built-in row-sparse optimizer (the
+    memory_sparse_table role: push applies the update, pull reads rows)."""
+
+    def __init__(self, rows: int, dim: int, optimizer: str = "sgd",
+                 learning_rate: float = 0.01, initializer_range: float = 0.0,
+                 seed: int = 0):
+        if optimizer not in ("sgd", "adagrad"):
+            raise ValueError("optimizer must be sgd or adagrad")
+        rng = np.random.default_rng(seed)
+        self.data = (rng.normal(0.0, initializer_range, (rows, dim))
+                     if initializer_range else np.zeros((rows, dim))) \
+            .astype(np.float32)
+        self.optimizer = optimizer
+        self.learning_rate = learning_rate
+        self._g2 = np.zeros(rows, np.float32) if optimizer == "adagrad" \
+            else None
+        self.lock = threading.Lock()
+        self.push_count = 0
+
+    def pull(self, ids: np.ndarray) -> np.ndarray:
+        with self.lock:
+            return self.data[ids].copy()
+
+    def push(self, ids: np.ndarray, grads: np.ndarray):
+        with self.lock:
+            self.push_count += 1
+            if self.optimizer == "sgd":
+                np.subtract.at(self.data, ids, self.learning_rate * grads)
+                return
+            g2 = (grads ** 2).mean(axis=1)
+            np.add.at(self._g2, ids, g2)
+            scale = self.learning_rate / np.sqrt(self._g2[ids] + 1e-10)
+            np.subtract.at(self.data, ids, scale[:, None] * grads)
+
+
+class _Server:
+    def __init__(self):
+        self.tables: Dict[str, Table] = {}
+        self.mu = threading.Lock()
+        self.cv = threading.Condition(self.mu)
+        self.clocks: Dict[int, int] = {}
+        self.stopping = False
+
+    def create_table(self, name, rows, dim, optimizer, lr, init_range, seed):
+        with self.mu:
+            if name not in self.tables:   # first creator wins (idempotent)
+                self.tables[name] = Table(rows, dim, optimizer, lr,
+                                          init_range, seed)
+            t = self.tables[name]
+            return (t.data.shape, t.optimizer, t.learning_rate)
+
+    def table(self, name) -> Table:
+        with self.mu:
+            t = self.tables.get(name)
+        if t is None:
+            raise KeyError(f"no such table {name!r}")
+        return t
+
+    def wait_staleness(self, worker: int, clock: int, staleness, timeout):
+        """SSP gate: block while this worker is > staleness ahead of the
+        slowest OTHER registered trainer (a worker's own recorded clock
+        always lags the clock it pulls with, so it must not gate itself)."""
+        if staleness is None:
+            return
+        deadline = time.monotonic() + timeout
+
+        def others_min():
+            rest = [c for w, c in self.clocks.items() if w != worker]
+            return min(rest) if rest else clock
+
+        with self.cv:
+            self.clocks.setdefault(worker, 0)
+            while (not self.stopping
+                   and clock - others_min() > staleness):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"SSP staleness wait: worker {worker} at clock "
+                        f"{clock} vs {self.clocks} (bound {staleness})")
+                self.cv.wait(remaining)
+
+    def tick(self, worker: int, clock: int):
+        with self.cv:
+            self.clocks[worker] = clock
+            self.cv.notify_all()
+
+
+_server: list = [None]
+
+
+def _srv() -> _Server:
+    if _server[0] is None:
+        raise RuntimeError("parameter server is not running in this process")
+    return _server[0]
+
+
+# -- rpc-exposed service functions (execute in the SERVER process) ------------
+
+def _ps_create(name, rows, dim, optimizer, lr, init_range, seed):
+    return _srv().create_table(name, rows, dim, optimizer, lr, init_range,
+                               seed)
+
+
+def _ps_pull(name, ids, worker, clock, staleness, timeout=120.0):
+    _srv().wait_staleness(worker, clock, staleness, timeout)
+    return _srv().table(name).pull(np.asarray(ids, np.int64))
+
+
+def _ps_push(name, ids, grads):
+    _srv().table(name).push(np.asarray(ids, np.int64),
+                            np.asarray(grads, np.float32))
+
+
+def _ps_pull_dense(name):
+    t = _srv().table(name)
+    with t.lock:
+        return t.data.copy()
+
+
+def _ps_push_dense(name, grad):
+    t = _srv().table(name)
+    t.push(np.arange(t.data.shape[0]), np.asarray(grad, np.float32))
+
+
+def _ps_assign(name, data):
+    """Overwrite the whole table atomically (checkpoint restore)."""
+    t = _srv().table(name)
+    arr = np.asarray(data, np.float32)
+    with t.lock:
+        if arr.shape != t.data.shape:
+            raise ValueError(f"assign shape {arr.shape} != table "
+                             f"{t.data.shape}")
+        t.data[...] = arr
+
+
+def _ps_register(worker):
+    """Enter the SSP clock set at clock 0: from this point the worker
+    counts as the 'slowest trainer' until it ticks."""
+    _srv().tick(worker, 0)
+
+
+def _ps_clock(worker, clock):
+    _srv().tick(worker, clock)
+
+
+# lock-only and on the SSP release path: must never queue behind handlers
+# blocked in wait_staleness (see rpc._rpc_inline)
+_ps_register._rpc_inline = True
+_ps_clock._rpc_inline = True
+
+
+def _ps_stats():
+    s = _srv()
+    with s.mu:
+        return {"tables": {n: {"shape": t.data.shape,
+                               "optimizer": t.optimizer,
+                               "push_count": t.push_count}
+                           for n, t in s.tables.items()},
+                "clocks": dict(s.clocks)}
+
+
+def _ps_shutdown():
+    s = _srv()
+    with s.cv:
+        s.stopping = True
+        s.cv.notify_all()
+
+
+def run_server(block: bool = True, poll: float = 0.2) -> None:
+    """Start serving tables in this process (rpc must be initialized as the
+    worker named "ps_server"). Returns on client shutdown_server()."""
+    if rpc.get_current_worker_info().name != _SERVER_NAME:
+        raise RuntimeError(
+            f'run_server() must run in the rpc worker named "{_SERVER_NAME}"')
+    _server[0] = _Server()
+    if block:
+        while not _server[0].stopping:
+            time.sleep(poll)
+
+
+class PSClient:
+    """Trainer-side handle (the brpc_ps_client.cc role): async push, SSP
+    pull, per-trainer clock."""
+
+    def __init__(self, server: str = _SERVER_NAME,
+                 staleness: Optional[int] = None):
+        self.server = server
+        self.staleness = staleness
+        self.worker = rpc.get_current_worker_info().rank
+        self.clock = 0
+        self._pending: list = []
+        # enter the SSP clock set immediately: a trainer still loading data
+        # must already count as "slowest", or the bound is unenforced
+        # exactly when skew is largest
+        rpc.rpc_sync(self.server, _ps_register, args=(self.worker,))
+
+    def create_table(self, name: str, rows: int, dim: int,
+                     optimizer: str = "sgd", learning_rate: float = 0.01,
+                     initializer_range: float = 0.0, seed: int = 0):
+        """Create-or-attach (first creator wins). The server's actual table
+        config is validated against the requested one so silent config
+        drift between trainers cannot produce shape/optimizer mismatches."""
+        shape, opt, lr = rpc.rpc_sync(
+            self.server, _ps_create,
+            args=(name, rows, dim, optimizer, learning_rate,
+                  initializer_range, seed))
+        if tuple(shape) != (rows, dim) or opt != optimizer or \
+                abs(lr - learning_rate) > 1e-12:
+            raise ValueError(
+                f"table {name!r} already exists with shape={tuple(shape)} "
+                f"optimizer={opt!r} lr={lr}, which conflicts with the "
+                f"requested ({rows}, {dim})/{optimizer!r}/lr={learning_rate}")
+        return shape, opt
+
+    def pull(self, name: str, ids) -> np.ndarray:
+        return rpc.rpc_sync(self.server, _ps_pull,
+                            args=(name, np.asarray(ids, np.int64),
+                                  self.worker, self.clock, self.staleness))
+
+    def push(self, name: str, ids, grads, sync: bool = False):
+        """Async by default (futures drained at the next barrier-ish op);
+        sync=True waits for the server to apply the update."""
+        fut = rpc.rpc_async(self.server, _ps_push,
+                            args=(name, np.asarray(ids, np.int64),
+                                  np.asarray(grads, np.float32)))
+        if sync:
+            fut.wait()
+        else:
+            self._pending.append(fut)
+            if len(self._pending) > 32:
+                self._drain()
+        return fut
+
+    def pull_dense(self, name: str) -> np.ndarray:
+        return rpc.rpc_sync(self.server, _ps_pull_dense, args=(name,))
+
+    def push_dense(self, name: str, grad, sync: bool = False):
+        fut = rpc.rpc_async(self.server, _ps_push_dense,
+                            args=(name, np.asarray(grad, np.float32)))
+        if sync:
+            fut.wait()
+        else:
+            self._pending.append(fut)
+        return fut
+
+    def assign(self, name: str, data):
+        """Atomically overwrite the table (checkpoint restore); outstanding
+        async pushes are drained first."""
+        self._drain()
+        rpc.rpc_sync(self.server, _ps_assign,
+                     args=(name, np.asarray(data, np.float32)))
+
+    def _drain(self):
+        pending, self._pending = self._pending, []
+        for f in pending:
+            f.wait()
+
+    def step_done(self):
+        """Advance this trainer's SSP clock (call once per local step);
+        drains outstanding async pushes first so the clock never runs ahead
+        of this trainer's own updates."""
+        self._drain()
+        self.clock += 1
+        rpc.rpc_sync(self.server, _ps_clock, args=(self.worker, self.clock))
+
+    def stats(self) -> dict:
+        return rpc.rpc_sync(self.server, _ps_stats)
+
+    def shutdown_server(self):
+        self._drain()
+        rpc.rpc_sync(self.server, _ps_shutdown)
+
+
+__all__ = ["Table", "PSClient", "run_server"]

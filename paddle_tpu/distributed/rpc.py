@@ -1,0 +1,278 @@
+"""RPC over the TCPStore: peer-to-peer remote function calls.
+
+Reference parity: paddle.distributed.rpc (python/paddle/distributed/rpc/
+rpc.py — init_rpc / rpc_sync / rpc_async / shutdown / get_worker_info over
+a brpc fabric, fluid/distributed/rpc/). TPU-native design: the data plane
+(collectives) is compiled into programs, so RPC is control-plane only —
+instead of a second socket fabric it rides the existing TCPStore
+(csrc/store.cpp): every worker owns a mailbox (a ticket counter plus
+numbered message keys); send = atomic ADD for a ticket + SET of the pickled
+message; receive = the store's server-side blocking GET on the next ticket,
+so idle workers cost no polling traffic. The store server is hosted by
+rank 0 (master_endpoint), exactly like the reference's rendezvous.
+
+Callables must be picklable module-level functions (same contract as the
+reference and torch.distributed.rpc).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+import time
+import uuid
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+from .store import TCPStore
+
+_DEFAULT_TIMEOUT = 120.0
+
+
+@dataclass(frozen=True)
+class WorkerInfo:
+    """Parity: paddle.distributed.rpc.WorkerInfo (name/rank/ip/port).
+    ip/port here are the RENDEZVOUS STORE endpoint (identical for every
+    worker): workers are addressed by mailbox name through the store, they
+    do not listen on per-worker sockets like the reference's brpc agents."""
+    name: str
+    rank: int
+    ip: str
+    port: int
+
+
+class Future:
+    """Minimal future for rpc_async (parity: the FutureWrapper returned by
+    the reference's rpc_async; wait() blocks and re-raises remote errors)."""
+
+    def __init__(self):
+        self._ev = threading.Event()
+        self._value = None
+        self._exc: Optional[BaseException] = None
+
+    def _resolve(self, ok: bool, payload):
+        if ok:
+            self._value = payload
+        else:
+            self._exc = payload
+        self._ev.set()
+
+    def done(self) -> bool:
+        return self._ev.is_set()
+
+    def wait(self, timeout: Optional[float] = None):
+        if not self._ev.wait(_DEFAULT_TIMEOUT if timeout is None else timeout):
+            raise TimeoutError("rpc future timed out")
+        if self._exc is not None:
+            raise self._exc
+        return self._value
+
+
+class _RpcAgent:
+    def __init__(self, name: str, rank: int, world_size: int, host: str,
+                 port: int):
+        self.name = name
+        self.rank = rank
+        self.world_size = world_size
+        is_master = rank == 0
+        # two connections: the receive loop parks in a server-side blocking
+        # GET, so sends need their own socket (one request in flight per
+        # connection); sends are serialized by a lock
+        self._rx = TCPStore(host, port, is_master=is_master,
+                            world_size=world_size)
+        port = self._rx.port
+        self._tx = TCPStore(host, port, is_master=False,
+                            world_size=world_size)
+        self._tx_lock = threading.Lock()
+        self._futures: Dict[str, Future] = {}
+        self._fut_lock = threading.Lock()
+        self._stop = False
+        # handlers may park in long waits (e.g. the PS SSP gate), so the
+        # pool must stay larger than the plausible number of concurrently
+        # blocked callers; quick lock-only handlers can bypass it entirely
+        # by setting fn._rpc_inline = True (run on the receive loop)
+        self._pool = ThreadPoolExecutor(
+            max_workers=32, thread_name_prefix=f"rpc-{name}")
+        # registry
+        self._tx.set(f"rpc/worker/{rank}",
+                     pickle.dumps(WorkerInfo(name, rank, host, port)))
+        self._infos: List[WorkerInfo] = []
+        for r in range(world_size):
+            self._infos.append(pickle.loads(
+                self._tx.get(f"rpc/worker/{r}", timeout=_DEFAULT_TIMEOUT)))
+        self._by_name = {w.name: w for w in self._infos}
+        if len(self._by_name) != world_size:
+            raise ValueError("rpc worker names must be unique")
+        self._recv_thread = threading.Thread(target=self._recv_loop,
+                                             daemon=True,
+                                             name=f"rpc-recv-{name}")
+        self._recv_thread.start()
+
+    # -- transport ------------------------------------------------------------
+    def _send(self, to_rank: int, msg: dict):
+        data = pickle.dumps(msg)
+        with self._tx_lock:
+            ticket = self._tx.add(f"rpc/ibx/{to_rank}", 1) - 1
+            self._tx.set(f"rpc/msg/{to_rank}/{ticket}", data)
+
+    def _recv_loop(self):
+        i = 0
+        key = f"rpc/msg/{self.rank}/"
+        while not self._stop:
+            try:
+                data = self._rx.get(key + str(i), timeout=0.5)
+            except TimeoutError:
+                continue
+            except Exception:
+                if self._stop:
+                    return
+                raise
+            self._rx.delete_key(key + str(i))
+            i += 1
+            try:
+                msg = pickle.loads(data)
+            except Exception:
+                continue
+            if msg.get("kind") == "call":
+                # handlers run off the receive loop so they may block (SSP
+                # waits) or issue their own rpcs; _rpc_inline handlers run
+                # here so they can never be starved by blocked pool threads
+                if getattr(msg.get("fn"), "_rpc_inline", False):
+                    self._run_call(msg)
+                else:
+                    self._pool.submit(self._run_call, msg)
+            elif msg.get("kind") == "reply":
+                with self._fut_lock:
+                    fut = self._futures.pop(msg["req_id"], None)
+                if fut is not None:
+                    fut._resolve(msg["ok"], msg["payload"])
+
+    def _run_call(self, msg):
+        try:
+            fn = msg["fn"]
+            result = fn(*msg["args"], **msg["kwargs"])
+            ok, payload = True, result
+        except BaseException as e:  # propagated to the caller
+            ok, payload = False, e
+        if msg.get("needs_reply", True):
+            reply = {"kind": "reply", "req_id": msg["req_id"], "ok": ok,
+                     "payload": payload}
+            try:
+                self._send(msg["src"], reply)
+            except Exception as e:
+                # unpicklable result/exception: the caller must still get an
+                # answer, not a 120s timeout with no diagnostics
+                reply["ok"] = False
+                reply["payload"] = RuntimeError(
+                    f"rpc reply for {msg.get('fn')} could not be sent "
+                    f"({type(e).__name__}: {e})")
+                try:
+                    self._send(msg["src"], reply)
+                except Exception:
+                    pass
+
+    # -- public ---------------------------------------------------------------
+    def call_async(self, to: str, fn, args=(), kwargs=None,
+                   needs_reply=True) -> Optional[Future]:
+        w = self._by_name.get(to)
+        if w is None:
+            raise ValueError(f"unknown rpc worker {to!r}; known: "
+                             f"{sorted(self._by_name)}")
+        req_id = uuid.uuid4().hex
+        fut = None
+        if needs_reply:
+            fut = Future()
+            with self._fut_lock:
+                self._futures[req_id] = fut
+        self._send(w.rank, {"kind": "call", "src": self.rank,
+                            "req_id": req_id, "fn": fn, "args": tuple(args),
+                            "kwargs": dict(kwargs or {}),
+                            "needs_reply": needs_reply})
+        return fut
+
+    def shutdown(self, graceful: bool = True):
+        if graceful:
+            # every rank arrives before anyone tears down its mailbox
+            self._tx.barrier("rpc_shutdown")
+            # rank 0 hosts the store: it must outlive every peer's barrier
+            # GET, so wait for an explicit ack from all ranks before
+            # stopping the server
+            self._tx.add("rpc/shutdown_done", 1)
+            if self.rank == 0:
+                deadline = time.monotonic() + _DEFAULT_TIMEOUT
+                while self._tx.add("rpc/shutdown_done", 0) < self.world_size:
+                    if time.monotonic() > deadline:
+                        break
+                    time.sleep(0.02)
+        self._stop = True
+        self._recv_thread.join(timeout=5.0)
+        self._pool.shutdown(wait=False)
+        self._rx.stop()
+        self._tx.stop()
+
+
+_agent: List[Optional[_RpcAgent]] = [None]
+
+
+def _require_agent() -> _RpcAgent:
+    if _agent[0] is None:
+        raise RuntimeError("rpc is not initialized; call init_rpc() first")
+    return _agent[0]
+
+
+def init_rpc(name: str, rank: Optional[int] = None,
+             world_size: Optional[int] = None,
+             master_endpoint: Optional[str] = None) -> None:
+    """Parity: paddle.distributed.rpc.init_rpc (rpc.py). rank 0 hosts the
+    store server at master_endpoint ("ip:port"); defaults come from the
+    launch env (PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM /
+    PADDLE_MASTER)."""
+    if _agent[0] is not None:
+        raise RuntimeError("rpc already initialized")
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)) if rank is None \
+        else rank
+    world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1)) \
+        if world_size is None else world_size
+    ep = master_endpoint or os.environ.get("PADDLE_MASTER") or \
+        f"127.0.0.1:{os.environ.get('MASTER_PORT', '0')}"
+    host, port = ep.rsplit(":", 1)
+    _agent[0] = _RpcAgent(name, rank, world_size, host, int(port))
+
+
+def rpc_sync(to: str, fn, args=(), kwargs=None,
+             timeout: float = _DEFAULT_TIMEOUT):
+    """Blocking remote call; returns fn's result (parity: rpc.rpc_sync)."""
+    return _require_agent().call_async(to, fn, args, kwargs).wait(timeout)
+
+
+def rpc_async(to: str, fn, args=(), kwargs=None) -> Future:
+    """Non-blocking remote call returning a Future (parity: rpc.rpc_async)."""
+    return _require_agent().call_async(to, fn, args, kwargs)
+
+
+def get_worker_info(name: Optional[str] = None) -> WorkerInfo:
+    a = _require_agent()
+    if name is None:
+        return a._by_name[a.name]
+    return a._by_name[name]
+
+
+def get_all_worker_infos() -> List[WorkerInfo]:
+    return list(_require_agent()._infos)
+
+
+def get_current_worker_info() -> WorkerInfo:
+    return get_worker_info(None)
+
+
+def shutdown(graceful: bool = True) -> None:
+    """Parity: rpc.shutdown — barrier (graceful) then tear down."""
+    if _agent[0] is not None:
+        _agent[0].shutdown(graceful)
+        _agent[0] = None
+
+
+__all__ = ["WorkerInfo", "Future", "init_rpc", "rpc_sync", "rpc_async",
+           "get_worker_info", "get_all_worker_infos",
+           "get_current_worker_info", "shutdown"]

@@ -1,12 +1,19 @@
-"""HostEmbedding: larger-than-HBM embedding with row-sparse host updates.
+"""HostEmbedding: larger-than-HBM embedding with row-sparse updates.
 
 Reference parity: the sparse-table core of the parameter server
 (fluid/distributed/ps/table/ memory_sparse_table; python
-paddle.static.nn.sparse_embedding) — see distributed/DESIGN_PS.md for the
-scope decision. The table lives in host RAM (numpy); each step gathers only
-the touched rows to the device, and the backward applies a row-sparse
-update on the host (SGD or Adagrad), so HBM cost is O(batch-unique-ids),
-not O(vocab).
+paddle.static.nn.sparse_embedding) — see distributed/DESIGN_PS.md. Two
+backings:
+
+- local (default): the table lives in THIS process's host RAM (numpy);
+  each step gathers only the touched rows to the device and the backward
+  applies a row-sparse update on the host (SGD or Adagrad) — HBM cost is
+  O(batch-unique-ids), not O(vocab).
+- parameter server (`ps_client=`): the table lives in a table-server
+  process (distributed/ps); forward pulls the touched rows over RPC and
+  the backward pushes row gradients asynchronously — many trainers share
+  one table with bounded-staleness consistency, the reference's
+  brpc_ps_server/the_one_ps workload.
 """
 from __future__ import annotations
 
@@ -23,23 +30,41 @@ class HostEmbedding(Layer):
 
     forward(ids) gathers rows; apply_sparse_grad() (called by the layer's
     backward hook) scatters the row gradients back with a built-in sparse
-    optimizer — the PS "push" without a server.
+    optimizer — the PS "push", local or remote.
     """
 
     def __init__(self, num_embeddings: int, embedding_dim: int,
                  optimizer: str = "sgd", learning_rate: float = 0.01,
-                 initializer_range: float = 0.02, seed: int = 0, name=None):
+                 initializer_range: float = 0.02, seed: int = 0,
+                 ps_client=None, table_name: str = None, name=None):
         super().__init__()
         self.num_embeddings = num_embeddings
         self.embedding_dim = embedding_dim
-        rng = np.random.default_rng(seed)
-        self.table = rng.normal(
-            0.0, initializer_range,
-            (num_embeddings, embedding_dim)).astype(np.float32)
         if optimizer not in ("sgd", "adagrad"):
             raise ValueError("optimizer must be sgd or adagrad")
         self.optimizer = optimizer
         self.learning_rate = learning_rate
+        self._client = ps_client
+        if ps_client is not None:
+            if not (table_name or name):
+                raise ValueError(
+                    "HostEmbedding(ps_client=...) needs an explicit "
+                    "table_name (or name): a shared default would silently "
+                    "alias every embedding onto one server table")
+            self.table_name = table_name or name
+            # idempotent: the first trainer creates, later ones attach
+            ps_client.create_table(self.table_name, num_embeddings,
+                                   embedding_dim, optimizer=optimizer,
+                                   learning_rate=learning_rate,
+                                   initializer_range=initializer_range,
+                                   seed=seed)
+            self.table = None
+            self._g2 = None
+            return
+        rng = np.random.default_rng(seed)
+        self.table = rng.normal(
+            0.0, initializer_range,
+            (num_embeddings, embedding_dim)).astype(np.float32)
         self._g2 = np.zeros(num_embeddings, np.float32) \
             if optimizer == "adagrad" else None
 
@@ -47,9 +72,11 @@ class HostEmbedding(Layer):
         ids_t = ids if isinstance(ids, Tensor) else Tensor(ids)
         ids_np = np.asarray(ids_t._data).astype(np.int64)
         flat, inverse = np.unique(ids_np.reshape(-1), return_inverse=True)
-        # only the touched rows travel host -> HBM; differentiable so the
-        # tape produces d_rows for the sparse push
-        rows = Tensor(jnp.asarray(self.table[flat]), stop_gradient=False)
+        # only the touched rows travel (server ->) host -> HBM;
+        # differentiable so the tape produces d_rows for the sparse push
+        src = self.table[flat] if self._client is None else \
+            self._client.pull(self.table_name, flat)
+        rows = Tensor(jnp.asarray(src), stop_gradient=False)
         inv = jnp.asarray(inverse.astype(np.int32))
         layer = self
 
@@ -59,8 +86,8 @@ class HostEmbedding(Layer):
         out = dispatch("host_embedding_gather", fwd, rows)
         node = out._node
         if node is not None:
-            # row-sparse "push": route the row cotangents into the host-side
-            # sparse update as they are computed (PS push without a server)
+            # row-sparse "push": route the row cotangents into the sparse
+            # update as they are computed (local table or PS server)
             orig_vjp = node.vjp_fn
 
             def vjp_and_push(g):
@@ -72,7 +99,11 @@ class HostEmbedding(Layer):
         return out
 
     def apply_sparse_grad(self, row_ids: np.ndarray, row_grads: np.ndarray):
-        """Update only the touched rows (PS sparse-table push semantics)."""
+        """Update only the touched rows (PS sparse-table push semantics);
+        remote pushes are asynchronous (drained by PSClient.step_done)."""
+        if self._client is not None:
+            self._client.push(self.table_name, row_ids, row_grads)
+            return
         if self.optimizer == "sgd":
             self.table[row_ids] -= self.learning_rate * row_grads
             return
@@ -82,15 +113,24 @@ class HostEmbedding(Layer):
         self.table[row_ids] -= scale[:, None] * row_grads
 
     def lookup(self, ids: np.ndarray) -> np.ndarray:
-        return self.table[np.asarray(ids).astype(np.int64)]
+        ids = np.asarray(ids).astype(np.int64)
+        if self._client is not None:
+            return self._client.pull(self.table_name, ids)
+        return self.table[ids]
 
     def state_dict(self, *a, **k):
-        return {"table": Tensor(jnp.asarray(self.table))}
+        tbl = self.table if self._client is None else \
+            self._client.pull_dense(self.table_name)
+        return {"table": Tensor(jnp.asarray(tbl))}
 
     def set_state_dict(self, sd, *a, **k):
-        self.table = np.asarray(sd["table"]._data
-                                if isinstance(sd["table"], Tensor)
-                                else sd["table"]).copy()
+        tbl = np.asarray(sd["table"]._data
+                         if isinstance(sd["table"], Tensor)
+                         else sd["table"]).copy()
+        if self._client is not None:
+            self._client.assign(self.table_name, tbl)
+            return
+        self.table = tbl
 
 
 __all__ = ["HostEmbedding"]

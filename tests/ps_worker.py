@@ -1,0 +1,94 @@
+"""Worker script for the parameter-server multi-process test
+(tests/test_ps.py): 1 table server + 2 trainers over the TCPStore RPC
+fabric, CPU only. Role comes from PS_ROLE; rendezvous from PADDLE_MASTER.
+
+Mirrors the reference test strategy (SURVEY §4: TestDistBase spawns
+pservers + trainers as subprocesses and checks training progress)."""
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from paddle_tpu.distributed import ps, rpc  # noqa: E402
+
+ROWS, DIM = 64, 8
+STEPS = 30
+
+
+def main():
+    role = os.environ["PS_ROLE"]
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    world = int(os.environ["PADDLE_TRAINERS_NUM"])
+    name = "ps_server" if role == "server" else f"trainer{rank}"
+    rpc.init_rpc(name, rank=rank, world_size=world)
+
+    if role == "server":
+        ps.run_server()           # returns on client shutdown
+        rpc.shutdown()
+        return
+
+    # trainer: learn table rows toward fixed targets with async push / SSP
+    client = ps.PSClient(staleness=2)
+    client.create_table("emb", ROWS, DIM, optimizer="sgd", learning_rate=0.2)
+    rng = np.random.default_rng(1234)          # same targets on both trainers
+    targets = rng.normal(0.0, 1.0, (ROWS, DIM)).astype(np.float32)
+    my = np.random.default_rng(rank)
+    for _ in range(STEPS):
+        ids = my.integers(0, ROWS, 16)
+        uids = np.unique(ids)
+        rows = client.pull("emb", uids)
+        assert rows.shape == (len(uids), DIM)
+        grad = rows - targets[uids]            # dMSE/drow (x0.5)
+        client.push("emb", uids, grad)
+        client.step_done()
+
+    # HostEmbedding wired to the SAME server: shared table across trainers
+    from paddle_tpu.incubate.distributed import HostEmbedding
+    import paddle_tpu as paddle
+    emb = HostEmbedding(ROWS, DIM, learning_rate=0.2, ps_client=client,
+                        table_name="emb2")
+    t2 = rng.normal(0.0, 1.0, (ROWS, DIM)).astype(np.float32)
+    first = last = None
+    for _ in range(STEPS):
+        ids = my.integers(0, ROWS, 16)
+        out = emb(paddle.to_tensor(ids))
+        loss = ((out - paddle.to_tensor(t2[ids])) ** 2).sum()
+        loss.backward()
+        client.step_done()
+        v = float(loss.numpy())
+        first = v if first is None else first
+        last = v
+    assert last < first * 0.5, (first, last)
+
+    # trainers sync, then rank 1 validates convergence + stats and stops
+    # the server (rank 2 just leaves)
+    stats = client.stats()
+    assert stats["tables"]["emb"]["push_count"] > 0, stats
+    assert set(stats["clocks"]) == {1, 2}, stats
+    # SSP: both clocks ended within the staleness bound of each other
+    clocks = stats["clocks"]
+    final = client.pull("emb", np.arange(ROWS))
+    err = np.abs(final - targets).mean()
+    base = np.abs(targets).mean()
+    assert err < base * 0.5, (err, base)
+    if rank == 1:
+        # wait until the other trainer reached the end (its clock is final)
+        import time
+        deadline = time.monotonic() + 60
+        while client.stats()["clocks"].get(2, 0) < 2 * STEPS:
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"peer clock: {client.stats()}")
+            time.sleep(0.1)
+        client.shutdown_server()
+    print(f"{name} OK clocks={clocks} err={err:.4f}")
+    rpc.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,66 @@
+"""Worker script for the generic RPC test (tests/test_ps.py): two workers
+exchange rpc_sync / rpc_async calls (parity surface:
+paddle.distributed.rpc, python/paddle/distributed/rpc/rpc.py)."""
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from paddle_tpu.distributed import rpc  # noqa: E402
+
+
+def add_arrays(a, b):
+    return np.asarray(a) + np.asarray(b)
+
+
+def whoami(tag=None):
+    info = rpc.get_current_worker_info()
+    return (info.name, info.rank, tag)
+
+
+def boom():
+    raise ValueError("remote kaboom")
+
+
+def main():
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    world = int(os.environ["PADDLE_TRAINERS_NUM"])
+    rpc.init_rpc(f"worker{rank}", rank=rank, world_size=world)
+    peer = f"worker{1 - rank}"
+
+    # worker infos
+    infos = rpc.get_all_worker_infos()
+    assert {w.name for w in infos} == {"worker0", "worker1"}, infos
+    assert rpc.get_worker_info(peer).rank == 1 - rank
+
+    # sync call executes ON the peer
+    name, r, tag = rpc.rpc_sync(peer, whoami, kwargs={"tag": "hi"})
+    assert (name, r, tag) == (peer, 1 - rank, "hi"), (name, r, tag)
+
+    # async fan-out with numpy payloads
+    futs = [rpc.rpc_async(peer, add_arrays,
+                          args=(np.full((4,), i, np.float32),
+                                np.ones((4,), np.float32)))
+            for i in range(8)]
+    for i, f in enumerate(futs):
+        np.testing.assert_allclose(f.wait(), np.full((4,), i + 1.0))
+
+    # remote exceptions propagate to the caller
+    try:
+        rpc.rpc_sync(peer, boom)
+        raise SystemExit("expected ValueError from remote")
+    except ValueError as e:
+        assert "kaboom" in str(e)
+
+    print("RPC OK")
+    rpc.shutdown()
+
+
+if __name__ == "__main__":
+    main()

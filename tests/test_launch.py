@@ -56,11 +56,42 @@ def test_launch_gives_up_after_max_restarts():
         assert "max_restarts=1 exhausted" in r.stderr.decode()
 
 
-def test_launch_rejects_ps_mode():
+def test_launch_rejects_unknown_mode():
     r = subprocess.run(
         [sys.executable, "-m", "paddle_tpu.distributed.launch",
-         "--run_mode", "ps", "x.py"],
+         "--run_mode", "heter", "x.py"],
         capture_output=True, timeout=60,
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     assert r.returncode != 0
     assert "NotImplementedError" in r.stderr.decode()
+
+
+def test_launch_rejects_multiproc_on_tpu_host():
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # simulate a would-be TPU host
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "4", "x.py"],
+        capture_output=True, timeout=60, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert r.returncode != 0
+    assert "ONE worker process" in r.stderr.decode()
+
+
+def test_launch_ps_mode_spawns_server_and_trainers():
+    """The CLI analog of test_ps.py: --run_mode ps assigns PS_ROLE and the
+    rpc endpoint; the same worker script converges (reference --server_num
+    CLI, launch/main.py:23)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "ps_worker.py")
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--run_mode", "ps", "--server_num", "1", "--trainer_num", "2",
+         worker],
+        capture_output=True, timeout=300, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert r.returncode == 0, r.stdout.decode()[-3000:] + \
+        r.stderr.decode()[-3000:]
