@@ -87,7 +87,8 @@ class Controller:
         self.args = args
         self.ps_servers = 0
         if args.run_mode == "ps":
-            trainers = args.trainer_num or args.nproc_per_node
+            trainers = args.trainer_num if args.trainer_num is not None \
+                else args.nproc_per_node
             self.ps_servers = args.server_num
             args.nproc_per_node = self.ps_servers + trainers
         if args.nproc_per_node > 1 and \

@@ -33,6 +33,20 @@ from .. import rpc
 _SERVER_NAME = "ps_server"
 
 
+def rowwise_update(data: np.ndarray, g2, ids: np.ndarray,
+                   grads: np.ndarray, optimizer: str, lr: float) -> None:
+    """Row-sparse optimizer step shared by the server Table and the local
+    HostEmbedding path (one definition so eps/accumulator semantics cannot
+    drift). Duplicate ids accumulate (np.ufunc.at semantics). g2 is the
+    per-row Adagrad accumulator (None for SGD)."""
+    if optimizer == "sgd":
+        np.subtract.at(data, ids, lr * grads)
+        return
+    np.add.at(g2, ids, (grads ** 2).mean(axis=1))
+    scale = lr / np.sqrt(g2[ids] + 1e-10)
+    np.subtract.at(data, ids, scale[:, None] * grads)
+
+
 class Table:
     """One server-side table with a built-in row-sparse optimizer (the
     memory_sparse_table role: push applies the update, pull reads rows)."""
@@ -48,10 +62,16 @@ class Table:
             .astype(np.float32)
         self.optimizer = optimizer
         self.learning_rate = learning_rate
+        self.initializer_range = initializer_range
+        self.seed = seed
         self._g2 = np.zeros(rows, np.float32) if optimizer == "adagrad" \
             else None
         self.lock = threading.Lock()
         self.push_count = 0
+
+    def config(self):
+        return (self.data.shape, self.optimizer, self.learning_rate,
+                self.initializer_range, self.seed)
 
     def pull(self, ids: np.ndarray) -> np.ndarray:
         with self.lock:
@@ -60,13 +80,8 @@ class Table:
     def push(self, ids: np.ndarray, grads: np.ndarray):
         with self.lock:
             self.push_count += 1
-            if self.optimizer == "sgd":
-                np.subtract.at(self.data, ids, self.learning_rate * grads)
-                return
-            g2 = (grads ** 2).mean(axis=1)
-            np.add.at(self._g2, ids, g2)
-            scale = self.learning_rate / np.sqrt(self._g2[ids] + 1e-10)
-            np.subtract.at(self.data, ids, scale[:, None] * grads)
+            rowwise_update(self.data, self._g2, ids, grads, self.optimizer,
+                           self.learning_rate)
 
 
 class _Server:
@@ -82,8 +97,7 @@ class _Server:
             if name not in self.tables:   # first creator wins (idempotent)
                 self.tables[name] = Table(rows, dim, optimizer, lr,
                                           init_range, seed)
-            t = self.tables[name]
-            return (t.data.shape, t.optimizer, t.learning_rate)
+            return self.tables[name].config()
 
     def table(self, name) -> Table:
         with self.mu:
@@ -227,26 +241,39 @@ class PSClient:
         self._pending: list = []
         # enter the SSP clock set immediately: a trainer still loading data
         # must already count as "slowest", or the bound is unenforced
-        # exactly when skew is largest
-        rpc.rpc_sync(self.server, _ps_register, args=(self.worker,))
+        # exactly when skew is largest. Retried because rpc.init_rpc
+        # completing on the server rank does not mean its main thread has
+        # reached run_server() yet (startup race).
+        deadline = time.monotonic() + 60.0
+        while True:
+            try:
+                rpc.rpc_sync(self.server, _ps_register, args=(self.worker,))
+                break
+            except RuntimeError as e:
+                if "not running" not in str(e) or \
+                        time.monotonic() > deadline:
+                    raise
+                time.sleep(0.05)
 
     def create_table(self, name: str, rows: int, dim: int,
                      optimizer: str = "sgd", learning_rate: float = 0.01,
                      initializer_range: float = 0.0, seed: int = 0):
         """Create-or-attach (first creator wins). The server's actual table
-        config is validated against the requested one so silent config
-        drift between trainers cannot produce shape/optimizer mismatches."""
-        shape, opt, lr = rpc.rpc_sync(
+        config is validated against the requested one — shape, optimizer,
+        lr, AND init args — so silent config drift between trainers cannot
+        diverge the shared table."""
+        got = rpc.rpc_sync(
             self.server, _ps_create,
             args=(name, rows, dim, optimizer, learning_rate,
                   initializer_range, seed))
-        if tuple(shape) != (rows, dim) or opt != optimizer or \
-                abs(lr - learning_rate) > 1e-12:
+        want = ((rows, dim), optimizer, learning_rate, initializer_range,
+                seed)
+        if (tuple(got[0]),) + tuple(got[1:]) != want:
             raise ValueError(
-                f"table {name!r} already exists with shape={tuple(shape)} "
-                f"optimizer={opt!r} lr={lr}, which conflicts with the "
-                f"requested ({rows}, {dim})/{optimizer!r}/lr={learning_rate}")
-        return shape, opt
+                f"table {name!r} already exists with (shape, optimizer, lr, "
+                f"init_range, seed)={got}, which conflicts with the "
+                f"requested {want}")
+        return got[0], got[1]
 
     def pull(self, name: str, ids) -> np.ndarray:
         return rpc.rpc_sync(self.server, _ps_pull,

@@ -46,10 +46,11 @@ class Future:
     """Minimal future for rpc_async (parity: the FutureWrapper returned by
     the reference's rpc_async; wait() blocks and re-raises remote errors)."""
 
-    def __init__(self):
+    def __init__(self, cleanup=None):
         self._ev = threading.Event()
         self._value = None
         self._exc: Optional[BaseException] = None
+        self._cleanup = cleanup
 
     def _resolve(self, ok: bool, payload):
         if ok:
@@ -63,6 +64,8 @@ class Future:
 
     def wait(self, timeout: Optional[float] = None):
         if not self._ev.wait(_DEFAULT_TIMEOUT if timeout is None else timeout):
+            if self._cleanup is not None:
+                self._cleanup()   # unregister: a late reply must not leak
             raise TimeoutError("rpc future timed out")
         if self._exc is not None:
             raise self._exc
@@ -182,7 +185,11 @@ class _RpcAgent:
         req_id = uuid.uuid4().hex
         fut = None
         if needs_reply:
-            fut = Future()
+            def _cleanup(rid=req_id):
+                with self._fut_lock:
+                    self._futures.pop(rid, None)
+
+            fut = Future(cleanup=_cleanup)
             with self._fut_lock:
                 self._futures[req_id] = fut
         self._send(w.rank, {"kind": "call", "src": self.rank,
@@ -193,18 +200,28 @@ class _RpcAgent:
 
     def shutdown(self, graceful: bool = True):
         if graceful:
-            # every rank arrives before anyone tears down its mailbox
-            self._tx.barrier("rpc_shutdown")
-            # rank 0 hosts the store: it must outlive every peer's barrier
-            # GET, so wait for an explicit ack from all ranks before
-            # stopping the server
-            self._tx.add("rpc/shutdown_done", 1)
-            if self.rank == 0:
-                deadline = time.monotonic() + _DEFAULT_TIMEOUT
-                while self._tx.add("rpc/shutdown_done", 0) < self.world_size:
-                    if time.monotonic() > deadline:
-                        break
-                    time.sleep(0.02)
+            # A DEDICATED connection for the shutdown handshake: the barrier
+            # ends in a long blocking GET, and the store client allows one
+            # request in flight — parking that GET on _tx would stall reply
+            # sends from handler threads (deadlocking peers whose rpc_sync
+            # must return before THEY shut down).
+            ctrl = TCPStore(self._rx.host, self._rx.port, is_master=False,
+                            world_size=self.world_size)
+            try:
+                # every rank arrives before anyone tears down its mailbox
+                ctrl.barrier("rpc_shutdown")
+                # rank 0 hosts the store: it must outlive every peer's
+                # barrier GET, so wait for an explicit ack from all ranks
+                # before stopping the server
+                ctrl.add("rpc/shutdown_done", 1)
+                if self.rank == 0:
+                    deadline = time.monotonic() + _DEFAULT_TIMEOUT
+                    while ctrl.add("rpc/shutdown_done", 0) < self.world_size:
+                        if time.monotonic() > deadline:
+                            break
+                        time.sleep(0.02)
+            finally:
+                ctrl.stop()
         self._stop = True
         self._recv_thread.join(timeout=5.0)
         self._pool.shutdown(wait=False)

@@ -104,13 +104,9 @@ class HostEmbedding(Layer):
         if self._client is not None:
             self._client.push(self.table_name, row_ids, row_grads)
             return
-        if self.optimizer == "sgd":
-            self.table[row_ids] -= self.learning_rate * row_grads
-            return
-        g2 = (row_grads ** 2).mean(axis=1)
-        self._g2[row_ids] += g2
-        scale = self.learning_rate / np.sqrt(self._g2[row_ids] + 1e-10)
-        self.table[row_ids] -= scale[:, None] * row_grads
+        from ...distributed.ps import rowwise_update
+        rowwise_update(self.table, self._g2, row_ids, row_grads,
+                       self.optimizer, self.learning_rate)
 
     def lookup(self, ids: np.ndarray) -> np.ndarray:
         ids = np.asarray(ids).astype(np.int64)

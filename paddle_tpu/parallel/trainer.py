@@ -194,17 +194,25 @@ class SpmdTrainer:
             stacklevel=3)
         return entries
 
+    def _tp_spec(self, p: Tensor) -> PartitionSpec:
+        """TP-annotation-only layout (no ZeRO dims): the gradient's natural
+        layout as produced by the backward dots + dp psum."""
+        entries = [None] * p._data.ndim
+        if self.mesh is not None:
+            ann = get_param_annotation(p)
+            if ann is not None:
+                axis_name, dim = ann
+                if axis_name in self.mesh.dim_names and \
+                        self.mesh.get_dim_size(axis_name) > 1 and \
+                        p._data.shape[dim] % \
+                        self.mesh.get_dim_size(axis_name) == 0:
+                    entries[dim] = axis_name
+        return PartitionSpec(*entries)
+
     def _param_spec(self, name: str, p: Tensor) -> PartitionSpec:
         if self.mesh is None:
             return PartitionSpec()
-        entries = [None] * p._data.ndim
-        ann = get_param_annotation(p)
-        if ann is not None:
-            axis_name, dim = ann
-            if axis_name in self.mesh.dim_names and \
-                    self.mesh.get_dim_size(axis_name) > 1 and \
-                    p._data.shape[dim] % self.mesh.get_dim_size(axis_name) == 0:
-                entries[dim] = axis_name
+        entries = list(self._tp_spec(p))
         if self.zero_stage >= 3:
             # ZeRO-3/FSDP: params live sharded over `sharding`; GSPMD inserts
             # all-gather-on-use in fwd/bwd and reduce-scatter for their grads
@@ -317,6 +325,20 @@ class SpmdTrainer:
                 return self._pure_loss(params_, batch, key)
 
             loss, grads = jax.value_and_grad(pure_loss)(params)
+            if self.zero_stage >= 1 and self._jax_mesh is not None:
+                # Pin each gradient to its NATURAL layout (TP annotation
+                # only) first: user annotations are fixed points for GSPMD
+                # propagation, so the ZeRO 'sharding'-dim layout of the
+                # optimizer state/update cannot leak backward into the
+                # transpose dots (where it resharded the ACTIVATIONS from
+                # batch- to hidden-sharded — "involuntary full
+                # rematerialization", a param-sized all-gather per step;
+                # the dryrun asserts this stays fixed). The subsequent
+                # reshard to the ZeRO layout is a local slice of the psum'd
+                # gradient.
+                grads = {n: jax.lax.with_sharding_constraint(
+                            g, self._sharding(self._tp_spec(self._params[n])))
+                         for n, g in grads.items()}
             if self.zero_stage >= 2 and self._jax_mesh is not None:
                 grads = {n: jax.lax.with_sharding_constraint(
                             g, self._sharding(self._grad_spec(n)))
