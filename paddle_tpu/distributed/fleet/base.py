@@ -251,13 +251,15 @@ class Fleet:
         """Parity: fleet/model.py:33 — wrap by parallel mode."""
         hcg = self.get_hybrid_communicate_group()
         mode = hcg.get_parallel_mode()
-        from .meta_parallel import (PipelineParallel, ShardingParallel,
-                                    TensorParallel)
+        from .meta_parallel import (PipelineParallel, SegmentParallel,
+                                    ShardingParallel, TensorParallel)
         from ..parallel import DataParallel
         if mode == "pipeline":
             return PipelineParallel(model, hcg, self._strategy)
         if mode == "model":
             return TensorParallel(model, hcg, self._strategy)
+        if mode == "segment":
+            return SegmentParallel(model, hcg, self._strategy)
         if mode == "sharding":
             return ShardingParallel(model, hcg, self._strategy)
         return DataParallel(model)

@@ -235,16 +235,117 @@ class MetaParallelBase(Layer):
         return self._layers.named_parameters(*a, **k)
 
 
-class TensorParallel(MetaParallelBase):
-    """Parity: meta_parallel/tensor_parallel.py:28."""
+class _ReplicaConsistentParallel(MetaParallelBase):
+    """Shared mechanics of the mode wrappers (reference
+    meta_parallel/{tensor,segment,sharding}_parallel.py `_prepare_for_model`):
+
+    * **initial param sync** — the reference broadcasts params over each
+      NCCL group (mp/sep/sharding/dp) so replicas start identical. Here a
+      process holds the FULL replicated arrays (intra-program sharding is
+      GSPMD's job), so one rank-0 host broadcast over the world covers
+      every group; runs automatically at construction when launched
+      multi-process (PADDLE_TRAINERS_NUM > 1).
+    * **grad sync** — compiled steps get their gradient psums from GSPMD
+      (sharded batch ⇒ psum). For the eager multi-process path,
+      `apply_collective_grads()` averages ready grads across processes
+      (the EagerReducer role, reducer.cc:979, without bucketing — host
+      collectives are control-plane).
+    * **degrees** — the hcg's parallel degrees are exposed as properties
+      (reference wrappers reach them through self._hcg too).
+    """
+
+    def __init__(self, layers, hcg, strategy=None):
+        super().__init__(layers, hcg, strategy)
+        self._prepare_for_model()
+
+    # -- hcg degrees -----------------------------------------------------------
+    def _degree(self, getter: str) -> int:
+        if self._hcg is None:
+            return 1
+        return getattr(self._hcg, getter)()
+
+    @property
+    def mp_degree(self):
+        return self._degree("get_model_parallel_world_size")
+
+    @property
+    def dp_degree(self):
+        return self._degree("get_data_parallel_world_size")
+
+    @property
+    def pp_degree(self):
+        return self._degree("get_pipe_parallel_world_size")
+
+    @property
+    def sep_degree(self):
+        return self._degree("get_sep_parallel_world_size")
+
+    @property
+    def sharding_degree(self):
+        return self._degree("get_sharding_parallel_world_size")
+
+    # -- param/grad sync -------------------------------------------------------
+    def _prepare_for_model(self):
+        from ..host_collectives import get_host_collectives
+        cc = get_host_collectives()
+        if cc is None:
+            return
+        import jax.numpy as jnp
+        import numpy as np
+        named = sorted(self._layers.named_parameters(), key=lambda kv: kv[0])
+        # one store round for the whole state, not one per parameter
+        state = {n: np.asarray(p._data) for n, p in named} \
+            if cc.rank == 0 else None
+        state = cc.broadcast_object(state, src=0)
+        if cc.rank != 0:
+            for n, p in named:
+                p._data = jnp.asarray(state[n])
+
+    def apply_collective_grads(self):
+        """Average eager gradients across processes (dp replicas). Every
+        process must call this after backward, in lockstep. A param whose
+        grad is None locally (unused on this rank's data) still joins the
+        collective with zeros — rank-asymmetric participation would
+        desynchronize the store sequence for every later collective."""
+        from ..host_collectives import get_host_collectives
+        from ...tensor import Tensor
+        cc = get_host_collectives()
+        if cc is None:
+            return
+        import jax.numpy as jnp
+        import numpy as np
+        for _, p in sorted(self._layers.named_parameters(),
+                           key=lambda kv: kv[0]):
+            g = getattr(p, "grad", None)
+            local = np.zeros(p._data.shape, np.asarray(p._data).dtype) \
+                if g is None else np.asarray(g._data)
+            avg = cc.all_reduce(local, op="avg")
+            if g is None:
+                p.grad = Tensor(jnp.asarray(avg))
+            else:
+                p.grad._data = jnp.asarray(avg)
 
 
-class SegmentParallel(MetaParallelBase):
-    """Parity: meta_parallel/segment_parallel.py:26."""
+class TensorParallel(_ReplicaConsistentParallel):
+    """Parity: meta_parallel/tensor_parallel.py:28 (broadcast mp/sep/
+    sharding/dp params, broadcast input data over the mp group). The mp
+    group lives INSIDE the compiled program here (TP = sharding
+    annotations), so every mp "rank" reads the same input by construction
+    — `_pre_forward`'s input broadcast is subsumed; param sync and eager
+    grad sync are real (base class)."""
 
 
-class ShardingParallel(MetaParallelBase):
-    """Parity: meta_parallel/sharding_parallel.py."""
+class SegmentParallel(_ReplicaConsistentParallel):
+    """Parity: meta_parallel/segment_parallel.py:26 (broadcast sep/
+    sharding/dp params). Sequence sharding itself is the sep mesh axis +
+    ring attention (parallel/ring_attention.py)."""
+
+
+class ShardingParallel(_ReplicaConsistentParallel):
+    """Parity: meta_parallel/sharding_parallel.py (broadcast sharding/dp
+    params). The ZeRO partitioning is the trainer's zero_stage
+    (parallel/trainer.py); this wrapper guarantees consistent initial
+    replicas and exposes the degrees."""
 
 
 class PipelineParallel(MetaParallelBase):

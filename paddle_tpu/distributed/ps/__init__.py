@@ -266,14 +266,17 @@ class PSClient:
             self.server, _ps_create,
             args=(name, rows, dim, optimizer, learning_rate,
                   initializer_range, seed))
-        want = ((rows, dim), optimizer, learning_rate, initializer_range,
-                seed)
-        if (tuple(got[0]),) + tuple(got[1:]) != want:
+        g_shape, g_opt, g_lr, g_ir, g_seed = got
+        ok = (tuple(g_shape) == (rows, dim) and g_opt == optimizer
+              and abs(g_lr - learning_rate) <= 1e-12
+              and abs(g_ir - initializer_range) <= 1e-12
+              and g_seed == seed)
+        if not ok:
             raise ValueError(
                 f"table {name!r} already exists with (shape, optimizer, lr, "
                 f"init_range, seed)={got}, which conflicts with the "
-                f"requested {want}")
-        return got[0], got[1]
+                f"requested {((rows, dim), optimizer, learning_rate, initializer_range, seed)}")
+        return g_shape, g_opt
 
     def pull(self, name: str, ids) -> np.ndarray:
         return rpc.rpc_sync(self.server, _ps_pull,

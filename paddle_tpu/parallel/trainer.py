@@ -325,7 +325,7 @@ class SpmdTrainer:
                 return self._pure_loss(params_, batch, key)
 
             loss, grads = jax.value_and_grad(pure_loss)(params)
-            if self.zero_stage >= 1 and self._jax_mesh is not None:
+            if 1 <= self.zero_stage <= 2 and self._jax_mesh is not None:
                 # Pin each gradient to its NATURAL layout (TP annotation
                 # only) first: user annotations are fixed points for GSPMD
                 # propagation, so the ZeRO 'sharding'-dim layout of the
@@ -333,9 +333,13 @@ class SpmdTrainer:
                 # transpose dots (where it resharded the ACTIVATIONS from
                 # batch- to hidden-sharded — "involuntary full
                 # rematerialization", a param-sized all-gather per step;
-                # the dryrun asserts this stays fixed). The subsequent
+                # the dryrun asserts this stays fixed). With replicated
+                # params (stages 1/2) the TP layout IS the gradient's
+                # natural layout, so the pin is free and the subsequent
                 # reshard to the ZeRO layout is a local slice of the psum'd
-                # gradient.
+                # gradient. Stage 3 params are stored sharded — there the
+                # grads are pinned to the param layout instead (below), the
+                # FSDP reduce-scatter contract.
                 grads = {n: jax.lax.with_sharding_constraint(
                             g, self._sharding(self._tp_spec(self._params[n])))
                          for n, g in grads.items()}

@@ -1,0 +1,79 @@
+"""Worker for the meta_parallel wrapper multi-process tests
+(test_meta_parallel_wrappers.py): two processes with DIFFERENT seeds wrap a
+model in TensorParallel / SegmentParallel / ShardingParallel; the wrapper
+must (a) make initial params identical to rank 0's, and (b) after each rank
+backprops its own half-batch, apply_collective_grads() must reproduce the
+serial full-batch gradient (reference parallel==serial strategy, SURVEY §4).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.nn as nn  # noqa: E402
+from paddle_tpu.distributed.fleet.meta_parallel import (  # noqa: E402
+    SegmentParallel, ShardingParallel, TensorParallel)
+
+
+def build(seed):
+    paddle.seed(seed)
+    return nn.Sequential(nn.Linear(6, 16), nn.Tanh(), nn.Linear(16, 1))
+
+
+def main():
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    rng = np.random.default_rng(99)                 # same data on both ranks
+    x_full = rng.standard_normal((8, 6)).astype(np.float32)
+    y_full = rng.standard_normal((8, 1)).astype(np.float32)
+
+    for wrapper_cls in (TensorParallel, SegmentParallel, ShardingParallel):
+        model = build(seed=1234 + rank)             # ranks start DIFFERENT
+        wrapped = wrapper_cls(model, hcg=None)
+        assert wrapped.mp_degree == 1 and wrapped.dp_degree == 1
+
+        # (a) initial params now equal rank 0's
+        ref = build(seed=1234)                      # what rank 0 built
+        for (n1, p), (n2, q) in zip(
+                sorted(model.named_parameters(), key=lambda kv: kv[0]),
+                sorted(ref.named_parameters(), key=lambda kv: kv[0])):
+            np.testing.assert_allclose(np.asarray(p._data),
+                                       np.asarray(q._data), atol=0,
+                                       err_msg=f"{wrapper_cls.__name__} {n1}")
+
+        # (b) dp grad sync: each rank backprops its own half of the batch
+        half = slice(rank * 4, (rank + 1) * 4)
+        out = wrapped(paddle.to_tensor(x_full[half]))
+        loss = ((out - paddle.to_tensor(y_full[half])) ** 2).mean()
+        loss.backward()
+        wrapped.apply_collective_grads()
+
+        # serial oracle: full batch on the synced model
+        serial = build(seed=1234)
+        s_out = serial(paddle.to_tensor(x_full))
+        s_loss = ((s_out - paddle.to_tensor(y_full)) ** 2).mean()
+        s_loss.backward()
+        for (n1, p), (n2, q) in zip(
+                sorted(model.named_parameters(), key=lambda kv: kv[0]),
+                sorted(serial.named_parameters(), key=lambda kv: kv[0])):
+            np.testing.assert_allclose(
+                np.asarray(p.grad._data), np.asarray(q.grad._data),
+                rtol=1e-5, atol=1e-6,
+                err_msg=f"{wrapper_cls.__name__} grad {n1}")
+        print(f"{wrapper_cls.__name__} rank{rank} OK")
+
+    # rank 0 hosts the store: it must not exit while rank 1 still has a
+    # collective's payload in flight
+    from paddle_tpu.distributed.host_collectives import get_host_collectives
+    get_host_collectives().barrier()
+    print("META_PARALLEL OK")
+
+
+if __name__ == "__main__":
+    main()
