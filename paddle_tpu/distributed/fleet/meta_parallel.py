@@ -286,44 +286,16 @@ class _ReplicaConsistentParallel(MetaParallelBase):
 
     # -- param/grad sync -------------------------------------------------------
     def _prepare_for_model(self):
-        from ..host_collectives import get_host_collectives
-        cc = get_host_collectives()
-        if cc is None:
-            return
-        import jax.numpy as jnp
-        import numpy as np
-        named = sorted(self._layers.named_parameters(), key=lambda kv: kv[0])
-        # one store round for the whole state, not one per parameter
-        state = {n: np.asarray(p._data) for n, p in named} \
-            if cc.rank == 0 else None
-        state = cc.broadcast_object(state, src=0)
-        if cc.rank != 0:
-            for n, p in named:
-                p._data = jnp.asarray(state[n])
+        from ..replica_sync import sync_params_from_rank0
+        sync_params_from_rank0(self._layers)
 
     def apply_collective_grads(self):
         """Average eager gradients across processes (dp replicas). Every
-        process must call this after backward, in lockstep. A param whose
-        grad is None locally (unused on this rank's data) still joins the
-        collective with zeros — rank-asymmetric participation would
-        desynchronize the store sequence for every later collective."""
-        from ..host_collectives import get_host_collectives
-        from ...tensor import Tensor
-        cc = get_host_collectives()
-        if cc is None:
-            return
-        import jax.numpy as jnp
-        import numpy as np
-        for _, p in sorted(self._layers.named_parameters(),
-                           key=lambda kv: kv[0]):
-            g = getattr(p, "grad", None)
-            local = np.zeros(p._data.shape, np.asarray(p._data).dtype) \
-                if g is None else np.asarray(g._data)
-            avg = cc.all_reduce(local, op="avg")
-            if g is None:
-                p.grad = Tensor(jnp.asarray(avg))
-            else:
-                p.grad._data = jnp.asarray(avg)
+        process must call this after backward, in lockstep (see
+        replica_sync.average_gradients for the rank-symmetric participation
+        contract)."""
+        from ..replica_sync import average_gradients
+        average_gradients(self._layers)
 
 
 class TensorParallel(_ReplicaConsistentParallel):

@@ -1,10 +1,13 @@
 """DataParallel wrapper.
 
 Reference parity: paddle.DataParallel (distributed/parallel.py:219) +
-EagerReducer gradient bucketing (fluid/distributed/collective/reducer.cc). On
-TPU SPMD, gradient synchronization happens inside the compiled program (psum
-inserted by GSPMD when the batch dim is sharded), so this wrapper's job reduces
-to API parity: it marks the model for dp sharding and provides no_sync.
+EagerReducer gradient bucketing (fluid/distributed/collective/reducer.cc).
+Compiled steps get gradient synchronization from GSPMD (psum inserted when
+the batch dim is sharded); for the eager MULTI-PROCESS path this wrapper
+does the reference's real work over host collectives: initial params are
+broadcast from rank 0 at construction, and apply_collective_grads()
+averages gradients across replicas (replica_sync.py). Single-process: all
+of it no-ops.
 """
 from __future__ import annotations
 
@@ -21,13 +24,22 @@ class DataParallel(Layer):
         self._layers = layers
         self.group = group
         self.find_unused_parameters = find_unused_parameters
+        self._sync = True
+        from .replica_sync import sync_params_from_rank0
+        sync_params_from_rank0(layers)
 
     def forward(self, *inputs, **kwargs):
         return self._layers(*inputs, **kwargs)
 
     @contextlib.contextmanager
     def no_sync(self):
-        yield
+        """Skip grad averaging inside the context (gradient accumulation),
+        like the reference's hook suppression."""
+        self._sync = False
+        try:
+            yield
+        finally:
+            self._sync = True
 
     def state_dict(self, *args, **kwargs):
         return self._layers.state_dict(*args, **kwargs)
@@ -39,4 +51,7 @@ class DataParallel(Layer):
         return loss
 
     def apply_collective_grads(self):
-        pass
+        if not self._sync:
+            return
+        from .replica_sync import average_gradients
+        average_gradients(self._layers)

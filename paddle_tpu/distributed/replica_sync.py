@@ -1,0 +1,62 @@
+"""Cross-process replica synchronization (eager path).
+
+The mechanics behind DataParallel and the fleet mode wrappers (reference:
+broadcast_dp_parameters + EagerReducer, hybrid_parallel_util.py /
+reducer.cc): make initial params identical across processes and average
+eager gradients. Compiled steps don't need any of this — GSPMD emits the
+psums — so these run host collectives (control plane) and are no-ops in
+single-process mode.
+"""
+from __future__ import annotations
+
+
+def sync_params_from_rank0(layer) -> None:
+    """Broadcast rank 0's full parameter state to every process, in ONE
+    store round."""
+    from .host_collectives import get_host_collectives
+    cc = get_host_collectives()
+    if cc is None:
+        return
+    import jax.numpy as jnp
+    import numpy as np
+    named = sorted(layer.named_parameters(), key=lambda kv: kv[0])
+    state = {n: np.asarray(p._data) for n, p in named} \
+        if cc.rank == 0 else None
+    state = cc.broadcast_object(state, src=0)
+    if cc.rank != 0:
+        for n, p in named:
+            p._data = jnp.asarray(state[n])
+
+
+def average_gradients(layer) -> None:
+    """Average eager grads across processes. Participation must be
+    rank-symmetric or the store sequence desyncs, so ranks first agree
+    (one object round) on WHICH params have a grad anywhere: a param with
+    a grad on some rank joins with zeros where it is locally None; a param
+    with no grad on ANY rank stays None everywhere (the optimizer skips
+    it, exactly like the serial run)."""
+    from ..tensor import Tensor
+    from .host_collectives import get_host_collectives
+    cc = get_host_collectives()
+    if cc is None:
+        return
+    import jax.numpy as jnp
+    import numpy as np
+    named = sorted(layer.named_parameters(), key=lambda kv: kv[0])
+    local_has = {n: getattr(p, "grad", None) is not None for n, p in named}
+    any_has = {n: False for n, _ in named}
+    for other in cc.all_gather_object(local_has):
+        for n, has in other.items():
+            if has:
+                any_has[n] = True
+    for n, p in named:
+        if not any_has[n]:
+            continue
+        g = getattr(p, "grad", None)
+        local = np.zeros(p._data.shape, np.asarray(p._data).dtype) \
+            if g is None else np.asarray(g._data)
+        avg = cc.all_reduce(local, op="avg")
+        if g is None:
+            p.grad = Tensor(jnp.asarray(avg))
+        else:
+            p.grad._data = jnp.asarray(avg)

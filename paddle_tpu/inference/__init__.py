@@ -1,25 +1,42 @@
-"""paddle_tpu.inference — serving predictor over AOT-exported artifacts.
+"""paddle_tpu.inference — serving predictors over AOT-exported artifacts.
 
 Reference parity: paddle.inference (AnalysisConfig + AnalysisPredictor,
 paddle/fluid/inference/api/analysis_predictor.cc:1574 Run, :2177
-OptimizeInferenceProgram). TPU-native: the offline optimization pipeline
-(IR passes, TRT subgraphs) is replaced by ahead-of-time XLA compilation —
-the artifact produced by `paddle_tpu.jit.save` is a serialized StableHLO
-module with the weights alongside; `create_predictor` deserializes it and
-runs it through the XLA runtime. Zero-copy handles mirror the reference's
-copy_from_cpu/copy_to_cpu tensor API.
+OptimizeInferenceProgram; PredictorPool for multi-predictor serving).
+TPU-native: the offline optimization pipeline (IR passes, TRT subgraphs) is
+replaced by ahead-of-time XLA compilation — the artifact produced by
+`paddle_tpu.jit.save` is a serialized StableHLO module with the weights
+alongside; `create_predictor` deserializes it and runs it through the XLA
+runtime. Zero-copy handles mirror the reference's copy_from_cpu/copy_to_cpu
+tensor API. Concurrency: `Predictor.clone()` / `PredictorPool` share one
+loaded executable with per-predictor handles (the reference's clone()
+sharing the scope), and `BatchingServer` adds request-queue micro-batching
+on top — stacking compatible single requests into one device call, where
+TPU throughput lives.
 """
 from __future__ import annotations
 
+import warnings
 from typing import Dict, List, Optional
 
 import jax.numpy as jnp
 import numpy as np
 
+_warned_noops = set()
+
+
+def _warn_noop(knob: str, why: str):
+    if knob not in _warned_noops:
+        _warned_noops.add(knob)
+        warnings.warn(f"inference.Config.{knob} has no effect here: {why}",
+                      stacklevel=3)
+
 
 class Config:
     """Parity: paddle.inference.Config (AnalysisConfig). Graph-optimization
-    knobs are accepted for API compatibility; XLA owns those decisions."""
+    and device knobs are accepted for API compatibility but have no effect
+    (XLA owns those decisions) — each warns ONCE so misconfiguration is
+    visible instead of silent."""
 
     def __init__(self, model_path: Optional[str] = None,
                  params_path: Optional[str] = None):
@@ -36,27 +53,44 @@ class Config:
     def model_dir(self):
         return self.model_path
 
-    # accepted no-ops (XLA decides): keep the reference surface working
+    # accepted no-ops (XLA decides): keep the reference surface working,
+    # but never silently — one warning per knob per process. Enabling the
+    # optimizations is XLA's default (nothing to say); DISABLING them is a
+    # request we cannot honor, which warrants the warning.
     def switch_ir_optim(self, flag=True):
         self._ir_optim = flag
+        if not flag:
+            _warn_noop("switch_ir_optim(False)",
+                       "XLA always optimizes the AOT-compiled module")
 
     def enable_memory_optim(self, flag=True):
         self._memory_optim = flag
+        if not flag:
+            _warn_noop("enable_memory_optim(False)",
+                       "XLA owns buffer assignment in the compiled module")
 
     def disable_glog_info(self):
-        pass
+        pass  # logging verbosity: harmless, genuinely nothing to do
 
     def enable_use_gpu(self, *a, **k):
-        pass  # device choice is jax platform selection
+        _warn_noop("enable_use_gpu",
+                   "the device comes from the jax platform (TPU/CPU)")
 
     def disable_gpu(self):
-        pass
+        _warn_noop("disable_gpu",
+                   "the device comes from the jax platform (TPU/CPU)")
 
     def enable_xpu(self, *a, **k):
-        pass
+        _warn_noop("enable_xpu",
+                   "the device comes from the jax platform (TPU/CPU)")
+
+    def enable_tensorrt_engine(self, *a, **k):
+        _warn_noop("enable_tensorrt_engine",
+                   "AOT XLA compilation replaces the TRT subgraph engine")
 
     def set_cpu_math_library_num_threads(self, n):
-        pass
+        _warn_noop("set_cpu_math_library_num_threads",
+                   "XLA:CPU owns its own thread pool")
 
 
 class _Handle:
@@ -84,14 +118,23 @@ class _Handle:
 class Predictor:
     """Parity: paddle.inference.Predictor (AnalysisPredictor::Run :1574)."""
 
-    def __init__(self, config: Config):
-        from ..jit import load
-        if not config.model_path:
-            raise ValueError("Config needs a model path (jit.save artifact)")
-        self._layer = load(config.model_path)
+    def __init__(self, config: Config, _layer=None):
+        if _layer is None:
+            from ..jit import load
+            if not config.model_path:
+                raise ValueError(
+                    "Config needs a model path (jit.save artifact)")
+            _layer = load(config.model_path)
+        self._config = config
+        self._layer = _layer
         self._inputs: Dict[str, _Handle] = {
             n: _Handle() for n in self._layer.input_names()}
         self._output_arrays: List = []
+
+    def clone(self) -> "Predictor":
+        """Share the loaded executable + weights; private handles (parity:
+        AnalysisPredictor::Clone — new predictor over the shared scope)."""
+        return Predictor(self._config, _layer=self._layer)
 
     def get_input_names(self) -> List[str]:
         return list(self._inputs)
@@ -135,4 +178,142 @@ def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
 
 
-__all__ = ["Config", "Predictor", "create_predictor"]
+class PredictorPool:
+    """Parity: paddle.inference.PredictorPool — N predictors over ONE
+    loaded artifact (first is the main predictor, the rest are clones), so
+    concurrent server threads each own private handles while sharing the
+    compiled executable and weights."""
+
+    def __init__(self, config: Config, size: int = 1):
+        if size < 1:
+            raise ValueError(f"pool size must be >= 1, got {size}")
+        main = create_predictor(config)
+        self._preds = [main] + [main.clone() for _ in range(size - 1)]
+
+    def __len__(self):
+        return len(self._preds)
+
+    def retrieve(self, idx: int) -> Predictor:
+        return self._preds[idx]
+
+
+class BatchingServer:
+    """Request-queue micro-batching over one predictor.
+
+    The reference serves throughput with many AnalysisPredictors running
+    concurrently (analysis_predictor.cc:1574); a TPU serves it with BIGGER
+    batches — one executable call over stacked requests keeps the MXU fed.
+    submit() enqueues a single request (one array per model input, no batch
+    dim or batch=1 semantics decided by the model) and returns a Future;
+    a worker thread drains the queue, groups up to max_batch_size requests
+    with identical shapes/dtypes, stacks them along axis 0, runs ONE
+    forward, and splits the outputs back per request.
+    """
+
+    def __init__(self, predictor: Predictor, max_batch_size: int = 8,
+                 max_delay_ms: float = 2.0):
+        import queue
+        import threading
+        self._pred = predictor
+        self.max_batch_size = int(max_batch_size)
+        self.max_delay = float(max_delay_ms) / 1000.0
+        self._q: "queue.Queue" = queue.Queue()
+        self._stop = False
+        self._submit_lock = threading.Lock()
+        self.batches_run = 0
+        self.requests_served = 0
+        self._worker = threading.Thread(target=self._loop, daemon=True,
+                                        name="inference-batcher")
+        self._worker.start()
+
+    # -- client side ----------------------------------------------------------
+    def submit(self, inputs: List[np.ndarray]):
+        """Enqueue one request; returns a Future whose .result() is the
+        output list for THIS request (leading batch dim of size 1
+        squeezed off to match the submitted rank)."""
+        from concurrent.futures import Future
+        fut: Future = Future()
+        # lock closes the submit-vs-close race: nothing can enqueue after
+        # the close sentinel, so no Future is ever left undrained
+        with self._submit_lock:
+            if self._stop:
+                raise RuntimeError("BatchingServer is closed")
+            self._q.put(([np.asarray(a) for a in inputs], fut))
+        return fut
+
+    def close(self):
+        with self._submit_lock:
+            if self._stop:
+                return
+            self._stop = True
+            self._q.put(None)
+        self._worker.join(timeout=10.0)
+
+    # -- server side ----------------------------------------------------------
+    def _signature(self, arrays):
+        return tuple((a.shape, str(a.dtype)) for a in arrays)
+
+    def _loop(self):
+        import queue
+        import time
+        pending = []   # [(arrays, fut)] with identical signatures
+        sig = None
+        deadline = None
+        while True:
+            timeout = None if not pending else \
+                max(0.0, deadline - time.monotonic())
+            try:
+                item = self._q.get(timeout=timeout)
+            except queue.Empty:
+                item = False          # delay expired: flush
+            if item is None:          # close()
+                if pending:
+                    self._run_batch(pending)
+                return
+            if item is not False:
+                arrays, fut = item
+                s = self._signature(arrays)
+                if pending and s != sig:
+                    self._run_batch(pending)   # incompatible: flush first
+                    pending = []
+                if not pending:
+                    sig = s
+                    deadline = time.monotonic() + self.max_delay
+                pending.append(item)
+                if len(pending) < self.max_batch_size and \
+                        time.monotonic() < deadline:
+                    continue
+            if pending:
+                self._run_batch(pending)
+                pending = []
+
+    @staticmethod
+    def _deliver(fut, result=None, exc=None):
+        # a client may have cancelled its Future; that must not poison the
+        # co-batched requests
+        try:
+            if exc is not None:
+                fut.set_exception(exc)
+            else:
+                fut.set_result(result)
+        except Exception:
+            pass
+
+    def _run_batch(self, batch):
+        try:
+            n_inputs = len(batch[0][0])
+            stacked = [np.stack([req[0][i] for req in batch])
+                       for i in range(n_inputs)]
+            outs = self._pred.run(stacked)
+            self.batches_run += 1
+            self.requests_served += len(batch)
+            for j, (_, fut) in enumerate(batch):
+                self._deliver(fut, result=[o[j] for o in outs])
+        except BaseException as e:
+            for _, fut in batch:
+                if not fut.done():
+                    self._deliver(fut, exc=e)
+
+
+__all__ = ["Config", "Predictor", "PredictorPool", "BatchingServer",
+           "create_predictor"]

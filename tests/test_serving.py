@@ -1,0 +1,145 @@
+"""Serving depth: predictor clone/pool, concurrent clients, micro-batching
+server, and warn-once Config knobs (reference capability:
+analysis_predictor.cc:1574 multi-predictor Run + PredictorPool)."""
+import threading
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.inference import (BatchingServer, Config, PredictorPool,
+                                  create_predictor)
+from paddle_tpu.jit import InputSpec
+
+
+def _saved_mlp(tmp_path, seed=5):
+    paddle.seed(seed)
+    m = nn.Sequential(nn.Linear(10, 32), nn.ReLU(), nn.Linear(32, 4))
+    path = str(tmp_path / "mlp")
+    paddle.jit.save(m, path, input_spec=[InputSpec([None, 10], "float32")])
+    return m, path
+
+
+def test_clone_shares_weights_private_handles(tmp_path):
+    m, path = _saved_mlp(tmp_path)
+    p1 = create_predictor(Config(path))
+    p2 = p1.clone()
+    assert p2._layer is p1._layer          # shared executable + weights
+    x1 = np.random.default_rng(0).standard_normal((2, 10)).astype(np.float32)
+    x2 = np.random.default_rng(1).standard_normal((3, 10)).astype(np.float32)
+    p1.get_input_handle(p1.get_input_names()[0]).copy_from_cpu(x1)
+    p2.get_input_handle(p2.get_input_names()[0]).copy_from_cpu(x2)
+    o1 = p1.run()
+    o2 = p2.run()
+    np.testing.assert_allclose(o1[0], m(paddle.to_tensor(x1)).numpy(),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(o2[0], m(paddle.to_tensor(x2)).numpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pool_concurrent_clients(tmp_path):
+    m, path = _saved_mlp(tmp_path)
+    n_threads = 4
+    pool = PredictorPool(Config(path), size=n_threads)
+    rng = np.random.default_rng(2)
+    xs = [rng.standard_normal((2, 10)).astype(np.float32)
+          for _ in range(n_threads)]
+    refs = [m(paddle.to_tensor(x)).numpy() for x in xs]
+    results = [None] * n_threads
+    errors = []
+
+    def client(i):
+        try:
+            for _ in range(5):   # hammer it a bit
+                results[i] = pool.retrieve(i).run([xs[i]])[0]
+        except BaseException as e:  # surfaced below
+            errors.append((i, e))
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors
+    for got, ref in zip(results, refs):
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_batching_server_groups_requests(tmp_path):
+    m, path = _saved_mlp(tmp_path)
+    pred = create_predictor(Config(path))
+    server = BatchingServer(pred, max_batch_size=8, max_delay_ms=30.0)
+    try:
+        rng = np.random.default_rng(3)
+        xs = [rng.standard_normal((10,)).astype(np.float32)
+              for _ in range(16)]
+        futs = [server.submit([x]) for x in xs]
+        outs = [f.result(timeout=120) for f in futs]
+        for x, o in zip(xs, outs):
+            ref = m(paddle.to_tensor(x[None])).numpy()[0]
+            np.testing.assert_allclose(o[0], ref, rtol=1e-5, atol=1e-5)
+        assert server.requests_served == 16
+        # micro-batching actually grouped: far fewer device calls than
+        # requests
+        assert server.batches_run < 16, server.batches_run
+    finally:
+        server.close()
+
+
+def test_batching_server_multithreaded_clients_and_shape_change(tmp_path):
+    m, path = _saved_mlp(tmp_path)
+    server = BatchingServer(create_predictor(Config(path)),
+                            max_batch_size=4, max_delay_ms=10.0)
+    try:
+        rng = np.random.default_rng(4)
+        results = {}
+        lock = threading.Lock()
+
+        def client(i):
+            x = rng.standard_normal((10,)).astype(np.float32)
+            out = server.submit([x]).result(timeout=120)
+            with lock:
+                results[i] = (x, out[0])
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(12)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert len(results) == 12
+        for x, o in results.values():
+            np.testing.assert_allclose(
+                o, m(paddle.to_tensor(x[None])).numpy()[0], rtol=1e-5,
+                atol=1e-5)
+        # a request with a DIFFERENT shape flushes and still works
+        # (batch-of-1 fallback group)
+        x2 = rng.standard_normal((10,)).astype(np.float32)
+        np.testing.assert_allclose(
+            server.submit([x2]).result(timeout=120)[0],
+            m(paddle.to_tensor(x2[None])).numpy()[0], rtol=1e-5, atol=1e-5)
+    finally:
+        server.close()
+
+
+def test_server_rejects_after_close(tmp_path):
+    _, path = _saved_mlp(tmp_path)
+    server = BatchingServer(create_predictor(Config(path)))
+    server.close()
+    with pytest.raises(RuntimeError):
+        server.submit([np.zeros((10,), np.float32)])
+
+
+def test_config_noop_knobs_warn_once():
+    import paddle_tpu.inference as inf
+    inf._warned_noops.discard("enable_use_gpu")
+    c = Config("x")
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        c.enable_use_gpu(100, 0)
+        c.enable_use_gpu(100, 0)
+    hits = [x for x in w if "enable_use_gpu" in str(x.message)]
+    assert len(hits) == 1, [str(x.message) for x in w]
