@@ -34,12 +34,13 @@ class DataParallel(Layer):
     @contextlib.contextmanager
     def no_sync(self):
         """Skip grad averaging inside the context (gradient accumulation),
-        like the reference's hook suppression."""
-        self._sync = False
+        like the reference's hook suppression. Reentrant: restores the
+        prior state on exit."""
+        prev, self._sync = self._sync, False
         try:
             yield
         finally:
-            self._sync = True
+            self._sync = prev
 
     def state_dict(self, *args, **kwargs):
         return self._layers.state_dict(*args, **kwargs)

@@ -238,7 +238,9 @@ class BatchingServer:
         with self._submit_lock:
             if self._stop:
                 raise RuntimeError("BatchingServer is closed")
-            self._q.put(([np.asarray(a) for a in inputs], fut))
+            # copy: the caller may reuse its buffer before the worker
+            # drains the queue
+            self._q.put(([np.array(a) for a in inputs], fut))
         return fut
 
     def close(self):

@@ -242,6 +242,39 @@ def _linear_scan_alloc(intervals):
     return slot_of, n
 
 
+def _place_w_lane(p: int, feed, t_end: int, limit: int, defer_bound: int):
+    """Shared load-aware W placement for the zero-bubble schedules.
+
+    feed(t) -> (load, new_ready): per-rank base lane counts at tick t and
+    the units whose (x, dy) become available this tick ([(rank, unit)]).
+    Walks ticks in order; a ready W unit runs on rank r only when r's lane
+    count stays strictly below the tick's busiest rank (it rides on ranks
+    the lockstep barrier would leave waiting), with force-placement after
+    defer_bound ticks so the deferred buffer stays O(p). Leftovers drain in
+    all-W tail ticks past t_end. Returns {(rank, unit): w_tick}."""
+    w_tick = {}
+    ready = {r: [] for r in range(p)}   # FIFO of (unit, ready_tick)
+    t = 0
+    while t < t_end or any(ready[r] for r in range(p)):
+        load, new_ready = feed(t) if t < t_end else ([0] * p, [])
+        for r, unit in new_ready:
+            ready[r].append((unit, t))
+        tick_max = max(load)
+        for r in range(p):
+            if not ready[r]:
+                continue
+            unit, b_t = ready[r][0]
+            free = load[r] + 1 <= tick_max or tick_max == 0
+            overdue = t - b_t >= defer_bound
+            if free or overdue:
+                w_tick[(r, unit)] = t
+                ready[r].pop(0)
+        t += 1
+        if t > limit:
+            raise RuntimeError("zero-bubble W placement did not converge")
+    return w_tick
+
+
 def _zb_schedule(p: int, m: int):
     """ZB-H1 tick tables: 1F1B's F and B(dx) lanes plus a deferred W
     (weight-gradient) lane (parity: pipeline_zero_bubble.py:62
@@ -262,32 +295,20 @@ def _zb_schedule(p: int, m: int):
     both lockstep and async cost models."""
     import numpy as np_
     T0 = m + 2 * (p - 1)
-    w_tick = {}
-    ready = {r: [] for r in range(p)}   # FIFO of (unit, b_tick)
-    nxt_b = [0] * p
-    t = 0
-    while any(len(ready[r]) + (m - nxt_b[r]) for r in range(p)) or t < T0:
-        base = [0] * p
+
+    def feed(t):
+        load = [0] * p
+        new_ready = []
         for r in range(p):
             if 0 <= t - r < m:
-                base[r] += 1
+                load[r] += 1
             if 0 <= t - (2 * (p - 1) - r) < m:
-                base[r] += 1
-                ready[r].append((nxt_b[r], t))  # (x, dy) exist from this tick
-                nxt_b[r] += 1
-        tick_max = max(base)
-        for r in range(p):
-            if not ready[r]:
-                continue
-            unit, b_t = ready[r][0]
-            free = base[r] + 1 <= tick_max or tick_max == 0
-            overdue = t - b_t >= 2 * p
-            if free or overdue:
-                w_tick[(r, unit)] = t
-                ready[r].pop(0)
-        t += 1
-        if t > 4 * T0 + 4 * m:
-            raise RuntimeError("zb W placement did not converge")
+                load[r] += 1
+                # (x, dy) of this B unit exist from this tick
+                new_ready.append((r, t - (2 * (p - 1) - r)))
+        return load, new_ready
+
+    w_tick = _place_w_lane(p, feed, T0, 4 * T0 + 4 * m, 2 * p)
     T = max([T0] + [tt + 1 for tt in w_tick.values()])
 
     F_mb = np_.full((T, p), -1, np_.int32)
@@ -683,6 +704,87 @@ def _interleaved_schedule(p: int, v: int, m: int):
             "S_in": S_in, "S_stash": S_stash, "S_dy": S_dy}
 
 
+def _zb_vpp_schedule(p: int, v: int, m: int):
+    """Zero-bubble composed with virtual stages (parity:
+    pipeline_zero_bubble.py:151 ZB-VPP): the interleaved-VPP F/B tables
+    keep their timing (so the inter-stage dependency chain is untouched),
+    the backward is split into a dx-only B lane, and the weight-gradient W
+    lane is placed load-aware into the schedule's slack exactly like
+    _zb_schedule — a ready W unit runs on rank r only when r's lane count
+    stays strictly below the tick's busiest rank, with a 2p-tick deferral
+    bound so the (x, dy) buffer stays O(p). Leftover W drains in tail
+    ticks. Returns the interleave tables (padded to the extended T) plus
+    W_mb/W_ch/W_store_slot/W_read_slot/S_w and modeled lockstep makespans
+    for both this schedule and plain interleave (F=1, B_dx=1, W=1;
+    interleave's fused backward costs 2)."""
+    import numpy as np_
+    base = _interleaved_schedule(p, v, m)
+    T0 = base["T"]
+    F_mb, B_mb, B_ch = base["F_mb"], base["B_mb"], base["B_ch"]
+
+    def feed(t):
+        load = [0] * p
+        new_ready = []
+        for r in range(p):
+            if F_mb[t, r] >= 0:
+                load[r] += 1
+            if B_mb[t, r] >= 0:
+                load[r] += 1
+                new_ready.append((r, (int(B_mb[t, r]), int(B_ch[t, r]))))
+        return load, new_ready
+
+    w_tick = _place_w_lane(p, feed, T0, 8 * (T0 + m * v) + 16, 2 * p)
+    T = max([T0] + [tt + 1 for tt in w_tick.values()])
+
+    def pad(a):
+        out = np_.full((T, p), -1, np_.int32)
+        out[:a.shape[0]] = a
+        return out
+
+    sched = {k: (pad(vv) if isinstance(vv, np_.ndarray) else vv)
+             for k, vv in base.items() if k != "T"}
+    W_mb = np_.full((T, p), -1, np_.int32)
+    W_ch = np_.full((T, p), -1, np_.int32)
+    for (r, (i, j)), tt in w_tick.items():
+        W_mb[tt, r] = i
+        W_ch[tt, r] = j
+
+    # W-lane buffers: (x, dy) of unit (i, j) live [b_tick, w_tick]
+    b_tick = {}
+    for t_ in range(T0):
+        for r in range(p):
+            if B_mb[t_, r] >= 0:
+                b_tick[(r, (int(B_mb[t_, r]), int(B_ch[t_, r])))] = t_
+    W_store_slot = np_.full((T, p), -1, np_.int32)
+    W_read_slot = np_.full((T, p), -1, np_.int32)
+    S_w = 1
+    for r in range(p):
+        iv = [(bt, w_tick[(r, u)], u)
+              for (rr, u), bt in b_tick.items() if rr == r]
+        slots, n = _linear_scan_alloc(iv)
+        S_w = max(S_w, n)
+        for (rr, u), bt in b_tick.items():
+            if rr == r:
+                W_store_slot[bt, r] = slots[u]
+                W_read_slot[w_tick[(r, u)], r] = slots[u]
+
+    mk_lock_ilv = sum(
+        max((1 if F_mb[t_, r] >= 0 else 0)
+            + (2 if B_mb[t_, r] >= 0 else 0) for r in range(p))
+        for t_ in range(T0))
+    mk_lock_zb = sum(
+        max((1 if sched["F_mb"][t_, r] >= 0 else 0)
+            + (1 if sched["B_mb"][t_, r] >= 0 else 0)
+            + (1 if W_mb[t_, r] >= 0 else 0) for r in range(p))
+        for t_ in range(T))
+    sched.update({"T": T, "W_mb": W_mb, "W_ch": W_ch,
+                  "W_store_slot": W_store_slot, "W_read_slot": W_read_slot,
+                  "S_w": S_w,
+                  "makespan_lockstep_zb_vpp": mk_lock_zb,
+                  "makespan_lockstep_interleave": mk_lock_ilv})
+    return sched
+
+
 def pipeline_interleaved(h0, labels, consts, stacked_leaves, tail_leaves, *,
                          block_apply_flat, tail_apply_flat, axis_name: str,
                          n_micro: int, vpp_chunks: int, remat: bool = True):
@@ -824,6 +926,154 @@ def pipeline_interleaved(h0, labels, consts, stacked_leaves, tail_leaves, *,
     return loss, d_h0, blk_g, tail_g
 
 
+def pipeline_zb_vpp(h0, labels, consts, stacked_leaves, tail_leaves, *,
+                    block_apply_flat, tail_apply_flat, axis_name: str,
+                    n_micro: int, vpp_chunks: int, remat: bool = True):
+    """Per-device ZB-VPP region (call inside shard_map; manual over `pp`).
+
+    Interleaved-VPP's cross-phase F/B overlap (pipeline_interleaved) with
+    the zero-bubble backward split (pipeline_zb): the B lane computes only
+    dx — what the upstream virtual stage is waiting for — and the weight
+    gradient runs in the deferred W lane from _zb_vpp_schedule's tables,
+    filling ticks the lockstep barrier would waste (parity:
+    pipeline_zero_bubble.py:151 ZB-VPP). Numerics identical to
+    pipeline_interleaved: the same per-unit dW accumulates, one lane later.
+    """
+    p = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+    m, v = n_micro, vpp_chunks
+    sched = _zb_vpp_schedule(int(p), v, m)
+    lc = stacked_leaves[0].shape[0] // v
+
+    def chunk_slices(leaves, j):
+        return [lax.dynamic_slice_in_dim(l, j * lc, lc, axis=0)
+                for l in leaves]
+
+    def stage_fn(x, leaves):
+        def body(h, leaf_slices):
+            return block_apply_flat(leaf_slices, h, *consts), None
+        step = jax.checkpoint(body) if remat else body
+        y, _ = lax.scan(step, x, leaves)
+        return y
+
+    def tail_fn(y, tleaves, label):
+        return tail_apply_flat(list(tleaves), y, label)
+
+    x0 = jnp.zeros_like(h0[0])
+    zeros_like_tree = lambda tr: jax.tree.map(jnp.zeros_like, tr)
+    unit = h0.shape[1:]
+    carry0 = (
+        x0,                                   # x_recv
+        x0,                                   # dy_recv
+        jnp.zeros((sched["S_in"],) + unit, h0.dtype),     # in_buf[slot]
+        jnp.zeros((sched["S_dy"],) + unit, h0.dtype),     # dy_buf[slot]
+        jnp.zeros((sched["S_stash"],) + unit, h0.dtype),  # stash[slot]
+        jnp.zeros((sched["S_w"],) + unit, h0.dtype),      # W lane: x
+        jnp.zeros((sched["S_w"],) + unit, h0.dtype),      # W lane: dy
+        jnp.float32(0.0),                     # loss accumulator
+        zeros_like_tree(list(stacked_leaves)),  # block grads
+        zeros_like_tree(list(tail_leaves)),     # tail grads
+        jnp.zeros_like(h0),                   # d_h0 accumulator
+    )
+    V = v * int(p)
+
+    tables = tuple(jnp.asarray(sched[k]) for k in
+                   ("F_mb", "F_ch", "B_mb", "B_ch",
+                    "F_in_slot", "F_stash_slot", "F_dy_slot",
+                    "B_stash_slot", "B_dy_slot", "RSF_slot", "RSB_slot",
+                    "W_mb", "W_ch", "W_store_slot", "W_read_slot"))
+
+    def tick(carry, xs):
+        (x_recv, dy_recv, in_buf, dy_buf, stash, wx_buf, wdy_buf, loss_acc,
+         blk_g, tail_g, dh0_acc) = carry
+        (f_mb, f_ch, b_mb, b_ch, f_in_slot, f_stash_slot, f_dy_slot,
+         b_stash_slot, b_dy_slot, rsf_slot, rsb_slot,
+         w_mb, w_ch, w_store, w_read) = [row[rank] for row in xs]
+
+        def store(buf, val, slot, valid):
+            si = jnp.clip(slot, 0, buf.shape[0] - 1)
+            return buf.at[si].set(jnp.where(valid, val, buf[si]))
+
+        in_buf = store(in_buf, x_recv, rsf_slot, rsf_slot >= 0)
+        dy_buf = store(dy_buf, dy_recv, rsb_slot, rsb_slot >= 0)
+
+        # ---- forward micro-step (identical to pipeline_interleaved) ------
+        fwd_valid = f_mb >= 0
+        fi = jnp.clip(f_mb, 0, m - 1)
+        fj = jnp.clip(f_ch, 0, v - 1)
+        s_virt = fj * p + rank
+        fresh = lax.dynamic_index_in_dim(h0, fi, 0, keepdims=False)
+        from_buf = in_buf[jnp.clip(f_in_slot, 0, in_buf.shape[0] - 1)]
+        x_in = jnp.where(s_virt == 0, fresh, from_buf)
+        y = stage_fn(x_in, chunk_slices(list(stacked_leaves), fj))
+        stash = store(stash, x_in, f_stash_slot, fwd_valid)
+
+        lab = lax.dynamic_index_in_dim(labels, fi, 0, keepdims=False)
+
+        def tail_branch(y_, tleaves):
+            loss_f, tl_vjp = jax.vjp(lambda yy, tl: tail_fn(yy, tl, lab),
+                                     y_, tleaves)
+            dh, dtail = tl_vjp(jnp.float32(1.0 / m))
+            return loss_f, dh, dtail
+
+        def tail_skip(y_, tleaves):
+            return (jnp.float32(0.0), jnp.zeros_like(y_),
+                    tuple(jnp.zeros_like(t_) for t_ in tleaves))
+
+        is_last_virt = fwd_valid & (s_virt == V - 1)
+        loss_f, dh_f, dtail_f = lax.cond(
+            is_last_virt, tail_branch, tail_skip, y, tuple(tail_leaves))
+        loss_acc = loss_acc + loss_f / m
+        tail_g = [tg + dt for tg, dt in zip(tail_g, dtail_f)]
+        dy_buf = store(dy_buf, dh_f.astype(h0.dtype), f_dy_slot,
+                       is_last_virt)
+
+        # ---- B lane: dx ONLY ---------------------------------------------
+        bwd_valid = b_mb >= 0
+        bi = jnp.clip(b_mb, 0, m - 1)
+        bj = jnp.clip(b_ch, 0, v - 1)
+        sb_virt = bj * p + rank
+        x_b = stash[jnp.clip(b_stash_slot, 0, stash.shape[0] - 1)]
+        dy_in = dy_buf[jnp.clip(b_dy_slot, 0, dy_buf.shape[0] - 1)]
+        _, dx_vjp = jax.vjp(
+            lambda xx: stage_fn(xx, chunk_slices(list(stacked_leaves), bj)),
+            x_b)
+        (dx_b,) = dx_vjp(dy_in)
+        cur = lax.dynamic_index_in_dim(dh0_acc, bi, 0, keepdims=False)
+        dh0_acc = lax.dynamic_update_index_in_dim(
+            dh0_acc, jnp.where(bwd_valid & (sb_virt == 0), dx_b, cur), bi, 0)
+        # stash (x, dy) for the deferred W lane (same-tick W reads after
+        # this store, like pipeline_zb)
+        wx_buf = store(wx_buf, x_b, w_store, bwd_valid & (w_store >= 0))
+        wdy_buf = store(wdy_buf, dy_in, w_store, bwd_valid & (w_store >= 0))
+
+        # ---- W lane: dW for a (possibly earlier) unit --------------------
+        w_valid = w_mb >= 0
+        wj = jnp.clip(w_ch, 0, v - 1)
+        wr = jnp.clip(w_read, 0, wx_buf.shape[0] - 1)
+        x_w, dy_w = wx_buf[wr], wdy_buf[wr]
+        _, dw_vjp = jax.vjp(
+            lambda lv: stage_fn(x_w, chunk_slices(lv, wj)),
+            list(stacked_leaves))
+        (dleaves_w,) = dw_vjp(dy_w)
+        blk_g = [bg + jnp.where(w_valid, dl, jnp.zeros_like(dl))
+                 for bg, dl in zip(blk_g, dleaves_w)]
+
+        x_next = lax.ppermute(y, axis_name, rotate_perm(p))
+        dy_next = lax.ppermute(dx_b, axis_name,
+                               [(jj, (jj - 1) % p) for jj in range(p)])
+        return (x_next, dy_next, in_buf, dy_buf, stash, wx_buf, wdy_buf,
+                loss_acc, blk_g, tail_g, dh0_acc), None
+
+    (x_l, dy_l, in_buf, dy_buf, stash, wx_buf, wdy_buf, loss_acc, blk_g,
+     tail_g, dh0_acc), _ = lax.scan(tick, carry0, tables)
+
+    loss = lax.psum(loss_acc, axis_name)
+    d_h0 = lax.psum(dh0_acc, axis_name)
+    tail_g = [lax.psum(g, axis_name) for g in tail_g]
+    return loss, d_h0, blk_g, tail_g
+
+
 class PipelinedTrainer(SpmdTrainer):
     """SpmdTrainer with the decoder blocks run as a circular pp pipeline.
 
@@ -842,7 +1092,7 @@ class PipelinedTrainer(SpmdTrainer):
 
     STACK_PREFIX = "pp_stacked."
 
-    SCHEDULES = ("circular", "1f1b", "vpp", "interleave", "zb")
+    SCHEDULES = ("circular", "1f1b", "vpp", "interleave", "zb", "zb_vpp")
 
     def __init__(self, model, optimizer, loss_fn, mesh=None,
                  n_micro: int = 1, remat: bool = True,
@@ -856,7 +1106,8 @@ class PipelinedTrainer(SpmdTrainer):
         self.n_micro = n_micro
         self._pp_remat = remat
         self.schedule = schedule
-        self.vpp_chunks = vpp_chunks if schedule in ("vpp", "interleave") else 1
+        self.vpp_chunks = vpp_chunks \
+            if schedule in ("vpp", "interleave", "zb_vpp") else 1
         super().__init__(model, optimizer, loss_fn, mesh=mesh,
                          remat_layers=None, **kw)
         self.pp_degree = (mesh.get_dim_size("pp")
@@ -864,14 +1115,14 @@ class PipelinedTrainer(SpmdTrainer):
         if len(blocks) % max(self.pp_degree, 1) != 0:
             raise ValueError(
                 f"{len(blocks)} blocks not divisible by pp={self.pp_degree}")
-        if schedule in ("vpp", "interleave"):
+        if schedule in ("vpp", "interleave", "zb_vpp"):
             v, p = self.vpp_chunks, max(self.pp_degree, 1)
             if len(blocks) % (v * p) != 0:
                 raise ValueError(
                     f"{len(blocks)} blocks not divisible by "
                     f"vpp_chunks*pp={v}*{p}")
             self._vpp_reorder()
-        if schedule in ("1f1b", "interleave"):
+        if schedule in ("1f1b", "interleave", "zb_vpp"):
             for meth in ("pp_embed", "pp_tail", "pp_embed_param_names",
                          "pp_tail_param_names"):
                 if not hasattr(model, meth):
@@ -1009,7 +1260,7 @@ class PipelinedTrainer(SpmdTrainer):
 
     # -- 1F1B / interleave: manual schedules, grads produced by the region -----
     def _build(self, batch_arrays):
-        if self.schedule not in ("1f1b", "interleave", "zb"):
+        if self.schedule not in ("1f1b", "interleave", "zb", "zb_vpp"):
             return super()._build(batch_arrays)
         if self._jax_mesh is None or "pp" not in self.mesh.dim_names:
             raise ValueError(
@@ -1048,6 +1299,11 @@ class PipelinedTrainer(SpmdTrainer):
         if self.schedule == "interleave":
             region = functools.partial(
                 pipeline_interleaved, block_apply_flat=block_apply_flat,
+                tail_apply_flat=tail_apply_flat, axis_name="pp", n_micro=nm,
+                vpp_chunks=self.vpp_chunks, remat=self._pp_remat)
+        elif self.schedule == "zb_vpp":
+            region = functools.partial(
+                pipeline_zb_vpp, block_apply_flat=block_apply_flat,
                 tail_apply_flat=tail_apply_flat, axis_name="pp", n_micro=nm,
                 vpp_chunks=self.vpp_chunks, remat=self._pp_remat)
         elif self.schedule == "zb":
