@@ -85,10 +85,18 @@ class StaticFunction:
 
     Robustness beyond plain trace-and-compile (reference SOT capability,
     minus bytecode rewriting):
-    - graph-break fallback: if tracing hits data-dependent Python control
-      flow on tensor values (``if float(x) > 0``), the call falls back to
-      eager execution with a one-time warning, and that (shape, kwargs)
-      signature keeps using eager so the failing trace isn't re-attempted.
+    - graph-break PARTIAL compilation: if tracing hits data-dependent
+      Python control flow on tensor values (``if float(x) > 0``), the call
+      re-runs with the layer's Python forward interpreted eagerly but each
+      direct sublayer compiled as its own StaticFunction — the analog of
+      SOT's subgraph stitching around a break (opcode_executor.py:353) at
+      function granularity instead of bytecode granularity. A sublayer
+      that itself breaks recurses (its own children get compiled), so one
+      data-dependent ``if`` costs only the glue between sublayers, not all
+      fusion. Plain functions (no layer) fall back to fully-eager.
+      Diagnostics: ``.stats`` counts compiled/partial/eager calls and
+      traces, so "my model silently runs 100% eager" is visible; the
+      per-signature fallback cache is bounded.
     - optional shape bucketing (``to_static(..., bucket_batch=True)``): the
       leading dim of every input is padded to the next power of two and
       outputs are sliced back, so serving-style dynamic batch sizes reuse a
@@ -111,9 +119,13 @@ class StaticFunction:
         self._param_names: List[str] = []
         self._buffer_names: List[str] = []
         self._bucket_batch = bucket_batch
-        self._fallback_keys = set()
+        self._fallback_keys: Dict = {}   # signature -> "partial" | "eager"
+        self._fallback_cap = 512
+        self._child_static: Optional[Dict[str, "StaticFunction"]] = None
         self._warned_break = False
         self._trace_count = 0  # diagnostics: number of fresh traces
+        self.stats = {"compiled_calls": 0, "partial_calls": 0,
+                      "eager_calls": 0}
         self.__name__ = getattr(function, "__name__", "static_fn")
 
     @property
@@ -166,15 +178,56 @@ class StaticFunction:
     def _call_eager(self, args, kwargs):
         return self._function(*args, **kwargs)
 
+    def _build_child_static(self):
+        """Per-child StaticFunctions for the partial path. A child that
+        already carries its own instance-level forward (e.g. the user ran
+        to_static on the sublayer too) is left alone — it is already
+        compiled and must not be wrapped or clobbered."""
+        if self._child_static is None:
+            self._child_static = {
+                name: StaticFunction(child.forward, layer=child)
+                for name, child in self._layer.named_children()
+                if "forward" not in child.__dict__}
+        return self._child_static
+
+    def _call_fallback(self, args, kwargs):
+        """Partial-graph execution for a breaking signature: the layer's
+        own forward runs as eager Python (so the data-dependent branch just
+        executes), but every direct sublayer is swapped for its own
+        compiled StaticFunction for the duration of the call."""
+        layer = self._layer
+        if layer is None or not self._build_child_static():
+            # no sublayers to keep compiled: this really is eager
+            self.stats["eager_calls"] += 1
+            return self._call_eager(args, kwargs)
+        self.stats["partial_calls"] += 1
+        patched = []
+        try:
+            for name, child in layer.named_children():
+                sf = self._child_static.get(name)
+                if sf is not None and "forward" not in child.__dict__:
+                    child.__dict__["forward"] = sf
+                    patched.append(child)
+            return self._function(*args, **kwargs)
+        finally:
+            for child in patched:
+                child.__dict__.pop("forward", None)
+
     def _graph_break(self, static_key, err):
-        self._fallback_keys.add(static_key)
+        if len(self._fallback_keys) >= self._fallback_cap:
+            self._fallback_keys.clear()   # bounded: worst case re-warms
+        self._fallback_keys[static_key] = "partial"
         if not self._warned_break:
             self._warned_break = True
             import warnings
+            has_children = self._layer is not None and \
+                bool(self._build_child_static())
+            mode = "partial compilation (sublayers stay compiled)" \
+                if has_children else "eager"
             warnings.warn(
                 f"to_static({self.__name__}): graph break — data-dependent "
                 f"Python control flow on tensor values cannot be traced; "
-                f"falling back to eager for this call signature. "
+                f"this call signature uses {mode}. "
                 f"({type(err).__name__}: {str(err)[:200]})", stacklevel=3)
 
     def __call__(self, *args, **kwargs):
@@ -191,7 +244,7 @@ class StaticFunction:
                         tuple((tuple(t._data.shape), str(t._data.dtype))
                               for t in raw_tensors))
         if fallback_key in self._fallback_keys:
-            return self._call_eager(raw_args, kwargs)
+            return self._call_fallback(raw_args, kwargs)
         orig_batch = None
         if self._bucket_batch:
             args, orig_batch = self._pad_args(raw_spec, raw_tensors)
@@ -228,7 +281,8 @@ class StaticFunction:
             result = dispatch("to_static", fwd, *all_inputs)
         except _graph_break_errors() as e:
             self._graph_break(fallback_key, e)
-            return self._call_eager(raw_args, kwargs)
+            return self._call_fallback(raw_args, kwargs)
+        self.stats["compiled_calls"] += 1
         if not isinstance(result, tuple):
             result = (result,)
         out_spec = self._spec_cell[static_key]

@@ -785,6 +785,112 @@ def _zb_vpp_schedule(p: int, v: int, m: int):
     return sched
 
 
+class _IlvScaffold:
+    """Machinery shared by the interleave-family regions
+    (pipeline_interleaved, pipeline_zb_vpp): chunked stage application, the
+    slot-store helper, the forward micro-step (input select, stash, tail
+    loss + dL/dy feed) and the ring exchanges. The regions differ only in
+    their backward lane(s)."""
+
+    def __init__(self, h0, labels, consts, stacked_leaves, tail_leaves,
+                 block_apply_flat, tail_apply_flat, axis_name, m, v, remat):
+        self.p = lax.axis_size(axis_name)
+        self.rank = lax.axis_index(axis_name)
+        self.axis_name = axis_name
+        self.h0, self.labels = h0, labels
+        self.stacked_leaves = list(stacked_leaves)
+        self.tail_leaves = list(tail_leaves)
+        self.m, self.v = m, v
+        self.V = v * int(self.p)
+        self.lc = stacked_leaves[0].shape[0] // v
+        self.tail_apply_flat = tail_apply_flat
+
+        def stage_fn(x, leaves):
+            def body(h, leaf_slices):
+                return block_apply_flat(leaf_slices, h, *consts), None
+            step = jax.checkpoint(body) if remat else body
+            y, _ = lax.scan(step, x, leaves)
+            return y
+
+        self.stage_fn = stage_fn
+
+    def chunk_slices(self, leaves, j):
+        return [lax.dynamic_slice_in_dim(l, j * self.lc, self.lc, axis=0)
+                for l in leaves]
+
+    @staticmethod
+    def store(buf, val, slot, valid):
+        si = jnp.clip(slot, 0, buf.shape[0] - 1)
+        return buf.at[si].set(jnp.where(valid, val, buf[si]))
+
+    def base_carry(self, sched):
+        x0 = jnp.zeros_like(self.h0[0])
+        unit = self.h0.shape[1:]
+        zeros_like_tree = lambda tr: jax.tree.map(jnp.zeros_like, tr)
+        return (
+            x0,                                   # x_recv
+            x0,                                   # dy_recv
+            jnp.zeros((sched["S_in"],) + unit, self.h0.dtype),    # in_buf
+            jnp.zeros((sched["S_dy"],) + unit, self.h0.dtype),    # dy_buf
+            jnp.zeros((sched["S_stash"],) + unit, self.h0.dtype),  # stash
+            jnp.float32(0.0),                     # loss accumulator
+            zeros_like_tree(self.stacked_leaves),  # block grads
+            zeros_like_tree(self.tail_leaves),     # tail grads
+            jnp.zeros_like(self.h0),              # d_h0 accumulator
+        )
+
+    def forward_micro(self, cols, in_buf, dy_buf, stash, loss_acc, tail_g):
+        """One forward micro-step: input select (fresh vs ring buffer),
+        stage apply, stash write, and — on the last virtual stage — tail
+        loss + dL/dy fed straight into dy_buf."""
+        f_mb, f_ch, f_in_slot, f_stash_slot, f_dy_slot = cols
+        p, m, v = self.p, self.m, self.v
+        fwd_valid = f_mb >= 0
+        fi = jnp.clip(f_mb, 0, m - 1)
+        fj = jnp.clip(f_ch, 0, v - 1)
+        s_virt = fj * p + self.rank
+        fresh = lax.dynamic_index_in_dim(self.h0, fi, 0, keepdims=False)
+        from_buf = in_buf[jnp.clip(f_in_slot, 0, in_buf.shape[0] - 1)]
+        x_in = jnp.where(s_virt == 0, fresh, from_buf)
+        y = self.stage_fn(x_in, self.chunk_slices(self.stacked_leaves, fj))
+        stash = self.store(stash, x_in, f_stash_slot, fwd_valid)
+
+        lab = lax.dynamic_index_in_dim(self.labels, fi, 0, keepdims=False)
+
+        def tail_branch(y_, tleaves):
+            loss_f, tl_vjp = jax.vjp(
+                lambda yy, tl: self.tail_apply_flat(list(tl), yy, lab),
+                y_, tleaves)
+            dh, dtail = tl_vjp(jnp.float32(1.0 / m))
+            return loss_f, dh, dtail
+
+        def tail_skip(y_, tleaves):
+            return (jnp.float32(0.0), jnp.zeros_like(y_),
+                    tuple(jnp.zeros_like(t_) for t_ in tleaves))
+
+        is_last_virt = fwd_valid & (s_virt == self.V - 1)
+        loss_f, dh_f, dtail_f = lax.cond(
+            is_last_virt, tail_branch, tail_skip, y, tuple(self.tail_leaves))
+        loss_acc = loss_acc + loss_f / m
+        tail_g = [tg + dt for tg, dt in zip(tail_g, dtail_f)]
+        dy_buf = self.store(dy_buf, dh_f.astype(self.h0.dtype), f_dy_slot,
+                            is_last_virt)
+        return y, stash, dy_buf, loss_acc, tail_g
+
+    def ring_exchange(self, y, dx_b):
+        p = self.p
+        x_next = lax.ppermute(y, self.axis_name, rotate_perm(p))
+        dy_next = lax.ppermute(dx_b, self.axis_name,
+                               [(jj, (jj - 1) % p) for jj in range(p)])
+        return x_next, dy_next
+
+    def finalize(self, loss_acc, dh0_acc, tail_g):
+        loss = lax.psum(loss_acc, self.axis_name)
+        d_h0 = lax.psum(dh0_acc, self.axis_name)
+        tail_g = [lax.psum(g, self.axis_name) for g in tail_g]
+        return loss, d_h0, tail_g
+
+
 def pipeline_interleaved(h0, labels, consts, stacked_leaves, tail_leaves, *,
                          block_apply_flat, tail_apply_flat, axis_name: str,
                          n_micro: int, vpp_chunks: int, remat: bool = True):
@@ -804,42 +910,13 @@ def pipeline_interleaved(h0, labels, consts, stacked_leaves, tail_leaves, *,
     L_local = v * lc rows, chunk j = rows [j*lc, (j+1)*lc).
     Returns (mean_loss, d_h0, blk_grads, tail_grads) like pipeline_1f1b.
     """
-    p = lax.axis_size(axis_name)
-    rank = lax.axis_index(axis_name)
     m, v = n_micro, vpp_chunks
+    sc = _IlvScaffold(h0, labels, consts, stacked_leaves, tail_leaves,
+                      block_apply_flat, tail_apply_flat, axis_name, m, v,
+                      remat)
+    p, rank = sc.p, sc.rank
     sched = _interleaved_schedule(int(p), v, m)
-    T = sched["T"]
-    lc = stacked_leaves[0].shape[0] // v
-
-    def chunk_slices(leaves, j):
-        return [lax.dynamic_slice_in_dim(l, j * lc, lc, axis=0)
-                for l in leaves]
-
-    def stage_fn(x, leaves):
-        def body(h, leaf_slices):
-            return block_apply_flat(leaf_slices, h, *consts), None
-        step = jax.checkpoint(body) if remat else body
-        y, _ = lax.scan(step, x, leaves)
-        return y
-
-    def tail_fn(y, tleaves, label):
-        return tail_apply_flat(list(tleaves), y, label)
-
-    x0 = jnp.zeros_like(h0[0])
-    zeros_like_tree = lambda tr: jax.tree.map(jnp.zeros_like, tr)
-    unit = h0.shape[1:]
-    carry0 = (
-        x0,                                   # x_recv
-        x0,                                   # dy_recv
-        jnp.zeros((sched["S_in"],) + unit, h0.dtype),     # in_buf[slot]
-        jnp.zeros((sched["S_dy"],) + unit, h0.dtype),     # dy_buf[slot]
-        jnp.zeros((sched["S_stash"],) + unit, h0.dtype),  # stash[slot]
-        jnp.float32(0.0),                     # loss accumulator
-        zeros_like_tree(list(stacked_leaves)),  # block grads
-        zeros_like_tree(list(tail_leaves)),     # tail grads
-        jnp.zeros_like(h0),                   # d_h0 accumulator
-    )
-    V = v * int(p)
+    carry0 = sc.base_carry(sched)
 
     tables = tuple(jnp.asarray(sched[k]) for k in
                    ("F_mb", "F_ch", "B_mb", "B_ch",
@@ -854,46 +931,15 @@ def pipeline_interleaved(h0, labels, consts, stacked_leaves, tail_leaves, *,
             row[rank] for row in xs]
 
         # ---- store ring arrivals -----------------------------------------
-        def store(buf, val, slot, valid):
-            si = jnp.clip(slot, 0, buf.shape[0] - 1)
-            return buf.at[si].set(jnp.where(valid, val, buf[si]))
-
-        in_buf = store(in_buf, x_recv, rsf_slot, rsf_slot >= 0)
-        dy_buf = store(dy_buf, dy_recv, rsb_slot, rsb_slot >= 0)
+        in_buf = sc.store(in_buf, x_recv, rsf_slot, rsf_slot >= 0)
+        dy_buf = sc.store(dy_buf, dy_recv, rsb_slot, rsb_slot >= 0)
 
         # ---- forward micro-step ------------------------------------------
-        fwd_valid = f_mb >= 0
-        fi = jnp.clip(f_mb, 0, m - 1)
-        fj = jnp.clip(f_ch, 0, v - 1)
-        s_virt = fj * p + rank
-        fresh = lax.dynamic_index_in_dim(h0, fi, 0, keepdims=False)
-        from_buf = in_buf[jnp.clip(f_in_slot, 0, in_buf.shape[0] - 1)]
-        x_in = jnp.where(s_virt == 0, fresh, from_buf)
-        y = stage_fn(x_in, chunk_slices(list(stacked_leaves), fj))
-        stash = store(stash, x_in, f_stash_slot, fwd_valid)
+        y, stash, dy_buf, loss_acc, tail_g = sc.forward_micro(
+            (f_mb, f_ch, f_in_slot, f_stash_slot, f_dy_slot),
+            in_buf, dy_buf, stash, loss_acc, tail_g)
 
-        # last virtual stage: loss + dL/dy, fed straight into dy_buf
-        lab = lax.dynamic_index_in_dim(labels, fi, 0, keepdims=False)
-
-        def tail_branch(y_, tleaves):
-            loss_f, tl_vjp = jax.vjp(lambda yy, tl: tail_fn(yy, tl, lab),
-                                     y_, tleaves)
-            dh, dtail = tl_vjp(jnp.float32(1.0 / m))
-            return loss_f, dh, dtail
-
-        def tail_skip(y_, tleaves):
-            return (jnp.float32(0.0), jnp.zeros_like(y_),
-                    tuple(jnp.zeros_like(t_) for t_ in tleaves))
-
-        is_last_virt = fwd_valid & (s_virt == V - 1)
-        loss_f, dh_f, dtail_f = lax.cond(
-            is_last_virt, tail_branch, tail_skip, y, tuple(tail_leaves))
-        loss_acc = loss_acc + loss_f / m
-        tail_g = [tg + dt for tg, dt in zip(tail_g, dtail_f)]
-        dy_buf = store(dy_buf, dh_f.astype(h0.dtype), f_dy_slot,
-                       is_last_virt)
-
-        # ---- backward micro-step -----------------------------------------
+        # ---- backward micro-step (fused dx + dW) -------------------------
         bwd_valid = b_mb >= 0
         bi = jnp.clip(b_mb, 0, m - 1)
         bj = jnp.clip(b_ch, 0, v - 1)
@@ -901,7 +947,7 @@ def pipeline_interleaved(h0, labels, consts, stacked_leaves, tail_leaves, *,
         x_b = stash[jnp.clip(b_stash_slot, 0, stash.shape[0] - 1)]
         dy_in = dy_buf[jnp.clip(b_dy_slot, 0, dy_buf.shape[0] - 1)]
         _, st_vjp = jax.vjp(
-            lambda xx, lv: stage_fn(xx, chunk_slices(lv, bj)),
+            lambda xx, lv: sc.stage_fn(xx, sc.chunk_slices(lv, bj)),
             x_b, list(stacked_leaves))
         dx_b, dleaves_b = st_vjp(dy_in)
         blk_g = [bg + jnp.where(bwd_valid, dl, jnp.zeros_like(dl))
@@ -910,19 +956,14 @@ def pipeline_interleaved(h0, labels, consts, stacked_leaves, tail_leaves, *,
         dh0_acc = lax.dynamic_update_index_in_dim(
             dh0_acc, jnp.where(bwd_valid & (sb_virt == 0), dx_b, cur), bi, 0)
 
-        # ---- ring exchanges ----------------------------------------------
-        x_next = lax.ppermute(y, axis_name, rotate_perm(p))
-        dy_next = lax.ppermute(dx_b, axis_name,
-                               [(jj, (jj - 1) % p) for jj in range(p)])
+        x_next, dy_next = sc.ring_exchange(y, dx_b)
         return (x_next, dy_next, in_buf, dy_buf, stash, loss_acc, blk_g,
                 tail_g, dh0_acc), None
 
     (x_l, dy_l, in_buf, dy_buf, stash, loss_acc, blk_g, tail_g,
      dh0_acc), _ = lax.scan(tick, carry0, tables)
 
-    loss = lax.psum(loss_acc, axis_name)
-    d_h0 = lax.psum(dh0_acc, axis_name)
-    tail_g = [lax.psum(g, axis_name) for g in tail_g]
+    loss, d_h0, tail_g = sc.finalize(loss_acc, dh0_acc, tail_g)
     return loss, d_h0, blk_g, tail_g
 
 
@@ -939,43 +980,17 @@ def pipeline_zb_vpp(h0, labels, consts, stacked_leaves, tail_leaves, *,
     pipeline_zero_bubble.py:151 ZB-VPP). Numerics identical to
     pipeline_interleaved: the same per-unit dW accumulates, one lane later.
     """
-    p = lax.axis_size(axis_name)
-    rank = lax.axis_index(axis_name)
     m, v = n_micro, vpp_chunks
+    sc = _IlvScaffold(h0, labels, consts, stacked_leaves, tail_leaves,
+                      block_apply_flat, tail_apply_flat, axis_name, m, v,
+                      remat)
+    p, rank = sc.p, sc.rank
     sched = _zb_vpp_schedule(int(p), v, m)
-    lc = stacked_leaves[0].shape[0] // v
-
-    def chunk_slices(leaves, j):
-        return [lax.dynamic_slice_in_dim(l, j * lc, lc, axis=0)
-                for l in leaves]
-
-    def stage_fn(x, leaves):
-        def body(h, leaf_slices):
-            return block_apply_flat(leaf_slices, h, *consts), None
-        step = jax.checkpoint(body) if remat else body
-        y, _ = lax.scan(step, x, leaves)
-        return y
-
-    def tail_fn(y, tleaves, label):
-        return tail_apply_flat(list(tleaves), y, label)
-
-    x0 = jnp.zeros_like(h0[0])
-    zeros_like_tree = lambda tr: jax.tree.map(jnp.zeros_like, tr)
     unit = h0.shape[1:]
-    carry0 = (
-        x0,                                   # x_recv
-        x0,                                   # dy_recv
-        jnp.zeros((sched["S_in"],) + unit, h0.dtype),     # in_buf[slot]
-        jnp.zeros((sched["S_dy"],) + unit, h0.dtype),     # dy_buf[slot]
-        jnp.zeros((sched["S_stash"],) + unit, h0.dtype),  # stash[slot]
+    carry0 = sc.base_carry(sched) + (
         jnp.zeros((sched["S_w"],) + unit, h0.dtype),      # W lane: x
         jnp.zeros((sched["S_w"],) + unit, h0.dtype),      # W lane: dy
-        jnp.float32(0.0),                     # loss accumulator
-        zeros_like_tree(list(stacked_leaves)),  # block grads
-        zeros_like_tree(list(tail_leaves)),     # tail grads
-        jnp.zeros_like(h0),                   # d_h0 accumulator
     )
-    V = v * int(p)
 
     tables = tuple(jnp.asarray(sched[k]) for k in
                    ("F_mb", "F_ch", "B_mb", "B_ch",
@@ -984,49 +999,19 @@ def pipeline_zb_vpp(h0, labels, consts, stacked_leaves, tail_leaves, *,
                     "W_mb", "W_ch", "W_store_slot", "W_read_slot"))
 
     def tick(carry, xs):
-        (x_recv, dy_recv, in_buf, dy_buf, stash, wx_buf, wdy_buf, loss_acc,
-         blk_g, tail_g, dh0_acc) = carry
+        (x_recv, dy_recv, in_buf, dy_buf, stash, loss_acc, blk_g, tail_g,
+         dh0_acc, wx_buf, wdy_buf) = carry
         (f_mb, f_ch, b_mb, b_ch, f_in_slot, f_stash_slot, f_dy_slot,
          b_stash_slot, b_dy_slot, rsf_slot, rsb_slot,
          w_mb, w_ch, w_store, w_read) = [row[rank] for row in xs]
 
-        def store(buf, val, slot, valid):
-            si = jnp.clip(slot, 0, buf.shape[0] - 1)
-            return buf.at[si].set(jnp.where(valid, val, buf[si]))
-
-        in_buf = store(in_buf, x_recv, rsf_slot, rsf_slot >= 0)
-        dy_buf = store(dy_buf, dy_recv, rsb_slot, rsb_slot >= 0)
+        in_buf = sc.store(in_buf, x_recv, rsf_slot, rsf_slot >= 0)
+        dy_buf = sc.store(dy_buf, dy_recv, rsb_slot, rsb_slot >= 0)
 
         # ---- forward micro-step (identical to pipeline_interleaved) ------
-        fwd_valid = f_mb >= 0
-        fi = jnp.clip(f_mb, 0, m - 1)
-        fj = jnp.clip(f_ch, 0, v - 1)
-        s_virt = fj * p + rank
-        fresh = lax.dynamic_index_in_dim(h0, fi, 0, keepdims=False)
-        from_buf = in_buf[jnp.clip(f_in_slot, 0, in_buf.shape[0] - 1)]
-        x_in = jnp.where(s_virt == 0, fresh, from_buf)
-        y = stage_fn(x_in, chunk_slices(list(stacked_leaves), fj))
-        stash = store(stash, x_in, f_stash_slot, fwd_valid)
-
-        lab = lax.dynamic_index_in_dim(labels, fi, 0, keepdims=False)
-
-        def tail_branch(y_, tleaves):
-            loss_f, tl_vjp = jax.vjp(lambda yy, tl: tail_fn(yy, tl, lab),
-                                     y_, tleaves)
-            dh, dtail = tl_vjp(jnp.float32(1.0 / m))
-            return loss_f, dh, dtail
-
-        def tail_skip(y_, tleaves):
-            return (jnp.float32(0.0), jnp.zeros_like(y_),
-                    tuple(jnp.zeros_like(t_) for t_ in tleaves))
-
-        is_last_virt = fwd_valid & (s_virt == V - 1)
-        loss_f, dh_f, dtail_f = lax.cond(
-            is_last_virt, tail_branch, tail_skip, y, tuple(tail_leaves))
-        loss_acc = loss_acc + loss_f / m
-        tail_g = [tg + dt for tg, dt in zip(tail_g, dtail_f)]
-        dy_buf = store(dy_buf, dh_f.astype(h0.dtype), f_dy_slot,
-                       is_last_virt)
+        y, stash, dy_buf, loss_acc, tail_g = sc.forward_micro(
+            (f_mb, f_ch, f_in_slot, f_stash_slot, f_dy_slot),
+            in_buf, dy_buf, stash, loss_acc, tail_g)
 
         # ---- B lane: dx ONLY ---------------------------------------------
         bwd_valid = b_mb >= 0
@@ -1036,7 +1021,8 @@ def pipeline_zb_vpp(h0, labels, consts, stacked_leaves, tail_leaves, *,
         x_b = stash[jnp.clip(b_stash_slot, 0, stash.shape[0] - 1)]
         dy_in = dy_buf[jnp.clip(b_dy_slot, 0, dy_buf.shape[0] - 1)]
         _, dx_vjp = jax.vjp(
-            lambda xx: stage_fn(xx, chunk_slices(list(stacked_leaves), bj)),
+            lambda xx: sc.stage_fn(xx,
+                                   sc.chunk_slices(list(stacked_leaves), bj)),
             x_b)
         (dx_b,) = dx_vjp(dy_in)
         cur = lax.dynamic_index_in_dim(dh0_acc, bi, 0, keepdims=False)
@@ -1044,8 +1030,9 @@ def pipeline_zb_vpp(h0, labels, consts, stacked_leaves, tail_leaves, *,
             dh0_acc, jnp.where(bwd_valid & (sb_virt == 0), dx_b, cur), bi, 0)
         # stash (x, dy) for the deferred W lane (same-tick W reads after
         # this store, like pipeline_zb)
-        wx_buf = store(wx_buf, x_b, w_store, bwd_valid & (w_store >= 0))
-        wdy_buf = store(wdy_buf, dy_in, w_store, bwd_valid & (w_store >= 0))
+        wx_buf = sc.store(wx_buf, x_b, w_store, bwd_valid & (w_store >= 0))
+        wdy_buf = sc.store(wdy_buf, dy_in, w_store,
+                           bwd_valid & (w_store >= 0))
 
         # ---- W lane: dW for a (possibly earlier) unit --------------------
         w_valid = w_mb >= 0
@@ -1053,24 +1040,20 @@ def pipeline_zb_vpp(h0, labels, consts, stacked_leaves, tail_leaves, *,
         wr = jnp.clip(w_read, 0, wx_buf.shape[0] - 1)
         x_w, dy_w = wx_buf[wr], wdy_buf[wr]
         _, dw_vjp = jax.vjp(
-            lambda lv: stage_fn(x_w, chunk_slices(lv, wj)),
+            lambda lv: sc.stage_fn(x_w, sc.chunk_slices(lv, wj)),
             list(stacked_leaves))
         (dleaves_w,) = dw_vjp(dy_w)
         blk_g = [bg + jnp.where(w_valid, dl, jnp.zeros_like(dl))
                  for bg, dl in zip(blk_g, dleaves_w)]
 
-        x_next = lax.ppermute(y, axis_name, rotate_perm(p))
-        dy_next = lax.ppermute(dx_b, axis_name,
-                               [(jj, (jj - 1) % p) for jj in range(p)])
-        return (x_next, dy_next, in_buf, dy_buf, stash, wx_buf, wdy_buf,
-                loss_acc, blk_g, tail_g, dh0_acc), None
+        x_next, dy_next = sc.ring_exchange(y, dx_b)
+        return (x_next, dy_next, in_buf, dy_buf, stash, loss_acc, blk_g,
+                tail_g, dh0_acc, wx_buf, wdy_buf), None
 
-    (x_l, dy_l, in_buf, dy_buf, stash, wx_buf, wdy_buf, loss_acc, blk_g,
-     tail_g, dh0_acc), _ = lax.scan(tick, carry0, tables)
+    (x_l, dy_l, in_buf, dy_buf, stash, loss_acc, blk_g, tail_g, dh0_acc,
+     wx_buf, wdy_buf), _ = lax.scan(tick, carry0, tables)
 
-    loss = lax.psum(loss_acc, axis_name)
-    d_h0 = lax.psum(dh0_acc, axis_name)
-    tail_g = [lax.psum(g, axis_name) for g in tail_g]
+    loss, d_h0, tail_g = sc.finalize(loss_acc, dh0_acc, tail_g)
     return loss, d_h0, blk_g, tail_g
 
 
@@ -1122,7 +1105,7 @@ class PipelinedTrainer(SpmdTrainer):
                     f"{len(blocks)} blocks not divisible by "
                     f"vpp_chunks*pp={v}*{p}")
             self._vpp_reorder()
-        if schedule in ("1f1b", "interleave", "zb_vpp"):
+        if schedule in ("1f1b", "interleave", "zb", "zb_vpp"):
             for meth in ("pp_embed", "pp_tail", "pp_embed_param_names",
                          "pp_tail_param_names"):
                 if not hasattr(model, meth):

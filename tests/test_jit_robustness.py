@@ -143,3 +143,106 @@ def test_bucket_batch_skips_buffer_writeback_when_padded():
     assert any("buffer updates" in str(x.message) for x in w)
     static(_t(np.random.randn(4, 4)))  # exact bucket: stats update normally
     assert np.abs(np.asarray(m.bn._mean.numpy()) - before).sum() > 0
+
+
+def test_graph_break_partial_keeps_sublayers_compiled():
+    """A data-dependent branch in the TOP-LEVEL forward must not forfeit
+    the sublayers' compilation: the breaking signature re-runs with the
+    glue eager and each child as its own compiled StaticFunction
+    (function-level analog of SOT's subgraph stitching,
+    opcode_executor.py:353)."""
+    def build(seed):
+        paddle.seed(seed)
+
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.a = nn.Linear(4, 8)
+                self.b = nn.Linear(8, 4)
+
+            def forward(self, x):
+                h = self.a(x)
+                if float(h.sum().numpy()) > 0:    # graph break
+                    h = h * 2
+                else:
+                    h = h - 1
+                return self.b(h).sum()
+
+        return M()
+
+    m = paddle.jit.to_static(build(7))
+    sf = m.forward          # the StaticFunction (to_static returns the Layer)
+    x_pos = _t(np.ones((2, 4)))
+    x_neg = _t(-np.ones((2, 4)) * 5)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        loss_pos = m(x_pos)
+        loss_pos.backward()
+        loss_neg = m(x_neg)
+
+    # eager oracle with identical weights
+    ref = build(7)
+    h = ref.a(x_pos)
+    ref_pos = ref.b(h * 2 if float(h.sum().numpy()) > 0 else h - 1).sum()
+    ref_pos.backward()
+    np.testing.assert_allclose(loss_pos.numpy(), ref_pos.numpy(), rtol=1e-5)
+    h2 = ref.a(x_neg)
+    ref_neg = ref.b(h2 * 2 if float(h2.sum().numpy()) > 0 else h2 - 1).sum()
+    np.testing.assert_allclose(loss_neg.numpy(), ref_neg.numpy(), rtol=1e-5)
+    # gradients flow through the compiled children
+    for name in ("a", "b"):
+        g = getattr(m, name).weight.grad
+        r = getattr(ref, name).weight.grad
+        assert g is not None
+        np.testing.assert_allclose(np.asarray(g.numpy()),
+                                   np.asarray(r.numpy()), rtol=1e-5,
+                                   atol=1e-6)
+
+    # the children really are compiled (one trace each, reused thereafter)
+    assert sf.stats["partial_calls"] >= 2, sf.stats
+    assert sf._child_static["a"]._trace_count == 1
+    assert sf._child_static["b"]._trace_count == 1
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m(x_pos)
+    assert sf._child_static["a"]._trace_count == 1  # cache hit, no retrace
+    # after the partial call the children run through their ORIGINAL
+    # forwards again (patch removed)
+    assert "forward" not in m.a.__dict__
+
+
+def test_stats_surface_counts_modes():
+    class Clean(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 2)
+
+        def forward(self, x):
+            return self.fc(x).sum()
+
+    m = paddle.jit.to_static(Clean())
+    sf = m.forward
+    x = _t(np.ones((2, 4)))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m(x)
+        m(x)
+    assert sf.stats["compiled_calls"] == 2
+    assert sf.stats["partial_calls"] == 0
+    assert sf.stats["eager_calls"] == 0
+
+
+def test_fallback_cache_is_bounded():
+    @paddle.jit.to_static
+    def f(x):
+        if float(x.sum().numpy()) > 0:
+            return x * 2
+        return x
+
+    sf = f
+    sf._fallback_cap = 8
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for n in range(1, 22):          # each shape = distinct signature
+            f(_t(np.ones(n)))
+    assert len(sf._fallback_keys) <= 8
