@@ -236,6 +236,26 @@ def _run_probe(extend=None):
                 "rms_us": round(dt_rms * 1e6, 1),
                 "rms_xla_us": round(dt_rms_xla * 1e6, 1)}
 
+    def flashmask_probe():
+        # document-masked causal attention: the block-skip win should show
+        # as sub-linear time vs the dense-causal flash kernel when the mask
+        # kills most off-diagonal tiles (doc_len 256 of s=2048)
+        from paddle_tpu.kernels.flash_pallas import flashmask_attention
+        doc = 256
+        j = jnp.arange(s)
+        lts = ((j // doc + 1) * doc).astype(jnp.int32)
+        bounds = jnp.broadcast_to(
+            jnp.stack([lts, jnp.full((s,), s, jnp.int32),
+                       jnp.zeros((s,), jnp.int32),
+                       jnp.zeros((s,), jnp.int32)], -1)[None, None],
+            (b, h, s, 4))
+        f = jax.jit(lambda q, k, v: flashmask_attention(q, k, v, bounds,
+                                                        True))
+        dt = timeit(lambda: f(*qkv))
+        visible_frac = doc / (2.0 * s)  # per-column visible rows / s, causal
+        return {"us": round(dt * 1e6, 1), "doc_len": doc,
+                "visible_frac": round(visible_frac, 4)}
+
     def mem_probe():
         try:
             stats = dev.memory_stats() or {}
@@ -247,6 +267,7 @@ def _run_probe(extend=None):
     step("matmul", mm_probe)
     step("flash_fwd", flash_fwd_probe)
     step("flash_bwd", flash_bwd_probe)
+    step("flashmask", flashmask_probe)
     step("xla_attn", xla_attn_probe)
     step("fused", fused_probe)
     step("mem", mem_probe)

@@ -119,9 +119,12 @@ class StaticFunction:
         self._param_names: List[str] = []
         self._buffer_names: List[str] = []
         self._bucket_batch = bucket_batch
-        self._fallback_keys: Dict = {}   # signature -> "partial" | "eager"
+        # insertion-ordered set of breaking signatures (dict for FIFO
+        # eviction); the partial-vs-eager decision is made per call in
+        # _call_fallback, not cached here
+        self._fallback_keys: Dict = {}
         self._fallback_cap = 512
-        self._child_static: Optional[Dict[str, "StaticFunction"]] = None
+        self._child_static: Optional[List] = None   # [(layer, StaticFunction)]
         self._warned_break = False
         self._trace_count = 0  # diagnostics: number of fresh traces
         self.stats = {"compiled_calls": 0, "partial_calls": 0,
@@ -179,21 +182,35 @@ class StaticFunction:
         return self._function(*args, **kwargs)
 
     def _build_child_static(self):
-        """Per-child StaticFunctions for the partial path. A child that
-        already carries its own instance-level forward (e.g. the user ran
-        to_static on the sublayer too) is left alone — it is already
-        compiled and must not be wrapped or clobbered."""
+        """Compile units for the partial path. A child that already carries
+        its own instance-level forward (e.g. the user ran to_static on the
+        sublayer too) is left alone — it is already compiled and must not
+        be wrapped or clobbered. Pure containers (LayerList: no forward of
+        their own, iterated by the parent) are descended into, so a
+        transformer stack's blocks each become a compile unit rather than
+        the container being wrapped uselessly."""
         if self._child_static is None:
-            self._child_static = {
-                name: StaticFunction(child.forward, layer=child)
-                for name, child in self._layer.named_children()
-                if "forward" not in child.__dict__}
+            targets: List = []
+
+            def collect(layer):
+                for _, child in layer.named_children():
+                    if "forward" in child.__dict__:
+                        continue   # user-compiled already
+                    if type(child).forward is Layer.forward:
+                        collect(child)   # pure container: recurse
+                    else:
+                        targets.append(child)
+
+            collect(self._layer)
+            self._child_static = [
+                (child, StaticFunction(child.forward, layer=child))
+                for child in targets]
         return self._child_static
 
     def _call_fallback(self, args, kwargs):
         """Partial-graph execution for a breaking signature: the layer's
         own forward runs as eager Python (so the data-dependent branch just
-        executes), but every direct sublayer is swapped for its own
+        executes), but every compile-unit sublayer is swapped for its own
         compiled StaticFunction for the duration of the call."""
         layer = self._layer
         if layer is None or not self._build_child_static():
@@ -203,9 +220,8 @@ class StaticFunction:
         self.stats["partial_calls"] += 1
         patched = []
         try:
-            for name, child in layer.named_children():
-                sf = self._child_static.get(name)
-                if sf is not None and "forward" not in child.__dict__:
+            for child, sf in self._child_static:
+                if "forward" not in child.__dict__:
                     child.__dict__["forward"] = sf
                     patched.append(child)
             return self._function(*args, **kwargs)
@@ -214,9 +230,10 @@ class StaticFunction:
                 child.__dict__.pop("forward", None)
 
     def _graph_break(self, static_key, err):
-        if len(self._fallback_keys) >= self._fallback_cap:
-            self._fallback_keys.clear()   # bounded: worst case re-warms
-        self._fallback_keys[static_key] = "partial"
+        while len(self._fallback_keys) >= self._fallback_cap:
+            # FIFO: evict the oldest signature only, not the whole cache
+            self._fallback_keys.pop(next(iter(self._fallback_keys)))
+        self._fallback_keys[static_key] = True
         if not self._warned_break:
             self._warned_break = True
             import warnings

@@ -10,6 +10,8 @@ import sys
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+if not os.environ.get("PADDLE_TPU_TEST_FULL_OPT"):
+    jax.config.update("jax_disable_most_optimizations", True)
 
 import numpy as np  # noqa: E402
 

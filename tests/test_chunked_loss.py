@@ -1,4 +1,5 @@
 """forward_loss chunked CE == plain compute_loss (values AND gradients)."""
+import pytest
 import numpy as np
 
 import paddle_tpu as paddle
@@ -18,6 +19,7 @@ def _setup(tied=False):
     return cfg, model, ids
 
 
+@pytest.mark.slow
 def test_chunked_matches_plain_value_and_grad():
     cfg, model, ids = _setup()
     plain = model.forward_loss(ids, ids)
@@ -37,6 +39,7 @@ def test_chunked_matches_plain_value_and_grad():
                                    rtol=2e-4, atol=1e-6, err_msg=n)
 
 
+@pytest.mark.slow
 def test_chunked_tied_embeddings():
     cfg, model, ids = _setup(tied=True)
     plain = float(model.forward_loss(ids, ids).numpy())

@@ -180,6 +180,7 @@ def test_mvn_kl_vs_torch():
     np.testing.assert_allclose(ours, theirs, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_lkj_cholesky_log_prob_vs_torch_and_sample_validity():
     import torch
     from torch.distributions import LKJCholesky as TLKJ
@@ -201,6 +202,7 @@ def test_lkj_cholesky_log_prob_vs_torch_and_sample_validity():
         assert np.isfinite(_np(d.log_prob(L.astype(np.float32)))).all()
 
 
+@pytest.mark.slow
 def test_lkj_onion_matches_torch_marginals():
     """Correlation marginal of onion samples matches torch's (loose moment
     check: E[rho^2] over many draws)."""

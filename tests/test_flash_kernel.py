@@ -71,6 +71,7 @@ def test_multi_block_kv_accumulation():
                                rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_causal_kv_longer_than_q():
     """Bottom-right-aligned causal mask (kv-cache decode): query i attends
     keys up to i + (sk - sq), matching the XLA reference convention."""

@@ -200,15 +200,51 @@ def test_graph_break_partial_keeps_sublayers_compiled():
 
     # the children really are compiled (one trace each, reused thereafter)
     assert sf.stats["partial_calls"] >= 2, sf.stats
-    assert sf._child_static["a"]._trace_count == 1
-    assert sf._child_static["b"]._trace_count == 1
+    traces = {id(c): s._trace_count for c, s in sf._child_static}
+    assert traces == {id(m.a): 1, id(m.b): 1}, traces
     with warnings.catch_warnings():
         warnings.simplefilter("ignore")
         m(x_pos)
-    assert sf._child_static["a"]._trace_count == 1  # cache hit, no retrace
+    assert dict((id(c), s._trace_count) for c, s in sf._child_static) \
+        == traces  # cache hit, no retrace
     # after the partial call the children run through their ORIGINAL
     # forwards again (patch removed)
     assert "forward" not in m.a.__dict__
+
+
+def test_graph_break_partial_descends_into_layerlist():
+    """Container layers (LayerList: no forward of their own) must not be
+    wrapped as a unit — their sublayers are the compile units, so a
+    transformer-style stack stays compiled around a top-level break."""
+    paddle.seed(11)
+
+    class Stack(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.blocks = nn.LayerList([nn.Linear(4, 4) for _ in range(3)])
+
+        def forward(self, x):
+            for blk in self.blocks:
+                x = blk(x)
+            if float(x.sum().numpy()) > 1e9:   # never taken, still breaks
+                return x * 0
+            return x.sum()
+
+    m = paddle.jit.to_static(Stack())
+    sf = m.forward
+    x = _t(np.ones((2, 4)))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        loss = m(x)
+        loss.backward()
+        m(x)
+    assert sf.stats["partial_calls"] == 2
+    # the three Linear blocks (grandchildren through the container) are the
+    # compile units: one trace each
+    assert len(sf._child_static) == 3
+    assert all(s._trace_count == 1 for _, s in sf._child_static)
+    g = m.blocks[0].weight.grad
+    assert g is not None and np.abs(np.asarray(g.numpy())).sum() > 0
 
 
 def test_stats_surface_counts_modes():

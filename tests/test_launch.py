@@ -21,6 +21,7 @@ if rank == 1 and gen == 0:
 """
 
 
+@pytest.mark.slow
 def test_launch_restarts_failed_generation():
     with tempfile.TemporaryDirectory() as td:
         script = os.path.join(td, "train.py")
@@ -78,6 +79,7 @@ def test_launch_rejects_multiproc_on_tpu_host():
     assert "ONE worker process" in r.stderr.decode()
 
 
+@pytest.mark.slow
 def test_launch_ps_mode_spawns_server_and_trainers():
     """The CLI analog of test_ps.py: --run_mode ps assigns PS_ROLE and the
     rpc endpoint; the same worker script converges (reference --server_num

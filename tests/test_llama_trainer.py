@@ -25,6 +25,7 @@ def _loss_fn(m, input_ids, labels):
     return m.compute_loss(m(input_ids), labels)
 
 
+@pytest.mark.slow
 def test_llama_forward_shapes():
     cfg = _tiny_cfg()
     model = LlamaForCausalLM(cfg)
@@ -116,6 +117,7 @@ def test_parallel_equals_serial():
     np.testing.assert_allclose(parallel_losses, serial_losses, rtol=2e-3)
 
 
+@pytest.mark.slow
 def test_remat_matches_no_remat():
     cfg = _tiny_cfg()
     paddle.seed(5)
@@ -145,6 +147,7 @@ def test_trainer_optimizer_state_bridge():
     assert sd["accumulators"]  # moments exposed in eager format
 
 
+@pytest.mark.slow
 def test_gqa_heads():
     cfg = LlamaConfig.tiny(vocab_size=64, hidden_size=32, layers=1, heads=4,
                            kv_heads=1, seq=16)
@@ -156,6 +159,7 @@ def test_gqa_heads():
     assert model.model.layers[0].self_attn.k_proj.weight.grad is not None
 
 
+@pytest.mark.slow
 def test_remat_policy_dots_matches_full():
     """remat_policy='dots' (keep MXU outputs) must not change numerics."""
     import numpy as np

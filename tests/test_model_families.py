@@ -39,6 +39,7 @@ class TestErnie:
             last = v
         assert last < first
 
+    @pytest.mark.slow
     def test_sequence_classification(self):
         from paddle_tpu.models.ernie import (ErnieConfig,
                                              ErnieForSequenceClassification)

@@ -56,6 +56,7 @@ class TestMoeOps:
 
 
 class TestMoELayer:
+    @pytest.mark.slow
     def test_forward_backward_batched(self):
         m = MoELayer(d_model=16, d_hidden=32, num_expert=4, top_k=2,
                      gate="gshard")
@@ -80,6 +81,7 @@ class TestMoELayer:
         np.testing.assert_allclose(np.asarray(y._data), np.asarray(ref),
                                    atol=1e-5)
 
+    @pytest.mark.slow
     def test_expert_list_mode(self):
         class Expert(nn.Layer):
             def __init__(self):
@@ -164,6 +166,7 @@ class TestFusedMoe:
 
 
 class TestGPTMoE:
+    @pytest.mark.slow
     def test_dense_gpt_trains(self):
         from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
         cfg = GPTConfig.tiny(vocab_size=64, hidden_size=32, layers=2, heads=2,
@@ -177,6 +180,7 @@ class TestGPTMoE:
         loss.backward()
         assert np.isfinite(float(loss.item()))
 
+    @pytest.mark.slow
     def test_moe_gpt_aux_loss(self):
         from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
         cfg = GPTConfig.tiny(vocab_size=64, hidden_size=32, layers=2, heads=2,

@@ -40,16 +40,22 @@ def _train(trainer, cfg, steps=3):
     return losses
 
 
-def test_pipeline_matches_serial():
+@pytest.fixture(scope="module")
+def serial_ref3():
+    """The serial 3-step oracle every schedule is compared against —
+    computed ONCE per module (same _make() config and batches), not once
+    per schedule test."""
     cfg, model, optim = _make()
     serial = SpmdTrainer(model, optim, _loss_fn, mesh=None)
-    ref = _train(serial, cfg)
+    return _train(serial, cfg)
 
+
+def test_pipeline_matches_serial(serial_ref3):
     cfg2, model2, optim2 = _make()
     mesh = make_hybrid_mesh(dp=1, pp=4)
     pipe = PipelinedTrainer(model2, optim2, _loss_fn, mesh=mesh, n_micro=4)
     got = _train(pipe, cfg2)
-    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(got, serial_ref3, rtol=2e-4, atol=2e-5)
 
 
 @pytest.mark.slow
@@ -79,6 +85,7 @@ def test_pipeline_hybrid_pp_mp_dp():
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_pipeline_sync_model_roundtrip():
     cfg, model, optim = _make()
     mesh = make_hybrid_mesh(pp=2)
@@ -134,20 +141,18 @@ def test_pipeline_optimizer_state_roundtrip():
     assert np.abs(m1).sum() > 0
 
 
-def test_pipeline_1f1b_matches_serial():
+def test_pipeline_1f1b_matches_serial(serial_ref3):
     """1F1B manual schedule (loss inside the region, bounded stash)."""
-    cfg, model, optim = _make()
-    serial = SpmdTrainer(model, optim, _loss_fn, mesh=None)
-    ref = _train(serial, cfg)
 
     cfg2, model2, optim2 = _make()
     mesh = make_hybrid_mesh(dp=1, pp=4)
     pipe = PipelinedTrainer(model2, optim2, _loss_fn, mesh=mesh, n_micro=4,
                             schedule="1f1b")
     got = _train(pipe, cfg2)
-    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(got, serial_ref3, rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_pipeline_1f1b_hybrid_pp_mp():
     cfg, model, optim = _make()
     serial = SpmdTrainer(model, optim, _loss_fn, mesh=None)
@@ -161,18 +166,16 @@ def test_pipeline_1f1b_hybrid_pp_mp():
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
 
 
-def test_pipeline_vpp_matches_serial():
+@pytest.mark.slow
+def test_pipeline_vpp_matches_serial(serial_ref3):
     """Interleaved VPP: each stage owns vpp_chunks non-contiguous chunks."""
-    cfg, model, optim = _make()
-    serial = SpmdTrainer(model, optim, _loss_fn, mesh=None)
-    ref = _train(serial, cfg)
 
     cfg2, model2, optim2 = _make()
     mesh = make_hybrid_mesh(dp=1, pp=2)
     pipe = PipelinedTrainer(model2, optim2, _loss_fn, mesh=mesh, n_micro=2,
                             schedule="vpp", vpp_chunks=2)
     got = _train(pipe, cfg2)
-    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(got, serial_ref3, rtol=2e-4, atol=2e-5)
 
 
 @pytest.mark.slow
@@ -207,19 +210,16 @@ def test_pipeline_rejects_bad_split():
         PipelinedTrainer(model, optim, _loss_fn, mesh=mesh, n_micro=2)
 
 
-def test_pipeline_interleave_matches_serial():
+def test_pipeline_interleave_matches_serial(serial_ref3):
     """True interleaved-VPP 1F1B: host-simulated lockstep schedule, one fwd +
     one bwd micro-step per tick, chunks selected per tick."""
-    cfg, model, optim = _make()
-    serial = SpmdTrainer(model, optim, _loss_fn, mesh=None)
-    ref = _train(serial, cfg)
 
     cfg2, model2, optim2 = _make()
     mesh = make_hybrid_mesh(dp=1, pp=2)
     pipe = PipelinedTrainer(model2, optim2, _loss_fn, mesh=mesh, n_micro=4,
                             schedule="interleave", vpp_chunks=2)
     got = _train(pipe, cfg2)
-    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(got, serial_ref3, rtol=2e-4, atol=2e-5)
 
 
 def test_interleaved_schedule_beats_sequential_phases():
@@ -284,20 +284,18 @@ def test_interleave_slot_reuse_matches_high_water_mark():
                     assert s["B_dy_slot"][t, r] >= 0
 
 
-def test_pipeline_zb_matches_serial():
+@pytest.mark.slow
+def test_pipeline_zb_matches_serial(serial_ref3):
     """ZB-H1: backward split into a dx lane (1F1B timing) and a deferred
     weight-gradient lane; numerics must match serial training exactly like
     the other schedules."""
-    cfg, model, optim = _make()
-    serial = SpmdTrainer(model, optim, _loss_fn, mesh=None)
-    ref = _train(serial, cfg)
 
     cfg2, model2, optim2 = _make()
     mesh = make_hybrid_mesh(dp=1, pp=2)
     pipe = PipelinedTrainer(model2, optim2, _loss_fn, mesh=mesh, n_micro=4,
                             schedule="zb")
     got = _train(pipe, cfg2)
-    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(got, serial_ref3, rtol=2e-4, atol=2e-5)
 
 
 def test_zb_schedule_makespans_and_memory_bound():
@@ -327,19 +325,16 @@ def test_zb_schedule_makespans_and_memory_bound():
                 assert t >= 2 * (p - 1) - r + i  # not before its B tick
 
 
-def test_pipeline_zb_vpp_matches_serial():
+def test_pipeline_zb_vpp_matches_serial(serial_ref3):
     """ZB-VPP: interleaved virtual stages with the zero-bubble dx/dw split
     (reference pipeline_zero_bubble.py:151); numerics must match serial."""
-    cfg, model, optim = _make()
-    serial = SpmdTrainer(model, optim, _loss_fn, mesh=None)
-    ref = _train(serial, cfg)
 
     cfg2, model2, optim2 = _make()
     mesh = make_hybrid_mesh(dp=1, pp=2)
     pipe = PipelinedTrainer(model2, optim2, _loss_fn, mesh=mesh, n_micro=4,
                             schedule="zb_vpp", vpp_chunks=2)
     got = _train(pipe, cfg2)
-    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(got, serial_ref3, rtol=2e-4, atol=2e-5)
 
 
 def test_zb_vpp_schedule_makespan_and_coverage():

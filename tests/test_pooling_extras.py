@@ -53,6 +53,7 @@ def test_max_unpool_roundtrip_matches_torch(nd):
     np.testing.assert_allclose(rec.numpy(), trec.numpy(), rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_max_unpool2d_output_size():
     x = RNG.normal(size=(1, 2, 7, 7)).astype(np.float32)
     out, mask = F.max_pool2d(paddle.to_tensor(x), 2, 2, return_mask=True)
@@ -110,6 +111,7 @@ def test_fractional_max_pool2d_matches_kernel_math(kernel_size):
     np.testing.assert_allclose(out.numpy(), ref, rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_fractional_max_pool3d_with_mask():
     x = RNG.normal(size=(1, 2, 8, 9, 10)).astype(np.float32)
     u = 0.61

@@ -1,6 +1,7 @@
 """QAT/PTQ end-to-end workflow with real int8 conversion (reference:
 quantization/qat.py + ptq.py + weight_quantize capability)."""
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 import paddle_tpu.nn as nn
@@ -13,6 +14,7 @@ def _model(seed=7):
     return nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
 
 
+@pytest.mark.slow
 def test_qat_train_then_convert_int8():
     m = _model()
     qat = QAT(QuantConfig(quant_bits=8))

@@ -39,6 +39,7 @@ def test_lstm_matches_torch(bidirect):
     np.testing.assert_allclose(c.numpy(), ct.numpy(), rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_gru_matches_torch():
     paddle.seed(8)
     ours = nn.GRU(6, 12, num_layers=1)
@@ -64,6 +65,7 @@ def test_simple_rnn_matches_torch():
     np.testing.assert_allclose(y.numpy(), yt.numpy(), rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_lstm_cell_matches_layer_step():
     paddle.seed(10)
     cell = nn.LSTMCell(4, 6)
@@ -90,6 +92,7 @@ def test_rnn_wrapper_and_birnn():
     assert tuple(yb.shape) == (2, 3, 12)
 
 
+@pytest.mark.slow
 def test_lstm_backward_flows():
     paddle.seed(12)
     m = nn.LSTM(4, 8)

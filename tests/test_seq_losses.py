@@ -330,6 +330,7 @@ def test_ctc_norm_by_times_value_unscaled_grad_scaled():
     np.testing.assert_allclose(g1[:, 1], g0[:, 1] / 4.0, rtol=1e-5, atol=1e-7)
 
 
+@pytest.mark.slow
 def test_rnnt_fastemit_value_unchanged_grad_scaled():
     """FastEmit rescales emission gradients by (1+lambda); the loss value is
     the plain NLL for any lambda."""

@@ -54,6 +54,7 @@ def test_sp_matches_serial_mp2(serial_ref):
     np.testing.assert_allclose(got, serial_ref, rtol=3e-4, atol=3e-5)
 
 
+@pytest.mark.slow
 def test_sp_composes_with_ring_attention(serial_ref):
     """SP (mp) + context parallelism (sep) on the same seq dim."""
     cfg, model, optim = _make(sp=True)

@@ -118,6 +118,7 @@ def test_sparse_subm_conv3d_keeps_pattern():
     assert out.to_dense().numpy().shape == (1, 4, 4, 4, 3)
 
 
+@pytest.mark.slow
 def test_sparse_conv2d_and_batchnorm_train():
     import paddle_tpu.sparse.nn as snn
     paddle.seed(1)

@@ -17,13 +17,19 @@ def _x(n=1, c=3, hw=64):
 
 
 @pytest.mark.parametrize("builder,kwargs,hw", [
-    (M.mobilenet_v1, {"scale": 0.25}, 32),
+    # default-tier conv coverage comes from test_lenet_trains (conv
+    # fwd+bwd through the compiled trainer); these eval-only backbone
+    # forwards are compile-heavy duplicates of the same conv lowering
+    # paths -> slow tier
+    pytest.param(M.mobilenet_v1, {"scale": 0.25}, 32,
+                 marks=pytest.mark.slow),
     pytest.param(M.mobilenet_v2, {"scale": 0.25}, 32,
                  marks=pytest.mark.slow),
     pytest.param(M.mobilenet_v3_small, {"scale": 0.5}, 32,
                  marks=pytest.mark.slow),
-    (M.shufflenet_v2_x0_25, {}, 32),
-    (M.squeezenet1_1, {}, 32),
+    pytest.param(M.shufflenet_v2_x0_25, {}, 32,
+                 marks=pytest.mark.slow),
+    pytest.param(M.squeezenet1_1, {}, 32, marks=pytest.mark.slow),
     pytest.param(M.densenet121, {}, 32, marks=pytest.mark.slow),
 ])
 def test_small_backbones_forward(builder, kwargs, hw):

@@ -1,4 +1,5 @@
 """Watchdog (hang + heartbeat), ASP 2:4 sparsity, fused transformer layers."""
+import pytest
 import io
 import time
 
@@ -137,6 +138,7 @@ def test_nan_check_covers_bfloat16():
         paddle.set_flags({"FLAGS_check_nan_inf": False})
 
 
+@pytest.mark.slow
 def test_fused_transformer_layers():
     from paddle_tpu.incubate.nn import (FusedFeedForward,
                                         FusedMultiHeadAttention,

@@ -128,6 +128,7 @@ def test_fleet_pipeline_fallback_loss_type():
     assert np.isfinite(v)
 
 
+@pytest.mark.slow
 def test_fleet_pipeline_schedule_mode_interleave():
     """pipeline_configs.schedule_mode routes fleet train_batch to the
     interleaved-VPP 1F1B trainer."""
