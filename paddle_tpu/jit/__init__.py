@@ -229,11 +229,11 @@ class StaticFunction:
             for child in patched:
                 child.__dict__.pop("forward", None)
 
-    def _graph_break(self, static_key, err):
+    def _graph_break(self, fallback_key, err):
         while len(self._fallback_keys) >= self._fallback_cap:
             # FIFO: evict the oldest signature only, not the whole cache
             self._fallback_keys.pop(next(iter(self._fallback_keys)))
-        self._fallback_keys[static_key] = True
+        self._fallback_keys[fallback_key] = True
         if not self._warned_break:
             self._warned_break = True
             import warnings

@@ -216,6 +216,7 @@ def _sgd_update(p, g, lr_val, wd):
 
 
 class SGD(Optimizer):
+    _update_elementwise = True
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
                  grad_clip=None, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
@@ -233,6 +234,7 @@ def _momentum_update(p, g, vel, lr_val, mu, wd, use_nesterov):
 
 
 class Momentum(Optimizer):
+    _update_elementwise = True
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
                  use_nesterov=False, weight_decay=None, grad_clip=None,
                  name=None):
@@ -266,6 +268,7 @@ def _adam_update(p, g, m, v, lr_val, beta1, beta2, eps, step, wd, decoupled):
 
 
 class Adam(Optimizer):
+    _update_elementwise = True
     _decoupled_wd = False
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
@@ -320,6 +323,7 @@ def _adagrad_update(p, g, mom, lr_val, eps, wd):
 
 
 class Adagrad(Optimizer):
+    _update_elementwise = True
     def __init__(self, learning_rate, epsilon=1e-06, parameters=None,
                  weight_decay=None, grad_clip=None,
                  initial_accumulator_value=0.0, name=None):
@@ -350,6 +354,7 @@ def _adadelta_update(p, g, avg_sq, avg_upd, rho, eps, lr_val, wd):
 
 
 class Adadelta(Optimizer):
+    _update_elementwise = True
     def __init__(self, learning_rate=0.001, epsilon=1e-06, rho=0.95,
                  parameters=None, weight_decay=None, grad_clip=None, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
@@ -380,6 +385,7 @@ def _adamax_update(p, g, m, inf_norm, lr_val, beta1, beta2, eps, step, wd):
 
 
 class Adamax(Optimizer):
+    _update_elementwise = True
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-08, parameters=None, weight_decay=None,
                  grad_clip=None, name=None):
@@ -413,6 +419,7 @@ def _rmsprop_update(p, g, mean_sq, mean_g, mom, lr_val, rho, eps, momentum,
 
 
 class RMSProp(Optimizer):
+    _update_elementwise = True
     def __init__(self, learning_rate, rho=0.95, epsilon=1e-06, momentum=0.0,
                  centered=False, parameters=None, weight_decay=None,
                  grad_clip=None, name=None):
@@ -449,6 +456,10 @@ def _lamb_update(p, g, m, v, lr_val, beta1, beta2, eps, step, wd):
 
 
 class Lamb(Optimizer):
+    # NOTE: _update_elementwise stays False (base default): the trust
+    # ratio needs GLOBAL param/update norms, so ZeRO-3's shard_map update
+    # region must not shard this update
+
     def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
                  beta2=0.999, epsilon=1e-06, parameters=None, grad_clip=None,
                  exclude_from_weight_decay_fn=None, name=None):
@@ -499,6 +510,7 @@ def _nadam_update(p, g, m, v, mu_prod, lr_val, beta1, beta2, eps, psi, step,
 
 
 class NAdam(Optimizer):
+    _update_elementwise = True
     """Parity: paddle.optimizer.NAdam (python/paddle/optimizer/nadam.py)."""
 
     def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999,
@@ -544,6 +556,7 @@ def _radam_update(p, g, m, v, lr_val, beta1, beta2, eps, step, wd):
 
 
 class RAdam(Optimizer):
+    _update_elementwise = True
     """Parity: paddle.optimizer.RAdam (python/paddle/optimizer/radam.py)."""
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
@@ -578,6 +591,7 @@ def _rprop_update(p, g, prev, lrs, lr_min, lr_max, eta_neg, eta_pos):
 
 
 class Rprop(Optimizer):
+    _update_elementwise = True
     """Parity: paddle.optimizer.Rprop (python/paddle/optimizer/rprop.py);
     per-element sign-based step sizes, full-batch training only."""
 
@@ -613,6 +627,7 @@ def _asgd_update(p, g, d, ys, idx, n_eff, lr_val, wd):
 
 
 class ASGD(Optimizer):
+    _update_elementwise = True
     """Parity: paddle.optimizer.ASGD (python/paddle/optimizer/asgd.py) —
     averaged SGD over a sliding window of the last `batch_num` gradients."""
 

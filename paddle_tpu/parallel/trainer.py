@@ -296,15 +296,8 @@ class SpmdTrainer:
     def _wd(self, name: str) -> float:
         return self.opt._wd_coeff(self._params[name])
 
-    def _apply_update(self, params, grads, opt_state, lr, step_i):
-        """Shared step epilogue: grad clip + per-param optimizer update."""
+    def _update_loop(self, params, grads, opt_state, lr, step_i, asp_masks):
         opt = self.opt
-        grads = _clip_grads_functional(opt._grad_clip, params, grads)
-        # ASP: n:m sparsity masks survive compiled updates too (the eager
-        # path reapplies them in the decorated step(); see incubate/asp.py)
-        import sys
-        asp = sys.modules.get("paddle_tpu.incubate.asp")
-        asp_masks = asp._masks if asp is not None and asp._masks else None
         new_params, new_state = {}, {}
         for n in self._param_list:
             p = params[n]
@@ -319,9 +312,85 @@ class SpmdTrainer:
             new_state[n] = ns_
         return new_params, new_state
 
+    def _apply_update(self, params, grads, opt_state, lr, step_i):
+        """Shared step epilogue: grad clip + per-param optimizer update."""
+        opt = self.opt
+        grads = _clip_grads_functional(opt._grad_clip, params, grads)
+        asp_masks = self._active_asp_masks()
+        if self._use_sharded_update(asp_masks):
+            return self._apply_update_sharded(params, grads, opt_state, lr,
+                                              step_i)
+        return self._update_loop(params, grads, opt_state, lr, step_i,
+                                 asp_masks)
+
+    @staticmethod
+    def _active_asp_masks():
+        """ASP: n:m sparsity masks survive compiled updates too (the eager
+        path reapplies them in the decorated step(); see incubate/asp.py)."""
+        import sys
+        asp = sys.modules.get("paddle_tpu.incubate.asp")
+        return asp._masks if asp is not None and asp._masks else None
+
+    def _use_sharded_update(self, asp_masks=None) -> bool:
+        """ZeRO-3's shard_map update region applies only when the optimizer
+        declares a purely elementwise update (opt-in via
+        _update_elementwise; Lamb-style global trust ratios would compute
+        per-shard norms silently) and no ASP masks are active (masks would
+        need slicing into the manual region)."""
+        return (self.zero_stage >= 3 and self._jax_mesh is not None
+                and asp_masks is None
+                and getattr(self.opt, "_update_elementwise", False))
+
+    def _apply_update_sharded(self, params, grads, opt_state, lr, step_i):
+        """ZeRO-3: the elementwise optimizer update runs in a shard_map
+        manual region over the mesh. The region boundary is a GSPMD
+        propagation barrier, so the FSDP 'sharding'-dim layout of the
+        params/moments cannot leak backward into the transpose dots (the
+        "involuntary full rematerialization" activation reshard); entering
+        with the gradient's sharded in_spec lets XLA lower the dp/sharding
+        gradient sum to reduce-scatter + local slice — the FSDP contract
+        (reference: group_sharded_stage3 grads reduce-scatter,
+        group_sharded_stage3.py:85). Requires an elementwise optimizer
+        update (Lamb-style trust ratios need global norms and take the
+        plain path)."""
+        import numpy as _np
+        pspecs = {n: self._param_spec(n, self._params[n])
+                  for n in self._param_list}
+        gspecs = {n: self._grad_spec(n) for n in self._param_list}
+        sspecs = {n: {k: self._state_spec(pspecs[n], _np.shape(v))
+                      for k, v in opt_state[n].items()}
+                  for n in self._param_list}
+        rep = PartitionSpec()
+
+        def body(params_, grads_, state_, lr_, step_):
+            # lr/step enter as replicated operands (closure capture of
+            # tracers is not allowed in a manual region)
+            return self._update_loop(params_, grads_, state_, lr_, step_,
+                                     None)
+
+        return jax.shard_map(
+            body, mesh=self._jax_mesh,
+            in_specs=(pspecs, gspecs, sspecs, rep, rep),
+            out_specs=(pspecs, sspecs),
+            check_vma=False)(params, grads, opt_state, lr, step_i)
+
     def _build(self, batch_arrays):
         def step_fn(params, opt_state, lr, step_i, key, *batch):
             def pure_loss(params_):
+                if self.zero_stage >= 3 and self._jax_mesh is not None:
+                    # FSDP compute contract: gather the 'sharding'-dim-
+                    # stored params to their TP compute layout BEFORE the
+                    # dots (one all-gather per param per step), instead of
+                    # letting GSPMD reshard the activations to match a
+                    # contraction-dim-sharded weight (the involuntary-remat
+                    # tax). The constraint's VJP pins each gradient to the
+                    # same full layout, and the shard_map update boundary
+                    # then slices it back to the ZeRO shard — reduce-
+                    # scatter + local update, group_sharded_stage3
+                    # semantics.
+                    params_ = {n: jax.lax.with_sharding_constraint(
+                        a, self._sharding(self._tp_spec(self._params[n])))
+                        for n, a in params_.items()}
                 return self._pure_loss(params_, batch, key)
 
             loss, grads = jax.value_and_grad(pure_loss)(params)
@@ -343,7 +412,16 @@ class SpmdTrainer:
                 grads = {n: jax.lax.with_sharding_constraint(
                             g, self._sharding(self._tp_spec(self._params[n])))
                          for n, g in grads.items()}
-            if self.zero_stage >= 2 and self._jax_mesh is not None:
+            use_sharded = self._use_sharded_update(self._active_asp_masks())
+            if self._jax_mesh is not None and (
+                    self.zero_stage == 2
+                    or (self.zero_stage >= 3 and not use_sharded)):
+                # Stage 2 (and stage-3 configs the shard_map update cannot
+                # serve — Lamb, active ASP masks) pin grads to the ZeRO
+                # layout here. Stage 3 with the sharded update skips this:
+                # its grads reach the ZeRO layout at the shard_map boundary,
+                # and an explicit constraint would only re-open the
+                # propagation path into the backward dots.
                 grads = {n: jax.lax.with_sharding_constraint(
                             g, self._sharding(self._grad_spec(n)))
                          for n, g in grads.items()}
