@@ -1,0 +1,286 @@
+"""Autoregressive decoding with KV caches — the serving decode path.
+
+Reference parity (capability): the fused decode attention kernels
+(`phi/kernels/fusion/gpu/masked_multihead_attention_kernel.cu`,
+`block_multi_head_attention_kernel.cu`) plus the PaddleNLP-style
+`generate()` loop the inference engine serves. TPU-native design: decode is
+inference-only, so it does NOT thread Tensor tape nodes through the eager
+layers — the whole generation (prefill + every decode step + sampling) is
+ONE jitted XLA program over the model's weight arrays:
+
+  * preallocated per-layer KV caches [B, max_len, kv_heads, hd], appended
+    with `lax.dynamic_update_slice` (static shapes, no recompilation per
+    step);
+  * the decode loop is `lax.fori_loop` with a static trip count — finished
+    rows keep computing but their tokens are masked to pad (data-dependent
+    early exit would break XLA's static control flow);
+  * left-padded ragged prompts: per-row positions from the attention mask
+    drive both the rope rotation and the causal/padding score mask;
+  * sampling (greedy / temperature / top-k / top-p) happens on-device with
+    the framework PRNG.
+
+Numerics are parity-tested against the training forward
+(tests/test_generation.py): a cached decode step must reproduce the
+full-recompute logits exactly.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .tensor import Tensor
+
+NEG_INF = -1e30
+
+
+# -- pure llama math over weight arrays ---------------------------------------
+
+def _rms(x, w, eps):
+    x32 = x.astype(jnp.float32)
+    ms = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    return (x32 * jax.lax.rsqrt(ms + eps) * w.astype(jnp.float32)) \
+        .astype(x.dtype)
+
+
+def _rope_rows(x, cos, sin):
+    """Rotate pairs with PER-ROW position tables. x: [B, S, H, D];
+    cos/sin: [B, S, D/2] (already gathered at each row's positions)."""
+    x1 = x[..., 0::2]
+    x2 = x[..., 1::2]
+    c = cos[:, :, None, :]
+    s = sin[:, :, None, :]
+    ro1 = x1 * c - x2 * s
+    ro2 = x2 * c + x1 * s
+    return jnp.stack([ro1, ro2], axis=-1).reshape(x.shape).astype(x.dtype)
+
+
+def _attend(q, k, v, score_mask):
+    """q: [B, S, H, D]; k/v: [B, T, H, D]; score_mask: [B, 1, S, T] bool
+    (True = visible). Returns [B, S, H, D]."""
+    d = q.shape[-1]
+    scores = jnp.einsum("bshd,bthd->bhst", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) / np.sqrt(d)
+    scores = jnp.where(score_mask, scores, NEG_INF)
+    p = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhst,bthd->bshd", p, v.astype(jnp.float32)) \
+        .astype(q.dtype)
+
+
+class _LlamaDecoder:
+    """Pure functions over a LlamaForCausalLM state dict."""
+
+    def __init__(self, model):
+        cfg = model.config
+        self.cfg = cfg
+        self.n_heads = cfg.num_attention_heads
+        self.n_kv = cfg.num_key_value_heads or self.n_heads
+        self.hd = cfg.hidden_size // self.n_heads
+        self.eps = cfg.rms_norm_eps
+        self.n_layers = cfg.num_hidden_layers
+        self.tied = model.lm_head is None
+        state = model.named_state()
+        self.w = {n: t._data for n, t in state.items()}
+        self.rope_cos = model.model.rope_cos._data
+        self.rope_sin = model.model.rope_sin._data
+
+    def _lw(self, i, name):
+        return self.w[f"model.layers.{i}.{name}"]
+
+    def _layer(self, i, h, cos, sin, kc, vc, write_pos, score_mask):
+        """One decoder layer with cache append; h: [B, S, H*D]."""
+        b, s, _ = h.shape
+        x = _rms(h, self._lw(i, "input_layernorm.weight"), self.eps)
+        q = (x @ self._lw(i, "self_attn.q_proj.weight")) \
+            .reshape(b, s, self.n_heads, self.hd)
+        k = (x @ self._lw(i, "self_attn.k_proj.weight")) \
+            .reshape(b, s, self.n_kv, self.hd)
+        v = (x @ self._lw(i, "self_attn.v_proj.weight")) \
+            .reshape(b, s, self.n_kv, self.hd)
+        q = _rope_rows(q, cos, sin)
+        k = _rope_rows(k, cos, sin)
+        # append to the cache at write_pos (same slot for every row; rows
+        # that are still inside their left-padding write garbage that the
+        # score mask hides)
+        kc = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype),
+                                          (0, write_pos, 0, 0))
+        vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype),
+                                          (0, write_pos, 0, 0))
+        kf, vf = kc, vc
+        if self.n_kv != self.n_heads:
+            rep = self.n_heads // self.n_kv
+            kf = jnp.repeat(kf, rep, axis=2)
+            vf = jnp.repeat(vf, rep, axis=2)
+        att = _attend(q, kf, vf, score_mask).reshape(b, s, -1)
+        h = h + att @ self._lw(i, "self_attn.o_proj.weight")
+        x2 = _rms(h, self._lw(i, "post_attention_layernorm.weight"), self.eps)
+        gate = x2 @ self._lw(i, "mlp.gate_proj.weight")
+        up = x2 @ self._lw(i, "mlp.up_proj.weight")
+        swi = (jax.nn.silu(gate.astype(jnp.float32))
+               .astype(up.dtype) * up)
+        h = h + swi @ self._lw(i, "mlp.down_proj.weight")
+        return h, kc, vc
+
+    def _logits(self, h):
+        emb = self.w["model.embed_tokens.weight"]
+        h = _rms(h, self.w["model.norm.weight"], self.eps)
+        if self.tied:
+            return h @ emb.T
+        return h @ self.w["lm_head.weight"]
+
+    def step(self, tokens, positions, kcs, vcs, write_pos, score_mask):
+        """tokens: [B, S] int; positions: [B, S] int (rope positions);
+        kcs/vcs: [L, B, M, kvh, hd]; score_mask: [B, 1, S, M].
+        Returns (logits [B, S, V], kcs', vcs')."""
+        emb = self.w["model.embed_tokens.weight"]
+        h = emb[tokens]
+        cos = self.rope_cos[positions]        # [B, S, hd/2]
+        sin = self.rope_sin[positions]
+        new_k, new_v = [], []
+        for i in range(self.n_layers):
+            h, kc, vc = self._layer(i, h, cos, sin, kcs[i], vcs[i],
+                                    write_pos, score_mask)
+            new_k.append(kc)
+            new_v.append(vc)
+        return self._logits(h), jnp.stack(new_k), jnp.stack(new_v)
+
+
+# -- sampling ------------------------------------------------------------------
+
+def _sample(logits, key, do_sample, temperature, top_k, top_p):
+    """logits: [B, V] -> tokens [B]."""
+    if not do_sample:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    lg = logits.astype(jnp.float32) / jnp.maximum(temperature, 1e-6)
+    if top_k and top_k > 0:
+        kth = jax.lax.top_k(lg, top_k)[0][..., -1:]
+        lg = jnp.where(lg < kth, NEG_INF, lg)
+    if top_p < 1.0:
+        sorted_lg = jnp.sort(lg, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_lg, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep the smallest set whose mass reaches top_p (first token
+        # always kept)
+        keep_sorted = jnp.roll(cum, 1, axis=-1) < top_p
+        keep_sorted = keep_sorted.at[..., 0].set(True)
+        cutoff = jnp.min(jnp.where(keep_sorted, sorted_lg, jnp.inf),
+                         axis=-1, keepdims=True)
+        lg = jnp.where(lg < cutoff, NEG_INF, lg)
+    return jax.random.categorical(key, lg, axis=-1).astype(jnp.int32)
+
+
+# -- public API ----------------------------------------------------------------
+
+def _generate_impl(dec: "_LlamaDecoder", ids, mask, key, max_new, do_sample,
+                   temperature, eos_id, has_eos, top_k, top_p):
+    b, s = ids.shape
+    m_total = s + max_new
+    lengths = jnp.sum(mask, axis=1).astype(jnp.int32)        # [B]
+    # left-padded: row positions start at 0 on the first REAL token
+    positions = jnp.maximum(
+        jnp.cumsum(mask, axis=1).astype(jnp.int32) - 1, 0)   # [B, S]
+    kcs = jnp.zeros((dec.n_layers, b, m_total, dec.n_kv, dec.hd),
+                    dec.w["model.embed_tokens.weight"].dtype)
+    vcs = jnp.zeros_like(kcs)
+
+    # prefill: causal over the prompt, padding hidden
+    t_idx = jnp.arange(m_total)[None, None, None, :]         # key slots
+    q_idx = jnp.arange(s)[None, None, :, None]
+    key_mask = jnp.concatenate(
+        [mask.astype(bool), jnp.zeros((b, max_new), bool)], axis=1)
+    pre_mask = (t_idx <= q_idx) & key_mask[:, None, None, :]
+    logits, kcs, vcs = dec.step(ids, positions, kcs, vcs, 0, pre_mask)
+    # left padding => the last REAL token sits at index s-1 for every row
+    last_logits = logits[:, -1]
+
+    def body(t, carry):
+        kcs, vcs, last_logits, key_mask, out, finished, key = carry
+        key, k_step = jax.random.split(key)
+        tok = _sample(last_logits, k_step, do_sample, temperature, top_k,
+                      top_p)
+        if has_eos:
+            tok = jnp.where(finished, eos_id, tok)
+            finished = finished | (tok == eos_id)
+        out = out.at[:, t].set(tok)
+        write_pos = s + t
+        key_mask = key_mask.at[:, write_pos].set(True)
+        positions_t = (lengths + t)[:, None]                 # [B, 1]
+        step_mask = key_mask[:, None, None, :]               # attend all real
+        logits, kcs, vcs = dec.step(tok[:, None], positions_t, kcs, vcs,
+                                    write_pos, step_mask)
+        return kcs, vcs, logits[:, 0], key_mask, out, finished, key
+
+    out0 = jnp.zeros((b, max_new), jnp.int32)
+    finished0 = jnp.zeros((b,), bool)
+    carry = (kcs, vcs, last_logits, key_mask, out0, finished0, key)
+    carry = jax.lax.fori_loop(0, max_new, body, carry)
+    return carry[4], carry[5]
+
+
+def generate(model, input_ids, attention_mask=None, max_new_tokens: int = 32,
+             do_sample: bool = False, temperature: float = 1.0,
+             top_k: int = 0, top_p: float = 1.0,
+             eos_token_id: Optional[int] = None, seed: Optional[int] = None):
+    """Greedy/sampled continuation of `input_ids` ([B, S] int, LEFT-padded
+    for ragged batches with `attention_mask` [B, S] in {0,1}).
+
+    Returns (tokens [B, max_new_tokens] Tensor, finished [B] Tensor) —
+    rows that hit eos_token_id keep emitting eos. One compiled program per
+    (batch, prompt_len, max_new_tokens, sampling-config) signature."""
+    ids = input_ids._data if isinstance(input_ids, Tensor) \
+        else jnp.asarray(input_ids)
+    ids = ids.astype(jnp.int32)
+    b, s = ids.shape
+    if attention_mask is None:
+        mask = jnp.ones((b, s), jnp.int32)
+    else:
+        mask = (attention_mask._data if isinstance(attention_mask, Tensor)
+                else jnp.asarray(attention_mask)).astype(jnp.int32)
+        # left padding is the contract: real tokens are a suffix
+        lengths = jnp.sum(mask, axis=1)
+        suffix = jnp.arange(s)[None, :] >= (s - lengths[:, None])
+        if not bool(jnp.all(mask.astype(bool) == suffix)):
+            raise ValueError(
+                "generate() requires LEFT-padded prompts: attention_mask "
+                "must mark a suffix of real tokens per row")
+    if model.config.max_position_embeddings < s + max_new_tokens:
+        raise ValueError(
+            f"prompt {s} + max_new_tokens {max_new_tokens} exceeds "
+            f"max_position_embeddings "
+            f"{model.config.max_position_embeddings}")
+    dec = _decoder_for(model)
+    key = jax.random.PRNGKey(0 if seed is None else seed)
+    if seed is None and do_sample:
+        from .framework.random import next_key
+        key = next_key()
+    has_eos = eos_token_id is not None
+    toks, finished = dec._jit(
+        ids, mask, key, int(max_new_tokens), bool(do_sample),
+        float(temperature), jnp.int32(eos_token_id if has_eos else 0),
+        has_eos, int(top_k), float(top_p))
+    return Tensor(toks), Tensor(finished)
+
+
+def _decoder_for(model):
+    """One _LlamaDecoder per model instance, stored ON the model (so it —
+    and its jit executable cache, which closes over the weight arrays —
+    dies with the model instead of leaking in a module-global). Rebuilt
+    when the weight array objects change (e.g. after an optimizer step)."""
+    state_ver = tuple(id(t._data) for _, t in sorted(
+        model.named_state().items()))
+    dec = model.__dict__.get("_decode_cache")
+    if dec is None or dec._state_ver != state_ver:
+        dec = _LlamaDecoder(model)
+        dec._state_ver = state_ver
+        # jit is per-decoder: dropping the decoder drops its compiled
+        # executables and the old weights they captured
+        dec._jit = jax.jit(functools.partial(_generate_impl, dec),
+                           static_argnums=(3, 4, 7, 8, 9))
+        model.__dict__["_decode_cache"] = dec
+    return dec
+
+
+__all__ = ["generate"]

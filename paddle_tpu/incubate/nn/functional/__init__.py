@@ -3,6 +3,7 @@ from .fused_moe import fused_moe  # noqa: F401
 from .fused_ops import (  # noqa: F401
     fused_bias_act, fused_dropout_add, fused_layer_norm, fused_linear,
     fused_linear_activation, fused_matmul_bias,
-    fused_rotary_position_embedding, fused_rms_norm, swiglu,
+    fused_rotary_position_embedding, fused_rms_norm,
+    masked_multihead_attention, swiglu,
     variable_length_memory_efficient_attention,
 )

@@ -25,7 +25,8 @@ from ....tensor import Tensor
 __all__ = ["fused_rotary_position_embedding", "fused_layer_norm",
            "fused_rms_norm", "fused_dropout_add", "fused_matmul_bias",
            "fused_linear", "fused_linear_activation", "fused_bias_act",
-           "swiglu", "variable_length_memory_efficient_attention"]
+           "swiglu", "variable_length_memory_efficient_attention",
+           "masked_multihead_attention"]
 
 
 def _rope_rotate(x, cos, sin, neox):
@@ -375,3 +376,99 @@ def variable_length_memory_efficient_attention(query, key, value, seq_lens,
         return jnp.where(qvalid, out, 0.0).astype(q.dtype)
 
     return dispatch("varlen_mem_efficient_attention", fwd, *args)
+
+
+def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
+                               cum_offsets=None, sequence_lengths=None,
+                               rotary_tensor=None, beam_cache_offset=None,
+                               qkv_out_scale=None, out_shift=None,
+                               out_smooth=None, seq_len=1, rotary_emb_dims=0,
+                               use_neox_rotary_style=False,
+                               compute_dtype="default", out_scale=-1,
+                               quant_round_type=1, quant_max_bound=127.0,
+                               quant_min_bound=-127.0):
+    """Single-step decode attention against a KV cache (parity:
+    paddle.incubate.nn.functional.masked_multihead_attention /
+    masked_multihead_attention_kernel.cu — the fused generation-model
+    decode op). x: [B, 3*H*D] (this step's fused qkv); cache_kv:
+    [2, B, H, M, D]; sequence_lengths: [B, 1] current lengths (the write
+    slot; defaults to the cache being full up to src_mask's length);
+    src_mask: additive mask [B, 1, 1, M] (or shorter — padded with -inf).
+    Returns (out [B, H*D], cache_kv_out) exactly like the reference.
+
+    TPU-native: one jnp expression (XLA fuses qkv-split + rope-free decode
+    attention + cache scatter); the full generation loop lives in
+    paddle_tpu.generation. Quant/beam/rotary extras of the CUDA kernel are
+    rejected loudly rather than silently ignored."""
+    for name, v_ in (("cum_offsets", cum_offsets),
+                     ("rotary_tensor", rotary_tensor),
+                     ("beam_cache_offset", beam_cache_offset),
+                     ("qkv_out_scale", qkv_out_scale),
+                     ("out_shift", out_shift), ("out_smooth", out_smooth)):
+        if v_ is not None:
+            raise NotImplementedError(
+                f"masked_multihead_attention: {name} (quant/beam/fused-rope "
+                "variants) is not supported; apply rope before the qkv pack "
+                "and use paddle_tpu.generation for full loops")
+    if out_scale != -1:
+        raise NotImplementedError("quantized output path not supported")
+    if cache_kv is None:
+        raise ValueError("cache_kv is required")
+    xt, ct = ensure_tensor(x), ensure_tensor(cache_kv)
+    args = [xt, ct]
+    if bias is not None:
+        args.append(ensure_tensor(bias))
+    has_bias = bias is not None
+    if sequence_lengths is not None:
+        args.append(ensure_tensor(sequence_lengths))
+    has_len = sequence_lengths is not None
+    if src_mask is not None:
+        args.append(ensure_tensor(src_mask))
+    has_mask = src_mask is not None
+
+    def fwd(xa, cache, *rest):
+        rest = list(rest)
+        b_ = xa.shape[0]
+        _, _, h, m, d = cache.shape
+        qkv = xa.reshape(b_, 3, h, d)
+        if has_bias:
+            qkv = qkv + rest.pop(0).reshape(1, 3, h, d)
+        q, k_new, v_new = qkv[:, 0], qkv[:, 1], qkv[:, 2]   # [B, H, D]
+        if has_len:
+            lens = rest.pop(0).reshape(b_).astype(jnp.int32)
+            if not isinstance(lens, jax.core.Tracer):
+                if bool(jnp.any(lens >= m)):
+                    raise ValueError(
+                        f"masked_multihead_attention: cache is full "
+                        f"(a sequence_length >= max_seq_len {m}); this "
+                        f"step's K/V has nowhere to go")
+        elif has_mask:
+            # mask length tells how many slots are live INCLUDING this step
+            lens = jnp.full((b_,), rest[0].shape[-1] - 1, jnp.int32)
+        else:
+            raise ValueError("need sequence_lengths or src_mask to place "
+                             "this step in the cache")
+        slot = jnp.arange(m)[None, :]                        # [1, M]
+        write = slot == lens[:, None]                        # [B, M]
+        kc = jnp.where(write[:, None, :, None],
+                       k_new[:, :, None, :].astype(cache.dtype), cache[0])
+        vc = jnp.where(write[:, None, :, None],
+                       v_new[:, :, None, :].astype(cache.dtype), cache[1])
+        scores = jnp.einsum("bhd,bhmd->bhm", q.astype(jnp.float32),
+                            kc.astype(jnp.float32)) / math.sqrt(d)
+        live = slot <= lens[:, None]                         # [B, M]
+        scores = jnp.where(live[:, None, :], scores, -1e30)
+        if has_mask:
+            sm = rest.pop(0).astype(jnp.float32).reshape(b_, 1, -1)
+            pad = scores.shape[-1] - sm.shape[-1]
+            if pad > 0:
+                sm = jnp.pad(sm, ((0, 0), (0, 0), (0, pad)),
+                             constant_values=-1e30)
+            scores = scores + sm
+        p = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bhm,bhmd->bhd", p, vc.astype(jnp.float32))
+        return (out.reshape(b_, h * d).astype(xa.dtype),
+                jnp.stack([kc, vc]))
+
+    res = dispatch("masked_multihead_attention", fwd, *args)
+    return res

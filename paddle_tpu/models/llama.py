@@ -261,6 +261,13 @@ class LlamaForCausalLM(nn.Layer):
             return matmul(h, self.model.embed_tokens.weight, transpose_y=True)
         return self.lm_head(h)
 
+    def generate(self, input_ids, attention_mask=None, **kwargs):
+        """KV-cached autoregressive decoding as one compiled program
+        (greedy / temperature / top-k / top-p; see generation.generate)."""
+        from ..generation import generate
+        return generate(self, input_ids, attention_mask=attention_mask,
+                        **kwargs)
+
     def compute_loss(self, logits, labels):
         """Shifted next-token cross entropy."""
         from ..ops.manipulation import reshape

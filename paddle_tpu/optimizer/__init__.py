@@ -79,6 +79,12 @@ class ClipGradByGlobalNorm(ClipGradBase):
 # ---- base --------------------------------------------------------------------
 
 class Optimizer:
+    # ZeRO-3's shard_map update region is only safe for purely elementwise
+    # updates, so optimizers opt IN (the elementwise built-ins set True;
+    # Lamb-style global trust ratios and unknown subclasses stay on the
+    # plain path) — consumed by parallel.trainer._use_sharded_update
+    _update_elementwise = False
+
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
                  grad_clip=None, name=None):
         self._lr = learning_rate

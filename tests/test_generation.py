@@ -1,0 +1,170 @@
+"""KV-cached autoregressive decoding (paddle_tpu.generation) — the serving
+decode capability (reference: masked_multihead_attention_kernel.cu fused
+decode + PaddleNLP-style generate loops).
+
+Oracle strategy: the cached decode must reproduce the training forward's
+logits exactly (full recompute per step)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+
+def _model(tied=False, kv_heads=2, seed=3):
+    paddle.seed(seed)
+    cfg = LlamaConfig.tiny(vocab_size=61, hidden_size=32, layers=2, heads=4,
+                           kv_heads=kv_heads, seq=64)
+    cfg.use_flash_attention = False
+    cfg.tie_word_embeddings = tied
+    return LlamaForCausalLM(cfg)
+
+
+def _greedy_oracle(model, ids, steps):
+    """Naive loop: full forward recompute each step, argmax."""
+    cur = np.asarray(ids)
+    out = []
+    for _ in range(steps):
+        logits = model(paddle.to_tensor(cur)).numpy()
+        tok = np.argmax(logits[:, -1], axis=-1).astype(np.int32)
+        out.append(tok)
+        cur = np.concatenate([cur, tok[:, None]], axis=1)
+    return np.stack(out, axis=1)
+
+
+@pytest.mark.parametrize("kv_heads", [4, 2])   # MHA and GQA
+def test_greedy_generate_matches_full_recompute(kv_heads):
+    model = _model(kv_heads=kv_heads)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 61, (2, 7)).astype(np.int32)
+    want = _greedy_oracle(model, ids, steps=6)
+    got, finished = model.generate(paddle.to_tensor(ids), max_new_tokens=6)
+    np.testing.assert_array_equal(got.numpy(), want)
+    assert not finished.numpy().any()
+
+
+def test_left_padded_batch_matches_single_rows():
+    model = _model()
+    rng = np.random.default_rng(1)
+    p1 = rng.integers(0, 61, (1, 4)).astype(np.int32)
+    p2 = rng.integers(0, 61, (1, 7)).astype(np.int32)
+    # batch them left-padded to length 7
+    ids = np.zeros((2, 7), np.int32)
+    mask = np.zeros((2, 7), np.int32)
+    ids[0, 3:] = p1[0]
+    mask[0, 3:] = 1
+    ids[1] = p2[0]
+    mask[1] = 1
+    got, _ = model.generate(paddle.to_tensor(ids),
+                            attention_mask=paddle.to_tensor(mask),
+                            max_new_tokens=5)
+    want1 = _greedy_oracle(model, p1, 5)
+    want2 = _greedy_oracle(model, p2, 5)
+    np.testing.assert_array_equal(got.numpy()[0], want1[0])
+    np.testing.assert_array_equal(got.numpy()[1], want2[0])
+
+
+def test_right_padding_rejected():
+    model = _model()
+    ids = np.ones((1, 5), np.int32)
+    mask = np.array([[1, 1, 1, 0, 0]], np.int32)   # right padding
+    with pytest.raises(ValueError, match="LEFT-padded"):
+        model.generate(paddle.to_tensor(ids),
+                       attention_mask=paddle.to_tensor(mask),
+                       max_new_tokens=2)
+
+
+def test_eos_rows_keep_emitting_eos():
+    model = _model()
+    rng = np.random.default_rng(2)
+    ids = rng.integers(0, 61, (2, 5)).astype(np.int32)
+    free, _ = model.generate(paddle.to_tensor(ids), max_new_tokens=8)
+    first = free.numpy()[:, 0]
+    eos = int(first[0])                    # force row 0's first pick as eos
+    got, finished = model.generate(paddle.to_tensor(ids), max_new_tokens=8,
+                                   eos_token_id=eos)
+    g = got.numpy()
+    assert (g[0] == eos).all()             # finished row: eos forever
+    assert finished.numpy()[0]
+    if first[1] != eos:
+        assert g[1, 0] == first[1]         # other row unaffected at step 0
+
+
+def test_sampling_reproducible_and_top_k_respected():
+    model = _model()
+    rng = np.random.default_rng(4)
+    ids = rng.integers(0, 61, (2, 6)).astype(np.int32)
+    a, _ = model.generate(paddle.to_tensor(ids), max_new_tokens=5,
+                          do_sample=True, temperature=0.8, top_k=3, seed=7)
+    b, _ = model.generate(paddle.to_tensor(ids), max_new_tokens=5,
+                          do_sample=True, temperature=0.8, top_k=3, seed=7)
+    np.testing.assert_array_equal(a.numpy(), b.numpy())
+    c, _ = model.generate(paddle.to_tensor(ids), max_new_tokens=5,
+                          do_sample=True, temperature=0.8, top_k=3, seed=8)
+    assert not np.array_equal(a.numpy(), c.numpy())
+    # every sampled first token is within the top-3 of the prefill logits
+    logits = model(paddle.to_tensor(ids)).numpy()[:, -1]
+    top3 = np.argsort(logits, axis=-1)[:, -3:]
+    for row in range(2):
+        assert a.numpy()[row, 0] in top3[row]
+
+
+def test_tied_embeddings_generate():
+    model = _model(tied=True)
+    rng = np.random.default_rng(5)
+    ids = rng.integers(0, 61, (1, 6)).astype(np.int32)
+    want = _greedy_oracle(model, ids, 4)
+    got, _ = model.generate(paddle.to_tensor(ids), max_new_tokens=4)
+    np.testing.assert_array_equal(got.numpy(), want)
+
+
+def test_masked_multihead_attention_matches_dense():
+    """The fused decode op (incubate parity surface): one step against a
+    cache must equal dense attention over the concatenated sequence."""
+    import paddle_tpu.incubate.nn.functional as IF
+
+    rng = np.random.default_rng(6)
+    b, h, m, d = 2, 4, 8, 16
+    cur = 5                                # live cache entries per row
+    cache = rng.standard_normal((2, b, h, m, d)).astype(np.float32)
+    cache[:, :, :, cur:] = 0.0
+    x = rng.standard_normal((b, 3 * h * d)).astype(np.float32)
+    lens = np.full((b, 1), cur, np.int32)
+    out, new_cache = IF.masked_multihead_attention(
+        paddle.to_tensor(x), cache_kv=paddle.to_tensor(cache),
+        sequence_lengths=paddle.to_tensor(lens))
+    qkv = x.reshape(b, 3, h, d)
+    q, kn, vn = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+    kc = np.concatenate([cache[0][:, :, :cur], kn[:, :, None]], axis=2)
+    vc = np.concatenate([cache[1][:, :, :cur], vn[:, :, None]], axis=2)
+    scores = np.einsum("bhd,bhmd->bhm", q, kc) / np.sqrt(d)
+    p = np.exp(scores - scores.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    want = np.einsum("bhm,bhmd->bhd", p, vc).reshape(b, h * d)
+    np.testing.assert_allclose(out.numpy(), want, rtol=1e-5, atol=1e-5)
+    # the cache gained this step's k/v at slot `cur`
+    nc = new_cache.numpy()
+    np.testing.assert_allclose(nc[0][:, :, cur], kn, rtol=1e-6)
+    np.testing.assert_allclose(nc[1][:, :, cur], vn, rtol=1e-6)
+
+
+def test_masked_multihead_attention_rejects_quant_args():
+    import paddle_tpu.incubate.nn.functional as IF
+    with pytest.raises(NotImplementedError):
+        IF.masked_multihead_attention(
+            paddle.to_tensor(np.zeros((1, 12), np.float32)),
+            cache_kv=paddle.to_tensor(np.zeros((2, 1, 1, 4, 4), np.float32)),
+            sequence_lengths=paddle.to_tensor(np.zeros((1, 1), np.int32)),
+            qkv_out_scale=paddle.to_tensor(np.ones((3, 1, 4), np.float32)))
+
+
+def test_masked_multihead_attention_rejects_full_cache():
+    import paddle_tpu.incubate.nn.functional as IF
+    b, h, m, d = 1, 2, 4, 8
+    cache = np.zeros((2, b, h, m, d), np.float32)
+    x = np.zeros((b, 3 * h * d), np.float32)
+    with pytest.raises(ValueError, match="cache is full"):
+        IF.masked_multihead_attention(
+            paddle.to_tensor(x), cache_kv=paddle.to_tensor(cache),
+            sequence_lengths=paddle.to_tensor(np.full((b, 1), m, np.int32)))

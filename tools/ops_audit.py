@@ -55,6 +55,8 @@ ALIASES = {
     "flash_attn_varlen_qkvpacked": "F.flash_attn_unpadded",
     "memory_efficient_attention":
         "paddle.incubate.nn.functional.variable_length_memory_efficient_attention",
+    # masked_multihead_attention_ needs no alias: the in-place-spelling
+    # strip resolves it directly to incubate.nn.functional's symbol
     # norms / linalg
     "frobenius_norm": "paddle.linalg.norm", "p_norm": "paddle.norm",
     "matrix_rank_atol_rtol": "paddle.linalg.matrix_rank",
@@ -189,7 +191,6 @@ DECIDED_OUT = {
                            "khop loop is user-side"),
     "identity_loss": "IPU-specific marker op",
     "im2sequence": _LEGACY_LOD,
-    "masked_multihead_attention_": _INFER,
     "pyramid_hash": _PS, "rank_attention": _PS, "shuffle_batch": _PS,
     "sequence_conv": _LEGACY_LOD, "sequence_pool": _LEGACY_LOD,
     "tdm_child": _PS, "tdm_sampler": _PS,
