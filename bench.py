@@ -256,6 +256,40 @@ def _run_probe(extend=None):
         return {"us": round(dt * 1e6, 1), "doc_len": doc,
                 "visible_frac": round(visible_frac, 4)}
 
+    def decode_probe():
+        # serving decode throughput: KV-cached generate() as one compiled
+        # program on a small-but-real config (the inference-side headline
+        # next to the training tokens/s)
+        import numpy as _np
+        import paddle_tpu as paddle
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+        paddle.seed(0)
+        cfg = LlamaConfig.tiny(vocab_size=32000, hidden_size=1024, layers=8,
+                               heads=16, kv_heads=16, seq=1024)
+        model = LlamaForCausalLM(cfg)
+        ids = paddle.to_tensor(
+            _np.random.default_rng(0).integers(0, 32000, (4, 128))
+            .astype(_np.int32))
+        new_toks = 128
+        short = 8
+        for n in (short, new_toks):          # compile both signatures
+            out, _ = model.generate(ids, max_new_tokens=n)
+            barrier(out._data)
+        t0 = _t.perf_counter()
+        out, _ = model.generate(ids, max_new_tokens=short)
+        barrier(out._data)
+        dt_short = _t.perf_counter() - t0
+        t0 = _t.perf_counter()
+        out, _ = model.generate(ids, max_new_tokens=new_toks)
+        barrier(out._data)
+        dt = _t.perf_counter() - t0
+        # the two runs share the prefill; their difference isolates the
+        # per-decode-step cost
+        ms_step = (dt - dt_short) / (new_toks - short) * 1e3
+        return {"batch": 4, "new_tokens": new_toks,
+                "e2e_tok_per_s": round(4 * new_toks / dt, 1),
+                "decode_ms_per_step": round(ms_step, 2)}
+
     def mem_probe():
         try:
             stats = dev.memory_stats() or {}
@@ -270,6 +304,7 @@ def _run_probe(extend=None):
     step("flashmask", flashmask_probe)
     step("xla_attn", xla_attn_probe)
     step("fused", fused_probe)
+    step("decode", decode_probe)
     step("mem", mem_probe)
     out["ok"] = out["steps"].get("matmul", {}).get("ok", False)
     return out

@@ -70,8 +70,29 @@ def _attend(q, k, v, score_mask):
         .astype(q.dtype)
 
 
+def _attend_gqa(q, k, v, score_mask, rep):
+    """Grouped-query attention without expanding the KV cache. q:
+    [B, S, G*rep, D]; k/v: [B, T, G, D]; score_mask: [B, 1, S, T].
+    Returns [B, S, G*rep, D]."""
+    b, s, h, d = q.shape
+    g = h // rep
+    qg = q.reshape(b, s, g, rep, d)
+    scores = jnp.einsum("bsgrd,btgd->bgrst", qg.astype(jnp.float32),
+                        k.astype(jnp.float32)) / np.sqrt(d)
+    scores = jnp.where(score_mask[:, None], scores, NEG_INF)
+    p = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bgrst,btgd->bsgrd", p, v.astype(jnp.float32))
+    return out.reshape(b, s, h, d).astype(q.dtype)
+
+
 class _LlamaDecoder:
-    """Pure functions over a LlamaForCausalLM state dict."""
+    """Pure functions over a LlamaForCausalLM state dict.
+
+    Holds ONLY static config — the weight arrays are a jit ARGUMENT (the
+    `w` dict threaded through every method), so the compiled executable
+    never closure-captures them: training steps after a generate() don't
+    pin superseded arrays, and weight updates need no cache invalidation.
+    """
 
     def __init__(self, model):
         cfg = model.config
@@ -82,23 +103,28 @@ class _LlamaDecoder:
         self.eps = cfg.rms_norm_eps
         self.n_layers = cfg.num_hidden_layers
         self.tied = model.lm_head is None
-        state = model.named_state()
-        self.w = {n: t._data for n, t in state.items()}
-        self.rope_cos = model.model.rope_cos._data
-        self.rope_sin = model.model.rope_sin._data
 
-    def _lw(self, i, name):
-        return self.w[f"model.layers.{i}.{name}"]
+    @staticmethod
+    def weights(model):
+        """The jit-argument pytree: params + buffers + the rope tables."""
+        w = {n: t._data for n, t in model.named_state().items()}
+        w["__rope_cos"] = model.model.rope_cos._data
+        w["__rope_sin"] = model.model.rope_sin._data
+        return w
 
-    def _layer(self, i, h, cos, sin, kc, vc, write_pos, score_mask):
+    @staticmethod
+    def _lw(w, i, name):
+        return w[f"model.layers.{i}.{name}"]
+
+    def _layer(self, w, i, h, cos, sin, kc, vc, write_pos, score_mask):
         """One decoder layer with cache append; h: [B, S, H*D]."""
         b, s, _ = h.shape
-        x = _rms(h, self._lw(i, "input_layernorm.weight"), self.eps)
-        q = (x @ self._lw(i, "self_attn.q_proj.weight")) \
+        x = _rms(h, self._lw(w, i, "input_layernorm.weight"), self.eps)
+        q = (x @ self._lw(w, i, "self_attn.q_proj.weight")) \
             .reshape(b, s, self.n_heads, self.hd)
-        k = (x @ self._lw(i, "self_attn.k_proj.weight")) \
+        k = (x @ self._lw(w, i, "self_attn.k_proj.weight")) \
             .reshape(b, s, self.n_kv, self.hd)
-        v = (x @ self._lw(i, "self_attn.v_proj.weight")) \
+        v = (x @ self._lw(w, i, "self_attn.v_proj.weight")) \
             .reshape(b, s, self.n_kv, self.hd)
         q = _rope_rows(q, cos, sin)
         k = _rope_rows(k, cos, sin)
@@ -109,43 +135,47 @@ class _LlamaDecoder:
                                           (0, write_pos, 0, 0))
         vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype),
                                           (0, write_pos, 0, 0))
-        kf, vf = kc, vc
         if self.n_kv != self.n_heads:
+            # grouped-query attention against the UNEXPANDED cache: no
+            # n_heads/n_kv-fold repeat of [B, M, kvh, hd] on the decode
+            # hot path
             rep = self.n_heads // self.n_kv
-            kf = jnp.repeat(kf, rep, axis=2)
-            vf = jnp.repeat(vf, rep, axis=2)
-        att = _attend(q, kf, vf, score_mask).reshape(b, s, -1)
-        h = h + att @ self._lw(i, "self_attn.o_proj.weight")
-        x2 = _rms(h, self._lw(i, "post_attention_layernorm.weight"), self.eps)
-        gate = x2 @ self._lw(i, "mlp.gate_proj.weight")
-        up = x2 @ self._lw(i, "mlp.up_proj.weight")
+            att = _attend_gqa(q, kc, vc, score_mask, rep) \
+                .reshape(b, s, -1)
+        else:
+            att = _attend(q, kc, vc, score_mask).reshape(b, s, -1)
+        h = h + att @ self._lw(w, i, "self_attn.o_proj.weight")
+        x2 = _rms(h, self._lw(w, i, "post_attention_layernorm.weight"),
+                  self.eps)
+        gate = x2 @ self._lw(w, i, "mlp.gate_proj.weight")
+        up = x2 @ self._lw(w, i, "mlp.up_proj.weight")
         swi = (jax.nn.silu(gate.astype(jnp.float32))
                .astype(up.dtype) * up)
-        h = h + swi @ self._lw(i, "mlp.down_proj.weight")
+        h = h + swi @ self._lw(w, i, "mlp.down_proj.weight")
         return h, kc, vc
 
-    def _logits(self, h):
-        emb = self.w["model.embed_tokens.weight"]
-        h = _rms(h, self.w["model.norm.weight"], self.eps)
+    def _logits(self, w, h):
+        emb = w["model.embed_tokens.weight"]
+        h = _rms(h, w["model.norm.weight"], self.eps)
         if self.tied:
             return h @ emb.T
-        return h @ self.w["lm_head.weight"]
+        return h @ w["lm_head.weight"]
 
-    def step(self, tokens, positions, kcs, vcs, write_pos, score_mask):
+    def step(self, w, tokens, positions, kcs, vcs, write_pos, score_mask):
         """tokens: [B, S] int; positions: [B, S] int (rope positions);
         kcs/vcs: [L, B, M, kvh, hd]; score_mask: [B, 1, S, M].
         Returns (logits [B, S, V], kcs', vcs')."""
-        emb = self.w["model.embed_tokens.weight"]
+        emb = w["model.embed_tokens.weight"]
         h = emb[tokens]
-        cos = self.rope_cos[positions]        # [B, S, hd/2]
-        sin = self.rope_sin[positions]
+        cos = w["__rope_cos"][positions]      # [B, S, hd/2]
+        sin = w["__rope_sin"][positions]
         new_k, new_v = [], []
         for i in range(self.n_layers):
-            h, kc, vc = self._layer(i, h, cos, sin, kcs[i], vcs[i],
+            h, kc, vc = self._layer(w, i, h, cos, sin, kcs[i], vcs[i],
                                     write_pos, score_mask)
             new_k.append(kc)
             new_v.append(vc)
-        return self._logits(h), jnp.stack(new_k), jnp.stack(new_v)
+        return self._logits(w, h), jnp.stack(new_k), jnp.stack(new_v)
 
 
 # -- sampling ------------------------------------------------------------------
@@ -174,8 +204,8 @@ def _sample(logits, key, do_sample, temperature, top_k, top_p):
 
 # -- public API ----------------------------------------------------------------
 
-def _generate_impl(dec: "_LlamaDecoder", ids, mask, key, max_new, do_sample,
-                   temperature, eos_id, has_eos, top_k, top_p):
+def _generate_impl(dec: "_LlamaDecoder", w, ids, mask, key, max_new,
+                   do_sample, temperature, eos_id, has_eos, top_k, top_p):
     b, s = ids.shape
     m_total = s + max_new
     lengths = jnp.sum(mask, axis=1).astype(jnp.int32)        # [B]
@@ -183,7 +213,7 @@ def _generate_impl(dec: "_LlamaDecoder", ids, mask, key, max_new, do_sample,
     positions = jnp.maximum(
         jnp.cumsum(mask, axis=1).astype(jnp.int32) - 1, 0)   # [B, S]
     kcs = jnp.zeros((dec.n_layers, b, m_total, dec.n_kv, dec.hd),
-                    dec.w["model.embed_tokens.weight"].dtype)
+                    w["model.embed_tokens.weight"].dtype)
     vcs = jnp.zeros_like(kcs)
 
     # prefill: causal over the prompt, padding hidden
@@ -192,7 +222,7 @@ def _generate_impl(dec: "_LlamaDecoder", ids, mask, key, max_new, do_sample,
     key_mask = jnp.concatenate(
         [mask.astype(bool), jnp.zeros((b, max_new), bool)], axis=1)
     pre_mask = (t_idx <= q_idx) & key_mask[:, None, None, :]
-    logits, kcs, vcs = dec.step(ids, positions, kcs, vcs, 0, pre_mask)
+    logits, kcs, vcs = dec.step(w, ids, positions, kcs, vcs, 0, pre_mask)
     # left padding => the last REAL token sits at index s-1 for every row
     last_logits = logits[:, -1]
 
@@ -209,8 +239,8 @@ def _generate_impl(dec: "_LlamaDecoder", ids, mask, key, max_new, do_sample,
         key_mask = key_mask.at[:, write_pos].set(True)
         positions_t = (lengths + t)[:, None]                 # [B, 1]
         step_mask = key_mask[:, None, None, :]               # attend all real
-        logits, kcs, vcs = dec.step(tok[:, None], positions_t, kcs, vcs,
-                                    write_pos, step_mask)
+        logits, kcs, vcs = dec.step(w, tok[:, None], positions_t, kcs,
+                                    vcs, write_pos, step_mask)
         return kcs, vcs, logits[:, 0], key_mask, out, finished, key
 
     out0 = jnp.zeros((b, max_new), jnp.int32)
@@ -258,27 +288,27 @@ def generate(model, input_ids, attention_mask=None, max_new_tokens: int = 32,
         key = next_key()
     has_eos = eos_token_id is not None
     toks, finished = dec._jit(
-        ids, mask, key, int(max_new_tokens), bool(do_sample),
-        float(temperature), jnp.int32(eos_token_id if has_eos else 0),
-        has_eos, int(top_k), float(top_p))
+        _LlamaDecoder.weights(model), ids, mask, key, int(max_new_tokens),
+        bool(do_sample), float(temperature),
+        jnp.int32(eos_token_id if has_eos else 0), has_eos, int(top_k),
+        float(top_p))
     return Tensor(toks), Tensor(finished)
 
 
 def _decoder_for(model):
-    """One _LlamaDecoder per model instance, stored ON the model (so it —
-    and its jit executable cache, which closes over the weight arrays —
-    dies with the model instead of leaking in a module-global). Rebuilt
-    when the weight array objects change (e.g. after an optimizer step)."""
-    state_ver = tuple(id(t._data) for _, t in sorted(
-        model.named_state().items()))
+    """One _LlamaDecoder per model instance, stored ON the model (so its
+    jit executable cache dies with the model, not in a module global).
+    Weights are passed as a jit ARGUMENT on every call — never captured —
+    so weight updates need no invalidation and old arrays are never
+    pinned; the executable retraces only if shapes/dtypes change."""
     dec = model.__dict__.get("_decode_cache")
-    if dec is None or dec._state_ver != state_ver:
+    if dec is None:
         dec = _LlamaDecoder(model)
-        dec._state_ver = state_ver
-        # jit is per-decoder: dropping the decoder drops its compiled
-        # executables and the old weights they captured
+        # arg indices (after the partial binds dec): w=0, ids=1, mask=2,
+        # key=3, max_new=4, do_sample=5, temperature=6, eos_id=7,
+        # has_eos=8, top_k=9, top_p=10
         dec._jit = jax.jit(functools.partial(_generate_impl, dec),
-                           static_argnums=(3, 4, 7, 8, 9))
+                           static_argnums=(4, 5, 8, 9, 10))
         model.__dict__["_decode_cache"] = dec
     return dec
 

@@ -442,6 +442,9 @@ def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
                         f"masked_multihead_attention: cache is full "
                         f"(a sequence_length >= max_seq_len {m}); this "
                         f"step's K/V has nowhere to go")
+            # traced lens can't raise: poison overflowed rows with NaN so
+            # the wrong answer is loud, not plausible
+            overflow = lens >= m
         elif has_mask:
             # mask length tells how many slots are live INCLUDING this step
             lens = jnp.full((b_,), rest[0].shape[-1] - 1, jnp.int32)
@@ -467,6 +470,8 @@ def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
             scores = scores + sm
         p = jax.nn.softmax(scores, axis=-1)
         out = jnp.einsum("bhm,bhmd->bhd", p, vc.astype(jnp.float32))
+        if has_len:
+            out = jnp.where(overflow[:, None, None], jnp.nan, out)
         return (out.reshape(b_, h * d).astype(xa.dtype),
                 jnp.stack([kc, vc]))
 

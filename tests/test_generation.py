@@ -168,3 +168,44 @@ def test_masked_multihead_attention_rejects_full_cache():
         IF.masked_multihead_attention(
             paddle.to_tensor(x), cache_kv=paddle.to_tensor(cache),
             sequence_lengths=paddle.to_tensor(np.full((b, 1), m, np.int32)))
+
+
+def test_weight_updates_reflected_without_decoder_rebuild():
+    """Weights are a jit ARGUMENT, not a capture: after an update the same
+    compiled decoder must produce the new model's tokens (and no stale
+    arrays are pinned by a rebuilt cache)."""
+    model = _model(seed=9)
+    rng = np.random.default_rng(9)
+    ids = rng.integers(0, 61, (1, 6)).astype(np.int32)
+    a, _ = model.generate(paddle.to_tensor(ids), max_new_tokens=4)
+    dec_before = model.__dict__["_decode_cache"]
+    # perturb one projection hard enough to change the argmax path
+    w = model.model.layers[0].self_attn.q_proj.weight
+    w._data = w._data + 0.5
+    b, _ = model.generate(paddle.to_tensor(ids), max_new_tokens=4)
+    assert model.__dict__["_decode_cache"] is dec_before   # no rebuild
+    want = _greedy_oracle(model, ids, 4)
+    np.testing.assert_array_equal(b.numpy(), want)
+    assert not np.array_equal(a.numpy(), b.numpy())
+
+
+def test_masked_multihead_attention_traced_overflow_is_nan():
+    """Under tracing the full-cache guard cannot raise; the overflowed
+    row's output must be NaN-poisoned, never silently wrong."""
+    import jax
+    import paddle_tpu.incubate.nn.functional as IF
+
+    b, h, m, d = 2, 2, 4, 8
+    cache = jnp.zeros((2, b, h, m, d), jnp.float32)
+    x = jnp.ones((b, 3 * h * d), jnp.float32)
+    lens = jnp.array([[2], [m]], jnp.int32)       # row 1 overflows
+
+    def f(x_, cache_, lens_):
+        out, _ = IF.masked_multihead_attention(
+            paddle.to_tensor(x_), cache_kv=paddle.to_tensor(cache_),
+            sequence_lengths=paddle.to_tensor(lens_))
+        return out._data
+
+    out = jax.jit(f)(x, cache, lens)
+    assert np.isfinite(np.asarray(out[0])).all()
+    assert np.isnan(np.asarray(out[1])).all()
