@@ -271,7 +271,7 @@ def _run_probe(extend=None):
             _np.random.default_rng(0).integers(0, 32000, (4, 128))
             .astype(_np.int32))
         new_toks = 128
-        short = 8
+        short = 64
         for n in (short, new_toks):          # compile both signatures
             out, _ = model.generate(ids, max_new_tokens=n)
             barrier(out._data)
@@ -283,12 +283,14 @@ def _run_probe(extend=None):
         out, _ = model.generate(ids, max_new_tokens=new_toks)
         barrier(out._data)
         dt = _t.perf_counter() - t0
-        # the two runs share the prefill; their difference isolates the
-        # per-decode-step cost
+        # difference quotient APPROXIMATES per-step cost: the two runs
+        # share the same prompt but allocate caches of 192 vs 256 slots,
+        # so their prefill/step costs differ slightly — the e2e number is
+        # the exact headline, the step estimate is labeled approx
         ms_step = (dt - dt_short) / (new_toks - short) * 1e3
         return {"batch": 4, "new_tokens": new_toks,
                 "e2e_tok_per_s": round(4 * new_toks / dt, 1),
-                "decode_ms_per_step": round(ms_step, 2)}
+                "approx_decode_ms_per_step": round(ms_step, 2)}
 
     def mem_probe():
         try:

@@ -103,6 +103,7 @@ class _LlamaDecoder:
         self.eps = cfg.rms_norm_eps
         self.n_layers = cfg.num_hidden_layers
         self.tied = model.lm_head is None
+        self.embed_key = "model.embed_tokens.weight"
 
     @staticmethod
     def weights(model):
@@ -178,6 +179,78 @@ class _LlamaDecoder:
         return self._logits(w, h), jnp.stack(new_k), jnp.stack(new_v)
 
 
+
+
+def _ln(x, w, b, eps):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean((x32 - mu) ** 2, axis=-1, keepdims=True)
+    return ((x32 - mu) * jax.lax.rsqrt(var + eps) * w.astype(jnp.float32)
+            + b.astype(jnp.float32)).astype(x.dtype)
+
+
+class _GPTDecoder:
+    """Pure decode functions over a DENSE GPTForCausalLM state dict
+    (pre-LN GPT-2: learned positions, fused-qkv biases, erf GELU). MoE
+    blocks are rejected loudly — expert dispatch per decode step is a
+    different machine."""
+
+    def __init__(self, model):
+        cfg = model.config
+        if any(getattr(blk, "is_moe", False) for blk in model.transformer.h):
+            raise NotImplementedError(
+                "generate() supports dense GPT blocks only; MoE decode "
+                "(per-step expert dispatch) is not implemented")
+        self.cfg = cfg
+        self.n_heads = cfg.num_attention_heads
+        self.n_kv = self.n_heads
+        self.hd = cfg.hidden_size // self.n_heads
+        self.eps = cfg.layer_norm_epsilon
+        self.n_layers = cfg.num_hidden_layers
+        self.tied = model.lm_head is None
+        self.embed_key = "transformer.wte.weight"
+
+    @staticmethod
+    def weights(model):
+        return {n: t._data for n, t in model.named_state().items()}
+
+    def _layer(self, w, i, h, kc, vc, write_pos, score_mask):
+        p = f"transformer.h.{i}."
+        b, s, _ = h.shape
+        x = _ln(h, w[p + "ln_1.weight"], w[p + "ln_1.bias"], self.eps)
+        qkv = (x @ w[p + "attn.qkv_proj.weight"]
+               + w[p + "attn.qkv_proj.bias"]) \
+            .reshape(b, s, 3, self.n_heads, self.hd)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        kc = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype),
+                                          (0, write_pos, 0, 0))
+        vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype),
+                                          (0, write_pos, 0, 0))
+        att = _attend(q, kc, vc, score_mask).reshape(b, s, -1)
+        h = h + att @ w[p + "attn.out_proj.weight"] \
+            + w[p + "attn.out_proj.bias"]
+        x2 = _ln(h, w[p + "ln_2.weight"], w[p + "ln_2.bias"], self.eps)
+        m = jax.nn.gelu((x2 @ w[p + "mlp.fc_in.weight"]
+                         + w[p + "mlp.fc_in.bias"]).astype(jnp.float32),
+                        approximate=False).astype(h.dtype)
+        h = h + m @ w[p + "mlp.fc_out.weight"] + w[p + "mlp.fc_out.bias"]
+        return h, kc, vc
+
+    def step(self, w, tokens, positions, kcs, vcs, write_pos, score_mask):
+        wte = w["transformer.wte.weight"]
+        h = wte[tokens] + w["transformer.wpe.weight"][positions]
+        new_k, new_v = [], []
+        for i in range(self.n_layers):
+            h, kc, vc = self._layer(w, i, h, kcs[i], vcs[i], write_pos,
+                                    score_mask)
+            new_k.append(kc)
+            new_v.append(vc)
+        h = _ln(h, w["transformer.ln_f.weight"], w["transformer.ln_f.bias"],
+                self.eps)
+        logits = h @ wte.T if self.tied else h @ w["lm_head.weight"]
+        return logits, jnp.stack(new_k), jnp.stack(new_v)
+
+
 # -- sampling ------------------------------------------------------------------
 
 def _sample(logits, key, do_sample, temperature, top_k, top_p):
@@ -213,7 +286,7 @@ def _generate_impl(dec: "_LlamaDecoder", w, ids, mask, key, max_new,
     positions = jnp.maximum(
         jnp.cumsum(mask, axis=1).astype(jnp.int32) - 1, 0)   # [B, S]
     kcs = jnp.zeros((dec.n_layers, b, m_total, dec.n_kv, dec.hd),
-                    w["model.embed_tokens.weight"].dtype)
+                    w[dec.embed_key].dtype)
     vcs = jnp.zeros_like(kcs)
 
     # prefill: causal over the prompt, padding hidden
@@ -288,7 +361,7 @@ def generate(model, input_ids, attention_mask=None, max_new_tokens: int = 32,
         key = next_key()
     has_eos = eos_token_id is not None
     toks, finished = dec._jit(
-        _LlamaDecoder.weights(model), ids, mask, key, int(max_new_tokens),
+        dec.weights(model), ids, mask, key, int(max_new_tokens),
         bool(do_sample), float(temperature),
         jnp.int32(eos_token_id if has_eos else 0), has_eos, int(top_k),
         float(top_p))
@@ -301,9 +374,14 @@ def _decoder_for(model):
     Weights are passed as a jit ARGUMENT on every call — never captured —
     so weight updates need no invalidation and old arrays are never
     pinned; the executable retraces only if shapes/dtypes change."""
-    dec = model.__dict__.get("_decode_cache")
-    if dec is None:
-        dec = _LlamaDecoder(model)
+    from .models.gpt import GPTForCausalLM
+    cls = _GPTDecoder if isinstance(model, GPTForCausalLM) \
+        else _LlamaDecoder
+    struct = (cls, model.lm_head is None)   # head tying is baked into the
+    dec = model.__dict__.get("_decode_cache")   # traced logits branch
+    if dec is None or dec._struct != struct:
+        dec = cls(model)
+        dec._struct = struct
         # arg indices (after the partial binds dec): w=0, ids=1, mask=2,
         # key=3, max_new=4, do_sample=5, temperature=6, eos_id=7,
         # has_eos=8, top_k=9, top_p=10

@@ -170,6 +170,12 @@ class GPTForCausalLM(nn.Layer):
             return matmul(h, self.transformer.wte.weight, transpose_y=True)
         return self.lm_head(h)
 
+    def generate(self, input_ids, attention_mask=None, **kwargs):
+        """KV-cached decoding (dense blocks only; see generation.py)."""
+        from ..generation import generate
+        return generate(self, input_ids, attention_mask=attention_mask,
+                        **kwargs)
+
     def aux_loss(self):
         """Sum of MoE load-balance losses from the last forward (scaled)."""
         total = None

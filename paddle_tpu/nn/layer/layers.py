@@ -66,6 +66,9 @@ class Layer:
             for store in (layers, buffers):
                 if store is not None:
                     store.pop(name, None)
+            # a stale plain attribute (e.g. `self.p = None` at build time)
+            # would shadow the store in attribute lookup
+            self.__dict__.pop(name, None)
             params[name] = value
         elif isinstance(value, Layer):
             if layers is None:
@@ -73,6 +76,7 @@ class Layer:
             for store in (params, buffers):
                 if store is not None:
                     store.pop(name, None)
+            self.__dict__.pop(name, None)
             layers[name] = value
         else:
             if params is not None and name in params:

@@ -209,3 +209,47 @@ def test_masked_multihead_attention_traced_overflow_is_nan():
     out = jax.jit(f)(x, cache, lens)
     assert np.isfinite(np.asarray(out[0])).all()
     assert np.isnan(np.asarray(out[1])).all()
+
+
+def test_gpt_greedy_generate_matches_full_recompute():
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    paddle.seed(12)
+    cfg = GPTConfig.tiny(vocab_size=53, hidden_size=32, layers=2, heads=4,
+                         seq=64, num_experts=0)
+    model = GPTForCausalLM(cfg)
+    rng = np.random.default_rng(12)
+    ids = rng.integers(0, 53, (2, 6)).astype(np.int32)
+    want = _greedy_oracle(model, ids, 3)
+    got, _ = model.generate(paddle.to_tensor(ids), max_new_tokens=3)
+    np.testing.assert_array_equal(got.numpy(), want)
+
+
+def test_gpt_moe_generate_rejected():
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    paddle.seed(13)
+    cfg = GPTConfig.tiny(vocab_size=53, hidden_size=32, layers=2, heads=4,
+                         seq=64, num_experts=2, moe_every=1)
+    model = GPTForCausalLM(cfg)
+    ids = np.zeros((1, 4), np.int32)
+    with pytest.raises(NotImplementedError, match="MoE decode"):
+        model.generate(paddle.to_tensor(ids), max_new_tokens=2)
+
+
+def test_untying_head_rebuilds_decoder():
+    """Head tying is baked into the traced logits branch: changing it must
+    rebuild the decoder, not silently keep the old branch."""
+    import paddle_tpu.nn as nn
+
+    model = _model(tied=True, seed=15)
+    rng = np.random.default_rng(15)
+    ids = rng.integers(0, 61, (1, 5)).astype(np.int32)
+    model.generate(paddle.to_tensor(ids), max_new_tokens=2)
+    dec_tied = model.__dict__["_decode_cache"]
+    paddle.seed(99)
+    model.lm_head = nn.Linear(32, 61, bias_attr=False)   # untie
+    got, _ = model.generate(paddle.to_tensor(ids), max_new_tokens=3)
+    assert model.__dict__["_decode_cache"] is not dec_tied
+    want = _greedy_oracle(model, ids, 3)
+    np.testing.assert_array_equal(got.numpy(), want)
