@@ -156,7 +156,7 @@ class _LlamaDecoder:
         return h, kc, vc
 
     def _logits(self, w, h):
-        emb = w["model.embed_tokens.weight"]
+        emb = w[self.embed_key]
         h = _rms(h, w["model.norm.weight"], self.eps)
         if self.tied:
             return h @ emb.T
@@ -166,7 +166,7 @@ class _LlamaDecoder:
         """tokens: [B, S] int; positions: [B, S] int (rope positions);
         kcs/vcs: [L, B, M, kvh, hd]; score_mask: [B, 1, S, M].
         Returns (logits [B, S, V], kcs', vcs')."""
-        emb = w["model.embed_tokens.weight"]
+        emb = w[self.embed_key]
         h = emb[tokens]
         cos = w["__rope_cos"][positions]      # [B, S, hd/2]
         sin = w["__rope_sin"][positions]

@@ -66,9 +66,7 @@ class Layer:
             for store in (layers, buffers):
                 if store is not None:
                     store.pop(name, None)
-            # a stale plain attribute (e.g. `self.p = None` at build time)
-            # would shadow the store in attribute lookup
-            self.__dict__.pop(name, None)
+            self._unshadow(name)
             params[name] = value
         elif isinstance(value, Layer):
             if layers is None:
@@ -76,7 +74,7 @@ class Layer:
             for store in (params, buffers):
                 if store is not None:
                     store.pop(name, None)
-            self.__dict__.pop(name, None)
+            self._unshadow(name)
             layers[name] = value
         else:
             if params is not None and name in params:
@@ -112,16 +110,24 @@ class Layer:
         base = list(super().__dir__())
         return base + list(self._parameters) + list(self._sub_layers) + list(self._buffers)
 
+    def _unshadow(self, name: str):
+        # a stale plain attribute (e.g. `self.x = None` at build time)
+        # would win attribute lookup over the registration stores
+        self.__dict__.pop(str(name), None)
+
     def add_sublayer(self, name: str, sublayer: "Layer") -> "Layer":
+        self._unshadow(name)
         self._sub_layers[str(name)] = sublayer
         return sublayer
 
     def add_parameter(self, name: str, parameter: Optional[Parameter]):
+        self._unshadow(name)
         self._parameters[str(name)] = parameter
         return parameter
 
     def register_buffer(self, name: str, tensor: Optional[Tensor],
                         persistable: bool = True):
+        self._unshadow(name)
         self._buffers[str(name)] = tensor
         if not persistable:
             self._non_persistable_buffer_names.add(str(name))
