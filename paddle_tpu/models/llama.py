@@ -144,7 +144,8 @@ class LlamaAttention(nn.Layer):
         self.o_proj = Row(self.num_heads * self.head_dim, self.hidden_size,
                           has_bias=False)
 
-    def forward(self, hidden_states, rope_cache, attention_mask=None):
+    def forward(self, hidden_states, rope_cache, attention_mask=None,
+                startend_row_indices=None):
         b, s, _ = hidden_states.shape
         q = self.q_proj(hidden_states).reshape([b, s, self.num_heads,
                                                 self.head_dim])
@@ -154,12 +155,27 @@ class LlamaAttention(nn.Layer):
                                                 self.head_dim])
         cos, sin = rope_cache
         q, k = fused_rope(q, k, cos, sin)
+        if startend_row_indices is not None:
+            if attention_mask is not None:
+                raise NotImplementedError(
+                    "attention_mask cannot be combined with "
+                    "attn_startend_row_indices; fold padding into the "
+                    "column bounds (a padded key column is a fully-masked "
+                    "band)")
+            # packed-document / sparse-mask attention: O(S) column bounds
+            # instead of a dense mask (reference PaddleNLP flashmask
+            # integration over flash_attention.py:1299); GQA handled inside
+            return self.o_proj(F.flashmask_attention(
+                q, k, v, startend_row_indices, causal=True)
+                .reshape([b, s, self.num_heads * self.head_dim]))
         if self.num_kv_heads != self.num_heads:
             rep = self.num_heads // self.num_kv_heads
             from ..ops.manipulation import repeat_interleave
             k = repeat_interleave(k, rep, axis=2)
             v = repeat_interleave(v, rep, axis=2)
-        out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attention_mask, is_causal=True,
+            allow_flash=self.config.use_flash_attention)
         out = out.reshape([b, s, self.num_heads * self.head_dim])
         return self.o_proj(out)
 
@@ -191,10 +207,12 @@ class LlamaDecoderLayer(nn.Layer):
         self.post_attention_layernorm = nn.RMSNorm(config.hidden_size,
                                                    epsilon=config.rms_norm_eps)
 
-    def forward(self, hidden_states, rope_cache, attention_mask=None):
+    def forward(self, hidden_states, rope_cache, attention_mask=None,
+                startend_row_indices=None):
         residual = hidden_states
         h = self.input_layernorm(hidden_states)
-        h = self.self_attn(h, rope_cache, attention_mask)
+        h = self.self_attn(h, rope_cache, attention_mask,
+                           startend_row_indices)
         h = residual + h
         residual = h
         h2 = self.post_attention_layernorm(h)
@@ -217,7 +235,8 @@ class LlamaModel(nn.Layer):
         self.register_buffer("rope_cos", Tensor(cos), persistable=False)
         self.register_buffer("rope_sin", Tensor(sin), persistable=False)
 
-    def forward(self, input_ids, attention_mask=None):
+    def forward(self, input_ids, attention_mask=None,
+                attn_startend_row_indices=None):
         h = self.embed_tokens(input_ids)
         if self.config.sequence_parallel:
             # Megatron-SP: activations between blocks live seq-sharded over mp
@@ -228,17 +247,20 @@ class LlamaModel(nn.Layer):
         sin = Tensor(self.rope_sin._data[:s])
         run_blocks = getattr(self, "_pp_run_blocks", None)
         if run_blocks is not None:
-            if attention_mask is not None:
+            if attention_mask is not None or \
+                    attn_startend_row_indices is not None:
                 raise NotImplementedError(
-                    "attention_mask is not threaded through the pipelined "
-                    "block region yet (causal masking only); pad with "
-                    "ignore_index labels instead")
+                    "attention_mask / attn_startend_row_indices are not "
+                    "threaded through the pipelined block region yet "
+                    "(causal masking only); pad with ignore_index labels "
+                    "instead")
             # pipeline-parallel trace: the trainer replaces the block loop
             # with the compiled circular-pipeline region
             h = Tensor(run_blocks(h._data, cos._data, sin._data))
         else:
             for layer in self.layers:
-                h = layer(h, (cos, sin), attention_mask)
+                h = layer(h, (cos, sin), attention_mask,
+                          attn_startend_row_indices)
         return self.norm(h)
 
 
@@ -254,8 +276,15 @@ class LlamaForCausalLM(nn.Layer):
             self.lm_head = Col(config.hidden_size, config.vocab_size,
                                has_bias=False)
 
-    def forward(self, input_ids, attention_mask=None):
-        h = self.model(input_ids, attention_mask)
+    def forward(self, input_ids, attention_mask=None,
+                attn_startend_row_indices=None):
+        """attn_startend_row_indices: FlashMask column bounds
+        [B, KH, S, {1, 2}] (causal forms: LTS, or LTS+LTE) for packed-
+        document / sparse-mask attention (reference flashmask_attention,
+        flash_attention.py:1299). Mutually exclusive with
+        attention_mask."""
+        h = self.model(input_ids, attention_mask,
+                       attn_startend_row_indices)
         if self.lm_head is None:
             from ..ops.linalg import matmul
             return matmul(h, self.model.embed_tokens.weight, transpose_y=True)
@@ -278,7 +307,7 @@ class LlamaForCausalLM(nn.Layer):
                                reshape(shift_labels, [b * (s - 1)]))
 
     def forward_loss(self, input_ids, labels, loss_chunk_size=None,
-                     attention_mask=None):
+                     attention_mask=None, attn_startend_row_indices=None):
         """Trunk forward + shifted CE without materializing full logits.
 
         With loss_chunk_size=c, the head matmul + softmax run per sequence
@@ -288,8 +317,11 @@ class LlamaForCausalLM(nn.Layer):
         chip. Numerics identical to compute_loss(self(ids), labels).
         """
         if loss_chunk_size is None:
-            return self.compute_loss(self(input_ids, attention_mask), labels)
-        h = self.model(input_ids, attention_mask)
+            return self.compute_loss(
+                self(input_ids, attention_mask,
+                     attn_startend_row_indices), labels)
+        h = self.model(input_ids, attention_mask,
+                       attn_startend_row_indices)
         tied = self.lm_head is None
         w = (self.model.embed_tokens.weight if tied
              else self.lm_head.weight)  # tied: [V, H]; head: [H, V]

@@ -45,14 +45,16 @@ def _sdpa_reference(q, k, v, mask=None, dropout_p=0.0, causal=False,
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False, training=True,
-                                 name=None):
+                                 name=None, allow_flash=True):
     """Parity: paddle.nn.functional.scaled_dot_product_attention.
 
-    Layout [batch, seq, num_heads, head_dim]. Uses the Pallas flash kernel on TPU
-    for the mask-free case, XLA reference path otherwise.
+    Layout [batch, seq, num_heads, head_dim]. Uses the Pallas flash kernel
+    on TPU for the mask-free case, XLA reference path otherwise.
+    allow_flash=False (an additive knob; model configs' use_flash_attention
+    routes here) forces the XLA path even where the kernel would fit.
     """
     qt, kt, vt = ensure_tensor(query), ensure_tensor(key), ensure_tensor(value)
-    use_flash = attn_mask is None and dropout_p == 0.0
+    use_flash = attn_mask is None and dropout_p == 0.0 and allow_flash
     if use_flash:
         # Context parallelism: sequence sharded over the sep axis -> ring
         # attention (explicit KV rotation over ICI) instead of letting GSPMD
