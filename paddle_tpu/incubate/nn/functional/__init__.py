@@ -1,6 +1,7 @@
 """Fused incubate functionals (parity: python/paddle/incubate/nn/functional/)."""
 from .fused_moe import fused_moe  # noqa: F401
 from .fused_ops import (  # noqa: F401
+    block_multihead_attention,
     fused_bias_act, fused_dropout_add, fused_layer_norm, fused_linear,
     fused_linear_activation, fused_matmul_bias,
     fused_rotary_position_embedding, fused_rms_norm,

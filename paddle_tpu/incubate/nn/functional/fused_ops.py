@@ -13,6 +13,7 @@ CUDA fusion kernels.
 """
 from __future__ import annotations
 
+import numpy as np
 import math
 
 import jax
@@ -26,7 +27,7 @@ __all__ = ["fused_rotary_position_embedding", "fused_layer_norm",
            "fused_rms_norm", "fused_dropout_add", "fused_matmul_bias",
            "fused_linear", "fused_linear_activation", "fused_bias_act",
            "swiglu", "variable_length_memory_efficient_attention",
-           "masked_multihead_attention"]
+           "masked_multihead_attention", "block_multihead_attention"]
 
 
 def _rope_rotate(x, cos, sin, neox):
@@ -477,3 +478,141 @@ def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
 
     res = dispatch("masked_multihead_attention", fwd, *args)
     return res
+
+
+def block_multihead_attention(qkv, key_cache, value_cache,
+                              seq_lens_encoder=None, seq_lens_decoder=None,
+                              seq_lens_this_time=None, padding_offsets=None,
+                              cum_offsets=None, cu_seqlens_q=None,
+                              cu_seqlens_k=None, block_tables=None,
+                              pre_key_cache=None, pre_value_cache=None,
+                              cache_k_quant_scales=None,
+                              cache_v_quant_scales=None,
+                              cache_k_dequant_scales=None,
+                              cache_v_dequant_scales=None,
+                              qkv_out_scale=None, qkv_bias=None,
+                              out_shift=None, out_smooth=None,
+                              max_enc_len_this_time=None,
+                              max_dec_len_this_time=None, rope_emb=None,
+                              mask=None, tgt_mask=None, max_seq_len=-1,
+                              block_size=64, use_dynamic_cachekv_quant=False,
+                              quant_max_bound=127.0, rope_theta=10000.0):
+    """Paged-KV decode attention (parity surface:
+    paddle.incubate.nn.functional.block_multihead_attention /
+    block_multi_head_attention_kernel.cu — the PagedAttention-style serving
+    kernel). DECODE mode core: one new token per sequence against
+    block-pooled caches.
+
+    qkv: [B, 3*H*D]; key_cache/value_cache: [max_blocks, H, block_size, D]
+    global pools; block_tables: [B, max_blocks_per_seq] int32 page ids (-1
+    for unassigned); seq_lens_decoder: [B, 1] tokens already cached per
+    row. Returns (out [B, H*D], qkv, key_cache', value_cache') like the
+    reference (its caches are updated in place; here the updated pools are
+    returned).
+
+    TPU-native: the page gather is a jnp take over the block table (XLA
+    lowers to dynamic-gather) and the step write is a scatter into the
+    row's current page — O(used pages) work, no contiguous max_seq_len
+    cache. The prefill/encoder path and the quant/rope/smooth extras are
+    rejected loudly (paddle_tpu.generation owns full loops; rope belongs
+    before the qkv pack)."""
+    for name, v_ in (("pre_key_cache", pre_key_cache),
+                     ("pre_value_cache", pre_value_cache),
+                     ("cache_k_quant_scales", cache_k_quant_scales),
+                     ("cache_v_quant_scales", cache_v_quant_scales),
+                     ("cache_k_dequant_scales", cache_k_dequant_scales),
+                     ("cache_v_dequant_scales", cache_v_dequant_scales),
+                     ("qkv_out_scale", qkv_out_scale),
+                     ("out_shift", out_shift), ("out_smooth", out_smooth),
+                     ("rope_emb", rope_emb), ("mask", mask),
+                     ("tgt_mask", tgt_mask)):
+        if v_ is not None:
+            raise NotImplementedError(
+                f"block_multihead_attention: {name} (quant/rope/mask "
+                "variants) is not supported; apply rope before the qkv "
+                "pack and fold masks into the page layout")
+    if block_tables is None or seq_lens_decoder is None:
+        raise ValueError("block_tables and seq_lens_decoder are required")
+    qkvt, kt, vt = (ensure_tensor(qkv), ensure_tensor(key_cache),
+                    ensure_tensor(value_cache))
+    bt = ensure_tensor(block_tables)
+    sl = ensure_tensor(seq_lens_decoder)
+    args = [qkvt, kt, vt, bt, sl]
+    if qkv_bias is not None:
+        args.append(ensure_tensor(qkv_bias))
+    has_bias = qkv_bias is not None
+    has_enc = seq_lens_encoder is not None
+    if has_enc:
+        enc_t = ensure_tensor(seq_lens_encoder)
+        if not isinstance(enc_t._data, jax.core.Tracer) and \
+                bool(jnp.any(enc_t._data > 0)):
+            raise NotImplementedError(
+                "block_multihead_attention: encoder (prefill) mode is not "
+                "implemented; prefill with paddle_tpu.generation and use "
+                "this op for decode steps")
+        args.append(enc_t)
+    # eager overflow/unassigned-page checks (traced rows NaN-poison below)
+    if not isinstance(sl._data, jax.core.Tracer) and \
+            not isinstance(bt._data, jax.core.Tracer):
+        lens_c = np.asarray(sl._data).reshape(-1)
+        tab_c = np.asarray(bt._data)
+        bs_ = int(block_size)
+        col = lens_c // bs_
+        if (col >= tab_c.shape[1]).any():
+            raise ValueError(
+                "block_multihead_attention: a sequence outgrew its block "
+                f"table ({tab_c.shape[1]} pages of {bs_}); allocate more "
+                "pages before decoding further")
+        if (np.take_along_axis(tab_c, col[:, None], 1)[:, 0] < 0).any():
+            raise ValueError(
+                "block_multihead_attention: the page for this step is "
+                "unassigned (block_tables entry is -1); allocate the page "
+                "first")
+
+    def fwd(x, kc, vc, tables, lens, *rest):
+        rest = list(rest)
+        b_ = x.shape[0]
+        nb, h, bs, d = kc.shape
+        mp = tables.shape[1]                   # max pages per sequence
+        qkv_ = x.reshape(b_, 3, h, d)
+        if has_bias:
+            qkv_ = qkv_ + rest.pop(0).reshape(1, 3, h, d)
+        q, k_new, v_new = qkv_[:, 0], qkv_[:, 1], qkv_[:, 2]   # [B, H, D]
+        lens = lens.reshape(b_).astype(jnp.int32)
+        # rows whose write would be invalid: column overflow, unassigned
+        # page, or (traced) prefill mode — their writes are dropped and
+        # their outputs NaN-poisoned (loud, never plausible-wrong)
+        col = jnp.clip(lens // bs, 0, mp - 1)
+        page_ix = jnp.take_along_axis(tables, col[:, None], axis=1)[:, 0]
+        bad = (lens // bs >= mp) | (page_ix < 0)
+        if has_enc:
+            bad = bad | (rest.pop(0).reshape(b_) > 0)
+        slot = lens % bs
+        # invalid rows write to index nb, a genuinely out-of-range page
+        # that mode="drop" discards (a raw -1 would WRAP to page nb-1 and
+        # clobber another sequence)
+        safe_ix = jnp.where(bad, nb, page_ix)
+        kc = kc.at[safe_ix, :, slot, :].set(k_new.astype(kc.dtype),
+                                            mode="drop")
+        vc = vc.at[safe_ix, :, slot, :].set(v_new.astype(vc.dtype),
+                                            mode="drop")
+        # ---- gather each row's pages and attend --------------------------
+        safe_tables = jnp.clip(tables, 0, nb - 1)               # [B, MP]
+        kpages = kc[safe_tables]          # [B, MP, H, bs, D]
+        vpages = vc[safe_tables]
+        kfull = kpages.transpose(0, 2, 1, 3, 4).reshape(b_, h, mp * bs, d)
+        vfull = vpages.transpose(0, 2, 1, 3, 4).reshape(b_, h, mp * bs, d)
+        scores = jnp.einsum("bhd,bhmd->bhm", q.astype(jnp.float32),
+                            kfull.astype(jnp.float32)) / math.sqrt(d)
+        pos = jnp.arange(mp * bs)[None, :]
+        live = pos <= lens[:, None]       # cached tokens + this step
+        valid_page = (tables >= 0)[:, :, None]                  # [B, MP, 1]
+        live = live & jnp.broadcast_to(valid_page,
+                                       (b_, mp, bs)).reshape(b_, mp * bs)
+        scores = jnp.where(live[:, None, :], scores, -1e30)
+        p = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bhm,bhmd->bhd", p, vfull.astype(jnp.float32))
+        out = jnp.where(bad[:, None, None], jnp.nan, out)
+        return (out.reshape(b_, h * d).astype(x.dtype), x, kc, vc)
+
+    return dispatch("block_multihead_attention", fwd, *args)

@@ -279,3 +279,107 @@ def test_generate_with_tp_sharded_weights_matches_serial():
     model.__dict__.pop("_decode_cache", None)    # fresh trace, sharded args
     got, _ = model.generate(paddle.to_tensor(ids), max_new_tokens=5)
     np.testing.assert_array_equal(got.numpy(), ref.numpy())
+
+
+def test_block_multihead_attention_matches_contiguous_cache():
+    """Paged decode attention (PagedAttention-style serving kernel,
+    reference block_multi_head_attention_kernel.cu): gathering each row's
+    pages through its block table must equal dense attention over the
+    logically-contiguous cache, and this step's K/V must land in the
+    right page slot."""
+    import paddle_tpu.incubate.nn.functional as IF
+
+    rng = np.random.default_rng(40)
+    b, h, d, bs, nb, mp = 2, 2, 8, 4, 10, 3   # pool of 10 pages, 3 per row
+    kpool = rng.standard_normal((nb, h, bs, d)).astype(np.float32)
+    vpool = rng.standard_normal((nb, h, bs, d)).astype(np.float32)
+    # row 0 owns pages [7, 2], row 1 owns [5, 0, 3]
+    tables = np.array([[7, 2, -1], [5, 0, 3]], np.int32)
+    lens = np.array([[5], [9]], np.int32)     # cached tokens per row
+    x = rng.standard_normal((b, 3 * h * d)).astype(np.float32)
+
+    out, _, kc2, vc2 = IF.block_multihead_attention(
+        paddle.to_tensor(x), paddle.to_tensor(kpool),
+        paddle.to_tensor(vpool), seq_lens_decoder=paddle.to_tensor(lens),
+        block_tables=paddle.to_tensor(tables), block_size=bs)
+
+    qkv = x.reshape(b, 3, h, d)
+    q, kn, vn = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+    kc2_np, vc2_np = kc2.numpy(), vc2.numpy()
+    for row in range(b):
+        ln = int(lens[row, 0])
+        page = tables[row, ln // bs]
+        slot = ln % bs
+        # the step write landed in the row's current page
+        np.testing.assert_allclose(kc2_np[page, :, slot], kn[row], rtol=1e-6)
+        np.testing.assert_allclose(vc2_np[page, :, slot], vn[row], rtol=1e-6)
+        # contiguous-cache oracle from the UPDATED pools
+        pages = [p for p in tables[row] if p >= 0]
+        kfull = np.concatenate([kc2_np[p].transpose(1, 0, 2)
+                                for p in pages])[:ln + 1]  # [T, H, D]
+        vfull = np.concatenate([vc2_np[p].transpose(1, 0, 2)
+                                for p in pages])[:ln + 1]
+        scores = np.einsum("hd,thd->ht", q[row], kfull) / np.sqrt(d)
+        pr = np.exp(scores - scores.max(-1, keepdims=True))
+        pr /= pr.sum(-1, keepdims=True)
+        want = np.einsum("ht,thd->hd", pr, vfull).reshape(h * d)
+        np.testing.assert_allclose(out.numpy()[row], want, rtol=1e-5,
+                                   atol=1e-5)
+
+
+def test_block_multihead_attention_rejects_prefill_and_quant():
+    import paddle_tpu.incubate.nn.functional as IF
+
+    b, h, d, bs = 1, 1, 4, 2
+    kpool = paddle.to_tensor(np.zeros((2, h, bs, d), np.float32))
+    x = paddle.to_tensor(np.zeros((b, 3 * h * d), np.float32))
+    tables = paddle.to_tensor(np.zeros((b, 1), np.int32))
+    lens = paddle.to_tensor(np.zeros((b, 1), np.int32))
+    with pytest.raises(NotImplementedError, match="prefill"):
+        IF.block_multihead_attention(
+            x, kpool, kpool,
+            seq_lens_encoder=paddle.to_tensor(np.ones((b, 1), np.int32)),
+            seq_lens_decoder=lens, block_tables=tables, block_size=bs)
+    with pytest.raises(NotImplementedError, match="quant"):
+        IF.block_multihead_attention(
+            x, kpool, kpool, seq_lens_decoder=lens, block_tables=tables,
+            block_size=bs,
+            cache_k_quant_scales=paddle.to_tensor(np.ones(1, np.float32)))
+
+
+def test_block_multihead_attention_guards():
+    """Page-boundary safety: an unassigned (-1) page or an outgrown block
+    table raises eagerly and NaN-poisons (write-dropped) under tracing —
+    never wraps into another sequence's pool page."""
+    import jax as _jax
+    import paddle_tpu.incubate.nn.functional as IF
+
+    b, h, d, bs, nb = 1, 1, 4, 2, 4
+    kpool = np.ones((nb, h, bs, d), np.float32)
+    x = np.ones((b, 3 * h * d), np.float32)
+    tables = np.array([[1, -1]], np.int32)
+    full = np.array([[2]], np.int32)          # page 0 full, next unassigned
+    kp = paddle.to_tensor(kpool)
+    with pytest.raises(ValueError, match="unassigned"):
+        IF.block_multihead_attention(
+            paddle.to_tensor(x), kp, kp,
+            seq_lens_decoder=paddle.to_tensor(full),
+            block_tables=paddle.to_tensor(tables), block_size=bs)
+    with pytest.raises(ValueError, match="outgrew"):
+        IF.block_multihead_attention(
+            paddle.to_tensor(x), kp, kp,
+            seq_lens_decoder=paddle.to_tensor(np.array([[4]], np.int32)),
+            block_tables=paddle.to_tensor(tables), block_size=bs)
+
+    # traced: same inputs NaN-poison the bad row, drop the write, and do
+    # NOT touch pool page nb-1 (the raw -1 wrap target)
+    def f(x_, kp_, lens_, tab_):
+        out, _, kc, _ = IF.block_multihead_attention(
+            paddle.to_tensor(x_), paddle.to_tensor(kp_),
+            paddle.to_tensor(kp_), seq_lens_decoder=paddle.to_tensor(lens_),
+            block_tables=paddle.to_tensor(tab_), block_size=bs)
+        return out._data, kc._data
+
+    out, kc = _jax.jit(f)(x, kpool, full, tables)
+    assert np.isnan(np.asarray(out)).all()
+    np.testing.assert_array_equal(np.asarray(kc), kpool)  # nothing written

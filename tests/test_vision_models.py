@@ -88,3 +88,11 @@ def test_googlenet_aux_heads():
     assert list(aux1.shape) == [1, 6] and list(aux2.shape) == [1, 6]
     g.eval()
     assert list(g(_x(hw=96)).shape) == [1, 6]
+
+
+@pytest.mark.slow
+def test_inception_v3_forward():
+    model = M.inception_v3(num_classes=6)
+    model.eval()
+    out = model(_x(hw=96))          # inception needs a larger input grid
+    assert list(out.shape) == [1, 6]
