@@ -277,19 +277,16 @@ def _sample(logits, key, do_sample, temperature, top_k, top_p):
 
 # -- public API ----------------------------------------------------------------
 
-def _generate_impl(dec: "_LlamaDecoder", w, ids, mask, key, max_new,
-                   do_sample, temperature, eos_id, has_eos, top_k, top_p):
+def _prefill(dec, w, ids, mask, max_new):
+    """Shared prefill: cache alloc, left-padded positions, key/pre masks,
+    and the prompt step. Returns (kcs, vcs, key_mask, last_logits)."""
     b, s = ids.shape
     m_total = s + max_new
-    lengths = jnp.sum(mask, axis=1).astype(jnp.int32)        # [B]
-    # left-padded: row positions start at 0 on the first REAL token
     positions = jnp.maximum(
         jnp.cumsum(mask, axis=1).astype(jnp.int32) - 1, 0)   # [B, S]
     kcs = jnp.zeros((dec.n_layers, b, m_total, dec.n_kv, dec.hd),
                     w[dec.embed_key].dtype)
     vcs = jnp.zeros_like(kcs)
-
-    # prefill: causal over the prompt, padding hidden
     t_idx = jnp.arange(m_total)[None, None, None, :]         # key slots
     q_idx = jnp.arange(s)[None, None, :, None]
     key_mask = jnp.concatenate(
@@ -297,7 +294,14 @@ def _generate_impl(dec: "_LlamaDecoder", w, ids, mask, key, max_new,
     pre_mask = (t_idx <= q_idx) & key_mask[:, None, None, :]
     logits, kcs, vcs = dec.step(w, ids, positions, kcs, vcs, 0, pre_mask)
     # left padding => the last REAL token sits at index s-1 for every row
-    last_logits = logits[:, -1]
+    return kcs, vcs, key_mask, logits[:, -1]
+
+
+def _generate_impl(dec: "_LlamaDecoder", w, ids, mask, key, max_new,
+                   do_sample, temperature, eos_id, has_eos, top_k, top_p):
+    b, s = ids.shape
+    lengths = jnp.sum(mask, axis=1).astype(jnp.int32)        # [B]
+    kcs, vcs, key_mask, last_logits = _prefill(dec, w, ids, mask, max_new)
 
     def body(t, carry):
         kcs, vcs, last_logits, key_mask, out, finished, key = carry
@@ -323,10 +327,103 @@ def _generate_impl(dec: "_LlamaDecoder", w, ids, mask, key, max_new,
     return carry[4], carry[5]
 
 
+
+
+def _beam_impl(dec, w, ids, mask, max_new, num_beams, eos_id, has_eos,
+               length_penalty):
+    """Greedy beam search sharing dec.step. Beams live as an expanded batch
+    [B*K, ...]; each step scores K*V continuations per row, keeps the top
+    K, and reorders the KV caches along the beam axis. Finished beams
+    persist by emitting exactly one eos continuation at their frozen
+    score. Returns the best beam per row by length-penalized score."""
+    b, s = ids.shape
+    k = num_beams
+    bk = b * k
+    rep = lambda a: jnp.repeat(a, k, axis=0)
+    ids_r, mask_r = rep(ids), rep(mask)
+    kcs, vcs, key_mask, last_logits = _prefill(dec, w, ids_r, mask_r,
+                                               max_new)
+    last_lp = jax.nn.log_softmax(last_logits.astype(jnp.float32), -1)
+
+    v = last_lp.shape[-1]
+    # beam 0 starts live, the rest at -inf so step 0 picks K distinct
+    # tokens from beam 0 (all beams are identical clones at this point)
+    scores0 = jnp.where(jnp.arange(k)[None, :] == 0, 0.0, NEG_INF)
+    scores0 = jnp.broadcast_to(scores0, (b, k))
+
+    def body(t, carry):
+        kcs, vcs, last_lp, key_mask, scores, out, finished = carry
+        lp = last_lp.reshape(b, k, v)
+        if has_eos:
+            # finished beams contribute ONE candidate (eos) at their
+            # frozen score; everything else from them is -inf
+            only_eos = jnp.where(jnp.arange(v)[None, None, :] == eos_id,
+                                 0.0, NEG_INF)
+            lp = jnp.where(finished.reshape(b, k)[:, :, None], only_eos, lp)
+        cand = scores[:, :, None] + lp                    # [B, K, V]
+        flat = cand.reshape(b, k * v)
+        top_sc, top_ix = jax.lax.top_k(flat, k)           # [B, K]
+        src_beam = (top_ix // v).astype(jnp.int32)        # [B, K]
+        tok = (top_ix % v).astype(jnp.int32)              # [B, K]
+
+        def reorder(a):
+            # a: [..., B*K, ...] with beam-major rows; gather along beams
+            shp = a.shape
+            ax = 1 if a.ndim > 3 else 0   # kcs/vcs: [L, BK, ...]; 2-d: BK
+            aa = jnp.moveaxis(a, ax, 0).reshape((b, k) + shp[:ax]
+                                                + shp[ax + 1:])
+            ga = jnp.take_along_axis(
+                aa, src_beam.reshape((b, k) + (1,) * (aa.ndim - 2)), axis=1)
+            return jnp.moveaxis(ga.reshape((bk,) + shp[:ax] + shp[ax + 1:]),
+                                0, ax)
+
+        kcs = reorder(kcs)
+        vcs = reorder(vcs)
+        # key_mask needs no reorder: all K beams of a row share the same
+        # prompt mask and every step sets the same column for all rows
+        out = jnp.take_along_axis(out, src_beam[:, :, None], axis=1)
+        out = out.at[:, :, t].set(tok)
+        if has_eos:
+            finished = jnp.take_along_axis(finished.reshape(b, k),
+                                           src_beam, axis=1)
+            finished = finished | (tok == eos_id)
+        scores = top_sc
+
+        write_pos = s + t
+        key_mask = key_mask.at[:, write_pos].set(True)
+        positions_t = (jnp.repeat(jnp.sum(mask, 1).astype(jnp.int32), k)
+                       + t)[:, None]
+        step_mask = key_mask[:, None, None, :]
+        logits, kcs, vcs = dec.step(w, tok.reshape(bk, 1), positions_t,
+                                    kcs, vcs, write_pos, step_mask)
+        last_lp = jax.nn.log_softmax(logits[:, 0].astype(jnp.float32), -1)
+        return kcs, vcs, last_lp, key_mask, scores, out, finished.reshape(
+            b, k) if has_eos else finished
+
+    out0 = jnp.zeros((b, k, max_new), jnp.int32)
+    fin0 = jnp.zeros((b, k), bool)
+    carry = (kcs, vcs, last_lp, key_mask, scores0, out0, fin0)
+    kcs, vcs, last_lp, key_mask, scores, out, finished = jax.lax.fori_loop(
+        0, max_new, body, carry)
+    # length-penalized best beam (finished beams' length = tokens to eos)
+    if has_eos:
+        first_eos = jnp.argmax(out == eos_id, axis=2)
+        has = jnp.any(out == eos_id, axis=2)
+        gen_len = jnp.where(has, first_eos + 1, max_new).astype(jnp.float32)
+    else:
+        gen_len = jnp.full((b, k), float(max_new), jnp.float32)
+    norm = scores / (gen_len ** length_penalty)
+    best = jnp.argmax(norm, axis=1)
+    tokens = jnp.take_along_axis(out, best[:, None, None], axis=1)[:, 0]
+    fin = jnp.take_along_axis(finished, best[:, None], axis=1)[:, 0]
+    return tokens, fin
+
+
 def generate(model, input_ids, attention_mask=None, max_new_tokens: int = 32,
              do_sample: bool = False, temperature: float = 1.0,
              top_k: int = 0, top_p: float = 1.0,
-             eos_token_id: Optional[int] = None, seed: Optional[int] = None):
+             eos_token_id: Optional[int] = None, seed: Optional[int] = None,
+             num_beams: int = 1, length_penalty: float = 1.0):
     """Greedy/sampled continuation of `input_ids` ([B, S] int, LEFT-padded
     for ragged batches with `attention_mask` [B, S] in {0,1}).
 
@@ -355,6 +452,22 @@ def generate(model, input_ids, attention_mask=None, max_new_tokens: int = 32,
             f"max_position_embeddings "
             f"{model.config.max_position_embeddings}")
     dec = _decoder_for(model)
+    has_eos_b = eos_token_id is not None
+    if num_beams > 1:
+        if do_sample:
+            raise NotImplementedError(
+                "beam search with sampling is not supported; use "
+                "do_sample=False (greedy beams) or num_beams=1")
+        jb = dec.__dict__.get("_jit_beam")
+        if jb is None:
+            jb = jax.jit(functools.partial(_beam_impl, dec),
+                         static_argnums=(3, 4, 6))
+            dec._jit_beam = jb
+        toks, fin = jb(dec.weights(model), ids, mask, int(max_new_tokens),
+                       int(num_beams),
+                       jnp.int32(eos_token_id if has_eos_b else 0),
+                       has_eos_b, jnp.float32(length_penalty))
+        return Tensor(toks), Tensor(fin)
     key = jax.random.PRNGKey(0 if seed is None else seed)
     if seed is None and do_sample:
         from .framework.random import next_key

@@ -513,9 +513,12 @@ def block_multihead_attention(qkv, key_cache, value_cache,
     TPU-native: the page gather is a jnp take over the block table (XLA
     lowers to dynamic-gather) and the step write is a scatter into the
     row's current page — O(used pages) work, no contiguous max_seq_len
-    cache. The prefill/encoder path and the quant/rope/smooth extras are
-    rejected loudly (paddle_tpu.generation owns full loops; rope belongs
-    before the qkv pack)."""
+    cache. The prefill/encoder path and the quant/rope/smooth/mask extras
+    are rejected loudly (paddle_tpu.generation owns full loops; rope
+    belongs before the qkv pack). The varlen packing metadata
+    (seq_lens_this_time / padding_offsets / cum_offsets / cu_seqlens_*,
+    required positionals in the reference) is accepted but unused: decode
+    mode is exactly one token per row."""
     for name, v_ in (("pre_key_cache", pre_key_cache),
                      ("pre_value_cache", pre_value_cache),
                      ("cache_k_quant_scales", cache_k_quant_scales),
@@ -531,10 +534,22 @@ def block_multihead_attention(qkv, key_cache, value_cache,
                 f"block_multihead_attention: {name} (quant/rope/mask "
                 "variants) is not supported; apply rope before the qkv "
                 "pack and fold masks into the page layout")
+    if use_dynamic_cachekv_quant:
+        raise NotImplementedError(
+            "block_multihead_attention: use_dynamic_cachekv_quant changes "
+            "the cache math and is not supported")
     if block_tables is None or seq_lens_decoder is None:
         raise ValueError("block_tables and seq_lens_decoder are required")
     qkvt, kt, vt = (ensure_tensor(qkv), ensure_tensor(key_cache),
                     ensure_tensor(value_cache))
+    # the cache layout is authoritative for the page size; a mismatched
+    # block_size parameter would silently skew every guard and slot index.
+    # -1 and 64 (the reference default) are treated as "unset".
+    bs_real = int(kt._data.shape[2])
+    if block_size not in (-1, 64) and block_size != bs_real:
+        raise ValueError(
+            f"block_size={block_size} does not match the cache page size "
+            f"{bs_real} (key_cache.shape[2], the authoritative layout)")
     bt = ensure_tensor(block_tables)
     sl = ensure_tensor(seq_lens_decoder)
     args = [qkvt, kt, vt, bt, sl]
@@ -556,7 +571,7 @@ def block_multihead_attention(qkv, key_cache, value_cache,
             not isinstance(bt._data, jax.core.Tracer):
         lens_c = np.asarray(sl._data).reshape(-1)
         tab_c = np.asarray(bt._data)
-        bs_ = int(block_size)
+        bs_ = bs_real
         col = lens_c // bs_
         if (col >= tab_c.shape[1]).any():
             raise ValueError(

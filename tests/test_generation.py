@@ -383,3 +383,85 @@ def test_block_multihead_attention_guards():
     out, kc = _jax.jit(f)(x, kpool, full, tables)
     assert np.isnan(np.asarray(out)).all()
     np.testing.assert_array_equal(np.asarray(kc), kpool)  # nothing written
+
+
+def _seq_logprob(model, ids, cont):
+    """Total log-prob of continuation `cont` given prompt `ids` under the
+    model (full recompute)."""
+    cur = np.concatenate([ids, cont[None]], axis=1)
+    logits = model(paddle.to_tensor(cur)).numpy().astype(np.float64)
+    lp = 0.0
+    for t, tok in enumerate(cont):
+        row = logits[0, ids.shape[1] - 1 + t]
+        row = row - row.max()
+        lp += row[tok] - np.log(np.exp(row).sum())
+    return lp
+
+
+def test_beam_search_never_worse_than_greedy():
+    model = _model(seed=41)
+    rng = np.random.default_rng(41)
+    ids = rng.integers(0, 61, (1, 6)).astype(np.int32)
+    greedy, _ = model.generate(paddle.to_tensor(ids), max_new_tokens=5)
+    beam, _ = model.generate(paddle.to_tensor(ids), max_new_tokens=5,
+                             num_beams=4)
+    lp_g = _seq_logprob(model, ids, greedy.numpy()[0])
+    lp_b = _seq_logprob(model, ids, beam.numpy()[0])
+    assert lp_b >= lp_g - 1e-6, (lp_b, lp_g)
+
+
+def test_beam_one_equals_greedy():
+    model = _model(seed=42)
+    rng = np.random.default_rng(42)
+    ids = rng.integers(0, 61, (2, 6)).astype(np.int32)
+    a, _ = model.generate(paddle.to_tensor(ids), max_new_tokens=4)
+    b, _ = model.generate(paddle.to_tensor(ids), max_new_tokens=4,
+                          num_beams=1)
+    np.testing.assert_array_equal(a.numpy(), b.numpy())
+
+
+def test_beam_search_eos_finishes_beams():
+    model = _model(seed=43)
+    rng = np.random.default_rng(43)
+    ids = rng.integers(0, 61, (1, 5)).astype(np.int32)
+    free, _ = model.generate(paddle.to_tensor(ids), max_new_tokens=6,
+                             num_beams=3)
+    eos = int(free.numpy()[0, 0])
+    got, fin = model.generate(paddle.to_tensor(ids), max_new_tokens=6,
+                              num_beams=3, eos_token_id=eos)
+    g = got.numpy()[0]
+    if (g == eos).any():
+        first = int(np.argmax(g == eos))
+        assert (g[first:] == eos).all()       # eos persists on the beam
+    assert fin.numpy().shape == (1,)
+
+
+def test_beam_sampling_rejected():
+    model = _model(seed=44)
+    ids = np.zeros((1, 4), np.int32)
+    with pytest.raises(NotImplementedError, match="beam search with samp"):
+        model.generate(paddle.to_tensor(ids), max_new_tokens=2, num_beams=2,
+                       do_sample=True)
+
+
+def test_block_multihead_attention_block_size_authority():
+    """The cache layout is authoritative: an explicit mismatching
+    block_size is rejected; -1/64 mean unset."""
+    import paddle_tpu.incubate.nn.functional as IF
+
+    b, h, d, bs = 1, 1, 4, 2
+    kp = paddle.to_tensor(np.zeros((2, h, bs, d), np.float32))
+    x = paddle.to_tensor(np.zeros((b, 3 * h * d), np.float32))
+    tables = paddle.to_tensor(np.zeros((b, 1), np.int32))
+    lens = paddle.to_tensor(np.zeros((b, 1), np.int32))
+    with pytest.raises(ValueError, match="does not match the cache page"):
+        IF.block_multihead_attention(x, kp, kp, seq_lens_decoder=lens,
+                                     block_tables=tables, block_size=8)
+    with pytest.raises(NotImplementedError, match="cachekv_quant"):
+        IF.block_multihead_attention(x, kp, kp, seq_lens_decoder=lens,
+                                     block_tables=tables, block_size=bs,
+                                     use_dynamic_cachekv_quant=True)
+    # default 64 is treated as unset: works with a 2-slot cache
+    out, _, _, _ = IF.block_multihead_attention(
+        x, kp, kp, seq_lens_decoder=lens, block_tables=tables)
+    assert np.isfinite(out.numpy()).all()
