@@ -465,3 +465,21 @@ def test_block_multihead_attention_block_size_authority():
     out, _, _, _ = IF.block_multihead_attention(
         x, kp, kp, seq_lens_decoder=lens, block_tables=tables)
     assert np.isfinite(out.numpy()).all()
+
+
+def test_gpt_beam_search_never_worse_than_greedy():
+    """The beam loop is decoder-agnostic: same property holds for GPT."""
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    paddle.seed(45)
+    cfg = GPTConfig.tiny(vocab_size=53, hidden_size=32, layers=2, heads=4,
+                         seq=64, num_experts=0)
+    model = GPTForCausalLM(cfg)
+    rng = np.random.default_rng(45)
+    ids = rng.integers(0, 53, (1, 6)).astype(np.int32)
+    greedy, _ = model.generate(paddle.to_tensor(ids), max_new_tokens=3)
+    beam, _ = model.generate(paddle.to_tensor(ids), max_new_tokens=3,
+                             num_beams=3)
+    lp_g = _seq_logprob(model, ids, greedy.numpy()[0])
+    lp_b = _seq_logprob(model, ids, beam.numpy()[0])
+    assert lp_b >= lp_g - 1e-6, (lp_b, lp_g)
