@@ -298,16 +298,29 @@ def _prefill(dec, w, ids, mask, max_new):
 
 
 def _generate_impl(dec: "_LlamaDecoder", w, ids, mask, key, max_new,
-                   do_sample, temperature, eos_id, has_eos, top_k, top_p):
+                   do_sample, temperature, eos_id, has_eos, top_k, top_p,
+                   rep_penalty, has_rep):
     b, s = ids.shape
     lengths = jnp.sum(mask, axis=1).astype(jnp.int32)        # [B]
     kcs, vcs, key_mask, last_logits = _prefill(dec, w, ids, mask, max_new)
+    v = last_logits.shape[-1]
+    # CTRL-style repetition penalty: tokens already seen (prompt or
+    # generated) have positive logits divided / negative multiplied by it.
+    # has_rep is STATIC: the neutral default traces to a program with no
+    # seen state and no per-step penalty passes on the decode hot path.
+    seen0 = jnp.zeros((b, v), bool).at[
+        jnp.arange(b)[:, None], ids].max(mask.astype(bool)) \
+        if has_rep else jnp.zeros((b, 1), bool)
 
     def body(t, carry):
-        kcs, vcs, last_logits, key_mask, out, finished, key = carry
+        kcs, vcs, last_logits, key_mask, out, finished, key, seen = carry
         key, k_step = jax.random.split(key)
-        tok = _sample(last_logits, k_step, do_sample, temperature, top_k,
-                      top_p)
+        lg = last_logits
+        if has_rep:
+            lg = lg.astype(jnp.float32)
+            lg = jnp.where(seen, jnp.where(lg > 0, lg / rep_penalty,
+                                           lg * rep_penalty), lg)
+        tok = _sample(lg, k_step, do_sample, temperature, top_k, top_p)
         if has_eos:
             tok = jnp.where(finished, eos_id, tok)
             finished = finished | (tok == eos_id)
@@ -316,13 +329,15 @@ def _generate_impl(dec: "_LlamaDecoder", w, ids, mask, key, max_new,
         key_mask = key_mask.at[:, write_pos].set(True)
         positions_t = (lengths + t)[:, None]                 # [B, 1]
         step_mask = key_mask[:, None, None, :]               # attend all real
+        if has_rep:
+            seen = seen.at[jnp.arange(b), tok].set(True)
         logits, kcs, vcs = dec.step(w, tok[:, None], positions_t, kcs,
                                     vcs, write_pos, step_mask)
-        return kcs, vcs, logits[:, 0], key_mask, out, finished, key
+        return kcs, vcs, logits[:, 0], key_mask, out, finished, key, seen
 
     out0 = jnp.zeros((b, max_new), jnp.int32)
     finished0 = jnp.zeros((b,), bool)
-    carry = (kcs, vcs, last_logits, key_mask, out0, finished0, key)
+    carry = (kcs, vcs, last_logits, key_mask, out0, finished0, key, seen0)
     carry = jax.lax.fori_loop(0, max_new, body, carry)
     return carry[4], carry[5]
 
@@ -423,7 +438,8 @@ def generate(model, input_ids, attention_mask=None, max_new_tokens: int = 32,
              do_sample: bool = False, temperature: float = 1.0,
              top_k: int = 0, top_p: float = 1.0,
              eos_token_id: Optional[int] = None, seed: Optional[int] = None,
-             num_beams: int = 1, length_penalty: float = 1.0):
+             num_beams: int = 1, length_penalty: float = 1.0,
+             repetition_penalty: float = 1.0):
     """Greedy/sampled continuation of `input_ids` ([B, S] int, LEFT-padded
     for ragged batches with `attention_mask` [B, S] in {0,1}).
 
@@ -458,6 +474,9 @@ def generate(model, input_ids, attention_mask=None, max_new_tokens: int = 32,
             raise NotImplementedError(
                 "beam search with sampling is not supported; use "
                 "do_sample=False (greedy beams) or num_beams=1")
+        if repetition_penalty != 1.0:
+            raise NotImplementedError(
+                "repetition_penalty under beam search is not supported")
         jb = dec.__dict__.get("_jit_beam")
         if jb is None:
             jb = jax.jit(functools.partial(_beam_impl, dec),
@@ -477,7 +496,8 @@ def generate(model, input_ids, attention_mask=None, max_new_tokens: int = 32,
         dec.weights(model), ids, mask, key, int(max_new_tokens),
         bool(do_sample), float(temperature),
         jnp.int32(eos_token_id if has_eos else 0), has_eos, int(top_k),
-        float(top_p))
+        float(top_p), jnp.float32(repetition_penalty),
+        repetition_penalty != 1.0)
     return Tensor(toks), Tensor(finished)
 
 
@@ -497,9 +517,9 @@ def _decoder_for(model):
         dec._struct = struct
         # arg indices (after the partial binds dec): w=0, ids=1, mask=2,
         # key=3, max_new=4, do_sample=5, temperature=6, eos_id=7,
-        # has_eos=8, top_k=9, top_p=10
+        # has_eos=8, top_k=9, top_p=10, rep_penalty=11, has_rep=12
         dec._jit = jax.jit(functools.partial(_generate_impl, dec),
-                           static_argnums=(4, 5, 8, 9, 10))
+                           static_argnums=(4, 5, 8, 9, 10, 12))
         model.__dict__["_decode_cache"] = dec
     return dec
 

@@ -483,3 +483,25 @@ def test_gpt_beam_search_never_worse_than_greedy():
     lp_g = _seq_logprob(model, ids, greedy.numpy()[0])
     lp_b = _seq_logprob(model, ids, beam.numpy()[0])
     assert lp_b >= lp_g - 1e-6, (lp_b, lp_g)
+
+
+def test_repetition_penalty_steers_away_from_seen_tokens():
+    model = _model(seed=46)
+    rng = np.random.default_rng(46)
+    ids = rng.integers(0, 61, (1, 6)).astype(np.int32)
+    base, _ = model.generate(paddle.to_tensor(ids), max_new_tokens=4)
+    pen, _ = model.generate(paddle.to_tensor(ids), max_new_tokens=4,
+                            repetition_penalty=1000.0)
+    # a huge penalty must keep the FIRST generated token out of the
+    # prompt's token set (unseen tokens are unpenalized)
+    assert pen.numpy()[0, 0] not in set(ids[0].tolist())
+    # and no token repeats within the penalized continuation
+    g = pen.numpy()[0]
+    assert len(set(g.tolist())) == len(g), g
+    # neutral penalty is the default path
+    neutral, _ = model.generate(paddle.to_tensor(ids), max_new_tokens=4,
+                                repetition_penalty=1.0)
+    np.testing.assert_array_equal(neutral.numpy(), base.numpy())
+    with pytest.raises(NotImplementedError, match="repetition_penalty"):
+        model.generate(paddle.to_tensor(ids), max_new_tokens=2, num_beams=2,
+                       repetition_penalty=2.0)
