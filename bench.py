@@ -256,6 +256,8 @@ def _run_probe(extend=None):
         return {"us": round(dt * 1e6, 1), "doc_len": doc,
                 "visible_frac": round(visible_frac, 4)}
 
+    decode_state = {}
+
     def decode_probe():
         # serving decode throughput: KV-cached generate() as one compiled
         # program on a small-but-real config (the inference-side headline
@@ -270,6 +272,8 @@ def _run_probe(extend=None):
         ids = paddle.to_tensor(
             _np.random.default_rng(0).integers(0, 32000, (4, 128))
             .astype(_np.int32))
+        decode_state["model"] = model
+        decode_state["ids"] = ids
         new_toks = 128
         short = 64
         for n in (short, new_toks):          # compile both signatures
@@ -292,7 +296,29 @@ def _run_probe(extend=None):
                 "e2e_tok_per_s": round(4 * new_toks / dt, 1),
                 "approx_decode_ms_per_step": round(ms_step, 2)}
 
+    def decode_int8_probe():
+        # weight-only int8 decode (reference weight_only_linear serving
+        # path): decode is HBM-bound on weight reads, so int8 should beat
+        # the bf16 e2e number above on the same model/prompt
+        model = decode_state.get("model")
+        if model is None:
+            raise RuntimeError("decode probe did not run")
+        ids = decode_state["ids"]
+        out, _ = model.generate(ids, max_new_tokens=128,
+                                quant="weight_only_int8")
+        barrier(out._data)
+        t0 = _t.perf_counter()
+        out, _ = model.generate(ids, max_new_tokens=128,
+                                quant="weight_only_int8")
+        barrier(out._data)
+        dt = _t.perf_counter() - t0
+        return {"batch": 4, "new_tokens": 128,
+                "e2e_tok_per_s": round(4 * 128 / dt, 1)}
+
     def mem_probe():
+        # drop the decode model/quant cache first: mem numbers must stay
+        # comparable with pre-decode-probe bench artifacts
+        decode_state.clear()
         try:
             stats = dev.memory_stats() or {}
             return {"bytes_limit": stats.get("bytes_limit"),
@@ -307,6 +333,7 @@ def _run_probe(extend=None):
     step("xla_attn", xla_attn_probe)
     step("fused", fused_probe)
     step("decode", decode_probe)
+    step("decode_int8", decode_int8_probe)
     step("mem", mem_probe)
     out["ok"] = out["steps"].get("matmul", {}).get("ok", False)
     return out

@@ -37,6 +37,75 @@ from .tensor import Tensor
 NEG_INF = -1e30
 
 
+# -- weight-only int8 (serving quantization) ----------------------------------
+#
+# Reference capability: ops.yaml `weight_quantize` / `weight_only_linear` —
+# the llm_int8 serving path. TPU-native design: the quantized matrix rides in
+# the weights pytree as two leaves (`name::q` int8 [in, out], `name::s` fp32
+# per-output-channel scale) and the matmul becomes (x @ q.astype(x.dtype)) * s.
+# XLA fuses the int8→bf16 convert into the dot's operand read, so the decode
+# loop — which is HBM-bandwidth-bound on every weight matrix — reads half the
+# bytes; the per-column scale is applied to the [B, S, out] result, which is
+# mathematically identical to scaling the matrix (sum_i x_i q_ij s_j).
+
+from .quantization._kernels import (int8_matmul_arrays as _int8_mm,
+                                    quantize_weight_arrays as _wq)
+
+
+def _quant_leaves(src, names, lm_from_embed=None):
+    """Quantize each 2-D matmul weight in `names` to ::q/::s leaves; when
+    `lm_from_embed` is set (tied head), add __lm::q/__lm::s from embed.T so
+    the [H, V] logits matmul also reads int8 while the embedding GATHER
+    keeps the original-precision table (gather reads B rows, not V*H)."""
+    leaves = {}
+    for n in names:
+        q, s = _wq(src[n])
+        leaves[n + "::q"] = q
+        leaves[n + "::s"] = s
+    if lm_from_embed is not None:
+        q, s = _wq(src[lm_from_embed].T)
+        leaves["__lm::q"] = q
+        leaves["__lm::s"] = s
+    return leaves
+
+
+def _mm(x, w, name):
+    """x @ weight, transparently reading the int8 form when present."""
+    q = w.get(name + "::q")
+    if q is None:
+        return x @ w[name]
+    return _int8_mm(x, q, w[name + "::s"])
+
+
+def _quant_weights_cached(dec, model, quant):
+    """Build the decode pytree: live fp leaves (re-read from the model on
+    EVERY call — norms/biases/embeddings are never cached) + int8/scale
+    leaves for the matmul weights, quantized once per weight snapshot.
+    The cache holds WEAKREFS to the source matmul arrays (invalidate when
+    a training step / load_dict swaps any of them) and strong refs ONLY
+    to the int8 copies — its payload, which persists until the next quant
+    generate; superseded fp arrays are never pinned."""
+    import weakref
+    src = dec.weights(model)
+    names, lm_key = dec.quant_plan()
+    watched = names if lm_key is None else [*names, lm_key]
+    cached = model.__dict__.get("_quant_weights_cache")
+    leaves = None
+    if cached is not None:
+        prev_refs, prev_leaves, prev_algo = cached
+        if prev_algo == quant and list(prev_refs) == watched and \
+                all(prev_refs[k]() is src[k] for k in watched):
+            leaves = prev_leaves
+    if leaves is None:
+        leaves = _quant_leaves(src, names, lm_from_embed=lm_key)
+        model.__dict__["_quant_weights_cache"] = (
+            {k: weakref.ref(src[k]) for k in watched}, leaves, quant)
+    drop = set(names)
+    w = {k: v for k, v in src.items() if k not in drop}
+    w.update(leaves)
+    return w
+
+
 # -- pure llama math over weight arrays ---------------------------------------
 
 def _rms(x, w, eps):
@@ -117,15 +186,29 @@ class _LlamaDecoder:
     def _lw(w, i, name):
         return w[f"model.layers.{i}.{name}"]
 
+    _QUANT_SUFFIXES = ("self_attn.q_proj.weight", "self_attn.k_proj.weight",
+                       "self_attn.v_proj.weight", "self_attn.o_proj.weight",
+                       "mlp.gate_proj.weight", "mlp.up_proj.weight",
+                       "mlp.down_proj.weight")
+
+    def quant_plan(self):
+        """(matmul weight names to quantize, tied-embed key or None)."""
+        names = [f"model.layers.{i}.{sfx}" for i in range(self.n_layers)
+                 for sfx in self._QUANT_SUFFIXES]
+        if not self.tied:
+            names.append("lm_head.weight")
+        return names, (self.embed_key if self.tied else None)
+
     def _layer(self, w, i, h, cos, sin, kc, vc, write_pos, score_mask):
         """One decoder layer with cache append; h: [B, S, H*D]."""
         b, s, _ = h.shape
+        pre = f"model.layers.{i}."
         x = _rms(h, self._lw(w, i, "input_layernorm.weight"), self.eps)
-        q = (x @ self._lw(w, i, "self_attn.q_proj.weight")) \
+        q = _mm(x, w, pre + "self_attn.q_proj.weight") \
             .reshape(b, s, self.n_heads, self.hd)
-        k = (x @ self._lw(w, i, "self_attn.k_proj.weight")) \
+        k = _mm(x, w, pre + "self_attn.k_proj.weight") \
             .reshape(b, s, self.n_kv, self.hd)
-        v = (x @ self._lw(w, i, "self_attn.v_proj.weight")) \
+        v = _mm(x, w, pre + "self_attn.v_proj.weight") \
             .reshape(b, s, self.n_kv, self.hd)
         q = _rope_rows(q, cos, sin)
         k = _rope_rows(k, cos, sin)
@@ -145,22 +228,23 @@ class _LlamaDecoder:
                 .reshape(b, s, -1)
         else:
             att = _attend(q, kc, vc, score_mask).reshape(b, s, -1)
-        h = h + att @ self._lw(w, i, "self_attn.o_proj.weight")
+        h = h + _mm(att, w, pre + "self_attn.o_proj.weight")
         x2 = _rms(h, self._lw(w, i, "post_attention_layernorm.weight"),
                   self.eps)
-        gate = x2 @ self._lw(w, i, "mlp.gate_proj.weight")
-        up = x2 @ self._lw(w, i, "mlp.up_proj.weight")
+        gate = _mm(x2, w, pre + "mlp.gate_proj.weight")
+        up = _mm(x2, w, pre + "mlp.up_proj.weight")
         swi = (jax.nn.silu(gate.astype(jnp.float32))
                .astype(up.dtype) * up)
-        h = h + swi @ self._lw(w, i, "mlp.down_proj.weight")
+        h = h + _mm(swi, w, pre + "mlp.down_proj.weight")
         return h, kc, vc
 
     def _logits(self, w, h):
-        emb = w[self.embed_key]
         h = _rms(h, w["model.norm.weight"], self.eps)
+        if "__lm::q" in w:
+            return _int8_mm(h, w["__lm::q"], w["__lm::s"])
         if self.tied:
-            return h @ emb.T
-        return h @ w["lm_head.weight"]
+            return h @ w[self.embed_key].T
+        return _mm(h, w, "lm_head.weight")
 
     def step(self, w, tokens, positions, kcs, vcs, write_pos, score_mask):
         """tokens: [B, S] int; positions: [B, S] int (rope positions);
@@ -214,11 +298,22 @@ class _GPTDecoder:
     def weights(model):
         return {n: t._data for n, t in model.named_state().items()}
 
+    _QUANT_SUFFIXES = ("attn.qkv_proj.weight", "attn.out_proj.weight",
+                       "mlp.fc_in.weight", "mlp.fc_out.weight")
+
+    def quant_plan(self):
+        """(matmul weight names to quantize, tied-embed key or None)."""
+        names = [f"transformer.h.{i}.{sfx}" for i in range(self.n_layers)
+                 for sfx in self._QUANT_SUFFIXES]
+        if not self.tied:
+            names.append("lm_head.weight")
+        return names, (self.embed_key if self.tied else None)
+
     def _layer(self, w, i, h, kc, vc, write_pos, score_mask):
         p = f"transformer.h.{i}."
         b, s, _ = h.shape
         x = _ln(h, w[p + "ln_1.weight"], w[p + "ln_1.bias"], self.eps)
-        qkv = (x @ w[p + "attn.qkv_proj.weight"]
+        qkv = (_mm(x, w, p + "attn.qkv_proj.weight")
                + w[p + "attn.qkv_proj.bias"]) \
             .reshape(b, s, 3, self.n_heads, self.hd)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
@@ -227,13 +322,13 @@ class _GPTDecoder:
         vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype),
                                           (0, write_pos, 0, 0))
         att = _attend(q, kc, vc, score_mask).reshape(b, s, -1)
-        h = h + att @ w[p + "attn.out_proj.weight"] \
+        h = h + _mm(att, w, p + "attn.out_proj.weight") \
             + w[p + "attn.out_proj.bias"]
         x2 = _ln(h, w[p + "ln_2.weight"], w[p + "ln_2.bias"], self.eps)
-        m = jax.nn.gelu((x2 @ w[p + "mlp.fc_in.weight"]
+        m = jax.nn.gelu((_mm(x2, w, p + "mlp.fc_in.weight")
                          + w[p + "mlp.fc_in.bias"]).astype(jnp.float32),
                         approximate=False).astype(h.dtype)
-        h = h + m @ w[p + "mlp.fc_out.weight"] + w[p + "mlp.fc_out.bias"]
+        h = h + _mm(m, w, p + "mlp.fc_out.weight") + w[p + "mlp.fc_out.bias"]
         return h, kc, vc
 
     def step(self, w, tokens, positions, kcs, vcs, write_pos, score_mask):
@@ -247,7 +342,12 @@ class _GPTDecoder:
             new_v.append(vc)
         h = _ln(h, w["transformer.ln_f.weight"], w["transformer.ln_f.bias"],
                 self.eps)
-        logits = h @ wte.T if self.tied else h @ w["lm_head.weight"]
+        if "__lm::q" in w:
+            logits = _int8_mm(h, w["__lm::q"], w["__lm::s"])
+        elif self.tied:
+            logits = h @ wte.T
+        else:
+            logits = _mm(h, w, "lm_head.weight")
         return logits, jnp.stack(new_k), jnp.stack(new_v)
 
 
@@ -439,13 +539,23 @@ def generate(model, input_ids, attention_mask=None, max_new_tokens: int = 32,
              top_k: int = 0, top_p: float = 1.0,
              eos_token_id: Optional[int] = None, seed: Optional[int] = None,
              num_beams: int = 1, length_penalty: float = 1.0,
-             repetition_penalty: float = 1.0):
+             repetition_penalty: float = 1.0,
+             quant: Optional[str] = None):
     """Greedy/sampled continuation of `input_ids` ([B, S] int, LEFT-padded
     for ragged batches with `attention_mask` [B, S] in {0,1}).
+
+    quant="weight_only_int8" decodes against per-channel int8 weight
+    matrices (reference weight_only_linear/llm_int8 serving capability) —
+    the quantized pytree is cached per weight snapshot and the dequant
+    folds into each matmul's operand read.
 
     Returns (tokens [B, max_new_tokens] Tensor, finished [B] Tensor) —
     rows that hit eos_token_id keep emitting eos. One compiled program per
     (batch, prompt_len, max_new_tokens, sampling-config) signature."""
+    if quant not in (None, "weight_only_int8"):
+        raise NotImplementedError(
+            f"generate(quant={quant!r}): only 'weight_only_int8' is "
+            "supported (int4 packing is not)")
     ids = input_ids._data if isinstance(input_ids, Tensor) \
         else jnp.asarray(input_ids)
     ids = ids.astype(jnp.int32)
@@ -468,6 +578,8 @@ def generate(model, input_ids, attention_mask=None, max_new_tokens: int = 32,
             f"max_position_embeddings "
             f"{model.config.max_position_embeddings}")
     dec = _decoder_for(model)
+    weights = (_quant_weights_cached(dec, model, quant) if quant
+               else dec.weights(model))
     has_eos_b = eos_token_id is not None
     if num_beams > 1:
         if do_sample:
@@ -482,7 +594,7 @@ def generate(model, input_ids, attention_mask=None, max_new_tokens: int = 32,
             jb = jax.jit(functools.partial(_beam_impl, dec),
                          static_argnums=(3, 4, 6))
             dec._jit_beam = jb
-        toks, fin = jb(dec.weights(model), ids, mask, int(max_new_tokens),
+        toks, fin = jb(weights, ids, mask, int(max_new_tokens),
                        int(num_beams),
                        jnp.int32(eos_token_id if has_eos_b else 0),
                        has_eos_b, jnp.float32(length_penalty))
@@ -493,7 +605,7 @@ def generate(model, input_ids, attention_mask=None, max_new_tokens: int = 32,
         key = next_key()
     has_eos = eos_token_id is not None
     toks, finished = dec._jit(
-        dec.weights(model), ids, mask, key, int(max_new_tokens),
+        weights, ids, mask, key, int(max_new_tokens),
         bool(do_sample), float(temperature),
         jnp.int32(eos_token_id if has_eos else 0), has_eos, int(top_k),
         float(top_p), jnp.float32(repetition_penalty),

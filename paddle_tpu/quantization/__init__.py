@@ -103,10 +103,8 @@ def weight_quantize(w, algo: str = "weight_only_int8"):
         raise NotImplementedError(
             f"weight_quantize algo={algo!r}: only weight_only_int8 is "
             "implemented (int4 packing is not)")
-    arr = ensure_tensor(w)._data
-    qmax = 127.0
-    scale = jnp.maximum(jnp.abs(arr).max(axis=0), 1e-8) / qmax
-    q = jnp.clip(jnp.round(arr / scale), -128, 127).astype(jnp.int8)
+    from ._kernels import quantize_weight_arrays
+    q, scale = quantize_weight_arrays(ensure_tensor(w)._data)
     return Tensor(q), Tensor(scale)
 
 
@@ -121,10 +119,21 @@ def weight_dequantize(w_int8, scale):
 def weight_only_linear(x, weight_int8, bias=None, weight_scale=None,
                        weight_dtype="int8"):
     """Parity: ops.yaml weight_only_linear / llm_int8_linear capability —
-    dequant folds into the matmul under XLA."""
-    from ..nn import functional as F
-    w = weight_dequantize(weight_int8, weight_scale)
-    return F.linear(ensure_tensor(x), w, bias)
+    the int8 bytes feed the dot directly (shared kernel with the serving
+    decode path); the per-channel scale lands on the output."""
+    from ._kernels import int8_matmul_arrays
+    xt = ensure_tensor(x)
+    q = ensure_tensor(weight_int8)
+    s = ensure_tensor(weight_scale)
+    if bias is None:
+        return dispatch("weight_only_linear", int8_matmul_arrays, xt, q, s)
+
+    def fwd(xa, qa, sa, ba):
+        y = int8_matmul_arrays(xa, qa, sa)
+        return y + ba.astype(y.dtype)
+
+    return dispatch("weight_only_linear", fwd, xt, q, s,
+                    ensure_tensor(bias))
 
 
 class BaseObserver:

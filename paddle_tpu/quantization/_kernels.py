@@ -1,0 +1,30 @@
+"""Raw-array weight-only int8 kernels shared by the quantization API
+(`weight_quantize`/`weight_only_linear`, reference ops.yaml) and the
+serving decode path (`paddle_tpu.generation`, quant="weight_only_int8").
+
+One implementation so the two surfaces cannot drift numerically. jax-only
+imports — safe for any module to import at load time.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def quantize_weight_arrays(arr):
+    """Per-output-channel symmetric int8 for a matmul weight used as
+    `x @ arr` ([in, out]): returns (q int8 [in, out], scale fp32 [out]).
+    The fp32 upcast makes bf16 weights quantize against the true channel
+    max instead of a bf16-rounded one."""
+    a32 = arr.astype(jnp.float32)
+    scale = jnp.maximum(jnp.abs(a32).max(axis=0), 1e-8) / 127.0
+    q = jnp.clip(jnp.round(a32 / scale), -128, 127).astype(jnp.int8)
+    return q, scale
+
+
+def int8_matmul_arrays(x, q, s):
+    """(x @ int8-matrix) with the per-output-channel scale applied to the
+    fp32-upcast result — mathematically identical to dequantizing the
+    matrix first (sum_i x_i q_ij s_j), but XLA reads int8 bytes from HBM
+    and fuses the upcast into the dot's operand."""
+    y = x @ q.astype(x.dtype)
+    return (y.astype(jnp.float32) * s).astype(x.dtype)

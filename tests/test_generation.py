@@ -505,3 +505,86 @@ def test_repetition_penalty_steers_away_from_seen_tokens():
     with pytest.raises(NotImplementedError, match="repetition_penalty"):
         model.generate(paddle.to_tensor(ids), max_new_tokens=2, num_beams=2,
                        repetition_penalty=2.0)
+
+
+# -- weight-only int8 decode (reference weight_only_linear/llm_int8) ----------
+
+def _snap_int8(model):
+    """Overwrite every quantizable matrix with its int8-representable
+    projection (quantize->dequantize), so the quant decode is LOSSLESS up
+    to summation-order ulps and must reproduce the fp tokens exactly."""
+    from paddle_tpu.generation import _decoder_for, _wq
+    dec = _decoder_for(model)
+    names, _lm = dec.quant_plan()
+    for name, t in model.named_state().items():
+        if name in names:
+            q, s = _wq(t._data)
+            t._data = (q.astype(jnp.float32) * s).astype(t._data.dtype)
+
+
+@pytest.mark.parametrize("tied", [False, True])
+def test_weight_only_int8_decode_lossless_weights_exact(tied):
+    model = _model(tied=tied, seed=21)
+    _snap_int8(model)
+    if tied:
+        # the tied head quantizes the embedding TABLE too (__lm::q source)
+        emb = model.model.embed_tokens.weight
+        from paddle_tpu.generation import _wq
+        q, s = _wq(emb._data.T)
+        emb._data = (q.astype(jnp.float32) * s).T.astype(emb._data.dtype)
+    rng = np.random.default_rng(21)
+    ids = rng.integers(0, 61, (2, 7)).astype(np.int32)
+    fp, _ = model.generate(paddle.to_tensor(ids), max_new_tokens=8)
+    q8, _ = model.generate(paddle.to_tensor(ids), max_new_tokens=8,
+                           quant="weight_only_int8")
+    np.testing.assert_array_equal(fp.numpy(), q8.numpy())
+
+
+def test_weight_only_int8_pytree_and_cache():
+    from paddle_tpu.generation import _decoder_for
+    model = _model(seed=22)
+    rng = np.random.default_rng(22)
+    ids = rng.integers(0, 61, (1, 5)).astype(np.int32)
+    out1, _ = model.generate(paddle.to_tensor(ids), max_new_tokens=3,
+                             quant="weight_only_int8")
+    refs, qw, algo = model.__dict__["_quant_weights_cache"]
+    # the cache payload is ONLY int8/scale leaves (no fp copies pinned),
+    # and the invalidation snapshot is weakrefs
+    import weakref
+    assert all(isinstance(r, weakref.ref) for r in refs.values())
+    qleaves = [k for k in qw if k.endswith("::q")]
+    assert qleaves and all(qw[k].dtype == jnp.int8 for k in qleaves)
+    assert set(qw) == {k for k in qw if k.endswith(("::q", "::s"))}
+    # second call with unchanged weights reuses the cached quantization
+    model.generate(paddle.to_tensor(ids), max_new_tokens=3,
+                   quant="weight_only_int8")
+    assert model.__dict__["_quant_weights_cache"][1] is qw
+    # swapping any weight array invalidates the snapshot cache
+    w = model.model.layers[0].self_attn.q_proj.weight
+    w._data = w._data + 0.5
+    out3, _ = model.generate(paddle.to_tensor(ids), max_new_tokens=3,
+                             quant="weight_only_int8")
+    assert model.__dict__["_quant_weights_cache"][1] is not qw
+    # and the fp path still works interleaved (different pytree signature)
+    model.generate(paddle.to_tensor(ids), max_new_tokens=3)
+
+
+def test_weight_only_int8_gpt_and_beam():
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    paddle.seed(23)
+    cfg = GPTConfig.tiny(vocab_size=53, hidden_size=32, layers=2, heads=4,
+                         seq=64)
+    model = GPTForCausalLM(cfg)
+    rng = np.random.default_rng(23)
+    ids = rng.integers(0, 53, (2, 6)).astype(np.int32)
+    toks, fin = model.generate(paddle.to_tensor(ids), max_new_tokens=4,
+                               quant="weight_only_int8")
+    assert toks.numpy().shape == (2, 4)
+    assert (toks.numpy() >= 0).all() and (toks.numpy() < 53).all()
+    # beam search threads the same quantized pytree
+    btoks, _ = model.generate(paddle.to_tensor(ids), max_new_tokens=4,
+                              num_beams=2, quant="weight_only_int8")
+    assert btoks.numpy().shape == (2, 4)
+    with pytest.raises(NotImplementedError, match="weight_only_int8"):
+        model.generate(paddle.to_tensor(ids), max_new_tokens=2,
+                       quant="int4")
