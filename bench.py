@@ -296,20 +296,19 @@ def _run_probe(extend=None):
                 "e2e_tok_per_s": round(4 * new_toks / dt, 1),
                 "approx_decode_ms_per_step": round(ms_step, 2)}
 
-    def decode_int8_probe():
-        # weight-only int8 decode (reference weight_only_linear serving
-        # path): decode is HBM-bound on weight reads, so int8 should beat
-        # the bf16 e2e number above on the same model/prompt
+    def _decode_quant_probe(algo):
+        # weight-only int8/int4 decode (reference weight_only_linear
+        # serving path): decode is HBM-bound on weight reads, so narrower
+        # ints should beat the bf16 e2e number above on the same
+        # model/prompt (int4 additionally tests TPU native-int4 lowering)
         model = decode_state.get("model")
         if model is None:
             raise RuntimeError("decode probe did not run")
         ids = decode_state["ids"]
-        out, _ = model.generate(ids, max_new_tokens=128,
-                                quant="weight_only_int8")
+        out, _ = model.generate(ids, max_new_tokens=128, quant=algo)
         barrier(out._data)
         t0 = _t.perf_counter()
-        out, _ = model.generate(ids, max_new_tokens=128,
-                                quant="weight_only_int8")
+        out, _ = model.generate(ids, max_new_tokens=128, quant=algo)
         barrier(out._data)
         dt = _t.perf_counter() - t0
         return {"batch": 4, "new_tokens": 128,
@@ -333,7 +332,10 @@ def _run_probe(extend=None):
     step("xla_attn", xla_attn_probe)
     step("fused", fused_probe)
     step("decode", decode_probe)
-    step("decode_int8", decode_int8_probe)
+    step("decode_int8",
+         lambda: _decode_quant_probe("weight_only_int8"))
+    step("decode_int4",
+         lambda: _decode_quant_probe("weight_only_int4"))
     step("mem", mem_probe)
     out["ok"] = out["steps"].get("matmul", {}).get("ok", False)
     return out

@@ -48,22 +48,23 @@ NEG_INF = -1e30
 # bytes; the per-column scale is applied to the [B, S, out] result, which is
 # mathematically identical to scaling the matrix (sum_i x_i q_ij s_j).
 
-from .quantization._kernels import (int8_matmul_arrays as _int8_mm,
+from .quantization._kernels import (ALGO_BITS as _QUANT_BITS,
+                                    quant_matmul_arrays as _qmm,
                                     quantize_weight_arrays as _wq)
 
 
-def _quant_leaves(src, names, lm_from_embed=None):
+def _quant_leaves(src, names, lm_from_embed=None, bits=8):
     """Quantize each 2-D matmul weight in `names` to ::q/::s leaves; when
     `lm_from_embed` is set (tied head), add __lm::q/__lm::s from embed.T so
-    the [H, V] logits matmul also reads int8 while the embedding GATHER
-    keeps the original-precision table (gather reads B rows, not V*H)."""
+    the [H, V] logits matmul also reads narrow ints while the embedding
+    GATHER keeps the original-precision table (it reads B rows, not V*H)."""
     leaves = {}
     for n in names:
-        q, s = _wq(src[n])
+        q, s = _wq(src[n], bits=bits)
         leaves[n + "::q"] = q
         leaves[n + "::s"] = s
     if lm_from_embed is not None:
-        q, s = _wq(src[lm_from_embed].T)
+        q, s = _wq(src[lm_from_embed].T, bits=bits)
         leaves["__lm::q"] = q
         leaves["__lm::s"] = s
     return leaves
@@ -74,7 +75,17 @@ def _mm(x, w, name):
     q = w.get(name + "::q")
     if q is None:
         return x @ w[name]
-    return _int8_mm(x, q, w[name + "::s"])
+    return _qmm(x, q, w[name + "::s"])
+
+
+def _head_logits(w, h, tied, embed_key):
+    """The LM-head matmul, shared by both decoders: quantized tied head
+    (__lm leaves) > fp tied head (embed.T) > (possibly quantized) lm_head."""
+    if "__lm::q" in w:
+        return _qmm(h, w["__lm::q"], w["__lm::s"])
+    if tied:
+        return h @ w[embed_key].T
+    return _mm(h, w, "lm_head.weight")
 
 
 def _quant_weights_cached(dec, model, quant):
@@ -89,17 +100,18 @@ def _quant_weights_cached(dec, model, quant):
     src = dec.weights(model)
     names, lm_key = dec.quant_plan()
     watched = names if lm_key is None else [*names, lm_key]
-    cached = model.__dict__.get("_quant_weights_cache")
+    cache = model.__dict__.setdefault("_quant_weights_cache", {})
     leaves = None
+    cached = cache.get(quant)   # keyed per algo: int8/int4 coexist
     if cached is not None:
-        prev_refs, prev_leaves, prev_algo = cached
-        if prev_algo == quant and list(prev_refs) == watched and \
+        prev_refs, prev_leaves = cached
+        if list(prev_refs) == watched and \
                 all(prev_refs[k]() is src[k] for k in watched):
             leaves = prev_leaves
     if leaves is None:
-        leaves = _quant_leaves(src, names, lm_from_embed=lm_key)
-        model.__dict__["_quant_weights_cache"] = (
-            {k: weakref.ref(src[k]) for k in watched}, leaves, quant)
+        leaves = _quant_leaves(src, names, lm_from_embed=lm_key,
+                               bits=_QUANT_BITS[quant])
+        cache[quant] = ({k: weakref.ref(src[k]) for k in watched}, leaves)
     drop = set(names)
     w = {k: v for k, v in src.items() if k not in drop}
     w.update(leaves)
@@ -240,11 +252,7 @@ class _LlamaDecoder:
 
     def _logits(self, w, h):
         h = _rms(h, w["model.norm.weight"], self.eps)
-        if "__lm::q" in w:
-            return _int8_mm(h, w["__lm::q"], w["__lm::s"])
-        if self.tied:
-            return h @ w[self.embed_key].T
-        return _mm(h, w, "lm_head.weight")
+        return _head_logits(w, h, self.tied, self.embed_key)
 
     def step(self, w, tokens, positions, kcs, vcs, write_pos, score_mask):
         """tokens: [B, S] int; positions: [B, S] int (rope positions);
@@ -342,12 +350,7 @@ class _GPTDecoder:
             new_v.append(vc)
         h = _ln(h, w["transformer.ln_f.weight"], w["transformer.ln_f.bias"],
                 self.eps)
-        if "__lm::q" in w:
-            logits = _int8_mm(h, w["__lm::q"], w["__lm::s"])
-        elif self.tied:
-            logits = h @ wte.T
-        else:
-            logits = _mm(h, w, "lm_head.weight")
+        logits = _head_logits(w, h, self.tied, self.embed_key)
         return logits, jnp.stack(new_k), jnp.stack(new_v)
 
 
@@ -544,18 +547,19 @@ def generate(model, input_ids, attention_mask=None, max_new_tokens: int = 32,
     """Greedy/sampled continuation of `input_ids` ([B, S] int, LEFT-padded
     for ragged batches with `attention_mask` [B, S] in {0,1}).
 
-    quant="weight_only_int8" decodes against per-channel int8 weight
-    matrices (reference weight_only_linear/llm_int8 serving capability) —
-    the quantized pytree is cached per weight snapshot and the dequant
-    folds into each matmul's operand read.
+    quant="weight_only_int8" / "weight_only_int4" decodes against
+    per-channel narrow-int weight matrices (reference
+    weight_only_linear/llm_int8 serving capability) — the quantized
+    pytree is cached per weight snapshot and the dequant folds into each
+    matmul's operand read.
 
     Returns (tokens [B, max_new_tokens] Tensor, finished [B] Tensor) —
     rows that hit eos_token_id keep emitting eos. One compiled program per
     (batch, prompt_len, max_new_tokens, sampling-config) signature."""
-    if quant not in (None, "weight_only_int8"):
+    if quant is not None and quant not in _QUANT_BITS:
         raise NotImplementedError(
-            f"generate(quant={quant!r}): only 'weight_only_int8' is "
-            "supported (int4 packing is not)")
+            f"generate(quant={quant!r}): supported algos are "
+            f"{sorted(_QUANT_BITS)}")
     ids = input_ids._data if isinstance(input_ids, Tensor) \
         else jnp.asarray(input_ids)
     ids = ids.astype(jnp.int32)

@@ -98,13 +98,16 @@ def fake_channel_wise_quantize_abs_max(x, bit_length: int = 8,
 
 
 def weight_quantize(w, algo: str = "weight_only_int8"):
-    """Parity: ops.yaml weight_quantize — returns (int8 weight, scale)."""
-    if algo != "weight_only_int8":
+    """Parity: ops.yaml weight_quantize — returns (quantized weight,
+    scale). int4 uses the native jnp.int4 dtype instead of the
+    reference's two-nibbles-per-int8 packing (XLA owns the packing)."""
+    from ._kernels import ALGO_BITS, quantize_weight_arrays
+    bits = ALGO_BITS.get(algo)
+    if bits is None:
         raise NotImplementedError(
-            f"weight_quantize algo={algo!r}: only weight_only_int8 is "
-            "implemented (int4 packing is not)")
-    from ._kernels import quantize_weight_arrays
-    q, scale = quantize_weight_arrays(ensure_tensor(w)._data)
+            f"weight_quantize algo={algo!r}: implemented algos are "
+            f"{sorted(ALGO_BITS)}")
+    q, scale = quantize_weight_arrays(ensure_tensor(w)._data, bits=bits)
     return Tensor(q), Tensor(scale)
 
 
@@ -121,15 +124,15 @@ def weight_only_linear(x, weight_int8, bias=None, weight_scale=None,
     """Parity: ops.yaml weight_only_linear / llm_int8_linear capability —
     the int8 bytes feed the dot directly (shared kernel with the serving
     decode path); the per-channel scale lands on the output."""
-    from ._kernels import int8_matmul_arrays
+    from ._kernels import quant_matmul_arrays
     xt = ensure_tensor(x)
     q = ensure_tensor(weight_int8)
     s = ensure_tensor(weight_scale)
     if bias is None:
-        return dispatch("weight_only_linear", int8_matmul_arrays, xt, q, s)
+        return dispatch("weight_only_linear", quant_matmul_arrays, xt, q, s)
 
     def fwd(xa, qa, sa, ba):
-        y = int8_matmul_arrays(xa, qa, sa)
+        y = quant_matmul_arrays(xa, qa, sa)
         return y + ba.astype(y.dtype)
 
     return dispatch("weight_only_linear", fwd, xt, q, s,

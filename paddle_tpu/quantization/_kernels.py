@@ -1,6 +1,6 @@
-"""Raw-array weight-only int8 kernels shared by the quantization API
+"""Raw-array weight-only int8/int4 kernels shared by the quantization API
 (`weight_quantize`/`weight_only_linear`, reference ops.yaml) and the
-serving decode path (`paddle_tpu.generation`, quant="weight_only_int8").
+serving decode path (`paddle_tpu.generation`, quant="weight_only_int*").
 
 One implementation so the two surfaces cannot drift numerically. jax-only
 imports — safe for any module to import at load time.
@@ -9,22 +9,34 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+# the one algo registry both public surfaces (quantization.weight_quantize
+# and generation.generate(quant=...)) validate against
+ALGO_BITS = {"weight_only_int8": 8, "weight_only_int4": 4}
 
-def quantize_weight_arrays(arr):
-    """Per-output-channel symmetric int8 for a matmul weight used as
-    `x @ arr` ([in, out]): returns (q int8 [in, out], scale fp32 [out]).
-    The fp32 upcast makes bf16 weights quantize against the true channel
-    max instead of a bf16-rounded one."""
+
+def quantize_weight_arrays(arr, bits: int = 8):
+    """Per-output-channel symmetric quantization for a matmul weight used
+    as `x @ arr` ([in, out]): returns (q int8|int4 [in, out], scale fp32
+    [out]). The fp32 upcast makes bf16 weights quantize against the true
+    channel max instead of a bf16-rounded one. bits=4 uses the native
+    jnp.int4 dtype (TPU reads packed nibbles from HBM) rather than the
+    reference's two-nibbles-per-int8 manual packing."""
+    if bits == 8:
+        qmax, lo, hi, dt = 127.0, -128, 127, jnp.int8
+    elif bits == 4:
+        qmax, lo, hi, dt = 7.0, -8, 7, jnp.int4
+    else:
+        raise NotImplementedError(f"weight quantization bits={bits}")
     a32 = arr.astype(jnp.float32)
-    scale = jnp.maximum(jnp.abs(a32).max(axis=0), 1e-8) / 127.0
-    q = jnp.clip(jnp.round(a32 / scale), -128, 127).astype(jnp.int8)
+    scale = jnp.maximum(jnp.abs(a32).max(axis=0), 1e-8) / qmax
+    q = jnp.clip(jnp.round(a32 / scale), lo, hi).astype(dt)
     return q, scale
 
 
-def int8_matmul_arrays(x, q, s):
-    """(x @ int8-matrix) with the per-output-channel scale applied to the
-    fp32-upcast result — mathematically identical to dequantizing the
-    matrix first (sum_i x_i q_ij s_j), but XLA reads int8 bytes from HBM
-    and fuses the upcast into the dot's operand."""
+def quant_matmul_arrays(x, q, s):
+    """(x @ int8/int4-matrix) with the per-output-channel scale applied to
+    the fp32-upcast result — mathematically identical to dequantizing the
+    matrix first (sum_i x_i q_ij s_j), but XLA reads the narrow integer
+    bytes from HBM and fuses the upcast into the dot's operand."""
     y = x @ q.astype(x.dtype)
     return (y.astype(jnp.float32) * s).astype(x.dtype)

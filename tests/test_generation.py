@@ -509,8 +509,8 @@ def test_repetition_penalty_steers_away_from_seen_tokens():
 
 # -- weight-only int8 decode (reference weight_only_linear/llm_int8) ----------
 
-def _snap_int8(model):
-    """Overwrite every quantizable matrix with its int8-representable
+def _snap_quant(model, bits):
+    """Overwrite every quantizable matrix with its int8/int4-representable
     projection (quantize->dequantize), so the quant decode is LOSSLESS up
     to summation-order ulps and must reproduce the fp tokens exactly."""
     from paddle_tpu.generation import _decoder_for, _wq
@@ -518,26 +518,28 @@ def _snap_int8(model):
     names, _lm = dec.quant_plan()
     for name, t in model.named_state().items():
         if name in names:
-            q, s = _wq(t._data)
+            q, s = _wq(t._data, bits=bits)
             t._data = (q.astype(jnp.float32) * s).astype(t._data.dtype)
 
 
+@pytest.mark.parametrize("algo,bits", [("weight_only_int8", 8),
+                                       ("weight_only_int4", 4)])
 @pytest.mark.parametrize("tied", [False, True])
-def test_weight_only_int8_decode_lossless_weights_exact(tied):
+def test_weight_only_decode_lossless_weights_exact(tied, algo, bits):
     model = _model(tied=tied, seed=21)
-    _snap_int8(model)
+    _snap_quant(model, bits)
     if tied:
         # the tied head quantizes the embedding TABLE too (__lm::q source)
         emb = model.model.embed_tokens.weight
         from paddle_tpu.generation import _wq
-        q, s = _wq(emb._data.T)
+        q, s = _wq(emb._data.T, bits=bits)
         emb._data = (q.astype(jnp.float32) * s).T.astype(emb._data.dtype)
     rng = np.random.default_rng(21)
     ids = rng.integers(0, 61, (2, 7)).astype(np.int32)
     fp, _ = model.generate(paddle.to_tensor(ids), max_new_tokens=8)
-    q8, _ = model.generate(paddle.to_tensor(ids), max_new_tokens=8,
-                           quant="weight_only_int8")
-    np.testing.assert_array_equal(fp.numpy(), q8.numpy())
+    qq, _ = model.generate(paddle.to_tensor(ids), max_new_tokens=8,
+                           quant=algo)
+    np.testing.assert_array_equal(fp.numpy(), qq.numpy())
 
 
 def test_weight_only_int8_pytree_and_cache():
@@ -547,7 +549,7 @@ def test_weight_only_int8_pytree_and_cache():
     ids = rng.integers(0, 61, (1, 5)).astype(np.int32)
     out1, _ = model.generate(paddle.to_tensor(ids), max_new_tokens=3,
                              quant="weight_only_int8")
-    refs, qw, algo = model.__dict__["_quant_weights_cache"]
+    refs, qw = model.__dict__["_quant_weights_cache"]["weight_only_int8"]
     # the cache payload is ONLY int8/scale leaves (no fp copies pinned),
     # and the invalidation snapshot is weakrefs
     import weakref
@@ -558,13 +560,19 @@ def test_weight_only_int8_pytree_and_cache():
     # second call with unchanged weights reuses the cached quantization
     model.generate(paddle.to_tensor(ids), max_new_tokens=3,
                    quant="weight_only_int8")
-    assert model.__dict__["_quant_weights_cache"][1] is qw
+    cache = model.__dict__["_quant_weights_cache"]
+    assert cache["weight_only_int8"][1] is qw
+    # int4 coexists in the cache without evicting the int8 snapshot
+    model.generate(paddle.to_tensor(ids), max_new_tokens=3,
+                   quant="weight_only_int4")
+    assert cache["weight_only_int8"][1] is qw
+    assert cache["weight_only_int4"][1] is not qw
     # swapping any weight array invalidates the snapshot cache
     w = model.model.layers[0].self_attn.q_proj.weight
     w._data = w._data + 0.5
     out3, _ = model.generate(paddle.to_tensor(ids), max_new_tokens=3,
                              quant="weight_only_int8")
-    assert model.__dict__["_quant_weights_cache"][1] is not qw
+    assert cache["weight_only_int8"][1] is not qw
     # and the fp path still works interleaved (different pytree signature)
     model.generate(paddle.to_tensor(ids), max_new_tokens=3)
 
