@@ -25,7 +25,6 @@ full-recompute logits exactly.
 """
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax
@@ -186,6 +185,20 @@ class _LlamaDecoder:
         self.tied = model.lm_head is None
         self.embed_key = "model.embed_tokens.weight"
 
+    def _static_key(self):
+        """Everything the traced step() reads off `self` — two decoders
+        with equal keys produce identical traces, so they may share jit
+        executables (the decoder is a STATIC jit argument)."""
+        return (type(self), self.n_heads, self.n_kv, self.hd, self.eps,
+                self.n_layers, self.tied, self.embed_key)
+
+    def __hash__(self):
+        return hash(self._static_key())
+
+    def __eq__(self, other):
+        return (type(other) is type(self)
+                and other._static_key() == self._static_key())
+
     @staticmethod
     def weights(model):
         """The jit-argument pytree: params + buffers + the rope tables."""
@@ -282,17 +295,37 @@ def _ln(x, w, b, eps):
 
 
 class _GPTDecoder:
-    """Pure decode functions over a DENSE GPTForCausalLM state dict
-    (pre-LN GPT-2: learned positions, fused-qkv biases, erf GELU). MoE
-    blocks are rejected loudly — expert dispatch per decode step is a
-    different machine."""
+    """Pure decode functions over a GPTForCausalLM state dict (pre-LN
+    GPT-2: learned positions, fused-qkv biases, erf GELU). MoE blocks
+    decode with NO-DROP routing: per-token top-k expert mixing without
+    capacity dropping (a training-throughput device that would make a
+    cached step depend on which OTHER tokens were in the recompute batch
+    — dropped-token decode could never match the full forward). All
+    experts run densely and combine through exact 0/1 masks, so a no-drop
+    eval forward is reproduced bit-for-bit."""
 
     def __init__(self, model):
         cfg = model.config
-        if any(getattr(blk, "is_moe", False) for blk in model.transformer.h):
-            raise NotImplementedError(
-                "generate() supports dense GPT blocks only; MoE decode "
-                "(per-step expert dispatch) is not implemented")
+        self.moe_layers = {}
+        from .incubate.distributed.models.moe.gate import BaseGate
+        for i, blk in enumerate(model.transformer.h):
+            if getattr(blk, "is_moe", False):
+                if blk.mlp.w1 is None:
+                    raise NotImplementedError(
+                        "generate() supports batched-expert MoE blocks "
+                        "(stacked w1/w2 banks); per-expert Layer lists "
+                        "have no stacked weights to decode against")
+                if type(blk.mlp.gate).forward is not BaseGate.forward:
+                    raise NotImplementedError(
+                        "generate() routes with the standard linear gate; "
+                        f"{type(blk.mlp.gate).__name__} overrides "
+                        "forward(), which the decode program cannot "
+                        "reproduce from the state dict")
+                self.moe_layers[i] = {
+                    "top_k": blk.mlp.gate.top_k,
+                    "act": blk.mlp._act,
+                    "has_bias": blk.mlp.gate.bias is not None,
+                }
         self.cfg = cfg
         self.n_heads = cfg.num_attention_heads
         self.n_kv = self.n_heads
@@ -302,6 +335,22 @@ class _GPTDecoder:
         self.tied = model.lm_head is None
         self.embed_key = "transformer.wte.weight"
 
+    def _static_key(self):
+        """See _LlamaDecoder._static_key. The MoE fingerprint keys the
+        activation by function object — gates resolve activations from the
+        shared _ACTS registry, so equal configs get the same object."""
+        moe = tuple((i, m["top_k"], m["act"], m["has_bias"])
+                    for i, m in sorted(self.moe_layers.items()))
+        return (type(self), self.n_heads, self.hd, self.eps, self.n_layers,
+                self.tied, self.embed_key, moe)
+
+    def __hash__(self):
+        return hash(self._static_key())
+
+    def __eq__(self, other):
+        return (type(other) is type(self)
+                and other._static_key() == self._static_key())
+
     @staticmethod
     def weights(model):
         return {n: t._data for n, t in model.named_state().items()}
@@ -310,9 +359,12 @@ class _GPTDecoder:
                        "mlp.fc_in.weight", "mlp.fc_out.weight")
 
     def quant_plan(self):
-        """(matmul weight names to quantize, tied-embed key or None)."""
+        """(matmul weight names to quantize, tied-embed key or None).
+        MoE blocks keep fp expert banks (3-D [e,·,·] weights); only their
+        attention projections quantize."""
         names = [f"transformer.h.{i}.{sfx}" for i in range(self.n_layers)
-                 for sfx in self._QUANT_SUFFIXES]
+                 for sfx in self._QUANT_SUFFIXES
+                 if not (i in self.moe_layers and sfx.startswith("mlp."))]
         if not self.tied:
             names.append("lm_head.weight")
         return names, (self.embed_key if self.tied else None)
@@ -333,11 +385,43 @@ class _GPTDecoder:
         h = h + _mm(att, w, p + "attn.out_proj.weight") \
             + w[p + "attn.out_proj.bias"]
         x2 = _ln(h, w[p + "ln_2.weight"], w[p + "ln_2.bias"], self.eps)
+        if i in self.moe_layers:
+            h = h + self._moe_mlp(w, i, x2)
+            return h, kc, vc
         m = jax.nn.gelu((_mm(x2, w, p + "mlp.fc_in.weight")
                          + w[p + "mlp.fc_in.bias"]).astype(jnp.float32),
                         approximate=False).astype(h.dtype)
         h = h + _mm(m, w, p + "mlp.fc_out.weight") + w[p + "mlp.fc_out.bias"]
         return h, kc, vc
+
+    def _moe_mlp(self, w, i, x2):
+        """No-drop top-k expert mixing; x2: [B, S, D] -> [B, S, D].
+
+        Every expert runs on every token (dense [t, e, h] FFN — decode
+        steps have t = B tokens, so the e-fold compute is cheap next to
+        attention over the cache) and the top-k combine weights select via
+        exact one-hot masks: identical math to the training MoELayer with
+        an unbounded capacity, without its O(t^2 e) dispatch one-hots."""
+        p = f"transformer.h.{i}.mlp."
+        meta = self.moe_layers[i]
+        b, s, d = x2.shape
+        xt = x2.reshape(b * s, d)
+        logits = xt @ w[p + "gate.weight"]
+        if meta["has_bias"]:
+            logits = logits + w[p + "gate.bias"]
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        topv, topi = jax.lax.top_k(probs, meta["top_k"])
+        if meta["top_k"] > 1:
+            topv = topv / jnp.maximum(topv.sum(-1, keepdims=True), 1e-9)
+        e = probs.shape[-1]
+        comb = jnp.zeros((b * s, e), jnp.float32)
+        for j in range(meta["top_k"]):
+            comb = comb + topv[:, j, None] * jax.nn.one_hot(topi[:, j], e)
+        hh = jnp.einsum("td,edh->teh", xt, w[p + "w1"]) + w[p + "b1"][None]
+        hh = meta["act"](hh)
+        eo = jnp.einsum("teh,ehd->ted", hh, w[p + "w2"]) + w[p + "b2"][None]
+        y = jnp.einsum("te,ted->td", comb.astype(xt.dtype), eo)
+        return y.reshape(b, s, d)
 
     def step(self, w, tokens, positions, kcs, vcs, write_pos, score_mask):
         wte = w["transformer.wte.weight"]
@@ -593,23 +677,18 @@ def generate(model, input_ids, attention_mask=None, max_new_tokens: int = 32,
         if repetition_penalty != 1.0:
             raise NotImplementedError(
                 "repetition_penalty under beam search is not supported")
-        jb = dec.__dict__.get("_jit_beam")
-        if jb is None:
-            jb = jax.jit(functools.partial(_beam_impl, dec),
-                         static_argnums=(3, 4, 6))
-            dec._jit_beam = jb
-        toks, fin = jb(weights, ids, mask, int(max_new_tokens),
-                       int(num_beams),
-                       jnp.int32(eos_token_id if has_eos_b else 0),
-                       has_eos_b, jnp.float32(length_penalty))
+        toks, fin = _BEAM_JIT(dec, weights, ids, mask, int(max_new_tokens),
+                              int(num_beams),
+                              jnp.int32(eos_token_id if has_eos_b else 0),
+                              has_eos_b, jnp.float32(length_penalty))
         return Tensor(toks), Tensor(fin)
     key = jax.random.PRNGKey(0 if seed is None else seed)
     if seed is None and do_sample:
         from .framework.random import next_key
         key = next_key()
     has_eos = eos_token_id is not None
-    toks, finished = dec._jit(
-        weights, ids, mask, key, int(max_new_tokens),
+    toks, finished = _GEN_JIT(
+        dec, weights, ids, mask, key, int(max_new_tokens),
         bool(do_sample), float(temperature),
         jnp.int32(eos_token_id if has_eos else 0), has_eos, int(top_k),
         float(top_p), jnp.float32(repetition_penalty),
@@ -617,25 +696,52 @@ def generate(model, input_ids, attention_mask=None, max_new_tokens: int = 32,
     return Tensor(toks), Tensor(finished)
 
 
+# The decoder rides as a STATIC jit argument, hashed by its config
+# fingerprint (_static_key): every model with the same architecture —
+# predictor-pool clones, test fixtures, reloaded checkpoints — shares ONE
+# compiled executable per (shapes, sampling-config) signature instead of
+# recompiling per instance. Weights stay ordinary jit ARGUMENTS: never
+# captured, so updates need no invalidation and old arrays aren't pinned.
+# arg indices: dec=0(static), w=1, ids=2, mask=3, key=4, max_new=5(s),
+# do_sample=6(s), temperature=7, eos_id=8, has_eos=9(s), top_k=10(s),
+# top_p=11(s), rep_penalty=12, has_rep=13(s)
+_GEN_JIT = jax.jit(_generate_impl, static_argnums=(0, 5, 6, 9, 10, 11, 13))
+# dec=0(static), w=1, ids=2, mask=3, max_new=4(s), num_beams=5(s),
+# eos_id=6, has_eos=7(s), length_penalty=8
+_BEAM_JIT = jax.jit(_beam_impl, static_argnums=(0, 4, 5, 7))
+
+
+def _live_moe_struct(model):
+    """Fingerprint of the model's CURRENT MoE block state — everything the
+    decoder snapshots at construction, so mutating a block (swapped mlp,
+    changed top_k, custom gate) rebuilds the decoder instead of silently
+    decoding with stale routing."""
+    blocks = getattr(getattr(model, "transformer", None), "h", None)
+    if blocks is None:
+        return ()
+    fp = []
+    for i, blk in enumerate(blocks):
+        if getattr(blk, "is_moe", False):
+            g = blk.mlp.gate
+            fp.append((i, g.top_k, getattr(blk.mlp, "_act", None),
+                       g.bias is not None, blk.mlp.w1 is None,
+                       type(g).forward))
+    return tuple(fp)
+
+
 def _decoder_for(model):
-    """One _LlamaDecoder per model instance, stored ON the model (so its
-    jit executable cache dies with the model, not in a module global).
-    Weights are passed as a jit ARGUMENT on every call — never captured —
-    so weight updates need no invalidation and old arrays are never
-    pinned; the executable retraces only if shapes/dtypes change."""
+    """One decoder per model instance (holds only static config; equal
+    configs hash equal, so the module jits share executables across
+    instances)."""
     from .models.gpt import GPTForCausalLM
     cls = _GPTDecoder if isinstance(model, GPTForCausalLM) \
         else _LlamaDecoder
-    struct = (cls, model.lm_head is None)   # head tying is baked into the
-    dec = model.__dict__.get("_decode_cache")   # traced logits branch
+    struct = (cls, model.lm_head is None,    # head tying is baked into the
+              _live_moe_struct(model))       # traced logits branch
+    dec = model.__dict__.get("_decode_cache")
     if dec is None or dec._struct != struct:
         dec = cls(model)
         dec._struct = struct
-        # arg indices (after the partial binds dec): w=0, ids=1, mask=2,
-        # key=3, max_new=4, do_sample=5, temperature=6, eos_id=7,
-        # has_eos=8, top_k=9, top_p=10, rep_penalty=11, has_rep=12
-        dec._jit = jax.jit(functools.partial(_generate_impl, dec),
-                           static_argnums=(4, 5, 8, 9, 10, 12))
         model.__dict__["_decode_cache"] = dec
     return dec
 

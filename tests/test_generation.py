@@ -225,15 +225,60 @@ def test_gpt_greedy_generate_matches_full_recompute():
     np.testing.assert_array_equal(got.numpy(), want)
 
 
-def test_gpt_moe_generate_rejected():
+def _moe_model(gate="naive", seed=13, experts=4, top_k=2):
     from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    paddle.seed(seed)
+    cfg = GPTConfig.tiny(vocab_size=53, hidden_size=32, layers=2, heads=4,
+                         seq=64, num_experts=experts, moe_every=1,
+                         moe_top_k=top_k, moe_gate=gate)
+    return GPTForCausalLM(cfg)
 
-    paddle.seed(13)
+
+def test_gpt_moe_greedy_generate_matches_full_recompute():
+    """MoE decode parity: with an unbounded gate (naive = no capacity
+    dropping, eval policy deterministic) the cached decode must reproduce
+    the full-recompute greedy tokens exactly."""
+    model = _moe_model(gate="naive")
+    model.eval()
+    rng = np.random.default_rng(31)
+    ids = rng.integers(0, 53, (2, 6)).astype(np.int32)
+    want = _greedy_oracle(model, ids, 5)
+    got, _ = model.generate(paddle.to_tensor(ids), max_new_tokens=5)
+    np.testing.assert_array_equal(got.numpy(), want)
+
+
+def test_gpt_moe_generate_gshard_and_quant_smoke():
+    """GShard-gated MoE decodes (no-drop routing: serving never drops
+    tokens) and composes with weight-only quant on the attention
+    projections (expert banks stay fp)."""
+    model = _moe_model(gate="gshard", seed=14)
+    model.eval()
+    rng = np.random.default_rng(32)
+    ids = rng.integers(0, 53, (2, 5)).astype(np.int32)
+    toks, _ = model.generate(paddle.to_tensor(ids), max_new_tokens=4)
+    assert toks.numpy().shape == (2, 4)
+    q8, _ = model.generate(paddle.to_tensor(ids), max_new_tokens=4,
+                           quant="weight_only_int8")
+    assert q8.numpy().shape == (2, 4)
+    # expert banks are NOT in the quant cache (3-D fp weights)
+    refs, leaves = model.__dict__["_quant_weights_cache"]["weight_only_int8"]
+    assert not any(".mlp." in k for k in leaves)
+    assert any(".attn." in k for k in leaves)
+
+
+def test_gpt_moe_expert_list_backend_rejected():
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.incubate.distributed.models.moe.moe_layer import MoELayer
+    import paddle_tpu.nn as nn
+    paddle.seed(15)
     cfg = GPTConfig.tiny(vocab_size=53, hidden_size=32, layers=2, heads=4,
                          seq=64, num_experts=2, moe_every=1)
     model = GPTForCausalLM(cfg)
+    blk = model.transformer.h[0]
+    blk.mlp = MoELayer(32, 64, num_expert=2, gate="naive",
+                       experts=[nn.Linear(32, 32) for _ in range(2)])
     ids = np.zeros((1, 4), np.int32)
-    with pytest.raises(NotImplementedError, match="MoE decode"):
+    with pytest.raises(NotImplementedError, match="batched-expert"):
         model.generate(paddle.to_tensor(ids), max_new_tokens=2)
 
 
@@ -596,3 +641,52 @@ def test_weight_only_int8_gpt_and_beam():
     with pytest.raises(NotImplementedError, match="weight_only_int8"):
         model.generate(paddle.to_tensor(ids), max_new_tokens=2,
                        quant="int4")
+
+
+def test_equal_config_models_share_compiled_decoders():
+    """The decoder is a static jit arg hashed by config: a second model
+    with the same architecture (predictor-pool clone, reloaded
+    checkpoint) must NOT recompile the generate program."""
+    from paddle_tpu.generation import _GEN_JIT
+    m1 = _model(seed=51)
+    rng = np.random.default_rng(51)
+    ids = rng.integers(0, 61, (1, 6)).astype(np.int32)
+    m1.generate(paddle.to_tensor(ids), max_new_tokens=4)
+    size = _GEN_JIT._cache_size()
+    m2 = _model(seed=52)          # same config, different weights
+    a, _ = m2.generate(paddle.to_tensor(ids), max_new_tokens=4)
+    assert _GEN_JIT._cache_size() == size     # shared executable
+    # and it really used m2's weights, not m1's
+    b, _ = m1.generate(paddle.to_tensor(ids), max_new_tokens=4)
+    assert not np.array_equal(a.numpy(), b.numpy())
+
+
+def test_moe_block_mutation_rebuilds_decoder():
+    """Mutating MoE blocks after a generate() must rebuild the cached
+    decoder (stale routing would silently diverge from forward)."""
+    from paddle_tpu.incubate.distributed.models.moe.moe_layer import MoELayer
+    from paddle_tpu.incubate.distributed.models.moe.gate import BaseGate
+    import paddle_tpu.nn as nn
+    model = _moe_model(gate="naive", seed=16)
+    model.eval()
+    ids = np.zeros((1, 4), np.int32)
+    model.generate(paddle.to_tensor(ids), max_new_tokens=2)
+    # swap to the unsupported list backend AFTER the decoder was cached:
+    # the guard must still fire (decoder rebuilt, not reused stale)
+    model.transformer.h[0].mlp = MoELayer(
+        32, 64, num_expert=4, gate="naive",
+        experts=[nn.Linear(32, 32) for _ in range(4)])
+    with pytest.raises(NotImplementedError, match="batched-expert"):
+        model.generate(paddle.to_tensor(ids), max_new_tokens=2)
+
+    # custom gate forward overrides are rejected loudly, not mis-decoded
+    class WeirdGate(BaseGate):
+        def forward(self, x):
+            return super().forward(x * 2.0)
+
+    model2 = _moe_model(gate="naive", seed=17)
+    model2.eval()
+    model2.generate(paddle.to_tensor(ids), max_new_tokens=2)
+    model2.transformer.h[0].mlp.gate = WeirdGate(32, 4)
+    with pytest.raises(NotImplementedError, match="WeirdGate"):
+        model2.generate(paddle.to_tensor(ids), max_new_tokens=2)
