@@ -18,7 +18,8 @@ from ..tensor import Tensor
 
 # Ops cast to low precision under autocast (parity: amp_lists white list).
 WHITE_LIST = {"matmul", "linear", "conv1d", "conv2d", "conv3d", "bmm", "mm",
-              "mv", "einsum", "flash_attention", "sdpa", "addmm"}
+              "mv", "einsum", "flash_attention", "sdpa", "addmm",
+              "sp_overlap_column", "sp_overlap_row"}
 # Ops forced to fp32 (parity: black list).
 BLACK_LIST = {"exp", "log", "log2", "log10", "mean", "sum", "softmax",
               "log_softmax", "cross_entropy", "layer_norm", "batch_norm",

@@ -195,13 +195,18 @@ def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1,
 class ColumnSequenceParallelLinear(ColumnParallelLinear):
     """Parity: sequence_parallel_utils.py:429. Input arrives seq-sharded;
     the constraint makes GSPMD all-gather it for the out-sharded matmul.
-    With FLAGS_sp_overlap_linear (reference's mp_async_allreduce /
-    SPInnerOverlapLinear :257) the all-gather is ring-decomposed and
-    overlapped with the matmul chunks (parallel/overlap.py)."""
+    With FLAGS_sp_overlap_linear or overlap=True (reference's
+    mp_async_allreduce / SPInnerOverlapLinear :257) the all-gather is
+    ring-decomposed and overlapped with the matmul chunks
+    (parallel/overlap.py)."""
+
+    def __init__(self, *args, overlap=None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._overlap = overlap
 
     def forward(self, x):
         from ...parallel import overlap
-        if overlap.overlap_enabled():
+        if overlap.overlap_enabled(self._overlap):
             return overlap.column_sp_linear(x, self.weight, self.bias)
         x = _seq_parallel_constraint(x, "sp_column_in")
         return F.linear(x, self.weight, self.bias)
@@ -210,12 +215,16 @@ class ColumnSequenceParallelLinear(ColumnParallelLinear):
 class RowSequenceParallelLinear(RowParallelLinear):
     """Parity: sequence_parallel_utils.py:564. Output is declared seq-sharded,
     so the partial-sum over mp lowers to reduce-scatter instead of all-reduce.
-    With FLAGS_sp_overlap_linear the reduce-scatter rides the ring overlapped
-    with the per-chunk matmuls (parallel/overlap.py)."""
+    With FLAGS_sp_overlap_linear or overlap=True the reduce-scatter rides
+    the ring overlapped with the per-chunk matmuls (parallel/overlap.py)."""
+
+    def __init__(self, *args, overlap=None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._overlap = overlap
 
     def forward(self, x):
         from ...parallel import overlap
-        if overlap.overlap_enabled():
+        if overlap.overlap_enabled(self._overlap):
             return overlap.row_sp_linear(x, self.weight, self.bias)
         y = F.linear(x, self.weight, self.bias)
         return _seq_parallel_constraint(y, "sp_row_out")

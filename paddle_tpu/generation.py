@@ -25,6 +25,8 @@ full-recompute logits exactly.
 """
 from __future__ import annotations
 
+from collections import OrderedDict
+from functools import partial
 from typing import Optional
 
 import jax
@@ -321,11 +323,31 @@ class _GPTDecoder:
                         f"{type(blk.mlp.gate).__name__} overrides "
                         "forward(), which the decode program cannot "
                         "reproduce from the state dict")
+                if (blk.mlp.gate.capacity_factor(training=False) is not None
+                        and blk.mlp._capacity_override is None):
+                    # capacity routing makes a token's expert assignment
+                    # depend on which OTHER tokens share the forward call
+                    # (earlier tokens win slots) — a cached decode step sees
+                    # only the current positions, so it cannot reproduce the
+                    # full-forward drops; refuse rather than silently diverge
+                    raise NotImplementedError(
+                        f"generate() cannot reproduce "
+                        f"{type(blk.mlp.gate).__name__}'s eval capacity "
+                        "dropping (routing depends on batch composition). "
+                        "Use NaiveGate (unbounded), or set "
+                        "mlp._capacity_override >= tokens-per-forward to "
+                        "make eval routing no-drop")
                 self.moe_layers[i] = {
                     "top_k": blk.mlp.gate.top_k,
                     "act": blk.mlp._act,
                     "has_bias": blk.mlp.gate.bias is not None,
                 }
+                # generate() re-checks this bound against the actual
+                # tokens-per-forward of each call (b * (s + max_new))
+                ov = blk.mlp._capacity_override
+                if ov is not None:
+                    self.min_capacity_override = min(
+                        getattr(self, "min_capacity_override", ov), int(ov))
         self.cfg = cfg
         self.n_heads = cfg.num_attention_heads
         self.n_kv = self.n_heads
@@ -417,10 +439,18 @@ class _GPTDecoder:
         comb = jnp.zeros((b * s, e), jnp.float32)
         for j in range(meta["top_k"]):
             comb = comb + topv[:, j, None] * jax.nn.one_hot(topi[:, j], e)
-        hh = jnp.einsum("td,edh->teh", xt, w[p + "w1"]) + w[p + "b1"][None]
-        hh = meta["act"](hh)
-        eo = jnp.einsum("teh,ehd->ted", hh, w[p + "w2"]) + w[p + "b2"][None]
-        y = jnp.einsum("te,ted->td", comb.astype(xt.dtype), eo)
+        # scan over the expert bank: each expert's FFN runs on all tokens
+        # (dense compute; routing selects via comb's 0 weights) but only
+        # O(t, h) activation memory is live at once — the fused [t, e, h]
+        # einsum would scale e-fold with prompt length on the PREFILL step
+        def body(acc, ew):
+            w1_e, b1_e, w2_e, b2_e, comb_e = ew
+            hh = meta["act"](xt @ w1_e + b1_e[None])
+            return acc + comb_e[:, None].astype(xt.dtype) \
+                * (hh @ w2_e + b2_e[None]), None
+        y, _ = jax.lax.scan(
+            body, jnp.zeros_like(xt),
+            (w[p + "w1"], w[p + "b1"], w[p + "w2"], w[p + "b2"], comb.T))
         return y.reshape(b, s, d)
 
     def step(self, w, tokens, positions, kcs, vcs, write_pos, score_mask):
@@ -666,6 +696,17 @@ def generate(model, input_ids, attention_mask=None, max_new_tokens: int = 32,
             f"max_position_embeddings "
             f"{model.config.max_position_embeddings}")
     dec = _decoder_for(model)
+    mco = getattr(dec, "min_capacity_override", None)
+    if mco is not None and mco < b * (s + max_new_tokens):
+        # an override below tokens-per-forward means the eval forward DOES
+        # drop tokens, recreating exactly the decode-vs-forward divergence
+        # the no-drop contract forbids
+        raise ValueError(
+            f"MoE _capacity_override={mco} < tokens-per-forward "
+            f"{b * (s + max_new_tokens)} (batch {b} x (prompt {s} + "
+            f"max_new_tokens {max_new_tokens})): the full forward would "
+            "drop tokens, which the cached no-drop decode cannot "
+            "reproduce; raise the override or shorten the request")
     weights = (_quant_weights_cached(dec, model, quant) if quant
                else dec.weights(model))
     has_eos_b = eos_token_id is not None
@@ -677,18 +718,18 @@ def generate(model, input_ids, attention_mask=None, max_new_tokens: int = 32,
         if repetition_penalty != 1.0:
             raise NotImplementedError(
                 "repetition_penalty under beam search is not supported")
-        toks, fin = _BEAM_JIT(dec, weights, ids, mask, int(max_new_tokens),
-                              int(num_beams),
-                              jnp.int32(eos_token_id if has_eos_b else 0),
-                              has_eos_b, jnp.float32(length_penalty))
+        toks, fin = _jits_for(dec)[1](
+            weights, ids, mask, int(max_new_tokens), int(num_beams),
+            jnp.int32(eos_token_id if has_eos_b else 0),
+            has_eos_b, jnp.float32(length_penalty))
         return Tensor(toks), Tensor(fin)
     key = jax.random.PRNGKey(0 if seed is None else seed)
     if seed is None and do_sample:
         from .framework.random import next_key
         key = next_key()
     has_eos = eos_token_id is not None
-    toks, finished = _GEN_JIT(
-        dec, weights, ids, mask, key, int(max_new_tokens),
+    toks, finished = _jits_for(dec)[0](
+        weights, ids, mask, key, int(max_new_tokens),
         bool(do_sample), float(temperature),
         jnp.int32(eos_token_id if has_eos else 0), has_eos, int(top_k),
         float(top_p), jnp.float32(repetition_penalty),
@@ -696,19 +737,35 @@ def generate(model, input_ids, attention_mask=None, max_new_tokens: int = 32,
     return Tensor(toks), Tensor(finished)
 
 
-# The decoder rides as a STATIC jit argument, hashed by its config
-# fingerprint (_static_key): every model with the same architecture —
-# predictor-pool clones, test fixtures, reloaded checkpoints — shares ONE
-# compiled executable per (shapes, sampling-config) signature instead of
-# recompiling per instance. Weights stay ordinary jit ARGUMENTS: never
-# captured, so updates need no invalidation and old arrays aren't pinned.
-# arg indices: dec=0(static), w=1, ids=2, mask=3, key=4, max_new=5(s),
-# do_sample=6(s), temperature=7, eos_id=8, has_eos=9(s), top_k=10(s),
-# top_p=11(s), rep_penalty=12, has_rep=13(s)
-_GEN_JIT = jax.jit(_generate_impl, static_argnums=(0, 5, 6, 9, 10, 11, 13))
-# dec=0(static), w=1, ids=2, mask=3, max_new=4(s), num_beams=5(s),
-# eos_id=6, has_eos=7(s), length_penalty=8
-_BEAM_JIT = jax.jit(_beam_impl, static_argnums=(0, 4, 5, 7))
+# The decoder keys a bounded registry of jitted entry points: every model
+# with the same architecture — predictor-pool clones, test fixtures,
+# reloaded checkpoints — shares ONE compiled executable per (shapes,
+# sampling-config) signature instead of recompiling per instance. Weights
+# stay ordinary jit ARGUMENTS: never captured, so updates need no
+# invalidation and old arrays aren't pinned. The registry is LRU-bounded so
+# a serving process cycling through many architectures doesn't accumulate
+# executables (and their pinned decoder/config objects) forever — evicting
+# a decoder's entry drops its whole jit cache.
+_DEC_JIT = OrderedDict()
+_DEC_JIT_MAX = 8
+
+
+def _jits_for(dec):
+    ent = _DEC_JIT.pop(dec, None)
+    if ent is None:
+        # post-partial arg indices (dec bound):
+        # gen: w=0, ids=1, mask=2, key=3, max_new=4(s), do_sample=5(s),
+        #      temperature=6, eos_id=7, has_eos=8(s), top_k=9(s),
+        #      top_p=10(s), rep_penalty=11, has_rep=12(s)
+        # beam: w=0, ids=1, mask=2, max_new=3(s), num_beams=4(s),
+        #       eos_id=5, has_eos=6(s), length_penalty=7
+        ent = (jax.jit(partial(_generate_impl, dec),
+                       static_argnums=(4, 5, 8, 9, 10, 12)),
+               jax.jit(partial(_beam_impl, dec), static_argnums=(3, 4, 6)))
+    _DEC_JIT[dec] = ent
+    while len(_DEC_JIT) > _DEC_JIT_MAX:
+        _DEC_JIT.popitem(last=False)
+    return ent
 
 
 def _live_moe_struct(model):
@@ -725,7 +782,8 @@ def _live_moe_struct(model):
             g = blk.mlp.gate
             fp.append((i, g.top_k, getattr(blk.mlp, "_act", None),
                        g.bias is not None, blk.mlp.w1 is None,
-                       type(g).forward))
+                       type(g).forward, g.capacity_factor(training=False),
+                       blk.mlp._capacity_override))
     return tuple(fp)
 
 

@@ -39,6 +39,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..framework import flags
 from . import context as pctx
+from .context import rotate_perm
 
 flags.define_flag(
     "sp_overlap_linear", False,
@@ -46,12 +47,10 @@ flags.define_flag(
     "(reference: mp_async_allreduce / SPInnerOverlapLinear).")
 
 
-def _fwd_perm(n):
-    # chunk travels j -> j+1; after i hops, device `me` holds chunk (me - i)
-    return [(j, (j + 1) % n) for j in range(n)]
-
-
 # ---- per-device ring bodies (call inside shard_map over the mp axis) --------
+# n = lax.axis_size is static under shard_map tracing and small (the mp
+# degree), so the rings unroll as Python loops: n-1 ppermute hops (the
+# locally-held chunk needs none), each issued before the dot it overlaps.
 
 def _ring_ag_matmul(x, w, axis_name):
     """[..., s_loc, d] x [d, o] -> [..., s_loc*n, o] == all_gather(x) @ w."""
@@ -60,19 +59,16 @@ def _ring_ag_matmul(x, w, axis_name):
         return jnp.matmul(x, w)
     me = lax.axis_index(axis_name)
     s_loc = x.shape[-2]
-    perm = _fwd_perm(n)
+    perm = rotate_perm(n)
     out = jnp.zeros(x.shape[:-2] + (s_loc * n, w.shape[-1]),
                     jnp.result_type(x.dtype, w.dtype))
-
-    def body(i, carry):
-        cur, acc = carry
-        nxt = lax.ppermute(cur, axis_name, perm)  # in flight during the dot
+    cur = x
+    for i in range(n):
+        nxt = lax.ppermute(cur, axis_name, perm) if i < n - 1 else None
         idx = (me - i) % n
-        acc = lax.dynamic_update_slice_in_dim(
-            acc, jnp.matmul(cur, w).astype(acc.dtype), idx * s_loc, axis=-2)
-        return nxt, acc
-
-    _, out = lax.fori_loop(0, n, body, (x, out))
+        out = lax.dynamic_update_slice_in_dim(
+            out, jnp.matmul(cur, w).astype(out.dtype), idx * s_loc, axis=-2)
+        cur = nxt
     return out
 
 
@@ -81,30 +77,30 @@ def _ring_matmul_rs(x, w, axis_name):
 
     The accumulator travels the ring; at step i device j adds its local
     product for seq-chunk (j + n-1 - i), which is exactly the device that
-    accumulator will sit on after the remaining hops.
+    accumulator will sit on after the remaining hops. Step 0 has nothing to
+    rotate (the accumulator starts as the local product), so n-1 hops.
     """
     n = lax.axis_size(axis_name)
     if n == 1:
         return jnp.matmul(x, w)
     me = lax.axis_index(axis_name)
     s_loc = x.shape[-2] // n
-    perm = _fwd_perm(n)
-    acc0 = jnp.zeros(x.shape[:-2] + (s_loc, w.shape[-1]),
-                     jnp.result_type(x.dtype, w.dtype))
-
-    def body(i, acc):
-        acc = lax.ppermute(acc, axis_name, perm)  # in flight during the dot
+    perm = rotate_perm(n)
+    acc = jnp.zeros(x.shape[:-2] + (s_loc, w.shape[-1]),
+                    jnp.result_type(x.dtype, w.dtype))
+    for i in range(n):
+        if i:
+            acc = lax.ppermute(acc, axis_name, perm)
         idx = (me + (n - 1) - i) % n
         chunk = lax.dynamic_slice_in_dim(x, idx * s_loc, s_loc, axis=-2)
-        return acc + jnp.matmul(chunk, w).astype(acc.dtype)
-
-    return lax.fori_loop(0, n, body, acc0)
+        acc = acc + jnp.matmul(chunk, w).astype(acc.dtype)
+    return acc
 
 
 def _ring_dw(rotating, stationary, axis_name, rotating_is_lhs):
     """Weight grad ring: contract a seq-sharded rotating operand against the
     matching seq-chunk of a full-sequence stationary operand, accumulating
-    over all n hops (= the full-sequence contraction, no extra collective).
+    over all n chunks (= the full-sequence contraction, no extra collective).
 
     rotating_is_lhs=True:  dw[d,o] += sum_chunks rot[...,s,d]^T @ sta_chunk[...,s,o]
     rotating_is_lhs=False: dw[d,o] += sum_chunks sta_chunk[...,s,d]^T @ rot[...,s,o]
@@ -112,22 +108,19 @@ def _ring_dw(rotating, stationary, axis_name, rotating_is_lhs):
     n = lax.axis_size(axis_name)
     me = lax.axis_index(axis_name)
     s_loc = rotating.shape[-2]
-    perm = _fwd_perm(n)
+    perm = rotate_perm(n)
     d = rotating.shape[-1] if rotating_is_lhs else stationary.shape[-1]
     o = stationary.shape[-1] if rotating_is_lhs else rotating.shape[-1]
-    acc0 = jnp.zeros((d, o), jnp.result_type(rotating.dtype, stationary.dtype))
-
-    def body(i, carry):
-        cur, acc = carry
-        nxt = lax.ppermute(cur, axis_name, perm)
+    acc = jnp.zeros((d, o), jnp.result_type(rotating.dtype, stationary.dtype))
+    cur = rotating
+    for i in range(n):
+        nxt = lax.ppermute(cur, axis_name, perm) if i < n - 1 else None
         idx = (me - i) % n
         chunk = lax.dynamic_slice_in_dim(
             stationary, idx * s_loc, s_loc, axis=-2)
         lhs, rhs = (cur, chunk) if rotating_is_lhs else (chunk, cur)
         acc = acc + jnp.einsum("...sd,...so->do", lhs, rhs).astype(acc.dtype)
-        return nxt, acc
-
-    _, acc = lax.fori_loop(0, n, body, (rotating, acc0))
+        cur = nxt
     return acc
 
 

@@ -248,18 +248,33 @@ def test_gpt_moe_greedy_generate_matches_full_recompute():
 
 
 def test_gpt_moe_generate_gshard_and_quant_smoke():
-    """GShard-gated MoE decodes (no-drop routing: serving never drops
-    tokens) and composes with weight-only quant on the attention
-    projections (expert banks stay fp)."""
+    """A GShard gate's eval capacity dropping depends on batch composition,
+    which a cached decode cannot reproduce — generate() must refuse LOUDLY
+    rather than silently diverge from model(x). With _capacity_override
+    making eval routing no-drop, decode runs and matches the
+    full-recompute oracle exactly; weight-only quant composes (attention
+    projections quantize, expert banks stay fp)."""
     model = _moe_model(gate="gshard", seed=14)
     model.eval()
     rng = np.random.default_rng(32)
     ids = rng.integers(0, 53, (2, 5)).astype(np.int32)
+    with pytest.raises(NotImplementedError, match="capacity"):
+        model.generate(paddle.to_tensor(ids), max_new_tokens=4)
+    for blk in model.transformer.h:
+        if getattr(blk, "is_moe", False):
+            blk.mlp._capacity_override = 64  # >= tokens-per-forward: no-drop
+    want = _greedy_oracle(model, ids, 4)
     toks, _ = model.generate(paddle.to_tensor(ids), max_new_tokens=4)
-    assert toks.numpy().shape == (2, 4)
+    np.testing.assert_array_equal(toks.numpy(), want)
     q8, _ = model.generate(paddle.to_tensor(ids), max_new_tokens=4,
                            quant="weight_only_int8")
     assert q8.numpy().shape == (2, 4)
+    # a too-small override means the eval forward WOULD drop: refuse
+    for blk in model.transformer.h:
+        if getattr(blk, "is_moe", False):
+            blk.mlp._capacity_override = 4
+    with pytest.raises(ValueError, match="tokens-per-forward"):
+        model.generate(paddle.to_tensor(ids), max_new_tokens=4)
     # expert banks are NOT in the quant cache (3-D fp weights)
     refs, leaves = model.__dict__["_quant_weights_cache"]["weight_only_int8"]
     assert not any(".mlp." in k for k in leaves)
@@ -647,18 +662,44 @@ def test_equal_config_models_share_compiled_decoders():
     """The decoder is a static jit arg hashed by config: a second model
     with the same architecture (predictor-pool clone, reloaded
     checkpoint) must NOT recompile the generate program."""
-    from paddle_tpu.generation import _GEN_JIT
+    from paddle_tpu import generation as G
     m1 = _model(seed=51)
     rng = np.random.default_rng(51)
     ids = rng.integers(0, 61, (1, 6)).astype(np.int32)
     m1.generate(paddle.to_tensor(ids), max_new_tokens=4)
-    size = _GEN_JIT._cache_size()
+    dec1 = G._decoder_for(m1)
+    gen_jit = G._DEC_JIT[dec1][0]
+    size = gen_jit._cache_size()
+    registry = len(G._DEC_JIT)
     m2 = _model(seed=52)          # same config, different weights
     a, _ = m2.generate(paddle.to_tensor(ids), max_new_tokens=4)
-    assert _GEN_JIT._cache_size() == size     # shared executable
+    assert G._DEC_JIT[G._decoder_for(m2)][0] is gen_jit  # same entry
+    assert gen_jit._cache_size() == size      # shared executable
+    assert len(G._DEC_JIT) == registry        # no new registry entry
     # and it really used m2's weights, not m1's
     b, _ = m1.generate(paddle.to_tensor(ids), max_new_tokens=4)
     assert not np.array_equal(a.numpy(), b.numpy())
+
+
+def test_decoder_jit_registry_is_bounded():
+    """Cycling many architectures must not grow executables forever: the
+    registry LRU-evicts, dropping the evicted decoder's whole jit cache."""
+    from paddle_tpu import generation as G
+
+    class _FakeDec:       # hashable stand-in for a decoder fingerprint
+        pass
+
+    saved = dict(G._DEC_JIT)
+    try:
+        first = _FakeDec()
+        G._jits_for(first)
+        for _ in range(G._DEC_JIT_MAX + 3):
+            G._jits_for(_FakeDec())
+        assert len(G._DEC_JIT) <= G._DEC_JIT_MAX
+        assert first not in G._DEC_JIT        # oldest evicted
+    finally:
+        G._DEC_JIT.clear()
+        G._DEC_JIT.update(saved)              # don't evict real decoders
 
 
 def test_moe_block_mutation_rebuilds_decoder():

@@ -105,6 +105,15 @@ def test_overlap_linear_grads_match_dense(mesh4):
                                    rtol=3e-5, atol=3e-6)
 
 
+def test_overlap_ops_on_amp_white_list():
+    """Enabling the overlap flag must not silently opt the model's largest
+    matmuls out of autocast: the overlap dispatch names are white-listed
+    exactly like 'linear'."""
+    from paddle_tpu.amp import WHITE_LIST
+    assert "sp_overlap_column" in WHITE_LIST
+    assert "sp_overlap_row" in WHITE_LIST
+
+
 def _make(sp, seed=13):
     paddle.seed(seed)
     cfg = LlamaConfig.tiny(vocab_size=64, hidden_size=32, layers=2, heads=4,
