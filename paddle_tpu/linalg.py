@@ -6,3 +6,6 @@ from .ops.linalg import (  # noqa: F401
     svdvals, triangular_solve,
 )
 from .ops.linalg import matrix_norm, vector_norm  # noqa: F401
+# fp8 GEMM rides the quantization module's float8 kernels (reference:
+# python/paddle/linalg.py:30 exports it from tensor/linalg.py:358)
+from .quantization.fp8 import fp8_fp8_half_gemm_fused  # noqa: F401

@@ -361,3 +361,10 @@ class PTQ:
     def convert(self, model: Layer, inplace: bool = True) -> Layer:
         model.eval()
         return _freeze_quanted(model)
+
+
+# float8 path: quantizers, fp8 GEMM, fp8 training linear (reference:
+# nn/quant/format.py fake_fp8_* + linalg.fp8_fp8_half_gemm_fused)
+from .fp8 import (FP8Linear, dequantize_fp8, fake_fp8_dequant,  # noqa: E402
+                  fake_fp8_quant, fp8_fp8_half_gemm_fused, fp8_linear,
+                  quantize_fp8)

@@ -11,7 +11,14 @@ import jax.numpy as jnp
 
 # the one algo registry both public surfaces (quantization.weight_quantize
 # and generation.generate(quant=...)) validate against
-ALGO_BITS = {"weight_only_int8": 8, "weight_only_int4": 4}
+ALGO_BITS = {"weight_only_int8": 8, "weight_only_int4": 4,
+             "weight_only_fp8": "fp8_e4m3"}
+
+# float8_e4m3fn has NO inf: out-of-range casts produce nan, so every
+# quantizer clips to +-finfo.max BEFORE the cast (reference
+# nn/quant/format.py:37 does the same clip)
+FP8_MAX = {"fp8_e4m3": 448.0, "fp8_e5m2": 57344.0}
+FP8_DTYPE = {"fp8_e4m3": jnp.float8_e4m3fn, "fp8_e5m2": jnp.float8_e5m2}
 
 
 def quantize_weight_arrays(arr, bits: int = 8):
@@ -25,6 +32,12 @@ def quantize_weight_arrays(arr, bits: int = 8):
         qmax, lo, hi, dt = 127.0, -128, 127, jnp.int8
     elif bits == 4:
         qmax, lo, hi, dt = 7.0, -8, 7, jnp.int4
+    elif bits in FP8_MAX:
+        fmax = FP8_MAX[bits]
+        a32 = arr.astype(jnp.float32)
+        scale = jnp.maximum(jnp.abs(a32).max(axis=0), 1e-8) / fmax
+        q = jnp.clip(a32 / scale, -fmax, fmax).astype(FP8_DTYPE[bits])
+        return q, scale
     else:
         raise NotImplementedError(f"weight quantization bits={bits}")
     a32 = arr.astype(jnp.float32)

@@ -1,0 +1,191 @@
+"""float8 quantization + fp8 GEMM (TPU-native fp8 serving/training path).
+
+Reference parity:
+* `python/paddle/nn/quant/format.py:27,51` — `fake_fp8_quant` /
+  `fake_fp8_dequant` (scale-to-format-max quantizers used by PTQ export)
+* `python/paddle/tensor/linalg.py:358` — `fp8_fp8_half_gemm_fused`
+  (cutlass fp8 x fp8 -> half GEMM with bias + activation epilogue,
+  `phi/kernels/fusion/cutlass/fp8_gemm/`)
+
+TPU-native design: jnp's native float8_e4m3fn/e5m2 dtypes feed
+`lax.dot_general` directly (MXU has native fp8 on v5p-class chips;
+elsewhere XLA upconverts the operand reads, still halving HBM traffic for
+weights). The "fused epilogue" (scale * out + bias, activation) is plain
+jnp after the dot — XLA fuses it; no custom kernel is warranted.
+float8 casts do NOT saturate (e4m3fn has no inf — overflow becomes nan),
+so every quantizer clips to the format max before casting, matching the
+reference's clip-then-cast.
+
+`FP8Linear` is the training-side recipe (transformer-engine style,
+simplified): forward quantizes activation (per-tensor) and weight
+(per-output-channel) dynamically and runs the fp8 dot; backward runs in
+the input's precision (straight-through through the quantization error).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.dispatch import dispatch, ensure_tensor
+from ..tensor import Tensor
+from ._kernels import FP8_DTYPE, FP8_MAX, quantize_weight_arrays
+
+_CANON = {"e4m3": "fp8_e4m3", "e5m2": "fp8_e5m2",
+          "fp8_e4m3": "fp8_e4m3", "fp8_e5m2": "fp8_e5m2",
+          "float8_e4m3fn": "fp8_e4m3", "float8_e5m2": "fp8_e5m2"}
+
+
+def _fmt(type_str):
+    f = _CANON.get(type_str)
+    if f is None:
+        raise NotImplementedError(
+            f"fp8 format {type_str!r}: supported are e4m3 / e5m2")
+    return f
+
+
+def quantize_fp8(x, type="e4m3"):
+    """Dynamic per-tensor quantization: returns (q float8 Tensor, scale
+    float32 scalar Tensor) with q ~= x / scale, scale = absmax / fmax."""
+    f = _fmt(type)
+    fmax = FP8_MAX[f]
+
+    def fwd(a):
+        a32 = a.astype(jnp.float32)
+        scale = jnp.maximum(jnp.abs(a32).max(), 1e-8) / fmax
+        q = jnp.clip(a32 / scale, -fmax, fmax).astype(FP8_DTYPE[f])
+        return q, scale
+
+    return dispatch("quantize_fp8", fwd, ensure_tensor(x))
+
+
+def dequantize_fp8(q, scale):
+    """Inverse of quantize_fp8: q * scale in float32."""
+    return dispatch("dequantize_fp8",
+                    lambda a, s: a.astype(jnp.float32) * s,
+                    ensure_tensor(q), ensure_tensor(scale))
+
+
+def fake_fp8_quant(input, scale, type="e4m3"):
+    """Parity: nn/quant/format.py:27 — cast(clip(x * fmax / scale)); the
+    PTQ exporter's quantizer (scale here is the observed absmax)."""
+    f = _fmt(type)
+    fmax = FP8_MAX[f]
+
+    def fwd(a, s):
+        return jnp.clip(a.astype(jnp.float32) * fmax / s,
+                        -fmax, fmax).astype(FP8_DTYPE[f])
+
+    return dispatch("fake_fp8_quant", fwd, ensure_tensor(input),
+                    ensure_tensor(scale))
+
+
+def fake_fp8_dequant(input, scale, type="e4m3"):
+    """Parity: nn/quant/format.py:51 — x * scale / fmax."""
+    fmax = FP8_MAX[_fmt(type)]
+    return dispatch("fake_fp8_dequant",
+                    lambda a, s: a.astype(jnp.float32) * s / fmax,
+                    ensure_tensor(input), ensure_tensor(scale))
+
+
+_ACTS = {"identity": lambda x: x, "relu": jax.nn.relu,
+         "gelu": lambda x: jax.nn.gelu(x, approximate=False)}
+
+
+def fp8_fp8_half_gemm_fused(x, y, transpose_x=False, transpose_y=False,
+                            bias=None, scale=1.0, output_dtype="float16",
+                            act="identity", name=None):
+    """Parity: tensor/linalg.py:358 — fp8 x fp8 GEMM producing half
+    precision, with scale / bias / activation epilogue. Inputs must
+    already be float8 tensors (use quantize_fp8); the dot accumulates in
+    float32 (preferred_element_type) and the epilogue fuses behind it."""
+    if act not in _ACTS:
+        raise NotImplementedError(
+            f"fp8_fp8_half_gemm_fused act={act!r}: supported are "
+            f"{sorted(_ACTS)}")
+    out_dt = {"float16": jnp.float16, "bfloat16": jnp.bfloat16}.get(
+        output_dtype)
+    if out_dt is None:
+        raise NotImplementedError(
+            f"fp8_fp8_half_gemm_fused output_dtype={output_dtype!r}: "
+            "supported are float16 / bfloat16")
+    act_fn = _ACTS[act]
+
+    def fwd(xa, ya, *rest):
+        xm = jnp.swapaxes(xa, -1, -2) if transpose_x else xa
+        ym = jnp.swapaxes(ya, -1, -2) if transpose_y else ya
+        n = xm.ndim
+        out = jax.lax.dot_general(
+            xm, ym, (((n - 1,), (ym.ndim - 2,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        out = out * jnp.float32(scale)
+        if rest:
+            out = out + rest[0].astype(jnp.float32)
+        return act_fn(out).astype(out_dt)
+
+    args = [ensure_tensor(x), ensure_tensor(y)]
+    if bias is not None:
+        args.append(ensure_tensor(bias))
+    return dispatch("fp8_fp8_half_gemm_fused", fwd, *args)
+
+
+@jax.custom_vjp
+def _fp8_linear_arr(x, w):
+    fmax = FP8_MAX["fp8_e4m3"]
+    dt = FP8_DTYPE["fp8_e4m3"]
+    x32 = x.astype(jnp.float32)
+    sx = jnp.maximum(jnp.abs(x32).max(), 1e-8) / fmax
+    qx = jnp.clip(x32 / sx, -fmax, fmax).astype(dt)
+    # weight path shares the serving quantizer so train and serve cannot
+    # drift numerically (_kernels.py's contract)
+    qw, sw = quantize_weight_arrays(w, bits="fp8_e4m3")
+    y = jax.lax.dot_general(
+        qx, qw, (((qx.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return (y * (sx * sw)).astype(x.dtype)
+
+
+def _fp8_linear_fwd(x, w):
+    return _fp8_linear_arr(x, w), (x, w)
+
+
+def _fp8_linear_bwd(res, dy):
+    # straight-through: gradients flow as if y = x @ w, computed in the
+    # operands' precision (the transformer-engine "hp dgrad" recipe)
+    x, w = res
+    dx = jnp.matmul(dy, w.T.astype(dy.dtype)).astype(x.dtype)
+    dw = jnp.einsum("...i,...o->io", x.astype(dy.dtype), dy).astype(w.dtype)
+    return dx, dw
+
+
+_fp8_linear_arr.defvjp(_fp8_linear_fwd, _fp8_linear_bwd)
+
+
+def fp8_linear(x, weight, bias=None):
+    """y = x @ weight (+ bias) with the matmul executed in float8_e4m3
+    (dynamic per-tensor activation scale, per-output-channel weight
+    scale); backward is straight-through in the input precision."""
+    xt, wt = ensure_tensor(x), ensure_tensor(weight)
+    if bias is None:
+        return dispatch("fp8_linear", _fp8_linear_arr, xt, wt)
+
+    def fwd(a, w, b):
+        return _fp8_linear_arr(a, w) + b.astype(a.dtype)
+
+    return dispatch("fp8_linear", fwd, xt, wt, ensure_tensor(bias))
+
+
+from .. import nn  # noqa: E402  (after jnp helpers; no cycle — the
+#                     quantization package already imports nn first)
+
+
+class FP8Linear(nn.Linear):
+    """nn.Linear whose matmul executes in float8_e4m3 (dynamic scaling,
+    straight-through backward) — the training-side fp8 recipe."""
+
+    def forward(self, x):
+        return fp8_linear(x, self.weight, self.bias)
+
+
+__all__ = ["quantize_fp8", "dequantize_fp8", "fake_fp8_quant",
+           "fake_fp8_dequant", "fp8_fp8_half_gemm_fused", "fp8_linear",
+           "FP8Linear"]
