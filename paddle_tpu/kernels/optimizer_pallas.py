@@ -1,0 +1,142 @@
+"""Pallas TPU kernel: fused (multi-tensor) AdamW update.
+
+Reference parity: phi/kernels/fused_adam_kernel.h (FusedAdamKernel — the
+multi-tensor apply that updates every parameter of a group in one launch)
+and phi/kernels/adamw_kernel.h (fused decoupled-decay update).
+
+TPU-native design: the whole parameter group is flattened and concatenated
+into ONE 1-D buffer per role (p/g/m/v) and a single Pallas kernel streams
+it block-by-block through VMEM — four HBM reads + three writes per
+element, fp32 math in registers, regardless of how many tensors the group
+has. XLA usually fuses the per-tensor update chain already (which is why
+`merged_adam_` is decided-out as an *op*, OPS_COVERAGE.md:303); this
+kernel exists for the CINN-role perf path where one launch over the
+concatenated group beats XLA's per-tensor fusions on launch overhead and
+tail effects. OFF by default — FLAGS_use_pallas_fused routes
+Adam/AdamW's elementwise update through it on TPU; the jnp update stays
+the numerics oracle and fallback.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from . import fused_pallas as _fp
+
+
+def _adamw_kernel(p_ref, g_ref, m_ref, v_ref, sc_ref,
+                  op_ref, om_ref, ov_ref):
+    """One VMEM block of the flat group. sc_ref: [8] f32 scalars
+    (lr, beta1, beta2, eps, wd, bc1, bc2, decoupled)."""
+    lr, b1, b2, eps = sc_ref[0], sc_ref[1], sc_ref[2], sc_ref[3]
+    wd, bc1, bc2, dec = sc_ref[4], sc_ref[5], sc_ref[6], sc_ref[7]
+    p = p_ref[...].astype(jnp.float32)
+    g = g_ref[...].astype(jnp.float32)
+    m = m_ref[...]
+    v = v_ref[...]
+    # coupled (Adam+L2): decay joins the gradient; decoupled (AdamW):
+    # decay scales the parameter directly
+    g = g + (1.0 - dec) * wd * p
+    m_new = b1 * m + (1.0 - b1) * g
+    v_new = b2 * v + (1.0 - b2) * g * g
+    upd = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
+    p = p * (1.0 - dec * lr * wd) - lr * upd
+    op_ref[...] = p.astype(op_ref.dtype)
+    om_ref[...] = m_new
+    ov_ref[...] = v_new
+
+
+@functools.partial(jax.jit, static_argnames=("decoupled", "block"))
+def _fused_adamw_flat(p, g, m, v, lr, beta1, beta2, eps, wd, step,
+                      decoupled: bool, block: int = 65536):
+    """p/g: flat [n] (param dtype); m/v: flat [n] f32; scalars f32."""
+    n = p.shape[0]
+    bs = _fp._best_block(n, block)
+    bc1 = 1.0 - beta1 ** step
+    bc2 = 1.0 - beta2 ** step
+    sc = jnp.stack([lr, beta1, beta2, eps, wd, bc1, bc2,
+                    jnp.float32(1.0 if decoupled else 0.0)])
+    grid = (n // bs,)
+    blk = pl.BlockSpec((bs,), lambda i: (i,))
+    sc_spec = pl.BlockSpec((8,), lambda i: (0,))
+    return pl.pallas_call(
+        _adamw_kernel,
+        grid=grid,
+        in_specs=[blk, blk, blk, blk, sc_spec],
+        out_specs=[blk, blk, blk],
+        out_shape=[jax.ShapeDtypeStruct((n,), p.dtype),
+                   jax.ShapeDtypeStruct((n,), jnp.float32),
+                   jax.ShapeDtypeStruct((n,), jnp.float32)],
+        interpret=_fp._INTERPRET,
+    )(p, g, m, v, sc)
+
+
+def _pad_to(x, mult):
+    r = (-x.shape[0]) % mult
+    return jnp.pad(x, (0, r)) if r else x
+
+
+def fused_adamw_pallas(p, g, m, v, *, lr, beta1, beta2, eps, wd, step,
+                       decoupled=True):
+    """Single-tensor fused update: returns (p_new, m_new, v_new) with the
+    same math as the jnp oracle (optimizer/__init__.py _adam_update).
+    Flat views are padded to the TPU lane multiple; pad elements update
+    junk that is sliced away."""
+    shape = p.shape
+    n = p.size
+    out_p, out_m, out_v = _fused_adamw_flat(
+        _pad_to(p.reshape(-1), 1024), _pad_to(g.reshape(-1), 1024),
+        _pad_to(m.reshape(-1), 1024), _pad_to(v.reshape(-1), 1024),
+        jnp.float32(lr), jnp.float32(beta1), jnp.float32(beta2),
+        jnp.float32(eps), jnp.float32(wd), jnp.float32(step),
+        bool(decoupled))
+    return (out_p[:n].reshape(shape), out_m[:n].reshape(shape),
+            out_v[:n].reshape(shape))
+
+
+def multi_tensor_adamw_pallas(params, grads, ms, vs, *, lr, beta1, beta2,
+                              eps, wds, step, decoupled=True):
+    """Multi-tensor apply (FusedAdamKernel capability): every tensor of
+    the group with the SAME weight-decay coefficient is concatenated into
+    one flat buffer and updated by one kernel launch; distinct wd values
+    (e.g. no-decay bias/norm groups) get one launch each.
+
+    params/grads/ms/vs: lists of arrays; wds: per-tensor wd floats.
+    Returns (new_params, new_ms, new_vs) lists in input order.
+    """
+    if not (len(params) == len(grads) == len(ms) == len(vs) == len(wds)):
+        raise ValueError("multi_tensor_adamw: list length mismatch")
+    out_p = [None] * len(params)
+    out_m = [None] * len(params)
+    out_v = [None] * len(params)
+    groups = {}
+    for i, (p, g, wd) in enumerate(zip(params, grads, wds)):
+        # grads concatenate at their OWN dtype (the kernel upcasts to f32
+        # internally) — downcasting fp32 grads to bf16 params would lose
+        # update precision vs the oracle
+        groups.setdefault((float(wd), p.dtype, g.dtype), []).append(i)
+    for (wd, _pdt, _gdt), idxs in groups.items():
+        flat_p = jnp.concatenate([params[i].reshape(-1) for i in idxs])
+        flat_g = jnp.concatenate([grads[i].reshape(-1) for i in idxs])
+        flat_m = jnp.concatenate([ms[i].reshape(-1) for i in idxs])
+        flat_v = jnp.concatenate([vs[i].reshape(-1) for i in idxs])
+        np_, nm, nv = _fused_adamw_flat(
+            _pad_to(flat_p, 1024), _pad_to(flat_g, 1024),
+            _pad_to(flat_m, 1024), _pad_to(flat_v, 1024),
+            jnp.float32(lr), jnp.float32(beta1), jnp.float32(beta2),
+            jnp.float32(eps), jnp.float32(wd), jnp.float32(step),
+            bool(decoupled))
+        off = 0
+        for i in idxs:
+            sz = params[i].size
+            out_p[i] = np_[off:off + sz].reshape(params[i].shape)
+            out_m[i] = nm[off:off + sz].reshape(ms[i].shape)
+            out_v[i] = nv[off:off + sz].reshape(vs[i].shape)
+            off += sz
+    return out_p, out_m, out_v
+
+
+__all__ = ["fused_adamw_pallas", "multi_tensor_adamw_pallas"]

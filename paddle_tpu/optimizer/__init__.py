@@ -300,6 +300,46 @@ class Adam(Optimizer):
                                    self._decoupled_wd)
         return new_p, {"moment1": m, "moment2": v}
 
+    def step(self):
+        from ..kernels import fused_pallas, optimizer_pallas
+        if not fused_pallas.enabled():
+            return super().step()
+        # CINN-role fused path (reference FusedAdamKernel): the whole
+        # parameter group updates in ONE Pallas launch per (lr, step)
+        # bucket — multi_tensor_adamw_pallas concatenates the flat views,
+        # so N parameters pay one kernel, not N. Numerics == _adam_update.
+        self._global_step += 1
+        pgs = self._collect_params_grads()
+        if not pgs:
+            return
+        buckets = {}
+        for p, g in pgs:
+            acc = self._accumulators.get(id(p))
+            if acc is None:
+                acc = self._init_state(p)
+                acc["_step"] = 0
+                self._accumulators[id(p)] = acc
+            step = int(acc.get("_step", 0)) + 1
+            lr_val = self.get_lr() * p.optimize_attr.get(
+                "learning_rate", 1.0) if hasattr(p, "optimize_attr") \
+                else self.get_lr()
+            buckets.setdefault((float(lr_val), step), []).append((p, g, acc))
+        for (lr_val, step), items in buckets.items():
+            nps, nms, nvs = optimizer_pallas.multi_tensor_adamw_pallas(
+                [p._data for p, _, _ in items],
+                [g._data.astype(p._data.dtype) for p, g, _ in items],
+                [a["moment1"] for _, _, a in items],
+                [a["moment2"] for _, _, a in items],
+                wds=[self._wd_coeff(p) for p, _, _ in items],
+                lr=lr_val, beta1=self._beta1, beta2=self._beta2,
+                eps=self._epsilon, step=float(step),
+                decoupled=self._decoupled_wd)
+            for (p, _, acc), np_, nm, nv in zip(items, nps, nms, nvs):
+                p._data = np_
+                acc["moment1"] = nm
+                acc["moment2"] = nv
+                acc["_step"] = step
+
 
 class AdamW(Adam):
     """Decoupled weight decay (parity: paddle.optimizer.AdamW, adamw.py:528)."""

@@ -46,6 +46,17 @@ def quantize_weight_arrays(arr, bits: int = 8):
     return q, scale
 
 
+def quantize_tensor_fp8_arrays(arr, fmt: str = "fp8_e4m3"):
+    """Dynamic per-tensor float8 quantization: (q float8, scale f32 scalar)
+    with q ~= arr / scale, scale = absmax / format-max. The ONE home of the
+    clip-before-cast rule for per-tensor scales (e4m3fn overflow is nan)."""
+    fmax = FP8_MAX[fmt]
+    a32 = arr.astype(jnp.float32)
+    scale = jnp.maximum(jnp.abs(a32).max(), 1e-8) / fmax
+    q = jnp.clip(a32 / scale, -fmax, fmax).astype(FP8_DTYPE[fmt])
+    return q, scale
+
+
 def quant_matmul_arrays(x, q, s):
     """(x @ int8/int4-matrix) with the per-output-channel scale applied to
     the fp32-upcast result — mathematically identical to dequantizing the

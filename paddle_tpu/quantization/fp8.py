@@ -28,7 +28,8 @@ import jax.numpy as jnp
 
 from ..ops.dispatch import dispatch, ensure_tensor
 from ..tensor import Tensor
-from ._kernels import FP8_DTYPE, FP8_MAX, quantize_weight_arrays
+from ._kernels import (FP8_DTYPE, FP8_MAX, quantize_tensor_fp8_arrays,
+                       quantize_weight_arrays)
 
 _CANON = {"e4m3": "fp8_e4m3", "e5m2": "fp8_e5m2",
           "fp8_e4m3": "fp8_e4m3", "fp8_e5m2": "fp8_e5m2",
@@ -47,15 +48,9 @@ def quantize_fp8(x, type="e4m3"):
     """Dynamic per-tensor quantization: returns (q float8 Tensor, scale
     float32 scalar Tensor) with q ~= x / scale, scale = absmax / fmax."""
     f = _fmt(type)
-    fmax = FP8_MAX[f]
-
-    def fwd(a):
-        a32 = a.astype(jnp.float32)
-        scale = jnp.maximum(jnp.abs(a32).max(), 1e-8) / fmax
-        q = jnp.clip(a32 / scale, -fmax, fmax).astype(FP8_DTYPE[f])
-        return q, scale
-
-    return dispatch("quantize_fp8", fwd, ensure_tensor(x))
+    return dispatch("quantize_fp8",
+                    lambda a: quantize_tensor_fp8_arrays(a, f),
+                    ensure_tensor(x))
 
 
 def dequantize_fp8(q, scale):
@@ -113,10 +108,9 @@ def fp8_fp8_half_gemm_fused(x, y, transpose_x=False, transpose_y=False,
     def fwd(xa, ya, *rest):
         xm = jnp.swapaxes(xa, -1, -2) if transpose_x else xa
         ym = jnp.swapaxes(ya, -1, -2) if transpose_y else ya
-        n = xm.ndim
-        out = jax.lax.dot_general(
-            xm, ym, (((n - 1,), (ym.ndim - 2,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        # jnp.matmul carries leading batch dims through correctly (a raw
+        # dot_general with empty batch dims would outer-product them)
+        out = jnp.matmul(xm, ym, preferred_element_type=jnp.float32)
         out = out * jnp.float32(scale)
         if rest:
             out = out + rest[0].astype(jnp.float32)
@@ -130,13 +124,8 @@ def fp8_fp8_half_gemm_fused(x, y, transpose_x=False, transpose_y=False,
 
 @jax.custom_vjp
 def _fp8_linear_arr(x, w):
-    fmax = FP8_MAX["fp8_e4m3"]
-    dt = FP8_DTYPE["fp8_e4m3"]
-    x32 = x.astype(jnp.float32)
-    sx = jnp.maximum(jnp.abs(x32).max(), 1e-8) / fmax
-    qx = jnp.clip(x32 / sx, -fmax, fmax).astype(dt)
-    # weight path shares the serving quantizer so train and serve cannot
-    # drift numerically (_kernels.py's contract)
+    # both quantizers live in _kernels.py so train and serve cannot drift
+    qx, sx = quantize_tensor_fp8_arrays(x)
     qw, sw = quantize_weight_arrays(w, bits="fp8_e4m3")
     y = jax.lax.dot_general(
         qx, qw, (((qx.ndim - 1,), (0,)), ((), ())),

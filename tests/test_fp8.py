@@ -10,8 +10,6 @@ import pytest
 import jax.numpy as jnp
 
 import paddle_tpu as paddle
-import paddle_tpu.nn as nn
-from paddle_tpu import optimizer as opt
 from paddle_tpu import quantization as Q
 
 
@@ -84,6 +82,23 @@ def test_fp8_gemm_epilogue_bias_act_transpose():
                                rtol=2e-3, atol=2e-3)
     with pytest.raises(NotImplementedError, match="act"):
         paddle.linalg.fp8_fp8_half_gemm_fused(qx, qy, act="swish")
+
+
+def test_fp8_gemm_batched_inputs():
+    """3-D operands are a batched matmul ([B,M,K]x[B,K,N]->[B,M,N]), not a
+    cross-batch outer product."""
+    rng = np.random.default_rng(7)
+    x = rng.standard_normal((3, 4, 8)).astype(np.float32)
+    y = rng.standard_normal((3, 8, 5)).astype(np.float32)
+    qx = paddle.to_tensor(jnp.asarray(x).astype(jnp.float8_e4m3fn))
+    qy = paddle.to_tensor(jnp.asarray(y).astype(jnp.float8_e4m3fn))
+    out = paddle.linalg.fp8_fp8_half_gemm_fused(qx, qy,
+                                                output_dtype="bfloat16")
+    assert out.numpy().shape == (3, 4, 5)
+    want = np.matmul(qx.numpy().astype(np.float32),
+                     qy.numpy().astype(np.float32))
+    np.testing.assert_allclose(out.numpy().astype(np.float32), want,
+                               rtol=8e-3, atol=1e-2)
 
 
 def test_weight_only_fp8_quantize_and_linear():
