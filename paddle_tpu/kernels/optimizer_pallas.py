@@ -6,15 +6,16 @@ and phi/kernels/adamw_kernel.h (fused decoupled-decay update).
 
 TPU-native design: the whole parameter group is flattened and concatenated
 into ONE 1-D buffer per role (p/g/m/v) and a single Pallas kernel streams
-it block-by-block through VMEM — four HBM reads + three writes per
-element, fp32 math in registers, regardless of how many tensors the group
-has. XLA usually fuses the per-tensor update chain already (which is why
-`merged_adam_` is decided-out as an *op*, OPS_COVERAGE.md:303); this
-kernel exists for the CINN-role perf path where one launch over the
-concatenated group beats XLA's per-tensor fusions on launch overhead and
-tail effects. OFF by default — FLAGS_use_pallas_fused routes
-Adam/AdamW's elementwise update through it on TPU; the jnp update stays
-the numerics oracle and fallback.
+it block-by-block through VMEM with fp32 math in registers. The kernel
+itself is four HBM reads + three writes per element; the concat prologue
+and split epilogue add device-side copies (compiled into the same program
+so XLA schedules them around the launch) — a persistent flat-buffer
+optimizer state would remove those and is the natural extension. What one
+launch buys over XLA's per-tensor fusions (which are already good — that
+is why `merged_adam_` is decided-out as an *op*, OPS_COVERAGE.md:303) is
+launch-overhead amortization and no per-tensor tail effects. OFF by
+default — FLAGS_use_pallas_fused routes Adam/AdamW's step through it on
+TPU; the jnp update stays the numerics oracle and fallback.
 """
 from __future__ import annotations
 
@@ -97,14 +98,46 @@ def fused_adamw_pallas(p, g, m, v, *, lr, beta1, beta2, eps, wd, step,
             out_v[:n].reshape(shape))
 
 
+@functools.partial(jax.jit, static_argnames=("decoupled",))
+def _group_update(ps, gs, ms, vs, lr, beta1, beta2, eps, wd, step,
+                  decoupled):
+    """One compiled program per group shape-set: concat prologue -> one
+    Pallas launch -> split epilogue. The concat/split are device-side
+    copies XLA schedules around the single kernel; a persistent
+    flat-buffer optimizer state would eliminate them entirely and is the
+    natural next step at scale — the launch amortization is what this
+    path buys today."""
+    flat_p = jnp.concatenate([p.reshape(-1) for p in ps])
+    flat_g = jnp.concatenate([g.reshape(-1) for g in gs])
+    flat_m = jnp.concatenate([m.reshape(-1) for m in ms])
+    flat_v = jnp.concatenate([v.reshape(-1) for v in vs])
+    np_, nm, nv = _fused_adamw_flat(
+        _pad_to(flat_p, 1024), _pad_to(flat_g, 1024),
+        _pad_to(flat_m, 1024), _pad_to(flat_v, 1024),
+        lr, beta1, beta2, eps, wd, step, decoupled)
+    out_p, out_m, out_v = [], [], []
+    off = 0
+    for p in ps:
+        sz = p.size
+        out_p.append(np_[off:off + sz].reshape(p.shape))
+        out_m.append(nm[off:off + sz].reshape(p.shape))
+        out_v.append(nv[off:off + sz].reshape(p.shape))
+        off += sz
+    return out_p, out_m, out_v
+
+
 def multi_tensor_adamw_pallas(params, grads, ms, vs, *, lr, beta1, beta2,
                               eps, wds, step, decoupled=True):
     """Multi-tensor apply (FusedAdamKernel capability): every tensor of
-    the group with the SAME weight-decay coefficient is concatenated into
-    one flat buffer and updated by one kernel launch; distinct wd values
-    (e.g. no-decay bias/norm groups) get one launch each.
+    the group with the SAME weight-decay coefficient updates through one
+    compiled concat -> kernel -> split program; distinct wd values (e.g.
+    no-decay bias/norm groups) get one program each.
 
     params/grads/ms/vs: lists of arrays; wds: per-tensor wd floats.
+    Grads pass at their own dtype (the kernel upcasts to f32 internally);
+    note Adam.step pre-casts grads to the param dtype for exact parity
+    with the per-tensor oracle, so the dtype split below only engages for
+    direct callers that keep fp32 grads against bf16 params.
     Returns (new_params, new_ms, new_vs) lists in input order.
     """
     if not (len(params) == len(grads) == len(ms) == len(vs) == len(wds)):
@@ -114,28 +147,18 @@ def multi_tensor_adamw_pallas(params, grads, ms, vs, *, lr, beta1, beta2,
     out_v = [None] * len(params)
     groups = {}
     for i, (p, g, wd) in enumerate(zip(params, grads, wds)):
-        # grads concatenate at their OWN dtype (the kernel upcasts to f32
-        # internally) — downcasting fp32 grads to bf16 params would lose
-        # update precision vs the oracle
         groups.setdefault((float(wd), p.dtype, g.dtype), []).append(i)
     for (wd, _pdt, _gdt), idxs in groups.items():
-        flat_p = jnp.concatenate([params[i].reshape(-1) for i in idxs])
-        flat_g = jnp.concatenate([grads[i].reshape(-1) for i in idxs])
-        flat_m = jnp.concatenate([ms[i].reshape(-1) for i in idxs])
-        flat_v = jnp.concatenate([vs[i].reshape(-1) for i in idxs])
-        np_, nm, nv = _fused_adamw_flat(
-            _pad_to(flat_p, 1024), _pad_to(flat_g, 1024),
-            _pad_to(flat_m, 1024), _pad_to(flat_v, 1024),
+        nps, nms, nvs = _group_update(
+            [params[i] for i in idxs], [grads[i] for i in idxs],
+            [ms[i] for i in idxs], [vs[i] for i in idxs],
             jnp.float32(lr), jnp.float32(beta1), jnp.float32(beta2),
             jnp.float32(eps), jnp.float32(wd), jnp.float32(step),
             bool(decoupled))
-        off = 0
-        for i in idxs:
-            sz = params[i].size
-            out_p[i] = np_[off:off + sz].reshape(params[i].shape)
-            out_m[i] = nm[off:off + sz].reshape(ms[i].shape)
-            out_v[i] = nv[off:off + sz].reshape(vs[i].shape)
-            off += sz
+        for i, np_, nm, nv in zip(idxs, nps, nms, nvs):
+            out_p[i] = np_
+            out_m[i] = nm
+            out_v[i] = nv
     return out_p, out_m, out_v
 
 

@@ -1,0 +1,397 @@
+"""jaxpr -> ONNX GraphProto converter.
+
+The export surface traces the layer's eval forward to a jaxpr (the same
+trace jit.save serializes as StableHLO) and maps its primitives onto ONNX
+ops. This is deliberately the TPU-native route: the source of truth is
+the traced XLA-facing graph, not a parallel op-by-op converter registry
+like the reference's external paddle2onnx
+(python/paddle/onnx/export.py capability).
+
+Coverage is the primitive set of standard inference graphs — matmuls,
+convolutions (NCHW), elementwise math, normalization/softmax patterns
+(they arrive as reduce/broadcast/elementwise prims), embedding gathers,
+pooling via reduce_window, pad/slice/concat/transpose/reshape. Anything
+else raises NotImplementedError naming the primitive so the failure is
+loud, never a silently wrong graph.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+import jax
+from jax.extend import core as jcore
+
+from . import _proto as P
+
+_UNARY = {
+    "neg": "Neg", "abs": "Abs", "exp": "Exp", "log": "Log", "tanh": "Tanh",
+    "logistic": "Sigmoid", "sqrt": "Sqrt", "sign": "Sign", "floor": "Floor",
+    "ceil": "Ceil", "round": "Round", "erf": "Erf", "not": "Not",
+    "is_finite": "IsInf",  # replaced below; placeholder never used directly
+}
+_BINARY = {
+    "add": "Add", "sub": "Sub", "mul": "Mul", "div": "Div", "max": "Max",
+    "min": "Min", "pow": "Pow", "eq": "Equal", "lt": "Less",
+    "le": "LessOrEqual", "gt": "Greater", "ge": "GreaterOrEqual",
+    "and": "And", "or": "Or", "xor": "Xor",
+}
+# reduce prims whose opset-13 form takes axes as an ATTRIBUTE
+_REDUCE_ATTR = {"reduce_max": "ReduceMax", "reduce_min": "ReduceMin",
+                "reduce_prod": "ReduceProd"}
+
+
+class Converter:
+    def __init__(self):
+        self.nodes: List[bytes] = []
+        self.inits: List[bytes] = []
+        self.names: Dict[int, str] = {}   # id(var) -> onnx name
+        self._ctr = 0
+
+    # -- naming / constants ---------------------------------------------------
+    def fresh(self, hint="t"):
+        self._ctr += 1
+        return f"{hint}_{self._ctr}"
+
+    def const(self, arr, hint="const"):
+        arr = np.asarray(arr)
+        name = self.fresh(hint)
+        self.inits.append(P.tensor_proto(name, arr))
+        return name
+
+    def name_of(self, v):
+        if isinstance(v, jcore.Literal):
+            val = np.asarray(v.val)
+            if val.dtype == np.float64:
+                val = val.astype(np.float32)
+            if val.dtype == np.int64 and str(v.aval.dtype) == "int32":
+                val = val.astype(np.int32)
+            return self.const(val.astype(str(v.aval.dtype)), "lit")
+        return self.names[id(v)]
+
+    def bind(self, var, name):
+        self.names[id(var)] = name
+
+    def emit(self, op, ins, n_out=1, attrs=(), hint=None):
+        outs = [self.fresh(hint or op.lower()) for _ in range(n_out)]
+        self.nodes.append(P.node(op, ins, outs, attrs=list(attrs)))
+        return outs
+
+    # -- graph walk -----------------------------------------------------------
+    def run(self, closed, invar_names):
+        jaxpr = closed.jaxpr
+        for v, c in zip(jaxpr.constvars, closed.consts):
+            self.bind(v, self.const(np.asarray(c), "jconst"))
+        for v, n in zip(jaxpr.invars, invar_names):
+            self.bind(v, n)
+        self._walk(jaxpr)
+        return [self.name_of(v) for v in jaxpr.outvars]
+
+    def _inline(self, inner_closed, eqn):
+        sub_names = [self.name_of(v) for v in eqn.invars]
+        jaxpr = inner_closed.jaxpr
+        for v, c in zip(jaxpr.constvars, inner_closed.consts):
+            self.bind(v, self.const(np.asarray(c), "jconst"))
+        for v, n in zip(jaxpr.invars, sub_names):
+            self.bind(v, n)
+        self._walk(jaxpr)
+        for outer, inner in zip(eqn.outvars, jaxpr.outvars):
+            self.bind(outer, self.name_of(inner))
+
+    def _walk(self, jaxpr):
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            # call-like prims: inline the inner jaxpr
+            if name in ("jit", "pjit", "closed_call", "core_call",
+                        "xla_call"):
+                self._inline(eqn.params["jaxpr"], eqn)
+                continue
+            if name == "remat" or name == "checkpoint":
+                inner = eqn.params["jaxpr"]
+                self._inline(jcore.ClosedJaxpr(inner, ()), eqn)
+                continue
+            if name == "custom_jvp_call":
+                self._inline(eqn.params["call_jaxpr"], eqn)
+                continue
+            if name == "custom_vjp_call":
+                key = "call_jaxpr" if "call_jaxpr" in eqn.params \
+                    else "fun_jaxpr"
+                self._inline(eqn.params[key], eqn)
+                continue
+            handler = getattr(self, f"_p_{name}", None)
+            if handler is None:
+                handler = self._generic(name)
+            handler(eqn)
+
+    # -- generic elementwise --------------------------------------------------
+    def _generic(self, name):
+        if name in _UNARY and name != "is_finite":
+            def h(eqn, op=_UNARY[name]):
+                o, = self.emit(op, [self.name_of(eqn.invars[0])])
+                self.bind(eqn.outvars[0], o)
+            return h
+        if name in _BINARY:
+            def h(eqn, op=_BINARY[name]):
+                o, = self.emit(op, [self.name_of(v) for v in eqn.invars])
+                self.bind(eqn.outvars[0], o)
+            return h
+        if name in _REDUCE_ATTR:
+            def h(eqn, op=_REDUCE_ATTR[name]):
+                o, = self.emit(op, [self.name_of(eqn.invars[0])],
+                               attrs=[P.attr_ints("axes", eqn.params["axes"]),
+                                      P.attr_int("keepdims", 0)])
+                self.bind(eqn.outvars[0], o)
+            return h
+
+        def fail(eqn):
+            raise NotImplementedError(
+                f"ONNX export: primitive '{name}' has no mapping (eqn: "
+                f"{eqn}). The StableHLO bundle (export_format='stablehlo') "
+                "covers every op; ONNX covers standard inference graphs.")
+        return fail
+
+    # -- specific prims -------------------------------------------------------
+    def _p_stop_gradient(self, eqn):
+        self.bind(eqn.outvars[0], self.name_of(eqn.invars[0]))
+
+    def _p_copy(self, eqn):
+        self.bind(eqn.outvars[0], self.name_of(eqn.invars[0]))
+
+    def _p_square(self, eqn):
+        x = self.name_of(eqn.invars[0])
+        o, = self.emit("Mul", [x, x])
+        self.bind(eqn.outvars[0], o)
+
+    def _p_rsqrt(self, eqn):
+        s, = self.emit("Sqrt", [self.name_of(eqn.invars[0])])
+        o, = self.emit("Reciprocal", [s])
+        self.bind(eqn.outvars[0], o)
+
+    def _p_integer_pow(self, eqn):
+        x = eqn.invars[0]
+        e = self.const(np.asarray(eqn.params["y"],
+                                  dtype=str(x.aval.dtype)), "exp")
+        o, = self.emit("Pow", [self.name_of(x), e])
+        self.bind(eqn.outvars[0], o)
+
+    def _p_convert_element_type(self, eqn):
+        dt = P.DTYPE_ENUM[str(eqn.params["new_dtype"])]
+        o, = self.emit("Cast", [self.name_of(eqn.invars[0])],
+                       attrs=[P.attr_int("to", dt)])
+        self.bind(eqn.outvars[0], o)
+
+    def _p_reshape(self, eqn):
+        src = self.name_of(eqn.invars[0])
+        if eqn.params.get("dimensions") is not None:
+            src, = self.emit(
+                "Transpose", [src],
+                attrs=[P.attr_ints("perm", eqn.params["dimensions"])])
+        shape = self.const(np.asarray(eqn.params["new_sizes"], np.int64),
+                           "shape")
+        o, = self.emit("Reshape", [src, shape])
+        self.bind(eqn.outvars[0], o)
+
+    def _p_transpose(self, eqn):
+        o, = self.emit(
+            "Transpose", [self.name_of(eqn.invars[0])],
+            attrs=[P.attr_ints("perm", eqn.params["permutation"])])
+        self.bind(eqn.outvars[0], o)
+
+    def _p_broadcast_in_dim(self, eqn):
+        x = eqn.invars[0]
+        shape = tuple(eqn.params["shape"])
+        bdims = tuple(eqn.params["broadcast_dimensions"])
+        src = self.name_of(x)
+        # insert singleton dims so rank matches, then Expand broadcasts
+        if x.aval.ndim != len(shape):
+            interim = [1] * len(shape)
+            for i, d in enumerate(bdims):
+                interim[d] = x.aval.shape[i]
+            ishape = self.const(np.asarray(interim, np.int64), "shape")
+            src, = self.emit("Reshape", [src, ishape])
+        tgt = self.const(np.asarray(shape, np.int64), "shape")
+        o, = self.emit("Expand", [src, tgt])
+        self.bind(eqn.outvars[0], o)
+
+    def _p_dot_general(self, eqn):
+        (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+        lhs, rhs = eqn.invars
+        nb = len(lb)
+        plain = (nb == 0 and lc == (lhs.aval.ndim - 1,) and rc == (0,))
+        batched = (lb == tuple(range(nb)) and rb == tuple(range(nb))
+                   and lc == (lhs.aval.ndim - 1,)
+                   and rc == (rhs.aval.ndim - 2,) and nb > 0)
+        if not (plain or batched):
+            raise NotImplementedError(
+                "ONNX export: dot_general with dimension_numbers "
+                f"{eqn.params['dimension_numbers']} is not a matmul "
+                "pattern (transpose operands into numpy-matmul form)")
+        o, = self.emit("MatMul", [self.name_of(lhs), self.name_of(rhs)])
+        self.bind(eqn.outvars[0], o)
+
+    def _p_conv_general_dilated(self, eqn):
+        p = eqn.params
+        dn = p["dimension_numbers"]
+        nd = len(p["window_strides"])
+        iota = tuple(range(nd + 2))
+        if (tuple(dn.lhs_spec) != iota or tuple(dn.rhs_spec) != iota
+                or tuple(dn.out_spec) != iota):
+            raise NotImplementedError(
+                "ONNX export: conv layout must be NC*/OI* (channel-first); "
+                f"got {dn}")
+        if any(d != 1 for d in p["lhs_dilation"]):
+            raise NotImplementedError(
+                "ONNX export: transposed convolution (lhs_dilation) is "
+                "not mapped")
+        if p.get("batch_group_count", 1) != 1:
+            raise NotImplementedError("ONNX export: batch_group_count != 1")
+        pads = [lo for lo, _ in p["padding"]] + [hi for _, hi in p["padding"]]
+        attrs = [P.attr_ints("strides", p["window_strides"]),
+                 P.attr_ints("pads", pads),
+                 P.attr_ints("dilations", p["rhs_dilation"]),
+                 P.attr_int("group", p["feature_group_count"])]
+        o, = self.emit("Conv", [self.name_of(v) for v in eqn.invars],
+                       attrs=attrs)
+        self.bind(eqn.outvars[0], o)
+
+    def _p_reduce_sum(self, eqn):
+        axes = self.const(np.asarray(eqn.params["axes"], np.int64), "axes")
+        o, = self.emit("ReduceSum", [self.name_of(eqn.invars[0]), axes],
+                       attrs=[P.attr_int("keepdims", 0)])
+        self.bind(eqn.outvars[0], o)
+
+    def _p_argmax(self, eqn):
+        self._arg_minmax(eqn, "ArgMax")
+
+    def _p_argmin(self, eqn):
+        self._arg_minmax(eqn, "ArgMin")
+
+    def _arg_minmax(self, eqn, op):
+        axes = eqn.params["axes"]
+        o, = self.emit(op, [self.name_of(eqn.invars[0])],
+                       attrs=[P.attr_int("axis", axes[0]),
+                              P.attr_int("keepdims", 0)])
+        dt = str(eqn.outvars[0].aval.dtype)
+        if dt != "int64":                      # ONNX Arg* emits int64
+            o, = self.emit("Cast", [o],
+                           attrs=[P.attr_int("to", P.DTYPE_ENUM[dt])])
+        self.bind(eqn.outvars[0], o)
+
+    def _p_select_n(self, eqn):
+        pred, *cases = eqn.invars
+        if len(cases) != 2:
+            raise NotImplementedError("ONNX export: select_n with >2 cases")
+        # select_n picks cases[pred]: pred==True -> cases[1]
+        o, = self.emit("Where", [self.name_of(pred), self.name_of(cases[1]),
+                                 self.name_of(cases[0])])
+        # ONNX Where(cond, X, Y) = cond ? X : Y — X is the True branch
+        self.bind(eqn.outvars[0], o)
+
+    def _p_concatenate(self, eqn):
+        o, = self.emit("Concat", [self.name_of(v) for v in eqn.invars],
+                       attrs=[P.attr_int("axis", eqn.params["dimension"])])
+        self.bind(eqn.outvars[0], o)
+
+    def _p_slice(self, eqn):
+        p = eqn.params
+        nd = len(p["start_indices"])
+        starts = self.const(np.asarray(p["start_indices"], np.int64), "st")
+        ends = self.const(np.asarray(p["limit_indices"], np.int64), "en")
+        axes = self.const(np.arange(nd, dtype=np.int64), "ax")
+        steps = self.const(
+            np.asarray(p["strides"] or [1] * nd, np.int64), "sp")
+        o, = self.emit("Slice", [self.name_of(eqn.invars[0]), starts, ends,
+                                 axes, steps])
+        self.bind(eqn.outvars[0], o)
+
+    def _p_pad(self, eqn):
+        cfg = eqn.params["padding_config"]
+        if any(i != 0 for _, _, i in cfg):
+            raise NotImplementedError("ONNX export: interior padding")
+        pads = [lo for lo, _, _ in cfg] + [hi for _, hi, _ in cfg]
+        pc = self.const(np.asarray(pads, np.int64), "pads")
+        o, = self.emit("Pad", [self.name_of(eqn.invars[0]), pc,
+                               self.name_of(eqn.invars[1])])
+        self.bind(eqn.outvars[0], o)
+
+    def _p_iota(self, eqn):
+        arr = np.asarray(jax.lax.iota(eqn.params["dtype"],
+                                      eqn.params["shape"][
+                                          eqn.params["dimension"]]))
+        shape = eqn.params["shape"]
+        if len(shape) != 1:
+            full = np.broadcast_to(
+                arr.reshape([-1 if i == eqn.params["dimension"] else 1
+                             for i in range(len(shape))]), shape)
+        else:
+            full = arr
+        self.bind(eqn.outvars[0], self.const(np.ascontiguousarray(full),
+                                             "iota"))
+
+    def _p_gather(self, eqn):
+        dnums = eqn.params["dimension_numbers"]
+        operand, indices = eqn.invars
+        slice_sizes = tuple(eqn.params["slice_sizes"])
+        # embedding pattern: take(w, ids, axis=0) — single collapsed dim 0,
+        # full trailing slices, index vector of length 1
+        full_tail = slice_sizes[1:] == tuple(operand.aval.shape[1:])
+        if not (tuple(dnums.collapsed_slice_dims) == (0,)
+                and tuple(dnums.start_index_map) == (0,)
+                and slice_sizes[0] == 1 and full_tail
+                and indices.aval.shape[-1] == 1):
+            raise NotImplementedError(
+                "ONNX export: gather is mapped only for the embedding "
+                f"pattern take(w, ids, axis=0); got {dnums}")
+        idx_shape = self.const(
+            np.asarray(indices.aval.shape[:-1], np.int64), "shape")
+        ids, = self.emit("Reshape", [self.name_of(indices), idx_shape])
+        o, = self.emit("Gather", [self.name_of(operand), ids],
+                       attrs=[P.attr_int("axis", 0)])
+        self.bind(eqn.outvars[0], o)
+
+    def _p_reduce_window_max(self, eqn):
+        self._pool(eqn, "MaxPool")
+
+    def _p_reduce_window_sum(self, eqn):
+        self._pool(eqn, "SumPool")
+
+    def _pool(self, eqn, kind):
+        p = eqn.params
+        wd = tuple(p["window_dimensions"])
+        ws = tuple(p["window_strides"])
+        pad = tuple(p["padding"])
+        if len(wd) < 3 or wd[0] != 1 or wd[1] != 1:
+            raise NotImplementedError(
+                f"ONNX export: reduce_window over dims {wd} is not an "
+                "NCHW spatial pooling")
+        if any(d != 1 for d in p.get("window_dilation", (1,) * len(wd))):
+            raise NotImplementedError("ONNX export: dilated pooling")
+        spatial = len(wd) - 2
+        pads = [lo for lo, _ in pad[2:]] + [hi for _, hi in pad[2:]]
+        attrs = [P.attr_ints("kernel_shape", wd[2:]),
+                 P.attr_ints("strides", ws[2:]),
+                 P.attr_ints("pads", pads)]
+        src = self.name_of(eqn.invars[0])
+        if kind == "MaxPool":
+            o, = self.emit("MaxPool", [src], attrs=attrs)
+        else:
+            # sum pooling = AveragePool(count_include_pad) * window volume
+            o, = self.emit("AveragePool", [src],
+                           attrs=attrs + [P.attr_int("count_include_pad", 1)])
+            vol = float(np.prod(wd[2:]))
+            c = self.const(np.asarray(vol, str(eqn.invars[0].aval.dtype)),
+                           "winvol")
+            o, = self.emit("Mul", [o, c])
+        self.bind(eqn.outvars[0], o)
+
+    def _p_squeeze(self, eqn):
+        shape = self.const(
+            np.asarray(eqn.outvars[0].aval.shape, np.int64), "shape")
+        o, = self.emit("Reshape", [self.name_of(eqn.invars[0]), shape])
+        self.bind(eqn.outvars[0], o)
+
+    def _p_expand_dims(self, eqn):
+        self._p_squeeze(eqn)
+
+    def _p_rev(self, eqn):
+        raise NotImplementedError("ONNX export: lax.rev")

@@ -1,0 +1,212 @@
+"""Minimal ONNX protobuf writer (wire format hand-encoded).
+
+The environment ships no `onnx` package and no converter dependency, so
+the exporter serializes ModelProto directly at the protobuf wire level.
+Field numbers follow the stable public onnx.proto schema (ONNX IR v8,
+unchanged for these messages since IR v4):
+
+  ModelProto:   ir_version=1, producer_name=2, producer_version=3,
+                graph=7, opset_import=8
+  GraphProto:   node=1, name=2, initializer=5, input=11, output=12
+  NodeProto:    input=1, output=2, name=3, op_type=4, attribute=5
+  AttributeProto: name=1, f=2, i=3, s=4, t=5, floats=7, ints=8, type=20
+  TensorProto:  dims=1, data_type=2, name=8, raw_data=9
+  ValueInfoProto: name=1, type=2;  TypeProto: tensor_type=1
+  TypeProto.Tensor: elem_type=1, shape=2
+  TensorShapeProto: dim=1;  Dimension: dim_value=1, dim_param=2
+  OperatorSetIdProto: domain=1, version=2
+
+Wire rules used: varint (type 0) for ints/enums, 32-bit (type 5) for
+float, length-delimited (type 2) for strings/bytes/messages/packed
+repeated ints. Negative int64 attributes (e.g. axis=-1) encode as
+10-byte two's-complement varints, per protobuf.
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+# TensorProto.DataType enum values (onnx.proto)
+DTYPE_ENUM = {
+    "float32": 1, "uint8": 2, "int8": 3, "uint16": 4, "int16": 5,
+    "int32": 6, "int64": 7, "bool": 9, "float16": 10, "float64": 11,
+    "uint32": 12, "uint64": 13, "bfloat16": 16,
+    "float8_e4m3fn": 17, "float8_e5m2": 19,
+}
+
+# AttributeProto.AttributeType
+_AT_FLOAT, _AT_INT, _AT_STRING, _AT_TENSOR = 1, 2, 3, 4
+_AT_FLOATS, _AT_INTS = 6, 7
+
+
+def _varint(n: int) -> bytes:
+    if n < 0:
+        n += 1 << 64          # two's complement, 10 bytes
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def f_varint(field: int, value: int) -> bytes:
+    return _tag(field, 0) + _varint(int(value))
+
+
+def f_bytes(field: int, data: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(data)) + data
+
+
+def f_string(field: int, s: str) -> bytes:
+    return f_bytes(field, s.encode("utf-8"))
+
+
+def f_float(field: int, v: float) -> bytes:
+    return _tag(field, 5) + struct.pack("<f", float(v))
+
+
+def f_packed_int64(field: int, vals) -> bytes:
+    body = b"".join(_varint(int(v)) for v in vals)
+    return f_bytes(field, body)
+
+
+def tensor_proto(name: str, arr: np.ndarray) -> bytes:
+    dt = DTYPE_ENUM.get(str(arr.dtype))
+    if dt is None:
+        raise NotImplementedError(
+            f"ONNX export: initializer dtype {arr.dtype} has no "
+            "TensorProto mapping")
+    buf = f_packed_int64(1, arr.shape)
+    buf += f_varint(2, dt)
+    buf += f_string(8, name)
+    buf += f_bytes(9, np.ascontiguousarray(arr).tobytes())
+    return buf
+
+
+def attr_int(name: str, v: int) -> bytes:
+    return f_string(1, name) + f_varint(3, v) + f_varint(20, _AT_INT)
+
+
+def attr_float(name: str, v: float) -> bytes:
+    return f_string(1, name) + f_float(2, v) + f_varint(20, _AT_FLOAT)
+
+
+def attr_string(name: str, s: str) -> bytes:
+    return f_string(1, name) + f_bytes(4, s.encode()) + \
+        f_varint(20, _AT_STRING)
+
+
+def attr_ints(name: str, vals) -> bytes:
+    body = b"".join(f_varint(8, v) for v in vals)  # repeated i: unpacked ok
+    return f_string(1, name) + body + f_varint(20, _AT_INTS)
+
+
+def attr_floats(name: str, vals) -> bytes:
+    body = b"".join(f_float(7, v) for v in vals)
+    return f_string(1, name) + body + f_varint(20, _AT_FLOATS)
+
+
+def attr_tensor(name: str, arr: np.ndarray) -> bytes:
+    return f_string(1, name) + f_bytes(5, tensor_proto(name, arr)) + \
+        f_varint(20, _AT_TENSOR)
+
+
+def node(op_type: str, inputs, outputs, name: str = "",
+         attrs=()) -> bytes:
+    buf = b"".join(f_string(1, i) for i in inputs)
+    buf += b"".join(f_string(2, o) for o in outputs)
+    if name:
+        buf += f_string(3, name)
+    buf += f_string(4, op_type)
+    buf += b"".join(f_bytes(5, a) for a in attrs)
+    return buf
+
+
+def value_info(name: str, dtype: str, shape) -> bytes:
+    dims = b""
+    for d in shape:
+        if isinstance(d, str):
+            dims += f_bytes(1, f_string(2, d))
+        else:
+            dims += f_bytes(1, f_varint(1, int(d)))
+    tt = f_varint(1, DTYPE_ENUM[dtype]) + f_bytes(2, dims)
+    return f_string(1, name) + f_bytes(2, f_bytes(1, tt))
+
+
+def graph(nodes, name: str, inputs, outputs, initializers) -> bytes:
+    buf = b"".join(f_bytes(1, n) for n in nodes)
+    buf += f_string(2, name)
+    buf += b"".join(f_bytes(5, t) for t in initializers)
+    buf += b"".join(f_bytes(11, v) for v in inputs)
+    buf += b"".join(f_bytes(12, v) for v in outputs)
+    return buf
+
+
+def model(graph_bytes: bytes, opset: int = 13, ir_version: int = 8,
+          producer: str = "paddle_tpu") -> bytes:
+    opset_id = f_string(1, "") + f_varint(2, opset)
+    return (f_varint(1, ir_version) + f_string(2, producer)
+            + f_string(3, "0") + f_bytes(7, graph_bytes)
+            + f_bytes(8, opset_id))
+
+
+# ---- generic wire-format reader (for tests / sanity checks) -----------------
+
+def parse_message(buf: bytes):
+    """Decode one protobuf message into {field: [(wire, value), ...]}.
+    Length-delimited values stay raw bytes (caller recurses)."""
+    out = {}
+    i = 0
+    n = len(buf)
+    while i < n:
+        tag = 0
+        shift = 0
+        while True:
+            b = buf[i]
+            i += 1
+            tag |= (b & 0x7F) << shift
+            shift += 7
+            if not b & 0x80:
+                break
+        field, wire = tag >> 3, tag & 7
+        if wire == 0:
+            v = 0
+            shift = 0
+            while True:
+                b = buf[i]
+                i += 1
+                v |= (b & 0x7F) << shift
+                shift += 7
+                if not b & 0x80:
+                    break
+        elif wire == 2:
+            ln = 0
+            shift = 0
+            while True:
+                b = buf[i]
+                i += 1
+                ln |= (b & 0x7F) << shift
+                shift += 7
+                if not b & 0x80:
+                    break
+            v = buf[i:i + ln]
+            i += ln
+        elif wire == 5:
+            v = struct.unpack("<f", buf[i:i + 4])[0]
+            i += 4
+        elif wire == 1:
+            v = struct.unpack("<d", buf[i:i + 8])[0]
+            i += 8
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        out.setdefault(field, []).append((wire, v))
+    return out

@@ -182,21 +182,27 @@ class Optimizer:
         return None, []
 
     @jax.named_scope("optimizer_step")
+    def _resolve_param_step(self, p):
+        """Shared per-param bookkeeping for every step path: lazily init the
+        accumulator and return (acc, this param's update count, its lr).
+        Per-parameter step: bias correction must reflect how many updates
+        THIS param has seen — parity with the reference's beta1_pow/
+        beta2_pow accumulators, not the optimizer-global counter."""
+        acc = self._accumulators.get(id(p))
+        if acc is None:
+            acc = self._init_state(p)
+            acc["_step"] = 0
+            self._accumulators[id(p)] = acc
+        step = int(acc.get("_step", 0)) + 1
+        lr_val = self.get_lr() * p.optimize_attr.get("learning_rate", 1.0) \
+            if hasattr(p, "optimize_attr") else self.get_lr()
+        return acc, step, lr_val
+
     def step(self):
         self._global_step += 1
         pgs = self._collect_params_grads()
         for p, g in pgs:
-            acc = self._accumulators.get(id(p))
-            if acc is None:
-                acc = self._init_state(p)
-                acc["_step"] = 0
-                self._accumulators[id(p)] = acc
-            # per-parameter step (bias correction must reflect how many updates
-            # THIS param has seen — parity with the reference's beta1_pow/
-            # beta2_pow accumulators, not the optimizer-global counter)
-            step = int(acc.get("_step", 0)) + 1
-            lr_val = self.get_lr() * p.optimize_attr.get("learning_rate", 1.0) \
-                if hasattr(p, "optimize_attr") else self.get_lr()
+            acc, step, lr_val = self._resolve_param_step(p)
             state = {k: v for k, v in acc.items() if k != "_step"}
             new_param, acc_new = self._update(
                 p._data, g._data.astype(p._data.dtype), state, lr_val,
@@ -314,15 +320,7 @@ class Adam(Optimizer):
             return
         buckets = {}
         for p, g in pgs:
-            acc = self._accumulators.get(id(p))
-            if acc is None:
-                acc = self._init_state(p)
-                acc["_step"] = 0
-                self._accumulators[id(p)] = acc
-            step = int(acc.get("_step", 0)) + 1
-            lr_val = self.get_lr() * p.optimize_attr.get(
-                "learning_rate", 1.0) if hasattr(p, "optimize_attr") \
-                else self.get_lr()
+            acc, step, lr_val = self._resolve_param_step(p)
             buckets.setdefault((float(lr_val), step), []).append((p, g, acc))
         for (lr_val, step), items in buckets.items():
             nps, nms, nvs = optimizer_pallas.multi_tensor_adamw_pallas(

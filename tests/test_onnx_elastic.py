@@ -11,7 +11,8 @@ def test_onnx_export_writes_stablehlo_bundle(tmp_path):
     m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
     from paddle_tpu.jit import InputSpec
     p = paddle.onnx.export(m, str(tmp_path / "m.onnx"),
-                           input_spec=[InputSpec([None, 4], "float32")])
+                           input_spec=[InputSpec([None, 4], "float32")],
+                           export_format="stablehlo")
     loaded = paddle.jit.load(p)
     x = paddle.to_tensor(np.random.default_rng(0)
                          .standard_normal((3, 4)).astype(np.float32))
@@ -19,10 +20,10 @@ def test_onnx_export_writes_stablehlo_bundle(tmp_path):
                                atol=1e-6)
 
 
-def test_onnx_protobuf_requested_raises(tmp_path):
+def test_onnx_bad_format_raises(tmp_path):
     m = nn.Linear(2, 2)
     with pytest.raises(NotImplementedError):
-        paddle.onnx.export(m, str(tmp_path / "m"), export_format="onnx")
+        paddle.onnx.export(m, str(tmp_path / "m"), export_format="torchscript")
 
 
 def test_elastic_manager_detects_dead_member():

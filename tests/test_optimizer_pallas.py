@@ -80,6 +80,8 @@ def test_multi_tensor_adamw_groups_by_wd():
             ps[i], gs[i], ms[i], vs[i], wd=wds[i], **args)
         np.testing.assert_allclose(np.asarray(nps[i]), np.asarray(wp),
                                    rtol=1e-6, atol=1e-7)
+        np.testing.assert_allclose(np.asarray(nms[i]), np.asarray(wm),
+                                   rtol=1e-6, atol=1e-7)
         np.testing.assert_allclose(np.asarray(nvs[i]), np.asarray(wv),
                                    rtol=1e-6, atol=1e-7)
 
