@@ -440,6 +440,36 @@ class InputSpec:
                f"name={self.name})"
 
 
+def layer_trace_fn(layer):
+    """Shared export-tracing scaffold (jit.save + onnx.export): capture the
+    state dict, force eval mode, unwrap to_static, and build the pure
+    `(state_arrays, *inputs) -> output arrays` closure. Returns
+    (pure, state, names, restore_mode); call restore_mode() when tracing
+    is done. `pure._out_spec` carries the output tree spec after a trace."""
+    state = layer.named_state()
+    names = list(state)
+    was_training = layer.training
+    layer.eval()
+    self_fn = layer.forward
+    if isinstance(self_fn, StaticFunction):  # to_static-wrapped layer
+        self_fn = self_fn.dygraph_function  # already bound
+
+    def pure(state_arrays, *in_arrays):
+        st = dict(zip(names, state_arrays))
+        with layer.swap_state(st), no_grad():
+            out = self_fn(*[Tensor(a) for a in in_arrays])
+        outs: List[Tensor] = []
+        spec = _flatten_tensors(out, outs)
+        pure._out_spec = spec
+        return tuple(t._data for t in outs)
+
+    def restore_mode():
+        if was_training:
+            layer.train()
+
+    return pure, state, names, restore_mode
+
+
 def save(layer, path, input_spec=None, **config):
     """Parity: paddle.jit.save / the inference-export path
     (AnalysisPredictor's offline artifact, analysis_predictor.cc:1574
@@ -464,24 +494,7 @@ def save(layer, path, input_spec=None, **config):
                          "example Tensors) to trace the export")
     specs = [s if isinstance(s, InputSpec) else InputSpec.from_tensor(s)
              for s in input_spec]
-
-    state = layer.named_state()
-    names = list(state)
-    was_training = layer.training
-    layer.eval()
-
-    def pure(state_arrays, *in_arrays):
-        st = dict(zip(names, state_arrays))
-        with layer.swap_state(st), no_grad():
-            out = self_fn(*[Tensor(a) for a in in_arrays])
-        outs: List[Tensor] = []
-        spec = _flatten_tensors(out, outs)
-        pure._out_spec = spec
-        return tuple(t._data for t in outs)
-
-    self_fn = layer.forward
-    if isinstance(self_fn, StaticFunction):  # to_static-wrapped layer
-        self_fn = self_fn.dygraph_function  # already bound
+    pure, state, names, restore_mode = layer_trace_fn(layer)
 
     # symbolic dims: None/-1 get a positional symbol; a STRING dim (e.g.
     # "batch") names a shared symbol, letting several inputs declare the
@@ -521,8 +534,7 @@ def save(layer, path, input_spec=None, **config):
                 "platform only", stacklevel=2)
             exp = jexport.export(jax.jit(pure))(state_avals, *avals())
     finally:
-        if was_training:
-            layer.train()
+        restore_mode()
 
     with open(path + ".pdmodel", "wb") as f:
         f.write(exp.serialize())

@@ -16,8 +16,6 @@ by the external paddle2onnx converter). Two artifact formats:
 """
 from __future__ import annotations
 
-from typing import List
-
 import jax
 import numpy as np
 
@@ -40,11 +38,16 @@ def export(layer, path: str, input_spec=None, opset_version: int = 13,
         raise NotImplementedError(
             f"export_format={export_format!r}: supported are 'onnx' and "
             "'stablehlo'")
+    if not 13 <= opset_version <= 17:
+        # the emitter targets the opset-13 node forms (ReduceSum axes as
+        # input, ReduceMax/Min/Prod axes as attribute — the latter removed
+        # at 18); stamping any other opset would declare a form mismatch
+        raise NotImplementedError(
+            f"opset_version={opset_version}: the exporter emits opset "
+            "13..17 node forms")
 
-    from ..autograd.tape import no_grad
-    from ..jit import InputSpec, StaticFunction, _flatten_tensors
+    from ..jit import InputSpec, layer_trace_fn
     from ..nn.layer.layers import Layer
-    from ..tensor import Tensor
 
     if not isinstance(layer, Layer):
         raise TypeError("onnx.export expects a Layer")
@@ -62,22 +65,7 @@ def export(layer, path: str, input_spec=None, opset_version: int = 13,
                 "example sizes (or use export_format='stablehlo' for "
                 "symbolic-dim artifacts)")
 
-    state = layer.named_state()
-    names = list(state)
-    was_training = layer.training
-    layer.eval()
-    self_fn = layer.forward
-    if isinstance(self_fn, StaticFunction):
-        self_fn = self_fn.dygraph_function
-
-    def pure(state_arrays, *in_arrays):
-        st = dict(zip(names, state_arrays))
-        with layer.swap_state(st), no_grad():
-            out = self_fn(*[Tensor(a) for a in in_arrays])
-        outs: List[Tensor] = []
-        _flatten_tensors(out, outs)
-        return tuple(t._data for t in outs)
-
+    pure, state, names, restore_mode = layer_trace_fn(layer)
     try:
         state_avals = [jax.ShapeDtypeStruct(state[n]._data.shape,
                                             state[n]._data.dtype)
@@ -86,8 +74,7 @@ def export(layer, path: str, input_spec=None, opset_version: int = 13,
                                          np.dtype(s.dtype)) for s in specs]
         closed = jax.make_jaxpr(pure)(state_avals, *in_avals)
     finally:
-        if was_training:
-            layer.train()
+        restore_mode()
 
     conv = Converter()
     # parameters become initializers under their state-dict names

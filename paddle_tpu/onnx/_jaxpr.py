@@ -28,7 +28,6 @@ _UNARY = {
     "neg": "Neg", "abs": "Abs", "exp": "Exp", "log": "Log", "tanh": "Tanh",
     "logistic": "Sigmoid", "sqrt": "Sqrt", "sign": "Sign", "floor": "Floor",
     "ceil": "Ceil", "round": "Round", "erf": "Erf", "not": "Not",
-    "is_finite": "IsInf",  # replaced below; placeholder never used directly
 }
 _BINARY = {
     "add": "Add", "sub": "Sub", "mul": "Mul", "div": "Div", "max": "Max",
@@ -125,7 +124,7 @@ class Converter:
 
     # -- generic elementwise --------------------------------------------------
     def _generic(self, name):
-        if name in _UNARY and name != "is_finite":
+        if name in _UNARY:
             def h(eqn, op=_UNARY[name]):
                 o, = self.emit(op, [self.name_of(eqn.invars[0])])
                 self.bind(eqn.outvars[0], o)

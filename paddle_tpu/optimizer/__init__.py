@@ -181,7 +181,6 @@ class Optimizer:
         self.step()
         return None, []
 
-    @jax.named_scope("optimizer_step")
     def _resolve_param_step(self, p):
         """Shared per-param bookkeeping for every step path: lazily init the
         accumulator and return (acc, this param's update count, its lr).
@@ -198,6 +197,7 @@ class Optimizer:
             if hasattr(p, "optimize_attr") else self.get_lr()
         return acc, step, lr_val
 
+    @jax.named_scope("optimizer_step")
     def step(self):
         self._global_step += 1
         pgs = self._collect_params_grads()

@@ -39,19 +39,52 @@ from ..distributed.fleet.meta_parallel import get_param_annotation
 
 
 def make_hybrid_mesh(dp: int = 1, mp: int = 1, pp: int = 1, sharding: int = 1,
-                     sep: int = 1, ep: int = 1) -> ProcessMesh:
+                     sep: int = 1, ep: int = 1, dcn=None) -> ProcessMesh:
     """Build the fleet-style hybrid mesh over local devices.
 
     Axis order (outer→inner): dp, pp, sep, sharding, ep, mp — mp innermost so
     TP collectives ride adjacent-device ICI links (reference topology.py:298
     creates groups in pp->mp->sep->sharding->dp order for the same reason).
     ep shards MoE expert banks (all-to-all dispatch stays within-replica).
+
+    Multi-slice pods: `dcn={"dp": 2}` declares that axis `dp` factors as
+    2 (across slices, riding DCN) x dp//2 (within-slice, riding ICI) —
+    the jax mesh_utils.create_hybrid_device_mesh recipe, expressed on the
+    fleet axis names. Device ids are arranged so the DCN factor of each
+    axis is its slowest-varying part: with devices ordered
+    slice-major (jax.devices() on TPU pods), every collective on a
+    non-DCN axis stays inside one slice, and only the declared axes pay
+    DCN latency. The scaling-book layout: dp/pp outermost over DCN,
+    tp/sp innermost over ICI.
     """
     shape = [dp, pp, sep, sharding, ep, mp]
     names = ["dp", "pp", "sep", "sharding", "ep", "mp"]
     n = int(np.prod(shape))
-    return ProcessMesh(shape=shape, dim_names=names,
-                       process_ids=list(range(n)))
+    if not dcn:
+        return ProcessMesh(shape=shape, dim_names=names,
+                           process_ids=list(range(n)))
+    dcn_shape = []
+    ici_shape = []
+    for nm, sz in zip(names, shape):
+        f = int(dcn.get(nm, 1))
+        if f <= 0 or sz % f:
+            raise ValueError(
+                f"make_hybrid_mesh: dcn factor {f} does not divide "
+                f"{nm}={sz}")
+        dcn_shape.append(f)
+        ici_shape.append(sz // f)
+    unknown = set(dcn) - set(names)
+    if unknown:
+        raise ValueError(f"make_hybrid_mesh: unknown dcn axes {unknown}")
+    k = len(names)
+    grid = np.arange(n).reshape(dcn_shape + ici_shape)
+    # pair each axis's (dcn-major, ici-minor) factors and merge them
+    perm = [ax for i in range(k) for ax in (i, i + k)]
+    ids = grid.transpose(perm).reshape(shape)
+    mesh = ProcessMesh(shape=shape, dim_names=names,
+                       process_ids=ids.reshape(-1).tolist())
+    mesh.dcn_axes = dict(dcn)
+    return mesh
 
 
 def _clip_grads_functional(grad_clip, params: Dict, grads: Dict) -> Dict:
