@@ -440,7 +440,7 @@ class InputSpec:
                f"name={self.name})"
 
 
-def layer_trace_fn(layer):
+def _layer_trace_fn(layer):
     """Shared export-tracing scaffold (jit.save + onnx.export): capture the
     state dict, force eval mode, unwrap to_static, and build the pure
     `(state_arrays, *inputs) -> output arrays` closure. Returns
@@ -494,7 +494,7 @@ def save(layer, path, input_spec=None, **config):
                          "example Tensors) to trace the export")
     specs = [s if isinstance(s, InputSpec) else InputSpec.from_tensor(s)
              for s in input_spec]
-    pure, state, names, restore_mode = layer_trace_fn(layer)
+    pure, state, names, restore_mode = _layer_trace_fn(layer)
 
     # symbolic dims: None/-1 get a positional symbol; a STRING dim (e.g.
     # "batch") names a shared symbol, letting several inputs declare the

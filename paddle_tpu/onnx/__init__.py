@@ -46,7 +46,7 @@ def export(layer, path: str, input_spec=None, opset_version: int = 13,
             f"opset_version={opset_version}: the exporter emits opset "
             "13..17 node forms")
 
-    from ..jit import InputSpec, layer_trace_fn
+    from ..jit import InputSpec, _layer_trace_fn
     from ..nn.layer.layers import Layer
 
     if not isinstance(layer, Layer):
@@ -65,7 +65,7 @@ def export(layer, path: str, input_spec=None, opset_version: int = 13,
                 "example sizes (or use export_format='stablehlo' for "
                 "symbolic-dim artifacts)")
 
-    pure, state, names, restore_mode = layer_trace_fn(layer)
+    pure, state, names, restore_mode = _layer_trace_fn(layer)
     try:
         state_avals = [jax.ShapeDtypeStruct(state[n]._data.shape,
                                             state[n]._data.dtype)

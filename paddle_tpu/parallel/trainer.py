@@ -61,8 +61,10 @@ def make_hybrid_mesh(dp: int = 1, mp: int = 1, pp: int = 1, sharding: int = 1,
     names = ["dp", "pp", "sep", "sharding", "ep", "mp"]
     n = int(np.prod(shape))
     if not dcn:
-        return ProcessMesh(shape=shape, dim_names=names,
+        mesh = ProcessMesh(shape=shape, dim_names=names,
                            process_ids=list(range(n)))
+        mesh.dcn_axes = {}
+        return mesh
     dcn_shape = []
     ici_shape = []
     for nm, sz in zip(names, shape):
