@@ -121,6 +121,11 @@ from . import quantization  # noqa: F401
 from . import kernels  # noqa: F401  (registers kernel flags, e.g. autotune)
 from . import hapi  # noqa: F401
 from .hapi import Model, summary  # noqa: F401
+from . import callbacks  # noqa: F401
+from . import hub  # noqa: F401
+from . import regularizer  # noqa: F401
+from . import sysconfig  # noqa: F401
+from .batch import batch  # noqa: F401
 from .framework.io import save, load  # noqa: F401
 from .nn.layer.layers import disable_static, enable_static, in_dynamic_mode  # noqa: F401
 

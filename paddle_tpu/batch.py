@@ -1,0 +1,29 @@
+"""paddle_tpu.batch — minibatch reader decorator.
+
+Reference parity: python/paddle/batch.py (paddle.batch — wraps a sample
+reader generator into a batch reader; legacy pre-DataLoader API kept for
+compatibility; paddle_tpu.io.DataLoader is the modern path)."""
+from __future__ import annotations
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Wrap sample-reader `reader` (a no-arg callable yielding samples)
+    into a batch reader yielding lists of `batch_size` samples."""
+    if batch_size <= 0:
+        raise ValueError(
+            f"batch_size should be a positive integer, got {batch_size}")
+
+    def batch_reader():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batch_reader
+
+
+__all__ = ["batch"]

@@ -1,0 +1,10 @@
+"""paddle_tpu.callbacks — hapi training callbacks, top-level namespace.
+
+Reference parity: python/paddle/callbacks.py (re-exports the hapi
+callback set as paddle.callbacks.*)."""
+from .hapi.callbacks import (Callback, EarlyStopping,  # noqa: F401
+                             LRScheduler, ModelCheckpoint, ProgBarLogger,
+                             VisualDL)
+
+__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "LRScheduler",
+           "EarlyStopping", "VisualDL"]

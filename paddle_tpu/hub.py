@@ -1,0 +1,87 @@
+"""paddle_tpu.hub — hubconf-based model loading.
+
+Reference parity: python/paddle/hub.py (paddle.hub.list/help/load over a
+`hubconf.py` with a `dependencies` list and callable entrypoints; sources
+github / gitee / local). This environment has no network egress, so the
+remote sources raise a clear error; the local source implements the full
+contract: dependency check, entrypoint discovery, docstring help, and
+entrypoint invocation with kwargs."""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+_SOURCES = ("github", "gitee", "local")
+
+
+def _load_hubconf(repo_dir: str):
+    path = os.path.join(repo_dir, "hubconf.py")
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"no hubconf.py under {repo_dir!r}")
+    spec = importlib.util.spec_from_file_location(
+        f"_paddle_tpu_hubconf_{abs(hash(path))}", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, repo_dir)
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        sys.path.remove(repo_dir)
+    deps = getattr(mod, "dependencies", [])
+    missing = []
+    for d in deps:
+        try:
+            importlib.import_module(d)
+        except ImportError:
+            missing.append(d)
+    if missing:
+        raise RuntimeError(
+            f"hubconf dependencies not installed: {missing}")
+    return mod
+
+
+def _check_source(source: str):
+    if source not in _SOURCES:
+        raise ValueError(
+            f"hub source {source!r}: expected one of {_SOURCES}")
+    if source in ("github", "gitee"):
+        raise NotImplementedError(
+            f"hub source {source!r} requires network access, which this "
+            "environment does not have; clone the repo and use "
+            "source='local' with its directory path")
+
+
+def _entrypoints(mod):
+    return {n: f for n, f in vars(mod).items()
+            if callable(f) and not n.startswith("_")}
+
+
+def list(repo_dir: str, source: str = "github", force_reload: bool = False):
+    """Names of the callable entrypoints a repo's hubconf.py exposes."""
+    _check_source(source)
+    return sorted(_entrypoints(_load_hubconf(repo_dir)))
+
+
+def help(repo_dir: str, model: str, source: str = "github",
+         force_reload: bool = False):
+    """The docstring of one entrypoint."""
+    _check_source(source)
+    eps = _entrypoints(_load_hubconf(repo_dir))
+    if model not in eps:
+        raise RuntimeError(
+            f"entrypoint {model!r} not found; available: {sorted(eps)}")
+    return eps[model].__doc__
+
+
+def load(repo_dir: str, model: str, source: str = "github",
+         force_reload: bool = False, **kwargs):
+    """Call entrypoint `model` from the repo's hubconf.py with kwargs."""
+    _check_source(source)
+    eps = _entrypoints(_load_hubconf(repo_dir))
+    if model not in eps:
+        raise RuntimeError(
+            f"entrypoint {model!r} not found; available: {sorted(eps)}")
+    return eps[model](**kwargs)
+
+
+__all__ = ["list", "help", "load"]

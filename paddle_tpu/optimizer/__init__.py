@@ -144,13 +144,50 @@ class Optimizer:
             self._lr.set_state_dict(state["LR_Scheduler"])
 
     # helpers -----------------------------------------------------------------
+    def _effective_decay(self, param):
+        """Param-level regularizer wins over the optimizer default
+        (reference ParamAttr precedence); regularizer=False disables."""
+        r = getattr(param, "regularizer", None)
+        if r is False:
+            return None
+        return r if r is not None else self._weight_decay
+
     def _wd_coeff(self, param) -> float:
-        wd = self._weight_decay
+        from ..regularizer import L2Decay, WeightDecayRegularizer
+        wd = self._effective_decay(param)
         if wd is None:
             return 0.0
-        if isinstance(wd, (int, float)):
-            return 0.0 if getattr(param, "regularizer", None) is False else float(wd)
+        if isinstance(wd, WeightDecayRegularizer):
+            # regularizer objects are COUPLED by definition (grad-side
+            # penalty). On a coupled optimizer, L2Decay rides the update
+            # rule's wd term (identical math, no extra pass); everything
+            # else — L1, or any regularizer under a decoupled (AdamW)
+            # rule, which the reference handles by skipping decoupled
+            # decay and regularizing the gradient — applies in _reg_grad.
+            if isinstance(wd, L2Decay) and \
+                    not getattr(self, "_decoupled_wd", False):
+                return wd.coeff
+            return 0.0
         return float(wd)
+
+    def _needs_grad_transform(self, param) -> bool:
+        from ..regularizer import L2Decay, WeightDecayRegularizer
+        wd = self._effective_decay(param)
+        if not isinstance(wd, WeightDecayRegularizer):
+            return False
+        return not (isinstance(wd, L2Decay)
+                    and not getattr(self, "_decoupled_wd", False))
+
+    def _reg_grad(self, param, grad_arr, param_arr=None):
+        """Apply the regularizer's gradient-side penalty (see _wd_coeff for
+        which cases ride the wd path instead). `param_arr` must be the
+        traced parameter inside compiled steps — the eager `param._data`
+        there would bake a stale weight constant into the program."""
+        if not self._needs_grad_transform(param):
+            return grad_arr
+        wd = self._effective_decay(param)
+        arr = param._data if param_arr is None else param_arr
+        return wd.apply(grad_arr, arr)
 
     def _collect_params_grads(self):
         pgs = []
@@ -205,8 +242,8 @@ class Optimizer:
             acc, step, lr_val = self._resolve_param_step(p)
             state = {k: v for k, v in acc.items() if k != "_step"}
             new_param, acc_new = self._update(
-                p._data, g._data.astype(p._data.dtype), state, lr_val,
-                self._wd_coeff(p), step)
+                p._data, self._reg_grad(p, g._data.astype(p._data.dtype)),
+                state, lr_val, self._wd_coeff(p), step)
             p._data = new_param
             acc_new["_step"] = step
             self._accumulators[id(p)] = acc_new
@@ -325,7 +362,8 @@ class Adam(Optimizer):
         for (lr_val, step), items in buckets.items():
             nps, nms, nvs = optimizer_pallas.multi_tensor_adamw_pallas(
                 [p._data for p, _, _ in items],
-                [g._data.astype(p._data.dtype) for p, g, _ in items],
+                [self._reg_grad(p, g._data.astype(p._data.dtype))
+                 for p, g, _ in items],
                 [a["moment1"] for _, _, a in items],
                 [a["moment2"] for _, _, a in items],
                 wds=[self._wd_coeff(p) for p, _, _ in items],

@@ -1144,6 +1144,13 @@ class PipelinedTrainer(SpmdTrainer):
         for ln in self._local_names:
             per_layer = self._per_layer[ln]
             sname = self.STACK_PREFIX + ln
+            if any(optimizer._needs_grad_transform(t) for t in per_layer):
+                raise NotImplementedError(
+                    f"block param '{ln}' carries a gradient-transforming "
+                    "regularizer (L1Decay, or a regularizer object under "
+                    "a decoupled optimizer); the stacked pipeline update "
+                    "applies only wd-coefficient decay — use float "
+                    "weight_decay / L2Decay with a coupled optimizer")
             wds = {optimizer._wd_coeff(t) for t in per_layer}
             lrs = {(getattr(t, "optimize_attr", None) or {})
                    .get("learning_rate", 1.0) for t in per_layer}

@@ -336,7 +336,8 @@ class SpmdTrainer:
         new_params, new_state = {}, {}
         for n in self._param_list:
             p = params[n]
-            g = grads[n].astype(p.dtype)
+            g = opt._reg_grad(self._params[n], grads[n].astype(p.dtype),
+                              param_arr=p)
             np_, ns_ = opt._update(p, g, opt_state[n],
                                    lr * self._lr_mult(n), self._wd(n), step_i)
             if asp_masks is not None:

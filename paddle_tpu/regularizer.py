@@ -1,0 +1,52 @@
+"""paddle_tpu.regularizer — weight-decay regularizers.
+
+Reference parity: python/paddle/regularizer.py:51 (L1Decay), :169
+(L2Decay). TPU-native: a regularizer is a declarative coefficient the
+optimizer's update rule consumes — L2Decay folds into the existing
+weight-decay path (coupled decay, grad += coeff * p, exactly the
+reference's AppendRegularizationOps semantics for L2), L1Decay adds
+coeff * sign(p) to the gradient before the update. A parameter-level
+`param.regularizer` overrides the optimizer-level default, matching the
+reference's precedence (ParamAttr wins)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class WeightDecayRegularizer:
+    def __init__(self, coeff: float = 0.0):
+        self._coeff = float(coeff)
+
+    @property
+    def coeff(self) -> float:
+        return self._coeff
+
+    def __repr__(self):
+        return f"{type(self).__name__}(coeff={self._coeff})"
+
+    def apply(self, grad, param):
+        """Return the regularized gradient array (grad + d penalty/d p)."""
+        raise NotImplementedError
+
+
+class L1Decay(WeightDecayRegularizer):
+    """Parity: regularizer.py:51 — adds coeff * sign(p) to the gradient."""
+
+    def apply(self, grad, param):
+        return grad + (self._coeff
+                       * jnp.sign(param.astype(grad.dtype)))
+
+
+class L2Decay(WeightDecayRegularizer):
+    """Parity: regularizer.py:169 — adds coeff * p to the gradient
+    (coupled decay). On coupled optimizers this rides the update rule's
+    wd term (identical math); under a decoupled optimizer (AdamW) the
+    penalty still applies COUPLED through the gradient while the
+    decoupled term is skipped for that parameter — the reference AdamW's
+    handling of regularized params."""
+
+    def apply(self, grad, param):
+        return grad + self._coeff * param.astype(grad.dtype)
+
+
+__all__ = ["WeightDecayRegularizer", "L1Decay", "L2Decay"]

@@ -257,8 +257,9 @@ class Executor:
                     mult = (getattr(p, "optimize_attr", None) or
                             {}).get("learning_rate", 1.0)
                     np_, ns_ = optimizer._update(
-                        a, g.astype(a.dtype), st, lr * mult,
-                        optimizer._wd_coeff(p), step_i)
+                        a, optimizer._reg_grad(p, g.astype(a.dtype),
+                                               param_arr=a),
+                        st, lr * mult, optimizer._wd_coeff(p), step_i)
                     new_params.append(np_)
                     new_states.append(ns_)
                 outs = replay(param_arrays, *feeds)[:li]

@@ -1,0 +1,24 @@
+"""paddle_tpu.sysconfig — include/lib directories for extension builds.
+
+Reference parity: python/paddle/sysconfig.py (get_include/get_lib point
+at the installed package's headers and shared libraries). Here they point
+at the package's native artifacts (csrc headers, _native shared objects)
+consumed by utils.cpp_extension."""
+from __future__ import annotations
+
+import os
+
+_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include() -> str:
+    """Directory containing the native C headers/sources (csrc/)."""
+    return os.path.join(_ROOT, "csrc")
+
+
+def get_lib() -> str:
+    """Directory containing the built native shared libraries."""
+    return os.path.join(_ROOT, "_native")
+
+
+__all__ = ["get_include", "get_lib"]

@@ -1,0 +1,155 @@
+"""Top-level API-parity namespaces: regularizer, hub, batch, sysconfig,
+callbacks (reference: python/paddle/{regularizer,hub,batch,sysconfig,
+callbacks}.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import optimizer as opt
+from paddle_tpu.regularizer import L1Decay, L2Decay
+
+
+def test_l2decay_equals_float_weight_decay():
+    """L2Decay(c) on a coupled optimizer == weight_decay=c exactly."""
+    def run(wd):
+        paddle.seed(4)
+        lin = nn.Linear(6, 3)
+        o = opt.Momentum(learning_rate=0.1, momentum=0.9,
+                         parameters=lin.parameters(), weight_decay=wd)
+        x = paddle.to_tensor(np.random.default_rng(0)
+                             .standard_normal((4, 6)).astype(np.float32))
+        for _ in range(3):
+            (lin(x) ** 2).sum().backward()
+            o.step()
+            o.clear_grad()
+        return lin.weight.numpy()
+
+    np.testing.assert_array_equal(run(0.01), run(L2Decay(0.01)))
+
+
+def test_l1decay_adds_sign_penalty():
+    paddle.seed(5)
+    lin = nn.Linear(4, 2)
+    w0 = lin.weight.numpy().copy()
+    o = opt.SGD(learning_rate=0.1, parameters=lin.parameters(),
+                weight_decay=L1Decay(0.05))
+    x = paddle.to_tensor(np.zeros((2, 4), np.float32))
+    (lin(x).sum() * 0.0).backward()       # zero data gradient
+    o.step()
+    # with zero grads the whole update is the L1 penalty: -lr * c * sign(w)
+    np.testing.assert_allclose(lin.weight.numpy(),
+                               w0 - 0.1 * 0.05 * np.sign(w0),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_param_level_regularizer_wins():
+    paddle.seed(6)
+    lin = nn.Linear(4, 2)
+    lin.weight.regularizer = False         # disable for the weight
+    w0 = lin.weight.numpy().copy()
+    o = opt.SGD(learning_rate=0.1, parameters=lin.parameters(),
+                weight_decay=L2Decay(0.5))
+    x = paddle.to_tensor(np.zeros((2, 4), np.float32))
+    (lin(x).sum() * 0.0).backward()
+    o.step()
+    np.testing.assert_array_equal(lin.weight.numpy(), w0)  # untouched
+
+
+def test_l1decay_applies_in_compiled_trainer():
+    """The grad-transform regularizer must reach the COMPILED update loop
+    too (SpmdTrainer), with the traced parameter — not a stale eager
+    constant: compiled == eager step-for-step."""
+    from paddle_tpu.parallel import SpmdTrainer
+
+    def run(compiled):
+        paddle.seed(7)
+        lin = nn.Linear(6, 4)
+        o = opt.Momentum(learning_rate=0.05, momentum=0.9,
+                         parameters=lin.parameters(),
+                         weight_decay=L1Decay(0.02))
+        rng = np.random.default_rng(3)
+        xs = [rng.standard_normal((4, 6)).astype(np.float32)
+              for _ in range(3)]
+        if compiled:
+            tr = SpmdTrainer(lin, o, lambda m, x: (m(x) ** 2).sum(),
+                             mesh=None)
+            for x in xs:
+                tr.train_step(paddle.to_tensor(x))
+        else:
+            for x in xs:
+                (lin(paddle.to_tensor(x)) ** 2).sum().backward()
+                o.step()
+                o.clear_grad()
+        return lin.weight.numpy()
+
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-5, atol=1e-6)
+
+
+def test_adamw_param_level_l2decay_is_coupled():
+    """Reference AdamW skips decoupled decay for a param carrying a
+    regularizer and applies the penalty through the gradient: equals
+    plain Adam (coupled wd) on that param."""
+    def run(cls, **kw):
+        paddle.seed(8)
+        lin = nn.Linear(5, 3, bias_attr=False)
+        lin.weight.regularizer = L2Decay(0.1) if cls is opt.AdamW else None
+        o = cls(learning_rate=0.01, parameters=lin.parameters(), **kw)
+        x = paddle.to_tensor(np.random.default_rng(4)
+                             .standard_normal((4, 5)).astype(np.float32))
+        for _ in range(3):
+            (lin(x) ** 2).sum().backward()
+            o.step()
+            o.clear_grad()
+        return lin.weight.numpy()
+
+    got = run(opt.AdamW, weight_decay=0.3)   # decoupled coeff must NOT apply
+    want = run(opt.Adam, weight_decay=0.1)   # coupled L2 at the reg coeff
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+
+
+def test_hub_local_list_help_load(tmp_path):
+    (tmp_path / "hubconf.py").write_text(
+        "dependencies = ['numpy']\n"
+        "def tiny_mlp(hidden=8):\n"
+        "    '''A tiny MLP entrypoint.'''\n"
+        "    import paddle_tpu.nn as nn\n"
+        "    return nn.Linear(4, hidden)\n"
+        "_private = lambda: None\n")
+    names = paddle.hub.list(str(tmp_path), source="local")
+    assert names == ["tiny_mlp"]
+    assert "tiny MLP" in paddle.hub.help(str(tmp_path), "tiny_mlp",
+                                         source="local")
+    m = paddle.hub.load(str(tmp_path), "tiny_mlp", source="local", hidden=6)
+    assert tuple(m.weight.shape) == (4, 6)
+    with pytest.raises(NotImplementedError, match="network"):
+        paddle.hub.list("user/repo", source="github")
+    with pytest.raises(RuntimeError, match="dependencies"):
+        (tmp_path / "hubconf.py").write_text(
+            "dependencies = ['definitely_not_installed_pkg']\n")
+        paddle.hub.list(str(tmp_path), source="local")
+
+
+def test_batch_reader():
+    def reader():
+        yield from range(7)
+
+    out = [b for b in paddle.batch(reader, 3)()]
+    assert out == [[0, 1, 2], [3, 4, 5], [6]]
+    out = [b for b in paddle.batch(reader, 3, drop_last=True)()]
+    assert out == [[0, 1, 2], [3, 4, 5]]
+    with pytest.raises(ValueError):
+        paddle.batch(reader, 0)
+
+
+def test_sysconfig_paths_exist():
+    assert os.path.isdir(paddle.sysconfig.get_include())
+    assert os.path.isdir(paddle.sysconfig.get_lib())
+
+
+def test_callbacks_namespace():
+    assert paddle.callbacks.EarlyStopping is not None
+    assert issubclass(paddle.callbacks.ModelCheckpoint,
+                      paddle.callbacks.Callback)
