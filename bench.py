@@ -325,17 +325,68 @@ def _run_probe(extend=None):
         except Exception:  # noqa: BLE001
             return {}
 
+    def adamw_probe():
+        # fused multi-tensor AdamW vs the XLA per-tensor oracle on a
+        # llama-7B-shaped param group slice (~200M elements is too big for
+        # a probe; 16M exercises the same HBM-bound regime)
+        from paddle_tpu.kernels import optimizer_pallas as op
+        from paddle_tpu.optimizer import _adam_update
+        nels = [4096 * 4096, 4096 * 1024, 4096, 1024]
+        ks = jax.random.split(jax.random.PRNGKey(5), 4)
+        ps = [jax.random.normal(ks[i % 4], (ne,)).astype(jnp.float32)
+              for i, ne in enumerate(nels)]
+        gs = [p * 0.01 for p in ps]
+        ms = [jnp.zeros_like(p) for p in ps]
+        vs = [jnp.zeros_like(p) for p in ps]
+        args = dict(lr=1e-3, beta1=0.9, beta2=0.95, eps=1e-8, step=2.0)
+        f = lambda: op.multi_tensor_adamw_pallas(  # noqa: E731
+            ps, gs, ms, vs, wds=[0.1] * 4, **args)[0][0]
+        dt = timeit(f)
+        o = jax.jit(lambda p, g, m, v: _adam_update(
+            p, g, m, v, jnp.float32(1e-3), jnp.float32(0.9),
+            jnp.float32(0.95), jnp.float32(1e-8), jnp.float32(2.0),
+            jnp.float32(0.1), True)[0])
+        dt_xla = timeit(lambda: [o(p, g, m, v)
+                                 for p, g, m, v in zip(ps, gs, ms, vs)][0])
+        return {"fused_us": round(dt * 1e6, 1),
+                "xla_us": round(dt_xla * 1e6, 1)}
+
+    def fp8_probe():
+        # fp8 x fp8 MXU gemm vs bf16 on a serving-shaped matmul
+        from paddle_tpu.quantization._kernels import (
+            quantize_tensor_fp8_arrays, quantize_weight_arrays)
+        m_, k_, n_ = 4096, 4096, 4096
+        ks = jax.random.split(jax.random.PRNGKey(6), 2)
+        x = jax.random.normal(ks[0], (m_, k_)).astype(jnp.bfloat16)
+        w = jax.random.normal(ks[1], (k_, n_)).astype(jnp.bfloat16)
+        qx, sx = quantize_tensor_fp8_arrays(x)
+        qw, sw = quantize_weight_arrays(w, bits="fp8_e4m3")
+        f8 = jax.jit(lambda a, b: jnp.matmul(
+            a, b, preferred_element_type=jnp.float32))
+        dt8 = timeit(lambda: f8(qx, qw))
+        fb = jax.jit(lambda a, b: jnp.matmul(
+            a, b, preferred_element_type=jnp.float32))
+        dtb = timeit(lambda: fb(x, w))
+        fl = 2 * m_ * k_ * n_
+        return {"fp8_us": round(dt8 * 1e6, 1),
+                "bf16_us": round(dtb * 1e6, 1),
+                "fp8_tflops": round(fl / dt8 / 1e12, 1)}
+
     step("matmul", mm_probe)
     step("flash_fwd", flash_fwd_probe)
     step("flash_bwd", flash_bwd_probe)
     step("flashmask", flashmask_probe)
     step("xla_attn", xla_attn_probe)
     step("fused", fused_probe)
+    step("fused_adamw", adamw_probe)
+    step("fp8_gemm", fp8_probe)
     step("decode", decode_probe)
     step("decode_int8",
          lambda: _decode_quant_probe("weight_only_int8"))
     step("decode_int4",
          lambda: _decode_quant_probe("weight_only_int4"))
+    step("decode_fp8",
+         lambda: _decode_quant_probe("weight_only_fp8"))
     step("mem", mem_probe)
     out["ok"] = out["steps"].get("matmul", {}).get("ok", False)
     return out
