@@ -91,14 +91,18 @@ def _attempt_table():
     return table
 
 
-def _sub(argv, timeout):
+def _sub(argv, timeout, env_extra=None):
     """Run this file in a fresh subprocess, return (parsed-json-or-None, err)."""
     import os
     import subprocess
+    env = None
+    if env_extra:
+        env = dict(os.environ)
+        env.update(env_extra)
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), *argv],
-            capture_output=True, text=True, timeout=timeout)
+            capture_output=True, text=True, timeout=timeout, env=env)
     except subprocess.TimeoutExpired:
         return None, "timeout"
     line = None
@@ -339,15 +343,19 @@ def _run_probe(extend=None):
         ms = [jnp.zeros_like(p) for p in ps]
         vs = [jnp.zeros_like(p) for p in ps]
         args = dict(lr=1e-3, beta1=0.9, beta2=0.95, eps=1e-8, step=2.0)
-        f = lambda: op.multi_tensor_adamw_pallas(  # noqa: E731
-            ps, gs, ms, vs, wds=[0.1] * 4, **args)[0][0]
-        dt = timeit(f)
+        def _sync_all(results):
+            # one array depending on EVERY kernel, so timeit's barrier
+            # waits on all dispatches symmetrically on both sides
+            return jnp.stack([r.ravel()[0] for r in results])
+
+        dt = timeit(lambda: _sync_all(op.multi_tensor_adamw_pallas(
+            ps, gs, ms, vs, wds=[0.1] * 4, **args)[0]))
         o = jax.jit(lambda p, g, m, v: _adam_update(
             p, g, m, v, jnp.float32(1e-3), jnp.float32(0.9),
             jnp.float32(0.95), jnp.float32(1e-8), jnp.float32(2.0),
             jnp.float32(0.1), True)[0])
-        dt_xla = timeit(lambda: [o(p, g, m, v)
-                                 for p, g, m, v in zip(ps, gs, ms, vs)][0])
+        dt_xla = timeit(lambda: _sync_all(
+            [o(p, g, m, v) for p, g, m, v in zip(ps, gs, ms, vs)]))
         return {"fused_us": round(dt * 1e6, 1),
                 "xla_us": round(dt_xla * 1e6, 1)}
 
@@ -359,14 +367,12 @@ def _run_probe(extend=None):
         ks = jax.random.split(jax.random.PRNGKey(6), 2)
         x = jax.random.normal(ks[0], (m_, k_)).astype(jnp.bfloat16)
         w = jax.random.normal(ks[1], (k_, n_)).astype(jnp.bfloat16)
-        qx, sx = quantize_tensor_fp8_arrays(x)
-        qw, sw = quantize_weight_arrays(w, bits="fp8_e4m3")
-        f8 = jax.jit(lambda a, b: jnp.matmul(
-            a, b, preferred_element_type=jnp.float32))
-        dt8 = timeit(lambda: f8(qx, qw))
-        fb = jax.jit(lambda a, b: jnp.matmul(
-            a, b, preferred_element_type=jnp.float32))
-        dtb = timeit(lambda: fb(x, w))
+        qx, _ = quantize_tensor_fp8_arrays(x)
+        qw, _ = quantize_weight_arrays(w, bits="fp8_e4m3")
+        mmf32 = jax.jit(lambda a, b: jnp.matmul(
+            a, b, preferred_element_type=jnp.float32))  # retraces per dtype
+        dt8 = timeit(lambda: mmf32(qx, qw))
+        dtb = timeit(lambda: mmf32(x, w))
         fl = 2 * m_ * k_ * n_
         return {"fp8_us": round(dt8 * 1e6, 1),
                 "bf16_us": round(dtb * 1e6, 1),
@@ -435,6 +441,35 @@ def _run_parent():
         }))
         sys.exit(1)
 
+    # the probe's measured kernel timings decide the fused-Pallas flag for
+    # the training attempts (VERDICT r3 ask #1: "flip FLAGS_use_pallas_fused
+    # per data"): turn it on only when the Pallas rms-norm beats the
+    # XLA-fused chain on this chip
+    attempt_env = None
+    steps_ = (probe_extra or {}).get("steps", {})
+    fstep = steps_.get("fused", {})
+    astep = steps_.get("fused_adamw", {})
+    rms_wins = (fstep.get("ok") and fstep.get("rms_us")
+                and fstep.get("rms_xla_us")
+                and fstep["rms_us"] < fstep["rms_xla_us"])
+    # the one flag also reroutes AdamW through the Pallas kernel, so a
+    # measured optimizer regression vetoes it (no adamw data = no veto)
+    adamw_regresses = (astep.get("ok") and astep.get("fused_us")
+                       and astep.get("xla_us")
+                       and astep["fused_us"] > astep["xla_us"])
+    if rms_wins and not adamw_regresses:
+        attempt_env = {"FLAGS_use_pallas_fused": "1"}
+        sys.stderr.write(
+            f"probe: Pallas rms {fstep['rms_us']}us < XLA "
+            f"{fstep['rms_xla_us']}us (adamw "
+            f"{astep.get('fused_us', '?')}us vs {astep.get('xla_us', '?')}"
+            "us) — enabling FLAGS_use_pallas_fused for attempts\n")
+    elif rms_wins:
+        sys.stderr.write(
+            f"probe: Pallas rms wins but fused AdamW regresses "
+            f"({astep['fused_us']}us > {astep['xla_us']}us) — leaving "
+            "FLAGS_use_pallas_fused off\n")
+
     results, attempts_log = [], {}
     last_err = None
     for tag in ATTEMPT_ORDER:
@@ -444,8 +479,11 @@ def _run_parent():
                 r.get("extra", {}).get("config") for r in results}:
             continue  # same model, half batch: can't beat b8's MFU — don't
             # spend a scarce tunnel-up window on it
-        res, err = _sub(["--attempt", tag], timeout=2700)
+        res, err = _sub(["--attempt", tag], timeout=2700,
+                        env_extra=attempt_env)
         if res is not None and res.get("value", 0) > 0:
+            if attempt_env:
+                res.setdefault("extra", {})["pallas_fused"] = True
             results.append(res)
             attempts_log[tag] = {"tps": res["value"],
                                  "mfu": res.get("extra", {}).get("mfu")}

@@ -162,3 +162,76 @@ def test_parent_skip_probe_rejects_stale_error_record(monkeypatch, capsys,
         bench._run_parent()
     out = capsys.readouterr().out.strip().splitlines()[-1]
     assert "probe tier failed" in json.loads(out)["extra"]["error"]
+
+
+def test_parent_flips_pallas_flag_from_probe_timings(monkeypatch, capsys):
+    """When the probe measures the Pallas rms-norm beating the XLA chain,
+    attempts run with FLAGS_use_pallas_fused=1 (VERDICT r3 ask: flip the
+    flag per data) and the result records it."""
+    probe = json.dumps({"ok": True, "steps": {
+        "matmul": {"ok": True},
+        "fused": {"ok": True, "rms_us": 80.0, "rms_xla_us": 120.0}}}) + "\n"
+    seen_env = {}
+
+    def fake_run(cmd, **kw):
+        if "--probe" in cmd:
+            return FakeProc(probe)
+        tag = cmd[cmd.index("--attempt") + 1]
+        seen_env[tag] = (kw.get("env") or {}).get("FLAGS_use_pallas_fused")
+        return FakeProc(json.dumps(
+            {"metric": "m", "value": 10.0, "unit": "tokens/s",
+             "vs_baseline": 0.1, "extra": {"mfu": 0.2, "config": tag}}) + "\n")
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    bench._run_parent()
+    res = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert all(v == "1" for v in seen_env.values())
+    assert res["extra"]["pallas_fused"] is True
+
+
+def test_parent_keeps_flag_off_when_xla_wins(monkeypatch, capsys):
+    probe = json.dumps({"ok": True, "steps": {
+        "matmul": {"ok": True},
+        "fused": {"ok": True, "rms_us": 150.0, "rms_xla_us": 120.0}}}) + "\n"
+    seen_env = {}
+
+    def fake_run(cmd, **kw):
+        if "--probe" in cmd:
+            return FakeProc(probe)
+        tag = cmd[cmd.index("--attempt") + 1]
+        seen_env[tag] = kw.get("env")
+        return FakeProc(json.dumps(
+            {"metric": "m", "value": 10.0, "unit": "tokens/s",
+             "vs_baseline": 0.1, "extra": {"mfu": 0.2, "config": tag}}) + "\n")
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    bench._run_parent()
+    res = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert all(v is None for v in seen_env.values())
+    assert "pallas_fused" not in res["extra"]
+
+
+def test_parent_adamw_regression_vetoes_flag(monkeypatch, capsys):
+    """The flag also reroutes AdamW; a measured optimizer regression in
+    the probe must veto it even when the rms-norm kernel wins."""
+    probe = json.dumps({"ok": True, "steps": {
+        "matmul": {"ok": True},
+        "fused": {"ok": True, "rms_us": 80.0, "rms_xla_us": 120.0},
+        "fused_adamw": {"ok": True, "fused_us": 300.0,
+                        "xla_us": 200.0}}}) + "\n"
+    seen_env = {}
+
+    def fake_run(cmd, **kw):
+        if "--probe" in cmd:
+            return FakeProc(probe)
+        tag = cmd[cmd.index("--attempt") + 1]
+        seen_env[tag] = kw.get("env")
+        return FakeProc(json.dumps(
+            {"metric": "m", "value": 10.0, "unit": "tokens/s",
+             "vs_baseline": 0.1, "extra": {"mfu": 0.2, "config": tag}}) + "\n")
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    bench._run_parent()
+    res = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert all(v is None for v in seen_env.values())
+    assert "pallas_fused" not in res["extra"]
