@@ -51,10 +51,8 @@ def top_k_gating(logits, top_k: int, capacity: int, *, normalize: bool = True,
     slots (stable priority, matching the reference's prune-by-capacity order).
     """
     t, e = logits.shape
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-    topv, topi = jax.lax.top_k(probs, top_k)
-    if normalize and top_k > 1:
-        topv = topv / jnp.maximum(topv.sum(-1, keepdims=True), 1e-9)
+    from paddle_tpu.kernels.gmm_pallas import topk_route
+    probs, topv, topi = topk_route(logits, top_k, normalize)
     if second_policy == "random" and top_k >= 2 and key is not None:
         # keep 2nd expert with prob proportional to its weight (GShard §3.2;
         # reference random_routing_kernel: keep iff u < 2 * gate2)
@@ -77,8 +75,8 @@ def top_k_gating(logits, top_k: int, capacity: int, *, normalize: bool = True,
     dispatch_mask = combine > 0.0
     # load-balance loss: e * sum_e mean_tokens(P_e) * mean_tokens(f_e)
     # (Switch Transformer eq. 4 / GShard l_aux; reference gshard_gate.py)
-    first = jax.nn.one_hot(topi[:, 0], e)
-    aux = (probs.mean(0) * first.mean(0)).sum() * float(e)
+    from paddle_tpu.kernels.gmm_pallas import load_balance_aux
+    aux = load_balance_aux(probs, topi)
     return combine, dispatch_mask, aux
 
 
@@ -124,8 +122,14 @@ class MoELayer(Layer):
                  gate: Union[str, BaseGate] = "gshard",
                  experts: Optional[Sequence[Layer]] = None,
                  activation="gelu", ep_axis: str = "ep",
-                 moe_group=None, recompute_interval: int = 0, name=None):
+                 moe_group=None, recompute_interval: int = 0,
+                 dropless: bool = False, name=None):
         super().__init__()
+        # dropless (MegaBlocks): grouped-matmul FFN over expert-sorted
+        # tokens — no capacity bound, no dropped tokens, no [t,e,c]
+        # dispatch arrays (kernels/gmm_pallas.py). Batched-expert backend
+        # only; routing uses deterministic top-k (no random 2nd expert).
+        self.dropless = dropless
         self.d_model = d_model
         self.d_hidden = d_hidden or 4 * d_model
         self.ep_axis = ep_axis
@@ -150,6 +154,11 @@ class MoELayer(Layer):
         self.l_aux = None
 
         if experts is not None:
+            if dropless:
+                raise ValueError(
+                    "dropless=True requires the batched-expert backend "
+                    "(stacked w1/w2 banks); a custom experts list has no "
+                    "stacked weights for the grouped matmul")
             if len(experts) != self.num_expert:
                 raise ValueError(
                     f"len(experts)={len(experts)} does not match the gate's "
@@ -170,9 +179,14 @@ class MoELayer(Layer):
                 [e, h, d], default_initializer=XavierUniform(fan_in=h,
                                                              fan_out=d))
             self.b2 = self.create_parameter([e, d], is_bias=True)
-            from paddle_tpu.distributed.fleet.meta_parallel import annotate_param
-            for p in (self.w1, self.b1, self.w2, self.b2):
-                annotate_param(p, ep_axis, 0)
+            if not self.dropless:
+                # dropless keeps expert banks replicated: the grouped
+                # matmul indexes GLOBAL expert ids, so an ep-axis shard of
+                # dim 0 would hand each device the wrong expert block
+                from paddle_tpu.distributed.fleet.meta_parallel import \
+                    annotate_param
+                for p in (self.w1, self.b1, self.w2, self.b2):
+                    annotate_param(p, ep_axis, 0)
 
     # -- routing --------------------------------------------------------------
     def _capacity(self, tokens: int) -> int:
@@ -195,15 +209,28 @@ class MoELayer(Layer):
         tokens = 1
         for s in orig_shape[:-1]:
             tokens *= s
-        capacity = self._capacity(tokens)
         top_k = self.gate.top_k
-        policy = self.gate.second_policy if self.training else "all"
-        key = next_key() if policy == "random" else None
+        if not self.dropless:
+            # capacity-path-only state: the dropless route is
+            # deterministic and capacity-free — consuming next_key() there
+            # would silently advance the global RNG stream every forward
+            capacity = self._capacity(tokens)
+            policy = self.gate.second_policy if self.training else "all"
+            key = next_key() if policy == "random" else None
 
         x2 = reshape(x, [tokens, d])
         logits = self.gate(x2)  # custom gates override forward() — honored
 
-        if self.experts is None:
+        if self.dropless and self.experts is None:
+            from paddle_tpu.kernels.gmm_pallas import moe_dropless_ffn
+
+            def fwd(x2_arr, lg, w1, b1, w2, b2):
+                return moe_dropless_ffn(x2_arr, lg, top_k, w1, b1, w2, b2,
+                                        act=self._act)
+            out2, aux = dispatch("moe_dropless", fwd, x2, logits, self.w1,
+                                 self.b1, self.w2, self.b2)
+            out = reshape(out2, orig_shape)
+        elif self.experts is None:
             def fwd(x2_arr, lg, w1, b1, w2, b2):
                 combine, disp, aux = top_k_gating(
                     lg, top_k, capacity, second_policy=policy, key=key)

@@ -229,3 +229,82 @@ class TestGPTMoE:
         losses_par = [float(t_par.train_step(b).item()) for b in batches]
 
         np.testing.assert_allclose(losses_serial, losses_par, rtol=2e-4)
+
+
+def test_moe_dropless_matches_no_drop_capacity():
+    """MoELayer(dropless=True): grouped-matmul FFN == the capacity path
+    with capacity >= tokens (no drops), same routing."""
+    import numpy as np
+    paddle.seed(33)
+    layer = MoELayer(d_model=16, d_hidden=32, num_expert=4, top_k=2,
+                     gate="naive", dropless=True)
+    paddle.seed(33)
+    ref = MoELayer(d_model=16, d_hidden=32, num_expert=4, top_k=2,
+                   gate="naive")
+    ref.load_dict(layer.state_dict())
+    layer.eval()
+    ref.eval()
+    x = paddle.to_tensor(np.random.default_rng(5)
+                         .standard_normal((3, 7, 16)).astype(np.float32))
+    np.testing.assert_allclose(layer(x).numpy(), ref(x).numpy(),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_moe_dropless_trains():
+    import numpy as np
+    from paddle_tpu import optimizer as popt
+    paddle.seed(34)
+    layer = MoELayer(d_model=8, d_hidden=16, num_expert=3, top_k=2,
+                     gate="gshard", dropless=True)
+    o = popt.AdamW(learning_rate=1e-2, parameters=layer.parameters())
+    x = paddle.to_tensor(np.random.default_rng(6)
+                         .standard_normal((4, 5, 8)).astype(np.float32))
+    first = None
+    for _ in range(3):
+        y = layer(x)
+        loss = (y * y).sum() + 0.01 * layer.l_aux
+        if first is None:
+            first = float(loss)
+        loss.backward()
+        o.step()
+        o.clear_grad()
+    assert float(loss) < first
+
+
+def test_moe_dropless_rejects_expert_list_and_keeps_weights_replicated():
+    import numpy as np
+    import paddle_tpu.nn as pnn
+    with pytest.raises(ValueError, match="batched-expert"):
+        MoELayer(d_model=8, num_expert=2, dropless=True,
+                 experts=[pnn.Linear(8, 8), pnn.Linear(8, 8)])
+    layer = MoELayer(d_model=8, d_hidden=16, num_expert=2, top_k=1,
+                     gate="naive", dropless=True)
+    # dropless expert banks stay replicated (no ep-axis annotation: the
+    # grouped matmul indexes global expert ids)
+    from paddle_tpu.distributed.fleet.meta_parallel import \
+        get_param_annotation
+    assert get_param_annotation(layer.w1) is None
+    ref = MoELayer(d_model=8, d_hidden=16, num_expert=2, top_k=1,
+                   gate="naive")
+    assert get_param_annotation(ref.w1) is not None
+
+
+def test_moe_dropless_does_not_advance_rng():
+    """A dropless forward must not consume global RNG (the capacity
+    path's random second-expert key): dropout after the layer sees the
+    same stream whether the MoE ran or not... i.e. two identical models
+    stay in lockstep with a capacity model that IS allowed to differ."""
+    import numpy as np
+    from paddle_tpu.framework.random import next_key
+    paddle.seed(44)
+    layer = MoELayer(d_model=8, d_hidden=16, num_expert=2, top_k=2,
+                     gate="gshard", dropless=True)
+    x = paddle.to_tensor(np.random.default_rng(7)
+                         .standard_normal((2, 3, 8)).astype(np.float32))
+    paddle.seed(100)
+    k_before = next_key()
+    paddle.seed(100)
+    layer.train()
+    layer(x)
+    k_after = next_key()
+    np.testing.assert_array_equal(np.asarray(k_before), np.asarray(k_after))

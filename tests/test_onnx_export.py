@@ -274,3 +274,19 @@ def test_stablehlo_format_still_exports(tmp_path):
                            export_format="stablehlo")
     import os
     assert os.path.exists(p + ".pdmodel")
+
+
+@pytest.mark.slow
+def test_resnet18_onnx_numerics_match(tmp_path):
+    """A full model-zoo ResNet18 exports and the independent evaluator
+    reproduces the live logits."""
+    from paddle_tpu.vision.models import resnet18
+    paddle.seed(4)
+    m = resnet18(num_classes=10)
+    m.eval()
+    x = paddle.to_tensor(np.random.default_rng(3)
+                         .standard_normal((1, 3, 64, 64)).astype(np.float32))
+    p = paddle.onnx.export(m, str(tmp_path / "r18"), input_spec=[x])
+    want = m(x).numpy()
+    got, = _run_onnx(p, {"x0": x.numpy()})
+    np.testing.assert_allclose(got, want, rtol=3e-4, atol=3e-4)
