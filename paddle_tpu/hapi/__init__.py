@@ -5,6 +5,7 @@ from .callbacks import (  # noqa: F401
     ReduceLROnPlateau, VisualDL, WandbCallback,
 )
 from .model import Model  # noqa: F401
+from .dynamic_flops import flops  # noqa: F401
 
 
 def summary(net, input_size=None, dtypes=None):

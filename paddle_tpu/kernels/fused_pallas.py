@@ -52,17 +52,27 @@ def enabled() -> bool:
 
 # -- fused rope ---------------------------------------------------------------
 # q,k: [b, s, h, d]; cos/sin: [s, d/2]. Interleaved-pair rotation (llama).
+#
+# Mosaic constraint: >2D gathers don't lower, so the pair rotation is NOT
+# written as strided slices (x[..., 0::2]). Instead the host precomputes
+# lane-duplicated cos/sin ([s, d], each value repeated per pair) and the
+# kernel builds the rotated operand with two rolls along the lane axis plus
+# constant even/odd masks — contiguous slices and elementwise only:
+#   rot[2i] = -x[2i+1] = (roll(x,-1) * m_even_neg)[2i]
+#   rot[2i+1] = x[2i]  = (roll(x,+1) * m_odd)[2i+1]
+#   out = x * cos_dup + rot * sin_dup
 
-def _rope_kernel(q_ref, k_ref, cos_ref, sin_ref, oq_ref, ok_ref):
-    c = cos_ref[0]                                  # [Bs, d/2] fp32
+def _rope_kernel(q_ref, k_ref, cos_ref, sin_ref, mneg_ref, mpos_ref,
+                 oq_ref, ok_ref):
+    c = cos_ref[0]                                  # [Bs, d] fp32
     s = sin_ref[0]
+    m_neg = mneg_ref[0]                             # [1, d]: -1 even, 0 odd
+    m_pos = mpos_ref[0]                             # [1, d]: 0 even, +1 odd
     for src, dst in ((q_ref, oq_ref), (k_ref, ok_ref)):
         x = src[0].astype(jnp.float32)              # [Bs, h, d]
-        x1 = x[:, :, 0::2]
-        x2 = x[:, :, 1::2]
-        ro1 = x1 * c[:, None, :] - x2 * s[:, None, :]
-        ro2 = x2 * c[:, None, :] + x1 * s[:, None, :]
-        out = jnp.stack([ro1, ro2], axis=-1).reshape(x.shape)
+        rot = (jnp.roll(x, -1, axis=-1) * m_neg[None]
+               + jnp.roll(x, 1, axis=-1) * m_pos[None])
+        out = x * c[:, None, :] + rot * s[:, None, :]
         dst[0] = out.astype(dst.dtype)
 
 
@@ -71,16 +81,21 @@ def fused_rope_pallas(q, k, cos, sin, block_s: int = 256):
     b, s, h, d = q.shape
     bs = _best_block(s, block_s)
     ns = s // bs
-    cos2 = cos.astype(jnp.float32)
-    sin2 = sin.astype(jnp.float32)
+    cos2 = jnp.repeat(cos.astype(jnp.float32), 2, axis=-1)      # [s, d]
+    sin2 = jnp.repeat(sin.astype(jnp.float32), 2, axis=-1)
+    lane = jnp.arange(d, dtype=jnp.int32) % 2
+    m_neg = jnp.where(lane == 0, -1.0, 0.0).astype(jnp.float32)[None]
+    m_pos = jnp.where(lane == 1, 1.0, 0.0).astype(jnp.float32)[None]
     oq, ok = pl.pallas_call(
         _rope_kernel,
         grid=(b, ns),
         in_specs=[
             pl.BlockSpec((1, bs, h, d), lambda ib, i: (ib, i, 0, 0)),
             pl.BlockSpec((1, bs, k.shape[2], d), lambda ib, i: (ib, i, 0, 0)),
-            pl.BlockSpec((1, bs, d // 2), lambda ib, i: (0, i, 0)),
-            pl.BlockSpec((1, bs, d // 2), lambda ib, i: (0, i, 0)),
+            pl.BlockSpec((1, bs, d), lambda ib, i: (0, i, 0)),
+            pl.BlockSpec((1, bs, d), lambda ib, i: (0, i, 0)),
+            pl.BlockSpec((1, 1, d), lambda ib, i: (0, 0, 0)),
+            pl.BlockSpec((1, 1, d), lambda ib, i: (0, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, bs, h, d), lambda ib, i: (ib, i, 0, 0)),
@@ -91,7 +106,7 @@ def fused_rope_pallas(q, k, cos, sin, block_s: int = 256):
             jax.ShapeDtypeStruct(k.shape, k.dtype),
         ],
         interpret=_INTERPRET,
-    )(q, k, cos2[None], sin2[None])
+    )(q, k, cos2[None], sin2[None], m_neg[None], m_pos[None])
     return oq, ok
 
 
@@ -102,7 +117,7 @@ def _rmsnorm_kernel(x_ref, w_ref, o_ref, *, eps, has_residual, r_ref=None):
     if has_residual:
         x = x + r_ref[0].astype(jnp.float32)
     ms = jnp.mean(x * x, axis=-1, keepdims=True)
-    y = x * jax.lax.rsqrt(ms + eps) * w_ref[...].astype(jnp.float32)
+    y = x * jax.lax.rsqrt(ms + eps) * w_ref[0].astype(jnp.float32)
     o_ref[0] = y.astype(o_ref.dtype)
 
 
@@ -131,22 +146,22 @@ def fused_rms_norm_pallas(x, weight, eps: float = 1e-6, residual=None,
             in_specs=[
                 pl.BlockSpec((1, br, hidden), lambda i: (0, i, 0)),
                 pl.BlockSpec((1, br, hidden), lambda i: (0, i, 0)),
-                pl.BlockSpec((hidden,), lambda i: (0,)),
+                pl.BlockSpec((1, hidden), lambda i: (0, 0)),
             ],
             out_specs=pl.BlockSpec((1, br, hidden), lambda i: (0, i, 0)),
             out_shape=jax.ShapeDtypeStruct((1, rows, hidden), x.dtype),
             interpret=_INTERPRET,
-        )(xr[None], rr[None], weight)
+        )(xr[None], rr[None], weight[None])
     else:
         out = pl.pallas_call(
             functools.partial(_rmsnorm_kernel, eps=eps, has_residual=False),
             grid=(nr,),
             in_specs=[
                 pl.BlockSpec((1, br, hidden), lambda i: (0, i, 0)),
-                pl.BlockSpec((hidden,), lambda i: (0,)),
+                pl.BlockSpec((1, hidden), lambda i: (0, 0)),
             ],
             out_specs=pl.BlockSpec((1, br, hidden), lambda i: (0, i, 0)),
             out_shape=jax.ShapeDtypeStruct((1, rows, hidden), x.dtype),
             interpret=_INTERPRET,
-        )(xr[None], weight)
+        )(xr[None], weight[None])
     return out.reshape(orig_shape)

@@ -30,10 +30,12 @@ from . import fused_pallas as _fp
 
 def _adamw_kernel(p_ref, g_ref, m_ref, v_ref, sc_ref,
                   op_ref, om_ref, ov_ref):
-    """One VMEM block of the flat group. sc_ref: [8] f32 scalars
-    (lr, beta1, beta2, eps, wd, bc1, bc2, decoupled)."""
-    lr, b1, b2, eps = sc_ref[0], sc_ref[1], sc_ref[2], sc_ref[3]
-    wd, bc1, bc2, dec = sc_ref[4], sc_ref[5], sc_ref[6], sc_ref[7]
+    """One VMEM block of the flat group, viewed 2-D [rows, 1024] (Mosaic
+    wants >=2-D refs with a 128-multiple lane dim; the 1-D original
+    crashed the TPU compiler, PROBE_r04 fused_adamw). sc_ref: [1, 8] f32
+    scalars (lr, beta1, beta2, eps, wd, bc1, bc2, decoupled)."""
+    lr, b1, b2, eps = sc_ref[0, 0], sc_ref[0, 1], sc_ref[0, 2], sc_ref[0, 3]
+    wd, bc1, bc2, dec = sc_ref[0, 4], sc_ref[0, 5], sc_ref[0, 6], sc_ref[0, 7]
     p = p_ref[...].astype(jnp.float32)
     g = g_ref[...].astype(jnp.float32)
     m = m_ref[...]
@@ -50,29 +52,36 @@ def _adamw_kernel(p_ref, g_ref, m_ref, v_ref, sc_ref,
     ov_ref[...] = v_new
 
 
-@functools.partial(jax.jit, static_argnames=("decoupled", "block"))
+_LANES = 1024  # flat buffers are padded to this, so the 2-D view is exact
+
+
+@functools.partial(jax.jit, static_argnames=("decoupled", "block_rows"))
 def _fused_adamw_flat(p, g, m, v, lr, beta1, beta2, eps, wd, step,
-                      decoupled: bool, block: int = 65536):
-    """p/g: flat [n] (param dtype); m/v: flat [n] f32; scalars f32."""
+                      decoupled: bool, block_rows: int = 64):
+    """p/g: flat [n] (param dtype), n a multiple of _LANES; m/v: flat [n]
+    f32; scalars f32. The kernel streams [block_rows, _LANES] tiles."""
     n = p.shape[0]
-    bs = _fp._best_block(n, block)
+    rows = n // _LANES
+    br = _fp._best_block(rows, block_rows)
     bc1 = 1.0 - beta1 ** step
     bc2 = 1.0 - beta2 ** step
     sc = jnp.stack([lr, beta1, beta2, eps, wd, bc1, bc2,
-                    jnp.float32(1.0 if decoupled else 0.0)])
-    grid = (n // bs,)
-    blk = pl.BlockSpec((bs,), lambda i: (i,))
-    sc_spec = pl.BlockSpec((8,), lambda i: (0,))
-    return pl.pallas_call(
+                    jnp.float32(1.0 if decoupled else 0.0)])[None]
+    grid = (rows // br,)
+    blk = pl.BlockSpec((br, _LANES), lambda i: (i, 0))
+    sc_spec = pl.BlockSpec((1, 8), lambda i: (0, 0))
+    view = lambda a: a.reshape(rows, _LANES)
+    op, om, ov = pl.pallas_call(
         _adamw_kernel,
         grid=grid,
         in_specs=[blk, blk, blk, blk, sc_spec],
         out_specs=[blk, blk, blk],
-        out_shape=[jax.ShapeDtypeStruct((n,), p.dtype),
-                   jax.ShapeDtypeStruct((n,), jnp.float32),
-                   jax.ShapeDtypeStruct((n,), jnp.float32)],
+        out_shape=[jax.ShapeDtypeStruct((rows, _LANES), p.dtype),
+                   jax.ShapeDtypeStruct((rows, _LANES), jnp.float32),
+                   jax.ShapeDtypeStruct((rows, _LANES), jnp.float32)],
         interpret=_fp._INTERPRET,
-    )(p, g, m, v, sc)
+    )(view(p), view(g), view(m), view(v), sc)
+    return op.reshape(n), om.reshape(n), ov.reshape(n)
 
 
 def _pad_to(x, mult):
@@ -89,8 +98,8 @@ def fused_adamw_pallas(p, g, m, v, *, lr, beta1, beta2, eps, wd, step,
     shape = p.shape
     n = p.size
     out_p, out_m, out_v = _fused_adamw_flat(
-        _pad_to(p.reshape(-1), 1024), _pad_to(g.reshape(-1), 1024),
-        _pad_to(m.reshape(-1), 1024), _pad_to(v.reshape(-1), 1024),
+        _pad_to(p.reshape(-1), _LANES), _pad_to(g.reshape(-1), _LANES),
+        _pad_to(m.reshape(-1), _LANES), _pad_to(v.reshape(-1), _LANES),
         jnp.float32(lr), jnp.float32(beta1), jnp.float32(beta2),
         jnp.float32(eps), jnp.float32(wd), jnp.float32(step),
         bool(decoupled))
@@ -112,8 +121,8 @@ def _group_update(ps, gs, ms, vs, lr, beta1, beta2, eps, wd, step,
     flat_m = jnp.concatenate([m.reshape(-1) for m in ms])
     flat_v = jnp.concatenate([v.reshape(-1) for v in vs])
     np_, nm, nv = _fused_adamw_flat(
-        _pad_to(flat_p, 1024), _pad_to(flat_g, 1024),
-        _pad_to(flat_m, 1024), _pad_to(flat_v, 1024),
+        _pad_to(flat_p, _LANES), _pad_to(flat_g, _LANES),
+        _pad_to(flat_m, _LANES), _pad_to(flat_v, _LANES),
         lr, beta1, beta2, eps, wd, step, decoupled)
     out_p, out_m, out_v = [], [], []
     off = 0

@@ -99,8 +99,9 @@ def fake_channel_wise_quantize_abs_max(x, bit_length: int = 8,
 
 def weight_quantize(w, algo: str = "weight_only_int8"):
     """Parity: ops.yaml weight_quantize — returns (quantized weight,
-    scale). int4 uses the native jnp.int4 dtype instead of the
-    reference's two-nibbles-per-int8 packing (XLA owns the packing)."""
+    scale). int4 packs two nibbles per int8 along the in-dim (the
+    reference's packing; `weight_only_linear` unpacks inside the compiled
+    matmul so HBM still reads 4 bits/weight)."""
     from ._kernels import ALGO_BITS, quantize_weight_arrays
     bits = ALGO_BITS.get(algo)
     if bits is None:
@@ -111,12 +112,18 @@ def weight_quantize(w, algo: str = "weight_only_int8"):
     return Tensor(q), Tensor(scale)
 
 
-def weight_dequantize(w_int8, scale):
-    """Parity: ops.yaml weight_dequantize."""
+def weight_dequantize(w_int8, scale, algo: str = "weight_only_int8"):
+    """Parity: ops.yaml weight_dequantize. For the int4-packed form the
+    in-dim is recovered as 2x the packed row count (an odd original in-dim
+    keeps its zero pad row; pass the matrix through weight_only_linear for
+    exact odd-dim handling)."""
+    from ._kernels import dequantize_weight_arrays
     q = ensure_tensor(w_int8)
     s = ensure_tensor(scale)
+    n_rows = 2 * q.shape[0] if algo == "weight_only_int4" else None
     return dispatch("weight_dequantize",
-                    lambda a, b: a.astype(jnp.float32) * b, q, s)
+                    lambda a, b: dequantize_weight_arrays(a, b, n_rows),
+                    q, s)
 
 
 def weight_only_linear(x, weight_int8, bias=None, weight_scale=None,

@@ -21,17 +21,45 @@ FP8_MAX = {"fp8_e4m3": 448.0, "fp8_e5m2": 57344.0}
 FP8_DTYPE = {"fp8_e4m3": jnp.float8_e4m3fn, "fp8_e5m2": jnp.float8_e5m2}
 
 
+def pack_int4_rows(q8):
+    """Pack int4 values held in an int8 array [in, out] into nibbles along
+    axis 0 -> int8 [ceil(in/2), out]: even rows in the low nibble, odd rows
+    in the high nibble (reference weight_quantize packs the same way). An
+    odd row count gets a zero pad row that unpack_int4_rows slices off."""
+    n = q8.shape[0]
+    if n % 2:
+        q8 = jnp.concatenate(
+            [q8, jnp.zeros((1,) + q8.shape[1:], q8.dtype)], axis=0)
+    even = q8[0::2]
+    odd = q8[1::2]
+    return ((odd << 4) | (even & 0x0F)).astype(jnp.int8)
+
+
+def unpack_int4_rows(packed, n_rows):
+    """Inverse of pack_int4_rows: int8 [p, out] -> int8 [n_rows, out] with
+    sign extension. XLA fuses this into the consumer (the dot reads 4
+    bits/weight from HBM)."""
+    even = (packed << 4) >> 4        # arithmetic shifts sign-extend
+    odd = packed >> 4
+    full = jnp.stack([even, odd], axis=1).reshape(
+        (2 * packed.shape[0],) + packed.shape[1:])
+    return full[:n_rows]
+
+
 def quantize_weight_arrays(arr, bits: int = 8):
     """Per-output-channel symmetric quantization for a matmul weight used
-    as `x @ arr` ([in, out]): returns (q int8|int4 [in, out], scale fp32
-    [out]). The fp32 upcast makes bf16 weights quantize against the true
-    channel max instead of a bf16-rounded one. bits=4 uses the native
-    jnp.int4 dtype (TPU reads packed nibbles from HBM) rather than the
-    reference's two-nibbles-per-int8 manual packing."""
+    as `x @ arr` ([in, out]): returns (q, scale fp32 [out]). The fp32
+    upcast makes bf16 weights quantize against the true channel max
+    instead of a bf16-rounded one. bits=8 returns int8 [in, out]; bits=4
+    returns nibble-packed int8 [ceil(in/2), out] (reference parity with
+    weight_quantize's two-nibbles-per-int8 packing — native jnp.int4 jit
+    arguments hit a layout-conversion recursion on real TPU, see
+    PROBE_r04; the packed form keeps HBM reads at 4 bits/weight because
+    XLA fuses the unpack into the dot operand)."""
     if bits == 8:
-        qmax, lo, hi, dt = 127.0, -128, 127, jnp.int8
+        qmax, lo, hi = 127.0, -128, 127
     elif bits == 4:
-        qmax, lo, hi, dt = 7.0, -8, 7, jnp.int4
+        qmax, lo, hi = 7.0, -8, 7
     elif bits in FP8_MAX:
         fmax = FP8_MAX[bits]
         a32 = arr.astype(jnp.float32)
@@ -42,8 +70,19 @@ def quantize_weight_arrays(arr, bits: int = 8):
         raise NotImplementedError(f"weight quantization bits={bits}")
     a32 = arr.astype(jnp.float32)
     scale = jnp.maximum(jnp.abs(a32).max(axis=0), 1e-8) / qmax
-    q = jnp.clip(jnp.round(a32 / scale), lo, hi).astype(dt)
+    q = jnp.clip(jnp.round(a32 / scale), lo, hi).astype(jnp.int8)
+    if bits == 4:
+        q = pack_int4_rows(q)
     return q, scale
+
+
+def dequantize_weight_arrays(q, s, n_rows=None):
+    """Dequantize the output of quantize_weight_arrays back to fp32.
+    The int4-packed form REQUIRES `n_rows` (the original in-dim, used to
+    detect packing and slice the pad row); int8/fp8 arrays ignore it."""
+    if q.dtype == jnp.int8 and n_rows is not None and q.shape[0] != n_rows:
+        q = unpack_int4_rows(q, n_rows)
+    return q.astype(jnp.float32) * s
 
 
 def quantize_tensor_fp8_arrays(arr, fmt: str = "fp8_e4m3"):
@@ -58,9 +97,18 @@ def quantize_tensor_fp8_arrays(arr, fmt: str = "fp8_e4m3"):
 
 
 def quant_matmul_arrays(x, q, s):
-    """(x @ int8/int4-matrix) with the per-output-channel scale applied to
-    the fp32-upcast result — mathematically identical to dequantizing the
-    matrix first (sum_i x_i q_ij s_j), but XLA reads the narrow integer
-    bytes from HBM and fuses the upcast into the dot's operand."""
+    """(x @ int8-or-packed-int4 matrix) with the per-output-channel scale
+    applied to the fp32-upcast result — mathematically identical to
+    dequantizing the matrix first (sum_i x_i q_ij s_j), but XLA reads the
+    narrow integer bytes from HBM and fuses the upcast (and the int4
+    nibble unpack) into the dot's operand. A packed-int4 matrix is
+    recognized by its halved row count vs x's contraction dim."""
+    k = x.shape[-1]
+    if q.dtype == jnp.int8 and q.shape[0] != k:
+        if q.shape[0] != (k + 1) // 2:
+            raise ValueError(
+                f"quant_matmul: weight rows {q.shape[0]} match neither the "
+                f"contraction dim {k} (int8) nor its nibble-packed half")
+        q = unpack_int4_rows(q, k)
     y = x @ q.astype(x.dtype)
     return (y.astype(jnp.float32) * s).astype(x.dtype)

@@ -30,7 +30,7 @@ class _StaticNode:
     """One recorded op: replayable fwd + input refs (Variables or concrete
     Tensors captured by reference, e.g. Parameters)."""
 
-    __slots__ = ("name", "fwd", "inputs", "n_out")
+    __slots__ = ("name", "fwd", "inputs", "n_out", "__weakref__")
 
     def __init__(self, name, fwd, inputs, n_out):
         self.name = name
@@ -70,6 +70,9 @@ class Program:
     def __init__(self):
         self._optimize = None          # (optimizer, loss_var, params)
         self.random_seed = None
+        # weakrefs to recorded graph nodes (for flops): the nodes stay
+        # owned by their output Variables, so dead graphs still collect
+        self._nodes = []
 
     def global_block(self):
         return self
@@ -78,6 +81,7 @@ class Program:
         import copy
         p = Program()
         p._optimize = None if for_test else self._optimize
+        p._nodes = list(self._nodes)
         return p
 
 
@@ -132,6 +136,14 @@ def record_static_op(name, fwd, tensor_inputs):
     out = jax.eval_shape(fwd, *avals)
     node = _StaticNode(name, fwd, list(tensor_inputs),
                        len(out) if isinstance(out, (tuple, list)) else 1)
+    import weakref
+    prog = default_main_program()
+    prog._nodes.append(weakref.ref(node))
+    # prune cleared refs on a doubling schedule: a big LIVE graph must not
+    # rescan its whole list per op (that would be O(n^2) tracing)
+    if len(prog._nodes) > getattr(prog, "_nodes_prune_at", 4096):
+        prog._nodes = [r for r in prog._nodes if r() is not None]
+        prog._nodes_prune_at = max(4096, 2 * len(prog._nodes))
     if isinstance(out, (tuple, list)):
         return tuple(Variable(a, node=node, idx=i)
                      for i, a in enumerate(out))

@@ -574,12 +574,14 @@ def _snap_quant(model, bits):
     projection (quantize->dequantize), so the quant decode is LOSSLESS up
     to summation-order ulps and must reproduce the fp tokens exactly."""
     from paddle_tpu.generation import _decoder_for, _wq
+    from paddle_tpu.quantization._kernels import dequantize_weight_arrays
     dec = _decoder_for(model)
     names, _lm = dec.quant_plan()
     for name, t in model.named_state().items():
         if name in names:
             q, s = _wq(t._data, bits=bits)
-            t._data = (q.astype(jnp.float32) * s).astype(t._data.dtype)
+            t._data = dequantize_weight_arrays(
+                q, s, n_rows=t._data.shape[0]).astype(t._data.dtype)
 
 
 @pytest.mark.parametrize("algo,bits", [("weight_only_int8", 8),
@@ -592,8 +594,10 @@ def test_weight_only_decode_lossless_weights_exact(tied, algo, bits):
         # the tied head quantizes the embedding TABLE too (__lm::q source)
         emb = model.model.embed_tokens.weight
         from paddle_tpu.generation import _wq
+        from paddle_tpu.quantization._kernels import dequantize_weight_arrays
         q, s = _wq(emb._data.T, bits=bits)
-        emb._data = (q.astype(jnp.float32) * s).T.astype(emb._data.dtype)
+        emb._data = dequantize_weight_arrays(
+            q, s, n_rows=emb._data.T.shape[0]).T.astype(emb._data.dtype)
     rng = np.random.default_rng(21)
     ids = rng.integers(0, 61, (2, 7)).astype(np.int32)
     fp, _ = model.generate(paddle.to_tensor(ids), max_new_tokens=8)
