@@ -49,6 +49,12 @@ def _emit_error(msg: str) -> None:
 ATTEMPT_ORDER = ("llama-0.5b-b8", "llama-1.1b-b8", "llama-1.1b-b4",
                  "llama-0.27b-b8", "llama-0.27b-b8-remat")
 
+# extra rungs for tools/mfu_lab.py (not part of the driver ladder): remat
+# policy / batch / attention variants to locate the MFU sweet spot on
+# this chip (the 1.1B full-remat variants live in the ladder itself)
+LAB_TAGS = ("llama-0.5b-b8-noremat", "llama-0.5b-b16",
+            "llama-0.5b-b8-noflash")
+
 
 def _attempt_table():
     from paddle_tpu.models.llama import LlamaConfig
@@ -78,16 +84,23 @@ def _attempt_table():
                            max_position_embeddings=2048)
 
     # tag -> (cfg, batch, seq, steps, warmup, remat, loss_chunk)
-    # loss_chunk: sequence-chunked CE (no [B,S,V] logits buffer) — the
-    # 1.1B configs need it to fit ~13GB usable HBM on one v5e
+    # remat: False = no checkpointing; "dots" = save MXU outputs (cheap
+    # recompute); "full" = save only layer boundaries (max memory saving —
+    # what lets the 1.1B configs fit, their r04 OOM was a SAVED [8,2048,
+    # 5632] gate activation under "dots"). loss_chunk: sequence-chunked CE
+    # (no [B,S,V] logits buffer) — 1.1B needs it on ~13GB usable HBM.
     table = {
-        "llama-0.5b-b8": (cfg_half(), 8, 2048, 10, 2, True, 256),
-        "llama-1.1b-b8": (cfg_1b(), 8, 2048, 10, 2, True, 256),
-        "llama-1.1b-b4": (cfg_1b(), 4, 2048, 10, 2, True, 256),
+        "llama-0.5b-b8": (cfg_half(), 8, 2048, 10, 2, "dots", 256),
+        "llama-1.1b-b8": (cfg_1b(), 8, 2048, 10, 2, "full", 256),
+        "llama-1.1b-b4": (cfg_1b(), 4, 2048, 10, 2, "full", 256),
         "llama-0.27b-b8": (cfg_small(), 8, 2048, 10, 2, False, None),
-        "llama-0.27b-b8-remat": (cfg_small(), 8, 2048, 10, 2, True, 256),
+        "llama-0.27b-b8-remat": (cfg_small(), 8, 2048, 10, 2, "dots", 256),
+        # lab rungs
+        "llama-0.5b-b8-noremat": (cfg_half(), 8, 2048, 10, 2, False, 256),
+        "llama-0.5b-b16": (cfg_half(), 16, 2048, 10, 2, "dots", 256),
+        "llama-0.5b-b8-noflash": (cfg_half(), 8, 2048, 10, 2, "dots", 256),
     }
-    assert set(table) == set(ATTEMPT_ORDER)
+    assert set(ATTEMPT_ORDER) | set(LAB_TAGS) == set(table)
     return table
 
 
@@ -129,6 +142,8 @@ def _run_probe(extend=None):
 
     def step(name, fn):
         t0 = _t.perf_counter()
+        sys.stderr.write(f"[probe] {name} ...\n")
+        sys.stderr.flush()
         try:
             extra = fn() or {}
             out["steps"][name] = {"ok": True,
@@ -138,6 +153,10 @@ def _run_probe(extend=None):
             out["steps"][name] = {"ok": False,
                                   "sec": round(_t.perf_counter() - t0, 4),
                                   "error": f"{type(e).__name__}: {e}"[:500]}
+        sys.stderr.write(f"[probe] {name} -> "
+                         f"{out['steps'][name].get('ok')} "
+                         f"({out['steps'][name]['sec']}s)\n")
+        sys.stderr.flush()
 
     import jax
     import jax.numpy as jnp
@@ -165,16 +184,47 @@ def _run_probe(extend=None):
         barrier(r)
         return (_t.perf_counter() - t0) / iters
 
+    def ctimeit(fn, args, iters=16):
+        """Chained timing: `iters` dependent calls inside ONE jit (lax.scan),
+        so the ~9ms/dispatch tunnel RPC cost is paid once, not per iter
+        (measured r04: matmul4096 10,387us dispatched vs 1,422us chained —
+        every per-dispatch kernel number before this was overhead noise).
+        lax.optimization_barrier ties the args to the scan carry, so XLA
+        cannot hoist the body out of the loop — and unlike an input
+        perturbation it moves no data, keeping Pallas custom calls and XLA
+        fusions on equal footing. The carry sums ALL outputs (a dead
+        output would let XLA delete the kernel that produces it, e.g. the
+        dk/dv pallas_call of flash_attention's VJP)."""
+        from jax import lax
+
+        @jax.jit
+        def many(arg_tuple):
+            def body(c, _):
+                a, cc = lax.optimization_barrier((arg_tuple, c))
+                out = fn(*a)
+                leaves = jax.tree_util.tree_leaves(out)
+                s = sum(jnp.sum(o.astype(jnp.float32)) for o in leaves)
+                return cc + s * 1e-30, None
+            c, _ = lax.scan(body, jnp.float32(0.0), None, length=iters)
+            return c
+        args = tuple(args)
+        float(many(args))  # warm (compile) + sync
+        t0 = _t.perf_counter()
+        float(many(args))
+        return (_t.perf_counter() - t0) / iters
+
     def mm_probe():
         x = jnp.ones((256, 256), jnp.bfloat16)
         barrier(x @ x)
         n = 4096
         a = jax.random.normal(jax.random.PRNGKey(0), (n, n)).astype(jnp.bfloat16)
         f = jax.jit(lambda a: a @ a)
-        dt = timeit(lambda: f(a))
+        dt_disp = timeit(lambda: f(a), iters=5)   # includes tunnel RPC cost
+        dt = ctimeit(lambda a: a @ a, (a,), iters=16)
         peak, assumed = peak_flops_per_chip(dev)
         tflops = 2 * n ** 3 / dt / 1e12
         return {"matmul4096_us": round(dt * 1e6, 1),
+                "dispatch_overhead_us": round((dt_disp - dt) * 1e6, 1),
                 "bf16_tflops": round(tflops, 1),
                 "frac_peak": round(tflops * 1e12 / peak, 3),
                 "peak_assumed": assumed}
@@ -187,29 +237,28 @@ def _run_probe(extend=None):
 
     def flash_fwd_probe():
         from paddle_tpu.kernels.flash_pallas import flash_attention
-        f = jax.jit(lambda q, k, v: flash_attention(q, k, v, True))
-        dt = timeit(lambda: f(*qkv))
+        dt = ctimeit(lambda q, k, v: flash_attention(q, k, v, True), qkv)
         return {"us": round(dt * 1e6, 1),
                 "tflops": round(fa_flops / dt / 1e12, 1),
                 "shape": f"b{b}h{h}s{s}d{d}"}
 
     def flash_bwd_probe():
         from paddle_tpu.kernels.flash_pallas import flash_attention
-        g = jax.jit(jax.grad(
+        g = jax.grad(
             lambda q, k, v: flash_attention(q, k, v, True)
-            .astype(jnp.float32).sum(), argnums=(0, 1, 2)))
-        dt = timeit(lambda: g(*qkv)[0])
+            .astype(jnp.float32).sum(), argnums=(0, 1, 2))
+        dt = ctimeit(g, qkv)
         return {"us": round(dt * 1e6, 1),
                 "tflops": round(2.5 * fa_flops / dt / 1e12, 1)}
 
     def xla_attn_probe():
         from paddle_tpu.kernels.flash_pallas import _reference_bhsd
-        f = jax.jit(lambda q, k, v: _reference_bhsd(q, k, v, True, None))
-        dt = timeit(lambda: f(*qkv))
-        g = jax.jit(jax.grad(
+        dt = ctimeit(lambda q, k, v: _reference_bhsd(q, k, v, True, None),
+                     qkv)
+        g = jax.grad(
             lambda q, k, v: _reference_bhsd(q, k, v, True, None)
-            .astype(jnp.float32).sum(), argnums=(0, 1, 2)))
-        dtb = timeit(lambda: g(*qkv)[0])
+            .astype(jnp.float32).sum(), argnums=(0, 1, 2))
+        dtb = ctimeit(g, qkv)
         return {"fwd_us": round(dt * 1e6, 1), "bwd_us": round(dtb * 1e6, 1)}
 
     def fused_probe():
@@ -223,20 +272,28 @@ def _run_probe(extend=None):
                       .reshape(ss, dd // 2))
         sin = jnp.sin(jnp.arange(ss * dd // 2, dtype=jnp.float32)
                       .reshape(ss, dd // 2))
-        fr = jax.jit(lambda q, k: fused_rope_pallas(q, k, cos, sin))
-        dt_rope = timeit(lambda: fr(q, k)[0])
+        dt_rope = ctimeit(lambda q, k: fused_rope_pallas(q, k, cos, sin),
+                          (q, k))
         x = jax.random.normal(ks[2], (bb, ss, hh * dd)).astype(jnp.bfloat16)
         w = jnp.ones((hh * dd,), jnp.bfloat16)
-        fn = jax.jit(lambda x: fused_rms_norm_pallas(x, w))
-        dt_rms = timeit(lambda: fn(x))
+        dt_rms = ctimeit(lambda x: fused_rms_norm_pallas(x, w), (x,))
         # XLA-fused jnp versions of the same math, for the flag decision
         def rms_jnp(x):
             xf = x.astype(jnp.float32)
             return (xf * jax.lax.rsqrt(jnp.mean(xf * xf, -1, keepdims=True)
                                        + 1e-6) * w).astype(x.dtype)
-        fx = jax.jit(rms_jnp)
-        dt_rms_xla = timeit(lambda: fx(x))
+        dt_rms_xla = ctimeit(rms_jnp, (x,))
+        def rope_jnp(q, k):
+            c = cos[None, :, None, :]
+            si = sin[None, :, None, :]
+            def rot(t):
+                t1, t2 = t[..., 0::2], t[..., 1::2]
+                return jnp.stack([t1 * c - t2 * si, t2 * c + t1 * si],
+                                 -1).reshape(t.shape).astype(t.dtype)
+            return rot(q), rot(k)
+        dt_rope_xla = ctimeit(rope_jnp, (q, k))
         return {"rope_us": round(dt_rope * 1e6, 1),
+                "rope_xla_us": round(dt_rope_xla * 1e6, 1),
                 "rms_us": round(dt_rms * 1e6, 1),
                 "rms_xla_us": round(dt_rms_xla * 1e6, 1)}
 
@@ -253,9 +310,8 @@ def _run_probe(extend=None):
                        jnp.zeros((s,), jnp.int32),
                        jnp.zeros((s,), jnp.int32)], -1)[None, None],
             (b, h, s, 4))
-        f = jax.jit(lambda q, k, v: flashmask_attention(q, k, v, bounds,
-                                                        True))
-        dt = timeit(lambda: f(*qkv))
+        dt = ctimeit(lambda q, k, v: flashmask_attention(q, k, v, bounds,
+                                                         True), qkv)
         visible_frac = doc / (2.0 * s)  # per-column visible rows / s, causal
         return {"us": round(dt * 1e6, 1), "doc_len": doc,
                 "visible_frac": round(visible_frac, 4)}
@@ -344,18 +400,21 @@ def _run_probe(extend=None):
         vs = [jnp.zeros_like(p) for p in ps]
         args = dict(lr=1e-3, beta1=0.9, beta2=0.95, eps=1e-8, step=2.0)
         def _sync_all(results):
-            # one array depending on EVERY kernel, so timeit's barrier
-            # waits on all dispatches symmetrically on both sides
+            # one array depending on EVERY kernel, so the scan carry
+            # depends on all of them symmetrically
             return jnp.stack([r.ravel()[0] for r in results])
 
-        dt = timeit(lambda: _sync_all(op.multi_tensor_adamw_pallas(
-            ps, gs, ms, vs, wds=[0.1] * 4, **args)[0]))
-        o = jax.jit(lambda p, g, m, v: _adam_update(
-            p, g, m, v, jnp.float32(1e-3), jnp.float32(0.9),
-            jnp.float32(0.95), jnp.float32(1e-8), jnp.float32(2.0),
-            jnp.float32(0.1), True)[0])
-        dt_xla = timeit(lambda: _sync_all(
-            [o(p, g, m, v) for p, g, m, v in zip(ps, gs, ms, vs)]))
+        dt = ctimeit(lambda *allp: _sync_all(op.multi_tensor_adamw_pallas(
+            list(allp), gs, ms, vs, wds=[0.1] * 4, **args)[0]),
+            tuple(ps), iters=6)
+
+        def oracle_all(*allp):
+            return _sync_all([
+                _adam_update(p, g, m, v, jnp.float32(1e-3), jnp.float32(0.9),
+                             jnp.float32(0.95), jnp.float32(1e-8),
+                             jnp.float32(2.0), jnp.float32(0.1), True)[0]
+                for p, g, m, v in zip(allp, gs, ms, vs)])
+        dt_xla = ctimeit(oracle_all, tuple(ps), iters=6)
         return {"fused_us": round(dt * 1e6, 1),
                 "xla_us": round(dt_xla * 1e6, 1)}
 
@@ -369,10 +428,10 @@ def _run_probe(extend=None):
         w = jax.random.normal(ks[1], (k_, n_)).astype(jnp.bfloat16)
         qx, _ = quantize_tensor_fp8_arrays(x)
         qw, _ = quantize_weight_arrays(w, bits="fp8_e4m3")
-        mmf32 = jax.jit(lambda a, b: jnp.matmul(
-            a, b, preferred_element_type=jnp.float32))  # retraces per dtype
-        dt8 = timeit(lambda: mmf32(qx, qw))
-        dtb = timeit(lambda: mmf32(x, w))
+        mmf32 = lambda a, b: jnp.matmul(
+            a, b, preferred_element_type=jnp.float32)
+        dt8 = ctimeit(mmf32, (qx, qw), iters=16)
+        dtb = ctimeit(mmf32, (x, w), iters=16)
         fl = 2 * m_ * k_ * n_
         return {"fp8_us": round(dt8 * 1e6, 1),
                 "bf16_us": round(dtb * 1e6, 1),
@@ -581,6 +640,8 @@ def main():
             deadline["t"] = time.monotonic() + 1500
             deadline["what"] = f"compile/measure {tag}"
             paddle.seed(0)
+            if tag.endswith("-noflash"):
+                cfg.use_flash_attention = False
             model = LlamaForCausalLM(cfg)
             model.bfloat16()  # bf16 params, fp32 moments (AMP O2 recipe)
             optimizer = opt.AdamW(learning_rate=1e-4,
@@ -593,7 +654,7 @@ def main():
             trainer = SpmdTrainer(
                 model, optimizer, loss_fn, mesh=None,
                 remat_layers=list(model.model.layers) if remat else None,
-                remat_policy="dots")
+                remat_policy=remat if isinstance(remat, str) else "dots")
             rng = np.random.default_rng(0)
             ids = paddle.to_tensor(rng.integers(
                 0, cfg.vocab_size, (batch, seq)).astype(np.int32))

@@ -24,6 +24,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from . import fused_pallas as _fp
 
@@ -32,10 +33,11 @@ def _adamw_kernel(p_ref, g_ref, m_ref, v_ref, sc_ref,
                   op_ref, om_ref, ov_ref):
     """One VMEM block of the flat group, viewed 2-D [rows, 1024] (Mosaic
     wants >=2-D refs with a 128-multiple lane dim; the 1-D original
-    crashed the TPU compiler, PROBE_r04 fused_adamw). sc_ref: [1, 8] f32
-    scalars (lr, beta1, beta2, eps, wd, bc1, bc2, decoupled)."""
-    lr, b1, b2, eps = sc_ref[0, 0], sc_ref[0, 1], sc_ref[0, 2], sc_ref[0, 3]
-    wd, bc1, bc2, dec = sc_ref[0, 4], sc_ref[0, 5], sc_ref[0, 6], sc_ref[0, 7]
+    crashed the TPU compiler, PROBE_r04 fused_adamw). sc_ref: [8] f32
+    scalars in SMEM (lr, beta1, beta2, eps, wd, bc1, bc2, decoupled) —
+    a VMEM scalar block would violate the (8,128) tile divisibility."""
+    lr, b1, b2, eps = sc_ref[0], sc_ref[1], sc_ref[2], sc_ref[3]
+    wd, bc1, bc2, dec = sc_ref[4], sc_ref[5], sc_ref[6], sc_ref[7]
     p = p_ref[...].astype(jnp.float32)
     g = g_ref[...].astype(jnp.float32)
     m = m_ref[...]
@@ -52,13 +54,18 @@ def _adamw_kernel(p_ref, g_ref, m_ref, v_ref, sc_ref,
     ov_ref[...] = v_new
 
 
-_LANES = 1024  # flat buffers are padded to this, so the 2-D view is exact
+_LANES = 1024
+# flat buffers are padded to _PAD elements = 64 rows of _LANES, so every
+# [block_rows, _LANES] tile is divisible by both the f32 (8,128) and bf16
+# (16,128) Mosaic tiles regardless of group size (the probe's divisibility
+# error came from padding only to _LANES: tiny groups made thin blocks)
+_PAD = _LANES * 64
 
 
 @functools.partial(jax.jit, static_argnames=("decoupled", "block_rows"))
 def _fused_adamw_flat(p, g, m, v, lr, beta1, beta2, eps, wd, step,
                       decoupled: bool, block_rows: int = 64):
-    """p/g: flat [n] (param dtype), n a multiple of _LANES; m/v: flat [n]
+    """p/g: flat [n] (param dtype), n a multiple of _PAD; m/v: flat [n]
     f32; scalars f32. The kernel streams [block_rows, _LANES] tiles."""
     n = p.shape[0]
     rows = n // _LANES
@@ -66,10 +73,10 @@ def _fused_adamw_flat(p, g, m, v, lr, beta1, beta2, eps, wd, step,
     bc1 = 1.0 - beta1 ** step
     bc2 = 1.0 - beta2 ** step
     sc = jnp.stack([lr, beta1, beta2, eps, wd, bc1, bc2,
-                    jnp.float32(1.0 if decoupled else 0.0)])[None]
+                    jnp.float32(1.0 if decoupled else 0.0)])
     grid = (rows // br,)
     blk = pl.BlockSpec((br, _LANES), lambda i: (i, 0))
-    sc_spec = pl.BlockSpec((1, 8), lambda i: (0, 0))
+    sc_spec = pl.BlockSpec(memory_space=pltpu.SMEM)
     view = lambda a: a.reshape(rows, _LANES)
     op, om, ov = pl.pallas_call(
         _adamw_kernel,
@@ -98,8 +105,8 @@ def fused_adamw_pallas(p, g, m, v, *, lr, beta1, beta2, eps, wd, step,
     shape = p.shape
     n = p.size
     out_p, out_m, out_v = _fused_adamw_flat(
-        _pad_to(p.reshape(-1), _LANES), _pad_to(g.reshape(-1), _LANES),
-        _pad_to(m.reshape(-1), _LANES), _pad_to(v.reshape(-1), _LANES),
+        _pad_to(p.reshape(-1), _PAD), _pad_to(g.reshape(-1), _PAD),
+        _pad_to(m.reshape(-1), _PAD), _pad_to(v.reshape(-1), _PAD),
         jnp.float32(lr), jnp.float32(beta1), jnp.float32(beta2),
         jnp.float32(eps), jnp.float32(wd), jnp.float32(step),
         bool(decoupled))
@@ -121,8 +128,8 @@ def _group_update(ps, gs, ms, vs, lr, beta1, beta2, eps, wd, step,
     flat_m = jnp.concatenate([m.reshape(-1) for m in ms])
     flat_v = jnp.concatenate([v.reshape(-1) for v in vs])
     np_, nm, nv = _fused_adamw_flat(
-        _pad_to(flat_p, _LANES), _pad_to(flat_g, _LANES),
-        _pad_to(flat_m, _LANES), _pad_to(flat_v, _LANES),
+        _pad_to(flat_p, _PAD), _pad_to(flat_g, _PAD),
+        _pad_to(flat_m, _PAD), _pad_to(flat_v, _PAD),
         lr, beta1, beta2, eps, wd, step, decoupled)
     out_p, out_m, out_v = [], [], []
     off = 0

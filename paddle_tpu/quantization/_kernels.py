@@ -109,6 +109,13 @@ def quant_matmul_arrays(x, q, s):
             raise ValueError(
                 f"quant_matmul: weight rows {q.shape[0]} match neither the "
                 f"contraction dim {k} (int8) nor its nibble-packed half")
-        q = unpack_int4_rows(q, k)
+        # two half-dots against the nibble halves: no interleaved unpack
+        # buffer ever materializes (the PROBE_r04 rerun showed the
+        # stack+reshape unpack costing ~3x on decode), and XLA fuses each
+        # shift pair into its dot's operand read
+        even = ((q << 4) >> 4).astype(x.dtype)          # rows 0,2,4,...
+        odd = (q >> 4)[: k // 2].astype(x.dtype)        # rows 1,3,5,...
+        y = x[..., 0::2] @ even + x[..., 1::2] @ odd
+        return (y.astype(jnp.float32) * s).astype(x.dtype)
     y = x @ q.astype(x.dtype)
     return (y.astype(jnp.float32) * s).astype(x.dtype)

@@ -1,0 +1,58 @@
+#!/usr/bin/env python
+"""MFU lab: run bench.py --attempt over the experiment rungs (LAB_TAGS +
+the ladder's proven config) on the live chip, one fresh subprocess each
+(OOM isolation, same rationale as bench._run_parent), and write the
+results table to MFU_LAB_<round>.json. Used to pick ATTEMPT_ORDER and the
+default remat policy from measured data instead of guesses."""
+import json
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
+
+import bench  # noqa: E402  (bench._sub is the one subprocess runner)
+
+
+def run_tag(tag, timeout=2700, env_extra=None):
+    t0 = time.time()
+    res, err = bench._sub(["--attempt", tag], timeout=timeout,
+                          env_extra=env_extra)
+    if res is None:
+        res = {"error": str(err)[-400:]}
+    res["wall_s"] = round(time.time() - t0, 1)
+    return res
+
+
+def main():
+    rnd = sys.argv[1] if len(sys.argv) > 1 else "r04"
+    tags = sys.argv[2:]
+    if not tags:
+        tags = ["llama-0.5b-b8", "llama-1.1b-b8", "llama-1.1b-b4",
+                *bench.LAB_TAGS]
+    out_path = os.path.join(HERE, f"MFU_LAB_{rnd}.json")
+    results = {}
+    if os.path.exists(out_path):
+        with open(out_path) as f:
+            results = json.load(f)
+    for tag in tags:
+        if tag in results and results[tag].get("value", 0) > 0:
+            print(f"[lab] {tag}: cached {results[tag]['value']}", flush=True)
+            continue
+        print(f"[lab] running {tag} ...", flush=True)
+        res = run_tag(tag)
+        results[tag] = res
+        with open(out_path, "w") as f:
+            json.dump(results, f, indent=1)
+        mfu = res.get("extra", {}).get("mfu")
+        print(f"[lab] {tag}: tps={res.get('value')} mfu={mfu} "
+              f"err={str(res.get('error') or res.get('extra', {}).get('error'))[:160]}",
+              flush=True)
+    print(json.dumps({t: {"tps": r.get("value"),
+                          "mfu": r.get("extra", {}).get("mfu")}
+                      for t, r in results.items()}, indent=1))
+
+
+if __name__ == "__main__":
+    main()
