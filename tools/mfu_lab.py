@@ -36,12 +36,33 @@ def main():
     if os.path.exists(out_path):
         with open(out_path) as f:
             results = json.load(f)
+    # seed from the ladder's own attempts so shared tags don't re-run, and
+    # adopt the ladder's probe-decided FLAGS_use_pallas_fused so lab rungs
+    # and seeded rungs measure the SAME configuration (a mixed table would
+    # attribute the flag's delta to the remat/batch/attention variable)
+    env_extra = None
+    sess = os.path.join(HERE, f"BENCH_SESSION_{rnd}.json")
+    if os.path.exists(sess):
+        try:
+            with open(sess) as f:
+                best = json.load(f)
+            if best.get("extra", {}).get("pallas_fused"):
+                env_extra = {"FLAGS_use_pallas_fused": "1"}
+            for t, a in best.get("extra", {}).get("attempts", {}).items():
+                if t not in results and a.get("tps"):
+                    results[t] = {"value": a["tps"],
+                                  "extra": {"mfu": a.get("mfu")},
+                                  "from": "bench_session"}
+        except (OSError, json.JSONDecodeError, AttributeError):
+            pass
     for tag in tags:
         if tag in results and results[tag].get("value", 0) > 0:
             print(f"[lab] {tag}: cached {results[tag]['value']}", flush=True)
             continue
         print(f"[lab] running {tag} ...", flush=True)
-        res = run_tag(tag)
+        res = run_tag(tag, env_extra=env_extra)
+        if env_extra:
+            res.setdefault("extra", {})["pallas_fused"] = True
         results[tag] = res
         with open(out_path, "w") as f:
             json.dump(results, f, indent=1)

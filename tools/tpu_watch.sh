@@ -37,7 +37,7 @@ EOF
         grep '^{' /tmp/bench_out.json | tail -1 > "BENCH_SESSION_$ROUND.json"
         echo "[watch] bench done $(date -u +%FT%TZ): $(cat BENCH_SESSION_$ROUND.json)" >> "$LOG"
         commit_retry "BENCH_SESSION_$ROUND.json" "PROBE_$ROUND.json" PROBE_LATEST.json
-        # success with a real number -> stop; else keep watching
+        # success with a real number -> run the MFU lab variants, then stop
         if BFILE="BENCH_SESSION_$ROUND.json" python - <<'EOF'
 import json,os,sys
 try:
@@ -46,6 +46,12 @@ except Exception:
     sys.exit(1)
 EOF
         then
+            echo "[watch] bench ok; running MFU lab variants..." >> "$LOG"
+            # worst case: 6 rungs x 2700s subprocess budget
+            timeout 17000 python tools/mfu_lab.py "$ROUND" >> "$LOG" 2>&1 \
+                || echo "[watch] WARNING: mfu_lab timed out or failed; " \
+                        "MFU_LAB_$ROUND.json may be partial" >> "$LOG"
+            commit_retry "MFU_LAB_$ROUND.json" || true
             echo "[watch] SUCCESS, exiting" >> "$LOG"
             exit 0
         fi
