@@ -1,11 +1,13 @@
 """paddle_tpu.linalg — parity with paddle.linalg namespace."""
 from .ops.linalg import (  # noqa: F401
-    cholesky, cholesky_solve, cond, corrcoef, cov, det, eig, eigh,
-    eigvals, eigvalsh, householder_product, inv, lstsq, lu, lu_unpack, matmul,
-    matrix_power, matrix_rank, multi_dot, norm, pinv, qr, slogdet, solve, svd,
-    svdvals, triangular_solve,
+    cholesky, cholesky_inverse, cholesky_solve, cond, corrcoef, cov, cross,
+    det, eig, eigh, eigvals, eigvalsh, householder_product, inv, lstsq, lu,
+    lu_unpack, matmul, matrix_exp, matrix_power, matrix_rank,
+    matrix_transpose, multi_dot, norm, ormqr, pca_lowrank, pinv, qr, slogdet,
+    solve, svd, svd_lowrank, svdvals, triangular_solve, vecdot,
 )
 from .ops.linalg import matrix_norm, vector_norm  # noqa: F401
+from .ops.special import diagonal  # noqa: F401
 # fp8 GEMM rides the quantization module's float8 kernels (reference:
 # python/paddle/linalg.py:30 exports it from tensor/linalg.py:358)
 from .quantization.fp8 import fp8_fp8_half_gemm_fused  # noqa: F401

@@ -302,16 +302,8 @@ def cond(x, p=None, name=None):
 
 def householder_product(x, tau, name=None):
     def fwd(a, t):
-        m, n = a.shape[-2], a.shape[-1]
-        q = jnp.eye(m, dtype=a.dtype)
-        q = jnp.broadcast_to(q, a.shape[:-2] + (m, m)).copy() if a.ndim > 2 else q
-        for i in range(t.shape[-1]):
-            v = jnp.where(jnp.arange(m) < i, 0.0, a[..., :, i])
-            v = v.at[..., i].set(1.0)
-            ti = t[..., i]
-            outer = v[..., :, None] * v[..., None, :]
-            q = q - ti[..., None, None] * (q @ outer)
-        return q[..., :, :n]
+        n = a.shape[-1]
+        return _householder_q(a, t)[..., :, :n]
     return dispatch("householder_product", fwd, ensure_tensor(x), ensure_tensor(tau))
 
 
@@ -370,3 +362,120 @@ def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
 
 
 register_op("lu_unpack", lu_unpack)
+
+
+def matrix_transpose(x, name=None):
+    """Parity: paddle.linalg.matrix_transpose (tensor/linalg.py:191) —
+    swap the last two dims."""
+    return dispatch("matrix_transpose", lambda a: jnp.swapaxes(a, -2, -1),
+                    ensure_tensor(x))
+
+
+def vecdot(x, y, axis=-1, name=None):
+    """Parity: paddle.linalg.vecdot (tensor/linalg.py:1880): conjugating
+    dot product along `axis` with broadcasting."""
+    def fwd(a, b):
+        a = jnp.conj(a) if jnp.iscomplexobj(a) else a
+        return jnp.sum(a * b, axis=axis)
+    return dispatch("vecdot", fwd, ensure_tensor(x), ensure_tensor(y))
+
+
+def cholesky_inverse(x, upper=False, name=None):
+    """Parity: paddle.linalg.cholesky_inverse (tensor/linalg.py:5779):
+    (U U^T)^-1 (lower factor, default) or (U^T U)^-1 (upper factor)."""
+    def fwd(u):
+        from jax.scipy.linalg import cho_solve
+        eye = jnp.eye(u.shape[-1], dtype=u.dtype)
+        # cho_solve solves (L L^T) z = b given lower L / (U^T U) given upper
+        return cho_solve((u, not upper), eye)
+    return dispatch("cholesky_inverse", fwd, ensure_tensor(x))
+
+
+def matrix_exp(x, name=None):
+    """Parity: paddle.linalg.matrix_exp (tensor/linalg.py:5299) — the
+    Pade-based expm (jax.scipy) with vmap over batch dims."""
+    def fwd(a):
+        from jax.scipy.linalg import expm
+        if a.ndim == 2:
+            return expm(a)
+        flat = a.reshape((-1,) + a.shape[-2:])
+        return jax.vmap(expm)(flat).reshape(a.shape)
+    return dispatch("matrix_exp", fwd, ensure_tensor(x))
+
+
+def _householder_q(a, t):
+    """Full m x m Q from geqrf-style reflectors (columns of a) and tau.
+    Each reflector lands as a rank-1 update (q@v then outer), O(m^2) per
+    reflector rather than the O(m^3) dense q@(v v^T) form."""
+    m = a.shape[-2]
+    q = jnp.eye(m, dtype=a.dtype)
+    if a.ndim > 2:
+        q = jnp.broadcast_to(q, a.shape[:-2] + (m, m))
+    for i in range(t.shape[-1]):
+        v = jnp.where(jnp.arange(m) < i, 0.0, a[..., :, i])
+        v = v.at[..., i].set(1.0)
+        ti = t[..., i]
+        qv = jnp.einsum("...ij,...j->...i", q, v)
+        q = q - ti[..., None, None] * (qv[..., :, None] * v[..., None, :])
+    return q
+
+
+def ormqr(x, tau, y, left=True, transpose=False, name=None):
+    """Parity: paddle.linalg.ormqr (tensor/linalg.py:5681): op(Q) @ y
+    (left) or y @ op(Q) (right), Q implied by Householder reflectors
+    (x, tau). Q is formed explicitly — at the q sizes this API is used
+    for, the matmul against dense Q is MXU-friendlier on TPU than a
+    sequential reflector application."""
+    def fwd(a, t, c):
+        q = _householder_q(a, t)
+        qm = jnp.swapaxes(q, -2, -1) if transpose else q
+        return qm @ c if left else c @ qm
+    return dispatch("ormqr", fwd, ensure_tensor(x), ensure_tensor(tau),
+                    ensure_tensor(y))
+
+
+def svd_lowrank(x, q=None, niter=2, M=None, name=None):
+    """Parity: paddle.linalg.svd_lowrank (tensor/linalg.py:3081):
+    randomized SVD (Halko-style range finder + subspace iteration).
+    Returns (U [..., N, q], S [..., q], V [..., M, q])."""
+    from ..framework.random import next_key
+    xt = ensure_tensor(x)
+    n, m = xt.shape[-2], xt.shape[-1]
+    q_ = min(6, n, m) if q is None else q
+    if not 0 < q_ <= min(n, m):
+        raise ValueError(
+            f"svd_lowrank: q={q_} must be in (0, min(N, M)={min(n, m)}]")
+    key = next_key()
+
+    def fwd(a, *mm):
+        if mm:
+            a = a - mm[0]
+        g = jax.random.normal(key, a.shape[:-2] + (m, q_), dtype=a.dtype)
+        at = jnp.swapaxes(a, -2, -1)
+        qb, _ = jnp.linalg.qr(a @ g)
+        for _ in range(niter):
+            z, _ = jnp.linalg.qr(at @ qb)
+            qb, _ = jnp.linalg.qr(a @ z)
+        b = jnp.swapaxes(qb, -2, -1) @ a            # [..., q, M]
+        u1, s, vh = jnp.linalg.svd(b, full_matrices=False)
+        return qb @ u1, s, jnp.swapaxes(vh, -2, -1)
+    args = (xt,) if M is None else (xt, ensure_tensor(M))
+    return dispatch("svd_lowrank", fwd, *args)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Parity: paddle.linalg.pca_lowrank (tensor/linalg.py:3201):
+    svd_lowrank of the (optionally column-centered) matrix."""
+    xt = ensure_tensor(x)
+    n, m = xt.shape[-2], xt.shape[-1]
+    q_ = min(6, n, m) if q is None else q
+    if not center:
+        return svd_lowrank(xt, q=q_, niter=niter)
+    mean = dispatch("pca_center", lambda a: jnp.mean(a, axis=-2,
+                                                     keepdims=True), xt)
+    return svd_lowrank(xt, q=q_, niter=niter, M=mean)
+
+
+for _n in ("matrix_transpose", "vecdot", "cholesky_inverse", "matrix_exp",
+           "ormqr", "svd_lowrank", "pca_lowrank"):
+    register_op(_n, globals()[_n])

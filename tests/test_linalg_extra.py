@@ -1,0 +1,116 @@
+"""Tail of the paddle.linalg namespace (reference python/paddle/linalg.py
+__all__): cholesky_inverse, matrix_exp, ormqr, svd_lowrank, pca_lowrank,
+vecdot, matrix_transpose — scipy/numpy oracles. The companion
+completeness test asserts the whole reference __all__ resolves."""
+import ast
+
+import numpy as np
+import pytest
+import scipy.linalg as sla
+
+import paddle_tpu as paddle
+import paddle_tpu.linalg as L
+
+REF_ALL = "/root/reference/python/paddle/linalg.py"
+
+
+def test_linalg_namespace_complete():
+    import os
+    if not os.path.exists(REF_ALL):
+        pytest.skip("reference tree not mounted")
+    tree = ast.parse(open(REF_ALL).read())
+    ref = None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if getattr(t, "id", None) == "__all__":
+                    ref = ast.literal_eval(node.value)
+    assert ref, "reference __all__ not found"
+    missing = [a for a in ref if not hasattr(L, a)]
+    assert not missing, f"paddle.linalg missing: {missing}"
+
+
+def test_cholesky_inverse_both_triangles():
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((5, 5)).astype(np.float32)
+    spd = a @ a.T + 5 * np.eye(5, dtype=np.float32)
+    lf = np.linalg.cholesky(spd)
+    want = np.linalg.inv(spd)
+    got = L.cholesky_inverse(paddle.to_tensor(lf)).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-3)
+    got_u = L.cholesky_inverse(paddle.to_tensor(lf.T.copy()),
+                               upper=True).numpy()
+    np.testing.assert_allclose(got_u, want, atol=1e-3)
+
+
+def test_matrix_exp_matches_scipy_incl_batch():
+    rng = np.random.default_rng(1)
+    m = rng.standard_normal((4, 4)).astype(np.float32) * 0.3
+    np.testing.assert_allclose(L.matrix_exp(paddle.to_tensor(m)).numpy(),
+                               sla.expm(m), atol=1e-4)
+    b = rng.standard_normal((2, 3, 3)).astype(np.float32) * 0.3
+    got = L.matrix_exp(paddle.to_tensor(b)).numpy()
+    for i in range(2):
+        np.testing.assert_allclose(got[i], sla.expm(b[i]), atol=1e-4)
+
+
+def test_vecdot_and_matrix_transpose():
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((2, 3)).astype(np.float32)
+    y = rng.standard_normal((2, 3)).astype(np.float32)
+    np.testing.assert_allclose(
+        L.vecdot(paddle.to_tensor(x), paddle.to_tensor(y)).numpy(),
+        (x * y).sum(-1), atol=1e-5)
+    t = rng.standard_normal((2, 3, 4)).astype(np.float32)
+    assert list(L.matrix_transpose(paddle.to_tensor(t)).shape) == [2, 4, 3]
+
+
+def _ormqr_oracle(geq, tau):
+    m = geq.shape[0]
+    q = np.eye(m)
+    for i in range(len(tau)):
+        v = np.zeros(m)
+        v[i] = 1.0
+        v[i + 1:] = geq[i + 1:, i]
+        q = q @ (np.eye(m) - tau[i] * np.outer(v, v))
+    return q
+
+
+@pytest.mark.parametrize("left,transpose", [(True, False), (False, False),
+                                            (True, True)])
+def test_ormqr_variants(left, transpose):
+    rng = np.random.default_rng(3)
+    a = rng.standard_normal((5, 3)).astype(np.float64)
+    (geq, tau), _ = sla.qr(a, mode="raw")
+    q = _ormqr_oracle(geq, tau)
+    opq = q.T if transpose else q
+    c = rng.standard_normal((5, 4) if left else (4, 5)).astype(np.float64)
+    want = opq @ c if left else c @ opq
+    got = L.ormqr(paddle.to_tensor(geq.astype(np.float32)),
+                  paddle.to_tensor(tau.astype(np.float32)),
+                  paddle.to_tensor(c.astype(np.float32)),
+                  left=left, transpose=transpose).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_svd_lowrank_reconstructs_lowrank_matrix():
+    paddle.seed(7)
+    rng = np.random.default_rng(4)
+    x = (rng.standard_normal((40, 3)) @ rng.standard_normal((3, 30))) \
+        .astype(np.float32)
+    u, s, v = L.svd_lowrank(paddle.to_tensor(x), q=5)
+    rec = u.numpy() @ np.diag(s.numpy()) @ v.numpy().T
+    np.testing.assert_allclose(rec, x, atol=1e-2)
+    with pytest.raises(ValueError):
+        L.svd_lowrank(paddle.to_tensor(x), q=99)
+
+
+def test_pca_lowrank_centers():
+    paddle.seed(8)
+    rng = np.random.default_rng(5)
+    x = (rng.standard_normal((30, 3)) @ rng.standard_normal((3, 20)) +
+         5.0).astype(np.float32)
+    u, s, v = L.pca_lowrank(paddle.to_tensor(x), q=3)
+    xc = x - x.mean(0, keepdims=True)
+    rec = u.numpy() @ np.diag(s.numpy()) @ v.numpy().T
+    np.testing.assert_allclose(rec, xc, atol=1e-2)

@@ -51,13 +51,22 @@ def main():
             for t, a in best.get("extra", {}).get("attempts", {}).items():
                 if t not in results and a.get("tps"):
                     results[t] = {"value": a["tps"],
-                                  "extra": {"mfu": a.get("mfu")},
+                                  "extra": {"mfu": a.get("mfu"),
+                                            "pallas_fused":
+                                            bool(env_extra)},
                                   "from": "bench_session"}
+            with open(out_path, "w") as f:
+                json.dump(results, f, indent=1)
         except (OSError, json.JSONDecodeError, AttributeError):
             pass
+    flag_now = bool(env_extra)
     for tag in tags:
-        if tag in results and results[tag].get("value", 0) > 0:
-            print(f"[lab] {tag}: cached {results[tag]['value']}", flush=True)
+        row = results.get(tag)
+        row_flag = bool(row and row.get("extra", {}).get("pallas_fused"))
+        if row and row.get("value", 0) > 0 and row_flag == flag_now:
+            # a cached row measured under a DIFFERENT pallas flag would
+            # silently mix configurations in the comparison table
+            print(f"[lab] {tag}: cached {row['value']}", flush=True)
             continue
         print(f"[lab] running {tag} ...", flush=True)
         res = run_tag(tag, env_extra=env_extra)
