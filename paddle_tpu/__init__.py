@@ -88,7 +88,7 @@ from .ops.special import (  # noqa: F401
     squared_l2_norm, swapaxes, swiglu, top_p_sampling, trace, vander, view,
 )
 from .ops.random_ops import (  # noqa: F401
-    bernoulli, bernoulli_, binomial, multinomial, normal, poisson, rand,
+    bernoulli, bernoulli_, binomial, multinomial, normal, normal_, poisson, rand,
     rand_like, randint, randint_like, randn, randn_like, randperm,
     standard_gamma, standard_normal, uniform, uniform_,
 )
@@ -130,3 +130,63 @@ from .framework.io import save, load  # noqa: F401
 from .nn.layer.layers import disable_static, enable_static, in_dynamic_mode  # noqa: F401
 
 DataParallel = distributed.DataParallel
+
+# -- top-level namespace tail (reference python/paddle/__init__.py __all__) ---
+from .ops.tail import (  # noqa: F401
+    CPUPlace, CUDAPinnedPlace, CUDAPlace, LazyGuard, XPUPlace, block_diag,
+    bitwise_invert, cartesian_prod, cauchy_, check_shape, column_stack,
+    combinations, create_parameter, cumulative_trapezoid, diagonal_scatter,
+    disable_signal_handler, dsplit, dtype, e, finfo, float8_e4m3fn,
+    float8_e5m2, from_dlpack, geometric_, get_cuda_rng_state,
+    histogram_bin_edges, hsplit, iinfo, inf, isin, isneginf, isposinf,
+    isreal, log_normal, log_normal_, nan, negative, newaxis, pdist,
+    pi, positive, pstring, raw, row_stack, select_scatter,
+    set_cuda_rng_state, set_printoptions, sinc, tensor_split, to_dlpack,
+    tolist, unflatten, unfold, vsplit,
+)
+from .ops.tail import bool  # noqa: F401, A004 - paddle.bool dtype
+from .ops.linalg import vecdot  # noqa: F401
+from .ops.special import diagonal  # noqa: F401
+from .nn.initializer import ParamAttr  # noqa: F401
+less = less_than  # noqa: F405  (reference alias)
+
+# generated in-place variants: every reference `op_` whose out-of-place base
+# exists becomes make_inplace(base) and a Tensor method (reference generates
+# these in eager codegen; the storage-rebinding semantic is identical)
+from .ops.dispatch import make_inplace as _mk  # noqa: E402
+
+
+def _gen_inplace():
+    names = (
+        "abs_", "acos_", "addmm_", "atan_", "bitwise_and_",
+        "bitwise_invert_", "bitwise_left_shift_", "bitwise_not_",
+        "bitwise_or_", "bitwise_right_shift_", "bitwise_xor_", "cast_",
+        "copysign_", "cos_", "cumprod_", "cumsum_", "digamma_", "equal_",
+        "erf_", "expm1_", "floor_divide_", "floor_mod_", "frac_",
+        "gammainc_", "gammaincc_", "gammaln_", "gcd_", "greater_equal_",
+        "greater_than_", "hypot_", "i0_", "lcm_", "ldexp_", "less_",
+        "less_equal_", "less_than_", "lgamma_", "log10_", "log2_", "log_",
+        "logical_and_", "logical_not_", "logical_or_", "logit_",
+        "masked_scatter_", "mod_", "multigammaln_", "nan_to_num_", "neg_",
+        "polygamma_", "pow_", "renorm_", "sin_", "sinc_", "sinh_",
+        "square_", "tan_", "transpose_", "t_", "flatten_", "tril_",
+        "triu_", "trunc_",
+    )
+    g = globals()
+    for n in names:
+        if n in g:
+            continue
+        base = g.get(n[:-1])
+        if base is None:
+            continue
+        fn = _mk(base, n)
+        g[n] = fn
+        if not hasattr(Tensor, n):
+            setattr(Tensor, n, fn)
+    for n in ("cauchy_", "geometric_", "normal_", "log_normal_"):
+        if not hasattr(Tensor, n):
+            setattr(Tensor, n, g[n])
+
+
+_gen_inplace()
+del _gen_inplace

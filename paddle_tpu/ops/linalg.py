@@ -364,13 +364,6 @@ def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
 register_op("lu_unpack", lu_unpack)
 
 
-def matrix_transpose(x, name=None):
-    """Parity: paddle.linalg.matrix_transpose (tensor/linalg.py:191) —
-    swap the last two dims."""
-    return dispatch("matrix_transpose", lambda a: jnp.swapaxes(a, -2, -1),
-                    ensure_tensor(x))
-
-
 def vecdot(x, y, axis=-1, name=None):
     """Parity: paddle.linalg.vecdot (tensor/linalg.py:1880): conjugating
     dot product along `axis` with broadcasting."""
@@ -476,6 +469,8 @@ def pca_lowrank(x, q=None, center=True, niter=2, name=None):
     return svd_lowrank(xt, q=q_, niter=niter, M=mean)
 
 
-for _n in ("matrix_transpose", "vecdot", "cholesky_inverse", "matrix_exp",
+from .manipulation import matrix_transpose  # noqa: E402  (one impl)
+
+for _n in ("vecdot", "cholesky_inverse", "matrix_exp",
            "ormqr", "svd_lowrank", "pca_lowrank"):
     register_op(_n, globals()[_n])

@@ -315,12 +315,6 @@ def vander(x, n=None, increasing=False, name=None):
         ensure_tensor(x))
 
 
-def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
-    """Already in nn.functional? kept here as the op-level alias."""
-    from ..nn.functional import unfold as f_unfold
-    return f_unfold(x, kernel_sizes, strides, paddings, dilations)
-
-
 def sgn(x, name=None):
     """Parity: paddle.sgn — sign for real, unit phasor for complex."""
     def fwd(a):
@@ -387,6 +381,6 @@ moveaxis_alias = None  # moveaxis already exists in manipulation
 
 from .dispatch import register_op as _reg  # noqa: E402
 for _n in ("sgn", "multigammaln", "cdist", "slice_scatter", "swapaxes",
-           "trace", "lerp", "renorm", "vander", "as_strided", "unfold"):
+           "trace", "lerp", "renorm", "vander", "as_strided"):
     _reg(_n, globals()[_n])
 del _reg

@@ -1,0 +1,560 @@
+"""Tail of the paddle.* top-level namespace (reference
+python/paddle/__init__.py __all__): the places/dtype-introspection
+surface, numpy-parity helpers, dlpack interop, and the few base ops the
+rest of the tree didn't need yet. The in-place `op_` family is generated
+from these bases in paddle_tpu/__init__.py via make_inplace."""
+from __future__ import annotations
+
+import math as _math
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor import Tensor
+from .dispatch import dispatch, ensure_tensor, register_op
+
+# -- constants (reference __init__.py:779-782) --------------------------------
+newaxis = None
+inf = _math.inf
+nan = _math.nan
+pi = _math.pi
+e = _math.e
+
+
+# -- places -------------------------------------------------------------------
+# jax owns placement; the Place classes are accepted for API compatibility
+# (reference phi/common/place.h) and report the actual backend.
+
+class _Place:
+    def __init__(self, device_id: int = 0):
+        self._id = int(device_id)
+
+    def get_device_id(self) -> int:
+        return self._id
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self._id == other._id
+
+    def __hash__(self):
+        return hash((type(self).__name__, self._id))
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._id})"
+
+
+class CPUPlace(_Place):
+    def __init__(self):
+        super().__init__(0)
+
+    def __repr__(self):
+        return "Place(cpu)"
+
+
+class CUDAPlace(_Place):
+    """Accepted for compatibility; on this framework device placement is
+    owned by jax/XLA (the TPU is the accelerator, not CUDA)."""
+
+
+class CUDAPinnedPlace(_Place):
+    pass
+
+
+class XPUPlace(_Place):
+    pass
+
+
+# -- dtype introspection ------------------------------------------------------
+bool = jnp.bool_            # noqa: A001 - mirrors paddle.bool
+dtype = np.dtype            # paddle.dtype(x) / isinstance checks
+float8_e4m3fn = jnp.float8_e4m3fn
+float8_e5m2 = jnp.float8_e5m2
+
+
+class pstring:  # noqa: N801 - reference string-tensor dtype marker
+    """Placeholder dtype object for string tensors (reference pir
+    StringTensor surface); no string-tensor kernels exist on this
+    backend — constructing tensors with it raises."""
+
+
+class raw:  # noqa: N801 - reference opaque dtype marker
+    """Placeholder for the reference's DataType.RAW (opaque byte blobs)."""
+
+
+class _FInfo:
+    def __init__(self, dt):
+        # np.finfo has no bfloat16/float8; ml_dtypes (bundled with jax)
+        # provides finfo for the ML dtypes
+        import ml_dtypes
+        try:
+            fi = np.finfo(np.dtype(dt))
+        except (TypeError, ValueError):
+            fi = ml_dtypes.finfo(dt)
+        self.dtype = str(np.dtype(dt).name) if hasattr(dt, "name") or \
+            isinstance(dt, (str, type(np.float32))) else str(dt)
+        self.bits = fi.bits
+        self.eps = float(fi.eps)
+        self.min = float(fi.min)
+        self.max = float(fi.max)
+        self.tiny = float(fi.tiny)
+        self.smallest_normal = float(fi.tiny)
+        self.resolution = float(fi.resolution)
+
+    def __repr__(self):
+        return (f"finfo(min={self.min}, max={self.max}, eps={self.eps}, "
+                f"bits={self.bits}, dtype={self.dtype})")
+
+
+class _IInfo:
+    def __init__(self, dt):
+        ii = np.iinfo(np.dtype(dt))
+        self.dtype = str(np.dtype(dt).name)
+        self.bits = ii.bits
+        self.min = int(ii.min)
+        self.max = int(ii.max)
+
+    def __repr__(self):
+        return (f"iinfo(min={self.min}, max={self.max}, bits={self.bits}, "
+                f"dtype={self.dtype})")
+
+
+def finfo(dt):
+    """Parity: paddle.finfo."""
+    from ..framework.dtype import convert_dtype
+    return _FInfo(convert_dtype(dt))
+
+
+def iinfo(dt):
+    """Parity: paddle.iinfo."""
+    from ..framework.dtype import convert_dtype
+    return _IInfo(convert_dtype(dt))
+
+
+# -- numpy-parity ops ---------------------------------------------------------
+
+def sinc(x, name=None):
+    """Parity: paddle.sinc — sin(pi x)/(pi x), 1 at 0."""
+    return dispatch("sinc", jnp.sinc, ensure_tensor(x))
+
+
+def bitwise_invert(x, out=None, name=None):
+    """Parity: paddle.bitwise_invert (alias of bitwise_not)."""
+    return dispatch("bitwise_invert", jnp.invert, ensure_tensor(x))
+
+
+def negative(x, name=None):
+    """Parity: paddle.negative."""
+    return dispatch("negative", jnp.negative, ensure_tensor(x))
+
+
+def positive(x, name=None):
+    """Parity: paddle.positive — identity on numeric tensors (the
+    reference rejects bool)."""
+    xt = ensure_tensor(x)
+    if np.dtype(xt._data.dtype) == np.bool_:
+        raise TypeError("positive does not support bool tensors")
+    return dispatch("positive", lambda a: +a, xt)
+
+
+def isneginf(x, name=None):
+    return dispatch("isneginf", jnp.isneginf, ensure_tensor(x))
+
+
+def isposinf(x, name=None):
+    return dispatch("isposinf", jnp.isposinf, ensure_tensor(x))
+
+
+def isreal(x, name=None):
+    return dispatch("isreal", jnp.isreal, ensure_tensor(x))
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    """Parity: paddle.isin."""
+    return dispatch(
+        "isin",
+        lambda a, b: jnp.isin(a, b, assume_unique=assume_unique,
+                              invert=invert),
+        ensure_tensor(x), ensure_tensor(test_x))
+
+
+def block_diag(inputs, name=None):
+    """Parity: paddle.block_diag."""
+    from jax.scipy.linalg import block_diag as bd
+    ts = [ensure_tensor(t) for t in inputs]
+    return dispatch("block_diag", lambda *a: bd(*a), *ts)
+
+
+def cartesian_prod(x, name=None):
+    """Parity: paddle.cartesian_prod — cartesian product of 1-D tensors."""
+    ts = [ensure_tensor(t) for t in x]
+
+    def fwd(*arrs):
+        grids = jnp.meshgrid(*arrs, indexing="ij")
+        return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+    return dispatch("cartesian_prod", fwd, *ts)
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    """Parity: paddle.combinations — r-combinations of a 1-D tensor (host
+    index plan, device gather; the index set is data-independent)."""
+    import itertools
+    xt = ensure_tensor(x)
+    n = xt.shape[0]
+    it = (itertools.combinations_with_replacement(range(n), r)
+          if with_replacement else itertools.combinations(range(n), r))
+    idx = np.asarray(list(it), dtype=np.int32).reshape(-1, r)
+    return dispatch("combinations", lambda a: a[jnp.asarray(idx)], xt)
+
+
+def column_stack(x, name=None):
+    ts = [ensure_tensor(t) for t in x]
+    return dispatch("column_stack", lambda *a: jnp.column_stack(a), *ts)
+
+
+def row_stack(x, name=None):
+    ts = [ensure_tensor(t) for t in x]
+    return dispatch("row_stack", lambda *a: jnp.vstack(a), *ts)
+
+
+def _split_sections(arg):
+    return arg if isinstance(arg, int) else [int(s) for s in arg]
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    """Parity: paddle.tensor_split (uneven splits allowed)."""
+    xt = ensure_tensor(x)
+    spec = _split_sections(num_or_indices)
+    return dispatch(
+        "tensor_split",
+        lambda a: tuple(jnp.array_split(a, spec, axis=axis))
+        if isinstance(spec, int)
+        else tuple(jnp.split(a, spec, axis=axis)), xt)
+
+
+def hsplit(x, num_or_indices, name=None):
+    xt = ensure_tensor(x)
+    if xt.ndim < 1:
+        raise ValueError("hsplit expects at least a 1-D tensor")
+    return tensor_split(xt, num_or_indices, axis=0 if xt.ndim == 1 else 1)
+
+
+def vsplit(x, num_or_indices, name=None):
+    xt = ensure_tensor(x)
+    if xt.ndim < 2:
+        raise ValueError("vsplit expects at least a 2-D tensor")
+    return tensor_split(xt, num_or_indices, axis=0)
+
+
+def dsplit(x, num_or_indices, name=None):
+    xt = ensure_tensor(x)
+    if xt.ndim < 3:
+        raise ValueError("dsplit expects at least a 3-D tensor")
+    return tensor_split(xt, num_or_indices, axis=2)
+
+
+def histogram_bin_edges(input, bins=100, min=0, max=0, name=None):
+    """Parity: paddle.histogram_bin_edges."""
+    xt = ensure_tensor(input)
+    rng = None if (min == 0 and max == 0) else (float(min), float(max))
+    return dispatch(
+        "histogram_bin_edges",
+        lambda a: jnp.histogram_bin_edges(a, bins=bins, range=rng), xt)
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    """Parity: paddle.cumulative_trapezoid."""
+    yt = ensure_tensor(y)
+
+    def fwd(ya, *maybe_x):
+        y1 = jax.lax.slice_in_dim(ya, 1, ya.shape[axis], axis=axis)
+        y0 = jax.lax.slice_in_dim(ya, 0, ya.shape[axis] - 1, axis=axis)
+        if maybe_x:
+            xa = maybe_x[0]
+            x1 = jax.lax.slice_in_dim(xa, 1, xa.shape[axis], axis=axis)
+            x0 = jax.lax.slice_in_dim(xa, 0, xa.shape[axis] - 1, axis=axis)
+            d = x1 - x0
+        else:
+            d = dx if dx is not None else 1.0
+        return jnp.cumsum((y0 + y1) * d / 2.0, axis=axis)
+    if x is not None:
+        return dispatch("cumulative_trapezoid", fwd, yt, ensure_tensor(x))
+    return dispatch("cumulative_trapezoid", fwd, yt)
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    """Parity: paddle.diagonal_scatter — write y onto x's diagonal."""
+    def fwd(a, b):
+        ndim = a.ndim
+        ax1, ax2 = axis1 % ndim, axis2 % ndim
+        n1, n2 = a.shape[ax1], a.shape[ax2]
+        if offset >= 0:
+            dlen = min(n1, n2 - offset)
+            i1 = jnp.arange(dlen)
+            i2 = i1 + offset
+        else:
+            dlen = min(n1 + offset, n2)
+            i2 = jnp.arange(dlen)
+            i1 = i2 - offset
+        # move the two axes to the front, scatter rows, move back
+        a_m = jnp.moveaxis(a, (ax1, ax2), (0, 1))
+        b_m = jnp.moveaxis(b, -1, 0) if b.ndim > 1 else b
+        a_m = a_m.at[i1, i2].set(b_m)
+        return jnp.moveaxis(a_m, (0, 1), (ax1, ax2))
+    return dispatch("diagonal_scatter", fwd, ensure_tensor(x),
+                    ensure_tensor(y))
+
+
+def select_scatter(x, values, axis, index, name=None):
+    """Parity: paddle.select_scatter — write `values` into x[..., index,
+    ...] along axis."""
+    def fwd(a, v):
+        idx = [slice(None)] * a.ndim
+        idx[axis % a.ndim] = index
+        return a.at[tuple(idx)].set(v)
+    return dispatch("select_scatter", fwd, ensure_tensor(x),
+                    ensure_tensor(values))
+
+
+def pdist(x, p=2.0, name=None):
+    """Parity: paddle.pdist — condensed pairwise distances of an [N, D]
+    matrix (upper-triangle order)."""
+    xt = ensure_tensor(x)
+    n = xt.shape[0]
+    iu = np.triu_indices(n, k=1)
+
+    def fwd(a):
+        d = jnp.linalg.norm(a[iu[0]] - a[iu[1]], ord=p, axis=-1)
+        return d
+    return dispatch("pdist", fwd, xt)
+
+
+def unflatten(x, axis, shape, name=None):
+    """Parity: paddle.unflatten — expand one axis into `shape`."""
+    xt = ensure_tensor(x)
+
+    def fwd(a):
+        ax = axis % a.ndim
+        new = list(a.shape[:ax]) + [int(s) for s in shape] \
+            + list(a.shape[ax + 1:])
+        return a.reshape(new)
+    return dispatch("unflatten", fwd, xt)
+
+
+def unfold(x, axis, size, step, name=None):
+    """Parity: paddle.unfold (Tensor.unfold) — sliding windows of `size`
+    every `step` along `axis`, window dim appended last."""
+    xt = ensure_tensor(x)
+
+    def fwd(a):
+        ax = axis % a.ndim
+        n = a.shape[ax]
+        starts = range(0, n - size + 1, step)
+        wins = [jnp.moveaxis(
+            jax.lax.slice_in_dim(a, s, s + size, axis=ax), ax, -1)
+            for s in starts]
+        return jnp.stack(wins, axis=ax)
+    return dispatch("unfold", fwd, xt)
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, name=None):
+    """Parity: paddle.log_normal (tensor/random.py:346) — samples whose
+    log is N(mean, std)."""
+    from ..framework.random import next_key
+    from ..framework.dtype import get_default_dtype
+    key = next_key()
+    shp = tuple(shape) if shape is not None else ()
+    z = jax.random.normal(key, shp, dtype=np.dtype(get_default_dtype()))
+    return Tensor(jnp.exp(z * std + mean))
+
+
+def check_shape(shape, op_name="", expected_shape_type=(list, tuple),
+                expected_element_type=(int,),
+                expected_tensor_dtype=("int32", "int64")):
+    """Parity: paddle.check_shape (base/data_feeder.py:230) — validate a
+    shape argument (type + element types)."""
+    if isinstance(shape, Tensor):
+        if str(np.dtype(shape._data.dtype)) not in expected_tensor_dtype:
+            raise TypeError(
+                f"{op_name}: a shape tensor must be {expected_tensor_dtype},"
+                f" got {shape._data.dtype}")
+        return
+    if not isinstance(shape, expected_shape_type):
+        raise TypeError(f"{op_name}: shape must be one of "
+                        f"{expected_shape_type}, got {type(shape).__name__}")
+    for item in shape:
+        if not isinstance(item, (*expected_element_type, Tensor,
+                                 np.integer)):
+            raise TypeError(f"{op_name}: shape element {item!r} has "
+                            f"unsupported type {type(item).__name__}")
+
+
+def tolist(x):
+    """Parity: paddle.tolist."""
+    return np.asarray(ensure_tensor(x)._data).tolist()
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Parity: paddle.set_printoptions — Tensor repr prints through
+    numpy, so this maps onto numpy's printoptions."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+# -- dlpack interop (reference paddle.utils.dlpack, exported top-level) -------
+
+def to_dlpack(x):
+    """Parity: paddle.to_dlpack — export for dlpack consumers. Returns
+    the device array itself, which implements the modern
+    `__dlpack__`/`__dlpack_device__` protocol that torch/numpy/cupy
+    `from_dlpack` accept (the legacy bare-capsule form cannot carry the
+    device query the protocol requires)."""
+    return ensure_tensor(x)._data
+
+
+class _CapsuleHolder:
+    """Adapter for legacy bare PyCapsule producers: jax's from_dlpack
+    requires the protocol object form; a bare capsule carries no device
+    info, so it is presented as a CPU export (the only producer kind
+    that hands out bare capsules in this environment is host-side)."""
+
+    def __init__(self, capsule):
+        self._capsule = capsule
+
+    def __dlpack__(self, **kw):
+        return self._capsule
+
+    def __dlpack_device__(self):
+        return (1, 0)  # kDLCPU
+
+
+def from_dlpack(ext):
+    """Parity: paddle.from_dlpack — accepts protocol objects (torch
+    tensors, numpy arrays, jax arrays) or legacy capsules."""
+    if not hasattr(ext, "__dlpack__"):
+        ext = _CapsuleHolder(ext)
+    return Tensor(jnp.from_dlpack(ext))
+
+
+# -- CUDA rng-state aliases ---------------------------------------------------
+
+def get_cuda_rng_state():
+    """Parity alias: device RNG state == the framework RNG state here
+    (one jax PRNG key chain regardless of backend)."""
+    from ..framework.random import get_rng_state
+    return get_rng_state()
+
+
+def set_cuda_rng_state(state):
+    from ..framework.random import set_rng_state
+    return set_rng_state(state)
+
+
+def disable_signal_handler():
+    """Parity: paddle.disable_signal_handler — this framework installs no
+    C-level signal handlers, so there is nothing to disable; kept for
+    API compatibility."""
+
+
+class LazyGuard:
+    """Parity: paddle.LazyGuard (reference lazy-initializes parameters on
+    GPU to skip the host->device copy of initial values). jax initializes
+    parameters as host buffers that XLA transfers on first use, so the
+    eager path already has the lazy property this guard exists for; the
+    context is accepted and warns once."""
+    _warned = [False]
+
+    def __enter__(self):
+        if not self._warned[0]:
+            self._warned[0] = True
+            warnings.warn(
+                "LazyGuard is accepted for compatibility: parameter "
+                "initial values are host buffers transferred on first "
+                "device use, which is what lazy init exists to achieve")
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """Parity: paddle.create_parameter (tensor/creation.py) — a free
+    Parameter outside any Layer. Default init mirrors the reference:
+    Xavier-style for weights, zeros for bias."""
+    from ..framework.dtype import convert_dtype
+    from ..nn.initializer import Constant, ParamAttr, XavierNormal
+    from ..tensor import Parameter
+    shp = tuple(int(s) for s in shape)
+    dt = np.dtype(convert_dtype(dtype))
+    init = default_initializer
+    pname = name
+    if isinstance(attr, ParamAttr):
+        if attr.initializer is not None:
+            init = attr.initializer
+        if attr.name:
+            pname = attr.name
+    if init is None:
+        init = Constant(0.0) if is_bias else XavierNormal()
+    p = Parameter(jnp.asarray(init(shp, dt), dt))
+    if pname:
+        p.name = pname
+    return p
+
+
+for _n in ("sinc", "bitwise_invert", "negative", "positive", "isneginf",
+           "isposinf", "isreal", "isin", "tensor_split", "hsplit", "vsplit",
+           "dsplit", "histogram_bin_edges", "cumulative_trapezoid",
+           "diagonal_scatter", "select_scatter", "unflatten", "unfold",
+           "tolist"):
+    register_op(_n, globals()[_n])
+    # this module loads after ops.__init__ ran attach_methods(), so bind
+    # the Tensor methods directly (forced: `unfold` must rebind from the
+    # im2col form to the reference Tensor.unfold sliding-window form)
+    setattr(Tensor, _n, globals()[_n])
+
+
+# -- in-place random fills (reference Tensor.cauchy_/geometric_/normal_/
+# log_normal_: re-draw the tensor's values in place) --------------------------
+
+def _fill_inplace(x, vals):
+    xt = ensure_tensor(x)
+    return xt._assign_from(Tensor(vals.astype(xt._data.dtype)))
+
+
+def cauchy_(x, loc=0, scale=1, name=None):
+    """Parity: Tensor.cauchy_ — fill with Cauchy(loc, scale) draws."""
+    from ..framework.random import next_key
+    xt = ensure_tensor(x)
+    u = jax.random.uniform(next_key(), xt._data.shape, jnp.float32,
+                           1e-7, 1.0 - 1e-7)
+    return _fill_inplace(xt, loc + scale * jnp.tan(jnp.pi * (u - 0.5)))
+
+
+def geometric_(x, probs, name=None):
+    """Parity: Tensor.geometric_ — fill with Geometric(probs) draws."""
+    from ..framework.random import next_key
+    xt = ensure_tensor(x)
+    u = jax.random.uniform(next_key(), xt._data.shape, jnp.float32,
+                           1e-7, 1.0 - 1e-7)
+    return _fill_inplace(
+        xt, jnp.ceil(jnp.log(u) / jnp.log1p(-jnp.float32(probs))))
+
+
+def log_normal_(x, mean=1.0, std=2.0, name=None):
+    """Parity: Tensor.log_normal_ — fill with LogNormal(mean, std)."""
+    from ..framework.random import next_key
+    xt = ensure_tensor(x)
+    z = jax.random.normal(next_key(), xt._data.shape, jnp.float32)
+    return _fill_inplace(xt, jnp.exp(z * std + mean))

@@ -1,6 +1,6 @@
 #!/usr/bin/env python
 """MFU lab: run bench.py --attempt over the experiment rungs (LAB_TAGS +
-the ladder's proven config) on the live chip, one fresh subprocess each
+the ladder's proven configs) on the live chip, one fresh subprocess each
 (OOM isolation, same rationale as bench._run_parent), and write the
 results table to MFU_LAB_<round>.json. Used to pick ATTEMPT_ORDER and the
 default remat policy from measured data instead of guesses."""
@@ -25,6 +25,13 @@ def run_tag(tag, timeout=2700, env_extra=None):
     return res
 
 
+def _save(out_path, results):
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(results, f, indent=1)
+    os.replace(tmp, out_path)  # atomic: a killed run can't truncate
+
+
 def main():
     rnd = sys.argv[1] if len(sys.argv) > 1 else "r04"
     tags = sys.argv[2:]
@@ -34,8 +41,12 @@ def main():
     out_path = os.path.join(HERE, f"MFU_LAB_{rnd}.json")
     results = {}
     if os.path.exists(out_path):
-        with open(out_path) as f:
-            results = json.load(f)
+        try:
+            with open(out_path) as f:
+                results = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            results = {}
+
     # seed from the ladder's own attempts so shared tags don't re-run, and
     # adopt the ladder's probe-decided FLAGS_use_pallas_fused so lab rungs
     # and seeded rungs measure the SAME configuration (a mixed table would
@@ -55,10 +66,10 @@ def main():
                                             "pallas_fused":
                                             bool(env_extra)},
                                   "from": "bench_session"}
-            with open(out_path, "w") as f:
-                json.dump(results, f, indent=1)
+            _save(out_path, results)
         except (OSError, json.JSONDecodeError, AttributeError):
             pass
+
     flag_now = bool(env_extra)
     for tag in tags:
         row = results.get(tag)
@@ -73,12 +84,11 @@ def main():
         if env_extra:
             res.setdefault("extra", {})["pallas_fused"] = True
         results[tag] = res
-        with open(out_path, "w") as f:
-            json.dump(results, f, indent=1)
+        _save(out_path, results)
         mfu = res.get("extra", {}).get("mfu")
+        err = str(res.get("error") or res.get("extra", {}).get("error"))
         print(f"[lab] {tag}: tps={res.get('value')} mfu={mfu} "
-              f"err={str(res.get('error') or res.get('extra', {}).get('error'))[:160]}",
-              flush=True)
+              f"err={err[:160]}", flush=True)
     print(json.dumps({t: {"tps": r.get("value"),
                           "mfu": r.get("extra", {}).get("mfu")}
                       for t, r in results.items()}, indent=1))
