@@ -2,7 +2,7 @@
 from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
 from .layer.layers import (  # noqa: F401
-    Layer, LayerDict, LayerList, ParameterList, Sequential,
+    Layer, LayerDict, LayerList, ParameterDict, ParameterList, Sequential,
 )
 from .layer.activation import (  # noqa: F401
     CELU, ELU, GELU, GLU, Hardshrink, Hardsigmoid, Hardswish, Hardtanh,
@@ -14,17 +14,22 @@ from .layer.common import (  # noqa: F401
     Bilinear,
     AlphaDropout, ChannelShuffle, CosineSimilarity, Dropout, Dropout2D,
     Dropout3D, Embedding, Flatten, Identity, Linear, Pad1D, Pad2D, Pad3D,
-    PixelShuffle, PixelUnshuffle, Unflatten, Upsample, UpsamplingBilinear2D,
-    UpsamplingNearest2D, ZeroPad2D,
+    FeatureAlphaDropout, Fold, PairwiseDistance,
+    PixelShuffle, PixelUnshuffle, Softmax2D, SpectralNorm, Unflatten, Unfold,
+    Upsample, UpsamplingBilinear2D,
+    UpsamplingNearest2D, ZeroPad1D, ZeroPad2D, ZeroPad3D,
 )
 from .layer.conv import (  # noqa: F401
     Conv1D, Conv1DTranspose, Conv2D, Conv2DTranspose, Conv3D, Conv3DTranspose,
 )
 from .layer.loss import (  # noqa: F401
-    BCELoss, BCEWithLogitsLoss, CosineEmbeddingLoss, CrossEntropyLoss,
-    CTCLoss, HSigmoidLoss, RNNTLoss,
+    AdaptiveLogSoftmaxWithLoss, BCELoss, BCEWithLogitsLoss,
+    CosineEmbeddingLoss, CrossEntropyLoss,
+    CTCLoss, GaussianNLLLoss, HSigmoidLoss, MultiLabelSoftMarginLoss,
+    MultiMarginLoss, PoissonNLLLoss, RNNTLoss, SoftMarginLoss,
     HingeEmbeddingLoss, HuberLoss, KLDivLoss, L1Loss, MSELoss,
     MarginRankingLoss, NLLLoss, SmoothL1Loss, TripletMarginLoss,
+    TripletMarginWithDistanceLoss,
 )
 from .layer.norm import (  # noqa: F401
     BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, GroupNorm,
@@ -35,10 +40,16 @@ from .layer.pooling import (  # noqa: F401
     AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D, AdaptiveMaxPool1D,
     AdaptiveMaxPool2D, AdaptiveMaxPool3D, AvgPool1D, AvgPool2D, AvgPool3D,
     FractionalMaxPool2D, FractionalMaxPool3D, MaxPool1D, MaxPool2D, MaxPool3D,
+    LPPool1D, LPPool2D,
     MaxUnPool1D, MaxUnPool2D, MaxUnPool3D,
 )
 from .layer.rnn import (  # noqa: F401
     BiRNN, GRU, GRUCell, LSTM, LSTMCell, RNN, SimpleRNN, SimpleRNNCell,
+)
+from .layer.rnn import _CellBase as RNNCellBase  # noqa: F401
+from .decode import BeamSearchDecoder, dynamic_decode  # noqa: F401
+from ..optimizer import (  # noqa: F401
+    ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue,
 )
 from .layer.transformer import (  # noqa: F401
     MultiHeadAttention, Transformer, TransformerDecoder,

@@ -1,0 +1,152 @@
+"""Seq2seq decoding: BeamSearchDecoder + dynamic_decode.
+
+Parity: paddle.nn.BeamSearchDecoder / paddle.nn.dynamic_decode
+(python/paddle/nn/decode.py) — the RNN-cell seq2seq search API (the
+transformer serving path uses paddle_tpu.generation's compiled beam
+search instead; this surface exists for RNN-family models and API
+parity). Eager implementation: the step loop is host-driven like the
+reference's dygraph path, each step's math is jax ops."""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.dispatch import ensure_tensor
+from ..tensor import Tensor
+
+
+def _tile_beam(x, beam_size):
+    """[batch, ...] -> [batch * beam, ...] (repeat each row beam times)."""
+    a = ensure_tensor(x)._data
+    return Tensor(jnp.repeat(a, beam_size, axis=0))
+
+
+class BeamSearchDecoder:
+    """Beam search over an RNN cell.
+
+    cell: an RNNCellBase-style object: call(inputs, states) ->
+    (outputs, new_states). `embedding_fn` maps token ids -> embeddings;
+    `output_fn` maps cell outputs -> vocab logits (both default to
+    identity, matching the reference)."""
+
+    def __init__(self, cell, start_token: int, end_token: int,
+                 beam_size: int, embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """Parity: BeamSearchDecoder.tile_beam_merge_with_batch — expand
+        encoder outputs to the merged batch*beam layout."""
+        return _tile_beam(x, beam_size)
+
+    def initialize(self, initial_cell_states):
+        states = jax.tree_util.tree_map(
+            lambda t: _tile_beam(t, self.beam_size), initial_cell_states)
+        # infer batch from any state leaf
+        leaves = jax.tree_util.tree_leaves(states)
+        merged = leaves[0]._data.shape[0] if leaves else self.beam_size
+        batch = merged // self.beam_size
+        ids = jnp.full((batch * self.beam_size,), self.start_token,
+                       jnp.int32)
+        # only beam 0 is live initially (identical beams would collapse)
+        lp = jnp.where(jnp.arange(batch * self.beam_size)
+                       % self.beam_size == 0, 0.0, -1e9)
+        finished = jnp.zeros((batch * self.beam_size,), bool)
+        return Tensor(ids), (states, Tensor(lp), Tensor(finished))
+
+    def step(self, time, inputs, states):
+        cell_states, log_probs, finished = states
+        ids = ensure_tensor(inputs)
+        emb = self.embedding_fn(ids) if self.embedding_fn else ids
+        cell_out, next_cell_states = self.cell(emb, cell_states)
+        logits = self.output_fn(cell_out) if self.output_fn else cell_out
+        la = ensure_tensor(logits)._data.astype(jnp.float32)
+        merged, vocab = la.shape
+        batch = merged // self.beam_size
+        step_lp = jax.nn.log_softmax(la, axis=-1)
+        fin = ensure_tensor(finished)._data
+        # finished beams emit only end_token with probability 1
+        frozen = jnp.full((vocab,), -1e9).at[self.end_token].set(0.0)
+        step_lp = jnp.where(fin[:, None], frozen[None, :], step_lp)
+        total = ensure_tensor(log_probs)._data[:, None] + step_lp
+        flat = total.reshape(batch, self.beam_size * vocab)
+        top_lp, top_idx = jax.lax.top_k(flat, self.beam_size)
+        beam_idx = top_idx // vocab                   # [batch, beam]
+        tok = (top_idx % vocab).astype(jnp.int32)
+        src = (jnp.arange(batch)[:, None] * self.beam_size
+               + beam_idx).reshape(-1)
+
+        def regather(t):
+            return Tensor(ensure_tensor(t)._data[src])
+        next_cell_states = jax.tree_util.tree_map(regather,
+                                                  next_cell_states)
+        new_fin = fin[src] | (tok.reshape(-1) == self.end_token)
+        next_ids = Tensor(tok.reshape(-1))
+        next_states = (next_cell_states, Tensor(top_lp.reshape(-1)),
+                       Tensor(new_fin))
+        outputs = (next_ids, Tensor(src.astype(jnp.int32)))
+        return outputs, next_states, next_ids, Tensor(new_fin)
+
+    def finalize(self, step_outputs, final_states, batch):
+        """Backtrack the beam ancestry into token sequences
+        [batch, beam, T] best-first."""
+        toks = [ensure_tensor(t)._data for t, _ in step_outputs]
+        parents = [ensure_tensor(p)._data for _, p in step_outputs]
+        T = len(toks)
+        merged = toks[0].shape[0]
+        seqs = np.zeros((merged, T), np.int32)
+        cur = np.arange(merged)
+        for t in range(T - 1, -1, -1):
+            seqs[:, t] = np.asarray(toks[t])[cur]
+            cur = np.asarray(parents[t])[cur]
+        return Tensor(jnp.asarray(
+            seqs.reshape(batch, self.beam_size, T)))
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """Parity: paddle.nn.dynamic_decode — drive a decoder until every
+    sequence finishes or max_step_num. Returns (outputs, final_states)
+    (+ sequence_lengths when return_length)."""
+    max_steps = int(max_step_num) if max_step_num is not None else 256
+    inputs, states = decoder.initialize(inits)
+    step_outputs = []
+    lengths = None
+    for t in range(max_steps):
+        outputs, states, inputs, finished = decoder.step(t, inputs, states)
+        step_outputs.append(outputs)
+        fin = np.asarray(ensure_tensor(finished)._data)
+        if lengths is None:
+            lengths = np.full(fin.shape, max_steps, np.int32)
+        newly = (fin & (lengths == max_steps))
+        lengths[newly] = t + 1
+        if bool(fin.all()):
+            break
+    merged = np.asarray(
+        ensure_tensor(step_outputs[0][0])._data).shape[0]
+    if isinstance(decoder, BeamSearchDecoder):
+        batch = merged // decoder.beam_size
+        seqs = decoder.finalize(step_outputs, states, batch)
+        lengths_t = Tensor(jnp.asarray(
+            lengths.reshape(batch, decoder.beam_size)))
+    else:
+        seqs = Tensor(jnp.stack(
+            [ensure_tensor(o)._data for o, *_ in step_outputs], axis=1))
+        lengths_t = Tensor(jnp.asarray(lengths))
+    if output_time_major:
+        seqs = Tensor(jnp.moveaxis(seqs._data, -1, 0))
+    if return_length:
+        return seqs, states, lengths_t
+    return seqs, states
+
+
+__all__ = ["BeamSearchDecoder", "dynamic_decode"]

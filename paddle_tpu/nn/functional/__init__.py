@@ -6,7 +6,8 @@ from .activation import (  # noqa: F401
     softshrink, softsign, swish, tanh, tanhshrink, thresholded_relu,
 )
 from .attention import (  # noqa: F401
-    flash_attention, flash_attn_unpadded, flashmask_attention,
+    flash_attention, flash_attn_qkvpacked, flash_attn_unpadded,
+    flash_attn_varlen_qkvpacked, flashmask_attention,
     scaled_dot_product_attention, sdp_kernel,
 )
 from .vision import (  # noqa: F401
@@ -16,7 +17,8 @@ from .common import (  # noqa: F401
     bilinear,
     alpha_dropout, channel_shuffle, class_center_sample, cosine_similarity,
     dropout, dropout2d,
-    dropout3d, embedding, fold, interpolate, label_smooth, linear, one_hot, pad,
+    dropout3d, embedding, feature_alpha_dropout, fold, interpolate,
+    label_smooth, linear, one_hot, pad,
     pixel_shuffle, pixel_unshuffle, sparse_attention, unfold, upsample,
     zeropad2d,
 )
@@ -24,12 +26,17 @@ from .conv import (  # noqa: F401
     conv1d, conv1d_transpose, conv2d, conv2d_transpose, conv3d, conv3d_transpose,
 )
 from .loss import (  # noqa: F401
-    binary_cross_entropy, binary_cross_entropy_with_logits,
-    cosine_embedding_loss, cross_entropy, ctc_loss, hinge_embedding_loss,
-    hsigmoid_loss, margin_cross_entropy, rnnt_loss,
+    adaptive_log_softmax_with_loss, binary_cross_entropy,
+    binary_cross_entropy_with_logits,
+    cosine_embedding_loss, cross_entropy, ctc_loss, dice_loss,
+    gaussian_nll_loss, hinge_embedding_loss,
+    hsigmoid_loss, margin_cross_entropy, multi_label_soft_margin_loss,
+    multi_margin_loss, npair_loss, pairwise_distance, poisson_nll_loss,
+    rnnt_loss, soft_margin_loss,
     huber_loss, kl_div, l1_loss, log_loss, margin_ranking_loss, mse_loss,
     nll_loss, sigmoid_focal_loss, smooth_l1_loss, softmax_with_cross_entropy,
     square_error_cost, triplet_margin_loss,
+    triplet_margin_with_distance_loss,
 )
 from .norm import (  # noqa: F401
     batch_norm, group_norm, instance_norm, layer_norm, local_response_norm,
@@ -42,3 +49,14 @@ from .pooling import (  # noqa: F401
     lp_pool1d, lp_pool2d, max_pool1d, max_pool2d, max_pool3d, max_unpool1d,
     max_unpool2d, max_unpool3d,
 )
+
+# op-level re-exports the reference surfaces here too
+from ...ops.special import gather_tree, sequence_mask  # noqa: F401, E402
+
+# in-place activation variants (reference generates these in eager codegen)
+from ...ops.dispatch import make_inplace as _mk  # noqa: E402
+elu_ = _mk(elu, "elu_")
+hardtanh_ = _mk(hardtanh, "hardtanh_")
+leaky_relu_ = _mk(leaky_relu, "leaky_relu_")
+tanh_ = _mk(tanh, "tanh_")
+thresholded_relu_ = _mk(thresholded_relu, "thresholded_relu_")

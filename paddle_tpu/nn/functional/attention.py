@@ -318,3 +318,43 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
 def sdp_kernel(*args, **kwargs):  # config context no-op (XLA chooses)
     import contextlib
     return contextlib.nullcontext()
+
+
+def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False,
+                         return_softmax=False, fixed_seed_offset=None,
+                         rng_name="", training=True, name=None):
+    """Parity: F.flash_attn_qkvpacked (flash_attention.py qkvpacked
+    variant): qkv packed [batch, seq, 3, heads, dim] — unpack and ride
+    the flash path (the packed layout exists for CUDA kernel-argument
+    efficiency; XLA slices fuse into the same reads)."""
+    t = ensure_tensor(qkv)
+    if t.shape[2] != 3:
+        raise ValueError(
+            f"flash_attn_qkvpacked expects [b, s, 3, h, d], got {t.shape}")
+    q = t[:, :, 0]
+    k = t[:, :, 1]
+    v = t[:, :, 2]
+    return flash_attention(q, k, v, dropout=dropout, causal=causal,
+                           return_softmax=return_softmax, training=training)
+
+
+def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k,
+                                max_seqlen_q, max_seqlen_k, scale,
+                                dropout=0.0, causal=False,
+                                return_softmax=False, fixed_seed_offset=None,
+                                rng_name="", varlen_padded=True,
+                                training=True, name=None):
+    """Parity: F.flash_attn_varlen_qkvpacked — packed varlen form over
+    the segment-masked SDPA path."""
+    t = ensure_tensor(qkv)
+    if t.shape[1] != 3:
+        raise ValueError("flash_attn_varlen_qkvpacked expects "
+                         f"[total, 3, h, d], got {t.shape}")
+    q = t[:, 0]
+    k = t[:, 1]
+    v = t[:, 2]
+    return flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k,
+                               max_seqlen_q, max_seqlen_k, scale,
+                               dropout=dropout, causal=causal,
+                               return_softmax=return_softmax,
+                               training=training)

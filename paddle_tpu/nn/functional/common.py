@@ -69,22 +69,26 @@ def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
     return dropout(x, p=p, axis=axis, training=training)
 
 
+def _alpha_dropout_fwd(a, key, p, mask_shape):
+    """Shared SELU alpha-dropout math; mask_shape controls whether single
+    elements (alpha_dropout) or whole feature maps (feature_alpha_dropout)
+    drop together."""
+    alpha_p = -1.6732632423543772 * 1.0507009873554805
+    keep = jax.random.bernoulli(key, 1.0 - p, mask_shape)
+    q = 1.0 - p
+    a_coef = (q + alpha_p ** 2 * q * p) ** -0.5
+    b_coef = -a_coef * alpha_p * p
+    return (a_coef * jnp.where(keep, a, jnp.asarray(alpha_p, a.dtype))
+            + b_coef).astype(a.dtype)
+
+
 def alpha_dropout(x, p=0.5, training=True, name=None):
     xt = ensure_tensor(x)
     if not training or p == 0.0:
         return xt
     key = next_key()
-    alpha = 1.6732632423543772
-    scale = 1.0507009873554805
-    alpha_p = -alpha * scale
-
-    def fwd(a):
-        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
-        q = 1.0 - p
-        a_coef = (q + alpha_p ** 2 * q * p) ** -0.5
-        b_coef = -a_coef * alpha_p * p
-        return (a_coef * jnp.where(keep, a, alpha_p) + b_coef).astype(a.dtype)
-    return dispatch("alpha_dropout", fwd, xt)
+    return dispatch("alpha_dropout",
+                    lambda a: _alpha_dropout_fwd(a, key, p, a.shape), xt)
 
 
 def pad(x, pad, mode="constant", value=0.0, data_format=None, pad_from_left_axis=True,
@@ -432,3 +436,17 @@ def sparse_attention(query, key, value, sparse_csr_offset,
         return out.astype(q.dtype)
 
     return dispatch("sparse_attention", fwd, *args)
+
+
+def feature_alpha_dropout(x, p=0.5, training=True, name=None):
+    """Parity: F.feature_alpha_dropout — alpha dropout that drops whole
+    channel maps (axis 1), keeping SELU self-normalizing statistics."""
+    xt = ensure_tensor(x)
+    if not training or p == 0.0:
+        return xt
+    key = next_key()
+
+    def fwd(a):
+        mask_shape = (a.shape[0], a.shape[1]) + (1,) * (a.ndim - 2)
+        return _alpha_dropout_fwd(a, key, p, mask_shape)
+    return dispatch("feature_alpha_dropout", fwd, xt)

@@ -519,3 +519,212 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,
         return loss.astype(x.dtype)
 
     return dispatch("hsigmoid_loss", fwd, *args)
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """Parity: F.dice_loss (nn/functional/loss.py) — 1 - 2|X∩Y|/(|X|+|Y|)
+    per sample, meaned. input: [N, ..., C] probabilities; label integer
+    [N, ..., 1]."""
+    it, lt = ensure_tensor(input), ensure_tensor(label)
+
+    def fwd(x, lab):
+        n_classes = x.shape[-1]
+        lab = lab.reshape(lab.shape[:-1]) if lab.shape[-1] == 1 else lab
+        one_hot = jax.nn.one_hot(lab, n_classes, dtype=x.dtype)
+        red = tuple(range(1, x.ndim))
+        inter = jnp.sum(x * one_hot, axis=red)
+        union = jnp.sum(x, axis=red) + jnp.sum(one_hot, axis=red)
+        return jnp.mean(1.0 - (2.0 * inter + epsilon) / (union + epsilon))
+    return dispatch("dice_loss", fwd, it, lt)
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    """Parity: F.gaussian_nll_loss."""
+    it = ensure_tensor(input)
+    lt = ensure_tensor(label)
+    vt = ensure_tensor(variance)
+
+    def fwd(mu, y, var):
+        var = jnp.maximum(var.astype(jnp.float32), epsilon)
+        loss = 0.5 * (jnp.log(var) +
+                      (y.astype(jnp.float32) - mu.astype(jnp.float32)) ** 2
+                      / var)
+        if full:
+            loss = loss + 0.5 * jnp.log(jnp.asarray(2.0 * jnp.pi))
+        return _reduce(loss, reduction)
+    return dispatch("gaussian_nll_loss", fwd, it, lt, vt)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    """Parity: F.poisson_nll_loss — NLL of Poisson(label; rate)."""
+    it, lt = ensure_tensor(input), ensure_tensor(label)
+
+    def fwd(x, y):
+        x = x.astype(jnp.float32)
+        y = y.astype(jnp.float32)
+        if log_input:
+            loss = jnp.exp(x) - y * x
+        else:
+            loss = x - y * jnp.log(x + epsilon)
+        if full:
+            # Stirling approximation of log(y!) for y > 1
+            stir = y * jnp.log(y) - y + 0.5 * jnp.log(2.0 * jnp.pi * y)
+            loss = loss + jnp.where(y > 1, stir, 0.0)
+        return _reduce(loss, reduction)
+    return dispatch("poisson_nll_loss", fwd, it, lt)
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    """Parity: F.soft_margin_loss — log(1 + exp(-y x))."""
+    it, lt = ensure_tensor(input), ensure_tensor(label)
+
+    def fwd(x, y):
+        # softplus(-y*x) == log1p(exp(-y*x)) but stable for large logits
+        loss = jax.nn.softplus(-y.astype(jnp.float32)
+                               * x.astype(jnp.float32))
+        return _reduce(loss, reduction)
+    return dispatch("soft_margin_loss", fwd, it, lt)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean",
+                                 name=None):
+    """Parity: F.multi_label_soft_margin_loss."""
+    it, lt = ensure_tensor(input), ensure_tensor(label)
+    has_w = weight is not None
+    args = (it, lt) + ((ensure_tensor(weight),) if has_w else ())
+
+    def fwd(x, y, *w):
+        x = x.astype(jnp.float32)
+        y = y.astype(jnp.float32)
+        term = y * jax.nn.log_sigmoid(x) + (1 - y) * jax.nn.log_sigmoid(-x)
+        if has_w:
+            term = term * w[0]
+        loss = -jnp.mean(term, axis=-1)
+        return _reduce(loss, reduction)
+    return dispatch("multi_label_soft_margin_loss", fwd, *args)
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    """Parity: F.multi_margin_loss — multi-class margin hinge."""
+    it, lt = ensure_tensor(input), ensure_tensor(label)
+    has_w = weight is not None
+    args = (it, lt) + ((ensure_tensor(weight),) if has_w else ())
+
+    def fwd(x, y, *w):
+        x = x.astype(jnp.float32)
+        n, c = x.shape
+        correct = jnp.take_along_axis(x, y[:, None].astype(jnp.int32),
+                                      axis=1)
+        hinge = jnp.maximum(margin - correct + x, 0.0) ** p
+        if has_w:
+            hinge = hinge * w[0][y][:, None]
+        mask = 1.0 - jax.nn.one_hot(y, c, dtype=x.dtype)
+        loss = jnp.sum(hinge * mask, axis=1) / c
+        return _reduce(loss, reduction)
+    return dispatch("multi_margin_loss", fwd, *args)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    """Parity: F.pairwise_distance — ||x - y + eps||_p along the last dim."""
+    xt, yt = ensure_tensor(x), ensure_tensor(y)
+
+    def fwd(a, b):
+        d = a.astype(jnp.float32) - b.astype(jnp.float32) + epsilon
+        return jnp.linalg.norm(d, ord=p, axis=-1, keepdims=keepdim)
+    return dispatch("pairwise_distance", fwd, xt, yt)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    """Parity: F.triplet_margin_with_distance_loss — triplet loss under a
+    caller-supplied distance (default: euclidean pairwise_distance)."""
+    it = ensure_tensor(input)
+    pt = ensure_tensor(positive)
+    nt = ensure_tensor(negative)
+    dist = distance_function or (lambda a, b: pairwise_distance(a, b))
+    d_pos = ensure_tensor(dist(it, pt))
+    d_neg = ensure_tensor(dist(it, nt))
+    if swap:
+        d_pn = ensure_tensor(dist(pt, nt))
+        d_neg = dispatch("tmwd_min", jnp.minimum, d_neg, d_pn)
+
+    def fwd(dp, dn):
+        return _reduce(jnp.maximum(dp.astype(jnp.float32)
+                                   - dn.astype(jnp.float32) + margin, 0.0),
+                       reduction)
+    return dispatch("triplet_margin_with_distance_loss", fwd, d_pos, d_neg)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    """Parity: F.npair_loss — cross entropy over anchor·positiveᵀ
+    similarities with same-label targets + L2 on the embeddings."""
+    at, pt, lt = (ensure_tensor(anchor), ensure_tensor(positive),
+                  ensure_tensor(labels))
+
+    def fwd(a, p_, lab):
+        a32 = a.astype(jnp.float32)
+        p32 = p_.astype(jnp.float32)
+        lab = lab.reshape(-1)
+        sim = a32 @ p32.T                               # [B, B]
+        same = (lab[:, None] == lab[None, :]).astype(jnp.float32)
+        tgt = same / jnp.sum(same, axis=1, keepdims=True)
+        xe = -jnp.sum(tgt * jax.nn.log_softmax(sim, axis=1), axis=1)
+        reg = l2_reg * (jnp.mean(jnp.sum(a32 * a32, axis=1))
+                        + jnp.mean(jnp.sum(p32 * p32, axis=1))) * 0.25
+        return jnp.mean(xe) + reg
+    return dispatch("npair_loss", fwd, at, pt, lt)
+
+
+def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
+                                   cutoffs, head_bias=None, name=None):
+    """Parity: F.adaptive_log_softmax_with_loss (the AdaptiveLogSoftmax
+    efficient-softmax split: a head over [frequent classes + cluster
+    tokens] and low-rank tails per cluster). Returns (output, loss) where
+    output is the per-sample target log-probability."""
+    it, lt = ensure_tensor(input), ensure_tensor(label)
+    hw = ensure_tensor(head_weight)
+    hb = ensure_tensor(head_bias) if head_bias is not None else None
+    tw = [(ensure_tensor(w1), ensure_tensor(w2)) for w1, w2 in tail_weights]
+    cutoffs = [int(c) for c in cutoffs]
+    n_clusters = len(cutoffs) - 1
+    shortlist = cutoffs[0]
+
+    def fwd(x, y, hw_, *rest):
+        x = x.astype(jnp.float32)
+        idx = 0
+        hb_ = None
+        if hb is not None:
+            hb_ = rest[0].astype(jnp.float32)
+            idx = 1
+        tails = [(rest[idx + 2 * i].astype(jnp.float32),
+                  rest[idx + 2 * i + 1].astype(jnp.float32))
+                 for i in range(n_clusters)]
+        head = x @ hw_.astype(jnp.float32)
+        if hb_ is not None:
+            head = head + hb_
+        head_logp = jax.nn.log_softmax(head, axis=-1)     # [B, short + K]
+        y = y.reshape(-1).astype(jnp.int32)
+        # shortlist targets read the head directly
+        out = jnp.take_along_axis(
+            head_logp, jnp.clip(y, 0, shortlist - 1)[:, None], axis=1)[:, 0]
+        for i in range(n_clusters):
+            lo, hi = cutoffs[i], cutoffs[i + 1]
+            w_proj, w_cls = tails[i]
+            tail_logit = (x @ w_proj) @ w_cls
+            tail_logp = jax.nn.log_softmax(tail_logit, axis=-1)
+            rel = jnp.clip(y - lo, 0, hi - lo - 1)
+            cand = head_logp[:, shortlist + i] + jnp.take_along_axis(
+                tail_logp, rel[:, None], axis=1)[:, 0]
+            out = jnp.where((y >= lo) & (y < hi), cand, out)
+        return out, -jnp.mean(out)
+    flat = []
+    if hb is not None:
+        flat.append(hb)
+    for w1, w2 in tw:
+        flat.extend([w1, w2])
+    return dispatch("adaptive_log_softmax_with_loss", fwd, it, lt, hw, *flat)

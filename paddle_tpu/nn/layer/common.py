@@ -176,7 +176,10 @@ class Pad1D(Layer):
         self.data_format = data_format
 
     def forward(self, x):
-        return F.pad(x, self.padding, self.mode, self.value, self.data_format)
+        pad = self.padding
+        if isinstance(pad, int):  # reference Pad layers broadcast an int
+            pad = [pad] * (2 * (len(self.data_format) - 2))
+        return F.pad(x, pad, self.mode, self.value, self.data_format)
 
 
 class Pad2D(Pad1D):
@@ -250,3 +253,152 @@ class Bilinear(Layer):
 
     def forward(self, x1, x2):
         return F.bilinear(x1, x2, self.weight, self.bias)
+
+
+class ZeroPad1D(Pad1D):
+    """Parity: paddle.nn.ZeroPad1D."""
+
+    def __init__(self, padding, data_format="NCL", name=None):
+        super().__init__(padding, mode="constant", value=0.0,
+                         data_format=data_format)
+
+
+class ZeroPad3D(Pad3D):
+    """Parity: paddle.nn.ZeroPad3D."""
+
+    def __init__(self, padding, data_format="NCDHW", name=None):
+        super().__init__(padding, mode="constant", value=0.0,
+                         data_format=data_format)
+
+
+class FeatureAlphaDropout(Layer):
+    """Parity: paddle.nn.FeatureAlphaDropout."""
+
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.feature_alpha_dropout(x, self.p, self.training)
+
+
+class Fold(Layer):
+    """Parity: paddle.nn.Fold (col2im)."""
+
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1, name=None):
+        super().__init__()
+        self.output_sizes = output_sizes
+        self.kernel_sizes = kernel_sizes
+        self.strides = strides
+        self.paddings = paddings
+        self.dilations = dilations
+
+    def forward(self, x):
+        return F.fold(x, self.output_sizes, self.kernel_sizes, self.strides,
+                      self.paddings, self.dilations)
+
+
+class Unfold(Layer):
+    """Parity: paddle.nn.Unfold (im2col)."""
+
+    def __init__(self, kernel_sizes, strides=1, paddings=0, dilations=1,
+                 name=None):
+        super().__init__()
+        self.kernel_sizes = kernel_sizes
+        self.strides = strides
+        self.paddings = paddings
+        self.dilations = dilations
+
+    def forward(self, x):
+        return F.unfold(x, self.kernel_sizes, self.strides, self.paddings,
+                        self.dilations)
+
+
+class Softmax2D(Layer):
+    """Parity: paddle.nn.Softmax2D — softmax over the channel dim of
+    NCHW / CHW inputs."""
+
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, x):
+        if x.ndim not in (3, 4):
+            raise ValueError(
+                f"Softmax2D expects a 3D or 4D tensor, got {x.ndim}D")
+        return F.softmax(x, axis=-3)
+
+
+class PairwiseDistance(Layer):
+    """Parity: paddle.nn.PairwiseDistance."""
+
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p = p
+        self.epsilon = epsilon
+        self.keepdim = keepdim
+
+    def forward(self, x, y):
+        return F.pairwise_distance(x, y, self.p, self.epsilon, self.keepdim)
+
+
+class SpectralNorm(Layer):
+    """Parity: paddle.nn.SpectralNorm (the standalone layer form:
+    forward(weight) -> weight / sigma_max, sigma estimated by power
+    iteration on persistent u/v buffers). The wrapper-hook form lives in
+    nn.utils.spectral_norm."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 dtype="float32", name=None):
+        super().__init__()
+        import numpy as _np
+
+        from ...framework.random import next_key
+        self.dim = dim
+        self.power_iters = power_iters
+        self.eps = eps
+        shape = tuple(int(s) for s in weight_shape)
+        h = shape[dim]
+        w = 1
+        for i, s in enumerate(shape):
+            if i != dim:
+                w *= s
+        import jax as _jax
+
+        from ...tensor import Tensor as _T
+        ku, kv = _jax.random.split(next_key())
+        u = _jax.random.normal(ku, (h,), _np.dtype(dtype))
+        v = _jax.random.normal(kv, (w,), _np.dtype(dtype))
+        self.register_buffer("weight_u", _T(u / (_np.linalg.norm(u) + eps)),
+                             persistable=True)
+        self.register_buffer("weight_v", _T(v / (_np.linalg.norm(v) + eps)),
+                             persistable=True)
+
+    def forward(self, x):
+        import jax.numpy as jnp
+
+        from ...ops.dispatch import dispatch, ensure_tensor
+        xt = ensure_tensor(x)
+        dim, iters, eps = self.dim, self.power_iters, self.eps
+
+        def fwd(w, u, v):
+            wm = jnp.moveaxis(w.astype(jnp.float32), dim, 0)
+            mat = wm.reshape(wm.shape[0], -1)
+            for _ in range(max(1, iters)):
+                v = mat.T @ u
+                v = v / (jnp.linalg.norm(v) + eps)
+                u = mat @ v
+                u = u / (jnp.linalg.norm(u) + eps)
+            sigma = u @ mat @ v
+            return (w.astype(jnp.float32) / sigma).astype(w.dtype), u, v
+        out, u_new, v_new = dispatch(
+            "spectral_norm", fwd, xt, self.weight_u, self.weight_v)
+        # power-iteration state advances eagerly (matches the reference's
+        # persistent U/V estimate refinement across calls)
+        import jax as _jax
+        import jax.core as _core
+        if isinstance(u_new._data, _jax.Array) and \
+                not isinstance(u_new._data, _core.Tracer):
+            self.weight_u._data = u_new._data
+            self.weight_v._data = v_new._data
+        return out

@@ -5,6 +5,7 @@ Reference parity: python/paddle/nn/layer/loss.py.
 from __future__ import annotations
 
 from .. import functional as F
+from ...ops.dispatch import ensure_tensor
 from .layers import Layer
 
 
@@ -214,3 +215,171 @@ class HSigmoidLoss(Layer):
         return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
                                self.bias, path_table, path_code,
                                self.is_sparse)
+
+
+class GaussianNLLLoss(Layer):
+    """Parity: paddle.nn.GaussianNLLLoss."""
+
+    def __init__(self, full=False, epsilon=1e-6, reduction="mean", name=None):
+        super().__init__()
+        self.full = full
+        self.epsilon = epsilon
+        self.reduction = reduction
+
+    def forward(self, input, label, variance):
+        return F.gaussian_nll_loss(input, label, variance, self.full,
+                                   self.epsilon, self.reduction)
+
+
+class PoissonNLLLoss(Layer):
+    """Parity: paddle.nn.PoissonNLLLoss."""
+
+    def __init__(self, log_input=True, full=False, epsilon=1e-8,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.log_input = log_input
+        self.full = full
+        self.epsilon = epsilon
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.poisson_nll_loss(input, label, self.log_input, self.full,
+                                  self.epsilon, self.reduction)
+
+
+class SoftMarginLoss(Layer):
+    """Parity: paddle.nn.SoftMarginLoss."""
+
+    def __init__(self, reduction="mean", name=None):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.soft_margin_loss(input, label, self.reduction)
+
+
+class MultiLabelSoftMarginLoss(Layer):
+    """Parity: paddle.nn.MultiLabelSoftMarginLoss."""
+
+    def __init__(self, weight=None, reduction="mean", name=None):
+        super().__init__()
+        self.weight = weight
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.multi_label_soft_margin_loss(input, label, self.weight,
+                                              self.reduction)
+
+
+class MultiMarginLoss(Layer):
+    """Parity: paddle.nn.MultiMarginLoss."""
+
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.p = p
+        self.margin = margin
+        self.weight = weight
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.multi_margin_loss(input, label, self.p, self.margin,
+                                   self.weight, self.reduction)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    """Parity: paddle.nn.TripletMarginWithDistanceLoss."""
+
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.distance_function = distance_function
+        self.margin = margin
+        self.swap = swap
+        self.reduction = reduction
+
+    def forward(self, input, positive, negative):
+        return F.triplet_margin_with_distance_loss(
+            input, positive, negative, self.distance_function, self.margin,
+            self.swap, self.reduction)
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    """Parity: paddle.nn.AdaptiveLogSoftmaxWithLoss — owns the head and
+    per-cluster low-rank tail projections (efficient softmax for large,
+    Zipf-distributed vocabularies)."""
+
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, name=None):
+        super().__init__()
+        cutoffs = [int(c) for c in cutoffs]
+        if (not cutoffs or any(cutoffs[i] >= cutoffs[i + 1]
+                               for i in range(len(cutoffs) - 1))
+                or cutoffs[-1] > n_classes - 1):
+            raise ValueError("cutoffs must be increasing ints < n_classes")
+        self.in_features = in_features
+        self.n_classes = n_classes
+        self.cutoffs = cutoffs + [n_classes]
+        self.div_value = div_value
+        self.shortlist_size = cutoffs[0]
+        self.n_clusters = len(self.cutoffs) - 1
+        head_size = self.shortlist_size + self.n_clusters
+        self.head_weight = self.create_parameter((in_features, head_size))
+        self.head_bias = (self.create_parameter((head_size,), is_bias=True)
+                          if head_bias else None)
+        self.tail_weights = []
+        for i in range(self.n_clusters):
+            hsz = max(1, int(in_features / (div_value ** (i + 1))))
+            osz = self.cutoffs[i + 1] - self.cutoffs[i]
+            w1 = self.create_parameter((in_features, hsz))
+            w2 = self.create_parameter((hsz, osz))
+            self.add_parameter(f"tail_{i}_proj", w1)
+            self.add_parameter(f"tail_{i}_cls", w2)
+            self.tail_weights.append((w1, w2))
+
+    def forward(self, input, label):
+        out, loss = F.adaptive_log_softmax_with_loss(
+            input, label, self.head_weight, self.tail_weights,
+            self.cutoffs, self.head_bias)
+        return out, loss
+
+    def log_prob(self, input):
+        """Full [N, n_classes] log-probabilities."""
+        import jax
+        import jax.numpy as jnp
+
+        from ...ops.dispatch import dispatch
+
+        def fwd(x, hw, *rest):
+            x = x.astype(jnp.float32)
+            idx = 0
+            hb = None
+            if self.head_bias is not None:
+                hb = rest[0].astype(jnp.float32)
+                idx = 1
+            head = x @ hw.astype(jnp.float32)
+            if hb is not None:
+                head = head + hb
+            head_logp = jax.nn.log_softmax(head, axis=-1)
+            parts = [head_logp[:, :self.shortlist_size]]
+            for i in range(self.n_clusters):
+                w1 = rest[idx + 2 * i].astype(jnp.float32)
+                w2 = rest[idx + 2 * i + 1].astype(jnp.float32)
+                tail_logp = jax.nn.log_softmax((x @ w1) @ w2, axis=-1)
+                parts.append(head_logp[:, self.shortlist_size + i:
+                                       self.shortlist_size + i + 1]
+                             + tail_logp)
+            return jnp.concatenate(parts, axis=-1)
+        flat = ([] if self.head_bias is None else [self.head_bias])
+        for w1, w2 in self.tail_weights:
+            flat.extend([w1, w2])
+        return dispatch("adaptive_log_softmax_log_prob", fwd,
+                        ensure_tensor(input), self.head_weight, *flat)
+
+    def predict(self, input):
+        import jax.numpy as jnp
+
+        from ...ops.dispatch import dispatch
+        lp = self.log_prob(input)
+        return dispatch("adaptive_log_softmax_predict",
+                        lambda a: jnp.argmax(a, axis=-1), lp)

@@ -210,3 +210,31 @@ class FractionalMaxPool3D(Layer):
         return F.fractional_max_pool3d(x, self._output_size,
                                        self._kernel_size, self._random_u,
                                        self._return_mask)
+
+
+class LPPool1D(_Pool):
+    """Parity: paddle.nn.LPPool1D."""
+
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCL", name=None):
+        super().__init__(kernel_size, stride, padding, ceil_mode=ceil_mode,
+                         data_format=data_format)
+        self.norm_type = float(norm_type)
+
+    def forward(self, x):
+        return F.lp_pool1d(x, self.norm_type, self.kernel_size, self.stride,
+                           self.padding, self.ceil_mode, self.data_format)
+
+
+class LPPool2D(_Pool):
+    """Parity: paddle.nn.LPPool2D."""
+
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCHW", name=None):
+        super().__init__(kernel_size, stride, padding, ceil_mode=ceil_mode,
+                         data_format=data_format)
+        self.norm_type = float(norm_type)
+
+    def forward(self, x):
+        return F.lp_pool2d(x, self.norm_type, self.kernel_size, self.stride,
+                           self.padding, self.ceil_mode, self.data_format)

