@@ -163,3 +163,50 @@ def hessian(ys, xs, batch_axis=None):
         "tape-based hessian needs double backward; use "
         "paddle.incubate.autograd.Hessian(func, xs) (jax.hessian under the "
         "hood) instead")
+
+
+class saved_tensors_hooks:  # noqa: N801 - reference API name
+    """Parity: paddle.autograd.saved_tensors_hooks — intercept tensors
+    saved for backward with (pack_hook, unpack_hook). On this framework
+    the op-level residuals live inside jax's vjp closures (XLA manages
+    their memory/rematerialization), so the hookable save point — same
+    as the reference's user-visible one — is PyLayerContext.
+    save_for_backward: pack runs at save, unpack at saved_tensor()."""
+
+    _active = []
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        saved_tensors_hooks._active.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        saved_tensors_hooks._active.pop()
+        return False
+
+
+__all__.append("saved_tensors_hooks")
+
+
+def _hooked_save(self, *tensors):
+    hooks = saved_tensors_hooks._active
+    if hooks:
+        h = hooks[-1]
+        self._saved = tuple(h.pack_hook(t) for t in tensors)
+        self._unpack = h.unpack_hook
+    else:
+        self._saved = tensors
+        self._unpack = None
+
+
+def _hooked_load(self):
+    if getattr(self, "_unpack", None) is not None:
+        return tuple(self._unpack(t) for t in self._saved)
+    return self._saved
+
+
+PyLayerContext.save_for_backward = _hooked_save
+PyLayerContext.saved_tensor = _hooked_load

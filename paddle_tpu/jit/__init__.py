@@ -24,6 +24,23 @@ from ..nn.layer.layers import Layer
 from ..ops.dispatch import dispatch
 from ..tensor import Tensor
 
+_VERBOSITY = [0]
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """Parity: paddle.jit.set_verbosity — transform-logging verbosity:
+    level >= 1 re-enables the graph-break fallback warning for every new
+    broken signature instead of once per function."""
+    _VERBOSITY[0] = int(level)
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """Parity: paddle.jit.set_code_level (the reference dumps transformed
+    bytecode; the analogous debug surface here is the re-enabled
+    graph-break diagnostics)."""
+    _VERBOSITY[0] = max(_VERBOSITY[0], 1 if level else 0)
+
+
 
 def _flatten_tensors(obj, out_list):
     """Collect Tensors from nested structures; return a spec for rebuilding."""
@@ -234,7 +251,9 @@ class StaticFunction:
             # FIFO: evict the oldest signature only, not the whole cache
             self._fallback_keys.pop(next(iter(self._fallback_keys)))
         self._fallback_keys[fallback_key] = True
-        if not self._warned_break:
+        # set_verbosity(>=1) re-enables the warning for EVERY new broken
+        # signature instead of once per function
+        if not self._warned_break or _VERBOSITY[0] >= 1:
             self._warned_break = True
             import warnings
             has_children = self._layer is not None and \

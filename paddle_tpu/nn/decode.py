@@ -127,6 +127,12 @@ def dynamic_decode(decoder, inits=None, max_step_num=None,
         fin = np.asarray(ensure_tensor(finished)._data)
         if lengths is None:
             lengths = np.full(fin.shape, max_steps, np.int32)
+        elif isinstance(decoder, BeamSearchDecoder):
+            # beams were re-gathered this step: lengths must follow the
+            # same src permutation or a slot's length describes a
+            # different beam than finalize() backtracks
+            src = np.asarray(ensure_tensor(outputs[1])._data)
+            lengths = lengths[src]
         newly = (fin & (lengths == max_steps))
         lengths[newly] = t + 1
         if bool(fin.all()):
@@ -138,12 +144,14 @@ def dynamic_decode(decoder, inits=None, max_step_num=None,
         seqs = decoder.finalize(step_outputs, states, batch)
         lengths_t = Tensor(jnp.asarray(
             lengths.reshape(batch, decoder.beam_size)))
+        if output_time_major:                 # [batch, beam, T] -> [T, b, k]
+            seqs = Tensor(jnp.moveaxis(seqs._data, -1, 0))
     else:
         seqs = Tensor(jnp.stack(
             [ensure_tensor(o)._data for o, *_ in step_outputs], axis=1))
         lengths_t = Tensor(jnp.asarray(lengths))
-    if output_time_major:
-        seqs = Tensor(jnp.moveaxis(seqs._data, -1, 0))
+        if output_time_major:                 # [batch, T, ...] -> [T, b, ...]
+            seqs = Tensor(jnp.swapaxes(seqs._data, 0, 1))
     if return_length:
         return seqs, states, lengths_t
     return seqs, states

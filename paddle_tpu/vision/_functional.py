@@ -1,0 +1,338 @@
+"""Functional image transforms (reference parity:
+python/paddle/vision/transforms/functional.py). Host-side preprocessing:
+operates on HWC numpy arrays (uint8 or float) — image decode/augment is
+CPU work feeding the device input pipeline, so numpy is the right
+substrate (the reference's PIL/cv2 backends play the same role). Tensor
+inputs are accepted where the reference accepts them (normalize, erase)
+and returned as Tensors."""
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+
+from ..tensor import Tensor, to_tensor
+
+
+def _as_hwc(img):
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    return arr
+
+
+def crop(img, top, left, height, width):
+    """Parity: transforms.crop."""
+    arr = _as_hwc(img)
+    return arr[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    """Parity: transforms.center_crop."""
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    arr = _as_hwc(img)
+    th, tw = output_size
+    h, w = arr.shape[:2]
+    return crop(arr, max((h - th) // 2, 0), max((w - tw) // 2, 0), th, tw)
+
+
+def hflip(img):
+    """Parity: transforms.hflip."""
+    return _as_hwc(img)[:, ::-1].copy()
+
+
+def vflip(img):
+    """Parity: transforms.vflip."""
+    return _as_hwc(img)[::-1].copy()
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    """Parity: transforms.pad. padding: int | [l, r] | [l, t, r, b]."""
+    arr = _as_hwc(img)
+    if isinstance(padding, numbers.Number):
+        pl = pt = pr = pb = int(padding)
+    elif len(padding) == 2:
+        pl, pt = int(padding[0]), int(padding[1])
+        pr, pb = pl, pt
+    else:
+        pl, pt, pr, pb = (int(p) for p in padding)
+    spec = ((pt, pb), (pl, pr), (0, 0))
+    mode = {"constant": "constant", "edge": "edge", "reflect": "reflect",
+            "symmetric": "symmetric"}[padding_mode]
+    if mode == "constant":
+        return np.pad(arr, spec, mode, constant_values=fill)
+    return np.pad(arr, spec, mode)
+
+
+def resize(img, size, interpolation="bilinear"):
+    """Parity: transforms.resize. An int size scales the SHORT edge,
+    keeping aspect (the reference convention)."""
+    import jax
+    import jax.numpy as jnp
+    arr = _as_hwc(img)
+    h, w = arr.shape[:2]
+    if isinstance(size, numbers.Number):
+        short, long = (h, w) if h <= w else (w, h)
+        new_short = int(size)
+        new_long = int(size * long / short)
+        th, tw = (new_short, new_long) if h <= w else (new_long, new_short)
+    else:
+        th, tw = (int(size[0]), int(size[1]))
+    method = {"nearest": "nearest", "bilinear": "linear",
+              "bicubic": "cubic", "lanczos": "lanczos3"}.get(
+        interpolation, "linear")
+    out = jax.image.resize(jnp.asarray(arr, jnp.float32),
+                           (th, tw, arr.shape[2]), method=method)
+    out = np.asarray(out)
+    if arr.dtype == np.uint8:
+        out = np.clip(np.round(out), 0, 255).astype(np.uint8)
+    else:
+        out = out.astype(arr.dtype)
+    return out
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    """Parity: transforms.normalize (accepts Tensor or ndarray)."""
+    is_tensor = isinstance(img, Tensor)
+    arr = img.numpy() if is_tensor else np.asarray(img, np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    if data_format == "CHW":
+        mean = mean.reshape(-1, 1, 1)
+        std = std.reshape(-1, 1, 1)
+    if to_rgb:
+        arr = arr[::-1] if data_format == "CHW" else arr[..., ::-1]
+    out = ((arr - mean) / std).astype(np.float32)
+    return to_tensor(out) if is_tensor else out
+
+
+_GRAY_W = np.asarray([0.299, 0.587, 0.114], np.float32)
+
+
+def to_grayscale(img, num_output_channels=1):
+    """Parity: transforms.to_grayscale (ITU-R 601 luma)."""
+    arr = _as_hwc(img)
+    if arr.shape[2] == 1:
+        g = arr.astype(np.float32)[..., 0]
+    else:
+        g = arr[..., :3].astype(np.float32) @ _GRAY_W
+    out = np.repeat(g[:, :, None], num_output_channels, axis=2)
+    if arr.dtype == np.uint8:
+        return np.clip(np.round(out), 0, 255).astype(np.uint8)
+    return out.astype(arr.dtype)
+
+
+def _blend(a, b, factor, dtype):
+    out = a.astype(np.float32) * factor + b.astype(np.float32) * (1 - factor)
+    if dtype == np.uint8:
+        return np.clip(np.round(out), 0, 255).astype(np.uint8)
+    return out.astype(dtype)
+
+
+def adjust_brightness(img, brightness_factor):
+    """Parity: transforms.adjust_brightness — blend toward black."""
+    arr = _as_hwc(img)
+    return _blend(arr, np.zeros_like(arr), brightness_factor, arr.dtype)
+
+
+def adjust_contrast(img, contrast_factor):
+    """Parity: transforms.adjust_contrast — blend toward the mean gray."""
+    arr = _as_hwc(img)
+    g = to_grayscale(arr).astype(np.float32)
+    mean = np.full_like(arr, g.mean(), dtype=np.float32)
+    return _blend(arr, mean, contrast_factor, arr.dtype)
+
+
+def adjust_saturation(img, saturation_factor):
+    """Blend toward the grayscale image (used by ColorJitter /
+    SaturationTransform; the reference functional has the same helper)."""
+    arr = _as_hwc(img)
+    g = to_grayscale(arr, num_output_channels=arr.shape[2])
+    return _blend(arr, g, saturation_factor, arr.dtype)
+
+
+def adjust_hue(img, hue_factor):
+    """Parity: transforms.adjust_hue — rotate hue in HSV by
+    hue_factor (in [-0.5, 0.5] turns)."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError(f"hue_factor {hue_factor} not in [-0.5, 0.5]")
+    arr = _as_hwc(img)
+    dtype = arr.dtype
+    x = arr.astype(np.float32)
+    if dtype == np.uint8:
+        x = x / 255.0
+    r, g, b = x[..., 0], x[..., 1], x[..., 2]
+    maxc = x[..., :3].max(-1)
+    minc = x[..., :3].min(-1)
+    v = maxc
+    d = maxc - minc
+    s = np.where(maxc > 0, d / np.maximum(maxc, 1e-12), 0.0)
+    dz = np.maximum(d, 1e-12)
+    h = np.select(
+        [maxc == r, maxc == g],
+        [((g - b) / dz) % 6.0, (b - r) / dz + 2.0],
+        default=(r - g) / dz + 4.0) / 6.0
+    h = np.where(d == 0, 0.0, h)
+    h = (h + hue_factor) % 1.0
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1.0 - s)
+    q = v * (1.0 - s * f)
+    t = v * (1.0 - s * (1.0 - f))
+    i = i.astype(np.int32) % 6
+    rgb = np.select(
+        [i[..., None] == k for k in range(6)],
+        [np.stack(c, -1) for c in
+         [(v, t, p), (q, v, p), (p, v, t), (p, q, v), (t, p, v), (v, p, q)]])
+    if dtype == np.uint8:
+        return np.clip(np.round(rgb * 255.0), 0, 255).astype(np.uint8)
+    return rgb.astype(dtype)
+
+
+def _warp(img, inv, out_h=None, out_w=None, interpolation="nearest",
+          fill=0):
+    """Inverse-map warp: inv is a 3x3 matrix mapping OUTPUT pixel homog
+    coords (x, y, 1) to input coords."""
+    arr = _as_hwc(img)
+    h, w, c = arr.shape
+    oh = h if out_h is None else out_h
+    ow = w if out_w is None else out_w
+    ys, xs = np.meshgrid(np.arange(oh), np.arange(ow), indexing="ij")
+    coords = np.stack([xs.ravel(), ys.ravel(),
+                       np.ones(oh * ow)]).astype(np.float64)
+    src = inv @ coords
+    sx = src[0] / src[2]
+    sy = src[1] / src[2]
+    fillv = np.broadcast_to(np.asarray(fill, np.float32), (c,))
+    out = np.empty((oh * ow, c), np.float32)
+    if interpolation == "nearest":
+        xi = np.round(sx).astype(np.int64)
+        yi = np.round(sy).astype(np.int64)
+        valid = (xi >= 0) & (xi < w) & (yi >= 0) & (yi < h)
+        out[:] = fillv
+        out[valid] = arr[yi[valid], xi[valid]].astype(np.float32)
+    else:  # bilinear
+        x0 = np.floor(sx).astype(np.int64)
+        y0 = np.floor(sy).astype(np.int64)
+        fx = (sx - x0).astype(np.float32)[:, None]
+        fy = (sy - y0).astype(np.float32)[:, None]
+
+        def sample(xi, yi):
+            valid = (xi >= 0) & (xi < w) & (yi >= 0) & (yi < h)
+            v = np.empty((oh * ow, c), np.float32)
+            v[:] = fillv
+            v[valid] = arr[yi[valid], xi[valid]].astype(np.float32)
+            return v
+        out = (sample(x0, y0) * (1 - fx) * (1 - fy)
+               + sample(x0 + 1, y0) * fx * (1 - fy)
+               + sample(x0, y0 + 1) * (1 - fx) * fy
+               + sample(x0 + 1, y0 + 1) * fx * fy)
+    out = out.reshape(oh, ow, c)
+    if arr.dtype == np.uint8:
+        return np.clip(np.round(out), 0, 255).astype(np.uint8)
+    return out.astype(arr.dtype)
+
+
+def _inv_affine_matrix(center, angle, translate, scale, shear):
+    """Inverse affine (output->input), torchvision-compatible
+    parameterization: rotation `angle` deg, shear (sx, sy) deg, about
+    `center`, then `translate`."""
+    rot = np.deg2rad(angle)
+    sx, sy = (np.deg2rad(s) for s in shear)
+    cx, cy = center
+    tx, ty = translate
+    # forward: M = T(center+t) @ R(rot) @ Shear @ S(scale) @ T(-center)
+    a = np.cos(rot - sy) / np.cos(sy)
+    b = -np.cos(rot - sy) * np.tan(sx) / np.cos(sy) - np.sin(rot)
+    c = np.sin(rot - sy) / np.cos(sy)
+    d = -np.sin(rot - sy) * np.tan(sx) / np.cos(sy) + np.cos(rot)
+    m = np.array([[a * scale, b * scale, 0.0],
+                  [c * scale, d * scale, 0.0],
+                  [0.0, 0.0, 1.0]])
+    t_pre = np.array([[1, 0, -cx - tx], [0, 1, -cy - ty], [0, 0, 1.0]])
+    t_post = np.array([[1, 0, cx], [0, 1, cy], [0, 0, 1.0]])
+    # inverse of forward = T(center) @ inv(RSS) @ T(-center - t)
+    return t_post @ np.linalg.inv(m) @ t_pre
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest",
+           fill=0, center=None):
+    """Parity: transforms.affine."""
+    arr = _as_hwc(img)
+    h, w = arr.shape[:2]
+    if isinstance(shear, numbers.Number):
+        shear = (shear, 0.0)
+    ctr = ((w - 1) * 0.5, (h - 1) * 0.5) if center is None else center
+    inv = _inv_affine_matrix(ctr, angle, translate, scale, shear)
+    return _warp(arr, inv, interpolation=interpolation, fill=fill)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    """Parity: transforms.rotate (counter-clockwise degrees; expand grows
+    the canvas to hold the rotated image)."""
+    arr = _as_hwc(img)
+    h, w = arr.shape[:2]
+    ctr = ((w - 1) * 0.5, (h - 1) * 0.5) if center is None else center
+    out_h, out_w = h, w
+    inv = _inv_affine_matrix(ctr, -angle, (0, 0), 1.0, (0.0, 0.0))
+    if expand:
+        rad = np.deg2rad(angle)
+        # the 1e-9 slack keeps cos(90 deg) ~ 6e-17 from ceiling an extra px
+        out_w = int(np.ceil(abs(w * np.cos(rad)) + abs(h * np.sin(rad))
+                            - 1e-9))
+        out_h = int(np.ceil(abs(h * np.cos(rad)) + abs(w * np.sin(rad))
+                            - 1e-9))
+        # recenter: map new canvas center onto the old image center
+        shift = np.array([[1, 0, ctr[0] - (out_w - 1) * 0.5],
+                          [0, 1, ctr[1] - (out_h - 1) * 0.5],
+                          [0, 0, 1.0]])
+        rot_only = _inv_affine_matrix(ctr, -angle, (0, 0), 1.0, (0.0, 0.0))
+        inv = rot_only @ shift
+    return _warp(arr, inv, out_h, out_w, interpolation=interpolation,
+                 fill=fill)
+
+
+def _homography(src_pts, dst_pts):
+    """3x3 homography mapping src -> dst from 4 point correspondences."""
+    a = []
+    b = []
+    for (x, y), (u, v) in zip(src_pts, dst_pts):
+        a.append([x, y, 1, 0, 0, 0, -u * x, -u * y])
+        a.append([0, 0, 0, x, y, 1, -v * x, -v * y])
+        b.extend([u, v])
+    hvec = np.linalg.solve(np.asarray(a, np.float64),
+                           np.asarray(b, np.float64))
+    return np.append(hvec, 1.0).reshape(3, 3)
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest",
+                fill=0):
+    """Parity: transforms.perspective — warp so `startpoints` land on
+    `endpoints` (points are [x, y] corners)."""
+    inv = _homography(endpoints, startpoints)  # output -> input
+    return _warp(img, inv, interpolation=interpolation, fill=fill)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """Parity: transforms.erase — write value block v into img[i:i+h,
+    j:j+w] (Tensor CHW or ndarray HWC)."""
+    if isinstance(img, Tensor):
+        import jax.numpy as jnp
+        data = img._data
+        va = jnp.asarray(v, data.dtype)
+        if va.ndim == 1 and data.ndim >= 3 and \
+                va.shape[0] == data.shape[-3]:
+            va = va[:, None, None]            # per-channel fill for CHW
+        vv = jnp.broadcast_to(va, data.shape[:-2] + (h, w))
+        new = data.at[..., i:i + h, j:j + w].set(vv)
+        if inplace:
+            img._data = new
+            return img
+        return Tensor(new)
+    arr = np.asarray(img)
+    out = arr if inplace else arr.copy()
+    out[i:i + h, j:j + w] = np.broadcast_to(
+        np.asarray(v, out.dtype), (h, w) + out.shape[2:])
+    return out

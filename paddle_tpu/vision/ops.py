@@ -11,6 +11,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax import lax
 
+from ..nn.layer.layers import Layer
 from ..ops.dispatch import dispatch, ensure_tensor
 from ..tensor import Tensor
 
@@ -954,3 +955,109 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
 
 
 __all__ += ["yolo_loss"]
+
+
+# -- layer wrappers + file IO (reference vision/ops.py __all__ tail) ----------
+
+class RoIAlign(Layer):
+    """Parity: paddle.vision.ops.RoIAlign."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num, aligned=True):
+        return roi_align(x, boxes, boxes_num, self.output_size,
+                         self.spatial_scale, aligned=aligned)
+
+
+class RoIPool(Layer):
+    """Parity: paddle.vision.ops.RoIPool."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size,
+                        self.spatial_scale)
+
+
+class PSRoIPool(Layer):
+    """Parity: paddle.vision.ops.PSRoIPool."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self.output_size,
+                          self.spatial_scale)
+
+
+class DeformConv2D(Layer):
+    """Parity: paddle.vision.ops.DeformConv2D — owns the weight/bias;
+    offset (and optional modulation mask) arrive per-forward."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        ks = ((kernel_size, kernel_size) if isinstance(kernel_size, int)
+              else tuple(kernel_size))
+        self.stride = stride
+        self.padding = padding
+        self.dilation = dilation
+        self.deformable_groups = deformable_groups
+        self.groups = groups
+        self.weight = self.create_parameter(
+            (out_channels, in_channels // groups, ks[0], ks[1]),
+            attr=weight_attr)
+        self.bias = (None if bias_attr is False else
+                     self.create_parameter((out_channels,), attr=bias_attr,
+                                           is_bias=True))
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias, self.stride,
+                             self.padding, self.dilation,
+                             self.deformable_groups, self.groups, mask)
+
+
+def read_file(filename, name=None):
+    """Parity: paddle.vision.ops.read_file — raw bytes as a uint8 1-D
+    tensor."""
+    import numpy as _np
+
+    from ..tensor import Tensor
+    with open(filename, "rb") as f:
+        data = f.read()
+    return Tensor(jnp.asarray(_np.frombuffer(data, _np.uint8)))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Parity: paddle.vision.ops.decode_jpeg — decode a jpeg byte tensor
+    to CHW uint8. Host-side (PIL): image decode is input-pipeline CPU
+    work, like the reference's CPU kernel path."""
+    import io as _io
+
+    import numpy as _np
+    from PIL import Image
+
+    from ..tensor import Tensor
+    arr = _np.asarray(ensure_tensor(x)._data, _np.uint8)
+    img = Image.open(_io.BytesIO(arr.tobytes()))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    out = _np.asarray(img)
+    if out.ndim == 2:
+        out = out[:, :, None]
+    return Tensor(jnp.asarray(_np.transpose(out, (2, 0, 1))))
+
+
+__all__ += ["RoIAlign", "RoIPool", "PSRoIPool", "DeformConv2D",
+            "read_file", "decode_jpeg"]

@@ -57,21 +57,12 @@ class Normalize(BaseTransform):
         self.mean = np.asarray(mean, np.float32)
         self.std = np.asarray(std, np.float32)
         self.data_format = data_format
+        self.to_rgb = to_rgb
 
     def _apply_image(self, img):
-        if isinstance(img, Tensor):
-            arr = img.numpy()
-        else:
-            arr = np.asarray(img, np.float32)
-        if self.data_format == "CHW":
-            m = self.mean.reshape(-1, 1, 1)
-            s = self.std.reshape(-1, 1, 1)
-        else:
-            m = self.mean
-            s = self.std
-        out = (arr - m) / s
-        return to_tensor(out.astype(np.float32)) if isinstance(img, Tensor) \
-            else out
+        from ._functional import normalize as f_normalize
+        return f_normalize(img, self.mean, self.std, self.data_format,
+                           self.to_rgb)
 
     def __call__(self, img):
         return self._apply_image(img)
@@ -79,31 +70,23 @@ class Normalize(BaseTransform):
 
 class Resize(BaseTransform):
     def __init__(self, size, interpolation="bilinear", keys=None):
-        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        # an int size scales the SHORT edge (reference convention),
+        # handled by the functional
+        self.size = size
+        self.interpolation = interpolation
 
     def _apply_image(self, img):
-        arr = np.asarray(img)
-        import jax
-        import jax.numpy as jnp
-        h, w = self.size
-        if arr.ndim == 2:
-            arr = arr[:, :, None]
-        out = jax.image.resize(jnp.asarray(arr, jnp.float32),
-                               (h, w, arr.shape[2]), method="linear")
-        return np.asarray(out).astype(arr.dtype)
+        from ._functional import resize as f_resize
+        return f_resize(img, self.size, self.interpolation)
 
 
 class CenterCrop(BaseTransform):
     def __init__(self, size, keys=None):
-        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.size = size
 
     def _apply_image(self, img):
-        arr = np.asarray(img)
-        th, tw = self.size
-        h, w = arr.shape[0], arr.shape[1]
-        i = max((h - th) // 2, 0)
-        j = max((w - tw) // 2, 0)
-        return arr[i:i + th, j:j + tw]
+        from ._functional import center_crop as f_center_crop
+        return f_center_crop(img, self.size)
 
 
 class RandomCrop(BaseTransform):
@@ -143,3 +126,277 @@ class Transpose(BaseTransform):
         if arr.ndim == 2:
             arr = arr[:, :, None]
         return np.transpose(arr, self.order)
+
+
+# -- functional re-exports (reference transforms/functional.py) ---------------
+from . import _functional as _F  # noqa: E402
+from ._functional import (  # noqa: F401, E402
+    adjust_brightness, adjust_contrast, adjust_hue, affine, center_crop,
+    crop, erase, hflip, normalize, pad, perspective, resize, rotate,
+    to_grayscale, vflip,
+)
+
+
+def _factor_range(value, center=1.0, bound=(0.0, float("inf")),
+                  name="value"):
+    """Reference color-transform parameterization: a number v means
+    [center - v, center + v] clipped to bound; a (min, max) pair is used
+    as-is. Returns None when the range collapses to the identity."""
+    if isinstance(value, numbers.Number):
+        if value < 0:
+            raise ValueError(f"{name} should be non-negative, got {value}")
+        if value == 0:
+            return None
+        lo = max(bound[0], center - value)
+        hi = min(bound[1], center + value)
+    else:
+        lo, hi = (float(value[0]), float(value[1]))
+        if not bound[0] <= lo <= hi <= bound[1]:
+            raise ValueError(f"{name} range {value} not in {bound}")
+    return (lo, hi)
+
+
+class BrightnessTransform(BaseTransform):
+    """Parity: transforms.BrightnessTransform — random brightness factor
+    in [max(0, 1-value), 1+value] (or an explicit (min, max) pair)."""
+
+    def __init__(self, value, keys=None):
+        self.value = _factor_range(value, name="brightness")
+
+    def _apply_image(self, img):
+        if self.value is None:
+            return img
+        return _F.adjust_brightness(img, np.random.uniform(*self.value))
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = _factor_range(value, name="contrast")
+
+    def _apply_image(self, img):
+        if self.value is None:
+            return img
+        return _F.adjust_contrast(img, np.random.uniform(*self.value))
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = _factor_range(value, name="saturation")
+
+    def _apply_image(self, img):
+        if self.value is None:
+            return img
+        return _F.adjust_saturation(img, np.random.uniform(*self.value))
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = _factor_range(value, center=0.0, bound=(-0.5, 0.5),
+                                   name="hue")
+
+    def _apply_image(self, img):
+        if self.value is None:
+            return img
+        return _F.adjust_hue(img, np.random.uniform(*self.value))
+
+
+class ColorJitter(BaseTransform):
+    """Parity: transforms.ColorJitter — random order of the four color
+    transforms, each with a random factor."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        self.transforms = [BrightnessTransform(brightness),
+                           ContrastTransform(contrast),
+                           SaturationTransform(saturation),
+                           HueTransform(hue)]
+
+    def _apply_image(self, img):
+        order = np.random.permutation(len(self.transforms))
+        for i in order:
+            img = self.transforms[i]._apply_image(img)
+        return img
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return _F.to_grayscale(img, self.num_output_channels)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        self.padding = padding
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        return _F.pad(img, self.padding, self.fill, self.padding_mode)
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if np.random.rand() < self.prob:
+            return _F.vflip(img)
+        return np.asarray(img)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        if isinstance(degrees, numbers.Number):
+            degrees = (-float(degrees), float(degrees))
+        self.degrees = degrees
+        self.interpolation = interpolation
+        self.expand = expand
+        self.center = center
+        self.fill = fill
+
+    def _apply_image(self, img):
+        angle = np.random.uniform(*self.degrees)
+        return _F.rotate(img, angle, self.interpolation, self.expand,
+                         self.center, self.fill)
+
+
+class RandomAffine(BaseTransform):
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        if isinstance(degrees, numbers.Number):
+            degrees = (-float(degrees), float(degrees))
+        self.degrees = degrees
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.interpolation = interpolation
+        self.fill = fill
+        self.center = center
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        h, w = arr.shape[0], arr.shape[1]
+        angle = np.random.uniform(*self.degrees)
+        tx = ty = 0
+        if self.translate is not None:
+            tx = int(np.round(np.random.uniform(-1, 1)
+                              * self.translate[0] * w))
+            ty = int(np.round(np.random.uniform(-1, 1)
+                              * self.translate[1] * h))
+        sc = (np.random.uniform(*self.scale)
+              if self.scale is not None else 1.0)
+        sh = (0.0, 0.0)
+        if self.shear is not None:
+            shr = self.shear
+            if isinstance(shr, numbers.Number):
+                shr = (-float(shr), float(shr))
+            if len(shr) == 2:
+                sh = (np.random.uniform(shr[0], shr[1]), 0.0)
+            else:
+                sh = (np.random.uniform(shr[0], shr[1]),
+                      np.random.uniform(shr[2], shr[3]))
+        return _F.affine(img, angle, (tx, ty), sc, sh, self.interpolation,
+                         self.fill, self.center)
+
+
+class RandomPerspective(BaseTransform):
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.interpolation = interpolation
+        self.fill = fill
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        if np.random.rand() >= self.prob:
+            return arr
+        h, w = arr.shape[0], arr.shape[1]
+        dx = int(self.distortion_scale * w / 2)
+        dy = int(self.distortion_scale * h / 2)
+        start = [[0, 0], [w - 1, 0], [w - 1, h - 1], [0, h - 1]]
+        jit = lambda lo, hi: int(np.random.randint(lo, hi + 1))
+        end = [[jit(0, dx), jit(0, dy)],
+               [w - 1 - jit(0, dx), jit(0, dy)],
+               [w - 1 - jit(0, dx), h - 1 - jit(0, dy)],
+               [jit(0, dx), h - 1 - jit(0, dy)]]
+        return _F.perspective(img, start, end, self.interpolation, self.fill)
+
+
+class RandomResizedCrop(BaseTransform):
+    """Parity: transforms.RandomResizedCrop — random area/aspect crop
+    resized to `size`."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        arr = _F._as_hwc(img)
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            log_r = np.log(np.asarray(self.ratio))
+            aspect = np.exp(np.random.uniform(log_r[0], log_r[1]))
+            cw = int(round(np.sqrt(target * aspect)))
+            ch = int(round(np.sqrt(target / aspect)))
+            if 0 < cw <= w and 0 < ch <= h:
+                i = np.random.randint(0, h - ch + 1)
+                j = np.random.randint(0, w - cw + 1)
+                return _F.resize(arr[i:i + ch, j:j + cw], self.size,
+                                 self.interpolation)
+        return _F.resize(_F.center_crop(arr, min(h, w)), self.size,
+                         self.interpolation)
+
+
+class RandomErasing(BaseTransform):
+    """Parity: transforms.RandomErasing — erase a random block (expects
+    CHW Tensor or HWC ndarray)."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+        self.inplace = inplace
+
+    def __call__(self, img):
+        return self._apply_image(img)
+
+    def _apply_image(self, img):
+        if np.random.rand() >= self.prob:
+            return img
+        if isinstance(img, Tensor):
+            h, w = int(img.shape[-2]), int(img.shape[-1])
+        else:
+            img = np.asarray(img)
+            h, w = img.shape[0], img.shape[1]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            log_r = np.log(np.asarray(self.ratio))
+            aspect = np.exp(np.random.uniform(log_r[0], log_r[1]))
+            eh = int(round(np.sqrt(target / aspect)))
+            ew = int(round(np.sqrt(target * aspect)))
+            if eh < h and ew < w and eh > 0 and ew > 0:
+                i = np.random.randint(0, h - eh + 1)
+                j = np.random.randint(0, w - ew + 1)
+                v = self.value
+                if isinstance(v, str) and v == "random":
+                    # per-element noise, like the reference (a constant
+                    # patch would be a much weaker augmentation)
+                    if isinstance(img, Tensor):
+                        shape = tuple(img.shape[:-2]) + (eh, ew)
+                    else:
+                        shape = (eh, ew) + img.shape[2:]
+                    v = np.random.standard_normal(shape).astype(np.float32)
+                return _F.erase(img, i, j, eh, ew, v, self.inplace)
+        return img
