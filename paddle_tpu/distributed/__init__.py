@@ -44,3 +44,21 @@ from .sharding import (  # noqa: F401
     group_sharded_parallel, save_group_sharded_model,
 )
 from .engine import Engine, to_static  # noqa: F401
+
+# -- namespace tail (reference distributed/__init__.py __all__) ---------------
+from . import io  # noqa: F401
+from .engine import Engine as DistModel  # noqa: F401  (dist.to_static result)
+from .parallelize import (  # noqa: F401
+    ColWiseParallel, DistAttr, LocalLayer, ParallelMode, PrepareLayerInput,
+    PrepareLayerOutput, ReduceType, RowWiseParallel, SequenceParallelBegin,
+    SequenceParallelDisable, SequenceParallelEnable, SequenceParallelEnd,
+    ShardingStage1, ShardingStage2, ShardingStage3, SplitPoint, parallelize,
+    to_distributed,
+)
+from .extras import (  # noqa: F401
+    CountFilterEntry, InMemoryDataset, ProbabilityEntry, QueueDataset,
+    ShowClickEntry, Strategy, destroy_process_group, get_backend,
+    gloo_barrier, gloo_init_parallel_env, gloo_release, is_available,
+    shard_dataloader, shard_scaler, split,
+)
+from .communication import alltoall_single  # noqa: F401

@@ -345,3 +345,33 @@ class stream:
     scatter = staticmethod(scatter)
     send = staticmethod(send)
     recv = staticmethod(recv)
+
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
+                    out_split_sizes=None, group: Optional[Group] = None,
+                    sync_op: bool = True):
+    """Parity: dist.alltoall_single — one tensor split along dim 0 across
+    ranks (equal splits when sizes are None; the compiled path lowers to
+    one XLA all-to-all instead of the list form's stack)."""
+    if in_split_sizes is not None or out_split_sizes is not None:
+        raise NotImplementedError(
+            "alltoall_single: uneven split sizes need a pad-to-max layout "
+            "on XLA's tiled all_to_all; pass equal splits (None)")
+    ax = _axis(group)
+    it = in_tensor
+    if ax is not None and _is_traced(it._data):
+        out = lax.all_to_all(it._data.reshape(
+            (-1,) + it._data.shape[1:]), ax, split_axis=0, concat_axis=0,
+            tiled=True)
+        out_tensor._data = out
+        return _Task()
+    hc = _host(group, it._data)
+    if hc is not None:
+        n = hc.world
+        parts = jnp.split(it._data, n, axis=0)
+        outs = hc.all_to_all([_np(Tensor(p)) for p in parts])
+        out_tensor._data = jnp.concatenate(
+            [jnp.asarray(a) for a in outs], axis=0)
+    else:
+        out_tensor._data = it._data
+    return _Task()

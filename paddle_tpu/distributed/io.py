@@ -1,0 +1,41 @@
+"""paddle.distributed.io (reference distributed/io.py): save/load
+helpers for distributed training artifacts — here the sharded
+checkpoint machinery (distributed/checkpoint.py) provides the
+capability; these are the reference-named entry points."""
+from __future__ import annotations
+
+from ..framework.io import load as load_inference_model  # noqa: F401
+from ..framework.io import save as save_inference_model  # noqa: F401
+from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
+
+
+def save_persistables(executor=None, dirname=None, main_program=None,
+                      filename=None):
+    """Parity: distributed.io.save_persistables — persist a Program's
+    parameters (static-graph path)."""
+    from ..framework.io import save
+    from ..static import default_main_program
+    prog = main_program or default_main_program()
+    params = {}
+    for ref in getattr(prog, "_nodes", []):
+        node = ref()
+        if node is None:
+            continue
+        for t in node.inputs:
+            if getattr(t, "persistable", False) or (
+                    hasattr(t, "stop_gradient") and not t.stop_gradient):
+                params[getattr(t, "name", f"param_{id(t)}") or
+                       f"param_{id(t)}"] = t
+    save(params, (dirname or ".") + "/" + (filename or "persistables"))
+
+
+def load_persistables(executor=None, dirname=None, main_program=None,
+                      filename=None):
+    """Parity: distributed.io.load_persistables."""
+    from ..framework.io import load
+    return load((dirname or ".") + "/" + (filename or "persistables"))
+
+
+__all__ = ["save_state_dict", "load_state_dict", "save_persistables",
+           "load_persistables", "save_inference_model",
+           "load_inference_model"]

