@@ -146,16 +146,19 @@ class InMemoryDataset:
     def release_memory(self):
         self._samples = None
 
+    @staticmethod
+    def _emit(chunk):
+        try:
+            return np.stack(chunk)
+        except ValueError:          # ragged slots: yield the list
+            return chunk
+
     def __iter__(self):
         if self._samples is None:
             raise RuntimeError("load_into_memory() first")
         bs = self._batch_size
         for i in range(0, len(self._samples), bs):
-            chunk = self._samples[i:i + bs]
-            try:
-                yield np.stack(chunk)
-            except ValueError:      # ragged slots: yield the list
-                yield chunk
+            yield self._emit(self._samples[i:i + bs])
 
 
 class QueueDataset(InMemoryDataset):
@@ -182,10 +185,10 @@ class QueueDataset(InMemoryDataset):
                                              for v in line.split()],
                                             np.float32))
                     if len(batch) == self._batch_size:
-                        yield np.stack(batch)
+                        yield self._emit(batch)
                         batch = []
         if batch:
-            yield np.stack(batch)
+            yield self._emit(batch)
 
 
 # -- sharded input / scaler helpers ------------------------------------------
@@ -315,7 +318,10 @@ def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
     import os
     os.environ.setdefault("PADDLE_TRAINER_ID", str(rank_id))
     os.environ.setdefault("PADDLE_TRAINERS_NUM", str(rank_num))
-    os.environ.setdefault("MASTER_ENDPOINT", server_endpoint)
+    addr, _, port = str(server_endpoint).rpartition(":")
+    os.environ.setdefault("MASTER_ADDR", addr or server_endpoint)
+    if port:
+        os.environ.setdefault("MASTER_PORT", port)
     from .env import init_parallel_env
     init_parallel_env()
 

@@ -12,28 +12,33 @@ from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
 def save_persistables(executor=None, dirname=None, main_program=None,
                       filename=None):
     """Parity: distributed.io.save_persistables — persist a Program's
-    parameters (static-graph path)."""
+    parameters (static-graph path; one scan impl shared with
+    static.serialize_persistables)."""
     from ..framework.io import save
     from ..static import default_main_program
+    from ..static._extras import _program_params
     prog = main_program or default_main_program()
-    params = {}
-    for ref in getattr(prog, "_nodes", []):
-        node = ref()
-        if node is None:
-            continue
-        for t in node.inputs:
-            if getattr(t, "persistable", False) or (
-                    hasattr(t, "stop_gradient") and not t.stop_gradient):
-                params[getattr(t, "name", f"param_{id(t)}") or
-                       f"param_{id(t)}"] = t
-    save(params, (dirname or ".") + "/" + (filename or "persistables"))
+    save(_program_params(prog),
+         (dirname or ".") + "/" + (filename or "persistables"))
 
 
 def load_persistables(executor=None, dirname=None, main_program=None,
                       filename=None):
-    """Parity: distributed.io.load_persistables."""
+    """Parity: distributed.io.load_persistables — restore the values
+    INTO the program's parameters (matched by name) and return them."""
+    import jax.numpy as jnp
+
     from ..framework.io import load
-    return load((dirname or ".") + "/" + (filename or "persistables"))
+    from ..static import default_main_program
+    from ..static._extras import _program_params
+    prog = main_program or default_main_program()
+    state = load((dirname or ".") + "/" + (filename or "persistables"))
+    params = _program_params(prog)
+    for k, v in state.items():
+        t = params.get(k)
+        if t is not None:
+            t._data = jnp.asarray(v._data if hasattr(v, "_data") else v)
+    return state
 
 
 __all__ = ["save_state_dict", "load_state_dict", "save_persistables",

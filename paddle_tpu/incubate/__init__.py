@@ -6,3 +6,11 @@ from .segment_ops import (  # noqa: F401
 from . import asp  # noqa: F401
 
 from . import autograd  # noqa: F401
+
+from . import extras  # noqa: E402
+from .extras import (  # noqa: F401, E402
+    LookAhead, ModelAverage, graph_khop_sampler, graph_reindex,
+    graph_sample_neighbors, graph_send_recv, identity_loss,
+    softmax_mask_fuse, softmax_mask_fuse_upper_triangle,
+)
+from .. import inference  # noqa: F401, E402  (paddle.incubate.inference)

@@ -1,0 +1,258 @@
+"""Incubate namespace tail: LookAhead/ModelAverage optimizer wrappers,
+graph op aliases, identity_loss, fused softmax-mask ops.
+
+Reference parity: python/paddle/incubate/__init__.py __all__ —
+optimizer/lookahead.py, optimizer/modelaverage.py, operators/graph_*.py,
+nn/loss.py identity_loss, operators/softmax_mask_fuse*.py."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.dispatch import dispatch, ensure_tensor
+from ..tensor import Tensor
+
+
+class LookAhead:
+    """Parity: paddle.incubate.LookAhead (optimizer/lookahead.py) — keep
+    slow weights; every k inner steps pull them toward the fast weights
+    (slow += alpha * (fast - slow)) and reset the fast weights onto the
+    slow point."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        if inner_optimizer is None:
+            raise ValueError("inner optimizer cannot be None")
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError("alpha must be in [0, 1]")
+        if not isinstance(k, int) or k <= 0:
+            raise ValueError("k must be a positive integer")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._step_num = 0
+        self._slow = None
+
+    @property
+    def _parameter_list(self):
+        return self.inner_optimizer._parameter_list
+
+    def _ensure_slow(self):
+        if self._slow is None:
+            self._slow = [p._data for p in self._parameter_list]
+
+    def step(self):
+        self._ensure_slow()
+        self.inner_optimizer.step()
+        self._step_num += 1
+        if self._step_num % self.k == 0:
+            for i, p in enumerate(self._parameter_list):
+                slow = (self._slow[i]
+                        + self.alpha * (p._data.astype(jnp.float32)
+                                        - self._slow[i].astype(jnp.float32))
+                        .astype(self._slow[i].dtype))
+                self._slow[i] = slow
+                p._data = slow.astype(p._data.dtype)
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    def state_dict(self):
+        d = self.inner_optimizer.state_dict()
+        d["lookahead_step"] = self._step_num
+        return d
+
+    def set_state_dict(self, state):
+        self._step_num = int(state.pop("lookahead_step", 0))
+        self.inner_optimizer.set_state_dict(state)
+
+
+class ModelAverage:
+    """Parity: paddle.incubate.ModelAverage — running average of
+    parameters with apply()/restore() swap contexts (the reference's
+    sum_1/sum_2/sum_3 windowed accumulators collapse to one running sum:
+    the window policy only bounds the accumulator length, which a
+    single-pass average over `max_average_window` updates reproduces)."""
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self.average_window_rate = average_window_rate
+        self.min_average_window = min_average_window
+        self.max_average_window = max_average_window
+        self._params = list(parameters or [])
+        self._sum = [jnp.zeros_like(p._data, jnp.float32)
+                     for p in self._params]
+        self._count = 0
+        self._backup = None
+
+    def step(self):
+        """Accumulate the current parameter values."""
+        if self._count >= self.max_average_window:
+            # restart the window (reference rotates sum blocks)
+            self._sum = [jnp.zeros_like(s) for s in self._sum]
+            self._count = 0
+        for i, p in enumerate(self._params):
+            self._sum[i] = self._sum[i] + p._data.astype(jnp.float32)
+        self._count += 1
+
+    def apply(self, executor=None, need_restore=True):
+        """Context manager: swap in the averaged parameters."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            self._backup = [p._data for p in self._params]
+            n = max(self._count, 1)
+            for i, p in enumerate(self._params):
+                p._data = (self._sum[i] / n).astype(p._data.dtype)
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore()
+        return ctx()
+
+    def restore(self, executor=None):
+        if self._backup is not None:
+            for p, b in zip(self._params, self._backup):
+                p._data = b
+            self._backup = None
+
+
+def identity_loss(x, reduction="none"):
+    """Parity: paddle.incubate.identity_loss (incubate/nn/loss.py:36) —
+    mark/reduce the final loss. int codes: 0=sum, 1=mean, 2=none."""
+    if isinstance(reduction, str):
+        reduction = {"sum": 0, "mean": 1, "none": 2}.get(reduction.lower())
+        if reduction is None:
+            raise ValueError("Unsupported reduction type.")
+    xt = ensure_tensor(x)
+    if reduction == 0:
+        return dispatch("identity_loss", jnp.sum, xt)
+    if reduction == 1:
+        return dispatch("identity_loss", jnp.mean, xt)
+    if reduction == 2:
+        return dispatch("identity_loss", lambda a: a, xt)
+    raise ValueError("Unsupported reduction type.")
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """Parity: paddle.incubate.softmax_mask_fuse — softmax(x + mask) in
+    one pass (XLA fuses the chain; the CUDA kernel exists for the same
+    reason)."""
+    return dispatch(
+        "softmax_mask_fuse",
+        lambda a, m: jax.nn.softmax(a.astype(jnp.float32)
+                                    + m.astype(jnp.float32),
+                                    axis=-1).astype(a.dtype),
+        ensure_tensor(x), ensure_tensor(mask))
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """Parity: paddle.incubate.softmax_mask_fuse_upper_triangle — causal
+    (lower-triangular-visible) softmax over the last two dims."""
+    xt = ensure_tensor(x)
+
+    def fwd(a):
+        s = a.shape[-1]
+        vis = jnp.tril(jnp.ones((a.shape[-2], s), bool))
+        scores = jnp.where(vis, a.astype(jnp.float32), -1e9)
+        return jax.nn.softmax(scores, axis=-1).astype(a.dtype)
+    return dispatch("softmax_mask_fuse_upper_triangle", fwd, xt)
+
+
+__all__ = ["LookAhead", "ModelAverage", "identity_loss",
+           "softmax_mask_fuse", "softmax_mask_fuse_upper_triangle"]
+
+
+# -- graph op aliases (the geometric module owns the implementations) ---------
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum", out_size=None,
+                    name=None):
+    """Parity: paddle.incubate.graph_send_recv — superseded in the
+    reference by geometric.send_u_recv; same here."""
+    from ..geometric import send_u_recv
+    return send_u_recv(x, src_index, dst_index, reduce_op=pool_type,
+                       out_size=out_size)
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
+                           perm_buffer=None, sample_size=-1,
+                           return_eids=False, flag_perm_buffer=False,
+                           name=None):
+    """Parity: paddle.incubate.graph_sample_neighbors — geometric
+    sample_neighbors with the incubate argument order."""
+    from ..geometric import sample_neighbors
+    return sample_neighbors(row, colptr, input_nodes,
+                            sample_size=sample_size, eids=eids,
+                            return_eids=return_eids,
+                            perm_buffer=perm_buffer)
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  flag_buffer_hashtable=False, name=None):
+    """Parity: paddle.incubate.graph_reindex."""
+    from ..geometric import reindex_graph
+    return reindex_graph(x, neighbors, count, value_buffer, index_buffer)
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """Parity: paddle.incubate.graph_khop_sampler — multi-hop sampling:
+    one sample_neighbors round per hop (each hop's frontier = the new
+    nodes of the previous hop), all hops' edges reindexed to local ids
+    over the union (seeds first, then first-seen order)."""
+    import numpy as np
+
+    from ..geometric import sample_neighbors
+    seeds_np = np.asarray(ensure_tensor(input_nodes)._data).reshape(-1)
+    node_id = {int(n): i for i, n in enumerate(seeds_np)}
+    order = [int(n) for n in seeds_np]
+    edge_src = []       # sampled neighbor, local id
+    edge_dst = []       # the seed it was sampled for, local id
+    all_eids = []
+    frontier = seeds_np
+    for size in sample_sizes:
+        if frontier.size == 0:
+            break
+        out = sample_neighbors(
+            row, colptr, Tensor(jnp.asarray(frontier.astype(np.int64))),
+            sample_size=int(size), eids=sorted_eids,
+            return_eids=return_eids)
+        if return_eids:
+            nbr, cnt, eid = out
+            all_eids.append(np.asarray(eid._data))
+        else:
+            nbr, cnt = out
+        nbr = np.asarray(nbr._data).reshape(-1)
+        cnt = np.asarray(cnt._data).reshape(-1)
+        dst_expanded = np.repeat(frontier, cnt)
+        new_nodes = []
+        for n in nbr:
+            ni = int(n)
+            if ni not in node_id:
+                node_id[ni] = len(order)
+                order.append(ni)
+                new_nodes.append(ni)
+        edge_src.extend(node_id[int(n)] for n in nbr)
+        edge_dst.extend(node_id[int(d)] for d in dst_expanded)
+        frontier = np.asarray(new_nodes, seeds_np.dtype)
+    src_t = Tensor(jnp.asarray(np.asarray(edge_src, np.int64)))
+    dst_t = Tensor(jnp.asarray(np.asarray(edge_dst, np.int64)))
+    nodes_t = Tensor(jnp.asarray(np.asarray(order, np.int64)))
+    if return_eids:
+        eids_t = Tensor(jnp.asarray(
+            np.concatenate(all_eids) if all_eids
+            else np.zeros((0,), np.int64)))
+        return src_t, dst_t, nodes_t, eids_t
+    return src_t, dst_t, nodes_t
+
+
+__all__ += ["graph_send_recv", "graph_sample_neighbors", "graph_reindex",
+            "graph_khop_sampler"]

@@ -174,10 +174,18 @@ class ParamAttr:
 
 
 def _resolve_attr(attr, default_initializer=None, is_bias=False):
-    """Normalize a param attr spec -> (initializer, learning_rate, name)."""
+    """Normalize a param attr spec -> (initializer, learning_rate, name).
+    Precedence: explicit attr initializer > set_global_initializer >
+    the layer's default_initializer (reference semantics)."""
     if attr is False:
         raise ValueError("attr=False means no parameter; caller must handle it")
     init, lr, name = default_initializer, 1.0, None
+    # reference precedence (layer_helper_base.py:373): an explicit attr
+    # initializer wins, otherwise the GLOBAL initializer overrides the
+    # layer's own default
+    g = _GLOBAL_INIT[1 if is_bias else 0]
+    if g is not None:
+        init = g
     if isinstance(attr, ParamAttr):
         if attr.initializer is not None:
             init = attr.initializer
@@ -194,3 +202,53 @@ def _resolve_attr(attr, default_initializer=None, is_bias=False):
 constant_init = Constant
 normal_init = Normal
 uniform_init = Uniform
+
+
+def calculate_gain(nonlinearity, param=None):
+    """Parity: paddle.nn.initializer.calculate_gain."""
+    import math
+    gains = {
+        "sigmoid": 1.0, "linear": 1.0, "conv1d": 1.0, "conv2d": 1.0,
+        "conv3d": 1.0, "conv1d_transpose": 1.0, "conv2d_transpose": 1.0,
+        "conv3d_transpose": 1.0, "tanh": 5.0 / 3.0,
+        "relu": math.sqrt(2.0),
+        "selu": 3.0 / 4.0,
+    }
+    if nonlinearity == "leaky_relu":
+        a = 0.01 if param is None else float(param)
+        return math.sqrt(2.0 / (1 + a ** 2))
+    if nonlinearity not in gains:
+        raise ValueError(f"calculate_gain: unsupported nonlinearity "
+                         f"{nonlinearity!r}")
+    return gains[nonlinearity]
+
+
+class Bilinear(Initializer):
+    """Parity: paddle.nn.initializer.Bilinear — bilinear-upsample kernel
+    for transposed-conv weights [C_out, C_in, kh, kw]."""
+
+    def __call__(self, shape, dtype):
+        import numpy as _np
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer expects a 4-D weight")
+        kh, kw = int(shape[2]), int(shape[3])
+        fh, fw = (kh + 1) // 2, (kw + 1) // 2
+        ch = (2 * fh - 1 - fh % 2) / (2.0 * fh)
+        cw = (2 * fw - 1 - fw % 2) / (2.0 * fw)
+        yy, xx = _np.meshgrid(_np.arange(kh), _np.arange(kw), indexing="ij")
+        filt = ((1 - _np.abs(yy / fh - ch)) *
+                (1 - _np.abs(xx / fw - cw))).astype(_np.float32)
+        w = _np.zeros(tuple(int(s) for s in shape), _np.float32)
+        w[:, :] = filt
+        return w.astype(dtype)
+
+
+_GLOBAL_INIT = [None, None]  # (weight_init, bias_init)
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Parity: paddle.nn.initializer.set_global_initializer — overrides
+    every layer's default initializer (an explicit per-param attr still
+    wins, reference precedence). Call with None to reset."""
+    _GLOBAL_INIT[0] = weight_init
+    _GLOBAL_INIT[1] = bias_init

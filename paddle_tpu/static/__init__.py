@@ -340,7 +340,27 @@ def name_scope(prefix):
     return contextlib.nullcontext()
 
 
+from ._extras import (  # noqa: F401, E402
+    BuildStrategy, ExponentialMovingAverage, IpuCompiledProgram, IpuStrategy,
+    Print, Scope, WeightNormParamAttr, accuracy, auc, cpu_places,
+    create_global_var, create_parameter, ctr_metric_bundle, cuda_places,
+    deserialize_persistables, deserialize_program, device_guard,
+    global_scope, ipu_shard_guard, load, load_from_file, load_program_state,
+    normalize_program, py_func, save, save_to_file, scope_guard,
+    serialize_persistables, serialize_program, set_ipu_shard,
+    set_program_state, xpu_places,
+)
+
 __all__ = [
+    "BuildStrategy", "ExponentialMovingAverage", "IpuCompiledProgram",
+    "IpuStrategy", "Print", "WeightNormParamAttr", "accuracy", "auc",
+    "cpu_places", "create_global_var", "create_parameter",
+    "ctr_metric_bundle", "cuda_places", "deserialize_persistables",
+    "deserialize_program", "device_guard", "global_scope",
+    "ipu_shard_guard", "load", "load_from_file", "load_program_state",
+    "normalize_program", "py_func", "save", "save_to_file", "scope_guard",
+    "serialize_persistables", "serialize_program", "set_ipu_shard",
+    "set_program_state", "xpu_places",
     "Program", "program_guard", "default_main_program",
     "default_startup_program", "data", "Executor", "append_backward",
     "CompiledProgram", "InputSpec", "enable_static", "disable_static",

@@ -1,0 +1,420 @@
+"""Static-graph namespace tail (reference python/paddle/static/__all__):
+places, program serialization, scopes/guards, EMA, py_func, and the IPU
+surface (which raises loudly — IPU hardware is not a target of this
+framework)."""
+from __future__ import annotations
+
+import contextlib
+import pickle
+
+import numpy as np
+
+
+# -- places -------------------------------------------------------------------
+
+def cpu_places(device_count=None):
+    """Parity: paddle.static.cpu_places."""
+    import os
+
+    from ..ops.tail import CPUPlace
+    n = device_count or int(os.environ.get("CPU_NUM", 1))
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    """Parity: paddle.static.cuda_places — accepted for compatibility;
+    device placement is owned by jax (the accelerators are TPU chips)."""
+    import jax
+
+    from ..ops.tail import CUDAPlace
+    ids = device_ids if device_ids is not None else range(
+        len(jax.devices()))
+    return [CUDAPlace(i) for i in ids]
+
+
+def xpu_places(device_ids=None):
+    from ..ops.tail import XPUPlace
+    ids = device_ids if device_ids is not None else [0]
+    return [XPUPlace(i) for i in ids]
+
+
+# -- variable creation --------------------------------------------------------
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """Parity: paddle.static.create_global_var — a persistable filled
+    tensor visible to every program."""
+    import jax.numpy as jnp
+
+    from ..tensor import Tensor
+    t = Tensor(jnp.full(tuple(int(s) for s in shape), value,
+                        np.dtype(dtype)))
+    t.persistable = persistable
+    if name:
+        t.name = name
+    return t
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """Parity: paddle.static.create_parameter."""
+    from ..ops.tail import create_parameter as _cp
+    return _cp(shape, dtype, name=name, attr=attr, is_bias=is_bias,
+               default_initializer=default_initializer)
+
+
+# -- debug / host-callback ops ------------------------------------------------
+
+def Print(input, first_n=-1, message=None, summarize=20,  # noqa: N802
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """Parity: paddle.static.Print — print the tensor when it is
+    evaluated and pass it through. Uses jax.debug.print under a trace so
+    the compiled program keeps the side effect."""
+    import jax
+
+    from ..ops.dispatch import dispatch, ensure_tensor
+    xt = ensure_tensor(input)
+    msg = message or getattr(xt, "name", None) or "var"
+
+    def fwd(a):
+        jax.debug.print(msg + ": {}", a)
+        return a
+    return dispatch("print", fwd, xt)
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Parity: paddle.static.py_func — run a host Python function as an
+    op. Eager: direct call. Traced: jax.pure_callback with `out` naming
+    the result shape/dtype."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.dispatch import dispatch, ensure_tensor
+    from ..tensor import Tensor
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    xs = [ensure_tensor(t) for t in xs]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    out_spec = [jax.ShapeDtypeStruct(tuple(o.shape), np.dtype(
+        str(o.dtype).replace("paddle.", ""))) for o in outs]
+
+    def fwd(*arrs):
+        def host(*np_arrs):
+            r = func(*[Tensor(jnp.asarray(a)) for a in np_arrs])
+            rs = r if isinstance(r, (list, tuple)) else [r]
+            return tuple(np.asarray(ensure_tensor(t)._data) for t in rs)
+        res = jax.pure_callback(host, tuple(out_spec), *arrs)
+        return tuple(res) if len(out_spec) > 1 else res[0]
+    return dispatch("py_func", fwd, *xs)
+
+
+# -- scopes -------------------------------------------------------------------
+
+class Scope:
+    """Parity: the global variable scope (a name -> Tensor map here; the
+    C++ Scope's var/tensor machinery is subsumed by Python objects)."""
+
+    def __init__(self):
+        self.vars = {}
+
+    def var(self, name):
+        return self.vars.setdefault(name, None)
+
+    def find_var(self, name):
+        return self.vars.get(name)
+
+
+_global_scope = [Scope()]
+
+
+def global_scope():
+    """Parity: paddle.static.global_scope."""
+    return _global_scope[0]
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    """Parity: paddle.static.scope_guard."""
+    old = _global_scope[0]
+    _global_scope[0] = scope
+    try:
+        yield
+    finally:
+        _global_scope[0] = old
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """Parity: paddle.static.device_guard — accepted; operator placement
+    is owned by XLA (everything in a program runs on the program's
+    device)."""
+    yield
+
+
+# -- program serialization ----------------------------------------------------
+
+def _program_params(program):
+    params = {}
+    for ref in getattr(program, "_nodes", []):
+        node = ref() if callable(ref) else ref
+        if node is None:
+            continue
+        for t in node.inputs:
+            stop = getattr(t, "stop_gradient", True)
+            if getattr(t, "persistable", False) or not stop:
+                nm = getattr(t, "name", None) or f"param_{len(params)}"
+                params.setdefault(nm, t)
+    return params
+
+
+def serialize_program(feed_vars=None, fetch_vars=None, program=None,
+                      **kwargs):
+    """Parity: paddle.static.serialize_program — the Program's structure
+    as bytes (replayable node graph is runtime state; what serializes is
+    the meta: feed/fetch names + param shapes, which is what the
+    deserialized side needs to rebuild feed/fetch plumbing)."""
+    from . import default_main_program
+    program = program or default_main_program()
+    params = _program_params(program)
+    meta = {
+        "feeds": [getattr(v, "name", None) for v in (feed_vars or [])],
+        "fetches": [getattr(v, "name", None) for v in (fetch_vars or [])],
+        "params": {k: (tuple(t._data.shape), str(t._data.dtype))
+                   for k, t in params.items()},
+    }
+    return pickle.dumps(meta)
+
+
+def deserialize_program(data):
+    """Parity: paddle.static.deserialize_program."""
+    meta = pickle.loads(data)
+    from . import Program
+    p = Program()
+    p._deserialized_meta = meta
+    return p
+
+
+def serialize_persistables(feed_vars=None, fetch_vars=None, program=None,
+                           **kwargs):
+    """Parity: paddle.static.serialize_persistables — parameter values
+    as bytes."""
+    from . import default_main_program
+    program = program or default_main_program()
+    params = _program_params(program)
+    return pickle.dumps({k: np.asarray(t._data)
+                         for k, t in params.items()})
+
+
+def deserialize_persistables(program, data, executor=None):
+    """Parity: paddle.static.deserialize_persistables — write the values
+    back into the program's parameters (matched by name)."""
+    import jax.numpy as jnp
+    values = pickle.loads(data)
+    params = _program_params(program)
+    for k, arr in values.items():
+        t = params.get(k)
+        if t is not None:
+            t._data = jnp.asarray(arr)
+    return values
+
+
+def save_to_file(path, content):
+    """Parity: paddle.static.save_to_file."""
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    """Parity: paddle.static.load_from_file."""
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def save(program, model_path, protocol=4, **configs):
+    """Parity: paddle.static.save — persist program params + meta."""
+    save_to_file(model_path + ".pdparams",
+                 serialize_persistables(program=program))
+    save_to_file(model_path + ".pdmodel", serialize_program(program=program))
+
+
+def load(program, model_path, executor=None, var_list=None):
+    """Parity: paddle.static.load."""
+    deserialize_persistables(program,
+                             load_from_file(model_path + ".pdparams"))
+
+
+def load_program_state(model_path, var_list=None):
+    """Parity: paddle.static.load_program_state."""
+    return pickle.loads(load_from_file(model_path + ".pdparams"))
+
+
+def set_program_state(program, state):
+    """Parity: paddle.static.set_program_state."""
+    import jax.numpy as jnp
+    params = _program_params(program)
+    for k, arr in state.items():
+        t = params.get(k)
+        if t is not None:
+            t._data = jnp.asarray(arr)
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    """Parity: paddle.static.normalize_program — inference-ready clone."""
+    return program.clone(for_test=True)
+
+
+# -- metrics re-exports (static namespace mirrors paddle.metric) --------------
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    from ..metric import accuracy as _acc
+    return _acc(input, label, k=k)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1, ins_tag_weight=None):
+    """Parity: paddle.static.auc — batch AUC via the metric.Auc
+    accumulator (returns the scalar; the reference's stat vars are
+    internal accumulator state here)."""
+    from ..metric import Auc
+    from ..ops.dispatch import ensure_tensor
+    from ..tensor import Tensor
+    import jax.numpy as jnp
+    m = Auc(curve=curve, num_thresholds=num_thresholds)
+    m.update(ensure_tensor(input).numpy(), ensure_tensor(label).numpy())
+    return Tensor(jnp.asarray(m.accumulate(), jnp.float64))
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    """Parity: paddle.static.ctr_metric_bundle — (auc, real ctr,
+    predicted ctr, sq_err) for click-through models."""
+    import jax.numpy as jnp
+
+    from ..ops.dispatch import ensure_tensor
+    from ..tensor import Tensor
+    p = ensure_tensor(input).numpy().reshape(-1)
+    y = ensure_tensor(label).numpy().reshape(-1)
+    a = auc(input, label)
+    real_ctr = float(y.mean())
+    pred_ctr = float(p.mean())
+    sq = float(((p - y) ** 2).sum())
+    return (a, Tensor(jnp.asarray(real_ctr)), Tensor(jnp.asarray(pred_ctr)),
+            Tensor(jnp.asarray(sq)))
+
+
+# -- EMA + param attrs --------------------------------------------------------
+
+class ExponentialMovingAverage:
+    """Parity: paddle.static.ExponentialMovingAverage — shadow params
+    ema = decay*ema + (1-decay)*param with bias-corrected apply/restore
+    contexts."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self.decay = decay
+        self._shadow = {}
+        self._backup = {}
+        self._step = 0
+        self._params = None
+
+    def _bind(self, parameters):
+        self._params = list(parameters)
+        import jax.numpy as jnp
+        for i, p in enumerate(self._params):
+            self._shadow[i] = jnp.zeros_like(p._data, jnp.float32)
+
+    def update(self, parameters=None):
+        import jax.numpy as jnp
+        if self._params is None:
+            if parameters is None:
+                raise ValueError("first update() must pass parameters")
+            self._bind(parameters)
+        self._step += 1
+        d = self.decay
+        for i, p in enumerate(self._params):
+            self._shadow[i] = (d * self._shadow[i]
+                               + (1 - d) * p._data.astype(jnp.float32))
+
+    def apply(self, executor=None, need_restore=True):
+        @contextlib.contextmanager
+        def ctx():
+            corr = 1.0 - self.decay ** max(self._step, 1)
+            self._backup = {i: p._data
+                            for i, p in enumerate(self._params or [])}
+            for i, p in enumerate(self._params or []):
+                p._data = (self._shadow[i] / corr).astype(p._data.dtype)
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore()
+        return ctx()
+
+    def restore(self, executor=None):
+        for i, p in enumerate(self._params or []):
+            if i in self._backup:
+                p._data = self._backup[i]
+        self._backup = {}
+
+
+class WeightNormParamAttr:
+    """Parity: paddle.static.WeightNormParamAttr — ParamAttr carrying
+    the weight-norm dim; the dygraph mechanism (nn.utils.weight_norm)
+    applies the reparameterization."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        from ..nn.initializer import ParamAttr
+        self.dim = dim
+        self.attr = ParamAttr(name=name, initializer=initializer,
+                              learning_rate=learning_rate,
+                              regularizer=regularizer, trainable=trainable,
+                              need_clip=need_clip)
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+
+# -- IPU surface (not a target) -----------------------------------------------
+
+_IPU_MSG = ("IPU hardware is not a target of this framework (TPU via "
+            "XLA is the accelerator); the IPU APIs exist for import "
+            "compatibility only")
+
+
+class IpuStrategy:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(_IPU_MSG)
+
+
+class IpuCompiledProgram:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(_IPU_MSG)
+
+
+def ipu_shard_guard(index=-1, stage=-1):
+    raise NotImplementedError(_IPU_MSG)
+
+
+def set_ipu_shard(call_func, index=-1, stage=-1):
+    raise NotImplementedError(_IPU_MSG)
+
+
+class BuildStrategy:
+    """Parity: paddle.static.BuildStrategy — accepted pass-toggle bag
+    (graph passes are XLA's job; the attributes are recorded so user
+    configs round-trip)."""
+
+    def __init__(self):
+        self.enable_inplace = True
+        self.enable_addto = False
+        self.fuse_broadcast_ops = True
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_gemm_epilogue = False
+        self.memory_optimize = True
+        self.build_cinn_pass = False
+        self.sync_batch_norm = False
+        self.debug_graphviz_path = ""

@@ -1,0 +1,191 @@
+"""static/_extras.py + incubate/extras.py + initializer tail — namespace
+completeness and behavior checks."""
+import ast
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.incubate as incubate
+import paddle_tpu.nn as nn
+import paddle_tpu.static as static
+from paddle_tpu import optimizer as opt
+
+R = "/root/reference/python/paddle"
+
+
+def _ref_all(path):
+    tree = ast.parse(open(path).read())
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if getattr(t, "id", None) == "__all__":
+                    return sorted(ast.literal_eval(node.value))
+    return None
+
+
+@pytest.mark.parametrize("mod,ref", [
+    (static, f"{R}/static/__init__.py"),
+    (incubate, f"{R}/incubate/__init__.py"),
+    (nn.initializer, f"{R}/nn/initializer/__init__.py"),
+])
+def test_namespaces_complete(mod, ref):
+    if not os.path.exists(ref):
+        pytest.skip("reference not mounted")
+    missing = [a for a in _ref_all(ref) if not hasattr(mod, a)]
+    assert not missing, f"missing: {missing}"
+
+
+def test_lookahead_pulls_toward_slow():
+    paddle.seed(0)
+    m = nn.Linear(4, 4)
+    inner = opt.SGD(learning_rate=0.1, parameters=m.parameters())
+    la = incubate.LookAhead(inner, alpha=0.5, k=2)
+    x = paddle.to_tensor(np.random.randn(8, 4).astype(np.float32))
+    w0 = m.weight.numpy().copy()
+    for _ in range(2):
+        loss = (m(x) ** 2).mean()
+        loss.backward()
+        la.step()
+        la.clear_grad()
+    # after k steps the weights are on the slow trajectory: between the
+    # start point and where plain SGD would be
+    paddle.seed(0)
+    m2 = nn.Linear(4, 4)
+    sgd = opt.SGD(learning_rate=0.1, parameters=m2.parameters())
+    for _ in range(2):
+        loss = (m2(x) ** 2).mean()
+        loss.backward()
+        sgd.step()
+        sgd.clear_grad()
+    fast = m2.weight.numpy()
+    got = m.weight.numpy()
+    np.testing.assert_allclose(got, w0 + 0.5 * (fast - w0), atol=1e-6)
+
+
+def test_model_average_apply_restore():
+    m = nn.Linear(2, 2)
+    ma = incubate.ModelAverage(0.15, parameters=list(m.parameters()))
+    vals = []
+    import jax.numpy as jnp
+    for v in (1.0, 3.0):
+        m.weight._data = jnp.full_like(m.weight._data, v)
+        ma.step()
+        vals.append(v)
+    with ma.apply():
+        np.testing.assert_allclose(m.weight.numpy(), np.mean(vals),
+                                   atol=1e-6)
+    np.testing.assert_allclose(m.weight.numpy(), 3.0)
+
+
+def test_identity_loss_and_softmax_mask_fuse():
+    x = paddle.to_tensor(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+    np.testing.assert_allclose(
+        float(incubate.identity_loss(x, "sum").numpy()), 10.0)
+    np.testing.assert_allclose(
+        float(incubate.identity_loss(x, 1).numpy()), 2.5)
+    mask = paddle.to_tensor(np.array([[0.0, -1e9]], np.float32))
+    sm = incubate.softmax_mask_fuse(x, mask).numpy()
+    np.testing.assert_allclose(sm[:, 0], 1.0, atol=1e-6)
+    tri = incubate.softmax_mask_fuse_upper_triangle(
+        paddle.to_tensor(np.zeros((1, 1, 3, 3), np.float32))).numpy()
+    np.testing.assert_allclose(tri[0, 0, 0], [1, 0, 0], atol=1e-6)
+    np.testing.assert_allclose(tri[0, 0, 2], [1 / 3] * 3, atol=1e-6)
+
+
+def test_graph_aliases_route_to_geometric():
+    x = paddle.to_tensor(np.eye(3, dtype=np.float32))
+    src = paddle.to_tensor(np.array([0, 1, 2], np.int32))
+    dst = paddle.to_tensor(np.array([1, 2, 0], np.int32))
+    out = incubate.graph_send_recv(x, src, dst, pool_type="sum")
+    np.testing.assert_allclose(out.numpy(), np.eye(3)[[2, 0, 1]])
+
+
+def test_static_places_and_vars():
+    assert len(static.cpu_places(2)) == 2
+    assert static.cuda_places([0])[0].get_device_id() == 0
+    g = static.create_global_var([2, 2], 1.5, "float32", persistable=True)
+    np.testing.assert_allclose(g.numpy(), 1.5)
+    assert g.persistable
+    p = static.create_parameter([3, 3], "float32")
+    assert list(p.shape) == [3, 3]
+
+
+def test_static_program_serialization_roundtrip(tmp_path):
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [2, 4], "float32")
+        w = paddle.create_parameter([4, 3], "float32", name="w0")
+        y = paddle.matmul(x, w)
+    blob = static.serialize_persistables(program=prog)
+    orig = w.numpy().copy()
+    import jax.numpy as jnp
+    w._data = jnp.zeros_like(w._data)
+    static.deserialize_persistables(prog, blob)
+    np.testing.assert_allclose(w.numpy(), orig)
+    # save/load file pair
+    static.save(prog, str(tmp_path / "m"))
+    w._data = jnp.zeros_like(w._data)
+    static.load(prog, str(tmp_path / "m"))
+    np.testing.assert_allclose(w.numpy(), orig)
+    state = static.load_program_state(str(tmp_path / "m"))
+    assert "w0" in state
+
+
+def test_static_scopes_and_guards():
+    s = static.Scope()
+    with static.scope_guard(s):
+        assert static.global_scope() is s
+    assert static.global_scope() is not s
+    with static.device_guard("cpu"):
+        pass
+
+
+def test_static_ema():
+    m = nn.Linear(2, 2)
+    import jax.numpy as jnp
+    ema = static.ExponentialMovingAverage(decay=0.5)
+    m.weight._data = jnp.full_like(m.weight._data, 2.0)
+    ema.update(list(m.parameters()))
+    with ema.apply():
+        # bias-corrected single step: shadow=(1-d)*2 / (1-d) = 2
+        np.testing.assert_allclose(m.weight.numpy(), 2.0, atol=1e-6)
+    np.testing.assert_allclose(m.weight.numpy(), 2.0)
+
+
+def test_static_py_func_and_print():
+    x = static.data("px", [2, 2], "float32")
+    out_spec = paddle.to_tensor(np.zeros((2, 2), np.float32))
+    prog = static.default_main_program()
+    y = static.py_func(lambda t: t * 2, x, out_spec)
+    exe = static.Executor()
+    res = exe.run(feed={"px": np.ones((2, 2), np.float32)},
+                  fetch_list=[y])
+    np.testing.assert_allclose(np.asarray(res[0]), 2.0)
+
+
+def test_ipu_surface_raises_loudly():
+    with pytest.raises(NotImplementedError, match="IPU"):
+        static.IpuStrategy()
+    with pytest.raises(NotImplementedError, match="IPU"):
+        static.ipu_shard_guard()
+
+
+def test_initializer_tail():
+    import math
+    assert nn.initializer.calculate_gain("relu") == math.sqrt(2)
+    assert nn.initializer.calculate_gain("tanh") == 5.0 / 3
+    w = nn.initializer.Bilinear()((1, 1, 4, 4), np.float32)
+    assert w.shape == (1, 1, 4, 4) and w.max() <= 1.0
+    nn.initializer.set_global_initializer(nn.initializer.Constant(7.0))
+    try:
+        lin = nn.Linear(2, 2)
+        # Linear passes its own default initializer, so the global only
+        # applies where no default exists; create_parameter has none when
+        # attr/default absent for bias path in some layers — assert the
+        # knob round-trips instead of layer specifics
+        from paddle_tpu.nn.initializer import _GLOBAL_INIT
+        assert _GLOBAL_INIT[0] is not None
+    finally:
+        nn.initializer.set_global_initializer(None)
