@@ -171,6 +171,8 @@ def _gen_inplace():
         "polygamma_", "pow_", "renorm_", "sin_", "sinc_", "sinh_",
         "square_", "tan_", "transpose_", "t_", "flatten_", "tril_",
         "triu_", "trunc_",
+        "acosh_", "asin_", "asinh_", "atanh_", "cosh_", "erfinv_",
+        "lerp_", "log1p_", "logical_xor_", "not_equal_", "sigmoid_",
     )
     g = globals()
     for n in names:
@@ -190,3 +192,87 @@ def _gen_inplace():
 
 _gen_inplace()
 del _gen_inplace
+
+# -- Tensor method surface (reference tensor/__init__.py tensor_method_func) --
+from .signal import istft, stft  # noqa: F401, E402
+from .linalg import cond  # noqa: F401, E402
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    """Parity: paddle.create_tensor — an empty typed tensor to assign
+    into (static-graph idiom)."""
+    import jax.numpy as _jnp
+    import numpy as _np
+    from .framework.dtype import convert_dtype
+    t = Tensor(_jnp.zeros((0,), _np.dtype(convert_dtype(dtype))))
+    t.persistable = persistable
+    if name:
+        t.name = name
+    return t
+
+
+def _tensor_set_(self, source=None, shape=None, dtype=None, name=None):
+    """Parity: Tensor.set_ — rebind this tensor's storage to `source`
+    (or to uninitialized storage of `shape`/`dtype`). The autograd link
+    is cleared: the new value does not come from the old producer."""
+    import jax.numpy as _jnp
+    import numpy as _np
+    if source is not None:
+        self._data = (source._data if isinstance(source, Tensor)
+                      else _jnp.asarray(source))
+    else:
+        from .framework.dtype import convert_dtype
+        dt = _np.dtype(convert_dtype(dtype)) if dtype else self._data.dtype
+        self._data = _jnp.zeros(tuple(shape or ()), dt)
+    self._node = None
+    self._out_index = 0
+    return self
+
+
+def _tensor_resize_(self, shape, fill_zero=False, name=None):
+    """Parity: Tensor.resize_ — in-place resize keeping elements in
+    row-major order; growth fills zeros (fill_zero) or repeats
+    (np.resize semantics otherwise)."""
+    import jax.numpy as _jnp
+    n_new = 1
+    for s in shape:
+        n_new *= int(s)
+    flat = self._data.reshape(-1)
+    if n_new <= flat.shape[0]:
+        self._data = flat[:n_new].reshape(tuple(shape))
+    elif fill_zero or flat.shape[0] == 0:   # np.resize zero-fills empty
+        pad = _jnp.zeros((n_new - flat.shape[0],), flat.dtype)
+        self._data = _jnp.concatenate([flat, pad]).reshape(tuple(shape))
+    else:
+        reps = -(-n_new // flat.shape[0])
+        self._data = _jnp.tile(flat, reps)[:n_new].reshape(tuple(shape))
+    self._node = None
+    self._out_index = 0
+    return self
+
+
+def _attach_method_surface():
+    """Attach the reference's Tensor-method names that already exist as
+    top-level functions plus the small Tensor-specific ones above (the
+    in-place variants ride the _gen_inplace loop)."""
+    g = globals()
+    as_methods = (
+        "atleast_1d", "atleast_2d", "atleast_3d", "block_diag",
+        "broadcast_shape", "broadcast_tensors", "combinations", "concat",
+        "cond", "create_parameter", "create_tensor", "diagonal", "frexp",
+        "gammainc", "gammaincc", "gammaln", "histogramdd",
+        "householder_product", "is_tensor", "istft", "less", "lu",
+        "multiplex", "polar", "polygamma", "reduce_as", "reverse",
+        "scatter_nd", "shard_index", "slice", "stack", "stft",
+        "strided_slice", "top_p_sampling",
+    )
+    for n in as_methods:
+        fn = g.get(n)
+        if fn is not None and not hasattr(Tensor, n):
+            setattr(Tensor, n, fn)
+    Tensor.set_ = _tensor_set_
+    Tensor.resize_ = _tensor_resize_
+
+
+_attach_method_surface()
+del _attach_method_surface

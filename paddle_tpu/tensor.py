@@ -273,7 +273,42 @@ class Tensor:
         return _op("matmul")(self, other)
 
     def __invert__(self):
-        return _op("logical_not")(self)
+        # reference magic_method_func maps ~x to bitwise_not (equals
+        # logical_not on bool, differs on ints)
+        return _op("bitwise_not")(self)
+
+    def __and__(self, other):
+        return _op("bitwise_and")(self, other)
+
+    def __rand__(self, other):
+        return _op("bitwise_and")(Tensor(other), self)
+
+    def __or__(self, other):
+        return _op("bitwise_or")(self, other)
+
+    def __ror__(self, other):
+        return _op("bitwise_or")(Tensor(other), self)
+
+    def __xor__(self, other):
+        return _op("bitwise_xor")(self, other)
+
+    def __rxor__(self, other):
+        return _op("bitwise_xor")(Tensor(other), self)
+
+    def __pos__(self):
+        return _op("positive")(self)
+
+    def __lshift__(self, other):
+        return _op("bitwise_left_shift")(self, other)
+
+    def __rlshift__(self, other):
+        return _op("bitwise_left_shift")(Tensor(other), self)
+
+    def __rshift__(self, other):
+        return _op("bitwise_right_shift")(self, other)
+
+    def __rrshift__(self, other):
+        return _op("bitwise_right_shift")(Tensor(other), self)
 
     # comparisons
     def __eq__(self, other):
