@@ -1,1 +1,1 @@
-from . import activation, common, container_stub, conv, layers, loss, norm, pooling  # noqa: F401
+from . import activation, common, conv, layers, loss, norm, pooling  # noqa: F401
