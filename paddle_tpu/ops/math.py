@@ -252,33 +252,41 @@ def cumprod(x, dim=None, dtype=None, name=None):
     return dispatch("cumprod", fwd, ensure_tensor(x))
 
 
-def cummax(x, axis=None, dtype="int64", name=None):
-    ax = -1 if axis is None else int(axis)
+def _cum_axis(axis, ndim):
+    """Validate + normalize a cumulative-op axis (lax's autodiff path
+    rejects negative axes; out-of-range must raise, not wrap)."""
+    ax = int(axis)
+    if not -ndim <= ax < ndim:
+        raise ValueError(f"axis {ax} out of range for a {ndim}-D tensor")
+    return ax % ndim
+
+
+def _cum_minmax(x, axis, dtype, lax_op, op_name):
     xt = ensure_tensor(x)
+
+    def fwd(v):
+        a = v.reshape(-1) if axis is None else v
+        ax = (a.ndim - 1) if axis is None else _cum_axis(axis, a.ndim)
+        return lax_op(a, axis=ax)
+    values = dispatch(op_name, fwd, xt)
     a = xt._data.reshape(-1) if axis is None else xt._data
-    values = dispatch("cummax", lambda v: lax.cummax(v, axis=ax),
-                      Tensor(a) if axis is None else xt)
-    # Running argmax: positions where value equals the running max, cummax of iota.
-    iota = jnp.arange(a.shape[ax]).reshape([-1 if i == (ax % a.ndim) else 1
+    ax = (a.ndim - 1) if axis is None else _cum_axis(axis, a.ndim)
+    # Running argmax/argmin: positions where the value equals the running
+    # extreme, cummax of iota (indices need no grad — computed off-tape).
+    iota = jnp.arange(a.shape[ax]).reshape([-1 if i == ax else 1
                                             for i in range(a.ndim)])
     iota = jnp.broadcast_to(iota, a.shape)
     indices = lax.cummax(jnp.where(a == values._data, iota, -1), axis=ax)
     from ..framework.dtype import convert_dtype
     return values, Tensor(indices.astype(convert_dtype(dtype)))
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    return _cum_minmax(x, axis, dtype, lax.cummax, "cummax")
 
 
 def cummin(x, axis=None, dtype="int64", name=None):
-    ax = -1 if axis is None else int(axis)
-    xt = ensure_tensor(x)
-    a = xt._data.reshape(-1) if axis is None else xt._data
-    values = dispatch("cummin", lambda v: lax.cummin(v, axis=ax),
-                      Tensor(a) if axis is None else xt)
-    iota = jnp.arange(a.shape[ax]).reshape([-1 if i == (ax % a.ndim) else 1
-                                            for i in range(a.ndim)])
-    iota = jnp.broadcast_to(iota, a.shape)
-    indices = lax.cummax(jnp.where(a == values._data, iota, -1), axis=ax)
-    from ..framework.dtype import convert_dtype
-    return values, Tensor(indices.astype(convert_dtype(dtype)))
+    return _cum_minmax(x, axis, dtype, lax.cummin, "cummin")
 
 
 def logcumsumexp(x, axis=None, name=None):
@@ -287,7 +295,7 @@ def logcumsumexp(x, axis=None, name=None):
             a = a.reshape(-1)
             ax = 0
         else:
-            ax = int(axis)
+            ax = _cum_axis(axis, a.ndim)
         return lax.cumlogsumexp(a, axis=ax)
     return dispatch("logcumsumexp", fwd, ensure_tensor(x))
 
