@@ -1,0 +1,46 @@
+"""Export a model to a StableHLO serving artifact + ONNX, then serve it
+through the Predictor pool.
+
+Run: python examples/export_and_infer.py
+"""
+import os
+import tempfile
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def main():
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(16, 64), nn.ReLU(), nn.Linear(64, 4))
+    model.eval()
+    x = paddle.to_tensor(np.random.randn(3, 16).astype(np.float32))
+    ref = model(x).numpy()
+
+    d = tempfile.mkdtemp()
+    path = os.path.join(d, "mlp")
+    paddle.jit.save(model, path,
+                    input_spec=[paddle.jit.InputSpec([None, 16],
+                                                     "float32")])
+
+    from paddle_tpu import inference
+    cfg = inference.Config(path + ".pdmodel", path + ".pdiparams")
+    predictor = inference.create_predictor(cfg)
+    inp = predictor.get_input_handle(predictor.get_input_names()[0])
+    inp.copy_from_cpu(x.numpy())
+    predictor.run()
+    out = predictor.get_output_handle(
+        predictor.get_output_names()[0]).copy_to_cpu()
+    print("serving matches eager:", np.allclose(out, ref, atol=1e-5))
+
+    onnx_path = paddle.onnx.export(
+        model, os.path.join(d, "mlp_onnx"),
+        input_spec=[paddle.jit.InputSpec([3, 16], "float32")])
+    print("onnx artifact:", os.path.basename(onnx_path),
+          os.path.getsize(onnx_path), "bytes")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,44 @@
+"""Train a small CNN on synthetic MNIST-shaped data.
+
+The reference user experience (paddle.vision + nn + optimizer + io
+DataLoader) on this framework — swap `import paddle` for
+`import paddle_tpu as paddle` and the script is the same.
+
+Run: python examples/train_mnist_cnn.py
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import optimizer as opt
+from paddle_tpu.io import DataLoader, TensorDataset
+
+
+def main():
+    paddle.seed(0)
+    rng = np.random.default_rng(0)
+    images = paddle.to_tensor(
+        rng.standard_normal((256, 1, 28, 28)).astype(np.float32))
+    labels = paddle.to_tensor(rng.integers(0, 10, (256,)).astype(np.int64))
+    loader = DataLoader(TensorDataset([images, labels]), batch_size=64,
+                        shuffle=True)
+
+    model = nn.Sequential(
+        nn.Conv2D(1, 8, 3, padding=1), nn.ReLU(), nn.MaxPool2D(2, 2),
+        nn.Conv2D(8, 16, 3, padding=1), nn.ReLU(), nn.MaxPool2D(2, 2),
+        nn.Flatten(), nn.Linear(16 * 7 * 7, 10),
+    )
+    optimizer = opt.AdamW(learning_rate=1e-3, parameters=model.parameters())
+    loss_fn = nn.CrossEntropyLoss()
+
+    for epoch in range(2):
+        for x, y in loader:
+            loss = loss_fn(model(x), y)
+            loss.backward()
+            optimizer.step()
+            optimizer.clear_grad()
+        print(f"epoch {epoch}: loss={float(loss.numpy()):.4f}")
+
+
+if __name__ == "__main__":
+    main()
