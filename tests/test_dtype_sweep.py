@@ -1,0 +1,101 @@
+"""OpTest dtype-sweep analog (reference OpTestTool fp16/bf16 sweeps,
+test/legacy_test/op_test.py:4043): key ops and layers run in bfloat16 /
+float16 and must track their fp32 results within the format's tolerance.
+On TPU bf16 is the native matmul dtype, so this sweep is the first line
+of defense against silent upcast/downcast bugs in the dispatch chain."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+from test_op_gradcheck import BINARY_CASES, REDUCE_CASES, UNARY_CASES
+
+# bf16 has ~3 decimal digits; fp16 ~3.3. Relative tolerances sized to a
+# couple of ulps through one op.
+TOLS = {"bfloat16": dict(rtol=2e-2, atol=2e-2),
+        "float16": dict(rtol=5e-3, atol=5e-3)}
+
+
+def _run(fn, arrays, dtype):
+    outs = fn(*[paddle.to_tensor(a.astype(np.float32)).astype(dtype)
+                for a in arrays])
+    out = outs if isinstance(outs, paddle.Tensor) else outs[0]
+    return out.astype("float32").numpy()
+
+
+@pytest.mark.parametrize("dtype", ["bfloat16", "float16"])
+@pytest.mark.parametrize("name,fn,x", UNARY_CASES,
+                         ids=[c[0] for c in UNARY_CASES])
+def test_unary_dtype_parity(dtype, name, fn, x):
+    if name in ("lgamma", "digamma", "erfinv"):
+        pytest.skip("special functions evaluate in fp32 internally")
+    ref = _run(fn, [x], "float32")
+    got = _run(fn, [x], dtype)
+    np.testing.assert_allclose(got, ref, **TOLS[dtype])
+
+
+@pytest.mark.parametrize("dtype", ["bfloat16", "float16"])
+@pytest.mark.parametrize("name,fn,a,b", BINARY_CASES,
+                         ids=[c[0] for c in BINARY_CASES])
+def test_binary_dtype_parity(dtype, name, fn, a, b):
+    ref = _run(fn, [a, b], "float32")
+    got = _run(fn, [a, b], dtype)
+    np.testing.assert_allclose(got, ref, **TOLS[dtype])
+
+
+@pytest.mark.parametrize("dtype", ["bfloat16", "float16"])
+@pytest.mark.parametrize("name,fn,x", REDUCE_CASES,
+                         ids=[c[0] for c in REDUCE_CASES])
+def test_reduce_dtype_parity(dtype, name, fn, x):
+    ref = _run(fn, [x], "float32")
+    got = _run(fn, [x], dtype)
+    np.testing.assert_allclose(got, ref, **TOLS[dtype])
+
+
+@pytest.mark.parametrize("dtype", ["bfloat16", "float16"])
+def test_layer_dtype_parity(dtype):
+    paddle.seed(0)
+    rng = np.random.default_rng(0)
+    x32 = paddle.to_tensor(rng.standard_normal((4, 16)).astype(np.float32))
+
+    m = nn.Sequential(nn.Linear(16, 32), nn.GELU(), nn.LayerNorm(32),
+                      nn.Linear(32, 8))
+    m.eval()
+    ref = m(x32).numpy()
+    # cast params in place (Layer.bfloat16()/half() surface)
+    getattr(m, "bfloat16" if dtype == "bfloat16" else "half")()
+    got = m(x32.astype(dtype)).astype("float32").numpy()
+    np.testing.assert_allclose(got, ref, rtol=5e-2, atol=5e-2)
+
+
+@pytest.mark.parametrize("dtype", ["bfloat16"])
+def test_attention_dtype_parity(dtype):
+    rng = np.random.default_rng(1)
+    q, k, v = (rng.standard_normal((1, 8, 2, 16)).astype(np.float32)
+               for _ in range(3))
+
+    def sdpa(qq, kk, vv):
+        return F.scaled_dot_product_attention(qq, kk, vv, is_causal=True,
+                                              allow_flash=False)
+    ref = _run(sdpa, [q, k, v], "float32")
+    got = _run(sdpa, [q, k, v], dtype)
+    np.testing.assert_allclose(got, ref, rtol=3e-2, atol=3e-2)
+
+
+def test_bf16_matmul_accumulates_fp32():
+    """The MXU contract: bf16 operands, fp32 accumulation — a long
+    contraction must NOT lose precision to bf16 partial sums."""
+    n = 4096
+    a = np.full((1, n), 1.0, np.float32)
+    b = np.full((n, 1), 0.001, np.float32)
+    got = float(paddle.matmul(
+        paddle.to_tensor(a).astype("bfloat16"),
+        paddle.to_tensor(b).astype("bfloat16")).astype("float32")
+        .numpy().reshape(()))
+    # bf16 partial sums would drift far from n*0.001 (0.001 rounds to
+    # ~0.001007 in bf16; fp32 accumulation keeps the sum near n*that)
+    import ml_dtypes
+    expect = n * float(np.asarray(0.001).astype(ml_dtypes.bfloat16))
+    np.testing.assert_allclose(got, expect, rtol=5e-3)
