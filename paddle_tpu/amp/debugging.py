@@ -1,0 +1,168 @@
+"""paddle.amp.debugging (reference python/paddle/amp/debugging.py):
+numerical-fault tooling — tensor checking, per-op stats, accuracy
+comparison. Rides the framework's existing NaN/Inf machinery
+(FLAGS_check_nan_inf; eager + compiled via debug callbacks)."""
+from __future__ import annotations
+
+import contextlib
+from enum import Enum
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import flags as _flags
+from ..ops.dispatch import ensure_tensor
+
+
+class DebugMode(Enum):
+    """Parity: amp.debugging.DebugMode."""
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL_FOR_OVERFLOW = 2
+    CHECK_ALL = 3
+    CHECK_ALL_AND_ABORT = 4
+    DUMP_ALL = 5
+
+
+class TensorCheckerConfig:
+    """Parity: amp.debugging.TensorCheckerConfig."""
+
+    def __init__(self, enable=True,
+                 debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT,
+                 output_dir=None, checked_op_list=None,
+                 skipped_op_list=None, debug_step=None, stack_height_limit=1):
+        self.enable = enable
+        self.debug_mode = debug_mode
+        self.output_dir = output_dir
+        self.checked_op_list = checked_op_list
+        self.skipped_op_list = skipped_op_list
+        self.debug_step = debug_step
+        self.stack_height_limit = stack_height_limit
+
+
+def check_numerics(tensor, op_type="", var_name="", debug_mode=None):
+    """Parity: amp.debugging.check_numerics — (num_nan, num_inf,
+    num_zero) and raise on nan/inf when the mode aborts."""
+    from ..tensor import Tensor
+    a = ensure_tensor(tensor)._data.astype(jnp.float32)
+    n_nan = int(jnp.sum(jnp.isnan(a)))
+    n_inf = int(jnp.sum(jnp.isinf(a)))
+    n_zero = int(jnp.sum(a == 0))
+    if debug_mode in (None, DebugMode.CHECK_NAN_INF_AND_ABORT,
+                      DebugMode.CHECK_ALL_AND_ABORT) and (n_nan or n_inf):
+        raise FloatingPointError(
+            f"check_numerics: {op_type}:{var_name} has {n_nan} nan / "
+            f"{n_inf} inf values")
+    mk = lambda v: Tensor(jnp.asarray(v, jnp.int64))
+    return mk(n_nan), mk(n_inf), mk(n_zero)
+
+
+_op_stats = [None]
+
+
+def enable_operator_stats_collection():
+    """Parity: collect per-op call counts by dtype through the dispatch
+    chokepoint's stats hook."""
+    from ..ops.dispatch import _stats_hook
+    stats = {}
+
+    def counting(name, ts):
+        try:
+            first = ts[0]._data.dtype if ts else None
+            stats[f"{name}({first})"] = stats.get(
+                f"{name}({first})", 0) + 1
+        except Exception:  # noqa: BLE001 - stats must never break dispatch
+            pass
+    _stats_hook[0] = counting
+    _op_stats[0] = stats
+
+
+def disable_operator_stats_collection():
+    from ..ops.dispatch import _stats_hook
+    if _op_stats[0] is None:
+        return
+    stats = _op_stats[0]
+    _stats_hook[0] = None
+    _op_stats[0] = None
+    print("<------------------- op list ------------------->")
+    for k in sorted(stats):
+        print(f"  {k}: {stats[k]} calls")
+    print("<----------------------------------------------->")
+    return stats
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    """Parity: amp.debugging.collect_operator_stats."""
+    enable_operator_stats_collection()
+    try:
+        yield
+    finally:
+        disable_operator_stats_collection()
+
+
+_checker = [None]
+
+
+def enable_tensor_checker(checker_config: TensorCheckerConfig):
+    """Parity: enable_tensor_checker — turns on the framework NaN/Inf
+    check flag (eager + compiled paths consume it)."""
+    _checker[0] = checker_config
+    if checker_config.enable:
+        _flags.set_flags({"FLAGS_check_nan_inf": True})
+
+
+def disable_tensor_checker():
+    _checker[0] = None
+    _flags.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def compare_accuracy(dump_path, another_dump_path, output_filename,
+                     loss_scale=1, dump_all_tensors=False):
+    """Parity: amp.debugging.compare_accuracy — diff two tensor-dump
+    dirs (np .npy dumps) into a CSV report."""
+    import csv
+    import os
+    rows = []
+    names = sorted(set(os.listdir(dump_path))
+                   & set(os.listdir(another_dump_path)))
+    for n in names:
+        if not n.endswith(".npy"):
+            continue
+        a = np.load(os.path.join(dump_path, n))
+        b = np.load(os.path.join(another_dump_path, n))
+        if a.shape != b.shape:
+            rows.append([n, "shape mismatch", a.shape, b.shape])
+            continue
+        d = np.abs(a.astype(np.float64) - b.astype(np.float64))
+        rows.append([n, "ok", float(d.max()), float(d.mean())])
+    with open(output_filename, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["tensor", "status", "max_abs_diff", "mean_abs_diff"])
+        w.writerows(rows)
+    return rows
+
+
+def check_layer_numerics(func):
+    """Parity: @check_layer_numerics — decorator validating a layer
+    forward's inputs/outputs for nan/inf."""
+    import functools
+
+    @functools.wraps(func)
+    def wrapper(self, *args, **kwargs):
+        for i, a in enumerate(args):
+            if hasattr(a, "_data"):
+                check_numerics(a, type(self).__name__, f"input{i}")
+        out = func(self, *args, **kwargs)
+        if hasattr(out, "_data"):
+            check_numerics(out, type(self).__name__, "output")
+        return out
+    return wrapper
+
+
+__all__ = ["DebugMode", "TensorCheckerConfig", "check_numerics",
+           "enable_operator_stats_collection",
+           "disable_operator_stats_collection", "collect_operator_stats",
+           "enable_tensor_checker", "disable_tensor_checker",
+           "compare_accuracy", "check_layer_numerics"]

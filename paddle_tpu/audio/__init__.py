@@ -15,3 +15,5 @@ from .features import (  # noqa: F401
 
 __all__ = ["datasets", "functional", "features", "Spectrogram",
            "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+from . import backends  # noqa: F401, E402
+from .backends import info, load, save  # noqa: F401, E402

@@ -1,0 +1,100 @@
+"""paddle.audio.backends (reference python/paddle/audio/backends/):
+wave-file IO. The 'wave' backend is stdlib-based (16/32-bit PCM WAV read
++ write) — the reference's soundfile backend is an optional extra there
+too, and this image ships no soundfile."""
+from __future__ import annotations
+
+import wave as _wave
+from dataclasses import dataclass
+
+import numpy as np
+
+
+@dataclass
+class AudioInfo:
+    """Parity: backend info() result (sample rate, frames, channels,
+    bits per sample)."""
+    sample_rate: int
+    num_samples: int
+    num_channels: int
+    bits_per_sample: int
+    encoding: str = "PCM_S"
+
+
+def list_available_backends():
+    """Parity: paddle.audio.backends.list_available_backends."""
+    return ["wave_backend"]
+
+
+def get_current_backend():
+    return "wave_backend"
+
+
+def set_backend(backend_name: str):
+    if backend_name != "wave_backend":
+        raise NotImplementedError(
+            f"backend {backend_name!r}: only the stdlib wave backend is "
+            "available in this image (soundfile is not installed)")
+
+
+def info(filepath: str) -> AudioInfo:
+    """Parity: paddle.audio.info."""
+    with _wave.open(str(filepath), "rb") as w:
+        return AudioInfo(sample_rate=w.getframerate(),
+                         num_samples=w.getnframes(),
+                         num_channels=w.getnchannels(),
+                         bits_per_sample=8 * w.getsampwidth())
+
+
+def load(filepath: str, frame_offset: int = 0, num_frames: int = -1,
+         normalize: bool = True, channels_first: bool = True):
+    """Parity: paddle.audio.load — returns (waveform Tensor, sample_rate).
+    normalize=True scales PCM to [-1, 1] float32."""
+    import jax.numpy as jnp
+
+    from ..tensor import Tensor
+    with _wave.open(str(filepath), "rb") as w:
+        sr = w.getframerate()
+        nch = w.getnchannels()
+        width = w.getsampwidth()
+        w.setpos(min(frame_offset, w.getnframes()))
+        n = w.getnframes() - frame_offset if num_frames < 0 else num_frames
+        raw = w.readframes(max(n, 0))
+    dt = {1: np.uint8, 2: np.int16, 4: np.int32}.get(width)
+    if dt is None:
+        raise ValueError(f"unsupported WAV sample width {width}")
+    data = np.frombuffer(raw, dt).reshape(-1, nch)
+    if normalize:
+        if dt == np.uint8:       # unsigned 8-bit PCM centers at 128
+            out = (data.astype(np.float32) - 128.0) / 128.0
+        else:
+            out = data.astype(np.float32) / float(2 ** (8 * width - 1))
+    else:
+        out = data               # raw PCM samples, untouched
+    if channels_first:
+        out = out.T
+    return Tensor(jnp.asarray(np.ascontiguousarray(out))), sr
+
+
+def save(filepath: str, src, sample_rate: int, channels_first: bool = True,
+         encoding: str = "PCM_16", bits_per_sample: int = 16):
+    """Parity: paddle.audio.save — float waveform in [-1, 1] to 16-bit
+    PCM WAV."""
+    arr = np.asarray(src.numpy() if hasattr(src, "numpy") else src)
+    if arr.ndim == 1:
+        arr = arr[None] if channels_first else arr[:, None]
+    if channels_first:
+        arr = arr.T                               # -> [frames, channels]
+    if bits_per_sample != 16:
+        raise NotImplementedError("the wave backend writes 16-bit PCM")
+    pcm = np.clip(np.round(arr.astype(np.float64) * 32767), -32768,
+                  32767).astype("<i2")
+    with _wave.open(str(filepath), "wb") as w:
+        w.setnchannels(arr.shape[1])
+        w.setsampwidth(2)
+        w.setframerate(int(sample_rate))
+        w.writeframes(pcm.tobytes())
+
+
+__all__ = ["AudioInfo", "list_available_backends", "get_current_backend",
+           "set_backend", "info", "load", "save"]

@@ -19,8 +19,9 @@ from ..incubate.segment_ops import (  # noqa: F401
 )
 
 __all__ = ["send_u_recv", "send_ue_recv", "send_uv", "sample_neighbors",
-           "reindex_graph", "segment_sum", "segment_mean", "segment_max",
-           "segment_min", "weighted_sample_neighbors"]
+           "reindex_graph", "reindex_heter_graph", "segment_sum",
+           "segment_mean", "segment_max", "segment_min",
+           "weighted_sample_neighbors"]
 
 
 def _host_rng():
@@ -215,3 +216,43 @@ def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
     return (Tensor(jnp.asarray(src.astype(dtype))),
             Tensor(jnp.asarray(dst.astype(dtype))),
             Tensor(jnp.asarray(np.asarray(out_nodes, dtype))))
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """Parity: geometric.reindex_heter_graph (reindex.py heterogeneous
+    form): `neighbors`/`count` are per-edge-type lists sampled for the
+    SAME seed set x; one shared id space reindexes all types. Returns
+    (reindex_src, reindex_dst, out_nodes) with src/dst concatenated in
+    edge-type order."""
+    import numpy as np
+
+    xs = np.asarray(ensure_tensor(x).numpy()).reshape(-1)
+    mapping = {}
+    out_nodes = []
+    for v in xs:
+        if int(v) not in mapping:
+            mapping[int(v)] = len(out_nodes)
+            out_nodes.append(int(v))
+    srcs = []
+    dsts = []
+    dtype = None
+    for nbr, cnt in zip(neighbors, count):
+        nb = np.asarray(ensure_tensor(nbr).numpy()).reshape(-1)
+        ct = np.asarray(ensure_tensor(cnt).numpy()).reshape(-1)
+        dtype = nb.dtype if dtype is None else dtype
+        src = np.empty(len(nb), np.int64)
+        for i, v in enumerate(nb):
+            vi = int(v)
+            if vi not in mapping:
+                mapping[vi] = len(out_nodes)
+                out_nodes.append(vi)
+            src[i] = mapping[vi]
+        srcs.append(src)
+        dsts.append(np.repeat(np.arange(len(xs)), ct))
+    src_all = np.concatenate(srcs) if srcs else np.zeros(0, np.int64)
+    dst_all = np.concatenate(dsts) if dsts else np.zeros(0, np.int64)
+    return (Tensor(jnp.asarray(src_all.astype(dtype or np.int64))),
+            Tensor(jnp.asarray(dst_all.astype(dtype or np.int64))),
+            Tensor(jnp.asarray(np.asarray(out_nodes,
+                                          dtype or np.int64))))

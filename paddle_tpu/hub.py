@@ -84,4 +84,28 @@ def load(repo_dir: str, model: str, source: str = "github",
     return eps[model](**kwargs)
 
 
-__all__ = ["list", "help", "load"]
+def load_state_dict_from_url(url, model_dir=None, check_hash=False,
+                             file_name=None, map_location=None):
+    """Parity: paddle.hub.load_state_dict_from_url. This environment has
+    no network egress: file:// URLs and already-downloaded cache entries
+    load; a cache miss on an http(s) URL raises with the cache path the
+    caller can pre-populate."""
+    import os
+    import urllib.parse
+
+    from .framework.io import load as fload
+    parsed = urllib.parse.urlparse(str(url))
+    if parsed.scheme == "file":
+        return fload(parsed.path)
+    cache_dir = model_dir or os.path.expanduser("~/.cache/paddle_tpu/hub")
+    fname = file_name or os.path.basename(parsed.path) or "state_dict"
+    path = os.path.join(cache_dir, fname)
+    if os.path.exists(path):
+        return fload(path)
+    raise RuntimeError(
+        f"load_state_dict_from_url: no network egress in this "
+        f"environment and {path!r} is not cached; place the file there "
+        "or pass a file:// URL")
+
+
+__all__ = ["list", "help", "load", "load_state_dict_from_url"]

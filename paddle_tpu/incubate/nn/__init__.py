@@ -3,6 +3,9 @@ from . import layer  # noqa: F401
 from .layer import (  # noqa: F401
     FusedFeedForward, FusedMultiHeadAttention, FusedTransformerEncoderLayer,
 )
+from .layer.fused_transformer import (  # noqa: F401
+    FusedBiasDropoutResidualLayerNorm, FusedMultiTransformer,
+)
 from .layer.fused_ops_layers import (  # noqa: F401
     FusedDropoutAdd, FusedLinear,
 )

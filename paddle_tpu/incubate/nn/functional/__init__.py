@@ -1,10 +1,13 @@
 """Fused incubate functionals (parity: python/paddle/incubate/nn/functional/)."""
 from .fused_moe import fused_moe  # noqa: F401
 from .fused_ops import (  # noqa: F401
-    block_multihead_attention,
-    fused_bias_act, fused_dropout_add, fused_layer_norm, fused_linear,
+    blha_get_max_len, block_multihead_attention,
+    fused_bias_act, fused_bias_dropout_residual_layer_norm,
+    fused_dropout_add, fused_feedforward, fused_layer_norm, fused_linear,
     fused_linear_activation, fused_matmul_bias,
+    fused_multi_head_attention,
     fused_rotary_position_embedding, fused_rms_norm,
     masked_multihead_attention, swiglu,
     variable_length_memory_efficient_attention,
 )
+from .fused_transformer import fused_multi_transformer  # noqa: F401

@@ -631,3 +631,143 @@ def block_multihead_attention(qkv, key_cache, value_cache,
         return (out.reshape(b_, h * d).astype(x.dtype), x, kc, vc)
 
     return dispatch("block_multihead_attention", fwd, *args)
+
+
+def fused_bias_dropout_residual_layer_norm(
+        x, residual, bias=None, ln_scale=None, ln_bias=None,
+        dropout_rate=0.5, ln_epsilon=1e-5, training=True,
+        mode="upscale_in_train", name=None):
+    """Parity: incubate.nn.functional.fused_bias_dropout_residual_
+    layer_norm (fused_bias_dropout_residual_layer_norm_kernel.cu
+    capability): LayerNorm(residual + dropout(x + bias)). One XLA
+    fusion chain on TPU — the CUDA kernel exists to get the same single
+    HBM pass."""
+    from ....nn import functional as F
+    h = x if bias is None else x + bias
+    h = F.dropout(h, p=dropout_rate, training=training, mode=mode)
+    h = h + residual
+    d = int(h.shape[-1])
+    return F.layer_norm(h, d, weight=ln_scale, bias=ln_bias,
+                        epsilon=ln_epsilon)
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True, mode=
+                      "upscale_in_train", ring_id=-1, add_residual=True,
+                      name=None):
+    """Parity: F.fused_feedforward (fused_feedforward_kernel.cu):
+    residual + dropout2(linear2(dropout1(act(linear1(maybe_ln(x))))))
+    with pre- or post-layernorm."""
+    from ....nn import functional as F
+    d = int(x.shape[-1])
+    h = x
+    if pre_layer_norm:
+        h = F.layer_norm(h, d, weight=ln1_scale, bias=ln1_bias,
+                         epsilon=ln1_epsilon)
+    h = F.linear(h, linear1_weight, linear1_bias)
+    h = getattr(F, activation)(h)
+    h = F.dropout(h, p=dropout1_rate, training=training, mode=mode)
+    h = F.linear(h, linear2_weight, linear2_bias)
+    h = F.dropout(h, p=dropout2_rate, training=training, mode=mode)
+    if add_residual:
+        h = x + h
+    if not pre_layer_norm:
+        h = F.layer_norm(h, d, weight=ln2_scale, bias=ln2_bias,
+                         epsilon=ln2_epsilon)
+    return h
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight,
+                               pre_layer_norm=False, pre_ln_scale=None,
+                               pre_ln_bias=None, ln_scale=None,
+                               ln_bias=None, pre_ln_epsilon=1e-5,
+                               qkv_bias=None, linear_bias=None,
+                               cache_kv=None, attn_mask=None,
+                               dropout_rate=0.5, attn_dropout_rate=0.5,
+                               ln_epsilon=1e-5, training=True,
+                               mode="upscale_in_train", ring_id=-1,
+                               add_residual=True, num_heads=None,
+                               transpose_qkv_wb=False, name=None):
+    """Parity: F.fused_multi_head_attention
+    (fused_attention_kernel.cu): pre/post-LN multi-head self attention
+    with fused qkv projection. qkv_weight: [3, H, D, E] (reference
+    layout) or [E, 3*E] with transpose_qkv_wb."""
+    import jax.numpy as jnp
+
+    from ....nn import functional as F
+    from ....ops.dispatch import dispatch, ensure_tensor
+    xt = ensure_tensor(x)
+    e = int(xt.shape[-1])
+    h = xt
+    if pre_layer_norm:
+        h = F.layer_norm(h, e, weight=pre_ln_scale, bias=pre_ln_bias,
+                         epsilon=pre_ln_epsilon)
+    qw = ensure_tensor(qkv_weight)
+    if transpose_qkv_wb:
+        if num_heads is None:
+            raise ValueError("transpose_qkv_wb=True requires num_heads")
+        nh = num_heads
+        qkv = F.linear(h, qw, qkv_bias)              # [B, S, 3E]
+        b, s = int(qkv.shape[0]), int(qkv.shape[1])
+        qkv = qkv.reshape([b, s, 3, nh, e // nh])
+    else:
+        nh = int(qw.shape[1])
+        hd = int(qw.shape[2])
+
+        def proj(ha, wa, *maybe_b):
+            out = jnp.einsum("bse,thde->bsthd", ha, wa)
+            if maybe_b:
+                out = out + maybe_b[0]
+            return out
+        args = (h, qw) + ((ensure_tensor(qkv_bias),)
+                          if qkv_bias is not None else ())
+        qkv = dispatch("fused_qkv_proj", proj, *args)
+        b, s = int(qkv.shape[0]), int(qkv.shape[1])
+    q = qkv[:, :, 0]
+    k = qkv[:, :, 1]
+    v = qkv[:, :, 2]
+    new_cache = None
+    if cache_kv is not None:
+        # cache layout [2, B, H, T, D] (reference fused_attention):
+        # append this call's K/V and attend over the full history
+        ck = ensure_tensor(cache_kv)
+
+        def extend(cka, ka, va):
+            kt = jnp.swapaxes(ka, 1, 2)
+            vt = jnp.swapaxes(va, 1, 2)
+            return jnp.concatenate([cka, jnp.stack([kt, vt])], axis=3)
+        new_cache = dispatch("fused_mha_cache", extend, ck, k, v)
+        k = new_cache[0].transpose([0, 2, 1, 3])
+        v = new_cache[1].transpose([0, 2, 1, 3])
+    ctx = F.scaled_dot_product_attention(
+        q, k, v, attn_mask=attn_mask,
+        dropout_p=attn_dropout_rate if training else 0.0,
+        is_causal=False, training=training)
+    ctx = ctx.reshape([b, s, e])
+    out = F.linear(ctx, linear_weight, linear_bias)
+    out = F.dropout(out, p=dropout_rate, training=training, mode=mode)
+    if add_residual:
+        out = xt + out
+    if not pre_layer_norm:
+        out = F.layer_norm(out, e, weight=ln_scale, bias=ln_bias,
+                           epsilon=ln_epsilon)
+    if new_cache is not None:
+        return out, new_cache
+    return out
+
+
+def blha_get_max_len(seq_lens_encoder, seq_lens_decoder, batch_size,
+                     name=None):
+    """Parity: F.blha_get_max_len (block_multihead_attention helper):
+    (max encoder len, max decoder len) of the ragged batch."""
+    import jax.numpy as jnp
+
+    from ....ops.dispatch import dispatch, ensure_tensor
+    enc = ensure_tensor(seq_lens_encoder)
+    dec = ensure_tensor(seq_lens_decoder)
+    return (dispatch("blha_max_enc", lambda a: jnp.max(a), enc),
+            dispatch("blha_max_dec", lambda a: jnp.max(a), dec))

@@ -1,0 +1,110 @@
+"""fused_multi_transformer (reference incubate/nn/functional/
+fused_transformer.py over fused_multi_transformer_kernel.cu): the
+whole-stack serving op — N pre/post-LN transformer layers applied in one
+call from per-layer weight lists. On TPU the loop traces into one XLA
+program (the CUDA kernel exists to avoid N kernel-launch round trips,
+which tracing already eliminates); the production decode path with KV
+caches is paddle_tpu.generation."""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+from ....nn import functional as F
+from ....ops.dispatch import dispatch, ensure_tensor
+
+
+def _attn_one_layer(h, qkv_w, qkv_b, out_w, out_b, nh, attn_mask,
+                    cache_kv):
+    b, s, e = int(h.shape[0]), int(h.shape[1]), int(h.shape[2])
+
+    def proj(ha, wa, *mb):
+        out = jnp.einsum("bse,thde->bsthd", ha, wa)
+        if mb:
+            out = out + mb[0]
+        return out
+    args = (h, ensure_tensor(qkv_w)) + (
+        (ensure_tensor(qkv_b),) if qkv_b is not None else ())
+    qkv = dispatch("fmt_qkv", proj, *args)
+    q = qkv[:, :, 0]
+    k = qkv[:, :, 1]
+    v = qkv[:, :, 2]
+    new_cache = None
+    if cache_kv is not None:
+        # cache_kv: [2, B, H, T, D] (reference layout); append this step
+        ck = ensure_tensor(cache_kv)
+
+        def extend(cka, ka, va):
+            kt = jnp.swapaxes(ka, 1, 2)          # [B, H, S, D]
+            vt = jnp.swapaxes(va, 1, 2)
+            return jnp.concatenate(
+                [cka, jnp.stack([kt, vt])], axis=3)
+        new_cache = dispatch("fmt_cache", extend, ck, k, v)
+        k = new_cache[0].transpose([0, 2, 1, 3])
+        v = new_cache[1].transpose([0, 2, 1, 3])
+    ctx = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
+                                         is_causal=attn_mask is None,
+                                         training=False)
+    out = F.linear(ctx.reshape([b, s, e]), out_w, out_b)
+    return out, new_cache
+
+
+def fused_multi_transformer(
+        x, ln_scales: List, ln_biases: List, qkv_weights: List,
+        qkv_biases: Optional[List] = None, linear_weights: List = None,
+        linear_biases: Optional[List] = None, ffn_ln_scales: List = None,
+        ffn_ln_biases: List = None, ffn1_weights: List = None,
+        ffn1_biases: Optional[List] = None, ffn2_weights: List = None,
+        ffn2_biases: Optional[List] = None, pre_layer_norm: bool = True,
+        epsilon: float = 1e-5, cache_kvs: Optional[List] = None,
+        pre_caches=None, seq_lens=None, rotary_embs=None,
+        rotary_emb_dims=0, time_step=None, attn_mask=None,
+        dropout_rate: float = 0.0, activation: str = "gelu",
+        training: bool = False, mode: str = "upscale_in_train",
+        ring_id: int = -1, name=None):
+    """Run the whole transformer stack. Returns the output (and the
+    updated cache list when cache_kvs is given)."""
+    n_layers = len(qkv_weights)
+
+    def opt(lst, i):
+        return None if lst is None else lst[i]
+    h = ensure_tensor(x)
+    e = int(h.shape[-1])
+    new_caches = [] if cache_kvs is not None else None
+    for i in range(n_layers):
+        nh = int(ensure_tensor(qkv_weights[i]).shape[1])
+        resid = h
+        a = h
+        if pre_layer_norm:
+            a = F.layer_norm(a, e, weight=opt(ln_scales, i),
+                             bias=opt(ln_biases, i), epsilon=epsilon)
+        attn_out, new_cache = _attn_one_layer(
+            a, qkv_weights[i], opt(qkv_biases, i), linear_weights[i],
+            opt(linear_biases, i), nh, attn_mask, opt(cache_kvs, i))
+        if new_caches is not None:
+            new_caches.append(new_cache)
+        h = resid + F.dropout(attn_out, p=dropout_rate,
+                              training=training, mode=mode)
+        if not pre_layer_norm:
+            h = F.layer_norm(h, e, weight=opt(ln_scales, i),
+                             bias=opt(ln_biases, i), epsilon=epsilon)
+        resid = h
+        f = h
+        if pre_layer_norm:
+            f = F.layer_norm(f, e, weight=opt(ffn_ln_scales, i),
+                             bias=opt(ffn_ln_biases, i), epsilon=epsilon)
+        f = F.linear(f, ffn1_weights[i], opt(ffn1_biases, i))
+        f = getattr(F, activation)(f)
+        f = F.linear(f, ffn2_weights[i], opt(ffn2_biases, i))
+        h = resid + F.dropout(f, p=dropout_rate, training=training,
+                              mode=mode)
+        if not pre_layer_norm:
+            h = F.layer_norm(h, e, weight=opt(ffn_ln_scales, i),
+                             bias=opt(ffn_ln_biases, i), epsilon=epsilon)
+    if new_caches is not None:
+        return h, new_caches
+    return h
+
+
+__all__ = ["fused_multi_transformer"]

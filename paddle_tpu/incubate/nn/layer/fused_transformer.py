@@ -173,3 +173,104 @@ class FusedTransformerEncoderLayer(Layer):
     def forward(self, src, src_mask=None, cache=None):
         out = self.fused_attn(src, attn_mask=src_mask)
         return self.ffn(out)
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    """Parity: incubate.nn.FusedBiasDropoutResidualLayerNorm
+    (fused_bias_dropout_residual_layer_norm_kernel.cu capability):
+    LayerNorm(residual + dropout(x + bias))."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        self.embed_dim = embed_dim
+        self.dropout_rate = dropout_rate
+        self.epsilon = epsilon
+        self.linear_bias = self.create_parameter((embed_dim,),
+                                                 attr=bias_attr,
+                                                 is_bias=True)
+        from ....nn.initializer import Constant
+        self.ln_scale = self.create_parameter(
+            (embed_dim,), attr=weight_attr,
+            default_initializer=Constant(1.0))
+        self.ln_bias = self.create_parameter((embed_dim,), is_bias=True)
+
+    def forward(self, x, residual):
+        from ..functional import fused_bias_dropout_residual_layer_norm
+        return fused_bias_dropout_residual_layer_norm(
+            x, residual, self.linear_bias, self.ln_scale, self.ln_bias,
+            self.dropout_rate, self.epsilon, self.training)
+
+
+class FusedMultiTransformer(Layer):
+    """Parity: incubate.nn.FusedMultiTransformer — owns the per-layer
+    weight lists of the whole stack and runs them through
+    F.fused_multi_transformer (the serving-stack op)."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward,
+                 dropout_rate=0.0, activation="gelu", normalize_before=True,
+                 ln_scale_attrs=None, ln_bias_attrs=None,
+                 qkv_weight_attrs=None, qkv_bias_attrs=None,
+                 linear_weight_attrs=None, linear_bias_attrs=None,
+                 ffn_ln_scale_attrs=None, ffn_ln_bias_attrs=None,
+                 ffn1_weight_attrs=None, ffn1_bias_attrs=None,
+                 ffn2_weight_attrs=None, ffn2_bias_attrs=None,
+                 epsilon=1e-5, num_layers=-1, nranks=1, trans_qkvw=True,
+                 ring_id=-1, name=None):
+        super().__init__()
+        if num_layers <= 0:
+            num_layers = (len(qkv_weight_attrs)
+                          if isinstance(qkv_weight_attrs, (list, tuple))
+                          else 1)
+        if embed_dim % num_heads:
+            raise ValueError("num_heads must divide embed_dim")
+        from ....nn.initializer import Constant
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.normalize_before = normalize_before
+        self.epsilon = epsilon
+        self.dropout_rate = dropout_rate
+        self.activation = activation
+        head = embed_dim // num_heads
+        self.ln_scales, self.ln_biases = [], []
+        self.qkv_weights, self.qkv_biases = [], []
+        self.linear_weights, self.linear_biases = [], []
+        self.ffn_ln_scales, self.ffn_ln_biases = [], []
+        self.ffn1_weights, self.ffn1_biases = [], []
+        self.ffn2_weights, self.ffn2_biases = [], []
+        for i in range(num_layers):
+            mk = self.create_parameter
+            add = self.add_parameter
+            pairs = [
+                ("ln_scales", mk((embed_dim,),
+                                 default_initializer=Constant(1.0))),
+                ("ln_biases", mk((embed_dim,), is_bias=True)),
+                ("qkv_weights", mk((3, num_heads, head, embed_dim))),
+                ("qkv_biases", mk((3, num_heads, head), is_bias=True)),
+                ("linear_weights", mk((embed_dim, embed_dim))),
+                ("linear_biases", mk((embed_dim,), is_bias=True)),
+                ("ffn_ln_scales", mk((embed_dim,),
+                                     default_initializer=Constant(1.0))),
+                ("ffn_ln_biases", mk((embed_dim,), is_bias=True)),
+                ("ffn1_weights", mk((embed_dim, dim_feedforward))),
+                ("ffn1_biases", mk((dim_feedforward,), is_bias=True)),
+                ("ffn2_weights", mk((dim_feedforward, embed_dim))),
+                ("ffn2_biases", mk((embed_dim,), is_bias=True)),
+            ]
+            for name_, p in pairs:
+                add(f"{name_}_{i}", p)
+                getattr(self, name_).append(p)
+
+    def forward(self, src, attn_mask=None, caches=None, pre_caches=None,
+                rotary_embs=None, rotary_emb_dims=0, seq_lens=None,
+                time_step=None):
+        from ..functional import fused_multi_transformer
+        return fused_multi_transformer(
+            src, self.ln_scales, self.ln_biases, self.qkv_weights,
+            self.qkv_biases, self.linear_weights, self.linear_biases,
+            self.ffn_ln_scales, self.ffn_ln_biases, self.ffn1_weights,
+            self.ffn1_biases, self.ffn2_weights, self.ffn2_biases,
+            pre_layer_norm=self.normalize_before, epsilon=self.epsilon,
+            cache_kvs=caches, attn_mask=attn_mask,
+            dropout_rate=self.dropout_rate, activation=self.activation,
+            training=self.training)

@@ -1,0 +1,36 @@
+"""paddle.nn.quant (reference python/paddle/nn/quant/): the quantized
+op surface — one implementation with paddle_tpu.quantization."""
+from ...quantization import (  # noqa: F401
+    weight_dequantize, weight_only_linear, weight_quantize,
+)
+from ..layer.layers import Layer as _Layer
+
+
+def llm_int8_linear(x, weight, bias=None, weight_scale=None,
+                    threshold=6.0):
+    """Parity: nn.quant.llm_int8_linear — the threshold-split outlier
+    path is subsumed: the int8 dot accumulates in fp32 (XLA), which is
+    what the outlier split exists to protect on CUDA."""
+    from ...quantization import weight_only_linear as wol
+    return wol(x, weight, bias=bias, weight_scale=weight_scale)
+
+
+__all__ = ["weight_quantize", "weight_dequantize", "weight_only_linear",
+           "llm_int8_linear"]
+
+
+class Stub(_Layer):
+    """Parity: paddle.nn.quant.Stub — a marker layer for QAT insertion
+    points: carries an observer config; paddle_tpu.quantization.QAT
+    replaces/wraps it during quantize(). isinstance(x, Stub) is the
+    documented way QAT code finds insertion points."""
+
+    def __init__(self, observer=None):
+        super().__init__()
+        self._observer = observer
+
+    def forward(self, x):
+        return x
+
+
+__all__.append("Stub")

@@ -139,3 +139,52 @@ def vector_to_parameters(vec, parameters, name=None):
         p._data = data[offset:offset + n].reshape(p._data.shape) \
             .astype(p._data.dtype)
         offset += n
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    """Parity: paddle.nn.utils.clip_grad_norm_ — in-place global-norm
+    clip over the parameters' .grad; returns the total norm."""
+    import jax.numpy as jnp
+
+    from ..tensor import Tensor
+    params = [parameters] if isinstance(parameters, Tensor) else \
+        list(parameters)
+    grads = [p.grad for p in params if p.grad is not None]
+    if not grads:
+        return Tensor(jnp.asarray(0.0, jnp.float32))
+    nt = float(norm_type)
+    if nt == float("inf"):
+        total = jnp.max(jnp.stack(
+            [jnp.max(jnp.abs(g._data.astype(jnp.float32))) for g in grads]))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(g._data.astype(jnp.float32)) ** nt)
+             for g in grads])) ** (1.0 / nt)
+    if error_if_nonfinite and not bool(jnp.isfinite(total)):
+        raise RuntimeError(
+            "the total norm of gradients is non-finite; disable "
+            "error_if_nonfinite to clip anyway")
+    scale = jnp.minimum(1.0, max_norm / (total + 1e-6))
+    for p in params:
+        if p.grad is not None:
+            p.grad._data = (p.grad._data.astype(jnp.float32)
+                            * scale).astype(p.grad._data.dtype)
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    """Parity: paddle.nn.utils.clip_grad_value_ — clamp every grad to
+    [-clip_value, clip_value] in place."""
+    import jax.numpy as jnp
+
+    from ..tensor import Tensor
+    params = [parameters] if isinstance(parameters, Tensor) else \
+        list(parameters)
+    cv = float(clip_value)
+    for p in params:
+        if p.grad is not None:
+            p.grad._data = jnp.clip(p.grad._data, -cv, cv)
+
+
+__all__ += ["clip_grad_norm_", "clip_grad_value_"]

@@ -111,12 +111,20 @@ def _prof_span(name):
     return ev
 
 
+# amp.debugging's operator-stats collector: when set, called with
+# (op_name, tensor_inputs) on every dispatch (the one chokepoint every
+# eager/compiled-trace op passes through)
+_stats_hook = [None]
+
+
 def dispatch(name: str, fwd, *tensor_inputs: Tensor):
     """Run `fwd` over the arrays of `tensor_inputs`, recording a vjp node if needed.
 
     `fwd` takes jax arrays positionally (statics closed over) and returns one
     array or a tuple of arrays.
     """
+    if _stats_hook[0] is not None:
+        _stats_hook[0](name, tensor_inputs)
     span = _prof_span(name)
     try:
         return _dispatch_inner(name, fwd, tensor_inputs)

