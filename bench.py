@@ -512,11 +512,35 @@ def _run_parent():
                or probe_extra.get("extra", {}).get("error")  # __main__ handler
                or str(probe_extra.get("steps", {})
                       .get("matmul", {}).get("error", "?")))
+        extra = {"error": f"probe tier failed: {why}"[:1500],
+                 "probe": probe_extra}
+        # the tunnel comes and goes in windows; if THIS invocation missed
+        # one but a watcher-run session already landed a real number this
+        # round, attach it as clearly-labeled evidence
+        try:
+            import glob
+            sessions = sorted(glob.glob(
+                os.path.join(here, "BENCH_SESSION_r*.json")))
+            if sessions:
+                with open(sessions[-1]) as f:
+                    last = json.load(f)
+                if last.get("value", 0) > 0:
+                    extra["last_successful_hardware_session"] = {
+                        "file": os.path.basename(sessions[-1]),
+                        "note": "tunnel was down at this invocation; this "
+                                "is the committed result of the last "
+                                "successful hardware session",
+                        "value": last["value"], "unit": last.get("unit"),
+                        "mfu": last.get("extra", {}).get("mfu"),
+                        "config": last.get("extra", {}).get("config"),
+                        "device": last.get("extra", {}).get("device"),
+                    }
+        except (OSError, json.JSONDecodeError):
+            pass
         print(json.dumps({
             "metric": "llama_train_tokens_per_sec_per_chip",
             "value": 0.0, "unit": "tokens/s", "vs_baseline": 0.0,
-            "extra": {"error": f"probe tier failed: {why}"[:1500],
-                      "probe": probe_extra},
+            "extra": extra,
         }))
         sys.exit(1)
 
