@@ -47,3 +47,126 @@ def is_first_worker():
 
 def barrier_worker():
     pass
+
+
+# -- parameter-server role surface (reference fleet PS mode over the
+# runnable distributed.ps; roles resolve from the launch env) -----------------
+
+def _role():
+    import os
+    return os.environ.get("TRAINING_ROLE", "TRAINER").upper()
+
+
+def is_worker():
+    """Parity: fleet.is_worker."""
+    return _role() in ("TRAINER", "WORKER")
+
+
+def is_server():
+    """Parity: fleet.is_server."""
+    return _role() in ("PSERVER", "SERVER")
+
+
+def worker_endpoints(to_string=False):
+    """Parity: fleet.worker_endpoints (PADDLE_TRAINER_ENDPOINTS)."""
+    import os
+    eps = [e for e in os.environ.get("PADDLE_TRAINER_ENDPOINTS",
+                                     "").split(",") if e]
+    return ",".join(eps) if to_string else eps
+
+
+def server_endpoints(to_string=False):
+    """Parity: fleet.server_endpoints (PADDLE_PSERVERS_IP_PORT_LIST)."""
+    import os
+    eps = [e for e in os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST",
+                                     "").split(",") if e]
+    return ",".join(eps) if to_string else eps
+
+
+def server_num():
+    """Parity: fleet.server_num."""
+    return len(server_endpoints())
+
+
+def server_index():
+    """Parity: fleet.server_index (PADDLE_TRAINER_ID in server role)."""
+    import os
+    return int(os.environ.get("PADDLE_TRAINER_ID", 0))
+
+
+def init_worker(scopes=None):
+    """Parity: fleet.init_worker — connect this trainer to the table
+    server (distributed.ps.PSClient over the rpc mailboxes)."""
+    from .. import ps as _ps
+    _fleet._ps_client = _ps.PSClient()
+    return _fleet._ps_client
+
+
+def init_server(*args, **kwargs):
+    """Parity: fleet.init_server — nothing to preload here (tables are
+    created on first use); kept for API compatibility."""
+
+
+def run_server():
+    """Parity: fleet.run_server — serve tables until a client calls
+    shutdown (distributed.ps.run_server)."""
+    from .. import ps as _ps
+    _ps.run_server(block=True)
+
+
+def stop_worker():
+    """Parity: fleet.stop_worker — flush pending async pushes and drop
+    the client handle."""
+    client = getattr(_fleet, "_ps_client", None)
+    if client is not None and hasattr(client, "wait"):
+        client.wait()
+    _fleet._ps_client = None
+
+
+class UserDefinedRoleMaker:
+    """Parity: fleet.UserDefinedRoleMaker — explicit role/endpoint spec;
+    init() exports it to the env the role functions read."""
+
+    def __init__(self, is_collective=False, init_gloo=False, current_id=0,
+                 role=None, worker_endpoints=None, server_endpoints=None,
+                 worker_num=None, **kwargs):
+        self.current_id = current_id
+        self.role = role
+        self.worker_endpoints_list = list(worker_endpoints or [])
+        self.server_endpoints_list = list(server_endpoints or [])
+        self.num_workers = (worker_num if worker_num is not None
+                            else len(self.worker_endpoints_list) or 1)
+
+    def to_env(self):
+        import os
+        role = self.role
+        name = getattr(role, "name", None) or str(role or "TRAINER")
+        os.environ["TRAINING_ROLE"] = (
+            "PSERVER" if "SERVER" in name.upper() else "TRAINER")
+        os.environ["PADDLE_TRAINER_ID"] = str(self.current_id)
+        os.environ["PADDLE_TRAINERS_NUM"] = str(self.num_workers)
+        if self.worker_endpoints_list:
+            os.environ["PADDLE_TRAINER_ENDPOINTS"] = ",".join(
+                self.worker_endpoints_list)
+        if self.server_endpoints_list:
+            os.environ["PADDLE_PSERVERS_IP_PORT_LIST"] = ",".join(
+                self.server_endpoints_list)
+
+
+class Role:
+    """Parity: fleet.base.role_maker.Role enum values."""
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+
+
+class PaddleCloudRoleMaker:
+    """Parity: fleet.PaddleCloudRoleMaker — roles come from the launch
+    env (which our launch CLI already exports); nothing to compute."""
+
+    def __init__(self, is_collective=False, **kwargs):
+        self.is_collective = is_collective
+
+    def to_env(self):
+        pass
