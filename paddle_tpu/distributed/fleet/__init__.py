@@ -170,3 +170,80 @@ class PaddleCloudRoleMaker:
 
     def to_env(self):
         pass
+
+
+from .base import CommunicateTopology  # noqa: F401, E402
+
+
+class UtilBase:
+    """Parity: fleet.UtilBase — cross-worker helper utilities over the
+    host collectives."""
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        import numpy as np
+
+        from ..host_collectives import get_host_collectives
+        hc = get_host_collectives()
+        arr = np.asarray(input)
+        if hc is None:
+            return arr
+        return np.asarray(hc.all_reduce(arr, mode))
+
+    def barrier(self, comm_world="worker"):
+        from ..communication import barrier
+        barrier()
+
+    def all_gather(self, input, comm_world="worker"):
+        from ..host_collectives import get_host_collectives
+        hc = get_host_collectives()
+        if hc is None:
+            return [input]
+        return hc.all_gather_object(input)
+
+    def get_file_shard(self, files):
+        """Split a file list evenly over workers (reference semantics:
+        contiguous blocks, remainder to the first workers)."""
+        n = worker_num() or 1
+        i = worker_index()
+        files = list(files)
+        base, rem = divmod(len(files), n)
+        start = i * base + min(i, rem)
+        return files[start:start + base + (1 if i < rem else 0)]
+
+
+util = UtilBase()
+
+
+class MultiSlotDataGenerator:
+    """Parity: fleet.MultiSlotDataGenerator — PS slot-data pipeline:
+    subclass generate_sample(line) yielding [(slot_name, [values])];
+    run_from_stdin/run_from_file format lines for InMemoryDataset."""
+
+    def _format(self, sample):
+        out = []
+        for name, values in sample:
+            out.append(str(len(values)))
+            out.extend(str(v) for v in values)
+        return " ".join(out)
+
+    def generate_sample(self, line):
+        raise NotImplementedError(
+            "subclass MultiSlotDataGenerator and implement "
+            "generate_sample(line) -> iterator of [(slot, values), ...]")
+
+    def run_from_file(self, in_path, out_path):
+        with open(in_path) as fin, open(out_path, "w") as fout:
+            for line in fin:
+                for sample in self.generate_sample(line) or []:
+                    fout.write(self._format(sample) + "\n")
+
+    def run_from_stdin(self):
+        import sys as _sys
+        for line in _sys.stdin:
+            for sample in self.generate_sample(line) or []:
+                _sys.stdout.write(self._format(sample) + "\n")
+
+
+class MultiSlotStringDataGenerator(MultiSlotDataGenerator):
+    """Parity: fleet.MultiSlotStringDataGenerator — string-valued slots
+    (no numeric conversion)."""

@@ -14,3 +14,5 @@ from .extras import (  # noqa: F401, E402
     softmax_mask_fuse, softmax_mask_fuse_upper_triangle,
 )
 from .. import inference  # noqa: F401, E402  (paddle.incubate.inference)
+from . import multiprocessing  # noqa: F401, E402
+from . import optimizer  # noqa: F401, E402

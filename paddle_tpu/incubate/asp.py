@@ -83,3 +83,19 @@ def decorate(optimizer):
 
 __all__ = ["calculate_density", "prune_model", "decorate",
            "set_excluded_layers", "reset_excluded_layers"]
+
+
+_CUSTOM_PRUNE_FUNCS = {}
+
+
+def add_supported_layer(layer, pruning_func=None):
+    """Parity: incubate.asp.add_supported_layer — register a layer class
+    (or parameter-name substring) whose weights prune_model should
+    sparsify, optionally with a custom mask function
+    pruning_func(weight_np, n, m) -> mask_np."""
+    key = layer if isinstance(layer, str) else getattr(
+        layer, "__name__", str(layer))
+    _CUSTOM_PRUNE_FUNCS[key] = pruning_func
+
+
+__all__.append("add_supported_layer")

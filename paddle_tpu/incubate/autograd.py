@@ -114,3 +114,53 @@ class Hessian:
     def numpy(self):
         import numpy as np
         return np.asarray(jnp.asarray(self._hess))
+
+
+_PRIM_ENABLED = [False]
+
+
+def enable_prim():
+    """Parity: incubate.autograd.enable_prim — the reference lowers ops
+    to primitive form for higher-order AD; jax traces are already
+    primitive jaxprs, so the flag records intent (higher-order AD works
+    either way here)."""
+    _PRIM_ENABLED[0] = True
+
+
+def disable_prim():
+    _PRIM_ENABLED[0] = False
+
+
+def prim_enabled():
+    return _PRIM_ENABLED[0]
+
+
+def forward_grad(outputs, inputs, grad_inputs=None):
+    """Parity: incubate.autograd.forward_grad — forward-mode (JVP)
+    derivatives of `outputs` wrt `inputs`. Usable on the EAGER graph by
+    re-linearizing: outputs must be produced by a function; here the
+    functional jvp form is exposed (pass a callable), matching the
+    primitive-mode contract."""
+    if callable(outputs):
+        _, tangents = jvp(outputs, inputs, grad_inputs)
+        return tangents
+    raise ValueError(
+        "forward_grad(outputs=<callable>, inputs, grad_inputs): this "
+        "framework exposes the functional form — pass the function whose "
+        "forward derivative you want (jax forward-mode needs the "
+        "function, not a recorded graph)")
+
+
+def grad(outputs, inputs, grad_outputs=None):
+    """Parity: incubate.autograd.grad (prim-mode): functional reverse
+    grads; callable outputs use jax.vjp, recorded Tensors route to the
+    eager tape's paddle.grad."""
+    if callable(outputs):
+        _, pulled = vjp(outputs, inputs, grad_outputs)
+        return pulled
+    from ..autograd import grad as tape_grad
+    return tape_grad(outputs, inputs, grad_outputs)
+
+
+__all__ += ["enable_prim", "disable_prim", "prim_enabled",
+            "forward_grad", "grad"]

@@ -1,0 +1,36 @@
+"""paddle.incubate.multiprocessing (reference: tensor-sharing
+reductions for torch-style multiprocessing). Tensors pickle by value
+here (jax arrays serialize their host buffer), so a spawned worker can
+receive Tensors directly; the shm-ring DataLoader transport (csrc/)
+covers the zero-copy bulk path."""
+import multiprocessing as _mp
+
+from ...tensor import Tensor
+
+
+def _rebuild_tensor(arr):
+    import jax.numpy as jnp
+    return Tensor(jnp.asarray(arr))
+
+
+def _reduce_tensor(t):
+    import numpy as np
+    return (_rebuild_tensor, (np.asarray(t._data),))
+
+
+try:  # register with copyreg so any pickler (incl. mp) handles Tensors
+    import copyreg
+    copyreg.pickle(Tensor, _reduce_tensor)
+except Exception:  # noqa: BLE001
+    pass
+
+
+def get_context(method=None):
+    return _mp.get_context(method)
+
+
+Process = _mp.Process
+Queue = _mp.Queue
+Pipe = _mp.Pipe
+
+__all__ = ["Process", "Queue", "Pipe", "get_context"]

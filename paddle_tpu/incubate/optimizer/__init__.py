@@ -1,0 +1,8 @@
+"""paddle.incubate.optimizer (reference exports LBFGS; LookAhead /
+ModelAverage live at the incubate top level like the reference)."""
+from ...optimizer import LBFGS  # noqa: F401
+
+__all__ = ["LBFGS"]
+
+from .functional import minimize_bfgs, minimize_lbfgs  # noqa: F401, E402
+from . import functional  # noqa: F401, E402

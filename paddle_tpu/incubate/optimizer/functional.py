@@ -1,0 +1,155 @@
+"""Functional minimizers (reference incubate/optimizer/functional/:
+minimize_bfgs bfgs.py, minimize_lbfgs lbfgs.py): quasi-Newton
+minimization of a scalar objective over one flat variable, with an
+Armijo-backtracking line search. Eager host loop driving jax grads —
+these APIs target small smooth problems (hyperparameter fits, physics
+residuals), not network training (that is the Optimizer family)."""
+from __future__ import annotations
+
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops.dispatch import ensure_tensor
+from ...tensor import Tensor
+
+
+class _Result(NamedTuple):
+    is_converge: "Tensor"
+    num_func_calls: "Tensor"
+    position: "Tensor"
+    objective_value: "Tensor"
+    objective_gradient: "Tensor"
+    inverse_hessian_estimate: "Tensor" = None
+
+
+def _value_and_grad(objective_func):
+    def f(x):
+        out = objective_func(Tensor(x))
+        return ensure_tensor(out)._data.astype(jnp.float32).reshape(())
+    return jax.value_and_grad(f)
+
+
+def _line_search(vg, x, d, fx, gx, initial_step, calls,
+                 shrink=0.5, c1=1e-4, max_ls=20):
+    """Armijo backtracking along d; returns (step, f_new, g_new, calls)."""
+    step = initial_step
+    gd = float(gx @ d)
+    for _ in range(max_ls):
+        f_new, g_new = vg(x + step * d)
+        calls += 1
+        if float(f_new) <= float(fx) + c1 * step * gd or step < 1e-12:
+            return step, f_new, g_new, calls
+        step *= shrink
+    return step, f_new, g_new, calls
+
+
+def minimize_bfgs(objective_func: Callable, initial_position,
+                  max_iters: int = 50, tolerance_grad: float = 1e-7,
+                  tolerance_change: float = 1e-9, initial_inverse_hessian_estimate=None,
+                  line_search_fn: str = "strong_wolfe",
+                  max_line_search_iters: int = 50, initial_step_length=1.0,
+                  dtype="float32", name=None):
+    """Parity: incubate.optimizer.functional.minimize_bfgs. Returns
+    (is_converge, num_func_calls, position, objective_value,
+    objective_gradient, inverse_hessian_estimate)."""
+    vg = _value_and_grad(objective_func)
+    x = ensure_tensor(initial_position)._data.astype(jnp.float32).reshape(-1)
+    n = x.shape[0]
+    h = (jnp.eye(n, dtype=jnp.float32)
+         if initial_inverse_hessian_estimate is None
+         else ensure_tensor(initial_inverse_hessian_estimate)
+         ._data.astype(jnp.float32))
+    fx, gx = vg(x)
+    calls = 1
+    converged = False
+    for _ in range(max_iters):
+        if float(jnp.max(jnp.abs(gx))) <= tolerance_grad:
+            converged = True
+            break
+        d = -(h @ gx)
+        step, f_new, g_new, calls = _line_search(
+            vg, x, d, fx, gx, float(initial_step_length), calls,
+            max_ls=max_line_search_iters)
+        s = step * d
+        y = g_new - gx
+        sy = float(s @ y)
+        if abs(float(jnp.max(jnp.abs(s)))) <= tolerance_change:
+            x, fx, gx = x + s, f_new, g_new
+            converged = True
+            break
+        if sy > 1e-10:                     # curvature holds: BFGS update
+            rho = 1.0 / sy
+            eye = jnp.eye(n, dtype=jnp.float32)
+            v = eye - rho * jnp.outer(s, y)
+            h = v @ h @ v.T + rho * jnp.outer(s, s)
+        x, fx, gx = x + s, f_new, g_new
+    if float(jnp.max(jnp.abs(gx))) <= tolerance_grad:
+        converged = True               # grad test after the final step too
+    return _Result(Tensor(jnp.asarray(converged)),
+                   Tensor(jnp.asarray(calls, jnp.int64)), Tensor(x),
+                   Tensor(fx), Tensor(gx), Tensor(h))
+
+
+def minimize_lbfgs(objective_func: Callable, initial_position,
+                   history_size: int = 100, max_iters: int = 50,
+                   tolerance_grad: float = 1e-7,
+                   tolerance_change: float = 1e-9,
+                   initial_inverse_hessian_estimate=None,
+                   line_search_fn: str = "strong_wolfe",
+                   max_line_search_iters: int = 50,
+                   initial_step_length=1.0, dtype="float32", name=None):
+    """Parity: incubate.optimizer.functional.minimize_lbfgs — two-loop
+    recursion over the (s, y) history instead of a dense inverse
+    Hessian."""
+    vg = _value_and_grad(objective_func)
+    x = ensure_tensor(initial_position)._data.astype(jnp.float32).reshape(-1)
+    fx, gx = vg(x)
+    calls = 1
+    s_hist, y_hist = [], []
+    converged = False
+    for _ in range(max_iters):
+        if float(jnp.max(jnp.abs(gx))) <= tolerance_grad:
+            converged = True
+            break
+        q = gx
+        alphas = []
+        for s, y in reversed(list(zip(s_hist, y_hist))):
+            rho = 1.0 / float(s @ y)
+            a = rho * float(s @ q)
+            alphas.append((a, rho, s, y))
+            q = q - a * y
+        gamma = (float(s_hist[-1] @ y_hist[-1])
+                 / max(float(y_hist[-1] @ y_hist[-1]), 1e-12)
+                 if s_hist else 1.0)
+        r = gamma * q
+        for a, rho, s, y in reversed(alphas):
+            b = rho * float(y @ r)
+            r = r + (a - b) * s
+        d = -r
+        step, f_new, g_new, calls = _line_search(
+            vg, x, d, fx, gx, float(initial_step_length), calls,
+            max_ls=max_line_search_iters)
+        s = step * d
+        y = g_new - gx
+        if abs(float(jnp.max(jnp.abs(s)))) <= tolerance_change:
+            x, fx, gx = x + s, f_new, g_new
+            converged = True
+            break
+        if float(s @ y) > 1e-10:
+            s_hist.append(s)
+            y_hist.append(y)
+            if len(s_hist) > history_size:
+                s_hist.pop(0)
+                y_hist.pop(0)
+        x, fx, gx = x + s, f_new, g_new
+    if float(jnp.max(jnp.abs(gx))) <= tolerance_grad:
+        converged = True
+    return _Result(Tensor(jnp.asarray(converged)),
+                   Tensor(jnp.asarray(calls, jnp.int64)), Tensor(x),
+                   Tensor(fx), Tensor(gx))
+
+
+__all__ = ["minimize_bfgs", "minimize_lbfgs"]

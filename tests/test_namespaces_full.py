@@ -44,6 +44,16 @@ PAIRS = [
     ("paddle_tpu.hub", f"{R}/hub.py"),
     ("paddle_tpu.onnx", f"{R}/onnx/__init__.py"),
     ("paddle_tpu.profiler", f"{R}/profiler/__init__.py"),
+    ("paddle_tpu.incubate.autograd", f"{R}/incubate/autograd/__init__.py"),
+    ("paddle_tpu.incubate.asp", f"{R}/incubate/asp/__init__.py"),
+    ("paddle_tpu.incubate.optimizer",
+     f"{R}/incubate/optimizer/__init__.py"),
+    ("paddle_tpu.incubate.optimizer.functional",
+     f"{R}/incubate/optimizer/functional/__init__.py"),
+    ("paddle_tpu.distributed.fleet", f"{R}/distributed/fleet/__init__.py"),
+    ("paddle_tpu.vision.models", f"{R}/vision/models/__init__.py"),
+    ("paddle_tpu.sparse.nn", f"{R}/sparse/nn/__init__.py"),
+    ("paddle_tpu.optimizer.lr", f"{R}/optimizer/lr.py"),
 ]
 
 
