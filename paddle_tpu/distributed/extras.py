@@ -318,8 +318,10 @@ def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
     import os
     os.environ.setdefault("PADDLE_TRAINER_ID", str(rank_id))
     os.environ.setdefault("PADDLE_TRAINERS_NUM", str(rank_num))
-    addr, _, port = str(server_endpoint).rpartition(":")
-    os.environ.setdefault("MASTER_ADDR", addr or server_endpoint)
+    addr, sep, port = str(server_endpoint).rpartition(":")
+    if not sep:  # endpoint without a colon: it is all host, no port
+        addr, port = str(server_endpoint), ""
+    os.environ.setdefault("MASTER_ADDR", addr)
     if port:
         os.environ.setdefault("MASTER_PORT", port)
     from .env import init_parallel_env

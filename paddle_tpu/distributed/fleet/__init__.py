@@ -140,9 +140,16 @@ class UserDefinedRoleMaker:
     def to_env(self):
         import os
         role = self.role
-        name = getattr(role, "name", None) or str(role or "TRAINER")
+        # Role values are plain ints (Role.SERVER == 2); accept those, enum
+        # members, and strings
+        if isinstance(role, int):
+            name = {1: "WORKER", 2: "SERVER", 3: "HETER_WORKER",
+                    4: "ALL"}.get(role, "TRAINER")
+        else:
+            name = getattr(role, "name", None) or str(role or "TRAINER")
         os.environ["TRAINING_ROLE"] = (
-            "PSERVER" if "SERVER" in name.upper() else "TRAINER")
+            "PSERVER" if "SERVER" in name.upper()
+            and "HETER" not in name.upper() else "TRAINER")
         os.environ["PADDLE_TRAINER_ID"] = str(self.current_id)
         os.environ["PADDLE_TRAINERS_NUM"] = str(self.num_workers)
         if self.worker_endpoints_list:

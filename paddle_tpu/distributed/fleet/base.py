@@ -225,8 +225,15 @@ class Fleet:
 
     def init(self, role_maker=None, is_collective=False, strategy=None,
              log_level="INFO"):
+        import os
         from ..env import init_parallel_env
-        init_parallel_env()
+        if role_maker is not None and hasattr(role_maker, "to_env"):
+            role_maker.to_env()
+        # A parameter server never joins the trainer rendezvous; it serves
+        # tables (fleet.init_server/run_server) while trainers init the
+        # collective env. Reference: fleet/fleet.py:218 role-maker branch.
+        if os.environ.get("TRAINING_ROLE", "TRAINER").upper() != "PSERVER":
+            init_parallel_env()
         self._strategy = strategy or DistributedStrategy()
         self._hcg = HybridCommunicateGroup(self._strategy)
         self._is_initialized = True
@@ -242,10 +249,16 @@ class Fleet:
         return self._hcg
 
     def worker_index(self):
-        return jax.process_index()
+        import os
+        v = os.environ.get("PADDLE_TRAINER_ID")
+        return int(v) if v is not None else jax.process_index()
 
     def worker_num(self):
-        return jax.process_count()
+        # a role maker / launch CLI exports the trainer count; in a plain
+        # collective env it matches jax.process_count()
+        import os
+        v = os.environ.get("PADDLE_TRAINERS_NUM")
+        return int(v) if v is not None else jax.process_count()
 
     def distributed_model(self, model):
         """Parity: fleet/model.py:33 — wrap by parallel mode."""

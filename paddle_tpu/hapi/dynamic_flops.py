@@ -270,19 +270,21 @@ def flops(net, input_size=None, custom_ops: Optional[Dict[Type, Callable]]
         # for an override, or as the detail-row value
         need_traced = ov is not None or print_detail
         traced = None
+        blind = None  # why the override has no subtraction baseline
         if need_traced and ins:
             try:
                 traced = _trace_layer_flops(layer, ins)
             except Exception as e:  # noqa: BLE001
                 traced = None
-                if ov is not None:
-                    import warnings
-                    warnings.warn(
-                        f"flops: leaf {name!r} could not re-trace "
-                        f"standalone ({e}); its custom_ops override is "
-                        "ADDED to the total instead of replacing the "
-                        "traced contribution — the total may double-count "
-                        "this layer")
+                blind = f"could not re-trace standalone ({e})"
+        elif need_traced:
+            blind = "recorded no tensor inputs"
+        if blind is not None and ov is not None:
+            import warnings
+            warnings.warn(
+                f"flops: leaf {name!r} {blind}; its custom_ops override "
+                "is ADDED to the total instead of replacing the traced "
+                "contribution — the total may double-count this layer")
         if ov is not None:
             val = int(ov(layer, ins))
             total += val - (traced or 0)  # replace traced contribution

@@ -89,12 +89,19 @@ class ModelAverage:
         self._sum = [jnp.zeros_like(p._data, jnp.float32)
                      for p in self._params]
         self._count = 0
+        # previous completed window: guarantees apply() always averages
+        # over >= min(min_average_window, total updates) samples even right
+        # after a window restart (reference rotates sum_1/sum_2/sum_3).
+        self._prev_sum = None
+        self._prev_count = 0
         self._backup = None
 
     def step(self):
         """Accumulate the current parameter values."""
         if self._count >= self.max_average_window:
-            # restart the window (reference rotates sum blocks)
+            # rotate the window, keeping the completed one for history
+            self._prev_sum = self._sum
+            self._prev_count = self._count
             self._sum = [jnp.zeros_like(s) for s in self._sum]
             self._count = 0
         for i, p in enumerate(self._params):
@@ -108,9 +115,13 @@ class ModelAverage:
         @contextlib.contextmanager
         def ctx():
             self._backup = [p._data for p in self._params]
-            n = max(self._count, 1)
+            sums, n = self._sum, self._count
+            if n < self.min_average_window and self._prev_count:
+                sums = [s + ps for s, ps in zip(sums, self._prev_sum)]
+                n += self._prev_count
+            n = max(n, 1)
             for i, p in enumerate(self._params):
-                p._data = (self._sum[i] / n).astype(p._data.dtype)
+                p._data = (sums[i] / n).astype(p._data.dtype)
             try:
                 yield
             finally:

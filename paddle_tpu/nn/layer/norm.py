@@ -207,8 +207,4 @@ class LocalResponseNorm(Layer):
                                      self.k, self.data_format)
 
 
-class SpectralNorm(Layer):
-    def __init__(self, weight_shape, axis=0, power_iters=1, epsilon=1e-12,
-                 dtype="float32"):
-        super().__init__()
-        raise NotImplementedError("SpectralNorm: planned")
+from .common import SpectralNorm  # noqa: F401, E402  (canonical home)

@@ -91,3 +91,101 @@ def test_yolo_loss_duplicate_cell_later_gt_wins():
 
     np.testing.assert_allclose(loss(gt_dup, lbl_dup),
                                loss(gt_single, lbl_single), rtol=1e-5)
+
+
+# ---- round-4 advisor findings (ADVICE.md r04) ----
+
+def test_fleet_init_honors_role_maker():
+    """ADVICE r04 (medium): Fleet.init must export the role maker's role/
+    endpoints to the env so is_server()/server_endpoints() see them.
+
+    to_env() writes os.environ directly (that is its job), so snapshot and
+    restore the full environment — monkeypatch can't see those writes."""
+    import os
+    from paddle_tpu.distributed import fleet as fl
+    snap = dict(os.environ)
+    try:
+        for k in ("TRAINING_ROLE", "PADDLE_TRAINER_ID",
+                  "PADDLE_TRAINERS_NUM", "PADDLE_TRAINER_ENDPOINTS",
+                  "PADDLE_PSERVERS_IP_PORT_LIST"):
+            os.environ.pop(k, None)
+        rm = fl.UserDefinedRoleMaker(
+            current_id=0, role=fl.Role.SERVER,
+            worker_endpoints=["127.0.0.1:9000", "127.0.0.1:9001"],
+            server_endpoints=["127.0.0.1:9100"])
+        f = fl.Fleet()
+        f.init(role_maker=rm)
+        assert fl.is_server()
+        assert not fl.is_worker()
+        assert fl.server_endpoints() == ["127.0.0.1:9100"]
+        assert fl.worker_endpoints() == ["127.0.0.1:9000",
+                                         "127.0.0.1:9001"]
+        assert fl.worker_num() == 2
+    finally:
+        os.environ.clear()
+        os.environ.update(snap)
+
+
+def test_model_average_window_restart_keeps_history():
+    """ADVICE r04: right after a window rotation apply() must not average
+    over fewer than min_average_window samples when history exists."""
+    from paddle_tpu.incubate import ModelAverage
+    p = paddle.to_tensor(np.float32(0.0))
+    ma = ModelAverage(0.15, parameters=[p], min_average_window=3,
+                      max_average_window=4)
+    for v in (1.0, 1.0, 1.0, 1.0):   # fills the first window
+        p._data = paddle.to_tensor(np.float32(v))._data
+        ma.step()
+    p._data = paddle.to_tensor(np.float32(9.0))._data
+    ma.step()                         # rotates, new window has 1 sample
+    with ma.apply(need_restore=True):
+        # history must be included: mean of 4x1.0 + 1x9.0 = 13/5, not 9.0
+        np.testing.assert_allclose(float(p.numpy()), 13.0 / 5, rtol=1e-6)
+    np.testing.assert_allclose(float(p.numpy()), 9.0)
+
+
+def test_flops_custom_op_empty_inputs_warns():
+    """ADVICE r04: custom_ops override on a leaf with no recorded tensor
+    inputs must warn about potential double-count."""
+    import warnings as _w
+    import paddle_tpu.nn as nn
+
+    class NoInput(nn.Layer):
+        def forward(self):  # takes no tensors; never traced with inputs
+            return paddle.zeros([1])
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = nn.Linear(4, 4)
+            self.side = NoInput()
+
+        def forward(self, x):
+            return self.lin(x) + self.side()
+
+    with _w.catch_warnings(record=True) as rec:
+        _w.simplefilter("always")
+        paddle.flops(Net(), [1, 4],
+                     custom_ops={NoInput: lambda layer, ins: 1000})
+    assert any("double-count" in str(r.message) for r in rec)
+
+
+def test_gloo_init_endpoint_without_colon(monkeypatch):
+    """ADVICE r04: an endpoint with no colon must not set MASTER_PORT to
+    the host string. gloo_init writes os.environ directly, so snapshot
+    and restore the full environment."""
+    import os
+    from paddle_tpu.distributed import extras as dx
+    from paddle_tpu.distributed import env as denv
+    monkeypatch.setattr(denv, "init_parallel_env", lambda: None)
+    snap = dict(os.environ)
+    try:
+        for k in ("MASTER_ADDR", "MASTER_PORT", "PADDLE_TRAINER_ID",
+                  "PADDLE_TRAINERS_NUM"):
+            os.environ.pop(k, None)
+        dx.gloo_init_parallel_env(0, 1, "myhost")
+        assert os.environ["MASTER_ADDR"] == "myhost"
+        assert "MASTER_PORT" not in os.environ
+    finally:
+        os.environ.clear()
+        os.environ.update(snap)
