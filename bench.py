@@ -51,11 +51,17 @@ def _session_fallback(extra: dict) -> tuple:
         if last.get("value", 0) <= 0:
             return 0.0, 0.0
         import datetime as _dt
+        # prefer the capture timestamp recorded inside the artifact; file
+        # mtime is the checkout time in a fresh clone, so label it as such
+        ts = last.get("extra", {}).get("captured_utc")
+        ts_key = "captured_utc" if ts else "file_mtime_utc"
+        if not ts:
+            ts = _dt.datetime.fromtimestamp(
+                os.path.getmtime(sessions[-1]),
+                _dt.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
         extra["value_source"] = {
             "file": os.path.basename(sessions[-1]),
-            "captured_utc": _dt.datetime.fromtimestamp(
-                os.path.getmtime(sessions[-1]),
-                _dt.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ"),
+            ts_key: ts,
             "note": "no live hardware measurement in this invocation (see "
                     "extra.error for why); value/vs_baseline carry the "
                     "last committed successful hardware session (file "
@@ -777,6 +783,9 @@ def main():
                 "batch": batch, "seq": seq,
                 "device": getattr(dev, "device_kind", str(dev)),
                 "peak_flops_assumed": peak_assumed,
+                "captured_utc": __import__("datetime").datetime.now(
+                    __import__("datetime").timezone.utc).strftime(
+                    "%Y-%m-%dT%H:%M:%SZ"),
             },
         }
         deadline["t"] = float("inf")

@@ -251,14 +251,15 @@ class Fleet:
     def worker_index(self):
         import os
         v = os.environ.get("PADDLE_TRAINER_ID")
-        return int(v) if v is not None else jax.process_index()
+        # empty-string env values are tolerated like env.py:25 does
+        return int(v) if v else jax.process_index()
 
     def worker_num(self):
         # a role maker / launch CLI exports the trainer count; in a plain
         # collective env it matches jax.process_count()
         import os
         v = os.environ.get("PADDLE_TRAINERS_NUM")
-        return int(v) if v is not None else jax.process_count()
+        return int(v) if v else jax.process_count()
 
     def distributed_model(self, model):
         """Parity: fleet/model.py:33 — wrap by parallel mode."""

@@ -116,7 +116,10 @@ class ModelAverage:
         def ctx():
             self._backup = [p._data for p in self._params]
             sums, n = self._sum, self._count
-            if n < self.min_average_window and self._prev_count:
+            if self._prev_count:
+                # reference semantics: sum blocks are combined
+                # unconditionally (num + old_num), so the average changes
+                # smoothly across a window rotation
                 sums = [s + ps for s, ps in zip(sums, self._prev_sum)]
                 n += self._prev_count
             n = max(n, 1)

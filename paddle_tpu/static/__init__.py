@@ -28,15 +28,27 @@ from ..jit import InputSpec  # noqa: F401  (paddle.static.InputSpec parity)
 
 class _StaticNode:
     """One recorded op: replayable fwd + input refs (Variables or concrete
-    Tensors captured by reference, e.g. Parameters)."""
+    Tensors captured by reference, e.g. Parameters). `_serial` is a
+    monotonically increasing build-order stamp — static.nn control flow
+    uses it to tell which nodes were recorded inside a branch/body trace
+    (subgraph-inner) vs before it (outer deps)."""
 
-    __slots__ = ("name", "fwd", "inputs", "n_out", "__weakref__")
+    __slots__ = ("name", "fwd", "inputs", "n_out", "_serial", "__weakref__")
+
+    _counter = [0]
 
     def __init__(self, name, fwd, inputs, n_out):
         self.name = name
         self.fwd = fwd
         self.inputs = inputs
         self.n_out = n_out
+        _StaticNode._counter[0] += 1
+        self._serial = _StaticNode._counter[0]
+
+
+def _next_node_serial() -> int:
+    """The serial the NEXT recorded node will exceed (subgraph boundary)."""
+    return _StaticNode._counter[0]
 
 
 class Variable(Tensor):
@@ -367,3 +379,7 @@ __all__ = [
     "in_dynamic_mode", "name_scope", "save_inference_model",
     "load_inference_model", "gradients",
 ]
+
+from . import nn  # noqa: F401, E402  (paddle.static.nn — layer makers +
+#                   compiled control flow; imported last to avoid cycles)
+__all__.append("nn")

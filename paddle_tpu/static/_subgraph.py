@@ -1,0 +1,217 @@
+"""Subgraph tracing for static.nn control flow.
+
+TPU-native design: `paddle.static.nn.cond/while_loop/switch_case` record a
+SINGLE static node whose `fwd` lowers to `lax.cond` / `lax.while_loop` /
+`lax.switch` over replayed branch subgraphs — compiled control flow inside
+the one XLA program the Executor builds, instead of the reference's
+sub-block Programs interpreted by the C++ executor
+(/root/reference/python/paddle/static/nn/control_flow.py:755 while_loop,
+ConditionalBlock; paddle/fluid/operators/controlflow/).
+
+Mechanics: branch/body callables run once at graph-build time against the
+normal op recorder (`record_static_op`); every node they record carries a
+build-order serial, so nodes with serial > the trace start are
+subgraph-inner and everything else they reference — outer Variables, feed
+placeholders, concrete Tensors (Parameters included) — is collected as an
+ordered dep list. The combined node takes those deps as inputs (so the
+Executor sees parameters through the control flow and passes their CURRENT
+values on every run), and its fwd replays each branch functionally under
+the lax primitive.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..tensor import Tensor
+from . import Variable, _next_node_serial, record_static_op
+
+_PH_PREFIX = "__static_ph:"
+_ph_ids = itertools.count()
+
+
+def make_placeholder(aval, tag="v") -> Variable:
+    """A bound symbolic variable (loop carry / pylayer input): never a free
+    dep, always resolved from the enclosing lax primitive's arguments."""
+    return Variable(aval, name=None,
+                    feed_name=f"{_PH_PREFIX}{tag}:{next(_ph_ids)}")
+
+
+def is_placeholder(t) -> bool:
+    fn = getattr(t, "_feed_name", None)
+    return isinstance(fn, str) and fn.startswith(_PH_PREFIX)
+
+
+def aval_of(t):
+    d = t._data
+    if isinstance(d, jax.ShapeDtypeStruct):
+        return d
+    return jax.ShapeDtypeStruct(d.shape, d.dtype)
+
+
+def flatten_output(out) -> Tuple[List[Tensor], object]:
+    """Flatten a branch return (None / Tensor / nested tuple-list-dict of
+    Tensors) into a Tensor leaf list + a treedef that `unflatten_output`
+    rebuilds. Non-tensor leaves (python numbers) are converted to arrays so
+    both branches of a cond can return literals."""
+    leaves: List[Tensor] = []
+
+    def walk(o):
+        if o is None:
+            return ("none",)
+        if isinstance(o, Tensor):
+            leaves.append(o)
+            return ("leaf",)
+        if isinstance(o, (list, tuple)):
+            return ("seq", type(o) is tuple, [walk(x) for x in o])
+        if isinstance(o, dict):
+            keys = sorted(o)
+            return ("dict", keys, [walk(o[k]) for k in keys])
+        # python scalar / numpy array: wrap as a constant tensor leaf
+        leaves.append(Tensor(jnp.asarray(o)))
+        return ("leaf",)
+
+    spec = walk(out)
+    return leaves, spec
+
+
+def unflatten_output(spec, leaves: List):
+    it = iter(leaves)
+
+    def build(s):
+        kind = s[0]
+        if kind == "none":
+            return None
+        if kind == "leaf":
+            return next(it)
+        if kind == "seq":
+            seq = [build(x) for x in s[2]]
+            return tuple(seq) if s[1] else seq
+        if kind == "dict":
+            return {k: build(x) for k, x in zip(s[1], s[2])}
+        raise AssertionError(kind)
+
+    return build(spec)
+
+
+class TracedGraph:
+    """One traced subgraph: flat output tensors + the machinery to replay
+    them given concrete values for deps and placeholders."""
+
+    def __init__(self, flat_outs: List[Tensor], start_serial: int,
+                 bound: Sequence[Variable]):
+        self.flat = flat_outs
+        self.start = start_serial
+        self.bound_ids = {id(b) for b in bound}
+        self.deps: List[Tensor] = []
+        self._collect_deps()
+
+    def _inner(self, node) -> bool:
+        return node is not None and node._serial > self.start
+
+    def _collect_deps(self):
+        seen_nodes = set()
+        dep_ids = set()
+
+        def walk(t):
+            if id(t) in self.bound_ids:
+                return
+            if isinstance(t, Variable) and self._inner(
+                    getattr(t, "_static_node", None)):
+                node = t._static_node
+                if id(node) in seen_nodes:
+                    return
+                seen_nodes.add(id(node))
+                for i in node.inputs:
+                    walk(i)
+                return
+            if is_placeholder(t):
+                raise ValueError(
+                    "static.nn control flow: a bound loop/pylayer variable "
+                    "from a DIFFERENT control-flow op leaked into this "
+                    "subgraph — branch functions may only use their own "
+                    "arguments and outer variables")
+            # outer Variable (feed or earlier-produced) or concrete Tensor
+            # (Parameter/constant): a free dependency, passed as a node
+            # input so the Executor threads its live value through
+            if id(t) not in dep_ids:
+                dep_ids.add(id(t))
+                self.deps.append(t)
+
+        for t in self.flat:
+            walk(t)
+
+    def replay(self, valuation: Dict[int, object]) -> List:
+        """Evaluate the flat outputs; `valuation` maps id(dep-or-bound
+        Variable) -> concrete array."""
+        memo: Dict[int, object] = {}
+
+        def ev(t):
+            if id(t) in valuation:
+                return valuation[id(t)]
+            node = getattr(t, "_static_node", None) \
+                if isinstance(t, Variable) else None
+            if self._inner(node):
+                if id(node) not in memo:
+                    memo[id(node)] = node.fwd(*[ev(i) for i in node.inputs])
+                out = memo[id(node)]
+                return out[t._static_idx] if node.n_out > 1 else out
+            if isinstance(t, Variable):
+                raise AssertionError(
+                    f"unresolved outer variable {t.name!r} in subgraph "
+                    "replay (dep collection missed it)")
+            return t._data  # unreachable for collected deps; safety net
+
+        return [ev(t) for t in self.flat]
+
+    def avals(self):
+        return [aval_of(t) for t in self.flat]
+
+
+def trace_callable(fn: Callable, args: Sequence[Tensor] = ()) -> Tuple[
+        List[Tensor], object, TracedGraph]:
+    """Run a branch/body callable at build time; return (flat leaf tensors,
+    treedef, TracedGraph). `args` become bound placeholders."""
+    start = _next_node_serial()
+    out = fn(*args)
+    flat, spec = flatten_output(out)
+    return flat, spec, TracedGraph(flat, start, bound=list(args))
+
+
+def merge_deps(*graphs: TracedGraph) -> List[Tensor]:
+    """Union of the graphs' deps, order-stable, unique by identity."""
+    deps: List[Tensor] = []
+    seen = set()
+    for g in graphs:
+        for d in g.deps:
+            if id(d) not in seen:
+                seen.add(id(d))
+                deps.append(d)
+    return deps
+
+
+def check_same_structure(spec_a, spec_b, avals_a, avals_b, what: str):
+    if spec_a != spec_b:
+        raise ValueError(
+            f"static.nn.{what}: branches must return the same nested "
+            f"structure; got {spec_a} vs {spec_b}")
+    for i, (a, b) in enumerate(zip(avals_a, avals_b)):
+        if tuple(a.shape) != tuple(b.shape) or a.dtype != b.dtype:
+            raise ValueError(
+                f"static.nn.{what}: output {i} mismatches across branches: "
+                f"{a.shape}/{a.dtype} vs {b.shape}/{b.dtype} (XLA control "
+                "flow requires identical shapes and dtypes)")
+
+
+def as_bool_scalar(x):
+    return jnp.asarray(x).reshape(()).astype(bool)
+
+
+def is_traced(t) -> bool:
+    """True when the value is a jax tracer (inside to_static / jax.jit
+    tracing): control flow must lower to lax primitives to stay compiled."""
+    return isinstance(getattr(t, "_data", t), jax.core.Tracer)

@@ -1,0 +1,78 @@
+"""paddle.static.nn — static-graph layer makers + compiled control flow.
+
+Parity: /root/reference/python/paddle/static/nn/__init__.py:49-81
+(__all__ mirrored exactly). The control-flow ops are the TPU-native
+centerpiece: cond/while_loop/case/switch_case lower to
+lax.cond/lax.while_loop/lax.switch, so data-dependent control flow stays
+inside the compiled program in all three modes (static Program build,
+jit.to_static tracing, eager).
+"""
+from ...ops.tail import create_parameter  # noqa: F401
+from .common import (  # noqa: F401
+    batch_norm,
+    bilinear_tensor_product,
+    continuous_value_model,
+    conv2d,
+    conv2d_transpose,
+    conv3d,
+    conv3d_transpose,
+    data_norm,
+    deform_conv2d,
+    embedding,
+    fc,
+    group_norm,
+    instance_norm,
+    layer_norm,
+    prelu,
+    py_func,
+    row_conv,
+    sparse_embedding,
+    spectral_norm,
+)
+from .control_flow import Assert, case, cond, switch_case, while_loop  # noqa: F401
+from .loss import nce  # noqa: F401
+from .sequence_lod import (  # noqa: F401
+    sequence_conv,
+    sequence_expand,
+    sequence_first_step,
+    sequence_last_step,
+    sequence_pool,
+    sequence_softmax,
+)
+from .static_pylayer import static_pylayer  # noqa: F401
+
+# exact mirror of the reference __all__ (static/nn/__init__.py:49-81),
+# including its duplicated trailing 'prelu'
+__all__ = [
+    'fc',
+    'batch_norm',
+    'bilinear_tensor_product',
+    'embedding',
+    'case',
+    'cond',
+    'static_pylayer',
+    'conv2d',
+    'conv2d_transpose',
+    'conv3d',
+    'conv3d_transpose',
+    'data_norm',
+    'deform_conv2d',
+    'group_norm',
+    'instance_norm',
+    'layer_norm',
+    'nce',
+    'prelu',
+    'py_func',
+    'row_conv',
+    'spectral_norm',
+    'switch_case',
+    'while_loop',
+    'sparse_embedding',
+    'sequence_conv',
+    'sequence_softmax',
+    'sequence_pool',
+    'sequence_first_step',
+    'sequence_last_step',
+    'sequence_expand',
+    'prelu',
+]

@@ -1,0 +1,441 @@
+"""paddle.static.nn layer makers — thin constructors over the existing
+functional ops + create_parameter, recording into the static Program.
+
+Parity: /root/reference/python/paddle/static/nn/common.py (fc :48,
+batch_norm :2613, embedding :3689, conv2d :780, conv2d_transpose :1377,
+layer_norm :3553, group_norm :668, instance_norm :272, data_norm :461,
+prelu :2937, row_conv :3331, spectral_norm :3415, bilinear_tensor_product
+:2538, deform_conv2d :2362, continuous_value_model :412, sparse_embedding
+:3840). The reference makers append ops + persistable vars to the
+ProgramDesc; here they create live Parameters (captured by reference in
+the recorded graph, so Executor training updates them) and route the
+compute through the same dispatch chokepoint the eager API uses — one
+code path, two modes.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax.numpy as jnp
+
+from ...tensor import Tensor
+from ...nn import functional as F
+from .._extras import create_parameter, py_func  # noqa: F401  (re-export)
+
+__all__ = [
+    "fc", "batch_norm", "bilinear_tensor_product", "continuous_value_model",
+    "conv2d", "conv2d_transpose", "conv3d", "conv3d_transpose", "data_norm",
+    "deform_conv2d", "embedding", "group_norm", "instance_norm",
+    "layer_norm", "prelu", "py_func", "row_conv", "sparse_embedding",
+    "spectral_norm",
+]
+
+
+def _act(out, act: Optional[str]):
+    if act is None:
+        return out
+    fn = getattr(F, act, None)
+    if fn is None:
+        raise ValueError(f"static.nn: unknown activation {act!r}")
+    return fn(out)
+
+
+def _dtype_of(x) -> str:
+    return str(x._data.dtype)
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """Parity: common.py:48 — per-input weight, summed, plus one bias.
+    Input dims after `num_flatten_dims` are flattened into the feature
+    axis."""
+    xs = list(x) if isinstance(x, (list, tuple)) else [x]
+    outs = []
+    for i, xi in enumerate(xs):
+        shape = tuple(xi._data.shape)
+        if num_flatten_dims < 1 or num_flatten_dims >= len(shape):
+            raise ValueError(
+                f"fc: num_flatten_dims must be in [1, {len(shape) - 1}) "
+                f"for input rank {len(shape)}")
+        feat = 1
+        for d in shape[num_flatten_dims:]:
+            feat *= int(d)
+        w = create_parameter([feat, size], _dtype_of(xi), attr=weight_attr,
+                             name=None if name is None else f"{name}_w{i}")
+        xi2 = xi.reshape(list(shape[:num_flatten_dims]) + [feat]) \
+            if len(shape) != num_flatten_dims + 1 or shape[-1] != feat \
+            else xi
+        outs.append(F.linear(xi2, w))
+    out = outs[0]
+    for o in outs[1:]:
+        out = out + o
+    if bias_attr is not False:
+        b = create_parameter([size], _dtype_of(out), attr=bias_attr,
+                             is_bias=True,
+                             name=None if name is None else f"{name}_b")
+        out = out + b
+    return _act(out, activation)
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32"):
+    """Parity: common.py:3689. `is_sparse`/`is_distributed` route through
+    the same dense lookup — sparse-gradient tables are the PS path
+    (distributed.ps HostEmbedding)."""
+    w = create_parameter(list(size), dtype, attr=param_attr)
+    return F.embedding(input, w, padding_idx=padding_idx)
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False,
+                     entry=None, table_class="MemorySparseTable",
+                     param_attr=None, dtype="float32", slot=None):
+    """Parity: common.py:3840 — the huge-vocab PS-backed table. The
+    in-graph form is a dense lookup; genuinely PS-backed rows live on
+    distributed.ps.HostEmbedding (DESIGN_PS.md)."""
+    return embedding(input, size, is_sparse=True, padding_idx=padding_idx,
+                     param_attr=param_attr, dtype=dtype)
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-05,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               in_place=False, name=None, moving_mean_name=None,
+               moving_variance_name=None,
+               do_model_average_for_mean_and_var=True,
+               use_global_stats=False):
+    """Parity: common.py:2613. Training mode normalizes with batch stats
+    computed in-graph; the moving averages are persistable parameters used
+    at is_test=True. NOTE (TPU-native): the Executor's replay is a pure
+    function, so moving stats are not auto-updated across run() calls —
+    set them explicitly (set_program_state) or train in dygraph where the
+    eager buffers mutate."""
+    shape = tuple(input._data.shape)
+    ch_axis = len(shape) - 1 if data_layout.endswith("C") and \
+        data_layout != "NCHW" and len(shape) > 2 else 1
+    c = int(shape[ch_axis])
+    dt = _dtype_of(input)
+    scale = create_parameter([c], dt, attr=param_attr,
+                             default_initializer=None
+                             if param_attr is not None else _ones_init())
+    shift = create_parameter([c], dt, attr=bias_attr, is_bias=True)
+    mean = create_parameter([c], dt, name=moving_mean_name, is_bias=True)
+    var = create_parameter([c], dt, name=moving_variance_name,
+                           default_initializer=_ones_init())
+    mean.stop_gradient = True
+    var.stop_gradient = True
+    if is_test or use_global_stats:
+        out = F.batch_norm(input, mean, var, weight=scale, bias=shift,
+                           training=False, momentum=momentum,
+                           epsilon=epsilon, data_format=data_layout)
+    else:
+        axes = [i for i in range(len(shape)) if i != ch_axis]
+        bshape = [1] * len(shape)
+        bshape[ch_axis] = c
+        m = input.astype("float32").mean(axis=axes)
+        v = (input.astype("float32") ** 2).mean(axis=axes) - m * m
+        out = ((input.astype("float32") - m.reshape(bshape))
+               / (v.reshape(bshape) + epsilon).sqrt())
+        out = out * scale.astype("float32").reshape(bshape) \
+            + shift.astype("float32").reshape(bshape)
+        out = out.astype(dt)
+    return _act(out, act)
+
+
+def _ones_init():
+    from ...nn.initializer import Constant
+    return Constant(1.0)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-05, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    """Parity: common.py:3553 — normalizes over dims[begin_norm_axis:]."""
+    shape = tuple(int(d) for d in input._data.shape[begin_norm_axis:])
+    dt = _dtype_of(input)
+    w = create_parameter(list(shape), dt, attr=param_attr,
+                         default_initializer=_ones_init()) if scale \
+        else None
+    b = create_parameter(list(shape), dt, attr=bias_attr, is_bias=True) \
+        if shift else None
+    out = F.layer_norm(input, shape, weight=w, bias=b, epsilon=epsilon)
+    return _act(out, act)
+
+
+def group_norm(input, groups, epsilon=1e-05, param_attr=None,
+               bias_attr=None, act=None, data_layout="NCHW", name=None):
+    """Parity: common.py:668."""
+    ch_axis = 1 if data_layout == "NCHW" else len(input._data.shape) - 1
+    c = int(input._data.shape[ch_axis])
+    dt = _dtype_of(input)
+    w = None if param_attr is False else create_parameter(
+        [c], dt, attr=param_attr, default_initializer=_ones_init())
+    b = None if bias_attr is False else create_parameter(
+        [c], dt, attr=bias_attr, is_bias=True)
+    out = F.group_norm(input, groups, epsilon=epsilon, weight=w, bias=b,
+                       data_format=data_layout)
+    return _act(out, act)
+
+
+def instance_norm(input, epsilon=1e-05, param_attr=None, bias_attr=None,
+                  name=None):
+    """Parity: common.py:272."""
+    c = int(input._data.shape[1])
+    dt = _dtype_of(input)
+    w = None if param_attr is False else create_parameter(
+        [c], dt, attr=param_attr, default_initializer=_ones_init())
+    b = None if bias_attr is False else create_parameter(
+        [c], dt, attr=bias_attr, is_bias=True)
+    return F.instance_norm(input, weight=w, bias=b, eps=epsilon)
+
+
+def data_norm(input, act=None, epsilon=1e-05, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              sync_stats=False, summary_decay_rate=0.9999999,
+              enable_scale_and_shift=False):
+    """Parity: common.py:461 — normalization from accumulated
+    batch_size/batch_sum/batch_square_sum summaries (the CTR/PS data
+    normalization). The summaries are persistable parameters; like
+    batch_norm's moving stats they are read, not auto-accumulated, by the
+    pure-function Executor."""
+    c = int(input._data.shape[-1])
+    dt = _dtype_of(input)
+    from ...nn.initializer import Constant
+    batch_size = create_parameter([c], dt, name=None,
+                                  default_initializer=Constant(1e4))
+    batch_sum = create_parameter([c], dt, default_initializer=Constant(0.0))
+    batch_sq = create_parameter([c], dt,
+                                default_initializer=Constant(1e4))
+    for p in (batch_size, batch_sum, batch_sq):
+        p.stop_gradient = True
+    mean = batch_sum / batch_size
+    scale = (batch_size / batch_sq).sqrt()
+    out = (input - mean) * scale
+    if enable_scale_and_shift:
+        w = create_parameter([c], dt, attr=param_attr,
+                             default_initializer=_ones_init())
+        b = create_parameter([c], dt, is_bias=True)
+        out = out * w + b
+    return _act(out, act)
+
+
+def _conv_maker(fdim, transpose=False):
+    fconv = {2: (F.conv2d, F.conv2d_transpose),
+             3: (F.conv3d, F.conv3d_transpose)}[fdim][int(transpose)]
+
+    def maker(input, num_filters, filter_size=None, *, output_size=None,
+              stride=1, padding=0, dilation=1, groups=None, param_attr=None,
+              bias_attr=None, use_cudnn=True, act=None, name=None,
+              data_format="NCHW"):
+        groups = groups or 1
+        ch_axis = 1 if data_format in ("NCHW", "NCDHW") else \
+            len(input._data.shape) - 1
+        cin = int(input._data.shape[ch_axis])
+        ks = filter_size if isinstance(filter_size, (list, tuple)) \
+            else [filter_size] * fdim
+        ks = [int(k) for k in ks]
+        dt = _dtype_of(input)
+        if transpose:
+            wshape = [cin, num_filters // groups] + ks
+        else:
+            wshape = [num_filters, cin // groups] + ks
+        w = create_parameter(wshape, dt, attr=param_attr)
+        b = None if bias_attr is False else create_parameter(
+            [num_filters], dt, attr=bias_attr, is_bias=True)
+        kw = dict(stride=stride, padding=padding, dilation=dilation,
+                  groups=groups, data_format=data_format)
+        if transpose and output_size is not None:
+            kw["output_size"] = output_size
+        out = fconv(input, w, b, **kw)
+        return _act(out, act)
+
+    return maker
+
+
+_conv2d_impl = _conv_maker(2)
+_conv3d_impl = _conv_maker(3)
+_conv2dt_impl = _conv_maker(2, transpose=True)
+_conv3dt_impl = _conv_maker(3, transpose=True)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=None, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None, data_format="NCHW"):
+    """Parity: common.py:780."""
+    return _conv2d_impl(input, num_filters, filter_size, stride=stride,
+                        padding=padding, dilation=dilation, groups=groups,
+                        param_attr=param_attr, bias_attr=bias_attr,
+                        act=act, name=name, data_format=data_format)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=None, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None, data_format="NCDHW"):
+    """Parity: common.py:1088."""
+    return _conv3d_impl(input, num_filters, filter_size, stride=stride,
+                        padding=padding, dilation=dilation, groups=groups,
+                        param_attr=param_attr, bias_attr=bias_attr,
+                        act=act, name=name, data_format=data_format)
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=None,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCHW"):
+    """Parity: common.py:1377."""
+    if filter_size is None:
+        raise ValueError("conv2d_transpose: filter_size must be given "
+                         "(output_size-only inference is not supported)")
+    return _conv2dt_impl(input, num_filters, filter_size,
+                         output_size=output_size, stride=stride,
+                         padding=padding, dilation=dilation, groups=groups,
+                         param_attr=param_attr, bias_attr=bias_attr,
+                         act=act, name=name, data_format=data_format)
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=None,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCDHW"):
+    """Parity: common.py:1753."""
+    if filter_size is None:
+        raise ValueError("conv3d_transpose: filter_size must be given")
+    return _conv3dt_impl(input, num_filters, filter_size,
+                         output_size=output_size, stride=stride,
+                         padding=padding, dilation=dilation, groups=groups,
+                         param_attr=param_attr, bias_attr=bias_attr,
+                         act=act, name=name, data_format=data_format)
+
+
+def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, weight_attr=None, bias_attr=None,
+                  name=None):
+    """Parity: common.py:2362 — creates the filter/bias and defers to the
+    vision deform_conv2d op."""
+    from ...vision.ops import deform_conv2d as _dc
+    cin = int(x._data.shape[1])
+    ks = filter_size if isinstance(filter_size, (list, tuple)) \
+        else [filter_size] * 2
+    dt = _dtype_of(x)
+    w = create_parameter([num_filters, cin // groups] + [int(k) for k in ks],
+                         dt, attr=weight_attr)
+    b = None if bias_attr is False else create_parameter(
+        [num_filters], dt, attr=bias_attr, is_bias=True)
+    return _dc(x, offset, w, bias=b, stride=stride, padding=padding,
+               dilation=dilation, deformable_groups=deformable_groups,
+               groups=groups, mask=mask)
+
+
+def prelu(x, mode, param_attr=None, data_format="NCHW", name=None):
+    """Parity: common.py:2937 — modes: all (one alpha), channel (one per
+    channel), element (one per element)."""
+    shape = tuple(x._data.shape)
+    if mode == "all":
+        ashape: List[int] = [1]
+    elif mode == "channel":
+        ch_axis = 1 if data_format == "NCHW" else len(shape) - 1
+        ashape = [int(shape[ch_axis])]
+    elif mode == "element":
+        ashape = [1] + [int(d) for d in shape[1:]]
+    else:
+        raise ValueError(f"prelu: unknown mode {mode!r}")
+    from ...nn.initializer import Constant
+    alpha = create_parameter(ashape, _dtype_of(x), attr=param_attr,
+                             default_initializer=Constant(0.25))
+    if mode == "element":
+        from ...ops.dispatch import dispatch
+
+        def fwd(a, al):
+            return jnp.where(a > 0, a, al * a)
+
+        return dispatch("prelu", fwd, x, alpha)
+    return F.prelu(x, alpha, data_format=data_format)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """Parity: common.py:3331 — lookahead row convolution over [B, T, D]:
+    out[t] = sum_{i<=future_context_size} x[t+i] * W[i] (Hadamard per
+    feature). Dense layout (padded batch), the TPU-native form of the
+    reference's LoD variant."""
+    shape = tuple(input._data.shape)
+    if len(shape) != 3:
+        raise ValueError("row_conv expects [batch, time, dim] input")
+    d = int(shape[2])
+    w = create_parameter([future_context_size + 1, d], _dtype_of(input),
+                         attr=param_attr)
+    from ...ops.dispatch import dispatch
+
+    def fwd(a, wt):
+        t = a.shape[1]
+        out = jnp.zeros_like(a)
+        for i in range(future_context_size + 1):
+            out = out.at[:, :t - i, :].add(a[:, i:t, :] * wt[i])
+        return out
+
+    out = dispatch("row_conv", fwd, input, w)
+    return _act(out, act)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """Parity: common.py:3415 — returns the spectrally-normalized weight
+    via power iteration with fixed (untrained) u/v vectors."""
+    shape = tuple(int(d) for d in weight._data.shape)
+    h = shape[dim]
+    w_mat_cols = 1
+    for i, s in enumerate(shape):
+        if i != dim:
+            w_mat_cols *= s
+    from ...nn.initializer import Normal
+    u = create_parameter([h], _dtype_of(weight),
+                         default_initializer=Normal(0.0, 1.0))
+    v = create_parameter([w_mat_cols], _dtype_of(weight),
+                         default_initializer=Normal(0.0, 1.0))
+    u.stop_gradient = True
+    v.stop_gradient = True
+    from ...ops.dispatch import dispatch
+
+    def fwd(w, uu, vv):
+        perm = [dim] + [i for i in range(len(shape)) if i != dim]
+        wm = jnp.transpose(w, perm).reshape(h, w_mat_cols)
+        for _ in range(power_iters):
+            vv = wm.T @ uu
+            vv = vv / (jnp.linalg.norm(vv) + eps)
+            uu = wm @ vv
+            uu = uu / (jnp.linalg.norm(uu) + eps)
+        sigma = uu @ wm @ vv
+        return w / sigma
+
+    return dispatch("spectral_norm", fwd, weight, u, v)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    """Parity: common.py:2538 — out_i = x @ W_i @ y^T + b."""
+    m = int(x._data.shape[-1])
+    n = int(y._data.shape[-1])
+    dt = _dtype_of(x)
+    w = create_parameter([size, m, n], dt, attr=param_attr)
+    b = None if bias_attr is False else create_parameter(
+        [size], dt, attr=bias_attr, is_bias=True)
+    out = F.bilinear(x, y, w, b)
+    return _act(out, act)
+
+
+def continuous_value_model(input, cvm, use_cvm=True):
+    """Parity: common.py:412 (cvm op) — show/click feature transform for
+    CTR models: input [B, D] whose first two features are (show, click).
+    use_cvm=True keeps all D features with log-transformed show/click;
+    False strips the two leading features."""
+    from ...ops.dispatch import dispatch, ensure_tensor
+    xt = ensure_tensor(input)
+    ct = ensure_tensor(cvm)
+
+    def fwd(a, c):
+        show = jnp.log(a[:, :1] + 1.0)
+        click = jnp.log(a[:, 1:2] + 1.0) - show
+        if use_cvm:
+            return jnp.concatenate([show, click, a[:, 2:]], axis=1)
+        return a[:, 2:]
+
+    return dispatch("cvm", fwd, xt, ct)

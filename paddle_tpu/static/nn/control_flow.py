@@ -1,0 +1,418 @@
+"""Compiled control flow: paddle.static.nn.{cond, while_loop, case,
+switch_case} + Assert.
+
+Parity targets: /root/reference/python/paddle/static/nn/control_flow.py
+(cond :1637, while_loop :755, case :1062, switch_case :1185, Assert :59).
+
+TPU-native design — one op, three modes:
+- **static graph build** (inputs are static Variables): records ONE node
+  whose fwd is `lax.cond` / `lax.while_loop` / `lax.switch` over replayed
+  branch subgraphs (see static/_subgraph.py). The Executor's single XLA
+  program therefore contains real compiled control flow, not interpreter
+  blocks.
+- **traced** (inside jit.to_static / jax.jit: values are tracers): lowers
+  directly to the lax primitive, so a data-dependent `if`/`while` written
+  with these ops COMPILES instead of graph-breaking to eager.
+- **eager** (concrete values): plain Python semantics, matching the
+  reference's dygraph behavior (pick the branch / loop in Python, which
+  keeps the autograd tape exact for the taken path).
+
+Deliberate deviation from the reference: all branches must return the same
+nested structure with identical shapes/dtypes. The reference's legacy
+interpreter executes only the selected sub-block and so tolerates
+divergent shapes (control_flow.py case example returns [1,2] f32 vs [2,2]
+i32); XLA's functional control flow cannot represent that, and on TPU you
+would not want it to (shape-divergent programs defeat static compilation).
+A clear build-time error enforces the contract.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...tensor import Tensor
+from .. import Variable, record_static_op
+from .._subgraph import (aval_of, as_bool_scalar, check_same_structure,
+                         flatten_output, is_traced, make_placeholder,
+                         merge_deps, trace_callable, unflatten_output)
+
+__all__ = ["Assert", "case", "cond", "switch_case", "while_loop"]
+
+
+def _mode(*tensors) -> str:
+    """'static' if any input is a symbolic Variable, 'traced' if any is a
+    jax tracer, else 'eager'."""
+    ts = [t for t in tensors if isinstance(t, Tensor)]
+    if any(isinstance(t, Variable) for t in ts):
+        return "static"
+    if any(is_traced(t) for t in ts):
+        return "traced"
+    return "eager"
+
+
+def _wrap(arr) -> Tensor:
+    return Tensor(arr)
+
+
+# ---------------------------------------------------------------------------
+# cond
+# ---------------------------------------------------------------------------
+
+def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
+    """Parity: static/nn/control_flow.py:1637. Runs `true_fn()` when `pred`
+    is true else `false_fn()`; compiles to `lax.cond` in static/traced
+    modes."""
+    if true_fn is not None and not callable(true_fn):
+        raise TypeError("cond: true_fn must be callable")
+    if false_fn is not None and not callable(false_fn):
+        raise TypeError("cond: false_fn must be callable")
+    m = _mode(pred)
+    if m == "eager":
+        taken = bool(jnp.asarray(
+            pred._data if isinstance(pred, Tensor) else pred).reshape(()))
+        fn = true_fn if taken else false_fn
+        return fn() if fn is not None else None
+    if m == "traced":
+        return _traced_cond(pred, true_fn, false_fn)
+    return _static_cond(pred, true_fn, false_fn)
+
+
+def _run_branch_pair(true_fn, false_fn, what, args=()):
+    t_flat, t_spec, t_graph = trace_callable(true_fn or (lambda *a: None),
+                                             args)
+    f_flat, f_spec, f_graph = trace_callable(false_fn or (lambda *a: None),
+                                             args)
+    check_same_structure(t_spec, f_spec, t_graph.avals(), f_graph.avals(),
+                         what)
+    return (t_flat, t_spec, t_graph), (f_flat, f_spec, f_graph)
+
+
+def _static_cond(pred, true_fn, false_fn):
+    (t_flat, t_spec, t_graph), (f_flat, _, f_graph) = _run_branch_pair(
+        true_fn, false_fn, "cond")
+    if not t_flat:  # both branches return None / empty
+        return None
+    deps = merge_deps(t_graph, f_graph)
+
+    def fwd(pred_v, *dep_vals):
+        def run(graph):
+            def br(vals):
+                val = {id(d): v for d, v in zip(deps, vals)}
+                return tuple(graph.replay(val))
+            return br
+        res = lax.cond(as_bool_scalar(pred_v), run(t_graph), run(f_graph),
+                       dep_vals)
+        return res if len(res) != 1 else res[0]
+
+    outs = record_static_op("cond", fwd, [pred] + deps)
+    outs = outs if isinstance(outs, tuple) else (outs,)
+    return unflatten_output(t_spec, list(outs))
+
+
+def _traced_cond(pred, true_fn, false_fn):
+    spec_cell = {}
+
+    def mk(fn, key):
+        def br(_):
+            out = fn() if fn is not None else None
+            flat, spec = flatten_output(out)
+            spec_cell[key] = spec
+            return tuple(t._data for t in flat)
+        return br
+
+    p = as_bool_scalar(pred._data if isinstance(pred, Tensor) else pred)
+    arrs = lax.cond(p, mk(true_fn, "t"), mk(false_fn, "f"), ())
+    if spec_cell["t"] != spec_cell["f"]:
+        raise ValueError(
+            f"static.nn.cond: branches must return the same nested "
+            f"structure; got {spec_cell['t']} vs {spec_cell['f']}")
+    return unflatten_output(spec_cell["t"], [_wrap(a) for a in arrs])
+
+
+# ---------------------------------------------------------------------------
+# while_loop
+# ---------------------------------------------------------------------------
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None):
+    """Parity: static/nn/control_flow.py:755. Repeats `body` while
+    `cond(*loop_vars)` holds; compiles to `lax.while_loop` in static/traced
+    modes.
+
+    Reverse-mode gradients THROUGH a compiled while_loop are not defined
+    (XLA's while is forward-differentiable only); training losses that need
+    a differentiable loop should use a fixed trip count (lax.scan-backed
+    ops such as cumulative sums) — same constraint the compiled path of the
+    reference's CINN backend has."""
+    if not callable(cond):
+        raise TypeError("while_loop: cond must be callable")
+    if not callable(body):
+        raise TypeError("while_loop: body must be callable")
+    if not isinstance(loop_vars, (list, tuple)) or not loop_vars:
+        raise ValueError("while_loop: loop_vars must be a non-empty "
+                         "list/tuple")
+    loop_vars = list(loop_vars)
+    m = _mode(*loop_vars)
+    if m == "eager":
+        # the loop vars may be concrete while the condition/body reference
+        # symbolic Variables (static.data) or tracers through closures —
+        # probe one condition evaluation to find the true mode
+        probe = cond(*loop_vars)
+        if isinstance(probe, Variable):
+            m = "static"
+        elif is_traced(probe):
+            m = "traced"
+        else:
+            taken = bool(jnp.asarray(probe._data).reshape(()))
+            while taken:
+                out = body(*loop_vars)
+                loop_vars = list(out) if isinstance(out, (list, tuple)) \
+                    else [out]
+                taken = bool(jnp.asarray(
+                    cond(*loop_vars)._data).reshape(()))
+            return loop_vars
+    if m == "traced":
+        return _traced_while(cond, body, loop_vars)
+    return _static_while(cond, body, loop_vars)
+
+
+def _check_carry(init_avals, out_avals):
+    if len(init_avals) != len(out_avals):
+        raise ValueError(
+            f"while_loop: body returned {len(out_avals)} vars, expected "
+            f"{len(init_avals)} (must match loop_vars)")
+    for i, (a, b) in enumerate(zip(init_avals, out_avals)):
+        if tuple(a.shape) != tuple(b.shape) or a.dtype != b.dtype:
+            raise ValueError(
+                f"while_loop: loop var {i} changes from {a.shape}/{a.dtype}"
+                f" to {b.shape}/{b.dtype} across an iteration; XLA while "
+                "requires a fixed carry signature")
+
+
+def _static_while(cond_fn, body_fn, loop_vars):
+    phs = [make_placeholder(aval_of(v), "loop") for v in loop_vars]
+    c_flat, _, c_graph = trace_callable(lambda *a: cond_fn(*a), phs)
+    if len(c_flat) != 1:
+        raise ValueError("while_loop: cond must return a single boolean "
+                         "Tensor")
+    def _body_once(*a):
+        out = body_fn(*a)
+        return tuple(out) if isinstance(out, list) else out
+
+    b_flat, b_spec, b_graph = trace_callable(_body_once, phs)
+    _check_carry([aval_of(v) for v in loop_vars],
+                 [aval_of(t) for t in b_flat])
+    deps = merge_deps(c_graph, b_graph)
+    nd = len(deps)
+
+    def fwd(*args):
+        dep_vals, init = args[:nd], args[nd:]
+        base = {id(d): v for d, v in zip(deps, dep_vals)}
+
+        def cfun(carry):
+            val = dict(base)
+            val.update({id(p): c for p, c in zip(phs, carry)})
+            return as_bool_scalar(c_graph.replay(val)[0])
+
+        def bfun(carry):
+            val = dict(base)
+            val.update({id(p): c for p, c in zip(phs, carry)})
+            return tuple(b_graph.replay(val))
+
+        res = lax.while_loop(cfun, bfun, tuple(init))
+        return res if len(res) != 1 else res[0]
+
+    outs = record_static_op("while_loop", fwd, deps + loop_vars)
+    outs = outs if isinstance(outs, tuple) else (outs,)
+    return unflatten_output(b_spec, list(outs))
+
+
+def _traced_while(cond_fn, body_fn, loop_vars):
+    init = tuple(jnp.asarray(v._data) if isinstance(v, Tensor)
+                 else jnp.asarray(v) for v in loop_vars)
+
+    def cfun(carry):
+        out = cond_fn(*[_wrap(c) for c in carry])
+        return as_bool_scalar(out._data if isinstance(out, Tensor) else out)
+
+    def bfun(carry):
+        out = body_fn(*[_wrap(c) for c in carry])
+        out = list(out) if isinstance(out, (list, tuple)) else [out]
+        arrs = tuple(t._data if isinstance(t, Tensor) else jnp.asarray(t)
+                     for t in out)
+        _check_carry([jax.ShapeDtypeStruct(c.shape, c.dtype)
+                      for c in carry],
+                     [jax.ShapeDtypeStruct(a.shape, a.dtype)
+                      for a in arrs])
+        return arrs
+
+    final = lax.while_loop(cfun, bfun, init)
+    return [_wrap(a) for a in final]
+
+
+# ---------------------------------------------------------------------------
+# case / switch_case
+# ---------------------------------------------------------------------------
+
+def _validate_pairs(pred_fn_pairs):
+    if not isinstance(pred_fn_pairs, (list, tuple)) or not pred_fn_pairs:
+        raise TypeError("case: pred_fn_pairs must be a non-empty "
+                        "list/tuple")
+    for pair in pred_fn_pairs:
+        if not isinstance(pair, tuple) or len(pair) != 2:
+            raise TypeError("case: each element must be a (pred, fn) "
+                            "2-tuple")
+        pred, fn = pair
+        if not isinstance(pred, Tensor):
+            raise TypeError("case: pred must be a Tensor")
+        if not callable(fn):
+            raise TypeError("case: fn must be callable")
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """Parity: static/nn/control_flow.py:1062 — if / elif / else chain;
+    first true pred wins; with no default, the LAST fn is the fallback."""
+    _validate_pairs(pred_fn_pairs)
+    if default is not None and not callable(default):
+        raise TypeError("case: default must be callable")
+    pairs = list(pred_fn_pairs)
+    if default is None:
+        # reference semantics: last fn doubles as the default
+        default = pairs[-1][1]
+        pairs = pairs[:-1]
+        if not pairs:
+            return default()
+
+    def build(i):
+        if i == len(pairs):
+            return default()
+        pred, fn = pairs[i]
+        return cond(pred, fn, lambda: build(i + 1))
+
+    return build(0)
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """Parity: static/nn/control_flow.py:1185 — C-style switch over an
+    integer index; compiles to `lax.switch` in static/traced modes."""
+    if not isinstance(branch_index, Tensor):
+        raise TypeError("switch_case: branch_index must be a Tensor")
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+    elif isinstance(branch_fns, (list, tuple)):
+        if all(callable(f) for f in branch_fns):
+            items = list(enumerate(branch_fns))
+        else:
+            items = []
+            for el in branch_fns:
+                if not isinstance(el, tuple) or len(el) != 2:
+                    raise TypeError("switch_case: elements of branch_fns "
+                                    "must be (int, callable) 2-tuples")
+                items.append(el)
+            items.sort(key=lambda kv: kv[0])
+    else:
+        raise TypeError("switch_case: branch_fns must be dict, list or "
+                        "tuple")
+    keys = [k for k, _ in items]
+    if len(set(keys)) != len(keys):
+        raise ValueError("switch_case: branch index keys must be unique")
+    for k, f in items:
+        if not isinstance(k, int):
+            raise TypeError("switch_case: branch keys must be python int")
+        if not callable(f):
+            raise TypeError("switch_case: branch fns must be callable")
+    if default is None:
+        # reference semantics: the max-index fn doubles as the default
+        default = items[-1][1]
+    elif not callable(default):
+        raise TypeError("switch_case: default must be callable")
+
+    fns = [f for _, f in items] + [default]
+    m = _mode(branch_index)
+
+    if m == "eager":
+        idx = int(jnp.asarray(branch_index._data).reshape(()))
+        return fns[keys.index(idx) if idx in keys else len(keys)]()
+
+    def mapped_index(idx_arr):
+        idx = jnp.asarray(idx_arr).reshape(()).astype(jnp.int32)
+        sel = jnp.int32(len(keys))  # default position
+        for pos, k in enumerate(keys):
+            sel = jnp.where(idx == k, jnp.int32(pos), sel)
+        return sel
+
+    if m == "traced":
+        spec_cell = {}
+
+        def mk(fn, key):
+            def br(_):
+                flat, spec = flatten_output(fn())
+                spec_cell[key] = spec
+                return tuple(t._data for t in flat)
+            return br
+
+        arrs = lax.switch(mapped_index(branch_index._data),
+                          [mk(f, i) for i, f in enumerate(fns)], ())
+        specs = [spec_cell[i] for i in range(len(fns))]
+        if any(s != specs[0] for s in specs):
+            raise ValueError("static.nn.switch_case: all branches must "
+                             "return the same nested structure")
+        return unflatten_output(specs[0], [_wrap(a) for a in arrs])
+
+    # static graph build
+    traced = [trace_callable(f) for f in fns]
+    spec0, avals0 = traced[0][1], traced[0][2].avals()
+    for flat, spec, graph in traced[1:]:
+        check_same_structure(spec0, spec, avals0, graph.avals(),
+                             "switch_case")
+    deps = merge_deps(*[g for _, _, g in traced])
+
+    def fwd(idx_v, *dep_vals):
+        branches = []
+        for _, _, graph in traced:
+            def br(vals, graph=graph):
+                val = {id(d): v for d, v in zip(deps, vals)}
+                return tuple(graph.replay(val))
+            branches.append(br)
+        res = lax.switch(mapped_index(idx_v), branches, dep_vals)
+        return res if len(res) != 1 else res[0]
+
+    outs = record_static_op("switch_case", fwd, [branch_index] + deps)
+    outs = outs if isinstance(outs, tuple) else (outs,)
+    return unflatten_output(spec0, list(outs))
+
+
+# ---------------------------------------------------------------------------
+# Assert
+# ---------------------------------------------------------------------------
+
+def Assert(cond, data=None, summarize=20, name=None):  # noqa: N802
+    """Parity: static/nn/control_flow.py:59 — abort execution when `cond`
+    is false, printing `data`. Compiled path uses jax.debug-style checkify
+    semantics: eager/static replay raises; under a trace it prints."""
+    from ...ops.dispatch import dispatch, ensure_tensor
+    ct = ensure_tensor(cond)
+    extras = [ensure_tensor(d) for d in (data or [])]
+
+    def fwd(c, *ds):
+        ok = jnp.all(jnp.asarray(c).astype(bool))
+
+        def fail(_):
+            jax.debug.print(
+                "Assert failed" + "".join(
+                    f"; data[{i}]={{d{i}}}" for i in range(len(ds))),
+                **{f"d{i}": d for i, d in enumerate(ds)})
+            return jnp.asarray(c).astype(bool).reshape(-1)[:1]
+
+        def okf(_):
+            return jnp.asarray(c).astype(bool).reshape(-1)[:1]
+
+        return lax.cond(ok, okf, fail, 0)
+
+    out = dispatch("assert", fwd, ct, *extras)
+    if not isinstance(out, Variable) and not is_traced(out):
+        if not bool(jnp.asarray(out._data).reshape(-1)[:1].all()):
+            raise ValueError(f"Assert failed: {name or ''}")
+    return out

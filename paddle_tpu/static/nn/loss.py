@@ -1,0 +1,55 @@
+"""paddle.static.nn.nce — noise-contrastive estimation loss.
+
+Parity: /root/reference/python/paddle/static/nn/loss.py (nce maker over
+the nce op, paddle/phi/kernels/cpu/nce_kernel.cc role). TPU-native form:
+fixed-shape uniform negative sampling (one shared negative set per batch,
+drawn at graph-build from the framework RNG so the compiled program is
+static), logistic loss on true vs noise logits — the standard NCE
+objective with the uniform noise distribution the reference defaults to
+(sampler='uniform')."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...nn import functional as F  # noqa: F401
+from .._extras import create_parameter
+
+__all__ = ["nce"]
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=None, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    if sampler != "uniform":
+        raise NotImplementedError(
+            "static.nn.nce: only the uniform sampler is implemented "
+            "(reference default); log_uniform/custom_dist are decided-out")
+    num_neg = int(num_neg_samples or 10)
+    dim = int(input._data.shape[-1])
+    dt = str(input._data.dtype)
+    w = create_parameter([num_total_classes, dim], dt, attr=param_attr)
+    b = create_parameter([num_total_classes], dt, attr=bias_attr,
+                         is_bias=True)
+
+    # negatives drawn once at build time (static shapes; a fresh set per
+    # Executor.run would make the program shape-dynamic)
+    from ...framework.random import next_key
+    import jax
+    neg = jax.random.randint(next_key(), (num_neg,), 0, num_total_classes)
+
+    from ...ops.dispatch import dispatch
+
+    def fwd(x, lbl, wt, bt):
+        lbl_i = lbl.reshape(-1).astype(jnp.int32)
+        true_logit = jnp.sum(x * wt[lbl_i], axis=-1) + bt[lbl_i]
+        neg_w = wt[neg]                      # [S, D]
+        neg_logit = x @ neg_w.T + bt[neg]    # [B, S]
+        # NCE with uniform noise: log q = -log(num_total_classes)
+        log_q = -jnp.log(jnp.float32(num_total_classes))
+        pos_term = jax.nn.softplus(-(true_logit - log_q))
+        neg_term = jnp.sum(jax.nn.softplus(neg_logit - log_q), axis=-1)
+        return (pos_term + neg_term).reshape(-1, 1).astype(x.dtype)
+
+    from ...ops.dispatch import ensure_tensor
+    return dispatch("nce", fwd, ensure_tensor(input), ensure_tensor(label),
+                    w, b)

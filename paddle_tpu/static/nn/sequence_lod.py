@@ -1,0 +1,148 @@
+"""paddle.static.nn.sequence_* — sequence ops on dense padded batches.
+
+Parity targets: /root/reference/python/paddle/static/nn/sequence_lod.py
+(sequence_conv, sequence_pool, sequence_softmax, sequence_first_step,
+sequence_last_step, sequence_expand), which operate on LoD (ragged)
+tensors. TPU-native layout decision: ragged LoD tensors do not exist in
+this framework — sequences are dense padded [batch, time, ...] arrays
+with an optional `seq_len` (int Tensor [batch]) marking valid lengths,
+the layout every other part of the framework (and XLA) wants. With
+seq_len=None every row is treated as fully valid.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ...ops.dispatch import dispatch, ensure_tensor
+from .._extras import create_parameter
+
+__all__ = ["sequence_conv", "sequence_expand", "sequence_first_step",
+           "sequence_last_step", "sequence_pool", "sequence_softmax"]
+
+
+def _mask(a, seq_len):
+    """[B, T] validity mask from lengths (or all-true)."""
+    t = a.shape[1]
+    if seq_len is None:
+        return jnp.ones(a.shape[:2], bool)
+    return jnp.arange(t)[None, :] < seq_len.reshape(-1, 1)
+
+
+def sequence_pool(input, pool_type, is_test=False, pad_value=0.0,
+                  seq_len=None):
+    """Parity: sequence_lod.py sequence_pool — {sum, average, sqrt, max,
+    min, last, first} over the time axis of [B, T, D]."""
+    xt = ensure_tensor(input)
+    ts = [xt] + ([ensure_tensor(seq_len)] if seq_len is not None else [])
+    pt = pool_type.lower()
+
+    def fwd(a, *rest):
+        sl = rest[0] if rest else None
+        m = _mask(a, sl)[..., None]
+        n = jnp.maximum(jnp.sum(m, axis=1), 1)
+        if pt == "sum":
+            return jnp.sum(jnp.where(m, a, 0), axis=1)
+        if pt == "average":
+            return jnp.sum(jnp.where(m, a, 0), axis=1) / n
+        if pt == "sqrt":
+            return jnp.sum(jnp.where(m, a, 0), axis=1) / jnp.sqrt(
+                n.astype(a.dtype))
+        if pt == "max":
+            return jnp.max(jnp.where(m, a, -jnp.inf), axis=1)
+        if pt == "min":
+            return jnp.min(jnp.where(m, a, jnp.inf), axis=1)
+        if pt == "first":
+            return a[:, 0]
+        if pt == "last":
+            if sl is None:
+                return a[:, -1]
+            idx = jnp.maximum(sl.reshape(-1) - 1, 0)
+            return jnp.take_along_axis(
+                a, idx[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+        raise ValueError(f"sequence_pool: unknown pool_type {pool_type!r}")
+
+    return dispatch("sequence_pool", fwd, *ts)
+
+
+def sequence_first_step(input, seq_len=None):
+    return sequence_pool(input, "first", seq_len=seq_len)
+
+
+def sequence_last_step(input, seq_len=None):
+    return sequence_pool(input, "last", seq_len=seq_len)
+
+
+def sequence_softmax(input, use_cudnn=False, name=None, seq_len=None):
+    """Softmax over the time axis, masking padded steps."""
+    xt = ensure_tensor(input)
+    ts = [xt] + ([ensure_tensor(seq_len)] if seq_len is not None else [])
+
+    def fwd(a, *rest):
+        sl = rest[0] if rest else None
+        m = _mask(a, sl)
+        while m.ndim < a.ndim:
+            m = m[..., None]
+        z = jnp.where(m, a, -jnp.inf)
+        z = z - jnp.max(z, axis=1, keepdims=True)
+        e = jnp.where(m, jnp.exp(z), 0)
+        return e / jnp.maximum(jnp.sum(e, axis=1, keepdims=True), 1e-30)
+
+    return dispatch("sequence_softmax", fwd, *ts)
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, padding_start=None, bias_attr=None,
+                  param_attr=None, act=None, name=None):
+    """1-D conv over the time axis of [B, T, D] with context window
+    `filter_size` (reference sequence_conv: context windows over LoD
+    rows). padding_start defaults to -floor(filter_size/2)."""
+    if filter_stride != 1:
+        raise NotImplementedError("sequence_conv: filter_stride must be 1")
+    xt = ensure_tensor(input)
+    d = int(xt._data.shape[-1])
+    dt = str(xt._data.dtype)
+    w = create_parameter([filter_size * d, num_filters], dt,
+                         attr=param_attr)
+    b = None if bias_attr is False else create_parameter(
+        [num_filters], dt, attr=bias_attr, is_bias=True)
+    start = -(filter_size // 2) if padding_start is None else padding_start
+
+    def fwd(a, wt, *rest):
+        btc, t = a.shape[0], a.shape[1]
+        cols = []
+        for i in range(filter_size):
+            off = start + i
+            if off < 0:
+                seg = jnp.pad(a, ((0, 0), (-off, 0), (0, 0)))[:, :t]
+            else:
+                seg = jnp.pad(a, ((0, 0), (0, off), (0, 0)))[:, off:off + t]
+            cols.append(seg)
+        ctx = jnp.concatenate(cols, axis=-1)          # [B, T, k*D]
+        out = ctx.reshape(btc * t, -1) @ wt
+        if rest:
+            out = out + rest[0]
+        return out.reshape(btc, t, num_filters)
+
+    args = [xt, w] + ([b] if b is not None else [])
+    out = dispatch("sequence_conv", fwd, *args)
+    if act is not None:
+        from ...nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    """Parity: sequence_lod.py sequence_expand. Dense form: repeat each
+    row of x along a new time axis to match y's time length — x [B, D]
+    (or [B, 1, D]) expands to [B, T, D] with T from y."""
+    xt, yt = ensure_tensor(x), ensure_tensor(y)
+
+    def fwd(a, ref):
+        t = ref.shape[1]
+        if a.ndim == 2:
+            return jnp.repeat(a[:, None, :], t, axis=1)
+        return jnp.repeat(a[:, :1, :], t, axis=1)
+
+    return dispatch("sequence_expand", fwd, xt, yt)

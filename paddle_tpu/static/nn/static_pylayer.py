@@ -1,0 +1,115 @@
+"""paddle.static.nn.static_pylayer — custom forward/backward blocks.
+
+Parity: /root/reference/python/paddle/static/nn/static_pylayer.py:281.
+The reference builds a `pylayer` op holding two sub-block Programs; the
+TPU-native form records ONE node whose fwd is a `jax.custom_vjp` function:
+the forward subgraph is the primal, the backward subgraph is the custom
+VJP rule (receiving the output cotangents, exactly the reference
+contract: n(forward inputs) == n(backward outputs) and vice versa). The
+Executor's jax.value_and_grad then routes gradients through the user's
+backward block inside the same compiled program.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ...tensor import Tensor
+from .. import Variable, record_static_op
+from .._subgraph import (aval_of, is_traced, make_placeholder, merge_deps,
+                         trace_callable, unflatten_output)
+
+__all__ = ["static_pylayer"]
+
+
+def static_pylayer(forward_fn: Callable, inputs: Sequence,
+                   backward_fn: Optional[Callable] = None, name=None):
+    if not callable(forward_fn):
+        raise TypeError("static_pylayer: forward_fn must be callable")
+    if not isinstance(inputs, (list, tuple)):
+        raise TypeError("static_pylayer: inputs must be a list of "
+                        "Variables")
+    inputs = list(inputs)
+    if backward_fn is not None and not callable(backward_fn):
+        raise TypeError("static_pylayer: backward_fn must be callable")
+
+    # eager / traced passthrough: the forward just runs; the custom
+    # backward only has meaning for the recorded graph, matching the
+    # reference's static-graph-only contract (:299)
+    if not any(isinstance(t, Variable) for t in inputs
+               if isinstance(t, Tensor)):
+        out = forward_fn(*inputs)
+        return out
+
+    phs = [make_placeholder(aval_of(t), "pylayer") for t in inputs]
+    f_flat, f_spec, f_graph = trace_callable(forward_fn, phs)
+    if not f_flat:
+        raise ValueError("static_pylayer: forward_fn must return at least "
+                         "one Variable")
+
+    bwd_pack = None
+    if backward_fn is not None:
+        # backward receives the output cotangents (same avals as the
+        # forward outputs) and must return one grad per forward input
+        gphs = [make_placeholder(aval_of(t), "pylayer_grad")
+                for t in f_flat]
+        b_flat, _, b_graph = trace_callable(backward_fn, gphs)
+        if len(b_flat) != len(inputs):
+            raise ValueError(
+                f"static_pylayer: backward_fn returned {len(b_flat)} "
+                f"grads for {len(inputs)} forward inputs (reference "
+                "contract: the counts must match)")
+        for i, (g, x) in enumerate(zip(b_flat, inputs)):
+            ga, xa = aval_of(g), aval_of(x)
+            if tuple(ga.shape) != tuple(xa.shape):
+                raise ValueError(
+                    f"static_pylayer: grad {i} has shape {ga.shape}, "
+                    f"input has {xa.shape}")
+        bwd_pack = (gphs, b_flat, b_graph)
+
+    deps = merge_deps(f_graph, *( [bwd_pack[2]] if bwd_pack else [] ))
+    nd = len(deps)
+    n_in = len(inputs)
+
+    def run_forward(dep_vals, in_vals):
+        val = {id(d): v for d, v in zip(deps, dep_vals)}
+        val.update({id(p): v for p, v in zip(phs, in_vals)})
+        return tuple(f_graph.replay(val))
+
+    if bwd_pack is None:
+        def fwd(*args):
+            res = run_forward(args[:nd], args[nd:])
+            return res if len(res) != 1 else res[0]
+    else:
+        gphs, b_flat, b_graph = bwd_pack
+
+        @jax.custom_vjp
+        def core(dep_vals, in_vals):
+            return run_forward(dep_vals, in_vals)
+
+        def core_fwd(dep_vals, in_vals):
+            return run_forward(dep_vals, in_vals), dep_vals
+
+        def core_bwd(dep_vals, cts):
+            val = {id(d): v for d, v in zip(deps, dep_vals)}
+            val.update({id(p): jnp.asarray(c)
+                        for p, c in zip(gphs, cts)})
+            in_grads = tuple(b_graph.replay(val))
+            # deps (parameters/constants referenced inside the blocks) get
+            # symbolic zeros: the user's backward block defines input
+            # grads only, same as the reference pylayer op
+            dep_zeros = tuple(jnp.zeros(aval_of(d).shape,
+                                        aval_of(d).dtype) for d in deps)
+            return dep_zeros, in_grads
+
+        core.defvjp(core_fwd, core_bwd)
+
+        def fwd(*args):
+            res = core(tuple(args[:nd]), tuple(args[nd:]))
+            return res if len(res) != 1 else res[0]
+
+    outs = record_static_op("static_pylayer", fwd, deps + inputs)
+    outs = outs if isinstance(outs, tuple) else (outs,)
+    return unflatten_output(f_spec, list(outs))

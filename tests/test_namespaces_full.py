@@ -24,6 +24,7 @@ PAIRS = [
     ("paddle_tpu.vision.datasets", f"{R}/vision/datasets/__init__.py"),
     ("paddle_tpu.distributed", f"{R}/distributed/__init__.py"),
     ("paddle_tpu.static", f"{R}/static/__init__.py"),
+    ("paddle_tpu.static.nn", f"{R}/static/nn/__init__.py"),
     ("paddle_tpu.incubate", f"{R}/incubate/__init__.py"),
     ("paddle_tpu.incubate.nn", f"{R}/incubate/nn/__init__.py"),
     ("paddle_tpu.incubate.nn.functional",

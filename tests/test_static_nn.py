@@ -1,0 +1,469 @@
+"""paddle.static.nn: compiled control flow (cond/while_loop/case/
+switch_case/static_pylayer over lax primitives) + the static layer makers.
+
+Reference parity targets:
+- control flow: /root/reference/python/paddle/static/nn/control_flow.py
+  (cond :1637, while_loop :755, case :1062, switch_case :1185)
+- static_pylayer: static/nn/static_pylayer.py:281
+- makers: static/nn/common.py (fc :48, batch_norm :2613, embedding :3689)
+
+The dy2static test at the bottom is the VERDICT r04 ask #4 'done'
+criterion: a while-loop model compiles to ONE program (zero graph
+breaks), numerics == eager.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+import paddle_tpu.static as static
+import paddle_tpu.static.nn as snn
+
+
+@pytest.fixture
+def exe():
+    return static.Executor()
+
+
+# ---------------------------------------------------------------------------
+# cond
+# ---------------------------------------------------------------------------
+
+def test_cond_static_both_branches(exe):
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [4], "float32")
+        out = snn.cond((x.sum() > 0).all(), lambda: x * 2, lambda: x - 1)
+    r = exe.run(main, feed={"x": np.ones(4, np.float32)}, fetch_list=[out])
+    np.testing.assert_allclose(r[0], 2 * np.ones(4))
+    r = exe.run(main, feed={"x": -np.ones(4, np.float32)}, fetch_list=[out])
+    np.testing.assert_allclose(r[0], -2 * np.ones(4))
+
+
+def test_cond_nested_structure(exe):
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2], "float32")
+        out = snn.cond((x.sum() > 0).all(),
+                       lambda: {"a": x * 2, "b": (x + 1, x - 1)},
+                       lambda: {"a": x * 3, "b": (x + 9, x - 9)})
+    r = exe.run(main, feed={"x": np.ones(2, np.float32)},
+                fetch_list=[out["a"], out["b"][0], out["b"][1]])
+    np.testing.assert_allclose(r[0], [2, 2])
+    np.testing.assert_allclose(r[1], [2, 2])
+    np.testing.assert_allclose(r[2], [0, 0])
+
+
+def test_cond_structure_mismatch_rejected():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2], "float32")
+        with pytest.raises(ValueError, match="same nested structure|mismatches"):
+            snn.cond((x.sum() > 0).all(), lambda: x,
+                     lambda: (x, x))
+        with pytest.raises(ValueError, match="mismatches"):
+            snn.cond((x.sum() > 0).all(), lambda: x,
+                     lambda: x.reshape([1, 2]))
+
+
+def test_cond_gradients_flow_through_taken_branch(exe):
+    """grads through lax.cond select the taken branch inside the ONE
+    compiled training program."""
+    def build(wval):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("X", [3], "float32")
+            w = static.create_parameter([3], "float32")
+            w._data = paddle.to_tensor(np.full(3, wval, np.float32))._data
+            h = x * w
+            y = snn.cond((h.sum() > 0).all(), lambda: h * 2, lambda: h * 5)
+            loss = y.sum()
+        sgd = opt.SGD(learning_rate=1.0, parameters=[w])
+        main._optimize = (sgd, loss, [w])
+        return main, w, loss
+
+    main, w, loss = build(1.0)
+    wb = np.array(w.numpy())
+    static.Executor().run(main, feed={"X": np.ones(3, np.float32)},
+                          fetch_list=[loss])
+    np.testing.assert_allclose(wb - np.array(w.numpy()), np.full(3, 2.0),
+                               rtol=1e-5)  # true branch: dL/dw = 2x = 2
+
+    main, w, loss = build(1.0)
+    wb = np.array(w.numpy())
+    static.Executor().run(main, feed={"X": -np.ones(3, np.float32)},
+                          fetch_list=[loss])
+    np.testing.assert_allclose(wb - np.array(w.numpy()), np.full(3, -5.0),
+                               rtol=1e-5)  # false branch: dL/dw = 5x = -5
+
+
+def test_cond_eager_mode():
+    t = paddle.to_tensor(np.float32([1.0]))
+    o = snn.cond(paddle.to_tensor(True), lambda: t + 1, lambda: t - 1)
+    assert float(o.numpy()[0]) == 2.0
+    o = snn.cond(paddle.to_tensor(False), lambda: t + 1, lambda: t - 1)
+    assert float(o.numpy()[0]) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# while_loop
+# ---------------------------------------------------------------------------
+
+def test_while_loop_static(exe):
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [1], "float32")
+        i = paddle.zeros([1], "float32")
+        iv, = snn.while_loop(lambda i: (i < x).all(),
+                             lambda i: [i + 1.0], [i])
+    r = exe.run(main, feed={"x": np.array([5.3], np.float32)},
+                fetch_list=[iv])
+    np.testing.assert_allclose(r[0], [6.0])
+    # data-dependent trip count: same compiled program, other feed
+    r = exe.run(main, feed={"x": np.array([0.5], np.float32)},
+                fetch_list=[iv])
+    np.testing.assert_allclose(r[0], [1.0])
+
+
+def test_while_loop_multi_var_static(exe):
+    main = static.Program()
+    with static.program_guard(main):
+        n = static.data("n", [], "int32")
+        i = paddle.zeros([], "int32")
+        s = paddle.zeros([], "float32")
+        iv, sv = snn.while_loop(
+            lambda i, s: (i < n).all(),
+            lambda i, s: [i + 1, s + i.astype("float32")], [i, s])
+    r = exe.run(main, feed={"n": np.int32(5)}, fetch_list=[sv])
+    np.testing.assert_allclose(r[0], 10.0)  # 0+1+2+3+4
+
+
+def test_while_loop_carry_mismatch_rejected():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2], "float32")
+        with pytest.raises(ValueError, match="carry|changes"):
+            snn.while_loop(lambda v: (v.sum() < 5).all(),
+                           lambda v: [v.reshape([1, 2])], [x])
+
+
+def test_while_loop_eager():
+    iv = snn.while_loop(lambda i: (i < 3).all(), lambda i: [i + 1],
+                        [paddle.to_tensor(np.float32([0]))])
+    assert float(iv[0].numpy()[0]) == 3.0
+
+
+# ---------------------------------------------------------------------------
+# case / switch_case
+# ---------------------------------------------------------------------------
+
+def test_switch_case_static(exe):
+    main = static.Program()
+    with static.program_guard(main):
+        idx = static.data("i", [1], "int32")
+        o = snn.switch_case(idx, {1: lambda: paddle.full([2], 1.0),
+                                  2: lambda: paddle.full([2], 2.0)},
+                            default=lambda: paddle.full([2], 9.0))
+    for v, want in ((1, 1.0), (2, 2.0), (7, 9.0)):
+        r = exe.run(main, feed={"i": np.array([v], np.int32)},
+                    fetch_list=[o])
+        assert r[0][0] == want
+    # list-of-pairs and list-of-fns forms
+    with static.program_guard(main):
+        o2 = snn.switch_case(idx, [(3, lambda: paddle.full([1], 3.0)),
+                                   (4, lambda: paddle.full([1], 4.0))])
+        o3 = snn.switch_case(idx, [lambda: paddle.full([1], 0.0),
+                                   lambda: paddle.full([1], 1.0)])
+    r = exe.run(main, feed={"i": np.array([4], np.int32)},
+                fetch_list=[o2, o3])
+    assert r[0][0] == 4.0 and r[1][0] == 1.0  # o3: idx 4 -> max-key default
+
+
+def test_case_chain_static(exe):
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [1], "float32")
+        o = snn.case([((x > 2).all(), lambda: x * 10),
+                      ((x > 0).all(), lambda: x + 100)],
+                     default=lambda: x * 0)
+    for v, want in ((3.0, 30.0), (1.0, 101.0), (-1.0, 0.0)):
+        r = exe.run(main, feed={"x": np.array([v], np.float32)},
+                    fetch_list=[o])
+        np.testing.assert_allclose(r[0], [want], rtol=1e-6)
+
+
+def test_case_last_fn_is_default(exe):
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [1], "float32")
+        o = snn.case([((x > 10).all(), lambda: x * 0),
+                      ((x > 20).all(), lambda: x + 7)])  # last = default
+    r = exe.run(main, feed={"x": np.array([1.0], np.float32)},
+                fetch_list=[o])
+    np.testing.assert_allclose(r[0], [8.0])
+
+
+def test_switch_case_validation():
+    idx = paddle.to_tensor(np.int32([0]))
+    with pytest.raises(TypeError):
+        snn.switch_case(5, {0: lambda: idx})
+    with pytest.raises(ValueError, match="unique"):
+        snn.switch_case(idx, [(1, lambda: idx), (1, lambda: idx)])
+    with pytest.raises(TypeError):
+        snn.case([("notatensor", lambda: idx)])
+
+
+# ---------------------------------------------------------------------------
+# static_pylayer
+# ---------------------------------------------------------------------------
+
+def test_static_pylayer_forward(exe):
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("X", [1, 5], "float32")
+        ret = snn.static_pylayer(lambda d: d.exp(), [x],
+                                 lambda dy: dy.exp() * 2)
+    r = exe.run(main, feed={"X": np.ones((1, 5), np.float32)},
+                fetch_list=[ret])
+    np.testing.assert_allclose(r[0], np.exp(np.ones((1, 5))), rtol=1e-6)
+
+
+def test_static_pylayer_custom_vjp_in_training(exe):
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("X", [3], "float32")
+        w = static.create_parameter([3], "float32")
+        w._data = paddle.to_tensor(np.float32([0.1, 0.2, 0.3]))._data
+        h = x * w
+        y = snn.static_pylayer(lambda d: d * 3.0, [h],
+                               lambda dy: dy * 10.0)  # custom: 10, not 3
+        loss = y.sum()
+    sgd = opt.SGD(learning_rate=0.1, parameters=[w])
+    main._optimize = (sgd, loss, [w])
+    wb = np.array(w.numpy())
+    exe.run(main, feed={"X": np.ones(3, np.float32)}, fetch_list=[loss])
+    # custom bwd: dL/dh = 10 -> dw = 10*x; step = -0.1*10 = -1.0
+    np.testing.assert_allclose(wb - np.array(w.numpy()), np.full(3, 1.0),
+                               rtol=1e-5)
+
+
+def test_static_pylayer_count_contract():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("X", [3], "float32")
+        with pytest.raises(ValueError, match="grads for"):
+            snn.static_pylayer(lambda d: d * 2, [x],
+                               lambda dy: (dy, dy))  # 2 grads, 1 input
+
+
+# ---------------------------------------------------------------------------
+# layer makers
+# ---------------------------------------------------------------------------
+
+def test_fc_embedding_norm_makers(exe):
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2, 6], "float32")
+        f1 = snn.fc(x, 4, activation="relu")
+        bn = snn.batch_norm(f1, is_test=True)
+        bn_tr = snn.batch_norm(f1, is_test=False)
+        ln = snn.layer_norm(f1)
+        ids = static.data("ids", [2, 3], "int64")
+        e = snn.embedding(ids, (50, 8))
+        se = snn.sparse_embedding(ids, (50, 8))
+    r = exe.run(main, feed={"x": np.random.randn(2, 6).astype(np.float32),
+                            "ids": np.array([[1, 2, 3], [4, 5, 6]],
+                                            np.int64)},
+                fetch_list=[f1, bn, bn_tr, ln, e, se])
+    assert r[0].shape == (2, 4)
+    assert r[1].shape == (2, 4) and r[2].shape == (2, 4)
+    assert r[3].shape == (2, 4)
+    assert r[4].shape == (2, 3, 8) and r[5].shape == (2, 3, 8)
+    assert np.all(r[0] >= 0)  # relu applied
+    # training-mode BN output is batch-normalized: near-zero mean per ch
+    np.testing.assert_allclose(r[2].mean(axis=0), np.zeros(4), atol=1e-5)
+
+
+def test_fc_trains(exe):
+    """fc-created parameters are live: Executor training updates them."""
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [8, 6], "float32")
+        yv = static.data("y", [8, 1], "float32")
+        h = snn.fc(x, 4, activation="relu")
+        o = snn.fc(h, 1)
+        loss = ((o - yv) ** 2).mean()
+    params = []
+    seen = set()
+
+    def collect(var):
+        node = getattr(var, "_static_node", None)
+        if node is None or id(node) in seen:
+            return
+        seen.add(id(node))
+        for t in node.inputs:
+            if isinstance(t, static.Variable):
+                collect(t)
+            elif not t.stop_gradient:
+                params.append(t)
+    collect(loss)
+    sgd = opt.SGD(learning_rate=0.05, parameters=params)
+    main._optimize = (sgd, loss, params)
+    rng = np.random.default_rng(0)
+    xd = rng.standard_normal((8, 6)).astype(np.float32)
+    yd = rng.standard_normal((8, 1)).astype(np.float32)
+    losses = [float(exe.run(main, feed={"x": xd, "y": yd},
+                            fetch_list=[loss])[0]) for _ in range(30)]
+    assert losses[-1] < losses[0] * 0.7, losses[::10]
+
+
+def test_conv_prelu_groupnorm_makers(exe):
+    main = static.Program()
+    with static.program_guard(main):
+        img = static.data("img", [2, 3, 8, 8], "float32")
+        c = snn.conv2d(img, 6, 3, padding=1, act="relu")
+        g = snn.group_norm(c, groups=2)
+        p = snn.prelu(c, mode="channel")
+        ct = snn.conv2d_transpose(img, 5, filter_size=3, padding=1)
+        inorm = snn.instance_norm(c)
+    r = exe.run(main, feed={"img": np.random.randn(2, 3, 8, 8)
+                            .astype(np.float32)},
+                fetch_list=[c, g, p, ct, inorm])
+    assert r[0].shape == (2, 6, 8, 8)
+    assert r[1].shape == (2, 6, 8, 8)
+    assert r[2].shape == (2, 6, 8, 8)
+    assert r[3].shape == (2, 5, 8, 8)
+    assert r[4].shape == (2, 6, 8, 8)
+
+
+def test_misc_makers(exe):
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [3, 4], "float32")
+        y = static.data("y", [3, 5], "float32")
+        b = snn.bilinear_tensor_product(x, y, 6)
+        seq = static.data("s", [2, 7, 4], "float32")
+        rc = snn.row_conv(seq, 2)
+        cvm_in = static.data("c", [3, 6], "float32")
+        cvm = snn.continuous_value_model(cvm_in, cvm_in, use_cvm=True)
+        cvm2 = snn.continuous_value_model(cvm_in, cvm_in, use_cvm=False)
+        w = static.create_parameter([4, 4], "float32")
+        sn = snn.spectral_norm(w, dim=0, power_iters=30)
+        dn = snn.data_norm(cvm_in)
+    feeds = {"x": np.random.randn(3, 4).astype(np.float32),
+             "y": np.random.randn(3, 5).astype(np.float32),
+             "s": np.random.randn(2, 7, 4).astype(np.float32),
+             "c": np.abs(np.random.randn(3, 6)).astype(np.float32)}
+    r = exe.run(main, feed=feeds, fetch_list=[b, rc, cvm, cvm2, sn, dn])
+    assert r[0].shape == (3, 6)
+    assert r[1].shape == (2, 7, 4)
+    assert r[2].shape == (3, 6) and r[3].shape == (3, 4)
+    assert r[4].shape == (4, 4)
+    assert r[5].shape == (3, 6)
+    # spectral norm: largest singular value ~1 (30 power iters converge)
+    s = np.linalg.svd(r[4], compute_uv=False)
+    assert abs(s[0] - 1.0) < 0.05
+
+
+def test_nce_maker(exe):
+    main = static.Program()
+    with static.program_guard(main):
+        emb = static.data("e", [4, 16], "float32")
+        lbl = static.data("l", [4, 1], "int64")
+        loss = snn.nce(emb, lbl, num_total_classes=100, num_neg_samples=5)
+    r = exe.run(main, feed={"e": np.random.randn(4, 16).astype(np.float32),
+                            "l": np.array([[1], [2], [3], [4]], np.int64)},
+                fetch_list=[loss])
+    assert r[0].shape == (4, 1)
+    assert np.all(np.isfinite(r[0])) and np.all(r[0] > 0)
+
+
+def test_sequence_ops(exe):
+    main = static.Program()
+    with static.program_guard(main):
+        s = static.data("s", [2, 5, 3], "float32")
+        sl = static.data("len", [2], "int64")
+        pooled = snn.sequence_pool(s, "average", seq_len=sl)
+        first = snn.sequence_first_step(s)
+        last = snn.sequence_last_step(s, seq_len=sl)
+        sm = snn.sequence_softmax(s, seq_len=sl)
+        sc = snn.sequence_conv(s, 6, filter_size=3)
+        x2 = static.data("x2", [2, 3], "float32")
+        ex = snn.sequence_expand(x2, s)
+    sd = np.arange(30, dtype=np.float32).reshape(2, 5, 3)
+    lens = np.array([3, 5], np.int64)
+    r = exe.run(main, feed={"s": sd, "len": lens,
+                            "x2": np.ones((2, 3), np.float32)},
+                fetch_list=[pooled, first, last, sm, sc, ex])
+    # average over the VALID prefix only
+    np.testing.assert_allclose(r[0][0], sd[0, :3].mean(axis=0), rtol=1e-6)
+    np.testing.assert_allclose(r[0][1], sd[1].mean(axis=0), rtol=1e-6)
+    np.testing.assert_allclose(r[1], sd[:, 0], rtol=1e-6)
+    np.testing.assert_allclose(r[2][0], sd[0, 2], rtol=1e-6)  # len 3 -> idx 2
+    np.testing.assert_allclose(r[2][1], sd[1, 4], rtol=1e-6)
+    # masked softmax: padded steps get zero probability
+    assert np.allclose(r[3][0, 3:], 0)
+    np.testing.assert_allclose(r[3].sum(axis=1)[0], np.ones(3), rtol=1e-5)
+    assert r[4].shape == (2, 5, 6)
+    assert r[5].shape == (2, 5, 3)
+
+
+# ---------------------------------------------------------------------------
+# dy2static: VERDICT r04 #4 'done' criterion
+# ---------------------------------------------------------------------------
+
+class _LoopNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.lin = nn.Linear(4, 4)
+
+    def forward(self, x):
+        h = self.lin(x)
+        out = snn.while_loop(lambda v: (v * v).sum() > 100.0,
+                             lambda v: v * 0.5, [h])
+        return out[0]
+
+
+def test_dy2static_while_loop_single_program():
+    """A data-dependent while written with static.nn.while_loop compiles
+    to ONE program under to_static (no graph break), numerics == eager."""
+    net = _LoopNet()
+    st = paddle.jit.to_static(net)
+    x = paddle.to_tensor(np.random.default_rng(1)
+                         .standard_normal((2, 4)).astype(np.float32) * 100)
+    y_st = st(x)
+    sf = net.forward  # the StaticFunction
+    assert sf.stats["compiled_calls"] == 1
+    assert sf.stats["partial_calls"] == 0 and sf.stats["eager_calls"] == 0
+    # eager reference
+    ref = _LoopNet()
+    ref.set_state_dict(net.state_dict())
+    v = ref.lin(x)
+    while float((v * v).sum().numpy()) > 100.0:
+        v = v * 0.5
+    np.testing.assert_allclose(y_st.numpy(), v.numpy(), rtol=1e-5)
+
+
+class _CondNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.lin = nn.Linear(4, 4)
+
+    def forward(self, x):
+        h = self.lin(x)
+        return snn.cond((h.sum() > 0).all(), lambda: h * 2.0,
+                        lambda: h * -1.0)
+
+
+def test_dy2static_cond_single_program():
+    net = _CondNet()
+    st = paddle.jit.to_static(net)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    y = st(x)
+    sf = net.forward
+    assert sf.stats["compiled_calls"] == 1
+    assert sf.stats["partial_calls"] == 0 and sf.stats["eager_calls"] == 0
+    h = net.lin(x)
+    want = (h * 2.0) if float(h.sum().numpy()) > 0 else (h * -1.0)
+    np.testing.assert_allclose(y.numpy(), want.numpy(), rtol=1e-5)
