@@ -1,0 +1,11 @@
+"""paddle.distributed.utils — reference import-path parity.
+
+Parity: /root/reference/python/paddle/distributed/utils/__init__.py
+(__all__ = [] there too; the submodules are the surface). moe_utils
+re-exports the framework's all-to-all MoE dispatch ops; log_utils and
+process_utils provide the logging/affinity helpers (affinity is a no-op
+on TPU hosts — XLA owns device placement).
+"""
+from . import log_utils, moe_utils, process_utils  # noqa: F401
+
+__all__ = []

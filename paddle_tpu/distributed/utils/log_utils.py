@@ -1,0 +1,18 @@
+"""Parity: distributed/utils/log_utils.py get_logger."""
+import logging
+
+__all__ = ["get_logger"]
+
+
+def get_logger(log_level, name="root"):
+    logger = logging.getLogger(name)
+    if isinstance(log_level, str):
+        log_level = getattr(logging, log_level.upper(), logging.INFO)
+    logger.setLevel(log_level)
+    if not logger.handlers:
+        h = logging.StreamHandler()
+        h.setFormatter(logging.Formatter(
+            fmt="%(asctime)s %(levelname)-8s %(message)s",
+            datefmt="%Y-%m-%d %H:%M:%S"))
+        logger.addHandler(h)
+    return logger

@@ -1,0 +1,6 @@
+"""Parity: distributed/utils/moe_utils.py:20 global_scatter /
+global_gather — the canonical import path; implementations live in
+distributed/moe_utils.py (all-to-all over the ep mesh axis)."""
+from ..moe_utils import global_gather, global_scatter  # noqa: F401
+
+__all__ = ["global_scatter", "global_gather"]

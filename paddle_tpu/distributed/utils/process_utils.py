@@ -1,0 +1,21 @@
+"""Parity: distributed/utils/process_utils.py set_affinity — NUMA/CPU
+affinity pinning for trainer processes. On TPU hosts the runtime owns
+device-thread placement, so these degrade to best-effort CPU pinning via
+os.sched_setaffinity (no-op where unsupported)."""
+import os
+
+__all__ = ["set_affinity"]
+
+
+def set_affinity():
+    try:
+        n = os.cpu_count() or 1
+        rank = int(os.environ.get("PADDLE_LOCAL_RANK",
+                                  os.environ.get("PADDLE_TRAINER_ID", 0))
+                   or 0)
+        nproc = int(os.environ.get("PADDLE_LOCAL_SIZE", 1) or 1)
+        per = max(1, n // max(nproc, 1))
+        cpus = set(range(rank * per % n, min(rank * per % n + per, n)))
+        os.sched_setaffinity(0, cpus)
+    except (AttributeError, OSError, ValueError):
+        pass  # unsupported platform / bad env: leave affinity alone

@@ -94,6 +94,9 @@ class Program:
         p = Program()
         p._optimize = None if for_test else self._optimize
         p._nodes = list(self._nodes)
+        p._side_effects = list(getattr(self, "_side_effects", ()))
+        if hasattr(self, "_amp_replay_config"):
+            p._amp_replay_config = self._amp_replay_config
         return p
 
 
@@ -175,14 +178,20 @@ class Executor:
         program = program or default_main_program()
         feed = feed or {}
         fetch_list = list(fetch_list or [])
-        if not fetch_list and program._optimize is None:
+        if not fetch_list and program._optimize is None and \
+                not getattr(program, "_side_effects", None):
             return []  # startup program: params are already initialized
 
-        # collect graph inputs: feed placeholders + referenced parameters
+        # collect graph inputs: feed placeholders + referenced parameters.
+        # Side-effect nodes (static.nn.Assert) are demand-evaluated too:
+        # their outputs join the roots and are host-checked after the run.
         opt_spec = program._optimize
         params: List[Tensor] = []
         seen: set = set()
-        roots = list(fetch_list) + ([opt_spec[1]] if opt_spec else [])
+        side_effects = list(getattr(program, "_side_effects", ()))
+        roots = (list(fetch_list) + side_effects
+                 + ([opt_spec[1]] if opt_spec else []))
+        n_user = len(fetch_list)
         feed_vars: Dict[str, Variable] = {}
 
         def visit(var):
@@ -217,7 +226,15 @@ class Executor:
             if restrict:
                 allowed = {id(p) for p in restrict}
                 params = [p for p in params if id(p) in allowed]
-        cache_key = (id(program), tuple(id(r) for r in roots),
+        # static-graph AMP: a cast policy attached by static.amp.decorate
+        # (on the optimizer) or cast_model_to_fp16/rewrite_program_bf16
+        # (on the program) is applied per replayed node — the TPU-native
+        # form of the reference's cast-insertion pass (XLA fuses the
+        # casts into the surrounding ops)
+        amp_cfg = getattr(program, "_amp_replay_config", None)
+        if amp_cfg is None and opt_spec is not None:
+            amp_cfg = getattr(opt_spec[0], "_amp_replay_config", None)
+        cache_key = (id(program), tuple(id(r) for r in roots), id(amp_cfg),
                      tuple((n, a.shape, str(a.dtype))
                            for n, a in zip(feed_names, feed_arrays)))
 
@@ -236,8 +253,10 @@ class Executor:
                             f"Variable {t.name!r} has no producer and no "
                             "feed name")
                     if id(node) not in env:
-                        env[id(node)] = node.fwd(*[ev(i)
-                                                   for i in node.inputs])
+                        args = [ev(i) for i in node.inputs]
+                        if amp_cfg is not None:
+                            args = amp_cfg.cast_args(node.name, args)
+                        env[id(node)] = node.fwd(*args)
                     out = env[id(node)]
                     return out[t._static_idx] if node.n_out > 1 else out
                 return pmap.get(id(t), t._data)
@@ -245,14 +264,19 @@ class Executor:
             return [ev(v) if isinstance(v, Variable) else jnp.asarray(v)
                     for v in roots]
 
+        from . import _subgraph as _sg
         if opt_spec is None:
             fn = self._jit_cache.get(cache_key)
             if fn is None:
                 fn = self._jit_cache[cache_key] = jax.jit(replay)
-            outs = fn([p._data for p in params], *feed_arrays)
+            _sg.ACTIVE_AMP[0] = amp_cfg
+            try:
+                outs = fn([p._data for p in params], *feed_arrays)
+            finally:
+                _sg.ACTIVE_AMP[0] = None
         else:
             optimizer, loss_var, _ = opt_spec
-            li = len(fetch_list)  # loss is the extra root
+            li = n_user + len(side_effects)  # loss is the extra root
             # current optimizer state, threaded THROUGH the jit (a closure
             # would freeze the initial moments into the compiled program)
             states = []
@@ -262,11 +286,27 @@ class Executor:
                     st = optimizer._init_state(p)
                 states.append({k: v for k, v in st.items() if k != "_step"})
 
-            def train_step(param_arrays, state_list, lr, step_i, *feeds):
+            # static AMP loss scaling (fp16): scale the loss, unscale the
+            # grads, skip the update on inf/nan, adapt the scale — state
+            # (scale, good, bad) threads through the jit like the moments
+            use_scaling = bool(getattr(optimizer, "_use_scaling", False))
+
+            def train_step(param_arrays, state_list, lr, step_i, scale,
+                           good, bad, *feeds):
                 def loss_of(pa):
-                    return replay(pa, *feeds)[li].astype(jnp.float32)
+                    ls = replay(pa, *feeds)[li].astype(jnp.float32)
+                    return ls * scale if use_scaling else ls
 
                 loss, grads = jax.value_and_grad(loss_of)(param_arrays)
+                if use_scaling:
+                    loss = loss / scale
+                    grads = [g / scale.astype(g.dtype) for g in grads]
+                    found_inf = jnp.zeros((), bool)
+                    for g in grads:
+                        found_inf = found_inf | ~jnp.all(jnp.isfinite(
+                            g.astype(jnp.float32)))
+                else:
+                    found_inf = jnp.zeros((), bool)
                 # grad clipping must match the dygraph step exactly
                 from ..parallel.trainer import _clip_grads_functional
                 gdict = _clip_grads_functional(
@@ -284,25 +324,67 @@ class Executor:
                         a, optimizer._reg_grad(p, g.astype(a.dtype),
                                                param_arr=a),
                         st, lr * mult, optimizer._wd_coeff(p), step_i)
+                    if use_scaling:  # inf step: keep params and moments
+                        np_ = jnp.where(found_inf, a, np_)
+                        ns_ = {k: jnp.where(found_inf, st[k], v)
+                               for k, v in ns_.items()}
                     new_params.append(np_)
                     new_states.append(ns_)
+                if use_scaling:
+                    bad2 = jnp.where(found_inf, bad + 1, 0)
+                    good2 = jnp.where(found_inf, 0, good + 1)
+                    dec = bad2 >= optimizer._decr_every_n_nan_or_inf
+                    inc = good2 >= optimizer._incr_every_n_steps
+                    scale2 = jnp.where(
+                        dec, scale * optimizer._decr_ratio,
+                        jnp.where(inc, scale * optimizer._incr_ratio,
+                                  scale))
+                    bad2 = jnp.where(dec, 0, bad2)
+                    good2 = jnp.where(inc, 0, good2)
+                else:
+                    scale2, good2, bad2 = scale, good, bad
                 outs = replay(param_arrays, *feeds)[:li]
-                return loss, outs, new_params, new_states
+                return (loss, outs, new_params, new_states, scale2, good2,
+                        bad2)
 
             fn = self._jit_cache.get(cache_key)
             if fn is None:
                 fn = self._jit_cache[cache_key] = jax.jit(train_step)
             optimizer._global_step += 1
-            loss, outs, new_params, new_states = fn(
-                [p._data for p in params], states,
-                jnp.float32(optimizer.get_lr()),
-                jnp.float32(optimizer._global_step), *feed_arrays)
+            _sg.ACTIVE_AMP[0] = amp_cfg
+            try:
+                loss, outs, new_params, new_states, scale2, good2, bad2 = \
+                    fn([p._data for p in params], states,
+                       jnp.float32(optimizer.get_lr()),
+                       jnp.float32(optimizer._global_step),
+                       jnp.float32(getattr(optimizer, "_loss_scaling",
+                                           1.0)),
+                       jnp.int32(getattr(optimizer, "_good_steps", 0)),
+                       jnp.int32(getattr(optimizer, "_bad_steps", 0)),
+                       *feed_arrays)
+            finally:
+                _sg.ACTIVE_AMP[0] = None
+            if use_scaling:
+                optimizer._loss_scaling = float(scale2)
+                optimizer._good_steps = int(good2)
+                optimizer._bad_steps = int(bad2)
             for p, a, ns in zip(params, new_params, new_states):
                 p._data = a
                 ns = dict(ns)
                 ns["_step"] = optimizer._global_step
                 optimizer._accumulators[id(p)] = ns
-            outs = list(outs)  # exactly the user's fetch_list entries
+            outs = list(outs)
+
+        # host-check side-effect (Assert) results, then return exactly the
+        # user's fetch_list entries
+        for var, val in zip(side_effects, outs[n_user:n_user
+                                               + len(side_effects)]):
+            if not bool(np.asarray(val).all()):
+                raise ValueError(
+                    f"static.nn.Assert failed: "
+                    f"{getattr(var, 'name', None) or 'assertion'} did not "
+                    "hold for this feed")
+        outs = outs[:n_user]
 
         if return_numpy:
             return [np.asarray(o) for o in outs]
@@ -383,3 +465,6 @@ __all__ = [
 from . import nn  # noqa: F401, E402  (paddle.static.nn — layer makers +
 #                   compiled control flow; imported last to avoid cycles)
 __all__.append("nn")
+from . import amp  # noqa: F401, E402  (paddle.static.amp — replay-time AMP)
+from . import io  # noqa: F401, E402  (paddle.static.io — serialization)
+__all__ += ["amp", "io"]

@@ -33,6 +33,11 @@ from . import Variable, _next_node_serial, record_static_op
 _PH_PREFIX = "__static_ph:"
 _ph_ids = itertools.count()
 
+# The Executor installs the active static-AMP cast policy here while it
+# traces a program so control-flow subgraph replay applies the same
+# per-node casts as top-level replay (static/amp/decorator.py).
+ACTIVE_AMP = [None]
+
 
 def make_placeholder(aval, tag="v") -> Variable:
     """A bound symbolic variable (loop carry / pylayer input): never a free
@@ -129,15 +134,14 @@ class TracedGraph:
                 for i in node.inputs:
                     walk(i)
                 return
-            if is_placeholder(t):
-                raise ValueError(
-                    "static.nn control flow: a bound loop/pylayer variable "
-                    "from a DIFFERENT control-flow op leaked into this "
-                    "subgraph — branch functions may only use their own "
-                    "arguments and outer variables")
-            # outer Variable (feed or earlier-produced) or concrete Tensor
+            # NOTE: a placeholder bound by an ENCLOSING control-flow op is a
+            # legitimate free dep here (nested cond inside a while body
+            # referencing the loop var): it becomes an input of this inner
+            # node, and the enclosing graph's replay resolves it from its
+            # own carry valuation — nesting composes through the dep chain.
+            # Outer Variable (feed or earlier-produced) or concrete Tensor
             # (Parameter/constant): a free dependency, passed as a node
-            # input so the Executor threads its live value through
+            # input so the enclosing replay threads its live value through
             if id(t) not in dep_ids:
                 dep_ids.add(id(t))
                 self.deps.append(t)
@@ -145,9 +149,14 @@ class TracedGraph:
         for t in self.flat:
             walk(t)
 
-    def replay(self, valuation: Dict[int, object]) -> List:
+    def replay(self, valuation: Dict[int, object],
+               cast_to_recorded: bool = True) -> List:
         """Evaluate the flat outputs; `valuation` maps id(dep-or-bound
-        Variable) -> concrete array."""
+        Variable) -> concrete array. `cast_to_recorded` pins the outputs
+        to the build-time avals — under a replay-time AMP policy the
+        branch interiors may run in low precision, but the subgraph's
+        output contract (what lax.cond/switch/while type-check across
+        branches/iterations) stays exactly as recorded."""
         memo: Dict[int, object] = {}
 
         def ev(t):
@@ -157,7 +166,10 @@ class TracedGraph:
                 if isinstance(t, Variable) else None
             if self._inner(node):
                 if id(node) not in memo:
-                    memo[id(node)] = node.fwd(*[ev(i) for i in node.inputs])
+                    args = [ev(i) for i in node.inputs]
+                    if ACTIVE_AMP[0] is not None:
+                        args = ACTIVE_AMP[0].cast_args(node.name, args)
+                    memo[id(node)] = node.fwd(*args)
                 out = memo[id(node)]
                 return out[t._static_idx] if node.n_out > 1 else out
             if isinstance(t, Variable):
@@ -166,7 +178,11 @@ class TracedGraph:
                     "replay (dep collection missed it)")
             return t._data  # unreachable for collected deps; safety net
 
-        return [ev(t) for t in self.flat]
+        outs = [ev(t) for t in self.flat]
+        if cast_to_recorded:
+            outs = [jnp.asarray(v).astype(aval_of(t).dtype)
+                    for v, t in zip(outs, self.flat)]
+        return outs
 
     def avals(self):
         return [aval_of(t) for t in self.flat]

@@ -1,0 +1,15 @@
+"""paddle.static.amp — static-graph automatic mixed precision.
+
+Parity: /root/reference/python/paddle/static/amp/__init__.py. The
+reference's cast-insertion ProgramDesc pass becomes a replay-time cast
+policy the Executor applies while tracing the one XLA program (decorator
+.py), with dynamic loss scaling threaded through the compiled step.
+"""
+from . import bf16, debugging, decorator, fp16_lists, fp16_utils  # noqa: F401
+from .decorator import OptimizerWithMixedPrecision, decorate  # noqa: F401
+from .fp16_lists import AutoMixedPrecisionLists, CustomOpLists  # noqa: F401
+from .fp16_utils import (  # noqa: F401
+    cast_model_to_fp16,
+    cast_parameters_to_fp16,
+    fp16_guard,
+)

@@ -1,0 +1,11 @@
+"""paddle.static.amp.bf16 — parity: static/amp/bf16/__init__.py."""
+from . import amp_lists, amp_utils, decorator  # noqa: F401
+from .amp_lists import AutoMixedPrecisionListsBF16  # noqa: F401
+from .amp_utils import (  # noqa: F401
+    bf16_guard,
+    cast_model_to_bf16,
+    cast_parameters_to_bf16,
+    convert_float_to_uint16,
+    rewrite_program_bf16,
+)
+from .decorator import decorate_bf16  # noqa: F401

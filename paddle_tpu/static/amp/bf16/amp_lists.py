@@ -1,0 +1,17 @@
+"""Parity: static/amp/bf16/amp_lists.py:27 AutoMixedPrecisionListsBF16."""
+from ..fp16_lists import AutoMixedPrecisionLists
+
+__all__ = ["AutoMixedPrecisionListsBF16"]
+
+
+class AutoMixedPrecisionListsBF16(AutoMixedPrecisionLists):
+    def __init__(self, custom_bf16_list=None, custom_fp32_list=None,
+                 custom_fp32_varnames=None):
+        super().__init__(custom_white_list=custom_bf16_list,
+                         custom_black_list=custom_fp32_list,
+                         custom_black_varnames=custom_fp32_varnames,
+                         dtype="bfloat16")
+        # reference attribute names
+        self.bf16_list = self.white_list
+        self.fp32_list = self.black_list
+        self.fp32_varnames = self.black_varnames

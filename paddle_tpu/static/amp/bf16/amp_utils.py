@@ -1,0 +1,49 @@
+"""Parity: static/amp/bf16/amp_utils.py — bf16 Program conversion. bf16
+is the TPU-native compute dtype, so these are the thin duals of the fp16
+utils (no loss scaling needed: bf16 keeps fp32's exponent range)."""
+import contextlib
+
+import numpy as np
+
+from ..fp16_utils import cast_model_to_fp16, cast_parameters_to_fp16
+from .amp_lists import AutoMixedPrecisionListsBF16
+
+__all__ = ["bf16_guard", "cast_model_to_bf16", "cast_parameters_to_bf16",
+           "convert_float_to_uint16", "rewrite_program_bf16"]
+
+
+def convert_float_to_uint16(in_list):
+    """Parity: amp_utils.py:48 — reinterpret fp32 values as the uint16
+    bit pattern of their bf16 rounding (the reference's storage format
+    for bf16 tensors in numpy, which lacks a bfloat16 dtype)."""
+    a = np.asarray(in_list, dtype=np.float32)
+    return (a.view(np.uint32) >> 16).astype(np.uint16)
+
+
+@contextlib.contextmanager
+def bf16_guard():
+    from .... import amp as _amp
+    with _amp.auto_cast(True, level="O1", dtype="bfloat16"):
+        yield
+
+
+def cast_model_to_bf16(program, amp_lists=None, use_bf16_guard=True):
+    return cast_model_to_fp16(program, amp_lists or
+                              AutoMixedPrecisionListsBF16(),
+                              dest_type="bfloat16")
+
+
+def cast_parameters_to_bf16(place=None, program=None, scope=None,
+                            to_bf16_var_names=None):
+    return cast_parameters_to_fp16(place, program, scope,
+                                   to_fp16_var_names=to_bf16_var_names,
+                                   dest_type="bfloat16")
+
+
+def rewrite_program_bf16(main_prog, amp_lists=None):
+    """Parity: amp_utils.py:488 — O1 rewrite: attach the mixed (not pure)
+    bf16 replay policy."""
+    from ..decorator import _ReplayAmpConfig
+    lists = amp_lists or AutoMixedPrecisionListsBF16()
+    main_prog._amp_replay_config = _ReplayAmpConfig(lists, use_pure=False)
+    return main_prog

@@ -1,0 +1,11 @@
+"""Parity: static/amp/bf16/decorator.py:249 decorate_bf16."""
+from ..decorator import decorate
+
+__all__ = ["decorate_bf16"]
+
+
+def decorate_bf16(optimizer, amp_lists=None, use_pure_bf16=False,
+                  use_bf16_guard=None):
+    return decorate(optimizer, amp_lists=amp_lists, dtype="bfloat16",
+                    level="O2" if use_pure_bf16 else "O1",
+                    use_dynamic_loss_scaling=False)

@@ -1,0 +1,4 @@
+"""paddle.static.amp.debugging — parity shim: the eager amp.debugging
+tools (nan/inf checks, op stats) work on the static path too because
+both run through the same dispatch chokepoint."""
+from ...amp.debugging import *  # noqa: F401,F403

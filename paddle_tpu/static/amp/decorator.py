@@ -1,0 +1,140 @@
+"""paddle.static.amp.decorator — mixed-precision optimizer for static
+Programs.
+
+Parity: /root/reference/python/paddle/static/amp/decorator.py:53
+OptimizerWithMixedPrecision + :decorate. The reference rewrites the
+ProgramDesc (cast insertion pass + loss-scaling ops + master weights);
+the TPU-native form attaches a REPLAY-TIME cast policy to the recorded
+graph — the Executor casts each node's inputs per the white/black lists
+while tracing the one XLA program (XLA then fuses the casts into the
+surrounding ops), and wraps the training step in dynamic loss scaling
+whose state threads through the jit like the optimizer moments do.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .fp16_lists import AutoMixedPrecisionLists, check_amp_dtype
+
+__all__ = ["decorate", "OptimizerWithMixedPrecision"]
+
+
+class _ReplayAmpConfig:
+    """The cast policy the Executor applies per replayed node."""
+
+    def __init__(self, lists: AutoMixedPrecisionLists, use_pure: bool):
+        self.lists = lists
+        self.low = jnp.bfloat16 if lists.amp_dtype == "bfloat16" \
+            else jnp.float16
+        self.use_pure = use_pure  # O2: everything low except black list
+
+    def cast_args(self, op_name: str, args):
+        low, f32 = self.low, jnp.float32
+        if op_name in self.lists.black_list:
+            return [a.astype(f32) if hasattr(a, "dtype") and a.dtype == low
+                    else a for a in args]
+        if op_name in self.lists.white_list or self.use_pure:
+            return [a.astype(low) if hasattr(a, "dtype") and a.dtype == f32
+                    else a for a in args]
+        return args
+
+
+class OptimizerWithMixedPrecision:
+    """Wraps an optimizer for AMP static training. Delegates everything
+    the Executor needs (_update, _accumulators, get_lr, ...) to the inner
+    optimizer; carries the cast policy + dynamic loss-scaling state."""
+
+    def __init__(self, optimizer, amp_lists, level, dtype,
+                 init_loss_scaling=2.0 ** 15, use_dynamic_loss_scaling=True,
+                 incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
+                 incr_ratio=2.0, decr_ratio=0.8):
+        self._optimizer = optimizer
+        self._amp_lists = amp_lists or AutoMixedPrecisionLists(dtype=dtype)
+        self._amp_level = level
+        self._amp_dtype = check_amp_dtype(dtype)
+        # fp16 needs loss scaling; bf16 has fp32's exponent range and the
+        # reference's bf16 path runs unscaled
+        self._use_scaling = use_dynamic_loss_scaling and dtype == "float16"
+        self._loss_scaling = float(init_loss_scaling) if dtype == "float16" \
+            else 1.0
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._incr_every_n_steps = int(incr_every_n_steps)
+        self._decr_every_n_nan_or_inf = int(decr_every_n_nan_or_inf)
+        self._incr_ratio = float(incr_ratio)
+        self._decr_ratio = float(decr_ratio)
+        self._amp_replay_config = _ReplayAmpConfig(
+            self._amp_lists, use_pure=(level == "O2"))
+
+    # -- Executor-facing delegation ------------------------------------
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_optimizer"], name)
+
+    def get_loss_scaling(self):
+        return self._loss_scaling
+
+    def get_scaled_loss(self):
+        raise RuntimeError(
+            "get_scaled_loss: scaling happens inside Executor.run's "
+            "compiled step; fetch the loss normally")
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        from ... import static as _st
+        if not isinstance(loss, _st.Variable):
+            raise TypeError(
+                "static.amp decorate(...).minimize expects a static "
+                "Variable loss (build the program first)")
+        prog = _st.default_main_program()
+        prog._optimize = (self, loss, parameters)
+        self._train_program = prog  # amp_init target
+        return None, []
+
+    def amp_init(self, place=None, scope=None, test_program=None,
+                 use_fp16_test=False):
+        """Parity: decorator.py:359 — O2 master-weight init: cast the
+        main (and optionally test) program's parameters to the low dtype
+        (master fp32 copies live in the optimizer accumulators, which
+        always run fp32 math)."""
+        if self._amp_level != "O2":
+            return
+        from ... import static as _st
+        from .fp16_utils import cast_parameters_to_fp16
+        prog = getattr(self, "_train_program", None) \
+            or _st.default_main_program()
+        cast_parameters_to_fp16(place, prog, scope,
+                                dest_type=self._amp_dtype)
+        if test_program is not None:
+            cast_parameters_to_fp16(place, test_program, scope,
+                                    dest_type=self._amp_dtype)
+
+
+def decorate(optimizer, amp_lists=None, init_loss_scaling=2.0 ** 15,
+             incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
+             incr_ratio=2.0, decr_ratio=0.8,
+             use_dynamic_loss_scaling=None, use_pure_fp16=False,
+             use_fp16_guard=None, use_bf16=False, use_amp_guard=None,
+             use_promote=False, level=None, dtype=None, master_weight=None,
+             master_grad=False):
+    """Parity: static/amp/decorator.py decorate. Returns the wrapped
+    optimizer; use its .minimize(loss) and run the program normally —
+    Executor.run applies the casts and loss scaling inside the one
+    compiled step."""
+    if dtype is None:
+        dtype = "bfloat16" if use_bf16 else "float16"
+    if level is None:
+        level = "O2" if use_pure_fp16 else "O1"
+    if use_dynamic_loss_scaling is None:
+        use_dynamic_loss_scaling = dtype == "float16"
+    if amp_lists is not None and getattr(amp_lists, "amp_dtype", dtype) \
+            != dtype:
+        amp_lists.amp_dtype = check_amp_dtype(dtype)
+    return OptimizerWithMixedPrecision(
+        optimizer, amp_lists, level, dtype,
+        init_loss_scaling=init_loss_scaling,
+        use_dynamic_loss_scaling=use_dynamic_loss_scaling,
+        incr_every_n_steps=incr_every_n_steps,
+        decr_every_n_nan_or_inf=decr_every_n_nan_or_inf,
+        incr_ratio=incr_ratio, decr_ratio=decr_ratio)

@@ -1,0 +1,52 @@
+"""paddle.static.amp.fp16_lists — op cast lists for static-graph AMP.
+
+Parity: /root/reference/python/paddle/static/amp/fp16_lists.py:146
+AutoMixedPrecisionLists. The lists are keyed on this framework's dispatch
+op names (the names `record_static_op` stamps on nodes); the defaults are
+shared with the eager autocast (amp/__init__.py WHITE_LIST/BLACK_LIST),
+so static and dynamic AMP make identical cast decisions.
+"""
+from __future__ import annotations
+
+from ...amp import BLACK_LIST as _BLACK
+from ...amp import WHITE_LIST as _WHITE
+
+__all__ = ["AutoMixedPrecisionLists", "CustomOpLists", "check_amp_dtype"]
+
+
+def check_amp_dtype(dtype):
+    d = str(dtype)
+    if d not in ("float16", "bfloat16"):
+        raise ValueError(
+            f"amp dtype must be float16 or bfloat16, got {d!r}")
+    return d
+
+
+class AutoMixedPrecisionLists:
+    """White list: ops cast to low precision (MXU-bound matmul/conv);
+    black list: ops kept fp32 (reductions, losses, normalizations); gray
+    (everything else): follow their inputs."""
+
+    def __init__(self, custom_white_list=None, custom_black_list=None,
+                 custom_black_varnames=None, dtype="float16"):
+        self.amp_dtype = check_amp_dtype(dtype)
+        self.white_list = set(_WHITE)
+        self.black_list = set(_BLACK)
+        self.gray_list = set()
+        self.black_varnames = set(custom_black_varnames or ())
+        self._update_list(custom_white_list, custom_black_list)
+
+    def _update_list(self, custom_white_list, custom_black_list):
+        cw = set(custom_white_list or ())
+        cb = set(custom_black_list or ())
+        both = cw & cb
+        if both:
+            raise ValueError(
+                f"ops {sorted(both)} are in both custom white and black "
+                "lists")
+        self.white_list = (self.white_list | cw) - cb
+        self.black_list = (self.black_list | cb) - cw
+
+
+# reference alias (fp16_lists.py exports both names)
+CustomOpLists = AutoMixedPrecisionLists

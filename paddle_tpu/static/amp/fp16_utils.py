@@ -1,0 +1,68 @@
+"""paddle.static.amp.fp16_utils — Program/parameter dtype conversion.
+
+Parity: /root/reference/python/paddle/static/amp/fp16_utils.py
+(cast_model_to_fp16, cast_parameters_to_fp16, fp16_guard). The reference
+walks the ProgramDesc and rewrites var dtypes + inserts cast ops; here a
+Program is a recorded closure graph, so "casting the model" attaches a
+pure-low-precision replay policy to the Program (the Executor casts at
+trace time and XLA fuses), and casting parameters converts the live
+Parameter arrays in place.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+
+from .fp16_lists import AutoMixedPrecisionLists, check_amp_dtype
+
+__all__ = ["cast_model_to_fp16", "cast_parameters_to_fp16", "fp16_guard"]
+
+_guard_active = [False]
+
+
+@contextlib.contextmanager
+def fp16_guard():
+    """Parity: fp16_utils.py fp16_guard — ops recorded under the guard are
+    eligible for low precision when decorate(use_fp16_guard=True). Here
+    the dispatch-level autocast governs per-op dtype, so the guard simply
+    enables the eager autocast for the region (identical cast lists)."""
+    from ... import amp as _amp
+    with _amp.auto_cast(True, level="O1", dtype="float16"):
+        yield
+
+
+def cast_model_to_fp16(program, amp_lists=None, use_fp16_guard=True,
+                       dest_type=None, level="O2", use_promote=False):
+    """Attach a pure-fp16 (O2) replay policy to `program`: every node not
+    on the black list runs in the low dtype."""
+    from .decorator import _ReplayAmpConfig
+    dtype = check_amp_dtype(dest_type or "float16")
+    lists = amp_lists or AutoMixedPrecisionLists(dtype=dtype)
+    program._amp_replay_config = _ReplayAmpConfig(lists, use_pure=True)
+    return program
+
+
+def cast_parameters_to_fp16(place=None, program=None, scope=None,
+                            to_fp16_var_names=None, dest_type=None,
+                            dtype="float16"):
+    """Cast live Parameter arrays to the low dtype in place. With a
+    program given, casts the parameters reachable from its recorded
+    graph; otherwise casts nothing (the reference needs a scope — we need
+    the graph)."""
+    low = jnp.float16 if check_amp_dtype(dest_type or dtype) == "float16" \
+        else jnp.bfloat16
+    if program is None:
+        return
+    from .. import Variable
+    names = set(to_fp16_var_names or ())
+    for ref in getattr(program, "_nodes", []):
+        node = ref() if callable(ref) else None
+        if node is None:
+            continue
+        for t in node.inputs:
+            if isinstance(t, Variable) or t.stop_gradient:
+                continue
+            if t._data.dtype == jnp.float32 and (
+                    not names or getattr(t, "name", None) in names):
+                t._data = t._data.astype(low)

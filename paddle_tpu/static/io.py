@@ -1,0 +1,26 @@
+"""paddle.static.io — program/persistables serialization entry points.
+
+Parity: /root/reference/python/paddle/static/io.py (__all__ = [] there
+too; the functions are reached as paddle.static.io.* or re-exported at
+paddle.static.*). The implementations live in static/_extras.py — this
+module provides the reference import path.
+"""
+from ._extras import (  # noqa: F401
+    deserialize_persistables,
+    deserialize_program,
+    load,
+    load_from_file,
+    load_program_state,
+    normalize_program,
+    save,
+    save_to_file,
+    serialize_persistables,
+    serialize_program,
+    set_program_state,
+)
+from . import (  # noqa: F401
+    load_inference_model,
+    save_inference_model,
+)
+
+__all__ = []

@@ -324,21 +324,25 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
         if not callable(f):
             raise TypeError("switch_case: branch fns must be callable")
     if default is None:
-        # reference semantics: the max-index fn doubles as the default
-        default = items[-1][1]
-    elif not callable(default):
-        raise TypeError("switch_case: default must be callable")
-
-    fns = [f for _, f in items] + [default]
+        # reference semantics: the max-index fn doubles as the default —
+        # map unmatched indices onto its POSITION instead of tracing the
+        # fn twice (a second trace would duplicate its parameters)
+        fns = [f for _, f in items]
+        default_pos = len(keys) - 1
+    else:
+        if not callable(default):
+            raise TypeError("switch_case: default must be callable")
+        fns = [f for _, f in items] + [default]
+        default_pos = len(keys)
     m = _mode(branch_index)
 
     if m == "eager":
         idx = int(jnp.asarray(branch_index._data).reshape(()))
-        return fns[keys.index(idx) if idx in keys else len(keys)]()
+        return fns[keys.index(idx) if idx in keys else default_pos]()
 
     def mapped_index(idx_arr):
         idx = jnp.asarray(idx_arr).reshape(()).astype(jnp.int32)
-        sel = jnp.int32(len(keys))  # default position
+        sel = jnp.int32(default_pos)
         for pos, k in enumerate(keys):
             sel = jnp.where(idx == k, jnp.int32(pos), sel)
         return sel
@@ -390,8 +394,11 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
 
 def Assert(cond, data=None, summarize=20, name=None):  # noqa: N802
     """Parity: static/nn/control_flow.py:59 — abort execution when `cond`
-    is false, printing `data`. Compiled path uses jax.debug-style checkify
-    semantics: eager/static replay raises; under a trace it prints."""
+    is false, printing `data`. Static programs register the check as a
+    side-effect root: Executor.run evaluates it with the fetches and
+    raises host-side when it does not hold (the reference's abort-on-run
+    semantics). Eager raises immediately; inside a trace the failure
+    prints via jax.debug (a compiled TPU program cannot abort)."""
     from ...ops.dispatch import dispatch, ensure_tensor
     ct = ensure_tensor(cond)
     extras = [ensure_tensor(d) for d in (data or [])]
@@ -404,15 +411,20 @@ def Assert(cond, data=None, summarize=20, name=None):  # noqa: N802
                 "Assert failed" + "".join(
                     f"; data[{i}]={{d{i}}}" for i in range(len(ds))),
                 **{f"d{i}": d for i, d in enumerate(ds)})
-            return jnp.asarray(c).astype(bool).reshape(-1)[:1]
+            return ok
 
-        def okf(_):
-            return jnp.asarray(c).astype(bool).reshape(-1)[:1]
-
-        return lax.cond(ok, okf, fail, 0)
+        return lax.cond(ok, lambda _: ok, fail, 0)
 
     out = dispatch("assert", fwd, ct, *extras)
-    if not isinstance(out, Variable) and not is_traced(out):
-        if not bool(jnp.asarray(out._data).reshape(-1)[:1].all()):
+    if name is not None and hasattr(out, "name"):
+        out.name = name
+    if isinstance(out, Variable):
+        from .. import default_main_program
+        prog = default_main_program()
+        if not hasattr(prog, "_side_effects"):
+            prog._side_effects = []
+        prog._side_effects.append(out)
+    elif not is_traced(out):
+        if not bool(jnp.asarray(out._data).reshape(())):
             raise ValueError(f"Assert failed: {name or ''}")
     return out

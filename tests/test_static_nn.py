@@ -467,3 +467,63 @@ def test_dy2static_cond_single_program():
     h = net.lin(x)
     want = (h * 2.0) if float(h.sum().numpy()) > 0 else (h * -1.0)
     np.testing.assert_allclose(y.numpy(), want.numpy(), rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# round-5 review fixes
+# ---------------------------------------------------------------------------
+
+def test_nested_cond_in_while_loop(exe):
+    """A cond inside a while body referencing the loop var must compose
+    (the inner node's deps thread through the outer carry)."""
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [1], "float32")
+        i = paddle.zeros([1], "float32")
+        # while i < x: i += 2 if i.sum() > 2 else 1
+        iv, = snn.while_loop(
+            lambda i: (i < x).all(),
+            lambda i: [snn.cond((i.sum() > 2).all(),
+                                lambda: i + 2.0, lambda: i + 1.0)],
+            [i])
+    r = exe.run(main, feed={"x": np.array([6.0], np.float32)},
+                fetch_list=[iv])
+    # 0->1->2->3->5->7: steps +1,+1,+1,+2,+2
+    np.testing.assert_allclose(r[0], [7.0])
+
+
+def test_assert_static_enforced(exe):
+    """Assert must fail the run even when its output is not fetched."""
+    main = static.Program()
+    with static.program_guard(main):
+        y = static.data("y", [3], "float32")
+        snn.Assert((y > 100.0).all(), [y], name="y_gt_100")
+        z = y * 2
+    with pytest.raises(ValueError, match="Assert failed.*y_gt_100"):
+        exe.run(main, feed={"y": np.ones(3, np.float32)}, fetch_list=[z])
+    r = exe.run(main, feed={"y": np.full(3, 200.0, np.float32)},
+                fetch_list=[z])
+    np.testing.assert_allclose(r[0], np.full(3, 400.0))
+
+
+def test_assert_eager():
+    snn.Assert(paddle.to_tensor(True))
+    with pytest.raises(ValueError, match="Assert failed"):
+        snn.Assert(paddle.to_tensor(False))
+
+
+def test_switch_case_default_shares_max_key_params(exe):
+    """With default=None the max-key branch must not be traced twice:
+    a matched index and an unmatched index run the SAME parameters."""
+    main = static.Program()
+    with static.program_guard(main):
+        idx = static.data("i", [1], "int32")
+        x = static.data("x", [2, 6], "float32")
+        o = snn.switch_case(idx, [(0, lambda: x * 0),
+                                  (1, lambda: snn.fc(x, 6))])
+    xd = np.random.randn(2, 6).astype(np.float32)
+    r1 = exe.run(main, feed={"i": np.array([1], np.int32), "x": xd},
+                 fetch_list=[o])
+    r9 = exe.run(main, feed={"i": np.array([9], np.int32), "x": xd},
+                 fetch_list=[o])
+    np.testing.assert_allclose(r1[0], r9[0], rtol=1e-6)
