@@ -325,6 +325,91 @@ def _run_probe(extend=None):
         dtb = ctimeit(g, qkv)
         return {"fwd_us": round(dt * 1e6, 1), "bwd_us": round(dtb * 1e6, 1)}
 
+    def flash_tune_probe():
+        """Hardware block-size autotune (VERDICT r05 ask #1): time the
+        candidate (block_q, block_k) grids for the flash fwd/bwd kernels
+        with the chained-dispatch timer, record winners in the shared
+        autotune cache (disk) so the training attempts and library calls
+        resolve them, and report the tuned-vs-default speedup."""
+        import os
+        from paddle_tpu.kernels import autotune
+        from paddle_tpu.kernels.flash_pallas import flash_attention
+        autotune.set_cache_path(
+            os.environ.get("PADDLE_TPU_AUTOTUNE_CACHE") or os.path.join(
+                os.environ.get("BENCH_ARTIFACT_DIR") or os.path.dirname(
+                    os.path.abspath(__file__)), "AUTOTUNE_CACHE.json"))
+        out_t = {}
+        # tune at the probe shape AND the training shape (b8 h12 s2048
+        # d128 — the llama-0.5b bench config's attention geometry)
+        shapes = [(b, h, s, d)]
+        kt = jax.random.split(jax.random.PRNGKey(7), 3)
+        train_shape = (2, 12, 2048, 128)  # b2 keeps tuning VMEM-cheap
+        shapes.append(train_shape)
+        for (tb, th, ts, td) in shapes:
+            args = [jax.random.normal(kk, (tb, th, ts, td))
+                    .astype(jnp.bfloat16) for kk in kt]
+            cands = autotune.flash_block_candidates(ts, ts, td)
+            sig = (ts, ts, td, "bfloat16", True)
+            for which, make in (
+                ("flash_fwd", lambda bq, bk: (
+                    lambda q, k, v: flash_attention(q, k, v, True, None,
+                                                    bq, bk))),
+                ("flash_bwd", lambda bq, bk: jax.grad(
+                    lambda q, k, v: flash_attention(q, k, v, True, None,
+                                                    bq, bk)
+                    .astype(jnp.float32).sum(), argnums=(0, 1, 2))),
+            ):
+                best, best_dt, default_dt = None, float("inf"), None
+                for bq, bk in cands:
+                    try:
+                        dt_c = ctimeit(make(bq, bk), args, iters=4)
+                    except Exception:  # noqa: BLE001 invalid tiling
+                        continue
+                    if (bq, bk) == (128, 128):
+                        default_dt = dt_c
+                    if dt_c < best_dt:
+                        best, best_dt = (bq, bk), dt_c
+                if best is not None:
+                    autotune.record(which, sig, best)
+                    out_t[f"{which}_{tb}x{th}x{ts}x{td}"] = {
+                        "best": list(best),
+                        "us": round(best_dt * 1e6, 1),
+                        "default_us": round((default_dt or best_dt) * 1e6,
+                                            1),
+                        "speedup_vs_default": round(
+                            (default_dt or best_dt) / best_dt, 3)}
+        return out_t
+
+    def gmm_probe():
+        """Dropless-MoE grouped matmul vs dense padded matmul (VERDICT r04
+        ask #8): the routing decision data at two expert counts."""
+        from paddle_tpu.kernels.gmm_pallas import gmm
+        res = {}
+        tokens, dmodel, dff = 4096, 1024, 4096
+        for ne in (8, 64):
+            kk = jax.random.split(jax.random.PRNGKey(ne), 3)
+            x = jax.random.normal(kk[0], (tokens, dmodel)) \
+                .astype(jnp.bfloat16)
+            wgrp = jax.random.normal(kk[1], (ne, dmodel, dff)) \
+                .astype(jnp.bfloat16)
+            sizes = jnp.full((ne,), tokens // ne, jnp.int32)
+            dt_g = ctimeit(lambda x, w: gmm(x, w, sizes), (x, wgrp),
+                           iters=4)
+            # dense alternative: every expert multiplies every token and
+            # results are masked (the capacity-padded route's cost model)
+            def dense(x, w):
+                return jnp.einsum("td,edf->etf", x, w,
+                                  preferred_element_type=jnp.float32)
+            dt_d = ctimeit(dense, (x, wgrp), iters=2)
+            res[f"e{ne}"] = {
+                "gmm_us": round(dt_g * 1e6, 1),
+                "dense_us": round(dt_d * 1e6, 1),
+                "gmm_speedup": round(dt_d / dt_g, 2)}
+        res["decision"] = "dropless_gmm" if all(
+            v["gmm_speedup"] > 1.0 for k, v in res.items()
+            if k.startswith("e")) else "dense_padded"
+        return res
+
     def fused_probe():
         from paddle_tpu.kernels.fused_pallas import (fused_rms_norm_pallas,
                                                      fused_rope_pallas)
@@ -519,6 +604,8 @@ def _run_probe(extend=None):
     step("flash_bwd", flash_bwd_probe)
     step("flashmask", flashmask_probe)
     step("xla_attn", xla_attn_probe)
+    step("flash_tune", flash_tune_probe)
+    step("gmm", gmm_probe)
     step("fused", fused_probe)
     step("fused_adamw", adamw_probe)
     step("fp8_gemm", fp8_probe)
@@ -542,6 +629,12 @@ def _run_parent():
     import os
     here = os.environ.get("BENCH_ARTIFACT_DIR") or os.path.dirname(
         os.path.abspath(__file__))
+    # one shared autotune cache for the whole session: the probe's
+    # flash_tune step writes hardware-measured block-size winners there and
+    # every child (probe, attempts) inherits the env var, so the training
+    # step's flash calls resolve the tuned blocks (kernels/autotune.py)
+    os.environ.setdefault("PADDLE_TPU_AUTOTUNE_CACHE",
+                          os.path.join(here, "AUTOTUNE_CACHE.json"))
     if "--skip-probe" in sys.argv:
         # caller (e.g. tools/tpu_watch.sh) just proved the chip with its own
         # probe — don't burn the window on a duplicate init+compile pass.

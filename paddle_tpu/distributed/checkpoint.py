@@ -63,6 +63,50 @@ def _as_jax(v):
     return v._data if isinstance(v, Tensor) else jnp.asarray(v)
 
 
+class LocalShard:
+    """A host-mode shard of a logically-global tensor: `array` occupies
+    the block starting at `offsets` (one start per dim) inside
+    `global_shape`. Multi-PROCESS jobs (one rank per process over the
+    TCPStore host collectives, no jax.distributed mesh) save
+    rank-partitioned state in the same chunked format multi-device arrays
+    use — so reshard-on-load works across WORLD SIZE changes (the elastic
+    scale-in/out path; reference load_state_dict.py overlap algorithm)."""
+
+    def __init__(self, array, global_shape, offsets):
+        self.array = np.asarray(array)
+        self.global_shape = tuple(int(s) for s in global_shape)
+        self.offsets = tuple(int(o) for o in offsets)
+        if len(self.offsets) != len(self.global_shape):
+            raise ValueError("LocalShard: offsets rank != global rank")
+        if self.array.ndim != len(self.global_shape):
+            raise ValueError(
+                f"LocalShard: array rank {self.array.ndim} != global rank "
+                f"{len(self.global_shape)}")
+        for o, n, g in zip(self.offsets, self.array.shape,
+                           self.global_shape):
+            if o < 0 or o + n > g:
+                raise ValueError(
+                    f"LocalShard: block [{o}, {o + n}) exceeds global dim "
+                    f"{g}")
+
+    def box(self):
+        return [[o, o + n] for o, n in zip(self.offsets,
+                                           self.array.shape)]
+
+
+def _proc_info() -> Tuple[int, int]:
+    """(rank, world) — jax.distributed when initialized, else the launch
+    env (PADDLE_TRAINER_ID/NUM: multi-process host mode)."""
+    if jax.process_count() > 1:
+        return jax.process_index(), jax.process_count()
+    try:
+        w = int(os.environ.get("PADDLE_TRAINERS_NUM") or 1)
+        r = int(os.environ.get("PADDLE_TRAINER_ID") or 0)
+    except ValueError:
+        return 0, 1
+    return (r, w) if w > 1 else (0, 1)
+
+
 def _shard_chunks(arr: jax.Array) -> List[Tuple[List[List[int]], np.ndarray]]:
     """[(offsets [[start, stop] per dim], host chunk)] for shards this
     process must persist (replica 0 only, so replicated values are written
@@ -93,8 +137,7 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
         path = os.path.join(path, str(unique_id))
     os.makedirs(path, exist_ok=True)
     flat = _flatten(state_dict)
-    rank = jax.process_index()
-    nprocs = jax.process_count()
+    rank, nprocs = _proc_info()
     rank_dir = f"rank_{rank}"
     os.makedirs(os.path.join(path, rank_dir), exist_ok=True)
     # every rank removes ITS stale metadata first so the coordinator's wait
@@ -112,7 +155,15 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
     storage: Dict[str, List[Dict]] = {}
     counter = 0
     for key, v in flat.items():
-        if _is_array(v):
+        if isinstance(v, LocalShard):
+            meta_state[key] = {"shape": list(v.global_shape),
+                               "dtype": str(v.array.dtype)}
+            fname = f"{rank_dir}/c{counter}.npy"
+            counter += 1
+            npy_payload.append((fname, v.array))
+            storage[key] = [{"file": fname, "offsets": v.box(),
+                             "cdtype": str(v.array.dtype)}]
+        elif _is_array(v):
             arr = _as_jax(v)
             meta_state[key] = {"shape": [int(s) for s in arr.shape],
                                "dtype": str(arr.dtype)}
@@ -281,6 +332,14 @@ def load_state_dict(state_dict, path, process_group=None,
                                         entries[0]["chunk"])
             continue
         saved_shape = tuple(info["shape"])
+        if isinstance(target, LocalShard):
+            if saved_shape != target.global_shape:
+                raise ValueError(
+                    f"{key}: saved global shape {saved_shape} != target "
+                    f"global shape {target.global_shape}")
+            target.array = _assemble(key, target.box(), entries, reader,
+                                     target.array.dtype)
+            continue
         if not _is_array(target):
             # saved an array, target holds a plain python slot: materialize
             # the full array and write it back

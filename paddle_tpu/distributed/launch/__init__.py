@@ -39,8 +39,15 @@ def parse_args(argv: Optional[List[str]] = None):
         prog="python -m paddle_tpu.distributed.launch",
         description="launch a distributed training job "
                     "(reference: paddle.distributed.launch, main.py:23)")
-    p.add_argument("--nnodes", type=int, default=1,
-                   help="number of host-controller processes to launch")
+    p.add_argument("--nnodes", type=str, default="1",
+                   help="number of host-controller processes to launch. "
+                        "Elastic form MIN:MAX (reference --nnodes 2:4 / "
+                        "elastic manager scale semantics): starts MAX "
+                        "ranks; when ranks die, the next generation "
+                        "relaunches with the surviving count (never below "
+                        "MIN) and workers resume from their distributed "
+                        "checkpoint under the new world size "
+                        "(reshard-on-load)")
     p.add_argument("--nproc_per_node", type=int, default=1,
                    help="worker processes per node (reference-CLI parity). "
                         "On a TPU host exactly ONE process owns all local "
@@ -85,6 +92,18 @@ class Controller:
                 f"run_mode={args.run_mode!r}: collective and ps exist "
                 "(rpc workers launch as collective ranks + distributed.rpc)")
         self.args = args
+        # --nnodes N or MIN:MAX (elastic)
+        nn = str(args.nnodes)
+        if ":" in nn:
+            lo, hi = nn.split(":", 1)
+            self.min_nodes, self.max_nodes = int(lo), int(hi)
+            if not 1 <= self.min_nodes <= self.max_nodes:
+                raise SystemExit(f"--nnodes {nn}: need 1 <= MIN <= MAX")
+            self.elastic = True
+        else:
+            self.min_nodes = self.max_nodes = int(nn)
+            self.elastic = False
+        args.nnodes = self.max_nodes
         self.ps_servers = 0
         if args.run_mode == "ps":
             trainers = args.trainer_num if args.trainer_num is not None \
@@ -186,6 +205,17 @@ class Controller:
             failed = [i for i, c in enumerate(codes)
                       if c is not None and c != 0]
             if failed:
+                if self.elastic:
+                    # settle window: co-failing ranks exit staggered; the
+                    # survivor count must reflect the whole generation's
+                    # outcome, not the first poll that saw a failure
+                    deadline = time.time() + 5.0
+                    while time.time() < deadline and any(
+                            p.poll() is None for p in self.procs):
+                        time.sleep(0.2)
+                    codes = [p.poll() for p in self.procs]
+                    failed = [i for i, c in enumerate(codes)
+                              if c is not None and c != 0]
                 rank = self.args.rank_offset + failed[0]
                 if self.generation >= self.args.max_restarts:
                     sys.stderr.write(
@@ -195,6 +225,30 @@ class Controller:
                     self._kill_all()
                     return 1
                 self.generation += 1
+                if self.elastic and self.args.world_size is None:
+                    # elastic scale-in: continue with the surviving NODES
+                    # (reference ElasticManager scale decision,
+                    # fleet/elastic/manager.py:218-293); a node is dead
+                    # when any of its ranks failed. Workers resume from
+                    # the distributed checkpoint under the new world size
+                    # via reshard-on-load.
+                    nproc = self.args.nproc_per_node
+                    cur_nodes = self.nranks_local // nproc
+                    dead_nodes = {i // nproc for i in failed}
+                    new_nodes = cur_nodes - len(dead_nodes)
+                    if new_nodes < self.min_nodes:
+                        sys.stderr.write(
+                            f"[launch] {len(dead_nodes)} node(s) failed; "
+                            f"{new_nodes} survivors < min_nodes="
+                            f"{self.min_nodes}; giving up\n")
+                        self._kill_all()
+                        return 1
+                    if new_nodes != cur_nodes:
+                        sys.stderr.write(
+                            f"[launch] elastic scale-down: world "
+                            f"{self.world} -> {new_nodes * nproc}\n")
+                        self.nranks_local = new_nodes * nproc
+                        self.world = self.nranks_local
                 sys.stderr.write(
                     f"[launch] rank {rank} failed (rc={codes[failed[0]]}); "
                     f"restarting generation {self.generation}\n")

@@ -75,7 +75,7 @@ def pick(kernel: str, signature: Sequence, candidates: Sequence[tuple],
     """
     key = (kernel,) + tuple(signature)
     hit = _cache.get(key)
-    if hit is not None:
+    if hit is not None and hit is not _MISS:
         return hit
     if not flags.flag("use_autotune"):
         # do NOT cache the untimed default: enabling the flag later must
@@ -105,25 +105,68 @@ def pick(kernel: str, signature: Sequence, candidates: Sequence[tuple],
     return best
 
 
+_MISS = ("__miss__",)
+
+
 def cached(kernel: str, signature: Sequence) -> Optional[tuple]:
-    """Public cache lookup (used by traced call sites that cannot tune)."""
-    return _cache.get((kernel,) + tuple(signature))
+    """Public cache lookup (used by traced call sites that cannot tune).
+    Falls back to the disk cache so a probe-tuned decision reaches other
+    processes (the bench attempt children, the training job). Misses are
+    memoized: the disk file is read at most once per signature, keeping
+    the eager attention hot path free of file I/O. record() overwrites
+    the sentinel, so an in-process tune is still picked up."""
+    key = (kernel,) + tuple(signature)
+    hit = _cache.get(key)
+    if hit is _MISS:
+        return None
+    if hit is not None:
+        return hit
+    disk = _load_disk()
+    dkey = json.dumps(key)
+    if dkey in disk:
+        _cache[key] = tuple(disk[dkey])
+        return _cache[key]
+    _cache[key] = _MISS
+    return None
+
+
+def record(kernel: str, signature: Sequence, config: Sequence):
+    """Store an externally-measured winner (the hardware probe times
+    candidates with its own chained-dispatch timer and records the
+    decision here + on disk for other processes)."""
+    key = (kernel,) + tuple(signature)
+    _cache[key] = tuple(config)
+    disk = {**_load_disk(), json.dumps(key): list(config)}
+    _store_disk(disk)
 
 
 def clear():
     _cache.clear()
 
 
-def flash_block_candidates(sq: int, sk: int, head_dim: int) -> List[tuple]:
+def flash_block_candidates(sq: int, sk: int, head_dim: int,
+                           itemsize: int = 2) -> List[tuple]:
     """(block_q, block_k) candidates for the flash kernels: 128-multiples
-    that divide the sequence lengths (Mosaic tiling constraint)."""
-    qs = [b for b in (128, 256, 512) if sq % b == 0] or [sq]
-    ks = [b for b in (128, 256, 512) if sk % b == 0] or [sk]
-    out = [(q, k) for q in qs for k in ks]
+    that divide the sequence lengths (Mosaic tiling constraint), VMEM-
+    bounded (q/k/v/o tiles + fp32 scores + fp32 accumulators must fit
+    well under the ~16 MiB/core budget so the pipeline can double-
+    buffer)."""
+    qs = [b for b in (128, 256, 512, 1024) if sq % b == 0] or [sq]
+    ks = [b for b in (128, 256, 512, 1024) if sk % b == 0] or [sk]
+    out = []
+    for q in qs:
+        for k in ks:
+            tiles = (q + 3 * k) * head_dim * itemsize     # q + k/v/o tiles
+            scores = q * k * 4                            # fp32 s and p
+            acc = q * head_dim * 4 * 2                    # fp32 scratch
+            if 2 * (tiles + scores) + acc <= 10 * 2 ** 20:
+                out.append((q, k))
+    if not out:
+        out = [(min(qs), min(ks))]
     # default-first: 128x128 is the safe MXU tile
     out.sort(key=lambda c: (c != (128, 128), c))
     return out
 
 
-__all__ = ["pick", "cached", "clear", "set_cache_path",
+__all__ = ["pick", "cached", "record", "clear", "set_cache_path",
            "flash_block_candidates"]

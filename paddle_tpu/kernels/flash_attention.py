@@ -42,8 +42,11 @@ def is_available(q, k=None, causal=False) -> bool:
 
 
 def _tune_signature(q_bshd, k_bshd, causal):
+    # MUST match flash_pallas._resolve_blocks and the bench probe's
+    # flash_tune record key: (sq, sk, head_dim, dtype, causal) — batch and
+    # head count don't change the per-tile geometry
     b, sq, h, d = q_bshd.shape
-    return ((b, h, sq, d), k_bshd.shape[1], str(q_bshd.dtype), causal)
+    return (sq, k_bshd.shape[1], d, str(q_bshd.dtype), bool(causal))
 
 
 def tune_blocks(q_bshd, k_bshd, v_bshd, causal: bool = False, scale=None):
@@ -72,11 +75,10 @@ def cached_blocks(q_bshd, k_bshd, causal: bool):
 def flash_attention_bshd(q, k, v, causal: bool = False, scale=None,
                          block_q=None, block_k=None):
     """[batch, seq, heads, dim] layout wrapper around the Pallas kernel.
-    Block sizes default to the autotune cache entry for this signature
-    (tuned via tune_blocks(); 128x128 otherwise)."""
+    Block sizes stay None unless the caller pins them: the kernel's own
+    _resolve_blocks consults the autotune cache per direction (fwd AND
+    bwd keys; tuned by the hardware probe's flash_tune step)."""
     from .flash_pallas import flash_attention as fa_bhsd
-    if block_q is None or block_k is None:
-        block_q, block_k = cached_blocks(q, k, causal)
     # kernel uses [batch, heads, seq, dim]
     qh = jnp.swapaxes(q, 1, 2)
     kh = jnp.swapaxes(k, 1, 2)

@@ -110,14 +110,14 @@ def _fa_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, block_q, block_k,
         l_scratch[:] = jnp.zeros_like(l_scratch)
         acc_scratch[:] = jnp.zeros_like(acc_scratch)
 
-    def _compute(vis=None):
+    def _compute(vis=None, apply_causal=True):
         q = q_ref[0]                                 # [Bq, d] (input dtype)
         k = k_ref[0]                                 # [Bk, d]
         v = v_ref[0]                                 # [Bk, d]
         s = _dot(q, k, (((1,), (1,)))) * scale       # [Bq, Bk] fp32
         if vis is not None:
             s = jnp.where(vis, s, NEG_INF)
-        elif causal:
+        elif causal and apply_causal:
             s = jnp.where(_causal_mask(iq, ik, block_q, block_k, offset), s,
                           NEG_INF)
         m_prev = m_scratch[:]                        # [Bq, 1]
@@ -143,10 +143,20 @@ def _fa_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, block_q, block_k,
         def _():
             _compute(vis)
     elif causal:
-        # Skip fully-masked tiles (kv block entirely after the q block).
-        @pl.when(ik * block_k <= iq * block_q + (block_q - 1) + offset)
+        # Three tile kinds: fully masked (skip; the clamped index maps in
+        # the launcher make their k/v DMA a no-op as well), diagonal
+        # (apply the mask), fully visible interior (no mask work at all —
+        # the common case for long sequences).
+        visible = ik * block_k <= iq * block_q + (block_q - 1) + offset
+        interior = (ik + 1) * block_k - 1 <= iq * block_q + offset
+
+        @pl.when(visible & jnp.logical_not(interior))
         def _():
             _compute()
+
+        @pl.when(interior)
+        def _():
+            _compute(apply_causal=False)
     else:
         _compute()
 
@@ -187,11 +197,23 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, bounds=None,
     v_r = v.reshape(bh, sk, d)
     s = scale if scale is not None else 1.0 / math.sqrt(d)
     masked = bounds is not None
+    offset = sk - sq
+    if causal and not masked:
+        # Clamp the kv block index at the last visible block for this q
+        # block: grid steps past the diagonal then re-request the SAME
+        # block, and the Pallas pipeline elides the copy — causal skips
+        # save the HBM traffic, not just the MXU work.
+        def kv_idx(ibh, iq, ik):
+            last = jnp.clip((iq * bq + bq - 1 + offset) // bk, 0, nk - 1)
+            return (ibh, jnp.minimum(ik, last), 0)
+    else:
+        def kv_idx(ibh, iq, ik):
+            return (ibh, ik, 0)
     inputs = [q_r, k_r, v_r]
     in_specs = [
         pl.BlockSpec((1, bq, d), lambda ibh, iq, ik: (ibh, iq, 0)),
-        pl.BlockSpec((1, bk, d), lambda ibh, iq, ik: (ibh, ik, 0)),
-        pl.BlockSpec((1, bk, d), lambda ibh, iq, ik: (ibh, ik, 0)),
+        pl.BlockSpec((1, bk, d), kv_idx),
+        pl.BlockSpec((1, bk, d), kv_idx),
     ]
     if masked:
         # [b, h, sk, 4] -> [bh, 4, sk] (component-major for the kernel)
@@ -243,7 +265,7 @@ def _fa_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, *rest, scale,
     def _init():
         acc_scratch[:] = jnp.zeros_like(acc_scratch)
 
-    def _compute(vis=None):
+    def _compute(vis=None, apply_causal=True):
         q = q_ref[0]                                    # [Bq, d]
         k = k_ref[0]                                    # [Bk, d]
         v = v_ref[0]                                    # [Bk, d]
@@ -253,7 +275,7 @@ def _fa_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, *rest, scale,
         s = _dot(q, k, ((1,), (1,))) * scale            # [Bq, Bk] fp32
         if vis is not None:
             s = jnp.where(vis, s, NEG_INF)
-        elif causal:
+        elif causal and apply_causal:
             s = jnp.where(_causal_mask(iq, ik, block_q, block_k, offset), s,
                           NEG_INF)
         p = jnp.exp(s - lse)                            # [Bq, Bk] fp32
@@ -269,9 +291,16 @@ def _fa_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, *rest, scale,
         def _():
             _compute(vis)
     elif causal:
-        @pl.when(ik * block_k <= iq * block_q + (block_q - 1) + offset)
+        visible = ik * block_k <= iq * block_q + (block_q - 1) + offset
+        interior = (ik + 1) * block_k - 1 <= iq * block_q + offset
+
+        @pl.when(visible & jnp.logical_not(interior))
         def _():
             _compute()
+
+        @pl.when(interior)
+        def _():
+            _compute(apply_causal=False)
     else:
         _compute()
 
@@ -296,7 +325,7 @@ def _fa_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, *rest,
         dk_scratch[:] = jnp.zeros_like(dk_scratch)
         dv_scratch[:] = jnp.zeros_like(dv_scratch)
 
-    def _compute(vis=None):
+    def _compute(vis=None, apply_causal=True):
         # Same orientation as the dq kernel ([Bq, Bk] tiles); dk/dv contract
         # over the q dim (dim 0) instead, so no in-kernel transposes.
         q = q_ref[0]                                    # [Bq, d]
@@ -308,7 +337,7 @@ def _fa_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, *rest,
         s = _dot(q, k, ((1,), (1,))) * scale            # [Bq, Bk] fp32
         if vis is not None:
             s = jnp.where(vis, s, NEG_INF)
-        elif causal:
+        elif causal and apply_causal:
             s = jnp.where(_causal_mask(iq, ik, block_q, block_k, offset), s,
                           NEG_INF)
         p = jnp.exp(s - lse)                            # [Bq, Bk] fp32
@@ -325,10 +354,18 @@ def _fa_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, *rest,
         def _():
             _compute(vis)
     elif causal:
-        # Skip q blocks entirely before this kv block.
-        @pl.when(iq * block_q + (block_q - 1) + offset >= ik * block_k)
+        # Skip q blocks entirely before this kv block; interior q blocks
+        # (every query row past the kv block) need no mask work.
+        visible = iq * block_q + (block_q - 1) + offset >= ik * block_k
+        interior = iq * block_q + offset >= (ik + 1) * block_k - 1
+
+        @pl.when(visible & jnp.logical_not(interior))
         def _():
             _compute()
+
+        @pl.when(interior)
+        def _():
+            _compute(apply_causal=False)
     else:
         _compute()
 
@@ -359,14 +396,25 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
                     axis=-1).reshape(bh, sq)
     delta = jnp.broadcast_to(delta[:, :, None], (bh, sq, LANES))
 
+    offset = sk - sq
     q_spec = pl.BlockSpec((1, bq, d), lambda ibh, i, j: (ibh, i, 0))
     row_spec = pl.BlockSpec((1, bq, LANES), lambda ibh, i, j: (ibh, i, 0))
+
+    if causal and not masked:
+        # causal DMA elision (see _flash_forward): skipped kv blocks
+        # re-request the last visible block, so their copies are no-ops
+        def kv_idx_dq(ibh, iq, ik):
+            last = jnp.clip((iq * bq + bq - 1 + offset) // bk, 0, nk - 1)
+            return (ibh, jnp.minimum(ik, last), 0)
+    else:
+        def kv_idx_dq(ibh, iq, ik):
+            return (ibh, ik, 0)
 
     dq_inputs = [q_r, k_r, v_r, g_r, lse, delta]
     dq_in_specs = [
         q_spec,
-        pl.BlockSpec((1, bk, d), lambda ibh, iq, ik: (ibh, ik, 0)),
-        pl.BlockSpec((1, bk, d), lambda ibh, iq, ik: (ibh, ik, 0)),
+        pl.BlockSpec((1, bk, d), kv_idx_dq),
+        pl.BlockSpec((1, bk, d), kv_idx_dq),
         q_spec, row_spec, row_spec,
     ]
     if masked:
@@ -389,8 +437,23 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
     )(*dq_inputs)
 
     kv_spec = pl.BlockSpec((1, bk, d), lambda ibh, ik, iq: (ibh, ik, 0))
-    q_spec2 = pl.BlockSpec((1, bq, d), lambda ibh, ik, iq: (ibh, iq, 0))
-    row_spec2 = pl.BlockSpec((1, bq, LANES), lambda ibh, ik, iq: (ibh, iq, 0))
+    if causal and not masked:
+        # mirror of the dq clamp: q blocks entirely before this kv block
+        # are skipped, so clamp the q-side index maps at the first visible
+        # q block and their DMA elides
+        def q_pos(ik, iq):
+            first = jnp.clip((ik * bk - offset) // bq, 0, nq - 1)
+            return jnp.maximum(iq, first)
+
+        q_spec2 = pl.BlockSpec(
+            (1, bq, d), lambda ibh, ik, iq: (ibh, q_pos(ik, iq), 0))
+        row_spec2 = pl.BlockSpec(
+            (1, bq, LANES), lambda ibh, ik, iq: (ibh, q_pos(ik, iq), 0))
+    else:
+        q_spec2 = pl.BlockSpec((1, bq, d),
+                               lambda ibh, ik, iq: (ibh, iq, 0))
+        row_spec2 = pl.BlockSpec((1, bq, LANES),
+                                 lambda ibh, ik, iq: (ibh, iq, 0))
     dkv_inputs = [q_r, k_r, v_r, g_r, lse, delta]
     dkv_in_specs = [q_spec2, kv_spec, kv_spec, q_spec2, row_spec2, row_spec2]
     if masked:
@@ -435,23 +498,47 @@ def _reference_bhsd(q, k, v, causal, scale):
         .astype(q.dtype)
 
 
+def _resolve_blocks(which: str, q, k, causal, block_q, block_k):
+    """None block sizes resolve through the autotune cache (in-process or
+    the probe-written disk cache), else the static defaults — so a
+    hardware-tuned decision reaches every call site without threading
+    config (reference switch_autotune cache role)."""
+    if block_q is not None and block_k is not None:
+        return block_q, block_k
+    from . import autotune
+    sig = (q.shape[2], k.shape[2], q.shape[3], str(q.dtype), bool(causal))
+    hit = autotune.cached(which, sig)
+    if hit is None and which.startswith("flashmask"):
+        # the probe tunes the dense-causal kernels; the flashmask variant
+        # shares their tile geometry, so inherit the winner
+        hit = autotune.cached("flash" + which[len("flashmask"):], sig)
+    if hit is not None:
+        bq, bk = hit
+    else:
+        bq, bk = DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K
+    return (block_q or bq), (block_k or bk)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(q, k, v, causal=False, scale=None,
-                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
-    """q,k,v: [batch, heads, seq, head_dim]."""
-    out, _ = _flash_forward(q, k, v, causal, scale, block_q, block_k)
+                    block_q=None, block_k=None):
+    """q,k,v: [batch, heads, seq, head_dim]. block_q/block_k None =
+    autotune-cached (or the 128x128 default)."""
+    bq, bk = _resolve_blocks("flash_fwd", q, k, causal, block_q, block_k)
+    out, _ = _flash_forward(q, k, v, causal, scale, bq, bk)
     return out
 
 
 def _fa_fwd(q, k, v, causal, scale, block_q, block_k):
-    out, lse = _flash_forward(q, k, v, causal, scale, block_q, block_k)
+    bq, bk = _resolve_blocks("flash_fwd", q, k, causal, block_q, block_k)
+    out, lse = _flash_forward(q, k, v, causal, scale, bq, bk)
     return out, (q, k, v, out, lse)
 
 
 def _fa_bwd(causal, scale, block_q, block_k, res, g):
     q, k, v, out, lse = res
-    return _flash_backward(q, k, v, out, lse, g, causal, scale, block_q,
-                           block_k)
+    bq, bk = _resolve_blocks("flash_bwd", q, k, causal, block_q, block_k)
+    return _flash_backward(q, k, v, out, lse, g, causal, scale, bq, bk)
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)
@@ -461,28 +548,33 @@ flash_attention.defvjp(_fa_fwd, _fa_bwd)
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
 def flashmask_attention(q, k, v, bounds, causal=False, scale=None,
-                        window=None, block_q=DEFAULT_BLOCK_Q,
-                        block_k=DEFAULT_BLOCK_K):
+                        window=None, block_q=None, block_k=None):
     """FlashMask attention: q,k,v [batch, heads, seq, head_dim]; bounds
     [batch, heads, kv_seq, 4] int32 canonical (LTS, LTE, UTS, UTE) column
     bounds (see _flashmask_visible). The sparse mask costs O(seq) memory and
     fully-masked tiles skip the MXU — the capability of the reference's
     flashmask_attention (flash_attention.py:1299) without a dense mask."""
-    out, _ = _flash_forward(q, k, v, causal, scale, block_q, block_k,
+    bq, bk = _resolve_blocks("flashmask_fwd", q, k, causal, block_q,
+                             block_k)
+    out, _ = _flash_forward(q, k, v, causal, scale, bq, bk,
                             bounds=bounds, window=window)
     return out
 
 
 def _fm_fwd(q, k, v, bounds, causal, scale, window, block_q, block_k):
-    out, lse = _flash_forward(q, k, v, causal, scale, block_q, block_k,
+    bq, bk = _resolve_blocks("flashmask_fwd", q, k, causal, block_q,
+                             block_k)
+    out, lse = _flash_forward(q, k, v, causal, scale, bq, bk,
                               bounds=bounds, window=window)
     return out, (q, k, v, bounds, out, lse)
 
 
 def _fm_bwd(causal, scale, window, block_q, block_k, res, g):
     q, k, v, bounds, out, lse = res
+    bq, bk = _resolve_blocks("flashmask_bwd", q, k, causal, block_q,
+                             block_k)
     dq, dk, dv = _flash_backward(q, k, v, out, lse, g, causal, scale,
-                                 block_q, block_k, bounds=bounds,
+                                 bq, bk, bounds=bounds,
                                  window=window)
     return dq, dk, dv, None
 

@@ -334,11 +334,15 @@ class Executor:
                     bad2 = jnp.where(found_inf, bad + 1, 0)
                     good2 = jnp.where(found_inf, 0, good + 1)
                     dec = bad2 >= optimizer._decr_every_n_nan_or_inf
-                    inc = good2 >= optimizer._incr_every_n_steps
+                    # only grow while the grown scale stays finite
+                    # (reference update_loss_scaling contract) — an inf
+                    # scale could never recover (inf * decr_ratio == inf)
+                    grown = scale * optimizer._incr_ratio
+                    inc = (good2 >= optimizer._incr_every_n_steps) \
+                        & jnp.isfinite(grown)
                     scale2 = jnp.where(
                         dec, scale * optimizer._decr_ratio,
-                        jnp.where(inc, scale * optimizer._incr_ratio,
-                                  scale))
+                        jnp.where(inc, grown, scale))
                     bad2 = jnp.where(dec, 0, bad2)
                     good2 = jnp.where(inc, 0, good2)
                 else:
@@ -364,6 +368,15 @@ class Executor:
                        *feed_arrays)
             finally:
                 _sg.ACTIVE_AMP[0] = None
+            # a failing Assert must abort BEFORE the step is committed —
+            # the parameters were not updated on the bad batch (reference
+            # abort-on-run semantics)
+            self._check_side_effects(side_effects,
+                                     list(outs)[n_user:n_user
+                                                + len(side_effects)],
+                                     rollback=lambda:
+                                     setattr(optimizer, "_global_step",
+                                             optimizer._global_step - 1))
             if use_scaling:
                 optimizer._loss_scaling = float(scale2)
                 optimizer._good_steps = int(good2)
@@ -375,20 +388,28 @@ class Executor:
                 optimizer._accumulators[id(p)] = ns
             outs = list(outs)
 
-        # host-check side-effect (Assert) results, then return exactly the
+        # host-check side-effect (Assert) results (the train path already
+        # checked before committing its update), then return exactly the
         # user's fetch_list entries
-        for var, val in zip(side_effects, outs[n_user:n_user
-                                               + len(side_effects)]):
-            if not bool(np.asarray(val).all()):
-                raise ValueError(
-                    f"static.nn.Assert failed: "
-                    f"{getattr(var, 'name', None) or 'assertion'} did not "
-                    "hold for this feed")
+        if opt_spec is None:
+            self._check_side_effects(
+                side_effects, outs[n_user:n_user + len(side_effects)])
         outs = outs[:n_user]
 
         if return_numpy:
             return [np.asarray(o) for o in outs]
         return [Tensor(o) for o in outs]
+
+    @staticmethod
+    def _check_side_effects(side_effects, values, rollback=None):
+        for var, val in zip(side_effects, values):
+            if not bool(np.asarray(val).all()):
+                if rollback is not None:
+                    rollback()
+                raise ValueError(
+                    f"static.nn.Assert failed: "
+                    f"{getattr(var, 'name', None) or 'assertion'} did not "
+                    "hold for this feed")
 
     def close(self):
         pass

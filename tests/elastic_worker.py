@@ -1,0 +1,100 @@
+"""Worker for the elastic end-to-end drill (test_elastic_drill.py).
+
+Each rank owns a row-block of a global 8x4 parameter (ZeRO-style
+partition by world size), "trains" by adding 1.0 per step, and saves a
+distributed checkpoint (LocalShard format) after every step under
+ckpt/<step>/. On start it resumes from the newest complete checkpoint —
+whatever world size wrote it (reshard-on-load).
+
+Failure injection via env:
+- ELASTIC_FAIL_RANKS="2,3" + ELASTIC_FAIL_GEN=0 + ELASTIC_FAIL_STEP=3:
+  those ranks exit(7) after saving that step in that generation;
+  surviving ranks stop cleanly at the same step so the generation ends
+  and the launcher restarts (possibly scaled down).
+Reference semantics: fleet/elastic/manager.py restart + scale decisions,
+checkpoint/load_state_dict.py reshard-on-load.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+if not os.environ.get("PADDLE_TPU_TEST_FULL_OPT"):
+    jax.config.update("jax_disable_most_optimizations", True)
+
+import numpy as np
+
+from paddle_tpu.distributed.checkpoint import (LocalShard, load_state_dict,
+                                               save_state_dict)
+
+GLOBAL_SHAPE = (8, 4)
+TOTAL_STEPS = 6
+
+
+def _block(rank, world):
+    rows = GLOBAL_SHAPE[0]
+    per = rows // world
+    start = rank * per
+    stop = rows if rank == world - 1 else start + per
+    return start, stop
+
+
+def _latest_step(ckpt):
+    best = -1
+    if os.path.isdir(ckpt):
+        for d in os.listdir(ckpt):
+            if d.isdigit() and os.path.exists(
+                    os.path.join(ckpt, d, "metadata.json")):
+                best = max(best, int(d))
+    return best
+
+
+def main():
+    ckpt, marker_dir = sys.argv[1], sys.argv[2]
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    world = int(os.environ["PADDLE_TRAINERS_NUM"])
+    gen = int(os.environ["PADDLE_RESTART_GENERATION"])
+    fail_ranks = {int(r) for r in os.environ.get(
+        "ELASTIC_FAIL_RANKS", "").split(",") if r}
+    fail_gen = int(os.environ.get("ELASTIC_FAIL_GEN", -1))
+    fail_step = int(os.environ.get("ELASTIC_FAIL_STEP", 10 ** 9))
+
+    start_row, stop_row = _block(rank, world)
+    w = np.zeros((stop_row - start_row, GLOBAL_SHAPE[1]), np.float32)
+    step = 0
+
+    resume = _latest_step(ckpt)
+    if resume >= 0:
+        shard = LocalShard(w, GLOBAL_SHAPE, (start_row, 0))
+        sd = {"w": shard, "step": 0}
+        load_state_dict(sd, ckpt, unique_id=resume)
+        w = shard.array
+        step = int(sd["step"])
+        assert step == resume, (step, resume)
+        # the resumed shard must hold exactly `step` accumulated updates
+        # regardless of which world size wrote it (reshard-on-load proof)
+        assert np.all(w == float(step)), (rank, world, step, w)
+
+    open(os.path.join(
+        marker_dir,
+        f"gen{gen}.rank{rank}.world{world}.resume{step}"), "w").close()
+
+    while step < TOTAL_STEPS:
+        step += 1
+        w = w + 1.0
+        save_state_dict(
+            {"w": LocalShard(w, GLOBAL_SHAPE, (start_row, 0)),
+             "step": step},
+            ckpt, unique_id=step, barrier_timeout=60.0)
+        if gen == fail_gen and step >= fail_step:
+            if rank in fail_ranks:
+                sys.exit(7)  # simulated node death mid-training
+            sys.exit(0)      # survivors end the generation cleanly
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

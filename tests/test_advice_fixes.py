@@ -103,7 +103,9 @@ def test_fleet_init_honors_role_maker():
     restore the full environment — monkeypatch can't see those writes."""
     import os
     from paddle_tpu.distributed import fleet as fl
+    from paddle_tpu.distributed import mesh as dmesh
     snap = dict(os.environ)
+    prev_mesh = dmesh.get_mesh()
     try:
         for k in ("TRAINING_ROLE", "PADDLE_TRAINER_ID",
                   "PADDLE_TRAINERS_NUM", "PADDLE_TRAINER_ENDPOINTS",
@@ -124,6 +126,9 @@ def test_fleet_init_honors_role_maker():
     finally:
         os.environ.clear()
         os.environ.update(snap)
+        # Fleet.init builds an HCG which installs a global mesh — restore
+        # it so later no-mesh tests see the pristine state
+        dmesh._global_mesh[0] = prev_mesh
 
 
 def test_model_average_window_restart_keeps_history():

@@ -86,3 +86,48 @@ def test_flash_candidates_divisible():
     for q, k in cands:
         assert 1024 % q == 0 and 2048 % k == 0
     assert autotune.flash_block_candidates(96, 96, 64) == [(96, 96)]
+
+
+def test_tune_signature_matches_resolver():
+    """The bshd wrapper, the Pallas resolver, and the bench probe must
+    agree on the cache key, or probe-tuned blocks never reach training
+    (round-5 review finding)."""
+    import jax.numpy as jnp
+    from paddle_tpu.kernels import autotune
+    from paddle_tpu.kernels.flash_attention import _tune_signature
+    from paddle_tpu.kernels.flash_pallas import _resolve_blocks
+    q_bshd = jnp.zeros((2, 2048, 12, 128), jnp.bfloat16)
+    sig = _tune_signature(q_bshd, q_bshd, True)
+    assert sig == (2048, 2048, 128, "bfloat16", True)
+    autotune.record("flash_fwd", sig, (256, 512))
+    try:
+        q_bhsd = jnp.zeros((2, 12, 2048, 128), jnp.bfloat16)
+        assert _resolve_blocks("flash_fwd", q_bhsd, q_bhsd, True,
+                               None, None) == (256, 512)
+        # flashmask inherits the dense-causal winner
+        assert _resolve_blocks("flashmask_fwd", q_bhsd, q_bhsd, True,
+                               None, None) == (256, 512)
+    finally:
+        autotune.clear()
+
+
+def test_cached_memoizes_misses(tmp_path):
+    import json as _json
+    from paddle_tpu.kernels import autotune
+    p = tmp_path / "cache.json"
+    p.write_text(_json.dumps({}))
+    autotune.set_cache_path(str(p))
+    try:
+        autotune.clear()
+        assert autotune.cached("flash_fwd", (1, 1, 1, "x", True)) is None
+        # poison the file: a re-read would now crash json parsing… but a
+        # memoized miss never re-reads
+        p.write_text("{not json")
+        assert autotune.cached("flash_fwd", (1, 1, 1, "x", True)) is None
+        # record() overwrites the sentinel
+        autotune.record("flash_fwd", (1, 1, 1, "x", True), (256, 256))
+        assert autotune.cached("flash_fwd",
+                               (1, 1, 1, "x", True)) == (256, 256)
+    finally:
+        autotune.set_cache_path(None)
+        autotune.clear()

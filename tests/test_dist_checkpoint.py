@@ -154,3 +154,13 @@ class TestTrainerCheckpointBridge:
             np.testing.assert_allclose(np.asarray(pa._data),
                                        np.asarray(pb._data), atol=1e-6,
                                        err_msg=na)
+
+
+def test_local_shard_validation():
+    from paddle_tpu.distributed.checkpoint import LocalShard
+    with pytest.raises(ValueError, match="array rank"):
+        LocalShard(np.zeros(4, np.float32), (8, 4), (0, 0))
+    with pytest.raises(ValueError, match="offsets rank"):
+        LocalShard(np.zeros((2, 4), np.float32), (8, 4), (0,))
+    with pytest.raises(ValueError, match="exceeds"):
+        LocalShard(np.zeros((4, 4), np.float32), (8, 4), (6, 0))

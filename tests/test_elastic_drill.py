@@ -1,0 +1,109 @@
+"""Elastic end-to-end drills (VERDICT r04 #6): kill ranks mid-training,
+assert generation restart resumes from the distributed checkpoint; scale
+the world down 4 -> 2 proving reshard-on-load across world sizes.
+
+Reference parity: fleet/elastic/manager.py:218-293 (scale decisions +
+restart), launch collective controller watcher, and the checkpoint
+overlap algorithm (checkpoint/load_state_dict.py). Subprocess-based on
+CPU, like tests/test_launch.py.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "elastic_worker.py")
+
+
+def _launch(nnodes, ckpt, markers, env_extra, max_restarts=2):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(env_extra)
+    return subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nnodes", str(nnodes), "--nproc_per_node", "1",
+         "--max_restarts", str(max_restarts), WORKER, ckpt, markers],
+        capture_output=True, timeout=600, cwd=REPO, env=env)
+
+
+def _final_w(ckpt):
+    """Load the newest checkpoint as one full array (world=1 reader)."""
+    steps = sorted(int(d) for d in os.listdir(ckpt) if d.isdigit()
+                   and os.path.exists(os.path.join(ckpt, d,
+                                                   "metadata.json")))
+    assert steps, "no complete checkpoint written"
+    last = steps[-1]
+    sys.path.insert(0, REPO)
+    from paddle_tpu.distributed.checkpoint import (LocalShard,
+                                                   load_state_dict)
+    shard = LocalShard(np.zeros((8, 4), np.float32), (8, 4), (0, 0))
+    sd = {"w": shard, "step": 0}
+    load_state_dict(sd, ckpt, unique_id=last)
+    return shard.array, int(sd["step"])
+
+
+@pytest.mark.slow
+def test_kill_rank_mid_training_resumes_from_checkpoint(tmp_path):
+    """4 fixed ranks; rank 1 dies after step 2 of generation 0; the
+    restarted generation must RESUME from the step-2 checkpoint (not
+    restart training from zero) and finish."""
+    ckpt, markers = str(tmp_path / "ckpt"), str(tmp_path / "markers")
+    os.makedirs(markers)
+    r = _launch(4, ckpt, markers,
+                {"ELASTIC_FAIL_RANKS": "1", "ELASTIC_FAIL_GEN": "0",
+                 "ELASTIC_FAIL_STEP": "2"})
+    err = r.stderr.decode()
+    assert r.returncode == 0, err + r.stdout.decode()
+    assert "restarting generation 1" in err
+    # generation 1 ran with the SAME world and resumed from step 2
+    gen1 = [m for m in os.listdir(markers) if m.startswith("gen1.")]
+    assert len(gen1) == 4, (gen1, err)
+    assert all(".world4.resume2" in m for m in gen1), gen1
+    w, step = _final_w(ckpt)
+    assert step == 6
+    np.testing.assert_array_equal(w, np.full((8, 4), 6.0))
+
+
+@pytest.mark.slow
+def test_elastic_scale_down_4_to_2_reshard_on_load(tmp_path):
+    """Elastic --nnodes 2:4: ranks 2 and 3 die after step 3; the next
+    generation relaunches with 2 ranks which load the 4-rank checkpoint
+    (reshard-on-load across world sizes) and finish training."""
+    ckpt, markers = str(tmp_path / "ckpt"), str(tmp_path / "markers")
+    os.makedirs(markers)
+    r = _launch("2:4", ckpt, markers,
+                {"ELASTIC_FAIL_RANKS": "2,3", "ELASTIC_FAIL_GEN": "0",
+                 "ELASTIC_FAIL_STEP": "3"})
+    err = r.stderr.decode()
+    assert r.returncode == 0, err + r.stdout.decode()
+    assert "elastic scale-down: world 4 -> 2" in err
+    gen0 = [m for m in os.listdir(markers) if m.startswith("gen0.")]
+    gen1 = [m for m in os.listdir(markers) if m.startswith("gen1.")]
+    assert len(gen0) == 4 and all(".world4." in m for m in gen0)
+    # the scaled-down generation: 2 ranks, resumed from the 4-rank step-3
+    # checkpoint — each rank's WIDER row-block assembled from the old
+    # narrower shards
+    assert len(gen1) == 2, (gen1, err)
+    assert all(".world2.resume3" in m for m in gen1), gen1
+    w, step = _final_w(ckpt)
+    assert step == 6
+    np.testing.assert_array_equal(w, np.full((8, 4), 6.0))
+    # the final metadata records the new world size
+    meta = json.load(open(os.path.join(ckpt, "6", "metadata.json")))
+    assert meta["world_size"] == 2
+
+
+@pytest.mark.slow
+def test_elastic_gives_up_below_min_nodes(tmp_path):
+    """2:4 with 3 dead ranks: 1 survivor < min 2 -> clean failure."""
+    ckpt, markers = str(tmp_path / "ckpt"), str(tmp_path / "markers")
+    os.makedirs(markers)
+    r = _launch("2:4", ckpt, markers,
+                {"ELASTIC_FAIL_RANKS": "1,2,3", "ELASTIC_FAIL_GEN": "0",
+                 "ELASTIC_FAIL_STEP": "1"})
+    assert r.returncode == 1
+    assert "survivors < min_nodes=2" in r.stderr.decode()

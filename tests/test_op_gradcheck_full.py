@@ -1,0 +1,489 @@
+"""OpTest gradient sweep over the FULL direct-op surface (VERDICT r04 #5).
+
+Enumerates every `direct` op from OPS_COVERAGE.md (the machine-generated
+audit of the reference's ops.yaml) and requires each to be exactly one
+of:
+- GRAD_CASES here: analytic-vs-finite-difference gradient check
+  (reference pattern: test/legacy_test/op_test.py:3075 check_grad);
+- BASE_COVERED: gradient-checked in tests/test_op_gradcheck.py;
+- SKIP: a documented reason (non-differentiable output, stochastic,
+  creation, utility) or a pointer to the dedicated suite that exercises
+  its backward.
+
+test_direct_surface_fully_classified is the completeness gate: a new
+direct op that lands unclassified fails the suite.
+
+The same registry powers a bf16 forward-parity sweep (fp32 vs bf16
+within bf16 tolerance) extending tests/test_dtype_sweep.py to the full
+differentiable surface.
+"""
+import os
+import re
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from test_op_gradcheck import _a, check_grad
+
+
+def _direct_ops():
+    path = os.path.join(os.path.dirname(__file__), "..", "OPS_COVERAGE.md")
+    ops = []
+    for line in open(path):
+        m = re.match(r"\| `([^`]+)` \| direct \|", line)
+        if m:
+            ops.append(m.group(1))
+    assert len(ops) >= 290, f"audit table parse broke: {len(ops)}"
+    return ops
+
+
+def _t(a):
+    return paddle.to_tensor(a)
+
+
+def _spd(n, seed=0):
+    """Symmetric positive definite matrix."""
+    rng = np.random.default_rng(seed)
+    a = rng.standard_normal((n, n)).astype(np.float32)
+    return a @ a.T + n * np.eye(n, dtype=np.float32)
+
+
+# ---------------------------------------------------------------------------
+# ops whose gradient is checked in tests/test_op_gradcheck.py
+# ---------------------------------------------------------------------------
+BASE_COVERED = {
+    "exp", "log", "sqrt", "rsqrt", "erf", "sin", "cos", "atan", "asinh",
+    "log1p", "expm1", "gelu", "silu", "mish", "logit", "reciprocal",
+    "square", "lgamma", "digamma", "erfinv", "log_softmax",
+    "logcumsumexp", "cumsum", "cumprod", "cummax", "cummin", "pow",
+    "atan2", "kron", "lerp", "sum", "mean", "max", "logsumexp", "prod",
+    "norm", "amax", "transpose", "reshape", "flip", "roll", "gather",
+    "index_select", "tril", "unfold", "take_along_axis", "conv2d",
+    "layer_norm", "rms_norm", "softplus", "nll_loss",
+}
+
+# ---------------------------------------------------------------------------
+# documented skips: reason or dedicated-suite pointer
+# ---------------------------------------------------------------------------
+_BOOL = "boolean/comparison output: no gradient exists"
+_INT = "integer/index output: no gradient exists"
+_RAND = "stochastic sampling: no deterministic finite-difference oracle"
+_CREATE = "creation op: no differentiable tensor input"
+_ZERO = "zero gradient almost everywhere (step function)"
+_UTIL = "shape/dtype/metadata utility: gradient is trivial or undefined"
+_DETECT = "detection geometry/post-processing on box coordinates: " \
+          "selection-based, exercised in tests/test_detection_ops.py"
+
+SKIP = {
+    # boolean / comparison
+    "all": _BOOL, "any": _BOOL, "allclose": _BOOL, "equal_all": _BOOL,
+    "is_empty": _BOOL, "isclose": _BOOL, "isfinite": _BOOL,
+    "isinf": _BOOL, "isnan": _BOOL, "logical_and": _BOOL,
+    "logical_not": _BOOL, "logical_or": _BOOL, "logical_xor": _BOOL,
+    "sequence_mask": _BOOL,
+    # integer / index outputs
+    "argmax": _INT, "argmin": _INT, "argsort": _INT, "bincount": _INT,
+    "bipartite_match": _INT, "crf_decoding": _INT, "edit_distance": _INT,
+    "gather_tree": _INT, "histogram": _INT, "matrix_rank": _INT,
+    "nonzero": _INT, "numel": _INT, "searchsorted": _INT, "shape": _INT,
+    "shard_index": _INT, "unique_consecutive": _INT,
+    "viterbi_decode": _INT, "one_hot": _INT,
+    # stochastic
+    "bernoulli": _RAND, "binomial": _RAND, "exponential_": _RAND,
+    "multinomial": _RAND, "poisson": _RAND, "randint": _RAND,
+    "randperm": _RAND, "standard_gamma": _RAND, "uniform": _RAND,
+    "top_p_sampling": _RAND,
+    "dropout": "stochastic mask; backward exercised deterministically in "
+               "tests/test_nn.py (train/eval modes)",
+    "rrelu": "stochastic slope in training; deterministic eval path is "
+             "elementwise linear",
+    "gumbel_softmax": _RAND,
+    "class_center_sample": _INT,
+    # creation
+    "empty": _CREATE, "empty_like": _CREATE, "eye": _CREATE,
+    "full": _CREATE, "full_": _CREATE, "full_like": _CREATE,
+    "linspace": _CREATE, "logspace": _CREATE, "ones": _CREATE,
+    "ones_like": _CREATE, "zeros": _CREATE, "zeros_like": _CREATE,
+    "tril_indices": _CREATE, "triu_indices": _CREATE,
+    # zero-gradient a.e.
+    "ceil": _ZERO, "floor": _ZERO, "round": _ZERO, "trunc": _ZERO,
+    "sign": _ZERO, "heaviside": _ZERO,
+    # integer-dtype ops
+    "bitwise_and": _INT, "bitwise_left_shift": _INT, "bitwise_not": _INT,
+    "bitwise_or": _INT, "bitwise_right_shift": _INT, "bitwise_xor": _INT,
+    # io
+    "decode_jpeg": "byte-stream decoder (no gradient); parsing exercised "
+                   "in tests/test_vision_io.py if present",
+    "read_file": "byte-stream reader: no gradient",
+    # utilities
+    "cast": "gradient is identity through dtype change; exercised "
+            "implicitly by every mixed-dtype grad test",
+    "increment": "in-place counter utility on a scalar",
+    "accuracy": "metric op (argmax-based): no gradient",
+    "as_strided": "aliasing view; gradient covered via slice/reshape "
+                  "cases and tests/test_ops.py view tests",
+    "identity_loss": _UTIL,
+    # complex-valued ops: the finite-difference harness here is
+    # real-valued; complex forward/backward is exercised in
+    # tests/test_ops.py complex cases
+    "as_complex": "complex-valued; see tests/test_linalg_extra.py",
+    "as_real": "complex-valued; see tests/test_linalg_extra.py",
+    "complex": "complex-valued; see tests/test_linalg_extra.py",
+    "conj": "complex-valued; see tests/test_linalg_extra.py",
+    "angle": "complex-valued; see tests/test_linalg_extra.py",
+    "real": "complex-valued; see tests/test_linalg_extra.py",
+    "imag": "complex-valued; see tests/test_linalg_extra.py",
+    "eig": "complex eigenpairs; forward exercised in "
+           "tests/test_linalg_extra.py",
+    "eigvals": "complex eigenvalues; forward exercised in "
+               "tests/test_linalg_extra.py",
+    # dedicated suites
+    "flash_attn_qkvpacked": "fwd+bwd vs oracle in "
+                            "tests/test_flash_kernel.py",
+    "flash_attn_unpadded": "varlen surface in tests/test_flash_kernel.py",
+    "flash_attn_varlen_qkvpacked": "varlen surface in "
+                                   "tests/test_flash_kernel.py",
+    "flashmask_attention": "fwd+bwd parity in tests/test_flashmask.py",
+    "masked_multihead_attention_": "fused decode step vs dense oracle in "
+                                   "tests/test_incubate_fused.py",
+    "sparse_attention": "CSR-masked attention vs dense oracle in "
+                        "tests/test_nn.py",
+    "margin_cross_entropy": "loss+grad parity in tests/test_chunked_loss"
+                            ".py / loss suites",
+    "stft": "complex output; signal round-trip (stft->istft) in "
+            "tests/test_audio_autograd.py",
+    "conv2d_transpose": "grad via tests/test_op_gradcheck.py conv + "
+                        "transpose-conv parity in tests/test_nn.py",
+    "conv3d": "same kernel family as conv2d (checked); 3-D forward "
+              "parity in tests/test_nn.py",
+    "conv3d_transpose": "see conv3d",
+    "nms": _DETECT, "matrix_nms": _DETECT, "generate_proposals": _DETECT,
+    "prior_box": _DETECT, "box_clip": _DETECT, "box_coder": _DETECT,
+    "yolo_box": _DETECT,
+    "yolo_loss": "assignment-based detection loss; determinism + value "
+                 "tests in tests/test_detection_ops.py",
+    "roi_align": "gradient flows through bilinear sampling; op parity in "
+                 "tests/test_detection_ops.py",
+    "roi_pool": "max-pool selection over ROIs; parity in "
+                "tests/test_detection_ops.py",
+    "psroi_pool": "position-sensitive ROI pooling; parity in "
+                  "tests/test_detection_ops.py",
+    # graph ops
+    "graph_khop_sampler": "graph sampling (integer neighborhoods); "
+                          "tests/test_geometric.py",
+    "graph_sample_neighbors": "graph sampling; tests/test_geometric.py",
+    "reindex_graph": _INT,
+    "weighted_sample_neighbors": _RAND,
+    "send_u_recv": "message passing; value tests in "
+                   "tests/test_geometric.py (scatter-gather grads are "
+                   "the gather/put_along_axis cases here)",
+    "send_ue_recv": "see send_u_recv",
+    "send_uv": "see send_u_recv",
+    # numerically-awkward decompositions (jax provides no / unstable vjp)
+    "lstsq": "least-squares solver; vjp not defined for all driver "
+             "modes — forward parity in tests/test_linalg_extra.py",
+    "lu": "pivoted LU vjp unstable under finite differences; forward "
+          "round-trip in tests/test_linalg_extra.py",
+    "lu_unpack": "see lu",
+    "qr": "sign-ambiguous factors make finite differences ill-posed; "
+          "forward orthogonality checked in tests/test_linalg_extra.py",
+    "svd": "sign/ordering ambiguity of factors; forward parity in "
+           "tests/test_linalg_extra.py",
+    "eigh": "eigenvector sign ambiguity; eigenvalue path covered by "
+            "eigvalsh case",
+    "hsigmoid_loss": "hierarchical-softmax tree loss; value tests in "
+                     "tests/test_nn_tail.py",
+    "mode": "most-frequent-value selection: gradient ill-defined under "
+            "perturbation (element selection flips discontinuously)",
+    "nextafter": "bit-level dtype operation: gradient undefined",
+    "fractional_max_pool2d": "random region boundaries; deterministic "
+                             "pooling grads covered by max/pool cases",
+    "fractional_max_pool3d": "see fractional_max_pool2d",
+}
+
+# ---------------------------------------------------------------------------
+# gradient cases for everything else
+# ---------------------------------------------------------------------------
+
+
+def _ga(*shape, lo=-1.0, hi=1.0, seed=1):
+    return _a(*shape, lo=lo, hi=hi, seed=seed)
+
+
+GRAD_CASES = {
+    "abs": (lambda x: paddle.abs(x), [_ga(3, 4, lo=0.2, hi=1.0)]),
+    "acos": (lambda x: paddle.acos(x), [_ga(3, 4, lo=-0.8, hi=0.8)]),
+    "acosh": (lambda x: paddle.acosh(x), [_ga(3, 4, lo=1.5, hi=3.0)]),
+    "addmm": (lambda m, a, b: paddle.addmm(m, a, b),
+              [_ga(2, 3), _ga(2, 4, seed=2), _ga(4, 3, seed=3)]),
+    "affine_grid": (lambda th: F.affine_grid(th, [2, 2, 4, 4]),
+                    [_ga(2, 2, 3)]),
+    "amin": (lambda x: paddle.amin(x, axis=1), [_ga(3, 4)]),
+    "asin": (lambda x: paddle.asin(x), [_ga(3, 4, lo=-0.8, hi=0.8)]),
+    "atanh": (lambda x: paddle.atanh(x), [_ga(3, 4, lo=-0.7, hi=0.7)]),
+    "bilinear": (lambda x, y, w: F.bilinear(x, y, w),
+                 [_ga(3, 4), _ga(3, 5, seed=2), _ga(2, 4, 5, seed=3)]),
+    "bmm": (lambda a, b: paddle.bmm(a, b),
+            [_ga(2, 3, 4), _ga(2, 4, 2, seed=2)]),
+    "broadcast_tensors": (
+        lambda a, b: paddle.broadcast_tensors([a, b])[0] *
+        paddle.broadcast_tensors([a, b])[1],
+        [_ga(3, 1), _ga(1, 4, seed=2)]),
+    "celu": (lambda x: F.celu(x, alpha=1.2), [_ga(3, 4)]),
+    "channel_shuffle": (lambda x: F.channel_shuffle(x, 2),
+                        [_ga(1, 4, 3, 3)]),
+    "cholesky": (lambda x: paddle.linalg.cholesky(x), [_spd(3)]),
+    "cholesky_solve": (
+        lambda b, l: paddle.linalg.cholesky_solve(
+            b, l, upper=False),
+        [_ga(3, 2), np.linalg.cholesky(_spd(3)).astype(np.float32)]),
+    "clip": (lambda x: paddle.clip(x, -0.5, 0.5),
+             [_ga(3, 4, lo=-0.45, hi=0.45)]),
+    "clip_by_norm": (lambda x: paddle.clip_by_norm(x, 0.5),
+                     [_ga(3, 4)]),
+    "concat": (lambda a, b: paddle.concat([a, b], axis=1),
+               [_ga(3, 2), _ga(3, 3, seed=2)]),
+    "copysign": (lambda x: paddle.copysign(
+        x, paddle.to_tensor(np.float32([[1, -1, 1, -1]] * 3))),
+        [_ga(3, 4, lo=0.2, hi=1.0)]),
+    "cosh": (lambda x: paddle.cosh(x), [_ga(3, 4)]),
+    "crop": (lambda x: paddle.crop(x, shape=[2, 2], offsets=[1, 1]),
+             [_ga(4, 4)]),
+    "cross": (lambda a, b: paddle.cross(a, b, axis=1),
+              [_ga(2, 3), _ga(2, 3, seed=2)]),
+    "det": (lambda x: paddle.linalg.det(x), [_spd(3)]),
+    "diag": (lambda x: paddle.diag(x), [_ga(4)]),
+    "diag_embed": (lambda x: paddle.diag_embed(x), [_ga(3, 4)]),
+    "diagonal": (lambda x: paddle.diagonal(x), [_ga(4, 4)]),
+    "dist": (lambda a, b: paddle.dist(a, b, p=2),
+             [_ga(3, 4), _ga(3, 4, seed=2)]),
+    "dot": (lambda a, b: paddle.dot(a, b), [_ga(5), _ga(5, seed=2)]),
+    "eigvalsh": (lambda x: paddle.linalg.eigvalsh(x), [_spd(3)]),
+    "elu": (lambda x: F.elu(x, alpha=1.1), [_ga(3, 4)]),
+    "expand": (lambda x: paddle.expand(x, [3, 4]), [_ga(1, 4)]),
+    "expand_as": (lambda x: paddle.expand_as(
+        x, paddle.to_tensor(np.zeros((3, 4), np.float32))), [_ga(1, 4)]),
+    "fill_diagonal_tensor": (
+        lambda x, y: paddle.fill_diagonal_tensor(x, y),
+        [_ga(3, 3), _ga(3, seed=2)]),
+    "flatten": (lambda x: paddle.flatten(x), [_ga(2, 3, 2)]),
+    "fmax": (lambda a, b: paddle.fmax(a, b),
+             [_ga(3, 4), _ga(3, 4, seed=2)]),
+    "fmin": (lambda a, b: paddle.fmin(a, b),
+             [_ga(3, 4), _ga(3, 4, seed=2)]),
+    "fold": (lambda x: F.fold(x, output_sizes=[4, 4], kernel_sizes=[2, 2],
+                              strides=2), [_ga(1, 4, 4)]),
+    "frame": (lambda x: paddle.signal.frame(x, frame_length=4, hop_length=2),
+              [_ga(10)]),
+    "gammaincc": (lambda x: paddle.gammaincc(
+        paddle.to_tensor(np.float32([2.0, 3.0, 2.5])), x),
+        [_ga(3, lo=1.0, hi=3.0)]),
+    "gammaln": (lambda x: paddle.gammaln(x), [_ga(3, 4, lo=1.5, hi=3.0)]),
+    "gather_nd": (lambda x: paddle.gather_nd(
+        x, paddle.to_tensor(np.array([[0, 1], [2, 0]], np.int64))),
+        [_ga(3, 4)]),
+    "grid_sample": (lambda x, g: F.grid_sample(x, g, align_corners=True),
+                    [_ga(1, 2, 4, 4), _ga(1, 3, 3, 2, lo=-0.8, hi=0.8,
+                                          seed=2)]),
+    "group_norm": (lambda x, w, b: F.group_norm(x, 2, weight=w, bias=b),
+                   [_ga(2, 4, 3, 3), _ga(4, lo=0.5, hi=1.5, seed=2),
+                    _ga(4, seed=3)]),
+    "hardshrink": (lambda x: F.hardshrink(x, threshold=0.3),
+                   [_ga(3, 4, lo=0.35, hi=1.0)]),
+    "hardsigmoid": (lambda x: F.hardsigmoid(x),
+                    [_ga(3, 4, lo=-0.9, hi=0.9)]),
+    "hardtanh": (lambda x: F.hardtanh(x), [_ga(3, 4, lo=-0.9, hi=0.9)]),
+    "huber_loss": (lambda x: F.smooth_l1_loss(
+        x, paddle.to_tensor(_ga(3, 4, seed=9))), [_ga(3, 4)]),
+    "i0": (lambda x: paddle.i0(x), [_ga(3, 4)]),
+    "i0e": (lambda x: paddle.i0e(x), [_ga(3, 4)]),
+    "i1": (lambda x: paddle.i1(x), [_ga(3, 4)]),
+    "i1e": (lambda x: paddle.i1e(x), [_ga(3, 4)]),
+    "index_add": (lambda x, v: paddle.index_add(
+        x, paddle.to_tensor(np.array([0, 2], np.int64)), 0, v),
+        [_ga(3, 4), _ga(2, 4, seed=2)]),
+    "index_put": (lambda x, v: paddle.index_put(
+        x, [paddle.to_tensor(np.array([0, 2], np.int64))], v),
+        [_ga(3, 4), _ga(2, 4, seed=2)]),
+    "index_sample": (lambda x: paddle.index_sample(
+        x, paddle.to_tensor(np.array([[0, 2], [1, 3], [2, 0]], np.int64))),
+        [_ga(3, 4)]),
+    "instance_norm": (lambda x, w, b: F.instance_norm(x, weight=w, bias=b),
+                      [_ga(2, 3, 4, 4), _ga(3, lo=0.5, hi=1.5, seed=2),
+                       _ga(3, seed=3)]),
+    "inverse": (lambda x: paddle.linalg.inv(x), [_spd(3)]),
+    "kthvalue": (lambda x: paddle.kthvalue(x, k=2, axis=1)[0],
+                 [_ga(3, 4)]),
+    "l1_norm": (lambda x: paddle.abs(x).sum(), [_ga(3, 4, lo=0.2,
+                                                    hi=1.0)]),
+    "label_smooth": (lambda x: F.label_smooth(x, epsilon=0.1),
+                     [_ga(3, 4, lo=0.0, hi=1.0)]),
+    "leaky_relu": (lambda x: F.leaky_relu(x, 0.1),
+                   [_ga(3, 4, lo=0.1, hi=1.0)]),
+    "log10": (lambda x: paddle.log10(x), [_ga(3, 4, lo=0.5, hi=2.0)]),
+    "log2": (lambda x: paddle.log2(x), [_ga(3, 4, lo=0.5, hi=2.0)]),
+    "log_loss": (lambda x: F.log_loss(
+        x, paddle.to_tensor(_ga(3, 1, lo=0.0, hi=1.0, seed=9))),
+        [_ga(3, 1, lo=0.2, hi=0.8)]),
+    "lp_pool2d": (lambda x: F.lp_pool2d(x, norm_type=2, kernel_size=2),
+                  [_ga(1, 2, 4, 4, lo=0.2, hi=1.0)]),
+    "masked_select": (lambda x: paddle.masked_select(
+        x, paddle.to_tensor(np.array([[True, False, True, False]] * 3))),
+        [_ga(3, 4)]),
+    "matrix_power": (lambda x: paddle.linalg.matrix_power(x, 2),
+                     [_spd(3)]),
+    "maxout": (lambda x: F.maxout(x, groups=2), [_ga(1, 4, 3, 3)]),
+    "meshgrid": (lambda a, b: paddle.meshgrid(a, b)[0] *
+                 paddle.meshgrid(a, b)[1], [_ga(3), _ga(4, seed=2)]),
+    "multi_dot": (lambda a, b, c: paddle.linalg.multi_dot([a, b, c]),
+                  [_ga(2, 3), _ga(3, 4, seed=2), _ga(4, 2, seed=3)]),
+    "multiplex": (lambda a, b: paddle.multiplex(
+        [a, b], paddle.to_tensor(np.array([[0], [1], [0]], np.int32))),
+        [_ga(3, 4), _ga(3, 4, seed=2)]),
+    "mv": (lambda m, v: paddle.mv(m, v), [_ga(3, 4), _ga(4, seed=2)]),
+    "nanmedian": (lambda x: paddle.nanmedian(x, axis=1), [_ga(3, 5)]),
+    "overlap_add": (lambda x: paddle.signal.overlap_add(x, hop_length=2),
+                    [_ga(4, 3)]),
+    "pad": (lambda x: F.pad(x, [1, 1], value=0.0), [_ga(3, 4)]),
+    "pixel_shuffle": (lambda x: F.pixel_shuffle(x, 2), [_ga(1, 4, 2, 2)]),
+    "pixel_unshuffle": (lambda x: F.pixel_unshuffle(x, 2),
+                        [_ga(1, 1, 4, 4)]),
+    "polygamma": (lambda x: paddle.polygamma(x, 1),
+                  [_ga(3, 4, lo=1.5, hi=3.0)]),
+    "prelu": (lambda x, w: F.prelu(x, w),
+              [_ga(3, 4, lo=0.1, hi=1.0), _ga(1, lo=0.1, hi=0.5,
+                                              seed=2)]),
+    "put_along_axis": (lambda x, v: paddle.put_along_axis(
+        x, paddle.to_tensor(np.array([[0], [2], [1]], np.int64)), v, 1),
+        [_ga(3, 4), _ga(3, 1, seed=2)]),
+    "reduce_as": (lambda x: paddle.reduce_as(
+        x, paddle.to_tensor(np.zeros(4, np.float32))), [_ga(3, 4)]),
+    "relu": (lambda x: F.relu(x), [_ga(3, 4, lo=0.1, hi=1.0)]),
+    "relu6": (lambda x: F.relu6(x), [_ga(3, 4, lo=0.1, hi=1.0)]),
+    "renorm": (lambda x: paddle.renorm(x, p=2.0, axis=0, max_norm=0.5),
+               [_ga(3, 4)]),
+    "repeat_interleave": (lambda x: paddle.repeat_interleave(x, 2, axis=1),
+                          [_ga(3, 4)]),
+    "reverse": (lambda x: paddle.reverse(x, axis=[0]), [_ga(3, 4)]),
+    "scale": (lambda x: paddle.scale(x, scale=2.5, bias=0.5), [_ga(3, 4)]),
+    "scatter": (lambda x, u: paddle.scatter(
+        x, paddle.to_tensor(np.array([0, 2], np.int64)), u),
+        [_ga(3, 4), _ga(2, 4, seed=2)]),
+    "scatter_nd_add": (lambda x, u: paddle.scatter_nd_add(
+        x, paddle.to_tensor(np.array([[0], [2]], np.int64)), u),
+        [_ga(3, 4), _ga(2, 4, seed=2)]),
+    "selu": (lambda x: F.selu(x), [_ga(3, 4)]),
+    "sigmoid": (lambda x: F.sigmoid(x), [_ga(3, 4)]),
+    "sinh": (lambda x: paddle.sinh(x), [_ga(3, 4)]),
+    "slice": (lambda x: paddle.slice(x, [0, 1], [0, 1], [2, 3]),
+              [_ga(3, 4)]),
+    "slogdet": (lambda x: paddle.linalg.slogdet(x)[1], [_spd(3)]),
+    "softshrink": (lambda x: F.softshrink(x, threshold=0.2),
+                   [_ga(3, 4, lo=0.3, hi=1.0)]),
+    "softsign": (lambda x: F.softsign(x), [_ga(3, 4)]),
+    "solve": (lambda a, b: paddle.linalg.solve(a, b),
+              [_spd(3), _ga(3, 2, seed=2)]),
+    "split": (lambda x: paddle.split(x, 2, axis=1)[0], [_ga(3, 4)]),
+    "sqrt": (lambda x: paddle.sqrt(x), [_ga(3, 4, lo=0.5, hi=2.0)]),
+    "squared_l2_norm": (lambda x: (x * x).sum(), [_ga(3, 4)]),
+    "squeeze": (lambda x: paddle.squeeze(x, axis=1), [_ga(3, 1, 4)]),
+    "stack": (lambda a, b: paddle.stack([a, b], axis=0),
+              [_ga(3, 4), _ga(3, 4, seed=2)]),
+    "stanh": (lambda x: paddle.stanh(x), [_ga(3, 4)]),
+    "strided_slice": (lambda x: paddle.strided_slice(
+        x, [1], [0], [4], [2]), [_ga(3, 4)]),
+    "swiglu": (lambda a, b: paddle.incubate.nn.functional.swiglu(a, b),
+               [_ga(3, 4), _ga(3, 4, seed=2)]),
+    "swish": (lambda x: F.swish(x), [_ga(3, 4)]),
+    "tan": (lambda x: paddle.tan(x), [_ga(3, 4, lo=-1.0, hi=1.0)]),
+    "tanh": (lambda x: paddle.tanh(x), [_ga(3, 4)]),
+    "temporal_shift": (lambda x: F.temporal_shift(x, seg_num=2,
+                                                  shift_ratio=0.25),
+                       [_ga(4, 4, 2, 2)]),
+    "thresholded_relu": (lambda x: F.thresholded_relu(x, threshold=0.2),
+                         [_ga(3, 4, lo=0.3, hi=1.0)]),
+    "topk": (lambda x: paddle.topk(x, k=2, axis=1)[0], [_ga(3, 5)]),
+    "trace": (lambda x: paddle.trace(x), [_ga(4, 4)]),
+    "triangular_solve": (
+        lambda a, b: paddle.linalg.triangular_solve(a, b, upper=False),
+        [np.linalg.cholesky(_spd(3)).astype(np.float32), _ga(3, 2,
+                                                             seed=2)]),
+    "triu": (lambda x: paddle.triu(x), [_ga(4, 4)]),
+    "unbind": (lambda x: paddle.unbind(x, axis=0)[0], [_ga(3, 4)]),
+    "unsqueeze": (lambda x: paddle.unsqueeze(x, axis=1), [_ga(3, 4)]),
+    "unstack": (lambda x: paddle.unstack(x, axis=0)[0], [_ga(3, 4)]),
+    "where": (lambda a, b: paddle.where(
+        paddle.to_tensor(np.array([[True, False, True, False]] * 3)),
+        a, b), [_ga(3, 4), _ga(3, 4, seed=2)]),
+    "svdvals": (lambda x: paddle.linalg.svdvals(x), [_spd(3) +
+                                                     _ga(3, 3, seed=7)]),
+}
+
+
+def test_direct_surface_fully_classified():
+    """The completeness gate: every direct op from the audit table must
+    be gradient-checked here or in the base file, or carry a documented
+    skip reason. No overlaps, no strays, no unexplained gaps."""
+    direct = set(_direct_ops())
+    cased = set(GRAD_CASES) | BASE_COVERED
+    skipped = set(SKIP)
+    overlap = cased & skipped
+    assert not overlap, f"ops both cased and skipped: {sorted(overlap)}"
+    unknown = (cased | skipped) - direct
+    assert not unknown, f"classified ops not in audit table: " \
+                        f"{sorted(unknown)}"
+    missing = direct - cased - skipped
+    assert not missing, (
+        f"{len(missing)} direct ops with neither a gradient case nor a "
+        f"documented skip: {sorted(missing)}")
+
+
+@pytest.mark.parametrize("name", sorted(GRAD_CASES),
+                         ids=sorted(GRAD_CASES))
+def test_full_surface_gradients(name):
+    fn, arrays = GRAD_CASES[name][:2]
+    kw = GRAD_CASES[name][2] if len(GRAD_CASES[name]) > 2 else {}
+    check_grad(fn, arrays, **kw)
+
+
+# ---------------------------------------------------------------------------
+# bf16 forward-parity sweep over the same registry (extends
+# tests/test_dtype_sweep.py to the full differentiable surface)
+# ---------------------------------------------------------------------------
+
+_BF16_SKIP = {
+    # ops whose CPU bf16 lowering is unsupported or numerically
+    # meaningless at bf16 precision
+    "cholesky", "cholesky_solve", "det", "eigvalsh", "inverse",
+    "matrix_power", "multi_dot", "slogdet", "solve", "svdvals",
+    "triangular_solve",  # LAPACK paths are f32/f64-only
+    "gammaincc", "polygamma", "i0", "i0e", "i1", "i1e",  # special fns
+    "nextafter",  # dtype-specific by definition
+}
+
+
+@pytest.mark.parametrize("name", sorted(set(GRAD_CASES) - _BF16_SKIP),
+                         ids=sorted(set(GRAD_CASES) - _BF16_SKIP))
+def test_full_surface_bf16_forward(name):
+    """fp32 vs bf16 forward within bf16 tolerance — the MXU contract
+    (matmul-class ops accumulate fp32, elementwise ops round to bf16)."""
+    fn, arrays = GRAD_CASES[name][:2]
+
+    def run(dtype):
+        ts = []
+        for a in arrays:
+            t = paddle.to_tensor(a.astype(dtype)
+                                 if a.dtype == np.float32 else a)
+            ts.append(t)
+        out = fn(*ts)
+        out = out if isinstance(out, paddle.Tensor) else out[0]
+        return np.asarray(out.astype("float32").numpy())
+
+    ref = run(np.float32)
+    import ml_dtypes
+    got = run(ml_dtypes.bfloat16)
+    scale = max(np.abs(ref).max(), 1.0)
+    np.testing.assert_allclose(got, ref, rtol=0.05, atol=0.05 * scale,
+                               err_msg=name)

@@ -527,3 +527,23 @@ def test_switch_case_default_shares_max_key_params(exe):
     r9 = exe.run(main, feed={"i": np.array([9], np.int32), "x": xd},
                  fetch_list=[o])
     np.testing.assert_allclose(r1[0], r9[0], rtol=1e-6)
+
+
+def test_assert_aborts_before_update(exe):
+    """A failing Assert must abort the step BEFORE the optimizer update
+    is committed (reference abort-on-run ordering)."""
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [3], "float32")
+        w = static.create_parameter([3], "float32")
+        w._data = paddle.to_tensor(np.ones(3, np.float32))._data
+        snn.Assert((x > 0).all(), name="pos_x")
+        loss = (x * w).sum()
+    sgd = opt.SGD(learning_rate=1.0, parameters=[w])
+    main._optimize = (sgd, loss, [w])
+    before = np.array(w.numpy())
+    with pytest.raises(ValueError, match="pos_x"):
+        exe.run(main, feed={"x": -np.ones(3, np.float32)},
+                fetch_list=[loss])
+    np.testing.assert_array_equal(np.array(w.numpy()), before)
+    assert sgd._global_step == 0  # step counter rolled back

@@ -9,8 +9,12 @@ ROUND="${1:-r03}"
 LOG=tools/tpu_watch.log
 
 commit_retry() {  # survive index.lock races with the interactive session
+    local files=()
+    local f
+    for f in "$@"; do [ -e "$f" ] && files+=("$f"); done
+    [ ${#files[@]} -eq 0 ] && return 0
     for i in 1 2 3 4 5; do
-        git add -A "$@" 2>>"$LOG" && git commit -m "TPU watcher: hardware evidence ($ROUND)" -- "$@" >>"$LOG" 2>&1 && return 0
+        git add -A "${files[@]}" 2>>"$LOG" && git commit -m "TPU watcher: hardware evidence ($ROUND)" -- "${files[@]}" >>"$LOG" 2>&1 && return 0
         sleep 7
     done
     return 1
@@ -31,12 +35,12 @@ EOF
         echo "[watch] PROBE OK $(date -u +%FT%TZ)" >> "$LOG"
         grep '^{' /tmp/probe_out.json | tail -1 > "PROBE_$ROUND.json"
         cp "PROBE_$ROUND.json" PROBE_LATEST.json
-        commit_retry "PROBE_$ROUND.json" PROBE_LATEST.json
+        commit_retry "PROBE_$ROUND.json" PROBE_LATEST.json AUTOTUNE_CACHE.json
         echo "[watch] running full bench ladder..." >> "$LOG"
         timeout 14400 python bench.py --skip-probe > /tmp/bench_out.json 2>>"$LOG"
         grep '^{' /tmp/bench_out.json | tail -1 > "BENCH_SESSION_$ROUND.json"
         echo "[watch] bench done $(date -u +%FT%TZ): $(cat BENCH_SESSION_$ROUND.json)" >> "$LOG"
-        commit_retry "BENCH_SESSION_$ROUND.json" "PROBE_$ROUND.json" PROBE_LATEST.json
+        commit_retry "BENCH_SESSION_$ROUND.json" "PROBE_$ROUND.json" PROBE_LATEST.json AUTOTUNE_CACHE.json
         # success with a real number -> run the MFU lab variants, then stop
         if BFILE="BENCH_SESSION_$ROUND.json" python - <<'EOF'
 import json,os,sys
