@@ -94,11 +94,16 @@ class LocalShard:
                                            self.array.shape)]
 
 
-def _proc_info() -> Tuple[int, int]:
-    """(rank, world) — jax.distributed when initialized, else the launch
-    env (PADDLE_TRAINER_ID/NUM: multi-process host mode)."""
+def _proc_info(host_mode: bool) -> Tuple[int, int]:
+    """(rank, world) — jax.distributed when initialized; the launch env
+    (PADDLE_TRAINER_ID/NUM) ONLY when the caller opted into host-mode
+    collective semantics by saving LocalShard leaves. A plain
+    single-jax-process save under the launcher must stay a complete
+    standalone world-1 checkpoint (no cross-rank metadata barrier)."""
     if jax.process_count() > 1:
         return jax.process_index(), jax.process_count()
+    if not host_mode:
+        return 0, 1
     try:
         w = int(os.environ.get("PADDLE_TRAINERS_NUM") or 1)
         r = int(os.environ.get("PADDLE_TRAINER_ID") or 0)
@@ -137,7 +142,8 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
         path = os.path.join(path, str(unique_id))
     os.makedirs(path, exist_ok=True)
     flat = _flatten(state_dict)
-    rank, nprocs = _proc_info()
+    host_mode = any(isinstance(v, LocalShard) for v in flat.values())
+    rank, nprocs = _proc_info(host_mode)
     rank_dir = f"rank_{rank}"
     os.makedirs(os.path.join(path, rank_dir), exist_ok=True)
     # every rank removes ITS stale metadata first so the coordinator's wait

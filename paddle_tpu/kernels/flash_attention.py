@@ -51,9 +51,11 @@ def _tune_signature(q_bshd, k_bshd, causal):
 
 def tune_blocks(q_bshd, k_bshd, v_bshd, causal: bool = False, scale=None):
     """Autotune (block_q, block_k) for these CONCRETE [b,s,h,d] inputs and
-    cache the winner (kernels/autotune.py). Call sites inside a trace must
-    instead use cached_blocks(); dispatch layers call this before tracing
-    (nn/functional/attention.py) so training picks up tuned blocks."""
+    cache the winner under the 'flash_fwd' key (kernels/autotune.py).
+    Traced call sites need nothing special: flash_pallas._resolve_blocks
+    consults the cache at trace time, and its fallback chain gives the
+    backward the forward's winner unless a bwd-specific entry exists
+    (the hardware probe's flash_tune step records both)."""
     from . import autotune
     sq, sk, d = q_bshd.shape[1], k_bshd.shape[1], q_bshd.shape[3]
     sig = _tune_signature(q_bshd, k_bshd, causal)
@@ -62,14 +64,6 @@ def tune_blocks(q_bshd, k_bshd, v_bshd, causal: bool = False, scale=None):
         lambda c: flash_attention_bshd(q_bshd, k_bshd, v_bshd, causal=causal,
                                        scale=scale, block_q=c[0],
                                        block_k=c[1]))
-
-
-def cached_blocks(q_bshd, k_bshd, causal: bool):
-    from . import autotune
-    from .flash_pallas import DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q
-    hit = autotune.cached("flash_fwd", _tune_signature(q_bshd, k_bshd,
-                                                       causal))
-    return hit if hit is not None else (DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K)
 
 
 def flash_attention_bshd(q, k, v, causal: bool = False, scale=None,

@@ -507,11 +507,18 @@ def _resolve_blocks(which: str, q, k, causal, block_q, block_k):
         return block_q, block_k
     from . import autotune
     sig = (q.shape[2], k.shape[2], q.shape[3], str(q.dtype), bool(causal))
-    hit = autotune.cached(which, sig)
-    if hit is None and which.startswith("flashmask"):
-        # the probe tunes the dense-causal kernels; the flashmask variant
-        # shares their tile geometry, so inherit the winner
-        hit = autotune.cached("flash" + which[len("flashmask"):], sig)
+    # fallback chain: flashmask inherits the dense-causal winner (same
+    # tile geometry), and an untuned backward inherits the forward's
+    # blocks (runtime tune_blocks only times the forward) — 128x128 only
+    # when nothing was ever tuned
+    chain = {"flashmask_fwd": ("flashmask_fwd", "flash_fwd"),
+             "flashmask_bwd": ("flashmask_bwd", "flash_bwd", "flash_fwd"),
+             "flash_bwd": ("flash_bwd", "flash_fwd")}.get(which, (which,))
+    hit = None
+    for key in chain:
+        hit = autotune.cached(key, sig)
+        if hit is not None:
+            break
     if hit is not None:
         bq, bk = hit
     else:

@@ -8,4 +8,5 @@ jax Mesh, with GSPMD doing sharding propagation and collective insertion.
 from .trainer import SpmdTrainer, make_hybrid_mesh  # noqa: F401
 from .pipeline import PipelinedTrainer, pipeline_blocks  # noqa: F401
 from .ring_attention import ring_attention  # noqa: F401
+from .ulysses import ulysses_attention  # noqa: F401
 from .overlap import all_gather_matmul, matmul_reduce_scatter  # noqa: F401

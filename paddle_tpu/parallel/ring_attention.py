@@ -25,6 +25,17 @@ from .context import rotate_perm
 NEG_INF = -1e30
 
 
+def batch_axes_entry(batch_axes):
+    """PartitionSpec entry for a batch-axes argument: a single axis NAME
+    (string) stays one entry — iterating a string would silently split
+    'dp' into mesh axes 'd' and 'p'."""
+    if not batch_axes:
+        return None
+    if isinstance(batch_axes, str):
+        return batch_axes
+    return tuple(batch_axes) if len(batch_axes) > 1 else batch_axes[0]
+
+
 def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
                           scale: Optional[float]):
     """Per-device body (inside shard_map). q,k,v: [b, s_loc, h, d] local chunks.
@@ -82,9 +93,7 @@ def ring_attention(q, k, v, mesh, seq_axis: str, batch_axes=None,
     inside a jitted (GSPMD) program.
     """
     jax_mesh = mesh.to_jax() if hasattr(mesh, "to_jax") else mesh
-    batch_entry = None
-    if batch_axes:
-        batch_entry = tuple(batch_axes) if len(batch_axes) > 1 else batch_axes[0]
+    batch_entry = batch_axes_entry(batch_axes)
     # Keep Megatron-TP inside attention: heads stay sharded over mp (the
     # ColumnParallelLinear annotations put them there) when divisible.
     heads_entry = None

@@ -1,0 +1,96 @@
+"""Ulysses (DeepSpeed-style) all-to-all sequence parallelism.
+
+Reference parity: the long-context capability class the reference covers
+with SEP + Megatron-SP (SURVEY §2.3/§5) — this adds the all-to-all
+variant the graft brief names alongside ring attention. Where ring
+attention rotates K/V chunks P-1 hops around the ICI ring (bandwidth
+~S*D per hop, P hops), Ulysses does TWO all-to-alls: reshard
+[b, S/P, H, d] -> [b, S, H/P, d], run FULL attention per head subset
+(any kernel — the Pallas flash path included, since each device now
+sees the whole sequence), and reshard back. Better for moderate P with
+many heads (one collective round instead of P-1 hops, and the attention
+kernel sees contiguous sequences); ring wins when S/P is the only thing
+that fits. Both compose with the same `sep` mesh axis.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec
+
+
+def _ulysses_local(q, k, v, *, axis_name: str, causal: bool,
+                   scale: Optional[float], impl):
+    """Per-device body (inside shard_map). q,k,v: [b, s_loc, h, d]; the
+    head dim h is the GLOBAL head count (seq sharded). Requires
+    h % axis_size == 0."""
+    p = lax.axis_size(axis_name)
+    b, s_loc, h, d = q.shape
+    if h % p != 0:
+        raise ValueError(
+            f"ulysses_attention: head count {h} not divisible by "
+            f"sequence-parallel degree {p}")
+
+    def seq_to_heads(t):
+        # [b, s_loc, h, d] -> concat_s(split_h): [b, s_loc*p, h/p, d]
+        # all_to_all: split the head axis across devices, gather the
+        # sequence axis
+        return lax.all_to_all(t, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def heads_to_seq(t):
+        return lax.all_to_all(t, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qg = seq_to_heads(q)   # [b, S, h/p, d] — full sequence, head subset
+    kg = seq_to_heads(k)
+    vg = seq_to_heads(v)
+    out = impl(qg, kg, vg, causal, scale)
+    return heads_to_seq(out)  # back to [b, s_loc, h, d]
+
+
+def _dense_attention(q, k, v, causal, scale):
+    """[b, s, h, d] reference attention (fp32 softmax)."""
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    scores = jnp.einsum("bshd,bthd->bhst", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * s
+    if causal:
+        sq, sk = scores.shape[-2], scores.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), jnp.bool_), k=sk - sq)
+        scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhst,bthd->bshd", probs,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+def _flash_impl(q, k, v, causal, scale):
+    from ..kernels.flash_attention import flash_attention_bshd
+    return flash_attention_bshd(q, k, v, causal=causal, scale=scale)
+
+
+def ulysses_attention(q, k, v, mesh, seq_axis: str, batch_axes=None,
+                      causal: bool = True, scale: Optional[float] = None,
+                      use_flash: bool = False):
+    """Global-view entry: q,k,v [b, s, h, d] with s sharded over
+    `seq_axis`. Two all-to-alls around full per-head-subset attention;
+    callable inside a jitted (GSPMD) program. `use_flash` routes the
+    inner attention through the Pallas flash kernel (each device sees
+    the full sequence, so the kernel applies unchanged)."""
+    from .ring_attention import batch_axes_entry
+    jax_mesh = mesh.to_jax() if hasattr(mesh, "to_jax") else mesh
+    spec = PartitionSpec(batch_axes_entry(batch_axes), seq_axis, None,
+                         None)
+    impl = _flash_impl if use_flash else _dense_attention
+    fn = functools.partial(_ulysses_local, axis_name=seq_axis,
+                           causal=causal, scale=scale, impl=impl)
+    return jax.shard_map(fn, mesh=jax_mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)
+
+
+__all__ = ["ulysses_attention"]

@@ -131,3 +131,26 @@ def test_cached_memoizes_misses(tmp_path):
     finally:
         autotune.set_cache_path(None)
         autotune.clear()
+
+
+def test_flash_bwd_inherits_fwd_winner():
+    """Runtime tune_blocks records only flash_fwd; the resolver's
+    fallback chain must give the backward the same winner (round-5
+    review finding)."""
+    import jax.numpy as jnp
+    from paddle_tpu.kernels import autotune
+    from paddle_tpu.kernels.flash_pallas import _resolve_blocks
+    sig = (4096, 4096, 64, "bfloat16", True)
+    autotune.record("flash_fwd", sig, (512, 256))
+    try:
+        q = jnp.zeros((1, 2, 4096, 64), jnp.bfloat16)
+        assert _resolve_blocks("flash_bwd", q, q, True, None,
+                               None) == (512, 256)
+        assert _resolve_blocks("flashmask_bwd", q, q, True, None,
+                               None) == (512, 256)
+        # a bwd-specific entry (the hardware probe writes one) wins
+        autotune.record("flash_bwd", sig, (128, 512))
+        assert _resolve_blocks("flash_bwd", q, q, True, None,
+                               None) == (128, 512)
+    finally:
+        autotune.clear()

@@ -164,3 +164,20 @@ def test_local_shard_validation():
         LocalShard(np.zeros((2, 4), np.float32), (8, 4), (0,))
     with pytest.raises(ValueError, match="exceeds"):
         LocalShard(np.zeros((4, 4), np.float32), (8, 4), (6, 0))
+
+
+def test_plain_save_ignores_launcher_env(tmp_path, monkeypatch):
+    """A single-jax-process save of ordinary tensors under the launcher
+    env must stay a complete standalone world-1 checkpoint — no
+    cross-rank metadata barrier (round-5 review finding). Host-mode
+    collective naming applies only to LocalShard saves."""
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "4")
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "2")
+    save_state_dict({"w": Tensor(jnp.arange(6.0).reshape(2, 3))},
+                    str(tmp_path), barrier_timeout=5.0)
+    assert os.path.exists(tmp_path / "metadata.json")
+    assert os.path.exists(tmp_path / "rank_0")  # world-1 naming
+    tgt = {"w": Tensor(jnp.zeros((2, 3)))}
+    load_state_dict(tgt, str(tmp_path))
+    np.testing.assert_array_equal(np.asarray(tgt["w"]._data),
+                                  np.arange(6.0).reshape(2, 3))
