@@ -168,10 +168,22 @@ def _attempt_table():
     return table
 
 
+def _autotune_cache_path():
+    """The ONE location of the shared flash-block autotune cache: the
+    probe's flash_tune step writes winners there; every bench child
+    (parent ladder or mfu_lab) reads them via the inherited env var."""
+    import os
+    return os.environ.get("PADDLE_TPU_AUTOTUNE_CACHE") or os.path.join(
+        os.environ.get("BENCH_ARTIFACT_DIR") or os.path.dirname(
+            os.path.abspath(__file__)), "AUTOTUNE_CACHE.json")
+
+
 def _sub(argv, timeout, env_extra=None):
     """Run this file in a fresh subprocess, return (parsed-json-or-None, err)."""
     import os
     import subprocess
+    os.environ.setdefault("PADDLE_TPU_AUTOTUNE_CACHE",
+                          _autotune_cache_path())
     env = None
     if env_extra:
         env = dict(os.environ)
@@ -331,13 +343,9 @@ def _run_probe(extend=None):
         with the chained-dispatch timer, record winners in the shared
         autotune cache (disk) so the training attempts and library calls
         resolve them, and report the tuned-vs-default speedup."""
-        import os
         from paddle_tpu.kernels import autotune
         from paddle_tpu.kernels.flash_pallas import flash_attention
-        autotune.set_cache_path(
-            os.environ.get("PADDLE_TPU_AUTOTUNE_CACHE") or os.path.join(
-                os.environ.get("BENCH_ARTIFACT_DIR") or os.path.dirname(
-                    os.path.abspath(__file__)), "AUTOTUNE_CACHE.json"))
+        autotune.set_cache_path(_autotune_cache_path())
         out_t = {}
         # tune at the probe shape AND the training shape (b8 h12 s2048
         # d128 — the llama-0.5b bench config's attention geometry)
@@ -629,12 +637,6 @@ def _run_parent():
     import os
     here = os.environ.get("BENCH_ARTIFACT_DIR") or os.path.dirname(
         os.path.abspath(__file__))
-    # one shared autotune cache for the whole session: the probe's
-    # flash_tune step writes hardware-measured block-size winners there and
-    # every child (probe, attempts) inherits the env var, so the training
-    # step's flash calls resolve the tuned blocks (kernels/autotune.py)
-    os.environ.setdefault("PADDLE_TPU_AUTOTUNE_CACHE",
-                          os.path.join(here, "AUTOTUNE_CACHE.json"))
     if "--skip-probe" in sys.argv:
         # caller (e.g. tools/tpu_watch.sh) just proved the chip with its own
         # probe — don't burn the window on a duplicate init+compile pass.
