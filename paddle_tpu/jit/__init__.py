@@ -13,6 +13,7 @@ also one compiled program).
 from __future__ import annotations
 
 import functools
+import inspect
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -269,6 +270,7 @@ class StaticFunction:
     def __call__(self, *args, **kwargs):
         if self._jitted is None:
             self._build()
+        self._select_ast_variant()
         layer = self._layer
         raw_args = args
         raw_tensors: List[Tensor] = []
@@ -316,9 +318,38 @@ class StaticFunction:
         try:
             result = dispatch("to_static", fwd, *all_inputs)
         except _graph_break_errors() as e:
+            # before giving up fusion: try the dy2static AST pass — a
+            # tensor-condition if/while rewritten onto static.nn control
+            # flow often turns this graph break into a full compile
+            # (reference ifelse/loop transformers' role)
+            if self._try_ast_conversion():
+                try:
+                    result = dispatch("to_static", fwd, *all_inputs)
+                except Exception as e2:  # noqa: BLE001 — ANY retry
+                    # failure (trace break, converter-scope scoping
+                    # issue) reverts to the original function + the
+                    # partial/eager fallback, never a changed behavior
+                    self._function = self._ast_original
+                    self._ast_converted = False
+                    self._graph_break(fallback_key, e2)
+                    return self._call_fallback(raw_args, kwargs)
+                else:
+                    self.stats["ast_converted_calls"] = \
+                        self.stats.get("ast_converted_calls", 0) + 1
+                    self.stats["compiled_calls"] += 1
+                    return self._finish_call(result, static_key, n_buf,
+                                             orig_batch, raw_spec, layer)
             self._graph_break(fallback_key, e)
             return self._call_fallback(raw_args, kwargs)
         self.stats["compiled_calls"] += 1
+        return self._finish_call(result, static_key, n_buf, orig_batch,
+                                 raw_spec, layer)
+
+    def _finish_call(self, result, static_key, n_buf, orig_batch, raw_spec,
+                     layer):
+        """Post-compile bookkeeping shared by the direct and the
+        AST-converted retry paths: buffer write-back, output rebuild,
+        bucket un-padding."""
         if not isinstance(result, tuple):
             result = (result,)
         out_spec = self._spec_cell[static_key]
@@ -342,6 +373,56 @@ class StaticFunction:
         if padded:
             out = self._slice_outputs(out, orig_batch)
         return out
+
+    def _ast_allow_while(self) -> bool:
+        """while loops convert only when this call provably does NOT need
+        gradients: lax.while has no reverse-mode gradient, and the
+        partial fallback TRAINS correctly. Layers: eval mode only. Plain
+        functions (no mode signal): never — they keep the trainable
+        fallback and can use static.nn.while_loop explicitly."""
+        if self._layer is None:
+            return False
+        return not bool(self._layer.training)
+
+    def _ast_variant(self, allow_while: bool):
+        cache = getattr(self, "_ast_cache", None)
+        if cache is None:
+            cache = self._ast_cache = {}
+        if allow_while not in cache:
+            from .ast_transform import convert_control_flow
+            target = getattr(self, "_ast_original", self._function)
+            if not inspect.ismethod(target) and \
+                    not inspect.isfunction(target):
+                cache[allow_while] = None
+            else:
+                cache[allow_while] = convert_control_flow(
+                    target, allow_while=allow_while)
+        return cache[allow_while]
+
+    def _select_ast_variant(self):
+        """Install the converted function matching THIS call's mode (an
+        eval-converted while must not leak into a training trace — its
+        backward would fail; review finding). No-op until a conversion
+        has ever been attempted."""
+        if not hasattr(self, "_ast_original"):
+            return
+        variant = self._ast_variant(self._ast_allow_while())
+        self._function = variant if variant is not None \
+            else self._ast_original
+
+    def _try_ast_conversion(self) -> bool:
+        """dy2static AST pass over the wrapped function: rewrite
+        tensor-condition if/while onto static.nn control flow and swap
+        the converted function in. Cached per while-conversion mode.
+        False when the source is out of scope."""
+        converted = self._ast_variant(self._ast_allow_while())
+        if converted is None:
+            return False
+        if not hasattr(self, "_ast_original"):
+            self._ast_original = self._function
+        self._function = converted
+        self._ast_converted = True
+        return True
 
     def _warn_once(self, flag, msg):
         if not getattr(self, flag, False):

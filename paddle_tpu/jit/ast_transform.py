@@ -1,0 +1,323 @@
+"""AST control-flow conversion for dy2static (the reference's central
+dy2static mechanism, TPU-native).
+
+Parity targets: /root/reference/python/paddle/jit/dy2static/transformers/
+ifelse_transformer.py + loop_transformer.py (source-to-source rewrite of
+`if`/`while` into runtime-dispatched converter calls) and
+convert_operators.py:398 convert_ifelse / :167 convert_while_loop (pick
+the tensor or the Python path at RUNTIME, when the condition's type is
+known).
+
+TPU-native shape: the rewritten calls dispatch to `paddle.static.nn.cond`
+/ `while_loop`, which lower to lax.cond / lax.while_loop under a trace —
+so a model written with plain Python `if tensor:` / `while tensor:`
+compiles to ONE XLA program instead of graph-breaking. jit.to_static
+tries this conversion automatically when tracing hits data-dependent
+control flow (StaticFunction._graph_break), and falls back to
+partial-graph compilation when the source uses constructs outside this
+converter's scope.
+
+Deliberately-compact scope (bail -> None, caller keeps the original
+function): `if`/`elif`/`else` and `while` with assignments; no
+`break`/`continue`/`return` inside converted blocks, no `for` over
+tensors, no nested function/class definitions inside converted blocks,
+no closures over outer function locals. Everything else in the function
+body is left untouched.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+from typing import List, Optional, Set
+
+
+# ---------------------------------------------------------------------------
+# runtime converters (referenced by the generated code)
+# ---------------------------------------------------------------------------
+
+class _JstUndefined:
+    """Placeholder for a name defined only inside one branch (reference
+    UndefinedVar role): tracing a branch that actually uses it fails with
+    a clear message instead of a silent wrong value."""
+
+    _singleton = None
+
+    def __repr__(self):
+        return "<undefined before control flow>"
+
+
+_jst_undef = _JstUndefined()
+_JstUndefined._singleton = _jst_undef
+
+
+def _jst_if(cond, true_fn, false_fn, vals):
+    """convert_ifelse analog: tensor condition -> compiled static.nn.cond
+    (eager/traced/static modes all handled there); python condition ->
+    plain branch. `vals` carries the current values of every name either
+    branch rebinds (they become the branch functions' parameters —
+    read-then-assign would otherwise hit UnboundLocalError)."""
+    from ..tensor import Tensor
+    if isinstance(cond, Tensor):
+        from ..static.nn import cond as _cond
+        return _cond(cond, lambda: true_fn(*vals), lambda: false_fn(*vals))
+    return true_fn(*vals) if cond else false_fn(*vals)
+
+
+def _jst_while(cond_fn, body_fn, init):
+    """convert_while_loop analog: if the condition evaluates to a tensor
+    on the initial state, run the compiled static.nn.while_loop; else the
+    plain Python loop."""
+    from ..tensor import Tensor
+    probe = cond_fn(*init)
+    if isinstance(probe, Tensor):
+        from ..static.nn import while_loop as _while
+        out = _while(cond_fn, lambda *a: list(body_fn(*a)), list(init))
+        return tuple(out)
+    state = tuple(init)
+    while True:
+        c = cond_fn(*state)
+        if isinstance(c, Tensor):
+            # the state became tensor-valued mid-loop: hand the rest to
+            # the compiled path
+            from ..static.nn import while_loop as _while
+            return tuple(_while(cond_fn, lambda *a: list(body_fn(*a)),
+                                list(state)))
+        if not c:
+            return state
+        state = tuple(body_fn(*state))
+
+
+class _Unsupported(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# analysis helpers
+# ---------------------------------------------------------------------------
+
+def _assigned_names(nodes: List[ast.stmt]) -> Set[str]:
+    """Names bound by simple assignments/augassigns in a statement list
+    (recursing into nested if/while bodies). Tuple targets supported;
+    anything fancier (starred, attribute/subscript-only writes are fine —
+    they mutate, not rebind) is ignored."""
+    out: Set[str] = set()
+
+    class V(ast.NodeVisitor):
+        def visit_FunctionDef(self, node):
+            if node.name.startswith("__jst_"):
+                # a helper WE generated for an inner (already-converted)
+                # if/while: opaque implementation detail, NOT a carried
+                # variable (each enclosing branch body re-defines its own)
+                return
+            raise _Unsupported("nested def")
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+        visit_ClassDef = visit_FunctionDef
+
+        def visit_Lambda(self, node):
+            pass  # lambdas bind only their own params
+
+        def visit_Name(self, node):
+            if isinstance(node.ctx, ast.Store) and \
+                    not node.id.startswith("__jst_"):
+                out.add(node.id)
+
+        def visit_Return(self, node):
+            raise _Unsupported("return inside converted block")
+
+        def visit_Break(self, node):
+            raise _Unsupported("break inside converted block")
+
+        def visit_Continue(self, node):
+            raise _Unsupported("continue inside converted block")
+
+    v = V()
+    for n in nodes:
+        v.visit(n)
+    return out
+
+
+def _loaded_names(node) -> Set[str]:
+    return {n.id for n in ast.walk(node)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)}
+
+
+# ---------------------------------------------------------------------------
+# the transformer
+# ---------------------------------------------------------------------------
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self, allow_while=True):
+        self.counter = 0
+        self.changed = False
+        self.allow_while = allow_while
+
+    def _fresh(self, base):
+        self.counter += 1
+        return f"__jst_{base}_{self.counter}"
+
+    @staticmethod
+    def _seed_undefined(names):
+        """`try: n \n except NameError: n = _jst_undef` per name, so a
+        name bound only inside a branch/loop can still be PASSED into the
+        generated functions (reference create_undefined_var)."""
+        seeds = []
+        for n in names:
+            seeds.append(ast.Try(
+                body=[ast.Expr(value=ast.Name(id=n, ctx=ast.Load()))],
+                handlers=[ast.ExceptHandler(
+                    type=ast.Name(id="NameError", ctx=ast.Load()),
+                    name=None,
+                    body=[ast.Assign(
+                        targets=[ast.Name(id=n, ctx=ast.Store())],
+                        value=ast.Name(id="_jst_undef", ctx=ast.Load()))])],
+                orelse=[], finalbody=[]))
+        return seeds
+
+    # -- if/elif/else -------------------------------------------------------
+    def visit_If(self, node: ast.If):
+        self.generic_visit(node)  # innermost-first
+        names = sorted(_assigned_names(node.body)
+                       | _assigned_names(node.orelse))
+        tname, fname = self._fresh("true"), self._fresh("false")
+        params = ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=n) for n in names],
+            kwonlyargs=[], kw_defaults=[], defaults=[])
+        ret = ast.Return(value=ast.Tuple(
+            elts=[ast.Name(id=n, ctx=ast.Load()) for n in names],
+            ctx=ast.Load()))
+
+        def mk(fn_name, body):
+            return ast.FunctionDef(
+                name=fn_name, args=params,
+                body=(list(body) or [ast.Pass()]) + [ret],
+                decorator_list=[])
+
+        call = ast.Call(
+            func=ast.Name(id="_jst_if", ctx=ast.Load()),
+            args=[node.test,
+                  ast.Name(id=tname, ctx=ast.Load()),
+                  ast.Name(id=fname, ctx=ast.Load()),
+                  ast.Tuple(elts=[ast.Name(id=n, ctx=ast.Load())
+                                  for n in names], ctx=ast.Load())],
+            keywords=[])
+        target = ast.Tuple(
+            elts=[ast.Name(id=n, ctx=ast.Store()) for n in names],
+            ctx=ast.Store())
+        assign = ast.Assign(targets=[target], value=call) if names else \
+            ast.Expr(value=call)
+        self.changed = True
+        return (self._seed_undefined(names)
+                + [mk(tname, node.body), mk(fname, node.orelse), assign])
+
+    # -- while --------------------------------------------------------------
+    def visit_While(self, node: ast.While):
+        self.generic_visit(node)
+        if not self.allow_while:
+            # lax.while_loop is not reverse-differentiable: in TRAINING
+            # mode a converted while would break loss.backward() with an
+            # obscure transpose error, while the partial-compilation
+            # fallback trains correctly — so the caller disables while
+            # conversion for training-mode functions
+            raise _Unsupported("while in training mode (lax.while has no "
+                               "reverse-mode gradient)")
+        if node.orelse:
+            raise _Unsupported("while/else")
+        # carry every name the body rebinds (reads of never-rebound outer
+        # names stay plain closure reads)
+        carried = sorted(_assigned_names(node.body))
+        if not carried:
+            raise _Unsupported("while body binds nothing (no carry)")
+        cname, bname = self._fresh("cond"), self._fresh("body")
+        params = ast.arguments(
+            posonlyargs=[],
+            args=[ast.arg(arg=n) for n in carried],
+            kwonlyargs=[], kw_defaults=[], defaults=[])
+        cond_def = ast.FunctionDef(
+            name=cname, args=params,
+            body=[ast.Return(value=node.test)], decorator_list=[])
+        body_ret = ast.Return(value=ast.Tuple(
+            elts=[ast.Name(id=n, ctx=ast.Load()) for n in carried],
+            ctx=ast.Load()))
+        body_def = ast.FunctionDef(
+            name=bname, args=params,
+            body=list(node.body) + [body_ret], decorator_list=[])
+        call = ast.Call(
+            func=ast.Name(id="_jst_while", ctx=ast.Load()),
+            args=[ast.Name(id=cname, ctx=ast.Load()),
+                  ast.Name(id=bname, ctx=ast.Load()),
+                  ast.Tuple(elts=[ast.Name(id=n, ctx=ast.Load())
+                                  for n in carried], ctx=ast.Load())],
+            keywords=[])
+        assign = ast.Assign(
+            targets=[ast.Tuple(elts=[ast.Name(id=n, ctx=ast.Store())
+                                     for n in carried], ctx=ast.Store())],
+            value=call)
+        self.changed = True
+        return self._seed_undefined(carried) + [cond_def, body_def, assign]
+
+    def visit_For(self, node):
+        # Python for-loops over ranges/containers are fine under a trace
+        # (unrolled); tensor-dependent fors are out of scope. Leave as-is
+        # but still transform nested ifs/whiles inside.
+        self.generic_visit(node)
+        return node
+
+
+def convert_control_flow(fn, allow_while: bool = True) -> Optional[object]:
+    """Return a rewritten version of `fn` whose tensor-condition if/while
+    compile via static.nn control flow; None when the function is out of
+    this converter's scope (caller should keep the original).
+    `allow_while=False` bails on while loops (training mode: lax.while
+    has no reverse-mode gradient, so the trainable fallback is better)."""
+    bound_self = None
+    if inspect.ismethod(fn):
+        bound_self = fn.__self__
+        fn = fn.__func__
+    try:
+        if getattr(fn, "__closure__", None):
+            return None  # cannot rebuild closure cells through exec
+        if not hasattr(fn, "__globals__"):
+            return None  # builtin / C function: no source to rewrite
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError, IndentationError):
+        return None
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return None
+    if isinstance(fdef, ast.AsyncFunctionDef):
+        return None
+    fdef.decorator_list = []  # the decorator is the caller (to_static)
+
+    tr = _ControlFlowTransformer(allow_while=allow_while)
+    try:
+        new_tree = tr.visit(tree)
+    except _Unsupported:
+        return None
+    if not tr.changed:
+        return None
+    ast.fix_missing_locations(new_tree)
+    glb = dict(fn.__globals__)
+    glb["_jst_if"] = _jst_if
+    glb["_jst_while"] = _jst_while
+    glb["_jst_undef"] = _jst_undef
+    try:
+        code = compile(new_tree, filename=f"<dy2static {fn.__qualname__}>",
+                       mode="exec")
+        exec(code, glb)  # noqa: S102 — the function's own source, rewritten
+    except Exception:  # noqa: BLE001 — any compile issue: bail to fallback
+        return None
+    new_fn = glb.get(fdef.name)
+    if new_fn is None:
+        return None
+    new_fn = functools.wraps(fn)(new_fn)
+    new_fn.__jst_converted__ = True
+    if bound_self is not None:
+        return new_fn.__get__(bound_self)
+    return new_fn
+
+
+__all__ = ["convert_control_flow", "_jst_if", "_jst_while"]

@@ -1,0 +1,136 @@
+"""Model definitions for the AST dy2static tests — in their own module
+because inspect.getsource (the converter's input) needs real files."""
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+class IfElseNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.a = nn.Linear(4, 4)
+        self.b = nn.Linear(4, 2)
+
+    def forward(self, x):
+        h = self.a(x)
+        if (h.sum() > 0):
+            h = F.relu(h)
+        else:
+            h = -h
+        return self.b(h)
+
+
+class ElifChainNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.lin = nn.Linear(4, 4)
+
+    def forward(self, x):
+        h = self.lin(x)
+        s = h.sum()
+        if (s > 10.0):
+            out = h * 0.1
+        elif (s > 0.0):
+            out = h * 2.0
+        else:
+            out = h * -1.0
+        return out
+
+
+class BranchOnlyVarNet(nn.Layer):
+    """`scale` exists only inside the branches (reference UndefinedVar
+    case) — both branches bind it, so the converted cond is well-typed."""
+
+    def __init__(self):
+        super().__init__()
+        self.lin = nn.Linear(4, 4)
+
+    def forward(self, x):
+        h = self.lin(x)
+        if (h.mean() > 0):
+            scale = h.sum()
+        else:
+            scale = -h.sum()
+        return h * scale
+
+
+class NoElseNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.lin = nn.Linear(4, 4)
+
+    def forward(self, x):
+        h = self.lin(x)
+        if (h.sum() > 0):
+            h = h * 2.0
+        return h
+
+
+class WhileNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.lin = nn.Linear(4, 4)
+
+    def forward(self, x):
+        h = self.lin(x)
+        while (h * h).sum() > 100.0:
+            h = h * 0.5
+        return h
+
+
+class WhileMultiVarNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.lin = nn.Linear(4, 1)
+
+    def forward(self, x):
+        target = self.lin(x).sum()
+        i = paddle.zeros([], "float32")
+        acc = paddle.zeros([], "float32")
+        while i < 5.0:
+            acc = acc + i * 0.1 + target * 0.0
+            i = i + 1.0
+        return acc + target
+
+
+class PythonBoolNet(nn.Layer):
+    """Condition is a plain python bool — the converter's runtime
+    dispatch must take the Python branch (no tensor path)."""
+
+    def __init__(self, flag):
+        super().__init__()
+        self.flag = flag
+        self.lin = nn.Linear(4, 2)
+
+    def forward(self, x):
+        if self.flag:
+            x = x * 2.0
+        else:
+            x = x * 3.0
+        return self.lin(x)
+
+
+class BreakNet(nn.Layer):
+    """`break` is outside the converter's scope: conversion bails and the
+    function falls back to partial compilation, numerics unchanged."""
+
+    def __init__(self):
+        super().__init__()
+        self.lin = nn.Linear(4, 4)
+
+    def forward(self, x):
+        h = self.lin(x)
+        while (h * h).sum() > 10.0:
+            h = h * 0.5
+            if float(h.mean().numpy()) < -100.0:
+                break
+        return h
+
+
+def plain_while_fn(w, x):
+    """Module-level plain function (no Layer): tensor while must NOT be
+    converted (no mode signal => gradients may be needed)."""
+    h = x * w
+    while (h * h).sum() > 100.0:
+        h = h * 0.5
+    return h

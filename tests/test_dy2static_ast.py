@@ -1,0 +1,264 @@
+"""dy2static AST control-flow conversion: plain Python `if tensor:` /
+`while tensor:` in a model forward compiles to ONE program.
+
+Parity targets: /root/reference/python/paddle/jit/dy2static/transformers/
+ifelse_transformer.py, loop_transformer.py, convert_operators.py:398
+convert_ifelse / :167 convert_while_loop. Here the rewrite lands on
+static.nn.cond/while_loop (lax control flow) and runs automatically when
+jit.to_static hits a graph break (jit/__init__.py _try_ast_conversion).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from dy2static_ast_models import (BranchOnlyVarNet, BreakNet, ElifChainNet,
+                                  IfElseNet, NoElseNet, PythonBoolNet,
+                                  WhileMultiVarNet, WhileNet)
+
+
+def _x(shape=(3, 4), seed=0, scale=1.0):
+    rng = np.random.default_rng(seed)
+    return paddle.to_tensor(
+        (rng.standard_normal(shape) * scale).astype(np.float32))
+
+
+def _check_converted(cls, x, eager_fn, **kw):
+    net = cls(**kw)
+    st = paddle.jit.to_static(net)
+    y = st(x)
+    sf = net.forward
+    assert sf.stats.get("ast_converted_calls", 0) >= 1, sf.stats
+    assert sf.stats["partial_calls"] == 0 and sf.stats["eager_calls"] == 0
+    ref = cls(**kw)
+    ref.set_state_dict(net.state_dict())
+    np.testing.assert_allclose(y.numpy(), eager_fn(ref, x).numpy(),
+                               rtol=1e-5, atol=1e-6)
+    return net, st, sf
+
+
+def test_if_else_converts_to_one_program():
+    def eager(ref, x):
+        h = ref.a(x)
+        h = F.relu(h) if float(h.sum().numpy()) > 0 else -h
+        return ref.b(h)
+
+    net, st, sf = _check_converted(IfElseNet, _x(), eager)
+    # both sides of the branch execute correctly from the SAME program
+    y_neg = st(_x(seed=3, scale=-5.0) * 0 - 1.0)
+    ref = IfElseNet(); ref.set_state_dict(net.state_dict())
+    xn = _x(seed=3, scale=-5.0) * 0 - 1.0
+    np.testing.assert_allclose(y_neg.numpy(), eager(ref, xn).numpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_elif_chain():
+    def eager(ref, x):
+        h = ref.lin(x)
+        s = float(h.sum().numpy())
+        if s > 10.0:
+            return h * 0.1
+        if s > 0.0:
+            return h * 2.0
+        return h * -1.0
+
+    _check_converted(ElifChainNet, _x(), eager)
+
+
+def test_branch_only_variable():
+    def eager(ref, x):
+        h = ref.lin(x)
+        scale = h.sum() if float(h.mean().numpy()) > 0 else -h.sum()
+        return h * scale
+
+    _check_converted(BranchOnlyVarNet, _x(), eager)
+
+
+def test_if_without_else():
+    def eager(ref, x):
+        h = ref.lin(x)
+        if float(h.sum().numpy()) > 0:
+            h = h * 2.0
+        return h
+
+    _check_converted(NoElseNet, _x(), eager)
+
+
+def test_while_converts_to_one_program():
+    def eager(ref, x):
+        h = ref.lin(x)
+        while float((h * h).sum().numpy()) > 100.0:
+            h = h * 0.5
+        return h
+
+    net = WhileNet()
+    net.eval()  # while converts in eval mode only (no reverse-mode
+    # grad through lax.while; training uses the trainable fallback)
+    st = paddle.jit.to_static(net)
+    y = st(_x(scale=100.0))
+    sf = net.forward
+    assert sf.stats.get("ast_converted_calls", 0) >= 1, sf.stats
+    ref = WhileNet(); ref.set_state_dict(net.state_dict())
+    np.testing.assert_allclose(y.numpy(),
+                               eager(ref, _x(scale=100.0)).numpy(),
+                               rtol=1e-5, atol=1e-6)
+    # different trip count, same compiled program
+    y2 = st(_x(seed=9, scale=1000.0))
+    ref = WhileNet(); ref.set_state_dict(net.state_dict())
+    np.testing.assert_allclose(
+        y2.numpy(), eager(ref, _x(seed=9, scale=1000.0)).numpy(),
+        rtol=1e-5, atol=1e-6)
+    assert sf.stats["compiled_calls"] == 2
+
+
+def test_while_multi_carry():
+    def eager(ref, x):
+        t = float(ref.lin(x).sum().numpy())
+        acc, i = 0.0, 0.0
+        while i < 5.0:
+            acc += i * 0.1
+            i += 1.0
+        return paddle.to_tensor(np.float32(acc + t))
+
+    net = WhileMultiVarNet()
+    net.eval()
+    st = paddle.jit.to_static(net)
+    y = st(_x())
+    assert net.forward.stats.get("ast_converted_calls", 0) == 1
+    ref = WhileMultiVarNet(); ref.set_state_dict(net.state_dict())
+    np.testing.assert_allclose(y.numpy(), eager(ref, _x()).numpy(),
+                               rtol=1e-5)
+
+
+def test_python_bool_condition_stays_python():
+    for flag in (True, False):
+        net = PythonBoolNet(flag)
+        st = paddle.jit.to_static(net)
+        y = st(_x())
+        # a python-bool if traces fine directly: no graph break, no
+        # conversion needed
+        assert net.forward.stats["compiled_calls"] == 1
+        ref = PythonBoolNet(flag)
+        ref.set_state_dict(net.state_dict())
+        want = ref.lin(_x() * (2.0 if flag else 3.0))
+        np.testing.assert_allclose(y.numpy(), want.numpy(), rtol=1e-5)
+
+
+def test_unsupported_break_falls_back():
+    net = BreakNet()
+    st = paddle.jit.to_static(net)
+    x = _x(scale=10.0)
+    y = st(x)
+    sf = net.forward
+    # conversion bailed (break in loop); partial fallback ran instead
+    assert sf.stats.get("ast_converted_calls", 0) == 0
+    assert sf.stats["partial_calls"] >= 1
+    ref = BreakNet(); ref.set_state_dict(net.state_dict())
+    h = ref.lin(x)
+    while float((h * h).sum().numpy()) > 10.0:
+        h = h * 0.5
+    np.testing.assert_allclose(y.numpy(), h.numpy(), rtol=1e-5)
+
+
+def test_gradients_through_converted_control_flow():
+    """A training step through the AST-converted model: grads match the
+    eager tape's."""
+    net = IfElseNet()
+    st = paddle.jit.to_static(net)
+    x = _x()
+    loss = (st(x) ** 2).sum()
+    loss.backward()
+    g_st = {n: np.array(p.grad.numpy()) for n, p in
+            net.named_parameters() if p.grad is not None}
+    ref = IfElseNet(); ref.set_state_dict(net.state_dict())
+    h = ref.a(x)
+    h = F.relu(h) if float(h.sum().numpy()) > 0 else -h
+    (ref.b(h) ** 2).sum().backward()
+    for n, p in ref.named_parameters():
+        if p.grad is None:
+            continue
+        np.testing.assert_allclose(g_st[n], p.grad.numpy(), rtol=1e-4,
+                                    atol=1e-6, err_msg=n)
+
+
+def test_convert_control_flow_bails_cleanly():
+    from paddle_tpu.jit.ast_transform import convert_control_flow
+
+    # no control flow -> None (nothing to do)
+    def plain(x):
+        return x * 2
+    assert convert_control_flow(plain) is None
+
+    # closure -> None
+    k = 3
+
+    def closed(x):
+        if (x.sum() > 0):
+            x = x * k
+        return x
+    assert convert_control_flow(closed) is None
+
+    # builtin / no source -> None
+    assert convert_control_flow(len) is None
+
+
+def test_while_in_training_mode_keeps_trainable_fallback():
+    """lax.while has no reverse-mode gradient, so a training-mode model
+    with a Python while must NOT be converted — the partial fallback
+    runs and loss.backward() works."""
+    import paddle_tpu.optimizer as opt
+
+    net = WhileNet()  # training mode (default)
+    st = paddle.jit.to_static(net)
+    o = opt.SGD(learning_rate=0.01, parameters=net.parameters())
+    x = _x(scale=100.0)
+    loss = (st(x) ** 2).sum()
+    loss.backward()
+    o.step()
+    o.clear_grad()
+    sf = net.forward
+    assert sf.stats.get("ast_converted_calls", 0) == 0
+    assert sf.stats["partial_calls"] >= 1
+    assert all(np.isfinite(p.numpy()).all() for p in net.parameters())
+
+
+def test_eval_converted_while_does_not_leak_into_training():
+    """Round-5 review repro: eval-warmup then train. The training trace
+    must use the mode-matched (unconverted) function so backward works."""
+    import paddle_tpu.optimizer as opt
+
+    net = WhileNet()
+    net.eval()
+    st = paddle.jit.to_static(net)
+    x = _x(scale=100.0)
+    st(x)  # eval: converts the while to lax.while_loop
+    sf = net.forward
+    assert sf.stats.get("ast_converted_calls", 0) >= 1
+
+    net.train()
+    o = opt.SGD(learning_rate=0.01, parameters=net.parameters())
+    loss = (st(x) ** 2).sum()
+    loss.backward()  # would raise on lax.while; must use the fallback
+    o.step()
+    assert all(np.isfinite(p.numpy()).all() for p in net.parameters())
+
+
+def test_plain_function_while_keeps_trainable_fallback():
+    """Round-5 review repro: a plain function (no Layer) has no mode
+    signal, so its tensor while is never converted and backward works."""
+    from dy2static_ast_models import plain_while_fn
+
+    st = paddle.jit.to_static(plain_while_fn)
+    w = paddle.to_tensor(np.float32([2.0, 3.0, 4.0, 5.0]))
+    w.stop_gradient = False
+    x = _x((4,), scale=10.0)
+    y = st(w, x)
+    loss = (y ** 2).sum()
+    loss.backward()
+    assert w.grad is not None
+    assert np.isfinite(w.grad.numpy()).all()
+    # eager reference
+    h = x * w
+    while float((h * h).sum().numpy()) > 100.0:
+        h = h * 0.5
+    np.testing.assert_allclose(y.numpy(), h.numpy(), rtol=1e-5)
