@@ -151,7 +151,10 @@ class StaticFunction:
 
     @property
     def dygraph_function(self):
-        return self._function
+        # the USER's function — never a generated AST variant (export
+        # tracing and user inspection must see the original source's
+        # behavior; review finding)
+        return getattr(self, "_ast_original", self._function)
 
     def _build(self):
         layer = self._layer
@@ -327,10 +330,10 @@ class StaticFunction:
                     result = dispatch("to_static", fwd, *all_inputs)
                 except Exception as e2:  # noqa: BLE001 — ANY retry
                     # failure (trace break, converter-scope scoping
-                    # issue) reverts to the original function + the
-                    # partial/eager fallback, never a changed behavior
-                    self._function = self._ast_original
-                    self._ast_converted = False
+                    # issue): poison the variant so it is never
+                    # reinstalled, revert to the original function + the
+                    # partial/eager fallback — never a changed behavior
+                    self._poison_ast_variant()
                     self._graph_break(fallback_key, e2)
                     return self._call_fallback(raw_args, kwargs)
                 else:
@@ -341,6 +344,15 @@ class StaticFunction:
                                              orig_batch, raw_spec, layer)
             self._graph_break(fallback_key, e)
             return self._call_fallback(raw_args, kwargs)
+        except Exception as e:  # noqa: BLE001
+            if getattr(self, "_ast_converted", False):
+                # an installed AST variant failed on a NEW signature with
+                # a non-graph-break error: poison it and fall back (the
+                # original would have fallen back cleanly; review repro)
+                self._poison_ast_variant()
+                self._graph_break(fallback_key, e)
+                return self._call_fallback(raw_args, kwargs)
+            raise
         self.stats["compiled_calls"] += 1
         return self._finish_call(result, static_key, n_buf, orig_batch,
                                  raw_spec, layer)
@@ -399,6 +411,15 @@ class StaticFunction:
                     target, allow_while=allow_while)
         return cache[allow_while]
 
+    def _poison_ast_variant(self):
+        """A converted variant failed at trace/run time: negative-cache
+        it (never reinstall), restore the user's function."""
+        if hasattr(self, "_ast_cache"):
+            self._ast_cache[self._ast_allow_while()] = None
+        if hasattr(self, "_ast_original"):
+            self._function = self._ast_original
+        self._ast_converted = False
+
     def _select_ast_variant(self):
         """Install the converted function matching THIS call's mode (an
         eval-converted while must not leak into a training trace — its
@@ -409,6 +430,7 @@ class StaticFunction:
         variant = self._ast_variant(self._ast_allow_while())
         self._function = variant if variant is not None \
             else self._ast_original
+        self._ast_converted = variant is not None
 
     def _try_ast_conversion(self) -> bool:
         """dy2static AST pass over the wrapped function: rewrite
@@ -552,7 +574,12 @@ def _layer_trace_fn(layer):
     layer.eval()
     self_fn = layer.forward
     if isinstance(self_fn, StaticFunction):  # to_static-wrapped layer
-        self_fn = self_fn.dygraph_function  # already bound
+        # export runs in eval mode: prefer the eval AST variant (a
+        # tensor `while` only traces through its converted form), else
+        # the user's original function
+        variant = self_fn._ast_variant(True)
+        self_fn = variant if variant is not None \
+            else self_fn.dygraph_function  # already bound
 
     def pure(state_arrays, *in_arrays):
         st = dict(zip(names, state_arrays))

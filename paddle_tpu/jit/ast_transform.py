@@ -97,11 +97,14 @@ class _Unsupported(Exception):
 # analysis helpers
 # ---------------------------------------------------------------------------
 
-def _assigned_names(nodes: List[ast.stmt]) -> Set[str]:
+def _assigned_names(nodes: List[ast.stmt],
+                    allow_return: bool = False) -> Set[str]:
     """Names bound by simple assignments/augassigns in a statement list
     (recursing into nested if/while bodies). Tuple targets supported;
     anything fancier (starred, attribute/subscript-only writes are fine —
-    they mutate, not rebind) is ignored."""
+    they mutate, not rebind) is ignored. `allow_return` is used for
+    return-style branch conversion (the generated branch function's own
+    returns ARE its return values)."""
     out: Set[str] = set()
 
     class V(ast.NodeVisitor):
@@ -125,7 +128,8 @@ def _assigned_names(nodes: List[ast.stmt]) -> Set[str]:
                 out.add(node.id)
 
         def visit_Return(self, node):
-            raise _Unsupported("return inside converted block")
+            if not allow_return:
+                raise _Unsupported("return inside converted block")
 
         def visit_Break(self, node):
             raise _Unsupported("break inside converted block")
@@ -139,16 +143,41 @@ def _assigned_names(nodes: List[ast.stmt]) -> Set[str]:
     return out
 
 
-def _loaded_names(node) -> Set[str]:
-    return {n.id for n in ast.walk(node)
-            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)}
+def _suite_returns(stmts: List[ast.stmt]) -> bool:
+    """True when the suite definitely ends in a return on every path:
+    its last statement is a Return, or an If whose body AND (non-empty)
+    orelse both end in a return."""
+    if not stmts:
+        return False
+    last = stmts[-1]
+    if isinstance(last, ast.Return):
+        return True
+    if isinstance(last, ast.If):
+        return bool(last.orelse) and _suite_returns(last.body) \
+            and _suite_returns(last.orelse)
+    return False
+
+
 
 
 # ---------------------------------------------------------------------------
 # the transformer
 # ---------------------------------------------------------------------------
 
-class _ControlFlowTransformer(ast.NodeTransformer):
+class _ControlFlowTransformer:
+    """Suite-based source rewriter. Two if-conversion styles:
+
+    - assign-style (no returns in the branches): branches become
+      functions returning the rebound names, spliced back by tuple
+      assignment — control flow continues after the if.
+    - return-style (the guard-clause idiom `if c: return f(x)`): the
+      statements AFTER the if become the else-path, both paths end in a
+      return, and the whole tail collapses to `return _jst_if(...)`
+      (reference early_return_transformer + ifelse return handling).
+      Only valid where an inserted `return` means "return from the
+      function" — the function body and if-branches, never loop bodies.
+    """
+
     def __init__(self, allow_while=True):
         self.counter = 0
         self.changed = False
@@ -157,6 +186,79 @@ class _ControlFlowTransformer(ast.NodeTransformer):
     def _fresh(self, base):
         self.counter += 1
         return f"__jst_{base}_{self.counter}"
+
+    # -- suite driver -------------------------------------------------------
+    def transform_suite(self, stmts: List[ast.stmt],
+                        allow_return_style: bool) -> List[ast.stmt]:
+        out: List[ast.stmt] = []
+        for i, s in enumerate(stmts):
+            if isinstance(s, ast.If):
+                s.body = self.transform_suite(s.body, allow_return_style)
+                s.orelse = self.transform_suite(s.orelse,
+                                                allow_return_style)
+                if allow_return_style and (_suite_returns(s.body)
+                                           or _suite_returns(s.orelse)):
+                    rest = self.transform_suite(list(stmts[i + 1:]),
+                                                allow_return_style)
+                    out.extend(self._convert_return_if(s, rest))
+                    return out
+                out.extend(self._convert_assign_if(s))
+            elif isinstance(s, ast.While):
+                s.body = self.transform_suite(s.body, False)
+                out.extend(self._convert_while(s))
+            elif isinstance(s, ast.For):
+                # python iteration is unrolled by the trace; convert
+                # nested control flow inside the body (assign-style only:
+                # a generated `return` inside a loop body would exit the
+                # FUNCTION on every path, changing iteration semantics)
+                s.body = self.transform_suite(s.body, False)
+                s.orelse = self.transform_suite(s.orelse, False)
+                out.append(s)
+            elif isinstance(s, (ast.With, ast.Try)):
+                for attr in ("body", "orelse", "finalbody"):
+                    if hasattr(s, attr):
+                        setattr(s, attr, self.transform_suite(
+                            getattr(s, attr), False))
+                if isinstance(s, ast.Try):
+                    for h in s.handlers:
+                        h.body = self.transform_suite(h.body, False)
+                out.append(s)
+            else:
+                out.append(s)
+        return out
+
+    # -- return-style if (guard clauses) ------------------------------------
+    def _convert_return_if(self, node: ast.If,
+                           rest: List[ast.stmt]) -> List[ast.stmt]:
+        t_body = list(node.body)
+        f_body = list(node.orelse) + rest
+        if not _suite_returns(t_body):
+            t_body.append(ast.Return(value=ast.Constant(value=None)))
+        if not _suite_returns(f_body):
+            f_body.append(ast.Return(value=ast.Constant(value=None)))
+        names = sorted(_assigned_names(t_body, allow_return=True)
+                       | _assigned_names(f_body, allow_return=True))
+        tname, fname = self._fresh("rtrue"), self._fresh("rfalse")
+        params = ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=n) for n in names],
+            kwonlyargs=[], kw_defaults=[], defaults=[])
+
+        def mk(fn_name, body):
+            return ast.FunctionDef(name=fn_name, args=params,
+                                   body=body, decorator_list=[])
+
+        call = ast.Call(
+            func=ast.Name(id="_jst_if", ctx=ast.Load()),
+            args=[node.test,
+                  ast.Name(id=tname, ctx=ast.Load()),
+                  ast.Name(id=fname, ctx=ast.Load()),
+                  ast.Tuple(elts=[ast.Name(id=n, ctx=ast.Load())
+                                  for n in names], ctx=ast.Load())],
+            keywords=[])
+        self.changed = True
+        return (self._seed_undefined(names)
+                + [mk(tname, t_body), mk(fname, f_body),
+                   ast.Return(value=call)])
 
     @staticmethod
     def _seed_undefined(names):
@@ -176,9 +278,8 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                 orelse=[], finalbody=[]))
         return seeds
 
-    # -- if/elif/else -------------------------------------------------------
-    def visit_If(self, node: ast.If):
-        self.generic_visit(node)  # innermost-first
+    # -- assign-style if/elif/else ------------------------------------------
+    def _convert_assign_if(self, node: ast.If):
         names = sorted(_assigned_names(node.body)
                        | _assigned_names(node.orelse))
         tname, fname = self._fresh("true"), self._fresh("false")
@@ -213,8 +314,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                 + [mk(tname, node.body), mk(fname, node.orelse), assign])
 
     # -- while --------------------------------------------------------------
-    def visit_While(self, node: ast.While):
-        self.generic_visit(node)
+    def _convert_while(self, node: ast.While):
         if not self.allow_while:
             # lax.while_loop is not reverse-differentiable: in TRAINING
             # mode a converted while would break loss.backward() with an
@@ -258,12 +358,6 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         self.changed = True
         return self._seed_undefined(carried) + [cond_def, body_def, assign]
 
-    def visit_For(self, node):
-        # Python for-loops over ranges/containers are fine under a trace
-        # (unrolled); tensor-dependent fors are out of scope. Leave as-is
-        # but still transform nested ifs/whiles inside.
-        self.generic_visit(node)
-        return node
 
 
 def convert_control_flow(fn, allow_while: bool = True) -> Optional[object]:
@@ -294,24 +388,35 @@ def convert_control_flow(fn, allow_while: bool = True) -> Optional[object]:
 
     tr = _ControlFlowTransformer(allow_while=allow_while)
     try:
-        new_tree = tr.visit(tree)
+        fdef.body = tr.transform_suite(fdef.body, allow_return_style=True)
     except _Unsupported:
         return None
     if not tr.changed:
         return None
+    new_tree = tree
     ast.fix_missing_locations(new_tree)
-    glb = dict(fn.__globals__)
-    glb["_jst_if"] = _jst_if
-    glb["_jst_while"] = _jst_while
-    glb["_jst_undef"] = _jst_undef
+    # exec in a scratch namespace (must not rebind the user's module-level
+    # name), then rebuild the function over the ORIGINAL module globals so
+    # later global rebinds (config flags, monkeypatched helpers) are seen
+    # exactly as the unconverted path sees them. Only the three prefixed
+    # converter names are injected into the user's module.
+    import types
+    scratch = {"__builtins__": fn.__globals__.get("__builtins__",
+                                                  __builtins__)}
+    fn.__globals__["_jst_if"] = _jst_if
+    fn.__globals__["_jst_while"] = _jst_while
+    fn.__globals__["_jst_undef"] = _jst_undef
     try:
         code = compile(new_tree, filename=f"<dy2static {fn.__qualname__}>",
                        mode="exec")
-        exec(code, glb)  # noqa: S102 — the function's own source, rewritten
+        exec(code, scratch)  # noqa: S102 — the fn's own source, rewritten
+        raw = scratch.get(fdef.name)
+        if raw is None:
+            return None
+        new_fn = types.FunctionType(raw.__code__, fn.__globals__,
+                                    fn.__name__, raw.__defaults__,
+                                    raw.__closure__)
     except Exception:  # noqa: BLE001 — any compile issue: bail to fallback
-        return None
-    new_fn = glb.get(fdef.name)
-    if new_fn is None:
         return None
     new_fn = functools.wraps(fn)(new_fn)
     new_fn.__jst_converted__ = True

@@ -134,3 +134,87 @@ def plain_while_fn(w, x):
     while (h * h).sum() > 100.0:
         h = h * 0.5
     return h
+
+
+class GuardReturnNet(nn.Layer):
+    """The guard-clause idiom: `if cond: return ...` with code after —
+    return-style conversion (reference early_return_transformer)."""
+
+    def __init__(self):
+        super().__init__()
+        self.lin = nn.Linear(4, 4)
+
+    def forward(self, x):
+        h = self.lin(x)
+        if (h.sum() > 0):
+            return h * 2.0
+        h = F.relu(-h)
+        return h + 1.0
+
+
+class BothReturnNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.lin = nn.Linear(4, 4)
+
+    def forward(self, x):
+        h = self.lin(x)
+        if (h.mean() > 0):
+            return F.gelu(h)
+        else:
+            return F.relu(-h)
+
+
+class GuardThenAssignNet(nn.Layer):
+    """A guard return followed by an assign-style if in the tail."""
+
+    def __init__(self):
+        super().__init__()
+        self.lin = nn.Linear(4, 4)
+
+    def forward(self, x):
+        h = self.lin(x)
+        if (h.sum() > 100.0):
+            return h * 0.0
+        if (h.mean() > 0):
+            h = h * 2.0
+        else:
+            h = h * 3.0
+        return h - 1.0
+
+
+class StructMismatchNet(nn.Layer):
+    """One branch binds a name the other leaves undefined AND the
+    branches need it after — conversion traces fail; the fallback must
+    absorb it on EVERY call signature (round-5 review repro)."""
+
+    def __init__(self):
+        super().__init__()
+        self.lin = nn.Linear(4, 4)
+
+    def forward(self, x):
+        h = self.lin(x)
+        if (h.sum() > 0):
+            s = h.sum()
+            h = h * s
+        return h
+
+
+JST_GLOBAL_SCALE = 2.0
+
+
+class GlobalReadNet(nn.Layer):
+    """Reads a module global the test rebinds between calls: the
+    converted variant must see the LIVE global, like every other path."""
+
+    def __init__(self):
+        super().__init__()
+        self.lin = nn.Linear(4, 4)
+
+    def forward(self, x):
+        h = self.lin(x)
+        if (h.sum() > 0):
+            h = h * JST_GLOBAL_SCALE
+        else:
+            h = h / JST_GLOBAL_SCALE
+        return h
