@@ -103,8 +103,8 @@ def _emit_error(msg: str) -> None:
 # Attempt order: proven-fit FIRST (land *a* number), then the bigger configs
 # that produce the better headline. The parent reports the best (highest-MFU)
 # success and lists every attempt in extra.attempts.
-ATTEMPT_ORDER = ("llama-0.5b-b8", "llama-1.1b-b8", "llama-1.1b-b4",
-                 "llama-0.27b-b8", "llama-0.27b-b8-remat")
+ATTEMPT_ORDER = ("llama-0.5b-b8", "llama-1.1b-b8", "llama-1.1b-b8-acc2",
+                 "llama-1.1b-b4", "llama-0.27b-b8", "llama-0.27b-b8-remat")
 
 # extra rungs for tools/mfu_lab.py (not part of the driver ladder): remat
 # policy / batch / attention variants to locate the MFU sweet spot on
@@ -155,6 +155,10 @@ def _attempt_table():
     table = {
         "llama-0.5b-b8": (cfg_half(), 8, 2048, 10, 2, "dots", 256),
         "llama-1.1b-b8": (cfg_1b(), 8, 2048, 10, 2, "full", 256),
+        # same tokens, HALF the live activation memory: grad accumulation
+        # scans 2 micro-batches of 4 inside the one compiled step — the
+        # insurance rung if plain b8 still OOMs under full remat
+        "llama-1.1b-b8-acc2": (cfg_1b(), 8, 2048, 10, 2, "full", 256, 2),
         "llama-1.1b-b4": (cfg_1b(), 4, 2048, 10, 2, "full", 256),
         "llama-0.27b-b8": (cfg_small(), 8, 2048, 10, 2, False, None),
         "llama-0.27b-b8-remat": (cfg_small(), 8, 2048, 10, 2, "dots", 256),
@@ -714,10 +718,13 @@ def _run_parent():
     for tag in ATTEMPT_ORDER:
         if tag.startswith("llama-0.27b") and results:
             continue  # fallback rungs only needed when nothing else landed
-        if tag == "llama-1.1b-b4" and "llama-1.1b-b8" in {
-                r.get("extra", {}).get("config") for r in results}:
-            continue  # same model, half batch: can't beat b8's MFU — don't
-            # spend a scarce tunnel-up window on it
+        done_1b = {r.get("extra", {}).get("config") for r in results}
+        if tag == "llama-1.1b-b8-acc2" and "llama-1.1b-b8" in done_1b:
+            continue  # plain b8 fit: the memory-insurance rung is moot
+        if tag == "llama-1.1b-b4" and done_1b & {"llama-1.1b-b8",
+                                                 "llama-1.1b-b8-acc2"}:
+            continue  # same model at equal-or-more tokens already landed
+            # — don't spend a scarce tunnel-up window on it
         res, err = _sub(["--attempt", tag], timeout=2700,
                         env_extra=attempt_env)
         if res is not None and res.get("value", 0) > 0:
@@ -815,7 +822,9 @@ def main():
         attempts = [(attempt_tag, *table[attempt_tag])]
 
     last_err = None
-    for tag, cfg, batch, seq, steps, warmup, remat, loss_chunk in attempts:
+    for tag, cfg, batch, seq, steps, warmup, remat, loss_chunk, \
+            *extra_cfg in attempts:
+        acc = extra_cfg[0] if extra_cfg else 1
         try:
             deadline["t"] = time.monotonic() + 1500
             deadline["what"] = f"compile/measure {tag}"
@@ -832,7 +841,8 @@ def main():
             trainer = SpmdTrainer(
                 model, optimizer, loss_fn, mesh=None,
                 remat_layers=list(model.model.layers) if remat else None,
-                remat_policy=remat if isinstance(remat, str) else "dots")
+                remat_policy=remat if isinstance(remat, str) else "dots",
+                accumulate_steps=acc)
             rng = np.random.default_rng(0)
             ids = paddle.to_tensor(rng.integers(
                 0, cfg.vocab_size, (batch, seq)).astype(np.int32))

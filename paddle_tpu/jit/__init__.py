@@ -347,9 +347,15 @@ class StaticFunction:
         except Exception as e:  # noqa: BLE001
             if getattr(self, "_ast_converted", False):
                 # an installed AST variant failed on a NEW signature with
-                # a non-graph-break error: poison it and fall back (the
-                # original would have fallen back cleanly; review repro)
-                self._poison_ast_variant()
+                # a non-graph-break error: fall back for THIS signature
+                # only (fallback_keys is per-signature). The variant is
+                # NOT poisoned — it may be a genuine user error (bad
+                # input, assert) that would fail any path, and other
+                # signatures where the variant works keep their full
+                # compilation (review finding). Converter-attributed
+                # failures are poisoned at conversion time by the retry
+                # handler above.
+                self._function = self._ast_original
                 self._graph_break(fallback_key, e)
                 return self._call_fallback(raw_args, kwargs)
             raise
@@ -574,10 +580,15 @@ def _layer_trace_fn(layer):
     layer.eval()
     self_fn = layer.forward
     if isinstance(self_fn, StaticFunction):  # to_static-wrapped layer
-        # export runs in eval mode: prefer the eval AST variant (a
-        # tensor `while` only traces through its converted form), else
-        # the user's original function
-        variant = self_fn._ast_variant(True)
+        # export runs in eval mode. Use the eval AST variant ONLY when a
+        # graph break was actually observed in live use (a tensor `while`
+        # traces only through its converted form) — a cleanly-tracing
+        # original must export as-is so converter bugs can never widen
+        # into wrong artifacts (review finding).
+        variant = None
+        if self_fn._fallback_keys or getattr(self_fn, "_ast_converted",
+                                             False):
+            variant = self_fn._ast_variant(True)
         self_fn = variant if variant is not None \
             else self_fn.dygraph_function  # already bound
 

@@ -231,7 +231,14 @@ class _ControlFlowTransformer:
     def _convert_return_if(self, node: ast.If,
                            rest: List[ast.stmt]) -> List[ast.stmt]:
         t_body = list(node.body)
-        f_body = list(node.orelse) + rest
+        f_body = list(node.orelse)
+        # the tail statements continue on whichever path does NOT return
+        # (the fall-through path); when both return, the tail is dead
+        # code and stays on the else path harmlessly
+        if _suite_returns(t_body):
+            f_body = f_body + rest
+        else:
+            t_body = t_body + rest
         if not _suite_returns(t_body):
             t_body.append(ast.Return(value=ast.Constant(value=None)))
         if not _suite_returns(f_body):
@@ -395,17 +402,27 @@ def convert_control_flow(fn, allow_while: bool = True) -> Optional[object]:
         return None
     new_tree = tree
     ast.fix_missing_locations(new_tree)
-    # exec in a scratch namespace (must not rebind the user's module-level
-    # name), then rebuild the function over the ORIGINAL module globals so
-    # later global rebinds (config flags, monkeypatched helpers) are seen
-    # exactly as the unconverted path sees them. Only the three prefixed
-    # converter names are injected into the user's module.
+    # exec in a scratch namespace that READS through to the user's module
+    # globals (default-arg expressions may reference them) but never
+    # WRITES into it (the def must not rebind the user's module-level
+    # name), then rebuild the function over the ORIGINAL module globals
+    # so later global rebinds (config flags, monkeypatched helpers) are
+    # seen exactly as the unconverted path sees them. Only the three
+    # prefixed converter names are injected into the user's module.
     import types
-    scratch = {"__builtins__": fn.__globals__.get("__builtins__",
-                                                  __builtins__)}
+
+    class _ReadThrough(dict):
+        def __init__(self, base):
+            super().__init__()
+            self._base = base
+
+        def __missing__(self, k):
+            return self._base[k]
+
     fn.__globals__["_jst_if"] = _jst_if
     fn.__globals__["_jst_while"] = _jst_while
     fn.__globals__["_jst_undef"] = _jst_undef
+    scratch = _ReadThrough(fn.__globals__)
     try:
         code = compile(new_tree, filename=f"<dy2static {fn.__qualname__}>",
                        mode="exec")
@@ -416,6 +433,7 @@ def convert_control_flow(fn, allow_while: bool = True) -> Optional[object]:
         new_fn = types.FunctionType(raw.__code__, fn.__globals__,
                                     fn.__name__, raw.__defaults__,
                                     raw.__closure__)
+        new_fn.__kwdefaults__ = raw.__kwdefaults__
     except Exception:  # noqa: BLE001 — any compile issue: bail to fallback
         return None
     new_fn = functools.wraps(fn)(new_fn)

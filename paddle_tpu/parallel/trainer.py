@@ -169,11 +169,20 @@ class SpmdTrainer:
                  donate: bool = True, batch_axes=("dp", "sharding"),
                  seq_axis: Optional[str] = None,
                  zero_stage: Optional[int] = None,
-                 remat_policy: str = "full"):
+                 remat_policy: str = "full",
+                 accumulate_steps: int = 1):
         self.model = model
         self.opt = optimizer
         self.loss_fn = loss_fn
         self.mesh = mesh
+        # gradient accumulation (reference gradient_merge / non-pipeline
+        # accumulate_steps): the batch splits into k micro-batches scanned
+        # INSIDE the compiled step — one micro-batch of activations live
+        # at a time (k-fold activation-memory saving at equal tokens),
+        # f32 grad accumulation, one optimizer update
+        self.accumulate_steps = int(accumulate_steps)
+        if self.accumulate_steps < 1:
+            raise ValueError("accumulate_steps must be >= 1")
         if zero_stage is None:  # group_sharded_parallel() tags take effect
             zero_stage = getattr(optimizer, "_group_sharded_stage",
                                  getattr(model, "_group_sharded_stage", 1))
@@ -410,26 +419,63 @@ class SpmdTrainer:
             out_specs=(pspecs, sspecs),
             check_vma=False)(params, grads, opt_state, lr, step_i)
 
-    def _build(self, batch_arrays):
-        def step_fn(params, opt_state, lr, step_i, key, *batch):
-            def pure_loss(params_):
-                if self.zero_stage >= 3 and self._jax_mesh is not None:
-                    # FSDP compute contract: gather the 'sharding'-dim-
-                    # stored params to their TP compute layout BEFORE the
-                    # dots (one all-gather per param per step), instead of
-                    # letting GSPMD reshard the activations to match a
-                    # contraction-dim-sharded weight (the involuntary-remat
-                    # tax). The constraint's VJP pins each gradient to the
-                    # same full layout, and the shard_map update boundary
-                    # then slices it back to the ZeRO shard — reduce-
-                    # scatter + local update, group_sharded_stage3
-                    # semantics.
-                    params_ = {n: jax.lax.with_sharding_constraint(
-                        a, self._sharding(self._tp_spec(self._params[n])))
-                        for n, a in params_.items()}
-                return self._pure_loss(params_, batch, key)
+    def _check_accumulate_batch(self, batch_arrays):
+        k = self.accumulate_steps
+        if k > 1:
+            for b in batch_arrays:
+                if b.ndim < 1 or b.shape[0] % k != 0:
+                    raise ValueError(
+                        f"accumulate_steps={k} must divide the batch dim "
+                        f"of every input (got shape {tuple(b.shape)})")
 
-            loss, grads = jax.value_and_grad(pure_loss)(params)
+    def _build(self, batch_arrays):
+        k = self.accumulate_steps
+
+        def step_fn(params, opt_state, lr, step_i, key, *batch):
+            def grads_of(mb, kk):
+                def pure_loss(params_):
+                    if self.zero_stage >= 3 and self._jax_mesh is not None:
+                        # FSDP compute contract: gather the 'sharding'-
+                        # dim-stored params to their TP compute layout
+                        # BEFORE the dots (one all-gather per param per
+                        # step), instead of letting GSPMD reshard the
+                        # activations to match a contraction-dim-sharded
+                        # weight (the involuntary-remat tax). The
+                        # constraint's VJP pins each gradient to the same
+                        # full layout, and the shard_map update boundary
+                        # then slices it back to the ZeRO shard — reduce-
+                        # scatter + local update, group_sharded_stage3
+                        # semantics.
+                        params_ = {n: jax.lax.with_sharding_constraint(
+                            a, self._sharding(
+                                self._tp_spec(self._params[n])))
+                            for n, a in params_.items()}
+                    return self._pure_loss(params_, mb, kk)
+
+                return jax.value_and_grad(pure_loss)(params)
+
+            if k == 1:
+                loss, grads = grads_of(batch, key)
+            else:
+                micro = tuple(b.reshape((k, b.shape[0] // k)
+                                        + b.shape[1:]) for b in batch)
+                keys = jax.random.split(key, k)
+                g_init = {n: jnp.zeros(params[n].shape, jnp.float32)
+                          for n in params}
+
+                def body(carry, xs):
+                    mbs, kk = xs
+                    l, g = grads_of(tuple(mbs), kk)
+                    lc, gc = carry
+                    gc = {n: gc[n] + g[n].astype(jnp.float32)
+                          for n in gc}
+                    return (lc + l.astype(jnp.float32), gc), None
+
+                (loss_s, grad_s), _ = jax.lax.scan(
+                    body, (jnp.float32(0.0), g_init), (micro, keys))
+                loss = loss_s / k
+                grads = {n: (grad_s[n] / k).astype(params[n].dtype)
+                         for n in grad_s}
             if 1 <= self.zero_stage <= 2 and self._jax_mesh is not None:
                 # Pin each gradient to its NATURAL layout (TP annotation
                 # only) first: user annotations are fixed points for GSPMD
@@ -493,6 +539,10 @@ class SpmdTrainer:
         """One compiled fwd+bwd+update step. batch: Tensors or arrays."""
         batch_arrays = tuple(b._data if isinstance(b, Tensor) else jnp.asarray(b)
                              for b in batch)
+        # validated per call: jit retraces on new shapes, and a
+        # non-divisible batch must fail with THIS message, not a reshape
+        # error deep inside the trace
+        self._check_accumulate_batch(batch_arrays)
         if self._opt_state is None:
             self._place_params()
             self._opt_state = self._init_opt_state()

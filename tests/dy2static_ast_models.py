@@ -218,3 +218,40 @@ class GlobalReadNet(nn.Layer):
         else:
             h = h / JST_GLOBAL_SCALE
         return h
+
+
+class ElseReturnNet(nn.Layer):
+    """Fall-through on the TRUE path: else returns, body continues into
+    the tail (round-5 review repro — the tail must follow the body)."""
+
+    def __init__(self):
+        super().__init__()
+        self.lin = nn.Linear(4, 4)
+
+    def forward(self, x):
+        h = self.lin(x)
+        if (h.sum() > 0):
+            h = h * 2.0
+        else:
+            return h - 1.0
+        return h + 10.0
+
+
+JST_DEFAULT_BASE = 4.0
+
+
+class KwDefaultNet(nn.Layer):
+    """Keyword-only default + a default-arg expression reading a module
+    global: both must survive conversion (round-5 review repros)."""
+
+    def __init__(self):
+        super().__init__()
+        self.lin = nn.Linear(4, 4)
+
+    def forward(self, x, base=JST_DEFAULT_BASE, *, scale=3.0):
+        h = self.lin(x)
+        if (h.sum() > 0):
+            h = h * scale
+        else:
+            h = h + base
+        return h

@@ -398,3 +398,91 @@ def test_jit_save_of_converted_while_model(tmp_path):
     net.eval()
     np.testing.assert_allclose(loaded(x).numpy(), st(x).numpy(),
                                rtol=1e-5)
+
+
+def test_else_returns_body_falls_through():
+    """Round-5 review repro: when only the ELSE returns, the tail must
+    continue on the body path (not be dropped as return None)."""
+    from dy2static_ast_models import ElseReturnNet
+
+    def eager(ref, x):
+        h = ref.lin(x)
+        if float(h.sum().numpy()) > 0:
+            return h * 2.0 + 10.0
+        return h - 1.0
+
+    for seed, scale in ((0, 1.0), (5, -3.0)):
+        net, st, sf = _check_converted(ElseReturnNet,
+                                       _x(seed=seed, scale=scale), eager)
+        assert st(_x(seed=seed, scale=scale)) is not None
+
+
+def test_kw_defaults_and_global_default_survive_conversion():
+    """Round-5 review repros: keyword-only defaults and module-global
+    default expressions must work on the converted variant."""
+    from dy2static_ast_models import KwDefaultNet
+
+    net = KwDefaultNet()
+    st = paddle.jit.to_static(net)
+    x = _x()
+    y = st(x)  # no kwargs passed: defaults must apply
+    sf = net.forward
+    assert sf.stats.get("ast_converted_calls", 0) >= 1, sf.stats
+    ref = KwDefaultNet(); ref.set_state_dict(net.state_dict())
+    h = ref.lin(x)
+    want = h * 3.0 if float(h.sum().numpy()) > 0 else h + 4.0
+    np.testing.assert_allclose(y.numpy(), want.numpy(), rtol=1e-5)
+
+
+def test_working_variant_not_poisoned_by_user_error():
+    """A genuine user error while the variant is installed must not
+    permanently degrade other signatures to partial compilation."""
+    from dy2static_ast_models import IfElseNet
+
+    net = IfElseNet()
+    st = paddle.jit.to_static(net)
+    x = _x()
+    st(x)
+    sf = net.forward
+    assert sf.stats.get("ast_converted_calls", 0) >= 1
+    compiled_before = sf.stats["compiled_calls"]
+    # a bad input (wrong rank) fails on any path — per-signature fallback
+    try:
+        st(paddle.to_tensor(np.float32([1.0])))
+    except Exception:
+        pass
+    # the good signature still runs fully compiled
+    st(x)
+    assert sf.stats["compiled_calls"] == compiled_before + 1
+
+
+def test_export_uses_original_when_it_traces():
+    """A cleanly-tracing model must export through the user's original
+    function (converter bugs must never widen into artifacts)."""
+    from dy2static_ast_models import PythonBoolNet
+    import paddle_tpu
+
+    net = PythonBoolNet(True)
+    st = paddle.jit.to_static(net)
+    st(_x())  # traces cleanly: no graph break, no conversion
+    assert not net.forward._fallback_keys
+    assert not getattr(net.forward, "_ast_converted", False)
+
+
+def test_accumulate_divisor_checked_per_call():
+    import paddle_tpu as pt
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.parallel import SpmdTrainer
+
+    cfg = LlamaConfig.tiny(vocab_size=64, hidden_size=32, layers=1,
+                           heads=4, kv_heads=2, seq=16)
+    m = LlamaForCausalLM(cfg)
+    o = opt.SGD(learning_rate=0.1, parameters=m.parameters())
+    t = SpmdTrainer(m, o, lambda mm, i, l: mm.forward_loss(i, l),
+                    accumulate_steps=2)
+    ids4 = pt.to_tensor(np.zeros((4, 16), np.int32))
+    t.train_step(ids4, ids4)  # builds fine
+    ids5 = pt.to_tensor(np.zeros((5, 16), np.int32))
+    with pytest.raises(ValueError, match="divide the batch"):
+        t.train_step(ids5, ids5)  # later call must still be validated

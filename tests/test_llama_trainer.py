@@ -196,3 +196,63 @@ def test_remat_policy_dots_matches_full():
         m3, o3 = make()
         SpmdTrainer(m3, o3, loss_fn, mesh=None,
                     remat_layers=list(m3.model.layers), remat_policy="bogus")
+
+
+def test_gradient_accumulation_matches_full_batch():
+    """accumulate_steps=k (scan over micro-batches inside the compiled
+    step) must produce the same update as the full-batch step — the
+    reference gradient_merge contract."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.parallel import SpmdTrainer
+
+    def build():
+        paddle.seed(0)
+        cfg = LlamaConfig.tiny(vocab_size=64, hidden_size=32, layers=2,
+                               heads=4, kv_heads=2, seq=16)
+        m = LlamaForCausalLM(cfg)
+        o = opt.SGD(learning_rate=0.1, parameters=m.parameters())
+        return m, o
+
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(rng.integers(0, 64, (4, 16)).astype(np.int32))
+
+    def loss_fn(m, i, l):
+        return m.forward_loss(i, l)
+
+    m1, o1 = build()
+    t1 = SpmdTrainer(m1, o1, loss_fn)
+    l1 = float(t1.train_step(ids, ids).numpy())
+
+    m2, o2 = build()
+    t2 = SpmdTrainer(m2, o2, loss_fn, accumulate_steps=2)
+    l2 = float(t2.train_step(ids, ids).numpy())
+
+    # same loss (mean over the same tokens) and same updated params
+    np.testing.assert_allclose(l2, l1, rtol=1e-5)
+    p1 = dict(m1.named_parameters())
+    for n, p in m2.named_parameters():
+        np.testing.assert_allclose(np.asarray(p.numpy(), np.float32),
+                                   np.asarray(p1[n].numpy(), np.float32),
+                                   rtol=2e-4, atol=2e-5, err_msg=n)
+
+
+def test_gradient_accumulation_bad_divisor_rejected():
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.parallel import SpmdTrainer
+    import pytest as _pt
+
+    cfg = LlamaConfig.tiny(vocab_size=64, hidden_size=32, layers=1,
+                           heads=4, kv_heads=2, seq=16)
+    m = LlamaForCausalLM(cfg)
+    o = opt.SGD(learning_rate=0.1, parameters=m.parameters())
+    t = SpmdTrainer(m, o, lambda mm, i, l: mm.forward_loss(i, l),
+                    accumulate_steps=3)
+    ids = paddle.to_tensor(np.zeros((4, 16), np.int32))
+    with _pt.raises(ValueError, match="divide the batch"):
+        t.train_step(ids, ids)
