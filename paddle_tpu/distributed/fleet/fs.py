@@ -1,0 +1,290 @@
+"""Filesystem abstraction for checkpoint/data storage.
+
+Reference parity: distributed/fleet/utils/fs.py (FS base :72, LocalFS
+:134, HDFSClient — the storage layer distributed checkpointing and
+dataset pipelines read/write through). LocalFS is a complete native
+implementation; HDFSClient shells to the `hadoop fs` CLI exactly like
+the reference (command construction is fully testable with a stub
+executable; on hosts without hadoop every call raises a clear error).
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from typing import List, Optional, Tuple
+
+__all__ = ["FS", "LocalFS", "HDFSClient", "ExecuteError",
+           "FSFileExistsError", "FSFileNotExistsError", "FSTimeOut",
+           "FSShellCmdAborted"]
+
+
+class ExecuteError(Exception):
+    pass
+
+
+class FSFileExistsError(Exception):
+    pass
+
+
+class FSFileNotExistsError(Exception):
+    pass
+
+
+class FSTimeOut(Exception):
+    pass
+
+
+class FSShellCmdAborted(ExecuteError):
+    pass
+
+
+class FS:
+    """Abstract storage interface (reference fs.py:72)."""
+
+    def ls_dir(self, fs_path):
+        raise NotImplementedError
+
+    def is_file(self, fs_path):
+        raise NotImplementedError
+
+    def is_dir(self, fs_path):
+        raise NotImplementedError
+
+    def is_exist(self, fs_path):
+        raise NotImplementedError
+
+    def upload(self, local_path, fs_path):
+        raise NotImplementedError
+
+    def download(self, fs_path, local_path):
+        raise NotImplementedError
+
+    def mkdirs(self, fs_path):
+        raise NotImplementedError
+
+    def delete(self, fs_path):
+        raise NotImplementedError
+
+    def need_upload_download(self):
+        raise NotImplementedError
+
+    def rename(self, fs_src_path, fs_dst_path):
+        raise NotImplementedError
+
+    def mv(self, fs_src_path, fs_dst_path, overwrite=False,
+           test_exists=True):
+        raise NotImplementedError
+
+    def upload_dir(self, local_dir, dest_dir):
+        raise NotImplementedError
+
+    def list_dirs(self, fs_path):
+        raise NotImplementedError
+
+    def touch(self, fs_path, exist_ok=True):
+        raise NotImplementedError
+
+    def cat(self, fs_path=None):
+        raise NotImplementedError
+
+
+class LocalFS(FS):
+    """Local filesystem (reference fs.py:134)."""
+
+    def ls_dir(self, fs_path) -> Tuple[List[str], List[str]]:
+        """(dirs, files) directly under fs_path."""
+        if not self.is_exist(fs_path):
+            return [], []
+        dirs, files = [], []
+        for name in sorted(os.listdir(fs_path)):
+            if os.path.isdir(os.path.join(fs_path, name)):
+                dirs.append(name)
+            else:
+                files.append(name)
+        return dirs, files
+
+    def is_file(self, fs_path) -> bool:
+        return os.path.isfile(fs_path)
+
+    def is_dir(self, fs_path) -> bool:
+        return os.path.isdir(fs_path)
+
+    def is_exist(self, fs_path) -> bool:
+        return os.path.exists(fs_path)
+
+    def mkdirs(self, fs_path):
+        os.makedirs(fs_path, exist_ok=True)
+
+    def rename(self, fs_src_path, fs_dst_path):
+        os.rename(fs_src_path, fs_dst_path)
+
+    def delete(self, fs_path):
+        if not self.is_exist(fs_path):
+            return
+        if os.path.isdir(fs_path):
+            shutil.rmtree(fs_path)
+        else:
+            os.remove(fs_path)
+
+    def need_upload_download(self) -> bool:
+        return False
+
+    def touch(self, fs_path, exist_ok=True):
+        if self.is_exist(fs_path):
+            if exist_ok:
+                return
+            raise FSFileExistsError(fs_path)
+        with open(fs_path, "a"):
+            pass
+
+    def mv(self, fs_src_path, fs_dst_path, overwrite=False,
+           test_exists=True):
+        if test_exists:
+            if not self.is_exist(fs_src_path):
+                raise FSFileNotExistsError(fs_src_path)
+            if self.is_exist(fs_dst_path):
+                if not overwrite:
+                    raise FSFileExistsError(fs_dst_path)
+                self.delete(fs_dst_path)
+        os.rename(fs_src_path, fs_dst_path)
+
+    def list_dirs(self, fs_path) -> List[str]:
+        if not self.is_exist(fs_path):
+            return []
+        return sorted(n for n in os.listdir(fs_path)
+                      if os.path.isdir(os.path.join(fs_path, n)))
+
+    def cat(self, fs_path=None) -> str:
+        with open(fs_path, "r") as f:
+            return f.read()
+
+    # local "upload"/"download" are copies (parity: reference LocalFS)
+    def upload(self, local_path, fs_path):
+        if os.path.isdir(local_path):
+            shutil.copytree(local_path, fs_path)
+        else:
+            shutil.copy2(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        self.upload(fs_path, local_path)
+
+    def upload_dir(self, local_dir, dest_dir):
+        shutil.copytree(local_dir, dest_dir, dirs_exist_ok=True)
+
+
+class HDFSClient(FS):
+    """HDFS through the `hadoop fs` CLI (reference fs.py HDFSClient —
+    same transport). `hadoop_bin` overrides the executable (tests use a
+    stub); configs dict becomes -D options like the reference."""
+
+    def __init__(self, hadoop_home: Optional[str] = None,
+                 configs: Optional[dict] = None, time_out: int = 300,
+                 sleep_inter: int = 1000, hadoop_bin: Optional[str] = None):
+        self._hadoop = hadoop_bin or (
+            os.path.join(hadoop_home, "bin", "hadoop") if hadoop_home
+            else "hadoop")
+        self._dopts = []
+        for k, v in (configs or {}).items():
+            self._dopts += ["-D", f"{k}={v}"]
+        self._timeout = time_out
+
+    def _run(self, *args) -> str:
+        cmd = [self._hadoop, "fs", *self._dopts, *args]
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=self._timeout)
+        except FileNotFoundError:
+            raise ExecuteError(
+                f"hadoop executable not found ({self._hadoop!r}); "
+                "HDFSClient needs a hadoop installation (pass "
+                "hadoop_home= or hadoop_bin=)")
+        except subprocess.TimeoutExpired:
+            raise FSTimeOut(f"{' '.join(cmd)} timed out after "
+                            f"{self._timeout}s")
+        if proc.returncode != 0:
+            raise ExecuteError(
+                f"{' '.join(cmd)} failed (rc={proc.returncode}): "
+                f"{proc.stderr[-500:]}")
+        return proc.stdout
+
+    def ls_dir(self, fs_path) -> Tuple[List[str], List[str]]:
+        if not self.is_exist(fs_path):
+            return [], []
+        dirs, files = [], []
+        for line in self._run("-ls", fs_path).splitlines():
+            # 8 columns; the path column may contain spaces, so bound the
+            # split and keep column 8 whole
+            parts = line.split(None, 7)
+            if len(parts) < 8:
+                continue
+            name = os.path.basename(parts[7])
+            (dirs if parts[0].startswith("d") else files).append(name)
+        return dirs, files
+
+    def is_dir(self, fs_path) -> bool:
+        try:
+            self._run("-test", "-d", fs_path)
+            return True
+        except ExecuteError:
+            return False
+
+    def is_file(self, fs_path) -> bool:
+        try:
+            self._run("-test", "-f", fs_path)  # one CLI round trip
+            return True
+        except ExecuteError:
+            return False
+
+    def is_exist(self, fs_path) -> bool:
+        try:
+            self._run("-test", "-e", fs_path)
+            return True
+        except ExecuteError:
+            return False
+
+    def upload(self, local_path, fs_path):
+        self._run("-put", local_path, fs_path)
+
+    def upload_dir(self, local_dir, dest_dir):
+        self._run("-put", local_dir, dest_dir)
+
+    def download(self, fs_path, local_path):
+        self._run("-get", fs_path, local_path)
+
+    def mkdirs(self, fs_path):
+        self._run("-mkdir", "-p", fs_path)
+
+    def delete(self, fs_path):
+        if self.is_exist(fs_path):
+            self._run("-rm", "-r", "-skipTrash", fs_path)
+
+    def need_upload_download(self) -> bool:
+        return True
+
+    def rename(self, fs_src_path, fs_dst_path):
+        self._run("-mv", fs_src_path, fs_dst_path)
+
+    def mv(self, fs_src_path, fs_dst_path, overwrite=False,
+           test_exists=True):
+        if test_exists:
+            if not self.is_exist(fs_src_path):
+                raise FSFileNotExistsError(fs_src_path)
+            if self.is_exist(fs_dst_path):
+                if not overwrite:
+                    raise FSFileExistsError(fs_dst_path)
+                self.delete(fs_dst_path)
+        self._run("-mv", fs_src_path, fs_dst_path)
+
+    def list_dirs(self, fs_path) -> List[str]:
+        return self.ls_dir(fs_path)[0]
+
+    def touch(self, fs_path, exist_ok=True):
+        if self.is_exist(fs_path):
+            if exist_ok:
+                return
+            raise FSFileExistsError(fs_path)
+        self._run("-touchz", fs_path)
+
+    def cat(self, fs_path=None) -> str:
+        return self._run("-cat", fs_path)

@@ -84,3 +84,50 @@ def recompute_sequential(ctx, functions, *args, **kwargs):
             return x
         out = recompute(run_seg, out, **kwargs)
     return out
+
+
+# -- storage + PS-infer utilities (reference fleet/utils/__init__.py __all__:
+# LocalFS, HDFSClient, DistributedInfer, recompute) --------------------------
+from .fs import (ExecuteError, FS, FSFileExistsError,  # noqa: F401, E402
+                 FSFileNotExistsError, FSShellCmdAborted, FSTimeOut,
+                 HDFSClient, LocalFS)
+
+
+class DistributedInfer:
+    """Parity: fleet/utils/ps_util.py:32 — serving with PS-backed sparse
+    tables. TPU-native shape: there is no Program rewrite to do (the
+    compiled program is self-contained); the sparse-table capability is
+    `incubate.HostEmbedding(ps_client=...)`, which pulls rows from the
+    table server at lookup time. This class wires the client env the
+    reference API expects."""
+
+    def __init__(self, main_program=None, startup_program=None):
+        self._main = main_program
+        self._client = None
+
+    def init_distributed_infer_env(self, exe=None, loss=None,
+                                   role_maker=None, dirname=None):
+        if role_maker is not None and hasattr(role_maker, "to_env"):
+            role_maker.to_env()
+        if dirname is not None:
+            import warnings
+            warnings.warn(
+                "DistributedInfer: dirname is accepted for reference-API "
+                "compatibility but sparse rows are NOT preloaded from it "
+                "— load dense weights with paddle.load and let "
+                "HostEmbedding pull rows from the live table server",
+                stacklevel=2)
+        from . import init_worker, server_endpoints
+        if not server_endpoints():
+            self._client = None  # genuinely no PS configured: local infer
+            return None
+        # PS endpoints ARE configured: a connection failure is a real
+        # error the caller must see, not a silent local-only downgrade
+        self._client = init_worker()
+        return self._client
+
+    def get_dist_infer_program(self):
+        """The compiled program needs no rewriting (sparse lookups go
+        through HostEmbedding's client at run time); returns the program
+        unchanged, reference-API-compatible."""
+        return self._main

@@ -1,0 +1,158 @@
+"""fleet.utils storage layer: LocalFS (native), HDFSClient (hadoop-CLI
+transport, command construction tested with a stub executable), and the
+DistributedInfer shim.
+
+Parity: /root/reference/python/paddle/distributed/fleet/utils/fs.py
+(FS :72, LocalFS :134, HDFSClient), fleet/utils/ps_util.py:32
+(DistributedInfer); fleet/utils/__init__.py __all__ =
+[LocalFS, recompute, DistributedInfer, HDFSClient]."""
+import os
+import stat
+
+import pytest
+
+from paddle_tpu.distributed.fleet.utils import (DistributedInfer,
+                                                ExecuteError,
+                                                FSFileExistsError,
+                                                FSFileNotExistsError,
+                                                HDFSClient, LocalFS,
+                                                recompute)
+
+
+def test_fleet_utils_all_parity():
+    import paddle_tpu.distributed.fleet.utils as U
+    for n in ("LocalFS", "recompute", "DistributedInfer", "HDFSClient"):
+        assert hasattr(U, n), n
+
+
+class TestLocalFS:
+    def test_roundtrip(self, tmp_path):
+        fs = LocalFS()
+        root = str(tmp_path)
+        fs.mkdirs(os.path.join(root, "a/b"))
+        fs.touch(os.path.join(root, "a/f.txt"))
+        with open(os.path.join(root, "a/f.txt"), "w") as f:
+            f.write("hello")
+        assert fs.is_dir(os.path.join(root, "a"))
+        assert fs.is_file(os.path.join(root, "a/f.txt"))
+        assert fs.is_exist(os.path.join(root, "a/b"))
+        assert fs.ls_dir(os.path.join(root, "a")) == (["b"], ["f.txt"])
+        assert fs.cat(os.path.join(root, "a/f.txt")) == "hello"
+        assert not fs.need_upload_download()
+        fs.upload(os.path.join(root, "a/f.txt"),
+                  os.path.join(root, "a/copy.txt"))
+        assert fs.cat(os.path.join(root, "a/copy.txt")) == "hello"
+        fs.mv(os.path.join(root, "a/f.txt"), os.path.join(root, "a/g.txt"))
+        assert fs.list_dirs(os.path.join(root, "a")) == ["b"]
+        fs.delete(os.path.join(root, "a"))
+        assert not fs.is_exist(os.path.join(root, "a"))
+
+    def test_errors(self, tmp_path):
+        fs = LocalFS()
+        f = str(tmp_path / "x")
+        fs.touch(f)
+        with pytest.raises(FSFileExistsError):
+            fs.touch(f, exist_ok=False)
+        with pytest.raises(FSFileNotExistsError):
+            fs.mv(str(tmp_path / "nope"), str(tmp_path / "y"))
+        fs.touch(str(tmp_path / "y"))
+        with pytest.raises(FSFileExistsError):
+            fs.mv(f, str(tmp_path / "y"), overwrite=False)
+        fs.mv(f, str(tmp_path / "y"), overwrite=True)
+
+
+class TestHDFSClient:
+    def _stub(self, tmp_path, rc=0):
+        """A fake `hadoop` that logs its argv and exits rc."""
+        log = tmp_path / "calls.log"
+        stub = tmp_path / "hadoop"
+        stub.write_text("#!/bin/sh\n"
+                        f'echo "$@" >> {log}\n'
+                        "echo drwxr-xr-x - u g 0 2026-01-01 00:00 "
+                        "/data/sub\n"
+                        "echo -rw-r--r-- 1 u g 9 2026-01-01 00:00 "
+                        "/data/file.txt\n"
+                        f"exit {rc}\n")
+        stub.chmod(stub.stat().st_mode | stat.S_IEXEC)
+        return str(stub), log
+
+    def test_command_construction(self, tmp_path):
+        stub, log = self._stub(tmp_path)
+        c = HDFSClient(hadoop_bin=stub,
+                       configs={"fs.default.name": "hdfs://ns",
+                                "hadoop.job.ugi": "u,p"})
+        c.mkdirs("/data/x")
+        c.upload("/tmp/l", "/data/l")
+        c.download("/data/l", "/tmp/l2")
+        c.cat("/data/file.txt")
+        calls = log.read_text().splitlines()
+        assert calls[0].startswith("fs -D fs.default.name=hdfs://ns -D "
+                                   "hadoop.job.ugi=u,p -mkdir -p /data/x")
+        assert "-put /tmp/l /data/l" in calls[1]
+        assert "-get /data/l /tmp/l2" in calls[2]
+        assert "-cat /data/file.txt" in calls[3]
+        assert c.need_upload_download()
+
+    def test_ls_parses_dirs_and_files(self, tmp_path):
+        stub, _ = self._stub(tmp_path)
+        c = HDFSClient(hadoop_bin=stub)
+        dirs, files = c.ls_dir("/data")
+        assert dirs == ["sub"] and files == ["file.txt"]
+
+    def test_failure_raises_execute_error(self, tmp_path):
+        stub, _ = self._stub(tmp_path, rc=3)
+        c = HDFSClient(hadoop_bin=stub)
+        with pytest.raises(ExecuteError):
+            c.mkdirs("/data/x")
+        # -test based probes swallow the failure into False
+        assert not c.is_dir("/data")
+
+    def test_missing_hadoop_clear_error(self, tmp_path):
+        c = HDFSClient(hadoop_bin=str(tmp_path / "no-such-hadoop"))
+        with pytest.raises(ExecuteError, match="hadoop executable"):
+            c.mkdirs("/x")
+
+
+def test_distributed_infer_shim():
+    di = DistributedInfer(main_program="prog")
+    di.init_distributed_infer_env()
+    assert di.get_dist_infer_program() == "prog"
+
+
+def test_hdfs_ls_handles_spaces(tmp_path):
+    import stat as _stat
+    stub = tmp_path / "hadoop"
+    stub.write_text("#!/bin/sh\n"
+                    "echo '-rw-r--r-- 1 u g 9 2026-01-01 00:00 "
+                    "/data/part 0001.txt'\n")
+    stub.chmod(stub.stat().st_mode | _stat.S_IEXEC)
+    c = HDFSClient(hadoop_bin=str(stub))
+    dirs, files = c.ls_dir("/data")
+    assert files == ["part 0001.txt"]
+
+
+def test_mv_uniform_signature(tmp_path):
+    fs = LocalFS()
+    a, b = str(tmp_path / "a"), str(tmp_path / "b")
+    fs.touch(a)
+    fs.mv(fs_src_path=a, fs_dst_path=b)  # base-class kwarg names work
+    assert fs.is_exist(b)
+    # test_exists=False skips the checks (uniform with HDFSClient)
+    fs.touch(a)
+    fs.mv(a, b, overwrite=True)
+    assert not fs.is_exist(a)
+
+
+def test_distributed_infer_no_endpoints_is_local(monkeypatch):
+    monkeypatch.delenv("PADDLE_PSERVERS_IP_PORT_LIST", raising=False)
+    di = DistributedInfer()
+    assert di.init_distributed_infer_env() is None
+
+
+def test_distributed_infer_dirname_warns():
+    import warnings as _w
+    di = DistributedInfer()
+    with _w.catch_warnings(record=True) as rec:
+        _w.simplefilter("always")
+        di.init_distributed_infer_env(dirname="/ckpt")
+    assert any("NOT preloaded" in str(r.message) for r in rec)
