@@ -179,7 +179,8 @@ class HDFSClient(FS):
     stub); configs dict becomes -D options like the reference."""
 
     def __init__(self, hadoop_home: Optional[str] = None,
-                 configs: Optional[dict] = None, time_out: int = 300,
+                 configs: Optional[dict] = None,
+                 time_out: int = 5 * 60 * 1000,
                  sleep_inter: int = 1000, hadoop_bin: Optional[str] = None):
         self._hadoop = hadoop_bin or (
             os.path.join(hadoop_home, "bin", "hadoop") if hadoop_home
@@ -187,7 +188,10 @@ class HDFSClient(FS):
         self._dopts = []
         for k, v in (configs or {}).items():
             self._dopts += ["-D", f"{k}={v}"]
-        self._timeout = time_out
+        # reference API takes MILLISECONDS (fs.py:508) — a ported
+        # time_out=6*60*1000 must mean 6 minutes, not 100 hours
+        self._timeout = max(1.0, time_out / 1000.0)
+        self._sleep_inter = sleep_inter  # accepted for API parity
 
     def _run(self, *args) -> str:
         cmd = [self._hadoop, "fs", *self._dopts, *args]
@@ -287,4 +291,8 @@ class HDFSClient(FS):
         self._run("-touchz", fs_path)
 
     def cat(self, fs_path=None) -> str:
+        # reference contract: a missing path yields empty content, not an
+        # error (ported probe-then-read patterns check for "")
+        if not self.is_file(fs_path):
+            return ""
         return self._run("-cat", fs_path)

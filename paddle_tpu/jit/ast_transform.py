@@ -210,10 +210,18 @@ class _ControlFlowTransformer:
                 # python iteration is unrolled by the trace; convert
                 # nested control flow inside the body (assign-style only:
                 # a generated `return` inside a loop body would exit the
-                # FUNCTION on every path, changing iteration semantics)
+                # FUNCTION on every path, changing iteration semantics).
+                # A `for i in range(...)` additionally converts to the
+                # while machinery (reference loop_transformer's for->while
+                # lowering) so a TENSOR trip count compiles instead of
+                # graph-breaking.
                 s.body = self.transform_suite(s.body, False)
                 s.orelse = self.transform_suite(s.orelse, False)
-                out.append(s)
+                conv = self._maybe_convert_range_for(s)
+                if conv is not None:
+                    out.extend(conv)
+                else:
+                    out.append(s)
             elif isinstance(s, (ast.With, ast.Try)):
                 for attr in ("body", "orelse", "finalbody"):
                     if hasattr(s, attr):
@@ -319,6 +327,94 @@ class _ControlFlowTransformer:
         self.changed = True
         return (self._seed_undefined(names)
                 + [mk(tname, node.body), mk(fname, node.orelse), assign])
+
+    # -- for i in range(...) ------------------------------------------------
+    def _maybe_convert_range_for(self, node: ast.For):
+        """`for i in range(start, stop, step)` lowers onto the while
+        machinery (counter carry + runtime-dispatched condition), so a
+        tensor-valued trip count compiles. Returns None to keep the For
+        as-is (python iteration unrolls under the trace): non-range
+        iterables, non-Name targets, for/else, non-literal steps, or
+        training mode (the while path is eval-only — see
+        _convert_while)."""
+        if not self.allow_while or node.orelse:
+            return None
+        if not isinstance(node.target, ast.Name):
+            return None
+        it = node.iter
+        if not (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+                and it.func.id == "range" and not it.keywords
+                and 1 <= len(it.args) <= 3):
+            return None
+        if len(it.args) == 3:
+            stepn = it.args[2]
+            # -1 parses as UnaryOp(USub, Constant(1)), not Constant(-1)
+            if isinstance(stepn, ast.UnaryOp) \
+                    and isinstance(stepn.op, ast.USub) \
+                    and isinstance(stepn.operand, ast.Constant) \
+                    and isinstance(stepn.operand.value, int):
+                step_val = -stepn.operand.value
+            elif isinstance(stepn, ast.Constant) \
+                    and isinstance(stepn.value, int):
+                step_val = stepn.value
+            else:
+                return None  # direction must be known statically
+            if step_val == 0:
+                return None
+        else:
+            step_val = 1
+        start = it.args[0] if len(it.args) >= 2 else ast.Constant(value=0)
+        stop = it.args[1] if len(it.args) >= 2 else it.args[0]
+        # synthetic counter (carried; the "_jsti_" prefix is NOT excluded
+        # from carry analysis) so the user's loop var keeps Python
+        # for-semantics after the loop (last USED value, unbound when the
+        # loop never ran)
+        self.counter += 1
+        ctr = f"_jsti_ctr_{self.counter}"
+        stop_name = f"_jsti_stop_{self.counter}"
+        pre = [
+            ast.Assign(targets=[ast.Name(id=ctr, ctx=ast.Store())],
+                       value=start),
+            ast.Assign(targets=[ast.Name(id=stop_name, ctx=ast.Store())],
+                       value=stop),
+            # the loop var is a while-carry and needs a defined,
+            # correctly-typed init — but ONLY when it was unbound (a
+            # previously-bound value must survive a zero-trip loop, like
+            # Python). Deviation from Python only when the loop runs ZERO
+            # times and an UNBOUND var is read after — Python would raise
+            # NameError, here it reads `start`.
+            ast.Try(
+                body=[ast.Expr(value=ast.Name(id=node.target.id,
+                                              ctx=ast.Load()))],
+                handlers=[ast.ExceptHandler(
+                    type=ast.Name(id="NameError", ctx=ast.Load()),
+                    name=None,
+                    body=[ast.Assign(
+                        targets=[ast.Name(id=node.target.id,
+                                          ctx=ast.Store())],
+                        value=ast.Name(id=ctr, ctx=ast.Load()))])],
+                orelse=[], finalbody=[]),
+        ]
+        cmp_op = ast.Lt() if step_val > 0 else ast.Gt()
+        test = ast.Compare(left=ast.Name(id=ctr, ctx=ast.Load()),
+                           ops=[cmp_op],
+                           comparators=[ast.Name(id=stop_name,
+                                                 ctx=ast.Load())])
+        body = ([ast.Assign(targets=[ast.Name(id=node.target.id,
+                                              ctx=ast.Store())],
+                            value=ast.Name(id=ctr, ctx=ast.Load()))]
+                + list(node.body)
+                + [ast.Assign(
+                    targets=[ast.Name(id=ctr, ctx=ast.Store())],
+                    value=ast.BinOp(
+                        left=ast.Name(id=ctr, ctx=ast.Load()),
+                        op=ast.Add(),
+                        right=ast.Constant(value=step_val)))])
+        wh = ast.While(test=test, body=body, orelse=[])
+        try:
+            return pre + self._convert_while(wh)
+        except _Unsupported:
+            return None  # e.g. nothing carried — keep the python for
 
     # -- while --------------------------------------------------------------
     def _convert_while(self, node: ast.While):

@@ -255,3 +255,71 @@ class KwDefaultNet(nn.Layer):
         else:
             h = h + base
         return h
+
+
+class RangeForNet(nn.Layer):
+    """`for i in range(tensor)` — the trip count depends on data."""
+
+    def __init__(self):
+        super().__init__()
+        self.lin = nn.Linear(4, 4)
+
+    def forward(self, x):
+        h = self.lin(x)
+        n = (h.sum().abs() * 0 + 3).astype("int32")  # data-typed count 3
+        acc = h * 0.0
+        for i in range(n):
+            acc = acc + h * float(1.0)
+        return acc
+
+
+class PythonRangeForNet(nn.Layer):
+    """Plain python range inside a function that ALSO graph-breaks (the
+    tensor if): the for must keep exact python semantics through
+    conversion, including the post-loop value of the loop var."""
+
+    def __init__(self):
+        super().__init__()
+        self.lin = nn.Linear(4, 4)
+
+    def forward(self, x):
+        h = self.lin(x)
+        last = 0
+        for i in range(3):
+            h = h + float(i)
+            last = i
+        if (h.sum() > 0):
+            h = h * 2.0
+        return h + float(last)
+
+
+class ZeroTripForNet(nn.Layer):
+    """Zero-trip range-for over a prebound loop var: the prebound value
+    must survive (round-5 review repro)."""
+
+    def __init__(self):
+        super().__init__()
+        self.lin = nn.Linear(4, 4)
+
+    def forward(self, x):
+        h = self.lin(x)
+        i = 99.0
+        for i in range(0):
+            h = h + 1.0
+        if (h.sum() > 0):
+            h = h * 2.0
+        return h + float(i)
+
+
+class DescendingForNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.lin = nn.Linear(4, 4)
+
+    def forward(self, x):
+        h = self.lin(x)
+        acc = h * 0.0
+        n = (h.sum() * 0 + 3).astype("int32")
+        for i in range(n, 0, -1):
+            acc = acc + h * float(1.0)
+        return acc

@@ -486,3 +486,77 @@ def test_accumulate_divisor_checked_per_call():
     ids5 = pt.to_tensor(np.zeros((5, 16), np.int32))
     with pytest.raises(ValueError, match="divide the batch"):
         t.train_step(ids5, ids5)  # later call must still be validated
+
+
+def test_range_for_with_tensor_count_converts():
+    """`for i in range(tensor)` compiles via the while machinery in eval
+    mode (reference loop_transformer for->while lowering)."""
+    from dy2static_ast_models import RangeForNet
+
+    net = RangeForNet()
+    net.eval()
+    st = paddle.jit.to_static(net)
+    x = _x()
+    y = st(x)
+    sf = net.forward
+    assert sf.stats.get("ast_converted_calls", 0) >= 1, sf.stats
+    ref = RangeForNet(); ref.set_state_dict(net.state_dict())
+    h = ref.lin(x)
+    np.testing.assert_allclose(y.numpy(), (h * 3.0).numpy(), rtol=1e-5)
+
+
+def test_python_range_for_semantics_preserved():
+    """A plain python range-for converted alongside a tensor if keeps
+    exact python semantics (incl. the loop var's post-loop value)."""
+    from dy2static_ast_models import PythonRangeForNet
+
+    def eager(ref, x):
+        h = ref.lin(x)
+        for i in range(3):
+            h = h + float(i)
+        h = h * 2.0 if float(h.sum().numpy()) > 0 else h
+        return h + 2.0  # last == 2
+
+    for seed, scale in ((0, 1.0), (5, -3.0)):
+        net = PythonRangeForNet()
+        net.eval()
+        st = paddle.jit.to_static(net)
+        xx = _x(seed=seed, scale=scale)
+        y = st(xx)
+        assert net.forward.stats.get("ast_converted_calls", 0) >= 1
+        ref = PythonRangeForNet(); ref.set_state_dict(net.state_dict())
+        np.testing.assert_allclose(y.numpy(), eager(ref, xx).numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_zero_trip_for_keeps_prebound_var():
+    """Round-5 review repro: a zero-trip converted range-for must not
+    clobber a previously-bound loop variable."""
+    from dy2static_ast_models import ZeroTripForNet
+
+    net = ZeroTripForNet()
+    net.eval()
+    st = paddle.jit.to_static(net)
+    x = _x()
+    y = st(x)
+    assert net.forward.stats.get("ast_converted_calls", 0) >= 1
+    ref = ZeroTripForNet(); ref.set_state_dict(net.state_dict())
+    h = ref.lin(x)
+    h = h * 2.0 if float(h.sum().numpy()) > 0 else h
+    np.testing.assert_allclose(y.numpy(), (h + 99.0).numpy(), rtol=1e-5)
+
+
+def test_descending_range_converts():
+    """Round-5 review repro: range(n, 0, -1) (UnaryOp step) converts."""
+    from dy2static_ast_models import DescendingForNet
+
+    net = DescendingForNet()
+    net.eval()
+    st = paddle.jit.to_static(net)
+    x = _x()
+    y = st(x)
+    assert net.forward.stats.get("ast_converted_calls", 0) >= 1, \
+        net.forward.stats
+    ref = DescendingForNet(); ref.set_state_dict(net.state_dict())
+    np.testing.assert_allclose(y.numpy(), (ref.lin(x) * 3.0).numpy(),
+                               rtol=1e-5)

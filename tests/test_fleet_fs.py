@@ -90,7 +90,7 @@ class TestHDFSClient:
                                    "hadoop.job.ugi=u,p -mkdir -p /data/x")
         assert "-put /tmp/l /data/l" in calls[1]
         assert "-get /data/l /tmp/l2" in calls[2]
-        assert "-cat /data/file.txt" in calls[3]
+        assert any("-cat /data/file.txt" in c for c in calls)
         assert c.need_upload_download()
 
     def test_ls_parses_dirs_and_files(self, tmp_path):
@@ -156,3 +156,17 @@ def test_distributed_infer_dirname_warns():
         _w.simplefilter("always")
         di.init_distributed_infer_env(dirname="/ckpt")
     assert any("NOT preloaded" in str(r.message) for r in rec)
+
+
+def test_hdfs_timeout_is_milliseconds(tmp_path):
+    c = HDFSClient(hadoop_bin=str(tmp_path / "x"), time_out=6 * 60 * 1000)
+    assert c._timeout == 360.0  # reference ms contract -> 6 minutes
+
+
+def test_hdfs_cat_missing_returns_empty(tmp_path):
+    import stat as _stat
+    stub = tmp_path / "hadoop"
+    stub.write_text("#!/bin/sh\nexit 1\n")  # every probe fails
+    stub.chmod(stub.stat().st_mode | _stat.S_IEXEC)
+    c = HDFSClient(hadoop_bin=str(stub))
+    assert c.cat("/no/such/file") == ""
