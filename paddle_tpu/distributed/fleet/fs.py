@@ -190,6 +190,12 @@ class HDFSClient(FS):
             self._dopts += ["-D", f"{k}={v}"]
         # reference API takes MILLISECONDS (fs.py:508) — a ported
         # time_out=6*60*1000 must mean 6 minutes, not 100 hours
+        if time_out < 1000:
+            import warnings
+            warnings.warn(
+                f"HDFSClient: time_out={time_out} means {time_out}ms "
+                "(<1s) — the reference contract is milliseconds; pass "
+                "e.g. 300*1000 for 5 minutes", stacklevel=2)
         self._timeout = max(1.0, time_out / 1000.0)
         self._sleep_inter = sleep_inter  # accepted for API parity
 
@@ -207,10 +213,24 @@ class HDFSClient(FS):
             raise FSTimeOut(f"{' '.join(cmd)} timed out after "
                             f"{self._timeout}s")
         if proc.returncode != 0:
-            raise ExecuteError(
+            err = ExecuteError(
                 f"{' '.join(cmd)} failed (rc={proc.returncode}): "
                 f"{proc.stderr[-500:]}")
+            err.returncode = proc.returncode
+            raise err
         return proc.stdout
+
+    def _test(self, flag: str, fs_path) -> bool:
+        """`hadoop fs -test <flag>`: rc=1 means the probe is FALSE;
+        anything else (binary missing, cluster down, auth) is a real
+        error the caller must see, never a silent False."""
+        try:
+            self._run("-test", flag, fs_path)
+            return True
+        except ExecuteError as e:
+            if getattr(e, "returncode", None) == 1:
+                return False
+            raise
 
     def ls_dir(self, fs_path) -> Tuple[List[str], List[str]]:
         if not self.is_exist(fs_path):
@@ -227,25 +247,13 @@ class HDFSClient(FS):
         return dirs, files
 
     def is_dir(self, fs_path) -> bool:
-        try:
-            self._run("-test", "-d", fs_path)
-            return True
-        except ExecuteError:
-            return False
+        return self._test("-d", fs_path)
 
     def is_file(self, fs_path) -> bool:
-        try:
-            self._run("-test", "-f", fs_path)  # one CLI round trip
-            return True
-        except ExecuteError:
-            return False
+        return self._test("-f", fs_path)  # one CLI round trip
 
     def is_exist(self, fs_path) -> bool:
-        try:
-            self._run("-test", "-e", fs_path)
-            return True
-        except ExecuteError:
-            return False
+        return self._test("-e", fs_path)
 
     def upload(self, local_path, fs_path):
         self._run("-put", local_path, fs_path)

@@ -347,19 +347,11 @@ class _ControlFlowTransformer:
                 and 1 <= len(it.args) <= 3):
             return None
         if len(it.args) == 3:
-            stepn = it.args[2]
-            # -1 parses as UnaryOp(USub, Constant(1)), not Constant(-1)
-            if isinstance(stepn, ast.UnaryOp) \
-                    and isinstance(stepn.op, ast.USub) \
-                    and isinstance(stepn.operand, ast.Constant) \
-                    and isinstance(stepn.operand.value, int):
-                step_val = -stepn.operand.value
-            elif isinstance(stepn, ast.Constant) \
-                    and isinstance(stepn.value, int):
-                step_val = stepn.value
-            else:
+            try:  # handles Constant AND the UnaryOp form of -1
+                step_val = ast.literal_eval(it.args[2])
+            except ValueError:
                 return None  # direction must be known statically
-            if step_val == 0:
+            if not isinstance(step_val, int) or step_val == 0:
                 return None
         else:
             step_val = 1

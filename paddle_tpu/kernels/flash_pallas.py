@@ -198,11 +198,14 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, bounds=None,
     s = scale if scale is not None else 1.0 / math.sqrt(d)
     masked = bounds is not None
     offset = sk - sq
-    if causal and not masked:
+    if causal:
         # Clamp the kv block index at the last visible block for this q
         # block: grid steps past the diagonal then re-request the SAME
         # block, and the Pallas pipeline elides the copy — causal skips
-        # save the HBM traffic, not just the MXU work.
+        # save the HBM traffic, not just the MXU work. SAFE for flashmask
+        # too: a beyond-diagonal tile is invisible from the causal test
+        # alone (i < j everywhere), whatever bounds data the clamped
+        # fetch delivers.
         def kv_idx(ibh, iq, ik):
             last = jnp.clip((iq * bq + bq - 1 + offset) // bk, 0, nk - 1)
             return (ibh, jnp.minimum(ik, last), 0)
@@ -216,10 +219,14 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, bounds=None,
         pl.BlockSpec((1, bk, d), kv_idx),
     ]
     if masked:
-        # [b, h, sk, 4] -> [bh, 4, sk] (component-major for the kernel)
+        # [b, h, sk, 4] -> [bh, 4, sk] (component-major for the kernel);
+        # kv-block index clamped exactly like k/v under causal
+        def bounds_idx(ibh, iq, ik):
+            kidx = kv_idx(ibh, iq, ik)
+            return (kidx[0], 0, kidx[1])
+
         inputs.append(jnp.swapaxes(bounds.reshape(bh, sk, 4), 1, 2))
-        in_specs.append(
-            pl.BlockSpec((1, 4, bk), lambda ibh, iq, ik: (ibh, 0, ik)))
+        in_specs.append(pl.BlockSpec((1, 4, bk), bounds_idx))
 
     kernel = functools.partial(_fa_kernel, scale=s, causal=causal, block_q=bq,
                                block_k=bk, nk=nk, offset=sk - sq,
@@ -400,9 +407,11 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
     q_spec = pl.BlockSpec((1, bq, d), lambda ibh, i, j: (ibh, i, 0))
     row_spec = pl.BlockSpec((1, bq, LANES), lambda ibh, i, j: (ibh, i, 0))
 
-    if causal and not masked:
-        # causal DMA elision (see _flash_forward): skipped kv blocks
-        # re-request the last visible block, so their copies are no-ops
+    if causal:
+        # causal DMA elision (see _flash_forward; safe for flashmask —
+        # beyond-diagonal tiles are invisible from the causal test
+        # alone): skipped kv blocks re-request the last visible block,
+        # so their copies are no-ops
         def kv_idx_dq(ibh, iq, ik):
             last = jnp.clip((iq * bq + bq - 1 + offset) // bk, 0, nk - 1)
             return (ibh, jnp.minimum(ik, last), 0)
@@ -418,10 +427,13 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
         q_spec, row_spec, row_spec,
     ]
     if masked:
+        def bounds_idx_dq(ibh, iq, ik):
+            kidx = kv_idx_dq(ibh, iq, ik)
+            return (kidx[0], 0, kidx[1])
+
         bounds_r = jnp.swapaxes(bounds.reshape(bh, sk, 4), 1, 2)
         dq_inputs.append(bounds_r)
-        dq_in_specs.append(
-            pl.BlockSpec((1, 4, bk), lambda ibh, iq, ik: (ibh, 0, ik)))
+        dq_in_specs.append(pl.BlockSpec((1, 4, bk), bounds_idx_dq))
     dq = pl.pallas_call(
         functools.partial(_fa_dq_kernel, scale=s, causal=causal, block_q=bq,
                           block_k=bk, nk=nk, offset=sk - sq, masked=masked,
@@ -437,10 +449,11 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
     )(*dq_inputs)
 
     kv_spec = pl.BlockSpec((1, bk, d), lambda ibh, ik, iq: (ibh, ik, 0))
-    if causal and not masked:
-        # mirror of the dq clamp: q blocks entirely before this kv block
-        # are skipped, so clamp the q-side index maps at the first visible
-        # q block and their DMA elides
+    if causal:
+        # mirror of the dq clamp (safe for flashmask for the same
+        # reason): q blocks entirely before this kv block are skipped, so
+        # clamp the q-side index maps at the first visible q block and
+        # their DMA elides
         def q_pos(ik, iq):
             first = jnp.clip((ik * bk - offset) // bq, 0, nq - 1)
             return jnp.maximum(iq, first)

@@ -104,8 +104,10 @@ class TestHDFSClient:
         c = HDFSClient(hadoop_bin=stub)
         with pytest.raises(ExecuteError):
             c.mkdirs("/data/x")
-        # -test based probes swallow the failure into False
-        assert not c.is_dir("/data")
+        # -test probes: only rc=1 means probe-false; rc=3 is an
+        # infrastructure failure and must raise
+        with pytest.raises(ExecuteError):
+            c.is_dir("/data")
 
     def test_missing_hadoop_clear_error(self, tmp_path):
         c = HDFSClient(hadoop_bin=str(tmp_path / "no-such-hadoop"))
@@ -170,3 +172,29 @@ def test_hdfs_cat_missing_returns_empty(tmp_path):
     stub.chmod(stub.stat().st_mode | _stat.S_IEXEC)
     c = HDFSClient(hadoop_bin=str(stub))
     assert c.cat("/no/such/file") == ""
+
+
+def test_hdfs_probe_distinguishes_infra_errors(tmp_path):
+    import stat as _stat
+    # rc=1 = probe false (no error); rc=255 = infrastructure failure
+    for rc, expect_raise in ((1, False), (255, True)):
+        stub = tmp_path / f"hadoop{rc}"
+        stub.write_text(f"#!/bin/sh\nexit {rc}\n")
+        stub.chmod(stub.stat().st_mode | _stat.S_IEXEC)
+        c = HDFSClient(hadoop_bin=str(stub))
+        if expect_raise:
+            with pytest.raises(ExecuteError):
+                c.is_file("/x")
+            with pytest.raises(ExecuteError):
+                c.cat("/x")  # outages are loud, not empty-string
+        else:
+            assert c.is_file("/x") is False
+            assert c.cat("/x") == ""
+
+
+def test_hdfs_small_timeout_warns(tmp_path):
+    import warnings as _w
+    with _w.catch_warnings(record=True) as rec:
+        _w.simplefilter("always")
+        HDFSClient(hadoop_bin=str(tmp_path / "x"), time_out=300)
+    assert any("milliseconds" in str(r.message) for r in rec)
