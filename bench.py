@@ -110,7 +110,7 @@ ATTEMPT_ORDER = ("llama-0.5b-b8", "llama-1.1b-b8", "llama-1.1b-b8-acc2",
 # policy / batch / attention variants to locate the MFU sweet spot on
 # this chip (the 1.1B full-remat variants live in the ladder itself)
 LAB_TAGS = ("llama-0.5b-b8-noremat", "llama-0.5b-b16",
-            "llama-0.5b-b8-noflash")
+            "llama-0.5b-b8-noflash", "llama-0.5b-b8-acc2")
 
 
 def _attempt_table():
@@ -167,6 +167,9 @@ def _attempt_table():
         "llama-0.5b-b16": (cfg_half(), 16, 2048, 10, 2, "dots", 256),
         "llama-0.5b-b8-noflash": (noflash(cfg_half()), 8, 2048, 10, 2,
                                   "dots", 256),
+        # grad-accumulation vs remat A/B: acc halves live activations
+        # WITHOUT recompute FLOPs — if MFU holds, prefer acc over remat
+        "llama-0.5b-b8-acc2": (cfg_half(), 8, 2048, 10, 2, False, 256, 2),
     }
     assert set(ATTEMPT_ORDER) | set(LAB_TAGS) == set(table)
     return table

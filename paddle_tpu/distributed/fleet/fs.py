@@ -190,12 +190,15 @@ class HDFSClient(FS):
             self._dopts += ["-D", f"{k}={v}"]
         # reference API takes MILLISECONDS (fs.py:508) — a ported
         # time_out=6*60*1000 must mean 6 minutes, not 100 hours
-        if time_out < 1000:
+        if time_out < 30_000:
+            # the realistic unit mistake is seconds (300, 1800, 3600) —
+            # all far below any plausible ms budget for a hadoop CLI call
             import warnings
             warnings.warn(
-                f"HDFSClient: time_out={time_out} means {time_out}ms "
-                "(<1s) — the reference contract is milliseconds; pass "
-                "e.g. 300*1000 for 5 minutes", stacklevel=2)
+                f"HDFSClient: time_out={time_out} is interpreted as "
+                f"MILLISECONDS ({time_out / 1000:.1f}s) — the reference "
+                "contract; pass e.g. 300*1000 for 5 minutes",
+                stacklevel=2)
         self._timeout = max(1.0, time_out / 1000.0)
         self._sleep_inter = sleep_inter  # accepted for API parity
 

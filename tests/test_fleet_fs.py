@@ -197,4 +197,4 @@ def test_hdfs_small_timeout_warns(tmp_path):
     with _w.catch_warnings(record=True) as rec:
         _w.simplefilter("always")
         HDFSClient(hadoop_bin=str(tmp_path / "x"), time_out=300)
-    assert any("milliseconds" in str(r.message) for r in rec)
+    assert any("MILLISECONDS" in str(r.message) for r in rec)
