@@ -136,16 +136,19 @@ def _traced_cond(pred, true_fn, false_fn):
 # while_loop
 # ---------------------------------------------------------------------------
 
-def while_loop(cond, body, loop_vars, is_test=False, name=None):
+def while_loop(cond, body, loop_vars, is_test=False, name=None,
+               maximum_trip_count=None):
     """Parity: static/nn/control_flow.py:755. Repeats `body` while
-    `cond(*loop_vars)` holds; compiles to `lax.while_loop` in static/traced
-    modes.
+    `cond(*loop_vars)` holds; compiles to `lax.while_loop` in
+    static/traced modes.
 
-    Reverse-mode gradients THROUGH a compiled while_loop are not defined
-    (XLA's while is forward-differentiable only); training losses that need
-    a differentiable loop should use a fixed trip count (lax.scan-backed
-    ops such as cumulative sums) — same constraint the compiled path of the
-    reference's CINN backend has."""
+    Reverse-mode gradients THROUGH a compiled unbounded while are not
+    defined (XLA's while is forward-differentiable only). Pass
+    `maximum_trip_count=N` (a TPU-native extension the reference gets
+    from its interpreter) to lower onto a length-N `lax.scan` with an
+    active mask instead: iterations after the condition first fails are
+    computed-and-discarded (bounded wasted FLOPs), and the loop becomes
+    fully reverse-differentiable — trainable whiles."""
     if not callable(cond):
         raise TypeError("while_loop: cond must be callable")
     if not callable(body):
@@ -153,6 +156,8 @@ def while_loop(cond, body, loop_vars, is_test=False, name=None):
     if not isinstance(loop_vars, (list, tuple)) or not loop_vars:
         raise ValueError("while_loop: loop_vars must be a non-empty "
                          "list/tuple")
+    if maximum_trip_count is not None and int(maximum_trip_count) < 1:
+        raise ValueError("while_loop: maximum_trip_count must be >= 1")
     loop_vars = list(loop_vars)
     m = _mode(*loop_vars)
     if m == "eager":
@@ -166,16 +171,45 @@ def while_loop(cond, body, loop_vars, is_test=False, name=None):
             m = "traced"
         else:
             taken = bool(jnp.asarray(probe._data).reshape(()))
-            while taken:
+            trips = 0
+            while taken and (maximum_trip_count is None
+                             or trips < maximum_trip_count):
                 out = body(*loop_vars)
                 loop_vars = list(out) if isinstance(out, (list, tuple)) \
                     else [out]
                 taken = bool(jnp.asarray(
                     cond(*loop_vars)._data).reshape(()))
+                trips += 1
             return loop_vars
     if m == "traced":
-        return _traced_while(cond, body, loop_vars)
-    return _static_while(cond, body, loop_vars)
+        return _traced_while(cond, body, loop_vars,
+                             max_trips=maximum_trip_count)
+    return _static_while(cond, body, loop_vars,
+                         max_trips=maximum_trip_count)
+
+
+def _bounded_while_arrays(cfun, bfun, init, n):
+    """Length-n lax.scan with an active mask: differentiable bounded
+    while over ARRAY carries. cfun(carry)->bool scalar, bfun(carry)->
+    carry, init: tuple of arrays.
+
+    The inactive path goes through lax.cond (NOT run-then-jnp.where):
+    a body that is only defined while the condition holds would produce
+    NaN on the frozen post-exit carry, and where's VJP turns a masked
+    forward NaN into 0*NaN = NaN gradients — the classic where trap.
+    cond's VJP takes only the selected branch, so post-exit iterations
+    contribute exactly zero gradient (and no wasted body FLOPs)."""
+    def step(carry_done, _):
+        carry, done = carry_done
+        active = jnp.logical_and(jnp.logical_not(done),
+                                 as_bool_scalar(cfun(carry)))
+        carry = jax.lax.cond(active, lambda c: tuple(bfun(c)),
+                             lambda c: c, carry)
+        return (carry, jnp.logical_not(active)), None
+
+    (final, _), _ = jax.lax.scan(step, (tuple(init), jnp.bool_(False)),
+                                 None, length=int(n))
+    return final
 
 
 def _check_carry(init_avals, out_avals):
@@ -191,7 +225,7 @@ def _check_carry(init_avals, out_avals):
                 "requires a fixed carry signature")
 
 
-def _static_while(cond_fn, body_fn, loop_vars):
+def _static_while(cond_fn, body_fn, loop_vars, max_trips=None):
     phs = [make_placeholder(aval_of(v), "loop") for v in loop_vars]
     c_flat, _, c_graph = trace_callable(lambda *a: cond_fn(*a), phs)
     if len(c_flat) != 1:
@@ -221,7 +255,10 @@ def _static_while(cond_fn, body_fn, loop_vars):
             val.update({id(p): c for p, c in zip(phs, carry)})
             return tuple(b_graph.replay(val))
 
-        res = lax.while_loop(cfun, bfun, tuple(init))
+        if max_trips is not None:
+            res = _bounded_while_arrays(cfun, bfun, init, max_trips)
+        else:
+            res = lax.while_loop(cfun, bfun, tuple(init))
         return res if len(res) != 1 else res[0]
 
     outs = record_static_op("while_loop", fwd, deps + loop_vars)
@@ -229,7 +266,7 @@ def _static_while(cond_fn, body_fn, loop_vars):
     return unflatten_output(b_spec, list(outs))
 
 
-def _traced_while(cond_fn, body_fn, loop_vars):
+def _traced_while(cond_fn, body_fn, loop_vars, max_trips=None):
     init = tuple(jnp.asarray(v._data) if isinstance(v, Tensor)
                  else jnp.asarray(v) for v in loop_vars)
 
@@ -248,7 +285,10 @@ def _traced_while(cond_fn, body_fn, loop_vars):
                       for a in arrs])
         return arrs
 
-    final = lax.while_loop(cfun, bfun, init)
+    if max_trips is not None:
+        final = _bounded_while_arrays(cfun, bfun, init, max_trips)
+    else:
+        final = lax.while_loop(cfun, bfun, init)
     return [_wrap(a) for a in final]
 
 

@@ -323,3 +323,20 @@ class DescendingForNet(nn.Layer):
         for i in range(n, 0, -1):
             acc = acc + h * float(1.0)
         return acc
+
+
+class BoundedWhileNet(nn.Layer):
+    """Explicit static.nn.while_loop with maximum_trip_count: trainable
+    data-dependent loop inside ONE compiled program."""
+
+    def __init__(self):
+        super().__init__()
+        self.lin = nn.Linear(4, 4)
+
+    def forward(self, x):
+        import paddle_tpu.static.nn as snn
+        h = self.lin(x)
+        out = snn.while_loop(lambda v: ((v * v).sum() > 50.0).all(),
+                             lambda v: [v * 0.5], [h],
+                             maximum_trip_count=10)
+        return out[0]
