@@ -225,6 +225,12 @@ def _run_probe(extend=None):
 
     def step(name, fn):
         t0 = _t.perf_counter()
+        if extend is not None:
+            # per-step watchdog budget: one long (but progressing) step
+            # must not starve the remaining steps and discard everything
+            # collected so far; the watcher's outer `timeout 1800` stays
+            # the whole-probe guard
+            extend(900)
         sys.stderr.write(f"[probe] {name} ...\n")
         sys.stderr.flush()
         try:
@@ -380,9 +386,10 @@ def _run_probe(extend=None):
         ):
             best, best_dt, default_dt = None, float("inf"), None
             tried = 0
-            for bq, bk in cands:
-                if _t.monotonic() > budget_end and best is not None:
-                    break  # keep the rest of the window for the ladder
+            for n_cand, (bq, bk) in enumerate(cands):
+                if n_cand > 0 and _t.monotonic() > budget_end:
+                    break  # hard cap (first candidate always allowed);
+                    # keep the rest of the window for the ladder
                 try:
                     dt_c = ctimeit(make(bq, bk), args, iters=4)
                     tried += 1
@@ -392,7 +399,10 @@ def _run_probe(extend=None):
                     default_dt = dt_c
                 if dt_c < best_dt:
                     best, best_dt = (bq, bk), dt_c
-            if best is not None:
+            if best is not None and tried >= 2:
+                # a 1-candidate "tuning" is just the default — recording
+                # it would shadow _resolve_blocks' bwd->fwd fallback
+                # chain with an untuned entry
                 autotune.record(which, sig, best)
                 out_t[f"{which}_{tb}x{th}x{ts}x{td}"] = {
                     "best": list(best), "tried": tried,
