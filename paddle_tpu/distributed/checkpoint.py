@@ -20,6 +20,7 @@ writes in a background thread.
 """
 from __future__ import annotations
 
+import functools
 import json
 import os
 import pickle
@@ -32,7 +33,26 @@ import jax.numpy as jnp
 import ml_dtypes
 import numpy as np
 
+from .. import profiler as _prof
+from ..profiler import instrument as _instr
 from ..tensor import Tensor
+
+
+def _timed(kind):
+    """Record a checkpoint_<kind>_seconds observation + a host span around
+    the wrapped function (span/metric no-op unless enabled)."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            t0 = time.perf_counter()
+            with _prof.RecordEvent(f"checkpoint::{kind}",
+                                   _prof.TracerEventType.UserDefined):
+                try:
+                    return fn(*a, **k)
+                finally:
+                    _instr.record_checkpoint(kind, time.perf_counter() - t0)
+        return wrapper
+    return deco
 
 _META_NAME = "metadata.json"
 _FORMAT_VERSION = 2
@@ -188,7 +208,9 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
                              "offsets": None}]
 
     def _do_save():
-        with _async_lock:
+        t0 = time.perf_counter()
+        with _async_lock, _prof.RecordEvent(
+                "checkpoint::save", _prof.TracerEventType.UserDefined):
             for fname, chunk in npy_payload:
                 np.save(os.path.join(path, fname), chunk,
                         allow_pickle=False)
@@ -229,6 +251,7 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
                                "world_size": nprocs,
                                "state": merged_state,
                                "storage": merged_storage}, f)
+        _instr.record_checkpoint("save", time.perf_counter() - t0)
 
     if async_save:
         t = threading.Thread(target=_do_save, daemon=True)
@@ -308,6 +331,7 @@ def _assemble(key, offsets_box, entries, reader, dtype):
     return buf
 
 
+@_timed("load")
 def load_state_dict(state_dict, path, process_group=None,
                     coordinator_rank=0, offload=False,
                     unique_id: Optional[int] = None):

@@ -16,6 +16,7 @@ tiers:
 """
 from __future__ import annotations
 
+import functools
 from typing import List, Optional
 
 import jax
@@ -23,6 +24,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from .. import profiler as _prof
+from ..profiler import instrument as _instr
 from ..tensor import Tensor
 from .group import Group
 
@@ -76,6 +79,82 @@ class _Task:
         return True
 
 
+# -- observability ------------------------------------------------------------
+def _payload_bytes(obj) -> int:
+    """Bytes of a Tensor / list of Tensors (static shape+dtype works for
+    tracers too); 0 when unknowable (python objects)."""
+    if isinstance(obj, Tensor):
+        obj = [obj]
+    if not isinstance(obj, (list, tuple)):
+        return 0
+    total = 0
+    for t in obj:
+        arr = t._data if isinstance(t, Tensor) else t
+        try:
+            n = 1
+            for d in arr.shape:
+                n *= int(d)
+            total += n * np.dtype(arr.dtype).itemsize
+        except Exception:  # noqa: BLE001 — dynamic shape, non-array
+            pass
+    return total
+
+
+def _obs_tier(group, obj) -> str:
+    """Which of the three execution tiers this call will take:
+    traced-ICI ("ici"), host store-routed ("host"), or identity."""
+    arr = None
+    if isinstance(obj, Tensor):
+        arr = obj._data
+    elif isinstance(obj, (list, tuple)) and obj and \
+            isinstance(obj[0], Tensor):
+        arr = obj[0]._data
+    if arr is not None and _is_traced(arr):
+        return "ici" if _axis(group) is not None else "identity"
+    from .host_collectives import get_host_collectives
+    return "host" if get_host_collectives() is not None else "identity"
+
+
+def _instrumented(op_name, extract):
+    """Wrap a collective entry point with metrics (calls + payload bytes +
+    tier) and a Communication RecordEvent span. The disabled path is two
+    boolean checks; ``extract(args, kwargs) -> (payload, group)``."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if not (_instr._enabled[0] or _prof._tracer.enabled):
+                return fn(*args, **kwargs)
+            payload, group = extract(args, kwargs)
+            if _instr._enabled[0]:
+                _instr.record_collective(op_name, _payload_bytes(payload),
+                                         _obs_tier(group, payload))
+            span = None
+            if _prof._tracer.enabled:
+                span = _prof.RecordEvent(
+                    f"Communication::{op_name}",
+                    _prof.TracerEventType.Communication)
+                span.begin()
+            try:
+                return fn(*args, **kwargs)
+            finally:
+                if span is not None:
+                    span.end()
+        return wrapper
+    return deco
+
+
+def _arg(i, group_i=None, group_kw="group"):
+    """Extractor: payload = positional arg ``i``; group from kwargs or
+    positional ``group_i``."""
+    def extract(args, kwargs):
+        payload = args[i] if len(args) > i else None
+        group = kwargs.get(group_kw)
+        if group is None and group_i is not None and len(args) > group_i:
+            group = args[group_i]
+        return payload, group
+    return extract
+
+
 def _reduce_traced(arr, op, axis_name):
     if op in (ReduceOp.SUM, "sum"):
         return lax.psum(arr, axis_name)
@@ -90,6 +169,7 @@ def _reduce_traced(arr, op, axis_name):
     raise ValueError(f"unknown reduce op {op}")
 
 
+@_instrumented("all_reduce", _arg(0, 2))
 def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group: Optional[Group] = None,
                sync_op: bool = True):
     ax = _axis(group)
@@ -102,6 +182,7 @@ def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group: Optional[Group] = None,
     return _Task()
 
 
+@_instrumented("all_gather", _arg(1, 2))
 def all_gather(tensor_list: List, tensor: Tensor, group: Optional[Group] = None,
                sync_op: bool = True):
     ax = _axis(group)
@@ -119,6 +200,7 @@ def all_gather(tensor_list: List, tensor: Tensor, group: Optional[Group] = None,
     return _Task()
 
 
+@_instrumented("all_gather_object", _arg(1, 2))
 def all_gather_object(object_list: List, obj, group=None):
     hc = _host(group)
     if hc is not None:
@@ -128,6 +210,7 @@ def all_gather_object(object_list: List, obj, group=None):
     return _Task()
 
 
+@_instrumented("broadcast", _arg(0, 2))
 def broadcast(tensor: Tensor, src: int, group: Optional[Group] = None,
               sync_op: bool = True):
     # Traced/SPMD: replicated values are kept consistent by the compiler
@@ -139,6 +222,7 @@ def broadcast(tensor: Tensor, src: int, group: Optional[Group] = None,
     return _Task()
 
 
+@_instrumented("broadcast_object_list", _arg(0, 2))
 def broadcast_object_list(object_list, src=0, group=None):
     hc = _host(group)
     if hc is not None:
@@ -153,6 +237,7 @@ def reduce(tensor: Tensor, dst: int, op=ReduceOp.SUM,
     return all_reduce(tensor, op, group, sync_op)
 
 
+@_instrumented("reduce_scatter", _arg(1, 3))
 def reduce_scatter(tensor: Tensor, tensor_list_or_input, op=ReduceOp.SUM,
                    group: Optional[Group] = None, sync_op: bool = True):
     ax = _axis(group)
@@ -177,6 +262,7 @@ def reduce_scatter(tensor: Tensor, tensor_list_or_input, op=ReduceOp.SUM,
     return _Task()
 
 
+@_instrumented("all_to_all", _arg(1, 2))
 def all_to_all(out_tensor_list, in_tensor_list, group: Optional[Group] = None,
                sync_op: bool = True):
     ax = _axis(group)
@@ -200,6 +286,7 @@ def all_to_all(out_tensor_list, in_tensor_list, group: Optional[Group] = None,
 alltoall = all_to_all
 
 
+@_instrumented("scatter", _arg(1, 3))
 def scatter(tensor: Tensor, tensor_list=None, src=0,
             group: Optional[Group] = None, sync_op: bool = True):
     ax = _axis(group)
@@ -220,6 +307,7 @@ def scatter(tensor: Tensor, tensor_list=None, src=0,
     return _Task()
 
 
+@_instrumented("scatter_object_list", _arg(1, 3))
 def scatter_object_list(out_object_list, in_object_list, src=0, group=None):
     hc = _host(group)
     if hc is not None:
@@ -230,6 +318,7 @@ def scatter_object_list(out_object_list, in_object_list, src=0, group=None):
     return _Task()
 
 
+@_instrumented("gather", _arg(0, 3))
 def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
     ax = _axis(group)
     if ax is not None and _is_traced(tensor._data):
@@ -250,6 +339,7 @@ def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
     return _Task()
 
 
+@_instrumented("send", _arg(0, 2))
 def send(tensor: Tensor, dst: int = 0, group: Optional[Group] = None,
          sync_op: bool = True):
     """P2P send. Traced path: use batch_isend_irecv (lowers to ppermute);
@@ -263,6 +353,7 @@ def send(tensor: Tensor, dst: int = 0, group: Optional[Group] = None,
     return _Task()
 
 
+@_instrumented("recv", _arg(0, 2))
 def recv(tensor: Tensor, src: int = 0, group: Optional[Group] = None,
          sync_op: bool = True):
     if _is_traced(tensor._data):
@@ -290,6 +381,7 @@ class P2POp:
         self.group = group
 
 
+@_instrumented("batch_isend_irecv", lambda a, k: ([op.tensor for op in (a[0] if a else k.get("p2p_op_list") or [])], (a[0][0].group if a and a[0] else None)))
 def batch_isend_irecv(p2p_op_list: List[P2POp]):
     """Parity: communication/batch_isend_irecv.py. Traced path: each matched
     send/recv pair lowers to one lax.ppermute over the group axis."""
@@ -327,6 +419,7 @@ def wait(tensor, group=None, use_calc_stream=True):
     return _Task()
 
 
+@_instrumented("barrier", lambda a, k: (None, a[0] if a else k.get("group")))
 def barrier(group: Optional[Group] = None):
     hc = _host(group)
     if hc is not None:
@@ -347,6 +440,7 @@ class stream:
     recv = staticmethod(recv)
 
 
+@_instrumented("alltoall_single", _arg(1, 4))
 def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
                     out_split_sizes=None, group: Optional[Group] = None,
                     sync_op: bool = True):

@@ -15,11 +15,41 @@ gives reference users the same fit/evaluate/predict/dist.to_static shape.
 """
 from __future__ import annotations
 
+import time
 from typing import Callable, Optional
 
 import numpy as np
 
+from .. import profiler as _prof
+from ..profiler import instrument as _instr
 from ..tensor import Tensor
+
+_END = object()  # loader-exhausted sentinel for the instrumented fetch
+
+
+def _next_batch(data_iter):
+    """One loader fetch, under a Dataloader span when tracing (the guard is
+    the single tracer boolean; the off path is a bare next())."""
+    if _prof._tracer.enabled:
+        with _prof.RecordEvent("Dataloader",
+                               _prof.TracerEventType.Dataloader):
+            return next(data_iter, _END)
+    return next(data_iter, _END)
+
+
+def _tokens_of(batch) -> Optional[int]:
+    """Element count of the first batch input (B*T for token models) for
+    runlog tokens/s; None when the shape is not discoverable."""
+    try:
+        first = batch[0] if isinstance(batch, (list, tuple)) else batch
+        shape = first.shape if hasattr(first, "shape") else \
+            np.shape(first)
+        n = 1
+        for d in shape:
+            n *= int(d)
+        return n
+    except Exception:  # noqa: BLE001
+        return None
 
 
 class Engine:
@@ -100,20 +130,43 @@ class Engine:
         return self._build_trainer()
 
     def fit(self, train_data, epochs: int = 1, batch_size=None, steps=None,
-            log_freq: int = 10, verbose: int = 1):
-        """train_data: iterable of (inputs, labels) batches."""
+            log_freq: int = 10, verbose: int = 1, runlog=None):
+        """train_data: iterable of (inputs, labels) batches. runlog: a
+        profiler.RunLog (or path for one) receiving per-step records."""
         tr = self._build_trainer()
+        rl = _prof.RunLog(runlog) if isinstance(runlog, str) else runlog
         history = []
         step = 0
-        for _ in range(epochs):
-            for batch in train_data:
-                loss = tr.train_step(*[b if isinstance(b, Tensor) else
-                                       Tensor(np.asarray(b)) for b in batch])
-                history.append(float(loss.numpy()))
-                step += 1
-                if steps is not None and step >= steps:
-                    return history
-        return history
+        try:
+            for _ in range(epochs):
+                data_iter = iter(train_data)
+                while True:
+                    batch = _next_batch(data_iter)
+                    if batch is _END:
+                        break
+                    t0 = time.perf_counter()
+                    with _prof.RecordEvent(
+                            "ProfileStep",
+                            _prof.TracerEventType.ProfileStep):
+                        loss = tr.train_step(
+                            *[b if isinstance(b, Tensor) else
+                              Tensor(np.asarray(b)) for b in batch])
+                    loss_val = float(loss.numpy())
+                    history.append(loss_val)
+                    if _instr._enabled[0]:
+                        _instr.record_train_step()
+                    if rl is not None:
+                        rl.log_step(
+                            step=step, loss=loss_val,
+                            step_time_ms=(time.perf_counter() - t0) * 1e3,
+                            tokens=_tokens_of(batch))
+                    step += 1
+                    if steps is not None and step >= steps:
+                        return history
+            return history
+        finally:
+            if rl is not None and isinstance(runlog, str):
+                rl.close()
 
     def evaluate(self, valid_data, steps=None):
         losses = []
@@ -125,7 +178,9 @@ class Engine:
                     break
                 t = [b if isinstance(b, Tensor) else Tensor(np.asarray(b))
                      for b in batch]
-                losses.append(float(fn(self.model, *t).numpy()))
+                with _prof.RecordEvent("EvalStep",
+                                       _prof.TracerEventType.Forward):
+                    losses.append(float(fn(self.model, *t).numpy()))
         finally:
             self.model.train()
         return {"loss": float(np.mean(losses))} if losses else {}

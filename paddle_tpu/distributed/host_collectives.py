@@ -22,6 +22,7 @@ from typing import List, Optional
 
 import numpy as np
 
+from ..profiler import instrument as _instr
 from .store import TCPStore, create_or_get_global_tcp_store
 
 
@@ -59,6 +60,8 @@ class HostCollectives:
 
     # -- core rounds ----------------------------------------------------------
     def all_gather_bytes(self, data: bytes, op: str = "ag") -> List[bytes]:
+        if _instr._enabled[0]:
+            _instr.record_host_collective(op, len(data))
         key = self._key(op)
         mine = f"{key}/{self.rank}"
         self.store.set(mine, data)
@@ -68,6 +71,8 @@ class HostCollectives:
 
     def broadcast_bytes(self, data: Optional[bytes], src: int,
                         op: str = "bc") -> bytes:
+        if _instr._enabled[0]:
+            _instr.record_host_collective(op, len(data) if data else 0)
         key = self._key(op)
         if self.rank == src:
             self.store.set(f"{key}/v", data or b"")
@@ -108,6 +113,9 @@ class HostCollectives:
         return full[self.rank * chunk:(self.rank + 1) * chunk]
 
     def all_to_all(self, parts: List[np.ndarray]) -> List[np.ndarray]:
+        if _instr._enabled[0]:
+            _instr.record_host_collective(
+                "a2a", int(sum(p.nbytes for p in parts)))
         key = self._key("a2a")
         keys = []
         for dst, p in enumerate(parts):
@@ -134,6 +142,8 @@ class HostCollectives:
 
     # -- p2p ------------------------------------------------------------------
     def send(self, arr: np.ndarray, dst: int) -> None:
+        if _instr._enabled[0]:
+            _instr.record_host_collective("p2p", int(arr.nbytes))
         pair = (self.rank, dst)
         n = self._p2p_seq.get(pair, 0)
         self._p2p_seq[pair] = n + 1
@@ -159,6 +169,8 @@ class HostCollectives:
         return pickle.loads(self.broadcast_bytes(data, src, op="bco"))
 
     def barrier(self) -> None:
+        if _instr._enabled[0]:
+            _instr.record_host_collective("barrier", 0)
         self.store.barrier(prefix=f"hc/{self.prefix}")
 
 

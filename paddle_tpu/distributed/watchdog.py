@@ -23,6 +23,8 @@ import time
 import traceback
 from typing import Callable, List, Optional
 
+from ..profiler import instrument as _instr
+
 
 def _dump_stacks(out=sys.stderr):
     out.write("=== watchdog: dumping all thread stacks ===\n")
@@ -65,6 +67,8 @@ class StepWatchdog:
 
     def tick(self):
         """Call once per completed training step."""
+        if _instr._enabled[0]:
+            _instr.record_watchdog_tick()
         self._last = time.monotonic()
 
     @property
@@ -76,6 +80,8 @@ class StepWatchdog:
             if self._armed and \
                     time.monotonic() - self._last > self.timeout:
                 self._fired += 1
+                if _instr._enabled[0]:
+                    _instr.record_watchdog_fire()
                 self._last = time.monotonic()  # don't refire every poll
                 try:
                     self.on_hang()

@@ -8,11 +8,15 @@ static-graph adapter, done the trace-and-compile way).
 """
 from __future__ import annotations
 
+import time
 from typing import List, Optional, Sequence
 
 import numpy as np
 
 from .. import optimizer as opt_mod
+from .. import profiler as _prof
+from ..profiler import TracerEventType as _Ev
+from ..profiler import instrument as _instr
 from ..io import DataLoader, Dataset
 from ..metric import Metric
 from ..tensor import Tensor, to_tensor
@@ -29,6 +33,22 @@ def _tensorize(batch):
     if isinstance(batch, (list, tuple)):
         return [b if isinstance(b, Tensor) else to_tensor(b) for b in batch]
     return [batch if isinstance(batch, Tensor) else to_tensor(batch)]
+
+
+_FIT_END = object()  # loader-exhausted sentinel for the instrumented fetch
+
+
+def _batch_tokens(inputs) -> Optional[int]:
+    """Element count of the first input (B*T for token models), for
+    runlog tokens/s."""
+    try:
+        first = inputs[0] if isinstance(inputs, (list, tuple)) else inputs
+        n = 1
+        for d in first.shape:
+            n *= int(d)
+        return n
+    except Exception:  # noqa: BLE001
+        return None
 
 
 class Model:
@@ -73,11 +93,16 @@ class Model:
         self.network.train()
         inputs = _tensorize(inputs)
         labels = _tensorize(labels) if labels is not None else []
-        loss, outputs = self._forward_loss(inputs, labels)
-        (loss * loss_scale if loss_scale != 1.0 else loss).backward()
+        with _prof.RecordEvent("Forward", _Ev.Forward):
+            loss, outputs = self._forward_loss(inputs, labels)
+        with _prof.RecordEvent("Backward", _Ev.Backward):
+            (loss * loss_scale if loss_scale != 1.0 else loss).backward()
         if update:
-            self._optimizer.step()
-            self._optimizer.clear_grad()
+            with _prof.RecordEvent("Optimization", _Ev.Optimization):
+                self._optimizer.step()
+                self._optimizer.clear_grad()
+        if _instr._enabled[0]:
+            _instr.record_train_step()
         metrics = self._update_metrics(outputs, labels)
         return [float(np.asarray(loss._data))], metrics
 
@@ -86,7 +111,7 @@ class Model:
         from ..autograd import no_grad
         inputs = _tensorize(inputs)
         labels = _tensorize(labels) if labels is not None else []
-        with no_grad():
+        with no_grad(), _prof.RecordEvent("Forward", _Ev.Forward):
             loss, outputs = self._forward_loss(inputs, labels)
         metrics = self._update_metrics(outputs, labels)
         return [float(np.asarray(loss._data))], metrics
@@ -130,9 +155,10 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
-            accumulate_grad_batches=1, num_iters=None):
+            accumulate_grad_batches=1, num_iters=None, runlog=None):
         assert self._optimizer is not None and self._loss is not None, \
             "call prepare(optimizer, loss) first"
+        rl = _prof.RunLog(runlog) if isinstance(runlog, str) else runlog
         self._save_dir = save_dir
         loader = self._loader(train_data, batch_size, shuffle, num_workers,
                               drop_last=drop_last)
@@ -147,6 +173,16 @@ class Model:
                         "metrics": ["loss"] + [m.name()
                                                for m in self._metrics]})
         cbs.on_train_begin()
+        try:
+            self._fit_loop(loader, eval_loader, cbs, epochs, eval_freq,
+                           accumulate_grad_batches, num_iters, rl)
+        finally:
+            if rl is not None and isinstance(runlog, str):
+                rl.close()
+        cbs.on_train_end()
+
+    def _fit_loop(self, loader, eval_loader, cbs, epochs, eval_freq,
+                  accumulate_grad_batches, num_iters, rl):
         steps_done = 0
         for epoch in range(epochs):
             for m in self._metrics:
@@ -154,13 +190,31 @@ class Model:
             cbs.on_epoch_begin(epoch)
             logs = {}
             pending_update = False
-            for step, batch in enumerate(loader):
+            data_iter = iter(loader)
+            step = -1
+            while True:
+                # loader fetch under a Dataloader span (worker-thread spans
+                # inside DataLoader land in the same shared buffer)
+                with _prof.RecordEvent("Dataloader", _Ev.Dataloader):
+                    batch = next(data_iter, _FIT_END)
+                if batch is _FIT_END:
+                    break
+                step += 1
+                if _instr._enabled[0]:
+                    _instr.record_dataloader_batch()
                 cbs.on_train_batch_begin(step)
                 inputs, labels = self._split_batch(batch)
                 update = (step + 1) % accumulate_grad_batches == 0
-                loss, _ = self.train_batch(
-                    inputs, labels, update=update,
-                    loss_scale=1.0 / accumulate_grad_batches)
+                t0 = time.perf_counter()
+                with _prof.RecordEvent("ProfileStep", _Ev.ProfileStep):
+                    loss, _ = self.train_batch(
+                        inputs, labels, update=update,
+                        loss_scale=1.0 / accumulate_grad_batches)
+                if rl is not None:
+                    rl.log_step(
+                        step=steps_done, loss=loss[0],
+                        step_time_ms=(time.perf_counter() - t0) * 1e3,
+                        tokens=_batch_tokens(inputs))
                 pending_update = not update
                 logs = self._metric_logs(loss)
                 cbs.on_train_batch_end(step, logs)
@@ -179,14 +233,22 @@ class Model:
                 break
             if num_iters is not None and steps_done >= num_iters:
                 break
-        cbs.on_train_end()
 
     def _run_eval(self, loader, cbs):
         for m in self._metrics:
             m.reset()
         cbs.on_eval_begin()
         logs = {}
-        for step, batch in enumerate(loader):
+        data_iter = iter(loader)
+        step = -1
+        while True:
+            with _prof.RecordEvent("Dataloader", _Ev.Dataloader):
+                batch = next(data_iter, _FIT_END)
+            if batch is _FIT_END:
+                break
+            step += 1
+            if _instr._enabled[0]:
+                _instr.record_dataloader_batch()
             cbs.on_eval_batch_begin(step)
             inputs, labels = self._split_batch(batch)
             loss, _ = self.eval_batch(inputs, labels)

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import functools
 import inspect
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -306,6 +307,8 @@ class StaticFunction:
                 state_tensors.append(state[n])
 
         key = next_key()
+        t0 = time.perf_counter()
+        traces_before = self._trace_count
         all_inputs = state_tensors + in_tensors
         n_state = len(state_tensors)
         n_buf = len(self._buffer_names)
@@ -340,6 +343,7 @@ class StaticFunction:
                     self.stats["ast_converted_calls"] = \
                         self.stats.get("ast_converted_calls", 0) + 1
                     self.stats["compiled_calls"] += 1
+                    self._record_jit_metrics(traces_before, t0)
                     return self._finish_call(result, static_key, n_buf,
                                              orig_batch, raw_spec, layer)
             self._graph_break(fallback_key, e)
@@ -360,8 +364,23 @@ class StaticFunction:
                 return self._call_fallback(raw_args, kwargs)
             raise
         self.stats["compiled_calls"] += 1
+        self._record_jit_metrics(traces_before, t0)
         return self._finish_call(result, static_key, n_buf, orig_batch,
                                  raw_spec, layer)
+
+    def _record_jit_metrics(self, traces_before, t0):
+        """Compile-cache observability: a call whose trace count advanced
+        was a cache miss (the wall time spans trace+compile+first run — an
+        upper bound on compile, recorded as such); an unchanged count is a
+        hit on the compiled program."""
+        from ..profiler import instrument
+        if not instrument._enabled[0]:
+            return
+        if self._trace_count > traces_before:
+            instrument.record_jit_compile(self.__name__,
+                                          time.perf_counter() - t0)
+        else:
+            instrument.record_jit_cache_hit(self.__name__)
 
     def _finish_call(self, result, static_key, n_buf, orig_batch, raw_spec,
                      layer):

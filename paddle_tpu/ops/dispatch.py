@@ -95,15 +95,22 @@ def _check_numerics(name, out):
 
 
 _prof = None  # lazily bound paddle_tpu.profiler (host tracer)
+_metrics_on = None  # lazily bound metrics-enabled cell (single-bool guard)
+_instr = None
 
 
 def _prof_span(name):
     """Open a RecordEvent for this op when the profiler is recording
     (parity: the 'Dygraph Record Event' slot in eager_gen.py:372)."""
-    global _prof
+    global _prof, _metrics_on, _instr
     if _prof is None:
         from .. import profiler as _prof_mod
+        from ..profiler import instrument as _instr_mod
         _prof = _prof_mod
+        _instr = _instr_mod
+        _metrics_on = _instr_mod._enabled
+    if _metrics_on[0]:
+        _instr.record_op_dispatch(name)
     if not _prof._tracer.enabled:
         return None
     ev = _prof.RecordEvent(name, _prof.TracerEventType.Operator)

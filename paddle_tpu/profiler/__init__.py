@@ -1,15 +1,28 @@
-"""Profiler.
+"""Profiler + observability plane.
 
 Reference parity: python/paddle/profiler/ (Profiler profiler.py:358 with
 states CLOSED/READY/RECORD/RECORD_AND_RETURN, ProfilerTarget, RecordEvent
 utils.py:47, make_scheduler, chrome-trace export, summary tables) wrapping
 the C++ host tracer + CUPTI (fluid/platform/profiler/).
 
-TPU-native: host-side annotations are recorded in-process (RecordEvent
-spans; the framework emits one per dispatched op when profiling is on), and
-device-side tracing delegates to jax.profiler (XLA's TPU trace), the
-platform's CUPTI equivalent. Chrome-trace JSON export merges host spans;
-device traces land in the jax.profiler log dir for TensorBoard.
+TPU-native: host-side annotations are recorded in-process into ONE shared,
+lock-guarded buffer (spans may begin/end on any thread — dataloader worker
+spans are collected too); the framework emits spans per dispatched op, per
+train/eval phase (Forward/Backward/Optimization/Dataloader), and per
+collective entry point, all guarded by a single boolean so disabled runs
+pay one check. Device-side tracing delegates to jax.profiler (XLA's TPU
+trace), the platform's CUPTI equivalent.
+
+Exports: chrome-trace JSON with rank-qualified pids, process/thread-name
+metadata and a wall-clock anchor (``tools/trace_merge.py`` merges N ranks
+into one timeline); protobuf wire format (``export_protobuf``); summary
+tables (``Profiler.summary`` honoring ``SortedKeys``).
+
+Beyond tracing, this package is the metrics plane (``profiler.metrics``:
+Counter/Gauge/Histogram registry with JSON + Prometheus text exporters,
+framework built-ins in ``profiler.instrument``) and the structured run log
+(``profiler.runlog``: per-rank JSONL step records with step time, loss,
+tokens/s and a FLOPs-based MFU estimate).
 """
 from __future__ import annotations
 
@@ -19,6 +32,14 @@ import threading
 import time
 from enum import Enum
 from typing import Callable, List, Optional
+
+from . import instrument, metrics, runlog  # noqa: F401 (re-export)
+from .metrics import (Counter, Gauge, Histogram,  # noqa: F401
+                      MetricsRegistry, disable_metrics, enable_metrics,
+                      get_registry, metrics_enabled, reset_registry)
+from .runlog import RunLog, model_flops_per_step, read_runlog  # noqa: F401
+
+CLOCK_ANCHOR_EVENT = "paddle_tpu.clock_anchor"
 
 
 class ProfilerState(Enum):
@@ -48,10 +69,18 @@ class TracerEventType(Enum):
     UserDefined = 8
 
 
-class _HostTracer(threading.local):
+class _HostTracer:
+    """Process-wide span buffer. NOT thread-local: spans begun on worker
+    threads (dataloader, async checkpoint) land in the same lock-guarded
+    list the profiler collects from — the old per-thread buffers silently
+    dropped every worker-thread span."""
+
+    __slots__ = ("enabled", "events", "lock")
+
     def __init__(self):
         self.enabled = False
         self.events: List[dict] = []
+        self.lock = threading.Lock()
 
 
 _tracer = _HostTracer()
@@ -59,6 +88,19 @@ _tracer = _HostTracer()
 
 def _now_us() -> float:
     return time.perf_counter_ns() / 1000.0
+
+
+_pid_cell: List[Optional[int]] = [None]
+
+
+def _trace_pid() -> int:
+    """Rank-qualified pid: the global rank in multi-rank jobs (so merged
+    timelines get one track per rank), the OS pid otherwise."""
+    if _pid_cell[0] is None:
+        from ..distributed.host_collectives import world_info
+        rank, world = world_info()
+        _pid_cell[0] = rank if world > 1 else os.getpid()
+    return _pid_cell[0]
 
 
 class RecordEvent:
@@ -71,17 +113,20 @@ class RecordEvent:
         self._begin = None
 
     def begin(self):
-        self._begin = _now_us()
+        # off path: one boolean check, no clock read
+        self._begin = _now_us() if _tracer.enabled else None
 
     def end(self):
         if self._begin is None or not _tracer.enabled:
             self._begin = None
             return
-        _tracer.events.append({
+        ev = {
             "name": self.name, "cat": self.event_type.name, "ph": "X",
             "ts": self._begin, "dur": _now_us() - self._begin,
-            "pid": os.getpid(), "tid": threading.get_ident() % 100000,
-        })
+            "pid": _trace_pid(), "tid": threading.get_ident() % 100000,
+        }
+        with _tracer.lock:
+            _tracer.events.append(ev)
         self._begin = None
 
     def __enter__(self):
@@ -115,6 +160,39 @@ def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
     return scheduler
 
 
+def _chrome_payload(events: List[dict]) -> dict:
+    """Chrome-trace JSON body: spans + process/thread-name metadata
+    (ph:"M") + a wall-clock anchor instant event so multi-rank traces can
+    be aligned by tools/trace_merge.py. displayTimeUnit makes Perfetto
+    render ms instead of raw microsecond ticks."""
+    from ..distributed.host_collectives import world_info
+    rank, world = world_info()
+    meta: List[dict] = []
+    seen_pids, seen_tids = set(), set()
+    for e in events:
+        pid = e.get("pid", 0)
+        if pid not in seen_pids:
+            seen_pids.add(pid)
+            pname = f"rank {rank} (paddle_tpu)" if world > 1 \
+                else f"paddle_tpu host {pid}"
+            meta.append({"name": "process_name", "ph": "M", "pid": pid,
+                         "args": {"name": pname}})
+            meta.append({"name": "process_sort_index", "ph": "M", "pid": pid,
+                         "args": {"sort_index": rank}})
+        tkey = (pid, e.get("tid", 0))
+        if tkey not in seen_tids:
+            seen_tids.add(tkey)
+            meta.append({"name": "thread_name", "ph": "M", "pid": pid,
+                         "tid": tkey[1],
+                         "args": {"name": f"thread {tkey[1]}"}})
+    anchor_pid = next(iter(seen_pids)) if seen_pids else _trace_pid()
+    anchor = {"name": CLOCK_ANCHOR_EVENT, "ph": "i", "s": "g",
+              "pid": anchor_pid, "tid": 0, "ts": _now_us(),
+              "args": {"unix_time_us": time.time() * 1e6, "rank": rank}}
+    return {"traceEvents": meta + [anchor] + list(events),
+            "displayTimeUnit": "ms"}
+
+
 def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
     """on_trace_ready callback writing chrome://tracing JSON."""
     def handler(prof: "Profiler"):
@@ -123,7 +201,7 @@ def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
         path = os.path.join(dir_name, f"{name}_{prof._export_seq}.json")
         prof._export_seq += 1
         with open(path, "w") as f:
-            json.dump({"traceEvents": prof._events}, f)
+            json.dump(_chrome_payload(prof._events), f)
         prof.last_export_path = path
     return handler
 
@@ -192,8 +270,9 @@ class Profiler:
         recording = self._state in (ProfilerState.RECORD,
                                     ProfilerState.RECORD_AND_RETURN)
         if recording and not _tracer.enabled:
+            with _tracer.lock:
+                _tracer.events = []
             _tracer.enabled = True
-            _tracer.events = []
             if not self.timer_only and (
                     ProfilerTarget.TPU in self.targets
                     or ProfilerTarget.GPU in self.targets):
@@ -214,8 +293,10 @@ class Profiler:
             self._jax_trace_dir = None
 
     def _collect(self):
-        self._events.extend(_tracer.events)
-        _tracer.events = []
+        with _tracer.lock:
+            collected = _tracer.events
+            _tracer.events = []
+        self._events.extend(collected)
 
     def _finish_record(self):
         if self._jax_trace_dir is not None:
@@ -239,33 +320,57 @@ class Profiler:
     # -- results --------------------------------------------------------------
     def export(self, path: str, format: str = "json"):
         with open(path, "w") as f:
-            json.dump({"traceEvents": self._events}, f)
+            json.dump(_chrome_payload(self._events), f)
 
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
-                time_unit="ms"):
+                time_unit="ms") -> str:
+        """Render the per-name table, sorted per ``sorted_by`` (a
+        ``SortedKeys``; GPU* keys alias their CPU counterparts — host spans
+        are the only timed events here). Returns the rendered table."""
         by_name = {}
         for e in self._events:
-            d = by_name.setdefault(e["name"], {"calls": 0, "total_us": 0.0})
+            d = by_name.setdefault(e["name"], {"calls": 0, "total_us": 0.0,
+                                               "max_us": 0.0,
+                                               "min_us": float("inf")})
             d["calls"] += 1
             d["total_us"] += e["dur"]
-        rows = sorted(by_name.items(), key=lambda kv: -kv[1]["total_us"])
-        lines = [f"{'name':<40} {'calls':>8} {'total(ms)':>12} {'avg(us)':>10}"]
+            d["max_us"] = max(d["max_us"], e["dur"])
+            d["min_us"] = min(d["min_us"], e["dur"])
+        sort_key = {
+            SortedKeys.CPUTotal: lambda d: d["total_us"],
+            SortedKeys.GPUTotal: lambda d: d["total_us"],
+            SortedKeys.CPUAvg: lambda d: d["total_us"] / max(d["calls"], 1),
+            SortedKeys.GPUAvg: lambda d: d["total_us"] / max(d["calls"], 1),
+            SortedKeys.CPUMax: lambda d: d["max_us"],
+            SortedKeys.GPUMax: lambda d: d["max_us"],
+            SortedKeys.CPUMin: lambda d: d["min_us"],
+            SortedKeys.GPUMin: lambda d: d["min_us"],
+        }.get(sorted_by, lambda d: d["total_us"])
+        rows = sorted(by_name.items(), key=lambda kv: -sort_key(kv[1]))
+        div, unit = {"s": (1e6, "s"), "ms": (1e3, "ms"),
+                     "us": (1.0, "us")}.get(time_unit, (1e3, "ms"))
+        lines = [f"{'name':<40} {'calls':>8} {f'total({unit})':>14} "
+                 f"{f'avg({unit})':>12} {f'max({unit})':>12} "
+                 f"{f'min({unit})':>12}"]
         for name, d in rows[:50]:
-            lines.append(f"{name:<40} {d['calls']:>8} "
-                         f"{d['total_us'] / 1000.0:>12.3f} "
-                         f"{d['total_us'] / max(d['calls'], 1):>10.1f}")
+            lines.append(
+                f"{name:<40} {d['calls']:>8} {d['total_us'] / div:>14.3f} "
+                f"{d['total_us'] / max(d['calls'], 1) / div:>12.3f} "
+                f"{d['max_us'] / div:>12.3f} {d['min_us'] / div:>12.3f}")
         text = "\n".join(lines)
         print(text)
-        return by_name
+        return text
 
     def step_info(self, unit=None) -> str:
         if not self._step_times:
             return "no steps recorded"
         import numpy as np
-        arr = np.asarray(self._step_times)
-        return (f"steps: {len(arr)}, avg: {arr.mean():.3f} ms, "
-                f"p50: {np.percentile(arr, 50):.3f} ms, "
-                f"p99: {np.percentile(arr, 99):.3f} ms")
+        div, u = {"s": (1e3, "s"), "ms": (1.0, "ms"),
+                  "us": (1e-3, "us")}.get(unit or "ms", (1.0, "ms"))
+        arr = np.asarray(self._step_times) / div
+        return (f"steps: {len(arr)}, avg: {arr.mean():.3f} {u}, "
+                f"p50: {np.percentile(arr, 50):.3f} {u}, "
+                f"p99: {np.percentile(arr, 99):.3f} {u}")
 
 
 def host_tracing_enabled() -> bool:

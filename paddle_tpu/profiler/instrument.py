@@ -1,0 +1,127 @@
+"""Framework built-in metrics: the stable, greppable catalog.
+
+Every instrumented call site in the framework funnels through one
+``record_*`` helper here, each of which starts with the single-boolean
+enabled check (``metrics._ENABLED[0]``) so disabled runs pay nothing
+beyond that check. Metric families live on the default registry and are
+created lazily on first record.
+
+Catalog (names are a stable API — see README "Observability"):
+
+  ops_dispatch_total{op}                 ops/dispatch.py, per dispatched op
+  jit_compile_total{fn}                  jit/ — fresh traces (cache misses)
+  jit_cache_hits_total{fn}               jit/ — compiled calls reusing a trace
+  jit_compile_seconds                    wall time of calls that traced
+  collective_calls_total{op,tier}        distributed/communication.py
+  collective_bytes_total{op,tier}        payload bytes (tier: ici|host|identity)
+  host_collective_rounds_total{op}       distributed/host_collectives.py
+  host_collective_bytes_total{op}        store-routed payload bytes
+  checkpoint_save_seconds                distributed/checkpoint.py
+  checkpoint_load_seconds                distributed/checkpoint.py
+  watchdog_ticks_total                   distributed/watchdog.py StepWatchdog
+  watchdog_fires_total                   hang events fired
+  train_steps_total                      engine/hapi training steps
+  dataloader_batches_total               hapi fit/eval loader batches
+"""
+from __future__ import annotations
+
+from . import metrics as _m
+
+_enabled = _m._ENABLED  # bind the cell once: hot-path guard is _enabled[0]
+
+_TIME_BUCKETS = (0.001, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 30.0, 60.0,
+                 300.0, 1800.0)
+
+
+def _reg() -> "_m.MetricsRegistry":
+    return _m.get_registry()
+
+
+def enabled() -> bool:
+    return _enabled[0]
+
+
+def record_op_dispatch(op: str) -> None:
+    if not _enabled[0]:
+        return
+    _reg().counter("ops_dispatch_total",
+                   "eager/traced op dispatches by op name",
+                   labelnames=("op",)).labels(op=op).inc()
+
+
+def record_jit_compile(fn: str, seconds: float) -> None:
+    if not _enabled[0]:
+        return
+    r = _reg()
+    r.counter("jit_compile_total", "to_static fresh traces (cache misses)",
+              labelnames=("fn",)).labels(fn=fn).inc()
+    r.histogram("jit_compile_seconds",
+                "wall seconds of to_static calls that traced "
+                "(trace+compile+first run)", buckets=_TIME_BUCKETS
+                ).observe(seconds)
+
+
+def record_jit_cache_hit(fn: str) -> None:
+    if not _enabled[0]:
+        return
+    _reg().counter("jit_cache_hits_total",
+                   "to_static calls served from the compile cache",
+                   labelnames=("fn",)).labels(fn=fn).inc()
+
+
+def record_collective(op: str, nbytes: int, tier: str) -> None:
+    if not _enabled[0]:
+        return
+    r = _reg()
+    lbl = {"op": op, "tier": tier}
+    r.counter("collective_calls_total", "collective API calls",
+              labelnames=("op", "tier")).labels(**lbl).inc()
+    r.counter("collective_bytes_total", "collective payload bytes",
+              labelnames=("op", "tier")).labels(**lbl).inc(max(int(nbytes), 0))
+
+
+def record_host_collective(op: str, nbytes: int) -> None:
+    if not _enabled[0]:
+        return
+    r = _reg()
+    r.counter("host_collective_rounds_total",
+              "store-routed host collective rounds",
+              labelnames=("op",)).labels(op=op).inc()
+    r.counter("host_collective_bytes_total",
+              "store-routed host collective payload bytes",
+              labelnames=("op",)).labels(op=op).inc(max(int(nbytes), 0))
+
+
+def record_checkpoint(kind: str, seconds: float) -> None:
+    if not _enabled[0]:
+        return
+    _reg().histogram(f"checkpoint_{kind}_seconds",
+                     f"distributed checkpoint {kind} wall seconds",
+                     buckets=_TIME_BUCKETS).observe(seconds)
+
+
+def record_watchdog_tick() -> None:
+    if not _enabled[0]:
+        return
+    _reg().counter("watchdog_ticks_total",
+                   "StepWatchdog step completions observed").inc()
+
+
+def record_watchdog_fire() -> None:
+    if not _enabled[0]:
+        return
+    _reg().counter("watchdog_fires_total",
+                   "StepWatchdog hang events fired").inc()
+
+
+def record_train_step() -> None:
+    if not _enabled[0]:
+        return
+    _reg().counter("train_steps_total", "training steps completed").inc()
+
+
+def record_dataloader_batch() -> None:
+    if not _enabled[0]:
+        return
+    _reg().counter("dataloader_batches_total",
+                   "batches yielded to fit/evaluate loops").inc()

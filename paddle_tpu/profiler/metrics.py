@@ -1,0 +1,349 @@
+"""Metrics plane: thread-safe Counter / Gauge / Histogram registry.
+
+Reference parity: the C++ profiler's summary statistics plus the
+production-monitoring role the reference fills with external exporters.
+TPU-native design: one in-process registry the whole framework reports
+into — op dispatch, jit compiles, collectives, checkpoints, watchdog —
+exportable as a plain dict, JSON, or Prometheus text exposition format.
+
+Recording is OFF by default and gated on one module-level boolean
+(``_ENABLED[0]``), so instrumented hot paths (eager op dispatch) pay a
+single list-index + bool check when disabled. ``enable_metrics()`` turns
+the plane on; the registry itself always works (tests and user code may
+record into a private registry regardless of the global switch).
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "get_registry", "reset_registry",
+    "enable_metrics", "disable_metrics", "metrics_enabled",
+]
+
+# the one hot-path guard: instrumented call sites check _ENABLED[0] before
+# touching the registry (a list so other modules can bind the cell once)
+_ENABLED: List[bool] = [False]
+
+
+def enable_metrics(flag: bool = True) -> None:
+    """Turn the global metrics plane on/off (off by default)."""
+    _ENABLED[0] = bool(flag)
+
+
+def disable_metrics() -> None:
+    enable_metrics(False)
+
+
+def metrics_enabled() -> bool:
+    return _ENABLED[0]
+
+
+def _check_labels(labelnames: Sequence[str], labels: Dict[str, str]) -> Tuple:
+    if set(labels) != set(labelnames):
+        raise ValueError(
+            f"labels {sorted(labels)} do not match declared labelnames "
+            f"{sorted(labelnames)}")
+    return tuple(str(labels[n]) for n in labelnames)
+
+
+class _Metric:
+    """Base: a named family with optional labels; children keyed by the
+    tuple of label values (in declared labelname order)."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = "",
+                 labelnames: Sequence[str] = ()):
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._lock = threading.Lock()
+        self._children: Dict[Tuple, "_Metric"] = {}
+
+    def labels(self, **labels) -> "_Metric":
+        """The child series for these label values (created on first use)."""
+        if not self.labelnames:
+            raise ValueError(f"metric {self.name!r} declares no labels")
+        key = _check_labels(self.labelnames, labels)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = type(self)(self.name, self.help)
+                child._lock = self._lock  # one lock per family
+                self._children[key] = child
+            return child
+
+    def _series(self) -> Iterable[Tuple[Tuple, "_Metric"]]:
+        if self.labelnames:
+            with self._lock:
+                return list(self._children.items())
+        return [((), self)]
+
+    def _require_no_labels(self) -> None:
+        """Recording on a labeled FAMILY would accumulate into a value no
+        exporter emits — force the caller through .labels(...)."""
+        if self.labelnames:
+            raise ValueError(
+                f"metric {self.name!r} declares labels {self.labelnames}; "
+                "record through .labels(...)")
+
+    def _label_str(self, key: Tuple, extra: str = "") -> str:
+        parts = [f'{n}="{v}"' for n, v in zip(self.labelnames, key)]
+        if extra:
+            parts.append(extra)
+        return "{" + ",".join(parts) + "}" if parts else ""
+
+
+class Counter(_Metric):
+    """Monotonically increasing count."""
+
+    kind = "counter"
+
+    def __init__(self, name, help="", labelnames=()):
+        super().__init__(name, help, labelnames)
+        self._value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError("Counter can only increase")
+        self._require_no_labels()
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def snapshot(self):
+        if self.labelnames:
+            return {key: c._value for key, c in self._series()}
+        return self._value
+
+
+class Gauge(_Metric):
+    """A value that can go up and down."""
+
+    kind = "gauge"
+
+    def __init__(self, name, help="", labelnames=()):
+        super().__init__(name, help, labelnames)
+        self._value = 0.0
+
+    def set(self, value: float) -> None:
+        self._require_no_labels()
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._require_no_labels()
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def snapshot(self):
+        if self.labelnames:
+            return {key: c._value for key, c in self._series()}
+        return self._value
+
+
+_DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0,
+                    30.0, 60.0, 300.0)
+
+
+class Histogram(_Metric):
+    """Cumulative-bucket histogram (Prometheus semantics: each bucket counts
+    observations <= its upper bound; +Inf is implicit = count)."""
+
+    kind = "histogram"
+
+    def __init__(self, name, help="", labelnames=(),
+                 buckets: Sequence[float] = _DEFAULT_BUCKETS):
+        super().__init__(name, help, labelnames)
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        self._counts = [0] * len(self.buckets)
+        self._count = 0
+        self._sum = 0.0
+
+    def labels(self, **labels) -> "Histogram":
+        if not self.labelnames:
+            raise ValueError(f"metric {self.name!r} declares no labels")
+        key = _check_labels(self.labelnames, labels)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = Histogram(self.name, self.help, buckets=self.buckets)
+                child._lock = self._lock
+                self._children[key] = child
+            return child
+
+    def observe(self, value: float) -> None:
+        self._require_no_labels()
+        value = float(value)
+        with self._lock:
+            self._count += 1
+            self._sum += value
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    self._counts[i] += 1
+
+    def time(self):
+        """Context manager observing the elapsed wall seconds."""
+        hist = self
+
+        class _Timer:
+            def __enter__(self):
+                self._t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                hist.observe(time.perf_counter() - self._t0)
+                return False
+
+        return _Timer()
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def snapshot(self):
+        def one(h):
+            return {"count": h._count, "sum": h._sum,
+                    "buckets": dict(zip(h.buckets, h._counts))}
+        if self.labelnames:
+            return {key: one(h) for key, h in self._series()}
+        return one(self)
+
+
+class MetricsRegistry:
+    """A named collection of metric families. ``counter``/``gauge``/
+    ``histogram`` are get-or-create (idempotent re-registration with the
+    same kind); ``snapshot`` returns plain dicts suitable for JSON."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def _get_or_create(self, cls, name, help, labelnames, **kwargs):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if not isinstance(m, cls):
+                    raise ValueError(
+                        f"metric {name!r} already registered as {m.kind}")
+                if m.labelnames != tuple(labelnames):
+                    raise ValueError(
+                        f"metric {name!r} already registered with labels "
+                        f"{m.labelnames}, got {tuple(labelnames)}")
+                return m
+            m = cls(name, help, labelnames=labelnames, **kwargs)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name: str, help: str = "",
+                labelnames: Sequence[str] = ()) -> Counter:
+        return self._get_or_create(Counter, name, help, labelnames)
+
+    def gauge(self, name: str, help: str = "",
+              labelnames: Sequence[str] = ()) -> Gauge:
+        return self._get_or_create(Gauge, name, help, labelnames)
+
+    def histogram(self, name: str, help: str = "",
+                  labelnames: Sequence[str] = (),
+                  buckets: Sequence[float] = _DEFAULT_BUCKETS) -> Histogram:
+        return self._get_or_create(Histogram, name, help, labelnames,
+                                   buckets=buckets)
+
+    def get(self, name: str) -> Optional[_Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._metrics.pop(name, None)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._metrics.clear()
+
+    # -- export ----------------------------------------------------------------
+    def snapshot(self) -> Dict[str, object]:
+        """{name: value | {label-tuple: value} | histogram dict}."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        out = {}
+        for m in metrics:
+            if m.labelnames:
+                out[m.name] = {",".join(f"{n}={v}" for n, v in
+                                        zip(m.labelnames, key)): val
+                               for key, val in m.snapshot().items()}
+            else:
+                out[m.name] = m.snapshot()
+        return out
+
+    def to_json(self, indent: Optional[int] = None) -> str:
+        return json.dumps(self.snapshot(), indent=indent, sort_keys=True)
+
+    def to_prometheus_text(self) -> str:
+        """Prometheus text exposition format (v0.0.4)."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        lines: List[str] = []
+        for m in metrics:
+            if m.help:
+                lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            for key, series in m._series():
+                if isinstance(series, Histogram):
+                    cum = 0
+                    for b, c in zip(series.buckets, series._counts):
+                        cum = c  # counts are already cumulative per bucket
+                        lbl = m._label_str(key, f'le="{b}"')
+                        lines.append(f"{m.name}_bucket{lbl} {cum}")
+                    lbl = m._label_str(key, 'le="+Inf"')
+                    lines.append(f"{m.name}_bucket{lbl} {series._count}")
+                    lines.append(
+                        f"{m.name}_sum{m._label_str(key)} {series._sum}")
+                    lines.append(
+                        f"{m.name}_count{m._label_str(key)} {series._count}")
+                else:
+                    lines.append(
+                        f"{m.name}{m._label_str(key)} {series._value}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+_default: List[Optional[MetricsRegistry]] = [None]
+_default_lock = threading.Lock()
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-wide default registry (created on first use)."""
+    if _default[0] is None:
+        with _default_lock:
+            if _default[0] is None:
+                _default[0] = MetricsRegistry()
+    return _default[0]
+
+
+def reset_registry() -> None:
+    """Drop every metric in the default registry (tests)."""
+    if _default[0] is not None:
+        _default[0].clear()

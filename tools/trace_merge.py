@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""Merge per-rank chrome-trace JSON files into one timeline.
+
+Each rank's profiler export carries a wall-clock anchor instant event
+(``paddle_tpu.clock_anchor``: perf-counter ``ts`` paired with
+``args.unix_time_us`` captured in the same instant). Per-rank timestamps
+are perf-counter based and NOT comparable across processes; the anchor
+gives each file an offset onto the shared unix clock, so the merged
+timeline lines ranks up on real time:
+
+    rebased_ts = ts + (anchor.unix_time_us - anchor.ts) - t0
+
+(t0 = the earliest rebased timestamp across all ranks, keeping numbers
+small for the viewer). Files missing the anchor merge with a warning at
+offset 0 relative to the earliest anchored file.
+
+pid collisions between ranks (e.g. two single-process exports that both
+used the OS pid, or two ranks that both recorded pid 0 before their env
+was set) are resolved by re-qualifying the later file's pids.
+
+Usage:
+    python tools/trace_merge.py rank0.json rank1.json ... -o merged.json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional, Tuple
+
+CLOCK_ANCHOR_EVENT = "paddle_tpu.clock_anchor"
+_META_PHASES = {"M"}
+
+
+def _load(path: str) -> List[dict]:
+    with open(path) as f:
+        data = json.load(f)
+    if isinstance(data, dict):
+        return list(data.get("traceEvents", []))
+    if isinstance(data, list):  # bare-array chrome trace form
+        return data
+    raise ValueError(f"{path}: not a chrome trace (dict or list expected)")
+
+
+def _find_anchor(events: List[dict]) -> Optional[Tuple[float, float, object]]:
+    """(ts, unix_time_us, rank) of the first clock anchor, or None."""
+    for e in events:
+        if e.get("name") == CLOCK_ANCHOR_EVENT:
+            args = e.get("args", {})
+            if "unix_time_us" in args:
+                return float(e.get("ts", 0.0)), float(args["unix_time_us"]), \
+                    args.get("rank")
+    return None
+
+
+def merge_traces(paths: List[str]) -> dict:
+    """Merge chrome traces from ``paths`` into one aligned payload."""
+    per_file = []
+    offsets: List[Optional[float]] = []
+    for path in paths:
+        events = _load(path)
+        anchor = _find_anchor(events)
+        per_file.append((path, events, anchor))
+        offsets.append(None if anchor is None
+                       else anchor[1] - anchor[0])
+    anchored = [o for o in offsets if o is not None]
+    if not anchored and per_file:
+        print("trace_merge: no clock anchors found; concatenating on raw "
+              "timestamps", file=sys.stderr)
+    base = min(anchored) if anchored else 0.0
+    for path, _, anchor in per_file:
+        if anchor is None:
+            print(f"trace_merge: {path} has no {CLOCK_ANCHOR_EVENT} event; "
+                  "merging without clock alignment", file=sys.stderr)
+
+    merged: List[dict] = []
+    used_pids: Dict[object, int] = {}  # original pid -> file index that owns it
+    t0: Optional[float] = None
+    rebased_files = []
+    for idx, (path, events, anchor) in enumerate(per_file):
+        off = offsets[idx]
+        shift = (off - base) if off is not None else 0.0
+        # pid re-qualification: a pid already claimed by an earlier file
+        # gets a per-file suffix so ranks don't collapse into one track
+        remap: Dict[object, object] = {}
+        for e in events:
+            pid = e.get("pid")
+            if pid is None:
+                continue
+            if pid in remap:
+                continue
+            owner = used_pids.setdefault(pid, idx)
+            remap[pid] = pid if owner == idx else f"{pid}.{idx}"
+        out = []
+        for e in events:
+            e = dict(e)
+            if e.get("pid") in remap:
+                e["pid"] = remap[e["pid"]]
+            if "ts" in e:
+                e["ts"] = float(e["ts"]) + shift
+                if e.get("ph") not in _META_PHASES:
+                    t0 = e["ts"] if t0 is None else min(t0, e["ts"])
+            out.append(e)
+        rebased_files.append(out)
+    for out in rebased_files:
+        for e in out:
+            if "ts" in e and e.get("ph") not in _META_PHASES and \
+                    t0 is not None:
+                e["ts"] = e["ts"] - t0
+            elif "ts" in e and e.get("ph") in _META_PHASES:
+                e["ts"] = 0
+        merged.extend(out)
+    return {"traceEvents": merged, "displayTimeUnit": "ms",
+            "metadata": {"merged_from": list(paths)}}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Merge per-rank chrome traces into one timeline")
+    ap.add_argument("traces", nargs="+", help="per-rank trace JSON files")
+    ap.add_argument("-o", "--output", default="merged_trace.json")
+    args = ap.parse_args(argv)
+    payload = merge_traces(args.traces)
+    with open(args.output, "w") as f:
+        json.dump(payload, f)
+    n = len([e for e in payload["traceEvents"]
+             if e.get("ph") not in _META_PHASES])
+    print(f"merged {len(args.traces)} trace(s), {n} events -> {args.output}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
