@@ -21,11 +21,13 @@ writes in a background thread.
 from __future__ import annotations
 
 import functools
+import io
 import json
 import os
 import pickle
 import threading
 import time
+import zlib
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -35,7 +37,32 @@ import numpy as np
 
 from .. import profiler as _prof
 from ..profiler import instrument as _instr
+from ..resilience import chaos as _chaos
 from ..tensor import Tensor
+
+
+class CheckpointCorruptionError(ValueError):
+    """A checkpoint failed integrity verification at load: missing/unreadable
+    metadata, a missing or truncated shard file, or a per-shard checksum
+    mismatch. ValueError subclass so pre-integrity callers keep working;
+    deliberately NOT a retryable-I/O error (corruption is not transient —
+    the recovery path is CheckpointManager's last-good fallback)."""
+
+
+def _atomic_write(full_path: str, data: bytes) -> None:
+    """write-fsync-then-rename so a crash (process or power) never leaves
+    a half shard under the final name: the data is durable before the
+    atomic rename can make it visible."""
+    tmp = full_path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, full_path)
+
+
+def _retry_run(policy, site: str, fn):
+    return fn() if policy is None else policy.run(fn, site=site)
 
 
 def _timed(kind):
@@ -154,10 +181,16 @@ def _shard_chunks(arr: jax.Array) -> List[Tuple[List[List[int]], np.ndarray]]:
 
 def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
                     async_save=False, unique_id: Optional[int] = None,
-                    barrier_timeout: float = 300.0):
+                    barrier_timeout: float = 300.0, retry_policy=None):
     """Write this process's shards of `state_dict` (nested dicts of
     Tensor/array/python leaves) under `path` (or `path/<unique_id>`).
-    Returns the writer thread when async_save, else None."""
+    Returns the writer thread when async_save, else None.
+
+    Integrity: every shard file is written tmp-then-rename with its crc32
+    (of the serialized .npy bytes) recorded in the metadata, so load can
+    verify and a crash mid-save never shadows a good file. retry_policy:
+    an optional resilience.RetryPolicy applied per shard write (transient
+    I/O errors only)."""
     if unique_id is not None:
         path = os.path.join(path, str(unique_id))
     os.makedirs(path, exist_ok=True)
@@ -207,18 +240,34 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
             storage[key] = [{"file": f"{rank_dir}/py.pkl", "chunk": key,
                              "offsets": None}]
 
+    entry_by_file = {e["file"]: e for ents in storage.values()
+                     for e in ents if e.get("offsets") is not None}
+
     def _do_save():
         t0 = time.perf_counter()
         with _async_lock, _prof.RecordEvent(
                 "checkpoint::save", _prof.TracerEventType.UserDefined):
             for fname, chunk in npy_payload:
-                np.save(os.path.join(path, fname), chunk,
-                        allow_pickle=False)
+                def _write_one(fname=fname, chunk=chunk):
+                    _chaos.site("ckpt.shard_write")
+                    buf = io.BytesIO()
+                    np.save(buf, chunk, allow_pickle=False)
+                    data = buf.getvalue()
+                    ent = entry_by_file.get(fname)
+                    if ent is not None:
+                        ent["crc32"] = zlib.crc32(data) & 0xFFFFFFFF
+                        ent["nbytes"] = len(data)
+                    _atomic_write(os.path.join(path, fname),
+                                  _chaos.mangle("ckpt.shard_bytes", data))
+                _retry_run(retry_policy, "ckpt.shard_write", _write_one)
             if py_payload:
-                with open(os.path.join(path, rank_dir, "py.pkl"), "wb") as f:
-                    pickle.dump(py_payload, f, protocol=4)
-            with open(os.path.join(path, f"meta_{rank}.json"), "w") as f:
-                json.dump({"state": meta_state, "storage": storage}, f)
+                _atomic_write(os.path.join(path, rank_dir, "py.pkl"),
+                              pickle.dumps(py_payload, protocol=4))
+            _chaos.site("ckpt.meta_write")
+            _atomic_write(
+                os.path.join(path, f"meta_{rank}.json"),
+                json.dumps({"state": meta_state,
+                            "storage": storage}).encode())
             if rank == coordinator_rank:
                 # wait for every live rank's metadata (poor-man's barrier;
                 # multi-host file systems are shared for checkpoints)
@@ -246,11 +295,12 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
                     merged_state.update(m["state"])
                     for k, entries in m["storage"].items():
                         merged_storage.setdefault(k, []).extend(entries)
-                with open(os.path.join(path, _META_NAME), "w") as f:
-                    json.dump({"format": _FORMAT_VERSION,
-                               "world_size": nprocs,
-                               "state": merged_state,
-                               "storage": merged_storage}, f)
+                _atomic_write(
+                    os.path.join(path, _META_NAME),
+                    json.dumps({"format": _FORMAT_VERSION,
+                                "world_size": nprocs,
+                                "state": merged_state,
+                                "storage": merged_storage}).encode())
         _instr.record_checkpoint("save", time.perf_counter() - t0)
 
     if async_save:
@@ -283,23 +333,56 @@ def _overlap(t_offs, c_offs):
 class _ChunkReader:
     """mmap-backed chunk access: only overlapping slices are paged in; the
     pickled python-leaf files (small) are cached whole. Memmap handles are
-    cached so repeated overlaps with the same chunk reuse one mapping."""
+    cached so repeated overlaps with the same chunk reuse one mapping.
 
-    def __init__(self, path):
+    verify=True checks each file's recorded crc32/length once on first
+    touch (reads the whole file — integrity costs the mmap laziness for
+    verified files; chunks saved without checksums skip the check)."""
+
+    def __init__(self, path, verify: bool = True, retry_policy=None):
         self.path = path
+        self.verify = verify
+        self.retry_policy = retry_policy
         self._pkl_cache: Dict[str, Dict] = {}
         self._mmap_cache: Dict[str, np.ndarray] = {}
 
-    def array(self, fname, cdtype=None) -> np.ndarray:
+    def _open(self, fname, cdtype, crc, nbytes) -> np.ndarray:
+        _chaos.site("ckpt.shard_read")
+        full = os.path.join(self.path, fname)
+        try:
+            if self.verify and crc is not None:
+                with open(full, "rb") as f:
+                    data = f.read()
+                if nbytes is not None and len(data) != int(nbytes):
+                    raise CheckpointCorruptionError(
+                        f"checkpoint shard {fname}: {len(data)} bytes on "
+                        f"disk, metadata says {nbytes} (truncated write?)")
+                if zlib.crc32(data) & 0xFFFFFFFF != int(crc):
+                    raise CheckpointCorruptionError(
+                        f"checkpoint shard {fname}: crc32 mismatch "
+                        "(bit rot or partial write)")
+            arr = np.load(full, mmap_mode="r", allow_pickle=False)
+        except CheckpointCorruptionError:
+            raise
+        except FileNotFoundError as e:
+            raise CheckpointCorruptionError(
+                f"checkpoint shard {fname} is missing: {e}") from e
+        except ValueError as e:
+            # np.load: bad magic / truncated header
+            raise CheckpointCorruptionError(
+                f"checkpoint shard {fname} is unreadable: {e}") from e
+        if arr.dtype.kind == "V" and cdtype:
+            # ml_dtypes (bfloat16, float8_*) round-trip npy as raw
+            # bytes; reinterpret the memmap in place (a full-array view
+            # keeps it lazy — only sliced ranges are paged in)
+            arr = arr.view(_resolve_dtype(cdtype))
+        return arr
+
+    def array(self, fname, cdtype=None, crc=None, nbytes=None) -> np.ndarray:
         arr = self._mmap_cache.get(fname)
         if arr is None:
-            arr = np.load(os.path.join(self.path, fname), mmap_mode="r",
-                          allow_pickle=False)
-            if arr.dtype.kind == "V" and cdtype:
-                # ml_dtypes (bfloat16, float8_*) round-trip npy as raw
-                # bytes; reinterpret the memmap in place (a full-array view
-                # keeps it lazy — only sliced ranges are paged in)
-                arr = arr.view(_resolve_dtype(cdtype))
+            arr = _retry_run(self.retry_policy, "ckpt.shard_read",
+                             lambda: self._open(fname, cdtype, crc, nbytes))
             self._mmap_cache[fname] = arr
         return arr
 
@@ -321,10 +404,12 @@ def _assemble(key, offsets_box, entries, reader, dtype):
         if ov is None:
             continue
         sl_t, sl_c = ov
-        buf[sl_t] = reader.array(ent["file"], ent.get("cdtype"))[sl_c]
+        buf[sl_t] = reader.array(ent["file"], ent.get("cdtype"),
+                                 crc=ent.get("crc32"),
+                                 nbytes=ent.get("nbytes"))[sl_c]
         covered[sl_t] = True
     if not covered.all():
-        raise ValueError(
+        raise CheckpointCorruptionError(
             f"checkpoint is missing data for '{key}' region {offsets_box}: "
             f"{int((~covered).sum())} of {covered.size} elements uncovered "
             "(incomplete or corrupted save)")
@@ -334,21 +419,39 @@ def _assemble(key, offsets_box, entries, reader, dtype):
 @_timed("load")
 def load_state_dict(state_dict, path, process_group=None,
                     coordinator_rank=0, offload=False,
-                    unique_id: Optional[int] = None):
+                    unique_id: Optional[int] = None, verify: bool = True,
+                    retry_policy=None):
     """Load into the provided (possibly differently-sharded) state_dict.
 
     Each target Tensor keeps its current sharding; its per-device shards are
-    assembled from whatever saved chunks overlap them (reshard-on-load)."""
+    assembled from whatever saved chunks overlap them (reshard-on-load).
+
+    verify=True checks recorded per-shard crc32s; integrity failures raise
+    CheckpointCorruptionError (fall back via resilience.CheckpointManager).
+    retry_policy retries transient shard-read I/O errors only."""
     if unique_id is not None:
         path = os.path.join(path, str(unique_id))
-    with open(os.path.join(path, _META_NAME)) as f:
-        meta = json.load(f)
+    try:
+        with open(os.path.join(path, _META_NAME)) as f:
+            meta = json.load(f)
+    except FileNotFoundError as e:
+        raise CheckpointCorruptionError(
+            f"checkpoint at {path} has no {_META_NAME} "
+            "(incomplete or never-finished save)") from e
+    except json.JSONDecodeError as e:
+        raise CheckpointCorruptionError(
+            f"checkpoint metadata {path}/{_META_NAME} is unparseable: "
+            f"{e}") from e
     fmt = meta.get("format")
     if fmt != _FORMAT_VERSION:
         raise ValueError(
             f"checkpoint format {fmt!r} unsupported (expected "
             f"{_FORMAT_VERSION}); re-save with this version")
-    reader = _ChunkReader(path)
+    if "state" not in meta or "storage" not in meta:
+        raise CheckpointCorruptionError(
+            f"checkpoint metadata {path}/{_META_NAME} lacks "
+            "state/storage sections")
+    reader = _ChunkReader(path, verify=verify, retry_policy=retry_policy)
     parents = {}
     flat_target = _flatten(state_dict, parents=parents)
     for key, target in flat_target.items():

@@ -26,6 +26,7 @@ from jax import lax
 
 from .. import profiler as _prof
 from ..profiler import instrument as _instr
+from ..utils.jax_compat import axis_size as _axis_size
 from ..tensor import Tensor
 from .group import Group
 
@@ -248,7 +249,7 @@ def reduce_scatter(tensor: Tensor, tensor_list_or_input, op=ReduceOp.SUM,
     else:
         src_t = src
     if ax is not None and _is_traced(src_t._data):
-        n = lax.axis_size(ax)
+        n = _axis_size(ax)
         reduced = _reduce_traced(src_t._data, op, ax)
         idx = lax.axis_index(ax)
         chunk = reduced.shape[0] // n
@@ -407,7 +408,7 @@ def batch_isend_irecv(p2p_op_list: List[P2POp]):
     for s, r in zip(sends, recvs):
         ax = _axis(s.group)
         if ax is not None and _is_traced(s.tensor._data):
-            n = lax.axis_size(ax)
+            n = _axis_size(ax)
             perm = [(i, (i + 1) % n) for i in range(n)]
             r.tensor._data = lax.ppermute(s.tensor._data, ax, perm)
         else:

@@ -130,9 +130,15 @@ class Engine:
         return self._build_trainer()
 
     def fit(self, train_data, epochs: int = 1, batch_size=None, steps=None,
-            log_freq: int = 10, verbose: int = 1, runlog=None):
+            log_freq: int = 10, verbose: int = 1, runlog=None,
+            step_guard=None):
         """train_data: iterable of (inputs, labels) batches. runlog: a
-        profiler.RunLog (or path for one) receiving per-step records."""
+        profiler.RunLog (or path for one) receiving per-step records.
+        step_guard: optional resilience.StepGuard — the compiled trainer
+        applies its update inside train_step, so here the guard is a
+        detector: "skip" only counts the event (use abort-class actions +
+        checkpoint fallback to recover poisoned optimizer state)."""
+        from ..resilience import chaos as _chaos
         tr = self._build_trainer()
         rl = _prof.RunLog(runlog) if isinstance(runlog, str) else runlog
         history = []
@@ -144,6 +150,8 @@ class Engine:
                     batch = _next_batch(data_iter)
                     if batch is _END:
                         break
+                    if _chaos.enabled():
+                        _chaos.site("train.step")
                     t0 = time.perf_counter()
                     with _prof.RecordEvent(
                             "ProfileStep",
@@ -152,6 +160,10 @@ class Engine:
                             *[b if isinstance(b, Tensor) else
                               Tensor(np.asarray(b)) for b in batch])
                     loss_val = float(loss.numpy())
+                    if _chaos.enabled():  # probe advances with or without
+                        loss_val = _chaos.poison("train.loss", loss_val)
+                    if step_guard is not None:
+                        step_guard.check(loss_val, step=step)
                     history.append(loss_val)
                     if _instr._enabled[0]:
                         _instr.record_train_step()

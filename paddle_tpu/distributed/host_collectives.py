@@ -23,6 +23,7 @@ from typing import List, Optional
 import numpy as np
 
 from ..profiler import instrument as _instr
+from ..resilience import chaos as _chaos
 from .store import TCPStore, create_or_get_global_tcp_store
 
 
@@ -37,21 +38,35 @@ def _load(data: bytes) -> np.ndarray:
 
 
 class HostCollectives:
-    """Store-routed collectives among `world` processes (global ranks)."""
+    """Store-routed collectives among `world` processes (global ranks).
+
+    retry_policy: optional resilience.RetryPolicy for the blocking waits
+    of a round (the store.get side — safe to retry: reads of a fresh
+    per-round key namespace are idempotent; the sequence counters that
+    name rounds are never retried)."""
 
     def __init__(self, store: TCPStore, rank: int, world: int,
-                 prefix: str = "hc"):
+                 prefix: str = "hc", retry_policy=None):
         self.store = store
         self.rank = rank
         self.world = world
         self.prefix = prefix
+        self.retry_policy = retry_policy
         self._seq: dict = {}
         self._p2p_seq: dict = {}
 
     def _key(self, op: str) -> str:
+        _chaos.site("hc.round")
         n = self._seq.get(op, 0)
         self._seq[op] = n + 1
         return f"__hc/{self.prefix}/{op}/{n}"
+
+    def _wait(self, key: str) -> bytes:
+        """One blocking fetch of a round key, under this collective's own
+        retry policy (layered over whatever policy the store itself has)."""
+        if self.retry_policy is None:
+            return self.store.get(key)
+        return self.retry_policy.run(self.store.get, key, site="hc.wait")
 
     def _finish(self, key: str, keys: List[str]) -> None:
         if self.store.add(f"{key}/done", 1) == self.world:
@@ -65,7 +80,7 @@ class HostCollectives:
         key = self._key(op)
         mine = f"{key}/{self.rank}"
         self.store.set(mine, data)
-        out = [self.store.get(f"{key}/{i}") for i in range(self.world)]
+        out = [self._wait(f"{key}/{i}") for i in range(self.world)]
         self._finish(key, [f"{key}/{i}" for i in range(self.world)])
         return out
 
@@ -76,7 +91,7 @@ class HostCollectives:
         key = self._key(op)
         if self.rank == src:
             self.store.set(f"{key}/v", data or b"")
-        out = self.store.get(f"{key}/v")
+        out = self._wait(f"{key}/v")
         self._finish(key, [f"{key}/v"])
         return out
 
@@ -122,7 +137,7 @@ class HostCollectives:
             k = f"{key}/{self.rank}->{dst}"
             self.store.set(k, _dump(p))
             keys.append(k)
-        out = [_load(self.store.get(f"{key}/{src}->{self.rank}"))
+        out = [_load(self._wait(f"{key}/{src}->{self.rank}"))
                for src in range(self.world)]
         self._finish(key, [f"{key}/{s}->{d}" for s in range(self.world)
                            for d in range(self.world)])
@@ -136,7 +151,7 @@ class HostCollectives:
         if self.rank == src:
             for dst, p in enumerate(parts):
                 self.store.set(f"{key}/{dst}", _dump(p))
-        out = _load(self.store.get(f"{key}/{self.rank}"))
+        out = _load(self._wait(f"{key}/{self.rank}"))
         self._finish(key, [f"{key}/{i}" for i in range(self.world)])
         return out
 
@@ -194,6 +209,9 @@ def get_host_collectives() -> Optional[HostCollectives]:
         rank, world = world_info()
         if world <= 1:
             return None
+        # no retry_policy here: the global store already carries the
+        # PADDLE_RETRY_* env policy on its get/set — layering a second
+        # copy would square the attempt count on every round wait
         _host_cc[0] = HostCollectives(create_or_get_global_tcp_store(),
                                      rank, world)
     return _host_cc[0]

@@ -19,18 +19,28 @@ import time
 from typing import List, Optional
 
 from .. import _native
+from ..resilience import chaos as _chaos
 
 
 class TCPStore:
     """KV store. The master rank hosts the server in-process; every rank
-    (master included) connects a client to it."""
+    (master included) connects a client to it.
+
+    rank: this process's global rank, used only to name stragglers in
+    barrier-timeout errors (None = unknown). retry_policy: an optional
+    resilience.RetryPolicy wrapped around get/set (each attempt keeps its
+    own timeout, so total wait can reach attempts x timeout; add is never
+    retried — it is not idempotent)."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  is_master: bool = False, world_size: int = 1,
-                 timeout: float = 900.0):
+                 timeout: float = 900.0, rank: Optional[int] = None,
+                 retry_policy=None):
         self.host = host
         self.world_size = world_size
         self.timeout = timeout
+        self.rank = rank
+        self.retry_policy = retry_policy
         self._barrier_rounds = {}
         self._lib = _native.load()
         self._server = None
@@ -51,33 +61,58 @@ class TCPStore:
         if not self._client:
             raise RuntimeError(f"TCPStore: cannot connect {host}:{port}")
 
+    def _run(self, site: str, fn):
+        """One store op: chaos probe + optional retry (probe inside the
+        retried callable so an injected transient is retried like a real
+        one)."""
+        def attempt():
+            _chaos.site(site)
+            return fn()
+        if self.retry_policy is None:
+            return attempt()
+        return self.retry_policy.run(attempt, site=site)
+
     # -- KV -------------------------------------------------------------------
     def set(self, key: str, value) -> None:
         data = value.encode() if isinstance(value, str) else bytes(value)
-        if self._py:
-            return self._py.set(key, data)
-        buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data) if data \
-            else None
-        rc = self._lib.pt_store_set(self._client, key.encode(), buf,
-                                    len(data))
-        if rc != 0:
-            raise RuntimeError(f"TCPStore.set({key}) failed")
+
+        def _set():
+            if self._py:
+                return self._py.set(key, data)
+            buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data) \
+                if data else None
+            rc = self._lib.pt_store_set(self._client, key.encode(), buf,
+                                        len(data))
+            if rc != 0:
+                # ConnectionError (not RuntimeError): a failed native set
+                # is a transport flake, and must match RetryPolicy's
+                # default retryable set or the policy never fires here
+                raise ConnectionError(f"TCPStore.set({key}) failed")
+        return self._run("store.set", _set)
 
     def get(self, key: str, timeout: Optional[float] = None) -> bytes:
-        """Blocks until the key exists (up to timeout)."""
+        """Blocks until the key exists (up to timeout per attempt)."""
         t = self.timeout if timeout is None else timeout
-        if self._py:
-            return self._py.get(key, t)
-        out = ctypes.POINTER(ctypes.c_uint8)()
-        n = self._lib.pt_store_get(self._client, key.encode(),
-                                   int(t * 1000), ctypes.byref(out))
-        if n < 0:
-            raise TimeoutError(f"TCPStore.get({key}) timed out after {t}s")
-        data = ctypes.string_at(out, n) if n else b""
-        self._lib.pt_store_free(out)  # buffer is malloc'd even when n == 0
-        return data
+
+        def _get():
+            if self._py:
+                return self._py.get(key, t)
+            out = ctypes.POINTER(ctypes.c_uint8)()
+            n = self._lib.pt_store_get(self._client, key.encode(),
+                                       int(t * 1000), ctypes.byref(out))
+            if n < 0:
+                raise TimeoutError(
+                    f"TCPStore.get({key}) timed out after {t}s")
+            data = ctypes.string_at(out, n) if n else b""
+            self._lib.pt_store_free(out)  # malloc'd even when n == 0
+            return data
+        return self._run("store.get", _get)
 
     def add(self, key: str, amount: int = 1) -> int:
+        # NOT retried: add is at-most-once from the caller's view but not
+        # idempotent — a retry after a lost reply would double-count (and
+        # barriers are built on these counters). Chaos-probed only.
+        _chaos.site("store.add")
         if self._py:
             return self._py.add(key, amount)
         v = self._lib.pt_store_add(self._client, key.encode(), amount)
@@ -104,15 +139,50 @@ class TCPStore:
     def barrier(self, prefix: str = "default",
                 timeout: Optional[float] = None) -> None:
         """All `world_size` ranks must call with the same prefix, the same
-        number of times (each call is its own rendezvous round)."""
+        number of times (each call is its own rendezvous round).
+
+        On timeout the error names the missing ranks (when this store
+        knows its own rank — peers register presence keys) and this
+        rank's arrival is rolled back, round counter included, so a
+        retried barrier re-enters the SAME round and can still succeed
+        once the stragglers show up. The last rank through deletes the
+        round's keys."""
         t = self.timeout if timeout is None else timeout
         rnd = self._barrier_rounds.get(prefix, 0)
-        self._barrier_rounds[prefix] = rnd + 1
         key = f"__barrier/{prefix}/{rnd}"
+        _chaos.site("store.barrier")
+        if self.rank is not None:
+            self.set(f"{key}/r{self.rank}", b"1")
         arrived = self.add(f"{key}/count", 1)
         if arrived == self.world_size:
             self.set(f"{key}/go", b"1")
-        self.get(f"{key}/go", t)
+        try:
+            self.get(f"{key}/go", t)
+        except TimeoutError:
+            # roll back our arrival so a retry can rendezvous afresh in
+            # this same round (the counter must not drift past world_size)
+            self.add(f"{key}/count", -1)
+            if self.rank is not None:
+                self.delete_key(f"{key}/r{self.rank}")
+            if self.rank is not None:
+                missing = [r for r in range(self.world_size)
+                           if r != self.rank
+                           and not self.check([f"{key}/r{r}"])]
+                detail = f"missing ranks {missing}"
+            else:  # rank-less stores can only report the arrival count
+                detail = (f"{self.world_size - arrived} of "
+                          f"{self.world_size} ranks never arrived")
+            raise TimeoutError(
+                f"Store.barrier({prefix!r}, round {rnd}) timed out after "
+                f"{t}s: {detail}. The round was rolled back; retrying the "
+                "barrier re-enters round "
+                f"{rnd}.") from None
+        self._barrier_rounds[prefix] = rnd + 1
+        # last rank out tears the round down so keys don't accumulate
+        if self.add(f"{key}/done", 1) == self.world_size:
+            for k in ([f"{key}/count", f"{key}/go", f"{key}/done"]
+                      + [f"{key}/r{r}" for r in range(self.world_size)]):
+                self.delete_key(k)
 
     def stop(self):
         if self._py:
@@ -189,6 +259,8 @@ def create_or_get_global_tcp_store() -> TCPStore:
                                   os.environ.get("RANK", "0")) or 0)
         world = int(os.environ.get("PADDLE_TRAINERS_NUM",
                                    os.environ.get("WORLD_SIZE", "1")) or 1)
+        from ..resilience.retry import policy_from_env
         _global_store[0] = TCPStore(master, port, is_master=(rank == 0),
-                                    world_size=world)
+                                    world_size=world, rank=rank,
+                                    retry_policy=policy_from_env())
     return _global_store[0]

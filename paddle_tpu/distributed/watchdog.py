@@ -17,6 +17,7 @@ HOST that stops making progress. This module watches both:
 """
 from __future__ import annotations
 
+import logging
 import sys
 import threading
 import time
@@ -24,6 +25,8 @@ import traceback
 from typing import Callable, List, Optional
 
 from ..profiler import instrument as _instr
+
+logger = logging.getLogger(__name__)
 
 
 def _dump_stacks(out=sys.stderr):
@@ -50,6 +53,21 @@ class StepWatchdog:
         self._thread: Optional[threading.Thread] = None
 
     def start(self):
+        t = self._thread
+        if t is not None and not t.is_alive():
+            # reap a handle left behind by a failed stop() (the stuck
+            # thread has since exited) so a restart spawns a fresh one
+            self._thread = None
+            self._stop.clear()
+        elif t is not None and self._stop.is_set():
+            # leaked-and-still-stuck thread: it will exit as soon as it
+            # unsticks (the stop event stays set); a second poll thread
+            # cannot be spawned safely alongside it
+            logger.warning(
+                "StepWatchdog.start: previous poll thread is still "
+                "stuck; watchdog NOT restarted — retry once is_alive() "
+                "turns false")
+            return self
         if self._thread is None:
             self._thread = threading.Thread(target=self._loop, daemon=True)
             self._thread.start()
@@ -58,12 +76,29 @@ class StepWatchdog:
         return self
 
     def stop(self):
+        """Stop the poll thread. If it fails to join within 5s the handle
+        is KEPT (is_alive() stays true, the stop event stays set so the
+        thread can still exit) and a warning is logged — supervisors/tests
+        should assert is_alive() is False after stop()."""
         self._armed = False
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5)
+            if t.is_alive():
+                logger.warning(
+                    "StepWatchdog.stop: poll thread failed to join within "
+                    "5s (likely stuck in on_hang); leaking it — check "
+                    "is_alive() before restarting")
+                return
             self._thread = None
         self._stop.clear()
+
+    def is_alive(self) -> bool:
+        """True while the poll thread is running (including a thread that
+        failed to join in stop())."""
+        t = self._thread
+        return t is not None and t.is_alive()
 
     def tick(self):
         """Call once per completed training step."""
@@ -125,17 +160,38 @@ class Heartbeat:
 
     def start(self):
         self.beat()
+        t = self._thread
+        if t is not None and not t.is_alive():
+            self._thread = None  # reap after a failed stop()
+            self._stop.clear()
+        elif t is not None and self._stop.is_set():
+            logger.warning(
+                "Heartbeat.start: previous thread still stuck; NOT "
+                "restarted — retry once is_alive() turns false")
+            return self
         if self._thread is None:
             self._thread = threading.Thread(target=self._loop, daemon=True)
             self._thread.start()
         return self
 
     def stop(self):
+        """Stop the heartbeat thread; same leak-visible contract as
+        StepWatchdog.stop (warn + keep the handle on join failure)."""
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5)
+            if t.is_alive():
+                logger.warning(
+                    "Heartbeat.stop: thread failed to join within 5s "
+                    "(store call stuck?); leaking it")
+                return
             self._thread = None
         self._stop.clear()
+
+    def is_alive(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()
 
     def _loop(self):
         while not self._stop.wait(self.interval):

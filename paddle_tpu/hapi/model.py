@@ -17,6 +17,7 @@ from .. import optimizer as opt_mod
 from .. import profiler as _prof
 from ..profiler import TracerEventType as _Ev
 from ..profiler import instrument as _instr
+from ..resilience import chaos as _chaos
 from ..io import DataLoader, Dataset
 from ..metric import Metric
 from ..tensor import Tensor, to_tensor
@@ -89,12 +90,25 @@ class Model:
         return loss, outputs
 
     def train_batch(self, inputs, labels=None, update=True,
-                    loss_scale=1.0):
+                    loss_scale=1.0, step_guard=None, step=None):
         self.network.train()
+        if _chaos.enabled():
+            _chaos.site("train.step")
         inputs = _tensorize(inputs)
         labels = _tensorize(labels) if labels is not None else []
         with _prof.RecordEvent("Forward", _Ev.Forward):
             loss, outputs = self._forward_loss(inputs, labels)
+        if step_guard is not None:
+            # guard BEFORE backward/update: a poisoned step must not touch
+            # optimizer state (the sync this forces is the one the loss
+            # logging below pays anyway)
+            lossf = float(np.asarray(loss._data))
+            if _chaos.enabled():
+                lossf = _chaos.poison("train.loss", lossf)
+            if step_guard.check(lossf, step=step) == "skip":
+                self._optimizer.clear_grad()
+                metrics = self._update_metrics(outputs, labels)
+                return [lossf], metrics
         with _prof.RecordEvent("Backward", _Ev.Backward):
             (loss * loss_scale if loss_scale != 1.0 else loss).backward()
         if update:
@@ -104,7 +118,13 @@ class Model:
         if _instr._enabled[0]:
             _instr.record_train_step()
         metrics = self._update_metrics(outputs, labels)
-        return [float(np.asarray(loss._data))], metrics
+        lossf = float(np.asarray(loss._data))
+        if step_guard is None and _chaos.enabled():
+            # keep the train.loss probe advancing (and its poison visible
+            # in logs) on unguarded runs too, so an env-armed plan behaves
+            # identically with and without a guard
+            lossf = _chaos.poison("train.loss", lossf)
+        return [lossf], metrics
 
     def eval_batch(self, inputs, labels=None):
         self.network.eval()
@@ -155,7 +175,12 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
-            accumulate_grad_batches=1, num_iters=None, runlog=None):
+            accumulate_grad_batches=1, num_iters=None, runlog=None,
+            step_guard=None):
+        """step_guard: an optional resilience.StepGuard checked on every
+        step's loss before backward/update — "skip" drops the update (the
+        whole accumulation window when accumulating), "abort" raises
+        StepGuardAbort out of fit."""
         assert self._optimizer is not None and self._loss is not None, \
             "call prepare(optimizer, loss) first"
         rl = _prof.RunLog(runlog) if isinstance(runlog, str) else runlog
@@ -175,14 +200,16 @@ class Model:
         cbs.on_train_begin()
         try:
             self._fit_loop(loader, eval_loader, cbs, epochs, eval_freq,
-                           accumulate_grad_batches, num_iters, rl)
+                           accumulate_grad_batches, num_iters, rl,
+                           step_guard)
         finally:
             if rl is not None and isinstance(runlog, str):
                 rl.close()
         cbs.on_train_end()
 
     def _fit_loop(self, loader, eval_loader, cbs, epochs, eval_freq,
-                  accumulate_grad_batches, num_iters, rl):
+                  accumulate_grad_batches, num_iters, rl,
+                  step_guard=None):
         steps_done = 0
         for epoch in range(epochs):
             for m in self._metrics:
@@ -190,6 +217,7 @@ class Model:
             cbs.on_epoch_begin(epoch)
             logs = {}
             pending_update = False
+            window_poisoned = False
             data_iter = iter(loader)
             step = -1
             while True:
@@ -207,22 +235,36 @@ class Model:
                 update = (step + 1) % accumulate_grad_batches == 0
                 t0 = time.perf_counter()
                 with _prof.RecordEvent("ProfileStep", _Ev.ProfileStep):
+                    # a skip poisons its whole accumulation window: later
+                    # micro-batches still run (metrics/logs) but must not
+                    # apply a partial, mis-scaled update at the boundary
                     loss, _ = self.train_batch(
-                        inputs, labels, update=update,
-                        loss_scale=1.0 / accumulate_grad_batches)
+                        inputs, labels,
+                        update=update and not window_poisoned,
+                        loss_scale=1.0 / accumulate_grad_batches,
+                        step_guard=step_guard, step=steps_done)
+                if step_guard is not None and \
+                        step_guard.last_decision == "skip":
+                    window_poisoned = True
                 if rl is not None:
                     rl.log_step(
                         step=steps_done, loss=loss[0],
                         step_time_ms=(time.perf_counter() - t0) * 1e3,
                         tokens=_batch_tokens(inputs))
+                if update and window_poisoned:
+                    self._optimizer.clear_grad()  # drop the poisoned window
+                    window_poisoned = False
                 pending_update = not update
                 logs = self._metric_logs(loss)
                 cbs.on_train_batch_end(step, logs)
                 steps_done += 1
                 if num_iters is not None and steps_done >= num_iters:
                     break
-            if pending_update:  # flush a partial accumulation window
+            if pending_update and not window_poisoned:
+                # flush a partial accumulation window
                 self._optimizer.step()
+                self._optimizer.clear_grad()
+            elif window_poisoned:
                 self._optimizer.clear_grad()
             cbs.on_epoch_end(epoch, logs)
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:

@@ -38,6 +38,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..framework import flags
+from ..utils.jax_compat import axis_size as _axis_size, shard_map
 from . import context as pctx
 from .context import rotate_perm
 
@@ -54,7 +55,7 @@ flags.define_flag(
 
 def _ring_ag_matmul(x, w, axis_name):
     """[..., s_loc, d] x [d, o] -> [..., s_loc*n, o] == all_gather(x) @ w."""
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     if n == 1:
         return jnp.matmul(x, w)
     me = lax.axis_index(axis_name)
@@ -80,7 +81,7 @@ def _ring_matmul_rs(x, w, axis_name):
     accumulator will sit on after the remaining hops. Step 0 has nothing to
     rotate (the accumulator starts as the local product), so n-1 hops.
     """
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     if n == 1:
         return jnp.matmul(x, w)
     me = lax.axis_index(axis_name)
@@ -105,7 +106,7 @@ def _ring_dw(rotating, stationary, axis_name, rotating_is_lhs):
     rotating_is_lhs=True:  dw[d,o] += sum_chunks rot[...,s,d]^T @ sta_chunk[...,s,o]
     rotating_is_lhs=False: dw[d,o] += sum_chunks sta_chunk[...,s,d]^T @ rot[...,s,o]
     """
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     me = lax.axis_index(axis_name)
     s_loc = rotating.shape[-2]
     perm = rotate_perm(n)
@@ -184,7 +185,7 @@ def _mp_manual_region_cached(dev_fn, jmesh, ndim, x_seq_sharded):
     # spec check in jax 0.9 (_unmatch builds dst=P(mesh.axis_names)); under
     # jit the manual region lowers directly, which is also the only path we
     # care about for perf.
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         partial(dev_fn, axis_name="mp"), mesh=jmesh,
         in_specs=(x_spec, w_spec), out_specs=y_spec,
         axis_names={"mp"}, check_vma=False))

@@ -36,6 +36,7 @@ from jax import lax
 from jax.sharding import PartitionSpec
 
 from ..autograd.tape import no_grad
+from ..utils.jax_compat import axis_size as _axis_size, shard_map
 from ..framework.random import key_context
 from ..tensor import Tensor
 from ..distributed.fleet.meta_parallel import get_param_annotation
@@ -54,7 +55,7 @@ def pipeline_blocks(h0, consts, stacked_leaves, *, block_apply_flat,
     applies ONE block. Returns [n_micro, mb, ...] outputs of the last stage
     (broadcast to all pp ranks).
     """
-    p = lax.axis_size(axis_name)
+    p = _axis_size(axis_name)
     rank = lax.axis_index(axis_name)
 
     def apply_stage(x):
@@ -123,7 +124,7 @@ def pipeline_1f1b(h0, labels, consts, stacked_leaves, tail_leaves, *,
     blk_grads are per-device (sharded over pp), the rest are psum'd so every
     rank holds identical replicated values.
     """
-    p = lax.axis_size(axis_name)
+    p = _axis_size(axis_name)
     rank = lax.axis_index(axis_name)
     m = n_micro
     S = 2 * p - 1                      # stash slots: max in-flight microbatches
@@ -427,7 +428,7 @@ def pipeline_zb(h0, labels, consts, stacked_leaves, tail_leaves, *,
     bubble fraction (p-1)/m exceeds the recompute fraction. The modeled
     makespans in the schedule dict quantify the bubble win.
     """
-    p = lax.axis_size(axis_name)
+    p = _axis_size(axis_name)
     rank = lax.axis_index(axis_name)
     m = n_micro
     S = 2 * p - 1
@@ -794,7 +795,7 @@ class _IlvScaffold:
 
     def __init__(self, h0, labels, consts, stacked_leaves, tail_leaves,
                  block_apply_flat, tail_apply_flat, axis_name, m, v, remat):
-        self.p = lax.axis_size(axis_name)
+        self.p = _axis_size(axis_name)
         self.rank = lax.axis_index(axis_name)
         self.axis_name = axis_name
         self.h0, self.labels = h0, labels
@@ -1339,7 +1340,7 @@ class PipelinedTrainer(SpmdTrainer):
             leaf_specs = tuple(
                 PartitionSpec(*(["pp"] + [None] * (l.ndim - 1)))
                 for l in stacked)
-            loss, d_h0, blk_g, tail_g = jax.shard_map(
+            loss, d_h0, blk_g, tail_g = shard_map(
                 lambda h0_, lab_, consts_, st_, tl_: region(
                     h0_, lab_, tuple(consts_), list(st_), list(tl_)),
                 mesh=self._jax_mesh,
@@ -1419,7 +1420,7 @@ class PipelinedTrainer(SpmdTrainer):
                 PartitionSpec(*( ["pp"] + [None] * (l.ndim - 1)))
                 for l in stacked_leaves)
             const_specs = tuple(PartitionSpec() for _ in const_arrays)
-            out = jax.shard_map(
+            out = shard_map(
                 local_fn,
                 mesh=self._jax_mesh,
                 in_specs=(PartitionSpec(), const_specs) + leaf_specs,

@@ -20,6 +20,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec
 
+from ..utils.jax_compat import axis_size as _axis_size, shard_map
+
 from .context import rotate_perm
 
 NEG_INF = -1e30
@@ -43,7 +45,7 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
     Online-softmax accumulation over P hops; K/V rotate by +1 each hop (the
     final hop is peeled so no wasted rotation trails the loop).
     """
-    p = lax.axis_size(axis_name)
+    p = _axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, s_loc, h, d = q.shape
     s = scale if scale is not None else 1.0 / math.sqrt(d)
@@ -104,5 +106,5 @@ def ring_attention(q, k, v, mesh, seq_axis: str, batch_axes=None,
     spec = PartitionSpec(batch_entry, seq_axis, heads_entry, None)
     fn = functools.partial(_ring_attention_local, axis_name=seq_axis,
                            causal=causal, scale=scale)
-    return jax.shard_map(fn, mesh=jax_mesh, in_specs=(spec, spec, spec),
+    return shard_map(fn, mesh=jax_mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, check_vma=False)(q, k, v)

@@ -30,6 +30,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
 from ..autograd.tape import no_grad
+from ..utils.jax_compat import shard_map
 from ..framework.random import key_context, next_key
 from ..optimizer import (ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue,
                          Optimizer)
@@ -413,7 +414,7 @@ class SpmdTrainer:
             return self._update_loop(params_, grads_, state_, lr_, step_,
                                      None)
 
-        return jax.shard_map(
+        return shard_map(
             body, mesh=self._jax_mesh,
             in_specs=(pspecs, gspecs, sspecs, rep, rep),
             out_specs=(pspecs, sspecs),

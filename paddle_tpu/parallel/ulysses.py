@@ -23,13 +23,15 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec
 
+from ..utils.jax_compat import axis_size as _axis_size, shard_map
+
 
 def _ulysses_local(q, k, v, *, axis_name: str, causal: bool,
                    scale: Optional[float], impl):
     """Per-device body (inside shard_map). q,k,v: [b, s_loc, h, d]; the
     head dim h is the GLOBAL head count (seq sharded). Requires
     h % axis_size == 0."""
-    p = lax.axis_size(axis_name)
+    p = _axis_size(axis_name)
     b, s_loc, h, d = q.shape
     if h % p != 0:
         raise ValueError(
@@ -89,7 +91,7 @@ def ulysses_attention(q, k, v, mesh, seq_axis: str, batch_axes=None,
     impl = _flash_impl if use_flash else _dense_attention
     fn = functools.partial(_ulysses_local, axis_name=seq_axis,
                            causal=causal, scale=scale, impl=impl)
-    return jax.shard_map(fn, mesh=jax_mesh, in_specs=(spec, spec, spec),
+    return shard_map(fn, mesh=jax_mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, check_vma=False)(q, k, v)
 
 

@@ -22,6 +22,11 @@ Catalog (names are a stable API — see README "Observability"):
   watchdog_fires_total                   hang events fired
   train_steps_total                      engine/hapi training steps
   dataloader_batches_total               hapi fit/eval loader batches
+  resilience_faults_injected_total{site,kind}  resilience/chaos.py probes
+  resilience_retries_total{site}         resilience/retry.py retried attempts
+  resilience_giveups_total{site}         retry budget exhausted (raise)
+  resilience_ckpt_events_total{event}    corrupt_detected|fallback|gc
+  resilience_guard_events_total{kind,action}   StepGuard nan/spike events
 """
 from __future__ import annotations
 
@@ -125,3 +130,46 @@ def record_dataloader_batch() -> None:
         return
     _reg().counter("dataloader_batches_total",
                    "batches yielded to fit/evaluate loops").inc()
+
+
+def record_fault_injected(site: str, kind: str) -> None:
+    if not _enabled[0]:
+        return
+    _reg().counter("resilience_faults_injected_total",
+                   "chaos faults fired by probe site and kind",
+                   labelnames=("site", "kind")).labels(
+        site=site, kind=kind).inc()
+
+
+def record_resilience_retry(site: str) -> None:
+    if not _enabled[0]:
+        return
+    _reg().counter("resilience_retries_total",
+                   "RetryPolicy retried attempts by call site",
+                   labelnames=("site",)).labels(site=site).inc()
+
+
+def record_resilience_giveup(site: str) -> None:
+    if not _enabled[0]:
+        return
+    _reg().counter("resilience_giveups_total",
+                   "RetryPolicy exhaustions (exception re-raised)",
+                   labelnames=("site",)).labels(site=site).inc()
+
+
+def record_ckpt_event(event: str) -> None:
+    if not _enabled[0]:
+        return
+    _reg().counter("resilience_ckpt_events_total",
+                   "checkpoint lifecycle events "
+                   "(corrupt_detected|fallback|gc)",
+                   labelnames=("event",)).labels(event=event).inc()
+
+
+def record_guard_event(kind: str, action: str) -> None:
+    if not _enabled[0]:
+        return
+    _reg().counter("resilience_guard_events_total",
+                   "StepGuard anomalies by kind and action taken",
+                   labelnames=("kind", "action")).labels(
+        kind=kind, action=action).inc()

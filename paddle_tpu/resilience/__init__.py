@@ -1,0 +1,50 @@
+"""Resilience layer: deterministic chaos, retry policies, checkpoint
+lifecycle, and step guards.
+
+A production-scale TPU training stack dies on its first transient
+failure unless fault tolerance is a subsystem, not an afterthought. This
+package is that subsystem, in four parts that compose:
+
+  * ``chaos``  — seeded, deterministic fault injection at named sites
+    (store ops, checkpoint shard I/O, host collectives, the train step)
+    so failure behavior is *testable*: same seed, same faults, same run.
+  * ``retry``  — ``RetryPolicy``: capped exponential backoff + seeded
+    jitter + deadline + retryable-exception predicate, applied to store
+    ops, checkpoint shard I/O, and host-collective rounds.
+  * ``ckpt``   — ``CheckpointManager``: last-good ledger, fallback-on-
+    corruption loads (per-shard crc32 verification lives in
+    ``distributed.checkpoint``), keep-N GC.
+  * ``guards`` — ``StepGuard``: NaN/inf and loss-spike detection in the
+    fit loops with skip/warn/abort policies.
+
+Everything reports through the PR-1 metrics catalog under
+``resilience_*`` (see profiler.instrument); every knob has an env-var
+twin (``PADDLE_CHAOS_PLAN``/``PADDLE_CHAOS_SEED``, ``PADDLE_RETRY_*``)
+so drills run against unmodified training scripts. ``tools/chaos_drill.py``
+is the end-to-end seeded drill.
+"""
+from . import chaos
+from .chaos import FaultInjected, FaultPlan
+from .guards import GuardEvent, StepGuard, StepGuardAbort
+from .retry import RetryPolicy, policy_from_env, retrying
+
+__all__ = [
+    "chaos", "FaultPlan", "FaultInjected",
+    "RetryPolicy", "retrying", "policy_from_env",
+    "CheckpointManager", "CheckpointCorruptionError",
+    "StepGuard", "StepGuardAbort", "GuardEvent",
+]
+
+_LAZY = {"CheckpointManager", "CheckpointCorruptionError"}
+
+
+def __getattr__(name):
+    # ckpt depends on distributed.checkpoint, which itself imports
+    # resilience.chaos — resolve lazily to keep the package import acyclic
+    # (import_module, not `from . import`: the fromlist path re-enters
+    # this __getattr__ and recurses)
+    if name in _LAZY or name == "ckpt":
+        import importlib
+        mod = importlib.import_module(".ckpt", __name__)
+        return mod if name == "ckpt" else getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

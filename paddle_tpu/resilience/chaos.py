@@ -1,0 +1,269 @@
+"""Deterministic fault injection: seeded chaos for the trainer's hot seams.
+
+Large-run practice (MegaScale-style preemption handling; the every-few-
+hours failure rates of multi-thousand-chip LLM runs) makes fault tolerance
+a first-class subsystem — and a subsystem nobody can trust without a way
+to *test* failure behavior on demand. This module provides that: a seeded
+``FaultPlan`` describing which instrumented sites misbehave, how, and on
+which hit, so a whole kill/corrupt/retry drill replays bit-identically
+from one integer seed.
+
+Instrumented call sites are cheap probes that no-op when no plan is
+installed (one list-index + ``is None`` check):
+
+  * ``site(name)``        — control-flow faults: ``delay`` (sleep),
+    ``error`` (raise a named exception), ``die`` (kill the process, the
+    "rank dies" drill).
+  * ``mangle(name, b)``   — byte-stream faults: ``corrupt`` (deterministic
+    single-byte flip) and ``truncate`` (drop the tail) for checkpoint
+    shard writes.
+  * ``poison(name, x)``   — value faults: ``nan``/``inf``/``spike`` on a
+    scalar (loss poisoning for StepGuard drills).
+
+Site catalog (stable names, see README "Resilience"): ``store.get``,
+``store.set``, ``store.add``, ``store.barrier``, ``ckpt.shard_write``,
+``ckpt.shard_read``, ``ckpt.meta_write``, ``hc.round``, ``train.step``,
+``train.loss``.
+
+Configuration: programmatic (``install_plan(FaultPlan(...))``) or via env —
+``PADDLE_CHAOS_PLAN="store.get:error:TimeoutError@1;ckpt.shard_write:corrupt@2"``
+with ``PADDLE_CHAOS_SEED`` — parsed at import so a launcher can chaos a
+run without code changes. Each entry is ``site:kind[:arg][@hits|@p=prob]``;
+``hits`` is a comma list of 1-based per-site hit indices, ``p=`` a seeded
+per-hit probability. Faults fire at most ``site()``-call order, so the
+same plan + the same program = the same failures.
+"""
+from __future__ import annotations
+
+import fnmatch
+import os
+import random
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..profiler import instrument as _instr
+
+__all__ = [
+    "Fault", "FaultPlan", "FaultInjected", "install_plan", "clear_plan",
+    "active_plan", "enabled", "site", "mangle", "poison", "plan_from_env",
+]
+
+_CONTROL_KINDS = ("delay", "error", "die")
+_BYTE_KINDS = ("corrupt", "truncate")
+_VALUE_KINDS = ("nan", "inf", "spike")
+
+_EXCEPTIONS = {
+    "TimeoutError": TimeoutError,
+    "ConnectionError": ConnectionError,
+    "ConnectionResetError": ConnectionResetError,
+    "OSError": OSError,
+    "IOError": OSError,
+    "RuntimeError": RuntimeError,
+    "ValueError": ValueError,
+}
+
+
+class FaultInjected(RuntimeError):
+    """Default exception for ``error`` faults with no named exception."""
+
+
+class Fault:
+    """One fault rule: fire `kind` at `site` (fnmatch pattern) on the given
+    1-based hit indices (`at`) or with seeded probability `prob`."""
+
+    __slots__ = ("pattern", "kind", "arg", "at", "prob")
+
+    def __init__(self, pattern: str, kind: str, arg: Optional[str] = None,
+                 at: Optional[Sequence[int]] = None,
+                 prob: Optional[float] = None):
+        if kind not in _CONTROL_KINDS + _BYTE_KINDS + _VALUE_KINDS:
+            raise ValueError(f"unknown fault kind {kind!r}")
+        if at is None and prob is None:
+            at = (1,)  # default: fire on the first hit
+        self.pattern = pattern
+        self.kind = kind
+        self.arg = arg
+        self.at = frozenset(int(i) for i in at) if at is not None else None
+        self.prob = float(prob) if prob is not None else None
+
+    def __repr__(self):
+        when = f"@{sorted(self.at)}" if self.at is not None \
+            else f"@p={self.prob}"
+        return f"Fault({self.pattern}:{self.kind}:{self.arg}{when})"
+
+
+class FaultPlan:
+    """A seeded set of Fault rules plus per-site hit counters.
+
+    Determinism contract: with the same seed, the same rules, and the same
+    sequence of probe calls, the same faults fire at the same probes (hit
+    counters are per site; the RNG is consumed only by probabilistic rules
+    and byte mangling, in probe order)."""
+
+    def __init__(self, faults: Sequence[Fault] = (), seed: int = 0):
+        self.seed = int(seed)
+        self.faults: List[Fault] = list(faults)
+        self._rng = random.Random(self.seed)
+        self._hits: Dict[str, int] = {}
+        self._fired: List[Tuple[str, str, int]] = []  # (site, kind, hit#)
+        self._lock = threading.Lock()
+
+    # builder-style configuration -------------------------------------------
+    def add(self, pattern: str, kind: str, arg: Optional[str] = None,
+            at: Optional[Sequence[int]] = None,
+            prob: Optional[float] = None) -> "FaultPlan":
+        self.faults.append(Fault(pattern, kind, arg, at=at, prob=prob))
+        return self
+
+    # probe-side API ---------------------------------------------------------
+    def poll(self, name: str, kinds: Tuple[str, ...]) -> Optional[Fault]:
+        """Advance `name`'s hit counter and return the first matching rule
+        of one of `kinds` that fires on this hit, recording it."""
+        with self._lock:
+            n = self._hits.get(name, 0) + 1
+            self._hits[name] = n
+            for f in self.faults:
+                if f.kind not in kinds:
+                    continue
+                if not fnmatch.fnmatchcase(name, f.pattern):
+                    continue
+                if f.at is not None:
+                    if n not in f.at:
+                        continue
+                elif self._rng.random() >= f.prob:
+                    continue
+                self._fired.append((name, f.kind, n))
+                return f
+        return None
+
+    def rng(self) -> random.Random:
+        return self._rng
+
+    @property
+    def fired(self) -> List[Tuple[str, str, int]]:
+        """(site, kind, hit#) of every fault fired so far, in order."""
+        return list(self._fired)
+
+    def hit_count(self, name: str) -> int:
+        with self._lock:
+            return self._hits.get(name, 0)
+
+
+# -- the installed plan (None = chaos off; hot probes check this only) --------
+_PLAN: List[Optional[FaultPlan]] = [None]
+
+
+def install_plan(plan: Optional[FaultPlan]) -> Optional[FaultPlan]:
+    _PLAN[0] = plan
+    return plan
+
+
+def clear_plan() -> None:
+    _PLAN[0] = None
+
+
+def active_plan() -> Optional[FaultPlan]:
+    return _PLAN[0]
+
+
+def enabled() -> bool:
+    return _PLAN[0] is not None
+
+
+def _record(name: str, kind: str) -> None:
+    _instr.record_fault_injected(name, kind)
+
+
+def site(name: str) -> None:
+    """Control-flow probe: may sleep, raise, or kill this process."""
+    plan = _PLAN[0]
+    if plan is None:
+        return
+    f = plan.poll(name, _CONTROL_KINDS)
+    if f is None:
+        return
+    _record(name, f.kind)
+    if f.kind == "delay":
+        time.sleep(float(f.arg) if f.arg else 0.05)
+    elif f.kind == "error":
+        exc = _EXCEPTIONS.get(f.arg or "", FaultInjected)
+        raise exc(f"chaos: injected {f.arg or 'FaultInjected'} at "
+                  f"{name} (hit {plan.hit_count(name)})")
+    elif f.kind == "die":
+        # the "rank dies" drill: hard-exit like a preempted/OOM-killed host
+        # (no atexit, no finally blocks — that is the point)
+        os._exit(int(f.arg) if f.arg else 43)
+
+
+def mangle(name: str, data: bytes) -> bytes:
+    """Byte-stream probe: deterministic corruption/truncation of `data`."""
+    plan = _PLAN[0]
+    if plan is None or not data:
+        return data
+    f = plan.poll(name, _BYTE_KINDS)
+    if f is None:
+        return data
+    _record(name, f.kind)
+    rng = plan.rng()
+    if f.kind == "truncate":
+        keep = int(f.arg) if f.arg else max(1, len(data) // 2)
+        return data[:keep]
+    pos = int(f.arg) if f.arg else rng.randrange(len(data))
+    flipped = data[pos] ^ 0xFF
+    return data[:pos] + bytes([flipped]) + data[pos + 1:]
+
+
+def poison(name: str, value: float) -> float:
+    """Value probe: may replace a scalar with nan/inf/a spiked value."""
+    plan = _PLAN[0]
+    if plan is None:
+        return value
+    f = plan.poll(name, _VALUE_KINDS)
+    if f is None:
+        return value
+    _record(name, f.kind)
+    if f.kind == "nan":
+        return float("nan")
+    if f.kind == "inf":
+        return float("inf")
+    return value * (float(f.arg) if f.arg else 1e4)  # spike
+
+
+# -- env configuration --------------------------------------------------------
+def plan_from_env(env: Optional[Dict[str, str]] = None) -> Optional[FaultPlan]:
+    """Parse PADDLE_CHAOS_PLAN / PADDLE_CHAOS_SEED into a FaultPlan.
+
+    Grammar: entries split on ';', each ``site:kind[:arg][@spec]`` where
+    ``@spec`` is a comma list of 1-based hit indices or ``p=<float>``."""
+    e = os.environ if env is None else env
+    raw = e.get("PADDLE_CHAOS_PLAN", "").strip()
+    if not raw:
+        return None
+    plan = FaultPlan(seed=int(e.get("PADDLE_CHAOS_SEED", "0") or 0))
+    for entry in raw.split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        at = prob = None
+        if "@" in entry:
+            entry, spec = entry.rsplit("@", 1)
+            if spec.startswith("p="):
+                prob = float(spec[2:])
+            else:
+                at = [int(x) for x in spec.split(",") if x]
+        parts = entry.split(":")
+        if len(parts) < 2:
+            raise ValueError(
+                f"PADDLE_CHAOS_PLAN entry {entry!r}: want site:kind[:arg]")
+        pattern, kind = parts[0], parts[1]
+        arg = parts[2] if len(parts) > 2 else None
+        plan.add(pattern, kind, arg, at=at, prob=prob)
+    return plan
+
+
+# env-configured chaos arms itself at import so launchers can inject faults
+# into an unmodified training script
+_env_plan = plan_from_env()
+if _env_plan is not None:
+    install_plan(_env_plan)

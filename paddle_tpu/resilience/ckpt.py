@@ -1,0 +1,155 @@
+"""Checkpoint lifecycle: last-good tracking, fallback load, keep-N GC.
+
+``distributed.checkpoint`` gives one checkpoint atomic shard writes and
+crc-verified loads; this module manages a *directory of them* the way a
+long run needs: every completed save is recorded in a ``_GOOD.json``
+ledger (written atomically, coordinator only), loads walk the ledger
+newest-first and fall back past any checkpoint that fails integrity
+verification (quarantining it as ``<step>.corrupt``), and garbage
+collection keeps the newest ``keep`` good checkpoints so a run that
+saves every N steps does not eat the filesystem. Events land in
+``resilience_ckpt_events_total{event}`` (corrupt_detected / fallback /
+gc) so a dashboard can see a fleet silently burning through its
+checkpoint history.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import shutil
+from typing import List, Optional
+
+from ..distributed.checkpoint import (CheckpointCorruptionError,
+                                      load_state_dict, save_state_dict)
+from ..profiler import instrument as _instr
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["CheckpointManager", "CheckpointCorruptionError"]
+
+_GOOD_NAME = "_GOOD.json"
+
+
+class CheckpointManager:
+    """Manage step-indexed checkpoints under `root` (one subdir per step).
+
+    keep: good checkpoints retained by GC (older ones deleted after each
+    successful save). coordinator: only the coordinator rank mutates the
+    ledger/GC state — pass rank == coordinator_rank in multi-process jobs.
+    retry_policy: resilience.RetryPolicy forwarded to shard I/O.
+    """
+
+    def __init__(self, root: str, keep: int = 3, coordinator: bool = True,
+                 retry_policy=None):
+        if keep < 1:
+            raise ValueError("keep must be >= 1")
+        self.root = root
+        self.keep = int(keep)
+        self.coordinator = coordinator
+        self.retry_policy = retry_policy
+        os.makedirs(root, exist_ok=True)
+
+    # -- ledger ---------------------------------------------------------------
+    def _ledger_path(self) -> str:
+        return os.path.join(self.root, _GOOD_NAME)
+
+    def good_steps(self) -> List[int]:
+        """Completed-save steps whose directories still exist, ascending.
+        Without a ledger (e.g. pre-manager checkpoints) every step-named
+        subdir with a metadata file counts."""
+        try:
+            with open(self._ledger_path()) as f:
+                steps = [int(s) for s in json.load(f)]
+        except (FileNotFoundError, json.JSONDecodeError, ValueError):
+            steps = []
+            for name in os.listdir(self.root):
+                if name.isdigit() and os.path.exists(
+                        os.path.join(self.root, name, "metadata.json")):
+                    steps.append(int(name))
+        return sorted(s for s in set(steps)
+                      if os.path.isdir(os.path.join(self.root, str(s))))
+
+    def _write_ledger(self, steps: List[int]) -> None:
+        tmp = self._ledger_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(sorted(set(steps)), f)
+            f.flush()
+            os.fsync(f.fileno())  # a step must not be 'good' before its
+        os.replace(tmp, self._ledger_path())  # bytes are durable
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.good_steps()
+        return steps[-1] if steps else None
+
+    # -- save/load ------------------------------------------------------------
+    def save(self, state_dict, step: int, **kw):
+        """save_state_dict under root/<step>; on completion mark the step
+        good and GC beyond keep-N. Returns the writer thread for
+        async_save=True (the step is marked good only for sync saves —
+        async callers mark via mark_good() when the thread joins)."""
+        thread = save_state_dict(state_dict, self.root, unique_id=int(step),
+                                 retry_policy=self.retry_policy, **kw)
+        if thread is None:
+            self.mark_good(step)
+        return thread
+
+    def mark_good(self, step: int) -> None:
+        if not self.coordinator:
+            return
+        self._write_ledger(self.good_steps() + [int(step)])
+        self.gc()
+
+    def load_latest(self, state_dict, verify: bool = True) -> int:
+        """Load the newest good checkpoint into state_dict; on integrity
+        failure quarantine it and fall back to the next-newest. Returns
+        the step loaded; raises CheckpointCorruptionError when nothing
+        loadable remains."""
+        steps = self.good_steps()
+        tried = []
+        for step in reversed(steps):
+            try:
+                load_state_dict(state_dict, self.root, unique_id=step,
+                                verify=verify,
+                                retry_policy=self.retry_policy)
+                return step
+            except CheckpointCorruptionError as e:
+                tried.append(step)
+                _instr.record_ckpt_event("corrupt_detected")
+                logger.warning(
+                    "checkpoint %s/%s failed verification (%s); falling "
+                    "back to previous", self.root, step, e)
+                self._quarantine(step)
+                _instr.record_ckpt_event("fallback")
+        raise CheckpointCorruptionError(
+            f"no loadable checkpoint under {self.root}: "
+            f"{'corrupt steps ' + repr(tried) if tried else 'none saved'}")
+
+    # -- hygiene --------------------------------------------------------------
+    def _quarantine(self, step: int) -> None:
+        if not self.coordinator:
+            return
+        src = os.path.join(self.root, str(step))
+        dst = src + ".corrupt"
+        try:
+            if os.path.exists(dst):
+                shutil.rmtree(dst, ignore_errors=True)
+            os.rename(src, dst)
+        except OSError:  # another rank raced us; the ledger fix suffices
+            pass
+        self._write_ledger([s for s in self.good_steps() if s != step])
+
+    def gc(self) -> List[int]:
+        """Delete good checkpoints older than the newest `keep`; returns
+        the steps removed."""
+        if not self.coordinator:
+            return []
+        steps = self.good_steps()
+        doomed = steps[:-self.keep] if len(steps) > self.keep else []
+        for step in doomed:
+            shutil.rmtree(os.path.join(self.root, str(step)),
+                          ignore_errors=True)
+            _instr.record_ckpt_event("gc")
+        if doomed:
+            self._write_ledger([s for s in steps if s not in set(doomed)])
+        return doomed

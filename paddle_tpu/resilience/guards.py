@@ -1,0 +1,136 @@
+"""Step guards: NaN/inf and loss-spike detection for training loops.
+
+A single poisoned step (bad batch, numeric blow-up, flipped bit) can
+destroy hours of optimizer state if its update is applied. ``StepGuard``
+sits in ``Model.fit``/``Engine.fit`` between the forward pass and the
+update: every step's loss is checked against (a) finiteness and (b) an
+optional spike threshold relative to the median of recent healthy losses.
+The configured action per anomaly kind is
+
+  * ``"skip"``  — drop the update (grads cleared, optimizer untouched),
+  * ``"warn"``  — count and continue (the update is applied),
+  * ``"abort"`` — raise ``StepGuardAbort`` (after the optional watchdog
+    stack dump), stopping the run for a supervisor/elastic layer to
+    handle.
+
+Consecutive skips escalate to abort after ``max_consecutive_skips`` — a
+run that skips everything is not training. Events are counted in
+``resilience_guard_events_total{kind,action}`` and kept on
+``guard.events`` for tests/drills.
+"""
+from __future__ import annotations
+
+import logging
+import math
+import statistics
+from collections import deque
+from typing import Callable, Deque, List, NamedTuple, Optional
+
+from ..profiler import instrument as _instr
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["StepGuard", "StepGuardAbort", "GuardEvent"]
+
+_ACTIONS = ("skip", "warn", "abort")
+
+
+class StepGuardAbort(RuntimeError):
+    """Raised when a guard event's action is 'abort' (or skips escalate)."""
+
+
+class GuardEvent(NamedTuple):
+    step: Optional[int]
+    kind: str        # "nan" | "spike"
+    loss: float
+    action: str
+
+
+class StepGuard:
+    """Loss sanity guard; ``check(loss)`` -> "ok" | "skip" | raises.
+
+    nan_action/spike_action: one of "skip", "warn", "abort".
+    spike_factor: flag loss > spike_factor * median(recent window); None
+    disables spike detection. warmup: healthy losses required before spike
+    detection arms. dump_stacks_on_abort: reuse the watchdog's all-thread
+    stack dump so an abort leaves the same forensics as a hang.
+    """
+
+    def __init__(self, nan_action: str = "skip",
+                 spike_action: str = "warn",
+                 spike_factor: Optional[float] = None,
+                 window: int = 32, warmup: int = 5,
+                 max_consecutive_skips: int = 10,
+                 dump_stacks_on_abort: bool = False,
+                 on_abort: Optional[Callable[["GuardEvent"], None]] = None):
+        for a in (nan_action, spike_action):
+            if a not in _ACTIONS:
+                raise ValueError(f"action {a!r} not in {_ACTIONS}")
+        self.nan_action = nan_action
+        self.spike_action = spike_action
+        self.spike_factor = spike_factor
+        self.warmup = int(warmup)
+        self.max_consecutive_skips = int(max_consecutive_skips)
+        self.dump_stacks_on_abort = dump_stacks_on_abort
+        self.on_abort = on_abort
+        self._recent: Deque[float] = deque(maxlen=int(window))
+        self._consecutive_skips = 0
+        self.events: List[GuardEvent] = []
+        self.last_decision = "ok"  # decision of the most recent check()
+
+    # -- classification -------------------------------------------------------
+    def _classify(self, loss: float) -> Optional[str]:
+        if not math.isfinite(loss):
+            return "nan"
+        if self.spike_factor is not None and \
+                len(self._recent) >= self.warmup:
+            med = statistics.median(self._recent)
+            if med > 0 and loss > self.spike_factor * med:
+                return "spike"
+        return None
+
+    def check(self, loss: float, step: Optional[int] = None) -> str:
+        """Classify one step's loss. Returns "ok" or "skip"; raises
+        StepGuardAbort for abort-class events."""
+        kind = self._classify(float(loss))
+        if kind is None:
+            self._recent.append(float(loss))
+            self._consecutive_skips = 0
+            self.last_decision = "ok"
+            return "ok"
+        action = self.nan_action if kind == "nan" else self.spike_action
+        ev = GuardEvent(step, kind, float(loss), action)
+        self.events.append(ev)
+        _instr.record_guard_event(kind, action)
+        logger.warning("StepGuard: %s loss %r at step %s -> %s",
+                       kind, loss, step, action)
+        if action == "skip":
+            self._consecutive_skips += 1
+            self.last_decision = "skip"
+            if self._consecutive_skips > self.max_consecutive_skips:
+                ev = GuardEvent(step, kind, float(loss), "abort")
+                self.events.append(ev)
+                _instr.record_guard_event(kind, "abort")
+                self._abort(ev, f"{self._consecutive_skips} consecutive "
+                                "skipped steps")
+            return "skip"
+        if action == "abort":
+            self._abort(ev, f"{kind} loss {loss!r}")
+        self.last_decision = "ok"
+        return "ok"  # "warn": counted above, update proceeds
+
+    def _abort(self, ev: GuardEvent, why: str) -> None:
+        if self.dump_stacks_on_abort:
+            from ..distributed.watchdog import _dump_stacks
+            _dump_stacks()
+        if self.on_abort is not None:
+            self.on_abort(ev)
+        raise StepGuardAbort(
+            f"StepGuard abort at step {ev.step}: {why}")
+
+    # -- introspection --------------------------------------------------------
+    def counts(self) -> dict:
+        out: dict = {}
+        for ev in self.events:
+            out[(ev.kind, ev.action)] = out.get((ev.kind, ev.action), 0) + 1
+        return out

@@ -1,0 +1,117 @@
+"""Retry/backoff policy engine for transient control-plane failures.
+
+Store RPCs, checkpoint shard I/O, and host-collective rounds all talk to
+infrastructure that *will* flake over a long multi-host run. A
+``RetryPolicy`` bounds how hard a call site fights back: capped
+exponential backoff with seeded jitter, an attempt ceiling, an optional
+wall-clock deadline, and a retryable-exception predicate (retrying a
+``ValueError`` would mask bugs; retrying a ``TimeoutError`` is the whole
+point). Every retry and give-up is counted through the PR-1 metrics
+catalog (``resilience_retries_total{site}`` /
+``resilience_giveups_total{site}``) so dashboards see flake rates, and
+jitter is drawn from a per-policy seeded RNG so chaos drills replay
+deterministically.
+"""
+from __future__ import annotations
+
+import functools
+import random
+import time
+from typing import Callable, Optional, Tuple, Type
+
+from ..profiler import instrument as _instr
+
+__all__ = ["RetryPolicy", "retrying", "policy_from_env"]
+
+_DEFAULT_RETRYABLE: Tuple[Type[BaseException], ...] = (
+    TimeoutError, ConnectionError, OSError)
+
+
+class RetryPolicy:
+    """max_attempts total tries; sleep base_delay * multiplier**k (capped at
+    max_delay) plus uniform jitter between tries; optionally give up early
+    when the next sleep would cross `deadline` wall seconds."""
+
+    def __init__(self, max_attempts: int = 3, base_delay: float = 0.05,
+                 max_delay: float = 2.0, multiplier: float = 2.0,
+                 jitter: float = 0.5,
+                 deadline: Optional[float] = None,
+                 retryable: Tuple[Type[BaseException], ...] =
+                 _DEFAULT_RETRYABLE,
+                 seed: Optional[int] = None):
+        if max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        self.max_attempts = int(max_attempts)
+        self.base_delay = float(base_delay)
+        self.max_delay = float(max_delay)
+        self.multiplier = float(multiplier)
+        self.jitter = float(jitter)
+        self.deadline = deadline
+        self.retryable = tuple(retryable)
+        self._rng = random.Random(seed)
+
+    def is_retryable(self, exc: BaseException) -> bool:
+        return isinstance(exc, self.retryable)
+
+    def backoff(self, attempt: int) -> float:
+        """Sleep before try `attempt`+1 (attempt is 0-based try index)."""
+        d = min(self.base_delay * (self.multiplier ** attempt),
+                self.max_delay)
+        return d * (1.0 + self.jitter * self._rng.random())
+
+    def run(self, fn: Callable, *args, site: str = "", **kwargs):
+        """Call fn until it returns, a non-retryable exception escapes, the
+        attempt budget is spent, or the deadline would be crossed."""
+        start = time.monotonic()
+        for attempt in range(self.max_attempts):
+            try:
+                return fn(*args, **kwargs)
+            except self.retryable as exc:
+                delay = self.backoff(attempt)
+                out_of_tries = attempt + 1 >= self.max_attempts
+                out_of_time = self.deadline is not None and \
+                    (time.monotonic() - start) + delay > self.deadline
+                if out_of_tries or out_of_time:
+                    _instr.record_resilience_giveup(site or "unnamed")
+                    raise
+                _instr.record_resilience_retry(site or "unnamed")
+                time.sleep(delay)
+        raise AssertionError("unreachable")  # loop always returns or raises
+
+
+def retrying(policy: Optional[RetryPolicy], site: str = ""):
+    """Decorator form; a None policy decorates to the bare function."""
+    def deco(fn):
+        if policy is None:
+            return fn
+
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            return policy.run(fn, *a, site=site or fn.__name__, **k)
+        return wrapper
+    return deco
+
+
+def policy_from_env(prefix: str = "PADDLE_RETRY_") -> Optional[RetryPolicy]:
+    """Build a policy from <prefix>MAX_ATTEMPTS / BASE_DELAY / MAX_DELAY /
+    DEADLINE / SEED env knobs; None when MAX_ATTEMPTS is unset/<=1."""
+    import os
+    raw = os.environ.get(prefix + "MAX_ATTEMPTS", "").strip()
+    if not raw:
+        return None
+    attempts = int(raw)
+    if attempts <= 1:
+        return None
+
+    def _f(name, default):
+        v = os.environ.get(prefix + name, "").strip()
+        return float(v) if v else default
+
+    seed_raw = os.environ.get(prefix + "SEED", "").strip()
+    deadline_raw = os.environ.get(prefix + "DEADLINE", "").strip()
+    return RetryPolicy(
+        max_attempts=attempts,
+        base_delay=_f("BASE_DELAY", 0.05),
+        max_delay=_f("MAX_DELAY", 2.0),
+        deadline=float(deadline_raw) if deadline_raw else None,
+        seed=int(seed_raw) if seed_raw else None)

@@ -1,0 +1,53 @@
+"""Version-guarded JAX API shims.
+
+The repo targets the current JAX API surface but must run on older
+point releases shipped in CI images. Each symbol resolves once at import
+time to whatever spelling the installed JAX provides; call sites import
+from this module instead of guessing.
+
+``shard_map``: promoted to ``jax.shard_map`` in newer JAX; on 0.4.x it
+lives at ``jax.experimental.shard_map.shard_map`` with the older kwarg
+spellings (``check_rep`` for ``check_vma``; manual axes are expressed as
+the ``auto`` complement instead of ``axis_names``). The wrapper below
+accepts the NEW spellings everywhere and translates when running on the
+old API, so call sites are written once against current JAX.
+"""
+from __future__ import annotations
+
+import jax
+
+try:
+    shard_map = jax.shard_map  # promoted spelling (new JAX)
+except AttributeError:
+    from jax.experimental.shard_map import shard_map as _old_shard_map
+
+    def shard_map(f, mesh, in_specs, out_specs, check_vma=True,
+                  axis_names=None, **kw):
+        # `axis_names` (partial-manual) maps to `auto=<complement>` on
+        # 0.4.x, but that lowering is broken there on the CPU backend
+        # (XLA aborts on manual-subgroup collectives). Since our bodies
+        # only issue collectives over the named axes, full-manual is
+        # numerically equivalent: axes absent from the specs behave as
+        # replicated (callers pass check_vma=False), at worst paying an
+        # extra gather at the region boundary on this legacy path.
+        return _old_shard_map(f, mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=check_vma)
+
+try:
+    axis_size = jax.lax.axis_size  # new JAX
+except AttributeError:
+    def axis_size(axis_name):
+        # psum of a Python-int constant folds to a static int under a
+        # manual (shard_map) trace — the pre-promotion idiom
+        return jax.lax.psum(1, axis_name)
+
+
+def tpu_compiler_params(**kw):
+    """pltpu.CompilerParams on new JAX, TPUCompilerParams on 0.4.x
+    (same fields — the class was renamed when Pallas-TPU stabilized)."""
+    from jax.experimental.pallas import tpu as pltpu
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(**kw)
+
+
+__all__ = ["shard_map", "axis_size", "tpu_compiler_params"]

@@ -52,6 +52,7 @@ def test_traced_prod_allreduce():
 
     import paddle_tpu.distributed as dist
     from paddle_tpu.tensor import Tensor
+    from paddle_tpu.utils.jax_compat import shard_map
 
     devs = np.array(jax.devices("cpu")[:4])
     mesh = Mesh(devs, ("x",))
@@ -63,7 +64,7 @@ def test_traced_prod_allreduce():
         return t._data[None]
 
     x = jnp.asarray(np.array([[-2.0], [3.0], [-4.0], [5.0]], np.float32))
-    out = jax.shard_map(body, mesh=mesh, in_specs=PartitionSpec("x"),
+    out = shard_map(body, mesh=mesh, in_specs=PartitionSpec("x"),
                         out_specs=PartitionSpec("x"))(x)
     np.testing.assert_allclose(np.asarray(out),
                                np.full((4, 1), 120.0, np.float32))

@@ -12,6 +12,7 @@ import numpy as np
 import pytest
 
 import jax
+from paddle_tpu.utils.jax_compat import shard_map
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
@@ -32,10 +33,10 @@ def mesh4():
 
 def _shard_oracle(dev_fn, oracle_fn, mesh, x_spec, w_spec, y_spec, x, w):
     jmesh = mesh.to_jax()
-    got = jax.jit(jax.shard_map(dev_fn, mesh=jmesh, in_specs=(x_spec, w_spec),
+    got = jax.jit(shard_map(dev_fn, mesh=jmesh, in_specs=(x_spec, w_spec),
                                 out_specs=y_spec, axis_names={"mp"},
                                 check_vma=False))(x, w)
-    want = jax.jit(jax.shard_map(oracle_fn, mesh=jmesh,
+    want = jax.jit(shard_map(oracle_fn, mesh=jmesh,
                                  in_specs=(x_spec, w_spec),
                                  out_specs=y_spec, axis_names={"mp"},
                                  check_vma=False))(x, w)
