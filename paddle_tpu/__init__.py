@@ -121,6 +121,7 @@ from . import quantization  # noqa: F401
 from . import kernels  # noqa: F401  (registers kernel flags, e.g. autotune)
 from . import hapi  # noqa: F401
 from . import resilience  # noqa: F401
+from . import analysis  # noqa: F401
 from .hapi import Model, flops, summary  # noqa: F401
 from . import callbacks  # noqa: F401
 from . import hub  # noqa: F401

@@ -1,0 +1,50 @@
+"""Static analysis + trace sanitation: catch TPU sharp bits before a run.
+
+Two complementary passes (driven together by ``tools/lint.py``):
+
+* ``analysis.astlint`` / ``analysis.rules`` — an AST linter for the
+  framework's machine-checkable invariants: raw ``jax.shard_map`` /
+  ``lax.axis_size`` / Pallas ``CompilerParams`` spellings that bypass the
+  ``utils/jax_compat`` version shims (PR 2's 32-failure bug class),
+  wall-clock/unseeded-random reads inside chaos-probed or jit-traced
+  regions, metric names missing from the ``profiler.instrument`` catalog,
+  unknown chaos probe sites, broad excepts that can swallow
+  ``CheckpointCorruptionError``, and mutable default args in
+  constructors. Rules carry stable ids, severities and fix hints;
+  ``# tpu-lint: disable=<ID>`` suppresses per line and is itself checked.
+* ``analysis.tracecheck`` — dynamic: traces a step function and flags
+  recompile hazards (scalar closures, Python branches on tracers,
+  empirical retrace on same-shape inputs), host round-trips inside the
+  step, donated buffers no output can reuse, and — with per-rank
+  schedules captured by ``analysis.schedule`` — cross-rank collective
+  order divergence.
+
+The linter half is stdlib-only; the trace half needs JAX and loads
+lazily, so ``import paddle_tpu.analysis`` stays cheap for editors and CI.
+"""
+from __future__ import annotations
+
+from . import schedule  # noqa: F401  (stdlib-only)
+from .astlint import (iter_python_files, lint_file, lint_paths,  # noqa: F401
+                      lint_source)
+from .rules import (RULES, Finding, get_rule,  # noqa: F401
+                    load_chaos_sites, load_metric_catalog, rule_table)
+
+__all__ = [
+    "Finding", "RULES", "get_rule", "rule_table",
+    "lint_source", "lint_file", "lint_paths", "iter_python_files",
+    "load_chaos_sites", "load_metric_catalog",
+    "schedule", "trace_check", "check_collective_schedules", "TRACE_RULES",
+]
+
+_LAZY = {"trace_check", "check_collective_schedules", "TRACE_RULES"}
+
+
+def __getattr__(name):  # tracecheck imports jax; defer until first use
+    if name in _LAZY or name == "tracecheck":
+        # importlib, NOT `from . import ...`: the latter re-enters this
+        # __getattr__ through _handle_fromlist and recurses
+        import importlib
+        mod = importlib.import_module(".tracecheck", __name__)
+        return mod if name == "tracecheck" else getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

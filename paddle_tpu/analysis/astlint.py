@@ -1,0 +1,123 @@
+"""AST linter: run the rule registry over framework (and user) source.
+
+Stdlib-only by design — no jax import, no paddle_tpu import — so the CI
+driver lints a broken tree in well under the 30 s budget and editors can
+call ``lint_source`` per keystroke.
+
+Scope semantics: files under the ``paddle_tpu`` package are *framework*
+files and get every rule; anything else (user scripts, examples, tests)
+gets only the rules that encode portable invariants (version-shim
+bypasses, exception hygiene). Rules may exempt specific path suffixes —
+``utils/jax_compat.py`` is the one place allowed to spell raw JAX API.
+
+Suppression: ``# tpu-lint: disable=TPU101`` (comma-separated ids) on the
+offending line suppresses those findings for that line only. Unknown ids
+in a disable comment are themselves reported (TPU000) — a suppression
+that cannot mean anything is a typo hiding a real finding.
+"""
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+from .rules import Finding, FileContext, RULES
+
+__all__ = ["lint_source", "lint_file", "lint_paths", "iter_python_files"]
+
+_SUPPRESS_RE = re.compile(r"#\s*tpu-lint:\s*disable=([A-Za-z0-9_,\s]+)")
+
+
+def _suppressions(source: str, path: str):
+    """({line: set(ids)}, [TPU000 findings for unknown ids]).
+
+    Tokenize-based: only real COMMENT tokens count, so lint fixtures and
+    docs quoting the syntax inside string literals are not suppressions."""
+    by_line: Dict[int, Set[str]] = {}
+    bad: List[Finding] = []
+    try:
+        tokens = [(t.start[0], t.start[1], t.string) for t in
+                  tokenize.generate_tokens(io.StringIO(source).readline)
+                  if t.type == tokenize.COMMENT]
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        tokens = []
+    for line, col, text in tokens:
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        ids = {s.strip() for s in m.group(1).split(",") if s.strip()}
+        unknown = sorted(ids - set(RULES))
+        for u in unknown:
+            bad.append(Finding(
+                "TPU000", path, line, col,
+                f"suppression names unknown rule {u!r}",
+                "valid ids: " + ", ".join(sorted(RULES)), "error"))
+        by_line[line] = by_line.get(line, set()) | (ids & set(RULES))
+    return by_line, bad
+
+
+def _is_framework_path(path: str) -> bool:
+    norm = os.path.abspath(path).replace(os.sep, "/")
+    return "/paddle_tpu/" in norm
+
+
+def lint_source(source: str, path: str = "<string>",
+                is_framework: Optional[bool] = None,
+                rules: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Lint one source blob. ``rules`` restricts to the given ids."""
+    if is_framework is None:
+        is_framework = _is_framework_path(path)
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding("TPU000", path, e.lineno or 0, e.offset or 0,
+                        f"syntax error: {e.msg}", "", "error")]
+    ctx = FileContext(path, source, tree, is_framework)
+    suppress, findings = _suppressions(source, path)
+    norm = os.path.abspath(path).replace(os.sep, "/")
+    for rule in RULES.values():
+        if rules is not None and rule.id not in rules:
+            continue
+        if rule.framework_only and not is_framework:
+            continue
+        if any(norm.endswith(suf) for suf in rule.exempt_suffixes):
+            continue
+        for f in rule.check(ctx):
+            if rule.id not in suppress.get(f.line, ()):
+                findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def lint_file(path: str, **kw) -> List[Finding]:
+    with open(path, "r", encoding="utf-8") as f:
+        return lint_source(f.read(), path, **kw)
+
+
+_SKIP_DIRS = {"__pycache__", ".git", ".xla_cache", "build", "dist",
+              "node_modules", ".venv"}
+
+
+def iter_python_files(paths: Iterable[str]) -> Iterable[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(d for d in dirs if d not in _SKIP_DIRS)
+            for name in sorted(files):
+                if name.endswith(".py"):
+                    yield os.path.join(root, name)
+
+
+def lint_paths(paths: Iterable[str],
+               rules: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Lint every .py file under the given files/directories."""
+    findings: List[Finding] = []
+    for f in iter_python_files(paths):
+        findings.extend(lint_file(f, rules=rules))
+    return findings

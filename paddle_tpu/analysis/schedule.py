@@ -1,0 +1,117 @@
+"""Per-rank collective-schedule recording for the cross-rank order checker.
+
+Cross-rank collective divergence (rank 0 enters an all_reduce while rank 1
+sits in a barrier) is the classic whole-pod-hour bug: nothing crashes, the
+job just stops. The store-routed host collectives and the compiled-path
+entry points in ``distributed/communication.py`` already funnel through a
+handful of choke points; this module gives those choke points one cheap
+hook (a single list-index check when disabled, exactly like the chaos
+probes) that appends ``(op, detail)`` events to a per-rank log.
+
+Arm it programmatically (``start_recording()``) or via env
+(``PADDLE_SCHEDULE_LOG=<dir>``) so a launcher can capture a whole
+multi-process run without code changes: each rank appends JSONL to
+``<dir>/schedule_rank<k>.jsonl``, line-flushed so a deadlocked or killed
+rank still leaves its prefix on disk — which is precisely the evidence the
+checker (``analysis.tracecheck.check_collective_schedules``) needs.
+
+Stdlib-only: importable from the distributed layer without cycles.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["ScheduleRecorder", "start_recording", "stop_recording",
+           "recording", "record", "load_schedules"]
+
+
+class ScheduleRecorder:
+    """Records collective events for one rank; optionally mirrors each
+    event to a line-flushed JSONL file (truncated per run — stale events
+    from a previous run would read as bogus divergence).
+
+    keep_in_memory=False drops the in-process list (the env-armed
+    whole-run capture writes potentially millions of events that only
+    the file consumer reads — an unbounded list would leak for days)."""
+
+    def __init__(self, rank: int = 0, path: Optional[str] = None,
+                 keep_in_memory: bool = True):
+        self.rank = int(rank)
+        self.path = path
+        self.keep_in_memory = keep_in_memory
+        self.events: List[Tuple[str, str]] = []
+        self._fh = open(path, "w", buffering=1) if path else None
+
+    def record(self, op: str, detail: str = "") -> None:
+        if self.keep_in_memory:
+            self.events.append((op, detail))
+        if self._fh is not None:
+            self._fh.write(json.dumps({"op": op, "detail": detail}) + "\n")
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+
+# hot-path cell: call sites check `_REC[0] is not None` and nothing else
+_REC: List[Optional[ScheduleRecorder]] = [None]
+
+
+def start_recording(rank: int = 0, path: Optional[str] = None,
+                    keep_in_memory: bool = True) -> ScheduleRecorder:
+    rec = ScheduleRecorder(rank, path, keep_in_memory=keep_in_memory)
+    _REC[0] = rec
+    return rec
+
+
+def stop_recording() -> List[Tuple[str, str]]:
+    """Disarm and return the recorded events."""
+    rec, _REC[0] = _REC[0], None
+    if rec is None:
+        return []
+    rec.close()
+    return rec.events
+
+
+def recording() -> bool:
+    return _REC[0] is not None
+
+
+def record(op: str, detail: str = "") -> None:
+    """Instrumented-call-site hook (no-op unless armed)."""
+    rec = _REC[0]
+    if rec is not None:
+        rec.record(op, detail)
+
+
+def load_schedules(directory: str) -> Dict[int, List[Tuple[str, str]]]:
+    """{rank: [(op, detail)]} from a directory of per-rank JSONL logs."""
+    out: Dict[int, List[Tuple[str, str]]] = {}
+    for name in sorted(os.listdir(directory)):
+        if not (name.startswith("schedule_rank") and
+                name.endswith(".jsonl")):
+            continue
+        rank = int(name[len("schedule_rank"):-len(".jsonl")])
+        events = []
+        with open(os.path.join(directory, name)) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    d = json.loads(line)
+                    events.append((d["op"], d.get("detail", "")))
+        out[rank] = events
+    return out
+
+
+# env-armed recording so a launcher can capture an unmodified script
+_log_dir = os.environ.get("PADDLE_SCHEDULE_LOG", "").strip()
+if _log_dir:
+    os.makedirs(_log_dir, exist_ok=True)
+    _rank = int(os.environ.get("PADDLE_TRAINER_ID",
+                               os.environ.get("RANK", "0")) or 0)
+    start_recording(_rank, os.path.join(_log_dir,
+                                        f"schedule_rank{_rank}.jsonl"),
+                    keep_in_memory=False)

@@ -1,0 +1,332 @@
+"""Trace sanitizer: dynamic checks for the sharp bits AST linting cannot see.
+
+``trace_check(fn, args)`` traces a step function the way ``jax.jit`` would
+and reports the hazards that burn TPU pod-hours at runtime:
+
+* **Recompile hazards** — Python scalars closed over by the function
+  (baked into the trace as weak-typed constants: every rebuilt closure
+  retraces and recompiles), Python branches on traced values, and traced
+  values forced into static positions (shapes, range bounds). An
+  empirical retrace probe also jits the function twice with perturbed
+  same-shape inputs and flags compile-cache growth.
+* **Host round-trips** — ``.item()`` / ``float()`` / implicit numpy
+  conversion inside the step: each one is a device->host sync that
+  serializes the pipeline.
+* **Donated-buffer misuse** — ``donate_argnums`` entries whose shape and
+  dtype match no output, so XLA silently drops the donation (the memory
+  saving the caller is counting on never happens).
+
+``check_collective_schedules`` is the cross-rank half: given per-rank
+collective sequences recorded by ``analysis.schedule`` (hooked into
+``distributed/communication.py``, ``host_collectives.py`` and
+``store.barrier``), it reports the first point where ranks disagree on
+which collective comes next — the divergent/deadlocking schedule bug —
+and count mismatches where some ranks keep issuing collectives after
+others stopped.
+
+Findings reuse the linter's ``Finding`` shape so ``tools/lint.py`` can
+report both passes uniformly.
+"""
+from __future__ import annotations
+
+import inspect
+import traceback
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple, Union
+
+import jax
+
+from .rules import Finding
+
+__all__ = ["trace_check", "check_collective_schedules", "TRACE_RULES"]
+
+# id -> (name, hint) — mirrored in tools/lint.py --fix-hints and README
+TRACE_RULES = {
+    "TRC101": ("scalar-closure",
+               "pass the value as a traced argument (or fold it into the "
+               "pytree of parameters) instead of closing over it — every "
+               "closure rebuild bakes a new weak-typed constant and "
+               "recompiles"),
+    "TRC102": ("python-branch-on-tracer",
+               "replace Python `if`/`int()` on traced values with "
+               "jnp.where / lax.cond / lax.switch, or hoist the decision "
+               "out of the jitted region as a static argument"),
+    "TRC103": ("host-sync-in-step",
+               "keep .item()/float()/np.asarray() out of the step "
+               "function; return the value and read it outside jit (or "
+               "log asynchronously every N steps)"),
+    "TRC104": ("donation-unused",
+               "donate only buffers an output can alias (same shape and "
+               "dtype, e.g. params -> new params); XLA silently ignores "
+               "unusable donations and the expected memory saving never "
+               "happens"),
+    "TRC105": ("retrace-on-same-shapes",
+               "the function retraced on a second call with identical "
+               "shapes/dtypes — hunt for value-dependent Python control "
+               "flow, fresh closures, or non-array arguments changing "
+               "between calls"),
+    "TRC201": ("collective-order-divergence",
+               "all ranks must issue the same collective sequence; gate "
+               "rank-dependent work so it cannot reorder or skip "
+               "collectives (e.g. coordinator-only code must not call "
+               "collectives other ranks do not)"),
+    "TRC202": ("collective-count-mismatch",
+               "some ranks issue more collectives than others — the "
+               "extras will block forever; make every rank run the same "
+               "number of rounds (loop bounds and early exits must be "
+               "rank-invariant)"),
+}
+
+
+def _f(rule: str, where: str, line: int, message: str,
+       severity: str = "error") -> Finding:
+    name, hint = TRACE_RULES[rule]
+    return Finding(rule, where, line, 0, message, hint, severity)
+
+
+# -- Tensor <-> array plumbing (duck-typed: no framework import needed) -------
+def _is_tensor(x) -> bool:
+    return type(x).__name__ == "Tensor" and hasattr(x, "_data")
+
+
+def _unwrap(x):
+    if _is_tensor(x):
+        return x._data
+    if isinstance(x, (list, tuple)):
+        return type(x)(_unwrap(v) for v in x)
+    if isinstance(x, dict):
+        return {k: _unwrap(v) for k, v in x.items()}
+    return x
+
+
+def _rewrap_like(template, x):
+    if _is_tensor(template):
+        return type(template)(x)
+    if isinstance(template, (list, tuple)):
+        return type(template)(_rewrap_like(t, v)
+                              for t, v in zip(template, x))
+    if isinstance(template, dict):
+        return {k: _rewrap_like(template[k], x[k]) for k in template}
+    return x
+
+
+def _perturb_scalars(x):
+    """Same structure, same avals, different Python-scalar values — what a
+    second training step looks like to the compile cache."""
+    if isinstance(x, bool):
+        return x
+    if isinstance(x, int):
+        return x + 1
+    if isinstance(x, float):
+        return x + 1.0
+    if isinstance(x, (list, tuple)):
+        return type(x)(_perturb_scalars(v) for v in x)
+    if isinstance(x, dict):
+        return {k: _perturb_scalars(v) for k, v in x.items()}
+    return x
+
+
+def _fn_label(fn) -> str:
+    return getattr(fn, "__qualname__", None) or getattr(
+        fn, "__name__", None) or repr(fn)
+
+
+def _user_line(fn, exc) -> int:
+    """Best-effort source line of `fn` where the trace blew up."""
+    try:
+        src_file = inspect.getsourcefile(fn)
+    except TypeError:
+        src_file = None
+    line = 0
+    for frame in traceback.extract_tb(exc.__traceback__):
+        if src_file and frame.filename == src_file:
+            line = frame.lineno or line
+    if not line:
+        try:
+            line = inspect.getsourcelines(fn)[1]
+        except (OSError, TypeError):
+            line = 0
+    return line
+
+
+def _scalar_closures(fn) -> List[Tuple[str, object]]:
+    try:
+        cv = inspect.getclosurevars(fn)
+    except TypeError:
+        return []
+    return [(name, val) for name, val in sorted(cv.nonlocals.items())
+            if isinstance(val, (bool, int, float))]
+
+
+def _leaf_avals(tree) -> List[Tuple[Tuple[int, ...], str]]:
+    out = []
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            out.append((tuple(leaf.shape), str(leaf.dtype)))
+        elif isinstance(leaf, (bool, int, float, complex)):
+            out.append(((), type(leaf).__name__))
+    return out
+
+
+def trace_check(fn, args: Sequence = (), kwargs: Optional[dict] = None,
+                *, donate_argnums: Sequence[int] = (),
+                label: Optional[str] = None,
+                check_retrace: bool = True) -> List[Finding]:
+    """Trace `fn(*args, **kwargs)` and report TPU sharp bits as findings.
+
+    ``check_retrace=True`` additionally jits and RUNS the function twice
+    (second time with perturbed Python-scalar values), so pass example
+    args that are cheap to execute.
+    """
+    kwargs = dict(kwargs or {})
+    where = label or _fn_label(fn)
+    findings: List[Finding] = []
+
+    for name, val in _scalar_closures(fn):
+        findings.append(_f(
+            "TRC101", where, 0,
+            f"closes over Python scalar {name}={val!r}: baked into the "
+            "trace as a weak-typed constant — a rebuilt closure with a "
+            "new value recompiles"))
+
+    arr_args = _unwrap(list(args))
+    arr_kwargs = _unwrap(kwargs)
+
+    def wrapped(*a, **k):
+        out = fn(*_rewrap_like(list(args), list(a)),
+                 **_rewrap_like(kwargs, k))
+        return _unwrap(out)
+
+    bool_err = getattr(jax.errors, "TracerBoolConversionError", ())
+    int_err = getattr(jax.errors, "TracerIntegerConversionError", ())
+    arr_err = getattr(jax.errors, "TracerArrayConversionError", ())
+    conc_err = jax.errors.ConcretizationTypeError
+    closed = None
+    try:
+        closed = jax.make_jaxpr(wrapped)(*arr_args, **arr_kwargs)
+    except bool_err as e:
+        findings.append(_f("TRC102", where, _user_line(fn, e),
+                           "Python branch on a traced value (if/while on "
+                           "tracer): the branch cannot be staged and "
+                           "value-dependent variants each retrace"))
+    except int_err as e:
+        findings.append(_f("TRC102", where, _user_line(fn, e),
+                           "traced value forced to a Python int (shape/"
+                           "index/range position): every distinct value "
+                           "would need its own compile"))
+    except arr_err as e:
+        findings.append(_f("TRC103", where, _user_line(fn, e),
+                           "implicit device->host conversion of a traced "
+                           "value (np.asarray/np.float64-style): a sync "
+                           "inside the step"))
+    except conc_err as e:
+        # the generic concretization error covers both host conversions
+        # (float()/bool()/.item()) and traced values forced into static
+        # shape/size positions (jnp.arange bound, reshape dim via int());
+        # JAX names the offending function in the message
+        msg = str(e)
+        if any(s in msg for s in ("`float` function", "`bool` function",
+                                  "item() method", "tolist", "numpy")):
+            findings.append(_f(
+                "TRC103", where, _user_line(fn, e),
+                ".item()/float()/bool() on a traced value: a "
+                "device->host round-trip inside the step"))
+        else:
+            findings.append(_f(
+                "TRC102", where, _user_line(fn, e),
+                "traced value used in a static (shape/size) position: "
+                "every distinct value would need its own compile"))
+
+    if closed is not None and donate_argnums:
+        out_avals = _leaf_avals([getattr(v, "aval", v)
+                                 for v in closed.jaxpr.outvars])
+        budget: Dict[Tuple, int] = {}
+        for aval in out_avals:
+            budget[aval] = budget.get(aval, 0) + 1
+        for i in donate_argnums:
+            if i >= len(args):
+                continue
+            for aval in _leaf_avals(arr_args[i]):
+                if budget.get(aval, 0) > 0:
+                    budget[aval] -= 1
+                else:
+                    shape, dtype = aval
+                    findings.append(_f(
+                        "TRC104", where, 0,
+                        f"donated arg {i} has a {dtype}{list(shape)} "
+                        "buffer no output can reuse: XLA drops the "
+                        "donation silently"))
+
+    if closed is not None and check_retrace:
+        jitted = jax.jit(wrapped)
+        cache_size = getattr(jitted, "_cache_size", None)
+        if callable(cache_size):
+            try:
+                jitted(*arr_args, **arr_kwargs)
+                n1 = cache_size()
+                jitted(*_perturb_scalars(arr_args),
+                       **_perturb_scalars(arr_kwargs))
+                n2 = cache_size()
+            except Exception:  # execution failure ≠ a trace hazard
+                n1 = n2 = 0
+            if n2 > n1:
+                findings.append(_f(
+                    "TRC105", where, 0,
+                    "retraced on a second call with identical shapes and "
+                    "dtypes: the step will recompile every iteration"))
+
+    return findings
+
+
+# -- cross-rank collective order ----------------------------------------------
+Event = Union[str, Tuple[str, str]]
+
+
+def _render(ev: Event) -> str:
+    if isinstance(ev, str):
+        return ev
+    op, detail = ev
+    return f"{op}({detail})" if detail else op
+
+
+def _group(d: Mapping[int, str]) -> str:
+    """'ranks [0, 2]: all_reduce | rank [1]: barrier' — grouped by op."""
+    by_op: Dict[str, List[int]] = {}
+    for rank, op in sorted(d.items()):
+        by_op.setdefault(op, []).append(rank)
+    return " | ".join(f"rank{'s' if len(r) > 1 else ''} {r}: {op}"
+                      for op, r in sorted(by_op.items(),
+                                          key=lambda kv: kv[1]))
+
+
+def check_collective_schedules(
+        schedules: Mapping[int, Sequence[Event]]) -> List[Finding]:
+    """Compare per-rank collective sequences; report the first divergence.
+
+    `schedules`: {rank: sequence of events}, each event an op string or an
+    (op, detail) tuple — the shapes ``analysis.schedule`` records and
+    ``load_schedules`` returns. Returns [] when every rank agrees.
+    """
+    if len(schedules) < 2:
+        return []
+    rendered = {r: [_render(e) for e in evs]
+                for r, evs in schedules.items()}
+    where = "<collective-schedule>"
+    n_max = max(len(v) for v in rendered.values())
+    for i in range(n_max):
+        present = {r: evs[i] for r, evs in rendered.items()
+                   if i < len(evs)}
+        done = sorted(set(rendered) - set(present))
+        if done:
+            return [_f(
+                "TRC202", where, i + 1,
+                f"collective count mismatch at event {i + 1}: "
+                f"rank{'s' if len(done) > 1 else ''} {done} recorded no "
+                f"more events while {_group(present)} — the extra "
+                "collective(s) will wait forever")]
+        if len(set(present.values())) > 1:
+            return [_f(
+                "TRC201", where, i + 1,
+                f"collective schedules diverge at event {i + 1}: "
+                f"{_group(present)} — ranks posting different "
+                "collectives deadlock")]
+    return []

@@ -273,9 +273,11 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
                 # multi-host file systems are shared for checkpoints)
                 expect = [os.path.join(path, f"meta_{r}.json")
                           for r in range(nprocs)]
-                deadline = time.time() + barrier_timeout
+                # monotonic, not wall clock: this runs in a chaos-probed
+                # region and an NTP step would skew the seeded replay
+                deadline = time.monotonic() + barrier_timeout
                 while not all(os.path.exists(p) for p in expect):
-                    if time.time() > deadline:
+                    if time.monotonic() > deadline:
                         missing = [p for p in expect
                                    if not os.path.exists(p)]
                         raise TimeoutError(

@@ -25,6 +25,7 @@ import numpy as np
 from jax import lax
 
 from .. import profiler as _prof
+from ..analysis import schedule as _sched
 from ..profiler import instrument as _instr
 from ..utils.jax_compat import axis_size as _axis_size
 from ..tensor import Tensor
@@ -123,6 +124,8 @@ def _instrumented(op_name, extract):
     def deco(fn):
         @functools.wraps(fn)
         def wrapper(*args, **kwargs):
+            if _sched._REC[0] is not None:  # collective-order recorder
+                _sched.record(op_name)
             if not (_instr._enabled[0] or _prof._tracer.enabled):
                 return fn(*args, **kwargs)
             payload, group = extract(args, kwargs)

@@ -22,6 +22,7 @@ from typing import List, Optional
 
 import numpy as np
 
+from ..analysis import schedule as _sched
 from ..profiler import instrument as _instr
 from ..resilience import chaos as _chaos
 from .store import TCPStore, create_or_get_global_tcp_store
@@ -59,6 +60,8 @@ class HostCollectives:
         _chaos.site("hc.round")
         n = self._seq.get(op, 0)
         self._seq[op] = n + 1
+        if _sched._REC[0] is not None:  # collective-order recorder
+            _sched.record(f"hc.{op}", str(n))
         return f"__hc/{self.prefix}/{op}/{n}"
 
     def _wait(self, key: str) -> bytes:

@@ -19,6 +19,7 @@ import time
 from typing import List, Optional
 
 from .. import _native
+from ..analysis import schedule as _sched
 from ..resilience import chaos as _chaos
 
 
@@ -151,6 +152,8 @@ class TCPStore:
         rnd = self._barrier_rounds.get(prefix, 0)
         key = f"__barrier/{prefix}/{rnd}"
         _chaos.site("store.barrier")
+        if _sched._REC[0] is not None:  # collective-order recorder
+            _sched.record("store.barrier", f"{prefix}/{rnd}")
         if self.rank is not None:
             self.set(f"{key}/r{self.rank}", b"1")
         arrived = self.add(f"{key}/count", 1)

@@ -32,6 +32,33 @@ from __future__ import annotations
 
 from . import metrics as _m
 
+# The built-in metric-name catalog: every framework-emitted family, by its
+# stable name. The analysis linter (paddle_tpu/analysis, rule TPU301) reads
+# this tuple STATICALLY and flags any registry.counter/gauge/histogram call
+# in the package whose literal name is absent — adding an instrumented call
+# site means adding its family here (and to the docstring table above).
+CATALOG = (
+    "ops_dispatch_total",
+    "jit_compile_total",
+    "jit_cache_hits_total",
+    "jit_compile_seconds",
+    "collective_calls_total",
+    "collective_bytes_total",
+    "host_collective_rounds_total",
+    "host_collective_bytes_total",
+    "checkpoint_save_seconds",
+    "checkpoint_load_seconds",
+    "watchdog_ticks_total",
+    "watchdog_fires_total",
+    "train_steps_total",
+    "dataloader_batches_total",
+    "resilience_faults_injected_total",
+    "resilience_retries_total",
+    "resilience_giveups_total",
+    "resilience_ckpt_events_total",
+    "resilience_guard_events_total",
+)
+
 _enabled = _m._ENABLED  # bind the cell once: hot-path guard is _enabled[0]
 
 _TIME_BUCKETS = (0.001, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 30.0, 60.0,

@@ -20,10 +20,9 @@ installed (one list-index + ``is None`` check):
   * ``poison(name, x)``   — value faults: ``nan``/``inf``/``spike`` on a
     scalar (loss poisoning for StepGuard drills).
 
-Site catalog (stable names, see README "Resilience"): ``store.get``,
-``store.set``, ``store.add``, ``store.barrier``, ``ckpt.shard_write``,
-``ckpt.shard_read``, ``ckpt.meta_write``, ``hc.round``, ``train.step``,
-``train.loss``.
+Site catalog: the ``SITES`` registry below is the one source of truth
+(name -> probe kind); the analysis linter validates probe literals against
+it and ``install_plan`` warns on plans whose patterns can never fire.
 
 Configuration: programmatic (``install_plan(FaultPlan(...))``) or via env —
 ``PADDLE_CHAOS_PLAN="store.get:error:TimeoutError@1;ckpt.shard_write:corrupt@2"``
@@ -47,7 +46,28 @@ from ..profiler import instrument as _instr
 __all__ = [
     "Fault", "FaultPlan", "FaultInjected", "install_plan", "clear_plan",
     "active_plan", "enabled", "site", "mangle", "poison", "plan_from_env",
+    "SITES",
 ]
+
+# The probe-site registry: every instrumented call site in the framework,
+# mapped to the probe function that fires there (site | mangle | poison).
+# This is the ONE source of truth consumers read — the analysis linter
+# checks probe literals against it, install_plan() warns on plans whose
+# patterns can never fire, and the README table is generated from it.
+# Adding a probe to the framework means adding its name here.
+SITES = {
+    "store.get": "site",
+    "store.set": "site",
+    "store.add": "site",
+    "store.barrier": "site",
+    "ckpt.shard_write": "site",
+    "ckpt.shard_read": "site",
+    "ckpt.meta_write": "site",
+    "ckpt.shard_bytes": "mangle",
+    "hc.round": "site",
+    "train.step": "site",
+    "train.loss": "poison",
+}
 
 _CONTROL_KINDS = ("delay", "error", "die")
 _BYTE_KINDS = ("corrupt", "truncate")
@@ -155,6 +175,14 @@ _PLAN: List[Optional[FaultPlan]] = [None]
 
 
 def install_plan(plan: Optional[FaultPlan]) -> Optional[FaultPlan]:
+    if plan is not None:
+        for f in plan.faults:
+            if not any(fnmatch.fnmatchcase(s, f.pattern) for s in SITES):
+                import logging
+                logging.getLogger(__name__).warning(
+                    "chaos: fault pattern %r matches no registered probe "
+                    "site (known sites: %s) — it will never fire",
+                    f.pattern, ", ".join(sorted(SITES)))
     _PLAN[0] = plan
     return plan
 
@@ -209,7 +237,10 @@ def mangle(name: str, data: bytes) -> bytes:
     if f.kind == "truncate":
         keep = int(f.arg) if f.arg else max(1, len(data) // 2)
         return data[:keep]
-    pos = int(f.arg) if f.arg else rng.randrange(len(data))
+    # clamp an explicit position into the payload: a plan written for big
+    # shards must still corrupt (not IndexError) a smaller one
+    pos = min(int(f.arg), len(data) - 1) if f.arg \
+        else rng.randrange(len(data))
     flipped = data[pos] ^ 0xFF
     return data[:pos] + bytes([flipped]) + data[pos + 1:]
 

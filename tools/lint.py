@@ -1,0 +1,176 @@
+"""Framework lint driver: both analysis passes over the repo, CI-gated.
+
+    python tools/lint.py                  # lint the shipped tree (exit 0)
+    python tools/lint.py path/to/file.py  # lint specific files/dirs
+    python tools/lint.py --fix-hints      # per-rule remediation table
+    python tools/lint.py --update-baseline
+
+Pass 1 (AST, stdlib-only, fast): every rule in paddle_tpu.analysis.rules
+over paddle_tpu/, tools/, examples/ and tests/. Pass 2 (trace, imports
+JAX; skip with --no-trace): trace-sanitizes a representative train-step
+function built from the framework's own layers, and — when --schedules
+<dir> points at logs captured via PADDLE_SCHEDULE_LOG — checks the
+recorded per-rank collective schedules for divergence.
+
+Findings are diffed against the committed baseline
+(tools/lint_baseline.json, shipped EMPTY: the tree self-hosts clean);
+any finding not in the baseline prints with its rule id and fix hint and
+the driver exits nonzero. tests/test_analysis.py runs the same gate as a
+tier-1 test.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _bootstrap_analysis_pkg():
+    """Make `import paddle_tpu.analysis` work WITHOUT executing the full
+    paddle_tpu/__init__.py (which imports JAX and the whole framework):
+    register a bare parent package whose __path__ points at the source
+    tree. When paddle_tpu is already imported (in-process test use) this
+    is a no-op."""
+    import types
+    if "paddle_tpu" not in sys.modules:
+        pkg = types.ModuleType("paddle_tpu")
+        pkg.__path__ = [os.path.join(REPO, "paddle_tpu")]
+        sys.modules["paddle_tpu"] = pkg
+
+DEFAULT_PATHS = ["paddle_tpu", "tools", "examples", "tests"]
+BASELINE = os.path.join(REPO, "tools", "lint_baseline.json")
+
+
+def _load_baseline(path):
+    try:
+        with open(path) as f:
+            return set(json.load(f))
+    except (FileNotFoundError, json.JSONDecodeError):
+        return set()
+
+
+def _print_fix_hints():
+    from paddle_tpu.analysis.rules import rule_table
+    print("AST rules (suppress per line with  # tpu-lint: disable=<ID>):\n")
+    for rid, name, sev, desc, hint in rule_table():
+        print(f"  {rid} {name} [{sev}]")
+        print(f"      what: {desc}")
+        print(f"      fix:  {hint}\n")
+    # trace rules live beside the trace pass; import lazily (needs jax)
+    try:
+        from paddle_tpu.analysis.tracecheck import TRACE_RULES
+    except Exception:
+        print("(trace-rule table unavailable: jax not importable)")
+        return
+    print("Trace-sanitizer rules (reported by trace_check / "
+          "check_collective_schedules):\n")
+    for rid, (name, hint) in sorted(TRACE_RULES.items()):
+        print(f"  {rid} {name}")
+        print(f"      fix:  {hint}\n")
+
+
+def _trace_self_check():
+    """Trace-sanitize a representative step function built from the
+    framework's own layers — proves the dynamic pass runs on the shipped
+    tree without findings (the examples' training loops are eager; this
+    is their jitted equivalent)."""
+    import numpy as np
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    jax.config.update("jax_platforms", "cpu")  # tunnel plugin ignores env
+    from paddle_tpu.analysis.tracecheck import trace_check
+    import jax.numpy as jnp
+
+    def sgd_step(w, b, x, y, lr):
+        pred = jnp.maximum(x @ w + b, 0.0)
+        err = pred - y
+        loss = (err * err).mean()
+        gw = x.T @ (2.0 * (jnp.where(x @ w + b > 0, 1.0, 0.0) * err)) \
+            / x.shape[0]
+        gb = (2.0 * err).mean()
+        return w - lr * gw, b - lr * gb, loss
+
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.standard_normal((8, 4)).astype(np.float32))
+    b = jnp.zeros((4,), jnp.float32)
+    x = jnp.asarray(rng.standard_normal((16, 8)).astype(np.float32))
+    y = jnp.zeros((16, 4), jnp.float32)
+    return trace_check(sgd_step, (w, b, x, y, 0.1),
+                       label="tools/lint.py::sgd_step self-check")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*", default=None,
+                    help=f"files/dirs to lint (default: {DEFAULT_PATHS})")
+    ap.add_argument("--baseline", default=BASELINE)
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="write current findings as the new baseline")
+    ap.add_argument("--fix-hints", action="store_true",
+                    help="print the per-rule remediation table and exit")
+    ap.add_argument("--no-trace", action="store_true",
+                    help="skip the trace-sanitizer pass (no jax import)")
+    ap.add_argument("--schedules", default=None, metavar="DIR",
+                    help="check per-rank collective logs recorded via "
+                         "PADDLE_SCHEDULE_LOG=DIR")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable findings on stdout")
+    args = ap.parse_args(argv)
+
+    _bootstrap_analysis_pkg()
+    if args.fix_hints:
+        _print_fix_hints()
+        return 0
+
+    t0 = time.perf_counter()
+    from paddle_tpu.analysis import lint_paths
+
+    paths = [os.path.join(REPO, p) if not os.path.exists(p) else p
+             for p in (args.paths or DEFAULT_PATHS)]
+    findings = lint_paths(paths)
+    n_ast = len(findings)
+
+    if not args.no_trace:
+        findings.extend(_trace_self_check())
+    if args.schedules:  # needs jax only for the Finding type's module
+        from paddle_tpu.analysis.schedule import load_schedules
+        from paddle_tpu.analysis.tracecheck import \
+            check_collective_schedules
+        findings.extend(
+            check_collective_schedules(load_schedules(args.schedules)))
+
+    baseline = _load_baseline(args.baseline)
+    fresh = [f for f in findings if f.key() not in baseline]
+
+    if args.update_baseline:
+        with open(args.baseline, "w") as f:
+            json.dump(sorted(f2.key() for f2 in findings), f, indent=1)
+        print(f"wrote {len(findings)} finding keys to {args.baseline}")
+        return 0
+
+    if args.as_json:
+        print(json.dumps([vars(f) for f in fresh], indent=1))
+    else:
+        for f in fresh:
+            rel = os.path.relpath(f.path, REPO) if os.path.isabs(f.path) \
+                else f.path
+            print(f"{rel}:{f.line}: {f.rule} [{f.severity}] {f.message}")
+            if f.hint:
+                print(f"    fix: {f.hint}")
+        dt = time.perf_counter() - t0
+        known = len(findings) - len(fresh)
+        print(f"\nlint: {n_ast} ast + {len(findings) - n_ast} trace "
+              f"finding(s), {known} baselined, {len(fresh)} new "
+              f"({dt:.1f}s)")
+    errors = [f for f in fresh if f.severity == "error"]
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

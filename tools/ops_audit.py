@@ -3,6 +3,13 @@ paddle/phi/ops/yaml/ops.yaml to {direct public symbol | alias | decided-out
 reason} and generates OPS_COVERAGE.md. Run: python tools/ops_audit.py
 (tests/test_ops_coverage.py runs it and asserts the classification is total
 and that every alias target actually resolves).
+
+CI gate: ``python tools/ops_audit.py --check`` re-audits and exits nonzero
+if coverage REGRESSED vs the committed OPS_COVERAGE.md — any op that lost
+its classification, any alias target that stopped import-resolving, or a
+drop in the direct / direct+alias counts. When the reference yaml is not
+mounted (most CI images), the op list is read from the committed
+OPS_COVERAGE.md itself, so the gate runs everywhere tools/lint.py does.
 """
 from __future__ import annotations
 
@@ -197,7 +204,25 @@ DECIDED_OUT = {
 }
 
 
+COVERAGE_MD = os.path.join(REPO, "OPS_COVERAGE.md")
+
+
+def md_rows(path=None):
+    """Parse the committed OPS_COVERAGE.md back into (name, kind, detail)
+    rows — the baseline the --check gate compares against, and the op-name
+    source when the reference yaml is not mounted."""
+    rows = []
+    for line in open(path or COVERAGE_MD):
+        m = re.match(r"^\|\s*`(\w+)`\s*\|\s*([\w-]+)\s*\|\s*(.*?)\s*\|$",
+                     line)
+        if m:
+            rows.append((m.group(1), m.group(2), m.group(3)))
+    return rows
+
+
 def yaml_op_names():
+    if not os.path.exists(OPS_YAML):
+        return [n for n, _, _ in md_rows()]
     names = []
     for line in open(OPS_YAML):
         m = re.match(r"^- op\s*:\s*(\w+)", line)
@@ -298,9 +323,52 @@ def write_md(rows, counts, path=None):
     return path
 
 
+def check(md_path=None):
+    """Fresh audit vs the committed OPS_COVERAGE.md. Returns a list of
+    regression strings (empty = gate passes)."""
+    baseline = md_rows(md_path)
+    base_kind = {n: kind for n, kind, _ in baseline}
+    base_counts = {"direct": 0, "alias": 0, "decided-out": 0,
+                   "unclassified": 0}
+    for _, kind, _ in baseline:
+        base_counts[kind] = base_counts.get(kind, 0) + 1
+    names, rows, counts, bad = audit()
+    problems = []
+    for n, tgt in bad:
+        problems.append(
+            f"alias target for `{n}` no longer import-resolves: {tgt}")
+    for n, kind, _ in rows:
+        was = base_kind.get(n)
+        if kind == "unclassified" and was in ("direct", "alias"):
+            problems.append(
+                f"`{n}` was {was}, now unclassified (symbol removed?)")
+        elif kind == "unclassified" and was is None:
+            problems.append(f"new op `{n}` is unclassified")
+    if counts["direct"] < base_counts["direct"]:
+        problems.append(
+            f"direct coverage regressed: {counts['direct']} < committed "
+            f"{base_counts['direct']}")
+    resolvable = counts["direct"] + counts["alias"]
+    base_resolvable = base_counts["direct"] + base_counts["alias"]
+    if resolvable < base_resolvable:
+        problems.append(
+            f"direct+alias coverage regressed: {resolvable} < committed "
+            f"{base_resolvable}")
+    return problems
+
+
 if __name__ == "__main__":
     import jax
     jax.config.update("jax_platforms", "cpu")
+    if "--check" in sys.argv:
+        problems = check()
+        for p in problems:
+            print(f"ops_audit: REGRESSION: {p}")
+        if not problems:
+            names = yaml_op_names()
+            print(f"ops_audit: coverage holds vs OPS_COVERAGE.md "
+                  f"({len(names)} ops)")
+        sys.exit(1 if problems else 0)
     names, rows, counts, bad = audit()
     p = write_md(rows, counts)
     print(f"wrote {p}")
@@ -310,3 +378,4 @@ if __name__ == "__main__":
     unc = [n for n, k, _ in rows if k == "unclassified"]
     if unc:
         print("UNCLASSIFIED:", unc)
+    sys.exit(1 if (bad or unc) else 0)
