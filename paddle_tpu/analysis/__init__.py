@@ -1,39 +1,65 @@
 """Static analysis + trace sanitation: catch TPU sharp bits before a run.
 
-Two complementary passes (driven together by ``tools/lint.py``):
+Three cooperating passes (driven together by ``tools/lint.py``):
 
-* ``analysis.astlint`` / ``analysis.rules`` — an AST linter for the
-  framework's machine-checkable invariants: raw ``jax.shard_map`` /
-  ``lax.axis_size`` / Pallas ``CompilerParams`` spellings that bypass the
-  ``utils/jax_compat`` version shims (PR 2's 32-failure bug class),
-  wall-clock/unseeded-random reads inside chaos-probed or jit-traced
-  regions, metric names missing from the ``profiler.instrument`` catalog,
-  unknown chaos probe sites, broad excepts that can swallow
-  ``CheckpointCorruptionError``, and mutable default args in
-  constructors. Rules carry stable ids, severities and fix hints;
-  ``# tpu-lint: disable=<ID>`` suppresses per line and is itself checked.
+* ``analysis.astlint`` / ``analysis.rules`` / ``analysis.shard_rules``
+  — stdlib-only AST linting of the framework's machine-checkable
+  invariants, including the sharding/layout surface.
 * ``analysis.tracecheck`` — dynamic: traces a step function and flags
-  recompile hazards (scalar closures, Python branches on tracers,
-  empirical retrace on same-shape inputs), host round-trips inside the
-  step, donated buffers no output can reuse, and — with per-rank
-  schedules captured by ``analysis.schedule`` — cross-rank collective
+  recompile hazards, host syncs, wasted donations, and (with per-rank
+  schedules captured by ``analysis.schedule``) cross-rank collective
   order divergence.
+* ``analysis.shardcheck`` — abstract layout evaluation: runs a step
+  function under ``jax.eval_shape`` with sharding-annotated inputs (no
+  devices needed) and reports divisibility violations, implicit-reshard
+  hotspots, and a per-op layout report diffed against a baseline.
 
-The linter half is stdlib-only; the trace half needs JAX and loads
-lazily, so ``import paddle_tpu.analysis`` stays cheap for editors and CI.
+Rule families (every id is greppable from this one table):
+
+======== ====================================================================
+family   meaning
+======== ====================================================================
+TPU000   meta: syntax error / unknown rule id in a suppression comment
+TPU1xx   version-shim invariants: raw shard_map / axis_size / Pallas
+         CompilerParams spelled outside ``utils/jax_compat.py``
+TPU2xx   determinism: wall-clock or unseeded random in chaos-probed or
+         jit-traced regions; probe sites absent from ``chaos.SITES``
+TPU3xx   observability: metric names absent from ``instrument.CATALOG``
+TPU4xx   exception hygiene: bare except; broad except swallowing
+         ``CheckpointCorruptionError`` around checkpoint loads
+TPU5xx   construction hygiene: mutable constructor defaults
+TRC1xx   trace sanitizer: recompile hazards (scalar closures, python
+         branches on tracers, retrace probe), host syncs, dead donations
+TRC2xx   cross-rank collective schedules: order divergence, count mismatch
+SHD1xx   static sharding/layout: unknown or duplicated mesh axes,
+         collectives outside their region, in_specs arity, hard-coded
+         mesh facts, donation/sharding mismatches
+SHD2xx   abstract layout evaluation: sharded-dim divisibility, implicit
+         reshard traffic over threshold, layout-report baseline drift
+======== ====================================================================
+
+The linter half (TPU/SHD1xx) is stdlib-only; the trace half (TRC) needs
+JAX and loads lazily; the layout half (SHD2xx) imports JAX only inside
+its functions — so ``import paddle_tpu.analysis`` stays cheap for
+editors and CI.
 """
 from __future__ import annotations
 
 from . import schedule  # noqa: F401  (stdlib-only)
+from . import shardcheck  # noqa: F401  (stdlib-only at import time)
 from .astlint import (iter_python_files, lint_file, lint_paths,  # noqa: F401
                       lint_source)
 from .rules import (RULES, Finding, get_rule,  # noqa: F401
                     load_chaos_sites, load_metric_catalog, rule_table)
+from .shard_rules import load_known_axes  # noqa: F401
+from .shardcheck import (SHARD_RULES, layout_check,  # noqa: F401
+                         layout_report)
 
 __all__ = [
     "Finding", "RULES", "get_rule", "rule_table",
     "lint_source", "lint_file", "lint_paths", "iter_python_files",
-    "load_chaos_sites", "load_metric_catalog",
+    "load_chaos_sites", "load_metric_catalog", "load_known_axes",
+    "SHARD_RULES", "layout_check", "layout_report", "shardcheck",
     "schedule", "trace_check", "check_collective_schedules", "TRACE_RULES",
 ]
 

@@ -588,3 +588,9 @@ def _check_mutable_defaults(ctx: FileContext):
                     yield _finding(
                         rule, ctx, d,
                         f"mutable default in {node.name}.__init__")
+
+
+# SHD1xx (sharding/layout) rules register themselves into RULES; the
+# import sits at the bottom so shard_rules can import this module's
+# half-initialized namespace (everything it needs is defined above).
+from . import shard_rules  # noqa: E402,F401

@@ -1,0 +1,515 @@
+"""SHD1xx — static sharding/layout rules (the shardcheck AST half).
+
+Distributed layout is the first-class programming surface of a
+TPU-native framework: a typo'd mesh axis, a duplicated PartitionSpec
+entry, or a collective over an axis the enclosing manual region never
+bound all COMPILE fine and only surface as a hang, a wrong result, or a
+10x step-time regression once a pod is burning. These rules catch the
+machine-checkable subset before any device is touched.
+
+Ground truth is the canonical axis registry ``distributed/mesh.py
+KNOWN_AXES``, read statically with ``ast.literal_eval`` (the same
+no-jax-at-lint-time contract as the chaos-site and metric-catalog
+rules). The abstract layout evaluator (divisibility, implicit-reshard
+cost — SHD2xx) lives in ``analysis/shardcheck.py``; this module is the
+stdlib-only half that rides the astlint rule framework, so SHD findings
+get stable ids, severities, fix hints, baseline keys, and
+``# tpu-lint: disable=`` suppression for free.
+
+Registered into ``rules.RULES`` on import (rules.py imports this module
+at the bottom of its own body).
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import os
+from typing import Dict, List, Optional, Tuple
+
+from .rules import (RULES, FileContext, _finding, _literal_from_source,
+                    _PKG_ROOT, _register)
+
+__all__ = ["load_known_axes"]
+
+
+@functools.lru_cache(maxsize=1)
+def _known_axes_cached() -> Tuple[str, ...]:
+    path = os.path.join(_PKG_ROOT, "distributed", "mesh.py")
+    return tuple(_literal_from_source(path, "KNOWN_AXES"))
+
+
+def load_known_axes() -> Tuple[str, ...]:
+    """Canonical mesh-axis names, read statically from
+    distributed/mesh.py's KNOWN_AXES registry (registry order)."""
+    return _known_axes_cached()
+
+
+# -- literal harvesting helpers -----------------------------------------------
+def _str_const(node) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _is_partition_spec_call(ctx: FileContext, node) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    d = ctx.dotted(node.func)
+    return bool(d) and (d == "PartitionSpec" or d.endswith(".PartitionSpec")
+                        or d.endswith("PartitionSpec"))
+
+
+def _spec_axis_literals(call: ast.Call) -> List[Tuple[ast.AST, str]]:
+    """(node, axis-name) for every string literal in a PartitionSpec
+    call: plain entries, tuple entries, and constants inside starred
+    expressions (``PartitionSpec(*(["pp"] + [None] * k))``)."""
+    out: List[Tuple[ast.AST, str]] = []
+    for arg in call.args:
+        if isinstance(arg, ast.Starred):
+            for n in ast.walk(arg.value):
+                if (s := _str_const(n)) is not None:
+                    out.append((n, s))
+        elif isinstance(arg, (ast.Tuple, ast.List)):
+            for elt in arg.elts:
+                if (s := _str_const(elt)) is not None:
+                    out.append((elt, s))
+        elif (s := _str_const(arg)) is not None:
+            out.append((arg, s))
+    return out
+
+
+# axis-name positional index per lax-style collective / axis query
+_COLLECTIVE_AXIS_ARG = {
+    "psum": 1, "pmax": 1, "pmin": 1, "pmean": 1, "ppermute": 1,
+    "all_gather": 1, "all_to_all": 1, "psum_scatter": 1,
+    "axis_index": 0, "axis_size": 0,
+}
+# only these heads make a tail above a collective (communication.py's
+# eager all_gather(tensor_list, tensor) takes no axis-name string)
+_COLLECTIVE_HEADS = ("jax.lax", "lax", "jax_compat")
+
+
+def _collective_axis_literal(ctx: FileContext,
+                             call: ast.Call) -> Optional[Tuple[ast.AST, str]]:
+    """(node, axis) when `call` is a lax/jax_compat collective with a
+    literal axis-name argument (positional or axis_name= keyword)."""
+    d = ctx.dotted(call.func)
+    if not d:
+        return None
+    head, _, tail = d.rpartition(".")
+    if tail not in _COLLECTIVE_AXIS_ARG:
+        return None
+    if head and not head.endswith(_COLLECTIVE_HEADS):
+        return None
+    if not head and ("jax_compat" not in ctx.imports.get(tail, "")
+                     and "lax" not in ctx.imports.get(tail, "")):
+        return None
+    idx = _COLLECTIVE_AXIS_ARG[tail]
+    if len(call.args) > idx and (s := _str_const(call.args[idx])) is not None:
+        return call.args[idx], s
+    for kw in call.keywords:
+        if kw.arg == "axis_name" and (s := _str_const(kw.value)) is not None:
+            return kw.value, s
+    return None
+
+
+def _axis_kwarg_literals(call: ast.Call) -> List[Tuple[ast.AST, str]]:
+    """Literal axis names in axis_name=/seq_axis=/ep_axis= keywords and
+    axis_names={...} set literals of any call."""
+    out = []
+    for kw in call.keywords:
+        if kw.arg in ("axis_name", "seq_axis", "ep_axis"):
+            if (s := _str_const(kw.value)) is not None:
+                out.append((kw.value, s))
+        elif kw.arg == "axis_names" and isinstance(kw.value,
+                                                  (ast.Set, ast.Tuple,
+                                                   ast.List)):
+            for elt in kw.value.elts:
+                if (s := _str_const(elt)) is not None:
+                    out.append((elt, s))
+    return out
+
+
+def _is_shard_map_call(ctx: FileContext, node) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    d = ctx.dotted(node.func)
+    return bool(d) and d.rpartition(".")[2] == "shard_map"
+
+
+def _keyword(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+# =============================================================================
+# SHD101 — unknown mesh axis
+# =============================================================================
+@_register(
+    "SHD101", "unknown-mesh-axis",
+    "string axis name in a PartitionSpec / collective / axis-name "
+    "argument that no framework mesh defines",
+    "mesh axes are the canonical registry distributed.mesh.KNOWN_AXES "
+    "(dp/pp/sep/sharding/ep/mp); a typo'd axis compiles and then hangs "
+    "or silently replicates on real hardware — fix the name or add the "
+    "axis to KNOWN_AXES",
+    framework_only=True)
+def _check_unknown_axis(ctx: FileContext):
+    rule = RULES["SHD101"]
+    try:
+        known = set(load_known_axes())
+    except (OSError, LookupError):
+        return
+    seen_nodes = set()
+
+    def emit(node, axis, where):
+        if id(node) in seen_nodes or axis in known:
+            return
+        seen_nodes.add(id(node))
+        yield _finding(rule, ctx, node,
+                       f"axis {axis!r} in {where} is not in "
+                       "distributed.mesh.KNOWN_AXES")
+
+    for node in ctx.nodes():
+        if _is_partition_spec_call(ctx, node):
+            for n, axis in _spec_axis_literals(node):
+                yield from emit(n, axis, "a PartitionSpec")
+        if isinstance(node, ast.Call):
+            hit = _collective_axis_literal(ctx, node)
+            if hit is not None:
+                yield from emit(hit[0], hit[1], "a collective axis arg")
+            for n, axis in _axis_kwarg_literals(node):
+                yield from emit(n, axis, "an axis-name keyword")
+            d = ctx.dotted(node.func) or ""
+            tail = d.rpartition(".")[2]
+            if tail == "get_dim_size" and node.args and \
+                    (s := _str_const(node.args[0])) is not None:
+                yield from emit(node.args[0], s, "get_dim_size()")
+            elif tail == "annotate_param" and len(node.args) > 1 and \
+                    (s := _str_const(node.args[1])) is not None:
+                yield from emit(node.args[1], s, "annotate_param()")
+            elif tail == "sharding_constraint" and len(node.args) > 1:
+                for a in node.args[1:]:
+                    if (s := _str_const(a)) is not None:
+                        yield from emit(a, s, "sharding_constraint()")
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            params = node.args.posonlyargs + node.args.args \
+                + node.args.kwonlyargs
+            defaults = ([None] * (len(node.args.posonlyargs)
+                                  + len(node.args.args)
+                                  - len(node.args.defaults))
+                        + list(node.args.defaults)
+                        + list(node.args.kw_defaults))
+            for p, dflt in zip(params, defaults):
+                if dflt is not None and (p.arg.endswith("_axis")
+                                         or p.arg == "axis_name") and \
+                        (s := _str_const(dflt)) is not None:
+                    yield from emit(dflt, s,
+                                    f"the default of parameter {p.arg!r}")
+
+
+# =============================================================================
+# SHD102 — duplicate axis within one PartitionSpec
+# =============================================================================
+@_register(
+    "SHD102", "duplicate-spec-axis",
+    "the same mesh axis appears in two entries of one PartitionSpec",
+    "a dimension set cannot be sharded over one mesh axis twice — jax "
+    "rejects it at trace time at best, and at worst the spec silently "
+    "means something else after a refactor; drop one entry",
+    framework_only=True)
+def _check_duplicate_spec_axis(ctx: FileContext):
+    rule = RULES["SHD102"]
+    for node in ctx.nodes():
+        if not _is_partition_spec_call(ctx, node):
+            continue
+        counts: Dict[str, List[ast.AST]] = {}
+        for n, axis in _spec_axis_literals(node):
+            counts.setdefault(axis, []).append(n)
+        for axis, nodes in counts.items():
+            if len(nodes) > 1:
+                yield _finding(rule, ctx, nodes[1],
+                               f"axis {axis!r} appears {len(nodes)}x in one "
+                               "PartitionSpec")
+
+
+# =============================================================================
+# SHD103 — collective over an axis absent from the enclosing manual region
+# =============================================================================
+def _region_axes(ctx: FileContext) -> set:
+    """Mesh axes this file's manual regions bind: every literal axis in
+    a PartitionSpec, every axis_names={...} entry, every axis_name=
+    keyword binding (functools.partial wiring included). A collective's
+    OWN axis argument does not bind anything — counting it would make
+    every kwarg-spelled violation self-justifying."""
+    axes = set()
+    for node in ctx.nodes():
+        if _is_partition_spec_call(ctx, node):
+            axes.update(a for _, a in _spec_axis_literals(node))
+        if isinstance(node, ast.Call) and \
+                _collective_axis_literal(ctx, node) is None:
+            axes.update(a for _, a in _axis_kwarg_literals(node))
+    return axes
+
+
+@_register(
+    "SHD103", "collective-axis-outside-region",
+    "collective over a literal axis that no shard_map region in this "
+    "file binds (specs / axis_names never mention it)",
+    "a collective over an axis the enclosing mesh region does not bind "
+    "is an unbound-axis-name trace error at best and a cross-region "
+    "deadlock at worst; thread the axis through the region's in_specs/"
+    "axis_names (or take it as the body's axis_name parameter)",
+    framework_only=True)
+def _check_collective_outside_region(ctx: FileContext):
+    rule = RULES["SHD103"]
+    try:
+        known = set(load_known_axes())
+    except (OSError, LookupError):
+        return
+    if not any(_is_shard_map_call(ctx, n) for n in ctx.nodes()):
+        return  # no manual region here: nothing to check against
+    bound = _region_axes(ctx)
+    for node in ctx.nodes():
+        if not isinstance(node, ast.Call):
+            continue
+        hit = _collective_axis_literal(ctx, node)
+        if hit is None:
+            continue
+        n, axis = hit
+        if axis in known and axis not in bound:
+            yield _finding(
+                rule, ctx, n,
+                f"collective over axis {axis!r}, but this file's "
+                f"shard_map regions only bind {sorted(bound) or 'nothing'}")
+
+
+# =============================================================================
+# SHD104 — in_specs arity vs wrapped function signature
+# =============================================================================
+def _positional_arity(fn) -> Optional[int]:
+    """Number of call-time positional params of a def/lambda; None when
+    *args makes it unbounded."""
+    a = fn.args
+    if a.vararg is not None:
+        return None
+    return len(a.posonlyargs) + len(a.args)
+
+
+def _resolve_callee(ctx: FileContext, node):
+    """Resolve a shard_map first argument to (def-or-lambda node,
+    n_bound_positional, bound_kw_names) or None. Handles direct lambdas,
+    file-level defs, functools.partial over a def, and simple
+    ``name = partial(...)`` / ``name = lambda ...`` local assignments."""
+    if isinstance(node, ast.Lambda):
+        return node, 0, set()
+    if isinstance(node, ast.Call):
+        d = ctx.dotted(node.func) or ""
+        if d.rpartition(".")[2] != "partial" or not node.args:
+            return None
+        inner = _resolve_callee(ctx, node.args[0])
+        if inner is None:
+            return None
+        fn, bound_pos, bound_kw = inner
+        return (fn, bound_pos + len(node.args) - 1,
+                bound_kw | {kw.arg for kw in node.keywords if kw.arg})
+    if not isinstance(node, ast.Name):
+        return None
+    # last simple assignment to that name wins; a def by that name too
+    defs = [n for n in ctx.functions()
+            if getattr(n, "name", None) == node.id]
+    assigns = [n.value for n in ctx.nodes()
+               if isinstance(n, ast.Assign) and len(n.targets) == 1
+               and isinstance(n.targets[0], ast.Name)
+               and n.targets[0].id == node.id]
+    if len(defs) + len(assigns) != 1:
+        return None  # ambiguous or imported: stay silent
+    if defs:
+        return defs[0], 0, set()
+    return _resolve_callee(ctx, assigns[0])
+
+
+@_register(
+    "SHD104", "spec-arity-mismatch",
+    "shard_map in_specs tuple length differs from the wrapped "
+    "function's positional arity",
+    "in_specs must give one spec per call-time positional argument of "
+    "the wrapped body; an arity mismatch is a tree-structure error at "
+    "trace time on one jax version and silently zips short on another",
+    framework_only=True)
+def _check_spec_arity(ctx: FileContext):
+    rule = RULES["SHD104"]
+    for node in ctx.nodes():
+        if not _is_shard_map_call(ctx, node) or not node.args:
+            continue
+        in_specs = _keyword(node, "in_specs")
+        if not isinstance(in_specs, ast.Tuple):
+            continue
+        resolved = _resolve_callee(ctx, node.args[0])
+        if resolved is None:
+            continue
+        fn, bound_pos, bound_kw = resolved
+        arity = _positional_arity(fn)
+        if arity is None:
+            continue
+        pos_names = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+        required = arity - bound_pos - len(bound_kw & set(pos_names))
+        n_specs = len(in_specs.elts)
+        if required >= 0 and n_specs != required:
+            name = getattr(fn, "name", "<lambda>")
+            yield _finding(
+                rule, ctx, node,
+                f"in_specs has {n_specs} entr{'y' if n_specs == 1 else 'ies'}"
+                f" but {name}() takes {required} positional argument"
+                f"{'' if required == 1 else 's'}")
+
+
+# =============================================================================
+# SHD105 — hard-coded mesh facts that the registry owns
+# =============================================================================
+_SIZE_LOOKUPS = {"get_dim_size", "axis_size"}
+
+
+def _is_size_lookup(ctx: FileContext, node) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    d = ctx.dotted(node.func) or ""
+    return d.rpartition(".")[2] in _SIZE_LOOKUPS
+
+
+def _int_const_ge2(node) -> Optional[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool) and node.value >= 2:
+        return node.value
+    return None
+
+
+def _canonical_restatement(known: Tuple[str, ...], strings: List[str]) -> bool:
+    """True when `strings` restate the registry: >=3 entries, all known
+    axes, in the registry's relative order (a deliberately different
+    order — e.g. a topology build order — is NOT a restatement)."""
+    if len(strings) < 3 or len(set(strings)) != len(strings):
+        return False
+    if not all(s in known for s in strings):
+        return False
+    idx = [known.index(s) for s in strings]
+    return idx == sorted(idx)
+
+
+@_register(
+    "SHD105", "hard-coded-mesh-fact",
+    "mesh fact the registry owns is hard-coded: an axis-name list "
+    "restating distributed.mesh.KNOWN_AXES, or an axis size compared/"
+    "reduced against an int literal",
+    "derive names from the registry (e.g. `list(KNOWN_AXES)` or a "
+    "filtered comprehension over it) and sizes from the mesh "
+    "(`mesh.get_dim_size(axis)` / `axis_size(axis)`) — a literal copy "
+    "drifts silently when the topology changes and the mesh registry "
+    "does not",
+    framework_only=True,
+    exempt_suffixes=("distributed/mesh.py",))
+def _check_hardcoded_mesh_fact(ctx: FileContext):
+    rule = RULES["SHD105"]
+    try:
+        known = load_known_axes()
+    except (OSError, LookupError):
+        return
+    for node in ctx.nodes():
+        if isinstance(node, (ast.List, ast.Tuple)):
+            strings = [s for e in node.elts
+                       if (s := _str_const(e)) is not None]
+            if len(strings) == len(node.elts) and \
+                    _canonical_restatement(known, strings):
+                yield _finding(
+                    rule, ctx, node,
+                    f"axis-name literal {strings} restates "
+                    "distributed.mesh.KNOWN_AXES")
+        elif isinstance(node, ast.Dict):
+            keys = [s for k in node.keys
+                    if k is not None and (s := _str_const(k)) is not None]
+            if len(keys) == len(node.keys) and \
+                    _canonical_restatement(known, keys):
+                yield _finding(
+                    rule, ctx, node,
+                    f"mesh-axis dict keys {keys} restate "
+                    "distributed.mesh.KNOWN_AXES")
+        elif isinstance(node, ast.Compare) and len(node.ops) == 1 and \
+                isinstance(node.ops[0], (ast.Eq, ast.NotEq)):
+            sides = (node.left, node.comparators[0])
+            for a, b in (sides, sides[::-1]):
+                if _is_size_lookup(ctx, a) and \
+                        (v := _int_const_ge2(b)) is not None:
+                    yield _finding(
+                        rule, ctx, node,
+                        f"axis size compared against hard-coded literal {v}")
+                    break
+        elif isinstance(node, ast.BinOp) and \
+                isinstance(node.op, (ast.Mod, ast.FloorDiv)):
+            sides = (node.left, node.right)
+            for a, b in (sides, sides[::-1]):
+                if _is_size_lookup(ctx, a) and \
+                        (v := _int_const_ge2(b)) is not None:
+                    yield _finding(
+                        rule, ctx, node,
+                        f"axis size combined with hard-coded literal {v}")
+                    break
+
+
+# =============================================================================
+# SHD106 — donated argument whose spec no output spec matches
+# =============================================================================
+def _spec_repr(node) -> Optional[str]:
+    """Canonical text of a literal sharding expression (for structural
+    equality); None when the expression is not statically renderable."""
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - malformed synthetic nodes
+        return None
+
+
+@_register(
+    "SHD106", "donation-spec-unmatched",
+    "jit donate_argnums names an argument whose in_sharding matches no "
+    "out_sharding — XLA silently drops the donation",
+    "donation only helps when an output can alias the donated buffer, "
+    "which requires matching shardings; align the specs or drop the "
+    "argnum (tracecheck TRC104 is the dynamic twin of this rule)",
+    framework_only=True)
+def _check_donation_spec(ctx: FileContext):
+    rule = RULES["SHD106"]
+    for node in ctx.nodes():
+        if not isinstance(node, ast.Call):
+            continue
+        d = ctx.dotted(node.func) or ""
+        if d.rpartition(".")[2] != "jit":
+            continue
+        donate = _keyword(node, "donate_argnums")
+        in_sh = _keyword(node, "in_shardings")
+        out_sh = _keyword(node, "out_shardings")
+        if donate is None or not isinstance(in_sh, ast.Tuple) or \
+                out_sh is None:
+            continue
+        if isinstance(donate, ast.Tuple):
+            argnums = [e.value for e in donate.elts
+                       if isinstance(e, ast.Constant)
+                       and isinstance(e.value, int)]
+        elif isinstance(donate, ast.Constant) and \
+                isinstance(donate.value, int):
+            argnums = [donate.value]
+        else:
+            continue
+        outs = out_sh.elts if isinstance(out_sh, ast.Tuple) else [out_sh]
+        out_reprs = {r for o in outs if (r := _spec_repr(o)) is not None}
+        if not out_reprs:
+            continue
+        for i in argnums:
+            if not 0 <= i < len(in_sh.elts):
+                continue
+            r = _spec_repr(in_sh.elts[i])
+            if r is not None and r not in out_reprs:
+                yield _finding(
+                    rule, ctx, node,
+                    f"donated arg {i} has in_sharding {r} but no "
+                    "out_sharding matches it")

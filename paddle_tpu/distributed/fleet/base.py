@@ -15,7 +15,7 @@ import numpy as np
 import jax
 
 from ..group import Group, new_group
-from ..mesh import ProcessMesh, set_mesh
+from ..mesh import KNOWN_AXES, ProcessMesh, set_mesh
 
 
 class DistributedStrategy:
@@ -110,11 +110,11 @@ class HybridCommunicateGroup:
         # One mesh for the whole topology; axes named after hybrid dims.
         # (jax mesh axis order: outermost..innermost = dp, pp, sep, sharding, mp
         #  so mp lands on adjacent devices / fastest ICI.)
-        mesh_dims = {"dp": self._dp_degree, "pp": self._pp_degree,
-                     "sep": self._sep_degree, "sharding": self._sharding_degree,
-                     "mp": self._mp_degree}
-        names = [n for n, d in mesh_dims.items()]
-        shape = [mesh_dims[n] for n in names]
+        # mesh axes derive from the canonical registry (shardcheck SHD105
+        # self-hosts this: a literal restatement drifts when the registry
+        # grows); fleet's hybrid config has no expert-parallel degree.
+        names = [n for n in KNOWN_AXES if n != "ep"]
+        shape = [getattr(self, f"_{n}_degree") for n in names]
         if int(np.prod(shape)) <= jax.device_count():
             self.mesh = ProcessMesh(shape=shape, dim_names=names,
                                     process_ids=list(range(int(np.prod(shape)))))

@@ -15,6 +15,88 @@ from jax.sharding import Mesh
 
 _global_mesh: List[Optional["ProcessMesh"]] = [None]
 
+# Canonical mesh-axis registry: every axis name the framework's hybrid
+# topology can spell, outermost-to-innermost (the make_hybrid_mesh order:
+# mp innermost so TP collectives ride adjacent-device ICI links).
+#
+# This is the single source of truth for axis names. Runtime consumers
+# derive their name lists from it (make_hybrid_mesh, fleet topology);
+# the static analyzer (analysis/shard_rules.py) reads it out of this
+# file with ast.literal_eval — so it MUST stay a plain literal dict (no
+# computed values) and is the reason rule SHD101/SHD105 never need to
+# import jax to know what an axis name is.
+KNOWN_AXES = {
+    "dp": "data parallel: batch outermost, DCN-capable across slices",
+    "pp": "pipeline stages (manual shard_map region, ppermute ring)",
+    "sep": "sequence/context parallel (ring attention, Ulysses)",
+    "sharding": "ZeRO/FSDP shard axis for optimizer state and params",
+    "ep": "MoE expert banks (dispatch all-to-all stays within replica)",
+    "mp": "tensor (model) parallel: innermost, adjacent-ICI collectives",
+}
+
+
+def _axis_names_of(mesh) -> Optional[List[str]]:
+    """Axis names of a ProcessMesh, jax Mesh, or AbstractMesh; None when
+    the object exposes neither spelling (validation is then skipped)."""
+    names = getattr(mesh, "dim_names", None)
+    if names is None:
+        names = getattr(mesh, "axis_names", None)
+    return list(names) if names is not None else None
+
+
+def validate_spec(spec, mesh) -> None:
+    """Cheap structural check of one PartitionSpec(-like) against a mesh.
+
+    Raises ValueError tagged with the shardcheck rule id when an entry
+    names an axis the mesh does not define (SHD101) or the same axis
+    appears in two entries (SHD102) — the runtime twin of the static
+    pass, wired into the utils/jax_compat shard_map shim so a typo'd
+    axis fails at the call site with a framework message instead of a
+    jax internals trace."""
+    if mesh is None or spec is None:
+        return
+    names = _axis_names_of(mesh)
+    if names is None:
+        return
+    if isinstance(spec, str):  # shorthand: one entry, not per-character
+        spec = (spec,)
+    seen = set()
+    for entry in spec:
+        if entry is None:
+            continue
+        axes = (entry,) if isinstance(entry, str) else tuple(entry)
+        for a in axes:
+            if not isinstance(a, str):
+                continue
+            if a not in names:
+                raise ValueError(
+                    f"SHD101: PartitionSpec axis {a!r} is not an axis of "
+                    f"the mesh (axes: {names}); known framework axes: "
+                    f"{list(KNOWN_AXES)}")
+            if a in seen:
+                raise ValueError(
+                    f"SHD102: axis {a!r} appears twice in one "
+                    f"PartitionSpec — a dimension cannot be sharded over "
+                    f"the same mesh axis in two places")
+            seen.add(a)
+
+
+def validate_specs(mesh, *trees) -> None:
+    """validate_spec over arbitrarily nested tuples/lists/dicts of
+    PartitionSpecs (the shapes shard_map in_specs/out_specs take)."""
+    from jax.sharding import PartitionSpec as _PS
+    stack = list(trees)
+    while stack:
+        node = stack.pop()
+        if node is None:
+            continue
+        if isinstance(node, _PS):
+            validate_spec(node, mesh)
+        elif isinstance(node, dict):
+            stack.extend(node.values())
+        elif isinstance(node, (tuple, list)):
+            stack.extend(node)
+
 
 class ProcessMesh:
     def __init__(self, mesh=None, dim_names: Optional[Sequence[str]] = None,

@@ -35,7 +35,7 @@ from ..framework.random import key_context, next_key
 from ..optimizer import (ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue,
                          Optimizer)
 from ..tensor import Tensor
-from ..distributed.mesh import ProcessMesh
+from ..distributed.mesh import KNOWN_AXES, ProcessMesh
 from ..distributed.fleet.meta_parallel import get_param_annotation
 
 
@@ -58,8 +58,9 @@ def make_hybrid_mesh(dp: int = 1, mp: int = 1, pp: int = 1, sharding: int = 1,
     DCN latency. The scaling-book layout: dp/pp outermost over DCN,
     tp/sp innermost over ICI.
     """
-    shape = [dp, pp, sep, sharding, ep, mp]
-    names = ["dp", "pp", "sep", "sharding", "ep", "mp"]
+    degrees = locals()  # the parameters are named after their mesh axes
+    names = list(KNOWN_AXES)  # canonical order; never restate it (SHD105)
+    shape = [int(degrees[n]) for n in names]
     n = int(np.prod(shape))
     if not dcn:
         mesh = ProcessMesh(shape=shape, dim_names=names,

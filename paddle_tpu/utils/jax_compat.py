@@ -16,8 +16,27 @@ from __future__ import annotations
 
 import jax
 
+
+def _validate_shard_specs(mesh, in_specs, out_specs):
+    """Shardcheck's runtime twin: reject typo'd/duplicated mesh axes in
+    shard_map specs HERE, with the SHD rule id in the message, instead
+    of letting jax fail deep inside spec resolution. Deferred import:
+    distributed.mesh must not load while this module initializes."""
+    if mesh is None:
+        return
+    from ..distributed.mesh import validate_specs
+    validate_specs(mesh, in_specs, out_specs)
+
+
 try:
-    shard_map = jax.shard_map  # promoted spelling (new JAX)
+    _new_shard_map = jax.shard_map  # promoted spelling (new JAX)
+
+    def shard_map(f, mesh=None, in_specs=None, out_specs=None, **kw):
+        # mesh must go by keyword: the promoted signature is
+        # shard_map(f, /, *, mesh=None, ...)
+        _validate_shard_specs(mesh, in_specs, out_specs)
+        return _new_shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, **kw)
 except AttributeError:
     from jax.experimental.shard_map import shard_map as _old_shard_map
 
@@ -30,6 +49,7 @@ except AttributeError:
         # numerically equivalent: axes absent from the specs behave as
         # replicated (callers pass check_vma=False), at worst paying an
         # extra gather at the region boundary on this legacy path.
+        _validate_shard_specs(mesh, in_specs, out_specs)
         return _old_shard_map(f, mesh, in_specs=in_specs,
                               out_specs=out_specs, check_rep=check_vma)
 

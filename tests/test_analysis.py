@@ -187,6 +187,12 @@ CASES = {
     ),
 }
 
+# the SHD (sharding/layout) family's fixtures live with their own test
+# module; pulled in here so the rule-completeness gate covers them too
+from test_shardcheck import SHD_CASES  # noqa: E402
+
+CASES.update(SHD_CASES)
+
 
 def test_every_rule_has_fixtures():
     assert set(CASES) == set(RULES) | {"TPU000"}, (

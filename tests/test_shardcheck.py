@@ -1,0 +1,487 @@
+"""shardcheck: static sharding/layout analysis (SHD1xx) + abstract
+layout evaluation (SHD2xx) + the layout-report baseline gate.
+
+Mirrors test_analysis.py's fixture discipline: every SHD rule gets a
+(bad, suppressed, clean) triple — test_analysis imports SHD_CASES so
+its rule-completeness gate covers this family too. The evaluator cases
+run under the CPU backend with an ABSTRACT mesh (shapes only, no
+devices): the planted step exercises both the divisibility violation
+(SHD201) and the implicit-reshard hotspot (SHD202) the issue names.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+import paddle_tpu  # noqa: F401  (registers the virtual-device conftest env)
+from paddle_tpu.analysis import RULES, lint_file, lint_paths, lint_source
+from paddle_tpu.analysis.shard_rules import load_known_axes
+from paddle_tpu.analysis.shardcheck import (SHARD_RULES, baseline_view,
+                                            layout_check, layout_report,
+                                            spec_tuple)
+from paddle_tpu.distributed.mesh import (KNOWN_AXES, ProcessMesh,
+                                         validate_spec, validate_specs)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FAKE_PATH = os.path.join(REPO, "paddle_tpu", "_lintfixture.py")  # framework
+
+
+def lint(src, path=FAKE_PATH, **kw):
+    return lint_source(textwrap.dedent(src), path, **kw)
+
+
+def ids_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+# -- fixture snippets: {rule: (bad, suppressed, clean)} -----------------------
+SHD_CASES = {
+    "SHD101": (
+        "from jax.sharding import PartitionSpec\n"
+        "s = PartitionSpec('bogus', None)\n",
+        "from jax.sharding import PartitionSpec\n"
+        "s = PartitionSpec('bogus', None)  # tpu-lint: disable=SHD101\n",
+        "from jax.sharding import PartitionSpec\n"
+        "s = PartitionSpec('dp', None)\n",
+    ),
+    "SHD102": (
+        "from jax.sharding import PartitionSpec\n"
+        "s = PartitionSpec('mp', 'mp')\n",
+        "from jax.sharding import PartitionSpec\n"
+        "s = PartitionSpec('mp', 'mp')  # tpu-lint: disable=SHD102\n",
+        "from jax.sharding import PartitionSpec\n"
+        "s = PartitionSpec('dp', 'mp')\n",
+    ),
+    "SHD103": (
+        """\
+        from jax import lax
+        from jax.sharding import PartitionSpec
+        from paddle_tpu.utils.jax_compat import shard_map
+        def body(x):
+            return lax.psum(x, 'dp')
+        def wrap(mesh):
+            return shard_map(body, mesh=mesh,
+                             in_specs=(PartitionSpec('mp'),),
+                             out_specs=PartitionSpec('mp'))
+        """,
+        """\
+        from jax import lax
+        from jax.sharding import PartitionSpec
+        from paddle_tpu.utils.jax_compat import shard_map
+        def body(x):
+            return lax.psum(x, 'dp')  # tpu-lint: disable=SHD103
+        def wrap(mesh):
+            return shard_map(body, mesh=mesh,
+                             in_specs=(PartitionSpec('mp'),),
+                             out_specs=PartitionSpec('mp'))
+        """,
+        """\
+        from jax import lax
+        from jax.sharding import PartitionSpec
+        from paddle_tpu.utils.jax_compat import shard_map
+        def body(x):
+            return lax.psum(x, 'mp')
+        def wrap(mesh):
+            return shard_map(body, mesh=mesh,
+                             in_specs=(PartitionSpec('mp'),),
+                             out_specs=PartitionSpec('mp'))
+        """,
+    ),
+    "SHD104": (
+        """\
+        from jax.sharding import PartitionSpec
+        from paddle_tpu.utils.jax_compat import shard_map
+        def body(x, y):
+            return x + y
+        def wrap(mesh):
+            return shard_map(body, mesh=mesh, in_specs=(PartitionSpec('dp'),), out_specs=PartitionSpec('dp'))
+        """,
+        """\
+        from jax.sharding import PartitionSpec
+        from paddle_tpu.utils.jax_compat import shard_map
+        def body(x, y):
+            return x + y
+        def wrap(mesh):
+            return shard_map(body, mesh=mesh, in_specs=(PartitionSpec('dp'),), out_specs=PartitionSpec('dp'))  # tpu-lint: disable=SHD104
+        """,
+        """\
+        from jax.sharding import PartitionSpec
+        from paddle_tpu.utils.jax_compat import shard_map
+        def body(x, y):
+            return x + y
+        def wrap(mesh):
+            return shard_map(body, mesh=mesh,
+                             in_specs=(PartitionSpec('dp'),
+                                       PartitionSpec('dp')),
+                             out_specs=PartitionSpec('dp'))
+        """,
+    ),
+    "SHD105": (
+        "names = ['dp', 'pp', 'sep', 'sharding', 'ep', 'mp']\n",
+        "names = ['dp', 'pp', 'sep', 'sharding', 'ep', 'mp']"
+        "  # tpu-lint: disable=SHD105\n",
+        "from paddle_tpu.distributed.mesh import KNOWN_AXES\n"
+        "names = list(KNOWN_AXES)\n",
+    ),
+    "SHD106": (
+        """\
+        import jax
+        from jax.sharding import PartitionSpec
+        def build(step):
+            return jax.jit(step, donate_argnums=(0,), in_shardings=(PartitionSpec('dp'), PartitionSpec()), out_shardings=(PartitionSpec(),))
+        """,
+        """\
+        import jax
+        from jax.sharding import PartitionSpec
+        def build(step):
+            return jax.jit(step, donate_argnums=(0,), in_shardings=(PartitionSpec('dp'), PartitionSpec()), out_shardings=(PartitionSpec(),))  # tpu-lint: disable=SHD106
+        """,
+        """\
+        import jax
+        from jax.sharding import PartitionSpec
+        def build(step):
+            return jax.jit(step, donate_argnums=(0,),
+                           in_shardings=(PartitionSpec('dp'),
+                                         PartitionSpec()),
+                           out_shardings=(PartitionSpec('dp'),))
+        """,
+    ),
+}
+
+
+def test_every_shd_rule_has_fixtures():
+    assert set(SHD_CASES) == {r for r in RULES if r.startswith("SHD")}, (
+        "new SHD rule without fixture snippets (or stale fixture id)")
+
+
+@pytest.mark.parametrize("rule", sorted(SHD_CASES))
+def test_rule_fires(rule):
+    bad, _, _ = SHD_CASES[rule]
+    findings = lint(bad)
+    assert rule in ids_of(findings), \
+        f"{rule} did not fire on its fixture: {findings}"
+
+
+@pytest.mark.parametrize("rule", sorted(SHD_CASES))
+def test_rule_suppressed(rule):
+    _, suppressed, _ = SHD_CASES[rule]
+    assert rule not in ids_of(lint(suppressed)), \
+        f"{rule} fired despite # tpu-lint: disable"
+
+
+@pytest.mark.parametrize("rule", sorted(SHD_CASES))
+def test_rule_clean(rule):
+    _, _, clean = SHD_CASES[rule]
+    findings = [f for f in lint(clean) if f.rule == rule]
+    assert not findings, f"{rule} false-positive on clean spelling"
+
+
+def test_shd_rules_skip_user_scripts():
+    bad = SHD_CASES["SHD101"][0]
+    assert "SHD101" not in ids_of(
+        lint(bad, path="/tmp/userscript.py", is_framework=False))
+
+
+def test_sharp_variants_still_fire():
+    # starred spec entries (the pipeline spelling) are harvested
+    src = ("from jax.sharding import PartitionSpec\n"
+           "s = PartitionSpec(*(['bogus'] + [None] * 3))\n")
+    assert "SHD101" in ids_of(lint(src))
+    # partial-wrapped bodies resolve for the arity check
+    src = """\
+    import functools
+    from jax.sharding import PartitionSpec
+    from paddle_tpu.utils.jax_compat import shard_map
+    def body(q, k, v, *, axis_name):
+        return q
+    def wrap(mesh):
+        fn = functools.partial(body, axis_name='sep')
+        return shard_map(fn, mesh=mesh, in_specs=(PartitionSpec('sep'), PartitionSpec('sep')), out_specs=PartitionSpec('sep'))
+    """
+    assert "SHD104" in ids_of(lint(src))
+    # axis-size lookup against a hard-coded literal
+    src = """\
+    def check(mesh):
+        assert mesh.get_dim_size('mp') == 8
+    """
+    assert "SHD105" in ids_of(lint(src))
+    # SHD103 fires for the keyword spelling too — the collective's own
+    # axis_name kwarg must not count as a region binding
+    src = """\
+    from jax import lax
+    from jax.sharding import PartitionSpec
+    from paddle_tpu.utils.jax_compat import shard_map
+    def body(x):
+        return lax.psum(x, axis_name='dp')
+    def wrap(mesh):
+        return shard_map(body, mesh=mesh,
+                         in_specs=(PartitionSpec('mp'),),
+                         out_specs=PartitionSpec('mp'))
+    """
+    assert "SHD103" in ids_of(lint(src))
+
+
+# =============================================================================
+# registry + runtime validation
+# =============================================================================
+def test_known_axes_static_matches_runtime():
+    assert load_known_axes() == tuple(KNOWN_AXES)  # static read == live
+    from paddle_tpu.parallel.trainer import make_hybrid_mesh
+    assert make_hybrid_mesh().dim_names == list(KNOWN_AXES)
+
+
+def test_validate_spec_accepts_and_rejects():
+    mesh = ProcessMesh(shape=[2, 2], dim_names=["dp", "mp"],
+                       process_ids=list(range(4)))
+    validate_spec(("dp", None), mesh)                    # fine
+    validate_spec((("dp", "mp"), None), mesh)            # tuple entry fine
+    validate_spec(None, mesh)                            # no spec: no-op
+    validate_spec("dp", mesh)  # bare-string shorthand: one entry
+    with pytest.raises(ValueError, match="SHD101"):
+        validate_spec(("bogus",), mesh)
+    with pytest.raises(ValueError, match="SHD101.*'bogus'"):
+        validate_spec("bogus", mesh)  # NOT per-character iteration
+    with pytest.raises(ValueError, match="SHD102"):
+        validate_spec(("dp", "dp"), mesh)
+    with pytest.raises(ValueError, match="SHD102"):
+        validate_spec((("dp", "mp"), "mp"), mesh)
+
+
+def test_validate_specs_walks_nested_trees():
+    from jax.sharding import PartitionSpec as P
+    mesh = ProcessMesh(shape=[2], dim_names=["dp"],
+                       process_ids=[0, 1])
+    validate_specs(mesh, (P("dp"), {"w": P(None)}), [P()])
+    with pytest.raises(ValueError, match="SHD101"):
+        validate_specs(mesh, (P("dp"), {"w": P("typo")}))
+
+
+def test_shard_map_shim_validates_specs():
+    """The runtime twin: a typo'd axis fails AT THE SHIM with the SHD
+    rule id, not deep inside jax spec resolution."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+    from paddle_tpu.utils.jax_compat import shard_map
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("dp",))
+    with pytest.raises(ValueError, match="SHD101.*'dq'"):
+        shard_map(lambda x: x, mesh=mesh, in_specs=(P("dq"),),
+                  out_specs=P("dp"))
+    # the valid spelling still traces and runs
+    import jax.numpy as jnp
+    out = jax.jit(shard_map(lambda x: x * 2.0, mesh=mesh,
+                            in_specs=(P("dp"),), out_specs=P("dp"),
+                            check_vma=False))(jnp.ones((4, 3)))
+    assert out.shape == (4, 3) and float(out[0, 0]) == 2.0
+
+
+# =============================================================================
+# abstract layout evaluator (SHD2xx)
+# =============================================================================
+import jax.numpy as jnp  # noqa: E402
+
+
+def _clean_step(w, b, x, y):
+    pred = jnp.maximum(x @ w + b, 0.0)
+    err = pred - y
+    return (err * err).mean()
+
+
+_CLEAN_ARGS = [((8, 4), "float32"), ((4,), "float32"),
+               ((16, 8), "float32"), ((16, 4), "float32")]
+_CLEAN_SPECS = [(None, "mp"), ("mp",), ("dp", None), ("dp", "mp")]
+
+
+def test_layout_clean_step_no_findings():
+    findings, report = layout_check(_clean_step, _CLEAN_ARGS, _CLEAN_SPECS,
+                                    {"dp": 2, "mp": 2}, out_specs=[()])
+    assert findings == []
+    assert report["violations"] == []
+    assert report["ops"], "per-op report must not be empty"
+    ops = {o["op"] for o in report["ops"]}
+    assert "dot_general" in ops and "reduce_sum" in ops
+    # the loss psum is the only modeled traffic: tiny
+    assert 0 < report["total_reshard_bytes"] <= 64
+    json.dumps(report)  # machine-readable end to end
+
+
+def test_layout_propagates_through_the_step():
+    _, report = layout_check(_clean_step, _CLEAN_ARGS, _CLEAN_SPECS,
+                             {"dp": 2, "mp": 2})
+    by_op = {o["op"]: o for o in report["ops"]}
+    assert by_op["dot_general"]["spec"] == ["dp", "mp"]
+    assert by_op["sub"]["spec"] == ["dp", "mp"]
+    assert "psum" in by_op["reduce_sum"]["note"]
+
+
+def test_layout_flags_planted_divisibility_and_hotspot():
+    """The acceptance case: a seeded step whose batch dim does not
+    divide dp AND whose dot contracts a sharded dim — both must land
+    in the report's findings, CPU-only, no devices."""
+    def hot(x, w):
+        return (x @ w).sum()
+
+    findings, report = layout_check(
+        hot,
+        [((6, 4096), "float32"), ((4096, 8), "float32")],
+        [("dp", "mp"), (None, None)],
+        {"dp": 4, "mp": 2}, reshard_threshold=1024, label="planted")
+    rules = {f.rule for f in findings}
+    assert rules == {"SHD201", "SHD202"}
+    div = [f for f in findings if f.rule == "SHD201"]
+    assert "not divisible" in div[0].message and "pads" in div[0].message
+    hotspots = [f for f in findings if f.rule == "SHD202"]
+    assert any("all-gather" in f.message for f in hotspots)
+    assert report["total_reshard_bytes"] > 1024
+    assert report["violations"]  # report carries them machine-readably
+
+
+def test_layout_output_spec_mismatch_costs():
+    def ident(x):
+        return x * 1.0
+
+    findings, report = layout_check(
+        ident, [((1024, 1024), "float32")], [("dp", None)],
+        {"dp": 2}, out_specs=[(None, "dp")], reshard_threshold=1024)
+    assert any(f.rule == "SHD202" and "out_spec" in f.message
+               for f in findings)
+    assert report["outputs"][0]["requested"] == [None, "dp"]
+
+
+def test_layout_report_and_baseline_view():
+    rep = layout_report(_clean_step, _CLEAN_ARGS, _CLEAN_SPECS,
+                        {"dp": 2, "mp": 2}, out_specs=[()])
+    view = baseline_view(rep)
+    assert set(view) == {"label", "mesh", "inputs", "outputs",
+                         "total_reshard_bytes", "violations"}
+    assert "ops" not in view  # primitive spellings drift across versions
+
+
+def test_spec_tuple_normalizes():
+    assert spec_tuple(None, 3) == (None, None, None)
+    assert spec_tuple(("dp",), 2) == ("dp", None)
+    assert spec_tuple((("dp", "mp"), None), 2) == (("dp", "mp"), None)
+    assert spec_tuple((["sep"], None), 2) == ("sep", None)
+    assert spec_tuple("dp", 2) == ("dp", None)  # not ('d', 'p')
+
+
+# =============================================================================
+# self-hosting: the seeded SHD105 fix + repo gates
+# =============================================================================
+def test_seeded_fix_old_spelling_fires():
+    """The pre-PR spelling of make_hybrid_mesh's axis list (and fleet's
+    mesh dict) is exactly the SHD105 shape; the shipped tree hosts the
+    registry-derived fix."""
+    old_trainer = """\
+    def make_hybrid_mesh(dp=1, mp=1, pp=1, sharding=1, sep=1, ep=1):
+        shape = [dp, pp, sep, sharding, ep, mp]
+        names = ["dp", "pp", "sep", "sharding", "ep", "mp"]
+        return shape, names
+    """
+    assert "SHD105" in ids_of(lint(old_trainer))
+    old_fleet = """\
+    def build(self):
+        mesh_dims = {"dp": self._dp, "pp": self._pp, "sep": self._sep,
+                     "sharding": self._sharding, "mp": self._mp}
+        return mesh_dims
+    """
+    assert "SHD105" in ids_of(lint(old_fleet))
+    # a deliberately different order (fleet's topology build order) is
+    # NOT a restatement of the registry and stays clean
+    reordered = 'AXIS_ORDER = ["pp", "mp", "sep", "sharding", "dp"]\n'
+    assert "SHD105" not in ids_of(lint(reordered))
+    # and the shipped files lint clean
+    for rel in ("paddle_tpu/parallel/trainer.py",
+                "paddle_tpu/distributed/fleet/base.py"):
+        shd = [f for f in lint_file(os.path.join(REPO, rel))
+               if f.rule.startswith("SHD")]
+        assert shd == [], [f.render() for f in shd]
+
+
+@pytest.mark.lint
+def test_repo_is_shd_clean():
+    """Repo gate, mirroring test_analysis.test_repo_is_clean: zero SHD
+    findings over the package against the (empty) baseline."""
+    findings = [f for f in lint_paths(
+        [os.path.join(REPO, p)
+         for p in ("paddle_tpu", "tools", "examples", "tests")])
+        if f.rule.startswith("SHD")]
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+@pytest.mark.lint
+def test_driver_flags_every_injected_shd_violation(tmp_path):
+    """Acceptance: a scratch framework module violating every SHD rule
+    makes tools/lint.py exit nonzero, naming each rule id and its fix
+    hint."""
+    pkg = tmp_path / "paddle_tpu"  # path-based framework detection
+    pkg.mkdir()
+    scratch = pkg / "scratch_mod.py"
+    scratch.write_text(textwrap.dedent("""\
+        import jax
+        from jax import lax
+        from jax.sharding import PartitionSpec
+        from paddle_tpu.utils.jax_compat import shard_map
+
+        BAD = PartitionSpec('modelp', None)                  # SHD101
+        DUP = PartitionSpec('mp', 'mp')                      # SHD102
+        NAMES = ['dp', 'pp', 'sep', 'sharding', 'ep', 'mp']  # SHD105
+
+        def body(x, y):
+            return lax.psum(x, 'dp')                         # SHD103
+
+        def wrap(mesh):                                      # SHD104
+            return shard_map(body, mesh=mesh,
+                             in_specs=(PartitionSpec('mp'),),
+                             out_specs=PartitionSpec('mp'))
+
+        def build(step):                                     # SHD106
+            return jax.jit(step, donate_argnums=(0,),
+                           in_shardings=(PartitionSpec('sep'),
+                                         PartitionSpec()),
+                           out_shardings=(PartitionSpec(),))
+        """))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint.py"),
+         "--no-trace", "--no-shard", str(scratch)],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    for rid in SHD_CASES:
+        assert rid in proc.stdout, f"{rid} missing from driver output"
+    assert "KNOWN_AXES" in proc.stdout  # the fix hint names the registry
+
+
+@pytest.mark.lint
+def test_driver_shard_pass_and_layout_report(tmp_path):
+    """tools/lint.py --shard runs the eval half clean against the
+    committed layout baseline and --layout-report dumps the per-op
+    JSON."""
+    out = tmp_path / "layout.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint.py"),
+         "--no-trace", "--shard", "--layout-report", str(out),
+         os.path.join(REPO, "paddle_tpu", "analysis")],
+        capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    rep = json.loads(out.read_text())
+    assert rep["ops"] and rep["violations"] == []
+    assert rep["mesh"] == {"dp": 2, "mp": 2}
+    # the committed baseline matches the live stable subset
+    with open(os.path.join(REPO, "tools", "layout_baseline.json")) as f:
+        assert json.load(f) == baseline_view(rep)
+
+
+def test_fix_hints_cover_shard_rules():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint.py"),
+         "--fix-hints"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0
+    for rid in SHARD_RULES:
+        assert rid in proc.stdout
+    for rid in SHD_CASES:
+        assert rid in proc.stdout

@@ -1,22 +1,29 @@
-"""Framework lint driver: both analysis passes over the repo, CI-gated.
+"""Framework lint driver: all three analysis passes over the repo, CI-gated.
 
     python tools/lint.py                  # lint the shipped tree (exit 0)
     python tools/lint.py path/to/file.py  # lint specific files/dirs
     python tools/lint.py --fix-hints      # per-rule remediation table
+    python tools/lint.py --layout-report out.json   # dump per-op report
     python tools/lint.py --update-baseline
 
 Pass 1 (AST, stdlib-only, fast): every rule in paddle_tpu.analysis.rules
-over paddle_tpu/, tools/, examples/ and tests/. Pass 2 (trace, imports
-JAX; skip with --no-trace): trace-sanitizes a representative train-step
-function built from the framework's own layers, and — when --schedules
-<dir> points at logs captured via PADDLE_SCHEDULE_LOG — checks the
-recorded per-rank collective schedules for divergence.
+— the TPU and SHD1xx families — over paddle_tpu/, tools/, examples/ and
+tests/. Pass 2 (trace, imports JAX; skip with --no-trace):
+trace-sanitizes a representative train-step function built from the
+framework's own layers, and — when --schedules <dir> points at logs
+captured via PADDLE_SCHEDULE_LOG — checks the recorded per-rank
+collective schedules for divergence. Pass 3 (shard, imports JAX; skip
+with --no-shard): abstractly evaluates a representative sharded step
+over a dp×mp mesh with paddle_tpu.analysis.shardcheck — divisibility +
+implicit-reshard findings (SHD2xx) plus a per-op layout report whose
+stable subset is diffed against tools/layout_baseline.json (SHD210 on
+drift). All of it runs on CPU with no devices: the mesh is abstract.
 
 Findings are diffed against the committed baseline
 (tools/lint_baseline.json, shipped EMPTY: the tree self-hosts clean);
 any finding not in the baseline prints with its rule id and fix hint and
-the driver exits nonzero. tests/test_analysis.py runs the same gate as a
-tier-1 test.
+the driver exits nonzero. tests/test_analysis.py and
+tests/test_shardcheck.py run the same gates as tier-1 tests.
 """
 from __future__ import annotations
 
@@ -44,6 +51,7 @@ def _bootstrap_analysis_pkg():
 
 DEFAULT_PATHS = ["paddle_tpu", "tools", "examples", "tests"]
 BASELINE = os.path.join(REPO, "tools", "lint_baseline.json")
+LAYOUT_BASELINE = os.path.join(REPO, "tools", "layout_baseline.json")
 
 
 def _load_baseline(path):
@@ -60,6 +68,11 @@ def _print_fix_hints():
     for rid, name, sev, desc, hint in rule_table():
         print(f"  {rid} {name} [{sev}]")
         print(f"      what: {desc}")
+        print(f"      fix:  {hint}\n")
+    from paddle_tpu.analysis.shardcheck import SHARD_RULES  # stdlib-only
+    print("Layout-evaluator rules (reported by shardcheck.layout_check):\n")
+    for rid, (name, hint) in sorted(SHARD_RULES.items()):
+        print(f"  {rid} {name}")
         print(f"      fix:  {hint}\n")
     # trace rules live beside the trace pass; import lazily (needs jax)
     try:
@@ -105,6 +118,49 @@ def _trace_self_check():
                        label="tools/lint.py::sgd_step self-check")
 
 
+def _shard_self_check(compare_baseline: bool):
+    """Abstract-layout-evaluate a representative sharded step over a
+    dp×mp mesh (no devices — CPU-safe): proves the SHD2xx pass runs
+    clean on the shipped tree and yields the layout report whose stable
+    subset is pinned by tools/layout_baseline.json.
+
+    Returns (findings, report)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    jax.config.update("jax_platforms", "cpu")  # tunnel plugin ignores env
+    import jax.numpy as jnp
+    from paddle_tpu.analysis.shardcheck import baseline_view, layout_check
+
+    def step(w, b, x, y):
+        # Megatron-ish layout: batch over dp, features/heads over mp.
+        pred = jnp.maximum(x @ w + b, 0.0)
+        err = pred - y
+        return (err * err).mean()
+
+    args = [((8, 4), "float32"), ((4,), "float32"),
+            ((16, 8), "float32"), ((16, 4), "float32")]
+    in_specs = [(None, "mp"), ("mp",), ("dp", None), ("dp", "mp")]
+    findings, report = layout_check(
+        step, args, in_specs, {"dp": 2, "mp": 2}, out_specs=[()],
+        label="tools/lint.py::sharded_step self-check")
+    if compare_baseline:
+        from paddle_tpu.analysis.rules import Finding
+        from paddle_tpu.analysis.shardcheck import SHARD_RULES
+        try:
+            with open(LAYOUT_BASELINE) as f:
+                want = json.load(f)
+        except (FileNotFoundError, json.JSONDecodeError):
+            want = None
+        got = baseline_view(report)
+        if got != want:
+            findings.append(Finding(
+                "SHD210", LAYOUT_BASELINE, 0, 0,
+                "layout report for the representative step drifted from "
+                "the committed baseline",
+                SHARD_RULES["SHD210"][1], "error"))
+    return findings, report
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("paths", nargs="*", default=None,
@@ -116,6 +172,13 @@ def main(argv=None) -> int:
                     help="print the per-rule remediation table and exit")
     ap.add_argument("--no-trace", action="store_true",
                     help="skip the trace-sanitizer pass (no jax import)")
+    ap.add_argument("--no-shard", action="store_true",
+                    help="skip the abstract-layout (shardcheck) pass")
+    ap.add_argument("--shard", action="store_true",
+                    help="run the shardcheck pass (the default; kept as "
+                         "an explicit spelling for CI scripts)")
+    ap.add_argument("--layout-report", default=None, metavar="FILE",
+                    help="dump the per-op layout report JSON to FILE")
     ap.add_argument("--schedules", default=None, metavar="DIR",
                     help="check per-rank collective logs recorded via "
                          "PADDLE_SCHEDULE_LOG=DIR")
@@ -138,6 +201,19 @@ def main(argv=None) -> int:
 
     if not args.no_trace:
         findings.extend(_trace_self_check())
+    layout_report = None
+    if not args.no_shard:
+        shard_findings, layout_report = _shard_self_check(
+            compare_baseline=not args.update_baseline)
+        findings.extend(shard_findings)
+    if args.layout_report:
+        if layout_report is None:
+            print("--layout-report requires the shard pass "
+                  "(drop --no-shard)", file=sys.stderr)
+            return 2
+        with open(args.layout_report, "w") as f:
+            json.dump(layout_report, f, indent=1)
+        print(f"wrote layout report to {args.layout_report}")
     if args.schedules:  # needs jax only for the Finding type's module
         from paddle_tpu.analysis.schedule import load_schedules
         from paddle_tpu.analysis.tracecheck import \
@@ -152,6 +228,11 @@ def main(argv=None) -> int:
         with open(args.baseline, "w") as f:
             json.dump(sorted(f2.key() for f2 in findings), f, indent=1)
         print(f"wrote {len(findings)} finding keys to {args.baseline}")
+        if layout_report is not None:
+            from paddle_tpu.analysis.shardcheck import baseline_view
+            with open(LAYOUT_BASELINE, "w") as f:
+                json.dump(baseline_view(layout_report), f, indent=1)
+            print(f"wrote layout baseline to {LAYOUT_BASELINE}")
         return 0
 
     if args.as_json:
@@ -165,9 +246,9 @@ def main(argv=None) -> int:
                 print(f"    fix: {f.hint}")
         dt = time.perf_counter() - t0
         known = len(findings) - len(fresh)
-        print(f"\nlint: {n_ast} ast + {len(findings) - n_ast} trace "
-              f"finding(s), {known} baselined, {len(fresh)} new "
-              f"({dt:.1f}s)")
+        print(f"\nlint: {n_ast} ast + {len(findings) - n_ast} "
+              f"trace/shard finding(s), {known} baselined, {len(fresh)} "
+              f"new ({dt:.1f}s)")
     errors = [f for f in fresh if f.severity == "error"]
     return 1 if errors else 0
 
