@@ -20,6 +20,7 @@ writes in a background thread.
 """
 from __future__ import annotations
 
+import atexit
 import functools
 import io
 import json
@@ -84,6 +85,90 @@ def _timed(kind):
 _META_NAME = "metadata.json"
 _FORMAT_VERSION = 2
 _async_lock = threading.Lock()
+
+
+class AsyncSaveHandle:
+    """Handle to an in-flight async save's writer thread.
+
+    The writer thread stays ``daemon=True`` (a hung filesystem must not
+    wedge interpreter shutdown forever), but every live handle is drained
+    by an atexit hook with a bounded timeout so a normally-exiting
+    process never tears a persistent save mid-write — the failure mode
+    that used to require the verify-on-load path to catch much later.
+
+    ``join(timeout)`` keeps the old returned-Thread contract;
+    ``wait(timeout)`` additionally re-raises any exception the writer
+    hit and returns True only when the write fully completed. ``error``
+    exposes the writer's exception without raising.
+    """
+
+    def __init__(self, thread: threading.Thread, path: str):
+        self._thread = thread
+        self.path = path
+        self.error: Optional[BaseException] = None
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        self._thread.join(timeout)
+
+    def is_alive(self) -> bool:
+        return self._thread.is_alive()
+
+    def done(self) -> bool:
+        return not self._thread.is_alive()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Join the writer; False on join timeout, raises the writer's
+        exception if it failed, True when the save landed completely.
+        Join latency is recorded in checkpoint_async_join_seconds."""
+        t0 = time.monotonic()
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            return False
+        _instr.record_async_join(time.monotonic() - t0)
+        _prune_live_handles()  # keep the queue-depth gauge honest
+        if self.error is not None:
+            raise self.error
+        return True
+
+
+# every not-yet-joined async handle, drained at interpreter exit so a
+# daemon writer thread is never killed mid-write on a clean shutdown
+_live_handles: List[AsyncSaveHandle] = []
+_live_lock = threading.Lock()
+
+
+def _prune_live_handles() -> None:
+    with _live_lock:
+        _live_handles[:] = [h for h in _live_handles if h.is_alive()]
+        _instr.record_async_queue_depth(len(_live_handles))
+
+
+def _track_handle(handle: AsyncSaveHandle) -> None:
+    with _live_lock:
+        _live_handles[:] = [h for h in _live_handles if h.is_alive()]
+        _live_handles.append(handle)
+        _instr.record_async_queue_depth(
+            sum(1 for h in _live_handles if h.is_alive()))
+
+
+def drain_async_saves(timeout: Optional[float] = None) -> bool:
+    """Join every in-flight async save (atexit hook; callable directly
+    by emergency paths). Returns True when none remain running."""
+    if timeout is None:
+        raw = os.environ.get("PADDLE_CKPT_DRAIN_TIMEOUT", "").strip()
+        timeout = float(raw) if raw else 60.0
+    deadline = time.monotonic() + timeout
+    with _live_lock:
+        handles = list(_live_handles)
+    ok = True
+    for h in handles:
+        h.join(max(0.0, deadline - time.monotonic()))
+        ok = ok and not h.is_alive()
+    _prune_live_handles()
+    return ok
+
+
+atexit.register(drain_async_saves)
 
 
 def _flatten(state_dict, prefix="", parents=None):
@@ -306,9 +391,23 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
         _instr.record_checkpoint("save", time.perf_counter() - t0)
 
     if async_save:
-        t = threading.Thread(target=_do_save, daemon=True)
+        handle: List[AsyncSaveHandle] = []
+
+        def _async_body():
+            try:
+                # preemption drills kill the writer exactly here — mid
+                # persistent write, before any byte lands — so tests can
+                # pin that an interrupted async save is never marked good
+                _chaos.site("ckpt.async_write.kill")
+                _do_save()
+            except BaseException as e:  # noqa: BLE001 — surfaced via wait()
+                handle[0].error = e
+
+        t = threading.Thread(target=_async_body, daemon=True)
+        handle.append(AsyncSaveHandle(t, path))
         t.start()
-        return t
+        _track_handle(handle[0])
+        return handle[0]
     _do_save()
     return None
 
@@ -513,3 +612,59 @@ def load_state_dict(state_dict, path, process_group=None,
         else:
             container, leaf = parents[key]
             container[leaf] = new_arr
+
+
+def verify_checkpoint(path: str, unique_id: Optional[int] = None) -> Dict:
+    """Integrity-check a completed checkpoint WITHOUT loading tensors:
+    metadata parses, every referenced file exists, and every shard with a
+    recorded crc32/nbytes matches on disk. Raises
+    CheckpointCorruptionError on the first violation; returns the parsed
+    metadata dict on success. This is the post-join gate the
+    resilience.CheckpointManager runs before a persistent async save may
+    be marked good."""
+    if unique_id is not None:
+        path = os.path.join(path, str(unique_id))
+    try:
+        with open(os.path.join(path, _META_NAME)) as f:
+            meta = json.load(f)
+    except FileNotFoundError as e:
+        raise CheckpointCorruptionError(
+            f"checkpoint at {path} has no {_META_NAME} "
+            "(incomplete or never-finished save)") from e
+    except json.JSONDecodeError as e:
+        raise CheckpointCorruptionError(
+            f"checkpoint metadata {path}/{_META_NAME} is unparseable: "
+            f"{e}") from e
+    if "state" not in meta or "storage" not in meta:
+        raise CheckpointCorruptionError(
+            f"checkpoint metadata {path}/{_META_NAME} lacks "
+            "state/storage sections")
+    for key, entries in meta["storage"].items():
+        for ent in entries:
+            full = os.path.join(path, ent["file"])
+            if not os.path.exists(full):
+                raise CheckpointCorruptionError(
+                    f"checkpoint shard {ent['file']} (for '{key}') is "
+                    "missing")
+            if ent.get("offsets") is None or ent.get("crc32") is None:
+                continue  # python-leaf pickle / pre-integrity chunk
+            # stream the crc: this runs on the training thread (post-join
+            # gate) and multi-GB shards must not be slurped into RAM
+            crc, seen = 0, 0
+            with open(full, "rb") as f:
+                while True:
+                    chunk = f.read(4 << 20)
+                    if not chunk:
+                        break
+                    crc = zlib.crc32(chunk, crc)
+                    seen += len(chunk)
+            nbytes = ent.get("nbytes")
+            if nbytes is not None and seen != int(nbytes):
+                raise CheckpointCorruptionError(
+                    f"checkpoint shard {ent['file']}: {seen} bytes "
+                    f"on disk, metadata says {nbytes} (truncated write?)")
+            if crc & 0xFFFFFFFF != int(ent["crc32"]):
+                raise CheckpointCorruptionError(
+                    f"checkpoint shard {ent['file']}: crc32 mismatch "
+                    "(bit rot or partial write)")
+    return meta

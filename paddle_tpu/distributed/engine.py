@@ -131,13 +131,19 @@ class Engine:
 
     def fit(self, train_data, epochs: int = 1, batch_size=None, steps=None,
             log_freq: int = 10, verbose: int = 1, runlog=None,
-            step_guard=None):
+            step_guard=None, preempt_guard=None, checkpointer=None):
         """train_data: iterable of (inputs, labels) batches. runlog: a
         profiler.RunLog (or path for one) receiving per-step records.
         step_guard: optional resilience.StepGuard — the compiled trainer
         applies its update inside train_step, so here the guard is a
         detector: "skip" only counts the event (use abort-class actions +
-        checkpoint fallback to recover poisoned optimizer state)."""
+        checkpoint fallback to recover poisoned optimizer state).
+        preempt_guard/checkpointer: as in hapi.Model.fit — the tiered
+        checkpointer fires at each step boundary (NOTE: its state_fn must
+        read through the trainer's sync_model/sync_optimizer_state if the
+        compiled step owns the weights), and a preemption notice triggers
+        a deadline-aware emergency save then raises resilience.Preempted
+        (eval/metrics flush skipped)."""
         from ..resilience import chaos as _chaos
         tr = self._build_trainer()
         rl = _prof.RunLog(runlog) if isinstance(runlog, str) else runlog
@@ -173,12 +179,41 @@ class Engine:
                             step_time_ms=(time.perf_counter() - t0) * 1e3,
                             tokens=_tokens_of(batch))
                     step += 1
+                    if checkpointer is not None:
+                        checkpointer.maybe_save(step)
+                    if preempt_guard is not None and \
+                            preempt_guard.should_stop(step=step):
+                        self._emergency_stop(preempt_guard, checkpointer,
+                                             step)
                     if steps is not None and step >= steps:
+                        if checkpointer is not None:
+                            checkpointer.wait()
                         return history
+            if checkpointer is not None:
+                checkpointer.wait()
             return history
         finally:
             if rl is not None and isinstance(runlog, str):
                 rl.close()
+            if checkpointer is not None:
+                checkpointer.poll()  # finished writers: verify+mark even
+                # when leaving via StepGuardAbort/Preempted
+
+    def _emergency_stop(self, preempt_guard, checkpointer, step):
+        """Preemption at a step boundary: emergency-save within the grace
+        deadline, then raise Preempted (optional work skipped)."""
+        from ..resilience.preempt import Preempted
+        tr = self._trainer
+        if tr is not None and hasattr(tr, "sync_model"):
+            tr.sync_model()  # the compiled step owns the weights
+        if tr is not None and hasattr(tr, "sync_optimizer_state"):
+            tr.sync_optimizer_state()
+        saved = None
+        if checkpointer is not None:
+            saved = checkpointer.emergency_save(
+                step, deadline=preempt_guard.remaining())
+        raise Preempted(step, saved_step=saved,
+                        source=preempt_guard.source or "unknown")
 
     def evaluate(self, valid_data, steps=None):
         losses = []

@@ -11,6 +11,7 @@ and expose them to training loops or the launcher.
 """
 from __future__ import annotations
 
+import os
 import time
 from enum import Enum
 from typing import List, Optional
@@ -21,11 +22,22 @@ class ElasticStatus(Enum):
     ERROR = "error"
     HOLD = "hold"
     RESTART = "restart"
+    PREEMPT = "preempt"
     EXIT = "exit"
 
 
 class ElasticManager:
-    """Minimal elastic membership manager over the TCPStore heartbeat."""
+    """Minimal elastic membership manager over the TCPStore heartbeat.
+
+    `generation` is the supervisor/launcher restart generation
+    (PADDLE_RESTART_GENERATION — both tools/supervise.py and
+    distributed/launch thread it), so in-process code can tell a fresh
+    job from attempt N of a self-healing one. Dead peers are classified:
+    a rank that published a preemption notice (resilience.preempt rank
+    key) before dying was *reclaimed*, not crashed — `health_check`
+    reports PREEMPT when every dead member was, which a scheduler treats
+    as routine (restart, don't alert) versus RESTART (something broke).
+    """
 
     def __init__(self, store=None, rank: Optional[int] = None,
                  world: Optional[int] = None, interval: float = 5.0,
@@ -38,6 +50,8 @@ class ElasticManager:
         self.world = world if world is not None else w
         self.enabled = self.world > 1
         self.stale_after = stale_after
+        self.generation = int(
+            os.environ.get("PADDLE_RESTART_GENERATION", "0") or 0)
         self._hb = None
         if self.enabled:
             self._hb = Heartbeat(store or create_or_get_global_tcp_store(),
@@ -53,13 +67,46 @@ class ElasticManager:
             return []
         return self._hb.dead_peers(stale_after=self.stale_after)
 
+    def preempted_members(self,
+                          dead: Optional[List[int]] = None) -> List[int]:
+        """Dead peers that published a preemption notice before going
+        away — reclaimed capacity, not a code failure. Pass a
+        dead_members() snapshot to classify it without re-sweeping the
+        heartbeats (one store round-trip per rank otherwise)."""
+        if self._hb is None:
+            return []
+        from ...resilience.preempt import rank_key
+        store = self._hb.store
+        out = []
+        for r in (self.dead_members() if dead is None else dead):
+            try:
+                if store.check([rank_key(r)]):
+                    out.append(r)
+            except Exception:  # noqa: BLE001 — store flake: call it dead
+                pass
+        return out
+
+    def crashed_members(self) -> List[int]:
+        """Dead peers with NO preemption notice: genuine failures."""
+        dead = self.dead_members()  # one snapshot for both classes
+        preempted = set(self.preempted_members(dead))
+        return [r for r in dead if r not in preempted]
+
     def health_check(self) -> ElasticStatus:
-        """HOLD while peers are healthy; RESTART when membership broke
-        (reference: manager watch loop -> restart decision)."""
+        """HOLD while peers are healthy; PREEMPT when membership broke
+        but every dead member announced a preemption (routine reclaim —
+        restart without alerting); RESTART when any member died without
+        notice (reference: manager watch loop -> restart decision)."""
         if not self.enabled:
             return ElasticStatus.HOLD
-        return ElasticStatus.RESTART if self.dead_members() \
-            else ElasticStatus.HOLD
+        dead = self.dead_members()
+        if not dead:
+            return ElasticStatus.HOLD
+        # classify the SAME snapshot the decision is about: one sweep
+        preempted = set(self.preempted_members(dead))
+        if preempted and all(r in preempted for r in dead):
+            return ElasticStatus.PREEMPT
+        return ElasticStatus.RESTART
 
     def exit(self, completed: bool = True) -> ElasticStatus:
         if self._hb is not None:

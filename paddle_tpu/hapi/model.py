@@ -176,11 +176,23 @@ class Model:
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
             accumulate_grad_batches=1, num_iters=None, runlog=None,
-            step_guard=None):
+            step_guard=None, preempt_guard=None, checkpointer=None):
         """step_guard: an optional resilience.StepGuard checked on every
         step's loss before backward/update — "skip" drops the update (the
         whole accumulation window when accumulating), "abort" raises
-        StepGuardAbort out of fit."""
+        StepGuardAbort out of fit.
+
+        preempt_guard: an optional resilience.PreemptionGuard polled at
+        every step boundary; once any rank holds a preemption notice the
+        loop performs a deadline-aware emergency save through
+        `checkpointer` (skipping eval/metrics flush/end callbacks) and
+        raises resilience.Preempted.
+
+        checkpointer: an optional resilience.TieredCheckpointer driven at
+        each step boundary (RAM snapshots every `memory_every` steps,
+        async persistent saves every `persist_every`); its background
+        saves are drained (join + verify + mark_good) before fit
+        returns."""
         assert self._optimizer is not None and self._loss is not None, \
             "call prepare(optimizer, loss) first"
         rl = _prof.RunLog(runlog) if isinstance(runlog, str) else runlog
@@ -201,15 +213,23 @@ class Model:
         try:
             self._fit_loop(loader, eval_loader, cbs, epochs, eval_freq,
                            accumulate_grad_batches, num_iters, rl,
-                           step_guard)
+                           step_guard, preempt_guard, checkpointer)
         finally:
             if rl is not None and isinstance(runlog, str):
                 rl.close()
+            if checkpointer is not None:
+                # even when leaving via StepGuardAbort/Preempted, finished
+                # background writers must still be verified + marked good
+                # (non-blocking: in-flight writers are left to atexit) —
+                # the abort-recovery path reads the ledger next
+                checkpointer.poll()
+        if checkpointer is not None:
+            checkpointer.wait()  # mark cadence saves good before returning
         cbs.on_train_end()
 
     def _fit_loop(self, loader, eval_loader, cbs, epochs, eval_freq,
                   accumulate_grad_batches, num_iters, rl,
-                  step_guard=None):
+                  step_guard=None, preempt_guard=None, checkpointer=None):
         steps_done = 0
         for epoch in range(epochs):
             for m in self._metrics:
@@ -258,6 +278,19 @@ class Model:
                 logs = self._metric_logs(loss)
                 cbs.on_train_batch_end(step, logs)
                 steps_done += 1
+                # cadence saves only at optimizer-update boundaries, or
+                # accumulation would inflate the save rate by the window
+                # size; step ids count loader (micro-)steps throughout
+                if checkpointer is not None and update:
+                    checkpointer.maybe_save(steps_done)
+                # preemption is checked EVERY micro-batch — reaction
+                # latency beats boundary alignment, and the state is
+                # consistent mid-window (optimizer untouched; only the
+                # partial gradient window is lost, as on any restart)
+                if preempt_guard is not None and \
+                        preempt_guard.should_stop(step=steps_done):
+                    self._emergency_stop(preempt_guard, checkpointer,
+                                         steps_done)
                 if num_iters is not None and steps_done >= num_iters:
                     break
             if pending_update and not window_poisoned:
@@ -275,6 +308,20 @@ class Model:
                 break
             if num_iters is not None and steps_done >= num_iters:
                 break
+
+    def _emergency_stop(self, preempt_guard, checkpointer, steps_done):
+        """Preemption notice at a step boundary: land the emergency
+        checkpoint inside the grace window (all optional work — eval,
+        metrics flush, end-of-training callbacks — is skipped by the
+        raise) and surface resilience.Preempted to the caller, who maps
+        it to PREEMPTED_EXIT_CODE for the supervisor."""
+        from ..resilience.preempt import Preempted
+        saved = None
+        if checkpointer is not None:
+            saved = checkpointer.emergency_save(
+                steps_done, deadline=preempt_guard.remaining())
+        raise Preempted(steps_done, saved_step=saved,
+                        source=preempt_guard.source or "unknown")
 
     def _run_eval(self, loader, cbs):
         for m in self._metrics:

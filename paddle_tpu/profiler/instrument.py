@@ -27,6 +27,10 @@ Catalog (names are a stable API — see README "Observability"):
   resilience_giveups_total{site}         retry budget exhausted (raise)
   resilience_ckpt_events_total{event}    corrupt_detected|fallback|gc
   resilience_guard_events_total{kind,action}   StepGuard nan/spike events
+  resilience_preemptions_total{source}   resilience/preempt.py notices
+  resilience_emergency_save_seconds      preemption emergency-save wall time
+  checkpoint_async_queue_depth           in-flight async writer threads
+  checkpoint_async_join_seconds          async writer join (drain) latency
 """
 from __future__ import annotations
 
@@ -57,6 +61,10 @@ CATALOG = (
     "resilience_giveups_total",
     "resilience_ckpt_events_total",
     "resilience_guard_events_total",
+    "resilience_preemptions_total",
+    "resilience_emergency_save_seconds",
+    "checkpoint_async_queue_depth",
+    "checkpoint_async_join_seconds",
 )
 
 _enabled = _m._ENABLED  # bind the cell once: hot-path guard is _enabled[0]
@@ -200,3 +208,36 @@ def record_guard_event(kind: str, action: str) -> None:
                    "StepGuard anomalies by kind and action taken",
                    labelnames=("kind", "action")).labels(
         kind=kind, action=action).inc()
+
+
+def record_preemption(source: str) -> None:
+    if not _enabled[0]:
+        return
+    _reg().counter("resilience_preemptions_total",
+                   "preemption notices by source "
+                   "(signal|file|env|chaos|peer|api)",
+                   labelnames=("source",)).labels(source=source).inc()
+
+
+def record_emergency_save(seconds: float) -> None:
+    if not _enabled[0]:
+        return
+    _reg().histogram("resilience_emergency_save_seconds",
+                     "deadline-driven emergency checkpoint wall seconds",
+                     buckets=_TIME_BUCKETS).observe(seconds)
+
+
+def record_async_queue_depth(depth: int) -> None:
+    if not _enabled[0]:
+        return
+    _reg().gauge("checkpoint_async_queue_depth",
+                 "async checkpoint writer threads not yet joined"
+                 ).set(float(depth))
+
+
+def record_async_join(seconds: float) -> None:
+    if not _enabled[0]:
+        return
+    _reg().histogram("checkpoint_async_join_seconds",
+                     "wall seconds spent joining async checkpoint "
+                     "writers", buckets=_TIME_BUCKETS).observe(seconds)

@@ -17,34 +17,64 @@ package is that subsystem, in four parts that compose:
   * ``guards`` — ``StepGuard``: NaN/inf and loss-spike detection in the
     fit loops with skip/warn/abort policies.
 
+Preemption tolerance (the single most common TPU failure mode —
+maintenance events and spot reclaims) is its own trio:
+
+  * ``preempt``  — ``PreemptionGuard``: SIGTERM/SIGUSR1 + file/env/chaos
+    notice sources, TCPStore cross-rank consensus ("any rank noticed →
+    all ranks save at the next step boundary"), and the monotonic grace
+    deadline that drives the emergency save; ``Preempted`` /
+    ``PREEMPTED_EXIT_CODE`` tell the supervisor it was a reclaim, not a
+    crash.
+  * ``snapshot`` — ``TieredCheckpointer``: cheap in-host-RAM snapshots
+    every ``memory_every`` steps + persistent async saves every
+    ``persist_every``, restore-from-freshest-valid-tier, and the
+    synchronous deadline-aware ``emergency_save``. Persistent async
+    steps are marked good only after writer join + integrity re-verify.
+  * ``tools/supervise.py`` — the restart loop that wraps the training
+    command, backs off via ``RetryPolicy``, threads the elastic
+    generation env, and writes a crash report per attempt.
+
 Everything reports through the PR-1 metrics catalog under
 ``resilience_*`` (see profiler.instrument); every knob has an env-var
-twin (``PADDLE_CHAOS_PLAN``/``PADDLE_CHAOS_SEED``, ``PADDLE_RETRY_*``)
-so drills run against unmodified training scripts. ``tools/chaos_drill.py``
-is the end-to-end seeded drill.
+twin (``PADDLE_CHAOS_PLAN``/``PADDLE_CHAOS_SEED``, ``PADDLE_RETRY_*``,
+``PADDLE_PREEMPT_GRACE``/``PADDLE_PREEMPT_NOTICE_FILE``) so drills run
+against unmodified training scripts. ``tools/chaos_drill.py`` is the
+end-to-end seeded drill (``--preempt`` for the kill→restart→resume
+loop).
 """
 from . import chaos
 from .chaos import FaultInjected, FaultPlan
 from .guards import GuardEvent, StepGuard, StepGuardAbort
+from .preempt import (PREEMPTED_EXIT_CODE, Preempted, PreemptionGuard)
 from .retry import RetryPolicy, policy_from_env, retrying
 
 __all__ = [
     "chaos", "FaultPlan", "FaultInjected",
     "RetryPolicy", "retrying", "policy_from_env",
-    "CheckpointManager", "CheckpointCorruptionError",
+    "CheckpointManager", "CheckpointCorruptionError", "ManagedAsyncSave",
     "StepGuard", "StepGuardAbort", "GuardEvent",
+    "PreemptionGuard", "Preempted", "PREEMPTED_EXIT_CODE",
+    "MemorySnapshot", "TieredCheckpointer",
 ]
 
-_LAZY = {"CheckpointManager", "CheckpointCorruptionError"}
+# name -> submodule for attributes resolved lazily: ckpt (and snapshot,
+# which imports it) depend on distributed.checkpoint, which itself
+# imports resilience.chaos — resolve on first touch to keep the package
+# import acyclic
+_LAZY = {
+    "CheckpointManager": "ckpt", "CheckpointCorruptionError": "ckpt",
+    "ManagedAsyncSave": "ckpt",
+    "MemorySnapshot": "snapshot", "TieredCheckpointer": "snapshot",
+}
 
 
 def __getattr__(name):
-    # ckpt depends on distributed.checkpoint, which itself imports
-    # resilience.chaos — resolve lazily to keep the package import acyclic
-    # (import_module, not `from . import`: the fromlist path re-enters
-    # this __getattr__ and recurses)
-    if name in _LAZY or name == "ckpt":
+    # import_module, not `from . import`: the fromlist path re-enters
+    # this __getattr__ and recurses
+    modname = _LAZY.get(name, name if name in ("ckpt", "snapshot") else None)
+    if modname is not None:
         import importlib
-        mod = importlib.import_module(".ckpt", __name__)
-        return mod if name == "ckpt" else getattr(mod, name)
+        mod = importlib.import_module("." + modname, __name__)
+        return mod if name == modname else getattr(mod, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -64,9 +64,11 @@ SITES = {
     "ckpt.shard_read": "site",
     "ckpt.meta_write": "site",
     "ckpt.shard_bytes": "mangle",
+    "ckpt.async_write.kill": "site",
     "hc.round": "site",
     "train.step": "site",
     "train.loss": "poison",
+    "preempt.notice": "site",
 }
 
 _CONTROL_KINDS = ("delay", "error", "die")

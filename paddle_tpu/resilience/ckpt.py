@@ -21,14 +21,61 @@ import shutil
 from typing import List, Optional
 
 from ..distributed.checkpoint import (CheckpointCorruptionError,
-                                      load_state_dict, save_state_dict)
+                                      load_state_dict, save_state_dict,
+                                      verify_checkpoint)
 from ..profiler import instrument as _instr
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["CheckpointManager", "CheckpointCorruptionError"]
+__all__ = ["CheckpointManager", "CheckpointCorruptionError",
+           "ManagedAsyncSave"]
 
 _GOOD_NAME = "_GOOD.json"
+
+
+class ManagedAsyncSave:
+    """An async save whose ledger entry is *earned*, not assumed: the step
+    is recorded good only after ``wait()`` has (a) joined the writer
+    thread, (b) re-raised any exception it hit, and (c) re-verified the
+    on-disk integrity metadata. A process killed mid-async-write (the
+    preemption drill) therefore never leaves a good-marked torn
+    checkpoint — ``load_latest`` simply never considers it."""
+
+    def __init__(self, manager: "CheckpointManager", step: int, handle):
+        self.manager = manager
+        self.step = int(step)
+        self.handle = handle
+        self._marked = False
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        self.handle.join(timeout)
+
+    def done(self) -> bool:
+        return self.handle.done()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Join + verify + mark_good. False on join timeout; raises the
+        writer's exception or CheckpointCorruptionError (either way the
+        step stays out of the good ledger). Only the coordinator rank
+        verifies/marks: non-coordinator writers finish before the
+        coordinator's merged metadata.json exists (their verify would
+        race it and misreport a healthy save), and mark_good is
+        coordinator-only anyway.
+
+        The verify re-reads the checkpoint on the CALLING thread —
+        deliberate: marking good from a background thread would race the
+        ledger with concurrent sync saves/GC. For huge checkpoints that
+        read is the price of the no-torn-save guarantee; callers who
+        cannot afford it at a step boundary should wait() from their own
+        drain point instead of TieredCheckpointer.poll()."""
+        if not self.handle.wait(timeout):
+            return False
+        if not self._marked:
+            if self.manager.coordinator:
+                verify_checkpoint(self.manager.root, unique_id=self.step)
+                self.manager.mark_good(self.step)
+            self._marked = True
+        return True
 
 
 class CheckpointManager:
@@ -48,6 +95,7 @@ class CheckpointManager:
         self.keep = int(keep)
         self.coordinator = coordinator
         self.retry_policy = retry_policy
+        self._pending: List[ManagedAsyncSave] = []
         os.makedirs(root, exist_ok=True)
 
     # -- ledger ---------------------------------------------------------------
@@ -85,14 +133,60 @@ class CheckpointManager:
     # -- save/load ------------------------------------------------------------
     def save(self, state_dict, step: int, **kw):
         """save_state_dict under root/<step>; on completion mark the step
-        good and GC beyond keep-N. Returns the writer thread for
-        async_save=True (the step is marked good only for sync saves —
-        async callers mark via mark_good() when the thread joins)."""
-        thread = save_state_dict(state_dict, self.root, unique_id=int(step),
+        good and GC beyond keep-N. For async_save=True returns a
+        ManagedAsyncSave (also queued on this manager — drain with
+        wait_pending()): the step is marked good ONLY after its wait()
+        joins the writer and the integrity metadata re-verifies, so an
+        interrupted background write can never enter the good ledger."""
+        handle = save_state_dict(state_dict, self.root, unique_id=int(step),
                                  retry_policy=self.retry_policy, **kw)
-        if thread is None:
+        if handle is None:
             self.mark_good(step)
-        return thread
+            return None
+        managed = ManagedAsyncSave(self, int(step), handle)
+        self._pending.append(managed)
+        return managed
+
+    def pending(self) -> List[ManagedAsyncSave]:
+        """Async saves not yet joined+verified (oldest first)."""
+        return list(self._pending)
+
+    def wait_pending(self, timeout: Optional[float] = None,
+                     raise_on_error: bool = False) -> List[int]:
+        """Drain queued async saves: join each writer, verify, mark good.
+        `timeout` is a TOTAL budget across all pending handles (a
+        deadline, not per-writer — the emergency path hands its remaining
+        grace here and must not wait N x grace). Returns the steps
+        successfully marked. Failed saves are logged (and re-raised when
+        raise_on_error) but never marked; joins that exhaust the budget
+        stay queued."""
+        import time as _time
+        deadline = None if timeout is None \
+            else _time.monotonic() + max(0.0, timeout)
+        marked: List[int] = []
+        still: List[ManagedAsyncSave] = []
+        pending, self._pending = self._pending, []
+        try:
+            for i, m in enumerate(pending):
+                budget = None if deadline is None \
+                    else max(0.0, deadline - _time.monotonic())
+                try:
+                    if m.wait(budget):
+                        marked.append(m.step)
+                    else:
+                        still.append(m)  # writer still running
+                except Exception as e:  # noqa: BLE001 — writer error or
+                    # CheckpointCorruptionError: either way NOT marked
+                    logger.warning(
+                        "async checkpoint %s/%s failed before mark_good "
+                        "(%s); the step stays out of the good ledger",
+                        self.root, m.step, e)
+                    if raise_on_error:
+                        still.extend(pending[i + 1:])
+                        raise
+        finally:
+            self._pending = still + self._pending
+        return marked
 
     def mark_good(self, step: int) -> None:
         if not self.coordinator:

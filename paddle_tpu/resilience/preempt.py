@@ -1,0 +1,286 @@
+"""Preemption tolerance: notice sources, cross-rank consensus, deadlines.
+
+TPU fleets do not crash politely — they get *preempted*: a maintenance
+event or spot reclaim delivers SIGTERM and a short grace window, and the
+job is expected to come back by itself at the last good step. The single
+most common failure mode of a long run is therefore not a kernel bug but
+an un-handled kill. ``PreemptionGuard`` is the in-process half of
+surviving it (the out-of-process half is ``tools/supervise.py``):
+
+  * **Notice sources** — a SIGTERM/SIGUSR1 handler (``install()``), a
+    notice *file* (``PADDLE_PREEMPT_NOTICE_FILE`` — how tests and cloud
+    metadata watchers deliver a notice without signals), the
+    ``PADDLE_PREEMPT_NOTICE`` env twin, a chaos probe
+    (``preempt.notice`` — any injected error at that site counts as a
+    notice, so drills are seeded and deterministic), and ``notify()``
+    for direct API use.
+  * **Cross-rank consensus** — the first rank to notice publishes
+    ``__preempt/notice`` (and its own ``__preempt/r<rank>``) to the
+    TCPStore; every other rank's ``should_stop()`` poll sees it, so *any
+    rank noticed ⇒ all ranks save at the next step boundary* instead of
+    one rank checkpointing while its peers plough on into a collective
+    that will never complete. ``fleet.ElasticManager`` reads the same
+    rank keys to report preempted (vs crashed) members.
+  * **Grace deadline** — ``remaining()`` counts down ``grace`` seconds
+    (``time.monotonic``, never wall clock) from the first notice; the
+    fit loops use it to drive a deadline-aware *emergency save* that
+    skips all optional work (eval, metrics flush) and then raise
+    ``Preempted``, which a training script converts to
+    ``PREEMPTED_EXIT_CODE`` so the supervisor can tell a preemption from
+    a crash.
+
+Every notice lands in ``resilience_preemptions_total{source}``.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import threading
+import time
+from typing import Optional, Sequence
+
+from ..profiler import instrument as _instr
+from . import chaos as _chaos
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["PreemptionGuard", "Preempted", "PREEMPTED_EXIT_CODE",
+           "NOTICE_KEY", "rank_key"]
+
+# A preempted process exits with this code after its emergency save so a
+# supervisor can distinguish "host is being reclaimed, restart me" from a
+# genuine crash. 84 collides with no shell/signal convention (126+ are
+# shell-reserved, 128+N are signal deaths).
+PREEMPTED_EXIT_CODE = 84
+
+# Store keys for cross-rank consensus. NOTICE_KEY is the broadcast flag
+# ("somebody got the notice"); rank_key(r) records WHICH ranks were
+# preempted, which fleet.ElasticManager uses to classify dead members.
+NOTICE_KEY = "__preempt/notice"
+
+
+def rank_key(rank: int) -> str:
+    return f"__preempt/r{int(rank)}"
+
+
+class Preempted(RuntimeError):
+    """Raised out of a fit loop after the emergency checkpoint landed (or
+    was skipped because no checkpointer was wired). `step` is the number
+    of fully-completed loader (micro-)steps in this process — with
+    gradient accumulation a preemption mid-window drops the partial
+    gradients, like any restart; `saved_step` the (global) checkpoint
+    step that landed (None when nothing was saved)."""
+
+    def __init__(self, step: int, saved_step: Optional[int] = None,
+                 source: str = "unknown"):
+        self.step = int(step)
+        self.saved_step = saved_step
+        self.source = source
+        saved = f"emergency checkpoint at step {saved_step}" \
+            if saved_step is not None else "no checkpoint wired"
+        super().__init__(
+            f"preempted (source={source}) after step {step}; {saved}")
+
+
+class PreemptionGuard:
+    """Collects preemption notices and answers ``should_stop()`` at step
+    boundaries.
+
+    signals: which to trap on ``install()`` (SIGTERM + SIGUSR1 — the
+    usual reclaim warning pair). grace: seconds between first notice and
+    the hard kill (``PADDLE_PREEMPT_GRACE`` env twin). notice_file: path
+    whose existence is a notice (``PADDLE_PREEMPT_NOTICE_FILE`` twin).
+    store/rank: TCPStore consensus — pass the bootstrap store so all
+    ranks stop at the same step boundary; consensus_every throttles the
+    store poll to every Nth ``should_stop()`` (a store round-trip per
+    step is cheap but not free at scale).
+    """
+
+    def __init__(self, signals: Sequence[int] = (signal.SIGTERM,
+                                                 signal.SIGUSR1),
+                 grace: Optional[float] = None,
+                 notice_file: Optional[str] = None,
+                 store=None, rank: int = 0, consensus_every: int = 1):
+        if grace is None:
+            raw = os.environ.get("PADDLE_PREEMPT_GRACE", "").strip()
+            grace = float(raw) if raw else 10.0
+        if notice_file is None:
+            notice_file = os.environ.get(
+                "PADDLE_PREEMPT_NOTICE_FILE", "").strip() or None
+        self.signals = tuple(signals)
+        self.grace = float(grace)
+        self.notice_file = notice_file
+        self.store = store
+        self.rank = int(rank)
+        self.consensus_every = max(1, int(consensus_every))
+        self.source: Optional[str] = None
+        self._noticed = threading.Event()
+        self._noticed_at: Optional[float] = None  # monotonic
+        self._pending_source: Optional[str] = None  # set by the handler
+        self._finalized = False
+        self._lock = threading.Lock()
+        self._old_handlers = {}
+        self._polls = 0
+        # a set env twin is a notice delivered before the process even
+        # started (the cloud scheduler already knows) — but it is also
+        # inherited through a supervisor restart, where honoring it again
+        # would re-preempt every generation after ~1 step (restart
+        # livelock); only the first generation takes it
+        if os.environ.get("PADDLE_PREEMPT_NOTICE", "").strip() and \
+                not int(os.environ.get(
+                    "PADDLE_RESTART_GENERATION", "0") or 0):
+            self.notify("env")
+
+    # -- install/uninstall ----------------------------------------------------
+    def install(self) -> "PreemptionGuard":
+        """Trap the configured signals (main thread only — the interpreter
+        enforces it). Previous handlers are saved and restored by
+        ``uninstall()``. A restarted generation also clears the previous
+        generation's consensus keys here: when the store outlives the
+        workers, a stale ``__preempt/notice`` would otherwise re-preempt
+        the replacement process on its first step boundary — a restart
+        livelock with zero training progress."""
+        for sig in self.signals:
+            self._old_handlers[sig] = signal.signal(sig, self._on_signal)
+        gen = int(os.environ.get("PADDLE_RESTART_GENERATION", "0") or 0)
+        # never wipe keys a PRE-install notice of this very process just
+        # published (e.g. the env twin firing in __init__) — only clear
+        # truly stale state from the previous generation. The notice
+        # value is generation-tagged ("<gen>:<source>"), so a partial
+        # restart only deletes a notice OLDER than its own generation —
+        # a fresh notice a still-running peer just published survives.
+        if self.store is not None and not self._noticed.is_set():
+            try:
+                if gen > 0 and self.store.check([NOTICE_KEY]):
+                    k_gen = -1
+                    try:
+                        raw = self.store.get(NOTICE_KEY, timeout=1.0)
+                        k_gen = int(raw.decode().split(":", 1)[0])
+                    except (ValueError, UnicodeDecodeError):
+                        pass  # untagged/garbled: treat as stale
+                    if k_gen < gen:
+                        self.store.delete_key(NOTICE_KEY)
+                self.store.delete_key(rank_key(self.rank))
+            except Exception:  # noqa: BLE001 — no store, no stale keys
+                logger.debug("preempt: could not clear stale notice keys",
+                             exc_info=True)
+        # a notice FILE that already exists when a restarted generation
+        # boots is the previous generation's (the reclaim that caused the
+        # restart): consume it, or the replacement re-preempts itself
+        # every generation. A fresh event recreates the file.
+        if gen > 0 and self.notice_file and not self._noticed.is_set():
+            try:
+                os.remove(self.notice_file)
+            except OSError:
+                pass
+        return self
+
+    def uninstall(self) -> None:
+        for sig, old in self._old_handlers.items():
+            try:
+                signal.signal(sig, old)
+            except (ValueError, OSError):  # not main thread / torn down
+                pass
+        self._old_handlers.clear()
+
+    def __enter__(self) -> "PreemptionGuard":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+    def _on_signal(self, signum, frame) -> None:
+        # async-signal-minimal: the interrupted main thread may hold the
+        # store/metrics/logging locks, so the handler only flags — all
+        # bookkeeping (metric, log, store publish) happens at the next
+        # should_stop() poll in normal context
+        if self._noticed.is_set():
+            return
+        try:
+            name = signal.Signals(signum).name
+        except ValueError:
+            name = str(signum)
+        self._pending_source = f"signal:{name}"
+        self._noticed_at = time.monotonic()  # the grace clock starts NOW
+        self._noticed.set()
+
+    # -- notice ---------------------------------------------------------------
+    def notify(self, source: str = "api") -> None:
+        """Record a preemption notice (idempotent: only the first starts
+        the grace clock) and publish it to the store for peers. Normal
+        (non-handler) contexts only — signals go through _on_signal."""
+        with self._lock:
+            if not self._noticed.is_set():
+                self._noticed_at = time.monotonic()
+                self.source = source
+                self._noticed.set()
+        self._finalize_notice()
+
+    def _finalize_notice(self) -> None:
+        """The lock-touching half of a notice (once): metric, log, store
+        publish. Runs in normal context — either inline from notify() or
+        from the first should_stop() after a signal flagged us."""
+        with self._lock:
+            if self._finalized or not self._noticed.is_set():
+                return
+            self._finalized = True
+            if self.source is None:
+                self.source = self._pending_source or "unknown"
+        _instr.record_preemption(self.source.split(":", 1)[0])
+        logger.warning(
+            "preemption notice (source=%s): emergency checkpoint at next "
+            "step boundary, %.1fs grace", self.source, self.grace)
+        if self.store is not None:
+            gen = int(os.environ.get(
+                "PADDLE_RESTART_GENERATION", "0") or 0)
+            payload = f"{gen}:{self.source}".encode()
+            try:
+                self.store.set(NOTICE_KEY, payload)
+                self.store.set(rank_key(self.rank), payload)
+            except Exception:  # noqa: BLE001 — peers learn via their own
+                logger.warning("preempt: could not publish notice to "
+                               "store", exc_info=True)
+
+    def noticed(self) -> bool:
+        """Local view only — no polling, safe from any thread."""
+        return self._noticed.is_set()
+
+    # -- the step-boundary poll -----------------------------------------------
+    def should_stop(self, step: Optional[int] = None) -> bool:
+        """Poll every notice source; True once ANY rank was preempted.
+        Called by the fit loops after each completed step."""
+        if self._noticed.is_set():
+            self._finalize_notice()  # a signal may have flagged us
+            return True
+        self._polls += 1
+        # seeded drills: any injected error at this probe is a notice
+        try:
+            _chaos.site("preempt.notice")
+        except Exception:  # noqa: BLE001 — the injected kind is irrelevant
+            self.notify("chaos")
+            return True
+        if self.notice_file and os.path.exists(self.notice_file):
+            self.notify("file")
+            return True
+        if self.store is not None and \
+                self._polls % self.consensus_every == 0:
+            try:
+                if self.store.check([NOTICE_KEY]):
+                    self.notify("peer")
+                    return True
+            except Exception:  # noqa: BLE001 — store flake ≠ preemption
+                logger.debug("preempt: consensus poll failed",
+                             exc_info=True)
+        return False
+
+    # -- deadline -------------------------------------------------------------
+    def remaining(self) -> float:
+        """Grace seconds left (inf before any notice, can go negative)."""
+        at = self._noticed_at
+        if at is None:
+            return float("inf")
+        return self.grace - (time.monotonic() - at)
+
+    def deadline_exceeded(self) -> bool:
+        return self.remaining() <= 0.0

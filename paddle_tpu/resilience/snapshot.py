@@ -1,0 +1,278 @@
+"""Tiered checkpointing: cheap RAM snapshots + durable async saves.
+
+One checkpoint cadence cannot serve two masters: persistent saves are
+expensive enough that runs space them many minutes apart (losing up to a
+full interval on a kill), while recovering from a *soft* failure (NaN
+step, guard abort, desynced loader) needs something much fresher and
+does not need to survive the host. So checkpoint in tiers, the way the
+large-run postmortems (MegaScale, fault-tolerance practice in PAPERS.md)
+describe:
+
+  * **memory tier** — every ``memory_every`` steps, a host-RAM deep copy
+    of the state (``MemorySnapshot``). Costs one device→host transfer
+    and host memcpy; no filesystem, no metadata, gone with the process.
+  * **persistent tier** — every ``persist_every`` steps, the existing
+    ``CheckpointManager`` async save. The step enters the good ledger
+    only after the writer thread joined AND the integrity metadata
+    re-verified (``ManagedAsyncSave``), so a kill mid-write can never
+    shadow the last good step.
+  * **emergency save** — on a preemption notice, a *synchronous*,
+    deadline-aware persistent save of the current step that skips every
+    optional nicety; duration lands in
+    ``resilience_emergency_save_seconds``.
+
+``restore_latest`` picks the freshest tier that is actually valid:
+memory when it is newer than the newest good persistent step (in-process
+rollback), else the manager's verified fallback chain.
+
+``TieredCheckpointer`` is what the fit loops accept as ``checkpointer=``:
+they call ``maybe_save(step)`` at every step boundary and
+``emergency_save(step, deadline=...)`` when a ``PreemptionGuard`` fires.
+"""
+from __future__ import annotations
+
+import copy
+import logging
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..profiler import instrument as _instr
+from ..tensor import Tensor
+from .ckpt import CheckpointManager
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["MemorySnapshot", "TieredCheckpointer"]
+
+
+def _is_leaf_array(v) -> bool:
+    if isinstance(v, (Tensor, np.ndarray)):
+        return True
+    # jax.Array without importing jax at module scope in the hot path
+    return hasattr(v, "__array__") and hasattr(v, "dtype") and \
+        hasattr(v, "shape")
+
+
+class MemorySnapshot:
+    """The in-host-RAM tier: one deep host copy of a nested state dict.
+
+    ``take`` snapshots device arrays to host numpy (a device→host copy —
+    synchronous, so the snapshot is consistent at the step boundary);
+    ``restore`` writes the copies back into the *live* state dict,
+    re-placing arrays onto their current sharding/device. Python leaves
+    round-trip via deepcopy. Single-host by construction: each process
+    snapshots exactly the state it owns.
+    """
+
+    def __init__(self):
+        self.step: Optional[int] = None
+        self._flat: Optional[List[Tuple[tuple, object]]] = None
+        self.taken_at: Optional[float] = None  # monotonic, for staleness
+
+    def valid(self) -> bool:
+        return self._flat is not None
+
+    def _walk(self, d: Dict, path: tuple = ()):
+        for k, v in d.items():
+            if isinstance(v, dict):
+                yield from self._walk(v, path + (k,))
+            else:
+                yield path + (k,), v
+
+    def take(self, state_dict: Dict, step: int) -> None:
+        flat = []
+        for path, v in self._walk(state_dict):
+            if isinstance(v, Tensor):
+                flat.append((path, np.array(np.asarray(v._data))))
+            elif _is_leaf_array(v):
+                flat.append((path, np.array(np.asarray(v))))
+            else:
+                flat.append((path, copy.deepcopy(v)))
+        self._flat = flat
+        self.step = int(step)
+        self.taken_at = time.monotonic()
+
+    def restore(self, state_dict: Dict) -> int:
+        """Write the snapshot back into ``state_dict``'s live leaves;
+        returns the snapshot's step. Raises when never taken or when the
+        target's structure no longer matches."""
+        if self._flat is None:
+            raise ValueError("MemorySnapshot.restore: no snapshot taken")
+        import jax
+        import jax.numpy as jnp
+        for path, saved in self._flat:
+            container = state_dict
+            for k in path[:-1]:
+                container = container[k]
+            leaf = path[-1]
+            if leaf not in container:
+                raise KeyError(
+                    f"MemorySnapshot.restore: target lost leaf "
+                    f"{'/'.join(map(str, path))}")
+            tgt = container[leaf]
+            if isinstance(tgt, Tensor):
+                sharding = getattr(tgt._data, "sharding", None)
+                tgt._data = jax.device_put(saved, sharding) \
+                    if sharding is not None else jnp.asarray(saved)
+            elif isinstance(saved, np.ndarray):
+                container[leaf] = np.array(saved)
+            else:
+                container[leaf] = copy.deepcopy(saved)
+        return int(self.step)
+
+
+class TieredCheckpointer:
+    """Drives both tiers from the step boundary of a fit loop.
+
+    manager: the CheckpointManager owning the persistent directory.
+    state_fn: zero-arg callable returning the LIVE nested state dict to
+    snapshot/save (called at each cadence hit, so it may rebuild the
+    dict; the leaves must be the live Tensors for restore to land).
+    memory_every / persist_every: tier cadences in completed steps
+    (0 disables a tier). A step hitting both cadences persists (the
+    durable tier supersedes the RAM one at the same step).
+    async_persist: cadence saves use the background writer (emergency
+    saves are always synchronous).
+    step_offset: added to every step the fit loop reports — a resumed
+    process passes the restored step here so checkpoint ids stay global
+    (fit loops count from 0 in each generation) and cadences stay
+    aligned across restarts.
+    """
+
+    def __init__(self, manager: CheckpointManager,
+                 state_fn: Callable[[], Dict],
+                 memory_every: int = 0, persist_every: int = 0,
+                 async_persist: bool = True, step_offset: int = 0):
+        if memory_every < 0 or persist_every < 0:
+            raise ValueError("tier cadences must be >= 0")
+        self.manager = manager
+        self.state_fn = state_fn
+        self.memory_every = int(memory_every)
+        self.persist_every = int(persist_every)
+        self.async_persist = bool(async_persist)
+        self.step_offset = int(step_offset)
+        self.memory = MemorySnapshot()
+        self.last_persist_step: Optional[int] = None
+        self.last_emergency_step: Optional[int] = None
+
+    # -- cadence --------------------------------------------------------------
+    def maybe_save(self, step: int) -> Optional[str]:
+        """Call at each step boundary with the count of completed steps
+        (this process; step_offset globalizes it); returns which tier
+        fired ("persist" | "memory" | None)."""
+        step = int(step) + self.step_offset
+        if step <= 0:
+            return None
+        # opportunistically finalize finished background writers FIRST so
+        # the good ledger advances every step (non-blocking), not only on
+        # persist-cadence steps — a crash between cadences must not hide
+        # an already-landed checkpoint from load_latest
+        self.poll()
+        if self.persist_every and step % self.persist_every == 0:
+            self.persist(step)
+            return "persist"
+        if self.memory_every and step % self.memory_every == 0:
+            self.memory.take(self.state_fn(), step)
+            return "memory"
+        return None
+
+    def persist(self, step: int):
+        """One persistent-tier save (async by default) at GLOBAL `step`
+        (maybe_save already applied step_offset). The async handle is
+        queued on the manager; poll()/wait() mark it good later."""
+        self.last_persist_step = int(step)
+        handle = self.manager.save(self.state_fn(), int(step),
+                                   async_save=self.async_persist)
+        self.poll()
+        return handle
+
+    def poll(self) -> List[int]:
+        """Non-blocking: join+verify+mark_good every background save whose
+        writer already finished."""
+        done = [m for m in self.manager.pending() if m.done()]
+        if not done:
+            return []
+        return self.manager.wait_pending(timeout=0)
+
+    def wait(self, timeout: Optional[float] = None) -> List[int]:
+        """Blocking drain of all background saves (end of training)."""
+        return self.manager.wait_pending(timeout)
+
+    # -- emergency ------------------------------------------------------------
+    def emergency_save(self, step: int,
+                       deadline: Optional[float] = None) -> int:
+        """Synchronous, deadline-aware persistent save for a preemption:
+        no memory tier, no GC-blocking extras — land the bytes, verify,
+        mark good, return the (global) step. `deadline` is the grace
+        seconds left (bounds the metadata barrier wait); blowing it is
+        logged, not raised — a late checkpoint still beats none."""
+        step = int(step) + self.step_offset
+        t0 = time.monotonic()
+        bounded = deadline if deadline is not None and \
+            deadline != float("inf") else None
+        if any(m.step == step for m in self.manager.pending()):
+            # the cadence tier already has THIS step in flight: drain it
+            # (join+verify+mark_good) instead of starting a second writer
+            # for the same directory. If the drain times out or the write
+            # is torn we fall through to the synchronous save — safe even
+            # against a still-running writer, because every save body
+            # serializes on checkpoint._async_lock and shard/metadata
+            # writes are atomic-rename.
+            try:
+                if step in self.manager.wait_pending(timeout=bounded,
+                                                     raise_on_error=True):
+                    dt = time.monotonic() - t0
+                    _instr.record_emergency_save(dt)
+                    self.last_emergency_step = step
+                    logger.warning("emergency: in-flight cadence save at "
+                                   "step %d drained (%.2fs)", step, dt)
+                    return step
+            except Exception:  # noqa: BLE001 — torn write: redo it sync
+                logger.warning("emergency: draining in-flight save at "
+                               "step %d failed; re-saving synchronously",
+                               step, exc_info=True)
+        kw = {}
+        if bounded is not None:
+            # grace REMAINING after the drain, not the entry-time figure
+            kw["barrier_timeout"] = max(
+                0.5, bounded - (time.monotonic() - t0))
+        # NOTE: a still-running writer holds checkpoint._async_lock, so
+        # this blocks until it finishes — serialized, never corrupted; a
+        # writer hung on dead storage eats the grace, but a sync save to
+        # the same filesystem would hang identically
+        self.manager.save(self.state_fn(), step, async_save=False, **kw)
+        dt = time.monotonic() - t0
+        _instr.record_emergency_save(dt)
+        self.last_emergency_step = step
+        if deadline is not None and dt > deadline:
+            logger.warning(
+                "emergency save at step %d took %.2fs, past the %.2fs "
+                "grace deadline — the kill may have raced the write",
+                step, dt, deadline)
+        else:
+            logger.warning("emergency checkpoint landed at step %d "
+                           "(%.2fs)", step, dt)
+        return step
+
+    # -- restore --------------------------------------------------------------
+    def restore_latest(self, state_dict: Optional[Dict] = None) -> int:
+        """Restore from the freshest valid tier into ``state_dict``
+        (default: ``state_fn()``'s live dict). Memory wins only when
+        strictly newer than the newest good persistent step; a memory
+        restore that fails falls back to the persistent chain. Returns
+        the restored step; raises CheckpointCorruptionError when no tier
+        is restorable."""
+        target = self.state_fn() if state_dict is None else state_dict
+        persist_step = self.manager.latest_step()
+        mem_step = self.memory.step if self.memory.valid() else None
+        if mem_step is not None and \
+                (persist_step is None or mem_step > persist_step):
+            try:
+                return self.memory.restore(target)
+            except (KeyError, ValueError) as e:
+                logger.warning(
+                    "memory snapshot (step %s) unusable (%s); falling "
+                    "back to persistent tier", mem_step, e)
+        return self.manager.load_latest(target)

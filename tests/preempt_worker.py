@@ -1,0 +1,150 @@
+"""Preemption-drill training worker (subprocess target).
+
+A small deterministic `Model.fit` run wired with the full preemption
+stack: `PreemptionGuard` (signals + chaos notice), `TieredCheckpointer`
+(RAM tier + async persistent tier), resume-from-last-good on start, and
+the `Preempted -> PREEMPTED_EXIT_CODE` contract the supervisor keys on.
+
+    python tests/preempt_worker.py CKPT_ROOT --steps 8 --persist-every 2 \
+        [--mode signal|chaos] [--preempt-at 4] [--marker-dir DIR] \
+        [--step-sleep 0.05] [--seed 1234]
+
+mode=chaos: generation 0 installs a seeded FaultPlan that injects an
+error at the `preempt.notice` probe on hit `--preempt-at` — a fully
+deterministic preemption at that exact step boundary. Generation > 0
+runs clean (the reclaim happened; the replacement host trains on).
+
+mode=signal: no plan; the parent test SIGTERMs this process mid-fit
+(the pid and per-step progress land in marker-dir for it to aim with).
+
+Markers written to --marker-dir:
+    pid                         this process's pid (written at start)
+    progress                    rewritten with the global step each step
+    gen<G>.resume<S>            generation G started at global step S
+    emergency.<S>               emergency checkpoint landed at step S
+    done.<S>.w<H>               run finished at step S, weight hash H
+"""
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("ckpt_root")
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--persist-every", type=int, default=2)
+    ap.add_argument("--memory-every", type=int, default=1)
+    ap.add_argument("--mode", choices=("signal", "chaos"), default="chaos")
+    ap.add_argument("--preempt-at", type=int, default=4)
+    ap.add_argument("--marker-dir", default=None)
+    ap.add_argument("--step-sleep", type=float, default=0.0)
+    ap.add_argument("--grace", type=float, default=10.0)
+    ap.add_argument("--seed", type=int, default=1234)
+    args = ap.parse_args(argv)
+
+    import time
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.hapi.callbacks import Callback
+    from paddle_tpu.io import TensorDataset
+    from paddle_tpu.resilience import (CheckpointCorruptionError,
+                                       CheckpointManager, FaultPlan,
+                                       Preempted, PreemptionGuard,
+                                       PREEMPTED_EXIT_CODE,
+                                       TieredCheckpointer, chaos)
+
+    gen = int(os.environ.get("PADDLE_RESTART_GENERATION", "0") or 0)
+    marker_dir = args.marker_dir
+    if marker_dir:
+        os.makedirs(marker_dir, exist_ok=True)
+
+    def mark(name: str) -> None:
+        if marker_dir:
+            with open(os.path.join(marker_dir, name), "w") as f:
+                f.write("")
+
+    if marker_dir:
+        with open(os.path.join(marker_dir, "pid"), "w") as f:
+            f.write(str(os.getpid()))
+
+    # deterministic everything: same seed => same data, same init, and
+    # (mode=chaos) the same preemption at the same step boundary
+    paddle.seed(args.seed)
+    np.random.seed(args.seed % (2 ** 31))
+    x = np.random.randn(64, 4).astype(np.float32)
+    y = (x @ np.random.randn(4, 1)).astype(np.float32)
+    net = nn.Linear(4, 1)
+    model = Model(net)
+    model.prepare(optimizer.SGD(learning_rate=0.01,
+                                parameters=net.parameters()),
+                  nn.MSELoss())
+
+    mgr = CheckpointManager(args.ckpt_root, keep=4)
+    state = {"w": net.weight, "b": net.bias, "step": 0}
+    resume_step = 0
+    try:
+        resume_step = mgr.load_latest(state)
+    except CheckpointCorruptionError:
+        resume_step = 0  # nothing saved yet: fresh start
+    mark(f"gen{gen}.resume{resume_step}")
+
+    ckpt = TieredCheckpointer(
+        mgr, lambda: state, memory_every=args.memory_every,
+        persist_every=args.persist_every, step_offset=resume_step)
+    guard = PreemptionGuard(grace=args.grace).install()
+
+    if args.mode == "chaos" and gen == 0:
+        # hit N of the preempt.notice probe = the Nth should_stop poll =
+        # the boundary after N completed steps — exact and replayable
+        plan = FaultPlan(seed=args.seed)
+        plan.add("preempt.notice", "error", at=(args.preempt_at,))
+        chaos.install_plan(plan)
+
+    class _Progress(Callback):
+        """Per-step bookkeeping: global step into the saved state (so a
+        checkpoint knows where to resume), progress marker for the
+        parent test's aim, optional sleep so a signal can land mid-fit."""
+
+        def on_train_batch_end(self, step, logs=None):
+            state["step"] = resume_step + step + 1
+            if marker_dir:
+                with open(os.path.join(marker_dir, "progress"), "w") as f:
+                    f.write(str(state["step"]))
+            if args.step_sleep:
+                time.sleep(args.step_sleep)
+
+    ds = TensorDataset([paddle.to_tensor(x), paddle.to_tensor(y)])
+    remaining = args.steps - resume_step
+    if remaining > 0:
+        try:
+            model.fit(ds, batch_size=8, epochs=args.steps, verbose=0,
+                      shuffle=False, num_iters=remaining,
+                      callbacks=[_Progress()], preempt_guard=guard,
+                      checkpointer=ckpt)
+        except Preempted as p:
+            if p.saved_step is not None:
+                mark(f"emergency.{p.saved_step}")
+            sys.stderr.write(f"worker: {p}\n")
+            return PREEMPTED_EXIT_CODE
+        finally:
+            guard.uninstall()
+            chaos.clear_plan()
+    # final state: persist if the last step missed the cadence
+    if mgr.latest_step() != args.steps:
+        mgr.save(state, step=args.steps)
+    w_hash = int(np.abs(np.asarray(net.weight._data)).sum() * 1e6)
+    mark(f"done.{args.steps}.w{w_hash}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

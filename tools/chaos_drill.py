@@ -11,7 +11,16 @@ bit-for-bit.
 
     JAX_PLATFORMS=cpu python tools/chaos_drill.py [--seed 1234] [--json]
 
-Exit code 0 = all three recovery paths exercised and verified.
+``--preempt`` runs the preemption drill instead: a supervised training
+worker (tools/supervise.py wrapping tests/preempt_worker.py) gets a
+seeded chaos preemption notice at an exact step boundary, lands its
+emergency checkpoint, exits with PREEMPTED_EXIT_CODE, is restarted by
+the supervisor, resumes at the saved step (not zero), and finishes —
+deterministically per seed (same resumed step, same final weight hash).
+
+    JAX_PLATFORMS=cpu python tools/chaos_drill.py --preempt [--seed 1234]
+
+Exit code 0 = every exercised recovery path verified.
 """
 from __future__ import annotations
 
@@ -122,13 +131,93 @@ def run_drill(seed: int = 1234, verbose: bool = True):
         _metrics.reset_registry()
 
 
+def run_preempt_drill(seed: int = 1234, steps: int = 8, preempt_at: int = 4,
+                      persist_every: int = 2, verbose: bool = True,
+                      work_dir: str = None):
+    """The kill→restart→resume loop, end to end, under the supervisor.
+
+    Generation 0 of tests/preempt_worker.py takes a seeded chaos
+    preemption notice at the step-`preempt_at` boundary, emergency-saves,
+    and exits PREEMPTED_EXIT_CODE; tools/supervise.py restarts it;
+    generation 1 resumes at the saved step and finishes. Asserts the
+    resumed step, the exit-cause classification, and (per seed) the
+    deterministic final weight hash. Returns the report dict."""
+    import re
+    import subprocess
+    import sys as _sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ctx = tempfile.TemporaryDirectory() if work_dir is None else None
+    root = work_dir if work_dir is not None else ctx.name
+    try:
+        ckpt = os.path.join(root, "ckpt")
+        markers = os.path.join(root, "markers")
+        reports = os.path.join(root, "reports")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PADDLE_CHAOS_PLAN", None)  # the worker arms its own plan
+        r = subprocess.run(
+            [_sys.executable, os.path.join(repo, "tools", "supervise.py"),
+             "--max-restarts", "2", "--seed", str(seed),
+             "--report-dir", reports, "--",
+             _sys.executable, os.path.join(repo, "tests",
+                                           "preempt_worker.py"),
+             ckpt, "--steps", str(steps), "--persist-every",
+             str(persist_every), "--preempt-at", str(preempt_at),
+             "--mode", "chaos", "--seed", str(seed),
+             "--marker-dir", markers],
+            capture_output=True, timeout=300, env=env, cwd=repo)
+        err = r.stderr.decode()
+        assert r.returncode == 0, \
+            f"supervised run failed rc={r.returncode}:\n{err}"
+        got = sorted(os.listdir(markers))
+        assert f"emergency.{preempt_at}" in got, \
+            f"no emergency checkpoint marker: {got}"
+        assert "gen0.resume0" in got and \
+            f"gen1.resume{preempt_at}" in got, \
+            f"generation 1 did not resume at step {preempt_at}: {got}"
+        done = [m for m in got if m.startswith("done.")]
+        assert done, f"run never finished: {got}"
+        final_step, w_hash = re.match(r"done\.(\d+)\.w(\d+)",
+                                      done[0]).groups()
+        with open(os.path.join(reports, "crash_report_0.json")) as f:
+            rep0 = json.load(f)
+        assert rep0["cause"] == "preempted" and rep0["exit_code"] == 84, \
+            f"generation 0 misclassified: {rep0['cause']}"
+        assert not os.path.exists(
+            os.path.join(reports, "crash_report_2.json")), \
+            "more than one restart — resume did not stick"
+        # the good ledger must contain the emergency step
+        with open(os.path.join(ckpt, "_GOOD.json")) as f:
+            good = json.load(f)
+        assert preempt_at in good, f"emergency step not in ledger: {good}"
+        report = {"seed": seed, "resumed_step": preempt_at,
+                  "final_step": int(final_step), "w_hash": int(w_hash),
+                  "generations": 2, "ok": True}
+        if verbose:
+            print(f"preempt drill (seed={seed}): notice at step "
+                  f"{preempt_at} -> emergency ckpt -> supervisor restart "
+                  f"-> resumed at {preempt_at} -> finished at "
+                  f"{final_step} (w_hash={w_hash}) — kill/restart/resume "
+                  "verified")
+        return report
+    finally:
+        if ctx is not None:
+            ctx.cleanup()
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seed", type=int, default=1234)
     ap.add_argument("--json", action="store_true",
                     help="print the full report as JSON")
+    ap.add_argument("--preempt", action="store_true",
+                    help="run the supervised kill/restart/resume drill")
     args = ap.parse_args(argv)
-    report = run_drill(seed=args.seed, verbose=not args.json)
+    if args.preempt:
+        report = run_preempt_drill(seed=args.seed, verbose=not args.json)
+    else:
+        report = run_drill(seed=args.seed, verbose=not args.json)
     if args.json:
         print(json.dumps(report, indent=2, sort_keys=True))
     return 0

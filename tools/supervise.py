@@ -1,0 +1,252 @@
+#!/usr/bin/env python
+"""Single-host training supervisor: the restart half of preemption tolerance.
+
+``resilience.PreemptionGuard`` gets a checkpoint onto disk before the
+grace window closes; this process is the reason the run then *comes
+back*. It wraps the training command, restarts it on nonzero exit with
+capped attempts and ``resilience.RetryPolicy`` backoff, threads the
+elastic generation through ``PADDLE_RESTART_GENERATION`` (the same env
+the multi-host launcher uses, so ``fleet.ElasticManager`` and worker
+scripts need no supervisor-specific code), and writes one crash report
+per attempt — exit cause (preempted vs crashed vs signal), the tail of
+the attempt's log, and the metrics dump when the worker left one.
+
+    python tools/supervise.py --max-restarts 3 --report-dir runs/r0 -- \\
+        python train.py --ckpt runs/r0/ckpt
+
+Exit-cause contract: a worker that was preempted exits with
+``PREEMPTED_EXIT_CODE`` (84) after its emergency save; the supervisor
+restarts it immediately (a reclaimed host's replacement should not be
+penalized with crash backoff). Any other nonzero exit is a crash and
+backs off exponentially. Exit 0 ends supervision. When the SUPERVISOR
+itself receives SIGTERM/SIGINT it forwards the signal to the worker,
+waits for the emergency save, writes the final report, and exits with
+the worker's code — it never restarts into a dying host.
+
+The supervisor never imports jax (lint.py-style package bootstrap): a
+restart must cost a fork+exec, not a framework import.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _bootstrap_pkg():
+    """Register a bare `paddle_tpu` parent package so the resilience
+    submodules import WITHOUT executing paddle_tpu/__init__.py (which
+    imports jax and the whole framework)."""
+    import types
+    if "paddle_tpu" not in sys.modules:
+        pkg = types.ModuleType("paddle_tpu")
+        pkg.__path__ = [os.path.join(REPO, "paddle_tpu")]
+        sys.modules["paddle_tpu"] = pkg
+
+
+_bootstrap_pkg()
+from paddle_tpu.resilience.preempt import PREEMPTED_EXIT_CODE  # noqa: E402
+from paddle_tpu.resilience.retry import RetryPolicy  # noqa: E402
+
+
+def _classify(returncode: int) -> str:
+    """preempted | signal:<NAME> | crashed | ok."""
+    if returncode == 0:
+        return "ok"
+    if returncode == PREEMPTED_EXIT_CODE:
+        return "preempted"
+    if returncode < 0:
+        try:
+            name = signal.Signals(-returncode).name
+        except ValueError:
+            name = str(-returncode)
+        # an unhandled SIGTERM is still a preemption — the guard just
+        # never got to run (no emergency checkpoint landed)
+        return f"preempted-unclean:{name}" if -returncode == \
+            signal.SIGTERM else f"signal:{name}"
+    return "crashed"
+
+
+def _tail(path: str, lines: int = 50) -> list:
+    try:
+        with open(path, "rb") as f:
+            data = f.read()[-65536:]
+        return data.decode("utf-8", "replace").splitlines()[-lines:]
+    except OSError:
+        return []
+
+
+def _metrics_dump(env: dict, since: float) -> object:
+    """The worker may leave a metrics JSON (PADDLE_METRICS_DUMP); inline
+    it into the crash report so a dead attempt still has numbers. A file
+    not touched since this attempt started belongs to a PREVIOUS
+    generation — reporting it as this attempt's numbers would corrupt
+    the postmortem, so it is skipped."""
+    path = env.get("PADDLE_METRICS_DUMP", "")
+    if not path or not os.path.exists(path):
+        return None
+    try:
+        if os.path.getmtime(path) < since:
+            return None  # stale: written by an earlier attempt
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {"unparseable": path}
+
+
+class Supervisor:
+    def __init__(self, cmd, max_restarts=3, report_dir=None,
+                 backoff_base=1.0, backoff_max=30.0, seed=0,
+                 log_tail_lines=50):
+        self.cmd = list(cmd)
+        self.max_restarts = int(max_restarts)
+        self.report_dir = report_dir
+        self.log_tail_lines = int(log_tail_lines)
+        # RetryPolicy as the backoff engine: capped exponential + seeded
+        # jitter, identical semantics to every other retry in the stack
+        self.policy = RetryPolicy(max_attempts=self.max_restarts + 1,
+                                  base_delay=float(backoff_base),
+                                  max_delay=float(backoff_max), seed=seed)
+        self.generation = 0
+        self.reports = []
+        self._child = None
+        self._terminating = False
+        if report_dir:
+            os.makedirs(report_dir, exist_ok=True)
+
+    # -- signal forwarding ----------------------------------------------------
+    def _forward(self, signum, frame):
+        self._terminating = True
+        child = self._child
+        if child is not None and child.poll() is None:
+            try:
+                child.send_signal(signum)
+            except OSError:
+                pass
+
+    def install_handlers(self):
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            signal.signal(sig, self._forward)
+
+    # -- one attempt ----------------------------------------------------------
+    def _attempt_env(self) -> dict:
+        env = dict(os.environ)
+        env["PADDLE_RESTART_GENERATION"] = str(self.generation)
+        env["PADDLE_SUPERVISED"] = "1"
+        return env
+
+    def _log_path(self) -> str:
+        if not self.report_dir:
+            return os.devnull
+        return os.path.join(self.report_dir,
+                            f"attempt{self.generation}.log")
+
+    def run_once(self) -> int:
+        env = self._attempt_env()
+        log_path = self._log_path()
+        t0 = time.monotonic()
+        wall0 = time.time()  # mtime comparisons need the wall clock
+        with open(log_path, "ab") as log:
+            self._child = subprocess.Popen(self.cmd, env=env, stdout=log,
+                                           stderr=subprocess.STDOUT)
+            if self._terminating and self._child.poll() is None:
+                # the reclaim signal landed inside the fork/exec window,
+                # before _forward had a child to aim at: re-deliver it so
+                # the fresh worker still gets its emergency-save chance
+                try:
+                    self._child.send_signal(signal.SIGTERM)
+                except OSError:
+                    pass
+            rc = self._child.wait()
+        self._child = None
+        cause = _classify(rc)
+        report = {
+            "generation": self.generation,
+            "cmd": self.cmd,
+            "exit_code": rc,
+            "cause": cause,
+            "duration_s": round(time.monotonic() - t0, 3),
+            "log": log_path if self.report_dir else None,
+            "log_tail": _tail(log_path, self.log_tail_lines),
+            "metrics": _metrics_dump(env, wall0),
+        }
+        self.reports.append(report)
+        if self.report_dir:
+            path = os.path.join(self.report_dir,
+                                f"crash_report_{self.generation}.json")
+            with open(path, "w") as f:
+                json.dump(report, f, indent=1)
+        sys.stderr.write(
+            f"supervise: generation {self.generation} exited "
+            f"rc={rc} ({cause}) after {report['duration_s']}s\n")
+        return rc
+
+    # -- the loop -------------------------------------------------------------
+    def run(self) -> int:
+        self.install_handlers()
+        while True:
+            rc = self.run_once()
+            if rc == 0 or self._terminating:
+                return rc
+            if self.generation >= self.max_restarts:
+                sys.stderr.write(
+                    f"supervise: giving up after "
+                    f"{self.generation + 1} attempts\n")
+                return rc
+            cause = self.reports[-1]["cause"]
+            if cause.startswith("preempted"):
+                # a reclaimed host restarts clean — no crash backoff
+                delay = 0.0
+            else:
+                delay = self.policy.backoff(self.generation)
+                sys.stderr.write(
+                    f"supervise: backing off {delay:.2f}s before "
+                    f"generation {self.generation + 1}\n")
+            if delay:
+                time.sleep(delay)
+            if self._terminating:
+                # the host's own reclaim arrived during backoff (no child
+                # to forward to): never restart into a dying host
+                sys.stderr.write(
+                    "supervise: terminated during backoff; not "
+                    "restarting\n")
+                return rc
+            self.generation += 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        usage="supervise.py [options] -- CMD [ARG...]")
+    ap.add_argument("--max-restarts", type=int, default=3,
+                    help="restarts after the first attempt (default 3)")
+    ap.add_argument("--report-dir", default=None,
+                    help="write attemptN.log + crash_report_N.json here")
+    ap.add_argument("--backoff-base", type=float, default=1.0)
+    ap.add_argument("--backoff-max", type=float, default=30.0)
+    ap.add_argument("--seed", type=int, default=0,
+                    help="backoff-jitter seed (deterministic drills)")
+    ap.add_argument("cmd", nargs=argparse.REMAINDER,
+                    help="-- then the training command")
+    args = ap.parse_args(argv)
+    cmd = args.cmd
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        ap.error("no training command given (supervise.py ... -- cmd)")
+    sup = Supervisor(cmd, max_restarts=args.max_restarts,
+                     report_dir=args.report_dir,
+                     backoff_base=args.backoff_base,
+                     backoff_max=args.backoff_max, seed=args.seed)
+    return sup.run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
