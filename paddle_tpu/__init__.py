@@ -112,6 +112,7 @@ from . import text  # noqa: F401
 from . import geometric  # noqa: F401
 from . import audio  # noqa: F401
 from . import inference  # noqa: F401
+from . import serving  # noqa: F401
 from . import fft  # noqa: F401
 from . import signal  # noqa: F401
 from . import static  # noqa: F401

@@ -226,17 +226,36 @@ class _LlamaDecoder:
             names.append("lm_head.weight")
         return names, (self.embed_key if self.tied else None)
 
-    def _layer(self, w, i, h, cos, sin, kc, vc, write_pos, score_mask):
-        """One decoder layer with cache append; h: [B, S, H*D]."""
-        b, s, _ = h.shape
+    def _qkv_proj(self, w, i, x, b, s):
+        """Roped q/k/v projections shared by the dense and ragged layers
+        (rope applied by the caller, which owns the position tables)."""
         pre = f"model.layers.{i}."
-        x = _rms(h, self._lw(w, i, "input_layernorm.weight"), self.eps)
         q = _mm(x, w, pre + "self_attn.q_proj.weight") \
             .reshape(b, s, self.n_heads, self.hd)
         k = _mm(x, w, pre + "self_attn.k_proj.weight") \
             .reshape(b, s, self.n_kv, self.hd)
         v = _mm(x, w, pre + "self_attn.v_proj.weight") \
             .reshape(b, s, self.n_kv, self.hd)
+        return q, k, v
+
+    def _post_attn(self, w, i, h, att):
+        """Residual + output projection + MLP, shared by both layer paths;
+        att: [B, S, H*D]."""
+        pre = f"model.layers.{i}."
+        h = h + _mm(att, w, pre + "self_attn.o_proj.weight")
+        x2 = _rms(h, self._lw(w, i, "post_attention_layernorm.weight"),
+                  self.eps)
+        gate = _mm(x2, w, pre + "mlp.gate_proj.weight")
+        up = _mm(x2, w, pre + "mlp.up_proj.weight")
+        swi = (jax.nn.silu(gate.astype(jnp.float32))
+               .astype(up.dtype) * up)
+        return h + _mm(swi, w, pre + "mlp.down_proj.weight")
+
+    def _layer(self, w, i, h, cos, sin, kc, vc, write_pos, score_mask):
+        """One decoder layer with cache append; h: [B, S, H*D]."""
+        b, s, _ = h.shape
+        x = _rms(h, self._lw(w, i, "input_layernorm.weight"), self.eps)
+        q, k, v = self._qkv_proj(w, i, x, b, s)
         q = _rope_rows(q, cos, sin)
         k = _rope_rows(k, cos, sin)
         # append to the cache at write_pos (same slot for every row; rows
@@ -255,15 +274,46 @@ class _LlamaDecoder:
                 .reshape(b, s, -1)
         else:
             att = _attend(q, kc, vc, score_mask).reshape(b, s, -1)
-        h = h + _mm(att, w, pre + "self_attn.o_proj.weight")
-        x2 = _rms(h, self._lw(w, i, "post_attention_layernorm.weight"),
-                  self.eps)
-        gate = _mm(x2, w, pre + "mlp.gate_proj.weight")
-        up = _mm(x2, w, pre + "mlp.up_proj.weight")
-        swi = (jax.nn.silu(gate.astype(jnp.float32))
-               .astype(up.dtype) * up)
-        h = h + _mm(swi, w, pre + "mlp.down_proj.weight")
-        return h, kc, vc
+        return self._post_attn(w, i, h, att), kc, vc
+
+    def _layer_ragged(self, w, i, h, cos, sin, kp, vp, scatter, attend):
+        """One layer over a PACKED ragged batch (mixed prefill chunks and
+        decode tokens from different sequences as a [T, 1, ...] batch).
+        kp/vp: [P, kvh, bs, D] paged pools; scatter: (pages [T], offs [T])
+        per-token write targets (page index P == dropped row); attend:
+        callable(q [T, H, D], kp, vp) -> [T, H, D] — the ragged paged
+        attention (paddle_tpu.serving.ragged supplies it)."""
+        t, s, _ = h.shape
+        x = _rms(h, self._lw(w, i, "input_layernorm.weight"), self.eps)
+        q, k, v = self._qkv_proj(w, i, x, t, s)
+        q = _rope_rows(q, cos, sin)
+        k = _rope_rows(k, cos, sin)
+        pages, offs = scatter
+        kp = kp.at[pages, :, offs, :].set(k[:, 0].astype(kp.dtype),
+                                          mode="drop")
+        vp = vp.at[pages, :, offs, :].set(v[:, 0].astype(vp.dtype),
+                                          mode="drop")
+        att = attend(q[:, 0], kp, vp).reshape(t, 1, -1)
+        return self._post_attn(w, i, h, att), kp, vp
+
+    def step_ragged(self, w, tokens, positions, k_pools, v_pools, scatter,
+                    attend):
+        """Ragged-batch twin of step(): tokens/positions: [T] packed
+        mixed-phase batch (each entry one token of some sequence at its
+        absolute position); k_pools/v_pools: [L, P, kvh, bs, D] shared
+        block pools; scatter/attend as in _layer_ragged. Returns
+        (logits [T, V], k_pools', v_pools')."""
+        emb = w[self.embed_key]
+        h = emb[tokens][:, None]                     # [T, 1, H*D]
+        cos = w["__rope_cos"][positions][:, None]    # [T, 1, hd/2]
+        sin = w["__rope_sin"][positions][:, None]
+        new_k, new_v = [], []
+        for i in range(self.n_layers):
+            h, kp, vp = self._layer_ragged(w, i, h, cos, sin, k_pools[i],
+                                           v_pools[i], scatter, attend)
+            new_k.append(kp)
+            new_v.append(vp)
+        return self._logits(w, h)[:, 0], jnp.stack(new_k), jnp.stack(new_v)
 
     def _logits(self, w, h):
         h = _rms(h, w["model.norm.weight"], self.eps)
@@ -391,30 +441,70 @@ class _GPTDecoder:
             names.append("lm_head.weight")
         return names, (self.embed_key if self.tied else None)
 
+    def _qkv_proj(self, w, i, x, b, s):
+        """Fused-qkv projection shared by the dense and ragged layers."""
+        p = f"transformer.h.{i}."
+        qkv = (_mm(x, w, p + "attn.qkv_proj.weight")
+               + w[p + "attn.qkv_proj.bias"]) \
+            .reshape(b, s, 3, self.n_heads, self.hd)
+        return qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+
+    def _post_attn(self, w, i, h, att):
+        """Residual + out proj + (MoE-)MLP, shared by both layer paths."""
+        p = f"transformer.h.{i}."
+        h = h + _mm(att, w, p + "attn.out_proj.weight") \
+            + w[p + "attn.out_proj.bias"]
+        x2 = _ln(h, w[p + "ln_2.weight"], w[p + "ln_2.bias"], self.eps)
+        if i in self.moe_layers:
+            return h + self._moe_mlp(w, i, x2)
+        m = jax.nn.gelu((_mm(x2, w, p + "mlp.fc_in.weight")
+                         + w[p + "mlp.fc_in.bias"]).astype(jnp.float32),
+                        approximate=False).astype(h.dtype)
+        return h + _mm(m, w, p + "mlp.fc_out.weight") \
+            + w[p + "mlp.fc_out.bias"]
+
     def _layer(self, w, i, h, kc, vc, write_pos, score_mask):
         p = f"transformer.h.{i}."
         b, s, _ = h.shape
         x = _ln(h, w[p + "ln_1.weight"], w[p + "ln_1.bias"], self.eps)
-        qkv = (_mm(x, w, p + "attn.qkv_proj.weight")
-               + w[p + "attn.qkv_proj.bias"]) \
-            .reshape(b, s, 3, self.n_heads, self.hd)
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        q, k, v = self._qkv_proj(w, i, x, b, s)
         kc = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype),
                                           (0, write_pos, 0, 0))
         vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype),
                                           (0, write_pos, 0, 0))
         att = _attend(q, kc, vc, score_mask).reshape(b, s, -1)
-        h = h + _mm(att, w, p + "attn.out_proj.weight") \
-            + w[p + "attn.out_proj.bias"]
-        x2 = _ln(h, w[p + "ln_2.weight"], w[p + "ln_2.bias"], self.eps)
-        if i in self.moe_layers:
-            h = h + self._moe_mlp(w, i, x2)
-            return h, kc, vc
-        m = jax.nn.gelu((_mm(x2, w, p + "mlp.fc_in.weight")
-                         + w[p + "mlp.fc_in.bias"]).astype(jnp.float32),
-                        approximate=False).astype(h.dtype)
-        h = h + _mm(m, w, p + "mlp.fc_out.weight") + w[p + "mlp.fc_out.bias"]
-        return h, kc, vc
+        return self._post_attn(w, i, h, att), kc, vc
+
+    def _layer_ragged(self, w, i, h, kp, vp, scatter, attend):
+        """Packed ragged-batch layer (see _LlamaDecoder._layer_ragged);
+        GPT has no rope — positions enter through the wpe embedding."""
+        p = f"transformer.h.{i}."
+        t, s, _ = h.shape
+        x = _ln(h, w[p + "ln_1.weight"], w[p + "ln_1.bias"], self.eps)
+        q, k, v = self._qkv_proj(w, i, x, t, s)
+        pages, offs = scatter
+        kp = kp.at[pages, :, offs, :].set(k[:, 0].astype(kp.dtype),
+                                          mode="drop")
+        vp = vp.at[pages, :, offs, :].set(v[:, 0].astype(vp.dtype),
+                                          mode="drop")
+        att = attend(q[:, 0], kp, vp).reshape(t, 1, -1)
+        return self._post_attn(w, i, h, att), kp, vp
+
+    def step_ragged(self, w, tokens, positions, k_pools, v_pools, scatter,
+                    attend):
+        """Ragged-batch twin of step(); see _LlamaDecoder.step_ragged."""
+        h = (w["transformer.wte.weight"][tokens]
+             + w["transformer.wpe.weight"][positions])[:, None]
+        new_k, new_v = [], []
+        for i in range(self.n_layers):
+            h, kp, vp = self._layer_ragged(w, i, h, k_pools[i], v_pools[i],
+                                           scatter, attend)
+            new_k.append(kp)
+            new_v.append(vp)
+        h = _ln(h, w["transformer.ln_f.weight"], w["transformer.ln_f.bias"],
+                self.eps)
+        logits = _head_logits(w, h, self.tied, self.embed_key)
+        return logits[:, 0], jnp.stack(new_k), jnp.stack(new_v)
 
     def _moe_mlp(self, w, i, x2):
         """No-drop top-k expert mixing; x2: [B, S, D] -> [B, S, D].

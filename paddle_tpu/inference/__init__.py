@@ -46,6 +46,37 @@ class Config:
         self.params_path = params_path
         self._ir_optim = True
         self._memory_optim = True
+        # serving knobs routed to paddle_tpu.serving (NOT no-ops): batch
+        # and KV-cache sizing feed ServingEngine via serving_options()
+        self._serving = {"max_seqs": None, "block_size": None,
+                         "num_blocks": None}
+
+    # -- serving knobs (routed, not warned) -----------------------------------
+    def set_max_batch_size(self, n: int):
+        """Max concurrently running sequences for the serving engine (and
+        the BatchingServer group size). Routed to ServingEngine.max_seqs —
+        previously this knob only existed inside enable_tensorrt_engine
+        and was a warned no-op."""
+        if int(n) < 1:
+            raise ValueError(f"max_batch_size must be >= 1, got {n}")
+        self._serving["max_seqs"] = int(n)
+
+    def set_kv_cache_block_size(self, tokens: int):
+        """Token slots per KV page (ServingEngine block_size)."""
+        if int(tokens) < 1:
+            raise ValueError(f"kv block size must be >= 1, got {tokens}")
+        self._serving["block_size"] = int(tokens)
+
+    def set_kv_cache_capacity(self, blocks: int):
+        """Total pages in the shared KV pool (ServingEngine num_blocks)."""
+        if int(blocks) < 1:
+            raise ValueError(f"kv capacity must be >= 1, got {blocks}")
+        self._serving["num_blocks"] = int(blocks)
+
+    def serving_options(self) -> Dict[str, Optional[int]]:
+        """The routed serving knobs (serving.engine_from_config reads
+        this; None = engine default)."""
+        return dict(self._serving)
 
     def set_model(self, model_path, params_path=None):
         self.__init__(model_path, params_path)
@@ -84,9 +115,16 @@ class Config:
         _warn_noop("enable_xpu",
                    "the device comes from the jax platform (TPU/CPU)")
 
-    def enable_tensorrt_engine(self, *a, **k):
+    def enable_tensorrt_engine(self, workspace_size=1 << 30,
+                               max_batch_size=None, *a, **k):
+        """TRT subgraphs are replaced by XLA (warned once), but the
+        max_batch_size the reference buries in this call IS routed to the
+        serving engine instead of being dropped."""
+        if max_batch_size is not None:
+            self.set_max_batch_size(max_batch_size)
         _warn_noop("enable_tensorrt_engine",
-                   "AOT XLA compilation replaces the TRT subgraph engine")
+                   "AOT XLA compilation replaces the TRT subgraph engine "
+                   "(its max_batch_size is routed to the serving engine)")
 
     def set_cpu_math_library_num_threads(self, n):
         _warn_noop("set_cpu_math_library_num_threads",
@@ -178,16 +216,41 @@ def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
 
 
+def create_llm_predictor(model, config: Optional[Config] = None,
+                         max_new_tokens: int = 32,
+                         eos_id: Optional[int] = None):
+    """Engine-backed predictor over a live causal-LM: builds ONE
+    continuous-batching ServingEngine honoring the Config's routed
+    serving knobs (set_max_batch_size / set_kv_cache_*) and wraps it in
+    the Predictor duck type, so PredictorPool clones and BatchingServer
+    share the engine."""
+    from ..serving import EnginePredictor, engine_from_config
+    eng = engine_from_config(model, config)
+    pred = EnginePredictor(eng, max_new_tokens=max_new_tokens,
+                           eos_id=eos_id)
+    pred._config = config if config is not None else Config()
+    return pred
+
+
 class PredictorPool:
     """Parity: paddle.inference.PredictorPool — N predictors over ONE
     loaded artifact (first is the main predictor, the rest are clones), so
     concurrent server threads each own private handles while sharing the
-    compiled executable and weights."""
+    compiled executable and weights. Pass ``predictor=`` (e.g. an
+    engine-backed ``create_llm_predictor`` result) to pool clones of an
+    existing predictor — engine-backed clones share ONE scheduler and KV
+    pool, not per-predictor state."""
 
-    def __init__(self, config: Config, size: int = 1):
+    def __init__(self, config: Optional[Config] = None, size: int = 1,
+                 predictor: Optional[Predictor] = None):
         if size < 1:
             raise ValueError(f"pool size must be >= 1, got {size}")
-        main = create_predictor(config)
+        if predictor is None:
+            if config is None:
+                raise ValueError("PredictorPool needs a config or a "
+                                 "predictor")
+            predictor = create_predictor(config)
+        main = predictor
         self._preds = [main] + [main.clone() for _ in range(size - 1)]
 
     def __len__(self):
@@ -208,22 +271,42 @@ class BatchingServer:
     a worker thread drains the queue, groups up to max_batch_size requests
     with identical shapes/dtypes, stacks them along axis 0, runs ONE
     forward, and splits the outputs back per request.
+
+    When the predictor is engine-backed (``serving.EnginePredictor``
+    exposes an ``engine`` attribute), the server DELEGATES: each request
+    goes straight into the shared continuous-batching engine (which
+    admits/evicts per decode step — strictly better than stacking), and
+    the worker thread becomes the engine driver. All predictors/clones
+    over one engine then share ONE scheduler and KV pool instead of
+    per-predictor state.
     """
 
-    def __init__(self, predictor: Predictor, max_batch_size: int = 8,
+    def __init__(self, predictor: Predictor,
+                 max_batch_size: Optional[int] = None,
                  max_delay_ms: float = 2.0):
         import queue
         import threading
         self._pred = predictor
+        self._engine = getattr(predictor, "engine", None)
+        if max_batch_size is None:
+            cfg = getattr(predictor, "_config", None)
+            routed = cfg.serving_options().get("max_seqs") \
+                if isinstance(cfg, Config) else None
+            if routed is None and self._engine is not None:
+                routed = self._engine.config.max_seqs
+            max_batch_size = routed or 8
         self.max_batch_size = int(max_batch_size)
         self.max_delay = float(max_delay_ms) / 1000.0
         self._q: "queue.Queue" = queue.Queue()
         self._stop = False
         self._submit_lock = threading.Lock()
+        self._inflight: List = []     # engine mode: (Request, Future)
         self.batches_run = 0
         self.requests_served = 0
-        self._worker = threading.Thread(target=self._loop, daemon=True,
-                                        name="inference-batcher")
+        self._worker = threading.Thread(
+            target=self._loop_engine if self._engine is not None
+            else self._loop,
+            daemon=True, name="inference-batcher")
         self._worker.start()
 
     # -- client side ----------------------------------------------------------
@@ -238,6 +321,15 @@ class BatchingServer:
         with self._submit_lock:
             if self._stop:
                 raise RuntimeError("BatchingServer is closed")
+            if self._engine is not None:
+                # continuous-batching delegation: one prompt per request
+                (ids,) = inputs
+                req = self._engine.submit(
+                    np.asarray(ids).reshape(-1).tolist(),
+                    max_new_tokens=getattr(self._pred, "max_new_tokens", 32),
+                    eos_id=getattr(self._pred, "eos_id", None))
+                self._inflight.append((req, fut))
+                return fut
             # copy: the caller may reuse its buffer before the worker
             # drains the queue
             self._q.put(([np.array(a) for a in inputs], fut))
@@ -250,6 +342,33 @@ class BatchingServer:
             self._stop = True
             self._q.put(None)
         self._worker.join(timeout=10.0)
+
+    # -- engine driver (continuous-batching delegation) -----------------------
+    def _resolve_finished(self):
+        with self._submit_lock:
+            live = []
+            for req, fut in self._inflight:
+                if req.done:
+                    self.requests_served += 1
+                    self._deliver(fut,
+                                  result=[np.asarray(req.output, np.int32)])
+                else:
+                    live.append((req, fut))
+            self._inflight = live
+
+    def _loop_engine(self):
+        eng = self._engine
+        while True:
+            self._resolve_finished()
+            # stop-exit first: a shared engine may ALWAYS have work from
+            # other front doors — this server only owes its own inflight
+            if self._stop and not self._inflight:
+                return
+            if eng.has_work():
+                eng.step()
+                self.batches_run += 1
+            else:
+                eng.wait_for_work(timeout=0.02)
 
     # -- server side ----------------------------------------------------------
     def _signature(self, arrays):
@@ -318,4 +437,4 @@ class BatchingServer:
 
 
 __all__ = ["Config", "Predictor", "PredictorPool", "BatchingServer",
-           "create_predictor"]
+           "create_predictor", "create_llm_predictor"]

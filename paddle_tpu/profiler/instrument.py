@@ -31,6 +31,18 @@ Catalog (names are a stable API — see README "Observability"):
   resilience_emergency_save_seconds      preemption emergency-save wall time
   checkpoint_async_queue_depth           in-flight async writer threads
   checkpoint_async_join_seconds          async writer join (drain) latency
+  serve_queue_depth                      serving/engine.py waiting requests
+  serve_running_seqs                     sequences in the continuous batch
+  serve_admitted_total                   requests admitted to the batch
+  serve_finished_total                   requests finished and evicted
+  serve_preempted_total                  requests preempted under pool pressure
+  serve_steps_total                      engine steps (device calls)
+  serve_tokens_total                     tokens sampled across all requests
+  serve_kv_pool_utilization              live KV pages / pool size (0..1)
+  serve_prefix_cache_queries_total       serving/kv_pool.py prefix lookups
+  serve_prefix_cache_hits_total          lookups that reused >= 1 page
+  serve_ttft_seconds                     submit -> first token latency
+  serve_token_seconds                    per-token (step) latency
 """
 from __future__ import annotations
 
@@ -65,6 +77,18 @@ CATALOG = (
     "resilience_emergency_save_seconds",
     "checkpoint_async_queue_depth",
     "checkpoint_async_join_seconds",
+    "serve_queue_depth",
+    "serve_running_seqs",
+    "serve_admitted_total",
+    "serve_finished_total",
+    "serve_preempted_total",
+    "serve_steps_total",
+    "serve_tokens_total",
+    "serve_kv_pool_utilization",
+    "serve_prefix_cache_queries_total",
+    "serve_prefix_cache_hits_total",
+    "serve_ttft_seconds",
+    "serve_token_seconds",
 )
 
 _enabled = _m._ENABLED  # bind the cell once: hot-path guard is _enabled[0]
@@ -241,3 +265,76 @@ def record_async_join(seconds: float) -> None:
     _reg().histogram("checkpoint_async_join_seconds",
                      "wall seconds spent joining async checkpoint "
                      "writers", buckets=_TIME_BUCKETS).observe(seconds)
+
+
+def record_serve_queue_depth(depth: int) -> None:
+    if not _enabled[0]:
+        return
+    _reg().gauge("serve_queue_depth",
+                 "serving requests waiting for admission").set(float(depth))
+
+
+def record_serve_step(admitted: int, finished: int, preempted: int,
+                      queue_depth: int, running: int,
+                      pool_utilization: float) -> None:
+    """One continuous-batching engine step's worth of scheduler events."""
+    if not _enabled[0]:
+        return
+    r = _reg()
+    r.counter("serve_steps_total", "serving engine steps (device calls)") \
+        .inc()
+    if admitted:
+        r.counter("serve_admitted_total",
+                  "requests admitted into the continuous batch") \
+            .inc(admitted)
+    if finished:
+        r.counter("serve_finished_total",
+                  "requests finished and evicted from the batch") \
+            .inc(finished)
+    if preempted:
+        r.counter("serve_preempted_total",
+                  "requests preempted under KV-pool pressure") \
+            .inc(preempted)
+    r.gauge("serve_queue_depth",
+            "serving requests waiting for admission").set(float(queue_depth))
+    r.gauge("serve_running_seqs",
+            "sequences live in the continuous batch").set(float(running))
+    r.gauge("serve_kv_pool_utilization",
+            "KV pages held by live sequences / pool size") \
+        .set(float(pool_utilization))
+
+
+def record_serve_prefix(queries: int, hits: int) -> None:
+    if not _enabled[0]:
+        return
+    r = _reg()
+    if queries:
+        r.counter("serve_prefix_cache_queries_total",
+                  "KV prefix-cache lookups at admission").inc(queries)
+    if hits:
+        r.counter("serve_prefix_cache_hits_total",
+                  "prefix-cache lookups reusing >= 1 cached page") \
+            .inc(hits)
+
+
+def record_serve_ttft(seconds: float) -> None:
+    if not _enabled[0]:
+        return
+    _reg().histogram("serve_ttft_seconds",
+                     "submit -> first sampled token latency",
+                     buckets=_TIME_BUCKETS).observe(seconds)
+
+
+def record_serve_tokens(n: int, step_seconds: float) -> None:
+    """n tokens sampled by one step of step_seconds wall time."""
+    if not _enabled[0]:
+        return
+    r = _reg()
+    if n:
+        r.counter("serve_tokens_total",
+                  "tokens sampled across all serving requests").inc(n)
+    h = r.histogram("serve_token_seconds",
+                    "per-token latency (wall time of the step that "
+                    "produced it)", buckets=_TIME_BUCKETS)
+    for _ in range(n):
+        h.observe(step_seconds)

@@ -69,6 +69,8 @@ SITES = {
     "train.step": "site",
     "train.loss": "poison",
     "preempt.notice": "site",
+    "serve.admit": "site",
+    "serve.kv_alloc": "site",
 }
 
 _CONTROL_KINDS = ("delay", "error", "die")

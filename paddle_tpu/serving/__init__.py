@@ -1,0 +1,30 @@
+"""paddle_tpu.serving — continuous-batching LLM serving engine.
+
+The production serving tier (ROADMAP item 2): a continuous-batching
+scheduler over a shared, prefix-cached KV block pool, attending through
+ragged paged attention (pure-JAX reference now, flag-gated Pallas kernel
+for the TPU window), with streaming output and an
+``inference.Predictor``-compatible front door.
+
+    from paddle_tpu.serving import ServingEngine, EngineConfig
+    eng = ServingEngine(model, EngineConfig(max_seqs=8, token_budget=64,
+                                            block_size=16))
+    req = eng.submit(prompt_ids, max_new_tokens=64, stream=True)
+    while eng.step():
+        pass                       # or drive from a server thread
+    print(req.result())
+
+Benchmark with ``python tools/bench_serve.py --fast`` (Poisson open-loop
+load, continuous vs static policy, BENCH_SERVE_*.json artifact).
+"""
+from .engine import (EngineConfig, EnginePredictor, ServingEngine,
+                     engine_from_config)
+from .kv_pool import KVBlockPool, PoolExhausted
+from .ragged import ragged_paged_attention
+from .scheduler import Request, Scheduler
+
+__all__ = [
+    "EngineConfig", "EnginePredictor", "ServingEngine",
+    "engine_from_config", "KVBlockPool", "PoolExhausted",
+    "ragged_paged_attention", "Request", "Scheduler",
+]

@@ -1,0 +1,330 @@
+"""ServingEngine: continuous batching over ragged paged attention.
+
+Composes the pieces PR 1-5 left on the table into a serving tier:
+
+  * ``generation.step_ragged`` — ONE jitted XLA program per engine (all
+    shapes static: token budget, slot count, page-table width), fed a
+    packed mixed-phase batch each step;
+  * ``kv_pool.KVBlockPool`` — shared fixed-size pages, ref-counted, with
+    hash-chain prefix reuse across requests;
+  * ``scheduler.Scheduler`` — admits new requests and evicts finished
+    ones at every decode step under a token budget;
+  * ``serving.ragged`` — the pure-JAX ragged attention reference, with
+    the flag-gated Pallas kernel underneath for the TPU window.
+
+Sampling runs host-side (greedy, or temperature with a seeded generator
+per engine) so the device program stays sampling-agnostic and requests
+stream tokens as they land. ``EnginePredictor`` wraps the engine in the
+``inference.Predictor`` duck type so ``PredictorPool`` clones and
+``BatchingServer`` delegate to ONE shared engine instead of stacking
+per-predictor state.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from functools import partial
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..profiler import instrument as _instr
+from . import ragged as _ragged
+from .kv_pool import KVBlockPool
+from .scheduler import Request, Scheduler
+
+
+class EngineConfig:
+    """Static shapes and policy for one engine (one compiled program)."""
+
+    def __init__(self, max_seqs: int = 8, token_budget: int = 64,
+                 block_size: int = 16, num_blocks: Optional[int] = None,
+                 max_model_len: Optional[int] = None,
+                 enable_prefix_cache: bool = True,
+                 policy: str = "continuous", quant: Optional[str] = None):
+        self.max_seqs = int(max_seqs)
+        self.token_budget = int(token_budget)
+        self.block_size = int(block_size)
+        self.num_blocks = num_blocks
+        self.max_model_len = max_model_len
+        self.enable_prefix_cache = bool(enable_prefix_cache)
+        self.policy = policy
+        self.quant = quant
+
+
+@partial(jax.jit, static_argnums=(0,), donate_argnums=(7, 8))
+def _engine_step(dec, w, tokens, slot_ids, positions, valid, tables,
+                 k_pools, v_pools):
+    """The one compiled serving program: scatter targets from the page
+    tables, ragged attention over the pools, logits for every packed
+    token. Pools are donated — each step reuses the previous buffers."""
+    bs = k_pools.shape[3]
+    p_total = k_pools.shape[1]
+    mp = tables.shape[1]
+    col = positions // bs
+    page = jnp.take_along_axis(tables[slot_ids],
+                               jnp.clip(col, 0, mp - 1)[:, None], 1)[:, 0]
+    # invalid rows write to page index p_total, which mode="drop" discards
+    bad = (~valid) | (col >= mp) | (page < 0)
+    pages = jnp.where(bad, p_total, page)
+    offs = positions % bs
+    attend = _ragged.make_attend(tables, slot_ids, positions, valid,
+                                 dec.n_heads // dec.n_kv)
+    return dec.step_ragged(w, tokens, positions, k_pools, v_pools,
+                           (pages, offs), attend)
+
+
+class ServingEngine:
+    """Continuous-batching LLM serving over one model.
+
+    Thread-safe: ``submit`` may be called from client threads while one
+    driver thread calls ``step()`` (steps themselves are serialized)."""
+
+    def __init__(self, model, config: Optional[EngineConfig] = None,
+                 seed: int = 0):
+        from ..generation import _decoder_for, _quant_weights_cached
+        cfg = config or EngineConfig()
+        self.model = model
+        self.config = cfg
+        self.dec = _decoder_for(model)
+        mco = getattr(self.dec, "min_capacity_override", None)
+        if mco is not None and mco < cfg.token_budget:
+            raise ValueError(
+                f"MoE _capacity_override={mco} < token_budget "
+                f"{cfg.token_budget}: a full step could drop tokens, which "
+                "the no-drop decode contract forbids; raise the override "
+                "or shrink the budget")
+        self._w = (_quant_weights_cached(self.dec, model, cfg.quant)
+                   if cfg.quant else self.dec.weights(model))
+        max_len = cfg.max_model_len or model.config.max_position_embeddings
+        self.max_model_len = int(min(max_len,
+                                     model.config.max_position_embeddings))
+        bs = cfg.block_size
+        self.max_pages_per_seq = -(-self.max_model_len // bs)
+        num_blocks = cfg.num_blocks
+        if num_blocks is None:
+            num_blocks = cfg.max_seqs * self.max_pages_per_seq
+        dtype = self._w[self.dec.embed_key].dtype
+        shape = (self.dec.n_layers, num_blocks, self.dec.n_kv, bs,
+                 self.dec.hd)
+        self._kp = jnp.zeros(shape, dtype)
+        self._vp = jnp.zeros(shape, dtype)
+        self.pool = KVBlockPool(num_blocks, bs,
+                                enable_prefix_cache=cfg.enable_prefix_cache)
+        self.sched = Scheduler(self.pool, cfg.max_seqs, cfg.token_budget,
+                               self.max_pages_per_seq, policy=cfg.policy)
+        self._tables = np.full((cfg.max_seqs, self.max_pages_per_seq), -1,
+                               np.int32)
+        self._rng = np.random.default_rng(seed)
+        self._lock = threading.RLock()
+        self._work = threading.Event()
+        self.steps = 0
+        self.tokens_generated = 0
+
+    # -- client side ----------------------------------------------------------
+    def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
+               eos_id: Optional[int] = None, on_token=None,
+               stream: bool = False) -> Request:
+        """Enqueue one request; returns the Request handle (``result()``
+        blocks for the token list, ``stream()`` yields tokens live)."""
+        req = Request(prompt, max_new_tokens=max_new_tokens, eos_id=eos_id,
+                      on_token=on_token, stream=stream)
+        total = len(req.prompt) + req.max_new_tokens
+        if total > self.max_model_len:
+            raise ValueError(
+                f"prompt {len(req.prompt)} + max_new_tokens "
+                f"{req.max_new_tokens} exceeds max_model_len "
+                f"{self.max_model_len}")
+        # the last fed position is total-2 (the final sampled token is
+        # never fed), so the worst case is (total-2)//bs + 1 pages
+        if (total - 2) // self.pool.block_size + 1 > self.pool.num_blocks:
+            raise ValueError(
+                f"request needs more pages than the whole pool "
+                f"({self.pool.num_blocks} x {self.pool.block_size})")
+        with self._lock:
+            self.sched.submit(req)
+        self._work.set()
+        _instr.record_serve_queue_depth(self.sched.queue_depth())
+        return req
+
+    # -- engine side ----------------------------------------------------------
+    def step(self) -> bool:
+        """Run one continuous-batching step: schedule, one device call,
+        sample, evict. Returns True while work remains."""
+        t0 = time.monotonic()
+        with self._lock:
+            q0 = self.pool.stats["prefix_queries"]
+            h0 = self.pool.stats["prefix_hits"]
+            plan = self.sched.schedule()
+            if not plan.entries:
+                if not self.sched.has_work():
+                    self._work.clear()
+                return self.sched.has_work()
+            sampled = self._run_plan(plan)
+            self.steps += 1
+            queue_depth = self.sched.queue_depth()
+            running = len(self.sched.running)
+            util = self.pool.utilization()
+            dq = self.pool.stats["prefix_queries"] - q0
+            dh = self.pool.stats["prefix_hits"] - h0
+        dt = time.monotonic() - t0
+        _instr.record_serve_step(plan.admitted, sampled["finished"],
+                                 plan.preempted, queue_depth, running, util)
+        _instr.record_serve_prefix(dq, dh)
+        for lat in sampled["ttfts"]:
+            _instr.record_serve_ttft(lat)
+        _instr.record_serve_tokens(sampled["tokens"], dt)
+        return self.sched.has_work()
+
+    def _run_plan(self, plan) -> dict:
+        t_max = self.config.token_budget
+        tokens = np.zeros(t_max, np.int32)
+        slots = np.zeros(t_max, np.int32)
+        positions = np.zeros(t_max, np.int32)
+        valid = np.zeros(t_max, bool)
+        sample_points = []
+        idx = 0
+        for e in plan.entries:
+            n = e.n
+            tokens[idx:idx + n] = e.req.seq[e.start:e.start + n]
+            slots[idx:idx + n] = e.req.slot
+            positions[idx:idx + n] = np.arange(e.start, e.start + n)
+            valid[idx:idx + n] = True
+            row = self._tables[e.req.slot]
+            row[:] = -1
+            row[:len(e.req.pages)] = e.req.pages
+            if e.samples:
+                sample_points.append((e.req, idx + n - 1))
+            idx += n
+        logits, self._kp, self._vp = _engine_step(
+            self.dec, self._w, jnp.asarray(tokens), jnp.asarray(slots),
+            jnp.asarray(positions), jnp.asarray(valid),
+            jnp.asarray(self._tables), self._kp, self._vp)
+        out = {"tokens": 0, "finished": 0, "ttfts": []}
+        for e in plan.entries:
+            e.req.pos = e.start + e.n
+        if sample_points:
+            rows = np.asarray(
+                logits[jnp.asarray([i for _, i in sample_points])])
+            now = time.monotonic()
+            finished = []
+            for (req, _), lg in zip(sample_points, rows):
+                tok = int(np.argmax(lg))
+                if req.first_token_at is None:
+                    req.first_token_at = now
+                    out["ttfts"].append(now - req.arrival)
+                req.emit(tok)
+                self.tokens_generated += 1
+                out["tokens"] += 1
+                if (len(req.output) >= req.max_new_tokens
+                        or (req.eos_id is not None and tok == req.eos_id)):
+                    finished.append(req)
+            for req in finished:
+                self.sched.evict_finished(req)
+            out["finished"] = len(finished)
+        return out
+
+    def run_until_idle(self, max_steps: Optional[int] = None) -> int:
+        """Drive step() until no work remains; returns steps taken."""
+        n = 0
+        while self.step():
+            n += 1
+            if max_steps is not None and n >= max_steps:
+                break
+        return n
+
+    def wait_for_work(self, timeout: Optional[float] = None) -> bool:
+        return self._work.wait(timeout)
+
+    def has_work(self) -> bool:
+        with self._lock:
+            return self.sched.has_work()
+
+    def generate_batch(self, prompts: Sequence[Sequence[int]],
+                       max_new_tokens: int = 32,
+                       eos_id: Optional[int] = None) -> List[List[int]]:
+        """Convenience: submit a batch, drain the engine, return outputs
+        in submission order."""
+        reqs = [self.submit(p, max_new_tokens=max_new_tokens, eos_id=eos_id)
+                for p in prompts]
+        self.run_until_idle()
+        return [r.result(timeout=0) for r in reqs]
+
+    def refresh_weights(self) -> None:
+        """Re-snapshot the model weights (after a load_dict / train step).
+        The KV pool keeps its content — callers that swapped weights
+        should also drop the prefix cache via a fresh engine."""
+        from ..generation import _quant_weights_cached
+        with self._lock:
+            self._w = (_quant_weights_cached(self.dec, self.model,
+                                             self.config.quant)
+                       if self.config.quant
+                       else self.dec.weights(self.model))
+
+
+class EnginePredictor:
+    """``inference.Predictor``-compatible front door over ONE shared
+    engine. ``clone()`` returns another handle to the same engine, so a
+    ``PredictorPool`` of these shares the scheduler and KV pool instead
+    of holding per-predictor caches; ``BatchingServer`` detects the
+    ``engine`` attribute and delegates per-request instead of stacking."""
+
+    def __init__(self, engine: ServingEngine, max_new_tokens: int = 32,
+                 eos_id: Optional[int] = None):
+        self.engine = engine
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_id = eos_id
+
+    def clone(self) -> "EnginePredictor":
+        return EnginePredictor(self.engine, self.max_new_tokens,
+                               self.eos_id)
+
+    def get_input_names(self) -> List[str]:
+        return ["input_ids"]
+
+    def run(self, inputs) -> List[np.ndarray]:
+        """inputs: [token_ids] where token_ids is one 1-D prompt or a list
+        of 1-D prompts (ragged). Returns [outputs] padded with -1."""
+        (ids,) = inputs
+        if isinstance(ids, (list, tuple)) and len(ids) and \
+                isinstance(ids[0], (list, tuple, np.ndarray)):
+            prompts = [list(map(int, p)) for p in ids]     # ragged list
+        else:
+            arr = np.asarray(ids)
+            if arr.ndim == 1:
+                prompts = [arr.astype(np.int64).tolist()]
+            elif arr.ndim == 2:
+                prompts = [row.astype(np.int64).tolist() for row in arr]
+            else:
+                raise ValueError(
+                    f"input_ids must be 1-D, 2-D, or a list of 1-D "
+                    f"prompts; got ndim={arr.ndim}")
+        outs = self.engine.generate_batch(prompts, self.max_new_tokens,
+                                          eos_id=self.eos_id)
+        width = max(len(o) for o in outs)
+        padded = np.full((len(outs), width), -1, np.int32)
+        for i, o in enumerate(outs):
+            padded[i, :len(o)] = o
+        return [padded]
+
+
+def engine_from_config(model, config=None, **overrides) -> ServingEngine:
+    """Build a ServingEngine honoring ``inference.Config`` serving knobs
+    (max_batch_size -> max_seqs, kv-cache block size/capacity -> pool
+    geometry); keyword overrides win."""
+    kw = {}
+    serving = getattr(config, "serving_options", None)
+    if callable(serving):
+        for k, v in serving().items():
+            if v is not None:
+                kw[k] = v
+    kw.update(overrides)
+    if "max_seqs" in kw and "token_budget" not in kw:
+        kw["token_budget"] = max(8 * kw["max_seqs"], 64)
+    return ServingEngine(model, EngineConfig(**kw))
+
+
+__all__ = ["EngineConfig", "ServingEngine", "EnginePredictor",
+           "engine_from_config"]

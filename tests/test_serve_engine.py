@@ -1,0 +1,461 @@
+"""Continuous-batching serving engine (paddle_tpu.serving).
+
+Oracle strategy, mirroring test_generation.py: the engine's packed
+ragged-paged decode must reproduce the one-shot ``generate()`` tokens
+exactly, and the ragged paged attention must match the dense
+``generation._attend`` / ``_attend_gqa`` paths on CPU. Scheduler
+invariants (FIFO no-starvation, eviction frees every page, prefix-reuse
+refcounts) and the chaos drill sites are pinned host-side.
+"""
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import generation as G
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.resilience import chaos
+from paddle_tpu.serving import (EngineConfig, KVBlockPool, PoolExhausted,
+                                ServingEngine, ragged_paged_attention)
+
+pytestmark = pytest.mark.serve
+
+
+def _model(kv_heads=2, seed=3, vocab=61):
+    paddle.seed(seed)
+    cfg = LlamaConfig.tiny(vocab_size=vocab, hidden_size=32, layers=2,
+                           heads=4, kv_heads=kv_heads, seq=64)
+    cfg.use_flash_attention = False
+    return LlamaForCausalLM(cfg)
+
+
+def _prompts(n, lens=(7, 4, 11, 5, 9, 3, 8, 6), vocab=61, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, vocab, (lens[i % len(lens)],)).tolist()
+            for i in range(n)]
+
+
+def _oracle(model, prompts, max_new):
+    out = []
+    for p in prompts:
+        toks, _ = model.generate(
+            paddle.to_tensor(np.asarray([p], np.int32)),
+            max_new_tokens=max_new)
+        out.append(toks.numpy()[0].tolist())
+    return out
+
+
+# -- ragged paged attention vs the dense decode paths -------------------------
+
+def _build_pool(rng, lens, kvh, bs, d, extra_pages=2):
+    """Per-seq dense caches packed into a paged pool + tables."""
+    mp = max((ln - 1) // bs + 1 for ln in lens) + 1
+    total = sum((ln - 1) // bs + 1 for ln in lens) + extra_pages
+    kp = np.zeros((total, kvh, bs, d), np.float32)
+    vp = np.zeros((total, kvh, bs, d), np.float32)
+    tables = np.full((len(lens), mp), -1, np.int32)
+    dense_k, dense_v = [], []
+    nxt = 0
+    for s, ln in enumerate(lens):
+        dk = rng.standard_normal((ln, kvh, d)).astype(np.float32)
+        dv = rng.standard_normal((ln, kvh, d)).astype(np.float32)
+        dense_k.append(dk)
+        dense_v.append(dv)
+        for c in range((ln - 1) // bs + 1):
+            pg = nxt
+            nxt += 1
+            tables[s, c] = pg
+            chunk_k = dk[c * bs:(c + 1) * bs]
+            kp[pg, :, :len(chunk_k)] = chunk_k.transpose(1, 0, 2)
+            chunk_v = dv[c * bs:(c + 1) * bs]
+            vp[pg, :, :len(chunk_v)] = chunk_v.transpose(1, 0, 2)
+    return kp, vp, tables, dense_k, dense_v
+
+
+@pytest.mark.parametrize("rep", [1, 2])
+def test_ragged_attention_matches_dense(rep):
+    rng = np.random.default_rng(0)
+    kvh, d, bs = 2, 8, 4
+    h = kvh * rep
+    lens = [5, 9, 3]
+    kp, vp, tables, dense_k, dense_v = _build_pool(rng, lens, kvh, bs, d)
+    # one decode query per sequence at its last position
+    q = rng.standard_normal((len(lens), h, d)).astype(np.float32)
+    slot = np.arange(len(lens), dtype=np.int32)
+    pos = np.asarray([ln - 1 for ln in lens], np.int32)
+    got = ragged_paged_attention(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(tables), jnp.asarray(slot), jnp.asarray(pos),
+        jnp.ones(len(lens), bool), rep=rep)
+    for s, ln in enumerate(lens):
+        # dense oracle: [1, 1, H, D] query over the [1, ln, kvh, D] cache
+        qd = jnp.asarray(q[s][None, None])
+        kd = jnp.asarray(dense_k[s][None])
+        vd = jnp.asarray(dense_v[s][None])
+        mask = jnp.ones((1, 1, 1, ln), bool)
+        if rep == 1:
+            want = G._attend(qd, kd, vd, mask)
+        else:
+            want = G._attend_gqa(qd, kd, vd, mask, rep)
+        np.testing.assert_allclose(np.asarray(got[s]),
+                                   np.asarray(want[0, 0]),
+                                   atol=2e-5, rtol=2e-5)
+
+
+def test_ragged_attention_mixed_phase_chunk():
+    """A prefill chunk (several tokens of one seq) packed with decode
+    tokens of others matches the dense causal computation."""
+    rng = np.random.default_rng(1)
+    kvh = h = 2
+    d, bs = 8, 4
+    lens = [6, 10]
+    kp, vp, tables, dense_k, dense_v = _build_pool(rng, lens, kvh, bs, d)
+    # seq 0: chunk of 3 queries at positions 3..5; seq 1: decode at 9
+    q = rng.standard_normal((4, h, d)).astype(np.float32)
+    slot = np.asarray([0, 0, 0, 1], np.int32)
+    pos = np.asarray([3, 4, 5, 9], np.int32)
+    got = ragged_paged_attention(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(tables), jnp.asarray(slot), jnp.asarray(pos),
+        jnp.ones(4, bool), rep=1)
+    qd = jnp.asarray(q[:3][None])                    # [1, 3, H, D]
+    kd = jnp.asarray(dense_k[0][None])
+    vd = jnp.asarray(dense_v[0][None])
+    t_idx = jnp.arange(lens[0])[None, None, None, :]
+    q_idx = jnp.asarray(pos[:3])[None, None, :, None]
+    want = G._attend(qd, kd, vd, t_idx <= q_idx)
+    np.testing.assert_allclose(np.asarray(got[:3]), np.asarray(want[0]),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_pallas_kernel_matches_reference(monkeypatch):
+    from paddle_tpu.kernels import ragged_pallas as rp
+    monkeypatch.setattr(rp, "_INTERPRET", True)
+    rng = np.random.default_rng(2)
+    t, kvh, d, p, bs, mp, s = 10, 2, 8, 12, 4, 5, 3
+    kp = jnp.asarray(rng.standard_normal((p, kvh, bs, d)), jnp.float32)
+    vp = jnp.asarray(rng.standard_normal((p, kvh, bs, d)), jnp.float32)
+    tables = np.full((s, mp), -1, np.int32)
+    tables[0, :3] = [2, 5, 7]
+    tables[1, :2] = [1, 9]
+    tables[2, :5] = [0, 3, 4, 6, 8]
+    tables = jnp.asarray(tables)
+    slot = jnp.asarray(rng.integers(0, s, (t,)), jnp.int32)
+    cap = np.asarray([3, 2, 5])[np.asarray(slot)] * bs - 1
+    pos = jnp.asarray(rng.integers(0, cap + 1), jnp.int32)
+    valid = jnp.asarray(rng.random(t) > 0.2)
+    for rep in (1, 2):
+        q = jnp.asarray(rng.standard_normal((t, kvh * rep, d)), jnp.float32)
+        ref = ragged_paged_attention(q, kp, vp, tables, slot, pos, valid,
+                                     rep=rep)
+        ref = np.where(np.asarray(valid)[:, None, None],
+                       np.asarray(ref), 0.0)
+        got = rp.ragged_decode_attention(q, kp, vp, tables, slot, pos,
+                                         valid, rep=rep)
+        np.testing.assert_allclose(np.asarray(got), ref, atol=2e-5,
+                                   rtol=2e-5)
+
+
+def test_pallas_kernel_flag_gated(monkeypatch):
+    from paddle_tpu.framework import flags
+    from paddle_tpu.kernels import ragged_pallas as rp
+    assert not rp.enabled()          # OFF by default (pending hardware)
+    monkeypatch.setattr(rp, "_INTERPRET", True)
+    flags.set_flags({"use_ragged_pallas": True})
+    try:
+        assert rp.enabled()
+    finally:
+        flags.set_flags({"use_ragged_pallas": False})
+
+
+# -- engine vs generate() parity ----------------------------------------------
+
+@pytest.mark.parametrize("kv_heads", [4, 2])     # MHA and GQA
+def test_engine_matches_generate(kv_heads):
+    model = _model(kv_heads=kv_heads)
+    prompts = _prompts(5)
+    want = _oracle(model, prompts, max_new=6)
+    eng = ServingEngine(model, EngineConfig(max_seqs=3, token_budget=16,
+                                            block_size=8))
+    got = eng.generate_batch(prompts, max_new_tokens=6)
+    assert got == want
+    assert eng.pool.used_blocks() == 0           # eviction freed everything
+
+
+def test_engine_matches_generate_gpt():
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    paddle.seed(5)
+    cfg = GPTConfig.tiny(vocab_size=53, hidden_size=32, layers=2, heads=4,
+                         seq=64)
+    model = GPTForCausalLM(cfg)
+    prompts = _prompts(3, vocab=53, seed=4)
+    want = _oracle(model, prompts, max_new=5)
+    eng = ServingEngine(model, EngineConfig(max_seqs=2, token_budget=12,
+                                            block_size=4))
+    got = eng.generate_batch(prompts, max_new_tokens=5)
+    assert got == want
+
+
+def test_engine_matches_generate_gpt_moe():
+    """step_ragged through the no-drop MoE blocks (scan over expert
+    banks on [T, 1, d] packed tokens)."""
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    paddle.seed(13)
+    cfg = GPTConfig.tiny(vocab_size=53, hidden_size=32, layers=2, heads=4,
+                         seq=64, num_experts=4, moe_every=1, moe_top_k=2,
+                         moe_gate="naive")
+    model = GPTForCausalLM(cfg)
+    prompts = _prompts(2, vocab=53, seed=9)
+    want = _oracle(model, prompts, max_new=4)
+    eng = ServingEngine(model, EngineConfig(max_seqs=2, token_budget=12,
+                                            block_size=4))
+    assert eng.generate_batch(prompts, max_new_tokens=4) == want
+
+
+def test_engine_eos_and_streaming():
+    model = _model()
+    prompts = _prompts(2)
+    ref = _oracle(model, prompts, max_new=8)
+    eos = ref[0][2]                  # force an early stop on row 0
+    eng = ServingEngine(model, EngineConfig(max_seqs=2, token_budget=16,
+                                            block_size=8))
+    seen = []
+    r0 = eng.submit(prompts[0], max_new_tokens=8, eos_id=eos,
+                    on_token=seen.append, stream=True)
+    r1 = eng.submit(prompts[1], max_new_tokens=8, eos_id=eos)
+    streamed = []
+    t = threading.Thread(target=lambda: streamed.extend(r0.stream()))
+    t.start()
+    eng.run_until_idle()
+    t.join(timeout=30)
+    assert r0.output == ref[0][:3]           # stopped AT the eos token
+    assert streamed == r0.output == seen
+    assert r1.done and len(r1.output) <= 8
+
+
+def test_engine_chunked_prefill_matches():
+    """token_budget smaller than a prompt forces multi-step prefill
+    chunks; output must not change."""
+    model = _model()
+    prompts = [_prompts(1, lens=(23,))[0]]
+    want = _oracle(model, prompts, max_new=4)
+    eng = ServingEngine(model, EngineConfig(max_seqs=2, token_budget=6,
+                                            block_size=4))
+    got = eng.generate_batch(prompts, max_new_tokens=4)
+    assert got == want
+
+
+# -- scheduler invariants ------------------------------------------------------
+
+def test_fifo_no_starvation():
+    """With equal-length work and a 2-slot batch, FIFO admission means
+    finish order == submission order (nobody is starved past a later
+    arrival)."""
+    model = _model()
+    eng = ServingEngine(model, EngineConfig(max_seqs=2, token_budget=16,
+                                            block_size=8))
+    prompts = _prompts(6, lens=(5,))
+    reqs = [eng.submit(p, max_new_tokens=4) for p in prompts]
+    eng.run_until_idle()
+    finished = [r.finished_at for r in reqs]
+    assert all(r.done for r in reqs)
+    assert finished == sorted(finished)
+
+
+def test_eviction_frees_all_blocks_no_prefix_cache():
+    model = _model()
+    eng = ServingEngine(model, EngineConfig(max_seqs=4, token_budget=32,
+                                            block_size=4,
+                                            enable_prefix_cache=False))
+    eng.generate_batch(_prompts(6), max_new_tokens=5)
+    assert eng.pool.used_blocks() == 0
+    assert eng.pool.cached_blocks() == 0
+    assert eng.pool.free_blocks() == eng.pool.num_blocks
+
+
+def test_prefix_reuse_refcounts_and_parity():
+    model = _model()
+    eng = ServingEngine(model, EngineConfig(max_seqs=3, token_budget=16,
+                                            block_size=4))
+    rng = np.random.default_rng(7)
+    shared = rng.integers(1, 61, (9,)).tolist()   # 2 full pages + 1
+    want = _oracle(model, [shared], max_new=6)[0]
+    # populate the prefix cache
+    assert eng.generate_batch([shared], max_new_tokens=6) == [want]
+    assert eng.pool.cached_blocks() == 2
+    base_hits = eng.pool.stats["prefix_hits"]
+    # two concurrent requests with the same prompt share the cached pages
+    r1 = eng.submit(shared, max_new_tokens=6)
+    r2 = eng.submit(shared, max_new_tokens=6)
+    eng.step()                                    # both admitted
+    shared_pages = r1.pages[:2]
+    assert r1.n_prefix == 8 and r2.n_prefix == 8
+    assert r2.pages[:2] == shared_pages           # same physical pages
+    assert all(eng.pool._ref[p] == 2 for p in shared_pages)
+    eng.run_until_idle()
+    assert r1.result(0) == want and r2.result(0) == want
+    assert eng.pool.stats["prefix_hits"] == base_hits + 2
+    assert eng.pool.used_blocks() == 0            # refcounts fully drained
+    assert all(eng.pool._ref[p] == 0 for p in shared_pages)
+
+
+def test_pool_pressure_preempts_and_completes():
+    """A pool too small for all sequences' full growth must preempt (not
+    wedge or corrupt): everything still finishes with oracle tokens."""
+    model = _model()
+    prompts = _prompts(3, lens=(9, 11, 10))
+    want = _oracle(model, prompts, max_new=8)
+    eng = ServingEngine(model, EngineConfig(max_seqs=3, token_budget=16,
+                                            block_size=4, num_blocks=9,
+                                            enable_prefix_cache=False))
+    reqs = [eng.submit(p, max_new_tokens=8) for p in prompts]
+    eng.run_until_idle(max_steps=500)
+    assert [r.result(0) for r in reqs] == want
+    assert eng.pool.used_blocks() == 0
+
+
+def test_prefill_makes_partial_progress_on_page_shortage():
+    """allocate() is all-or-nothing; a prompt needing more pages than are
+    free must still prefill the chunk the free pages CAN cover instead of
+    stalling the FIFO head (review regression)."""
+    from paddle_tpu.serving.scheduler import Request, Scheduler
+    pool = KVBlockPool(2, 16, enable_prefix_cache=False)
+    sched = Scheduler(pool, max_seqs=2, token_budget=64,
+                      max_pages_per_seq=4)
+    sched.submit(Request(list(range(1, 41)), max_new_tokens=2))
+    plan = sched.schedule()
+    assert plan.admitted == 1
+    assert plan.entries and plan.entries[0].n == 32   # 2 pages x 16
+
+
+def test_submit_accepts_exact_pool_fit():
+    """total == an exact page multiple must not be rejected by an
+    off-by-one page count (review regression)."""
+    model = _model()
+    eng = ServingEngine(model, EngineConfig(max_seqs=1, token_budget=8,
+                                            block_size=8, num_blocks=4,
+                                            max_model_len=32))
+    req = eng.submit(list(range(1, 29)), max_new_tokens=4)   # total 32
+    eng.run_until_idle()
+    assert len(req.result(0)) == 4
+
+
+def test_pool_exhaustion_raises_on_impossible_request():
+    pool = KVBlockPool(2, 4)
+    pool.allocate(2)
+    with pytest.raises(PoolExhausted):
+        pool.allocate(1)
+
+
+def test_submit_rejects_oversized_request():
+    model = _model()
+    eng = ServingEngine(model, EngineConfig(max_seqs=2, token_budget=8,
+                                            block_size=4))
+    with pytest.raises(ValueError, match="max_model_len"):
+        eng.submit(list(range(1, 60)), max_new_tokens=30)
+
+
+# -- chaos drill sites ---------------------------------------------------------
+
+def test_chaos_admit_defers_then_serves():
+    model = _model()
+    prompts = _prompts(2)
+    want = _oracle(model, prompts, max_new=4)
+    plan = chaos.FaultPlan(seed=0).add("serve.admit", "error", at=(1,))
+    chaos.install_plan(plan)
+    try:
+        eng = ServingEngine(model, EngineConfig(max_seqs=2, token_budget=16,
+                                                block_size=8))
+        got = eng.generate_batch(prompts, max_new_tokens=4)
+    finally:
+        chaos.clear_plan()
+    assert got == want
+    assert ("serve.admit", "error", 1) in plan.fired
+
+
+def test_chaos_kv_alloc_exercises_exhaustion_path():
+    model = _model()
+    prompts = _prompts(2)
+    want = _oracle(model, prompts, max_new=4)
+    plan = chaos.FaultPlan(seed=0).add("serve.kv_alloc", "error", at=(1, 2))
+    chaos.install_plan(plan)
+    try:
+        eng = ServingEngine(model, EngineConfig(max_seqs=2, token_budget=16,
+                                                block_size=8))
+        got = eng.generate_batch(prompts, max_new_tokens=4)
+    finally:
+        chaos.clear_plan()
+    assert got == want                 # deferred, retried, completed
+    assert [f for f in plan.fired if f[0] == "serve.kv_alloc"]
+
+
+# -- config routing / front door ----------------------------------------------
+
+def test_config_knobs_route_to_engine():
+    import warnings
+
+    from paddle_tpu.inference import Config, create_llm_predictor
+    model = _model()
+    conf = Config()
+    conf.set_max_batch_size(3)
+    conf.set_kv_cache_block_size(8)
+    conf.set_kv_cache_capacity(24)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")   # routed knobs must NOT warn
+        pred = create_llm_predictor(model, conf, max_new_tokens=4)
+    eng = pred.engine
+    assert eng.config.max_seqs == 3
+    assert eng.pool.block_size == 8
+    assert eng.pool.num_blocks == 24
+    assert pred.clone().engine is eng    # pool/scheduler shared via clone
+
+
+def test_tensorrt_max_batch_size_routed():
+    from paddle_tpu.inference import Config
+    conf = Config()
+    with pytest.warns(UserWarning, match="routed to the serving engine"):
+        conf.enable_tensorrt_engine(1 << 20, 5)
+    assert conf.serving_options()["max_seqs"] == 5
+
+
+def test_batching_server_delegates_to_engine():
+    from paddle_tpu.inference import (BatchingServer, Config,
+                                      create_llm_predictor)
+    model = _model()
+    prompts = _prompts(4)
+    want = _oracle(model, prompts, max_new=5)
+    conf = Config()
+    conf.set_max_batch_size(4)
+    pred = create_llm_predictor(model, conf, max_new_tokens=5)
+    server = BatchingServer(pred)
+    try:
+        assert server.max_batch_size == 4
+        futs = [server.submit([np.asarray(p, np.int32)]) for p in prompts]
+        got = [f.result(timeout=120)[0].tolist() for f in futs]
+    finally:
+        server.close()
+    assert got == want
+    assert server.requests_served == 4
+
+
+# -- benchmark fast mode (throughput floor) ------------------------------------
+
+def test_bench_serve_fast_mode(tmp_path):
+    import importlib
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    bench_serve = importlib.import_module("bench_serve")
+    res = bench_serve.run_bench(fast=True, seed=0,
+                                out_path=str(tmp_path / "BENCH_SERVE.json"))
+    cont = res["continuous"]["tokens_per_s"]
+    stat = res["static"]["tokens_per_s"]
+    assert cont > 0 and stat > 0
+    # the acceptance floor: continuous batching beats static batching in
+    # tokens/s at equal (seeded Poisson) load
+    assert cont > stat, res
+    assert res["continuous"]["p99_latency_s"] > 0
+    assert (tmp_path / "BENCH_SERVE.json").exists()

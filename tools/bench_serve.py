@@ -1,0 +1,198 @@
+#!/usr/bin/env python
+"""Poisson open-loop serving benchmark: continuous vs static batching.
+
+The serving twin of bench.py: a seeded open-loop load generator (arrivals
+are a Poisson process — exponential gaps at --rate requests/s — fixed by
+the seed BEFORE either run, so both policies face the identical
+schedule) drives the ServingEngine twice over the same request set:
+
+  * ``continuous`` — the real scheduler: admit/evict every decode step,
+    prefill chunks and decode sharing one token budget;
+  * ``static``     — the same engine machinery with gang admission
+    (fill the batch only when it is empty, run it dry), i.e. the
+    BatchingServer micro-batching policy. Identical per-step dispatch
+    cost, so the measured delta is the SCHEDULING POLICY, not harness
+    overhead.
+
+Success metric (ROADMAP item 2): tokens/s and p99 end-to-end latency.
+Writes a BENCH_SERVE_<tag>.json artifact; ``--fast`` is the seeded
+tier-1 mode (tiny model, seconds on CPU) whose throughput floor
+(continuous > static) tests/test_serve_engine.py asserts.
+
+Usage:
+  python tools/bench_serve.py --fast                # tier-1 smoke
+  python tools/bench_serve.py --tag r06 --requests 64 --rate 30
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
+
+import numpy as np  # noqa: E402
+
+
+def _build_model(fast: bool):
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    paddle.seed(11)
+    if fast:
+        cfg = LlamaConfig.tiny(vocab_size=128, hidden_size=32, layers=2,
+                               heads=4, kv_heads=2, seq=128)
+    else:
+        cfg = LlamaConfig.tiny(vocab_size=1024, hidden_size=256, layers=4,
+                               heads=8, kv_heads=4, seq=512)
+    cfg.use_flash_attention = False
+    return LlamaForCausalLM(cfg)
+
+
+def make_workload(seed: int, n_requests: int, rate: float, vocab: int,
+                  prompt_lens=(6, 24), max_new=(4, 16)):
+    """Seeded Poisson open-loop schedule: (arrival_s, prompt, max_new)."""
+    rng = np.random.default_rng(seed)
+    gaps = rng.exponential(1.0 / rate, n_requests)
+    arrivals = np.cumsum(gaps)
+    reqs = []
+    for i in range(n_requests):
+        plen = int(rng.integers(prompt_lens[0], prompt_lens[1] + 1))
+        mnew = int(rng.integers(max_new[0], max_new[1] + 1))
+        prompt = rng.integers(1, vocab, (plen,)).tolist()
+        reqs.append({"arrival_s": float(arrivals[i]), "prompt": prompt,
+                     "max_new": mnew})
+    return reqs
+
+
+def drive(model, workload, policy: str, engine_kw: dict):
+    """One open-loop run: submit each request when the run clock passes
+    its arrival time, step the engine whenever it has work. Returns the
+    stats row for the artifact."""
+    from paddle_tpu.serving import EngineConfig, ServingEngine
+    eng = ServingEngine(model, EngineConfig(policy=policy, **engine_kw))
+    pending = sorted(workload, key=lambda r: r["arrival_s"])
+    handles = []
+    t0 = time.monotonic()
+    i = 0
+    while i < len(pending) or eng.has_work():
+        now = time.monotonic() - t0
+        while i < len(pending) and pending[i]["arrival_s"] <= now:
+            r = pending[i]
+            handles.append((r, eng.submit(r["prompt"],
+                                          max_new_tokens=r["max_new"])))
+            i += 1
+        if eng.has_work():
+            eng.step()
+        elif i < len(pending):
+            time.sleep(min(pending[i]["arrival_s"] - now, 0.005))
+    wall = time.monotonic() - t0
+    lats, ttfts, tokens = [], [], 0
+    for spec, req in handles:
+        assert req.done, f"request {req.rid} never finished"
+        tokens += len(req.output)
+        lats.append((req.finished_at - t0) - spec["arrival_s"])
+        ttfts.append((req.first_token_at - t0) - spec["arrival_s"])
+    lats = np.asarray(lats)
+    return {
+        "policy": policy,
+        "requests": len(handles),
+        "output_tokens": int(tokens),
+        "wall_s": round(wall, 4),
+        "tokens_per_s": round(tokens / wall, 2),
+        "p50_latency_s": round(float(np.percentile(lats, 50)), 4),
+        "p99_latency_s": round(float(np.percentile(lats, 99)), 4),
+        "mean_ttft_s": round(float(np.mean(ttfts)), 4),
+        "engine_steps": eng.steps,
+        "preemptions": sum(1 for _, r in handles if r.preemptions),
+        "prefix_hits": eng.pool.stats["prefix_hits"],
+        "kv_evictions": eng.pool.stats["evicted"],
+    }
+
+
+def run_bench(fast: bool = True, seed: int = 0, tag: str = "fast",
+              n_requests: int = None, rate: float = None,
+              out_path: str = None):
+    model = _build_model(fast)
+    vocab = model.config.vocab_size
+    if fast:
+        n_requests = n_requests or 24
+        rate = rate or 200.0           # arrivals outrun a tiny CPU model
+        engine_kw = {"max_seqs": 4, "token_budget": 24, "block_size": 8}
+    else:
+        n_requests = n_requests or 64
+        rate = rate or 30.0
+        engine_kw = {"max_seqs": 8, "token_budget": 64, "block_size": 16}
+    workload = make_workload(seed, n_requests, rate, vocab)
+
+    # warm the jit cache outside the timed runs (both policies share the
+    # one compiled program: same decoder, same static shapes)
+    warm = ServingEngineWarmup(model, engine_kw)
+    rows = {}
+    for policy in ("static", "continuous"):
+        rows[policy] = drive(model, workload, policy, engine_kw)
+        print(f"[bench_serve] {policy:11s}: "
+              f"{rows[policy]['tokens_per_s']:8.1f} tok/s  "
+              f"p99 {rows[policy]['p99_latency_s']:.3f}s  "
+              f"steps {rows[policy]['engine_steps']}", flush=True)
+
+    result = {
+        "bench": "serve",
+        "tag": tag,
+        "seed": seed,
+        "fast": bool(fast),
+        "model": {"hidden": model.config.hidden_size,
+                  "layers": model.config.num_hidden_layers,
+                  "heads": model.config.num_attention_heads,
+                  "kv_heads": model.config.num_key_value_heads,
+                  "vocab": vocab},
+        "workload": {"n_requests": n_requests, "rate_rps": rate,
+                     "poisson": True, "open_loop": True},
+        "engine": engine_kw,
+        "static": rows["static"],
+        "continuous": rows["continuous"],
+        "vs_static": round(rows["continuous"]["tokens_per_s"]
+                           / max(rows["static"]["tokens_per_s"], 1e-9), 3),
+        "warmup_steps": warm,
+    }
+    if out_path is None:
+        out_path = os.path.join(HERE, f"BENCH_SERVE_{tag}.json")
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(result, f, indent=1)
+    os.replace(tmp, out_path)          # atomic: a killed run can't truncate
+    print(f"[bench_serve] vs_static={result['vs_static']}  -> {out_path}",
+          flush=True)
+    return result
+
+
+def ServingEngineWarmup(model, engine_kw):
+    """Compile the engine step (and generate-path jits the oracle tests
+    share) before any timer starts; returns steps used."""
+    from paddle_tpu.serving import EngineConfig, ServingEngine
+    eng = ServingEngine(model, EngineConfig(**engine_kw))
+    eng.generate_batch([[1, 2, 3]], max_new_tokens=2)
+    return eng.steps
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--fast", action="store_true",
+                    help="tiny seeded tier-1 mode")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--tag", default=None,
+                    help="artifact tag (BENCH_SERVE_<tag>.json)")
+    ap.add_argument("--requests", type=int, default=None)
+    ap.add_argument("--rate", type=float, default=None,
+                    help="Poisson arrival rate (requests/s)")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args(argv)
+    tag = args.tag or ("fast" if args.fast else "run")
+    res = run_bench(fast=args.fast, seed=args.seed, tag=tag,
+                    n_requests=args.requests, rate=args.rate,
+                    out_path=args.out)
+    return 0 if res["vs_static"] > 1.0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
