@@ -827,6 +827,48 @@ def generate(model, input_ids, attention_mask=None, max_new_tokens: int = 32,
     return Tensor(toks), Tensor(finished)
 
 
+def draft_greedy_batch(model, seqs, k: int, width: int = 64,
+                       quant: Optional[str] = None):
+    """Greedy k-token draft continuations of every ``seqs`` entry (each
+    a python token list) in ONE generate() call — speculative decoding
+    (``serving.speculative``) drafts for the whole decode batch per
+    step, not one device call per sequence.
+
+    Reuses the one-program generate() path — same ``_LlamaDecoder`` /
+    ``_GPTDecoder`` step machinery as the target model — but pins each
+    context into a FIXED left-padded window of ``width`` tokens, so a
+    serving drafter compiles one program per (batch, width, k)
+    signature instead of one per prompt length. A sequence longer than
+    the window keeps its most recent tokens (sliding-window drafting:
+    the drafter only proposes; verification restores exactness).
+    Returns a list of k-int lists, one per input sequence."""
+    if k < 1 or not seqs:
+        return [[] for _ in seqs]
+    max_pos = model.config.max_position_embeddings
+    if max_pos <= k:
+        raise ValueError(
+            f"draft model caps at {max_pos} positions, cannot draft "
+            f"{k} tokens")
+    width = int(min(width, max_pos - k))
+    ids = np.zeros((len(seqs), width), np.int32)
+    mask = np.zeros((len(seqs), width), np.int32)
+    for b, seq in enumerate(seqs):
+        ctx = [int(t) for t in seq[-width:]]
+        ids[b, width - len(ctx):] = ctx
+        mask[b, width - len(ctx):] = 1
+    toks, _ = generate(model, ids, attention_mask=mask,
+                       max_new_tokens=k, quant=quant)
+    return [[int(t) for t in row] for row in np.asarray(toks._data)]
+
+
+def draft_greedy(model, seq, k: int, width: int = 64,
+                 quant: Optional[str] = None):
+    """Single-sequence convenience over ``draft_greedy_batch``."""
+    if k < 1:
+        return []
+    return draft_greedy_batch(model, [seq], k, width=width, quant=quant)[0]
+
+
 # The decoder keys a bounded registry of jitted entry points: every model
 # with the same architecture — predictor-pool clones, test fixtures,
 # reloaded checkpoints — shares ONE compiled executable per (shapes,
@@ -894,4 +936,4 @@ def _decoder_for(model):
     return dec
 
 
-__all__ = ["generate"]
+__all__ = ["generate", "draft_greedy", "draft_greedy_batch"]

@@ -47,9 +47,12 @@ class Config:
         self._ir_optim = True
         self._memory_optim = True
         # serving knobs routed to paddle_tpu.serving (NOT no-ops): batch
-        # and KV-cache sizing feed ServingEngine via serving_options()
+        # and KV-cache sizing feed ServingEngine via serving_options(),
+        # speculative decoding via speculative_options()
         self._serving = {"max_seqs": None, "block_size": None,
                          "num_blocks": None}
+        self._speculative = {"spec_method": None, "num_draft_tokens": None,
+                             "draft_model": None, "spec_options": None}
 
     # -- serving knobs (routed, not warned) -----------------------------------
     def set_max_batch_size(self, n: int):
@@ -77,6 +80,34 @@ class Config:
         """The routed serving knobs (serving.engine_from_config reads
         this; None = engine default)."""
         return dict(self._serving)
+
+    def set_speculative_config(self, method: str, num_draft_tokens: int = 4,
+                               draft_model=None, **options):
+        """Speculative decoding for the serving engine: ``method`` is
+        "ngram" (model-free self-drafting; options max_match/min_match)
+        or "draft_model" (requires ``draft_model``, a small causal LM;
+        options context_width/quant); ``num_draft_tokens`` is the per-
+        sequence draft budget k. Routed to ServingEngine — greedy output
+        stays bit-identical to non-speculative decoding."""
+        if method not in ("ngram", "draft_model", "none", None):
+            raise ValueError(
+                f"unknown speculative method {method!r}: expected 'ngram',"
+                f" 'draft_model', or 'none'")
+        if int(num_draft_tokens) < 1:
+            raise ValueError(
+                f"num_draft_tokens must be >= 1, got {num_draft_tokens}")
+        if method == "draft_model" and draft_model is None:
+            raise ValueError("method='draft_model' needs draft_model=")
+        self._speculative = {
+            "spec_method": None if method == "none" else method,
+            "num_draft_tokens": int(num_draft_tokens),
+            "draft_model": draft_model,
+            "spec_options": dict(options) if options else None}
+
+    def speculative_options(self) -> Dict[str, object]:
+        """The routed speculative knobs (serving.engine_from_config reads
+        this; None = engine default / speculation off)."""
+        return dict(self._speculative)
 
     def set_model(self, model_path, params_path=None):
         self.__init__(model_path, params_path)

@@ -43,6 +43,10 @@ Catalog (names are a stable API — see README "Observability"):
   serve_prefix_cache_hits_total          lookups that reused >= 1 page
   serve_ttft_seconds                     submit -> first token latency
   serve_token_seconds                    per-token (step) latency
+  serve_spec_proposed_tokens_total       draft tokens fed to verify steps
+  serve_spec_accepted_tokens_total       drafts confirmed by greedy verify
+  serve_spec_accept_rate                 per-step accepted/proposed ratio
+  serve_spec_rollback_pages_total        KV pages released rolling back drafts
 """
 from __future__ import annotations
 
@@ -89,6 +93,10 @@ CATALOG = (
     "serve_prefix_cache_hits_total",
     "serve_ttft_seconds",
     "serve_token_seconds",
+    "serve_spec_proposed_tokens_total",
+    "serve_spec_accepted_tokens_total",
+    "serve_spec_accept_rate",
+    "serve_spec_rollback_pages_total",
 )
 
 _enabled = _m._ENABLED  # bind the cell once: hot-path guard is _enabled[0]
@@ -323,6 +331,33 @@ def record_serve_ttft(seconds: float) -> None:
     _reg().histogram("serve_ttft_seconds",
                      "submit -> first sampled token latency",
                      buckets=_TIME_BUCKETS).observe(seconds)
+
+
+def record_serve_spec_tokens(proposed: int, accepted: int) -> None:
+    """One verify step's speculative outcome: ``proposed`` draft tokens
+    fed, ``accepted`` confirmed by longest-prefix greedy verification."""
+    if not _enabled[0]:
+        return
+    r = _reg()
+    if proposed:
+        r.counter("serve_spec_proposed_tokens_total",
+                  "draft tokens fed to speculative verify steps") \
+            .inc(proposed)
+        r.gauge("serve_spec_accept_rate",
+                "accepted/proposed draft ratio of the last verify step") \
+            .set(accepted / proposed)
+    if accepted:
+        r.counter("serve_spec_accepted_tokens_total",
+                  "draft tokens confirmed by greedy verification") \
+            .inc(accepted)
+
+
+def record_serve_spec_rollback(pages: int) -> None:
+    if not _enabled[0] or not pages:
+        return
+    _reg().counter("serve_spec_rollback_pages_total",
+                   "KV pages released rolling back rejected drafts") \
+        .inc(pages)
 
 
 def record_serve_tokens(n: int, step_seconds: float) -> None:

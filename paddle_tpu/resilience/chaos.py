@@ -71,6 +71,7 @@ SITES = {
     "preempt.notice": "site",
     "serve.admit": "site",
     "serve.kv_alloc": "site",
+    "serve.spec_verify": "site",
 }
 
 _CONTROL_KINDS = ("delay", "error", "die")

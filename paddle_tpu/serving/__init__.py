@@ -14,17 +14,32 @@ for the TPU window), with streaming output and an
         pass                       # or drive from a server thread
     print(req.result())
 
+Speculative decoding (``serving.speculative``) rides the same packed
+batch: a drafter (model-free n-gram prompt-lookup, or a small draft
+model) proposes k tokens per decode sequence, one ragged verify forward
+scores all k+1 positions, longest-accepted-prefix greedy verification
+keeps output bit-identical, and ``KVBlockPool.truncate`` rolls pages
+back past the accepted frontier (copy-on-write on shared pages):
+
+    eng = ServingEngine(model, EngineConfig(spec_method="ngram",
+                                            num_draft_tokens=4))
+
 Benchmark with ``python tools/bench_serve.py --fast`` (Poisson open-loop
-load, continuous vs static policy, BENCH_SERVE_*.json artifact).
+load, continuous vs static policy, BENCH_SERVE_*.json artifact; add
+``--spec`` for the speculative vs non-speculative rows).
 """
 from .engine import (EngineConfig, EnginePredictor, ServingEngine,
                      engine_from_config)
 from .kv_pool import KVBlockPool, PoolExhausted
 from .ragged import ragged_paged_attention
 from .scheduler import Request, Scheduler
+from .speculative import (Drafter, DraftModelDrafter, NgramDrafter,
+                          make_drafter, verify_greedy)
 
 __all__ = [
     "EngineConfig", "EnginePredictor", "ServingEngine",
     "engine_from_config", "KVBlockPool", "PoolExhausted",
     "ragged_paged_attention", "Request", "Scheduler",
+    "Drafter", "NgramDrafter", "DraftModelDrafter", "make_drafter",
+    "verify_greedy",
 ]

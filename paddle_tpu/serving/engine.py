@@ -31,19 +31,32 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..profiler import instrument as _instr
+from ..resilience import chaos
 from . import ragged as _ragged
 from .kv_pool import KVBlockPool
 from .scheduler import Request, Scheduler
+from .speculative import make_drafter, verify_greedy
 
 
 class EngineConfig:
-    """Static shapes and policy for one engine (one compiled program)."""
+    """Static shapes and policy for one engine (one compiled program).
+
+    Speculative decoding: ``spec_method`` = None (off), "ngram"
+    (model-free self-drafting), or "draft_model" (requires
+    ``draft_model``); ``num_draft_tokens`` is k, the per-sequence draft
+    budget a verify step scores; ``spec_options`` are drafter kwargs
+    (``max_match``/``min_match`` for ngram, ``context_width``/``quant``
+    for draft_model). Speculation changes how many tokens a step can
+    emit, never which tokens — greedy output stays bit-identical."""
 
     def __init__(self, max_seqs: int = 8, token_budget: int = 64,
                  block_size: int = 16, num_blocks: Optional[int] = None,
                  max_model_len: Optional[int] = None,
                  enable_prefix_cache: bool = True,
-                 policy: str = "continuous", quant: Optional[str] = None):
+                 policy: str = "continuous", quant: Optional[str] = None,
+                 spec_method: Optional[str] = None,
+                 num_draft_tokens: int = 4, draft_model=None,
+                 spec_options: Optional[dict] = None):
         self.max_seqs = int(max_seqs)
         self.token_budget = int(token_budget)
         self.block_size = int(block_size)
@@ -52,6 +65,32 @@ class EngineConfig:
         self.enable_prefix_cache = bool(enable_prefix_cache)
         self.policy = policy
         self.quant = quant
+        self.spec_method = spec_method
+        self.num_draft_tokens = int(num_draft_tokens)
+        self.draft_model = draft_model
+        self.spec_options = dict(spec_options) if spec_options else {}
+        if spec_method is not None and self.num_draft_tokens < 1:
+            raise ValueError(
+                f"speculative decoding needs num_draft_tokens >= 1, "
+                f"got {self.num_draft_tokens}")
+
+
+@jax.jit
+def _argmax_rows(logits):
+    """Greedy token for EVERY packed row — fixed [T] shape, so the one
+    compiled program serves any mix of decode/prefill/verify entries
+    (a per-step gather of just the sampling rows would recompile on
+    every distinct row-count the speculative planner produces)."""
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def _copy_page(k_pools, v_pools, src, dst):
+    """Copy one physical page across every layer of the shared pools —
+    the device half of a copy-on-write rollback: the sequence's new
+    private boundary page starts as a byte copy of the shared one."""
+    return (k_pools.at[:, dst].set(k_pools[:, src]),
+            v_pools.at[:, dst].set(v_pools[:, src]))
 
 
 @partial(jax.jit, static_argnums=(0,), donate_argnums=(7, 8))
@@ -113,8 +152,29 @@ class ServingEngine:
         self._vp = jnp.zeros(shape, dtype)
         self.pool = KVBlockPool(num_blocks, bs,
                                 enable_prefix_cache=cfg.enable_prefix_cache)
+        spec_opts = dict(cfg.spec_options)
+        if cfg.spec_method == "draft_model":
+            if cfg.draft_model is None:
+                raise ValueError(
+                    "spec_method='draft_model' needs a draft_model")
+            d_cap = cfg.draft_model.config.max_position_embeddings
+            if d_cap <= cfg.num_draft_tokens:
+                raise ValueError(
+                    f"draft model caps at {d_cap} positions, cannot "
+                    f"draft {cfg.num_draft_tokens} tokens per step")
+            # pin the batched-draft program shape: padding every propose
+            # to (max_seqs, width, num_draft_tokens) means ONE compile no
+            # matter how the live decode batch and budgets fluctuate
+            spec_opts.setdefault("batch_pad", cfg.max_seqs)
+            spec_opts.setdefault("draft_k", cfg.num_draft_tokens)
+        self.drafter = make_drafter(cfg.spec_method,
+                                    draft_model=cfg.draft_model,
+                                    **spec_opts)
         self.sched = Scheduler(self.pool, cfg.max_seqs, cfg.token_budget,
-                               self.max_pages_per_seq, policy=cfg.policy)
+                               self.max_pages_per_seq, policy=cfg.policy,
+                               drafter=self.drafter,
+                               num_draft_tokens=cfg.num_draft_tokens
+                               if self.drafter is not None else 0)
         self._tables = np.full((cfg.max_seqs, self.max_pages_per_seq), -1,
                                np.int32)
         self._rng = np.random.default_rng(seed)
@@ -122,6 +182,9 @@ class ServingEngine:
         self._work = threading.Event()
         self.steps = 0
         self.tokens_generated = 0
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self.spec_rollback_pages = 0
 
     # -- client side ----------------------------------------------------------
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
@@ -176,6 +239,10 @@ class ServingEngine:
         for lat in sampled["ttfts"]:
             _instr.record_serve_ttft(lat)
         _instr.record_serve_tokens(sampled["tokens"], dt)
+        if plan.drafted:
+            _instr.record_serve_spec_tokens(plan.drafted,
+                                            sampled["accepted"])
+        _instr.record_serve_spec_rollback(sampled["rollback_pages"])
         return self.sched.has_work()
 
     def _run_plan(self, plan) -> dict:
@@ -184,46 +251,89 @@ class ServingEngine:
         slots = np.zeros(t_max, np.int32)
         positions = np.zeros(t_max, np.int32)
         valid = np.zeros(t_max, bool)
-        sample_points = []
+        sample_points = []             # (entry, row of its LAST seq token)
         idx = 0
         for e in plan.entries:
-            n = e.n
+            n, k = e.n, len(e.draft)
             tokens[idx:idx + n] = e.req.seq[e.start:e.start + n]
-            slots[idx:idx + n] = e.req.slot
-            positions[idx:idx + n] = np.arange(e.start, e.start + n)
-            valid[idx:idx + n] = True
+            if k:
+                # the verify chunk: drafted tokens ride the SAME packed
+                # batch at the positions they would occupy if accepted —
+                # to the kernel this is just one more prefill-like chunk
+                tokens[idx + n:idx + n + k] = e.draft
+            slots[idx:idx + n + k] = e.req.slot
+            positions[idx:idx + n + k] = np.arange(e.start, e.start + n + k)
+            valid[idx:idx + n + k] = True
             row = self._tables[e.req.slot]
             row[:] = -1
             row[:len(e.req.pages)] = e.req.pages
             if e.samples:
-                sample_points.append((e.req, idx + n - 1))
-            idx += n
+                sample_points.append((e, idx + n - 1))
+            idx += n + k
         logits, self._kp, self._vp = _engine_step(
             self.dec, self._w, jnp.asarray(tokens), jnp.asarray(slots),
             jnp.asarray(positions), jnp.asarray(valid),
             jnp.asarray(self._tables), self._kp, self._vp)
-        out = {"tokens": 0, "finished": 0, "ttfts": []}
+        out = {"tokens": 0, "finished": 0, "ttfts": [], "accepted": 0,
+               "rollback_pages": 0}
         for e in plan.entries:
-            e.req.pos = e.start + e.n
+            e.req.pos = e.start + e.n    # draft positions confirmed below
         if sample_points:
-            rows = np.asarray(
-                logits[jnp.asarray([i for _, i in sample_points])])
+            all_tok = np.asarray(_argmax_rows(logits))
             now = time.monotonic()
             finished = []
-            for (req, _), lg in zip(sample_points, rows):
-                tok = int(np.argmax(lg))
-                if req.first_token_at is None:
-                    req.first_token_at = now
-                    out["ttfts"].append(now - req.arrival)
-                req.emit(tok)
-                self.tokens_generated += 1
-                out["tokens"] += 1
-                if (len(req.output) >= req.max_new_tokens
-                        or (req.eos_id is not None and tok == req.eos_id)):
-                    finished.append(req)
+            for e, i in sample_points:
+                req = e.req
+                k = len(e.draft)
+                targets = [int(t) for t in all_tok[i:i + k + 1]]
+                if k:
+                    try:
+                        chaos.site("serve.spec_verify")
+                        _, emitted = verify_greedy(e.draft, targets)
+                    except chaos.FaultInjected:
+                        # full-rejection drill: every draft is discarded,
+                        # but the bonus token still lands — the engine
+                        # never falls below one token per seq per step
+                        emitted = targets[:1]
+                else:
+                    emitted = targets[:1]
+                used = 0
+                for tok in emitted:
+                    if req.first_token_at is None:
+                        req.first_token_at = now
+                        out["ttfts"].append(now - req.arrival)
+                    req.emit(tok)
+                    self.tokens_generated += 1
+                    out["tokens"] += 1
+                    used += 1
+                    if (len(req.output) >= req.max_new_tokens
+                            or (req.eos_id is not None
+                                and tok == req.eos_id)):
+                        finished.append(req)
+                        break
+                # used-1 drafts were confirmed correct (eos may cut the
+                # emission short of the full accepted prefix)
+                consumed = used - 1
+                out["accepted"] += consumed
+                req.pos = e.start + e.n + consumed
+                if consumed < k:
+                    # rejected drafts left garbage K/V past the accepted
+                    # frontier: roll the page list back; copy-on-write if
+                    # the kept boundary page is shared (rollback must
+                    # never mutate a page another holder can read)
+                    kept, released, cow = self.pool.truncate(req.pages,
+                                                             req.pos)
+                    req.pages = kept
+                    out["rollback_pages"] += released
+                    if cow is not None:
+                        self._kp, self._vp = _copy_page(
+                            self._kp, self._vp, cow[0], cow[1])
             for req in finished:
                 self.sched.evict_finished(req)
             out["finished"] = len(finished)
+            self.spec_proposed += plan.drafted
+            self.spec_accepted += out["accepted"]
+            self.spec_rollback_pages += out["rollback_pages"]
         return out
 
     def run_until_idle(self, max_steps: Optional[int] = None) -> int:
@@ -251,6 +361,13 @@ class ServingEngine:
                 for p in prompts]
         self.run_until_idle()
         return [r.result(timeout=0) for r in reqs]
+
+    def spec_stats(self) -> dict:
+        """Lifetime speculative-decoding counters (zeros when off)."""
+        p, a = self.spec_proposed, self.spec_accepted
+        return {"proposed": p, "accepted": a,
+                "accept_rate": a / p if p else 0.0,
+                "rollback_pages": self.spec_rollback_pages}
 
     def refresh_weights(self) -> None:
         """Re-snapshot the model weights (after a load_dict / train step).
@@ -313,13 +430,15 @@ class EnginePredictor:
 def engine_from_config(model, config=None, **overrides) -> ServingEngine:
     """Build a ServingEngine honoring ``inference.Config`` serving knobs
     (max_batch_size -> max_seqs, kv-cache block size/capacity -> pool
-    geometry); keyword overrides win."""
+    geometry, set_speculative_config -> drafter/k); keyword overrides
+    win."""
     kw = {}
-    serving = getattr(config, "serving_options", None)
-    if callable(serving):
-        for k, v in serving().items():
-            if v is not None:
-                kw[k] = v
+    for reader in ("serving_options", "speculative_options"):
+        opts = getattr(config, reader, None)
+        if callable(opts):
+            for k, v in opts().items():
+                if v is not None:
+                    kw[k] = v
     kw.update(overrides)
     if "max_seqs" in kw and "token_budget" not in kw:
         kw["token_budget"] = max(8 * kw["max_seqs"], 64)

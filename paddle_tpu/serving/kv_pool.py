@@ -85,18 +85,22 @@ class KVBlockPool:
                 f"KV pool exhausted: want {n} pages, "
                 f"{len(self._free)} free + {len(self._cached)} cached of "
                 f"{self.num_blocks}")
-        out = []
-        for _ in range(n):
-            if self._free:
-                blk = self._free.pop()
-            else:
-                blk, _ = self._cached.popitem(last=False)   # LRU evict
-                self._drop_key(blk)
-                self.stats["evicted"] += 1
-            self._ref[blk] = 1
-            out.append(blk)
-        self.stats["allocated"] += n
-        return out
+        return [self._take_page() for _ in range(n)]
+
+    def _take_page(self) -> int:
+        """One page off the free list (LRU-evicting a cached prefix page
+        under pressure), refcount 1. Caller has proven availability; no
+        chaos probe fires — ``truncate`` uses this mid-rollback, where an
+        injected allocation fault could not be unwound atomically."""
+        if self._free:
+            blk = self._free.pop()
+        else:
+            blk, _ = self._cached.popitem(last=False)   # LRU evict
+            self._drop_key(blk)
+            self.stats["evicted"] += 1
+        self._ref[blk] = 1
+        self.stats["allocated"] += 1
+        return blk
 
     def incref(self, blocks: Sequence[int]) -> None:
         for blk in blocks:
@@ -124,6 +128,65 @@ class KVBlockPool:
         key = self._key_of.pop(blk, None)
         if key is not None and self._by_key.get(key) == blk:
             del self._by_key[key]
+
+    def truncate(self, pages: Sequence[int], n_tokens: int
+                 ) -> Tuple[List[int], int, Optional[Tuple[int, int]]]:
+        """Roll one sequence's page list back so it covers exactly
+        ``n_tokens`` cached positions — the speculative-decode rollback:
+        pages past the accepted prefix return to the pool. Returns
+        ``(kept_pages, released, cow)``:
+
+          * ``kept_pages`` — the new page list (``ceil(n_tokens / bs)``
+            pages, a prefix of ``pages`` except possibly its last entry);
+          * ``released``   — trailing pages dropped past the kept prefix
+            (the COW exchange below is not counted: it frees and takes
+            one page, net zero);
+          * ``cow``        — ``None``, or ``(old, new)`` when the kept
+            BOUNDARY page (only partially covered, so the sequence will
+            rewrite its tail slots on the next feeds) is shared: held by
+            another sequence (refcount > 1) or registered in the prefix
+            cache, where a later request could acquire it at any moment.
+            Rollback must never mutate a page someone else can read, so
+            the boundary goes copy-on-write: the caller owns ``new``
+            (refcount 1, unregistered) and must copy the device-pool
+            content of ``old`` into it before the next scatter; ``old``
+            keeps serving its other holders untouched.
+
+        Raises PoolExhausted only on the (engine-unreachable) COW path
+        when no page would be obtainable for the private copy — checked
+        BEFORE any state changes, so a failed truncate leaves the pool
+        and the caller's page list exactly as they were."""
+        if n_tokens < 0:
+            raise ValueError(f"truncate to negative coverage {n_tokens}")
+        keep = -(-n_tokens // self.block_size)
+        if keep > len(pages):
+            raise ValueError(
+                f"truncate to {n_tokens} tokens needs {keep} pages but "
+                f"the sequence holds only {len(pages)}")
+        kept = list(pages[:keep])
+        tail = list(pages[keep:])
+        blk = kept[-1] if n_tokens % self.block_size and kept else None
+        need_cow = blk is not None and (self._ref[blk] > 1
+                                        or blk in self._key_of)
+        if need_cow:
+            # releasing the tail only frees pages this sequence holds
+            # the LAST reference to; prove the copy is obtainable before
+            # mutating anything (atomicity: fail ⇒ nothing changed)
+            obtainable = self.available_blocks() \
+                + sum(1 for t in tail if self._ref[t] == 1)
+            if obtainable < 1:
+                raise PoolExhausted(
+                    "KV pool exhausted: no page obtainable for the "
+                    "copy-on-write rollback of a shared boundary page")
+        if tail:
+            self.release(tail)
+        cow = None
+        if need_cow:
+            new = self._take_page()
+            self.release([blk])
+            kept[-1] = new
+            cow = (blk, new)
+        return kept, len(tail), cow
 
     # -- prefix cache ---------------------------------------------------------
     @staticmethod

@@ -22,6 +22,13 @@ the serving engine):
     [t]`` — the pools already contain this step's K/V (the engine
     scatters before attending), so within-chunk causality falls out of
     the position compare with no separate mask.
+
+Speculative verify chunks (``serving.speculative``) need nothing extra:
+k drafted tokens occupy positions ``pos..pos+k-1`` of their sequence
+exactly like a prefill chunk, so one forward scores every draft in the
+same packed batch — and after a rejection the garbage K/V left past the
+accepted frontier stays invisible to every later query, because the
+position compare already hides slots beyond a query's position.
 """
 from __future__ import annotations
 

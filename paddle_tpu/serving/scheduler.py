@@ -18,7 +18,11 @@ step under one token budget:
   * when the pool cannot grow a decode sequence, the MOST RECENTLY
     admitted running request is preempted (pages released, re-queued at
     the waiting front for recompute with its generated tokens appended
-    to the prompt) — FIFO order again decides who survives pressure.
+    to the prompt) — FIFO order again decides who survives pressure;
+  * speculative DRAFT tokens (``serving.speculative``) come LAST: only
+    budget left over after decode, prefill, and admission turns into
+    per-sequence verify chunks, so speculation accelerates idle decode
+    capacity and yields to real work under load.
 
 ``policy="static"`` degrades this scheduler to gang admission (admit only
 into an empty batch, run it dry) — the BatchingServer behavior — so
@@ -31,6 +35,7 @@ import itertools
 import queue
 import threading
 import time
+import warnings
 from typing import Callable, List, Optional, Sequence
 
 from ..resilience import chaos
@@ -120,14 +125,18 @@ class Request:
 
 class StepEntry:
     """One request's contribution to a packed step: feed
-    ``seq[start:start+n]`` at positions ``start..start+n-1``."""
+    ``seq[start:start+n]`` at positions ``start..start+n-1``, then any
+    ``draft`` tokens (speculative proposals, NOT part of ``seq``) at
+    positions ``start+n..start+n+len(draft)-1`` — the verify chunk."""
 
-    __slots__ = ("req", "start", "n")
+    __slots__ = ("req", "start", "n", "draft")
 
-    def __init__(self, req: Request, start: int, n: int):
+    def __init__(self, req: Request, start: int, n: int,
+                 draft: Sequence[int] = ()):
         self.req = req
         self.start = start
         self.n = n
+        self.draft = tuple(draft)
 
     @property
     def samples(self) -> bool:
@@ -137,16 +146,17 @@ class StepEntry:
 
 
 class StepPlan:
-    __slots__ = ("entries", "admitted", "preempted")
+    __slots__ = ("entries", "admitted", "preempted", "drafted")
 
-    def __init__(self, entries, admitted, preempted):
+    def __init__(self, entries, admitted, preempted, drafted=0):
         self.entries: List[StepEntry] = entries
         self.admitted: int = admitted
         self.preempted: int = preempted
+        self.drafted: int = drafted
 
     @property
     def total_tokens(self) -> int:
-        return sum(e.n for e in self.entries)
+        return sum(e.n + len(e.draft) for e in self.entries)
 
 
 class Scheduler:
@@ -154,18 +164,25 @@ class Scheduler:
     the engine serializes submit/step under its lock."""
 
     def __init__(self, pool: KVBlockPool, max_seqs: int, token_budget: int,
-                 max_pages_per_seq: int, policy: str = "continuous"):
+                 max_pages_per_seq: int, policy: str = "continuous",
+                 drafter=None, num_draft_tokens: int = 0):
         if policy not in ("continuous", "static"):
             raise ValueError(f"unknown scheduling policy {policy!r}")
         if token_budget < max_seqs:
             raise ValueError(
                 f"token_budget {token_budget} < max_seqs {max_seqs}: a "
                 "full decode batch would not fit one step")
+        if num_draft_tokens < 0:
+            raise ValueError(
+                f"num_draft_tokens must be >= 0, got {num_draft_tokens}")
         self.pool = pool
         self.max_seqs = int(max_seqs)
         self.token_budget = int(token_budget)
         self.max_pages_per_seq = int(max_pages_per_seq)
         self.policy = policy
+        self.drafter = drafter
+        self.num_draft_tokens = int(num_draft_tokens)
+        self._drafter_warned = False
         self.waiting: List[Request] = []
         self.running: List[Request] = []   # admission order
         self._free_slots = list(range(self.max_seqs - 1, -1, -1))
@@ -238,8 +255,9 @@ class Scheduler:
     # -- the per-step planner -------------------------------------------------
     def schedule(self) -> StepPlan:
         entries: List[StepEntry] = []
+        decode_entries: List[StepEntry] = []
         budget = self.token_budget
-        admitted = preempted = 0
+        admitted = preempted = drafted = 0
 
         # 1) one decode token per running sequence in its decode phase —
         #    grown pages first; exhaustion preempts the youngest (possibly
@@ -256,7 +274,9 @@ class Scheduler:
                 continue                      # preempted itself
             if len(req.pages) * self.pool.block_size <= req.pos:
                 continue                      # still no page: sit out
-            entries.append(StepEntry(req, req.pos, 1))
+            e = StepEntry(req, req.pos, 1)
+            entries.append(e)
+            decode_entries.append(e)
             budget -= 1
 
         # 2) prefill chunks for running requests still inside their prompt
@@ -306,7 +326,66 @@ class Scheduler:
             budget -= chunk
             admitted += 1
 
-        return StepPlan(entries, admitted, preempted)
+        # 4) speculation LAST: drafted tokens take only the budget left
+        #    after every decode step, prefill chunk, and admission got
+        #    theirs — under load speculation yields its slots to real
+        #    work instead of starving it, degrading toward plain decode.
+        #    Each draft token occupies the budget like a prefill token
+        #    (it is one more row of the same packed verify batch). All
+        #    eligible sequences are drafted in ONE propose_batch call so
+        #    a device-backed drafter runs one program per step, not one
+        #    per sequence.
+        if (self.drafter is not None and self.num_draft_tokens > 0
+                and budget > 0):
+            cands = []
+            avail = budget
+            for e in decode_entries:
+                if avail <= 0:
+                    break
+                # drafting past the request's remaining output is waste
+                # (a verify step emits at most len(draft)+1 tokens), and
+                # drafting past the leftover budget is waste a device-
+                # backed drafter would PAY for — cap each candidate's k
+                # so the batched propose never computes discarded drafts
+                room = e.req.max_new_tokens - len(e.req.output) - 1
+                d_max = min(self.num_draft_tokens, room, avail)
+                if d_max > 0:
+                    cands.append((e, d_max))
+                    avail -= d_max
+            # a drafter can cost throughput, never correctness — and
+            # never the engine: a propose failure (draft-model capacity,
+            # user drafter bug) degrades this step to plain decode
+            # instead of escaping schedule() and wedging the driver
+            # thread with RUNNING requests parked forever
+            try:
+                proposals = self.drafter.propose_batch(
+                    [e.req for e, _ in cands], [d for _, d in cands]) \
+                    if cands else []
+            except Exception as exc:
+                if not self._drafter_warned:
+                    warnings.warn(
+                        f"drafter propose_batch failed ({exc!r}); "
+                        "skipping speculation — decode continues "
+                        "unspeculated")
+                    self._drafter_warned = True
+                proposals = []
+            for (e, d_max), prop in zip(cands, proposals):
+                if budget <= 0:
+                    break
+                drafts = list(prop)[:min(d_max, budget)]
+                # pages must also cover the drafted positions; shrink the
+                # proposal under pool pressure rather than preempting —
+                # speculation is opportunistic
+                while drafts and not self._grow_pages(
+                        e.req, e.start + e.n - 1 + len(drafts)):
+                    drafts.pop()
+                if not drafts:
+                    continue
+                e.draft = tuple(int(t) for t in drafts)
+                budget -= len(drafts)
+                drafted += len(drafts)
+
+        return StepPlan(entries, admitted, preempted, drafted)
 
     def _fit_chunk(self, req: Request, chunk: int) -> int:
         """Shrink a prefill chunk to the pages actually obtainable.

@@ -1,0 +1,208 @@
+"""Speculative decoding for the serving engine: draft, verify, roll back.
+
+Decode is memory-bound — one forward pass per token per sequence reads
+every weight matrix to produce ONE token. Speculation breaks that bound
+without changing the output: a cheap DRAFTER proposes k continuation
+tokens, the engine feeds them alongside the sequence's pending token as
+one packed multi-token chunk (exactly the mixed-phase batch shape Ragged
+Paged Attention already serves — verification reuses the PR 6
+``step_ragged`` path, no new kernel), and greedy verification keeps the
+longest prefix of drafts that match the model's own argmax chain:
+
+    drafts   d1  d2  d3 ... dk          (from the drafter)
+    targets  t0  t1  t2 ... tk          (argmax at each fed position)
+    accept a = longest prefix with d_{j+1} == t_j
+    emit     t0 .. ta                   (a accepted drafts + 1 bonus)
+
+Every emitted token is an argmax over logits whose inputs — the cache
+below the position plus accepted (== correct) draft K/V — are identical
+to the non-speculative run's, so speculative greedy output is
+bit-identical to plain greedy decoding; a full rejection still emits t0,
+the ordinary next token, so the engine never regresses below one token
+per sequence per step. Rejected drafts leave K/V garbage past the
+accepted frontier; pages past it are rolled back via
+``KVBlockPool.truncate`` (copy-on-write when the boundary page is
+shared), and garbage inside the kept boundary page stays invisible —
+the position-compare mask hides slots beyond a query's position until a
+later feed overwrites them.
+
+Two drafters ship:
+
+  * ``NgramDrafter``     — model-free self-drafting (prompt-lookup): the
+    longest recent n-gram suffix of the sequence is searched earlier in
+    the sequence and its historical continuation proposed. Deterministic,
+    CPU-only, no second model; strong on repetitive/code-like text.
+  * ``DraftModelDrafter`` — a small causal LM drafts greedily through
+    ``generation.draft_greedy`` (the same ``_LlamaDecoder``/
+    ``_GPTDecoder`` step path as the target model, left-padded to a
+    fixed context width so serving compiles ONE draft program).
+
+Drafters only PROPOSE — a wrong, stale, or truncated-context draft can
+cost throughput, never correctness.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+
+class Drafter:
+    """Interface: propose up to ``k`` draft tokens continuing
+    ``req.seq`` (the prompt plus every token emitted so far). May return
+    fewer than ``k`` — or ``[]`` to skip speculation for this sequence
+    this step. Must be cheap and side-effect free on the request.
+
+    The scheduler calls ``propose_batch`` once per step with every
+    draft-eligible decode sequence; drafters backed by a device program
+    override it to draft the whole batch in one call."""
+
+    def propose(self, req, k: int) -> List[int]:
+        raise NotImplementedError
+
+    def propose_batch(self, reqs, ks) -> List[List[int]]:
+        return [self.propose(req, k) for req, k in zip(reqs, ks)]
+
+
+class NgramDrafter(Drafter):
+    """Self-drafting by prompt lookup (model-free).
+
+    Finds the longest match (``max_match`` down to ``min_match`` tokens)
+    of the sequence's current suffix at an EARLIER offset — most recent
+    occurrence wins — and proposes the tokens that followed it there.
+    Greedy decode loves this: repetitive prompts, code, and the short
+    cycles small models fall into all replay history verbatim, and the
+    verify step charges nothing for misses beyond the drafted slots.
+
+    The search runs every decode step for every running sequence (on
+    the host, under the engine lock), so it is bounded to the most
+    recent ``lookback`` tokens — long sequences keep O(lookback)
+    per-step cost, and the cycles worth replaying are recent anyway."""
+
+    def __init__(self, max_match: int = 4, min_match: int = 1,
+                 lookback: int = 256):
+        if not 1 <= int(min_match) <= int(max_match):
+            raise ValueError(
+                f"need 1 <= min_match <= max_match, got "
+                f"({min_match}, {max_match})")
+        if int(lookback) < 2:
+            raise ValueError(f"lookback must be >= 2, got {lookback}")
+        self.max_match = int(max_match)
+        self.min_match = int(min_match)
+        self.lookback = int(lookback)
+
+    def propose(self, req, k: int) -> List[int]:
+        seq = req.seq[-self.lookback:]
+        n = len(seq)
+        if k < 1 or n < self.min_match + 1:
+            return []
+        for m in range(min(self.max_match, n - 1), self.min_match - 1, -1):
+            tail = seq[n - m:]
+            for i in range(n - m - 1, -1, -1):
+                if seq[i:i + m] == tail:
+                    # the continuation may run into the tail itself —
+                    # those are real tokens too (period < m repetition)
+                    return [int(t) for t in seq[i + m:i + m + k]]
+        return []
+
+
+class DraftModelDrafter(Drafter):
+    """Draft with a small causal LM through the existing decode path.
+
+    ``generation.draft_greedy_batch`` left-pads every sequence into a
+    FIXED ``context_width`` window (a serving loop must not recompile
+    per prompt length) and runs the plain one-program greedy generate
+    ONCE for the whole decode batch each step. With ``batch_pad`` and
+    ``draft_k`` set (the engine pins them to its max_seqs /
+    num_draft_tokens), every call shares ONE (batch_pad, width,
+    draft_k) jit signature no matter how the live decode batch and
+    per-sequence budgets fluctuate — the recompile class the serving
+    tier bans everywhere else. Context beyond the window slides off the
+    left; the draft model may disagree with the target anywhere —
+    verification keeps output exact either way."""
+
+    def __init__(self, draft_model, context_width: int = 64,
+                 quant: Optional[str] = None,
+                 batch_pad: Optional[int] = None,
+                 draft_k: Optional[int] = None):
+        if draft_model is None:
+            raise ValueError("DraftModelDrafter needs a draft model")
+        if int(context_width) < 1:
+            raise ValueError(
+                f"context_width must be >= 1, got {context_width}")
+        self.model = draft_model
+        self.context_width = int(context_width)
+        self.quant = quant
+        self.batch_pad = None if batch_pad is None else int(batch_pad)
+        self.draft_k = None if draft_k is None else int(draft_k)
+
+    def propose(self, req, k: int) -> List[int]:
+        if k < 1:
+            return []
+        from ..generation import draft_greedy
+        return draft_greedy(self.model, req.seq, k,
+                            width=self.context_width, quant=self.quant)
+
+    def propose_batch(self, reqs, ks) -> List[List[int]]:
+        """One batched draft forward for the whole decode batch: draft
+        together, slice each row back to its own budget (over-drafted
+        tails are simply never fed). Rows are padded to ``batch_pad``
+        and the draft length pinned to ``draft_k`` when set, so the
+        device program compiles once."""
+        ks = list(ks)
+        live = [(i, req) for i, (req, k) in enumerate(zip(reqs, ks))
+                if k >= 1]
+        if not live:
+            return [[] for _ in ks]
+        from ..generation import draft_greedy_batch
+        seqs = [req.seq for _, req in live]
+        k = max(ks) if self.draft_k is None else max(self.draft_k,
+                                                     max(ks))
+        if self.batch_pad is not None and len(seqs) < self.batch_pad:
+            seqs = seqs + [[0]] * (self.batch_pad - len(seqs))
+        rows = draft_greedy_batch(self.model, seqs, k,
+                                  width=self.context_width,
+                                  quant=self.quant)
+        out: List[List[int]] = [[] for _ in ks]
+        for (i, _), row in zip(live, rows):
+            out[i] = row[:ks[i]]
+        return out
+
+
+def make_drafter(method: Optional[str], draft_model=None,
+                 **options) -> Optional[Drafter]:
+    """Drafter factory keyed by the ``inference.Config`` /
+    ``EngineConfig`` method name: ``None``/"none" (speculation off),
+    "ngram" (options: max_match/min_match), or "draft_model" (requires
+    ``draft_model``; options: context_width/quant)."""
+    if method in (None, "none"):
+        return None
+    if method == "ngram":
+        return NgramDrafter(**options)
+    if method == "draft_model":
+        return DraftModelDrafter(draft_model, **options)
+    raise ValueError(
+        f"unknown speculative method {method!r}: expected 'ngram' or "
+        "'draft_model' (or None to disable)")
+
+
+def verify_greedy(drafts: Sequence[int], targets: Sequence[int]
+                  ) -> Tuple[int, List[int]]:
+    """Longest-accepted-prefix greedy verification.
+
+    ``targets[j]`` is the model's argmax at the j-th fed position of the
+    verify chunk (``len(drafts) + 1`` entries: the pending token's slot
+    first, then one per draft). Returns ``(accepted, emitted)`` where
+    ``emitted == targets[:accepted + 1]`` — the accepted drafts (each
+    equal to its target) plus the bonus token, i.e. exactly the tokens
+    plain greedy decoding would have produced one step at a time."""
+    if len(targets) != len(drafts) + 1:
+        raise ValueError(
+            f"verify needs len(drafts)+1 targets, got {len(drafts)} "
+            f"drafts and {len(targets)} targets")
+    a = 0
+    while a < len(drafts) and int(drafts[a]) == int(targets[a]):
+        a += 1
+    return a, [int(t) for t in targets[:a + 1]]
+
+
+__all__ = ["Drafter", "NgramDrafter", "DraftModelDrafter", "make_drafter",
+           "verify_greedy"]

@@ -440,6 +440,436 @@ def test_batching_server_delegates_to_engine():
     assert server.requests_served == 4
 
 
+# -- speculative decoding ------------------------------------------------------
+
+def test_verify_greedy_unit():
+    from paddle_tpu.serving import verify_greedy
+    # full accept: every draft equals its target; bonus token rides along
+    assert verify_greedy([7, 8, 9], [7, 8, 9, 4]) == (3, [7, 8, 9, 4])
+    # partial: first mismatch cuts; emitted = accepted drafts + the
+    # model's own token AT the mismatch position
+    assert verify_greedy([7, 8, 9], [7, 5, 9, 4]) == (1, [7, 5])
+    # full rejection still emits the ordinary next token
+    assert verify_greedy([7, 8], [1, 2, 3]) == (0, [1])
+    assert verify_greedy([], [6]) == (0, [6])
+    with pytest.raises(ValueError, match="len\\(drafts\\)\\+1"):
+        verify_greedy([7], [1])
+
+
+def test_ngram_drafter_prompt_lookup():
+    from paddle_tpu.serving import NgramDrafter
+
+    class Req:
+        def __init__(self, seq):
+            self.seq = seq
+
+    d = NgramDrafter(max_match=3, min_match=1)
+    # suffix [2, 3] recurs at offset 1; its continuation [4, 5] is drafted
+    assert d.propose(Req([9, 2, 3, 4, 5, 2, 3]), 2) == [4, 5]
+    # the continuation may overlap the tail, but never runs past the
+    # end of recorded history (proposals are real observed tokens only)
+    assert d.propose(Req([1, 2, 1, 2, 1, 2]), 4) == [1, 2]
+    # most recent occurrence wins
+    assert d.propose(Req([5, 7, 1, 5, 8, 2, 5]), 1) == [8]
+    # nothing recurs -> no proposal (speculation skipped, never wrong)
+    assert d.propose(Req([1, 2, 3, 4, 5]), 3) == []
+    assert d.propose(Req([1, 2]), 0) == []
+    with pytest.raises(ValueError, match="min_match"):
+        NgramDrafter(max_match=2, min_match=3)
+    # the per-step scan is bounded: a recurrence older than `lookback`
+    # is invisible (host cost stays O(lookback) as sequences grow)
+    d8 = NgramDrafter(max_match=3, min_match=2, lookback=8)
+    far = [4, 5, 6] + [9] * 10 + [4, 5]          # match 10 tokens back
+    assert d8.propose(Req(far), 2) == []
+    assert NgramDrafter(max_match=3, min_match=2).propose(Req(far), 1) \
+        == [6]
+    # default propose_batch maps propose over the batch
+    seqs = [[9, 2, 3, 4, 2, 3], [1, 2, 3]]
+    assert d.propose_batch([Req(s) for s in seqs], [2, 2]) == [[4, 2], []]
+
+
+def test_draft_greedy_matches_generate():
+    """Within its context window the draft path IS plain greedy
+    generate() — same decoder, left-padded fixed width."""
+    model = _model()
+    prompt = _prompts(1, lens=(9,))[0]
+    want = _oracle(model, [prompt], max_new=4)[0]
+    got = G.draft_greedy(model, prompt, 4, width=16)
+    assert got == want
+
+
+@pytest.mark.parametrize("kv_heads", [4, 2])     # MHA and GQA
+def test_spec_matches_generate_llama(kv_heads):
+    model = _model(kv_heads=kv_heads)
+    prompts = _prompts(4)
+    want = _oracle(model, prompts, max_new=8)
+    eng = ServingEngine(model, EngineConfig(
+        max_seqs=3, token_budget=24, block_size=8,
+        spec_method="ngram", num_draft_tokens=4))
+    got = eng.generate_batch(prompts, max_new_tokens=8)
+    assert got == want                 # bit-identical to one-shot greedy
+    assert eng.pool.used_blocks() == 0  # rollbacks drained every refcount
+
+
+def test_spec_matches_generate_gpt():
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    paddle.seed(5)
+    cfg = GPTConfig.tiny(vocab_size=53, hidden_size=32, layers=2, heads=4,
+                         seq=64)
+    model = GPTForCausalLM(cfg)
+    prompts = _prompts(3, vocab=53, seed=4)
+    want = _oracle(model, prompts, max_new=5)
+    eng = ServingEngine(model, EngineConfig(
+        max_seqs=2, token_budget=12, block_size=4,
+        spec_method="ngram", num_draft_tokens=3))
+    assert eng.generate_batch(prompts, max_new_tokens=5) == want
+
+
+def test_spec_draft_model_matches_generate():
+    """The draft-model drafter (here: self-speculation through a
+    SLIDING 16-token window, so drafts can diverge from the full-context
+    target) still yields bit-identical output."""
+    model = _model()
+    prompts = _prompts(2, lens=(7, 5))
+    want = _oracle(model, prompts, max_new=6)
+    eng = ServingEngine(model, EngineConfig(
+        max_seqs=2, token_budget=16, block_size=8,
+        spec_method="draft_model", num_draft_tokens=2, draft_model=model,
+        spec_options={"context_width": 16}))
+    got = eng.generate_batch(prompts, max_new_tokens=6)
+    assert got == want
+    assert eng.spec_proposed > 0       # the drafter did participate
+
+
+def test_spec_eos_cut_parity():
+    """eos landing inside an accepted verify prefix must cut the
+    emission exactly where plain decoding would stop."""
+    model = _model()
+    prompts = _prompts(2)
+    ref = _oracle(model, prompts, max_new=8)
+    eos = ref[0][2]
+    eng0 = ServingEngine(model, EngineConfig(max_seqs=2, token_budget=24,
+                                             block_size=8))
+    want = [eng0.submit(p, max_new_tokens=8, eos_id=eos) for p in prompts]
+    eng0.run_until_idle()
+    eng1 = ServingEngine(model, EngineConfig(
+        max_seqs=2, token_budget=24, block_size=8,
+        spec_method="ngram", num_draft_tokens=4))
+    got = [eng1.submit(p, max_new_tokens=8, eos_id=eos) for p in prompts]
+    eng1.run_until_idle()
+    assert [r.result(0) for r in got] == [r.result(0) for r in want]
+    assert got[0].result(0) == ref[0][:3]        # stopped AT the eos token
+
+
+def test_spec_accept_rate_floor_on_repetitive_text():
+    """Repetitive/code-like prompts are the n-gram drafter's home turf:
+    the accept rate must clear a floor and buy real steps (seeded, no
+    wall clock — fully deterministic)."""
+    model = _model(seed=3)
+    rng = np.random.default_rng(7)
+    pattern = rng.integers(1, 61, (5,)).tolist()
+    prompts = [(pattern * 4)[:18], (pattern * 4)[:15]]
+    kw = dict(max_seqs=2, token_budget=32, block_size=8)
+    eng0 = ServingEngine(model, EngineConfig(**kw))
+    want = eng0.generate_batch(prompts, max_new_tokens=24)
+    eng1 = ServingEngine(model, EngineConfig(
+        spec_method="ngram", num_draft_tokens=4, **kw))
+    assert eng1.generate_batch(prompts, max_new_tokens=24) == want
+    stats = eng1.spec_stats()
+    assert stats["accept_rate"] >= 0.3, stats
+    assert eng1.steps < eng0.steps     # speculation saved device calls
+
+
+# -- KV rollback (truncate) ----------------------------------------------------
+
+def test_truncate_releases_tail_and_drains_to_zero():
+    pool = KVBlockPool(8, 4, enable_prefix_cache=False)
+    pages = pool.allocate(4)                      # covers 16 positions
+    kept, released, cow = pool.truncate(pages, 9)  # keep ceil(9/4) = 3
+    assert kept == pages[:3] and released == 1 and cow is None
+    assert pool._ref[pages[3]] == 0
+    # exact page boundary: no partial page, no COW even at full coverage
+    kept2, released2, cow2 = pool.truncate(kept, 8)
+    assert kept2 == pages[:2] and released2 == 1 and cow2 is None
+    pool.release(kept2)
+    assert pool.used_blocks() == 0
+    assert pool.free_blocks() == pool.num_blocks
+    with pytest.raises(ValueError, match="negative"):
+        pool.truncate([], -1)
+    with pytest.raises(ValueError, match="holds only"):
+        pool.truncate(pages[:1], 9)
+
+
+def test_truncate_cow_on_refcount_shared_boundary():
+    """Rollback must never mutate a page another sequence holds: a
+    shared partially-kept boundary page is exchanged for a private
+    copy, the original untouched for its other holder."""
+    pool = KVBlockPool(8, 4, enable_prefix_cache=False)
+    pages = pool.allocate(2)
+    pool.incref([pages[1]])                       # second holder
+    kept, released, cow = pool.truncate(list(pages), 6)   # partial page 1
+    assert released == 0 and cow is not None
+    old, new = cow
+    assert old == pages[1] and kept == [pages[0], new] and new != old
+    assert pool._ref[old] == 1                    # other holder keeps it
+    assert pool._ref[new] == 1                    # caller owns the copy
+    pool.release([old])
+    pool.release(kept)
+    assert pool.used_blocks() == 0
+
+
+def test_truncate_cow_on_prefix_registered_boundary():
+    """A boundary page registered in the prefix cache could be acquired
+    by a later request at any moment — rollback goes copy-on-write and
+    the registered original parks with its content intact."""
+    pool = KVBlockPool(8, 4)
+    toks = list(range(100, 108))                  # 2 full pages
+    pages = pool.allocate(2)
+    pool.register_prefix(toks, pages)
+    kept, released, cow = pool.truncate(list(pages), 6)
+    assert cow is not None and cow[0] == pages[1]
+    assert kept[-1] == cow[1] and kept[-1] not in pool._key_of
+    # the original parked in the cache and is still prefix-matchable
+    assert pool._ref[pages[1]] == 0 and pages[1] in pool._key_of
+    hit_pages, n = pool.match_prefix(toks + [1])
+    assert hit_pages == pages and n == 8
+    pool.release(hit_pages)
+    pool.release(kept)
+    assert pool.used_blocks() == 0
+
+
+# -- scheduler: drafts yield budget under load ---------------------------------
+
+def _running_decode_req(sched, pool, seq, slot):
+    from paddle_tpu.serving.scheduler import RUNNING, Request
+    req = Request(seq[:1], max_new_tokens=32)
+    req.seq = list(seq)
+    req.pos = len(seq) - 1
+    req.state = RUNNING
+    req.slot = slot
+    req.pages = pool.allocate((req.pos - 1) // pool.block_size + 1)
+    sched.running.append(req)
+    sched._free_slots.remove(slot)
+    return req
+
+
+def test_truncate_cow_exhaustion_is_atomic():
+    """When no page is obtainable for the copy-on-write, truncate must
+    raise BEFORE mutating anything — the caller's page list stays fully
+    owned (review regression: a mid-truncate failure used to leave the
+    released tail behind)."""
+    pool = KVBlockPool(2, 4, enable_prefix_cache=False)
+    pages = pool.allocate(2)
+    pool.incref([pages[1]])                       # shared boundary
+    pool.incref([pages[0]])                       # tail share: release
+    with pytest.raises(PoolExhausted, match="copy-on-write"):
+        pool.truncate([pages[1], pages[0]], 3)    # of [0] frees nothing
+    assert pool._ref[pages[0]] == 2               # nothing changed
+    assert pool._ref[pages[1]] == 2
+    # with the tail's last reference releasable the same call succeeds
+    pool.release([pages[0]])
+    kept, released, cow = pool.truncate([pages[1], pages[0]], 3)
+    assert released == 1 and cow is not None and cow[0] == pages[1]
+
+
+def test_truncate_cow_immune_to_kv_alloc_chaos():
+    """The rollback's COW page grab bypasses the serve.kv_alloc probe:
+    an armed pool-exhaustion drill must not be able to break truncate's
+    atomicity mid-rollback (review regression)."""
+    plan = chaos.FaultPlan(seed=0).add("serve.kv_alloc", "error", prob=1.0)
+    chaos.install_plan(plan)
+    try:
+        pool = KVBlockPool(4, 4, enable_prefix_cache=False)
+        with pytest.raises(chaos.FaultInjected):
+            pool.allocate(2)                      # front door still drills
+        chaos.clear_plan()
+        pages = pool.allocate(2)
+        chaos.install_plan(plan)
+        pool.incref([pages[1]])
+        kept, released, cow = pool.truncate(list(pages), 6)
+    finally:
+        chaos.clear_plan()
+    assert released == 0 and cow is not None and cow[0] == pages[1]
+
+
+def test_draft_model_propose_batch_slices_per_budget():
+    """One batched draft forward serves mixed per-sequence budgets."""
+    from paddle_tpu.serving import DraftModelDrafter
+
+    class Req:
+        def __init__(self, seq):
+            self.seq = seq
+
+    model = _model()
+    prompts = _prompts(3, lens=(9, 6, 4))
+    # k=0 sequences are excluded from the device batch entirely; the
+    # others share one draft forward and slice to their own budget
+    rows = G.draft_greedy_batch(model, prompts[:2], 3, width=16)
+    d = DraftModelDrafter(model, context_width=16)
+    got = d.propose_batch([Req(p) for p in prompts], [3, 1, 0])
+    assert got == [rows[0], rows[1][:1], []]
+
+
+def test_engine_pins_draft_model_batch_shape():
+    """The engine pads every propose to (max_seqs, width, k): padding
+    rows and the draft length are pinned at construction so the batched
+    draft program compiles ONCE, however the live batch fluctuates —
+    and the padded program proposes the same drafts as the bare one."""
+    from paddle_tpu.serving import DraftModelDrafter
+
+    class Req:
+        def __init__(self, seq):
+            self.seq = seq
+
+    model = _model()
+    eng = ServingEngine(model, EngineConfig(
+        max_seqs=4, token_budget=16, block_size=8,
+        spec_method="draft_model", num_draft_tokens=3, draft_model=model,
+        spec_options={"context_width": 16}))
+    assert eng.drafter.batch_pad == 4
+    assert eng.drafter.draft_k == 3
+    # explicit spec_options win over the engine's pinning
+    eng2 = ServingEngine(model, EngineConfig(
+        max_seqs=4, token_budget=16, block_size=8,
+        spec_method="draft_model", num_draft_tokens=3, draft_model=model,
+        spec_options={"context_width": 16, "batch_pad": 2, "draft_k": 1}))
+    assert eng2.drafter.batch_pad == 2
+    assert eng2.drafter.draft_k == 1
+    # padded-batch proposals == bare per-sequence proposals
+    prompts = _prompts(2, lens=(9, 6))
+    bare = DraftModelDrafter(model, context_width=16)
+    reqs = [Req(p) for p in prompts]
+    assert eng.drafter.propose_batch(reqs, [2, 3]) == \
+        bare.propose_batch(reqs, [2, 3])
+
+
+def test_drafter_failure_degrades_not_wedges():
+    """A drafter is opportunistic all the way down: propose_batch
+    raising must degrade the step to plain decode (one warning, parity
+    kept), never escape schedule() and wedge the engine's driver with
+    RUNNING requests parked forever. An impossible draft-model config
+    is rejected eagerly at engine construction instead."""
+    import warnings as W
+    from paddle_tpu.serving.speculative import Drafter
+
+    class Exploding(Drafter):
+        def propose(self, req, k):
+            raise RuntimeError("boom")
+
+    model = _model()
+    prompts = _prompts(2, lens=(7, 5))
+    want = _oracle(model, prompts, max_new=6)
+    eng = ServingEngine(model, EngineConfig(max_seqs=2, token_budget=16,
+                                            block_size=8))
+    eng.drafter = eng.sched.drafter = Exploding()
+    eng.sched.num_draft_tokens = 2
+    with W.catch_warnings(record=True) as rec:
+        W.simplefilter("always")
+        got = eng.generate_batch(prompts, max_new_tokens=6)
+    assert got == want                      # parity, engine alive
+    assert eng.spec_proposed == 0
+    warned = [w for w in rec if "drafter" in str(w.message)]
+    assert len(warned) == 1                 # warn once, not per step
+    # draft model too small for k: caught at construction, not step time
+    with pytest.raises(ValueError, match="draft model caps"):
+        ServingEngine(model, EngineConfig(
+            max_seqs=2, token_budget=16, block_size=8,
+            spec_method="draft_model", num_draft_tokens=64,
+            draft_model=model))
+    # missing draft model: clean ValueError, not an AttributeError
+    with pytest.raises(ValueError, match="needs a draft_model"):
+        ServingEngine(model, EngineConfig(
+            max_seqs=2, token_budget=16, block_size=8,
+            spec_method="draft_model", num_draft_tokens=2))
+
+
+def test_spec_drafts_take_only_leftover_budget():
+    from paddle_tpu.serving import NgramDrafter
+    from paddle_tpu.serving.scheduler import Request, Scheduler
+    pool = KVBlockPool(64, 4)
+    rep = [3, 4, 5, 3, 4, 5, 3, 4, 5]           # ngram-draftable history
+    # budget == max_seqs: decode eats everything, drafts get nothing
+    sched = Scheduler(pool, max_seqs=2, token_budget=2,
+                      max_pages_per_seq=16, drafter=NgramDrafter(),
+                      num_draft_tokens=4)
+    for slot in (0, 1):
+        _running_decode_req(sched, pool, rep, slot)
+    plan = sched.schedule()
+    assert plan.drafted == 0
+    assert all(e.draft == () for e in plan.entries)
+    # slack budget: the same batch drafts up to k per decode entry
+    sched2 = Scheduler(pool, max_seqs=2, token_budget=16,
+                       max_pages_per_seq=16, drafter=NgramDrafter(),
+                       num_draft_tokens=4)
+    for slot in (0, 1):
+        _running_decode_req(sched2, pool, rep, slot)
+    plan2 = sched2.schedule()
+    # the lookup hit's continuation runs off the end of the 9-token
+    # history after 3 tokens — drafters may propose fewer than k
+    assert plan2.drafted == 6
+    assert all(len(e.draft) == 3 for e in plan2.entries)
+    # a waiting prefill outranks drafts for the leftover budget
+    sched3 = Scheduler(pool, max_seqs=3, token_budget=9,
+                       max_pages_per_seq=16, drafter=NgramDrafter(),
+                       num_draft_tokens=4)
+    for slot in (0, 1):
+        _running_decode_req(sched3, pool, rep, slot)
+    sched3.submit(Request(list(range(1, 8)), max_new_tokens=4))
+    plan3 = sched3.schedule()
+    assert plan3.admitted == 1
+    prefill = [e for e in plan3.entries if e.n > 1]
+    assert prefill and prefill[0].n == 7         # whole leftover to prefill
+    assert plan3.drafted == 0
+
+
+def test_chaos_spec_verify_full_rejection_drill():
+    """Seeded full-rejection drill: when EVERY draft is rejected the
+    engine still makes one-token-per-step progress (no livelock), output
+    stays bit-identical, and FIFO finish order is preserved."""
+    model = _model()
+    prompts = _prompts(4, lens=(5,))
+    want = _oracle(model, prompts, max_new=6)
+    plan = chaos.FaultPlan(seed=0).add("serve.spec_verify", "error",
+                                       prob=1.0)
+    chaos.install_plan(plan)
+    try:
+        eng = ServingEngine(model, EngineConfig(
+            max_seqs=2, token_budget=16, block_size=8,
+            spec_method="ngram", num_draft_tokens=4))
+        reqs = [eng.submit(p, max_new_tokens=6) for p in prompts]
+        steps = eng.run_until_idle(max_steps=300)
+    finally:
+        chaos.clear_plan()
+    assert steps < 300                            # no livelock
+    assert [r.result(0) for r in reqs] == want    # parity preserved
+    assert eng.spec_accepted == 0                 # drill rejected all
+    assert [f for f in plan.fired if f[0] == "serve.spec_verify"]
+    finished = [r.finished_at for r in reqs]
+    assert finished == sorted(finished)           # FIFO order held
+
+
+def test_spec_config_routes_to_engine():
+    import warnings
+
+    from paddle_tpu.inference import Config, create_llm_predictor
+    from paddle_tpu.serving import NgramDrafter
+    model = _model()
+    conf = Config()
+    conf.set_speculative_config("ngram", num_draft_tokens=3, max_match=2)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")   # routed knobs must NOT warn
+        pred = create_llm_predictor(model, conf, max_new_tokens=4)
+    eng = pred.engine
+    assert eng.config.spec_method == "ngram"
+    assert isinstance(eng.drafter, NgramDrafter)
+    assert eng.drafter.max_match == 2
+    assert eng.sched.num_draft_tokens == 3
+    with pytest.raises(ValueError, match="draft_model"):
+        Config().set_speculative_config("draft_model")
+    with pytest.raises(ValueError, match="unknown speculative"):
+        Config().set_speculative_config("medusa")
+
+
 # -- benchmark fast mode (throughput floor) ------------------------------------
 
 def test_bench_serve_fast_mode(tmp_path):
@@ -449,7 +879,7 @@ def test_bench_serve_fast_mode(tmp_path):
     sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
                                     "tools"))
     bench_serve = importlib.import_module("bench_serve")
-    res = bench_serve.run_bench(fast=True, seed=0,
+    res = bench_serve.run_bench(fast=True, seed=0, spec=True,
                                 out_path=str(tmp_path / "BENCH_SERVE.json"))
     cont = res["continuous"]["tokens_per_s"]
     stat = res["static"]["tokens_per_s"]
@@ -458,4 +888,15 @@ def test_bench_serve_fast_mode(tmp_path):
     # tokens/s at equal (seeded Poisson) load
     assert cont > stat, res
     assert res["continuous"]["p99_latency_s"] > 0
+    # the speculative pair: same engine, repetitive workload; output
+    # bit-equality is asserted inside run_bench (crc32). The tier-1
+    # floor is tokens-per-STEP (what speculation actually changes —
+    # wall-clock tokens/s is load-noise-prone on a shared CPU box; the
+    # committed full-run artifact records the wall-clock vs_nonspec)
+    assert res["spec"]["accept_rate"] > 0
+    spec_tpstep = res["spec"]["output_tokens"] / res["spec"]["engine_steps"]
+    non_tpstep = (res["nonspec"]["output_tokens"]
+                  / res["nonspec"]["engine_steps"])
+    assert spec_tpstep > non_tpstep * 1.1, res
+    assert res["vs_nonspec"] > 0
     assert (tmp_path / "BENCH_SERVE.json").exists()

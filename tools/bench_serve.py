@@ -14,20 +14,31 @@ schedule) drives the ServingEngine twice over the same request set:
     cost, so the measured delta is the SCHEDULING POLICY, not harness
     overhead.
 
-Success metric (ROADMAP item 2): tokens/s and p99 end-to-end latency.
-Writes a BENCH_SERVE_<tag>.json artifact; ``--fast`` is the seeded
-tier-1 mode (tiny model, seconds on CPU) whose throughput floor
-(continuous > static) tests/test_serve_engine.py asserts.
+``--spec`` adds the speculative-decoding pair: the same engine driven
+twice over one seeded repetitive/code-like workload (prompts built from
+repeated token patterns, decode-heavy max_new), once plain
+(``nonspec``) and once with the n-gram self-drafting drafter
+(``spec``) — identical compiled program (the packed verify batch has
+the same static shape), so ``vs_nonspec`` measures the SPECULATION
+delta: fewer engine steps for the same bit-identical tokens. The spec
+row reports accept_rate and rollback pages.
+
+Success metric (ROADMAP items 2/4b): tokens/s and p99 end-to-end
+latency. Writes a BENCH_SERVE_<tag>.json artifact; ``--fast`` is the
+seeded tier-1 mode (tiny model, seconds on CPU) whose throughput floors
+(continuous > static; with --spec, spec > nonspec)
+tests/test_serve_engine.py asserts.
 
 Usage:
-  python tools/bench_serve.py --fast                # tier-1 smoke
-  python tools/bench_serve.py --tag r06 --requests 64 --rate 30
+  python tools/bench_serve.py --fast --spec         # tier-1 smoke
+  python tools/bench_serve.py --spec --tag r07
 """
 import argparse
 import json
 import os
 import sys
 import time
+import zlib
 
 HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, HERE)
@@ -65,12 +76,38 @@ def make_workload(seed: int, n_requests: int, rate: float, vocab: int,
     return reqs
 
 
-def drive(model, workload, policy: str, engine_kw: dict):
+def make_repetitive_workload(seed: int, n_requests: int, rate: float,
+                             vocab: int, n_patterns: int = 4,
+                             period=(3, 6), prompt_lens=(12, 24),
+                             max_new=(16, 32)):
+    """Seeded Poisson schedule over repetitive/code-like prompts: each
+    prompt is one of ``n_patterns`` short token patterns tiled to its
+    length — the shape boilerplate-heavy serving traffic takes, and the
+    one a prompt-lookup drafter feeds on."""
+    rng = np.random.default_rng(seed)
+    pats = [rng.integers(1, vocab,
+                         (int(rng.integers(period[0], period[1] + 1)),)
+                         ).tolist() for _ in range(n_patterns)]
+    gaps = rng.exponential(1.0 / rate, n_requests)
+    arrivals = np.cumsum(gaps)
+    reqs = []
+    for i in range(n_requests):
+        plen = int(rng.integers(prompt_lens[0], prompt_lens[1] + 1))
+        mnew = int(rng.integers(max_new[0], max_new[1] + 1))
+        pat = pats[int(rng.integers(0, n_patterns))]
+        prompt = (pat * (plen // len(pat) + 1))[:plen]
+        reqs.append({"arrival_s": float(arrivals[i]), "prompt": prompt,
+                     "max_new": mnew})
+    return reqs
+
+
+def drive(model, workload, policy: str, engine_kw: dict, spec_kw=None):
     """One open-loop run: submit each request when the run clock passes
     its arrival time, step the engine whenever it has work. Returns the
     stats row for the artifact."""
     from paddle_tpu.serving import EngineConfig, ServingEngine
-    eng = ServingEngine(model, EngineConfig(policy=policy, **engine_kw))
+    eng = ServingEngine(model, EngineConfig(policy=policy, **engine_kw,
+                                            **(spec_kw or {})))
     pending = sorted(workload, key=lambda r: r["arrival_s"])
     handles = []
     t0 = time.monotonic()
@@ -88,13 +125,15 @@ def drive(model, workload, policy: str, engine_kw: dict):
             time.sleep(min(pending[i]["arrival_s"] - now, 0.005))
     wall = time.monotonic() - t0
     lats, ttfts, tokens = [], [], 0
+    crc = 0
     for spec, req in handles:
         assert req.done, f"request {req.rid} never finished"
         tokens += len(req.output)
+        crc = zlib.crc32(np.asarray(req.output, np.int32).tobytes(), crc)
         lats.append((req.finished_at - t0) - spec["arrival_s"])
         ttfts.append((req.first_token_at - t0) - spec["arrival_s"])
     lats = np.asarray(lats)
-    return {
+    row = {
         "policy": policy,
         "requests": len(handles),
         "output_tokens": int(tokens),
@@ -107,12 +146,22 @@ def drive(model, workload, policy: str, engine_kw: dict):
         "preemptions": sum(1 for _, r in handles if r.preemptions),
         "prefix_hits": eng.pool.stats["prefix_hits"],
         "kv_evictions": eng.pool.stats["evicted"],
+        "output_crc32": crc,
     }
+    if spec_kw:
+        s = eng.spec_stats()
+        row["speculative"] = spec_kw
+        row["spec_proposed_tokens"] = s["proposed"]
+        row["spec_accepted_tokens"] = s["accepted"]
+        row["accept_rate"] = round(s["accept_rate"], 3)
+        row["spec_rollback_pages"] = s["rollback_pages"]
+    return row
 
 
 def run_bench(fast: bool = True, seed: int = 0, tag: str = "fast",
               n_requests: int = None, rate: float = None,
-              out_path: str = None):
+              out_path: str = None, spec: bool = False,
+              num_draft_tokens: int = 4):
     model = _build_model(fast)
     vocab = model.config.vocab_size
     if fast:
@@ -125,8 +174,9 @@ def run_bench(fast: bool = True, seed: int = 0, tag: str = "fast",
         engine_kw = {"max_seqs": 8, "token_budget": 64, "block_size": 16}
     workload = make_workload(seed, n_requests, rate, vocab)
 
-    # warm the jit cache outside the timed runs (both policies share the
-    # one compiled program: same decoder, same static shapes)
+    # warm the jit cache outside the timed runs (all rows share the one
+    # compiled program: same decoder, same static shapes — a speculative
+    # verify batch is the same packed [token_budget] shape)
     warm = ServingEngineWarmup(model, engine_kw)
     rows = {}
     for policy in ("static", "continuous"):
@@ -155,14 +205,46 @@ def run_bench(fast: bool = True, seed: int = 0, tag: str = "fast",
                            / max(rows["static"]["tokens_per_s"], 1e-9), 3),
         "warmup_steps": warm,
     }
+
+    if spec:
+        # speculation pair: same continuous engine, one seeded
+        # repetitive/code-like workload, with and without the n-gram
+        # self-drafting drafter. Greedy verification keeps output
+        # bit-identical, so identical output_crc32 is asserted here.
+        spec_load = make_repetitive_workload(seed + 1, n_requests, rate,
+                                             vocab)
+        spec_kw = {"spec_method": "ngram",
+                   "num_draft_tokens": int(num_draft_tokens)}
+        for name, skw in (("nonspec", None), ("spec", spec_kw)):
+            rows[name] = drive(model, spec_load, "continuous", engine_kw,
+                               spec_kw=skw)
+            extra = (f"  accept {rows[name]['accept_rate']:.2f}"
+                     if skw else "")
+            print(f"[bench_serve] {name:11s}: "
+                  f"{rows[name]['tokens_per_s']:8.1f} tok/s  "
+                  f"p99 {rows[name]['p99_latency_s']:.3f}s  "
+                  f"steps {rows[name]['engine_steps']}{extra}", flush=True)
+        assert rows["spec"]["output_crc32"] == \
+            rows["nonspec"]["output_crc32"], \
+            "speculative output diverged from non-speculative greedy"
+        result["spec_workload"] = {"n_requests": n_requests,
+                                   "rate_rps": rate, "poisson": True,
+                                   "open_loop": True, "repetitive": True}
+        result["nonspec"] = rows["nonspec"]
+        result["spec"] = rows["spec"]
+        result["vs_nonspec"] = round(
+            rows["spec"]["tokens_per_s"]
+            / max(rows["nonspec"]["tokens_per_s"], 1e-9), 3)
     if out_path is None:
         out_path = os.path.join(HERE, f"BENCH_SERVE_{tag}.json")
     tmp = out_path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(result, f, indent=1)
     os.replace(tmp, out_path)          # atomic: a killed run can't truncate
-    print(f"[bench_serve] vs_static={result['vs_static']}  -> {out_path}",
-          flush=True)
+    ratios = f"vs_static={result['vs_static']}"
+    if spec:
+        ratios += f" vs_nonspec={result['vs_nonspec']}"
+    print(f"[bench_serve] {ratios}  -> {out_path}", flush=True)
     return result
 
 
@@ -185,13 +267,20 @@ def main(argv=None):
     ap.add_argument("--requests", type=int, default=None)
     ap.add_argument("--rate", type=float, default=None,
                     help="Poisson arrival rate (requests/s)")
+    ap.add_argument("--spec", action="store_true",
+                    help="add the speculative vs non-speculative pair on "
+                         "a repetitive workload")
+    ap.add_argument("--draft-tokens", type=int, default=4,
+                    help="per-sequence draft budget k for --spec")
     ap.add_argument("--out", default=None)
     args = ap.parse_args(argv)
     tag = args.tag or ("fast" if args.fast else "run")
     res = run_bench(fast=args.fast, seed=args.seed, tag=tag,
                     n_requests=args.requests, rate=args.rate,
-                    out_path=args.out)
-    return 0 if res["vs_static"] > 1.0 else 1
+                    out_path=args.out, spec=args.spec,
+                    num_draft_tokens=args.draft_tokens)
+    ok = res["vs_static"] > 1.0 and res.get("vs_nonspec", 2.0) > 1.0
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":
