@@ -1,0 +1,54 @@
+"""paddle_tpu.aot — persistent compiled-program artifact cache.
+
+Reference parity: the ``jit.save`` / load-inference split
+(python/paddle/jit/) promoted to a *cache*: training-step and serving
+programs are exported via ``jax.export`` (StableHLO) into an
+integrity-checked artifact store keyed by everything that can change
+the compiled program, so a restarted process (supervisor generation,
+serving scale-up replica) deserializes instead of re-tracing.
+
+Layout:
+
+  * ``fingerprint`` — the cache key: topology, avals, flags, versions,
+    source digests, caller extras. Any mismatch is a miss, never a
+    wrong hit.
+  * ``store`` — ``ArtifactStore``: atomic tmp+rename writes, per-
+    artifact crc32+nbytes, a ``_GOOD.json`` last-good ledger (the
+    commit point), keep-N GC, cross-process lockfile. Stdlib-only so
+    jax-free tools can read it.
+  * ``cache`` — ``cached_jit`` / ``CachedProgram``: load-or-compile
+    wrappers with the tagged, metered, never-fatal fallback ladder.
+
+Integrations: ``jit.to_static(aot_cache=...)`` (inference calls),
+``parallel.SpmdTrainer(aot_cache=...)`` (the compiled train step),
+``serving.EngineConfig(aot_cache=...)`` (``_engine_step`` warm-start),
+``tools/supervise.py --aot-cache`` (threads ``PADDLE_AOT_CACHE`` across
+restart generations), ``tools/aot_warm.py`` (pre-populate before a
+hardware window).
+
+``store`` (and this package) import without jax; ``cache`` and
+``fingerprint``'s device probes pull jax in lazily on first use.
+"""
+from .store import (ArtifactCorrupt, ArtifactError, ArtifactMiss,
+                    ArtifactStore, LockTimeout)
+
+__all__ = [
+    "ArtifactStore", "ArtifactError", "ArtifactMiss", "ArtifactCorrupt",
+    "LockTimeout",
+    "CachedProgram", "cached_jit", "resolve_store", "aot_stats",
+    "reset_stats", "fingerprint", "avals_signature",
+]
+
+_LAZY = {
+    "CachedProgram": "cache", "cached_jit": "cache",
+    "resolve_store": "cache", "aot_stats": "cache", "reset_stats": "cache",
+    "fingerprint": "fingerprint", "avals_signature": "fingerprint",
+}
+
+
+def __getattr__(name):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+    return getattr(importlib.import_module(f".{mod}", __name__), name)

@@ -1,0 +1,388 @@
+"""cached_jit: persistent load-or-compile wrappers over jax.export.
+
+The restart path's analog of Ragged Paged Attention's "one reusable
+compiled artifact across mixed batches": one reusable exported program
+across *process generations*. A ``CachedProgram`` wraps a pure function
+of array pytrees; per input-signature it either
+
+  * **hit** — deserializes the StableHLO artifact from the
+    ``ArtifactStore`` and compiles it (no Python tracing: the expensive
+    re-trace of the model/trainer/engine code is skipped entirely), or
+  * **miss** — traces once via ``jax.export``, serializes, publishes to
+    the store, and runs through the same exported module — so hit and
+    miss generations execute the *identical* StableHLO, and outputs are
+    bit-identical across restarts by construction.
+
+Fallback ladder (tagged in ``aot_cache_fallbacks_total{reason}``,
+metered, never fatal):
+
+  1. load error (corrupt artifact, chaos fault, deserialize failure)
+     -> fresh compile + re-export (heals the cache);
+  2. export/publish error (unexportable op, store lock timeout)
+     -> plain ``jax.jit`` for this process (cache skipped);
+  3. first call through a *loaded* program raises
+     -> rebuild with a fresh direct ``jax.jit`` and re-run, so a
+     crc-valid but unrunnable artifact degrades to exactly the
+     uncached behavior (a genuine user error then re-raises from the
+     fresh path with its real traceback).
+
+Statics are not supported — close them over before wrapping (the key
+must then commit to them via ``key_extras``). Donation is honored on
+both paths via ``jit_kwargs["donate_argnums"]``; explicit in/out
+shardings apply to the fresh path and ride inside the exported module
+on the hit path.
+
+Restart observability: when ``PADDLE_AOT_STATS`` names a file, every
+program-ready event atomically rewrites it with per-program hit/miss/
+fallback counts and the wall timestamp at which the process's FIRST
+program became ready — ``tools/supervise.py`` turns that into the
+``cold_start_seconds`` figure in each generation's crash report.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+from ..profiler import instrument as _instr
+from . import fingerprint as _fp
+from .store import ArtifactCorrupt, ArtifactMiss, ArtifactStore
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["CachedProgram", "cached_jit", "resolve_store", "aot_stats",
+           "reset_stats"]
+
+ENV_CACHE = "PADDLE_AOT_CACHE"
+ENV_STATS = "PADDLE_AOT_STATS"
+
+# monotonic anchor for the in-process cold-start figure (set when the
+# cache layer is first imported; the supervisor's wall-clock spawn-to-
+# first-program-ready number is the authoritative one)
+_T0 = time.monotonic()
+_STATS_LOCK = threading.Lock()
+_STATS: Dict[str, Any] = {
+    "programs": {},
+    "first_program_ready_unix": None,
+    "seconds_since_aot_import": None,
+}
+
+
+def reset_stats() -> None:
+    """Test hook: clear the per-process stats accumulator."""
+    with _STATS_LOCK:
+        _STATS["programs"] = {}
+        _STATS["first_program_ready_unix"] = None
+        _STATS["seconds_since_aot_import"] = None
+
+
+def aot_stats() -> Dict[str, Any]:
+    with _STATS_LOCK:
+        return json.loads(json.dumps(_STATS))
+
+
+def _note_event(name: str, event: str, seconds: float = 0.0,
+                reason: Optional[str] = None) -> None:
+    with _STATS_LOCK:
+        prog = _STATS["programs"].setdefault(
+            name, {"hits": 0, "misses": 0, "fallbacks": 0,
+                   "load_seconds": 0.0, "export_seconds": 0.0,
+                   "fallback_reasons": []})
+        if event == "hit":
+            prog["hits"] += 1
+            prog["load_seconds"] += seconds
+        elif event == "miss":
+            prog["misses"] += 1
+            prog["export_seconds"] += seconds
+        elif event == "fallback":
+            prog["fallbacks"] += 1
+            if reason and reason not in prog["fallback_reasons"]:
+                prog["fallback_reasons"].append(reason)
+        # "ready" marks first-program readiness WITHOUT counting: the
+        # uncached-jit rung must not inflate the miss counter, which is
+        # documented as "traced+exported fresh (published)"
+        if event in ("hit", "miss", "ready") and \
+                _STATS["first_program_ready_unix"] is None:
+            _STATS["first_program_ready_unix"] = time.time()
+            _STATS["seconds_since_aot_import"] = time.monotonic() - _T0
+        snapshot = json.dumps(_STATS, indent=1)
+    path = os.environ.get(ENV_STATS, "").strip()
+    if path:
+        try:
+            tmp = f"{path}.tmp-{os.getpid()}"
+            with open(tmp, "w") as f:
+                f.write(snapshot)
+            os.replace(tmp, path)
+        except OSError:
+            logger.warning("aot: could not write stats file %s", path,
+                           exc_info=True)
+
+
+def resolve_store(cache=None, keep: int = 16) -> Optional[ArtifactStore]:
+    """Normalize a cache argument: an ArtifactStore passes through, a
+    path string opens one, None reads the PADDLE_AOT_CACHE env (the
+    supervisor threads it across generations), False disables."""
+    if cache is False:
+        return None
+    if isinstance(cache, ArtifactStore):
+        return cache
+    if cache is None:
+        cache = os.environ.get(ENV_CACHE, "").strip() or None
+        if cache is None:
+            return None
+    return ArtifactStore(str(cache), keep=keep)
+
+
+def _fallback_reason(exc: BaseException) -> str:
+    if isinstance(exc, ArtifactCorrupt):
+        return "corrupt"
+    from ..resilience.chaos import FaultInjected
+    if isinstance(exc, FaultInjected):
+        return "chaos"
+    if isinstance(exc, (OSError, TimeoutError)):
+        return "io"
+    return "deserialize"
+
+
+class _Entry:
+    __slots__ = ("call", "loaded", "validated", "key", "meta")
+
+    def __init__(self, call, loaded: bool, key: str, meta=None):
+        self.call = call
+        self.loaded = loaded
+        self.validated = False
+        self.key = key
+        self.meta = meta
+
+
+class CachedProgram:
+    """One logical program, AOT-cached per input signature.
+
+    fn: pure callable over pytrees of arrays (statics closed over).
+    name: stable program name (artifact label + metric label).
+    store: the ArtifactStore (callers resolve via ``resolve_store``).
+    key_extras: extra cache-key discriminators (repr-ed).
+    jit_kwargs: forwarded to the fresh ``jax.jit`` (donate_argnums is
+    also applied to the loaded program's wrapper).
+    extra_meta_fn: zero-arg callable evaluated after a successful export
+    trace; its JSON-able dict rides in the artifact meta (e.g. the
+    to_static output tree spec). on_hit_meta: callback receiving that
+    dict when a hit restores the program without tracing.
+    """
+
+    def __init__(self, fn: Callable, name: str, store: ArtifactStore,
+                 key_extras: Sequence = (),
+                 jit_kwargs: Optional[Dict] = None,
+                 extra_meta_fn: Optional[Callable[[], Dict]] = None,
+                 on_hit_meta: Optional[Callable[[Dict], None]] = None,
+                 shardings_repr: Optional[str] = None):
+        self._fn = fn
+        self.name = name
+        self.store = store
+        self.key_extras = tuple(key_extras)
+        self._jit_kwargs = dict(jit_kwargs or {})
+        self._donate = tuple(self._jit_kwargs.get("donate_argnums", ()) or ())
+        self._extra_meta_fn = extra_meta_fn
+        self._on_hit_meta = on_hit_meta
+        self._shardings_repr = shardings_repr
+        self._programs: Dict[Any, _Entry] = {}  # keyed by _call_key
+        self._lock = threading.Lock()
+        self.stats = {"hits": 0, "misses": 0, "fallbacks": 0}
+        self.__name__ = name
+
+    # -- key ------------------------------------------------------------------
+    def _avals_of(self, args) -> Any:
+        import jax
+        return jax.eval_shape(lambda *xs: xs, *args)
+
+    def key_for(self, *args) -> str:
+        """The cache key these concrete args (or aval trees) map to."""
+        sig = _fp.avals_signature(self._avals_of(args))
+        key, _ = _fp.fingerprint(self.name, sig, fn=self._fn,
+                                 extras=self.key_extras,
+                                 shardings=self._shardings_repr)
+        return key
+
+    # -- materialization ------------------------------------------------------
+    def _fresh_jit(self):
+        import jax
+        return jax.jit(self._fn, **self._jit_kwargs)
+
+    def _loaded_wrapper(self, exported):
+        import jax
+        kw = {"donate_argnums": self._donate} if self._donate else {}
+        return jax.jit(exported.call, **kw)
+
+    def _materialize(self, sig: str, avals) -> _Entry:
+        from jax import export as jexport
+        key, components = _fp.fingerprint(
+            self.name, sig, fn=self._fn, extras=self.key_extras,
+            shardings=self._shardings_repr)
+        t0 = time.monotonic()
+        try:
+            payload, meta = self.store.get(key)
+            exported = jexport.deserialize(bytearray(payload))
+            call = self._loaded_wrapper(exported)
+            dt = time.monotonic() - t0
+            self.stats["hits"] += 1
+            _instr.record_aot_cache_hit(self.name)
+            _instr.record_aot_load(dt)
+            _note_event(self.name, "hit", dt)
+            if self._on_hit_meta is not None:
+                self._on_hit_meta(meta.get("extra") or {})
+            logger.info("aot: %s hit %s (%.3fs)", self.name, key[:12], dt)
+            return _Entry(call, loaded=True, key=key, meta=meta)
+        except ArtifactMiss:
+            pass
+        except Exception as e:  # noqa: BLE001 — ladder rung 1: never fatal
+            reason = _fallback_reason(e)
+            self.stats["fallbacks"] += 1
+            _instr.record_aot_fallback(reason)
+            _note_event(self.name, "fallback", reason=reason)
+            logger.warning("aot: %s load failed (%s: %s); falling back to "
+                           "fresh compile", self.name, reason, e)
+        return self._compile_and_publish(key, sig, avals, components)
+
+    def _compile_and_publish(self, key: str, sig: str, avals,
+                             components) -> _Entry:
+        from jax import export as jexport
+        t0 = time.monotonic()
+        jitted = self._fresh_jit()
+        try:
+            flat_avals = avals if isinstance(avals, tuple) else tuple(avals)
+            exported = jexport.export(jitted)(*flat_avals)
+            payload = exported.serialize()
+            meta = {"components": components, "avals": sig,
+                    "extra": (self._extra_meta_fn() if self._extra_meta_fn
+                              else {})}
+            self.store.put(key, payload, meta, name=self.name)
+            call = self._loaded_wrapper(exported)
+            dt = time.monotonic() - t0
+            self.stats["misses"] += 1
+            _instr.record_aot_cache_miss(self.name)
+            _instr.record_aot_export(dt)
+            _note_event(self.name, "miss", dt)
+            logger.info("aot: %s exported %s (%.3fs, %dB)", self.name,
+                        key[:12], dt, len(payload))
+            return _Entry(call, loaded=False, key=key, meta=meta)
+        except Exception as e:  # noqa: BLE001 — ladder rung 2: never fatal
+            self.stats["fallbacks"] += 1
+            _instr.record_aot_fallback("export")
+            _note_event(self.name, "fallback", reason="export")
+            # the program still counts as (uncached-)ready: first-step
+            # readiness must be reported even when the cache is bypassed
+            # — but NOT as a miss, which would claim an export happened
+            _note_event(self.name, "ready", time.monotonic() - t0)
+            logger.warning("aot: %s not cacheable (%s: %s); running "
+                           "uncached jit", self.name, type(e).__name__, e)
+            entry = _Entry(jitted, loaded=False, key=key)
+            entry.validated = True  # plain jit: no artifact to distrust
+            return entry
+
+    # -- call -----------------------------------------------------------------
+    @staticmethod
+    def _args_alive(args) -> bool:
+        import jax
+        return not any(getattr(leaf, "is_deleted", lambda: False)()
+                       for leaf in jax.tree_util.tree_leaves(args))
+
+    @staticmethod
+    def _call_key(args):
+        """Hot-path dispatch key: (treedef, per-leaf (shape, dtype))
+        tuples read straight off the arrays. No eval_shape trace and no
+        string building — ``avals_signature`` stringifies the treedef,
+        which for a real model enumerates every weight-dict key, an
+        O(params) Python cost per step the cache-off jax.jit path never
+        pays. The canonical string is built once, at materialization."""
+        import jax
+        leaves, treedef = jax.tree_util.tree_flatten(args)
+        return treedef, tuple(
+            (getattr(leaf, "shape", ()),
+             getattr(leaf, "dtype", None) or type(leaf).__name__)
+            for leaf in leaves)
+
+    def __call__(self, *args):
+        key = self._call_key(args)
+        entry = self._programs.get(key)
+        if entry is None:
+            with self._lock:
+                entry = self._programs.get(key)
+                if entry is None:
+                    avals = self._avals_of(args)
+                    entry = self._materialize(
+                        _fp.avals_signature(avals), avals)
+                    self._programs[key] = entry
+        try:
+            out = entry.call(*args)
+        except Exception as e:  # noqa: BLE001 — ladder rung 3
+            if not (entry.loaded and not entry.validated):
+                raise
+            # a loaded artifact failed its FIRST call: distrust it,
+            # quarantine, and re-run through an uncached fresh jit so a
+            # genuine user error re-raises with its real traceback.
+            self.stats["fallbacks"] += 1
+            _instr.record_aot_fallback("run")
+            _note_event(self.name, "fallback", reason="run")
+            logger.warning("aot: %s loaded program failed first call "
+                           "(%s: %s); recompiling fresh", self.name,
+                           type(e).__name__, e)
+            self.store.quarantine(entry.key)
+            if self._donate and not self._args_alive(args):
+                # the failure happened AFTER donation consumed an input
+                # buffer (execution-time, not compile-time): a re-run
+                # would die on deleted arrays and mask this error
+                raise
+            fresh = _Entry(self._fresh_jit(), loaded=False, key=entry.key)
+            fresh.validated = True
+            with self._lock:
+                self._programs[key] = fresh
+            out = fresh.call(*args)
+            entry = fresh
+        entry.validated = True
+        return out
+
+    def warm(self, *aval_args) -> str:
+        """Materialize (load or export) without executing: pass
+        ShapeDtypeStruct trees shaped like the call args. Returns
+        "hit" | "miss" | "fallback" for the program just readied.
+        Keyed via ``_call_key`` so the first real __call__ with
+        same-shaped concrete arrays dispatches straight to the warmed
+        entry (ShapeDtypeStruct and jax.Array agree on shape/dtype)."""
+        key = self._call_key(aval_args)
+        with self._lock:
+            if key in self._programs:
+                return "warm"
+            avals = self._avals_of(aval_args)
+            before = dict(self.stats)
+            entry = self._materialize(_fp.avals_signature(avals), avals)
+            self._programs[key] = entry
+        if self.stats["hits"] > before["hits"]:
+            return "hit"
+        if self.stats["fallbacks"] > before["fallbacks"]:
+            return "fallback"
+        return "miss"
+
+
+def cached_jit(fn: Callable, *, name: Optional[str] = None, cache=None,
+               key_extras: Sequence = (),
+               jit_kwargs: Optional[Dict] = None,
+               extra_meta_fn: Optional[Callable[[], Dict]] = None,
+               on_hit_meta: Optional[Callable[[Dict], None]] = None,
+               shardings_repr: Optional[str] = None):
+    """The one entry point integrations call: returns a ``CachedProgram``
+    when a cache is configured (argument, or the ``PADDLE_AOT_CACHE``
+    env the supervisor threads across generations), else a plain
+    ``jax.jit(fn, **jit_kwargs)`` — so call sites wrap unconditionally
+    and pay nothing when the cache is off."""
+    store = resolve_store(cache)
+    if store is None:
+        import jax
+        return jax.jit(fn, **(jit_kwargs or {}))
+    return CachedProgram(fn, name or getattr(fn, "__name__", "program"),
+                         store, key_extras=key_extras,
+                         jit_kwargs=jit_kwargs, extra_meta_fn=extra_meta_fn,
+                         on_hit_meta=on_hit_meta,
+                         shardings_repr=shardings_repr)

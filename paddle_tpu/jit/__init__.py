@@ -129,12 +129,20 @@ class StaticFunction:
 
     def __init__(self, function: Callable, layer: Optional[Layer] = None,
                  input_spec=None, build_strategy=None, backend=None,
-                 full_graph: bool = True, bucket_batch: bool = False):
+                 full_graph: bool = True, bucket_batch: bool = False,
+                 aot_cache=None):
         self._function = function
         self._layer = layer
         self._input_spec = input_spec
         self._out_spec = None
         self._jitted = None
+        # AOT artifact cache (paddle_tpu.aot): False disables, a path/
+        # ArtifactStore enables, None defers to the PADDLE_AOT_CACHE env
+        # the supervisor threads across restart generations. Resolved
+        # lazily at first build so a late-set env still takes effect.
+        self._aot_cache_arg = aot_cache
+        self._aot_store = None
+        self._aot_programs: Dict = {}
         self._param_names: List[str] = []
         self._buffer_names: List[str] = []
         self._bucket_batch = bucket_batch
@@ -199,9 +207,81 @@ class StaticFunction:
         self._static_tbl: Dict = {}
         self._jitted = jitted
         self._spec_cell = spec_cell
+        self._pure = pure
+        from ..aot.cache import resolve_store
+        self._aot_store = resolve_store(self._aot_cache_arg)
 
     def _call_eager(self, args, kwargs):
         return self._function(*args, **kwargs)
+
+    # -- AOT artifact cache ----------------------------------------------------
+    def _aot_program(self, static_key):
+        """Per-static-signature CachedProgram over the pure body: on a
+        cache hit the exported StableHLO is deserialized and the Python
+        re-trace of the forward is skipped; the out_spec (Python metadata
+        normally captured during tracing) rides in the artifact meta and
+        is restored through the on_hit hook."""
+        prog = self._aot_programs.get(static_key)
+        if prog is not None:
+            return prog
+        import json as _json
+
+        from ..aot.cache import CachedProgram
+
+        def specialized(state_arrays, key, in_arrays):
+            static_kwargs, in_spec = self._static_tbl[static_key]
+            outs, new_bufs, out_spec = self._pure(
+                state_arrays, key, tuple(in_arrays), in_spec, static_kwargs)
+            self._spec_cell[static_key] = out_spec
+            return outs, new_bufs
+
+        def export_meta():
+            spec = self._spec_cell.get(static_key)
+            # the spec must survive the artifact's JSON meta round-trip
+            # (tuples come back as lists; _json_to_spec undoes that) —
+            # an exotic const that does not survive makes the program
+            # uncacheable, which the fallback ladder turns into a plain
+            # uncached jit rather than a wrong rebuild on some later hit
+            if _json_to_spec(_json.loads(_json.dumps(spec))) != spec:
+                raise ValueError(
+                    f"to_static({self.__name__}): output tree spec does "
+                    "not survive JSON; not cacheable")
+            return {"out_spec": spec}
+
+        def on_hit(meta_extra):
+            self._spec_cell[static_key] = _json_to_spec(
+                meta_extra.get("out_spec"))
+
+        # the CachedProgram fingerprints `specialized`, whose closure
+        # reaches the USER's forward only through runtime attribute
+        # access — commit to that code explicitly (and, for a Layer, to
+        # the sublayer tree: two containers with identical param shapes
+        # but different activation classes trace different programs)
+        from ..aot import fingerprint as _afp
+        extras = [static_key, _afp.code_digest(self._function)]
+        if self._layer is not None:
+            extras.append(_afp.module_digest(self._layer))
+        prog = CachedProgram(
+            specialized, f"to_static:{self.__name__}", self._aot_store,
+            key_extras=tuple(extras), extra_meta_fn=export_meta,
+            on_hit_meta=on_hit)
+        self._aot_programs[static_key] = prog
+        return prog
+
+    def _aot_usable(self, all_inputs) -> bool:
+        """The AOT path serves inference calls only: a grad-recording call
+        needs jax.vjp THROUGH the program, which a deserialized exported
+        module does not provide (export serializes the primal). Symbolic
+        (static-graph build) inputs also stay on the fresh path."""
+        if self._aot_store is None:
+            return False
+        from ..autograd.tape import is_grad_enabled
+        from ..ops.dispatch import _is_diff
+        if any(isinstance(t._data, jax.ShapeDtypeStruct)
+               for t in all_inputs):
+            return False
+        return not (is_grad_enabled() and any(_is_diff(t)
+                                              for t in all_inputs))
 
     def _build_child_static(self):
         """Compile units for the partial path. A child that already carries
@@ -313,10 +393,17 @@ class StaticFunction:
         n_state = len(state_tensors)
         n_buf = len(self._buffer_names)
 
+        aot_prog = self._aot_program(static_key) \
+            if self._aot_usable(all_inputs) else None
+
         def fwd(*arrays):
             state_arrays = dict(zip(names, arrays[:n_state]))
-            outs, new_bufs = self._jitted(state_arrays, key,
-                                          tuple(arrays[n_state:]), static_key)
+            if aot_prog is not None:
+                outs, new_bufs = aot_prog(state_arrays, key,
+                                          tuple(arrays[n_state:]))
+            else:
+                outs, new_bufs = self._jitted(
+                    state_arrays, key, tuple(arrays[n_state:]), static_key)
             combined = tuple(outs) + tuple(new_bufs)
             # a 1-tuple would break the tape's vjp pytree contract
             return combined if len(combined) != 1 else combined[0]
@@ -530,19 +617,25 @@ class StaticFunction:
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,
-              backend=None, full_graph=True, bucket_batch=False, **kwargs):
+              backend=None, full_graph=True, bucket_batch=False,
+              aot_cache=None, **kwargs):
     """Parity: paddle.jit.to_static (python/paddle/jit/api.py:197).
     bucket_batch=True additionally pads the batch dim to power-of-two
-    buckets to avoid per-batch-size recompilation (see StaticFunction)."""
+    buckets to avoid per-batch-size recompilation (see StaticFunction).
+    aot_cache routes no-grad calls through the persistent artifact cache
+    (paddle_tpu.aot): a path/ArtifactStore enables it, False disables,
+    None defers to the PADDLE_AOT_CACHE env."""
     def decorate(obj):
         if isinstance(obj, Layer):
             static = StaticFunction(obj.forward, layer=obj,
                                     input_spec=input_spec,
-                                    bucket_batch=bucket_batch)
+                                    bucket_batch=bucket_batch,
+                                    aot_cache=aot_cache)
             obj.forward = static
             return obj
         return StaticFunction(obj, layer=None, input_spec=input_spec,
-                              bucket_batch=bucket_batch)
+                              bucket_batch=bucket_batch,
+                              aot_cache=aot_cache)
 
     if function is not None:
         return decorate(function)

@@ -47,6 +47,11 @@ Catalog (names are a stable API — see README "Observability"):
   serve_spec_accepted_tokens_total       drafts confirmed by greedy verify
   serve_spec_accept_rate                 per-step accepted/proposed ratio
   serve_spec_rollback_pages_total        KV pages released rolling back drafts
+  aot_cache_hits_total{program}          aot/cache.py artifact deserialized
+  aot_cache_misses_total{program}        traced+exported fresh (published)
+  aot_cache_load_seconds                 deserialize+ready wall time on a hit
+  aot_cache_export_seconds               trace+export+publish wall time
+  aot_cache_fallbacks_total{reason}      corrupt|chaos|io|deserialize|export|run
 """
 from __future__ import annotations
 
@@ -97,6 +102,11 @@ CATALOG = (
     "serve_spec_accepted_tokens_total",
     "serve_spec_accept_rate",
     "serve_spec_rollback_pages_total",
+    "aot_cache_hits_total",
+    "aot_cache_misses_total",
+    "aot_cache_load_seconds",
+    "aot_cache_export_seconds",
+    "aot_cache_fallbacks_total",
 )
 
 _enabled = _m._ENABLED  # bind the cell once: hot-path guard is _enabled[0]
@@ -358,6 +368,48 @@ def record_serve_spec_rollback(pages: int) -> None:
     _reg().counter("serve_spec_rollback_pages_total",
                    "KV pages released rolling back rejected drafts") \
         .inc(pages)
+
+
+def record_aot_cache_hit(program: str) -> None:
+    if not _enabled[0]:
+        return
+    _reg().counter("aot_cache_hits_total",
+                   "AOT program artifacts deserialized (trace skipped)",
+                   labelnames=("program",)).labels(program=program).inc()
+
+
+def record_aot_cache_miss(program: str) -> None:
+    if not _enabled[0]:
+        return
+    _reg().counter("aot_cache_misses_total",
+                   "AOT programs traced+exported fresh (published)",
+                   labelnames=("program",)).labels(program=program).inc()
+
+
+def record_aot_load(seconds: float) -> None:
+    if not _enabled[0]:
+        return
+    _reg().histogram("aot_cache_load_seconds",
+                     "artifact deserialize + program-ready wall seconds "
+                     "on a cache hit", buckets=_TIME_BUCKETS) \
+        .observe(seconds)
+
+
+def record_aot_export(seconds: float) -> None:
+    if not _enabled[0]:
+        return
+    _reg().histogram("aot_cache_export_seconds",
+                     "trace + export + publish wall seconds on a cache "
+                     "miss", buckets=_TIME_BUCKETS).observe(seconds)
+
+
+def record_aot_fallback(reason: str) -> None:
+    if not _enabled[0]:
+        return
+    _reg().counter("aot_cache_fallbacks_total",
+                   "AOT cache degraded to fresh/uncached compile "
+                   "(corrupt|chaos|io|deserialize|export|run)",
+                   labelnames=("reason",)).labels(reason=reason).inc()
 
 
 def record_serve_tokens(n: int, step_seconds: float) -> None:

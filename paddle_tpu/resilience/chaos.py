@@ -72,6 +72,9 @@ SITES = {
     "serve.admit": "site",
     "serve.kv_alloc": "site",
     "serve.spec_verify": "site",
+    "aot.export": "site",
+    "aot.load": "site",
+    "aot.artifact_bytes": "mangle",
 }
 
 _CONTROL_KINDS = ("delay", "error", "die")

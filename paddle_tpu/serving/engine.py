@@ -56,7 +56,8 @@ class EngineConfig:
                  policy: str = "continuous", quant: Optional[str] = None,
                  spec_method: Optional[str] = None,
                  num_draft_tokens: int = 4, draft_model=None,
-                 spec_options: Optional[dict] = None):
+                 spec_options: Optional[dict] = None,
+                 aot_cache=None):
         self.max_seqs = int(max_seqs)
         self.token_budget = int(token_budget)
         self.block_size = int(block_size)
@@ -69,6 +70,11 @@ class EngineConfig:
         self.num_draft_tokens = int(num_draft_tokens)
         self.draft_model = draft_model
         self.spec_options = dict(spec_options) if spec_options else {}
+        # persistent AOT program cache (paddle_tpu.aot): a path or
+        # ArtifactStore warm-starts ``_engine_step`` from a stored
+        # artifact at engine construction, False disables, None defers
+        # to the PADDLE_AOT_CACHE env
+        self.aot_cache = aot_cache
         if spec_method is not None and self.num_draft_tokens < 1:
             raise ValueError(
                 f"speculative decoding needs num_draft_tokens >= 1, "
@@ -93,12 +99,13 @@ def _copy_page(k_pools, v_pools, src, dst):
             v_pools.at[:, dst].set(v_pools[:, src]))
 
 
-@partial(jax.jit, static_argnums=(0,), donate_argnums=(7, 8))
-def _engine_step(dec, w, tokens, slot_ids, positions, valid, tables,
-                 k_pools, v_pools):
+def _engine_step_impl(dec, w, tokens, slot_ids, positions, valid, tables,
+                      k_pools, v_pools):
     """The one compiled serving program: scatter targets from the page
     tables, ragged attention over the pools, logits for every packed
-    token. Pools are donated — each step reuses the previous buffers."""
+    token. Pools are donated — each step reuses the previous buffers.
+    (The un-jitted body, so the AOT cache path can close over ``dec``
+    and export a program of array-only inputs.)"""
     bs = k_pools.shape[3]
     p_total = k_pools.shape[1]
     mp = tables.shape[1]
@@ -113,6 +120,10 @@ def _engine_step(dec, w, tokens, slot_ids, positions, valid, tables,
                                  dec.n_heads // dec.n_kv)
     return dec.step_ragged(w, tokens, positions, k_pools, v_pools,
                            (pages, offs), attend)
+
+
+_engine_step = partial(jax.jit, static_argnums=(0,),
+                       donate_argnums=(7, 8))(_engine_step_impl)
 
 
 class ServingEngine:
@@ -180,11 +191,64 @@ class ServingEngine:
         self._rng = np.random.default_rng(seed)
         self._lock = threading.RLock()
         self._work = threading.Event()
+        self._step_call = self._build_step_call()
+        self.aot_warm_result = self._warm_start()
         self.steps = 0
         self.tokens_generated = 0
         self.spec_proposed = 0
         self.spec_accepted = 0
         self.spec_rollback_pages = 0
+
+    # -- AOT program cache ----------------------------------------------------
+    def _build_step_call(self):
+        """The engine-step callable: a persistent ``CachedProgram`` when
+        an AOT cache is configured (``EngineConfig.aot_cache`` or the
+        ``PADDLE_AOT_CACHE`` env), else the plain jitted program."""
+        from ..aot.cache import cached_jit, resolve_store
+        store = resolve_store(self.config.aot_cache)
+        if store is None:
+            return partial(_engine_step, self.dec)
+        dec = self.dec
+
+        def serve_engine_step(w, tokens, slot_ids, positions, valid,
+                              tables, k_pools, v_pools):
+            return _engine_step_impl(dec, w, tokens, slot_ids, positions,
+                                     valid, tables, k_pools, v_pools)
+
+        # _static_key() is what jax.jit's static-argnums dispatch keyed
+        # the uncached path on: the decoder's baked-in trace constants
+        # (eps, head geometry, n_layers, ...). The class NAME alone
+        # would let two same-shape models differing only in eps share
+        # one artifact — a wrong hit. stable_repr, not raw repr: the
+        # MoE static key holds live function objects whose repr embeds
+        # a per-process address (= a permanent spurious miss).
+        from ..aot.fingerprint import stable_repr
+        return cached_jit(
+            serve_engine_step, name="serve_engine_step", cache=store,
+            key_extras=(stable_repr(self.dec._static_key()),
+                        self.config.quant,
+                        getattr(self.dec, "min_capacity_override", None),
+                        self.config.block_size, self.max_pages_per_seq),
+            jit_kwargs={"donate_argnums": (6, 7)})
+
+    def _warm_start(self) -> Optional[str]:
+        """Materialize the one engine program at construction: on a cache
+        hit the first real step deserializes instead of re-tracing (the
+        serving scale-up story). Returns "hit" | "miss" | "fallback" when
+        a cache is configured, None otherwise."""
+        if not hasattr(self._step_call, "warm"):
+            return None
+        t_max = self.config.token_budget
+        sds = jax.ShapeDtypeStruct
+        i32 = jnp.int32
+        w_avals = jax.tree_util.tree_map(
+            lambda a: sds(jnp.shape(a), a.dtype), self._w)
+        return self._step_call.warm(
+            w_avals, sds((t_max,), i32), sds((t_max,), i32),
+            sds((t_max,), i32), sds((t_max,), jnp.bool_),
+            sds(self._tables.shape, i32),
+            sds(self._kp.shape, self._kp.dtype),
+            sds(self._vp.shape, self._vp.dtype))
 
     # -- client side ----------------------------------------------------------
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
@@ -270,8 +334,8 @@ class ServingEngine:
             if e.samples:
                 sample_points.append((e, idx + n - 1))
             idx += n + k
-        logits, self._kp, self._vp = _engine_step(
-            self.dec, self._w, jnp.asarray(tokens), jnp.asarray(slots),
+        logits, self._kp, self._vp = self._step_call(
+            self._w, jnp.asarray(tokens), jnp.asarray(slots),
             jnp.asarray(positions), jnp.asarray(valid),
             jnp.asarray(self._tables), self._kp, self._vp)
         out = {"tokens": 0, "finished": 0, "ttfts": [], "accepted": 0,

@@ -17,6 +17,13 @@ runs clean (the reclaim happened; the replacement host trains on).
 mode=signal: no plan; the parent test SIGTERMs this process mid-fit
 (the pid and per-step progress land in marker-dir for it to aim with).
 
+--aot: train through the COMPILED step (parallel.SpmdTrainer) instead of
+the eager Model.fit loop, so the persistent AOT program cache
+(paddle_tpu.aot, enabled by the PADDLE_AOT_CACHE env the supervisor
+threads) is exercised: generation 0 traces+exports the train step,
+the restarted generation deserializes it (a cache hit) and resumes
+stepping without re-tracing. Same markers, same preemption contract.
+
 Markers written to --marker-dir:
     pid                         this process's pid (written at start)
     progress                    rewritten with the global step each step
@@ -45,6 +52,9 @@ def main(argv=None) -> int:
     ap.add_argument("--step-sleep", type=float, default=0.0)
     ap.add_argument("--grace", type=float, default=10.0)
     ap.add_argument("--seed", type=int, default=1234)
+    ap.add_argument("--aot", action="store_true",
+                    help="train via the compiled SpmdTrainer step "
+                         "(exercises the AOT program cache)")
     args = ap.parse_args(argv)
 
     import time
@@ -82,6 +92,75 @@ def main(argv=None) -> int:
     np.random.seed(args.seed % (2 ** 31))
     x = np.random.randn(64, 4).astype(np.float32)
     y = (x @ np.random.randn(4, 1)).astype(np.float32)
+    if args.aot:
+        # the compiled-step variant: SpmdTrainer traces ONE XLA program
+        # for fwd+bwd+update; with PADDLE_AOT_CACHE set (the supervisor
+        # threads it) that program is exported on generation 0 and
+        # deserialized — not re-traced — by every restarted generation
+        from paddle_tpu.parallel import SpmdTrainer
+
+        net = nn.Sequential(nn.Linear(4, 16), nn.ReLU(),
+                            nn.Linear(16, 1))
+        mse = nn.MSELoss()
+
+        def loss_fn(model, xb, yb):
+            return mse(model(xb), yb)
+
+        mgr = CheckpointManager(args.ckpt_root, keep=4)
+        state = {"step": 0}
+        state.update(dict(net.named_parameters()))
+        resume_step = 0
+        try:
+            resume_step = mgr.load_latest(state)
+        except CheckpointCorruptionError:
+            resume_step = 0
+        mark(f"gen{gen}.resume{resume_step}")
+
+        trainer = SpmdTrainer(
+            net, optimizer.SGD(learning_rate=0.01,
+                               parameters=net.parameters()), loss_fn)
+        ckpt = TieredCheckpointer(mgr, lambda: state,
+                                  memory_every=args.memory_every,
+                                  persist_every=args.persist_every,
+                                  step_offset=resume_step)
+        guard = PreemptionGuard(grace=args.grace).install()
+        if args.mode == "chaos" and gen == 0:
+            plan = FaultPlan(seed=args.seed)
+            plan.add("preempt.notice", "error", at=(args.preempt_at,))
+            chaos.install_plan(plan)
+        xt, yt = paddle.to_tensor(x), paddle.to_tensor(y)
+        try:
+            for local in range(args.steps - resume_step):
+                trainer.train_step(xt, yt)
+                done_steps = local + 1
+                state["step"] = resume_step + done_steps
+                if marker_dir:
+                    with open(os.path.join(marker_dir, "progress"),
+                              "w") as f:
+                        f.write(str(state["step"]))
+                if args.step_sleep:
+                    time.sleep(args.step_sleep)
+                ckpt.maybe_save(done_steps)
+                if guard.should_stop(state["step"]):
+                    trainer.block()
+                    saved = ckpt.emergency_save(done_steps,
+                                                deadline=guard.remaining())
+                    mark(f"emergency.{saved}")
+                    sys.stderr.write(
+                        f"worker(aot): preempted at step {saved}\n")
+                    return PREEMPTED_EXIT_CODE
+            ckpt.wait()
+        finally:
+            guard.uninstall()
+            chaos.clear_plan()
+        if mgr.latest_step() != args.steps:
+            mgr.save(state, step=args.steps)
+        trainer.block()
+        w_hash = int(sum(float(np.abs(np.asarray(p._data)).sum())
+                         for p in net.parameters()) * 1e6)
+        mark(f"done.{args.steps}.w{w_hash}")
+        return 0
+
     net = nn.Linear(4, 1)
     model = Model(net)
     model.prepare(optimizer.SGD(learning_rate=0.01,

@@ -17,6 +17,12 @@ seeded chaos preemption notice at an exact step boundary, lands its
 emergency checkpoint, exits with PREEMPTED_EXIT_CODE, is restarted by
 the supervisor, resumes at the saved step (not zero), and finishes —
 deterministically per seed (same resumed step, same final weight hash).
+By default the worker trains through the compiled SpmdTrainer step with
+a persistent AOT program cache (paddle_tpu.aot) threaded across the
+generations: the drill additionally asserts generation 0 exported the
+step program, the restarted generation deserialized it (cache hit, no
+re-trace) and reported a LOWER cold start. ``--no-aot`` restores the
+eager PR-5 worker.
 
     JAX_PLATFORMS=cpu python tools/chaos_drill.py --preempt [--seed 1234]
 
@@ -133,7 +139,7 @@ def run_drill(seed: int = 1234, verbose: bool = True):
 
 def run_preempt_drill(seed: int = 1234, steps: int = 8, preempt_at: int = 4,
                       persist_every: int = 2, verbose: bool = True,
-                      work_dir: str = None):
+                      work_dir: str = None, aot: bool = False):
     """The kill→restart→resume loop, end to end, under the supervisor.
 
     Generation 0 of tests/preempt_worker.py takes a seeded chaos
@@ -141,7 +147,15 @@ def run_preempt_drill(seed: int = 1234, steps: int = 8, preempt_at: int = 4,
     and exits PREEMPTED_EXIT_CODE; tools/supervise.py restarts it;
     generation 1 resumes at the saved step and finishes. Asserts the
     resumed step, the exit-cause classification, and (per seed) the
-    deterministic final weight hash. Returns the report dict."""
+    deterministic final weight hash. Returns the report dict.
+
+    aot=True additionally trains through the compiled SpmdTrainer step
+    with a persistent AOT program cache threaded across generations
+    (supervise.py --aot-cache): asserts generation 0 exported the step
+    program (a miss), the restarted generation deserialized it (>= 1
+    hit, NO fresh export), and the restart's cold start — supervisor
+    spawn to first program ready — beat generation 0's, which paid the
+    full trace+compile+export."""
     import re
     import subprocess
     import sys as _sys
@@ -156,16 +170,21 @@ def run_preempt_drill(seed: int = 1234, steps: int = 8, preempt_at: int = 4,
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
         env.pop("PADDLE_CHAOS_PLAN", None)  # the worker arms its own plan
+        sup_args = ["--max-restarts", "2", "--seed", str(seed),
+                    "--report-dir", reports]
+        worker_args = []
+        if aot:
+            sup_args += ["--aot-cache", os.path.join(root, "aot_cache")]
+            worker_args += ["--aot"]
         r = subprocess.run(
             [_sys.executable, os.path.join(repo, "tools", "supervise.py"),
-             "--max-restarts", "2", "--seed", str(seed),
-             "--report-dir", reports, "--",
+             *sup_args, "--",
              _sys.executable, os.path.join(repo, "tests",
                                            "preempt_worker.py"),
              ckpt, "--steps", str(steps), "--persist-every",
              str(persist_every), "--preempt-at", str(preempt_at),
              "--mode", "chaos", "--seed", str(seed),
-             "--marker-dir", markers],
+             "--marker-dir", markers, *worker_args],
             capture_output=True, timeout=300, env=env, cwd=repo)
         err = r.stderr.decode()
         assert r.returncode == 0, \
@@ -194,12 +213,51 @@ def run_preempt_drill(seed: int = 1234, steps: int = 8, preempt_at: int = 4,
         report = {"seed": seed, "resumed_step": preempt_at,
                   "final_step": int(final_step), "w_hash": int(w_hash),
                   "generations": 2, "ok": True}
+        if aot:
+            with open(os.path.join(reports,
+                                   "crash_report_1.json")) as f:
+                rep1 = json.load(f)
+            aot0, aot1 = rep0.get("aot"), rep1.get("aot")
+            assert aot0 and aot0["misses"] >= 1 and \
+                aot0["fallbacks"] == 0, \
+                f"generation 0 never exported the step program: {aot0}"
+            assert aot1 and aot1["hits"] >= 1 and \
+                aot1["misses"] == 0 and aot1["fallbacks"] == 0, \
+                f"restarted generation did not hit the AOT cache: {aot1}"
+            # the deterministic timing signal: gen1's deserialize must
+            # beat gen0's trace+export (both measured INSIDE each
+            # process, immune to jax-import and machine-load noise that
+            # dominates toy-config wall clocks)
+            load1 = sum(p.get("load_seconds", 0.0)
+                        for p in aot1["programs"].values())
+            export0 = sum(p.get("export_seconds", 0.0)
+                          for p in aot0["programs"].values())
+            assert 0 < load1 < export0, \
+                f"restart deserialize ({load1:.3f}s) did not beat " \
+                f"generation 0's trace+export ({export0:.3f}s)"
+            # wall-clock cold start: asserted with a noise budget —
+            # on the toy config both generations' cold starts are
+            # dominated by the shared interpreter+jax startup, so a
+            # loaded machine can legitimately wobble the difference
+            cold0 = aot0["cold_start_seconds"]
+            cold1 = aot1["cold_start_seconds"]
+            assert cold0 is not None and cold1 is not None and \
+                cold1 < cold0 * 1.5 + 2.0, \
+                f"restart cold start {cold1}s blew past " \
+                f"generation 0's {cold0}s beyond any startup noise"
+            report["aot"] = {"gen0": aot0, "gen1": aot1,
+                             "cold_start_gen0_s": cold0,
+                             "cold_start_gen1_s": cold1}
         if verbose:
             print(f"preempt drill (seed={seed}): notice at step "
                   f"{preempt_at} -> emergency ckpt -> supervisor restart "
                   f"-> resumed at {preempt_at} -> finished at "
                   f"{final_step} (w_hash={w_hash}) — kill/restart/resume "
                   "verified")
+            if aot:
+                print(f"  aot: gen0 exported (cold start {cold0}s), gen1 "
+                      f"hit x{report['aot']['gen1']['hits']} (cold start "
+                      f"{cold1}s) — restart skipped the re-trace")
         return report
     finally:
         if ctx is not None:
@@ -212,10 +270,15 @@ def main(argv=None):
     ap.add_argument("--json", action="store_true",
                     help="print the full report as JSON")
     ap.add_argument("--preempt", action="store_true",
-                    help="run the supervised kill/restart/resume drill")
+                    help="run the supervised kill/restart/resume drill "
+                         "(with the AOT program cache unless --no-aot)")
+    ap.add_argument("--no-aot", action="store_true",
+                    help="with --preempt: skip the AOT program-cache leg "
+                         "(eager Model.fit worker, PR-5 behavior)")
     args = ap.parse_args(argv)
     if args.preempt:
-        report = run_preempt_drill(seed=args.seed, verbose=not args.json)
+        report = run_preempt_drill(seed=args.seed, verbose=not args.json,
+                                   aot=not args.no_aot)
     else:
         report = run_drill(seed=args.seed, verbose=not args.json)
     if args.json:

@@ -101,13 +101,47 @@ def _metrics_dump(env: dict, since: float) -> object:
         return {"unparseable": path}
 
 
+def _aot_report(stats_path: str, spawn_wall: float) -> object:
+    """Summarize the worker's AOT cache stats file (PADDLE_AOT_STATS,
+    rewritten atomically by paddle_tpu.aot.cache on every program-ready
+    event) for the crash report: per-program hit/miss/fallback counts
+    plus ``cold_start_seconds`` — supervisor spawn to the first program
+    (train step / engine step) becoming ready. None when the worker
+    never exercised the cache."""
+    if not stats_path or not os.path.exists(stats_path):
+        return None
+    try:
+        if os.path.getmtime(stats_path) < spawn_wall:
+            # written by a PREVIOUS run reusing this report dir — its
+            # numbers (and a negative cold start) would corrupt the
+            # postmortem, same staleness rule as _metrics_dump
+            return None
+        with open(stats_path) as f:
+            stats = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {"unparseable": stats_path}
+    ready = stats.get("first_program_ready_unix")
+    return {
+        "programs": stats.get("programs", {}),
+        "hits": sum(p.get("hits", 0)
+                    for p in stats.get("programs", {}).values()),
+        "misses": sum(p.get("misses", 0)
+                      for p in stats.get("programs", {}).values()),
+        "fallbacks": sum(p.get("fallbacks", 0)
+                         for p in stats.get("programs", {}).values()),
+        "cold_start_seconds": (round(ready - spawn_wall, 3)
+                               if ready is not None else None),
+    }
+
+
 class Supervisor:
     def __init__(self, cmd, max_restarts=3, report_dir=None,
                  backoff_base=1.0, backoff_max=30.0, seed=0,
-                 log_tail_lines=50):
+                 log_tail_lines=50, aot_cache=None):
         self.cmd = list(cmd)
         self.max_restarts = int(max_restarts)
         self.report_dir = report_dir
+        self.aot_cache = aot_cache
         self.log_tail_lines = int(log_tail_lines)
         # RetryPolicy as the backoff engine: capped exponential + seeded
         # jitter, identical semantics to every other retry in the stack
@@ -140,7 +174,19 @@ class Supervisor:
         env = dict(os.environ)
         env["PADDLE_RESTART_GENERATION"] = str(self.generation)
         env["PADDLE_SUPERVISED"] = "1"
+        if self.aot_cache:
+            # the whole point: every generation sees the SAME artifact
+            # store, so a restart deserializes programs generation 0 paid
+            # to trace+export (the store's lockfile+ledger make the
+            # sharing safe — the story the stock XLA cache lacked)
+            env["PADDLE_AOT_CACHE"] = os.path.abspath(self.aot_cache)
+        if self.report_dir:
+            env["PADDLE_AOT_STATS"] = self._aot_stats_path()
         return env
+
+    def _aot_stats_path(self) -> str:
+        return os.path.join(self.report_dir,
+                            f"aot_stats_{self.generation}.json")
 
     def _log_path(self) -> str:
         if not self.report_dir:
@@ -176,7 +222,11 @@ class Supervisor:
             "log": log_path if self.report_dir else None,
             "log_tail": _tail(log_path, self.log_tail_lines),
             "metrics": _metrics_dump(env, wall0),
+            "aot": _aot_report(env.get("PADDLE_AOT_STATS", ""), wall0),
         }
+        if isinstance(report["aot"], dict):
+            report["cold_start_seconds"] = \
+                report["aot"].get("cold_start_seconds")
         self.reports.append(report)
         if self.report_dir:
             path = os.path.join(self.report_dir,
@@ -233,6 +283,11 @@ def main(argv=None) -> int:
     ap.add_argument("--backoff-max", type=float, default=30.0)
     ap.add_argument("--seed", type=int, default=0,
                     help="backoff-jitter seed (deterministic drills)")
+    ap.add_argument("--aot-cache", default=None,
+                    help="AOT artifact-store dir threaded to every "
+                         "generation via PADDLE_AOT_CACHE (restarts "
+                         "deserialize compiled programs instead of "
+                         "re-tracing)")
     ap.add_argument("cmd", nargs=argparse.REMAINDER,
                     help="-- then the training command")
     args = ap.parse_args(argv)
@@ -244,7 +299,8 @@ def main(argv=None) -> int:
     sup = Supervisor(cmd, max_restarts=args.max_restarts,
                      report_dir=args.report_dir,
                      backoff_base=args.backoff_base,
-                     backoff_max=args.backoff_max, seed=args.seed)
+                     backoff_max=args.backoff_max, seed=args.seed,
+                     aot_cache=args.aot_cache)
     return sup.run()
 
 
