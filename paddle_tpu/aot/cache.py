@@ -85,7 +85,8 @@ def aot_stats() -> Dict[str, Any]:
 
 
 def _note_event(name: str, event: str, seconds: float = 0.0,
-                reason: Optional[str] = None) -> None:
+                reason: Optional[str] = None,
+                cost: Optional[Dict[str, float]] = None) -> None:
     with _STATS_LOCK:
         prog = _STATS["programs"].setdefault(
             name, {"hits": 0, "misses": 0, "fallbacks": 0,
@@ -101,6 +102,12 @@ def _note_event(name: str, event: str, seconds: float = 0.0,
             prog["fallbacks"] += 1
             if reason and reason not in prog["fallback_reasons"]:
                 prog["fallback_reasons"].append(reason)
+        if cost:
+            # XLA cost_analysis of the cached program (flops / bytes
+            # accessed): computed once at export, rides the artifact
+            # meta on hits — the MFU-attribution evidence the perf
+            # config resolver (ROADMAP item 1) reads per program
+            prog["cost"] = dict(cost)
         # "ready" marks first-program readiness WITHOUT counting: the
         # uncached-jit rung must not inflate the miss counter, which is
         # documented as "traced+exported fresh (published)"
@@ -134,6 +141,35 @@ def resolve_store(cache=None, keep: int = 16) -> Optional[ArtifactStore]:
         if cache is None:
             return None
     return ArtifactStore(str(cache), keep=keep)
+
+
+def _cost_analysis(jitted, avals) -> Optional[Dict[str, float]]:
+    """XLA's per-program cost model (flops, bytes accessed) for the
+    traced function over abstract inputs. Best-effort: any backend or
+    version that cannot answer returns None rather than failing the
+    export — the numbers are evidence, not a dependency.
+
+    Costs one extra trace+lower of ``jitted`` (jax.export consumed its
+    own), so callers only invoke this when a PADDLE_AOT_STATS consumer
+    is actually configured — a cache miss on a large training step must
+    not pay double tracing for numbers nobody reads."""
+    try:
+        costs = jitted.lower(*avals).cost_analysis()
+        if isinstance(costs, (list, tuple)):
+            costs = costs[0] if costs else None
+        if not isinstance(costs, dict):
+            return None
+        out = {}
+        for key, label in (("flops", "flops"),
+                           ("bytes accessed", "bytes_accessed"),
+                           ("transcendentals", "transcendentals")):
+            v = costs.get(key)
+            if v is not None:
+                out[label] = float(v)
+        return out or None
+    except Exception:  # noqa: BLE001 — cost numbers are never load-bearing
+        logger.debug("aot: cost_analysis unavailable", exc_info=True)
+        return None
 
 
 def _fallback_reason(exc: BaseException) -> str:
@@ -230,7 +266,7 @@ class CachedProgram:
             self.stats["hits"] += 1
             _instr.record_aot_cache_hit(self.name)
             _instr.record_aot_load(dt)
-            _note_event(self.name, "hit", dt)
+            _note_event(self.name, "hit", dt, cost=meta.get("cost"))
             if self._on_hit_meta is not None:
                 self._on_hit_meta(meta.get("extra") or {})
             logger.info("aot: %s hit %s (%.3fs)", self.name, key[:12], dt)
@@ -255,16 +291,20 @@ class CachedProgram:
             flat_avals = avals if isinstance(avals, tuple) else tuple(avals)
             exported = jexport.export(jitted)(*flat_avals)
             payload = exported.serialize()
+            cost = _cost_analysis(jitted, flat_avals) \
+                if os.environ.get(ENV_STATS, "").strip() else None
             meta = {"components": components, "avals": sig,
                     "extra": (self._extra_meta_fn() if self._extra_meta_fn
                               else {})}
+            if cost:
+                meta["cost"] = cost
             self.store.put(key, payload, meta, name=self.name)
             call = self._loaded_wrapper(exported)
             dt = time.monotonic() - t0
             self.stats["misses"] += 1
             _instr.record_aot_cache_miss(self.name)
             _instr.record_aot_export(dt)
-            _note_event(self.name, "miss", dt)
+            _note_event(self.name, "miss", dt, cost=cost)
             logger.info("aot: %s exported %s (%.3fs, %dB)", self.name,
                         key[:12], dt, len(payload))
             return _Entry(call, loaded=False, key=key, meta=meta)

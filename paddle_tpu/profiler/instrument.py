@@ -47,6 +47,13 @@ Catalog (names are a stable API — see README "Observability"):
   serve_spec_accepted_tokens_total       drafts confirmed by greedy verify
   serve_spec_accept_rate                 per-step accepted/proposed ratio
   serve_spec_rollback_pages_total        KV pages released rolling back drafts
+  serve_slo_violations_total{kind}       serving/obs.py deadline misses (ttft|tpot)
+  serve_slo_attainment                   SLO-tracked requests meeting deadlines (0..1)
+  serve_goodput_tokens_total             tokens from requests that met their SLOs
+  serve_flight_dumps_total{trigger}      flight-recorder dumps by trigger reason
+  serve_ttft_quantile_seconds{q}         streaming TTFT sketch quantiles (p50|p95|p99)
+  serve_tpot_quantile_seconds{q}         streaming per-output-token quantiles
+  serve_e2e_quantile_seconds{q}          streaming end-to-end latency quantiles
   aot_cache_hits_total{program}          aot/cache.py artifact deserialized
   aot_cache_misses_total{program}        traced+exported fresh (published)
   aot_cache_load_seconds                 deserialize+ready wall time on a hit
@@ -102,6 +109,13 @@ CATALOG = (
     "serve_spec_accepted_tokens_total",
     "serve_spec_accept_rate",
     "serve_spec_rollback_pages_total",
+    "serve_slo_violations_total",
+    "serve_slo_attainment",
+    "serve_goodput_tokens_total",
+    "serve_flight_dumps_total",
+    "serve_ttft_quantile_seconds",
+    "serve_tpot_quantile_seconds",
+    "serve_e2e_quantile_seconds",
     "aot_cache_hits_total",
     "aot_cache_misses_total",
     "aot_cache_load_seconds",
@@ -368,6 +382,57 @@ def record_serve_spec_rollback(pages: int) -> None:
     _reg().counter("serve_spec_rollback_pages_total",
                    "KV pages released rolling back rejected drafts") \
         .inc(pages)
+
+
+def record_serve_slo_violation(kind: str) -> None:
+    """One SLO deadline miss (kind: ttft | tpot)."""
+    if not _enabled[0]:
+        return
+    _reg().counter("serve_slo_violations_total",
+                   "serving SLO deadline misses by kind (ttft|tpot)",
+                   labelnames=("kind",)).labels(kind=kind).inc()
+
+
+def record_serve_slo_attainment(fraction: float) -> None:
+    if not _enabled[0]:
+        return
+    _reg().gauge("serve_slo_attainment",
+                 "fraction of SLO-tracked finished requests that met "
+                 "every deadline").set(float(fraction))
+
+
+def record_serve_goodput(tokens: int) -> None:
+    """Tokens from a finished request that met its SLO deadlines (0 for
+    a request that blew one — those tokens are throughput, not goodput)."""
+    if not _enabled[0] or not tokens:
+        return
+    _reg().counter("serve_goodput_tokens_total",
+                   "output tokens from requests that met their SLO "
+                   "deadlines").inc(tokens)
+
+
+def record_serve_flight_dump(trigger: str) -> None:
+    if not _enabled[0]:
+        return
+    _reg().counter("serve_flight_dumps_total",
+                   "flight-recorder dumps by trigger "
+                   "(stall|pool_exhausted|chaos_fault|slo_blow|manual)",
+                   labelnames=("trigger",)).labels(trigger=trigger).inc()
+
+
+def record_serve_quantiles(kind: str, p50: float, p95: float,
+                           p99: float) -> None:
+    """Streaming latency sketch quantiles (kind: ttft | tpot | e2e) —
+    gauges so dashboards read the engine's bounded-sketch estimates
+    without scraping histograms."""
+    if not _enabled[0]:
+        return
+    g = _reg().gauge(f"serve_{kind}_quantile_seconds",
+                     "bounded-sketch streaming latency quantile by q "
+                     "(p50|p95|p99)", labelnames=("q",))
+    g.labels(q="p50").set(float(p50))
+    g.labels(q="p95").set(float(p95))
+    g.labels(q="p99").set(float(p99))
 
 
 def record_aot_cache_hit(program: str) -> None:

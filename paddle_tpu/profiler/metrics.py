@@ -15,6 +15,7 @@ record into a private registry regardless of the global switch).
 from __future__ import annotations
 
 import json
+import math
 import threading
 import time
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
@@ -23,6 +24,7 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "get_registry", "reset_registry",
     "enable_metrics", "disable_metrics", "metrics_enabled",
+    "QUANTILE_RELATIVE_ERROR",
 ]
 
 # the one hot-path guard: instrumented call sites check _ENABLED[0] before
@@ -162,18 +164,42 @@ class Gauge(_Metric):
 _DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0,
                     30.0, 60.0, 300.0)
 
+# Bounded streaming-quantile sketch grid (``track_quantiles=True``): a
+# geometric bucket ladder from _Q_MIN with per-bucket growth _Q_GROWTH.
+# quantile(q) returns the UPPER edge of the bucket holding the q-th
+# order statistic, so the estimate e of a true value v in range obeys
+# v <= e <= v * _Q_GROWTH — a fixed 5% relative error bound from a
+# fixed-size int array (no unbounded observation list on the hot path).
+_Q_MIN = 1e-6
+_Q_GROWTH = 1.05
+_Q_BUCKETS = 512          # reaches _Q_MIN * 1.05**511 ~ 6.7e4 (~18.6 h)
+_Q_LOG_G = math.log(_Q_GROWTH)
+
+# the public error-bound contract consumers assert against (e.g. the
+# serving bench cross-checks engine sketch quantiles vs its own exact
+# offline order statistics within this factor)
+QUANTILE_RELATIVE_ERROR = _Q_GROWTH
+
 
 class Histogram(_Metric):
     """Cumulative-bucket histogram (Prometheus semantics: each bucket counts
-    observations <= its upper bound; +Inf is implicit = count)."""
+    observations <= its upper bound; +Inf is implicit = count).
+
+    ``track_quantiles=True`` additionally maintains a bounded log-spaced
+    sketch (fixed ``_Q_BUCKETS`` int array) so ``quantile(q)`` answers
+    streaming p50/p95/p99 within ``QUANTILE_RELATIVE_ERROR`` relative
+    error — memory stays O(1) however many values are observed."""
 
     kind = "histogram"
 
     def __init__(self, name, help="", labelnames=(),
-                 buckets: Sequence[float] = _DEFAULT_BUCKETS):
+                 buckets: Sequence[float] = _DEFAULT_BUCKETS,
+                 track_quantiles: bool = False):
         super().__init__(name, help, labelnames)
         self.buckets = tuple(sorted(float(b) for b in buckets))
+        self.track_quantiles = bool(track_quantiles)
         self._counts = [0] * len(self.buckets)
+        self._qcounts = [0] * _Q_BUCKETS if self.track_quantiles else None
         self._count = 0
         self._sum = 0.0
 
@@ -184,10 +210,18 @@ class Histogram(_Metric):
         with self._lock:
             child = self._children.get(key)
             if child is None:
-                child = Histogram(self.name, self.help, buckets=self.buckets)
+                child = Histogram(self.name, self.help, buckets=self.buckets,
+                                  track_quantiles=self.track_quantiles)
                 child._lock = self._lock
                 self._children[key] = child
             return child
+
+    @staticmethod
+    def _q_index(value: float) -> int:
+        if value <= _Q_MIN:
+            return 0
+        return min(_Q_BUCKETS - 1,
+                   1 + int(math.log(value / _Q_MIN) / _Q_LOG_G))
 
     def observe(self, value: float) -> None:
         self._require_no_labels()
@@ -198,6 +232,34 @@ class Histogram(_Metric):
             for i, b in enumerate(self.buckets):
                 if value <= b:
                     self._counts[i] += 1
+            if self._qcounts is not None:
+                self._qcounts[self._q_index(value)] += 1
+
+    def quantile(self, q: float) -> float:
+        """Sketch estimate of the q-th quantile (the ceil(q*n)-th order
+        statistic's bucket upper edge). 0.0 with no observations."""
+        if self._qcounts is None:
+            raise ValueError(
+                f"histogram {self.name!r} was not created with "
+                "track_quantiles=True")
+        if not 0.0 < q <= 1.0:
+            raise ValueError(f"quantile wants 0 < q <= 1, got {q}")
+        with self._lock:
+            total = self._count
+            if total == 0:
+                return 0.0
+            rank = max(1, math.ceil(q * total))
+            seen = 0
+            for i, n in enumerate(self._qcounts):
+                seen += n
+                if seen >= rank:
+                    return _Q_MIN * (_Q_GROWTH ** i)
+        return _Q_MIN * (_Q_GROWTH ** (_Q_BUCKETS - 1))
+
+    @property
+    def mean(self) -> float:
+        with self._lock:
+            return self._sum / self._count if self._count else 0.0
 
     def time(self):
         """Context manager observing the elapsed wall seconds."""
@@ -226,8 +288,12 @@ class Histogram(_Metric):
 
     def snapshot(self):
         def one(h):
-            return {"count": h._count, "sum": h._sum,
-                    "buckets": dict(zip(h.buckets, h._counts))}
+            out = {"count": h._count, "sum": h._sum,
+                   "buckets": dict(zip(h.buckets, h._counts))}
+            if h._qcounts is not None and h._count:
+                out["quantiles"] = {q: h.quantile(q)
+                                    for q in (0.5, 0.95, 0.99)}
+            return out
         if self.labelnames:
             return {key: one(h) for key, h in self._series()}
         return one(self)
@@ -268,9 +334,11 @@ class MetricsRegistry:
 
     def histogram(self, name: str, help: str = "",
                   labelnames: Sequence[str] = (),
-                  buckets: Sequence[float] = _DEFAULT_BUCKETS) -> Histogram:
+                  buckets: Sequence[float] = _DEFAULT_BUCKETS,
+                  track_quantiles: bool = False) -> Histogram:
         return self._get_or_create(Histogram, name, help, labelnames,
-                                   buckets=buckets)
+                                   buckets=buckets,
+                                   track_quantiles=track_quantiles)
 
     def get(self, name: str) -> Optional[_Metric]:
         with self._lock:

@@ -72,6 +72,7 @@ SITES = {
     "serve.admit": "site",
     "serve.kv_alloc": "site",
     "serve.spec_verify": "site",
+    "serve.flight_dump": "site",
     "aot.export": "site",
     "aot.load": "site",
     "aot.artifact_bytes": "mangle",

@@ -27,10 +27,25 @@ back past the accepted frontier (copy-on-write on shared pages):
 Benchmark with ``python tools/bench_serve.py --fast`` (Poisson open-loop
 load, continuous vs static policy, BENCH_SERVE_*.json artifact; add
 ``--spec`` for the speculative vs non-speculative rows).
+
+Observability (``serving.obs``): per-request lifecycle tracing
+(chrome-trace exportable, trace_merge-alignable with training traces),
+a step-plan flight recorder that dumps to JSON on anomalies (driver
+stall, pool exhaustion, chaos fault, SLO deadline blow) or on demand via
+``engine.dump_flight_record()``, and SLO/goodput telemetry with bounded
+streaming quantiles behind ``engine.telemetry()`` (rendered live by
+``tools/serve_top.py``). Disarmed by default — arm with
+``EngineConfig(obs=True)`` or ``PADDLE_SERVE_OBS=1``:
+
+    eng = ServingEngine(model, EngineConfig(obs=ObsConfig(
+        flight_steps=256, stall_threshold_s=30.0)))
+    req = eng.submit(ids, max_new_tokens=64, ttft_deadline=0.5,
+                     tpot_deadline=0.05)
 """
 from .engine import (EngineConfig, EnginePredictor, ServingEngine,
                      engine_from_config)
 from .kv_pool import KVBlockPool, PoolExhausted
+from .obs import ObsConfig, RequestTrace, ServingObserver, resolve_observer
 from .ragged import ragged_paged_attention
 from .scheduler import Request, Scheduler
 from .speculative import (Drafter, DraftModelDrafter, NgramDrafter,
@@ -42,4 +57,5 @@ __all__ = [
     "ragged_paged_attention", "Request", "Scheduler",
     "Drafter", "NgramDrafter", "DraftModelDrafter", "make_drafter",
     "verify_greedy",
+    "ObsConfig", "RequestTrace", "ServingObserver", "resolve_observer",
 ]

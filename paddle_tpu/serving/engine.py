@@ -34,6 +34,7 @@ from ..profiler import instrument as _instr
 from ..resilience import chaos
 from . import ragged as _ragged
 from .kv_pool import KVBlockPool
+from .obs import resolve_observer
 from .scheduler import Request, Scheduler
 from .speculative import make_drafter, verify_greedy
 
@@ -57,7 +58,7 @@ class EngineConfig:
                  spec_method: Optional[str] = None,
                  num_draft_tokens: int = 4, draft_model=None,
                  spec_options: Optional[dict] = None,
-                 aot_cache=None):
+                 aot_cache=None, obs=None):
         self.max_seqs = int(max_seqs)
         self.token_budget = int(token_budget)
         self.block_size = int(block_size)
@@ -75,6 +76,11 @@ class EngineConfig:
         # artifact at engine construction, False disables, None defers
         # to the PADDLE_AOT_CACHE env
         self.aot_cache = aot_cache
+        # observability plane (serving/obs.py): True/ObsConfig/
+        # ServingObserver arms lifecycle tracing + flight recorder + SLO
+        # telemetry, False disarms, None defers to PADDLE_SERVE_OBS /
+        # PADDLE_SERVE_FLIGHT (disarmed = one `is None` check per seam)
+        self.obs = obs
         if spec_method is not None and self.num_draft_tokens < 1:
             raise ValueError(
                 f"speculative decoding needs num_draft_tokens >= 1, "
@@ -181,11 +187,13 @@ class ServingEngine:
         self.drafter = make_drafter(cfg.spec_method,
                                     draft_model=cfg.draft_model,
                                     **spec_opts)
+        self.obs = resolve_observer(cfg.obs)
         self.sched = Scheduler(self.pool, cfg.max_seqs, cfg.token_budget,
                                self.max_pages_per_seq, policy=cfg.policy,
                                drafter=self.drafter,
                                num_draft_tokens=cfg.num_draft_tokens
-                               if self.drafter is not None else 0)
+                               if self.drafter is not None else 0,
+                               obs=self.obs)
         self._tables = np.full((cfg.max_seqs, self.max_pages_per_seq), -1,
                                np.int32)
         self._rng = np.random.default_rng(seed)
@@ -253,11 +261,19 @@ class ServingEngine:
     # -- client side ----------------------------------------------------------
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
                eos_id: Optional[int] = None, on_token=None,
-               stream: bool = False) -> Request:
+               stream: bool = False,
+               ttft_deadline: Optional[float] = None,
+               tpot_deadline: Optional[float] = None) -> Request:
         """Enqueue one request; returns the Request handle (``result()``
-        blocks for the token list, ``stream()`` yields tokens live)."""
+        blocks for the token list, ``stream()`` yields tokens live).
+        ``ttft_deadline`` / ``tpot_deadline`` (seconds) are optional SLO
+        deadlines the observability plane accounts (violations, goodput,
+        attainment — see ``telemetry()``); they never change
+        scheduling."""
         req = Request(prompt, max_new_tokens=max_new_tokens, eos_id=eos_id,
-                      on_token=on_token, stream=stream)
+                      on_token=on_token, stream=stream,
+                      ttft_deadline=ttft_deadline,
+                      tpot_deadline=tpot_deadline)
         total = len(req.prompt) + req.max_new_tokens
         if total > self.max_model_len:
             raise ValueError(
@@ -272,6 +288,8 @@ class ServingEngine:
                 f"({self.pool.num_blocks} x {self.pool.block_size})")
         with self._lock:
             self.sched.submit(req)
+            if self.obs is not None:
+                self.obs.on_submit(req)
         self._work.set()
         _instr.record_serve_queue_depth(self.sched.queue_depth())
         return req
@@ -281,21 +299,75 @@ class ServingEngine:
         """Run one continuous-batching step: schedule, one device call,
         sample, evict. Returns True while work remains."""
         t0 = time.monotonic()
+        obs = self.obs
+        armed = obs is not None and obs.armed
         with self._lock:
             q0 = self.pool.stats["prefix_queries"]
             h0 = self.pool.stats["prefix_hits"]
             plan = self.sched.schedule()
             if not plan.entries:
+                # an EMPTY plan is still evidence when something went
+                # wrong building it (exhaustion/chaos with nothing
+                # schedulable — the wedged-engine case the flight
+                # recorder exists for): land its record so the pending
+                # anomaly flushes against the step that explains it.
+                # Quiet idle polls stay out of the ring.
+                if armed and ((plan.explain is not None
+                               and (plan.explain["exhaustion"]
+                                    or plan.explain["chaos"]))
+                              or obs.has_pending()):
+                    obs.record_step({
+                        "step": self.steps, "empty": True,
+                        "t_mono_s": round(t0, 6),
+                        "dt_s": round(time.monotonic() - t0, 6),
+                        "plan": plan.explain, "entries": [],
+                        "tokens": 0, "finished": [],
+                        "queue_depth": self.sched.queue_depth(),
+                        "running": len(self.sched.running),
+                        "pool": {"used": self.pool.used_blocks(),
+                                 "cached": self.pool.cached_blocks(),
+                                 "free": self.pool.free_blocks(),
+                                 "utilization":
+                                     round(self.pool.utilization(), 4)},
+                    })
                 if not self.sched.has_work():
                     self._work.clear()
                 return self.sched.has_work()
-            sampled = self._run_plan(plan)
+            sampled = self._run_plan(plan, armed)
             self.steps += 1
             queue_depth = self.sched.queue_depth()
             running = len(self.sched.running)
             util = self.pool.utilization()
             dq = self.pool.stats["prefix_queries"] - q0
             dh = self.pool.stats["prefix_hits"] - h0
+            if armed:
+                dt = time.monotonic() - t0
+                obs.record_step({
+                    "step": self.steps,
+                    "t_mono_s": round(t0, 6),
+                    "dt_s": round(dt, 6),
+                    "plan": plan.explain,
+                    "entries": [{"rid": e.req.rid, "start": e.start,
+                                 "n": e.n, "draft": len(e.draft)}
+                                for e in plan.entries],
+                    "tokens": sampled["tokens"],
+                    "finished": sampled["finished_rids"],
+                    "accepted": sampled["accepted"],
+                    "rollback_pages": sampled["rollback_pages"],
+                    "pool": {"used": self.pool.used_blocks(),
+                             "cached": self.pool.cached_blocks(),
+                             "free": self.pool.free_blocks(),
+                             "utilization": round(util, 4)},
+                    "prefix": {"queries": dq, "hits": dh},
+                    "queue_depth": queue_depth,
+                    "running": running,
+                })
+        if armed and obs.telemetry_path and \
+                self.steps % obs.config.telemetry_every == 0:
+            # telemetry file I/O happens OUTSIDE the engine lock —
+            # telemetry() takes it briefly for the snapshot, but the
+            # write must not stall concurrent submit() callers
+            obs.write_telemetry(self.telemetry())
         dt = time.monotonic() - t0
         _instr.record_serve_step(plan.admitted, sampled["finished"],
                                  plan.preempted, queue_depth, running, util)
@@ -309,7 +381,7 @@ class ServingEngine:
         _instr.record_serve_spec_rollback(sampled["rollback_pages"])
         return self.sched.has_work()
 
-    def _run_plan(self, plan) -> dict:
+    def _run_plan(self, plan, armed: bool = False) -> dict:
         t_max = self.config.token_budget
         tokens = np.zeros(t_max, np.int32)
         slots = np.zeros(t_max, np.int32)
@@ -333,13 +405,15 @@ class ServingEngine:
             row[:len(e.req.pages)] = e.req.pages
             if e.samples:
                 sample_points.append((e, idx + n - 1))
+            if armed and e.start + e.n < len(e.req.seq):
+                self.obs.on_prefill(e.req, e.start, e.n)
             idx += n + k
         logits, self._kp, self._vp = self._step_call(
             self._w, jnp.asarray(tokens), jnp.asarray(slots),
             jnp.asarray(positions), jnp.asarray(valid),
             jnp.asarray(self._tables), self._kp, self._vp)
-        out = {"tokens": 0, "finished": 0, "ttfts": [], "accepted": 0,
-               "rollback_pages": 0}
+        out = {"tokens": 0, "finished": 0, "finished_rids": [],
+               "ttfts": [], "accepted": 0, "rollback_pages": 0}
         for e in plan.entries:
             e.req.pos = e.start + e.n    # draft positions confirmed below
         if sample_points:
@@ -359,6 +433,14 @@ class ServingEngine:
                         # but the bonus token still lands — the engine
                         # never falls below one token per seq per step
                         emitted = targets[:1]
+                        if armed:
+                            if plan.explain is not None:
+                                plan.explain["chaos"].append(
+                                    "serve.spec_verify")
+                            self.obs.note_anomaly(
+                                "chaos_fault",
+                                {"site": "serve.spec_verify",
+                                 "rid": req.rid})
                 else:
                     emitted = targets[:1]
                 used = 0
@@ -366,6 +448,8 @@ class ServingEngine:
                     if req.first_token_at is None:
                         req.first_token_at = now
                         out["ttfts"].append(now - req.arrival)
+                        if armed:
+                            self.obs.on_first_token(req, now - req.arrival)
                     req.emit(tok)
                     self.tokens_generated += 1
                     out["tokens"] += 1
@@ -373,6 +457,9 @@ class ServingEngine:
                     if (len(req.output) >= req.max_new_tokens
                             or (req.eos_id is not None
                                 and tok == req.eos_id)):
+                        req.finish_reason = (
+                            "eos" if req.eos_id is not None
+                            and tok == req.eos_id else "max_new_tokens")
                         finished.append(req)
                         break
                 # used-1 drafts were confirmed correct (eos may cut the
@@ -380,6 +467,8 @@ class ServingEngine:
                 consumed = used - 1
                 out["accepted"] += consumed
                 req.pos = e.start + e.n + consumed
+                if armed:
+                    self.obs.on_decode(req, used, k, consumed)
                 if consumed < k:
                     # rejected drafts left garbage K/V past the accepted
                     # frontier: roll the page list back; copy-on-write if
@@ -395,6 +484,7 @@ class ServingEngine:
             for req in finished:
                 self.sched.evict_finished(req)
             out["finished"] = len(finished)
+            out["finished_rids"] = [r.rid for r in finished]
             self.spec_proposed += plan.drafted
             self.spec_accepted += out["accepted"]
             self.spec_rollback_pages += out["rollback_pages"]
@@ -432,6 +522,49 @@ class ServingEngine:
         return {"proposed": p, "accepted": a,
                 "accept_rate": a / p if p else 0.0,
                 "rollback_pages": self.spec_rollback_pages}
+
+    # -- observability --------------------------------------------------------
+    def telemetry(self) -> dict:
+        """Engine telemetry snapshot (``tools/serve_top.py`` renders it
+        live): step/token counters, queue/pool state and spec stats
+        always; SLO attainment, goodput and streaming
+        p50/p95/p99 TTFT/TPOT/e2e (bounded quantile sketch) when the
+        observability plane is armed."""
+        with self._lock:
+            s = self.pool.stats
+            base = {
+                "steps": self.steps,
+                "tokens_generated": self.tokens_generated,
+                "queue_depth": self.sched.queue_depth(),
+                "running": len(self.sched.running),
+                "pool": {
+                    "size": self.pool.num_blocks,
+                    "block_size": self.pool.block_size,
+                    "used": self.pool.used_blocks(),
+                    "cached": self.pool.cached_blocks(),
+                    "free": self.pool.free_blocks(),
+                    "utilization": round(self.pool.utilization(), 4),
+                    "prefix": {"queries": s["prefix_queries"],
+                               "hits": s["prefix_hits"],
+                               "hit_tokens": s["prefix_hit_tokens"]},
+                },
+                "spec": self.spec_stats(),
+            }
+            if self.drafter is not None:
+                base["spec"]["drafter"] = self.drafter.describe()
+            if self.obs is not None:
+                return self.obs.telemetry(base)
+            return base
+
+    def dump_flight_record(self, path: Optional[str] = None,
+                           reason: str = "manual") -> Optional[dict]:
+        """Dump the flight recorder (last N step-plan records + last M
+        request lifecycles) to JSON on demand. Returns the record dict,
+        or None when the observability plane is disarmed or the dump
+        failed — it NEVER raises (``serve.flight_dump`` chaos-drilled)."""
+        if self.obs is None:
+            return None
+        return self.obs.dump(reason=reason, path=path)
 
     def refresh_weights(self) -> None:
         """Re-snapshot the model weights (after a load_dict / train step).

@@ -60,18 +60,31 @@ class Request:
     def __init__(self, prompt: Sequence[int], max_new_tokens: int = 32,
                  eos_id: Optional[int] = None,
                  on_token: Optional[Callable[[int], None]] = None,
-                 stream: bool = False):
+                 stream: bool = False,
+                 ttft_deadline: Optional[float] = None,
+                 tpot_deadline: Optional[float] = None):
         if len(prompt) < 1:
             raise ValueError("empty prompt")
         if max_new_tokens < 1:
             raise ValueError(f"max_new_tokens must be >= 1, "
                              f"got {max_new_tokens}")
+        for name, d in (("ttft_deadline", ttft_deadline),
+                        ("tpot_deadline", tpot_deadline)):
+            if d is not None and d <= 0:
+                raise ValueError(f"{name} must be > 0 seconds, got {d}")
         self.rid = next(_req_ids)
         self.prompt: List[int] = [int(t) for t in prompt]
         self.seq: List[int] = list(self.prompt)
         self.max_new_tokens = int(max_new_tokens)
         self.eos_id = eos_id
         self.on_token = on_token
+        # SLO deadlines (seconds; None = untracked): TTFT is submit ->
+        # first token, TPOT is the mean per-output-token latency after
+        # the first. serving/obs.py accounts violations and goodput.
+        self.ttft_deadline = None if ttft_deadline is None \
+            else float(ttft_deadline)
+        self.tpot_deadline = None if tpot_deadline is None \
+            else float(tpot_deadline)
         self.output: List[int] = []
         self.state = WAITING
         self.slot: Optional[int] = None
@@ -82,6 +95,8 @@ class Request:
         self.arrival = time.monotonic()
         self.first_token_at: Optional[float] = None
         self.finished_at: Optional[float] = None
+        self.finish_reason: Optional[str] = None
+        self.trace = None             # RequestTrace when the obs plane is on
         self._done = threading.Event()
         self._stream: Optional["queue.Queue"] = queue.Queue() if stream \
             else None
@@ -146,13 +161,18 @@ class StepEntry:
 
 
 class StepPlan:
-    __slots__ = ("entries", "admitted", "preempted", "drafted")
+    __slots__ = ("entries", "admitted", "preempted", "drafted", "explain")
 
-    def __init__(self, entries, admitted, preempted, drafted=0):
+    def __init__(self, entries, admitted, preempted, drafted=0,
+                 explain=None):
         self.entries: List[StepEntry] = entries
         self.admitted: int = admitted
         self.preempted: int = preempted
         self.drafted: int = drafted
+        # structured step-plan record (serving/obs.py flight recorder):
+        # budget split, who was admitted/preempted and WHY, exhaustion
+        # events, spec outcome. None when the obs plane is disarmed.
+        self.explain: Optional[dict] = explain
 
     @property
     def total_tokens(self) -> int:
@@ -165,7 +185,7 @@ class Scheduler:
 
     def __init__(self, pool: KVBlockPool, max_seqs: int, token_budget: int,
                  max_pages_per_seq: int, policy: str = "continuous",
-                 drafter=None, num_draft_tokens: int = 0):
+                 drafter=None, num_draft_tokens: int = 0, obs=None):
         if policy not in ("continuous", "static"):
             raise ValueError(f"unknown scheduling policy {policy!r}")
         if token_budget < max_seqs:
@@ -183,6 +203,10 @@ class Scheduler:
         self.drafter = drafter
         self.num_draft_tokens = int(num_draft_tokens)
         self._drafter_warned = False
+        # serving/obs.py observer (None = disarmed: every hook below is
+        # one `is None` check) and the current step's explain record
+        self.obs = obs
+        self._explain: Optional[dict] = None
         self.waiting: List[Request] = []
         self.running: List[Request] = []   # admission order
         self._free_slots = list(range(self.max_seqs - 1, -1, -1))
@@ -206,7 +230,8 @@ class Scheduler:
         return len(self.waiting)
 
     # -- page bookkeeping -----------------------------------------------------
-    def _grow_pages(self, req: Request, upto_pos: int) -> bool:
+    def _grow_pages(self, req: Request, upto_pos: int,
+                    phase: str = "decode") -> bool:
         """Ensure pages cover positions [0, upto_pos]; False on exhaustion
         (caller decides: shrink chunk, defer, or preempt)."""
         need = upto_pos // self.pool.block_size + 1 - len(req.pages)
@@ -214,11 +239,33 @@ class Scheduler:
             return True
         try:
             req.pages.extend(self.pool.allocate(need))
-        except (PoolExhausted, chaos.FaultInjected):
+        except PoolExhausted:
+            self._note_exhaustion(req, phase, "exhausted", need)
+            return False
+        except chaos.FaultInjected:
             # an injected serve.kv_alloc fault IS the pool-exhaustion
             # drill: same deferral/preemption path, deterministically
+            self._note_exhaustion(req, phase, "chaos", need)
             return False
         return True
+
+    def _note_exhaustion(self, req: Request, phase: str, kind: str,
+                         need: int) -> None:
+        """Record a failed page grow in the step-plan record and raise
+        the pool-exhaustion anomaly (flight-recorder dump trigger).
+        Draft-phase pressure is routine opportunistic yielding, not an
+        anomaly — it is recorded but never triggers a dump."""
+        ex = self._explain
+        if ex is not None and len(ex["exhaustion"]) < 8:
+            ex["exhaustion"].append({
+                "site": "serve.kv_alloc", "rid": req.rid, "phase": phase,
+                "kind": kind, "need_pages": need,
+                "free": self.pool.free_blocks(),
+                "cached": self.pool.cached_blocks()})
+        if self.obs is not None and phase != "draft":
+            self.obs.note_anomaly("pool_exhausted", {
+                "site": "serve.kv_alloc", "rid": req.rid, "phase": phase,
+                "kind": kind, "need_pages": need})
 
     def _release(self, req: Request, cache_prefix: bool) -> None:
         if cache_prefix and req.pos >= len(req.prompt):
@@ -236,9 +283,12 @@ class Scheduler:
         prompt pages for prefix reuse."""
         self.running.remove(req)
         self._release(req, cache_prefix=True)
+        if self.obs is not None:
+            self.obs.on_finish(req, req.finish_reason or "finished")
         req.finish()
 
-    def _preempt_youngest(self) -> Optional[Request]:
+    def _preempt_youngest(self, to_grow: Optional[Request] = None
+                          ) -> Optional[Request]:
         """Pool pressure relief: kick the most recently admitted running
         request back to the waiting front for recompute."""
         if not self.running:
@@ -250,6 +300,14 @@ class Scheduler:
         victim.n_prefix = 0
         victim.preemptions += 1
         self.waiting.insert(0, victim)
+        if self._explain is not None:
+            self._explain["preempted"].append({
+                "rid": victim.rid, "reason": "pool_pressure",
+                "to_grow": to_grow.rid if to_grow is not None else None,
+                "generated": len(victim.output)})
+        if self.obs is not None:
+            self.obs.on_preempt(
+                victim, to_grow.rid if to_grow is not None else None)
         return victim
 
     # -- the per-step planner -------------------------------------------------
@@ -258,6 +316,15 @@ class Scheduler:
         decode_entries: List[StepEntry] = []
         budget = self.token_budget
         admitted = preempted = drafted = 0
+        obs = self.obs
+        armed = obs is not None and obs.armed
+        explain = None
+        if armed:
+            explain = {"budget_total": budget, "decode_tokens": 0,
+                       "prefill_tokens": 0, "drafted_tokens": 0,
+                       "admitted": [], "preempted": [], "exhaustion": [],
+                       "chaos": [], "admission": None, "spec": None}
+        self._explain = explain
 
         # 1) one decode token per running sequence in its decode phase —
         #    grown pages first; exhaustion preempts the youngest (possibly
@@ -266,7 +333,7 @@ class Scheduler:
             if req.pos != len(req.seq) - 1 or budget <= 0:
                 continue
             while not self._grow_pages(req, req.pos):
-                victim = self._preempt_youngest()
+                victim = self._preempt_youngest(to_grow=req)
                 preempted += 1
                 if victim is None or victim is req:
                     break
@@ -278,6 +345,8 @@ class Scheduler:
             entries.append(e)
             decode_entries.append(e)
             budget -= 1
+            if explain is not None:
+                explain["decode_tokens"] += 1
 
         # 2) prefill chunks for running requests still inside their prompt
         #    (chunked prefill: admitted earlier, prompt longer than the
@@ -293,23 +362,40 @@ class Scheduler:
                 continue
             entries.append(StepEntry(req, req.pos, chunk))
             budget -= chunk
+            if explain is not None:
+                explain["prefill_tokens"] += chunk
 
         # 3) admission, strictly FIFO. Static policy: gang admission into
         #    an empty batch only (the BatchingServer baseline).
         can_admit = not self.running if self.policy == "static" else True
-        while (can_admit and self.waiting and self._free_slots
-               and budget > 0):
+        stopped_by = None
+        while self.waiting:
+            if not can_admit:
+                stopped_by = "policy"
+                break
+            if not self._free_slots:
+                stopped_by = "no_slot"
+                break
+            if budget <= 0:
+                stopped_by = "budget"
+                break
             req = self.waiting[0]
             try:
                 chaos.site("serve.admit")
             except chaos.FaultInjected:
-                break                         # drill: defer this step
+                stopped_by = "chaos"          # drill: defer this step
+                if explain is not None:
+                    explain["chaos"].append("serve.admit")
+                if obs is not None:
+                    obs.note_anomaly("chaos_fault",
+                                     {"site": "serve.admit"})
+                break
             pages, n_cached = self.pool.match_prefix(
                 req.seq, max_tokens=len(req.seq) - 1)
             req.pages = pages
             req.pos = req.n_prefix = n_cached
             chunk = min(len(req.seq) - req.pos, budget)
-            chunk = self._fit_chunk(req, chunk)
+            chunk = self._fit_chunk(req, chunk, phase="admit")
             if chunk <= 0:
                 # pool pressure: roll the prefix hit back and stop
                 # admitting (FIFO: nobody behind may jump the queue)
@@ -317,6 +403,7 @@ class Scheduler:
                     self.pool.release(req.pages)
                 req.pages = []
                 req.pos = req.n_prefix = 0
+                stopped_by = "pool"
                 break
             self.waiting.pop(0)
             req.slot = self._free_slots.pop()
@@ -325,6 +412,16 @@ class Scheduler:
             entries.append(StepEntry(req, req.pos, chunk))
             budget -= chunk
             admitted += 1
+            if explain is not None:
+                explain["prefill_tokens"] += chunk
+                explain["admitted"].append({"rid": req.rid, "chunk": chunk,
+                                            "prefix_tokens": n_cached,
+                                            "requeued": req.preemptions})
+            if armed:
+                obs.on_admit(req, chunk, n_cached)
+        if explain is not None:
+            explain["admission"] = {"stopped_by": stopped_by,
+                                    "waiting_after": len(self.waiting)}
 
         # 4) speculation LAST: drafted tokens take only the budget left
         #    after every decode step, prefill chunk, and admission got
@@ -357,11 +454,14 @@ class Scheduler:
             # user drafter bug) degrades this step to plain decode
             # instead of escaping schedule() and wedging the driver
             # thread with RUNNING requests parked forever
+            t_draft = time.monotonic() if explain is not None else 0.0
+            draft_error = None
             try:
                 proposals = self.drafter.propose_batch(
                     [e.req for e, _ in cands], [d for _, d in cands]) \
                     if cands else []
             except Exception as exc:
+                draft_error = repr(exc)
                 if not self._drafter_warned:
                     warnings.warn(
                         f"drafter propose_batch failed ({exc!r}); "
@@ -369,6 +469,7 @@ class Scheduler:
                         "unspeculated")
                     self._drafter_warned = True
                 proposals = []
+            proposed_total = sum(len(p) for p in proposals)
             for (e, d_max), prop in zip(cands, proposals):
                 if budget <= 0:
                     break
@@ -377,24 +478,40 @@ class Scheduler:
                 # proposal under pool pressure rather than preempting —
                 # speculation is opportunistic
                 while drafts and not self._grow_pages(
-                        e.req, e.start + e.n - 1 + len(drafts)):
+                        e.req, e.start + e.n - 1 + len(drafts),
+                        phase="draft"):
                     drafts.pop()
                 if not drafts:
                     continue
                 e.draft = tuple(int(t) for t in drafts)
                 budget -= len(drafts)
                 drafted += len(drafts)
+            if explain is not None:
+                explain["drafted_tokens"] = drafted
+                explain["spec"] = {
+                    "candidates": len(cands),
+                    "proposed": proposed_total,
+                    "scheduled": drafted,
+                    "propose_seconds": round(
+                        time.monotonic() - t_draft, 6),
+                    "error": draft_error}
 
-        return StepPlan(entries, admitted, preempted, drafted)
+        if explain is not None:
+            explain["budget_left"] = budget
+        self._explain = None
+        return StepPlan(entries, admitted, preempted, drafted,
+                        explain=explain)
 
-    def _fit_chunk(self, req: Request, chunk: int) -> int:
+    def _fit_chunk(self, req: Request, chunk: int,
+                   phase: str = "prefill") -> int:
         """Shrink a prefill chunk to the pages actually obtainable.
         allocate() is all-or-nothing, so on failure retry with the chunk
         the currently AVAILABLE pages could cover — partial progress
         beats stalling the FIFO head on idle free pages."""
         bs = self.pool.block_size
         while chunk > 0 and not self._grow_pages(req,
-                                                 req.pos + chunk - 1):
+                                                 req.pos + chunk - 1,
+                                                 phase=phase):
             cap = (len(req.pages) + self.pool.available_blocks()) * bs \
                 - req.pos
             chunk = min(chunk - 1, max(cap, 0))

@@ -61,6 +61,12 @@ class Drafter:
     def propose_batch(self, reqs, ks) -> List[List[int]]:
         return [self.propose(req, k) for req, k in zip(reqs, ks)]
 
+    def describe(self) -> dict:
+        """JSON-able self-description for the observability plane
+        (``engine.telemetry()`` / flight-dump headers): subclasses add
+        their configuration so a postmortem names the exact drafter."""
+        return {"drafter": type(self).__name__}
+
 
 class NgramDrafter(Drafter):
     """Self-drafting by prompt lookup (model-free).
@@ -88,6 +94,11 @@ class NgramDrafter(Drafter):
         self.max_match = int(max_match)
         self.min_match = int(min_match)
         self.lookback = int(lookback)
+
+    def describe(self) -> dict:
+        return {"drafter": type(self).__name__,
+                "max_match": self.max_match, "min_match": self.min_match,
+                "lookback": self.lookback}
 
     def propose(self, req, k: int) -> List[int]:
         seq = req.seq[-self.lookback:]
@@ -133,6 +144,11 @@ class DraftModelDrafter(Drafter):
         self.quant = quant
         self.batch_pad = None if batch_pad is None else int(batch_pad)
         self.draft_k = None if draft_k is None else int(draft_k)
+
+    def describe(self) -> dict:
+        return {"drafter": type(self).__name__,
+                "context_width": self.context_width, "quant": self.quant,
+                "batch_pad": self.batch_pad, "draft_k": self.draft_k}
 
     def propose(self, req, k: int) -> List[int]:
         if k < 1:

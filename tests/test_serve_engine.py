@@ -17,13 +17,23 @@ import paddle_tpu as paddle
 from paddle_tpu import generation as G
 from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
 from paddle_tpu.resilience import chaos
-from paddle_tpu.serving import (EngineConfig, KVBlockPool, PoolExhausted,
-                                ServingEngine, ragged_paged_attention)
+from paddle_tpu.serving import (EngineConfig, KVBlockPool, ObsConfig,
+                                PoolExhausted, ServingEngine,
+                                ragged_paged_attention)
 
 pytestmark = pytest.mark.serve
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=None)
 def _model(kv_heads=2, seed=3, vocab=61):
+    """One shared read-only model per (geometry, seed): every engine in
+    this file only READS weights (pools are the donated state), so the
+    ~30 tests that used to rebuild identical models now share three —
+    the deterministic paddle.seed(seed) build makes the cached instance
+    bit-identical to a fresh one."""
     paddle.seed(seed)
     cfg = LlamaConfig.tiny(vocab_size=vocab, hidden_size=32, layers=2,
                            heads=4, kv_heads=kv_heads, seq=64)
@@ -37,13 +47,23 @@ def _prompts(n, lens=(7, 4, 11, 5, 9, 3, 8, 6), vocab=61, seed=0):
             for i in range(n)]
 
 
+_oracle_memo = {}
+
+
 def _oracle(model, prompts, max_new):
+    """Memoized one-shot generate() reference: several tests ask for the
+    oracle of the identical (model, prompts, max_new) triple — compute
+    each once (keyed by model identity: _model() is cached too)."""
+    key = (id(model), tuple(tuple(p) for p in prompts), max_new)
+    if key in _oracle_memo:
+        return [list(o) for o in _oracle_memo[key]]
     out = []
     for p in prompts:
         toks, _ = model.generate(
             paddle.to_tensor(np.asarray([p], np.int32)),
             max_new_tokens=max_new)
         out.append(toks.numpy()[0].tolist())
+    _oracle_memo[key] = [list(o) for o in out]
     return out
 
 
@@ -870,6 +890,404 @@ def test_spec_config_routes_to_engine():
         Config().set_speculative_config("medusa")
 
 
+# -- observability plane (serving/obs.py) --------------------------------------
+
+def test_engine_parity_and_lifecycle_completeness_with_tracing_armed():
+    """Arming the observability plane must not change a single token —
+    and every submitted request's trace must end in exactly ONE terminal
+    finish event, with submit/admit/first_token in causal order."""
+    model = _model()
+    prompts = _prompts(5)
+    want = _oracle(model, prompts, max_new=6)
+    eng = ServingEngine(model, EngineConfig(
+        max_seqs=3, token_budget=16, block_size=8,
+        obs=ObsConfig(flight_steps=64, flight_requests=32)))
+    reqs = [eng.submit(p, max_new_tokens=6) for p in prompts]
+    eng.run_until_idle()
+    assert [r.result(0) for r in reqs] == want   # bit-identical, armed
+    for r in reqs:
+        assert r.trace is not None
+        terms = r.trace.terminal_events()
+        assert len(terms) == 1, (r.rid, r.trace.events)
+        assert terms[0] is r.trace.events[-1]    # finish is the LAST event
+        kinds = [e["kind"] for e in r.trace.events]
+        assert kinds[0] == "submit"
+        assert kinds.index("admit") < kinds.index("first_token")
+        assert terms[0]["reason"] == "max_new_tokens"
+        assert terms[0]["output_tokens"] == 6
+    tel = eng.telemetry()
+    assert tel["requests"]["submitted"] == tel["requests"]["finished"] == 5
+    assert tel["requests"]["live"] == 0
+
+
+def test_lifecycle_terminal_events_on_eviction_and_preemption_paths():
+    """The terminal-event invariant must survive the rough paths: pool
+    pressure preempting requests (preempt events recorded, request
+    re-admitted, still exactly one finish) and eos eviction."""
+    model = _model()
+    prompts = _prompts(3, lens=(9, 11, 10))
+    want = _oracle(model, prompts, max_new=8)
+    eng = ServingEngine(model, EngineConfig(
+        max_seqs=3, token_budget=16, block_size=4, num_blocks=9,
+        enable_prefix_cache=False, obs=True))
+    reqs = [eng.submit(p, max_new_tokens=8) for p in prompts]
+    eng.run_until_idle(max_steps=500)
+    assert [r.result(0) for r in reqs] == want
+    preempted = [r for r in reqs if r.preemptions]
+    assert preempted, "drill config no longer exercises preemption"
+    for r in reqs:
+        assert len(r.trace.terminal_events()) == 1, r.trace.events
+    for r in preempted:
+        kinds = [e["kind"] for e in r.trace.events]
+        assert "preempt" in kinds
+        # re-admitted after the preemption: a later admit event exists
+        assert max(i for i, k in enumerate(kinds) if k == "admit") \
+            > kinds.index("preempt")
+    tel = eng.telemetry()
+    assert tel["requests"]["preempted"] == \
+        sum(r.preemptions for r in reqs)
+    # eos path: terminal reason says so
+    ref = _oracle(model, [prompts[0]], max_new=8)[0]
+    eng2 = ServingEngine(model, EngineConfig(max_seqs=2, token_budget=16,
+                                             block_size=8, obs=True))
+    r = eng2.submit(prompts[0], max_new_tokens=8, eos_id=ref[2])
+    eng2.run_until_idle()
+    assert r.trace.terminal_events()[0]["reason"] == "eos"
+
+
+def test_flight_ring_and_trace_bounded_under_long_run():
+    """The flight recorder is a RING: a long run keeps only the last N
+    step records and M lifecycles, and a single request's trace caps its
+    event list (terminal event always lands; drops are counted)."""
+    model = _model()
+    eng = ServingEngine(model, EngineConfig(
+        max_seqs=2, token_budget=16, block_size=8,
+        obs=ObsConfig(flight_steps=6, flight_requests=3,
+                      max_events_per_request=4)))
+    reqs = [eng.submit(p, max_new_tokens=6) for p in _prompts(8, lens=(5,))]
+    eng.run_until_idle()
+    obs = eng.obs
+    assert len(obs._steps) == 6                  # ring clamped, not grown
+    assert len(obs._done) == 3
+    assert eng.steps > 6                         # the run outgrew the ring
+    steps = list(obs._steps)
+    assert [s["step"] for s in steps] == \
+        list(range(eng.steps - 5, eng.steps + 1))  # the LAST six, in order
+    for r in reqs:
+        # cap + the terminal event (which always lands past the cap)
+        assert len(r.trace.events) <= 5
+        assert len(r.trace.terminal_events()) == 1   # capped, never lost
+        assert r.trace.dropped > 0
+    rec = eng.dump_flight_record()
+    assert len(rec["steps"]) == 6 and len(rec["requests"]) == 3
+
+
+def test_step_plan_records_explain_budget_and_admission():
+    """Every buffered step record must carry the scheduler's structured
+    plan: budget split that adds up, admission verdicts, pool state."""
+    model = _model()
+    eng = ServingEngine(model, EngineConfig(
+        max_seqs=2, token_budget=12, block_size=4, obs=True,
+        spec_method="ngram", num_draft_tokens=3))
+    rep = [3, 4, 5] * 6
+    eng.submit(rep[:10], max_new_tokens=8)
+    eng.submit(rep[:8], max_new_tokens=8)
+    eng.run_until_idle()
+    for rec in eng.obs._steps:
+        plan = rec["plan"]
+        used = plan["decode_tokens"] + plan["prefill_tokens"] + \
+            plan["drafted_tokens"]
+        assert used + plan["budget_left"] == plan["budget_total"]
+        assert used == sum(e["n"] + e["draft"] for e in rec["entries"])
+        assert plan["admission"] is not None
+        assert {"used", "cached", "free", "utilization"} <= \
+            set(rec["pool"])
+    admitted = [a for rec in eng.obs._steps
+                for a in rec["plan"]["admitted"]]
+    assert {a["rid"] for a in admitted} == {r["rid"] for r in
+                                            eng.obs._done}
+    drafted = sum(rec["plan"]["drafted_tokens"] for rec in eng.obs._steps)
+    assert drafted == eng.spec_proposed > 0
+    specs = [rec["plan"]["spec"] for rec in eng.obs._steps
+             if rec["plan"]["spec"]]
+    assert all("propose_seconds" in s and s["error"] is None
+               for s in specs)
+
+
+def test_flight_dump_determinism_under_seeded_chaos_drill(tmp_path):
+    """tools/chaos_drill.py --flight: the armed-but-quiet run produces
+    no dump, the seeded exhaustion exactly one whose last step names it
+    — and the dump's stable subset is identical across two runs of the
+    same seed (replayable postmortems)."""
+    import importlib
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    chaos_drill = importlib.import_module("chaos_drill")
+    a = chaos_drill.run_flight_drill(seed=77, verbose=False)
+    b = chaos_drill.run_flight_drill(seed=77, verbose=False)
+    assert a["ok"] and a["stable"] == b["stable"]
+    assert a["stable"]["reason"] == "pool_exhausted"
+    assert a["stable"]["exhaustion"][0]["site"] == "serve.kv_alloc"
+
+
+def test_flight_dump_never_raises_and_latches():
+    """The dump path is chaos-drilled (serve.flight_dump): a faulted or
+    unwritable dump degrades to a warning, never an exception into the
+    engine driver; anomaly-triggered dumps latch per reason (one
+    postmortem per anomaly class, not a dump storm)."""
+    model = _model()
+    eng = ServingEngine(model, EngineConfig(max_seqs=2, token_budget=16,
+                                            block_size=8, obs=True))
+    eng.generate_batch(_prompts(2), max_new_tokens=4)
+    plan = chaos.FaultPlan(seed=0).add("serve.flight_dump", "error",
+                                       prob=1.0)
+    chaos.install_plan(plan)
+    try:
+        assert eng.dump_flight_record() is None    # faulted: None, no raise
+    finally:
+        chaos.clear_plan()
+    assert eng.obs.dump_failures == 1
+    # unwritable path: same contract, no chaos needed
+    assert eng.obs.dump(path="/nonexistent-dir/flight.json") is None
+    assert eng.obs.dump_failures == 2
+    # latching: repeated anomalies of one reason dump once
+    eng.obs.note_anomaly("stall", {"fake": True})
+    eng.obs.record_step({"step": 1, "dt_s": 0.0})
+    eng.obs.note_anomaly("stall", {"fake": True})
+    eng.obs.record_step({"step": 2, "dt_s": 0.0})
+    stalls = [d for d in eng.obs.dumps if d["reason"] == "stall"]
+    assert len(stalls) == 1
+    eng.obs.reset_triggers()
+    eng.obs.note_anomaly("stall", {"fake": True})
+    eng.obs.record_step({"step": 3, "dt_s": 0.0})
+    assert len([d for d in eng.obs.dumps
+                if d["reason"] == "stall"]) == 2
+
+
+def test_empty_plan_anomaly_still_dumps():
+    """A wedged engine — exhaustion with NOTHING schedulable, so every
+    plan comes back empty — must still land the explaining step record
+    and flush the dump (review regression: the early return on empty
+    plans skipped record_step, deferring the postmortem of exactly the
+    stuck-engine case the recorder exists for)."""
+    model = _model()
+    plan = chaos.FaultPlan(seed=0).add("serve.kv_alloc", "error", prob=1.0)
+    chaos.install_plan(plan)
+    try:
+        eng = ServingEngine(model, EngineConfig(max_seqs=2,
+                                                token_budget=16,
+                                                block_size=8, obs=True))
+        eng.submit(_prompts(1)[0], max_new_tokens=4)
+        eng.run_until_idle(max_steps=5)      # spins on empty plans
+    finally:
+        chaos.clear_plan()
+    assert eng.steps == 0                     # nothing ever ran
+    dumps = [d for d in eng.obs.dumps if d["reason"] == "pool_exhausted"]
+    assert len(dumps) == 1
+    last = list(eng.obs._steps)[-1]
+    assert last.get("empty") is True
+    assert last["plan"]["exhaustion"][0]["site"] == "serve.kv_alloc"
+    # the request is still waiting, fault cleared => it must drain clean
+    assert eng.run_until_idle(max_steps=50) < 50
+    assert eng.telemetry()["requests"]["finished"] == 1
+
+
+def test_stall_watchdog_triggers_dump():
+    """A step exceeding the stall threshold is an anomaly: with a 0s
+    threshold the very first step must dump with reason 'stall'."""
+    model = _model()
+    eng = ServingEngine(model, EngineConfig(
+        max_seqs=2, token_budget=16, block_size=8,
+        obs=ObsConfig(stall_threshold_s=0.0)))
+    eng.generate_batch(_prompts(1), max_new_tokens=3)
+    assert eng.obs.dumps and eng.obs.dumps[0]["reason"] == "stall"
+    assert len([d for d in eng.obs.dumps
+                if d["reason"] == "stall"]) == 1   # latched
+
+
+def test_slo_goodput_telemetry_and_violation_dump():
+    """Deadline accounting: generous deadlines => full attainment and
+    goodput == throughput; an impossible TTFT deadline => one violation
+    per request, zero goodput, and ONE slo_blow flight dump. The
+    registry gauges/counters land when metrics are enabled."""
+    from paddle_tpu.profiler import metrics as _metrics
+    model = _model()
+    prompts = _prompts(3)
+    eng = ServingEngine(model, EngineConfig(max_seqs=2, token_budget=16,
+                                            block_size=8, obs=True))
+    reqs = [eng.submit(p, max_new_tokens=5, ttft_deadline=60.0,
+                       tpot_deadline=60.0) for p in prompts]
+    eng.run_until_idle()
+    tel = eng.telemetry()
+    assert tel["slo"]["tracked"] == 3 and tel["slo"]["met"] == 3
+    assert tel["slo"]["attainment"] == 1.0
+    assert tel["slo"]["goodput_tokens"] == tel["slo"]["total_tokens"] \
+        == sum(len(r.output) for r in reqs)
+    assert tel["latency"]["ttft"]["count"] == 3
+    assert 0 < tel["latency"]["ttft"]["p50"] <= \
+        tel["latency"]["ttft"]["p95"] <= tel["latency"]["ttft"]["p99"]
+
+    _metrics.reset_registry()
+    _metrics.enable_metrics()
+    try:
+        eng2 = ServingEngine(model, EngineConfig(max_seqs=2,
+                                                 token_budget=16,
+                                                 block_size=8, obs=True))
+        rs = [eng2.submit(p, max_new_tokens=5, ttft_deadline=1e-9)
+              for p in prompts]
+        eng2.run_until_idle()
+        tel2 = eng2.telemetry()
+        assert tel2["slo"]["violations"]["ttft"] == 3
+        assert tel2["slo"]["met"] == 0 and tel2["slo"]["attainment"] == 0.0
+        assert tel2["slo"]["goodput_tokens"] == 0
+        assert tel2["slo"]["total_tokens"] == sum(len(r.output)
+                                                  for r in rs)
+        blows = [d for d in eng2.obs.dumps if d["reason"] == "slo_blow"]
+        assert len(blows) == 1                     # latched: one postmortem
+        snap = _metrics.get_registry().snapshot()
+        assert snap["serve_slo_violations_total"]["kind=ttft"] == 3
+        assert snap["serve_slo_attainment"] == 0.0
+        assert snap["serve_flight_dumps_total"]["trigger=slo_blow"] == 1
+        assert "q=p99" in snap["serve_ttft_quantile_seconds"]
+        assert "serve_goodput_tokens_total" not in snap or \
+            snap["serve_goodput_tokens_total"] == 0
+    finally:
+        _metrics.disable_metrics()
+        _metrics.reset_registry()
+
+
+def test_quantile_sketch_bounds_and_memory():
+    """The Histogram quantile sketch: bounded memory, estimates within
+    the published relative error of the exact order statistics."""
+    from paddle_tpu.profiler import metrics as _metrics
+    h = _metrics.Histogram("t", track_quantiles=True)
+    rng = np.random.default_rng(0)
+    vals = rng.lognormal(mean=-3.0, sigma=1.5, size=5000)
+    for v in vals:
+        h.observe(float(v))
+    assert len(h._qcounts) == _metrics._Q_BUCKETS  # fixed, not per-value
+    srt = np.sort(vals)
+    rel = _metrics.QUANTILE_RELATIVE_ERROR
+    for q in (0.5, 0.95, 0.99):
+        exact = float(srt[int(np.ceil(q * len(srt))) - 1])
+        got = h.quantile(q)
+        assert exact * (1 - 1e-9) <= got <= exact * rel * (1 + 1e-9), \
+            (q, exact, got)
+    assert h.quantile(1.0) >= float(srt[-1]) * (1 - 1e-9)
+    # empty + error contracts
+    h2 = _metrics.Histogram("t2", track_quantiles=True)
+    assert h2.quantile(0.5) == 0.0
+    with pytest.raises(ValueError, match="track_quantiles"):
+        _metrics.Histogram("t3").quantile(0.5)
+    with pytest.raises(ValueError, match="0 < q"):
+        h.quantile(0.0)
+    # snapshot carries the sketch quantiles
+    assert set(h.snapshot()["quantiles"]) == {0.5, 0.95, 0.99}
+
+
+def test_obs_disabled_path_overhead_microbench():
+    """The disarm contract: with the plane off the engine holds obs=None
+    (requests get no trace, zero ring growth) and the disabled record_*
+    helpers cost a single boolean check (generous 20us/call bound
+    absorbs CI noise), same budget the PR 1 plane pins."""
+    import time as _time
+
+    from paddle_tpu.profiler import instrument, metrics as _metrics
+    model = _model()
+    eng = ServingEngine(model, EngineConfig(max_seqs=2, token_budget=16,
+                                            block_size=8))
+    assert eng.obs is None
+    req = eng.submit(_prompts(1)[0], max_new_tokens=3)
+    eng.run_until_idle()
+    assert req.trace is None                      # no per-request work
+    assert not _metrics.metrics_enabled()
+    n = 20_000
+    t0 = _time.perf_counter()
+    for _ in range(n):
+        instrument.record_serve_slo_violation("ttft")
+    per_v = (_time.perf_counter() - t0) / n
+    t0 = _time.perf_counter()
+    for _ in range(n):
+        instrument.record_serve_quantiles("ttft", 0.1, 0.2, 0.3)
+    per_q = (_time.perf_counter() - t0) / n
+    t0 = _time.perf_counter()
+    for _ in range(n):
+        instrument.record_serve_flight_dump("manual")
+    per_d = (_time.perf_counter() - t0) / n
+    for per in (per_v, per_q, per_d):
+        assert per < 20e-6, f"disabled obs record path {per:.2e}s/call"
+
+
+def test_telemetry_stream_and_serve_top_render(tmp_path):
+    """PADDLE_SERVE_TELEMETRY-style streaming: the observer rewrites the
+    snapshot file on a step cadence and serve_top renders it."""
+    import importlib
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    serve_top = importlib.import_module("serve_top")
+    import json as _json
+    tel_path = tmp_path / "telemetry.json"
+    model = _model()
+    eng = ServingEngine(model, EngineConfig(
+        max_seqs=2, token_budget=16, block_size=8,
+        obs=ObsConfig(telemetry_path=str(tel_path), telemetry_every=1)))
+    eng.generate_batch(_prompts(3), max_new_tokens=4)
+    with open(tel_path) as f:
+        tel = _json.load(f)
+    assert tel["requests"]["submitted"] == 3
+    frame = serve_top.render(tel)
+    assert "slo" in frame and "kv pool" in frame and "latency" in frame
+
+
+def test_serve_top_demo_and_trace_export_smoke(tmp_path):
+    """serve_top --demo runs end to end via subprocess, and the chrome
+    trace export merges through tools/trace_merge.py (also subprocess)
+    with the clock anchor aligning it like any training rank trace."""
+    import json as _json
+    import os
+    import subprocess
+    import sys
+    repo = os.path.join(os.path.dirname(__file__), "..")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "serve_top.py"),
+         "--demo", "--iterations", "2", "--requests", "4", "--no-clear"],
+        capture_output=True, timeout=300, env=env, cwd=repo)
+    out = r.stdout.decode()
+    assert r.returncode == 0, r.stderr.decode()
+    assert "slo" in out and "drained 4 requests" in out
+
+    # trace export -> trace_merge CLI
+    model = _model()
+    eng = ServingEngine(model, EngineConfig(max_seqs=2, token_budget=16,
+                                            block_size=8, obs=True))
+    eng.generate_batch(_prompts(3), max_new_tokens=4)
+    trace_path = tmp_path / "serve_trace.json"
+    payload = eng.obs.export_chrome_trace(str(trace_path))
+    names = [e["name"] for e in payload["traceEvents"]]
+    assert "paddle_tpu.clock_anchor" in names
+    for span in ("queue_wait", "prefill", "decode"):
+        assert span in names
+    tids = {e.get("tid") for e in payload["traceEvents"]
+            if e["name"] == "decode"}
+    assert len(tids) == 3                          # one track per request
+    merged_path = tmp_path / "merged.json"
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "trace_merge.py"),
+         str(trace_path), "-o", str(merged_path)],
+        capture_output=True, timeout=120, cwd=repo)
+    assert r.returncode == 0, r.stderr.decode()
+    assert b"no clock anchors" not in r.stderr     # anchor was found
+    with open(merged_path) as f:
+        merged = _json.load(f)
+    spans = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+    assert spans and all(e["ts"] >= 0 for e in spans)
+
+
 # -- benchmark fast mode (throughput floor) ------------------------------------
 
 def test_bench_serve_fast_mode(tmp_path):
@@ -888,6 +1306,19 @@ def test_bench_serve_fast_mode(tmp_path):
     # tokens/s at equal (seeded Poisson) load
     assert cont > stat, res
     assert res["continuous"]["p99_latency_s"] > 0
+    # schema v2: SLO/goodput columns sourced from engine.telemetry(),
+    # plus the engine's streaming sketch p50/p99 TTFT — run_bench itself
+    # asserts the sketch against the offline order statistics within the
+    # sketch error bound (_crosscheck_sketch), so reaching here means
+    # the acceptance cross-check held for every row
+    assert res["schema_version"] == 2
+    assert res["slo"]["ttft_deadline_s"] > 0
+    for row in (res["static"], res["continuous"]):
+        assert 0.0 <= row["slo_attainment"] <= 1.0
+        assert row["goodput_tokens"] <= row["output_tokens"]
+        assert row["goodput_tokens_per_s"] <= row["tokens_per_s"] + 1e-9
+        assert 0 < row["ttft_p99_engine_s"]
+        assert 0 < row["ttft_p99_offline_s"]
     # the speculative pair: same engine, repetitive workload; output
     # bit-equality is asserted inside run_bench (crc32). The tier-1
     # floor is tokens-per-STEP (what speculation actually changes —

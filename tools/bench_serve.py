@@ -24,10 +24,15 @@ delta: fewer engine steps for the same bit-identical tokens. The spec
 row reports accept_rate and rollback pages.
 
 Success metric (ROADMAP items 2/4b): tokens/s and p99 end-to-end
-latency. Writes a BENCH_SERVE_<tag>.json artifact; ``--fast`` is the
-seeded tier-1 mode (tiny model, seconds on CPU) whose throughput floors
-(continuous > static; with --spec, spec > nonspec)
-tests/test_serve_engine.py asserts.
+latency. Every row also carries SLO columns sourced from
+``engine.telemetry()`` (serving/obs.py): attainment and goodput under
+per-request TTFT/TPOT deadlines, plus the engine's STREAMING sketch
+p50/p99 TTFT — cross-checked in-run against the bench's own offline
+percentiles of the identical values and asserted within the sketch's
+published error bound. Writes a BENCH_SERVE_<tag>.json artifact
+(schema_version 2); ``--fast`` is the seeded tier-1 mode (tiny model,
+seconds on CPU) whose throughput floors (continuous > static; with
+--spec, spec > nonspec) tests/test_serve_engine.py asserts.
 
 Usage:
   python tools/bench_serve.py --fast --spec         # tier-1 smoke
@@ -101,13 +106,51 @@ def make_repetitive_workload(seed: int, n_requests: int, rate: float,
     return reqs
 
 
-def drive(model, workload, policy: str, engine_kw: dict, spec_kw=None):
+def _order_stat(values, q: float) -> float:
+    """The ceil(q*n)-th order statistic — EXACTLY what the engine's
+    bounded quantile sketch estimates, so the cross-check below compares
+    like with like (np.percentile interpolates between order stats,
+    which would loosen the assertable bound for no reason)."""
+    v = np.sort(np.asarray(values, np.float64))
+    return float(v[max(1, int(np.ceil(q * len(v)))) - 1])
+
+
+def _crosscheck_sketch(row, tel, engine_ttfts):
+    """Assert the engine's streaming sketch p50/p99 TTFT agree with the
+    offline percentiles computed from the SAME per-request values within
+    the sketch's published error bound: a value v lands in a bucket whose
+    upper edge e obeys v <= e <= v * rel_err, so the sketch estimate of
+    the q-th order statistic o is bounded by o <= sketch <= o * rel_err
+    (tiny absolute slack absorbs float rounding)."""
+    lat = tel["latency"]["ttft"]
+    rel = tel["latency"]["quantile_rel_error"]
+    assert lat["count"] == len(engine_ttfts), \
+        f"sketch saw {lat['count']} TTFTs, offline saw {len(engine_ttfts)}"
+    for name, q in (("p50", 0.50), ("p99", 0.99)):
+        off = _order_stat(engine_ttfts, q)
+        got = lat[name]
+        lo, hi = off * (1 - 1e-9) - 1e-9, off * rel * (1 + 1e-6) + 1e-9
+        assert lo <= got <= hi, \
+            (f"engine sketch TTFT {name}={got:.6f}s outside the sketch "
+             f"error bound [{lo:.6f}, {hi:.6f}] of offline {off:.6f}s")
+        row[f"ttft_{name}_engine_s"] = round(got, 6)
+        row[f"ttft_{name}_offline_s"] = round(off, 6)
+
+
+def drive(model, workload, policy: str, engine_kw: dict, spec_kw=None,
+          slo=None):
     """One open-loop run: submit each request when the run clock passes
     its arrival time, step the engine whenever it has work. Returns the
-    stats row for the artifact."""
-    from paddle_tpu.serving import EngineConfig, ServingEngine
-    eng = ServingEngine(model, EngineConfig(policy=policy, **engine_kw,
-                                            **(spec_kw or {})))
+    stats row for the artifact. ``slo=(ttft_deadline_s, tpot_deadline_s)``
+    attaches deadlines to every request; the row then carries
+    SLO-attainment/goodput columns sourced from ``engine.telemetry()``
+    and the engine's streaming quantiles are cross-checked against the
+    offline percentiles of the same values."""
+    from paddle_tpu.serving import EngineConfig, ObsConfig, ServingEngine
+    eng = ServingEngine(model, EngineConfig(
+        policy=policy, obs=ObsConfig(flight_steps=64, flight_requests=32),
+        **engine_kw, **(spec_kw or {})))
+    ttft_d, tpot_d = slo if slo else (None, None)
     pending = sorted(workload, key=lambda r: r["arrival_s"])
     handles = []
     t0 = time.monotonic()
@@ -117,14 +160,16 @@ def drive(model, workload, policy: str, engine_kw: dict, spec_kw=None):
         while i < len(pending) and pending[i]["arrival_s"] <= now:
             r = pending[i]
             handles.append((r, eng.submit(r["prompt"],
-                                          max_new_tokens=r["max_new"])))
+                                          max_new_tokens=r["max_new"],
+                                          ttft_deadline=ttft_d,
+                                          tpot_deadline=tpot_d)))
             i += 1
         if eng.has_work():
             eng.step()
         elif i < len(pending):
             time.sleep(min(pending[i]["arrival_s"] - now, 0.005))
     wall = time.monotonic() - t0
-    lats, ttfts, tokens = [], [], 0
+    lats, ttfts, engine_ttfts, tokens = [], [], [], 0
     crc = 0
     for spec, req in handles:
         assert req.done, f"request {req.rid} never finished"
@@ -132,7 +177,12 @@ def drive(model, workload, policy: str, engine_kw: dict, spec_kw=None):
         crc = zlib.crc32(np.asarray(req.output, np.int32).tobytes(), crc)
         lats.append((req.finished_at - t0) - spec["arrival_s"])
         ttfts.append((req.first_token_at - t0) - spec["arrival_s"])
+        # the engine-side TTFT (submit -> first token): the exact values
+        # its quantile sketch summarized, for the cross-check
+        engine_ttfts.append(req.first_token_at - req.arrival)
     lats = np.asarray(lats)
+    tel = eng.telemetry()
+    goodput = tel["slo"]["goodput_tokens"]
     row = {
         "policy": policy,
         "requests": len(handles),
@@ -147,7 +197,13 @@ def drive(model, workload, policy: str, engine_kw: dict, spec_kw=None):
         "prefix_hits": eng.pool.stats["prefix_hits"],
         "kv_evictions": eng.pool.stats["evicted"],
         "output_crc32": crc,
+        "slo_attainment": tel["slo"]["attainment"],
+        "slo_violations": tel["slo"]["violations"],
+        "goodput_tokens": goodput,
+        "goodput_tokens_per_s": round(goodput / wall, 2),
+        "goodput_fraction": tel["slo"]["goodput_fraction"],
     }
+    _crosscheck_sketch(row, tel, engine_ttfts)
     if spec_kw:
         s = eng.spec_stats()
         row["speculative"] = spec_kw
@@ -161,17 +217,19 @@ def drive(model, workload, policy: str, engine_kw: dict, spec_kw=None):
 def run_bench(fast: bool = True, seed: int = 0, tag: str = "fast",
               n_requests: int = None, rate: float = None,
               out_path: str = None, spec: bool = False,
-              num_draft_tokens: int = 4):
+              num_draft_tokens: int = 4, slo=None):
     model = _build_model(fast)
     vocab = model.config.vocab_size
     if fast:
         n_requests = n_requests or 24
         rate = rate or 200.0           # arrivals outrun a tiny CPU model
         engine_kw = {"max_seqs": 4, "token_budget": 24, "block_size": 8}
+        slo = slo or (5.0, 2.0)        # generous CPU-fast-path deadlines
     else:
         n_requests = n_requests or 64
         rate = rate or 30.0
         engine_kw = {"max_seqs": 8, "token_budget": 64, "block_size": 16}
+        slo = slo or (2.0, 0.5)
     workload = make_workload(seed, n_requests, rate, vocab)
 
     # warm the jit cache outside the timed runs (all rows share the one
@@ -180,17 +238,21 @@ def run_bench(fast: bool = True, seed: int = 0, tag: str = "fast",
     warm = ServingEngineWarmup(model, engine_kw)
     rows = {}
     for policy in ("static", "continuous"):
-        rows[policy] = drive(model, workload, policy, engine_kw)
+        rows[policy] = drive(model, workload, policy, engine_kw, slo=slo)
         print(f"[bench_serve] {policy:11s}: "
               f"{rows[policy]['tokens_per_s']:8.1f} tok/s  "
               f"p99 {rows[policy]['p99_latency_s']:.3f}s  "
+              f"slo {rows[policy]['slo_attainment']:.2f}  "
+              f"goodput {rows[policy]['goodput_tokens_per_s']:.1f} tok/s  "
               f"steps {rows[policy]['engine_steps']}", flush=True)
 
     result = {
         "bench": "serve",
+        "schema_version": 2,
         "tag": tag,
         "seed": seed,
         "fast": bool(fast),
+        "slo": {"ttft_deadline_s": slo[0], "tpot_deadline_s": slo[1]},
         "model": {"hidden": model.config.hidden_size,
                   "layers": model.config.num_hidden_layers,
                   "heads": model.config.num_attention_heads,
@@ -217,7 +279,7 @@ def run_bench(fast: bool = True, seed: int = 0, tag: str = "fast",
                    "num_draft_tokens": int(num_draft_tokens)}
         for name, skw in (("nonspec", None), ("spec", spec_kw)):
             rows[name] = drive(model, spec_load, "continuous", engine_kw,
-                               spec_kw=skw)
+                               spec_kw=skw, slo=slo)
             extra = (f"  accept {rows[name]['accept_rate']:.2f}"
                      if skw else "")
             print(f"[bench_serve] {name:11s}: "

@@ -26,6 +26,16 @@ eager PR-5 worker.
 
     JAX_PLATFORMS=cpu python tools/chaos_drill.py --preempt [--seed 1234]
 
+``--flight`` runs the serving flight-recorder drill: a seeded
+``serve.kv_alloc`` exhaustion against an armed observability plane
+(paddle_tpu.serving.obs) must produce EXACTLY one well-formed flight
+dump whose last step-plan record names the exhaustion — and the
+armed-but-quiet control run (same engine, same workload, no fault) must
+produce none. Deterministic per seed: two runs yield the same stable
+dump subset (reason, exhaustion site/phase, step/request ids).
+
+    JAX_PLATFORMS=cpu python tools/chaos_drill.py --flight [--seed 1234]
+
 Exit code 0 = every exercised recovery path verified.
 """
 from __future__ import annotations
@@ -264,6 +274,97 @@ def run_preempt_drill(seed: int = 1234, steps: int = 8, preempt_at: int = 4,
             ctx.cleanup()
 
 
+def run_flight_drill(seed: int = 1234, verbose: bool = True):
+    """Seeded serving flight-recorder drill (see module docstring).
+
+    Phase 1 (armed-but-quiet): the observability plane is on, no fault
+    is installed — asserts ZERO dumps (an idle postmortem layer that
+    dumps on healthy traffic would be noise nobody reads). Phase 2: a
+    hit-indexed ``serve.kv_alloc`` error (the deterministic
+    pool-exhaustion drill) — asserts exactly ONE well-formed dump whose
+    LAST step record carries the exhaustion in its plan, so the
+    postmortem always contains the step that explains itself. Returns a
+    report whose ``stable`` subset is bit-identical per seed."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.resilience import chaos
+    from paddle_tpu.serving import EngineConfig, ObsConfig, ServingEngine
+
+    paddle.seed(seed % (2 ** 31))
+    cfg = LlamaConfig.tiny(vocab_size=61, hidden_size=32, layers=2,
+                           heads=4, kv_heads=2, seq=64)
+    cfg.use_flash_attention = False
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(1, 61, (6 + i % 4,)).tolist() for i in range(4)]
+
+    def run(fault: bool, dump_path: str):
+        eng = ServingEngine(model, EngineConfig(
+            max_seqs=2, token_budget=16, block_size=8,
+            enable_prefix_cache=False,
+            obs=ObsConfig(flight_steps=32, flight_requests=16,
+                          dump_path=dump_path)))
+        if fault:
+            chaos.install_plan(chaos.FaultPlan(seed=seed).add(
+                "serve.kv_alloc", "error", at=(2,)))
+        try:
+            reqs = [eng.submit(p, max_new_tokens=6) for p in prompts]
+            eng.run_until_idle(max_steps=400)
+        finally:
+            chaos.clear_plan()
+        assert all(r.done for r in reqs), "drill workload never drained"
+        # request ids are process-global; the determinism contract is on
+        # SUBMISSION order, so the stable report normalizes through this
+        return eng, {r.rid: i for i, r in enumerate(reqs)}
+
+    with tempfile.TemporaryDirectory() as root:
+        quiet_path = os.path.join(root, "quiet_flight.json")
+        quiet, _ = run(fault=False, dump_path=quiet_path)
+        assert quiet.obs.dumps == [], \
+            f"armed-but-quiet run dumped: {quiet.obs.dumps}"
+        assert not os.path.exists(quiet_path), \
+            "armed-but-quiet run wrote a flight file"
+
+        dump_path = os.path.join(root, "flight.json")
+        faulted, rid_of = run(fault=True, dump_path=dump_path)
+        assert len(faulted.obs.dumps) == 1, \
+            f"expected exactly one flight dump, got {faulted.obs.dumps}"
+        with open(dump_path) as f:
+            dump = json.load(f)
+        for key in ("version", "reason", "steps", "requests",
+                    "live_requests", "telemetry", "unix_time"):
+            assert key in dump, f"flight dump missing {key!r}"
+        assert dump["reason"] == "pool_exhausted", dump["reason"]
+        last = dump["steps"][-1]
+        exh = last["plan"]["exhaustion"]
+        assert exh and exh[0]["site"] == "serve.kv_alloc", \
+            f"last step record does not name the exhaustion: {last}"
+        report = {
+            "seed": seed, "ok": True,
+            "stable": {
+                "reason": dump["reason"],
+                "exhaustion": [{"site": e["site"],
+                                "req": rid_of[e["rid"]],
+                                "phase": e["phase"], "kind": e["kind"],
+                                "need_pages": e["need_pages"]}
+                               for e in exh],
+                "exhaustion_step": last["step"],
+                "steps_in_dump": len(dump["steps"]),
+                "finished_requests": [rid_of[r["rid"]]
+                                      for r in dump["requests"]],
+            },
+        }
+    if verbose:
+        print(f"flight drill (seed={seed}): quiet run 0 dumps; seeded "
+              f"serve.kv_alloc exhaustion -> 1 dump at step "
+              f"{report['stable']['exhaustion_step']} naming "
+              f"{report['stable']['exhaustion'][0]['site']} — flight "
+              "recorder verified")
+    return report
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seed", type=int, default=1234)
@@ -275,10 +376,15 @@ def main(argv=None):
     ap.add_argument("--no-aot", action="store_true",
                     help="with --preempt: skip the AOT program-cache leg "
                          "(eager Model.fit worker, PR-5 behavior)")
+    ap.add_argument("--flight", action="store_true",
+                    help="run the serving flight-recorder drill (seeded "
+                         "pool exhaustion => exactly one dump)")
     args = ap.parse_args(argv)
     if args.preempt:
         report = run_preempt_drill(seed=args.seed, verbose=not args.json,
                                    aot=not args.no_aot)
+    elif args.flight:
+        report = run_flight_drill(seed=args.seed, verbose=not args.json)
     else:
         report = run_drill(seed=args.seed, verbose=not args.json)
     if args.json:

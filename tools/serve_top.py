@@ -1,0 +1,239 @@
+#!/usr/bin/env python
+"""serve_top: live terminal dashboard over ServingEngine telemetry.
+
+The `top` of the serving tier — renders the ``engine.telemetry()``
+snapshot (serving/obs.py) as refreshing terminal panels: queue/batch
+occupancy, KV-pool utilization, streaming p50/p95/p99 TTFT/TPOT/e2e
+(bounded quantile sketch), SLO attainment + goodput, speculative accept
+rate, and flight-recorder status.
+
+Two modes:
+
+  * ``--watch FILE`` — follow a telemetry JSON file an engine process
+    streams (arm the engine with ``PADDLE_SERVE_TELEMETRY=FILE`` or
+    ``ObsConfig(telemetry_path=FILE)``; the observer atomically rewrites
+    it every ``telemetry_every`` steps). This is the production shape:
+    the dashboard never touches the serving process.
+  * ``--demo``       — self-contained: builds a tiny CPU model, drives a
+    seeded Poisson load through an armed engine in-process, and renders
+    between step batches. The zero-setup smoke (used by tier-1 via
+    subprocess).
+
+Usage:
+    python tools/serve_top.py --watch /run/serve_telemetry.json
+    JAX_PLATFORMS=cpu python tools/serve_top.py --demo --iterations 6
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
+
+CLEAR = "\x1b[2J\x1b[H"
+
+
+def _bar(frac: float, width: int = 24) -> str:
+    frac = min(max(float(frac), 0.0), 1.0)
+    n = int(round(frac * width))
+    return "[" + "#" * n + "-" * (width - n) + "]"
+
+
+def _fmt_s(v) -> str:
+    if v is None:
+        return "   -  "
+    v = float(v)
+    if v >= 10:
+        return f"{v:5.1f}s"
+    if v >= 0.01:
+        return f"{v * 1e3:4.0f}ms"
+    return f"{v * 1e6:4.0f}us"
+
+
+def _lat_line(name: str, d: dict) -> str:
+    return (f"  {name:<5} p50 {_fmt_s(d.get('p50'))}  "
+            f"p95 {_fmt_s(d.get('p95'))}  p99 {_fmt_s(d.get('p99'))}  "
+            f"mean {_fmt_s(d.get('mean'))}  n={d.get('count', 0)}")
+
+
+def render(tel: dict, prev: dict = None) -> str:
+    """One dashboard frame from a telemetry snapshot (prev = the
+    previous snapshot, for instantaneous rates)."""
+    lines = []
+    steps = tel.get("steps", 0)
+    tokens = tel.get("tokens_generated", 0)
+    rate = ""
+    if prev and tel.get("unix_time") and prev.get("unix_time"):
+        dt = tel["unix_time"] - prev["unix_time"]
+        if dt > 0:
+            tps = (tokens - prev.get("tokens_generated", 0)) / dt
+            rate = f"  {tps:8.1f} tok/s (inst)"
+    lines.append(f"paddle_tpu serve_top — steps {steps}  "
+                 f"tokens {tokens}{rate}")
+    lines.append("-" * 72)
+
+    req = tel.get("requests", {})
+    lines.append(
+        f"requests  waiting {tel.get('queue_depth', 0):>3}  "
+        f"running {tel.get('running', 0):>3}  "
+        f"finished {req.get('finished', 0)}/{req.get('submitted', 0)}  "
+        f"preempted {req.get('preempted', 0)}")
+
+    pool = tel.get("pool", {})
+    util = pool.get("utilization", 0.0)
+    prefix = pool.get("prefix", {})
+    lines.append(
+        f"kv pool   {_bar(util)} {util * 100:5.1f}%  "
+        f"used {pool.get('used', 0)} cached {pool.get('cached', 0)} "
+        f"free {pool.get('free', 0)} of {pool.get('size', 0)}   "
+        f"prefix hits {prefix.get('hits', 0)}/{prefix.get('queries', 0)}")
+
+    lat = tel.get("latency")
+    if lat:
+        lines.append("latency (streaming sketch, rel err "
+                     f"{lat.get('quantile_rel_error', 0):.2f}x)")
+        for kind, label in (("ttft", "ttft"), ("tpot", "tpot"),
+                            ("e2e", "e2e")):
+            if kind in lat:
+                lines.append(_lat_line(label, lat[kind]))
+
+    slo = tel.get("slo")
+    if slo:
+        att = slo.get("attainment", 1.0)
+        gp = slo.get("goodput_fraction", 1.0)
+        v = slo.get("violations", {})
+        lines.append(
+            f"slo       attainment {att * 100:5.1f}% "
+            f"({slo.get('met', 0)}/{slo.get('tracked', 0)} tracked)  "
+            f"violations ttft {v.get('ttft', 0)} tpot {v.get('tpot', 0)}")
+        lines.append(
+            f"goodput   {_bar(gp)} {gp * 100:5.1f}%  "
+            f"{slo.get('goodput_tokens', 0)}/{slo.get('total_tokens', 0)} "
+            "tokens met their deadlines")
+
+    spec = tel.get("spec", {})
+    if spec.get("proposed"):
+        lines.append(
+            f"spec      accept {spec.get('accept_rate', 0.0):.2f}  "
+            f"proposed {spec.get('proposed', 0)} "
+            f"accepted {spec.get('accepted', 0)}  "
+            f"rollback pages {spec.get('rollback_pages', 0)}")
+
+    flight = tel.get("flight")
+    if flight:
+        dumps = flight.get("dumps", [])
+        tail = (f"  last: {dumps[-1].get('reason')}" if dumps else "")
+        lines.append(
+            f"flight    {flight.get('buffered_steps', 0)} steps / "
+            f"{flight.get('buffered_requests', 0)} reqs buffered  "
+            f"dumps {len(dumps)}{tail}")
+    return "\n".join(lines) + "\n"
+
+
+def watch(path: str, interval: float, iterations, no_clear: bool) -> int:
+    prev = None
+    n = 0
+    while iterations is None or n < iterations:
+        tel = None
+        try:
+            with open(path) as f:
+                tel = json.load(f)
+        except FileNotFoundError:
+            sys.stdout.write(f"serve_top: waiting for {path} ...\n")
+        except json.JSONDecodeError:
+            pass                          # mid-rewrite: keep last frame
+        if tel is not None:
+            if not no_clear:
+                sys.stdout.write(CLEAR)
+            sys.stdout.write(render(tel, prev))
+            sys.stdout.flush()
+            prev = tel
+        n += 1
+        if iterations is None or n < iterations:
+            time.sleep(interval)
+    return 0
+
+
+def demo(iterations: int, n_requests: int, interval: float,
+         no_clear: bool, seed: int = 0) -> int:
+    """Self-contained demo: tiny model, seeded load, armed engine."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import EngineConfig, ObsConfig, ServingEngine
+
+    paddle.seed(11)
+    cfg = LlamaConfig.tiny(vocab_size=128, hidden_size=32, layers=2,
+                           heads=4, kv_heads=2, seq=128)
+    cfg.use_flash_attention = False
+    model = LlamaForCausalLM(cfg)
+    eng = ServingEngine(model, EngineConfig(
+        max_seqs=4, token_budget=24, block_size=8,
+        spec_method="ngram", num_draft_tokens=3,
+        obs=ObsConfig(flight_steps=64, flight_requests=32)))
+    rng = np.random.default_rng(seed)
+    pattern = rng.integers(1, 128, (5,)).tolist()
+    for i in range(n_requests):
+        prompt = (pattern * 4)[:int(rng.integers(8, 18))] \
+            if i % 2 else rng.integers(1, 128,
+                                       (int(rng.integers(6, 14)),)).tolist()
+        eng.submit(prompt, max_new_tokens=int(rng.integers(8, 20)),
+                   ttft_deadline=5.0, tpot_deadline=2.0)
+    prev = None
+    for _ in range(iterations):
+        if eng.has_work():
+            eng.run_until_idle(max_steps=4)
+        tel = eng.telemetry()
+        if not no_clear:
+            sys.stdout.write(CLEAR)
+        sys.stdout.write(render(tel, prev))
+        sys.stdout.flush()
+        prev = tel
+        if eng.has_work():
+            continue
+        break
+    eng.run_until_idle()
+    tel = eng.telemetry()
+    if not no_clear:
+        sys.stdout.write(CLEAR)
+    sys.stdout.write(render(tel, prev))
+    sys.stdout.write("serve_top demo: drained "
+                     f"{tel['requests']['finished']} requests, "
+                     f"{tel['tokens_generated']} tokens\n")
+    return 0 if tel["requests"]["finished"] == n_requests else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    mode = ap.add_mutually_exclusive_group(required=True)
+    mode.add_argument("--watch", metavar="FILE",
+                      help="follow a telemetry JSON file "
+                           "(PADDLE_SERVE_TELEMETRY on the engine side)")
+    mode.add_argument("--demo", action="store_true",
+                      help="drive a tiny in-process engine and render it")
+    ap.add_argument("--interval", type=float, default=0.5,
+                    help="refresh period in seconds (watch mode)")
+    ap.add_argument("--iterations", type=int, default=None,
+                    help="frames to render then exit (default: forever in "
+                         "watch mode, until drained in demo mode)")
+    ap.add_argument("--requests", type=int, default=12,
+                    help="demo-mode request count")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--no-clear", action="store_true",
+                    help="append frames instead of clearing the screen "
+                         "(logs, subprocess tests)")
+    args = ap.parse_args(argv)
+    if args.demo:
+        return demo(args.iterations if args.iterations is not None
+                    else 10 ** 9, args.requests, args.interval,
+                    args.no_clear, seed=args.seed)
+    return watch(args.watch, args.interval, args.iterations, args.no_clear)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -101,6 +101,25 @@ def _metrics_dump(env: dict, since: float) -> object:
         return {"unparseable": path}
 
 
+def _flight_dump(env: dict, since: float) -> object:
+    """Inline the worker's serving flight-recorder dump
+    (PADDLE_SERVE_FLIGHT, written by paddle_tpu.serving.obs on anomaly
+    triggers) into the crash report — a serving worker that died with a
+    pool exhaustion or driver stall ships its last N step-plan records
+    with the postmortem. Same staleness rule as _metrics_dump: a file
+    older than this attempt belongs to a previous generation."""
+    path = env.get("PADDLE_SERVE_FLIGHT", "")
+    if not path or not os.path.exists(path):
+        return None
+    try:
+        if os.path.getmtime(path) < since:
+            return None  # stale: written by an earlier attempt
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {"unparseable": path}
+
+
 def _aot_report(stats_path: str, spawn_wall: float) -> object:
     """Summarize the worker's AOT cache stats file (PADDLE_AOT_STATS,
     rewritten atomically by paddle_tpu.aot.cache on every program-ready
@@ -121,14 +140,19 @@ def _aot_report(stats_path: str, spawn_wall: float) -> object:
     except (OSError, json.JSONDecodeError):
         return {"unparseable": stats_path}
     ready = stats.get("first_program_ready_unix")
+    programs = stats.get("programs", {})
+    # per-program XLA cost_analysis (flops / bytes accessed), recorded by
+    # aot/cache.py at export and restored from artifact meta on hits —
+    # the MFU-attribution evidence surfaced next to the hit/miss counts
+    cost = {name: p["cost"] for name, p in programs.items()
+            if p.get("cost")}
     return {
-        "programs": stats.get("programs", {}),
-        "hits": sum(p.get("hits", 0)
-                    for p in stats.get("programs", {}).values()),
-        "misses": sum(p.get("misses", 0)
-                      for p in stats.get("programs", {}).values()),
+        "programs": programs,
+        "hits": sum(p.get("hits", 0) for p in programs.values()),
+        "misses": sum(p.get("misses", 0) for p in programs.values()),
         "fallbacks": sum(p.get("fallbacks", 0)
-                         for p in stats.get("programs", {}).values()),
+                         for p in programs.values()),
+        "cost": cost or None,
         "cold_start_seconds": (round(ready - spawn_wall, 3)
                                if ready is not None else None),
     }
@@ -182,6 +206,11 @@ class Supervisor:
             env["PADDLE_AOT_CACHE"] = os.path.abspath(self.aot_cache)
         if self.report_dir:
             env["PADDLE_AOT_STATS"] = self._aot_stats_path()
+            # serving workers get a flight-dump path per generation (an
+            # explicit PADDLE_SERVE_FLIGHT from the launcher wins); the
+            # dump is inlined into this generation's crash report
+            env.setdefault("PADDLE_SERVE_FLIGHT", os.path.join(
+                self.report_dir, f"flight_{self.generation}.json"))
         return env
 
     def _aot_stats_path(self) -> str:
@@ -223,6 +252,7 @@ class Supervisor:
             "log_tail": _tail(log_path, self.log_tail_lines),
             "metrics": _metrics_dump(env, wall0),
             "aot": _aot_report(env.get("PADDLE_AOT_STATS", ""), wall0),
+            "flight": _flight_dump(env, wall0),
         }
         if isinstance(report["aot"], dict):
             report["cold_start_seconds"] = \
