@@ -8,6 +8,7 @@ from __future__ import annotations
 
 __version__ = "0.1.0"
 
+import os as _os
 import warnings as _warnings
 
 # int64 requests truncate to int32 with x64 disabled (the right tradeoff on
@@ -131,6 +132,13 @@ from . import sysconfig  # noqa: F401
 from .batch import batch  # noqa: F401
 from .framework.io import save, load  # noqa: F401
 from .nn.layer.layers import disable_static, enable_static, in_dynamic_mode  # noqa: F401
+
+# profile-guided startup: when PADDLE_PERF_CONFIG names a resolver
+# output (tools/perf_resolve.py), apply its matching, non-stale
+# per-device flag decisions now that every define_flag has run. Never
+# load-bearing: any failure keeps defaults (one warning + a metric).
+if _os.environ.get(framework.flags.ENV_PERF_CONFIG, "").strip():
+    framework.flags.apply_perf_config()
 
 DataParallel = distributed.DataParallel
 

@@ -50,7 +50,8 @@ from . import shardcheck  # noqa: F401  (stdlib-only at import time)
 from .astlint import (iter_python_files, lint_file, lint_paths,  # noqa: F401
                       lint_source)
 from .rules import (RULES, Finding, get_rule,  # noqa: F401
-                    load_chaos_sites, load_metric_catalog, rule_table)
+                    load_chaos_sites, load_flag_registry,
+                    load_metric_catalog, rule_table)
 from .shard_rules import load_known_axes  # noqa: F401
 from .shardcheck import (SHARD_RULES, layout_check,  # noqa: F401
                          layout_report)
@@ -58,7 +59,8 @@ from .shardcheck import (SHARD_RULES, layout_check,  # noqa: F401
 __all__ = [
     "Finding", "RULES", "get_rule", "rule_table",
     "lint_source", "lint_file", "lint_paths", "iter_python_files",
-    "load_chaos_sites", "load_metric_catalog", "load_known_axes",
+    "load_chaos_sites", "load_flag_registry", "load_metric_catalog",
+    "load_known_axes",
     "SHARD_RULES", "layout_check", "layout_report", "shardcheck",
     "schedule", "trace_check", "check_collective_schedules", "TRACE_RULES",
 ]

@@ -26,7 +26,8 @@ from dataclasses import dataclass
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 __all__ = ["Finding", "Rule", "RULES", "rule_table", "get_rule",
-           "load_metric_catalog", "load_chaos_sites"]
+           "load_metric_catalog", "load_chaos_sites",
+           "load_flag_registry"]
 
 _PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -113,6 +114,39 @@ def load_metric_catalog() -> frozenset:
     profiler/instrument.py's CATALOG tuple."""
     path = os.path.join(_PKG_ROOT, "profiler", "instrument.py")
     return frozenset(_literal_from_source(path, "CATALOG"))
+
+
+@functools.lru_cache(maxsize=1)
+def load_flag_registry() -> frozenset:
+    """Every flag name the package defines, read statically from
+    ``define_flag("<name>", ...)`` call sites across paddle_tpu/*.py.
+    Static on purpose: kernel modules register their flags on first
+    import, so a runtime ``flags._FLAGS`` snapshot taken under the
+    jax-free bootstrap would miss them — and the perf-config provenance
+    check (tools/lint.py --perf-config) must see the full registry."""
+    names = set()
+    for dirpath, dirnames, filenames in os.walk(_PKG_ROOT):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            try:
+                with open(path, "r") as f:
+                    tree = ast.parse(f.read())
+            except (OSError, SyntaxError):
+                continue
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                func = node.func
+                callee = func.attr if isinstance(func, ast.Attribute) \
+                    else getattr(func, "id", None)
+                if callee == "define_flag" and node.args and \
+                        isinstance(node.args[0], ast.Constant) and \
+                        isinstance(node.args[0].value, str):
+                    names.add(node.args[0].value)
+    return frozenset(names)
 
 
 @functools.lru_cache(maxsize=1)

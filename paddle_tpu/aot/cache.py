@@ -68,6 +68,8 @@ _STATS: Dict[str, Any] = {
     "programs": {},
     "first_program_ready_unix": None,
     "seconds_since_aot_import": None,
+    "device_kind": None,
+    "platform": None,
 }
 
 
@@ -77,6 +79,17 @@ def reset_stats() -> None:
         _STATS["programs"] = {}
         _STATS["first_program_ready_unix"] = None
         _STATS["seconds_since_aot_import"] = None
+        _STATS["device_kind"] = None
+        _STATS["platform"] = None
+
+
+def _device_identity() -> tuple:
+    """(device_kind, platform) stamped into the stats file so
+    perf-evidence consumers (profiler/evidence.py) key per-program
+    costs by device. By the time a program is ready the backend exists;
+    the shared probe never raises."""
+    from ..profiler.evidence import device_identity
+    return device_identity()
 
 
 def aot_stats() -> Dict[str, Any]:
@@ -115,6 +128,8 @@ def _note_event(name: str, event: str, seconds: float = 0.0,
                 _STATS["first_program_ready_unix"] is None:
             _STATS["first_program_ready_unix"] = time.time()
             _STATS["seconds_since_aot_import"] = time.monotonic() - _T0
+        if _STATS["device_kind"] is None:
+            _STATS["device_kind"], _STATS["platform"] = _device_identity()
         snapshot = json.dumps(_STATS, indent=1)
     path = os.environ.get(ENV_STATS, "").strip()
     if path:

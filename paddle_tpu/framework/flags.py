@@ -3,18 +3,45 @@
 Reference parity: paddle's gflags-style registry (paddle/common/flags.h,
 flags_native.cc) exposed via paddle.set_flags/get_flags. Flags may be overridden
 with FLAGS_<name> environment variables at import time.
+
+``apply_perf_config`` closes the profile-guided loop: it applies the
+per-device-type flag decisions ``tools/perf_resolve.py`` distilled from
+the perf-evidence ledger (``PERF_CONFIG.json``), so every process on a
+known device inherits the measured winners without re-profiling. It is
+NEVER load-bearing: a missing, corrupt, schema-mismatched or
+wrong-device config leaves the compiled-in defaults untouched, logs one
+warning, and meters the outcome
+(``perf_resolver_decisions_total{flag,status}``).
 """
 from __future__ import annotations
 
+import json
+import logging
 import os
-from typing import Any, Dict
+from typing import Any, Dict, Optional
+
+logger = logging.getLogger(__name__)
 
 _FLAGS: Dict[str, Any] = {}
+
+ENV_PERF_CONFIG = "PADDLE_PERF_CONFIG"
+
+# perf-config decisions for flags whose define_flag has not run yet
+# (kernel modules define theirs on first import): define_flag consults
+# this map, so apply-at-startup survives any import order. Precedence:
+# explicit FLAGS_<name> env > perf config > compiled-in default.
+_PERF_PENDING: Dict[str, Any] = {}
 
 
 def define_flag(name: str, default, help_str: str = ""):
     env = os.environ.get("FLAGS_" + name)
     value = default
+    if name in _PERF_PENDING:
+        if env is not None:
+            _record_decision(name, "env_override")
+        else:
+            value = _PERF_PENDING[name]
+            _record_decision(name, "applied")
     if env is not None:
         if isinstance(default, bool):
             value = env.lower() in ("1", "true", "yes", "on")
@@ -50,7 +77,159 @@ def flag(name: str):
     return _FLAGS[name]
 
 
+def known_flags() -> Dict[str, Any]:
+    """Snapshot of the registry (name -> current value)."""
+    return dict(_FLAGS)
+
+
+def _record_decision(flag_name: str, status: str) -> None:
+    try:
+        from ..profiler.instrument import record_perf_resolver_decision
+        record_perf_resolver_decision(flag_name, status)
+    except Exception:  # noqa: BLE001 — metering must not gate startup
+        pass
+
+
+def _detect_device_kind() -> Optional[str]:
+    """Best-effort device kind for matching against PERF_CONFIG device
+    keys (the shared never-raising probe in profiler/evidence.py)."""
+    try:
+        from ..profiler.evidence import device_identity
+        return device_identity()[0]
+    except Exception:  # noqa: BLE001 — device probing is advisory here
+        return None
+
+
+def apply_perf_config(path: Optional[str] = None,
+                      device_kind: Optional[str] = None,
+                      include_stale: bool = False) -> Dict[str, Any]:
+    """Apply matching, non-stale PERF_CONFIG.json flag decisions.
+
+    path defaults to ``$PADDLE_PERF_CONFIG``; with neither given this is
+    a no-op. device_kind defaults to the current backend's (lazily
+    probed). Returns a report dict (``status`` plus per-flag outcomes)
+    and NEVER raises: every failure mode degrades to the compiled-in
+    defaults with one warning and a metric.
+
+    Kernel block-size winners (``kernel_blocks``) are fed to
+    ``kernels.autotune.record`` so traced call sites see the tuned
+    blocks without the flag-gated first-use timing.
+    """
+    report: Dict[str, Any] = {"status": "applied", "path": None,
+                              "device_kind": None, "flags": {},
+                              "kernel_blocks": 0}
+    try:
+        path = path or os.environ.get(ENV_PERF_CONFIG, "").strip() or None
+        report["path"] = path
+        if not path:
+            report["status"] = "no_config"
+            return report
+        try:
+            with open(path) as f:
+                config = json.load(f)
+        except (OSError, ValueError) as e:
+            logger.warning("perf config %s unreadable (%s); keeping "
+                           "default flags", path, e)
+            _record_decision("_config", "corrupt")
+            report["status"] = "corrupt"
+            return report
+        if not isinstance(config, dict) or config.get("schema") != 1 or \
+                not isinstance(config.get("devices"), dict):
+            logger.warning("perf config %s has unknown schema; keeping "
+                           "default flags", path)
+            _record_decision("_config", "corrupt")
+            report["status"] = "corrupt"
+            return report
+        device_kind = device_kind or _detect_device_kind()
+        report["device_kind"] = device_kind
+        entry = config["devices"].get(device_kind) \
+            if device_kind else None
+        if not isinstance(entry, dict):
+            logger.warning(
+                "perf config %s has no decisions for device kind %r; "
+                "keeping default flags", path, device_kind)
+            _record_decision("_config", "device_mismatch")
+            report["status"] = "device_mismatch"
+            return report
+        for name in sorted(entry.get("flags") or {}):
+            decision = entry["flags"][name]
+            if not isinstance(decision, dict) or "value" not in decision:
+                report["flags"][name] = "malformed"
+                _record_decision(name, "corrupt")
+                continue
+            if decision.get("stale") and not include_stale:
+                report["flags"][name] = "stale"
+                _record_decision(name, "stale")
+                continue
+            if name not in _FLAGS:
+                # not registered YET: kernel modules define their flags
+                # on first import — park the decision for define_flag
+                _PERF_PENDING[name] = decision["value"]
+                report["flags"][name] = "deferred"
+                _record_decision(name, "deferred")
+                continue
+            if os.environ.get("FLAGS_" + name) is not None:
+                # an explicit env override outranks the resolver
+                report["flags"][name] = "env_override"
+                _record_decision(name, "env_override")
+                continue
+            # type gate: a config value whose type disagrees with the
+            # registered flag (e.g. the string "false" for a bool gate,
+            # which every `if flag(...)` would read as ON) must not
+            # become load-bearing
+            current = _FLAGS[name]
+            value = decision["value"]
+            if type(value) is not type(current) and not (
+                    isinstance(current, float)
+                    and isinstance(value, int)
+                    and not isinstance(value, bool)):
+                logger.warning("perf config value %r for flag %r does "
+                               "not match its registered type %s; "
+                               "keeping default", value, name,
+                               type(current).__name__)
+                report["flags"][name] = "invalid_value"
+                _record_decision(name, "invalid_value")
+                continue
+            _FLAGS[name] = value
+            report["flags"][name] = "applied"
+            _record_decision(name, "applied")
+        blocks = entry.get("kernel_blocks") or {}
+        if blocks:
+            try:
+                from ..kernels import autotune
+            except Exception:  # noqa: BLE001 — winners are advisory
+                autotune = None
+                logger.warning("perf config kernel blocks not applied",
+                               exc_info=True)
+            if autotune is not None:
+                for dkey in sorted(blocks):
+                    # per-entry guard: one malformed winner must not
+                    # cost the remaining kernels their tuned blocks
+                    try:
+                        spec = blocks[dkey]
+                        key = json.loads(dkey)
+                        autotune.record(key[0], key[1:], spec["block"])
+                        report["kernel_blocks"] += 1
+                    except Exception:  # noqa: BLE001
+                        logger.warning("perf config kernel block %r "
+                                       "not applied", dkey,
+                                       exc_info=True)
+        return report
+    except Exception:  # noqa: BLE001 — the whole apply is never fatal
+        logger.warning("apply_perf_config failed; keeping default flags",
+                       exc_info=True)
+        _record_decision("_config", "corrupt")
+        report["status"] = "error"
+        return report
+
+
 # Core flags (parity with the reference's most commonly used debug flags).
 define_flag("check_nan_inf", False, "Check outputs of every op for NaN/Inf.")
 define_flag("check_nan_inf_level", 0, "0: error on nan/inf; >0: warn only.")
 define_flag("eager_op_log", False, "Log every dispatched eager op.")
+define_flag("remat_policy", "",
+            "Default remat policy for SpmdTrainer(remat_layers=...) when "
+            "the caller passes none: a parallel.trainer.REMAT_POLICIES "
+            "name, 'off' (skip wrapping), or '' (trainer default). Set "
+            "per device by the perf-config resolver from mfu_lab A/B "
+            "evidence.")

@@ -171,7 +171,7 @@ class SpmdTrainer:
                  donate: bool = True, batch_axes=("dp", "sharding"),
                  seq_axis: Optional[str] = None,
                  zero_stage: Optional[int] = None,
-                 remat_policy: str = "full",
+                 remat_policy: Optional[str] = None,
                  accumulate_steps: int = 1,
                  aot_cache=None):
         self.model = model
@@ -209,7 +209,25 @@ class SpmdTrainer:
             seq_axis = None  # degenerate context parallelism = serial
         self.seq_axis = seq_axis
         self.donate = donate
-        if remat_layers:
+        if remat_policy is None:
+            # caller expressed no preference: the perf-config resolver's
+            # measured per-device decision (FLAGS_remat_policy, set by
+            # flags.apply_perf_config from mfu_lab A/B evidence) wins
+            # over the compiled-in "full"; "off" skips wrapping entirely
+            # (the measured-faster no-checkpointing side). A flag value
+            # outside the known domain (hand-edited config) degrades to
+            # "full" — the flag path is advisory, never load-bearing
+            from ..framework import flags as _flags
+            remat_policy = _flags.flag("remat_policy") or "full"
+            if remat_policy not in ("off", "full") and \
+                    remat_policy not in REMAT_POLICIES:
+                import logging
+                logging.getLogger(__name__).warning(
+                    "FLAGS_remat_policy=%r is not a known policy; "
+                    "using 'full'", remat_policy)
+                remat_policy = "full"
+        self.remat_policy = remat_policy
+        if remat_layers and remat_policy != "off":
             for l in remat_layers:
                 _wrap_remat(l, remat_policy)
 

@@ -33,7 +33,7 @@ import time
 from enum import Enum
 from typing import Callable, List, Optional
 
-from . import instrument, metrics, runlog  # noqa: F401 (re-export)
+from . import evidence, instrument, metrics, runlog  # noqa: F401 (re-export)
 from .metrics import (Counter, Gauge, Histogram,  # noqa: F401
                       MetricsRegistry, disable_metrics, enable_metrics,
                       get_registry, metrics_enabled, reset_registry)

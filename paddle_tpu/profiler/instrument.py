@@ -59,6 +59,10 @@ Catalog (names are a stable API — see README "Observability"):
   aot_cache_load_seconds                 deserialize+ready wall time on a hit
   aot_cache_export_seconds               trace+export+publish wall time
   aot_cache_fallbacks_total{reason}      corrupt|chaos|io|deserialize|export|run
+  perf_evidence_rows_total{source}       profiler/evidence.py ledger ingests
+  perf_resolver_decisions_total{flag,status}  flags.apply_perf_config outcomes
+  perf_step_fraction{component}          step-time anatomy (compute|collective|data|host)
+  perf_program_roofline_ratio{program}   intensity / machine balance per program
 """
 from __future__ import annotations
 
@@ -121,6 +125,10 @@ CATALOG = (
     "aot_cache_load_seconds",
     "aot_cache_export_seconds",
     "aot_cache_fallbacks_total",
+    "perf_evidence_rows_total",
+    "perf_resolver_decisions_total",
+    "perf_step_fraction",
+    "perf_program_roofline_ratio",
 )
 
 _enabled = _m._ENABLED  # bind the cell once: hot-path guard is _enabled[0]
@@ -475,6 +483,53 @@ def record_aot_fallback(reason: str) -> None:
                    "AOT cache degraded to fresh/uncached compile "
                    "(corrupt|chaos|io|deserialize|export|run)",
                    labelnames=("reason",)).labels(reason=reason).inc()
+
+
+def record_perf_evidence_rows(source: str, n: int = 1) -> None:
+    """n rows ingested into the perf-evidence ledger from one source."""
+    if not _enabled[0] or not n:
+        return
+    _reg().counter("perf_evidence_rows_total",
+                   "perf-evidence ledger rows ingested by source "
+                   "(probe|bench|bench_serve|bench_session|mfu_lab|"
+                   "autotune|aot_stats|runlog|flight)",
+                   labelnames=("source",)).labels(source=source).inc(n)
+
+
+def record_perf_resolver_decision(flag: str, status: str) -> None:
+    """One apply_perf_config outcome for one flag (status: applied|
+    deferred|env_override|stale|device_mismatch|corrupt)."""
+    if not _enabled[0]:
+        return
+    _reg().counter("perf_resolver_decisions_total",
+                   "perf-config resolver decisions by flag and apply "
+                   "outcome",
+                   labelnames=("flag", "status")).labels(
+        flag=flag, status=status).inc()
+
+
+def record_perf_step_fraction(component: str, fraction: float) -> None:
+    """Step-time anatomy: the fraction of the last attributed step spent
+    in one component (compute|collective|data|host)."""
+    if not _enabled[0]:
+        return
+    _reg().gauge("perf_step_fraction",
+                 "fraction of the last attributed step's wall time by "
+                 "component (compute|collective|data|host)",
+                 labelnames=("component",)).labels(
+        component=component).set(float(fraction))
+
+
+def record_perf_roofline(program: str, ratio: float) -> None:
+    """Roofline position of one program: arithmetic intensity over the
+    device's machine balance (>=1 compute-bound, <1 memory-bound)."""
+    if not _enabled[0]:
+        return
+    _reg().gauge("perf_program_roofline_ratio",
+                 "program arithmetic intensity / device machine balance "
+                 "(>=1: compute-bound)",
+                 labelnames=("program",)).labels(
+        program=program).set(float(ratio))
 
 
 def record_serve_tokens(n: int, step_seconds: float) -> None:

@@ -73,6 +73,28 @@ class RunLog:
                   "peak_flops": peak_flops}
         header.update(meta or {})
         self._write(header)
+        # live perf-evidence stream: when PADDLE_PERF_EVIDENCE names a
+        # ledger (tools/supervise.py threads one per generation), every
+        # step record is appended as a normalized evidence row so the
+        # crash report / resolver read measurements without re-parsing
+        # rank logs. Best-effort: evidence must never break training.
+        self._evidence = None
+        self._device_kind = (meta or {}).get("device_kind") or \
+            (meta or {}).get("device")
+        ev_path = os.environ.get("PADDLE_PERF_EVIDENCE", "").strip()
+        if ev_path:
+            try:
+                from . import evidence as _ev
+                self._evidence = _ev.Ledger(ev_path)
+                self._evidence.append_line(_ev.make_row(
+                    "runlog", "runlog_meta",
+                    {"rank": self.rank, "world": self.world,
+                     "flops_per_step": flops_per_step,
+                     "peak_flops": peak_flops},
+                    file=os.path.basename(self.path),
+                    device_kind=self._device_kind))
+            except Exception:  # noqa: BLE001 — advisory stream only
+                self._evidence = None
 
     def _write(self, rec: Dict) -> None:
         self._f.write(json.dumps(rec) + "\n")
@@ -110,6 +132,18 @@ class RunLog:
                "unix_time": time.time()}
         rec.update(extra)
         self._write(rec)
+        if self._evidence is not None:
+            try:
+                from . import evidence as _ev
+                self._evidence.append_line(_ev.make_row(
+                    "runlog", "train_step",
+                    {k: rec.get(k) for k in
+                     ("step", "step_time_ms", "loss", "tokens",
+                      "tokens_per_s", "mfu")},
+                    file=os.path.basename(self.path),
+                    device_kind=self._device_kind))
+            except Exception:  # noqa: BLE001 — advisory stream only
+                self._evidence = None
         return rec
 
     def mark(self) -> None:

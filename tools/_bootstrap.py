@@ -1,0 +1,25 @@
+"""Shared bare-package bootstrap for jax-free tools.
+
+``bootstrap_pkg()`` registers a bare ``paddle_tpu`` parent package whose
+``__path__`` points at the source tree, so stdlib-only submodules
+(``profiler.evidence``, ``analysis``, ``resilience.*``) import WITHOUT
+executing ``paddle_tpu/__init__.py`` (which imports jax and the whole
+framework). A tool must stay a fork+exec, not a framework import.
+No-op when paddle_tpu is already imported (in-process test use).
+"""
+from __future__ import annotations
+
+import os
+import sys
+import types
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def bootstrap_pkg() -> None:
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    if "paddle_tpu" not in sys.modules:
+        pkg = types.ModuleType("paddle_tpu")
+        pkg.__path__ = [os.path.join(REPO, "paddle_tpu")]
+        sys.modules["paddle_tpu"] = pkg

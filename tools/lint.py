@@ -52,6 +52,8 @@ def _bootstrap_analysis_pkg():
 DEFAULT_PATHS = ["paddle_tpu", "tools", "examples", "tests"]
 BASELINE = os.path.join(REPO, "tools", "lint_baseline.json")
 LAYOUT_BASELINE = os.path.join(REPO, "tools", "layout_baseline.json")
+PERF_CONFIG = os.path.join(REPO, "PERF_CONFIG.json")
+PERF_LEDGER = os.path.join(REPO, "PERF_LEDGER.jsonl")
 
 
 def _load_baseline(path):
@@ -85,6 +87,69 @@ def _print_fix_hints():
     for rid, (name, hint) in sorted(TRACE_RULES.items()):
         print(f"  {rid} {name}")
         print(f"      fix:  {hint}\n")
+
+
+def _perf_config_check(config_path, ledger_path):
+    """Provenance gate for the committed perf config (stdlib-only):
+    every decision in PERF_CONFIG.json must cite evidence-row ids that
+    exist in the committed ledger (PRF501), and every flag it names
+    must exist in the statically-scanned define_flag registry (PRF502);
+    an unreadable config or ledger is itself a finding (PRF503). This
+    is what keeps a flag flip reviewable: the diff always carries the
+    measurement rows that justify it."""
+    from paddle_tpu.analysis.rules import Finding, load_flag_registry
+    from paddle_tpu.profiler import evidence
+
+    findings = []
+
+    def bad(rule, msg, hint):
+        findings.append(Finding(rule, config_path, 0, 0, msg, hint))
+
+    try:
+        with open(config_path) as f:
+            config = json.load(f)
+    except (OSError, ValueError) as e:
+        bad("PRF503", f"perf config unreadable: {e}",
+            "regenerate with tools/perf_resolve.py --build")
+        return findings
+    rows, quarantined = evidence.read_rows(ledger_path)
+    if not rows:
+        bad("PRF503", f"evidence ledger {os.path.basename(ledger_path)} "
+            "is empty or unreadable",
+            "rebuild it with tools/perf_resolve.py --build")
+        return findings
+    ids = {r["id"] for r in rows}
+    flags = load_flag_registry()
+    for dk, entry in sorted((config.get("devices") or {}).items()):
+        sections = [("flags", entry.get("flags") or {}),
+                    ("policies", entry.get("policies") or {}),
+                    ("kernel_blocks", entry.get("kernel_blocks") or {}),
+                    ("window", {"window": entry.get("window") or {}})]
+        for section, decisions in sections:
+            for name, d in sorted(decisions.items()):
+                if not isinstance(d, dict):
+                    continue
+                cited = d.get("evidence") or []
+                if section in ("flags", "policies", "kernel_blocks") \
+                        and not cited:
+                    bad("PRF501",
+                        f"decision {dk}/{section}/{name} cites no "
+                        "evidence rows",
+                        "every decision must carry provenance; re-run "
+                        "tools/perf_resolve.py")
+                for rid in cited:
+                    if rid not in ids:
+                        bad("PRF501",
+                            f"decision {dk}/{section}/{name} cites "
+                            f"evidence id {rid!r} absent from the ledger",
+                            "config and ledger are out of sync; re-run "
+                            "tools/perf_resolve.py --build")
+                if section == "flags" and name not in flags:
+                    bad("PRF502",
+                        f"decision names unknown flag {name!r} for {dk}",
+                        "flags must exist as a define_flag call in the "
+                        "package (see analysis.load_flag_registry)")
+    return findings
 
 
 def _trace_self_check():
@@ -182,6 +247,16 @@ def main(argv=None) -> int:
     ap.add_argument("--schedules", default=None, metavar="DIR",
                     help="check per-rank collective logs recorded via "
                          "PADDLE_SCHEDULE_LOG=DIR")
+    ap.add_argument("--perf-config", default=None, metavar="FILE",
+                    help="perf config to provenance-check against "
+                         "--perf-ledger (default: the committed "
+                         "PERF_CONFIG.json, checked automatically when "
+                         "it exists)")
+    ap.add_argument("--perf-ledger", default=PERF_LEDGER, metavar="FILE",
+                    help="evidence ledger the config must cite "
+                         "(default PERF_LEDGER.jsonl)")
+    ap.add_argument("--no-perf-config", action="store_true",
+                    help="skip the perf-config provenance check")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="machine-readable findings on stdout")
     args = ap.parse_args(argv)
@@ -198,6 +273,13 @@ def main(argv=None) -> int:
              for p in (args.paths or DEFAULT_PATHS)]
     findings = lint_paths(paths)
     n_ast = len(findings)
+
+    # perf-config provenance (stdlib, rides the AST pass): committed
+    # config is checked by default; --perf-config points at another
+    perf_config = args.perf_config or (
+        PERF_CONFIG if os.path.exists(PERF_CONFIG) else None)
+    if perf_config and not args.no_perf_config:
+        findings.extend(_perf_config_check(perf_config, args.perf_ledger))
 
     if not args.no_trace:
         findings.extend(_trace_self_check())

@@ -3,7 +3,13 @@
 the ladder's proven configs) on the live chip, one fresh subprocess each
 (OOM isolation, same rationale as bench._run_parent), and write the
 results table to MFU_LAB_<round>.json. Used to pick ATTEMPT_ORDER and the
-default remat policy from measured data instead of guesses."""
+default remat policy from measured data instead of guesses.
+
+``--evidence[=PATH]`` (or ``--evidence PATH.jsonl``) additionally appends
+each rung to the perf-evidence ledger (default PERF_LEDGER.jsonl) with
+the same atomic tmp+rename write discipline as the results table, so
+``tools/perf_resolve.py`` can turn the remat/batch A/B into a persistent
+per-device policy decision."""
 import json
 import os
 import sys
@@ -13,6 +19,25 @@ HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, HERE)
 
 import bench  # noqa: E402  (bench._sub is the one subprocess runner)
+
+
+def _append_evidence(ledger_path, rnd, results, out_path):
+    """Merge the current results table into the evidence ledger
+    (dedupe-by-id; atomic rewrite). Never raises: the lab's job is the
+    measurement, the ledger is a rider."""
+    try:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from _bootstrap import bootstrap_pkg
+        bootstrap_pkg()
+        from paddle_tpu.profiler import evidence
+        rows = evidence.rows_from_mfu_lab(
+            results, rnd, os.path.basename(out_path))
+        added = evidence.Ledger(ledger_path).merge(rows)
+        if added:
+            print(f"[lab] evidence: +{added} row(s) -> {ledger_path}",
+                  flush=True)
+    except Exception as e:  # noqa: BLE001 — evidence must not kill a run
+        print(f"[lab] evidence append failed: {e}", flush=True)
 
 
 def run_tag(tag, timeout=2700, env_extra=None):
@@ -33,8 +58,24 @@ def _save(out_path, results):
 
 
 def main():
-    rnd = sys.argv[1] if len(sys.argv) > 1 else "r04"
-    tags = sys.argv[2:]
+    argv = list(sys.argv[1:])
+    evidence_path = None
+    for i, a in enumerate(argv):
+        if a == "--evidence" or a.startswith("--evidence="):
+            if "=" in a:
+                evidence_path = a.split("=", 1)[1]
+                del argv[i]
+            elif i + 1 < len(argv) and argv[i + 1].endswith(".jsonl"):
+                # space-separated path form; a bare --evidence followed
+                # by a round tag/bench tag keeps the repo-root default
+                evidence_path = argv[i + 1]
+                del argv[i:i + 2]
+            else:
+                evidence_path = os.path.join(HERE, "PERF_LEDGER.jsonl")
+                del argv[i]
+            break
+    rnd = argv[0] if argv else "r04"
+    tags = argv[1:]
     if not tags:
         tags = ["llama-0.5b-b8", "llama-1.1b-b8", "llama-1.1b-b4",
                 *bench.LAB_TAGS]
@@ -67,6 +108,8 @@ def main():
                                             bool(env_extra)},
                                   "from": "bench_session"}
             _save(out_path, results)
+            if evidence_path:
+                _append_evidence(evidence_path, rnd, results, out_path)
         except (OSError, json.JSONDecodeError, AttributeError):
             pass
 
@@ -85,6 +128,8 @@ def main():
             res.setdefault("extra", {})["pallas_fused"] = True
         results[tag] = res
         _save(out_path, results)
+        if evidence_path:
+            _append_evidence(evidence_path, rnd, results, out_path)
         mfu = res.get("extra", {}).get("mfu")
         err = str(res.get("error") or res.get("extra", {}).get("error"))
         print(f"[lab] {tag}: tps={res.get('value')} mfu={mfu} "

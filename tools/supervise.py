@@ -120,6 +120,86 @@ def _flight_dump(env: dict, since: float) -> object:
         return {"unparseable": path}
 
 
+def _perf_report(env: dict, since: float) -> object:
+    """Inline the generation's perf-evidence summary into the crash
+    report: row counts by source from the per-generation ledger
+    (PADDLE_PERF_EVIDENCE, appended live by RunLog), the MFU attribution
+    of the last completed step (wall time joined with the generation's
+    AOT cost_analysis stats), and the resolver decisions in effect
+    (PADDLE_PERF_CONFIG). Same stale-mtime guard as _metrics_dump: a
+    ledger not touched since this attempt started belongs to a previous
+    generation. Never raises — a perf summary must not break the
+    postmortem that carries it."""
+    try:
+        from paddle_tpu.profiler import evidence
+    except Exception:  # noqa: BLE001 — summary is advisory
+        return None
+    out = {}
+    path = env.get("PADDLE_PERF_EVIDENCE", "")
+    rows = []
+    if path and os.path.exists(path):
+        try:
+            if os.path.getmtime(path) >= since:
+                rows, quarantined = evidence.read_rows(path)
+                by_source = {}
+                for row in rows:
+                    by_source[row["source"]] = \
+                        by_source.get(row["source"], 0) + 1
+                out["evidence"] = {"path": path, "rows": len(rows),
+                                   "quarantined": len(quarantined),
+                                   "by_source": by_source}
+        except OSError:
+            out["evidence"] = {"unparseable": path}
+    # last completed step -> anatomy (needs the aot stats' program costs)
+    try:
+        steps = [r for r in rows if r.get("kind") == "train_step"
+                 and (r.get("data") or {}).get("step_time_ms")]
+        metas = [r for r in rows if r.get("kind") == "runlog_meta"]
+        stats_path = env.get("PADDLE_AOT_STATS", "")
+        costs = {}
+        device_kind = None
+        if stats_path and os.path.exists(stats_path) and \
+                os.path.getmtime(stats_path) >= since:
+            for row in evidence.ingest_aot_stats(stats_path):
+                if (row["data"] or {}).get("cost"):
+                    costs[row["data"]["program"]] = row["data"]["cost"]
+                device_kind = device_kind or row.get("device_kind")
+        if steps:
+            last = steps[-1]["data"]
+            peak = None
+            if metas:
+                peak = (metas[-1]["data"] or {}).get("peak_flops")
+            peak = peak or evidence.peak_flops_for_kind(device_kind)
+            entry = {"step": last.get("step"),
+                     "step_time_ms": last.get("step_time_ms"),
+                     "mfu": last.get("mfu")}
+            if costs and peak:
+                entry["attribution"] = evidence.attribute_step(
+                    last["step_time_ms"] / 1000.0, costs, peak,
+                    evidence.peak_bytes_for_kind(device_kind))
+            out["last_step"] = entry
+    except Exception:  # noqa: BLE001 — summary is advisory
+        pass
+    # resolver decisions in effect (committed input: no mtime guard)
+    cfg_path = env.get("PADDLE_PERF_CONFIG", "")
+    if cfg_path and os.path.exists(cfg_path):
+        try:
+            with open(cfg_path) as f:
+                cfg = json.load(f)
+            out["perf_config"] = {
+                "path": cfg_path,
+                "devices": {
+                    dk: {name: d.get("value")
+                         for name, d in sorted(
+                             (entry.get("flags") or {}).items())}
+                    for dk, entry in sorted(
+                        (cfg.get("devices") or {}).items())},
+            }
+        except (OSError, json.JSONDecodeError, AttributeError):
+            out["perf_config"] = {"unparseable": cfg_path}
+    return out or None
+
+
 def _aot_report(stats_path: str, spawn_wall: float) -> object:
     """Summarize the worker's AOT cache stats file (PADDLE_AOT_STATS,
     rewritten atomically by paddle_tpu.aot.cache on every program-ready
@@ -211,6 +291,10 @@ class Supervisor:
             # dump is inlined into this generation's crash report
             env.setdefault("PADDLE_SERVE_FLIGHT", os.path.join(
                 self.report_dir, f"flight_{self.generation}.json"))
+            # per-generation perf-evidence ledger (RunLog appends step
+            # rows live); inlined as the crash report's perf summary
+            env.setdefault("PADDLE_PERF_EVIDENCE", os.path.join(
+                self.report_dir, f"evidence_{self.generation}.jsonl"))
         return env
 
     def _aot_stats_path(self) -> str:
@@ -253,6 +337,7 @@ class Supervisor:
             "metrics": _metrics_dump(env, wall0),
             "aot": _aot_report(env.get("PADDLE_AOT_STATS", ""), wall0),
             "flight": _flight_dump(env, wall0),
+            "perf": _perf_report(env, wall0),
         }
         if isinstance(report["aot"], dict):
             report["cold_start_seconds"] = \
