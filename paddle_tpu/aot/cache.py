@@ -99,7 +99,8 @@ def aot_stats() -> Dict[str, Any]:
 
 def _note_event(name: str, event: str, seconds: float = 0.0,
                 reason: Optional[str] = None,
-                cost: Optional[Dict[str, float]] = None) -> None:
+                cost: Optional[Dict[str, float]] = None,
+                mem: Optional[Dict[str, float]] = None) -> None:
     with _STATS_LOCK:
         prog = _STATS["programs"].setdefault(
             name, {"hits": 0, "misses": 0, "fallbacks": 0,
@@ -121,6 +122,12 @@ def _note_event(name: str, event: str, seconds: float = 0.0,
             # meta on hits — the MFU-attribution evidence the perf
             # config resolver (ROADMAP item 1) reads per program
             prog["cost"] = dict(cost)
+        if mem:
+            # compiled memory_analysis (temp/argument/output bytes):
+            # same discipline — computed once at export, restored from
+            # artifact meta on hits, the static side of the per-chip
+            # budget breakdown tools/mem_report.py renders
+            prog["mem"] = dict(mem)
         # "ready" marks first-program readiness WITHOUT counting: the
         # uncached-jit rung must not inflate the miss counter, which is
         # documented as "traced+exported fresh (published)"
@@ -158,33 +165,59 @@ def resolve_store(cache=None, keep: int = 16) -> Optional[ArtifactStore]:
     return ArtifactStore(str(cache), keep=keep)
 
 
-def _cost_analysis(jitted, avals) -> Optional[Dict[str, float]]:
-    """XLA's per-program cost model (flops, bytes accessed) for the
+def _program_stats(jitted, avals) -> Tuple[Optional[Dict[str, float]],
+                                           Optional[Dict[str, float]]]:
+    """(cost, mem): XLA's per-program cost model (flops, bytes accessed)
+    and compiled memory footprint (temp / argument / output /
+    generated-code bytes — ``Compiled.memory_analysis()``) for the
     traced function over abstract inputs. Best-effort: any backend or
-    version that cannot answer returns None rather than failing the
-    export — the numbers are evidence, not a dependency.
+    version that cannot answer a half returns None for it rather than
+    failing the export — the numbers are evidence, not a dependency.
 
-    Costs one extra trace+lower of ``jitted`` (jax.export consumed its
-    own), so callers only invoke this when a PADDLE_AOT_STATS consumer
-    is actually configured — a cache miss on a large training step must
-    not pay double tracing for numbers nobody reads."""
+    Costs one extra trace+lower (+compile for the memory half) of
+    ``jitted`` (jax.export consumed its own), so callers only invoke
+    this when a PADDLE_AOT_STATS consumer is actually configured — a
+    cache miss on a large training step must not pay double
+    tracing/compilation for numbers nobody reads. Both halves share ONE
+    lowering."""
+    cost = mem = None
     try:
-        costs = jitted.lower(*avals).cost_analysis()
+        lowered = jitted.lower(*avals)
+    except Exception:  # noqa: BLE001 — stats are never load-bearing
+        logger.debug("aot: lower for program stats unavailable",
+                     exc_info=True)
+        return None, None
+    try:
+        costs = lowered.cost_analysis()
         if isinstance(costs, (list, tuple)):
             costs = costs[0] if costs else None
-        if not isinstance(costs, dict):
-            return None
-        out = {}
-        for key, label in (("flops", "flops"),
-                           ("bytes accessed", "bytes_accessed"),
-                           ("transcendentals", "transcendentals")):
-            v = costs.get(key)
-            if v is not None:
-                out[label] = float(v)
-        return out or None
+        if isinstance(costs, dict):
+            out = {}
+            for key, label in (("flops", "flops"),
+                               ("bytes accessed", "bytes_accessed"),
+                               ("transcendentals", "transcendentals")):
+                v = costs.get(key)
+                if v is not None:
+                    out[label] = float(v)
+            cost = out or None
     except Exception:  # noqa: BLE001 — cost numbers are never load-bearing
         logger.debug("aot: cost_analysis unavailable", exc_info=True)
-        return None
+    try:
+        ma = lowered.compile().memory_analysis()
+        out = {}
+        for attr, label in (("temp_size_in_bytes", "temp_bytes"),
+                            ("argument_size_in_bytes", "argument_bytes"),
+                            ("output_size_in_bytes", "output_bytes"),
+                            ("alias_size_in_bytes", "alias_bytes"),
+                            ("generated_code_size_in_bytes",
+                             "generated_code_bytes")):
+            v = getattr(ma, attr, None)
+            if v is not None:
+                out[label] = float(v)
+        mem = out or None
+    except Exception:  # noqa: BLE001 — mem numbers are never load-bearing
+        logger.debug("aot: memory_analysis unavailable", exc_info=True)
+    return cost, mem
 
 
 def _fallback_reason(exc: BaseException) -> str:
@@ -281,7 +314,8 @@ class CachedProgram:
             self.stats["hits"] += 1
             _instr.record_aot_cache_hit(self.name)
             _instr.record_aot_load(dt)
-            _note_event(self.name, "hit", dt, cost=meta.get("cost"))
+            _note_event(self.name, "hit", dt, cost=meta.get("cost"),
+                        mem=meta.get("mem"))
             if self._on_hit_meta is not None:
                 self._on_hit_meta(meta.get("extra") or {})
             logger.info("aot: %s hit %s (%.3fs)", self.name, key[:12], dt)
@@ -306,20 +340,23 @@ class CachedProgram:
             flat_avals = avals if isinstance(avals, tuple) else tuple(avals)
             exported = jexport.export(jitted)(*flat_avals)
             payload = exported.serialize()
-            cost = _cost_analysis(jitted, flat_avals) \
-                if os.environ.get(ENV_STATS, "").strip() else None
+            cost, mem = (_program_stats(jitted, flat_avals)
+                         if os.environ.get(ENV_STATS, "").strip()
+                         else (None, None))
             meta = {"components": components, "avals": sig,
                     "extra": (self._extra_meta_fn() if self._extra_meta_fn
                               else {})}
             if cost:
                 meta["cost"] = cost
+            if mem:
+                meta["mem"] = mem
             self.store.put(key, payload, meta, name=self.name)
             call = self._loaded_wrapper(exported)
             dt = time.monotonic() - t0
             self.stats["misses"] += 1
             _instr.record_aot_cache_miss(self.name)
             _instr.record_aot_export(dt)
-            _note_event(self.name, "miss", dt, cost=cost)
+            _note_event(self.name, "miss", dt, cost=cost, mem=mem)
             logger.info("aot: %s exported %s (%.3fs, %dB)", self.name,
                         key[:12], dt, len(payload))
             return _Entry(call, loaded=False, key=key, meta=meta)

@@ -85,6 +85,10 @@ class cuda:
     def max_memory_reserved(device=None):
         return max_memory_reserved(device)
 
+    @staticmethod
+    def reset_peak_memory_stats(device=None):
+        return reset_peak_memory_stats(device)
+
 
 def synchronize(device=None):
     """Block until all queued device work completes."""
@@ -100,17 +104,60 @@ def synchronize(device=None):
 # TPU-native: XLA owns allocation; PJRT exposes per-device counters via
 # Device.memory_stats() (bytes_in_use, peak_bytes_in_use, bytes_limit, ...).
 
-def _mem_stats(device=None) -> dict:
-    idx = 0
+def _device_index(device=None) -> int:
     if isinstance(device, int):
-        idx = device
-    elif isinstance(device, str) and ":" in device:
-        idx = int(device.rsplit(":", 1)[1])
-    d = _devices()[idx]
+        return device
+    if isinstance(device, str) and ":" in device:
+        return int(device.rsplit(":", 1)[1])
+    return 0
+
+
+def _mem_stats(device=None) -> dict:
+    d = _devices()[_device_index(device)]
     try:
         return d.memory_stats() or {}
     except Exception:
         return {}
+
+
+# Resettable peak overlay (reference stats.h STAT_ResetPeakValue /
+# paddle.device.cuda.reset_peak_memory_stats): PJRT's peak counters are
+# monotone for the process, so after a reset the peak is tracked HERE —
+# the running max of bytes_in_use observed at each stats poll since the
+# reset. Polled, not hooked: allocations between polls can exceed the
+# reported peak (documented approximation; profiler/memwatch.py polls
+# every step, which bounds the gap to within-step churn).
+_PEAK_RESET: dict = {}  # device index -> running max since reset
+
+
+def _note_peak(device, bytes_in_use: int) -> None:
+    idx = _device_index(device)
+    if idx in _PEAK_RESET:
+        _PEAK_RESET[idx] = max(_PEAK_RESET[idx], int(bytes_in_use))
+
+
+def reset_peak_memory_stats(device=None) -> None:
+    """Reset the peak-allocated counter to the CURRENT allocation
+    (reference-API parity). Subsequent ``max_memory_allocated`` /
+    ``max_memory_reserved`` report the max observed at stats polls since
+    this call, letting per-phase peaks be measured."""
+    idx = _device_index(device)
+    s = _mem_stats(device)
+    current = int(s.get("bytes_in_use", 0)) or live_array_bytes()
+    _PEAK_RESET[idx] = current
+
+
+def live_array_bytes() -> int:
+    """CPU fallback for backends whose PJRT devices report no allocator
+    counters: the sum of ``jax.live_arrays()`` sizes by shape×dtype.
+    Committed (undonated/undeleted) buffers only — a close analog of
+    bytes_in_use for the host-memory backend."""
+    total = 0
+    for a in jax.live_arrays():
+        n = getattr(a, "nbytes", None)
+        if isinstance(n, (int, float)):
+            total += int(n)
+    return total
 
 
 def memory_allocated(device=None) -> int:
@@ -120,8 +167,18 @@ def memory_allocated(device=None) -> int:
 
 
 def max_memory_allocated(device=None) -> int:
-    """Peak allocated bytes (stats.h STAT_GetPeakValue analog)."""
+    """Peak allocated bytes (stats.h STAT_GetPeakValue analog).
+    After ``reset_peak_memory_stats`` this is the poll-observed max
+    since the reset, not the process-lifetime PJRT peak."""
+    idx = _device_index(device)
     s = _mem_stats(device)
+    if idx in _PEAK_RESET:
+        # same fallback as the reset path: a backend with no allocator
+        # counters (CPU PJRT) polls live-array bytes, otherwise the
+        # post-reset peak would freeze at the reset-time value
+        current = int(s.get("bytes_in_use", 0)) or live_array_bytes()
+        _note_peak(device, current)
+        return _PEAK_RESET[idx]
     return int(s.get("peak_bytes_in_use", s.get("bytes_in_use", 0)))
 
 
@@ -133,6 +190,9 @@ def memory_reserved(device=None) -> int:
 
 
 def max_memory_reserved(device=None) -> int:
+    idx = _device_index(device)
+    if idx in _PEAK_RESET:
+        return max_memory_allocated(device)
     s = _mem_stats(device)
     return int(s.get("peak_bytes_reserved",
                      s.get("peak_bytes_in_use", s.get("bytes_in_use", 0))))

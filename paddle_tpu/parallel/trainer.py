@@ -173,11 +173,19 @@ class SpmdTrainer:
                  zero_stage: Optional[int] = None,
                  remat_policy: Optional[str] = None,
                  accumulate_steps: int = 1,
-                 aot_cache=None):
+                 aot_cache=None, memwatch=None):
         self.model = model
         self.opt = optimizer
         self.loss_fn = loss_fn
         self.mesh = mesh
+        # memory observability plane (profiler/memwatch.py): True/
+        # MemWatchConfig/MemoryWatcher arms per-step device-memory
+        # snapshots attributed into params/optimizer pools, False
+        # disarms, None defers to PADDLE_MEMWATCH / PADDLE_MEMWATCH_DUMP
+        # (disarmed = one `is None` check per step)
+        from ..profiler.memwatch import resolve_watcher
+        self.memwatch = resolve_watcher(memwatch)
+        self._mem_pools_tagged = False
         # persistent AOT program cache (paddle_tpu.aot): a path or
         # ArtifactStore enables export/restore of the compiled step,
         # False disables, None defers to the PADDLE_AOT_CACHE env the
@@ -634,7 +642,23 @@ class SpmdTrainer:
         self._opt_state = new_state
         self.opt._global_step = self._step_count
         self._last_loss = loss
+        if self.memwatch is not None:
+            if not self._mem_pools_tagged:
+                self._tag_mem_pools()
+            self.memwatch.snapshot(step=self._step_count)
         return Tensor(loss)
+
+    def _tag_mem_pools(self):
+        """Register the trainer's array families with the memory watcher
+        (profiler/memwatch.py): providers read the LIVE state each
+        snapshot, so params updated to fresh arrays every step stay
+        attributed without the watcher pinning stale buffers."""
+        self.memwatch.register_pool(
+            "params", lambda: [self._params[n]._data
+                               for n in self._param_list])
+        self.memwatch.register_pool(
+            "optimizer", lambda: self._opt_state or {})
+        self._mem_pools_tagged = True
 
     def block(self):
         """Barrier on all dispatched steps.

@@ -33,7 +33,10 @@ import time
 from enum import Enum
 from typing import Callable, List, Optional
 
-from . import evidence, instrument, metrics, runlog  # noqa: F401 (re-export)
+from . import evidence, instrument, memwatch, metrics  # noqa: F401
+from . import runlog  # noqa: F401 (re-export)
+from .memwatch import (MemoryWatcher, MemWatchConfig,  # noqa: F401
+                       resolve_watcher)
 from .metrics import (Counter, Gauge, Histogram,  # noqa: F401
                       MetricsRegistry, disable_metrics, enable_metrics,
                       get_registry, metrics_enabled, reset_registry)

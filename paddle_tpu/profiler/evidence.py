@@ -6,7 +6,8 @@ for one consumer: probe ladders (``PROBE_*.json``, including the
 (``BENCH_*.json`` / ``BENCH_SERVE_*.json`` / ``BENCH_SESSION_*.json``),
 ``tools/mfu_lab.py`` tables, the kernel-autotune disk cache, the AOT
 cache's per-program XLA ``cost_analysis`` stats (``PADDLE_AOT_STATS``),
-per-rank runlogs, and the serving flight recorder's step plans. This
+per-rank runlogs, the serving flight recorder's step plans, and the
+memory watcher's ring dumps (``profiler/memwatch.py``). This
 module normalizes all of them into ONE schema-versioned JSONL ledger so
 the profile-guided resolver (``tools/perf_resolve.py``) reads evidence
 instead of re-profiling, and every flag decision can cite the row ids
@@ -60,7 +61,8 @@ __all__ = [
     "SCHEMA_VERSION", "SOURCES", "Ledger", "read_rows", "row_id",
     "make_row", "ingest_probe", "ingest_bench", "ingest_bench_serve",
     "ingest_bench_session", "ingest_mfu_lab", "ingest_autotune",
-    "ingest_aot_stats", "ingest_runlog", "ingest_flight", "ingest_path",
+    "ingest_aot_stats", "ingest_runlog", "ingest_flight", "ingest_mem",
+    "ingest_path",
     "scan_repo", "build_ledger", "round_order", "roofline",
     "attribute_step", "PEAK_BYTES_PER_S", "peak_flops_for_kind",
     "device_identity",
@@ -70,7 +72,7 @@ SCHEMA_VERSION = 1
 
 #: every source tag a row may carry (perf_evidence_rows_total{source})
 SOURCES = ("probe", "bench", "bench_serve", "bench_session", "mfu_lab",
-           "autotune", "aot_stats", "runlog", "flight")
+           "autotune", "aot_stats", "runlog", "flight", "mem")
 
 # -- peak tables (documented approximations; bench.py owns the flops side) ----
 #: bf16 peak FLOP/s by device-kind substring (mirrors bench.peak_flops_per_chip
@@ -617,6 +619,11 @@ def ingest_aot_stats(path: str, device_kind: Optional[str] = None
                 "fallbacks": prog.get("fallbacks"),
                 "cost": dict(prog["cost"]) if isinstance(prog.get("cost"),
                                                          dict) else None}
+        if isinstance(prog.get("mem"), dict):
+            # static memory footprint (aot/cache.py memory_analysis) —
+            # added ONLY when present so pre-mem artifacts keep their
+            # content-addressed row ids (ledger stability across rebuilds)
+            data["mem"] = dict(prog["mem"])
         rows.append(make_row("aot_stats", "program_cost", data, file=base,
                              rnd=rnd, ok=data["cost"] is not None,
                              device_kind=dk, mtime_utc=mt))
@@ -696,6 +703,34 @@ def ingest_flight(path: str) -> List[Dict[str, Any]]:
                      mtime_utc=_mtime_utc(path))]
 
 
+def ingest_mem(path: str) -> List[Dict[str, Any]]:
+    """Memory-watcher dumps (profiler/memwatch.py): one ``mem_snapshot``
+    row summarizing the ring — why the dump fired, the last snapshot's
+    pool split, and the high watermarks. ``tools/mem_report.py`` joins
+    these with the AOT ``memory_analysis`` rows into the per-chip
+    budget breakdown. An anomaly-triggered dump (near_oom) ingests
+    ``ok: false`` — pressure is failure evidence, same convention as
+    the serving flight recorder's rows."""
+    doc = _load_json(path)
+    if not isinstance(doc, dict) or doc.get("kind") != "memwatch" or \
+            "steps" not in doc:
+        return []
+    steps = doc.get("steps") or []
+    last = steps[-1] if steps else None
+    data = {"reason": doc.get("reason"),
+            "detail": doc.get("detail"),
+            "buffered_steps": len(steps),
+            "last": last,
+            "watermarks": doc.get("watermarks"),
+            "counters": doc.get("counters")}
+    return [make_row("mem", "mem_snapshot", data,
+                     file=os.path.basename(path),
+                     rnd=_round_from_name(path),
+                     ok=doc.get("reason") == "manual",
+                     device_kind=doc.get("device_kind"),
+                     mtime_utc=_mtime_utc(path))]
+
+
 #: (glob pattern, ingestor) in scan order. BENCH_SESSION must come before
 #: the BENCH_r* pattern would otherwise swallow it.
 _SCAN = (
@@ -710,6 +745,8 @@ _SCAN = (
     ("runlog_rank*.jsonl", ingest_runlog),
     ("flight_*.json", ingest_flight),
     ("FLIGHT_*.json", ingest_flight),
+    ("memwatch_*.json", ingest_mem),
+    ("MEM_WATCH_*.json", ingest_mem),
 )
 
 

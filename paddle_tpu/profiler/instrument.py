@@ -63,6 +63,11 @@ Catalog (names are a stable API — see README "Observability"):
   perf_resolver_decisions_total{flag,status}  flags.apply_perf_config outcomes
   perf_step_fraction{component}          step-time anatomy (compute|collective|data|host)
   perf_program_roofline_ratio{program}   intensity / machine balance per program
+  mem_bytes_in_use{pool}                 profiler/memwatch.py pool split + total
+  mem_peak_bytes{pool}                   per-pool high watermarks (resettable)
+  mem_watermark_fraction                 bytes_in_use / bytes_limit (0..1)
+  mem_pressure_dumps_total{trigger}      memwatch ring dumps (near_oom|manual)
+  serve_kv_pool_bytes                    device bytes of live sequences' KV pages
 """
 from __future__ import annotations
 
@@ -129,6 +134,11 @@ CATALOG = (
     "perf_resolver_decisions_total",
     "perf_step_fraction",
     "perf_program_roofline_ratio",
+    "mem_bytes_in_use",
+    "mem_peak_bytes",
+    "mem_watermark_fraction",
+    "mem_pressure_dumps_total",
+    "serve_kv_pool_bytes",
 )
 
 _enabled = _m._ENABLED  # bind the cell once: hot-path guard is _enabled[0]
@@ -530,6 +540,52 @@ def record_perf_roofline(program: str, ratio: float) -> None:
                  "(>=1: compute-bound)",
                  labelnames=("program",)).labels(
         program=program).set(float(ratio))
+
+
+def record_mem_bytes_in_use(pool: str, nbytes: int) -> None:
+    """Current device bytes attributed to one memwatch pool (params|
+    optimizer|kv_pages|workspace|other|total)."""
+    if not _enabled[0]:
+        return
+    _reg().gauge("mem_bytes_in_use",
+                 "device bytes currently attributed to a memwatch pool "
+                 "(params|optimizer|kv_pages|workspace|other|total)",
+                 labelnames=("pool",)).labels(pool=pool).set(float(nbytes))
+
+
+def record_mem_peak_bytes(pool: str, nbytes: int) -> None:
+    if not _enabled[0]:
+        return
+    _reg().gauge("mem_peak_bytes",
+                 "high-watermark device bytes per memwatch pool "
+                 "(resettable via reset_watermarks)",
+                 labelnames=("pool",)).labels(pool=pool).set(float(nbytes))
+
+
+def record_mem_watermark_fraction(fraction: float) -> None:
+    if not _enabled[0]:
+        return
+    _reg().gauge("mem_watermark_fraction",
+                 "bytes_in_use / bytes_limit of the last memory snapshot "
+                 "(near-OOM trigger input, 0..1)").set(float(fraction))
+
+
+def record_mem_pressure_dump(trigger: str) -> None:
+    if not _enabled[0]:
+        return
+    _reg().counter("mem_pressure_dumps_total",
+                   "memwatch ring dumps by trigger (near_oom|manual)",
+                   labelnames=("trigger",)).labels(trigger=trigger).inc()
+
+
+def record_serve_kv_pool_bytes(nbytes: int) -> None:
+    """Device bytes held by live sequences' KV pages (used pages x
+    per-page bytes across both K and V pools)."""
+    if not _enabled[0]:
+        return
+    _reg().gauge("serve_kv_pool_bytes",
+                 "device bytes of KV pages held by live sequences "
+                 "(used pages x per-page K+V bytes)").set(float(nbytes))
 
 
 def record_serve_tokens(n: int, step_seconds: float) -> None:

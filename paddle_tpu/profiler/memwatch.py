@@ -1,0 +1,459 @@
+"""Memory observability plane: device-memory ledger + near-OOM flight trigger.
+
+The perf-evidence plane (PR 10) answers "where did the time go"; this
+module answers **"where did the HBM go"** — the binding constraint behind
+every memory-shaped failure the stack can hit: a remat/batch rung that
+OOMs mid-campaign, a KV pool sized one page too greedy, a ZeRO layout
+whose optimizer state quietly replicated. Three layers share one
+``MemoryWatcher`` object wired through the SpmdTrainer and ServingEngine
+seams:
+
+  * **Device-memory ledger** — per-step snapshots of the accelerator's
+    allocator counters (``bytes_in_use`` / ``peak_bytes_in_use`` /
+    ``bytes_limit`` from PJRT ``Device.memory_stats()``, read through
+    ``paddle_tpu.device``), with a CPU fallback that sums
+    ``jax.live_arrays()`` by shape×dtype when the backend reports no
+    counters. Each snapshot is attributed into **named pools** via
+    lightweight array tagging: integration seams register a pool name
+    with a zero-arg provider returning the live pytree (params,
+    optimizer state, KV pages), the watcher sums leaf ``nbytes`` per
+    pool, and whatever the pools cannot explain lands in ``other``
+    (workspace, XLA temp buffers, untagged arrays). Snapshots live in a
+    bounded ring (``deque(maxlen)``) with per-pool high watermarks.
+
+  * **Near-OOM flight trigger** — when ``bytes_in_use / bytes_limit``
+    crosses the configured high-watermark fraction, the ring dumps to
+    JSON through the same machinery as the PR 9 serving flight recorder:
+    latched once per reason (one pressure event = one postmortem, not a
+    dump storm), the dump names the pool whose **growth since the first
+    snapshot** is largest (what *filled* the chip, not what merely sat
+    on it), and the whole snapshot+dump path can NEVER raise into the
+    driver — ``mem.snapshot`` is a chaos site drilling exactly that
+    (``tools/chaos_drill.py --mem``).
+
+  * **Watermark accounting** — per-pool and overall peaks, resettable
+    (``reset_watermarks()`` also resets the device-level peak counters
+    via ``device.reset_peak_memory_stats()``) so per-phase peaks — warm
+    start vs steady state, prefill vs decode — are measurable.
+
+Gate discipline (same as PR 1/PR 9): the plane is DISARMED by default —
+integrations hold ``memwatch=None`` and every instrumented seam costs
+one ``is None`` check (microbench-pinned). Arm per object with
+``SpmdTrainer(memwatch=True | MemWatchConfig(...))`` /
+``EngineConfig(memwatch=...)`` or globally with ``PADDLE_MEMWATCH=1``;
+``PADDLE_MEMWATCH_DUMP=<file>`` names the pressure-dump file (also arms
+— ``tools/supervise.py`` threads a per-generation path and inlines the
+dump into crash reports) and ``PADDLE_MEMWATCH_WATERMARK`` overrides the
+trigger fraction. jax is imported lazily inside snapshot paths so the
+module stays importable through the jax-free tools bootstrap.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+from ..resilience import chaos
+from . import instrument as _instr
+
+logger = logging.getLogger(__name__)
+
+ENV_MEMWATCH = "PADDLE_MEMWATCH"
+ENV_DUMP = "PADDLE_MEMWATCH_DUMP"
+ENV_WATERMARK = "PADDLE_MEMWATCH_WATERMARK"
+
+#: canonical pool names the integrations register (metric label values);
+#: ``other`` is computed, never registered: bytes_in_use minus the tagged
+#: pools — workspace, XLA temps, and anything nobody claimed.
+POOLS = ("params", "optimizer", "kv_pages", "workspace")
+
+_TRUTHY = ("1", "true", "on", "yes")
+
+
+def tree_bytes(tree) -> int:
+    """Sum of per-leaf device bytes over a pytree of arrays. Works on
+    jax arrays, numpy arrays and ShapeDtypeStructs (``nbytes`` first,
+    shape×itemsize fallback); non-array leaves count 0."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        n = getattr(leaf, "nbytes", None)
+        if isinstance(n, (int, float)):
+            total += int(n)
+            continue
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            continue
+        size = 1
+        for d in shape:
+            size *= int(d)
+        total += size * int(getattr(dtype, "itemsize", None)
+                            or _dtype_itemsize(dtype))
+    return total
+
+
+def _dtype_itemsize(dtype) -> int:
+    import numpy as np
+    try:
+        return int(np.dtype(dtype).itemsize)
+    except TypeError:
+        return 0
+
+
+def _atomic_json(path: str, payload, indent: Optional[int] = None) -> None:
+    """tmp-write + rename so readers (supervise, serve_top) never see a
+    torn dump; the orphaned tmp is removed if the dump itself fails."""
+    tmp = f"{path}.tmp-{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(payload, f, indent=indent)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+class MemWatchConfig:
+    """Knobs for one memory watcher.
+
+    ring_steps bounds the snapshot ring; watermark is the near-OOM
+    trigger fraction of ``bytes_limit`` (default 0.92, or the
+    ``PADDLE_MEMWATCH_WATERMARK`` env); dump_path defaults to the
+    ``PADDLE_MEMWATCH_DUMP`` env; limit_bytes overrides the device's
+    reported ``bytes_limit`` — the ONLY way to exercise the pressure
+    trigger on a backend (CPU) that reports no limit, and a way to
+    enforce a tighter budget than the physical HBM on real silicon;
+    stats_fn replaces the device-counter read entirely (a zero-arg
+    callable returning the stats dict) — the deterministic-pressure
+    hook ``tools/chaos_drill.py --mem`` and the tests drive, immune to
+    whatever else the process has live."""
+
+    def __init__(self, ring_steps: int = 256,
+                 watermark: Optional[float] = None,
+                 dump_path: Optional[str] = None,
+                 limit_bytes: Optional[int] = None,
+                 device: int = 0,
+                 stats_fn: Optional[Callable[[], Dict[str, Any]]] = None):
+        if ring_steps < 1:
+            raise ValueError(f"ring_steps must be >= 1, got {ring_steps}")
+        if watermark is None:
+            env = os.environ.get(ENV_WATERMARK, "").strip()
+            try:
+                watermark = float(env) if env else 0.92
+            except ValueError:
+                watermark = 0.92
+        if not 0.0 < watermark <= 1.0:
+            raise ValueError(
+                f"watermark must be a fraction in (0, 1], got {watermark}")
+        self.ring_steps = int(ring_steps)
+        self.watermark = float(watermark)
+        self.dump_path = dump_path
+        self.limit_bytes = int(limit_bytes) if limit_bytes else None
+        self.device = int(device)
+        self.stats_fn = stats_fn
+
+
+class MemoryWatcher:
+    """The armed memory-observability plane for one trainer or engine.
+
+    Snapshot hooks are called by the integration under its own lock
+    (trainer step / engine step); the watcher's RLock additionally
+    protects concurrent ``telemetry()`` / ``dump()`` readers on other
+    threads. Lock order is always integration -> watcher, never the
+    reverse."""
+
+    def __init__(self, config: Optional[MemWatchConfig] = None):
+        cfg = config or MemWatchConfig()
+        self.config = cfg
+        self.armed = True
+        self._lock = threading.RLock()
+        # one (monotonic, wall) instant pair: every exported timestamp
+        # derives from it, so the chaos-probed snapshot/dump path never
+        # reads a jumpable clock (TPU201 discipline, same as serving/obs)
+        self._anchor_mono = time.monotonic()
+        self._anchor_wall = time.time()
+        self._ring: "deque[dict]" = deque(maxlen=cfg.ring_steps)
+        self._pools: Dict[str, Callable[[], Any]] = {}
+        self._baseline: Optional[Dict[str, int]] = None  # first snapshot
+        self.watermarks: Dict[str, Any] = {
+            "peak_bytes_in_use": 0, "peak_fraction": 0.0, "pools": {}}
+        self.snapshots = 0
+        self.snapshot_failures = 0
+        self._latched: set = set()
+        self.dumps: List[Dict[str, Any]] = []
+        self.dump_failures = 0
+        self.dump_path = cfg.dump_path if cfg.dump_path is not None \
+            else (os.environ.get(ENV_DUMP, "").strip() or None)
+        self._identity: Optional[tuple] = None
+
+    # -- clock ----------------------------------------------------------------
+    def _wall(self, mono: float) -> float:
+        return self._anchor_wall + (mono - self._anchor_mono)
+
+    # -- pool tagging ---------------------------------------------------------
+    def register_pool(self, name: str,
+                      provider: Callable[[], Any]) -> None:
+        """Tag a named pool: ``provider`` is a zero-arg callable returning
+        the pool's CURRENT pytree of arrays (called at every snapshot, so
+        a trainer whose params are fresh arrays each step stays
+        attributed without the watcher holding stale references)."""
+        if not callable(provider):
+            raise TypeError(f"pool {name!r} provider must be callable")
+        with self._lock:
+            self._pools[str(name)] = provider
+
+    def _pool_bytes(self) -> Dict[str, int]:
+        out = {}
+        for name in sorted(self._pools):
+            try:
+                out[name] = tree_bytes(self._pools[name]())
+            except Exception:  # noqa: BLE001 — attribution must not raise
+                out[name] = 0
+        return out
+
+    # -- the ledger -----------------------------------------------------------
+    def snapshot(self, step: Optional[int] = None) -> Optional[dict]:
+        """Take one device-memory snapshot into the ring; returns the
+        record, or None on failure. NEVER raises — this runs on the
+        trainer/engine driver path, and a memory probe that kills the
+        step it was watching is worse than no probe (the ``mem.snapshot``
+        chaos site drills exactly that)."""
+        if not self.armed:
+            return None
+        try:
+            chaos.site("mem.snapshot")
+            return self._snapshot_inner(step)
+        except Exception:  # noqa: BLE001 — ledger-on-pressure must not raise
+            with self._lock:
+                self.snapshot_failures += 1
+            logger.warning("memwatch: snapshot failed", exc_info=True)
+            return None
+
+    def _device_stats(self) -> Dict[str, Any]:
+        """Allocator counters with the CPU fallback: a backend that
+        reports no ``bytes_in_use`` (CPU PJRT returns None) is summed
+        from ``jax.live_arrays()`` by shape×dtype instead."""
+        if self.config.stats_fn is not None:
+            stats = dict(self.config.stats_fn())
+            stats.setdefault("bytes_in_use", 0)
+            stats.setdefault("source", "injected")
+            stats.setdefault("peak_bytes_in_use", stats.get("bytes_in_use",
+                                                            0))
+            stats.setdefault("bytes_limit", None)
+            return stats
+        from .. import device as _device
+        stats = _device.memory_stats(self.config.device)
+        if stats.get("bytes_in_use"):
+            return {
+                "bytes_in_use": int(stats["bytes_in_use"]),
+                "peak_bytes_in_use":
+                    _device.max_memory_allocated(self.config.device),
+                "bytes_limit": int(stats.get("bytes_limit") or 0) or None,
+                "source": "pjrt",
+            }
+        live = _device.live_array_bytes()
+        _device._note_peak(self.config.device, live)
+        return {
+            "bytes_in_use": live,
+            "peak_bytes_in_use":
+                _device.max_memory_allocated(self.config.device) or live,
+            "bytes_limit": None,
+            "source": "live_arrays",
+        }
+
+    def _snapshot_inner(self, step: Optional[int]) -> dict:
+        mono = time.monotonic()
+        stats = self._device_stats()
+        pools = self._pool_bytes()
+        tagged = sum(pools.values())
+        # tagged pools are a LOWER BOUND on true usage: on a PJRT
+        # backend bytes_in_use already covers them, but the CPU
+        # live-arrays fallback cannot see host-side pool storage (numpy
+        # pages), so the ledger takes the max rather than undercounting
+        in_use = max(stats["bytes_in_use"], tagged)
+        limit = self.config.limit_bytes or stats["bytes_limit"]
+        fraction = (in_use / limit) if limit else None
+        rec = {
+            "step": step,
+            "t_mono_s": round(mono, 6),
+            "bytes_in_use": in_use,
+            "peak_bytes_in_use": stats["peak_bytes_in_use"],
+            "bytes_limit": limit,
+            "fraction": round(fraction, 6) if fraction is not None
+            else None,
+            "source": stats["source"],
+            "pools": dict(pools, other=max(in_use - tagged, 0)),
+        }
+        trigger = None
+        with self._lock:
+            self.snapshots += 1
+            self._ring.append(rec)
+            if self._baseline is None:
+                self._baseline = dict(rec["pools"])
+            wm = self.watermarks
+            wm["peak_bytes_in_use"] = max(wm["peak_bytes_in_use"], in_use)
+            if fraction is not None:
+                wm["peak_fraction"] = max(wm["peak_fraction"], fraction)
+            for name, b in rec["pools"].items():
+                wm["pools"][name] = max(wm["pools"].get(name, 0), b)
+            if fraction is not None and \
+                    fraction >= self.config.watermark and \
+                    "near_oom" not in self._latched:
+                self._latched.add("near_oom")
+                trigger = {
+                    "fraction": round(fraction, 6),
+                    "watermark": self.config.watermark,
+                    "bytes_in_use": in_use,
+                    "bytes_limit": limit,
+                    "pool": self._growth_culprit_locked(rec["pools"]),
+                    "pools": dict(rec["pools"]),
+                }
+            wm_pools = dict(wm["pools"])
+            wm_peak = wm["peak_bytes_in_use"]
+        for name, b in sorted(rec["pools"].items()):
+            _instr.record_mem_bytes_in_use(name, b)
+        _instr.record_mem_bytes_in_use("total", in_use)
+        for name, b in sorted(wm_pools.items()):
+            _instr.record_mem_peak_bytes(name, b)
+        _instr.record_mem_peak_bytes("total", wm_peak)
+        if fraction is not None:
+            _instr.record_mem_watermark_fraction(fraction)
+        if trigger is not None:
+            # dump AFTER the triggering snapshot landed in the ring, so
+            # the dump's last record is the one that explains it (the
+            # PR 9 flush-after-step discipline)
+            self.dump(reason="near_oom", detail=trigger)
+        return rec
+
+    def _growth_culprit_locked(self, pools: Dict[str, int]) -> str:
+        """The pool whose growth since the FIRST snapshot is largest —
+        what filled the chip, not what merely sat on it. Ties break by
+        current bytes, then name (deterministic for the drill)."""
+        base = self._baseline or {}
+        ranked = sorted(
+            ((b - base.get(name, 0), b, name)
+             for name, b in pools.items()),
+            key=lambda t: (-t[0], -t[1], t[2]))
+        return ranked[0][2] if ranked else "other"
+
+    # -- watermarks -----------------------------------------------------------
+    def reset_watermarks(self) -> None:
+        """Clear the per-pool and overall high watermarks AND the
+        device-level peak counters (``device.reset_peak_memory_stats``),
+        so per-phase peaks — warm start vs steady state, prefill vs
+        decode — are measurable from a clean floor."""
+        with self._lock:
+            self.watermarks = {"peak_bytes_in_use": 0,
+                               "peak_fraction": 0.0, "pools": {}}
+            self._baseline = None
+        try:
+            from .. import device as _device
+            _device.reset_peak_memory_stats(self.config.device)
+        except Exception:  # noqa: BLE001 — reset is advisory
+            logger.debug("memwatch: device peak reset unavailable",
+                         exc_info=True)
+
+    def reset_triggers(self) -> None:
+        """Re-arm latched pressure-dump reasons (tests / long-lived
+        processes that rotated their dump file)."""
+        with self._lock:
+            self._latched.clear()
+
+    # -- flight dump ----------------------------------------------------------
+    def dump(self, reason: str = "manual", detail: Optional[dict] = None,
+             path: Optional[str] = None) -> Optional[Dict[str, Any]]:
+        """Dump the memory ring; returns the record dict, or None on
+        failure. NEVER raises — a dump triggered by memory pressure must
+        not become the allocation that tips the process over."""
+        try:
+            with self._lock:
+                rec = self._dump_record_locked(reason, detail)
+                target = path if path is not None else self.dump_path
+                if target:
+                    _atomic_json(target, rec, indent=1)
+                self.dumps.append({"reason": reason,
+                                   "unix_time": rec["unix_time"],
+                                   "path": target or None})
+            _instr.record_mem_pressure_dump(reason)
+            logger.info("memwatch: dump (%s)%s", reason,
+                        f" -> {target}" if target else "")
+            return rec
+        except Exception:  # noqa: BLE001 — dump-on-pressure must not raise
+            with self._lock:
+                self.dump_failures += 1
+            logger.warning("memwatch: dump failed (reason=%s)", reason,
+                           exc_info=True)
+            return None
+
+    def _dump_record_locked(self, reason: str,
+                            detail: Optional[dict]) -> Dict[str, Any]:
+        if self._identity is None:
+            from .evidence import device_identity
+            self._identity = device_identity()
+        return {
+            "version": 1,
+            "kind": "memwatch",
+            "reason": reason,
+            "detail": detail,
+            "unix_time": self._wall(time.monotonic()),
+            "device_kind": self._identity[0],
+            "platform": self._identity[1],
+            "ring": {"ring_steps": self.config.ring_steps,
+                     "watermark": self.config.watermark},
+            "steps": list(self._ring),
+            "watermarks": json.loads(json.dumps(self.watermarks)),
+            "counters": {"snapshots": self.snapshots,
+                         "snapshot_failures": self.snapshot_failures,
+                         "dump_failures": self.dump_failures},
+        }
+
+    # -- telemetry ------------------------------------------------------------
+    def telemetry(self) -> Dict[str, Any]:
+        """Snapshot for ``engine.telemetry()`` / dashboards: the last
+        ring record, watermarks, and dump status."""
+        with self._lock:
+            return {
+                "last": dict(self._ring[-1]) if self._ring else None,
+                "watermarks": json.loads(json.dumps(self.watermarks)),
+                "snapshots": self.snapshots,
+                "snapshot_failures": self.snapshot_failures,
+                "dumps": list(self.dumps),
+                "dump_failures": self.dump_failures,
+            }
+
+
+def resolve_watcher(spec) -> Optional[MemoryWatcher]:
+    """Normalize a ``memwatch`` argument: a watcher passes through, a
+    MemWatchConfig builds one, True arms the defaults, False disarms,
+    and None defers to the env (``PADDLE_MEMWATCH`` truthy, or a
+    ``PADDLE_MEMWATCH_DUMP`` file being named, arms)."""
+    if spec is None:
+        if os.environ.get(ENV_MEMWATCH, "").strip().lower() in _TRUTHY \
+                or os.environ.get(ENV_DUMP, "").strip():
+            return MemoryWatcher()
+        return None
+    if spec is False:
+        return None
+    if spec is True:
+        return MemoryWatcher()
+    if isinstance(spec, MemWatchConfig):
+        return MemoryWatcher(spec)
+    if isinstance(spec, MemoryWatcher):
+        return spec
+    raise TypeError(
+        f"memwatch wants None/bool/MemWatchConfig/MemoryWatcher, "
+        f"got {type(spec).__name__}")
+
+
+__all__ = ["MemWatchConfig", "MemoryWatcher", "resolve_watcher",
+           "tree_bytes", "POOLS", "ENV_MEMWATCH", "ENV_DUMP",
+           "ENV_WATERMARK"]

@@ -76,6 +76,7 @@ SITES = {
     "aot.export": "site",
     "aot.load": "site",
     "aot.artifact_bytes": "mangle",
+    "mem.snapshot": "site",
 }
 
 _CONTROL_KINDS = ("delay", "error", "die")

@@ -58,7 +58,7 @@ class EngineConfig:
                  spec_method: Optional[str] = None,
                  num_draft_tokens: int = 4, draft_model=None,
                  spec_options: Optional[dict] = None,
-                 aot_cache=None, obs=None):
+                 aot_cache=None, obs=None, memwatch=None):
         self.max_seqs = int(max_seqs)
         self.token_budget = int(token_budget)
         self.block_size = int(block_size)
@@ -81,6 +81,11 @@ class EngineConfig:
         # telemetry, False disarms, None defers to PADDLE_SERVE_OBS /
         # PADDLE_SERVE_FLIGHT (disarmed = one `is None` check per seam)
         self.obs = obs
+        # memory observability plane (profiler/memwatch.py): per-step
+        # device-memory snapshots attributed into params/kv_pages pools
+        # with a near-OOM pressure dump; same disarm discipline as obs
+        # (None defers to PADDLE_MEMWATCH / PADDLE_MEMWATCH_DUMP)
+        self.memwatch = memwatch
         if spec_method is not None and self.num_draft_tokens < 1:
             raise ValueError(
                 f"speculative decoding needs num_draft_tokens >= 1, "
@@ -167,6 +172,9 @@ class ServingEngine:
                  self.dec.hd)
         self._kp = jnp.zeros(shape, dtype)
         self._vp = jnp.zeros(shape, dtype)
+        # device bytes of one page across K+V and every layer — the unit
+        # the telemetry/memwatch byte accounting is denominated in
+        self.page_bytes = (self._kp.nbytes + self._vp.nbytes) // num_blocks
         self.pool = KVBlockPool(num_blocks, bs,
                                 enable_prefix_cache=cfg.enable_prefix_cache)
         spec_opts = dict(cfg.spec_options)
@@ -188,6 +196,12 @@ class ServingEngine:
                                     draft_model=cfg.draft_model,
                                     **spec_opts)
         self.obs = resolve_observer(cfg.obs)
+        from ..profiler.memwatch import resolve_watcher
+        self.memwatch = resolve_watcher(cfg.memwatch)
+        if self.memwatch is not None:
+            self.memwatch.register_pool("params", lambda: self._w)
+            self.memwatch.register_pool(
+                "kv_pages", lambda: (self._kp, self._vp))
         self.sched = Scheduler(self.pool, cfg.max_seqs, cfg.token_budget,
                                self.max_pages_per_seq, policy=cfg.policy,
                                drafter=self.drafter,
@@ -338,6 +352,9 @@ class ServingEngine:
             queue_depth = self.sched.queue_depth()
             running = len(self.sched.running)
             util = self.pool.utilization()
+            used_blocks = self.pool.used_blocks()
+            if self.memwatch is not None:
+                self.memwatch.snapshot(step=self.steps)
             dq = self.pool.stats["prefix_queries"] - q0
             dh = self.pool.stats["prefix_hits"] - h0
             if armed:
@@ -371,6 +388,7 @@ class ServingEngine:
         dt = time.monotonic() - t0
         _instr.record_serve_step(plan.admitted, sampled["finished"],
                                  plan.preempted, queue_depth, running, util)
+        _instr.record_serve_kv_pool_bytes(used_blocks * self.page_bytes)
         _instr.record_serve_prefix(dq, dh)
         for lat in sampled["ttfts"]:
             _instr.record_serve_ttft(lat)
@@ -544,6 +562,9 @@ class ServingEngine:
                     "cached": self.pool.cached_blocks(),
                     "free": self.pool.free_blocks(),
                     "utilization": round(self.pool.utilization(), 4),
+                    "page_bytes": self.page_bytes,
+                    "bytes": self.pool.num_blocks * self.page_bytes,
+                    "used_bytes": self.pool.used_blocks() * self.page_bytes,
                     "prefix": {"queries": s["prefix_queries"],
                                "hits": s["prefix_hits"],
                                "hit_tokens": s["prefix_hit_tokens"]},
@@ -552,6 +573,8 @@ class ServingEngine:
             }
             if self.drafter is not None:
                 base["spec"]["drafter"] = self.drafter.describe()
+            if self.memwatch is not None:
+                base["mem"] = self.memwatch.telemetry()
             if self.obs is not None:
                 return self.obs.telemetry(base)
             return base

@@ -36,6 +36,16 @@ dump subset (reason, exhaustion site/phase, step/request ids).
 
     JAX_PLATFORMS=cpu python tools/chaos_drill.py --flight [--seed 1234]
 
+``--mem`` runs the memory-pressure drill: an armed memory watcher
+(paddle_tpu.profiler.memwatch) with a seeded growth workload filling the
+``kv_pages`` pool must produce EXACTLY one well-formed pressure dump
+whose detail names ``kv_pages`` as the pool that crossed the high
+watermark — and a below-watermark control run must produce none; a
+seeded ``mem.snapshot`` chaos fault must be swallowed (snapshot returns
+None, never raises into the driver). Deterministic per seed.
+
+    JAX_PLATFORMS=cpu python tools/chaos_drill.py --mem [--seed 1234]
+
 Exit code 0 = every exercised recovery path verified.
 """
 from __future__ import annotations
@@ -365,6 +375,98 @@ def run_flight_drill(seed: int = 1234, verbose: bool = True):
     return report
 
 
+def run_mem_drill(seed: int = 1234, verbose: bool = True):
+    """Seeded memory-pressure drill (see module docstring).
+
+    Phase 1 (armed-but-quiet): pools grow but stay under the watermark —
+    ZERO dumps. Phase 2: the kv_pages pool grows past the limit fraction
+    — exactly ONE well-formed dump whose detail names kv_pages as the
+    growth culprit, latched (further pressure snapshots do not re-dump).
+    Phase 3: a seeded ``mem.snapshot`` chaos error is swallowed — the
+    snapshot returns None and the driver loop it models never sees an
+    exception. Returns a report whose ``stable`` subset is bit-identical
+    per seed."""
+    import numpy as np
+
+    from paddle_tpu.profiler.memwatch import MemoryWatcher, MemWatchConfig
+    from paddle_tpu.resilience import chaos
+
+    rng = np.random.default_rng(seed)
+    base = np.ones((64, 64), np.float32)          # 16 KiB of "params"
+
+    def run(grow_pages: int, dump_path: str, limit: int):
+        # stats_fn pins bytes_in_use to the tagged pools: the drill's
+        # pressure curve depends only on its own seeded growth, not on
+        # whatever the host process happens to have live
+        w = MemoryWatcher(MemWatchConfig(
+            ring_steps=32, watermark=0.9, dump_path=dump_path,
+            limit_bytes=limit, stats_fn=lambda: {"bytes_in_use": 0}))
+        pages = []
+        w.register_pool("params", lambda: base)
+        w.register_pool("kv_pages", lambda: pages)
+        for i in range(grow_pages):
+            pages.append(np.full((256,), float(rng.integers(1, 9)),
+                                 np.float32))  # 1 KiB per page
+            w.snapshot(step=i)
+        return w
+
+    limit = base.nbytes + 64 * 1024  # params + 64 pages of headroom
+    with tempfile.TemporaryDirectory() as root:
+        quiet_path = os.path.join(root, "quiet_memwatch.json")
+        quiet = run(grow_pages=8, dump_path=quiet_path, limit=limit)
+        assert quiet.dumps == [], \
+            f"below-watermark run dumped: {quiet.dumps}"
+        assert not os.path.exists(quiet_path), \
+            "below-watermark run wrote a dump file"
+
+        dump_path = os.path.join(root, "memwatch.json")
+        hot = run(grow_pages=80, dump_path=dump_path, limit=limit)
+        assert len(hot.dumps) == 1, \
+            f"expected exactly one pressure dump, got {hot.dumps}"
+        with open(dump_path) as f:
+            dump = json.load(f)
+        for key in ("version", "kind", "reason", "detail", "steps",
+                    "watermarks", "counters", "unix_time"):
+            assert key in dump, f"memwatch dump missing {key!r}"
+        assert dump["kind"] == "memwatch" and \
+            dump["reason"] == "near_oom", dump["reason"]
+        detail = dump["detail"]
+        assert detail["pool"] == "kv_pages", \
+            f"dump blamed {detail['pool']!r}, expected kv_pages"
+        assert detail["fraction"] >= 0.9
+        cross_step = dump["steps"][-1]["step"]
+
+        # phase 3: a chaos fault on the snapshot path is swallowed
+        chaos.install_plan(chaos.FaultPlan(seed=seed).add(
+            "mem.snapshot", "error", at=(1,)))
+        try:
+            got = hot.snapshot(step=999)
+        finally:
+            chaos.clear_plan()
+        assert got is None and hot.snapshot_failures == 1, \
+            "chaos-faulted snapshot leaked instead of being swallowed"
+        assert len(hot.dumps) == 1, "latched near_oom re-dumped"
+
+    report = {
+        "seed": seed, "ok": True,
+        "stable": {
+            "reason": dump["reason"],
+            "pool": detail["pool"],
+            "watermark": detail["watermark"],
+            "cross_step": cross_step,
+            "steps_in_dump": len(dump["steps"]),
+            "pools_at_cross": {k: v for k, v in
+                               sorted(detail["pools"].items())},
+        },
+    }
+    if verbose:
+        print(f"mem drill (seed={seed}): quiet run 0 dumps; kv_pages "
+              f"growth crossed the {detail['watermark']:.0%} watermark at "
+              f"step {cross_step} -> 1 dump naming kv_pages; chaos "
+              "snapshot fault swallowed — memory pressure plane verified")
+    return report
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seed", type=int, default=1234)
@@ -379,12 +481,17 @@ def main(argv=None):
     ap.add_argument("--flight", action="store_true",
                     help="run the serving flight-recorder drill (seeded "
                          "pool exhaustion => exactly one dump)")
+    ap.add_argument("--mem", action="store_true",
+                    help="run the memory-pressure drill (seeded pool "
+                         "growth => exactly one dump naming the pool)")
     args = ap.parse_args(argv)
     if args.preempt:
         report = run_preempt_drill(seed=args.seed, verbose=not args.json,
                                    aot=not args.no_aot)
     elif args.flight:
         report = run_flight_drill(seed=args.seed, verbose=not args.json)
+    elif args.mem:
+        report = run_mem_drill(seed=args.seed, verbose=not args.json)
     else:
         report = run_drill(seed=args.seed, verbose=not args.json)
     if args.json:

@@ -152,6 +152,27 @@ def _perf_config_check(config_path, ledger_path):
     return findings
 
 
+def _mem_self_check():
+    """What-fits planner gate (stdlib, rides the AST pass): the
+    committed fixture (tools/mem_plan_baseline.json) must reproduce
+    tools/mem_report.py plan() output exactly — capacity predictions
+    the sharding auto-planner and serving pre-checks consume must not
+    drift silently (MEM501)."""
+    from paddle_tpu.analysis.rules import Finding
+
+    tools_dir = os.path.join(REPO, "tools")
+    if tools_dir not in sys.path:
+        sys.path.insert(0, tools_dir)
+    import mem_report
+    return [
+        Finding("MEM501", mem_report.FIXTURE, 0, 0,
+                f"what-fits planner drifted from the committed fixture: "
+                f"{msg}",
+                "review the change, then tools/mem_report.py "
+                "--update-fixture")
+        for msg in mem_report.self_check()]
+
+
 def _trace_self_check():
     """Trace-sanitize a representative step function built from the
     framework's own layers — proves the dynamic pass runs on the shipped
@@ -257,6 +278,8 @@ def main(argv=None) -> int:
                          "(default PERF_LEDGER.jsonl)")
     ap.add_argument("--no-perf-config", action="store_true",
                     help="skip the perf-config provenance check")
+    ap.add_argument("--no-mem-check", action="store_true",
+                    help="skip the mem_report what-fits fixture check")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="machine-readable findings on stdout")
     args = ap.parse_args(argv)
@@ -280,6 +303,11 @@ def main(argv=None) -> int:
         PERF_CONFIG if os.path.exists(PERF_CONFIG) else None)
     if perf_config and not args.no_perf_config:
         findings.extend(_perf_config_check(perf_config, args.perf_ledger))
+
+    # what-fits planner self-check (stdlib, fast): committed fixture
+    # must match tools/mem_report.py plan() byte-for-byte
+    if not args.no_mem_check:
+        findings.extend(_mem_self_check())
 
     if not args.no_trace:
         findings.extend(_trace_self_check())

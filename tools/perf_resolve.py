@@ -66,7 +66,7 @@ CONFIG = os.path.join(REPO, "PERF_CONFIG.json")
 #: conflict tie-break: lower = more authoritative for the same round
 SOURCE_PRIORITY = ("probe", "bench_session", "mfu_lab", "bench",
                    "autotune", "aot_stats", "runlog", "bench_serve",
-                   "flight")
+                   "flight", "mem")
 
 
 def _prio(source: str) -> int:

@@ -43,6 +43,17 @@ def _bar(frac: float, width: int = 24) -> str:
     return "[" + "#" * n + "-" * (width - n) + "]"
 
 
+def _fmt_b(v) -> str:
+    if v is None:
+        return "    - "
+    v = float(v)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if v < 1024 or unit == "GiB":
+            return f"{v:6.1f}{unit}" if unit != "B" else f"{v:6.0f}B"
+        v /= 1024.0
+    return f"{v:6.1f}GiB"
+
+
 def _fmt_s(v) -> str:
     if v is None:
         return "   -  "
@@ -91,6 +102,33 @@ def render(tel: dict, prev: dict = None) -> str:
         f"used {pool.get('used', 0)} cached {pool.get('cached', 0)} "
         f"free {pool.get('free', 0)} of {pool.get('size', 0)}   "
         f"prefix hits {prefix.get('hits', 0)}/{prefix.get('queries', 0)}")
+
+    if pool.get("bytes"):
+        lines.append(
+            f"kv bytes  used {_fmt_b(pool.get('used_bytes'))} of "
+            f"{_fmt_b(pool.get('bytes'))} pool  "
+            f"(page {_fmt_b(pool.get('page_bytes'))})")
+
+    mem = tel.get("mem")
+    if mem and mem.get("last"):
+        last = mem["last"]
+        frac = last.get("fraction")
+        wm = mem.get("watermarks", {})
+        pools = last.get("pools", {})
+        split = "  ".join(f"{k} {_fmt_b(v).strip()}"
+                          for k, v in sorted(pools.items()) if v)
+        bar = f"{_bar(frac)} {frac * 100:5.1f}%  " if frac is not None \
+            else ""
+        lines.append(
+            f"memory    {bar}in use {_fmt_b(last.get('bytes_in_use'))}  "
+            f"peak {_fmt_b(wm.get('peak_bytes_in_use'))}"
+            f"  [{last.get('source', '?')}]")
+        if split:
+            lines.append(f"  pools   {split}")
+        dumps = mem.get("dumps", [])
+        if dumps:
+            lines.append(f"  mem dumps {len(dumps)}  last: "
+                         f"{dumps[-1].get('reason')}")
 
     lat = tel.get("latency")
     if lat:
@@ -175,7 +213,8 @@ def demo(iterations: int, n_requests: int, interval: float,
     eng = ServingEngine(model, EngineConfig(
         max_seqs=4, token_budget=24, block_size=8,
         spec_method="ngram", num_draft_tokens=3,
-        obs=ObsConfig(flight_steps=64, flight_requests=32)))
+        obs=ObsConfig(flight_steps=64, flight_requests=32),
+        memwatch=True))
     rng = np.random.default_rng(seed)
     pattern = rng.integers(1, 128, (5,)).tolist()
     for i in range(n_requests):

@@ -120,6 +120,37 @@ def _flight_dump(env: dict, since: float) -> object:
         return {"unparseable": path}
 
 
+def _mem_report(env: dict, since: float) -> object:
+    """Inline the worker's memory-watcher dump (PADDLE_MEMWATCH_DUMP,
+    written by paddle_tpu.profiler.memwatch on near-OOM pressure or on
+    demand) into the crash report as a compact summary: why it fired,
+    the last snapshot's pool split, and the high watermarks — so an
+    OOM-killed generation leaves a postmortem that says WHAT filled the
+    chip. Same stale-mtime rule as _metrics_dump: a file older than this
+    attempt belongs to a previous generation."""
+    path = env.get("PADDLE_MEMWATCH_DUMP", "")
+    if not path or not os.path.exists(path):
+        return None
+    try:
+        if os.path.getmtime(path) < since:
+            return None  # stale: written by an earlier attempt
+        with open(path) as f:
+            dump = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {"unparseable": path}
+    steps = dump.get("steps") or []
+    last = steps[-1] if steps else None
+    return {
+        "reason": dump.get("reason"),
+        "detail": dump.get("detail"),
+        "device_kind": dump.get("device_kind"),
+        "buffered_steps": len(steps),
+        "last": last,
+        "watermarks": dump.get("watermarks"),
+        "counters": dump.get("counters"),
+    }
+
+
 def _perf_report(env: dict, since: float) -> object:
     """Inline the generation's perf-evidence summary into the crash
     report: row counts by source from the per-generation ledger
@@ -226,6 +257,11 @@ def _aot_report(stats_path: str, spawn_wall: float) -> object:
     # the MFU-attribution evidence surfaced next to the hit/miss counts
     cost = {name: p["cost"] for name, p in programs.items()
             if p.get("cost")}
+    # per-program compiled memory footprint (memory_analysis: temp/
+    # argument/output bytes), recorded at export, restored on hits —
+    # the static half of the mem_report budget breakdown
+    mem = {name: p["mem"] for name, p in programs.items()
+           if p.get("mem")}
     return {
         "programs": programs,
         "hits": sum(p.get("hits", 0) for p in programs.values()),
@@ -233,6 +269,7 @@ def _aot_report(stats_path: str, spawn_wall: float) -> object:
         "fallbacks": sum(p.get("fallbacks", 0)
                          for p in programs.values()),
         "cost": cost or None,
+        "mem": mem or None,
         "cold_start_seconds": (round(ready - spawn_wall, 3)
                                if ready is not None else None),
     }
@@ -295,6 +332,11 @@ class Supervisor:
             # rows live); inlined as the crash report's perf summary
             env.setdefault("PADDLE_PERF_EVIDENCE", os.path.join(
                 self.report_dir, f"evidence_{self.generation}.jsonl"))
+            # per-generation memory-watcher dump (arms the memwatch
+            # plane, same as the flight path arms serving obs); the
+            # near-OOM postmortem is inlined into the crash report
+            env.setdefault("PADDLE_MEMWATCH_DUMP", os.path.join(
+                self.report_dir, f"memwatch_{self.generation}.json"))
         return env
 
     def _aot_stats_path(self) -> str:
@@ -338,6 +380,7 @@ class Supervisor:
             "aot": _aot_report(env.get("PADDLE_AOT_STATS", ""), wall0),
             "flight": _flight_dump(env, wall0),
             "perf": _perf_report(env, wall0),
+            "mem": _mem_report(env, wall0),
         }
         if isinstance(report["aot"], dict):
             report["cold_start_seconds"] = \
