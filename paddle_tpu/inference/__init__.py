@@ -380,9 +380,16 @@ class BatchingServer:
             live = []
             for req, fut in self._inflight:
                 if req.done:
-                    self.requests_served += 1
-                    self._deliver(fut,
-                                  result=[np.asarray(req.output, np.int32)])
+                    if req.error is not None:
+                        # terminal failure (step-fault budget exhausted,
+                        # engine abort): the Future raises instead of
+                        # hanging its client forever — and does NOT
+                        # count as served
+                        self._deliver(fut, exc=req.error)
+                    else:
+                        self.requests_served += 1
+                        self._deliver(
+                            fut, result=[np.asarray(req.output, np.int32)])
                 else:
                     live.append((req, fut))
             self._inflight = live
@@ -396,7 +403,17 @@ class BatchingServer:
             if self._stop and not self._inflight:
                 return
             if eng.has_work():
-                eng.step()
+                try:
+                    eng.step()
+                except BaseException as e:  # noqa: BLE001
+                    # an escaping step (resilience plane disarmed) used
+                    # to kill THIS thread silently, parking every queued
+                    # request forever — instead fail every live request
+                    # through the engine's terminal-error path (pages
+                    # released, one terminal lifecycle event each) and
+                    # keep driving: the Futures resolve with the error
+                    # on the next _resolve_finished pass
+                    eng.abort_all(e, reason="engine_driver_fault")
                 self.batches_run += 1
             else:
                 eng.wait_for_work(timeout=0.02)

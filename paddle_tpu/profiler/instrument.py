@@ -68,6 +68,11 @@ Catalog (names are a stable API — see README "Observability"):
   mem_watermark_fraction                 bytes_in_use / bytes_limit (0..1)
   mem_pressure_dumps_total{trigger}      memwatch ring dumps (near_oom|manual)
   serve_kv_pool_bytes                    device bytes of live sequences' KV pages
+  serve_step_faults_total{kind}          serving/resilience.py contained step faults
+  serve_request_retries_total{reason}    requests requeued for recompute after a fault
+  serve_shed_total{policy}               submissions refused by admission control
+  serve_drain_seconds                    graceful-drain wall time (notice -> manifest)
+  serve_engine_restarts_total            drain manifests replayed into a fresh engine
 """
 from __future__ import annotations
 
@@ -139,6 +144,11 @@ CATALOG = (
     "mem_watermark_fraction",
     "mem_pressure_dumps_total",
     "serve_kv_pool_bytes",
+    "serve_step_faults_total",
+    "serve_request_retries_total",
+    "serve_shed_total",
+    "serve_drain_seconds",
+    "serve_engine_restarts_total",
 )
 
 _enabled = _m._ENABLED  # bind the cell once: hot-path guard is _enabled[0]
@@ -586,6 +596,56 @@ def record_serve_kv_pool_bytes(nbytes: int) -> None:
     _reg().gauge("serve_kv_pool_bytes",
                  "device bytes of KV pages held by live sequences "
                  "(used pages x per-page K+V bytes)").set(float(nbytes))
+
+
+def record_serve_step_fault(kind: str) -> None:
+    """One contained engine-step fault (kind: chaos | nan_logits | the
+    escaping exception's class name)."""
+    if not _enabled[0]:
+        return
+    _reg().counter("serve_step_faults_total",
+                   "serving engine steps that raised and were contained "
+                   "by the resilience plane (by fault kind)",
+                   labelnames=("kind",)).labels(kind=kind).inc()
+
+
+def record_serve_request_retry(reason: str) -> None:
+    """One request requeued for prefix recompute after a contained
+    fault (reason: step_fault)."""
+    if not _enabled[0]:
+        return
+    _reg().counter("serve_request_retries_total",
+                   "serving requests requeued for recompute by reason",
+                   labelnames=("reason",)).labels(reason=reason).inc()
+
+
+def record_serve_shed(policy: str) -> None:
+    """One submission refused by admission control under the named
+    backpressure policy (block | reject | shed)."""
+    if not _enabled[0]:
+        return
+    _reg().counter("serve_shed_total",
+                   "serving submissions refused by admission control "
+                   "(by backpressure policy)",
+                   labelnames=("policy",)).labels(policy=policy).inc()
+
+
+def record_serve_drain(seconds: float) -> None:
+    if not _enabled[0]:
+        return
+    _reg().histogram("serve_drain_seconds",
+                     "graceful-drain wall seconds (stop admission -> "
+                     "manifest exported)", buckets=_TIME_BUCKETS) \
+        .observe(seconds)
+
+
+def record_serve_engine_restart() -> None:
+    """One drain manifest replayed into a (re)started engine."""
+    if not _enabled[0]:
+        return
+    _reg().counter("serve_engine_restarts_total",
+                   "drain manifests replayed into a fresh serving "
+                   "engine after a restart").inc()
 
 
 def record_serve_tokens(n: int, step_seconds: float) -> None:

@@ -73,6 +73,7 @@ SITES = {
     "serve.kv_alloc": "site",
     "serve.spec_verify": "site",
     "serve.flight_dump": "site",
+    "serve.engine_step": "site",
     "aot.export": "site",
     "aot.load": "site",
     "aot.artifact_bytes": "mangle",

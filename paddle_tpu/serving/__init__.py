@@ -41,12 +41,30 @@ streaming quantiles behind ``engine.telemetry()`` (rendered live by
         flight_steps=256, stall_threshold_s=30.0)))
     req = eng.submit(ids, max_new_tokens=64, ttft_deadline=0.5,
                      tpot_deadline=0.05)
+
+Resilience (``serving.resilience``): step-fault containment (a raising
+or NaN-logits step requeues its requests for recompute under a bounded
+retry budget; past-budget requests fail with a clean terminal error),
+graceful drain with an atomic restart-replay manifest
+(``engine.drain`` / ``replay_manifest`` / ``serve_until_preempted``,
+supervised by ``tools/supervise.py``), and bounded-queue admission
+control (``block`` | ``reject`` | SLO-aware ``shed`` — overload becomes
+a typed ``AdmissionRejected`` with a retry-after estimate). Disarmed by
+default — arm with ``EngineConfig(resilience=True | ResilienceConfig)``
+or ``PADDLE_SERVE_RESILIENCE=1``; drill with
+``tools/chaos_drill.py --serve``:
+
+    eng = ServingEngine(model, EngineConfig(resilience=ResilienceConfig(
+        max_step_retries=2, max_waiting=64, backpressure="shed")))
 """
 from .engine import (EngineConfig, EnginePredictor, ServingEngine,
                      engine_from_config)
 from .kv_pool import KVBlockPool, PoolExhausted
 from .obs import ObsConfig, RequestTrace, ServingObserver, resolve_observer
 from .ragged import ragged_paged_attention
+from .resilience import (AdmissionRejected, RequestFailed, ResilienceConfig,
+                         StepFault, load_manifest, replay_manifest,
+                         resolve_resilience, serve_until_preempted)
 from .scheduler import Request, Scheduler
 from .speculative import (Drafter, DraftModelDrafter, NgramDrafter,
                           make_drafter, verify_greedy)
@@ -58,4 +76,7 @@ __all__ = [
     "Drafter", "NgramDrafter", "DraftModelDrafter", "make_drafter",
     "verify_greedy",
     "ObsConfig", "RequestTrace", "ServingObserver", "resolve_observer",
+    "ResilienceConfig", "resolve_resilience", "AdmissionRejected",
+    "RequestFailed", "StepFault", "load_manifest", "replay_manifest",
+    "serve_until_preempted",
 ]

@@ -33,6 +33,7 @@ import numpy as np
 from ..profiler import instrument as _instr
 from ..resilience import chaos
 from . import ragged as _ragged
+from . import resilience as _res
 from .kv_pool import KVBlockPool
 from .obs import resolve_observer
 from .scheduler import Request, Scheduler
@@ -58,7 +59,8 @@ class EngineConfig:
                  spec_method: Optional[str] = None,
                  num_draft_tokens: int = 4, draft_model=None,
                  spec_options: Optional[dict] = None,
-                 aot_cache=None, obs=None, memwatch=None):
+                 aot_cache=None, obs=None, memwatch=None,
+                 resilience=None):
         self.max_seqs = int(max_seqs)
         self.token_budget = int(token_budget)
         self.block_size = int(block_size)
@@ -86,6 +88,11 @@ class EngineConfig:
         # with a near-OOM pressure dump; same disarm discipline as obs
         # (None defers to PADDLE_MEMWATCH / PADDLE_MEMWATCH_DUMP)
         self.memwatch = memwatch
+        # resilience plane (serving/resilience.py): True/ResilienceConfig
+        # arms step-fault containment + drain/replay + admission control,
+        # False disarms, None defers to PADDLE_SERVE_RESILIENCE /
+        # PADDLE_SERVE_DRAIN_MANIFEST (disarmed = one `is None` check)
+        self.resilience = resilience
         if spec_method is not None and self.num_draft_tokens < 1:
             raise ValueError(
                 f"speculative decoding needs num_draft_tokens >= 1, "
@@ -99,6 +106,15 @@ def _argmax_rows(logits):
     (a per-step gather of just the sampling rows would recompile on
     every distinct row-count the speculative planner produces)."""
     return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+@jax.jit
+def _all_finite(logits):
+    """The StepGuard-style sample guard (serving/resilience.py): one
+    fused reduce over the step's logits — NaN/inf anywhere means the
+    sampled tokens cannot be trusted and the whole step is a fault.
+    Fixed [T, V] shape, so it shares the engine's one-compile story."""
+    return jnp.all(jnp.isfinite(logits))
 
 
 @partial(jax.jit, donate_argnums=(0, 1))
@@ -220,6 +236,22 @@ class ServingEngine:
         self.spec_proposed = 0
         self.spec_accepted = 0
         self.spec_rollback_pages = 0
+        # resilience plane (serving/resilience.py); disarmed = None, and
+        # every armed-only seam below is behind one `is None` check
+        self.resilience = _res.resolve_resilience(cfg.resilience)
+        self._pool_shape, self._pool_dtype = shape, dtype
+        self._draining = False
+        self._admit_cv = threading.Condition()
+        self.step_faults = 0
+        self.request_retries = 0
+        self.requests_failed = 0
+        self.shed_total = 0
+        self.drains = 0
+        # running mean of finished-request e2e seconds: the evidence the
+        # retry-after / predicted-queue-wait estimates derive from (two
+        # float adds per finished request — always on, cost-free)
+        self._e2e_sum = 0.0
+        self._e2e_n = 0
 
     # -- AOT program cache ----------------------------------------------------
     def _build_step_call(self):
@@ -277,17 +309,30 @@ class ServingEngine:
                eos_id: Optional[int] = None, on_token=None,
                stream: bool = False,
                ttft_deadline: Optional[float] = None,
-               tpot_deadline: Optional[float] = None) -> Request:
+               tpot_deadline: Optional[float] = None,
+               generated: Optional[Sequence[int]] = None,
+               tag=None, _bypass_admission: bool = False) -> Request:
         """Enqueue one request; returns the Request handle (``result()``
         blocks for the token list, ``stream()`` yields tokens live).
         ``ttft_deadline`` / ``tpot_deadline`` (seconds) are optional SLO
         deadlines the observability plane accounts (violations, goodput,
-        attainment — see ``telemetry()``); they never change
-        scheduling."""
+        attainment — see ``telemetry()``); with the resilience plane's
+        ``shed`` policy the TTFT deadline also drives admission.
+        ``generated`` seeds already-produced output tokens (restart
+        replay: they ride along in ``seq`` for prefix recompute, the
+        PR 6 preemption mechanics — decoding continues after them, and
+        they are NOT re-delivered to ``on_token``/``stream``). ``tag``
+        is an opaque caller identity carried through drain manifests.
+
+        With the resilience plane armed and a bounded queue, this may
+        raise ``serving.resilience.AdmissionRejected`` (policies
+        ``reject``/``shed``, or a ``block`` timeout) with a structured
+        retry-after estimate — overload becomes a clean, typed refusal
+        instead of an unbounded queue."""
         req = Request(prompt, max_new_tokens=max_new_tokens, eos_id=eos_id,
                       on_token=on_token, stream=stream,
                       ttft_deadline=ttft_deadline,
-                      tpot_deadline=tpot_deadline)
+                      tpot_deadline=tpot_deadline, tag=tag)
         total = len(req.prompt) + req.max_new_tokens
         if total > self.max_model_len:
             raise ValueError(
@@ -300,13 +345,132 @@ class ServingEngine:
             raise ValueError(
                 f"request needs more pages than the whole pool "
                 f"({self.pool.num_blocks} x {self.pool.block_size})")
-        with self._lock:
-            self.sched.submit(req)
-            if self.obs is not None:
-                self.obs.on_submit(req)
+        if generated:
+            if len(generated) >= req.max_new_tokens:
+                raise ValueError(
+                    f"replay carries {len(generated)} generated tokens "
+                    f"but max_new_tokens is {req.max_new_tokens} — "
+                    "nothing left to decode")
+            req.seq.extend(int(t) for t in generated)
+            req.output = [int(t) for t in generated]
+        self._admit(req, bypass=_bypass_admission)
         self._work.set()
         _instr.record_serve_queue_depth(self.sched.queue_depth())
         return req
+
+    def _admit(self, req: Request, bypass: bool = False) -> None:
+        """Put one request on the waiting queue, applying the resilience
+        plane's admission control when armed. Blocking (policy
+        ``block``) happens OUTSIDE the engine lock, so the driver thread
+        can keep stepping the queue down while submitters wait."""
+        res = self.resilience
+        if bypass:
+            # restart replay (resilience.replay_manifest): the manifest
+            # entries were ALREADY admitted once by the dead generation —
+            # re-judging the hand-over against the bounded queue could
+            # deadlock a block policy (nobody steps during replay) or
+            # silently drop accepted work on reject/shed
+            with self._lock:
+                self.sched.submit(req)
+                if self.obs is not None:
+                    self.obs.on_submit(req)
+            return
+        deadline = None
+        if res is not None and res.backpressure == "block" and \
+                res.block_timeout_s is not None:
+            deadline = time.monotonic() + res.block_timeout_s
+        while True:
+            with self._lock:
+                verdict, reason, retry_after, predicted = \
+                    self._admission_verdict(req)
+                if verdict == "admit":
+                    self.sched.submit(req)
+                    if self.obs is not None:
+                        self.obs.on_submit(req)
+                    return
+                if verdict == "reject":
+                    depth = self.sched.queue_depth()
+                    self.shed_total += 1
+                    _instr.record_serve_shed(res.backpressure)
+                    if self.obs is not None:
+                        # shed requests still get a complete lifecycle:
+                        # submit + exactly one terminal finish event
+                        self.obs.on_submit(req)
+                        self.obs.on_fail(req, "shed")
+                    err = _res.AdmissionRejected(
+                        reason, retry_after_s=retry_after,
+                        queue_depth=depth, predicted_wait_s=predicted)
+                    req.fail(err)
+                    raise err
+            # verdict == "wait" (policy block): sleep until the driver
+            # frees queue room (or drain wakes us to a clean rejection)
+            timeout = 0.05
+            if deadline is not None:
+                timeout = min(timeout, max(deadline - time.monotonic(), 0))
+                if timeout <= 0:
+                    with self._lock:
+                        self.shed_total += 1
+                        _instr.record_serve_shed("block")
+                        if self.obs is not None:
+                            self.obs.on_submit(req)
+                            self.obs.on_fail(req, "shed")
+                        err = _res.AdmissionRejected(
+                            "block_timeout",
+                            retry_after_s=self._retry_after_estimate(),
+                            queue_depth=self.sched.queue_depth())
+                        req.fail(err)
+                        raise err
+            with self._admit_cv:
+                self._admit_cv.wait(timeout=timeout)
+
+    def _admission_verdict(self, req: Request):
+        """(verdict, reason, retry_after_s, predicted_wait_s) for one
+        candidate under the engine lock. verdict: admit | reject | wait."""
+        res = self.resilience
+        if res is None:
+            return "admit", None, None, None
+        if self._draining:
+            return "reject", "draining", None, None
+        depth = self.sched.queue_depth()
+        if res.max_waiting is not None and depth >= res.max_waiting:
+            if res.backpressure == "block":
+                return "wait", None, None, None
+            return "reject", "queue_full", self._retry_after_estimate(), \
+                None
+        if res.backpressure == "shed" and req.ttft_deadline is not None:
+            predicted = self._predicted_wait(depth)
+            if predicted is not None and predicted > req.ttft_deadline:
+                # SLO-aware shed: admitting would only burn pool pages
+                # on a request whose deadline is already lost — refusing
+                # it NOW protects the goodput of everyone behind it
+                return "reject", "shed", self._retry_after_estimate(), \
+                    predicted
+        return "admit", None, None, None
+
+    def _service_estimate(self) -> Optional[float]:
+        """Mean end-to-end seconds of finished requests (None until the
+        engine has finished at least one — no evidence, no estimates)."""
+        if self._e2e_n:
+            return self._e2e_sum / self._e2e_n
+        return None
+
+    def _predicted_wait(self, depth: int) -> Optional[float]:
+        """Estimated queue wait for a request arriving at ``depth``:
+        the queue ahead of it drains roughly ``max_seqs`` requests per
+        mean service time (the continuous batch serves that many
+        concurrently)."""
+        est = self._service_estimate()
+        if est is None:
+            return None
+        return (depth / max(self.config.max_seqs, 1)) * est
+
+    def _retry_after_estimate(self) -> Optional[float]:
+        """Structured backoff hint for a rejected submitter: about one
+        batch-slot's worth of service time until queue room opens."""
+        est = self._service_estimate()
+        if est is None:
+            return None
+        return est / max(self.config.max_seqs, 1)
 
     # -- engine side ----------------------------------------------------------
     def step(self) -> bool:
@@ -347,7 +511,14 @@ class ServingEngine:
                 if not self.sched.has_work():
                     self._work.clear()
                 return self.sched.has_work()
-            sampled = self._run_plan(plan, armed)
+            try:
+                sampled = self._run_plan(plan, armed)
+            except Exception as exc:  # noqa: BLE001 — containment seam
+                if self.resilience is None:
+                    raise           # disarmed: the pre-resilience contract
+                self._contain_step_fault(plan, exc, armed, t0)
+                self._notify_admit()
+                return self.sched.has_work()
             self.steps += 1
             queue_depth = self.sched.queue_depth()
             running = len(self.sched.running)
@@ -397,9 +568,101 @@ class ServingEngine:
             _instr.record_serve_spec_tokens(plan.drafted,
                                             sampled["accepted"])
         _instr.record_serve_spec_rollback(sampled["rollback_pages"])
+        self._notify_admit()
         return self.sched.has_work()
 
+    def _notify_admit(self) -> None:
+        """Wake submitters blocked on queue room (policy ``block``)."""
+        if self.resilience is not None:
+            with self._admit_cv:
+                self._admit_cv.notify_all()
+
+    # -- step-fault containment (serving/resilience.py) -----------------------
+    def _contain_step_fault(self, plan, exc: BaseException, armed: bool,
+                            t0: float) -> None:
+        """A raising step never escapes an armed engine. Reset to a
+        consistent state: re-zero the device pools if the fault
+        invalidated the donated buffers, drop prefix-cache content that
+        can no longer be trusted, requeue every running request at the
+        waiting front for prefix recompute (generated tokens ride
+        along), and FAIL requests past their retry budget with a clean
+        terminal error. Runs under the engine lock."""
+        res = self.resilience
+        if isinstance(exc, _res.StepFault):
+            kind = exc.kind
+        elif isinstance(exc, chaos.FaultInjected):
+            kind = "chaos"
+        else:
+            kind = type(exc).__name__
+        self.step_faults += 1
+        _instr.record_serve_step_fault(kind)
+        # the donated pools: a fault AFTER the device call consumed the
+        # old buffers leaves self._kp/_vp deleted — rebuild them (zeros:
+        # every sequence recomputes from scratch anyway)
+        pools_rebuilt = False
+        for name in ("_kp", "_vp"):
+            arr = getattr(self, name)
+            if getattr(arr, "is_deleted", lambda: False)():
+                setattr(self, name,
+                        jnp.zeros(self._pool_shape, self._pool_dtype))
+                pools_rebuilt = True
+        if pools_rebuilt or kind == "nan_logits":
+            # rebuilt pools hold zeros, and garbage logits mean NOTHING
+            # device-resident is trustworthy — cached prefix pages
+            # included. A pure control-flow fault (chaos error before
+            # the device call) keeps the cache: its content was written
+            # by successful steps.
+            self.pool.drop_cache()
+        requeued = self.sched.requeue_all_running(reason=kind)
+        self._tables[:] = -1
+        failed = []
+        for req in requeued:
+            if req.step_retries > res.max_step_retries:
+                err = _res.RequestFailed(
+                    req.rid, reason=f"step_fault:{kind}",
+                    retries=req.step_retries - 1, cause=exc)
+                self.sched.fail_request(req, err, reason="error")
+                failed.append(req)
+                self.requests_failed += 1
+            else:
+                self.request_retries += 1
+                _instr.record_serve_request_retry("step_fault")
+        if armed:
+            self.obs.note_anomaly("step_fault", {
+                "kind": kind, "error": repr(exc),
+                "requeued": [r.rid for r in requeued if r not in failed],
+                "failed": [r.rid for r in failed],
+                "retry_budget": res.max_step_retries})
+            self.obs.record_step({
+                "step": self.steps, "fault": {
+                    "kind": kind, "error": repr(exc),
+                    "pools_rebuilt": pools_rebuilt,
+                    "requeued": [r.rid for r in requeued
+                                 if r not in failed],
+                    "failed": [r.rid for r in failed]},
+                "t_mono_s": round(t0, 6),
+                "dt_s": round(time.monotonic() - t0, 6),
+                "plan": plan.explain,
+                "entries": [{"rid": e.req.rid, "start": e.start,
+                             "n": e.n, "draft": len(e.draft)}
+                            for e in plan.entries],
+                "tokens": 0, "finished": [],
+                "queue_depth": self.sched.queue_depth(),
+                "running": len(self.sched.running),
+                "pool": {"used": self.pool.used_blocks(),
+                         "cached": self.pool.cached_blocks(),
+                         "free": self.pool.free_blocks(),
+                         "utilization":
+                             round(self.pool.utilization(), 4)},
+            })
+        if self.sched.has_work():
+            self._work.set()
+
     def _run_plan(self, plan, armed: bool = False) -> dict:
+        # the step-fault drill seam: an injected error here is exactly a
+        # device step blowing up with requests mid-flight (contained by
+        # _contain_step_fault when the resilience plane is armed)
+        chaos.site("serve.engine_step")
         t_max = self.config.token_budget
         tokens = np.zeros(t_max, np.int32)
         slots = np.zeros(t_max, np.int32)
@@ -430,6 +693,15 @@ class ServingEngine:
             self._w, jnp.asarray(tokens), jnp.asarray(slots),
             jnp.asarray(positions), jnp.asarray(valid),
             jnp.asarray(self._tables), self._kp, self._vp)
+        res = self.resilience
+        if res is not None and res.nan_guard and \
+                not bool(_all_finite(logits)):
+            # garbage logits: fail the STEP before any token of it can
+            # reach a client (pools already swapped — consistent; the
+            # containment path requeues everything for recompute)
+            raise _res.StepFault(
+                "nan_logits", f"step {self.steps + 1} produced non-finite "
+                f"logits over {int(valid.sum())} packed tokens")
         out = {"tokens": 0, "finished": 0, "finished_rids": [],
                "ttfts": [], "accepted": 0, "rollback_pages": 0}
         for e in plan.entries:
@@ -501,6 +773,11 @@ class ServingEngine:
                             self._kp, self._vp, cow[0], cow[1])
             for req in finished:
                 self.sched.evict_finished(req)
+                if req.finished_at is not None:
+                    # service-time evidence the admission-control
+                    # estimates (retry-after, predicted queue wait) read
+                    self._e2e_sum += req.finished_at - req.arrival
+                    self._e2e_n += 1
             out["finished"] = len(finished)
             out["finished_rids"] = [r.rid for r in finished]
             self.spec_proposed += plan.drafted
@@ -533,6 +810,79 @@ class ServingEngine:
                 for p in prompts]
         self.run_until_idle()
         return [r.result(timeout=0) for r in reqs]
+
+    # -- graceful drain / abort (serving/resilience.py) -----------------------
+    def drain(self, deadline_s: Optional[float] = None,
+              manifest_path: Optional[str] = None) -> dict:
+        """Gracefully wind the engine down: stop admission (late
+        ``submit()`` callers get ``AdmissionRejected(reason="draining")``),
+        run decode-only until the running set finishes or the grace
+        budget expires, then export the restart-replay manifest of every
+        UNFINISHED request (prompt + generated tokens + SLO deadlines +
+        submission order) — ``resilience.replay_manifest`` feeds it to
+        the restarted engine. Returns the manifest dict; writes it
+        atomically to ``manifest_path`` (or the resilience config's /
+        PADDLE_SERVE_DRAIN_MANIFEST path) when one is named."""
+        t0 = time.monotonic()
+        with self._lock:
+            self._draining = True
+            self.sched.draining = True
+        self._notify_admit()            # blocked submitters: clean reject
+        idle = 0
+        while True:
+            with self._lock:
+                if not self.sched.running:
+                    break
+            if deadline_s is not None and \
+                    time.monotonic() - t0 >= deadline_s:
+                break
+            before = self.steps
+            self.step()
+            # a wedged pool (nothing schedulable) must not spin the
+            # grace window away: give up after repeated empty plans
+            idle = idle + 1 if self.steps == before else 0
+            if idle >= 100:
+                break
+        drain_seconds = time.monotonic() - t0
+        with self._lock:
+            unfinished = list(self.sched.running) + list(self.sched.waiting)
+            manifest = _res.build_manifest(unfinished, drain_seconds)
+            self.drains += 1
+        path = manifest_path
+        if path is None and self.resilience is not None:
+            path = self.resilience.manifest_path
+        if path:
+            _res.write_manifest(manifest, path)
+        _instr.record_serve_drain(drain_seconds)
+        if self.obs is not None:
+            self.obs.note_anomaly("drain", {
+                "drain_seconds": round(drain_seconds, 6),
+                "deadline_s": deadline_s,
+                "unfinished": len(manifest["requests"]),
+                "manifest": path})
+        return manifest
+
+    def abort_all(self, exc: Optional[BaseException] = None,
+                  reason: str = "engine_abort") -> int:
+        """Terminally fail EVERY live request (running + waiting) with a
+        clean ``RequestFailed`` and reset pool/slot accounting — the
+        last-resort cleanup a front door (``inference.BatchingServer``)
+        uses when a disarmed engine's step raised: queued clients get an
+        exception instead of a forever-parked Future. Returns how many
+        requests were failed. Always available, armed or not."""
+        with self._lock:
+            live = list(self.sched.running) + list(self.sched.waiting)
+            for req in live:
+                err = _res.RequestFailed(req.rid, reason=reason,
+                                         retries=req.step_retries,
+                                         cause=exc)
+                self.sched.fail_request(req, err, reason="error")
+            self.requests_failed += len(live)
+            self._tables[:] = -1
+            if not self.sched.has_work():
+                self._work.clear()
+        self._notify_admit()
+        return len(live)
 
     def spec_stats(self) -> dict:
         """Lifetime speculative-decoding counters (zeros when off)."""
@@ -575,6 +925,20 @@ class ServingEngine:
                 base["spec"]["drafter"] = self.drafter.describe()
             if self.memwatch is not None:
                 base["mem"] = self.memwatch.telemetry()
+            if self.resilience is not None:
+                res = self.resilience
+                base["resilience"] = {
+                    "step_faults": self.step_faults,
+                    "request_retries": self.request_retries,
+                    "requests_failed": self.requests_failed,
+                    "shed_total": self.shed_total,
+                    "drains": self.drains,
+                    "draining": self._draining,
+                    "policy": res.backpressure,
+                    "max_waiting": res.max_waiting,
+                    "max_step_retries": res.max_step_retries,
+                    "service_estimate_s": self._service_estimate(),
+                }
             if self.obs is not None:
                 return self.obs.telemetry(base)
             return base

@@ -129,6 +129,23 @@ class KVBlockPool:
         if key is not None and self._by_key.get(key) == blk:
             del self._by_key[key]
 
+    def drop_cache(self) -> int:
+        """Forget every registered prefix: parked cached pages return to
+        the free list and ALL keys are dropped (pages still referenced
+        by live sequences keep their refcounts, they just stop being
+        prefix-matchable). The step-fault containment reset calls this
+        when device pool content can no longer be trusted — a stale
+        prefix hit would silently serve garbage K/V. Returns how many
+        parked pages were freed."""
+        freed = 0
+        while self._cached:
+            blk, _ = self._cached.popitem(last=False)
+            self._free.append(blk)
+            freed += 1
+        for blk in list(self._key_of):
+            self._drop_key(blk)
+        return freed
+
     def truncate(self, pages: Sequence[int], n_tokens: int
                  ) -> Tuple[List[int], int, Optional[Tuple[int, int]]]:
         """Roll one sequence's page list back so it covers exactly

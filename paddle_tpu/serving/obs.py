@@ -174,7 +174,8 @@ class ServingObserver:
         self._done: "deque[dict]" = deque(maxlen=cfg.flight_requests)
         self._live: Dict[int, Any] = {}          # rid -> Request
         self.counters = {"submitted": 0, "admitted": 0, "finished": 0,
-                         "preempted": 0}
+                         "preempted": 0, "requeued": 0, "failed": 0,
+                         "shed": 0}
         # bounded quantile sketches (private Histogram instances — the
         # registry-facing gauges are updated through instrument.record_*)
         self._lat = {
@@ -272,6 +273,48 @@ class ServingObserver:
                 req.trace.add("preempt", time.monotonic(),
                               reason="pool_pressure", to_grow=to_grow,
                               generated=len(req.output))
+
+    def on_requeue(self, req, reason: str) -> None:
+        """A contained step fault kicked the request back to the waiting
+        queue for recompute (serving/resilience.py). NOT terminal — the
+        request's one finish event still comes later, from wherever it
+        actually ends (completion or terminal failure)."""
+        if not self.armed:
+            return
+        with self._lock:
+            self.counters["requeued"] += 1
+            if req.trace is not None:
+                req.trace.add("step_fault_requeue", time.monotonic(),
+                              reason=reason, retries=req.step_retries,
+                              generated=len(req.output))
+
+    def on_fail(self, req, reason: str) -> None:
+        """Terminal failure/shed: exactly ONE finish event with the
+        failure reason, same lifecycle bookkeeping as a clean finish —
+        but never counted toward SLO attainment or goodput (a shed or
+        failed request produced no deliverable result; its tokens are
+        not goodput)."""
+        if not self.armed:
+            return
+        now = time.monotonic()
+        with self._lock:
+            self.counters["shed" if reason == "shed" else "failed"] += 1
+            if req.trace is not None:
+                req.trace.add(TERMINAL_EVENT, now, reason=reason,
+                              output_tokens=len(req.output), slo_ok=False)
+                life = req.trace.to_dict()
+                life.update({
+                    "prompt_tokens": len(req.prompt),
+                    "output_tokens": len(req.output),
+                    "prefix_tokens": req.n_prefix,
+                    "preemptions": req.preemptions,
+                    "reason": reason,
+                    "e2e_s": round(now - req.arrival, 6),
+                    "error": repr(req.error) if req.error is not None
+                    else None,
+                })
+                self._done.append(life)
+            self._live.pop(req.rid, None)
 
     def on_finish(self, req, reason: str) -> None:
         if not self.armed:

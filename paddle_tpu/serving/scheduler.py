@@ -62,7 +62,8 @@ class Request:
                  on_token: Optional[Callable[[int], None]] = None,
                  stream: bool = False,
                  ttft_deadline: Optional[float] = None,
-                 tpot_deadline: Optional[float] = None):
+                 tpot_deadline: Optional[float] = None,
+                 tag=None):
         if len(prompt) < 1:
             raise ValueError("empty prompt")
         if max_new_tokens < 1:
@@ -85,6 +86,10 @@ class Request:
             else float(ttft_deadline)
         self.tpot_deadline = None if tpot_deadline is None \
             else float(tpot_deadline)
+        # opaque caller identity, carried through drain manifests and
+        # restart replay (a router's affinity key, a drill's stable
+        # request index) — never read by the engine itself
+        self.tag = tag
         self.output: List[int] = []
         self.state = WAITING
         self.slot: Optional[int] = None
@@ -92,6 +97,8 @@ class Request:
         self.pos = 0                  # tokens already in the KV cache
         self.n_prefix = 0             # of which reused from the prefix cache
         self.preemptions = 0
+        self.step_retries = 0         # contained step-fault requeues
+        self.error: Optional[BaseException] = None
         self.arrival = time.monotonic()
         self.first_token_at: Optional[float] = None
         self.finished_at: Optional[float] = None
@@ -103,18 +110,27 @@ class Request:
 
     # -- client-side API ------------------------------------------------------
     def result(self, timeout: Optional[float] = None) -> List[int]:
+        """The full output token list; raises the request's terminal
+        error (``serving.resilience.RequestFailed``) if the engine gave
+        up on it — a failed request resolves, it never hangs."""
         if not self._done.wait(timeout):
             raise TimeoutError(f"request {self.rid} not finished")
+        if self.error is not None:
+            raise self.error
         return list(self.output)
 
     def stream(self):
-        """Yield tokens as they are generated (requires stream=True)."""
+        """Yield tokens as they are generated (requires stream=True).
+        A failed request's stream raises its terminal error after the
+        last delivered token instead of blocking forever."""
         if self._stream is None:
             raise ValueError("request was not created with stream=True")
         while True:
             tok = self._stream.get()
             if tok is None:
                 return
+            if isinstance(tok, BaseException):
+                raise tok
             yield tok
 
     @property
@@ -135,6 +151,21 @@ class Request:
         self.finished_at = time.monotonic()
         if self._stream is not None:
             self._stream.put(None)
+        self._done.set()
+
+    def fail(self, exc: BaseException) -> None:
+        """Resolve this request with a terminal error: ``result()``
+        raises it, ``stream()`` raises it after the delivered tokens.
+        Idempotent against a racing finish — the first terminal state
+        wins."""
+        if self._done.is_set():
+            return
+        self.error = exc
+        self.finish_reason = "error"
+        self.state = FINISHED
+        self.finished_at = time.monotonic()
+        if self._stream is not None:
+            self._stream.put(exc)
         self._done.set()
 
 
@@ -210,6 +241,9 @@ class Scheduler:
         self.waiting: List[Request] = []
         self.running: List[Request] = []   # admission order
         self._free_slots = list(range(self.max_seqs - 1, -1, -1))
+        # drain mode (engine.drain): admission stops, running requests
+        # decode to completion — waiting requests go to the manifest
+        self.draining = False
 
     # -- queue side -----------------------------------------------------------
     def submit(self, req: Request) -> None:
@@ -310,6 +344,47 @@ class Scheduler:
                 victim, to_grow.rid if to_grow is not None else None)
         return victim
 
+    # -- step-fault containment (serving/resilience.py) -----------------------
+    def requeue_all_running(self, reason: str = "step_fault"
+                            ) -> List[Request]:
+        """Kick EVERY running request back to the waiting front for
+        prefix recompute — the step-fault containment reset: after a
+        faulted device step no in-flight KV write can be trusted, so
+        pages are released (content unregistered) and each request
+        recomputes from its surviving ``seq`` (prompt + generated
+        tokens, the PR 6 preemption mechanics). Requests rejoin the
+        waiting queue in submission order, AHEAD of never-admitted
+        arrivals; each carries one more ``step_retries`` tick for the
+        engine's retry-budget check. Returns the requeued requests."""
+        victims = sorted(self.running, key=lambda r: r.rid)
+        self.running.clear()
+        for req in reversed(victims):
+            self._release(req, cache_prefix=False)
+            req.state = WAITING
+            req.pos = 0
+            req.n_prefix = 0
+            req.step_retries += 1
+            self.waiting.insert(0, req)
+            if self.obs is not None:
+                self.obs.on_requeue(req, reason)
+        return victims
+
+    def fail_request(self, req: Request, exc: BaseException,
+                     reason: str = "error") -> None:
+        """Terminally fail one request (retry budget exhausted, engine
+        abort): evict it from wherever it lives, release its pages
+        WITHOUT caching (its KV content is not trusted), record exactly
+        one terminal lifecycle event, and resolve its ``result()``/
+        ``stream()`` with the error instead of leaving it parked."""
+        if req in self.running:
+            self.running.remove(req)
+            self._release(req, cache_prefix=False)
+        elif req in self.waiting:
+            self.waiting.remove(req)
+        req.fail(exc)                 # resolve first: clients unblock now
+        if self.obs is not None:
+            self.obs.on_fail(req, reason)
+
     # -- the per-step planner -------------------------------------------------
     def schedule(self) -> StepPlan:
         entries: List[StepEntry] = []
@@ -370,6 +445,9 @@ class Scheduler:
         can_admit = not self.running if self.policy == "static" else True
         stopped_by = None
         while self.waiting:
+            if self.draining:
+                stopped_by = "drain"
+                break
             if not can_admit:
                 stopped_by = "policy"
                 break

@@ -1,0 +1,158 @@
+"""Serving-drill worker (subprocess target): drain on preemption, replay
+on restart.
+
+The serving twin of preempt_worker.py. A deterministic ServingEngine run
+wired with the full serving-resilience stack: `PreemptionGuard`
+(signals + chaos notice), `serve_until_preempted` (the canonical driver
+loop: step while work remains, poll the guard, drain into the manifest
+named by PADDLE_SERVE_DRAIN_MANIFEST within the grace window), and the
+`drain -> exit 84 -> supervisor restart -> replay_manifest` contract.
+
+    python tests/serve_worker.py --seed 1234 --requests 6 --max-new 8 \
+        --preempt-at 3 --results RESULTS.json [--marker-dir DIR]
+
+Generation 0 submits a seeded workload (every request tagged with its
+submission index) and, in mode=chaos, installs a FaultPlan that injects
+an error at the `preempt.notice` probe on hit `--preempt-at` — a fully
+deterministic preemption at that exact step boundary. It drains, records
+the outputs of already-FINISHED requests into --results (keyed by tag),
+and exits PREEMPTED_EXIT_CODE. Generation > 0 finds the drain manifest
+(the env path the supervisor shares across generations), replays it,
+runs clean to completion, merges its outputs into --results, deletes the
+consumed manifest, and exits 0.
+
+Markers written to --marker-dir:
+    pid                  this process's pid
+    gen<G>.fresh<N>      generation G submitted N fresh requests
+    gen<G>.replay<N>     generation G replayed N manifest requests
+    drained.<K>          drain exported K unfinished requests
+    done.<N>             run finished with N results recorded
+"""
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def build_model(seed: int):
+    """The drill model — ALSO built in-process by chaos_drill.py with
+    the same seed, so the oracle outputs and the worker outputs come
+    from bit-identical weights."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    paddle.seed(seed % (2 ** 31))
+    cfg = LlamaConfig.tiny(vocab_size=61, hidden_size=32, layers=2,
+                           heads=4, kv_heads=2, seq=64)
+    cfg.use_flash_attention = False
+    return LlamaForCausalLM(cfg)
+
+
+def build_prompts(seed: int, n: int):
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, 61, (int(rng.integers(4, 12)),)).tolist()
+            for _ in range(n)]
+
+
+def _merge_results(path: str, outputs: dict) -> int:
+    """Read-modify-write of the cross-generation results file (the
+    generations run strictly sequentially under the supervisor, so a
+    plain read+rewrite is race-free)."""
+    merged = {}
+    if os.path.exists(path):
+        with open(path) as f:
+            merged = json.load(f)
+    merged.update({str(k): v for k, v in outputs.items()})
+    tmp = f"{path}.tmp-{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(merged, f, indent=1)
+    os.replace(tmp, path)
+    return len(merged)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seed", type=int, default=1234)
+    ap.add_argument("--requests", type=int, default=6)
+    ap.add_argument("--max-new", type=int, default=8)
+    ap.add_argument("--preempt-at", type=int, default=3,
+                    help="chaos preempt.notice hit index (gen 0 only)")
+    ap.add_argument("--grace", type=float, default=10.0)
+    ap.add_argument("--results", required=True,
+                    help="cross-generation outputs JSON (tag -> tokens)")
+    ap.add_argument("--marker-dir", default=None)
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.resilience import (FaultPlan, PreemptionGuard,
+                                       PREEMPTED_EXIT_CODE, chaos)
+    from paddle_tpu.serving import (EngineConfig, ResilienceConfig,
+                                    ServingEngine, replay_manifest,
+                                    serve_until_preempted)
+    from paddle_tpu.serving.resilience import ENV_DRAIN_MANIFEST
+
+    gen = int(os.environ.get("PADDLE_RESTART_GENERATION", "0") or 0)
+    manifest_path = os.environ.get(ENV_DRAIN_MANIFEST, "").strip()
+    marker_dir = args.marker_dir
+    if marker_dir:
+        os.makedirs(marker_dir, exist_ok=True)
+
+    def mark(name: str) -> None:
+        if marker_dir:
+            with open(os.path.join(marker_dir, name), "w") as f:
+                f.write("")
+
+    if marker_dir:
+        with open(os.path.join(marker_dir, "pid"), "w") as f:
+            f.write(str(os.getpid()))
+
+    model = build_model(args.seed)
+    eng = ServingEngine(model, EngineConfig(
+        max_seqs=2, token_budget=16, block_size=8,
+        resilience=ResilienceConfig(max_step_retries=2)))
+
+    if manifest_path and os.path.exists(manifest_path):
+        # restarted generation: finish what the dead one handed over
+        handles = replay_manifest(eng, manifest_path)
+        mark(f"gen{gen}.replay{len(handles)}")
+    else:
+        prompts = build_prompts(args.seed, args.requests)
+        handles = [eng.submit(p, max_new_tokens=args.max_new, tag=i)
+                   for i, p in enumerate(prompts)]
+        mark(f"gen{gen}.fresh{len(handles)}")
+
+    guard = PreemptionGuard(grace=args.grace).install()
+    if gen == 0 and args.preempt_at > 0:
+        plan = FaultPlan(seed=args.seed)
+        plan.add("preempt.notice", "error", at=(args.preempt_at,))
+        chaos.install_plan(plan)
+    try:
+        state, manifest = serve_until_preempted(
+            eng, guard, manifest_path=manifest_path or None,
+            stop_when_idle=True)
+    finally:
+        guard.uninstall()
+        chaos.clear_plan()
+
+    outputs = {h.tag: h.output for h in handles
+               if h.done and h.error is None}
+    n_recorded = _merge_results(args.results, outputs)
+    if state == "drained":
+        mark(f"drained.{len(manifest['requests'])}")
+        sys.stderr.write(
+            f"serve_worker: gen {gen} drained "
+            f"{len(manifest['requests'])} unfinished requests\n")
+        return PREEMPTED_EXIT_CODE
+    # clean completion: the manifest is consumed — a stale one would
+    # make a LATER restart replay requests that already finished
+    if manifest_path and os.path.exists(manifest_path):
+        os.remove(manifest_path)
+    mark(f"done.{n_recorded}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -34,9 +34,23 @@ published error bound. Writes a BENCH_SERVE_<tag>.json artifact
 seconds on CPU) whose throughput floors (continuous > static; with
 --spec, spec > nonspec) tests/test_serve_engine.py asserts.
 
+``--chaos`` adds the resilience pair (ROADMAP serving-resilience):
+the same seeded OVERLOAD schedule (arrival rate far past capacity,
+every request deadline-tracked) with a seeded ``serve.engine_step``
+fault injected mid-run, driven twice. ``chaos_baseline`` is the PR 6
+engine: unbounded queue, no containment — the fault escapes ``step()``
+and wedges the driver (the bench models the dead thread by stopping
+the drive loop), parking every in-flight request. ``chaos_resilient``
+arms the resilience plane (bounded queue, SLO-aware shed, retry
+budget): the fault is contained and retried, overload is refused as
+typed ``AdmissionRejected`` sheds, and every accepted request FINISHES
+— the row asserts zero parked requests and strictly more goodput than
+the baseline. Both rows face the identical schedule and fault plan.
+
 Usage:
   python tools/bench_serve.py --fast --spec         # tier-1 smoke
   python tools/bench_serve.py --spec --tag r07
+  python tools/bench_serve.py --chaos --tag r13
 """
 import argparse
 import json
@@ -214,10 +228,142 @@ def drive(model, workload, policy: str, engine_kw: dict, spec_kw=None,
     return row
 
 
+def drive_chaos(model, workload, engine_kw: dict, resilient: bool,
+                fault_at, seed: int, slo, max_waiting: int):
+    """One overload+fault run. ``resilient=False`` reproduces the PR 6
+    failure mode: the injected ``serve.engine_step`` error escapes
+    ``step()`` and the driver stops (requests park forever — counted,
+    not waited for). ``resilient=True`` arms containment + SLO-aware
+    shed: the fault is retried, overload is refused at ``submit()``,
+    and the run drains completely. Both see the identical seeded
+    schedule and fault plan."""
+    from paddle_tpu.resilience import chaos
+    from paddle_tpu.serving import (AdmissionRejected, EngineConfig,
+                                    ObsConfig, ResilienceConfig,
+                                    ServingEngine)
+    res_cfg = ResilienceConfig(max_step_retries=3, nan_guard=True,
+                               max_waiting=max_waiting,
+                               backpressure="shed") if resilient else False
+    eng = ServingEngine(model, EngineConfig(
+        policy="continuous", resilience=res_cfg,
+        obs=ObsConfig(flight_steps=64, flight_requests=32), **engine_kw))
+    ttft_d, tpot_d = slo
+    plan = chaos.FaultPlan(seed=seed).add("serve.engine_step", "error",
+                                          at=fault_at)
+    chaos.install_plan(plan)
+    pending = sorted(workload, key=lambda r: r["arrival_s"])
+    handles, shed, failed = [], 0, 0
+    wedged = False
+    t0 = time.monotonic()
+    i = 0
+    try:
+        while i < len(pending) or eng.has_work():
+            now = time.monotonic() - t0
+            while i < len(pending) and pending[i]["arrival_s"] <= now:
+                r = pending[i]
+                i += 1
+                try:
+                    handles.append((r, eng.submit(
+                        r["prompt"], max_new_tokens=r["max_new"],
+                        ttft_deadline=ttft_d, tpot_deadline=tpot_d)))
+                except AdmissionRejected:
+                    shed += 1
+            if wedged:
+                if i >= len(pending):
+                    break       # nobody will ever serve the rest
+                time.sleep(0.001)
+                continue
+            if eng.has_work():
+                try:
+                    eng.step()
+                except Exception:
+                    # the PR 6 wedge: the driver thread dies with its
+                    # RUNNING requests parked — keep accepting arrivals
+                    # (the queue is unbounded) but never step again
+                    wedged = True
+            elif i < len(pending):
+                time.sleep(min(pending[i]["arrival_s"] - now, 0.005))
+    finally:
+        chaos.clear_plan()
+    wall = time.monotonic() - t0
+    finished = parked = tokens = 0
+    for _, req in handles:
+        if req.done and req.error is None:
+            finished += 1
+            tokens += len(req.output)
+        elif req.done:
+            failed += 1
+        else:
+            parked += 1
+    tel = eng.telemetry()
+    goodput = tel["slo"]["goodput_tokens"]
+    row = {
+        "resilient": resilient,
+        "requests": len(handles) + shed,
+        "accepted": len(handles),
+        "finished": finished,
+        "parked": parked,
+        "failed": failed,
+        "shed": shed,
+        "wedged": wedged,
+        "engine_step_faults": getattr(eng, "step_faults", 0),
+        "output_tokens": int(tokens),
+        "wall_s": round(wall, 4),
+        "tokens_per_s": round(tokens / wall, 2),
+        "slo_attainment": tel["slo"]["attainment"],
+        "goodput_tokens": goodput,
+        "goodput_tokens_per_s": round(goodput / wall, 2),
+    }
+    if resilient:
+        row["resilience"] = tel["resilience"]
+    return row
+
+
+def run_chaos_pair(model, seed: int, fast: bool, engine_kw: dict):
+    """The fault+overload schedule and both rows. Overload: arrivals at
+    several times the engine's drain rate; fault: one seeded
+    ``serve.engine_step`` error once the batch is saturated."""
+    vocab = model.config.vocab_size
+    if fast:
+        n_requests, rate, max_waiting = 24, 400.0, 6
+        slo = (2.0, 2.0)
+    else:
+        n_requests, rate, max_waiting = 64, 120.0, 12
+        slo = (2.0, 0.5)
+    workload = make_workload(seed + 2, n_requests, rate, vocab)
+    fault_at = (6,)
+    rows = {}
+    for name, resilient in (("chaos_baseline", False),
+                            ("chaos_resilient", True)):
+        rows[name] = drive_chaos(model, workload, engine_kw, resilient,
+                                 fault_at, seed, slo, max_waiting)
+        r = rows[name]
+        print(f"[bench_serve] {name:15s}: finished {r['finished']:3d}/"
+              f"{r['requests']}  parked {r['parked']:3d}  "
+              f"shed {r['shed']:3d}  goodput "
+              f"{r['goodput_tokens_per_s']:.1f} tok/s  "
+              f"wedged={r['wedged']}", flush=True)
+    base, res = rows["chaos_baseline"], rows["chaos_resilient"]
+    assert base["wedged"] and base["parked"] > 0, \
+        "baseline did not wedge — the chaos schedule lost its teeth"
+    assert not res["wedged"] and res["parked"] == 0, \
+        f"resilient engine parked requests: {res}"
+    assert res["goodput_tokens"] > base["goodput_tokens"], \
+        "resilience did not protect goodput under fault+overload"
+    rows["chaos_workload"] = {"n_requests": n_requests, "rate_rps": rate,
+                              "poisson": True, "open_loop": True,
+                              "fault": {"site": "serve.engine_step",
+                                        "at": list(fault_at)},
+                              "max_waiting": max_waiting,
+                              "slo": {"ttft_deadline_s": slo[0],
+                                      "tpot_deadline_s": slo[1]}}
+    return rows
+
+
 def run_bench(fast: bool = True, seed: int = 0, tag: str = "fast",
               n_requests: int = None, rate: float = None,
               out_path: str = None, spec: bool = False,
-              num_draft_tokens: int = 4, slo=None):
+              num_draft_tokens: int = 4, slo=None, chaos: bool = False):
     model = _build_model(fast)
     vocab = model.config.vocab_size
     if fast:
@@ -297,6 +443,16 @@ def run_bench(fast: bool = True, seed: int = 0, tag: str = "fast",
         result["vs_nonspec"] = round(
             rows["spec"]["tokens_per_s"]
             / max(rows["nonspec"]["tokens_per_s"], 1e-9), 3)
+    if chaos:
+        # resilience pair: identical fault+overload schedule, PR 6
+        # baseline behavior (wedge) vs the armed resilience plane
+        crows = run_chaos_pair(model, seed, fast, engine_kw)
+        result["chaos_workload"] = crows["chaos_workload"]
+        result["chaos_baseline"] = crows["chaos_baseline"]
+        result["chaos_resilient"] = crows["chaos_resilient"]
+        result["chaos_goodput_ratio"] = round(
+            crows["chaos_resilient"]["goodput_tokens"]
+            / max(crows["chaos_baseline"]["goodput_tokens"], 1), 3)
     if out_path is None:
         out_path = os.path.join(HERE, f"BENCH_SERVE_{tag}.json")
     tmp = out_path + ".tmp"
@@ -332,6 +488,10 @@ def main(argv=None):
     ap.add_argument("--spec", action="store_true",
                     help="add the speculative vs non-speculative pair on "
                          "a repetitive workload")
+    ap.add_argument("--chaos", action="store_true",
+                    help="add the resilience pair: seeded fault+overload "
+                         "schedule, PR 6 baseline (wedges) vs the armed "
+                         "resilience plane (contains, sheds, finishes)")
     ap.add_argument("--draft-tokens", type=int, default=4,
                     help="per-sequence draft budget k for --spec")
     ap.add_argument("--out", default=None)
@@ -340,7 +500,7 @@ def main(argv=None):
     res = run_bench(fast=args.fast, seed=args.seed, tag=tag,
                     n_requests=args.requests, rate=args.rate,
                     out_path=args.out, spec=args.spec,
-                    num_draft_tokens=args.draft_tokens)
+                    num_draft_tokens=args.draft_tokens, chaos=args.chaos)
     ok = res["vs_static"] > 1.0 and res.get("vs_nonspec", 2.0) > 1.0
     return 0 if ok else 1
 

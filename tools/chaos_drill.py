@@ -36,6 +36,26 @@ dump subset (reason, exhaustion site/phase, step/request ids).
 
     JAX_PLATFORMS=cpu python tools/chaos_drill.py --flight [--seed 1234]
 
+``--serve`` runs the serving-resilience drill
+(paddle_tpu.serving.resilience), two phases. In-process: a seeded
+``serve.engine_step`` fault against an armed resilience plane must be
+contained — exactly one fault, every affected request retried once
+(requeued for prefix recompute), final outputs BIT-IDENTICAL to a
+fault-free run, driver never sees the exception; an always-faulting
+plan must converge to clean terminal ``RequestFailed`` errors (bounded
+retry budget, no hang) and leave the engine reusable. Supervised: a
+serving worker (tools/supervise.py wrapping tests/serve_worker.py)
+takes a seeded preemption notice mid-serving, drains its in-flight
+requests into the shared drain manifest within the grace window, exits
+PREEMPTED_EXIT_CODE, is restarted, REPLAYS the manifest and finishes
+every request — with greedy token-prefix consistency across the
+restart (the final outputs equal the fault-free oracle, and each
+drained request's pre-kill tokens are a prefix of its final output).
+Deterministic per seed: the ``stable`` report subset is bit-identical
+across runs.
+
+    JAX_PLATFORMS=cpu python tools/chaos_drill.py --serve [--seed 1234]
+
 ``--mem`` runs the memory-pressure drill: an armed memory watcher
 (paddle_tpu.profiler.memwatch) with a seeded growth workload filling the
 ``kv_pages`` pool must produce EXACTLY one well-formed pressure dump
@@ -467,6 +487,174 @@ def run_mem_drill(seed: int = 1234, verbose: bool = True):
     return report
 
 
+def run_serve_drill(seed: int = 1234, verbose: bool = True,
+                    supervised: bool = True, work_dir: str = None):
+    """Seeded serving-resilience drill (see module docstring).
+
+    Phase 1 (in-process): containment — one injected ``serve.engine_step``
+    fault is absorbed (bit-identical outputs, exactly one contained
+    retry round), and an always-faulting plan converges to clean
+    terminal errors within the retry budget. Phase 2 (supervised,
+    ``supervised=True``): the kill→drain→restart→replay loop through
+    tools/supervise.py and tests/serve_worker.py, asserting every
+    request finishes after the restart with greedy token-prefix
+    consistency. Returns a report whose ``stable`` subset is
+    bit-identical per seed."""
+    import subprocess
+    import sys as _sys
+    import zlib
+
+    import numpy as np
+
+    from paddle_tpu.resilience import chaos
+    from paddle_tpu.serving import (EngineConfig, ResilienceConfig,
+                                    RequestFailed, ServingEngine)
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(repo, "tests"))
+    import serve_worker
+
+    model = serve_worker.build_model(seed)
+    prompts = serve_worker.build_prompts(seed, 6)
+    max_new = 8
+
+    def run(fault_plan, retries=2):
+        eng = ServingEngine(model, EngineConfig(
+            max_seqs=2, token_budget=16, block_size=8,
+            resilience=ResilienceConfig(max_step_retries=retries)))
+        if fault_plan is not None:
+            chaos.install_plan(fault_plan)
+        try:
+            reqs = [eng.submit(p, max_new_tokens=max_new, tag=i)
+                    for i, p in enumerate(prompts)]
+            eng.run_until_idle(max_steps=400)
+        finally:
+            chaos.clear_plan()
+        return eng, reqs
+
+    # -- phase 1a: fault-free oracle, then one contained fault ----------------
+    _, oracle_reqs = run(None)
+    oracle = [r.result(0) for r in oracle_reqs]
+    plan = chaos.FaultPlan(seed=seed).add("serve.engine_step", "error",
+                                          at=(2,))
+    eng, reqs = run(plan)
+    got = [r.result(0) for r in reqs]
+    assert got == oracle, "contained fault changed tokens"
+    assert eng.step_faults == 1, \
+        f"expected exactly one contained fault, got {eng.step_faults}"
+    assert [f[0] for f in plan.fired] == ["serve.engine_step"]
+    assert eng.requests_failed == 0
+    assert eng.pool.used_blocks() == 0, "containment leaked pages"
+    retried = eng.request_retries
+
+    # -- phase 1b: past-budget => clean terminal errors, engine reusable ------
+    always = chaos.FaultPlan(seed=seed).add("serve.engine_step", "error",
+                                            prob=1.0)
+    eng2, reqs2 = run(always, retries=1)
+    failures = 0
+    for r in reqs2:
+        assert r.done, "past-budget request left hanging"
+        try:
+            r.result(0)
+        except RequestFailed:
+            failures += 1
+    assert failures == len(reqs2), \
+        f"only {failures}/{len(reqs2)} requests failed cleanly"
+    assert eng2.pool.used_blocks() == 0
+    # the driver survived: with chaos cleared the SAME engine serves again
+    again = eng2.submit(prompts[0], max_new_tokens=max_new)
+    eng2.run_until_idle(max_steps=200)
+    assert again.result(0) == oracle[0], "engine unusable after failures"
+
+    report = {
+        "seed": seed, "ok": True,
+        "stable": {
+            "oracle_crc": zlib.crc32(np.asarray(
+                [t for o in oracle for t in o], np.int64).tobytes()),
+            "contained_faults": eng.step_faults,
+            "contained_retries": retried,
+            "budget_failures": failures,
+        },
+    }
+    if verbose:
+        print(f"serve drill (seed={seed}): 1 injected engine-step fault "
+              f"contained ({retried} requests requeued, outputs "
+              f"bit-identical); always-faulting plan -> {failures} clean "
+              "terminal errors, engine reusable — containment verified")
+    if not supervised:
+        return report
+
+    # -- phase 2: supervised kill -> drain -> restart -> replay ---------------
+    ctx = tempfile.TemporaryDirectory() if work_dir is None else None
+    root = work_dir if work_dir is not None else ctx.name
+    try:
+        markers = os.path.join(root, "markers")
+        reports = os.path.join(root, "reports")
+        results = os.path.join(root, "results.json")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PADDLE_CHAOS_PLAN", None)  # the worker arms its own plan
+        env.pop("PADDLE_SERVE_DRAIN_MANIFEST", None)  # supervisor threads it
+        r = subprocess.run(
+            [_sys.executable, os.path.join(repo, "tools", "supervise.py"),
+             "--max-restarts", "2", "--seed", str(seed),
+             "--report-dir", reports, "--",
+             _sys.executable, os.path.join(repo, "tests",
+                                           "serve_worker.py"),
+             "--seed", str(seed), "--requests", str(len(prompts)),
+             "--max-new", str(max_new), "--preempt-at", "3",
+             "--results", results, "--marker-dir", markers],
+            capture_output=True, timeout=600, env=env, cwd=repo)
+        err = r.stderr.decode()
+        assert r.returncode == 0, \
+            f"supervised serving run failed rc={r.returncode}:\n{err}"
+        got_markers = sorted(os.listdir(markers))
+        drained = [m for m in got_markers if m.startswith("drained.")]
+        assert drained, f"generation 0 never drained: {got_markers}"
+        n_manifest = int(drained[0].split(".", 1)[1])
+        assert n_manifest > 0, "drain exported zero requests (kill " \
+            "landed after the workload finished — preempt-at too late)"
+        assert f"gen1.replay{n_manifest}" in got_markers, \
+            f"generation 1 did not replay the manifest: {got_markers}"
+        with open(os.path.join(reports, "crash_report_0.json")) as f:
+            rep0 = json.load(f)
+        assert rep0["cause"] == "preempted" and rep0["exit_code"] == 84, \
+            f"generation 0 misclassified: {rep0['cause']}"
+        assert rep0.get("drain") and \
+            rep0["drain"]["requests"] == n_manifest, \
+            f"crash report missed the drain hand-off: {rep0.get('drain')}"
+        assert not os.path.exists(
+            os.path.join(reports, "crash_report_2.json")), \
+            "more than one restart — replay did not stick"
+        with open(results) as f:
+            finals = json.load(f)
+        assert len(finals) == len(prompts), \
+            f"requests parked across the restart: {sorted(finals)}"
+        # greedy token-prefix consistency: the post-restart outputs ARE
+        # the fault-free outputs (replayed tokens rode along as the
+        # prefix, the restarted engine greedily continued them)
+        got_final = [finals[str(i)] for i in range(len(prompts))]
+        assert got_final == oracle, \
+            "restart replay diverged from the fault-free oracle"
+        report["stable"]["manifest_requests"] = n_manifest
+        report["stable"]["replay_crc"] = zlib.crc32(np.asarray(
+            [t for o in got_final for t in o], np.int64).tobytes())
+        report["supervised"] = {
+            "generations": 2,
+            "drain_seconds": rep0["drain"]["drain_seconds"],
+            "handed_over_tokens": rep0["drain"]["generated_tokens"],
+        }
+        if verbose:
+            print(f"  supervised: kill at step boundary 3 -> drained "
+                  f"{n_manifest} requests -> restart replayed -> all "
+                  f"{len(prompts)} finished, outputs == fault-free "
+                  "oracle — kill/drain/restart/replay verified")
+        return report
+    finally:
+        if ctx is not None:
+            ctx.cleanup()
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seed", type=int, default=1234)
@@ -481,6 +669,14 @@ def main(argv=None):
     ap.add_argument("--flight", action="store_true",
                     help="run the serving flight-recorder drill (seeded "
                          "pool exhaustion => exactly one dump)")
+    ap.add_argument("--serve", action="store_true",
+                    help="run the serving-resilience drill (contained "
+                         "engine-step fault + supervised kill/drain/"
+                         "restart/replay)")
+    ap.add_argument("--no-supervised", action="store_true",
+                    help="with --serve: skip the supervised "
+                         "kill/restart phase (in-process containment "
+                         "only)")
     ap.add_argument("--mem", action="store_true",
                     help="run the memory-pressure drill (seeded pool "
                          "growth => exactly one dump naming the pool)")
@@ -490,6 +686,9 @@ def main(argv=None):
                                    aot=not args.no_aot)
     elif args.flight:
         report = run_flight_drill(seed=args.seed, verbose=not args.json)
+    elif args.serve:
+        report = run_serve_drill(seed=args.seed, verbose=not args.json,
+                                 supervised=not args.no_supervised)
     elif args.mem:
         report = run_mem_drill(seed=args.seed, verbose=not args.json)
     else:

@@ -120,6 +120,35 @@ def _flight_dump(env: dict, since: float) -> object:
         return {"unparseable": path}
 
 
+def _drain_report(env: dict, since: float) -> object:
+    """Summarize the serving drain manifest (PADDLE_SERVE_DRAIN_MANIFEST,
+    written by engine.drain() inside the grace window) for the crash
+    report: how many in-flight requests the dying generation handed
+    over, how many tokens they had already generated, and how long the
+    drain took — the restart-replay contract made visible in the
+    postmortem. Same stale-mtime rule as _metrics_dump: a manifest the
+    PREVIOUS generation left (and this one already replayed) is not this
+    attempt's hand-off."""
+    path = env.get("PADDLE_SERVE_DRAIN_MANIFEST", "")
+    if not path or not os.path.exists(path):
+        return None
+    try:
+        if os.path.getmtime(path) < since:
+            return None  # stale: written by an earlier attempt
+        with open(path) as f:
+            manifest = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {"unparseable": path}
+    reqs = manifest.get("requests") or []
+    return {
+        "path": path,
+        "requests": len(reqs),
+        "generated_tokens": sum(len(r.get("generated") or ())
+                                for r in reqs),
+        "drain_seconds": manifest.get("drain_seconds"),
+    }
+
+
 def _mem_report(env: dict, since: float) -> object:
     """Inline the worker's memory-watcher dump (PADDLE_MEMWATCH_DUMP,
     written by paddle_tpu.profiler.memwatch on near-OOM pressure or on
@@ -337,6 +366,15 @@ class Supervisor:
             # near-OOM postmortem is inlined into the crash report
             env.setdefault("PADDLE_MEMWATCH_DUMP", os.path.join(
                 self.report_dir, f"memwatch_{self.generation}.json"))
+            # the serving mode: ONE drain-manifest path shared by every
+            # generation (unlike the per-generation dumps above) — a
+            # preempted serving worker drains its in-flight requests
+            # into it, and the RESTARTED generation replays them
+            # (serving/resilience.py replay_manifest; the env also arms
+            # the worker's resilience plane). An explicit path from the
+            # launcher wins.
+            env.setdefault("PADDLE_SERVE_DRAIN_MANIFEST", os.path.join(
+                self.report_dir, "drain_manifest.json"))
         return env
 
     def _aot_stats_path(self) -> str:
@@ -381,6 +419,7 @@ class Supervisor:
             "flight": _flight_dump(env, wall0),
             "perf": _perf_report(env, wall0),
             "mem": _mem_report(env, wall0),
+            "drain": _drain_report(env, wall0),
         }
         if isinstance(report["aot"], dict):
             report["cold_start_seconds"] = \
