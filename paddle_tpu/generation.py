@@ -276,32 +276,41 @@ class _LlamaDecoder:
             att = _attend(q, kc, vc, score_mask).reshape(b, s, -1)
         return self._post_attn(w, i, h, att), kc, vc
 
-    def _layer_ragged(self, w, i, h, cos, sin, kp, vp, scatter, attend):
+    def _layer_ragged(self, w, i, h, cos, sin, kp, vp, scatter, attend,
+                      shard=None):
         """One layer over a PACKED ragged batch (mixed prefill chunks and
         decode tokens from different sequences as a [T, 1, ...] batch).
         kp/vp: [P, kvh, bs, D] paged pools; scatter: (pages [T], offs [T])
         per-token write targets (page index P == dropped row); attend:
         callable(q [T, H, D], kp, vp) -> [T, H, D] — the ragged paged
-        attention (paddle_tpu.serving.ragged supplies it)."""
+        attention (paddle_tpu.serving.ragged supplies it); shard: the
+        serving engine's tensor-parallel annotator (None = single chip) —
+        it pins q/k/v to the per-head layout right after the projection
+        and the attention output right before the row-parallel o_proj,
+        the same two seams the training side shards."""
         t, s, _ = h.shape
         x = _rms(h, self._lw(w, i, "input_layernorm.weight"), self.eps)
         q, k, v = self._qkv_proj(w, i, x, t, s)
         q = _rope_rows(q, cos, sin)
         k = _rope_rows(k, cos, sin)
+        if shard is not None:
+            q, k, v = shard.qkv(q, k, v)
         pages, offs = scatter
         kp = kp.at[pages, :, offs, :].set(k[:, 0].astype(kp.dtype),
                                           mode="drop")
         vp = vp.at[pages, :, offs, :].set(v[:, 0].astype(vp.dtype),
                                           mode="drop")
         att = attend(q[:, 0], kp, vp).reshape(t, 1, -1)
+        if shard is not None:
+            att = shard.att(att)
         return self._post_attn(w, i, h, att), kp, vp
 
     def step_ragged(self, w, tokens, positions, k_pools, v_pools, scatter,
-                    attend):
+                    attend, shard=None):
         """Ragged-batch twin of step(): tokens/positions: [T] packed
         mixed-phase batch (each entry one token of some sequence at its
         absolute position); k_pools/v_pools: [L, P, kvh, bs, D] shared
-        block pools; scatter/attend as in _layer_ragged. Returns
+        block pools; scatter/attend/shard as in _layer_ragged. Returns
         (logits [T, V], k_pools', v_pools')."""
         emb = w[self.embed_key]
         h = emb[tokens][:, None]                     # [T, 1, H*D]
@@ -310,10 +319,33 @@ class _LlamaDecoder:
         new_k, new_v = [], []
         for i in range(self.n_layers):
             h, kp, vp = self._layer_ragged(w, i, h, cos, sin, k_pools[i],
-                                           v_pools[i], scatter, attend)
+                                           v_pools[i], scatter, attend,
+                                           shard=shard)
             new_k.append(kp)
             new_v.append(vp)
         return self._logits(w, h)[:, 0], jnp.stack(new_k), jnp.stack(new_v)
+
+    _TP_COL = ("self_attn.q_proj.weight", "self_attn.k_proj.weight",
+               "self_attn.v_proj.weight", "mlp.gate_proj.weight",
+               "mlp.up_proj.weight")
+    _TP_ROW = ("self_attn.o_proj.weight", "mlp.down_proj.weight")
+
+    def tp_specs(self):
+        """Per-weight-name PartitionSpec entries (as plain tuples) for
+        tensor-parallel serving over an ``mp`` mesh axis: the Megatron
+        column/row split at the ``_qkv_proj``/``_post_attn`` seams —
+        q/k/v/gate/up shard their OUTPUT dim (per-head / per-neuron, no
+        collective), o_proj/down shard their INPUT dim (the psum lands
+        on the residual). Names absent from the map stay replicated
+        (embeddings, norms, rope tables, lm head)."""
+        specs = {}
+        for i in range(self.n_layers):
+            pre = f"model.layers.{i}."
+            for n in self._TP_COL:
+                specs[pre + n] = (None, "mp")
+            for n in self._TP_ROW:
+                specs[pre + n] = ("mp", None)
+        return specs
 
     def _logits(self, w, h):
         h = _rms(h, w["model.norm.weight"], self.eps)
@@ -475,36 +507,57 @@ class _GPTDecoder:
         att = _attend(q, kc, vc, score_mask).reshape(b, s, -1)
         return self._post_attn(w, i, h, att), kc, vc
 
-    def _layer_ragged(self, w, i, h, kp, vp, scatter, attend):
+    def _layer_ragged(self, w, i, h, kp, vp, scatter, attend, shard=None):
         """Packed ragged-batch layer (see _LlamaDecoder._layer_ragged);
         GPT has no rope — positions enter through the wpe embedding."""
         p = f"transformer.h.{i}."
         t, s, _ = h.shape
         x = _ln(h, w[p + "ln_1.weight"], w[p + "ln_1.bias"], self.eps)
         q, k, v = self._qkv_proj(w, i, x, t, s)
+        if shard is not None:
+            q, k, v = shard.qkv(q, k, v)
         pages, offs = scatter
         kp = kp.at[pages, :, offs, :].set(k[:, 0].astype(kp.dtype),
                                           mode="drop")
         vp = vp.at[pages, :, offs, :].set(v[:, 0].astype(vp.dtype),
                                           mode="drop")
         att = attend(q[:, 0], kp, vp).reshape(t, 1, -1)
+        if shard is not None:
+            att = shard.att(att)
         return self._post_attn(w, i, h, att), kp, vp
 
     def step_ragged(self, w, tokens, positions, k_pools, v_pools, scatter,
-                    attend):
+                    attend, shard=None):
         """Ragged-batch twin of step(); see _LlamaDecoder.step_ragged."""
         h = (w["transformer.wte.weight"][tokens]
              + w["transformer.wpe.weight"][positions])[:, None]
         new_k, new_v = [], []
         for i in range(self.n_layers):
             h, kp, vp = self._layer_ragged(w, i, h, k_pools[i], v_pools[i],
-                                           scatter, attend)
+                                           scatter, attend, shard=shard)
             new_k.append(kp)
             new_v.append(vp)
         h = _ln(h, w["transformer.ln_f.weight"], w["transformer.ln_f.bias"],
                 self.eps)
         logits = _head_logits(w, h, self.tied, self.embed_key)
         return logits[:, 0], jnp.stack(new_k), jnp.stack(new_v)
+
+    def tp_specs(self):
+        """See _LlamaDecoder.tp_specs. GPT's fused qkv projection packs
+        its output dim [3, heads, hd]-major — slicing that dim over mp
+        would NOT be head-aligned, so the attention matmul weights stay
+        replicated and the per-head layout is pinned on the ACTIVATIONS
+        (the ``shard.qkv`` seam); the dense MLP gets the column/row
+        split. MoE expert banks ride the ep story, not mp: replicated."""
+        specs = {}
+        for i in range(self.n_layers):
+            p = f"transformer.h.{i}."
+            if i in self.moe_layers:
+                continue
+            specs[p + "mlp.fc_in.weight"] = (None, "mp")
+            specs[p + "mlp.fc_in.bias"] = ("mp",)
+            specs[p + "mlp.fc_out.weight"] = ("mp", None)
+        return specs
 
     def _moe_mlp(self, w, i, x2):
         """No-drop top-k expert mixing; x2: [B, S, D] -> [B, S, D].

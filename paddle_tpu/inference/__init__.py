@@ -50,7 +50,7 @@ class Config:
         # and KV-cache sizing feed ServingEngine via serving_options(),
         # speculative decoding via speculative_options()
         self._serving = {"max_seqs": None, "block_size": None,
-                         "num_blocks": None}
+                         "num_blocks": None, "mesh": None}
         self._speculative = {"spec_method": None, "num_draft_tokens": None,
                              "draft_model": None, "spec_options": None}
 
@@ -75,6 +75,17 @@ class Config:
         if int(blocks) < 1:
             raise ValueError(f"kv capacity must be >= 1, got {blocks}")
         self._serving["num_blocks"] = int(blocks)
+
+    def set_tensor_parallel_degree(self, mp: int):
+        """Tensor-parallel degree for the serving engine: the one
+        compiled engine step runs under an ``mp`` mesh (weights
+        column/row-split at the attention/MLP seams, KV pools sharded
+        per-KV-head) so flagship-sized models serve at all. Routed to
+        ServingEngine via ``EngineConfig(mesh=mp)``; 1 = single chip."""
+        if int(mp) < 1:
+            raise ValueError(
+                f"tensor_parallel_degree must be >= 1, got {mp}")
+        self._serving["mesh"] = int(mp) if int(mp) > 1 else None
 
     def serving_options(self) -> Dict[str, Optional[int]]:
         """The routed serving knobs (serving.engine_from_config reads

@@ -73,6 +73,10 @@ Catalog (names are a stable API — see README "Observability"):
   serve_shed_total{policy}               submissions refused by admission control
   serve_drain_seconds                    graceful-drain wall time (notice -> manifest)
   serve_engine_restarts_total            drain manifests replayed into a fresh engine
+  serve_router_routed_total{policy}      serving/router.py routing decisions by policy
+  serve_router_affinity_hits_total       submissions routed to a prefix-affine replica
+  serve_router_replica_queue_depth{replica}  per-replica waiting requests
+  serve_router_failover_total{reason}    requests re-routed off a replica (backpressure|death|drain)
 """
 from __future__ import annotations
 
@@ -149,6 +153,10 @@ CATALOG = (
     "serve_shed_total",
     "serve_drain_seconds",
     "serve_engine_restarts_total",
+    "serve_router_routed_total",
+    "serve_router_affinity_hits_total",
+    "serve_router_replica_queue_depth",
+    "serve_router_failover_total",
 )
 
 _enabled = _m._ENABLED  # bind the cell once: hot-path guard is _enabled[0]
@@ -646,6 +654,43 @@ def record_serve_engine_restart() -> None:
     _reg().counter("serve_engine_restarts_total",
                    "drain manifests replayed into a fresh serving "
                    "engine after a restart").inc()
+
+
+def record_router_routed(policy: str, affinity_hit: bool = False) -> None:
+    """One replica-router routing decision. ``policy`` names what
+    actually decided the placement (affinity | least_loaded | random |
+    round_robin); ``affinity_hit`` marks submissions that landed on a
+    replica already holding their prefix."""
+    if not _enabled[0]:
+        return
+    r = _reg()
+    r.counter("serve_router_routed_total",
+              "replica-router routing decisions by deciding policy",
+              labelnames=("policy",)).labels(policy=policy).inc()
+    if affinity_hit:
+        r.counter("serve_router_affinity_hits_total",
+                  "submissions routed to a replica already holding "
+                  "their prompt prefix").inc()
+
+
+def record_router_queue_depth(replica: int, depth: int) -> None:
+    """One replica's waiting-queue depth (refreshed per router step)."""
+    if not _enabled[0]:
+        return
+    _reg().gauge("serve_router_replica_queue_depth",
+                 "waiting requests per router replica",
+                 labelnames=("replica",)) \
+        .labels(replica=str(replica)).set(float(depth))
+
+
+def record_router_failover(reason: str) -> None:
+    """One request re-routed off its chosen replica (reason:
+    backpressure | death | drain)."""
+    if not _enabled[0]:
+        return
+    _reg().counter("serve_router_failover_total",
+                   "requests re-routed off a replica by reason",
+                   labelnames=("reason",)).labels(reason=reason).inc()
 
 
 def record_serve_tokens(n: int, step_seconds: float) -> None:

@@ -56,10 +56,32 @@ or ``PADDLE_SERVE_RESILIENCE=1``; drill with
 
     eng = ServingEngine(model, EngineConfig(resilience=ResilienceConfig(
         max_step_retries=2, max_waiting=64, backpressure="shed")))
+
+Scale-out (``serving.router`` + ``EngineConfig(mesh=)``): the engine
+step runs tensor-parallel under an ``mp`` mesh (weights column/row
+split at the ``_qkv_proj``/``_post_attn`` seams, KV pools sharded
+per-KV-head, greedy output bit-identical to ``generate()``), and
+``ReplicaRouter`` puts N engines behind a prefix-affinity admission
+tier — the affinity key is the KV pool's hash-chain prefix key, a
+replica's ``AdmissionRejected`` fails over least-loaded-first, and a
+dead or decommissioned replica's drain manifest (its ``tag`` carries
+the affinity key) replays onto affinity-matched survivors:
+
+    tp = ServingEngine(model, EngineConfig(mesh=4))     # 4-way TP
+    router = ReplicaRouter([ServingEngine(model, EngineConfig())
+                            for _ in range(4)], policy="affinity")
+    req = router.submit(ids, max_new_tokens=64, tag="user-7")
+    while router.step_all():
+        pass
+
+Benchmark with ``python tools/bench_serve.py --router``; drill replica
+death with ``python tools/chaos_drill.py --router``; watch the fleet
+with ``python tools/serve_top.py --demo --replicas 4``.
 """
 from .engine import (EngineConfig, EnginePredictor, ServingEngine,
                      engine_from_config)
-from .kv_pool import KVBlockPool, PoolExhausted
+from .kv_pool import KVBlockPool, PoolExhausted, prefix_chain_keys
+from .router import ReplicaRouter
 from .obs import ObsConfig, RequestTrace, ServingObserver, resolve_observer
 from .ragged import ragged_paged_attention
 from .resilience import (AdmissionRejected, RequestFailed, ResilienceConfig,
@@ -72,6 +94,7 @@ from .speculative import (Drafter, DraftModelDrafter, NgramDrafter,
 __all__ = [
     "EngineConfig", "EnginePredictor", "ServingEngine",
     "engine_from_config", "KVBlockPool", "PoolExhausted",
+    "prefix_chain_keys", "ReplicaRouter",
     "ragged_paged_attention", "Request", "Scheduler",
     "Drafter", "NgramDrafter", "DraftModelDrafter", "make_drafter",
     "verify_greedy",

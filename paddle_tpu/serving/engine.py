@@ -60,7 +60,7 @@ class EngineConfig:
                  num_draft_tokens: int = 4, draft_model=None,
                  spec_options: Optional[dict] = None,
                  aot_cache=None, obs=None, memwatch=None,
-                 resilience=None):
+                 resilience=None, mesh=None):
         self.max_seqs = int(max_seqs)
         self.token_budget = int(token_budget)
         self.block_size = int(block_size)
@@ -93,10 +93,108 @@ class EngineConfig:
         # False disarms, None defers to PADDLE_SERVE_RESILIENCE /
         # PADDLE_SERVE_DRAIN_MANIFEST (disarmed = one `is None` check)
         self.resilience = resilience
+        # tensor-parallel mesh geometry: None (single chip), an int mp
+        # degree, {"mp": n}, a distributed.mesh.ProcessMesh, or a jax
+        # Mesh with an "mp" axis — the engine step runs under it with
+        # the weights column/row-split at the _qkv_proj/_post_attn
+        # seams and the KV pools sharded per-KV-head ([L,P,kvh/mp,bs,hd]
+        # per chip), so flagship-sized models serve at all
+        self.mesh = mesh
         if spec_method is not None and self.num_draft_tokens < 1:
             raise ValueError(
                 f"speculative decoding needs num_draft_tokens >= 1, "
                 f"got {self.num_draft_tokens}")
+
+
+def _resolve_engine_mesh(spec):
+    """Normalize ``EngineConfig.mesh`` into a jax Mesh with an ``mp``
+    axis (or None for the single-chip engine): an int / {"mp": n} builds
+    a 1-D mesh over the first n local devices, a ``ProcessMesh``
+    materializes via ``to_jax()``, a jax Mesh passes through. mp degree
+    1 resolves to None — a trivial mesh must compile the exact
+    single-chip program."""
+    if spec is None or spec is False:
+        return None
+    from jax.sharding import Mesh
+    from ..distributed.mesh import ProcessMesh
+    if isinstance(spec, ProcessMesh):
+        mesh = spec.to_jax()
+    elif isinstance(spec, Mesh):
+        mesh = spec
+    else:
+        if isinstance(spec, dict):
+            unknown = set(spec) - {"mp"}
+            if unknown:
+                raise ValueError(
+                    f"EngineConfig.mesh dict understands only 'mp' "
+                    f"(tensor parallel), got extra axes {sorted(unknown)}")
+            mp = int(spec.get("mp", 1))
+        else:
+            mp = int(spec)
+        if mp <= 1:
+            return None
+        devs = jax.devices()
+        if mp > len(devs):
+            raise ValueError(
+                f"EngineConfig.mesh: mp={mp} needs {mp} devices, this "
+                f"process sees {len(devs)}")
+        mesh = Mesh(np.asarray(devs[:mp]), ("mp",))
+    if "mp" not in mesh.axis_names:
+        raise ValueError(
+            f"EngineConfig.mesh must define an 'mp' axis (got axes "
+            f"{list(mesh.axis_names)})")
+    if int(mesh.shape["mp"]) <= 1:
+        return None
+    return mesh
+
+
+class _MeshShard:
+    """The engine's tensor-parallel annotator: a STATIC jit argument
+    (hashable by mesh geometry + device assignment, so jax dispatch and
+    the AOT fingerprint both fork per mesh) whose methods pin the packed
+    ragged batch to the TP layout at the seams ``generation``'s
+    ``_layer_ragged`` exposes — q/k/v per-head right after the
+    projection, the attention output (heads-major flatten) right before
+    the row-parallel o_proj, and the KV pools per-KV-head."""
+
+    __slots__ = ("mesh", "mp")
+
+    def __init__(self, mesh):
+        self.mesh = mesh
+        self.mp = int(mesh.shape["mp"])
+
+    def _geometry(self):
+        return (tuple(self.mesh.axis_names),
+                tuple(self.mesh.devices.shape),
+                tuple(d.id for d in self.mesh.devices.flat))
+
+    def __hash__(self):
+        return hash(self._geometry())
+
+    def __eq__(self, other):
+        return (type(other) is _MeshShard
+                and other._geometry() == self._geometry())
+
+    def _c(self, x, *spec):
+        from jax.sharding import NamedSharding, PartitionSpec
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(self.mesh, PartitionSpec(*spec)))
+
+    def qkv(self, q, k, v):
+        """[T, 1, H|kvh, D] projections: shard the head dim."""
+        return (self._c(q, None, None, "mp", None),
+                self._c(k, None, None, "mp", None),
+                self._c(v, None, None, "mp", None))
+
+    def att(self, att):
+        """[T, 1, H*D] attention output: the heads-major flatten keeps
+        each shard's heads contiguous, so sharding the last dim IS the
+        per-head split feeding the row-parallel o_proj."""
+        return self._c(att, None, None, "mp")
+
+    def pools(self, pools):
+        """[L, P, kvh, bs, D] stacked pools: per-KV-head shards."""
+        return self._c(pools, None, None, "mp", None, None)
 
 
 @jax.jit
@@ -126,13 +224,15 @@ def _copy_page(k_pools, v_pools, src, dst):
             v_pools.at[:, dst].set(v_pools[:, src]))
 
 
-def _engine_step_impl(dec, w, tokens, slot_ids, positions, valid, tables,
-                      k_pools, v_pools):
+def _engine_step_impl(dec, shard, w, tokens, slot_ids, positions, valid,
+                      tables, k_pools, v_pools):
     """The one compiled serving program: scatter targets from the page
     tables, ragged attention over the pools, logits for every packed
     token. Pools are donated — each step reuses the previous buffers.
-    (The un-jitted body, so the AOT cache path can close over ``dec``
-    and export a program of array-only inputs.)"""
+    ``shard`` (static, None on a single chip) is the tensor-parallel
+    annotator pinning the TP layout through the ragged path. (The
+    un-jitted body, so the AOT cache path can close over ``dec`` and
+    ``shard`` and export a program of array-only inputs.)"""
     bs = k_pools.shape[3]
     p_total = k_pools.shape[1]
     mp = tables.shape[1]
@@ -145,12 +245,18 @@ def _engine_step_impl(dec, w, tokens, slot_ids, positions, valid, tables,
     offs = positions % bs
     attend = _ragged.make_attend(tables, slot_ids, positions, valid,
                                  dec.n_heads // dec.n_kv)
-    return dec.step_ragged(w, tokens, positions, k_pools, v_pools,
-                           (pages, offs), attend)
+    logits, kp, vp = dec.step_ragged(w, tokens, positions, k_pools,
+                                     v_pools, (pages, offs), attend,
+                                     shard=shard)
+    if shard is not None:
+        # pin the donated outputs to the per-KV-head layout the next
+        # step's inputs commit to (no silent gather between steps)
+        kp, vp = shard.pools(kp), shard.pools(vp)
+    return logits, kp, vp
 
 
-_engine_step = partial(jax.jit, static_argnums=(0,),
-                       donate_argnums=(7, 8))(_engine_step_impl)
+_engine_step = partial(jax.jit, static_argnums=(0, 1),
+                       donate_argnums=(8, 9))(_engine_step_impl)
 
 
 class ServingEngine:
@@ -173,8 +279,20 @@ class ServingEngine:
                 f"{cfg.token_budget}: a full step could drop tokens, which "
                 "the no-drop decode contract forbids; raise the override "
                 "or shrink the budget")
+        self.mesh = _resolve_engine_mesh(cfg.mesh)
+        self._shard = None
+        if self.mesh is not None:
+            mp = int(self.mesh.shape["mp"])
+            if self.dec.n_kv % mp or self.dec.n_heads % mp:
+                raise ValueError(
+                    f"EngineConfig.mesh: mp={mp} must divide both "
+                    f"num_attention_heads={self.dec.n_heads} and "
+                    f"num_key_value_heads={self.dec.n_kv} — the KV pools "
+                    "shard per-KV-head and attention per-head")
+            self._shard = _MeshShard(self.mesh)
         self._w = (_quant_weights_cached(self.dec, model, cfg.quant)
                    if cfg.quant else self.dec.weights(model))
+        self._w = self._shard_weights(self._w)
         max_len = cfg.max_model_len or model.config.max_position_embeddings
         self.max_model_len = int(min(max_len,
                                      model.config.max_position_embeddings))
@@ -186,8 +304,9 @@ class ServingEngine:
         dtype = self._w[self.dec.embed_key].dtype
         shape = (self.dec.n_layers, num_blocks, self.dec.n_kv, bs,
                  self.dec.hd)
-        self._kp = jnp.zeros(shape, dtype)
-        self._vp = jnp.zeros(shape, dtype)
+        self._pool_shape, self._pool_dtype = shape, dtype
+        self._kp = self._new_pool()
+        self._vp = self._new_pool()
         # device bytes of one page across K+V and every layer — the unit
         # the telemetry/memwatch byte accounting is denominated in
         self.page_bytes = (self._kp.nbytes + self._vp.nbytes) // num_blocks
@@ -239,7 +358,6 @@ class ServingEngine:
         # resilience plane (serving/resilience.py); disarmed = None, and
         # every armed-only seam below is behind one `is None` check
         self.resilience = _res.resolve_resilience(cfg.resilience)
-        self._pool_shape, self._pool_dtype = shape, dtype
         self._draining = False
         self._admit_cv = threading.Condition()
         self.step_faults = 0
@@ -253,6 +371,72 @@ class ServingEngine:
         self._e2e_sum = 0.0
         self._e2e_n = 0
 
+    # -- tensor-parallel placement (EngineConfig.mesh) ------------------------
+    def _pool_sharding(self):
+        """NamedSharding of one stacked pool ([L, P, kvh, bs, D]
+        per-KV-head over mp), or None on a single chip."""
+        if self.mesh is None:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec
+        return NamedSharding(self.mesh,
+                             PartitionSpec(None, None, "mp", None, None))
+
+    def _new_pool(self):
+        """A zeroed device pool in the engine's placement — construction
+        and the step-fault containment rebuild share one spelling."""
+        pool = jnp.zeros(self._pool_shape, self._pool_dtype)
+        ns = self._pool_sharding()
+        return pool if ns is None else jax.device_put(pool, ns)
+
+    def _weight_sharding(self, name, ndim):
+        """PartitionSpec entries for one weight leaf under the TP mesh:
+        the decoder's ``tp_specs`` map, extended to the quantized ::q
+        (same layout as the fp matrix) and ::s (the per-output-channel
+        scale follows the matrix's OUTPUT split) leaves; anything else —
+        or a dim the mp degree does not divide — replicates."""
+        specs = self._tp_specs
+        if name.endswith("::q"):
+            spec = specs.get(name[:-3])
+        elif name.endswith("::s"):
+            base = specs.get(name[:-3])
+            spec = None if base is None else (base[1],)
+        else:
+            spec = specs.get(name)
+        if spec is None:
+            return ()
+        return spec if len(spec) <= ndim else ()
+
+    def _shard_weights(self, w):
+        """Commit every weight leaf to the mesh (column/row TP split per
+        ``_weight_sharding``, replicated otherwise) so the one compiled
+        step reads per-chip shards; identity on a single chip."""
+        if self.mesh is None:
+            return w
+        from jax.sharding import NamedSharding, PartitionSpec
+        mp = int(self.mesh.shape["mp"])
+        self._tp_specs = getattr(self, "_tp_specs", None) \
+            or self.dec.tp_specs()
+        out = {}
+        for name, arr in w.items():
+            spec = self._weight_sharding(name, jnp.ndim(arr))
+            ok = all(s is None or jnp.shape(arr)[d] % mp == 0
+                     for d, s in enumerate(spec))
+            if not ok:
+                spec = ()
+            out[name] = jax.device_put(
+                arr, NamedSharding(self.mesh, PartitionSpec(*spec)))
+        return out
+
+    def _mesh_geometry(self):
+        """Hashable/repr-stable mesh descriptor: the AOT fingerprint
+        extra that forks cached serve_engine_step artifacts per mesh
+        (None vs mp=2 vs mp=4 must never share a program)."""
+        if self.mesh is None:
+            return None
+        return (tuple(self.mesh.axis_names),
+                tuple(int(self.mesh.shape[a])
+                      for a in self.mesh.axis_names))
+
     # -- AOT program cache ----------------------------------------------------
     def _build_step_call(self):
         """The engine-step callable: a persistent ``CachedProgram`` when
@@ -261,13 +445,15 @@ class ServingEngine:
         from ..aot.cache import cached_jit, resolve_store
         store = resolve_store(self.config.aot_cache)
         if store is None:
-            return partial(_engine_step, self.dec)
+            return partial(_engine_step, self.dec, self._shard)
         dec = self.dec
+        shard = self._shard
 
         def serve_engine_step(w, tokens, slot_ids, positions, valid,
                               tables, k_pools, v_pools):
-            return _engine_step_impl(dec, w, tokens, slot_ids, positions,
-                                     valid, tables, k_pools, v_pools)
+            return _engine_step_impl(dec, shard, w, tokens, slot_ids,
+                                     positions, valid, tables, k_pools,
+                                     v_pools)
 
         # _static_key() is what jax.jit's static-argnums dispatch keyed
         # the uncached path on: the decoder's baked-in trace constants
@@ -277,13 +463,28 @@ class ServingEngine:
         # MoE static key holds live function objects whose repr embeds
         # a per-process address (= a permanent spurious miss).
         from ..aot.fingerprint import stable_repr
+        jit_kwargs = {"donate_argnums": (6, 7)}
+        if self.mesh is not None:
+            # warm() lowers from avals ALONE — without explicit
+            # in_shardings the exported program would assume unsharded
+            # inputs and silently gather the committed TP shards on
+            # every real call. Pin the argument layouts the engine
+            # actually feeds: per-leaf weight split, replicated host
+            # arrays, per-KV-head pools.
+            from jax.sharding import NamedSharding, PartitionSpec
+            rep = NamedSharding(self.mesh, PartitionSpec())
+            w_sh = {name: arr.sharding for name, arr in self._w.items()}
+            pool = self._pool_sharding()
+            jit_kwargs["in_shardings"] = (w_sh, rep, rep, rep, rep, rep,
+                                          pool, pool)
         return cached_jit(
             serve_engine_step, name="serve_engine_step", cache=store,
             key_extras=(stable_repr(self.dec._static_key()),
                         self.config.quant,
                         getattr(self.dec, "min_capacity_override", None),
-                        self.config.block_size, self.max_pages_per_seq),
-            jit_kwargs={"donate_argnums": (6, 7)})
+                        self.config.block_size, self.max_pages_per_seq,
+                        ("mesh", self._mesh_geometry())),
+            jit_kwargs=jit_kwargs)
 
     def _warm_start(self) -> Optional[str]:
         """Materialize the one engine program at construction: on a cache
@@ -603,8 +804,7 @@ class ServingEngine:
         for name in ("_kp", "_vp"):
             arr = getattr(self, name)
             if getattr(arr, "is_deleted", lambda: False)():
-                setattr(self, name,
-                        jnp.zeros(self._pool_shape, self._pool_dtype))
+                setattr(self, name, self._new_pool())
                 pools_rebuilt = True
         if pools_rebuilt or kind == "nan_logits":
             # rebuilt pools hold zeros, and garbage logits mean NOTHING
@@ -921,6 +1121,9 @@ class ServingEngine:
                 },
                 "spec": self.spec_stats(),
             }
+            if self.mesh is not None:
+                base["mesh"] = {"mp": int(self.mesh.shape["mp"]),
+                                "devices": self.mesh.devices.size}
             if self.drafter is not None:
                 base["spec"]["drafter"] = self.drafter.describe()
             if self.memwatch is not None:
@@ -959,10 +1162,11 @@ class ServingEngine:
         should also drop the prefix cache via a fresh engine."""
         from ..generation import _quant_weights_cached
         with self._lock:
-            self._w = (_quant_weights_cached(self.dec, self.model,
-                                             self.config.quant)
-                       if self.config.quant
-                       else self.dec.weights(self.model))
+            self._w = self._shard_weights(
+                _quant_weights_cached(self.dec, self.model,
+                                      self.config.quant)
+                if self.config.quant
+                else self.dec.weights(self.model))
 
 
 class EnginePredictor:

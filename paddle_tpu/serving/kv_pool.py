@@ -208,7 +208,10 @@ class KVBlockPool:
     # -- prefix cache ---------------------------------------------------------
     @staticmethod
     def _chain_keys(token_ids: Sequence[int], block_size: int):
-        """Hash-chain keys for each FULL page of token_ids."""
+        """Hash-chain keys for each FULL page of token_ids. Keys hash
+        only ints/tuples, so they are stable across processes and
+        PYTHONHASHSEED values — the replica router's drain manifests
+        carry them through JSON as the affinity hand-off signal."""
         keys = []
         parent = ()
         for c in range(len(token_ids) // block_size):
@@ -267,4 +270,14 @@ class KVBlockPool:
             self._key_of[blk] = key
 
 
-__all__ = ["KVBlockPool", "PoolExhausted"]
+def prefix_chain_keys(token_ids: Sequence[int], block_size: int
+                      ) -> List[Tuple]:
+    """Public spelling of the pool's hash-chain prefix keys: one key per
+    FULL page of ``token_ids``, each committing to every token before it.
+    Two prompts share a key exactly when they share that page-aligned
+    prefix — which is both when cached K/V is reusable (kv_pool) and
+    when routing them to the same replica pays (serving/router.py)."""
+    return KVBlockPool._chain_keys(token_ids, block_size)
+
+
+__all__ = ["KVBlockPool", "PoolExhausted", "prefix_chain_keys"]

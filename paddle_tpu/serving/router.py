@@ -1,0 +1,438 @@
+"""Replica router: N serving engines behind one prefix-affine front door.
+
+ROADMAP item 2 rung (c): the admission tier that composes the PR 13
+per-engine failure contract into a scale-out serving fleet. Each replica
+is ONE ``ServingEngine`` — one chip (or one ``mesh=`` TP group), one KV
+pool, one failure unit that either serves, refuses with a typed
+``AdmissionRejected``, or hands its work back as a drain manifest. The
+router owns only placement:
+
+  * **prefix-affinity routing** — the affinity key IS the KV pool's
+    hash-chain prefix key (``kv_pool.prefix_chain_keys``): requests
+    sharing a page-aligned prompt prefix route to the replica that
+    already holds that prefix's K/V, so the fleet's prefix caches
+    PARTITION the working set instead of each replica thrashing over all
+    of it (aggregate cache capacity is the scale-out win the bench
+    pins); deepest registered key wins, the affinity map is LRU-bounded;
+  * **least-loaded fallback** — no affinity match (or policies
+    ``least_loaded`` / ``random`` / ``round_robin``) places by queue
+    depth and the engine's ``_predicted_wait`` service-time estimate
+    (PR 13's admission-control evidence, reused as the load signal);
+  * **backpressure failover** — a replica refusing with
+    ``AdmissionRejected`` (bounded queue, SLO shed, draining) is not an
+    error, it is a routing signal: the router retries the remaining
+    replicas least-loaded-first and only re-raises when EVERY replica
+    refused (the fleet-level typed refusal);
+  * **death/drain as a unit** — ``step_all`` treating an ESCAPED engine
+    step as replica death, or an explicit ``decommission`` (graceful
+    drain within a deadline): either way the replica's drain manifest —
+    whose per-request ``tag`` carries the affinity key — replays onto
+    survivors grouped by affinity (every request of one prefix lands on
+    ONE survivor, which inherits the registration), with generated
+    tokens riding along so greedy output continues exactly where the
+    dead replica stopped. Original handles resolve with a terminal
+    ``RequestFailed`` (never park); the replacement handles returned by
+    the hand-off carry the work to completion.
+
+The router never touches engine internals beyond the documented failure
+contract; driving stays with the caller (``step_all`` round-robin, or
+one thread per replica calling ``engine.step()``).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence
+
+from ..profiler import instrument as _instr
+from . import resilience as _res
+from .kv_pool import prefix_chain_keys
+
+_POLICIES = ("affinity", "least_loaded", "random", "round_robin")
+
+
+class ReplicaRouter:
+    """Prefix-affinity admission tier over N ``ServingEngine`` replicas.
+
+    Thread-safe like the engine: ``submit`` may run from client threads
+    while one driver calls ``step_all()`` (or per-replica threads call
+    ``engine.step()``); routing state mutates under the router lock, and
+    the lock is never held across an engine call that can block."""
+
+    def __init__(self, engines: Sequence, policy: str = "affinity",
+                 seed: int = 0, max_affinity_keys: int = 4096,
+                 failover: bool = True):
+        import numpy as np
+        if not engines:
+            raise ValueError("ReplicaRouter needs at least one engine")
+        if policy not in _POLICIES:
+            raise ValueError(f"unknown router policy {policy!r} "
+                             f"(want one of {_POLICIES})")
+        sizes = {e.pool.block_size for e in engines}
+        if len(sizes) > 1:
+            raise ValueError(
+                f"replicas disagree on block_size {sorted(sizes)}: the "
+                "affinity key is the page-chain key, which is only "
+                "comparable at one page geometry")
+        self.replicas: List = list(engines)
+        self.policy = policy
+        self.failover = bool(failover)
+        self.block_size = engines[0].pool.block_size
+        self._alive = [True] * len(self.replicas)
+        self._rng = np.random.default_rng(seed)
+        self._rr = 0
+        self.max_affinity_keys = int(max_affinity_keys)
+        # chain key -> replica idx holding that prefix (LRU-bounded)
+        self._affinity: "OrderedDict" = OrderedDict()
+        self.routed: Dict[str, int] = {p: 0 for p in _POLICIES}
+        self.affinity_hits = 0
+        self.failovers: Dict[str, int] = {}
+        # hand-off evidence, one record per dead/drained replica: which
+        # affinity group replayed onto which survivor, plus the live
+        # replacement handles — ``step_all`` fails a replica in-flight,
+        # so callers recover the replacements here (keyed by
+        # ``handle.tag["tag"]``), and the chaos drill asserts the
+        # affinity-matched grouping from the same record
+        self.handoffs: List[dict] = []
+        # per-replica "hand-off finished" latch: a submit that raced a
+        # death waits on this before deciding between the replacement
+        # handle and a fresh fail-over (the replay runs BEFORE the
+        # handoff record lands, so reading handoffs without the latch
+        # could miss a replacement and run the request twice)
+        self._handoff_complete = [threading.Event()
+                                  for _ in self.replicas]
+        self._lock = threading.RLock()
+
+    # -- placement ------------------------------------------------------------
+    def _routable(self, exclude: Optional[int] = None) -> List[int]:
+        return [i for i, e in enumerate(self.replicas)
+                if self._alive[i] and not e._draining and i != exclude]
+
+    def _least_loaded(self, cands: Sequence[int]) -> int:
+        """Queue-depth / predicted-wait placement: the engine's own
+        service-time evidence (``_predicted_wait``, PR 13) breaks depth
+        ties, replica index breaks the rest (deterministic)."""
+        def score(i):
+            e = self.replicas[i]
+            depth = e.sched.queue_depth()
+            wait = e._predicted_wait(depth)
+            return (depth + len(e.sched.running),
+                    wait if wait is not None else 0.0, i)
+        return min(cands, key=score)
+
+    def _route(self, keys) -> List:
+        """Candidate replica order (best first) + the deciding policy.
+        Returns (order, why, affinity_depth)."""
+        cands = self._routable()
+        if not cands:
+            raise _res.AdmissionRejected("no_replica", queue_depth=0)
+        target, why, depth = None, None, 0
+        if self.policy == "affinity" and keys:
+            for d in range(len(keys), 0, -1):
+                idx = self._affinity.get(keys[d - 1])
+                if idx is not None and idx in cands:
+                    target, why, depth = idx, "affinity", d
+                    self._affinity.move_to_end(keys[d - 1])
+                    break
+        if target is None:
+            if self.policy == "random":
+                target, why = int(self._rng.choice(cands)), "random"
+            elif self.policy == "round_robin":
+                target = cands[self._rr % len(cands)]
+                self._rr += 1
+                why = "round_robin"
+            else:
+                target, why = self._least_loaded(cands), "least_loaded"
+        rest = sorted((i for i in cands if i != target),
+                      key=lambda i: (self.replicas[i].sched.queue_depth(),
+                                     i))
+        return [target] + rest, why, depth
+
+    def _register(self, keys, idx: int) -> None:
+        for key in keys:
+            self._affinity[key] = idx
+            self._affinity.move_to_end(key)
+        while len(self._affinity) > self.max_affinity_keys:
+            self._affinity.popitem(last=False)
+
+    @staticmethod
+    def _make_tag(keys, user_tag):
+        """The manifest-portable router tag: the DEEPEST chain key (the
+        prefix identity, JSON-stable ints) + the caller's opaque tag —
+        the affinity hand-off signal a failover replay groups by."""
+        return {"affinity": list(keys[-1]) if keys else None,
+                "tag": user_tag}
+
+    # -- client side ----------------------------------------------------------
+    def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
+               eos_id: Optional[int] = None, on_token=None,
+               stream: bool = False,
+               ttft_deadline: Optional[float] = None,
+               tpot_deadline: Optional[float] = None, tag=None):
+        """Route one request to a replica and submit it there; returns
+        the replica engine's ``Request`` handle (``handle.tag["tag"]``
+        is the caller's ``tag``). A replica's ``AdmissionRejected`` is
+        consumed as backpressure and the request fails over to the next
+        candidate; only when every routable replica refused does the
+        LAST refusal re-raise — the fleet's typed overload signal."""
+        keys = prefix_chain_keys(prompt, self.block_size)
+        with self._lock:
+            order, why, depth = self._route(keys)
+        last_err = None
+        for n_try, idx in enumerate(order):
+            decided = why if n_try == 0 else "least_loaded"
+            try:
+                req = self.replicas[idx].submit(
+                    prompt, max_new_tokens=max_new_tokens, eos_id=eos_id,
+                    on_token=on_token, stream=stream,
+                    ttft_deadline=ttft_deadline,
+                    tpot_deadline=tpot_deadline,
+                    tag=self._make_tag(keys, tag))
+            except _res.AdmissionRejected as exc:
+                last_err = exc
+                if not self.failover:
+                    break
+                if n_try < len(order) - 1:
+                    # an actual re-route follows; the final all-refused
+                    # candidate is a rejection, not a failover
+                    with self._lock:
+                        self.failovers["backpressure"] = \
+                            self.failovers.get("backpressure", 0) + 1
+                    _instr.record_router_failover("backpressure")
+                continue
+            with self._lock:
+                died = not self._alive[idx]
+            if died:
+                # the replica died between routing and placement (a
+                # concurrent step_all caught its step fault). Wait for
+                # its hand-off to FINISH before deciding — the replay
+                # runs before the handoff record lands, and deciding
+                # mid-replay could resubmit a request whose replacement
+                # is already decoding (the same work twice).
+                self._handoff_complete[idx].wait(timeout=30.0)
+                if req.done and req.error is None:
+                    return req          # served before the death landed
+                if req.done:
+                    # the death snapshot caught this request: return its
+                    # replacement (same tag OBJECT — the replay passes
+                    # the manifest tag through verbatim)
+                    with self._lock:
+                        for rec in reversed(self.handoffs):
+                            if rec["replica"] != idx:
+                                continue
+                            for h in rec["handles"]:
+                                if h.tag is req.tag:
+                                    return h
+                    # aborted but never replayed (placed after the
+                    # snapshot): fall through and fail over fresh
+                else:
+                    # stranded in the dead scheduler after snapshot AND
+                    # abort: pull it back terminally and fail over —
+                    # nothing parks, nothing runs twice
+                    eng = self.replicas[idx]
+                    with eng._lock:
+                        eng.sched.fail_request(req, _res.RequestFailed(
+                            req.rid, reason="replica_death"))
+                continue
+            with self._lock:
+                self._register(keys, idx)
+                self.routed[decided] = self.routed.get(decided, 0) + 1
+                hit = decided == "affinity"
+                if hit:
+                    self.affinity_hits += 1
+            _instr.record_router_routed(decided, affinity_hit=hit)
+            return req
+        raise last_err if last_err is not None else \
+            _res.AdmissionRejected("no_replica", queue_depth=0)
+
+    # -- driving --------------------------------------------------------------
+    def step_all(self) -> bool:
+        """One round-robin pass: step every live replica that has work.
+        An ESCAPED step exception is the replica-death signal — the
+        replica is failed as a unit (its manifest replays onto affinity
+        -matched survivors) and the pass continues. Returns True while
+        any live replica still has work."""
+        for idx, eng in enumerate(self.replicas):
+            if not self._alive[idx]:
+                continue
+            try:
+                if eng.has_work():
+                    eng.step()
+            except Exception as exc:  # noqa: BLE001 — death containment
+                self.fail_replica(idx, reason="death", cause=exc)
+            _instr.record_router_queue_depth(idx,
+                                             eng.sched.queue_depth())
+        return self.has_work()
+
+    def has_work(self) -> bool:
+        return any(self._alive[i] and e.has_work()
+                   for i, e in enumerate(self.replicas))
+
+    def run_until_idle(self, max_steps: Optional[int] = None) -> int:
+        """Drive ``step_all`` until the fleet drains; returns passes."""
+        n = 0
+        while self.step_all():
+            n += 1
+            if max_steps is not None and n >= max_steps:
+                break
+        return n
+
+    # -- replica death / decommission -----------------------------------------
+    def fail_replica(self, idx: int, reason: str = "death", cause=None,
+                     manifest: Optional[dict] = None) -> List:
+        """Treat replica ``idx`` as DEAD: stop routing to it, salvage
+        its live requests into a drain manifest (taken from the
+        scheduler state — a dead engine cannot run its own drain loop),
+        resolve the original handles with a terminal ``RequestFailed``
+        (nothing parks), and replay the manifest onto affinity-matched
+        survivors. Returns the replacement ``Request`` handles (each
+        carries the original router tag, so callers re-key by
+        ``handle.tag["tag"]``); empty when no survivor remains."""
+        with self._lock:
+            if not self._alive[idx]:
+                return []
+            self._alive[idx] = False
+        eng = self.replicas[idx]
+        if manifest is None:
+            manifest = self._salvage_manifest(eng)
+        eng.abort_all(cause, reason=f"replica_{reason}")
+        return self._hand_off(manifest, exclude=idx, reason=reason)
+
+    @staticmethod
+    def _salvage_manifest(eng) -> dict:
+        """A drain manifest taken from the scheduler state directly —
+        what death and a fault-mid-drain both fall back to when the
+        engine cannot run its own drain loop."""
+        with eng._lock:
+            live = list(eng.sched.running) + list(eng.sched.waiting)
+            return _res.build_manifest(live, 0.0)
+
+    def decommission(self, idx: int,
+                     deadline_s: Optional[float] = None) -> List:
+        """Gracefully retire replica ``idx``: drain it (admission stops,
+        decode runs within the grace budget), then hand the manifest of
+        whatever did not finish to affinity-matched survivors exactly
+        like a death — the drained replica's still-live handles resolve
+        with a terminal error, the returned replacements finish the
+        work. The PR 13 per-engine drain contract, composed."""
+        with self._lock:
+            if not self._alive[idx]:
+                return []
+            self._alive[idx] = False
+        eng = self.replicas[idx]
+        reason = "drain"
+        try:
+            manifest = eng.drain(deadline_s=deadline_s)
+        except Exception:  # noqa: BLE001 — a fault mid-drain IS death
+            # a disarmed replica's step can raise inside the drain
+            # loop; the retiring replica just died instead — salvage
+            # the manifest from the scheduler state like fail_replica
+            # would, so its work still hands off instead of parking
+            manifest = self._salvage_manifest(eng)
+            reason = "death"
+        eng.abort_all(reason=f"replica_{reason}")
+        return self._hand_off(manifest, exclude=idx, reason=reason)
+
+    def _hand_off(self, manifest: dict, exclude: int,
+                  reason: str) -> List:
+        """Replay a dead/drained replica's manifest onto survivors,
+        GROUPED by the tag's affinity key: every request of one prefix
+        lands on the same survivor (a registered surviving holder of
+        that prefix wins, else least-loaded), which inherits the
+        affinity registration — so the hand-off preserves both the
+        prefix-sharing of the replayed group and the routing of future
+        same-prefix arrivals."""
+        entries = sorted(manifest.get("requests", ()),
+                         key=lambda e: e["order"])
+        groups: "OrderedDict" = OrderedDict()
+        for entry in entries:
+            tag = entry.get("tag")
+            aff = tuple(tag["affinity"]) if isinstance(tag, dict) \
+                and tag.get("affinity") else None
+            groups.setdefault(aff, []).append(entry)
+        handles: List = []
+        record = {"replica": exclude, "reason": reason,
+                  "requests": len(entries), "groups": []}
+        for aff, group in groups.items():
+            with self._lock:
+                cands = self._routable(exclude=exclude)
+                if not cands:
+                    break           # no survivor: originals already failed
+                target = None
+                if aff is not None:
+                    idx = self._affinity.get(aff)
+                    if idx is not None and idx in cands:
+                        target = idx
+                if target is None:
+                    target = self._least_loaded(cands)
+            sub = dict(manifest)
+            sub["requests"] = group
+            handles.extend(_res.replay_manifest(self.replicas[target],
+                                                sub))
+            record["groups"].append(
+                {"affinity": list(aff) if aff else None,
+                 "target": target,
+                 "orders": [e["order"] for e in group]})
+            with self._lock:
+                for entry in group:
+                    keys = prefix_chain_keys(entry["prompt"],
+                                             self.block_size)
+                    self._register(keys, target)
+                self.failovers[reason] = \
+                    self.failovers.get(reason, 0) + len(group)
+            for _ in group:
+                _instr.record_router_failover(reason)
+        record["handles"] = handles
+        with self._lock:
+            self.handoffs.append(record)
+        self._handoff_complete[exclude].set()
+        return handles
+
+    # -- observability --------------------------------------------------------
+    def telemetry(self) -> dict:
+        """Fleet telemetry: the router's routing/failover counters, the
+        per-replica ``engine.telemetry()`` snapshots (tagged with
+        replica id + liveness), and fleet totals (tokens, steps, queue,
+        pool occupancy, prefix hit aggregate) — what
+        ``tools/serve_top.py`` renders as the multi-replica dashboard."""
+        with self._lock:
+            alive = list(self._alive)
+            router = {
+                "policy": self.policy,
+                "replicas": len(self.replicas),
+                "alive": sum(alive),
+                "routed": {k: v for k, v in self.routed.items() if v},
+                "affinity_hits": self.affinity_hits,
+                "affinity_keys": len(self._affinity),
+                "failovers": dict(self.failovers),
+                "handoffs": len(self.handoffs),
+            }
+        reps = []
+        fleet = {"steps": 0, "tokens_generated": 0, "queue_depth": 0,
+                 "running": 0,
+                 "pool": {"size": 0, "used": 0, "cached": 0, "free": 0},
+                 "prefix": {"queries": 0, "hits": 0, "hit_tokens": 0}}
+        for idx, eng in enumerate(self.replicas):
+            tel = eng.telemetry()
+            tel["replica"] = idx
+            tel["alive"] = alive[idx]
+            reps.append(tel)
+            fleet["steps"] += tel["steps"]
+            fleet["tokens_generated"] += tel["tokens_generated"]
+            fleet["queue_depth"] += tel["queue_depth"]
+            fleet["running"] += tel["running"]
+            for k in ("size", "used", "cached", "free"):
+                fleet["pool"][k] += tel["pool"][k]
+            for k in ("queries", "hits", "hit_tokens"):
+                fleet["prefix"][k] += tel["pool"]["prefix"][k]
+        fleet["pool"]["utilization"] = round(
+            fleet["pool"]["used"] / max(fleet["pool"]["size"], 1), 4)
+        q = fleet["prefix"]["queries"]
+        fleet["prefix"]["hit_rate"] = round(
+            fleet["prefix"]["hits"] / q, 4) if q else 0.0
+        return {"router": router, "fleet": fleet, "replicas": reps,
+                "unix_time": time.time()}
+
+
+__all__ = ["ReplicaRouter"]

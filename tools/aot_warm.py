@@ -22,6 +22,14 @@ Named configs:
   tiny-llama-serve  tiny Llama ServingEngine (construction warms the
                     ``serve_engine_step`` program from avals alone)
   tiny-gpt-serve    tiny GPT variant of the same
+  tiny-llama-serve-mp2 / tiny-gpt-serve-mp2
+                    the same serving programs under an mp=2 tensor-
+                    parallel mesh (weights column/row-split, KV pools
+                    per-KV-head) — pre-populates the TP engine
+                    artifacts the next tunnel window serves from.
+                    ``--mp N`` overrides the degree on any serve
+                    config; the mesh geometry is part of the
+                    fingerprint, so every degree is its own artifact.
 
 Exit code 0 = every program for the config is now in the ledger
 (freshly exported, or already present = a hit).
@@ -37,7 +45,19 @@ import time
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
-CONFIGS = ("toy-trainer", "tiny-llama-serve", "tiny-gpt-serve")
+CONFIGS = ("toy-trainer", "tiny-llama-serve", "tiny-gpt-serve",
+           "tiny-llama-serve-mp2", "tiny-gpt-serve-mp2")
+
+
+def _ensure_host_devices(n: int) -> None:
+    """A TP warm needs n visible devices BEFORE jax initializes; on a
+    CPU host that is the forced-host-platform flag (on real TPU
+    topologies the devices are simply there)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count="
+            f"{max(n, 2)}").strip()
 
 
 def warm_toy_trainer(cache: str, seed: int = 1234) -> dict:
@@ -69,9 +89,11 @@ def warm_toy_trainer(cache: str, seed: int = 1234) -> dict:
 
 def warm_serve(cache: str, family: str, seed: int = 3, max_seqs: int = 8,
                token_budget: int = 64, block_size: int = 16,
-               quant=None) -> dict:
+               quant=None, mp: int = 1) -> dict:
     """Construct a ServingEngine over the tiny model: construction
-    materializes ``serve_engine_step`` from avals (no tokens run)."""
+    materializes ``serve_engine_step`` from avals (no tokens run).
+    ``mp > 1`` warms the tensor-parallel program instead — the sharded
+    engine the next tunnel window's serving replicas deserialize."""
     import paddle_tpu as paddle
     from paddle_tpu.serving import EngineConfig, ServingEngine
 
@@ -89,8 +111,9 @@ def warm_serve(cache: str, family: str, seed: int = 3, max_seqs: int = 8,
         model = GPTForCausalLM(cfg)
     engine = ServingEngine(model, EngineConfig(
         max_seqs=max_seqs, token_budget=token_budget,
-        block_size=block_size, quant=quant, aot_cache=cache))
-    return {"warm": engine.aot_warm_result,
+        block_size=block_size, quant=quant, aot_cache=cache,
+        mesh=mp if mp > 1 else None))
+    return {"warm": engine.aot_warm_result, "mp": mp,
             **dict(engine._step_call.stats)}
 
 
@@ -106,9 +129,17 @@ def main(argv=None) -> int:
     ap.add_argument("--block-size", type=int, default=16)
     ap.add_argument("--quant", default=None,
                     help="serving weight quantization (int8|int4)")
+    ap.add_argument("--mp", type=int, default=None,
+                    help="tensor-parallel degree for the serve configs "
+                         "(default 1; the -mp2 named configs imply 2)")
     ap.add_argument("--stats", action="store_true",
                     help="print the cache ledger and exit")
     args = ap.parse_args(argv)
+    mp = args.mp
+    if mp is None:
+        mp = 2 if args.config and args.config.endswith("-mp2") else 1
+    if mp > 1:
+        _ensure_host_devices(mp)   # must land before jax initializes
 
     from paddle_tpu.aot.store import ArtifactStore
     store = ArtifactStore(args.cache)
@@ -127,7 +158,8 @@ def main(argv=None) -> int:
         stats = warm_serve(args.cache, family, seed=args.seed,
                            max_seqs=args.max_seqs,
                            token_budget=args.token_budget,
-                           block_size=args.block_size, quant=args.quant)
+                           block_size=args.block_size, quant=args.quant,
+                           mp=mp)
     dt = time.monotonic() - t0
     ok = stats.get("fallbacks", 0) == 0
     print(f"aot_warm: {args.config} -> {args.cache} in {dt:.2f}s "

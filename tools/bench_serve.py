@@ -47,10 +47,23 @@ typed ``AdmissionRejected`` sheds, and every accepted request FINISHES
 — the row asserts zero parked requests and strictly more goodput than
 the baseline. Both rows face the identical schedule and fault plan.
 
+``--router`` adds the scale-out rows (ROADMAP item 2 rung c): ONE
+seeded shared-prefix open-loop schedule (thousands of requests in full
+mode) driven three ways on identical per-engine configs — a single
+engine, an N-replica ``ReplicaRouter`` under RANDOM placement, and the
+same fleet under PREFIX-AFFINITY placement. A replica is one chip, so
+what the fleet adds is aggregate KV/prefix-cache capacity: the workload's
+prefix working set fits the affinity-PARTITIONED caches but thrashes one
+pool's LRU (and every replica's, under random placement). The rows pin
+router-vs-single tokens/s scaling and the affinity-vs-random prefix-hit
+uplift; greedy output crc equality across all three is asserted in-run
+(routing moves requests, never changes tokens).
+
 Usage:
   python tools/bench_serve.py --fast --spec         # tier-1 smoke
   python tools/bench_serve.py --spec --tag r07
   python tools/bench_serve.py --chaos --tag r13
+  python tools/bench_serve.py --router --tag r14
 """
 import argparse
 import json
@@ -117,6 +130,35 @@ def make_repetitive_workload(seed: int, n_requests: int, rate: float,
         prompt = (pat * (plen // len(pat) + 1))[:plen]
         reqs.append({"arrival_s": float(arrivals[i]), "prompt": prompt,
                      "max_new": mnew})
+    return reqs
+
+
+def make_shared_prefix_workload(seed: int, n_requests: int, rate: float,
+                                vocab: int, n_prefixes: int,
+                                prefix_len: int, suffix_lens=(3, 8),
+                                max_new=(3, 6)):
+    """Seeded Poisson schedule over shared-system-prompt traffic: each
+    request is one of ``n_prefixes`` page-aligned shared prefixes plus a
+    short unique suffix — the workload shape where serving throughput is
+    prefill-dominated and the prefix cache (and who HOLDS it) decides
+    how much of that prefill is ever recomputed. This is the router
+    bench's working set: all prefixes fit in the FLEET's pooled cache
+    but not in one replica's."""
+    rng = np.random.default_rng(seed)
+    prefixes = [rng.integers(1, vocab, (prefix_len,)).tolist()
+                for _ in range(n_prefixes)]
+    gaps = rng.exponential(1.0 / rate, n_requests)
+    arrivals = np.cumsum(gaps)
+    reqs = []
+    for i in range(n_requests):
+        pre = prefixes[int(rng.integers(0, n_prefixes))]
+        tail = rng.integers(
+            1, vocab,
+            (int(rng.integers(suffix_lens[0], suffix_lens[1] + 1)),)
+        ).tolist()
+        mnew = int(rng.integers(max_new[0], max_new[1] + 1))
+        reqs.append({"arrival_s": float(arrivals[i]),
+                     "prompt": pre + tail, "max_new": mnew})
     return reqs
 
 
@@ -226,6 +268,154 @@ def drive(model, workload, policy: str, engine_kw: dict, spec_kw=None,
         row["accept_rate"] = round(s["accept_rate"], 3)
         row["spec_rollback_pages"] = s["rollback_pages"]
     return row
+
+
+def drive_router(model, workload, n_replicas: int, policy: str,
+                 engine_kw: dict, seed: int):
+    """One open-loop run through a ``ReplicaRouter`` of ``n_replicas``
+    identical engines (``n_replicas=1`` IS the single-engine baseline on
+    the same machinery, so the measured delta is the fleet + routing
+    policy, not harness overhead). Single-threaded round-robin driving:
+    on this box the honest scale-out win is aggregate KV/prefix-cache
+    capacity — compute is one core either way — so the row reports both
+    tokens/s and the prefix-cache hit economics that produce it."""
+    from paddle_tpu.serving import (EngineConfig, ReplicaRouter,
+                                    ServingEngine)
+    engines = [ServingEngine(model, EngineConfig(policy="continuous",
+                                                 **engine_kw))
+               for _ in range(n_replicas)]
+    router = ReplicaRouter(engines, policy=policy, seed=seed)
+    pending = sorted(workload, key=lambda r: r["arrival_s"])
+    handles = []
+    t0 = time.monotonic()
+    i = 0
+    while i < len(pending) or router.has_work():
+        now = time.monotonic() - t0
+        while i < len(pending) and pending[i]["arrival_s"] <= now:
+            r = pending[i]
+            handles.append((r, router.submit(r["prompt"],
+                                             max_new_tokens=r["max_new"],
+                                             tag=i)))
+            i += 1
+        if router.has_work():
+            router.step_all()
+        elif i < len(pending):
+            time.sleep(min(pending[i]["arrival_s"] - now, 0.005))
+    wall = time.monotonic() - t0
+    lats, ttfts, tokens = [], [], 0
+    crc = 0
+    for spec, req in handles:
+        assert req.done, f"request {req.rid} never finished"
+        tokens += len(req.output)
+        crc = zlib.crc32(np.asarray(req.output, np.int32).tobytes(), crc)
+        lats.append((req.finished_at - t0) - spec["arrival_s"])
+        ttfts.append((req.first_token_at - t0) - spec["arrival_s"])
+    lats = np.asarray(lats)
+    tel = router.telemetry()
+    prompt_tokens = sum(len(r["prompt"]) for r, _ in handles)
+    hit_tokens = tel["fleet"]["prefix"]["hit_tokens"]
+    return {
+        "policy": policy,
+        "replicas": n_replicas,
+        "requests": len(handles),
+        "output_tokens": int(tokens),
+        "prompt_tokens": int(prompt_tokens),
+        "wall_s": round(wall, 4),
+        "tokens_per_s": round(tokens / wall, 2),
+        "p50_latency_s": round(float(np.percentile(lats, 50)), 4),
+        "p99_latency_s": round(float(np.percentile(lats, 99)), 4),
+        "mean_ttft_s": round(float(np.mean(ttfts)), 4),
+        "engine_steps": tel["fleet"]["steps"],
+        "prefix_queries": tel["fleet"]["prefix"]["queries"],
+        "prefix_hits": tel["fleet"]["prefix"]["hits"],
+        "prefix_hit_rate": tel["fleet"]["prefix"]["hit_rate"],
+        "prefix_hit_tokens": int(hit_tokens),
+        # the load-bearing economics: what fraction of offered prompt
+        # tokens the fleet's caches served instead of re-prefilling
+        "prefix_hit_token_rate": round(hit_tokens
+                                       / max(prompt_tokens, 1), 4),
+        "routed": tel["router"]["routed"],
+        "affinity_hits": tel["router"]["affinity_hits"],
+        "output_crc32": crc,
+    }
+
+
+def _build_router_model(fast: bool):
+    """The router rows' own tiny model: same geometry as the fast bench
+    model but with a LONGER position budget in full mode — the scale-out
+    rows measure shared-prefix prefill economics, and a system-prompt-
+    sized prefix needs the context room."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    paddle.seed(11)
+    cfg = LlamaConfig.tiny(vocab_size=128, hidden_size=32, layers=2,
+                           heads=4, kv_heads=2,
+                           seq=128 if fast else 256)
+    cfg.use_flash_attention = False
+    return LlamaForCausalLM(cfg)
+
+
+def run_router_pair(seed: int, fast: bool):
+    """The scale-out rows: one shared-prefix open-loop schedule driven
+    through (a) a single engine, (b) N replicas under RANDOM routing,
+    (c) N replicas under PREFIX-AFFINITY routing — identical per-engine
+    config (a replica is one chip; scale-out adds chips, so aggregate
+    pool/cache capacity is exactly what the fleet buys). The per-engine
+    pool holds its affinity SHARE of the prefix working set but not all
+    of it: under affinity routing every prefix stays resident on its
+    home replica, while the single engine (and every replica under
+    random routing) keeps evicting and re-prefilling — the honest
+    mechanism behind the tokens/s scaling the artifact pins (compute
+    here is one CPU core either way; on real silicon the per-chip
+    parallelism multiplies on top)."""
+    model = _build_router_model(fast)
+    vocab = model.config.vocab_size
+    if fast:
+        n_replicas, n_requests, rate = 2, 48, 2000.0
+        n_prefixes, prefix_len = 8, 96
+        engine_kw = {"max_seqs": 4, "token_budget": 24, "block_size": 8,
+                     "num_blocks": 64}
+    else:
+        n_replicas, n_requests, rate = 4, 1500, 400.0
+        n_prefixes, prefix_len = 32, 216
+        engine_kw = {"max_seqs": 8, "token_budget": 32, "block_size": 8,
+                     "num_blocks": 240}
+    workload = make_shared_prefix_workload(seed + 3, n_requests, rate,
+                                           vocab, n_prefixes, prefix_len)
+    # compile the one engine program (the pool shape is part of it)
+    # OUTSIDE every timed row — the single-engine row must not be the
+    # one that happens to pay the jit cold start
+    ServingEngineWarmup(model, engine_kw)
+    rows = {}
+    for name, n, policy in (("router_single", 1, "least_loaded"),
+                            ("router_random", n_replicas, "random"),
+                            ("router_affinity", n_replicas, "affinity")):
+        rows[name] = drive_router(model, workload, n, policy, engine_kw,
+                                  seed)
+        r = rows[name]
+        print(f"[bench_serve] {name:15s}: {r['tokens_per_s']:8.1f} tok/s  "
+              f"p99 {r['p99_latency_s']:7.3f}s  "
+              f"steps {r['engine_steps']:5d}  "
+              f"prefix hit {r['prefix_hit_token_rate'] * 100:5.1f}%",
+              flush=True)
+    aff, rnd, one = (rows["router_affinity"], rows["router_random"],
+                     rows["router_single"])
+    # every policy must deliver identical greedy tokens — routing moves
+    # requests, it never changes what the model says
+    assert aff["output_crc32"] == rnd["output_crc32"] \
+        == one["output_crc32"], "routing changed greedy output"
+    assert aff["prefix_hit_token_rate"] > rnd["prefix_hit_token_rate"], \
+        "prefix-affinity routing did not beat random on cache hit rate"
+    rows["router_workload"] = {
+        "n_requests": n_requests, "rate_rps": rate, "poisson": True,
+        "open_loop": True, "n_prefixes": n_prefixes,
+        "prefix_len": prefix_len, "replicas": n_replicas,
+        "engine": engine_kw}
+    rows["router_vs_single"] = round(
+        aff["tokens_per_s"] / max(one["tokens_per_s"], 1e-9), 3)
+    rows["affinity_vs_random"] = round(
+        aff["tokens_per_s"] / max(rnd["tokens_per_s"], 1e-9), 3)
+    return rows
 
 
 def drive_chaos(model, workload, engine_kw: dict, resilient: bool,
@@ -363,7 +553,8 @@ def run_chaos_pair(model, seed: int, fast: bool, engine_kw: dict):
 def run_bench(fast: bool = True, seed: int = 0, tag: str = "fast",
               n_requests: int = None, rate: float = None,
               out_path: str = None, spec: bool = False,
-              num_draft_tokens: int = 4, slo=None, chaos: bool = False):
+              num_draft_tokens: int = 4, slo=None, chaos: bool = False,
+              router: bool = False):
     model = _build_model(fast)
     vocab = model.config.vocab_size
     if fast:
@@ -453,6 +644,18 @@ def run_bench(fast: bool = True, seed: int = 0, tag: str = "fast",
         result["chaos_goodput_ratio"] = round(
             crows["chaos_resilient"]["goodput_tokens"]
             / max(crows["chaos_baseline"]["goodput_tokens"], 1), 3)
+    if router:
+        # scale-out rows: single engine vs N-replica router (random and
+        # prefix-affinity). The router rows run on their own tiny model
+        # even in full mode: the thousands-of-requests open-loop
+        # schedule is what exercises the fleet, and the measured
+        # quantity (aggregate prefix-cache capacity + placement policy)
+        # is model-size-free.
+        rrows = run_router_pair(seed, fast)
+        for key in ("router_workload", "router_single", "router_random",
+                    "router_affinity", "router_vs_single",
+                    "affinity_vs_random"):
+            result[key] = rrows[key]
     if out_path is None:
         out_path = os.path.join(HERE, f"BENCH_SERVE_{tag}.json")
     tmp = out_path + ".tmp"
@@ -462,6 +665,9 @@ def run_bench(fast: bool = True, seed: int = 0, tag: str = "fast",
     ratios = f"vs_static={result['vs_static']}"
     if spec:
         ratios += f" vs_nonspec={result['vs_nonspec']}"
+    if router:
+        ratios += (f" router_vs_single={result['router_vs_single']}"
+                   f" affinity_vs_random={result['affinity_vs_random']}")
     print(f"[bench_serve] {ratios}  -> {out_path}", flush=True)
     return result
 
@@ -492,6 +698,11 @@ def main(argv=None):
                     help="add the resilience pair: seeded fault+overload "
                          "schedule, PR 6 baseline (wedges) vs the armed "
                          "resilience plane (contains, sheds, finishes)")
+    ap.add_argument("--router", action="store_true",
+                    help="add the scale-out rows: single engine vs an "
+                         "N-replica ReplicaRouter under random and "
+                         "prefix-affinity routing on a shared-prefix "
+                         "open-loop workload")
     ap.add_argument("--draft-tokens", type=int, default=4,
                     help="per-sequence draft budget k for --spec")
     ap.add_argument("--out", default=None)
@@ -500,8 +711,10 @@ def main(argv=None):
     res = run_bench(fast=args.fast, seed=args.seed, tag=tag,
                     n_requests=args.requests, rate=args.rate,
                     out_path=args.out, spec=args.spec,
-                    num_draft_tokens=args.draft_tokens, chaos=args.chaos)
-    ok = res["vs_static"] > 1.0 and res.get("vs_nonspec", 2.0) > 1.0
+                    num_draft_tokens=args.draft_tokens, chaos=args.chaos,
+                    router=args.router)
+    ok = res["vs_static"] > 1.0 and res.get("vs_nonspec", 2.0) > 1.0 \
+        and res.get("router_vs_single", 2.0) > 1.0
     return 0 if ok else 1
 
 

@@ -655,6 +655,148 @@ def run_serve_drill(seed: int = 1234, verbose: bool = True,
             ctx.cleanup()
 
 
+def run_router_drill(seed: int = 1234, verbose: bool = True):
+    """Seeded replica-death drill for the prefix-affinity router
+    (serving/router.py): N=3 DISARMED replicas serve a shared-prefix
+    workload mid-load when an injected ``serve.engine_step`` fault
+    escapes one replica's step — to the router that IS replica death
+    (the PR 13 failure contract composed: a replica either serves or
+    hands its work back as a unit). Asserts:
+
+      * exactly one replica died and its drain manifest replayed onto
+        survivors GROUPED by the tag's affinity key (every request of
+        one prefix lands on ONE affinity-matched survivor);
+      * zero requests parked: every original handle resolved (finished,
+        or terminally failed with its replacement carrying on) and
+        every replacement finished;
+      * merged outputs (originals where they finished, replacements
+        where the death interrupted) equal the FAULT-FREE oracle —
+        generated tokens rode the manifest, greedy decode continued
+        exactly where the dead replica stopped;
+      * the ``stable`` report subset is bit-identical per seed.
+    """
+    import zlib
+
+    import numpy as np
+
+    from paddle_tpu.resilience import chaos
+    from paddle_tpu.serving import EngineConfig, ReplicaRouter, ServingEngine
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(repo, "tests"))
+    import serve_worker
+
+    model = serve_worker.build_model(seed)
+    rng = np.random.default_rng(seed)
+    # shared-prefix workload: 3 page-aligned 16-token prefixes (block
+    # size 8), 3 requests each with unique tails — the affinity signal
+    # the hand-off must preserve
+    prefixes = [rng.integers(1, 61, (16,)).tolist() for _ in range(3)]
+    prompts = [prefixes[i % 3]
+               + rng.integers(1, 61, (int(rng.integers(2, 5)),)).tolist()
+               for i in range(9)]
+    max_new = 6
+
+    def mk_router():
+        engines = [ServingEngine(model, EngineConfig(
+            max_seqs=2, token_budget=16, block_size=8))
+            for _ in range(3)]
+        return ReplicaRouter(engines, policy="affinity", seed=seed)
+
+    def run(fault_plan):
+        router = mk_router()
+        if fault_plan is not None:
+            chaos.install_plan(fault_plan)
+        try:
+            handles = [router.submit(p, max_new_tokens=max_new, tag=i)
+                       for i, p in enumerate(prompts)]
+            router.run_until_idle(max_steps=600)
+        finally:
+            chaos.clear_plan()
+        return router, handles
+
+    # -- fault-free oracle ----------------------------------------------------
+    oracle_router, oracle_handles = run(None)
+    oracle = {h.tag["tag"]: h.result(0) for h in oracle_handles}
+    assert not oracle_router.handoffs, "fault-free run handed off work"
+
+    # -- the death run: one escaped engine-step fault mid-load ----------------
+    plan = chaos.FaultPlan(seed=seed).add("serve.engine_step", "error",
+                                          at=(3,))
+    router, handles = run(plan)
+    assert [f[0] for f in plan.fired] == ["serve.engine_step"], \
+        "the death fault never fired — drill lost its teeth"
+    dead = [i for i, a in enumerate(router._alive) if not a]
+    assert len(dead) == 1, f"expected exactly one dead replica: {dead}"
+    assert len(router.handoffs) == 1
+    handoff = router.handoffs[0]
+    assert handoff["replica"] == dead[0] and handoff["reason"] == "death"
+    assert handoff["requests"] > 0, \
+        "death landed after the workload drained — fault index too late"
+    # affinity-matched hand-off: every group names ONE surviving target
+    for g in handoff["groups"]:
+        assert g["target"] != dead[0], "hand-off routed to the corpse"
+    replacements = handoff["handles"]
+
+    # zero parked: originals all resolved, replacements all finished
+    merged = {}
+    parked = 0
+    for h in list(handles) + list(replacements):
+        if not h.done:
+            parked += 1
+        elif h.error is None:
+            merged[h.tag["tag"]] = h.result(0)
+    assert parked == 0, f"{parked} requests parked across the death"
+    assert merged == oracle, \
+        "post-death outputs diverged from the fault-free oracle"
+    # the survivor inherited the affinity: a fresh same-prefix request
+    # routes to the hand-off target, not the corpse
+    from paddle_tpu.serving import prefix_chain_keys
+    probe_prefix = None
+    for g in handoff["groups"]:
+        if g["affinity"]:
+            probe_prefix = g
+            break
+    if probe_prefix is not None:
+        probe_prompt = next(
+            p for p in prompts
+            if prefix_chain_keys(p, 8)
+            and prefix_chain_keys(p, 8)[-1]
+            == tuple(probe_prefix["affinity"]))
+        probe = router.submit(probe_prompt, max_new_tokens=2,
+                              tag="probe")
+        target = probe_prefix["target"]
+        with router.replicas[target]._lock:
+            owned = probe in router.replicas[target].sched.waiting \
+                or probe in router.replicas[target].sched.running
+        assert owned, "affinity did not follow the hand-off target"
+        router.run_until_idle(max_steps=200)
+
+    report = {
+        "seed": seed, "ok": True,
+        "stable": {
+            "oracle_crc": zlib.crc32(np.asarray(
+                [t for i in sorted(oracle) for t in oracle[i]],
+                np.int64).tobytes()),
+            "dead_replica": dead[0],
+            "manifest_requests": handoff["requests"],
+            "handoff_groups": [
+                {"affinity": g["affinity"], "target": g["target"],
+                 "orders": g["orders"]} for g in handoff["groups"]],
+            "replay_crc": zlib.crc32(np.asarray(
+                [t for i in sorted(merged) for t in merged[i]],
+                np.int64).tobytes()),
+        },
+    }
+    if verbose:
+        print(f"router drill (seed={seed}): replica {dead[0]} died at "
+              f"engine-step fault #3 -> {handoff['requests']} requests "
+              f"handed off in {len(handoff['groups'])} affinity "
+              f"group(s), 0 parked, outputs == fault-free oracle — "
+              "replica-death failover verified")
+    return report
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seed", type=int, default=1234)
@@ -680,6 +822,10 @@ def main(argv=None):
     ap.add_argument("--mem", action="store_true",
                     help="run the memory-pressure drill (seeded pool "
                          "growth => exactly one dump naming the pool)")
+    ap.add_argument("--router", action="store_true",
+                    help="run the replica-death drill (one of N router "
+                         "replicas dies mid-load; its manifest replays "
+                         "onto affinity-matched survivors)")
     args = ap.parse_args(argv)
     if args.preempt:
         report = run_preempt_drill(seed=args.seed, verbose=not args.json,
@@ -691,6 +837,8 @@ def main(argv=None):
                                  supervised=not args.no_supervised)
     elif args.mem:
         report = run_mem_drill(seed=args.seed, verbose=not args.json)
+    elif args.router:
+        report = run_router_drill(seed=args.seed, verbose=not args.json)
     else:
         report = run_drill(seed=args.seed, verbose=not args.json)
     if args.json:

@@ -71,9 +71,73 @@ def _lat_line(name: str, d: dict) -> str:
             f"mean {_fmt_s(d.get('mean'))}  n={d.get('count', 0)}")
 
 
+def render_router(tel: dict, prev: dict = None) -> str:
+    """One multi-replica frame from a ``ReplicaRouter.telemetry()``
+    snapshot: fleet totals up top (aggregate tokens/steps/queue/pool +
+    prefix hit economics + routing/failover counters), then one compact
+    panel line per replica. ``prev`` supplies the instantaneous fleet
+    rate."""
+    router = tel["router"]
+    fleet = tel["fleet"]
+    lines = []
+    rate = ""
+    # prev may be a single-engine frame (a --watch file whose writer
+    # switched to a router mid-stream): only rate against router frames
+    if prev and "fleet" in prev and tel.get("unix_time") \
+            and prev.get("unix_time"):
+        dt = tel["unix_time"] - prev["unix_time"]
+        if dt > 0:
+            tps = (fleet["tokens_generated"]
+                   - prev["fleet"].get("tokens_generated", 0)) / dt
+            rate = f"  {tps:8.1f} tok/s (inst)"
+    lines.append(
+        f"paddle_tpu serve_top — fleet of {router['replicas']} "
+        f"({router['alive']} alive, policy {router['policy']})  "
+        f"steps {fleet['steps']}  tokens {fleet['tokens_generated']}"
+        f"{rate}")
+    lines.append("-" * 72)
+    routed = "  ".join(f"{k} {v}" for k, v in
+                       sorted(router.get("routed", {}).items()))
+    lines.append(
+        f"routing   {routed or '(none)'}   affinity hits "
+        f"{router.get('affinity_hits', 0)}  keys "
+        f"{router.get('affinity_keys', 0)}")
+    fo = router.get("failovers", {})
+    if fo or router.get("handoffs"):
+        lines.append(
+            f"failover  "
+            + ("  ".join(f"{k} {v}" for k, v in sorted(fo.items()))
+               or "none")
+            + f"   handoffs {router.get('handoffs', 0)}")
+    pool = fleet["pool"]
+    util = pool.get("utilization", 0.0)
+    prefix = fleet["prefix"]
+    lines.append(
+        f"fleet     waiting {fleet['queue_depth']:>3}  running "
+        f"{fleet['running']:>3}  kv {_bar(util)} {util * 100:5.1f}%  "
+        f"prefix hits {prefix['hits']}/{prefix['queries']} "
+        f"({prefix.get('hit_rate', 0.0) * 100:.1f}%)")
+    lines.append("-" * 72)
+    for rep in tel.get("replicas", ()):
+        p = rep.get("pool", {})
+        u = p.get("utilization", 0.0)
+        pre = p.get("prefix", {})
+        mark = " " if rep.get("alive", True) else "✗"
+        lines.append(
+            f"  r{rep.get('replica', '?')}{mark} steps {rep['steps']:>5}  "
+            f"tok {rep['tokens_generated']:>6}  wait "
+            f"{rep['queue_depth']:>3}  run {rep['running']:>2}  "
+            f"kv {_bar(u, 12)} {u * 100:5.1f}%  hits "
+            f"{pre.get('hits', 0)}/{pre.get('queries', 0)}")
+    return "\n".join(lines) + "\n"
+
+
 def render(tel: dict, prev: dict = None) -> str:
     """One dashboard frame from a telemetry snapshot (prev = the
-    previous snapshot, for instantaneous rates)."""
+    previous snapshot, for instantaneous rates). A ``ReplicaRouter``
+    snapshot (the ``router`` key) renders as the fleet dashboard."""
+    if "router" in tel and "replicas" in tel:
+        return render_router(tel, prev)
     lines = []
     steps = tel.get("steps", 0)
     tokens = tel.get("tokens_generated", 0)
@@ -196,6 +260,66 @@ def watch(path: str, interval: float, iterations, no_clear: bool) -> int:
     return 0
 
 
+def demo_router(iterations: int, n_requests: int, interval: float,
+                no_clear: bool, replicas: int, seed: int = 0) -> int:
+    """Multi-replica demo: a prefix-affinity ``ReplicaRouter`` over N
+    tiny engines under a seeded shared-prefix load, rendered as the
+    fleet dashboard between step batches."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import (EngineConfig, ReplicaRouter,
+                                    ServingEngine)
+
+    paddle.seed(11)
+    cfg = LlamaConfig.tiny(vocab_size=128, hidden_size=32, layers=2,
+                           heads=4, kv_heads=2, seq=128)
+    cfg.use_flash_attention = False
+    model = LlamaForCausalLM(cfg)
+    engines = [ServingEngine(model, EngineConfig(
+        max_seqs=4, token_budget=24, block_size=8))
+        for _ in range(replicas)]
+    router = ReplicaRouter(engines, policy="affinity", seed=seed)
+    rng = np.random.default_rng(seed)
+    prefixes = [rng.integers(1, 128, (16,)).tolist()
+                for _ in range(max(replicas, 2))]
+    handles = []
+    for i in range(n_requests):
+        pre = prefixes[i % len(prefixes)]
+        tail = rng.integers(1, 128,
+                            (int(rng.integers(2, 6)),)).tolist()
+        handles.append(router.submit(
+            pre + tail, max_new_tokens=int(rng.integers(6, 14)), tag=i))
+    prev = None
+    for _ in range(iterations):
+        if router.has_work():
+            for _ in range(4):
+                if not router.step_all():
+                    break
+        tel = router.telemetry()
+        if not no_clear:
+            sys.stdout.write(CLEAR)
+        sys.stdout.write(render(tel, prev))
+        sys.stdout.flush()
+        prev = tel
+        if not router.has_work():
+            break
+        if interval:
+            time.sleep(interval)
+    router.run_until_idle()
+    tel = router.telemetry()
+    if not no_clear:
+        sys.stdout.write(CLEAR)
+    sys.stdout.write(render(tel, prev))
+    finished = sum(1 for h in handles if h.done and h.error is None)
+    sys.stdout.write(
+        f"serve_top router demo: {finished}/{n_requests} requests over "
+        f"{replicas} replicas, {tel['fleet']['tokens_generated']} "
+        "tokens\n")
+    return 0 if finished == n_requests else 1
+
+
 def demo(iterations: int, n_requests: int, interval: float,
          no_clear: bool, seed: int = 0) -> int:
     """Self-contained demo: tiny model, seeded load, armed engine."""
@@ -262,14 +386,22 @@ def main(argv=None) -> int:
                          "watch mode, until drained in demo mode)")
     ap.add_argument("--requests", type=int, default=12,
                     help="demo-mode request count")
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="demo-mode replica count (> 1 drives a "
+                         "prefix-affinity ReplicaRouter and renders the "
+                         "fleet dashboard)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--no-clear", action="store_true",
                     help="append frames instead of clearing the screen "
                          "(logs, subprocess tests)")
     args = ap.parse_args(argv)
     if args.demo:
-        return demo(args.iterations if args.iterations is not None
-                    else 10 ** 9, args.requests, args.interval,
+        iters = args.iterations if args.iterations is not None else 10 ** 9
+        if args.replicas > 1:
+            return demo_router(iters, args.requests, args.interval,
+                               args.no_clear, args.replicas,
+                               seed=args.seed)
+        return demo(iters, args.requests, args.interval,
                     args.no_clear, seed=args.seed)
     return watch(args.watch, args.interval, args.iterations, args.no_clear)
 
