@@ -77,6 +77,9 @@ Catalog (names are a stable API — see README "Observability"):
   serve_router_affinity_hits_total       submissions routed to a prefix-affine replica
   serve_router_replica_queue_depth{replica}  per-replica waiting requests
   serve_router_failover_total{reason}    requests re-routed off a replica (backpressure|death|drain)
+  serve_kv_handoff_pages_total           KV pages moved prefill->decode across the pool boundary
+  serve_disagg_handoffs_total{outcome}   disaggregated hand-offs by outcome (pages|recompute|failed)
+  serve_role_queue_depth{role}           waiting requests per engine-pool role (prefill|decode)
 """
 from __future__ import annotations
 
@@ -157,6 +160,9 @@ CATALOG = (
     "serve_router_affinity_hits_total",
     "serve_router_replica_queue_depth",
     "serve_router_failover_total",
+    "serve_kv_handoff_pages_total",
+    "serve_disagg_handoffs_total",
+    "serve_role_queue_depth",
 )
 
 _enabled = _m._ENABLED  # bind the cell once: hot-path guard is _enabled[0]
@@ -691,6 +697,38 @@ def record_router_failover(reason: str) -> None:
     _reg().counter("serve_router_failover_total",
                    "requests re-routed off a replica by reason",
                    labelnames=("reason",)).labels(reason=reason).inc()
+
+
+def record_kv_handoff(pages: int) -> None:
+    """One prefill->decode KV-page export: ``pages`` physical pages'
+    contents moved across the pool boundary (0 for a 1-token prompt)."""
+    if not _enabled[0] or not pages:
+        return
+    _reg().counter("serve_kv_handoff_pages_total",
+                   "KV pages moved prefill->decode across the "
+                   "disaggregated pool boundary").inc(pages)
+
+
+def record_disagg_handoff(outcome: str) -> None:
+    """One disaggregated hand-off resolved (outcome: pages = KV import
+    landed, recompute = fallback to prompt recompute on the decode
+    replica, failed = no decode survivor — terminal error)."""
+    if not _enabled[0]:
+        return
+    _reg().counter("serve_disagg_handoffs_total",
+                   "prefill->decode hand-offs by outcome "
+                   "(pages|recompute|failed)",
+                   labelnames=("outcome",)).labels(outcome=outcome).inc()
+
+
+def record_role_queue_depth(role: str, depth: int) -> None:
+    """Aggregate waiting-queue depth of one engine-pool role."""
+    if not _enabled[0]:
+        return
+    _reg().gauge("serve_role_queue_depth",
+                 "waiting requests per engine-pool role "
+                 "(prefill|decode)",
+                 labelnames=("role",)).labels(role=role).set(float(depth))
 
 
 def record_serve_tokens(n: int, step_seconds: float) -> None:

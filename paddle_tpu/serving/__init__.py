@@ -77,6 +77,25 @@ the affinity key) replays onto affinity-matched survivors:
 Benchmark with ``python tools/bench_serve.py --router``; drill replica
 death with ``python tools/chaos_drill.py --router``; watch the fleet
 with ``python tools/serve_top.py --demo --replicas 4``.
+
+Disaggregated serving (``EngineConfig(role=)`` + the router's pool
+classes): ``role="prefill"`` engines give the whole token budget to
+chunked prefill and never sample; at prefill completion the request's
+KV pages — contents as device arrays plus hash-chain prefix
+registrations (``KVBlockPool.export_pages``/``import_pages``) — hand
+off to the affinity-matched ``role="decode"`` replica, where decode
+resumes bit-identically on a token-thin step program. Unobtainable
+imports and prefill-replica death degrade to prompt recompute on a
+decode survivor; nothing parks:
+
+    fleet = [ServingEngine(model, EngineConfig(role="prefill")),
+             ServingEngine(model, EngineConfig(role="decode",
+                                               token_budget=16))]
+    router = ReplicaRouter(fleet, policy="affinity")
+
+Benchmark with ``python tools/bench_serve.py --disagg``; drill prefill
+death with ``python tools/chaos_drill.py --disagg``; watch the pools
+with ``python tools/serve_top.py --demo --disagg --replicas 4``.
 """
 from .engine import (EngineConfig, EnginePredictor, ServingEngine,
                      engine_from_config)

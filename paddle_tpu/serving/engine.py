@@ -36,7 +36,7 @@ from . import ragged as _ragged
 from . import resilience as _res
 from .kv_pool import KVBlockPool
 from .obs import resolve_observer
-from .scheduler import Request, Scheduler
+from .scheduler import Request, Scheduler, WAITING
 from .speculative import make_drafter, verify_greedy
 
 
@@ -60,7 +60,7 @@ class EngineConfig:
                  num_draft_tokens: int = 4, draft_model=None,
                  spec_options: Optional[dict] = None,
                  aot_cache=None, obs=None, memwatch=None,
-                 resilience=None, mesh=None):
+                 resilience=None, mesh=None, role: Optional[str] = None):
         self.max_seqs = int(max_seqs)
         self.token_budget = int(token_budget)
         self.block_size = int(block_size)
@@ -100,6 +100,20 @@ class EngineConfig:
         # seams and the KV pools sharded per-KV-head ([L,P,kvh/mp,bs,hd]
         # per chip), so flagship-sized models serve at all
         self.mesh = mesh
+        # disaggregated-serving role (None = unified): "prefill" gives
+        # the WHOLE token budget to chunked prefill and never samples —
+        # finished prefills export their KV pages to a decode-pool
+        # replica (same compiled step program, different budget split);
+        # "decode" is the receiving pool's label (still a full engine:
+        # the recompute fallback needs it to prefill).
+        self.role = role
+        if role not in (None, "prefill", "decode"):
+            raise ValueError(
+                f"unknown engine role {role!r} (want prefill|decode|None)")
+        if role == "prefill" and spec_method is not None:
+            raise ValueError(
+                "a prefill-role engine never decodes — speculative "
+                "decoding belongs on the decode pool")
         if spec_method is not None and self.num_draft_tokens < 1:
             raise ValueError(
                 f"speculative decoding needs num_draft_tokens >= 1, "
@@ -213,6 +227,24 @@ def _all_finite(logits):
     sampled tokens cannot be trusted and the whole step is a fault.
     Fixed [T, V] shape, so it shares the engine's one-compile story."""
     return jnp.all(jnp.isfinite(logits))
+
+
+@jax.jit
+def _read_page(k_pools, v_pools, src):
+    """Gather one physical page's K/V across every layer — the device
+    half of a KV-page handoff EXPORT. ``src`` is a traced scalar, so one
+    compiled program serves every page index (a per-export stacked
+    gather would recompile on each distinct page count)."""
+    return k_pools[:, src], v_pools[:, src]
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def _install_page(k_pools, v_pools, k_page, v_page, dst):
+    """Scatter one exported page into the receiving pool at ``dst`` —
+    the device half of a KV-page handoff IMPORT. Pools donated like the
+    engine step; ``dst`` traced, one compile."""
+    return (k_pools.at[:, dst].set(k_page),
+            v_pools.at[:, dst].set(v_page))
 
 
 @partial(jax.jit, donate_argnums=(0, 1))
@@ -337,12 +369,27 @@ class ServingEngine:
             self.memwatch.register_pool("params", lambda: self._w)
             self.memwatch.register_pool(
                 "kv_pages", lambda: (self._kp, self._vp))
+        self.role = cfg.role
         self.sched = Scheduler(self.pool, cfg.max_seqs, cfg.token_budget,
                                self.max_pages_per_seq, policy=cfg.policy,
                                drafter=self.drafter,
                                num_draft_tokens=cfg.num_draft_tokens
                                if self.drafter is not None else 0,
-                               obs=self.obs)
+                               obs=self.obs, role=cfg.role)
+        # disaggregated hand-off plumbing: a router installs a sink
+        # (called OUTSIDE the engine lock with (request, export record))
+        # to move finished prefills to the decode pool; a standalone
+        # prefill engine stashes them for ``pop_handoffs()``
+        self.handoff_sink = None
+        self._handoff_outbox: List = []
+        # post-step hook (outside the engine lock): the router wires
+        # decode replicas to retry deferred hand-offs here, so fleets
+        # driven by one thread per replica — not step_all — still drain
+        # the pending list as decode queues free up
+        self.step_hook = None
+        self.kv_handoffs_out = 0
+        self.kv_handoffs_in = 0
+        self.kv_handoff_pages = 0
         self._tables = np.full((cfg.max_seqs, self.max_pages_per_seq), -1,
                                np.int32)
         self._rng = np.random.default_rng(seed)
@@ -676,15 +723,22 @@ class ServingEngine:
     # -- engine side ----------------------------------------------------------
     def step(self) -> bool:
         """Run one continuous-batching step: schedule, one device call,
-        sample, evict. Returns True while work remains."""
+        sample, evict — and on a prefill-role engine, export finished
+        prefills' KV pages for hand-off to the decode pool. Returns
+        True while work remains."""
         t0 = time.monotonic()
         obs = self.obs
         armed = obs is not None and obs.armed
+        sampled = None
         with self._lock:
             q0 = self.pool.stats["prefix_queries"]
             h0 = self.pool.stats["prefix_hits"]
             plan = self.sched.schedule()
             if not plan.entries:
+                # prefill-complete requests can exist even on an empty
+                # plan (everything schedulable was already swept):
+                # export them so the hand-off never waits on new work
+                outbox = self._collect_handoffs()
                 # an EMPTY plan is still evidence when something went
                 # wrong building it (exhaustion/chaos with nothing
                 # schedulable — the wedged-engine case the flight
@@ -711,46 +765,64 @@ class ServingEngine:
                     })
                 if not self.sched.has_work():
                     self._work.clear()
-                return self.sched.has_work()
-            try:
-                sampled = self._run_plan(plan, armed)
-            except Exception as exc:  # noqa: BLE001 — containment seam
-                if self.resilience is None:
-                    raise           # disarmed: the pre-resilience contract
-                self._contain_step_fault(plan, exc, armed, t0)
-                self._notify_admit()
-                return self.sched.has_work()
-            self.steps += 1
-            queue_depth = self.sched.queue_depth()
-            running = len(self.sched.running)
-            util = self.pool.utilization()
-            used_blocks = self.pool.used_blocks()
-            if self.memwatch is not None:
-                self.memwatch.snapshot(step=self.steps)
-            dq = self.pool.stats["prefix_queries"] - q0
-            dh = self.pool.stats["prefix_hits"] - h0
-            if armed:
-                dt = time.monotonic() - t0
-                obs.record_step({
-                    "step": self.steps,
-                    "t_mono_s": round(t0, 6),
-                    "dt_s": round(dt, 6),
-                    "plan": plan.explain,
-                    "entries": [{"rid": e.req.rid, "start": e.start,
-                                 "n": e.n, "draft": len(e.draft)}
-                                for e in plan.entries],
-                    "tokens": sampled["tokens"],
-                    "finished": sampled["finished_rids"],
-                    "accepted": sampled["accepted"],
-                    "rollback_pages": sampled["rollback_pages"],
-                    "pool": {"used": self.pool.used_blocks(),
-                             "cached": self.pool.cached_blocks(),
-                             "free": self.pool.free_blocks(),
-                             "utilization": round(util, 4)},
-                    "prefix": {"queries": dq, "hits": dh},
-                    "queue_depth": queue_depth,
-                    "running": running,
-                })
+                has_work = self.sched.has_work()
+            else:
+                try:
+                    sampled = self._run_plan(plan, armed)
+                except Exception as exc:  # noqa: BLE001 — containment seam
+                    if self.resilience is None:
+                        # disarmed: the pre-resilience contract — the
+                        # swept-but-unexported prefill_done requests stay
+                        # in scheduler state, so a router's salvage
+                        # manifest still sees them
+                        raise
+                    self._contain_step_fault(plan, exc, armed, t0)
+                    self._notify_admit()
+                    return self.sched.has_work()
+                # export AFTER the device call landed: a raising step
+                # must leave every request somewhere a salvage/requeue
+                # can find it, never half-exported in a dropped outbox
+                outbox = self._collect_handoffs()
+                self.steps += 1
+                queue_depth = self.sched.queue_depth()
+                running = len(self.sched.running)
+                util = self.pool.utilization()
+                used_blocks = self.pool.used_blocks()
+                if self.memwatch is not None:
+                    self.memwatch.snapshot(step=self.steps)
+                dq = self.pool.stats["prefix_queries"] - q0
+                dh = self.pool.stats["prefix_hits"] - h0
+                if armed:
+                    dt = time.monotonic() - t0
+                    obs.record_step({
+                        "step": self.steps,
+                        "t_mono_s": round(t0, 6),
+                        "dt_s": round(dt, 6),
+                        "plan": plan.explain,
+                        "entries": [{"rid": e.req.rid, "start": e.start,
+                                     "n": e.n, "draft": len(e.draft)}
+                                    for e in plan.entries],
+                        "tokens": sampled["tokens"],
+                        "finished": sampled["finished_rids"],
+                        "accepted": sampled["accepted"],
+                        "rollback_pages": sampled["rollback_pages"],
+                        "pool": {"used": self.pool.used_blocks(),
+                                 "cached": self.pool.cached_blocks(),
+                                 "free": self.pool.free_blocks(),
+                                 "utilization": round(util, 4)},
+                        "prefix": {"queries": dq, "hits": dh},
+                        "queue_depth": queue_depth,
+                        "running": running,
+                    })
+                has_work = self.sched.has_work()
+        # -- outside the engine lock: hand-off dispatch, telemetry I/O,
+        #    metrics (the sink takes the router lock, and lock order is
+        #    always engine -> nothing while dispatching)
+        self._dispatch_handoffs(outbox)
+        if self.step_hook is not None:
+            self.step_hook()
+        if sampled is None:
+            return has_work
         if armed and obs.telemetry_path and \
                 self.steps % obs.config.telemetry_every == 0:
             # telemetry file I/O happens OUTSIDE the engine lock —
@@ -770,13 +842,187 @@ class ServingEngine:
                                             sampled["accepted"])
         _instr.record_serve_spec_rollback(sampled["rollback_pages"])
         self._notify_admit()
-        return self.sched.has_work()
+        return has_work
 
     def _notify_admit(self) -> None:
         """Wake submitters blocked on queue room (policy ``block``)."""
         if self.resilience is not None:
             with self._admit_cv:
                 self._admit_cv.notify_all()
+
+    # -- disaggregated KV-page handoff (prefill -> decode pools) --------------
+    def _collect_handoffs(self) -> List:
+        """Export every prefill-complete request and detach it from this
+        engine (runs under the engine lock): gather the KV page contents
+        into standalone device arrays, register the full prompt pages in
+        the LOCAL prefix cache (later same-prefix arrivals prefill only
+        the tail), release the pages, and queue (request, record) for
+        the hand-off sink. After this the request owns nothing here."""
+        done = self.sched.pop_prefill_done()
+        if not done:
+            return []
+        out = []
+        now = time.monotonic()
+        bs = self.pool.block_size
+        for req in done:
+            record = self._export_request(req)
+            safe = req.pos // bs
+            if safe and self.config.enable_prefix_cache:
+                # only pages whose FULL content is cached may register —
+                # pos can sit mid-page, and a half-written boundary page
+                # served as a full-page hit would be garbage K/V
+                self.pool.register_prefix(req.seq[:safe * bs],
+                                          req.pages[:safe])
+            if req.pages:
+                self.pool.release(req.pages)
+            req.pages = []
+            # prefill service time: arrival -> hand-off is what this
+            # role's wait predictions must price (an e2e figure would
+            # never land here — prefill engines finish nothing), so the
+            # router's least-loaded fallback and the SLO-aware shed stop
+            # mispricing prefill replicas
+            self._e2e_sum += now - req.arrival
+            self._e2e_n += 1
+            self.kv_handoffs_out += 1
+            self.kv_handoff_pages += record["num_pages"]
+            _instr.record_kv_handoff(record["num_pages"])
+            if self.obs is not None:
+                self.obs.on_handoff_out(req, record["num_pages"],
+                                        record["n_tokens"])
+            out.append((req, record))
+        return out
+
+    def _export_request(self, req) -> dict:
+        """Device half of the KV-page export: one ``_read_page`` gather
+        per page (traced index — one compiled program serves every page
+        count). The gathered arrays are standalone copies, so releasing
+        or even LRU-overwriting the source pages can never touch the
+        hand-off. On a multi-host topology THIS is the ICI-transfer
+        seam: these arrays would be collective-sent to the decode
+        replica's chips; in-process the receiving engine device_puts
+        them into its own layout (``_place_page``)."""
+        record = self.pool.export_pages(req.pages, req.seq, req.pos)
+        ks, vs = [], []
+        for p in req.pages:
+            k, v = _read_page(self._kp, self._vp, jnp.int32(p))
+            ks.append(k)
+            vs.append(v)
+        record["k"] = ks
+        record["v"] = vs
+        return record
+
+    def _place_page(self, arr):
+        """Commit one incoming page array ([L, kvh, bs, hd]) to this
+        engine's device layout — the in-process spelling of the
+        cross-replica transfer (a device_put here; an ICI send/recv
+        between real hosts). Per-KV-head sharded under a TP mesh,
+        matching the pool layout the step program commits to."""
+        if self.mesh is None:
+            return arr
+        from jax.sharding import NamedSharding, PartitionSpec
+        return jax.device_put(arr, NamedSharding(
+            self.mesh, PartitionSpec(None, "mp", None, None)))
+
+    def import_handoff(self, req, record) -> None:
+        """Receive one prefill-complete hand-off INTO this decode-pool
+        engine: allocate pages, scatter the exported contents, attach
+        pages + position to the request, and queue it — the next step's
+        admission feeds the one pending prompt token and samples the
+        first output token, bit-identically to a single-engine run (the
+        imported K/V is byte-for-byte what this engine would have
+        computed). Raises ``PoolExhausted`` (or lets a ``serve.kv_alloc``
+        chaos fault through) when pages are unobtainable, with NOTHING
+        mutated — the router falls back to ``adopt_recompute``."""
+        with self._lock:
+            if self._draining:
+                raise _res.AdmissionRejected(
+                    "draining", queue_depth=self.sched.queue_depth())
+            # validate BEFORE allocating: a request this engine's caps
+            # can never hold (heterogeneous fleet) must not leak pages
+            # or escape the router's fallback ladder as a late raise
+            total = len(req.prompt) + req.max_new_tokens
+            cap = self.sched.max_pages_per_seq * self.pool.block_size
+            if total - 1 > cap:
+                raise ValueError(
+                    f"hand-off needs up to {total - 1} cached tokens "
+                    f"but this engine caps a sequence at {cap}")
+            pages = self.pool.import_pages(record)
+            try:
+                for dst, k, v in zip(pages, record["k"], record["v"]):
+                    self._kp, self._vp = _install_page(
+                        self._kp, self._vp, self._place_page(k),
+                        self._place_page(v), jnp.int32(dst))
+            except BaseException:
+                # import_pages registered the prefix keys; the scatter
+                # never wrote the contents — unregister BEFORE release,
+                # or garbage pages would park prefix-matchable
+                self.pool.unregister(pages)
+                self.pool.release(pages)
+                raise
+            req.pages = list(pages)
+            req.pos = record["n_tokens"]
+            req.n_prefix = record["n_tokens"]
+            req.state = WAITING
+            req.handoff_at = time.monotonic()
+            self.sched.submit(req)
+            self.kv_handoffs_in += 1
+            if self.obs is not None:
+                self.obs.on_handoff_in(req, outcome="pages")
+        self._work.set()
+        _instr.record_serve_queue_depth(self.sched.queue_depth())
+
+    def adopt_recompute(self, req) -> None:
+        """The hand-off fallback: take the request WITHOUT its KV pages
+        (prefill-replica death mid-handoff, import pool exhausted, chaos
+        fault on the import path) and recompute its prompt from scratch
+        — the PR 6 preemption mechanics, so greedy output is unchanged.
+        Bypasses admission control: the fleet already admitted it once.
+        A request THIS engine can never serve (pool or per-sequence cap
+        smaller than the request — a misconfigured fleet) resolves with
+        a terminal ``RequestFailed`` that also raises to the caller: an
+        impossible adoption must never park in the queue forever."""
+        with self._lock:
+            total = len(req.prompt) + req.max_new_tokens
+            bs = self.pool.block_size
+            if (total - 2) // bs + 1 > self.pool.num_blocks or \
+                    total - 1 > self.sched.max_pages_per_seq * bs:
+                err = _res.RequestFailed(req.rid,
+                                         reason="recompute_too_large")
+                req.fail(err)
+                self.requests_failed += 1
+                if self.obs is not None:
+                    self.obs.on_fail(req, "handoff_failed")
+                raise err
+            req.pages = []
+            req.pos = 0
+            req.n_prefix = 0
+            req.state = WAITING
+            req.handoff_at = time.monotonic()
+            self.sched.submit(req)
+            self.kv_handoffs_in += 1
+            if self.obs is not None:
+                self.obs.on_handoff_in(req, outcome="recompute")
+        self._work.set()
+
+    def _dispatch_handoffs(self, outbox) -> None:
+        """Hand collected exports to the sink (the router's dispatch) —
+        OUTSIDE the engine lock, since the sink takes the router lock
+        and then a decode replica's lock. Without a sink they stash for
+        ``pop_handoffs()`` (standalone prefill engines, tests)."""
+        if not outbox:
+            return
+        sink = self.handoff_sink
+        if sink is None:
+            self._handoff_outbox.extend(outbox)
+            return
+        for req, record in outbox:
+            sink(req, record)
+
+    def pop_handoffs(self) -> List:
+        """Drain the sink-less hand-off stash: (request, record) pairs
+        in prefill-completion order."""
+        out, self._handoff_outbox = self._handoff_outbox, []
+        return out
 
     # -- step-fault containment (serving/resilience.py) -----------------------
     def _contain_step_fault(self, plan, exc: BaseException, armed: bool,
@@ -975,8 +1221,13 @@ class ServingEngine:
                 self.sched.evict_finished(req)
                 if req.finished_at is not None:
                     # service-time evidence the admission-control
-                    # estimates (retry-after, predicted queue wait) read
-                    self._e2e_sum += req.finished_at - req.arrival
+                    # estimates (retry-after, predicted queue wait)
+                    # read; a handed-off request clocks from its
+                    # hand-off, not the original submit — decode-pool
+                    # estimates must not be polluted by prefill time
+                    self._e2e_sum += req.finished_at - (
+                        req.handoff_at if req.handoff_at is not None
+                        else req.arrival)
                     self._e2e_n += 1
             out["finished"] = len(finished)
             out["finished_rids"] = [r.rid for r in finished]
@@ -1045,8 +1296,8 @@ class ServingEngine:
                 break
         drain_seconds = time.monotonic() - t0
         with self._lock:
-            unfinished = list(self.sched.running) + list(self.sched.waiting)
-            manifest = _res.build_manifest(unfinished, drain_seconds)
+            manifest = _res.build_manifest(self._live_requests(),
+                                           drain_seconds)
             self.drains += 1
         path = manifest_path
         if path is None and self.resilience is not None:
@@ -1062,6 +1313,17 @@ class ServingEngine:
                 "manifest": path})
         return manifest
 
+    def _live_requests(self) -> List[Request]:
+        """Every request this engine is still responsible for (under the
+        engine lock), in scheduling order: running, prefill-complete
+        awaiting hand-off (swept but not yet dispatched, plus any
+        sink-less outbox entries), and waiting. Drain manifests and
+        abort_all enumerate THIS — a request mid-handoff must never be
+        invisible to a salvage."""
+        return (list(self.sched.running) + list(self.sched.prefill_done)
+                + [r for r, _ in self._handoff_outbox]
+                + list(self.sched.waiting))
+
     def abort_all(self, exc: Optional[BaseException] = None,
                   reason: str = "engine_abort") -> int:
         """Terminally fail EVERY live request (running + waiting) with a
@@ -1071,7 +1333,8 @@ class ServingEngine:
         exception instead of a forever-parked Future. Returns how many
         requests were failed. Always available, armed or not."""
         with self._lock:
-            live = list(self.sched.running) + list(self.sched.waiting)
+            live = self._live_requests()
+            self._handoff_outbox = []
             for req in live:
                 err = _res.RequestFailed(req.rid, reason=reason,
                                          retries=req.step_retries,
@@ -1124,6 +1387,11 @@ class ServingEngine:
             if self.mesh is not None:
                 base["mesh"] = {"mp": int(self.mesh.shape["mp"]),
                                 "devices": self.mesh.devices.size}
+            if self.role is not None:
+                base["role"] = self.role
+                base["handoff"] = {"out": self.kv_handoffs_out,
+                                   "in": self.kv_handoffs_in,
+                                   "pages": self.kv_handoff_pages}
             if self.drafter is not None:
                 base["spec"]["drafter"] = self.drafter.describe()
             if self.memwatch is not None:

@@ -205,6 +205,73 @@ class KVBlockPool:
             cow = (blk, new)
         return kept, len(tail), cow
 
+    # -- KV-page handoff (disaggregated serving) ------------------------------
+    def export_pages(self, pages: Sequence[int], token_ids: Sequence[int],
+                     n_tokens: int) -> dict:
+        """Accounting half of a prefill→decode KV-page handoff export:
+        the record a decode-pool replica's ``import_pages`` consumes.
+        ``pages`` must cover exactly ``n_tokens`` cached positions of
+        ``token_ids`` (full pages plus at most one partial boundary
+        page). The record carries the page COUNT and geometry, the
+        hash-chain keys of the FULL pages (so the importing pool can
+        re-register the prefix and the router can affinity-match the
+        hand-off), and the token ids those full pages hold — page
+        CONTENTS ride next to it as device arrays (the engine's half;
+        see ``ServingEngine._export_request``). Pure read: refcounts
+        stay with the exporting request until its engine releases them
+        after the device gather."""
+        if n_tokens < 0:
+            raise ValueError(f"export of negative coverage {n_tokens}")
+        need = -(-n_tokens // self.block_size)
+        if need != len(pages):
+            raise ValueError(
+                f"export of {n_tokens} tokens needs exactly {need} pages, "
+                f"got {len(pages)}")
+        full = n_tokens // self.block_size
+        tokens = [int(t) for t in token_ids[:full * self.block_size]]
+        return {
+            "version": 1,
+            "num_pages": len(pages),
+            "n_tokens": int(n_tokens),
+            "block_size": self.block_size,
+            # full-page chain keys: the prefix identity the import
+            # re-registers and the router's decode-pool affinity signal
+            "keys": self._chain_keys(tokens, self.block_size),
+            "tokens": tokens,
+        }
+
+    def unregister(self, pages: Sequence[int]) -> None:
+        """Drop the prefix keys of the given pages (their content can no
+        longer be trusted — e.g. a hand-off import whose device scatter
+        failed after ``import_pages`` registered them): a later
+        ``release`` frees them instead of parking garbage-content pages
+        where ``match_prefix`` would serve them as valid K/V."""
+        for blk in pages:
+            self._drop_key(blk)
+
+    def import_pages(self, record: dict) -> List[int]:
+        """Take ownership of one exported hand-off in THIS pool:
+        allocates ``num_pages`` fresh pages (refcount 1 each — the
+        importing request owns them) and re-registers the full pages'
+        hash-chain prefix keys, so the prefix travels WITH the K/V and
+        future same-prefix arrivals at the decode replica hit the cache.
+        Returns the new page list in export order (the engine scatters
+        the device contents into these slots). Raises ``PoolExhausted``
+        (or lets a ``serve.kv_alloc`` chaos fault through) when the
+        pages are not obtainable — the caller falls back to prompt
+        recompute, never a torn import: allocation is all-or-nothing
+        and nothing else mutates before it succeeds."""
+        if record.get("block_size") != self.block_size:
+            raise ValueError(
+                f"hand-off at block_size {record.get('block_size')} "
+                f"cannot import into a pool at {self.block_size}")
+        pages = self.allocate(record["num_pages"]) \
+            if record["num_pages"] else []
+        full = record["n_tokens"] // self.block_size
+        if full and record.get("tokens"):
+            self.register_prefix(record["tokens"], pages[:full])
+        return pages
+
     # -- prefix cache ---------------------------------------------------------
     @staticmethod
     def _chain_keys(token_ids: Sequence[int], block_size: int):

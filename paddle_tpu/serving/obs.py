@@ -175,7 +175,7 @@ class ServingObserver:
         self._live: Dict[int, Any] = {}          # rid -> Request
         self.counters = {"submitted": 0, "admitted": 0, "finished": 0,
                          "preempted": 0, "requeued": 0, "failed": 0,
-                         "shed": 0}
+                         "shed": 0, "handoff_out": 0, "handoff_in": 0}
         # bounded quantile sketches (private Histogram instances — the
         # registry-facing gauges are updated through instrument.record_*)
         self._lat = {
@@ -287,6 +287,39 @@ class ServingObserver:
                 req.trace.add("step_fault_requeue", time.monotonic(),
                               reason=reason, retries=req.step_retries,
                               generated=len(req.output))
+
+    def on_handoff_out(self, req, pages: int, n_tokens: int) -> None:
+        """Prefill complete, KV pages exported to the decode pool: the
+        ``kv_handoff`` lifecycle event — it sits between the prefill
+        chunks and the first_token the DECODE replica will record onto
+        the same trace (the trace object rides with the request across
+        the pool boundary). NOT terminal: the one finish event lands on
+        the receiving observer. The request leaves this observer's live
+        set — it is no longer this engine's to account."""
+        if not self.armed:
+            return
+        with self._lock:
+            self.counters["handoff_out"] += 1
+            if req.trace is not None:
+                req.trace.add("kv_handoff", time.monotonic(),
+                              pages=pages, tokens=n_tokens)
+            self._live.pop(req.rid, None)
+
+    def on_handoff_in(self, req, outcome: str = "pages") -> None:
+        """A handed-off request landed on this (decode-pool) engine —
+        ``outcome`` says how: "pages" (KV import, no recompute) or
+        "recompute" (fallback: pages were unobtainable or the prefill
+        replica died mid-handoff; the prompt re-prefills here). The
+        request joins this observer's live set; its eventual finish /
+        fail records the trace's single terminal event here."""
+        if not self.armed:
+            return
+        with self._lock:
+            self.counters["handoff_in"] += 1
+            self._live[req.rid] = req
+            if req.trace is not None:
+                req.trace.add("handoff_admit", time.monotonic(),
+                              outcome=outcome)
 
     def on_fail(self, req, reason: str) -> None:
         """Terminal failure/shed: exactly ONE finish event with the

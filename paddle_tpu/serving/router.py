@@ -34,20 +34,39 @@ router owns only placement:
     ``RequestFailed`` (never park); the replacement handles returned by
     the hand-off carry the work to completion.
 
+**Disaggregated serving** (ROADMAP item 2 rung b): when the engines
+carry roles (``EngineConfig(role="prefill" | "decode")``), the router
+splits the fleet into a PREFILL pool and a DECODE pool. A request is
+admitted to a prefill replica (whole token budget to chunked prefill,
+never a sampled token); at prefill completion the engine exports the
+request's KV pages — contents as device arrays plus the hash-chain
+prefix registrations — and the router hands both to the
+affinity-matched decode replica (``import_handoff``), where decode
+resumes bit-identically: the imported K/V is byte-for-byte what the
+decode engine would have computed itself. An unobtainable import (pool
+exhausted, chaos fault) or a prefill replica dying mid-handoff falls
+back to prompt recompute on a decode survivor (``adopt_recompute`` /
+the manifest replay) — degraded, never wrong, never parked. The two
+pools keep separate affinity maps: the prefill map routes arrivals to
+the replica holding their prompt prefix, the decode map keeps every
+hand-off of one prefix landing on the same decode replica.
+
 The router never touches engine internals beyond the documented failure
 contract; driving stays with the caller (``step_all`` round-robin, or
 one thread per replica calling ``engine.step()``).
 """
 from __future__ import annotations
 
+import functools
 import threading
 import time
 from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence
 
 from ..profiler import instrument as _instr
+from ..resilience import chaos
 from . import resilience as _res
-from .kv_pool import prefix_chain_keys
+from .kv_pool import PoolExhausted, prefix_chain_keys
 
 _POLICIES = ("affinity", "least_loaded", "random", "round_robin")
 
@@ -79,6 +98,44 @@ class ReplicaRouter:
         self.policy = policy
         self.failover = bool(failover)
         self.block_size = engines[0].pool.block_size
+        # disaggregated pools: engines carrying roles split the fleet
+        # into a prefill pool (admission targets) and a decode pool
+        # (hand-off targets); a role-less fleet is the unified router
+        roles = [getattr(e, "role", None) for e in self.replicas]
+        self.prefill_pool = [i for i, r in enumerate(roles)
+                             if r == "prefill"]
+        self.decode_pool = [i for i, r in enumerate(roles)
+                            if r == "decode"]
+        self.disaggregated = bool(self.prefill_pool or self.decode_pool)
+        if self.disaggregated:
+            if not (self.prefill_pool and self.decode_pool):
+                raise ValueError(
+                    "a disaggregated fleet needs at least one prefill "
+                    f"AND one decode replica (roles: {roles})")
+            if any(r is None for r in roles):
+                raise ValueError(
+                    "mixed fleet: every replica must carry a role once "
+                    f"any does (roles: {roles})")
+        # decode-pool affinity: chain key -> decode replica holding that
+        # prefix's handed-off K/V (the prefill map is self._affinity)
+        self._decode_affinity: "OrderedDict" = OrderedDict()
+        self.kv_handoffs = {"pages": 0, "recompute": 0, "failed": 0,
+                            "deferred": 0, "pages_moved": 0}
+        # hand-offs waiting for decode-pool admission room: importing
+        # pages under a queue deeper than a batch would park pool pages
+        # the queue itself cannibalizes long before admission (LRU
+        # eviction cascade — every queued request ends up recomputing
+        # its full prompt through the token-thin decode budget).
+        # ``step_all`` retries these; the page contents live in the
+        # record, so deferral holds no pool pages anywhere.
+        self._pending_handoffs: List = []
+        for i in self.prefill_pool:
+            self.replicas[i].handoff_sink = functools.partial(
+                self._dispatch_handoff, i)
+        for i in self.decode_pool:
+            # per-replica-thread driving never runs step_all, so the
+            # deferred-hand-off retry rides each decode step instead
+            self.replicas[i].step_hook = self._retry_pending_handoffs
         self._alive = [True] * len(self.replicas)
         self._rng = np.random.default_rng(seed)
         self._rr = 0
@@ -105,9 +162,16 @@ class ReplicaRouter:
         self._lock = threading.RLock()
 
     # -- placement ------------------------------------------------------------
-    def _routable(self, exclude: Optional[int] = None) -> List[int]:
-        return [i for i, e in enumerate(self.replicas)
-                if self._alive[i] and not e._draining and i != exclude]
+    def _routable(self, exclude: Optional[int] = None,
+                  role: Optional[str] = None) -> List[int]:
+        pool = range(len(self.replicas))
+        if role == "prefill":
+            pool = self.prefill_pool
+        elif role == "decode":
+            pool = self.decode_pool
+        return [i for i in pool
+                if self._alive[i] and not self.replicas[i]._draining
+                and i != exclude]
 
     def _least_loaded(self, cands: Sequence[int]) -> int:
         """Queue-depth / predicted-wait placement: the engine's own
@@ -123,8 +187,14 @@ class ReplicaRouter:
 
     def _route(self, keys) -> List:
         """Candidate replica order (best first) + the deciding policy.
-        Returns (order, why, affinity_depth)."""
-        cands = self._routable()
+        Returns (order, why, affinity_depth). Disaggregated fleets route
+        arrivals into the PREFILL pool; with every prefill replica
+        dead/draining, decode survivors take them (a decode engine is a
+        full engine — prompt recompute beats a refusal)."""
+        cands = self._routable(role="prefill") if self.disaggregated \
+            else self._routable()
+        if not cands and self.disaggregated:
+            cands = self._routable()
         if not cands:
             raise _res.AdmissionRejected("no_replica", queue_depth=0)
         target, why, depth = None, None, 0
@@ -150,11 +220,14 @@ class ReplicaRouter:
         return [target] + rest, why, depth
 
     def _register(self, keys, idx: int) -> None:
+        self._register_into(self._affinity, keys, idx)
+
+    def _register_into(self, amap, keys, idx: int) -> None:
         for key in keys:
-            self._affinity[key] = idx
-            self._affinity.move_to_end(key)
-        while len(self._affinity) > self.max_affinity_keys:
-            self._affinity.popitem(last=False)
+            amap[key] = idx
+            amap.move_to_end(key)
+        while len(amap) > self.max_affinity_keys:
+            amap.popitem(last=False)
 
     @staticmethod
     def _make_tag(keys, user_tag):
@@ -246,6 +319,135 @@ class ReplicaRouter:
         raise last_err if last_err is not None else \
             _res.AdmissionRejected("no_replica", queue_depth=0)
 
+    # -- disaggregated prefill -> decode hand-off -----------------------------
+    def _dispatch_handoff(self, src_idx: int, req, record,
+                          retry: bool = False) -> None:
+        """The prefill replicas' hand-off sink: land one finished
+        prefill on a decode replica — the decode pool's registered
+        holder of its prefix when alive, else least-loaded — and import
+        its KV pages there. An unobtainable import (pool exhausted,
+        chaos fault, draining target) degrades to prompt recompute; no
+        decode survivor degrades to ANY survivor; no survivor at all
+        resolves the request with a terminal error. A hand-off never
+        parks. Called outside the source engine's lock."""
+        keys = tuple(record.get("keys") or ())
+        aff = keys[-1] if keys else None
+        with self._lock:
+            cands = self._routable(role="decode")
+            if cands:
+                # decode-pull backpressure: only import onto a replica
+                # whose waiting queue is shallower than one batch — a
+                # deeper queue means the pages would sit parked (and be
+                # LRU-cannibalized) long before admission. No roomy
+                # survivor => defer; step_all retries as decode drains.
+                roomy = [i for i in cands
+                         if self.replicas[i].sched.queue_depth()
+                         < self.replicas[i].config.max_seqs]
+                if not roomy:
+                    if not retry:       # count requests, not retries
+                        self.kv_handoffs["deferred"] += 1
+                    self._pending_handoffs.append((src_idx, req, record))
+                    return
+                cands = roomy
+            else:
+                # a hand-off target must be able to SAMPLE: a prefill
+                # survivor would sweep the request straight back to its
+                # own hand-off list — an export/import ping-pong that
+                # never emits a token — so only non-prefill survivors
+                # qualify, and none left means a terminal failure below
+                cands = [i for i in self._routable(exclude=src_idx)
+                         if self.replicas[i].role != "prefill"]
+            target = None
+            if aff is not None and cands:
+                idx = self._decode_affinity.get(aff)
+                if idx is not None and idx in cands:
+                    target = idx
+                    self._decode_affinity.move_to_end(aff)
+            if target is None and cands:
+                target = self._least_loaded(cands)
+        if target is None:
+            # nothing left to serve it: terminal failure, not a park —
+            # the client's result()/stream() resolves now
+            err = _res.RequestFailed(req.rid, reason="handoff_no_replica")
+            req.fail(err)
+            src = self.replicas[src_idx]
+            if src.obs is not None:
+                # exactly one terminal lifecycle event, recorded where
+                # the request last lived
+                src.obs.on_fail(req, "handoff_failed")
+            with self._lock:
+                self.kv_handoffs["failed"] += 1
+            _instr.record_disagg_handoff("failed")
+            return
+        try:
+            self.replicas[target].import_handoff(req, record)
+            outcome = "pages"
+        except (PoolExhausted, ValueError, chaos.FaultInjected,
+                _res.AdmissionRejected):
+            # ValueError: the target's caps cannot hold the request
+            # (heterogeneous fleet) — same fallback as exhaustion; an
+            # exception must never escape the sink into the healthy
+            # prefill replica's step (step_all would read it as death)
+            # the manifest-style fallback: recompute the prompt on a
+            # decode survivor (prefer one that is not the replica that
+            # just refused) — degraded, never wrong
+            with self._lock:
+                alt = [i for i in self._routable(role="decode")
+                       if i != target] or \
+                      [i for i in self._routable(exclude=src_idx)
+                       if i != target
+                       and self.replicas[i].role != "prefill"]
+                if alt:
+                    target = self._least_loaded(alt)
+            try:
+                self.replicas[target].adopt_recompute(req)
+                outcome = "recompute"
+            except _res.RequestFailed:
+                # no replica can ever serve it (misconfigured fleet):
+                # the request resolved terminally inside adopt — count
+                # it and stop, nothing parks
+                outcome = "failed"
+        with self._lock:
+            self.kv_handoffs[outcome] += 1
+            if outcome == "pages":
+                self.kv_handoffs["pages_moved"] += record["num_pages"]
+            if outcome != "failed":
+                self._register_into(self._decode_affinity, keys, target)
+            died = outcome != "failed" and not self._alive[target]
+        _instr.record_disagg_handoff(outcome)
+        if died:
+            # the decode replica died while the import was landing: wait
+            # for its hand-off to finish, then recover whatever the
+            # death snapshot missed (the PR 14 placement-race contract)
+            self._handoff_complete[target].wait(timeout=30.0)
+            if not req.done:
+                # placed after the snapshot+abort: pull it out of the
+                # corpse and re-dispatch — the dead replica is no longer
+                # routable, so this terminates
+                eng = self.replicas[target]
+                with eng._lock:
+                    for q in (eng.sched.waiting, eng.sched.running,
+                              eng.sched.prefill_done):
+                        if req in q:
+                            q.remove(req)
+                    if req.pages:
+                        eng.pool.release(req.pages)
+                        req.pages = []
+                    if req.slot is not None:
+                        eng.sched._free_slots.append(req.slot)
+                        req.slot = None
+                # retry=True: a defer of this request was already
+                # counted once — re-dispatch must not double it
+                self._dispatch_handoff(src_idx, req, record, retry=True)
+
+    def _retry_pending_handoffs(self) -> None:
+        """Re-dispatch hand-offs deferred for decode-pool room (a
+        re-defer lands back on the pending list, retried next pass)."""
+        with self._lock:
+            pending, self._pending_handoffs = self._pending_handoffs, []
+        for src_idx, req, record in pending:
+            self._dispatch_handoff(src_idx, req, record, retry=True)
+
     # -- driving --------------------------------------------------------------
     def step_all(self) -> bool:
         """One round-robin pass: step every live replica that has work.
@@ -253,6 +455,8 @@ class ReplicaRouter:
         replica is failed as a unit (its manifest replays onto affinity
         -matched survivors) and the pass continues. Returns True while
         any live replica still has work."""
+        if self._pending_handoffs:
+            self._retry_pending_handoffs()
         for idx, eng in enumerate(self.replicas):
             if not self._alive[idx]:
                 continue
@@ -263,11 +467,18 @@ class ReplicaRouter:
                 self.fail_replica(idx, reason="death", cause=exc)
             _instr.record_router_queue_depth(idx,
                                              eng.sched.queue_depth())
+        if self.disaggregated:
+            for role, pool in (("prefill", self.prefill_pool),
+                               ("decode", self.decode_pool)):
+                _instr.record_role_queue_depth(
+                    role, sum(self.replicas[i].sched.queue_depth()
+                              for i in pool if self._alive[i]))
         return self.has_work()
 
     def has_work(self) -> bool:
-        return any(self._alive[i] and e.has_work()
-                   for i, e in enumerate(self.replicas))
+        return bool(self._pending_handoffs) or \
+            any(self._alive[i] and e.has_work()
+                for i, e in enumerate(self.replicas))
 
     def run_until_idle(self, max_steps: Optional[int] = None) -> int:
         """Drive ``step_all`` until the fleet drains; returns passes."""
@@ -303,10 +514,11 @@ class ReplicaRouter:
     def _salvage_manifest(eng) -> dict:
         """A drain manifest taken from the scheduler state directly —
         what death and a fault-mid-drain both fall back to when the
-        engine cannot run its own drain loop."""
+        engine cannot run its own drain loop. ``_live_requests`` also
+        covers prefill-complete requests a dying prefill replica swept
+        but never exported: mid-handoff work must not vanish."""
         with eng._lock:
-            live = list(eng.sched.running) + list(eng.sched.waiting)
-            return _res.build_manifest(live, 0.0)
+            return _res.build_manifest(eng._live_requests(), 0.0)
 
     def decommission(self, idx: int,
                      deadline_s: Optional[float] = None) -> List:
@@ -354,14 +566,24 @@ class ReplicaRouter:
         handles: List = []
         record = {"replica": exclude, "reason": reason,
                   "requests": len(entries), "groups": []}
+        # disaggregated fleets replay onto SAME-ROLE survivors first (a
+        # dead prefill replica's work re-prefills and hands off again; a
+        # dead decode replica's work recomputes on the decode pool), and
+        # only with none left onto any survivor — the manifest fallback
+        # for a prefill death with no prefill peer is prompt recompute
+        # straight on a decode survivor
+        role = getattr(self.replicas[exclude], "role", None)
+        amap = self._decode_affinity if role == "decode" \
+            else self._affinity
         for aff, group in groups.items():
             with self._lock:
-                cands = self._routable(exclude=exclude)
+                cands = self._routable(exclude=exclude, role=role) \
+                    or self._routable(exclude=exclude)
                 if not cands:
                     break           # no survivor: originals already failed
                 target = None
                 if aff is not None:
-                    idx = self._affinity.get(aff)
+                    idx = amap.get(aff)
                     if idx is not None and idx in cands:
                         target = idx
                 if target is None:
@@ -378,7 +600,7 @@ class ReplicaRouter:
                 for entry in group:
                     keys = prefix_chain_keys(entry["prompt"],
                                              self.block_size)
-                    self._register(keys, target)
+                    self._register_into(amap, keys, target)
                 self.failovers[reason] = \
                     self.failovers.get(reason, 0) + len(group)
             for _ in group:
@@ -408,11 +630,32 @@ class ReplicaRouter:
                 "failovers": dict(self.failovers),
                 "handoffs": len(self.handoffs),
             }
+            if self.disaggregated:
+                router["pools"] = {
+                    "prefill": {
+                        "replicas": list(self.prefill_pool),
+                        "alive": sum(1 for i in self.prefill_pool
+                                     if alive[i]),
+                        "queue_depth": sum(
+                            self.replicas[i].sched.queue_depth()
+                            for i in self.prefill_pool)},
+                    "decode": {
+                        "replicas": list(self.decode_pool),
+                        "alive": sum(1 for i in self.decode_pool
+                                     if alive[i]),
+                        "queue_depth": sum(
+                            self.replicas[i].sched.queue_depth()
+                            for i in self.decode_pool)},
+                }
+                router["kv_handoffs"] = dict(self.kv_handoffs)
         reps = []
         fleet = {"steps": 0, "tokens_generated": 0, "queue_depth": 0,
                  "running": 0,
                  "pool": {"size": 0, "used": 0, "cached": 0, "free": 0},
                  "prefix": {"queries": 0, "hits": 0, "hit_tokens": 0}}
+        slo = {"tracked": 0, "met": 0, "goodput_tokens": 0,
+               "total_tokens": 0}
+        saw_slo = False
         for idx, eng in enumerate(self.replicas):
             tel = eng.telemetry()
             tel["replica"] = idx
@@ -426,11 +669,25 @@ class ReplicaRouter:
                 fleet["pool"][k] += tel["pool"][k]
             for k in ("queries", "hits", "hit_tokens"):
                 fleet["prefix"][k] += tel["pool"]["prefix"][k]
+            if isinstance(tel.get("slo"), dict):
+                saw_slo = True
+                for k in slo:
+                    slo[k] += tel["slo"].get(k, 0)
         fleet["pool"]["utilization"] = round(
             fleet["pool"]["used"] / max(fleet["pool"]["size"], 1), 4)
         q = fleet["prefix"]["queries"]
         fleet["prefix"]["hit_rate"] = round(
             fleet["prefix"]["hits"] / q, 4) if q else 0.0
+        if saw_slo:
+            # fleet SLO roll-up (observers are per-engine; a handed-off
+            # request finishes — and is accounted — on its decode
+            # replica, so the sums are double-count-free)
+            slo["attainment"] = round(
+                slo["met"] / slo["tracked"], 6) if slo["tracked"] else 1.0
+            slo["goodput_fraction"] = round(
+                slo["goodput_tokens"] / slo["total_tokens"], 6) \
+                if slo["total_tokens"] else 1.0
+            fleet["slo"] = slo
         return {"router": router, "fleet": fleet, "replicas": reps,
                 "unix_time": time.time()}
 

@@ -28,6 +28,15 @@ step under one token budget:
 into an empty batch, run it dry) — the BatchingServer behavior — so
 tools/bench_serve.py measures the POLICY delta with identical per-step
 machinery.
+
+``role="prefill"`` (disaggregated serving) re-purposes the same budget
+machinery: the WHOLE budget feeds chunked prefill, a chunk never
+includes the sequence's final pending token (feeding it would SAMPLE —
+the decode pool's job), and a request whose prompt is fully cached
+minus that token sweeps into ``prefill_done`` for the engine's KV-page
+hand-off. ``role="decode"`` engines keep the full scheduler (the
+recompute fallback prefills here); their admission honors pages a
+KV-page import pre-attached instead of re-matching the prefix cache.
 """
 from __future__ import annotations
 
@@ -43,8 +52,10 @@ from .kv_pool import KVBlockPool, PoolExhausted
 
 _req_ids = itertools.count()
 
-# Request lifecycle states
+# Request lifecycle states (HANDOFF: prefill complete on a prefill-role
+# engine, KV pages awaiting export to a decode-pool replica)
 WAITING, RUNNING, FINISHED = "waiting", "running", "finished"
+HANDOFF = "handoff"
 
 
 class Request:
@@ -100,6 +111,11 @@ class Request:
         self.step_retries = 0         # contained step-fault requeues
         self.error: Optional[BaseException] = None
         self.arrival = time.monotonic()
+        # when a disaggregated hand-off landed this request on its
+        # decode replica (None otherwise): the decode engine's service
+        # -time evidence clocks from here, not from the original submit,
+        # so prefill time never pollutes the decode pool's estimates
+        self.handoff_at: Optional[float] = None
         self.first_token_at: Optional[float] = None
         self.finished_at: Optional[float] = None
         self.finish_reason: Optional[str] = None
@@ -216,9 +232,13 @@ class Scheduler:
 
     def __init__(self, pool: KVBlockPool, max_seqs: int, token_budget: int,
                  max_pages_per_seq: int, policy: str = "continuous",
-                 drafter=None, num_draft_tokens: int = 0, obs=None):
+                 drafter=None, num_draft_tokens: int = 0, obs=None,
+                 role: Optional[str] = None):
         if policy not in ("continuous", "static"):
             raise ValueError(f"unknown scheduling policy {policy!r}")
+        if role not in (None, "prefill", "decode"):
+            raise ValueError(
+                f"unknown engine role {role!r} (want prefill|decode|None)")
         if token_budget < max_seqs:
             raise ValueError(
                 f"token_budget {token_budget} < max_seqs {max_seqs}: a "
@@ -238,6 +258,14 @@ class Scheduler:
         # one `is None` check) and the current step's explain record
         self.obs = obs
         self._explain: Optional[dict] = None
+        # disaggregated-serving role: "prefill" devotes the whole token
+        # budget to chunked prefill and never schedules a sampling
+        # token — requests whose prompt is fully cached (one pending
+        # token) sweep into ``prefill_done`` for KV-page hand-off;
+        # "decode" is a routing/accounting label (a decode engine still
+        # prefills for the recompute fallback); None = unified.
+        self.role = role
+        self.prefill_done: List[Request] = []
         self.waiting: List[Request] = []
         self.running: List[Request] = []   # admission order
         self._free_slots = list(range(self.max_seqs - 1, -1, -1))
@@ -258,7 +286,25 @@ class Scheduler:
         self.waiting.append(req)
 
     def has_work(self) -> bool:
-        return bool(self.waiting or self.running)
+        return bool(self.waiting or self.running or self.prefill_done)
+
+    def pop_prefill_done(self) -> List[Request]:
+        """Drain the prefill-complete list (requests still holding their
+        KV pages — the engine exports those pages, hands the request to
+        the decode pool, and only then releases). Called by the engine
+        every step, so nothing lingers here past the step that swept it."""
+        done, self.prefill_done = self.prefill_done, []
+        return done
+
+    def _prefill_complete(self, req: Request) -> None:
+        """Move one request out of scheduling and into the hand-off
+        list: prompt fully cached (one pending token), pages KEPT for
+        export, slot returned (slots only matter for page-table rows)."""
+        req.state = HANDOFF
+        if req.slot is not None:
+            self._free_slots.append(req.slot)
+            req.slot = None
+        self.prefill_done.append(req)
 
     def queue_depth(self) -> int:
         return len(self.waiting)
@@ -356,8 +402,10 @@ class Scheduler:
         waiting queue in submission order, AHEAD of never-admitted
         arrivals; each carries one more ``step_retries`` tick for the
         engine's retry-budget check. Returns the requeued requests."""
-        victims = sorted(self.running, key=lambda r: r.rid)
+        victims = sorted(self.running + self.prefill_done,
+                         key=lambda r: r.rid)
         self.running.clear()
+        self.prefill_done.clear()
         for req in reversed(victims):
             self._release(req, cache_prefix=False)
             req.state = WAITING
@@ -378,6 +426,11 @@ class Scheduler:
         ``stream()`` with the error instead of leaving it parked."""
         if req in self.running:
             self.running.remove(req)
+            self._release(req, cache_prefix=False)
+        elif req in self.prefill_done:
+            # swept but never exported (death/abort before the hand-off
+            # landed): its pages are still held — release them
+            self.prefill_done.remove(req)
             self._release(req, cache_prefix=False)
         elif req in self.waiting:
             self.waiting.remove(req)
@@ -400,6 +453,17 @@ class Scheduler:
                        "admitted": [], "preempted": [], "exhaustion": [],
                        "chaos": [], "admission": None, "spec": None}
         self._explain = explain
+
+        # 0) prefill role: a request whose prompt is fully cached (one
+        #    pending token — feeding it would SAMPLE, which is the decode
+        #    pool's job) is prefill-complete: sweep it into the hand-off
+        #    list with its pages intact. The engine exports the pages and
+        #    hands the request across the pool boundary this same step.
+        if self.role == "prefill":
+            for req in [r for r in self.running
+                        if r.pos >= len(r.seq) - 1]:
+                self.running.remove(req)
+                self._prefill_complete(req)
 
         # 1) one decode token per running sequence in its decode phase —
         #    grown pages first; exhaustion preempts the youngest (possibly
@@ -431,7 +495,7 @@ class Scheduler:
                 break
             if req.pos >= len(req.seq) - 1:
                 continue                      # decode-phase: handled above
-            chunk = min(len(req.seq) - req.pos, budget)
+            chunk = min(self._prefill_cap(req), budget)
             chunk = self._fit_chunk(req, chunk)
             if chunk <= 0:
                 continue
@@ -468,11 +532,34 @@ class Scheduler:
                     obs.note_anomaly("chaos_fault",
                                      {"site": "serve.admit"})
                 break
-            pages, n_cached = self.pool.match_prefix(
-                req.seq, max_tokens=len(req.seq) - 1)
-            req.pages = pages
-            req.pos = req.n_prefix = n_cached
-            chunk = min(len(req.seq) - req.pos, budget)
+            if req.pages:
+                # a KV-page hand-off import pre-attached this request's
+                # cache (pages + pos, including the partial boundary
+                # page a fresh match_prefix could never return): honor
+                # it instead of re-matching, which would clobber the
+                # imported position
+                n_cached = req.pos
+            else:
+                pages, n_cached = self.pool.match_prefix(
+                    req.seq, max_tokens=len(req.seq) - 1)
+                req.pages = pages
+                req.pos = req.n_prefix = n_cached
+            if self.role == "prefill" and req.pos >= len(req.seq) - 1:
+                # the prefix cache already covers everything but the
+                # sampling token: prefill-complete straight from the
+                # queue — no slot, no chunk, pages ride to the hand-off
+                self.waiting.pop(0)
+                admitted += 1
+                if explain is not None:
+                    explain["admitted"].append(
+                        {"rid": req.rid, "chunk": 0,
+                         "prefix_tokens": n_cached,
+                         "requeued": req.preemptions})
+                if armed:
+                    obs.on_admit(req, 0, n_cached)
+                self._prefill_complete(req)
+                continue
+            chunk = min(self._prefill_cap(req), budget)
             chunk = self._fit_chunk(req, chunk, phase="admit")
             if chunk <= 0:
                 # pool pressure: roll the prefix hit back and stop
@@ -580,6 +667,17 @@ class Scheduler:
         return StepPlan(entries, admitted, preempted, drafted,
                         explain=explain)
 
+    def _prefill_cap(self, req: Request) -> int:
+        """How many tokens of ``req.seq`` prefill may still feed: the
+        full remainder on a unified/decode engine (feeding the final
+        token yields the logits the sample comes from), but NEVER the
+        final token on a prefill-role engine — that feed would sample,
+        and sampling is the decode pool's half of the split."""
+        cap = len(req.seq) - req.pos
+        if self.role == "prefill":
+            cap -= 1
+        return cap
+
     def _fit_chunk(self, req: Request, chunk: int,
                    phase: str = "prefill") -> int:
         """Shrink a prefill chunk to the pages actually obtainable.
@@ -597,4 +695,4 @@ class Scheduler:
 
 
 __all__ = ["Request", "Scheduler", "StepPlan", "StepEntry",
-           "WAITING", "RUNNING", "FINISHED"]
+           "WAITING", "RUNNING", "FINISHED", "HANDOFF"]

@@ -30,6 +30,15 @@ Named configs:
                     ``--mp N`` overrides the degree on any serve
                     config; the mesh geometry is part of the
                     fingerprint, so every degree is its own artifact.
+  tiny-llama-serve-prefill / tiny-llama-serve-decode
+                    the disaggregated pool programs: the prefill-role
+                    engine's wide chunked-prefill step (token budget 64)
+                    and the decode-role engine's token-thin step
+                    (token budget 16). The ROLE is scheduler policy,
+                    not program shape — what forks the artifact is the
+                    per-role token budget, which is exactly the point
+                    of the split (decode never rides a prefill-width
+                    program). ``--role`` sets it on any serve config.
 
 Exit code 0 = every program for the config is now in the ledger
 (freshly exported, or already present = a hit).
@@ -46,7 +55,8 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 CONFIGS = ("toy-trainer", "tiny-llama-serve", "tiny-gpt-serve",
-           "tiny-llama-serve-mp2", "tiny-gpt-serve-mp2")
+           "tiny-llama-serve-mp2", "tiny-gpt-serve-mp2",
+           "tiny-llama-serve-prefill", "tiny-llama-serve-decode")
 
 
 def _ensure_host_devices(n: int) -> None:
@@ -89,11 +99,13 @@ def warm_toy_trainer(cache: str, seed: int = 1234) -> dict:
 
 def warm_serve(cache: str, family: str, seed: int = 3, max_seqs: int = 8,
                token_budget: int = 64, block_size: int = 16,
-               quant=None, mp: int = 1) -> dict:
+               quant=None, mp: int = 1, role=None) -> dict:
     """Construct a ServingEngine over the tiny model: construction
     materializes ``serve_engine_step`` from avals (no tokens run).
     ``mp > 1`` warms the tensor-parallel program instead — the sharded
-    engine the next tunnel window's serving replicas deserialize."""
+    engine the next tunnel window's serving replicas deserialize.
+    ``role`` warms a disaggregated pool's engine (the prefill/decode
+    budgets produce differently-shaped programs)."""
     import paddle_tpu as paddle
     from paddle_tpu.serving import EngineConfig, ServingEngine
 
@@ -112,8 +124,8 @@ def warm_serve(cache: str, family: str, seed: int = 3, max_seqs: int = 8,
     engine = ServingEngine(model, EngineConfig(
         max_seqs=max_seqs, token_budget=token_budget,
         block_size=block_size, quant=quant, aot_cache=cache,
-        mesh=mp if mp > 1 else None))
-    return {"warm": engine.aot_warm_result, "mp": mp,
+        mesh=mp if mp > 1 else None, role=role))
+    return {"warm": engine.aot_warm_result, "mp": mp, "role": role,
             **dict(engine._step_call.stats)}
 
 
@@ -132,6 +144,10 @@ def main(argv=None) -> int:
     ap.add_argument("--mp", type=int, default=None,
                     help="tensor-parallel degree for the serve configs "
                          "(default 1; the -mp2 named configs imply 2)")
+    ap.add_argument("--role", choices=("prefill", "decode"), default=None,
+                    help="disaggregated pool role for the serve configs "
+                         "(the -prefill/-decode named configs imply it, "
+                         "with token budgets 64/16)")
     ap.add_argument("--stats", action="store_true",
                     help="print the cache ledger and exit")
     args = ap.parse_args(argv)
@@ -140,6 +156,17 @@ def main(argv=None) -> int:
         mp = 2 if args.config and args.config.endswith("-mp2") else 1
     if mp > 1:
         _ensure_host_devices(mp)   # must land before jax initializes
+    role = args.role
+    if role is None and args.config:
+        if args.config.endswith("-prefill"):
+            role = "prefill"
+        elif args.config.endswith("-decode"):
+            role = "decode"
+    token_budget = args.token_budget
+    if role == "decode" and args.config and \
+            args.config.endswith("-decode") and token_budget == 64:
+        # the decode pool's whole point is the token-thin program
+        token_budget = 16
 
     from paddle_tpu.aot.store import ArtifactStore
     store = ArtifactStore(args.cache)
@@ -157,9 +184,9 @@ def main(argv=None) -> int:
         family = "llama" if "llama" in args.config else "gpt"
         stats = warm_serve(args.cache, family, seed=args.seed,
                            max_seqs=args.max_seqs,
-                           token_budget=args.token_budget,
+                           token_budget=token_budget,
                            block_size=args.block_size, quant=args.quant,
-                           mp=mp)
+                           mp=mp, role=role)
     dt = time.monotonic() - t0
     ok = stats.get("fallbacks", 0) == 0
     print(f"aot_warm: {args.config} -> {args.cache} in {dt:.2f}s "

@@ -59,11 +59,25 @@ router-vs-single tokens/s scaling and the affinity-vs-random prefix-hit
 uplift; greedy output crc equality across all three is asserted in-run
 (routing moves requests, never changes tokens).
 
+``--disagg`` adds the disaggregation rows (ROADMAP item 2 rung b): ONE
+seeded bursty-prompt open-loop schedule — a steady decode-heavy stream
+with per-request TPOT deadlines, overlaid with periodic long-prompt
+bursts — driven through an N-replica UNIFIED fleet and an equal-size
+DISAGGREGATED fleet (N/2 prefill-role + N/2 decode-role engines, KV
+pages handed off over the router). On a unified engine every decode
+token rides a step program wide enough for chunked prefill, and bursts
+contend with decode for the KV pool; the split lets decode run the
+token-thin program on an interference-free pool. The rows pin decode
+TPOT p99 and SLO goodput improving at equal load, with greedy-output
+crc equality asserted in-run (disaggregation moves work, never changes
+tokens).
+
 Usage:
   python tools/bench_serve.py --fast --spec         # tier-1 smoke
   python tools/bench_serve.py --spec --tag r07
   python tools/bench_serve.py --chaos --tag r13
   python tools/bench_serve.py --router --tag r14
+  python tools/bench_serve.py --disagg --tag r15
 """
 import argparse
 import json
@@ -159,6 +173,46 @@ def make_shared_prefix_workload(seed: int, n_requests: int, rate: float,
         mnew = int(rng.integers(max_new[0], max_new[1] + 1))
         reqs.append({"arrival_s": float(arrivals[i]),
                      "prompt": pre + tail, "max_new": mnew})
+    return reqs
+
+
+def make_bursty_workload(seed: int, n_steady: int, steady_rate: float,
+                         vocab: int, burst_every_s: float,
+                         burst_size: int, steady_prompt=(6, 12),
+                         steady_new=(14, 22), burst_prompt=(64, 96),
+                         burst_new=(2, 3)):
+    """Seeded bursty-prompt open-loop schedule: a steady Poisson stream
+    of DECODE-HEAVY requests (short prompt, long output — the
+    interactive traffic whose TPOT the SLO tracks) overlaid with
+    periodic BURSTS of long-prompt, short-output arrivals (the ingest
+    traffic whose chunked prefill steals the token budget — and the KV
+    pool — from decode on a unified engine). Every request carries a
+    ``kind`` tag so the bench accounts decode TPOT on exactly the
+    steady stream; the schedule is fixed by the seed BEFORE either
+    fleet runs, so unified and disaggregated face identical load."""
+    rng = np.random.default_rng(seed)
+    gaps = rng.exponential(1.0 / steady_rate, n_steady)
+    arrivals = np.cumsum(gaps)
+    reqs = []
+    for i in range(n_steady):
+        plen = int(rng.integers(steady_prompt[0], steady_prompt[1] + 1))
+        mnew = int(rng.integers(steady_new[0], steady_new[1] + 1))
+        reqs.append({"arrival_s": float(arrivals[i]), "kind": "steady",
+                     "prompt": rng.integers(1, vocab, (plen,)).tolist(),
+                     "max_new": mnew})
+    span = float(arrivals[-1])
+    n_bursts = max(int(span / burst_every_s), 1)
+    for b in range(n_bursts):
+        t = burst_every_s * (b + 0.5)
+        for _ in range(burst_size):
+            plen = int(rng.integers(burst_prompt[0], burst_prompt[1] + 1))
+            mnew = int(rng.integers(burst_new[0], burst_new[1] + 1))
+            reqs.append({
+                "arrival_s": t + float(rng.uniform(0, 0.02)),
+                "kind": "burst",
+                "prompt": rng.integers(1, vocab, (plen,)).tolist(),
+                "max_new": mnew})
+    reqs.sort(key=lambda r: r["arrival_s"])
     return reqs
 
 
@@ -338,6 +392,199 @@ def drive_router(model, workload, n_replicas: int, policy: str,
         "affinity_hits": tel["router"]["affinity_hits"],
         "output_crc32": crc,
     }
+
+
+def drive_fleet(workload, engines, seed: int, slo):
+    """Open-loop drive of one pre-built fleet behind an affinity
+    ``ReplicaRouter`` (role-less engines = the unified fleet; prefill/
+    decode-role engines = the disaggregated fleet with KV-page
+    hand-off). SLO deadlines attach to the STEADY stream only — the
+    decode-latency contract disaggregation exists to protect. Returns
+    the stats row: tokens/s, steady-stream decode TPOT order-stat
+    percentiles, the fleet SLO roll-up, hand-off economics, crc."""
+    from paddle_tpu.serving import ReplicaRouter
+    router = ReplicaRouter(engines, policy="affinity", seed=seed)
+    ttft_d, tpot_d = slo
+    pending = sorted(workload, key=lambda r: r["arrival_s"])
+    handles = []
+    t0 = time.monotonic()
+    i = 0
+    while i < len(pending) or router.has_work():
+        now = time.monotonic() - t0
+        while i < len(pending) and pending[i]["arrival_s"] <= now:
+            r = pending[i]
+            steady = r.get("kind") != "burst"
+            handles.append((r, router.submit(
+                r["prompt"], max_new_tokens=r["max_new"],
+                ttft_deadline=ttft_d if steady else None,
+                tpot_deadline=tpot_d if steady else None, tag=i)))
+            i += 1
+        if router.has_work():
+            router.step_all()
+        elif i < len(pending):
+            time.sleep(min(pending[i]["arrival_s"] - now, 0.005))
+    wall = time.monotonic() - t0
+    tokens, crc = 0, 0
+    lats, tpots = [], []
+    for spec, req in handles:
+        assert req.done and req.error is None, \
+            f"request {req.rid} parked/failed across the fleet"
+        tokens += len(req.output)
+        crc = zlib.crc32(np.asarray(req.output, np.int32).tobytes(), crc)
+        lats.append((req.finished_at - t0) - spec["arrival_s"])
+        if spec.get("kind") != "burst" and len(req.output) > 1 \
+                and req.first_token_at is not None:
+            # per-request decode TPOT: mean seconds per output token
+            # AFTER the first — the quantity prefill interference taxes
+            tpots.append((req.finished_at - req.first_token_at)
+                         / (len(req.output) - 1))
+    tel = router.telemetry()
+    slo_agg = tel["fleet"].get("slo", {})
+    goodput = slo_agg.get("goodput_tokens", 0)
+    return {
+        "replicas": len(engines),
+        "roles": [getattr(e, "role", None) for e in engines],
+        "requests": len(handles),
+        "output_tokens": int(tokens),
+        "wall_s": round(wall, 4),
+        "tokens_per_s": round(tokens / wall, 2),
+        "p99_latency_s": round(float(np.percentile(np.asarray(lats),
+                                                   99)), 4),
+        "steady_requests": len(tpots),
+        "decode_tpot_p50_s": round(_order_stat(tpots, 0.50), 5),
+        "decode_tpot_p99_s": round(_order_stat(tpots, 0.99), 5),
+        "engine_steps": tel["fleet"]["steps"],
+        "slo_attainment": slo_agg.get("attainment"),
+        "goodput_tokens": goodput,
+        "goodput_tokens_per_s": round(goodput / wall, 2),
+        "goodput_fraction": slo_agg.get("goodput_fraction"),
+        "prefix_hit_tokens": int(tel["fleet"]["prefix"]["hit_tokens"]),
+        "kv_handoffs": dict(router.kv_handoffs)
+        if router.disaggregated else None,
+        "output_crc32": crc,
+    }
+
+
+def run_disagg_pair(seed: int, fast: bool):
+    """The disaggregation rows (ROADMAP item 2 rung b): ONE seeded
+    bursty-prompt schedule driven through (a) an N-replica UNIFIED
+    fleet — every engine serves both phases, so every decode token
+    rides a step program wide enough for chunked prefill, and bursts
+    contend with decode for each engine's KV pool — and (b) an
+    EQUAL-SIZE disaggregated fleet: N/2 prefill-role engines at the
+    same wide budget feeding N/2 decode-role engines that run the
+    token-thin decode program, KV pages handed off over the router.
+    The honest one-core mechanism: a decode token's latency is the
+    wall time of the step that carries it, and disaggregation is what
+    lets decode steps stop paying for prefill width (plus pool
+    isolation: bursts can no longer evict or preempt decode KV). On
+    real silicon the pools also separate compute. Greedy output crc
+    equality between the fleets is asserted in-run — disaggregation
+    moves work, never changes tokens."""
+    from paddle_tpu.serving import EngineConfig, ObsConfig, ServingEngine
+    model = _build_router_model(fast)
+    vocab = model.config.vocab_size
+    if fast:
+        n_replicas = 2
+        n_steady, steady_rate = 28, 40.0
+        burst_every, burst_size = 0.25, 3
+        burst_prompt = (56, 80)
+        slo = (8.0, 0.15)
+        steady_new = (14, 22)
+        uni_kw = {"max_seqs": 4, "token_budget": 24, "block_size": 8,
+                  "num_blocks": 48}
+        dec_budget = 6
+    else:
+        n_replicas = 4
+        n_steady, steady_rate = 400, 90.0
+        burst_every, burst_size = 0.3, 9
+        burst_prompt = (160, 224)
+        # long steady outputs: each request's TPOT is a mean over 24-32
+        # tokens, so the per-request distribution is tight and the p99
+        # separates structurally instead of by sampling noise
+        steady_new = (24, 32)
+        # the TPOT deadline sits BETWEEN the two fleets' observed
+        # distributions (unified p50 ~9-14ms, split p99 ~9.5ms on this
+        # host): a deadline both fleets trivially meet — or both blow —
+        # would measure nothing
+        slo = (10.0, 0.010)
+        # pool sized for a full batch of burst prompts (8 x 28 pages)
+        # PLUS decode growth slack: pressure without preemption thrash
+        # — a preempted 28-page request recomputing through the budget
+        # only to be preempted again would measure the thrash, not the
+        # split
+        uni_kw = {"max_seqs": 8, "token_budget": 64, "block_size": 8,
+                  "num_blocks": 320}
+        dec_budget = 8
+    pre_kw = dict(uni_kw)
+    dec_kw = dict(uni_kw, token_budget=dec_budget)
+    workload = make_bursty_workload(seed + 7, n_steady, steady_rate,
+                                    vocab, burst_every, burst_size,
+                                    steady_new=steady_new,
+                                    burst_prompt=burst_prompt)
+    obs = lambda: ObsConfig(flight_steps=32, flight_requests=16)  # noqa: E731
+
+    def unified():
+        return [ServingEngine(model, EngineConfig(obs=obs(), **uni_kw))
+                for _ in range(n_replicas)]
+
+    # the split keeps the replica COUNT equal: half the fleet prefills
+    # at the unified fleet's wide budget, half decodes token-thin
+    n_prefill = max(n_replicas // 2, 1)
+
+    def split():
+        pre = [ServingEngine(model, EngineConfig(
+            obs=obs(), role="prefill", **pre_kw))
+            for _ in range(n_prefill)]
+        dec = [ServingEngine(model, EngineConfig(
+            obs=obs(), role="decode", **dec_kw))
+            for _ in range(n_replicas - n_prefill)]
+        return pre + dec
+
+    # compile every program shape (unified/prefill width, decode width,
+    # page export/import) outside the timed rows
+    ServingEngineWarmup(model, uni_kw)
+    ServingEngineWarmup(model, dec_kw)
+    drive_fleet(make_bursty_workload(seed + 8, 4, 200.0, vocab, 0.1, 1,
+                                     burst_prompt=burst_prompt),
+                split(), seed, (None, None))
+    rows = {}
+    for name, mk in (("disagg_unified", unified),
+                     ("disagg_split", split)):
+        rows[name] = drive_fleet(workload, mk(), seed, slo)
+        r = rows[name]
+        print(f"[bench_serve] {name:15s}: {r['tokens_per_s']:8.1f} tok/s  "
+              f"decode tpot p99 {r['decode_tpot_p99_s'] * 1e3:7.2f}ms  "
+              f"slo {r['slo_attainment']:.2f}  goodput "
+              f"{r['goodput_tokens_per_s']:8.1f} tok/s  "
+              f"steps {r['engine_steps']:5d}", flush=True)
+    uni, spl = rows["disagg_unified"], rows["disagg_split"]
+    assert spl["output_crc32"] == uni["output_crc32"], \
+        "disaggregation changed greedy output"
+    assert spl["decode_tpot_p99_s"] < uni["decode_tpot_p99_s"], \
+        "disaggregated fleet did not improve decode TPOT p99"
+    if fast:
+        # fast mode keeps loose deadlines (CPU jitter): the floor is
+        # goodput parity + the TPOT win above
+        assert spl["goodput_tokens"] >= uni["goodput_tokens"], \
+            "disaggregated fleet lost SLO goodput"
+    else:
+        assert spl["goodput_tokens"] > uni["goodput_tokens"], \
+            "disaggregated fleet did not improve SLO goodput under " \
+            "the calibrated TPOT deadline"
+    rows["disagg_workload"] = {
+        "n_steady": n_steady, "steady_rate_rps": steady_rate,
+        "burst_every_s": burst_every, "burst_size": burst_size,
+        "burst_prompt": list(burst_prompt), "poisson": True,
+        "open_loop": True, "replicas": n_replicas,
+        "unified_engine": uni_kw, "prefill_engine": pre_kw,
+        "decode_engine": dec_kw,
+        "slo": {"ttft_deadline_s": slo[0], "tpot_deadline_s": slo[1]}}
+    rows["disagg_tpot_p99_ratio"] = round(
+        uni["decode_tpot_p99_s"] / max(spl["decode_tpot_p99_s"], 1e-9), 3)
+    rows["disagg_goodput_ratio"] = round(
+        spl["goodput_tokens"] / max(uni["goodput_tokens"], 1), 3)
+    return rows
 
 
 def _build_router_model(fast: bool):
@@ -554,7 +801,7 @@ def run_bench(fast: bool = True, seed: int = 0, tag: str = "fast",
               n_requests: int = None, rate: float = None,
               out_path: str = None, spec: bool = False,
               num_draft_tokens: int = 4, slo=None, chaos: bool = False,
-              router: bool = False):
+              router: bool = False, disagg: bool = False):
     model = _build_model(fast)
     vocab = model.config.vocab_size
     if fast:
@@ -656,6 +903,14 @@ def run_bench(fast: bool = True, seed: int = 0, tag: str = "fast",
                     "router_affinity", "router_vs_single",
                     "affinity_vs_random"):
             result[key] = rrows[key]
+    if disagg:
+        # disaggregation rows: equal-size unified vs prefill/decode
+        # split fleets on one bursty-prompt schedule — decode TPOT p99
+        # and SLO goodput are the headline, crc equality the invariant
+        drows = run_disagg_pair(seed, fast)
+        for key in ("disagg_workload", "disagg_unified", "disagg_split",
+                    "disagg_tpot_p99_ratio", "disagg_goodput_ratio"):
+            result[key] = drows[key]
     if out_path is None:
         out_path = os.path.join(HERE, f"BENCH_SERVE_{tag}.json")
     tmp = out_path + ".tmp"
@@ -668,6 +923,11 @@ def run_bench(fast: bool = True, seed: int = 0, tag: str = "fast",
     if router:
         ratios += (f" router_vs_single={result['router_vs_single']}"
                    f" affinity_vs_random={result['affinity_vs_random']}")
+    if disagg:
+        ratios += (f" disagg_tpot_p99_ratio="
+                   f"{result['disagg_tpot_p99_ratio']}"
+                   f" disagg_goodput_ratio="
+                   f"{result['disagg_goodput_ratio']}")
     print(f"[bench_serve] {ratios}  -> {out_path}", flush=True)
     return result
 
@@ -703,6 +963,11 @@ def main(argv=None):
                          "N-replica ReplicaRouter under random and "
                          "prefix-affinity routing on a shared-prefix "
                          "open-loop workload")
+    ap.add_argument("--disagg", action="store_true",
+                    help="add the disaggregation rows: equal-size "
+                         "unified vs prefill/decode split fleets "
+                         "(KV-page handoff over the router) on a "
+                         "bursty-prompt schedule")
     ap.add_argument("--draft-tokens", type=int, default=4,
                     help="per-sequence draft budget k for --spec")
     ap.add_argument("--out", default=None)
@@ -712,9 +977,10 @@ def main(argv=None):
                     n_requests=args.requests, rate=args.rate,
                     out_path=args.out, spec=args.spec,
                     num_draft_tokens=args.draft_tokens, chaos=args.chaos,
-                    router=args.router)
+                    router=args.router, disagg=args.disagg)
     ok = res["vs_static"] > 1.0 and res.get("vs_nonspec", 2.0) > 1.0 \
-        and res.get("router_vs_single", 2.0) > 1.0
+        and res.get("router_vs_single", 2.0) > 1.0 \
+        and res.get("disagg_tpot_p99_ratio", 2.0) > 1.0
     return 0 if ok else 1
 
 

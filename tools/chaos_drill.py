@@ -56,6 +56,17 @@ across runs.
 
     JAX_PLATFORMS=cpu python tools/chaos_drill.py --serve [--seed 1234]
 
+``--disagg`` runs the prefill-replica-death drill for the
+disaggregated fleet: 1 prefill + 2 decode replicas serve a
+shared-prefix workload; once the first KV-page hand-off has landed, a
+seeded ``serve.engine_step`` fault kills the PREFILL replica. With no
+prefill survivor the salvage manifest replays onto decode survivors
+via prompt recompute (the manifest fallback) — zero parked, outputs
+equal the fault-free oracle, and the headless fleet still serves fresh
+requests. Deterministic per seed.
+
+    JAX_PLATFORMS=cpu python tools/chaos_drill.py --disagg [--seed 1234]
+
 ``--mem`` runs the memory-pressure drill: an armed memory watcher
 (paddle_tpu.profiler.memwatch) with a seeded growth workload filling the
 ``kv_pages`` pool must produce EXACTLY one well-formed pressure dump
@@ -797,6 +808,154 @@ def run_router_drill(seed: int = 1234, verbose: bool = True):
     return report
 
 
+def run_disagg_drill(seed: int = 1234, verbose: bool = True):
+    """Seeded prefill-replica death drill for the disaggregated fleet
+    (serving/router.py pool classes): 1 prefill + 2 decode replicas
+    serve a shared-prefix workload when an injected
+    ``serve.engine_step`` fault kills the PREFILL replica mid-stream —
+    some requests already handed their KV pages to the decode pool,
+    the rest are mid-prefill or queued. With no prefill survivor, the
+    salvage manifest replays onto DECODE survivors via prompt recompute
+    (the manifest fallback: a decode engine is a full engine). Asserts:
+
+      * the dead replica is the prefill one, and every hand-off group
+        in the manifest replay targets a decode survivor;
+      * at least one KV-page hand-off landed BEFORE the death (the
+        drill kills mid-handoff, not before the machinery engaged);
+      * zero requests parked: originals resolved, replacements
+        finished, merged outputs equal the fault-free disaggregated
+        oracle (which itself equals the single-engine oracle);
+      * the headless fleet still serves: a fresh post-death submit
+        recomputes on the decode pool and completes;
+      * the ``stable`` report subset is bit-identical per seed.
+    """
+    import zlib
+
+    import numpy as np
+
+    from paddle_tpu.resilience import chaos
+    from paddle_tpu.serving import (EngineConfig, ReplicaRouter,
+                                    ServingEngine)
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(repo, "tests"))
+    import serve_worker
+
+    model = serve_worker.build_model(seed)
+    rng = np.random.default_rng(seed)
+    prefixes = [rng.integers(1, 61, (16,)).tolist() for _ in range(3)]
+    prompts = [prefixes[i % 3]
+               + rng.integers(1, 61, (int(rng.integers(2, 5)),)).tolist()
+               for i in range(9)]
+    max_new = 6
+
+    def mk_router():
+        pre = ServingEngine(model, EngineConfig(
+            max_seqs=2, token_budget=16, block_size=8, role="prefill"))
+        dec = [ServingEngine(model, EngineConfig(
+            max_seqs=2, token_budget=8, block_size=8, role="decode"))
+            for _ in range(2)]
+        return ReplicaRouter([pre] + dec, policy="affinity", seed=seed)
+
+    def run(fault: bool):
+        router = mk_router()
+        handles = [router.submit(p, max_new_tokens=max_new, tag=i)
+                   for i, p in enumerate(prompts)]
+        if not fault:
+            router.run_until_idle(max_steps=800)
+            return router, handles, None
+        # drive until the FIRST KV-page hand-off has landed on the
+        # decode pool, then arm the fault: the very next engine step to
+        # run is the prefill replica's (it steps first in the round and
+        # its queue is still deep), so the death strikes the prefill
+        # replica MID-handoff — some pages already moved, the rest of
+        # the work mid-prefill or queued. Deterministic per seed.
+        rounds = 0
+        while router.kv_handoffs["pages"] < 1 and rounds < 50:
+            router.step_all()
+            rounds += 1
+        plan = chaos.FaultPlan(seed=seed).add("serve.engine_step",
+                                              "error", at=(1,))
+        chaos.install_plan(plan)
+        try:
+            router.run_until_idle(max_steps=800)
+        finally:
+            chaos.clear_plan()
+        return router, handles, plan
+
+    # -- fault-free disaggregated oracle --------------------------------------
+    oracle_router, oracle_handles, _ = run(fault=False)
+    oracle = {h.tag["tag"]: h.result(0) for h in oracle_handles}
+    assert not oracle_router.handoffs, "fault-free run replayed a manifest"
+    assert oracle_router.kv_handoffs["pages"] > 0, \
+        "fault-free run never exercised the KV-page hand-off"
+
+    # -- the death run: the prefill replica dies mid-handoff ------------------
+    router, handles, plan = run(fault=True)
+    assert [f[0] for f in plan.fired] == ["serve.engine_step"], \
+        "the death fault never fired — drill lost its teeth"
+    dead = [i for i, a in enumerate(router._alive) if not a]
+    assert dead == [0], f"expected the prefill replica dead, got {dead}"
+    assert router.kv_handoffs["pages"] >= 1, \
+        "death landed before any KV hand-off — not a mid-handoff drill"
+    assert len(router.handoffs) == 1
+    handoff = router.handoffs[0]
+    assert handoff["replica"] == 0 and handoff["reason"] == "death"
+    assert handoff["requests"] > 0, \
+        "death landed after the workload drained — fault index too late"
+    for g in handoff["groups"]:
+        # no prefill survivor exists: every group must land on a decode
+        # survivor for prompt recompute
+        assert g["target"] in router.decode_pool, \
+            f"hand-off group landed outside the decode pool: {g}"
+    replacements = handoff["handles"]
+
+    merged, parked = {}, 0
+    for h in list(handles) + list(replacements):
+        if not h.done:
+            parked += 1
+        elif h.error is None:
+            merged[h.tag["tag"]] = h.result(0)
+    assert parked == 0, f"{parked} requests parked across the death"
+    assert merged == oracle, \
+        "post-death outputs diverged from the fault-free oracle"
+
+    # the headless fleet still serves: a fresh submit recomputes on the
+    # decode pool (no prefill replica remains to route to)
+    probe = router.submit(prompts[0], max_new_tokens=max_new,
+                          tag="probe")
+    router.run_until_idle(max_steps=300)
+    assert probe.result(0) == oracle[0], \
+        "post-death fleet no longer serves fresh requests"
+
+    report = {
+        "seed": seed, "ok": True,
+        "stable": {
+            "oracle_crc": zlib.crc32(np.asarray(
+                [t for i in sorted(oracle) for t in oracle[i]],
+                np.int64).tobytes()),
+            "dead_replica": dead[0],
+            "pre_death_page_handoffs": router.kv_handoffs["pages"],
+            "manifest_requests": handoff["requests"],
+            "handoff_groups": [
+                {"affinity": g["affinity"], "target": g["target"],
+                 "orders": g["orders"]} for g in handoff["groups"]],
+            "replay_crc": zlib.crc32(np.asarray(
+                [t for i in sorted(merged) for t in merged[i]],
+                np.int64).tobytes()),
+        },
+    }
+    if verbose:
+        print(f"disagg drill (seed={seed}): prefill replica died at the "
+              f"first post-handoff engine step, after "
+              f"{router.kv_handoffs['pages']} page hand-off(s) -> "
+              f"{handoff['requests']} requests recomputed on decode "
+              f"survivors in {len(handoff['groups'])} group(s), 0 "
+              "parked, outputs == fault-free oracle — prefill-death "
+              "manifest fallback verified")
+    return report
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seed", type=int, default=1234)
@@ -826,6 +985,10 @@ def main(argv=None):
                     help="run the replica-death drill (one of N router "
                          "replicas dies mid-load; its manifest replays "
                          "onto affinity-matched survivors)")
+    ap.add_argument("--disagg", action="store_true",
+                    help="run the prefill-replica-death drill (the "
+                         "prefill pool dies mid-handoff; requests land "
+                         "on decode survivors via prompt recompute)")
     args = ap.parse_args(argv)
     if args.preempt:
         report = run_preempt_drill(seed=args.seed, verbose=not args.json,
@@ -839,6 +1002,8 @@ def main(argv=None):
         report = run_mem_drill(seed=args.seed, verbose=not args.json)
     elif args.router:
         report = run_router_drill(seed=args.seed, verbose=not args.json)
+    elif args.disagg:
+        report = run_disagg_drill(seed=args.seed, verbose=not args.json)
     else:
         report = run_drill(seed=args.seed, verbose=not args.json)
     if args.json:

@@ -155,12 +155,31 @@ def plan(cfg: dict, *, mesh: dict = None, dtype: str = "float32",
          remat: str = "full", accumulate_steps: int = 1,
          kv_dtype: str = None, block_size: int = 16,
          num_blocks: int = None, max_seqs: int = 8,
-         workspace_bytes: int = 0, hbm_gib: float = None) -> dict:
+         workspace_bytes: int = 0, hbm_gib: float = None,
+         role: str = None) -> dict:
     """Devices-free per-chip memory prediction. See module docstring for
     the component model; every figure is integer bytes so the committed
-    fixture pins the arithmetic exactly."""
+    fixture pins the arithmetic exactly.
+
+    ``role`` (serve mode only; None = unified engine) prices a
+    disaggregated pool's KV separately. The two pools want opposite
+    shapes: a PREFILL pool needs DEPTH — every in-flight prefill holds
+    its whole prompt's pages only until the hand-off, so ``max_seqs``
+    is the concurrent-prefill count and ``context`` the prompt budget —
+    while a DECODE pool needs RESIDENCY — sequences hold their pages
+    for the whole decode lifetime, so ``max_seqs`` is the resident
+    batch and ``context`` the full prompt+output length. Both roles
+    additionally price ``kv_staging``: one max-depth request's pages
+    live OUTSIDE the pool during a hand-off (the export's gathered
+    copies on the prefill side, the pre-scatter arrays on the decode
+    side), which the unified engine never pays."""
     if mode not in ("train", "serve"):
         raise ValueError(f"mode must be train|serve, got {mode!r}")
+    if role not in (None, "prefill", "decode"):
+        raise ValueError(
+            f"role must be prefill|decode|None, got {role!r}")
+    if role is not None and mode != "serve":
+        raise ValueError("role= is a serve-mode term (engine pools)")
     if remat not in ACT_FACTORS:
         raise ValueError(
             f"remat must be one of {sorted(ACT_FACTORS)}, got {remat!r}")
@@ -211,6 +230,14 @@ def plan(cfg: dict, *, mesh: dict = None, dtype: str = "float32",
         components["kv_cache"] = _bytes_of(
             2 * layers * pages * kv * block_size * hd, kbits) // mp
         # packed ragged batch activations are token_budget-sized: noise
+        if role is not None:
+            # one max-depth request's pages in flight across the pool
+            # boundary (export copies / pre-scatter arrays), beyond the
+            # pool itself — the hand-off's working-set tax
+            staging_pages = -(-ctx // block_size)
+            components["kv_staging"] = _bytes_of(
+                2 * layers * staging_pages * kv * block_size * hd,
+                kbits) // mp
     components["workspace"] = int(workspace_bytes)
     if not workspace_bytes:
         estimates.append("workspace")
@@ -220,6 +247,9 @@ def plan(cfg: dict, *, mesh: dict = None, dtype: str = "float32",
         "schema": 1,
         "mode": mode,
         "dtype": dtype,
+        # role key only present when set, so pre-disagg fixture cases
+        # (and their committed expectations) stay byte-identical
+        **({"role": role} if role is not None else {}),
         "mesh": {"mp": mp, "sharding": sharding, "dp": dp},
         "zero_stage": zero_stage if mode == "train" else None,
         "context": ctx,
@@ -392,6 +422,10 @@ def main(argv=None) -> int:
                          "evidence report")
     ap.add_argument("--preset", choices=sorted(PRESETS), default="toy")
     ap.add_argument("--mode", choices=("train", "serve"), default="train")
+    ap.add_argument("--role", choices=("prefill", "decode"), default=None,
+                    help="serve mode: price a disaggregated pool "
+                         "(prefill = depth, decode = residency; both "
+                         "add the hand-off kv_staging term)")
     ap.add_argument("--dtype", default="float32")
     ap.add_argument("--kv-dtype", default=None)
     ap.add_argument("--mesh", default="", help="e.g. mp=4,sharding=8,dp=1")
@@ -447,7 +481,8 @@ def main(argv=None) -> int:
                  context=args.context, remat=args.remat,
                  kv_dtype=args.kv_dtype, block_size=args.block_size,
                  num_blocks=args.num_blocks, max_seqs=args.max_seqs,
-                 workspace_bytes=args.workspace, hbm_gib=args.fits)
+                 workspace_bytes=args.workspace, hbm_gib=args.fits,
+                 role=args.role)
         print(json.dumps(p, indent=1, sort_keys=True) if args.as_json
               else render_plan(p), end="")
         return 0

@@ -109,6 +109,22 @@ def render_router(tel: dict, prev: dict = None) -> str:
             + ("  ".join(f"{k} {v}" for k, v in sorted(fo.items()))
                or "none")
             + f"   handoffs {router.get('handoffs', 0)}")
+    pools = router.get("pools")
+    if pools:
+        # disaggregated fleet: the prefill/decode pool panel + the
+        # KV-page hand-off economics between them
+        kh = router.get("kv_handoffs", {})
+        parts = []
+        for role in ("prefill", "decode"):
+            p = pools.get(role, {})
+            parts.append(
+                f"{role} {p.get('alive', 0)}/{len(p.get('replicas', []))}"
+                f" (queue {p.get('queue_depth', 0)})")
+        lines.append("pools     " + "   ".join(parts))
+        lines.append(
+            f"handoff   pages {kh.get('pages', 0)}  recompute "
+            f"{kh.get('recompute', 0)}  failed {kh.get('failed', 0)}  "
+            f"kv pages moved {kh.get('pages_moved', 0)}")
     pool = fleet["pool"]
     util = pool.get("utilization", 0.0)
     prefix = fleet["prefix"]
@@ -123,12 +139,19 @@ def render_router(tel: dict, prev: dict = None) -> str:
         u = p.get("utilization", 0.0)
         pre = p.get("prefix", {})
         mark = " " if rep.get("alive", True) else "✗"
+        role = {"prefill": "P", "decode": "D"}.get(rep.get("role"), " ")
+        extra = ""
+        hand = rep.get("handoff")
+        if hand:
+            extra = (f"  hoff {hand.get('out', 0)}>" if rep.get("role")
+                     == "prefill" else f"  hoff >{hand.get('in', 0)}")
         lines.append(
-            f"  r{rep.get('replica', '?')}{mark} steps {rep['steps']:>5}  "
+            f" {role}r{rep.get('replica', '?')}{mark} steps "
+            f"{rep['steps']:>5}  "
             f"tok {rep['tokens_generated']:>6}  wait "
             f"{rep['queue_depth']:>3}  run {rep['running']:>2}  "
             f"kv {_bar(u, 12)} {u * 100:5.1f}%  hits "
-            f"{pre.get('hits', 0)}/{pre.get('queries', 0)}")
+            f"{pre.get('hits', 0)}/{pre.get('queries', 0)}{extra}")
     return "\n".join(lines) + "\n"
 
 
@@ -261,10 +284,13 @@ def watch(path: str, interval: float, iterations, no_clear: bool) -> int:
 
 
 def demo_router(iterations: int, n_requests: int, interval: float,
-                no_clear: bool, replicas: int, seed: int = 0) -> int:
+                no_clear: bool, replicas: int, seed: int = 0,
+                disagg: bool = False) -> int:
     """Multi-replica demo: a prefix-affinity ``ReplicaRouter`` over N
     tiny engines under a seeded shared-prefix load, rendered as the
-    fleet dashboard between step batches."""
+    fleet dashboard between step batches. ``disagg=True`` splits the
+    fleet into prefill/decode pools (half each, at least one of both)
+    and renders the pool panels + hand-off economics."""
     import numpy as np
 
     import paddle_tpu as paddle
@@ -277,9 +303,18 @@ def demo_router(iterations: int, n_requests: int, interval: float,
                            heads=4, kv_heads=2, seq=128)
     cfg.use_flash_attention = False
     model = LlamaForCausalLM(cfg)
-    engines = [ServingEngine(model, EngineConfig(
-        max_seqs=4, token_budget=24, block_size=8))
-        for _ in range(replicas)]
+    if disagg:
+        n_pre = max(replicas // 2, 1)
+        engines = [ServingEngine(model, EngineConfig(
+            max_seqs=4, token_budget=24, block_size=8, role="prefill"))
+            for _ in range(n_pre)]
+        engines += [ServingEngine(model, EngineConfig(
+            max_seqs=4, token_budget=8, block_size=8, role="decode"))
+            for _ in range(max(replicas - n_pre, 1))]
+    else:
+        engines = [ServingEngine(model, EngineConfig(
+            max_seqs=4, token_budget=24, block_size=8))
+            for _ in range(replicas)]
     router = ReplicaRouter(engines, policy="affinity", seed=seed)
     rng = np.random.default_rng(seed)
     prefixes = [rng.integers(1, 128, (16,)).tolist()
@@ -390,6 +425,10 @@ def main(argv=None) -> int:
                     help="demo-mode replica count (> 1 drives a "
                          "prefix-affinity ReplicaRouter and renders the "
                          "fleet dashboard)")
+    ap.add_argument("--disagg", action="store_true",
+                    help="demo mode: split the replicas into prefill/"
+                         "decode pools (KV-page hand-off) and render "
+                         "the pool panels")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--no-clear", action="store_true",
                     help="append frames instead of clearing the screen "
@@ -397,10 +436,10 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     if args.demo:
         iters = args.iterations if args.iterations is not None else 10 ** 9
-        if args.replicas > 1:
+        if args.replicas > 1 or args.disagg:
             return demo_router(iters, args.requests, args.interval,
-                               args.no_clear, args.replicas,
-                               seed=args.seed)
+                               args.no_clear, max(args.replicas, 2),
+                               seed=args.seed, disagg=args.disagg)
         return demo(iters, args.requests, args.interval,
                     args.no_clear, seed=args.seed)
     return watch(args.watch, args.interval, args.iterations, args.no_clear)
