@@ -80,6 +80,11 @@ Catalog (names are a stable API — see README "Observability"):
   serve_kv_handoff_pages_total           KV pages moved prefill->decode across the pool boundary
   serve_disagg_handoffs_total{outcome}   disaggregated hand-offs by outcome (pages|recompute|failed)
   serve_role_queue_depth{role}           waiting requests per engine-pool role (prefill|decode)
+  serve_router_dispatch_seconds          route decision -> replica placement wall time
+  fleet_slo_attainment                   finished-weighted fleet SLO attainment roll-up (0..1)
+  fleet_pressure_ratio{role}             per-role demand / capacity from the fleet signal bus
+  fleet_replica_signal{name,replica}     sampled per-replica fleet-bus signals (queue_depth|tok_per_s)
+  fleet_flight_dumps_total{trigger}      correlated fleet flight dumps by latch reason
 """
 from __future__ import annotations
 
@@ -163,6 +168,11 @@ CATALOG = (
     "serve_kv_handoff_pages_total",
     "serve_disagg_handoffs_total",
     "serve_role_queue_depth",
+    "serve_router_dispatch_seconds",
+    "fleet_slo_attainment",
+    "fleet_pressure_ratio",
+    "fleet_replica_signal",
+    "fleet_flight_dumps_total",
 )
 
 _enabled = _m._ENABLED  # bind the cell once: hot-path guard is _enabled[0]
@@ -729,6 +739,55 @@ def record_role_queue_depth(role: str, depth: int) -> None:
                  "waiting requests per engine-pool role "
                  "(prefill|decode)",
                  labelnames=("role",)).labels(role=role).set(float(depth))
+
+
+def record_router_dispatch(seconds: float) -> None:
+    """Wall time of one router dispatch: route decision through replica
+    placement (including any backpressure fail-over hops)."""
+    if not _enabled[0]:
+        return
+    _reg().histogram("serve_router_dispatch_seconds",
+                     "route decision -> replica placement wall time",
+                     buckets=_TIME_BUCKETS).observe(seconds)
+
+
+def record_fleet_slo_attainment(value: float) -> None:
+    """The fleet signal bus's finished-weighted SLO attainment roll-up
+    (replicas with no tracked finishes carry zero weight)."""
+    if not _enabled[0]:
+        return
+    _reg().gauge("fleet_slo_attainment",
+                 "finished-weighted fleet SLO attainment roll-up") \
+        .set(float(value))
+
+
+def record_fleet_pressure(role: str, value: float) -> None:
+    """One role pool's pressure (demand / capacity) from the bus."""
+    if not _enabled[0]:
+        return
+    _reg().gauge("fleet_pressure_ratio",
+                 "per-role demand / capacity from the fleet signal bus",
+                 labelnames=("role",)).labels(role=role).set(float(value))
+
+
+def record_fleet_replica_signal(name: str, replica: int,
+                                value: float) -> None:
+    """One sampled per-replica signal from the fleet bus ring."""
+    if not _enabled[0]:
+        return
+    _reg().gauge("fleet_replica_signal",
+                 "sampled per-replica fleet-bus signals",
+                 labelnames=("name", "replica")) \
+        .labels(name=name, replica=str(replica)).set(float(value))
+
+
+def record_fleet_flight_dump(trigger: str) -> None:
+    """One correlated fleet flight dump latched (by reason)."""
+    if not _enabled[0]:
+        return
+    _reg().counter("fleet_flight_dumps_total",
+                   "correlated fleet flight dumps by latch reason",
+                   labelnames=("trigger",)).labels(trigger=trigger).inc()
 
 
 def record_serve_tokens(n: int, step_seconds: float) -> None:

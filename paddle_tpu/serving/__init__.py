@@ -96,12 +96,36 @@ decode survivor; nothing parks:
 Benchmark with ``python tools/bench_serve.py --disagg``; drill prefill
 death with ``python tools/chaos_drill.py --disagg``; watch the pools
 with ``python tools/serve_top.py --demo --disagg --replicas 4``.
+
+Fleet observability (``serving.fleet_obs``): the third observability
+plane (training → engine → fleet). ``ReplicaRouter(fleet_obs=True |
+FleetObsConfig)`` arms a ``FleetObserver`` that (a) rings a bounded,
+time-aligned window of per-replica signals every ``step_all`` pass and
+derives fleet signals — prefill:decode pressure ratio,
+finished-weighted SLO attainment roll-up, ``mem_report``-priced
+headroom — behind a stable ``signals()`` schema streamed atomically to
+``PADDLE_FLEET_TELEMETRY``; (b) adds router-side spans (route decision,
+hand-off dispatch/defer, failover) to the lifecycle trace that rides
+each request, and exports one fleet chrome trace
+(router→prefill→kv_handoff→decode per request, plus per-replica engine
+tracks) on the shared clock anchor; (c) snapshots EVERY peer's signal
+window into one correlated ``fleet_flight_<reason>.json`` when any
+replica's flight trigger latches or a replica dies — latched once per
+reason, never raising into ``step_all``:
+
+    router = ReplicaRouter(fleet, fleet_obs=FleetObsConfig(window=64))
+    sig = router.signals()              # the item-2(c) autoscaler feed
+    router.export_chrome_trace("fleet_trace.json")
+
+Drill it with ``python tools/chaos_drill.py --fleet-obs``; watch with
+``python tools/serve_top.py --demo --fleet``.
 """
 from .engine import (EngineConfig, EnginePredictor, ServingEngine,
                      engine_from_config)
 from .kv_pool import KVBlockPool, PoolExhausted, prefix_chain_keys
 from .router import ReplicaRouter
 from .obs import ObsConfig, RequestTrace, ServingObserver, resolve_observer
+from .fleet_obs import FleetObsConfig, FleetObserver, resolve_fleet_obs
 from .ragged import ragged_paged_attention
 from .resilience import (AdmissionRejected, RequestFailed, ResilienceConfig,
                          StepFault, load_manifest, replay_manifest,
@@ -118,6 +142,7 @@ __all__ = [
     "Drafter", "NgramDrafter", "DraftModelDrafter", "make_drafter",
     "verify_greedy",
     "ObsConfig", "RequestTrace", "ServingObserver", "resolve_observer",
+    "FleetObsConfig", "FleetObserver", "resolve_fleet_obs",
     "ResilienceConfig", "resolve_resilience", "AdmissionRejected",
     "RequestFailed", "StepFault", "load_manifest", "replay_manifest",
     "serve_until_preempted",

@@ -1414,6 +1414,56 @@ class ServingEngine:
                 return self.obs.telemetry(base)
             return base
 
+    def signals(self) -> dict:
+        """One replica's row on the fleet signal bus — the cheap flat
+        subset of ``telemetry()`` the ``FleetObserver`` rings every
+        ``step_all`` pass (no sketches, no nested spec/mem blocks).
+        SLO fields are None when the per-engine obs plane is disarmed:
+        the fleet roll-up weights such replicas at zero rather than
+        inventing vacuous attainment."""
+        with self._lock:
+            s = self.pool.stats
+            depth = self.sched.queue_depth()
+            wait = self._predicted_wait(depth)
+            queries = s["prefix_queries"]
+            sig = {
+                "role": self.role,
+                "steps": self.steps,
+                "tokens_generated": self.tokens_generated,
+                "queue_depth": depth,
+                "running": len(self.sched.running),
+                "kv_used": self.pool.used_blocks(),
+                "kv_size": self.pool.num_blocks,
+                "kv_utilization": round(self.pool.utilization(), 4),
+                "kv_bytes": self.pool.used_blocks() * self.page_bytes,
+                "prefix_queries": queries,
+                "prefix_hits": s["prefix_hits"],
+                "prefix_hit_rate": round(s["prefix_hits"] / queries, 4)
+                if queries else 0.0,
+                "handoff_out": self.kv_handoffs_out,
+                "handoff_in": self.kv_handoffs_in,
+                "handoff_pages": self.kv_handoff_pages,
+                "predicted_wait_s": round(wait, 6)
+                if wait is not None else None,
+            }
+            obs = self.obs
+        if obs is not None:
+            with obs._lock:
+                slo = obs.slo
+                tracked = slo["tracked"]
+                sig.update(
+                    finished=obs.counters["finished"],
+                    slo_tracked=tracked, slo_met=slo["met"],
+                    slo_attainment=round(slo["met"] / tracked, 6)
+                    if tracked else None,
+                    goodput_tokens=slo["goodput_tokens"],
+                    total_tokens=slo["total_tokens"])
+        else:
+            sig.update(finished=None, slo_tracked=None, slo_met=None,
+                       slo_attainment=None, goodput_tokens=None,
+                       total_tokens=None)
+        return sig
+
     def dump_flight_record(self, path: Optional[str] = None,
                            reason: str = "manual") -> Optional[dict]:
         """Dump the flight recorder (last N step-plan records + last M

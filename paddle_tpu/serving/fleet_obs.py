@@ -1,0 +1,627 @@
+"""Fleet observability plane: cross-replica tracing, signal bus, flight dumps.
+
+PR 1 built the training observability plane and PR 9 the per-engine
+serving plane; since the serving tier became a FLEET (N replicas split
+into prefill/decode pools behind a ``ReplicaRouter``) the only
+cross-replica visibility was a counters dict in ``router.telemetry()``.
+This module is the third and final plane — three legs sharing one
+``FleetObserver`` object armed via ``ReplicaRouter(fleet_obs=)``:
+
+  * **Cross-replica request tracing** — the ``RequestTrace`` object
+    already rides a request across the prefill→decode hand-off boundary;
+    the router now records its own spans onto it (``router_route`` with
+    the deciding policy + affinity depth + failover count,
+    ``router_handoff`` dispatch/defer/retry outcomes,
+    ``router_failover`` on death/drain replays), and
+    ``FleetObserver.export_chrome_trace()`` merges per-replica engine
+    step tracks with per-request tracks spanning
+    router→prefill→``kv_handoff``→decode — all carrying the PR 1
+    ``paddle_tpu.clock_anchor`` instant, so ``tools/trace_merge.py``
+    overlays fleet traces with training traces on the shared wall clock.
+
+  * **Fleet signal bus** — ``step_all()`` samples every replica into a
+    bounded, time-aligned ring of per-replica signals (role, queue
+    depth, running seqs, tok/s, goodput, SLO attainment, KV-pool
+    utilization/bytes, prefix-hit rate, hand-off counters,
+    ``_predicted_wait``) plus derived fleet signals: the
+    prefill:decode PRESSURE RATIO (per-role demand over capacity), the
+    finished-request-WEIGHTED fleet SLO attainment roll-up (an idle
+    prefill pool's vacuous per-replica 1.0s must not dilute the decode
+    pool's real attainment — the naive mean does exactly that), and
+    capacity HEADROOM priced via ``tools/mem_report.plan(role=)``.
+    ``signals()`` is a documented stable schema (version-tagged,
+    JSON-roundtrip-pinned) streamed atomically to
+    ``PADDLE_FLEET_TELEMETRY`` — the exact input contract the ROADMAP
+    item-2(c) autoscaler consumes.
+
+  * **Correlated fleet flight recorder** — when any replica's PR 9
+    flight trigger latches (or on replica death / decommission), the
+    router snapshots EVERY peer's last-N signal window and step records
+    into one ``fleet_flight_<reason>.json`` naming the originating
+    replica — "what was the rest of the fleet doing when replica 2
+    wedged" is one artifact. Latched once per reason; the whole dump
+    path never raises into ``step_all``.
+
+Gate discipline (PRs 1/9/11): DISARMED by default — the router holds
+``fleet_obs=None`` and every instrumented seam costs one ``is None``
+check (microbench-pinned). Arm with ``ReplicaRouter(fleet_obs=True |
+FleetObsConfig(...))`` or the ``PADDLE_FLEET_OBS`` /
+``PADDLE_FLEET_TELEMETRY`` / ``PADDLE_FLEET_FLIGHT`` envs.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from ..profiler import instrument as _instr
+from .obs import _atomic_json
+
+logger = logging.getLogger(__name__)
+
+ENV_FLEET_OBS = "PADDLE_FLEET_OBS"
+ENV_FLEET_TELEMETRY = "PADDLE_FLEET_TELEMETRY"
+ENV_FLEET_FLIGHT = "PADDLE_FLEET_FLIGHT"
+
+_TRUTHY = ("1", "true", "on", "yes")
+
+#: ``signals()`` schema version — the item-2(c) autoscaler contract.
+#: Bump ONLY with a README schema-table update; consumers pin this.
+SIGNALS_SCHEMA_VERSION = 1
+
+#: per-replica signal names guaranteed present in every ring entry /
+#: ``signals()`` replica row (None where the source is disarmed or has
+#: no evidence yet — e.g. SLO counts without a per-engine observer,
+#: ``predicted_wait_s`` before the first finished request).
+REPLICA_SIGNALS = (
+    "replica", "role", "alive", "t_mono_s", "pass",
+    "steps", "tokens_generated", "tok_per_s",
+    "queue_depth", "running",
+    "kv_used", "kv_size", "kv_utilization", "kv_bytes",
+    "prefix_queries", "prefix_hits", "prefix_hit_rate",
+    "handoff_out", "handoff_in", "handoff_pages",
+    "predicted_wait_s",
+    "finished", "slo_tracked", "slo_met", "slo_attainment",
+    "goodput_tokens", "total_tokens",
+)
+
+#: the sparkline-worthy subset serve_top renders from the ring window
+WINDOW_SIGNALS = ("queue_depth", "running", "tok_per_s",
+                  "kv_utilization")
+
+
+class FleetObsConfig:
+    """Knobs for one router's fleet observability plane.
+
+    ``window`` bounds the per-replica signal ring (last-N samples);
+    ``sample_every`` samples each k-th ``step_all`` pass;
+    ``telemetry_path`` / ``telemetry_every`` stream the ``signals()``
+    snapshot atomically (default: the ``PADDLE_FLEET_TELEMETRY`` env);
+    ``dump_dir`` is where correlated ``fleet_flight_<reason>.json``
+    dumps land (default: ``PADDLE_FLEET_FLIGHT``; unset keeps dumps
+    in-memory only); ``model_cfg`` + ``hbm_gib`` arm the capacity
+    headroom pricing (``tools/mem_report.plan(role=)``) — both unset
+    leaves ``headroom: None`` in the derived signals."""
+
+    def __init__(self, window: int = 64, sample_every: int = 1,
+                 telemetry_path: Optional[str] = None,
+                 telemetry_every: int = 8,
+                 dump_dir: Optional[str] = None,
+                 model_cfg: Optional[dict] = None,
+                 hbm_gib: Optional[float] = None):
+        if window < 1:
+            raise ValueError(f"window needs >= 1 slot, got {window}")
+        if sample_every < 1 or telemetry_every < 1:
+            raise ValueError(
+                f"sample_every/telemetry_every must be >= 1, got "
+                f"{sample_every}/{telemetry_every}")
+        self.window = int(window)
+        self.sample_every = int(sample_every)
+        self.telemetry_path = telemetry_path
+        self.telemetry_every = int(telemetry_every)
+        self.dump_dir = dump_dir
+        self.model_cfg = model_cfg
+        self.hbm_gib = hbm_gib
+
+
+class FleetObserver:
+    """The armed fleet observability plane for one ``ReplicaRouter``.
+
+    ``on_step_all`` is called by the router driver thread at the end of
+    every ``step_all`` pass; the observer's RLock protects the rings
+    against concurrent ``signals()`` / ``export_chrome_trace()``
+    readers (lock order router -> observer is never reversed). Every
+    externally-reachable path is fenced: nothing here may raise into
+    the driver."""
+
+    def __init__(self, config: Optional[FleetObsConfig] = None):
+        cfg = config or FleetObsConfig()
+        self.config = cfg
+        self.armed = True
+        self._lock = threading.RLock()
+        # one (monotonic, wall) instant pair: every exported timestamp
+        # derives from it (no jumpable clocks on the dump path)
+        self._anchor_mono = time.monotonic()
+        self._anchor_wall = time.time()
+        self._pid = os.getpid()
+        self.passes = 0                     # step_all passes observed
+        self.samples = 0                    # sampled passes
+        self._rings: Dict[int, "deque[dict]"] = {}
+        self._seen_flight_dumps: Dict[int, int] = {}
+        self._latched: set = set()
+        self.dumps: List[dict] = []
+        self.dump_failures = 0
+        self.telemetry_path = cfg.telemetry_path \
+            if cfg.telemetry_path is not None \
+            else (os.environ.get(ENV_FLEET_TELEMETRY, "").strip() or None)
+        self.dump_dir = cfg.dump_dir if cfg.dump_dir is not None \
+            else (os.environ.get(ENV_FLEET_FLIGHT, "").strip() or None)
+        self._headroom_cache: Optional[dict] = None
+
+    # -- clock ----------------------------------------------------------------
+    def _wall(self, mono: float) -> float:
+        return self._anchor_wall + (mono - self._anchor_mono)
+
+    # -- sampling (router driver thread) --------------------------------------
+    def on_step_all(self, router) -> None:
+        """One ``step_all`` pass ended: sample the fleet every
+        ``sample_every`` passes, promote any newly-latched per-replica
+        flight dump into a correlated fleet dump, and stream the
+        telemetry file every ``telemetry_every`` samples. NEVER raises
+        into the driver."""
+        try:
+            with self._lock:
+                self.passes += 1
+                if self.passes % self.config.sample_every:
+                    return
+                self.samples += 1
+                self._sample_locked(router)
+                self._check_replica_flights(router)
+                stream = (self.telemetry_path and
+                          self.samples % self.config.telemetry_every == 0)
+            if stream:
+                self.write_telemetry(router)
+        except Exception:  # noqa: BLE001 — observability must not wound
+            logger.warning("fleet_obs: sample pass failed", exc_info=True)
+
+    def _sample_locked(self, router) -> None:
+        now = time.monotonic()
+        for idx, eng in enumerate(router.replicas):
+            ring = self._rings.setdefault(
+                idx, deque(maxlen=self.config.window))
+            sig = eng.signals()
+            sig["replica"] = idx
+            sig["alive"] = bool(router._alive[idx])
+            sig["t_mono_s"] = round(now, 6)
+            sig["pass"] = self.passes
+            prev = ring[-1] if ring else None
+            if prev is not None and now > prev["t_mono_s"]:
+                sig["tok_per_s"] = round(
+                    (sig["tokens_generated"] - prev["tokens_generated"])
+                    / (now - prev["t_mono_s"]), 2)
+            else:
+                sig["tok_per_s"] = 0.0
+            ring.append(sig)
+            _instr.record_fleet_replica_signal(
+                "queue_depth", idx, sig["queue_depth"])
+            _instr.record_fleet_replica_signal(
+                "tok_per_s", idx, sig["tok_per_s"])
+        derived = self._derived_locked(router)
+        _instr.record_fleet_slo_attainment(
+            derived["slo"]["attainment"])
+        for role, p in derived["pressure"]["per_role"].items():
+            _instr.record_fleet_pressure(role, p["pressure"])
+
+    def _check_replica_flights(self, router) -> None:
+        """Promote a replica's newly-latched PR 9 flight dump into one
+        correlated fleet dump (latched per fleet reason)."""
+        for idx, eng in enumerate(router.replicas):
+            obs = getattr(eng, "obs", None)
+            if obs is None:
+                continue
+            seen = self._seen_flight_dumps.get(idx, 0)
+            new = obs.dumps[seen:]
+            if new:
+                self._seen_flight_dumps[idx] = len(obs.dumps)
+                for d in new:
+                    self.dump(router, reason=d.get("reason", "flight"),
+                              origin=idx,
+                              detail={"replica_dump": dict(d)})
+
+    # -- derived fleet signals ------------------------------------------------
+    def _derived_locked(self, router) -> Dict[str, Any]:
+        latest = [self._rings[i][-1] for i in sorted(self._rings)
+                  if self._rings[i]]
+        alive = [s for s in latest if s["alive"]]
+        # per-role pressure: demand (waiting + running) over capacity
+        # (alive replicas x max_seqs) — the load signal the item-2(c)
+        # autoscaler scales pools by
+        per_role: Dict[str, dict] = {}
+        for s in alive:
+            role = s["role"] or "unified"
+            r = per_role.setdefault(role, {"demand": 0, "capacity": 0,
+                                           "replicas": 0})
+            r["demand"] += s["queue_depth"] + s["running"]
+            r["capacity"] += \
+                router.replicas[s["replica"]].config.max_seqs
+            r["replicas"] += 1
+        for r in per_role.values():
+            r["pressure"] = round(r["demand"] / max(r["capacity"], 1), 4)
+        pre = per_role.get("prefill", {}).get("pressure", 0.0)
+        dec = per_role.get("decode", {}).get("pressure", 0.0)
+        pressure = {
+            "per_role": per_role,
+            # prefill:decode pressure ratio — >1 means the prefill pool
+            # is the bottleneck (scale it out), <1 the decode pool
+            "prefill_decode_ratio": round(pre / dec, 4) if dec else
+            (round(pre, 4) if pre else None),
+        }
+        # fleet SLO roll-up WEIGHTED by per-replica finished/tracked
+        # COUNTS (the PR 15 double-count-free observer sums): a naive
+        # mean of per-replica attainments lets an idle prefill pool's
+        # vacuous 1.0s dilute the decode pool's real number — replicas
+        # with no tracked finishes must carry zero weight
+        tracked = met = goodput = total = 0
+        for s in latest:
+            if s["slo_tracked"] is None:
+                continue
+            tracked += s["slo_tracked"]
+            met += s["slo_met"]
+            goodput += s["goodput_tokens"]
+            total += s["total_tokens"]
+        slo = {
+            "tracked": tracked, "met": met,
+            "attainment": round(met / tracked, 6) if tracked else 1.0,
+            "goodput_tokens": goodput, "total_tokens": total,
+            "goodput_fraction": round(goodput / total, 6)
+            if total else 1.0,
+        }
+        fleet = {
+            "replicas": len(latest),
+            "alive": len(alive),
+            "queue_depth": sum(s["queue_depth"] for s in alive),
+            "running": sum(s["running"] for s in alive),
+            "tok_per_s": round(sum(s["tok_per_s"] for s in alive), 2),
+            "kv_used": sum(s["kv_used"] for s in alive),
+            "kv_size": sum(s["kv_size"] for s in alive),
+        }
+        return {"pressure": pressure, "slo": slo, "fleet": fleet,
+                "headroom": self._headroom(router)}
+
+    def _headroom(self, router) -> Optional[dict]:
+        """Capacity headroom priced through ``tools/mem_report.plan``:
+        per-chip bytes of one replica of each role against the HBM
+        budget — how many MORE replicas of each role one chip's worth
+        of headroom buys is the autoscaler's admission price. Needs
+        ``model_cfg`` (+ ``hbm_gib``); None (and never an exception)
+        without them."""
+        cfg = self.config
+        if not cfg.model_cfg or cfg.hbm_gib is None:
+            return None
+        if self._headroom_cache is not None:
+            return self._headroom_cache
+        try:
+            import sys
+            tools = os.path.join(os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))), "tools")
+            if tools not in sys.path:
+                sys.path.insert(0, tools)
+            import mem_report
+            out = {"hbm_gib": cfg.hbm_gib, "per_role": {}}
+            roles = {getattr(e, "role", None) for e in router.replicas}
+            for role in roles:
+                eng = next(e for e in router.replicas
+                           if getattr(e, "role", None) == role)
+                plan = mem_report.plan(
+                    cfg.model_cfg, mode="serve", role=role,
+                    block_size=eng.pool.block_size,
+                    num_blocks=eng.pool.num_blocks,
+                    max_seqs=eng.config.max_seqs,
+                    hbm_gib=cfg.hbm_gib)
+                out["per_role"][role or "unified"] = {
+                    "per_chip_bytes": plan["per_chip_bytes"],
+                    "headroom_bytes": plan["headroom_bytes"],
+                    "fits": plan["fits"],
+                }
+            self._headroom_cache = out
+            return out
+        except Exception:  # noqa: BLE001 — pricing is advisory
+            logger.warning("fleet_obs: headroom pricing failed",
+                           exc_info=True)
+            self._headroom_cache = None
+            return None
+
+    # -- the stable signals() schema ------------------------------------------
+    def signals(self, router) -> Dict[str, Any]:
+        """The fleet signal snapshot — the documented, version-tagged
+        schema the item-2(c) autoscaler (and ``serve_top --watch``)
+        consumes. JSON-serializable by construction (test-pinned
+        roundtrip). Keys:
+
+          version, schema   SIGNALS_SCHEMA_VERSION, "fleet_signals"
+          unix_time         wall-clock seconds of the snapshot
+          passes, samples   step_all passes seen / sampled
+          window            ring capacity (last-N samples kept)
+          replicas          one row per replica: REPLICA_SIGNALS plus
+                            ``window``: {signal: [last-N values]} for
+                            each WINDOW_SIGNALS sparkline series
+          fleet             derived: pressure (per-role + the
+                            prefill:decode ratio), slo (finished-
+                            weighted roll-up), headroom (mem_report
+                            pricing or None), aggregate queue/run/tok
+          dumps             correlated fleet flight dumps so far
+        """
+        with self._lock:
+            reps = []
+            for idx in sorted(self._rings):
+                ring = list(self._rings[idx])
+                if not ring:
+                    continue
+                row = dict(ring[-1])
+                row["window"] = {name: [s[name] for s in ring]
+                                 for name in WINDOW_SIGNALS}
+                reps.append(row)
+            derived = self._derived_locked(router)
+            return {
+                "version": SIGNALS_SCHEMA_VERSION,
+                "schema": "fleet_signals",
+                "unix_time": round(self._wall(time.monotonic()), 6),
+                "passes": self.passes,
+                "samples": self.samples,
+                "window": self.config.window,
+                "replicas": reps,
+                "fleet": derived,
+                "dumps": [dict(d, record=None) if "record" in d
+                          else dict(d) for d in self.dumps],
+            }
+
+    def write_telemetry(self, router,
+                        path: Optional[str] = None) -> bool:
+        """Atomically stream ``signals()`` for ``serve_top --watch``.
+        Never raises: telemetry is advisory."""
+        target = path if path is not None else self.telemetry_path
+        if not target:
+            return False
+        try:
+            _atomic_json(target, self.signals(router), indent=1)
+            return True
+        except Exception:  # noqa: BLE001 — advisory path
+            logger.warning("fleet_obs: could not write telemetry %s",
+                           target, exc_info=True)
+            return False
+
+    # -- correlated fleet flight recorder -------------------------------------
+    def on_replica_event(self, router, idx: int, reason: str) -> None:
+        """Router-side trigger: replica ``idx`` died or was
+        decommissioned — snapshot the whole fleet. Never raises."""
+        self.dump(router, reason=reason, origin=idx)
+
+    def dump(self, router, reason: str, origin: Optional[int] = None,
+             detail: Optional[dict] = None) -> Optional[dict]:
+        """Write one correlated ``fleet_flight_<reason>.json``: every
+        peer's last-N signal window + flight-ring step records, naming
+        the originating replica. Latched ONCE per reason (a dump storm
+        is not a postmortem); NEVER raises — the path rides inside
+        ``step_all``."""
+        try:
+            with self._lock:
+                if reason in self._latched:
+                    return None
+                self._latched.add(reason)
+                rec = self._fleet_record(router, reason, origin, detail)
+                target = None
+                if self.dump_dir:
+                    safe = "".join(c if c.isalnum() or c in "-_"
+                                   else "_" for c in reason)
+                    target = os.path.join(self.dump_dir,
+                                          f"fleet_flight_{safe}.json")
+                    _atomic_json(target, rec, indent=1)
+                self.dumps.append({"reason": reason, "origin": origin,
+                                   "unix_time": rec["unix_time"],
+                                   "path": target})
+            _instr.record_fleet_flight_dump(reason)
+            logger.info("fleet_obs: correlated flight dump (%s, "
+                        "origin=r%s)%s", reason, origin,
+                        f" -> {target}" if target else "")
+            return rec
+        except Exception:  # noqa: BLE001 — dump-on-fault must not raise
+            with self._lock:
+                self.dump_failures += 1
+            logger.warning("fleet_obs: fleet flight dump failed "
+                           "(reason=%s)", reason, exc_info=True)
+            return None
+
+    def _fleet_record(self, router, reason: str, origin: Optional[int],
+                      detail: Optional[dict]) -> Dict[str, Any]:
+        replicas = {}
+        for idx, eng in enumerate(router.replicas):
+            entry: Dict[str, Any] = {
+                "role": getattr(eng, "role", None),
+                "alive": bool(router._alive[idx]),
+                "signals": [dict(s) for s in
+                            self._rings.get(idx, ())],
+            }
+            obs = getattr(eng, "obs", None)
+            if obs is not None:
+                entry["steps"] = list(obs._steps)
+                entry["dumps"] = list(obs.dumps)
+            replicas[str(idx)] = entry
+        with router._lock:
+            rstate = {
+                "policy": router.policy,
+                "alive": list(router._alive),
+                "routed": dict(router.routed),
+                "failovers": dict(router.failovers),
+                "kv_handoffs": dict(router.kv_handoffs),
+                "handoffs": len(router.handoffs),
+            }
+        return {
+            "version": 1,
+            "reason": reason,
+            "origin_replica": origin,
+            "detail": detail,
+            "unix_time": round(self._wall(time.monotonic()), 6),
+            "passes": self.passes,
+            "window": self.config.window,
+            "router": rstate,
+            "replicas": replicas,
+        }
+
+    # -- fleet chrome-trace export --------------------------------------------
+    def export_chrome_trace(self, router, path: Optional[str] = None
+                            ) -> Dict[str, Any]:
+        """One chrome-trace payload for the whole fleet: a pid per
+        replica carrying its engine's flight-ring step spans, plus one
+        ``fleet.requests`` pid with a track per request spanning
+        ``router_dispatch`` → ``prefill`` → ``kv_handoff`` → ``decode``
+        (rebuilt from the lifecycle trace that rode the request across
+        the hand-off boundary). Carries the PR 1
+        ``paddle_tpu.clock_anchor`` instant, so ``tools/trace_merge.py``
+        overlays fleet traces with training traces on real time."""
+        meta: List[dict] = []
+        events: List[dict] = []
+        req_pid = "fleet.requests"
+        meta.append({"name": "process_name", "ph": "M", "pid": req_pid,
+                     "args": {"name": "paddle_tpu fleet requests"}})
+        anchor = {"name": "paddle_tpu.clock_anchor", "ph": "i", "s": "g",
+                  "pid": req_pid, "tid": 0,
+                  "ts": self._anchor_mono * 1e6,
+                  "args": {"unix_time_us": self._anchor_wall * 1e6,
+                           "rank": "fleet"}}
+        # per-replica engine tracks from the flight ring (armed only)
+        for idx, eng in enumerate(router.replicas):
+            obs = getattr(eng, "obs", None)
+            if obs is None:
+                continue
+            pid = f"replica{idx}"
+            role = getattr(eng, "role", None)
+            meta.append({"name": "process_name", "ph": "M", "pid": pid,
+                         "args": {"name": f"paddle_tpu replica {idx}"
+                                  + (f" [{role}]" if role else "")}})
+            with obs._lock:
+                steps = list(obs._steps)
+            for rec in steps:
+                if "t_mono_s" not in rec:
+                    continue
+                events.append({
+                    "name": "engine_step", "cat": "fleet", "ph": "X",
+                    "pid": pid, "tid": 0,
+                    "ts": rec["t_mono_s"] * 1e6,
+                    "dur": max(rec.get("dt_s", 0.0), 0.0) * 1e6,
+                    "args": {"step": rec.get("step"),
+                             "tokens": rec.get("tokens"),
+                             "queue_depth": rec.get("queue_depth")}})
+        # per-request tracks: gather lifecycles from every replica's
+        # observer — a trace rides with its request, so each appears
+        # exactly once (on the replica where it terminally resolved, or
+        # in one live set); tids are assigned serially because rids are
+        # per-engine counters and can collide across replicas
+        lifecycles: List[dict] = []
+        seen_traces = set()
+        for eng in router.replicas:
+            obs = getattr(eng, "obs", None)
+            if obs is None:
+                continue
+            with obs._lock:
+                lifecycles.extend(dict(d) for d in obs._done)
+                for req in obs._live.values():
+                    if req.trace is not None and \
+                            id(req.trace) not in seen_traces:
+                        seen_traces.add(id(req.trace))
+                        lifecycles.append(req.trace.to_dict())
+        for tid, life in enumerate(lifecycles):
+            rid = life.get("rid")
+            evs = life.get("events", [])
+            if not evs:
+                continue
+            times: Dict[str, float] = {}
+            for e in evs:
+                times.setdefault(e["kind"], e["t_s"])
+            t_end = evs[-1]["t_s"]
+            t_route = times.get("router_route", times.get("submit"))
+            t_admit = times.get("admit")
+            t_hand = times.get("kv_handoff")
+            t_land = times.get("handoff_admit")
+            t_first = times.get("first_token")
+            if t_route is None:
+                continue
+            meta.append({"name": "thread_name", "ph": "M",
+                         "pid": req_pid, "tid": tid,
+                         "args": {"name": f"req {rid}"}})
+
+            def span(name, t0, t1, **args):
+                events.append({"name": name, "cat": "fleet", "ph": "X",
+                               "pid": req_pid, "tid": tid,
+                               "ts": t0 * 1e6,
+                               "dur": max(t1 - t0, 0.0) * 1e6,
+                               "args": dict(args, rid=rid)})
+
+            route_ev = next((e for e in evs
+                             if e["kind"] == "router_route"), None)
+            span("router_dispatch", t_route,
+                 t_admit if t_admit is not None else t_end,
+                 **({k: v for k, v in route_ev.items()
+                     if k not in ("t_s", "kind")} if route_ev else {}))
+            if t_admit is not None:
+                pre_end = t_hand if t_hand is not None else (
+                    t_first if t_first is not None else t_end)
+                span("prefill", t_admit, pre_end)
+            if t_hand is not None:
+                span("kv_handoff", t_hand,
+                     t_land if t_land is not None else t_hand,
+                     pages=times.get("kv_handoff") and next(
+                         (e.get("pages") for e in evs
+                          if e["kind"] == "kv_handoff"), None))
+            dec_start = t_first if t_first is not None else t_land
+            if dec_start is not None:
+                span("decode", dec_start, t_end,
+                     tokens=life.get("output_tokens"))
+            for e in evs:
+                if e["kind"] in ("router_route", "router_handoff",
+                                 "router_failover"):
+                    args = {k: v for k, v in e.items()
+                            if k not in ("t_s", "kind")}
+                    events.append({"name": e["kind"], "cat": "fleet",
+                                   "ph": "i", "s": "t", "pid": req_pid,
+                                   "tid": tid, "ts": e["t_s"] * 1e6,
+                                   "args": args})
+        payload = {"traceEvents": meta + [anchor] + events,
+                   "displayTimeUnit": "ms",
+                   "metadata": {"source": "paddle_tpu.serving.fleet_obs"}}
+        if path:
+            _atomic_json(path, payload)
+        return payload
+
+
+def resolve_fleet_obs(spec) -> Optional[FleetObserver]:
+    """Normalize ``ReplicaRouter(fleet_obs=)``: an observer passes
+    through, a FleetObsConfig builds one, True arms the defaults, False
+    disarms, and None defers to the env (``PADDLE_FLEET_OBS`` truthy,
+    or a ``PADDLE_FLEET_TELEMETRY`` / ``PADDLE_FLEET_FLIGHT`` path
+    being named, arms)."""
+    if spec is None:
+        env = os.environ
+        if env.get(ENV_FLEET_OBS, "").strip().lower() in _TRUTHY or \
+                env.get(ENV_FLEET_TELEMETRY, "").strip() or \
+                env.get(ENV_FLEET_FLIGHT, "").strip():
+            return FleetObserver()
+        return None
+    if spec is False:
+        return None
+    if spec is True:
+        return FleetObserver()
+    if isinstance(spec, FleetObsConfig):
+        return FleetObserver(spec)
+    if isinstance(spec, FleetObserver):
+        return spec
+    raise TypeError(
+        f"ReplicaRouter.fleet_obs wants None/bool/FleetObsConfig/"
+        f"FleetObserver, got {type(spec).__name__}")
+
+
+__all__ = ["FleetObsConfig", "FleetObserver", "resolve_fleet_obs",
+           "SIGNALS_SCHEMA_VERSION", "REPLICA_SIGNALS", "WINDOW_SIGNALS",
+           "ENV_FLEET_OBS", "ENV_FLEET_TELEMETRY", "ENV_FLEET_FLIGHT"]

@@ -621,7 +621,13 @@ class ServingObserver:
                 span("decode", t_first, t_end, rid=rid,
                      tokens=life.get("output_tokens"))
             for e in evs:
-                if e["kind"] in ("prefill", "preempt", "spec_verify"):
+                # router_* kinds are the PR 16 fleet-plane spans — a
+                # single-engine export still shows where the router
+                # placed / handed off / failed over this request
+                if e["kind"] in ("prefill", "preempt", "spec_verify",
+                                 "router_route", "router_handoff",
+                                 "router_handoff_defer",
+                                 "router_failover"):
                     args = {k: v for k, v in e.items()
                             if k not in ("t_s", "kind")}
                     events.append({"name": e["kind"], "cat": "serving",

@@ -66,6 +66,7 @@ from typing import Dict, List, Optional, Sequence
 from ..profiler import instrument as _instr
 from ..resilience import chaos
 from . import resilience as _res
+from .fleet_obs import resolve_fleet_obs
 from .kv_pool import PoolExhausted, prefix_chain_keys
 
 _POLICIES = ("affinity", "least_loaded", "random", "round_robin")
@@ -81,7 +82,7 @@ class ReplicaRouter:
 
     def __init__(self, engines: Sequence, policy: str = "affinity",
                  seed: int = 0, max_affinity_keys: int = 4096,
-                 failover: bool = True):
+                 failover: bool = True, fleet_obs=None):
         import numpy as np
         if not engines:
             raise ValueError("ReplicaRouter needs at least one engine")
@@ -160,6 +161,11 @@ class ReplicaRouter:
         self._handoff_complete = [threading.Event()
                                   for _ in self.replicas]
         self._lock = threading.RLock()
+        # fleet observability plane (serving/fleet_obs.py): disarmed =
+        # None, every armed-only seam below is one `is None` check. Its
+        # lock is only ever taken FIRST (fleet -> router/engine/obs) —
+        # no router/engine path takes it while holding their locks
+        self.fleet_obs = resolve_fleet_obs(fleet_obs)
 
     # -- placement ------------------------------------------------------------
     def _routable(self, exclude: Optional[int] = None,
@@ -250,6 +256,7 @@ class ReplicaRouter:
         candidate; only when every routable replica refused does the
         LAST refusal re-raise — the fleet's typed overload signal."""
         keys = prefix_chain_keys(prompt, self.block_size)
+        t_route = time.monotonic()
         with self._lock:
             order, why, depth = self._route(keys)
         last_err = None
@@ -315,6 +322,17 @@ class ReplicaRouter:
                 if hit:
                     self.affinity_hits += 1
             _instr.record_router_routed(decided, affinity_hit=hit)
+            # router-side span onto the lifecycle trace that rides the
+            # request (present only when the replica's obs plane is on):
+            # the route DECISION instant, the deciding policy, how deep
+            # the affinity key matched, and how many candidates refused
+            # before placement
+            tr = getattr(req, "trace", None)
+            if tr is not None:
+                tr.add("router_route", t_route, policy=decided,
+                       affinity_depth=depth if hit else 0, replica=idx,
+                       failovers=n_try)
+            _instr.record_router_dispatch(time.monotonic() - t_route)
             return req
         raise last_err if last_err is not None else \
             _res.AdmissionRejected("no_replica", queue_depth=0)
@@ -347,6 +365,10 @@ class ReplicaRouter:
                     if not retry:       # count requests, not retries
                         self.kv_handoffs["deferred"] += 1
                     self._pending_handoffs.append((src_idx, req, record))
+                    tr = getattr(req, "trace", None)
+                    if tr is not None:
+                        tr.add("router_handoff_defer", time.monotonic(),
+                               first=not retry)
                     return
                 cands = roomy
             else:
@@ -370,6 +392,10 @@ class ReplicaRouter:
             # the client's result()/stream() resolves now
             err = _res.RequestFailed(req.rid, reason="handoff_no_replica")
             req.fail(err)
+            tr = getattr(req, "trace", None)
+            if tr is not None:
+                tr.add("router_handoff", time.monotonic(), target=None,
+                       outcome="failed", retry=retry)
             src = self.replicas[src_idx]
             if src.obs is not None:
                 # exactly one terminal lifecycle event, recorded where
@@ -415,6 +441,10 @@ class ReplicaRouter:
                 self._register_into(self._decode_affinity, keys, target)
             died = outcome != "failed" and not self._alive[target]
         _instr.record_disagg_handoff(outcome)
+        tr = getattr(req, "trace", None)
+        if tr is not None:
+            tr.add("router_handoff", time.monotonic(), target=target,
+                   outcome=outcome, retry=retry)
         if died:
             # the decode replica died while the import was landing: wait
             # for its hand-off to finish, then recover whatever the
@@ -473,6 +503,11 @@ class ReplicaRouter:
                 _instr.record_role_queue_depth(
                     role, sum(self.replicas[i].sched.queue_depth()
                               for i in pool if self._alive[i]))
+        if self.fleet_obs is not None:
+            # sample the fleet signal bus + promote any newly-latched
+            # per-replica flight dump into a correlated fleet dump;
+            # internally fenced — nothing can raise into this driver
+            self.fleet_obs.on_step_all(self)
         return self.has_work()
 
     def has_work(self) -> bool:
@@ -508,7 +543,12 @@ class ReplicaRouter:
         if manifest is None:
             manifest = self._salvage_manifest(eng)
         eng.abort_all(cause, reason=f"replica_{reason}")
-        return self._hand_off(manifest, exclude=idx, reason=reason)
+        handles = self._hand_off(manifest, exclude=idx, reason=reason)
+        if self.fleet_obs is not None:
+            # correlated fleet flight dump: every peer's signal window
+            # at the instant this replica died (never raises)
+            self.fleet_obs.on_replica_event(self, idx, reason)
+        return handles
 
     @staticmethod
     def _salvage_manifest(eng) -> dict:
@@ -544,7 +584,10 @@ class ReplicaRouter:
             manifest = self._salvage_manifest(eng)
             reason = "death"
         eng.abort_all(reason=f"replica_{reason}")
-        return self._hand_off(manifest, exclude=idx, reason=reason)
+        handles = self._hand_off(manifest, exclude=idx, reason=reason)
+        if self.fleet_obs is not None:
+            self.fleet_obs.on_replica_event(self, idx, reason)
+        return handles
 
     def _hand_off(self, manifest: dict, exclude: int,
                   reason: str) -> List:
@@ -590,8 +633,14 @@ class ReplicaRouter:
                     target = self._least_loaded(cands)
             sub = dict(manifest)
             sub["requests"] = group
-            handles.extend(_res.replay_manifest(self.replicas[target],
-                                                sub))
+            replayed = _res.replay_manifest(self.replicas[target], sub)
+            for h in replayed:
+                tr = getattr(h, "trace", None)
+                if tr is not None:
+                    tr.add("router_failover", time.monotonic(),
+                           from_replica=exclude, to_replica=target,
+                           reason=reason)
+            handles.extend(replayed)
             record["groups"].append(
                 {"affinity": list(aff) if aff else None,
                  "target": target,
@@ -690,6 +739,25 @@ class ReplicaRouter:
             fleet["slo"] = slo
         return {"router": router, "fleet": fleet, "replicas": reps,
                 "unix_time": time.time()}
+
+    def signals(self) -> dict:
+        """The fleet signal-bus snapshot (``FleetObserver.signals()``
+        schema); needs the plane armed via ``fleet_obs=``."""
+        if self.fleet_obs is None:
+            raise RuntimeError(
+                "fleet signals need the fleet observability plane: "
+                "ReplicaRouter(fleet_obs=True) or PADDLE_FLEET_OBS=1")
+        return self.fleet_obs.signals(self)
+
+    def export_chrome_trace(self, path: Optional[str] = None) -> dict:
+        """Fleet chrome trace: per-replica engine tracks + per-request
+        router→prefill→kv_handoff→decode tracks on the shared clock
+        anchor; needs the plane armed via ``fleet_obs=``."""
+        if self.fleet_obs is None:
+            raise RuntimeError(
+                "a fleet trace needs the fleet observability plane: "
+                "ReplicaRouter(fleet_obs=True) or PADDLE_FLEET_OBS=1")
+        return self.fleet_obs.export_chrome_trace(self, path)
 
 
 __all__ = ["ReplicaRouter"]

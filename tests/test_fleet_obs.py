@@ -1,0 +1,519 @@
+"""Fleet observability plane: tracing, signal bus, correlated dumps.
+
+PR 16's acceptance pins live here:
+
+  * the signal ring keeps EXACTLY the last N samples per replica, and
+    the derived fleet signals (per-role pressure, prefill:decode ratio,
+    finished-WEIGHTED SLO roll-up, capacity headroom) match values
+    computed by hand — including the idle-prefill-pool case where a
+    naive mean of per-replica attainments would report 0.75 while the
+    count-weighted truth is 0.5;
+  * one request's router-side spans land on the lifecycle trace that
+    rides it across the hand-off boundary, in causal order, with
+    exactly ONE terminal event — and the exported fleet chrome trace,
+    pushed through ``tools/trace_merge.py``, carries that request's
+    router_dispatch → prefill → kv_handoff → decode spans on the
+    shared clock anchor;
+  * correlated fleet flight dumps latch once per reason and the whole
+    dump path NEVER raises (unwritable directory included);
+  * ``signals()`` is JSON-roundtrip-stable — the documented item-2(c)
+    autoscaler input contract;
+  * the disarmed plane costs one pointer check: disabled-path record_*
+    helpers stay under the 20µs/call PR 1 budget.
+"""
+import functools
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.profiler import instrument
+from paddle_tpu.serving import (EngineConfig, FleetObsConfig, FleetObserver,
+                                ReplicaRouter, ServingEngine,
+                                resolve_fleet_obs)
+from paddle_tpu.serving.fleet_obs import (ENV_FLEET_FLIGHT, ENV_FLEET_OBS,
+                                          ENV_FLEET_TELEMETRY,
+                                          REPLICA_SIGNALS,
+                                          SIGNALS_SCHEMA_VERSION,
+                                          WINDOW_SIGNALS)
+from paddle_tpu.serving.obs import TERMINAL_EVENT
+
+TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools")
+sys.path.insert(0, TOOLS)
+
+pytestmark = pytest.mark.fleetobs
+
+
+# -- duck-typed fleet: hand-computable signals --------------------------------
+
+class FakeConfig:
+    def __init__(self, max_seqs):
+        self.max_seqs = max_seqs
+
+
+class FakeObs:
+    def __init__(self):
+        self.dumps = []
+        self._steps = []
+
+
+class FakeEngine:
+    """Just enough engine for the FleetObserver: ``signals()`` returns
+    controlled numbers, so every derived fleet signal is checkable by
+    hand."""
+
+    def __init__(self, role=None, max_seqs=4, obs=None, **sig):
+        self.role = role
+        self.config = FakeConfig(max_seqs)
+        self.obs = obs
+        self._sig = sig
+
+    def signals(self):
+        base = {
+            "role": self.role, "steps": 0, "tokens_generated": 0,
+            "queue_depth": 0, "running": 0,
+            "kv_used": 0, "kv_size": 8, "kv_utilization": 0.0,
+            "kv_bytes": 0, "prefix_queries": 0, "prefix_hits": 0,
+            "prefix_hit_rate": 0.0, "handoff_out": 0, "handoff_in": 0,
+            "handoff_pages": 0, "predicted_wait_s": None,
+            "finished": None, "slo_tracked": None, "slo_met": None,
+            "slo_attainment": None, "goodput_tokens": None,
+            "total_tokens": None,
+        }
+        base.update(self._sig)
+        return base
+
+
+class FakeRouter:
+    def __init__(self, engines):
+        self.replicas = list(engines)
+        self._alive = [True] * len(engines)
+        self._lock = threading.RLock()
+        self.policy = "affinity"
+        self.routed = {}
+        self.failovers = {}
+        self.kv_handoffs = {}
+        self.handoffs = []
+
+
+# -- real tiny disaggregated fleet --------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def _model(seed=3, vocab=61):
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    paddle.seed(seed)
+    cfg = LlamaConfig.tiny(vocab_size=vocab, hidden_size=32, layers=2,
+                           heads=4, kv_heads=2, seq=128)
+    cfg.use_flash_attention = False
+    return LlamaForCausalLM(cfg)
+
+
+def _fleet(model, fleet_obs=True, obs=True):
+    engines = [ServingEngine(model, EngineConfig(
+        role="prefill", max_seqs=4, token_budget=24, block_size=8,
+        obs=obs))]
+    engines += [ServingEngine(model, EngineConfig(
+        role="decode", max_seqs=4, token_budget=8, block_size=8,
+        obs=obs)) for _ in range(2)]
+    return ReplicaRouter(engines, policy="affinity", seed=0,
+                         fleet_obs=fleet_obs)
+
+
+def _prompts(n, vocab=61, seed=0, lens=(9, 12, 17, 7)):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, vocab, (lens[i % len(lens)],)).tolist()
+            for i in range(n)]
+
+
+# -- signal ring + derived signals --------------------------------------------
+
+class TestSignalBus:
+    def test_ring_keeps_exactly_last_n(self):
+        fo = FleetObserver(FleetObsConfig(window=3))
+        router = FakeRouter([FakeEngine(), FakeEngine()])
+        for _ in range(5):
+            fo.on_step_all(router)
+        assert fo.passes == 5 and fo.samples == 5
+        for idx in (0, 1):
+            ring = list(fo._rings[idx])
+            assert len(ring) == 3                      # exactly last N
+            assert [s["pass"] for s in ring] == [3, 4, 5]
+
+    def test_sample_every_skips_passes(self):
+        fo = FleetObserver(FleetObsConfig(window=8, sample_every=3))
+        router = FakeRouter([FakeEngine()])
+        for _ in range(7):
+            fo.on_step_all(router)
+        assert fo.samples == 2                         # passes 3 and 6
+        assert [s["pass"] for s in fo._rings[0]] == [3, 6]
+
+    def test_derived_signals_hand_computed(self):
+        """prefill demand 4 over capacity 2 -> pressure 2.0; decode
+        demand 3 over capacity 8 -> 0.375; ratio 2.0/0.375."""
+        fo = FleetObserver(FleetObsConfig(window=4))
+        router = FakeRouter([
+            FakeEngine(role="prefill", max_seqs=2, queue_depth=3,
+                       running=1),
+            FakeEngine(role="decode", max_seqs=4, queue_depth=1,
+                       running=1),
+            FakeEngine(role="decode", max_seqs=4, queue_depth=0,
+                       running=1),
+        ])
+        fo.on_step_all(router)
+        sig = fo.signals(router)
+        pr = sig["fleet"]["pressure"]
+        assert pr["per_role"]["prefill"] == {
+            "demand": 4, "capacity": 2, "replicas": 1, "pressure": 2.0}
+        assert pr["per_role"]["decode"] == {
+            "demand": 3, "capacity": 8, "replicas": 2, "pressure": 0.375}
+        assert pr["prefill_decode_ratio"] == round(2.0 / 0.375, 4)
+        assert sig["fleet"]["fleet"]["queue_depth"] == 4
+        assert sig["fleet"]["fleet"]["running"] == 3
+        assert sig["fleet"]["headroom"] is None        # no model_cfg
+
+    def test_slo_rollup_weights_by_finished_requests(self):
+        """The satellite-5 fix: an idle prefill pool (0 tracked
+        finishes) must carry ZERO weight in the fleet SLO roll-up. The
+        decode replica is at 2/4 = 0.5; a naive mean over per-replica
+        attainments (idle prefill defaulting to a vacuous 1.0) would
+        report 0.75 — the count-weighted truth is 0.5."""
+        fo = FleetObserver(FleetObsConfig(window=4))
+        router = FakeRouter([
+            FakeEngine(role="prefill", finished=0, slo_tracked=0,
+                       slo_met=0, goodput_tokens=0, total_tokens=0),
+            FakeEngine(role="decode", finished=4, slo_tracked=4,
+                       slo_met=2, goodput_tokens=10, total_tokens=20),
+        ])
+        fo.on_step_all(router)
+        slo = fo.signals(router)["fleet"]["slo"]
+        assert slo == {"tracked": 4, "met": 2, "attainment": 0.5,
+                       "goodput_tokens": 10, "total_tokens": 20,
+                       "goodput_fraction": 0.5}
+        naive_mean = (1.0 + 2 / 4) / 2                 # the wrong number
+        assert slo["attainment"] != naive_mean
+
+    def test_dead_replica_leaves_pressure_capacity(self):
+        fo = FleetObserver(FleetObsConfig(window=4))
+        router = FakeRouter([
+            FakeEngine(role="decode", max_seqs=4, queue_depth=2),
+            FakeEngine(role="decode", max_seqs=4, queue_depth=2),
+        ])
+        router._alive[1] = False
+        fo.on_step_all(router)
+        pr = fo.signals(router)["fleet"]["pressure"]["per_role"]
+        assert pr["decode"]["capacity"] == 4           # dead one excluded
+        assert pr["decode"]["replicas"] == 1
+
+    def test_tok_per_s_derives_from_ring_deltas(self):
+        fo = FleetObserver(FleetObsConfig(window=4))
+        eng = FakeEngine(tokens_generated=0)
+        router = FakeRouter([eng])
+        fo.on_step_all(router)
+        eng._sig["tokens_generated"] = 50
+        time.sleep(0.01)
+        fo.on_step_all(router)
+        ring = list(fo._rings[0])
+        assert ring[0]["tok_per_s"] == 0.0             # no prior sample
+        assert ring[1]["tok_per_s"] > 0.0
+
+
+# -- signals() schema ---------------------------------------------------------
+
+class TestSignalsSchema:
+    def test_json_roundtrip_and_shape(self):
+        fo = FleetObserver(FleetObsConfig(window=4))
+        router = FakeRouter([FakeEngine(role="prefill"),
+                             FakeEngine(role="decode")])
+        for _ in range(3):
+            fo.on_step_all(router)
+        sig = fo.signals(router)
+        assert json.loads(json.dumps(sig)) == sig      # roundtrip-stable
+        assert sig["version"] == SIGNALS_SCHEMA_VERSION
+        assert sig["schema"] == "fleet_signals"
+        assert sig["passes"] == 3 and sig["window"] == 4
+        assert len(sig["replicas"]) == 2
+        for row in sig["replicas"]:
+            for name in REPLICA_SIGNALS:
+                assert name in row, f"missing signal {name}"
+            for name in WINDOW_SIGNALS:
+                assert len(row["window"][name]) == 3   # one per sample
+        for key in ("pressure", "slo", "fleet", "headroom"):
+            assert key in sig["fleet"]
+
+    def test_telemetry_file_streams_atomically(self, tmp_path):
+        path = str(tmp_path / "fleet.json")
+        fo = FleetObserver(FleetObsConfig(window=4, telemetry_path=path,
+                                          telemetry_every=2))
+        router = FakeRouter([FakeEngine()])
+        fo.on_step_all(router)
+        assert not os.path.exists(path)                # every 2nd sample
+        fo.on_step_all(router)
+        with open(path) as f:
+            streamed = json.load(f)
+        assert streamed["schema"] == "fleet_signals"
+        assert streamed["samples"] == 2
+        assert not [p for p in os.listdir(str(tmp_path))
+                    if p != "fleet.json"]              # no tmp litter
+
+    def test_unwritable_telemetry_never_raises(self):
+        fo = FleetObserver(FleetObsConfig(
+            window=4, telemetry_path="/nonexistent_dir_xyz/t.json",
+            telemetry_every=1))
+        router = FakeRouter([FakeEngine()])
+        fo.on_step_all(router)                         # must not raise
+        assert fo.samples == 1
+
+
+# -- correlated fleet flight dumps --------------------------------------------
+
+class TestFleetFlightDumps:
+    def test_dump_latches_once_per_reason(self, tmp_path):
+        fo = FleetObserver(FleetObsConfig(window=4,
+                                          dump_dir=str(tmp_path)))
+        router = FakeRouter([FakeEngine(), FakeEngine()])
+        fo.on_step_all(router)
+        rec = fo.dump(router, reason="death", origin=1)
+        assert rec is not None
+        assert fo.dump(router, reason="death", origin=0) is None
+        assert len(fo.dumps) == 1                      # latched
+        assert fo.dump(router, reason="drain", origin=0) is not None
+        assert len(fo.dumps) == 2                      # new reason passes
+        names = sorted(os.listdir(str(tmp_path)))
+        assert names == ["fleet_flight_death.json",
+                         "fleet_flight_drain.json"]
+
+    def test_dump_names_origin_and_snapshots_every_peer(self, tmp_path):
+        fo = FleetObserver(FleetObsConfig(window=4,
+                                          dump_dir=str(tmp_path)))
+        router = FakeRouter([FakeEngine(role="prefill"),
+                             FakeEngine(role="decode", queue_depth=2)])
+        for _ in range(3):
+            fo.on_step_all(router)
+        fo.on_replica_event(router, 0, "death")
+        with open(str(tmp_path / "fleet_flight_death.json")) as f:
+            rec = json.load(f)
+        assert rec["reason"] == "death"
+        assert rec["origin_replica"] == 0              # names the dead one
+        assert set(rec["replicas"]) == {"0", "1"}
+        for peer in rec["replicas"].values():
+            assert len(peer["signals"]) == 3           # last-N window
+        assert rec["replicas"]["1"]["signals"][-1]["queue_depth"] == 2
+        assert rec["router"]["alive"] == [True, True]
+        assert json.loads(json.dumps(rec)) == rec
+
+    def test_unwritable_dump_dir_never_raises(self):
+        fo = FleetObserver(FleetObsConfig(
+            window=4, dump_dir="/nonexistent_dir_xyz/dumps"))
+        router = FakeRouter([FakeEngine()])
+        assert fo.dump(router, reason="death", origin=0) is None
+        assert fo.dump_failures == 1
+        assert fo.dumps == []
+
+    def test_replica_flight_latch_promotes_to_fleet_dump(self, tmp_path):
+        """A per-replica PR 9 flight dump appearing on any engine's
+        observer is promoted into ONE correlated fleet dump naming that
+        replica."""
+        obs0 = FakeObs()
+        fo = FleetObserver(FleetObsConfig(window=4,
+                                          dump_dir=str(tmp_path)))
+        router = FakeRouter([FakeEngine(obs=obs0), FakeEngine()])
+        fo.on_step_all(router)
+        assert fo.dumps == []                          # armed but quiet
+        obs0.dumps.append({"reason": "stall", "unix_time": 1.0})
+        fo.on_step_all(router)
+        assert len(fo.dumps) == 1
+        assert fo.dumps[0]["reason"] == "stall"
+        assert fo.dumps[0]["origin"] == 0
+        fo.on_step_all(router)                         # no re-dump
+        assert len(fo.dumps) == 1
+
+
+# -- router spans + fleet chrome trace ----------------------------------------
+
+class TestFleetTrace:
+    def test_router_spans_causal_and_one_terminal(self):
+        model = _model()
+        router = _fleet(model)
+        reqs = [router.submit(p, max_new_tokens=4)
+                for p in _prompts(4)]
+        router.run_until_idle(max_steps=300)
+        assert all(r.done and r.error is None for r in reqs)
+        assert router.kv_handoffs["pages"] >= 1
+        # find a handed-off lifecycle on a decode replica
+        lives = []
+        for i in router.decode_pool:
+            lives += [d for d in router.replicas[i].obs._done
+                      if any(e["kind"] == "kv_handoff"
+                             for e in d["events"])]
+        assert lives, "no handed-off lifecycle recorded"
+        life = lives[0]
+        evs = sorted(life["events"], key=lambda e: e["t_s"])
+        kinds = [e["kind"] for e in evs]
+        for kind in ("router_route", "admit", "kv_handoff",
+                     "handoff_admit", "router_handoff", TERMINAL_EVENT):
+            assert kind in kinds, f"missing {kind} in {kinds}"
+        # causal order across the three tiers
+        order = [kinds.index("router_route"), kinds.index("admit"),
+                 kinds.index("kv_handoff"), kinds.index("handoff_admit"),
+                 kinds.index(TERMINAL_EVENT)]
+        assert order == sorted(order), kinds
+        assert kinds.count(TERMINAL_EVENT) == 1        # exactly one
+        route = next(e for e in evs if e["kind"] == "router_route")
+        assert route["policy"] in ("affinity", "least_loaded")
+        assert route["replica"] in router.prefill_pool
+        hand = next(e for e in evs if e["kind"] == "router_handoff")
+        assert hand["outcome"] == "pages"
+        assert hand["target"] in router.decode_pool
+
+    def test_merged_fleet_trace_spans_all_tiers(self, tmp_path):
+        """The acceptance pin: one request's spans across router
+        dispatch, a prefill replica, the kv_handoff, and a decode
+        replica survive a trace_merge pass on the shared clock
+        anchor."""
+        import trace_merge
+        model = _model()
+        router = _fleet(model)
+        for p in _prompts(4):
+            router.submit(p, max_new_tokens=4)
+        router.run_until_idle(max_steps=300)
+        fleet_path = str(tmp_path / "fleet_trace.json")
+        router.export_chrome_trace(fleet_path)
+        # overlay with a single replica's own engine-plane export
+        eng_path = str(tmp_path / "replica0_trace.json")
+        router.replicas[0].obs.export_chrome_trace(eng_path)
+        merged = trace_merge.merge_traces([fleet_path, eng_path])
+        events = merged["traceEvents"]
+        anchors = [e for e in events
+                   if e["name"] == trace_merge.CLOCK_ANCHOR_EVENT]
+        assert {a["args"]["rank"] for a in anchors} >= {"fleet", "serve"}
+        # one request track carrying all four fleet-tier spans
+        by_track = {}
+        for e in events:
+            if e.get("ph") == "X" and e.get("cat") == "fleet":
+                by_track.setdefault((e["pid"], e["tid"]),
+                                    set()).add(e["name"])
+        assert any({"router_dispatch", "prefill", "kv_handoff",
+                    "decode"} <= names
+                   for names in by_track.values()), by_track
+        # per-replica engine tracks rode along
+        assert any(e["name"] == "engine_step" for e in events)
+        # merged timestamps are normalized (non-meta events >= 0)
+        assert all(e["ts"] >= 0 for e in events if e.get("ph") != "M")
+
+    def test_directory_argument_expands(self, tmp_path):
+        import trace_merge
+        model = _model()
+        router = _fleet(model)
+        router.submit(_prompts(1)[0], max_new_tokens=2)
+        router.run_until_idle(max_steps=200)
+        router.export_chrome_trace(str(tmp_path / "fleet.json"))
+        out = str(tmp_path / "merged.json")
+        assert trace_merge.main([str(tmp_path), "-o", out]) == 0
+        with open(out) as f:
+            assert json.load(f)["traceEvents"]
+
+    def test_fleet_slo_rollup_idle_prefill_pool_real_fleet(self):
+        """Real-fleet satellite-5 pin: the prefill pool finishes zero
+        requests (every finish lands on decode), so fleet tracked ==
+        decode tracked and the prefill rows carry zero weight."""
+        model = _model()
+        router = _fleet(model)
+        reqs = [router.submit(p, max_new_tokens=4, ttft_deadline=30.0,
+                              tpot_deadline=30.0) for p in _prompts(4)]
+        router.run_until_idle(max_steps=300)
+        assert all(r.done and r.error is None for r in reqs)
+        sig = router.signals()
+        rows = {r["replica"]: r for r in sig["replicas"]}
+        for i in router.prefill_pool:
+            assert rows[i]["slo_tracked"] == 0         # idle pool
+            assert rows[i]["slo_attainment"] is None   # no vacuous 1.0
+        dec_tracked = sum(rows[i]["slo_tracked"]
+                          for i in router.decode_pool)
+        assert dec_tracked == 4
+        assert sig["fleet"]["slo"]["tracked"] == dec_tracked
+        assert sig["fleet"]["slo"]["attainment"] == 1.0
+
+
+# -- arming / disarm discipline -----------------------------------------------
+
+class TestArming:
+    def test_default_disarmed(self, monkeypatch):
+        for env in (ENV_FLEET_OBS, ENV_FLEET_TELEMETRY, ENV_FLEET_FLIGHT):
+            monkeypatch.delenv(env, raising=False)
+        assert resolve_fleet_obs(None) is None
+        assert resolve_fleet_obs(False) is None
+
+    def test_env_arms(self, monkeypatch, tmp_path):
+        for env in (ENV_FLEET_OBS, ENV_FLEET_TELEMETRY, ENV_FLEET_FLIGHT):
+            monkeypatch.delenv(env, raising=False)
+        monkeypatch.setenv(ENV_FLEET_OBS, "1")
+        assert isinstance(resolve_fleet_obs(None), FleetObserver)
+        monkeypatch.delenv(ENV_FLEET_OBS)
+        path = str(tmp_path / "t.json")
+        monkeypatch.setenv(ENV_FLEET_TELEMETRY, path)
+        fo = resolve_fleet_obs(None)
+        assert fo is not None and fo.telemetry_path == path
+        monkeypatch.delenv(ENV_FLEET_TELEMETRY)
+        monkeypatch.setenv(ENV_FLEET_FLIGHT, str(tmp_path))
+        fo = resolve_fleet_obs(None)
+        assert fo is not None and fo.dump_dir == str(tmp_path)
+
+    def test_spec_forms(self):
+        assert isinstance(resolve_fleet_obs(True), FleetObserver)
+        cfg = FleetObsConfig(window=7)
+        assert resolve_fleet_obs(cfg).config.window == 7
+        fo = FleetObserver()
+        assert resolve_fleet_obs(fo) is fo
+        with pytest.raises(TypeError):
+            resolve_fleet_obs(42)
+
+    def test_bad_config_rejected(self):
+        with pytest.raises(ValueError):
+            FleetObsConfig(window=0)
+        with pytest.raises(ValueError):
+            FleetObsConfig(sample_every=0)
+
+    def test_disarmed_router_has_no_plane(self, monkeypatch):
+        for env in (ENV_FLEET_OBS, ENV_FLEET_TELEMETRY, ENV_FLEET_FLIGHT):
+            monkeypatch.delenv(env, raising=False)
+        model = _model()
+        router = _fleet(model, fleet_obs=None, obs=False)
+        assert router.fleet_obs is None
+        with pytest.raises(RuntimeError):
+            router.signals()
+        with pytest.raises(RuntimeError):
+            router.export_chrome_trace()
+
+    def test_disabled_record_paths_under_budget(self):
+        """The PR 1 20µs/call bound on every disabled instrument
+        seam this PR added."""
+        from paddle_tpu.profiler import metrics
+        assert not metrics.metrics_enabled()
+        n = 20_000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            instrument.record_fleet_slo_attainment(1.0)
+            instrument.record_fleet_pressure("decode", 0.5)
+            instrument.record_fleet_replica_signal("queue_depth", 0, 1)
+            instrument.record_fleet_flight_dump("death")
+            instrument.record_router_dispatch(0.001)
+        per_call = (time.perf_counter() - t0) / (n * 5)
+        assert per_call < 20e-6, f"disabled path {per_call:.2e}s/call"
+
+    def test_sample_pass_never_raises_into_driver(self):
+        """A replica whose signals() explodes must not take step_all's
+        caller down — the fenced sample pass swallows it."""
+        class ExplodingEngine(FakeEngine):
+            def signals(self):
+                raise RuntimeError("boom")
+
+        fo = FleetObserver(FleetObsConfig(window=4))
+        router = FakeRouter([ExplodingEngine()])
+        fo.on_step_all(router)                         # must not raise
+        assert fo.passes == 1

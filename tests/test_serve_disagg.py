@@ -591,6 +591,19 @@ class TestBenchAndDrill:
         assert rows["disagg_split"]["output_crc32"] == \
             rows["disagg_unified"]["output_crc32"]
         assert rows["disagg_split"]["kv_handoffs"]["pages"] > 0
+        # Fleet signal-bus evidence rides every bench row (round 16):
+        # pressure ratio, finished-weighted attainment, and per-role
+        # queue percentiles from the signal ring.
+        for key in ("disagg_unified", "disagg_split"):
+            fs = rows[key]["fleet_signals"]
+            assert fs["schema_version"] == 1
+            assert fs["samples"] > 0
+            assert "prefill_decode_ratio" in fs["pressure"]
+            assert 0.0 <= fs["slo_attainment_weighted"] <= 1.0
+            for role_q in fs["queue_depth"].values():
+                assert role_q["p50"] <= role_q["p99"]
+        assert set(rows["disagg_split"]["fleet_signals"]
+                   ["queue_depth"]) == {"prefill", "decode"}
 
     def test_chaos_drill_disagg_stable_per_seed(self):
         """tools/chaos_drill.py --disagg: the prefill-death drill runs

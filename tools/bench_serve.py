@@ -401,9 +401,13 @@ def drive_fleet(workload, engines, seed: int, slo):
     hand-off). SLO deadlines attach to the STEADY stream only — the
     decode-latency contract disaggregation exists to protect. Returns
     the stats row: tokens/s, steady-stream decode TPOT order-stat
-    percentiles, the fleet SLO roll-up, hand-off economics, crc."""
-    from paddle_tpu.serving import ReplicaRouter
-    router = ReplicaRouter(engines, policy="affinity", seed=seed)
+    percentiles, the fleet SLO roll-up, hand-off economics, crc, and
+    the fleet signal-bus summary (pressure ratio, finished-weighted
+    attainment, per-role queue percentiles from the signal ring) —
+    BENCH_SERVE artifacts carry fleet evidence."""
+    from paddle_tpu.serving import FleetObsConfig, ReplicaRouter
+    router = ReplicaRouter(engines, policy="affinity", seed=seed,
+                           fleet_obs=FleetObsConfig(window=256))
     ttft_d, tpot_d = slo
     pending = sorted(workload, key=lambda r: r["arrival_s"])
     handles = []
@@ -441,6 +445,22 @@ def drive_fleet(workload, engines, seed: int, slo):
     tel = router.telemetry()
     slo_agg = tel["fleet"].get("slo", {})
     goodput = slo_agg.get("goodput_tokens", 0)
+    sig = router.signals()
+    per_role_q = {}
+    for rep in sig["replicas"]:
+        role = rep["role"] or "unified"
+        per_role_q.setdefault(role, []).extend(
+            rep["window"]["queue_depth"])
+    fleet_signals = {
+        "schema_version": sig["version"],
+        "samples": sig["samples"],
+        "pressure": sig["fleet"]["pressure"],
+        "slo_attainment_weighted": sig["fleet"]["slo"]["attainment"],
+        "queue_depth": {
+            role: {"p50": round(_order_stat(v, 0.50), 2),
+                   "p99": round(_order_stat(v, 0.99), 2)}
+            for role, v in sorted(per_role_q.items())},
+    }
     return {
         "replicas": len(engines),
         "roles": [getattr(e, "role", None) for e in engines],
@@ -461,6 +481,7 @@ def drive_fleet(workload, engines, seed: int, slo):
         "prefix_hit_tokens": int(tel["fleet"]["prefix"]["hit_tokens"]),
         "kv_handoffs": dict(router.kv_handoffs)
         if router.disaggregated else None,
+        "fleet_signals": fleet_signals,
         "output_crc32": crc,
     }
 

@@ -956,6 +956,159 @@ def run_disagg_drill(seed: int = 1234, verbose: bool = True):
     return report
 
 
+def run_fleet_obs_drill(seed: int = 1234, verbose: bool = True):
+    """Seeded correlated-fleet-flight-dump drill (serving/fleet_obs.py).
+    Two phases over the PR 15 disaggregated workload (1 prefill + 2
+    decode replicas, shared-prefix prompts):
+
+      * ARMED BUT QUIET: a fault-free run with the fleet plane armed
+        (signal bus sampling + telemetry streaming + dump dir set) must
+        produce ZERO fleet dumps and zero dump failures — observability
+        must not invent incidents;
+      * REPLICA DEATH: an injected ``serve.engine_step`` fault kills
+        the prefill replica mid-handoff; the router's death path must
+        latch EXACTLY ONE well-formed correlated dump naming replica 0
+        as the origin, with every surviving peer contributing a
+        non-empty signal window — run TWICE per seed and the stable
+        report subset must be bit-identical (the dump content is
+        evidence, so it must be reproducible).
+    """
+    import tempfile
+    import zlib
+
+    import numpy as np
+
+    from paddle_tpu.resilience import chaos
+    from paddle_tpu.serving import (EngineConfig, FleetObsConfig,
+                                    ReplicaRouter, ServingEngine)
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(repo, "tests"))
+    import serve_worker
+
+    model = serve_worker.build_model(seed)
+    rng = np.random.default_rng(seed)
+    prefixes = [rng.integers(1, 61, (16,)).tolist() for _ in range(3)]
+    prompts = [prefixes[i % 3]
+               + rng.integers(1, 61, (int(rng.integers(2, 5)),)).tolist()
+               for i in range(9)]
+    max_new = 6
+
+    def mk_router(tmp):
+        pre = ServingEngine(model, EngineConfig(
+            max_seqs=2, token_budget=16, block_size=8, role="prefill",
+            obs=True))
+        dec = [ServingEngine(model, EngineConfig(
+            max_seqs=2, token_budget=8, block_size=8, role="decode",
+            obs=True)) for _ in range(2)]
+        cfg = FleetObsConfig(
+            window=16, dump_dir=tmp,
+            telemetry_path=os.path.join(tmp, "fleet_signals.json"),
+            telemetry_every=4)
+        return ReplicaRouter([pre] + dec, policy="affinity", seed=seed,
+                             fleet_obs=cfg)
+
+    def run(fault: bool, tmp: str):
+        router = mk_router(tmp)
+        handles = [router.submit(p, max_new_tokens=max_new, tag=i)
+                   for i, p in enumerate(prompts)]
+        if not fault:
+            router.run_until_idle(max_steps=800)
+            return router, handles, None
+        rounds = 0
+        while router.kv_handoffs["pages"] < 1 and rounds < 50:
+            router.step_all()
+            rounds += 1
+        plan = chaos.FaultPlan(seed=seed).add("serve.engine_step",
+                                              "error", at=(1,))
+        chaos.install_plan(plan)
+        try:
+            router.run_until_idle(max_steps=800)
+        finally:
+            chaos.clear_plan()
+        return router, handles, plan
+
+    # -- phase 1: armed but quiet — zero dumps on a healthy fleet -------------
+    quiet_tmp = tempfile.mkdtemp(prefix="fleet_obs_quiet_")
+    router, handles, _ = run(fault=False, tmp=quiet_tmp)
+    fo = router.fleet_obs
+    assert fo is not None and fo.samples > 0, "fleet plane never sampled"
+    assert fo.dumps == [] and fo.dump_failures == 0, \
+        f"healthy fleet produced dumps: {fo.dumps}"
+    assert not [p for p in os.listdir(quiet_tmp)
+                if p.startswith("fleet_flight_")], \
+        "healthy fleet wrote a fleet_flight artifact"
+    with open(os.path.join(quiet_tmp, "fleet_signals.json")) as f:
+        streamed = json.load(f)
+    assert streamed["schema"] == "fleet_signals", \
+        "telemetry stream is not the documented signals() schema"
+    oracle = {h.tag["tag"]: h.result(0) for h in handles}
+
+    # -- phase 2: prefill death => exactly one correlated dump, twice ---------
+    def death_run():
+        tmp = tempfile.mkdtemp(prefix="fleet_obs_death_")
+        router, handles, plan = run(fault=True, tmp=tmp)
+        assert [f[0] for f in plan.fired] == ["serve.engine_step"], \
+            "the death fault never fired — drill lost its teeth"
+        dead = [i for i, a in enumerate(router._alive) if not a]
+        assert dead == [0], f"expected the prefill replica dead: {dead}"
+        fo = router.fleet_obs
+        assert len(fo.dumps) == 1, \
+            f"want exactly one correlated dump, got {fo.dumps}"
+        assert fo.dump_failures == 0
+        entry = fo.dumps[0]
+        assert entry["reason"] == "death" and entry["origin"] == 0
+        files = [p for p in os.listdir(tmp)
+                 if p.startswith("fleet_flight_")]
+        assert files == ["fleet_flight_death.json"], files
+        with open(os.path.join(tmp, files[0])) as f:
+            rec = json.load(f)            # well-formed: parses clean
+        assert rec["origin_replica"] == 0, "dump must name the dead one"
+        peers = [rec["replicas"][str(i)] for i in (1, 2)]
+        assert all(len(p["signals"]) >= 1 for p in peers), \
+            "a surviving peer contributed no signal window"
+        assert all(p["role"] == "decode" and p["alive"] for p in peers)
+        # resolve every request across the death (the PR 15 contract)
+        merged = {}
+        for h in list(handles) + list(router.handoffs[0]["handles"]):
+            assert h.done, "a request parked across the death"
+            if h.error is None:
+                merged[h.tag["tag"]] = h.result(0)
+        assert merged == oracle, "post-death outputs diverged"
+        stable = {
+            "reason": rec["reason"],
+            "origin_replica": rec["origin_replica"],
+            "dead": dead,
+            "roles": {i: r["role"] for i, r in rec["replicas"].items()},
+            "peer_window_passes": [
+                [s["pass"] for s in p["signals"]] for p in peers],
+            "peer_queue_series": [
+                [s["queue_depth"] for s in p["signals"]] for p in peers],
+            "router_kv_handoffs": rec["router"]["kv_handoffs"],
+            "router_failovers": rec["router"]["failovers"],
+            "replay_crc": zlib.crc32(np.asarray(
+                [t for i in sorted(merged) for t in merged[i]],
+                np.int64).tobytes()),
+        }
+        return stable
+
+    first = death_run()
+    second = death_run()
+    assert first == second, \
+        f"correlated dump not stable per seed:\n{first}\nvs\n{second}"
+
+    report = {"seed": seed, "ok": True, "stable": first}
+    if verbose:
+        print(f"fleet-obs drill (seed={seed}): armed-quiet run sampled "
+              f"{fo.samples if fo else 0}+ passes with 0 dumps; prefill "
+              f"death latched exactly one correlated fleet_flight_death"
+              f".json naming replica 0 with "
+              f"{len(first['peer_window_passes'])} peer windows, "
+              "bit-identical across a double run — correlated fleet "
+              "flight recorder verified")
+    return report
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seed", type=int, default=1234)
@@ -989,6 +1142,11 @@ def main(argv=None):
                     help="run the prefill-replica-death drill (the "
                          "prefill pool dies mid-handoff; requests land "
                          "on decode survivors via prompt recompute)")
+    ap.add_argument("--fleet-obs", action="store_true",
+                    help="run the correlated-fleet-flight-dump drill "
+                         "(armed-quiet run => zero dumps; seeded "
+                         "replica death => exactly one dump naming the "
+                         "dead replica, stable per seed)")
     args = ap.parse_args(argv)
     if args.preempt:
         report = run_preempt_drill(seed=args.seed, verbose=not args.json,
@@ -1004,6 +1162,9 @@ def main(argv=None):
         report = run_router_drill(seed=args.seed, verbose=not args.json)
     elif args.disagg:
         report = run_disagg_drill(seed=args.seed, verbose=not args.json)
+    elif args.fleet_obs:
+        report = run_fleet_obs_drill(seed=args.seed,
+                                     verbose=not args.json)
     else:
         report = run_drill(seed=args.seed, verbose=not args.json)
     if args.json:

@@ -13,15 +13,24 @@ Two modes:
     streams (arm the engine with ``PADDLE_SERVE_TELEMETRY=FILE`` or
     ``ObsConfig(telemetry_path=FILE)``; the observer atomically rewrites
     it every ``telemetry_every`` steps). This is the production shape:
-    the dashboard never touches the serving process.
+    the dashboard never touches the serving process. A
+    ``PADDLE_FLEET_TELEMETRY`` file (the ``FleetObserver.signals()``
+    schema) renders as the fleet signal-bus panels: per-replica
+    sparklines from the signal ring, per-role pressure + the
+    prefill:decode ratio, headroom pricing, and the last correlated
+    fleet dump pointer.
   * ``--demo``       — self-contained: builds a tiny CPU model, drives a
     seeded Poisson load through an armed engine in-process, and renders
     between step batches. The zero-setup smoke (used by tier-1 via
-    subprocess).
+    subprocess). ``--demo --fleet`` drives a disaggregated fleet with
+    the fleet observability plane armed and renders the signal-bus
+    panels under the router dashboard.
 
 Usage:
     python tools/serve_top.py --watch /run/serve_telemetry.json
+    python tools/serve_top.py --watch /run/fleet_signals.json
     JAX_PLATFORMS=cpu python tools/serve_top.py --demo --iterations 6
+    JAX_PLATFORMS=cpu python tools/serve_top.py --demo --fleet --replicas 3
 """
 from __future__ import annotations
 
@@ -69,6 +78,93 @@ def _lat_line(name: str, d: dict) -> str:
     return (f"  {name:<5} p50 {_fmt_s(d.get('p50'))}  "
             f"p95 {_fmt_s(d.get('p95'))}  p99 {_fmt_s(d.get('p99'))}  "
             f"mean {_fmt_s(d.get('mean'))}  n={d.get('count', 0)}")
+
+
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def _spark(values, width: int = 16) -> str:
+    """Unicode sparkline of the last ``width`` ring samples (scaled to
+    the window max; a flat-zero series renders flat-low)."""
+    vals = [0.0 if v is None else float(v) for v in values][-width:]
+    if not vals:
+        return " " * width
+    top = max(vals)
+    if top <= 0:
+        return (_SPARK[0] * len(vals)).ljust(width)
+    return "".join(
+        _SPARK[min(int(v / top * (len(_SPARK) - 1)), len(_SPARK) - 1)]
+        for v in vals).ljust(width)
+
+
+def render_fleet_signals(sig: dict, prev: dict = None) -> str:
+    """The fleet signal-bus panels from one ``FleetObserver.signals()``
+    snapshot (schema ``fleet_signals`` — what ``PADDLE_FLEET_TELEMETRY``
+    streams): per-role pressure + the prefill:decode ratio, the
+    finished-weighted fleet SLO roll-up, mem_report-priced headroom,
+    per-replica sparklines straight from the signal ring, and the last
+    correlated fleet flight dump."""
+    fleet = sig.get("fleet", {})
+    lines = [
+        f"fleet signal bus — pass {sig.get('passes', 0)} "
+        f"(samples {sig.get('samples', 0)}, ring window "
+        f"{sig.get('window', 0)})"]
+    pressure = fleet.get("pressure", {})
+    parts = []
+    for role, p in sorted(pressure.get("per_role", {}).items()):
+        parts.append(f"{role} {p.get('pressure', 0.0):.2f} "
+                     f"({p.get('demand', 0)}/{p.get('capacity', 0)})")
+    ratio = pressure.get("prefill_decode_ratio")
+    lines.append(
+        "pressure  " + ("  ".join(parts) or "(no live replicas)")
+        + (f"   prefill:decode {ratio:.2f}" if ratio is not None else ""))
+    slo = fleet.get("slo", {})
+    if slo:
+        lines.append(
+            f"fleet slo attainment {slo.get('attainment', 1.0) * 100:5.1f}% "
+            f"({slo.get('met', 0)}/{slo.get('tracked', 0)} "
+            f"finished-weighted)  goodput "
+            f"{slo.get('goodput_fraction', 1.0) * 100:5.1f}%")
+    head = fleet.get("headroom")
+    if head:
+        parts = []
+        for role, h in sorted(head.get("per_role", {}).items()):
+            fits = "fits" if h.get("fits") else "OVER"
+            parts.append(f"{role} {_fmt_b(h.get('headroom_bytes')).strip()}"
+                         f" headroom ({fits})")
+        lines.append(f"headroom  {'  '.join(parts)}  "
+                     f"@ {head.get('hbm_gib')} GiB HBM "
+                     "(mem_report role pricing)")
+    else:
+        lines.append("headroom  - (arm FleetObsConfig(model_cfg=, "
+                     "hbm_gib=) for mem_report pricing)")
+    agg = fleet.get("fleet", {})
+    lines.append(
+        f"aggregate waiting {agg.get('queue_depth', 0):>3}  running "
+        f"{agg.get('running', 0):>3}  {agg.get('tok_per_s', 0.0):8.1f} "
+        f"tok/s  kv {agg.get('kv_used', 0)}/{agg.get('kv_size', 0)} pages")
+    lines.append("-" * 72)
+    for row in sig.get("replicas", ()):
+        win = row.get("window", {})
+        mark = " " if row.get("alive", True) else "✗"
+        role = {"prefill": "P", "decode": "D"}.get(row.get("role"), " ")
+        lines.append(
+            f" {role}r{row.get('replica', '?')}{mark} "
+            f"q {_spark(win.get('queue_depth', ()))} {row['queue_depth']:>3} "
+            f" tok/s {_spark(win.get('tok_per_s', ()))} "
+            f"{row.get('tok_per_s', 0.0):7.1f}  kv "
+            f"{_spark(win.get('kv_utilization', ()))} "
+            f"{row.get('kv_utilization', 0.0) * 100:5.1f}%")
+    dumps = sig.get("dumps", ())
+    if dumps:
+        last = dumps[-1]
+        where = last.get("path") or "(in memory)"
+        lines.append(
+            f"fleet dumps {len(dumps)}  last: {last.get('reason')} "
+            f"(origin r{last.get('origin')}) -> {where}")
+    else:
+        lines.append("fleet dumps 0")
+    return "\n".join(lines) + "\n"
 
 
 def render_router(tel: dict, prev: dict = None) -> str:
@@ -158,7 +254,11 @@ def render_router(tel: dict, prev: dict = None) -> str:
 def render(tel: dict, prev: dict = None) -> str:
     """One dashboard frame from a telemetry snapshot (prev = the
     previous snapshot, for instantaneous rates). A ``ReplicaRouter``
-    snapshot (the ``router`` key) renders as the fleet dashboard."""
+    snapshot (the ``router`` key) renders as the fleet dashboard; a
+    ``FleetObserver.signals()`` snapshot (schema ``fleet_signals``)
+    renders as the signal-bus panels."""
+    if tel.get("schema") == "fleet_signals":
+        return render_fleet_signals(tel, prev)
     if "router" in tel and "replicas" in tel:
         return render_router(tel, prev)
     lines = []
@@ -285,37 +385,43 @@ def watch(path: str, interval: float, iterations, no_clear: bool) -> int:
 
 def demo_router(iterations: int, n_requests: int, interval: float,
                 no_clear: bool, replicas: int, seed: int = 0,
-                disagg: bool = False) -> int:
+                disagg: bool = False, fleet: bool = False) -> int:
     """Multi-replica demo: a prefix-affinity ``ReplicaRouter`` over N
     tiny engines under a seeded shared-prefix load, rendered as the
     fleet dashboard between step batches. ``disagg=True`` splits the
     fleet into prefill/decode pools (half each, at least one of both)
-    and renders the pool panels + hand-off economics."""
+    and renders the pool panels + hand-off economics. ``fleet=True``
+    additionally arms the fleet observability plane (implies disagg)
+    and renders the signal-bus panels under the dashboard."""
     import numpy as np
 
     import paddle_tpu as paddle
     from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
-    from paddle_tpu.serving import (EngineConfig, ReplicaRouter,
-                                    ServingEngine)
+    from paddle_tpu.serving import (EngineConfig, FleetObsConfig,
+                                    ReplicaRouter, ServingEngine)
 
+    disagg = disagg or fleet
     paddle.seed(11)
     cfg = LlamaConfig.tiny(vocab_size=128, hidden_size=32, layers=2,
                            heads=4, kv_heads=2, seq=128)
     cfg.use_flash_attention = False
     model = LlamaForCausalLM(cfg)
+    obs = True if fleet else None
     if disagg:
         n_pre = max(replicas // 2, 1)
         engines = [ServingEngine(model, EngineConfig(
-            max_seqs=4, token_budget=24, block_size=8, role="prefill"))
-            for _ in range(n_pre)]
+            max_seqs=4, token_budget=24, block_size=8, role="prefill",
+            obs=obs)) for _ in range(n_pre)]
         engines += [ServingEngine(model, EngineConfig(
-            max_seqs=4, token_budget=8, block_size=8, role="decode"))
-            for _ in range(max(replicas - n_pre, 1))]
+            max_seqs=4, token_budget=8, block_size=8, role="decode",
+            obs=obs)) for _ in range(max(replicas - n_pre, 1))]
     else:
         engines = [ServingEngine(model, EngineConfig(
             max_seqs=4, token_budget=24, block_size=8))
             for _ in range(replicas)]
-    router = ReplicaRouter(engines, policy="affinity", seed=seed)
+    router = ReplicaRouter(engines, policy="affinity", seed=seed,
+                           fleet_obs=FleetObsConfig(window=64)
+                           if fleet else None)
     rng = np.random.default_rng(seed)
     prefixes = [rng.integers(1, 128, (16,)).tolist()
                 for _ in range(max(replicas, 2))]
@@ -326,6 +432,13 @@ def demo_router(iterations: int, n_requests: int, interval: float,
                             (int(rng.integers(2, 6)),)).tolist()
         handles.append(router.submit(
             pre + tail, max_new_tokens=int(rng.integers(6, 14)), tag=i))
+    def frame(tel):
+        out = render(tel, prev)
+        if fleet:
+            out += "-" * 72 + "\n" + render_fleet_signals(
+                router.signals())
+        return out
+
     prev = None
     for _ in range(iterations):
         if router.has_work():
@@ -335,7 +448,7 @@ def demo_router(iterations: int, n_requests: int, interval: float,
         tel = router.telemetry()
         if not no_clear:
             sys.stdout.write(CLEAR)
-        sys.stdout.write(render(tel, prev))
+        sys.stdout.write(frame(tel))
         sys.stdout.flush()
         prev = tel
         if not router.has_work():
@@ -346,7 +459,7 @@ def demo_router(iterations: int, n_requests: int, interval: float,
     tel = router.telemetry()
     if not no_clear:
         sys.stdout.write(CLEAR)
-    sys.stdout.write(render(tel, prev))
+    sys.stdout.write(frame(tel))
     finished = sum(1 for h in handles if h.done and h.error is None)
     sys.stdout.write(
         f"serve_top router demo: {finished}/{n_requests} requests over "
@@ -429,6 +542,11 @@ def main(argv=None) -> int:
                     help="demo mode: split the replicas into prefill/"
                          "decode pools (KV-page hand-off) and render "
                          "the pool panels")
+    ap.add_argument("--fleet", action="store_true",
+                    help="demo mode: arm the fleet observability plane "
+                         "on a disaggregated fleet and render the "
+                         "signal-bus panels (sparklines, pressure, "
+                         "headroom, dump pointer)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--no-clear", action="store_true",
                     help="append frames instead of clearing the screen "
@@ -436,10 +554,11 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     if args.demo:
         iters = args.iterations if args.iterations is not None else 10 ** 9
-        if args.replicas > 1 or args.disagg:
+        if args.replicas > 1 or args.disagg or args.fleet:
             return demo_router(iters, args.requests, args.interval,
                                args.no_clear, max(args.replicas, 2),
-                               seed=args.seed, disagg=args.disagg)
+                               seed=args.seed, disagg=args.disagg,
+                               fleet=args.fleet)
         return demo(iters, args.requests, args.interval,
                     args.no_clear, seed=args.seed)
     return watch(args.watch, args.interval, args.iterations, args.no_clear)

@@ -20,11 +20,24 @@ was set) are resolved by re-qualifying the later file's pids.
 
 Usage:
     python tools/trace_merge.py rank0.json rank1.json ... -o merged.json
+
+Fleet workflow (PR 16): ``ReplicaRouter.export_chrome_trace()`` writes
+one fleet trace (anchor rank "fleet") whose per-request tracks span
+router→prefill→kv_handoff→decode; pass it here alongside training
+profiler exports — or a whole directory of ``*.json`` traces, which
+expands to every trace file in it — to overlay serving and training on
+the shared wall clock:
+
+    python tools/trace_merge.py fleet_trace.json profile_rank*.json \
+        -o merged.json
+    python tools/trace_merge.py trace_dir/ -o merged.json
 """
 from __future__ import annotations
 
 import argparse
+import glob
 import json
+import os
 import sys
 from typing import Dict, List, Optional, Tuple
 
@@ -114,12 +127,34 @@ def merge_traces(paths: List[str]) -> dict:
             "metadata": {"merged_from": list(paths)}}
 
 
+def expand_paths(paths: List[str]) -> List[str]:
+    """Expand directory arguments to their sorted ``*.json`` members
+    (a fleet run drops several exports into one directory)."""
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            members = sorted(glob.glob(os.path.join(p, "*.json")))
+            if not members:
+                print(f"trace_merge: {p}/ holds no *.json traces",
+                      file=sys.stderr)
+            out.extend(members)
+        else:
+            out.append(p)
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="Merge per-rank chrome traces into one timeline")
-    ap.add_argument("traces", nargs="+", help="per-rank trace JSON files")
+    ap.add_argument("traces", nargs="+",
+                    help="per-rank trace JSON files (a directory "
+                         "expands to its *.json members)")
     ap.add_argument("-o", "--output", default="merged_trace.json")
     args = ap.parse_args(argv)
+    args.traces = expand_paths(args.traces)
+    if not args.traces:
+        print("trace_merge: nothing to merge", file=sys.stderr)
+        return 1
     payload = merge_traces(args.traces)
     with open(args.output, "w") as f:
         json.dump(payload, f)
