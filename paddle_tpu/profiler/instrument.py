@@ -85,6 +85,9 @@ Catalog (names are a stable API — see README "Observability"):
   fleet_pressure_ratio{role}             per-role demand / capacity from the fleet signal bus
   fleet_replica_signal{name,replica}     sampled per-replica fleet-bus signals (queue_depth|tok_per_s)
   fleet_flight_dumps_total{trigger}      correlated fleet flight dumps by latch reason
+  fleet_replicas{role}                   live replicas per role in the autoscaled fleet
+  fleet_scale_events_total{action,outcome}  autoscale actuations (spawn|retire|rebalance x ok|fault|skipped)
+  fleet_autoscale_decision_seconds       signal read -> decision -> actuation wall time
 """
 from __future__ import annotations
 
@@ -173,6 +176,9 @@ CATALOG = (
     "fleet_pressure_ratio",
     "fleet_replica_signal",
     "fleet_flight_dumps_total",
+    "fleet_replicas",
+    "fleet_scale_events_total",
+    "fleet_autoscale_decision_seconds",
 )
 
 _enabled = _m._ENABLED  # bind the cell once: hot-path guard is _enabled[0]
@@ -788,6 +794,37 @@ def record_fleet_flight_dump(trigger: str) -> None:
     _reg().counter("fleet_flight_dumps_total",
                    "correlated fleet flight dumps by latch reason",
                    labelnames=("trigger",)).labels(trigger=trigger).inc()
+
+
+def record_fleet_scale_replicas(role: str, n: int) -> None:
+    """Live replica count for one role pool of the autoscaled fleet
+    (role "unified" for role-less fleets)."""
+    if not _enabled[0]:
+        return
+    _reg().gauge("fleet_replicas",
+                 "live replicas per role in the autoscaled fleet",
+                 labelnames=("role",)).labels(role=role).set(float(n))
+
+
+def record_fleet_scale_event(action: str, outcome: str) -> None:
+    """One autoscale actuation: action spawn|retire|rebalance, outcome
+    ok|fault|skipped."""
+    if not _enabled[0]:
+        return
+    _reg().counter("fleet_scale_events_total",
+                   "autoscale actuations by action and outcome",
+                   labelnames=("action", "outcome")) \
+        .labels(action=action, outcome=outcome).inc()
+
+
+def record_fleet_scale_decision(seconds: float) -> None:
+    """Wall time of one autoscaler control pass: signal read through
+    decision and (possibly chaos-probed) actuation."""
+    if not _enabled[0]:
+        return
+    _reg().histogram("fleet_autoscale_decision_seconds",
+                     "signal read -> decision -> actuation wall time",
+                     buckets=_TIME_BUCKETS).observe(seconds)
 
 
 def record_serve_tokens(n: int, step_seconds: float) -> None:

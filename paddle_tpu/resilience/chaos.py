@@ -78,6 +78,8 @@ SITES = {
     "aot.load": "site",
     "aot.artifact_bytes": "mangle",
     "mem.snapshot": "site",
+    "elastic.spawn": "site",
+    "elastic.retire": "site",
 }
 
 _CONTROL_KINDS = ("delay", "error", "die")

@@ -119,7 +119,33 @@ reason, never raising into ``step_all``:
 
 Drill it with ``python tools/chaos_drill.py --fleet-obs``; watch with
 ``python tools/serve_top.py --demo --fleet``.
+
+Elastic control plane (``serving.autoscaler``): the actuator that
+closes the item-2(c) loop. ``FleetAutoscaler`` reads one ``signals()``
+snapshot per control interval and fires at most one rule — spawn a
+replica of the hottest role (``engine_factory`` → ``add_replica``,
+gated fits-first on the ``mem_report`` headroom signal), retire the
+least-affinity-loaded replica through ``decommission`` (its drain
+manifest replays onto survivors: zero parked requests by
+construction), or flip a replica between prefill/decode roles
+(``router.set_role``: drain → re-validate → re-admit) when the
+prefill:decode pressure ratio drifts out of band — under hysteresis
+bands, per-action cooldowns, a min/max replica envelope and a
+chaos-probed actuation path (``elastic.spawn``/``elastic.retire``)
+whose faults degrade to backoff-and-hold, never a raise into
+``step_all``. Every decision lands as a structured ``AutoscaleEvent``
+on the fleet-obs signal ring:
+
+    scaler = FleetAutoscaler(router, engine_factory=make_engine,
+                             config=AutoscalerConfig(max_replicas=4))
+    while router.step_all():
+        scaler.control()                # at most one action per pass
+
+Benchmark the 10x traffic swing with ``python tools/bench_serve.py
+--elastic``; drill faulted spawns/mid-burst retires with ``python
+tools/chaos_drill.py --elastic``.
 """
+from .autoscaler import AutoscaleEvent, AutoscalerConfig, FleetAutoscaler
 from .engine import (EngineConfig, EnginePredictor, ServingEngine,
                      engine_from_config)
 from .kv_pool import KVBlockPool, PoolExhausted, prefix_chain_keys
@@ -138,6 +164,7 @@ __all__ = [
     "EngineConfig", "EnginePredictor", "ServingEngine",
     "engine_from_config", "KVBlockPool", "PoolExhausted",
     "prefix_chain_keys", "ReplicaRouter",
+    "AutoscaleEvent", "AutoscalerConfig", "FleetAutoscaler",
     "ragged_paged_attention", "Request", "Scheduler",
     "Drafter", "NgramDrafter", "DraftModelDrafter", "make_drafter",
     "verify_greedy",

@@ -1,0 +1,396 @@
+"""Elastic fleet control plane: signal-driven autoscaling that is
+lossless by construction.
+
+ROADMAP item 2 rung (c), closing the loop PR 16 opened: the fleet
+signal bus (``serving/fleet_obs.py signals()``) publishes per-role
+demand/capacity pressure, the prefill:decode pressure ratio, the
+finished-weighted SLO roll-up and ``mem_report.plan(role=)`` headroom —
+and ``FleetAutoscaler`` is the actuator that consumes it. Each control
+interval it reads one snapshot and fires AT MOST one rule:
+
+  rule              trigger (hysteresis band)        actuation
+  ----------------  -------------------------------  -------------------
+  pressure_high     max per-role pressure > up band, spawn one replica of
+                    fleet below the max envelope     the hottest role
+                                                     (``engine_factory``
+                                                     -> ``add_replica``),
+                                                     gated fits-first on
+                                                     the headroom signal
+  pressure_low      EVERY role pressure < down band, retire the least-
+                    fleet above the min envelope     affinity-loaded
+                                                     replica through
+                                                     ``decommission`` —
+                                                     its drain manifest
+                                                     replays onto
+                                                     survivors
+  ratio_high/_low   prefill:decode pressure ratio    flip one replica of
+                    outside the rebalance band       the cold role via
+                                                     ``router.set_role``
+                                                     (drain -> role swap
+                                                     -> re-admit)
+
+Robustness discipline, in order of importance:
+
+  * **lossless by construction** — scale-down and role flips ride the
+    PR 13/15 drain-manifest/replay machinery: unfinished requests hand
+    off to affinity-matched (same-role-first) survivors, original
+    handles resolve with a terminal error, nothing ever parks;
+  * **can never flap** — wide hysteresis bands between the up and down
+    thresholds, a per-action cooldown (control passes, deterministic —
+    never wall-clock) and a hard min/max replica envelope (disaggregated
+    fleets additionally keep >= 1 replica per role);
+  * **degrades, never raises** — the actuation path is chaos-probed
+    (``elastic.spawn`` / ``elastic.retire`` sites): a faulted spawn or
+    retire leaves the CURRENT fleet serving, arms an exponential
+    hold-down (``backoff`` passes, doubling per consecutive fault), and
+    is recorded — ``control()`` is additionally fenced so nothing can
+    raise into the ``step_all`` driver;
+  * **every decision is evidence** — each fired rule lands as a
+    structured ``AutoscaleEvent`` (signal snapshot + rule + outcome) on
+    the autoscaler's ledger AND the fleet-obs signal ring, so
+    ``signals()["autoscale"]``, correlated fleet flight dumps and
+    ``serve_top`` can all replay WHY the fleet has the shape it has.
+
+Driving stays with the caller: run ``scaler.control()`` between
+``step_all`` passes (the drill/bench loop), or on any cadence —
+``control_every`` decimates decisions independently of call rate.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from ..profiler import instrument as _instr
+from ..resilience import chaos
+
+logger = logging.getLogger("paddle_tpu.serving.autoscaler")
+
+_ACTIONS = ("spawn", "retire", "rebalance")
+
+
+@dataclass
+class AutoscalerConfig:
+    """Policy knobs. The defaults give a conservative controller: act
+    on sustained 1.5x overload, shrink only when EVERY pool runs below
+    a quarter of capacity, and never twice within a cooldown window."""
+    min_replicas: int = 1           # total envelope floor (>=1 per role
+                                    # is additionally enforced when
+                                    # disaggregated)
+    max_replicas: int = 4           # total envelope ceiling
+    scale_up_pressure: float = 1.5  # per-role pressure above -> spawn
+    scale_down_pressure: float = 0.25   # ALL roles below -> retire
+    rebalance_high: float = 3.0     # prefill:decode ratio above -> a
+                                    # decode replica flips to prefill
+    rebalance_low: float = 0.33     # ratio below -> prefill flips to
+                                    # decode
+    control_every: int = 1          # decide every Nth control() call
+    cooldown: int = 8               # control passes between two firings
+                                    # of the SAME action
+    backoff: int = 16               # hold-down after a faulted
+                                    # actuation; doubles per consecutive
+                                    # fault (capped at 8x)
+    drain_deadline_s: float = 0.25  # grace budget for retire/flip
+                                    # drains (unfinished work hands off)
+    require_headroom: bool = True   # spawn only when the headroom
+                                    # signal (if priced) says one more
+                                    # replica of that role fits
+
+    def __post_init__(self):
+        if self.min_replicas < 1:
+            raise ValueError("min_replicas must be >= 1")
+        if self.max_replicas < self.min_replicas:
+            raise ValueError(
+                f"max_replicas {self.max_replicas} < min_replicas "
+                f"{self.min_replicas}")
+        if self.scale_down_pressure >= self.scale_up_pressure:
+            raise ValueError(
+                "hysteresis needs scale_down_pressure < "
+                f"scale_up_pressure (got {self.scale_down_pressure} >= "
+                f"{self.scale_up_pressure})")
+        if self.rebalance_low >= self.rebalance_high:
+            raise ValueError(
+                "rebalance band needs rebalance_low < rebalance_high")
+        for name in ("control_every", "cooldown", "backoff"):
+            if getattr(self, name) < 1:
+                raise ValueError(f"{name} must be >= 1")
+
+
+@dataclass
+class AutoscaleEvent:
+    """One control decision, replayable: which rule fired on which
+    signal snapshot, what was actuated, and how it came out."""
+    tick: int                       # autoscaler control tick
+    passes: int                     # step_all passes the bus had seen
+    rule: str                       # pressure_high|pressure_low|...
+    action: str                     # spawn|retire|rebalance
+    role: Optional[str]             # acted-on role ("unified" = none)
+    replica: Optional[int]          # slot index (None: never actuated)
+    outcome: str                    # ok|fault|skipped|backoff_hold
+    reason: str                     # human-readable trigger arithmetic
+    signal: Dict[str, Any] = field(default_factory=dict)
+    detail: Dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "tick": self.tick, "passes": self.passes,
+            "rule": self.rule, "action": self.action,
+            "role": self.role, "replica": self.replica,
+            "outcome": self.outcome, "reason": self.reason,
+            "signal": dict(self.signal), "detail": dict(self.detail),
+        }
+
+
+class FleetAutoscaler:
+    """The elastic control loop over one ``ReplicaRouter``.
+
+    ``engine_factory(role)`` must return a fresh ``ServingEngine``
+    compatible with the fleet (same model geometry / ``block_size``);
+    ``role`` is ``None`` for unified fleets. The router's fleet
+    observability plane must be armed — the signal bus IS the sensor.
+    """
+
+    def __init__(self, router, engine_factory: Callable[[Optional[str]],
+                                                        Any],
+                 config: Optional[AutoscalerConfig] = None):
+        if router.fleet_obs is None:
+            raise ValueError(
+                "FleetAutoscaler needs the fleet signal bus: construct "
+                "the router with fleet_obs= (or PADDLE_FLEET_OBS=1)")
+        self.router = router
+        self.engine_factory = engine_factory
+        self.config = config or AutoscalerConfig()
+        self.events: List[AutoscaleEvent] = []
+        self.ticks = 0
+        self.spawns = 0
+        self.retires = 0
+        self.rebalances = 0
+        self.faults = 0
+        self._last_fired: Dict[str, int] = {}   # action -> tick
+        self._backoff_until = 0
+        self._consecutive_faults = 0
+
+    # -- the control interval -------------------------------------------------
+    def control(self) -> Optional[AutoscaleEvent]:
+        """One control interval: read the signal bus, fire at most one
+        rule, actuate it, record the decision. NEVER raises into the
+        driver — a policy/actuation failure degrades to the current
+        fleet (chaos-faulted actuations additionally arm the
+        hold-down)."""
+        t0 = time.monotonic()
+        try:
+            event = self._control_inner()
+        except Exception:  # noqa: BLE001 — the driver must keep stepping
+            logger.warning("autoscaler: control pass failed",
+                           exc_info=True)
+            event = None
+        _instr.record_fleet_scale_decision(time.monotonic() - t0)
+        return event
+
+    def _control_inner(self) -> Optional[AutoscaleEvent]:
+        self.ticks += 1
+        cfg = self.config
+        if self.ticks % cfg.control_every:
+            return None
+        sig = self.router.signals()
+        by_role = self._live_by_role()
+        for role, idxs in by_role.items():
+            _instr.record_fleet_scale_replicas(role, len(idxs))
+        per_role = sig["fleet"]["pressure"]["per_role"]
+        if not per_role:                    # bus has sampled nothing yet
+            return None
+        decision = self._decide(sig, per_role, by_role)
+        if decision is None:
+            return None
+        rule, action, role, reason = decision
+        snapshot = self._snapshot(sig, per_role)
+        if self.ticks < self._backoff_until:
+            # a prior actuation faulted: hold the current fleet until
+            # the hold-down expires (recorded — the drill asserts it)
+            return self._record(rule, action, role, None,
+                                "backoff_hold", reason, snapshot,
+                                {"backoff_until": self._backoff_until})
+        return self._actuate(rule, action, role, reason, snapshot,
+                             by_role)
+
+    # -- policy ---------------------------------------------------------------
+    def _decide(self, sig, per_role, by_role):
+        """Pick (rule, action, role, reason) or None. Priority: spawn
+        beats rebalance beats retire — overload is the emergency,
+        shrinking can always wait a band."""
+        cfg = self.config
+        live = sum(len(v) for v in by_role.values())
+        hot = max(per_role, key=lambda r: per_role[r]["pressure"])
+        hot_p = per_role[hot]["pressure"]
+        if hot_p > cfg.scale_up_pressure and live < cfg.max_replicas \
+                and self._cool("spawn"):
+            return ("pressure_high", "spawn",
+                    None if hot == "unified" else hot,
+                    f"pressure[{hot}]={hot_p} > {cfg.scale_up_pressure}")
+        ratio = sig["fleet"]["pressure"]["prefill_decode_ratio"]
+        if self.router.disaggregated and ratio is not None \
+                and self._cool("rebalance"):
+            if ratio > cfg.rebalance_high \
+                    and len(by_role.get("decode", ())) > 1:
+                return ("ratio_high", "rebalance", "decode",
+                        f"prefill:decode={ratio} > {cfg.rebalance_high}")
+            if ratio < cfg.rebalance_low \
+                    and len(by_role.get("prefill", ())) > 1:
+                return ("ratio_low", "rebalance", "prefill",
+                        f"prefill:decode={ratio} < {cfg.rebalance_low}")
+        cold_p = max(p["pressure"] for p in per_role.values())
+        if cold_p < cfg.scale_down_pressure and live > cfg.min_replicas \
+                and self._cool("retire"):
+            victim_role = self._retire_role(per_role, by_role)
+            if victim_role is not None:
+                return ("pressure_low", "retire",
+                        None if victim_role == "unified" else victim_role,
+                        f"max pressure={cold_p} < "
+                        f"{cfg.scale_down_pressure}")
+        return None
+
+    def _retire_role(self, per_role, by_role) -> Optional[str]:
+        """The coldest role that can spare a replica (disaggregated
+        fleets keep >= 1 per role)."""
+        floor = 1 if self.router.disaggregated else 0
+        cands = [r for r, idxs in by_role.items() if len(idxs) > floor]
+        if not cands:
+            return None
+        return min(cands,
+                   key=lambda r: per_role.get(r, {}).get("pressure", 0.0))
+
+    def _cool(self, action: str) -> bool:
+        last = self._last_fired.get(action)
+        return last is None or self.ticks - last >= self.config.cooldown
+
+    # -- actuation (chaos-probed; no wall-clock in here) ----------------------
+    def _actuate(self, rule, action, role, reason, snapshot, by_role):
+        cfg = self.config
+        outcome, replica, detail = "ok", None, {}
+        try:
+            if action == "spawn":
+                if cfg.require_headroom and not self._fits(snapshot,
+                                                           role):
+                    return self._record(rule, action, role, None,
+                                        "skipped", reason, snapshot,
+                                        {"skip": "no_headroom"})
+                chaos.site("elastic.spawn")
+                engine = self.engine_factory(role)
+                replica = self.router.add_replica(engine)
+                self.spawns += 1
+            elif action == "retire":
+                key = role or "unified"
+                replica = self._least_affinity_loaded(by_role[key])
+                chaos.site("elastic.retire")
+                handles = self.router.decommission(
+                    replica, deadline_s=cfg.drain_deadline_s)
+                self.retires += 1
+                detail["replayed"] = len(handles)
+            else:                           # rebalance: flip the cold role
+                new_role = "prefill" if role == "decode" else "decode"
+                replica = self._least_affinity_loaded(by_role[role])
+                chaos.site("elastic.retire")
+                handles = self.router.set_role(
+                    replica, new_role, deadline_s=cfg.drain_deadline_s)
+                self.rebalances += 1
+                detail["replayed"] = len(handles)
+                detail["new_role"] = new_role
+            self._consecutive_faults = 0
+            self._last_fired[action] = self.ticks
+        except Exception as exc:  # noqa: BLE001 — degrade, never raise
+            # a faulted actuation (chaos probe, factory failure, flip
+            # re-validation) leaves the CURRENT fleet serving and arms
+            # the exponential hold-down; the fleet is degraded, never
+            # wounded — and any drain that already ran handed its work
+            # off losslessly before the fault surfaced
+            outcome = "fault"
+            self.faults += 1
+            self._consecutive_faults += 1
+            mult = 2 ** min(self._consecutive_faults - 1, 3)
+            self._backoff_until = self.ticks + cfg.backoff * mult
+            detail["error"] = f"{type(exc).__name__}: {exc}"
+            detail["backoff_until"] = self._backoff_until
+            logger.warning("autoscaler: %s faulted (hold-down to tick "
+                           "%d): %s", action, self._backoff_until, exc)
+        return self._record(rule, action, role, replica, outcome,
+                            reason, snapshot, detail)
+
+    def _fits(self, snapshot, role) -> bool:
+        """The fits-before-spawn gate: when the bus priced headroom
+        (``mem_report.plan(role=)``), one more replica of ``role`` must
+        fit; an unpriced bus (no model_cfg/hbm_gib) does not gate."""
+        headroom = snapshot.get("headroom")
+        if not headroom:
+            return True
+        entry = headroom["per_role"].get(role or "unified")
+        return True if entry is None else bool(entry["fits"])
+
+    def _least_affinity_loaded(self, cands) -> int:
+        """Retire/flip victim: fewest affinity registrations (both
+        maps), then lightest queue, then index — the replica whose loss
+        costs the fleet's prefix-cache partition the least."""
+        r = self.router
+        with r._lock:
+            load = {i: 0 for i in cands}
+            for amap in (r._affinity, r._decode_affinity):
+                for tgt in amap.values():
+                    if tgt in load:
+                        load[tgt] += 1
+
+            def key(i):
+                sched = r.replicas[i].sched
+                return (load[i],
+                        sched.queue_depth() + len(sched.running), i)
+            return min(cands, key=key)
+
+    # -- evidence -------------------------------------------------------------
+    def _live_by_role(self) -> Dict[str, List[int]]:
+        r = self.router
+        with r._lock:
+            out: Dict[str, List[int]] = {}
+            for i, eng in enumerate(r.replicas):
+                if r._alive[i]:
+                    role = getattr(eng, "role", None) or "unified"
+                    out.setdefault(role, []).append(i)
+            return out
+
+    @staticmethod
+    def _snapshot(sig, per_role) -> Dict[str, Any]:
+        """The compact signal snapshot an event carries: enough to
+        replay the decision, small enough for a window-bounded ring."""
+        return {
+            "pressure": {r: p["pressure"] for r, p in per_role.items()},
+            "prefill_decode_ratio":
+                sig["fleet"]["pressure"]["prefill_decode_ratio"],
+            "attainment": sig["fleet"]["slo"]["attainment"],
+            "alive": sig["fleet"]["fleet"]["alive"],
+            "queue_depth": sig["fleet"]["fleet"]["queue_depth"],
+            "headroom": sig["fleet"]["headroom"],
+        }
+
+    def _record(self, rule, action, role, replica, outcome, reason,
+                snapshot, detail) -> AutoscaleEvent:
+        event = AutoscaleEvent(
+            tick=self.ticks, passes=self.router.fleet_obs.passes,
+            rule=rule, action=action,
+            role=role, replica=replica, outcome=outcome, reason=reason,
+            signal=snapshot, detail=detail)
+        self.events.append(event)
+        self.router.fleet_obs.on_autoscale_event(event.to_dict())
+        _instr.record_fleet_scale_event(action, outcome)
+        return event
+
+    def telemetry(self) -> Dict[str, Any]:
+        """Lifetime controller counters + envelope, for dashboards."""
+        return {
+            "ticks": self.ticks,
+            "spawns": self.spawns,
+            "retires": self.retires,
+            "rebalances": self.rebalances,
+            "faults": self.faults,
+            "events": len(self.events),
+            "backoff_until": self._backoff_until,
+            "envelope": {"min": self.config.min_replicas,
+                         "max": self.config.max_replicas},
+        }

@@ -1347,6 +1347,36 @@ class ServingEngine:
         self._notify_admit()
         return len(live)
 
+    def set_role(self, role: Optional[str]) -> None:
+        """Re-validate and flip this engine's disaggregated role (the
+        autoscaler's rebalance seam). Only legal on an IDLE engine —
+        the caller drains first, so every prior request either
+        finished or rode the drain manifest onto a survivor. Re-runs
+        the construction-time role checks (a prefill engine never
+        decodes, so it cannot carry speculative decoding), then
+        re-opens admission: the drain that preceded the flip closed
+        it."""
+        if role not in (None, "prefill", "decode"):
+            raise ValueError(
+                f"unknown engine role {role!r} (want prefill|decode|None)")
+        if role == "prefill" and self.config.spec_method is not None:
+            raise ValueError(
+                "a prefill-role engine never decodes — speculative "
+                "decoding belongs on the decode pool")
+        with self._lock:
+            if self._live_requests():
+                raise RuntimeError(
+                    "role flip needs an idle engine: drain it first so "
+                    "unfinished work hands off to a survivor instead "
+                    "of changing roles mid-flight")
+            self.role = role
+            self.config.role = role
+            self.sched.role = role
+            # re-admit: the drain that preceded the flip closed the door
+            self._draining = False
+            self.sched.draining = False
+        self._notify_admit()
+
     def spec_stats(self) -> dict:
         """Lifetime speculative-decoding counters (zeros when off)."""
         p, a = self.spec_proposed, self.spec_accepted

@@ -160,6 +160,16 @@ class FleetObserver:
         self.dump_dir = cfg.dump_dir if cfg.dump_dir is not None \
             else (os.environ.get(ENV_FLEET_FLIGHT, "").strip() or None)
         self._headroom_cache: Optional[dict] = None
+        # fingerprint of the fleet shape the headroom cache priced:
+        # (slot count, sorted role multiset). Any drift — a spawned or
+        # tombstone-reused slot, a role flip — invalidates the cache,
+        # so signals() never reports pre-change headroom (satellite
+        # fix: the cache used to live forever).
+        self._headroom_shape: Optional[tuple] = None
+        # autoscale decision ring: one structured AutoscaleEvent record
+        # per FleetAutoscaler control decision, surfaced in signals()
+        # and in every correlated fleet flight dump
+        self.autoscale_events: "deque[dict]" = deque(maxlen=cfg.window)
 
     # -- clock ----------------------------------------------------------------
     def _wall(self, mono: float) -> float:
@@ -301,7 +311,11 @@ class FleetObserver:
         cfg = self.config
         if not cfg.model_cfg or cfg.hbm_gib is None:
             return None
-        if self._headroom_cache is not None:
+        shape = (len(router.replicas),
+                 tuple(sorted(str(getattr(e, "role", None))
+                              for e in router.replicas)))
+        if self._headroom_cache is not None \
+                and shape == self._headroom_shape:
             return self._headroom_cache
         try:
             import sys
@@ -327,12 +341,48 @@ class FleetObserver:
                     "fits": plan["fits"],
                 }
             self._headroom_cache = out
+            self._headroom_shape = shape
             return out
         except Exception:  # noqa: BLE001 — pricing is advisory
             logger.warning("fleet_obs: headroom pricing failed",
                            exc_info=True)
             self._headroom_cache = None
+            self._headroom_shape = None
             return None
+
+    # -- elastic fleet hooks (autoscaler / router mutation seams) -------------
+    def on_fleet_change(self, router, idx: Optional[int] = None) -> None:
+        """The fleet's shape changed: a replica was spawned
+        (``router.add_replica``), a dead slot was tombstone-reused, or
+        a role flipped (``router.set_role``). Drop the headroom cache
+        (satellite fix: it must never survive a count/role-set change)
+        and, when a slot was REUSED, reset that slot's signal ring and
+        flight-dump cursor — the new occupant must not inherit the old
+        engine's sample history (a tok/s delta across two different
+        engines is garbage). Never raises."""
+        try:
+            with self._lock:
+                self._headroom_cache = None
+                self._headroom_shape = None
+                if idx is not None and idx in self._rings:
+                    self._rings.pop(idx, None)
+                    self._seen_flight_dumps.pop(idx, None)
+        except Exception:  # noqa: BLE001 — observability must not wound
+            logger.warning("fleet_obs: fleet-change hook failed",
+                           exc_info=True)
+
+    def on_autoscale_event(self, event: dict) -> None:
+        """Record one structured autoscaler decision on the signal
+        ring (bounded by the window) — ``signals()`` surfaces the ring
+        and every correlated fleet flight dump carries it, so a
+        postmortem can replay WHY the fleet had the shape it had.
+        Never raises."""
+        try:
+            with self._lock:
+                self.autoscale_events.append(dict(event))
+        except Exception:  # noqa: BLE001 — observability must not wound
+            logger.warning("fleet_obs: autoscale event dropped",
+                           exc_info=True)
 
     # -- the stable signals() schema ------------------------------------------
     def signals(self, router) -> Dict[str, Any]:
@@ -352,6 +402,9 @@ class FleetObserver:
                             prefill:decode ratio), slo (finished-
                             weighted roll-up), headroom (mem_report
                             pricing or None), aggregate queue/run/tok
+          autoscale         FleetAutoscaler decision ring: one record
+                            per control decision (rule fired, action,
+                            outcome, signal snapshot), window-bounded
           dumps             correlated fleet flight dumps so far
         """
         with self._lock:
@@ -374,6 +427,7 @@ class FleetObserver:
                 "window": self.config.window,
                 "replicas": reps,
                 "fleet": derived,
+                "autoscale": [dict(e) for e in self.autoscale_events],
                 "dumps": [dict(d, record=None) if "record" in d
                           else dict(d) for d in self.dumps],
             }
@@ -468,6 +522,7 @@ class FleetObserver:
             "window": self.config.window,
             "router": rstate,
             "replicas": replicas,
+            "autoscale": [dict(e) for e in self.autoscale_events],
         }
 
     # -- fleet chrome-trace export --------------------------------------------

@@ -51,6 +51,17 @@ pools keep separate affinity maps: the prefill map routes arrivals to
 the replica holding their prompt prefix, the decode map keeps every
 hand-off of one prefix landing on the same decode replica.
 
+**Elastic fleet mutation** (ROADMAP item 2 rung c): the
+``serving/autoscaler.py`` control loop resizes and re-shapes the fleet
+through two seams — ``add_replica`` (spawn: dead slots are
+tombstone-reused before the replica list grows, so a long-running
+autoscaled fleet never accretes an unbounded dead tail) and
+``set_role`` (rebalance: drain → role re-validation on the idle engine
+→ re-admit under the new role, the drain manifest replaying
+same-role-first onto survivors). Scale-down is plain
+``decommission`` — every elastic action rides the same lossless
+manifest machinery as death.
+
 The router never touches engine internals beyond the documented failure
 contract; driving stays with the caller (``step_all`` round-robin, or
 one thread per replica calling ``engine.step()``).
@@ -160,6 +171,11 @@ class ReplicaRouter:
         # could miss a replacement and run the request twice)
         self._handoff_complete = [threading.Event()
                                   for _ in self.replicas]
+        # elastic fleet counters (autoscaler evidence): admissions via
+        # add_replica, and how many of them tombstone-reused a dead slot
+        # instead of growing the replica list
+        self.spawns = 0
+        self.reused_slots = 0
         self._lock = threading.RLock()
         # fleet observability plane (serving/fleet_obs.py): disarmed =
         # None, every armed-only seam below is one `is None` check. Its
@@ -589,6 +605,114 @@ class ReplicaRouter:
             self.fleet_obs.on_replica_event(self, idx, reason)
         return handles
 
+    # -- elastic fleet mutation (autoscaler seams) -----------------------------
+    def _purge_affinity_locked(self, idx: int) -> None:
+        """Drop every affinity registration still pointing at slot
+        ``idx`` from BOTH maps — a reused/flipped slot's new occupant
+        holds none of the old occupant's prefixes (its pool was swept
+        on abort, or it is a different engine entirely)."""
+        for amap in (self._affinity, self._decode_affinity):
+            for key in [k for k, v in amap.items() if v == idx]:
+                del amap[key]
+
+    def _rewire_locked(self, idx: int) -> None:
+        """(Re)wire slot ``idx`` into the role pools and the hand-off
+        plumbing to match its engine's current role."""
+        eng = self.replicas[idx]
+        role = getattr(eng, "role", None)
+        if idx in self.prefill_pool:
+            self.prefill_pool.remove(idx)
+        if idx in self.decode_pool:
+            self.decode_pool.remove(idx)
+        eng.handoff_sink = None
+        eng.step_hook = None
+        if role == "prefill":
+            self.prefill_pool.append(idx)
+            self.prefill_pool.sort()
+            eng.handoff_sink = functools.partial(
+                self._dispatch_handoff, idx)
+        elif role == "decode":
+            self.decode_pool.append(idx)
+            self.decode_pool.sort()
+            eng.step_hook = self._retry_pending_handoffs
+
+    def add_replica(self, engine) -> int:
+        """Admit a new replica into the live fleet (the autoscaler's
+        spawn seam). Dead slots are TOMBSTONE-REUSED before the replica
+        list grows — a long-running autoscaled fleet cycles through
+        spawn/retire without an unbounded dead tail — and a reused
+        slot's stale affinity registrations are purged (the new engine
+        holds none of those prefixes), its hand-off latch re-armed, and
+        its fleet-obs signal ring reset. Returns the slot index."""
+        if engine.pool.block_size != self.block_size:
+            raise ValueError(
+                f"replica block_size {engine.pool.block_size} != fleet "
+                f"block_size {self.block_size}: the affinity key is the "
+                "page-chain key, which is only comparable at one page "
+                "geometry")
+        role = getattr(engine, "role", None)
+        if self.disaggregated and role not in ("prefill", "decode"):
+            raise ValueError(
+                "a disaggregated fleet only admits role-carrying "
+                f"replicas (got role={role!r})")
+        if not self.disaggregated and role is not None:
+            raise ValueError(
+                f"a unified fleet only admits role-less replicas "
+                f"(got role={role!r})")
+        with self._lock:
+            idx = next((i for i, a in enumerate(self._alive) if not a),
+                       None)
+            if idx is None:
+                idx = len(self.replicas)
+                self.replicas.append(engine)
+                self._alive.append(True)
+                self._handoff_complete.append(threading.Event())
+            else:
+                self._purge_affinity_locked(idx)
+                self.replicas[idx] = engine
+                self._alive[idx] = True
+                self._handoff_complete[idx] = threading.Event()
+                self.reused_slots += 1
+            self._rewire_locked(idx)
+            self.spawns += 1
+        if self.fleet_obs is not None:
+            self.fleet_obs.on_fleet_change(self, idx)
+        return idx
+
+    def set_role(self, idx: int, role: str,
+                 deadline_s: Optional[float] = None) -> List:
+        """Flip replica ``idx`` between disaggregated roles (the
+        autoscaler's rebalance seam): drain it — its manifest replays
+        same-role-first onto survivors exactly like ``decommission`` —
+        re-validate the flip on the now-idle engine
+        (``engine.set_role``), then re-admit the slot under the new
+        role. Returns the drain hand-off's replacement handles. The
+        slot is never half-alive: a drain fault degrades to the death
+        salvage, and a re-validation failure leaves the slot retired
+        (dead, work already handed off) with the error re-raised."""
+        if not self.disaggregated:
+            raise ValueError("set_role needs a disaggregated fleet "
+                             "(role-less replicas have no role to flip)")
+        if role not in ("prefill", "decode"):
+            raise ValueError(f"unknown role {role!r} "
+                             "(want prefill|decode)")
+        with self._lock:
+            if not self._alive[idx]:
+                raise ValueError(f"replica {idx} is not alive")
+        eng = self.replicas[idx]
+        if getattr(eng, "role", None) == role:
+            return []
+        handles = self.decommission(idx, deadline_s=deadline_s)
+        eng.set_role(role)          # raising leaves the slot retired
+        with self._lock:
+            self._purge_affinity_locked(idx)
+            self._alive[idx] = True
+            self._handoff_complete[idx] = threading.Event()
+            self._rewire_locked(idx)
+        if self.fleet_obs is not None:
+            self.fleet_obs.on_fleet_change(self, idx)
+        return handles
+
     def _hand_off(self, manifest: dict, exclude: int,
                   reason: str) -> List:
         """Replay a dead/drained replica's manifest onto survivors,
@@ -673,6 +797,9 @@ class ReplicaRouter:
                 "policy": self.policy,
                 "replicas": len(self.replicas),
                 "alive": sum(alive),
+                "dead_slots": len(alive) - sum(alive),
+                "spawns": self.spawns,
+                "reused_slots": self.reused_slots,
                 "routed": {k: v for k, v in self.routed.items() if v},
                 "affinity_hits": self.affinity_hits,
                 "affinity_keys": len(self._affinity),
@@ -687,14 +814,14 @@ class ReplicaRouter:
                                      if alive[i]),
                         "queue_depth": sum(
                             self.replicas[i].sched.queue_depth()
-                            for i in self.prefill_pool)},
+                            for i in self.prefill_pool if alive[i])},
                     "decode": {
                         "replicas": list(self.decode_pool),
                         "alive": sum(1 for i in self.decode_pool
                                      if alive[i]),
                         "queue_depth": sum(
                             self.replicas[i].sched.queue_depth()
-                            for i in self.decode_pool)},
+                            for i in self.decode_pool if alive[i])},
                 }
                 router["kv_handoffs"] = dict(self.kv_handoffs)
         reps = []

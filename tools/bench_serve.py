@@ -608,6 +608,236 @@ def run_disagg_pair(seed: int, fast: bool):
     return rows
 
 
+def make_swing_workload(seed: int, n_base: int, base_rate: float,
+                        vocab: int, swing_start_s: float,
+                        swing_dur_s: float, swing_mult: float = 10.0,
+                        prompt=(6, 12), new=(10, 16)):
+    """Seeded open-loop schedule with a traffic SWING: a base Poisson
+    stream overlaid with a ``swing_mult``x-rate window (the ROADMAP
+    item-2(c) "10x traffic swing") of identically-shaped requests.
+    Every request carries a ``kind`` tag (steady|swing); the schedule
+    is fixed by the seed BEFORE either fleet runs, so the fixed-max
+    oracle and the autoscaled fleet face identical load."""
+    rng = np.random.default_rng(seed)
+    reqs = []
+
+    def stream(rate, t_start, t_end, kind):
+        t = t_start
+        while True:
+            t += float(rng.exponential(1.0 / rate))
+            if t >= t_end:
+                break
+            plen = int(rng.integers(prompt[0], prompt[1] + 1))
+            mnew = int(rng.integers(new[0], new[1] + 1))
+            reqs.append({"arrival_s": t, "kind": kind,
+                         "prompt": rng.integers(1, vocab,
+                                                (plen,)).tolist(),
+                         "max_new": mnew})
+
+    stream(base_rate, 0.0, n_base / base_rate, "steady")
+    stream(base_rate * swing_mult, swing_start_s,
+           swing_start_s + swing_dur_s, "swing")
+    reqs.sort(key=lambda r: r["arrival_s"])
+    return reqs
+
+
+def drive_elastic(workload, router, scaler, slo):
+    """Open-loop drive of one router with an optional ``FleetAutoscaler``
+    ticking between ``step_all`` passes (``scaler=None`` = the fixed
+    fleet oracle). Differences from ``drive_fleet``, both forced by
+    elasticity:
+
+      * a RETIRED replica's original handles terminate with
+        ``RequestFailed`` by design — each logical request resolves to
+        its FINAL handle (the hand-off records are chronological, last
+        replacement wins), and THAT must finish clean: zero parked or
+        lost, asserted per request;
+      * the artifact's cost metric is REPLICA-PASSES (live replicas
+        stepped, summed over passes) — engine-step sums can't price an
+        idle-but-provisioned fleet, which is exactly what autoscaling
+        exists to avoid — and the crc/attainment are computed offline
+        over final handles keyed by tag, so both fleets are scored by
+        one placement-independent rule."""
+    ttft_d, tpot_d = slo
+    pending = sorted(workload, key=lambda r: r["arrival_s"])
+    handles = []
+    replica_passes = 0
+    peak_alive = sum(router._alive)
+    t0 = time.monotonic()
+    i = 0
+    while i < len(pending) or router.has_work():
+        now = time.monotonic() - t0
+        while i < len(pending) and pending[i]["arrival_s"] <= now:
+            r = pending[i]
+            steady = r.get("kind") != "swing"
+            handles.append((r, router.submit(
+                r["prompt"], max_new_tokens=r["max_new"],
+                ttft_deadline=ttft_d if steady else None,
+                tpot_deadline=tpot_d if steady else None, tag=i)))
+            i += 1
+        if router.has_work():
+            router.step_all()
+            replica_passes += sum(router._alive)
+        elif i < len(pending):
+            time.sleep(min(pending[i]["arrival_s"] - now, 0.005))
+        if scaler is not None:
+            scaler.control()
+            peak_alive = max(peak_alive, sum(router._alive))
+    wall = time.monotonic() - t0
+    final = {}
+    for idx, (spec, req) in enumerate(handles):
+        final[idx] = (spec, req)
+    for rec in router.handoffs:
+        for h in rec["handles"]:
+            final[h.tag["tag"]] = (final[h.tag["tag"]][0], h)
+    tokens, crc = 0, 0
+    lats, tpots, met, tracked = [], [], 0, 0
+    for key in sorted(final):
+        spec, req = final[key]
+        assert req.done and req.error is None, \
+            f"request {key} parked/lost across the elastic fleet"
+        tokens += len(req.output)
+        crc = zlib.crc32(np.asarray(req.output, np.int32).tobytes(), crc)
+        lats.append((req.finished_at - t0) - spec["arrival_s"])
+        if spec.get("kind") != "swing" and len(req.output) > 1 \
+                and req.first_token_at is not None:
+            ttft = (req.first_token_at - t0) - spec["arrival_s"]
+            tpot = (req.finished_at - req.first_token_at) \
+                / (len(req.output) - 1)
+            tpots.append(tpot)
+            # offline SLO attainment over FINAL handles: the engine
+            # roll-up can't follow a request across a retire, and a
+            # tombstone-reused slot drops its predecessor's counts —
+            # the offline rule scores both fleets identically
+            tracked += 1
+            if (ttft_d is None or ttft <= ttft_d) and \
+                    (tpot_d is None or tpot <= tpot_d):
+                met += 1
+    row = {
+        "replicas_start": len([a for a in router._alive if a])
+        if scaler is None else None,
+        "requests": len(handles),
+        "output_tokens": int(tokens),
+        "wall_s": round(wall, 4),
+        "tokens_per_s": round(tokens / wall, 2),
+        "p99_latency_s": round(float(np.percentile(np.asarray(lats),
+                                                   99)), 4),
+        "steady_requests": tracked,
+        "decode_tpot_p50_s": round(_order_stat(tpots, 0.50), 5),
+        "decode_tpot_p99_s": round(_order_stat(tpots, 0.99), 5),
+        "replica_passes": int(replica_passes),
+        "peak_alive": int(peak_alive),
+        "slo_attainment": round(met / tracked, 6) if tracked else 1.0,
+        "output_crc32": crc,
+    }
+    if scaler is not None:
+        row["autoscaler"] = scaler.telemetry()
+        row["scale_events"] = [
+            {"tick": e.tick, "rule": e.rule, "action": e.action,
+             "outcome": e.outcome, "replica": e.replica}
+            for e in scaler.events]
+    return row
+
+
+def run_elastic_pair(seed: int, fast: bool):
+    """The elastic rows (ROADMAP item 2 rung c): ONE seeded 10x-swing
+    schedule driven through (a) the fixed-max ORACLE — a fleet frozen
+    at the autoscaler's max envelope, always-on capacity — and (b) the
+    AUTOSCALED fleet: starts at the min envelope, and the
+    ``FleetAutoscaler`` spawns replicas into the swing
+    (``add_replica``) and retires them through ``decommission`` as it
+    subsides, every retire replaying its drain manifest onto
+    survivors. The claim priced by the artifact: elasticity holds the
+    oracle's SLO attainment within tolerance while paying for FEWER
+    replica-passes, with >= 1 spawn and >= 1 retire mid-run, zero
+    requests parked or lost, and greedy output crc-equal to the
+    oracle — scaling moves work, never changes tokens."""
+    from paddle_tpu.serving import (AutoscalerConfig, EngineConfig,
+                                    FleetAutoscaler, FleetObsConfig,
+                                    ObsConfig, ReplicaRouter,
+                                    ServingEngine)
+    model = _build_router_model(fast)
+    vocab = model.config.vocab_size
+    if fast:
+        n_base, base_rate = 16, 20.0
+        swing_start, swing_dur = 0.25, 0.12
+        min_r, max_r = 1, 3
+        slo = (8.0, 2.0)               # generous CPU-fast deadlines
+        kw = {"max_seqs": 4, "token_budget": 24, "block_size": 8,
+              "num_blocks": 48}
+        scfg = dict(cooldown=6, drain_deadline_s=0.05)
+        tol = 0.15
+    else:
+        n_base, base_rate = 120, 40.0
+        swing_start, swing_dur = 0.8, 0.5
+        min_r, max_r = 2, 6
+        slo = (5.0, 0.05)
+        kw = {"max_seqs": 8, "token_budget": 48, "block_size": 8,
+              "num_blocks": 160}
+        scfg = dict(cooldown=12, drain_deadline_s=0.1)
+        tol = 0.05
+    workload = make_swing_workload(seed + 17, n_base, base_rate, vocab,
+                                   swing_start, swing_dur)
+    obs = lambda: ObsConfig(flight_steps=32, flight_requests=16)  # noqa: E731
+
+    def mk(role=None):
+        return ServingEngine(model, EngineConfig(obs=obs(), **kw))
+
+    def mk_router(n):
+        return ReplicaRouter([mk() for _ in range(n)], policy="affinity",
+                             seed=seed,
+                             fleet_obs=FleetObsConfig(window=256))
+
+    ServingEngineWarmup(model, kw)
+    # warm the open-loop path once (placement/replay programs compiled)
+    drive_elastic(make_swing_workload(seed + 18, 4, 200.0, vocab,
+                                      0.01, 0.01), mk_router(1), None,
+                  (None, None))
+    rows = {}
+    rows["elastic_oracle"] = drive_elastic(workload, mk_router(max_r),
+                                           None, slo)
+    router = mk_router(min_r)
+    scaler = FleetAutoscaler(router, engine_factory=mk,
+                             config=AutoscalerConfig(
+                                 min_replicas=min_r, max_replicas=max_r,
+                                 **scfg))
+    rows["elastic_autoscaled"] = drive_elastic(workload, router, scaler,
+                                               slo)
+    for name in ("elastic_oracle", "elastic_autoscaled"):
+        r = rows[name]
+        extra = ""
+        if "autoscaler" in r:
+            a = r["autoscaler"]
+            extra = (f"  spawns {a['spawns']} retires {a['retires']} "
+                     f"faults {a['faults']}")
+        print(f"[bench_serve] {name:18s}: {r['tokens_per_s']:8.1f} "
+              f"tok/s  slo {r['slo_attainment']:.2f}  replica-passes "
+              f"{r['replica_passes']:6d}  peak {r['peak_alive']}"
+              f"{extra}", flush=True)
+    ora, ela = rows["elastic_oracle"], rows["elastic_autoscaled"]
+    a = ela["autoscaler"]
+    assert a["spawns"] >= 1 and a["retires"] >= 1, \
+        f"the swing never exercised the autoscaler: {a}"
+    assert ela["output_crc32"] == ora["output_crc32"], \
+        "autoscaling changed greedy output"
+    assert ela["replica_passes"] < ora["replica_passes"], \
+        "the autoscaled fleet paid more replica-passes than always-max"
+    assert ela["slo_attainment"] >= ora["slo_attainment"] - tol, \
+        (f"autoscaled SLO attainment {ela['slo_attainment']} fell past "
+         f"tolerance {tol} under the oracle's {ora['slo_attainment']}")
+    rows["elastic_workload"] = {
+        "n_base": n_base, "base_rate_rps": base_rate,
+        "swing_start_s": swing_start, "swing_dur_s": swing_dur,
+        "swing_mult": 10.0, "poisson": True, "open_loop": True,
+        "engine": kw, "envelope": {"min": min_r, "max": max_r},
+        "slo": {"ttft_deadline_s": slo[0], "tpot_deadline_s": slo[1]}}
+    rows["elastic_replica_pass_ratio"] = round(
+        ela["replica_passes"] / max(ora["replica_passes"], 1), 3)
+    rows["elastic_slo_delta"] = round(
+        ela["slo_attainment"] - ora["slo_attainment"], 6)
+    return rows
+
+
 def _build_router_model(fast: bool):
     """The router rows' own tiny model: same geometry as the fast bench
     model but with a LONGER position budget in full mode — the scale-out
@@ -822,7 +1052,8 @@ def run_bench(fast: bool = True, seed: int = 0, tag: str = "fast",
               n_requests: int = None, rate: float = None,
               out_path: str = None, spec: bool = False,
               num_draft_tokens: int = 4, slo=None, chaos: bool = False,
-              router: bool = False, disagg: bool = False):
+              router: bool = False, disagg: bool = False,
+              elastic: bool = False):
     model = _build_model(fast)
     vocab = model.config.vocab_size
     if fast:
@@ -932,6 +1163,15 @@ def run_bench(fast: bool = True, seed: int = 0, tag: str = "fast",
         for key in ("disagg_workload", "disagg_unified", "disagg_split",
                     "disagg_tpot_p99_ratio", "disagg_goodput_ratio"):
             result[key] = drows[key]
+    if elastic:
+        # elastic rows: one seeded 10x-swing schedule, fixed-max oracle
+        # vs the autoscaled fleet — SLO held within tolerance at fewer
+        # replica-passes, >= 1 spawn + retire, crc equality, zero parked
+        erows = run_elastic_pair(seed, fast)
+        for key in ("elastic_workload", "elastic_oracle",
+                    "elastic_autoscaled", "elastic_replica_pass_ratio",
+                    "elastic_slo_delta"):
+            result[key] = erows[key]
     if out_path is None:
         out_path = os.path.join(HERE, f"BENCH_SERVE_{tag}.json")
     tmp = out_path + ".tmp"
@@ -949,6 +1189,10 @@ def run_bench(fast: bool = True, seed: int = 0, tag: str = "fast",
                    f"{result['disagg_tpot_p99_ratio']}"
                    f" disagg_goodput_ratio="
                    f"{result['disagg_goodput_ratio']}")
+    if elastic:
+        ratios += (f" elastic_replica_pass_ratio="
+                   f"{result['elastic_replica_pass_ratio']}"
+                   f" elastic_slo_delta={result['elastic_slo_delta']}")
     print(f"[bench_serve] {ratios}  -> {out_path}", flush=True)
     return result
 
@@ -989,6 +1233,11 @@ def main(argv=None):
                          "unified vs prefill/decode split fleets "
                          "(KV-page handoff over the router) on a "
                          "bursty-prompt schedule")
+    ap.add_argument("--elastic", action="store_true",
+                    help="add the elastic rows: fixed-max oracle vs the "
+                         "FleetAutoscaler-driven fleet on a seeded "
+                         "10x-traffic-swing schedule (spawn into the "
+                         "swing, lossless retire out of it)")
     ap.add_argument("--draft-tokens", type=int, default=4,
                     help="per-sequence draft budget k for --spec")
     ap.add_argument("--out", default=None)
@@ -998,10 +1247,12 @@ def main(argv=None):
                     n_requests=args.requests, rate=args.rate,
                     out_path=args.out, spec=args.spec,
                     num_draft_tokens=args.draft_tokens, chaos=args.chaos,
-                    router=args.router, disagg=args.disagg)
+                    router=args.router, disagg=args.disagg,
+                    elastic=args.elastic)
     ok = res["vs_static"] > 1.0 and res.get("vs_nonspec", 2.0) > 1.0 \
         and res.get("router_vs_single", 2.0) > 1.0 \
-        and res.get("disagg_tpot_p99_ratio", 2.0) > 1.0
+        and res.get("disagg_tpot_p99_ratio", 2.0) > 1.0 \
+        and res.get("elastic_replica_pass_ratio", 0.5) < 1.0
     return 0 if ok else 1
 
 

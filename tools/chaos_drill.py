@@ -1109,6 +1109,192 @@ def run_fleet_obs_drill(seed: int = 1234, verbose: bool = True):
     return report
 
 
+def run_elastic_drill(seed: int = 1234, verbose: bool = True):
+    """Seeded elastic-control-plane drill (serving/autoscaler.py) over
+    a 10x traffic ramp. One deterministic pass-indexed schedule (steady
+    arrivals, then a 10x-rate swing window) drives a unified fleet that
+    starts at the min envelope (1 replica, max 2) under a
+    ``FleetAutoscaler`` whose cooldowns are tick-based — with
+    ``round_robin`` routing and a zero-grace drain deadline there is NO
+    wall-clock anywhere in the decision loop, so the whole run is
+    bit-reproducible per seed. Three teeth:
+
+      * SPAWN FAULT => BACKOFF-AND-HOLD: an ``elastic.spawn`` chaos
+        fault kills the FIRST spawn attempt mid-ramp — the autoscaler
+        must degrade to the current fleet (recorded ``fault`` event,
+        fleet size unchanged, hold-down armed, ``backoff_hold`` events
+        while it lasts), never raising into ``step_all``, and then
+        spawn clean once the hold-down expires;
+      * RETIRE-DURING-BURST IS LOSSLESS: as the swing subsides the
+        autoscaler retires a replica while it still holds live work —
+        the decommission manifest must replay onto the survivor
+        (``replayed >= 1``), and every request (original or
+        replacement) must finish with the fault-free oracle's exact
+        greedy tokens: zero parked, zero lost;
+      * STABLE PER SEED: the drill runs twice and the stable report
+        subset — the full (tick, rule, action, outcome, replica) event
+        sequence, the controller counters, the fired fault sites and
+        both output crcs — must be bit-identical.
+    """
+    import zlib
+
+    import numpy as np
+
+    from paddle_tpu.resilience import chaos
+    from paddle_tpu.serving import (AutoscalerConfig, EngineConfig,
+                                    FleetAutoscaler, FleetObsConfig,
+                                    ReplicaRouter, ServingEngine)
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(repo, "tests"))
+    import serve_worker
+
+    model = serve_worker.build_model(seed)
+    rng = np.random.default_rng(seed)
+    max_new = 6
+    # pass-indexed arrival schedule: 1 request every other pass for 10
+    # passes (the steady base), then 5 per pass for 6 passes (the 10x
+    # swing) — fixed by the seed before either fleet runs
+    schedule = {}
+    tag = 0
+    for p in range(0, 10, 2):
+        schedule[p] = [tag]
+        tag += 1
+    for p in range(10, 22):
+        schedule[p] = list(range(tag, tag + 5))
+        tag += 5
+    # post-swing steady tail: traffic settles back to the base rate, so
+    # the drain-out retire fires while the victim still carries work
+    for p in range(22, 80, 2):
+        schedule[p] = [tag]
+        tag += 1
+    prompts = [rng.integers(1, 61, (int(rng.integers(8, 13)),)).tolist()
+               for _ in range(tag)]
+
+    def mk():
+        return ServingEngine(model, EngineConfig(
+            max_seqs=4, token_budget=24, block_size=8, num_blocks=64))
+
+    def run(elastic: bool, fault: bool):
+        n0 = 1 if elastic else 2
+        router = ReplicaRouter([mk() for _ in range(n0)],
+                               policy="round_robin", seed=seed,
+                               fleet_obs=FleetObsConfig(window=64))
+        scaler = None
+        if elastic:
+            scaler = FleetAutoscaler(router, engine_factory=lambda r: mk(),
+                                     config=AutoscalerConfig(
+                                         min_replicas=1, max_replicas=2,
+                                         scale_up_pressure=4.0,
+                                         scale_down_pressure=3.0,
+                                         cooldown=1000, backoff=3,
+                                         drain_deadline_s=0.0))
+        plan = None
+        if fault:
+            plan = chaos.FaultPlan(seed=seed).add("elastic.spawn",
+                                                  "error", at=(1,))
+            chaos.install_plan(plan)
+        handles = {}
+        try:
+            p = 0
+            while p < 80 or router.has_work():
+                for t in schedule.get(p, ()):
+                    handles[t] = router.submit(prompts[t],
+                                               max_new_tokens=max_new,
+                                               tag=t)
+                router.step_all()
+                if scaler is not None:
+                    scaler.control()
+                p += 1
+                assert p < 500, "elastic drill never drained"
+        finally:
+            if fault:
+                chaos.clear_plan()
+        return router, scaler, handles, plan, p
+
+    # -- fault-free oracle: the fixed-max fleet's greedy tokens ---------------
+    router, _, handles, _, _ = run(elastic=False, fault=False)
+    oracle = {t: h.result(0) for t, h in handles.items()}
+    oracle_crc = zlib.crc32(np.asarray(
+        [tok for t in sorted(oracle) for tok in oracle[t]],
+        np.int64).tobytes())
+
+    def elastic_run():
+        router, scaler, handles, plan, passes = run(elastic=True,
+                                                    fault=True)
+        # the spawn fault fired exactly once and degraded, not raised
+        assert [f[0] for f in plan.fired] == ["elastic.spawn"], \
+            "the spawn fault never fired — drill lost its teeth"
+        outs = [(e.rule, e.action, e.outcome) for e in scaler.events]
+        spawn_outs = [o for _, a, o in outs if a == "spawn"]
+        assert spawn_outs[0] == "fault", \
+            f"first spawn attempt should fault: {spawn_outs}"
+        assert "backoff_hold" in spawn_outs, \
+            f"no hold-down after the faulted spawn: {spawn_outs}"
+        assert spawn_outs[-1] == "ok", \
+            f"the fleet never scaled after backoff: {spawn_outs}"
+        fault_evt = next(e for e in scaler.events
+                         if e.outcome == "fault")
+        assert fault_evt.signal["alive"] == 1, \
+            "faulted spawn must leave the current fleet serving"
+        assert scaler.spawns == 1 and scaler.faults == 1, \
+            scaler.telemetry()
+        # the retire fired during the drain-out and replayed live work
+        assert scaler.retires == 1, scaler.telemetry()
+        retire_evt = next(e for e in scaler.events
+                          if e.action == "retire" and e.outcome == "ok")
+        assert retire_evt.detail["replayed"] >= 1, \
+            "retire-during-burst handed off no work — the lossless " \
+            "claim went untested"
+        assert len(router.handoffs) == 1 and \
+            router.handoffs[0]["reason"] == "drain"
+        # zero parked or lost: every request's FINAL handle finished
+        # clean with the oracle's exact greedy tokens
+        final = dict(handles)
+        for rec in router.handoffs:
+            for h in rec["handles"]:
+                final[h.tag["tag"]] = h
+        merged = {}
+        for t, h in final.items():
+            assert h.done, f"request {t} parked across the scale-down"
+            assert h.error is None, f"request {t} lost: {h.error}"
+            merged[t] = h.result(0)
+        assert merged == oracle, "elastic outputs diverged from the " \
+            "fixed-fleet oracle"
+        return {
+            "events": [[e.tick, e.rule, e.action, e.outcome, e.replica]
+                       for e in scaler.events],
+            "spawns": scaler.spawns, "retires": scaler.retires,
+            "faults": scaler.faults,
+            "fired": [list(f) for f in plan.fired],
+            "retire_replayed": retire_evt.detail["replayed"],
+            "alive_at_end": sum(router._alive),
+            "passes": passes,
+            "replay_crc": zlib.crc32(np.asarray(
+                [tok for t in sorted(merged) for tok in merged[t]],
+                np.int64).tobytes()),
+            "oracle_crc": oracle_crc,
+        }
+
+    first = elastic_run()
+    second = elastic_run()
+    assert first == second, \
+        f"elastic drill not stable per seed:\n{first}\nvs\n{second}"
+    assert first["replay_crc"] == first["oracle_crc"]
+
+    report = {"seed": seed, "ok": True, "stable": first}
+    if verbose:
+        print(f"elastic drill (seed={seed}): spawn #1 faulted and "
+              f"degraded to backoff-and-hold ({first['faults']} fault, "
+              f"fleet held at 1), spawn #2 scaled into the swing, "
+              f"retire replayed {first['retire_replayed']} live "
+              f"request(s) onto the survivor, all "
+              f"{len(oracle)} requests finished with oracle-exact "
+              f"tokens in {first['passes']} passes, bit-identical "
+              "across a double run — elastic control plane verified")
+    return report
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seed", type=int, default=1234)
@@ -1147,6 +1333,12 @@ def main(argv=None):
                          "(armed-quiet run => zero dumps; seeded "
                          "replica death => exactly one dump naming the "
                          "dead replica, stable per seed)")
+    ap.add_argument("--elastic", action="store_true",
+                    help="run the elastic-control-plane drill (spawn "
+                         "fault during the 10x ramp degrades to "
+                         "backoff-and-hold; retire-during-burst "
+                         "replays its manifest onto survivors; stable "
+                         "per seed)")
     args = ap.parse_args(argv)
     if args.preempt:
         report = run_preempt_drill(seed=args.seed, verbose=not args.json,
@@ -1165,6 +1357,9 @@ def main(argv=None):
     elif args.fleet_obs:
         report = run_fleet_obs_drill(seed=args.seed,
                                      verbose=not args.json)
+    elif args.elastic:
+        report = run_elastic_drill(seed=args.seed,
+                                   verbose=not args.json)
     else:
         report = run_drill(seed=args.seed, verbose=not args.json)
     if args.json:

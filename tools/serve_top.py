@@ -102,8 +102,10 @@ def render_fleet_signals(sig: dict, prev: dict = None) -> str:
     snapshot (schema ``fleet_signals`` — what ``PADDLE_FLEET_TELEMETRY``
     streams): per-role pressure + the prefill:decode ratio, the
     finished-weighted fleet SLO roll-up, mem_report-priced headroom,
-    per-replica sparklines straight from the signal ring, and the last
-    correlated fleet flight dump."""
+    per-replica sparklines straight from the signal ring, the last
+    correlated fleet flight dump, and the autoscaler's recent decisions
+    (action/outcome counts + the last three events) off the
+    ``autoscale`` ring."""
     fleet = sig.get("fleet", {})
     lines = [
         f"fleet signal bus — pass {sig.get('passes', 0)} "
@@ -164,6 +166,22 @@ def render_fleet_signals(sig: dict, prev: dict = None) -> str:
             f"(origin r{last.get('origin')}) -> {where}")
     else:
         lines.append("fleet dumps 0")
+    scale = sig.get("autoscale", ())
+    if scale:
+        n = {}
+        for e in scale:
+            k = (e.get("action"), e.get("outcome"))
+            n[k] = n.get(k, 0) + 1
+        counts = "  ".join(f"{a}/{o} {c}" for (a, o), c in sorted(n.items()))
+        lines.append(f"autoscale {len(scale)} decisions  {counts}")
+        for e in scale[-3:]:
+            who = "" if e.get("replica") is None else f" r{e['replica']}"
+            why = e.get("reason") or e.get("rule")
+            lines.append(
+                f"  tick {e.get('tick', '?'):>4}  {e.get('rule')} -> "
+                f"{e.get('action')}{who} [{e.get('outcome')}] {why}")
+    else:
+        lines.append("autoscale 0 decisions (attach a FleetAutoscaler)")
     return "\n".join(lines) + "\n"
 
 
