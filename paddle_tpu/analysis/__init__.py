@@ -1,10 +1,12 @@
 """Static analysis + trace sanitation: catch TPU sharp bits before a run.
 
-Three cooperating passes (driven together by ``tools/lint.py``):
+Four cooperating passes (driven together by ``tools/lint.py``):
 
 * ``analysis.astlint`` / ``analysis.rules`` / ``analysis.shard_rules``
-  — stdlib-only AST linting of the framework's machine-checkable
-  invariants, including the sharding/layout surface.
+  / ``analysis.concur_rules`` — stdlib-only AST linting of the
+  framework's machine-checkable invariants, including the
+  sharding/layout surface and the serving tier's concurrency +
+  request-lifecycle discipline.
 * ``analysis.tracecheck`` — dynamic: traces a step function and flags
   recompile hazards, host syncs, wasted donations, and (with per-rank
   schedules captured by ``analysis.schedule``) cross-rank collective
@@ -13,6 +15,10 @@ Three cooperating passes (driven together by ``tools/lint.py``):
   function under ``jax.eval_shape`` with sharding-annotated inputs (no
   devices needed) and reports divisibility violations, implicit-reshard
   hotspots, and a per-op layout report diffed against a baseline.
+* ``analysis.concurcheck`` — concurrency-registry coherence: proves the
+  lock-order/lifecycle registries the CCY rules parse are internally
+  coherent and byte-identical to what the runtime ordered-lock twin
+  (``serving.locking``, armed via ``PADDLE_LOCKCHECK``) enforces.
 
 Rule families (every id is greppable from this one table):
 
@@ -36,6 +42,15 @@ SHD1xx   static sharding/layout: unknown or duplicated mesh axes,
          mesh facts, donation/sharding mismatches
 SHD2xx   abstract layout evaluation: sharded-dim divisibility, implicit
          reshard traffic over threshold, layout-report baseline drift
+CCY1xx   serving concurrency: lock-order violations and foreign-lock
+         grabs against serving/locking.py LOCK_ORDER, unguarded
+         lock-protected writes, blocking calls under a lock,
+         raise-into-driver telemetry paths, unguarded plane seams
+CCY2xx   request lifecycle: state assignments outside
+         scheduler.REQUEST_TRANSITIONS, terminal resolutions without
+         exactly one terminal trace event
+CCY5xx   concurrency-registry coherence: incoherent lock/lifecycle
+         registries, static/runtime ordered-lock drift
 ======== ====================================================================
 
 The linter half (TPU/SHD1xx) is stdlib-only; the trace half (TRC) needs
@@ -45,10 +60,14 @@ editors and CI.
 """
 from __future__ import annotations
 
+from . import concurcheck  # noqa: F401  (stdlib-only)
 from . import schedule  # noqa: F401  (stdlib-only)
 from . import shardcheck  # noqa: F401  (stdlib-only at import time)
 from .astlint import (iter_python_files, lint_file, lint_paths,  # noqa: F401
                       lint_source)
+from .concur_rules import (load_lock_order,  # noqa: F401
+                           load_request_transitions)
+from .concurcheck import CONCUR_RULES, concur_check  # noqa: F401
 from .rules import (RULES, Finding, get_rule,  # noqa: F401
                     load_chaos_sites, load_flag_registry,
                     load_metric_catalog, rule_table)
@@ -60,8 +79,9 @@ __all__ = [
     "Finding", "RULES", "get_rule", "rule_table",
     "lint_source", "lint_file", "lint_paths", "iter_python_files",
     "load_chaos_sites", "load_flag_registry", "load_metric_catalog",
-    "load_known_axes",
+    "load_known_axes", "load_lock_order", "load_request_transitions",
     "SHARD_RULES", "layout_check", "layout_report", "shardcheck",
+    "CONCUR_RULES", "concur_check", "concurcheck",
     "schedule", "trace_check", "check_collective_schedules", "TRACE_RULES",
 ]
 

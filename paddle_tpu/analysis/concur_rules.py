@@ -1,0 +1,1010 @@
+"""CCY1xx/2xx — static concurrency + lifecycle rules (concurcheck).
+
+The serving tier coordinates four RLocks with a declared partial order,
+a never-raise-into-``step_all`` dump discipline, a one-``is None``-check
+disarm convention, and a WAITING/RUNNING/HANDOFF/FINISHED request state
+machine. Every one of those invariants used to be enforced only by
+tests and reviewer memory — PR 17's autoscaler reaching straight into
+``router._lock`` is exactly the drift that accumulates. These rules
+make the machine-checkable subset a lint gate.
+
+Ground truth is read statically (``ast.literal_eval`` — no jax, no
+imports at lint time, the same contract as the chaos-site/metric/axis
+rules):
+
+  * ``serving/locking.py`` — ``LOCK_ORDER`` (the declared partial
+    order, outermost first), ``LOCK_OWNERS`` (class -> lock name, how
+    ``with self._lock`` resolves), ``LOCK_BEARERS`` (variable/attribute
+    spellings -> lock name, how ``with eng._lock`` resolves) and
+    ``LOCK_CORE_MODULES`` (the serving files blessed to take another
+    component's private lock directly). The runtime twin
+    (``locking.OrderedLock``, armed via ``PADDLE_LOCKCHECK``) reads the
+    SAME registry, so the static and dynamic halves cannot drift
+    (test-pinned).
+  * ``serving/scheduler.py`` — ``REQUEST_TRANSITIONS``, the canonical
+    request-lifecycle table ("new" is the pre-lifecycle pseudo-state a
+    fresh Request is born from).
+
+Rules (all framework-only; suppress a line with
+``# tpu-lint: disable=CCY101``):
+
+  CCY101  lock-order-violation / foreign-lock-grab — a nested
+          ``with X._lock`` under ``with Y._lock`` (including one level
+          of same-file call-graph resolution) whose edge contradicts
+          LOCK_ORDER; or a serving module outside LOCK_CORE_MODULES
+          grabbing another component's private ``_lock`` directly.
+  CCY102  unguarded-attr-write — an attribute a lock-owning class
+          assigns under ``with self._lock`` written from a public
+          method outside the lock.
+  CCY103  blocking-call-under-lock — ``time.sleep``, argless
+          ``.join()``, store ops, ``block_until_ready``, ``.item()``
+          while holding a lock.
+  CCY104  raise-into-driver — a dump/telemetry/record path reachable
+          from ``step()``/``step_all()`` (or bearing a canonical
+          never-raise seam name) whose body is not exception-contained.
+  CCY105  unguarded-plane-seam — an observer/memwatch/fleet-obs seam
+          calling an ``on_*``/``record_*``/``note_*``/``write_*``
+          method without the single ``is None`` disarm guard.
+  CCY201  illegal-state-transition — a ``req.state = ...`` assignment
+          outside REQUEST_TRANSITIONS, or a terminal finish/fail path
+          with zero or two terminal trace events (the exactly-one
+          terminal-event contract).
+
+Registered into ``rules.RULES`` on import (rules.py imports this module
+at the bottom of its own body, after shard_rules).
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import os
+from typing import Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
+
+from .rules import (RULES, FileContext, _finding, _literal_from_source,
+                    _own_body_walk, _PKG_ROOT, _register)
+
+__all__ = ["load_lock_order", "load_lock_owners", "load_lock_bearers",
+           "load_lock_core_modules", "load_request_transitions"]
+
+
+# -- static ground-truth readers ----------------------------------------------
+@functools.lru_cache(maxsize=1)
+def _lock_registry():
+    path = os.path.join(_PKG_ROOT, "serving", "locking.py")
+    return (tuple(_literal_from_source(path, "LOCK_ORDER")),
+            dict(_literal_from_source(path, "LOCK_OWNERS")),
+            dict(_literal_from_source(path, "LOCK_BEARERS")),
+            tuple(_literal_from_source(path, "LOCK_CORE_MODULES")))
+
+
+def load_lock_order() -> Tuple[str, ...]:
+    """The declared lock partial order (outermost first), read
+    statically from serving/locking.py's LOCK_ORDER registry."""
+    return _lock_registry()[0]
+
+
+def load_lock_owners() -> Dict[str, str]:
+    """class name -> lock name (how ``with self._lock`` resolves)."""
+    return dict(_lock_registry()[1])
+
+
+def load_lock_bearers() -> Dict[str, str]:
+    """variable/attribute spelling -> lock name (how ``with
+    eng._lock`` / ``with self.router._lock`` resolve)."""
+    return dict(_lock_registry()[2])
+
+
+def load_lock_core_modules() -> Tuple[str, ...]:
+    """Serving modules blessed to take another component's private
+    lock directly."""
+    return _lock_registry()[3]
+
+
+@functools.lru_cache(maxsize=1)
+def load_request_transitions() -> Dict[str, Tuple[str, ...]]:
+    """The canonical request-lifecycle table, read statically from
+    serving/scheduler.py's REQUEST_TRANSITIONS."""
+    path = os.path.join(_PKG_ROOT, "serving", "scheduler.py")
+    table = _literal_from_source(path, "REQUEST_TRANSITIONS")
+    return {k: tuple(v) for k, v in table.items()}
+
+
+def _rank() -> Dict[str, int]:
+    order = load_lock_order()
+    return {name: i for i, name in enumerate(order)}
+
+
+def _is_serving_path(path: str) -> bool:
+    return "/serving/" in os.path.abspath(path).replace(os.sep, "/")
+
+
+# -- lock-expression resolution -----------------------------------------------
+def _bearer_tail(node) -> Optional[str]:
+    """The name a lock-holding expression is spelled through:
+    ``eng`` -> "eng", ``self.router`` -> "router",
+    ``self.replicas[i]`` -> "replicas"."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _lock_base(expr) -> Optional[ast.AST]:
+    """The holder expression of a ``<holder>._lock`` spelling (the
+    with-item form every serving lock acquisition uses)."""
+    if isinstance(expr, ast.Attribute) and expr.attr == "_lock":
+        return expr.value
+    return None
+
+
+class _FileInfo:
+    """Per-file concurrency facts shared by the CCY checkers (computed
+    once per FileContext, cached on the ctx object)."""
+
+    def __init__(self, ctx: FileContext):
+        self.owners = load_lock_owners()
+        self.bearers = load_lock_bearers()
+        # enclosing class for every function defined directly in a
+        # class body (methods), by node identity
+        self.class_of: Dict[int, str] = {}
+        self.classes: List[ast.ClassDef] = []
+        for node in ctx.nodes():
+            if isinstance(node, ast.ClassDef):
+                self.classes.append(node)
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        self.class_of[id(item)] = node.name
+        self.functions = ctx.functions()
+        # function name -> lock names its own body acquires (for the
+        # one-level call-graph resolution in CCY101)
+        self.acquired_by_name: Dict[str, Set[str]] = {}
+        for fn in self.functions:
+            acq = set()
+            for n in _own_body_walk(fn):
+                if isinstance(n, ast.With):
+                    for item in n.items:
+                        name = self.resolve_lock(item.context_expr, fn)
+                        if name is not None:
+                            acq.add(name)
+            if acq:
+                self.acquired_by_name.setdefault(fn.name, set()).update(acq)
+
+    def resolve_lock(self, expr, fn) -> Optional[str]:
+        """LOCK_ORDER name for a with-item context expression, or None
+        when it is not a recognizable ordered-lock acquisition."""
+        base = _lock_base(expr)
+        if base is None:
+            return None
+        if isinstance(base, ast.Name):
+            if base.id == "self":
+                cls = self.class_of.get(id(fn))
+                return self.owners.get(cls) if cls else None
+            # one level of local-binding resolution: eng = self.replicas[i]
+            for n in _own_body_walk(fn):
+                if isinstance(n, ast.Assign) and len(n.targets) == 1 and \
+                        isinstance(n.targets[0], ast.Name) and \
+                        n.targets[0].id == base.id:
+                    tail = _bearer_tail(n.value)
+                    if tail is not None and tail in self.bearers:
+                        return self.bearers[tail]
+            return self.bearers.get(base.id)
+        tail = _bearer_tail(base)
+        return self.bearers.get(tail) if tail is not None else None
+
+
+def _info(ctx: FileContext) -> _FileInfo:
+    cached = getattr(ctx, "_ccy_info", None)
+    if cached is None:
+        cached = _FileInfo(ctx)
+        ctx._ccy_info = cached
+    return cached
+
+
+# =============================================================================
+# CCY101 — lock order / lock encapsulation
+# =============================================================================
+@_register(
+    "CCY101", "lock-order-violation",
+    "nested lock acquisition contradicting serving/locking.py "
+    "LOCK_ORDER, or a private component lock grabbed outside the "
+    "serving lock core",
+    "the declared order (outermost first) is serving.locking.LOCK_ORDER "
+    "(fleet_obs -> router -> engine -> observer): acquire strictly "
+    "inner locks only, or release before re-entering an outer one. "
+    "Outside the core modules (engine/router/obs/fleet_obs), never take "
+    "another component's ._lock directly — call a public seam on the "
+    "owner (e.g. router.live_by_role()) so the owner keeps its own "
+    "critical sections. PADDLE_LOCKCHECK=1 arms the runtime twin "
+    "(locking.OrderedLock) that catches the same inversions live.",
+    framework_only=True)
+def _check_lock_order(ctx: FileContext):
+    rule = RULES["CCY101"]
+    info = _info(ctx)
+    rank = _rank()
+    core = load_lock_core_modules()
+    serving = _is_serving_path(ctx.path)
+    base_name = os.path.basename(ctx.path)
+    out: List = []
+
+    def edge_findings(held: List[str], acq: str, node, via: str = ""):
+        for h in held:
+            if h != acq and rank[h] >= rank[acq]:
+                suffix = f" (via call to {via}())" if via else ""
+                out.append(_finding(
+                    rule, ctx, node,
+                    f"acquires lock '{acq}' while holding '{h}'"
+                    f"{suffix}: contradicts LOCK_ORDER "
+                    f"({' -> '.join(load_lock_order())})"))
+
+    def check_calls(node, held: List[str]):
+        # one level of same-file call-graph resolution while holding
+        if not held or node is None:
+            return
+        for call in ast.walk(node):
+            if not isinstance(call, ast.Call):
+                continue
+            f = call.func
+            callee = None
+            if isinstance(f, ast.Name):
+                callee = f.id
+            elif isinstance(f, ast.Attribute) and \
+                    isinstance(f.value, ast.Name) and \
+                    f.value.id in ("self", "cls"):
+                callee = f.attr
+            if callee is None:
+                continue
+            for acq in sorted(info.acquired_by_name.get(callee, ())):
+                edge_findings(held, acq, call, via=callee)
+
+    for fn in info.functions:
+        def visit(stmts, held: List[str]):
+            for st in stmts:
+                if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef)):
+                    continue
+                if isinstance(st, ast.With):
+                    inner = list(held)
+                    for item in st.items:
+                        name = info.resolve_lock(item.context_expr, fn)
+                        if name is None:
+                            continue
+                        base = _lock_base(item.context_expr)
+                        foreign = not (isinstance(base, ast.Name) and
+                                       base.id == "self")
+                        if foreign and serving and base_name not in core:
+                            out.append(_finding(
+                                rule, ctx, item.context_expr,
+                                f"grabs component lock '{name}' directly "
+                                f"from {base_name} (outside the serving "
+                                f"lock core): use a public seam on the "
+                                f"owning object"))
+                        edge_findings(inner, name, item.context_expr)
+                        if name not in inner:
+                            inner.append(name)
+                    visit(st.body, inner)
+                elif isinstance(st, (ast.If, ast.While)):
+                    check_calls(st.test, held)
+                    visit(st.body, held)
+                    visit(st.orelse, held)
+                elif isinstance(st, ast.For):
+                    check_calls(st.iter, held)
+                    visit(st.body, held)
+                    visit(st.orelse, held)
+                elif isinstance(st, ast.Try):
+                    visit(st.body, held)
+                    for h in st.handlers:
+                        visit(h.body, held)
+                    visit(st.orelse, held)
+                    visit(st.finalbody, held)
+                else:
+                    check_calls(st, held)
+
+        visit(fn.body, [])
+    return out
+
+
+# =============================================================================
+# CCY102 — guarded attributes leave the lock
+# =============================================================================
+def _self_attr_writes(stmt) -> Iterable[Tuple[ast.AST, str]]:
+    """(node, attr) for every ``self.<attr>`` assignment target in one
+    statement (plain, augmented, annotated, tuple-unpacked)."""
+    targets: List[ast.AST] = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    for t in targets:
+        if isinstance(t, (ast.Tuple, ast.List)):
+            stack = list(t.elts)
+        else:
+            stack = [t]
+        for el in stack:
+            if isinstance(el, ast.Attribute) and \
+                    isinstance(el.value, ast.Name) and el.value.id == "self":
+                yield el, el.attr
+
+
+def _is_self_lock_item(expr) -> bool:
+    base = _lock_base(expr)
+    return isinstance(base, ast.Name) and base.id == "self"
+
+
+@_register(
+    "CCY102", "unguarded-attr-write",
+    "attribute a lock-owning class assigns under `with self._lock` "
+    "written from a public method outside the lock",
+    "every attribute a class mutates under its own lock is part of that "
+    "lock's protected state: public entry points must re-enter "
+    "`with self._lock:` before writing it (private helpers are assumed "
+    "to run under a caller's lock — the engine/scheduler convention).",
+    framework_only=True)
+def _check_guarded_attr_writes(ctx: FileContext):
+    rule = RULES["CCY102"]
+    info = _info(ctx)
+    out: List = []
+    for cls in info.classes:
+        methods = [n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        init = next((m for m in methods if m.name == "__init__"), None)
+        if init is None:
+            continue
+        owns_lock = any(
+            attr == "_lock"
+            for st in _own_body_walk(init)
+            for _, attr in _self_attr_writes(st))
+        if not owns_lock:
+            continue
+        # the lock-protected attribute set: everything any method of
+        # this class assigns under `with self._lock`
+        guarded: Set[str] = set()
+        for m in methods:
+            for w in _own_body_walk(m):
+                if not isinstance(w, ast.With) or \
+                        not any(_is_self_lock_item(i.context_expr)
+                                for i in w.items):
+                    continue
+                for st in w.body:
+                    stack = [st]
+                    while stack:
+                        n = stack.pop()
+                        if isinstance(n, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)):
+                            continue
+                        for _, attr in _self_attr_writes(n):
+                            guarded.add(attr)
+                        stack.extend(ast.iter_child_nodes(n))
+        guarded.discard("_lock")
+        if not guarded:
+            continue
+        for m in methods:
+            if m.name.startswith("_"):
+                continue              # private: runs under a caller's lock
+
+            def visit(stmts, locked: bool):
+                for st in stmts:
+                    if isinstance(st, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef, ast.ClassDef)):
+                        continue
+                    if isinstance(st, ast.With):
+                        inner = locked or any(
+                            _is_self_lock_item(i.context_expr)
+                            for i in st.items)
+                        visit(st.body, inner)
+                        continue
+                    if not locked:
+                        for node, attr in _self_attr_writes(st):
+                            if attr in guarded:
+                                out.append(_finding(
+                                    rule, ctx, node,
+                                    f"public {cls.name}.{m.name}() writes "
+                                    f"lock-guarded attribute "
+                                    f"'self.{attr}' outside "
+                                    f"`with self._lock`"))
+                    for field in ("body", "orelse", "finalbody"):
+                        sub = getattr(st, field, None)
+                        if sub:
+                            visit(sub, locked)
+                    for h in getattr(st, "handlers", ()):
+                        visit(h.body, locked)
+
+            visit(m.body, False)
+    return out
+
+
+# =============================================================================
+# CCY103 — blocking calls while holding a lock
+# =============================================================================
+_STORE_BLOCKING_ATTRS = ("get", "set", "add", "wait", "barrier", "check")
+
+
+def _is_lockish_item(expr) -> bool:
+    if _lock_base(expr) is not None:
+        return True
+    return isinstance(expr, ast.Name) and (expr.id == "lock" or
+                                           expr.id.endswith("_lock"))
+
+
+def _blocking_kind(ctx: FileContext, call: ast.Call) -> Optional[str]:
+    d = ctx.dotted(call.func)
+    if d and (d == "time.sleep" or d.endswith(".time.sleep")):
+        return "time.sleep"
+    if d and (d == "block_until_ready" or
+              d.endswith(".block_until_ready")):
+        return "block_until_ready()"
+    if not isinstance(call.func, ast.Attribute):
+        return None
+    attr = call.func.attr
+    if attr == "block_until_ready":
+        return ".block_until_ready()"
+    if attr == "item" and not call.args and not call.keywords:
+        return ".item() host sync"
+    if attr == "join" and not call.args and \
+            all(k.arg == "timeout" for k in call.keywords):
+        # argless (or timeout=) join is a thread join; str.join always
+        # takes the iterable positionally
+        return ".join() thread wait"
+    if attr in _STORE_BLOCKING_ATTRS:
+        recv = (ctx.dotted(call.func.value) or
+                _bearer_tail(call.func.value) or "")
+        if "store" in recv.lower():
+            return f"store.{attr}() cross-host op"
+    return None
+
+
+@_register(
+    "CCY103", "blocking-call-under-lock",
+    "blocking call (time.sleep / thread .join() / store ops / "
+    "block_until_ready / .item()) while holding a lock",
+    "a blocking call inside a critical section serializes every thread "
+    "behind the sleeper — and a cross-host store op or device sync can "
+    "hold the lock for unbounded time (the classic serving stall). Move "
+    "the wait outside the `with ... _lock:` block (the engine does its "
+    "dispatch/telemetry AFTER releasing) or use a Condition with a "
+    "timeout.",
+    framework_only=True)
+def _check_blocking_under_lock(ctx: FileContext):
+    rule = RULES["CCY103"]
+    info = _info(ctx)
+    out: List = []
+    for fn in info.functions:
+        flagged: Set[int] = set()
+        for w in _own_body_walk(fn):
+            if not isinstance(w, ast.With) or \
+                    not any(_is_lockish_item(i.context_expr)
+                            for i in w.items):
+                continue
+            stack: List[ast.AST] = list(w.body)
+            while stack:
+                n = stack.pop()
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                    continue
+                if isinstance(n, ast.Call) and id(n) not in flagged:
+                    kind = _blocking_kind(ctx, n)
+                    if kind is not None:
+                        flagged.add(id(n))
+                        out.append(_finding(
+                            rule, ctx, n,
+                            f"blocking {kind} while holding a lock"))
+                stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+# =============================================================================
+# CCY104 — the never-raise-into-the-driver discipline
+# =============================================================================
+#: canonical never-raise seam names: methods the step_all driver loop
+#: (or the engine step) reaches on every pass — fleet sampling, the
+#: autoscaler control tick, telemetry streaming, flight dumps. Their
+#: whole body must be fenced (`try: ... except Exception: log`).
+_NEVER_RAISE_NAMES = ("on_step_all", "on_autoscale_event",
+                      "write_telemetry", "dump", "control")
+#: name shapes that make a same-file callee of step()/step_all() part
+#: of the dump/telemetry/record path
+_TELEMETRYISH_PREFIXES = ("dump", "record_", "write_", "note_",
+                          "on_step")
+#: calls a never-raise prologue/epilogue may make outside the fence:
+#: the instrumentation plane's bounded-metric recorders (no-raise by
+#: construction) and logging
+_BLESSED_CALL_HEADS = ("logger.", "logging.", "_instr.record_",
+                       "instrument.record_")
+_SAFE_CALLS = frozenset({
+    "time.monotonic", "time.time", "len", "int", "float", "bool", "str",
+    "list", "dict", "tuple", "set", "getattr", "min", "max", "sorted",
+    "isinstance", "id", "repr", "format", "round"})
+
+
+def _blessed_call(ctx: FileContext, call: ast.Call) -> bool:
+    """A prologue/epilogue call a never-raise body may make outside the
+    fence. Matched both on the resolved dotted path AND the raw
+    spelling: ``ctx.dotted`` expands import aliases (``_instr.record_x``
+    resolves to ``..profiler.instrument.record_x``), so the head check
+    alone would miss the aliased spelling every serving module uses."""
+    d = ctx.dotted(call.func) or ""
+    if d.startswith(_BLESSED_CALL_HEADS):
+        return True
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        tail = _bearer_tail(f.value)
+        if f.attr.startswith("record_") and tail in ("_instr", "instrument"):
+            return True
+        if tail in ("logger", "logging", "log", "_log"):
+            return True
+    return False
+
+
+def _safe_expr(ctx: FileContext, e) -> bool:
+    """Conservatively raise-free prologue expression: names, attribute
+    reads, constants, and arithmetic/boolean/conditional compositions
+    of those (plus a tiny blessed-call set like time.monotonic)."""
+    if e is None or isinstance(e, (ast.Constant, ast.Name)):
+        return True
+    if isinstance(e, ast.Attribute):
+        return _safe_expr(ctx, e.value)
+    if isinstance(e, ast.BoolOp):
+        return all(_safe_expr(ctx, v) for v in e.values)
+    if isinstance(e, (ast.UnaryOp,)):
+        return _safe_expr(ctx, e.operand)
+    if isinstance(e, ast.BinOp):
+        return _safe_expr(ctx, e.left) and _safe_expr(ctx, e.right)
+    if isinstance(e, ast.Compare):
+        return _safe_expr(ctx, e.left) and \
+            all(_safe_expr(ctx, c) for c in e.comparators)
+    if isinstance(e, ast.IfExp):
+        return all(_safe_expr(ctx, x) for x in (e.test, e.body, e.orelse))
+    if isinstance(e, (ast.Tuple, ast.List, ast.Set)):
+        return all(_safe_expr(ctx, x) for x in e.elts)
+    if isinstance(e, ast.Dict):
+        return all(_safe_expr(ctx, x) for x in
+                   list(e.keys) + list(e.values) if x is not None)
+    if isinstance(e, ast.Call):
+        d = ctx.dotted(e.func) or ""
+        if d in _SAFE_CALLS or _blessed_call(ctx, e):
+            return all(_safe_expr(ctx, a) for a in e.args) and \
+                all(_safe_expr(ctx, k.value) for k in e.keywords)
+        return False
+    return False
+
+
+def _broad_handler(handlers) -> bool:
+    for h in handlers:
+        if h.type is None:
+            return True
+        names = []
+        t = h.type
+        if isinstance(t, ast.Tuple):
+            names = [getattr(x, "attr", getattr(x, "id", "")) for x in t.elts]
+        else:
+            names = [getattr(t, "attr", getattr(t, "id", ""))]
+        if any(n in ("Exception", "BaseException") for n in names):
+            return True
+    return False
+
+
+def _exception_contained(ctx: FileContext, fn) -> bool:
+    """True when every statement of fn's body that can plausibly raise
+    sits inside a try whose handlers catch (at least) Exception — the
+    never-raise fence — allowing a raise-free prologue (docstring,
+    simple bindings, early-return guards) and a blessed epilogue
+    (logging / instrumentation counters / plain returns)."""
+    fenced = False
+    for st in fn.body:
+        if isinstance(st, ast.Expr) and isinstance(st.value, ast.Constant):
+            continue                                   # docstring
+        if isinstance(st, (ast.Pass, ast.Global, ast.Nonlocal)):
+            continue
+        if isinstance(st, ast.Try):
+            if not _broad_handler(st.handlers):
+                return False
+            fenced = True
+            continue
+        if isinstance(st, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            if _safe_expr(ctx, st.value):
+                continue
+            return False
+        if isinstance(st, ast.If):
+            if not _safe_expr(ctx, st.test):
+                return False
+            ok = all(isinstance(b, (ast.Return, ast.Pass, ast.Continue,
+                                    ast.Break))
+                     or (isinstance(b, (ast.Assign, ast.AnnAssign)) and
+                         _safe_expr(ctx, b.value))
+                     for b in st.body) and not st.orelse
+            if ok and all(_safe_expr(ctx, getattr(b, "value", None))
+                          for b in st.body if isinstance(b, ast.Return)):
+                continue
+            return False
+        if isinstance(st, ast.Return):
+            if _safe_expr(ctx, st.value):
+                continue
+            return False
+        if isinstance(st, ast.Expr) and isinstance(st.value, ast.Call):
+            if _blessed_call(ctx, st.value):
+                continue
+            return False
+        return False
+    return fenced
+
+
+@_register(
+    "CCY104", "raise-into-driver",
+    "dump/telemetry/record path reachable from step()/step_all() whose "
+    "body is not exception-contained",
+    "observability must never wound: anything the driver loop reaches "
+    "on its step path (flight dumps, telemetry writes, fleet sampling, "
+    "the autoscaler control tick) wraps its whole body in `try: ... "
+    "except Exception: logger.warning(...)` so a postmortem/telemetry "
+    "bug cannot kill the serving loop it is observing.",
+    framework_only=True)
+def _check_never_raise(ctx: FileContext):
+    rule = RULES["CCY104"]
+    info = _info(ctx)
+    out: List = []
+    by_name: Dict[str, List] = {}
+    for fn in info.functions:
+        by_name.setdefault(fn.name, []).append(fn)
+
+    def called_names(fn) -> Set[str]:
+        names = set()
+        for n in _own_body_walk(fn):
+            if isinstance(n, ast.Call):
+                f = n.func
+                if isinstance(f, ast.Attribute):
+                    names.add(f.attr)
+                elif isinstance(f, ast.Name):
+                    names.add(f.id)
+        return names
+
+    # same-file reachability from step()/step_all(), one call level deep
+    reachable: Set[str] = set()
+    for entry in info.functions:
+        if entry.name not in ("step", "step_all"):
+            continue
+        direct = called_names(entry)
+        reachable |= direct
+        for callee in direct:
+            for f in by_name.get(callee, ()):
+                reachable |= called_names(f)
+    candidates = {n for n in reachable
+                  if n.startswith(_TELEMETRYISH_PREFIXES)}
+
+    checked: Set[int] = set()
+    for fn in info.functions:
+        on_path = fn.name in candidates
+        canonical = fn.name in _NEVER_RAISE_NAMES and \
+            _is_serving_path(ctx.path)
+        if not (on_path or canonical) or id(fn) in checked:
+            continue
+        checked.add(id(fn))
+        if not _exception_contained(ctx, fn):
+            where = "reachable from the step driver" if on_path else \
+                "a canonical never-raise seam"
+            out.append(_finding(
+                rule, ctx, fn,
+                f"'{fn.name}' is {where} but its body is not "
+                f"exception-contained (no broad try/except fence)"))
+    return out
+
+
+# =============================================================================
+# CCY105 — the one-`is None`-check disarm convention
+# =============================================================================
+_PLANES = ("obs", "fleet_obs", "memwatch", "watcher")
+_SEAM_PREFIXES = ("on_", "record_", "note_", "write_")
+
+
+def _dotted_text(node) -> Optional[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _plane_key(base) -> Optional[str]:
+    tail = _bearer_tail(base)
+    if tail is None or tail.lstrip("_") not in _PLANES:
+        return None
+    return _dotted_text(base)
+
+
+@_register(
+    "CCY105", "unguarded-plane-seam",
+    "observability-plane seam call (on_*/record_*/note_*/write_*) "
+    "without the single `is None` disarm guard",
+    "disarmed planes are None by contract (obs/fleet_obs/memwatch): "
+    "every seam costs exactly one guard — `if self.obs is not None: "
+    "self.obs.on_x(...)` (or the bound-alias form `obs = self.obs; "
+    "armed = obs is not None and obs.armed`). An unguarded call is an "
+    "AttributeError on every disarmed run.",
+    framework_only=True)
+def _check_plane_guards(ctx: FileContext):
+    rule = RULES["CCY105"]
+    info = _info(ctx)
+    out: List = []
+
+    for fn in info.functions:
+        env_alias: Dict[str, str] = {}
+        env_flag: Dict[str, FrozenSet[str]] = {}
+        # the armed-parameter convention: a caller computes
+        # `armed = obs is not None and obs.armed` once and threads the
+        # flag into its private helpers (`_run_plan(plan, armed=...)`)
+        # — inside those helpers `if armed:` IS the disarm guard
+        for a in (fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs):
+            if a.arg == "armed" or a.arg.endswith("_armed"):
+                env_flag[a.arg] = frozenset(
+                    {"self.obs", "obs", "self.fleet_obs", "fleet_obs"})
+
+        def expand(keys: Set[str]) -> Set[str]:
+            full = set(keys)
+            for k in keys:
+                if k in env_alias:
+                    full.add(env_alias[k])
+            return full
+
+        def guard_keys(test) -> Tuple[Set[str], Set[str]]:
+            pos: Set[str] = set()
+            neg: Set[str] = set()
+
+            def conj(t):
+                if isinstance(t, ast.BoolOp) and isinstance(t.op, ast.And):
+                    for v in t.values:
+                        conj(v)
+                elif isinstance(t, ast.Compare) and len(t.ops) == 1 and \
+                        isinstance(t.comparators[0], ast.Constant) and \
+                        t.comparators[0].value is None:
+                    k = _dotted_text(t.left)
+                    if k:
+                        if isinstance(t.ops[0], ast.IsNot):
+                            pos.add(k)
+                        elif isinstance(t.ops[0], ast.Is):
+                            neg.add(k)
+                elif isinstance(t, ast.Name):
+                    pos.update(env_flag.get(t.id, frozenset()))
+                    pos.add(t.id)          # `if obs:` truthiness guard
+                elif isinstance(t, ast.Attribute):
+                    k = _dotted_text(t)
+                    if k:
+                        pos.add(k)         # `if self.obs:` truthiness
+                elif isinstance(t, ast.UnaryOp) and \
+                        isinstance(t.op, ast.Not):
+                    p2, n2 = guard_keys(t.operand)
+                    pos.update(n2)
+                    neg.update(p2)
+
+            conj(test)
+            return expand(pos), expand(neg)
+
+        def check_expr(node, guarded: Set[str]):
+            if node is None:
+                return
+            if isinstance(node, ast.IfExp):
+                check_expr(node.test, guarded)
+                pos, neg = guard_keys(node.test)
+                check_expr(node.body, guarded | pos)
+                check_expr(node.orelse, guarded | neg)
+                return
+            if isinstance(node, ast.BoolOp) and isinstance(node.op, ast.And):
+                acc = set(guarded)
+                for v in node.values:
+                    check_expr(v, acc)
+                    pos, _ = guard_keys(v)
+                    acc |= pos
+                return
+            if isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Attribute) and \
+                        f.attr.startswith(_SEAM_PREFIXES):
+                    key = _plane_key(f.value)
+                    cands: Set[str] = set()
+                    if key is not None:
+                        cands.add(key)
+                        if isinstance(f.value, ast.Name) and \
+                                f.value.id in env_alias:
+                            cands.add(env_alias[f.value.id])
+                    elif isinstance(f.value, ast.Name) and \
+                            f.value.id in env_alias:
+                        # alias escape hatch: `fo = self.router.fleet_obs`
+                        # then `fo.on_x()` — the alias name is not
+                        # plane-ish, the aliased target is
+                        target = env_alias[f.value.id]
+                        if target.rsplit(".", 1)[-1].lstrip("_") in _PLANES:
+                            key = target
+                            cands = {f.value.id, target}
+                    if key is not None and not (cands & guarded):
+                        out.append(_finding(
+                            rule, ctx, node,
+                            f"seam call {key}.{f.attr}() without an "
+                            f"`is None` disarm guard on '{key}'"))
+                check_expr(f.value if isinstance(f, ast.Attribute) else f,
+                           guarded)
+                for a in node.args:
+                    check_expr(a, guarded)
+                for k in node.keywords:
+                    check_expr(k.value, guarded)
+                return
+            for child in ast.iter_child_nodes(node):
+                check_expr(child, guarded)
+
+        def terminates(stmts) -> bool:
+            return bool(stmts) and isinstance(
+                stmts[-1], (ast.Return, ast.Raise, ast.Continue, ast.Break))
+
+        def scan(stmts, guarded: Set[str]):
+            guarded = set(guarded)
+            for st in stmts:
+                if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef)):
+                    continue
+                if isinstance(st, ast.Assign) and len(st.targets) == 1 \
+                        and isinstance(st.targets[0], ast.Name):
+                    check_expr(st.value, guarded)
+                    name = st.targets[0].id
+                    d = _dotted_text(st.value)
+                    if d:
+                        env_alias[name] = d
+                    pos, _ = guard_keys(st.value)
+                    pos.discard(name)
+                    if pos:
+                        env_flag[name] = frozenset(pos)
+                elif isinstance(st, ast.If):
+                    check_expr(st.test, guarded)
+                    pos, neg = guard_keys(st.test)
+                    scan(st.body, guarded | pos)
+                    scan(st.orelse, guarded | neg)
+                    if terminates(st.body):
+                        guarded |= neg
+                    if st.orelse and terminates(st.orelse):
+                        guarded |= pos
+                elif isinstance(st, ast.Assert):
+                    pos, _ = guard_keys(st.test)
+                    guarded |= pos
+                elif isinstance(st, ast.While):
+                    check_expr(st.test, guarded)
+                    pos, _ = guard_keys(st.test)
+                    scan(st.body, guarded | pos)
+                    scan(st.orelse, guarded)
+                elif isinstance(st, ast.For):
+                    check_expr(st.iter, guarded)
+                    scan(st.body, guarded)
+                    scan(st.orelse, guarded)
+                elif isinstance(st, ast.With):
+                    for item in st.items:
+                        check_expr(item.context_expr, guarded)
+                    scan(st.body, guarded)
+                elif isinstance(st, ast.Try):
+                    scan(st.body, guarded)
+                    for h in st.handlers:
+                        scan(h.body, guarded)
+                    scan(st.orelse, guarded)
+                    scan(st.finalbody, guarded)
+                else:
+                    check_expr(st, guarded)
+
+        scan(fn.body, set())
+    return out
+
+
+# =============================================================================
+# CCY201 — the request lifecycle table
+# =============================================================================
+_STATE_CONSTS = {"WAITING": "waiting", "RUNNING": "running",
+                 "FINISHED": "finished", "HANDOFF": "handoff"}
+
+
+def _state_value(node) -> Optional[str]:
+    if isinstance(node, ast.Name) and node.id in _STATE_CONSTS:
+        return _STATE_CONSTS[node.id]
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+@_register(
+    "CCY201", "illegal-state-transition",
+    "request state assignment outside scheduler.REQUEST_TRANSITIONS, "
+    "or a terminal finish/fail path without exactly one terminal "
+    "trace event",
+    "the request lifecycle is the literal table "
+    "serving/scheduler.py REQUEST_TRANSITIONS ('new' -> waiting -> "
+    "running/handoff -> finished): only declared edges may be "
+    "assigned, and every function that terminally resolves a request "
+    "(req.finish()/req.fail(...)) pairs each resolution with exactly "
+    "one obs.on_finish/on_fail terminal trace event — zero loses the "
+    "lifecycle's end, two double-counts SLO attainment.",
+    framework_only=True)
+def _check_state_machine(ctx: FileContext):
+    if not _is_serving_path(ctx.path):
+        return []
+    rule = RULES["CCY201"]
+    info = _info(ctx)
+    table = load_request_transitions()
+    enterable = {s for outs in table.values() for s in outs}
+    out: List = []
+
+    # classes owning the state machine itself (Request): their methods
+    # ARE the mechanism, not a lifecycle path
+    owner_classes = set()
+    for cls in info.classes:
+        for m in cls.body:
+            if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and m.name == "__init__":
+                for st in _own_body_walk(m):
+                    if any(a == "state"
+                           for _, a in _self_attr_writes(st)):
+                        owner_classes.add(cls.name)
+
+    for fn in info.functions:
+        # -- part A: .state assignments must be declared edges --------
+        # (_own_body_walk is stack-ordered; the prev-state edge check
+        # needs source order)
+        prev_by_target: Dict[str, str] = {}
+        assigns = sorted(
+            (n for n in _own_body_walk(fn) if isinstance(n, ast.Assign)),
+            key=lambda n: (n.lineno, n.col_offset))
+        for n in assigns:
+            for t in n.targets:
+                if not (isinstance(t, ast.Attribute) and
+                        t.attr == "state"):
+                    continue
+                val = _state_value(n.value)
+                if val is None:
+                    continue        # dynamic / not a lifecycle state
+                if val not in table:
+                    out.append(_finding(
+                        rule, ctx, n,
+                        f"assigns unknown lifecycle state {val!r} "
+                        f"(REQUEST_TRANSITIONS states: "
+                        f"{sorted(s for s in table if s != 'new')})"))
+                    continue
+                tgt = _dotted_text(t.value) or "<req>"
+                if fn.name == "__init__":
+                    frm = "new"
+                else:
+                    frm = prev_by_target.get(tgt)
+                if frm is not None and val not in table.get(frm, ()):
+                    out.append(_finding(
+                        rule, ctx, n,
+                        f"state transition {frm!r} -> {val!r} is not in "
+                        f"REQUEST_TRANSITIONS"))
+                elif frm is None and val not in enterable:
+                    out.append(_finding(
+                        rule, ctx, n,
+                        f"state {val!r} is not enterable by any "
+                        f"REQUEST_TRANSITIONS edge"))
+                prev_by_target[tgt] = val
+
+        # -- part B: exactly one terminal trace event per resolution --
+        if info.class_of.get(id(fn)) in owner_classes:
+            continue
+        resolutions: List[ast.Call] = []
+        terminal_events = 0
+        for n in _own_body_walk(fn):
+            if not isinstance(n, ast.Call) or \
+                    not isinstance(n.func, ast.Attribute):
+                continue
+            attr = n.func.attr
+            base_is_self = isinstance(n.func.value, ast.Name) and \
+                n.func.value.id == "self"
+            if attr in ("on_finish", "on_fail"):
+                terminal_events += 1
+            elif not base_is_self and (
+                    (attr == "finish" and not n.args) or
+                    (attr == "fail" and n.args)):
+                resolutions.append(n)
+        if resolutions and terminal_events != len(resolutions):
+            out.append(_finding(
+                rule, ctx, resolutions[0],
+                f"{len(resolutions)} terminal resolution(s) "
+                f"(.finish()/.fail()) but {terminal_events} terminal "
+                f"trace event(s) (on_finish/on_fail): the lifecycle "
+                f"contract is exactly one per resolution"))
+    return out

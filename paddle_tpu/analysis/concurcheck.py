@@ -1,0 +1,185 @@
+"""CCY5xx — concurrency-registry coherence (the concurcheck driver half).
+
+The static rules (CCY101..CCY201 in ``analysis/concur_rules.py``) and
+the runtime twin (``serving/locking.OrderedLock``, armed via
+``PADDLE_LOCKCHECK``) both take their ground truth from two literal
+registries:
+
+* ``serving/locking.py`` — LOCK_ORDER / LOCK_OWNERS / LOCK_BEARERS /
+  LOCK_CORE_MODULES
+* ``serving/scheduler.py`` — REQUEST_TRANSITIONS
+
+A linter whose registry is self-contradictory lies politely: it keeps
+exiting 0 while enforcing nothing. This module is the fourth lint
+pass's self-check — it proves the registries are internally coherent
+and that the runtime twin sees exactly the same order the static rules
+enforce, so the two halves cannot drift apart:
+
+* **CCY510** — lock-registry incoherence: duplicate names in
+  LOCK_ORDER, an owner/bearer mapping onto an undeclared lock, or an
+  empty/degenerate core-module list.
+* **CCY511** — transition-table incoherence: an edge targeting an
+  undeclared state, a missing ``"new"`` birth state, a non-terminal
+  ``"finished"``, or a state unreachable from ``"new"``.
+* **CCY520** — static/runtime drift: the registry the runtime
+  ``locking`` module actually exposes differs from the one the static
+  rules parsed, or OrderedLock cannot rank a declared lock name.
+
+Stdlib-only: the runtime ``locking`` module is loaded BY FILE PATH
+(``importlib.util.spec_from_file_location``), never through the
+``paddle_tpu.serving`` package — importing that package pulls the
+engine and therefore jax, which the lint driver must not need.
+"""
+from __future__ import annotations
+
+import functools
+import importlib.util
+import os
+from typing import List
+
+from .concur_rules import (load_lock_bearers, load_lock_core_modules,
+                           load_lock_order, load_lock_owners,
+                           load_request_transitions)
+from .rules import Finding, _PKG_ROOT
+
+__all__ = ["CONCUR_RULES", "concur_check", "load_locking_module"]
+
+CONCUR_RULES = {
+    "CCY510": ("lock-registry-incoherent",
+               "serving/locking.py's LOCK_ORDER must list each lock "
+               "once, every LOCK_OWNERS/LOCK_BEARERS value must name a "
+               "declared lock, and LOCK_CORE_MODULES must be .py "
+               "basenames — an incoherent registry makes CCY101 and "
+               "the OrderedLock twin silently under-enforce"),
+    "CCY511": ("transition-table-incoherent",
+               "serving/scheduler.py REQUEST_TRANSITIONS must be closed "
+               "(every edge target is a declared state), born from "
+               "'new', terminal at 'finished' (no outgoing edges), and "
+               "fully reachable from 'new' — otherwise CCY201 enforces "
+               "a lifecycle no request can actually live"),
+    "CCY520": ("static-runtime-lock-order-drift",
+               "the registry serving/locking.py exposes at runtime must "
+               "be byte-identical to the literals the static rules "
+               "parse, and OrderedLock must rank every declared name — "
+               "drift here means the armed twin and the lint gate "
+               "enforce different orders"),
+}
+
+_LOCKING_PATH = os.path.join(_PKG_ROOT, "serving", "locking.py")
+_SCHED_PATH = os.path.join(_PKG_ROOT, "serving", "scheduler.py")
+
+
+def _finding(rule: str, path: str, message: str) -> Finding:
+    return Finding(rule, path, 0, 0, message, CONCUR_RULES[rule][1])
+
+
+@functools.lru_cache(maxsize=1)
+def load_locking_module():
+    """The runtime ``serving.locking`` module, loaded by file path so
+    no package __init__ (and hence no jax) runs. Shared by the lint
+    driver's CCY520 check, the concur tier-1 tests, and the chaos
+    drill's --lockcheck scenario."""
+    spec = importlib.util.spec_from_file_location(
+        "paddle_tpu_serving_locking_standalone", _LOCKING_PATH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _check_lock_registry(out: List[Finding]) -> None:
+    order = load_lock_order()
+    if len(set(order)) != len(order) or not order:
+        out.append(_finding(
+            "CCY510", _LOCKING_PATH,
+            f"LOCK_ORDER is empty or repeats a lock name: {order!r}"))
+    declared = set(order)
+    for what, mapping in (("LOCK_OWNERS", load_lock_owners()),
+                          ("LOCK_BEARERS", load_lock_bearers())):
+        for key, lock in sorted(mapping.items()):
+            if lock not in declared:
+                out.append(_finding(
+                    "CCY510", _LOCKING_PATH,
+                    f"{what}[{key!r}] maps to {lock!r}, which is not in "
+                    f"LOCK_ORDER {order!r}"))
+    core = load_lock_core_modules()
+    if not core or not all(m.endswith(".py") and "/" not in m
+                           for m in core):
+        out.append(_finding(
+            "CCY510", _LOCKING_PATH,
+            f"LOCK_CORE_MODULES must be non-empty .py basenames, got "
+            f"{core!r}"))
+
+
+def _check_transition_table(out: List[Finding]) -> None:
+    table = load_request_transitions()
+    states = set(table)
+    for frm, outs in sorted(table.items()):
+        for to in outs:
+            if to not in states:
+                out.append(_finding(
+                    "CCY511", _SCHED_PATH,
+                    f"edge {frm!r} -> {to!r} targets an undeclared "
+                    f"state (declared: {sorted(states)})"))
+    if "new" not in states:
+        out.append(_finding(
+            "CCY511", _SCHED_PATH,
+            "no 'new' birth state: __init__ assignments have no edge "
+            "to check against"))
+    if table.get("finished"):
+        out.append(_finding(
+            "CCY511", _SCHED_PATH,
+            f"'finished' must be terminal but has outgoing edges "
+            f"{table['finished']!r}"))
+    # every declared state must be reachable from 'new'
+    seen, frontier = {"new"}, ["new"]
+    while frontier:
+        for to in table.get(frontier.pop(), ()):
+            if to in states and to not in seen:
+                seen.add(to)
+                frontier.append(to)
+    for orphan in sorted(states - seen):
+        out.append(_finding(
+            "CCY511", _SCHED_PATH,
+            f"state {orphan!r} is unreachable from 'new'"))
+
+
+def _check_runtime_twin(out: List[Finding]) -> None:
+    try:
+        mod = load_locking_module()
+    except Exception as e:  # pragma: no cover - import is stdlib-only
+        out.append(_finding(
+            "CCY520", _LOCKING_PATH,
+            f"runtime locking module failed to load standalone: {e}"))
+        return
+    pairs = (("LOCK_ORDER", tuple(load_lock_order())),
+             ("LOCK_OWNERS", dict(load_lock_owners())),
+             ("LOCK_BEARERS", dict(load_lock_bearers())),
+             ("LOCK_CORE_MODULES", tuple(load_lock_core_modules())))
+    for name, static in pairs:
+        runtime = getattr(mod, name, None)
+        if runtime is None or \
+                (tuple(runtime) if isinstance(static, tuple)
+                 else dict(runtime)) != static:
+            out.append(_finding(
+                "CCY520", _LOCKING_PATH,
+                f"runtime {name} ({runtime!r}) differs from the "
+                f"statically parsed literal ({static!r})"))
+    for lock_name in load_lock_order():
+        try:
+            mod.OrderedLock(lock_name)
+        except Exception as e:
+            out.append(_finding(
+                "CCY520", _LOCKING_PATH,
+                f"OrderedLock cannot rank declared lock "
+                f"{lock_name!r}: {e}"))
+
+
+def concur_check() -> List[Finding]:
+    """The fourth lint pass's self-check: registry coherence + runtime
+    twin agreement. Returns CCY5xx findings (empty on a healthy tree);
+    tools/lint.py diffs them against tools/concur_baseline.json."""
+    out: List[Finding] = []
+    _check_lock_registry(out)
+    _check_transition_table(out)
+    _check_runtime_twin(out)
+    return out

@@ -368,7 +368,11 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
                         raise TimeoutError(
                             f"save_state_dict: rank metadata missing after "
                             f"{barrier_timeout}s: {missing}")
-                    time.sleep(0.05)
+                    # cross-host metadata barrier: _async_lock only
+                    # serializes this process's async saves, and the
+                    # coordinator MUST hold it until every rank's file
+                    # lands — the sleep IS the wait, bounded by deadline
+                    time.sleep(0.05)  # tpu-lint: disable=CCY103
                 # drop stale files from an earlier save with a larger world
                 for fn in os.listdir(path):
                     if fn.startswith("meta_") and fn.endswith(".json"):

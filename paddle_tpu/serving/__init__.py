@@ -144,11 +144,25 @@ on the fleet-obs signal ring:
 Benchmark the 10x traffic swing with ``python tools/bench_serve.py
 --elastic``; drill faulted spawns/mid-burst retires with ``python
 tools/chaos_drill.py --elastic``.
+
+Lock discipline (``serving.locking``): every serving-plane lock is an
+``OrderedLock`` ranked by the declared ``LOCK_ORDER`` (fleet_obs →
+router → engine → observer, outermost first). Disarmed it is a plain
+``threading.RLock`` (sub-microsecond acquire); armed — via
+``PADDLE_LOCKCHECK=1`` or ``locking.arm(True)`` — any out-of-order
+acquisition raises ``LockOrderViolation`` *before* blocking, so
+inversions surface deterministically on a single thread instead of as
+a once-a-week fleet deadlock. The same ``LOCK_ORDER`` literal is the
+ground truth for the static CCY1xx analyzer
+(``paddle_tpu.analysis.concur_rules``); ``analysis.concurcheck``
+cross-checks that the static table and this runtime twin never drift.
+Drill the armed path with ``python tools/chaos_drill.py --lockcheck``.
 """
 from .autoscaler import AutoscaleEvent, AutoscalerConfig, FleetAutoscaler
 from .engine import (EngineConfig, EnginePredictor, ServingEngine,
                      engine_from_config)
 from .kv_pool import KVBlockPool, PoolExhausted, prefix_chain_keys
+from .locking import LOCK_ORDER, LockOrderViolation, OrderedLock
 from .router import ReplicaRouter
 from .obs import ObsConfig, RequestTrace, ServingObserver, resolve_observer
 from .fleet_obs import FleetObsConfig, FleetObserver, resolve_fleet_obs
@@ -164,6 +178,7 @@ __all__ = [
     "EngineConfig", "EnginePredictor", "ServingEngine",
     "engine_from_config", "KVBlockPool", "PoolExhausted",
     "prefix_chain_keys", "ReplicaRouter",
+    "LOCK_ORDER", "LockOrderViolation", "OrderedLock",
     "AutoscaleEvent", "AutoscalerConfig", "FleetAutoscaler",
     "ragged_paged_attention", "Request", "Scheduler",
     "Drafter", "NgramDrafter", "DraftModelDrafter", "make_drafter",

@@ -329,31 +329,16 @@ class FleetAutoscaler:
     def _least_affinity_loaded(self, cands) -> int:
         """Retire/flip victim: fewest affinity registrations (both
         maps), then lightest queue, then index — the replica whose loss
-        costs the fleet's prefix-cache partition the least."""
-        r = self.router
-        with r._lock:
-            load = {i: 0 for i in cands}
-            for amap in (r._affinity, r._decode_affinity):
-                for tgt in amap.values():
-                    if tgt in load:
-                        load[tgt] += 1
-
-            def key(i):
-                sched = r.replicas[i].sched
-                return (load[i],
-                        sched.queue_depth() + len(sched.running), i)
-            return min(cands, key=key)
+        costs the fleet's prefix-cache partition the least. Scored by
+        the router's public seam: the controller never grabs the
+        router's private lock directly (CCY101 — the round-18
+        self-host fix; the old spelling lives on as a firing fixture in
+        tests/test_concurcheck.py)."""
+        return self.router.least_affinity_loaded(cands)
 
     # -- evidence -------------------------------------------------------------
     def _live_by_role(self) -> Dict[str, List[int]]:
-        r = self.router
-        with r._lock:
-            out: Dict[str, List[int]] = {}
-            for i, eng in enumerate(r.replicas):
-                if r._alive[i]:
-                    role = getattr(eng, "role", None) or "unified"
-                    out.setdefault(role, []).append(i)
-            return out
+        return self.router.live_by_role()
 
     @staticmethod
     def _snapshot(sig, per_role) -> Dict[str, Any]:
@@ -371,13 +356,15 @@ class FleetAutoscaler:
 
     def _record(self, rule, action, role, replica, outcome, reason,
                 snapshot, detail) -> AutoscaleEvent:
+        fo = self.router.fleet_obs
         event = AutoscaleEvent(
-            tick=self.ticks, passes=self.router.fleet_obs.passes,
+            tick=self.ticks, passes=fo.passes if fo is not None else 0,
             rule=rule, action=action,
             role=role, replica=replica, outcome=outcome, reason=reason,
             signal=snapshot, detail=detail)
         self.events.append(event)
-        self.router.fleet_obs.on_autoscale_event(event.to_dict())
+        if fo is not None:
+            fo.on_autoscale_event(event.to_dict())
         _instr.record_fleet_scale_event(action, outcome)
         return event
 

@@ -35,6 +35,7 @@ from ..resilience import chaos
 from . import ragged as _ragged
 from . import resilience as _res
 from .kv_pool import KVBlockPool
+from .locking import OrderedLock
 from .obs import resolve_observer
 from .scheduler import Request, Scheduler, WAITING
 from .speculative import make_drafter, verify_greedy
@@ -393,7 +394,8 @@ class ServingEngine:
         self._tables = np.full((cfg.max_seqs, self.max_pages_per_seq), -1,
                                np.int32)
         self._rng = np.random.default_rng(seed)
-        self._lock = threading.RLock()
+        # reentrant; PADDLE_LOCKCHECK=1 arms LOCK_ORDER enforcement
+        self._lock = OrderedLock("engine")
         self._work = threading.Event()
         self._step_call = self._build_step_call()
         self.aot_warm_result = self._warm_start()
@@ -1020,9 +1022,13 @@ class ServingEngine:
 
     def pop_handoffs(self) -> List:
         """Drain the sink-less hand-off stash: (request, record) pairs
-        in prefill-completion order."""
-        out, self._handoff_outbox = self._handoff_outbox, []
-        return out
+        in prefill-completion order. Under the engine lock: the stash
+        is appended by ``_dispatch_handoffs`` and a lock-free swap here
+        can lose a pair that lands between the read and the reset
+        (CCY102 — found by the round-18 concurcheck self-host pass)."""
+        with self._lock:
+            out, self._handoff_outbox = self._handoff_outbox, []
+            return out
 
     # -- step-fault containment (serving/resilience.py) -----------------------
     def _contain_step_fault(self, plan, exc: BaseException, armed: bool,

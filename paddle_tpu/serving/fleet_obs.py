@@ -58,6 +58,7 @@ from collections import deque
 from typing import Any, Dict, List, Optional
 
 from ..profiler import instrument as _instr
+from .locking import OrderedLock
 from .obs import _atomic_json
 
 logger = logging.getLogger(__name__)
@@ -141,7 +142,8 @@ class FleetObserver:
         cfg = config or FleetObsConfig()
         self.config = cfg
         self.armed = True
-        self._lock = threading.RLock()
+        # reentrant; PADDLE_LOCKCHECK=1 arms LOCK_ORDER enforcement
+        self._lock = OrderedLock("fleet_obs")
         # one (monotonic, wall) instant pair: every exported timestamp
         # derives from it (no jumpable clocks on the dump path)
         self._anchor_mono = time.monotonic()

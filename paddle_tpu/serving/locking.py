@@ -1,0 +1,189 @@
+"""Canonical lock-order registry + the runtime ordered-lock twin.
+
+The serving tier coordinates four RLocks (fleet observer, router,
+engine, serving observer). Their partial order used to live only in
+docstrings (``obs.py``, ``fleet_obs.py``, ``engine.py``) and reviewer
+memory; this module is now the ONE place it is declared, and both
+enforcement halves read it:
+
+  * **static** — ``analysis/concur_rules.py`` reads ``LOCK_ORDER`` /
+    ``LOCK_OWNERS`` / ``LOCK_BEARERS`` with ``ast.literal_eval`` (no
+    jax, no imports at lint time — the ``KNOWN_AXES`` move) and flags
+    nested ``with X._lock`` acquisitions whose edge contradicts the
+    order (CCY101);
+  * **runtime** — ``OrderedLock`` (adopted by engine/router/observer/
+    fleet-observer for their ``_lock``) asserts the same order
+    per-thread at acquisition time when armed via ``PADDLE_LOCKCHECK=1``
+    (or ``arm()``), so every tier-1 serving suite and chaos drill
+    exercises the order on every run. Disarmed, an acquisition costs
+    one list-index check (microbench-pinned <1us in tests).
+
+Direction note: the declared order is **outermost first**. The fleet
+observer's lock is only ever taken FIRST — ``FleetObserver.dump`` holds
+it while ``_fleet_record`` takes the router lock, and ``on_step_all``
+holds it while sampling walks every engine's ``signals()`` (engine then
+observer lock) — and no router/engine/observer path ever takes the
+fleet lock while holding its own (``router.py`` documents the same
+invariant at the ``fleet_obs`` attribute). Hence::
+
+    fleet_obs  ->  router  ->  engine  ->  observer
+
+A thread may acquire a lock only if every lock it already holds sits
+STRICTLY EARLIER in this order (re-acquiring the same RLock is always
+fine — reentrancy is part of the contract; external drivers do
+``with eng._lock`` around multi-call sections).
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+__all__ = [
+    "LOCK_ORDER", "LOCK_OWNERS", "LOCK_BEARERS", "LOCK_CORE_MODULES",
+    "LockOrderViolation", "OrderedLock", "arm", "armed", "held_names",
+]
+
+#: The declared partial order, outermost lock first. Read statically by
+#: ``analysis.concur_rules.load_lock_order`` (ast.literal_eval — keep
+#: this a pure literal) and at runtime by ``OrderedLock``.
+LOCK_ORDER = ("fleet_obs", "router", "engine", "observer")
+
+#: Which class's ``self._lock`` each ordered name refers to — how the
+#: static pass resolves ``with self._lock`` to a position in the order.
+#: Pure literal (ast.literal_eval).
+LOCK_OWNERS = {
+    "FleetObserver": "fleet_obs",
+    "ReplicaRouter": "router",
+    "ServingEngine": "engine",
+    "ServingObserver": "observer",
+}
+
+#: How the static pass resolves ``with <name-or-attr>._lock`` spellings
+#: that are not ``self``: the variable name, or the attribute the
+#: variable was bound from (``eng = self.replicas[i]`` -> "replicas"
+#: -> engine). Pure literal (ast.literal_eval).
+LOCK_BEARERS = {
+    "router": "router",
+    "eng": "engine",
+    "engine": "engine",
+    "replicas": "engine",
+    "obs": "observer",
+    "observer": "observer",
+    "fleet_obs": "fleet_obs",
+}
+
+#: Serving modules blessed to acquire ANOTHER component's private
+#: ``_lock`` directly — the core that implements the ordered topology.
+#: Everything else in the serving package must go through a public seam
+#: on the owning object (CCY101 flags the grab; PR 17's autoscaler
+#: reaching into ``router._lock`` was exactly this drift). Pure literal.
+LOCK_CORE_MODULES = (
+    "engine.py", "router.py", "obs.py", "fleet_obs.py", "locking.py",
+)
+
+_RANK = {name: i for i, name in enumerate(LOCK_ORDER)}
+
+_TRUTHY = ("1", "true", "on", "yes")
+
+#: one-cell mutable flag (the ``instrument._enabled`` pattern): the
+#: disarmed fast path is a single list-index check, and tests/drills
+#: flip it without re-importing.
+_armed = [os.environ.get("PADDLE_LOCKCHECK", "").strip().lower()
+          in _TRUTHY]
+
+_tls = threading.local()
+
+
+def _held():
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    return stack
+
+
+class LockOrderViolation(RuntimeError):
+    """An armed ``OrderedLock`` caught an out-of-order acquisition.
+
+    Deterministic: raised at the acquiring call site, BEFORE the lock
+    is taken, naming both locks and the declared order — the would-be
+    deadlock's exact evidence, produced on every run instead of on the
+    unlucky interleaving."""
+
+
+def arm(on: bool = True) -> None:
+    """Programmatically (dis)arm order checking for every OrderedLock
+    in the process (tests, ``chaos_drill.py --lockcheck``)."""
+    _armed[0] = bool(on)
+
+
+def armed() -> bool:
+    return _armed[0]
+
+
+def held_names():
+    """Names of the ordered locks the CALLING thread holds, outermost
+    first (diagnostics; empty while disarmed — the stack is only
+    maintained when arming is on at acquisition time)."""
+    return tuple(lk.name for lk in _held())
+
+
+class OrderedLock:
+    """Drop-in ``threading.RLock`` that knows its place in LOCK_ORDER.
+
+    Context-manager + ``acquire``/``release`` compatible, reentrant.
+    While armed (``PADDLE_LOCKCHECK=1`` or ``arm()``), acquiring a lock
+    whose rank is <= any DIFFERENT lock the thread already holds raises
+    ``LockOrderViolation`` before blocking."""
+
+    __slots__ = ("name", "rank", "_lock")
+
+    def __init__(self, name: str):
+        rank = _RANK.get(name)
+        if rank is None:
+            raise ValueError(
+                f"unknown ordered lock {name!r}: LOCK_ORDER is "
+                f"{' -> '.join(LOCK_ORDER)}")
+        self.name = name
+        self.rank = rank
+        self._lock = threading.RLock()
+
+    def _check_order(self) -> None:
+        for held in _held():
+            if held._lock is self._lock:
+                return                      # reentrant re-acquire: fine
+        for held in _held():
+            if held.rank >= self.rank:
+                raise LockOrderViolation(
+                    f"acquiring lock '{self.name}' "
+                    f"(rank {self.rank}) while holding "
+                    f"'{held.name}' (rank {held.rank}); declared order "
+                    f"is {' -> '.join(LOCK_ORDER)} (outermost first)")
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        if _armed[0]:
+            self._check_order()
+            ok = self._lock.acquire(blocking, timeout)
+            if ok:
+                _held().append(self)
+            return ok
+        return self._lock.acquire(blocking, timeout)
+
+    def release(self) -> None:
+        if _armed[0]:
+            stack = _held()
+            for i in range(len(stack) - 1, -1, -1):
+                if stack[i] is self:
+                    del stack[i]
+                    break
+        self._lock.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"OrderedLock({self.name!r}, rank={self.rank})"

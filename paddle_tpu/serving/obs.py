@@ -56,6 +56,7 @@ from typing import Any, Dict, List, Optional
 from ..profiler import instrument as _instr
 from ..profiler import metrics as _metrics
 from ..resilience import chaos
+from .locking import OrderedLock
 
 logger = logging.getLogger(__name__)
 
@@ -163,7 +164,8 @@ class ServingObserver:
         cfg = config or ObsConfig()
         self.config = cfg
         self.armed = True
-        self._lock = threading.RLock()
+        # reentrant; PADDLE_LOCKCHECK=1 arms LOCK_ORDER enforcement
+        self._lock = OrderedLock("observer")
         # one (monotonic, wall) instant pair: every exported/unix
         # timestamp derives from it, so functions on the chaos-probed
         # dump path never read the wall clock directly
@@ -561,7 +563,7 @@ class ServingObserver:
         try:
             _atomic_json(target, tel, indent=1)
             return True
-        except (OSError, TypeError, ValueError):
+        except Exception:   # noqa: BLE001 — "Never raises" is the contract
             logger.warning("serve.obs: could not write telemetry %s",
                            target, exc_info=True)
             return False

@@ -267,7 +267,9 @@ def replay_manifest(engine, manifest) -> List:
             req.seq.extend(int(t) for t in generated)
             req.output = [int(t) for t in generated]
             req.finish_reason = "max_new_tokens"
-            req.finish()
+            # synthesized pre-finished handle: never submitted, so no
+            # lifecycle trace exists for on_finish to terminate
+            req.finish()  # tpu-lint: disable=CCY201
             handles.append(req)
             continue
         # _bypass_admission: the dead generation already admitted these —

@@ -79,6 +79,7 @@ from ..resilience import chaos
 from . import resilience as _res
 from .fleet_obs import resolve_fleet_obs
 from .kv_pool import PoolExhausted, prefix_chain_keys
+from .locking import OrderedLock
 
 _POLICIES = ("affinity", "least_loaded", "random", "round_robin")
 
@@ -176,7 +177,8 @@ class ReplicaRouter:
         # instead of growing the replica list
         self.spawns = 0
         self.reused_slots = 0
-        self._lock = threading.RLock()
+        # reentrant; PADDLE_LOCKCHECK=1 arms LOCK_ORDER enforcement
+        self._lock = OrderedLock("router")
         # fleet observability plane (serving/fleet_obs.py): disarmed =
         # None, every armed-only seam below is one `is None` check. Its
         # lock is only ever taken FIRST (fleet -> router/engine/obs) —
@@ -206,6 +208,38 @@ class ReplicaRouter:
             return (depth + len(e.sched.running),
                     wait if wait is not None else 0.0, i)
         return min(cands, key=score)
+
+    def live_by_role(self) -> Dict[str, List[int]]:
+        """Public fleet-inspection seam: live replica indices grouped by
+        role (``unified`` for role-less engines), under the router lock.
+        The autoscaler's census — callers outside the serving lock core
+        must use this instead of grabbing ``router._lock`` (CCY101)."""
+        with self._lock:
+            out: Dict[str, List[int]] = {}
+            for i, eng in enumerate(self.replicas):
+                if self._alive[i]:
+                    role = getattr(eng, "role", None) or "unified"
+                    out.setdefault(role, []).append(i)
+            return out
+
+    def least_affinity_loaded(self, cands: Sequence[int]) -> int:
+        """Public retire-placement seam: of ``cands``, the replica
+        holding the FEWEST affinity registrations (prefix + decode
+        maps), queue depth then index breaking ties — the cheapest
+        replica to drain, scored consistently under the router lock."""
+        with self._lock:
+            load = {i: 0 for i in cands}
+            for amap in (self._affinity, self._decode_affinity):
+                for tgt in amap.values():
+                    if tgt in load:
+                        load[tgt] += 1
+
+            def key(i):
+                sched = self.replicas[i].sched
+                return (load[i], sched.queue_depth() + len(sched.running),
+                        i)
+
+            return min(cands, key=key)
 
     def _route(self, keys) -> List:
         """Candidate replica order (best first) + the deciding policy.

@@ -57,6 +57,28 @@ _req_ids = itertools.count()
 WAITING, RUNNING, FINISHED = "waiting", "running", "finished"
 HANDOFF = "handoff"
 
+# The canonical lifecycle table: {from_state: (to_state, ...)} — the
+# ONLY legal ``req.state`` transitions, with "new" as the pre-lifecycle
+# pseudo-state a fresh Request is born from. Ground truth for the CCY201
+# static rule (analysis/concur_rules.py reads this with ast.literal_eval
+# — keep it a pure literal) and for the static==runtime pin in
+# tests/test_concurcheck.py.
+#   waiting -> running    admission (schedule)
+#   waiting -> handoff    prefill-complete sweep straight off the queue
+#   waiting -> finished   fail_request on a never-admitted request
+#   running -> waiting    preemption / step-fault requeue (recompute)
+#   running -> handoff    prefill-complete sweep
+#   running -> finished   finish (eos / budget) or terminal failure
+#   handoff -> waiting    decode-side import / recompute adoption
+#   handoff -> finished   fail_request before the hand-off landed
+REQUEST_TRANSITIONS = {
+    "new": ("waiting",),
+    "waiting": ("running", "handoff", "finished"),
+    "running": ("waiting", "handoff", "finished"),
+    "handoff": ("waiting", "finished"),
+    "finished": (),
+}
+
 
 class Request:
     """One generation request inside the engine.
@@ -695,4 +717,5 @@ class Scheduler:
 
 
 __all__ = ["Request", "Scheduler", "StepPlan", "StepEntry",
-           "WAITING", "RUNNING", "FINISHED", "HANDOFF"]
+           "WAITING", "RUNNING", "FINISHED", "HANDOFF",
+           "REQUEST_TRANSITIONS"]

@@ -187,11 +187,20 @@ CASES = {
     ),
 }
 
-# the SHD (sharding/layout) family's fixtures live with their own test
-# module; pulled in here so the rule-completeness gate covers them too
+# the SHD (sharding/layout) and CCY (serving concurrency) families'
+# fixtures live with their own test modules; pulled in here so the
+# rule-completeness gate covers them too
+from test_concurcheck import CCY_CASES, CCY_FIXTURE_PATH  # noqa: E402
 from test_shardcheck import SHD_CASES  # noqa: E402
 
 CASES.update(SHD_CASES)
+CASES.update(CCY_CASES)
+
+
+def _fixture_path(rule):
+    # CCY201 (and CCY101's foreign-grab arm) are serving-scoped: those
+    # snippets lint as a serving-tier file
+    return CCY_FIXTURE_PATH if rule.startswith("CCY") else FAKE_PATH
 
 
 def test_every_rule_has_fixtures():
@@ -202,7 +211,7 @@ def test_every_rule_has_fixtures():
 @pytest.mark.parametrize("rule", sorted(CASES))
 def test_rule_fires(rule):
     bad, _, _ = CASES[rule]
-    findings = lint(bad)
+    findings = lint(bad, path=_fixture_path(rule))
     assert rule in ids_of(findings), \
         f"{rule} did not fire on its fixture: {findings}"
 
@@ -210,14 +219,15 @@ def test_rule_fires(rule):
 @pytest.mark.parametrize("rule", sorted(r for r in CASES if CASES[r][1]))
 def test_rule_suppressed(rule):
     _, suppressed, _ = CASES[rule]
-    assert rule not in ids_of(lint(suppressed)), \
+    assert rule not in ids_of(lint(suppressed, path=_fixture_path(rule))), \
         f"{rule} fired despite # tpu-lint: disable"
 
 
 @pytest.mark.parametrize("rule", sorted(CASES))
 def test_rule_clean(rule):
     _, _, clean = CASES[rule]
-    findings = [f for f in lint(clean) if f.rule == rule]
+    findings = [f for f in lint(clean, path=_fixture_path(rule))
+                if f.rule == rule]
     assert not findings, f"{rule} false-positive on clean spelling"
 
 

@@ -77,6 +77,16 @@ None, never raises into the driver). Deterministic per seed.
 
     JAX_PLATFORMS=cpu python tools/chaos_drill.py --mem [--seed 1234]
 
+``--lockcheck`` runs the armed ordered-lock drill
+(paddle_tpu.serving.locking, the runtime twin of the CCY101 lint
+rule): a real engine serves a seeded workload with PADDLE_LOCKCHECK
+enforcement armed — zero violations, tokens bit-identical to the
+disarmed run — and then a planted observer->engine lock inversion must
+raise ``LockOrderViolation`` deterministically, naming the planted
+edge. Stable per seed.
+
+    JAX_PLATFORMS=cpu python tools/chaos_drill.py --lockcheck [--seed 1234]
+
 Exit code 0 = every exercised recovery path verified.
 """
 from __future__ import annotations
@@ -1295,6 +1305,99 @@ def run_elastic_drill(seed: int = 1234, verbose: bool = True):
     return report
 
 
+def run_lockcheck_drill(seed: int = 1234, verbose: bool = True):
+    """Armed ordered-lock drill (serving/locking.py, PADDLE_LOCKCHECK).
+
+    Phase 1 (armed-and-clean): a real engine serves a seeded workload
+    with the runtime twin armed — the serving tier's own lock pairing
+    (engine -> observer) must satisfy serving.locking.LOCK_ORDER end
+    to end (zero violations), and the tokens must be bit-identical to
+    the disarmed run (arming observes, never perturbs). Phase 2
+    (planted inversion): a rogue maintenance thread grabs the armed
+    engine's observer lock and then reaches back for the engine lock —
+    the twin must raise LockOrderViolation deterministically (checked
+    against the acquiring thread's own held stack BEFORE blocking, so
+    the catch cannot depend on interleaving), naming the planted edge.
+    The drill plants the same inversion twice and asserts the two
+    violation messages are bit-identical (stable per seed)."""
+    import threading
+    import zlib
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import EngineConfig, ServingEngine
+    from paddle_tpu.serving import locking
+
+    paddle.seed(seed % (2 ** 31))
+    cfg = LlamaConfig.tiny(vocab_size=61, hidden_size=32, layers=2,
+                           heads=4, kv_heads=2, seq=64)
+    cfg.use_flash_attention = False
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(1, 61, (6 + i % 4,)).tolist() for i in range(4)]
+
+    def serve(arm: bool):
+        eng = ServingEngine(model, EngineConfig(
+            max_seqs=2, token_budget=16, block_size=8,
+            enable_prefix_cache=False, obs=True))
+        locking.arm(arm)
+        try:
+            out = eng.generate_batch(prompts, max_new_tokens=6)
+        finally:
+            locking.arm(False)
+        return eng, out
+
+    _, out_off = serve(False)
+    eng, out_on = serve(True)
+    assert out_on == out_off, \
+        "arming the lock twin perturbed the served tokens"
+    crc = zlib.crc32(json.dumps(out_on).encode()) & 0xFFFFFFFF
+
+    def plant():
+        caught = []
+
+        def rogue():
+            try:
+                with eng.obs._lock:       # observer held first...
+                    with eng._lock:       # ...then the engine: inverted
+                        pass
+            except locking.LockOrderViolation as e:
+                caught.append(str(e))
+
+        t = threading.Thread(target=rogue, name="rogue-maintenance")
+        t.start()
+        t.join(timeout=30)
+        assert not t.is_alive(), "planted-inversion thread hung"
+        return caught
+
+    locking.arm(True)
+    try:
+        first, second = plant(), plant()
+    finally:
+        locking.arm(False)
+    assert first, "planted observer->engine inversion escaped the twin"
+    assert first == second, \
+        f"violation not deterministic: {first} != {second}"
+    assert "observer" in first[0] and "engine" in first[0], first[0]
+
+    report = {
+        "seed": seed, "ok": True,
+        "stable": {
+            "lock_order": list(locking.LOCK_ORDER),
+            "tokens_crc": crc,
+            "violation": first[0],
+        },
+    }
+    if verbose:
+        print(f"lockcheck drill (seed={seed}): armed clean run "
+              f"bit-identical to disarmed (crc {crc}); planted "
+              f"observer->engine inversion caught deterministically: "
+              f"{first[0]!r} — ordered-lock twin verified")
+    return report
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seed", type=int, default=1234)
@@ -1339,6 +1442,11 @@ def main(argv=None):
                          "backoff-and-hold; retire-during-burst "
                          "replays its manifest onto survivors; stable "
                          "per seed)")
+    ap.add_argument("--lockcheck", action="store_true",
+                    help="run the armed ordered-lock drill (armed "
+                         "serving run bit-identical to disarmed; a "
+                         "planted observer->engine inversion raises "
+                         "LockOrderViolation deterministically)")
     args = ap.parse_args(argv)
     if args.preempt:
         report = run_preempt_drill(seed=args.seed, verbose=not args.json,
@@ -1360,6 +1468,9 @@ def main(argv=None):
     elif args.elastic:
         report = run_elastic_drill(seed=args.seed,
                                    verbose=not args.json)
+    elif args.lockcheck:
+        report = run_lockcheck_drill(seed=args.seed,
+                                     verbose=not args.json)
     else:
         report = run_drill(seed=args.seed, verbose=not args.json)
     if args.json:

@@ -1,4 +1,4 @@
-"""Framework lint driver: all three analysis passes over the repo, CI-gated.
+"""Framework lint driver: all four analysis passes over the repo, CI-gated.
 
     python tools/lint.py                  # lint the shipped tree (exit 0)
     python tools/lint.py path/to/file.py  # lint specific files/dirs
@@ -7,23 +7,30 @@
     python tools/lint.py --update-baseline
 
 Pass 1 (AST, stdlib-only, fast): every rule in paddle_tpu.analysis.rules
-— the TPU and SHD1xx families — over paddle_tpu/, tools/, examples/ and
-tests/. Pass 2 (trace, imports JAX; skip with --no-trace):
-trace-sanitizes a representative train-step function built from the
-framework's own layers, and — when --schedules <dir> points at logs
-captured via PADDLE_SCHEDULE_LOG — checks the recorded per-rank
+— the TPU, SHD1xx and CCY families — over paddle_tpu/, tools/,
+examples/ and tests/. Pass 2 (trace, imports JAX; skip with
+--no-trace): trace-sanitizes a representative train-step function built
+from the framework's own layers, and — when --schedules <dir> points at
+logs captured via PADDLE_SCHEDULE_LOG — checks the recorded per-rank
 collective schedules for divergence. Pass 3 (shard, imports JAX; skip
 with --no-shard): abstractly evaluates a representative sharded step
 over a dp×mp mesh with paddle_tpu.analysis.shardcheck — divisibility +
 implicit-reshard findings (SHD2xx) plus a per-op layout report whose
 stable subset is diffed against tools/layout_baseline.json (SHD210 on
-drift). All of it runs on CPU with no devices: the mesh is abstract.
+drift). Pass 4 (concur, stdlib-only; skip with --no-concur): the
+serving concurrency gate — the CCY1xx/2xx AST rules ride pass 1, and
+paddle_tpu.analysis.concurcheck additionally proves the lock-order /
+request-lifecycle registries are coherent and byte-identical to what
+the runtime ordered-lock twin (PADDLE_LOCKCHECK=1) enforces (CCY5xx).
+All of it runs on CPU with no devices: the mesh is abstract.
 
-Findings are diffed against the committed baseline
-(tools/lint_baseline.json, shipped EMPTY: the tree self-hosts clean);
-any finding not in the baseline prints with its rule id and fix hint and
-the driver exits nonzero. tests/test_analysis.py and
-tests/test_shardcheck.py run the same gates as tier-1 tests.
+Findings are diffed against the committed baselines — CCY findings
+against tools/concur_baseline.json, everything else against
+tools/lint_baseline.json (both shipped EMPTY: the tree self-hosts
+clean); any finding not in its baseline prints with its rule id and fix
+hint and the driver exits nonzero. tests/test_analysis.py,
+tests/test_shardcheck.py and tests/test_concurcheck.py run the same
+gates as tier-1 tests.
 """
 from __future__ import annotations
 
@@ -51,6 +58,7 @@ def _bootstrap_analysis_pkg():
 
 DEFAULT_PATHS = ["paddle_tpu", "tools", "examples", "tests"]
 BASELINE = os.path.join(REPO, "tools", "lint_baseline.json")
+CONCUR_BASELINE = os.path.join(REPO, "tools", "concur_baseline.json")
 LAYOUT_BASELINE = os.path.join(REPO, "tools", "layout_baseline.json")
 PERF_CONFIG = os.path.join(REPO, "PERF_CONFIG.json")
 PERF_LEDGER = os.path.join(REPO, "PERF_LEDGER.jsonl")
@@ -74,6 +82,12 @@ def _print_fix_hints():
     from paddle_tpu.analysis.shardcheck import SHARD_RULES  # stdlib-only
     print("Layout-evaluator rules (reported by shardcheck.layout_check):\n")
     for rid, (name, hint) in sorted(SHARD_RULES.items()):
+        print(f"  {rid} {name}")
+        print(f"      fix:  {hint}\n")
+    from paddle_tpu.analysis.concurcheck import CONCUR_RULES  # stdlib-only
+    print("Concurrency-registry rules (reported by "
+          "concurcheck.concur_check):\n")
+    for rid, (name, hint) in sorted(CONCUR_RULES.items()):
         print(f"  {rid} {name}")
         print(f"      fix:  {hint}\n")
     # trace rules live beside the trace pass; import lazily (needs jax)
@@ -263,6 +277,13 @@ def main(argv=None) -> int:
     ap.add_argument("--shard", action="store_true",
                     help="run the shardcheck pass (the default; kept as "
                          "an explicit spelling for CI scripts)")
+    ap.add_argument("--no-concur", action="store_true",
+                    help="skip the serving-concurrency pass (drop CCY "
+                         "findings and the registry-coherence check)")
+    ap.add_argument("--concur", action="store_true",
+                    help="run the concurrency pass (the default; kept as "
+                         "an explicit spelling for CI scripts)")
+    ap.add_argument("--concur-baseline", default=CONCUR_BASELINE)
     ap.add_argument("--layout-report", default=None, metavar="FILE",
                     help="dump the per-op layout report JSON to FILE")
     ap.add_argument("--schedules", default=None, metavar="DIR",
@@ -295,7 +316,16 @@ def main(argv=None) -> int:
     paths = [os.path.join(REPO, p) if not os.path.exists(p) else p
              for p in (args.paths or DEFAULT_PATHS)]
     findings = lint_paths(paths)
+    if args.no_concur:
+        findings = [f for f in findings if not f.rule.startswith("CCY")]
     n_ast = len(findings)
+
+    # serving-concurrency registry coherence (stdlib, rides the AST
+    # pass): the CCY1xx/2xx rules above already ran as part of
+    # lint_paths; this adds the CCY5xx static/runtime coherence check
+    if not args.no_concur:
+        from paddle_tpu.analysis.concurcheck import concur_check
+        findings.extend(concur_check())
 
     # perf-config provenance (stdlib, rides the AST pass): committed
     # config is checked by default; --perf-config points at another
@@ -331,13 +361,31 @@ def main(argv=None) -> int:
         findings.extend(
             check_collective_schedules(load_schedules(args.schedules)))
 
+    # CCY findings diff against their own baseline so adopting (or
+    # retiring) the concurrency gate never rewrites the long-lived
+    # three-pass baseline file
     baseline = _load_baseline(args.baseline)
-    fresh = [f for f in findings if f.key() not in baseline]
+    concur_baseline = _load_baseline(args.concur_baseline)
+
+    def _known(f):
+        pool = concur_baseline if f.rule.startswith("CCY") else baseline
+        return f.key() in pool
+
+    fresh = [f for f in findings if not _known(f)]
 
     if args.update_baseline:
+        ccy_keys = sorted(f2.key() for f2 in findings
+                          if f2.rule.startswith("CCY"))
+        rest_keys = sorted(f2.key() for f2 in findings
+                           if not f2.rule.startswith("CCY"))
         with open(args.baseline, "w") as f:
-            json.dump(sorted(f2.key() for f2 in findings), f, indent=1)
-        print(f"wrote {len(findings)} finding keys to {args.baseline}")
+            json.dump(rest_keys, f, indent=1)
+        print(f"wrote {len(rest_keys)} finding keys to {args.baseline}")
+        if not args.no_concur:
+            with open(args.concur_baseline, "w") as f:
+                json.dump(ccy_keys, f, indent=1)
+            print(f"wrote {len(ccy_keys)} finding keys to "
+                  f"{args.concur_baseline}")
         if layout_report is not None:
             from paddle_tpu.analysis.shardcheck import baseline_view
             with open(LAYOUT_BASELINE, "w") as f:
@@ -357,8 +405,8 @@ def main(argv=None) -> int:
         dt = time.perf_counter() - t0
         known = len(findings) - len(fresh)
         print(f"\nlint: {n_ast} ast + {len(findings) - n_ast} "
-              f"trace/shard finding(s), {known} baselined, {len(fresh)} "
-              f"new ({dt:.1f}s)")
+              f"trace/shard/concur finding(s), {known} baselined, "
+              f"{len(fresh)} new ({dt:.1f}s)")
     errors = [f for f in fresh if f.severity == "error"]
     return 1 if errors else 0
 
