@@ -1,12 +1,13 @@
 """Static analysis + trace sanitation: catch TPU sharp bits before a run.
 
-Four cooperating passes (driven together by ``tools/lint.py``):
+Five cooperating passes (driven together by ``tools/lint.py``):
 
 * ``analysis.astlint`` / ``analysis.rules`` / ``analysis.shard_rules``
-  / ``analysis.concur_rules`` — stdlib-only AST linting of the
-  framework's machine-checkable invariants, including the
-  sharding/layout surface and the serving tier's concurrency +
-  request-lifecycle discipline.
+  / ``analysis.concur_rules`` / ``analysis.wire_rules`` — stdlib-only
+  AST linting of the framework's machine-checkable invariants,
+  including the sharding/layout surface, the serving tier's
+  concurrency + request-lifecycle discipline, and the wire contracts
+  of every record that crosses a process/host boundary.
 * ``analysis.tracecheck`` — dynamic: traces a step function and flags
   recompile hazards, host syncs, wasted donations, and (with per-rank
   schedules captured by ``analysis.schedule``) cross-rank collective
@@ -19,6 +20,11 @@ Four cooperating passes (driven together by ``tools/lint.py``):
   lock-order/lifecycle registries the CCY rules parse are internally
   coherent and byte-identical to what the runtime ordered-lock twin
   (``serving.locking``, armed via ``PADDLE_LOCKCHECK``) enforces.
+* ``analysis.wirecheck`` — wire-registry coherence: proves the
+  ``serving.wire.WIRE_SCHEMAS`` record registry the WIR rules parse is
+  internally coherent (version pins, key-hash pins) and byte-identical
+  to what the runtime sealing twin (``serving.wire.seal``, armed via
+  ``PADDLE_WIRECHECK``) enforces at the producing/consuming seams.
 
 Rule families (every id is greppable from this one table):
 
@@ -51,6 +57,12 @@ CCY2xx   request lifecycle: state assignments outside
          exactly one terminal trace event
 CCY5xx   concurrency-registry coherence: incoherent lock/lifecycle
          registries, static/runtime ordered-lock drift
+WIR1xx   wire contracts: impure values in cross-process records,
+         undeclared key writes/reads against WIRE_SCHEMAS, masked
+         required reads, unversioned records, floats in
+         prefix-key/crc positions, nondeterministic serialization
+WIR5xx   wire-registry coherence: incoherent schema registry, schema
+         edits without a version/key-hash bump, static/runtime drift
 ======== ====================================================================
 
 The linter half (TPU/SHD1xx) is stdlib-only; the trace half (TRC) needs
@@ -62,6 +74,7 @@ from __future__ import annotations
 
 from . import concurcheck  # noqa: F401  (stdlib-only)
 from . import schedule  # noqa: F401  (stdlib-only)
+from . import wirecheck  # noqa: F401  (stdlib-only)
 from . import shardcheck  # noqa: F401  (stdlib-only at import time)
 from .astlint import (iter_python_files, lint_file, lint_paths,  # noqa: F401
                       lint_source)
@@ -74,6 +87,8 @@ from .rules import (RULES, Finding, get_rule,  # noqa: F401
 from .shard_rules import load_known_axes  # noqa: F401
 from .shardcheck import (SHARD_RULES, layout_check,  # noqa: F401
                          layout_report)
+from .wire_rules import load_wire_schemas  # noqa: F401
+from .wirecheck import WIRE_RULES, wire_check  # noqa: F401
 
 __all__ = [
     "Finding", "RULES", "get_rule", "rule_table",
@@ -82,6 +97,7 @@ __all__ = [
     "load_known_axes", "load_lock_order", "load_request_transitions",
     "SHARD_RULES", "layout_check", "layout_report", "shardcheck",
     "CONCUR_RULES", "concur_check", "concurcheck",
+    "WIRE_RULES", "wire_check", "wirecheck", "load_wire_schemas",
     "schedule", "trace_check", "check_collective_schedules", "TRACE_RULES",
 ]
 

@@ -624,9 +624,10 @@ def _check_mutable_defaults(ctx: FileContext):
                         f"mutable default in {node.name}.__init__")
 
 
-# SHD1xx (sharding/layout) and CCY1xx/2xx (concurrency/lifecycle)
-# rules register themselves into RULES; the imports sit at the bottom
-# so each module can import this module's half-initialized namespace
-# (everything they need is defined above).
+# SHD1xx (sharding/layout), CCY1xx/2xx (concurrency/lifecycle) and
+# WIR1xx (wire-contract) rules register themselves into RULES; the
+# imports sit at the bottom so each module can import this module's
+# half-initialized namespace (everything they need is defined above).
 from . import shard_rules  # noqa: E402,F401
 from . import concur_rules  # noqa: E402,F401
+from . import wire_rules  # noqa: E402,F401

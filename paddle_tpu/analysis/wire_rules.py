@@ -1,0 +1,559 @@
+"""WIR1xx — static wire-contract rules (the wirecheck fifth pass).
+
+Every record that crosses (or will cross) a process/host boundary is
+declared in ``serving/wire.py``'s ``WIRE_SCHEMAS`` — one entry per
+family (kv_export_record, drain_manifest, fleet_signals,
+autoscale_event, flight_dump, checkpoint_meta, telemetry_line) with its
+version, required/optional keys, per-key JSON-pure type specs and the
+functions that build/read/write it. These rules parse that registry
+statically (``ast.literal_eval`` — no jax, no imports at lint time, the
+same contract as every other ground-truth reader) and police the code
+the registry names, so cross-process compatibility stops depending on
+reviewer memory before ROADMAP 2's multi-host rungs put the records on
+an actual wire.
+
+Rules (all framework-only; suppress a line with
+``# tpu-lint: disable=WIR101``):
+
+  WIR101  non-wire-pure-value — a set/bytes/datetime/numpy-scalar/
+          device-array expression flowing into a declared record key
+          (device-typed keys, the KV payload plane, are exempt).
+  WIR102  undeclared-key-write — a builder writes a key the family's
+          schema does not declare: drift caught at the write site, not
+          when a peer chokes on the file.
+  WIR103  masked-required-read — a consumer reads an undeclared key, or
+          ``.get()``s a key the schema marks REQUIRED (masking its
+          absence with a default instead of failing at the seam). The
+          version key is exempt: reading it via ``.get`` IS the
+          version gate.
+  WIR104  unversioned-record — a builder returns a record literal
+          without the family's version key, or pins a version constant
+          that contradicts the registry. (The registry-side half —
+          a schema edited without a version bump — is WIR511 in
+          ``analysis/wirecheck.py``.)
+  WIR105  float-in-key-position — a float/str/object expression flowing
+          into a ``prefix_keys``/``crc`` position: hash-chain prefix
+          keys and routing keys must stay ints/tuples by construction
+          or affinity breaks across hosts.
+  WIR106  nondeterministic-serialization — iterating a set (or
+          ``list(set(...))``) while building wire-tier content, or
+          ``json.dump`` without ``sort_keys=True`` in a sink of a
+          byte-stable family: byte-stability pins (tokens-crc, telemetry
+          diffing) need deterministic order.
+
+Registered into ``rules.RULES`` on import (rules.py imports this module
+at the bottom of its own body, after concur_rules).
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import os
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from .rules import (FileContext, _finding, _literal_from_source,
+                    _own_body_walk, _PKG_ROOT, _register)
+
+__all__ = ["load_wire_schemas", "load_non_wire_sinks", "wire_tail"]
+
+_WIRE_PATH = os.path.join(_PKG_ROOT, "serving", "wire.py")
+
+
+# -- static ground-truth readers ----------------------------------------------
+@functools.lru_cache(maxsize=1)
+def _wire_registry():
+    return (_literal_from_source(_WIRE_PATH, "WIRE_SCHEMAS"),
+            tuple(_literal_from_source(_WIRE_PATH, "NON_WIRE_SINKS")))
+
+
+def load_wire_schemas() -> Dict[str, dict]:
+    """{family: schema entry}, read statically from serving/wire.py's
+    WIRE_SCHEMAS registry (the runtime twin loads the same literal —
+    WIR520 pins the two views identical)."""
+    return dict(_wire_registry()[0])
+
+
+def load_non_wire_sinks() -> Tuple[str, ...]:
+    """Serving-tier JSON writers declared render-only (chrome traces):
+    exempt from the registry-drift gate."""
+    return _wire_registry()[1]
+
+
+def wire_tail(path: str) -> str:
+    """The registry's file spelling: last two path components."""
+    return "/".join(path.replace(os.sep, "/").split("/")[-2:])
+
+
+# -- per-file binding ---------------------------------------------------------
+class _WireInfo:
+    __slots__ = ("builders", "consumers", "item_consumers", "sinks",
+                 "wire_file")
+
+    def __init__(self):
+        # function name -> [family, ...] / [(family, var), ...]
+        self.builders: Dict[str, List[str]] = {}
+        self.consumers: Dict[str, List[Tuple[str, str]]] = {}
+        self.item_consumers: Dict[str, List[Tuple[str, str]]] = {}
+        self.sinks: Dict[str, List[str]] = {}
+        self.wire_file = False      # any binding at all (WIR106 scope)
+
+
+def _wire_info(ctx: FileContext) -> _WireInfo:
+    cached = getattr(ctx, "_wir_info", None)
+    if cached is not None:
+        return cached
+    info = _WireInfo()
+    tail = wire_tail(ctx.path)
+    for fam, spec in load_wire_schemas().items():
+        for spelling in spec["builders"]:
+            fspec, _, fname = spelling.partition("::")
+            if fspec == tail:
+                info.builders.setdefault(fname, []).append(fam)
+        for spelling, var in spec["consumers"]:
+            fspec, _, fname = spelling.partition("::")
+            if fspec == tail:
+                info.consumers.setdefault(fname, []).append((fam, var))
+        for spelling, var in spec["item_consumers"]:
+            fspec, _, fname = spelling.partition("::")
+            if fspec == tail:
+                info.item_consumers.setdefault(fname, []).append(
+                    (fam, var))
+        for spelling in spec["sinks"]:
+            fspec, _, fname = spelling.partition("::")
+            if fspec == tail:
+                info.sinks.setdefault(fname, []).append(fam)
+    info.wire_file = bool(info.builders or info.consumers or info.sinks)
+    ctx._wir_info = info
+    return info
+
+
+def _module_const(ctx: FileContext, name: str):
+    """Module-level ``NAME = <constant>`` value, or None."""
+    for node in ctx.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == name \
+                and isinstance(node.value, ast.Constant):
+            return node.value.value
+    return None
+
+
+def _record_roots(fn, vkey: str) -> List[ast.Dict]:
+    """Dict literals in ``fn`` whose top-level keys include the
+    family's version key — the record-construction sites."""
+    roots = []
+    for n in _own_body_walk(fn):
+        if isinstance(n, ast.Dict):
+            for k in n.keys:
+                if isinstance(k, ast.Constant) and k.value == vkey:
+                    roots.append(n)
+                    break
+    return roots
+
+
+def _record_vars(fn, fam: str, schemas: Dict[str, dict]) -> set:
+    """Names in ``fn`` bound to a record of ``fam``: assigned from a
+    record-root dict literal, or from a call to another declared
+    builder of the SAME family (``record = pool.export_pages(...)``)."""
+    vkey = schemas[fam]["version_key"]
+    bare = {s.partition("::")[2] for s in schemas[fam]["builders"]}
+    out = set()
+    for n in _own_body_walk(fn):
+        if not isinstance(n, ast.Assign) or len(n.targets) != 1 \
+                or not isinstance(n.targets[0], ast.Name):
+            continue
+        v = n.value
+        if isinstance(v, ast.Dict) and any(
+                isinstance(k, ast.Constant) and k.value == vkey
+                for k in v.keys):
+            out.add(n.targets[0].id)
+        elif isinstance(v, ast.Call):
+            callee = v.func.attr if isinstance(v.func, ast.Attribute) \
+                else getattr(v.func, "id", None)
+            if callee in bare:
+                out.add(n.targets[0].id)
+    return out
+
+
+def _writes_in(fn, fam: str, schemas: Dict[str, dict]
+               ) -> Iterable[Tuple[str, ast.AST, ast.AST]]:
+    """(key, value expr, report node) for every statically visible
+    write into a record of ``fam`` inside ``fn``: record-root literal
+    entries, item-row literal entries, and subscript stores on tracked
+    record variables."""
+    spec = schemas[fam]
+    vkey = spec["version_key"]
+    item_req = spec["item_required"]
+    rvars = _record_vars(fn, fam, schemas)
+    roots = []
+    for n in _own_body_walk(fn):
+        if isinstance(n, ast.Dict):
+            keys = [k.value for k in n.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)]
+            if vkey in keys:
+                roots.append(n)
+            elif item_req and len(set(keys) & set(item_req)) >= 2:
+                # an item-row literal (shares >= 2 required row keys)
+                for k, v in zip(n.keys, n.values):
+                    if isinstance(k, ast.Constant) \
+                            and isinstance(k.value, str):
+                        yield ("\0item\0" + k.value, v, k)
+        elif isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                and isinstance(n.targets[0], ast.Subscript) \
+                and isinstance(n.targets[0].value, ast.Name) \
+                and n.targets[0].value.id in rvars \
+                and isinstance(n.targets[0].slice, ast.Constant) \
+                and isinstance(n.targets[0].slice.value, str):
+            yield (n.targets[0].slice.value, n.value, n.targets[0])
+    for root in roots:
+        for k, v in zip(root.keys, root.values):
+            if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                yield (k.value, v, k)
+
+
+# -- impurity classifiers -----------------------------------------------------
+_IMPURE_CTORS = {"set", "frozenset", "bytes", "bytearray"}
+_IMPURE_DOTTED_PREFIXES = ("numpy.", "jax.numpy.", "jnp.")
+_IMPURE_DOTTED = {"datetime.datetime.now", "datetime.datetime.utcnow",
+                  "datetime.date.today", "datetime.datetime.today",
+                  "jax.device_put", "jax.numpy.asarray"}
+_IMPURE_METHODS = {"tobytes", "numpy"}
+
+
+def _impure_reason(ctx: FileContext, node) -> Optional[str]:
+    """Why ``node`` can never be a wire-pure value, or None."""
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return "a set (unordered, not JSON)"
+    if isinstance(node, ast.Constant) and isinstance(node.value, bytes):
+        return "bytes (not JSON)"
+    if isinstance(node, ast.Call):
+        callee = getattr(node.func, "id", None)
+        if callee in _IMPURE_CTORS:
+            return f"{callee}() (not JSON)"
+        if isinstance(node.func, ast.Attribute):
+            if node.func.attr in _IMPURE_METHODS:
+                return f".{node.func.attr}() (raw buffer/array)"
+            dotted = ctx.dotted(node.func) or ""
+            if dotted in _IMPURE_DOTTED:
+                return f"{dotted}() (not JSON-stable)"
+            if dotted.startswith(_IMPURE_DOTTED_PREFIXES):
+                return (f"{dotted}() (numpy/device scalar — wrap in "
+                        f"int()/float()/.tolist())")
+    return None
+
+
+def _nonint_reason(node) -> Optional[str]:
+    """Why ``node`` can never be an int/int-tuple key, or None."""
+    if isinstance(node, ast.Constant):
+        if isinstance(node.value, float):
+            return f"the float literal {node.value!r}"
+        if isinstance(node.value, str):
+            return f"the str literal {node.value!r}"
+    if isinstance(node, (ast.Dict, ast.Set, ast.SetComp)):
+        return "a dict/set"
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Div):
+        return "a true division (float)"
+    if isinstance(node, ast.Call):
+        callee = getattr(node.func, "id", None)
+        if callee == "float":
+            return "float()"
+        if callee == "round" and len(node.args) == 2:
+            return "round(x, n) (float)"
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "time":
+            return "a wall-clock float"
+    return None
+
+
+# =============================================================================
+# WIR101/102/104/105 — producer-side rules
+# =============================================================================
+@_register(
+    "WIR101", "non-wire-pure-value",
+    "a set/bytes/datetime/numpy-scalar/device expression flows into a "
+    "declared wire record: it will not survive a JSON hop between "
+    "hosts (device-typed keys are the exempt payload plane)",
+    "convert at the write site: sorted(...) for sets, int()/float()/"
+    ".tolist() for numpy, an epoch float for datetimes",
+    framework_only=True)
+def _rule_wir101(ctx: FileContext):
+    info = _wire_info(ctx)
+    if not info.builders:
+        return
+    schemas = load_wire_schemas()
+    for fn in ctx.functions():
+        for fam in info.builders.get(fn.name, ()):
+            spec = schemas[fam]
+            for key, value, rep in _writes_in(fn, fam, schemas):
+                if key.startswith("\0item\0"):
+                    key = key[6:]
+                    tspec = spec["item_required"].get(key) \
+                        or spec["item_optional"].get(key, "")
+                else:
+                    tspec = spec["required"].get(key) \
+                        or spec["optional"].get(key, "")
+                if tspec == "device":
+                    continue
+                reason = _impure_reason(ctx, value)
+                if reason:
+                    yield _finding(
+                        ctx_rule("WIR101"), ctx, rep,
+                        f"{fam} record key '{key}' is assigned "
+                        f"{reason} in {fn.name}()")
+
+
+@_register(
+    "WIR102", "undeclared-key-write",
+    "a builder writes a key absent from the family's WIRE_SCHEMAS "
+    "entry — schema drift at the write site, invisible until a peer "
+    "process chokes on the record",
+    "declare the key (with a type spec) in serving/wire.py and bump "
+    "the family version, or drop the write",
+    framework_only=True)
+def _rule_wir102(ctx: FileContext):
+    info = _wire_info(ctx)
+    if not info.builders:
+        return
+    schemas = load_wire_schemas()
+    for fn in ctx.functions():
+        for fam in info.builders.get(fn.name, ()):
+            spec = schemas[fam]
+            declared = set(spec["required"]) | set(spec["optional"])
+            item_declared = set(spec["item_required"]) \
+                | set(spec["item_optional"])
+            for key, _value, rep in _writes_in(fn, fam, schemas):
+                if key.startswith("\0item\0"):
+                    key = key[6:]
+                    if key not in item_declared:
+                        yield _finding(
+                            ctx_rule("WIR102"), ctx, rep,
+                            f"{fam} row key '{key}' written in "
+                            f"{fn.name}() is not declared in the "
+                            f"item schema")
+                elif key not in declared:
+                    yield _finding(
+                        ctx_rule("WIR102"), ctx, rep,
+                        f"{fam} key '{key}' written in {fn.name}() "
+                        f"is not declared in WIRE_SCHEMAS")
+
+
+@_register(
+    "WIR103", "masked-required-read",
+    "a consumer reads an undeclared key, or .get()s a key the schema "
+    "marks REQUIRED — the default masks a torn/drifted record instead "
+    "of failing at the seam (the version key is exempt: reading it "
+    "via .get IS the version gate)",
+    "index required keys directly (record['key']); declare new keys "
+    "in serving/wire.py before reading them",
+    framework_only=True)
+def _rule_wir103(ctx: FileContext):
+    info = _wire_info(ctx)
+    if not (info.consumers or info.item_consumers):
+        return
+    schemas = load_wire_schemas()
+    for fn in ctx.functions():
+        bindings = []
+        for fam, var in info.consumers.get(fn.name, ()):
+            spec = schemas[fam]
+            bindings.append((fam, var, spec["version_key"],
+                             spec["required"], spec["optional"]))
+        for fam, var in info.item_consumers.get(fn.name, ()):
+            spec = schemas[fam]
+            bindings.append((fam, var, None, spec["item_required"],
+                             spec["item_optional"]))
+        for fam, var, vkey, required, optional in bindings:
+            for n in _own_body_walk(fn):
+                key = None
+                masked = False
+                if isinstance(n, ast.Subscript) \
+                        and isinstance(n.value, ast.Name) \
+                        and n.value.id == var \
+                        and isinstance(n.ctx, ast.Load) \
+                        and isinstance(n.slice, ast.Constant) \
+                        and isinstance(n.slice.value, str):
+                    key = n.slice.value
+                elif isinstance(n, ast.Call) \
+                        and isinstance(n.func, ast.Attribute) \
+                        and n.func.attr == "get" \
+                        and isinstance(n.func.value, ast.Name) \
+                        and n.func.value.id == var \
+                        and n.args \
+                        and isinstance(n.args[0], ast.Constant) \
+                        and isinstance(n.args[0].value, str):
+                    key = n.args[0].value
+                    masked = True
+                if key is None or key == vkey:
+                    continue
+                if key not in required and key not in optional:
+                    yield _finding(
+                        ctx_rule("WIR103"), ctx, n,
+                        f"{fn.name}() reads undeclared {fam} key "
+                        f"'{key}' from '{var}'")
+                elif masked and key in required:
+                    yield _finding(
+                        ctx_rule("WIR103"), ctx, n,
+                        f"{fn.name}() .get()s required {fam} key "
+                        f"'{key}' — a missing key must fail at the "
+                        f"seam, not default through")
+
+
+@_register(
+    "WIR104", "unversioned-record",
+    "a builder returns a record without the family's version key, or "
+    "pins a version constant that contradicts WIRE_SCHEMAS — the "
+    "consumer generation gate cannot work on unversioned records",
+    "write the version key first ('version': N matching the registry); "
+    "schema edits bump the version AND append a key_hashes pin",
+    framework_only=True)
+def _rule_wir104(ctx: FileContext):
+    info = _wire_info(ctx)
+    if not info.builders:
+        return
+    schemas = load_wire_schemas()
+    for fn in ctx.functions():
+        for fam in info.builders.get(fn.name, ()):
+            spec = schemas[fam]
+            vkey = spec["version_key"]
+            for n in _own_body_walk(fn):
+                if not isinstance(n, ast.Return):
+                    continue
+                ret = n.value
+                if isinstance(ret, ast.Call):
+                    # look through `return seal({...}, fam)` wrappers
+                    dicts = [a for a in ret.args
+                             if isinstance(a, ast.Dict)]
+                    ret = dicts[0] if dicts else None
+                if not isinstance(ret, ast.Dict):
+                    continue
+                keys = [k.value for k in ret.keys
+                        if isinstance(k, ast.Constant)]
+                if vkey not in keys:
+                    yield _finding(
+                        ctx_rule("WIR104"), ctx, n,
+                        f"{fn.name}() returns a {fam} record without "
+                        f"its version key '{vkey}'")
+            for root in _record_roots(fn, vkey):
+                for k, v in zip(root.keys, root.values):
+                    if not (isinstance(k, ast.Constant)
+                            and k.value == vkey):
+                        continue
+                    got = None
+                    if isinstance(v, ast.Constant):
+                        got = v.value
+                    elif isinstance(v, ast.Name):
+                        got = _module_const(ctx, v.id)
+                    if got is not None and got != spec["version"]:
+                        yield _finding(
+                            ctx_rule("WIR104"), ctx, k,
+                            f"{fn.name}() pins {fam} {vkey}={got!r} "
+                            f"but WIRE_SCHEMAS declares "
+                            f"{spec['version']}")
+
+
+@_register(
+    "WIR105", "float-in-key-position",
+    "a float/str/object expression flows into a prefix_keys/crc "
+    "position: hash-chain prefix keys and routing keys must stay "
+    "ints/tuples by construction (PYTHONHASHSEED-stable, "
+    "JSON-roundtrip-exact) or cross-host affinity silently breaks",
+    "keep key material integral: hash(tuple), int(), zlib.crc32 — "
+    "never wall-clock floats, division results or str()",
+    framework_only=True)
+def _rule_wir105(ctx: FileContext):
+    info = _wire_info(ctx)
+    if not info.builders:
+        return
+    schemas = load_wire_schemas()
+    for fn in ctx.functions():
+        for fam in info.builders.get(fn.name, ()):
+            spec = schemas[fam]
+            for key, value, rep in _writes_in(fn, fam, schemas):
+                plain = key[6:] if key.startswith("\0item\0") else key
+                tspec = spec["required"].get(plain) \
+                    or spec["optional"].get(plain) \
+                    or spec["item_required"].get(plain) \
+                    or spec["item_optional"].get(plain, "")
+                if tspec not in ("prefix_keys", "crc"):
+                    continue
+                reason = _nonint_reason(value)
+                if reason:
+                    yield _finding(
+                        ctx_rule("WIR105"), ctx, rep,
+                        f"{fam} key position '{plain}' in {fn.name}() "
+                        f"is assigned {reason}")
+
+
+# =============================================================================
+# WIR106 — deterministic serialization order
+# =============================================================================
+def _set_expr(node) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    return isinstance(node, ast.Call) \
+        and getattr(node.func, "id", None) in ("set", "frozenset")
+
+
+@_register(
+    "WIR106", "nondeterministic-serialization",
+    "set iteration (or list(set(...))) while building wire-tier "
+    "content, or json.dump without sort_keys=True in a byte-stable "
+    "family's sink — serialization order must be deterministic where "
+    "a byte-stability pin (tokens-crc, telemetry diffing) exists",
+    "iterate sorted(the_set) (key=str for mixed None/str), and pass "
+    "sort_keys=True at byte-stable json.dump sites",
+    framework_only=True)
+def _rule_wir106(ctx: FileContext):
+    info = _wire_info(ctx)
+    if not info.wire_file:
+        return
+    schemas = load_wire_schemas()
+    for fn in ctx.functions():
+        # names bound to set expressions inside this function
+        set_vars = {n.targets[0].id for n in _own_body_walk(fn)
+                    if isinstance(n, ast.Assign)
+                    and len(n.targets) == 1
+                    and isinstance(n.targets[0], ast.Name)
+                    and _set_expr(n.value)}
+        for n in _own_body_walk(fn):
+            if isinstance(n, ast.For):
+                it = n.iter
+                if _set_expr(it) or (isinstance(it, ast.Name)
+                                     and it.id in set_vars):
+                    yield _finding(
+                        ctx_rule("WIR106"), ctx, n,
+                        f"{fn.name}() iterates a set — element order "
+                        f"is arbitrary, so the built record is not "
+                        f"byte-stable")
+            elif isinstance(n, ast.Call) \
+                    and getattr(n.func, "id", None) in ("list",
+                                                        "tuple") \
+                    and n.args and _set_expr(n.args[0]):
+                yield _finding(
+                    ctx_rule("WIR106"), ctx, n,
+                    f"{fn.name}() materializes a set in arbitrary "
+                    f"order — wrap it in sorted(...)")
+        # json.dump without sort_keys in a byte-stable family's sink
+        byte_stable = any(schemas[fam]["byte_stable"]
+                          for fam in info.sinks.get(fn.name, ()))
+        if not byte_stable:
+            continue
+        for n in _own_body_walk(fn):
+            if isinstance(n, ast.Call) \
+                    and (ctx.dotted(n.func) or "") in ("json.dump",
+                                                       "json.dumps"):
+                sorts = any(kw.arg == "sort_keys"
+                            and isinstance(kw.value, ast.Constant)
+                            and kw.value.value is True
+                            for kw in n.keywords)
+                if not sorts:
+                    yield _finding(
+                        ctx_rule("WIR106"), ctx, n,
+                        f"{fn.name}() json.dumps a byte-stable family "
+                        f"without sort_keys=True")
+
+
+# _finding takes a Rule; resolve lazily so decorator order cannot bite
+def ctx_rule(rule_id: str):
+    from .rules import RULES
+    return RULES[rule_id]

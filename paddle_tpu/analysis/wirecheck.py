@@ -1,0 +1,275 @@
+"""WIR5xx — wire-registry coherence (the wirecheck driver half).
+
+The static rules (WIR101..WIR106 in ``analysis/wire_rules.py``) and the
+runtime sealing twin (``serving/wire.seal``, armed via
+``PADDLE_WIRECHECK``) both take their ground truth from one literal
+registry: ``serving/wire.py``'s ``WIRE_SCHEMAS`` (+ the
+``NON_WIRE_SINKS`` exemption list). A linter whose registry is
+self-contradictory lies politely: it keeps exiting 0 while enforcing
+nothing. This module is the fifth lint pass's self-check:
+
+* **WIR510** — schema incoherence: a family whose ``family`` field
+  disagrees with its key, overlapping required/optional key sets, a
+  version key the schema does not declare, an unparseable type spec,
+  item specs without an ``item_key``, or a malformed builder/consumer/
+  sink spelling.
+* **WIR511** — version-hash mismatch: ``key_hashes`` lacks a pin for
+  the current version, or the pinned hash differs from the hash of the
+  declared key-set + type specs — a schema edited without a version
+  bump (the registry-side half of WIR104).
+* **WIR520** — static/runtime drift: the registry the runtime ``wire``
+  module actually exposes differs from the literal the static rules
+  parsed, its ``key_hash`` disagrees with the static computation, or
+  ``validate`` cannot accept a minimal well-formed record.
+
+Stdlib-only: the runtime ``wire`` module is loaded BY FILE PATH
+(``importlib.util.spec_from_file_location``), never through the
+``paddle_tpu.serving`` package — importing that package pulls the
+engine and therefore jax, which the lint driver must not need.
+"""
+from __future__ import annotations
+
+import functools
+import importlib.util
+import os
+import zlib
+from typing import List
+
+from .rules import Finding, _PKG_ROOT
+from .wire_rules import load_non_wire_sinks, load_wire_schemas
+
+__all__ = ["WIRE_RULES", "wire_check", "load_wire_module",
+           "static_key_hash"]
+
+WIRE_RULES = {
+    "WIR510": ("wire-schema-incoherent",
+               "serving/wire.py's WIRE_SCHEMAS must be internally "
+               "coherent: disjoint required/optional sets, a declared "
+               "version key, known type specs, and well-formed "
+               "builder/consumer/sink spellings — an incoherent "
+               "registry makes WIR101..WIR106 and the seal() twin "
+               "silently under-enforce"),
+    "WIR511": ("wire-version-hash-mismatch",
+               "each family's key_hashes must pin the current version "
+               "to the hash of its declared key-set + type specs; a "
+               "mismatch means the schema was edited without a version "
+               "bump — bump the version and append a fresh pin, never "
+               "overwrite an old one"),
+    "WIR520": ("static-runtime-wire-drift",
+               "the registry serving/wire.py exposes at runtime must be "
+               "byte-identical to the literal the static rules parse, "
+               "hash with the same key_hash, and validate a minimal "
+               "well-formed record — drift here means the armed twin "
+               "and the lint gate enforce different contracts"),
+}
+
+_WIRE_PATH = os.path.join(_PKG_ROOT, "serving", "wire.py")
+
+# the type-spec vocabulary (kept in sync with wire._type_ok; WIR510
+# rejects registry entries these cannot parse)
+_BASE_SPECS = {"int", "float", "number", "str", "bool", "none", "dict",
+               "list", "json", "device", "prefix_keys", "crc"}
+
+
+def _spec_ok(spec) -> bool:
+    if not isinstance(spec, str) or not spec:
+        return False
+    for part in spec.split("|"):
+        if part in _BASE_SPECS:
+            continue
+        if part.startswith("list[") and part.endswith("]") \
+                and _spec_ok(part[5:-1]):
+            continue
+        return False
+    return True
+
+
+def _finding(rule: str, message: str) -> Finding:
+    return Finding(rule, _WIRE_PATH, 0, 0, message, WIRE_RULES[rule][1])
+
+
+def static_key_hash(spec: dict) -> str:
+    """The schema-evolution pin, computed from the statically parsed
+    literal — deliberately reimplemented (not imported from the runtime
+    module) so WIR520 can catch the runtime half drifting."""
+    basis = repr((spec["version_key"],
+                  tuple(sorted(spec["required"].items())),
+                  tuple(sorted(spec["optional"].items())),
+                  spec.get("item_key"),
+                  tuple(sorted(spec.get("item_required", {}).items())),
+                  tuple(sorted(spec.get("item_optional", {}).items()))))
+    return f"{zlib.crc32(basis.encode('utf-8')) & 0xFFFFFFFF:08x}"
+
+
+@functools.lru_cache(maxsize=1)
+def load_wire_module():
+    """The runtime ``serving.wire`` module, loaded by file path so no
+    package __init__ (and hence no jax) runs. Shared by the lint
+    driver's WIR520 check, the wire tier-1 tests, and the chaos
+    drill's --wirecheck scenario."""
+    spec = importlib.util.spec_from_file_location(
+        "paddle_tpu_serving_wire_standalone", _WIRE_PATH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _minimal_record(spec: dict) -> dict:
+    """A smallest well-formed record of the family — what WIR520 feeds
+    the runtime validate() to prove the twin accepts its own schema."""
+    samples = {"int": 0, "float": 0.0, "number": 0, "str": "x",
+               "bool": False, "none": None, "dict": {}, "list": [],
+               "json": {}, "device": None, "prefix_keys": [],
+               "crc": 0}
+
+    def sample(tspec: str):
+        part = tspec.split("|")[0]
+        if part.startswith("list["):
+            return []
+        return samples.get(part)
+
+    rec = {k: sample(t) for k, t in spec["required"].items()}
+    rec[spec["version_key"]] = spec["version"]
+    return rec
+
+
+def _check_schema(out: List[Finding]) -> None:
+    schemas = load_wire_schemas()
+    if not schemas:
+        out.append(_finding("WIR510", "WIRE_SCHEMAS is empty"))
+        return
+    for fam, spec in sorted(schemas.items()):
+        if spec.get("family") != fam:
+            out.append(_finding(
+                "WIR510", f"entry {fam!r} declares family="
+                          f"{spec.get('family')!r}"))
+        req, opt = spec["required"], spec["optional"]
+        overlap = sorted(set(req) & set(opt))
+        if overlap:
+            out.append(_finding(
+                "WIR510", f"{fam}: keys {overlap} are both required "
+                          f"and optional"))
+        if spec["version_key"] not in req:
+            out.append(_finding(
+                "WIR510", f"{fam}: version key "
+                          f"{spec['version_key']!r} is not a required "
+                          f"key"))
+        if not isinstance(spec["version"], int) or spec["version"] < 1:
+            out.append(_finding(
+                "WIR510", f"{fam}: version must be an int >= 1, got "
+                          f"{spec['version']!r}"))
+        for where, mapping in (("required", req), ("optional", opt),
+                               ("item_required", spec["item_required"]),
+                               ("item_optional",
+                                spec["item_optional"])):
+            for key, tspec in sorted(mapping.items()):
+                if not _spec_ok(tspec):
+                    out.append(_finding(
+                        "WIR510", f"{fam}: {where}[{key!r}] has "
+                                  f"unknown type spec {tspec!r}"))
+        item_overlap = sorted(set(spec["item_required"])
+                              & set(spec["item_optional"]))
+        if item_overlap:
+            out.append(_finding(
+                "WIR510", f"{fam}: row keys {item_overlap} are both "
+                          f"required and optional"))
+        if spec["item_key"] is None and (spec["item_required"]
+                                         or spec["item_optional"]):
+            out.append(_finding(
+                "WIR510", f"{fam}: item specs declared without an "
+                          f"item_key"))
+        if spec["item_key"] is not None \
+                and spec["item_key"] not in req:
+            out.append(_finding(
+                "WIR510", f"{fam}: item_key {spec['item_key']!r} is "
+                          f"not a required key"))
+        for what in ("builders", "sinks"):
+            for s in spec[what]:
+                if not (isinstance(s, str) and s.count("::") == 1
+                        and s.split("::")[0].endswith(".py")
+                        and s.split("::")[1]):
+                    out.append(_finding(
+                        "WIR510", f"{fam}: malformed {what} spelling "
+                                  f"{s!r} (want 'dir/file.py::func')"))
+        for what in ("consumers", "item_consumers"):
+            for pair in spec[what]:
+                if not (isinstance(pair, tuple) and len(pair) == 2
+                        and isinstance(pair[0], str)
+                        and pair[0].count("::") == 1
+                        and isinstance(pair[1], str) and pair[1]):
+                    out.append(_finding(
+                        "WIR510", f"{fam}: malformed {what} entry "
+                                  f"{pair!r} (want ('dir/file.py::"
+                                  f"func', 'var'))"))
+    for s in load_non_wire_sinks():
+        if not (isinstance(s, str) and s.count("::") == 1):
+            out.append(_finding(
+                "WIR510", f"malformed NON_WIRE_SINKS spelling {s!r}"))
+
+
+def _check_version_hashes(out: List[Finding]) -> None:
+    for fam, spec in sorted(load_wire_schemas().items()):
+        pins = spec["key_hashes"]
+        pin = pins.get(spec["version"])
+        if pin is None:
+            out.append(_finding(
+                "WIR511", f"{fam}: key_hashes has no pin for the "
+                          f"current version {spec['version']} "
+                          f"(pinned: {sorted(pins)})"))
+            continue
+        want = static_key_hash(spec)
+        if pin != want:
+            out.append(_finding(
+                "WIR511", f"{fam}: key_hashes[{spec['version']}] is "
+                          f"{pin!r} but the declared keys hash to "
+                          f"{want!r} — schema edited without a "
+                          f"version bump"))
+
+
+def _check_runtime_twin(out: List[Finding]) -> None:
+    try:
+        mod = load_wire_module()
+    except Exception as e:  # pragma: no cover - import is stdlib-only
+        out.append(_finding(
+            "WIR520", f"runtime wire module failed to load "
+                      f"standalone: {e}"))
+        return
+    static = load_wire_schemas()
+    runtime = getattr(mod, "WIRE_SCHEMAS", None)
+    if runtime != static:
+        drift = sorted(set(static) ^ set(runtime or {})) or \
+            sorted(f for f in static if static[f] != (runtime or
+                                                      {}).get(f))
+        out.append(_finding(
+            "WIR520", f"runtime WIRE_SCHEMAS differs from the "
+                      f"statically parsed literal (families: "
+                      f"{drift})"))
+        return
+    if tuple(getattr(mod, "NON_WIRE_SINKS", ())) \
+            != load_non_wire_sinks():
+        out.append(_finding(
+            "WIR520", "runtime NON_WIRE_SINKS differs from the "
+                      "statically parsed literal"))
+    for fam, spec in sorted(static.items()):
+        if mod.key_hash(spec) != static_key_hash(spec):
+            out.append(_finding(
+                "WIR520", f"{fam}: runtime key_hash() disagrees with "
+                          f"the static computation"))
+        try:
+            mod.validate(_minimal_record(spec), fam)
+        except Exception as e:
+            out.append(_finding(
+                "WIR520", f"{fam}: runtime validate() rejects a "
+                          f"minimal well-formed record: {e}"))
+
+
+def wire_check() -> List[Finding]:
+    """The fifth lint pass's self-check: registry coherence + version
+    pins + runtime twin agreement. Returns WIR5xx findings (empty on a
+    healthy tree); tools/lint.py diffs them against
+    tools/wire_baseline.json."""
+    out: List[Finding] = []
+    _check_schema(out)
+    _check_version_hashes(out)
+    _check_runtime_twin(out)
+    return out

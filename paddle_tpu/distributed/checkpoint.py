@@ -386,12 +386,17 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
                     merged_state.update(m["state"])
                     for k, entries in m["storage"].items():
                         merged_storage.setdefault(k, []).extend(entries)
+                # function-level import: serving.wire is stdlib-only but
+                # its package __init__ is not, and distributed must not
+                # import serving at module scope (cycle via the mesh)
+                from ..serving.wire import seal as _seal
                 _atomic_write(
                     os.path.join(path, _META_NAME),
-                    json.dumps({"format": _FORMAT_VERSION,
-                                "world_size": nprocs,
-                                "state": merged_state,
-                                "storage": merged_storage}).encode())
+                    json.dumps(_seal({"format": _FORMAT_VERSION,
+                                      "world_size": nprocs,
+                                      "state": merged_state,
+                                      "storage": merged_storage},
+                                     "checkpoint_meta")).encode())
         _instr.record_checkpoint("save", time.perf_counter() - t0)
 
     if async_save:
@@ -556,6 +561,8 @@ def load_state_dict(state_dict, path, process_group=None,
         raise CheckpointCorruptionError(
             f"checkpoint metadata {path}/{_META_NAME} lacks "
             "state/storage sections")
+    from ..serving.wire import seal as _seal
+    _seal(meta, "checkpoint_meta")
     reader = _ChunkReader(path, verify=verify, retry_policy=retry_policy)
     parents = {}
     flat_target = _flatten(state_dict, parents=parents)
@@ -643,6 +650,8 @@ def verify_checkpoint(path: str, unique_id: Optional[int] = None) -> Dict:
         raise CheckpointCorruptionError(
             f"checkpoint metadata {path}/{_META_NAME} lacks "
             "state/storage sections")
+    from ..serving.wire import seal as _seal
+    _seal(meta, "checkpoint_meta")
     for key, entries in meta["storage"].items():
         for ent in entries:
             full = os.path.join(path, ent["file"])

@@ -64,6 +64,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from ..profiler import instrument as _instr
 from ..resilience import chaos
+from .wire import seal as _seal
 
 logger = logging.getLogger("paddle_tpu.serving.autoscaler")
 
@@ -133,13 +134,14 @@ class AutoscaleEvent:
     detail: Dict[str, Any] = field(default_factory=dict)
 
     def to_dict(self) -> Dict[str, Any]:
-        return {
+        return _seal({
+            "version": 1,
             "tick": self.tick, "passes": self.passes,
             "rule": self.rule, "action": self.action,
             "role": self.role, "replica": self.replica,
             "outcome": self.outcome, "reason": self.reason,
             "signal": dict(self.signal), "detail": dict(self.detail),
-        }
+        }, "autoscale_event")
 
 
 class FleetAutoscaler:

@@ -1400,6 +1400,7 @@ class ServingEngine:
         with self._lock:
             s = self.pool.stats
             base = {
+                "version": 1,
                 "steps": self.steps,
                 "tokens_generated": self.tokens_generated,
                 "queue_depth": self.sched.queue_depth(),

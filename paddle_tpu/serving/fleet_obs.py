@@ -60,6 +60,7 @@ from typing import Any, Dict, List, Optional
 from ..profiler import instrument as _instr
 from .locking import OrderedLock
 from .obs import _atomic_json
+from .wire import WireContractViolation, seal as _seal
 
 logger = logging.getLogger(__name__)
 
@@ -328,7 +329,7 @@ class FleetObserver:
             import mem_report
             out = {"hbm_gib": cfg.hbm_gib, "per_role": {}}
             roles = {getattr(e, "role", None) for e in router.replicas}
-            for role in roles:
+            for role in sorted(roles, key=str):
                 eng = next(e for e in router.replicas
                            if getattr(e, "role", None) == role)
                 plan = mem_report.plan(
@@ -420,7 +421,7 @@ class FleetObserver:
                                  for name in WINDOW_SIGNALS}
                 reps.append(row)
             derived = self._derived_locked(router)
-            return {
+            return _seal({
                 "version": SIGNALS_SCHEMA_VERSION,
                 "schema": "fleet_signals",
                 "unix_time": round(self._wall(time.monotonic()), 6),
@@ -432,7 +433,7 @@ class FleetObserver:
                 "autoscale": [dict(e) for e in self.autoscale_events],
                 "dumps": [dict(d, record=None) if "record" in d
                           else dict(d) for d in self.dumps],
-            }
+            }, "fleet_signals")
 
     def write_telemetry(self, router,
                         path: Optional[str] = None) -> bool:
@@ -444,6 +445,11 @@ class FleetObserver:
         try:
             _atomic_json(target, self.signals(router), indent=1)
             return True
+        except WireContractViolation:
+            # the one hole in the never-raise fence: an ARMED wire
+            # contract violation must surface at this producing seam,
+            # not be swallowed as an advisory-telemetry hiccup
+            raise
         except Exception:  # noqa: BLE001 — advisory path
             logger.warning("fleet_obs: could not write telemetry %s",
                            target, exc_info=True)
@@ -514,7 +520,7 @@ class FleetObserver:
                 "kv_handoffs": dict(router.kv_handoffs),
                 "handoffs": len(router.handoffs),
             }
-        return {
+        return _seal({
             "version": 1,
             "reason": reason,
             "origin_replica": origin,
@@ -525,7 +531,7 @@ class FleetObserver:
             "router": rstate,
             "replicas": replicas,
             "autoscale": [dict(e) for e in self.autoscale_events],
-        }
+        }, "flight_dump")
 
     # -- fleet chrome-trace export --------------------------------------------
     def export_chrome_trace(self, router, path: Optional[str] = None

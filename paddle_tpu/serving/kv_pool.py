@@ -27,6 +27,7 @@ from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..resilience import chaos
+from .wire import seal as _seal
 
 
 class PoolExhausted(RuntimeError):
@@ -229,7 +230,7 @@ class KVBlockPool:
                 f"got {len(pages)}")
         full = n_tokens // self.block_size
         tokens = [int(t) for t in token_ids[:full * self.block_size]]
-        return {
+        return _seal({
             "version": 1,
             "num_pages": len(pages),
             "n_tokens": int(n_tokens),
@@ -238,7 +239,7 @@ class KVBlockPool:
             # re-registers and the router's decode-pool affinity signal
             "keys": self._chain_keys(tokens, self.block_size),
             "tokens": tokens,
-        }
+        }, "kv_export_record")
 
     def unregister(self, pages: Sequence[int]) -> None:
         """Drop the prefix keys of the given pages (their content can no
@@ -261,14 +262,15 @@ class KVBlockPool:
         pages are not obtainable — the caller falls back to prompt
         recompute, never a torn import: allocation is all-or-nothing
         and nothing else mutates before it succeeds."""
-        if record.get("block_size") != self.block_size:
+        _seal(record, "kv_export_record")
+        if record["block_size"] != self.block_size:
             raise ValueError(
-                f"hand-off at block_size {record.get('block_size')} "
+                f"hand-off at block_size {record['block_size']} "
                 f"cannot import into a pool at {self.block_size}")
         pages = self.allocate(record["num_pages"]) \
             if record["num_pages"] else []
         full = record["n_tokens"] // self.block_size
-        if full and record.get("tokens"):
+        if full and record["tokens"]:
             self.register_prefix(record["tokens"], pages[:full])
         return pages
 

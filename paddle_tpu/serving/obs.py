@@ -56,6 +56,7 @@ from typing import Any, Dict, List, Optional
 from ..profiler import instrument as _instr
 from ..profiler import metrics as _metrics
 from ..resilience import chaos
+from . import wire as _wire
 from .locking import OrderedLock
 
 logger = logging.getLogger(__name__)
@@ -496,7 +497,7 @@ class ServingObserver:
             if req.trace is not None:
                 entry["events"] = list(req.trace.events[-32:])
             live.append(entry)
-        return {
+        return _wire.seal({
             "version": 1,
             "reason": reason,
             "detail": detail,
@@ -507,7 +508,7 @@ class ServingObserver:
             "requests": list(self._done),
             "live_requests": live,
             "telemetry": self._telemetry_locked({}),
-        }
+        }, "flight_dump")
 
     # -- telemetry ------------------------------------------------------------
     def _attainment(self) -> float:
@@ -561,8 +562,14 @@ class ServingObserver:
         if not target:
             return False
         try:
+            _wire.seal(tel, "telemetry_line")
             _atomic_json(target, tel, indent=1)
             return True
+        except _wire.WireContractViolation:
+            # the one hole in the never-raise fence: an ARMED wire
+            # contract violation must surface at this producing seam,
+            # not be swallowed as an advisory-telemetry hiccup
+            raise
         except Exception:   # noqa: BLE001 — "Never raises" is the contract
             logger.warning("serve.obs: could not write telemetry %s",
                            target, exc_info=True)

@@ -53,6 +53,7 @@ from typing import List, Optional, Sequence
 
 from ..profiler import instrument as _instr
 from .obs import _atomic_json
+from .wire import seal as _seal
 
 logger = logging.getLogger(__name__)
 
@@ -216,12 +217,12 @@ def build_manifest(requests: Sequence, drain_seconds: float) -> dict:
             "tpot_deadline": req.tpot_deadline,
             "stream": req._stream is not None,
         })
-    return {
+    return _seal({
         "version": MANIFEST_VERSION,
         "unix_time": time.time(),
         "drain_seconds": round(drain_seconds, 6),
         "requests": entries,
-    }
+    }, "drain_manifest")
 
 
 def write_manifest(manifest: dict, path: str) -> None:
@@ -238,7 +239,7 @@ def load_manifest(path: str) -> dict:
         raise ValueError(
             f"drain manifest {path} has version {version!r}, "
             f"this reader understands {MANIFEST_VERSION}")
-    return manifest
+    return _seal(manifest, "drain_manifest")
 
 
 def replay_manifest(engine, manifest) -> List:
@@ -250,6 +251,7 @@ def replay_manifest(engine, manifest) -> List:
     already delivered."""
     if isinstance(manifest, str):
         manifest = load_manifest(manifest)
+    _seal(manifest, "drain_manifest")
     _instr.record_serve_engine_restart()
     handles = []
     for entry in sorted(manifest["requests"], key=lambda e: e["order"]):

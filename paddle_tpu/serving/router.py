@@ -756,7 +756,7 @@ class ReplicaRouter:
         affinity registration — so the hand-off preserves both the
         prefix-sharing of the replayed group and the routing of future
         same-prefix arrivals."""
-        entries = sorted(manifest.get("requests", ()),
+        entries = sorted(manifest["requests"],
                          key=lambda e: e["order"])
         groups: "OrderedDict" = OrderedDict()
         for entry in entries:

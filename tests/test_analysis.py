@@ -187,19 +187,24 @@ CASES = {
     ),
 }
 
-# the SHD (sharding/layout) and CCY (serving concurrency) families'
-# fixtures live with their own test modules; pulled in here so the
-# rule-completeness gate covers them too
+# the SHD (sharding/layout), CCY (serving concurrency) and WIR (wire
+# contract) families' fixtures live with their own test modules; pulled
+# in here so the rule-completeness gate covers them too
 from test_concurcheck import CCY_CASES, CCY_FIXTURE_PATH  # noqa: E402
 from test_shardcheck import SHD_CASES  # noqa: E402
+from test_wirecheck import WIR_CASES, WIR_FIXTURE_PATHS  # noqa: E402
 
 CASES.update(SHD_CASES)
 CASES.update(CCY_CASES)
+CASES.update(WIR_CASES)
 
 
 def _fixture_path(rule):
     # CCY201 (and CCY101's foreign-grab arm) are serving-scoped: those
-    # snippets lint as a serving-tier file
+    # snippets lint as a serving-tier file; the WIR rules bind by
+    # WIRE_SCHEMAS spelling, so each lints at its registry-bound path
+    if rule.startswith("WIR"):
+        return WIR_FIXTURE_PATHS[rule]
     return CCY_FIXTURE_PATH if rule.startswith("CCY") else FAKE_PATH
 
 

@@ -87,6 +87,18 @@ edge. Stable per seed.
 
     JAX_PLATFORMS=cpu python tools/chaos_drill.py --lockcheck [--seed 1234]
 
+``--wirecheck`` runs the armed wire-contract drill
+(paddle_tpu.serving.wire, the runtime twin of the WIR1xx lint rules):
+the fleet-obs and elastic drills run twice each — sealing twin
+disarmed, then armed via ``wire.arm`` — and their stable reports
+(including the replayed tokens-crc) must be bit-identical; then a
+planted corrupt ``kv_export_record`` (one undeclared key smuggled in,
+one hash-chain prefix key degraded to a float) must die in a child
+process with exit code 1 and a byte-stable ``WireContractViolation``
+message, twice. Stable per seed.
+
+    JAX_PLATFORMS=cpu python tools/chaos_drill.py --wirecheck [--seed 1234]
+
 Exit code 0 = every exercised recovery path verified.
 """
 from __future__ import annotations
@@ -1398,6 +1410,124 @@ def run_lockcheck_drill(seed: int = 1234, verbose: bool = True):
     return report
 
 
+def run_wire_plant():
+    """Child-process half of ``--wirecheck`` phase 2: arm the sealing
+    twin, seal a deliberately corrupt kv_export_record (one undeclared
+    key, one float prefix-key) and exit 1 with the violation message on
+    stderr — the parent drill asserts the code and that the message is
+    byte-stable across two plants."""
+    from paddle_tpu.serving import wire
+
+    wire.arm(True)
+    record = {
+        "version": 1, "num_pages": 1, "n_tokens": 8, "block_size": 8,
+        "keys": [(1.5, 5, 0)],          # float where ints must live
+        "tokens": [5] * 8,
+        "smuggled": "not-in-any-schema",  # undeclared key
+    }
+    try:
+        wire.seal(record, "kv_export_record")
+    except wire.WireContractViolation as e:
+        print(str(e), file=sys.stderr)
+        return 1
+    print("planted corrupt record escaped the armed wire twin",
+          file=sys.stderr)
+    return 2
+
+
+def run_wirecheck_drill(seed: int = 1234, verbose: bool = True):
+    """Armed wire-contract drill (serving/wire.py, PADDLE_WIRECHECK).
+
+    Phase 1 (armed transparency): the fleet-obs and elastic drills —
+    together they exercise every adopted seam: KV export/import
+    hand-offs, drain-manifest build/replay, fleet signals + telemetry
+    streaming, autoscale ledger writes and correlated flight dumps —
+    run twice each, sealing twin disarmed then armed, and their stable
+    reports (including the replayed tokens-crc) must be bit-identical:
+    arming validates every record at its producing seam without
+    perturbing one token. Phase 2 (planted corruption): a corrupt
+    kv_export_record carrying an undeclared key AND a float prefix-key
+    is sealed in a child process; it must exit 1 with a byte-stable
+    WireContractViolation message, twice. A second in-process plant
+    with ONLY the float prefix-key pins the type-violation message
+    too (the undeclared-key check fires first when both are present).
+    """
+    import subprocess
+
+    from paddle_tpu.serving import wire
+
+    def both(arm: bool):
+        wire.arm(arm)
+        try:
+            fleet = run_fleet_obs_drill(seed=seed, verbose=False)
+            elastic = run_elastic_drill(seed=seed, verbose=False)
+        finally:
+            wire.arm(False)
+        return {"fleet_obs": fleet["stable"],
+                "elastic": elastic["stable"]}
+
+    off = both(False)
+    on = both(True)
+    assert on == off, \
+        f"arming the wire twin perturbed a drill report:\n{on}\nvs\n{off}"
+
+    # -- phase 2: planted corruption dies with exit 1, byte-stably ------------
+    here = os.path.abspath(__file__)
+
+    def plant() -> str:
+        proc = subprocess.run(
+            [sys.executable, here, "--wirecheck", "--plant-corruption"],
+            capture_output=True, text=True, timeout=120,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert proc.returncode == 1, \
+            (f"planted corruption must exit 1, got {proc.returncode}: "
+             f"{proc.stderr}")
+        return proc.stderr.strip().splitlines()[-1]
+
+    first, second = plant(), plant()
+    assert first == second, \
+        f"violation not byte-stable: {first!r} != {second!r}"
+    assert "wire[kv_export_record]" in first and "smuggled" in first, \
+        first
+
+    # the float prefix-key alone (undeclared-key check outranks it when
+    # both corruptions ride one record): pin the type-violation message
+    wire.arm(True)
+    try:
+        float_key = {
+            "version": 1, "num_pages": 1, "n_tokens": 8,
+            "block_size": 8, "keys": [(1.5, 5, 0)], "tokens": [5] * 8,
+        }
+        msgs = []
+        for _ in range(2):
+            try:
+                wire.seal(float_key, "kv_export_record")
+            except wire.WireContractViolation as e:
+                msgs.append(str(e))
+    finally:
+        wire.arm(False)
+    assert len(msgs) == 2 and msgs[0] == msgs[1], msgs
+    assert "'keys'" in msgs[0] and "prefix_keys" in msgs[0], msgs[0]
+
+    report = {
+        "seed": seed, "ok": True,
+        "stable": {
+            "fleet_obs": on["fleet_obs"],
+            "elastic": on["elastic"],
+            "undeclared_key_violation": first,
+            "float_prefix_key_violation": msgs[0],
+        },
+    }
+    if verbose:
+        print(f"wirecheck drill (seed={seed}): fleet-obs + elastic "
+              f"drills bit-identical armed vs disarmed (elastic crc "
+              f"{on['elastic'].get('replay_crc', '?')}); planted "
+              f"corrupt kv_export_record exited 1 byte-stably: "
+              f"{first!r}; float prefix-key pinned: {msgs[0]!r} — "
+              f"wire sealing twin verified")
+    return report
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seed", type=int, default=1234)
@@ -1447,7 +1577,19 @@ def main(argv=None):
                          "serving run bit-identical to disarmed; a "
                          "planted observer->engine inversion raises "
                          "LockOrderViolation deterministically)")
+    ap.add_argument("--wirecheck", action="store_true",
+                    help="run the armed wire-contract drill (fleet + "
+                         "elastic drills bit-identical armed vs "
+                         "disarmed; a planted corrupt record — extra "
+                         "key + float prefix-key — dies with exit 1 "
+                         "and a byte-stable message)")
+    ap.add_argument("--plant-corruption", action="store_true",
+                    help="with --wirecheck: child-process mode that "
+                         "seals a corrupt record under the armed twin "
+                         "and exits 1 (used by the drill itself)")
     args = ap.parse_args(argv)
+    if args.wirecheck and args.plant_corruption:
+        return run_wire_plant()
     if args.preempt:
         report = run_preempt_drill(seed=args.seed, verbose=not args.json,
                                    aot=not args.no_aot)
@@ -1470,6 +1612,9 @@ def main(argv=None):
                                    verbose=not args.json)
     elif args.lockcheck:
         report = run_lockcheck_drill(seed=args.seed,
+                                     verbose=not args.json)
+    elif args.wirecheck:
+        report = run_wirecheck_drill(seed=args.seed,
                                      verbose=not args.json)
     else:
         report = run_drill(seed=args.seed, verbose=not args.json)

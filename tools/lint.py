@@ -1,4 +1,4 @@
-"""Framework lint driver: all four analysis passes over the repo, CI-gated.
+"""Framework lint driver: all five analysis passes over the repo, CI-gated.
 
     python tools/lint.py                  # lint the shipped tree (exit 0)
     python tools/lint.py path/to/file.py  # lint specific files/dirs
@@ -7,7 +7,7 @@
     python tools/lint.py --update-baseline
 
 Pass 1 (AST, stdlib-only, fast): every rule in paddle_tpu.analysis.rules
-— the TPU, SHD1xx and CCY families — over paddle_tpu/, tools/,
+— the TPU, SHD1xx, CCY and WIR families — over paddle_tpu/, tools/,
 examples/ and tests/. Pass 2 (trace, imports JAX; skip with
 --no-trace): trace-sanitizes a representative train-step function built
 from the framework's own layers, and — when --schedules <dir> points at
@@ -22,15 +22,22 @@ serving concurrency gate — the CCY1xx/2xx AST rules ride pass 1, and
 paddle_tpu.analysis.concurcheck additionally proves the lock-order /
 request-lifecycle registries are coherent and byte-identical to what
 the runtime ordered-lock twin (PADDLE_LOCKCHECK=1) enforces (CCY5xx).
-All of it runs on CPU with no devices: the mesh is abstract.
+Pass 5 (wire, stdlib-only; skip with --no-wire): the wire-contract
+gate — the WIR1xx AST rules ride pass 1, and
+paddle_tpu.analysis.wirecheck additionally proves serving/wire.py's
+WIRE_SCHEMAS registry coherent, version-hash-pinned, and
+byte-identical to what the runtime sealing twin (PADDLE_WIRECHECK=1)
+enforces (WIR5xx). All of it runs on CPU with no devices: the mesh is
+abstract.
 
 Findings are diffed against the committed baselines — CCY findings
-against tools/concur_baseline.json, everything else against
-tools/lint_baseline.json (both shipped EMPTY: the tree self-hosts
+against tools/concur_baseline.json, WIR findings against
+tools/wire_baseline.json, everything else against
+tools/lint_baseline.json (all shipped EMPTY: the tree self-hosts
 clean); any finding not in its baseline prints with its rule id and fix
 hint and the driver exits nonzero. tests/test_analysis.py,
-tests/test_shardcheck.py and tests/test_concurcheck.py run the same
-gates as tier-1 tests.
+tests/test_shardcheck.py, tests/test_concurcheck.py and
+tests/test_wirecheck.py run the same gates as tier-1 tests.
 """
 from __future__ import annotations
 
@@ -59,6 +66,7 @@ def _bootstrap_analysis_pkg():
 DEFAULT_PATHS = ["paddle_tpu", "tools", "examples", "tests"]
 BASELINE = os.path.join(REPO, "tools", "lint_baseline.json")
 CONCUR_BASELINE = os.path.join(REPO, "tools", "concur_baseline.json")
+WIRE_BASELINE = os.path.join(REPO, "tools", "wire_baseline.json")
 LAYOUT_BASELINE = os.path.join(REPO, "tools", "layout_baseline.json")
 PERF_CONFIG = os.path.join(REPO, "PERF_CONFIG.json")
 PERF_LEDGER = os.path.join(REPO, "PERF_LEDGER.jsonl")
@@ -88,6 +96,11 @@ def _print_fix_hints():
     print("Concurrency-registry rules (reported by "
           "concurcheck.concur_check):\n")
     for rid, (name, hint) in sorted(CONCUR_RULES.items()):
+        print(f"  {rid} {name}")
+        print(f"      fix:  {hint}\n")
+    from paddle_tpu.analysis.wirecheck import WIRE_RULES  # stdlib-only
+    print("Wire-registry rules (reported by wirecheck.wire_check):\n")
+    for rid, (name, hint) in sorted(WIRE_RULES.items()):
         print(f"  {rid} {name}")
         print(f"      fix:  {hint}\n")
     # trace rules live beside the trace pass; import lazily (needs jax)
@@ -284,6 +297,13 @@ def main(argv=None) -> int:
                     help="run the concurrency pass (the default; kept as "
                          "an explicit spelling for CI scripts)")
     ap.add_argument("--concur-baseline", default=CONCUR_BASELINE)
+    ap.add_argument("--no-wire", action="store_true",
+                    help="skip the wire-contract pass (drop WIR "
+                         "findings and the registry-coherence check)")
+    ap.add_argument("--wire", action="store_true",
+                    help="run the wire pass (the default; kept as an "
+                         "explicit spelling for CI scripts)")
+    ap.add_argument("--wire-baseline", default=WIRE_BASELINE)
     ap.add_argument("--layout-report", default=None, metavar="FILE",
                     help="dump the per-op layout report JSON to FILE")
     ap.add_argument("--schedules", default=None, metavar="DIR",
@@ -318,6 +338,8 @@ def main(argv=None) -> int:
     findings = lint_paths(paths)
     if args.no_concur:
         findings = [f for f in findings if not f.rule.startswith("CCY")]
+    if args.no_wire:
+        findings = [f for f in findings if not f.rule.startswith("WIR")]
     n_ast = len(findings)
 
     # serving-concurrency registry coherence (stdlib, rides the AST
@@ -326,6 +348,13 @@ def main(argv=None) -> int:
     if not args.no_concur:
         from paddle_tpu.analysis.concurcheck import concur_check
         findings.extend(concur_check())
+
+    # wire-contract registry coherence (stdlib, rides the AST pass):
+    # the WIR1xx rules above already ran as part of lint_paths; this
+    # adds the WIR5xx registry/version-hash/runtime-twin self-check
+    if not args.no_wire:
+        from paddle_tpu.analysis.wirecheck import wire_check
+        findings.extend(wire_check())
 
     # perf-config provenance (stdlib, rides the AST pass): committed
     # config is checked by default; --perf-config points at another
@@ -361,14 +390,20 @@ def main(argv=None) -> int:
         findings.extend(
             check_collective_schedules(load_schedules(args.schedules)))
 
-    # CCY findings diff against their own baseline so adopting (or
-    # retiring) the concurrency gate never rewrites the long-lived
-    # three-pass baseline file
+    # CCY and WIR findings diff against their own baselines so adopting
+    # (or retiring) the concurrency/wire gates never rewrites the
+    # long-lived three-pass baseline file
     baseline = _load_baseline(args.baseline)
     concur_baseline = _load_baseline(args.concur_baseline)
+    wire_baseline = _load_baseline(args.wire_baseline)
 
     def _known(f):
-        pool = concur_baseline if f.rule.startswith("CCY") else baseline
+        if f.rule.startswith("CCY"):
+            pool = concur_baseline
+        elif f.rule.startswith("WIR"):
+            pool = wire_baseline
+        else:
+            pool = baseline
         return f.key() in pool
 
     fresh = [f for f in findings if not _known(f)]
@@ -376,8 +411,10 @@ def main(argv=None) -> int:
     if args.update_baseline:
         ccy_keys = sorted(f2.key() for f2 in findings
                           if f2.rule.startswith("CCY"))
+        wir_keys = sorted(f2.key() for f2 in findings
+                          if f2.rule.startswith("WIR"))
         rest_keys = sorted(f2.key() for f2 in findings
-                           if not f2.rule.startswith("CCY"))
+                           if not f2.rule.startswith(("CCY", "WIR")))
         with open(args.baseline, "w") as f:
             json.dump(rest_keys, f, indent=1)
         print(f"wrote {len(rest_keys)} finding keys to {args.baseline}")
@@ -386,6 +423,11 @@ def main(argv=None) -> int:
                 json.dump(ccy_keys, f, indent=1)
             print(f"wrote {len(ccy_keys)} finding keys to "
                   f"{args.concur_baseline}")
+        if not args.no_wire:
+            with open(args.wire_baseline, "w") as f:
+                json.dump(wir_keys, f, indent=1)
+            print(f"wrote {len(wir_keys)} finding keys to "
+                  f"{args.wire_baseline}")
         if layout_report is not None:
             from paddle_tpu.analysis.shardcheck import baseline_view
             with open(LAYOUT_BASELINE, "w") as f:
@@ -405,7 +447,7 @@ def main(argv=None) -> int:
         dt = time.perf_counter() - t0
         known = len(findings) - len(fresh)
         print(f"\nlint: {n_ast} ast + {len(findings) - n_ast} "
-              f"trace/shard/concur finding(s), {known} baselined, "
+              f"trace/shard/concur/wire finding(s), {known} baselined, "
               f"{len(fresh)} new ({dt:.1f}s)")
     errors = [f for f in fresh if f.severity == "error"]
     return 1 if errors else 0
