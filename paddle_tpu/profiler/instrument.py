@@ -88,6 +88,10 @@ Catalog (names are a stable API — see README "Observability"):
   fleet_replicas{role}                   live replicas per role in the autoscaled fleet
   fleet_scale_events_total{action,outcome}  autoscale actuations (spawn|retire|rebalance x ok|fault|skipped)
   fleet_autoscale_decision_seconds       signal read -> decision -> actuation wall time
+  transport_messages_total{kind,outcome} serving/transport.py messages by kind and terminal outcome
+  transport_retries_total{site}          transport retransmissions by send site
+  fleet_lease_transitions_total{from,to} serving/membership.py lease transitions (live|suspect|dead)
+  serve_handoff_aborts_total{reason}     two-phase KV hand-offs aborted/salvaged by reason
 """
 from __future__ import annotations
 
@@ -179,6 +183,10 @@ CATALOG = (
     "fleet_replicas",
     "fleet_scale_events_total",
     "fleet_autoscale_decision_seconds",
+    "transport_messages_total",
+    "transport_retries_total",
+    "fleet_lease_transitions_total",
+    "serve_handoff_aborts_total",
 )
 
 _enabled = _m._ENABLED  # bind the cell once: hot-path guard is _enabled[0]
@@ -825,6 +833,49 @@ def record_fleet_scale_decision(seconds: float) -> None:
     _reg().histogram("fleet_autoscale_decision_seconds",
                      "signal read -> decision -> actuation wall time",
                      buckets=_TIME_BUCKETS).observe(seconds)
+
+
+def record_transport_message(kind: str, outcome: str) -> None:
+    """One transport message reaching a terminal outcome (delivered |
+    dropped | deduped | partitioned | torn | expired | unroutable)."""
+    if not _enabled[0]:
+        return
+    _reg().counter("transport_messages_total",
+                   "replica-transport messages by kind and terminal "
+                   "outcome",
+                   labelnames=("kind", "outcome")) \
+        .labels(kind=kind, outcome=outcome).inc()
+
+
+def record_transport_retry(site: str) -> None:
+    """One transport retransmission of an unacked message (site names
+    the sending channel, e.g. transport.kv_prepare)."""
+    if not _enabled[0]:
+        return
+    _reg().counter("transport_retries_total",
+                   "transport retransmissions by send site",
+                   labelnames=("site",)).labels(site=site).inc()
+
+
+def record_lease_transition(frm: str, to: str) -> None:
+    """One membership lease transition (live|suspect|dead)."""
+    if not _enabled[0]:
+        return
+    _reg().counter("fleet_lease_transitions_total",
+                   "membership lease state transitions",
+                   labelnames=("from", "to")) \
+        .labels(**{"from": frm, "to": to}).inc()
+
+
+def record_handoff_abort(reason: str) -> None:
+    """One two-phase KV hand-off aborted (reason: the importer's nack
+    cause, ack_timeout for a retry give-up, ack_lost for a hand-off
+    that committed without its ack ever arriving)."""
+    if not _enabled[0]:
+        return
+    _reg().counter("serve_handoff_aborts_total",
+                   "two-phase KV hand-offs aborted by reason",
+                   labelnames=("reason",)).labels(reason=reason).inc()
 
 
 def record_serve_tokens(n: int, step_seconds: float) -> None:

@@ -80,6 +80,15 @@ SITES = {
     "mem.snapshot": "site",
     "elastic.spawn": "site",
     "elastic.retire": "site",
+    # serving/transport.py polls these through FaultPlan.poll directly
+    # (tick-based fault semantics — a wall-clock sleep or a raise would
+    # break the transport's bit-determinism): kind "error" with arg
+    # drop|dup|reorder torn-drops/duplicates/re-sequences one message,
+    # kind "delay" holds it arg ticks, and a "transport.link" error
+    # partitions the message's link for arg ticks
+    "transport.send": "site",
+    "transport.recv": "site",
+    "transport.link": "site",
 }
 
 _CONTROL_KINDS = ("delay", "error", "die")

@@ -145,9 +145,30 @@ Benchmark the 10x traffic swing with ``python tools/bench_serve.py
 --elastic``; drill faulted spawns/mid-burst retires with ``python
 tools/chaos_drill.py --elastic``.
 
+Fault-domain fabric (``serving.transport`` + ``serving.membership``):
+the router's three cross-replica channels — KV-page hand-off,
+drain-manifest replay, lease heartbeats — pushed through a
+chaos-injectable, tick-based message transport with idempotency-keyed
+dedup, per-link re-sequencing, and ack-tracked sends retransmitted on
+``RetryPolicy``'s seeded backoff. Liveness becomes a lease state
+machine (live → suspect → dead): a quiet replica loses dispatch
+immediately but is salvaged only at lease expiry, so a healed
+partition never double-decodes. The KV hand-off becomes two-phase —
+the exporter retains pages until the importer's ``kv_transfer_ack``
+commits or aborts, so a torn transfer leaves neither pool holding
+garbage and every request finishes exactly once:
+
+    router = ReplicaRouter(fleet, transport=True, membership=True)
+
+Disarmed (the default) the synchronous in-process paths are untouched,
+bit-identically. Drill with ``python tools/chaos_drill.py --partition``
+(partition-then-heal vs lease expiry) and ``--lossy`` (5% drop + dup +
+delay); benchmark with ``python tools/bench_serve.py --lossy``.
+
 Lock discipline (``serving.locking``): every serving-plane lock is an
 ``OrderedLock`` ranked by the declared ``LOCK_ORDER`` (fleet_obs →
-router → engine → observer, outermost first). Disarmed it is a plain
+router → transport → membership → engine → observer, outermost
+first). Disarmed it is a plain
 ``threading.RLock`` (sub-microsecond acquire); armed — via
 ``PADDLE_LOCKCHECK=1`` or ``locking.arm(True)`` — any out-of-order
 acquisition raises ``LockOrderViolation`` *before* blocking, so
@@ -173,6 +194,10 @@ from .resilience import (AdmissionRejected, RequestFailed, ResilienceConfig,
 from .scheduler import Request, Scheduler
 from .speculative import (Drafter, DraftModelDrafter, NgramDrafter,
                           make_drafter, verify_greedy)
+from .transport import (ReplicaTransport, TransportConfig,
+                        resolve_transport)
+from .membership import (MembershipConfig, MembershipTable,
+                         resolve_membership)
 
 __all__ = [
     "EngineConfig", "EnginePredictor", "ServingEngine",
@@ -188,4 +213,6 @@ __all__ = [
     "ResilienceConfig", "resolve_resilience", "AdmissionRejected",
     "RequestFailed", "StepFault", "load_manifest", "replay_manifest",
     "serve_until_preempted",
+    "ReplicaTransport", "TransportConfig", "resolve_transport",
+    "MembershipConfig", "MembershipTable", "resolve_membership",
 ]

@@ -286,7 +286,8 @@ class FleetAutoscaler:
                 replica = self._least_affinity_loaded(by_role[key])
                 chaos.site("elastic.retire")
                 handles = self.router.decommission(
-                    replica, deadline_s=cfg.drain_deadline_s)
+                    replica, deadline_s=cfg.drain_deadline_s,
+                    cause="autoscale_retire")
                 self.retires += 1
                 detail["replayed"] = len(handles)
             else:                           # rebalance: flip the cold role

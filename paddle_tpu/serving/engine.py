@@ -24,7 +24,7 @@ from __future__ import annotations
 import threading
 import time
 from functools import partial
-from typing import List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -383,6 +383,15 @@ class ServingEngine:
         # prefill engine stashes them for ``pop_handoffs()``
         self.handoff_sink = None
         self._handoff_outbox: List = []
+        # two-phase hand-off (the router's transport mode): the exporter
+        # KEEPS a request's pages after ``_collect_handoffs`` until the
+        # importer's ack decides — ``commit_export`` (landed) or
+        # ``abort_export`` (refused / torn / timed out) — so a transfer
+        # torn at any byte leaves neither pool holding garbage: either
+        # the importer owns good pages, or this pool still does.
+        # rid -> retained page list.
+        self.handoff_two_phase = False
+        self._pending_exports: Dict[int, List[int]] = {}
         # post-step hook (outside the engine lock): the router wires
         # decode replicas to retry deferred hand-offs here, so fleets
         # driven by one thread per replica — not step_all — still drain
@@ -875,7 +884,12 @@ class ServingEngine:
                 # served as a full-page hit would be garbage K/V
                 self.pool.register_prefix(req.seq[:safe * bs],
                                           req.pages[:safe])
-            if req.pages:
+            if self.handoff_two_phase:
+                # PREPARE: retain the pages — the importer's ack (or its
+                # absence) decides commit or abort; releasing now would
+                # let the pool recycle pages the transfer may yet need
+                self._pending_exports[req.rid] = list(req.pages)
+            elif req.pages:
                 self.pool.release(req.pages)
             req.pages = []
             # prefill service time: arrival -> hand-off is what this
@@ -939,6 +953,15 @@ class ServingEngine:
             if self._draining:
                 raise _res.AdmissionRejected(
                     "draining", queue_depth=self.sched.queue_depth())
+            if req.done or req in self.sched.waiting \
+                    or req in self.sched.running:
+                # a duplicated hand-off that evaded transport dedup (the
+                # lossy bench's no-dedup baseline runs exactly this):
+                # admitting it again would decode the same request twice
+                # — refuse with the typed rejection instead
+                raise _res.AdmissionRejected(
+                    "duplicate_import",
+                    queue_depth=self.sched.queue_depth())
             # validate BEFORE allocating: a request this engine's caps
             # can never hold (heterogeneous fleet) must not leak pages
             # or escape the router's fallback ladder as a late raise
@@ -1029,6 +1052,33 @@ class ServingEngine:
         with self._lock:
             out, self._handoff_outbox = self._handoff_outbox, []
             return out
+
+    def commit_export(self, rid: int) -> bool:
+        """Two-phase hand-off COMMIT: the importer acked ``rid``'s
+        prepare — the retained pages release now (and never before: a
+        transfer torn at any byte leaves the importer with nothing and
+        THIS pool still owning the truth). Idempotent — a torn ack can
+        make the router resolve the same prepare twice, and the second
+        resolution must find nothing to release."""
+        with self._lock:
+            pages = self._pending_exports.pop(rid, None)
+            if pages is None:
+                return False
+            self.pool.release(pages)
+        return True
+
+    def abort_export(self, rid: int) -> bool:
+        """Two-phase hand-off ABORT: the importer refused (or no ack
+        ever came) — release the retained pages; the router rebuilds the
+        K/V down the recompute ladder. Same idempotent shape as
+        ``commit_export``: either verdict leaves this pool clean, the
+        two differ only in who owns the K/V afterwards."""
+        with self._lock:
+            pages = self._pending_exports.pop(rid, None)
+            if pages is None:
+                return False
+            self.pool.release(pages)
+        return True
 
     # -- step-fault containment (serving/resilience.py) -----------------------
     def _contain_step_fault(self, plan, exc: BaseException, armed: bool,
@@ -1341,6 +1391,12 @@ class ServingEngine:
         with self._lock:
             live = self._live_requests()
             self._handoff_outbox = []
+            # retained two-phase exports: a dead exporter's pending
+            # prepares release here; a commit/abort arriving later finds
+            # the rid gone (idempotent pop) — never a double release
+            for pages in self._pending_exports.values():
+                self.pool.release(pages)
+            self._pending_exports.clear()
             for req in live:
                 err = _res.RequestFailed(req.rid, reason=reason,
                                          retries=req.step_retries,

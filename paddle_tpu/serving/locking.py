@@ -24,9 +24,15 @@ it while ``_fleet_record`` takes the router lock, and ``on_step_all``
 holds it while sampling walks every engine's ``signals()`` (engine then
 observer lock) — and no router/engine/observer path ever takes the
 fleet lock while holding its own (``router.py`` documents the same
-invariant at the ``fleet_obs`` attribute). Hence::
+invariant at the ``fleet_obs`` attribute). The fault-domain planes
+(``transport.py``/``membership.py``) slot between router and engine:
+the router sends/reads under its own lock (router -> transport/
+membership), and neither plane ever holds its lock across a delivery
+handler — handlers run lock-free and may take the router or engine
+lock themselves. Hence::
 
-    fleet_obs  ->  router  ->  engine  ->  observer
+    fleet_obs  ->  router  ->  transport  ->  membership  ->  engine
+              ->  observer
 
 A thread may acquire a lock only if every lock it already holds sits
 STRICTLY EARLIER in this order (re-acquiring the same RLock is always
@@ -46,7 +52,8 @@ __all__ = [
 #: The declared partial order, outermost lock first. Read statically by
 #: ``analysis.concur_rules.load_lock_order`` (ast.literal_eval — keep
 #: this a pure literal) and at runtime by ``OrderedLock``.
-LOCK_ORDER = ("fleet_obs", "router", "engine", "observer")
+LOCK_ORDER = ("fleet_obs", "router", "transport", "membership",
+              "engine", "observer")
 
 #: Which class's ``self._lock`` each ordered name refers to — how the
 #: static pass resolves ``with self._lock`` to a position in the order.
@@ -54,6 +61,8 @@ LOCK_ORDER = ("fleet_obs", "router", "engine", "observer")
 LOCK_OWNERS = {
     "FleetObserver": "fleet_obs",
     "ReplicaRouter": "router",
+    "ReplicaTransport": "transport",
+    "MembershipTable": "membership",
     "ServingEngine": "engine",
     "ServingObserver": "observer",
 }
@@ -64,6 +73,8 @@ LOCK_OWNERS = {
 #: -> engine). Pure literal (ast.literal_eval).
 LOCK_BEARERS = {
     "router": "router",
+    "transport": "transport",
+    "membership": "membership",
     "eng": "engine",
     "engine": "engine",
     "replicas": "engine",

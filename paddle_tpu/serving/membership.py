@@ -1,0 +1,265 @@
+"""Lease-based fleet membership: liveness as a state machine, not a bool.
+
+The router's ``_alive`` list answers "may I dispatch here?" with a
+boolean that flips exactly once, at the moment an exception surfaces.
+That is the in-process luxury: a dead replica *announces* its death by
+raising on the caller's stack. A replica across a real transport does
+no such thing — it just goes quiet, and quiet is ambiguous: crashed, or
+merely partitioned? Acting on the wrong guess is the classic split-brain
+hole: the router salvages the silent replica's manifest and re-decodes
+its requests elsewhere, the partition heals, and the SAME request is
+now decoding in two places.
+
+This table makes the ambiguity explicit with a three-state lease
+machine, all tick-denominated (the transport's clock, never wall-time):
+
+  * **live**    — heartbeat seen within ``suspect_after`` ticks. Fully
+    dispatchable.
+  * **suspect** — quiet past ``suspect_after``, lease not yet expired.
+    The router stops dispatching NEW work immediately (cheap, safe,
+    reversible) but does NOT salvage — the far side may still be
+    decoding. A heartbeat heals suspect back to live with no recovery
+    action at all.
+  * **dead**    — quiet past the lease (``lease_ticks`` from the last
+    heartbeat). Now salvage is safe-by-contract: a healed replica whose
+    lease expired stays FENCED (its heartbeats are ignored until an
+    explicit re-join), so both sides can never own the same request.
+
+``fail_replica`` / ``decommission`` / autoscaler retirement are the
+same transition (``kill``) taken eagerly with a reason, so every path
+to "dead" — crash, drain, scale-down, lease expiry — funnels through
+one salvage seam in the router and one ``fleet_lease_transitions_total``
+evidence stream.
+
+Heartbeats ride the transport's fleet-signal channel as sealed
+``membership_lease`` wire records (replica -> router, fire-and-forget;
+loss is the POINT — a lossy link is indistinguishable from a slow
+replica, which is exactly what the suspect grace absorbs), carrying
+``queue_depth``/``tokens_generated`` so the liveness stream doubles as
+the telemetry feed.
+
+Lock discipline: rank "membership" in ``locking.LOCK_ORDER`` — after
+router/transport (the router reads the table under its own lock; the
+transport's delivery pump calls ``heartbeat`` lock-free), before
+engine. The table never calls out while holding its lock.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..profiler import instrument as _instr
+from .locking import OrderedLock
+from . import wire as _wire
+
+__all__ = ["MembershipConfig", "MembershipTable", "resolve_membership",
+           "build_heartbeat", "LIVE", "SUSPECT", "DEAD"]
+
+LIVE = "live"
+SUSPECT = "suspect"
+DEAD = "dead"
+
+
+class MembershipConfig:
+    """Lease timing, in transport ticks. ``suspect_after`` < ``lease_ticks``
+    is the whole design: a cheap reversible caution window before the
+    expensive irreversible verdict."""
+
+    def __init__(self, suspect_after: int = 3, lease_ticks: int = 8):
+        if suspect_after < 1:
+            raise ValueError("suspect_after must be >= 1")
+        if lease_ticks <= suspect_after:
+            raise ValueError(
+                "lease_ticks must exceed suspect_after (the suspect "
+                "grace window is the point of the lease)")
+        self.suspect_after = int(suspect_after)
+        self.lease_ticks = int(lease_ticks)
+
+
+def build_heartbeat(replica: int, tick: int, role: Optional[str],
+                    lease_ticks: int, queue_depth: int,
+                    tokens_generated: int) -> dict:
+    """The ``membership_lease`` wire record: one replica's lease renewal
+    plus the piggy-backed telemetry payload."""
+    return _wire.seal({
+        "version": 1,
+        "replica": int(replica),
+        "tick": int(tick),
+        "role": role,
+        "lease_ticks": int(lease_ticks),
+        "queue_depth": int(queue_depth),
+        "tokens_generated": int(tokens_generated),
+    }, "membership_lease")
+
+
+class MembershipTable:
+    """The router-side view of who is live, suspect, or dead."""
+
+    LEDGER_CAP = 256
+
+    def __init__(self, config: Optional[MembershipConfig] = None):
+        self.config = config or MembershipConfig()
+        self._lock = OrderedLock("membership")
+        # replica -> {"state", "role", "last_heard", "lease_until",
+        #             "queue_depth", "tokens_generated", "reason"}
+        self._members: Dict[int, dict] = {}
+        # bounded (tick, replica, from, to, reason) transition ledger
+        self.transitions: List[Tuple[int, int, str, str, str]] = []
+        self.transition_counts: Dict[Tuple[str, str], int] = {}
+
+    # -- transitions (always via this one seam) -------------------------------
+    def _transit(self, replica: int, to: str, tick: int,
+                 reason: str) -> None:
+        m = self._members[replica]
+        frm = m["state"]
+        if frm == to:
+            return
+        m["state"] = to
+        m["reason"] = reason
+        self.transitions.append((tick, replica, frm, to, reason))
+        if len(self.transitions) > self.LEDGER_CAP:
+            del self.transitions[:len(self.transitions) - self.LEDGER_CAP]
+        key = (frm, to)
+        self.transition_counts[key] = self.transition_counts.get(key, 0) + 1
+        _instr.record_lease_transition(frm, to)
+
+    # -- lifecycle ------------------------------------------------------------
+    def join(self, replica: int, tick: int,
+             role: Optional[str] = None) -> None:
+        """(Re-)admit a replica as live with a fresh lease. The ONLY way
+        out of ``dead`` — expiry fencing stays until someone with
+        authority (router add_replica/set_role) explicitly re-admits."""
+        with self._lock:
+            prev = self._members.get(replica)
+            if prev is not None and prev["state"] != DEAD:
+                prev["role"] = role if role is not None else prev["role"]
+                return
+            if prev is not None:
+                self._transit(replica, LIVE, tick, "rejoin")
+                m = prev
+            else:
+                m = self._members[replica] = {"state": LIVE,
+                                              "reason": "join"}
+            m["role"] = role
+            m["last_heard"] = tick
+            m["lease_until"] = tick + self.config.lease_ticks
+            m["queue_depth"] = 0
+            m["tokens_generated"] = 0
+
+    def heartbeat(self, record: dict) -> Optional[str]:
+        """Apply one ``membership_lease`` renewal. Returns the member's
+        state after the renewal, or None when the heartbeat was fenced
+        (unknown member, stale version, or a dead lease — an expired
+        replica does NOT resurrect itself by talking again)."""
+        if record["version"] != 1:
+            return None
+        with self._lock:
+            m = self._members.get(record["replica"])
+            if m is None or m["state"] == DEAD:
+                return None
+            m["last_heard"] = record["tick"]
+            m["lease_until"] = record["tick"] + record["lease_ticks"]
+            m["role"] = record["role"]
+            m["queue_depth"] = record["queue_depth"]
+            m["tokens_generated"] = record["tokens_generated"]
+            if m["state"] == SUSPECT:
+                # the heal path: quiet was a lossy/partitioned link, not
+                # a death — no salvage ever happened, nothing to undo
+                self._transit(record["replica"], LIVE, record["tick"],
+                              "heartbeat")
+            return m["state"]
+
+    def advance(self, tick: int) -> List[Tuple[int, str, str, str]]:
+        """Run lease timing at ``tick``; returns the transitions taken,
+        as (replica, from, to, reason). ``-> dead`` entries are the
+        router's cue to salvage (exactly once — advance never re-reports
+        a transition)."""
+        out: List[Tuple[int, str, str, str]] = []
+        with self._lock:
+            for replica in sorted(self._members):
+                m = self._members[replica]
+                if m["state"] == DEAD:
+                    continue
+                if tick > m["lease_until"]:
+                    frm = m["state"]
+                    self._transit(replica, DEAD, tick, "lease_expired")
+                    out.append((replica, frm, DEAD, "lease_expired"))
+                elif m["state"] == LIVE and \
+                        tick - m["last_heard"] > self.config.suspect_after:
+                    self._transit(replica, SUSPECT, tick, "quiet")
+                    out.append((replica, LIVE, SUSPECT, "quiet"))
+        return out
+
+    def kill(self, replica: int, tick: int, reason: str) -> bool:
+        """Eager transition to dead (crash seen in-stack, drain
+        complete, autoscale retirement). Idempotent; returns True when
+        this call performed the transition."""
+        with self._lock:
+            m = self._members.get(replica)
+            if m is None or m["state"] == DEAD:
+                return False
+            self._transit(replica, DEAD, tick, reason)
+            return True
+
+    # -- queries --------------------------------------------------------------
+    def state(self, replica: int) -> Optional[str]:
+        with self._lock:
+            m = self._members.get(replica)
+            return None if m is None else m["state"]
+
+    def dispatchable(self, replica: int) -> bool:
+        """May the router route NEW work here? Only ``live`` qualifies —
+        suspect is exactly the state where dispatch stops but salvage
+        has not started."""
+        with self._lock:
+            m = self._members.get(replica)
+            return m is not None and m["state"] == LIVE
+
+    def alive(self, replica: int) -> bool:
+        with self._lock:
+            m = self._members.get(replica)
+            return m is not None and m["state"] != DEAD
+
+    def members(self) -> Dict[int, str]:
+        with self._lock:
+            return {r: m["state"] for r, m in sorted(self._members.items())}
+
+    def telemetry(self) -> dict:
+        with self._lock:
+            states: Dict[str, int] = {LIVE: 0, SUSPECT: 0, DEAD: 0}
+            for m in self._members.values():
+                states[m["state"]] += 1
+            return {
+                "members": {r: {"state": m["state"], "role": m["role"],
+                                "last_heard": m.get("last_heard", -1),
+                                "lease_until": m.get("lease_until", -1),
+                                "queue_depth": m.get("queue_depth", 0)}
+                            for r, m in sorted(self._members.items())},
+                "states": states,
+                "transition_counts": {f"{a}->{b}": n for (a, b), n in
+                                      sorted(self.transition_counts.items())},
+                "recent_transitions": list(self.transitions[-16:]),
+            }
+
+
+def resolve_membership(value, config: Optional[MembershipConfig] = None
+                       ) -> Optional[MembershipTable]:
+    """Plane-arming convention (``resolve_transport`` shape): None/False
+    = disarmed, True = defaults, a ``MembershipConfig`` or ready
+    ``MembershipTable`` pass through. ``PADDLE_SERVE_MEMBERSHIP=1`` arms
+    from the environment. Membership without a transport is rejected at
+    the router — leases need a clock and a heartbeat channel."""
+    import os
+    if value is None or value is False:
+        if os.environ.get("PADDLE_SERVE_MEMBERSHIP", "").strip().lower() \
+                in ("1", "true", "on", "yes"):
+            return MembershipTable(config)
+        return None
+    if value is True:
+        return MembershipTable(config)
+    if isinstance(value, MembershipConfig):
+        return MembershipTable(value)
+    if isinstance(value, MembershipTable):
+        return value
+    raise TypeError(
+        f"membership= wants None|True|MembershipConfig|MembershipTable, "
+        f"got {type(value).__name__}")

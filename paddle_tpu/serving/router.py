@@ -76,10 +76,13 @@ from typing import Dict, List, Optional, Sequence
 
 from ..profiler import instrument as _instr
 from ..resilience import chaos
+from . import membership as _mem
 from . import resilience as _res
+from . import transport as _tp
 from .fleet_obs import resolve_fleet_obs
 from .kv_pool import PoolExhausted, prefix_chain_keys
 from .locking import OrderedLock
+from .scheduler import HANDOFF as _HANDOFF
 
 _POLICIES = ("affinity", "least_loaded", "random", "round_robin")
 
@@ -94,7 +97,8 @@ class ReplicaRouter:
 
     def __init__(self, engines: Sequence, policy: str = "affinity",
                  seed: int = 0, max_affinity_keys: int = 4096,
-                 failover: bool = True, fleet_obs=None):
+                 failover: bool = True, fleet_obs=None,
+                 transport=None, membership=None):
         import numpy as np
         if not engines:
             raise ValueError("ReplicaRouter needs at least one engine")
@@ -184,6 +188,47 @@ class ReplicaRouter:
         # lock is only ever taken FIRST (fleet -> router/engine/obs) —
         # no router/engine path takes it while holding their locks
         self.fleet_obs = resolve_fleet_obs(fleet_obs)
+        # fault-domain planes (serving/transport.py + membership.py):
+        # disarmed (None, the default) every cross-replica interaction
+        # stays the synchronous in-process call it always was, bit-
+        # identically. Armed, the three channels — KV hand-off (two-
+        # phase prepare/commit), drain-manifest replay, and lease
+        # heartbeats — ride the chaos-injectable transport, and
+        # liveness comes from tick-denominated leases instead of a bool
+        # that flips on a caller-stack exception.
+        self.transport = _tp.resolve_transport(transport, seed=seed)
+        self.membership = _mem.resolve_membership(membership)
+        if self.membership is not None and self.transport is None:
+            raise ValueError(
+                "membership needs the transport plane: leases are "
+                "denominated in transport ticks and heartbeats ride "
+                "its signal channel (pass transport=True as well)")
+        # in-flight ack-tracked sends: msg_id -> sender context. The
+        # Request object and placement facts never ride the wire record
+        # (it stays the serializable cross-process truth) — the context
+        # is the sender's local bookkeeping the ack/give-up resolves.
+        self._inflight: Dict[str, dict] = {}
+        # manifest replays that landed, keyed by manifest message id:
+        # the ack record carries only the ref, the replacement handles
+        # are local objects waiting here for the resolution
+        self._replayed: Dict[str, List] = {}
+        # per-dead-replica async salvage progress (transport mode):
+        # replica -> {expected, done, record, reason, role}
+        self._pending_salvage: Dict[int, dict] = {}
+        if self.transport is not None:
+            self.transport.register("router", self._on_router_message)
+            for i in range(len(self.replicas)):
+                self.transport.register(
+                    i, functools.partial(self._on_replica_message, i))
+            for i in self.prefill_pool:
+                # the two-phase contract: exporters keep pages until
+                # the importer's ack decides commit or abort
+                self.replicas[i].handoff_two_phase = True
+            if self.membership is not None:
+                for i in range(len(self.replicas)):
+                    self.membership.join(
+                        i, self.transport.tick,
+                        role=getattr(self.replicas[i], "role", None))
 
     # -- placement ------------------------------------------------------------
     def _routable(self, exclude: Optional[int] = None,
@@ -193,9 +238,15 @@ class ReplicaRouter:
             pool = self.prefill_pool
         elif role == "decode":
             pool = self.decode_pool
-        return [i for i in pool
-                if self._alive[i] and not self.replicas[i]._draining
-                and i != exclude]
+        out = [i for i in pool
+               if self._alive[i] and not self.replicas[i]._draining
+               and i != exclude]
+        if self.membership is not None:
+            # lease gating: only LIVE members take new work. SUSPECT is
+            # exactly "stop dispatching, don't salvage yet" — cheap and
+            # reversible, where salvage is neither.
+            out = [i for i in out if self.membership.dispatchable(i)]
+        return out
 
     def _least_loaded(self, cands: Sequence[int]) -> int:
         """Queue-depth / predicted-wait placement: the engine's own
@@ -455,6 +506,13 @@ class ReplicaRouter:
                 self.kv_handoffs["failed"] += 1
             _instr.record_disagg_handoff("failed")
             return
+        if self.transport is not None:
+            # transport mode: the import becomes a two-phase PREPARE —
+            # the record rides the chaos-injectable channel and the src
+            # replica keeps the pages until the ack commits or aborts
+            self._send_kv_prepare(src_idx, req, record, target,
+                                  retry=retry)
+            return
         try:
             self.replicas[target].import_handoff(req, record)
             outcome = "pages"
@@ -528,6 +586,358 @@ class ReplicaRouter:
         for src_idx, req, record in pending:
             self._dispatch_handoff(src_idx, req, record, retry=True)
 
+    # -- the fault-domain fabric (transport mode) ------------------------------
+    def _transport_pass(self) -> None:
+        """One fabric tick, the armed prologue of ``step_all``: advance
+        the clock, renew every live replica's lease over the signal
+        channel, deliver everything due (handlers run lock-free), then
+        act on lease verdicts — the ONLY place a quiet replica becomes
+        a dead one, and strictly AFTER its lease ran out."""
+        tick = self.transport.advance()
+        if self.membership is not None:
+            with self._lock:
+                live = [i for i in range(len(self.replicas))
+                        if self._alive[i]]
+            for i in live:
+                eng = self.replicas[i]
+                hb = _mem.build_heartbeat(
+                    i, tick, getattr(eng, "role", None),
+                    self.membership.config.lease_ticks,
+                    eng.sched.queue_depth(), eng.tokens_generated)
+                # fire-and-forget by design: losing one is
+                # indistinguishable from a slow replica, which is
+                # exactly what the suspect grace window absorbs
+                self.transport.send(
+                    i, "router", kind="heartbeat",
+                    family="membership_lease", record=hb,
+                    site="transport.heartbeat")
+        self.transport.pump()
+        if self.membership is not None:
+            for replica, _frm, to, _why in self.membership.advance(tick):
+                if to != _mem.DEAD:
+                    continue
+                with self._lock:
+                    alive = replica < len(self._alive) and \
+                        self._alive[replica]
+                if alive:
+                    # the deferred verdict: suspect the moment it went
+                    # quiet, salvage only now the lease is up — a healed
+                    # partition inside the lease never double-decodes
+                    self.fail_replica(replica, reason="lease_expired")
+
+    def _on_router_message(self, msg) -> None:
+        """The router control endpoint: lease renewals and manifest-
+        channel acks land here (called lock-free by the pump)."""
+        if msg.kind == "heartbeat":
+            if self.membership is not None:
+                self.membership.heartbeat(msg.record)
+        elif msg.kind == "ack":
+            self._on_transfer_ack(msg)
+
+    def _on_replica_message(self, idx: int, msg) -> None:
+        """Replica ``idx``'s endpoint: hand-off prepares, manifest
+        replays, and kv-channel acks (the exporter side)."""
+        if msg.kind == "kv_prepare":
+            self._handle_kv_prepare(idx, msg)
+        elif msg.kind == "manifest":
+            self._handle_manifest(idx, msg)
+        elif msg.kind == "ack":
+            self._on_transfer_ack(msg)
+
+    def _send_kv_prepare(self, src_idx: int, req, record, target: int,
+                         retry: bool) -> None:
+        """Launch one two-phase KV hand-off onto the wire (ack-tracked;
+        the transport retransmits on its seeded backoff and fires
+        ``_on_kv_giveup`` after the attempt ceiling)."""
+        ctx = {"channel": "kv", "req": req, "record": record,
+               "src": src_idx, "target": target, "retry": retry}
+        msg_id = self.transport.send(
+            src_idx, target, kind="kv_prepare",
+            family="kv_export_record", record=record,
+            meta={"req": req}, needs_ack=True,
+            on_fail=self._on_kv_giveup, site="transport.kv_prepare")
+        with self._lock:
+            self._inflight[msg_id] = ctx
+        tr = getattr(req, "trace", None)
+        if tr is not None:
+            tr.add("router_handoff_send", time.monotonic(),
+                   target=target, retry=retry)
+
+    def _handle_kv_prepare(self, idx: int, msg) -> None:
+        """Deliver one hand-off prepare INTO decode replica ``idx`` and
+        ack the verdict. The record is self-contained (page contents
+        ride it), so a prepare landing after its exporter died still
+        imports cleanly; the Request object rides the message's
+        in-process meta side-channel, never the record. The ack goes
+        out AFTER ``import_handoff`` returned — with the engine lock
+        released and the import either fully landed or fully unwound."""
+        req = msg.meta["req"]
+        with self._lock:
+            alive = self._alive[idx]
+        if not alive:
+            status, why = "abort", "replica_dead"
+        else:
+            try:
+                self.replicas[idx].import_handoff(req, msg.record)
+                status, why = "ok", None
+            except (PoolExhausted, ValueError, chaos.FaultInjected,
+                    _res.AdmissionRejected) as exc:
+                status, why = "abort", type(exc).__name__
+        ack = _tp.build_ack(msg.msg_id, "kv", req.rid, status, why,
+                            msg.record["num_pages"]
+                            if status == "ok" else 0)
+        self.transport.send(idx, msg.src, kind="ack",
+                            family="kv_transfer_ack", record=ack,
+                            ack_ref=msg.msg_id,
+                            site="transport.kv_ack")
+
+    def _on_transfer_ack(self, msg) -> None:
+        """Resolve one ack-tracked send. The transport already closed
+        the retransmit timer (the ``ack_ref`` rode the message); this
+        is the PROTOCOL resolution — commit or abort the two-phase
+        hand-off, finish or re-route the manifest group."""
+        ack = msg.record
+        with self._lock:
+            ctx = self._inflight.pop(ack["ref"], None)
+        if ctx is None:
+            return          # duplicate ack, or the give-up beat it
+        if ack["channel"] == "kv":
+            self._finish_kv(ctx, ack["status"], ack["reason"])
+        else:
+            self._finish_manifest_group(ctx, ack["ref"], ack["status"],
+                                        ack["reason"])
+
+    def _finish_kv(self, ctx, status: str, why) -> None:
+        """Close one two-phase hand-off: commit (release the exporter's
+        retained pages, register decode affinity) or abort (unwind the
+        prepare, fall down the recompute ladder)."""
+        req, record = ctx["req"], ctx["record"]
+        src, target = ctx["src"], ctx["target"]
+        keys = tuple(record.get("keys") or ())
+        if status == "ok":
+            self.replicas[src].commit_export(req.rid)
+            with self._lock:
+                self.kv_handoffs["pages"] += 1
+                self.kv_handoffs["pages_moved"] += record["num_pages"]
+                self._register_into(self._decode_affinity, keys, target)
+            _instr.record_disagg_handoff("pages")
+            tr = getattr(req, "trace", None)
+            if tr is not None:
+                tr.add("router_handoff", time.monotonic(),
+                       target=target, outcome="pages",
+                       retry=ctx["retry"])
+            return
+        self.replicas[src].abort_export(req.rid)
+        _instr.record_handoff_abort(why or "abort")
+        self._recompute_fallback(ctx)
+
+    def _on_kv_giveup(self, msg, why: str) -> None:
+        """Retransmits exhausted with no ack. The transport already
+        poisoned the msg_id (a late in-flight copy can never deliver),
+        so resolve from in-process truth: an import that actually
+        LANDED (only the ack died) commits; one that never landed
+        aborts and recomputes. Cross-host this check would be the
+        importer's fencing epoch — in-process the request's own state
+        is that truth."""
+        with self._lock:
+            ctx = self._inflight.pop(msg.msg_id, None)
+        if ctx is None:
+            return
+        req = ctx["req"]
+        landed = req.done or req.state != _HANDOFF
+        if landed:
+            self._finish_kv(ctx, "ok", None)
+        else:
+            self.replicas[ctx["src"]].abort_export(req.rid)
+            _instr.record_handoff_abort("ack_timeout")
+            self._recompute_fallback(ctx)
+
+    def _recompute_fallback(self, ctx) -> None:
+        """The hand-off failure ladder, transport spelling (mirrors the
+        sync path's except arm): prompt recompute on a decode survivor
+        that is NOT the replica that refused or vanished, any
+        non-prefill survivor after that, terminal failure after THAT.
+        Degraded, never wrong, never parked."""
+        req, src, target = ctx["req"], ctx["src"], ctx["target"]
+        with self._lock:
+            alt = [i for i in self._routable(role="decode")
+                   if i != target] or \
+                  [i for i in self._routable(exclude=src)
+                   if i != target
+                   and self.replicas[i].role != "prefill"]
+            alt_t = self._least_loaded(alt) if alt else None
+        if alt_t is None:
+            if not req.done:
+                req.fail(_res.RequestFailed(
+                    req.rid, reason="handoff_no_replica"))
+                src_eng = self.replicas[src]
+                if src_eng.obs is not None:
+                    src_eng.obs.on_fail(req, "handoff_failed")
+            with self._lock:
+                self.kv_handoffs["failed"] += 1
+            _instr.record_disagg_handoff("failed")
+            return
+        try:
+            self.replicas[alt_t].adopt_recompute(req)
+            outcome = "recompute"
+        except _res.RequestFailed:
+            outcome = "failed"
+        with self._lock:
+            self.kv_handoffs[outcome] += 1
+            if outcome != "failed":
+                keys = tuple(ctx["record"].get("keys") or ())
+                self._register_into(self._decode_affinity, keys, alt_t)
+        _instr.record_disagg_handoff(outcome)
+        tr = getattr(req, "trace", None)
+        if tr is not None:
+            tr.add("router_handoff", time.monotonic(), target=alt_t,
+                   outcome=outcome, retry=ctx["retry"])
+
+    def _send_manifest_group(self, manifest, exclude, reason, role,
+                             aff, group, tried) -> None:
+        """Route one affinity group of a dead replica's manifest to a
+        survivor over the manifest channel (ack-tracked). ``tried``
+        fences targets that already refused or vanished under this
+        group — the re-route ladder terminates at the survivor count."""
+        amap = self._decode_affinity if role == "decode" \
+            else self._affinity
+        with self._lock:
+            cands = [i for i in
+                     (self._routable(exclude=exclude, role=role)
+                      or self._routable(exclude=exclude))
+                     if i not in tried]
+            target = None
+            if aff is not None and cands:
+                idx = amap.get(aff)
+                if idx is not None and idx in cands:
+                    target = idx
+            if target is None and cands:
+                target = self._least_loaded(cands)
+        if target is None:
+            # no survivor (left): the originals already resolved
+            # terminally in abort_all — the group closes empty
+            self._group_done(exclude, aff, None, [], group)
+            return
+        sub = dict(manifest)
+        sub["requests"] = group
+        ctx = {"channel": "manifest", "manifest": manifest,
+               "exclude": exclude, "reason": reason, "role": role,
+               "aff": aff, "group": group, "target": target,
+               "tried": tried + (target,)}
+        msg_id = self.transport.send(
+            "router", target, kind="manifest", family="drain_manifest",
+            record=sub, needs_ack=True,
+            on_fail=self._on_manifest_giveup,
+            site="transport.manifest")
+        with self._lock:
+            self._inflight[msg_id] = ctx
+
+    def _handle_manifest(self, idx: int, msg) -> None:
+        """Replay one manifest group INTO replica ``idx`` and ack. The
+        replacement handles are local objects — they wait in
+        ``_replayed`` under the message id for the ack resolution (the
+        ack record itself stays pure)."""
+        with self._lock:
+            alive = self._alive[idx]
+        if not alive:
+            status, why, n = "abort", "replica_dead", 0
+        else:
+            try:
+                replayed = _res.replay_manifest(self.replicas[idx],
+                                                msg.record)
+                with self._lock:
+                    self._replayed[msg.msg_id] = replayed
+                status, why, n = "ok", None, len(replayed)
+            except Exception as exc:  # noqa: BLE001 — refusal, not death
+                status, why, n = "abort", type(exc).__name__, 0
+        ack = _tp.build_ack(msg.msg_id, "manifest", None, status, why, n)
+        self.transport.send(idx, "router", kind="ack",
+                            family="kv_transfer_ack", record=ack,
+                            ack_ref=msg.msg_id,
+                            site="transport.manifest_ack")
+
+    def _finish_manifest_group(self, ctx, ref, status, why) -> None:
+        with self._lock:
+            replayed = self._replayed.pop(ref, [])
+        if status == "ok":
+            self._group_done(ctx["exclude"], ctx["aff"], ctx["target"],
+                             replayed, ctx["group"])
+            return
+        # the target refused or died under the replay: re-route to a
+        # survivor this group has not tried yet
+        _instr.record_handoff_abort(why or "manifest_abort")
+        self._send_manifest_group(ctx["manifest"], ctx["exclude"],
+                                  ctx["reason"], ctx["role"],
+                                  ctx["aff"], ctx["group"],
+                                  ctx["tried"])
+
+    def _on_manifest_giveup(self, msg, why: str) -> None:
+        """Manifest send exhausted its retransmits. A replay that
+        actually landed (ack lost) commits from the local stash; one
+        that never landed re-routes like an abort."""
+        with self._lock:
+            ctx = self._inflight.pop(msg.msg_id, None)
+            replayed = self._replayed.pop(msg.msg_id, None)
+        if ctx is None:
+            return
+        if replayed is not None:
+            self._group_done(ctx["exclude"], ctx["aff"], ctx["target"],
+                             replayed, ctx["group"])
+            return
+        _instr.record_handoff_abort("ack_timeout")
+        self._send_manifest_group(ctx["manifest"], ctx["exclude"],
+                                  ctx["reason"], ctx["role"],
+                                  ctx["aff"], ctx["group"],
+                                  ctx["tried"])
+
+    def _group_done(self, exclude, aff, target, handles, group) -> None:
+        """One manifest group resolved — replayed onto ``target``, or
+        closed empty with no survivor. The LAST group finalizes the
+        salvage: handoff record appended, per-replica latch set."""
+        finished = False
+        reason = None
+        with self._lock:
+            pend = self._pending_salvage.get(exclude)
+            if pend is None:
+                return
+            reason = pend["reason"]
+            rec = pend["record"]
+            rec["handles"].extend(handles)
+            rec["groups"].append(
+                {"affinity": list(aff) if aff else None,
+                 "target": target,
+                 "orders": [e["order"] for e in group]})
+            pend["done"] += 1
+            finished = pend["done"] >= pend["expected"]
+            if target is not None and handles:
+                amap = self._decode_affinity \
+                    if pend["role"] == "decode" else self._affinity
+                for entry in group:
+                    keys = prefix_chain_keys(entry["prompt"],
+                                             self.block_size)
+                    self._register_into(amap, keys, target)
+                self.failovers[reason] = \
+                    self.failovers.get(reason, 0) + len(group)
+        if target is not None and handles:
+            for h in handles:
+                tr = getattr(h, "trace", None)
+                if tr is not None:
+                    tr.add("router_failover", time.monotonic(),
+                           from_replica=exclude, to_replica=target,
+                           reason=reason)
+            for _ in group:
+                _instr.record_router_failover(reason)
+        if finished:
+            self._finalize_salvage(exclude)
+
+    def _finalize_salvage(self, exclude: int) -> None:
+        with self._lock:
+            pend = self._pending_salvage.pop(exclude, None)
+            if pend is None:
+                return
+            self.handoffs.append(pend["record"])
+        self._handoff_complete[exclude].set()
+
     # -- driving --------------------------------------------------------------
     def step_all(self) -> bool:
         """One round-robin pass: step every live replica that has work.
@@ -535,6 +945,8 @@ class ReplicaRouter:
         replica is failed as a unit (its manifest replays onto affinity
         -matched survivors) and the pass continues. Returns True while
         any live replica still has work."""
+        if self.transport is not None:
+            self._transport_pass()
         if self._pending_handoffs:
             self._retry_pending_handoffs()
         for idx, eng in enumerate(self.replicas):
@@ -562,6 +974,7 @@ class ReplicaRouter:
 
     def has_work(self) -> bool:
         return bool(self._pending_handoffs) or \
+            (self.transport is not None and self.transport.busy()) or \
             any(self._alive[i] and e.has_work()
                 for i, e in enumerate(self.replicas))
 
@@ -589,6 +1002,10 @@ class ReplicaRouter:
             if not self._alive[idx]:
                 return []
             self._alive[idx] = False
+        if self.membership is not None:
+            # every path to dead — crash, lease expiry, drain, scale-
+            # down — is the same lease transition with a reason
+            self.membership.kill(idx, self.transport.tick, reason)
         eng = self.replicas[idx]
         if manifest is None:
             manifest = self._salvage_manifest(eng)
@@ -610,8 +1027,8 @@ class ReplicaRouter:
         with eng._lock:
             return _res.build_manifest(eng._live_requests(), 0.0)
 
-    def decommission(self, idx: int,
-                     deadline_s: Optional[float] = None) -> List:
+    def decommission(self, idx: int, deadline_s: Optional[float] = None,
+                     cause: Optional[str] = None) -> List:
         """Gracefully retire replica ``idx``: drain it (admission stops,
         decode runs within the grace budget), then hand the manifest of
         whatever did not finish to affinity-matched survivors exactly
@@ -622,6 +1039,11 @@ class ReplicaRouter:
             if not self._alive[idx]:
                 return []
             self._alive[idx] = False
+        if self.membership is not None:
+            # ``cause`` names WHO retired it (autoscale_retire vs plain
+            # drain) in the lease ledger; the salvage path is identical
+            self.membership.kill(idx, self.transport.tick,
+                                 cause or "drain")
         eng = self.replicas[idx]
         reason = "drain"
         try:
@@ -665,6 +1087,7 @@ class ReplicaRouter:
             self.prefill_pool.sort()
             eng.handoff_sink = functools.partial(
                 self._dispatch_handoff, idx)
+            eng.handoff_two_phase = self.transport is not None
         elif role == "decode":
             self.decode_pool.append(idx)
             self.decode_pool.sort()
@@ -709,6 +1132,16 @@ class ReplicaRouter:
                 self.reused_slots += 1
             self._rewire_locked(idx)
             self.spawns += 1
+        if self.transport is not None:
+            # (re)bind the slot's endpoint, clear any partition left by
+            # the previous occupant, and re-admit it into the lease
+            # table — join is the ONE authority that exits "dead"
+            self.transport.register(
+                idx, functools.partial(self._on_replica_message, idx))
+            self.transport.heal(idx)
+            if self.membership is not None:
+                self.membership.join(idx, self.transport.tick,
+                                     role=role)
         if self.fleet_obs is not None:
             self.fleet_obs.on_fleet_change(self, idx)
         return idx
@@ -743,6 +1176,10 @@ class ReplicaRouter:
             self._alive[idx] = True
             self._handoff_complete[idx] = threading.Event()
             self._rewire_locked(idx)
+        if self.transport is not None and self.membership is not None:
+            # the decommission above killed the lease; the re-admit
+            # under the new role is an explicit rejoin
+            self.membership.join(idx, self.transport.tick, role=role)
         if self.fleet_obs is not None:
             self.fleet_obs.on_fleet_change(self, idx)
         return handles
@@ -764,9 +1201,6 @@ class ReplicaRouter:
             aff = tuple(tag["affinity"]) if isinstance(tag, dict) \
                 and tag.get("affinity") else None
             groups.setdefault(aff, []).append(entry)
-        handles: List = []
-        record = {"replica": exclude, "reason": reason,
-                  "requests": len(entries), "groups": []}
         # disaggregated fleets replay onto SAME-ROLE survivors first (a
         # dead prefill replica's work re-prefills and hands off again; a
         # dead decode replica's work recomputes on the decode pool), and
@@ -774,6 +1208,29 @@ class ReplicaRouter:
         # for a prefill death with no prefill peer is prompt recompute
         # straight on a decode survivor
         role = getattr(self.replicas[exclude], "role", None)
+        if self.transport is not None:
+            # transport mode: each affinity group rides the manifest
+            # channel as an ack-tracked send; the salvage record and
+            # the per-replica latch resolve when the LAST group acks
+            # (or exhausts its re-route ladder). Callers get [] — the
+            # async replacement handles land in ``self.handoffs``.
+            with self._lock:
+                self._pending_salvage[exclude] = {
+                    "expected": len(groups), "done": 0,
+                    "reason": reason, "role": role,
+                    "record": {"replica": exclude, "reason": reason,
+                               "requests": len(entries), "groups": [],
+                               "handles": []}}
+            if not groups:
+                self._finalize_salvage(exclude)
+                return []
+            for aff, group in groups.items():
+                self._send_manifest_group(manifest, exclude, reason,
+                                          role, aff, group, tried=())
+            return []
+        handles: List = []
+        record = {"replica": exclude, "reason": reason,
+                  "requests": len(entries), "groups": []}
         amap = self._decode_affinity if role == "decode" \
             else self._affinity
         for aff, group in groups.items():
@@ -858,6 +1315,10 @@ class ReplicaRouter:
                             for i in self.decode_pool if alive[i])},
                 }
                 router["kv_handoffs"] = dict(self.kv_handoffs)
+            if self.transport is not None:
+                router["transport"] = self.transport.telemetry()
+                router["membership"] = None if self.membership is None \
+                    else self.membership.telemetry()
         reps = []
         fleet = {"steps": 0, "tokens_generated": 0, "queue_depth": 0,
                  "running": 0,

@@ -1,0 +1,556 @@
+"""Chaos-injectable replica transport: the fault-domain message plane.
+
+ROADMAP item 2(a) names ``ServingEngine._export_request`` /
+``_place_page`` as the ICI/DCN seam where KV pages will cross hosts.
+Today every cross-replica interaction rides a perfect in-process
+function call: zero loss, zero duplication, zero delay. This module is
+the substrate that makes a dropped, duplicated, reordered, delayed, or
+torn message a HANDLED case before a real network exists to cause one:
+a tick-based store-and-forward message channel between fleet endpoints
+(replica indices + the router control endpoint), carrying exactly the
+sealed ``wire.py`` record families the router already exchanges.
+
+Fault model (all seeded, all tick-denominated — never wall-clock, so
+drills replay bit-identically from one integer seed):
+
+  * ``transport.send``  — polled per transmission. ``error`` faults
+    interpret their arg as the fault mode: ``drop`` (message vanishes;
+    its link sequence number still advances — drops create gaps),
+    ``dup`` (a second copy with the SAME idempotency key enqueues —
+    the receiver's dedup window must suppress it), ``reorder`` (held
+    one tick so later same-tick sends overtake it). ``delay`` faults
+    hold the message ``arg`` ticks.
+  * ``transport.recv``  — polled per delivery attempt. ``error`` =
+    the transfer tore in flight (receiver never sees it; the sender's
+    retransmit timer is the only recovery); ``delay`` holds delivery
+    one more tick.
+  * ``transport.link``  — polled per transmission. ``error`` takes the
+    message's link down BIDIRECTIONALLY for ``arg`` ticks (default 4):
+    a partition, distinct from per-message loss. Drills can also
+    partition an endpoint programmatically (``partition``/``heal``).
+
+Reliability mechanisms, mirroring what a real DCN transport owes the
+records above it:
+
+  * **idempotency keys + bounded dedup window** — every logical message
+    carries a unique ``msg_id``; retransmissions and chaos duplicates
+    reuse it, and the receiver delivers each key at most once (a
+    duplicated KV hand-off import must never double-admit). A deduped
+    message that was ack-carrying re-sends its CACHED ack — the torn-ack
+    case: the importer committed, the ack died on the wire, and the
+    retransmitted prepare must re-ack, not re-import.
+  * **per-link sequence numbers** — reorder is detected and
+    re-sequenced through a bounded hold-back buffer; a hole that does
+    not fill within ``reorder_window`` ticks is skipped (drops must
+    not wedge the link behind a gap that will never fill).
+  * **acks + capped exponential backoff** — ``needs_ack`` senders keep
+    a pending table; retransmit schedules come from
+    ``resilience/retry.py``'s ``RetryPolicy.backoff`` with its seeded
+    jitter, read as TICKS. A give-up (attempt ceiling) fires the
+    sender's ``on_fail`` — the router's abort/recompute ladder — and
+    poisons the key so a still-in-flight late copy can never deliver
+    after the sender already recovered elsewhere.
+
+Lock discipline: ``ReplicaTransport`` owns rank "transport" in
+``locking.LOCK_ORDER`` (between router and engine). The lock guards
+queue/dedup/pending state only and is NEVER held across a delivery
+handler — handlers run lock-free and may take the router or engine
+lock themselves (strictly later ranks are unreachable from them).
+
+Disarmed (``ReplicaRouter(transport=None)``, the default) none of this
+exists: the router keeps its PR 15 synchronous direct-call paths,
+bit-identically.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..profiler import instrument as _instr
+from ..resilience import chaos
+from ..resilience.retry import RetryPolicy
+from .locking import OrderedLock
+from . import wire as _wire
+
+__all__ = ["TransportConfig", "Message", "ReplicaTransport",
+           "resolve_transport", "build_ack"]
+
+
+class TransportConfig:
+    """Knobs for one fleet transport (all delays in TICKS — one tick is
+    one ``step_all`` pass; the transport never sleeps)."""
+
+    def __init__(self, dedup_window: int = 512, reorder_window: int = 2,
+                 max_attempts: int = 5, backoff_base: float = 2.0,
+                 backoff_max: float = 8.0, backoff_multiplier: float = 2.0,
+                 backoff_jitter: float = 0.25, link_down_ticks: int = 4,
+                 seed: int = 0):
+        if dedup_window < 0:
+            raise ValueError("dedup_window must be >= 0")
+        if max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        self.dedup_window = int(dedup_window)
+        self.reorder_window = int(reorder_window)
+        self.max_attempts = int(max_attempts)
+        self.backoff_base = float(backoff_base)
+        self.backoff_max = float(backoff_max)
+        self.backoff_multiplier = float(backoff_multiplier)
+        self.backoff_jitter = float(backoff_jitter)
+        self.link_down_ticks = int(link_down_ticks)
+        self.seed = int(seed)
+
+
+class Message:
+    """One transmission unit. Retransmissions and chaos duplicates are
+    new ``Message`` objects sharing the original's ``msg_id`` and
+    ``seq`` — identity lives in the idempotency key, not the object."""
+
+    __slots__ = ("src", "dst", "kind", "family", "record", "meta",
+                 "msg_id", "seq", "due", "needs_ack", "on_fail",
+                 "ack_ref", "site")
+
+    def __init__(self, src, dst, kind: str, family: str, record: dict,
+                 meta: Optional[dict], msg_id: str, seq: int, due: int,
+                 needs_ack: bool, on_fail, ack_ref: Optional[str],
+                 site: str):
+        self.src = src
+        self.dst = dst
+        self.kind = kind
+        self.family = family
+        self.record = record
+        self.meta = meta or {}
+        self.msg_id = msg_id
+        self.seq = seq
+        self.due = due
+        self.needs_ack = needs_ack
+        self.on_fail = on_fail
+        self.ack_ref = ack_ref
+        self.site = site
+
+    def _copy(self, due: int) -> "Message":
+        return Message(self.src, self.dst, self.kind, self.family,
+                       self.record, self.meta, self.msg_id, self.seq,
+                       due, self.needs_ack, self.on_fail, self.ack_ref,
+                       self.site)
+
+    def __repr__(self):
+        return (f"Message({self.kind} {self.src}->{self.dst} "
+                f"id={self.msg_id} seq={self.seq})")
+
+
+def build_ack(ref: str, channel: str, rid: Optional[int], status: str,
+              reason: Optional[str], num_pages: int) -> dict:
+    """The ``kv_transfer_ack`` wire record: closes one ack-tracked
+    transport message (``channel`` "kv" = two-phase KV hand-off,
+    "manifest" = drain-manifest replay). ``status`` "ok" commits the
+    sender's prepare; "abort" (with ``reason``) rolls it back down the
+    recompute ladder."""
+    return _wire.seal({
+        "version": 1,
+        "ref": ref,
+        "channel": channel,
+        "rid": rid,
+        "status": status,
+        "reason": reason,
+        "num_pages": int(num_pages),
+    }, "kv_transfer_ack")
+
+
+class ReplicaTransport:
+    """Tick-based store-and-forward message channel between fleet
+    endpoints. Driven by the router: ``advance()`` once per ``step_all``
+    pass, sends from any thread, one ``pump()`` per pass delivering
+    every due message to its endpoint handler (lock NEVER held across a
+    handler)."""
+
+    def __init__(self, config: Optional[TransportConfig] = None):
+        self.config = config or TransportConfig()
+        self.tick = 0
+        self._lock = OrderedLock("transport")
+        self._handlers: Dict[Any, Callable[[Message], None]] = {}
+        self._queue: List[Message] = []          # in-flight, FIFO
+        self._msg_counter = 0
+        # per-link (src, dst) sender sequence counters
+        self._send_seq: Dict[Tuple, int] = {}
+        # per-link receiver state: next expected seq + hold-back buffer
+        # {seq: (message, expire_tick)} for reorder re-sequencing
+        self._recv_seq: Dict[Tuple, int] = {}
+        self._holdback: "OrderedDict[Tuple, Dict[int, Tuple]]" = \
+            OrderedDict()
+        # bounded receiver dedup window: msg_id -> ack Message to replay
+        # on a duplicate (None for fire-and-forget kinds)
+        self._seen: "OrderedDict[str, Optional[Message]]" = OrderedDict()
+        # msg_ids poisoned after a give-up: a late in-flight copy must
+        # never deliver once the sender recovered down the fallback
+        # ladder (the double-decode hole a real transport closes with
+        # fencing; here the cancel set IS the fence)
+        self._canceled: set = set()
+        # sender-side ack tracking: msg_id -> [message, attempt,
+        # next_retry_tick]
+        self._pending: "OrderedDict[str, list]" = OrderedDict()
+        # endpoints (or endpoint pairs) with their links down
+        self._partitioned: set = set()
+        self._link_down: Dict[Tuple, int] = {}   # (a, b) -> up_tick
+        self.retry = RetryPolicy(
+            max_attempts=self.config.max_attempts,
+            base_delay=self.config.backoff_base,
+            max_delay=self.config.backoff_max,
+            multiplier=self.config.backoff_multiplier,
+            jitter=self.config.backoff_jitter,
+            seed=self.config.seed)
+        self.counters: Dict[str, int] = {
+            "sent": 0, "delivered": 0, "dropped": 0, "duplicate": 0,
+            "deduped": 0, "delayed": 0, "reordered": 0, "gap_skips": 0,
+            "partitioned": 0, "torn": 0, "unroutable": 0, "acked": 0,
+            "retransmits": 0, "giveups": 0, "canceled": 0,
+        }
+        self.retries_by_site: Dict[str, int] = {}
+        self.giveups_by_site: Dict[str, int] = {}
+
+    # -- wiring ---------------------------------------------------------------
+    def register(self, endpoint, handler: Callable[[Message], None]) -> None:
+        """Bind (or re-bind) one endpoint's delivery handler."""
+        with self._lock:
+            self._handlers[endpoint] = handler
+
+    def endpoints(self) -> List:
+        with self._lock:
+            return sorted(self._handlers, key=str)
+
+    # -- partitions -----------------------------------------------------------
+    def partition(self, endpoint) -> None:
+        """Take every link touching ``endpoint`` down until ``heal``:
+        nothing sends to or delivers at a partitioned endpoint (queued
+        in-flight messages included — they die at delivery time)."""
+        with self._lock:
+            self._partitioned.add(endpoint)
+
+    def heal(self, endpoint) -> None:
+        with self._lock:
+            self._partitioned.discard(endpoint)
+
+    def is_partitioned(self, endpoint) -> bool:
+        with self._lock:
+            return endpoint in self._partitioned
+
+    # -- chaos ----------------------------------------------------------------
+    @staticmethod
+    def _poll_fault(site: str):
+        """Poll the installed chaos plan at a transport site WITHOUT
+        ``chaos.site()`` semantics: an ``error`` fault must become a
+        deterministic message-level event (drop/dup/reorder/partition),
+        never a raise, and a ``delay`` fault must hold TICKS, never
+        sleep wall-clock."""
+        plan = chaos.active_plan()
+        if plan is None:
+            return None
+        f = plan.poll(site, ("error", "delay"))
+        if f is not None:
+            _instr.record_fault_injected(site, f.kind)
+        return f
+
+    # -- sending --------------------------------------------------------------
+    def send(self, src, dst, kind: str, family: str, record: dict,
+             meta: Optional[dict] = None, needs_ack: bool = False,
+             on_fail=None, ack_ref: Optional[str] = None,
+             site: Optional[str] = None) -> Optional[str]:
+        """Enqueue one message. Applies seeded link/send chaos; returns
+        the message's idempotency key (None when the message died at
+        the send seam — the sender learns nothing, exactly like a real
+        wire; ``needs_ack`` senders recover via retransmission)."""
+        site = site or f"transport.{kind}"
+        with self._lock:
+            link = (src, dst)
+            seq = self._send_seq.get(link, 0)
+            self._send_seq[link] = seq + 1
+            self._msg_counter += 1
+            msg_id = f"m{self._msg_counter}"
+            msg = Message(src, dst, kind, family, record, meta, msg_id,
+                          seq, self.tick, bool(needs_ack), on_fail,
+                          ack_ref, site)
+            if needs_ack:
+                self._pending[msg_id] = [
+                    msg, 0, self.tick + self._backoff_ticks(0)]
+            if ack_ref is not None:
+                # cache the ack so a deduped duplicate of the message it
+                # closes can re-send it (the torn-ack recovery)
+                self._remember_ack(ack_ref, msg)
+            self._transmit_locked(msg)
+        return msg_id
+
+    def _backoff_ticks(self, attempt: int) -> int:
+        return max(1, int(round(self.retry.backoff(attempt))))
+
+    def _remember_ack(self, ref: str, ack: Message) -> None:
+        if ref in self._seen:
+            self._seen[ref] = ack
+            self._seen.move_to_end(ref)
+
+    def _transmit_locked(self, msg: Message) -> None:
+        """One transmission attempt onto the wire (under the lock):
+        link partition check, then per-send chaos, then the queue."""
+        self.counters["sent"] += 1
+        if msg.src in self._partitioned or msg.dst in self._partitioned \
+                or self._link_is_down(msg.src, msg.dst):
+            self._terminal(msg, "partitioned")
+            return
+        f = self._poll_fault("transport.link")
+        if f is not None and f.kind == "error":
+            down = int(f.arg) if f.arg and str(f.arg).isdigit() \
+                else self.config.link_down_ticks
+            up = self.tick + down
+            self._link_down[(msg.src, msg.dst)] = up
+            self._link_down[(msg.dst, msg.src)] = up
+            self._terminal(msg, "partitioned")
+            return
+        f = self._poll_fault("transport.send")
+        if f is not None:
+            if f.kind == "delay":
+                hold = int(f.arg) if f.arg and str(f.arg).isdigit() else 1
+                self.counters["delayed"] += 1
+                self._queue.append(msg._copy(msg.due + hold))
+                return
+            mode = f.arg or "drop"
+            if mode == "drop":
+                self._terminal(msg, "dropped")
+                return
+            if mode == "dup":
+                self.counters["duplicate"] += 1
+                self._queue.append(msg)
+                self._queue.append(msg._copy(msg.due))
+                return
+            if mode == "reorder":
+                # held one tick: every later same-tick send overtakes it
+                self.counters["delayed"] += 1
+                self._queue.append(msg._copy(msg.due + 1))
+                return
+        self._queue.append(msg)
+
+    def _link_is_down(self, a, b) -> bool:
+        up = self._link_down.get((a, b))
+        if up is None:
+            return False
+        if self.tick >= up:
+            del self._link_down[(a, b)]
+            return False
+        return True
+
+    def _terminal(self, msg: Message, outcome: str) -> None:
+        self.counters[outcome] += 1
+        _instr.record_transport_message(msg.kind, outcome)
+
+    # -- the tick loop --------------------------------------------------------
+    def advance(self) -> int:
+        """One transport tick (the router calls this once per
+        ``step_all`` pass, before ``pump``)."""
+        with self._lock:
+            self.tick += 1
+            return self.tick
+
+    def busy(self) -> bool:
+        """True while undelivered messages, hold-back buffers, or
+        unacked sends remain — ``router.has_work`` keeps the driver
+        pumping until the fabric settles."""
+        with self._lock:
+            return bool(self._queue) or bool(self._pending) or \
+                any(self._holdback.values())
+
+    def pump(self) -> int:
+        """Deliver every due message (in send order, re-sequenced per
+        link), then run the retransmit/give-up pass. Returns delivered
+        count. Handlers are invoked OUTSIDE the transport lock."""
+        deliveries: List[Tuple[Optional[Callable], Message]] = []
+        failures: List[Message] = []
+        with self._lock:
+            due, still = [], []
+            for msg in self._queue:
+                (due if msg.due <= self.tick else still).append(msg)
+            self._queue = still
+            for msg in due:
+                self._receive_locked(msg, deliveries)
+            self._expire_holdbacks_locked(deliveries)
+            self._retransmit_locked(failures)
+        n = 0
+        for handler, msg in deliveries:
+            self._terminal(msg, "delivered")
+            n += 1
+            if handler is not None:
+                handler(msg)
+        for msg in failures:
+            if msg.on_fail is not None:
+                msg.on_fail(msg, "ack_timeout")
+        return n
+
+    # -- receive path (all under the lock; handlers collected, not run) -------
+    def _receive_locked(self, msg: Message, out: List) -> None:
+        if msg.src in self._partitioned or msg.dst in self._partitioned:
+            self._terminal(msg, "partitioned")
+            return
+        f = self._poll_fault("transport.recv")
+        if f is not None:
+            if f.kind == "delay":
+                self._queue.append(msg._copy(self.tick + 1))
+                self.counters["delayed"] += 1
+                return
+            # torn at some byte in flight: the receiver never saw it —
+            # neither pool mutates, the sender's retransmit recovers
+            self._terminal(msg, "torn")
+            return
+        if msg.ack_ref is not None:
+            self._resolve_ack_locked(msg.ack_ref)
+        if msg.msg_id in self._canceled:
+            self._terminal(msg, "canceled")
+            return
+        if msg.msg_id in self._seen:
+            self._seen.move_to_end(msg.msg_id)
+            cached_ack = self._seen[msg.msg_id]
+            self._terminal(msg, "deduped")
+            if cached_ack is not None:
+                # duplicated prepare whose ack died on the wire: re-send
+                # the SAME ack (never re-deliver, never double-admit)
+                self._transmit_locked(cached_ack._copy(self.tick))
+            return
+        self._sequence_locked(msg, out)
+
+    def _resolve_ack_locked(self, ref: str) -> None:
+        if self._pending.pop(ref, None) is not None:
+            self.counters["acked"] += 1
+
+    def _sequence_locked(self, msg: Message, out: List) -> None:
+        link = (msg.src, msg.dst)
+        expected = self._recv_seq.get(link, 0)
+        if msg.seq > expected:
+            # a hole precedes this message: hold it back so the hole's
+            # occupant (merely delayed or reordered) can slot in first;
+            # a hole that never fills expires in reorder_window ticks
+            self.counters["reordered"] += 1
+            hb = self._holdback.setdefault(link, {})
+            if msg.seq not in hb:
+                hb[msg.seq] = (msg, self.tick + self.config.reorder_window)
+            return
+        if msg.seq == expected:
+            self._recv_seq[link] = expected + 1
+        # msg.seq < expected: a gap-skipped straggler finally arriving —
+        # deliver it (first time for this msg_id; dedup already passed)
+        self._deliver_locked(msg, out)
+        self._drain_holdback_locked(link, out)
+
+    def _drain_holdback_locked(self, link: Tuple, out: List) -> None:
+        hb = self._holdback.get(link)
+        while hb:
+            nxt = self._recv_seq.get(link, 0)
+            if nxt not in hb:
+                return
+            held, _ = hb.pop(nxt)
+            self._recv_seq[link] = nxt + 1
+            self._deliver_locked(held, out)
+
+    def _expire_holdbacks_locked(self, out: List) -> None:
+        """Holes that never filled inside the reorder window: skip the
+        gap and release the held messages in seq order — a dropped
+        message must not wedge its link forever."""
+        for link in list(self._holdback):
+            hb = self._holdback[link]
+            while hb and min(exp for _, exp in hb.values()) <= self.tick:
+                seq = min(hb)
+                held, _ = hb.pop(seq)
+                if seq > self._recv_seq.get(link, 0):
+                    self.counters["gap_skips"] += 1
+                self._recv_seq[link] = seq + 1
+                self._deliver_locked(held, out)
+                self._drain_holdback_locked(link, out)
+            if not hb:
+                del self._holdback[link]
+
+    def _deliver_locked(self, msg: Message, out: List) -> None:
+        if self.config.dedup_window > 0:
+            self._seen[msg.msg_id] = None
+            self._seen.move_to_end(msg.msg_id)
+            while len(self._seen) > self.config.dedup_window:
+                self._seen.popitem(last=False)
+        handler = self._handlers.get(msg.dst)
+        if handler is None:
+            self._terminal(msg, "unroutable")
+            return
+        out.append((handler, msg))
+
+    # -- retransmit / give-up -------------------------------------------------
+    def _retransmit_locked(self, failures: List) -> None:
+        for msg_id in list(self._pending):
+            entry = self._pending[msg_id]
+            msg, attempt, next_retry = entry
+            if self.tick < next_retry:
+                continue
+            attempt += 1
+            if attempt >= self.config.max_attempts:
+                del self._pending[msg_id]
+                self._canceled.add(msg_id)
+                self.counters["giveups"] += 1
+                self.counters["canceled"] += 1
+                self.giveups_by_site[msg.site] = \
+                    self.giveups_by_site.get(msg.site, 0) + 1
+                _instr.record_resilience_giveup(msg.site)
+                failures.append(msg)
+                continue
+            entry[1] = attempt
+            entry[2] = self.tick + self._backoff_ticks(attempt)
+            self.counters["retransmits"] += 1
+            self.retries_by_site[msg.site] = \
+                self.retries_by_site.get(msg.site, 0) + 1
+            _instr.record_resilience_retry(msg.site)
+            _instr.record_transport_retry(msg.site)
+            self._transmit_locked(msg._copy(self.tick))
+
+    def resolve(self, msg_id: str) -> None:
+        """Manually close one pending ack-tracked message (the router's
+        give-up ladder uses this after recovering out-of-band)."""
+        with self._lock:
+            self._resolve_ack_locked(msg_id)
+
+    def cancel(self, msg_id: str) -> None:
+        """Poison ``msg_id``: any still-in-flight copy dies at delivery.
+        The sender calls this when it recovers down the fallback ladder
+        — a late duplicate must never land AFTER the recovery."""
+        with self._lock:
+            self._pending.pop(msg_id, None)
+            self._canceled.add(msg_id)
+
+    # -- evidence -------------------------------------------------------------
+    def telemetry(self) -> dict:
+        with self._lock:
+            return {
+                "tick": self.tick,
+                "in_flight": len(self._queue),
+                "pending_acks": len(self._pending),
+                "held_back": sum(len(h)
+                                 for h in self._holdback.values()),
+                "partitioned": sorted(self._partitioned, key=str),
+                "counters": dict(self.counters),
+                "retries_by_site": dict(sorted(
+                    self.retries_by_site.items())),
+                "giveups_by_site": dict(sorted(
+                    self.giveups_by_site.items())),
+            }
+
+
+def resolve_transport(value, seed: int = 0) -> Optional[ReplicaTransport]:
+    """The plane-arming convention (``resolve_fleet_obs`` shape):
+    None/False = disarmed (and every armed-only seam in the router is
+    one ``is None`` check), True = defaults, a ``TransportConfig`` or a
+    ready ``ReplicaTransport`` pass through. ``PADDLE_SERVE_TRANSPORT=1``
+    arms defaults from the environment."""
+    import os
+    if value is None or value is False:
+        if os.environ.get("PADDLE_SERVE_TRANSPORT", "").strip().lower() \
+                in ("1", "true", "on", "yes"):
+            return ReplicaTransport(TransportConfig(seed=seed))
+        return None
+    if value is True:
+        return ReplicaTransport(TransportConfig(seed=seed))
+    if isinstance(value, TransportConfig):
+        return ReplicaTransport(value)
+    if isinstance(value, ReplicaTransport):
+        return value
+    raise TypeError(
+        f"transport= wants None|True|TransportConfig|ReplicaTransport, "
+        f"got {type(value).__name__}")

@@ -276,6 +276,65 @@ WIRE_SCHEMAS = {
         "item_consumers": (),
         "sinks": (),
     },
+    "kv_transfer_ack": {
+        "family": "kv_transfer_ack",
+        "version": 1,
+        "version_key": "version",
+        "required": {
+            "version": "int",
+            # idempotency key of the message being acknowledged — the
+            # exporter resolves its pending retransmit table by this,
+            # and a deduped duplicate prepare re-sends the SAME ack
+            "ref": "str",
+            # which transport channel the ack closes: "kv" (two-phase
+            # KV-page hand-off) or "manifest" (drain-manifest replay)
+            "channel": "str",
+            "rid": "int|none",
+            "status": "str",            # ok | abort
+            "reason": "str|none",       # abort cause (PoolExhausted, ...)
+            "num_pages": "int",
+        },
+        "optional": {},
+        "item_key": None,
+        "item_required": {},
+        "item_optional": {},
+        "key_hashes": {1: "c947c98a"},
+        "byte_stable": False,
+        "builders": ("serving/transport.py::build_ack",),
+        "consumers": (("serving/router.py::_on_transfer_ack", "ack"),),
+        "item_consumers": (),
+        "sinks": (),
+    },
+    "membership_lease": {
+        "family": "membership_lease",
+        "version": 1,
+        "version_key": "version",
+        "required": {
+            "version": "int",
+            "replica": "int",
+            # sender-side transport tick the heartbeat was minted at;
+            # the lease extends lease_ticks past the RECEIVER's tick at
+            # delivery (clocks are per-process on a real wire)
+            "tick": "int",
+            "role": "str|none",
+            "lease_ticks": "int",
+            # the fleet-signal payload riding the lease ring: enough
+            # for membership telemetry to answer "what was this replica
+            # doing when we last heard from it"
+            "queue_depth": "int",
+            "tokens_generated": "int",
+        },
+        "optional": {},
+        "item_key": None,
+        "item_required": {},
+        "item_optional": {},
+        "key_hashes": {1: "30e15e76"},
+        "byte_stable": False,
+        "builders": ("serving/membership.py::build_heartbeat",),
+        "consumers": (("serving/membership.py::heartbeat", "record"),),
+        "item_consumers": (),
+        "sinks": (),
+    },
     "telemetry_line": {
         "family": "telemetry_line",
         "version": 1,

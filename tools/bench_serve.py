@@ -916,6 +916,194 @@ def run_router_pair(seed: int, fast: bool):
     return rows
 
 
+def drive_lossy(workload, engines, seed: int, slo, transport_cfg,
+                membership_cfg, plan):
+    """Open-loop drive of a disaggregated fleet over the fault-domain
+    transport, optionally under a seeded lossy-link chaos plan. Unlike
+    ``drive_fleet`` this is failure-tolerant: the row REPORTS terminal
+    failures instead of asserting them away, because the no-dedup/
+    no-lease baseline row exists to show what the reliability
+    machinery averts."""
+    from paddle_tpu.resilience import chaos
+    from paddle_tpu.serving import ReplicaRouter
+    router = ReplicaRouter(engines, policy="affinity", seed=seed,
+                           transport=transport_cfg,
+                           membership=membership_cfg)
+    ttft_d, tpot_d = slo
+    pending = sorted(workload, key=lambda r: r["arrival_s"])
+    handles = []
+    if plan is not None:
+        chaos.install_plan(plan)
+    t0 = time.monotonic()
+    try:
+        i = 0
+        while i < len(pending) or router.has_work():
+            now = time.monotonic() - t0
+            while i < len(pending) and pending[i]["arrival_s"] <= now:
+                r = pending[i]
+                handles.append((r, router.submit(
+                    r["prompt"], max_new_tokens=r["max_new"],
+                    ttft_deadline=ttft_d, tpot_deadline=tpot_d,
+                    tag=i)))
+                i += 1
+            if router.has_work():
+                router.step_all()
+            elif i < len(pending):
+                time.sleep(min(pending[i]["arrival_s"] - now, 0.005))
+    finally:
+        if plan is not None:
+            chaos.clear_plan()
+    wall = time.monotonic() - t0
+    tokens, crc, failed, parked = 0, 0, 0, 0
+    for spec, req in handles:
+        if not req.done:
+            parked += 1
+        elif req.error is not None:
+            failed += 1
+        else:
+            tokens += len(req.output)
+            crc = zlib.crc32(np.asarray(req.output, np.int32).tobytes(),
+                             crc)
+    tel = router.telemetry()
+    slo_agg = tel["fleet"].get("slo", {})
+    tp = tel["router"]["transport"]
+    return {
+        "replicas": len(engines),
+        "requests": len(handles),
+        "parked": parked,
+        "failed": failed,
+        "output_tokens": int(tokens),
+        "wall_s": round(wall, 4),
+        "tokens_per_s": round(tokens / wall, 2),
+        "slo_attainment": slo_agg.get("attainment"),
+        "goodput_tokens": slo_agg.get("goodput_tokens", 0),
+        "kv_handoffs": dict(router.kv_handoffs),
+        "transport": {"counters": tp["counters"],
+                      "retries_by_site": tp["retries_by_site"],
+                      "giveups_by_site": tp["giveups_by_site"]},
+        "lease_transitions":
+            tel["router"]["membership"]["transition_counts"]
+            if tel["router"]["membership"] else None,
+        "output_crc32": crc,
+    }
+
+
+def run_lossy_pair(seed: int, fast: bool):
+    """The fault-domain rows: ONE seeded open-loop schedule on a 1
+    prefill + 2 decode fleet whose cross-replica channels ride the
+    chaos-injectable transport, driven three ways — (a) fault-free
+    (the oracle crc), (b) a 5% drop + 5% dup + 5% delay plan against
+    the FULL reliability stack (dedup window, ack-tracked retransmits,
+    lease membership), and (c) the same plan against a no-dedup/
+    no-lease baseline (``max_attempts=1, dedup_window=0``,
+    membership disarmed). The floor: the resilient row absorbs the
+    loss with zero parked/failed requests, crc equal to the fault-free
+    oracle, and SLO attainment >= 0.95; the baseline row converges
+    only because the engine's duplicate-import guard and the give-up
+    recompute ladder avert the double-decode/wedge — its extra aborts
+    and recomputes are the measured cost of running lossy links
+    without the transport's reliability machinery."""
+    from paddle_tpu.resilience import chaos
+    from paddle_tpu.serving import (EngineConfig, ObsConfig,
+                                    ServingEngine, TransportConfig)
+    model = _build_router_model(fast)
+    vocab = model.config.vocab_size
+    if fast:
+        n_requests, rate = 18, 60.0
+        pre_kw = {"max_seqs": 2, "token_budget": 16, "block_size": 8,
+                  "num_blocks": 64}
+        dec_kw = {"max_seqs": 4, "token_budget": 8, "block_size": 8,
+                  "num_blocks": 64}
+        slo = (8.0, 2.0)               # generous CPU-fast deadlines
+    else:
+        n_requests, rate = 96, 60.0
+        pre_kw = {"max_seqs": 4, "token_budget": 32, "block_size": 8,
+                  "num_blocks": 128}
+        dec_kw = {"max_seqs": 8, "token_budget": 8, "block_size": 8,
+                  "num_blocks": 128}
+        slo = (4.0, 0.5)
+    workload = make_workload(seed + 11, n_requests, rate, vocab)
+
+    def mk_fleet():
+        obs = lambda: ObsConfig(flight_steps=32,  # noqa: E731
+                                flight_requests=16)
+        pre = ServingEngine(model, EngineConfig(role="prefill",
+                                                obs=obs(), **pre_kw))
+        dec = [ServingEngine(model, EngineConfig(role="decode",
+                                                 obs=obs(), **dec_kw))
+               for _ in range(2)]
+        return [pre] + dec
+
+    def mk_plan():
+        return (chaos.FaultPlan(seed=seed)
+                .add("transport.send", "error", "drop", prob=0.05)
+                .add("transport.send", "error", "dup", prob=0.05)
+                .add("transport.send", "delay", "1", prob=0.05))
+
+    ServingEngineWarmup(model, pre_kw)
+    ServingEngineWarmup(model, dec_kw)
+    drive_lossy(make_workload(seed + 12, 4, 200.0, vocab), mk_fleet(),
+                seed, (None, None), True, True, None)      # handoff warm
+
+    rows = {}
+    specs = (
+        ("lossy_faultfree", TransportConfig(), True, None),
+        ("lossy_resilient", TransportConfig(), True, mk_plan()),
+        ("lossy_naive", TransportConfig(max_attempts=1, dedup_window=0),
+         None, mk_plan()),
+    )
+    for name, cfg, member, plan in specs:
+        rows[name] = drive_lossy(workload, mk_fleet(), seed, slo, cfg,
+                                 member, plan)
+        r = rows[name]
+        c = r["transport"]["counters"]
+        print(f"[bench_serve] {name:15s}: {r['tokens_per_s']:8.1f} tok/s"
+              f"  slo {r['slo_attainment']:.2f}  parked {r['parked']}  "
+              f"failed {r['failed']}  pages {r['kv_handoffs']['pages']}"
+              f"  recompute {r['kv_handoffs']['recompute']}  dropped "
+              f"{c['dropped']}  deduped {c['deduped']}  retransmits "
+              f"{c['retransmits']}  giveups {c['giveups']}", flush=True)
+
+    oracle, res, naive = (rows["lossy_faultfree"],
+                          rows["lossy_resilient"], rows["lossy_naive"])
+    assert oracle["parked"] == 0 and oracle["failed"] == 0
+    assert oracle["transport"]["counters"]["retransmits"] == 0, \
+        "fault-free transport retransmitted — the clean path regressed"
+    rc = res["transport"]["counters"]
+    assert rc["dropped"] + rc["duplicate"] + rc["delayed"] > 0, \
+        "the lossy plan never fired — the bench has no teeth"
+    assert res["parked"] == 0 and res["failed"] == 0, \
+        "resilient row parked/failed requests on lossy links"
+    assert res["output_crc32"] == oracle["output_crc32"], \
+        "lossy-resilient outputs diverged from the fault-free oracle"
+    assert res["slo_attainment"] >= 0.95, \
+        f"lossy SLO attainment {res['slo_attainment']} < 0.95"
+    # the baseline converges CORRECTLY only because the engine guard
+    # and the recompute ladder catch what the transport no longer does
+    assert naive["parked"] == 0, "naive baseline wedged (parked)"
+    assert naive["output_crc32"] == oracle["output_crc32"] or \
+        naive["failed"] > 0, \
+        "naive baseline corrupted outputs without reporting failures"
+    rows["lossy_workload"] = {
+        "n_requests": n_requests, "rate_rps": rate, "poisson": True,
+        "open_loop": True, "replicas": 3,
+        "prefill_engine": pre_kw, "decode_engine": dec_kw,
+        "fault_plan": {"drop": 0.05, "dup": 0.05, "delay": 0.05},
+        "naive_transport": {"max_attempts": 1, "dedup_window": 0,
+                            "membership": False},
+        "slo": {"ttft_deadline_s": slo[0], "tpot_deadline_s": slo[1]}}
+    rows["lossy_slo_delta"] = round(
+        res["slo_attainment"] - (naive["slo_attainment"] or 0.0), 3)
+    rows["lossy_averted"] = {
+        "naive_recomputes": naive["kv_handoffs"]["recompute"],
+        "naive_giveups": naive["transport"]["counters"]["giveups"],
+        "naive_duplicates_delivered":
+            naive["transport"]["counters"]["duplicate"],
+        "resilient_deduped": rc["deduped"],
+        "resilient_retransmits": rc["retransmits"]}
+    return rows
+
+
 def drive_chaos(model, workload, engine_kw: dict, resilient: bool,
                 fault_at, seed: int, slo, max_waiting: int):
     """One overload+fault run. ``resilient=False`` reproduces the PR 6
@@ -1053,7 +1241,7 @@ def run_bench(fast: bool = True, seed: int = 0, tag: str = "fast",
               out_path: str = None, spec: bool = False,
               num_draft_tokens: int = 4, slo=None, chaos: bool = False,
               router: bool = False, disagg: bool = False,
-              elastic: bool = False):
+              elastic: bool = False, lossy: bool = False):
     model = _build_model(fast)
     vocab = model.config.vocab_size
     if fast:
@@ -1172,6 +1360,16 @@ def run_bench(fast: bool = True, seed: int = 0, tag: str = "fast",
                     "elastic_autoscaled", "elastic_replica_pass_ratio",
                     "elastic_slo_delta"):
             result[key] = erows[key]
+    if lossy:
+        # fault-domain rows: one lossy-link schedule, full reliability
+        # stack vs the no-dedup/no-lease baseline — crc equal to the
+        # fault-free oracle and SLO >= 0.95 the floor, the baseline's
+        # extra aborts/recomputes the measured cost
+        lrows = run_lossy_pair(seed, fast)
+        for key in ("lossy_workload", "lossy_faultfree",
+                    "lossy_resilient", "lossy_naive", "lossy_slo_delta",
+                    "lossy_averted"):
+            result[key] = lrows[key]
     if out_path is None:
         out_path = os.path.join(HERE, f"BENCH_SERVE_{tag}.json")
     tmp = out_path + ".tmp"
@@ -1193,6 +1391,10 @@ def run_bench(fast: bool = True, seed: int = 0, tag: str = "fast",
         ratios += (f" elastic_replica_pass_ratio="
                    f"{result['elastic_replica_pass_ratio']}"
                    f" elastic_slo_delta={result['elastic_slo_delta']}")
+    if lossy:
+        ratios += (f" lossy_slo="
+                   f"{result['lossy_resilient']['slo_attainment']}"
+                   f" lossy_slo_delta={result['lossy_slo_delta']}")
     print(f"[bench_serve] {ratios}  -> {out_path}", flush=True)
     return result
 
@@ -1238,6 +1440,11 @@ def main(argv=None):
                          "FleetAutoscaler-driven fleet on a seeded "
                          "10x-traffic-swing schedule (spawn into the "
                          "swing, lossless retire out of it)")
+    ap.add_argument("--lossy", action="store_true",
+                    help="add the fault-domain rows: a seeded 5%% "
+                         "drop+dup+delay plan against the full "
+                         "transport reliability stack vs the no-dedup/"
+                         "no-lease baseline")
     ap.add_argument("--draft-tokens", type=int, default=4,
                     help="per-sequence draft budget k for --spec")
     ap.add_argument("--out", default=None)
@@ -1248,11 +1455,13 @@ def main(argv=None):
                     out_path=args.out, spec=args.spec,
                     num_draft_tokens=args.draft_tokens, chaos=args.chaos,
                     router=args.router, disagg=args.disagg,
-                    elastic=args.elastic)
+                    elastic=args.elastic, lossy=args.lossy)
     ok = res["vs_static"] > 1.0 and res.get("vs_nonspec", 2.0) > 1.0 \
         and res.get("router_vs_single", 2.0) > 1.0 \
         and res.get("disagg_tpot_p99_ratio", 2.0) > 1.0 \
-        and res.get("elastic_replica_pass_ratio", 0.5) < 1.0
+        and res.get("elastic_replica_pass_ratio", 0.5) < 1.0 \
+        and (res.get("lossy_resilient") is None
+             or res["lossy_resilient"]["slo_attainment"] >= 0.95)
     return 0 if ok else 1
 
 

@@ -87,6 +87,29 @@ edge. Stable per seed.
 
     JAX_PLATFORMS=cpu python tools/chaos_drill.py --lockcheck [--seed 1234]
 
+``--partition`` runs the fault-domain partition drill
+(paddle_tpu.serving.transport + membership): a 1 prefill + 2 decode
+fleet on the armed transport serves a seeded workload through BOTH
+lease verdicts. Phase A partitions a decode replica and heals it
+INSIDE its lease: the replica goes live -> suspect -> live, dispatch
+avoids it while suspect, and NO salvage ever runs — the healed
+partition cannot double-decode. Phase B partitions it past the lease:
+exactly one suspect -> dead transition, exactly one salvage record
+(reason ``lease_expired``), zero parked, merged outputs equal the
+fault-free oracle. Deterministic per seed.
+
+    JAX_PLATFORMS=cpu python tools/chaos_drill.py --partition [--seed 1234]
+
+``--lossy`` runs the fault-domain lossy-link drill: the same fleet
+under a seeded 5% drop + 5% dup + 5% delay plan at the
+``transport.send`` seam. The dedup window and ack-tracked retransmits
+must absorb every fault: the fleet converges, zero requests park, no
+request ever receives a token twice (per-request callback counts equal
+output lengths), outputs equal the fault-free oracle, and a second run
+from the same seed reproduces the report bit-identically.
+
+    JAX_PLATFORMS=cpu python tools/chaos_drill.py --lossy [--seed 1234]
+
 ``--wirecheck`` runs the armed wire-contract drill
 (paddle_tpu.serving.wire, the runtime twin of the WIR1xx lint rules):
 the fleet-obs and elastic drills run twice each — sealing twin
@@ -1317,6 +1340,271 @@ def run_elastic_drill(seed: int = 1234, verbose: bool = True):
     return report
 
 
+def _mk_fabric_fleet(model, seed, membership_cfg):
+    """1 prefill + 2 decode on the armed transport/membership planes —
+    the fault-domain drills' shared fleet shape."""
+    from paddle_tpu.serving import (EngineConfig, ReplicaRouter,
+                                    ServingEngine)
+    pre = ServingEngine(model, EngineConfig(
+        max_seqs=2, token_budget=16, block_size=8, role="prefill"))
+    dec = [ServingEngine(model, EngineConfig(
+        max_seqs=2, token_budget=8, block_size=8, role="decode"))
+        for _ in range(2)]
+    return ReplicaRouter([pre] + dec, policy="affinity", seed=seed,
+                         transport=True, membership=membership_cfg)
+
+
+def _fabric_serve(router, prompts, max_new, hook=None, max_passes=900):
+    """Drive a fabric fleet to convergence with per-request exactly-once
+    token counting; returns (handles, counts)."""
+    counts = {}
+    handles = []
+    for i, p in enumerate(prompts):
+        counts[i] = 0
+
+        def cb(tok, i=i):
+            counts[i] += 1
+        handles.append(router.submit(p, max_new_tokens=max_new,
+                                     on_token=cb, tag=i))
+    n = 0
+    while True:
+        more = router.step_all()
+        n += 1
+        if hook is not None:
+            hook(n, router)
+        if not more:
+            return handles, counts
+        assert n < max_passes, "fabric fleet did not converge"
+
+
+def _merge_outputs(handles, extra=()):
+    """Original + replacement handles -> {tag: tokens}; parked count."""
+    merged, parked = {}, 0
+    for h in list(handles) + list(extra):
+        if not h.done:
+            parked += 1
+        elif h.error is None:
+            merged[h.tag["tag"]] = h.result(0)
+    return merged, parked
+
+
+def run_partition_drill(seed: int = 1234, verbose: bool = True):
+    """Seeded partition-then-heal drill for the fault-domain fabric
+    (serving/transport.py + serving/membership.py): the lease machine's
+    two verdicts, each taken exactly once.
+
+    Phase A (healed inside the lease): a decode replica is partitioned
+    mid-workload and healed before ``lease_ticks`` run out. Asserts the
+    replica went live -> suspect -> live (and NEVER dead), no salvage
+    record was written, outputs equal the fault-free oracle, and no
+    request received a token twice — the healed-partition/double-decode
+    hole the SUSPECT state exists to close.
+
+    Phase B (lease expiry): the same partition never heals. Asserts
+    exactly one suspect -> dead transition, exactly one salvage record
+    with reason ``lease_expired``, every original handle resolved
+    (replacements in the record finish the work), zero parked, and
+    merged outputs equal the fault-free oracle. The ``stable`` report
+    subset is bit-identical per seed."""
+    import zlib
+
+    import numpy as np
+
+    from paddle_tpu.serving import MembershipConfig
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(repo, "tests"))
+    import serve_worker
+
+    model = serve_worker.build_model(seed)
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(1, 61, (int(rng.integers(4, 12)),)).tolist()
+               for _ in range(6)]
+    max_new = 6
+
+    # -- fault-free oracle (armed fabric, no partition) -----------------------
+    oracle_router = _mk_fabric_fleet(
+        model, seed, MembershipConfig(suspect_after=3, lease_ticks=12))
+    oracle_handles, oracle_counts = _fabric_serve(
+        oracle_router, prompts, max_new)
+    oracle, parked = _merge_outputs(oracle_handles)
+    assert parked == 0 and len(oracle) == len(prompts)
+    assert not oracle_router.handoffs, \
+        "fault-free fabric run replayed a manifest"
+    assert oracle_counts == {i: len(oracle[i]) for i in oracle}
+
+    # -- phase A: partition heals inside the lease ----------------------------
+    def heal_hook(n, router):
+        if n == 2:
+            router.transport.partition(2)
+        elif n == 10:
+            router.transport.heal(2)
+
+    r_a = _mk_fabric_fleet(
+        model, seed, MembershipConfig(suspect_after=3, lease_ticks=12))
+    handles_a, counts_a = _fabric_serve(r_a, prompts, max_new,
+                                        hook=heal_hook)
+    out_a, parked_a = _merge_outputs(handles_a)
+    trans_a = r_a.membership.telemetry()["transition_counts"]
+    assert parked_a == 0, f"{parked_a} requests parked across the heal"
+    assert out_a == oracle, \
+        "healed-partition outputs diverged from the fault-free oracle"
+    assert counts_a == {i: len(out_a[i]) for i in out_a}, \
+        "a request received tokens twice across the healed partition"
+    assert trans_a.get("suspect->live", 0) >= 1, \
+        f"partition never suspected/healed: {trans_a}"
+    assert "suspect->dead" not in trans_a and "live->dead" not in trans_a
+    assert not r_a.handoffs, \
+        "healed partition was salvaged — the double-decode hole"
+
+    # -- phase B: the partition outlives the lease. The node is frozen
+    # AND unreachable (a crash, not a slow link): the moment it holds
+    # live decode work, its step stops making progress and its links
+    # go down — so real requests are stranded there at lease expiry
+    cut = {"done": False}
+
+    def kill_hook(n, router):
+        eng = router.replicas[2]
+        if not cut["done"] and (eng.sched.running or eng.sched.waiting):
+            cut["done"] = True
+            router.transport.partition(2)
+            eng.step = lambda: False     # frozen: alive but inert
+
+    r_b = _mk_fabric_fleet(
+        model, seed, MembershipConfig(suspect_after=2, lease_ticks=5))
+    handles_b, counts_b = _fabric_serve(r_b, prompts, max_new,
+                                        hook=kill_hook)
+    assert cut["done"], "no decode work ever landed on replica 2"
+    trans_b = r_b.membership.telemetry()["transition_counts"]
+    assert trans_b.get("suspect->dead", 0) == 1, \
+        f"lease expiry fired {trans_b.get('suspect->dead', 0)} times"
+    salvages = [rec for rec in r_b.handoffs
+                if rec["reason"] == "lease_expired"]
+    assert len(salvages) == 1 and len(r_b.handoffs) == 1, \
+        f"expected exactly one lease-expiry salvage, got {r_b.handoffs}"
+    assert salvages[0]["requests"] > 0, \
+        "lease expired with nothing to salvage — drill lost its teeth"
+    out_b, parked_b = _merge_outputs(handles_b,
+                                     extra=salvages[0]["handles"])
+    assert parked_b == 0, f"{parked_b} requests parked across expiry"
+    assert out_b == oracle, \
+        "post-expiry outputs diverged from the fault-free oracle"
+    assert not r_b.transport.busy() and not r_b._inflight, \
+        "fabric did not quiesce after the lease-expiry salvage"
+
+    oracle_crc = zlib.crc32(np.asarray(
+        [t for i in sorted(oracle) for t in oracle[i]],
+        np.int64).tobytes())
+    report = {
+        "seed": seed, "ok": True,
+        "stable": {
+            "oracle_crc": oracle_crc,
+            "heal_transitions": dict(sorted(trans_a.items())),
+            "expiry_transitions": dict(sorted(trans_b.items())),
+            "salvaged_requests": salvages[0]["requests"],
+            "salvage_groups": [
+                {"affinity": g["affinity"], "target": g["target"],
+                 "orders": g["orders"]} for g in salvages[0]["groups"]],
+        },
+    }
+    if verbose:
+        print(f"partition drill (seed={seed}): healed partition "
+              f"suspect->live with 0 salvages and outputs == oracle "
+              f"(crc {oracle_crc}); unhealed partition expired its "
+              f"lease exactly once -> {salvages[0]['requests']} "
+              f"request(s) salvaged, 0 parked, merged outputs == "
+              "oracle — lease machine verified on both verdicts")
+    return report
+
+
+def run_lossy_drill(seed: int = 1234, verbose: bool = True):
+    """Seeded lossy-link drill: 5% drop + 5% dup + 5% delay at the
+    ``transport.send`` seam over the full fabric fleet. The reliability
+    mechanisms must make the loss invisible above the transport:
+    convergence, zero parked, exactly-once token delivery, outputs
+    equal to the fault-free oracle, and faults demonstrably FIRED
+    (a lossy drill that loses nothing has no teeth). Runs the whole
+    scenario twice from one seed and asserts the reports are
+    bit-identical."""
+    import zlib
+
+    import numpy as np
+
+    from paddle_tpu.resilience import chaos
+    from paddle_tpu.serving import MembershipConfig
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(repo, "tests"))
+    import serve_worker
+
+    model = serve_worker.build_model(seed)
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(1, 61, (int(rng.integers(4, 12)),)).tolist()
+               for _ in range(6)]
+    max_new = 6
+
+    oracle_router = _mk_fabric_fleet(
+        model, seed, MembershipConfig(suspect_after=3, lease_ticks=12))
+    oracle_handles, _ = _fabric_serve(oracle_router, prompts, max_new)
+    oracle, parked = _merge_outputs(oracle_handles)
+    assert parked == 0
+    assert oracle_router.transport.counters["retransmits"] == 0, \
+        "fault-free fabric run retransmitted — the clean path regressed"
+
+    def lossy_run():
+        chaos.install_plan(
+            chaos.FaultPlan(seed=seed)
+            .add("transport.send", "error", "drop", prob=0.05)
+            .add("transport.send", "error", "dup", prob=0.05)
+            .add("transport.send", "delay", "1", prob=0.05))
+        try:
+            r = _mk_fabric_fleet(model, seed, MembershipConfig(
+                suspect_after=3, lease_ticks=12))
+            handles, counts = _fabric_serve(r, prompts, max_new)
+        finally:
+            chaos.clear_plan()
+        merged, parked = _merge_outputs(handles)
+        c = r.transport.counters
+        assert parked == 0, f"{parked} requests parked on lossy links"
+        assert merged == oracle, \
+            "lossy-link outputs diverged from the fault-free oracle"
+        assert counts == {i: len(merged[i]) for i in merged}, \
+            "a request received tokens twice through the lossy links"
+        assert c["dropped"] + c["duplicate"] + c["delayed"] > 0, \
+            "no fault ever fired — the lossy drill has no teeth"
+        assert c["duplicate"] == 0 or c["deduped"] >= 0
+        assert not r.transport.busy() and not r._inflight, \
+            "fabric did not quiesce after the lossy run"
+        return {
+            "outputs_crc": zlib.crc32(np.asarray(
+                [t for i in sorted(merged) for t in merged[i]],
+                np.int64).tobytes()),
+            "counters": dict(c),
+            "retries_by_site": dict(sorted(
+                r.transport.retries_by_site.items())),
+            "handoff_outcomes": dict(r.kv_handoffs),
+        }
+
+    first = lossy_run()
+    second = lossy_run()
+    assert first == second, \
+        f"lossy run not bit-stable per seed:\n{first}\nvs\n{second}"
+    assert first["outputs_crc"] == zlib.crc32(np.asarray(
+        [t for i in sorted(oracle) for t in oracle[i]],
+        np.int64).tobytes())
+
+    report = {"seed": seed, "ok": True, "stable": first}
+    if verbose:
+        c = first["counters"]
+        print(f"lossy drill (seed={seed}): 5% drop+dup+delay absorbed "
+              f"— {c['dropped']} dropped / {c['duplicate']} duplicated "
+              f"({c['deduped']} deduped) / {c['delayed']} delayed / "
+              f"{c['retransmits']} retransmit(s), 0 parked, outputs == "
+              f"fault-free oracle (crc {first['outputs_crc']}), "
+              "double-run bit-identical — lossy-link fabric verified")
+    return report
+
+
 def run_lockcheck_drill(seed: int = 1234, verbose: bool = True):
     """Armed ordered-lock drill (serving/locking.py, PADDLE_LOCKCHECK).
 
@@ -1366,6 +1654,18 @@ def run_lockcheck_drill(seed: int = 1234, verbose: bool = True):
     assert out_on == out_off, \
         "arming the lock twin perturbed the served tokens"
     crc = zlib.crc32(json.dumps(out_on).encode()) & 0xFFFFFFFF
+
+    # the fault-domain fabric walks the longest armed lock chain in the
+    # tree (router -> transport -> membership -> engine -> observer):
+    # the partition drill under enforcement must change nothing
+    locking.arm(True)
+    try:
+        fabric_on = run_partition_drill(seed=seed, verbose=False)
+    finally:
+        locking.arm(False)
+    fabric_off = run_partition_drill(seed=seed, verbose=False)
+    assert fabric_on["stable"] == fabric_off["stable"], \
+        "arming the lock twin perturbed the partition drill"
 
     def plant():
         caught = []
@@ -1461,10 +1761,15 @@ def run_wirecheck_drill(seed: int = 1234, verbose: bool = True):
         try:
             fleet = run_fleet_obs_drill(seed=seed, verbose=False)
             elastic = run_elastic_drill(seed=seed, verbose=False)
+            # the fault-domain fabric seals kv_transfer_ack +
+            # membership_lease at rates no other drill reaches (every
+            # heartbeat, every two-phase ack, every retransmitted dup)
+            lossy = run_lossy_drill(seed=seed, verbose=False)
         finally:
             wire.arm(False)
         return {"fleet_obs": fleet["stable"],
-                "elastic": elastic["stable"]}
+                "elastic": elastic["stable"],
+                "lossy": lossy["stable"]}
 
     off = both(False)
     on = both(True)
@@ -1572,6 +1877,13 @@ def main(argv=None):
                          "backoff-and-hold; retire-during-burst "
                          "replays its manifest onto survivors; stable "
                          "per seed)")
+    ap.add_argument("--partition", action="store_true",
+                    help="run the fault-domain partition drill "
+                         "(partition-then-heal = suspect, no salvage; "
+                         "lease expiry = exactly one salvage)")
+    ap.add_argument("--lossy", action="store_true",
+                    help="run the fault-domain lossy-link drill "
+                         "(5%% drop+dup+delay absorbed bit-identically)")
     ap.add_argument("--lockcheck", action="store_true",
                     help="run the armed ordered-lock drill (armed "
                          "serving run bit-identical to disarmed; a "
@@ -1610,6 +1922,11 @@ def main(argv=None):
     elif args.elastic:
         report = run_elastic_drill(seed=args.seed,
                                    verbose=not args.json)
+    elif args.partition:
+        report = run_partition_drill(seed=args.seed,
+                                     verbose=not args.json)
+    elif args.lossy:
+        report = run_lossy_drill(seed=args.seed, verbose=not args.json)
     elif args.lockcheck:
         report = run_lockcheck_drill(seed=args.seed,
                                      verbose=not args.json)

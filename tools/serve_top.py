@@ -174,6 +174,12 @@ def render_fleet_signals(sig: dict, prev: dict = None) -> str:
             n[k] = n.get(k, 0) + 1
         counts = "  ".join(f"{a}/{o} {c}" for (a, o), c in sorted(n.items()))
         lines.append(f"autoscale {len(scale)} decisions  {counts}")
+        holds = [e for e in scale if e.get("outcome") == "backoff_hold"]
+        if holds:
+            until = (holds[-1].get("detail") or {}).get("backoff_until")
+            lines.append(
+                f"  hold-down {len(holds)} held"
+                + (f"  (until tick {until})" if until is not None else ""))
         for e in scale[-3:]:
             who = "" if e.get("replica") is None else f" r{e['replica']}"
             why = e.get("reason") or e.get("rule")
@@ -239,6 +245,36 @@ def render_router(tel: dict, prev: dict = None) -> str:
             f"handoff   pages {kh.get('pages', 0)}  recompute "
             f"{kh.get('recompute', 0)}  failed {kh.get('failed', 0)}  "
             f"kv pages moved {kh.get('pages_moved', 0)}")
+    tp = router.get("transport")
+    if tp:
+        # fault-domain fabric: the chaos-injectable transport's loss/
+        # recovery economics + the per-site retry/give-up breakdown
+        c = tp.get("counters", {})
+        lines.append(
+            f"transport tick {tp.get('tick', 0)}  inflight "
+            f"{tp.get('in_flight', 0)}  pending acks "
+            f"{tp.get('pending_acks', 0)}  dropped {c.get('dropped', 0)}"
+            f"  deduped {c.get('deduped', 0)}  retransmits "
+            f"{c.get('retransmits', 0)}  giveups {c.get('giveups', 0)}")
+        retries = tp.get("retries_by_site", {})
+        giveups = tp.get("giveups_by_site", {})
+        if retries or giveups:
+            sites = sorted(set(retries) | set(giveups))
+            lines.append("  " + "  ".join(
+                f"{s.split('.')[-1]} r{retries.get(s, 0)}"
+                f"/g{giveups.get(s, 0)}" for s in sites))
+        parts = tp.get("partitioned")
+        if parts:
+            lines.append(f"  partitioned: {parts}")
+        ms = router.get("membership")
+        if ms:
+            st = ms.get("states", {})
+            tc = ms.get("transition_counts", {})
+            trans = "  ".join(f"{k} {v}" for k, v in sorted(tc.items()))
+            lines.append(
+                f"leases    live {st.get('live', 0)}  suspect "
+                f"{st.get('suspect', 0)}  dead {st.get('dead', 0)}"
+                + (f"   {trans}" if trans else ""))
     pool = fleet["pool"]
     util = pool.get("utilization", 0.0)
     prefix = fleet["prefix"]
